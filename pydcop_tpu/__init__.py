@@ -1,0 +1,24 @@
+"""pydcop_tpu — a TPU-native framework for Distributed Constraint
+Optimization Problems.
+
+A ground-up re-design of the capabilities of pyDCOP
+(https://github.com/Orange-OpenSource/pyDcop) for TPU hardware:
+the message-passing agent runtime is replaced by a compiled synchronous
+engine in which one algorithm round over the *entire* computation graph is
+a single jitted XLA program over stacked, padded arrays; agents,
+distribution and orchestration live host-side as the control plane.
+"""
+
+__version__ = "0.1.0"
+
+from .dcop import DCOP, load_dcop, load_dcop_from_file  # noqa: F401
+
+
+def solve(dcop, algo_def, distribution="oneagent", timeout=5, **kwargs):
+    """One-call solve API (parity: pydcop/infrastructure/run.py:52).
+
+    Lazy import so that model-layer users don't pay for jax startup.
+    """
+    from .infrastructure.run import solve as _solve
+
+    return _solve(dcop, algo_def, distribution, timeout=timeout, **kwargs)

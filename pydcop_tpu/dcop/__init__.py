@@ -1,0 +1,45 @@
+from .dcop import DCOP, filter_dcop
+from .objects import (
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableDomain,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    create_agents,
+    create_binary_variables,
+    create_variables,
+)
+from .relations import (
+    AsNAryFunctionRelation,
+    Constraint,
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    constraint_from_str,
+    join,
+    projection,
+)
+from .scenario import DcopEvent, EventAction, Scenario
+from .yamldcop import (
+    dcop_yaml,
+    load_dcop,
+    load_dcop_from_file,
+    load_scenario,
+    load_scenario_from_file,
+)
+
+__all__ = [
+    "DCOP", "filter_dcop",
+    "AgentDef", "BinaryVariable", "Domain", "ExternalVariable", "Variable",
+    "VariableDomain", "VariableNoisyCostFunc", "VariableWithCostDict",
+    "VariableWithCostFunc", "create_agents", "create_binary_variables",
+    "create_variables",
+    "AsNAryFunctionRelation", "Constraint", "NAryFunctionRelation",
+    "NAryMatrixRelation", "constraint_from_str", "join", "projection",
+    "DcopEvent", "EventAction", "Scenario",
+    "dcop_yaml", "load_dcop", "load_dcop_from_file", "load_scenario",
+    "load_scenario_from_file",
+]

@@ -1,0 +1,197 @@
+"""The DCOP container object.
+
+reference parity: pydcop/dcop/dcop.py:41-422.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+from .objects import AgentDef, Domain, ExternalVariable, Variable
+from .relations import (
+    Constraint,
+    UnaryFunctionRelation,
+    assignment_cost,
+)
+
+
+class DCOP:
+    """A complete DCOP: domains, variables, constraints, agents.
+
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> dcop = DCOP('test')
+    >>> d = Domain('colors', 'color', ['R', 'G'])
+    >>> v1 = Variable('v1', d)
+    >>> dcop += v1
+    >>> 'v1' in dcop.variables
+    True
+    """
+
+    def __init__(self, name: str = "dcop", objective: str = "min",
+                 description: str = "",
+                 domains: Optional[Dict[str, Domain]] = None,
+                 variables: Optional[Dict[str, Variable]] = None,
+                 constraints: Optional[Dict[str, Constraint]] = None,
+                 agents: Optional[Dict[str, AgentDef]] = None):
+        if objective not in ("min", "max"):
+            raise ValueError(f"Invalid objective {objective!r}")
+        self.name = name
+        self.objective = objective
+        self.description = description
+        self.domains: Dict[str, Domain] = domains or {}
+        self.variables: Dict[str, Variable] = variables or {}
+        self.external_variables: Dict[str, ExternalVariable] = {}
+        self.constraints: Dict[str, Constraint] = constraints or {}
+        self.agents: Dict[str, AgentDef] = agents or {}
+        self.dist_hints = None
+
+    # --- accessors -------------------------------------------------------
+
+    def domain(self, name: str) -> Domain:
+        return self.domains[name]
+
+    def variable(self, name: str) -> Variable:
+        if name in self.variables:
+            return self.variables[name]
+        if name in self.external_variables:
+            return self.external_variables[name]
+        raise KeyError(f"Unknown variable {name}")
+
+    def constraint(self, name: str) -> Constraint:
+        return self.constraints[name]
+
+    def agent(self, name: str) -> AgentDef:
+        return self.agents[name]
+
+    @property
+    def all_variables(self) -> List[Variable]:
+        return list(self.variables.values()) + list(
+            self.external_variables.values()
+        )
+
+    @property
+    def agents_def(self) -> List[AgentDef]:
+        return list(self.agents.values())
+
+    def variables_of(self, constraint: Union[str, Constraint]) -> List[Variable]:
+        if isinstance(constraint, str):
+            constraint = self.constraints[constraint]
+        return constraint.dimensions
+
+    def constraints_of(self, variable: Union[str, Variable]) -> List[Constraint]:
+        name = variable if isinstance(variable, str) else variable.name
+        return [
+            c for c in self.constraints.values()
+            if name in c.scope_names
+        ]
+
+    # --- mutation --------------------------------------------------------
+
+    def add_domain(self, domain: Domain):
+        self.domains[domain.name] = domain
+
+    def add_variable(self, variable: Variable):
+        if isinstance(variable, ExternalVariable):
+            self.external_variables[variable.name] = variable
+        else:
+            self.variables[variable.name] = variable
+        if variable.domain.name not in self.domains:
+            self.domains[variable.domain.name] = variable.domain
+
+    def add_constraint(self, constraint: Constraint):
+        """Add a constraint; its variables are auto-registered
+        (reference: dcop.py:120-140)."""
+        self.constraints[constraint.name] = constraint
+        for v in constraint.dimensions:
+            if v.name not in self.variables and \
+                    v.name not in self.external_variables:
+                self.add_variable(v)
+
+    def add_agents(self, agents: Union[Iterable[AgentDef], Dict[Any, AgentDef]]):
+        if isinstance(agents, dict):
+            agents = agents.values()
+        for a in agents:
+            self.agents[a.name] = a
+
+    def __iadd__(self, other):
+        if isinstance(other, Constraint):
+            self.add_constraint(other)
+        elif isinstance(other, Variable):
+            self.add_variable(other)
+        elif isinstance(other, AgentDef):
+            self.agents[other.name] = other
+        elif isinstance(other, Domain):
+            self.add_domain(other)
+        elif isinstance(other, (list, tuple)):
+            for o in other:
+                self.__iadd__(o)
+        elif isinstance(other, dict):
+            for o in other.values():
+                self.__iadd__(o)
+        else:
+            raise TypeError(f"Cannot add {other!r} to DCOP")
+        return self
+
+    # --- evaluation ------------------------------------------------------
+
+    def solution_cost(self, assignment: Dict[str, Any],
+                      infinity: float = float("inf")) -> Tuple[float, int]:
+        """Cost of a full assignment and number of hard-constraint
+        violations (reference: dcop.py:308-369)."""
+        missing = set(self.variables) - set(assignment)
+        if missing:
+            raise ValueError(
+                f"Assignment is missing values for {sorted(missing)}"
+            )
+        cost, violations = 0.0, 0
+        for c in self.constraints.values():
+            scoped = {}
+            for v in c.dimensions:
+                if isinstance(v, ExternalVariable):
+                    scoped[v.name] = v.value
+                else:
+                    scoped[v.name] = assignment[v.name]
+            c_cost = c(**scoped)
+            if c_cost == float("inf") or (infinity != float("inf")
+                                          and c_cost >= infinity):
+                violations += 1
+                cost += infinity if infinity != float("inf") else 0
+            else:
+                cost += c_cost
+        for v_name, v in self.variables.items():
+            cost += v.cost_for_val(assignment[v_name])
+        return cost, violations
+
+
+def filter_dcop(dcop: DCOP) -> DCOP:
+    """Fold unary constraints into variable costs
+    (reference: dcop.py:370-422): every unary constraint is removed and its
+    cost becomes (part of) the variable's cost function."""
+    from .objects import VariableWithCostDict
+
+    filtered = DCOP(
+        dcop.name, dcop.objective, dcop.description,
+        domains=dict(dcop.domains), agents=dict(dcop.agents),
+    )
+    filtered.dist_hints = dcop.dist_hints
+    unary: Dict[str, List[Constraint]] = {}
+    for c in dcop.constraints.values():
+        if c.arity == 1:
+            unary.setdefault(c.dimensions[0].name, []).append(c)
+        else:
+            filtered.add_constraint(c)
+    for v_name, v in dcop.variables.items():
+        if v_name in unary:
+            costs = {
+                val: v.cost_for_val(val) + sum(
+                    c(**{v_name: val}) for c in unary[v_name]
+                )
+                for val in v.domain
+            }
+            filtered.add_variable(
+                VariableWithCostDict(v_name, v.domain, costs,
+                                     v.initial_value)
+            )
+        else:
+            filtered.add_variable(v)
+    for ev in dcop.external_variables.values():
+        filtered.add_variable(ev)
+    return filtered

@@ -1,0 +1,526 @@
+"""DCOP model objects: domains, variables, agent definitions.
+
+TPU-native re-design of the reference model layer
+(reference: pydcop/dcop/objects.py:46-975).  Semantics match the reference —
+named typed domains, decision variables with optional (possibly noisy) cost
+functions, external (sensor) variables, agent definitions with capacity /
+hosting costs / routes — but every domain also knows its *index space* so
+that constraints can be lifted into dense cost tensors and variables can be
+identified by integer ids inside jitted kernels.
+"""
+
+import random
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from ..utils.expressionfunction import ExpressionFunction
+from ..utils.simple_repr import SimpleRepr, SimpleReprException, simple_repr
+
+
+class Domain(SimpleRepr):
+    """A named, typed, finite list of values.
+
+    reference parity: pydcop/dcop/objects.py:46-174 (``VariableDomain``).
+
+    >>> d = Domain('colors', 'color', ['R', 'G', 'B'])
+    >>> len(d)
+    3
+    >>> d.index('G')
+    1
+    >>> d.to_domain_value('B')
+    (2, 'B')
+    """
+
+    def __init__(self, name: str, domain_type: str, values: Iterable):
+        self._name = name
+        self._domain_type = domain_type
+        self._values = tuple(values)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def type(self) -> str:
+        return self._domain_type
+
+    @property
+    def values(self) -> Tuple:
+        return self._values
+
+    def index(self, val) -> int:
+        return self._values.index(val)
+
+    def to_domain_value(self, val: str) -> Tuple[int, Any]:
+        """Find the domain value whose string form is ``val``.
+
+        Returns ``(index, value)``.  Used when parsing extensional
+        constraints from YAML, where assignments are strings.
+        """
+        for i, v in enumerate(self._values):
+            if str(v) == val:
+                return i, v
+        raise ValueError(f"{val!r} is not in domain {self._name}")
+
+    def __len__(self):
+        return len(self._values)
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __getitem__(self, i):
+        return self._values[i]
+
+    def __contains__(self, v):
+        return v in self._values
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, Domain)
+            and self._name == o._name
+            and self._values == o._values
+            and self._domain_type == o._domain_type
+        )
+
+    def __hash__(self):
+        return hash((self._name, self._domain_type, self._values))
+
+    def __repr__(self):
+        return f"Domain({self._name!r}, {self._domain_type!r}, {list(self._values)})"
+
+    def __str__(self):
+        return f"Domain({self._name})"
+
+    def _simple_repr(self):
+        r = super()._simple_repr()
+        r["values"] = list(self._values)
+        return r
+
+
+# Backwards-compatible alias (the reference exposes ``VariableDomain``).
+VariableDomain = Domain
+
+binary_domain = Domain("binary", "binary", [0, 1])
+
+
+class Variable(SimpleRepr):
+    """A decision variable with a finite domain.
+
+    reference parity: pydcop/dcop/objects.py:175-334.
+    """
+
+    has_cost = False
+
+    def __init__(self, name: str, domain: Union[Domain, Iterable],
+                 initial_value=None):
+        self._name = name
+        if not isinstance(domain, Domain):
+            domain = Domain(f"d_{name}", "unnamed", list(domain))
+        self._domain = domain
+        if initial_value is not None and initial_value not in domain:
+            raise ValueError(
+                f"Invalid initial value {initial_value!r} for variable "
+                f"{name}: not in domain {domain.name}"
+            )
+        self._initial_value = initial_value
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def domain(self) -> Domain:
+        return self._domain
+
+    @property
+    def initial_value(self):
+        return self._initial_value
+
+    def cost_for_val(self, val) -> float:
+        return 0.0
+
+    def clone(self) -> "Variable":
+        return Variable(self._name, self._domain, self._initial_value)
+
+    def __eq__(self, o):
+        return (
+            type(o) is type(self)
+            and self._name == o.name
+            and self._domain == o.domain
+            and self._initial_value == o.initial_value
+        )
+
+    def __hash__(self):
+        return hash(("Variable", self._name, self._domain))
+
+    def __repr__(self):
+        return f"Variable({self._name!r}, {self._domain})"
+
+    def __str__(self):
+        return f"Variable({self._name})"
+
+
+class BinaryVariable(Variable):
+    """A 0/1 variable (used by the repair DCOP).
+
+    reference parity: pydcop/dcop/objects.py:335-409.
+    """
+
+    def __init__(self, name: str, initial_value=0):
+        super().__init__(name, binary_domain, initial_value)
+
+    def clone(self):
+        return BinaryVariable(self._name, self._initial_value)
+
+    def __repr__(self):
+        return f"BinaryVariable({self._name!r})"
+
+
+class VariableWithCostDict(Variable):
+    """Variable with an explicit per-value cost mapping.
+
+    reference parity: pydcop/dcop/objects.py:410-463.
+    """
+
+    has_cost = True
+
+    def __init__(self, name: str, domain: Union[Domain, Iterable],
+                 costs: Dict[Any, float], initial_value=None):
+        super().__init__(name, domain, initial_value)
+        self._costs = dict(costs)
+
+    def cost_for_val(self, val) -> float:
+        return self._costs.get(val, 0.0)
+
+    def clone(self):
+        return VariableWithCostDict(
+            self._name, self._domain, self._costs, self._initial_value
+        )
+
+    def __eq__(self, o):
+        return super().__eq__(o) and self._costs == o._costs
+
+    def __hash__(self):
+        return hash(("VariableWithCostDict", self._name, self._domain))
+
+    def __repr__(self):
+        return f"VariableWithCostDict({self._name!r}, {self._domain}, {self._costs})"
+
+
+class VariableWithCostFunc(Variable):
+    """Variable whose cost is given by a function of its value.
+
+    reference parity: pydcop/dcop/objects.py:464-546.
+    """
+
+    has_cost = True
+
+    def __init__(self, name: str, domain: Union[Domain, Iterable],
+                 cost_func: Union[Callable, ExpressionFunction],
+                 initial_value=None):
+        super().__init__(name, domain, initial_value)
+        if isinstance(cost_func, ExpressionFunction):
+            if list(cost_func.variable_names) != [name]:
+                raise ValueError(
+                    f"Cost function for {name} must depend only on {name}: "
+                    f"{cost_func.expression}"
+                )
+        self._cost_func = cost_func
+
+    @property
+    def cost_func(self):
+        return self._cost_func
+
+    def cost_for_val(self, val) -> float:
+        if isinstance(self._cost_func, ExpressionFunction):
+            return self._cost_func(**{self._name: val})
+        return self._cost_func(val)
+
+    def clone(self):
+        return VariableWithCostFunc(
+            self._name, self._domain, self._cost_func, self._initial_value
+        )
+
+    def __eq__(self, o):
+        if type(o) is not type(self):
+            return False
+        if self._name != o.name or self._domain != o.domain:
+            return False
+        return all(
+            self.cost_for_val(v) == o.cost_for_val(v) for v in self._domain
+        )
+
+    def __hash__(self):
+        return hash(("VariableWithCostFunc", self._name, self._domain))
+
+    def __repr__(self):
+        return f"VariableWithCostFunc({self._name!r}, {self._domain})"
+
+    def _simple_repr(self):
+        if not isinstance(self._cost_func, ExpressionFunction):
+            raise SimpleReprException(
+                "Cannot serialize a variable with an arbitrary python "
+                "callable cost, use an ExpressionFunction instead"
+            )
+        r = super()._simple_repr()
+        return r
+
+
+class VariableNoisyCostFunc(VariableWithCostFunc):
+    """Cost-function variable with additive per-value noise.
+
+    Noise breaks symmetry between equal-cost values, which many local-search
+    and max-sum variants rely on (reference: pydcop/dcop/objects.py:547-617).
+    Noise values are drawn once at construction so cost lookups stay
+    deterministic afterwards.
+    """
+
+    has_cost = True
+
+    def __init__(self, name: str, domain: Union[Domain, Iterable],
+                 cost_func, initial_value=None, noise_level: float = 0.02):
+        super().__init__(name, domain, cost_func, initial_value)
+        self._noise_level = noise_level
+        self._noise = {v: random.uniform(0, noise_level) for v in self.domain}
+
+    @property
+    def noise_level(self) -> float:
+        return self._noise_level
+
+    def noise_for_val(self, val) -> float:
+        return self._noise[val]
+
+    def cost_for_val(self, val) -> float:
+        return super().cost_for_val(val) + self._noise[val]
+
+    def clone(self):
+        return VariableNoisyCostFunc(
+            self._name, self._domain, self._cost_func, self._initial_value,
+            self._noise_level,
+        )
+
+    def __eq__(self, o):
+        if type(o) is not type(self):
+            return False
+        return (
+            self._name == o.name
+            and self._domain == o.domain
+            and self._noise_level == o.noise_level
+        )
+
+    def __hash__(self):
+        return hash(("VariableNoisyCostFunc", self._name, self._domain))
+
+
+class ExternalVariable(Variable):
+    """A non-decision variable whose value is set from outside (sensor).
+
+    Supports value-change subscription callbacks
+    (reference: pydcop/dcop/objects.py:618-668).
+    """
+
+    def __init__(self, name: str, domain: Union[Domain, Iterable],
+                 value=None):
+        super().__init__(name, domain, value)
+        self._cb = []
+        self._value = value if value is not None else domain.values[0] \
+            if isinstance(domain, Domain) else list(domain)[0]
+
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        if v == self._value:
+            return
+        if v not in self._domain:
+            raise ValueError(
+                f"Invalid value {v!r} for external variable {self._name}"
+            )
+        self._value = v
+        for cb in self._cb:
+            cb(v)
+
+    def subscribe(self, callback):
+        self._cb.append(callback)
+
+    def unsubscribe(self, callback):
+        self._cb.remove(callback)
+
+    def clone(self):
+        return ExternalVariable(self._name, self._domain, self._value)
+
+
+def create_variables(name_prefix: str, indexes, domain: Domain,
+                     separator: str = "_") -> Dict:
+    """Mass-create variables over one or several index collections.
+
+    reference parity: pydcop/dcop/objects.py:258-334.
+
+    >>> vs = create_variables('v', ['a', 'b'], Domain('d', 'd', [0, 1]))
+    >>> sorted(vs)
+    ['v_a', 'v_b']
+    """
+    variables = {}
+    if isinstance(indexes, range):
+        indexes = list(indexes)
+    if isinstance(indexes, list) and indexes and isinstance(indexes[0], (list, tuple, range)):
+        import itertools
+
+        for combi in itertools.product(*indexes):
+            key = tuple(str(i) for i in combi)
+            name = name_prefix + separator.join(key)
+            variables[key] = Variable(name, domain)
+    elif isinstance(indexes, list):
+        for i in indexes:
+            name = f"{name_prefix}{separator}{i}" if separator else f"{name_prefix}{i}"
+            variables[name] = Variable(name, domain)
+    else:
+        raise TypeError(f"Invalid indexes for create_variables: {indexes!r}")
+    return variables
+
+
+def create_binary_variables(name_prefix: str, indexes,
+                            separator: str = "_") -> Dict:
+    """Mass-create binary variables (reference: objects.py:349-409)."""
+    variables = {}
+    if isinstance(indexes, range):
+        indexes = list(indexes)
+    if isinstance(indexes, list) and indexes and isinstance(indexes[0], (list, tuple, range)):
+        import itertools
+
+        for combi in itertools.product(*indexes):
+            key = tuple(combi)
+            name = name_prefix + separator.join(str(i) for i in combi)
+            variables[key] = BinaryVariable(name)
+    elif isinstance(indexes, list):
+        for i in indexes:
+            name = f"{name_prefix}{separator}{i}" if separator else f"{name_prefix}{i}"
+            variables[name] = BinaryVariable(name)
+    else:
+        raise TypeError(f"Invalid indexes for create_binary_variables: {indexes!r}")
+    return variables
+
+
+DEFAULT_CAPACITY = 100
+
+
+class AgentDef(SimpleRepr):
+    """Definition of an agent: capacity, hosting costs, routes, extra attrs.
+
+    reference parity: pydcop/dcop/objects.py:669-878 — including arbitrary
+    extra attributes reachable as plain attributes.
+
+    >>> a = AgentDef('a1', capacity=100, foo='bar')
+    >>> a.foo
+    'bar'
+    >>> a.hosting_cost('c1')
+    0
+    """
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
+                 default_hosting_cost: float = 0,
+                 hosting_costs: Optional[Dict[str, float]] = None,
+                 default_route: float = 1,
+                 routes: Optional[Dict[str, float]] = None,
+                 **kwargs):
+        self._name = name
+        self._capacity = capacity
+        self._default_hosting_cost = default_hosting_cost
+        self._hosting_costs = dict(hosting_costs) if hosting_costs else {}
+        self._default_route = default_route
+        self._routes = dict(routes) if routes else {}
+        self._attrs = dict(kwargs)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def default_hosting_cost(self) -> float:
+        return self._default_hosting_cost
+
+    @property
+    def hosting_costs(self) -> Dict[str, float]:
+        return self._hosting_costs
+
+    @property
+    def default_route(self) -> float:
+        return self._default_route
+
+    @property
+    def routes(self) -> Dict[str, float]:
+        return self._routes
+
+    def hosting_cost(self, computation: str) -> float:
+        return self._hosting_costs.get(computation, self._default_hosting_cost)
+
+    def route(self, other_agent: str) -> float:
+        if other_agent == self._name:
+            return 0
+        return self._routes.get(other_agent, self._default_route)
+
+    def extra_attr(self) -> Dict[str, Any]:
+        return dict(self._attrs)
+
+    def __getattr__(self, item):
+        # only called when normal lookup fails
+        attrs = self.__dict__.get("_attrs", {})
+        if item in attrs:
+            return attrs[item]
+        raise AttributeError(f"AgentDef has no attribute {item!r}")
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, AgentDef)
+            and self._name == o._name
+            and self._capacity == o._capacity
+            and self._default_hosting_cost == o._default_hosting_cost
+            and self._hosting_costs == o._hosting_costs
+            and self._default_route == o._default_route
+            and self._routes == o._routes
+            and self._attrs == o._attrs
+        )
+
+    def __hash__(self):
+        return hash(("AgentDef", self._name))
+
+    def __repr__(self):
+        return f"AgentDef({self._name!r})"
+
+    def __str__(self):
+        return f"AgentDef({self._name})"
+
+    def _simple_repr(self):
+        r = super()._simple_repr()
+        for k, v in self._attrs.items():
+            r[k] = simple_repr(v)
+        return r
+
+
+def create_agents(name_prefix: str, indexes,
+                  default_hosting_cost: float = 0,
+                  hosting_costs: Optional[Dict] = None,
+                  default_route: float = 1,
+                  routes: Optional[Dict] = None,
+                  separator: str = "",
+                  **kwargs) -> Dict[Union[str, Tuple[str, ...]], AgentDef]:
+    """Mass-create agents (reference: objects.py:879-975)."""
+    agents = {}
+    if isinstance(indexes, range):
+        indexes = list(indexes)
+    for i in indexes:
+        name = f"{name_prefix}{separator}{i}"
+        agents[name] = AgentDef(
+            name,
+            default_hosting_cost=default_hosting_cost,
+            hosting_costs=hosting_costs or {},
+            default_route=default_route,
+            routes=routes or {},
+            **kwargs,
+        )
+    return agents

@@ -1,0 +1,747 @@
+"""Constraints (relations) and their algebra.
+
+TPU-first re-design of the reference constraint layer
+(reference: pydcop/dcop/relations.py:48-1760).  The key departure: every
+constraint can be *lifted* into a dense cost hypercube (`numpy` on host,
+shipped to device as a stacked `jnp` tensor), indexed by the domain indices
+of its variables.  The DPOP algebra (``join`` / ``projection``) — which the
+reference implements as per-assignment Python loops
+(relations.py:1672-1760) — is implemented here as numpy broadcasting +
+axis reductions, the exact shape XLA wants.
+"""
+
+import itertools
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..utils.expressionfunction import ExpressionFunction
+from ..utils.simple_repr import SimpleRepr, simple_repr, from_repr
+from .objects import Variable
+
+DEFAULT_TYPE = np.float32
+
+
+class Constraint(SimpleRepr):
+    """Base class for all constraints (``RelationProtocol`` parity,
+    reference: pydcop/dcop/relations.py:48-217)."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def dimensions(self) -> List[Variable]:
+        raise NotImplementedError()
+
+    @property
+    def arity(self) -> int:
+        return len(self.dimensions)
+
+    @property
+    def scope_names(self) -> List[str]:
+        return [v.name for v in self.dimensions]
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(len(v.domain) for v in self.dimensions)
+
+    def slice(self, partial_assignment: Dict[str, Any]) -> "Constraint":
+        """Constraint restricted by fixing some variables."""
+        raise NotImplementedError()
+
+    def get_value_for_assignment(self, assignment=None):
+        if assignment is None:
+            if self.arity != 0:
+                raise ValueError("Missing assignment")
+            return self()
+        if isinstance(assignment, list):
+            return self(*assignment)
+        return self(**assignment)
+
+    def __call__(self, *args, **kwargs) -> float:
+        raise NotImplementedError()
+
+    def to_matrix(self) -> "NAryMatrixRelation":
+        """Lift to a dense cost table — the TPU-side representation."""
+        return NAryMatrixRelation.from_func_relation(self)
+
+    def cost_hypercube(self) -> np.ndarray:
+        """Dense ndarray of costs indexed by domain indices."""
+        return self.to_matrix()._m
+
+    def __str__(self):
+        return f"{type(self).__name__}({self._name})"
+
+
+# The reference calls this protocol RelationProtocol.
+RelationProtocol = Constraint
+
+
+class ZeroAryRelation(Constraint):
+    """A constant relation with no variable
+    (reference: relations.py:218-269)."""
+
+    def __init__(self, name: str, value: float):
+        super().__init__(name)
+        self._value = value
+
+    @property
+    def dimensions(self):
+        return []
+
+    def slice(self, partial_assignment):
+        if partial_assignment:
+            raise ValueError("Cannot slice a 0-ary relation on variables")
+        return self
+
+    def __call__(self, *args, **kwargs):
+        if args or kwargs:
+            raise ValueError("ZeroAryRelation takes no argument")
+        return self._value
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, ZeroAryRelation)
+            and self._name == o._name
+            and self._value == o._value
+        )
+
+    def __hash__(self):
+        return hash((self._name, self._value))
+
+
+class UnaryFunctionRelation(Constraint):
+    """Unary relation from a function (reference: relations.py:270-379)."""
+
+    def __init__(self, name: str, variable: Variable,
+                 rel_function: Union[Callable, ExpressionFunction]):
+        super().__init__(name)
+        self._variable = variable
+        self._rel_function = rel_function
+
+    @property
+    def dimensions(self):
+        return [self._variable]
+
+    @property
+    def variable(self):
+        return self._variable
+
+    @property
+    def expression(self):
+        if isinstance(self._rel_function, ExpressionFunction):
+            return self._rel_function.expression
+        raise AttributeError("No expression for arbitrary callable")
+
+    def slice(self, partial_assignment: Dict[str, Any]):
+        if not partial_assignment:
+            return self
+        if (len(partial_assignment) != 1
+                or self._variable.name not in partial_assignment):
+            raise ValueError(
+                f"Invalid slice on unary relation {self._name}: "
+                f"{partial_assignment}"
+            )
+        val = partial_assignment[self._variable.name]
+        return ZeroAryRelation(self._name, self._apply(val))
+
+    def _apply(self, val):
+        if isinstance(self._rel_function, ExpressionFunction):
+            return self._rel_function(**{self._variable.name: val})
+        return self._rel_function(val)
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            if len(args) != 1:
+                raise ValueError("UnaryFunctionRelation takes one argument")
+            return self._apply(args[0])
+        return self._apply(kwargs[self._variable.name])
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, UnaryFunctionRelation)
+            and self._name == o._name
+            and self._variable == o._variable
+            and all(self._apply(v) == o._apply(v) for v in self._variable.domain)
+        )
+
+    def __hash__(self):
+        return hash(("UnaryFunctionRelation", self._name, self._variable))
+
+
+class UnaryBooleanRelation(Constraint):
+    """Unary hard relation: cost 0 if the (truthy) value holds, else inf
+    (reference: relations.py:380-455)."""
+
+    def __init__(self, name: str, variable: Variable):
+        super().__init__(name)
+        self._variable = variable
+
+    @property
+    def dimensions(self):
+        return [self._variable]
+
+    def slice(self, partial_assignment):
+        if not partial_assignment:
+            return self
+        val = partial_assignment[self._variable.name]
+        return ZeroAryRelation(self._name, 0 if val else float("inf"))
+
+    def __call__(self, *args, **kwargs):
+        val = args[0] if args else kwargs[self._variable.name]
+        return 0 if val else float("inf")
+
+
+class NAryFunctionRelation(Constraint):
+    """N-ary relation backed by a function
+    (reference: relations.py:456-638)."""
+
+    def __init__(self, f: Union[Callable, ExpressionFunction],
+                 variables: Iterable[Variable], name: Optional[str] = None,
+                 f_kwargs: bool = False):
+        super().__init__(name if name is not None else getattr(f, "__name__", "f"))
+        self._variables = list(variables)
+        self._f = f
+        # When True, the function is called with keyword args named after the
+        # variables; otherwise positionally in scope order.
+        self._f_kwargs = f_kwargs or isinstance(f, ExpressionFunction)
+
+    @property
+    def dimensions(self):
+        return list(self._variables)
+
+    @property
+    def function(self):
+        return self._f
+
+    @property
+    def expression(self):
+        if isinstance(self._f, ExpressionFunction):
+            return self._f.expression
+        raise AttributeError("No expression for arbitrary callable")
+
+    def slice(self, partial_assignment: Dict[str, Any]):
+        if not partial_assignment:
+            return self
+        names = [v.name for v in self._variables]
+        for k in partial_assignment:
+            if k not in names:
+                raise ValueError(
+                    f"Slice on {self._name}: unknown variable {k}"
+                )
+        remaining = [v for v in self._variables
+                     if v.name not in partial_assignment]
+        fixed = dict(partial_assignment)
+
+        if isinstance(self._f, ExpressionFunction):
+            sliced_f = self._f.partial(**fixed)
+            return NAryFunctionRelation(sliced_f, remaining, self._name)
+
+        def sliced(*args, **kwargs):
+            env = dict(fixed)
+            if args:
+                env.update(
+                    {v.name: a for v, a in zip(remaining, args)}
+                )
+            env.update(kwargs)
+            return self(**env)
+
+        return NAryFunctionRelation(sliced, remaining, self._name,
+                                    f_kwargs=True)
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            if len(args) != len(self._variables):
+                raise ValueError(
+                    f"{self._name} expects {len(self._variables)} arguments"
+                )
+            kwargs = {v.name: a for v, a in zip(self._variables, args)}
+        if self._f_kwargs:
+            return self._f(**{v.name: kwargs[v.name] for v in self._variables})
+        return self._f(*[kwargs[v.name] for v in self._variables])
+
+    def __eq__(self, o):
+        if not isinstance(o, NAryFunctionRelation):
+            return False
+        if self._name != o._name or self._variables != o._variables:
+            return False
+        for assignment in generate_assignment_as_dict(self._variables):
+            if self(**assignment) != o(**assignment):
+                return False
+        return True
+
+    def __hash__(self):
+        return hash(("NAryFunctionRelation", self._name,
+                     tuple(v.name for v in self._variables)))
+
+    def _simple_repr(self):
+        if not isinstance(self._f, ExpressionFunction):
+            # fall back to an extensional representation
+            return self.to_matrix()._simple_repr()
+        r = {
+            "__qualname__": "NAryFunctionRelation",
+            "__module__": type(self).__module__,
+            "name": self._name,
+            "variables": [simple_repr(v) for v in self._variables],
+            "f": simple_repr(self._f),
+        }
+        return r
+
+    @classmethod
+    def _from_repr(cls, name, variables, f):
+        return cls(from_repr(f), from_repr(variables), name)
+
+
+def AsNAryFunctionRelation(*variables):
+    """Decorator building an NAryFunctionRelation from a python function
+    (reference: relations.py:639-671).
+
+    >>> from pydcop_tpu.dcop.objects import Variable, Domain
+    >>> d = Domain('d', 'd', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> @AsNAryFunctionRelation(x, y)
+    ... def c(x, y):
+    ...     return x + y
+    >>> c(1, 1)
+    2
+    """
+
+    def decorate(f):
+        return NAryFunctionRelation(f, list(variables), f.__name__)
+
+    return decorate
+
+
+class NAryMatrixRelation(Constraint):
+    """N-ary relation as a dense cost hypercube — the canonical on-device
+    form (reference: relations.py:672-908, but vectorized).
+
+    The matrix is indexed by domain *indices* in scope order:
+    ``m[i1, ..., ik] = cost(v1=dom1[i1], ..., vk=domk[ik])``.
+    """
+
+    def __init__(self, variables: Iterable[Variable], matrix=None,
+                 name: Optional[str] = None):
+        super().__init__(name if name is not None else "rel")
+        self._variables = list(variables)
+        shape = tuple(len(v.domain) for v in self._variables)
+        if matrix is None:
+            self._m = np.zeros(shape, dtype=DEFAULT_TYPE)
+        else:
+            self._m = np.asarray(matrix, dtype=DEFAULT_TYPE).reshape(shape)
+
+    @property
+    def dimensions(self):
+        return list(self._variables)
+
+    @property
+    def matrix(self) -> np.ndarray:
+        return self._m
+
+    @property
+    def shape(self):
+        return self._m.shape
+
+    def cost_hypercube(self) -> np.ndarray:
+        return self._m
+
+    def to_matrix(self):
+        return self
+
+    @classmethod
+    def from_func_relation(cls, rel: Constraint) -> "NAryMatrixRelation":
+        """Lift any constraint to a matrix by vectorized-eager evaluation."""
+        variables = rel.dimensions
+        if isinstance(rel, NAryMatrixRelation):
+            return cls(variables, rel._m.copy(), rel.name)
+        shape = tuple(len(v.domain) for v in variables)
+        m = np.zeros(shape, dtype=DEFAULT_TYPE)
+        names = [v.name for v in variables]
+        for idx in np.ndindex(*shape) if shape else [()]:
+            assignment = {
+                n: variables[i].domain.values[idx[i]]
+                for i, n in enumerate(names)
+            }
+            m[idx] = rel(**assignment)
+        return cls(variables, m, rel.name)
+
+    def _positional_index(self, assignment: Dict[str, Any]):
+        idx = []
+        for v in self._variables:
+            idx.append(v.domain.index(assignment[v.name]))
+        return tuple(idx)
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            kwargs = {v.name: a for v, a in zip(self._variables, args)}
+        if self._variables:
+            return float(self._m[self._positional_index(kwargs)])
+        return float(self._m)
+
+    def get_value_for_assignment(self, assignment=None):
+        if isinstance(assignment, list):
+            idx = tuple(
+                v.domain.index(a) for v, a in zip(self._variables, assignment)
+            )
+            return float(self._m[idx])
+        return super().get_value_for_assignment(assignment)
+
+    def set_value_for_assignment(self, assignment: Dict[str, Any],
+                                 value: float) -> "NAryMatrixRelation":
+        """Return a new relation with one cell changed (immutable update —
+        jnp ``.at[].set`` style, unlike the reference's in-place variant)."""
+        m = self._m.copy()
+        m[self._positional_index(assignment)] = value
+        return NAryMatrixRelation(self._variables, m, self._name)
+
+    def slice(self, partial_assignment: Dict[str, Any]):
+        if not partial_assignment:
+            return self
+        for k in partial_assignment:
+            if k not in self.scope_names:
+                raise ValueError(f"Slice on {self._name}: unknown var {k}")
+        index = []
+        remaining = []
+        for v in self._variables:
+            if v.name in partial_assignment:
+                index.append(v.domain.index(partial_assignment[v.name]))
+            else:
+                index.append(slice(None))
+                remaining.append(v)
+        return NAryMatrixRelation(remaining, self._m[tuple(index)], self._name)
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, NAryMatrixRelation)
+            and self._name == o._name
+            and self._variables == o._variables
+            and np.array_equal(self._m, o._m)
+        )
+
+    def __hash__(self):
+        return hash(("NAryMatrixRelation", self._name,
+                     tuple(v.name for v in self._variables)))
+
+    def _simple_repr(self):
+        return {
+            "__qualname__": "NAryMatrixRelation",
+            "__module__": type(self).__module__,
+            "name": self._name,
+            "variables": [simple_repr(v) for v in self._variables],
+            "matrix": self._m.tolist(),
+        }
+
+    @classmethod
+    def _from_repr(cls, name, variables, matrix):
+        return cls(from_repr(variables), np.array(matrix), name)
+
+
+class NeutralRelation(Constraint):
+    """Relation that is always 0 (reference: relations.py:909-947)."""
+
+    def __init__(self, variables: Iterable[Variable],
+                 name: Optional[str] = None):
+        super().__init__(name if name is not None else "neutral")
+        self._variables = list(variables)
+
+    @property
+    def dimensions(self):
+        return list(self._variables)
+
+    def slice(self, partial_assignment):
+        remaining = [v for v in self._variables
+                     if v.name not in partial_assignment]
+        return NeutralRelation(remaining, self._name)
+
+    def __call__(self, *args, **kwargs):
+        return 0
+
+
+class ConditionalRelation(Constraint):
+    """Relation guarded by a boolean condition relation
+    (reference: relations.py:948-1100)."""
+
+    def __init__(self, condition: Constraint, relation_if_true: Constraint,
+                 name: Optional[str] = None,
+                 return_value_if_false: float = 0):
+        super().__init__(name if name is not None else "cond")
+        self._condition = condition
+        self._rel = relation_if_true
+        self._return_if_false = return_value_if_false
+
+    @property
+    def condition(self):
+        return self._condition
+
+    @property
+    def dimensions(self):
+        dims = list(self._condition.dimensions)
+        for v in self._rel.dimensions:
+            if v not in dims:
+                dims.append(v)
+        return dims
+
+    def slice(self, partial_assignment):
+        cond_partial = {
+            k: v for k, v in partial_assignment.items()
+            if k in self._condition.scope_names
+        }
+        rel_partial = {
+            k: v for k, v in partial_assignment.items()
+            if k in self._rel.scope_names
+        }
+        cond = self._condition.slice(cond_partial) if cond_partial else self._condition
+        rel = self._rel.slice(rel_partial) if rel_partial else self._rel
+        if cond.arity == 0:
+            if cond():
+                return rel
+            return ZeroAryRelation(self._name, self._return_if_false) \
+                if rel.arity == 0 else NeutralRelation(rel.dimensions, self._name)
+        return ConditionalRelation(cond, rel, self._name,
+                                   self._return_if_false)
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            kwargs = {v.name: a for v, a in zip(self.dimensions, args)}
+        cond_args = {
+            v.name: kwargs[v.name] for v in self._condition.dimensions
+        }
+        if self._condition(**cond_args):
+            rel_args = {v.name: kwargs[v.name] for v in self._rel.dimensions}
+            return self._rel(**rel_args)
+        return self._return_if_false
+
+
+def relation_from_str(name: str, expression: str,
+                      all_variables: Iterable[Variable]):
+    """Alias kept for reference-API familiarity."""
+    return constraint_from_str(name, expression, all_variables)
+
+
+def constraint_from_str(name: str, expression: str,
+                        all_variables: Iterable[Variable]) -> Constraint:
+    """Build a constraint from a python expression string
+    (reference: relations.py:1275-1313)."""
+    f = ExpressionFunction(expression)
+    relation_variables = []
+    known = {v.name: v for v in all_variables}
+    for v_name in f.variable_names:
+        if v_name not in known:
+            raise ValueError(
+                f"Unknown variable {v_name!r} in constraint {name}: "
+                f"{expression}"
+            )
+        relation_variables.append(known[v_name])
+    return NAryFunctionRelation(f, relation_variables, name)
+
+
+def constraint_from_external_definition(
+        name: str, source_file, expression: str,
+        all_variables: Iterable[Variable]) -> Constraint:
+    """Constraint whose expression uses helpers from an external python file
+    (reference: relations.py:1314-1366)."""
+    f = ExpressionFunction(expression, source_file=str(source_file))
+    known = {v.name: v for v in all_variables}
+    relation_variables = [known[v] for v in f.variable_names if v in known]
+    return NAryFunctionRelation(f, relation_variables, name)
+
+
+def assignment_matrix(variables: List[Variable], default_value=None):
+    """Nested-list matrix covering all assignments
+    (reference: relations.py helper used by yaml parsing)."""
+    matrix = default_value
+    for v in reversed(variables):
+        matrix = [
+            matrix if not isinstance(matrix, list) else _deep_copy(matrix)
+            for _ in range(len(v.domain))
+        ]
+    return matrix
+
+
+def _deep_copy(nested):
+    if isinstance(nested, list):
+        return [_deep_copy(i) for i in nested]
+    return nested
+
+
+def generate_assignment(variables: List[Variable]):
+    """Yield all assignments as lists, last variable varying fastest
+    (reference: relations.py:1413-1451)."""
+    for combi in itertools.product(*(v.domain.values for v in variables)):
+        yield list(combi)
+
+
+def generate_assignment_as_dict(variables: List[Variable]):
+    """Yield all assignments as dicts (reference: relations.py:1452-1478)."""
+    names = [v.name for v in variables]
+    for combi in itertools.product(*(v.domain.values for v in variables)):
+        yield dict(zip(names, combi))
+
+
+def filter_assignment_dict(assignment: Dict[str, Any],
+                           target_vars: Iterable[Variable]) -> Dict[str, Any]:
+    """Keep only the assignment entries for ``target_vars``."""
+    names = {v.name for v in target_vars}
+    return {k: v for k, v in assignment.items() if k in names}
+
+
+def count_var_match(assignment: Dict[str, Any],
+                    constraint: Constraint) -> int:
+    return len(set(assignment) & set(constraint.scope_names))
+
+
+def is_compatible(a1: Dict[str, Any], a2: Dict[str, Any]) -> bool:
+    return all(a2[k] == v for k, v in a1.items() if k in a2)
+
+
+def find_optimum(constraint: Constraint, mode: str) -> float:
+    """Best achievable value of a constraint over its full domain product
+    (reference: relations.py:1367-1412) — vectorized via the cost table."""
+    if mode not in ("min", "max"):
+        raise ValueError(f"Invalid mode {mode!r}")
+    cube = constraint.cost_hypercube()
+    return float(np.min(cube) if mode == "min" else np.max(cube))
+
+
+def find_optimal(variable: Variable, assignment: Dict[str, Any],
+                 constraints: Iterable[Constraint], mode: str):
+    """Best value(s) for ``variable`` given fixed neighbor values
+    (reference: relations.py:1594-1640).
+
+    Returns ``(best_values_list, best_cost)``.
+    """
+    arg_best, best = None, None
+    cmp = (lambda a, b: a < b) if mode == "min" else (lambda a, b: a > b)
+    for value in variable.domain:
+        asst = dict(assignment)
+        asst[variable.name] = value
+        cost = assignment_cost(asst, constraints, partial_ok=True)
+        if best is None or cmp(cost, best):
+            best, arg_best = cost, [value]
+        elif cost == best:
+            arg_best.append(value)
+    return arg_best, best
+
+
+def find_arg_optimal(variable: Variable, relation: Constraint, mode: str):
+    """Optimal values of a unary relation for ``variable``
+    (reference: relations.py:1554-1593)."""
+    if relation.arity != 1 or relation.dimensions[0] != variable:
+        raise ValueError(
+            f"find_arg_optimal expects a unary relation on {variable.name}"
+        )
+    costs = np.array([relation(v) for v in variable.domain])
+    best = float(np.min(costs) if mode == "min" else np.max(costs))
+    arg_best = [
+        variable.domain.values[i]
+        for i in np.flatnonzero(costs == best)
+    ]
+    return arg_best, best
+
+
+def optimal_cost_value(variable: Variable, mode: str):
+    """Optimal (cost, value) for a variable's own cost function
+    (reference: relations.py:1641-1671)."""
+    costs = np.array([variable.cost_for_val(v) for v in variable.domain])
+    i = int(np.argmin(costs) if mode == "min" else np.argmax(costs))
+    return variable.domain.values[i], float(costs[i])
+
+
+def assignment_cost(assignment: Dict[str, Any],
+                    constraints: Iterable[Constraint],
+                    consider_variable_cost: bool = False,
+                    partial_ok: bool = False) -> float:
+    """Total cost of an assignment over a set of constraints
+    (reference: relations.py:1479-1553)."""
+    cost = 0.0
+    for c in constraints:
+        if partial_ok:
+            scoped = {k: v for k, v in assignment.items()
+                      if k in c.scope_names}
+            if len(scoped) != c.arity:
+                continue
+            cost += c(**scoped)
+        else:
+            cost += c(**{k: assignment[k] for k in c.scope_names})
+    if consider_variable_cost:
+        seen = set()
+        for c in constraints:
+            for v in c.dimensions:
+                if v.name in assignment and v.name not in seen:
+                    seen.add(v.name)
+                    cost += v.cost_for_val(assignment[v.name])
+    return cost
+
+
+def join(u1: Constraint, u2: Constraint) -> NAryMatrixRelation:
+    """Join two relations: result scope = union of scopes, cost = sum.
+
+    The reference loops over every joint assignment in Python
+    (relations.py:1672-1716); here the two hypercubes are aligned by
+    axis-expansion and added in one vectorized numpy op — the same
+    broadcast-add XLA compiles onto the VPU for DPOP's UTIL phase.
+    """
+    m1, m2 = u1.to_matrix(), u2.to_matrix()
+    vars1, vars2 = m1.dimensions, m2.dimensions
+    out_vars = list(vars1) + [v for v in vars2 if v not in vars1]
+    names_out = [v.name for v in out_vars]
+
+    # expand u1 to the output axes
+    a1 = _expand_to(m1._m, [v.name for v in vars1], names_out,
+                    [len(v.domain) for v in out_vars])
+    a2 = _expand_to(m2._m, [v.name for v in vars2], names_out,
+                    [len(v.domain) for v in out_vars])
+    name = f"joined_{u1.name}_{u2.name}"
+    return NAryMatrixRelation(out_vars, a1 + a2, name)
+
+
+def _expand_to(arr: np.ndarray, axes_names: List[str],
+               out_names: List[str], out_sizes: List[int]) -> np.ndarray:
+    """Transpose+reshape ``arr`` so its axes line up with ``out_names``,
+    broadcasting over missing axes."""
+    # permutation of existing axes into their order within out_names
+    order = sorted(range(len(axes_names)),
+                   key=lambda i: out_names.index(axes_names[i]))
+    arr = np.transpose(arr, order) if axes_names else arr
+    present = [axes_names[i] for i in order]
+    shape = []
+    for n, size in zip(out_names, out_sizes):
+        shape.append(size if n in present else 1)
+    return arr.reshape(shape) if shape else arr
+
+
+def projection(a_rel: Constraint, a_var: Variable,
+               mode: str = "max") -> Constraint:
+    """Project a variable out of a relation by optimizing over it.
+
+    Vectorized: a single ``min``/``max`` reduction over the variable's axis
+    (the reference loops per remaining assignment, relations.py:1717-1760).
+    """
+    m = a_rel.to_matrix()
+    if a_var not in m.dimensions:
+        raise ValueError(
+            f"Cannot project {a_var.name} out of {a_rel.name}: not in scope"
+        )
+    axis = m.dimensions.index(a_var)
+    reduced = (np.max(m._m, axis=axis) if mode == "max"
+               else np.min(m._m, axis=axis))
+    remaining = [v for v in m.dimensions if v != a_var]
+    if not remaining:
+        return ZeroAryRelation(f"projection_{a_rel.name}", float(reduced))
+    return NAryMatrixRelation(remaining, reduced,
+                              f"projection_{a_rel.name}")
+
+
+def arg_projection(a_rel: Constraint, a_var: Variable,
+                   mode: str = "max") -> np.ndarray:
+    """Argmin/argmax companion of :func:`projection` (used by DPOP VALUE
+    phase): for every assignment of the remaining scope, the domain index
+    of ``a_var`` achieving the optimum."""
+    m = a_rel.to_matrix()
+    axis = m.dimensions.index(a_var)
+    return (np.argmax(m._m, axis=axis) if mode == "max"
+            else np.argmin(m._m, axis=axis))

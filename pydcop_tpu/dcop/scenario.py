@@ -1,0 +1,106 @@
+"""Dynamic DCOP scenarios: timed event streams.
+
+reference parity: pydcop/dcop/scenario.py:37-108.
+"""
+
+from typing import Dict, Iterable, List, Optional
+
+from ..utils.simple_repr import SimpleRepr
+
+
+class EventAction(SimpleRepr):
+    """A single action in a scenario event, e.g. ``remove_agent``."""
+
+    def __init__(self, type: str, **kwargs):  # noqa: A002 - parity with yaml key
+        self._type = type
+        self._args = dict(kwargs)
+
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def args(self) -> Dict:
+        return self._args
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, EventAction)
+            and self._type == o._type
+            and self._args == o._args
+        )
+
+    def __repr__(self):
+        return f"EventAction({self._type}, {self._args})"
+
+    def _simple_repr(self):
+        r = {
+            "__qualname__": "EventAction",
+            "__module__": type(self).__module__,
+            "type": self._type,
+        }
+        r.update(self._args)
+        return r
+
+    @classmethod
+    def _from_repr(cls, type, **kwargs):  # noqa: A002
+        return cls(type, **kwargs)
+
+
+class DcopEvent(SimpleRepr):
+    """An event: either a delay or a list of actions."""
+
+    def __init__(self, id: str, delay: Optional[float] = None,  # noqa: A002
+                 actions: Optional[List[EventAction]] = None):
+        self._id = id
+        self._delay = delay
+        self._actions = actions
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def delay(self) -> Optional[float]:
+        return self._delay
+
+    @property
+    def actions(self) -> Optional[List[EventAction]]:
+        return self._actions
+
+    @property
+    def is_delay(self) -> bool:
+        return self._delay is not None
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, DcopEvent)
+            and self._id == o._id
+            and self._delay == o._delay
+            and self._actions == o._actions
+        )
+
+    def __repr__(self):
+        if self.is_delay:
+            return f"DcopEvent({self._id}, delay={self._delay})"
+        return f"DcopEvent({self._id}, actions={self._actions})"
+
+
+class Scenario(SimpleRepr):
+    """An ordered list of events applied to a running DCOP."""
+
+    def __init__(self, events: Optional[Iterable[DcopEvent]] = None):
+        self._events = list(events) if events else []
+
+    @property
+    def events(self) -> List[DcopEvent]:
+        return list(self._events)
+
+    def __iter__(self):
+        return iter(self._events)
+
+    def __len__(self):
+        return len(self._events)
+
+    def __eq__(self, o):
+        return isinstance(o, Scenario) and self._events == o._events

@@ -1,0 +1,10 @@
+from .expressionfunction import ExpressionFunction
+from .simple_repr import SimpleRepr, SimpleReprException, from_repr, simple_repr
+
+__all__ = [
+    "ExpressionFunction",
+    "SimpleRepr",
+    "SimpleReprException",
+    "simple_repr",
+    "from_repr",
+]

@@ -1,0 +1,180 @@
+"""Python-expression-backed cost functions.
+
+Counterpart of the reference's ``ExpressionFunction``
+(reference: pydcop/utils/expressionfunction.py:40-240): compiles a python
+expression string into a callable, extracts the free variable names by AST
+analysis, and supports fixing some variables (partial application) and
+loading helper definitions from an external source file.
+
+In the TPU framework these functions are only ever evaluated *eagerly on the
+host* while lifting constraints into dense cost tables (one evaluation per
+assignment of the cartesian domain product); they never run on device.
+"""
+
+import ast
+import functools
+import math
+from typing import Dict, Iterable, Optional
+
+from .simple_repr import SimpleRepr
+
+_SAFE_BUILTINS = {
+    "abs": abs,
+    "round": round,
+    "min": min,
+    "max": max,
+    "pow": pow,
+    "len": len,
+    "sum": sum,
+    "int": int,
+    "float": float,
+    "str": str,
+    "bool": bool,
+    "math": math,
+    "sqrt": math.sqrt,
+    "exp": math.exp,
+    "log": math.log,
+    "floor": math.floor,
+    "ceil": math.ceil,
+}
+
+
+def _free_variables(expression: str) -> frozenset:
+    """Names that appear as loads in ``expression`` and are not builtins."""
+    tree = ast.parse(expression, mode="eval")
+    names = set()
+    bound = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.comprehension):
+            t = node.target
+            if isinstance(t, ast.Name):
+                bound.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        bound.add(e.id)
+        elif isinstance(node, ast.Lambda):
+            for a in node.args.args:
+                bound.add(a.arg)
+    return frozenset(n for n in names - bound if n not in _SAFE_BUILTINS)
+
+
+class ExpressionFunction(SimpleRepr):
+    """A callable built from a python expression string.
+
+    >>> f = ExpressionFunction('v1 + 2 * v2')
+    >>> sorted(f.variable_names)
+    ['v1', 'v2']
+    >>> f(v1=1, v2=3)
+    7
+    """
+
+    def __init__(self, expression: str, source_file: Optional[str] = None,
+                 **fixed_vars):
+        self._expression = expression
+        self._source_file = source_file
+        self._fixed_vars = dict(fixed_vars)
+        self._globals = dict(_SAFE_BUILTINS)
+        if source_file:
+            # Execute the external helper module once; its top-level names
+            # become available to the expression (reference behavior:
+            # pydcop/utils/expressionfunction.py:120-140).
+            with open(source_file, encoding="utf-8") as f:
+                src = f.read()
+            exec(compile(src, source_file, "exec"), self._globals)
+        if "\n" in expression.strip() or expression.strip().startswith("return"):
+            # multi-line / statement form: wrap into a function body
+            body = "\n".join("    " + line for line in expression.splitlines())
+            fn_src = f"def __expr_fn__({', '.join(self._detect_args(expression))}):\n{body}"
+            exec(compile(fn_src, "<expression>", "exec"), self._globals)
+            self._fn = self._globals["__expr_fn__"]
+            self._vars = frozenset(self._detect_args(expression)) - set(fixed_vars)
+            self._code = None
+        else:
+            self._code = compile(expression, "<expression>", "eval")
+            all_vars = _free_variables(expression)
+            extra = {n for n in all_vars if n in self._globals}
+            self._vars = frozenset(all_vars - set(fixed_vars) - extra)
+            self._fn = None
+
+    @staticmethod
+    def _detect_args(expression: str) -> list:
+        names = set()
+        bound = set()
+        tree = ast.parse(expression)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    bound.add(node.id)
+                else:
+                    names.add(node.id)
+        return sorted(n for n in names - bound if n not in _SAFE_BUILTINS)
+
+    @property
+    def expression(self) -> str:
+        return self._expression
+
+    @property
+    def source_file(self) -> Optional[str]:
+        return self._source_file
+
+    @property
+    def variable_names(self) -> Iterable[str]:
+        return self._vars
+
+    @property
+    def fixed_vars(self) -> Dict:
+        return self._fixed_vars
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            raise TypeError(
+                "ExpressionFunction only accepts keyword arguments, "
+                f"got positional {args!r}"
+            )
+        env = dict(self._fixed_vars)
+        env.update(kwargs)
+        missing = self._vars - set(env)
+        if missing:
+            raise TypeError(f"Missing variables {sorted(missing)} for {self}")
+        if self._fn is not None:
+            call_args = {k: env[k] for k in self._detect_args(self._expression)
+                         if k in env}
+            return self._fn(**call_args)
+        g = dict(self._globals)
+        g["__builtins__"] = {}
+        return eval(self._code, g, env)  # noqa: S307 - host-side model eval
+
+    def partial(self, **kwargs) -> "ExpressionFunction":
+        """Fix some variables, returning a narrower function."""
+        fixed = dict(self._fixed_vars)
+        fixed.update(kwargs)
+        return ExpressionFunction(self._expression, self._source_file, **fixed)
+
+    def __repr__(self):
+        return f"ExpressionFunction({self._expression!r})"
+
+    def __str__(self):
+        return f"f({', '.join(sorted(self._vars))}): {self._expression}"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ExpressionFunction)
+            and self._expression == other._expression
+            and self._fixed_vars == other._fixed_vars
+        )
+
+    def __hash__(self):
+        return hash((self._expression, tuple(sorted(self._fixed_vars.items()))))
+
+    def _simple_repr(self):
+        r = super()._simple_repr()
+        r["fixed_vars"] = dict(self._fixed_vars)
+        return r
+
+    @classmethod
+    def _from_repr(cls, expression, source_file=None, fixed_vars=None, **kw):
+        fixed_vars = fixed_vars or {}
+        return cls(expression, source_file, **fixed_vars)

@@ -1,0 +1,50 @@
+"""Graph helpers over networkx (reference: pydcop/utils/graphs.py:131-306)."""
+
+from typing import Iterable
+
+import networkx as nx
+
+
+def as_networkx_graph(variables, relations) -> nx.Graph:
+    """Build the constraint graph: one vertex per variable, an edge between
+    every pair of variables sharing a constraint."""
+    g = nx.Graph()
+    g.add_nodes_from(v.name for v in variables)
+    for r in relations:
+        names = [v.name for v in r.dimensions]
+        for i, n1 in enumerate(names):
+            for n2 in names[i + 1:]:
+                g.add_edge(n1, n2)
+    return g
+
+
+def as_bipartite_graph(variables, relations) -> nx.Graph:
+    g = nx.Graph()
+    for v in variables:
+        g.add_node(v.name, bipartite=0)
+    for r in relations:
+        g.add_node(r.name, bipartite=1)
+        for v in r.dimensions:
+            g.add_edge(r.name, v.name)
+    return g
+
+
+def display_graph(variables, relations):  # pragma: no cover - optional viz
+    import matplotlib.pyplot as plt
+
+    g = as_networkx_graph(variables, relations)
+    nx.draw(g, with_labels=True)
+    plt.show()
+
+
+def cycles_count(variables, relations) -> int:
+    g = as_networkx_graph(variables, relations)
+    return len(nx.cycle_basis(g))
+
+
+def graph_diameter(variables, relations) -> Iterable[int]:
+    """Diameter of each connected component."""
+    g = as_networkx_graph(variables, relations)
+    return [
+        nx.diameter(g.subgraph(c)) for c in nx.connected_components(g)
+    ]

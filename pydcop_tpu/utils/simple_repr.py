@@ -1,0 +1,144 @@
+"""Constructor-argument-driven serialization.
+
+TPU-native counterpart of the reference's ``SimpleRepr`` mixin
+(reference: pydcop/utils/simple_repr.py:68-209).  In the reference every
+network message is serialized through this mechanism; here serialization is
+only needed at the *host boundary* (YAML/JSON I/O, shipping computation
+definitions between hosts over DCN) — on-chip "messages" are array rows and
+never serialized.
+
+An object opting in inherits :class:`SimpleRepr`.  Its simple repr is a
+plain-JSON-able dict mapping each constructor argument to the value of the
+attribute of the same name (with a leading underscore by convention).  A
+class can remap an argument to a differently-named attribute with
+``_repr_mapping``.
+"""
+
+from importlib import import_module
+from typing import Any
+
+SIMPLE_REPR_CLASS_KEY = "__qualname__"
+SIMPLE_REPR_MODULE_KEY = "__module__"
+
+
+class SimpleReprException(Exception):
+    pass
+
+
+class SimpleRepr:
+    """Mixin providing automatic ``simple_repr`` support.
+
+    The simple repr of an object is built from its ``__init__`` signature:
+    for each parameter ``p`` the value is looked up on the instance as
+    ``self._p`` (or ``self.p``), recursively converted.
+    """
+
+    _repr_mapping: dict = {}
+
+    def _simple_repr(self):
+        r = {
+            SIMPLE_REPR_CLASS_KEY: type(self).__qualname__,
+            SIMPLE_REPR_MODULE_KEY: type(self).__module__,
+        }
+        args = _init_args(type(self))
+        for arg, has_default, default in args:
+            attr = "_" + self._repr_mapping.get(arg, arg)
+            if hasattr(self, attr):
+                val = getattr(self, attr)
+            elif hasattr(self, attr[1:]):
+                val = getattr(self, attr[1:])
+            elif has_default:
+                val = default
+            else:
+                raise SimpleReprException(
+                    f"Could not build repr for {self!r}: no attribute "
+                    f"for constructor argument {arg!r}"
+                )
+            r[arg] = simple_repr(val)
+        return r
+
+
+def _init_args(cls):
+    import inspect
+
+    sig = inspect.signature(cls.__init__)
+    args = []
+    for name, p in sig.parameters.items():
+        if name == "self":
+            continue
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        has_default = p.default is not inspect.Parameter.empty
+        args.append((name, has_default, p.default if has_default else None))
+    return args
+
+
+def simple_repr(o: Any):
+    """Return a plain (json/yaml-able) representation of ``o``."""
+    if isinstance(o, SimpleRepr):
+        return o._simple_repr()
+    if isinstance(o, tuple):
+        return {
+            SIMPLE_REPR_CLASS_KEY: "tuple",
+            SIMPLE_REPR_MODULE_KEY: "builtins",
+            "values": [simple_repr(i) for i in o],
+        }
+    if isinstance(o, list):
+        return [simple_repr(i) for i in o]
+    if isinstance(o, set):
+        return {
+            SIMPLE_REPR_CLASS_KEY: "set",
+            SIMPLE_REPR_MODULE_KEY: "builtins",
+            "values": [simple_repr(i) for i in o],
+        }
+    if isinstance(o, dict):
+        return {k: simple_repr(v) for k, v in o.items()}
+    if o is None or isinstance(o, (str, int, float, bool)):
+        return o
+    # numpy scalars / arrays: convert to python
+    try:
+        import numpy as np
+
+        if isinstance(o, np.ndarray):
+            return {
+                SIMPLE_REPR_CLASS_KEY: "ndarray",
+                SIMPLE_REPR_MODULE_KEY: "numpy",
+                "values": o.tolist(),
+            }
+        if isinstance(o, np.generic):
+            return o.item()
+    except ImportError:  # pragma: no cover
+        pass
+    raise SimpleReprException(f"Cannot build a simple repr for {o!r}")
+
+
+def from_repr(r: Any):
+    """Rebuild an object from its simple repr."""
+    if isinstance(r, list):
+        return [from_repr(i) for i in r]
+    if isinstance(r, dict):
+        if SIMPLE_REPR_CLASS_KEY not in r:
+            return {k: from_repr(v) for k, v in r.items()}
+        qual = r[SIMPLE_REPR_CLASS_KEY]
+        module = r[SIMPLE_REPR_MODULE_KEY]
+        if module == "builtins" and qual == "tuple":
+            return tuple(from_repr(i) for i in r["values"])
+        if module == "builtins" and qual == "set":
+            return set(from_repr(i) for i in r["values"])
+        if module == "numpy" and qual == "ndarray":
+            import numpy as np
+
+            return np.array(r["values"])
+        mod = import_module(module)
+        cls = mod
+        for part in qual.split("."):
+            cls = getattr(cls, part)
+        kwargs = {
+            k: from_repr(v)
+            for k, v in r.items()
+            if k not in (SIMPLE_REPR_CLASS_KEY, SIMPLE_REPR_MODULE_KEY)
+        }
+        if hasattr(cls, "_from_repr"):
+            return cls._from_repr(**kwargs)
+        return cls(**kwargs)
+    return r

@@ -1,0 +1,142 @@
+import pytest
+
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    create_agents,
+    create_binary_variables,
+    create_variables,
+)
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def test_domain_basics():
+    d = Domain("colors", "color", ["R", "G", "B"])
+    assert len(d) == 3
+    assert d.index("G") == 1
+    assert d.values == ("R", "G", "B")
+    assert "R" in d
+    assert list(d) == ["R", "G", "B"]
+    assert d[2] == "B"
+
+
+def test_domain_to_domain_value():
+    d = Domain("digits", "int", [0, 1, 2])
+    assert d.to_domain_value("2") == (2, 2)
+    with pytest.raises(ValueError):
+        d.to_domain_value("9")
+
+
+def test_domain_simple_repr_roundtrip():
+    d = Domain("colors", "color", ["R", "G"])
+    r = simple_repr(d)
+    d2 = from_repr(r)
+    assert d == d2
+
+
+def test_variable():
+    d = Domain("colors", "color", ["R", "G"])
+    v = Variable("v1", d, initial_value="G")
+    assert v.name == "v1"
+    assert v.initial_value == "G"
+    assert v.cost_for_val("R") == 0
+
+
+def test_variable_invalid_initial_value():
+    d = Domain("colors", "color", ["R", "G"])
+    with pytest.raises(ValueError):
+        Variable("v1", d, initial_value="B")
+
+
+def test_variable_from_iterable_domain():
+    v = Variable("v1", [1, 2, 3])
+    assert len(v.domain) == 3
+
+
+def test_variable_with_cost_func():
+    d = Domain("d", "", [0, 1, 2])
+    v = VariableWithCostFunc("v1", d, ExpressionFunction("v1 * 0.5"))
+    assert v.cost_for_val(2) == 1.0
+    assert v.has_cost
+
+
+def test_variable_with_cost_dict():
+    d = Domain("d", "", ["a", "b"])
+    v = VariableWithCostDict("v1", d, {"a": 1.0, "b": 2.0})
+    assert v.cost_for_val("b") == 2.0
+
+
+def test_noisy_cost_func_is_deterministic_per_instance():
+    d = Domain("d", "", [0, 1])
+    v = VariableNoisyCostFunc("v1", d, ExpressionFunction("v1 * 2"),
+                              noise_level=0.1)
+    c1, c2 = v.cost_for_val(1), v.cost_for_val(1)
+    assert c1 == c2
+    assert 2.0 <= c1 <= 2.1
+
+
+def test_binary_variable():
+    v = BinaryVariable("b1")
+    assert list(v.domain) == [0, 1]
+
+
+def test_external_variable_subscription():
+    d = Domain("d", "", [0, 1, 2])
+    v = ExternalVariable("e1", d, 0)
+    seen = []
+    v.subscribe(seen.append)
+    v.value = 2
+    assert v.value == 2
+    assert seen == [2]
+    with pytest.raises(ValueError):
+        v.value = 9
+
+
+def test_create_variables():
+    d = Domain("d", "", [0, 1])
+    vs = create_variables("v", ["a", "b", "c"], d)
+    assert set(vs) == {"v_a", "v_b", "v_c"}
+    vs2 = create_variables("m", [["x", "y"], ["1", "2"]], d)
+    assert ("x", "1") in vs2
+    assert vs2[("x", "1")].name == "mx_1"
+
+
+def test_create_binary_variables():
+    vs = create_binary_variables("b", list(range(3)))
+    assert len(vs) == 3
+    assert all(isinstance(v, BinaryVariable) for v in vs.values())
+
+
+def test_agentdef():
+    a = AgentDef("a1", capacity=42, foo="bar",
+                 hosting_costs={"c1": 5}, default_hosting_cost=1,
+                 routes={"a2": 3}, default_route=7)
+    assert a.capacity == 42
+    assert a.foo == "bar"
+    assert a.hosting_cost("c1") == 5
+    assert a.hosting_cost("cX") == 1
+    assert a.route("a2") == 3
+    assert a.route("a3") == 7
+    assert a.route("a1") == 0
+    with pytest.raises(AttributeError):
+        _ = a.missing_attr
+
+
+def test_agentdef_simple_repr_roundtrip():
+    a = AgentDef("a1", capacity=42, foo="bar")
+    a2 = from_repr(simple_repr(a))
+    assert a == a2
+    assert a2.foo == "bar"
+
+
+def test_create_agents():
+    agents = create_agents("a", list(range(5)), capacity=10)
+    assert len(agents) == 5
+    assert agents["a0"].capacity == 10

@@ -1,0 +1,236 @@
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import (
+    AsNAryFunctionRelation,
+    ConditionalRelation,
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    NeutralRelation,
+    UnaryBooleanRelation,
+    UnaryFunctionRelation,
+    ZeroAryRelation,
+    arg_projection,
+    assignment_cost,
+    constraint_from_str,
+    find_arg_optimal,
+    find_optimal,
+    find_optimum,
+    generate_assignment,
+    generate_assignment_as_dict,
+    join,
+    optimal_cost_value,
+    projection,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+d2 = Domain("d2", "", ["R", "G"])
+d3 = Domain("d3", "", [0, 1, 2])
+
+
+def test_zeroary():
+    r = ZeroAryRelation("z", 42)
+    assert r() == 42
+    assert r.arity == 0
+    assert r.slice({}) == r
+
+
+def test_unary_function_relation():
+    v = Variable("v1", d3)
+    r = UnaryFunctionRelation("u", v, lambda x: x * 2)
+    assert r(2) == 4
+    assert r(v1=1) == 2
+    assert r.arity == 1
+    s = r.slice({"v1": 2})
+    assert s.arity == 0
+    assert s() == 4
+
+
+def test_unary_boolean_relation():
+    v = Variable("v1", d3)
+    r = UnaryBooleanRelation("u", v)
+    assert r(0) == float("inf")
+    assert r(1) == 0
+
+
+def test_nary_function_relation():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+    r = NAryFunctionRelation(lambda a, b: a + b, [v1, v2], "sum")
+    assert r(1, 2) == 3
+    assert r(v1=1, v2=2) == 3
+    s = r.slice({"v1": 2})
+    assert s.arity == 1
+    assert s(v2=1) == 3
+
+
+def test_as_nary_decorator():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+
+    @AsNAryFunctionRelation(v1, v2)
+    def my_rel(v1, v2):
+        return v1 * v2
+
+    assert my_rel.name == "my_rel"
+    assert my_rel(2, 2) == 4
+
+
+def test_matrix_relation_from_func():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    r = NAryFunctionRelation(
+        lambda a, b: 1 if a == b else 0, [v1, v2], "diff")
+    m = r.to_matrix()
+    assert isinstance(m, NAryMatrixRelation)
+    assert m("R", "R") == 1
+    assert m("R", "G") == 0
+    assert m.matrix.shape == (2, 2)
+
+
+def test_matrix_relation_slice():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m")
+    s = m.slice({"v1": "G"})
+    assert s.arity == 1
+    assert s("R") == 3
+    assert s("G") == 4
+
+
+def test_matrix_set_value_immutable():
+    v1 = Variable("v1", d2)
+    m = NAryMatrixRelation([v1], np.array([0.0, 0.0]), "m")
+    m2 = m.set_value_for_assignment({"v1": "G"}, 5)
+    assert m("G") == 0
+    assert m2("G") == 5
+
+
+def test_matrix_get_value_for_assignment_list():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m")
+    assert m.get_value_for_assignment(["G", "R"]) == 3
+
+
+def test_matrix_simple_repr_roundtrip():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m")
+    m2 = from_repr(simple_repr(m))
+    assert m == m2
+
+
+def test_neutral_relation():
+    v1 = Variable("v1", d2)
+    r = NeutralRelation([v1])
+    assert r(v1="R") == 0
+
+
+def test_conditional_relation():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+    cond = UnaryFunctionRelation("c", v1, lambda x: x > 0)
+    rel = UnaryFunctionRelation("r", v2, lambda x: x * 10)
+    cr = ConditionalRelation(cond, rel)
+    assert cr(v1=1, v2=2) == 20
+    assert cr(v1=0, v2=2) == 0
+    assert {v.name for v in cr.dimensions} == {"v1", "v2"}
+
+
+def test_constraint_from_str():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+    c = constraint_from_str("c", "1 if v1 == v2 else 0", [v1, v2])
+    assert c(v1=1, v2=1) == 1
+    assert c(v1=0, v2=1) == 0
+    assert set(c.scope_names) == {"v1", "v2"}
+
+
+def test_constraint_from_str_unknown_var():
+    v1 = Variable("v1", d3)
+    with pytest.raises(ValueError):
+        constraint_from_str("c", "v1 + vX", [v1])
+
+
+def test_generate_assignments():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    assignments = list(generate_assignment([v1, v2]))
+    assert len(assignments) == 4
+    assert ["R", "R"] in assignments
+    dicts = list(generate_assignment_as_dict([v1, v2]))
+    assert {"v1": "G", "v2": "R"} in dicts
+
+
+def test_find_optimum():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m")
+    assert find_optimum(m, "min") == 1
+    assert find_optimum(m, "max") == 4
+
+
+def test_find_arg_optimal():
+    v1 = Variable("v1", d3)
+    r = UnaryFunctionRelation("u", v1, lambda x: (x - 1) ** 2)
+    vals, cost = find_arg_optimal(v1, r, "min")
+    assert vals == [1]
+    assert cost == 0
+
+
+def test_find_optimal_given_neighbors():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+    c = constraint_from_str("c", "abs(v1 - v2)", [v1, v2])
+    vals, cost = find_optimal(v1, {"v2": 2}, [c], "min")
+    assert vals == [2]
+    assert cost == 0
+
+
+def test_optimal_cost_value():
+    from pydcop_tpu.dcop.objects import VariableWithCostFunc
+    from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+    v = VariableWithCostFunc("v1", d3, ExpressionFunction("v1 * 2"))
+    val, cost = optimal_cost_value(v, "min")
+    assert val == 0 and cost == 0
+    val, cost = optimal_cost_value(v, "max")
+    assert val == 2 and cost == 4
+
+
+def test_assignment_cost():
+    v1, v2 = Variable("v1", d3), Variable("v2", d3)
+    c1 = constraint_from_str("c1", "v1 + v2", [v1, v2])
+    c2 = constraint_from_str("c2", "v1 * 2", [v1])
+    assert assignment_cost({"v1": 1, "v2": 2}, [c1, c2]) == 5
+
+
+def test_join_disjoint_scopes():
+    v1, v2, v3 = (Variable(n, d2) for n in ("v1", "v2", "v3"))
+    m1 = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m1")
+    m2 = NAryMatrixRelation([v2, v3], np.array([[10, 20], [30, 40]]), "m2")
+    j = join(m1, m2)
+    assert set(j.scope_names) == {"v1", "v2", "v3"}
+    # j(v1, v2, v3) = m1(v1,v2) + m2(v2,v3)
+    assert j(v1="R", v2="G", v3="R") == 2 + 30
+    assert j(v1="G", v2="R", v3="G") == 3 + 20
+
+
+def test_join_same_scope():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m1 = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 4]]), "m1")
+    m2 = NAryMatrixRelation([v2, v1], np.array([[5, 6], [7, 8]]), "m2")
+    j = join(m1, m2)
+    assert j.arity == 2
+    # m2 axes are (v2, v1): m2(v2=R, v1=G) = 6
+    assert j(v1="G", v2="R") == 3 + 6
+
+
+def test_projection_min():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    m = NAryMatrixRelation([v1, v2], np.array([[1, 2], [3, 0]]), "m")
+    p = projection(m, v2, "min")
+    assert p.arity == 1
+    assert p("R") == 1
+    assert p("G") == 0
+    args = arg_projection(m, v2, "min")
+    assert args.tolist() == [0, 1]
+
+
+def test_projection_to_scalar():
+    v1 = Variable("v1", d2)
+    m = NAryMatrixRelation([v1], np.array([3.0, 1.0]), "m")
+    p = projection(m, v1, "min")
+    assert p.arity == 0
+    assert p() == 1.0

@@ -1,0 +1,88 @@
+import pytest
+
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import (
+    SimpleRepr,
+    SimpleReprException,
+    from_repr,
+    simple_repr,
+)
+
+
+def test_expression_function_basic():
+    f = ExpressionFunction("a + b * 2")
+    assert sorted(f.variable_names) == ["a", "b"]
+    assert f(a=1, b=2) == 5
+
+
+def test_expression_function_builtins():
+    f = ExpressionFunction("abs(x - 3) + round(y)")
+    assert f(x=1, y=1.4) == 3
+
+
+def test_expression_function_partial():
+    f = ExpressionFunction("a + b")
+    g = f.partial(a=10)
+    assert list(g.variable_names) == ["b"]
+    assert g(b=5) == 15
+
+
+def test_expression_function_missing_var():
+    f = ExpressionFunction("a + b")
+    with pytest.raises(TypeError):
+        f(a=1)
+
+
+def test_expression_function_ternary():
+    f = ExpressionFunction("1 if v1 == v2 else 0")
+    assert f(v1="R", v2="R") == 1
+
+
+def test_expression_function_repr_roundtrip():
+    f = ExpressionFunction("a + b")
+    f2 = from_repr(simple_repr(f))
+    assert f2(a=1, b=1) == 2
+    assert f == f2
+
+
+def test_expression_function_source_file(tmp_path):
+    src = tmp_path / "helpers.py"
+    src.write_text("def double(x):\n    return 2 * x\n")
+    f = ExpressionFunction("double(a) + 1", source_file=str(src))
+    assert f(a=3) == 7
+
+
+class Point(SimpleRepr):
+    def __init__(self, x, y=0):
+        self._x = x
+        self._y = y
+
+    def __eq__(self, o):
+        return isinstance(o, Point) and self._x == o._x and self._y == o._y
+
+
+def test_simple_repr_roundtrip():
+    p = Point(1, 2)
+    r = simple_repr(p)
+    assert r["x"] == 1
+    p2 = from_repr(r)
+    assert p == p2
+
+
+def test_simple_repr_nested():
+    r = simple_repr({"points": [Point(1), Point(2, 3)], "n": 4})
+    back = from_repr(r)
+    assert back["n"] == 4
+    assert back["points"][0] == Point(1)
+
+
+def test_simple_repr_tuple_set():
+    r = simple_repr((1, 2))
+    assert from_repr(r) == (1, 2)
+    r = simple_repr({1, 2})
+    assert from_repr(r) == {1, 2}
+
+
+def test_simple_repr_unsupported():
+    with pytest.raises(SimpleReprException):
+        simple_repr(object())

@@ -1,0 +1,257 @@
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import (
+    dcop_yaml,
+    load_dcop,
+    load_scenario,
+    str_2_domain_values,
+    yaml_scenario,
+)
+
+GRAPH_COLORING = """
+name: graph coloring
+objective: min
+
+domains:
+  colors:
+    values: [R, G]
+    type: color
+
+variables:
+  v1:
+    domain: colors
+    cost_function: -0.1 if v1 == 'R' else 0.1
+  v2:
+    domain: colors
+    cost_function: -0.1 if v2 == 'G' else 0.1
+  v3:
+    domain: colors
+    cost_function: -0.1 if v3 == 'G' else 0.1
+
+constraints:
+  diff_1_2:
+    type: intention
+    function: 1 if v1 == v2 else 0
+  diff_2_3:
+    type: intention
+    function: 1 if v3 == v2 else 0
+
+agents:
+  a1:
+    capacity: 100
+  a2:
+    capacity: 100
+  a3:
+    capacity: 100
+
+distribution_hints:
+  must_host:
+    a1: [v1]
+    a2: [v2]
+"""
+
+EXTENSIONAL = """
+name: ext
+objective: min
+domains:
+  colors:
+    values: [R, G]
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+constraints:
+  c_1_2:
+    type: extensional
+    variables: [v1, v2]
+    values:
+      5: R R
+      8: R G
+      20: G R
+      3: G G
+  c_or:
+    type: extensional
+    default: 9
+    variables: [v1, v2]
+    values:
+      3: R R | G G
+agents: [a1, a2]
+"""
+
+
+def test_load_graph_coloring():
+    dcop = load_dcop(GRAPH_COLORING)
+    assert dcop.name == "graph coloring"
+    assert dcop.objective == "min"
+    assert set(dcop.variables) == {"v1", "v2", "v3"}
+    assert set(dcop.constraints) == {"diff_1_2", "diff_2_3"}
+    assert len(dcop.agents) == 3
+    assert dcop.agents["a1"].capacity == 100
+    # variable costs
+    assert dcop.variables["v1"].cost_for_val("R") == pytest.approx(-0.1)
+    # constraint semantics
+    c = dcop.constraints["diff_1_2"]
+    assert c(v1="R", v2="R") == 1
+    assert c(v1="R", v2="G") == 0
+    # hints
+    assert dcop.dist_hints.must_host("a1") == ["v1"]
+    assert dcop.dist_hints.must_host("a3") == []
+
+
+def test_solution_cost():
+    dcop = load_dcop(GRAPH_COLORING)
+    cost, violations = dcop.solution_cost(
+        {"v1": "R", "v2": "G", "v3": "R"})
+    assert cost == pytest.approx(-0.1 - 0.1 + 0.1)
+    assert violations == 0
+
+
+def test_load_extensional():
+    dcop = load_dcop(EXTENSIONAL)
+    c = dcop.constraints["c_1_2"]
+    assert c(v1="R", v2="R") == 5
+    assert c(v1="G", v2="R") == 20
+    c_or = dcop.constraints["c_or"]
+    assert c_or(v1="R", v2="R") == 3
+    assert c_or(v1="G", v2="G") == 3
+    assert c_or(v1="R", v2="G") == 9
+    # agents as a list
+    assert set(dcop.agents) == {"a1", "a2"}
+
+
+def test_extensional_single_variable():
+    yaml_str = """
+name: t
+domains:
+  d: {values: [a, b, c]}
+variables:
+  v1: {domain: d}
+constraints:
+  c1:
+    type: extensional
+    default: 0
+    variables: v1
+    values:
+      10: a | c
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    c = dcop.constraints["c1"]
+    assert c(v1="a") == 10
+    assert c(v1="b") == 0
+    assert c(v1="c") == 10
+
+
+def test_domain_range_shorthand():
+    yaml_str = """
+name: t
+domains:
+  d:
+    values: [0 .. 3]
+variables:
+  v1: {domain: d}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    assert list(dcop.domains["d"].values) == [0, 1, 2, 3]
+
+
+def test_str_2_domain_values():
+    assert str_2_domain_values("0..5") == [0, 1, 2, 3, 4, 5]
+
+
+def test_initial_value_validation():
+    yaml_str = """
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  v1: {domain: d, initial_value: 9}
+agents: [a1]
+"""
+    with pytest.raises(ValueError):
+        load_dcop(yaml_str)
+
+
+def test_hosting_costs_and_routes():
+    yaml_str = """
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  v1: {domain: d}
+agents:
+  a1: {capacity: 10}
+  a2: {capacity: 20}
+routes:
+  default: 5
+  a1: {a2: 2}
+hosting_costs:
+  default: 100
+  a1:
+    default: 7
+    computations: {v1: 3}
+"""
+    dcop = load_dcop(yaml_str)
+    a1, a2 = dcop.agents["a1"], dcop.agents["a2"]
+    assert a1.route("a2") == 2
+    assert a2.route("a1") == 2
+    assert a2.route("aX") == 5
+    assert a1.hosting_cost("v1") == 3
+    assert a1.hosting_cost("vX") == 7
+    assert a2.hosting_cost("v1") == 100
+
+
+def test_yaml_roundtrip():
+    dcop = load_dcop(GRAPH_COLORING)
+    s = dcop_yaml(dcop)
+    dcop2 = load_dcop(s)
+    assert set(dcop2.variables) == set(dcop.variables)
+    assert set(dcop2.constraints) == set(dcop.constraints)
+    c = dcop2.constraints["diff_1_2"]
+    assert c(v1="R", v2="R") == 1
+
+
+def test_yaml_roundtrip_extensional():
+    dcop = load_dcop(EXTENSIONAL)
+    dcop2 = load_dcop(dcop_yaml(dcop))
+    c = dcop2.constraints["c_1_2"]
+    assert c(v1="G", v2="R") == 20
+
+
+def test_load_scenario():
+    scenario_str = """
+events:
+  - id: w1
+    delay: 10
+  - id: e1
+    actions:
+      - type: remove_agent
+        agent: a1
+"""
+    s = load_scenario(scenario_str)
+    assert len(s) == 2
+    assert s.events[0].is_delay
+    assert s.events[0].delay == 10
+    assert s.events[1].actions[0].type == "remove_agent"
+    assert s.events[1].actions[0].args == {"agent": "a1"}
+    # roundtrip
+    s2 = load_scenario(yaml_scenario(s))
+    assert s2 == s
+
+
+def test_multiline_concat_load():
+    part1 = """
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  v1: {domain: d}
+"""
+    part2 = """
+agents: [a1, a2]
+"""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    # the reference concatenates multiple files; emulate with strings
+    dcop = load_dcop(part1 + part2)
+    assert set(dcop.agents) == {"a1", "a2"}
