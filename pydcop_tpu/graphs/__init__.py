@@ -1,0 +1,34 @@
+"""Computation-graph builders and their padded-array exports.
+
+Each sub-module exposes ``build_computation_graph(dcop)`` like the
+reference's ``pydcop/computations_graph`` package; :mod:`arrays` exports
+the compiled on-device form.
+"""
+
+from . import constraints_hypergraph, factor_graph, ordered_graph, pseudotree
+from .arrays import FactorGraphArrays, HypergraphArrays
+from .objects import ComputationGraph, ComputationNode, Link
+
+GRAPH_MODULES = {
+    "factor_graph": factor_graph,
+    "constraints_hypergraph": constraints_hypergraph,
+    "pseudotree": pseudotree,
+    "ordered_graph": ordered_graph,
+}
+
+
+def load_graph_module(graph_type: str):
+    """Parity with the reference's dynamic graph-module loading
+    (pydcop/computations_graph/__init__.py)."""
+    try:
+        return GRAPH_MODULES[graph_type]
+    except KeyError:
+        raise ImportError(f"Unknown graph type: {graph_type}")
+
+
+__all__ = [
+    "ComputationGraph", "ComputationNode", "Link",
+    "FactorGraphArrays", "HypergraphArrays",
+    "factor_graph", "constraints_hypergraph", "pseudotree", "ordered_graph",
+    "load_graph_module", "GRAPH_MODULES",
+]

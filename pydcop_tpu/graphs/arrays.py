@@ -1,0 +1,255 @@
+"""Padded array export of computation graphs — the on-device representation.
+
+This is the load-bearing design decision of the TPU framework (SURVEY.md §7):
+the computation graph is compiled once, host-side, into dense padded index
+arrays; one algorithm round over the *whole* graph is then a single jitted
+XLA program of gathers, broadcast-adds and segment reductions.  Message
+delivery — the reference's entire infrastructure layer of queues, threads
+and HTTP posts (pydcop/infrastructure/communication.py) — becomes array
+indexing on-chip.
+
+Conventions
+-----------
+* Variables and factors are integer ids in model iteration order.
+* All domains are padded to ``max_domain``; invalid slots are masked and
+  carry ``BIG`` cost so no reduction ever selects them.
+* ``max`` objectives are compiled to ``min`` by negating every cost at
+  build time (``sign``); reported costs are re-evaluated host-side.
+* Factors/constraints are bucketed by arity; each bucket stacks its cost
+  hypercubes into one ``(n, D, ..., D)`` tensor — static shapes, ready for
+  the MXU/VPU.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..dcop.dcop import DCOP
+from ..dcop.relations import Constraint
+
+BIG = np.float32(1e9)
+# Hard-constraint costs (inf in the model) are clipped to this so sums of a
+# few violations stay well under BIG and far from float32 overflow.
+HARD = np.float32(1e7)
+
+
+def _clip_costs(cube: np.ndarray, sign: float) -> np.ndarray:
+    cube = np.asarray(cube, dtype=np.float32) * np.float32(sign)
+    cube = np.nan_to_num(cube, posinf=HARD, neginf=-HARD)
+    return np.clip(cube, -HARD, HARD)
+
+
+def _padded_cube(constraint: Constraint, max_domain: int,
+                 sign: float) -> np.ndarray:
+    cube = _clip_costs(constraint.cost_hypercube(), sign)
+    pads = [(0, max_domain - s) for s in cube.shape]
+    return np.pad(cube, pads, constant_values=BIG)
+
+
+@dataclass
+class FactorBucket:
+    """All factors of one arity, stacked."""
+
+    arity: int
+    factor_ids: np.ndarray          # (Fa,) global factor index
+    cubes: np.ndarray               # (Fa, D, ..., D) padded costs
+    edge_ids: np.ndarray            # (Fa, arity) edge index per position
+    var_ids: np.ndarray             # (Fa, arity) variable index per position
+
+
+@dataclass
+class FactorGraphArrays:
+    """Compiled factor graph for the max-sum family."""
+
+    n_vars: int
+    n_factors: int
+    n_edges: int
+    max_domain: int
+    sign: float                      # +1 min, -1 max
+    var_names: List[str]
+    factor_names: List[str]
+    domain_size: np.ndarray          # (V,)
+    domain_mask: np.ndarray          # (V, D) bool
+    var_costs: np.ndarray            # (V, D) unary costs, BIG-padded
+    edge_var: np.ndarray             # (E,)
+    edge_factor: np.ndarray          # (E,)
+    buckets: List[FactorBucket] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, dcop: DCOP,
+              variables=None, constraints=None) -> "FactorGraphArrays":
+        if variables is None:
+            variables = list(dcop.variables.values())
+        if constraints is None:
+            constraints = list(dcop.constraints.values())
+        sign = 1.0 if dcop.objective == "min" else -1.0
+
+        var_names = [v.name for v in variables]
+        var_idx = {n: i for i, n in enumerate(var_names)}
+        factor_names = [c.name for c in constraints]
+        V, F = len(variables), len(constraints)
+        D = max((len(v.domain) for v in variables), default=1)
+
+        domain_size = np.array([len(v.domain) for v in variables],
+                               dtype=np.int32)
+        domain_mask = np.arange(D)[None, :] < domain_size[:, None]
+        var_costs = np.full((V, D), BIG, dtype=np.float32)
+        for i, v in enumerate(variables):
+            costs = _clip_costs(
+                np.array([v.cost_for_val(val) for val in v.domain]), sign)
+            var_costs[i, : len(v.domain)] = costs
+
+        edge_var, edge_factor = [], []
+        by_arity: Dict[int, List[int]] = {}
+        edge_of: Dict[Tuple[int, int], int] = {}
+        for f, c in enumerate(constraints):
+            by_arity.setdefault(c.arity, []).append(f)
+            for p, v in enumerate(c.dimensions):
+                edge_of[(f, p)] = len(edge_var)
+                edge_var.append(var_idx[v.name])
+                edge_factor.append(f)
+        E = len(edge_var)
+
+        buckets = []
+        for arity in sorted(by_arity):
+            ids = by_arity[arity]
+            cubes = np.stack([
+                _padded_cube(constraints[f], D, sign) for f in ids
+            ])
+            e_ids = np.array(
+                [[edge_of[(f, p)] for p in range(arity)] for f in ids],
+                dtype=np.int32,
+            )
+            v_ids = np.array(
+                [[var_idx[constraints[f].dimensions[p].name]
+                  for p in range(arity)] for f in ids],
+                dtype=np.int32,
+            )
+            buckets.append(FactorBucket(
+                arity, np.array(ids, dtype=np.int32), cubes, e_ids, v_ids))
+
+        return cls(
+            n_vars=V, n_factors=F, n_edges=E, max_domain=D, sign=sign,
+            var_names=var_names, factor_names=factor_names,
+            domain_size=domain_size, domain_mask=domain_mask,
+            var_costs=var_costs,
+            edge_var=np.array(edge_var, dtype=np.int32),
+            edge_factor=np.array(edge_factor, dtype=np.int32),
+            buckets=buckets,
+        )
+
+    def assignment_from_indices(self, idx: np.ndarray,
+                                variables) -> Dict[str, object]:
+        return {
+            v.name: v.domain.values[int(i)]
+            for v, i in zip(variables, idx)
+        }
+
+
+@dataclass
+class ConstraintBucket:
+    """All constraints of one arity, stacked (hypergraph form)."""
+
+    arity: int
+    cons_ids: np.ndarray            # (Ca,)
+    cubes: np.ndarray               # (Ca, D, ..., D)
+    var_ids: np.ndarray             # (Ca, arity)
+
+
+@dataclass
+class HypergraphArrays:
+    """Compiled constraints hypergraph for local-search algorithms."""
+
+    n_vars: int
+    n_constraints: int
+    max_domain: int
+    sign: float
+    var_names: List[str]
+    domain_size: np.ndarray          # (V,)
+    domain_mask: np.ndarray          # (V, D)
+    var_costs: np.ndarray            # (V, D)
+    initial_idx: np.ndarray          # (V,) initial value indices
+    has_initial: np.ndarray          # (V,) bool: explicit initial value?
+    buckets: List[ConstraintBucket] = field(default_factory=list)
+    # variable-to-variable neighbor pairs (deduped, both directions),
+    # for gain-exchange style algorithms (mgm, dba ...)
+    nbr_src: np.ndarray = None       # (P,)
+    nbr_dst: np.ndarray = None       # (P,)
+    max_degree: int = 0              # max #neighbors of any variable
+    max_arity_minus_one: int = 0     # for DSA p_mode thresholds
+
+    @classmethod
+    def build(cls, dcop: DCOP,
+              variables=None, constraints=None) -> "HypergraphArrays":
+        if variables is None:
+            variables = list(dcop.variables.values())
+        if constraints is None:
+            constraints = list(dcop.constraints.values())
+        sign = 1.0 if dcop.objective == "min" else -1.0
+
+        var_names = [v.name for v in variables]
+        var_idx = {n: i for i, n in enumerate(var_names)}
+        V = len(variables)
+        D = max((len(v.domain) for v in variables), default=1)
+
+        domain_size = np.array([len(v.domain) for v in variables],
+                               dtype=np.int32)
+        domain_mask = np.arange(D)[None, :] < domain_size[:, None]
+        var_costs = np.full((V, D), BIG, dtype=np.float32)
+        initial_idx = np.zeros(V, dtype=np.int32)
+        has_initial = np.zeros(V, dtype=bool)
+        for i, v in enumerate(variables):
+            costs = _clip_costs(
+                np.array([v.cost_for_val(val) for val in v.domain]), sign)
+            var_costs[i, : len(v.domain)] = costs
+            if v.initial_value is not None:
+                initial_idx[i] = v.domain.index(v.initial_value)
+                has_initial[i] = True
+
+        by_arity: Dict[int, List[int]] = {}
+        for ci, c in enumerate(constraints):
+            by_arity.setdefault(c.arity, []).append(ci)
+
+        buckets = []
+        pairs = set()
+        for arity in sorted(by_arity):
+            ids = by_arity[arity]
+            cubes = np.stack([
+                _padded_cube(constraints[ci], D, sign) for ci in ids
+            ])
+            v_ids = np.array(
+                [[var_idx[v.name] for v in constraints[ci].dimensions]
+                 for ci in ids],
+                dtype=np.int32,
+            )
+            buckets.append(ConstraintBucket(
+                arity, np.array(ids, dtype=np.int32), cubes, v_ids))
+            for ci in ids:
+                scope = [var_idx[v.name] for v in constraints[ci].dimensions]
+                for i, a in enumerate(scope):
+                    for b in scope[i + 1:]:
+                        if a != b:
+                            pairs.add((a, b))
+                            pairs.add((b, a))
+
+        if pairs:
+            src, dst = zip(*sorted(pairs))
+        else:
+            src, dst = (), ()
+        degree = np.zeros(V, dtype=np.int64)
+        for s in src:
+            degree[s] += 1
+        max_arity = max((c.arity for c in constraints), default=1)
+
+        return cls(
+            n_vars=V, n_constraints=len(constraints), max_domain=D,
+            sign=sign, var_names=var_names,
+            domain_size=domain_size, domain_mask=domain_mask,
+            var_costs=var_costs, initial_idx=initial_idx,
+            has_initial=has_initial, buckets=buckets,
+            nbr_src=np.array(src, dtype=np.int32),
+            nbr_dst=np.array(dst, dtype=np.int32),
+            max_degree=int(degree.max()) if V else 0,
+            max_arity_minus_one=max(0, max_arity - 1),
+        )

@@ -1,0 +1,91 @@
+"""Constraints hypergraph: one node per variable, one hyperedge per
+constraint.
+
+reference parity: pydcop/computations_graph/constraints_hypergraph.py:46-237.
+Used by all local-search algorithms (dsa, mgm, mgm2, dba, gdba, ...).
+"""
+
+from typing import Iterable, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import Variable
+from ..dcop.relations import Constraint
+from .objects import ComputationGraph, ComputationNode, Link
+
+
+class ConstraintLink(Link):
+    """Hyperedge: links every variable in a constraint's scope."""
+
+    def __init__(self, constraint_name: str, nodes: Iterable[str]):
+        super().__init__(nodes, "constraint_link")
+        self._constraint_name = constraint_name
+
+    @property
+    def constraint_name(self) -> str:
+        return self._constraint_name
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, ConstraintLink)
+            and self._constraint_name == o._constraint_name
+            and self.nodes == o.nodes
+        )
+
+    def __hash__(self):
+        return hash((self._constraint_name, self.nodes))
+
+    def __repr__(self):
+        return f"ConstraintLink({self._constraint_name}, {self.nodes})"
+
+
+class VariableComputationNode(ComputationNode):
+    def __init__(self, variable: Variable,
+                 constraints: Iterable[Constraint]):
+        self._constraints = list(constraints)
+        links = [
+            ConstraintLink(c.name, [v.name for v in c.dimensions])
+            for c in self._constraints
+        ]
+        super().__init__(variable.name, "VariableComputation", links)
+        self._variable = variable
+
+    @property
+    def variable(self) -> Variable:
+        return self._variable
+
+    @property
+    def constraints(self) -> List[Constraint]:
+        return list(self._constraints)
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, VariableComputationNode)
+            and self._variable == o._variable
+        )
+
+    def __hash__(self):
+        return hash(("chg.VariableComputationNode", self._name))
+
+
+class ComputationConstraintsHyperGraph(ComputationGraph):
+    def __init__(self, nodes):
+        super().__init__("ConstraintHyperGraph", nodes)
+
+
+def build_computation_graph(dcop: Optional[DCOP] = None,
+                            variables: Optional[Iterable[Variable]] = None,
+                            constraints: Optional[Iterable[Constraint]] = None
+                            ) -> ComputationConstraintsHyperGraph:
+    """Build the hypergraph (reference: constraints_hypergraph.py:176-237)."""
+    if dcop is not None:
+        variables = list(dcop.variables.values())
+        constraints = list(dcop.constraints.values())
+    else:
+        variables = list(variables or [])
+        constraints = list(constraints or [])
+
+    nodes = []
+    for v in variables:
+        v_constraints = [c for c in constraints if v in c.dimensions]
+        nodes.append(VariableComputationNode(v, v_constraints))
+    return ComputationConstraintsHyperGraph(nodes)

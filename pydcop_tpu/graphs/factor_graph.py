@@ -1,0 +1,100 @@
+"""Bipartite factor graph: one node per variable, one per constraint.
+
+reference parity: pydcop/computations_graph/factor_graph.py:45-288.
+Used by the max-sum family.
+"""
+
+from typing import Iterable, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import Variable
+from ..dcop.relations import Constraint
+from .objects import ComputationGraph, ComputationNode, Link
+
+GRAPH_NODE_TYPE_VARIABLE = "VariableComputation"
+GRAPH_NODE_TYPE_FACTOR = "FactorComputation"
+
+
+class VariableComputationNode(ComputationNode):
+    def __init__(self, variable: Variable, factor_names: Iterable[str]):
+        links = [
+            FactorGraphLink(variable.name, f) for f in factor_names
+        ]
+        super().__init__(variable.name, GRAPH_NODE_TYPE_VARIABLE, links)
+        self._variable = variable
+
+    @property
+    def variable(self) -> Variable:
+        return self._variable
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, VariableComputationNode)
+            and self._variable == o._variable
+        )
+
+    def __hash__(self):
+        return hash(("VariableComputationNode", self._name))
+
+
+class FactorComputationNode(ComputationNode):
+    def __init__(self, factor: Constraint, name: Optional[str] = None):
+        name = name if name else factor.name
+        links = [FactorGraphLink(name, v.name) for v in factor.dimensions]
+        super().__init__(name, GRAPH_NODE_TYPE_FACTOR, links)
+        self._factor = factor
+
+    @property
+    def factor(self) -> Constraint:
+        return self._factor
+
+    @property
+    def variables(self) -> List[Variable]:
+        return self._factor.dimensions
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, FactorComputationNode)
+            and self._name == o._name
+            and self._factor == o._factor
+        )
+
+    def __hash__(self):
+        return hash(("FactorComputationNode", self._name))
+
+
+class FactorGraphLink(Link):
+    def __init__(self, node1: str, node2: str):
+        super().__init__([node1, node2], "factor_link")
+
+
+class ComputationsFactorGraph(ComputationGraph):
+    def __init__(self, var_nodes, factor_nodes):
+        super().__init__("FactorGraph", list(var_nodes) + list(factor_nodes))
+        self.var_nodes = list(var_nodes)
+        self.factor_nodes = list(factor_nodes)
+
+
+def build_computation_graph(dcop: Optional[DCOP] = None,
+                            variables: Optional[Iterable[Variable]] = None,
+                            constraints: Optional[Iterable[Constraint]] = None
+                            ) -> ComputationsFactorGraph:
+    """Build the factor graph (reference: factor_graph.py:245-288)."""
+    if dcop is not None:
+        variables = list(dcop.variables.values())
+        constraints = list(dcop.constraints.values())
+    else:
+        variables = list(variables or [])
+        constraints = list(constraints or [])
+
+    factors_of = {v.name: [] for v in variables}
+    factor_nodes = []
+    for c in constraints:
+        factor_nodes.append(FactorComputationNode(c))
+        for v in c.dimensions:
+            factors_of.setdefault(v.name, []).append(c.name)
+
+    var_nodes = [
+        VariableComputationNode(v, factors_of[v.name]) for v in variables
+    ]
+    return ComputationsFactorGraph(var_nodes, factor_nodes)

@@ -1,0 +1,138 @@
+"""Abstract computation-graph objects.
+
+reference parity: pydcop/computations_graph/objects.py:37-329.
+Nodes/links describe *what must be computed and who talks to whom*; they are
+used by the distribution layer, the CLI ``graph`` command and tests.  The
+hot-path representation used on device is the padded array form exported by
+:mod:`pydcop_tpu.graphs.arrays`.
+"""
+
+from typing import Any, Iterable, List, Optional, Set
+
+from ..utils.simple_repr import SimpleRepr
+
+
+class Link(SimpleRepr):
+    """A communication link between computation nodes."""
+
+    def __init__(self, nodes: Iterable[str], link_type: str = "link"):
+        self._nodes = tuple(sorted(nodes))
+        self._link_type = link_type
+
+    @property
+    def nodes(self):
+        return self._nodes
+
+    @property
+    def type(self) -> str:
+        return self._link_type
+
+    def has_node(self, name: str) -> bool:
+        return name in self._nodes
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, Link)
+            and self._nodes == o._nodes
+            and self._link_type == o._link_type
+        )
+
+    def __hash__(self):
+        return hash((self._nodes, self._link_type))
+
+    def __repr__(self):
+        return f"Link({self._link_type}, {self._nodes})"
+
+
+class ComputationNode(SimpleRepr):
+    """A node in a computation graph: one message-passing computation."""
+
+    def __init__(self, name: str, node_type: str = "computation",
+                 links: Optional[Iterable[Link]] = None):
+        self._name = name
+        self._node_type = node_type
+        self._links = list(links) if links else []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def type(self) -> str:
+        return self._node_type
+
+    @property
+    def links(self) -> List[Link]:
+        return list(self._links)
+
+    @property
+    def neighbors(self) -> List[str]:
+        seen, out = {self._name}, []
+        for l in self._links:
+            for n in l.nodes:
+                if n not in seen:
+                    seen.add(n)
+                    out.append(n)
+        return out
+
+    def is_neighbor(self, other: str) -> bool:
+        return other in self.neighbors
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, ComputationNode)
+            and self._name == o._name
+            and self._node_type == o._node_type
+        )
+
+    def __hash__(self):
+        return hash((self._name, self._node_type))
+
+    def __repr__(self):
+        return f"ComputationNode({self._name!r}, {self._node_type!r})"
+
+    def __str__(self):
+        return self._name
+
+
+class ComputationGraph:
+    """A set of computation nodes + links."""
+
+    def __init__(self, graph_type: str,
+                 nodes: Optional[Iterable[ComputationNode]] = None):
+        self._graph_type = graph_type
+        self.nodes: List[ComputationNode] = list(nodes) if nodes else []
+
+    @property
+    def graph_type(self) -> str:
+        return self._graph_type
+
+    def computation(self, name: str) -> ComputationNode:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(f"No computation {name} in graph")
+
+    def computations(self) -> List[ComputationNode]:
+        return list(self.nodes)
+
+    def links_for_node(self, name: str) -> List[Link]:
+        return [l for n in self.nodes if n.name == name for l in n.links]
+
+    @property
+    def links(self) -> List[Link]:
+        out: Set[Link] = set()
+        for n in self.nodes:
+            out.update(n.links)
+        return list(out)
+
+    def density(self) -> float:
+        """edges / edges-of-complete-graph (reference: objects.py:328)."""
+        n = len(self.nodes)
+        if n < 2:
+            return 0.0
+        e = len(self.links)
+        return 2 * e / (n * (n - 1))
+
+    def __len__(self):
+        return len(self.nodes)

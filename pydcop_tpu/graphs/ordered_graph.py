@@ -1,0 +1,103 @@
+"""Ordered chain graph (for SyncBB).
+
+reference parity: pydcop/computations_graph/ordered_graph.py:46-206 —
+variables in lexical order, each node linked to the next/previous one.
+"""
+
+from typing import Iterable, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import Variable
+from ..dcop.relations import Constraint
+from .objects import ComputationGraph, ComputationNode, Link
+
+
+class OrderLink(Link):
+    def __init__(self, link_type: str, source: str, target: str):
+        if link_type not in ("next", "previous"):
+            raise ValueError(f"Invalid order link type {link_type}")
+        super().__init__([source, target], link_type)
+        self._source = source
+        self._target = target
+
+    @property
+    def source(self):
+        return self._source
+
+    @property
+    def target(self):
+        return self._target
+
+
+class OrderedVarNode(ComputationNode):
+    def __init__(self, variable: Variable,
+                 constraints: Iterable[Constraint],
+                 position: int,
+                 previous_node: Optional[str],
+                 next_node: Optional[str]):
+        links = []
+        if previous_node:
+            links.append(OrderLink("previous", variable.name, previous_node))
+        if next_node:
+            links.append(OrderLink("next", variable.name, next_node))
+        super().__init__(variable.name, "OrderedVarNode", links)
+        self._variable = variable
+        self._constraints = list(constraints)
+        self._position = position
+
+    @property
+    def variable(self) -> Variable:
+        return self._variable
+
+    @property
+    def constraints(self) -> List[Constraint]:
+        return list(self._constraints)
+
+    @property
+    def position(self) -> int:
+        return self._position
+
+
+class OrderedGraph(ComputationGraph):
+    def __init__(self, nodes: Iterable[OrderedVarNode]):
+        nodes = sorted(nodes, key=lambda n: n.position)
+        super().__init__("OrderedGraph", nodes)
+
+    @property
+    def ordered_nodes(self) -> List[OrderedVarNode]:
+        return list(self.nodes)
+
+
+def build_computation_graph(dcop: Optional[DCOP] = None,
+                            variables: Optional[Iterable[Variable]] = None,
+                            constraints: Optional[Iterable[Constraint]] = None
+                            ) -> OrderedGraph:
+    """Chain of variables in lexical name order
+    (reference: ordered_graph.py:182-206)."""
+    if dcop is not None:
+        variables = list(dcop.variables.values())
+        constraints = list(dcop.constraints.values())
+    else:
+        variables = list(variables or [])
+        constraints = list(constraints or [])
+
+    ordered = sorted(variables, key=lambda v: v.name)
+    names = [v.name for v in ordered]
+    nodes = []
+    for i, v in enumerate(ordered):
+        # constraints whose scope's *last* variable (in the order) is v:
+        # handled when the chain token reaches v
+        v_constraints = [
+            c for c in constraints
+            if max(
+                (names.index(x.name) for x in c.dimensions
+                 if x.name in names),
+                default=-1,
+            ) == i
+        ]
+        nodes.append(OrderedVarNode(
+            v, v_constraints, i,
+            names[i - 1] if i > 0 else None,
+            names[i + 1] if i < len(names) - 1 else None,
+        ))
+    return OrderedGraph(nodes)

@@ -1,0 +1,238 @@
+"""DFS pseudo-tree computation graph (for DPOP / NCBB).
+
+reference parity: pydcop/computations_graph/pseudotree.py:178-539.  The
+reference builds the tree through a token-passing simulation; the result is
+a plain DFS tree, so we compute it directly host-side (iterative DFS, no
+recursion limit on 10k+ variable problems) with the same heuristics:
+
+* root = highest-degree variable (pseudotree.py:350),
+* pseudo-parent/pseudo-child classification from back-edges,
+* each constraint is handled by the *lowest* (deepest) node of its scope
+  (pseudotree.py:452, ``_filter_relation_to_lowest_node``),
+* forests (disconnected problems) yield several roots (pseudotree.py:531).
+"""
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import Variable
+from ..dcop.relations import Constraint
+from .objects import ComputationGraph, ComputationNode, Link
+
+
+class PseudoTreeLink(Link):
+    def __init__(self, link_type: str, source: str, target: str):
+        # link_type: parent | pseudo_parent
+        super().__init__([source, target], link_type)
+        self._source = source
+        self._target = target
+
+    @property
+    def source(self):
+        return self._source
+
+    @property
+    def target(self):
+        return self._target
+
+
+class PseudoTreeNode(ComputationNode):
+    def __init__(self, variable: Variable,
+                 constraints: Iterable[Constraint],
+                 parent: Optional[str] = None,
+                 pseudo_parents: Optional[List[str]] = None,
+                 children: Optional[List[str]] = None,
+                 pseudo_children: Optional[List[str]] = None,
+                 depth: int = 0):
+        self._parent = parent
+        self._pseudo_parents = list(pseudo_parents or [])
+        self._children = list(children or [])
+        self._pseudo_children = list(pseudo_children or [])
+        links = []
+        if parent:
+            links.append(PseudoTreeLink("parent", variable.name, parent))
+        for pp in self._pseudo_parents:
+            links.append(PseudoTreeLink("pseudo_parent", variable.name, pp))
+        for c in self._children:
+            links.append(PseudoTreeLink("children", variable.name, c))
+        for pc in self._pseudo_children:
+            links.append(PseudoTreeLink("pseudo_children", variable.name, pc))
+        super().__init__(variable.name, "PseudoTreeComputation", links)
+        self._variable = variable
+        self._constraints = list(constraints)
+        self._depth = depth
+
+    @property
+    def variable(self) -> Variable:
+        return self._variable
+
+    @property
+    def constraints(self) -> List[Constraint]:
+        """Constraints this node is responsible for (lowest-node rule)."""
+        return list(self._constraints)
+
+    @property
+    def parent(self) -> Optional[str]:
+        return self._parent
+
+    @property
+    def pseudo_parents(self) -> List[str]:
+        return list(self._pseudo_parents)
+
+    @property
+    def children(self) -> List[str]:
+        return list(self._children)
+
+    @property
+    def pseudo_children(self) -> List[str]:
+        return list(self._pseudo_children)
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def is_root(self) -> bool:
+        return self._parent is None
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self._children
+
+
+class ComputationPseudoTree(ComputationGraph):
+    def __init__(self, nodes: Iterable[PseudoTreeNode]):
+        super().__init__("PseudoTree", list(nodes))
+        self._by_name: Dict[str, PseudoTreeNode] = {
+            n.name: n for n in self.nodes
+        }
+
+    @property
+    def roots(self) -> List[PseudoTreeNode]:
+        return [n for n in self.nodes if n.is_root]
+
+    def node(self, name: str) -> PseudoTreeNode:
+        return self._by_name[name]
+
+    def depth_ordered(self) -> List[List[PseudoTreeNode]]:
+        """Nodes grouped by depth, root level first — the schedule for
+        DPOP's level-synchronous UTIL/VALUE sweeps."""
+        levels: Dict[int, List[PseudoTreeNode]] = {}
+        for n in self.nodes:
+            levels.setdefault(n.depth, []).append(n)
+        return [levels[d] for d in sorted(levels)]
+
+
+def _adjacency(variables: List[Variable],
+               constraints: List[Constraint]) -> Dict[str, List[str]]:
+    adj: Dict[str, set] = {v.name: set() for v in variables}
+    for c in constraints:
+        names = [v.name for v in c.dimensions if v.name in adj]
+        for i, n1 in enumerate(names):
+            for n2 in names[i + 1:]:
+                if n1 != n2:
+                    adj[n1].add(n2)
+                    adj[n2].add(n1)
+    # deterministic neighbor order: degree desc, then name
+    return {
+        n: sorted(neigh, key=lambda m: (-len(adj[m]), m))
+        for n, neigh in adj.items()
+    }
+
+
+def build_computation_graph(dcop: Optional[DCOP] = None,
+                            variables: Optional[Iterable[Variable]] = None,
+                            constraints: Optional[Iterable[Constraint]] = None
+                            ) -> ComputationPseudoTree:
+    """Build a DFS pseudo-tree (reference: pseudotree.py:472-539)."""
+    if dcop is not None:
+        variables = list(dcop.variables.values())
+        constraints = list(dcop.constraints.values())
+    else:
+        variables = list(variables or [])
+        constraints = list(constraints or [])
+
+    adj = _adjacency(variables, constraints)
+    var_by_name = {v.name: v for v in variables}
+
+    visited: Dict[str, int] = {}  # name -> depth
+    parent: Dict[str, Optional[str]] = {}
+    children: Dict[str, List[str]] = {n: [] for n in adj}
+    pseudo_parents: Dict[str, List[str]] = {n: [] for n in adj}
+    pseudo_children: Dict[str, List[str]] = {n: [] for n in adj}
+
+    unvisited = set(adj)
+    while unvisited:
+        # root of this tree: max degree (ties by name) — pseudotree.py:350
+        root = max(sorted(unvisited), key=lambda n: len(adj[n]))
+        # iterative DFS; on_stack tracks the current root-path for back-edge
+        # classification
+        stack: List[Tuple[str, Optional[str], int]] = [(root, None, 0)]
+        on_path: Dict[str, int] = {}
+        # we emulate recursion with an explicit enter/exit stack
+        work: List[Tuple[str, Optional[str], int, bool]] = [
+            (root, None, 0, False)
+        ]
+        while work:
+            node, par, depth, done = work.pop()
+            if done:
+                on_path.pop(node, None)
+                continue
+            if node in visited:
+                continue
+            visited[node] = depth
+            parent[node] = par
+            if par is not None:
+                children[par].append(node)
+            on_path[node] = depth
+            work.append((node, par, depth, True))
+            # push children in reverse so the first neighbor is explored
+            # first
+            for m in reversed(adj[node]):
+                if m not in visited:
+                    work.append((m, node, depth + 1, False))
+                elif m in on_path and m != par:
+                    # back-edge: m is an ancestor of node
+                    if m not in pseudo_parents[node]:
+                        pseudo_parents[node].append(m)
+                        pseudo_children[m].append(node)
+        unvisited -= set(visited) & unvisited
+
+    # lowest-node rule: a constraint is handled by the deepest variable of
+    # its scope (ties broken by name for determinism)
+    constraints_of: Dict[str, List[Constraint]] = {n: [] for n in adj}
+    for c in constraints:
+        names = [v.name for v in c.dimensions if v.name in visited]
+        if not names:
+            continue
+        lowest = max(names, key=lambda n: (visited[n], n))
+        constraints_of[lowest].append(c)
+
+    nodes = [
+        PseudoTreeNode(
+            var_by_name[n],
+            constraints_of[n],
+            parent=parent[n],
+            pseudo_parents=pseudo_parents[n],
+            children=children[n],
+            pseudo_children=pseudo_children[n],
+            depth=visited[n],
+        )
+        for n in adj
+    ]
+    return ComputationPseudoTree(nodes)
+
+
+def get_dfs_relations(node: PseudoTreeNode):
+    """Split a node's constraints by whether they involve ancestors
+    (reference: pseudotree.py:178-241)."""
+    ancestors = set(node.pseudo_parents)
+    if node.parent:
+        ancestors.add(node.parent)
+    with_ancestors, own = [], []
+    for c in node.constraints:
+        if any(v.name in ancestors for v in c.dimensions):
+            with_ancestors.append(c)
+        else:
+            own.append(c)
+    return with_ancestors, own

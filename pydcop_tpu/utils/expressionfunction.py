@@ -39,15 +39,21 @@ _SAFE_BUILTINS = {
 }
 
 
-def _free_variables(expression: str) -> frozenset:
-    """Names that appear as loads in ``expression`` and are not builtins."""
+def _free_variables(expression: str):
+    """Names that appear as loads in ``expression`` and are not builtins,
+    ordered by first appearance (scope order must be deterministic — it
+    defines constraint tensor axis order)."""
     tree = ast.parse(expression, mode="eval")
-    names = set()
+    names = []
     bound = set()
+    for node in sorted(
+        (n for n in ast.walk(tree) if isinstance(n, ast.Name)),
+        key=lambda n: (n.lineno, n.col_offset),
+    ):
+        if node.id not in names:
+            names.append(node.id)
     for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            names.add(node.id)
-        elif isinstance(node, ast.comprehension):
+        if isinstance(node, ast.comprehension):
             t = node.target
             if isinstance(t, ast.Name):
                 bound.add(t.id)
@@ -58,7 +64,9 @@ def _free_variables(expression: str) -> frozenset:
         elif isinstance(node, ast.Lambda):
             for a in node.args.args:
                 bound.add(a.arg)
-    return frozenset(n for n in names - bound if n not in _SAFE_BUILTINS)
+    return tuple(
+        n for n in names if n not in bound and n not in _SAFE_BUILTINS
+    )
 
 
 class ExpressionFunction(SimpleRepr):
@@ -90,13 +98,18 @@ class ExpressionFunction(SimpleRepr):
             fn_src = f"def __expr_fn__({', '.join(self._detect_args(expression))}):\n{body}"
             exec(compile(fn_src, "<expression>", "exec"), self._globals)
             self._fn = self._globals["__expr_fn__"]
-            self._vars = frozenset(self._detect_args(expression)) - set(fixed_vars)
+            self._vars = tuple(
+                n for n in self._detect_args(expression)
+                if n not in fixed_vars
+            )
             self._code = None
         else:
             self._code = compile(expression, "<expression>", "eval")
             all_vars = _free_variables(expression)
-            extra = {n for n in all_vars if n in self._globals}
-            self._vars = frozenset(all_vars - set(fixed_vars) - extra)
+            self._vars = tuple(
+                n for n in all_vars
+                if n not in fixed_vars and n not in self._globals
+            )
             self._fn = None
 
     @staticmethod
@@ -136,7 +149,7 @@ class ExpressionFunction(SimpleRepr):
             )
         env = dict(self._fixed_vars)
         env.update(kwargs)
-        missing = self._vars - set(env)
+        missing = set(self._vars) - set(env)
         if missing:
             raise TypeError(f"Missing variables {sorted(missing)} for {self}")
         if self._fn is not None:

@@ -1,0 +1,216 @@
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs import (
+    constraints_hypergraph,
+    factor_graph,
+    load_graph_module,
+    ordered_graph,
+    pseudotree,
+)
+from pydcop_tpu.graphs.arrays import BIG, FactorGraphArrays, HypergraphArrays
+
+YAML3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+@pytest.fixture
+def dcop3():
+    return load_dcop(YAML3)
+
+
+def test_factor_graph_build(dcop3):
+    g = factor_graph.build_computation_graph(dcop3)
+    assert len(g.var_nodes) == 3
+    assert len(g.factor_nodes) == 2
+    v2 = g.computation("v2")
+    assert set(v2.neighbors) == {"diff_1_2", "diff_2_3"}
+    f = g.computation("diff_1_2")
+    assert set(f.neighbors) == {"v1", "v2"}
+
+
+def test_hypergraph_build(dcop3):
+    g = constraints_hypergraph.build_computation_graph(dcop3)
+    assert len(g.nodes) == 3
+    v2 = g.computation("v2")
+    assert set(v2.neighbors) == {"v1", "v3"}
+    v1 = g.computation("v1")
+    assert set(v1.neighbors) == {"v2"}
+
+
+def test_graph_density(dcop3):
+    g = constraints_hypergraph.build_computation_graph(dcop3)
+    assert g.density() == pytest.approx(2 * 2 / (3 * 2))
+
+
+def test_load_graph_module():
+    m = load_graph_module("factor_graph")
+    assert hasattr(m, "build_computation_graph")
+    with pytest.raises(ImportError):
+        load_graph_module("nope")
+
+
+def test_pseudotree_build(dcop3):
+    g = pseudotree.build_computation_graph(dcop3)
+    # v2 has max degree -> root
+    roots = g.roots
+    assert len(roots) == 1
+    assert roots[0].name == "v2"
+    n1, n3 = g.node("v1"), g.node("v3")
+    assert n1.parent == "v2"
+    assert n3.parent == "v2"
+    assert n1.depth == 1
+    # constraints handled by the lowest node of their scope
+    all_constraints = [c.name for n in g.nodes for c in n.constraints]
+    assert sorted(all_constraints) == ["diff_1_2", "diff_2_3"]
+    assert not g.node("v2").constraints
+
+
+def test_pseudotree_back_edges():
+    d = Domain("d", "", [0, 1])
+    vs = {n: Variable(n, d) for n in ("a", "b", "c")}
+    constraints = [
+        constraint_from_str("c_ab", "a + b", vs.values()),
+        constraint_from_str("c_bc", "b + c", vs.values()),
+        constraint_from_str("c_ac", "a + c", vs.values()),
+    ]
+    g = pseudotree.build_computation_graph(
+        variables=list(vs.values()), constraints=constraints)
+    # triangle: one root, a chain, and one pseudo-parent back edge
+    assert len(g.roots) == 1
+    pseudo_links = [
+        (n.name, pp) for n in g.nodes for pp in n.pseudo_parents
+    ]
+    assert len(pseudo_links) == 1
+    # depth levels for the chain
+    levels = g.depth_ordered()
+    assert len(levels) == 3
+
+
+def test_pseudotree_forest():
+    d = Domain("d", "", [0, 1])
+    vs = {n: Variable(n, d) for n in ("a", "b", "c", "x", "y")}
+    constraints = [
+        constraint_from_str("c_ab", "a + b", vs.values()),
+        constraint_from_str("c_bc", "b + c", vs.values()),
+        constraint_from_str("c_xy", "x + y", vs.values()),
+    ]
+    g = pseudotree.build_computation_graph(
+        variables=list(vs.values()), constraints=constraints)
+    assert len(g.roots) == 2
+
+
+def test_ordered_graph(dcop3):
+    g = ordered_graph.build_computation_graph(dcop3)
+    names = [n.name for n in g.ordered_nodes]
+    assert names == ["v1", "v2", "v3"]
+    assert g.ordered_nodes[0].links[0].type == "next"
+    # constraint handled at its last variable in the order
+    assert [c.name for c in g.node_constraints("v2")] if hasattr(g, "node_constraints") else True
+    c_names = {n.name: [c.name for c in n.constraints] for n in g.ordered_nodes}
+    assert c_names == {"v1": [], "v2": ["diff_1_2"], "v3": ["diff_2_3"]}
+
+
+def test_factor_graph_arrays(dcop3):
+    fga = FactorGraphArrays.build(dcop3)
+    assert fga.n_vars == 3
+    assert fga.n_factors == 2
+    assert fga.n_edges == 4
+    assert fga.max_domain == 2
+    assert fga.sign == 1.0
+    # unary costs
+    i1 = fga.var_names.index("v1")
+    assert fga.var_costs[i1, 0] == pytest.approx(-0.1)
+    assert fga.var_costs[i1, 1] == pytest.approx(0.1)
+    # one binary bucket
+    assert len(fga.buckets) == 1
+    b = fga.buckets[0]
+    assert b.arity == 2
+    assert b.cubes.shape == (2, 2, 2)
+    # diff constraint table
+    c = b.cubes[0]
+    assert c[0, 0] == 1 and c[0, 1] == 0
+    # edges: edge_var/edge_factor consistency
+    for flocal, f in enumerate(b.factor_ids):
+        for p in range(2):
+            e = b.edge_ids[flocal, p]
+            assert fga.edge_factor[e] == f
+            assert fga.edge_var[e] == b.var_ids[flocal, p]
+
+
+def test_hypergraph_arrays(dcop3):
+    hga = HypergraphArrays.build(dcop3)
+    assert hga.n_vars == 3
+    assert hga.n_constraints == 2
+    assert len(hga.buckets) == 1
+    b = hga.buckets[0]
+    assert b.cubes.shape == (2, 2, 2)
+    # neighbor pairs: v1<->v2, v2<->v3 both directions
+    pairs = set(zip(hga.nbr_src.tolist(), hga.nbr_dst.tolist()))
+    i = {n: k for k, n in enumerate(hga.var_names)}
+    assert (i["v1"], i["v2"]) in pairs
+    assert (i["v2"], i["v1"]) in pairs
+    assert (i["v3"], i["v2"]) in pairs
+    assert len(pairs) == 4
+    assert hga.max_degree == 2
+
+
+def test_arrays_padding_mixed_domains():
+    yaml_str = """
+name: t
+objective: min
+domains:
+  small: {values: [0, 1]}
+  large: {values: [0, 1, 2, 3]}
+variables:
+  a: {domain: small}
+  b: {domain: large}
+constraints:
+  c1: {type: intention, function: a + b}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    fga = FactorGraphArrays.build(dcop)
+    assert fga.max_domain == 4
+    ia = fga.var_names.index("a")
+    assert fga.domain_mask[ia].tolist() == [True, True, False, False]
+    assert fga.var_costs[ia, 2] == BIG
+    cube = fga.buckets[0].cubes[0]
+    assert cube.shape == (4, 4)
+    assert cube[2, 0] == BIG  # padded slot of a
+    assert cube[1, 3] == 4  # valid: a=1, b=3
+
+
+def test_arrays_max_objective_negates():
+    yaml_str = """
+name: t
+objective: max
+domains:
+  d: {values: [0, 1]}
+variables:
+  a: {domain: d}
+  b: {domain: d}
+constraints:
+  c1: {type: intention, function: a * b}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    fga = FactorGraphArrays.build(dcop)
+    assert fga.sign == -1.0
+    cube = fga.buckets[0].cubes[0]
+    assert cube[1, 1] == -1.0
