@@ -1,0 +1,202 @@
+"""Algorithm plugin layer.
+
+reference parity: pydcop/algorithms/__init__.py:99-614.  Each algorithm is
+a module in this package declaring:
+
+* ``GRAPH_TYPE`` — which computation graph it runs on,
+* ``algo_params: List[AlgoParameterDef]`` — declarative parameters with
+  types / allowed values / defaults, validated by ``prepare_algo_params``,
+* ``build_solver(dcop, params, variables=None, constraints=None)`` — the
+  TPU path: returns an engine solver whose ``step`` is one jitted cycle of
+  the algorithm over the whole graph,
+* ``computation_memory(node)`` / ``communication_load(node, target)`` —
+  analytic footprint/load callbacks used by the distribution layer.
+
+``load_algorithm_module`` injects defaults for the optional pieces, as the
+reference does (algorithms/__init__.py:527-566).
+"""
+
+import pkgutil
+from importlib import import_module
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional
+
+from ..utils.simple_repr import SimpleRepr, from_repr, simple_repr
+
+
+class AlgoParameterDef(NamedTuple):
+    name: str
+    type: str  # 'str' | 'int' | 'float' | 'bool'
+    values: Optional[List[Any]] = None
+    default: Any = None
+
+
+class AlgoParameterException(Exception):
+    pass
+
+
+_CASTS = {
+    "str": str,
+    "int": int,
+    "float": float,
+    "bool": lambda v: v if isinstance(v, bool) else str(v).lower() in (
+        "1", "true", "yes"),
+}
+
+
+def check_param_value(value: Any, param_def: AlgoParameterDef) -> Any:
+    """Cast and validate one parameter value
+    (reference: algorithms/__init__.py:446-505)."""
+    if value is None:
+        return param_def.default
+    try:
+        cast = _CASTS[param_def.type](value)
+    except (KeyError, ValueError, TypeError):
+        raise AlgoParameterException(
+            f"Invalid value {value!r} for parameter {param_def.name} "
+            f"of type {param_def.type}"
+        )
+    if param_def.values and cast not in param_def.values:
+        raise AlgoParameterException(
+            f"Value {cast!r} not allowed for parameter {param_def.name}: "
+            f"must be one of {param_def.values}"
+        )
+    return cast
+
+
+def prepare_algo_params(params: Dict[str, Any],
+                        parameters_definitions: List[AlgoParameterDef]
+                        ) -> Dict[str, Any]:
+    """Validate given params and fill in defaults
+    (reference: algorithms/__init__.py:99-137)."""
+    defs = {p.name: p for p in parameters_definitions}
+    unknown = set(params) - set(defs)
+    if unknown:
+        raise AlgoParameterException(
+            f"Unknown parameter(s) {sorted(unknown)}; "
+            f"allowed: {sorted(defs)}"
+        )
+    out = {}
+    for name, p_def in defs.items():
+        out[name] = check_param_value(params.get(name), p_def)
+    return out
+
+
+class AlgorithmDef(SimpleRepr):
+    """An algorithm selection + parameter values + optimization mode
+    (reference: algorithms/__init__.py:141-335)."""
+
+    def __init__(self, algo: str, params: Dict[str, Any],
+                 mode: str = "min"):
+        self._algo = algo
+        self._params = dict(params)
+        self._mode = mode
+
+    @classmethod
+    def build_with_default_param(
+            cls, algo: str, params: Optional[Dict[str, Any]] = None,
+            mode: str = "min",
+            parameters_definitions: Optional[List[AlgoParameterDef]] = None
+    ) -> "AlgorithmDef":
+        if parameters_definitions is None:
+            parameters_definitions = load_algorithm_module(algo).algo_params
+        return cls(
+            algo,
+            prepare_algo_params(params or {}, parameters_definitions),
+            mode,
+        )
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    def param_names(self) -> Iterable[str]:
+        return self._params.keys()
+
+    def param_value(self, name: str) -> Any:
+        return self._params[name]
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, AlgorithmDef)
+            and self._algo == o._algo
+            and self._params == o._params
+            and self._mode == o._mode
+        )
+
+    def __repr__(self):
+        return f"AlgorithmDef({self._algo!r}, {self._params}, {self._mode!r})"
+
+
+class ComputationDef(SimpleRepr):
+    """A computation node + the algorithm it runs
+    (reference: algorithms/__init__.py:336-445)."""
+
+    def __init__(self, node, algo: AlgorithmDef):
+        self._node = node
+        self._algo = algo
+
+    @property
+    def node(self):
+        return self._node
+
+    @property
+    def algo(self) -> AlgorithmDef:
+        return self._algo
+
+    @property
+    def name(self) -> str:
+        return self._node.name
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, ComputationDef)
+            and self._node == o._node
+            and self._algo == o._algo
+        )
+
+    def __repr__(self):
+        return f"ComputationDef({self.name}, {self._algo.algo})"
+
+
+def list_available_algorithms() -> List[str]:
+    """Discover algorithm modules in this package
+    (reference: algorithms/__init__.py:508-526)."""
+    exclude = set()
+    out = []
+    for _, name, ispkg in pkgutil.iter_modules(__path__):
+        if not ispkg and name not in exclude:
+            out.append(name)
+    return sorted(out)
+
+
+def _default_computation_memory(node, *args, **kwargs) -> float:
+    return 0.0
+
+
+def _default_communication_load(node, target, *args, **kwargs) -> float:
+    return 0.0
+
+
+def load_algorithm_module(algo_name: str):
+    """Import an algorithm module and inject defaults for optional pieces
+    (reference: algorithms/__init__.py:527-566)."""
+    module = import_module(f"pydcop_tpu.algorithms.{algo_name}")
+    if not hasattr(module, "algo_params"):
+        module.algo_params = []
+    if not hasattr(module, "computation_memory"):
+        module.computation_memory = _default_computation_memory
+    if not hasattr(module, "communication_load"):
+        module.communication_load = _default_communication_load
+    if not hasattr(module, "GRAPH_TYPE"):
+        raise AttributeError(
+            f"Algorithm module {algo_name} must declare GRAPH_TYPE"
+        )
+    return module

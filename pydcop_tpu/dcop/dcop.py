@@ -162,9 +162,14 @@ class DCOP:
 
 
 def filter_dcop(dcop: DCOP) -> DCOP:
-    """Fold unary constraints into variable costs
-    (reference: dcop.py:370-422): every unary constraint is removed and its
-    cost becomes (part of) the variable's cost function."""
+    """Fold unary constraints over *decision* variables into variable
+    costs (every such constraint is removed and its cost becomes part of
+    the variable's cost function).  Unary constraints over external
+    variables are kept as-is — their variable has no cost to fold into.
+
+    This normalization lets the factor-graph compiler put all unary costs
+    in the dense ``var_costs`` array instead of arity-1 factor buckets.
+    """
     from .objects import VariableWithCostDict
 
     filtered = DCOP(
@@ -174,7 +179,7 @@ def filter_dcop(dcop: DCOP) -> DCOP:
     filtered.dist_hints = dcop.dist_hints
     unary: Dict[str, List[Constraint]] = {}
     for c in dcop.constraints.values():
-        if c.arity == 1:
+        if c.arity == 1 and c.dimensions[0].name in dcop.variables:
             unary.setdefault(c.dimensions[0].name, []).append(c)
         else:
             filtered.add_constraint(c)

@@ -351,55 +351,59 @@ class ExternalVariable(Variable):
         return ExternalVariable(self._name, self._domain, self._value)
 
 
+def _mass_create(name_prefix: str, indexes, separator: str, factory) -> Dict:
+    """Shared naming logic for mass-creation helpers, matching the
+    reference exactly (objects.py:258-334): a *tuple* of iterables yields
+    the cartesian product keyed by value tuples; a range yields
+    zero-padded names; any other iterable appends ``str(i)`` directly."""
+    import itertools
+
+    out = {}
+    if isinstance(indexes, tuple):
+        for combi in itertools.product(*indexes):
+            name = name_prefix + separator.join(str(c) for c in combi)
+            out[tuple(combi)] = factory(name)
+    elif isinstance(indexes, range):
+        digit_count = len(str(indexes.stop - 1))
+        for i in indexes:
+            name = f"{name_prefix}{i:0{digit_count}d}"
+            out[name] = factory(name)
+    elif hasattr(indexes, "__iter__"):
+        for i in indexes:
+            name = name_prefix + str(i)
+            out[name] = factory(name)
+    else:
+        raise TypeError(
+            "indexes must be an iterable or a tuple of iterables"
+        )
+    return out
+
+
 def create_variables(name_prefix: str, indexes, domain: Domain,
                      separator: str = "_") -> Dict:
     """Mass-create variables over one or several index collections.
 
     reference parity: pydcop/dcop/objects.py:258-334.
 
-    >>> vs = create_variables('v', ['a', 'b'], Domain('d', 'd', [0, 1]))
+    >>> vs = create_variables('x_', ['a1', 'a2'], Domain('d', 'd', [0, 1]))
     >>> sorted(vs)
-    ['v_a', 'v_b']
+    ['x_a1', 'x_a2']
+    >>> vs = create_variables('v', range(10), Domain('d', 'd', [0, 1]))
+    >>> vs['v2'].name
+    'v2'
+    >>> vs = create_variables('m_', (['x1', 'x2'], ['a1', 'a2']),
+    ...                       Domain('d', 'd', [0, 1]))
+    >>> vs[('x2', 'a1')].name
+    'm_x2_a1'
     """
-    variables = {}
-    if isinstance(indexes, range):
-        indexes = list(indexes)
-    if isinstance(indexes, list) and indexes and isinstance(indexes[0], (list, tuple, range)):
-        import itertools
-
-        for combi in itertools.product(*indexes):
-            key = tuple(str(i) for i in combi)
-            name = name_prefix + separator.join(key)
-            variables[key] = Variable(name, domain)
-    elif isinstance(indexes, list):
-        for i in indexes:
-            name = f"{name_prefix}{separator}{i}" if separator else f"{name_prefix}{i}"
-            variables[name] = Variable(name, domain)
-    else:
-        raise TypeError(f"Invalid indexes for create_variables: {indexes!r}")
-    return variables
+    return _mass_create(name_prefix, indexes, separator,
+                        lambda name: Variable(name, domain))
 
 
 def create_binary_variables(name_prefix: str, indexes,
                             separator: str = "_") -> Dict:
     """Mass-create binary variables (reference: objects.py:349-409)."""
-    variables = {}
-    if isinstance(indexes, range):
-        indexes = list(indexes)
-    if isinstance(indexes, list) and indexes and isinstance(indexes[0], (list, tuple, range)):
-        import itertools
-
-        for combi in itertools.product(*indexes):
-            key = tuple(combi)
-            name = name_prefix + separator.join(str(i) for i in combi)
-            variables[key] = BinaryVariable(name)
-    elif isinstance(indexes, list):
-        for i in indexes:
-            name = f"{name_prefix}{separator}{i}" if separator else f"{name_prefix}{i}"
-            variables[name] = BinaryVariable(name)
-    else:
-        raise TypeError(f"Invalid indexes for create_binary_variables: {indexes!r}")
-    return variables
+    return _mass_create(name_prefix, indexes, separator, BinaryVariable)
 
 
 DEFAULT_CAPACITY = 100
@@ -503,24 +507,28 @@ class AgentDef(SimpleRepr):
 
 
 def create_agents(name_prefix: str, indexes,
-                  default_hosting_cost: float = 0,
-                  hosting_costs: Optional[Dict] = None,
                   default_route: float = 1,
                   routes: Optional[Dict] = None,
-                  separator: str = "",
+                  default_hosting_costs: float = 0,
+                  hosting_costs: Optional[Dict] = None,
+                  separator: str = "_",
                   **kwargs) -> Dict[Union[str, Tuple[str, ...]], AgentDef]:
-    """Mass-create agents (reference: objects.py:879-975)."""
-    agents = {}
-    if isinstance(indexes, range):
-        indexes = list(indexes)
-    for i in indexes:
-        name = f"{name_prefix}{separator}{i}"
-        agents[name] = AgentDef(
+    """Mass-create agents (reference: objects.py:879-975 — same signature,
+    including the plural ``default_hosting_costs`` and zero-padded names
+    for ranges).
+
+    >>> agts = create_agents('a', range(20))
+    >>> agts['a08'].name
+    'a08'
+    """
+    return _mass_create(
+        name_prefix, indexes, separator,
+        lambda name: AgentDef(
             name,
-            default_hosting_cost=default_hosting_cost,
+            default_hosting_cost=default_hosting_costs,
             hosting_costs=hosting_costs or {},
             default_route=default_route,
             routes=routes or {},
             **kwargs,
-        )
-    return agents
+        ),
+    )

@@ -499,8 +499,15 @@ class ConditionalRelation(Constraint):
         if cond.arity == 0:
             if cond():
                 return rel
-            return ZeroAryRelation(self._name, self._return_if_false) \
-                if rel.arity == 0 else NeutralRelation(rel.dimensions, self._name)
+            if rel.arity == 0:
+                return ZeroAryRelation(self._name, self._return_if_false)
+            # constant relation over the remaining scope
+            shape = tuple(len(v.domain) for v in rel.dimensions)
+            return NAryMatrixRelation(
+                rel.dimensions,
+                np.full(shape, self._return_if_false, dtype=DEFAULT_TYPE),
+                self._name,
+            )
         return ConditionalRelation(cond, rel, self._name,
                                    self._return_if_false)
 

@@ -1,0 +1,4 @@
+from .solver import ArraySolver, RunResult
+from .sync_engine import SyncEngine
+
+__all__ = ["ArraySolver", "RunResult", "SyncEngine"]

@@ -1,0 +1,54 @@
+"""Solver protocol: what an algorithm module hands to the engine.
+
+A solver owns the compiled graph arrays and exposes pure functions over an
+explicit state pytree.  One ``step`` = one synchronous round of the
+algorithm over the *entire* computation graph — the reference's
+``SynchronousComputationMixin`` cycle barrier
+(pydcop/infrastructure/computations.py:633-829) is free here: a jitted step
+IS the barrier.
+
+Required state keys (any extra entries are algorithm-private):
+
+* ``cycle``    — int32 scalar, incremented once per step,
+* ``finished`` — bool scalar, set when the algorithm has converged/ended,
+* ``key``      — jax PRNG key (for stochastic algorithms).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class ArraySolver:
+    """Base class for compiled-graph solvers."""
+
+    #: variable names, in index order (set by subclasses)
+    var_names: List[str] = []
+
+    def init_state(self, key) -> Dict[str, Any]:
+        raise NotImplementedError()
+
+    def step(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """One synchronous cycle — must be pure and jit-traceable."""
+        raise NotImplementedError()
+
+    def assignment_indices(self, state) -> Any:
+        """(V,) int array of selected domain indices."""
+        raise NotImplementedError()
+
+    def cost(self, state) -> Any:
+        """Scalar internal cost of the current assignment (sign-compiled:
+        always lower-is-better)."""
+        raise NotImplementedError()
+
+
+@dataclass
+class RunResult:
+    assignment: Dict[str, Any]
+    cycles: int
+    finished: bool
+    cost: float
+    violations: int
+    duration: float
+    status: str = "FINISHED"          # FINISHED | TIMEOUT | MAX_CYCLES
+    cost_trace: List[Tuple[int, float]] = field(default_factory=list)
+    metrics: Dict[str, Any] = field(default_factory=dict)

@@ -1,0 +1,97 @@
+"""The synchronous engine: drives a solver's jitted step to convergence.
+
+This replaces the reference's entire thread/queue/HTTP runtime for the
+data plane (SURVEY.md §3.3): instead of agents exchanging messages one at a
+time through per-agent priority queues, the engine runs chunks of algorithm
+cycles inside a single ``lax.while_loop`` on device, syncing back to the
+host only between chunks (for convergence checks, timeout and metric
+collection).
+"""
+
+import time
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .solver import ArraySolver, RunResult
+
+
+class SyncEngine:
+    def __init__(self, solver: ArraySolver, chunk_size: int = 32):
+        self._solver = solver
+        self._chunk = chunk_size
+
+        def run_chunk(state, limit):
+            def cond(s):
+                return jnp.logical_and(
+                    jnp.logical_not(s["finished"]), s["cycle"] < limit
+                )
+
+            return jax.lax.while_loop(cond, solver.step, state)
+
+        self._run_chunk = jax.jit(run_chunk)
+        self._cost = jax.jit(solver.cost)
+        self._idx = jax.jit(solver.assignment_indices)
+
+    @property
+    def solver(self) -> ArraySolver:
+        return self._solver
+
+    def run(self, key: int = 0, max_cycles: int = 1000,
+            timeout: Optional[float] = None,
+            collect_cost_every: Optional[int] = None,
+            variables=None) -> RunResult:
+        """Run until convergence, cycle cap, or wall-clock timeout."""
+        if isinstance(key, int):
+            key = jax.random.PRNGKey(key)
+        state = self._solver.init_state(key)
+        t0 = time.perf_counter()
+        status = "MAX_CYCLES"
+        trace = []
+        chunk = (collect_cost_every if collect_cost_every
+                 else self._chunk)
+        while True:
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                break
+            if cycle >= max_cycles:
+                status = "MAX_CYCLES"
+                break
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+            limit = min(cycle + chunk, max_cycles)
+            state = self._run_chunk(state, jnp.int32(limit))
+            if collect_cost_every:
+                trace.append(
+                    (int(state["cycle"]), float(self._cost(state)))
+                )
+        duration = time.perf_counter() - t0
+
+        idx = jax.device_get(self._idx(state))
+        cost = float(self._cost(state))
+        assignment = self._named_assignment(idx, variables)
+        return RunResult(
+            assignment=assignment,
+            cycles=int(state["cycle"]),
+            finished=bool(state["finished"]),
+            cost=cost,
+            violations=0,
+            duration=duration,
+            status=status,
+            cost_trace=trace,
+        )
+
+    def _named_assignment(self, idx, variables):
+        if variables is not None:
+            by_name = {v.name: v for v in variables}
+            return {
+                name: by_name[name].domain.values[int(i)]
+                for name, i in zip(self._solver.var_names, idx)
+            }
+        return {
+            name: int(i) for name, i in zip(self._solver.var_names, idx)
+        }

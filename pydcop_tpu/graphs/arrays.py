@@ -47,6 +47,24 @@ def _padded_cube(constraint: Constraint, max_domain: int,
     return np.pad(cube, pads, constant_values=BIG)
 
 
+def _bind_externals(dcop: Optional[DCOP], constraints: list) -> list:
+    """External (sensor) variables are not decision variables: fix them at
+    their current value by slicing the constraints at compile time.  The
+    host re-compiles when an external value changes (the dynamic-DCOP
+    path), keeping the on-device problem purely over decision variables."""
+    ext = dcop.external_variables if dcop is not None else {}
+    if not ext:
+        return constraints
+    out = []
+    for c in constraints:
+        fixed = {
+            v.name: ext[v.name].value
+            for v in c.dimensions if v.name in ext
+        }
+        out.append(c.slice(fixed) if fixed else c)
+    return out
+
+
 @dataclass
 class FactorBucket:
     """All factors of one arity, stacked."""
@@ -83,6 +101,7 @@ class FactorGraphArrays:
             variables = list(dcop.variables.values())
         if constraints is None:
             constraints = list(dcop.constraints.values())
+        constraints = _bind_externals(dcop, constraints)
         sign = 1.0 if dcop.objective == "min" else -1.0
 
         var_names = [v.name for v in variables]
@@ -186,6 +205,7 @@ class HypergraphArrays:
             variables = list(dcop.variables.values())
         if constraints is None:
             constraints = list(dcop.constraints.values())
+        constraints = _bind_externals(dcop, constraints)
         sign = 1.0 if dcop.objective == "min" else -1.0
 
         var_names = [v.name for v in variables]

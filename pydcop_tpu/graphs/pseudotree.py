@@ -165,9 +165,8 @@ def build_computation_graph(dcop: Optional[DCOP] = None,
     while unvisited:
         # root of this tree: max degree (ties by name) — pseudotree.py:350
         root = max(sorted(unvisited), key=lambda n: len(adj[n]))
-        # iterative DFS; on_stack tracks the current root-path for back-edge
+        # iterative DFS; on_path tracks the current root-path for back-edge
         # classification
-        stack: List[Tuple[str, Optional[str], int]] = [(root, None, 0)]
         on_path: Dict[str, int] = {}
         # we emulate recursion with an explicit enter/exit stack
         work: List[Tuple[str, Optional[str], int, bool]] = [

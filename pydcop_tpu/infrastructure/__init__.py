@@ -1,0 +1,3 @@
+from .run import solve, solve_result
+
+__all__ = ["solve", "solve_result"]

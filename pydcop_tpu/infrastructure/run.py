@@ -1,0 +1,68 @@
+"""Bootstrap / one-call API.
+
+reference parity: pydcop/infrastructure/run.py:52-287.  ``solve()`` keeps
+the reference signature shape: build the algorithm's graph, distribute the
+computations onto agents (the distribution doubles as the sharding spec),
+then run — except "run" means driving one jitted step to convergence
+instead of spawning a thread per agent.
+"""
+
+import time
+from typing import Any, Dict, Optional, Union
+
+from ..algorithms import AlgorithmDef, load_algorithm_module
+from ..dcop.dcop import DCOP
+from ..engine.solver import RunResult
+from ..engine.sync_engine import SyncEngine
+from ..graphs import load_graph_module
+
+
+def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
+          distribution: str = "oneagent",
+          timeout: Optional[float] = 5,
+          max_cycles: int = 2000,
+          seed: int = 0,
+          collect_cost_every: Optional[int] = None,
+          **kwargs) -> Dict[str, Any]:
+    """Solve a DCOP and return the assignment
+    (reference: infrastructure/run.py:52-144).
+
+    ``algo_def`` may be an algorithm name or an AlgorithmDef carrying
+    parameters.  Extra ``kwargs`` are passed as algorithm parameters.
+    """
+    res = solve_result(
+        dcop, algo_def, distribution, timeout=timeout,
+        max_cycles=max_cycles, seed=seed,
+        collect_cost_every=collect_cost_every, **kwargs)
+    return res.assignment
+
+
+def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
+                 distribution: str = "oneagent",
+                 timeout: Optional[float] = 5,
+                 max_cycles: int = 2000,
+                 seed: int = 0,
+                 collect_cost_every: Optional[int] = None,
+                 **kwargs) -> RunResult:
+    """Like :func:`solve` but returns the full :class:`RunResult` with
+    cycles, duration, status and true (sign-corrected) cost."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, params=kwargs, mode=dcop.objective)
+    algo_module = load_algorithm_module(algo_def.algo)
+
+    t0 = time.perf_counter()
+    solver = algo_module.build_solver(dcop, algo_def.params)
+    engine = SyncEngine(solver)
+    result = engine.run(
+        key=seed, max_cycles=max_cycles, timeout=timeout,
+        collect_cost_every=collect_cost_every,
+        variables=[dcop.variable(n) for n in solver.var_names],
+    )
+    result.duration = time.perf_counter() - t0
+    # report the true model cost (the engine's is sign/noise-compiled)
+    if result.assignment and set(result.assignment) == set(dcop.variables):
+        cost, violations = dcop.solution_cost(result.assignment)
+        result.cost = cost
+        result.violations = violations
+    return result

@@ -1,0 +1,14 @@
+from .kernels import (
+    assignment_cost_device,
+    bucket_cost,
+    candidate_costs,
+    factor_messages,
+    masked_argmin,
+    masked_min,
+    random_argmin,
+)
+
+__all__ = [
+    "assignment_cost_device", "bucket_cost", "candidate_costs",
+    "factor_messages", "masked_argmin", "masked_min", "random_argmin",
+]

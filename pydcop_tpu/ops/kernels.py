@@ -1,0 +1,124 @@
+"""Shared device kernels: the vectorized primitives every algorithm
+composes.
+
+These replace the reference's per-message Python hot loops (SURVEY.md §3.3):
+
+* ``factor_messages``        ↔ maxsum.factor_costs_for_var (maxsum.py:382):
+  brute-force loop over the factor's assignment space, per neighbor →
+  one broadcast-add + axis-min over the stacked cost hypercubes.
+* ``candidate_costs``        ↔ relations.find_optimal/assignment_cost loops
+  (relations.py:1479,1594) → gather + segment-sum producing the full
+  ``(n_vars, max_domain)`` best-response cost matrix in one shot.
+* ``buckets_cost``           ↔ dcop.solution_cost (dcop.py:308) on device.
+
+All shapes are static per arity bucket; everything here is jit-traceable.
+"""
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.arrays import BIG
+
+
+def _broadcast_q(q_p: jnp.ndarray, position: int, arity: int) -> jnp.ndarray:
+    """Reshape a per-position message batch (F, D) so it broadcasts along
+    axis ``position + 1`` of the (F, D, ..., D) cost cube."""
+    shape = [q_p.shape[0]] + [1] * arity
+    shape[position + 1] = q_p.shape[1]
+    return q_p.reshape(shape)
+
+
+def factor_messages(cubes: jnp.ndarray,
+                    q: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Min-marginal messages from every factor of one arity bucket to each
+    of its variables.
+
+    cubes: (F, D, ..., D) stacked cost hypercubes (arity axes).
+    q: per-position incoming messages, each (F, D).
+    Returns per-position outgoing messages, each (F, D):
+      r_p[d] = min over other vars' values of (cube + sum_{p'!=p} q_{p'}).
+    """
+    arity = cubes.ndim - 1
+    total = cubes
+    q_b = [_broadcast_q(q[p], p, arity) for p in range(arity)]
+    for p in range(arity):
+        total = total + q_b[p]
+    out = []
+    for p in range(arity):
+        t = total - q_b[p]
+        reduce_axes = tuple(i + 1 for i in range(arity) if i != p)
+        out.append(jnp.min(t, axis=reduce_axes) if reduce_axes else t)
+    return out
+
+
+def candidate_costs(cubes: jnp.ndarray, var_ids: jnp.ndarray,
+                    x: jnp.ndarray, n_vars: int) -> jnp.ndarray:
+    """Contribution of one constraint bucket to every variable's
+    per-candidate-value cost, holding all *other* variables at ``x``.
+
+    cubes: (C, D, ..., D); var_ids: (C, arity); x: (V,) value indices.
+    Returns (V, D): sum over constraints of the cost slice obtained by
+    fixing every scope variable except the target at its current value.
+    """
+    arity = cubes.ndim - 1
+    C = cubes.shape[0]
+    D = cubes.shape[-1]
+    vals = x[var_ids]  # (C, arity)
+    total = jnp.zeros((n_vars, D), dtype=cubes.dtype)
+    for p in range(arity):
+        t = jnp.moveaxis(cubes, p + 1, arity)  # target axis last
+        t = t.reshape(C, -1, D)
+        idx = jnp.zeros((C,), dtype=jnp.int32)
+        for q in range(arity):
+            if q != p:
+                idx = idx * D + vals[:, q]
+        contrib = t[jnp.arange(C), idx, :]  # (C, D)
+        total = total + jax.ops.segment_sum(
+            contrib, var_ids[:, p], num_segments=n_vars)
+    return total
+
+
+def bucket_cost(cubes: jnp.ndarray, var_ids: jnp.ndarray,
+                x: jnp.ndarray) -> jnp.ndarray:
+    """Per-constraint cost of assignment ``x`` for one bucket: (C,)."""
+    C = cubes.shape[0]
+    D = cubes.shape[-1]
+    arity = cubes.ndim - 1
+    vals = x[var_ids]  # (C, arity)
+    idx = jnp.zeros((C,), dtype=jnp.int32)
+    for p in range(arity):
+        idx = idx * D + vals[:, p]
+    return cubes.reshape(C, -1)[jnp.arange(C), idx]
+
+
+def assignment_cost_device(buckets: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+                           var_costs: jnp.ndarray,
+                           x: jnp.ndarray) -> jnp.ndarray:
+    """Total cost of assignment ``x``: constraint costs + unary costs."""
+    V = var_costs.shape[0]
+    total = jnp.sum(var_costs[jnp.arange(V), x])
+    for cubes, var_ids in buckets:
+        total = total + jnp.sum(bucket_cost(cubes, var_ids, x))
+    return total
+
+
+def masked_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Argmin over valid domain slots, rows = variables."""
+    return jnp.argmin(jnp.where(mask, costs, BIG * 2), axis=-1)
+
+
+def masked_min(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.min(jnp.where(mask, costs, BIG * 2), axis=-1)
+
+
+def random_argmin(key: jax.Array, costs: jnp.ndarray,
+                  mask: jnp.ndarray) -> jnp.ndarray:
+    """Argmin with uniform random tie-breaking among equal minima —
+    replaces the reference's ``random.choice(best_values)`` idiom."""
+    c = jnp.where(mask, costs, BIG * 2)
+    m = jnp.min(c, axis=-1, keepdims=True)
+    is_min = (c <= m) & mask
+    noise = jax.random.uniform(key, c.shape)
+    return jnp.argmax(is_min * (1.0 + noise), axis=-1)

@@ -93,15 +93,19 @@ class ExpressionFunction(SimpleRepr):
                 src = f.read()
             exec(compile(src, source_file, "exec"), self._globals)
         if "\n" in expression.strip() or expression.strip().startswith("return"):
-            # multi-line / statement form: wrap into a function body
+            # multi-line / statement form: wrap into a function body.
+            # Names provided by the helper module (source_file) or builtins
+            # are globals, not arguments.
+            args = [
+                n for n in self._detect_args(expression)
+                if n not in self._globals
+            ]
             body = "\n".join("    " + line for line in expression.splitlines())
-            fn_src = f"def __expr_fn__({', '.join(self._detect_args(expression))}):\n{body}"
+            fn_src = f"def __expr_fn__({', '.join(args)}):\n{body}"
             exec(compile(fn_src, "<expression>", "exec"), self._globals)
             self._fn = self._globals["__expr_fn__"]
-            self._vars = tuple(
-                n for n in self._detect_args(expression)
-                if n not in fixed_vars
-            )
+            self._fn_args = args
+            self._vars = tuple(n for n in args if n not in fixed_vars)
             self._code = None
         else:
             self._code = compile(expression, "<expression>", "eval")
@@ -153,9 +157,7 @@ class ExpressionFunction(SimpleRepr):
         if missing:
             raise TypeError(f"Missing variables {sorted(missing)} for {self}")
         if self._fn is not None:
-            call_args = {k: env[k] for k in self._detect_args(self._expression)
-                         if k in env}
-            return self._fn(**call_args)
+            return self._fn(**{k: env[k] for k in self._fn_args})
         g = dict(self._globals)
         g["__builtins__"] = {}
         return eval(self._code, g, env)  # noqa: S307 - host-side model eval

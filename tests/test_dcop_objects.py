@@ -101,11 +101,16 @@ def test_external_variable_subscription():
 
 def test_create_variables():
     d = Domain("d", "", [0, 1])
-    vs = create_variables("v", ["a", "b", "c"], d)
+    vs = create_variables("v_", ["a", "b", "c"], d)
     assert set(vs) == {"v_a", "v_b", "v_c"}
-    vs2 = create_variables("m", [["x", "y"], ["1", "2"]], d)
+    # tuple of iterables -> cartesian product, tuple keys (reference
+    # objects.py:258-334 semantics)
+    vs2 = create_variables("m_", (["x", "y"], ["1", "2"]), d)
     assert ("x", "1") in vs2
-    assert vs2[("x", "1")].name == "mx_1"
+    assert vs2[("x", "1")].name == "m_x_1"
+    # range -> zero-padded names
+    vs3 = create_variables("v", range(10), d)
+    assert "v2" in vs3
 
 
 def test_create_binary_variables():

@@ -1,0 +1,207 @@
+"""End-to-end MaxSum tests — the reference's canonical instances.
+
+Golden values follow the reference's own CI assertions
+(reference: tests/api/test_api_solve.py:36-93): on the 3-variable /
+2-color graph coloring the optimum is v1=R, v2=G, v3=R.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import (
+    AlgorithmDef,
+    AlgoParameterException,
+    list_available_algorithms,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.infrastructure.run import solve, solve_result
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+# AAMAS-19 tutorial instance (reference: tests/instances/
+# graph_coloring_tuto.yaml): 4 binary variables, extensional costs,
+# optimum G G G G with cost 12.
+TUTO = """
+name: gc tuto
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+  v4: {domain: colors}
+constraints:
+  c_1_2:
+    type: extensional
+    variables: [v1, v2]
+    values: {5: R R, 8: R G, 20: G R, 3: G G}
+  c_1_3:
+    type: extensional
+    variables: [v1, v3]
+    values: {5: R R, 10: R G, 20: G R, 3: G G}
+  c_2_3:
+    type: extensional
+    variables: [v2, v3]
+    values: {5: R R, 4: R G, 3: G R | G G}
+  c_2_4:
+    type: extensional
+    variables: [v2, v4]
+    values: {3: R R | G G, 8: R G, 10: G R}
+agents: [a1, a2, a3, a4]
+"""
+
+
+def test_maxsum_graph_coloring_3():
+    dcop = load_dcop(GC3)
+    assignment = solve(dcop, "maxsum", timeout=10)
+    assert assignment == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_maxsum_result_details():
+    dcop = load_dcop(GC3)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    assert res.status in ("FINISHED", "MAX_CYCLES")
+    assert res.finished
+    # v1=R (-0.1) + v2=G (-0.1) + v3=R (+0.1), no violated constraint —
+    # the reference's getting-started example reports the same -0.1
+    assert res.cost == pytest.approx(-0.1, abs=1e-5)
+    assert res.violations == 0
+    assert res.cycles >= 1
+
+
+def test_maxsum_tuto_extensional():
+    dcop = load_dcop(TUTO)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    assert res.assignment == {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}
+    assert res.cost == pytest.approx(12)
+
+
+def test_maxsum_max_objective():
+    yaml_str = GC3.replace("objective: min", "objective: max")
+    dcop = load_dcop(yaml_str)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    # maximizing: v1=v2 and v2=v3 (cost 1 each) + positive var costs
+    a = res.assignment
+    assert a["v1"] == a["v2"] == a["v3"]
+
+
+def test_maxsum_damping_param():
+    dcop = load_dcop(GC3)
+    assignment = solve(dcop, "maxsum", timeout=10, damping=0.7)
+    assert assignment == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_maxsum_stop_cycle():
+    dcop = load_dcop(GC3)
+    res = solve_result(dcop, "maxsum", timeout=10, stop_cycle=3)
+    assert res.cycles <= 3
+
+
+def test_maxsum_ternary_constraint():
+    yaml_str = """
+name: t3
+objective: min
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+  z: {domain: d}
+constraints:
+  c_all: {type: intention, function: abs(x - 1) + abs(y - 2) + abs(z - x)}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    assert res.assignment == {"x": 1, "y": 2, "z": 1}
+    assert res.cost == 0
+
+
+def test_maxsum_with_unary_constraint_factor():
+    yaml_str = """
+name: tu
+objective: min
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  pull_x: {type: intention, function: 10 * abs(x - 2)}
+  diff: {type: intention, function: 5 if x == y else 0}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    assert res.assignment["x"] == 2
+    assert res.assignment["y"] != 2
+
+
+def test_mixed_domain_sizes():
+    yaml_str = """
+name: mix
+objective: min
+domains:
+  small: {values: [0, 1]}
+  large: {values: [0, 1, 2, 3, 4]}
+variables:
+  a: {domain: small}
+  b: {domain: large}
+constraints:
+  c: {type: intention, function: abs(a - b) + b * 0.1}
+agents: [a1]
+"""
+    dcop = load_dcop(yaml_str)
+    res = solve_result(dcop, "maxsum", timeout=10)
+    # optimum: a=b in {0,1}, prefer b=0
+    assert res.assignment == {"a": 0, "b": 0}
+
+
+def test_algorithm_def_params():
+    algo = AlgorithmDef.build_with_default_param(
+        "maxsum", {"damping": 0.8})
+    assert algo.param_value("damping") == 0.8
+    assert algo.param_value("stability") == 0.1
+    with pytest.raises(AlgoParameterException):
+        AlgorithmDef.build_with_default_param("maxsum", {"nope": 1})
+
+
+def test_prepare_algo_params_validation():
+    module = load_algorithm_module("maxsum")
+    with pytest.raises(AlgoParameterException):
+        prepare_algo_params({"damping_nodes": "everything"},
+                            module.algo_params)
+
+
+def test_list_available_algorithms():
+    assert "maxsum" in list_available_algorithms()
+
+
+def test_footprints():
+    module = load_algorithm_module("maxsum")
+    from pydcop_tpu.graphs import factor_graph
+
+    dcop = load_dcop(GC3)
+    g = factor_graph.build_computation_graph(dcop)
+    f = g.computation("diff_1_2")
+    v = g.computation("v2")
+    assert module.computation_memory(f) == 4
+    assert module.computation_memory(v) == 4
+    assert module.communication_load(f, "v1") == 2
+    with pytest.raises(ValueError):
+        module.communication_load(f, "v3")
