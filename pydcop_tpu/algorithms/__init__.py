@@ -169,10 +169,10 @@ class ComputationDef(SimpleRepr):
 def list_available_algorithms() -> List[str]:
     """Discover algorithm modules in this package
     (reference: algorithms/__init__.py:508-526)."""
-    exclude = set()
     out = []
     for _, name, ispkg in pkgutil.iter_modules(__path__):
-        if not ispkg and name not in exclude:
+        # "_"-prefixed modules are shared helpers, not algorithms
+        if not ispkg and not name.startswith("_"):
             out.append(name)
     return sorted(out)
 

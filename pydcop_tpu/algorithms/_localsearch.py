@@ -1,0 +1,158 @@
+"""Shared machinery for local-search algorithms on the constraints
+hypergraph (dsa*, mgm*, dba, gdba, mixeddsa).
+
+All of them share the same data plane: the full ``(n_vars, max_domain)``
+best-response cost matrix computed in one shot (``ops.candidate_costs``),
+neighbor gain exchange as segment reductions over the variable-pair edge
+list, and per-constraint violation tests against precomputed per-constraint
+optima.  The reference computes all of this with per-agent Python loops
+over ``constraints_hypergraph`` neighbors (e.g. dsa.py:265-357,
+mgm.py:213-420).
+"""
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.solver import ArraySolver
+from ..graphs.arrays import BIG, HypergraphArrays
+from ..ops.kernels import (
+    assignment_cost_device,
+    bucket_cost,
+    candidate_costs,
+    masked_argmin,
+)
+
+
+class LocalSearchSolver(ArraySolver):
+    """Base: holds device arrays + the shared kernels."""
+
+    def __init__(self, arrays: HypergraphArrays, stop_cycle: int = 0):
+        self.arrays = arrays
+        self.var_names = arrays.var_names
+        self.stop_cycle = int(stop_cycle)
+
+        self.V = arrays.n_vars
+        self.D = arrays.max_domain
+        self.var_costs = jnp.asarray(arrays.var_costs)
+        self.domain_mask = jnp.asarray(arrays.domain_mask)
+        self.domain_size = jnp.asarray(arrays.domain_size)
+        self.initial_idx = jnp.asarray(arrays.initial_idx)
+        self.has_initial = jnp.asarray(arrays.has_initial)
+        self.buckets = [
+            (jnp.asarray(b.cubes), jnp.asarray(b.var_ids))
+            for b in arrays.buckets
+        ]
+        # per-constraint best achievable value, per bucket (for
+        # "violated constraint" tests, reference dsa.py:450-466)
+        self.bucket_optima = [
+            jnp.asarray(
+                np.min(b.cubes.reshape(b.cubes.shape[0], -1), axis=1))
+            for b in arrays.buckets
+        ]
+        self.nbr_src = jnp.asarray(arrays.nbr_src)
+        self.nbr_dst = jnp.asarray(arrays.nbr_dst)
+        self.has_neighbors = self.nbr_src.shape[0] > 0
+
+    # --- shared kernels --------------------------------------------------
+
+    def local_costs(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(V, D) cost of each candidate value given neighbors at ``x``."""
+        total = self.var_costs
+        for cubes, var_ids in self.buckets:
+            total = total + candidate_costs(cubes, var_ids, x, self.V)
+        return total
+
+    def random_values(self, key) -> jnp.ndarray:
+        """Random initial value per variable (or the declared initial)."""
+        r = jax.random.uniform(key, (self.V,))
+        rand_idx = (r * self.domain_size).astype(jnp.int32)
+        return jnp.where(self.has_initial, self.initial_idx, rand_idx)
+
+    def total_cost(self, x: jnp.ndarray) -> jnp.ndarray:
+        return assignment_cost_device(self.buckets, self.var_costs, x)
+
+    def var_has_violated_constraint(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(V,) bool: does the variable touch a constraint that is not at
+        its own optimum (reference dsa.py exists_violated_constraint)."""
+        out = jnp.zeros((self.V,), dtype=bool)
+        for (cubes, var_ids), opt in zip(self.buckets, self.bucket_optima):
+            violated = bucket_cost(cubes, var_ids, x) > opt + 1e-6
+            for p in range(var_ids.shape[1]):
+                out = out | (
+                    jax.ops.segment_max(
+                        violated.astype(jnp.int32), var_ids[:, p],
+                        num_segments=self.V,
+                    ) > 0
+                )
+        return out
+
+    def neighbor_max_gain(self, gain: jnp.ndarray) -> jnp.ndarray:
+        """(V,) max gain among each variable's neighbors (-inf if none)."""
+        if not self.has_neighbors:
+            return jnp.full((self.V,), -jnp.inf)
+        return jax.ops.segment_max(
+            gain[self.nbr_src], self.nbr_dst, num_segments=self.V)
+
+    def wins_tie(self, gain: jnp.ndarray, nbr_max: jnp.ndarray,
+                 priority: jnp.ndarray) -> jnp.ndarray:
+        """(V,) bool: strictly-greatest-gain test with tie-breaking by
+        ``priority`` (lower wins is encoded by the caller)."""
+        if not self.has_neighbors:
+            return gain > 0
+        at_max = gain[self.nbr_src] >= nbr_max[self.nbr_dst] - 1e-9
+        nbr_best_pri = jax.ops.segment_max(
+            jnp.where(at_max, priority[self.nbr_src], -jnp.inf),
+            self.nbr_dst, num_segments=self.V)
+        return (gain > nbr_max + 1e-9) | (
+            (gain >= nbr_max - 1e-9) & (priority > nbr_best_pri)
+        )
+
+    def best_response(self, key, x: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                 jnp.ndarray]:
+        """Returns (costs, current_cost, best_cost, best_val) where
+        best_val breaks ties randomly, preferring a value != current when
+        several minima exist (reference dsa.py variant_b/c)."""
+        costs = self.local_costs(x)
+        cur = costs[jnp.arange(self.V), x]
+        c = jnp.where(self.domain_mask, costs, BIG * 2)
+        best_cost = jnp.min(c, axis=-1)
+        is_min = (c <= best_cost[:, None] + 1e-9) & self.domain_mask
+        # prefer a minimum other than the current value when one exists
+        not_cur = is_min & ~jax.nn.one_hot(x, self.D, dtype=bool)
+        has_other = jnp.any(not_cur, axis=-1)
+        pick_from = jnp.where(has_other[:, None], not_cur, is_min)
+        noise = jax.random.uniform(key, c.shape)
+        best_val = jnp.argmax(pick_from * (1.0 + noise), axis=-1)
+        return costs, cur, best_cost, best_val
+
+    # --- engine protocol -------------------------------------------------
+
+    def assignment_indices(self, s):
+        return s["x"]
+
+    def cost(self, s):
+        return self.total_cost(s["x"])
+
+    def _finish(self, cycle):
+        if self.stop_cycle:
+            return cycle >= self.stop_cycle
+        return jnp.bool_(False)
+
+
+def hypergraph_footprints(unit_size: float = 1.0):
+    """Build computation_memory / communication_load callbacks shared by
+    all hypergraph algorithms (reference: dsa.py/mgm.py footprint
+    formulas — value messages carry one value, memory is one value per
+    neighbor)."""
+
+    def computation_memory(node) -> float:
+        return unit_size * len(node.neighbors)
+
+    def communication_load(node, target: str) -> float:
+        return unit_size
+
+    return computation_memory, communication_load

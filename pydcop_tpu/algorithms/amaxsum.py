@@ -1,0 +1,62 @@
+"""A-MaxSum: asynchronous MaxSum.
+
+reference parity: pydcop/algorithms/amaxsum.py (424 LoC).  The reference
+reuses MaxSum's math but sends messages on every receipt with no cycle
+barrier (amaxsum.py:108-251).  In the compiled engine the faithful model
+(SURVEY.md §7 hard part 3) is *stochastic activation*: each cycle an
+independent random subset of edges refreshes its messages while the rest
+keep their previous values — reproducing the reference's property that
+updates propagate asynchronously through a loopy graph, which often damps
+oscillations that bite synchronous MaxSum.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..dcop.dcop import DCOP
+from ..graphs.arrays import FactorGraphArrays
+from . import AlgoParameterDef
+from .maxsum import HEADER_SIZE, UNIT_SIZE, MaxSumSolver
+from .maxsum import communication_load, computation_memory  # noqa: F401
+
+GRAPH_TYPE = "factor_graph"
+
+algo_params = [
+    AlgoParameterDef("damping", "float", None, 0.5),
+    AlgoParameterDef("damping_nodes", "str",
+                     ["vars", "factors", "both", "none"], "vars"),
+    AlgoParameterDef("stability", "float", None, 0.1),
+    AlgoParameterDef("noise", "float", None, 0.0),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("activation", "float", None, 0.7),
+]
+
+
+class AMaxSumSolver(MaxSumSolver):
+    def __init__(self, arrays: FactorGraphArrays, activation: float = 0.7,
+                 **kwargs):
+        super().__init__(arrays, **kwargs)
+        self.activation = float(activation)
+
+    def step(self, s):
+        key, k_act_q, k_act_r = jax.random.split(s["key"], 3)
+        s2 = dict(s)
+        s2["key"] = key
+        out = super().step(s2)
+        # only a random subset of edges refreshes its messages this cycle
+        act_q = jax.random.uniform(
+            k_act_q, (self.E, 1)) < self.activation
+        act_r = jax.random.uniform(
+            k_act_r, (self.E, 1)) < self.activation
+        out["q"] = jnp.where(act_q, out["q"], s["q"])
+        out["r"] = jnp.where(act_r, out["r"], s["r"])
+        return out
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> AMaxSumSolver:
+    params = params or {}
+    arrays = FactorGraphArrays.build(dcop, variables, constraints)
+    return AMaxSumSolver(arrays, **params)

@@ -1,0 +1,133 @@
+"""DBA: Distributed Breakout Algorithm (for constraint *satisfaction*).
+
+reference parity: pydcop/algorithms/dba.py (597 LoC).  ok?/improve message
+waves become one jitted step: per-variable improvement on the
+*weighted-violation* objective, neighborhood-max winner moves, and every
+variable stuck in a quasi-local minimum raises the weight of its violated
+constraints (the "breakout", dba.py:272+).
+
+Deviations (documented):
+* constraint weights are global, not per-agent copies — the reference lets
+  each agent hold its own (eventually equal) copy of the weight of a
+  shared constraint; a shared array is the natural compiled form,
+* termination: the reference detects a solution with a distance-bounded
+  propagation wave (``max_distance``); here the global violation count is
+  directly readable on device each cycle, which is the same predicate
+  computed exactly.  ``infinity`` marks the hard-cost value (the array
+  compiler already clips ``inf`` to HARD).
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import BIG, HypergraphArrays
+from . import AlgoParameterDef
+from ._localsearch import LocalSearchSolver, hypergraph_footprints
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("infinity", "int", None, 10000),
+    AlgoParameterDef("max_distance", "int", None, 50),
+]
+
+
+class DbaSolver(LocalSearchSolver):
+    def __init__(self, arrays: HypergraphArrays, infinity: int = 10000,
+                 max_distance: int = 50):
+        super().__init__(arrays, stop_cycle=0)
+        self.infinity = infinity
+        self.max_distance = max_distance
+        # violation indicator cubes: nonzero base cost = violated (CSP
+        # semantics; padding excluded)
+        self.viol_cubes = [
+            (jnp.asarray(((b.cubes > 1e-9) & (b.cubes < BIG * 0.5))
+                         .astype(np.float32)),
+             jnp.asarray(b.var_ids))
+            for b in arrays.buckets
+        ]
+        self.n_cons = [b.var_ids.shape[0] for b in arrays.buckets]
+        self.lexic_priority = -jnp.arange(self.V, dtype=jnp.float32)
+
+    def init_state(self, key):
+        key, sub = jax.random.split(key)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": self.random_values(sub),
+            "weights": tuple(
+                jnp.ones((n,), dtype=jnp.float32) for n in self.n_cons
+            ),
+        }
+
+    def weighted_eval(self, x, weights):
+        """(V, D) weighted violation count per candidate value."""
+        from ..ops.kernels import candidate_costs
+
+        total = jnp.zeros((self.V, self.D))
+        for (ind, var_ids), w in zip(self.viol_cubes, weights):
+            # weight the indicator cube per constraint
+            shape = (ind.shape[0],) + (1,) * (ind.ndim - 1)
+            total = total + candidate_costs(
+                ind * w.reshape(shape), var_ids, x, self.V)
+        return total
+
+    def step(self, s):
+        key, k_best = jax.random.split(s["key"])
+        x, weights = s["x"], s["weights"]
+        ar = jnp.arange(self.V)
+
+        from ..ops.kernels import masked_min, random_argmin
+
+        ev = self.weighted_eval(x, weights)
+        cur = jnp.where(self.domain_mask, ev, BIG)[ar, x]
+        best = masked_min(ev, self.domain_mask)
+        best_val = random_argmin(k_best, ev, self.domain_mask)
+        improve = cur - best
+
+        nbr_max = self.neighbor_max_gain(improve)
+        wins = self.wins_tie(improve, nbr_max, self.lexic_priority)
+        move = (improve > 1e-9) & wins
+        x_new = jnp.where(move, best_val, x)
+
+        # quasi-local minimum: violated but nobody in the neighborhood
+        # (incl. itself) can improve -> breakout
+        qlm = (improve <= 1e-9) & (cur > 1e-9) & (nbr_max <= 1e-9)
+        new_weights = []
+        total_violations = jnp.float32(0)
+        for (ind, var_ids), w in zip(self.viol_cubes, weights):
+            from ..ops.kernels import bucket_cost
+
+            violated = bucket_cost(ind, var_ids, x) > 0.5  # (C,)
+            any_qlm = jnp.zeros(var_ids.shape[0], dtype=bool)
+            for p in range(var_ids.shape[1]):
+                any_qlm = any_qlm | qlm[var_ids[:, p]]
+            new_weights.append(
+                w + jnp.where(violated & any_qlm, 1.0, 0.0))
+            # count violations under the *new* assignment for termination
+            total_violations = total_violations + jnp.sum(
+                bucket_cost(ind, var_ids, x_new))
+        cycle = s["cycle"] + 1
+        return {
+            "cycle": cycle,
+            "finished": total_violations < 0.5,
+            "key": key,
+            "x": x_new,
+            "weights": tuple(new_weights),
+        }
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> DbaSolver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return DbaSolver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

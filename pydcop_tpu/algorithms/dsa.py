@@ -1,0 +1,102 @@
+"""Synchronous DSA (Distributed Stochastic Algorithm), variants A/B/C.
+
+reference parity: pydcop/algorithms/dsa.py (431 LoC).  Exact semantics of
+the variants (dsa.py:359-405):
+
+* A — change (with probability p) only on strictly positive gain,
+* B — also on zero gain if some incident constraint is not at its own
+  optimum ("violated", dsa.py:450-466), preferring a different value,
+* C — also on zero gain unconditionally, preferring a different value.
+
+``p_mode = arity`` re-derives the probability per variable as
+``1.2 / sum(arity - 1)`` over its constraints (dsa.py:256-263).
+
+One cycle for *all* variables = one jitted step; the manual current/next
+cycle barrier of the reference (dsa.py:265-357) is unnecessary.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import HypergraphArrays
+from . import AlgoParameterDef
+from ._localsearch import LocalSearchSolver, hypergraph_footprints
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("p_mode", "str", ["fixed", "arity"], "fixed"),
+]
+
+
+class DsaSolver(LocalSearchSolver):
+    def __init__(self, arrays: HypergraphArrays, probability: float = 0.7,
+                 variant: str = "B", stop_cycle: int = 0,
+                 p_mode: str = "fixed"):
+        super().__init__(arrays, stop_cycle)
+        self.variant = variant
+        if p_mode == "arity":
+            # per-variable threshold 1.2 / sum(arity-1) (dsa.py:256-263)
+            n_count = np.zeros(arrays.n_vars, dtype=np.float64)
+            for b in arrays.buckets:
+                for p in range(b.arity):
+                    np.add.at(n_count, b.var_ids[:, p], b.arity - 1)
+            with np.errstate(divide="ignore"):
+                prob = np.where(n_count > 0, 1.2 / n_count, 1.0)
+            self.probability = jnp.asarray(
+                np.clip(prob, 0.0, 1.0), dtype=jnp.float32)
+        else:
+            self.probability = jnp.float32(probability)
+
+    def init_state(self, key):
+        key, sub = jax.random.split(key)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": self.random_values(sub),
+        }
+
+    def step(self, s):
+        key, k_best, k_prob = jax.random.split(s["key"], 3)
+        x = s["x"]
+        _, cur, best_cost, best_val = self.best_response(k_best, x)
+        delta = cur - best_cost
+
+        improve = delta > 1e-9
+        equal = jnp.abs(delta) <= 1e-9
+        if self.variant == "A":
+            want = improve
+        elif self.variant == "B":
+            want = improve | (equal & self.var_has_violated_constraint(x))
+        else:  # C
+            want = improve | equal
+
+        lucky = jax.random.uniform(k_prob, (self.V,)) < self.probability
+        change = want & lucky
+        x_new = jnp.where(change, best_val, x)
+        cycle = s["cycle"] + 1
+        return {
+            "cycle": cycle,
+            "finished": self._finish(cycle),
+            "key": key,
+            "x": x_new,
+        }
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> DsaSolver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return DsaSolver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

@@ -1,0 +1,37 @@
+"""Minimal pedagogical DSA (reference: pydcop/algorithms/dsatuto.py,
+126 LoC — the algorithm-implementation tutorial's example).
+
+Equivalent to DSA-A with probability 0.5 and random initial values.
+Kept as its own module so the tutorial workflow (``-a dsatuto``) works.
+"""
+
+from typing import Dict, Optional
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import HypergraphArrays
+from . import AlgoParameterDef
+from ._localsearch import hypergraph_footprints
+from .dsa import DsaSolver
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class DsaTutoSolver(DsaSolver):
+    def __init__(self, arrays: HypergraphArrays, stop_cycle: int = 0):
+        super().__init__(arrays, probability=0.5, variant="A",
+                         stop_cycle=stop_cycle)
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> DsaTutoSolver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return DsaTutoSolver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

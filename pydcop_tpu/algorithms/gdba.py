@@ -1,0 +1,165 @@
+"""GDBA: Generalized Distributed Breakout for *optimization*.
+
+reference parity: pydcop/algorithms/gdba.py (658 LoC).  Per-constraint
+modifier hypercubes live in solver state and are combined with the base
+cost tables each cycle:
+
+* ``modifier`` A → effective = base + modifier;
+  M → effective = base × (modifier + 1)   (gdba.py:575-600)
+* ``violation`` NZ → base > 0; NM → base > min(cube);
+  MX → base == max(cube)                   (gdba.py:554-574)
+* ``increase_mode`` on quasi-local minimum, from each stuck variable's
+  perspective (gdba.py:627-654):
+  E → the current-assignment cell, R → all values of the stuck variable
+  (others at current), C → the stuck variable's current-value hyperplane,
+  T → the whole table.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import BIG, HypergraphArrays
+from ..ops.kernels import bucket_cost, candidate_costs
+from . import AlgoParameterDef
+from ._localsearch import LocalSearchSolver, hypergraph_footprints
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("modifier", "str", ["A", "M"], "A"),
+    AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
+    AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
+]
+
+
+class GdbaSolver(LocalSearchSolver):
+    def __init__(self, arrays: HypergraphArrays, modifier: str = "A",
+                 violation: str = "NZ", increase_mode: str = "E"):
+        super().__init__(arrays, stop_cycle=0)
+        self.modifier_mode = modifier
+        self.violation_mode = violation
+        self.increase_mode = increase_mode
+        self.lexic_priority = -jnp.arange(self.V, dtype=jnp.float32)
+        # per-constraint min/max over valid cells (for NM/MX violation)
+        self.cube_min = []
+        self.cube_max = []
+        for b in arrays.buckets:
+            flat = b.cubes.reshape(b.cubes.shape[0], -1)
+            valid = flat < BIG * 0.5
+            self.cube_min.append(jnp.asarray(
+                np.min(np.where(valid, flat, np.inf), axis=1)))
+            self.cube_max.append(jnp.asarray(
+                np.max(np.where(valid, flat, -np.inf), axis=1)))
+
+    def init_state(self, key):
+        key, sub = jax.random.split(key)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": self.random_values(sub),
+            "modifiers": tuple(
+                jnp.zeros_like(cubes) for cubes, _ in self.buckets
+            ),
+        }
+
+    def effective_cubes(self, modifiers):
+        out = []
+        for (cubes, var_ids), mod in zip(self.buckets, modifiers):
+            valid = cubes < BIG * 0.5
+            if self.modifier_mode == "A":
+                eff = jnp.where(valid, cubes + mod, cubes)
+            else:  # M
+                eff = jnp.where(valid, cubes * (mod + 1.0), cubes)
+            out.append((eff, var_ids))
+        return out
+
+    def constraint_violated(self, x, bucket_i):
+        """(C,) is each constraint violated at assignment x, per the
+        violation mode (evaluated on *base* costs, gdba.py:554-574)."""
+        cubes, var_ids = self.buckets[bucket_i]
+        cost = bucket_cost(cubes, var_ids, x)
+        if self.violation_mode == "NZ":
+            return cost > 1e-9
+        if self.violation_mode == "NM":
+            return cost > self.cube_min[bucket_i] + 1e-9
+        return cost >= self.cube_max[bucket_i] - 1e-9  # MX
+
+    def step(self, s):
+        key, k_best = jax.random.split(s["key"])
+        x, modifiers = s["x"], s["modifiers"]
+        ar = jnp.arange(self.V)
+
+        eff = self.effective_cubes(modifiers)
+        costs = self.var_costs
+        for cubes, var_ids in eff:
+            costs = costs + candidate_costs(cubes, var_ids, x, self.V)
+        from ..ops.kernels import masked_min, random_argmin
+
+        cur = jnp.where(self.domain_mask, costs, BIG * 2)[ar, x]
+        best = masked_min(costs, self.domain_mask)
+        best_val = random_argmin(k_best, costs, self.domain_mask)
+        improve = cur - best
+
+        nbr_max = self.neighbor_max_gain(improve)
+        wins = self.wins_tie(improve, nbr_max, self.lexic_priority)
+        move = (improve > 1e-9) & wins
+        x_new = jnp.where(move, best_val, x)
+
+        # breakout: quasi-local-minimum variables raise modifiers of their
+        # violated constraints
+        qlm = (improve <= 1e-9) & (nbr_max <= 1e-9)
+        new_mods = []
+        for i, ((cubes, var_ids), mod) in enumerate(
+                zip(self.buckets, modifiers)):
+            arity = cubes.ndim - 1
+            C, D = cubes.shape[0], self.D
+            violated = self.constraint_violated(x, i)
+            vals = x[var_ids]  # (C, arity)
+            for p in range(arity):
+                amount = jnp.where(
+                    violated & qlm[var_ids[:, p]], 1.0, 0.0)  # (C,)
+                if self.increase_mode == "T":
+                    mod = mod + amount.reshape(
+                        (C,) + (1,) * arity)
+                    continue
+                # work with axis p last: (C, M, D)
+                m_t = jnp.moveaxis(mod, p + 1, arity)
+                m_shape = m_t.shape
+                m_r = m_t.reshape(C, -1, D)
+                idx = jnp.zeros((C,), dtype=jnp.int32)
+                for q in range(arity):
+                    if q != p:
+                        idx = idx * D + vals[:, q]
+                if self.increase_mode == "E":
+                    m_r = m_r.at[jnp.arange(C), idx, vals[:, p]].add(amount)
+                elif self.increase_mode == "R":
+                    m_r = m_r.at[jnp.arange(C), idx, :].add(
+                        amount[:, None])
+                else:  # C: whole hyperplane at the current value of p
+                    m_r = m_r.at[jnp.arange(C), :, vals[:, p]].add(
+                        amount[:, None])
+                mod = jnp.moveaxis(m_r.reshape(m_shape), arity, p + 1)
+            new_mods.append(mod)
+        cycle = s["cycle"] + 1
+        return {
+            "cycle": cycle,
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": x_new,
+            "modifiers": tuple(new_mods),
+        }
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> GdbaSolver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return GdbaSolver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

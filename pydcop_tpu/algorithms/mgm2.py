@@ -1,0 +1,250 @@
+"""MGM-2: coordinated 2-variable Maximum Gain Message.
+
+reference parity: pydcop/algorithms/mgm2.py (1,062 LoC).  The reference
+runs a 5-state machine per cycle — value, offer, answer, gain, go
+(mgm2.py:435) — with offerers chosen with probability ``threshold``
+offering coordinated moves to one random neighbor.  Here the five message
+phases collapse into *one jitted step*:
+
+1. roles: offerer ~ Bernoulli(threshold) per variable,
+2. offers: every offerer picks one random neighbor; the joint pair-move
+   cost matrix ``P(d1,d2)`` is computed for **all** neighbor pair edges at
+   once from the shared-constraint slice tensor ``S`` (see below), offers
+   are just a mask over pair edges,
+3. answers: each non-offerer accepts its best received offer (segment-max),
+4. gains: matched pairs announce the pair gain, lone non-offerers their
+   unilateral MGM gain, rejected offerers 0 (they sit out the cycle, as in
+   the reference),
+5. go: a pair moves iff its gain strictly beats every neighbor's announced
+   gain for *both* members (partner excluded); lone variables follow the
+   MGM rule.
+
+The pair-move cost uses the identity
+``P(d1,d2) = L_o(d1) + L_t(d2) - S(d1, x_t) - S(x_o, d2) + S(d1, d2)``
+where ``L`` is the standard candidate-cost matrix (others fixed) and
+``S(d1,d2)`` sums the constraints *shared* by the pair, sliced at the
+current values of any third variables.  ``S`` is computed for every
+neighbor pair edge by one gather + segment-sum per (position, position)
+combination per arity bucket.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import BIG, HypergraphArrays
+from . import AlgoParameterDef
+from ._localsearch import LocalSearchSolver, hypergraph_footprints
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("threshold", "float", None, 0.5),
+    AlgoParameterDef("favor", "str", ["unilateral", "coordinated", "no"],
+                     "unilateral"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+_EPS = 1e-6
+
+
+class Mgm2Solver(LocalSearchSolver):
+    def __init__(self, arrays: HypergraphArrays, threshold: float = 0.5,
+                 favor: str = "unilateral", stop_cycle: int = 0):
+        super().__init__(arrays, stop_cycle)
+        self.threshold = float(threshold)
+        self.favor = favor
+
+        # --- host-side pair-edge compilation -----------------------------
+        src = np.asarray(arrays.nbr_src)
+        dst = np.asarray(arrays.nbr_dst)
+        self.P = len(src)
+        eid = {(int(a), int(b)): i for i, (a, b) in enumerate(zip(src, dst))}
+
+        # per bucket: pair-edge id for each ordered position pair
+        self.pair_eids = []
+        for b in arrays.buckets:
+            a = b.arity
+            m = np.zeros((b.var_ids.shape[0], a, a), dtype=np.int32)
+            for p in range(a):
+                for q in range(a):
+                    if p == q:
+                        continue
+                    for c in range(b.var_ids.shape[0]):
+                        u, v = int(b.var_ids[c, p]), int(b.var_ids[c, q])
+                        m[c, p, q] = eid.get((u, v), 0) if u != v else 0
+            self.pair_eids.append(jnp.asarray(m))
+
+        # padded per-variable out-edge lists for random partner choice
+        deg = np.zeros(arrays.n_vars, dtype=np.int64)
+        for s in src:
+            deg[s] += 1
+        maxdeg = max(1, int(deg.max()) if len(deg) else 1)
+        out_edges = np.zeros((arrays.n_vars, maxdeg), dtype=np.int32)
+        fill = np.zeros(arrays.n_vars, dtype=np.int64)
+        for i, s in enumerate(src):
+            out_edges[s, fill[s]] = i
+            fill[s] += 1
+        self.out_edges = jnp.asarray(out_edges)
+        self.out_degree = jnp.asarray(deg.astype(np.int32))
+        self.pair_src = jnp.asarray(src.astype(np.int32))
+        self.pair_dst = jnp.asarray(dst.astype(np.int32))
+
+    # --- device kernels --------------------------------------------------
+
+    def shared_slices(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(P, D, D): for every directed neighbor pair edge (u, v), the sum
+        of shared-constraint costs as a function of (u's value, v's value),
+        third variables fixed at ``x``."""
+        S = jnp.zeros((self.P, self.D, self.D))
+        for (cubes, var_ids), pair_eid in zip(self.buckets, self.pair_eids):
+            a = cubes.ndim - 1
+            if a < 2:
+                continue
+            C = cubes.shape[0]
+            vals = x[var_ids]
+            for p in range(a):
+                for q in range(a):
+                    if p == q:
+                        continue
+                    t = jnp.moveaxis(cubes, p + 1, a)   # p -> last
+                    # after moving p to the end, q's axis is q+1 if q < p
+                    # (unchanged) else q (shifted left by one)
+                    q_axis = q + 1 if q < p else q
+                    t = jnp.moveaxis(t, q_axis, a - 1)
+                    t = t.reshape(C, -1, self.D, self.D)
+                    idx = jnp.zeros((C,), dtype=jnp.int32)
+                    for r in range(a):
+                        if r != p and r != q:
+                            idx = idx * self.D + vals[:, r]
+                    contrib = t[jnp.arange(C), idx]      # (C, D_q, D_p)
+                    contrib = jnp.swapaxes(contrib, 1, 2)  # (C, D_p, D_q)
+                    S = S + jax.ops.segment_sum(
+                        contrib, pair_eid[:, p, q], num_segments=self.P)
+        return S
+
+    def init_state(self, key):
+        key, sub = jax.random.split(key)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": self.random_values(sub),
+        }
+
+    def step(self, s):
+        key, k_best, k_role, k_pick, k_tie = jax.random.split(s["key"], 5)
+        x = s["x"]
+        V, D, P = self.V, self.D, self.P
+        ar = jnp.arange(V)
+
+        # phase 1: local view ------------------------------------------------
+        L, cur, best_cost, best_val = self.best_response(k_best, x)
+        solo_gain = cur - best_cost
+
+        # phase 2: roles + offers -------------------------------------------
+        offerer = jax.random.uniform(k_role, (V,)) < self.threshold
+        pick = (jax.random.uniform(k_pick, (V,))
+                * jnp.maximum(self.out_degree, 1)).astype(jnp.int32)
+        chosen_edge = self.out_edges[ar, pick]           # (V,)
+        has_nbr = self.out_degree > 0
+
+        S = self.shared_slices(x)                        # (P, D, D)
+        o, t = self.pair_src, self.pair_dst
+        # P_e(d1, d2) for every pair edge
+        pair_cost = (
+            L[o][:, :, None] + L[t][:, None, :]
+            - S[jnp.arange(P), :, x[t]][:, :, None]
+            - S[jnp.arange(P), x[o], :][:, None, :]
+            + S
+        )
+        mask2 = (self.domain_mask[o][:, :, None]
+                 & self.domain_mask[t][:, None, :])
+        pair_cost = jnp.where(mask2, pair_cost, BIG * 2)
+        pair_cur = cur[o] + cur[t] - S[jnp.arange(P), x[o], x[t]]
+        flat = pair_cost.reshape(P, -1)
+        pair_best = jnp.min(flat, axis=1)
+        pair_arg = jnp.argmin(flat, axis=1)
+        pair_d1 = pair_arg // D
+        pair_d2 = pair_arg % D
+        pair_gain = pair_cur - pair_best                 # (P,)
+
+        # an offer lives on edge e iff src is an offerer, chose e, and dst
+        # is not an offerer (reference: only non-offerers answer)
+        is_offer = (offerer[o] & has_nbr[o]
+                    & (chosen_edge[o] == jnp.arange(P))
+                    & ~offerer[t] & (pair_gain > _EPS))
+
+        # phase 3: answers — dst accepts its best received offer ------------
+        tie = jax.random.uniform(k_tie, (P,))
+        offer_score = jnp.where(is_offer, pair_gain + tie * _EPS, -jnp.inf)
+        best_offer_at = jax.ops.segment_max(offer_score, t, num_segments=V)
+        accepted = is_offer & (offer_score >= best_offer_at[t]) \
+            & jnp.isfinite(best_offer_at[t])
+
+        in_pair_src = jax.ops.segment_max(
+            accepted.astype(jnp.int32), o, num_segments=V) > 0
+        in_pair_dst = jax.ops.segment_max(
+            accepted.astype(jnp.int32), t, num_segments=V) > 0
+        in_pair = in_pair_src | in_pair_dst
+        # per-variable: the accepted edge id (src or dst side)
+        eidx = jnp.arange(P)
+        edge_of_src = jax.ops.segment_max(
+            jnp.where(accepted, eidx, -1), o, num_segments=V)
+        edge_of_dst = jax.ops.segment_max(
+            jnp.where(accepted, eidx, -1), t, num_segments=V)
+        my_edge = jnp.maximum(edge_of_src, edge_of_dst)  # (V,) or -1
+        partner = jnp.where(
+            in_pair_src, t[jnp.clip(my_edge, 0)], o[jnp.clip(my_edge, 0)])
+
+        # phase 4: announced gains ------------------------------------------
+        favor_bonus = {"unilateral": -_EPS, "coordinated": _EPS,
+                       "no": 0.0}[self.favor]
+        g_pair = pair_gain[jnp.clip(my_edge, 0)] + favor_bonus
+        announced = jnp.where(
+            in_pair, g_pair,
+            jnp.where(offerer, 0.0, solo_gain))
+
+        # phase 5: go — strict max in neighborhood --------------------------
+        # neighbor max of announced gains, excluding the partner
+        exclude = in_pair[self.pair_dst] \
+            & (self.pair_src == partner[self.pair_dst])
+        nbr_gain = jnp.where(
+            exclude, -jnp.inf, announced[self.pair_src])
+        nbr_max = jax.ops.segment_max(
+            nbr_gain, self.pair_dst, num_segments=V) \
+            if self.has_neighbors else jnp.full((V,), -jnp.inf)
+
+        my_go = announced > nbr_max + _EPS
+        # both pair members must go
+        partner_go = my_go[partner]
+        pair_moves = in_pair & my_go & partner_go & (announced > _EPS)
+        solo_moves = (~in_pair) & (~offerer) & (solo_gain > _EPS) & my_go
+
+        # new values: pair members take the pair argmin, solos take best
+        pair_val = jnp.where(
+            in_pair_src, pair_d1[jnp.clip(my_edge, 0)],
+            pair_d2[jnp.clip(my_edge, 0)])
+        x_new = jnp.where(pair_moves, pair_val,
+                          jnp.where(solo_moves, best_val, x))
+        cycle = s["cycle"] + 1
+        return {
+            "cycle": cycle,
+            "finished": self._finish(cycle),
+            "key": key,
+            "x": x_new,
+        }
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> Mgm2Solver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return Mgm2Solver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

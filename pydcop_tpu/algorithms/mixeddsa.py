@@ -1,0 +1,111 @@
+"""MixedDSA: DSA hybrid for problems mixing hard and soft constraints.
+
+reference parity: pydcop/algorithms/mixeddsa.py (476 LoC).  Semantics
+(mixeddsa.py:286-320): each cycle a variable first checks whether it can
+*reduce the number of violated hard constraints* — if so it moves with
+``proba_hard``; otherwise, if the soft cost can be improved (per the DSA
+variant rule) it moves with ``proba_soft``.
+
+Hard constraints are recognized at compile time as cost tables containing
+infinite (clipped-to-HARD) entries; the per-candidate violated-hard count
+is computed exactly like the candidate cost matrix, over indicator cubes.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP, filter_dcop
+from ..graphs.arrays import HARD, HypergraphArrays
+from ..ops.kernels import candidate_costs
+from . import AlgoParameterDef
+from ._localsearch import LocalSearchSolver, hypergraph_footprints
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("proba_hard", "float", None, 0.7),
+    AlgoParameterDef("proba_soft", "float", None, 0.5),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+_HARD_THRESH = float(HARD) * 0.99
+
+
+class MixedDsaSolver(LocalSearchSolver):
+    def __init__(self, arrays: HypergraphArrays, proba_hard: float = 0.7,
+                 proba_soft: float = 0.5, variant: str = "B",
+                 stop_cycle: int = 0):
+        super().__init__(arrays, stop_cycle)
+        self.proba_hard = float(proba_hard)
+        self.proba_soft = float(proba_soft)
+        self.variant = variant
+        # indicator cubes marking hard-violation cells
+        self.hard_buckets = [
+            (jnp.asarray((b.cubes >= _HARD_THRESH).astype(np.float32)
+                         * (b.cubes < 1e8)),  # exclude BIG padding
+             jnp.asarray(b.var_ids))
+            for b in arrays.buckets
+        ]
+
+    def hard_violation_counts(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(V, D) number of violated hard constraints per candidate."""
+        total = jnp.zeros((self.V, self.D))
+        for cubes, var_ids in self.hard_buckets:
+            total = total + candidate_costs(cubes, var_ids, x, self.V)
+        return total
+
+    def init_state(self, key):
+        key, sub = jax.random.split(key)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "x": self.random_values(sub),
+        }
+
+    def step(self, s):
+        key, k_best, k_prob = jax.random.split(s["key"], 3)
+        x = s["x"]
+        _, cur, best_cost, best_val = self.best_response(k_best, x)
+        delta = cur - best_cost
+
+        hard_counts = self.hard_violation_counts(x)
+        cur_hard = hard_counts[jnp.arange(self.V), x]
+        best_hard = hard_counts[jnp.arange(self.V), best_val]
+        reduces_hard = cur_hard > best_hard
+
+        improve = delta > 1e-9
+        equal = jnp.abs(delta) <= 1e-9
+        if self.variant == "A":
+            want = improve
+        elif self.variant == "B":
+            want = improve | (equal & self.var_has_violated_constraint(x))
+        else:
+            want = improve | equal
+
+        proba = jnp.where(reduces_hard, self.proba_hard, self.proba_soft)
+        lucky = jax.random.uniform(k_prob, (self.V,)) < proba
+        change = want & lucky
+        x_new = jnp.where(change, best_val, x)
+        cycle = s["cycle"] + 1
+        return {
+            "cycle": cycle,
+            "finished": self._finish(cycle),
+            "key": key,
+            "x": x_new,
+        }
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> MixedDsaSolver:
+    params = params or {}
+    arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
+                                    constraints)
+    return MixedDsaSolver(arrays, **params)
+
+
+computation_memory, communication_load = hypergraph_footprints()

@@ -51,6 +51,11 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             algo_def, params=kwargs, mode=dcop.objective)
     algo_module = load_algorithm_module(algo_def.algo)
 
+    if hasattr(algo_module, "solve_direct"):
+        # exact / sequential algorithms (dpop, syncbb, ncbb) run their own
+        # sweep instead of the cyclic engine
+        return algo_module.solve_direct(dcop, algo_def.params)
+
     t0 = time.perf_counter()
     solver = algo_module.build_solver(dcop, algo_def.params)
     engine = SyncEngine(solver)

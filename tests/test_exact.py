@@ -1,0 +1,153 @@
+"""Exact algorithms: DPOP, SyncBB, NCBB.
+
+All three must return the true optimum; cross-checked against each other
+and against brute force on random instances.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, constraint_from_str
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.infrastructure.run import solve_result
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+EXACT = ["dpop", "syncbb", "ncbb"]
+
+
+def brute_force(dcop):
+    names = list(dcop.variables)
+    doms = [list(dcop.variables[n].domain.values) for n in names]
+    best, best_a = None, None
+    for combi in itertools.product(*doms):
+        a = dict(zip(names, combi))
+        c, _ = dcop.solution_cost(a)
+        if best is None or (c < best if dcop.objective == "min"
+                            else c > best):
+            best, best_a = c, a
+    return best, best_a
+
+
+@pytest.mark.parametrize("algo", EXACT)
+def test_exact_gc3(algo):
+    dcop = load_dcop(GC3)
+    res = solve_result(dcop, algo, timeout=20)
+    # reference getting_started.rst golden: optimum R G R, cost -0.1
+    assert res.assignment == {"v1": "R", "v2": "G", "v3": "R"}
+    assert res.cost == pytest.approx(-0.1, abs=1e-5)
+    assert res.finished
+
+
+def random_dcop(seed, n=7, density=0.4, d_size=3, objective="min"):
+    rng = random.Random(seed)
+    d = Domain("d", "", list(range(d_size)))
+    dcop = DCOP(f"rand{seed}", objective)
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if rng.random() < density:
+                import numpy as np
+
+                m = np.array(
+                    [[rng.randint(0, 9) for _ in range(d_size)]
+                     for _ in range(d_size)], dtype=float)
+                dcop.add_constraint(NAryMatrixRelation(
+                    [vs[i], vs[j]], m, f"c_{i}_{j}"))
+    return dcop
+
+
+@pytest.mark.parametrize("algo", EXACT)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exact_random_binary(algo, seed):
+    dcop = random_dcop(seed)
+    expected_cost, _ = brute_force(dcop)
+    res = solve_result(dcop, algo, timeout=30)
+    assert res.cost == pytest.approx(expected_cost), \
+        f"{algo} got {res.cost}, optimum {expected_cost}"
+
+
+@pytest.mark.parametrize("algo", EXACT)
+def test_exact_max_objective(algo):
+    dcop = random_dcop(3, objective="max")
+    expected_cost, _ = brute_force(dcop)
+    res = solve_result(dcop, algo, timeout=30)
+    assert res.cost == pytest.approx(expected_cost)
+
+
+@pytest.mark.parametrize("algo", ["dpop", "ncbb"])
+def test_exact_ternary(algo):
+    """Ternary constraints (the reference NCBB can't do these —
+    ncbb.py:139 binary only; ours can)."""
+    d = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("t3", "min")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(constraint_from_str(
+        "c1", "abs(v0 + v1 - v2)", vs))
+    dcop.add_constraint(constraint_from_str(
+        "c2", "(v2 - v3)**2", vs))
+    expected_cost, _ = brute_force(dcop)
+    res = solve_result(dcop, algo, timeout=30)
+    assert res.cost == pytest.approx(expected_cost)
+
+
+def test_exact_disconnected():
+    """Forest: two independent components."""
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("forest", "min")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(constraint_from_str("c1", "v0 * v1", vs))
+    dcop.add_constraint(constraint_from_str("c2", "(1-v2) + v2*v3", vs))
+    for algo in EXACT:
+        res = solve_result(dcop, algo, timeout=20)
+        expected_cost, _ = brute_force(dcop)
+        assert res.cost == pytest.approx(expected_cost), algo
+
+
+def test_dpop_memory_limit():
+    import numpy as np
+
+    d = Domain("d", "", list(range(10)))
+    dcop = DCOP("big", "min")
+    vs = [Variable(f"v{i}", d) for i in range(12)]
+    for v in vs:
+        dcop.add_variable(v)
+    # clique -> separator blows up
+    for i in range(12):
+        for j in range(i + 1, 12):
+            m = np.zeros((10, 10))
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i], vs[j]], m, f"c{i}_{j}"))
+    from pydcop_tpu.algorithms.dpop import solve_direct
+
+    with pytest.raises(MemoryError):
+        solve_direct(dcop, {}, memory_limit=10 ** 4)
+
+
+def test_amaxsum_gc3():
+    dcop = load_dcop(GC3)
+    res = solve_result(dcop, "amaxsum", timeout=20, max_cycles=200)
+    assert res.assignment == {"v1": "R", "v2": "G", "v3": "R"}

@@ -1,0 +1,177 @@
+"""Local-search algorithm family tests.
+
+Golden values follow the reference's CI envelope
+(tests/api/test_api_solve.py:95-105): local search on the 3-var coloring
+must end in one of the two acceptable colorings.
+"""
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.infrastructure.run import solve, solve_result
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+# reference test_api_solve.py:95-105: local search may land in either of
+# these two colorings
+VALID_GC3 = [
+    {"v1": "R", "v2": "G", "v3": "R"},
+    {"v1": "G", "v2": "R", "v3": "G"},
+]
+
+CSP = """
+name: csp
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  x1: {domain: colors}
+  x2: {domain: colors}
+  x3: {domain: colors}
+  x4: {domain: colors}
+constraints:
+  d12: {type: intention, function: 1000 if x1 == x2 else 0}
+  d13: {type: intention, function: 1000 if x1 == x3 else 0}
+  d23: {type: intention, function: 1000 if x2 == x3 else 0}
+  d34: {type: intention, function: 1000 if x3 == x4 else 0}
+agents: [a1, a2, a3, a4]
+"""
+
+
+def no_conflicts(a):
+    return (a["x1"] != a["x2"] and a["x1"] != a["x3"]
+            and a["x2"] != a["x3"] and a["x3"] != a["x4"])
+
+
+@pytest.mark.parametrize("algo", ["dsa", "adsa", "dsatuto", "mixeddsa"])
+def test_dsa_family_gc3(algo):
+    dcop = load_dcop(GC3)
+    a = solve(dcop, algo, timeout=20, max_cycles=100, seed=2)
+    assert a in VALID_GC3, a
+
+
+@pytest.mark.parametrize("variant", ["A", "B", "C"])
+def test_dsa_variants(variant):
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "dsa", timeout=20, max_cycles=200, seed=1,
+              variant=variant)
+    assert no_conflicts(a), a
+
+
+def test_dsa_p_mode_arity():
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "dsa", timeout=20, max_cycles=300, seed=3,
+              p_mode="arity")
+    assert no_conflicts(a), a
+
+
+def test_dsa_stop_cycle():
+    dcop = load_dcop(GC3)
+    res = solve_result(dcop, "dsa", timeout=20, stop_cycle=5)
+    assert res.cycles == 5
+    assert res.finished
+
+
+def test_mgm_gc3():
+    dcop = load_dcop(GC3)
+    a = solve(dcop, "mgm", timeout=20, max_cycles=100, seed=0)
+    assert a in VALID_GC3, a
+
+
+def test_mgm_monotonic_cost():
+    """MGM is monotonic: collected cost trace must never increase."""
+    dcop = load_dcop(CSP)
+    res = solve_result(dcop, "mgm", timeout=30, max_cycles=60, seed=5,
+                       collect_cost_every=1)
+    costs = [c for _, c in res.cost_trace]
+    assert all(c2 <= c1 + 1e-6 for c1, c2 in zip(costs, costs[1:])), costs
+
+
+def test_mgm_random_break_mode():
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "mgm", timeout=20, max_cycles=200, seed=7,
+              break_mode="random")
+    assert no_conflicts(a), a
+
+
+def test_mgm2_gc3():
+    dcop = load_dcop(GC3)
+    a = solve(dcop, "mgm2", timeout=30, max_cycles=150, seed=1)
+    assert a in VALID_GC3, a
+
+
+def test_mgm2_csp():
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "mgm2", timeout=30, max_cycles=300, seed=2)
+    assert no_conflicts(a), a
+
+
+def test_mgm2_favor_param():
+    dcop = load_dcop(GC3)
+    a = solve(dcop, "mgm2", timeout=30, max_cycles=150, seed=4,
+              favor="coordinated", threshold=0.3)
+    assert a in VALID_GC3, a
+
+
+def test_dba_csp():
+    dcop = load_dcop(CSP)
+    res = solve_result(dcop, "dba", timeout=30, max_cycles=300, seed=1)
+    assert no_conflicts(res.assignment), res.assignment
+    # dba terminates itself once no constraint is violated
+    assert res.finished
+
+
+@pytest.mark.parametrize("increase_mode", ["E", "R", "C", "T"])
+def test_gdba_increase_modes(increase_mode):
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "gdba", timeout=30, max_cycles=150, seed=1,
+              increase_mode=increase_mode)
+    assert no_conflicts(a), a
+
+
+def test_gdba_multiplicative():
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "gdba", timeout=30, max_cycles=150, seed=2,
+              modifier="M", violation="NM")
+    assert no_conflicts(a), a
+
+
+def test_mixeddsa_hard_constraints():
+    yaml_str = """
+name: mixed
+objective: min
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+  z: {domain: d}
+constraints:
+  hard_xy: {type: intention, function: float('inf') if x == y else 0}
+  soft_yz: {type: intention, function: abs(y - z)}
+agents: [a1, a2, a3]
+"""
+    dcop = load_dcop(yaml_str)
+    res = solve_result(dcop, "mixeddsa", timeout=30, max_cycles=200,
+                      seed=3)
+    assert res.assignment["x"] != res.assignment["y"]
+
+
+def test_adsa_activation():
+    dcop = load_dcop(CSP)
+    a = solve(dcop, "adsa", timeout=30, max_cycles=400, seed=5,
+              activation=0.3)
+    assert no_conflicts(a), a
