@@ -57,11 +57,16 @@ def message_size(util: NAryMatrixRelation) -> int:
 
 def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
                  memory_limit: int = 10 ** 8,
+                 timeout: Optional[float] = None,
                  **_kwargs) -> RunResult:
-    """Run DPOP to optimality."""
+    """Run DPOP to optimality (or TIMEOUT with an empty assignment —
+    DPOP has no meaningful anytime solution mid-UTIL-sweep)."""
     import time
 
     t0 = time.perf_counter()
+
+    def out_of_time():
+        return timeout is not None and time.perf_counter() - t0 > timeout
     mode = dcop.objective
     g = pseudotree.build_computation_graph(dcop)
 
@@ -80,6 +85,9 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
     # --- UTIL phase: deepest level first -----------------------------------
     for level in reversed(levels):
+        if out_of_time():
+            return RunResult({}, 0, False, float("inf"), 0,
+                             time.perf_counter() - t0, status="TIMEOUT")
         for node in level:
             rel = NAryMatrixRelation([node.variable],
                                      name=f"util_{node.name}")

@@ -40,7 +40,12 @@ def communication_load(node, target: str) -> float:
     return 1.0
 
 
+class _Timeout(Exception):
+    pass
+
+
 def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
+                 timeout: Optional[float] = None,
                  **_kwargs) -> RunResult:
     t0 = time.perf_counter()
     sign = 1.0 if dcop.objective == "min" else -1.0
@@ -102,6 +107,9 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
         """Best (cost, assignment) of the subtree under ``name`` given
         ancestor context, or (inf, None) if it cannot beat ``ub``."""
         stats["expansions"] += 1
+        if timeout is not None and stats["expansions"] % 256 == 0 \
+                and time.perf_counter() - t0 > timeout:
+            raise _Timeout()
         n = nodes[name]
         inc = increments(name, ctx)
         order = np.argsort(inc, kind="stable")
@@ -133,22 +141,29 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
                 best_cost, best_assign = total, assign
         return best_cost, best_assign
 
+    def greedy_assign(name, ctx, out):
+        inc = increments(name, ctx)
+        vi = int(np.argmin(inc))
+        out[name] = vi
+        ctx2 = dict(ctx)
+        ctx2[name] = vi
+        for c in nodes[name].children:
+            greedy_assign(c, ctx2, out)
+
+    status = "FINISHED"
     assignment_idx: Dict[str, int] = {}
     for root in g.roots:
         ub = greedy(root.name, {}) + 1e-9
-        cost, assign = search(root.name, {}, ub + 1e-6)
+        try:
+            cost, assign = search(root.name, {}, ub + 1e-6)
+        except _Timeout:
+            # anytime fallback: the greedy-descent solution
+            status = "TIMEOUT"
+            greedy_assign(root.name, {}, assignment_idx)
+            continue
         if assign is None:
             # the greedy solution itself was optimal; re-run greedy
             # capturing the assignment
-            def greedy_assign(name, ctx, out):
-                inc = increments(name, ctx)
-                vi = int(np.argmin(inc))
-                out[name] = vi
-                ctx2 = dict(ctx)
-                ctx2[name] = vi
-                for c in nodes[name].children:
-                    greedy_assign(c, ctx2, out)
-
             greedy_assign(root.name, {}, assignment_idx)
         else:
             assignment_idx.update(assign)
@@ -161,10 +176,10 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
     return RunResult(
         assignment=assignment,
         cycles=stats["expansions"],
-        finished=True,
+        finished=status == "FINISHED",
         cost=cost,
         violations=violations,
         duration=time.perf_counter() - t0,
-        status="FINISHED",
+        status=status,
         metrics={"expansions": stats["expansions"]},
     )

@@ -81,6 +81,7 @@ def _increments(level: int, x_idx: List[int], per_level, n_values: int
 
 
 def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
+                 timeout: Optional[float] = None,
                  **_kwargs) -> RunResult:
     t0 = time.perf_counter()
     sign = 1.0 if dcop.objective == "min" else -1.0
@@ -103,7 +104,12 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
     push(0, 0.0)
     msg_count = 0
+    status = "FINISHED"
     while stack:
+        if timeout is not None and msg_count % 1024 == 0 \
+                and time.perf_counter() - t0 > timeout:
+            status = "TIMEOUT"  # anytime: keep the best found so far
+            break
         order, ptr, inc, cost_so_far = stack[-1]
         level = len(stack) - 1
         advanced = False
@@ -140,10 +146,10 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
     return RunResult(
         assignment=assignment,
         cycles=msg_count,
-        finished=True,
+        finished=status == "FINISHED",
         cost=cost,
         violations=violations,
         duration=time.perf_counter() - t0,
-        status="FINISHED",
+        status=status,
         metrics={"msg_count": msg_count},
     )

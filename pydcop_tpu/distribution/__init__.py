@@ -1,0 +1,42 @@
+"""Distribution layer: placing computations onto agents.
+
+reference parity: pydcop/distribution/ — every module exposes
+``distribute(computation_graph, agentsdef, hints, computation_memory,
+communication_load) -> Distribution`` and most ``distribution_cost``.
+
+On TPU this layer doubles as the sharding-spec generator: the agent
+partition of the computation graph is the natural partition of the
+stacked array state over devices/hosts.
+"""
+
+from importlib import import_module
+
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+    distribution_cost,
+)
+
+DISTRIBUTION_METHODS = [
+    "oneagent", "adhoc", "heur_comhost",
+    "ilp_compref", "ilp_compref_fg", "ilp_fgdp",
+    "oilp_cgdp", "oilp_secp_cgdp", "oilp_secp_fgdp",
+    "gh_cgdp", "gh_secp_cgdp", "gh_secp_fgdp",
+]
+
+
+def load_distribution_module(name: str):
+    if name not in DISTRIBUTION_METHODS:
+        raise ImportError(
+            f"Unknown distribution method {name!r}; "
+            f"available: {DISTRIBUTION_METHODS}"
+        )
+    return import_module(f"pydcop_tpu.distribution.{name}")
+
+
+__all__ = [
+    "Distribution", "DistributionHints",
+    "ImpossibleDistributionException", "distribution_cost",
+    "DISTRIBUTION_METHODS", "load_distribution_module",
+]

@@ -1,0 +1,141 @@
+"""Shared optimal-ILP distribution model.
+
+The reference solves its placement ILPs with PuLP + GLPK
+(pydcop/distribution/ilp_compref.py:139, ilp_fgdp.py:161, .travis.yml
+installs glpk-utils).  Here the same model runs through
+``scipy.optimize.milp`` (HiGHS), which ships in the baked-in scipy.
+
+Model (reference ilp_compref.py):
+  min   alpha * sum_e sum_{a1,a2} load(e) * route(a1,a2) * y[e,a1,a2]
+      + beta  * sum_{c,a} hosting(a,c) * x[c,a]
+  s.t.  sum_a x[c,a] = 1                      for every computation c
+        sum_c mem(c) * x[c,a] <= capacity(a)  for every agent a
+        y[e,a1,a2] >= x[c1,a1] + x[c2,a2] - 1 (link activation)
+        x[c,a] = 1 for must_host hints
+        x, y binary
+"""
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+from scipy import sparse
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+from .objects import (
+    Distribution,
+    ImpossibleDistributionException,
+    link_pair_loads,
+)
+
+
+def ilp_distribute(computation_graph, agentsdef: Iterable, hints=None,
+                   computation_memory=None, communication_load=None,
+                   alpha: float = 0.8, beta: float = 0.2) -> Distribution:
+    agents = list(agentsdef)
+    comps = computation_graph.nodes
+    C, A = len(comps), len(agents)
+    if A == 0:
+        raise ImpossibleDistributionException("No agents")
+    if C == 0:
+        return Distribution({a.name: [] for a in agents})
+    comp_idx = {n.name: i for i, n in enumerate(comps)}
+    # per-pair aggregated loads — the SAME accounting distribution_cost
+    # uses, so the ILP optimum is optimal under the reported metric
+    pair_loads = link_pair_loads(computation_graph, communication_load)
+    links = sorted(pair_loads)
+    load = np.array([pair_loads[k] for k in links])
+    E = len(links)
+
+    mem = np.array(
+        [computation_memory(n) if computation_memory else 0.0
+         for n in comps])
+    route = np.array(
+        [[a1.route(a2.name) for a2 in agents] for a1 in agents])
+    hosting = np.array(
+        [[a.hosting_cost(n.name) for a in agents] for n in comps])
+
+    nx = C * A
+
+    def xv(c, a):
+        return c * A + a
+
+    # y variables only where the link/agent-pair cost is nonzero (route 0
+    # — same agent or free route — needs no activation variable at all)
+    y_index = {}
+    y_cost: List[float] = []
+    for e in range(E):
+        for a1 in range(A):
+            for a2 in range(A):
+                c_val = alpha * load[e] * route[a1, a2]
+                if c_val > 0:
+                    y_index[(e, a1, a2)] = nx + len(y_cost)
+                    y_cost.append(c_val)
+    n_var = nx + len(y_cost)
+
+    cost = np.zeros(n_var)
+    cost[:nx] = beta * hosting.reshape(-1)
+    cost[nx:] = y_cost
+
+    rows, cols, vals = [], [], []
+    lb, ub = [], []
+    r = 0
+    # each computation hosted exactly once
+    for c in range(C):
+        for a in range(A):
+            rows.append(r)
+            cols.append(xv(c, a))
+            vals.append(1.0)
+        lb.append(1.0)
+        ub.append(1.0)
+        r += 1
+    # capacity
+    for a, agent in enumerate(agents):
+        for c in range(C):
+            rows.append(r)
+            cols.append(xv(c, a))
+            vals.append(float(mem[c]))
+        lb.append(-np.inf)
+        ub.append(float(agent.capacity))
+        r += 1
+    # link activation: x1 + x2 - y <= 1
+    for e, (c1, c2) in enumerate(links):
+        i1, i2 = comp_idx[c1], comp_idx[c2]
+        for a1 in range(A):
+            for a2 in range(A):
+                yv = y_index.get((e, a1, a2))
+                if yv is None:
+                    continue  # free pairing, y not modeled
+                rows += [r, r, r]
+                cols += [xv(i1, a1), xv(i2, a2), yv]
+                vals += [1.0, 1.0, -1.0]
+                lb.append(-np.inf)
+                ub.append(1.0)
+                r += 1
+
+    var_lb = np.zeros(n_var)
+    var_ub = np.ones(n_var)
+    # must_host hints pin x variables
+    if hints is not None:
+        agent_idx = {a.name: i for i, a in enumerate(agents)}
+        for a_name, a_i in agent_idx.items():
+            for c_name in hints.must_host(a_name):
+                if c_name in comp_idx:
+                    var_lb[xv(comp_idx[c_name], a_i)] = 1.0
+
+    mat = sparse.csr_matrix((vals, (rows, cols)), shape=(r, n_var))
+    res = milp(
+        c=cost,
+        constraints=LinearConstraint(mat, lb, ub),
+        integrality=np.ones(n_var),
+        bounds=Bounds(var_lb, var_ub),
+    )
+    if not res.success:
+        raise ImpossibleDistributionException(
+            f"ILP distribution infeasible: {res.message}"
+        )
+    x = res.x[:nx].reshape(C, A)
+    mapping = {a.name: [] for a in agents}
+    for c, node in enumerate(comps):
+        a = int(np.argmax(x[c]))
+        mapping[agents[a].name].append(node.name)
+    return Distribution(mapping)

@@ -1,0 +1,73 @@
+"""adhoc distribution: greedy heuristic honoring hints and capacity.
+
+reference parity: pydcop/distribution/adhoc.py:56-239 — must_host hints
+placed first, then computations greedily packed onto agents with available
+capacity, preferring the agent already hosting a neighbor (keeps chatty
+computations together).
+"""
+
+from typing import Iterable, Optional
+
+from .objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(computation_graph, agentsdef: Iterable, hints=None,
+               computation_memory=None,
+               communication_load=None) -> Distribution:
+    agents = list(agentsdef)
+    if not agents:
+        raise ImpossibleDistributionException("No agents")
+    footprint = (
+        (lambda node: computation_memory(node))
+        if computation_memory else (lambda node: 0.0)
+    )
+    capacity = {a.name: a.capacity for a in agents}
+    mapping = {a.name: [] for a in agents}
+    placed = {}
+
+    def host(agent_name, node):
+        mapping[agent_name].append(node.name)
+        placed[node.name] = agent_name
+        capacity[agent_name] -= footprint(node)
+
+    nodes = {n.name: n for n in computation_graph.nodes}
+
+    # 1. must_host hints first (reference: adhoc.py hints handling)
+    if hints is not None:
+        for a in agents:
+            for c in hints.must_host(a.name):
+                if c in nodes and c not in placed:
+                    host(a.name, nodes[c])
+
+    # 2. remaining computations, biggest footprint first, preferring an
+    # agent that hosts a neighbor and has capacity
+    remaining = sorted(
+        (n for n in computation_graph.nodes if n.name not in placed),
+        key=lambda n: -footprint(n),
+    )
+    for node in remaining:
+        candidates = sorted(
+            agents,
+            key=lambda a: (
+                -sum(1 for nb in node.neighbors
+                     if placed.get(nb) == a.name),
+                -capacity[a.name],
+                a.name,
+            ),
+        )
+        chosen = None
+        for a in candidates:
+            if capacity[a.name] >= footprint(node):
+                chosen = a
+                break
+        if chosen is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity left for {node.name} "
+                f"(footprint {footprint(node)})"
+            )
+        host(chosen.name, node)
+    return Distribution(mapping)

@@ -1,0 +1,4 @@
+"""Greedy heuristic on the constraint graph (reference: gh_cgdp.py:232) -
+the communication+hosting greedy, shared with heur_comhost."""
+
+from .heur_comhost import distribute, distribution_cost  # noqa: F401

@@ -1,0 +1,3 @@
+"""Greedy SECP heuristic, constraint graph (reference: gh_secp_cgdp.py:195)."""
+
+from .heur_comhost import distribute, distribution_cost  # noqa: F401

@@ -1,0 +1,3 @@
+"""Greedy SECP heuristic, factor graph (reference: gh_secp_fgdp.py:231)."""
+
+from .heur_comhost import distribute, distribution_cost  # noqa: F401

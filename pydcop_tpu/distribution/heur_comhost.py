@@ -1,0 +1,80 @@
+"""heur_comhost: greedy communication + hosting cost heuristic.
+
+reference parity: pydcop/distribution/heur_comhost.py:69-232 — iterate
+computations (most-connected first); place each on the agent minimizing
+``RATIO · communication-to-already-placed-neighbors + (1-RATIO) · hosting``
+under capacity.
+"""
+
+from typing import Iterable
+
+from .objects import (
+    Distribution,
+    ImpossibleDistributionException,
+    distribution_cost as _distribution_cost,
+)
+
+RATIO_HOST_COMM = 0.8
+
+
+def distribute(computation_graph, agentsdef: Iterable, hints=None,
+               computation_memory=None,
+               communication_load=None) -> Distribution:
+    agents = list(agentsdef)
+    if not agents:
+        raise ImpossibleDistributionException("No agents")
+    footprint = (
+        (lambda node: computation_memory(node))
+        if computation_memory else (lambda node: 0.0)
+    )
+    load = (
+        (lambda node, target: communication_load(node, target))
+        if communication_load else (lambda node, target: 1.0)
+    )
+    capacity = {a.name: a.capacity for a in agents}
+    mapping = {a.name: [] for a in agents}
+    placed = {}
+
+    if hints is not None:
+        nodes_by_name = {n.name: n for n in computation_graph.nodes}
+        for a in agents:
+            for c in hints.must_host(a.name):
+                if c in nodes_by_name and c not in placed:
+                    mapping[a.name].append(c)
+                    placed[c] = a.name
+                    capacity[a.name] -= footprint(nodes_by_name[c])
+
+    # most-connected computations first
+    remaining = sorted(
+        (n for n in computation_graph.nodes if n.name not in placed),
+        key=lambda n: (-len(n.neighbors), n.name),
+    )
+    for node in remaining:
+        best_agent, best_cost = None, None
+        for a in agents:
+            if capacity[a.name] < footprint(node):
+                continue
+            comm = sum(
+                load(node, nb) * a.route(placed[nb])
+                for nb in node.neighbors if nb in placed
+            )
+            cost = (RATIO_HOST_COMM * comm
+                    + (1 - RATIO_HOST_COMM) * a.hosting_cost(node.name))
+            if best_cost is None or cost < best_cost or (
+                    cost == best_cost and a.name < best_agent.name):
+                best_agent, best_cost = a, cost
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity for {node.name}"
+            )
+        mapping[best_agent.name].append(node.name)
+        placed[node.name] = best_agent.name
+        capacity[best_agent.name] -= footprint(node)
+    return Distribution(mapping)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return _distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

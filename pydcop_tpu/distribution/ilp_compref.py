@@ -1,0 +1,24 @@
+"""Optimal ILP distribution minimizing alpha*communication + beta*hosting.
+
+reference parity: pydcop/distribution/ilp_compref.py:139-297 (PuLP/GLPK
+there, scipy HiGHS here - see _ilp.py).
+"""
+
+from ._ilp import ilp_distribute
+from .objects import distribution_cost as _distribution_cost
+
+RATIO_HOST_COMM = 0.8
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    return ilp_distribute(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        alpha=RATIO_HOST_COMM, beta=1 - RATIO_HOST_COMM)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return _distribution_cost(distribution, computation_graph, agentsdef,
+                              computation_memory, communication_load)

@@ -1,0 +1,7 @@
+"""ilp_compref on factor graphs (reference: ilp_compref_fg.py:298).
+
+The model is graph-agnostic here; this module exists for name parity with
+the reference's per-graph-type registration.
+"""
+
+from .ilp_compref import distribute, distribution_cost  # noqa: F401

@@ -1,0 +1,23 @@
+"""Optimal ILP for factor-graph distribution (SECP paper model).
+
+reference parity: pydcop/distribution/ilp_fgdp.py:161-340 - minimizes
+communication only, with must_host hints pinning device-bound computations
+(e.g. SECP lights on their light agents).
+"""
+
+from ._ilp import ilp_distribute
+from .objects import distribution_cost as _distribution_cost
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    return ilp_distribute(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        alpha=1.0, beta=0.0)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return _distribution_cost(distribution, computation_graph, agentsdef,
+                              computation_memory, communication_load)

@@ -1,0 +1,143 @@
+"""Distribution objects: mapping computations onto agents.
+
+reference parity: pydcop/distribution/objects.py:36-292.  On TPU the
+distribution doubles as the *sharding spec*: the groups it defines are the
+natural partition for placing slices of the stacked node state on devices
+(and for multi-host DCN placement).
+"""
+
+from typing import Dict, Iterable, List, Optional
+
+from ..utils.simple_repr import SimpleRepr
+
+
+class ImpossibleDistributionException(Exception):
+    pass
+
+
+class Distribution(SimpleRepr):
+    """A mapping agent name -> list of computation names
+    (reference: distribution/objects.py:36-222)."""
+
+    def __init__(self, mapping: Dict[str, List[str]]):
+        self._mapping = {a: list(cs) for a, cs in mapping.items()}
+        self._inverse: Dict[str, str] = {}
+        for a, cs in self._mapping.items():
+            for c in cs:
+                if c in self._inverse:
+                    raise ValueError(
+                        f"Computation {c} hosted on both "
+                        f"{self._inverse[c]} and {a}"
+                    )
+                self._inverse[c] = a
+
+    @property
+    def agents(self) -> List[str]:
+        return list(self._mapping)
+
+    @property
+    def computations(self) -> List[str]:
+        return list(self._inverse)
+
+    def mapping(self) -> Dict[str, List[str]]:
+        return {a: list(cs) for a, cs in self._mapping.items()}
+
+    def computations_hosted(self, agent: str) -> List[str]:
+        return list(self._mapping.get(agent, []))
+
+    def agent_for(self, computation: str) -> str:
+        try:
+            return self._inverse[computation]
+        except KeyError:
+            raise KeyError(f"No agent hosts {computation}")
+
+    def is_hosted(self, computations) -> bool:
+        if isinstance(computations, str):
+            computations = [computations]
+        return all(c in self._inverse for c in computations)
+
+    def host_on_agent(self, agent: str, computations: List[str]):
+        for c in computations:
+            if c in self._inverse:
+                raise ValueError(
+                    f"{c} is already hosted on {self._inverse[c]}"
+                )
+            self._inverse[c] = agent
+        self._mapping.setdefault(agent, []).extend(computations)
+
+    def has_computation(self, computation: str) -> bool:
+        return computation in self._inverse
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, Distribution) and self._mapping == o._mapping
+        )
+
+    def __repr__(self):
+        return f"Distribution({self._mapping})"
+
+
+class DistributionHints(SimpleRepr):
+    """must_host / host_with placement hints
+    (reference: distribution/objects.py:223-292)."""
+
+    def __init__(self, must_host: Optional[Dict[str, List[str]]] = None,
+                 host_with: Optional[Dict[str, List[str]]] = None):
+        self._must_host = {k: list(v) for k, v in (must_host or {}).items()}
+        self._host_with = {k: list(v) for k, v in (host_with or {}).items()}
+
+    def must_host(self, agt_name: str) -> List[str]:
+        return list(self._must_host.get(agt_name, []))
+
+    def host_with(self, name: str) -> List[str]:
+        return list(self._host_with.get(name, []))
+
+    @property
+    def must_host_map(self) -> Dict[str, List[str]]:
+        return {k: list(v) for k, v in self._must_host.items()}
+
+
+def link_pair_loads(computation_graph, communication_load=None
+                    ) -> Dict[tuple, float]:
+    """Aggregate communication load per unordered node pair: for every
+    (deduplicated) link, every node pair it connects contributes its load.
+    Single source of truth for both :func:`distribution_cost` and the ILP
+    objective — they must agree or 'optimal' placements can score worse
+    than greedy ones."""
+    loads: Dict[tuple, float] = {}
+    for link in computation_graph.links:
+        names = sorted(set(link.nodes))
+        for i, n1 in enumerate(names):
+            for n2 in names[i + 1:]:
+                load = communication_load(
+                    computation_graph.computation(n1), n2) \
+                    if communication_load else 1.0
+                key = (n1, n2)
+                loads[key] = loads.get(key, 0.0) + load
+    return loads
+
+
+def distribution_cost(distribution: Distribution, computation_graph,
+                      agentsdef: Iterable, computation_memory=None,
+                      communication_load=None):
+    """Cost of a distribution: communication (load × route, each link
+    counted once) + hosting costs (reference: the ``distribution_cost``
+    functions of ilp_compref/heur_comhost).
+
+    Returns (total, communication_part, hosting_part).
+    """
+    agents = {a.name: a for a in agentsdef}
+    comm = 0.0
+    for (n1, n2), load in link_pair_loads(
+            computation_graph, communication_load).items():
+        if not (distribution.has_computation(n1)
+                and distribution.has_computation(n2)):
+            continue
+        a1 = distribution.agent_for(n1)
+        a2 = distribution.agent_for(n2)
+        comm += load * agents[a1].route(a2)
+    hosting = 0.0
+    for c in distribution.computations:
+        a = agents[distribution.agent_for(c)]
+        hosting += a.hosting_cost(c)
+    return comm + hosting, comm, hosting

@@ -1,0 +1,3 @@
+"""Optimal ILP on the constraint graph (reference: oilp_cgdp.py:368)."""
+
+from .ilp_compref import distribute, distribution_cost  # noqa: F401

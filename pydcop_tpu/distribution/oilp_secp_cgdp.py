@@ -1,0 +1,5 @@
+"""Optimal SECP ILP on the constraint graph
+(reference: oilp_secp_cgdp.py:344). SECP semantics = must_host hints pin
+actuator variables; the shared ILP enforces them."""
+
+from .ilp_compref import distribute, distribution_cost  # noqa: F401

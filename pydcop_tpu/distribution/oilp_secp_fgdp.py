@@ -1,0 +1,3 @@
+"""Optimal SECP ILP on the factor graph (reference: oilp_secp_fgdp.py:376)."""
+
+from .ilp_fgdp import distribute, distribution_cost  # noqa: F401

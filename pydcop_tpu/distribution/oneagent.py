@@ -1,0 +1,30 @@
+"""oneagent distribution: one computation per agent.
+
+reference parity: pydcop/distribution/oneagent.py:90-131.
+"""
+
+from typing import Iterable, Optional
+
+from .objects import Distribution, ImpossibleDistributionException
+
+
+def distribute(computation_graph, agentsdef: Iterable, hints=None,
+               computation_memory=None,
+               communication_load=None) -> Distribution:
+    agents = list(agentsdef)
+    computations = computation_graph.nodes
+    if len(agents) < len(computations):
+        raise ImpossibleDistributionException(
+            f"Cannot distribute {len(computations)} computations on "
+            f"{len(agents)} agents with oneagent"
+        )
+    mapping = {a.name: [] for a in agents}
+    for agent, comp in zip(agents, computations):
+        mapping[agent.name].append(comp.name)
+    return Distribution(mapping)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    # oneagent ignores costs (reference: oneagent.py)
+    return 0, 0, 0

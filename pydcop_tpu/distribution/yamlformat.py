@@ -1,0 +1,28 @@
+"""Distribution YAML I/O (reference: pydcop/distribution/yamlformat.py:44)."""
+
+from typing import Union
+
+import yaml
+
+from .objects import Distribution
+
+
+def load_dist_from_file(filename: str) -> Distribution:
+    with open(filename, encoding="utf-8") as f:
+        return load_dist(f.read())
+
+
+def load_dist(dist_str: str) -> Distribution:
+    loaded = yaml.load(dist_str, Loader=yaml.FullLoader)
+    if "distribution" not in loaded:
+        raise ValueError("Invalid distribution yaml: no 'distribution' key")
+    loaded_dist = loaded["distribution"]
+    dist = {}
+    for a, comps in loaded_dist.items():
+        dist[a] = list(comps) if comps else []
+    return Distribution(dist)
+
+
+def yaml_dist(dist: Distribution) -> str:
+    return yaml.dump({"distribution": dist.mapping()},
+                     default_flow_style=False)

@@ -16,9 +16,11 @@ from ..engine.solver import RunResult
 from ..engine.sync_engine import SyncEngine
 from ..graphs import load_graph_module
 
+DEFAULT_DISTRIBUTION = "adhoc"
+
 
 def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
-          distribution: str = "oneagent",
+          distribution: Optional[str] = DEFAULT_DISTRIBUTION,
           timeout: Optional[float] = 5,
           max_cycles: int = 2000,
           seed: int = 0,
@@ -38,7 +40,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 
 
 def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
-                 distribution: str = "oneagent",
+                 distribution: Optional[str] = DEFAULT_DISTRIBUTION,
                  timeout: Optional[float] = 5,
                  max_cycles: int = 2000,
                  seed: int = 0,
@@ -54,9 +56,35 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     if hasattr(algo_module, "solve_direct"):
         # exact / sequential algorithms (dpop, syncbb, ncbb) run their own
         # sweep instead of the cyclic engine
-        return algo_module.solve_direct(dcop, algo_def.params)
+        return algo_module.solve_direct(dcop, algo_def.params,
+                                        timeout=timeout)
 
     t0 = time.perf_counter()
+    dist_obj = None
+    if distribution is not None and dcop.agents:
+        # the distribution is the control-plane placement (and the
+        # sharding spec); the data plane always runs the whole graph as
+        # one compiled program (reference: run.py:108-124 builds the
+        # graph + distribution before deploying)
+        from ..distribution import (
+            ImpossibleDistributionException,
+            load_distribution_module,
+        )
+
+        graph = load_graph_module(
+            algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+        dist_module = load_distribution_module(distribution)
+        try:
+            dist_obj = dist_module.distribute(
+                graph, dcop.agents_def, dcop.dist_hints,
+                algo_module.computation_memory,
+                algo_module.communication_load)
+        except ImpossibleDistributionException:
+            if distribution != DEFAULT_DISTRIBUTION:
+                raise
+            # the implicit default placement is metrics-only: an
+            # infeasible placement must not break the solve
+            dist_obj = None
     solver = algo_module.build_solver(dcop, algo_def.params)
     engine = SyncEngine(solver)
     result = engine.run(
@@ -70,4 +98,6 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         cost, violations = dcop.solution_cost(result.assignment)
         result.cost = cost
         result.violations = violations
+    if dist_obj is not None:
+        result.metrics["distribution"] = dist_obj.mapping()
     return result

@@ -1,0 +1,178 @@
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.distribution import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+    load_distribution_module,
+)
+from pydcop_tpu.distribution.yamlformat import load_dist, yaml_dist
+from pydcop_tpu.graphs import constraints_hypergraph, factor_graph
+
+YAML = """
+name: gc
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+constraints:
+  c12: {type: intention, function: 1 if v1 == v2 else 0}
+  c23: {type: intention, function: 1 if v2 == v3 else 0}
+agents:
+  a1: {capacity: 100}
+  a2: {capacity: 100}
+  a3: {capacity: 100}
+  a4: {capacity: 100}
+  a5: {capacity: 100}
+"""
+
+
+@pytest.fixture
+def setup():
+    dcop = load_dcop(YAML)
+    fg = factor_graph.build_computation_graph(dcop)
+    maxsum = load_algorithm_module("maxsum")
+    return dcop, fg, maxsum
+
+
+def test_distribution_object():
+    d = Distribution({"a1": ["c1", "c2"], "a2": ["c3"]})
+    assert d.agent_for("c3") == "a2"
+    assert d.computations_hosted("a1") == ["c1", "c2"]
+    assert d.is_hosted(["c1", "c3"])
+    d.host_on_agent("a1", ["c4"])
+    assert d.agent_for("c4") == "a1"
+    with pytest.raises(ValueError):
+        d.host_on_agent("a2", ["c4"])
+    with pytest.raises(ValueError):
+        Distribution({"a1": ["c1"], "a2": ["c1"]})
+
+
+def test_oneagent(setup):
+    dcop, fg, maxsum = setup
+    m = load_distribution_module("oneagent")
+    dist = m.distribute(fg, dcop.agents_def)
+    # 5 computations (3 vars + 2 factors) on 5 agents
+    assert len(dist.computations) == 5
+    for a in dist.agents:
+        assert len(dist.computations_hosted(a)) <= 1
+
+
+def test_oneagent_not_enough_agents(setup):
+    dcop, fg, _ = setup
+    m = load_distribution_module("oneagent")
+    with pytest.raises(ImpossibleDistributionException):
+        m.distribute(fg, dcop.agents_def[:3])
+
+
+def test_adhoc(setup):
+    dcop, fg, maxsum = setup
+    m = load_distribution_module("adhoc")
+    dist = m.distribute(fg, dcop.agents_def, None,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    assert sorted(dist.computations) == sorted(
+        n.name for n in fg.nodes)
+
+
+def test_adhoc_respects_hints(setup):
+    dcop, fg, maxsum = setup
+    hints = DistributionHints(must_host={"a3": ["v1", "c12"]})
+    m = load_distribution_module("adhoc")
+    dist = m.distribute(fg, dcop.agents_def, hints,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    assert dist.agent_for("v1") == "a3"
+    assert dist.agent_for("c12") == "a3"
+
+
+def test_adhoc_capacity_limit(setup):
+    dcop, fg, maxsum = setup
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    # capacity too small for anything
+    tiny = [AgentDef("t1", capacity=1)]
+    m = load_distribution_module("adhoc")
+    with pytest.raises(ImpossibleDistributionException):
+        m.distribute(fg, tiny, None, maxsum.computation_memory,
+                     maxsum.communication_load)
+
+
+def test_heur_comhost(setup):
+    dcop, fg, maxsum = setup
+    m = load_distribution_module("heur_comhost")
+    dist = m.distribute(fg, dcop.agents_def, None,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    assert sorted(dist.computations) == sorted(
+        n.name for n in fg.nodes)
+    total, comm, host = m.distribution_cost(
+        dist, fg, dcop.agents_def, maxsum.computation_memory,
+        maxsum.communication_load)
+    assert total == comm + host
+
+
+def test_ilp_compref(setup):
+    dcop, fg, maxsum = setup
+    m = load_distribution_module("ilp_compref")
+    dist = m.distribute(fg, dcop.agents_def, None,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    assert sorted(dist.computations) == sorted(
+        n.name for n in fg.nodes)
+    # the optimal distribution should not be worse than the greedy one
+    gh = load_distribution_module("heur_comhost")
+    gh_dist = gh.distribute(fg, dcop.agents_def, None,
+                            maxsum.computation_memory,
+                            maxsum.communication_load)
+    ilp_cost, _, _ = m.distribution_cost(
+        dist, fg, dcop.agents_def, maxsum.computation_memory,
+        maxsum.communication_load)
+    gh_cost, _, _ = gh.distribution_cost(
+        gh_dist, fg, dcop.agents_def, maxsum.computation_memory,
+        maxsum.communication_load)
+    assert ilp_cost <= gh_cost + 1e-6
+
+
+def test_ilp_fgdp_must_host(setup):
+    dcop, fg, maxsum = setup
+    hints = DistributionHints(must_host={"a2": ["v2"]})
+    m = load_distribution_module("ilp_fgdp")
+    dist = m.distribute(fg, dcop.agents_def, hints,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    assert dist.agent_for("v2") == "a2"
+
+
+def test_all_methods_loadable():
+    from pydcop_tpu.distribution import DISTRIBUTION_METHODS
+
+    for name in DISTRIBUTION_METHODS:
+        m = load_distribution_module(name)
+        assert hasattr(m, "distribute")
+    with pytest.raises(ImportError):
+        load_distribution_module("nope")
+
+
+def test_yaml_roundtrip():
+    d = Distribution({"a1": ["c1", "c2"], "a2": []})
+    s = yaml_dist(d)
+    d2 = load_dist(s)
+    assert d2.computations_hosted("a1") == ["c1", "c2"]
+    assert d2.computations_hosted("a2") == []
+
+
+def test_hypergraph_distribution(setup):
+    dcop, _, _ = setup
+    dsa = load_algorithm_module("dsa")
+    g = constraints_hypergraph.build_computation_graph(dcop)
+    m = load_distribution_module("adhoc")
+    dist = m.distribute(g, dcop.agents_def, None,
+                        dsa.computation_memory,
+                        dsa.communication_load)
+    assert sorted(dist.computations) == ["v1", "v2", "v3"]
