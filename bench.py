@@ -1,0 +1,100 @@
+"""Benchmark: MaxSum msgs/sec on 10k-variable graph coloring, TPU vs the
+reference-architecture CPU-thread runtime.
+
+North star (BASELINE.json): 10k-var graph-coloring MaxSum converging <1s
+on one chip, >=100x the threaded CPU agent runtime at equal solution cost.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Message accounting follows the reference: one var->factor and one
+factor->var message per edge per cycle (the reference counts each posted
+message, SURVEY.md §3.3); the compiled engine moves 2*E messages per
+jitted step, so msgs/sec = 2 * E * cycles / elapsed.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_VARS = 10_000
+N_EDGES = 30_000
+N_COLORS = 3
+MEASURE_CYCLES = 60
+BASELINE_SECONDS = 4.0
+# threaded-baseline problem is smaller (the python runtime would need
+# hours for 10k vars); per-message python cost is size-independent, so
+# msgs/sec transfers
+BASELINE_VARS = 1_000
+BASELINE_EDGES = 3_000
+
+
+def tpu_run():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(
+        N_VARS, N_EDGES, N_COLORS, seed=7, noise=0.05)
+    solver = MaxSumSolver(arrays, damping=0.5, stability=0.0)
+
+    k = 10  # cycles per jitted call
+
+    @jax.jit
+    def run_k(s):
+        return jax.lax.fori_loop(0, k, lambda i, st: solver.step(st), s)
+
+    state = solver.init_state(jax.random.PRNGKey(0))
+    # warm-up / compile
+    state = run_k(state)
+    jax.block_until_ready(state["selection"])
+
+    state = solver.init_state(jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    cycles = 0
+    while cycles < MEASURE_CYCLES:
+        state = run_k(state)
+        cycles += k
+    jax.block_until_ready(state["selection"])
+    elapsed = time.perf_counter() - t0
+
+    sel = np.asarray(jax.device_get(state["selection"]))
+    b = arrays.buckets[0]
+    n_conflicts = int(np.sum(sel[b.var_ids[:, 0]] == sel[b.var_ids[:, 1]]))
+    msgs = 2 * arrays.n_edges * cycles
+    return msgs / elapsed, elapsed, cycles, n_conflicts
+
+
+def cpu_baseline():
+    sys.path.insert(0, "benchmarks")
+    from cpu_baseline import run_maxsum_baseline
+
+    from pydcop_tpu.generators.fast import random_graph_edges
+
+    rng = np.random.default_rng(7)
+    edges = random_graph_edges(BASELINE_VARS, BASELINE_EDGES, seed=7)
+    var_costs = rng.uniform(0, 0.05, size=(BASELINE_VARS, N_COLORS))
+    msgs, elapsed = run_maxsum_baseline(
+        edges.tolist(), BASELINE_VARS, N_COLORS, var_costs,
+        duration=BASELINE_SECONDS)
+    return msgs / elapsed
+
+
+def main():
+    tpu_msgs_per_sec, elapsed, cycles, n_conflicts = tpu_run()
+    cpu_msgs_per_sec = cpu_baseline()
+    vs = tpu_msgs_per_sec / cpu_msgs_per_sec if cpu_msgs_per_sec else 0.0
+    print(json.dumps({
+        "metric": "maxsum_msgs_per_sec_10kvar_coloring",
+        "value": round(tpu_msgs_per_sec, 1),
+        "unit": "msgs/s",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Pure-Python threaded MaxSum baseline, reference-architecture style.
+
+Faithful to the reference's execution model (SURVEY.md §3.3): one thread
+per agent, each agent hosting computations, messages delivered through
+synchronized per-agent queues, factor updates brute-forcing the joint
+assignment space per neighbor in Python (maxsum.py:382-447).  Used by
+bench.py to measure the msgs/sec the reference-style runtime achieves on
+the same problem, for the vs_baseline ratio.
+
+This is a re-implementation of the *architecture*, not a copy: agents,
+queue delivery, per-message handler dispatch, per-neighbor min-marginal
+loops.
+"""
+
+import itertools
+import queue
+import threading
+import time
+from collections import defaultdict
+
+
+class Agent(threading.Thread):
+    def __init__(self, name, network):
+        super().__init__(daemon=True)
+        self.name = name
+        self.inbox = queue.PriorityQueue()
+        self.network = network
+        self.computations = {}
+        self.running = True
+        self.seq = 0
+        self.handled = 0
+
+    def post(self, dest_comp, msg):
+        self.network.deliver(dest_comp, msg)
+
+    def run(self):
+        while self.running:
+            try:
+                _, _, (dest, msg) = self.inbox.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            comp = self.computations.get(dest)
+            if comp is not None:
+                comp.on_message(msg)
+                self.handled += 1
+
+
+class Network:
+    def __init__(self):
+        self.location = {}
+        self.agents = {}
+        self.msg_count = 0
+        self.lock = threading.Lock()
+
+    def register(self, comp_name, agent):
+        self.location[comp_name] = agent
+
+    def deliver(self, dest_comp, msg):
+        agent = self.location[dest_comp]
+        with self.lock:
+            self.msg_count += 1
+            agent.seq += 1
+            seq = agent.seq
+        agent.inbox.put((20, seq, (dest_comp, msg)))
+
+
+class VariableComputation:
+    def __init__(self, name, domain_size, unary, factors, agent):
+        self.name = name
+        self.D = domain_size
+        self.unary = unary
+        self.factors = factors
+        self.agent = agent
+        self.received = {}
+        self.cycle_msgs = defaultdict(dict)
+
+    def start(self):
+        for f in self.factors:
+            self.agent.post(f, ("var", self.name, 0, [0.0] * self.D))
+
+    def on_message(self, msg):
+        kind, sender, cycle, costs = msg
+        self.received[sender] = costs
+        if len(self.received) >= len(self.factors):
+            # send next-cycle messages: sum of other factors' costs
+            for f in self.factors:
+                out = list(self.unary)
+                for f2, c in self.received.items():
+                    if f2 != f:
+                        for d in range(self.D):
+                            out[d] += c[d]
+                avg = sum(out) / self.D
+                out = [v - avg for v in out]
+                self.agent.post(f, ("var", self.name, cycle + 1, out))
+            self.received = {}
+
+
+class FactorComputation:
+    def __init__(self, name, variables, domain_size, table, agent):
+        self.name = name
+        self.variables = variables
+        self.D = domain_size
+        self.table = table  # dict assignment-tuple -> cost
+        self.agent = agent
+        self.received = {}
+
+    def on_message(self, msg):
+        kind, sender, cycle, costs = msg
+        self.received[sender] = costs
+        if len(self.received) >= len(self.variables):
+            # per neighbor: min-marginal over the full joint space
+            # (reference maxsum.py:382-447 brute-force)
+            for i, v in enumerate(self.variables):
+                out = [float("inf")] * self.D
+                others = [v2 for v2 in self.variables if v2 != v]
+                for assignment in itertools.product(
+                        range(self.D), repeat=len(others)):
+                    for d in range(self.D):
+                        full = list(assignment)
+                        full.insert(i, d)
+                        c = self.table[tuple(full)]
+                        for j, v2 in enumerate(others):
+                            c += self.received[v2][assignment[j]]
+                        if c < out[d]:
+                            out[d] = c
+                self.agent.post(v, ("factor", self.name, cycle, out))
+            self.received = {}
+
+
+def run_maxsum_baseline(edges, n_vars, n_colors, var_costs,
+                        duration: float = 5.0, n_agents: int = 8):
+    """Run the threaded baseline for ``duration`` seconds; returns
+    (msgs_delivered, elapsed)."""
+    network = Network()
+    agents = [Agent(f"a{i}", network) for i in range(n_agents)]
+
+    factors_of = defaultdict(list)
+    table = {}
+    for d1 in range(n_colors):
+        for d2 in range(n_colors):
+            table[(d1, d2)] = 1.0 if d1 == d2 else 0.0
+
+    comps = []
+    for f, (u, v) in enumerate(edges):
+        name = f"c{f}"
+        agent = agents[f % n_agents]
+        comp = FactorComputation(
+            name, [f"v{u}", f"v{v}"], n_colors, table, agent)
+        agent.computations[name] = comp
+        network.register(name, agent)
+        factors_of[u].append(name)
+        factors_of[v].append(name)
+        comps.append(comp)
+    var_comps = []
+    for i in range(n_vars):
+        name = f"v{i}"
+        agent = agents[i % n_agents]
+        comp = VariableComputation(
+            name, n_colors, list(var_costs[i]), factors_of[i], agent)
+        agent.computations[name] = comp
+        network.register(name, agent)
+        var_comps.append(comp)
+
+    for a in agents:
+        a.start()
+    t0 = time.perf_counter()
+    for vc in var_comps:
+        vc.start()
+    time.sleep(duration)
+    elapsed = time.perf_counter() - t0
+    msgs = network.msg_count
+    for a in agents:
+        a.running = False
+    for a in agents:
+        a.join(timeout=1)
+    return msgs, elapsed

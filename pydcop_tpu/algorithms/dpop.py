@@ -85,10 +85,11 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
     # --- UTIL phase: deepest level first -----------------------------------
     for level in reversed(levels):
-        if out_of_time():
-            return RunResult({}, 0, False, float("inf"), 0,
-                             time.perf_counter() - t0, status="TIMEOUT")
         for node in level:
+            if out_of_time():
+                return RunResult({}, 0, False, float("inf"), 0,
+                                 time.perf_counter() - t0,
+                                 status="TIMEOUT")
             rel = NAryMatrixRelation([node.variable],
                                      name=f"util_{node.name}")
             if node.name in var_cost_rel:

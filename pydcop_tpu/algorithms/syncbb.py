@@ -104,9 +104,11 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
     push(0, 0.0)
     msg_count = 0
+    outer_iter = 0
     status = "FINISHED"
     while stack:
-        if timeout is not None and msg_count % 1024 == 0 \
+        outer_iter += 1
+        if timeout is not None and outer_iter % 1024 == 0 \
                 and time.perf_counter() - t0 > timeout:
             status = "TIMEOUT"  # anytime: keep the best found so far
             break

@@ -44,15 +44,17 @@ def distribute(computation_graph, agentsdef: Iterable, hints=None,
                     host(a.name, nodes[c])
 
     # 2. remaining computations, biggest footprint first, preferring an
-    # agent that hosts a neighbor and has capacity
+    # agent that hosts a host_with partner, then one hosting a neighbor
     remaining = sorted(
         (n for n in computation_graph.nodes if n.name not in placed),
         key=lambda n: -footprint(n),
     )
     for node in remaining:
+        partners = hints.host_with(node.name) if hints is not None else []
         candidates = sorted(
             agents,
             key=lambda a: (
+                -sum(1 for p in partners if placed.get(p) == a.name),
                 -sum(1 for nb in node.neighbors
                      if placed.get(nb) == a.name),
                 -capacity[a.name],

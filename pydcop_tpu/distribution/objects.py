@@ -117,14 +117,20 @@ def link_pair_loads(computation_graph, communication_load=None
     return loads
 
 
+RATIO_HOST_COMM = 0.8
+
+
 def distribution_cost(distribution: Distribution, computation_graph,
                       agentsdef: Iterable, computation_memory=None,
-                      communication_load=None):
-    """Cost of a distribution: communication (load × route, each link
-    counted once) + hosting costs (reference: the ``distribution_cost``
-    functions of ilp_compref/heur_comhost).
+                      communication_load=None,
+                      ratio_host_comm: float = RATIO_HOST_COMM):
+    """Cost of a distribution: ``ratio·communication + (1-ratio)·hosting``
+    — the same weighting the ILP objective minimizes (reference
+    ilp_compref.py:135), so "optimal" means optimal under the reported
+    metric.
 
-    Returns (total, communication_part, hosting_part).
+    Returns (total, communication_part, hosting_part); the parts are
+    unweighted.
     """
     agents = {a.name: a for a in agentsdef}
     comm = 0.0
@@ -140,4 +146,5 @@ def distribution_cost(distribution: Distribution, computation_graph,
     for c in distribution.computations:
         a = agents[distribution.agent_for(c)]
         hosting += a.hosting_cost(c)
-    return comm + hosting, comm, hosting
+    total = ratio_host_comm * comm + (1 - ratio_host_comm) * hosting
+    return total, comm, hosting

@@ -1,0 +1,170 @@
+"""Direct-to-arrays benchmark generators.
+
+For 10k+ variable problems the host-side object model (one python object
+per constraint) is itself the bottleneck; these generators emit
+:class:`FactorGraphArrays` / :class:`HypergraphArrays` directly from
+numpy, the TPU-native equivalent of the reference's YAML-emitting
+generators (pydcop/commands/generators/graphcoloring.py:238).
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..graphs.arrays import BIG, ConstraintBucket, FactorBucket, \
+    FactorGraphArrays, HypergraphArrays
+
+
+def random_graph_edges(n_vars: int, n_edges: int, seed: int = 0
+                       ) -> np.ndarray:
+    """(E, 2) distinct random undirected edges."""
+    rng = np.random.default_rng(seed)
+    seen = set()
+    out = []
+    while len(out) < n_edges:
+        draw = rng.integers(0, n_vars, size=(n_edges, 2))
+        for a, b in draw:
+            if a == b:
+                continue
+            key = (min(a, b), max(a, b))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(key)
+            if len(out) == n_edges:
+                break
+    return np.array(out, dtype=np.int32)
+
+
+def coloring_factor_arrays(n_vars: int, n_edges: int, n_colors: int = 3,
+                           seed: int = 0, noise: float = 0.05,
+                           conflict_cost: float = 1.0
+                           ) -> FactorGraphArrays:
+    """Random graph-coloring factor graph, arrays only.
+
+    Binary "different-color" soft constraints (cost ``conflict_cost`` on
+    equal colors) + small random unary costs for symmetry breaking (the
+    role VariableNoisyCostFunc plays in the reference's generator).
+    """
+    rng = np.random.default_rng(seed)
+    edges = random_graph_edges(n_vars, n_edges, seed)
+    D = n_colors
+    V, F = n_vars, n_edges
+
+    var_costs = rng.uniform(0, noise, size=(V, D)).astype(np.float32)
+    domain_size = np.full(V, D, dtype=np.int32)
+    domain_mask = np.ones((V, D), dtype=bool)
+
+    table = np.where(np.eye(D, dtype=bool), conflict_cost, 0.0
+                     ).astype(np.float32)
+    cubes = np.broadcast_to(table[None], (F, D, D)).copy()
+
+    edge_var = np.empty(2 * F, dtype=np.int32)
+    edge_factor = np.empty(2 * F, dtype=np.int32)
+    edge_ids = np.empty((F, 2), dtype=np.int32)
+    for p in range(2):
+        idx = np.arange(F) * 2 + p
+        edge_var[idx] = edges[:, p]
+        edge_factor[idx] = np.arange(F)
+        edge_ids[:, p] = idx
+
+    bucket = FactorBucket(
+        arity=2,
+        factor_ids=np.arange(F, dtype=np.int32),
+        cubes=cubes,
+        edge_ids=edge_ids,
+        var_ids=edges.copy(),
+    )
+    return FactorGraphArrays(
+        n_vars=V, n_factors=F, n_edges=2 * F, max_domain=D, sign=1.0,
+        var_names=[f"v{i}" for i in range(V)],
+        factor_names=[f"c{i}" for i in range(F)],
+        domain_size=domain_size, domain_mask=domain_mask,
+        var_costs=var_costs, edge_var=edge_var, edge_factor=edge_factor,
+        buckets=[bucket],
+    )
+
+
+def coloring_hypergraph_arrays(n_vars: int, n_edges: int,
+                               n_colors: int = 3, seed: int = 0,
+                               noise: float = 0.05,
+                               conflict_cost: float = 1.0
+                               ) -> HypergraphArrays:
+    """Same problem, hypergraph form (for the local-search family)."""
+    rng = np.random.default_rng(seed)
+    edges = random_graph_edges(n_vars, n_edges, seed)
+    D = n_colors
+    V, C = n_vars, n_edges
+    table = np.where(np.eye(D, dtype=bool), conflict_cost, 0.0
+                     ).astype(np.float32)
+    bucket = ConstraintBucket(
+        arity=2,
+        cons_ids=np.arange(C, dtype=np.int32),
+        cubes=np.broadcast_to(table[None], (C, D, D)).copy(),
+        var_ids=edges.copy(),
+    )
+    pairs = np.concatenate([edges, edges[:, ::-1]])
+    pairs = np.unique(pairs, axis=0)
+    degree = np.bincount(pairs[:, 0], minlength=V)
+    return HypergraphArrays(
+        n_vars=V, n_constraints=C, max_domain=D, sign=1.0,
+        var_names=[f"v{i}" for i in range(V)],
+        domain_size=np.full(V, D, dtype=np.int32),
+        domain_mask=np.ones((V, D), dtype=bool),
+        var_costs=rng.uniform(0, noise, size=(V, D)).astype(np.float32),
+        initial_idx=np.zeros(V, dtype=np.int32),
+        has_initial=np.zeros(V, dtype=bool),
+        buckets=[bucket],
+        nbr_src=pairs[:, 0].astype(np.int32),
+        nbr_dst=pairs[:, 1].astype(np.int32),
+        max_degree=int(degree.max()) if V else 0,
+        max_arity_minus_one=1,
+    )
+
+
+def ising_factor_arrays(rows: int, cols: int, seed: int = 0,
+                        coupling: float = 1.0, field: float = 0.1
+                        ) -> FactorGraphArrays:
+    """Random-coupling Ising grid (reference generator:
+    commands/generators/ising.py:213), arrays only: spins on a torus grid,
+    binary +-J couplings and random fields."""
+    rng = np.random.default_rng(seed)
+    V = rows * cols
+    edges = []
+    for r in range(rows):
+        for c in range(cols):
+            i = r * cols + c
+            edges.append((i, r * cols + (c + 1) % cols))
+            edges.append((i, ((r + 1) % rows) * cols + c))
+    edges = np.array(sorted(set(
+        (min(a, b), max(a, b)) for a, b in edges)), dtype=np.int32)
+    F = len(edges)
+    D = 2
+    j = rng.uniform(-coupling, coupling, size=F).astype(np.float32)
+    # cost(s1, s2) = J * s1 * s2 with s in {-1, +1}
+    spin = np.array([-1.0, 1.0], dtype=np.float32)
+    cubes = j[:, None, None] * spin[None, :, None] * spin[None, None, :]
+    h = rng.uniform(-field, field, size=V).astype(np.float32)
+    var_costs = h[:, None] * spin[None, :]
+
+    edge_var = np.empty(2 * F, dtype=np.int32)
+    edge_factor = np.empty(2 * F, dtype=np.int32)
+    edge_ids = np.empty((F, 2), dtype=np.int32)
+    for p in range(2):
+        idx = np.arange(F) * 2 + p
+        edge_var[idx] = edges[:, p]
+        edge_factor[idx] = np.arange(F)
+        edge_ids[:, p] = idx
+    bucket = FactorBucket(2, np.arange(F, dtype=np.int32),
+                          cubes.astype(np.float32), edge_ids,
+                          edges.copy())
+    return FactorGraphArrays(
+        n_vars=V, n_factors=F, n_edges=2 * F, max_domain=D, sign=1.0,
+        var_names=[f"s{i}" for i in range(V)],
+        factor_names=[f"j{i}" for i in range(F)],
+        domain_size=np.full(V, D, dtype=np.int32),
+        domain_mask=np.ones((V, D), dtype=bool),
+        var_costs=var_costs.astype(np.float32),
+        edge_var=edge_var, edge_factor=edge_factor,
+        buckets=[bucket],
+    )

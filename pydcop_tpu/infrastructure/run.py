@@ -16,11 +16,11 @@ from ..engine.solver import RunResult
 from ..engine.sync_engine import SyncEngine
 from ..graphs import load_graph_module
 
-DEFAULT_DISTRIBUTION = "adhoc"
+DEFAULT_DISTRIBUTION = "adhoc"  # used by the CLI; library default is None
 
 
 def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
-          distribution: Optional[str] = DEFAULT_DISTRIBUTION,
+          distribution: Optional[str] = None,
           timeout: Optional[float] = 5,
           max_cycles: int = 2000,
           seed: int = 0,
@@ -40,7 +40,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 
 
 def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
-                 distribution: Optional[str] = DEFAULT_DISTRIBUTION,
+                 distribution: Optional[str] = None,
                  timeout: Optional[float] = 5,
                  max_cycles: int = 2000,
                  seed: int = 0,
@@ -65,26 +65,17 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         # the distribution is the control-plane placement (and the
         # sharding spec); the data plane always runs the whole graph as
         # one compiled program (reference: run.py:108-124 builds the
-        # graph + distribution before deploying)
-        from ..distribution import (
-            ImpossibleDistributionException,
-            load_distribution_module,
-        )
+        # graph + distribution before deploying).  Only computed when the
+        # caller asks for one (default None: the engine doesn't need it).
+        from ..distribution import load_distribution_module
 
         graph = load_graph_module(
             algo_module.GRAPH_TYPE).build_computation_graph(dcop)
         dist_module = load_distribution_module(distribution)
-        try:
-            dist_obj = dist_module.distribute(
-                graph, dcop.agents_def, dcop.dist_hints,
-                algo_module.computation_memory,
-                algo_module.communication_load)
-        except ImpossibleDistributionException:
-            if distribution != DEFAULT_DISTRIBUTION:
-                raise
-            # the implicit default placement is metrics-only: an
-            # infeasible placement must not break the solve
-            dist_obj = None
+        dist_obj = dist_module.distribute(
+            graph, dcop.agents_def, dcop.dist_hints,
+            algo_module.computation_memory,
+            algo_module.communication_load)
     solver = algo_module.build_solver(dcop, algo_def.params)
     engine = SyncEngine(solver)
     result = engine.run(

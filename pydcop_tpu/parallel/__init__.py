@@ -1,0 +1,21 @@
+from .batch import BatchedMaxSum
+from .sharded_maxsum import ShardedMaxSum
+
+
+def make_mesh(n_devices: int = None, tp: int = None):
+    """Build a (dp, tp) mesh over the available devices.
+
+    Default: tp = 2 when at least 4 devices are available (factor-parallel
+    pairs), the rest data-parallel.
+    """
+    import jax
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if tp is None:
+        tp = 2 if n_devices >= 4 and n_devices % 2 == 0 else 1
+    dp = n_devices // tp
+    return jax.make_mesh((dp, tp), ("dp", "tp"))
+
+
+__all__ = ["BatchedMaxSum", "ShardedMaxSum", "make_mesh"]

@@ -1,0 +1,249 @@
+"""Multi-chip MaxSum: dp x tp sharded step over a jax.sharding.Mesh.
+
+This is the framework's "distributed communication backend" for the data
+plane (SURVEY.md §2.8): where the reference scales out by placing agent
+processes on machines and POSTing JSON messages over HTTP
+(pydcop/infrastructure/communication.py:313-441), the TPU framework
+shards the *stacked message arrays* over a device mesh:
+
+* ``dp`` (data-parallel) axis — independent problem instances (the batch
+  dimension of BASELINE config 5),
+* ``tp`` (tensor-parallel) axis — factors of one instance, partitioned
+  across devices; the variable update's segment-sum over incoming
+  messages becomes a per-device partial sum + ``psum`` over ``tp`` — the
+  XLA collective rides ICI, replacing the reference's network plane.
+
+The factor partition is computed host-side (round-robin per arity bucket,
+padded with inert dummy factors so every shard has identical static
+shapes); dummy edges point at a sink variable row which every reduction
+masks out.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..graphs.arrays import BIG, FactorGraphArrays
+from ..ops.kernels import factor_messages
+
+SAME_COUNT = 4
+
+
+@dataclass
+class _ShardedBucket:
+    arity: int
+    cubes: np.ndarray      # (TP, F, D, ..., D)
+    edge_ids: np.ndarray   # (TP, F, arity) — local edge ids
+    var_ids: np.ndarray    # (TP, F, arity) — global var ids (V = sink)
+
+
+def _partition(arrays: FactorGraphArrays, tp: int):
+    """Split factors across tp shards; every shard gets identical static
+    shapes (padded with dummy factors)."""
+    D = arrays.max_domain
+    V = arrays.n_vars
+    shard_buckets: List[_ShardedBucket] = []
+    # per-shard local edge counter
+    edge_count = [0] * tp
+    # collect (bucket, shard) -> list of (factor local slot data)
+    for b in arrays.buckets:
+        a = b.arity
+        n = b.cubes.shape[0]
+        groups = [list(range(g, n, tp)) for g in range(tp)]
+        fmax = max(len(g) for g in groups) if groups else 0
+        cubes = np.full((tp, fmax) + (D,) * a, BIG, dtype=np.float32)
+        edge_ids = np.zeros((tp, fmax, a), dtype=np.int32)
+        var_ids = np.full((tp, fmax, a), V, dtype=np.int32)
+        for g in range(tp):
+            for slot, fi in enumerate(groups[g]):
+                cubes[g, slot] = b.cubes[fi]
+                var_ids[g, slot] = b.var_ids[fi]
+            # assign local edge ids for every slot (incl. dummies)
+            for slot in range(fmax):
+                for p in range(a):
+                    edge_ids[g, slot, p] = edge_count[g]
+                    edge_count[g] += 1
+        shard_buckets.append(_ShardedBucket(a, cubes, edge_ids, var_ids))
+    e_loc = max(edge_count) if edge_count else 0
+    # edge_var per shard: (TP, E_loc)
+    edge_var = np.full((tp, e_loc), V, dtype=np.int32)
+    for sb in shard_buckets:
+        a = sb.arity
+        for g in range(tp):
+            for slot in range(sb.cubes.shape[1]):
+                for p in range(a):
+                    edge_var[g, sb.edge_ids[g, slot, p]] = \
+                        sb.var_ids[g, slot, p]
+    return shard_buckets, edge_var, e_loc
+
+
+class ShardedMaxSum:
+    """MaxSum over a (dp, tp) mesh.
+
+    ``cost_cubes_batch`` may carry a leading batch axis (B,) of
+    per-instance cost-table variations sharing the topology; B must be a
+    multiple of the mesh's dp size.
+    """
+
+    def __init__(self, arrays: FactorGraphArrays, mesh,
+                 damping: float = 0.5, batch: int = 1):
+        self.mesh = mesh
+        self.tp = mesh.shape["tp"]
+        self.dp = mesh.shape["dp"]
+        self.damping = float(damping)
+        self.V = arrays.n_vars
+        self.D = arrays.max_domain
+        if batch % self.dp != 0:
+            raise ValueError(
+                f"batch {batch} must be a multiple of dp={self.dp}")
+        self.B = batch
+
+        shard_buckets, edge_var, e_loc = _partition(arrays, self.tp)
+        self.E_loc = e_loc
+        self.buckets = shard_buckets
+        self.edge_var = edge_var                        # (TP, E_loc)
+
+        vc = np.concatenate(
+            [arrays.var_costs,
+             np.full((1, self.D), BIG, dtype=np.float32)])
+        self.var_costs = vc                             # (V+1, D)
+        dm = np.concatenate(
+            [arrays.domain_mask, np.zeros((1, self.D), dtype=bool)])
+        self.domain_mask = dm
+        ds = np.concatenate(
+            [arrays.domain_size, np.ones((1,), dtype=np.int32)])
+        self.domain_size = ds
+
+        self._build_step()
+
+    def _device_put(self):
+        """Shard the state and constants onto the mesh."""
+        from jax.sharding import NamedSharding
+
+        B, TP, E, D = self.B, self.tp, self.E_loc, self.D
+        mesh = self.mesh
+        mask_e = self.domain_mask[self.edge_var]        # (TP, E, D)
+        q0 = np.where(mask_e, 0.0, BIG).astype(np.float32)
+        q0 = np.broadcast_to(q0[None], (B, TP, E, D)).copy()
+        sh = NamedSharding(mesh, P("dp", "tp"))
+        q = jax.device_put(q0, sh)
+        r = jax.device_put(np.zeros((B, TP, E, D), dtype=np.float32), sh)
+        consts = {
+            "edge_var": jax.device_put(
+                self.edge_var, NamedSharding(mesh, P("tp"))),
+            "cubes": [
+                jax.device_put(sb.cubes, NamedSharding(mesh, P("tp")))
+                for sb in self.buckets
+            ],
+            "edge_ids": [
+                jax.device_put(sb.edge_ids, NamedSharding(mesh, P("tp")))
+                for sb in self.buckets
+            ],
+            "var_costs": jax.device_put(
+                jnp.asarray(self.var_costs),
+                NamedSharding(mesh, P())),
+            "domain_mask": jax.device_put(
+                jnp.asarray(self.domain_mask), NamedSharding(mesh, P())),
+            "domain_size": jax.device_put(
+                jnp.asarray(self.domain_size), NamedSharding(mesh, P())),
+        }
+        return q, r, consts
+
+    def _build_step(self):
+        V, D, E = self.V, self.D, self.E_loc
+        damping = self.damping
+        arities = [sb.arity for sb in self.buckets]
+
+        def local_step(q, r, edge_var, cubes, edge_ids, var_costs,
+                       domain_mask, domain_size):
+            # q, r: (B_loc, E, D); edge_var: (E,); cubes[i]: (F, D..)
+            def one(q1, r1):
+                new_r = jnp.zeros((E, D), dtype=q1.dtype)
+                for a, cu, ei in zip(arities, cubes, edge_ids):
+                    if a == 0:
+                        continue
+                    q_in = [q1[ei[:, p]] for p in range(a)]
+                    msgs = factor_messages(cu, q_in)
+                    for p in range(a):
+                        new_r = new_r.at[ei[:, p]].set(msgs[p])
+                partial_sum = jax.ops.segment_sum(
+                    new_r, edge_var, num_segments=V + 1)
+                sum_r = jax.lax.psum(partial_sum, "tp")
+                belief = var_costs + sum_r
+                q_new = belief[edge_var] - new_r
+                mask_e = domain_mask[edge_var]
+                mean = (jnp.sum(jnp.where(mask_e, q_new, 0.0), axis=1)
+                        / domain_size[edge_var])
+                q_new = q_new - mean[:, None]
+                q_new = damping * q1 + (1 - damping) * q_new
+                q_new = jnp.where(mask_e, q_new, BIG)
+                sel = jnp.argmin(
+                    jnp.where(domain_mask[:V], belief[:V], BIG * 2),
+                    axis=-1)
+                return q_new, new_r, sel
+
+            return jax.vmap(one)(q, r)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(
+                P("dp", "tp"), P("dp", "tp"), P("tp"),
+                [P("tp") for _ in self.buckets],
+                [P("tp") for _ in self.buckets],
+                P(), P(), P(),
+            ),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp")),
+        )
+        def sharded(q, r, edge_var, cubes, edge_ids, var_costs,
+                    domain_mask, domain_size):
+            # local blocks: q (B_loc, 1, E, D); squeeze the tp axis
+            q_l = q[:, 0]
+            r_l = r[:, 0]
+            cubes_l = [c[0] for c in cubes]
+            eids_l = [e[0] for e in edge_ids]
+            q2, r2, sel = local_step(
+                q_l, r_l, edge_var[0], cubes_l, eids_l,
+                var_costs, domain_mask, domain_size)
+            return q2[:, None], r2[:, None], sel
+
+        self._step = jax.jit(sharded)
+
+    def run(self, n_cycles: int, tol: float = 1e-2
+            ) -> Tuple[np.ndarray, int]:
+        """Run up to ``n_cycles``, returning ((B, V) selections, cycles)."""
+        q, r, consts = self._device_put()
+        args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
+                consts["var_costs"], consts["domain_mask"],
+                consts["domain_size"])
+        prev_sel = None
+        same = 0
+        cycle = 0
+        sel = None
+        while cycle < n_cycles:
+            q, r, sel = self._step(q, r, *args)
+            cycle += 1
+            if cycle % 8 == 0 or cycle == n_cycles:
+                sel_h = np.asarray(jax.device_get(sel))
+                if prev_sel is not None and np.array_equal(sel_h, prev_sel):
+                    same += 1
+                    if same >= SAME_COUNT:
+                        break
+                else:
+                    same = 0
+                prev_sel = sel_h
+        return np.asarray(jax.device_get(sel)), cycle
+
+    def step_once(self):
+        """One sharded step (for compile-checking the multi-chip path)."""
+        q, r, consts = self._device_put()
+        args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
+                consts["var_costs"], consts["domain_mask"],
+                consts["domain_size"])
+        q, r, sel = self._step(q, r, *args)
+        jax.block_until_ready(sel)
+        return np.asarray(jax.device_get(sel))

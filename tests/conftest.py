@@ -2,14 +2,19 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path).  Must be set before jax is imported anywhere.
+multi-chip path).  The axon TPU plugin ignores the JAX_PLATFORMS env var,
+so the platform is forced via jax.config as well — before any jax use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
