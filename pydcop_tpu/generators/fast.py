@@ -18,6 +18,12 @@ from ..graphs.arrays import BIG, ConstraintBucket, FactorBucket, \
 def random_graph_edges(n_vars: int, n_edges: int, seed: int = 0
                        ) -> np.ndarray:
     """(E, 2) distinct random undirected edges."""
+    max_edges = n_vars * (n_vars - 1) // 2
+    if n_edges > max_edges:
+        raise ValueError(
+            f"Cannot draw {n_edges} distinct edges from {n_vars} "
+            f"vertices (max {max_edges})"
+        )
     rng = np.random.default_rng(seed)
     seen = set()
     out = []

@@ -1,0 +1,67 @@
+"""Process-local event bus for observability.
+
+reference parity: pydcop/infrastructure/Events.py:41-104.  Topics use
+dotted paths with a trailing ``*`` wildcard on subscriptions.  Disabled by
+default, exactly like the reference (:47) — enabling it adds host-side
+callbacks only; the compiled data plane is unaffected.
+
+Topics emitted by this framework:
+``computations.value.<name>``, ``computations.cycle.<name>``,
+``computations.message_rcv.<name>``, ``computations.message_snd.<name>``,
+``agents.add_computation.<agent>``, ``engine.chunk.<algo>``.
+"""
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List
+
+logger = logging.getLogger("pydcop_tpu.events")
+
+
+class EventDispatcher:
+    """Topic-based pub/sub with suffix-wildcard subscriptions
+    (reference: Events.py:41-97)."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._subscriptions: Dict[str, Dict[str, Callable]] = {}
+        self._lock = threading.Lock()
+
+    def send(self, topic: str, evt: Any):
+        if not self.enabled:
+            return
+        with self._lock:
+            targets: List[Callable] = []
+            for sub_topic, cbs in self._subscriptions.items():
+                if sub_topic.endswith("*"):
+                    if topic.startswith(sub_topic[:-1]):
+                        targets.extend(cbs.values())
+                elif sub_topic == topic:
+                    targets.extend(cbs.values())
+        for cb in targets:
+            try:
+                cb(topic, evt)
+            except Exception:  # noqa: BLE001 - observers must not break runs
+                logger.exception("Event callback failed for %s", topic)
+
+    def subscribe(self, topic: str, cb: Callable, sub_id: str = None):
+        """Subscribe ``cb`` to ``topic`` (suffix ``*`` = prefix match).
+        Returns the subscription id used for unsubscribing."""
+        sub_id = sub_id or f"{id(cb)}"
+        with self._lock:
+            self._subscriptions.setdefault(topic, {})[sub_id] = cb
+        return sub_id
+
+    def unsubscribe(self, sub_id: str, topic: str = None):
+        with self._lock:
+            topics = [topic] if topic else list(self._subscriptions)
+            for t in topics:
+                self._subscriptions.get(t, {}).pop(sub_id, None)
+
+    def reset(self):
+        with self._lock:
+            self._subscriptions = {}
+
+
+#: global process-local bus, disabled by default (reference: Events.py:98)
+event_bus = EventDispatcher(enabled=False)

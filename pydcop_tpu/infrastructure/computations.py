@@ -1,0 +1,525 @@
+"""Control-plane message-passing computations.
+
+reference parity: pydcop/infrastructure/computations.py:53-1165.
+
+TPU-first split: in the reference *everything* — algorithm math included —
+runs as message-passing computations on agent threads.  Here the data
+plane (algorithm math) is compiled: one jitted step = one synchronous
+round over the whole graph, and "messages" are array rows (see
+``engine/sync_engine.py``).  Message-passing computations remain the
+*control plane*: orchestration commands, the discovery directory, the
+repair / replication protocols, value-change reporting, and
+tutorial-style algorithms (``dsatuto``).  The classes below therefore keep
+the reference's lifecycle semantics (start / pause with buffering /
+stop), its ``@register`` handler registration and its synchronous-round
+mixin, but are only ever exercised host-side.
+"""
+
+import logging
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.simple_repr import SimpleRepr, from_repr, simple_repr
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.computations")
+
+
+class Message(SimpleRepr):
+    """Base class for all control-plane messages
+    (reference: infrastructure/computations.py:53-121)."""
+
+    def __init__(self, msg_type: str, content: Any = None):
+        self._msg_type = msg_type
+        self._content = content
+
+    @property
+    def type(self) -> str:
+        return self._msg_type
+
+    @property
+    def content(self) -> Any:
+        return self._content
+
+    @property
+    def size(self) -> int:
+        return 1
+
+    def __eq__(self, o):
+        return (
+            isinstance(o, Message)
+            and self.type == o.type
+            and self.content == o.content
+        )
+
+    def __repr__(self):
+        return f"Message({self._msg_type}, {self._content})"
+
+
+def message_type(msg_type: str, fields: List[str]):
+    """Build a lightweight message class with named fields
+    (reference: infrastructure/computations.py:122-190).
+
+    >>> MyMsg = message_type('my_msg', ['a', 'b'])
+    >>> m = MyMsg(1, 2)
+    >>> m.a, m.b, m.type
+    (1, 2, 'my_msg')
+    """
+
+    def __init__(self, *args, **kwargs):
+        names = list(fields)
+        if len(args) > len(names):
+            raise ValueError(
+                f"Too many positional arguments for {msg_type}: {args}"
+            )
+        values = dict(zip(names, args))
+        for k, v in kwargs.items():
+            if k not in names:
+                raise ValueError(
+                    f"Unknown field {k!r} for message type {msg_type}"
+                )
+            if k in values:
+                raise ValueError(f"Duplicate value for field {k!r}")
+            values[k] = v
+        for name in names:
+            setattr(self, "_" + name, values.get(name))
+        Message.__init__(self, msg_type, None)
+
+    def _content_prop(self):
+        return {f: getattr(self, "_" + f) for f in fields}
+
+    def _str(self):
+        vals = ", ".join(f"{f}={getattr(self, '_' + f)!r}" for f in fields)
+        return f"{msg_type}({vals})"
+
+    def _simple_repr_impl(self):
+        # the generated __init__ is var-args, so the signature-driven
+        # SimpleRepr walk can't see the fields; emit them explicitly
+        from ..utils.simple_repr import (
+            SIMPLE_REPR_CLASS_KEY, SIMPLE_REPR_MODULE_KEY, simple_repr,
+        )
+
+        r = {
+            SIMPLE_REPR_CLASS_KEY: type(self).__qualname__,
+            SIMPLE_REPR_MODULE_KEY: type(self).__module__,
+        }
+        for f in fields:
+            r[f] = simple_repr(getattr(self, "_" + f))
+        return r
+
+    attrs = {
+        "__init__": __init__,
+        "__repr__": _str,
+        "__str__": _str,
+        "_simple_repr": _simple_repr_impl,
+        "content": property(_content_prop),
+    }
+    for f in fields:
+        attrs[f] = property(lambda self, _f=f: getattr(self, "_" + _f))
+    cls = type(msg_type, (Message,), attrs)
+    return cls
+
+
+def register(msg_type: str):
+    """Decorator registering a method as the handler for one message type
+    (reference: infrastructure/computations.py:576-632)."""
+
+    def decorate(handler: Callable):
+        handler._registered_handler = msg_type
+        return handler
+
+    return decorate
+
+
+class ComputationMetaClass(type):
+    """Collects ``@register``-decorated handlers into
+    ``cls._decorated_handlers`` (reference: computations.py:237-260)."""
+
+    def __new__(mcs, name, bases, namespace):
+        cls = super().__new__(mcs, name, bases, namespace)
+        handlers: Dict[str, Callable] = {}
+        for base in bases:
+            handlers.update(getattr(base, "_decorated_handlers", {}))
+        for attr in namespace.values():
+            msg_type = getattr(attr, "_registered_handler", None)
+            if msg_type is not None:
+                handlers[msg_type] = attr
+        cls._decorated_handlers = handlers
+        return cls
+
+
+class ComputationException(Exception):
+    pass
+
+
+class MessagePassingComputation(metaclass=ComputationMetaClass):
+    """A named computation exchanging messages on the control plane
+    (reference: infrastructure/computations.py:261-573).
+
+    Lifecycle: created -> started -> (paused <-> running) -> stopped.
+    Messages received while paused are buffered and delivered on resume;
+    messages posted while paused are buffered and sent on resume
+    (reference: computations.py:400-446).
+    """
+
+    def __init__(self, name: str):
+        self._name = name
+        self._msg_sender: Optional[Callable] = None
+        self._periodic_action_handler = None
+        self._running = False
+        self._is_paused = False
+        self._paused_messages_post: List[Tuple] = []
+        self._paused_messages_recv: List[Tuple] = []
+        self.logger = logging.getLogger(f"pydcop_tpu.comp.{name}")
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def is_paused(self) -> bool:
+        return self._is_paused
+
+    @property
+    def message_sender(self) -> Optional[Callable]:
+        return self._msg_sender
+
+    @message_sender.setter
+    def message_sender(self, sender: Callable):
+        if self._msg_sender is not None and sender is not self._msg_sender:
+            raise ComputationException(
+                f"Can only set message sender once on {self.name}"
+            )
+        self._msg_sender = sender
+
+    def footprint(self) -> float:
+        """Memory footprint used by the distribution layer."""
+        return 1.0
+
+    def start(self):
+        self._running = True
+        self.on_start()
+
+    def stop(self):
+        self.on_stop()
+        self._running = False
+
+    def pause(self, is_paused: bool = True):
+        """Pause or resume; on resume, buffered messages are flushed
+        (reference: computations.py:400-446)."""
+        changed = self._is_paused != is_paused
+        self._is_paused = is_paused
+        if changed and not is_paused:
+            waiting_msg = self._paused_messages_recv
+            self._paused_messages_recv = []
+            for sender, msg, t in waiting_msg:
+                self.on_message(sender, msg, t)
+            to_post = self._paused_messages_post
+            self._paused_messages_post = []
+            for target, msg, prio, on_error in to_post:
+                self.post_msg(target, msg, prio, on_error)
+            self.on_resume()
+        elif changed and is_paused:
+            self.on_pause()
+
+    # hooks for subclasses
+    def on_start(self):
+        pass
+
+    def on_stop(self):
+        pass
+
+    def on_pause(self):
+        pass
+
+    def on_resume(self):
+        pass
+
+    def on_message(self, sender: str, msg: Message, t: float):
+        """Dispatch an incoming message to its registered handler."""
+        if self._is_paused:
+            self._paused_messages_recv.append((sender, msg, t))
+            return
+        try:
+            handler = self._decorated_handlers[msg.type]
+        except KeyError:
+            raise ComputationException(
+                f"No handler for message type {msg.type!r} on "
+                f"{self.name} ({type(self).__name__})"
+            )
+        handler(self, sender, msg, t)
+
+    def post_msg(self, target: str, msg: Message, prio: int = None,
+                 on_error=None):
+        """Send a message to another computation by name."""
+        if self._is_paused:
+            self._paused_messages_post.append((target, msg, prio, on_error))
+            return
+        if self._msg_sender is None:
+            raise ComputationException(
+                f"Cannot post message from {self.name}: no message sender"
+            )
+        self._msg_sender(self.name, target, msg, prio, on_error)
+
+    def add_periodic_action(self, period: float, cb: Callable):
+        """Register ``cb`` to run every ``period`` seconds while running
+        (wired to the agent's timer wheel — reference agents.py:743-852)."""
+        if self._periodic_action_handler is None:
+            raise ComputationException(
+                f"{self.name} is not attached to an agent; cannot add "
+                "periodic actions"
+            )
+        return self._periodic_action_handler(period, cb)
+
+    def finished(self):
+        """Signal the hosting agent that this computation is done; wrapped
+        by the agent (reference: agents.py:870-876)."""
+        pass
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class SynchronizationMsg(Message):
+    """Empty message carrying only cycle alignment
+    (reference: computations.py:614-632)."""
+
+    def __init__(self):
+        super().__init__("cycle_sync", None)
+
+    def __repr__(self):
+        return "SynchronizationMsg()"
+
+
+class SynchronousComputationMixin:
+    """Synchronous-rounds network model on top of the async control plane
+    (reference: infrastructure/computations.py:633-829).
+
+    Tags every outgoing message with a cycle id, auto-sends
+    ``SynchronizationMsg`` to neighbors not messaged this round, buffers
+    next-cycle messages, and fires ``on_new_cycle(messages, cycle_id)``
+    once all neighbors' current-round messages have arrived.
+
+    On the TPU data plane this barrier is *free* — a jitted step is the
+    barrier — so this mixin only serves control-plane protocols (e.g. the
+    repair computations) and tutorial algorithms.
+    """
+
+    _sync_initialized = False
+
+    def _init_sync(self):
+        self._current_cycle = 0
+        self._cycle_messages: Dict[str, Tuple[Message, float]] = {}
+        self._next_cycle_messages: Dict[str, Tuple[Message, float]] = {}
+        self._sent_this_cycle: set = set()
+        self._sync_initialized = True
+
+    @property
+    def cycle_count(self) -> int:
+        if not self._sync_initialized:
+            self._init_sync()
+        return self._current_cycle
+
+    @property
+    def neighbors(self) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError()
+
+    def start_cycle(self):
+        """Called by subclasses from on_start to open cycle 0."""
+        if not self._sync_initialized:
+            self._init_sync()
+
+    def on_message(self, sender: str, msg: Message, t: float):
+        if not self._sync_initialized:
+            self._init_sync()
+        if getattr(self, "_is_paused", False):
+            self._paused_messages_recv.append((sender, msg, t))
+            return
+        cycle_id = getattr(msg, "_cycle_id", self._current_cycle)
+        if cycle_id == self._current_cycle:
+            self._cycle_messages[sender] = (msg, t)
+        elif cycle_id == self._current_cycle + 1:
+            self._next_cycle_messages[sender] = (msg, t)
+        else:
+            raise ComputationException(
+                f"Out-of-sync message from {sender} on {self.name}: "
+                f"cycle {cycle_id}, current {self._current_cycle}"
+            )
+        self._maybe_end_cycle()
+
+    def post_msg(self, target: str, msg: Message, prio: int = None,
+                 on_error=None):
+        if not self._sync_initialized:
+            self._init_sync()
+        msg._cycle_id = self._current_cycle
+        self._sent_this_cycle.add(target)
+        super().post_msg(target, msg, prio, on_error)
+
+    def _maybe_end_cycle(self):
+        missing = set(self.neighbors) - set(self._cycle_messages)
+        if missing:
+            return
+        # close the round: sync any neighbor we did not message
+        for n in set(self.neighbors) - self._sent_this_cycle:
+            sync = SynchronizationMsg()
+            sync._cycle_id = self._current_cycle
+            super().post_msg(n, sync)
+        messages = {
+            s: (m, t)
+            for s, (m, t) in self._cycle_messages.items()
+            if not isinstance(m, SynchronizationMsg)
+        }
+        cycle_id = self._current_cycle
+        self._current_cycle += 1
+        self._cycle_messages = self._next_cycle_messages
+        self._next_cycle_messages = {}
+        self._sent_this_cycle = set()
+        self.on_new_cycle(messages, cycle_id)
+        # messages for the new cycle may already all be there
+        if set(self.neighbors) <= set(self._cycle_messages):
+            self._maybe_end_cycle()
+
+    def on_new_cycle(self, messages: Dict[str, Tuple[Message, float]],
+                     cycle_id: int):  # pragma: no cover - abstract
+        raise NotImplementedError()
+
+
+class DcopComputation(MessagePassingComputation):
+    """A computation attached to a node of a computation graph
+    (reference: infrastructure/computations.py:832-966)."""
+
+    def __init__(self, name: str, comp_def):
+        super().__init__(name)
+        self.computation_def = comp_def
+        self._cycle_count = 0
+
+    @property
+    def neighbors(self) -> List[str]:
+        return list(self.computation_def.node.neighbors)
+
+    @property
+    def cycle_count(self) -> int:
+        return self._cycle_count
+
+    def new_cycle(self):
+        """Increment the cycle counter; fires the agent's cycle hook."""
+        self._cycle_count += 1
+        self._on_new_cycle(self._cycle_count)
+
+    def _on_new_cycle(self, count: int):
+        """Hook wrapped by the hosting agent for cycle metrics."""
+        pass
+
+    def post_to_all_neighbors(self, msg: Message, prio: int = None,
+                              on_error=None):
+        for n in self.neighbors:
+            self.post_msg(n, msg, prio, on_error)
+
+    def footprint(self) -> float:
+        from ..algorithms import load_algorithm_module
+
+        algo = load_algorithm_module(self.computation_def.algo.algo)
+        return algo.computation_memory(self.computation_def.node)
+
+
+class VariableComputation(DcopComputation):
+    """A computation responsible for selecting one variable's value
+    (reference: infrastructure/computations.py:967-1092)."""
+
+    def __init__(self, variable, comp_def):
+        super().__init__(variable.name, comp_def)
+        self._variable = variable
+        self.current_value = None
+        self.current_cost = None
+        self._previous_val = None
+
+    @property
+    def variable(self):
+        return self._variable
+
+    def value_selection(self, val, cost: float = 0.0):
+        """Select a value for the variable; fires the agent's
+        value-selection hook when the value changes
+        (reference: computations.py:1058-1079)."""
+        if val != self._previous_val:
+            self.current_value = val
+            self._on_value_selection(val, cost, self._cycle_count)
+            self._previous_val = val
+        self.current_cost = cost
+
+    def random_value_selection(self):
+        """Select a random value from the domain
+        (reference: computations.py:1080-1092)."""
+        self.value_selection(random.choice(self._variable.domain.values))
+
+    def _on_value_selection(self, val, cost, cycle_count):
+        """Hook wrapped by the hosting agent for value metrics."""
+        pass
+
+
+class ExternalVariableComputation(DcopComputation):
+    """Passive computation publishing an external (sensor) variable's
+    value to subscribers (reference: computations.py:1093-1155)."""
+
+    def __init__(self, external_var, comp_def=None):
+        # external variables have no algorithm; fabricate a minimal node
+        if comp_def is None:
+            comp_def = _external_comp_def(external_var)
+        super().__init__(external_var.name, comp_def)
+        self._external_var = external_var.clone() \
+            if hasattr(external_var, "clone") else external_var
+        self._subscribers: set = set()
+        self._external_var.subscribe(self._on_variable_change)
+
+    @property
+    def current_value(self):
+        return self._external_var.value
+
+    @register("SUBSCRIBE")
+    def _on_subscribe_msg(self, sender, msg, t):
+        self._subscribers.add(sender)
+        self.post_msg(sender, Message("VARIABLE_VALUE",
+                                      self._external_var.value))
+
+    def _on_variable_change(self, value):
+        self._fire()
+
+    def change_value(self, value):
+        self._external_var.value = value
+
+    def _fire(self):
+        for s in self._subscribers:
+            self.post_msg(s, Message("VARIABLE_VALUE",
+                                     self._external_var.value))
+
+
+def _external_comp_def(external_var):
+    from ..algorithms import AlgorithmDef, ComputationDef
+    from ..graphs.objects import ComputationNode
+
+    node = ComputationNode(external_var.name, "external", links=[])
+    return ComputationDef(
+        node, AlgorithmDef("external", {}, "min"))
+
+
+def build_computation(comp_def) -> MessagePassingComputation:
+    """Build a control-plane computation instance from a ComputationDef
+    (reference: infrastructure/computations.py:1156-1165).
+
+    Only algorithms that expose ``build_computation`` support the
+    message-passing backend (tutorial / control-plane algorithms); the
+    compiled algorithms run through ``build_solver`` + the engine instead.
+    """
+    from ..algorithms import load_algorithm_module
+
+    algo_module = load_algorithm_module(comp_def.algo.algo)
+    if not hasattr(algo_module, "build_computation"):
+        raise ComputationException(
+            f"Algorithm {comp_def.algo.algo!r} has no message-passing "
+            "build_computation; it runs on the compiled engine "
+            "(build_solver)"
+        )
+    return algo_module.build_computation(comp_def)

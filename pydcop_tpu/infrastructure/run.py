@@ -16,7 +16,8 @@ from ..engine.solver import RunResult
 from ..engine.sync_engine import SyncEngine
 from ..graphs import load_graph_module
 
-DEFAULT_DISTRIBUTION = "adhoc"  # used by the CLI; library default is None
+DEFAULT_DISTRIBUTION = "adhoc"  # default for CLI-style entry points;
+# library calls default to distribution=None (engine needs none)
 
 
 def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],

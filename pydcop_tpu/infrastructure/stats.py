@@ -1,0 +1,78 @@
+"""Optional per-computation CSV step tracing.
+
+reference parity: pydcop/infrastructure/stats.py:49-103.  The reference
+traces every message-handling step of every computation (duration, message
+sizes, op counts, the *non-concurrent op count* — its wallclock-independent
+cost metric).  Here the data plane executes whole graph-rounds at once, so
+the natural trace unit is one engine cycle (or control-plane step); the
+``non_concurrent_ops`` column keeps the reference's meaning: the length of
+the longest sequential dependency chain, which for a synchronous round is
+``cycles`` (every node's update within a round is concurrent).
+"""
+
+import csv
+import logging
+import threading
+import time
+from typing import List, Optional
+
+COLUMNS = [
+    "time", "computation", "step", "duration", "msg_in_size",
+    "msg_out_size", "op_count", "non_concurrent_ops", "value",
+]
+
+_tracer: Optional["StatsTracer"] = None
+_lock = threading.Lock()
+
+
+class StatsTracer:
+    """Appends one CSV row per traced step
+    (reference: stats.py:49-103 writes via a dedicated logger)."""
+
+    def __init__(self, target_file: str):
+        self._file = open(target_file, "w", newline="")
+        self._writer = csv.writer(self._file)
+        self._writer.writerow(COLUMNS)
+        self._lock = threading.Lock()
+
+    def row(self, computation: str, step: int, duration: float,
+            msg_in_size: int = 0, msg_out_size: int = 0,
+            op_count: int = 0, non_concurrent_ops: int = 0,
+            value=None):
+        with self._lock:
+            self._writer.writerow([
+                f"{time.time():.6f}", computation, step,
+                f"{duration:.6f}", msg_in_size, msg_out_size, op_count,
+                non_concurrent_ops, value,
+            ])
+            self._file.flush()
+
+    def close(self):
+        with self._lock:
+            self._file.close()
+
+
+def setup_tracing(target_file: str) -> StatsTracer:
+    """Enable tracing globally; returns the tracer."""
+    global _tracer
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = StatsTracer(target_file)
+    return _tracer
+
+
+def teardown_tracing():
+    global _tracer
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+            _tracer = None
+
+
+def trace_computation(computation: str, step: int, duration: float,
+                      **kwargs):
+    """Trace one step if tracing is enabled
+    (reference: stats.py:81-103)."""
+    if _tracer is not None:
+        _tracer.row(computation, step, duration, **kwargs)

@@ -63,11 +63,17 @@ class BatchedMaxSum:
 
         self._one = one_instance
         self.max_cycles = 200
+        self._jitted = {}  # max_cycles -> compiled vmapped runner
 
     def run(self, seed: int = 0, max_cycles: int = 200):
         """Returns (selections (B, V), cycles (B,), finished (B,))."""
         self.max_cycles = max_cycles
         keys = jax.random.split(jax.random.PRNGKey(seed), self.B)
-        run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+        # max_cycles is baked into the traced while-loop via the closure,
+        # so the compiled runner is cached per max_cycles value
+        run_all = self._jitted.get(max_cycles)
+        if run_all is None:
+            run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+            self._jitted[max_cycles] = run_all
         sel, cycles, finished = run_all(self.solver_buckets_batched, keys)
         return (np.asarray(sel), np.asarray(cycles), np.asarray(finished))

@@ -132,7 +132,6 @@ class ShardedMaxSum:
         q0 = np.broadcast_to(q0[None], (B, TP, E, D)).copy()
         sh = NamedSharding(mesh, P("dp", "tp"))
         q = jax.device_put(q0, sh)
-        r = jax.device_put(np.zeros((B, TP, E, D), dtype=np.float32), sh)
         consts = {
             "edge_var": jax.device_put(
                 self.edge_var, NamedSharding(mesh, P("tp"))),
@@ -152,17 +151,20 @@ class ShardedMaxSum:
             "domain_size": jax.device_put(
                 jnp.asarray(self.domain_size), NamedSharding(mesh, P())),
         }
-        return q, r, consts
+        return q, consts
 
     def _build_step(self):
         V, D, E = self.V, self.D, self.E_loc
         damping = self.damping
         arities = [sb.arity for sb in self.buckets]
 
-        def local_step(q, r, edge_var, cubes, edge_ids, var_costs,
+        def local_step(q, edge_var, cubes, edge_ids, var_costs,
                        domain_mask, domain_size):
-            # q, r: (B_loc, E, D); edge_var: (E,); cubes[i]: (F, D..)
-            def one(q1, r1):
+            # q: (B_loc, E, D); edge_var: (E,); cubes[i]: (F, D..)
+            # factor->var messages (new_r) are recomputed from q each
+            # step, never carried: damping applies on the var->factor
+            # side only, matching the single-chip solver
+            def one(q1):
                 new_r = jnp.zeros((E, D), dtype=q1.dtype)
                 for a, cu, ei in zip(arities, cubes, edge_ids):
                     if a == 0:
@@ -185,38 +187,37 @@ class ShardedMaxSum:
                 sel = jnp.argmin(
                     jnp.where(domain_mask[:V], belief[:V], BIG * 2),
                     axis=-1)
-                return q_new, new_r, sel
+                return q_new, sel
 
-            return jax.vmap(one)(q, r)
+            return jax.vmap(one)(q)
 
         @partial(
             jax.shard_map, mesh=self.mesh,
             in_specs=(
-                P("dp", "tp"), P("dp", "tp"), P("tp"),
+                P("dp", "tp"), P("tp"),
                 [P("tp") for _ in self.buckets],
                 [P("tp") for _ in self.buckets],
                 P(), P(), P(),
             ),
-            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp")),
+            out_specs=(P("dp", "tp"), P("dp")),
         )
-        def sharded(q, r, edge_var, cubes, edge_ids, var_costs,
+        def sharded(q, edge_var, cubes, edge_ids, var_costs,
                     domain_mask, domain_size):
             # local blocks: q (B_loc, 1, E, D); squeeze the tp axis
             q_l = q[:, 0]
-            r_l = r[:, 0]
             cubes_l = [c[0] for c in cubes]
             eids_l = [e[0] for e in edge_ids]
-            q2, r2, sel = local_step(
-                q_l, r_l, edge_var[0], cubes_l, eids_l,
+            q2, sel = local_step(
+                q_l, edge_var[0], cubes_l, eids_l,
                 var_costs, domain_mask, domain_size)
-            return q2[:, None], r2[:, None], sel
+            return q2[:, None], sel
 
         self._step = jax.jit(sharded)
 
     def run(self, n_cycles: int, tol: float = 1e-2
             ) -> Tuple[np.ndarray, int]:
         """Run up to ``n_cycles``, returning ((B, V) selections, cycles)."""
-        q, r, consts = self._device_put()
+        q, consts = self._device_put()
         args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
                 consts["var_costs"], consts["domain_mask"],
                 consts["domain_size"])
@@ -225,7 +226,7 @@ class ShardedMaxSum:
         cycle = 0
         sel = None
         while cycle < n_cycles:
-            q, r, sel = self._step(q, r, *args)
+            q, sel = self._step(q, *args)
             cycle += 1
             if cycle % 8 == 0 or cycle == n_cycles:
                 sel_h = np.asarray(jax.device_get(sel))
@@ -240,10 +241,10 @@ class ShardedMaxSum:
 
     def step_once(self):
         """One sharded step (for compile-checking the multi-chip path)."""
-        q, r, consts = self._device_put()
+        q, consts = self._device_put()
         args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
                 consts["var_costs"], consts["domain_mask"],
                 consts["domain_size"])
-        q, r, sel = self._step(q, r, *args)
+        q, sel = self._step(q, *args)
         jax.block_until_ready(sel)
         return np.asarray(jax.device_get(sel))

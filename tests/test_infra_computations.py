@@ -1,0 +1,317 @@
+"""Tests for the control-plane message-passing layer.
+
+Mirrors the reference's test strategy (SURVEY.md §4): computations are
+driven synchronously by calling handlers directly, message senders are
+mocks — no threads, no real runtime.
+"""
+
+from unittest.mock import MagicMock
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+from pydcop_tpu.dcop.objects import Domain, ExternalVariable, Variable
+from pydcop_tpu.graphs.objects import ComputationNode, Link
+from pydcop_tpu.infrastructure.Events import EventDispatcher
+from pydcop_tpu.infrastructure import stats
+from pydcop_tpu.infrastructure.computations import (
+    ComputationException,
+    DcopComputation,
+    Message,
+    MessagePassingComputation,
+    SynchronizationMsg,
+    SynchronousComputationMixin,
+    VariableComputation,
+    ExternalVariableComputation,
+    message_type,
+    register,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+# ---------------------------------------------------------------- messages
+
+def test_message_type_factory():
+    MyMsg = message_type("my_msg", ["a", "b"])
+    m = MyMsg(1, b=2)
+    assert m.type == "my_msg"
+    assert m.a == 1 and m.b == 2
+    assert m.content == {"a": 1, "b": 2}
+
+
+def test_message_type_rejects_bad_fields():
+    MyMsg = message_type("my_msg", ["a"])
+    with pytest.raises(ValueError):
+        MyMsg(1, 2)
+    with pytest.raises(ValueError):
+        MyMsg(nope=3)
+    with pytest.raises(ValueError):
+        MyMsg(1, a=1)
+
+
+def test_message_simple_repr_roundtrip():
+    m = Message("test", {"x": 1})
+    r = simple_repr(m)
+    m2 = from_repr(r)
+    assert m == m2
+
+
+def test_message_type_simple_repr_roundtrip():
+    MyMsg = message_type("rt_msg", ["a", "b"])
+    # message_type classes are dynamic; register for from_repr lookup
+    import tests.test_infra_computations as this_mod
+
+    this_mod.rt_msg = MyMsg
+    MyMsg.__module__ = "tests.test_infra_computations"
+    MyMsg.__qualname__ = "rt_msg"
+    m = MyMsg(a=[1, 2], b="x")
+    m2 = from_repr(simple_repr(m))
+    assert m2.a == [1, 2] and m2.b == "x"
+
+
+# ------------------------------------------------------------ computations
+
+class PingComp(MessagePassingComputation):
+    def __init__(self, name):
+        super().__init__(name)
+        self.seen = []
+
+    @register("ping")
+    def on_ping(self, sender, msg, t):
+        self.seen.append((sender, msg))
+        self.post_msg(sender, Message("pong"))
+
+
+def test_handler_dispatch_and_post():
+    c = PingComp("c1")
+    sender = MagicMock()
+    c.message_sender = sender
+    c.start()
+    c.on_message("c2", Message("ping"), 0.0)
+    assert c.seen[0][0] == "c2"
+    sender.assert_called_once()
+    args = sender.call_args[0]
+    assert args[0] == "c1" and args[1] == "c2"
+    assert args[2].type == "pong"
+
+
+def test_unknown_message_raises():
+    c = PingComp("c1")
+    c.message_sender = MagicMock()
+    with pytest.raises(ComputationException):
+        c.on_message("x", Message("nope"), 0.0)
+
+
+def test_pause_buffers_received_and_posted():
+    c = PingComp("c1")
+    sender = MagicMock()
+    c.message_sender = sender
+    c.start()
+    c.pause(True)
+    c.on_message("c2", Message("ping"), 0.0)
+    assert c.seen == []  # buffered, not handled
+    sender.assert_not_called()
+    c.pause(False)
+    assert len(c.seen) == 1  # delivered on resume
+    sender.assert_called_once()
+
+
+def test_message_sender_set_once():
+    c = PingComp("c1")
+    c.message_sender = MagicMock()
+    with pytest.raises(ComputationException):
+        c.message_sender = MagicMock()
+
+
+# ------------------------------------------------------- synchronous mixin
+
+class SyncComp(SynchronousComputationMixin, MessagePassingComputation):
+    def __init__(self, name, neighbors):
+        super().__init__(name)
+        self._neighbors = neighbors
+        self.cycles = []
+
+    @property
+    def neighbors(self):
+        return self._neighbors
+
+    def on_new_cycle(self, messages, cycle_id):
+        self.cycles.append((cycle_id, dict(messages)))
+
+
+def test_sync_barrier_waits_for_all_neighbors():
+    c = SyncComp("a", ["b", "c"])
+    c.message_sender = MagicMock()
+    c.start()
+    m1 = Message("v")
+    m1._cycle_id = 0
+    c.on_message("b", m1, 0.0)
+    assert c.cycles == []  # still waiting for c
+    m2 = Message("v")
+    m2._cycle_id = 0
+    c.on_message("c", m2, 0.0)
+    assert len(c.cycles) == 1
+    cycle_id, messages = c.cycles[0]
+    assert cycle_id == 0
+    assert set(messages) == {"b", "c"}
+
+
+def test_sync_next_cycle_messages_buffered():
+    c = SyncComp("a", ["b"])
+    c.message_sender = MagicMock()
+    c.start()
+    m_next = Message("v")
+    m_next._cycle_id = 1
+    c.on_message("b", m_next, 0.0)  # early next-cycle message
+    assert c.cycles == []
+    m_cur = Message("v")
+    m_cur._cycle_id = 0
+    c.on_message("b", m_cur, 0.0)
+    # cycle 0 closes, and the buffered cycle-1 message closes cycle 1 too
+    assert [cid for cid, _ in c.cycles] == [0, 1]
+
+
+def test_sync_sends_sync_msgs_to_unmessaged_neighbors():
+    c = SyncComp("a", ["b"])
+    sender = MagicMock()
+    c.message_sender = sender
+    c.start()
+    m = Message("v")
+    m._cycle_id = 0
+    c.on_message("b", m, 0.0)
+    # we never posted to b this cycle -> a SynchronizationMsg went out
+    sync_sends = [
+        call for call in sender.call_args_list
+        if isinstance(call[0][2], SynchronizationMsg)
+    ]
+    assert len(sync_sends) == 1
+
+
+def test_sync_messages_filtered_from_cycle():
+    c = SyncComp("a", ["b", "c"])
+    c.message_sender = MagicMock()
+    c.start()
+    real = Message("v")
+    real._cycle_id = 0
+    sync = SynchronizationMsg()
+    sync._cycle_id = 0
+    c.on_message("b", real, 0.0)
+    c.on_message("c", sync, 0.0)
+    _, messages = c.cycles[0]
+    assert set(messages) == {"b"}  # sync msgs dropped from the payload
+
+
+def test_out_of_sync_raises():
+    c = SyncComp("a", ["b"])
+    c.message_sender = MagicMock()
+    c.start()
+    m = Message("v")
+    m._cycle_id = 5
+    with pytest.raises(ComputationException):
+        c.on_message("b", m, 0.0)
+
+
+# ------------------------------------------------- dcop-level computations
+
+def _comp_def(name="v1", neighbors=()):
+    links = [Link([name, n]) for n in neighbors]
+    node = ComputationNode(name, "test", links=links)
+    return ComputationDef(node, AlgorithmDef("dsatuto", {}, "min"))
+
+
+def test_dcop_computation_neighbors_and_cycle():
+    c = DcopComputation("v1", _comp_def("v1", ["v2", "v3"]))
+    assert set(c.neighbors) == {"v2", "v3"}
+    assert c.cycle_count == 0
+    c.new_cycle()
+    assert c.cycle_count == 1
+
+
+def test_post_to_all_neighbors():
+    c = DcopComputation("v1", _comp_def("v1", ["v2", "v3"]))
+    sender = MagicMock()
+    c.message_sender = sender
+    c.post_to_all_neighbors(Message("v"))
+    targets = {call[0][1] for call in sender.call_args_list}
+    assert targets == {"v2", "v3"}
+
+
+def test_variable_computation_value_selection_fires_once_per_change():
+    d = Domain("colors", "colors", ["R", "G"])
+    v = Variable("v1", d)
+    c = VariableComputation(v, _comp_def("v1"))
+    fired = []
+    c._on_value_selection = lambda val, cost, cyc: fired.append(val)
+    c.value_selection("R", 1.0)
+    c.value_selection("R", 2.0)  # same value: no new event
+    c.value_selection("G", 0.0)
+    assert fired == ["R", "G"]
+    assert c.current_value == "G"
+    assert c.current_cost == 0.0
+
+
+def test_random_value_selection():
+    d = Domain("colors", "colors", ["R", "G", "B"])
+    v = Variable("v1", d)
+    c = VariableComputation(v, _comp_def("v1"))
+    c.random_value_selection()
+    assert c.current_value in ["R", "G", "B"]
+
+
+def test_external_variable_computation_publishes():
+    d = Domain("temp", "temp", [18, 19, 20])
+    ev = ExternalVariable("sensor", d, value=18)
+    c = ExternalVariableComputation(ev)
+    sender = MagicMock()
+    c.message_sender = sender
+    c.on_message("sub1", Message("SUBSCRIBE"), 0.0)
+    # subscription answered with current value
+    assert sender.call_args[0][2].content == 18
+    c.change_value(20)
+    assert sender.call_args[0][2].content == 20
+
+
+# ------------------------------------------------------------- event bus
+
+def test_event_bus_exact_and_wildcard():
+    bus = EventDispatcher(enabled=True)
+    got = []
+    bus.subscribe("computations.value.v1", lambda t, e: got.append((t, e)))
+    bus.subscribe("computations.*", lambda t, e: got.append(("w", e)))
+    bus.send("computations.value.v1", 42)
+    assert ("computations.value.v1", 42) in got
+    assert ("w", 42) in got
+
+
+def test_event_bus_disabled_by_default():
+    bus = EventDispatcher()
+    got = []
+    bus.subscribe("x", lambda t, e: got.append(e))
+    bus.send("x", 1)
+    assert got == []
+
+
+def test_event_bus_unsubscribe():
+    bus = EventDispatcher(enabled=True)
+    got = []
+    sid = bus.subscribe("x", lambda t, e: got.append(e))
+    bus.unsubscribe(sid)
+    bus.send("x", 1)
+    assert got == []
+
+
+# ------------------------------------------------------------ stats trace
+
+def test_stats_tracing(tmp_path):
+    f = tmp_path / "trace.csv"
+    stats.setup_tracing(str(f))
+    stats.trace_computation("v1", 1, 0.5, op_count=10, value="R")
+    stats.teardown_tracing()
+    lines = f.read_text().strip().splitlines()
+    assert lines[0].startswith("time,computation,step")
+    assert "v1" in lines[1] and "R" in lines[1]
+
+
+def test_stats_disabled_noop(tmp_path):
+    stats.teardown_tracing()
+    stats.trace_computation("v1", 1, 0.5)  # must not raise
