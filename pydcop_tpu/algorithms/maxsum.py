@@ -61,6 +61,7 @@ class MaxSumSolver(ArraySolver):
         # damping shrinks per-cycle message deltas by (1 - damping); scale
         # the stability threshold so convergence detection is
         # damping-invariant (total remaining change ~ delta / (1-damping))
+        self.stability_param = float(stability)  # as the user gave it
         self.stability = float(stability)
         if damping_nodes in ("vars", "both") and 0 < damping < 1:
             self.stability *= (1 - float(damping))
@@ -94,13 +95,19 @@ class MaxSumSolver(ArraySolver):
             "same": jnp.int32(0),
         }
 
+    def _cubes(self, s):
+        """Per-bucket cost hypercubes.  Static solver constants here; the
+        dynamic variant (maxsum_dynamic) stores them in the state pytree so
+        the host can swap factor functions between steps."""
+        return [cubes for cubes, _, _ in self.buckets]
+
     def step(self, s):
         q, r = s["q"], s["r"]
         edge_mask = self.domain_mask[self.edge_var]
 
         # --- factor update: min-marginal messages per arity bucket -------
         new_r = jnp.zeros((self.E, self.D), dtype=q.dtype)
-        for cubes, edge_ids, _ in self.buckets:
+        for cubes, (_, edge_ids, _) in zip(self._cubes(s), self.buckets):
             arity = cubes.ndim - 1
             if arity == 0:
                 continue
@@ -141,22 +148,21 @@ class MaxSumSolver(ArraySolver):
         finished = same >= SAME_COUNT
         if self.stop_cycle:
             finished = jnp.logical_or(finished, cycle >= self.stop_cycle)
-        return {
-            "cycle": cycle,
-            "finished": finished,
-            "key": key,
-            "q": q_new,
-            "r": new_r,
-            "selection": selection,
-            "same": same,
-        }
+        out = dict(s)  # preserve algorithm-private extras (e.g. dynamic
+        # factor tables in maxsum_dynamic)
+        out.update(
+            cycle=cycle, finished=finished, key=key,
+            q=q_new, r=new_r, selection=selection, same=same,
+        )
+        return out
 
     def assignment_indices(self, s):
         return s["selection"]
 
     def cost(self, s):
         return assignment_cost_device(
-            [(cubes, var_ids) for cubes, _, var_ids in self.buckets],
+            [(cubes, var_ids) for cubes, (_, _, var_ids)
+             in zip(self._cubes(s), self.buckets)],
             self.var_costs, s["selection"],
         )
 

@@ -1,0 +1,213 @@
+"""Dynamic MaxSum: factors whose cost functions change at runtime.
+
+reference parity: pydcop/algorithms/maxsum_dynamic.py (405 LoC):
+
+* ``DynamicFunctionFactorComputation`` (:40) — a factor whose function can
+  be swapped mid-run (``change_factor_function``), dimensions unchanged.
+* ``FactorWithReadOnlyVariables`` (:113) — a factor conditioned on
+  external (sensor) variables; on an external value change the factor is
+  re-sliced over the remaining decision variables.
+* ``DynamicFactorComputation`` (:188) — a factor whose *dimensions* can
+  change; neighbor variables re-subscribe (:352).
+
+TPU-first design: the factor cost hypercubes are moved from solver
+constants into the **state pytree**, so swapping a factor's function is a
+host-side ``state.at[row].set(new_cube)`` between jitted steps — same
+shapes, zero recompilation.  Dimension changes do force new shapes, so
+they take the rebuild path: compile new arrays and migrate message state
+for every (variable, factor) edge that survives, exactly the information
+the reference preserves across re-subscription.
+"""
+
+from typing import Dict, Iterable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..dcop.dcop import DCOP
+from ..dcop.relations import Constraint
+from ..graphs.arrays import FactorGraphArrays, _padded_cube
+from . import AlgoParameterDef
+from .amaxsum import AMaxSumSolver
+from .maxsum import HEADER_SIZE, UNIT_SIZE  # noqa: F401
+from .maxsum import communication_load, computation_memory  # noqa: F401
+
+GRAPH_TYPE = "factor_graph"
+
+algo_params = [
+    AlgoParameterDef("damping", "float", None, 0.5),
+    AlgoParameterDef("damping_nodes", "str",
+                     ["vars", "factors", "both", "none"], "vars"),
+    AlgoParameterDef("stability", "float", None, 0.1),
+    AlgoParameterDef("noise", "float", None, 0.0),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("activation", "float", None, 1.0),
+]
+
+
+class DynamicMaxSumSolver(AMaxSumSolver):
+    """A-MaxSum whose factor tables live in the state pytree.
+
+    ``activation`` defaults to 1.0 (synchronous); lower it for the
+    asynchronous behavior of the reference's A-MaxSum base.
+    """
+
+    def __init__(self, arrays: FactorGraphArrays, **kwargs):
+        kwargs.setdefault("activation", 1.0)
+        super().__init__(arrays, **kwargs)
+        # factor name -> (bucket index, row in bucket)
+        self._factor_pos: Dict[str, tuple] = {}
+        for b_idx, bucket in enumerate(arrays.buckets):
+            for row, f_id in enumerate(bucket.factor_ids):
+                self._factor_pos[arrays.factor_names[int(f_id)]] = (
+                    b_idx, row)
+
+    def init_state(self, key):
+        s = super().init_state(key)
+        s["cubes"] = tuple(cubes for cubes, _, _ in self.buckets)
+        return s
+
+    def _cubes(self, s):
+        return list(s["cubes"])
+
+    # ------------------------------------------------------------------ #
+    # host-side dynamics (called between steps, never traced)            #
+    # ------------------------------------------------------------------ #
+
+    def change_factor_function(self, state, factor_name: str,
+                               constraint: Constraint):
+        """Swap one factor's cost function, dimensions unchanged
+        (reference: maxsum_dynamic.py:40-110 ``change_factor_function``).
+
+        Returns a new state; the jitted step is reused as-is.
+        """
+        b_idx, row = self._factor_pos[factor_name]
+        bucket = self.arrays.buckets[b_idx]
+        if constraint.arity != bucket.arity:
+            raise ValueError(
+                f"change_factor_function: factor {factor_name!r} has "
+                f"arity {bucket.arity}, new constraint has "
+                f"{constraint.arity}; dimension changes need rebuild()"
+            )
+        expect = [self.arrays.var_names[int(v)]
+                  for v in bucket.var_ids[row]]
+        got = [v.name for v in constraint.dimensions]
+        if expect != got:
+            raise ValueError(
+                f"change_factor_function: factor {factor_name!r} scope is "
+                f"{expect}, new constraint scope is {got}; dimension "
+                f"changes need rebuild()"
+            )
+        cube = _padded_cube(constraint, self.arrays.max_domain,
+                            self.arrays.sign)
+        cubes = list(state["cubes"])
+        cubes[b_idx] = jnp.asarray(cubes[b_idx]).at[row].set(
+            jnp.asarray(cube))
+        out = dict(state)
+        out["cubes"] = tuple(cubes)
+        # a changed factor invalidates convergence history
+        out["same"] = jnp.int32(0)
+        out["finished"] = jnp.bool_(False)
+        return out
+
+    def set_externals(self, state, factor_name: str,
+                      base_constraint: Constraint,
+                      external_values: Dict[str, object]):
+        """Re-slice a factor conditioned on external (read-only) variables
+        at their new values (reference: maxsum_dynamic.py:113-186
+        ``FactorWithReadOnlyVariables.on_external_var_change``)."""
+        b_idx, row = self._factor_pos[factor_name]
+        bucket = self.arrays.buckets[b_idx]
+        scope = {self.arrays.var_names[int(v)]
+                 for v in bucket.var_ids[row]}
+        externals = [v.name for v in base_constraint.dimensions
+                     if v.name not in scope]
+        missing = [n for n in externals if n not in external_values]
+        if missing:
+            raise ValueError(
+                f"set_externals: factor {factor_name!r} needs values for "
+                f"external variables {missing}"
+            )
+        fixed = {n: external_values[n] for n in externals}
+        sliced = base_constraint.slice(fixed) if fixed else base_constraint
+        return self.change_factor_function(state, factor_name, sliced)
+
+
+def rebuild(dcop: DCOP, solver: DynamicMaxSumSolver, state,
+            variables=None, constraints=None,
+            params: Optional[Dict] = None):
+    """Dimension-changing rebuild
+    (reference: maxsum_dynamic.py:188-352 ``DynamicFactorComputation`` +
+    variable re-subscription).
+
+    Compiles fresh arrays for the updated problem and migrates the q/r
+    message rows of every (variable, factor) edge present in both the old
+    and new graphs — new edges start from the neutral zero message, exactly
+    as a freshly subscribed variable does in the reference.  Returns
+    ``(new_solver, new_state)``; the next ``step`` call triggers one
+    recompile for the new shapes.
+    """
+    params = dict(params or {})
+    params.setdefault("damping", solver.damping)
+    params.setdefault("damping_nodes", solver.damping_nodes)
+    params.setdefault("stability", solver.stability_param)
+    params.setdefault("noise", solver.noise)
+    params.setdefault("stop_cycle", solver.stop_cycle)
+    params.setdefault("activation", solver.activation)
+    new_arrays = FactorGraphArrays.build(dcop, variables, constraints)
+    new_solver = DynamicMaxSumSolver(new_arrays, **params)
+    new_state = new_solver.init_state(state["key"])
+
+    # factors whose scope survived keep their *current* (possibly
+    # runtime-swapped) table from the old state, not the DCOP's original —
+    # the reference's DynamicFunctionFactorComputation keeps its current
+    # function across re-subscription
+    if solver.arrays.max_domain == new_arrays.max_domain:
+        new_cubes = [np.array(c) for c in new_state["cubes"]]
+        old_cubes = [np.asarray(c) for c in state["cubes"]]
+        for fname, (ob, orow) in solver._factor_pos.items():
+            pos = new_solver._factor_pos.get(fname)
+            if pos is None:
+                continue
+            nb, nrow = pos
+            old_bucket = solver.arrays.buckets[ob]
+            new_bucket = new_arrays.buckets[nb]
+            if old_bucket.arity != new_bucket.arity:
+                continue
+            old_scope = [solver.arrays.var_names[int(v)]
+                         for v in old_bucket.var_ids[orow]]
+            new_scope = [new_arrays.var_names[int(v)]
+                         for v in new_bucket.var_ids[nrow]]
+            if old_scope == new_scope:
+                new_cubes[nb][nrow] = old_cubes[ob][orow]
+        new_state["cubes"] = tuple(jnp.asarray(c) for c in new_cubes)
+
+    old_a, new_a = solver.arrays, new_arrays
+    old_edge = {
+        (old_a.var_names[int(old_a.edge_var[e])],
+         old_a.factor_names[int(old_a.edge_factor[e])]): e
+        for e in range(old_a.n_edges)
+    }
+    q = np.array(new_state["q"])
+    r = np.array(new_state["r"])
+    old_q = np.asarray(state["q"])
+    old_r = np.asarray(state["r"])
+    d = min(old_a.max_domain, new_a.max_domain)
+    for e in range(new_a.n_edges):
+        key = (new_a.var_names[int(new_a.edge_var[e])],
+               new_a.factor_names[int(new_a.edge_factor[e])])
+        oe = old_edge.get(key)
+        if oe is not None:
+            q[e, :d] = old_q[oe, :d]
+            r[e, :d] = old_r[oe, :d]
+    new_state["q"] = jnp.asarray(q)
+    new_state["r"] = jnp.asarray(r)
+    new_state["cycle"] = state["cycle"]
+    return new_solver, new_state
+
+
+def build_solver(dcop: DCOP, params: Optional[Dict] = None,
+                 variables=None, constraints=None) -> DynamicMaxSumSolver:
+    params = params or {}
+    arrays = FactorGraphArrays.build(dcop, variables, constraints)
+    return DynamicMaxSumSolver(arrays, **params)

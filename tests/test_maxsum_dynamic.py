@@ -1,0 +1,188 @@
+"""Dynamic MaxSum: runtime factor-function changes.
+
+reference parity: pydcop/algorithms/maxsum_dynamic.py and
+tests around DynamicFunctionFactorComputation — a factor's function can be
+swapped mid-run and the algorithm re-converges to the new optimum.
+"""
+
+import jax
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.algorithms.maxsum_dynamic import (
+    DynamicMaxSumSolver,
+    build_solver,
+    rebuild,
+)
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.infrastructure.run import solve
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def _run_to_convergence(solver, state, max_cycles=200):
+    step = jax.jit(solver.step)
+    for _ in range(max_cycles):
+        state = step(state)
+        if bool(state["finished"]):
+            break
+    return state
+
+
+def test_dynamic_solves_like_maxsum():
+    dcop = load_dcop(GC3)
+    assignment = solve(dcop, "maxsum_dynamic", timeout=30)
+    assert assignment == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_change_factor_function_reconverges():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {"damping": 0.5})
+    state = _run_to_convergence(solver, state=solver.init_state(
+        jax.random.PRNGKey(0)))
+    a = solver.arrays.assignment_from_indices(
+        solver.assignment_indices(state), list(dcop.variables.values()))
+    assert a == {"v1": "R", "v2": "G", "v3": "R"}
+
+    # flip diff_1_2 into an *equality* preference: v1 == v2 now free,
+    # differing costs 1.  New optimum has v1 == v2.
+    new_c = constraint_from_str(
+        "diff_1_2", "0 if v1 == v2 else 1",
+        [dcop.variables["v1"], dcop.variables["v2"]])
+    state = solver.change_factor_function(state, "diff_1_2", new_c)
+    assert not bool(state["finished"])
+    state = _run_to_convergence(solver, state)
+    a = solver.arrays.assignment_from_indices(
+        solver.assignment_indices(state), list(dcop.variables.values()))
+    assert a["v1"] == a["v2"]
+    assert a["v2"] != a["v3"]
+
+
+def test_change_factor_function_rejects_dimension_change():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {})
+    state = solver.init_state(jax.random.PRNGKey(0))
+    bad = constraint_from_str(
+        "diff_1_2", "1 if v1 == v3 else 0",
+        [dcop.variables["v1"], dcop.variables["v3"]])
+    with pytest.raises(ValueError, match="rebuild"):
+        solver.change_factor_function(state, "diff_1_2", bad)
+
+
+def test_set_externals_reslices_factor():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {})
+    state = solver.init_state(jax.random.PRNGKey(0))
+    # base constraint over (v1, v2, sensor); conditioning on the sensor
+    # yields a binary factor over the original (v1, v2) scope
+    from pydcop_tpu.dcop.objects import Domain, Variable
+
+    sensor = Variable("sensor", Domain("onoff", "binary", [0, 1]))
+    base = constraint_from_str(
+        "diff_1_2", "(1 if v1 == v2 else 0) if sensor == 1 else 0",
+        [dcop.variables["v1"], dcop.variables["v2"], sensor])
+    state = solver.set_externals(state, "diff_1_2", base, {"sensor": 0})
+    state = _run_to_convergence(solver, state)
+    # with the constraint neutralized, unary costs decide: v1=R v2=G v3=G
+    a = solver.arrays.assignment_from_indices(
+        solver.assignment_indices(state), list(dcop.variables.values()))
+    assert a == {"v1": "R", "v2": "G", "v3": "G"}
+
+
+def test_rebuild_migrates_messages_and_dimensions():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {"damping": 0.5})
+    state = _run_to_convergence(solver, solver.init_state(
+        jax.random.PRNGKey(0)))
+
+    # dimension change: add constraint diff_1_3, keep the rest
+    new_c = constraint_from_str(
+        "diff_1_3", "1 if v1 == v3 else 0",
+        [dcop.variables["v1"], dcop.variables["v3"]])
+    dcop.add_constraint(new_c)
+    new_solver, new_state = rebuild(dcop, solver, state)
+    assert isinstance(new_solver, DynamicMaxSumSolver)
+    assert int(new_state["cycle"]) == int(state["cycle"])
+    # surviving edges carried their messages over
+    import numpy as np
+
+    old_key = (solver.arrays.var_names[int(solver.arrays.edge_var[0])],
+               solver.arrays.factor_names[
+                   int(solver.arrays.edge_factor[0])])
+    new_edges = {
+        (new_solver.arrays.var_names[int(new_solver.arrays.edge_var[e])],
+         new_solver.arrays.factor_names[
+             int(new_solver.arrays.edge_factor[e])]): e
+        for e in range(new_solver.arrays.n_edges)
+    }
+    np.testing.assert_allclose(
+        np.asarray(new_state["q"])[new_edges[old_key]],
+        np.asarray(state["q"])[0], rtol=1e-6)
+
+    new_state = _run_to_convergence(new_solver, new_state)
+    a = new_solver.arrays.assignment_from_indices(
+        new_solver.assignment_indices(new_state),
+        list(dcop.variables.values()))
+    # with all three diff constraints on 2 colors one must be violated;
+    # unary costs make v1=R v2=G v3=G optimal (cost 1 - 0.3)
+    assert a["v1"] != a["v2"]
+
+
+def test_rebuild_preserves_swapped_factor():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {"damping": 0.5, "stability": 0.01})
+    state = solver.init_state(jax.random.PRNGKey(0))
+    # swap diff_1_2 into an equality preference, then rebuild with an
+    # extra constraint: the swap must survive
+    swapped = constraint_from_str(
+        "diff_1_2", "0 if v1 == v2 else 1",
+        [dcop.variables["v1"], dcop.variables["v2"]])
+    state = solver.change_factor_function(state, "diff_1_2", swapped)
+    new_c = constraint_from_str(
+        "extra_1_3", "0.01 if v1 == v3 else 0",
+        [dcop.variables["v1"], dcop.variables["v3"]])
+    dcop.add_constraint(new_c)
+    new_solver, new_state = rebuild(dcop, solver, state)
+    assert new_solver.stability_param == solver.stability_param
+    import numpy as np
+
+    ob, orow = solver._factor_pos["diff_1_2"]
+    nb, nrow = new_solver._factor_pos["diff_1_2"]
+    np.testing.assert_allclose(
+        np.asarray(new_state["cubes"][nb])[nrow],
+        np.asarray(state["cubes"][ob])[orow])
+
+
+def test_set_externals_missing_value_raises():
+    dcop = load_dcop(GC3)
+    solver = build_solver(dcop, {})
+    state = solver.init_state(jax.random.PRNGKey(0))
+    from pydcop_tpu.dcop.objects import Domain, Variable
+
+    sensor = Variable("sensor", Domain("onoff", "binary", [0, 1]))
+    base = constraint_from_str(
+        "diff_1_2", "(1 if v1 == v2 else 0) if sensor == 1 else 0",
+        [dcop.variables["v1"], dcop.variables["v2"], sensor])
+    with pytest.raises(ValueError, match="sensor"):
+        solver.set_externals(state, "diff_1_2", base, {})
+
+
+def test_module_contract():
+    mod = load_algorithm_module("maxsum_dynamic")
+    assert mod.GRAPH_TYPE == "factor_graph"
+    names = [p.name for p in mod.algo_params]
+    assert "damping" in names and "activation" in names
