@@ -65,6 +65,21 @@ class Distribution(SimpleRepr):
             self._inverse[c] = agent
         self._mapping.setdefault(agent, []).extend(computations)
 
+    def move_computation(self, computation: str, agent: str):
+        """Re-host a computation (used by the repair protocol)."""
+        old = self._inverse.get(computation)
+        if old is not None and computation in self._mapping.get(old, []):
+            self._mapping[old].remove(computation)
+        self._inverse[computation] = agent
+        self._mapping.setdefault(agent, []).append(computation)
+
+    def remove_agent(self, agent: str) -> List[str]:
+        """Drop an agent; returns its now-unhosted computations."""
+        orphaned = self._mapping.pop(agent, [])
+        for c in orphaned:
+            self._inverse.pop(c, None)
+        return orphaned
+
     def has_computation(self, computation: str) -> bool:
         return computation in self._inverse
 

@@ -17,11 +17,17 @@ GRAPH_NODE_TYPE_FACTOR = "FactorComputation"
 
 class VariableComputationNode(ComputationNode):
     def __init__(self, variable: Variable, factor_names: Iterable[str]):
+        factor_names = list(factor_names)
         links = [
             FactorGraphLink(variable.name, f) for f in factor_names
         ]
         super().__init__(variable.name, GRAPH_NODE_TYPE_VARIABLE, links)
         self._variable = variable
+        self._factor_names = list(factor_names)
+
+    @property
+    def factor_names(self) -> List[str]:
+        return list(self._factor_names)
 
     @property
     def variable(self) -> Variable:
@@ -66,6 +72,8 @@ class FactorComputationNode(ComputationNode):
 class FactorGraphLink(Link):
     def __init__(self, node1: str, node2: str):
         super().__init__([node1, node2], "factor_link")
+        self._node1 = node1
+        self._node2 = node2
 
 
 class ComputationsFactorGraph(ComputationGraph):

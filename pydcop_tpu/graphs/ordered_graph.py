@@ -44,6 +44,8 @@ class OrderedVarNode(ComputationNode):
         self._variable = variable
         self._constraints = list(constraints)
         self._position = position
+        self._previous_node = previous_node
+        self._next_node = next_node
 
     @property
     def variable(self) -> Variable:

@@ -1,0 +1,432 @@
+"""Agents: one thread per agent, hosting control-plane computations.
+
+reference parity: pydcop/infrastructure/agents.py:78-1431.
+
+TPU-first split: in the reference the agent thread *is* the compute
+engine — every algorithm message is handled on it.  Here the data plane is
+one jitted step over the whole graph; agents carry the control plane only:
+orchestration commands, discovery, metrics reporting, replication and the
+repair protocol for dynamic DCOPs.  The lifecycle, the single-thread
+event loop over a priority queue, periodic actions and the hook-wrapping
+of hosted computations all mirror the reference so that the distributed
+story (multi-host over DCN) stays honest.
+"""
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .communication import CommunicationLayer, Messaging, MSG_MGT
+from .computations import MessagePassingComputation
+from .discovery import Directory, Discovery
+from .Events import event_bus
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.agents")
+
+
+class AgentException(Exception):
+    pass
+
+
+def notify_wrap(f: Callable, cb: Callable) -> Callable:
+    """Wrap ``f`` so that ``cb`` fires after it
+    (reference: agents.py:870-876)."""
+
+    def wrapped(*args, **kwargs):
+        out = f(*args, **kwargs)
+        cb(*args, **kwargs)
+        return out
+
+    return wrapped
+
+
+class _PeriodicAction:
+    """One entry of the agent's timer wheel
+    (reference: agents.py:743-852)."""
+
+    __slots__ = ("period", "cb", "next_time")
+
+    def __init__(self, period: float, cb: Callable, now: float):
+        self.period = period
+        self.cb = cb
+        self.next_time = now + period
+
+
+class AgentMetrics:
+    """Per-agent activity and message accounting
+    (reference: agents.py:878-926)."""
+
+    def __init__(self, agent: "Agent"):
+        self._agent = agent
+
+    @property
+    def count_ext_msg(self) -> Dict[str, int]:
+        return dict(self._agent._messaging.count_ext_msg)
+
+    @property
+    def size_ext_msg(self) -> Dict[str, int]:
+        return dict(self._agent._messaging.size_ext_msg)
+
+    @property
+    def activity_ratio(self) -> float:
+        total = time.perf_counter() - self._agent._t_started \
+            if self._agent._t_started else 0
+        return self._agent.t_active / total if total else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "count_ext_msg": self.count_ext_msg,
+            "size_ext_msg": self.size_ext_msg,
+            "activity_ratio": self.activity_ratio,
+            "cycles": {
+                c.name: getattr(c, "cycle_count", 0)
+                for c in self._agent.computations()},
+        }
+
+
+class Agent:
+    """An agent: one thread, one message queue, hosted computations
+    (reference: agents.py:78-877).
+
+    The event loop pops one message at a time (50 ms poll) and dispatches
+    it to the destination computation; periodic actions run from the same
+    loop, so a computation's handlers never race each other.
+    """
+
+    def __init__(self, name: str, comm: CommunicationLayer,
+                 agent_def=None, ui_port: Optional[int] = None,
+                 delay: float = 0):
+        self._name = name
+        self.agent_def = agent_def
+        self._comm = comm
+        self._messaging = Messaging(name, comm, delay=delay)
+        self.discovery = Discovery(name, comm.address)
+        comm.discovery = self.discovery
+        self._computations: Dict[str, MessagePassingComputation] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._stopping = threading.Event()
+        self._shutdown = threading.Event()
+        self._started_event = threading.Event()
+        self._periodic: List[_PeriodicAction] = []
+        self._periodic_lock = threading.Lock()
+        self.t_active = 0.0
+        self._t_started: Optional[float] = None
+        self.metrics = AgentMetrics(self)
+        self._on_fail_cb: Optional[Callable] = None
+        self._ui_server = None
+        self._ui_port = ui_port
+        self.logger = logging.getLogger(f"pydcop_tpu.agent.{name}")
+        # the discovery computation is always hosted
+        self.add_computation(self.discovery.discovery_computation,
+                             publish=False)
+
+    # ------------------------------------------------------------ props
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def address(self):
+        return self._comm.address
+
+    @property
+    def communication(self) -> CommunicationLayer:
+        return self._comm
+
+    @property
+    def messaging(self) -> Messaging:
+        return self._messaging
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def is_stopping(self) -> bool:
+        return self._stopping.is_set()
+
+    # ----------------------------------------------------- computations
+
+    def add_computation(self, computation: MessagePassingComputation,
+                        comp_name: Optional[str] = None,
+                        publish: bool = True):
+        """Host a computation on this agent
+        (reference: agents.py:175-235)."""
+        name = comp_name or computation.name
+        computation.message_sender = self._messaging.post_msg
+        computation._periodic_action_handler = self._add_periodic_cb
+        self._computations[name] = computation
+        # wrap hooks so the agent observes value selections / cycles
+        if hasattr(computation, "_on_value_selection"):
+            computation._on_value_selection = notify_wrap(
+                computation._on_value_selection,
+                lambda val, cost, cycle, _c=computation:
+                    self._on_computation_value_changed(_c.name, val, cost,
+                                                       cycle))
+        if hasattr(computation, "_on_new_cycle"):
+            computation._on_new_cycle = notify_wrap(
+                computation._on_new_cycle,
+                lambda count, _c=computation:
+                    self._on_computation_new_cycle(_c.name, count))
+        computation.finished = notify_wrap(
+            computation.finished,
+            lambda *a, _c=computation:
+                self._on_computation_finished(_c.name))
+        self.discovery.register_computation(
+            name, self._name, self.address, publish=publish)
+        event_bus.send(f"agents.add_computation.{self._name}", name)
+
+    def remove_computation(self, name: str):
+        comp = self._computations.pop(name, None)
+        if comp is None:
+            raise AgentException(f"No computation {name} on {self._name}")
+        if comp.is_running:
+            comp.stop()
+        try:
+            self.discovery.unregister_computation(name, self._name)
+        except Exception:
+            pass
+
+    def computation(self, name: str) -> MessagePassingComputation:
+        try:
+            return self._computations[name]
+        except KeyError:
+            raise AgentException(
+                f"No computation {name} on agent {self._name}")
+
+    def computations(self, include_technical: bool = False
+                     ) -> List[MessagePassingComputation]:
+        return [
+            c for n, c in self._computations.items()
+            if include_technical or not n.startswith("_")]
+
+    def has_computation(self, name: str) -> bool:
+        return name in self._computations
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self):
+        """Start the agent thread (reference: agents.py:140,360-430)."""
+        if self._thread is not None:
+            raise AgentException(f"Agent {self._name} already started")
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name=f"agent-{self._name}", daemon=True)
+        self._thread.start()
+        self._started_event.wait(5)
+        return self
+
+    def run_computations(self, names: Optional[List[str]] = None):
+        """Start hosted computations (all non-technical by default)."""
+        for comp in self.computations(include_technical=False):
+            if names is None or comp.name in names:
+                if not comp.is_running:
+                    comp.start()
+
+    def stop(self):
+        """Request a clean shutdown (reference: agents.py:431-470)."""
+        self._stopping.set()
+
+    def join(self, timeout: float = 5):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def clean_shutdown(self, timeout: float = 5):
+        self.stop()
+        self.join(timeout)
+
+    # ------------------------------------------------------- event loop
+
+    def _run(self):
+        self._t_started = time.perf_counter()
+        try:
+            self._on_start()
+            self._started_event.set()
+            while not self._stopping.is_set():
+                msg = self._messaging.next_msg(timeout=0.05)
+                if msg is not None:
+                    t0 = time.perf_counter()
+                    self._handle_message(msg)
+                    handling = time.perf_counter() - t0
+                    self.t_active += handling
+                    if handling > 1:
+                        self.logger.warning(
+                            "Long message handling (%.2fs) on %s: %s",
+                            handling, self._name, msg.dest_comp)
+                self._tick_periodic()
+        except Exception as e:  # pragma: no cover - defensive
+            self.logger.exception("Agent %s failed: %s", self._name, e)
+            if self._on_fail_cb:
+                self._on_fail_cb(e)
+        finally:
+            self._on_stop()
+            self._running = False
+            self._shutdown.set()
+
+    def _handle_message(self, cm):
+        """Dispatch to the destination computation
+        (reference: agents.py:709-742)."""
+        dest = cm.dest_comp
+        if dest is None:
+            return
+        comp = self._computations.get(dest)
+        if comp is None:
+            self.logger.warning(
+                "Message for unknown computation %s on %s", dest,
+                self._name)
+            return
+        if not comp.is_running and not comp.is_paused:
+            # buffer via the computation's pause machinery would lose
+            # start ordering; deliver anyway for control computations
+            if dest.startswith("_"):
+                comp.on_message(cm.src_comp, cm.msg, time.perf_counter())
+            return
+        event_bus.send(
+            f"computations.message_rcv.{dest}",
+            (cm.src_comp, getattr(cm.msg, "size", 1)))
+        comp.on_message(cm.src_comp, cm.msg, time.perf_counter())
+
+    def _tick_periodic(self):
+        now = time.perf_counter()
+        with self._periodic_lock:
+            due = [p for p in self._periodic if p.next_time <= now]
+        for p in due:
+            p.next_time = now + p.period
+            try:
+                p.cb()
+            except Exception:
+                self.logger.exception("Periodic action failed on %s",
+                                      self._name)
+
+    def _add_periodic_cb(self, period: float, cb: Callable):
+        action = _PeriodicAction(period, cb, time.perf_counter())
+        with self._periodic_lock:
+            self._periodic.append(action)
+        return action
+
+    def remove_periodic_action(self, action):
+        with self._periodic_lock:
+            if action in self._periodic:
+                self._periodic.remove(action)
+
+    # ----------------------------------------------------------- hooks
+
+    def _on_start(self):
+        """Agent-thread startup hook; runs on the agent thread."""
+        if self._ui_port:
+            try:
+                from .ui import UiServer
+
+                self._ui_server = UiServer(self, self._ui_port)
+                self._ui_server.start()
+            except Exception:
+                self.logger.exception("Could not start UI server")
+
+    def _on_stop(self):
+        for comp in list(self._computations.values()):
+            if comp.is_running:
+                comp.stop()
+        if self._ui_server is not None:
+            self._ui_server.stop()
+        self._messaging.shutdown()
+
+    def _on_computation_value_changed(self, computation, value, cost,
+                                      cycle):
+        event_bus.send(f"computations.value.{computation}",
+                       (value, cost, cycle))
+
+    def _on_computation_new_cycle(self, computation, count):
+        event_bus.send(f"computations.cycle.{computation}", count)
+
+    def _on_computation_finished(self, computation):
+        pass
+
+    def __repr__(self):
+        return f"Agent({self._name})"
+
+
+class ResilientAgent(Agent):
+    """Agent able to replicate its computations and take part in the
+    repair protocol of dynamic DCOPs (reference: agents.py:927-1431).
+
+    Replication places ``k`` replicas of each hosted (active) computation
+    on other agents, minimizing route + hosting costs (uniform-cost
+    search over the agent route graph, see
+    :mod:`pydcop_tpu.replication.dist_ucs_hostingcosts`).  On agent
+    departure, replica holders become candidates in a small *repair DCOP*
+    (one binary variable per orphaned computation × candidate) solved with
+    the compiled MGM engine — the TPU-first counterpart of the
+    reference's MGM-style repair computations (agents.py:1047-1258).
+    """
+
+    def __init__(self, name: str, comm: CommunicationLayer,
+                 agent_def=None, replication: Optional[str] = None,
+                 ui_port: Optional[int] = None, delay: float = 0):
+        super().__init__(name, comm, agent_def=agent_def, ui_port=ui_port,
+                         delay=delay)
+        self.replication_method = replication
+        # replicas this agent holds: computation name -> ComputationDef
+        self.replicas: Dict[str, Any] = {}
+        self._repair_info: Optional[Dict[str, Any]] = None
+        self._replication_comp = None
+        if replication is not None:
+            from ..replication.dist_ucs_hostingcosts import UCSReplication
+
+            self._replication_comp = UCSReplication(self)
+            self.add_computation(self._replication_comp, publish=False)
+
+    def replicate(self, k: int,
+                  comp_defs: Optional[Dict[str, Any]] = None,
+                  on_done: Optional[Callable] = None):
+        """Place k replicas of each active computation
+        (reference: agents.py:1042-1046)."""
+        from ..replication.dist_ucs_hostingcosts import replicate_on_agent
+
+        if self.replication_method is None:
+            raise AgentException(
+                f"Agent {self._name} has no replication method")
+        return replicate_on_agent(self, k, comp_defs=comp_defs,
+                                  on_done=on_done)
+
+    def accept_replica(self, comp_name: str, comp_def):
+        """Hold a replica of a computation (registered in discovery so
+        repair can find candidates)."""
+        self.replicas[comp_name] = comp_def
+        self.discovery.register_replica(comp_name, self._name)
+
+    def drop_replica(self, comp_name: str):
+        self.replicas.pop(comp_name, None)
+        self.discovery.unregister_replica(comp_name, self._name)
+
+    def setup_repair(self, repair_info: Dict[str, Any]):
+        """Store the repair problem data for the next repair run
+        (reference: agents.py:1047-1258).  Returns the names of the
+        orphaned computations this agent is candidate for."""
+        self._repair_info = repair_info
+        return sorted(set(repair_info.get("orphaned", []))
+                      & set(self.replicas))
+
+    def repair_run(self):
+        """Decide which orphaned computations this agent takes over
+        (reference: agents.py:1260-1382).
+
+        The placement decision is solved as a small DCOP (binary
+        activation variables, hosting + capacity costs) with the compiled
+        engine; candidates then activate the computations they won.
+        """
+        from ..reparation import solve_repair_dcop
+
+        if self._repair_info is None:
+            return []
+        won = solve_repair_dcop(self, self._repair_info)
+        for comp_name in won:
+            comp_def = self.replicas.get(comp_name)
+            if comp_def is None:
+                continue
+            self.discovery.register_computation(
+                comp_name, self._name, self.address)
+        self._repair_info = None
+        return won

@@ -1,0 +1,424 @@
+"""Agent-to-agent communication layers + per-agent messaging queues.
+
+reference parity: pydcop/infrastructure/communication.py:56-729.
+
+TPU-first split: algorithm "messages" are array rows exchanged inside one
+jitted step over ICI — they never touch this module.  What remains here is
+the *control plane*: orchestration commands, discovery traffic, metrics
+reports, and the repair protocol between hosts.  Two transports are
+provided, mirroring the reference:
+
+* :class:`InProcessCommunicationLayer` — a fake network for same-process
+  agents (address = the layer object itself, delivery = a synchronized
+  queue put).  This is also the test transport, the counterpart of the
+  reference's thread mode (communication.py:207-294).
+* :class:`HttpCommunicationLayer` — one lightweight HTTP server thread per
+  agent; messages are ``simple_repr`` JSON POSTed with routing headers
+  (communication.py:313-499).  This is the DCN-side transport for
+  multi-host runs.
+"""
+
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.simple_repr import SimpleRepr, from_repr, simple_repr
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.communication")
+
+# Message priority classes, lower value = delivered first
+# (reference: communication.py:495-497, discovery.py:77).
+MSG_DISCOVERY = 5
+MSG_MGT = 10
+MSG_VALUE = 15
+MSG_ALGO = 20
+
+
+class CommunicationException(Exception):
+    pass
+
+
+class UnreachableAgent(CommunicationException):
+    """Raised (or reported through on_error) when a message cannot be
+    delivered to its destination agent."""
+
+    def __init__(self, agent, msg=None):
+        super().__init__(f"Unreachable agent {agent}")
+        self.agent = agent
+        self.msg = msg
+
+
+class UnknownAgent(CommunicationException):
+    pass
+
+
+class UnknownComputation(CommunicationException):
+    pass
+
+
+class CommunicationLayer:
+    """Transport abstraction between agents
+    (reference: communication.py:56-200).
+
+    ``on_error`` delivery modes: ``'ignore'`` drops the message, ``'fail'``
+    raises, ``'retry'`` retries a few times before failing.
+    """
+
+    def __init__(self):
+        self.discovery = None  # set by the owning agent
+        self.messaging: Optional["Messaging"] = None
+
+    @property
+    def address(self):
+        raise NotImplementedError()
+
+    def send_msg(self, src_agent: str, dest_agent: str, msg,
+                 prio: int = MSG_ALGO, on_error: str = "ignore") -> bool:
+        raise NotImplementedError()
+
+    def start(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+    def _handle_error(self, dest_agent, msg, on_error, err=None) -> bool:
+        if on_error == "fail":
+            raise UnreachableAgent(dest_agent, msg) from err
+        logger.warning("Dropping undeliverable message to %s: %s",
+                       dest_agent, msg)
+        return False
+
+
+class InProcessCommunicationLayer(CommunicationLayer):
+    """Fake network for same-process agents
+    (reference: communication.py:207-294).
+
+    The layer's *address is the object itself*; sending means calling
+    directly into the destination layer, which enqueues on its agent's
+    Messaging queue — the queue provides the thread safety.
+    """
+
+    def __init__(self):
+        super().__init__()
+
+    @property
+    def address(self):
+        return self
+
+    def send_msg(self, src_agent: str, dest_agent: str, msg,
+                 prio: int = MSG_ALGO, on_error: str = "ignore") -> bool:
+        try:
+            address = self.discovery.agent_address(dest_agent)
+        except Exception as e:
+            return self._handle_error(dest_agent, msg, on_error, e)
+        if not isinstance(address, InProcessCommunicationLayer):
+            return self._handle_error(dest_agent, msg, on_error)
+        address.receive_msg(src_agent, dest_agent, msg, prio)
+        return True
+
+    def receive_msg(self, src_agent: str, dest_agent: str, msg,
+                    prio: int = MSG_ALGO):
+        if self.messaging is not None:
+            self.messaging.post_local(msg, prio)
+
+    def __repr__(self):
+        return f"InProcessCommunicationLayer({id(self):#x})"
+
+    # addresses must be serializable when shipped in discovery messages
+    # between processes — in-process they never are, identity is enough
+    def _simple_repr(self):
+        raise CommunicationException(
+            "InProcess addresses cannot cross a process boundary")
+
+
+class Address(SimpleRepr):
+    """host:port address of an HTTP comm layer
+    (reference: communication.py:300-312)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    def __eq__(self, o):
+        return (isinstance(o, Address) and self.host == o.host
+                and self.port == o.port)
+
+    def __hash__(self):
+        return hash((self.host, self.port))
+
+    def __repr__(self):
+        return f"Address({self.host!r}, {self.port})"
+
+    def _simple_repr(self):
+        return {"__qualname__": "Address",
+                "__module__": type(self).__module__,
+                "host": self.host, "port": self.port}
+
+    @classmethod
+    def _from_repr(cls, host, port):
+        return cls(host, port)
+
+
+class HttpCommunicationLayer(CommunicationLayer):
+    """One HTTP server thread per agent; send = POST of simple_repr JSON
+    (reference: communication.py:313-499)."""
+
+    def __init__(self, address: Optional[Tuple[str, int]] = None,
+                 timeout: float = 0.5):
+        super().__init__()
+        host, port = address if address else ("127.0.0.1", 9000)
+        self._address = Address(host, port)
+        self._timeout = timeout
+        self._server: Optional[HTTPServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._start_server()
+
+    @property
+    def address(self) -> Address:
+        return self._address
+
+    def _start_server(self):
+        comm = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # reference: MPCHttpHandler, communication.py:447-494
+            def do_POST(self):
+                length = int(self.headers.get("content-length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    content = json.loads(raw.decode("utf-8"))
+                    msg = from_repr(content)
+                except Exception:  # malformed payload: report 500
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                prio = int(self.headers.get("prio", MSG_ALGO))
+                src = self.headers.get("sender-agent")
+                dest = self.headers.get("dest-agent")
+                comm.on_post_message(src, dest, msg, prio)
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, format, *args):  # silence stdlib logs
+                pass
+
+        port = self._address.port
+        last_err = None
+        for _ in range(3):
+            try:
+                self._server = HTTPServer(("0.0.0.0", port), _Handler)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.3)
+        else:
+            raise CommunicationException(
+                f"Could not bind HTTP comm on port {port}: {last_err}")
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"comm-http-{port}", daemon=True)
+        self._server_thread.start()
+
+    def on_post_message(self, src_agent, dest_agent, msg, prio):
+        if self.messaging is not None:
+            self.messaging.post_local(msg, prio)
+
+    def send_msg(self, src_agent: str, dest_agent: str, msg,
+                 prio: int = MSG_ALGO, on_error: str = "ignore") -> bool:
+        import requests
+
+        try:
+            address = self.discovery.agent_address(dest_agent)
+        except Exception as e:
+            return self._handle_error(dest_agent, msg, on_error, e)
+        url = f"http://{address.host}:{address.port}/pydcop"
+        headers = {"sender-agent": str(src_agent),
+                   "dest-agent": str(dest_agent),
+                   "prio": str(prio),
+                   "type": getattr(msg, "type", "raw")}
+        retries = 3 if on_error == "retry" else 1
+        for attempt in range(retries):
+            try:
+                requests.post(url, json=simple_repr(msg), headers=headers,
+                              timeout=self._timeout)
+                return True
+            except Exception as e:
+                if attempt == retries - 1:
+                    return self._handle_error(dest_agent, msg, on_error, e)
+                time.sleep(0.1 * (attempt + 1))
+        return False
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def __repr__(self):
+        return f"HttpCommunicationLayer({self._address})"
+
+
+class ComputationMessage:
+    """A message between two named computations, as queued
+    (reference: communication.py:712-729)."""
+
+    __slots__ = ("src_comp", "dest_comp", "msg", "prio")
+
+    def __init__(self, src_comp: str, dest_comp: str, msg, prio: int):
+        self.src_comp = src_comp
+        self.dest_comp = dest_comp
+        self.msg = msg
+        self.prio = prio
+
+
+class Messaging:
+    """Per-agent prioritized message queue + routing
+    (reference: communication.py:500-711).
+
+    Outgoing messages are routed with a discovery lookup: if the target
+    computation lives on this agent the message goes straight to the local
+    queue, otherwise it is handed to the communication layer.  Messages for
+    computations not registered anywhere yet are *parked* and retried when
+    the computation appears (at-least-once park-and-retry,
+    reference: communication.py:637-650).
+    """
+
+    def __init__(self, agent_name: str, comm: CommunicationLayer,
+                 delay: float = 0):
+        self._agent_name = agent_name
+        self._comm = comm
+        comm.messaging = self
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._delay = delay  # optional per-message delay for observation
+        self._shutdown = False
+        # parked messages waiting for their destination to register
+        self._waiting: Dict[str, List[Tuple[str, str, Any, int, Any]]] = {}
+        # metrics (external = crossed the comm layer)
+        self.count_ext_msg: Dict[str, int] = {}
+        self.size_ext_msg: Dict[str, int] = {}
+        self.msg_queue_count = 0
+
+    @property
+    def communication(self) -> CommunicationLayer:
+        return self._comm
+
+    @property
+    def discovery(self):
+        return self._comm.discovery
+
+    def next_msg(self, timeout: float = 0.05
+                 ) -> Optional[ComputationMessage]:
+        """Pop the next message in priority order, or None on timeout."""
+        try:
+            _, _, item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if self._delay:
+            time.sleep(self._delay)
+        return item
+
+    def post_msg(self, src_comp: str, dest_comp: str, msg,
+                 prio: int = MSG_ALGO, on_error: str = "ignore"):
+        """Route a message from a local computation to any computation."""
+        if self._shutdown:
+            return
+        discovery = self.discovery
+        try:
+            dest_agent = discovery.computation_agent(dest_comp)
+        except Exception:
+            # destination not registered yet: park and retry on
+            # registration (reference: communication.py:637-650)
+            with self._lock:
+                self._waiting.setdefault(dest_comp, []).append(
+                    (src_comp, dest_comp, msg, prio, on_error))
+            try:
+                if dest_comp == "_directory":
+                    # a directory subscription would itself be a message
+                    # to the directory: local callback only, else the
+                    # parking recurses forever
+                    discovery.subscribe_computation_local(
+                        dest_comp, self._on_computation_registered,
+                        one_shot=True)
+                else:
+                    discovery.subscribe_computation(
+                        dest_comp, self._on_computation_registered,
+                        one_shot=True)
+            except Exception:
+                pass
+            return
+        if dest_agent == self._agent_name:
+            self._enqueue(ComputationMessage(src_comp, dest_comp, msg,
+                                             prio or MSG_ALGO))
+        else:
+            self._record_ext(src_comp, msg)
+            full = _Envelope(src_comp, dest_comp, msg)
+            self._comm.send_msg(self._agent_name, dest_agent, full,
+                                prio=prio or MSG_ALGO, on_error=on_error)
+
+    def post_local(self, envelope, prio: int = MSG_ALGO):
+        """Deliver a message arriving from the network."""
+        if isinstance(envelope, _Envelope):
+            self._enqueue(ComputationMessage(
+                envelope.src_comp, envelope.dest_comp, envelope.msg, prio))
+        else:
+            self._enqueue(ComputationMessage(None, None, envelope, prio))
+
+    def _enqueue(self, cm: ComputationMessage):
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        self.msg_queue_count += 1
+        self._queue.put((cm.prio, seq, cm))
+
+    def _on_computation_registered(self, evt: str, computation: str,
+                                   agent: str):
+        with self._lock:
+            parked = self._waiting.pop(computation, [])
+        for src, dest, msg, prio, on_error in parked:
+            self.post_msg(src, dest, msg, prio, on_error)
+
+    def _record_ext(self, src_comp: str, msg):
+        self.count_ext_msg[src_comp] = \
+            self.count_ext_msg.get(src_comp, 0) + 1
+        self.size_ext_msg[src_comp] = \
+            self.size_ext_msg.get(src_comp, 0) + getattr(msg, "size", 1)
+
+    def shutdown(self):
+        self._shutdown = True
+        self._comm.shutdown()
+
+
+class _Envelope(SimpleRepr):
+    """Routing wrapper carrying computation names across the wire."""
+
+    def __init__(self, src_comp: str, dest_comp: str, msg):
+        self._src_comp = src_comp
+        self._dest_comp = dest_comp
+        self._msg = msg
+
+    @property
+    def src_comp(self):
+        return self._src_comp
+
+    @property
+    def dest_comp(self):
+        return self._dest_comp
+
+    @property
+    def msg(self):
+        return self._msg
+
+    def _simple_repr(self):
+        return {"__qualname__": "_Envelope",
+                "__module__": type(self).__module__,
+                "src_comp": self._src_comp,
+                "dest_comp": self._dest_comp,
+                "msg": simple_repr(self._msg)}

@@ -106,16 +106,32 @@ def message_type(msg_type: str, fields: List[str]):
             r[f] = simple_repr(getattr(self, "_" + f))
         return r
 
+    import sys
+
+    caller = sys._getframe(1).f_globals
     attrs = {
         "__init__": __init__,
         "__repr__": _str,
         "__str__": _str,
+        "__module__": caller.get("__name__", __name__),
         "_simple_repr": _simple_repr_impl,
         "content": property(_content_prop),
     }
     for f in fields:
         attrs[f] = property(lambda self, _f=f: getattr(self, "_" + _f))
     cls = type(msg_type, (Message,), attrs)
+    # publish the class under its message-type name in the caller's
+    # module so ``from_repr`` can resolve it when deserializing
+    existing = caller.get(msg_type)
+    if existing is None:
+        caller[msg_type] = cls
+    elif not (isinstance(existing, type)
+              and issubclass(existing, Message)):
+        raise ValueError(
+            f"message_type({msg_type!r}) collides with an existing "
+            f"non-message binding in {caller.get('__name__')}; "
+            "cross-process deserialization would resolve the wrong "
+            "object")
     return cls
 
 

@@ -1,0 +1,480 @@
+"""Name resolution plane: central directory + per-agent discovery cache.
+
+reference parity: pydcop/infrastructure/discovery.py:95-1496.
+
+The directory is a message-passing computation hosted on the orchestrator
+agent; every agent keeps a local :class:`Discovery` cache that registers
+agents / computations / replicas with the directory and can subscribe to
+changes.  The interface is deliberately swappable for a fully distributed
+implementation (reference: discovery.py:31-43).
+
+On the TPU build this is pure control plane: the data plane's "routing" is
+array indexing inside a jitted step; discovery only matters for host-side
+orchestration (deploy/repair/multi-host DCN bootstrap).
+"""
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .communication import MSG_DISCOVERY, UnknownAgent, UnknownComputation
+from .computations import Message, MessagePassingComputation, \
+    message_type, register
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.discovery")
+
+DIRECTORY_COMP = "_directory"
+
+
+class DiscoveryException(Exception):
+    pass
+
+
+# Directory protocol vocabulary (reference: discovery.py:95-117)
+RegisterAgentMessage = message_type(
+    "register_agent", ["agent", "address"])
+UnregisterAgentMessage = message_type(
+    "unregister_agent", ["agent"])
+RegisterComputationMessage = message_type(
+    "register_computation", ["computation", "agent", "address"])
+UnregisterComputationMessage = message_type(
+    "unregister_computation", ["computation", "agent"])
+RegisterReplicaMessage = message_type(
+    "register_replica", ["replica", "agent"])
+UnregisterReplicaMessage = message_type(
+    "unregister_replica", ["replica", "agent"])
+SubscribeAgentMessage = message_type(
+    "subscribe_agent", ["agent", "subscribe"])
+SubscribeComputationMessage = message_type(
+    "subscribe_computation", ["computation", "subscribe"])
+SubscribeReplicaMessage = message_type(
+    "subscribe_replica", ["replica", "subscribe"])
+PublishAgentMessage = message_type(
+    "publish_agent", ["event", "agent", "address"])
+PublishComputationMessage = message_type(
+    "publish_computation", ["event", "computation", "agent", "address"])
+PublishReplicaMessage = message_type(
+    "publish_replica", ["event", "replica", "agent"])
+
+
+class DirectoryComputation(MessagePassingComputation):
+    """Central registry hosted on the orchestrator agent
+    (reference: discovery.py:121-292)."""
+
+    def __init__(self, discovery: "Discovery"):
+        super().__init__(DIRECTORY_COMP)
+        self.discovery = discovery
+        # subscriptions: name -> set of subscriber computation names
+        self._agent_subs: Dict[str, Set[str]] = {}
+        self._comp_subs: Dict[str, Set[str]] = {}
+        self._replica_subs: Dict[str, Set[str]] = {}
+
+    @register("register_agent")
+    def _on_register_agent(self, sender, msg, t):
+        self.discovery.register_agent(msg.agent, msg.address, publish=False)
+        for sub in self._agent_subs.get(msg.agent, set()) | \
+                self._agent_subs.get("*", set()):
+            self.post_msg(sub, PublishAgentMessage(
+                "agent_added", msg.agent, msg.address), MSG_DISCOVERY)
+
+    @register("unregister_agent")
+    def _on_unregister_agent(self, sender, msg, t):
+        try:
+            self.discovery.unregister_agent(msg.agent, publish=False)
+        except UnknownAgent:
+            pass
+        for sub in self._agent_subs.get(msg.agent, set()) | \
+                self._agent_subs.get("*", set()):
+            self.post_msg(sub, PublishAgentMessage(
+                "agent_removed", msg.agent, None), MSG_DISCOVERY)
+
+    @register("register_computation")
+    def _on_register_computation(self, sender, msg, t):
+        if msg.address is not None:
+            self.discovery.register_agent(msg.agent, msg.address,
+                                          publish=False)
+        self.discovery.register_computation(
+            msg.computation, msg.agent, publish=False)
+        for sub in self._comp_subs.get(msg.computation, set()) | \
+                self._comp_subs.get("*", set()):
+            self.post_msg(sub, PublishComputationMessage(
+                "computation_added", msg.computation, msg.agent,
+                msg.address), MSG_DISCOVERY)
+
+    @register("unregister_computation")
+    def _on_unregister_computation(self, sender, msg, t):
+        try:
+            self.discovery.unregister_computation(
+                msg.computation, msg.agent, publish=False)
+        except UnknownComputation:
+            pass
+        for sub in self._comp_subs.get(msg.computation, set()) | \
+                self._comp_subs.get("*", set()):
+            self.post_msg(sub, PublishComputationMessage(
+                "computation_removed", msg.computation, msg.agent, None),
+                MSG_DISCOVERY)
+
+    @register("register_replica")
+    def _on_register_replica(self, sender, msg, t):
+        self.discovery.register_replica(msg.replica, msg.agent,
+                                        publish=False)
+        for sub in self._replica_subs.get(msg.replica, set()) | \
+                self._replica_subs.get("*", set()):
+            self.post_msg(sub, PublishReplicaMessage(
+                "replica_added", msg.replica, msg.agent), MSG_DISCOVERY)
+
+    @register("unregister_replica")
+    def _on_unregister_replica(self, sender, msg, t):
+        self.discovery.unregister_replica(msg.replica, msg.agent,
+                                          publish=False)
+        for sub in self._replica_subs.get(msg.replica, set()) | \
+                self._replica_subs.get("*", set()):
+            self.post_msg(sub, PublishReplicaMessage(
+                "replica_removed", msg.replica, msg.agent), MSG_DISCOVERY)
+
+    @register("subscribe_agent")
+    def _on_subscribe_agent(self, sender, msg, t):
+        if msg.subscribe:
+            self._agent_subs.setdefault(msg.agent, set()).add(sender)
+            # answer with current state so the subscriber syncs up
+            if msg.agent != "*":
+                try:
+                    addr = self.discovery.agent_address(msg.agent)
+                    self.post_msg(sender, PublishAgentMessage(
+                        "agent_added", msg.agent, addr), MSG_DISCOVERY)
+                except UnknownAgent:
+                    pass
+            else:
+                for a in self.discovery.agents():
+                    self.post_msg(sender, PublishAgentMessage(
+                        "agent_added", a,
+                        self.discovery.agent_address(a)), MSG_DISCOVERY)
+        else:
+            self._agent_subs.get(msg.agent, set()).discard(sender)
+
+    @register("subscribe_computation")
+    def _on_subscribe_computation(self, sender, msg, t):
+        if msg.subscribe:
+            self._comp_subs.setdefault(msg.computation, set()).add(sender)
+            if msg.computation != "*":
+                try:
+                    agt = self.discovery.computation_agent(msg.computation)
+                    addr = None
+                    try:
+                        addr = self.discovery.agent_address(agt)
+                    except UnknownAgent:
+                        pass
+                    self.post_msg(sender, PublishComputationMessage(
+                        "computation_added", msg.computation, agt, addr),
+                        MSG_DISCOVERY)
+                except UnknownComputation:
+                    pass
+        else:
+            self._comp_subs.get(msg.computation, set()).discard(sender)
+
+    @register("subscribe_replica")
+    def _on_subscribe_replica(self, sender, msg, t):
+        if msg.subscribe:
+            self._replica_subs.setdefault(msg.replica, set()).add(sender)
+            for agt in self.discovery.replica_agents(msg.replica):
+                self.post_msg(sender, PublishReplicaMessage(
+                    "replica_added", msg.replica, agt), MSG_DISCOVERY)
+        else:
+            self._replica_subs.get(msg.replica, set()).discard(sender)
+
+
+class Directory:
+    """The directory service object, owned by the orchestrator agent
+    (reference: discovery.py:294-651)."""
+
+    def __init__(self, discovery: "Discovery"):
+        self.discovery = discovery
+        self.directory_computation = DirectoryComputation(discovery)
+
+    @property
+    def address(self):
+        return self.discovery.agent_address(self.discovery.agent_name)
+
+
+class _DiscoveryComputation(MessagePassingComputation):
+    """Per-agent computation receiving directory publications
+    (reference: discovery.py:654-727)."""
+
+    def __init__(self, name: str, discovery: "Discovery"):
+        super().__init__(name)
+        self.discovery = discovery
+
+    @register("publish_agent")
+    def _on_publish_agent(self, sender, msg, t):
+        if msg.event == "agent_added":
+            self.discovery.register_agent(msg.agent, msg.address,
+                                          publish=False)
+        else:
+            try:
+                self.discovery.unregister_agent(msg.agent, publish=False)
+            except UnknownAgent:
+                pass
+        if msg.event == "agent_removed":
+            self.discovery._fire_agent(msg.event, msg.agent, msg.address)
+
+    @register("publish_computation")
+    def _on_publish_computation(self, sender, msg, t):
+        if msg.event == "computation_added":
+            if msg.address is not None:
+                self.discovery.register_agent(msg.agent, msg.address,
+                                              publish=False)
+            self.discovery.register_computation(
+                msg.computation, msg.agent, publish=False)
+        else:
+            try:
+                self.discovery.unregister_computation(
+                    msg.computation, msg.agent, publish=False)
+            except UnknownComputation:
+                pass
+        if msg.event == "computation_removed":
+            self.discovery._fire_computation(msg.event, msg.computation,
+                                             msg.agent)
+
+    @register("publish_replica")
+    def _on_publish_replica(self, sender, msg, t):
+        if msg.event == "replica_added":
+            self.discovery.register_replica(msg.replica, msg.agent,
+                                            publish=False)
+        else:
+            self.discovery.unregister_replica(msg.replica, msg.agent,
+                                              publish=False)
+
+
+class Discovery:
+    """Local, eventually-consistent view of agents / computations /
+    replicas (reference: discovery.py:654-1496).
+
+    All mutating calls optionally *publish* to the central directory via
+    the agent's discovery computation; publications come back to
+    subscribers as ``publish_*`` messages.
+    """
+
+    def __init__(self, agent_name: str, address: Any = None):
+        self.agent_name = agent_name
+        self._lock = threading.RLock()
+        self._agents_data: Dict[str, Any] = {}
+        if address is not None:
+            self._agents_data[agent_name] = address
+        self._computations_data: Dict[str, str] = {}
+        self._replicas_data: Dict[str, Set[str]] = {}
+        # callbacks: name -> list of (cb, one_shot)
+        self._agent_cbs: Dict[str, List[Tuple[Callable, bool]]] = {}
+        self._comp_cbs: Dict[str, List[Tuple[Callable, bool]]] = {}
+        self._replica_cbs: Dict[str, List[Tuple[Callable, bool]]] = {}
+        self.discovery_computation = _DiscoveryComputation(
+            f"_discovery_{agent_name}", self)
+
+    # ------------------------------------------------------------- agents
+
+    def agents(self) -> List[str]:
+        with self._lock:
+            return list(self._agents_data)
+
+    def agent_address(self, agent: str):
+        with self._lock:
+            try:
+                return self._agents_data[agent]
+            except KeyError:
+                raise UnknownAgent(agent)
+
+    def register_agent(self, agent: str, address: Any = None,
+                       publish: bool = True):
+        with self._lock:
+            known = agent in self._agents_data
+            self._agents_data[agent] = address
+        if publish:
+            self._send_to_directory(RegisterAgentMessage(agent, address))
+        if not known:
+            self._fire_agent("agent_added", agent, address)
+
+    def unregister_agent(self, agent: str, publish: bool = True):
+        with self._lock:
+            if agent not in self._agents_data:
+                raise UnknownAgent(agent)
+            del self._agents_data[agent]
+            # drop computations hosted there
+            for c, a in list(self._computations_data.items()):
+                if a == agent:
+                    del self._computations_data[c]
+        if publish:
+            self._send_to_directory(UnregisterAgentMessage(agent))
+        self._fire_agent("agent_removed", agent, None)
+
+    def subscribe_agent(self, agent: str, cb: Optional[Callable] = None,
+                        one_shot: bool = False):
+        if cb is not None:
+            with self._lock:
+                self._agent_cbs.setdefault(agent, []).append((cb, one_shot))
+        self._send_to_directory(SubscribeAgentMessage(agent, True))
+
+    def subscribe_agent_local(self, agent: str, cb: Callable,
+                              one_shot: bool = False):
+        """Callback-only subscription, no directory round-trip — used by
+        the directory's own host (the orchestrator)."""
+        with self._lock:
+            self._agent_cbs.setdefault(agent, []).append((cb, one_shot))
+
+    def subscribe_computation_local(self, computation: str, cb: Callable,
+                                    one_shot: bool = False):
+        with self._lock:
+            self._comp_cbs.setdefault(computation, []).append(
+                (cb, one_shot))
+
+    def unsubscribe_agent(self, agent: str, cb: Optional[Callable] = None):
+        with self._lock:
+            if cb is None:
+                self._agent_cbs.pop(agent, None)
+            else:
+                self._agent_cbs[agent] = [
+                    (c, o) for c, o in self._agent_cbs.get(agent, [])
+                    if c != cb]
+        self._send_to_directory(SubscribeAgentMessage(agent, False))
+
+    # ------------------------------------------------------- computations
+
+    def computations(self, include_technical: bool = False) -> List[str]:
+        with self._lock:
+            return [c for c in self._computations_data
+                    if include_technical or not c.startswith("_")]
+
+    def computation_agent(self, computation: str) -> str:
+        with self._lock:
+            try:
+                return self._computations_data[computation]
+            except KeyError:
+                raise UnknownComputation(computation)
+
+    def agent_computations(self, agent: str,
+                           include_technical: bool = False) -> List[str]:
+        with self._lock:
+            return [
+                c for c, a in self._computations_data.items()
+                if a == agent and
+                (include_technical or not c.startswith("_"))]
+
+    def register_computation(self, computation: str,
+                             agent: Optional[str] = None,
+                             address: Any = None, publish: bool = True):
+        agent = agent if agent is not None else self.agent_name
+        with self._lock:
+            if address is not None:
+                self._agents_data[agent] = address
+            elif agent not in self._agents_data:
+                self._agents_data.setdefault(agent, None)
+            known = self._computations_data.get(computation)
+            self._computations_data[computation] = agent
+        if publish:
+            self._send_to_directory(RegisterComputationMessage(
+                computation, agent, address))
+        if known != agent:
+            self._fire_computation("computation_added", computation, agent)
+
+    def unregister_computation(self, computation: str,
+                               agent: Optional[str] = None,
+                               publish: bool = True):
+        with self._lock:
+            known = self._computations_data.get(computation)
+            if known is None and computation not in self._computations_data:
+                raise UnknownComputation(computation)
+            if agent is not None and known != agent:
+                # stale unregistration, someone else re-registered it
+                return
+            del self._computations_data[computation]
+        if publish:
+            self._send_to_directory(UnregisterComputationMessage(
+                computation, agent))
+        self._fire_computation("computation_removed", computation, agent)
+
+    def subscribe_computation(self, computation: str,
+                              cb: Optional[Callable] = None,
+                              one_shot: bool = False):
+        if cb is not None:
+            with self._lock:
+                self._comp_cbs.setdefault(computation, []).append(
+                    (cb, one_shot))
+        self._send_to_directory(SubscribeComputationMessage(
+            computation, True))
+
+    def unsubscribe_computation(self, computation: str,
+                                cb: Optional[Callable] = None):
+        with self._lock:
+            if cb is None:
+                self._comp_cbs.pop(computation, None)
+            else:
+                self._comp_cbs[computation] = [
+                    (c, o) for c, o in self._comp_cbs.get(computation, [])
+                    if c != cb]
+        self._send_to_directory(SubscribeComputationMessage(
+            computation, False))
+
+    # ------------------------------------------------------------ replicas
+
+    def replica_agents(self, replica: str) -> Set[str]:
+        with self._lock:
+            return set(self._replicas_data.get(replica, set()))
+
+    def register_replica(self, replica: str, agent: Optional[str] = None,
+                         publish: bool = True):
+        agent = agent if agent is not None else self.agent_name
+        with self._lock:
+            self._replicas_data.setdefault(replica, set()).add(agent)
+        if publish:
+            self._send_to_directory(RegisterReplicaMessage(replica, agent))
+        self._fire_replica("replica_added", replica, agent)
+
+    def unregister_replica(self, replica: str,
+                           agent: Optional[str] = None,
+                           publish: bool = True):
+        agent = agent if agent is not None else self.agent_name
+        with self._lock:
+            self._replicas_data.get(replica, set()).discard(agent)
+        if publish:
+            self._send_to_directory(UnregisterReplicaMessage(
+                replica, agent))
+
+    def subscribe_replica(self, replica: str,
+                          cb: Optional[Callable] = None):
+        if cb is not None:
+            with self._lock:
+                self._replica_cbs.setdefault(replica, []).append(
+                    (cb, False))
+        self._send_to_directory(SubscribeReplicaMessage(replica, True))
+
+    # ------------------------------------------------------------ internal
+
+    def _send_to_directory(self, msg: Message):
+        sender = self.discovery_computation.message_sender
+        if sender is None:
+            # not attached to an agent yet (standalone/test use): the
+            # local cache is authoritative, nothing to publish to
+            return
+        self.discovery_computation.post_msg(DIRECTORY_COMP, msg,
+                                            MSG_DISCOVERY)
+
+    def _fire(self, cbs_map, key: str, event: str, name: str, agent):
+        with self._lock:
+            cbs = list(cbs_map.get(key, [])) + list(cbs_map.get("*", []))
+        for cb, one_shot in cbs:
+            try:
+                cb(event, name, agent)
+            except Exception:
+                logger.exception("Error in discovery callback for %s", name)
+            if one_shot:
+                with self._lock:
+                    for k in (key, "*"):
+                        if (cb, one_shot) in cbs_map.get(k, []):
+                            cbs_map[k].remove((cb, one_shot))
+
+    def _fire_agent(self, event, agent, address):
+        self._fire(self._agent_cbs, agent, event, agent, address)
+
+    def _fire_computation(self, event, computation, agent):
+        self._fire(self._comp_cbs, computation, event, computation, agent)
+
+    def _fire_replica(self, event, replica, agent):
+        self._fire(self._replica_cbs, replica, event, replica, agent)

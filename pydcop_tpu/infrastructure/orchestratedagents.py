@@ -1,0 +1,266 @@
+"""Agents remotely controlled by the orchestrator.
+
+reference parity: pydcop/infrastructure/orchestratedagents.py:71-386.
+
+An :class:`OrchestratedAgent` is a :class:`ResilientAgent` plus an
+:class:`OrchestrationComputation` that executes orchestrator commands
+(deploy / run / pause / resume / stop / replicate / repair) and reports
+value changes, cycles and metrics back.
+
+TPU-first split: the computations deployed onto agents are *mirrors* of
+the compiled data plane — they own the variable (for the distributed
+ownership story: discovery registration, repair, metrics) while the math
+for all nodes runs as one jitted step driven by the orchestrator.  The
+orchestrator pushes value updates between engine chunks; mirrors fire the
+same value/cycle hooks the reference's real computations do, so the whole
+metrics/reporting fabric is exercised identically (and over HTTP/DCN in
+process/multi-host modes).
+"""
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from .agents import ResilientAgent
+from .communication import CommunicationLayer, MSG_MGT, MSG_VALUE
+from .computations import DcopComputation, MessagePassingComputation, \
+    VariableComputation, register
+from .discovery import DIRECTORY_COMP
+from .orchestrator import AgentStoppedMessage, CycleChangeMessage, \
+    MetricsMessage, ORCHESTRATOR_AGENT, ORCHESTRATOR_MGT, \
+    RepairDoneMessage, RepairReadyMessage, ReplicationDoneMessage, \
+    ValueChangeMessage, orchestration_comp_name
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.orchestratedagents")
+
+
+class ValueMirrorComputation(VariableComputation):
+    """Mirror of one variable of the compiled data plane
+    (the TPU build's counterpart of a deployed algorithm computation —
+    reference: orchestratedagents.py:265-291 deploys the real thing)."""
+
+    def __init__(self, variable, comp_def):
+        super().__init__(variable, comp_def)
+
+    def set_value(self, value, cost: float, cycle: int):
+        self._cycle_count = cycle
+        self.value_selection(value, cost)
+
+    def on_start(self):
+        pass
+
+
+class FactorMirrorComputation(DcopComputation):
+    """Mirror of a factor node (no value to select)."""
+
+    def on_start(self):
+        pass
+
+
+def build_mirror_computation(comp_def) -> MessagePassingComputation:
+    """Build the agent-side mirror for a deployed ComputationDef."""
+    variable = getattr(comp_def.node, "variable", None)
+    if variable is not None:
+        return ValueMirrorComputation(variable, comp_def)
+    return FactorMirrorComputation(comp_def.name, comp_def)
+
+
+class OrchestrationComputation(MessagePassingComputation):
+    """Per-agent management computation executing orchestrator commands
+    (reference: orchestratedagents.py:178-386)."""
+
+    def __init__(self, agent: "OrchestratedAgent"):
+        super().__init__(orchestration_comp_name(agent.name))
+        self.agent = agent
+        self.metrics_on: Optional[str] = agent.metrics_on
+        self._deployed: List[str] = []
+
+    def on_start(self):
+        # register this agent (and implicitly this computation) with the
+        # central directory (reference: orchestratedagents.py:118-140)
+        self.agent.discovery.register_agent(
+            self.agent.name, self.agent.address)
+        self.agent.discovery.register_computation(
+            self.name, self.agent.name, self.agent.address)
+        # the discovery computation must be directory-resolvable so
+        # publications can be routed back to this agent
+        self.agent.discovery.register_computation(
+            self.agent.discovery.discovery_computation.name,
+            self.agent.name, self.agent.address)
+        if self.agent.replication_method is not None:
+            from ..replication.dist_ucs_hostingcosts import \
+                replication_computation_name
+
+            # peers + their replication computations must be resolvable
+            # for the hop-by-hop replication protocol
+            self.agent.discovery.subscribe_agent("*")
+            self.agent.discovery.register_computation(
+                replication_computation_name(self.agent.name),
+                self.agent.name, self.agent.address)
+        if self.metrics_on == "period" and self.agent.metrics_period:
+            self.add_periodic_action(self.agent.metrics_period,
+                                     self._periodic_metrics)
+
+    # ------------------------------------------------------- lifecycle
+
+    @register("deploy")
+    def _on_deploy(self, sender, msg, t):
+        from ..utils.simple_repr import from_repr
+
+        comp_def = msg.comp_def
+        if isinstance(comp_def, dict):
+            comp_def = from_repr(comp_def)
+        comp = self._build_computation(comp_def)
+        self.agent.add_computation(comp)
+        self._deployed.append(comp.name)
+
+    def _build_computation(self, comp_def):
+        from ..algorithms import load_algorithm_module
+
+        algo_module = load_algorithm_module(comp_def.algo.algo)
+        if hasattr(algo_module, "build_computation"):
+            # message-passing algorithm (tutorial/control plane)
+            return algo_module.build_computation(comp_def)
+        return build_mirror_computation(comp_def)
+
+    @register("run_agent")
+    def _on_run(self, sender, msg, t):
+        names = msg.computations or None
+        self.agent.run_computations(names)
+
+    @register("pause")
+    def _on_pause(self, sender, msg, t):
+        for comp in self._targets(msg.computations):
+            comp.pause(True)
+
+    @register("resume")
+    def _on_resume(self, sender, msg, t):
+        for comp in self._targets(msg.computations):
+            comp.pause(False)
+
+    @register("stop_agent")
+    def _on_stop(self, sender, msg, t):
+        self.post_msg(ORCHESTRATOR_MGT, AgentStoppedMessage(
+            self.agent.name, self.agent.metrics.to_dict()), MSG_MGT)
+        self.agent.stop()
+
+    @register("agent_removed")
+    def _on_agent_removed(self, sender, msg, t):
+        # departure injected by a scenario event
+        # (reference: orchestrator.py:974)
+        self.agent.stop()
+
+    def _targets(self, names):
+        if not names:
+            return self.agent.computations()
+        return [self.agent.computation(n) for n in names
+                if self.agent.has_computation(n)]
+
+    # ----------------------------------------------------- data plane
+
+    @register("values")
+    def _on_values(self, sender, msg, t):
+        """Engine push: updated values for the mirrors hosted here."""
+        for comp_name, (value, cost) in msg.values.items():
+            if not self.agent.has_computation(comp_name):
+                continue
+            comp = self.agent.computation(comp_name)
+            if isinstance(comp, ValueMirrorComputation):
+                comp.set_value(value, cost, msg.cycle)
+
+    # ---------------------------------------------------- resilience
+
+    @register("replicate")
+    def _on_replicate(self, sender, msg, t):
+        comp_defs = {
+            c.name: c.computation_def
+            for c in self.agent.computations()
+            if getattr(c, "computation_def", None) is not None}
+
+        def done(dist):
+            self.post_msg(ORCHESTRATOR_MGT, ReplicationDoneMessage(
+                self.agent.name, dist.mapping), MSG_MGT)
+
+        self.agent.replicate(msg.k, comp_defs=comp_defs, on_done=done)
+
+    @register("setup_repair")
+    def _on_setup_repair(self, sender, msg, t):
+        comps = self.agent.setup_repair(msg.repair_info)
+        self.post_msg(ORCHESTRATOR_MGT, RepairReadyMessage(
+            self.agent.name, comps), MSG_MGT)
+
+    @register("repair_run")
+    def _on_repair_run(self, sender, msg, t):
+        won = self.agent.repair_run()
+        for comp_name in won:
+            comp_def = None
+            if comp_name in self.agent.replicas:
+                comp_def = self.agent.replicas[comp_name]
+            if comp_def is not None and \
+                    not self.agent.has_computation(comp_name):
+                comp = self._build_computation(comp_def)
+                self.agent.add_computation(comp)
+                comp.start()
+        self.post_msg(ORCHESTRATOR_MGT, RepairDoneMessage(
+            self.agent.name, won), MSG_MGT)
+
+    # ------------------------------------------------------- metrics
+
+    def report_value_change(self, computation, value, cost, cycle):
+        if self.metrics_on in ("value_change", None):
+            self.post_msg(ORCHESTRATOR_MGT, ValueChangeMessage(
+                self.agent.name, computation, value, cost, cycle),
+                MSG_VALUE)
+
+    def report_cycle_change(self, computation, cycle):
+        if self.metrics_on == "cycle_change":
+            self.post_msg(ORCHESTRATOR_MGT, CycleChangeMessage(
+                self.agent.name, computation, cycle), MSG_VALUE)
+
+    def _periodic_metrics(self):
+        self.post_msg(ORCHESTRATOR_MGT, MetricsMessage(
+            self.agent.name, self.agent.metrics.to_dict()), MSG_VALUE)
+
+
+class OrchestratedAgent(ResilientAgent):
+    """A ResilientAgent driven by a remote orchestrator
+    (reference: orchestratedagents.py:71-177)."""
+
+    def __init__(self, name: str, comm: CommunicationLayer,
+                 orchestrator_address, agent_def=None,
+                 metrics_on: Optional[str] = None,
+                 metrics_period: Optional[float] = None,
+                 replication: Optional[str] = None,
+                 ui_port: Optional[int] = None, delay: float = 0):
+        self.metrics_on = metrics_on
+        self.metrics_period = metrics_period
+        super().__init__(name, comm, agent_def=agent_def,
+                         replication=replication, ui_port=ui_port,
+                         delay=delay)
+        # seed the local cache so directory traffic can be routed
+        self.discovery.register_agent(
+            ORCHESTRATOR_AGENT, orchestrator_address, publish=False)
+        self.discovery.register_computation(
+            DIRECTORY_COMP, ORCHESTRATOR_AGENT, publish=False)
+        self.discovery.register_computation(
+            ORCHESTRATOR_MGT, ORCHESTRATOR_AGENT, publish=False)
+        self._orchestration = OrchestrationComputation(self)
+        self.add_computation(self._orchestration, publish=False)
+
+    @property
+    def orchestration(self) -> OrchestrationComputation:
+        return self._orchestration
+
+    def _on_start(self):
+        super()._on_start()
+        self._orchestration.start()
+
+    def _on_computation_value_changed(self, computation, value, cost,
+                                      cycle):
+        super()._on_computation_value_changed(computation, value, cost,
+                                              cycle)
+        self._orchestration.report_value_change(computation, value, cost,
+                                                cycle)
+
+    def _on_computation_new_cycle(self, computation, count):
+        super()._on_computation_new_cycle(computation, count)
+        self._orchestration.report_cycle_change(computation, count)

@@ -1,0 +1,596 @@
+"""Orchestrator: bootstraps, deploys, runs and repairs a DCOP system.
+
+reference parity: pydcop/infrastructure/orchestrator.py:58-1281.
+
+The orchestrator is itself an agent (named ``orchestrator``) hosting the
+discovery Directory and the :class:`AgentsMgt` management computation.
+Mirroring the reference's message vocabulary (:385-438), it deploys
+serialized ``ComputationDef``s to agents, starts/pauses/stops them,
+aggregates metrics and handles dynamic-DCOP scenario events (agent
+departures → replication-backed repair).
+
+TPU-first split: the *data plane* — the actual algorithm math — runs as
+one compiled engine driven from :meth:`Orchestrator.run` (a jitted step
+per synchronous round over the whole graph); between engine chunks the
+orchestrator pushes value updates to the owning agents' mirror
+computations, which feed the exact same metrics/reporting fabric the
+reference's in-agent computations do.  Message-passing algorithms (those
+exposing ``build_computation``, e.g. ``dsatuto``) instead run fully on
+the agents, as in the reference.
+"""
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ..utils.simple_repr import simple_repr
+from .agents import Agent
+from .communication import CommunicationLayer, MSG_MGT
+from .computations import MessagePassingComputation, message_type, register
+from .discovery import Directory
+from .Events import event_bus
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.orchestrator")
+
+ORCHESTRATOR_AGENT = "orchestrator"  # reference: orchestrator.py:58
+ORCHESTRATOR_MGT = "_mgt_orchestrator"
+
+
+def orchestration_comp_name(agent_name: str) -> str:
+    """Name of the management computation living on ``agent_name``."""
+    return f"_mgt_{agent_name}"
+
+
+# Orchestration message vocabulary (reference: orchestrator.py:385-438)
+DeployMessage = message_type("deploy", ["comp_def"])
+RunAgentMessage = message_type("run_agent", ["computations"])
+PauseMessage = message_type("pause", ["computations"])
+ResumeMessage = message_type("resume", ["computations"])
+StopAgentMessage = message_type("stop_agent", [])
+AgentRemovedMessage = message_type("agent_removed", [])
+ValuesMessage = message_type("values", ["values", "cycle"])
+AgentStoppedMessage = message_type("agent_stopped", ["agent", "metrics"])
+ValueChangeMessage = message_type(
+    "value_change", ["agent", "computation", "value", "cost", "cycle"])
+CycleChangeMessage = message_type(
+    "cycle_change", ["agent", "computation", "cycle"])
+MetricsMessage = message_type("metrics", ["agent", "metrics"])
+ReplicateMessage = message_type("replicate", ["k"])
+ReplicationDoneMessage = message_type(
+    "replication_done", ["agent", "replica_dist"])
+SetupRepairMessage = message_type("setup_repair", ["repair_info"])
+RepairReadyMessage = message_type("repair_ready",
+                                  ["agent", "computations"])
+RepairRunMessage = message_type("repair_run", [])
+RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+
+
+class AgentsMgt(MessagePassingComputation):
+    """Management computation aggregating the whole system's state
+    (reference: orchestrator.py:535-1281)."""
+
+    def __init__(self, orchestrator: "Orchestrator"):
+        super().__init__(ORCHESTRATOR_MGT)
+        self.orchestrator = orchestrator
+        self._lock = threading.Lock()
+        self.registered_agents: Set[str] = set()
+        self.registered_computations: Set[str] = set()
+        self.stopped_agents: Set[str] = set()
+        self.agent_metrics: Dict[str, Dict] = {}
+        self.current_values: Dict[str, Any] = {}
+        self.current_costs: Dict[str, float] = {}
+        self.max_cycle = 0
+        self.replica_dists: Dict[str, Dict] = {}
+        self.repair_ready_agents: Set[str] = set()
+        self.repair_done_agents: Set[str] = set()
+        self.repair_selected: Dict[str, List[str]] = {}
+        # events the orchestrator thread waits on
+        self.all_registered = threading.Event()
+        self.all_deployed = threading.Event()
+        self.all_stopped = threading.Event()
+        self.all_replicated = threading.Event()
+        self.repair_all_ready = threading.Event()
+        self.repair_all_done = threading.Event()
+        self._expected_repair_candidates: Set[str] = set()
+
+    # -------------------------------------------------- registrations
+
+    def on_agent_registered(self, evt: str, agent: str, _):
+        if evt != "agent_added" or agent == ORCHESTRATOR_AGENT \
+                or agent.startswith("_"):
+            return
+        with self._lock:
+            self.registered_agents.add(agent)
+            expected = set(self.orchestrator.expected_agents)
+            if expected and expected <= self.registered_agents:
+                self.all_registered.set()
+
+    def on_computation_registered(self, evt: str, computation: str, agt):
+        if evt != "computation_added":
+            return
+        with self._lock:
+            self.registered_computations.add(computation)
+            expected = set(self.orchestrator.expected_computations)
+            if expected and expected <= self.registered_computations:
+                self.all_deployed.set()
+
+    # ------------------------------------------------------- handlers
+
+    @register("agent_stopped")
+    def _on_agent_stopped(self, sender, msg, t):
+        with self._lock:
+            self.stopped_agents.add(msg.agent)
+            if msg.metrics:
+                self.agent_metrics[msg.agent] = msg.metrics
+            live = (set(self.orchestrator.live_agents)
+                    - self.orchestrator.departed_agents)
+            if live <= self.stopped_agents:
+                self.all_stopped.set()
+
+    @register("value_change")
+    def _on_value_change(self, sender, msg, t):
+        with self._lock:
+            self.current_values[msg.computation] = msg.value
+            self.current_costs[msg.computation] = msg.cost
+            self.max_cycle = max(self.max_cycle, msg.cycle or 0)
+        event_bus.send(f"computations.value.{msg.computation}",
+                       (msg.value, msg.cost, msg.cycle))
+        collector = self.orchestrator.collector
+        if collector is not None and \
+                self.orchestrator.collect_moment == "value_change":
+            collector.put((time.perf_counter(), msg.computation,
+                           msg.value, msg.cost, msg.cycle))
+
+    @register("cycle_change")
+    def _on_cycle_change(self, sender, msg, t):
+        with self._lock:
+            self.max_cycle = max(self.max_cycle, msg.cycle or 0)
+        collector = self.orchestrator.collector
+        if collector is not None and \
+                self.orchestrator.collect_moment == "cycle_change":
+            collector.put((time.perf_counter(), msg.computation,
+                           None, None, msg.cycle))
+
+    @register("metrics")
+    def _on_metrics(self, sender, msg, t):
+        with self._lock:
+            self.agent_metrics[msg.agent] = msg.metrics
+        collector = self.orchestrator.collector
+        if collector is not None and \
+                self.orchestrator.collect_moment == "period":
+            collector.put((time.perf_counter(), msg.agent, None, None,
+                           self.max_cycle))
+
+    @register("replication_done")
+    def _on_replication_done(self, sender, msg, t):
+        with self._lock:
+            self.replica_dists[msg.agent] = msg.replica_dist or {}
+            live = (set(self.orchestrator.live_agents)
+                    - self.orchestrator.departed_agents)
+            if live <= set(self.replica_dists):
+                self.all_replicated.set()
+
+    @register("repair_ready")
+    def _on_repair_ready(self, sender, msg, t):
+        with self._lock:
+            self.repair_ready_agents.add(msg.agent)
+            ready = self._expected_repair_candidates <= \
+                self.repair_ready_agents
+        if ready:
+            self.repair_all_ready.set()
+            for agent in self._expected_repair_candidates:
+                self.post_msg(orchestration_comp_name(agent),
+                              RepairRunMessage(), MSG_MGT)
+
+    @register("repair_done")
+    def _on_repair_done(self, sender, msg, t):
+        with self._lock:
+            self.repair_done_agents.add(msg.agent)
+            self.repair_selected[msg.agent] = list(msg.selected or [])
+            if self._expected_repair_candidates <= self.repair_done_agents:
+                self.repair_all_done.set()
+
+    def start_repair(self, candidates: Set[str], repair_info: Dict):
+        """Send setup_repair to all candidates and arm the events
+        (called from the orchestrator thread)."""
+        with self._lock:
+            self._expected_repair_candidates = set(candidates)
+            self.repair_ready_agents = set()
+            self.repair_done_agents = set()
+            self.repair_selected = {}
+            self.repair_all_ready.clear()
+            self.repair_all_done.clear()
+        for agent in candidates:
+            self.post_msg(orchestration_comp_name(agent),
+                          SetupRepairMessage(repair_info), MSG_MGT)
+
+
+class Orchestrator:
+    """Bootstraps and drives a full DCOP system
+    (reference: orchestrator.py:62-533)."""
+
+    def __init__(self, algo, cg, agent_mapping, comm: CommunicationLayer,
+                 dcop=None, collector: Optional[queue.Queue] = None,
+                 collect_moment: str = "value_change",
+                 collect_period: Optional[float] = None,
+                 ui_port: Optional[int] = None):
+        self.algo = algo
+        self.cg = cg
+        self.distribution = agent_mapping
+        self.dcop = dcop
+        self.collector = collector
+        self.collect_moment = collect_moment
+        self.collect_period = collect_period
+        self._own_agent = Agent(ORCHESTRATOR_AGENT, comm,
+                                ui_port=ui_port)
+        self.directory = Directory(self._own_agent.discovery)
+        self._own_agent.add_computation(
+            self.directory.directory_computation, publish=False)
+        self.mgt = AgentsMgt(self)
+        self._own_agent.add_computation(self.mgt, publish=False)
+        self._own_agent.discovery.subscribe_agent_local(
+            "*", self.mgt.on_agent_registered)
+        self._own_agent.discovery.subscribe_computation_local(
+            "*", self.mgt.on_computation_registered)
+        self.departed_agents: Set[str] = set()
+        self.status = "STOPPED"
+        self._result = None
+        self._ready = threading.Event()
+        self._stopping = False
+
+    # ----------------------------------------------------------- props
+
+    @property
+    def address(self):
+        return self._own_agent.address
+
+    @property
+    def discovery(self):
+        return self._own_agent.discovery
+
+    @property
+    def expected_agents(self) -> List[str]:
+        return [a for a in self.distribution.agents]
+
+    @property
+    def live_agents(self) -> List[str]:
+        return [a for a in self.distribution.agents
+                if a not in self.departed_agents]
+
+    @property
+    def expected_computations(self) -> List[str]:
+        return [c for c in self.distribution.computations]
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self):
+        self._own_agent.start()
+        self.directory.directory_computation.start()
+        self.mgt.start()
+        self.status = "STARTED"
+        return self
+
+    def deploy_computations(self, timeout: float = 15):
+        """Wait for all agents, then ship every ComputationDef to its
+        host (reference: orchestrator.py:203-244, 915-1213)."""
+        from ..algorithms import ComputationDef
+
+        if not self.mgt.all_registered.wait(timeout):
+            missing = set(self.expected_agents) - \
+                self.mgt.registered_agents
+            raise TimeoutError(
+                f"Agents not registered after {timeout}s: {missing}")
+        for comp_name in self.distribution.computations:
+            agent = self.distribution.agent_for(comp_name)
+            node = self.cg.computation(comp_name)
+            comp_def = ComputationDef(node, self.algo)
+            self.mgt.post_msg(
+                orchestration_comp_name(agent),
+                DeployMessage(simple_repr(comp_def)), MSG_MGT)
+        if not self.mgt.all_deployed.wait(timeout):
+            missing = set(self.expected_computations) - \
+                self.mgt.registered_computations
+            raise TimeoutError(
+                f"Computations not deployed after {timeout}s: {missing}")
+
+    def start_replication(self, k: int, timeout: float = 30):
+        """Ask every agent to place k replicas of its computations
+        (reference: orchestrator.py:223-244)."""
+        self.mgt.all_replicated.clear()
+        for agent in self.live_agents:
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              ReplicateMessage(k), MSG_MGT)
+        if not self.mgt.all_replicated.wait(timeout):
+            raise TimeoutError("Replication did not finish in time")
+        merged: Dict[str, List[str]] = {}
+        for dist in self.mgt.replica_dists.values():
+            for comp, agents in (dist or {}).items():
+                merged.setdefault(comp, []).extend(agents)
+        return merged
+
+    def run(self, scenario=None, timeout: Optional[float] = None,
+            max_cycles: int = 2000, seed: int = 0):
+        """Run the system: compiled engine + agent fabric
+        (reference: orchestrator.py:245-374)."""
+        from ..algorithms import load_algorithm_module
+
+        self.status = "RUNNING"
+        for agent in self.live_agents:
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              RunAgentMessage(None), MSG_MGT)
+        algo_module = load_algorithm_module(self.algo.algo)
+        try:
+            if hasattr(algo_module, "build_solver") or \
+                    hasattr(algo_module, "solve_direct"):
+                self._run_compiled(algo_module, scenario, timeout,
+                                   max_cycles, seed)
+            else:
+                self._run_message_passing(timeout)
+        finally:
+            if self.status == "RUNNING":
+                self.status = "FINISHED"
+            self._ready.set()
+        return self._result
+
+    def _run_compiled(self, algo_module, scenario, timeout, max_cycles,
+                      seed):
+        """Drive the jitted engine, pushing values to agent mirrors
+        between chunks and applying scenario events at their offsets."""
+        import jax
+
+        from ..engine.sync_engine import SyncEngine
+
+        if self.dcop is None:
+            raise ValueError("Orchestrator needs the DCOP to run "
+                             "compiled algorithms")
+        t0 = time.perf_counter()
+        if hasattr(algo_module, "solve_direct"):
+            result = algo_module.solve_direct(self.dcop, self.algo.params,
+                                              timeout=timeout)
+            self._push_values(result.assignment, result.cycles)
+            self._finish_run(result)
+            return
+        solver = algo_module.build_solver(self.dcop, self.algo.params)
+        engine = SyncEngine(solver)
+        variables = [self.dcop.variable(n) for n in solver.var_names]
+        key = jax.random.PRNGKey(seed)
+        state = solver.init_state(key)
+        events = _scenario_offsets(scenario)
+        status = "MAX_CYCLES"
+        import jax.numpy as jnp
+
+        last_pushed: Dict[str, Any] = {}
+        while True:
+            elapsed = time.perf_counter() - t0
+            while events and events[0][0] <= elapsed:
+                _, actions = events.pop(0)
+                self._apply_scenario_actions(actions)
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                break
+            if cycle >= max_cycles:
+                break
+            if timeout is not None and elapsed > timeout:
+                status = "TIMEOUT"
+                break
+            limit = min(cycle + 16, max_cycles)
+            state = engine._run_chunk(state, jnp.int32(limit))
+            self._push_state(engine, solver, state, variables,
+                             last_pushed)
+        from ..engine.solver import RunResult
+
+        idx = jax.device_get(engine._idx(state))
+        assignment = {
+            v.name: v.domain.values[int(i)]
+            for v, i in zip(variables, idx)}
+        cost, violations = (self.dcop.solution_cost(assignment)
+                            if assignment else (0.0, 0))
+        result = RunResult(
+            assignment=assignment, cycles=int(state["cycle"]),
+            finished=bool(state["finished"]), cost=cost,
+            violations=violations,
+            duration=time.perf_counter() - t0, status=status)
+        self._push_values(assignment, result.cycles)
+        self._finish_run(result)
+
+    def _push_state(self, engine, solver, state, variables, last_pushed):
+        import jax
+
+        idx = jax.device_get(engine._idx(state))
+        cycle = int(state["cycle"])
+        changed = {}
+        for v, i in zip(variables, idx):
+            val = v.domain.values[int(i)]
+            if last_pushed.get(v.name) != val:
+                last_pushed[v.name] = val
+                changed[v.name] = val
+        if changed:
+            self._push_values(changed, cycle)
+
+    def _push_values(self, values: Dict[str, Any], cycle: int):
+        """Send per-agent value updates for their hosted mirrors."""
+        by_agent: Dict[str, Dict[str, Any]] = {}
+        for comp, val in values.items():
+            try:
+                agent = self.distribution.agent_for(comp)
+            except (KeyError, ValueError):
+                continue
+            if agent in self.departed_agents:
+                continue
+            by_agent.setdefault(agent, {})[comp] = (val, 0.0)
+        for agent, vals in by_agent.items():
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              ValuesMessage(vals, cycle), MSG_MGT)
+
+    def _run_message_passing(self, timeout):
+        """Algorithms that run fully on the agents (e.g. dsatuto)."""
+        deadline = time.perf_counter() + (timeout or 5)
+        while time.perf_counter() < deadline:
+            time.sleep(0.1)
+        from ..engine.solver import RunResult
+
+        assignment = dict(self.mgt.current_values)
+        cost, violations = (0.0, 0)
+        if self.dcop is not None and assignment and \
+                set(assignment) >= set(self.dcop.variables):
+            cost, violations = self.dcop.solution_cost(
+                {k: v for k, v in assignment.items()
+                 if k in self.dcop.variables})
+        self._result = RunResult(
+            assignment=assignment, cycles=self.mgt.max_cycle,
+            finished=False, cost=cost, violations=violations,
+            duration=timeout or 5, status="TIMEOUT")
+
+    def _finish_run(self, result):
+        self._result = result
+        self.status = result.status
+
+    # ------------------------------------------------ dynamic scenario
+
+    def _apply_scenario_actions(self, actions):
+        """Pause → remove agents → repair → resume
+        (reference: orchestrator.py:955-1124)."""
+        removed = []
+        for action in actions:
+            if action.type == "remove_agent":
+                removed.extend(_action_agents(action))
+            elif action.type == "add_agent":
+                logger.warning("add_agent scenario events need external "
+                               "agent processes; ignored in local run")
+        if not removed:
+            return
+        logger.info("Scenario event: removing agents %s", removed)
+        for agent in self.live_agents:
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              PauseMessage(None), MSG_MGT)
+        orphaned_with_candidates = self._remove_agents(removed)
+        for agent in self.live_agents:
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              ResumeMessage(None), MSG_MGT)
+
+    def _remove_agents(self, removed: List[str]):
+        from ..reparation.removal import build_repair_info
+
+        for agent in removed:
+            if agent in self.departed_agents:
+                continue
+            self.mgt.post_msg(orchestration_comp_name(agent),
+                              AgentRemovedMessage(), MSG_MGT)
+            self.departed_agents.add(agent)
+            self.distribution.remove_agent(agent)
+        agent_defs = {}
+        if self.dcop is not None:
+            agent_defs = dict(self.dcop.agents)
+        repair_info = build_repair_info(removed, self.discovery,
+                                        agent_defs)
+        candidates = {a for agts in repair_info["candidates"].values()
+                      for a in agts}
+        candidates -= self.departed_agents
+        # drop departed agents from the directory
+        for agent in removed:
+            try:
+                self.discovery.unregister_agent(agent, publish=True)
+            except Exception:
+                pass
+        if not candidates:
+            logger.warning("No repair candidates for %s (no replicas?)",
+                           removed)
+            return repair_info
+        self.mgt.start_repair(candidates, repair_info)
+        if not self.mgt.repair_all_done.wait(30):
+            logger.warning("Repair did not complete in time")
+        else:
+            # update the distribution with the repaired placement
+            for agent, comps in self.mgt.repair_selected.items():
+                for comp in comps:
+                    self.distribution.move_computation(comp, agent)
+        return repair_info
+
+    # -------------------------------------------------------- results
+
+    def current_global_assignment(self) -> Dict[str, Any]:
+        return dict(self.mgt.current_values)
+
+    def global_metrics(self) -> Dict[str, Any]:
+        """Aggregate system metrics (reference: orchestrator.py:1215)."""
+        assignment = (self._result.assignment if self._result
+                      else self.current_global_assignment())
+        cost, violations = None, None
+        if self.dcop is not None and assignment:
+            try:
+                cost, violations = self.dcop.solution_cost(assignment)
+            except Exception:
+                pass
+        msg_count = sum(
+            sum(m.get("count_ext_msg", {}).values())
+            for m in self.mgt.agent_metrics.values())
+        msg_size = sum(
+            sum(m.get("size_ext_msg", {}).values())
+            for m in self.mgt.agent_metrics.values())
+        activity = {
+            a: m.get("activity_ratio", 0.0)
+            for a, m in self.mgt.agent_metrics.items()}
+        return {
+            "assignment": assignment,
+            "cost": cost,
+            "violation_count": violations,
+            "msg_count": msg_count,
+            "msg_size": msg_size,
+            "cycle": (self._result.cycles if self._result
+                      else self.mgt.max_cycle),
+            "agents_activity": activity,
+            "status": self.status,
+        }
+
+    def end_metrics(self) -> Dict[str, Any]:
+        return self.global_metrics()
+
+    @property
+    def result(self):
+        return self._result
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return self._ready.wait(timeout)
+
+    # ----------------------------------------------------------- stop
+
+    def stop_agents(self, timeout: float = 10):
+        """Cleanly stop all live agents and collect their metrics
+        (reference: orchestrator.py:291-340, 1180)."""
+        for agent in self.live_agents:
+            if agent not in self.mgt.stopped_agents:
+                self.mgt.post_msg(orchestration_comp_name(agent),
+                                  StopAgentMessage(), MSG_MGT)
+        self.mgt.all_stopped.wait(timeout)
+
+    def stop(self):
+        self._stopping = True
+        self._own_agent.clean_shutdown()
+        self.status = "STOPPED" if self._result is None else self.status
+
+
+def _scenario_offsets(scenario):
+    """Flatten a Scenario into [(wall_offset_seconds, actions), ...]."""
+    if scenario is None:
+        return []
+    out = []
+    offset = 0.0
+    for event in scenario.events:
+        if event.is_delay:
+            offset += event.delay
+        else:
+            out.append((offset, list(event.actions)))
+    return out
+
+
+def _action_agents(action) -> List[str]:
+    args = action.args or {}
+    agents = args.get("agents", args.get("agent"))
+    if agents is None:
+        return []
+    if isinstance(agents, str):
+        return [agents]
+    return list(agents)

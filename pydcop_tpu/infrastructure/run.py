@@ -8,7 +8,7 @@ instead of spawning a thread per agent.
 """
 
 import time
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from ..algorithms import AlgorithmDef, load_algorithm_module
 from ..dcop.dcop import DCOP
@@ -93,3 +93,186 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     if dist_obj is not None:
         result.metrics["distribution"] = dist_obj.mapping()
     return result
+
+# --------------------------------------------------------------------------
+# Orchestrated runtime bootstrap (reference: infrastructure/run.py:145-287)
+# --------------------------------------------------------------------------
+
+
+def _prepare_run(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
+                 distribution: Union[str, Any] = "adhoc",
+                 graph: Optional[str] = None,
+                 algo_params: Optional[Dict[str, Any]] = None):
+    """Build (algo_def, graph, distribution) for an orchestrated run."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, params=algo_params, mode=dcop.objective)
+    algo_module = load_algorithm_module(algo_def.algo)
+    graph_module = load_graph_module(graph or algo_module.GRAPH_TYPE)
+    cg = graph_module.build_computation_graph(dcop)
+    if isinstance(distribution, str):
+        from ..distribution import load_distribution_module
+
+        dist_module = load_distribution_module(distribution)
+        dist = dist_module.distribute(
+            cg, dcop.agents_def, dcop.dist_hints,
+            algo_module.computation_memory,
+            algo_module.communication_load)
+    else:
+        dist = distribution
+    return algo_def, cg, dist
+
+
+def run_local_thread_dcop(algo_def, cg, distribution, dcop,
+                          collector=None,
+                          collect_moment: str = "value_change",
+                          collect_period: Optional[float] = None,
+                          replication: Optional[str] = None,
+                          delay: float = 0,
+                          uiport: Optional[int] = None):
+    """One thread per agent, in-process communication
+    (reference: infrastructure/run.py:145-224).  Returns the started
+    Orchestrator, with the local agents attached as ``local_agents``."""
+    from .communication import InProcessCommunicationLayer
+    from .orchestrator import Orchestrator
+    from .orchestratedagents import OrchestratedAgent
+
+    comm = InProcessCommunicationLayer()
+    orchestrator = Orchestrator(
+        algo_def, cg, distribution, comm, dcop=dcop,
+        collector=collector, collect_moment=collect_moment,
+        collect_period=collect_period)
+    orchestrator.start()
+    agents: List[OrchestratedAgent] = []
+    port = uiport
+    for agent_def in dcop.agents_def:
+        if agent_def.name not in distribution.agents:
+            continue
+        if port is not None:
+            port += 1
+        a = OrchestratedAgent(
+            agent_def.name, InProcessCommunicationLayer(),
+            orchestrator.address, agent_def=agent_def,
+            metrics_on=collect_moment, metrics_period=collect_period,
+            replication=replication, ui_port=port, delay=delay)
+        a.start()
+        agents.append(a)
+    orchestrator.local_agents = agents
+    return orchestrator
+
+
+def _process_agent_main(name: str, port: int, orchestrator_host: str,
+                        orchestrator_port: int, agent_def_repr: Dict,
+                        metrics_on: str,
+                        metrics_period: Optional[float],
+                        replication: Optional[str], delay: float):
+    """Entry point of one agent process
+    (reference: infrastructure/run.py:268-287)."""
+    from ..utils.simple_repr import from_repr
+    from .communication import Address, HttpCommunicationLayer
+    from .orchestratedagents import OrchestratedAgent
+
+    agent_def = from_repr(agent_def_repr) if agent_def_repr else None
+    comm = HttpCommunicationLayer(("127.0.0.1", port))
+    agent = OrchestratedAgent(
+        name, comm, Address(orchestrator_host, orchestrator_port),
+        agent_def=agent_def, metrics_on=metrics_on,
+        metrics_period=metrics_period, replication=replication,
+        delay=delay)
+    agent.start()
+    agent._shutdown.wait()
+
+
+def run_local_process_dcop(algo_def, cg, distribution, dcop,
+                           collector=None,
+                           collect_moment: str = "value_change",
+                           collect_period: Optional[float] = None,
+                           replication: Optional[str] = None,
+                           delay: float = 0,
+                           port: int = 9000):
+    """One OS process per agent, HTTP/JSON communication on localhost
+    (reference: infrastructure/run.py:225-287).  Returns the started
+    Orchestrator with the processes attached as ``agent_processes``."""
+    import multiprocessing
+
+    from ..utils.simple_repr import simple_repr
+    from .communication import HttpCommunicationLayer
+    from .orchestrator import Orchestrator
+
+    comm = HttpCommunicationLayer(("127.0.0.1", port))
+    orchestrator = Orchestrator(
+        algo_def, cg, distribution, comm, dcop=dcop,
+        collector=collector, collect_moment=collect_moment,
+        collect_period=collect_period)
+    orchestrator.start()
+    ctx = multiprocessing.get_context("spawn")
+    processes = []
+    agent_port = port
+    for agent_def in dcop.agents_def:
+        if agent_def.name not in distribution.agents:
+            continue
+        agent_port += 1
+        p = ctx.Process(
+            target=_process_agent_main,
+            args=(agent_def.name, agent_port, "127.0.0.1", port,
+                  simple_repr(agent_def), collect_moment, collect_period,
+                  replication, delay),
+            name=f"agent-{agent_def.name}", daemon=True)
+        p.start()
+        processes.append(p)
+    orchestrator.agent_processes = processes
+    return orchestrator
+
+
+def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
+             distribution: Union[str, Any] = "adhoc",
+             mode: str = "thread", scenario=None,
+             timeout: Optional[float] = 10,
+             ktarget: Optional[int] = None,
+             replication: Optional[str] = None,
+             collector=None, collect_moment: str = "value_change",
+             collect_period: Optional[float] = None,
+             seed: int = 0, max_cycles: int = 2000,
+             port: int = 9000, graph: Optional[str] = None,
+             **algo_params) -> RunResult:
+    """End-to-end orchestrated run, with optional dynamic scenario +
+    k-replication (the library-level counterpart of the ``run`` CLI;
+    reference: commands/run.py:314).  Extra ``algo_params`` are passed
+    as algorithm parameters; ``port`` is the HTTP base port in process
+    mode.
+    """
+    if mode not in ("thread", "process"):
+        raise ValueError(f"Invalid mode {mode!r}: 'thread' or 'process'")
+    algo_def, cg, dist = _prepare_run(dcop, algo_def, distribution,
+                                      graph=graph,
+                                      algo_params=algo_params or None)
+    rep = replication or ("dist_ucs_hostingcosts" if ktarget else None)
+    if mode == "thread":
+        orchestrator = run_local_thread_dcop(
+            algo_def, cg, dist, dcop, collector=collector,
+            collect_moment=collect_moment,
+            collect_period=collect_period, replication=rep)
+    else:
+        orchestrator = run_local_process_dcop(
+            algo_def, cg, dist, dcop, collector=collector,
+            collect_moment=collect_moment,
+            collect_period=collect_period, replication=rep, port=port)
+    try:
+        orchestrator.deploy_computations()
+        if ktarget:
+            orchestrator.start_replication(ktarget)
+        result = orchestrator.run(scenario=scenario, timeout=timeout,
+                                  max_cycles=max_cycles, seed=seed)
+        orchestrator.stop_agents()
+        metrics = orchestrator.global_metrics()
+        if result is not None:
+            result.metrics.update(metrics)
+        return result
+    finally:
+        orchestrator.stop()
+        for agent in getattr(orchestrator, "local_agents", []):
+            agent.clean_shutdown(1)
+        for p in getattr(orchestrator, "agent_processes", []):
+            p.join(2)
+            if p.is_alive():
+                p.terminate()

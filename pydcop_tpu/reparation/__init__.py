@@ -1,0 +1,125 @@
+"""Resilience: repair of orphaned computations after agent departure.
+
+reference parity: pydcop/reparation/ (229 LoC __init__ + removal.py).
+
+The repair problem is itself a DCOP (reference: agents.py:1047-1258):
+one binary activation variable per (orphaned computation, candidate
+agent) pair, with
+
+* a hard-ish "exactly one host per computation" constraint,
+* per-agent capacity constraints,
+* unary hosting costs.
+
+The reference solves it with distributed MGM-style computations spread
+over the candidate agents.  TPU-first redesign: the repair info shipped
+to candidates is global and deterministic, so every candidate solves the
+*same* compiled repair DCOP (our DSA/MGM engine, fixed seed) and reads
+off its own wins — replicated deterministic solving replaces the repair
+message protocol while keeping the decision distributed (every agent
+computes its own outcome; no agent is told what to host by a peer).
+"""
+
+from typing import Dict, List
+
+from .removal import build_repair_info, candidate_agents, \
+    orphaned_computations  # noqa: F401  (re-exported)
+
+# penalty magnitudes for the soft encodings of the hard rules
+_ORPHAN_PENALTY = 10_000.0
+_CAPACITY_PENALTY = 10_000.0
+
+
+def build_repair_dcop(repair_info: Dict) -> "DCOP":
+    """Build the repair DCOP from a repair-info dict
+    (see :func:`removal.build_repair_info`)."""
+    from ..dcop.dcop import DCOP
+    from ..dcop.objects import BinaryVariable
+    from ..dcop.relations import NAryFunctionRelation, \
+        UnaryFunctionRelation
+
+    dcop = DCOP("repair", objective="min")
+
+    variables: Dict[str, Dict[str, BinaryVariable]] = {}
+    for comp, agents in repair_info["candidates"].items():
+        variables[comp] = {}
+        for agent in agents:
+            v = BinaryVariable(_repair_var_name(comp, agent))
+            variables[comp][agent] = v
+            dcop.add_variable(v)
+            hosting = repair_info["hosting_costs"].get(agent, {}).get(
+                comp, 0.0)
+            if hosting:
+                dcop.add_constraint(UnaryFunctionRelation(
+                    f"hosting_{comp}_{agent}", v,
+                    lambda x, h=hosting: h * x))
+
+    # exactly one host per computation (reference: agents.py:1159-1199)
+    for comp, by_agent in variables.items():
+        vs = list(by_agent.values())
+        if not vs:
+            continue
+
+        def one_host(*vals):
+            return _ORPHAN_PENALTY * abs(sum(vals) - 1)
+
+        dcop.add_constraint(NAryFunctionRelation(
+            one_host, vs, name=f"one_host_{comp}"))
+
+    # capacity per candidate agent (reference: agents.py:1200-1246)
+    by_candidate: Dict[str, List] = {}
+    for comp, by_agent in variables.items():
+        for agent, v in by_agent.items():
+            by_candidate.setdefault(agent, []).append(v)
+    for agent, vs in by_candidate.items():
+        cap = repair_info["capacity"].get(agent, float("inf"))
+        if cap == float("inf") or len(vs) <= 1:
+            continue
+
+        def within_cap(*vals, _cap=cap):
+            extra = sum(vals) - _cap
+            return _CAPACITY_PENALTY * extra if extra > 0 else 0.0
+
+        dcop.add_constraint(NAryFunctionRelation(
+            within_cap, vs, name=f"capacity_{agent}"))
+    return dcop
+
+
+def solve_repair(repair_info: Dict, seed: int = 0) -> Dict[str, str]:
+    """Solve the repair DCOP; returns computation -> winning agent.
+
+    Deterministic for a given ``repair_info`` + ``seed`` so that every
+    candidate agent can run it independently and agree on the outcome.
+    """
+    if not repair_info.get("orphaned"):
+        return {}
+    dcop = build_repair_dcop(repair_info)
+    if not dcop.variables:
+        return {}
+    from ..infrastructure.run import solve_result
+
+    res = solve_result(dcop, "mgm", timeout=10, max_cycles=100, seed=seed,
+                       stop_cycle=50)
+    placement: Dict[str, str] = {}
+    for comp, agents in repair_info["candidates"].items():
+        chosen = [a for a in agents
+                  if res.assignment.get(_repair_var_name(comp, a)) == 1]
+        if chosen:
+            placement[comp] = sorted(chosen)[0]
+        elif agents:
+            # penalty solve failed to activate anyone: cheapest fallback
+            placement[comp] = min(
+                agents,
+                key=lambda a: repair_info["hosting_costs"]
+                .get(a, {}).get(comp, 0.0))
+    return placement
+
+
+def solve_repair_dcop(agent, repair_info: Dict) -> List[str]:
+    """The wins of one candidate agent (used by
+    ResilientAgent.repair_run; reference: agents.py:1260-1382)."""
+    placement = solve_repair(repair_info, seed=0)
+    return sorted(c for c, a in placement.items() if a == agent.name)
+
+
+def _repair_var_name(comp: str, agent: str) -> str:
+    return f"x_{comp}__{agent}"

@@ -1,0 +1,65 @@
+"""Repair-problem data extraction after agent departures.
+
+reference parity: pydcop/reparation/removal.py:38-167.  Given the set of
+departed agents, derive the orphaned computations, the candidate agents
+(replica holders) and the per-candidate data needed to build the repair
+DCOP.
+"""
+
+from typing import Dict, Iterable, List, Set
+
+
+def orphaned_computations(departed: Iterable[str], discovery
+                          ) -> List[str]:
+    """Computations hosted (only) on departed agents
+    (reference: removal.py:38-60)."""
+    orphaned: List[str] = []
+    for agent in departed:
+        orphaned.extend(discovery.agent_computations(agent))
+    return sorted(set(orphaned))
+
+
+def candidate_agents(orphaned: Iterable[str], discovery,
+                     departed: Iterable[str] = ()) -> Dict[str, Set[str]]:
+    """For each orphaned computation, the agents holding a replica of it
+    (reference: removal.py:61-100)."""
+    departed = set(departed)
+    return {
+        comp: {a for a in discovery.replica_agents(comp)
+               if a not in departed}
+        for comp in orphaned}
+
+
+def build_repair_info(departed: Iterable[str], discovery,
+                      agent_defs: Dict[str, object] = None
+                      ) -> Dict[str, object]:
+    """Assemble the data each candidate needs to set up the repair DCOP
+    (reference: removal.py:101-167 + agents.py:1047-1258).
+
+    The info is *global and deterministic*: every candidate receives the
+    same dict, so each can solve the same repair DCOP with the same seed
+    and read off its own wins without further coordination.
+    """
+    departed = sorted(set(departed))
+    orphaned = orphaned_computations(departed, discovery)
+    candidates = candidate_agents(orphaned, discovery, departed)
+    agent_defs = agent_defs or {}
+    hosting: Dict[str, Dict[str, float]] = {}
+    capacity: Dict[str, float] = {}
+    all_candidates = sorted({a for agts in candidates.values()
+                             for a in agts})
+    for agent in all_candidates:
+        adef = agent_defs.get(agent)
+        hosting[agent] = {
+            comp: (adef.hosting_cost(comp) if adef is not None else 0.0)
+            for comp in orphaned}
+        capacity[agent] = (
+            float(adef.capacity) if adef is not None and
+            adef.capacity is not None else float("inf"))
+    return {
+        "departed": departed,
+        "orphaned": orphaned,
+        "candidates": {c: sorted(a) for c, a in candidates.items()},
+        "hosting_costs": hosting,
+        "capacity": capacity,
+    }

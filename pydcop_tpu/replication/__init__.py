@@ -1,0 +1,5 @@
+"""Resilience: k-replication of computations.
+
+reference parity: pydcop/replication/ (dist_ucs_hostingcosts.py,
+path_utils.py, objects.py, yamlformat.py).
+"""

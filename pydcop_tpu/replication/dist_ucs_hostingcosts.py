@@ -1,0 +1,319 @@
+"""Distributed replica placement: uniform-cost search over route +
+hosting costs.
+
+reference parity: pydcop/replication/dist_ucs_hostingcosts.py:60-1278.
+The reference places ``k`` replicas of every computation on the cheapest
+agents, where cheap = route-path cost to reach the agent + its hosting
+cost, under capacity limits, via a hop-by-hop request/answer protocol
+(:573-860) with budget-limited path exploration.
+
+This build keeps the same placement semantics and the same *message*
+protocol shape (control plane over the agent fabric — it must work
+across hosts on DCN), but splits it into two phases:
+
+1. **explore** — poll agents in cheapest-known-path order; every answer
+   reports the agent's hosting cost, free capacity and outgoing route
+   costs, which extend the initiator's paths table (the UCS frontier);
+   exploration stops when the cheapest unexplored path cannot beat the
+   current k-th best candidate (UCS admissibility) or all agents are
+   seen.
+2. **commit** — ask the chosen k agents to actually hold the replica;
+   a refusal (capacity raced away) falls back to the next candidate.
+"""
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..infrastructure.communication import MSG_MGT
+from ..infrastructure.computations import MessagePassingComputation, \
+    message_type, register
+from .objects import ReplicaDistribution
+from .path_utils import PathsTable, cheapest_path_to
+
+logger = logging.getLogger("pydcop_tpu.replication.ucs")
+
+# per-agent virtual hosting node trick (reference: :60-82): the cost of
+# hosting on an agent is modelled as one extra edge to a virtual
+# "__hosting__" node, which is what makes plain UCS find route+hosting
+# optima.  We keep the constant for YAML-compat.
+HOSTING_NODE = "__hosting__"
+
+ReplicaRequestMessage = message_type(
+    "replica_request", ["computation", "footprint", "commit"])
+ReplicaAnswerMessage = message_type(
+    "replica_answer",
+    ["computation", "accept", "hosting_cost", "capacity", "routes",
+     "commit"])
+
+
+def replication_computation_name(agent_name: str) -> str:
+    return f"_replication_{agent_name}"
+
+
+class UCSReplication(MessagePassingComputation):
+    """Per-agent replication computation
+    (reference: dist_ucs_hostingcosts.py:265-572)."""
+
+    def __init__(self, agent):
+        super().__init__(replication_computation_name(agent.name))
+        self.agent = agent
+        self._runs: Dict[str, "_CompReplication"] = {}
+        self._k = 0
+        self.on_done: Optional[Callable] = None
+
+    # ------------------------------------------------------- initiator
+
+    def start_replication(self, k: int,
+                          comp_defs: Dict[str, Any]) -> None:
+        """Start placing k replicas of each given computation.
+
+        ``comp_defs``: computation name -> ComputationDef (shipped in
+        commit requests so holders can rebuild the computation after a
+        failure).
+        """
+        self._k = k
+        if not comp_defs:
+            self._finish()
+            return
+        for comp_name, comp_def in comp_defs.items():
+            run = _CompReplication(self, comp_name, comp_def, k)
+            self._runs[comp_name] = run
+        # start after all runs are registered: answers may interleave
+        for run in list(self._runs.values()):
+            run.start()
+
+    def _run_finished(self, comp_name: str):
+        if all(r.done for r in self._runs.values()):
+            self._finish()
+
+    def _finish(self):
+        dist = ReplicaDistribution(
+            {c: sorted(r.placed) for c, r in self._runs.items()})
+        self._runs = {}
+        if self.on_done is not None:
+            self.on_done(dist)
+
+    @register("replica_answer")
+    def _on_answer(self, sender, msg, t):
+        run = self._runs.get(msg.computation)
+        if run is not None:
+            run.on_answer(sender, msg)
+
+    # -------------------------------------------------------- receiver
+
+    @register("replica_request")
+    def _on_request(self, sender, msg, t):
+        agent_def = self.agent.agent_def
+        footprint = msg.footprint or 0.0
+        free = self._free_capacity()
+        accept = free is None or free >= footprint
+        hosting = (agent_def.hosting_cost(msg.computation)
+                   if agent_def is not None else 0.0)
+        routes: Dict[str, float] = {}
+        if agent_def is not None:
+            for other in self.agent.discovery.agents():
+                if other != self.agent.name and \
+                        not other.startswith("_") and \
+                        other != "orchestrator":
+                    routes[other] = agent_def.route(other)
+        if accept and msg.commit:
+            comp_def = None
+            if msg.commit is not True:
+                from ..utils.simple_repr import from_repr
+
+                try:
+                    comp_def = from_repr(msg.commit)
+                except Exception:
+                    comp_def = None
+            self.agent.accept_replica(msg.computation, comp_def)
+        self.post_msg(sender, ReplicaAnswerMessage(
+            msg.computation, accept, hosting,
+            free if free is not None else -1.0, routes, msg.commit),
+            MSG_MGT)
+
+    def _free_capacity(self) -> Optional[float]:
+        agent_def = self.agent.agent_def
+        if agent_def is None or agent_def.capacity is None:
+            return None
+        used = 0.0
+        for comp in self.agent.computations():
+            try:
+                used += comp.footprint()
+            except Exception:
+                used += 1.0
+        for rep in getattr(self.agent, "replicas", {}):
+            used += 1.0
+        return agent_def.capacity - used
+
+
+class _CompReplication:
+    """UCS state for one computation's k replicas (initiator side)."""
+
+    def __init__(self, comp: UCSReplication, comp_name: str, comp_def,
+                 k: int):
+        self.comp = comp
+        self.comp_name = comp_name
+        self.comp_def = comp_def
+        self.k = k
+        self.paths: PathsTable = {}
+        self.explored: Set[str] = {comp.agent.name}
+        self.pending: Optional[str] = None
+        # agent -> (total_cost, accepted)
+        self.candidates: Dict[str, Tuple[float, bool]] = {}
+        self.committing: List[str] = []
+        self.placed: Set[str] = set()
+        self.done = False
+
+    # --------------------------------------------------------- explore
+
+    def start(self):
+        me = self.comp.agent.name
+        agent_def = self.comp.agent.agent_def
+        for other in self.comp.agent.discovery.agents():
+            if other == me or other.startswith("_") or \
+                    other == "orchestrator":
+                continue
+            hop = agent_def.route(other) if agent_def is not None else 1.0
+            self.paths[(me, other)] = hop
+        self._explore_next()
+
+    def _explore_next(self):
+        nxt = self._cheapest_unexplored()
+        if nxt is not None:
+            self.pending = nxt
+            self.comp.post_msg(
+                replication_computation_name(nxt),
+                ReplicaRequestMessage(self.comp_name, self._footprint(),
+                                      False),
+                MSG_MGT)
+            return
+        self._start_commit()
+
+    def _cheapest_unexplored(self) -> Optional[str]:
+        """Next agent to poll, or None when UCS can stop: either all
+        known agents explored, or the cheapest open path cannot beat the
+        current k-th candidate."""
+        best_agent, best_cost = None, float("inf")
+        for path, cost in self.paths.items():
+            tgt = path[-1]
+            if tgt in self.explored:
+                continue
+            if cost < best_cost:
+                best_agent, best_cost = tgt, cost
+        if best_agent is None:
+            return None
+        kth = self._kth_candidate_cost()
+        if kth is not None and best_cost >= kth:
+            return None  # UCS cut: path cost alone already too expensive
+        return best_agent
+
+    def _kth_candidate_cost(self) -> Optional[float]:
+        accepted = sorted(c for c, ok in self.candidates.values() if ok)
+        if len(accepted) < self.k:
+            return None
+        return accepted[self.k - 1]
+
+    def on_answer(self, sender: str, msg):
+        agent = sender.replace("_replication_", "", 1)
+        if msg.commit:
+            self._on_commit_answer(agent, msg)
+            return
+        self.explored.add(agent)
+        self.pending = None
+        _, path_cost = self._path_cost(agent)
+        total = path_cost + (msg.hosting_cost or 0.0)
+        self.candidates[agent] = (total, bool(msg.accept))
+        # extend the frontier with the answering agent's route costs
+        base_cost, base_path = self._best_path(agent)
+        for other, hop in (msg.routes or {}).items():
+            if other in base_path or other == self.comp.agent.name:
+                continue
+            new_path = base_path + (other,)
+            new_cost = base_cost + hop
+            old = self.paths.get(new_path)
+            if old is None or new_cost < old:
+                self.paths[new_path] = new_cost
+        self._explore_next()
+
+    def _best_path(self, agent: str) -> Tuple[float, Tuple[str, ...]]:
+        cost, path = cheapest_path_to(agent, self.paths)
+        if path == ():
+            return 0.0, (self.comp.agent.name, agent)
+        return cost, path
+
+    def _path_cost(self, agent: str) -> Tuple[Tuple[str, ...], float]:
+        cost, path = cheapest_path_to(agent, self.paths)
+        return path, (0.0 if cost == float("inf") else cost)
+
+    # ---------------------------------------------------------- commit
+
+    def _start_commit(self):
+        ranked = sorted(
+            (cost, a) for a, (cost, ok) in self.candidates.items() if ok)
+        self.committing = [a for _, a in ranked]
+        self._commit_next()
+
+    def _commit_next(self):
+        while self.committing and len(self.placed) < self.k:
+            agent = self.committing.pop(0)
+            self.pending = agent
+            self.comp.post_msg(
+                replication_computation_name(agent),
+                ReplicaRequestMessage(
+                    self.comp_name, self._footprint(),
+                    self._comp_def_repr()),
+                MSG_MGT)
+            return
+        self._finish()
+
+    def _on_commit_answer(self, agent: str, msg):
+        self.pending = None
+        if msg.accept:
+            self.placed.add(agent)
+        if len(self.placed) >= self.k or not self.committing:
+            self._finish()
+        else:
+            self._commit_next()
+
+    def _finish(self):
+        if not self.done:
+            self.done = True
+            self.comp._run_finished(self.comp_name)
+
+    # ----------------------------------------------------------- utils
+
+    def _footprint(self) -> float:
+        try:
+            if self.comp.agent.has_computation(self.comp_name):
+                return self.comp.agent.computation(
+                    self.comp_name).footprint()
+        except Exception:
+            pass
+        return 1.0
+
+    def _comp_def_repr(self):
+        from ..utils.simple_repr import simple_repr
+
+        if self.comp_def is None:
+            return True
+        try:
+            return simple_repr(self.comp_def)
+        except Exception:
+            return True
+
+
+def replicate_on_agent(agent, k: int,
+                       comp_defs: Optional[Dict[str, Any]] = None,
+                       on_done: Optional[Callable] = None):
+    """Start replication of the agent's active computations
+    (helper used by ResilientAgent.replicate; reference:
+    agents.py:1042-1046)."""
+    comp = agent.computation(replication_computation_name(agent.name))
+    if on_done is not None:
+        comp.on_done = on_done
+    if comp_defs is None:
+        comp_defs = {
+            c.name: getattr(c, "computation_def", None)
+            for c in agent.computations()}
+    comp.start_replication(k, comp_defs)
+    return comp

@@ -1,0 +1,36 @@
+"""Replica distribution value objects.
+
+reference parity: pydcop/replication/objects.py:1-73.
+"""
+
+from typing import Dict, Iterable, List
+
+from ..utils.simple_repr import SimpleRepr
+
+
+class ReplicaDistribution(SimpleRepr):
+    """Mapping computation -> list of agents hosting a replica of it
+    (reference: replication/objects.py)."""
+
+    def __init__(self, mapping: Dict[str, Iterable[str]]):
+        self._mapping = {c: list(agts) for c, agts in mapping.items()}
+
+    @property
+    def mapping(self) -> Dict[str, List[str]]:
+        return {c: list(a) for c, a in self._mapping.items()}
+
+    def agents_for_computation(self, computation: str) -> List[str]:
+        return list(self._mapping.get(computation, []))
+
+    def computations_on_agent(self, agent: str) -> List[str]:
+        return [c for c, agts in self._mapping.items() if agent in agts]
+
+    def replica_count(self, computation: str) -> int:
+        return len(self._mapping.get(computation, []))
+
+    def __eq__(self, o):
+        return (isinstance(o, ReplicaDistribution)
+                and self._mapping == o._mapping)
+
+    def __repr__(self):
+        return f"ReplicaDistribution({self._mapping})"

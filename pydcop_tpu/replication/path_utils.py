@@ -1,0 +1,83 @@
+"""Route-graph path helpers for replica placement.
+
+reference parity: pydcop/replication/path_utils.py (PathsTable,
+cheapest-path helpers).  Paths are tuples of agent names; costs are sums
+of per-hop route costs from :class:`AgentDef.route`.
+"""
+
+import heapq
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+Path = Tuple[str, ...]
+PathsTable = Dict[Path, float]
+
+
+def head(path: Path) -> Optional[str]:
+    return path[0] if path else None
+
+
+def last(path: Path) -> Optional[str]:
+    return path[-1] if path else None
+
+
+def before_last(path: Path) -> Optional[str]:
+    if len(path) < 2:
+        raise IndexError("path too short")
+    return path[-2]
+
+
+def path_starting_with(prefix: Path, paths: PathsTable) -> List[Tuple[float, Path]]:
+    """All paths extending ``prefix``, as (cost, suffix) sorted by cost
+    (reference: path_utils.py)."""
+    n = len(prefix)
+    out = [(c, p[n:]) for p, c in paths.items()
+           if p[:n] == prefix and len(p) > n]
+    return sorted(out)
+
+
+def filter_missing_agents_paths(paths: PathsTable,
+                                available: Iterable[str]) -> PathsTable:
+    """Drop paths traversing agents that left the system."""
+    available = set(available)
+    return {p: c for p, c in paths.items()
+            if all(a in available for a in p)}
+
+
+def cheapest_path_to(target: str, paths: PathsTable
+                     ) -> Tuple[float, Path]:
+    """Cheapest known path ending at ``target``."""
+    best, best_path = float("inf"), ()
+    for p, c in paths.items():
+        if p and p[-1] == target and c < best:
+            best, best_path = c, p
+    return best, best_path
+
+
+def uniform_cost_search(start: str, agents: Iterable[str],
+                        route: Callable[[str, str], float],
+                        max_paths: Optional[int] = None) -> PathsTable:
+    """Expand cheapest paths from ``start`` over the full route graph
+    (host-side Dijkstra; the reference explores the same space hop-by-hop
+    with messages — dist_ucs_hostingcosts.py:573-860)."""
+    agents = set(agents)
+    frontier: List[Tuple[float, Path]] = [(0.0, (start,))]
+    best: Dict[str, float] = {}
+    table: PathsTable = {}
+    while frontier:
+        cost, path = heapq.heappop(frontier)
+        node = path[-1]
+        if node in best and best[node] <= cost:
+            continue
+        best[node] = cost
+        if node != start:
+            table[path] = cost
+            if max_paths and len(table) >= max_paths:
+                break
+        for nxt in agents:
+            if nxt in path:
+                continue
+            hop = route(node, nxt)
+            if hop is None or hop == float("inf"):
+                continue
+            heapq.heappush(frontier, (cost + hop, path + (nxt,)))
+    return table

@@ -1,0 +1,31 @@
+"""Replica-distribution YAML I/O.
+
+reference parity: pydcop/replication/yamlformat.py:1-59.  Format::
+
+    replica_dist:
+      <computation>: [agent1, agent2, ...]
+"""
+
+from typing import Union
+
+import yaml
+
+from .objects import ReplicaDistribution
+
+
+def load_replica_dist(content: str) -> ReplicaDistribution:
+    loaded = yaml.safe_load(content)
+    if not loaded or "replica_dist" not in loaded:
+        raise ValueError("Invalid replica distribution: missing "
+                         "'replica_dist' key")
+    return ReplicaDistribution(loaded["replica_dist"])
+
+
+def load_replica_dist_from_file(filename: str) -> ReplicaDistribution:
+    with open(filename) as f:
+        return load_replica_dist(f.read())
+
+
+def yaml_replica_dist(dist: ReplicaDistribution) -> str:
+    return yaml.safe_dump({"replica_dist": dist.mapping},
+                          default_flow_style=False)

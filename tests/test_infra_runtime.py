@@ -1,0 +1,170 @@
+"""Infrastructure runtime tests: communication, discovery, agents,
+orchestrator.
+
+Modelled on the reference's test strategy (SURVEY.md §4): the in-process
+communication layer is the fake network; end-to-end runs go through the
+orchestrated runtime with thread agents on the canonical 3-variable
+graph-coloring fixture.
+"""
+
+import queue
+import time
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.infrastructure.communication import (
+    InProcessCommunicationLayer, Messaging, MSG_ALGO, MSG_MGT)
+from pydcop_tpu.infrastructure.agents import Agent, ResilientAgent
+from pydcop_tpu.infrastructure.computations import (
+    Message, MessagePassingComputation, register)
+from pydcop_tpu.infrastructure.run import run_dcop
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents:
+  a1: {capacity: 100}
+  a2: {capacity: 100}
+  a3: {capacity: 100}
+"""
+
+VALID_GC3 = [
+    {"v1": "R", "v2": "G", "v3": "R"},
+    {"v1": "G", "v2": "R", "v3": "G"},
+]
+
+
+class EchoComputation(MessagePassingComputation):
+    def __init__(self, name):
+        super().__init__(name)
+        self.received = []
+
+    @register("ping")
+    def _on_ping(self, sender, msg, t):
+        self.received.append((sender, msg.content))
+        self.post_msg(sender, Message("pong", msg.content))
+
+    @register("pong")
+    def _on_pong(self, sender, msg, t):
+        self.received.append((sender, msg.content))
+
+
+def _wait(predicate, timeout=5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_messaging_priority_order():
+    comm = InProcessCommunicationLayer()
+    agent = Agent("a1", comm)
+    msging = agent.messaging
+    # enqueue low-priority first, high-priority second
+    msging.post_local(Message("algo"), MSG_ALGO)
+    msging.post_local(Message("mgt"), MSG_MGT)
+    first = msging.next_msg()
+    second = msging.next_msg()
+    assert first.msg.type == "mgt"  # MGT (10) beats ALGO (20)
+    assert second.msg.type == "algo"
+
+
+def test_two_agents_message_exchange_inprocess():
+    a1 = Agent("a1", InProcessCommunicationLayer())
+    a2 = Agent("a2", InProcessCommunicationLayer())
+    # wire discovery manually (no directory in this minimal setup)
+    a1.discovery.register_agent("a2", a2.address, publish=False)
+    a2.discovery.register_agent("a1", a1.address, publish=False)
+    c1, c2 = EchoComputation("c1"), EchoComputation("c2")
+    a1.add_computation(c1, publish=False)
+    a2.add_computation(c2, publish=False)
+    a1.discovery.register_computation("c2", "a2", publish=False)
+    a2.discovery.register_computation("c1", "a1", publish=False)
+    a1.start()
+    a2.start()
+    try:
+        c1.start()
+        c2.start()
+        c1.post_msg("c2", Message("ping", 42))
+        assert _wait(lambda: ("c2", 42) in c1.received)
+        assert ("c1", 42) in c2.received
+    finally:
+        a1.clean_shutdown()
+        a2.clean_shutdown()
+
+
+def test_park_and_retry_unknown_destination():
+    """Messages to not-yet-registered computations are parked and
+    delivered once the computation registers
+    (reference: communication.py:637-650)."""
+    a1 = Agent("a1", InProcessCommunicationLayer())
+    c1 = EchoComputation("c1")
+    a1.add_computation(c1, publish=False)
+    a1.start()
+    try:
+        c1.start()
+        c1.post_msg("late", Message("ping", 1))  # not registered yet
+        late = EchoComputation("late")
+        a1.add_computation(late, publish=False)
+        late.start()
+        assert _wait(lambda: ("c1", 1) in late.received)
+    finally:
+        a1.clean_shutdown()
+
+
+def test_run_dcop_thread_maxsum():
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "maxsum", timeout=20)
+    assert result.assignment in VALID_GC3
+    assert result.metrics["status"] in ("FINISHED", "MAX_CYCLES",
+                                        "TIMEOUT")
+
+
+def test_run_dcop_thread_dpop():
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "dpop", distribution="oneagent", timeout=20)
+    assert result.assignment == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_run_dcop_with_replication():
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "maxsum", timeout=30, ktarget=1)
+    assert result.assignment in VALID_GC3
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_maxsum():
+    """Process mode: one OS process per agent, HTTP/JSON messaging on
+    localhost (reference: run.py:225-287, communication.py:313)."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "maxsum", mode="process", timeout=60)
+    assert result.assignment in VALID_GC3
+
+
+def test_run_dcop_scenario_agent_removal():
+    """Dynamic DCOP: an agent leaves mid-run; replicas + repair keep all
+    computations hosted (reference: §3.4)."""
+    from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+
+    dcop = load_dcop(GC3)
+    scenario = Scenario([
+        DcopEvent("d1", delay=0.5),
+        DcopEvent("e1", actions=[
+            EventAction("remove_agent", agents=["a1"])]),
+    ])
+    result = run_dcop(dcop, "maxsum", timeout=30, ktarget=1,
+                      scenario=scenario, max_cycles=100000)
+    # the solve must still produce a full assignment
+    assert set(result.assignment) == {"v1", "v2", "v3"}
