@@ -1,0 +1,7 @@
+"""``python -m pydcop_tpu`` = the pydcop CLI."""
+
+import sys
+
+from .dcop_cli import main
+
+sys.exit(main())

@@ -1,0 +1,75 @@
+"""CLI subcommands.
+
+reference parity: pydcop/commands/ — solve, run, orchestrator, agent,
+distribute, graph, generate, replica_dist, batch, consolidate.
+
+Shared helpers here mirror pydcop/commands/_utils.py: algorithm-parameter
+parsing (`-p name:value`), numpy-aware JSON encoding, output-file
+handling.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class CliError(Exception):
+    pass
+
+
+def parse_algo_params(param_strs: Optional[List[str]]) -> Dict[str, Any]:
+    """Parse repeated ``-p name:value`` options
+    (reference: commands/_utils.py)."""
+    params: Dict[str, Any] = {}
+    for p in param_strs or []:
+        if ":" not in p:
+            raise CliError(
+                f"Invalid algorithm parameter {p!r}; use name:value")
+        name, _, value = p.partition(":")
+        params[name.strip()] = value.strip()
+    return params
+
+
+def build_algo_def(algo: str, param_strs: Optional[List[str]],
+                   mode: str = "min"):
+    """Build an AlgorithmDef from CLI args, validating parameters
+    (reference: commands/_utils.py build_algo_def)."""
+    from ..algorithms import (AlgoParameterException, AlgorithmDef,
+                              list_available_algorithms)
+
+    try:
+        return AlgorithmDef.build_with_default_param(
+            algo, params=parse_algo_params(param_strs), mode=mode)
+    except ModuleNotFoundError:
+        raise CliError(
+            f"Unknown algorithm {algo!r}; available: "
+            f"{', '.join(list_available_algorithms())}")
+    except AlgoParameterException as e:
+        raise CliError(str(e))
+
+
+class NumpyEncoder(json.JSONEncoder):
+    """JSON encoder accepting numpy scalars/arrays
+    (reference: commands/solve.py:602)."""
+
+    def default(self, o):
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        return super().default(o)
+
+
+def output_json(data: Dict, output: Optional[str] = None):
+    """Dump result JSON to stdout and optionally a file."""
+    txt = json.dumps(data, sort_keys=True, indent=2, cls=NumpyEncoder)
+    try:
+        print(txt)
+    except BrokenPipeError:  # e.g. piped into `head`
+        pass
+    if output:
+        with open(output, "w") as f:
+            f.write(txt)

@@ -1,0 +1,82 @@
+"""``pydcop agent``: standalone agent(s) joining a remote orchestrator.
+
+reference parity: pydcop/commands/agent.py:33-350.  Starts N agents in
+this process (one thread + one HTTP port each) pointed at the
+orchestrator's address; they register through the directory protocol and
+then follow orchestrator commands until stopped.
+"""
+
+import time
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "agent", help="standalone agents joining an orchestrator")
+    parser.add_argument("-n", "--names", nargs="+", required=True,
+                        help="agent names (one per agent)")
+    parser.add_argument("-p", "--port", type=int, default=9001,
+                        help="base port; agent i listens on port+i")
+    parser.add_argument("--address", default="127.0.0.1",
+                        help="local address agents bind to")
+    parser.add_argument("-o", "--orchestrator", required=True,
+                        help="orchestrator ip:port")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="base websocket UI port (one per agent)")
+    parser.add_argument("--replication",
+                        default="dist_ucs_hostingcosts")
+    parser.add_argument("--restart", action="store_true",
+                        help="restart agents if they stop")
+    parser.add_argument("--delay", type=float, default=0)
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def _start_agents(args, orchestrator_address):
+    from ..infrastructure.communication import HttpCommunicationLayer
+    from ..infrastructure.orchestratedagents import OrchestratedAgent
+
+    agents = []
+    for i, name in enumerate(args.names):
+        comm = HttpCommunicationLayer((args.address, args.port + i))
+        ui_port = args.uiport + i if args.uiport else None
+        agent = OrchestratedAgent(
+            name, comm, orchestrator_address,
+            replication=args.replication, ui_port=ui_port,
+            delay=args.delay)
+        agent.start()
+        agents.append(agent)
+    return agents
+
+
+def run_cmd(args, timeout=None):
+    from ..infrastructure.communication import Address
+
+    try:
+        host, _, port = args.orchestrator.partition(":")
+        orchestrator_address = Address(host, int(port))
+    except ValueError:
+        raise CliError(
+            f"Invalid orchestrator address {args.orchestrator!r}; "
+            "use ip:port")
+    agents = _start_agents(args, orchestrator_address)
+    deadline = time.perf_counter() + timeout if timeout else None
+    try:
+        while True:
+            time.sleep(0.2)
+            alive = [a for a in agents if a.is_running]
+            if not alive:
+                if args.restart and (deadline is None
+                                     or time.perf_counter() < deadline):
+                    agents = _start_agents(args, orchestrator_address)
+                    continue
+                break
+            if deadline and time.perf_counter() > deadline:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for a in agents:
+            a.clean_shutdown(1)
+    return 0

@@ -1,0 +1,62 @@
+"""``pydcop consolidate``: aggregate batch results into one CSV.
+
+reference parity: pydcop/commands/consolidate.py:129-235.
+"""
+
+import csv
+import glob
+import json
+import os
+import sys
+from typing import List
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "consolidate", help="aggregate result JSON files into a CSV")
+    parser.add_argument("result_files", nargs="+",
+                        help="result json files (or globs)")
+    parser.add_argument("-o", "--csv", dest="csv_out",
+                        default=None, help="output CSV path")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    files: List[str] = []
+    for pattern in args.result_files:
+        matched = sorted(glob.glob(pattern))
+        files.extend(matched if matched else [pattern])
+    rows = []
+    for path in files:
+        if not os.path.exists(path):
+            print(f"warning: no such file {path}", file=sys.stderr)
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        rows.append({
+            "file": os.path.basename(path),
+            "status": data.get("status"),
+            "cost": data.get("cost"),
+            "violation": data.get("violation"),
+            "cycle": data.get("cycle"),
+            "time": data.get("time"),
+            "msg_count": data.get("msg_count"),
+            "msg_size": data.get("msg_size"),
+        })
+    fieldnames = ["file", "status", "cost", "violation", "cycle",
+                  "time", "msg_count", "msg_size"]
+    out = open(args.csv_out, "w", newline="") if args.csv_out \
+        else sys.stdout
+    try:
+        writer = csv.DictWriter(out, fieldnames=fieldnames)
+        writer.writeheader()
+        writer.writerows(rows)
+    finally:
+        if args.csv_out:
+            out.close()
+    return 0

@@ -1,0 +1,66 @@
+"""``pydcop distribute``: compute/evaluate a distribution offline.
+
+reference parity: pydcop/commands/distribute.py:226-407.
+"""
+
+import yaml
+
+from . import CliError, output_json
+from ..dcop.yamldcop import load_dcop_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "distribute", help="distribute computations onto agents")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument("-d", "--distribution", required=True,
+                        help="distribution method")
+    parser.add_argument("-a", "--algo", default=None,
+                        help="algorithm (for memory/load footprints)")
+    parser.add_argument("-g", "--graph", default=None,
+                        help="graph model, if no algo given")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..algorithms import load_algorithm_module
+    from ..distribution import load_distribution_module
+    from ..distribution.objects import distribution_cost
+    from ..graphs import load_graph_module
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    if args.algo:
+        algo_module = load_algorithm_module(args.algo)
+        graph_name = args.graph or algo_module.GRAPH_TYPE
+        footprint = algo_module.computation_memory
+        load = algo_module.communication_load
+    elif args.graph:
+        graph_name, footprint, load = args.graph, None, None
+        algo_module = None
+    else:
+        raise CliError("distribute needs --algo or --graph")
+    cg = load_graph_module(graph_name).build_computation_graph(dcop)
+    dist_module = load_distribution_module(args.distribution)
+    dist = dist_module.distribute(
+        cg, dcop.agents_def, dcop.dist_hints, footprint, load)
+    result = {
+        "distribution": dist.mapping(),
+        "inputs": {
+            "dcop": [str(f) for f in args.dcop_files],
+            "dist_algo": args.distribution,
+            "algo": args.algo,
+            "graph": graph_name,
+        },
+    }
+    try:
+        cost, comm, hosting = distribution_cost(
+            dist, cg, dcop.agents_def, computation_memory=footprint,
+            communication_load=load)
+        result["cost"] = cost
+        result["communication_cost"] = comm
+        result["hosting_cost"] = hosting
+    except Exception:
+        result["cost"] = None
+    output_json(result, args.output)
+    return 0

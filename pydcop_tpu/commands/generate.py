@@ -1,0 +1,228 @@
+"""``pydcop generate``: benchmark problem generators.
+
+reference parity: pydcop/commands/generate.py:879 + generators/
+(graph_coloring, ising, meeting_scheduling, secp, iot, small_world,
+agents, scenario).  Emits YAML on stdout or to ``--output``.
+"""
+
+import yaml
+
+
+def _emit(args, text: str):
+    try:
+        print(text)
+    except BrokenPipeError:
+        pass
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "generate", help="generate benchmark problems")
+    sub = parser.add_subparsers(dest="generator", required=True)
+
+    gc = sub.add_parser("graph_coloring")
+    gc.add_argument("-v", "--variables_count", type=int, required=True)
+    gc.add_argument("-c", "--colors_count", type=int, default=3)
+    gc.add_argument("-g", "--graph", default="random",
+                    choices=["random", "scalefree", "grid"])
+    gc.add_argument("--p_edge", type=float, default=None)
+    gc.add_argument("--m_edge", type=int, default=None)
+    gc.add_argument("--allow_subgraph", action="store_true")
+    gc.add_argument("--soft", action="store_true",
+                    help="soft coloring (cost-1 conflicts + noise)")
+    gc.add_argument("--noise", type=float, default=0.02)
+    gc.add_argument("--extensive", action="store_true",
+                    help="extensional (matrix) constraints")
+    gc.add_argument("--seed", type=int, default=None)
+    gc.set_defaults(func=_gen_graph_coloring)
+
+    ising = sub.add_parser("ising")
+    ising.add_argument("--row_count", type=int, required=True)
+    ising.add_argument("--col_count", type=int, default=None)
+    ising.add_argument("--bin_range", type=float, default=1.6)
+    ising.add_argument("--un_range", type=float, default=0.05)
+    ising.add_argument("--seed", type=int, default=None)
+    ising.set_defaults(func=_gen_ising)
+
+    ms = sub.add_parser("meeting_scheduling")
+    ms.add_argument("--slots_count", type=int, default=5)
+    ms.add_argument("--events_count", type=int, default=4)
+    ms.add_argument("--resources_count", type=int, default=3)
+    ms.add_argument("--max_resources_event", type=int, default=2)
+    ms.add_argument("--seed", type=int, default=None)
+    ms.set_defaults(func=_gen_meetings)
+
+    secp = sub.add_parser("secp")
+    secp.add_argument("-l", "--lights", type=int, default=9)
+    secp.add_argument("-m", "--models", type=int, default=3)
+    secp.add_argument("-r", "--rules", type=int, default=2)
+    secp.add_argument("--levels", type=int, default=5)
+    secp.add_argument("--capacity", type=int, default=100)
+    secp.add_argument("--seed", type=int, default=None)
+    secp.set_defaults(func=_gen_secp)
+
+    iot = sub.add_parser("iot")
+    iot.add_argument("-n", "--num_device", type=int, default=30)
+    iot.add_argument("--m_edge", type=int, default=2)
+    iot.add_argument("--states", type=int, default=3)
+    iot.add_argument("--seed", type=int, default=None)
+    iot.set_defaults(func=_gen_iot)
+
+    sw = sub.add_parser("small_world")
+    sw.add_argument("-v", "--variables_count", type=int, default=20)
+    sw.add_argument("-k", type=int, default=4)
+    sw.add_argument("-p", type=float, default=0.1)
+    sw.add_argument("-c", "--colors_count", type=int, default=3)
+    sw.add_argument("--seed", type=int, default=None)
+    sw.set_defaults(func=_gen_small_world)
+
+    agts = sub.add_parser("agents")
+    agts.add_argument("--count", type=int, default=None)
+    agts.add_argument("--dcop_files", nargs="*", default=None)
+    agts.add_argument("--capacity", type=int, default=100)
+    agts.add_argument("--hosting", default="none",
+                      choices=["none", "name_mapping"])
+    agts.add_argument("--hosting_default", type=float, default=100)
+    agts.add_argument("--routes", default="none",
+                      choices=["none", "uniform"])
+    agts.add_argument("--routes_default", type=float, default=1)
+    agts.add_argument("--agent_prefix", default="a")
+    agts.add_argument("--seed", type=int, default=None)
+    agts.set_defaults(func=_gen_agents)
+
+    sc = sub.add_parser("scenario")
+    sc.add_argument("--evts_count", type=int, default=3)
+    sc.add_argument("--actions_count", type=int, default=1)
+    sc.add_argument("--delay", type=float, default=10)
+    sc.add_argument("--dcop_files", nargs="*", default=None)
+    sc.add_argument("--agents", nargs="*", default=None)
+    sc.add_argument("--keep", nargs="*", default=None)
+    sc.add_argument("--seed", type=int, default=None)
+    sc.set_defaults(func=_gen_scenario)
+    return parser
+
+
+def _gen_graph_coloring(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.graphcoloring import generate_graph_coloring
+
+    dcop = generate_graph_coloring(
+        args.variables_count, args.colors_count, graph_type=args.graph,
+        p_edge=args.p_edge, m_edge=args.m_edge,
+        allow_subgraph=args.allow_subgraph, soft=args.soft,
+        noise_level=args.noise, extensive=args.extensive,
+        seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_ising(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.ising import generate_ising
+
+    dcop = generate_ising(
+        args.row_count, args.col_count or args.row_count,
+        bin_range=args.bin_range, un_range=args.un_range,
+        seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_meetings(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.meetingscheduling import generate_meetings
+
+    dcop = generate_meetings(
+        slots_count=args.slots_count, events_count=args.events_count,
+        resources_count=args.resources_count,
+        max_resources_event=args.max_resources_event, seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_secp(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.secp import generate_secp
+
+    dcop = generate_secp(
+        lights_count=args.lights, models_count=args.models,
+        rules_count=args.rules, levels=args.levels,
+        capacity=args.capacity, seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_iot(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.iot import generate_iot
+
+    dcop = generate_iot(num_device=args.num_device, m_edge=args.m_edge,
+                        states_count=args.states, seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_small_world(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.smallworld import generate_small_world
+
+    dcop = generate_small_world(
+        args.variables_count, k=args.k, p=args.p,
+        colors_count=args.colors_count, seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_agents(args, timeout=None):
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..generators.agents import generate_agents
+
+    dcop = (load_dcop_from_file(args.dcop_files)
+            if args.dcop_files else None)
+    agents = generate_agents(
+        count=args.count, dcop=dcop, agent_prefix=args.agent_prefix,
+        capacity=args.capacity, hosting=args.hosting,
+        hosting_default=args.hosting_default, routes=args.routes,
+        routes_default=args.routes_default, seed=args.seed)
+    data = {"agents": {
+        a.name: {
+            "capacity": a.capacity,
+            "hosting": {"default": a.default_hosting_cost,
+                        **a.hosting_costs},
+            "routes": {"default": a.default_route, **a.routes},
+        } for a in agents}}
+    _emit(args, yaml.safe_dump(data, default_flow_style=False))
+    return 0
+
+
+def _gen_scenario(args, timeout=None):
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..generators.scenario import generate_scenario
+
+    if args.agents:
+        agent_names = args.agents
+    elif args.dcop_files:
+        agent_names = sorted(
+            load_dcop_from_file(args.dcop_files).agents)
+    else:
+        from . import CliError
+
+        raise CliError("scenario generation needs --agents or "
+                       "--dcop_files")
+    scenario = generate_scenario(
+        agent_names, evts_count=args.evts_count,
+        actions_count=args.actions_count, delay=args.delay,
+        keep=args.keep, seed=args.seed)
+    events = []
+    for e in scenario.events:
+        if e.is_delay:
+            events.append({"id": e.id, "delay": e.delay})
+        else:
+            events.append({"id": e.id, "actions": [
+                {"type": a.type, **a.args} for a in e.actions]})
+    _emit(args, yaml.safe_dump({"events": events},
+                               default_flow_style=False))
+    return 0

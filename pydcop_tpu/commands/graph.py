@@ -1,0 +1,44 @@
+"""``pydcop graph``: computation-graph statistics for a DCOP.
+
+reference parity: pydcop/commands/graph.py:144-198.
+"""
+
+from . import output_json
+from ..dcop.yamldcop import load_dcop_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "graph", help="computation graph statistics")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument("-g", "--graph", required=True,
+                        help="graph model: factor_graph | "
+                             "constraints_hypergraph | pseudotree | "
+                             "ordered_graph")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..graphs import load_graph_module
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    cg = load_graph_module(args.graph).build_computation_graph(dcop)
+    edges_count = len(cg.links)
+    nodes_count = len(cg.nodes)
+    result = {
+        "graph": {
+            "nodes_count": nodes_count,
+            "edges_count": edges_count,
+            "density": cg.density(),
+        },
+        "inputs": {
+            "dcop": [str(f) for f in args.dcop_files],
+            "graph": args.graph,
+            "variables_count": len(dcop.variables),
+            "constraints_count": len(dcop.constraints),
+            "agents_count": len(dcop.agents),
+        },
+    }
+    output_json(result, args.output)
+    return 0

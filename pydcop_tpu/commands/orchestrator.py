@@ -1,0 +1,73 @@
+"""``pydcop orchestrator``: standalone orchestrator for multi-machine
+deployment.
+
+reference parity: pydcop/commands/orchestrator.py:185-618.  Starts an
+orchestrator with an HTTP communication layer; remote ``pydcop agent``
+processes join it over the network (DCN in a TPU-pod deployment), then
+the DCOP is deployed, run and the result printed.
+"""
+
+import time
+
+from . import build_algo_def, output_json
+from ..dcop.yamldcop import load_dcop_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "orchestrator", help="standalone orchestrator (multi-machine)")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-p", "--algo_params", action="append",
+                        default=None)
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--address", default="127.0.0.1")
+    parser.add_argument("-s", "--scenario", default=None)
+    parser.add_argument("-k", "--ktarget", type=int, default=None)
+    parser.add_argument("--deploy_timeout", type=float, default=60,
+                        help="max wait for agents to join (s)")
+    parser.add_argument("--max_cycles", type=int, default=100000)
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..dcop.yamldcop import load_scenario_from_file
+    from ..infrastructure.communication import HttpCommunicationLayer
+    from ..infrastructure.orchestrator import Orchestrator
+    from ..infrastructure.run import _prepare_run
+
+    t0 = time.perf_counter()
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, args.algo_params,
+                              mode=dcop.objective)
+    algo_def, cg, dist = _prepare_run(dcop, algo_def,
+                                      args.distribution)
+    scenario = (load_scenario_from_file(args.scenario)
+                if args.scenario else None)
+    comm = HttpCommunicationLayer((args.address, args.port))
+    orchestrator = Orchestrator(algo_def, cg, dist, comm, dcop=dcop)
+    orchestrator.start()
+    try:
+        orchestrator.deploy_computations(timeout=args.deploy_timeout)
+        if args.ktarget:
+            orchestrator.start_replication(args.ktarget)
+        res = orchestrator.run(scenario=scenario, timeout=timeout,
+                               max_cycles=args.max_cycles)
+        orchestrator.stop_agents()
+        metrics = orchestrator.global_metrics()
+        result = {
+            "status": res.status if res else orchestrator.status,
+            "assignment": metrics["assignment"],
+            "cost": metrics["cost"],
+            "violation": metrics["violation_count"],
+            "cycle": metrics["cycle"],
+            "msg_count": metrics["msg_count"],
+            "msg_size": metrics["msg_size"],
+            "time": time.perf_counter() - t0,
+        }
+        output_json(result, args.output)
+    finally:
+        orchestrator.stop()
+    return 0

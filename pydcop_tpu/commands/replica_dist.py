@@ -1,0 +1,47 @@
+"""``pydcop replica_dist``: compute a replica placement only.
+
+reference parity: pydcop/commands/replica_dist.py:160-279.  Runs the
+orchestrated runtime just long enough to deploy + replicate, then
+prints the replica distribution YAML.
+"""
+
+from . import build_algo_def, output_json
+from ..dcop.yamldcop import load_dcop_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "replica_dist", help="compute k-replica placement")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument("-k", "--ktarget", type=int, required=True)
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-p", "--algo_params", action="append",
+                        default=None)
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..infrastructure.run import _prepare_run, \
+        run_local_thread_dcop
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, args.algo_params,
+                              mode=dcop.objective)
+    algo_def, cg, dist = _prepare_run(dcop, algo_def,
+                                      args.distribution)
+    orchestrator = run_local_thread_dcop(
+        algo_def, cg, dist, dcop,
+        replication="dist_ucs_hostingcosts")
+    try:
+        orchestrator.deploy_computations(timeout=timeout or 30)
+        merged = orchestrator.start_replication(
+            args.ktarget, timeout=timeout or 30)
+        output_json({"replica_dist": merged}, args.output)
+    finally:
+        orchestrator.stop_agents(2)
+        orchestrator.stop()
+        for a in getattr(orchestrator, "local_agents", []):
+            a.clean_shutdown(1)
+    return 0

@@ -1,0 +1,63 @@
+"""``pydcop run``: dynamic DCOP run with scenario + replication.
+
+reference parity: pydcop/commands/run.py:33-507.
+"""
+
+import time
+
+from . import build_algo_def, output_json
+from ..dcop.yamldcop import load_dcop_from_file, load_scenario_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "run", help="run a dynamic DCOP with scenario events")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-p", "--algo_params", action="append",
+                        default=None)
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("-m", "--mode", default="thread",
+                        choices=["thread", "process"])
+    parser.add_argument("-s", "--scenario", required=True,
+                        help="scenario yaml file")
+    parser.add_argument("-k", "--ktarget", type=int, default=3,
+                        help="replication factor")
+    parser.add_argument("--replication_method",
+                        default="dist_ucs_hostingcosts")
+    parser.add_argument("-c", "--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change",
+                                 "period"])
+    parser.add_argument("--period", type=float, default=None)
+    parser.add_argument("--max_cycles", type=int, default=1_000_000)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    t0 = time.perf_counter()
+    dcop = load_dcop_from_file(args.dcop_files)
+    scenario = load_scenario_from_file(args.scenario)
+    algo_def = build_algo_def(args.algo, args.algo_params,
+                              mode=dcop.objective)
+    from ..infrastructure.run import run_dcop
+
+    res = run_dcop(
+        dcop, algo_def, distribution=args.distribution, mode=args.mode,
+        scenario=scenario, timeout=timeout, ktarget=args.ktarget,
+        replication=args.replication_method,
+        collect_moment=args.collect_on, collect_period=args.period,
+        seed=args.seed, max_cycles=args.max_cycles)
+    result = {
+        "status": res.status,
+        "assignment": res.assignment,
+        "cost": res.cost,
+        "violation": res.violations,
+        "cycle": res.cycles,
+        "time": time.perf_counter() - t0,
+        "msg_count": res.metrics.get("msg_count", 0),
+        "msg_size": res.metrics.get("msg_size", 0),
+    }
+    output_json(result, args.output)
+    return 0

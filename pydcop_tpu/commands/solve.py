@@ -1,0 +1,129 @@
+"""``pydcop solve``: single-machine end-to-end solve.
+
+reference parity: pydcop/commands/solve.py:444-632.  Loads YAML dcop
+file(s), builds the algorithm's graph, distributes, solves — by default
+on the compiled engine (the fast path), or through the orchestrated
+thread/process runtime with ``--mode thread|process`` when the
+distributed fabric (metrics reporting, HTTP messaging) should be
+exercised.  Prints a JSON result.
+"""
+
+import csv
+import queue
+import threading
+import time
+from typing import Optional
+
+from . import build_algo_def, output_json
+from ..dcop.yamldcop import load_dcop_from_file
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "solve", help="solve a static DCOP on this machine")
+    parser.add_argument("dcop_files", type=str, nargs="+",
+                        help="dcop yaml file(s), concatenated")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument("-p", "--algo_params", action="append",
+                        default=None, help="algorithm param name:value")
+    parser.add_argument("-d", "--distribution", default="oneagent",
+                        help="distribution method or yaml file")
+    parser.add_argument("-m", "--mode", default="engine",
+                        choices=["engine", "thread", "process"],
+                        help="engine = compiled fast path (default); "
+                             "thread/process = orchestrated runtime")
+    parser.add_argument("-c", "--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change",
+                                 "period"])
+    parser.add_argument("--period", type=float, default=None,
+                        help="metrics collection period: seconds in "
+                             "thread/process mode, cycles in engine "
+                             "mode")
+    parser.add_argument("--run_metrics", type=str, default=None,
+                        help="CSV file for run metrics")
+    parser.add_argument("--delay", type=float, default=None,
+                        help="inter-message delay (thread/process mode)")
+    parser.add_argument("--max_cycles", type=int, default=2000)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout: Optional[float] = None):
+    t0 = time.perf_counter()
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, args.algo_params,
+                              mode=dcop.objective)
+    collector, collector_thread, stop_evt = None, None, None
+    if args.run_metrics:
+        collector = queue.Queue()
+        stop_evt = threading.Event()
+        collector_thread = threading.Thread(
+            target=_collect_to_csv,
+            args=(collector, args.run_metrics, stop_evt), daemon=True)
+        collector_thread.start()
+
+    if args.mode == "engine":
+        from ..infrastructure.run import solve_result
+
+        collect_every = None
+        if args.period:
+            collect_every = max(1, int(round(args.period)))
+        elif args.run_metrics:
+            collect_every = 16  # default trace granularity (cycles)
+        res = solve_result(
+            dcop, algo_def, distribution=args.distribution,
+            timeout=timeout, max_cycles=args.max_cycles, seed=args.seed,
+            collect_cost_every=collect_every)
+        metrics = res.metrics
+        if collector is not None:
+            # engine mode has no per-computation value stream; feed the
+            # global cost trace so --run_metrics is never silently empty
+            for cycle, cost in res.cost_trace:
+                collector.put(("", "global", "", cost, cycle))
+    else:
+        from ..infrastructure.run import run_dcop
+
+        res = run_dcop(
+            dcop, algo_def, distribution=args.distribution,
+            mode=args.mode, timeout=timeout, max_cycles=args.max_cycles,
+            seed=args.seed, collector=collector,
+            collect_moment=args.collect_on,
+            collect_period=args.period, delay=args.delay)
+        metrics = res.metrics
+
+    if stop_evt is not None:
+        stop_evt.set()
+        collector_thread.join(2)
+
+    result = {
+        "status": res.status,
+        "assignment": res.assignment,
+        "cost": res.cost,
+        "violation": res.violations,
+        "cycle": res.cycles,
+        "time": time.perf_counter() - t0,
+        "msg_count": metrics.get("msg_count", 0),
+        "msg_size": metrics.get("msg_size", 0),
+    }
+    if res.cost_trace:
+        result["cost_trace"] = res.cost_trace
+    output_json(result, args.output)
+    return 0
+
+
+def _collect_to_csv(collector: "queue.Queue", path: str,
+                    stop_evt: threading.Event):
+    """Stream collected metric tuples to CSV
+    (reference: commands/solve.py:393-441)."""
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["time", "computation", "value", "cost",
+                        "cycle"])
+        while not stop_evt.is_set() or not collector.empty():
+            try:
+                row = collector.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            writer.writerow(row)

@@ -219,7 +219,8 @@ class VariableWithCostFunc(Variable):
                  initial_value=None):
         super().__init__(name, domain, initial_value)
         if isinstance(cost_func, ExpressionFunction):
-            if list(cost_func.variable_names) != [name]:
+            # constants are fine (e.g. noise-only variables)
+            if not set(cost_func.variable_names) <= {name}:
                 raise ValueError(
                     f"Cost function for {name} must depend only on {name}: "
                     f"{cost_func.expression}"

@@ -1,0 +1,100 @@
+"""``pydcop`` command-line entry point.
+
+reference parity: pydcop/dcop_cli.py:62-190 — global ``--timeout`` (with
+grace slack), ``--strict_timeout``, verbosity / log-config flags,
+``--output``, SIGINT handling, and subcommand registration.
+
+Run as ``python -m pydcop_tpu.dcop_cli`` (or the ``pydcop`` console
+script when installed).
+"""
+
+import argparse
+import logging
+import logging.config
+import signal
+import sys
+
+from .version import __version__
+
+#: grace period added on top of --timeout before the process is killed
+#: (reference: dcop_cli.py:59 uses 40 s of slack)
+TIMEOUT_SLACK = 40
+
+
+def _make_parser():
+    parser = argparse.ArgumentParser(
+        prog="pydcop",
+        description="pydcop_tpu: TPU-native DCOP solving")
+    parser.add_argument("-t", "--timeout", type=float, default=None,
+                        help="global timeout (s) for the command")
+    parser.add_argument("--strict_timeout", action="store_true",
+                        help="kill the process at exactly --timeout")
+    parser.add_argument("-v", "--verbosity", type=int, default=0,
+                        help="0: errors, 1: warnings, 2: info, 3: debug")
+    parser.add_argument("--log", type=str, default=None,
+                        help="logging config file (fileConfig format)")
+    parser.add_argument("-o", "--output", type=str, default=None,
+                        help="result output file (global option: place "
+                             "it before the subcommand)")
+    parser.add_argument("--version", action="version",
+                        version=f"pydcop_tpu {__version__}")
+
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    from .commands import (agent, batch, consolidate, distribute,
+                           generate, graph, orchestrator, replica_dist,
+                           run, solve)
+
+    for module in (solve, run, orchestrator, agent, distribute, graph,
+                   generate, replica_dist, batch, consolidate):
+        module.set_parser(subparsers)
+    return parser
+
+
+def _setup_logging(args):
+    if args.log:
+        logging.config.fileConfig(args.log,
+                                  disable_existing_loggers=False)
+        return
+    level = {0: logging.ERROR, 1: logging.WARNING, 2: logging.INFO,
+             3: logging.DEBUG}.get(args.verbosity, logging.DEBUG)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+
+def main(argv=None) -> int:
+    parser = _make_parser()
+    args = parser.parse_args(argv)
+    _setup_logging(args)
+
+    def _on_sigint(signum, frame):
+        print("Interrupted", file=sys.stderr)
+        sys.exit(130)
+
+    signal.signal(signal.SIGINT, _on_sigint)
+
+    hard_timeout = None
+    if args.timeout is not None:
+        hard_timeout = args.timeout + (
+            0 if args.strict_timeout else TIMEOUT_SLACK)
+
+        def _on_alarm(signum, frame):
+            print("Timeout exceeded, aborting", file=sys.stderr)
+            sys.exit(1)
+
+        signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(int(hard_timeout) + 1)
+
+    from .commands import CliError
+
+    try:
+        return args.func(args, timeout=args.timeout) or 0
+    except (CliError, ValueError, ImportError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        signal.alarm(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Benchmark problem generators.
+
+reference parity: pydcop/commands/generators/ (graphcoloring, ising,
+meetingscheduling, secp, iot, smallworld, agents, scenario) plus the
+TPU-native direct-to-arrays generators in :mod:`fast`.
+"""

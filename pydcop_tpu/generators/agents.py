@@ -1,0 +1,60 @@
+"""Agents generator: capacity, hosting costs and route costs.
+
+reference parity: pydcop/commands/generators/agents.py:186 — generate
+AgentDefs for an existing DCOP, with optional name-mapped hosting costs
+and random route costs.
+"""
+
+import random
+from typing import Dict, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef
+
+
+def generate_agents(count: Optional[int] = None,
+                    dcop: Optional[DCOP] = None,
+                    agent_prefix: str = "a",
+                    capacity: int = 100,
+                    hosting: str = "none",
+                    hosting_default: float = 100,
+                    routes: str = "none",
+                    routes_default: float = 1,
+                    route_range: float = 10,
+                    seed: Optional[int] = None) -> List[AgentDef]:
+    """Generate agents.
+
+    ``hosting='name_mapping'`` gives agent ``a<i>`` a zero hosting cost
+    for the i-th variable of the DCOP (its "own" computation) and
+    ``hosting_default`` elsewhere.  ``routes='uniform'`` draws random
+    symmetric route costs in [1, route_range].
+    """
+    if seed is not None:
+        random.seed(seed)
+    if count is None:
+        if dcop is None:
+            raise ValueError("need count or dcop")
+        count = len(dcop.variables)
+    var_names = sorted(dcop.variables) if dcop is not None else []
+    names = [f"{agent_prefix}{i:03d}" for i in range(count)]
+    route_costs: Dict[str, Dict[str, float]] = {n: {} for n in names}
+    if routes == "uniform":
+        for i, n1 in enumerate(names):
+            for n2 in names[i + 1:]:
+                c = random.uniform(1, route_range)
+                route_costs[n1][n2] = c
+                route_costs[n2][n1] = c
+    agents = []
+    for i, name in enumerate(names):
+        hosting_costs: Dict[str, float] = {}
+        default_hc = 0.0
+        if hosting == "name_mapping" and i < len(var_names):
+            hosting_costs = {var_names[i]: 0}
+            default_hc = hosting_default
+        agents.append(AgentDef(
+            name, capacity=capacity,
+            default_hosting_cost=default_hc,
+            hosting_costs=hosting_costs,
+            default_route=routes_default,
+            routes=route_costs[name]))
+    return agents

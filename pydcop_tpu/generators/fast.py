@@ -143,7 +143,8 @@ def ising_factor_arrays(rows: int, cols: int, seed: int = 0,
             edges.append((i, r * cols + (c + 1) % cols))
             edges.append((i, ((r + 1) % rows) * cols + c))
     edges = np.array(sorted(set(
-        (min(a, b), max(a, b)) for a, b in edges)), dtype=np.int32)
+        (min(a, b), max(a, b)) for a, b in edges
+        if a != b)), dtype=np.int32)  # 1-wide grids wrap onto themselves
     F = len(edges)
     D = 2
     j = rng.uniform(-coupling, coupling, size=F).astype(np.float32)

@@ -1,0 +1,109 @@
+"""Graph-coloring problem generator.
+
+reference parity: pydcop/commands/generators/graphcoloring.py:238
+(random / scale-free / grid graphs, soft or hard constraints,
+intentional or extensional representation, noisy preference costs).
+"""
+
+import random
+from typing import Dict, Optional
+
+import networkx as nx
+
+from ..dcop.dcop import DCOP
+from ..utils.expressionfunction import ExpressionFunction
+from ..dcop.objects import AgentDef, Domain, Variable, \
+    VariableNoisyCostFunc
+from ..dcop.relations import NAryMatrixRelation, constraint_from_str
+
+COLORS = ["R", "G", "B", "O", "P", "Y", "W", "K", "C", "M"]
+
+
+def generate_graph(variables_count: int, graph_type: str = "random",
+                   p_edge: Optional[float] = None,
+                   m_edge: Optional[int] = None,
+                   allow_subgraph: bool = False,
+                   seed: Optional[int] = None) -> nx.Graph:
+    """Build the constraint graph (reference: graphcoloring.py:300-380)."""
+    if graph_type in ("random", "random_graph"):
+        if p_edge is None:
+            raise ValueError("random graphs need --p_edge")
+        for attempt in range(50):
+            g = nx.gnp_random_graph(
+                variables_count, p_edge,
+                seed=None if seed is None else seed + attempt)
+            if allow_subgraph or nx.is_connected(g):
+                return g
+        raise ValueError(
+            f"Could not generate a connected random graph with "
+            f"p_edge={p_edge}; raise p_edge or pass allow_subgraph")
+    if graph_type in ("scalefree", "scale_free"):
+        if m_edge is None:
+            raise ValueError("scale-free graphs need --m_edge")
+        return nx.barabasi_albert_graph(variables_count, m_edge,
+                                        seed=seed)
+    if graph_type == "grid":
+        side = int(round(variables_count ** 0.5))
+        if side * side != variables_count:
+            raise ValueError(
+                f"grid graphs need a square variables_count, got "
+                f"{variables_count}")
+        g = nx.grid_2d_graph(side, side)
+        return nx.convert_node_labels_to_integers(g)
+    raise ValueError(f"Unknown graph type {graph_type!r}")
+
+
+def generate_graph_coloring(
+        variables_count: int, colors_count: int = 3,
+        graph_type: str = "random", p_edge: Optional[float] = None,
+        m_edge: Optional[int] = None, allow_subgraph: bool = False,
+        soft: bool = False, noise_level: float = 0.02,
+        extensive: bool = False, intentional: Optional[bool] = None,
+        penalty: float = 10000.0, seed: Optional[int] = None,
+        agents_count: Optional[int] = None) -> DCOP:
+    """Generate a graph-coloring DCOP.
+
+    ``soft`` gives cost-1 conflicts + noisy unary preferences; otherwise
+    conflicts cost ``penalty`` (hard CSP flavor).  ``extensive`` emits
+    matrix (extensional) constraints instead of expression
+    (intentional) ones (reference: graphcoloring.py:238-299).
+    """
+    if seed is not None:
+        random.seed(seed)
+    if intentional is not None:
+        extensive = not intentional
+    if colors_count > len(COLORS):
+        raise ValueError(f"At most {len(COLORS)} colors supported")
+    g = generate_graph(variables_count, graph_type, p_edge, m_edge,
+                       allow_subgraph, seed)
+    colors = COLORS[:colors_count]
+    domain = Domain("colors", "color", colors)
+    dcop = DCOP(f"graph_coloring_{variables_count}", objective="min")
+    variables: Dict[int, Variable] = {}
+    for node in sorted(g.nodes):
+        name = f"v{node:03d}"
+        if soft:
+            variables[node] = VariableNoisyCostFunc(
+                name, domain, cost_func=ExpressionFunction("0"),
+                noise_level=noise_level)
+        else:
+            variables[node] = Variable(name, domain)
+        dcop.add_variable(variables[node])
+    conflict = 1.0 if soft else penalty
+    for i, (a, b) in enumerate(sorted(g.edges)):
+        v1, v2 = variables[a], variables[b]
+        name = f"c{v1.name}_{v2.name}"
+        if extensive:
+            rel = NAryMatrixRelation([v1, v2], name=name)
+            for ci in colors:
+                rel = rel.set_value_for_assignment(
+                    {v1.name: ci, v2.name: ci}, conflict)
+            dcop.add_constraint(rel)
+        else:
+            expr = (f"{conflict} if {v1.name} == {v2.name} else 0")
+            dcop.add_constraint(constraint_from_str(
+                name, expr, [v1, v2]))
+    n_agents = agents_count if agents_count else variables_count
+    for i in range(n_agents):
+        dcop.add_agents([AgentDef(f"a{i:03d}")])
+    return dcop

@@ -1,0 +1,45 @@
+"""IoT benchmark generator: power-law device network.
+
+reference parity: pydcop/commands/generators/iot.py:74 — devices in a
+scale-free (power-law degree) network, each picking a state, with
+coloring-style soft conflicts between connected devices.
+"""
+
+import random
+from typing import Optional
+
+import networkx as nx
+
+from ..dcop.dcop import DCOP
+from ..utils.expressionfunction import ExpressionFunction
+from ..dcop.objects import AgentDef, Domain, VariableNoisyCostFunc
+from ..dcop.relations import constraint_from_str
+
+
+def generate_iot(num_device: int = 30, m_edge: int = 2,
+                 states_count: int = 3, noise_level: float = 0.05,
+                 seed: Optional[int] = None) -> DCOP:
+    if seed is not None:
+        random.seed(seed)
+    g = nx.barabasi_albert_graph(num_device, m_edge, seed=seed)
+    domain = Domain("states", "state", list(range(states_count)))
+    dcop = DCOP(f"iot_{num_device}", objective="min")
+    variables = {}
+    for node in sorted(g.nodes):
+        v = VariableNoisyCostFunc(
+            f"d{node:03d}", domain, cost_func=ExpressionFunction("0"),
+            noise_level=noise_level)
+        variables[node] = v
+        dcop.add_variable(v)
+    for a, b in sorted(g.edges):
+        v1, v2 = variables[a], variables[b]
+        dcop.add_constraint(constraint_from_str(
+            f"c_{v1.name}_{v2.name}",
+            f"1 if {v1.name} == {v2.name} else 0", [v1, v2]))
+    # one agent per device: the IoT deployment story (each object hosts
+    # its own computation; hosting elsewhere is expensive)
+    for node, v in variables.items():
+        dcop.add_agents([AgentDef(
+            f"a{node:03d}", hosting_costs={v.name: 0},
+            default_hosting_cost=100)])
+    return dcop

@@ -1,0 +1,58 @@
+"""Ising-model benchmark generator.
+
+reference parity: pydcop/commands/generators/ising.py:213 — a cyclic
+2-D grid of binary spins with random pairwise couplings and random
+unary fields.
+"""
+
+import random
+from typing import Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef, Domain, Variable
+from ..dcop.relations import NAryMatrixRelation, UnaryFunctionRelation
+
+
+def generate_ising(row_count: int, col_count: int,
+                   bin_range: float = 1.6, un_range: float = 0.05,
+                   seed: Optional[int] = None,
+                   no_agents: bool = False) -> DCOP:
+    """Cyclic grid Ising DCOP: spins in {0,1}; each edge (i,j) carries a
+    2x2 cost table ``J * s_i * s_j`` with ``J ~ U(-bin_range, bin_range)``
+    (spins remapped to ±1), each variable a unary field
+    ``h ~ U(-un_range, un_range)``."""
+    if seed is not None:
+        random.seed(seed)
+    domain = Domain("binary", "binary", [0, 1])
+    dcop = DCOP(f"ising_{row_count}x{col_count}", objective="min")
+    grid = {}
+    for r in range(row_count):
+        for c in range(col_count):
+            v = Variable(f"v{r}_{c}", domain)
+            grid[(r, c)] = v
+            dcop.add_variable(v)
+            h = random.uniform(-un_range, un_range)
+            dcop.add_constraint(UnaryFunctionRelation(
+                f"u_v{r}_{c}", v, lambda s, _h=h: _h * (2 * s - 1)))
+    # cyclic right + down neighbors: every cell has exactly 2 outgoing
+    # couplings, giving the standard toroidal Ising grid
+    for r in range(row_count):
+        for c in range(col_count):
+            for (r2, c2) in (((r + 1) % row_count, c),
+                             (r, (c + 1) % col_count)):
+                if (r2, c2) == (r, c):
+                    continue
+                v1, v2 = grid[(r, c)], grid[(r2, c2)]
+                coupling = random.uniform(-bin_range, bin_range)
+                rel = NAryMatrixRelation([v1, v2],
+                                         name=f"c_{v1.name}_{v2.name}")
+                for s1 in (0, 1):
+                    for s2 in (0, 1):
+                        rel = rel.set_value_for_assignment(
+                            {v1.name: s1, v2.name: s2},
+                            coupling * (2 * s1 - 1) * (2 * s2 - 1))
+                dcop.add_constraint(rel)
+    if not no_agents:
+        for i in range(row_count * col_count):
+            dcop.add_agents([AgentDef(f"a{i:03d}")])
+    return dcop

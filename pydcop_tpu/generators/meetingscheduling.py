@@ -1,0 +1,76 @@
+"""Meeting-scheduling (PEAV) benchmark generator.
+
+reference parity: pydcop/commands/generators/meetingscheduling.py:210.
+
+PEAV (Private Events As Variables): each (event, resource) pair becomes
+one variable over the time slots; all variables of one event must agree
+(equality constraints); two events sharing a resource must not overlap
+(mutex constraints); each resource has a private per-slot value for each
+event (unary costs, maximised).
+"""
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef, Domain, Variable
+from ..dcop.relations import NAryFunctionRelation, UnaryFunctionRelation
+
+
+def generate_meetings(slots_count: int = 5, events_count: int = 4,
+                      resources_count: int = 3,
+                      max_resources_event: int = 2,
+                      max_value: int = 10,
+                      seed: Optional[int] = None) -> DCOP:
+    if seed is not None:
+        random.seed(seed)
+    slots = list(range(1, slots_count + 1))
+    domain = Domain("slots", "slots", slots)
+    dcop = DCOP(f"meetings_{events_count}e_{resources_count}r",
+                objective="max")
+
+    # which resources attend which event
+    events: Dict[int, List[int]] = {}
+    for e in range(events_count):
+        k = random.randint(1, max_resources_event)
+        events[e] = random.sample(range(resources_count),
+                                  min(k, resources_count))
+
+    variables: Dict[Tuple[int, int], Variable] = {}
+    for e, resources in events.items():
+        for r in resources:
+            v = Variable(f"m{e}_r{r}", domain)
+            variables[(e, r)] = v
+            dcop.add_variable(v)
+            value = {s: random.randint(0, max_value) for s in slots}
+            dcop.add_constraint(UnaryFunctionRelation(
+                f"value_{v.name}", v, lambda s, _v=value: _v[s]))
+
+    # intra-event equality: all participants pick the same slot
+    for e, resources in events.items():
+        vs = [variables[(e, r)] for r in resources]
+        for i in range(len(vs) - 1):
+            v1, v2 = vs[i], vs[i + 1]
+            dcop.add_constraint(NAryFunctionRelation(
+                lambda a, b: 0 if a == b else -10000,
+                [v1, v2], name=f"eq_{v1.name}_{v2.name}"))
+
+    # inter-event mutex: one resource cannot attend 2 events in the
+    # same slot
+    for r in range(resources_count):
+        attending = [e for e, res in events.items() if r in res]
+        for i in range(len(attending)):
+            for j in range(i + 1, len(attending)):
+                v1 = variables[(attending[i], r)]
+                v2 = variables[(attending[j], r)]
+                dcop.add_constraint(NAryFunctionRelation(
+                    lambda a, b: -10000 if a == b else 0,
+                    [v1, v2], name=f"mutex_{v1.name}_{v2.name}"))
+
+    # one agent per resource, hosting its own event variables cheaply
+    for r in range(resources_count):
+        own = [v.name for (e, rr), v in variables.items() if rr == r]
+        dcop.add_agents([AgentDef(
+            f"a{r:02d}", hosting_costs={c: 0 for c in own},
+            default_hosting_cost=10)])
+    return dcop

@@ -1,0 +1,38 @@
+"""Dynamic-scenario generator: timed agent-departure events.
+
+reference parity: pydcop/commands/generators/scenario.py:136 — a
+sequence of delay + remove_agent events over the agents of a DCOP,
+sparing the agents named in ``keep``.
+"""
+
+import random
+from typing import Iterable, List, Optional
+
+from ..dcop.scenario import DcopEvent, EventAction, Scenario
+
+
+def generate_scenario(agents: Iterable[str], evts_count: int = 3,
+                      actions_count: int = 1, delay: float = 10,
+                      keep: Optional[Iterable[str]] = None,
+                      seed: Optional[int] = None) -> Scenario:
+    """``evts_count`` events, each removing ``actions_count`` random
+    agents after ``delay`` seconds."""
+    if seed is not None:
+        random.seed(seed)
+    keep = set(keep or [])
+    pool = [a for a in agents if a not in keep]
+    events: List[DcopEvent] = []
+    evt_id = 0
+    for e in range(evts_count):
+        if len(pool) < actions_count:
+            break
+        events.append(DcopEvent(f"d{evt_id}", delay=delay))
+        evt_id += 1
+        removed = random.sample(pool, actions_count)
+        for a in removed:
+            pool.remove(a)
+        events.append(DcopEvent(
+            f"e{evt_id}",
+            actions=[EventAction("remove_agent", agents=removed)]))
+        evt_id += 1
+    return Scenario(events)

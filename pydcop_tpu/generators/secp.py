@@ -1,0 +1,63 @@
+"""SECP (Smart Environment Configuration Problem) generator.
+
+reference parity: pydcop/commands/generators/secp.py:129 — smart-lighting
+problems: dimmable lights, scene *models* targeting a light level over a
+subset of lights, and physical *rules* coupling devices; lights carry an
+efficiency cost.
+"""
+
+import random
+from typing import Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef, Domain, Variable
+from ..dcop.relations import NAryFunctionRelation, UnaryFunctionRelation
+
+
+def generate_secp(lights_count: int = 9, models_count: int = 3,
+                  rules_count: int = 2, levels: int = 5,
+                  max_model_size: int = 4, capacity: int = 100,
+                  seed: Optional[int] = None) -> DCOP:
+    if seed is not None:
+        random.seed(seed)
+    domain = Domain("levels", "luminosity", list(range(levels)))
+    dcop = DCOP(f"secp_{lights_count}l_{models_count}m", objective="min")
+
+    lights = []
+    for i in range(lights_count):
+        v = Variable(f"l{i:02d}", domain)
+        lights.append(v)
+        dcop.add_variable(v)
+        # efficiency cost: brighter = more power
+        cost_factor = random.uniform(0.1, 1.0)
+        dcop.add_constraint(UnaryFunctionRelation(
+            f"cost_{v.name}", v,
+            lambda level, _c=cost_factor: _c * level))
+
+    # models: target average level over a subset of lights
+    for m in range(models_count):
+        size = random.randint(2, min(max_model_size, lights_count))
+        scope = random.sample(lights, size)
+        target = random.randint(0, levels - 1)
+
+        def model_cost(*vals, _t=target):
+            avg = sum(vals) / len(vals)
+            return 10 * abs(avg - _t)
+
+        dcop.add_constraint(NAryFunctionRelation(
+            model_cost, scope, name=f"model_m{m:02d}"))
+
+    # rules: hard physical dependencies between two devices
+    for r in range(rules_count):
+        v1, v2 = random.sample(lights, 2)
+        max_sum = random.randint(levels // 2, levels)
+        dcop.add_constraint(NAryFunctionRelation(
+            lambda a, b, _m=max_sum: 10000 if a + b > _m else 0,
+            [v1, v2], name=f"rule_r{r:02d}"))
+
+    # one agent per light, with capacity (models are hosted where cheap)
+    for i, v in enumerate(lights):
+        dcop.add_agents([AgentDef(
+            f"a{i:02d}", capacity=capacity,
+            hosting_costs={v.name: 0}, default_hosting_cost=10)])
+    return dcop

@@ -1,0 +1,43 @@
+"""Small-world benchmark generator.
+
+reference parity: pydcop/commands/generators/smallworld.py:50 —
+Watts-Strogatz small-world constraint graph with coloring-style costs.
+"""
+
+import random
+from typing import Optional
+
+import networkx as nx
+
+from ..dcop.dcop import DCOP
+from ..utils.expressionfunction import ExpressionFunction
+from ..dcop.objects import AgentDef, Domain, VariableNoisyCostFunc
+from ..dcop.relations import constraint_from_str
+
+
+def generate_small_world(variables_count: int = 20, k: int = 4,
+                         p: float = 0.1, colors_count: int = 3,
+                         noise_level: float = 0.05,
+                         seed: Optional[int] = None) -> DCOP:
+    if seed is not None:
+        random.seed(seed)
+    g = nx.connected_watts_strogatz_graph(variables_count, k, p,
+                                          seed=seed)
+    domain = Domain("colors", "color",
+                    list(range(colors_count)))
+    dcop = DCOP(f"small_world_{variables_count}", objective="min")
+    variables = {}
+    for node in sorted(g.nodes):
+        v = VariableNoisyCostFunc(
+            f"v{node:03d}", domain, cost_func=ExpressionFunction("0"),
+            noise_level=noise_level)
+        variables[node] = v
+        dcop.add_variable(v)
+    for a, b in sorted(g.edges):
+        v1, v2 = variables[a], variables[b]
+        dcop.add_constraint(constraint_from_str(
+            f"c_{v1.name}_{v2.name}",
+            f"1 if {v1.name} == {v2.name} else 0", [v1, v2]))
+    for i in range(variables_count):
+        dcop.add_agents([AgentDef(f"a{i:03d}")])
+    return dcop

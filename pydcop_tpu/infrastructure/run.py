@@ -60,6 +60,8 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         return algo_module.solve_direct(dcop, algo_def.params,
                                         timeout=timeout)
 
+    import logging
+
     t0 = time.perf_counter()
     dist_obj = None
     if distribution is not None and dcop.agents:
@@ -70,13 +72,22 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         # caller asks for one (default None: the engine doesn't need it).
         from ..distribution import load_distribution_module
 
-        graph = load_graph_module(
-            algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+        # an unknown distribution name is a user error: fail hard
         dist_module = load_distribution_module(distribution)
-        dist_obj = dist_module.distribute(
-            graph, dcop.agents_def, dcop.dist_hints,
-            algo_module.computation_memory,
-            algo_module.communication_load)
+        # ...but a placement that merely cannot be computed (capacity
+        # infeasible, missing footprint model) must not kill the solve:
+        # the engine does not need the placement for the math
+        try:
+            graph = load_graph_module(
+                algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+            dist_obj = dist_module.distribute(
+                graph, dcop.agents_def, dcop.dist_hints,
+                algo_module.computation_memory,
+                algo_module.communication_load)
+        except Exception as e:
+            logging.getLogger("pydcop_tpu.run").warning(
+                "Could not compute the %s distribution (%s); solving "
+                "without a placement", distribution, e)
     solver = algo_module.build_solver(dcop, algo_def.params)
     engine = SyncEngine(solver)
     result = engine.run(
@@ -234,6 +245,7 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
              collect_period: Optional[float] = None,
              seed: int = 0, max_cycles: int = 2000,
              port: int = 9000, graph: Optional[str] = None,
+             delay: Optional[float] = None,
              **algo_params) -> RunResult:
     """End-to-end orchestrated run, with optional dynamic scenario +
     k-replication (the library-level counterpart of the ``run`` CLI;
@@ -251,12 +263,14 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         orchestrator = run_local_thread_dcop(
             algo_def, cg, dist, dcop, collector=collector,
             collect_moment=collect_moment,
-            collect_period=collect_period, replication=rep)
+            collect_period=collect_period, replication=rep,
+            delay=delay or 0)
     else:
         orchestrator = run_local_process_dcop(
             algo_def, cg, dist, dcop, collector=collector,
             collect_moment=collect_moment,
-            collect_period=collect_period, replication=rep, port=port)
+            collect_period=collect_period, replication=rep, port=port,
+            delay=delay or 0)
     try:
         orchestrator.deploy_computations()
         if ktarget:

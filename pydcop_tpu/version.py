@@ -1,0 +1,3 @@
+"""Version (reference parity: pydcop/version.py)."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,165 @@
+"""CLI end-to-end tests: subprocess invocations of the real CLI.
+
+Mirrors the reference's tests/dcop_cli tier (SURVEY.md §4): run
+``python -m pydcop_tpu.dcop_cli`` as a subprocess against YAML
+instances, parse the JSON output, assert assignment + status.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def run_cli(*args, timeout=120, expect_ok=True):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    if expect_ok:
+        assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+@pytest.fixture()
+def gc3_file(tmp_path):
+    p = tmp_path / "gc3.yaml"
+    p.write_text(GC3)
+    return str(p)
+
+
+def test_version():
+    out = run_cli("--version").stdout
+    assert "pydcop_tpu" in out
+
+
+def test_solve_maxsum(gc3_file):
+    proc = run_cli("-t", "20", "solve", "-a", "maxsum", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["assignment"] == {"v1": "R", "v2": "G", "v3": "R"}
+    assert result["status"] == "FINISHED"
+    assert result["cost"] == pytest.approx(-0.1)
+
+
+def test_solve_dsa_with_params_and_output(gc3_file, tmp_path):
+    out_file = str(tmp_path / "res.json")
+    proc = run_cli("-t", "20", "-o", out_file, "solve", "-a", "dsa",
+                   "-p", "stop_cycle:20", "-p", "variant:B",
+                   "-d", "adhoc", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["assignment"]["v1"] != result["assignment"]["v2"]
+    with open(out_file) as f:
+        assert json.load(f) == result
+
+
+def test_solve_unknown_algo(gc3_file):
+    proc = run_cli("solve", "-a", "nosuchalgo", gc3_file,
+                   expect_ok=False)
+    assert proc.returncode == 2
+    assert "Unknown algorithm" in proc.stderr
+
+
+def test_solve_bad_param(gc3_file):
+    proc = run_cli("solve", "-a", "maxsum", "-p", "damping:high",
+                   gc3_file, expect_ok=False)
+    assert proc.returncode == 2
+
+
+def test_graph_stats(gc3_file):
+    proc = run_cli("graph", "-g", "factor_graph", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["graph"]["nodes_count"] == 5  # 3 vars + 2 factors
+    assert result["graph"]["edges_count"] == 4
+
+
+def test_distribute(gc3_file):
+    proc = run_cli("distribute", "-d", "adhoc", "-a", "maxsum",
+                   gc3_file)
+    result = json.loads(proc.stdout)
+    hosted = [c for cs in result["distribution"].values() for c in cs]
+    assert sorted(hosted) == ["diff_1_2", "diff_2_3", "v1", "v2", "v3"]
+
+
+def test_generate_and_solve(tmp_path):
+    gen_file = str(tmp_path / "gen.yaml")
+    run_cli("-o", gen_file, "generate", "graph_coloring", "-v", "6",
+            "-c", "3", "-g", "random", "--p_edge", "0.5", "--soft",
+            "--seed", "1")
+    proc = run_cli("-t", "20", "solve", "-a", "mgm",
+                   "-p", "stop_cycle:20", "-d", "adhoc", gen_file)
+    result = json.loads(proc.stdout)
+    assert result["status"] == "FINISHED"
+    assert len(result["assignment"]) == 6
+
+
+def test_generate_scenario_roundtrip(tmp_path):
+    scen_file = str(tmp_path / "scen.yaml")
+    run_cli("-o", scen_file, "generate", "scenario", "--agents", "a1",
+            "a2", "a3", "--evts_count", "1", "--seed", "0")
+    sys.path.insert(0, REPO)
+    from pydcop_tpu.dcop.yamldcop import load_scenario_from_file
+
+    scenario = load_scenario_from_file(scen_file)
+    assert len(scenario.events) == 2
+
+
+@pytest.mark.slow
+def test_run_with_scenario(gc3_file, tmp_path):
+    scen = tmp_path / "scen.yaml"
+    scen.write_text(
+        "events:\n"
+        "  - id: d1\n    delay: 0.5\n"
+        "  - id: e1\n    actions:\n"
+        "      - type: remove_agent\n        agents: [a1]\n")
+    proc = run_cli("-t", "30", "run", "-a", "maxsum", "-d", "adhoc",
+                   "-s", str(scen), "-k", "1", gc3_file, timeout=180)
+    result = json.loads(proc.stdout)
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+
+
+@pytest.mark.slow
+def test_batch_and_consolidate(tmp_path, gc3_file):
+    bench = tmp_path / "bench.yaml"
+    bench.write_text(f"""
+sets:
+  s1:
+    path: '{gc3_file}'
+batches:
+  b1:
+    command: solve
+    command_options:
+      algo: [maxsum]
+      timeout: 15
+""")
+    out_dir = str(tmp_path / "out")
+    proc = run_cli("batch", str(bench), "--simulate")
+    assert "1 jobs" in proc.stdout
+    run_cli("batch", str(bench), "--dir", out_dir, timeout=180)
+    # resume: nothing left to run
+    proc = run_cli("batch", str(bench), "--dir", out_dir)
+    assert "0 to run" in proc.stdout
+    proc = run_cli("consolidate", os.path.join(out_dir, "*.json"))
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 2
+    assert "FINISHED" in lines[1]

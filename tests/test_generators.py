@@ -1,0 +1,117 @@
+"""Benchmark-generator tests.
+
+Each generator must produce a well-formed DCOP that YAML round-trips
+(reference generators emit YAML) and solves with the compiled engine.
+"""
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import dcop_yaml, load_dcop
+from pydcop_tpu.generators.agents import generate_agents
+from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
+from pydcop_tpu.generators.iot import generate_iot
+from pydcop_tpu.generators.ising import generate_ising
+from pydcop_tpu.generators.meetingscheduling import generate_meetings
+from pydcop_tpu.generators.scenario import generate_scenario
+from pydcop_tpu.generators.secp import generate_secp
+from pydcop_tpu.generators.smallworld import generate_small_world
+from pydcop_tpu.infrastructure.run import solve_result
+
+
+def test_graph_coloring_random():
+    dcop = generate_graph_coloring(10, 3, graph_type="random",
+                                   p_edge=0.4, soft=True, seed=42)
+    assert len(dcop.variables) == 10
+    assert len(dcop.agents) == 10
+    assert all(len(c.dimensions) <= 2
+               for c in dcop.constraints.values())
+    res = solve_result(dcop, "dsa", timeout=10, stop_cycle=30)
+    assert set(res.assignment) == set(dcop.variables)
+
+
+def test_graph_coloring_scale_free_and_grid():
+    sf = generate_graph_coloring(12, 3, graph_type="scalefree",
+                                 m_edge=2, seed=1)
+    assert len(sf.variables) == 12
+    grid = generate_graph_coloring(9, 4, graph_type="grid", seed=1)
+    # 3x3 grid: 12 edges
+    assert len(grid.constraints) == 12
+
+
+def test_graph_coloring_extensive_roundtrip():
+    dcop = generate_graph_coloring(6, 3, graph_type="random",
+                                   p_edge=0.5, extensive=True, seed=3)
+    yaml_str = dcop_yaml(dcop)
+    dcop2 = load_dcop(yaml_str)
+    assert set(dcop2.variables) == set(dcop.variables)
+    assert set(dcop2.constraints) == set(dcop.constraints)
+
+
+def test_graph_coloring_errors():
+    with pytest.raises(ValueError):
+        generate_graph_coloring(10, 3)  # random without p_edge
+    with pytest.raises(ValueError):
+        generate_graph_coloring(10, 3, graph_type="grid")  # not square
+
+
+def test_ising():
+    dcop = generate_ising(3, 3, seed=0)
+    assert len(dcop.variables) == 9
+    # toroidal grid: 2 couplings per cell + 1 unary each
+    assert len(dcop.constraints) == 9 * 2 + 9
+    res = solve_result(dcop, "maxsum", timeout=15, max_cycles=30)
+    assert set(res.assignment) == set(dcop.variables)
+
+
+def test_meetings_peav():
+    dcop = generate_meetings(slots_count=4, events_count=3,
+                             resources_count=3, seed=5)
+    assert dcop.objective == "max"
+    assert dcop.variables
+    res = solve_result(dcop, "dsa", timeout=10, stop_cycle=30)
+    assert set(res.assignment) == set(dcop.variables)
+
+
+def test_secp():
+    dcop = generate_secp(lights_count=6, models_count=2, rules_count=1,
+                         seed=7)
+    assert len(dcop.variables) == 6
+    assert len(dcop.agents) == 6
+    res = solve_result(dcop, "mgm", timeout=10, stop_cycle=30)
+    assert set(res.assignment) == set(dcop.variables)
+
+
+def test_iot_and_smallworld():
+    iot = generate_iot(num_device=12, seed=2)
+    assert len(iot.variables) == 12
+    sw = generate_small_world(14, seed=2)
+    assert len(sw.variables) == 14
+    # every agent in iot hosts its own device cheaply
+    a0 = iot.agent("a000")
+    assert a0.hosting_cost("d000") == 0
+    assert a0.hosting_cost("d001") == 100
+
+
+def test_generate_agents_name_mapping_and_routes():
+    dcop = generate_graph_coloring(5, 3, graph_type="random",
+                                   p_edge=0.6, seed=0)
+    agents = generate_agents(dcop=dcop, hosting="name_mapping",
+                             routes="uniform", seed=0)
+    assert len(agents) == 5
+    v0 = sorted(dcop.variables)[0]
+    assert agents[0].hosting_cost(v0) == 0
+    assert agents[0].hosting_cost("other") == 100
+    # routes symmetric
+    assert agents[0].route(agents[1].name) == \
+        agents[1].route(agents[0].name)
+
+
+def test_generate_scenario():
+    sc = generate_scenario([f"a{i}" for i in range(10)], evts_count=2,
+                           actions_count=2, delay=5, keep=["a0"],
+                           seed=0)
+    assert len(sc.events) == 4  # delay + action, twice
+    removed = [a for e in sc.events if not e.is_delay
+               for act in e.actions for a in act.args["agents"]]
+    assert "a0" not in removed
+    assert len(removed) == 4
