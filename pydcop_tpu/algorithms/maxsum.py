@@ -80,6 +80,33 @@ class MaxSumSolver(ArraySolver):
         self.E = arrays.n_edges
         self.D = arrays.max_domain
         self.V = arrays.n_vars
+        # Canonical factor-major edge layout (edge 2f/2f+1 = the two
+        # endpoints of factor f, as the fast generators emit): the
+        # per-bucket gather/scatter degenerates into reshapes, removing
+        # the two most expensive irregular ops of the cycle on TPU.
+        self._canonical = self._detect_canonical(arrays)
+
+    @staticmethod
+    def _detect_canonical(arrays):
+        import numpy as np
+
+        offset = 0
+        layout = []
+        for b in arrays.buckets:
+            arity = b.cubes.ndim - 1
+            if arity == 0:
+                layout.append(None)
+                continue
+            f = b.edge_ids.shape[0]
+            expected = offset + np.arange(f * arity, dtype=np.int64) \
+                .reshape(f, arity)
+            if not np.array_equal(np.asarray(b.edge_ids), expected):
+                return None
+            layout.append((offset, f, arity))
+            offset += f * arity
+        if offset != arrays.n_edges:
+            return None
+        return layout
 
     def init_state(self, key):
         edge_mask = self.domain_mask[self.edge_var]
@@ -106,15 +133,36 @@ class MaxSumSolver(ArraySolver):
         edge_mask = self.domain_mask[self.edge_var]
 
         # --- factor update: min-marginal messages per arity bucket -------
-        new_r = jnp.zeros((self.E, self.D), dtype=q.dtype)
-        for cubes, (_, edge_ids, _) in zip(self._cubes(s), self.buckets):
-            arity = cubes.ndim - 1
-            if arity == 0:
-                continue
-            q_in = [q[edge_ids[:, p]] for p in range(arity)]
-            msgs = factor_messages(cubes, q_in)
-            for p in range(arity):
-                new_r = new_r.at[edge_ids[:, p]].set(msgs[p])
+        if self._canonical is not None:
+            # factor-major layout: slices + reshapes, no gather/scatter
+            blocks = []
+            for cubes, spec in zip(self._cubes(s), self._canonical):
+                if spec is None:
+                    continue
+                offset, f, arity = spec
+                q_blk = q[offset:offset + f * arity] \
+                    .reshape(f, arity, self.D)
+                q_in = [q_blk[:, p] for p in range(arity)]
+                msgs = factor_messages(cubes, q_in)
+                blocks.append(jnp.stack(msgs, axis=1)
+                              .reshape(f * arity, self.D))
+            if not blocks:  # unary-only problem: no factor messages
+                new_r = jnp.zeros((self.E, self.D), dtype=q.dtype)
+            elif len(blocks) == 1:
+                new_r = blocks[0]
+            else:
+                new_r = jnp.concatenate(blocks, axis=0)
+        else:
+            new_r = jnp.zeros((self.E, self.D), dtype=q.dtype)
+            for cubes, (_, edge_ids, _) in zip(self._cubes(s),
+                                               self.buckets):
+                arity = cubes.ndim - 1
+                if arity == 0:
+                    continue
+                q_in = [q[edge_ids[:, p]] for p in range(arity)]
+                msgs = factor_messages(cubes, q_in)
+                for p in range(arity):
+                    new_r = new_r.at[edge_ids[:, p]].set(msgs[p])
         if self.damping_nodes in ("factors", "both") and self.damping > 0:
             new_r = self.damping * r + (1 - self.damping) * new_r
 

@@ -146,30 +146,41 @@ def run_cmd(args, timeout=None):
     todo = [(j, a) for j, a in jobs if j not in done]
     print(f"{len(jobs)} jobs, {len(done)} done, {len(todo)} to run")
 
+    import threading
     from concurrent.futures import ThreadPoolExecutor
+
+    progress_lock = threading.Lock()
 
     def run_one(job):
         job_id, argv = job
         out_path = os.path.join(args.out_dir, f"{job_id}.json")
         argv = argv[:3] + ["--output", out_path] + argv[3:]
         t0 = time.perf_counter()
+        failure = None
         try:
             proc = subprocess.run(
                 argv, capture_output=True, text=True,
                 timeout=args.job_timeout)
-            ok = proc.returncode == 0
+            if proc.returncode != 0:
+                failure = (f"exit {proc.returncode}\n--- stdout ---\n"
+                           f"{proc.stdout}\n--- stderr ---\n"
+                           f"{proc.stderr}")
         except subprocess.TimeoutExpired:
-            ok = False
-        print(f"[{'ok' if ok else 'FAIL'}] {job_id} "
+            failure = f"timed out after {args.job_timeout}s"
+        if failure is None:
+            # register_job immediately (not in submission order) so an
+            # interrupted --parallel campaign never re-runs a finished
+            # job on resume (reference: batch.py:501)
+            with progress_lock, open(progress_path, "a") as f:
+                f.write(job_id + "\n")
+        else:
+            with open(os.path.join(args.out_dir,
+                                   f"{job_id}.log"), "w") as f:
+                f.write(failure)
+        print(f"[{'ok' if failure is None else 'FAIL'}] {job_id} "
               f"({time.perf_counter() - t0:.1f}s)")
-        return job_id, ok
+        return failure is None
 
     with ThreadPoolExecutor(max_workers=max(1, args.parallel)) as pool:
-        for job_id, ok in pool.map(run_one, todo):
-            if ok:
-                # register_job: append to the progress file so an
-                # interrupted campaign resumes where it stopped
-                # (reference: batch.py:501)
-                with open(progress_path, "a") as f:
-                    f.write(job_id + "\n")
+        list(pool.map(run_one, todo))
     return 0

@@ -3,8 +3,6 @@
 reference parity: pydcop/commands/distribute.py:226-407.
 """
 
-import yaml
-
 from . import CliError, output_json
 from ..dcop.yamldcop import load_dcop_from_file
 

@@ -35,13 +35,19 @@ def generate_ising(row_count: int, col_count: int,
             dcop.add_constraint(UnaryFunctionRelation(
                 f"u_v{r}_{c}", v, lambda s, _h=h: _h * (2 * s - 1)))
     # cyclic right + down neighbors: every cell has exactly 2 outgoing
-    # couplings, giving the standard toroidal Ising grid
+    # couplings, giving the standard toroidal Ising grid.  2-wide grids
+    # wrap onto the same pair from both sides: dedup.
+    seen_pairs = set()
     for r in range(row_count):
         for c in range(col_count):
             for (r2, c2) in (((r + 1) % row_count, c),
                              (r, (c + 1) % col_count)):
                 if (r2, c2) == (r, c):
                     continue
+                pair = tuple(sorted(((r, c), (r2, c2))))
+                if pair in seen_pairs:
+                    continue
+                seen_pairs.add(pair)
                 v1, v2 = grid[(r, c)], grid[(r2, c2)]
                 coupling = random.uniform(-bin_range, bin_range)
                 rel = NAryMatrixRelation([v1, v2],

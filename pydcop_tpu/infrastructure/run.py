@@ -72,14 +72,15 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         # caller asks for one (default None: the engine doesn't need it).
         from ..distribution import load_distribution_module
 
-        # an unknown distribution name is a user error: fail hard
+        # an unknown distribution name is a user error: fail hard, as is
+        # a graph build failure (a real bug, not an infeasible placement)
         dist_module = load_distribution_module(distribution)
+        graph = load_graph_module(
+            algo_module.GRAPH_TYPE).build_computation_graph(dcop)
         # ...but a placement that merely cannot be computed (capacity
         # infeasible, missing footprint model) must not kill the solve:
         # the engine does not need the placement for the math
         try:
-            graph = load_graph_module(
-                algo_module.GRAPH_TYPE).build_computation_graph(dcop)
             dist_obj = dist_module.distribute(
                 graph, dcop.agents_def, dcop.dist_hints,
                 algo_module.computation_memory,

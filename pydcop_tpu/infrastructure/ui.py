@@ -1,0 +1,130 @@
+"""Per-agent websocket UI server.
+
+reference parity: pydcop/infrastructure/ui.py:43-262 — one websocket
+server per agent (ports 10001+), exposing agent/computation state to a
+live GUI and forwarding event-bus traffic (value/cycle events) to
+connected clients.
+
+Protocol (JSON text frames):
+
+* client request ``{"cmd": "agent"}`` → agent description
+* client request ``{"cmd": "computations"}`` → list of computations
+  with current value/state
+* server push ``{"evt": topic, "data": ...}`` for subscribed event-bus
+  topics (``computations.value.*`` / ``computations.cycle.*``).
+"""
+
+import json
+import logging
+import threading
+from typing import Optional, Set
+
+from .Events import event_bus
+
+logger = logging.getLogger("pydcop_tpu.infrastructure.ui")
+
+
+class UiServer:
+    """Websocket server exposing one agent's state
+    (reference: ui.py:43-120)."""
+
+    def __init__(self, agent, port: int = 10001):
+        self.agent = agent
+        self.port = port
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self._clients: Set = set()
+        self._clients_lock = threading.Lock()
+        self._sub_id: Optional[str] = None
+
+    def start(self):
+        from websockets.sync.server import serve
+
+        self._server = serve(self._handle_client, "0.0.0.0", self.port)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"ui-{self.agent.name}-{self.port}", daemon=True)
+        self._thread.start()
+        # forward value/cycle events to connected clients
+        self._sub_id = event_bus.subscribe(
+            "computations.*", self._on_event,
+            sub_id=f"ui_{self.agent.name}_{self.port}")
+        logger.info("UI server for %s on ws://0.0.0.0:%s",
+                    self.agent.name, self.port)
+
+    def stop(self):
+        if self._sub_id:
+            event_bus.unsubscribe(self._sub_id)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # ------------------------------------------------------- handlers
+
+    def _handle_client(self, websocket):
+        with self._clients_lock:
+            self._clients.add(websocket)
+        try:
+            for raw in websocket:
+                try:
+                    req = json.loads(raw)
+                except json.JSONDecodeError:
+                    websocket.send(json.dumps(
+                        {"error": "invalid json"}))
+                    continue
+                websocket.send(json.dumps(self._answer(req)))
+        except Exception:
+            pass
+        finally:
+            with self._clients_lock:
+                self._clients.discard(websocket)
+
+    def _answer(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd == "agent":
+            agent_def = self.agent.agent_def
+            return {
+                "agent": self.agent.name,
+                "is_running": self.agent.is_running,
+                "capacity": (agent_def.capacity
+                             if agent_def is not None else None),
+                "replicas": sorted(
+                    getattr(self.agent, "replicas", {})),
+            }
+        if cmd == "computations":
+            comps = []
+            for c in self.agent.computations():
+                comps.append({
+                    "name": c.name,
+                    "type": type(c).__name__,
+                    "running": c.is_running,
+                    "paused": c.is_paused,
+                    "value": getattr(c, "current_value", None),
+                    "cycle": getattr(c, "cycle_count", 0),
+                })
+            return {"agent": self.agent.name, "computations": comps}
+        return {"error": f"unknown command {cmd!r}"}
+
+    def _on_event(self, topic: str, evt):
+        # only forward events about computations hosted on this agent
+        comp = topic.rsplit(".", 1)[-1]
+        if not self.agent.has_computation(comp):
+            return
+        msg = json.dumps({"evt": topic, "data": _jsonable(evt)})
+        with self._clients_lock:
+            clients = list(self._clients)
+        for ws in clients:
+            try:
+                ws.send(msg)
+            except Exception:
+                pass
+
+
+def _jsonable(evt):
+    try:
+        json.dumps(evt)
+        return evt
+    except TypeError:
+        if isinstance(evt, tuple):
+            return [_jsonable(e) for e in evt]
+        return repr(evt)

@@ -1,0 +1,18 @@
+"""Misc introspection helpers.
+
+reference parity: pydcop/utils/various.py (func_args).
+"""
+
+import inspect
+from typing import Callable, List
+
+
+def func_args(f: Callable) -> List[str]:
+    """Names of the positional/keyword arguments of ``f``
+    (reference: various.py func_args)."""
+    sig = inspect.signature(f)
+    return [
+        name for name, p in sig.parameters.items()
+        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY,
+                      p.POSITIONAL_ONLY)
+    ]

@@ -205,3 +205,37 @@ def test_footprints():
     assert module.communication_load(f, "v1") == 2
     with pytest.raises(ValueError):
         module.communication_load(f, "v3")
+
+
+def test_maxsum_unary_only():
+    """A DCOP with only unary cost functions must still solve
+    (regression: empty factor-block concat in the canonical path)."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop("""
+name: unary
+objective: min
+domains:
+  d: {values: [a, b]}
+variables:
+  x1: {domain: d, cost_function: 0 if x1 == 'a' else 1}
+  x2: {domain: d, cost_function: 1 if x2 == 'a' else 0}
+constraints: {}
+agents: [a1]
+""")
+    res = solve_result(dcop, "maxsum", timeout=10)
+    assert res.assignment == {"x1": "a", "x2": "b"}
+
+
+def test_ising_generator_no_duplicate_pairs():
+    """2-row toroidal grids must not emit two couplings for one pair."""
+    from pydcop_tpu.generators.ising import generate_ising
+
+    dcop = generate_ising(2, 3, seed=0)
+    pairs = set()
+    for name, c in dcop.constraints.items():
+        if len(c.dimensions) == 2:
+            pair = tuple(sorted(v.name for v in c.dimensions))
+            assert pair not in pairs, f"duplicate coupling {pair}"
+            pairs.add(pair)

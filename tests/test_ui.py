@@ -1,0 +1,74 @@
+"""UI websocket server tests (reference: infrastructure/ui.py +
+tests/utils/ws-client.html)."""
+
+import json
+import time
+
+import pytest
+
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import \
+    InProcessCommunicationLayer
+from pydcop_tpu.infrastructure.Events import event_bus
+from pydcop_tpu.infrastructure.ui import UiServer
+from pydcop_tpu.utils.various import func_args
+
+
+def test_func_args():
+    def f(a, b, c=1, *args, d=2, **kw):
+        pass
+
+    assert func_args(f) == ["a", "b", "c", "d"]
+
+
+def test_ui_server_agent_and_computations():
+    from websockets.sync.client import connect
+
+    agent = Agent("ui_test", InProcessCommunicationLayer())
+    agent.start()
+    server = UiServer(agent, port=10901)
+    server.start()
+    try:
+        time.sleep(0.2)
+        with connect("ws://127.0.0.1:10901") as ws:
+            ws.send(json.dumps({"cmd": "agent"}))
+            resp = json.loads(ws.recv(timeout=5))
+            assert resp["agent"] == "ui_test"
+            assert resp["is_running"] is True
+            ws.send(json.dumps({"cmd": "computations"}))
+            resp = json.loads(ws.recv(timeout=5))
+            assert resp["computations"] == []
+            ws.send(json.dumps({"cmd": "bogus"}))
+            resp = json.loads(ws.recv(timeout=5))
+            assert "error" in resp
+    finally:
+        server.stop()
+        agent.clean_shutdown()
+
+
+def test_ui_event_forwarding():
+    from websockets.sync.client import connect
+
+    from pydcop_tpu.infrastructure.computations import \
+        MessagePassingComputation
+
+    agent = Agent("ui_evt", InProcessCommunicationLayer())
+    comp = MessagePassingComputation("c_ui")
+    agent.add_computation(comp, publish=False)
+    agent.start()
+    server = UiServer(agent, port=10902)
+    server.start()
+    was_enabled = event_bus.enabled
+    event_bus.enabled = True
+    try:
+        time.sleep(0.2)
+        with connect("ws://127.0.0.1:10902") as ws:
+            time.sleep(0.2)
+            event_bus.send("computations.value.c_ui", ("R", 0.5, 3))
+            msg = json.loads(ws.recv(timeout=5))
+            assert msg["evt"] == "computations.value.c_ui"
+            assert msg["data"] == ["R", 0.5, 3]
+    finally:
+        event_bus.enabled = was_enabled
+        server.stop()
+        agent.clean_shutdown()
