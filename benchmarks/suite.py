@@ -1,0 +1,202 @@
+"""Benchmark suite: the BASELINE.md target configurations.
+
+Runs each target config and prints one JSON line per benchmark (plus a
+final summary line).  ``bench.py`` at the repo root stays the driver's
+single headline metric; this suite is the full coverage:
+
+1. 10-var/3-color coloring through the public solve API (the reference's
+   CI envelope: correct assignment within seconds — BASELINE.md #1),
+2. 1k-var damped A-MaxSum on a factor graph (#2),
+3. DPOP UTIL/VALUE on a ~200-agent meeting-scheduling pseudo-tree (#3),
+4. DSA-B and MGM-2 on a 10k-variable grid (#4),
+5. batched instances vmapped across the chip (#5; pmapped over 8 devices
+   when available).
+
+Usage: python benchmarks/suite.py [--quick]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def bench_solve_api_small():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    yaml_src = """
+name: gc10
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+""" + "".join(
+        f"  v{i}: {{domain: colors, cost_function: '0', "
+        f"noise_level: 0.02}}\n"
+        for i in range(10)) + "constraints:\n" + "".join(
+        f"  c{i}: {{type: intention, function: 1 if v{i} == v{(i+1)%10} "
+        f"else 0}}\n" for i in range(10)) + \
+        "agents: [" + ", ".join(f"a{i}" for i in range(10)) + "]\n"
+    dcop = load_dcop(yaml_src)
+    t0 = time.perf_counter()
+    res = solve_result(dcop, "maxsum", timeout=15)
+    return {
+        "metric": "solve_api_gc10_maxsum_seconds",
+        "value": round(time.perf_counter() - t0, 3), "unit": "s",
+        "cost": res.cost, "violations": res.violations,
+        "status": res.status,
+    }
+
+
+def bench_amaxsum_1k(quick=False):
+    import jax
+
+    from pydcop_tpu.algorithms.amaxsum import AMaxSumSolver
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    n = 200 if quick else 1000
+    arrays = coloring_factor_arrays(n, 3 * n, 3, seed=11, noise=0.05)
+    solver = AMaxSumSolver(arrays, activation=0.7, damping=0.5,
+                           stability=0.0)
+    k = 50
+
+    @jax.jit
+    def run_k(s):
+        return jax.lax.fori_loop(0, k, lambda i, st: solver.step(st), s)
+
+    state = solver.init_state(jax.random.PRNGKey(0))
+    state = run_k(state)
+    jax.block_until_ready(state["selection"])
+    state = solver.init_state(jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    state = run_k(state)
+    jax.block_until_ready(state["selection"])
+    elapsed = time.perf_counter() - t0
+    msgs = 2 * arrays.n_edges * k
+    return {
+        "metric": f"amaxsum_{n}var_msgs_per_sec",
+        "value": round(msgs / elapsed, 1), "unit": "msgs/s",
+    }
+
+
+def bench_dpop_meetings(quick=False):
+    from pydcop_tpu.algorithms.dpop import solve_direct
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+
+    # resources ~= events keeps the pseudo-tree's induced width small
+    # (few events share a resource), so the exact DPOP tables stay
+    # feasible at ~200 agents — the BASELINE.md #3 shape
+    events = 20 if quick else 100
+    dcop = generate_meetings(
+        slots_count=6, events_count=events,
+        resources_count=max(3, events), max_resources_event=2,
+        seed=13)
+    n_vars = len(dcop.variables)
+    t0 = time.perf_counter()
+    res = solve_direct(dcop, {}, timeout=120)
+    return {
+        "metric": f"dpop_meetings_{n_vars}vars_seconds",
+        "value": round(time.perf_counter() - t0, 3), "unit": "s",
+        "status": res.status, "violations": res.violations,
+    }
+
+
+def bench_localsearch_10k(quick=False):
+    import jax
+
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+    from pydcop_tpu.algorithms.mgm2 import Mgm2Solver
+    from pydcop_tpu.generators.fast import coloring_hypergraph_arrays
+
+    n = 1024 if quick else 10_000
+    side = int(n ** 0.5)
+    n = side * side
+    # grid edges (sensor-grid style)
+    import numpy as np
+
+    edges = []
+    for r in range(side):
+        for c in range(side):
+            i = r * side + c
+            if c + 1 < side:
+                edges.append((i, i + 1))
+            if r + 1 < side:
+                edges.append((i, i + side))
+    edges = np.array(edges, dtype=np.int32)
+    arrays = coloring_hypergraph_arrays(n, len(edges), n_colors=4,
+                                        seed=17, edges=edges)
+    out = {}
+    for name, solver in (
+            ("dsa_b", DsaSolver(arrays, probability=0.7, variant="B")),
+            ("mgm2", Mgm2Solver(arrays, threshold=0.5))):
+        k = 20
+
+        @jax.jit
+        def run_k(s, _solver=solver):
+            return jax.lax.fori_loop(
+                0, k, lambda i, st: _solver.step(st), s)
+
+        state = solver.init_state(jax.random.PRNGKey(0))
+        state = run_k(state)
+        jax.block_until_ready(state["x"])
+        state = solver.init_state(jax.random.PRNGKey(0))
+        t0 = time.perf_counter()
+        state = run_k(state)
+        jax.block_until_ready(state["x"])
+        per_cycle = (time.perf_counter() - t0) / k
+        out[name] = round(per_cycle * 1e3, 3)
+    return {
+        "metric": f"localsearch_{n}var_grid_ms_per_cycle",
+        "value": out, "unit": "ms/cycle",
+    }
+
+
+def bench_batched(quick=False):
+    import jax
+
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    batch = 64 if quick else 1024
+    template = coloring_factor_arrays(100, 300, 3, seed=19, noise=0.05)
+    runner = BatchedMaxSum(template, batch=batch)
+    t0 = time.perf_counter()
+    selections, cycles, finished = runner.run(seed=0, max_cycles=50)
+    jax.block_until_ready(selections)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": f"batched_{batch}x100var_instances_per_sec",
+        "value": round(batch / elapsed, 1), "unit": "instances/s",
+    }
+
+
+BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
+           bench_dpop_meetings, bench_localsearch_10k, bench_batched]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller sizes (CI-friendly)")
+    args = parser.parse_args()
+    results = []
+    for bench in BENCHES:
+        try:
+            if "quick" in bench.__code__.co_varnames:
+                r = bench(quick=args.quick)
+            else:
+                r = bench()
+        except Exception as e:  # keep the suite running
+            r = {"metric": bench.__name__, "error": repr(e)}
+        results.append(r)
+        print(json.dumps(r))
+    ok = sum(1 for r in results if "error" not in r)
+    print(json.dumps({"suite": "baseline_configs", "ok": ok,
+                      "total": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,16 @@ class AlgorithmDef(SimpleRepr):
             mode: str = "min",
             parameters_definitions: Optional[List[AlgoParameterDef]] = None
     ) -> "AlgorithmDef":
+        """Validate ``params`` against the definitions and fill defaults
+        (reference doctest: algorithms/__init__.py:220-225).
+
+        >>> algo = AlgorithmDef.build_with_default_param(
+        ...     'dsa', {'variant': 'B'})
+        >>> algo.param_value('variant')
+        'B'
+        >>> algo.param_value('probability')
+        0.7
+        """
         if parameters_definitions is None:
             parameters_definitions = load_algorithm_module(algo).algo_params
         return cls(

@@ -182,5 +182,10 @@ def run_cmd(args, timeout=None):
         return failure is None
 
     with ThreadPoolExecutor(max_workers=max(1, args.parallel)) as pool:
-        list(pool.map(run_one, todo))
+        outcomes = list(pool.map(run_one, todo))
+    failed = outcomes.count(False)
+    if failed:
+        print(f"{failed}/{len(outcomes)} jobs failed "
+              f"(see *.log in {args.out_dir})", file=sys.stderr)
+        return 1
     return 0

@@ -94,11 +94,17 @@ def coloring_factor_arrays(n_vars: int, n_edges: int, n_colors: int = 3,
 def coloring_hypergraph_arrays(n_vars: int, n_edges: int,
                                n_colors: int = 3, seed: int = 0,
                                noise: float = 0.05,
-                               conflict_cost: float = 1.0
+                               conflict_cost: float = 1.0,
+                               edges: Optional[np.ndarray] = None
                                ) -> HypergraphArrays:
-    """Same problem, hypergraph form (for the local-search family)."""
+    """Same problem, hypergraph form (for the local-search family).
+    ``edges`` overrides the random graph (e.g. a sensor grid)."""
     rng = np.random.default_rng(seed)
-    edges = random_graph_edges(n_vars, n_edges, seed)
+    if edges is None:
+        edges = random_graph_edges(n_vars, n_edges, seed)
+    else:
+        edges = np.asarray(edges, dtype=np.int32)
+        n_edges = len(edges)
     D = n_colors
     V, C = n_vars, n_edges
     table = np.where(np.eye(D, dtype=bool), conflict_cost, 0.0

@@ -16,12 +16,17 @@ Protocol (JSON text frames):
 
 import json
 import logging
+import queue
 import threading
 from typing import Optional, Set
 
 from .Events import event_bus
 
 logger = logging.getLogger("pydcop_tpu.infrastructure.ui")
+
+#: outbound frames buffered per client; beyond this, events are dropped
+#: (a stalled GUI must never block the agent thread)
+CLIENT_QUEUE_SIZE = 100
 
 
 class UiServer:
@@ -41,6 +46,8 @@ class UiServer:
         from websockets.sync.server import serve
 
         self._server = serve(self._handle_client, "0.0.0.0", self.port)
+        if self.port == 0:  # ephemeral port: read back the real one
+            self.port = self._server.socket.getsockname()[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name=f"ui-{self.agent.name}-{self.port}", daemon=True)
@@ -62,8 +69,31 @@ class UiServer:
     # ------------------------------------------------------- handlers
 
     def _handle_client(self, websocket):
+        from websockets.exceptions import ConnectionClosed
+
+        # outbound event queue + sender thread per client: event-bus
+        # callers enqueue without blocking; only this thread sends
+        outbox: "queue.Queue" = queue.Queue(maxsize=CLIENT_QUEUE_SIZE)
+        client = (websocket, outbox)
         with self._clients_lock:
-            self._clients.add(websocket)
+            self._clients.add(client)
+        alive = threading.Event()
+        alive.set()
+
+        def sender():
+            while alive.is_set():
+                try:
+                    msg = outbox.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                try:
+                    websocket.send(msg)
+                except Exception:
+                    alive.clear()
+
+        sender_thread = threading.Thread(
+            target=sender, name=f"ui-send-{self.port}", daemon=True)
+        sender_thread.start()
         try:
             for raw in websocket:
                 try:
@@ -72,12 +102,21 @@ class UiServer:
                     websocket.send(json.dumps(
                         {"error": "invalid json"}))
                     continue
-                websocket.send(json.dumps(self._answer(req)))
-        except Exception:
+                try:
+                    answer = self._answer(req)
+                except Exception:
+                    logger.exception("UI request failed: %r", req)
+                    answer = {"error": "internal error"}
+                websocket.send(json.dumps(answer))
+        except ConnectionClosed:
             pass
+        except Exception:
+            logger.exception("UI client handler failed on %s",
+                             self.agent.name)
         finally:
+            alive.clear()
             with self._clients_lock:
-                self._clients.discard(websocket)
+                self._clients.discard(client)
 
     def _answer(self, req: dict) -> dict:
         cmd = req.get("cmd")
@@ -113,10 +152,10 @@ class UiServer:
         msg = json.dumps({"evt": topic, "data": _jsonable(evt)})
         with self._clients_lock:
             clients = list(self._clients)
-        for ws in clients:
+        for _, outbox in clients:
             try:
-                ws.send(msg)
-            except Exception:
+                outbox.put_nowait(msg)
+            except queue.Full:  # stalled client: drop, never block
                 pass
 
 

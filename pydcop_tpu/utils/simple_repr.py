@@ -74,7 +74,13 @@ def _init_args(cls):
 
 
 def simple_repr(o: Any):
-    """Return a plain (json/yaml-able) representation of ``o``."""
+    """Return a plain (json/yaml-able) representation of ``o``.
+
+    >>> simple_repr([1, "a", {"k": 2.5}])
+    [1, 'a', {'k': 2.5}]
+    >>> from_repr(simple_repr((1, 2))) == (1, 2)
+    True
+    """
     if isinstance(o, SimpleRepr):
         return o._simple_repr()
     if isinstance(o, tuple):

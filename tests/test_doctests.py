@@ -1,0 +1,31 @@
+"""Doctest tier: run the docstring examples of the core modules.
+
+The reference runs ``pytest --doctest-modules ./pydcop`` as part of
+``make test`` (SURVEY.md §4); this collects the same kind of examples
+explicitly so they stay part of the default suite.
+"""
+
+import doctest
+from importlib import import_module
+
+import pytest
+
+# import_module avoids the package-attribute shadowing quirk:
+# utils/__init__ re-exports the simple_repr *function*, which
+# ``import a.b.simple_repr as m`` would then bind instead of the module
+MODULES = [import_module(n) for n in (
+    "pydcop_tpu.dcop.objects",
+    "pydcop_tpu.dcop.dcop",
+    "pydcop_tpu.algorithms",
+    "pydcop_tpu.infrastructure.computations",
+    "pydcop_tpu.utils.expressionfunction",
+    "pydcop_tpu.utils.simple_repr",
+)]
+
+
+@pytest.mark.parametrize("module", MODULES,
+                         ids=[m.__name__ for m in MODULES])
+def test_doctests(module):
+    result = doctest.testmod(module, verbose=False)
+    assert result.failed == 0
+    assert result.attempted > 0, f"no doctests found in {module.__name__}"

@@ -26,11 +26,11 @@ def test_ui_server_agent_and_computations():
 
     agent = Agent("ui_test", InProcessCommunicationLayer())
     agent.start()
-    server = UiServer(agent, port=10901)
+    server = UiServer(agent, port=0)
     server.start()
     try:
         time.sleep(0.2)
-        with connect("ws://127.0.0.1:10901") as ws:
+        with connect(f"ws://127.0.0.1:{server.port}") as ws:
             ws.send(json.dumps({"cmd": "agent"}))
             resp = json.loads(ws.recv(timeout=5))
             assert resp["agent"] == "ui_test"
@@ -56,13 +56,13 @@ def test_ui_event_forwarding():
     comp = MessagePassingComputation("c_ui")
     agent.add_computation(comp, publish=False)
     agent.start()
-    server = UiServer(agent, port=10902)
+    server = UiServer(agent, port=0)
     server.start()
     was_enabled = event_bus.enabled
     event_bus.enabled = True
     try:
         time.sleep(0.2)
-        with connect("ws://127.0.0.1:10902") as ws:
+        with connect(f"ws://127.0.0.1:{server.port}") as ws:
             time.sleep(0.2)
             event_bus.send("computations.value.c_ui", ("R", 0.5, 3))
             msg = json.loads(ws.recv(timeout=5))
