@@ -1,0 +1,20 @@
+# reference parity: pyDCOP's Makefile (make test = unit + doctests +
+# cli + api tiers).  Tests force the CPU backend with a virtual
+# 8-device mesh (tests/conftest.py).
+
+.PHONY: test test-fast bench suite lint
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+bench:
+	python bench.py
+
+suite:
+	python benchmarks/suite.py
+
+lint:
+	python -m compileall -q pydcop_tpu

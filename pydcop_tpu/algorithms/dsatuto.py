@@ -35,3 +35,85 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend — the reference's tutorial implementation
+# shape (dsatuto.py:66-126): a VariableComputation using the
+# synchronous-rounds mixin, exchanging value messages with neighbors on
+# the agent fabric.  This is the control-plane path; the compiled
+# DsaTutoSolver above is the data-plane path.
+# ---------------------------------------------------------------------
+
+import random as _random
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+
+DsaTutoValueMessage = message_type("dsa_value", ["value"])
+
+
+class DsaTutoComputation(SynchronousComputationMixin,
+                         VariableComputation):
+    """Synchronous DSA-A with p=0.5 as a message-passing computation
+    (reference: dsatuto.py:66-126)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        self.constraints = list(comp_def.node.constraints)
+        self.stop_cycle = comp_def.algo.params.get("stop_cycle", 0)
+        self.mode = comp_def.algo.mode
+
+    def on_start(self):
+        self.start_cycle()
+        self.random_value_selection()
+        self.post_to_all_neighbors(
+            DsaTutoValueMessage(self.current_value), MSG_ALGO)
+
+    @register("dsa_value")
+    def _on_value_msg(self, sender, msg, t):
+        # never called directly: the sync mixin intercepts on_message
+        # and delivers whole rounds through on_new_cycle
+        pass  # pragma: no cover
+
+    def on_new_cycle(self, messages, cycle_id):
+        neighbor_values = {
+            sender: msg.value for sender, (msg, t) in messages.items()}
+        self.new_cycle()
+        current_cost, best_value, best_cost = self._evaluate(
+            neighbor_values)
+        if best_cost != current_cost and _random.random() < 0.5:
+            self.value_selection(best_value, best_cost)
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            DsaTutoValueMessage(self.current_value), MSG_ALGO)
+
+    def _evaluate(self, neighbor_values):
+        """(current model cost, best value, best model cost) given the
+        neighbors' current values; "best" minimizes (mode=min) or
+        maximizes (mode=max)."""
+        from ..dcop.relations import assignment_cost
+
+        sign = 1 if self.mode == "min" else -1
+        best_value, best_signed, current_signed = None, None, None
+        for value in self.variable.domain.values:
+            assignment = dict(neighbor_values)
+            assignment[self.variable.name] = value
+            signed = sign * assignment_cost(
+                assignment, [
+                    c for c in self.constraints
+                    if set(c.scope_names) <= set(assignment)])
+            if value == self.current_value:
+                current_signed = signed
+            if best_signed is None or signed < best_signed:
+                best_value, best_signed = value, signed
+        return (None if current_signed is None else sign * current_signed,
+                best_value, sign * best_signed)
+
+
+def build_computation(comp_def) -> DsaTutoComputation:
+    return DsaTutoComputation(comp_def)

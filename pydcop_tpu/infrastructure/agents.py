@@ -111,6 +111,10 @@ class Agent:
         self._started_event = threading.Event()
         self._periodic: List[_PeriodicAction] = []
         self._periodic_lock = threading.Lock()
+        # messages for computations that are not running yet: parked and
+        # delivered from the event loop once the computation starts
+        # (reference buffers pre-start messages too, computations.py:400)
+        self._pending_start: Dict[str, List] = {}
         self.t_active = 0.0
         self._t_started: Optional[float] = None
         self.metrics = AgentMetrics(self)
@@ -257,6 +261,7 @@ class Agent:
                             "Long message handling (%.2fs) on %s: %s",
                             handling, self._name, msg.dest_comp)
                 self._tick_periodic()
+                self._flush_pending_start()
         except Exception as e:  # pragma: no cover - defensive
             self.logger.exception("Agent %s failed: %s", self._name, e)
             if self._on_fail_cb:
@@ -279,15 +284,30 @@ class Agent:
                 self._name)
             return
         if not comp.is_running and not comp.is_paused:
-            # buffer via the computation's pause machinery would lose
-            # start ordering; deliver anyway for control computations
+            # control computations accept messages without a start;
+            # algorithm computations get theirs parked until started
             if dest.startswith("_"):
                 comp.on_message(cm.src_comp, cm.msg, time.perf_counter())
+            else:
+                self._pending_start.setdefault(dest, []).append(cm)
             return
         event_bus.send(
             f"computations.message_rcv.{dest}",
             (cm.src_comp, getattr(cm.msg, "size", 1)))
         comp.on_message(cm.src_comp, cm.msg, time.perf_counter())
+
+    def _flush_pending_start(self):
+        """Deliver parked messages to computations that started since
+        (runs on the agent thread, so delivery stays single-threaded)."""
+        if not self._pending_start:
+            return
+        for name in list(self._pending_start):
+            comp = self._computations.get(name)
+            if comp is None:
+                del self._pending_start[name]
+            elif comp.is_running:
+                for cm in self._pending_start.pop(name):
+                    self._handle_message(cm)
 
     def _tick_periodic(self):
         now = time.perf_counter()

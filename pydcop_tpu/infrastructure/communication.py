@@ -359,15 +359,22 @@ class Messaging:
                                              prio or MSG_ALGO))
         else:
             self._record_ext(src_comp, msg)
-            full = _Envelope(src_comp, dest_comp, msg)
+            # the sync-round cycle tag is a plain attribute, invisible
+            # to simple_repr: carry it in the envelope so remote rounds
+            # stay aligned (reference tags every message with cycle_id)
+            full = _Envelope(src_comp, dest_comp, msg,
+                             getattr(msg, "_cycle_id", None))
             self._comm.send_msg(self._agent_name, dest_agent, full,
                                 prio=prio or MSG_ALGO, on_error=on_error)
 
     def post_local(self, envelope, prio: int = MSG_ALGO):
         """Deliver a message arriving from the network."""
         if isinstance(envelope, _Envelope):
+            msg = envelope.msg
+            if envelope.cycle_id is not None:
+                msg._cycle_id = envelope.cycle_id
             self._enqueue(ComputationMessage(
-                envelope.src_comp, envelope.dest_comp, envelope.msg, prio))
+                envelope.src_comp, envelope.dest_comp, msg, prio))
         else:
             self._enqueue(ComputationMessage(None, None, envelope, prio))
 
@@ -397,12 +404,15 @@ class Messaging:
 
 
 class _Envelope(SimpleRepr):
-    """Routing wrapper carrying computation names across the wire."""
+    """Routing wrapper carrying computation names (and the sync-round
+    cycle tag) across the wire."""
 
-    def __init__(self, src_comp: str, dest_comp: str, msg):
+    def __init__(self, src_comp: str, dest_comp: str, msg,
+                 cycle_id: Optional[int] = None):
         self._src_comp = src_comp
         self._dest_comp = dest_comp
         self._msg = msg
+        self._cycle_id = cycle_id
 
     @property
     def src_comp(self):
@@ -416,9 +426,14 @@ class _Envelope(SimpleRepr):
     def msg(self):
         return self._msg
 
+    @property
+    def cycle_id(self):
+        return self._cycle_id
+
     def _simple_repr(self):
         return {"__qualname__": "_Envelope",
                 "__module__": type(self).__module__,
                 "src_comp": self._src_comp,
                 "dest_comp": self._dest_comp,
-                "msg": simple_repr(self._msg)}
+                "msg": simple_repr(self._msg),
+                "cycle_id": self._cycle_id}

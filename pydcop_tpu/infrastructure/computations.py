@@ -216,8 +216,13 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         return 1.0
 
     def start(self):
-        self._running = True
+        # on_start runs before the computation is marked running:
+        # messages arriving meanwhile are parked by the hosting agent
+        # and delivered on its thread once is_running flips, so startup
+        # state (e.g. the sync mixin's cycle maps) is never mutated from
+        # two threads at once
         self.on_start()
+        self._running = True
 
     def stop(self):
         self.on_stop()
@@ -339,9 +344,9 @@ class SynchronousComputationMixin:
             self._init_sync()
         return self._current_cycle
 
-    @property
-    def neighbors(self) -> List[str]:  # pragma: no cover - abstract
-        raise NotImplementedError()
+    # subclasses must provide a ``neighbors`` property (DcopComputation
+    # does); the mixin deliberately does not declare one — an abstract
+    # property here would shadow the concrete one under this MRO
 
     def start_cycle(self):
         """Called by subclasses from on_start to open cycle 0."""

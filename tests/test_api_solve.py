@@ -1,0 +1,82 @@
+"""API tier: end-to-end through the public ``solve`` API on the
+canonical fixtures.
+
+Mirrors the reference's tests/api/test_api_solve.py:36-105: exact
+optimum asserted for complete algorithms, either-of-two acceptable
+colorings for local search / message passing, on
+``tests/instances/graph_coloring_3.yaml``.
+"""
+
+import os
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+from pydcop_tpu.infrastructure.run import solve, solve_result
+
+INSTANCES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "instances")
+
+OPTIMUM = {"v1": "R", "v2": "G", "v3": "R"}
+ACCEPTABLE = [
+    {"v1": "R", "v2": "G", "v3": "R"},
+    {"v1": "G", "v2": "R", "v3": "G"},
+]
+
+
+@pytest.fixture(scope="module")
+def gc3():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_3.yaml"))
+
+
+@pytest.mark.parametrize("algo", ["dpop", "syncbb", "ncbb"])
+def test_api_solve_exact(gc3, algo):
+    assert solve(gc3, algo, timeout=10) == OPTIMUM
+
+
+@pytest.mark.parametrize("algo", ["maxsum", "amaxsum"])
+def test_api_solve_maxsum_family(gc3, algo):
+    assert solve(gc3, algo, timeout=10) == OPTIMUM
+
+
+@pytest.mark.parametrize(
+    "algo", ["dsa", "adsa", "dsatuto", "mixeddsa", "mgm", "mgm2"])
+def test_api_solve_local_search(gc3, algo):
+    assignment = solve(gc3, algo, timeout=10, stop_cycle=30)
+    assert assignment in ACCEPTABLE
+
+
+def test_api_solve_gdba(gc3):
+    # gdba has no stop_cycle param (as in the reference); the engine's
+    # cycle cap bounds the run
+    assignment = solve(gc3, "gdba", timeout=10, max_cycles=50)
+    assert assignment in ACCEPTABLE
+
+
+def test_api_solve_result_metadata(gc3):
+    res = solve_result(gc3, "maxsum", timeout=10)
+    assert res.status == "FINISHED"
+    assert res.cost == pytest.approx(-0.1)
+    assert res.violations == 0
+    assert res.cycles < 20
+
+
+def test_api_secp_instance():
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "secp_simple.yaml"))
+    res = solve_result(dcop, "mgm", timeout=10, stop_cycle=40)
+    # no hard rule violated, scene close to target
+    assert res.violations == 0
+    values = res.assignment
+    assert values["l1"] + values["l2"] <= 7
+
+
+def test_api_coloring_10(gc3):
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "coloring_random_10.yaml"))
+    res = solve_result(dcop, "maxsum", timeout=15, max_cycles=200)
+    conflicts = sum(
+        1 for c in dcop.constraints.values()
+        if len(set(res.assignment[v] for v in c.scope_names)) == 1)
+    assert conflicts == 0

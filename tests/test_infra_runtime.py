@@ -168,3 +168,50 @@ def test_run_dcop_scenario_agent_removal():
                       scenario=scenario, max_cycles=100000)
     # the solve must still produce a full assignment
     assert set(result.assignment) == {"v1", "v2", "v3"}
+
+
+def test_dsatuto_message_passing_on_agents():
+    """The tutorial algorithm's message-passing backend runs for real on
+    the agent fabric: one computation per variable, synchronous rounds
+    via the cycle mixin, in-process queues (reference: dsatuto + the
+    algorithm-implementation tutorial)."""
+    from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+    from pydcop_tpu.algorithms.dsatuto import build_computation
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.constraints_hypergraph import \
+        build_computation_graph
+
+    dcop = load_dcop(GC3)
+    cg = build_computation_graph(dcop)
+    algo = AlgorithmDef.build_with_default_param(
+        "dsatuto", {"stop_cycle": 40})
+    agents = []
+    comps = []
+    try:
+        for node in cg.nodes:
+            a = Agent(f"ag_{node.name}", InProcessCommunicationLayer())
+            comp = build_computation(ComputationDef(node, algo))
+            a.add_computation(comp, publish=False)
+            agents.append(a)
+            comps.append(comp)
+        # full-mesh discovery wiring (no directory in this unit test)
+        for a in agents:
+            for b in agents:
+                if a is not b:
+                    a.discovery.register_agent(b.name, b.address,
+                                               publish=False)
+                    for c in b.computations():
+                        a.discovery.register_computation(
+                            c.name, b.name, publish=False)
+        for a in agents:
+            a.start()
+        for c in comps:
+            c.start()
+        assert _wait(
+            lambda: all(c.cycle_count >= 40 for c in comps), timeout=15)
+        values = {c.name: c.current_value for c in comps}
+        assert values in VALID_GC3 or (
+            values["v1"] != values["v2"] and values["v2"] != values["v3"])
+    finally:
+        for a in agents:
+            a.clean_shutdown(1)
