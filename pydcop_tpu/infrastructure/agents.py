@@ -184,6 +184,7 @@ class Agent:
         event_bus.send(f"agents.add_computation.{self._name}", name)
 
     def remove_computation(self, name: str):
+        self._pending_start.pop(name, None)
         comp = self._computations.pop(name, None)
         if comp is None:
             raise AgentException(f"No computation {name} on {self._name}")
@@ -285,11 +286,18 @@ class Agent:
             return
         if not comp.is_running and not comp.is_paused:
             # control computations accept messages without a start;
-            # algorithm computations get theirs parked until started
+            # not-yet-started algorithm computations get theirs parked
+            # until started; stragglers for *stopped* computations are
+            # dropped (parking them would leak and could replay a stale
+            # cycle into a restarted computation)
             if dest.startswith("_"):
                 comp.on_message(cm.src_comp, cm.msg, time.perf_counter())
-            else:
+            elif not comp._has_run:
                 self._pending_start.setdefault(dest, []).append(cm)
+            else:
+                self.logger.debug(
+                    "Dropping straggler for stopped computation %s",
+                    dest)
             return
         event_bus.send(
             f"computations.message_rcv.{dest}",

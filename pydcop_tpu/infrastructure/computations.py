@@ -182,6 +182,7 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         self._msg_sender: Optional[Callable] = None
         self._periodic_action_handler = None
         self._running = False
+        self._has_run = False
         self._is_paused = False
         self._paused_messages_post: List[Tuple] = []
         self._paused_messages_recv: List[Tuple] = []
@@ -223,6 +224,7 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         # two threads at once
         self.on_start()
         self._running = True
+        self._has_run = True
 
     def stop(self):
         self.on_stop()

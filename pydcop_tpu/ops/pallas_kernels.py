@@ -1,0 +1,96 @@
+"""Pallas TPU kernels for the hot ops.
+
+The MaxSum binary-factor update is the framework's hottest op (one per
+cycle over every factor).  In lane-major layout — factors in the
+128-wide lane dimension, the small domain axis in sublanes — both
+outgoing min-marginal messages fuse into ONE kernel: per-cycle cost on
+the benched chip is dominated by the number of separate kernels, not
+FLOPs (see benchmarks/PERF_NOTES.md), so fusing the broadcast-add +
+two axis-mins + subtraction chain into a single pallas_call removes
+several kernel launches per cycle.
+
+Layout contract (lane-major):
+  cubesT: (D, D, F)   cost tables, factor axis last (lanes)
+  q0,q1:  (D, F)      incoming var->factor messages per endpoint
+  m0,m1:  (D, F)      outgoing factor->var min-marginals
+
+  m0[d0, f] = min_d1 (cubesT[d0, d1, f] + q1[d1, f])
+  m1[d1, f] = min_d0 (cubesT[d0, d1, f] + q0[d0, f])
+
+The domain axis D is small and static, so the kernel unrolls D*D fused
+vector ops over (BLK,) lanes — pure VPU work with perfect tiling.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BLK_F = 512  # factors per grid step (multiple of the 128-lane tile)
+
+
+def _binary_kernel(cube_ref, q0_ref, q1_ref, m0_ref, m1_ref):
+    D = q0_ref.shape[0]
+    for d0 in range(D):
+        acc = None
+        for d1 in range(D):
+            v = cube_ref[d0, d1, :] + q1_ref[d1, :]
+            acc = v if acc is None else jnp.minimum(acc, v)
+        m0_ref[d0, :] = acc
+    for d1 in range(D):
+        acc = None
+        for d0 in range(D):
+            v = cube_ref[d0, d1, :] + q0_ref[d0, :]
+            acc = v if acc is None else jnp.minimum(acc, v)
+        m1_ref[d1, :] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def factor_messages_binary_lane_major(cubesT, q0, q1, interpret=False):
+    """Fused binary-factor min-marginals, lane-major (see module doc).
+
+    Pads F up to a BLK_F multiple; the padded tail reads zeros and its
+    outputs are sliced away.
+    """
+    from jax.experimental import pallas as pl
+
+    D, _, F = cubesT.shape
+    F_pad = ((F + BLK_F - 1) // BLK_F) * BLK_F
+    if F_pad != F:
+        cubesT = jnp.pad(cubesT, ((0, 0), (0, 0), (0, F_pad - F)))
+        q0 = jnp.pad(q0, ((0, 0), (0, F_pad - F)))
+        q1 = jnp.pad(q1, ((0, 0), (0, F_pad - F)))
+    grid = (F_pad // BLK_F,)
+    m0, m1 = pl.pallas_call(
+        _binary_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((D, D, BLK_F), lambda i: (0, 0, i)),
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i)),
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i)),
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((D, F_pad), cubesT.dtype),
+            jax.ShapeDtypeStruct((D, F_pad), cubesT.dtype),
+        ],
+        interpret=interpret,
+    )(cubesT, q0, q1)
+    return m0[:, :F], m1[:, :F]
+
+
+def factor_messages_binary_lane_major_ref(cubesT, q0, q1):
+    """jnp reference implementation (and the non-TPU fallback)."""
+    m0 = jnp.min(cubesT + q1[None, :, :], axis=1)
+    m1 = jnp.min(cubesT + q0[:, None, :], axis=0)
+    return m0, m1
+
+
+def default_backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "cpu"
