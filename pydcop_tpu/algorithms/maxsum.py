@@ -192,6 +192,11 @@ class MaxSumSolver(ArraySolver):
         selection = masked_argmin(belief, self.domain_mask)
         delta = jnp.max(jnp.where(edge_mask, jnp.abs(q_new - q), 0.0)) \
             if self.E else jnp.float32(0)
+        return self._advance(s, key, q_new, new_r, selection, delta)
+
+    def _advance(self, s, key, q_new, new_r, selection, delta):
+        """Shared convergence bookkeeping (SAME_COUNT stable cycles,
+        stop_cycle cap) — one copy for every state layout."""
         stable = jnp.logical_and(
             jnp.all(selection == s["selection"]), delta < self.stability
         )
@@ -345,20 +350,7 @@ class MaxSumLaneSolver(MaxSumSolver):
         selection = self._select(belief)
         delta = jnp.max(jnp.where(self.emaskT, jnp.abs(q_new - q), 0.0)) \
             if self.E else jnp.float32(0)
-        stable = jnp.logical_and(
-            jnp.all(selection == s["selection"]), delta < self.stability
-        )
-        same = jnp.where(stable, s["same"] + 1, 0)
-        cycle = s["cycle"] + 1
-        finished = same >= SAME_COUNT
-        if self.stop_cycle:
-            finished = jnp.logical_or(finished, cycle >= self.stop_cycle)
-        out = dict(s)
-        out.update(
-            cycle=cycle, finished=finished, key=key,
-            q=q_new, r=new_r, selection=selection, same=same,
-        )
-        return out
+        return self._advance(s, key, q_new, new_r, selection, delta)
 
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,

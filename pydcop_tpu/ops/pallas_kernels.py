@@ -87,10 +87,3 @@ def factor_messages_binary_lane_major_ref(cubesT, q0, q1):
     m0 = jnp.min(cubesT + q1[None, :, :], axis=1)
     m1 = jnp.min(cubesT + q0[:, None, :], axis=0)
     return m0, m1
-
-
-def default_backend() -> str:
-    try:
-        return jax.default_backend()
-    except Exception:  # pragma: no cover
-        return "cpu"

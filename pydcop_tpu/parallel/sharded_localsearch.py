@@ -1,0 +1,184 @@
+"""Multi-chip local search: dp x tp sharded DSA over a jax.sharding.Mesh.
+
+Companion of :mod:`sharded_maxsum` for the local-search family
+(SURVEY.md §2.8): constraints are partitioned across the ``tp`` axis;
+each device computes its shard's contribution to the per-variable
+candidate-cost matrix, a ``psum`` over ``tp`` assembles the full
+``(V, D)`` matrix (the collective rides ICI), and the DSA-B decision —
+move to the best value with probability p when it improves — runs
+replicated per device on the small reduced state.  ``dp`` shards
+independent problem instances.
+
+This is the scale-out story for the 10k-agent grid configs
+(BASELINE.md #4): the expensive part (constraint-slice enumeration,
+O(C * D * arity)) is tp-sharded; the per-variable decision is O(V * D).
+"""
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..graphs.arrays import BIG, HypergraphArrays
+from ..ops.kernels import bucket_cost, candidate_costs
+
+
+def _partition_constraints(arrays: HypergraphArrays, tp: int):
+    """Round-robin each bucket's constraints over tp shards, padding
+    with inert (all-BIG... actually all-zero) dummy constraints that
+    point at a sink variable row so shapes stay identical per shard."""
+    D = arrays.max_domain
+    V = arrays.n_vars
+    out = []
+    for b in arrays.buckets:
+        a = b.arity
+        n = b.cubes.shape[0]
+        groups = [list(range(g, n, tp)) for g in range(tp)]
+        fmax = max(len(g) for g in groups) if groups else 0
+        # dummy constraints contribute 0 to the sink row only
+        cubes = np.zeros((tp, fmax) + (D,) * a, dtype=np.float32)
+        var_ids = np.full((tp, fmax, a), V, dtype=np.int32)
+        for g in range(tp):
+            for slot, ci in enumerate(groups[g]):
+                cubes[g, slot] = b.cubes[ci]
+                var_ids[g, slot] = b.var_ids[ci]
+        out.append((a, cubes, var_ids))
+    return out
+
+
+class ShardedDsa:
+    """DSA-B over a (dp, tp) mesh; ``batch`` independent instances."""
+
+    def __init__(self, arrays: HypergraphArrays, mesh,
+                 probability: float = 0.7, batch: int = 1):
+        self.mesh = mesh
+        self.tp = mesh.shape["tp"]
+        self.dp = mesh.shape["dp"]
+        if batch % self.dp != 0:
+            raise ValueError(
+                f"batch {batch} must be a multiple of dp={self.dp}")
+        self.B = batch
+        self.V = arrays.n_vars
+        self.D = arrays.max_domain
+        self.probability = float(probability)
+        self.sharded_buckets = _partition_constraints(arrays, self.tp)
+        # sink row for dummy constraints
+        self.var_costs = np.concatenate(
+            [arrays.var_costs,
+             np.zeros((1, self.D), dtype=np.float32)])
+        self.domain_mask = np.concatenate(
+            [arrays.domain_mask, np.ones((1, self.D), dtype=bool)])
+        self.domain_size = np.concatenate(
+            [arrays.domain_size, np.full((1,), self.D, np.int32)])
+        self._build_step()
+
+    def _build_step(self):
+        V, D = self.V, self.D
+        prob = self.probability
+        arities = [a for a, _, _ in self.sharded_buckets]
+
+        def local_step(x, key, cubes, var_ids, var_costs, domain_mask):
+            # x: (B_loc, V+1) current value indices (incl. sink)
+            def one(x1, k1):
+                # shard-local constraint contributions; unary costs are
+                # added AFTER the psum (they are replicated — adding
+                # them per shard would count them tp times)
+                cand = jnp.zeros_like(var_costs)  # (V+1, D)
+                violated = jnp.zeros((V + 1,), dtype=jnp.int32)
+                for a, cu, vi in zip(arities, cubes, var_ids):
+                    cand = cand + candidate_costs(cu, vi, x1, V + 1)
+                    ccost = bucket_cost(cu, vi, x1)
+                    # per-constraint optimum from the shard-local cubes
+                    # (dummy all-zero constraints: optimum == cost == 0,
+                    # so they never read as violated)
+                    opt = jnp.min(cu.reshape(cu.shape[0], -1), axis=-1)
+                    viol = (ccost > opt + 1e-6).astype(jnp.int32)
+                    for p in range(a):
+                        violated = violated.at[vi[:, p]].add(viol)
+                cand = jax.lax.psum(cand, "tp")
+                violated = jax.lax.psum(violated, "tp") > 0
+                cand = cand + var_costs
+                cand = jnp.where(domain_mask, cand, BIG * 2)
+                best = jnp.argmin(cand, axis=-1)          # (V+1,)
+                cur_cost = jnp.take_along_axis(
+                    cand, x1[:, None], axis=-1)[:, 0]
+                best_cost = jnp.min(cand, axis=-1)
+                k_move = jax.random.fold_in(k1, 0)
+                # DSA-B (reference dsa.py variants): move on strict
+                # improvement, or on an equal-cost tie when an incident
+                # constraint is violated (plateau escape)
+                improve = best_cost < cur_cost
+                sideways = (best_cost == cur_cost) & violated & \
+                    (best != x1)
+                move = (improve | sideways) & (
+                    jax.random.uniform(k_move, (V + 1,)) < prob)
+                return jnp.where(move, best, x1)
+
+            # per-instance keys must differ across dp shards too
+            dp_idx = jax.lax.axis_index("dp")
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(key, dp_idx), i))(
+                jnp.arange(x.shape[0]))
+            return jax.vmap(one)(x, keys)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(
+                P("dp"), P(),
+                [P("tp") for _ in self.sharded_buckets],
+                [P("tp") for _ in self.sharded_buckets],
+                P(), P(),
+            ),
+            out_specs=P("dp"),
+        )
+        def sharded(x, key, cubes, var_ids, var_costs, domain_mask):
+            cubes_l = [c[0] for c in cubes]
+            vids_l = [v[0] for v in var_ids]
+            return local_step(x, key, cubes_l, vids_l, var_costs,
+                              domain_mask)
+
+        self._step = jax.jit(sharded)
+
+    def _device_put(self, seed: int):
+        mesh = self.mesh
+        rng = np.random.default_rng(seed)
+        x0 = rng.integers(
+            0, np.maximum(self.domain_size, 1),
+            size=(self.B, self.V + 1)).astype(np.int32)
+        x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
+        consts = (
+            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+             for _, c, _ in self.sharded_buckets],
+            [jax.device_put(v, NamedSharding(mesh, P("tp")))
+             for _, _, v in self.sharded_buckets],
+            jax.device_put(jnp.asarray(self.var_costs),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.domain_mask),
+                           NamedSharding(mesh, P())),
+        )
+        return x, consts
+
+    def run(self, n_cycles: int, seed: int = 0
+            ) -> Tuple[np.ndarray, int]:
+        """Returns ((B, V) selections, cycles run)."""
+        x, (cubes, var_ids, var_costs, domain_mask) = \
+            self._device_put(seed)
+        key = jax.random.PRNGKey(seed)
+        for cycle in range(n_cycles):
+            key, sub = jax.random.split(key)
+            x = self._step(x, sub, cubes, var_ids, var_costs,
+                           domain_mask)
+        sel = np.asarray(jax.device_get(x))[:, :self.V]
+        return sel, n_cycles
+
+    def step_once(self, seed: int = 0) -> np.ndarray:
+        x, (cubes, var_ids, var_costs, domain_mask) = \
+            self._device_put(seed)
+        key = jax.random.PRNGKey(seed)
+        x = self._step(x, key, cubes, var_ids, var_costs, domain_mask)
+        jax.block_until_ready(x)
+        return np.asarray(jax.device_get(x))[:, :self.V]
