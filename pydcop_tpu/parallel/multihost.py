@@ -1,0 +1,70 @@
+"""Multi-host bootstrap: the DCN side of the distributed backend.
+
+reference parity: the reference scales to machines with
+``pydcop orchestrator`` + ``pydcop agent`` over HTTP
+(SURVEY.md §2.8 #3).  This framework keeps that control plane (it works
+across hosts unchanged — agents POST JSON to the orchestrator's
+address) and adds the *data plane* story: multi-controller JAX over
+DCN, where every host runs the same program and the global device mesh
+spans all hosts' chips.
+
+Typical pod usage::
+
+    from pydcop_tpu.parallel.multihost import initialize_multihost, \
+        global_mesh
+
+    initialize_multihost()            # jax.distributed.initialize()
+    mesh = global_mesh(dp=..., tp=...)
+    solver = ShardedMaxSum(arrays, mesh, batch=...)
+
+On TPU pods ``jax.distributed.initialize`` picks up the coordinator
+from the environment; on CPU/GPU clusters pass coordinator_address /
+num_processes / process_id explicitly.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Initialize multi-controller JAX; returns True when running
+    multi-process (False for a single-process run, which needs no
+    initialization)."""
+    import jax
+
+    if num_processes in (None, 1) and coordinator_address is None:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            # single-host run (no coordinator in the environment)
+            return False
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    return jax.process_count() > 1
+
+
+def global_mesh(dp: Optional[int] = None, tp: Optional[int] = None,
+                axis_names: Tuple[str, str] = ("dp", "tp")):
+    """A (dp, tp) mesh over ALL hosts' devices.
+
+    Defaults: tp = devices per host (so tensor-parallel collectives ride
+    ICI within a host/slice), dp = the rest (instance parallelism over
+    DCN, which only synchronizes at chunk boundaries).
+    """
+    import jax
+
+    devices = np.array(jax.devices())
+    n = devices.size
+    if tp is None:
+        tp = max(1, jax.local_device_count())
+    if dp is None:
+        dp = n // tp
+    if dp * tp != n:
+        raise ValueError(
+            f"dp*tp = {dp}*{tp} != {n} global devices")
+    return jax.sharding.Mesh(devices.reshape(dp, tp), axis_names)
