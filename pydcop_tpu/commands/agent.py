@@ -19,7 +19,12 @@ def set_parser(subparsers):
     parser.add_argument("-p", "--port", type=int, default=9001,
                         help="base port; agent i listens on port+i")
     parser.add_argument("--address", default="127.0.0.1",
-                        help="local address agents bind to")
+                        help="address advertised to peers (and bound, "
+                             "unless --bind_address is given)")
+    parser.add_argument("--bind_address", default=None,
+                        help="address to bind the HTTP server to when it "
+                             "differs from --address (NAT / container "
+                             "port mapping, e.g. 0.0.0.0)")
     parser.add_argument("-o", "--orchestrator", required=True,
                         help="orchestrator ip:port")
     parser.add_argument("--uiport", type=int, default=None,
@@ -39,7 +44,9 @@ def _start_agents(args, orchestrator_address):
 
     agents = []
     for i, name in enumerate(args.names):
-        comm = HttpCommunicationLayer((args.address, args.port + i))
+        comm = HttpCommunicationLayer(
+            (args.address, args.port + i),
+            bind_host=getattr(args, "bind_address", None))
         ui_port = args.uiport + i if args.uiport else None
         agent = OrchestratedAgent(
             name, comm, orchestrator_address,

@@ -22,7 +22,13 @@ def set_parser(subparsers):
                         default=None)
     parser.add_argument("-d", "--distribution", default="oneagent")
     parser.add_argument("--port", type=int, default=9000)
-    parser.add_argument("--address", default="127.0.0.1")
+    parser.add_argument("--address", default="127.0.0.1",
+                        help="address advertised to agents (and bound, "
+                             "unless --bind_address is given)")
+    parser.add_argument("--bind_address", default=None,
+                        help="address to bind the HTTP server to when it "
+                             "differs from --address (NAT / container "
+                             "port mapping, e.g. 0.0.0.0)")
     parser.add_argument("-s", "--scenario", default=None)
     parser.add_argument("-k", "--ktarget", type=int, default=None)
     parser.add_argument("--deploy_timeout", type=float, default=60,
@@ -46,7 +52,9 @@ def run_cmd(args, timeout=None):
                                       args.distribution)
     scenario = (load_scenario_from_file(args.scenario)
                 if args.scenario else None)
-    comm = HttpCommunicationLayer((args.address, args.port))
+    comm = HttpCommunicationLayer(
+        (args.address, args.port),
+        bind_host=getattr(args, "bind_address", None))
     orchestrator = Orchestrator(algo_def, cg, dist, comm, dcop=dcop)
     orchestrator.start()
     try:

@@ -37,6 +37,10 @@ MSG_MGT = 10
 MSG_VALUE = 15
 MSG_ALGO = 20
 
+# name of the directory computation (duplicated from discovery.py, which
+# imports this module)
+DIRECTORY_COMP_NAME = "_directory"
+
 
 class CommunicationException(Exception):
     pass
@@ -169,10 +173,18 @@ class HttpCommunicationLayer(CommunicationLayer):
     (reference: communication.py:313-499)."""
 
     def __init__(self, address: Optional[Tuple[str, int]] = None,
-                 timeout: float = 0.5):
+                 timeout: float = 0.5,
+                 bind_host: Optional[str] = None):
+        """``address`` is the host:port peers dial (advertised through
+        discovery).  By default the server binds that same host — not
+        0.0.0.0, which would expose the unauthenticated control plane to
+        any network peer.  Deployments where the advertised address is not
+        locally bindable (NAT, container port mapping) must pass an
+        explicit ``bind_host`` (e.g. ``"0.0.0.0"``)."""
         super().__init__()
         host, port = address if address else ("127.0.0.1", 9000)
         self._address = Address(host, port)
+        self._bind_host = bind_host if bind_host is not None else host
         self._timeout = timeout
         self._server: Optional[HTTPServer] = None
         self._server_thread: Optional[threading.Thread] = None
@@ -192,8 +204,17 @@ class HttpCommunicationLayer(CommunicationLayer):
                 raw = self.rfile.read(length)
                 try:
                     content = json.loads(raw.decode("utf-8"))
-                    msg = from_repr(content)
-                except Exception:  # malformed payload: report 500
+                    # network payloads may only instantiate framework
+                    # classes (messages, envelopes, ComputationDefs, …):
+                    # an unrestricted from_repr would let any peer trigger
+                    # arbitrary imports + constructor calls
+                    msg = from_repr(
+                        content, allowed_prefixes=("pydcop_tpu.",))
+                except Exception as e:  # malformed/rejected: report 500
+                    logger.warning(
+                        "Rejected message from %s to %s: %s",
+                        self.headers.get("sender-agent"),
+                        self.headers.get("dest-agent"), e)
                     self.send_response(500)
                     self.end_headers()
                     return
@@ -212,7 +233,8 @@ class HttpCommunicationLayer(CommunicationLayer):
         last_err = None
         for _ in range(3):
             try:
-                self._server = HTTPServer(("0.0.0.0", port), _Handler)
+                self._server = HTTPServer((self._bind_host, port),
+                                          _Handler)
                 break
             except OSError as e:
                 last_err = e
@@ -245,8 +267,16 @@ class HttpCommunicationLayer(CommunicationLayer):
         retries = 3 if on_error == "retry" else 1
         for attempt in range(retries):
             try:
-                requests.post(url, json=simple_repr(msg), headers=headers,
-                              timeout=self._timeout)
+                resp = requests.post(url, json=simple_repr(msg),
+                                     headers=headers,
+                                     timeout=self._timeout)
+                if resp.status_code != 200:
+                    # the receiver rejected the payload (e.g. the
+                    # deserialization allowlist): that's a delivery
+                    # failure, not a success
+                    raise CommunicationException(
+                        f"Receiver {dest_agent} rejected message "
+                        f"({resp.status_code}): {msg}")
                 return True
             except Exception as e:
                 if attempt == retries - 1:
@@ -339,20 +369,7 @@ class Messaging:
             with self._lock:
                 self._waiting.setdefault(dest_comp, []).append(
                     (src_comp, dest_comp, msg, prio, on_error))
-            try:
-                if dest_comp == "_directory":
-                    # a directory subscription would itself be a message
-                    # to the directory: local callback only, else the
-                    # parking recurses forever
-                    discovery.subscribe_computation_local(
-                        dest_comp, self._on_computation_registered,
-                        one_shot=True)
-                else:
-                    discovery.subscribe_computation(
-                        dest_comp, self._on_computation_registered,
-                        one_shot=True)
-            except Exception:
-                pass
+            self._subscribe_for_parked(dest_comp)
             return
         if dest_agent == self._agent_name:
             self._enqueue(ComputationMessage(src_comp, dest_comp, msg,
@@ -385,8 +402,35 @@ class Messaging:
         self.msg_queue_count += 1
         self._queue.put((cm.prio, seq, cm))
 
+    def _subscribe_for_parked(self, computation: str):
+        """One-shot subscription that retries the parked messages for
+        ``computation`` when it registers."""
+        try:
+            if computation == DIRECTORY_COMP_NAME:
+                # a directory subscription would itself be a message to
+                # the directory: local callback only, else the parking
+                # recurses forever
+                self.discovery.subscribe_computation_local(
+                    computation, self._on_computation_registered,
+                    one_shot=True)
+            else:
+                self.discovery.subscribe_computation(
+                    computation, self._on_computation_registered,
+                    one_shot=True)
+        except Exception:
+            pass
+
     def _on_computation_registered(self, evt: str, computation: str,
                                    agent: str):
+        if evt != "computation_added":
+            # a removal publication also consumes the one-shot
+            # subscription: re-arm it, the parked messages still wait for
+            # the computation to (re)appear
+            with self._lock:
+                waiting = bool(self._waiting.get(computation))
+            if waiting:
+                self._subscribe_for_parked(computation)
+            return
         with self._lock:
             parked = self._waiting.pop(computation, [])
         for src, dest, msg, prio, on_error in parked:

@@ -17,13 +17,14 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from .communication import MSG_DISCOVERY, UnknownAgent, UnknownComputation
+from .communication import DIRECTORY_COMP_NAME, MSG_DISCOVERY, \
+    UnknownAgent, UnknownComputation
 from .computations import Message, MessagePassingComputation, \
     message_type, register
 
 logger = logging.getLogger("pydcop_tpu.infrastructure.discovery")
 
-DIRECTORY_COMP = "_directory"
+DIRECTORY_COMP = DIRECTORY_COMP_NAME
 
 
 class DiscoveryException(Exception):
@@ -211,11 +212,13 @@ class _DiscoveryComputation(MessagePassingComputation):
                                           publish=False)
         else:
             try:
+                # unregister_agent fires 'agent_removed' itself: removal
+                # events must fire exactly once per publication
                 self.discovery.unregister_agent(msg.agent, publish=False)
             except UnknownAgent:
-                pass
-        if msg.event == "agent_removed":
-            self.discovery._fire_agent(msg.event, msg.agent, msg.address)
+                # agent unknown locally: subscribers still expect the event
+                self.discovery._fire_agent(msg.event, msg.agent,
+                                           msg.address)
 
     @register("publish_computation")
     def _on_publish_computation(self, sender, msg, t):
@@ -227,13 +230,15 @@ class _DiscoveryComputation(MessagePassingComputation):
                 msg.computation, msg.agent, publish=False)
         else:
             try:
+                # unregister_computation fires 'computation_removed'
+                # itself — except for *stale* removals (the computation
+                # has since re-registered on another agent), which must
+                # not fire a false removal event
                 self.discovery.unregister_computation(
                     msg.computation, msg.agent, publish=False)
             except UnknownComputation:
-                pass
-        if msg.event == "computation_removed":
-            self.discovery._fire_computation(msg.event, msg.computation,
-                                             msg.agent)
+                self.discovery._fire_computation(
+                    msg.event, msg.computation, msg.agent)
 
     @register("publish_replica")
     def _on_publish_replica(self, sender, msg, t):

@@ -65,18 +65,25 @@ def build_repair_dcop(repair_info: Dict) -> "DCOP":
         dcop.add_constraint(NAryFunctionRelation(
             one_host, vs, name=f"one_host_{comp}"))
 
-    # capacity per candidate agent (reference: agents.py:1200-1246)
+    # capacity per candidate agent, footprint-weighted
+    # (reference: agents.py:1200-1246)
+    footprints = repair_info.get("footprints", {})
     by_candidate: Dict[str, List] = {}
     for comp, by_agent in variables.items():
         for agent, v in by_agent.items():
-            by_candidate.setdefault(agent, []).append(v)
-    for agent, vs in by_candidate.items():
+            by_candidate.setdefault(agent, []).append(
+                (v, float(footprints.get(comp, 1.0))))
+    for agent, pairs in by_candidate.items():
         cap = repair_info["capacity"].get(agent, float("inf"))
-        if cap == float("inf") or len(vs) <= 1:
+        vs = [v for v, _ in pairs]
+        fps = tuple(fp for _, fp in pairs)
+        # the constraint can only bind when activating all candidates
+        # would exceed the (remaining) capacity — note cap may be 0
+        if cap == float("inf") or sum(fps) <= cap:
             continue
 
-        def within_cap(*vals, _cap=cap):
-            extra = sum(vals) - _cap
+        def within_cap(*vals, _cap=cap, _fps=fps):
+            extra = sum(f * v for f, v in zip(_fps, vals)) - _cap
             return _CAPACITY_PENALTY * extra if extra > 0 else 0.0
 
         dcop.add_constraint(NAryFunctionRelation(
@@ -95,10 +102,17 @@ def solve_repair(repair_info: Dict, seed: int = 0) -> Dict[str, str]:
     dcop = build_repair_dcop(repair_info)
     if not dcop.variables:
         return {}
+    import jax
+
     from ..infrastructure.run import solve_result
 
-    res = solve_result(dcop, "mgm", timeout=10, max_cycles=100, seed=seed,
-                       stop_cycle=50)
+    # every candidate agent must reach the *same* assignment: no
+    # wall-clock timeout (stop_cycle is the only, deterministic, stop
+    # condition) and a forced CPU backend so float behavior cannot differ
+    # between hosts with different accelerators
+    with jax.default_device(jax.devices("cpu")[0]):
+        res = solve_result(dcop, "mgm", timeout=None, max_cycles=50,
+                           seed=seed, stop_cycle=50)
     placement: Dict[str, str] = {}
     for comp, agents in repair_info["candidates"].items():
         chosen = [a for a in agents

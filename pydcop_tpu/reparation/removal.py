@@ -31,7 +31,8 @@ def candidate_agents(orphaned: Iterable[str], discovery,
 
 
 def build_repair_info(departed: Iterable[str], discovery,
-                      agent_defs: Dict[str, object] = None
+                      agent_defs: Dict[str, object] = None,
+                      footprints: Dict[str, float] = None
                       ) -> Dict[str, object]:
     """Assemble the data each candidate needs to set up the repair DCOP
     (reference: removal.py:101-167 + agents.py:1047-1258).
@@ -39,11 +40,18 @@ def build_repair_info(departed: Iterable[str], discovery,
     The info is *global and deterministic*: every candidate receives the
     same dict, so each can solve the same repair DCOP with the same seed
     and read off its own wins without further coordination.
+
+    ``capacity`` entries are *remaining* capacity: the AgentDef capacity
+    minus the footprint of the computations the candidate already hosts
+    (as ``_free_capacity`` in the replication protocol computes) —
+    otherwise repair could overload an agent already at capacity.
+    ``footprints`` maps computation name -> footprint (default 1.0).
     """
     departed = sorted(set(departed))
     orphaned = orphaned_computations(departed, discovery)
     candidates = candidate_agents(orphaned, discovery, departed)
     agent_defs = agent_defs or {}
+    footprints = footprints or {}
     hosting: Dict[str, Dict[str, float]] = {}
     capacity: Dict[str, float] = {}
     all_candidates = sorted({a for agts in candidates.values()
@@ -53,13 +61,20 @@ def build_repair_info(departed: Iterable[str], discovery,
         hosting[agent] = {
             comp: (adef.hosting_cost(comp) if adef is not None else 0.0)
             for comp in orphaned}
-        capacity[agent] = (
-            float(adef.capacity) if adef is not None and
-            adef.capacity is not None else float("inf"))
+        if adef is not None and adef.capacity is not None:
+            used = sum(footprints.get(c, 1.0)
+                       for c in discovery.agent_computations(agent))
+            capacity[agent] = max(0.0, float(adef.capacity) - used)
+        else:
+            capacity[agent] = float("inf")
     return {
         "departed": departed,
         "orphaned": orphaned,
         "candidates": {c: sorted(a) for c, a in candidates.items()},
         "hosting_costs": hosting,
         "capacity": capacity,
+        # per-orphan footprints, so capacity constraints weigh each
+        # activation by its real size rather than counting 1 per orphan
+        "footprints": {c: float(footprints.get(c, 1.0))
+                       for c in orphaned},
     }

@@ -39,6 +39,32 @@ _SAFE_BUILTINS = {
 }
 
 
+def validate_untrusted_expression(expression: str):
+    """Reject expression constructs that escape the sandbox.
+
+    Empty ``__builtins__`` alone is not enough: the object graph is
+    reachable through dunder attributes (``().__class__.__base__...``).
+    Expressions arriving from the network are therefore restricted to a
+    safe AST subset: no imports and no underscore-prefixed attribute or
+    name access.  Raises ``ValueError`` on violation.
+    """
+    mode = ("exec" if "\n" in expression.strip()
+            or expression.strip().startswith("return") else "eval")
+    tree = ast.parse(expression, mode=mode)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            raise ValueError("imports are not allowed in expressions "
+                             "from untrusted input")
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise ValueError(
+                f"underscore attribute access ({node.attr!r}) is not "
+                "allowed in expressions from untrusted input")
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise ValueError(
+                f"dunder name ({node.id!r}) is not allowed in "
+                "expressions from untrusted input")
+
+
 def _free_variables(expression: str):
     """Names that appear as loads in ``expression`` and are not builtins,
     ordered by first appearance (scope order must be deterministic — it
@@ -102,8 +128,14 @@ class ExpressionFunction(SimpleRepr):
             ]
             body = "\n".join("    " + line for line in expression.splitlines())
             fn_src = f"def __expr_fn__({', '.join(args)}):\n{body}"
-            exec(compile(fn_src, "<expression>", "exec"), self._globals)
-            self._fn = self._globals["__expr_fn__"]
+            # expressions only get the safe builtins + helper names, never
+            # the real builtins (exec would inject them into a dict that
+            # lacks '__builtins__', handing __import__/open to expressions
+            # that may have crossed the network)
+            fn_globals = dict(self._globals)
+            fn_globals["__builtins__"] = {}
+            exec(compile(fn_src, "<expression>", "exec"), fn_globals)
+            self._fn = fn_globals["__expr_fn__"]
             self._fn_args = args
             self._vars = tuple(n for n in args if n not in fixed_vars)
             self._code = None
@@ -191,5 +223,20 @@ class ExpressionFunction(SimpleRepr):
 
     @classmethod
     def _from_repr(cls, expression, source_file=None, fixed_vars=None, **kw):
+        from .simple_repr import SimpleReprException, \
+            in_untrusted_deserialization
+
+        if in_untrusted_deserialization():
+            if source_file:
+                # a source_file expression open()+exec()s a local file at
+                # construction time: never allowed from network payloads
+                raise SimpleReprException(
+                    "source_file expressions cannot be deserialized from "
+                    "untrusted input")
+            try:
+                validate_untrusted_expression(expression)
+            except (ValueError, SyntaxError) as e:
+                raise SimpleReprException(
+                    f"unsafe expression in untrusted input: {e}")
         fixed_vars = fixed_vars or {}
         return cls(expression, source_file, **fixed_vars)

@@ -14,11 +14,22 @@ class can remap an argument to a differently-named attribute with
 ``_repr_mapping``.
 """
 
+import contextvars
 from importlib import import_module
 from typing import Any
 
 SIMPLE_REPR_CLASS_KEY = "__qualname__"
 SIMPLE_REPR_MODULE_KEY = "__module__"
+
+# set while from_repr runs with an allowlist (i.e. on untrusted input);
+# _from_repr hooks with construction-time side effects must consult it
+_UNTRUSTED = contextvars.ContextVar("simple_repr_untrusted", default=False)
+
+
+def in_untrusted_deserialization() -> bool:
+    """True while deserializing a payload from an untrusted source
+    (:func:`from_repr` called with ``allowed_prefixes``)."""
+    return _UNTRUSTED.get()
 
 
 class SimpleReprException(Exception):
@@ -118,33 +129,72 @@ def simple_repr(o: Any):
     raise SimpleReprException(f"Cannot build a simple repr for {o!r}")
 
 
-def from_repr(r: Any):
-    """Rebuild an object from its simple repr."""
+def from_repr(r: Any, allowed_prefixes=None):
+    """Rebuild an object from its simple repr.
+
+    ``allowed_prefixes`` optionally restricts which modules classes may be
+    instantiated from (a tuple of module-name prefixes).  Payloads arriving
+    from the network MUST be deserialized with a restriction, otherwise any
+    peer can trigger an arbitrary import + constructor call.
+    """
     if isinstance(r, list):
-        return [from_repr(i) for i in r]
+        return [from_repr(i, allowed_prefixes) for i in r]
     if isinstance(r, dict):
         if SIMPLE_REPR_CLASS_KEY not in r:
-            return {k: from_repr(v) for k, v in r.items()}
+            return {k: from_repr(v, allowed_prefixes) for k, v in r.items()}
         qual = r[SIMPLE_REPR_CLASS_KEY]
         module = r[SIMPLE_REPR_MODULE_KEY]
         if module == "builtins" and qual == "tuple":
-            return tuple(from_repr(i) for i in r["values"])
+            return tuple(from_repr(i, allowed_prefixes)
+                         for i in r["values"])
         if module == "builtins" and qual == "set":
-            return set(from_repr(i) for i in r["values"])
+            return set(from_repr(i, allowed_prefixes) for i in r["values"])
         if module == "numpy" and qual == "ndarray":
             import numpy as np
 
             return np.array(r["values"])
+        if allowed_prefixes is not None and not any(
+                module == p.rstrip(".") or module.startswith(p)
+                for p in allowed_prefixes):
+            raise SimpleReprException(
+                f"Refusing to deserialize {module}.{qual}: module not in "
+                f"the allowlist {allowed_prefixes}")
         mod = import_module(module)
         cls = mod
         for part in qual.split("."):
             cls = getattr(cls, part)
+        if allowed_prefixes is not None:
+            # the qualname getattr chain could traverse into modules
+            # re-exported by an allowlisted module (e.g. a stdlib module
+            # imported at its top level): require the *resolved* object to
+            # be a SimpleRepr class defined in an allowlisted module.
+            # The SimpleRepr bound keeps side-effectful framework classes
+            # (comm layers, agents, servers) out of reach of payloads.
+            cls_module = getattr(cls, "__module__", "")
+            if (not isinstance(cls, type)
+                    or not issubclass(cls, SimpleRepr)
+                    or not any(
+                        cls_module == p.rstrip(".")
+                        or cls_module.startswith(p)
+                        for p in allowed_prefixes)):
+                raise SimpleReprException(
+                    f"Refusing to deserialize {module}.{qual}: not a "
+                    f"serializable framework class from the allowlist "
+                    f"{allowed_prefixes}")
         kwargs = {
-            k: from_repr(v)
+            k: from_repr(v, allowed_prefixes)
             for k, v in r.items()
             if k not in (SIMPLE_REPR_CLASS_KEY, SIMPLE_REPR_MODULE_KEY)
         }
-        if hasattr(cls, "_from_repr"):
-            return cls._from_repr(**kwargs)
-        return cls(**kwargs)
+        if allowed_prefixes is None:
+            if hasattr(cls, "_from_repr"):
+                return cls._from_repr(**kwargs)
+            return cls(**kwargs)
+        token = _UNTRUSTED.set(True)
+        try:
+            if hasattr(cls, "_from_repr"):
+                return cls._from_repr(**kwargs)
+            return cls(**kwargs)
+        finally:
+            _UNTRUSTED.reset(token)
     return r

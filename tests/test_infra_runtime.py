@@ -215,3 +215,42 @@ def test_dsatuto_message_passing_on_agents():
     finally:
         for a in agents:
             a.clean_shutdown(1)
+
+
+def test_repair_respects_remaining_capacity():
+    """Repair must not place an orphan on an agent whose *remaining*
+    capacity (footprint-weighted) cannot hold it."""
+    from pydcop_tpu.reparation import solve_repair
+
+    info = {"departed": ["a0"], "orphaned": ["X"],
+            "candidates": {"X": ["a1", "a2"]},
+            "hosting_costs": {"a1": {"X": 0.0}, "a2": {"X": 5.0}},
+            "capacity": {"a1": 0.0, "a2": 10.0},
+            "footprints": {"X": 3.0}}
+    # a1 is cheaper but full: the capacity penalty must push X to a2
+    assert solve_repair(info) == {"X": "a2"}
+
+
+def test_discovery_removal_fires_once():
+    """Removal publications must fire subscriber callbacks exactly once
+    (regression: double-fire via unregister + explicit publish fire)."""
+    from pydcop_tpu.infrastructure.discovery import Discovery, \
+        PublishAgentMessage, PublishComputationMessage
+
+    d = Discovery("agt")
+    events = []
+    d.subscribe_agent_local("a9", lambda e, n, a: events.append((e, n)))
+    d.register_agent("a9", None, publish=False)
+    d.discovery_computation._on_publish_agent(
+        "_directory", PublishAgentMessage("agent_removed", "a9", None), 0)
+    assert events.count(("agent_removed", "a9")) == 1
+
+    comp_events = []
+    d.subscribe_computation_local(
+        "c9", lambda e, n, a: comp_events.append((e, n)))
+    d.register_computation("c9", "agt", publish=False)
+    d.discovery_computation._on_publish_computation(
+        "_directory",
+        PublishComputationMessage("computation_removed", "c9", "agt",
+                                  None), 0)
+    assert comp_events.count(("computation_removed", "c9")) == 1

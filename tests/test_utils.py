@@ -86,3 +86,70 @@ def test_simple_repr_tuple_set():
 def test_simple_repr_unsupported():
     with pytest.raises(SimpleReprException):
         simple_repr(object())
+
+
+# ------------------------------------------------------- untrusted payloads
+# Network payloads (HTTP control plane) are deserialized with a module
+# allowlist; these tests pin the hardening behavior.
+
+
+def test_from_repr_allowlist_blocks_foreign_module():
+    with pytest.raises(SimpleReprException):
+        from_repr(
+            {"__qualname__": "Popen", "__module__": "subprocess",
+             "args": ["true"]},
+            allowed_prefixes=("pydcop_tpu.",))
+
+
+def test_from_repr_allowlist_blocks_reexport_traversal():
+    # the qualname chain must not escape through modules re-exported by
+    # an allowlisted module (e.g. stdlib imports at its top level)
+    with pytest.raises(SimpleReprException):
+        from_repr(
+            {"__qualname__": "subprocess.Popen",
+             "__module__": "pydcop_tpu.commands.batch",
+             "args": ["true"]},
+            allowed_prefixes=("pydcop_tpu.",))
+
+
+def test_from_repr_untrusted_blocks_source_file():
+    f = ExpressionFunction("a + 1")
+    r = simple_repr(f)
+    r["source_file"] = "/etc/passwd"
+    with pytest.raises(SimpleReprException):
+        from_repr(r, allowed_prefixes=("pydcop_tpu.",))
+
+
+def test_from_repr_untrusted_blocks_sandbox_escape():
+    r = simple_repr(ExpressionFunction("a + 1"))
+    r["expression"] = (
+        "return [c for c in ().__class__.__base__.__subclasses__()][0]")
+    with pytest.raises(SimpleReprException):
+        from_repr(r, allowed_prefixes=("pydcop_tpu.",))
+
+
+def test_from_repr_untrusted_allows_normal_expressions():
+    r = simple_repr(ExpressionFunction(
+        "if v1 == v2:\n    return 10\nreturn abs(v1 - v2)"))
+    f = from_repr(r, allowed_prefixes=("pydcop_tpu.",))
+    assert f(v1=1, v2=1) == 10
+    assert f(v1=4, v2=1) == 3
+
+
+def test_multiline_expression_has_no_real_builtins():
+    f = ExpressionFunction("return __import__('os').getpid()")
+    with pytest.raises(Exception):
+        f()
+
+
+def test_from_repr_untrusted_blocks_side_effect_classes():
+    # framework classes that are not SimpleRepr (comm layers, agents…)
+    # must not be constructible from network payloads
+    with pytest.raises(SimpleReprException):
+        from_repr(
+            {"__qualname__": "HttpCommunicationLayer",
+             "__module__": "pydcop_tpu.infrastructure.communication",
+             "address": {"__qualname__": "tuple",
+                         "__module__": "builtins",
+                         "values": ["0.0.0.0", 4444]}},
+            allowed_prefixes=("pydcop_tpu.",))
