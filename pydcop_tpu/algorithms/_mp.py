@@ -1,0 +1,101 @@
+"""Shared helpers for the message-passing (agent-fabric) backends.
+
+In the reference *every* algorithm runs as message-passing computations
+deployed on agents (maxsum.py:279-676, dsa.py:265-357, mgm.py:213-420).
+In this framework the compiled engine is the data plane (one jitted step
+per synchronous round); the classes built on these helpers are the same
+algorithms' *distributed* execution path, running on the agent fabric in
+thread / process / multi-machine mode so orchestrated runs exchange real
+algorithm messages between agents, exactly like the reference.
+
+Everything here is host-side control-plane code operating on one node's
+local neighborhood — small dict/loop math, the compiled engine covers the
+large regime.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+EPS = 1e-9
+
+
+def sign_for_mode(mode: str) -> float:
+    """min problems search smaller costs, max problems larger; all search
+    logic below works in *signed* space (always minimizing)."""
+    return 1.0 if mode != "max" else -1.0
+
+
+def local_cost(variable, constraints, assignment: Dict[str, Any]) -> float:
+    """Model cost of this variable's neighborhood under ``assignment``
+    (unary variable cost + all fully-instantiated incident constraints)."""
+    cost = variable.cost_for_val(assignment[variable.name])
+    for c in constraints:
+        scope = c.scope_names
+        if all(n in assignment for n in scope):
+            cost += c(**{n: assignment[n] for n in scope})
+    return cost
+
+
+def best_response(variable, constraints, neighbor_values: Dict[str, Any],
+                  current_value, mode: str,
+                  prefer_different: bool = False,
+                  rnd=None) -> Tuple[Optional[float], Any, float]:
+    """(current_cost, best_value, best_cost) for one variable given its
+    neighbors' values (reference: dsa.py:407-466, mgm.py:213-420).
+
+    Costs are model costs (caller-facing); the search itself minimizes
+    signed cost.  With ``prefer_different`` a minimum other than the
+    current value is preferred when several exist (reference DSA
+    variant B/C move preference); ties beyond that break randomly when
+    ``rnd`` is given, else by domain order.
+    """
+    sign = sign_for_mode(mode)
+    best_vals: List[Any] = []
+    best_signed = None
+    current_signed = None
+    for value in variable.domain.values:
+        assignment = dict(neighbor_values)
+        assignment[variable.name] = value
+        signed = sign * local_cost(variable, constraints, assignment)
+        if value == current_value:
+            current_signed = signed
+        if best_signed is None or signed < best_signed - EPS:
+            best_vals, best_signed = [value], signed
+        elif signed <= best_signed + EPS:
+            best_vals.append(value)
+    if prefer_different and len(best_vals) > 1:
+        others = [v for v in best_vals if v != current_value]
+        if others:
+            best_vals = others
+    best = rnd.choice(best_vals) if rnd is not None else best_vals[0]
+    return (
+        None if current_signed is None else sign * current_signed,
+        best,
+        sign * best_signed,
+    )
+
+
+def constraint_optima(constraints: Iterable, mode: str) -> Dict[str, float]:
+    """Per-constraint best achievable *signed* cost, used by the
+    "violated constraint" test (reference: dsa.py:450-466)."""
+    sign = sign_for_mode(mode)
+    optima: Dict[str, float] = {}
+    for c in constraints:
+        m = sign * c.to_matrix().matrix
+        optima[c.name] = float(m.min())
+    return optima
+
+
+def has_violated_constraint(constraints, optima: Dict[str, float],
+                            assignment: Dict[str, Any],
+                            mode: str) -> bool:
+    """True when some fully-instantiated incident constraint is not at
+    its own optimum under ``assignment``."""
+    sign = sign_for_mode(mode)
+    for c in constraints:
+        scope = c.scope_names
+        if not all(n in assignment for n in scope):
+            continue
+        signed = sign * c(**{n: assignment[n] for n in scope})
+        if signed > optima[c.name] + 1e-6:
+            return True
+    return False

@@ -100,3 +100,102 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: DSA running ON the agent fabric
+# (reference: dsa.py:265-405).  One computation per variable, value
+# messages between hypergraph neighbors, variant A/B/C semantics as in
+# the compiled solver above.  Used by orchestrated (thread / process /
+# multi-machine) runs; the compiled solver is the data plane.
+# ---------------------------------------------------------------------
+
+import random as _random
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from ._mp import EPS, best_response, constraint_optima, \
+    has_violated_constraint, sign_for_mode
+
+DsaValueMessage = message_type("dsa_value", ["value"])
+
+
+class DsaMpComputation(SynchronousComputationMixin, VariableComputation):
+    """Synchronous DSA on the agent fabric (reference: dsa.py:265-405).
+    The reference's manual current/next-cycle barrier (dsa.py:265-357)
+    is the sync mixin here."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.variant = params.get("variant", "B")
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        if params.get("p_mode", "fixed") == "arity":
+            # per-variable threshold 1.2 / sum(arity - 1)
+            # (reference: dsa.py:256-263)
+            n = sum(len(c.dimensions) - 1 for c in self.constraints)
+            self.probability = min(1.0, 1.2 / n) if n > 0 else 1.0
+        else:
+            self.probability = float(params.get("probability", 0.7))
+        self._optima = constraint_optima(self.constraints, self.mode) \
+            if self.variant == "B" else {}
+        self._neighbor_values: Dict[str, object] = {}
+        self._rnd = _random.Random()
+
+    def on_start(self):
+        self.start_cycle()
+        self.random_value_selection()
+        self.post_to_all_neighbors(
+            DsaValueMessage(self.current_value), MSG_ALGO)
+        if not self.neighbors:
+            self.finished()
+
+    def on_fast_forward(self, cycle_id):
+        self.post_to_all_neighbors(
+            DsaValueMessage(self.current_value), MSG_ALGO)
+
+    @register("dsa_value")
+    def _on_value(self, sender, msg, t):  # pragma: no cover
+        pass  # rounds are delivered through on_new_cycle
+
+    def on_new_cycle(self, messages, cycle_id):
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        self.new_cycle()
+        cur, best_val, best_cost = best_response(
+            self.variable, self.constraints, self._neighbor_values,
+            self.current_value, self.mode,
+            prefer_different=self.variant in ("B", "C"), rnd=self._rnd)
+        sign = sign_for_mode(self.mode)
+        delta = sign * (cur - best_cost) if cur is not None else 0.0
+        improve = delta > EPS
+        if self.variant == "A":
+            want = improve
+        elif self.variant == "B":
+            assignment = dict(self._neighbor_values)
+            assignment[self.variable.name] = self.current_value
+            want = improve or (
+                abs(delta) <= EPS and best_val != self.current_value
+                and has_violated_constraint(
+                    self.constraints, self._optima, assignment,
+                    self.mode))
+        else:  # C
+            want = improve or (abs(delta) <= EPS
+                               and best_val != self.current_value)
+        if want and self._rnd.random() < self.probability:
+            self.value_selection(best_val, best_cost)
+        # count rounds actually processed (self._cycle_count), not the
+        # mixin's round id, which can jump on fast-forward rejoin
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            DsaValueMessage(self.current_value), MSG_ALGO)
+
+
+def build_computation(comp_def) -> DsaMpComputation:
+    return DsaMpComputation(comp_def)

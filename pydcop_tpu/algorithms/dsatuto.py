@@ -86,7 +86,9 @@ class DsaTutoComputation(SynchronousComputationMixin,
             neighbor_values)
         if best_cost != current_cost and _random.random() < 0.5:
             self.value_selection(best_value, best_cost)
-        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+        # count processed rounds (not the mixin round id, which can jump
+        # on fast-forward rejoin)
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
             self.finished()
             return
         self.post_to_all_neighbors(

@@ -387,3 +387,199 @@ def communication_load(node, target: str) -> float:
                 return HEADER_SIZE + UNIT_SIZE * len(v.domain)
         raise ValueError(f"{target} is not a neighbor of {node.name}")
     return HEADER_SIZE + UNIT_SIZE * len(node.variable.domain)
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: MaxSum running ON the agent fabric, one
+# computation per factor-graph node, exchanging real cost messages in
+# thread / process / multi-machine mode (reference: maxsum.py:279-676
+# MaxSumFactorComputation / MaxSumVariableComputation).  The compiled
+# solvers above are the data plane; this is the distributed path used by
+# orchestrated runs.
+# ---------------------------------------------------------------------
+
+import numpy as _np
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    DcopComputation, SynchronousComputationMixin, VariableComputation,
+    message_type, register)
+from ._mp import sign_for_mode
+
+#: costs: list of floats aligned to the *target* variable's domain order
+#: (a list, not a value-keyed dict: JSON would silently stringify
+#: non-string domain values used as dict keys across processes)
+MaxSumCostsMessage = message_type("maxsum_costs", ["costs"])
+
+
+class MaxSumVariableMpComputation(SynchronousComputationMixin,
+                                  VariableComputation):
+    """One variable node of the factor graph on the agent fabric
+    (reference: maxsum.py:450-676)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.damping = float(params.get("damping", 0.5))
+        self.damping_nodes = params.get("damping_nodes", "vars")
+        self.stability = float(params.get("stability", 0.1))
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.factor_names = list(comp_def.node.neighbors)
+        sign = sign_for_mode(self.mode)
+        self._own_costs = _np.array(
+            [sign * self.variable.cost_for_val(v)
+             for v in self.variable.domain.values])
+        # factor -> costs last received / last q sent (signed space)
+        self._r: Dict[str, _np.ndarray] = {}
+        self._q_sent: Dict[str, _np.ndarray] = {}
+        self._same = 0
+
+    def on_start(self):
+        self.start_cycle()
+        self._select_and_send()
+        if not self.factor_names:
+            # unconstrained variable: nothing to exchange, done
+            self.finished()
+
+    def on_fast_forward(self, cycle_id):
+        # rejoin after repair re-deploy: re-announce for the new round
+        self._send_costs()
+
+    @register("maxsum_costs")
+    def _on_costs(self, sender, msg, t):  # pragma: no cover
+        pass  # sync mixin delivers whole rounds via on_new_cycle
+
+    def on_new_cycle(self, messages, cycle_id):
+        prev_selection = self.current_value
+        for sender, (msg, _) in messages.items():
+            self._r[sender] = _np.asarray(msg.costs, dtype=float)
+        self.new_cycle()
+        delta = self._select_and_send()
+        # convergence: stable selection + message change below the
+        # stability threshold for SAME_COUNT cycles (maxsum.py:106,688)
+        if self.current_value == prev_selection and \
+                delta < self.stability:
+            self._same += 1
+        else:
+            self._same = 0
+        if self._same >= SAME_COUNT or (
+                self.stop_cycle
+                and self._cycle_count >= self.stop_cycle):
+            self.finished()
+
+    # ------------------------------------------------------------ internals
+
+    def _belief(self) -> _np.ndarray:
+        belief = self._own_costs.copy()
+        for r in self._r.values():
+            belief = belief + r
+        return belief
+
+    def _select_and_send(self) -> float:
+        belief = self._belief()
+        idx = int(_np.argmin(belief))
+        sign = sign_for_mode(self.mode)
+        self.value_selection(self.variable.domain.values[idx],
+                             sign * float(belief[idx]))
+        return self._send_costs(belief)
+
+    def _send_costs(self, belief: Optional[_np.ndarray] = None) -> float:
+        """Send q = belief - echo to every factor, normalized by the
+        average (maxsum.py:623-676), damped (maxsum.py:679)."""
+        if belief is None:
+            belief = self._belief()
+        delta = 0.0
+        for f in self.factor_names:
+            q = belief - self._r.get(f, 0.0)
+            q = q - q.mean()
+            prev = self._q_sent.get(f)
+            if prev is not None and \
+                    self.damping_nodes in ("vars", "both") and \
+                    0 < self.damping < 1:
+                q = self.damping * prev + (1 - self.damping) * q
+            if prev is not None:
+                delta = max(delta, float(_np.abs(q - prev).max()))
+            self._q_sent[f] = q
+            self.post_msg(f, MaxSumCostsMessage(q.tolist()), MSG_ALGO)
+        return delta
+
+
+class MaxSumFactorMpComputation(SynchronousComputationMixin,
+                                DcopComputation):
+    """One factor node of the factor graph on the agent fabric
+    (reference: maxsum.py:279-449).  The reference brute-forces the
+    joint assignment space in Python loops; here the factor's cost
+    hypercube is materialized once and each neighbor's message is a
+    numpy broadcast-add + axis-min."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.name, comp_def)
+        self.mode = comp_def.algo.mode
+        params = comp_def.algo.params
+        self.damping = float(params.get("damping", 0.5))
+        self.damping_nodes = params.get("damping_nodes", "vars")
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        factor = comp_def.node.factor
+        self.variables = list(factor.dimensions)
+        sign = sign_for_mode(self.mode)
+        self._cube = sign * factor.to_matrix().matrix.astype(float)
+        self._axis = {v.name: i for i, v in enumerate(self.variables)}
+        self._q: Dict[str, _np.ndarray] = {}
+        self._r_sent: Dict[str, _np.ndarray] = {}
+
+    def on_start(self):
+        self.start_cycle()
+
+    def on_fast_forward(self, cycle_id):
+        self._send_marginals()
+
+    @register("maxsum_costs")
+    def _on_costs(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    def on_new_cycle(self, messages, cycle_id):
+        for sender, (msg, _) in messages.items():
+            self._q[sender] = _np.asarray(msg.costs, dtype=float)
+        self.new_cycle()
+        self._send_marginals()
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+
+    def _send_marginals(self):
+        """r_{f->v}[d] = min over assignments of the other variables of
+        (factor cost + sum of their q messages) — maxsum.py:382-447 as a
+        broadcast-add + min-reduction."""
+        n = self._cube.ndim
+        total = self._cube
+        for name, q in self._q.items():
+            axis = self._axis.get(name)
+            if axis is None:
+                continue
+            shape = [1] * n
+            shape[axis] = q.shape[0]
+            total = total + q.reshape(shape)
+        for v in self.variables:
+            axis = self._axis[v.name]
+            other_axes = tuple(i for i in range(n) if i != axis)
+            marg = total.min(axis=other_axes) if other_axes \
+                else total.copy()
+            q_v = self._q.get(v.name)
+            if q_v is not None:
+                marg = marg - q_v  # remove the target's own echo
+            prev = self._r_sent.get(v.name)
+            if prev is not None and \
+                    self.damping_nodes in ("factors", "both") and \
+                    0 < self.damping < 1:
+                marg = self.damping * prev + (1 - self.damping) * marg
+            self._r_sent[v.name] = marg
+            self.post_msg(v.name, MaxSumCostsMessage(marg.tolist()),
+                          MSG_ALGO)
+
+
+def build_computation(comp_def):
+    """Agent-fabric computation for one factor-graph node
+    (reference: maxsum.py:118-123 dispatches the same way)."""
+    if hasattr(comp_def.node, "variable"):
+        return MaxSumVariableMpComputation(comp_def)
+    return MaxSumFactorMpComputation(comp_def)

@@ -77,3 +77,133 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: MGM running ON the agent fabric
+# (reference: mgm.py:213-420).  Two alternating synchronous phases —
+# value messages, then gain messages; the strictly-largest gain in the
+# neighborhood moves, ties broken lexic (lower name) or random.  Used by
+# orchestrated runs; the compiled solver above is the data plane.
+# ---------------------------------------------------------------------
+
+import random as _random
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from ._mp import EPS, best_response, local_cost, sign_for_mode
+
+MgmValueMessage = message_type("mgm_value", ["value"])
+#: priority carries the sender's tie-break token: the random draw for
+#: break_mode=random, unused for lexic (names compare instead)
+MgmGainMessage = message_type("mgm_gain", ["gain", "priority"])
+
+
+class MgmMpComputation(SynchronousComputationMixin, VariableComputation):
+    """Synchronous MGM on the agent fabric (reference: mgm.py:213-420).
+    Phase alternation rides the sync-mixin cycle parity: even cycles
+    deliver value messages, odd cycles deliver gain messages."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.break_mode = params.get("break_mode", "lexic")
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._neighbor_values: Dict[str, object] = {}
+        self._gain = 0.0
+        self._candidate = None
+        self._priority = 0.0
+        self._rnd = _random.Random()
+
+    def on_start(self):
+        self.start_cycle()
+        self.random_value_selection()
+        self.post_to_all_neighbors(
+            MgmValueMessage(self.current_value), MSG_ALGO)
+        if not self.neighbors:
+            # no neighbors: a pure local optimization, done immediately
+            _, best, cost = best_response(
+                self.variable, self.constraints, {}, self.current_value,
+                self.mode)
+            self.value_selection(best, cost)
+            self.finished()
+
+    def on_fast_forward(self, cycle_id):
+        # rejoin for the round being joined: even rounds carry values,
+        # odd rounds carry gains
+        if cycle_id % 2 == 0:
+            self.post_to_all_neighbors(
+                MgmValueMessage(self.current_value), MSG_ALGO)
+        else:
+            self.post_to_all_neighbors(
+                MgmGainMessage(0.0, 0.0), MSG_ALGO)
+
+    @register("mgm_value")
+    def _on_value(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    @register("mgm_gain")
+    def _on_gain(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    def on_new_cycle(self, messages, cycle_id):
+        if cycle_id % 2 == 0:
+            self._value_phase(messages)
+        else:
+            self._gain_phase(messages)
+
+    def _value_phase(self, messages):
+        """Collect neighbor values, compute my best gain, announce it
+        (reference: mgm.py:213-300)."""
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        cur, best, best_cost = best_response(
+            self.variable, self.constraints, self._neighbor_values,
+            self.current_value, self.mode, prefer_different=False,
+            rnd=self._rnd)
+        sign = sign_for_mode(self.mode)
+        self._gain = sign * (cur - best_cost) if cur is not None else 0.0
+        self._candidate = best
+        self._priority = self._rnd.random()
+        self.post_to_all_neighbors(
+            MgmGainMessage(self._gain, self._priority), MSG_ALGO)
+
+    def _gain_phase(self, messages):
+        """Move iff my gain strictly beats every neighbor's, ties broken
+        by break_mode (reference: mgm.py:300-420).  Monotonic: only
+        strictly-improving moves."""
+        wins = True
+        for sender, (msg, _) in messages.items():
+            g = float(msg.gain or 0.0)
+            if g > self._gain + EPS:
+                wins = False
+            elif abs(g - self._gain) <= EPS:
+                if self.break_mode == "random":
+                    # identical draws: fall back to name order
+                    if (msg.priority, sender) > (self._priority,
+                                                 self.name):
+                        wins = False
+                elif sender < self.name:  # lexic: lower name wins
+                    wins = False
+        if wins and self._gain > EPS:
+            assignment = dict(self._neighbor_values)
+            assignment[self.variable.name] = self._candidate
+            self.value_selection(
+                self._candidate,
+                local_cost(self.variable, self.constraints, assignment))
+        self.new_cycle()
+        # one MGM iteration = value + gain phase: count full iterations
+        # (self._cycle_count, bumped by new_cycle), not mixin half-rounds
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            MgmValueMessage(self.current_value), MSG_ALGO)
+
+
+def build_computation(comp_def) -> MgmMpComputation:
+    return MgmMpComputation(comp_def)

@@ -366,11 +366,32 @@ class SynchronousComputationMixin:
             self._cycle_messages[sender] = (msg, t)
         elif cycle_id == self._current_cycle + 1:
             self._next_cycle_messages[sender] = (msg, t)
+        elif cycle_id > self._current_cycle + 1:
+            # a computation (re)starting into a running system — e.g.
+            # re-deployed on a replica holder after repair — receives
+            # messages from rounds far ahead: fast-forward to the
+            # senders' round instead of failing, and let the algorithm
+            # re-announce its state for that round (best-effort rejoin).
+            # The round id is sender-supplied: algorithms must not treat
+            # it as work performed (count processed rounds themselves,
+            # see e.g. DsaMpComputation) since a bad peer could inflate
+            # it — the control plane is unauthenticated, like the
+            # reference's
+            self.logger.info(
+                "%s fast-forwarding from cycle %s to %s (msg from %s)",
+                self.name, self._current_cycle, cycle_id, sender)
+            self._current_cycle = cycle_id
+            self._cycle_messages = {sender: (msg, t)}
+            self._next_cycle_messages = {}
+            self._sent_this_cycle = set()
+            self.on_fast_forward(cycle_id)
         else:
-            raise ComputationException(
-                f"Out-of-sync message from {sender} on {self.name}: "
-                f"cycle {cycle_id}, current {self._current_cycle}"
-            )
+            # stale message from a round already closed (e.g. posted to
+            # us before we fast-forwarded): drop
+            self.logger.debug(
+                "%s dropping stale cycle-%s message from %s (current %s)",
+                self.name, cycle_id, sender, self._current_cycle)
+            return
         self._maybe_end_cycle()
 
     def post_msg(self, target: str, msg: Message, prio: int = None,
@@ -408,6 +429,13 @@ class SynchronousComputationMixin:
     def on_new_cycle(self, messages: Dict[str, Tuple[Message, float]],
                      cycle_id: int):  # pragma: no cover - abstract
         raise NotImplementedError()
+
+    def on_fast_forward(self, cycle_id: int):
+        """Called after the mixin fast-forwarded into round ``cycle_id``
+        (rejoin after restart).  Subclasses should re-post their
+        current-round message so neighbors waiting on this computation
+        can close the round; the default does nothing."""
+        pass
 
 
 class DcopComputation(MessagePassingComputation):

@@ -25,10 +25,10 @@ from .communication import CommunicationLayer, MSG_MGT, MSG_VALUE
 from .computations import DcopComputation, MessagePassingComputation, \
     VariableComputation, register
 from .discovery import DIRECTORY_COMP
-from .orchestrator import AgentStoppedMessage, CycleChangeMessage, \
-    MetricsMessage, ORCHESTRATOR_AGENT, ORCHESTRATOR_MGT, \
-    RepairDoneMessage, RepairReadyMessage, ReplicationDoneMessage, \
-    ValueChangeMessage, orchestration_comp_name
+from .orchestrator import AgentStoppedMessage, ComputationFinishedMessage, \
+    CycleChangeMessage, MetricsMessage, ORCHESTRATOR_AGENT, \
+    ORCHESTRATOR_MGT, RepairDoneMessage, RepairReadyMessage, \
+    ReplicationDoneMessage, ValueChangeMessage, orchestration_comp_name
 
 logger = logging.getLogger("pydcop_tpu.infrastructure.orchestratedagents")
 
@@ -216,6 +216,10 @@ class OrchestrationComputation(MessagePassingComputation):
             self.post_msg(ORCHESTRATOR_MGT, CycleChangeMessage(
                 self.agent.name, computation, cycle), MSG_VALUE)
 
+    def report_finished(self, computation):
+        self.post_msg(ORCHESTRATOR_MGT, ComputationFinishedMessage(
+            self.agent.name, computation), MSG_MGT)
+
     def _periodic_metrics(self):
         self.post_msg(ORCHESTRATOR_MGT, MetricsMessage(
             self.agent.name, self.agent.metrics.to_dict()), MSG_VALUE)
@@ -264,3 +268,7 @@ class OrchestratedAgent(ResilientAgent):
     def _on_computation_new_cycle(self, computation, count):
         super()._on_computation_new_cycle(computation, count)
         self._orchestration.report_cycle_change(computation, count)
+
+    def _on_computation_finished(self, computation):
+        super()._on_computation_finished(computation)
+        self._orchestration.report_finished(computation)

@@ -65,6 +65,8 @@ RepairReadyMessage = message_type("repair_ready",
                                   ["agent", "computations"])
 RepairRunMessage = message_type("repair_run", [])
 RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+ComputationFinishedMessage = message_type(
+    "computation_finished", ["agent", "computation"])
 
 
 class AgentsMgt(MessagePassingComputation):
@@ -81,6 +83,7 @@ class AgentsMgt(MessagePassingComputation):
         self.agent_metrics: Dict[str, Dict] = {}
         self.current_values: Dict[str, Any] = {}
         self.current_costs: Dict[str, float] = {}
+        self.finished_computations: Set[str] = set()
         self.max_cycle = 0
         self.replica_dists: Dict[str, Dict] = {}
         self.repair_ready_agents: Set[str] = set()
@@ -152,6 +155,11 @@ class AgentsMgt(MessagePassingComputation):
                 self.orchestrator.collect_moment == "cycle_change":
             collector.put((time.perf_counter(), msg.computation,
                            None, None, msg.cycle))
+
+    @register("computation_finished")
+    def _on_computation_finished(self, sender, msg, t):
+        with self._lock:
+            self.finished_computations.add(msg.computation)
 
     @register("metrics")
     def _on_metrics(self, sender, msg, t):
@@ -322,12 +330,18 @@ class Orchestrator:
                               RunAgentMessage(None), MSG_MGT)
         algo_module = load_algorithm_module(self.algo.algo)
         try:
-            if hasattr(algo_module, "build_solver") or \
+            if hasattr(algo_module, "build_computation"):
+                # the deployed computations are the real algorithm (they
+                # were built from algo_module.build_computation): the
+                # math runs distributed on the agent fabric, as in the
+                # reference — the orchestrator only aggregates
+                self._run_message_passing(scenario, timeout)
+            elif hasattr(algo_module, "build_solver") or \
                     hasattr(algo_module, "solve_direct"):
                 self._run_compiled(algo_module, scenario, timeout,
                                    max_cycles, seed)
             else:
-                self._run_message_passing(timeout)
+                self._run_message_passing(scenario, timeout)
         finally:
             if self.status == "RUNNING":
                 self.status = "FINISHED"
@@ -425,11 +439,34 @@ class Orchestrator:
             self.mgt.post_msg(orchestration_comp_name(agent),
                               ValuesMessage(vals, cycle), MSG_MGT)
 
-    def _run_message_passing(self, timeout):
-        """Algorithms that run fully on the agents (e.g. dsatuto)."""
-        deadline = time.perf_counter() + (timeout or 5)
+    def _run_message_passing(self, scenario, timeout):
+        """Algorithms that run fully on the agents (the reference's only
+        mode, orchestrator.py:245-374): wait until every deployed
+        computation reports finished, the timeout expires, or scenario
+        events fire along the way."""
+        t0 = time.perf_counter()
+        deadline = t0 + (timeout or 5)
+        events = _scenario_offsets(scenario)
+        finished = False
+        # the run is finished when every *decision* computation has
+        # reported finished — factor nodes have no value to select and
+        # (like the reference's) no convergence test of their own
+        decision = {n.name for n in self.cg.nodes
+                    if hasattr(n, "variable")}
         while time.perf_counter() < deadline:
-            time.sleep(0.1)
+            elapsed = time.perf_counter() - t0
+            while events and events[0][0] <= elapsed:
+                _, actions = events.pop(0)
+                self._apply_scenario_actions(actions)
+            with self.mgt._lock:
+                done = set(self.mgt.finished_computations)
+            # expected stays in the loop: repair can move computations
+            expected = {c for c in self.distribution.computations
+                        if c in decision}
+            if expected and expected <= done:
+                finished = True
+                break
+            time.sleep(0.05)
         from ..engine.solver import RunResult
 
         assignment = dict(self.mgt.current_values)
@@ -439,10 +476,11 @@ class Orchestrator:
             cost, violations = self.dcop.solution_cost(
                 {k: v for k, v in assignment.items()
                  if k in self.dcop.variables})
-        self._result = RunResult(
+        self._finish_run(RunResult(
             assignment=assignment, cycles=self.mgt.max_cycle,
-            finished=False, cost=cost, violations=violations,
-            duration=timeout or 5, status="TIMEOUT")
+            finished=finished, cost=cost, violations=violations,
+            duration=time.perf_counter() - t0,
+            status="FINISHED" if finished else "TIMEOUT"))
 
     def _finish_run(self, result):
         self._result = result
@@ -484,8 +522,20 @@ class Orchestrator:
         agent_defs = {}
         if self.dcop is not None:
             agent_defs = dict(self.dcop.agents)
+        # footprint-weighted remaining capacity: weigh each orphan by its
+        # algorithm footprint, not 1 per computation
+        from ..algorithms import load_algorithm_module
+
+        footprints = {}
+        algo_module = load_algorithm_module(self.algo.algo)
+        for n in self.cg.nodes:
+            try:
+                footprints[n.name] = float(
+                    algo_module.computation_memory(n))
+            except Exception:
+                pass  # no footprint model (e.g. dpop): default 1.0
         repair_info = build_repair_info(removed, self.discovery,
-                                        agent_defs)
+                                        agent_defs, footprints=footprints)
         candidates = {a for agts in repair_info["candidates"].values()
                       for a in agts}
         candidates -= self.departed_agents

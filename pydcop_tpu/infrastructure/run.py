@@ -273,7 +273,11 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             collect_period=collect_period, replication=rep, port=port,
             delay=delay or 0)
     try:
-        orchestrator.deploy_computations()
+        # process mode spawns one interpreter per agent: registration can
+        # take tens of seconds for larger fleets, scale the wait with it
+        n_agents = len(list(dist.agents))
+        orchestrator.deploy_computations(
+            timeout=max(15.0, 4.0 * n_agents))
         if ktarget:
             orchestrator.start_replication(ktarget)
         result = orchestrator.run(scenario=scenario, timeout=timeout,

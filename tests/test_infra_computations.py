@@ -201,14 +201,28 @@ def test_sync_messages_filtered_from_cycle():
     assert set(messages) == {"b"}  # sync msgs dropped from the payload
 
 
-def test_out_of_sync_raises():
-    c = SyncComp("a", ["b"])
+def test_out_of_sync_fast_forwards_and_drops_stale():
+    """A computation (re)starting into a running system fast-forwards to
+    the senders' round (repair re-deploy rejoin); messages from already
+    closed rounds are dropped."""
+    c = SyncComp("a", ["b", "x"])
     c.message_sender = MagicMock()
     c.start()
     m = Message("v")
     m._cycle_id = 5
-    with pytest.raises(ComputationException):
-        c.on_message("b", m, 0.0)
+    c.on_message("b", m, 0.0)
+    assert c.cycle_count == 5  # joined the senders' round
+    stale = Message("v")
+    stale._cycle_id = 1
+    c.on_message("x", stale, 0.0)  # dropped, no exception
+    assert c.cycle_count == 5
+    # the round closes normally once the remaining neighbor catches up
+    m2 = Message("v")
+    m2._cycle_id = 5
+    c.on_message("x", m2, 0.0)
+    assert c.cycle_count == 6
+    assert c.cycles and c.cycles[-1][0] == 5
+    assert set(c.cycles[-1][1]) == {"b", "x"}
 
 
 # ------------------------------------------------- dcop-level computations
