@@ -13,9 +13,42 @@ local neighborhood — small dict/loop math, the compiled engine covers the
 large regime.
 """
 
+import random as _random
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 EPS = 1e-9
+
+
+def mp_rng(params: Dict[str, Any], name: str) -> _random.Random:
+    """Per-computation RNG for the message-passing backends.
+
+    With the ``seed`` algo param set, every computation derives its own
+    deterministic stream from ``(seed, name)`` so distributed runs are
+    reproducible and can be cross-checked against the compiled engine;
+    without it the stream is OS-seeded, like the reference's bare
+    ``random`` calls (reference: dsa.py:300, mgm.py:270)."""
+    seed = params.get("seed")
+    if seed is None:
+        return _random.Random()
+    return _random.Random(f"{seed}:{name}")
+
+
+#: declarative ``seed`` parameter shared by the stochastic mp backends
+def seed_param():
+    from . import AlgoParameterDef
+
+    return AlgoParameterDef("seed", "int", None, None)
+
+
+#: params consumed only by the message-passing backends; the compiled
+#: solvers take their seed from the engine's PRNG key instead
+MP_ONLY_PARAMS = frozenset({"seed", "start_messages"})
+
+
+def engine_params(params):
+    """Filter out mp-only params before handing to a compiled solver."""
+    return {k: v for k, v in (params or {}).items()
+            if k not in MP_ONLY_PARAMS}
 
 
 def sign_for_mode(mode: str) -> float:
@@ -28,6 +61,18 @@ def local_cost(variable, constraints, assignment: Dict[str, Any]) -> float:
     """Model cost of this variable's neighborhood under ``assignment``
     (unary variable cost + all fully-instantiated incident constraints)."""
     cost = variable.cost_for_val(assignment[variable.name])
+    for c in constraints:
+        scope = c.scope_names
+        if all(n in assignment for n in scope):
+            cost += c(**{n: assignment[n] for n in scope})
+    return cost
+
+
+def constraints_cost(constraints: Iterable,
+                     assignment: Dict[str, Any]) -> float:
+    """Sum of the fully-instantiated constraints under ``assignment``
+    (no unary variable cost)."""
+    cost = 0.0
     for c in constraints:
         scope = c.scope_names
         if all(n in assignment for n in scope):

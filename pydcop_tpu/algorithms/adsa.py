@@ -61,10 +61,134 @@ class ADsaSolver(DsaSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> ADsaSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return ADsaSolver(arrays, **params)
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: A-DSA running ON the agent fabric
+# (reference: adsa.py:131-392).  Fully asynchronous: value messages
+# update the local view whenever they arrive, and the DSA decision runs
+# on the hosting agent's timer wheel every ``period`` seconds (with a
+# random start delay) — the one algorithm exercising the fabric's
+# periodic-action path.
+# ---------------------------------------------------------------------
+
+from typing import Dict as _DictT
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    VariableComputation, message_type, register)
+from ._mp import EPS, best_response, constraint_optima, \
+    has_violated_constraint, mp_rng, seed_param, sign_for_mode
+
+algo_params = algo_params + [seed_param()]
+
+ADsaValueMessage = message_type("adsa_value", ["value"])
+
+
+class ADsaMpComputation(VariableComputation):
+    """A-DSA on the agent fabric (reference: adsa.py:131-392).
+
+    ``stop_cycle`` bounds the number of periodic activations (the
+    reference's A-DSA never terminates on its own and relies on the
+    orchestrator timeout; a bound makes orchestrated runs finish)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.variant = params.get("variant", "B")
+        self.probability = float(params.get("probability", 0.7))
+        self.period = float(params.get("period", 0.5))
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._rnd = mp_rng(params, self.name)
+        self._optima = constraint_optima(self.constraints, self.mode) \
+            if self.variant == "B" else {}
+        self._neighbor_values: _DictT[str, object] = {}
+        self._start_handle = None
+        self._tick_handle = None
+
+    def on_start(self):
+        # random start delay desynchronizes the fleet
+        # (reference: adsa.py:158-161)
+        delay = self._rnd.random() * self.period or self.period
+        self._start_handle = self.add_periodic_action(
+            delay, self._delayed_start)
+
+    def on_stop(self):
+        if self._start_handle is not None:
+            self.remove_periodic_action(self._start_handle)
+            self._start_handle = None
+        if self._tick_handle is not None:
+            self.remove_periodic_action(self._tick_handle)
+            self._tick_handle = None
+
+    def _delayed_start(self):
+        if self._start_handle is not None:
+            self.remove_periodic_action(self._start_handle)
+            self._start_handle = None
+        if not self.neighbors:
+            _, best, cost = best_response(
+                self.variable, self.constraints, {}, None, self.mode,
+                rnd=self._rnd)
+            self.value_selection(best, cost)
+            self.finished()
+            return
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
+        self.post_to_all_neighbors(
+            ADsaValueMessage(self.current_value), MSG_ALGO)
+        self._tick_handle = self.add_periodic_action(
+            self.period, self._tick)
+
+    @register("adsa_value")
+    def _on_value(self, sender, msg, t):
+        self._neighbor_values[sender] = msg.value
+
+    def _tick(self):
+        """One asynchronous DSA activation (reference: adsa.py:222-260).
+        """
+        if self.is_paused or not self.is_running:
+            return
+        if len(self._neighbor_values) < len(self.neighbors):
+            return  # still waiting for the first full view
+        self.new_cycle()
+        cur, best_val, best_cost = best_response(
+            self.variable, self.constraints, self._neighbor_values,
+            self.current_value, self.mode,
+            prefer_different=self.variant in ("B", "C"), rnd=self._rnd)
+        sign = sign_for_mode(self.mode)
+        delta = sign * (cur - best_cost) if cur is not None else 0.0
+        improve = delta > EPS
+        if self.variant == "A":
+            want = improve
+        elif self.variant == "B":
+            assignment = dict(self._neighbor_values)
+            assignment[self.variable.name] = self.current_value
+            want = improve or (
+                abs(delta) <= EPS and best_val != self.current_value
+                and has_violated_constraint(
+                    self.constraints, self._optima, assignment,
+                    self.mode))
+        else:  # C
+            want = improve or (abs(delta) <= EPS
+                               and best_val != self.current_value)
+        if want and self._rnd.random() < self.probability:
+            self.value_selection(best_val, best_cost)
+            self.post_to_all_neighbors(
+                ADsaValueMessage(self.current_value), MSG_ALGO)
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+
+
+def build_computation(comp_def) -> ADsaMpComputation:
+    return ADsaMpComputation(comp_def)

@@ -57,6 +57,251 @@ class AMaxSumSolver(MaxSumSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> AMaxSumSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = FactorGraphArrays.build(dcop, variables, constraints)
     return AMaxSumSolver(arrays, **params)
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: A-MaxSum running ON the agent fabric
+# (reference: amaxsum.py:108-424).  Truly asynchronous: every node
+# recomputes and re-sends on receipt, no round barrier; messages are
+# suppressed once they stop changing (approx-match + SAME_COUNT,
+# reference amaxsum.py:186-229).
+# ---------------------------------------------------------------------
+
+import numpy as _np
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    DcopComputation, VariableComputation, message_type, register)
+from ._mp import mp_rng, seed_param, sign_for_mode
+from .maxsum import SAME_COUNT
+
+algo_params = algo_params + [
+    AlgoParameterDef("start_messages", "str",
+                     ["leafs", "leafs_vars", "vars", "all"],
+                     "leafs_vars"),
+    seed_param(),
+]
+
+#: costs aligned to the target variable's domain order (list, not dict:
+#: JSON stringifies non-string keys across processes)
+AMaxSumCostsMessage = message_type("amaxsum_costs", ["costs"])
+
+
+def _approx_match(a, b, stability) -> bool:
+    if b is None:
+        return False
+    return bool(_np.max(_np.abs(a - b)) <= stability)
+
+
+class AMaxSumVariableMpComputation(VariableComputation):
+    """Variable node of asynchronous MaxSum (reference:
+    amaxsum.py:253-424).  Terminates once its outgoing messages and
+    selection have been stable SAME_COUNT receipts in a row (the
+    reference never self-terminates and leans on the orchestrator
+    timeout)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.damping = float(params.get("damping", 0.5))
+        self.damping_nodes = params.get("damping_nodes", "vars")
+        self.stability = float(params.get("stability", 0.1))
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.start_messages = params.get("start_messages", "leafs_vars")
+        self.factor_names = list(comp_def.node.neighbors)
+        sign = sign_for_mode(self.mode)
+        self._own_costs = _np.array(
+            [sign * self.variable.cost_for_val(v)
+             for v in self.variable.domain.values])
+        self._r: Dict[str, _np.ndarray] = {}
+        self._q_sent: Dict[str, _np.ndarray] = {}
+        self._same_sent: Dict[str, int] = {}
+        self._stable = 0
+        self._last_receipt = 0.0
+        self._quiet_handle = None
+
+    def on_start(self):
+        import time as _time
+
+        if not self.factor_names:
+            idx = int(_np.argmin(self._own_costs))
+            sign = sign_for_mode(self.mode)
+            self.value_selection(self.variable.domain.values[idx],
+                                 sign * float(self._own_costs[idx]))
+            self.finished()
+            return
+        self._select()
+        if self.start_messages in ("leafs_vars", "vars", "all"):
+            self._send_all()
+        # quiescence detector: asynchronous message suppression can
+        # leave the whole graph silent before the stability counter
+        # trips; a silent second with a value selected = converged
+        self._last_receipt = _time.perf_counter()
+        self._quiet_handle = self.add_periodic_action(
+            0.5, self._check_quiescence)
+
+    def _check_quiescence(self):
+        import time as _time
+
+        # only after real message exchange: a slow-starting neighborhood
+        # must not be mistaken for a converged one (with no traffic at
+        # all the orchestrator timeout applies, as in the reference)
+        if self._r and self.current_value is not None and \
+                _time.perf_counter() - self._last_receipt > 2.0:
+            self.finished()
+
+    def on_stop(self):
+        if self._quiet_handle is not None:
+            self.remove_periodic_action(self._quiet_handle)
+            self._quiet_handle = None
+
+    def _belief(self):
+        belief = self._own_costs.copy()
+        for r in self._r.values():
+            belief = belief + r
+        return belief
+
+    def _select(self):
+        belief = self._belief()
+        idx = int(_np.argmin(belief))
+        sign = sign_for_mode(self.mode)
+        prev = self.current_value
+        self.value_selection(self.variable.domain.values[idx],
+                             sign * float(belief[idx]))
+        return prev == self.current_value
+
+    def _send_all(self):
+        belief = self._belief()
+        for f in self.factor_names:
+            q = belief - self._r.get(f, 0.0)
+            q = q - q.mean()
+            prev = self._q_sent.get(f)
+            if prev is not None and \
+                    self.damping_nodes in ("vars", "both") and \
+                    0 < self.damping < 1:
+                q = self.damping * prev + (1 - self.damping) * q
+            if _approx_match(q, prev, self.stability):
+                count = self._same_sent.get(f, 0)
+                if count >= SAME_COUNT:
+                    continue  # suppressed: stable enough, stop chatting
+                self._same_sent[f] = count + 1
+            else:
+                self._same_sent[f] = 0
+            self._q_sent[f] = q
+            self.post_msg(f, AMaxSumCostsMessage(q.tolist()), MSG_ALGO)
+
+    @register("amaxsum_costs")
+    def _on_costs(self, sender, msg, t):
+        import time as _time
+
+        self._last_receipt = _time.perf_counter()
+        self._r[sender] = _np.asarray(msg.costs, dtype=float)
+        self.new_cycle()
+        stable_sel = self._select()
+        self._send_all()
+        # all outgoing suppressed + selection unchanged = converged
+        all_suppressed = all(
+            self._same_sent.get(f, 0) >= SAME_COUNT
+            for f in self.factor_names)
+        self._stable = self._stable + 1 \
+            if (stable_sel and all_suppressed) else 0
+        if self._stable >= SAME_COUNT or (
+                self.stop_cycle
+                and self._cycle_count >= self.stop_cycle):
+            self.finished()
+
+
+class AMaxSumFactorMpComputation(DcopComputation):
+    """Factor node of asynchronous MaxSum (reference: amaxsum.py:108-251).
+    Recomputes marginals on every receipt once all variables reported;
+    the cost hypercube lives as one ndarray and each marginal is a
+    broadcast-add + axis-min (the reference brute-forces assignments in
+    Python loops)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.name, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.damping = float(params.get("damping", 0.5))
+        self.damping_nodes = params.get("damping_nodes", "vars")
+        self.stability = float(params.get("stability", 0.1))
+        self.start_messages = params.get("start_messages", "leafs_vars")
+        factor = comp_def.node.factor
+        self.factor = factor
+        self.variables = list(factor.dimensions)
+        self._load_cube()
+        self._q: Dict[str, _np.ndarray] = {}
+        self._r_sent: Dict[str, _np.ndarray] = {}
+        self._same_sent: Dict[str, int] = {}
+
+    def _load_cube(self):
+        sign = sign_for_mode(self.mode)
+        self._cube = sign * self.factor.to_matrix().matrix.astype(float)
+        self._axis = {v.name: i
+                      for i, v in enumerate(self.variables)}
+
+    def on_start(self):
+        is_leaf = len(self.variables) == 1
+        if (is_leaf and self.start_messages in ("leafs", "leafs_vars")) \
+                or self.start_messages == "all":
+            self._send_marginals()
+
+    def _send_marginals(self, exclude: Optional[str] = None):
+        n = self._cube.ndim
+        total = self._cube
+        for name, q in self._q.items():
+            axis = self._axis.get(name)
+            if axis is None:
+                continue
+            shape = [1] * n
+            shape[axis] = q.shape[0]
+            total = total + q.reshape(shape)
+        for v in self.variables:
+            if exclude is not None and v.name == exclude:
+                continue
+            axis = self._axis[v.name]
+            other_axes = tuple(i for i in range(n) if i != axis)
+            marg = total.min(axis=other_axes) if other_axes \
+                else total.copy()
+            q_v = self._q.get(v.name)
+            if q_v is not None:
+                marg = marg - q_v
+            prev = self._r_sent.get(v.name)
+            if prev is not None and \
+                    self.damping_nodes in ("factors", "both") and \
+                    0 < self.damping < 1:
+                marg = self.damping * prev + (1 - self.damping) * marg
+            if _approx_match(marg, prev, self.stability):
+                count = self._same_sent.get(v.name, 0)
+                if count >= SAME_COUNT:
+                    continue
+                self._same_sent[v.name] = count + 1
+            else:
+                self._same_sent[v.name] = 0
+            self._r_sent[v.name] = marg
+            self.post_msg(v.name, AMaxSumCostsMessage(marg.tolist()),
+                          MSG_ALGO)
+
+    @register("amaxsum_costs")
+    def _on_costs(self, sender, msg, t):
+        self._q[sender] = _np.asarray(msg.costs, dtype=float)
+        self.new_cycle()
+        # wait for the full view before the first send, then re-send to
+        # everyone but the sender (reference: amaxsum.py:186-229)
+        if len(self._q) == len(self.variables):
+            self._send_marginals(exclude=sender
+                                 if len(self.variables) > 1 else None)
+
+
+def build_computation(comp_def):
+    """Agent-fabric computation for one factor-graph node
+    (reference: amaxsum.py:89-95)."""
+    if hasattr(comp_def.node, "variable"):
+        return AMaxSumVariableMpComputation(comp_def)
+    return AMaxSumFactorMpComputation(comp_def)

@@ -124,10 +124,203 @@ class DbaSolver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> DbaSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return DbaSolver(arrays, **params)
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: DBA running ON the agent fabric
+# (reference: dba.py:272-597).  The reference's wait_ok / wait_improve
+# modes with postponed-message queues become two sync-mixin sub-cycles
+# (even = ok?, odd = improve); the asynchronous termination broadcast
+# (dba_end, reference dba.py:568-581) bypasses the round barrier.
+# ---------------------------------------------------------------------
+
+from typing import Dict
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from ._mp import mp_rng, seed_param
+
+algo_params = algo_params + [seed_param()]
+
+DbaOkMessage = message_type("dba_ok", ["value"])
+DbaImproveMessage = message_type(
+    "dba_improve", ["improve", "current_eval", "termination_counter"])
+DbaEndMessage = message_type("dba_end", [])
+
+
+class DbaMpComputation(SynchronousComputationMixin, VariableComputation):
+    """Distributed Breakout on the agent fabric (reference:
+    dba.py:272-597).  A constraint is violated when its cost reaches the
+    ``infinity`` marker; the eval value is the weighted count of violated
+    constraints, and weights grow at quasi-local-minima (the breakout)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        if comp_def.algo.mode != "min":
+            raise ValueError("DBA is a constraint satisfaction algorithm "
+                             "and only supports minimization")
+        self.infinity = float(params.get("infinity", 10000))
+        self.max_distance = int(params.get("max_distance", 50))
+        self.constraints = list(comp_def.node.constraints)
+        self._weights = [1.0 for _ in self.constraints]
+        self._rnd = mp_rng(params, self.name)
+        self._neighbor_values: Dict[str, object] = {}
+        self._termination_counter = 0
+        self._consistent = False
+        self._can_move = False
+        self._quasi_local_minimum = False
+        self._my_improve = 0.0
+        self._new_value = None
+        self._current_eval = 0.0
+        self._violated = []
+
+    def on_start(self):
+        self.start_cycle()
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
+        if not self.neighbors:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            DbaOkMessage(self.current_value), MSG_ALGO)
+
+    def on_fast_forward(self, cycle_id):
+        if cycle_id % 2 == 0:
+            self.post_to_all_neighbors(
+                DbaOkMessage(self.current_value), MSG_ALGO)
+        else:
+            self.post_to_all_neighbors(
+                DbaImproveMessage(0.0, self._current_eval,
+                                  self._termination_counter), MSG_ALGO)
+
+    def on_message(self, sender, msg, t):
+        # termination is asynchronous in the reference (dba.py:568-581):
+        # handle it outside the round barrier so a finished neighbor
+        # cannot deadlock our cycle
+        if msg.type == "dba_end":
+            self._on_end()
+            return
+        super().on_message(sender, msg, t)
+
+    def _on_end(self):
+        if self.is_running:
+            self.post_to_all_neighbors(DbaEndMessage(), MSG_ALGO)
+            self.finished()
+            self.stop()
+
+    @register("dba_ok")
+    def _on_ok(self, sender, msg, t):  # pragma: no cover
+        pass  # rounds are delivered through on_new_cycle
+
+    @register("dba_improve")
+    def _on_improve(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    @register("dba_end")
+    def _on_end_msg(self, sender, msg, t):  # pragma: no cover
+        pass  # handled in on_message, outside the round barrier
+
+    def on_new_cycle(self, messages, cycle_id):
+        if cycle_id % 2 == 0:
+            self._ok_phase(messages)
+        else:
+            self._improve_phase(messages)
+
+    # ---------------------------------------------------------- phases
+
+    def _eval_value(self, val):
+        """(weighted violation count, violated constraint indices) for
+        ``val`` under the neighbors' values (reference: dba.py:450-476).
+        """
+        assignment = dict(self._neighbor_values)
+        assignment[self.variable.name] = val
+        total, violated = 0.0, []
+        for i, c in enumerate(self.constraints):
+            scope = c.scope_names
+            if not all(n in assignment for n in scope):
+                continue
+            if c(**{n: assignment[n] for n in scope}) >= self.infinity:
+                violated.append(i)
+                total += self._weights[i]
+        return total, violated
+
+    def _ok_phase(self, messages):
+        """Collect values, compute best weighted-violation improvement,
+        announce it (reference: dba.py:352-442)."""
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        self._current_eval, self._violated = self._eval_value(
+            self.current_value)
+        best_vals, best_eval = [], None
+        for v in self.variable.domain.values:
+            ev, _ = self._eval_value(v)
+            if best_eval is None or ev < best_eval - 1e-9:
+                best_vals, best_eval = [v], ev
+            elif ev <= best_eval + 1e-9:
+                best_vals.append(v)
+
+        if self._current_eval == 0:
+            self._consistent = True
+        else:
+            self._consistent = False
+            self._termination_counter = 0
+        self._my_improve = self._current_eval - best_eval
+        if self._my_improve > 1e-9:
+            self._can_move = True
+            self._quasi_local_minimum = False
+            self._new_value = self._rnd.choice(best_vals)
+        else:
+            self._can_move = False
+            self._quasi_local_minimum = True
+        self.post_to_all_neighbors(DbaImproveMessage(
+            self._my_improve, self._current_eval,
+            self._termination_counter), MSG_ALGO)
+
+    def _improve_phase(self, messages):
+        """The strictly-best improver moves (lower name wins ties); at a
+        quasi-local-minimum the violated constraints' weights grow
+        (reference: dba.py:489-567)."""
+        for sender, (msg, _) in messages.items():
+            self._termination_counter = min(
+                int(msg.termination_counter), self._termination_counter)
+            if msg.improve > self._my_improve + 1e-9:
+                self._can_move = False
+                self._quasi_local_minimum = False
+            elif abs(msg.improve - self._my_improve) <= 1e-9 \
+                    and self.name > sender:
+                self._can_move = False
+            if msg.current_eval > 0:
+                self._consistent = False
+
+        self.new_cycle()
+        if self._consistent:
+            self._termination_counter += 1
+            if self._termination_counter >= self.max_distance:
+                self._on_end()
+                return
+        if self._quasi_local_minimum:
+            for i in self._violated:
+                self._weights[i] += 1.0
+        if self._can_move:
+            self.value_selection(
+                self._new_value, self._current_eval - self._my_improve)
+        self._neighbor_values.clear()
+        self._violated = []
+        self.post_to_all_neighbors(
+            DbaOkMessage(self.current_value), MSG_ALGO)
+
+
+def build_computation(comp_def) -> DbaMpComputation:
+    return DbaMpComputation(comp_def)

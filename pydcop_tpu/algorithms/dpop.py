@@ -139,3 +139,150 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
         status="FINISHED",
         metrics={"msg_count": msg_count, "msg_size": msg_size},
     )
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: DPOP running ON the agent fabric
+# (reference: dpop.py:151-441).  UTIL tables flow leaves -> root, VALUE
+# assignments root -> leaves; each node's join/projection is the same
+# vectorized broadcast-add / axis-reduce used by solve_direct above (the
+# reference's per-cell Python loops, relations.py:1672-1760, never
+# appear).  UTIL tables cross the wire as (dims, nested costs) lists so
+# the JSON transport carries them between processes / machines.
+# ---------------------------------------------------------------------
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    VariableComputation, message_type, register)
+from ..dcop.objects import Domain
+from ..dcop.relations import find_arg_optimal
+
+#: dims: [[var_name, [domain values...]], ...], costs: nested list with
+#: one axis per dim (JSON-safe: the reference ships pickled relation
+#: objects instead, dpop.py:88-109)
+DpopUtilMessage = message_type("dpop_util", ["dims", "costs"])
+#: assignment: [[var_name, value], ...] for the receiver's separator
+DpopValueMessage = message_type("dpop_value", ["assignment"])
+
+
+def _wire_util(util: NAryMatrixRelation):
+    dims = [[v.name, list(v.domain.values)] for v in util.dimensions]
+    return dims, util.matrix.tolist()
+
+
+def _unwire_util(dims, costs) -> NAryMatrixRelation:
+    variables = [
+        Variable(name, Domain(f"d_{name}", "", values))
+        for name, values in dims]
+    return NAryMatrixRelation(variables, np.asarray(costs),
+                              name="util")
+
+
+class DpopMpComputation(VariableComputation):
+    """One DPOP variable on the agent fabric (reference: dpop.py:151-441).
+
+    Asynchronous by construction: leaves fire their UTIL at start; every
+    node forwards once all children reported; the root kicks off the
+    VALUE wave and each node finishes right after selecting its value
+    (DPOP is not iterative — reference dpop.py:292-312)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        node = comp_def.node
+        self.mode = comp_def.algo.mode
+        self.parent = node.parent
+        self.children = list(node.children)
+        self.constraints = list(node.constraints)
+        # lowest-node rule is already applied by the graph build
+        # (graphs/pseudotree.py), unlike the reference which re-filters
+        # in the computation (dpop.py:186-202)
+        self._waited_children = set(self.children)
+        self._children_separator: Dict[str, list] = {}
+        rel = NAryMatrixRelation([self.variable],
+                                 name=f"util_{self.name}")
+        if self.variable.has_cost:
+            costs = [self.variable.cost_for_val(v)
+                     for v in self.variable.domain.values]
+            rel = join(rel, NAryMatrixRelation(
+                [self.variable], np.asarray(costs),
+                name=f"cost_{self.name}"))
+        self._joined_utils = rel
+
+    @property
+    def is_root(self):
+        return self.parent is None
+
+    @property
+    def is_leaf(self):
+        return not self.children
+
+    def on_start(self):
+        if self.is_leaf and not self.is_root:
+            util = self._compute_util()
+            dims, costs = _wire_util(util)
+            self.post_msg(self.parent, DpopUtilMessage(dims, costs),
+                          MSG_ALGO)
+        elif self.is_leaf:
+            # isolated variable: optimize alone (reference: dpop.py:255-283)
+            for c in self.constraints:
+                self._joined_utils = join(self._joined_utils,
+                                          c.to_matrix())
+            values, cost = find_arg_optimal(
+                self.variable, self._joined_utils, self.mode)
+            self._select_and_finish(values[0], float(cost))
+
+    def _compute_util(self) -> NAryMatrixRelation:
+        for c in self.constraints:
+            self._joined_utils = join(self._joined_utils, c.to_matrix())
+        return projection(self._joined_utils, self.variable, self.mode)
+
+    def _select_and_finish(self, value, cost):
+        self.value_selection(value, cost)
+        self.finished()
+
+    @register("dpop_util")
+    def _on_util(self, sender, msg, t):
+        util = _unwire_util(msg.dims, msg.costs)
+        self._joined_utils = join(self._joined_utils, util)
+        self._waited_children.discard(sender)
+        self._children_separator[sender] = [d[0] for d in msg.dims]
+        if self._waited_children:
+            return
+        if self.is_root:
+            for c in self.constraints:
+                self._joined_utils = join(self._joined_utils,
+                                          c.to_matrix())
+            values, cost = find_arg_optimal(
+                self.variable, self._joined_utils, self.mode)
+            selected = values[0]
+            for child in self.children:
+                self.post_msg(child, DpopValueMessage(
+                    [[self.name, selected]]), MSG_ALGO)
+            self._select_and_finish(selected, float(cost))
+        else:
+            util = self._compute_util()
+            dims, costs = _wire_util(util)
+            self.post_msg(self.parent, DpopUtilMessage(dims, costs),
+                          MSG_ALGO)
+
+    @register("dpop_value")
+    def _on_value(self, sender, msg, t):
+        value_dict = {name: value for name, value in msg.assignment}
+        fixed = {n: value_dict[n]
+                 for n in self._joined_utils.scope_names
+                 if n != self.name and n in value_dict}
+        rel = self._joined_utils.slice(fixed) if fixed \
+            else self._joined_utils
+        values, cost = find_arg_optimal(self.variable, rel, self.mode)
+        selected = values[0]
+        for child in self.children:
+            assignment = [[self.name, selected]]
+            for v in self._children_separator.get(child, []):
+                if v in value_dict:
+                    assignment.append([v, value_dict[v]])
+            self.post_msg(child, DpopValueMessage(assignment), MSG_ALGO)
+        self._select_and_finish(selected, float(cost))
+
+
+def build_computation(comp_def) -> DpopMpComputation:
+    return DpopMpComputation(comp_def)

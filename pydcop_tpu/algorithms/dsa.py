@@ -93,7 +93,9 @@ class DsaSolver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> DsaSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return DsaSolver(arrays, **params)
@@ -110,14 +112,14 @@ computation_memory, communication_load = hypergraph_footprints()
 # multi-machine) runs; the compiled solver is the data plane.
 # ---------------------------------------------------------------------
 
-import random as _random
-
 from ..infrastructure.communication import MSG_ALGO
 from ..infrastructure.computations import (
     SynchronousComputationMixin, VariableComputation, message_type,
     register)
 from ._mp import EPS, best_response, constraint_optima, \
-    has_violated_constraint, sign_for_mode
+    has_violated_constraint, mp_rng, seed_param, sign_for_mode
+
+algo_params = algo_params + [seed_param()]
 
 DsaValueMessage = message_type("dsa_value", ["value"])
 
@@ -144,11 +146,12 @@ class DsaMpComputation(SynchronousComputationMixin, VariableComputation):
         self._optima = constraint_optima(self.constraints, self.mode) \
             if self.variant == "B" else {}
         self._neighbor_values: Dict[str, object] = {}
-        self._rnd = _random.Random()
+        self._rnd = mp_rng(params, self.name)
 
     def on_start(self):
         self.start_cycle()
-        self.random_value_selection()
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
         self.post_to_all_neighbors(
             DsaValueMessage(self.current_value), MSG_ALGO)
         if not self.neighbors:

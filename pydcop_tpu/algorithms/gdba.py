@@ -38,8 +38,9 @@ algo_params = [
 
 class GdbaSolver(LocalSearchSolver):
     def __init__(self, arrays: HypergraphArrays, modifier: str = "A",
-                 violation: str = "NZ", increase_mode: str = "E"):
-        super().__init__(arrays, stop_cycle=0)
+                 violation: str = "NZ", increase_mode: str = "E",
+                 stop_cycle: int = 0):
+        super().__init__(arrays, stop_cycle=stop_cycle)
         self.modifier_mode = modifier
         self.violation_mode = violation
         self.increase_mode = increase_mode
@@ -156,10 +157,237 @@ class GdbaSolver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> GdbaSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return GdbaSolver(arrays, **params)
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: GDBA running ON the agent fabric
+# (reference: gdba.py:189-658).  ok/improve waves as in DBA, but over
+# real costs with per-assignment modifiers: EffCost A/M, IsViolated
+# NZ/NM/MX, IncreaseMode E/R/C/T.
+# ---------------------------------------------------------------------
+
+import itertools as _it
+from typing import Dict
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from . import AlgoParameterDef
+from ._mp import mp_rng, seed_param, sign_for_mode
+
+algo_params = algo_params + [
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    seed_param(),
+]
+
+GdbaOkMessage = message_type("gdba_ok", ["value"])
+GdbaImproveMessage = message_type("gdba_improve", ["improve"])
+
+
+class GdbaMpComputation(SynchronousComputationMixin, VariableComputation):
+    """Generalized DBA on the agent fabric (reference: gdba.py:189-658).
+
+    Each constraint carries per-assignment modifiers (base 0 additive /
+    1 multiplicative); the effective cost of an assignment is
+    ``base (+|*) modifier``, and modifiers of violated constraints grow
+    when nobody in the neighborhood can improve."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.modifier_mode = params.get("modifier", "A")
+        self.violation_mode = params.get("violation", "NZ")
+        self.increase_mode = params.get("increase_mode", "E")
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._rnd = mp_rng(params, self.name)
+        base = 0.0 if self.modifier_mode == "A" else 1.0
+        self._base_modifier = base
+        # per-constraint: {frozenset(assignment.items()): modifier}
+        self._modifiers = [dict() for _ in self.constraints]
+        self._extrema = []
+        for c in self.constraints:
+            m = c.to_matrix().matrix
+            self._extrema.append((float(m.min()), float(m.max())))
+        self._neighbor_values: Dict[str, object] = {}
+        self._neighbor_improves: Dict[str, float] = {}
+        self._my_improve = 0.0
+        self._new_value = None
+        self._current_eval = 0.0
+        self._violated = []
+
+    def on_start(self):
+        self.start_cycle()
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
+        if not self.neighbors:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            GdbaOkMessage(self.current_value), MSG_ALGO)
+
+    def on_fast_forward(self, cycle_id):
+        if cycle_id % 2 == 0:
+            self.post_to_all_neighbors(
+                GdbaOkMessage(self.current_value), MSG_ALGO)
+        else:
+            self.post_to_all_neighbors(GdbaImproveMessage(0.0), MSG_ALGO)
+
+    @register("gdba_ok")
+    def _on_ok(self, sender, msg, t):  # pragma: no cover
+        pass  # rounds are delivered through on_new_cycle
+
+    @register("gdba_improve")
+    def _on_improve(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    def on_new_cycle(self, messages, cycle_id):
+        if cycle_id % 2 == 0:
+            self._ok_phase(messages)
+        else:
+            self._improve_phase(messages)
+
+    # ------------------------------------------------------- internals
+
+    def _scope_assignment(self, c, val):
+        assignment = dict(self._neighbor_values)
+        assignment[self.variable.name] = val
+        return {n: assignment[n] for n in c.scope_names}
+
+    def _eff_cost(self, i, asgt):
+        """base cost combined with the assignment's modifier
+        (reference: gdba.py:576-600)."""
+        c = self.constraints[i]
+        base = c(**asgt)
+        mod = self._modifiers[i].get(
+            frozenset(asgt.items()), self._base_modifier)
+        return base + mod if self.modifier_mode == "A" else base * mod
+
+    def _is_violated(self, i, asgt):
+        """NZ: non-zero cost, NM: above the constraint's own minimum,
+        MX: at its maximum (reference: gdba.py:552-574)."""
+        c = self.constraints[i]
+        cost = c(**asgt)
+        mini, maxi = self._extrema[i]
+        if self.violation_mode == "NZ":
+            return cost != 0
+        if self.violation_mode == "NM":
+            return cost != mini
+        return cost == maxi
+
+    def _eval_value(self, val):
+        """(signed effective cost, violated constraint indices) under
+        the neighbors' values (reference: gdba.py:428-461)."""
+        sign = sign_for_mode(self.mode)
+        total = sign * self.variable.cost_for_val(val)
+        violated = []
+        for i, c in enumerate(self.constraints):
+            asgt = self._scope_assignment(c, val)
+            total += sign * self._eff_cost(i, asgt)
+            if self._is_violated(i, asgt):
+                violated.append(i)
+        return total, violated
+
+    def _ok_phase(self, messages):
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        self._current_eval, self._violated = self._eval_value(
+            self.current_value)
+        best_vals, best_eval = [], None
+        for v in self.variable.domain.values:
+            ev, _ = self._eval_value(v)
+            if best_eval is None or ev < best_eval - 1e-9:
+                best_vals, best_eval = [v], ev
+            elif ev <= best_eval + 1e-9:
+                best_vals.append(v)
+        self._my_improve = self._current_eval - best_eval
+        if self._my_improve > 1e-9:
+            self._new_value = self._rnd.choice(best_vals)
+        else:
+            self._new_value = self.current_value
+        self.post_to_all_neighbors(
+            GdbaImproveMessage(self._my_improve), MSG_ALGO)
+
+    def _improve_phase(self, messages):
+        """Strictly-best improver moves (sorted-name tie-break); if the
+        whole neighborhood is stuck, increase the violated constraints'
+        modifiers per increase_mode (reference: gdba.py:494-550)."""
+        self._neighbor_improves = {
+            sender: float(msg.improve)
+            for sender, (msg, _) in messages.items()}
+        maxi = self._my_improve
+        max_list = [self.name]
+        for n, imp in self._neighbor_improves.items():
+            if imp > maxi + 1e-9:
+                maxi, max_list = imp, [n]
+            elif abs(imp - maxi) <= 1e-9:
+                max_list.append(n)
+        if self._my_improve > 1e-9:
+            if sorted(max_list)[0] == self.name:
+                sign = sign_for_mode(self.mode)
+                self.value_selection(
+                    self._new_value,
+                    sign * (self._current_eval - self._my_improve))
+        elif abs(maxi) <= 1e-9:
+            for i in self._violated:
+                self._increase_modifiers(i)
+
+        self._neighbor_values.clear()
+        self._neighbor_improves.clear()
+        self._violated = []
+        self.new_cycle()
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            GdbaOkMessage(self.current_value), MSG_ALGO)
+
+    def _increase_modifiers(self, i):
+        """E: this assignment only; R: my whole row; C: the column (all
+        neighbor assignments, my value fixed); T: every cell
+        (reference: gdba.py:622-651)."""
+        c = self.constraints[i]
+        if self.increase_mode == "E":
+            self._bump(i, self._scope_assignment(c, self.current_value))
+        elif self.increase_mode == "R":
+            for v in self.variable.domain.values:
+                self._bump(i, self._scope_assignment(c, v))
+        elif self.increase_mode in ("C", "T"):
+            others = [d for d in c.dimensions
+                      if d.name != self.variable.name]
+            for combo in _it.product(
+                    *[list(d.domain.values) for d in others]):
+                asgt = dict(zip([d.name for d in others], combo))
+                if self.increase_mode == "C":
+                    asgt[self.variable.name] = self.current_value
+                    if self.variable.name not in c.scope_names:
+                        asgt.pop(self.variable.name)
+                    self._bump(i, asgt)
+                else:
+                    for v in self.variable.domain.values:
+                        full = dict(asgt)
+                        if self.variable.name in c.scope_names:
+                            full[self.variable.name] = v
+                        self._bump(i, full)
+        else:  # pragma: no cover - validated by algo_params
+            raise ValueError(self.increase_mode)
+
+    def _bump(self, i, asgt):
+        key = frozenset(asgt.items())
+        self._modifiers[i][key] = self._modifiers[i].get(
+            key, self._base_modifier) + 1.0
+
+
+def build_computation(comp_def) -> GdbaMpComputation:
+    return GdbaMpComputation(comp_def)

@@ -208,6 +208,137 @@ def rebuild(dcop: DCOP, solver: DynamicMaxSumSolver, state,
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> DynamicMaxSumSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = FactorGraphArrays.build(dcop, variables, constraints)
     return DynamicMaxSumSolver(arrays, **params)
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: dynamic MaxSum computations ON the agent
+# fabric (reference: maxsum_dynamic.py:40-405).  The reference ships
+# three factor computation classes meant to be subclassed by
+# applications; their fabric equivalents here build on the asynchronous
+# amaxsum backend so a deployed dynamic system exchanges the same
+# amaxsum_costs messages, plus the dynamic control messages
+# (VARIABLE_VALUE / ADD / REMOVE).
+# ---------------------------------------------------------------------
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import Message, register
+from .amaxsum import AMaxSumFactorMpComputation, \
+    AMaxSumVariableMpComputation
+
+
+class DynamicFunctionFactorMpComputation(AMaxSumFactorMpComputation):
+    """Factor whose cost function can be swapped mid-run, dimensions
+    unchanged (reference: maxsum_dynamic.py:40-110)."""
+
+    def change_factor_function(self, factor):
+        """Swap in a new factor with identical dimensions and replay the
+        marginals (reference: maxsum_dynamic.py:80-105)."""
+        old_names = [v.name for v in self.variables]
+        new_names = [v.name for v in factor.dimensions]
+        if set(old_names) != set(new_names):
+            raise ValueError(
+                f"change_factor_function requires identical dimensions; "
+                f"got {new_names}, had {old_names}")
+        self.factor = factor
+        self.variables = list(factor.dimensions)
+        self._load_cube()
+        # previous send history no longer describes the new function
+        self._r_sent.clear()
+        self._same_sent.clear()
+        if self.is_running:
+            self._send_marginals()
+
+
+class FactorWithReadOnlyVariableMpComputation(
+        DynamicFunctionFactorMpComputation):
+    """Factor conditioned on external (sensor) variables: subscribes to
+    their publishing computations and re-slices its cube on every
+    VARIABLE_VALUE publication (reference: maxsum_dynamic.py:113-187)."""
+
+    def __init__(self, comp_def, read_only_variables=()):
+        super().__init__(comp_def)
+        self.read_only_variables = list(read_only_variables)
+        self._external_values = {}
+        self._full_factor = self.factor
+        # decision variables = dimensions minus the read-only ones
+        ro_names = {v.name for v in self.read_only_variables}
+        self.variables = [v for v in self.factor.dimensions
+                          if v.name not in ro_names]
+
+    def on_start(self):
+        for v in self.read_only_variables:
+            self.post_msg(v.name, Message("SUBSCRIBE", self.name),
+                          MSG_ALGO)
+        super().on_start()
+
+    @register("VARIABLE_VALUE")
+    def _on_variable_value(self, sender, msg, t):
+        self._external_values[sender] = msg.content
+        if len(self._external_values) < len(self.read_only_variables):
+            return
+        sliced = self._full_factor.slice(dict(self._external_values))
+        self.factor = sliced
+        self.variables = list(sliced.dimensions)
+        self._load_cube()
+        self._r_sent.clear()
+        self._same_sent.clear()
+        if self.is_running:
+            self._send_marginals()
+
+
+class DynamicFactorMpComputation(DynamicFunctionFactorMpComputation):
+    """Factor whose *dimensions* may change: on a function swap with a
+    different scope, departed variables get REMOVE, joining ones ADD
+    (reference: maxsum_dynamic.py:188-350)."""
+
+    def change_factor_function(self, factor):
+        old = {v.name for v in self.variables}
+        new = {v.name for v in factor.dimensions}
+        self.factor = factor
+        self.variables = list(factor.dimensions)
+        self._load_cube()
+        self._q = {k: v for k, v in self._q.items() if k in new}
+        self._r_sent.clear()
+        self._same_sent.clear()
+        for name in sorted(old - new):
+            self.post_msg(name, Message("REMOVE", self.name), MSG_ALGO)
+        for name in sorted(new - old):
+            self.post_msg(name, Message("ADD", self.name), MSG_ALGO)
+        if self.is_running:
+            self._send_marginals()
+
+
+class DynamicFactorVariableMpComputation(AMaxSumVariableMpComputation):
+    """Variable that tracks factor ADD/REMOVE notifications
+    (reference: maxsum_dynamic.py:352-405)."""
+
+    @register("REMOVE")
+    def _on_remove(self, sender, msg, t):
+        if sender in self.factor_names:
+            self.factor_names.remove(sender)
+        self._r.pop(sender, None)
+        self._q_sent.pop(sender, None)
+        self._same_sent.pop(sender, None)
+        if self.is_running:
+            self._select()
+
+    @register("ADD")
+    def _on_add(self, sender, msg, t):
+        if sender not in self.factor_names:
+            self.factor_names.append(sender)
+        if self.is_running:
+            self._send_all()
+
+
+def build_computation(comp_def):
+    """Deploy dynamic-capable computations: amaxsum messaging plus the
+    dynamic control protocol (the reference's classes are meant to be
+    subclassed by applications; these are directly deployable)."""
+    if hasattr(comp_def.node, "variable"):
+        return DynamicFactorVariableMpComputation(comp_def)
+    return DynamicFactorMpComputation(comp_def)

@@ -70,7 +70,9 @@ class MgmSolver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> MgmSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return MgmSolver(arrays, **params)
@@ -87,13 +89,14 @@ computation_memory, communication_load = hypergraph_footprints()
 # orchestrated runs; the compiled solver above is the data plane.
 # ---------------------------------------------------------------------
 
-import random as _random
-
 from ..infrastructure.communication import MSG_ALGO
 from ..infrastructure.computations import (
     SynchronousComputationMixin, VariableComputation, message_type,
     register)
-from ._mp import EPS, best_response, local_cost, sign_for_mode
+from ._mp import EPS, best_response, local_cost, mp_rng, seed_param, \
+    sign_for_mode
+
+algo_params = algo_params + [seed_param()]
 
 MgmValueMessage = message_type("mgm_value", ["value"])
 #: priority carries the sender's tie-break token: the random draw for
@@ -117,11 +120,12 @@ class MgmMpComputation(SynchronousComputationMixin, VariableComputation):
         self._gain = 0.0
         self._candidate = None
         self._priority = 0.0
-        self._rnd = _random.Random()
+        self._rnd = mp_rng(params, self.name)
 
     def on_start(self):
         self.start_cycle()
-        self.random_value_selection()
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
         self.post_to_all_neighbors(
             MgmValueMessage(self.current_value), MSG_ALGO)
         if not self.neighbors:

@@ -241,10 +241,372 @@ class Mgm2Solver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> Mgm2Solver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return Mgm2Solver(arrays, **params)
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: MGM-2 running ON the agent fabric
+# (reference: mgm2.py:435-1062).  The reference's five waiting states —
+# value / offer / answer? / gain / go? — with per-state postponed-message
+# queues become five sync-mixin sub-cycles per MGM-2 iteration: the
+# mixin's round barrier replaces the manual postponing, and states that
+# only involve a subset of agents (answer? for offerers, go? for
+# committed pairs) ride the mixin's automatic SynchronizationMsg fill.
+# ---------------------------------------------------------------------
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from ._mp import EPS, best_response, constraints_cost, local_cost, \
+    mp_rng, seed_param, sign_for_mode
+
+algo_params = algo_params + [seed_param()]
+
+Mgm2ValueMessage = message_type("mgm2_value", ["value"])
+#: offers: list of [my_value, partner_value, gain] triples (a list, not a
+#: tuple-keyed dict as in the reference: JSON can't carry tuple keys
+#: across processes); gain is in signed (minimizing) space
+Mgm2OfferMessage = message_type("mgm2_offer", ["offers", "is_offering"])
+Mgm2ResponseMessage = message_type("mgm2_response",
+                                   ["accept", "value", "gain"])
+Mgm2GainMessage = message_type("mgm2_gain", ["gain"])
+Mgm2GoMessage = message_type("mgm2_go", ["go"])
+
+#: sub-cycle roles within one MGM-2 iteration
+_PHASE_VALUE, _PHASE_OFFER, _PHASE_RESPONSE, _PHASE_GAIN, _PHASE_GO = \
+    range(5)
+
+
+class Mgm2MpComputation(SynchronousComputationMixin, VariableComputation):
+    """MGM-2 on the agent fabric (reference: mgm2.py:435-1062).
+
+    One MGM-2 iteration = five mixin sub-cycles:
+
+    0. value    — everyone announces its value,
+    1. offer    — offerers (drawn with prob. ``threshold``) send their
+                  coordinated-move offers to one random partner; everyone
+                  else receives empty offers (reference sends explicit
+                  empty offer messages, mgm2.py:763-770),
+    2. response — non-offerers accept/reject the offers they received,
+    3. gain     — everyone announces its potential gain (coordinated
+                  gain for committed pairs, unilateral otherwise),
+    4. go       — committed pairs confirm/cancel the coordinated move.
+
+    All gains travel in signed (minimizing) space, so min/max modes share
+    one comparison; the reference's mode-conditional branches
+    (mgm2.py:838-847) collapse.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.threshold = float(params.get("threshold", 0.5))
+        self.favor = params.get("favor", "unilateral")
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._rnd = mp_rng(params, self.name)
+        self._neighbor_values: Dict[str, object] = {}
+        self._neighbor_gains: Dict[str, float] = {}
+        self._offers_recv = []  # (sender, offers, is_offering)
+        self._partner: Optional[str] = None
+        self._is_offerer = False
+        self._committed = False
+        self._can_move = False
+        self._potential_gain = 0.0  # signed space: positive = improves
+        self._potential_value = None
+        self._current_signed = 0.0
+
+    # ------------------------------------------------------- lifecycle
+
+    def on_start(self):
+        self.start_cycle()
+        if not self.neighbors:
+            _, best, cost = best_response(
+                self.variable, self.constraints, {}, None, self.mode,
+                rnd=self._rnd)
+            self.value_selection(best, cost)
+            self.finished()
+            return
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
+        self.post_to_all_neighbors(
+            Mgm2ValueMessage(self.current_value), MSG_ALGO)
+
+    def on_fast_forward(self, cycle_id):
+        # rejoin after repair re-deploy: re-announce what this sub-cycle
+        # carries; our own protocol state restarts from a clean slate
+        self._clear_iteration()
+        phase = cycle_id % 5
+        if phase == _PHASE_VALUE:
+            self.post_to_all_neighbors(
+                Mgm2ValueMessage(self.current_value), MSG_ALGO)
+        elif phase == _PHASE_OFFER:
+            self.post_to_all_neighbors(
+                Mgm2OfferMessage([], False), MSG_ALGO)
+        elif phase == _PHASE_GAIN:
+            self.post_to_all_neighbors(Mgm2GainMessage(0.0), MSG_ALGO)
+        # response / go sub-cycles: nothing to re-announce, the mixin's
+        # sync fill closes the round for our neighbors
+
+    @register("mgm2_value")
+    def _on_value(self, sender, msg, t):  # pragma: no cover
+        pass  # rounds are delivered through on_new_cycle
+
+    @register("mgm2_offer")
+    def _on_offer(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    @register("mgm2_response")
+    def _on_response(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    @register("mgm2_gain")
+    def _on_gain(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    @register("mgm2_go")
+    def _on_go(self, sender, msg, t):  # pragma: no cover
+        pass
+
+    def on_new_cycle(self, messages, cycle_id):
+        phase = cycle_id % 5
+        if phase == _PHASE_VALUE:
+            self._value_phase(messages)
+        elif phase == _PHASE_OFFER:
+            self._offer_phase(messages)
+        elif phase == _PHASE_RESPONSE:
+            self._response_phase(messages)
+        elif phase == _PHASE_GAIN:
+            self._gain_phase(messages)
+        else:
+            self._go_phase(messages)
+
+    # ---------------------------------------------------------- phases
+
+    def _value_phase(self, messages):
+        """Collect values; draw offerer role; send offers (empty for
+        non-partners); compute best unilateral move
+        (reference: mgm2.py:734-786)."""
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        sign = sign_for_mode(self.mode)
+        assignment = dict(self._neighbor_values)
+        assignment[self.variable.name] = self.current_value
+        self._current_signed = sign * local_cost(
+            self.variable, self.constraints, assignment)
+
+        self._is_offerer = self._rnd.random() < self.threshold
+        if self._is_offerer:
+            self._partner = self._rnd.choice(sorted(self.neighbors))
+        for n in self.neighbors:
+            if self._is_offerer and n == self._partner:
+                self.post_msg(n, Mgm2OfferMessage(
+                    self._compute_offers(), True), MSG_ALGO)
+            else:
+                self.post_msg(n, Mgm2OfferMessage([], False), MSG_ALGO)
+
+        cur, best, best_cost = best_response(
+            self.variable, self.constraints, self._neighbor_values,
+            self.current_value, self.mode, rnd=self._rnd)
+        gain = sign * (cur - best_cost) if cur is not None else 0.0
+        if gain > EPS:
+            self._potential_gain = gain
+            self._potential_value = best
+        else:
+            self._potential_gain = 0.0
+            self._potential_value = self.current_value
+
+    def _compute_offers(self):
+        """All coordinated (my_value, partner_value) moves improving my
+        own neighborhood, with their signed gain
+        (reference: mgm2.py:520-553)."""
+        sign = sign_for_mode(self.mode)
+        partner_domain = self._partner_domain()
+        offers = []
+        for my_val in self.variable.domain.values:
+            for p_val in partner_domain:
+                assignment = dict(self._neighbor_values)
+                assignment[self.variable.name] = my_val
+                assignment[self._partner] = p_val
+                signed = sign * local_cost(
+                    self.variable, self.constraints, assignment)
+                gain = self._current_signed - signed
+                if gain > EPS:
+                    offers.append([my_val, p_val, gain])
+        return offers
+
+    def _partner_domain(self):
+        for c in self.constraints:
+            for v in c.dimensions:
+                if v.name == self._partner:
+                    return list(v.domain.values)
+        # partner shares no constraint with us (cannot happen for
+        # hypergraph neighbors): no coordinated move to propose
+        return []
+
+    def _offer_phase(self, messages):
+        """Non-offerers pick the best received offer and answer every
+        offerer; offerers reject any offer they received
+        (reference: mgm2.py:787-856)."""
+        self._offers_recv = [
+            (sender, msg.offers, msg.is_offering)
+            for sender, (msg, _) in messages.items()]
+        if self._is_offerer:
+            for sender, _, is_offering in self._offers_recv:
+                if is_offering:
+                    self.post_msg(sender, Mgm2ResponseMessage(
+                        False, None, 0.0), MSG_ALGO)
+            self.sync_neighbors()
+            return
+
+        best_offers, best_gain = self._find_best_offer()
+        self._committed = False
+        accepted_val = None
+        if best_offers and best_gain > EPS:
+            if best_gain > self._potential_gain + EPS:
+                self._committed = True
+            elif abs(best_gain - self._potential_gain) <= EPS:
+                if self.favor == "coordinated":
+                    self._committed = True
+                elif self.favor == "no" and self._rnd.random() > 0.5:
+                    self._committed = True
+        if self._committed:
+            p_val, my_val, partner = self._rnd.choice(best_offers)
+            accepted_val = p_val
+            self._potential_value = my_val
+            self._potential_gain = best_gain
+            self._partner = partner
+        for sender, _, is_offering in self._offers_recv:
+            if not is_offering:
+                continue
+            if self._committed and sender == self._partner:
+                self.post_msg(sender, Mgm2ResponseMessage(
+                    True, accepted_val, best_gain), MSG_ALGO)
+            else:
+                self.post_msg(sender, Mgm2ResponseMessage(
+                    False, None, 0.0), MSG_ALGO)
+        self.sync_neighbors()
+
+    def _find_best_offer(self):
+        """Best global gain over all received offers: my local gain over
+        the constraints not shared with the offerer, plus the offerer's
+        announced local gain (reference: mgm2.py:555-603)."""
+        sign = sign_for_mode(self.mode)
+        bests, best_gain = [], 0.0
+        for sender, offers, is_offering in self._offers_recv:
+            if not is_offering:
+                continue
+            # constraints not involving the offerer: their cost change is
+            # mine alone; shared constraints ride the offerer's gain
+            not_shared = [
+                c for c in self.constraints
+                if sender not in c.scope_names]
+            for p_val, my_val, partner_gain in offers:
+                assignment = dict(self._neighbor_values)
+                assignment[sender] = p_val
+                assignment[self.variable.name] = my_val
+                unary = self.variable.cost_for_val(my_val)
+                signed = sign * (
+                    constraints_cost(not_shared, assignment) + unary)
+                global_gain = (
+                    self._current_signed - signed) + float(partner_gain)
+                if global_gain > best_gain + EPS:
+                    bests = [(p_val, my_val, sender)]
+                    best_gain = global_gain
+                elif abs(global_gain - best_gain) <= EPS and bests:
+                    bests.append((p_val, my_val, sender))
+        return bests, best_gain
+
+    def _response_phase(self, messages):
+        """Offerers learn their partner's verdict; everyone announces
+        its gain (reference: mgm2.py:857-888)."""
+        if self._is_offerer:
+            self._committed = False
+            for sender, (msg, _) in messages.items():
+                if sender == self._partner and msg.accept:
+                    self._potential_value = msg.value
+                    self._potential_gain = float(msg.gain)
+                    self._committed = True
+        self.post_to_all_neighbors(
+            Mgm2GainMessage(self._potential_gain), MSG_ALGO)
+
+    def _gain_phase(self, messages):
+        """Committed pairs check the neighborhood and confirm with go
+        messages; everyone else applies the MGM unilateral rule
+        (reference: mgm2.py:889-968)."""
+        for sender, (msg, _) in messages.items():
+            self._neighbor_gains[sender] = float(msg.gain)
+
+        if self._potential_gain <= EPS:
+            self._can_move = False
+            self.sync_neighbors()
+            return  # nothing to move this iteration; go sub-cycle idles
+
+        if self._committed:
+            others = [g for n, g in self._neighbor_gains.items()
+                      if n != self._partner]
+            self._can_move = not others or \
+                self._potential_gain > max(others) + EPS
+            self.post_msg(self._partner,
+                          Mgm2GoMessage(bool(self._can_move)), MSG_ALGO)
+            self.sync_neighbors()
+            return
+
+        self._can_move = False
+        gains = self._neighbor_gains
+        max_gain = max(gains.values()) if gains else 0.0
+        if self._potential_gain > max_gain + EPS:
+            self._move_unilateral()
+        elif abs(self._potential_gain - max_gain) <= EPS:
+            ties = sorted(
+                [n for n, g in gains.items()
+                 if abs(g - max_gain) <= EPS] + [self.name])
+            if ties[0] == self.name:
+                self._move_unilateral()
+        self.sync_neighbors()
+
+    def _move_unilateral(self):
+        sign = sign_for_mode(self.mode)
+        self.value_selection(
+            self._potential_value,
+            sign * (self._current_signed - self._potential_gain))
+
+    def _go_phase(self, messages):
+        """Coordinated move happens iff both pair members said go
+        (reference: mgm2.py:969-1006); iteration closes, values go out
+        for the next one."""
+        for sender, (msg, _) in messages.items():
+            if sender == self._partner and msg.go and self._can_move:
+                self._move_unilateral()
+        self.new_cycle()
+        self._clear_iteration()
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            Mgm2ValueMessage(self.current_value), MSG_ALGO)
+
+    def _clear_iteration(self):
+        self._neighbor_values.clear()
+        self._neighbor_gains.clear()
+        self._offers_recv = []
+        self._partner = None
+        self._is_offerer = False
+        self._committed = False
+        self._can_move = False
+        self._potential_gain = 0.0
+        self._potential_value = None
+
+
+def build_computation(comp_def) -> Mgm2MpComputation:
+    return Mgm2MpComputation(comp_def)

@@ -102,10 +102,158 @@ class MixedDsaSolver(LocalSearchSolver):
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> MixedDsaSolver:
-    params = params or {}
+    from ._mp import engine_params
+
+    params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
                                     constraints)
     return MixedDsaSolver(arrays, **params)
 
 
 computation_memory, communication_load = hypergraph_footprints()
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: MixedDSA running ON the agent fabric
+# (reference: mixeddsa.py:154-476).  One value sub-cycle per iteration
+# like DSA; the move rule ranks candidates by (violated hard
+# constraints, soft cost) and uses proba_hard / proba_soft depending on
+# which tier improves.
+# ---------------------------------------------------------------------
+
+import math as _math
+from typing import Dict as _DictT
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    SynchronousComputationMixin, VariableComputation, message_type,
+    register)
+from ._mp import EPS, mp_rng, seed_param, sign_for_mode
+
+algo_params = algo_params + [seed_param()]
+
+MixedDsaValueMessage = message_type("mixed_dsa_value", ["value"])
+
+
+class MixedDsaMpComputation(SynchronousComputationMixin,
+                            VariableComputation):
+    """MixedDSA on the agent fabric (reference: mixeddsa.py:154-476).
+    Hard constraints are those whose cost table contains an infinite
+    entry (reference: mixeddsa.py:203-225); candidates are ranked by
+    violated-hard count first, soft cost second."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.mode = comp_def.algo.mode
+        self.variant = params.get("variant", "B")
+        self.proba_hard = float(params.get("proba_hard", 0.7))
+        self.proba_soft = float(params.get("proba_soft", 0.5))
+        self.stop_cycle = int(params.get("stop_cycle", 0) or 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._rnd = mp_rng(params, self.name)
+        self.hard_constraints = []
+        self.soft_constraints = []
+        for c in self.constraints:
+            m = c.to_matrix().matrix
+            if _math.isinf(float(abs(m).max())) or \
+                    float(abs(m).max()) >= _HARD_THRESH:
+                self.hard_constraints.append(c)
+            else:
+                self.soft_constraints.append(c)
+        self._neighbor_values: _DictT[str, object] = {}
+
+    def on_start(self):
+        self.start_cycle()
+        self.value_selection(
+            self._rnd.choice(list(self.variable.domain.values)))
+        if not self.neighbors:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            MixedDsaValueMessage(self.current_value), MSG_ALGO)
+
+    def on_fast_forward(self, cycle_id):
+        self.post_to_all_neighbors(
+            MixedDsaValueMessage(self.current_value), MSG_ALGO)
+
+    @register("mixed_dsa_value")
+    def _on_value(self, sender, msg, t):  # pragma: no cover
+        pass  # rounds are delivered through on_new_cycle
+
+    def _tier_cost(self, val):
+        """(violated hard count, signed soft cost) for ``val`` under the
+        neighbors' values (reference: mixeddsa.py:410-447)."""
+        sign = sign_for_mode(self.mode)
+        assignment = dict(self._neighbor_values)
+        assignment[self.variable.name] = val
+        violated = 0
+        for c in self.hard_constraints:
+            scope = c.scope_names
+            if all(n in assignment for n in scope):
+                cost = c(**{n: assignment[n] for n in scope})
+                if _math.isinf(cost) or abs(cost) >= _HARD_THRESH:
+                    violated += 1
+        soft = sign * self.variable.cost_for_val(val)
+        for c in self.soft_constraints:
+            scope = c.scope_names
+            if all(n in assignment for n in scope):
+                soft += sign * c(**{n: assignment[n] for n in scope})
+        return violated, soft
+
+    def on_new_cycle(self, messages, cycle_id):
+        for sender, (msg, _) in messages.items():
+            self._neighbor_values[sender] = msg.value
+        self.new_cycle()
+
+        cur_violated, cur_soft = self._tier_cost(self.current_value)
+        best_vals, best_violated, best_soft = [], None, None
+        for v in self.variable.domain.values:
+            violated, soft = self._tier_cost(v)
+            if best_violated is None or violated < best_violated or (
+                    violated == best_violated
+                    and soft < best_soft - EPS):
+                best_vals = [v]
+                best_violated, best_soft = violated, soft
+            elif violated == best_violated and \
+                    abs(soft - best_soft) <= EPS:
+                best_vals.append(v)
+
+        delta_hard = cur_violated - best_violated
+        delta_soft = cur_soft - best_soft
+        sign = sign_for_mode(self.mode)
+        if delta_hard > 0:
+            if self._rnd.random() < self.proba_hard:
+                self.value_selection(self._rnd.choice(best_vals),
+                                     sign * best_soft)
+        elif delta_hard == 0:
+            if delta_soft > EPS:
+                if self._rnd.random() < self.proba_soft:
+                    self.value_selection(self._rnd.choice(best_vals),
+                                         sign * best_soft)
+            elif self.variant in ("B", "C") and cur_violated > 0 and \
+                    len(best_vals) > 1:
+                # stuck with conflicts: sideways move to escape
+                # (reference: mixeddsa.py:320-341)
+                others = [v for v in best_vals
+                          if v != self.current_value]
+                if others and self._rnd.random() < self.proba_hard:
+                    self.value_selection(self._rnd.choice(others),
+                                         sign * best_soft)
+            elif self.variant == "C" and len(best_vals) > 1:
+                others = [v for v in best_vals
+                          if v != self.current_value]
+                if others and self._rnd.random() < min(self.proba_hard,
+                                                       self.proba_soft):
+                    self.value_selection(self._rnd.choice(others),
+                                         sign * best_soft)
+
+        if self.stop_cycle and self._cycle_count >= self.stop_cycle:
+            self.finished()
+            return
+        self.post_to_all_neighbors(
+            MixedDsaValueMessage(self.current_value), MSG_ALGO)
+
+
+def build_computation(comp_def) -> MixedDsaMpComputation:
+    return MixedDsaMpComputation(comp_def)

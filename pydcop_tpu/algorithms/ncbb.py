@@ -183,3 +183,133 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
         status=status,
         metrics={"expansions": stats["expansions"]},
     )
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: NCBB running ON the agent fabric
+# (reference: ncbb.py:137-350).  The reference implements only NCBB's
+# initialization phase — greedy top-down value propagation and
+# bottom-up subtree cost aggregation; its search phase is an empty stub
+# (ncbb.py:337-350).  This backend completes the same INIT phase and
+# terminates cleanly with the greedy solution: the root broadcasts a
+# stop wave once it knows the full subtree cost (where the reference's
+# computations would hang forever, never reporting finished).
+# ---------------------------------------------------------------------
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    VariableComputation, message_type, register)
+
+NcbbValueMessage = message_type("ncbb_value", ["value"])
+NcbbCostMessage = message_type("ncbb_cost", ["cost"])
+NcbbStopMessage = message_type("ncbb_stop", ["bound"])
+
+
+class NcbbMpComputation(VariableComputation):
+    """One NCBB variable on the agent fabric (reference: ncbb.py:137-335).
+    Works in signed (minimizing) space."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        node = comp_def.node
+        self.mode = comp_def.algo.mode
+        self._sign = 1.0 if self.mode != "max" else -1.0
+        self.parent = node.parent
+        self.children = list(node.children)
+        self.ancestors = list(node.pseudo_parents) + \
+            ([node.parent] if node.parent else [])
+        self.descendants = list(node.pseudo_children) + self.children
+        self.constraints = list(node.constraints)
+        self._parents_values: Dict[str, object] = {}
+        self._children_costs: Dict[str, float] = {}
+        self._subtree_cost = 0.0
+
+    @property
+    def is_root(self):
+        return self.parent is None
+
+    @property
+    def is_leaf(self):
+        return not self.children
+
+    def on_start(self):
+        if not self.is_root:
+            return
+        # root: free greedy choice, kicked down the tree
+        # (reference: ncbb.py:218-227)
+        best_val, best_cost = None, None
+        for v in self.variable.domain.values:
+            cost = self._sign * self.variable.cost_for_val(v)
+            if best_cost is None or cost < best_cost:
+                best_val, best_cost = v, cost
+        self.value_selection(best_val, self._sign * best_cost)
+        self._subtree_cost = best_cost
+        for d in self.descendants:
+            self.post_msg(d, NcbbValueMessage(self.current_value),
+                          MSG_ALGO)
+        if self.is_leaf and not self.descendants:
+            self.finished()
+
+    @register("ncbb_value")
+    def _on_value(self, sender, msg, t):
+        """Greedy selection once every ancestor's value arrived
+        (reference: ncbb.py:252-296)."""
+        self._parents_values[sender] = msg.value
+        if len(self._parents_values) < len(self.ancestors):
+            return
+        best_val, best_cost = None, None
+        for v in self.variable.domain.values:
+            assignment = dict(self._parents_values)
+            assignment[self.name] = v
+            cost = self._sign * self.variable.cost_for_val(v)
+            for c in self.constraints:
+                scope = c.scope_names
+                if all(n in assignment for n in scope):
+                    cost += self._sign * c(
+                        **{n: assignment[n] for n in scope})
+            if best_cost is None or cost < best_cost:
+                best_val, best_cost = v, cost
+        self.value_selection(best_val, self._sign * best_cost)
+        self._subtree_cost = best_cost
+        if not self.is_leaf:
+            for d in self.descendants:
+                self.post_msg(d, NcbbValueMessage(self.current_value),
+                              MSG_ALGO)
+        else:
+            # leaves start the cost wave (to the tree parent only: the
+            # reference posts to every ancestor and would reject the
+            # pseudo-parent copies, ncbb.py:290-296,302-310)
+            if self.parent:
+                self.post_msg(self.parent, NcbbCostMessage(best_cost),
+                              MSG_ALGO)
+            self.finished()
+
+    @register("ncbb_cost")
+    def _on_cost(self, sender, msg, t):
+        """Aggregate children subtree costs (reference: ncbb.py:298-330).
+        """
+        self._children_costs[sender] = float(msg.cost)
+        if len(self._children_costs) < len(self.children):
+            return
+        self._subtree_cost += sum(self._children_costs.values())
+        if not self.is_root:
+            self.post_msg(self.parent,
+                          NcbbCostMessage(self._subtree_cost), MSG_ALGO)
+            self.finished()
+        else:
+            # INIT complete: the greedy bound is known, stop the tree
+            self.value_selection(self.current_value,
+                                 self._sign * self._subtree_cost)
+            for d in self.descendants:
+                self.post_msg(d, NcbbStopMessage(self._subtree_cost),
+                              MSG_ALGO)
+            self.finished()
+
+    @register("ncbb_stop")
+    def _on_stop(self, sender, msg, t):
+        for d in self.descendants:
+            self.post_msg(d, NcbbStopMessage(msg.bound), MSG_ALGO)
+
+
+def build_computation(comp_def) -> NcbbMpComputation:
+    return NcbbMpComputation(comp_def)

@@ -155,3 +155,188 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
         status=status,
         metrics={"msg_count": msg_count},
     )
+
+
+# ---------------------------------------------------------------------
+# Message-passing backend: SyncBB running ON the agent fabric
+# (reference: syncbb.py:150-512).  A Current Partial Assignment token
+# walks the variable chain: forward messages extend it, backward
+# messages backtrack, terminate carries the optimum to every node.
+# ---------------------------------------------------------------------
+
+from ..infrastructure.communication import MSG_ALGO
+from ..infrastructure.computations import (
+    VariableComputation, message_type, register)
+
+INFINITY = float("inf")
+
+#: current_path: [[var, value, cost], ...]
+SyncBBForwardMessage = message_type("syncbb_forward",
+                                    ["current_path", "ub"])
+#: best: [[var, value], ...] full assignment achieving ub (the
+#: reference's backward carries only the bound, syncbb.py:355-370, and
+#: leaves middle variables on stale values at termination)
+SyncBBBackwardMessage = message_type("syncbb_backward",
+                                     ["current_path", "ub", "best"])
+#: assignment: [[var, value], ...] of the best full path found (the
+#: reference's terminate message carries nothing and leaves middle
+#: variables on their last backward-improved value, syncbb.py:211-229;
+#: carrying the optimum assigns every variable exactly)
+SyncBBTerminateMessage = message_type("syncbb_terminate",
+                                      ["assignment", "ub"])
+
+
+class SyncBBMpComputation(VariableComputation):
+    """One variable of the SyncBB chain (reference: syncbb.py:175-415).
+    Works in signed (minimizing) space: max problems negate costs."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        node = comp_def.node
+        self.mode = comp_def.algo.mode
+        self.constraints = list(node.constraints)
+        self.next_var = node.next_node
+        self.previous_var = node.previous_node
+        self.upper_bound = INFINITY
+        self._best_assignment = None
+        self._sign = 1.0 if self.mode != "max" else -1.0
+
+    def on_start(self):
+        if self.previous_var is None:
+            if self.next_var is None:
+                # single-variable problem: optimize locally
+                best_val, best_cost = None, INFINITY
+                for v in self.variable.domain.values:
+                    cost = self._sign * self.variable.cost_for_val(v)
+                    if cost < best_cost:
+                        best_val, best_cost = v, cost
+                self.value_selection(best_val, self._sign * best_cost)
+                self.finished()
+                return
+            first = self.variable.domain.values[0]
+            # include our unary cost so the path bound stays exact (the
+            # reference seeds with 0, syncbb.py:203, and loses unary
+            # costs of the first variable)
+            path = [[self.name, first,
+                     self._path_cost_for(first, [])]]
+            self.post_msg(self.next_var,
+                          SyncBBForwardMessage(path, None), MSG_ALGO)
+
+    # ------------------------------------------------------- helpers
+
+    def _path_cost_for(self, candidate, current_path):
+        """Signed cost this variable adds to the path by taking
+        ``candidate`` (reference: syncbb.py:420-474), with upper-bound
+        pruning."""
+        assignment = {var: val for var, val, _ in current_path}
+        assignment[self.name] = candidate
+        cost = self._sign * self.variable.cost_for_val(candidate)
+        for c in self.constraints:
+            scope = c.scope_names
+            if all(n in assignment for n in scope):
+                cost += self._sign * c(
+                    **{n: assignment[n] for n in scope})
+        return cost
+
+    def _next_assignment(self, current_value, current_path):
+        """First domain value after ``current_value`` whose path cost
+        keeps the partial assignment under the upper bound."""
+        values = list(self.variable.domain.values)
+        if current_value is not None:
+            idx = values.index(current_value) + 1
+            values = values[idx:]
+        path_bound = sum(c for _, _, c in current_path)
+        for candidate in values:
+            cost = self._path_cost_for(candidate, current_path)
+            if path_bound + cost < self.upper_bound:
+                return candidate, cost
+        return None
+
+    def _terminate(self):
+        assignment = self._best_assignment or []
+        for var, val in assignment:
+            if var == self.name:
+                self.value_selection(val, self._sign * self.upper_bound)
+        if self.next_var is not None:
+            self.post_msg(self.next_var, SyncBBTerminateMessage(
+                assignment, self.upper_bound), MSG_ALGO)
+        self.finished()
+
+    # ------------------------------------------------------ handlers
+
+    @register("syncbb_terminate")
+    def _on_terminate(self, sender, msg, t):
+        self.upper_bound = msg.ub
+        self._best_assignment = msg.assignment
+        self._terminate()
+
+    @register("syncbb_forward")
+    def _on_forward(self, sender, msg, t):
+        current_path = [list(e) for e in msg.current_path]
+        if msg.ub is not None and msg.ub < self.upper_bound:
+            self.upper_bound = msg.ub
+        nxt = self._next_assignment(None, current_path)
+        if nxt is None:
+            if self.previous_var is None:
+                self._terminate()
+            else:
+                self.post_msg(self.previous_var, SyncBBBackwardMessage(
+                    current_path, self.upper_bound,
+                    self._best_assignment), MSG_ALGO)
+            self.new_cycle()
+            return
+        if self.next_var is None:
+            # last in the chain: sweep the whole domain for new bounds
+            # (reference: syncbb.py:283-330)
+            path_bound = sum(c for _, _, c in current_path)
+            value, cost = nxt
+            while True:
+                if path_bound + cost < self.upper_bound:
+                    self.upper_bound = path_bound + cost
+                    self._best_assignment = [
+                        [var, val] for var, val, _ in current_path
+                    ] + [[self.name, value]]
+                    self.value_selection(value,
+                                         self._sign * self.upper_bound)
+                nxt = self._next_assignment(value, current_path)
+                if nxt is None:
+                    break
+                value, cost = nxt
+            self.post_msg(self.previous_var, SyncBBBackwardMessage(
+                current_path, self.upper_bound,
+                self._best_assignment), MSG_ALGO)
+        else:
+            value, cost = nxt
+            new_path = current_path + [[self.name, value, cost]]
+            self.post_msg(self.next_var, SyncBBForwardMessage(
+                new_path, self.upper_bound), MSG_ALGO)
+        self.new_cycle()
+
+    @register("syncbb_backward")
+    def _on_backward(self, sender, msg, t):
+        current_path = [list(e) for e in msg.current_path]
+        if msg.ub < self.upper_bound or (
+                msg.ub == self.upper_bound
+                and self._best_assignment is None):
+            self.upper_bound = msg.ub
+            if msg.best is not None:
+                self._best_assignment = msg.best
+        var, val, _ = current_path[-1]
+        nxt = self._next_assignment(val, current_path[:-1])
+        if nxt is not None:
+            new_val, new_cost = nxt
+            new_path = current_path[:-1] + [[self.name, new_val,
+                                             new_cost]]
+            self.post_msg(self.next_var, SyncBBForwardMessage(
+                new_path, self.upper_bound), MSG_ALGO)
+        elif self.previous_var is None:
+            self._terminate()
+        else:
+            self.post_msg(self.previous_var, SyncBBBackwardMessage(
+                current_path[:-1], self.upper_bound,
+                self._best_assignment), MSG_ALGO)
+        self.new_cycle()
+
+
+def build_computation(comp_def) -> SyncBBMpComputation:
+    return SyncBBMpComputation(comp_def)

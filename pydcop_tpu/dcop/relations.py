@@ -694,7 +694,12 @@ def join(u1: Constraint, u2: Constraint) -> NAryMatrixRelation:
     """
     m1, m2 = u1.to_matrix(), u2.to_matrix()
     vars1, vars2 = m1.dimensions, m2.dimensions
-    out_vars = list(vars1) + [v for v in vars2 if v not in vars1]
+    # dimensions are identified by *name*: variable names are unique in
+    # a DCOP, and tables arriving over the wire (dpop's UTIL messages)
+    # carry reconstructed Variable objects whose synthetic domains would
+    # defeat full-object equality
+    names1 = {v.name for v in vars1}
+    out_vars = list(vars1) + [v for v in vars2 if v.name not in names1]
     names_out = [v.name for v in out_vars]
 
     # expand u1 to the output axes

@@ -30,7 +30,14 @@ from .objects import (
 
 def ilp_distribute(computation_graph, agentsdef: Iterable, hints=None,
                    computation_memory=None, communication_load=None,
-                   alpha: float = 0.8, beta: float = 0.2) -> Distribution:
+                   alpha: float = 0.8, beta: float = 0.2,
+                   fixed_mapping=None,
+                   min_one_per_agent: bool = False) -> Distribution:
+    """``fixed_mapping`` pins computations to agents (the SECP models'
+    actuator pre-assignment, reference oilp_secp_fgdp.py:84-128);
+    ``min_one_per_agent`` adds the SECP models' "every agent hosts at
+    least one computation" constraint (reference ilp_fgdp.py:219-226 —
+    only enforced for agents with no pinned computation)."""
     agents = list(agentsdef)
     comps = computation_graph.nodes
     C, A = len(comps), len(agents)
@@ -112,6 +119,21 @@ def ilp_distribute(computation_graph, agentsdef: Iterable, hints=None,
                 ub.append(1.0)
                 r += 1
 
+    # at least one computation on every agent without a pinned one
+    if min_one_per_agent:
+        pinned_agents = set((fixed_mapping or {}).keys())
+        for a, agent in enumerate(agents):
+            if agent.name in pinned_agents and \
+                    (fixed_mapping or {}).get(agent.name):
+                continue
+            for c in range(C):
+                rows.append(r)
+                cols.append(xv(c, a))
+                vals.append(1.0)
+            lb.append(1.0)
+            ub.append(np.inf)
+            r += 1
+
     var_lb = np.zeros(n_var)
     var_ub = np.ones(n_var)
     # must_host hints pin x variables
@@ -121,6 +143,12 @@ def ilp_distribute(computation_graph, agentsdef: Iterable, hints=None,
             for c_name in hints.must_host(a_name):
                 if c_name in comp_idx:
                     var_lb[xv(comp_idx[c_name], a_i)] = 1.0
+    if fixed_mapping:
+        agent_idx = {a.name: i for i, a in enumerate(agents)}
+        for a_name, comps_fixed in fixed_mapping.items():
+            for c_name in comps_fixed:
+                if c_name in comp_idx:
+                    var_lb[xv(comp_idx[c_name], agent_idx[a_name])] = 1.0
 
     mat = sparse.csr_matrix((vals, (rows, cols)), shape=(r, n_var))
     res = milp(

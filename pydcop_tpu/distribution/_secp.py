@@ -1,0 +1,208 @@
+"""Shared machinery for the SECP distribution models.
+
+SECP (Smart Environment Configuration Problem) instances carry
+device-bound computations: an actuator variable must live on its device's
+agent, marked by an *explicit* hosting cost of 0 (reference:
+oilp_secp_fgdp.py:84-128, gh_secp_cgdp.py:92-105).  On factor graphs the
+actuator's cost factor (named ``c_<actuator>``) rides along.  The four
+SECP strategies differ in the solver (optimal ILP vs greedy heuristic)
+and the computation graph (constraint hypergraph vs factor graph); the
+pre-assignment and the greedy candidate rule live here.
+"""
+
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Tuple
+
+from .objects import Distribution, ImpossibleDistributionException
+
+
+def is_actuator(agent, comp_name: str) -> bool:
+    """An actuator computation is pinned by an explicit hosting cost of 0
+    on its device agent.
+
+    The reference tests ``hosting_cost == 0`` directly (its generated
+    SECPs set a nonzero default); with our AgentDef's default hosting
+    cost of 0 that test would pin *everything*, so a zero only counts
+    when it is explicit or the agent's default is nonzero."""
+    return agent.hosting_cost(comp_name) == 0 and (
+        comp_name in agent.hosting_costs
+        or agent.default_hosting_cost != 0)
+
+
+def pin_explicit_zero_hosting(computation_graph,
+                              agents) -> Dict[str, List[str]]:
+    """agent -> computations with an explicit hosting cost of 0 there;
+    first agent (in order) wins when several declare the same pin
+    (reference: oilp_cgdp.py:96-106, gh_cgdp.py:96-106)."""
+    pinned: Dict[str, List[str]] = defaultdict(list)
+    taken = set()
+    for node in computation_graph.nodes:
+        for agent in agents:
+            if node.name not in taken and is_actuator(agent, node.name):
+                pinned[agent.name].append(node.name)
+                taken.add(node.name)
+                break
+    return dict(pinned)
+
+
+def actuator_preassignment(
+        computation_graph, agentsdef: Iterable,
+        computation_memory: Callable,
+        with_cost_factors: bool = False,
+) -> Tuple[Dict[str, List[str]], Dict[str, float], List[str]]:
+    """Pin actuator computations (and, on factor graphs, their
+    ``c_<actuator>`` cost factors) to their device agents.
+
+    Returns (mapping agent -> computations, remaining capacity per
+    agent, remaining computation names).
+    """
+    mapping: Dict[str, List[str]] = defaultdict(list)
+    capacity = {a.name: float(a.capacity) for a in agentsdef}
+    remaining = [n.name for n in computation_graph.nodes]
+
+    def place(agent_name: str, comp_name: str):
+        mapping[agent_name].append(comp_name)
+        remaining.remove(comp_name)
+        capacity[agent_name] -= computation_memory(
+            computation_graph.computation(comp_name))
+        if capacity[agent_name] < 0:
+            raise ImpossibleDistributionException(
+                f"Not enough capacity on {agent_name} to host actuator "
+                f"computation {comp_name}")
+
+    for agent in agentsdef:
+        for comp in list(remaining):
+            if is_actuator(agent, comp):
+                place(agent.name, comp)
+                cost_factor = f"c_{comp}"
+                if with_cost_factors and cost_factor in remaining:
+                    place(agent.name, cost_factor)
+    return dict(mapping), capacity, remaining
+
+
+def find_candidates(agents_capa: Dict[str, float], comp: str,
+                    footprint: float, mapping: Dict[str, List[str]],
+                    neighbors: Iterable[str]):
+    """Agents with enough remaining capacity hosting >=1 neighbor of
+    ``comp``, best first: most hosted neighbors, then most remaining
+    capacity (reference: gh_secp_cgdp.py:141-195)."""
+    neighbor_set = set(neighbors)
+    candidates = []
+    for agent, capa in agents_capa.items():
+        hosted = len(set(mapping.get(agent, ())) & neighbor_set)
+        if hosted > 0 and capa >= footprint:
+            candidates.append((hosted, capa, agent))
+    if not candidates:
+        raise ImpossibleDistributionException(
+            f"No neighbor-hosting agent with enough capacity for {comp}")
+    candidates.sort(reverse=True)
+    return candidates
+
+
+def node_neighbors(computation_graph, name: str) -> List[str]:
+    return list(computation_graph.computation(name).neighbors)
+
+
+def greedy_secp_cg(computation_graph, agentsdef,
+                   computation_memory) -> Distribution:
+    """GH-SECP on a constraint graph: pin actuators, then place every
+    remaining (model) variable next to an already-placed neighbor
+    (reference: gh_secp_cgdp.py:74-138)."""
+    mapping, capa, remaining = actuator_preassignment(
+        computation_graph, agentsdef, computation_memory)
+    mapping = defaultdict(list, mapping)
+    for comp in remaining:
+        footprint = computation_memory(
+            computation_graph.computation(comp))
+        cands = find_candidates(
+            capa, comp, footprint, mapping,
+            node_neighbors(computation_graph, comp))
+        selected = cands[0][2]
+        mapping[selected].append(comp)
+        capa[selected] -= footprint
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
+
+
+def greedy_secp_fg(computation_graph, agentsdef,
+                   computation_memory) -> Distribution:
+    """GH-SECP on a factor graph: pin actuator variables + their cost
+    factors; place each physical model (variable ``m``, factor ``c_m``)
+    as a pair next to its dependencies; place rule factors last
+    (reference: gh_secp_fgdp.py:94-198)."""
+    from ..graphs.factor_graph import VariableComputationNode
+
+    mapping, capa, remaining = actuator_preassignment(
+        computation_graph, agentsdef, computation_memory,
+        with_cost_factors=True)
+    mapping = defaultdict(list, mapping)
+    variables = [n for n in remaining
+                 if isinstance(computation_graph.computation(n),
+                               VariableComputationNode)]
+    factors = [n for n in remaining if n not in variables]
+
+    models = []
+    for model_var in variables:
+        fact = f"c_{model_var}"
+        if fact in factors:
+            models.append((model_var, fact))
+            factors.remove(fact)
+    lone_vars = [v for v, _ in models]
+    lone_vars = [v for v in variables if v not in lone_vars]
+
+    for model_var, model_fac in models:
+        footprint = computation_memory(
+            computation_graph.computation(model_var)) + \
+            computation_memory(computation_graph.computation(model_fac))
+        cands = find_candidates(
+            capa, model_fac, footprint, mapping,
+            node_neighbors(computation_graph, model_fac))
+        selected = cands[0][2]
+        mapping[selected].extend([model_var, model_fac])
+        capa[selected] -= footprint
+    # variables with no model factor, then the remaining (rule) factors
+    for comp in lone_vars + factors:
+        footprint = computation_memory(
+            computation_graph.computation(comp))
+        cands = find_candidates(
+            capa, comp, footprint, mapping,
+            node_neighbors(computation_graph, comp))
+        selected = cands[0][2]
+        mapping[selected].append(comp)
+        capa[selected] -= footprint
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
+
+
+def secp_ilp(computation_graph, agentsdef, computation_memory,
+             communication_load,
+             with_cost_factors: bool = False) -> Distribution:
+    """OILP-SECP: actuator pre-assignment + communication-only optimal
+    ILP with the at-least-one-computation-per-free-agent constraint
+    (reference: oilp_secp_cgdp.py:170-298, oilp_secp_fgdp.py:175-340)."""
+    from ._ilp import ilp_distribute
+
+    fixed, _capa, _rest = actuator_preassignment(
+        computation_graph, agentsdef, computation_memory,
+        with_cost_factors=with_cost_factors)
+    return ilp_distribute(
+        computation_graph, agentsdef, None,
+        computation_memory, communication_load,
+        alpha=1.0, beta=0.0,
+        fixed_mapping=fixed, min_one_per_agent=True)
+
+
+def secp_distribution_cost(distribution, computation_graph, agentsdef,
+                           computation_memory=None,
+                           communication_load=None):
+    """Communication-only cost: total load over cross-agent edges
+    (reference: oilp_secp_fgdp.py:133-171 returns (comm, comm, 0))."""
+    from .objects import link_pair_loads
+
+    comm = 0.0
+    for (n1, n2), load in link_pair_loads(
+            computation_graph, communication_load).items():
+        if not (distribution.has_computation(n1)
+                and distribution.has_computation(n2)):
+            continue
+        if distribution.agent_for(n1) != distribution.agent_for(n2):
+            comm += load
+    return comm, comm, 0.0

@@ -1,4 +1,118 @@
-"""Greedy heuristic on the constraint graph (reference: gh_cgdp.py:232) -
-the communication+hosting greedy, shared with heur_comhost."""
+"""GH-CGDP: greedy heuristic with backtracking for any computation graph.
 
-from .heur_comhost import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/gh_cgdp.py:70-270.  Differences
+from the plain ``heur_comhost`` greedy:
+
+* computations with an (explicit) hosting cost of 0 are pinned first
+  (SECP actuators land on their devices, gh_cgdp.py:96-106),
+* placement order is biggest-footprint-first with random tie-breaks,
+* when a computation has no feasible agent, the algorithm *backtracks*:
+  the previous placement is undone and its next-best candidate tried
+  (gh_cgdp.py:120-173) — heur_comhost simply fails there.
+
+Candidate ranking: weighted ``RATIO·comm-to-placed-neighbors +
+(1-RATIO)·hosting`` cost, cheapest first, under remaining capacity.
+"""
+
+import random
+from collections import defaultdict
+from typing import Iterable
+
+from .objects import (
+    Distribution,
+    ImpossibleDistributionException,
+    RATIO_HOST_COMM,
+    distribution_cost as _distribution_cost,
+)
+
+
+def distribute(computation_graph, agentsdef: Iterable, hints=None,
+               computation_memory=None,
+               communication_load=None) -> Distribution:
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_cgdp requires a computation_memory function")
+    load = communication_load or (lambda node, target: 1.0)
+    agents = list(agentsdef)
+    rnd = random.Random(0)  # deterministic tie-breaks, unlike reference
+
+    # pin computations with explicit hosting cost 0 (SECP devices)
+    from ._secp import pin_explicit_zero_hosting
+
+    fixed = {}  # comp -> (agent, footprint)
+    for a_name, comps in pin_explicit_zero_hosting(
+            computation_graph, agents).items():
+        for comp in comps:
+            fixed[comp] = (a_name, computation_memory(
+                computation_graph.computation(comp)))
+
+    todo = sorted(
+        ((computation_memory(n), rnd.random(), n)
+         for n in computation_graph.nodes if n.name not in fixed),
+        reverse=True)
+    nodes = [n for _, _, n in todo]
+    footprints = {n.name: f for f, _, n in todo}
+
+    placed = {}  # comp -> agent name
+    candidate_stack = [None] * len(nodes)
+    i = 0
+    while i < len(nodes):
+        node = nodes[i]
+        if candidate_stack[i] is None:
+            candidate_stack[i] = _candidates(
+                node, footprints, fixed, placed, agents, load, rnd)
+        if not candidate_stack[i]:
+            if i == 0:
+                raise ImpossibleDistributionException(
+                    f"No feasible agent for {node.name}")
+            # backtrack: undo the previous placement, try its next
+            # candidate (reference: gh_cgdp.py:146-166)
+            candidate_stack[i] = None
+            i -= 1
+            placed.pop(nodes[i].name, None)
+            continue
+        _, _, agent = candidate_stack[i].pop(0)
+        placed[node.name] = agent.name
+        i += 1
+
+    mapping = defaultdict(list)
+    for comp, (agent, _) in fixed.items():
+        mapping[agent].append(comp)
+    for comp, agent in placed.items():
+        mapping[agent].append(comp)
+    return Distribution({a: sorted(cs) for a, cs in mapping.items()})
+
+
+def _candidates(node, footprints, fixed, placed, agents, load, rnd):
+    """Feasible agents for ``node``, cheapest weighted cost first
+    (reference: gh_cgdp.py:201-270)."""
+    used = defaultdict(float)
+    location = {}
+    for comp, agent in placed.items():
+        used[agent] += footprints[comp]
+        location[comp] = agent
+    for comp, (agent, footprint) in fixed.items():
+        used[agent] += footprint
+        location[comp] = agent
+    # duplicates intended: a neighbor shared by several links costs once
+    # per link (reference: gh_cgdp.py:252-258)
+    linked = [n for link in node.links for n in link.nodes
+              if n != node.name and n in location]
+
+    out = []
+    for agent in agents:
+        if agent.capacity - used[agent.name] < footprints[node.name]:
+            continue
+        comm = sum(load(node, n) * agent.route(location[n])
+                   for n in linked)
+        cost = RATIO_HOST_COMM * comm + \
+            (1 - RATIO_HOST_COMM) * agent.hosting_cost(node.name)
+        out.append((cost, rnd.random(), agent))
+    out.sort(key=lambda t: (t[0], t[1]))
+    return out
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return _distribution_cost(distribution, computation_graph, agentsdef,
+                              computation_memory, communication_load)

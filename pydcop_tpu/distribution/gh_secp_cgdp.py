@@ -1,3 +1,27 @@
-"""Greedy SECP heuristic, constraint graph (reference: gh_secp_cgdp.py:195)."""
+"""GH-SECP-CGDP: greedy SECP heuristic on the constraint graph.
 
-from .heur_comhost import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/gh_secp_cgdp.py:74-195.
+Actuators pinned to their device agents; each physical-model variable
+goes to the agent hosting the most of its neighbors (ties: most
+remaining capacity).  Communication load is never evaluated — grouping
+dependencies is the whole heuristic.
+"""
+
+from ._secp import greedy_secp_cg, secp_distribution_cost
+from .objects import ImpossibleDistributionException
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_cgdp requires a computation_memory function")
+    return greedy_secp_cg(computation_graph, list(agentsdef),
+                          computation_memory)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return secp_distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

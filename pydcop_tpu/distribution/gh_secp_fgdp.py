@@ -1,3 +1,27 @@
-"""Greedy SECP heuristic, factor graph (reference: gh_secp_fgdp.py:231)."""
+"""GH-SECP-FGDP: greedy SECP heuristic on the factor graph.
 
-from .heur_comhost import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/gh_secp_fgdp.py:94-231.
+Actuator variables + cost factors pinned to device agents; each physical
+model's (variable, factor) pair is placed together next to the agent
+hosting most of the factor's dependencies; rule factors placed last by
+the same rule.
+"""
+
+from ._secp import greedy_secp_fg, secp_distribution_cost
+from .objects import ImpossibleDistributionException
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_fgdp requires a computation_memory function")
+    return greedy_secp_fg(computation_graph, list(agentsdef),
+                          computation_memory)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return secp_distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

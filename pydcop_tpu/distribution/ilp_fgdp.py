@@ -1,23 +1,44 @@
-"""Optimal ILP for factor-graph distribution (SECP paper model).
+"""ILP-FGDP: the OPTMAS'17 factor-graph distribution model.
 
-reference parity: pydcop/distribution/ilp_fgdp.py:161-340 - minimizes
-communication only, with must_host hints pinning device-bound computations
-(e.g. SECP lights on their light agents).
+reference parity: pydcop/distribution/ilp_fgdp.py:70-340.  Minimizes
+communication cost only (message sizes across agents), subject to agent
+memory capacities, with:
+
+* computations whose hosting cost is (explicitly) 0 on an agent pinned
+  there — the paper's device-bound computations (ilp_fgdp.py:91-100),
+* every agent without a pinned computation hosting at least one
+  (ilp_fgdp.py:219-226),
+* plus any caller-supplied must_host hints.
+
+The reference solves with PuLP+GLPK; here the same model runs through
+scipy's HiGHS MILP (see ``_ilp.py``).
 """
 
 from ._ilp import ilp_distribute
-from .objects import distribution_cost as _distribution_cost
+from ._secp import pin_explicit_zero_hosting, secp_distribution_cost
+from .objects import ImpossibleDistributionException
 
 
 def distribute(computation_graph, agentsdef, hints=None,
                computation_memory=None, communication_load=None):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "ilp_fgdp requires computation_memory and "
+            "communication_load functions")
+    agents = list(agentsdef)
+    # hosting cost 0 = "must host" (explicit entries only; first agent
+    # wins, reference ilp_fgdp.py:91-100)
+    must_host = pin_explicit_zero_hosting(computation_graph, agents)
     return ilp_distribute(
-        computation_graph, agentsdef, hints,
+        computation_graph, agents, hints,
         computation_memory, communication_load,
-        alpha=1.0, beta=0.0)
+        alpha=1.0, beta=0.0,
+        fixed_mapping=must_host, min_one_per_agent=True)
 
 
 def distribution_cost(distribution, computation_graph, agentsdef,
                       computation_memory=None, communication_load=None):
-    return _distribution_cost(distribution, computation_graph, agentsdef,
-                              computation_memory, communication_load)
+    """Communication-only (reference: ilp_fgdp.py:103-147)."""
+    return secp_distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

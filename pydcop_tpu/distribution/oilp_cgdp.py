@@ -1,3 +1,33 @@
-"""Optimal ILP on the constraint graph (reference: oilp_cgdp.py:368)."""
+"""OILP-CGDP: optimal weighted ILP for any computation graph (AAMAS'18).
 
-from .ilp_compref import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/oilp_cgdp.py:60-368.  Same model
+as ``ilp_compref`` (weighted communication·route + hosting objective
+under capacities) plus the reference's pinning of computations with an
+explicit hosting cost of 0 — on SECP instances actuators land on their
+devices before the ILP runs (oilp_cgdp.py:96-106).
+"""
+
+from ._ilp import ilp_distribute
+from ._secp import pin_explicit_zero_hosting
+from .objects import ImpossibleDistributionException, \
+    distribution_cost as _distribution_cost
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_cgdp requires computation_memory and "
+            "communication_load functions")
+    agents = list(agentsdef)
+    fixed = pin_explicit_zero_hosting(computation_graph, agents)
+    return ilp_distribute(
+        computation_graph, agents, hints,
+        computation_memory, communication_load,
+        fixed_mapping=fixed)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return _distribution_cost(distribution, computation_graph, agentsdef,
+                              computation_memory, communication_load)

@@ -1,5 +1,27 @@
-"""Optimal SECP ILP on the constraint graph
-(reference: oilp_secp_cgdp.py:344). SECP semantics = must_host hints pin
-actuator variables; the shared ILP enforces them."""
+"""OILP-SECP-CGDP: optimal ILP SECP distribution on the constraint graph.
 
-from .ilp_compref import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/oilp_secp_cgdp.py:81-344.
+Actuator variables (explicit hosting cost 0) are pinned to their device
+agents, then a communication-only ILP places the physical-model
+variables, with every free agent hosting at least one computation.
+"""
+
+from ._secp import secp_distribution_cost, secp_ilp
+from .objects import ImpossibleDistributionException
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_cgdp requires computation_memory and "
+            "communication_load functions")
+    return secp_ilp(computation_graph, list(agentsdef),
+                    computation_memory, communication_load)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return secp_distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

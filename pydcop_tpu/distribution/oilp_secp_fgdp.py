@@ -1,3 +1,28 @@
-"""Optimal SECP ILP on the factor graph (reference: oilp_secp_fgdp.py:376)."""
+"""OILP-SECP-FGDP: optimal ILP SECP distribution on the factor graph.
 
-from .ilp_fgdp import distribute, distribution_cost  # noqa: F401
+reference parity: pydcop/distribution/oilp_secp_fgdp.py:72-376.
+Actuator variables AND their ``c_<actuator>`` cost factors are pinned to
+the device agents; a communication-only ILP places the physical-model
+variables, model factors and rule factors.
+"""
+
+from ._secp import secp_distribution_cost, secp_ilp
+from .objects import ImpossibleDistributionException
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_fgdp requires computation_memory and "
+            "communication_load functions")
+    return secp_ilp(computation_graph, list(agentsdef),
+                    computation_memory, communication_load,
+                    with_cost_factors=True)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return secp_distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

@@ -28,32 +28,39 @@ def generate_secp(lights_count: int = 9, models_count: int = 3,
         v = Variable(f"l{i:02d}", domain)
         lights.append(v)
         dcop.add_variable(v)
-        # efficiency cost: brighter = more power
+        # efficiency cost: brighter = more power.  Named c_<light> — the
+        # SECP naming convention the distribution models key on
+        # (reference: commands/generators/secp.py:311-317)
         cost_factor = random.uniform(0.1, 1.0)
         dcop.add_constraint(UnaryFunctionRelation(
-            f"cost_{v.name}", v,
+            f"c_{v.name}", v,
             lambda level, _c=cost_factor: _c * level))
 
-    # models: target average level over a subset of lights
+    # physical models: a model variable m<j> tracks the perceived level
+    # of a subset of lights, coupled by a factor named c_m<j>
+    # (reference: commands/generators/secp.py:213-235)
+    models = []
     for m in range(models_count):
+        mv = Variable(f"m{m:02d}", domain)
+        models.append(mv)
+        dcop.add_variable(mv)
         size = random.randint(2, min(max_model_size, lights_count))
         scope = random.sample(lights, size)
-        target = random.randint(0, levels - 1)
 
-        def model_cost(*vals, _t=target):
+        def model_cost(model_level, *vals):
             avg = sum(vals) / len(vals)
-            return 10 * abs(avg - _t)
+            return 10 * abs(avg - model_level)
 
         dcop.add_constraint(NAryFunctionRelation(
-            model_cost, scope, name=f"model_m{m:02d}"))
+            model_cost, [mv] + scope, name=f"c_{mv.name}"))
 
-    # rules: hard physical dependencies between two devices
+    # rules: target scenes over models and lights
     for r in range(rules_count):
-        v1, v2 = random.sample(lights, 2)
-        max_sum = random.randint(levels // 2, levels)
+        target_var = random.choice(models + lights)
+        target = random.randint(0, levels - 1)
         dcop.add_constraint(NAryFunctionRelation(
-            lambda a, b, _m=max_sum: 10000 if a + b > _m else 0,
-            [v1, v2], name=f"rule_r{r:02d}"))
+            lambda v, _t=target: 10 * abs(v - _t),
+            [target_var], name=f"r{r:02d}"))
 
     # one agent per light, with capacity (models are hosted where cheap)
     for i, v in enumerate(lights):

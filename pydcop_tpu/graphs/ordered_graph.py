@@ -59,6 +59,14 @@ class OrderedVarNode(ComputationNode):
     def position(self) -> int:
         return self._position
 
+    @property
+    def previous_node(self) -> Optional[str]:
+        return self._previous_node
+
+    @property
+    def next_node(self) -> Optional[str]:
+        return self._next_node
+
 
 class OrderedGraph(ComputationGraph):
     def __init__(self, nodes: Iterable[OrderedVarNode]):

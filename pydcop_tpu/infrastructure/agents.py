@@ -162,6 +162,7 @@ class Agent:
         name = comp_name or computation.name
         computation.message_sender = self._messaging.post_msg
         computation._periodic_action_handler = self._add_periodic_cb
+        computation._periodic_action_remover = self.remove_periodic_action
         self._computations[name] = computation
         # wrap hooks so the agent observes value selections / cycles
         if hasattr(computation, "_on_value_selection"):

@@ -116,6 +116,9 @@ def message_type(msg_type: str, fields: List[str]):
         "__module__": caller.get("__name__", __name__),
         "_simple_repr": _simple_repr_impl,
         "content": property(_content_prop),
+        # introspectable field list (serialization round-trip tests
+        # synthesize instances of every registered wire message)
+        "_fields": list(fields),
     }
     for f in fields:
         attrs[f] = property(lambda self, _f=f: getattr(self, "_" + _f))
@@ -181,6 +184,7 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         self._name = name
         self._msg_sender: Optional[Callable] = None
         self._periodic_action_handler = None
+        self._periodic_action_remover = None
         self._running = False
         self._has_run = False
         self._is_paused = False
@@ -297,6 +301,12 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             )
         return self._periodic_action_handler(period, cb)
 
+    def remove_periodic_action(self, handle):
+        """Cancel a periodic action previously returned by
+        :meth:`add_periodic_action` (reference: agents.py:853-869)."""
+        if self._periodic_action_remover is not None:
+            self._periodic_action_remover(handle)
+
     def finished(self):
         """Signal the hosting agent that this computation is done; wrapped
         by the agent (reference: agents.py:870-876)."""
@@ -401,6 +411,22 @@ class SynchronousComputationMixin:
         msg._cycle_id = self._current_cycle
         self._sent_this_cycle.add(target)
         super().post_msg(target, msg, prio, on_error)
+
+    def sync_neighbors(self):
+        """Proactively send this round's SynchronizationMsg to every
+        neighbor not yet messaged.
+
+        Needed by protocols with *idle* rounds for some participants
+        (e.g. MGM-2's response/go sub-cycles): the automatic fill in
+        ``_maybe_end_cycle`` only fires when the round closes, and two
+        mutually-idle neighbors would each wait for the other's message
+        forever.  Call this at the end of a phase handler after posting
+        the phase's real messages."""
+        if not self._sync_initialized:
+            self._init_sync()
+        for n in set(self.neighbors) - self._sent_this_cycle:
+            sync = SynchronizationMsg()
+            self.post_msg(n, sync)
 
     def _maybe_end_cycle(self):
         missing = set(self.neighbors) - set(self._cycle_messages)

@@ -217,8 +217,13 @@ class OrchestrationComputation(MessagePassingComputation):
                 self.agent.name, computation, cycle), MSG_VALUE)
 
     def report_finished(self, computation):
+        value, cost = None, None
+        if self.agent.has_computation(computation):
+            comp = self.agent.computation(computation)
+            value = getattr(comp, "current_value", None)
+            cost = getattr(comp, "current_cost", None)
         self.post_msg(ORCHESTRATOR_MGT, ComputationFinishedMessage(
-            self.agent.name, computation), MSG_MGT)
+            self.agent.name, computation, value, cost), MSG_MGT)
 
     def _periodic_metrics(self):
         self.post_msg(ORCHESTRATOR_MGT, MetricsMessage(

@@ -65,8 +65,12 @@ RepairReadyMessage = message_type("repair_ready",
                                   ["agent", "computations"])
 RepairRunMessage = message_type("repair_run", [])
 RepairDoneMessage = message_type("repair_done", ["agent", "selected"])
+#: value/cost carry the computation's final selection: value_change
+#: reports are delta-based and can be dropped by the transport during
+#: startup races, so the finished report is the authoritative source of
+#: the final assignment
 ComputationFinishedMessage = message_type(
-    "computation_finished", ["agent", "computation"])
+    "computation_finished", ["agent", "computation", "value", "cost"])
 
 
 class AgentsMgt(MessagePassingComputation):
@@ -135,8 +139,12 @@ class AgentsMgt(MessagePassingComputation):
     @register("value_change")
     def _on_value_change(self, sender, msg, t):
         with self._lock:
-            self.current_values[msg.computation] = msg.value
-            self.current_costs[msg.computation] = msg.cost
+            # the finished report carries the authoritative final value;
+            # a lower-priority value_change may arrive after it — don't
+            # let the stale delta overwrite it
+            if msg.computation not in self.finished_computations:
+                self.current_values[msg.computation] = msg.value
+                self.current_costs[msg.computation] = msg.cost
             self.max_cycle = max(self.max_cycle, msg.cycle or 0)
         event_bus.send(f"computations.value.{msg.computation}",
                        (msg.value, msg.cost, msg.cycle))
@@ -160,6 +168,9 @@ class AgentsMgt(MessagePassingComputation):
     def _on_computation_finished(self, sender, msg, t):
         with self._lock:
             self.finished_computations.add(msg.computation)
+            if msg.value is not None:
+                self.current_values[msg.computation] = msg.value
+                self.current_costs[msg.computation] = msg.cost
 
     @register("metrics")
     def _on_metrics(self, sender, msg, t):

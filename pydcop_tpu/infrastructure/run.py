@@ -114,9 +114,20 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 def _prepare_run(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                  distribution: Union[str, Any] = "adhoc",
                  graph: Optional[str] = None,
-                 algo_params: Optional[Dict[str, Any]] = None):
+                 algo_params: Optional[Dict[str, Any]] = None,
+                 seed: Optional[int] = None):
     """Build (algo_def, graph, distribution) for an orchestrated run."""
     if isinstance(algo_def, str):
+        algo_params = dict(algo_params or {})
+        if seed is not None and "seed" not in algo_params:
+            # one seed drives both planes: the engine's PRNG key and the
+            # fabric computations' per-computation streams (algorithms
+            # declaring a ``seed`` param pick it up; others ignore it)
+            from ..algorithms import load_algorithm_module as _lam
+
+            declared = {p.name for p in _lam(algo_def).algo_params}
+            if "seed" in declared:
+                algo_params["seed"] = seed
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, params=algo_params, mode=dcop.objective)
     algo_module = load_algorithm_module(algo_def.algo)
@@ -258,7 +269,8 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         raise ValueError(f"Invalid mode {mode!r}: 'thread' or 'process'")
     algo_def, cg, dist = _prepare_run(dcop, algo_def, distribution,
                                       graph=graph,
-                                      algo_params=algo_params or None)
+                                      algo_params=algo_params or None,
+                                      seed=seed)
     rep = replication or ("dist_ucs_hostingcosts" if ktarget else None)
     if mode == "thread":
         orchestrator = run_local_thread_dcop(

@@ -176,3 +176,142 @@ def test_hypergraph_distribution(setup):
                         dsa.computation_memory,
                         dsa.communication_load)
     assert sorted(dist.computations) == ["v1", "v2", "v3"]
+
+
+# ---- round 3: real SECP distribution models (VERDICT r2 item 2) -------
+
+
+@pytest.fixture
+def secp_setup():
+    from pydcop_tpu.generators.secp import generate_secp
+
+    dcop = generate_secp(lights_count=6, models_count=2, rules_count=1,
+                         seed=11)
+    maxsum = load_algorithm_module("maxsum")
+    dsa = load_algorithm_module("dsa")
+    fg = factor_graph.build_computation_graph(dcop)
+    cg = constraints_hypergraph.build_computation_graph(dcop)
+    return dcop, fg, cg, maxsum, dsa
+
+
+def test_secp_actuators_pinned_cgdp(secp_setup):
+    """Both SECP constraint-graph models pin every light on its device
+    agent (reference: gh_secp_cgdp.py:92-105)."""
+    dcop, _, cg, _, dsa = secp_setup
+    for method in ("gh_secp_cgdp", "oilp_secp_cgdp"):
+        m = load_distribution_module(method)
+        dist = m.distribute(cg, dcop.agents_def, None,
+                            dsa.computation_memory,
+                            dsa.communication_load)
+        for agent in dcop.agents_def:
+            for comp, cost in agent.hosting_costs.items():
+                if cost == 0:
+                    assert dist.agent_for(comp) == agent.name, method
+        # every computation hosted
+        assert set(dist.computations) == {n.name for n in cg.nodes}
+
+
+def test_secp_fgdp_cost_factor_rides_with_actuator(secp_setup):
+    """Factor-graph SECP models place each light's c_<light> cost factor
+    on the light's device (reference: oilp_secp_fgdp.py:100-121)."""
+    dcop, fg, _, maxsum, _ = secp_setup
+    for method in ("gh_secp_fgdp", "oilp_secp_fgdp"):
+        m = load_distribution_module(method)
+        dist = m.distribute(fg, dcop.agents_def, None,
+                            maxsum.computation_memory,
+                            maxsum.communication_load)
+        for agent in dcop.agents_def:
+            for comp, cost in agent.hosting_costs.items():
+                if cost == 0:
+                    assert dist.agent_for(comp) == agent.name, method
+                    assert dist.agent_for(f"c_{comp}") == \
+                        agent.name, method
+        assert set(dist.computations) == {n.name for n in fg.nodes}
+
+
+def test_secp_fgdp_models_placed_as_pairs(secp_setup):
+    """gh_secp_fgdp keeps each physical model's (variable, factor) pair
+    on one agent (reference: gh_secp_fgdp.py:166-183)."""
+    dcop, fg, _, maxsum, _ = secp_setup
+    m = load_distribution_module("gh_secp_fgdp")
+    dist = m.distribute(fg, dcop.agents_def, None,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    for v in dcop.variables:
+        if v.startswith("m") and f"c_{v}" in dcop.constraints:
+            assert dist.agent_for(v) == dist.agent_for(f"c_{v}")
+
+
+def test_secp_models_beat_generic_on_secp_cost(secp_setup):
+    """On a SECP instance the SECP-aware models respect device pinning,
+    which the generic weighted models don't guarantee; under the SECP
+    communication-only metric the optimal SECP ILP must be at least as
+    cheap as the greedy SECP heuristic, and both must beat or match the
+    generic adhoc placement."""
+    dcop, fg, _, maxsum, _ = secp_setup
+    from pydcop_tpu.distribution._secp import secp_distribution_cost
+
+    def secp_cost(dist):
+        return secp_distribution_cost(
+            dist, fg, dcop.agents_def, maxsum.computation_memory,
+            maxsum.communication_load)[0]
+
+    oilp = load_distribution_module("oilp_secp_fgdp").distribute(
+        fg, dcop.agents_def, None, maxsum.computation_memory,
+        maxsum.communication_load)
+    gh = load_distribution_module("gh_secp_fgdp").distribute(
+        fg, dcop.agents_def, None, maxsum.computation_memory,
+        maxsum.communication_load)
+    adhoc = load_distribution_module("adhoc").distribute(
+        fg, dcop.agents_def, None, maxsum.computation_memory,
+        maxsum.communication_load)
+    assert secp_cost(oilp) <= secp_cost(gh) + 1e-9
+    assert secp_cost(oilp) <= secp_cost(adhoc) + 1e-9
+    # and the SECP strategies produce *different* placements than the
+    # generic one (they are not aliases anymore)
+    assert oilp != adhoc or gh != adhoc
+
+
+def test_oilp_secp_ilp_is_optimal_vs_greedy(secp_setup):
+    """Same check on the constraint graph."""
+    dcop, _, cg, _, dsa = secp_setup
+    from pydcop_tpu.distribution._secp import secp_distribution_cost
+
+    def secp_cost(dist):
+        return secp_distribution_cost(
+            dist, cg, dcop.agents_def, dsa.computation_memory,
+            dsa.communication_load)[0]
+
+    oilp = load_distribution_module("oilp_secp_cgdp").distribute(
+        cg, dcop.agents_def, None, dsa.computation_memory,
+        dsa.communication_load)
+    gh = load_distribution_module("gh_secp_cgdp").distribute(
+        cg, dcop.agents_def, None, dsa.computation_memory,
+        dsa.communication_load)
+    assert secp_cost(oilp) <= secp_cost(gh) + 1e-9
+
+
+def test_gh_cgdp_backtracking_distribution(secp_setup):
+    """gh_cgdp: biggest-footprint-first greedy with backtracking
+    (reference: gh_cgdp.py:120-173)."""
+    dcop, _, cg, _, dsa = secp_setup
+    m = load_distribution_module("gh_cgdp")
+    dist = m.distribute(cg, dcop.agents_def, None,
+                        dsa.computation_memory, dsa.communication_load)
+    assert set(dist.computations) == {n.name for n in cg.nodes}
+    # explicit-zero hosting costs are pinned
+    for agent in dcop.agents_def:
+        for comp, cost in agent.hosting_costs.items():
+            if cost == 0:
+                assert dist.agent_for(comp) == agent.name
+
+
+def test_oilp_cgdp_pins_devices(secp_setup):
+    dcop, _, cg, _, dsa = secp_setup
+    m = load_distribution_module("oilp_cgdp")
+    dist = m.distribute(cg, dcop.agents_def, None,
+                        dsa.computation_memory, dsa.communication_load)
+    for agent in dcop.agents_def:
+        for comp, cost in agent.hosting_costs.items():
+            if cost == 0:
+                assert dist.agent_for(comp) == agent.name

@@ -75,8 +75,12 @@ def test_meetings_peav():
 def test_secp():
     dcop = generate_secp(lights_count=6, models_count=2, rules_count=1,
                          seed=7)
-    assert len(dcop.variables) == 6
+    # 6 lights + 2 physical-model variables
+    assert len(dcop.variables) == 8
     assert len(dcop.agents) == 6
+    # SECP naming convention: c_<light> cost factors, c_<model> factors
+    assert "c_l00" in dcop.constraints
+    assert "c_m00" in dcop.constraints
     res = solve_result(dcop, "mgm", timeout=10, stop_cycle=30)
     assert set(res.assignment) == set(dcop.variables)
 
