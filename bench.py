@@ -78,7 +78,11 @@ def tpu_run():
     return msgs / elapsed, elapsed, cycles, n_conflicts
 
 
-def cpu_baseline():
+def cpu_baseline(best_of: int = 3):
+    """Best-of-N like the TPU side: the host is contended too, and a
+    single 4-second sample made vs_baseline swing 50% between rounds
+    (75.9M/1136x in r01 vs 84.7M/734x in r02 — the TPU got *faster*
+    while the ratio fell)."""
     sys.path.insert(0, "benchmarks")
     from cpu_baseline import run_maxsum_baseline
 
@@ -87,21 +91,39 @@ def cpu_baseline():
     rng = np.random.default_rng(7)
     edges = random_graph_edges(BASELINE_VARS, BASELINE_EDGES, seed=7)
     var_costs = rng.uniform(0, 0.05, size=(BASELINE_VARS, N_COLORS))
-    msgs, elapsed = run_maxsum_baseline(
-        edges.tolist(), BASELINE_VARS, N_COLORS, var_costs,
-        duration=BASELINE_SECONDS)
-    return msgs / elapsed
+    best_rate, conflicts = 0.0, None
+    for _ in range(best_of):
+        msgs, elapsed, n_conf = run_maxsum_baseline(
+            edges.tolist(), BASELINE_VARS, N_COLORS, var_costs,
+            duration=BASELINE_SECONDS)
+        rate = msgs / elapsed
+        if rate > best_rate:
+            best_rate = rate
+        # conflicts after a full-duration run (any run: converged state)
+        conflicts = n_conf if conflicts is None else min(conflicts,
+                                                        n_conf)
+    return best_rate, conflicts
 
 
 def main():
-    tpu_msgs_per_sec, elapsed, cycles, n_conflicts = tpu_run()
-    cpu_msgs_per_sec = cpu_baseline()
+    tpu_msgs_per_sec, elapsed, cycles, tpu_conflicts = tpu_run()
+    cpu_msgs_per_sec, cpu_conflicts = cpu_baseline()
     vs = tpu_msgs_per_sec / cpu_msgs_per_sec if cpu_msgs_per_sec else 0.0
+    # the BASELINE.md claim is ">=100x at equal solution cost": compare
+    # conflict *rates* (the instances differ in size: 30k vs 3k edges)
+    tpu_rate = tpu_conflicts / N_EDGES
+    cpu_rate = (cpu_conflicts / BASELINE_EDGES
+                if cpu_conflicts is not None else 1.0)
     print(json.dumps({
         "metric": "maxsum_msgs_per_sec_10kvar_coloring",
         "value": round(tpu_msgs_per_sec, 1),
         "unit": "msgs/s",
         "vs_baseline": round(vs, 2),
+        "tpu_conflicts": tpu_conflicts,
+        "tpu_conflict_rate": round(tpu_rate, 5),
+        "cpu_conflicts": cpu_conflicts,
+        "cpu_conflict_rate": round(cpu_rate, 5),
+        "cost_parity": bool(tpu_rate <= cpu_rate + 0.005),
     }))
 
 

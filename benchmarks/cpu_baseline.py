@@ -73,6 +73,16 @@ class VariableComputation:
         self.agent = agent
         self.received = {}
         self.cycle_msgs = defaultdict(dict)
+        # last cost table per factor, kept across rounds so the final
+        # selection (argmin of belief) can be read after the run
+        self.last_costs = {}
+
+    def selection(self):
+        belief = list(self.unary)
+        for costs in self.last_costs.values():
+            for d in range(self.D):
+                belief[d] += costs[d]
+        return min(range(self.D), key=lambda d: belief[d])
 
     def start(self):
         for f in self.factors:
@@ -81,6 +91,7 @@ class VariableComputation:
     def on_message(self, msg):
         kind, sender, cycle, costs = msg
         self.received[sender] = costs
+        self.last_costs[sender] = costs
         if len(self.received) >= len(self.factors):
             # send next-cycle messages: sum of other factors' costs
             for f in self.factors:
@@ -173,4 +184,6 @@ def run_maxsum_baseline(edges, n_vars, n_colors, var_costs,
         a.running = False
     for a in agents:
         a.join(timeout=1)
-    return msgs, elapsed
+    selection = [vc.selection() for vc in var_comps]
+    conflicts = sum(1 for u, v in edges if selection[u] == selection[v])
+    return msgs, elapsed, conflicts

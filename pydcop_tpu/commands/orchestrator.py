@@ -4,9 +4,17 @@ deployment.
 reference parity: pydcop/commands/orchestrator.py:185-618.  Starts an
 orchestrator with an HTTP communication layer; remote ``pydcop agent``
 processes join it over the network (DCN in a TPU-pod deployment), then
-the DCOP is deployed, run and the result printed.
+the DCOP is deployed, run and the result printed.  Carries the same
+observability surface as ``pydcop solve``: ``--collect_on`` /
+``--period`` select when assignments are observed, ``--run_metrics``
+streams them to CSV during the run, ``--end_metrics`` appends one
+end-of-run summary row, ``--uiport`` starts the websocket UI server.
 """
 
+import csv
+import os
+import queue
+import threading
 import time
 
 from . import build_algo_def, output_json
@@ -29,6 +37,20 @@ def set_parser(subparsers):
                         help="address to bind the HTTP server to when it "
                              "differs from --address (NAT / container "
                              "port mapping, e.g. 0.0.0.0)")
+    parser.add_argument("-c", "--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change",
+                                 "period"],
+                        help="when a new assignment is observed "
+                             "(reference: orchestrator.py:219-233)")
+    parser.add_argument("--period", type=float, default=None,
+                        help="metrics period (seconds) for "
+                             "--collect_on period")
+    parser.add_argument("--run_metrics", type=str, default=None,
+                        help="CSV file streaming run metrics")
+    parser.add_argument("--end_metrics", type=str, default=None,
+                        help="CSV file to append end-of-run metrics to")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="websocket UI server port (none = no UI)")
     parser.add_argument("-s", "--scenario", default=None)
     parser.add_argument("-k", "--ktarget", type=int, default=None)
     parser.add_argument("--deploy_timeout", type=float, default=60,
@@ -52,10 +74,25 @@ def run_cmd(args, timeout=None):
                                       args.distribution)
     scenario = (load_scenario_from_file(args.scenario)
                 if args.scenario else None)
+
+    collector, collector_thread, stop_evt = None, None, None
+    if args.run_metrics:
+        collector = queue.Queue()
+        stop_evt = threading.Event()
+        collector_thread = threading.Thread(
+            target=_collect_to_csv,
+            args=(collector, args.run_metrics, stop_evt), daemon=True)
+        collector_thread.start()
+
     comm = HttpCommunicationLayer(
         (args.address, args.port),
         bind_host=getattr(args, "bind_address", None))
-    orchestrator = Orchestrator(algo_def, cg, dist, comm, dcop=dcop)
+    orchestrator = Orchestrator(
+        algo_def, cg, dist, comm, dcop=dcop,
+        collector=collector,
+        collect_moment=args.collect_on,
+        collect_period=args.period,
+        ui_port=getattr(args, "uiport", None))
     orchestrator.start()
     try:
         orchestrator.deploy_computations(timeout=args.deploy_timeout)
@@ -75,7 +112,45 @@ def run_cmd(args, timeout=None):
             "msg_size": metrics["msg_size"],
             "time": time.perf_counter() - t0,
         }
+        if args.end_metrics:
+            _append_end_metrics(args.end_metrics, result)
         output_json(result, args.output)
     finally:
+        if stop_evt is not None:
+            stop_evt.set()
+            collector_thread.join(2)
         orchestrator.stop()
     return 0
+
+
+def _collect_to_csv(collector: "queue.Queue", path: str,
+                    stop_evt: threading.Event):
+    """Stream collected metric tuples to CSV
+    (reference: commands/orchestrator.py:412-474 collect_t thread)."""
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["time", "computation", "value", "cost",
+                         "cycle"])
+        while not stop_evt.is_set() or not collector.empty():
+            try:
+                row = collector.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            writer.writerow(row)
+            f.flush()
+
+
+def _append_end_metrics(path: str, result: dict):
+    """One end-of-run summary row, appended (reference:
+    commands/orchestrator.py:476-521 end metrics)."""
+    new_file = not os.path.exists(path)
+    with open(path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(["time", "status", "cost", "violation",
+                             "cycle", "msg_count", "msg_size"])
+        writer.writerow([
+            round(result["time"], 4), result["status"], result["cost"],
+            result["violation"], result["cycle"], result["msg_count"],
+            result["msg_size"],
+        ])

@@ -271,17 +271,27 @@ class VariableNoisyCostFunc(VariableWithCostFunc):
 
     Noise breaks symmetry between equal-cost values, which many local-search
     and max-sum variants rely on (reference: pydcop/dcop/objects.py:547-617).
-    Noise values are drawn once at construction so cost lookups stay
-    deterministic afterwards.
+    Unlike the reference (which draws from the global RNG at construction,
+    objects.py:591), the noise is derived deterministically from the
+    (variable, value) pair: loading the same problem twice — or cloning the
+    variable into another process, as deployment and replication do — yields
+    the same costs, so solver runs are reproducible for a fixed seed.
     """
 
     has_cost = True
 
     def __init__(self, name: str, domain: Union[Domain, Iterable],
                  cost_func, initial_value=None, noise_level: float = 0.02):
+        import hashlib
+
         super().__init__(name, domain, cost_func, initial_value)
         self._noise_level = noise_level
-        self._noise = {v: random.uniform(0, noise_level) for v in self.domain}
+        self._noise = {}
+        for v in self.domain:
+            digest = hashlib.blake2b(
+                f"{name}\x00{v!r}".encode(), digest_size=8).digest()
+            u = int.from_bytes(digest, "big") / 2.0 ** 64
+            self._noise[v] = u * noise_level
 
     @property
     def noise_level(self) -> float:

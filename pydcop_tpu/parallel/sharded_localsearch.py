@@ -15,7 +15,7 @@ O(C * D * arity)) is tp-sharded; the per-variable decision is O(V * D).
 """
 
 from functools import partial
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,23 +28,28 @@ from ..ops.kernels import bucket_cost, candidate_costs
 
 def _partition_constraints(arrays: HypergraphArrays, tp: int):
     """Round-robin each bucket's constraints over tp shards, padding
-    with inert (all-BIG... actually all-zero) dummy constraints that
-    point at a sink variable row so shapes stay identical per shard."""
+    with inert all-zero dummy constraints that point at a sink variable
+    row so shapes stay identical per shard.  One vectorized gather per
+    bucket — the only Python loop is over the tp shards for the index
+    table (the old per-constraint nested loops were O(C) interpreter
+    time on 100k-constraint grids)."""
     D = arrays.max_domain
     V = arrays.n_vars
     out = []
     for b in arrays.buckets:
         a = b.arity
         n = b.cubes.shape[0]
-        groups = [list(range(g, n, tp)) for g in range(tp)]
-        fmax = max(len(g) for g in groups) if groups else 0
+        fmax = (n + tp - 1) // tp if n else 0
+        idx = np.full((tp, fmax), -1, dtype=np.int64)
+        for g in range(tp):
+            ids = np.arange(g, n, tp)
+            idx[g, : len(ids)] = ids
+        valid = idx >= 0
         # dummy constraints contribute 0 to the sink row only
         cubes = np.zeros((tp, fmax) + (D,) * a, dtype=np.float32)
         var_ids = np.full((tp, fmax, a), V, dtype=np.int32)
-        for g in range(tp):
-            for slot, ci in enumerate(groups[g]):
-                cubes[g, slot] = b.cubes[ci]
-                var_ids[g, slot] = b.var_ids[ci]
+        cubes[valid] = b.cubes[idx[valid]]
+        var_ids[valid] = b.var_ids[idx[valid]]
         out.append((a, cubes, var_ids))
     return out
 
@@ -180,5 +185,163 @@ class ShardedDsa:
             self._device_put(seed)
         key = jax.random.PRNGKey(seed)
         x = self._step(x, key, cubes, var_ids, var_costs, domain_mask)
+        jax.block_until_ready(x)
+        return np.asarray(jax.device_get(x))[:, :self.V]
+
+
+class ShardedMgm:
+    """MGM over a (dp, tp) mesh (the round-2 gap: no mgm-family solver
+    had a sharded path).
+
+    Same mechanics as :class:`ShardedDsa` for the candidate-cost psum;
+    the MGM decision needs one extra collective round: the
+    "strictly-largest gain in my neighborhood" test.  Each shard
+    scatter-maxes its constraints' participant gains (excluding self)
+    into a per-variable neighbor-max, ``pmax`` over tp assembles the
+    global view, and the lexic tie-break (lower variable index wins, as
+    in the single-chip ``MgmSolver``) uses a second scatter-max over the
+    at-max neighbors' priorities.  Monotonic: only strictly-improving
+    moves, so the conflict count never increases.
+    """
+
+    def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1):
+        self.mesh = mesh
+        self.tp = mesh.shape["tp"]
+        self.dp = mesh.shape["dp"]
+        if batch % self.dp != 0:
+            raise ValueError(
+                f"batch {batch} must be a multiple of dp={self.dp}")
+        self.B = batch
+        self.V = arrays.n_vars
+        self.D = arrays.max_domain
+        self.sharded_buckets = _partition_constraints(arrays, self.tp)
+        self.var_costs = np.concatenate(
+            [arrays.var_costs,
+             np.zeros((1, self.D), dtype=np.float32)])
+        self.domain_mask = np.concatenate(
+            [arrays.domain_mask, np.ones((1, self.D), dtype=bool)])
+        self.domain_size = np.concatenate(
+            [arrays.domain_size, np.full((1,), self.D, np.int32)])
+        self._build_step()
+
+    def _build_step(self):
+        V, D = self.V, self.D
+        arities = [a for a, _, _ in self.sharded_buckets]
+        # lexic tie-break: lower variable index wins (MgmSolver:35-37);
+        # the sink row gets the worst priority
+        priority = jnp.concatenate(
+            [-jnp.arange(V, dtype=jnp.float32),
+             jnp.asarray([-jnp.inf], dtype=jnp.float32)])
+
+        def local_step(x, cubes, var_ids, var_costs, domain_mask):
+            def one(x1):
+                cand = jnp.zeros_like(var_costs)  # (V+1, D)
+                for a, cu, vi in zip(arities, cubes, var_ids):
+                    cand = cand + candidate_costs(cu, vi, x1, V + 1)
+                cand = jax.lax.psum(cand, "tp")
+                cand = cand + var_costs
+                cand = jnp.where(domain_mask, cand, BIG * 2)
+                best = jnp.argmin(cand, axis=-1)          # (V+1,)
+                cur_cost = jnp.take_along_axis(
+                    cand, x1[:, None], axis=-1)[:, 0]
+                gain = cur_cost - jnp.min(cand, axis=-1)  # >= 0
+
+                # pass 1: neighbor max gain (excluding self) per shard,
+                # assembled with pmax over tp
+                nbr_max = jnp.full((V + 1,), -jnp.inf)
+                for a, cu, vi in zip(arities, cubes, var_ids):
+                    if a < 2:
+                        continue
+                    g_part = gain[vi]                     # (F, a)
+                    for p in range(a):
+                        others = jnp.max(
+                            jnp.concatenate([
+                                g_part[:, :p], g_part[:, p + 1:]
+                            ], axis=1), axis=1)
+                        nbr_max = nbr_max.at[vi[:, p]].max(others)
+                nbr_max = jax.lax.pmax(nbr_max, "tp")
+
+                # pass 2: best priority among at-max neighbors
+                nbr_pri = jnp.full((V + 1,), -jnp.inf)
+                for a, cu, vi in zip(arities, cubes, var_ids):
+                    if a < 2:
+                        continue
+                    g_part = gain[vi]
+                    p_part = priority[vi]
+                    for p in range(a):
+                        g_o = jnp.concatenate(
+                            [g_part[:, :p], g_part[:, p + 1:]], axis=1)
+                        p_o = jnp.concatenate(
+                            [p_part[:, :p], p_part[:, p + 1:]], axis=1)
+                        at_max = g_o >= nbr_max[vi[:, p]][:, None] - 1e-9
+                        best_o = jnp.max(
+                            jnp.where(at_max, p_o, -jnp.inf), axis=1)
+                        nbr_pri = nbr_pri.at[vi[:, p]].max(best_o)
+                nbr_pri = jax.lax.pmax(nbr_pri, "tp")
+
+                wins = (gain > nbr_max + 1e-9) | (
+                    (gain >= nbr_max - 1e-9) & (priority > nbr_pri))
+                change = (gain > 1e-9) & wins
+                return jnp.where(change, best, x1)
+
+            return jax.vmap(one)(x)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(
+                P("dp"),
+                [P("tp") for _ in self.sharded_buckets],
+                [P("tp") for _ in self.sharded_buckets],
+                P(), P(),
+            ),
+            out_specs=P("dp"),
+        )
+        def sharded(x, cubes, var_ids, var_costs, domain_mask):
+            cubes_l = [c[0] for c in cubes]
+            vids_l = [v[0] for v in var_ids]
+            return local_step(x, cubes_l, vids_l, var_costs,
+                              domain_mask)
+
+        self._step = jax.jit(sharded)
+
+    def _device_put(self, seed: int, x0: Optional[np.ndarray] = None):
+        mesh = self.mesh
+        if x0 is None:
+            rng = np.random.default_rng(seed)
+            x0 = rng.integers(
+                0, np.maximum(self.domain_size, 1),
+                size=(self.B, self.V + 1)).astype(np.int32)
+        else:
+            sink = np.zeros((self.B, 1), dtype=np.int32)
+            x0 = np.concatenate(
+                [np.asarray(x0, dtype=np.int32), sink], axis=1)
+        x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
+        consts = (
+            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+             for _, c, _ in self.sharded_buckets],
+            [jax.device_put(v, NamedSharding(mesh, P("tp")))
+             for _, _, v in self.sharded_buckets],
+            jax.device_put(jnp.asarray(self.var_costs),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.domain_mask),
+                           NamedSharding(mesh, P())),
+        )
+        return x, consts
+
+    def run(self, n_cycles: int, seed: int = 0,
+            x0: Optional[np.ndarray] = None) -> Tuple[np.ndarray, int]:
+        """Returns ((B, V) selections, cycles run).  ``x0`` optionally
+        fixes the initial (B, V) assignment (equivalence tests)."""
+        x, (cubes, var_ids, var_costs, domain_mask) = \
+            self._device_put(seed, x0)
+        for cycle in range(n_cycles):
+            x = self._step(x, cubes, var_ids, var_costs, domain_mask)
+        sel = np.asarray(jax.device_get(x))[:, :self.V]
+        return sel, n_cycles
+
+    def step_once(self, seed: int = 0) -> np.ndarray:
+        x, (cubes, var_ids, var_costs, domain_mask) = \
+            self._device_put(seed)
+        x = self._step(x, cubes, var_ids, var_costs, domain_mask)
         jax.block_until_ready(x)
         return np.asarray(jax.device_get(x))[:, :self.V]

@@ -16,7 +16,17 @@ shards the *stacked message arrays* over a device mesh:
 The factor partition is computed host-side (round-robin per arity bucket,
 padded with inert dummy factors so every shard has identical static
 shapes); dummy edges point at a sink variable row which every reduction
-masks out.
+masks out.  The per-shard edge layout is canonical factor-major by
+construction, so the shard-local update supports the same two layouts as
+the single-chip solver: edge-major ``(E, D)`` and lane-major ``(D, E)``
+(edges riding the 128-wide lane dimension, reusing the lane factor
+kernel).
+
+Message semantics mirror the single-chip :class:`MaxSumSolver` exactly:
+``damping_nodes`` (vars / factors / both / none), solver noise, mean
+normalization over valid slots, and SAME_COUNT-stable convergence with
+the same damping-scaled stability threshold — so a sharded run and a
+single-chip run of the same instance select the same values.
 """
 
 from dataclasses import dataclass
@@ -26,7 +36,7 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..graphs.arrays import BIG, FactorGraphArrays
 from ..ops.kernels import factor_messages
@@ -38,64 +48,74 @@ SAME_COUNT = 4
 class _ShardedBucket:
     arity: int
     cubes: np.ndarray      # (TP, F, D, ..., D)
-    edge_ids: np.ndarray   # (TP, F, arity) — local edge ids
+    offset: int            # first local edge id of this bucket's block
     var_ids: np.ndarray    # (TP, F, arity) — global var ids (V = sink)
 
 
+def _round_robin(n: int, tp: int) -> np.ndarray:
+    """(tp, ceil(n/tp)) indices, -1 marking padded dummy slots."""
+    fmax = (n + tp - 1) // tp if n else 0
+    idx = np.full((tp, fmax), -1, dtype=np.int64)
+    for g in range(tp):
+        ids = np.arange(g, n, tp)
+        idx[g, : len(ids)] = ids
+    return idx
+
+
 def _partition(arrays: FactorGraphArrays, tp: int):
-    """Split factors across tp shards; every shard gets identical static
-    shapes (padded with dummy factors)."""
+    """Split factors across tp shards (vectorized gather per bucket; the
+    only Python loop is over the tp shards for the index table)."""
     D = arrays.max_domain
     V = arrays.n_vars
     shard_buckets: List[_ShardedBucket] = []
-    # per-shard local edge counter
-    edge_count = [0] * tp
-    # collect (bucket, shard) -> list of (factor local slot data)
+    offset = 0
+    blocks = []  # per bucket: (TP, fmax*arity) var ids for edge_var
     for b in arrays.buckets:
         a = b.arity
-        n = b.cubes.shape[0]
-        groups = [list(range(g, n, tp)) for g in range(tp)]
-        fmax = max(len(g) for g in groups) if groups else 0
+        idx = _round_robin(b.cubes.shape[0], tp)
+        fmax = idx.shape[1]
+        valid = idx >= 0
         cubes = np.full((tp, fmax) + (D,) * a, BIG, dtype=np.float32)
-        edge_ids = np.zeros((tp, fmax, a), dtype=np.int32)
         var_ids = np.full((tp, fmax, a), V, dtype=np.int32)
-        for g in range(tp):
-            for slot, fi in enumerate(groups[g]):
-                cubes[g, slot] = b.cubes[fi]
-                var_ids[g, slot] = b.var_ids[fi]
-            # assign local edge ids for every slot (incl. dummies)
-            for slot in range(fmax):
-                for p in range(a):
-                    edge_ids[g, slot, p] = edge_count[g]
-                    edge_count[g] += 1
-        shard_buckets.append(_ShardedBucket(a, cubes, edge_ids, var_ids))
-    e_loc = max(edge_count) if edge_count else 0
-    # edge_var per shard: (TP, E_loc)
-    edge_var = np.full((tp, e_loc), V, dtype=np.int32)
-    for sb in shard_buckets:
-        a = sb.arity
-        for g in range(tp):
-            for slot in range(sb.cubes.shape[1]):
-                for p in range(a):
-                    edge_var[g, sb.edge_ids[g, slot, p]] = \
-                        sb.var_ids[g, slot, p]
+        cubes[valid] = b.cubes[idx[valid]]
+        var_ids[valid] = b.var_ids[idx[valid]]
+        shard_buckets.append(_ShardedBucket(a, cubes, offset, var_ids))
+        blocks.append(var_ids.reshape(tp, fmax * a))
+        offset += fmax * a
+    e_loc = offset
+    edge_var = (np.concatenate(blocks, axis=1) if blocks
+                else np.full((tp, 0), V, dtype=np.int32)).astype(np.int32)
     return shard_buckets, edge_var, e_loc
 
 
 class ShardedMaxSum:
     """MaxSum over a (dp, tp) mesh.
 
-    ``cost_cubes_batch`` may carry a leading batch axis (B,) of
-    per-instance cost-table variations sharing the topology; B must be a
-    multiple of the mesh's dp size.
+    Parameters mirror the single-chip solver
+    (``algorithms/maxsum.py``): ``damping`` / ``damping_nodes`` /
+    ``stability`` / ``noise``; ``layout`` picks the shard-local state
+    layout (``edge_major`` or ``lane_major``; ``auto`` = lane-major when
+    all factor arities are <= 2, like ``build_solver``).
+
+    ``batch`` independent instances ride the dp axis (must be a multiple
+    of the mesh's dp size).
     """
 
     def __init__(self, arrays: FactorGraphArrays, mesh,
-                 damping: float = 0.5, batch: int = 1):
+                 damping: float = 0.5, damping_nodes: str = "vars",
+                 stability: float = 0.1, noise: float = 0.0,
+                 layout: str = "auto", batch: int = 1):
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
         self.damping = float(damping)
+        self.damping_nodes = damping_nodes
+        # damping-invariant convergence threshold, same rule as the
+        # single-chip solver (algorithms/maxsum.py:64-70)
+        self.stability = float(stability)
+        if damping_nodes in ("vars", "both") and 0 < damping < 1:
+            self.stability *= (1 - float(damping))
+        self.noise = float(noise)
         self.V = arrays.n_vars
         self.D = arrays.max_domain
         if batch % self.dp != 0:
@@ -107,6 +127,13 @@ class ShardedMaxSum:
         self.E_loc = e_loc
         self.buckets = shard_buckets
         self.edge_var = edge_var                        # (TP, E_loc)
+        if layout == "auto":
+            layout = "lane_major" if all(
+                sb.arity <= 2 for sb in shard_buckets) else "edge_major"
+        if layout == "lane_major" and any(
+                sb.arity > 2 for sb in shard_buckets):
+            raise ValueError("lane_major needs arities <= 2")
+        self.layout = layout
 
         vc = np.concatenate(
             [arrays.var_costs,
@@ -121,26 +148,25 @@ class ShardedMaxSum:
 
         self._build_step()
 
+    # ------------------------------------------------------------ state
+
     def _device_put(self):
         """Shard the state and constants onto the mesh."""
-        from jax.sharding import NamedSharding
-
         B, TP, E, D = self.B, self.tp, self.E_loc, self.D
         mesh = self.mesh
         mask_e = self.domain_mask[self.edge_var]        # (TP, E, D)
         q0 = np.where(mask_e, 0.0, BIG).astype(np.float32)
+        r0 = np.zeros_like(q0)
         q0 = np.broadcast_to(q0[None], (B, TP, E, D)).copy()
+        r0 = np.broadcast_to(r0[None], (B, TP, E, D)).copy()
         sh = NamedSharding(mesh, P("dp", "tp"))
-        q = jax.device_put(q0, sh)
+        state = {"q": jax.device_put(q0, sh),
+                 "r": jax.device_put(r0, sh)}
         consts = {
             "edge_var": jax.device_put(
                 self.edge_var, NamedSharding(mesh, P("tp"))),
             "cubes": [
                 jax.device_put(sb.cubes, NamedSharding(mesh, P("tp")))
-                for sb in self.buckets
-            ],
-            "edge_ids": [
-                jax.device_put(sb.edge_ids, NamedSharding(mesh, P("tp")))
                 for sb in self.buckets
             ],
             "var_costs": jax.device_put(
@@ -151,28 +177,69 @@ class ShardedMaxSum:
             "domain_size": jax.device_put(
                 jnp.asarray(self.domain_size), NamedSharding(mesh, P())),
         }
-        return q, consts
+        return state, consts
+
+    # ------------------------------------------------------------- step
+
+    def _factor_update_edge_major(self, q, cubes):
+        """(E, D) layout: per-bucket factor_messages, canonical slices."""
+        E, D = self.E_loc, self.D
+        blocks = []
+        for sb, cu in zip(self.buckets, cubes):
+            a = sb.arity
+            if a == 0:
+                continue
+            f = cu.shape[0]
+            q_blk = q[sb.offset:sb.offset + f * a].reshape(f, a, D)
+            msgs = factor_messages(cu, [q_blk[:, p] for p in range(a)])
+            blocks.append(jnp.stack(msgs, axis=1).reshape(f * a, D))
+        if not blocks:
+            return jnp.zeros((E, D), dtype=q.dtype)
+        return blocks[0] if len(blocks) == 1 else \
+            jnp.concatenate(blocks, axis=0)
+
+    def _factor_update_lane_major(self, qT, cubes):
+        """(D, E) layout: lane kernels, same math as MaxSumLaneSolver."""
+        from ..ops.pallas_kernels import \
+            factor_messages_binary_lane_major_ref
+
+        D, E = self.D, self.E_loc
+        blocks = []
+        for sb, cu in zip(self.buckets, cubes):
+            a = sb.arity
+            if a == 0:
+                continue
+            f = cu.shape[0]
+            if a == 1:
+                blocks.append(jnp.transpose(cu))            # (D, F)
+                continue
+            cubesT = jnp.transpose(cu, (1, 2, 0))           # (D, D, F)
+            q_blk = qT[:, sb.offset:sb.offset + 2 * f]
+            q0, q1 = q_blk[:, 0::2], q_blk[:, 1::2]
+            m0, m1 = factor_messages_binary_lane_major_ref(cubesT, q0, q1)
+            blocks.append(jnp.stack([m0, m1], axis=2)
+                          .reshape(D, 2 * f))
+        if not blocks:
+            return jnp.zeros((D, E), dtype=qT.dtype)
+        return blocks[0] if len(blocks) == 1 else \
+            jnp.concatenate(blocks, axis=1)
 
     def _build_step(self):
         V, D, E = self.V, self.D, self.E_loc
-        damping = self.damping
-        arities = [sb.arity for sb in self.buckets]
+        damping, damping_nodes = self.damping, self.damping_nodes
+        noise = self.noise
+        lane = self.layout == "lane_major"
 
-        def local_step(q, edge_var, cubes, edge_ids, var_costs,
+        def local_step(q, r, key, edge_var, cubes, var_costs,
                        domain_mask, domain_size):
-            # q: (B_loc, E, D); edge_var: (E,); cubes[i]: (F, D..)
-            # factor->var messages (new_r) are recomputed from q each
-            # step, never carried: damping applies on the var->factor
-            # side only, matching the single-chip solver
-            def one(q1):
-                new_r = jnp.zeros((E, D), dtype=q1.dtype)
-                for a, cu, ei in zip(arities, cubes, edge_ids):
-                    if a == 0:
-                        continue
-                    q_in = [q1[ei[:, p]] for p in range(a)]
-                    msgs = factor_messages(cu, q_in)
-                    for p in range(a):
-                        new_r = new_r.at[ei[:, p]].set(msgs[p])
+            # q, r: (B_loc, E, D); edge_var: (E,)
+            def one(q1, r1, k1):
+                new_r = self._factor_update_edge_major(q1, cubes) \
+                    if not lane else jnp.transpose(
+                        self._factor_update_lane_major(
+                            jnp.transpose(q1), cubes))
+                if damping_nodes in ("factors", "both") and damping > 0:
+                    new_r = damping * r1 + (1 - damping) * new_r
                 partial_sum = jax.ops.segment_sum(
                     new_r, edge_var, num_segments=V + 1)
                 sum_r = jax.lax.psum(partial_sum, "tp")
@@ -182,69 +249,94 @@ class ShardedMaxSum:
                 mean = (jnp.sum(jnp.where(mask_e, q_new, 0.0), axis=1)
                         / domain_size[edge_var])
                 q_new = q_new - mean[:, None]
-                q_new = damping * q1 + (1 - damping) * q_new
+                if noise > 0:
+                    # per-(shard, instance) streams: edges are split
+                    # across devices so one global stream cannot exist
+                    tp_idx = jax.lax.axis_index("tp")
+                    sub = jax.random.fold_in(k1, tp_idx)
+                    q_new = q_new + noise * jax.random.uniform(
+                        sub, q_new.shape)
+                if damping_nodes in ("vars", "both") and damping > 0:
+                    q_new = damping * q1 + (1 - damping) * q_new
                 q_new = jnp.where(mask_e, q_new, BIG)
                 sel = jnp.argmin(
                     jnp.where(domain_mask[:V], belief[:V], BIG * 2),
                     axis=-1)
-                return q_new, sel
+                delta_local = jnp.max(
+                    jnp.where(mask_e, jnp.abs(q_new - q1), 0.0)) \
+                    if E else jnp.float32(0)
+                delta = jax.lax.pmax(delta_local, "tp")
+                return q_new, new_r, sel, delta
 
-            return jax.vmap(one)(q)
+            dp_idx = jax.lax.axis_index("dp")
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(key, dp_idx), i))(
+                jnp.arange(q.shape[0]))
+            return jax.vmap(one)(q, r, keys)
 
         @partial(
             jax.shard_map, mesh=self.mesh,
             in_specs=(
-                P("dp", "tp"), P("tp"),
-                [P("tp") for _ in self.buckets],
+                P("dp", "tp"), P("dp", "tp"), P(), P("tp"),
                 [P("tp") for _ in self.buckets],
                 P(), P(), P(),
             ),
-            out_specs=(P("dp", "tp"), P("dp")),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
         )
-        def sharded(q, edge_var, cubes, edge_ids, var_costs,
+        def sharded(q, r, key, edge_var, cubes, var_costs,
                     domain_mask, domain_size):
-            # local blocks: q (B_loc, 1, E, D); squeeze the tp axis
-            q_l = q[:, 0]
-            cubes_l = [c[0] for c in cubes]
-            eids_l = [e[0] for e in edge_ids]
-            q2, sel = local_step(
-                q_l, edge_var[0], cubes_l, eids_l,
+            q2, r2, sel, delta = local_step(
+                q[:, 0], r[:, 0], key, edge_var[0],
+                [c[0] for c in cubes],
                 var_costs, domain_mask, domain_size)
-            return q2[:, None], sel
+            return q2[:, None], r2[:, None], sel, delta
 
         self._step = jax.jit(sharded)
 
-    def run(self, n_cycles: int, tol: float = 1e-2
+    # -------------------------------------------------------------- run
+
+    def run(self, n_cycles: int, seed: int = 0
             ) -> Tuple[np.ndarray, int]:
-        """Run up to ``n_cycles``, returning ((B, V) selections, cycles)."""
-        q, consts = self._device_put()
-        args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
+        """Run until SAME_COUNT-stable (same convergence rule as the
+        single-chip solver: selection unchanged AND message delta below
+        the stability threshold) or ``n_cycles``.
+
+        Returns ((B, V) selections, cycles run)."""
+        state, consts = self._device_put()
+        q, r = state["q"], state["r"]
+        args = (consts["edge_var"], consts["cubes"],
                 consts["var_costs"], consts["domain_mask"],
                 consts["domain_size"])
+        key = jax.random.PRNGKey(seed)
         prev_sel = None
         same = 0
         cycle = 0
         sel = None
         while cycle < n_cycles:
-            q, sel = self._step(q, *args)
+            key, sub = jax.random.split(key)
+            q, r, sel, delta = self._step(q, r, sub, *args)
             cycle += 1
-            if cycle % 8 == 0 or cycle == n_cycles:
-                sel_h = np.asarray(jax.device_get(sel))
-                if prev_sel is not None and np.array_equal(sel_h, prev_sel):
-                    same += 1
-                    if same >= SAME_COUNT:
-                        break
-                else:
-                    same = 0
-                prev_sel = sel_h
+            sel_h = np.asarray(jax.device_get(sel))
+            delta_h = float(np.max(np.asarray(jax.device_get(delta))))
+            if prev_sel is not None and \
+                    np.array_equal(sel_h, prev_sel) and \
+                    delta_h < self.stability:
+                same += 1
+                if same >= SAME_COUNT:
+                    break
+            else:
+                same = 0
+            prev_sel = sel_h
         return np.asarray(jax.device_get(sel)), cycle
 
-    def step_once(self):
+    def step_once(self, seed: int = 0):
         """One sharded step (for compile-checking the multi-chip path)."""
-        q, consts = self._device_put()
-        args = (consts["edge_var"], consts["cubes"], consts["edge_ids"],
+        state, consts = self._device_put()
+        args = (consts["edge_var"], consts["cubes"],
                 consts["var_costs"], consts["domain_mask"],
                 consts["domain_size"])
-        q, sel = self._step(q, *args)
+        q, r, sel, _delta = self._step(
+            state["q"], state["r"], jax.random.PRNGKey(seed), *args)
         jax.block_until_ready(sel)
         return np.asarray(jax.device_get(sel))

@@ -483,3 +483,95 @@ def test_run_dcop_process_mode_dpop_real_messages():
                       distribution="oneagent", timeout=90)
     assert result.metrics["status"] == "FINISHED"
     assert result.assignment in VALID_GC3
+
+
+# ---- round 3: fabric vs engine cross-checks (VERDICT r2 item 7) ------
+
+
+def _random_coloring_yaml(n=20, colors=("R", "G", "B"), seed=4):
+    """Ring + chords coloring instance, deterministic for a seed."""
+    import random as _r
+
+    rnd = _r.Random(seed)
+    lines = ["name: xcheck", "objective: min", "domains:",
+             f"  colors: {{values: [{', '.join(colors)}]}}",
+             "variables:"]
+    for i in range(n):
+        lines.append(f"  v{i:02d}: {{domain: colors}}")
+    lines.append("constraints:")
+    edges = [(i, (i + 1) % n) for i in range(n)]
+    extra = set()
+    while len(extra) < n // 2:
+        a, b = rnd.sample(range(n), 2)
+        if (a, b) not in edges and (b, a) not in edges:
+            extra.add((min(a, b), max(a, b)))
+    for a, b in edges + sorted(extra):
+        lines.append(
+            f"  c{a:02d}_{b:02d}: {{type: intention, "
+            f"function: 1 if v{a:02d} == v{b:02d} else 0}}")
+    lines.append("agents:")
+    for i in range(n):
+        lines.append(f"  ag{i:02d}: {{capacity: 100}}")
+    return "\n".join(lines)
+
+
+def test_fabric_matches_engine_cost_envelope():
+    """Same 20-var instance through the compiled engine and the thread
+    fabric: both must reach comparably low conflict counts under the
+    same seed (the fabric is the reference's execution model, the
+    engine is the data plane — they must agree on solution quality)."""
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    yaml_src = _random_coloring_yaml()
+    engine = solve_result(load_dcop(yaml_src), "dsa", timeout=30,
+                          stop_cycle=50, seed=11)
+    fabric = run_dcop(load_dcop(yaml_src), "dsa",
+                      distribution="oneagent", timeout=60,
+                      stop_cycle=50, seed=11)
+    assert fabric.metrics["status"] == "FINISHED"
+    assert set(fabric.assignment) == set(engine.assignment)
+    # 3-coloring of a ring+chords instance: both paths should settle
+    # near zero conflicts within 50 cycles
+    assert engine.violations <= 2
+    assert fabric.violations <= 2
+
+
+def test_maxsum_mp_arity3_factor():
+    """Sync maxsum backend with a 3-ary factor: the multi-axis
+    min-reduction in MaxSumFactorMpComputation._send_marginals
+    (maxsum.py) must produce a consistent optimum."""
+    src = """
+name: arity3
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x1: {domain: d, cost_function: 0.1 * x1}
+  x2: {domain: d, cost_function: 0.2 * x2}
+  x3: {domain: d, cost_function: 0.4 * x3}
+constraints:
+  odd: {type: intention, function: 0 if (x1 + x2 + x3) % 2 == 1 else 5}
+agents: [a1, a2, a3, a4]
+"""
+    result = run_dcop(load_dcop(src), "maxsum", timeout=30, seed=2)
+    assert result.metrics["status"] == "FINISHED"
+    # unique optimum of the tree: x1=1, x2=0, x3=0 (cost 0.1) — exact
+    # for max-sum on a tree, so the arity-3 min-reduction must find it
+    assert result.assignment == {"x1": 1, "x2": 0, "x3": 0}
+
+
+def test_scenario_agent_removal_dsa_backend():
+    """Repair path with a real mp backend: after an agent removal the
+    orphaned DSA computation re-deploys from its replica and rejoins
+    via the sync-mixin fast-forward."""
+    from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+
+    dcop = load_dcop(GC3)
+    scenario = Scenario([
+        DcopEvent("e1", delay=1.5,
+                  actions=[EventAction("remove_agent", agent="a1")]),
+    ])
+    result = run_dcop(dcop, "dsa", timeout=45, ktarget=1,
+                      scenario=scenario, stop_cycle=200, seed=6)
+    # the run survives the removal and still produces a full assignment
+    assert set(result.assignment) == {"v1", "v2", "v3"}
