@@ -104,6 +104,40 @@ def bench_dpop_meetings(quick=False):
     }
 
 
+def bench_dpop_device_widetree(quick=False):
+    """BASELINE config 3 at the scale where the device UTIL sweep pays:
+    wide-separator meeting scheduling (5 GB top table at slots=20).
+    Reports the host-numpy path and the jitted device-spine path (cold
+    = includes the one-time XLA compile; warm = steady state, the
+    deployment regime where the same problem shape re-solves)."""
+    import time as _time
+
+    from pydcop_tpu.algorithms.dpop import solve_direct
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+
+    slots = 12 if quick else 20
+    dcop = generate_meetings(
+        slots_count=slots, events_count=150, resources_count=120,
+        max_resources_event=2, seed=13)
+    limit = 1_400_000_000
+    r_cold = solve_direct(dcop, {"device": "jax"}, memory_limit=limit,
+                          timeout=900)
+    r_warm = solve_direct(dcop, {"device": "jax"}, memory_limit=limit,
+                          timeout=900)
+    r_host = solve_direct(dcop, {"device": "host"}, memory_limit=limit,
+                          timeout=900)
+    assert abs(r_host.cost - r_warm.cost) < 1e-3
+    return {
+        "metric": f"dpop_device_widetree_slots{slots}_seconds",
+        "value": round(r_warm.duration, 3), "unit": "s",
+        "host_seconds": round(r_host.duration, 3),
+        "device_cold_seconds": round(r_cold.duration, 3),
+        "device_speedup_warm": round(
+            r_host.duration / r_warm.duration, 1),
+        "cost": r_warm.cost, "violations": r_warm.violations,
+    }
+
+
 def bench_localsearch_10k(quick=False):
     import jax
 
@@ -174,6 +208,7 @@ def bench_batched(quick=False):
 
 
 BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
+           bench_dpop_device_widetree,
            bench_dpop_meetings, bench_localsearch_10k, bench_batched]
 
 

@@ -41,6 +41,11 @@ GRAPH_TYPE = "pseudotree"
 
 algo_params = []
 
+#: compiled spine programs, keyed by the spine's structural signature —
+#: re-solving the same problem shape (the normal batch/bench pattern)
+#: reuses the executable instead of re-tracing and re-compiling
+_SPINE_CACHE: Dict[Any, Any] = {}
+
 #: device path kicks in when the predicted UTIL work crosses this many
 #: table cells — below it, per-level dispatch overhead beats the win
 DEVICE_AUTO_CELLS = 2_000_000
@@ -92,83 +97,291 @@ def _domain_sizes(g):
     return sizes
 
 
+def _pack_input(arr: np.ndarray, dims, out_dims, sizes):
+    """Host-side prep of one input table for the packed device layout.
+
+    The device table's two minormost dims (last separator dim, own
+    variable) are merged into one axis of size ``s_last * s_own`` so the
+    minor dim is a lane-friendly multiple of 128 instead of a tiny
+    domain that TPU tiling would pad 8x (a 1 GB table would occupy
+    8 GB of HBM in naive (…, 16, 16) layout).  Inputs touching either
+    merged dim are expanded over BOTH (inputs are small — constraint
+    matrices and child utils, far below the table size) and reshaped so
+    their last axis is the merged pair; all other dims map one-to-one.
+
+    Returns (packed array, packed axis positions).
+    """
+    pair = out_dims[-2:]
+    axis_of = {d: i for i, d in enumerate(out_dims)}
+    # sort input dims into output order first
+    order = sorted(range(len(dims)), key=lambda i: axis_of[dims[i]])
+    if order != list(range(len(dims))):
+        arr = np.transpose(arr, order)
+        dims = tuple(dims[i] for i in order)
+    touches = [d for d in dims if d in pair]
+    lead = [d for d in dims if d not in pair]
+    n_packed_axes = len(out_dims) - 1
+    if not touches:
+        return arr, tuple(axis_of[d] for d in dims)
+    # expand over the full merged pair, then fold it into one axis
+    shape = tuple(arr.shape[: len(lead)]) + tuple(
+        arr.shape[len(lead) + touches.index(d)] if d in touches else 1
+        for d in pair)
+    arr = arr.reshape(shape)
+    full = tuple(arr.shape[: len(lead)]) + tuple(
+        sizes[d] for d in pair)
+    arr = np.ascontiguousarray(np.broadcast_to(arr, full))
+    arr = arr.reshape(arr.shape[: len(lead)] + (-1,))
+    positions = tuple(axis_of[d] for d in lead) + (n_packed_axes - 1,)
+    return arr, positions
+
+
 def device_util_sweep(g, var_cost_rel, mode: str,
-                      memory_limit: int = 10 ** 8):
-    """UTIL phase on the accelerator: per tree level, nodes are grouped
-    by their join *signature* (output shape + every input's shape and
-    axis mapping) and each group runs as ONE jitted stacked
-    broadcast-add + axis-min over all its nodes — the batching that
-    makes tiny per-node tables worth a device dispatch
-    (VERDICT r2 item 3; the reference's joins are per-cell Python
+                      memory_limit: int = 10 ** 8,
+                      node_device_cells: int = 200_000):
+    """Hybrid UTIL/VALUE split: the pseudo-tree *spine* — every node
+    whose table crosses ``node_device_cells`` plus all its ancestors up
+    to the root — runs as ONE jitted device program (joins, projections
+    AND the top-down VALUE slicing), everything below runs in vectorized
+    numpy (VERDICT r2 item 3; the reference's joins are per-cell Python
     loops, relations.py:1672-1760).
 
-    Returns {node name: joined numpy table over plan out_dims}.
-    """
-    import jax
-    import jax.numpy as jnp
+    Why this shape: real pseudo-trees are skewed — one or two
+    wide-separator nodes near the root own almost all the work — and on
+    a tunneled TPU the wires dominate: a 67 MB util costs ~2 s to
+    download and every eager dispatch ~70 ms, while the chip crunches a
+    1 GB table join in ~0.1 s.  So big tables must never cross the
+    tunnel and the whole spine must be one dispatch.  Tables are held
+    in the lane-packed layout (last separator dim and own variable
+    merged into one >=256-wide minor axis) because naive (…, 16, 16)
+    tiling pads the minor dim 8x.
 
+    Returns (plans, host_joined, spine_assignment) where
+    ``spine_assignment`` maps spine node names to chosen value indices
+    and ``host_joined`` carries the numpy joined tables of non-spine
+    nodes for the host VALUE phase.
+    """
     plans = _util_plans(g, var_cost_rel)
     sizes = _domain_sizes(g)
-    reduce_fn = jnp.min if mode == "min" else jnp.max
 
-    def run_group(out_shape, input_specs, stacked):
-        # eager (unjitted) device ops: one dispatch per input, no
-        # per-signature compilation — real DCOP trees are heterogeneous
-        # enough (dozens of distinct signatures) that tracing each
-        # would cost more than the whole sweep
-        n = stacked[0].shape[0]
-        total = jnp.zeros((n,) + out_shape, dtype=jnp.float32)
-        for arr, (_shape, bdims) in zip(stacked, input_specs):
-            total = total + jax.lax.broadcast_in_dim(
-                jnp.asarray(arr), (n,) + out_shape,
-                (0,) + tuple(d + 1 for d in bdims))
-        return total, reduce_fn(total, axis=-1)
+    # ---- spine membership: big nodes + ancestors (upward-closed) ----
+    cells_of = {}
+    for name, plan in plans.items():
+        cells_of[name] = int(np.prod(
+            [sizes[d] for d in plan["out_dims"]]))
+        if cells_of[name] > memory_limit:
+            raise MemoryError(
+                f"DPOP UTIL table for {name} exceeds memory limit")
+    spine = set()
+    for level in reversed(g.depth_ordered()):
+        for node in level:
+            if cells_of[node.name] >= node_device_cells or any(
+                    c in spine for c in node.children):
+                spine.add(node.name)
 
-    joined_of = {}
+    def np_reduce_last(total):
+        return (np.min if mode == "min" else np.max)(total, axis=-1)
+
+    # ---- host part: all non-spine nodes, bottom-up ------------------
+    host_joined = {}
     util_of = {}
     for level in reversed(g.depth_ordered()):
-        groups = {}
         for node in level:
+            if node.name in spine:
+                continue
             plan = plans[node.name]
             out_dims = plan["out_dims"]
             out_shape = tuple(sizes[d] for d in out_dims)
-            if int(np.prod(out_shape)) > memory_limit:
-                raise MemoryError(
-                    f"DPOP UTIL table for {node.name} exceeds memory "
-                    f"limit: shape {out_shape}")
             axis_of = {d: i for i, d in enumerate(out_dims)}
-            specs = []
-            arrays = []
+            total = np.zeros(out_shape, dtype=np.float32)
             for kind, payload, dims in plan["inputs"]:
-                arr = payload if kind == "const" else util_of[payload]
+                arr = np.asarray(
+                    payload if kind == "const" else util_of[payload],
+                    dtype=np.float32)
                 positions = [axis_of[d] for d in dims]
-                # broadcast_in_dim needs strictly increasing target
-                # axes: pre-transpose on host into output-axis order
                 perm = sorted(range(len(positions)),
                               key=lambda i: positions[i])
                 if perm != list(range(len(positions))):
-                    arr = np.ascontiguousarray(
-                        np.transpose(arr, perm))
+                    arr = np.transpose(arr, perm)
                     positions = [positions[i] for i in perm]
-                specs.append((tuple(arr.shape), tuple(positions)))
-                arrays.append(arr)
-            sig = (out_shape, tuple(specs))
-            groups.setdefault(sig, []).append((node.name, arrays))
-        for (out_shape, specs), members in groups.items():
-            stacked = [
-                np.stack([arrays[i] for _, arrays in members])
-                for i in range(len(specs))
-            ]
-            joined, util = run_group(out_shape, specs, stacked)
-            # utils feed the next level's joins (host staging keeps the
-            # level loop simple; the math itself ran on device); joined
-            # tables come back for the host VALUE slicing
-            joined = np.asarray(jax.device_get(joined))
-            util = np.asarray(jax.device_get(util))
-            for row, (name, _) in enumerate(members):
-                joined_of[name] = joined[row]
-                util_of[name] = util[row]
-    return plans, joined_of
+                shape = [1] * len(out_shape)
+                for ax, size in zip(positions, arr.shape):
+                    shape[ax] = size
+                total = total + arr.reshape(shape)
+            host_joined[node.name] = total
+            util_of[node.name] = np_reduce_last(total)
+
+    spine_assignment = {}
+    if spine:
+        spine_assignment = _run_spine(
+            g, plans, sizes, spine, util_of, mode)
+    return plans, host_joined, spine_assignment
+
+
+def _run_spine(g, plans, sizes, spine, host_util_of, mode):
+    """Compile + run the spine as one device program.  The jitted
+    function takes every external input table as an argument (host
+    utils of the spine's children, constraint matrices, unary costs),
+    runs the bottom-up packed joins and the top-down VALUE argmins
+    on-device, and returns one value index per spine node."""
+    import jax
+    import jax.numpy as jnp
+
+    # bottom-up and top-down spine orders
+    bottom_up = [n for level in reversed(g.depth_ordered())
+                 for n in level if n.name in spine]
+    top_down = list(reversed(bottom_up))
+
+    # external inputs, flattened in a stable order
+    ext_arrays = []
+    ext_index = {}
+
+    def ext(arr):
+        key = id(arr)
+        if key not in ext_index:
+            ext_index[key] = len(ext_arrays)
+            ext_arrays.append(np.asarray(arr, dtype=np.float32))
+        return ext_index[key]
+
+    node_specs = []
+    for node in bottom_up:
+        plan = plans[node.name]
+        out_dims = plan["out_dims"]
+        packed = len(out_dims) >= 2
+        inputs = []
+        for kind, payload, dims in plan["inputs"]:
+            if kind == "child" and payload in spine:
+                inputs.append(("spine", payload, tuple(dims)))
+            else:
+                arr = payload if kind == "const" \
+                    else host_util_of[payload]
+                if packed:
+                    arr2, positions = _pack_input(
+                        np.asarray(arr, dtype=np.float32), tuple(dims),
+                        out_dims, sizes)
+                    inputs.append(("ext", ext(arr2), positions))
+                else:
+                    a = np.asarray(arr, dtype=np.float32)
+                    inputs.append(("ext", ext(a),
+                                   tuple(range(a.ndim))))
+        node_specs.append((node.name, out_dims, packed, inputs,
+                           list(node.children)))
+
+    dom_sizes = sizes
+
+    def spine_fn(*args):
+        util = {}
+        joined = {}
+        sep_layout = {}
+        for name, out_dims, packed, inputs, _children in node_specs:
+            s_own = dom_sizes[out_dims[-1]]
+            if packed:
+                shape = tuple(dom_sizes[d] for d in out_dims[:-2]) + (
+                    dom_sizes[out_dims[-2]] * s_own,)
+            else:
+                shape = (s_own,)
+            total = jnp.zeros(shape, dtype=jnp.float32)
+            for kind, ref, positions in inputs:
+                if kind == "ext":
+                    arr = args[ref]
+                else:
+                    # a spine child's util, resident on device, over its
+                    # (sorted) separator dims; pack it for this node
+                    arr = util[ref]
+                    arr, positions = _pack_traced(
+                        arr, sep_layout[ref], out_dims, dom_sizes)
+                total = total + jax.lax.broadcast_in_dim(
+                    arr, shape, positions)
+            joined[name] = total
+            if packed:
+                window = (1,) * (total.ndim - 1) + (s_own,)
+                init = jnp.inf if mode == "min" else -jnp.inf
+                comp = jax.lax.min if mode == "min" else jax.lax.max
+                u = jax.lax.reduce_window(
+                    total, init, comp, window_dimensions=window,
+                    window_strides=window, padding="VALID")
+            else:
+                u = (jnp.min if mode == "min" else jnp.max)(total)
+            util[name] = u
+            sep_layout[name] = tuple(out_dims[:-1])
+
+        # ---- VALUE: top-down argmin slicing, all on device ----------
+        chosen = {}
+        out = []
+        for name, out_dims, packed, _inputs, _children in \
+                reversed(node_specs):
+            table = joined[name]
+            s_own = dom_sizes[out_dims[-1]]
+            if packed:
+                starts = [chosen[d] if d in chosen else 0
+                          for d in out_dims[:-2]]
+                last_sep = chosen.get(out_dims[-2], 0)
+                starts = [jnp.asarray(i, dtype=jnp.int32)
+                          for i in starts]
+                starts.append(jnp.asarray(last_sep * s_own,
+                                          dtype=jnp.int32)
+                              if not isinstance(last_sep, int)
+                              else jnp.asarray(last_sep * s_own,
+                                               dtype=jnp.int32))
+                sizes_slice = (1,) * (table.ndim - 1) + (s_own,)
+                block = jax.lax.dynamic_slice(table, starts,
+                                              sizes_slice)
+                costs = block.reshape(-1)
+            else:
+                costs = table
+            idx = (jnp.argmin if mode == "min" else jnp.argmax)(costs)
+            chosen[name] = idx
+            out.append(idx)
+        return jnp.stack(out)
+
+    sig = (mode, tuple(
+        (name, tuple(out_dims), packed,
+         tuple((k, r if k == "spine" else ext_arrays[r].shape, p)
+               for k, r, p in inputs))
+        for name, out_dims, packed, inputs, _ch in node_specs))
+    fitted = _SPINE_CACHE.get(sig)
+    if fitted is None:
+        fitted = jax.jit(spine_fn)
+        _SPINE_CACHE[sig] = fitted
+    idxs = np.asarray(jax.device_get(fitted(*[
+        jnp.asarray(a) for a in ext_arrays])))
+    names_top_down = [spec[0] for spec in reversed(node_specs)]
+    return dict(zip(names_top_down, (int(i) for i in idxs)))
+
+
+def _pack_traced(arr, arr_dims, out_dims, sizes):
+    """Device-side counterpart of :func:`_pack_input` for a spine
+    child's util (a traced jax array over ``arr_dims``): transpose into
+    output order, expand over the merged (last separator, own) pair and
+    fold it, returning (packed array, packed axis positions)."""
+    import jax
+    import jax.numpy as jnp
+
+    axis_of = {d: i for i, d in enumerate(out_dims)}
+    if len(out_dims) < 2:
+        # unpacked (single-dim) parent: direct axis mapping
+        return arr, tuple(axis_of[d] for d in arr_dims)
+    pair = out_dims[-2:]
+    order = sorted(range(len(arr_dims)),
+                   key=lambda i: axis_of[arr_dims[i]])
+    if order != list(range(len(arr_dims))):
+        arr = jnp.transpose(arr, order)
+        arr_dims = tuple(arr_dims[i] for i in order)
+    touches = [d for d in arr_dims if d in pair]
+    lead = [d for d in arr_dims if d not in pair]
+    n_packed_axes = len(out_dims) - 1
+    if not touches:
+        return arr, tuple(axis_of[d] for d in arr_dims)
+    shape = tuple(arr.shape[: len(lead)]) + tuple(
+        arr.shape[len(lead) + touches.index(d)] if d in touches else 1
+        for d in pair)
+    arr = arr.reshape(shape)
+    full = tuple(arr.shape[: len(lead)]) + tuple(
+        sizes[d] for d in pair)
+    arr = jnp.broadcast_to(arr, full)
+    arr = arr.reshape(arr.shape[: len(lead)] + (-1,))
+    positions = tuple(axis_of[d] for d in lead) + (n_packed_axes - 1,)
+    return arr, positions
 
 
 def computation_memory(*args, **kwargs):
@@ -292,11 +505,12 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
 def _solve_device(dcop, g, var_cost_rel, mode, memory_limit, t0,
                   timeout):
-    """Device path: batched UTIL sweep on the accelerator, VALUE phase
-    host-side over the returned joined tables (tiny slicing argmins)."""
+    """Device path: the wide spine runs as one jitted device program
+    (UTIL joins + VALUE argmins); the host finishes the VALUE walk for
+    the small subtrees below it."""
     import time
 
-    plans, joined_of = device_util_sweep(
+    plans, host_joined, spine_assignment = device_util_sweep(
         g, var_cost_rel, mode, memory_limit=memory_limit)
     levels = g.depth_ordered()
     dom_index = {
@@ -308,8 +522,17 @@ def _solve_device(dcop, g, var_cost_rel, mode, memory_limit, t0,
     msg_count, msg_size = 0, 0
     for level in levels:
         for node in level:
-            arr = joined_of[node.name]
             dims = plans[node.name]["out_dims"]
+            if node.name in spine_assignment:
+                i = spine_assignment[node.name]
+                assignment[node.name] = node.variable.domain.values[i]
+                if not node.is_root:
+                    msg_count += 2
+                    sizes = [len(g.node(d).variable.domain)
+                             for d in dims[:-1]]
+                    msg_size += int(np.prod(sizes)) if sizes else 1
+                continue
+            arr = host_joined[node.name]
             idx = tuple(
                 dom_index[d][assignment[d]] if d != node.name
                 else slice(None) for d in dims)

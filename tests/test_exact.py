@@ -151,3 +151,28 @@ def test_amaxsum_gc3():
     dcop = load_dcop(GC3)
     res = solve_result(dcop, "amaxsum", timeout=20, max_cycles=200)
     assert res.assignment == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_dpop_device_spine_matches_host():
+    """The jitted device-spine UTIL/VALUE path must agree exactly with
+    the host-numpy path (forced low threshold so the spine covers the
+    tree even on a small instance)."""
+    import functools
+
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+
+    dcop = generate_meetings(slots_count=5, events_count=30,
+                             resources_count=30,
+                             max_resources_event=2, seed=3)
+    r_host = dpop.solve_direct(dcop, {"device": "host"}, timeout=60)
+    orig = dpop.device_util_sweep
+    dpop.device_util_sweep = functools.partial(
+        orig, node_device_cells=50)
+    try:
+        r_dev = dpop.solve_direct(dcop, {"device": "jax"}, timeout=60)
+    finally:
+        dpop.device_util_sweep = orig
+    assert r_dev.metrics.get("device") == "jax"
+    assert abs(r_host.cost - r_dev.cost) < 1e-6
+    assert r_dev.violations == r_host.violations
