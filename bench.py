@@ -15,6 +15,7 @@ jitted step, so msgs/sec = 2 * E * cycles / elapsed.
 import json
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -49,7 +50,10 @@ def tpu_run():
     # while-loop still evaluates convergence every cycle on device)
     k = 60
 
-    @jax.jit
+    # donate the state pytree: the step is a pure in-place update, so
+    # XLA reuses the message buffers instead of allocating per call
+    # (measured 77.7 -> 87.6 M msgs/s on-chip)
+    @partial(jax.jit, donate_argnums=0)
     def run_k(s):
         return jax.lax.fori_loop(0, k, lambda i, st: solver.step(st), s)
 
