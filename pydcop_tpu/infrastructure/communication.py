@@ -264,7 +264,7 @@ class HttpCommunicationLayer(CommunicationLayer):
                    "dest-agent": str(dest_agent),
                    "prio": str(prio),
                    "type": getattr(msg, "type", "raw")}
-        retries = 3 if on_error == "retry" else 1
+        retries = 5 if on_error == "retry" else 1
         for attempt in range(retries):
             try:
                 resp = requests.post(url, json=simple_repr(msg),
@@ -381,6 +381,14 @@ class Messaging:
             # stay aligned (reference tags every message with cycle_id)
             full = _Envelope(src_comp, dest_comp, msg,
                              getattr(msg, "_cycle_id", None))
+            if on_error is None and (prio or MSG_ALGO) < MSG_ALGO:
+                # management/value-report traffic (deploy commands,
+                # value changes, finished reports) must survive a
+                # transient transport hiccup: one dropped finished
+                # report stalls the whole orchestrated run on a loaded
+                # host (observed with process-mode HTTP under full-CI
+                # contention)
+                on_error = "retry"
             self._comm.send_msg(self._agent_name, dest_agent, full,
                                 prio=prio or MSG_ALGO, on_error=on_error)
 

@@ -575,3 +575,121 @@ def test_scenario_agent_removal_dsa_backend():
                       scenario=scenario, stop_cycle=200, seed=6)
     # the run survives the removal and still produces a full assignment
     assert set(result.assignment) == {"v1", "v2", "v3"}
+
+
+# ---- round 3: protocol-level behavior of the new mp backends ---------
+
+PAIR_TRAP = """
+name: pairtrap
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b}
+  y: {domain: b}
+constraints:
+  c: {type: intention,
+      function: 0 if (x==1 and y==1) else (1 if (x==0 and y==0) else 5)}
+agents: [a1, a2]
+"""
+
+
+def test_mgm2_coordinated_move_escapes_pair_trap():
+    """(0,0) is a strict local optimum for unilateral moves (any single
+    flip costs 5 > 1) but the coordinated pair move reaches the global
+    optimum (1,1) = 0.  MGM-2's offer/accept/go machinery must find it
+    (reference: mgm2.py's raison d'etre) — from any start, on every
+    seed."""
+    for seed in (0, 1, 2):
+        dcop = load_dcop(PAIR_TRAP)
+        r = run_dcop(dcop, "mgm2", distribution="oneagent", timeout=30,
+                     stop_cycle=12, seed=seed, threshold=0.6)
+        assert r.assignment == {"x": 1, "y": 1}, (seed, r.assignment)
+        assert r.cost == 0.0
+
+
+def test_syncbb_fabric_finds_exact_optimum():
+    """The CPA token walk must return the solve_direct optimum on a
+    chain where greedy first-values are suboptimal."""
+    src = """
+name: chain4
+objective: min
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  v1: {domain: d, cost_function: 0.3 * v1}
+  v2: {domain: d}
+  v3: {domain: d}
+  v4: {domain: d, cost_function: 0.2 * (2 - v4)}
+constraints:
+  c12: {type: intention, function: 2 if v1 == v2 else abs(v1 - v2)}
+  c23: {type: intention, function: 2 if v2 == v3 else abs(v2 - v3)}
+  c34: {type: intention, function: 2 if v3 == v4 else abs(v3 - v4)}
+agents: [a1, a2, a3, a4]
+"""
+    from pydcop_tpu.algorithms.syncbb import solve_direct
+
+    exact = solve_direct(load_dcop(src), {})
+    dcop = load_dcop(src)
+    r = run_dcop(dcop, "syncbb", distribution="oneagent", timeout=40)
+    assert r.metrics["status"] == "FINISHED"
+    assert r.cost == pytest.approx(exact.cost)
+
+
+def test_dpop_fabric_nary_constraint():
+    """UTIL tables for an arity-3 factor cross the wire and the fabric
+    reaches the exact optimum."""
+    src = """
+name: nary
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  a: {domain: d, cost_function: 0.1 * a}
+  b: {domain: d, cost_function: 0.2 * b}
+  c: {domain: d, cost_function: 0.4 * c}
+constraints:
+  odd: {type: intention, function: 0 if (a + b + c) % 2 == 1 else 5}
+agents: [a1, a2, a3]
+"""
+    from pydcop_tpu.algorithms.dpop import solve_direct
+
+    exact = solve_direct(load_dcop(src), {})
+    dcop = load_dcop(src)
+    r = run_dcop(dcop, "dpop", distribution="oneagent", timeout=40)
+    assert r.metrics["status"] == "FINISHED"
+    assert r.cost == pytest.approx(exact.cost)
+    assert r.assignment == {"a": 1, "b": 0, "c": 0}
+
+
+def test_dba_breakout_increases_weights_to_escape():
+    """DBA's weight mechanism must escape a quasi-local-minimum CSP: a
+    frustrated triangle where one constraint must stay violated, and
+    the breakout redistributes which one."""
+    src = """
+name: triangle
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b}
+  y: {domain: b}
+  z: {domain: b}
+constraints:
+  cxy: {type: intention, function: 10000 if x == y else 0}
+  cyz: {type: intention, function: 10000 if y == z else 0}
+  czx: {type: intention, function: 10000 if z == x else 0}
+agents: [a1, a2, a3]
+"""
+    dcop = load_dcop(src)
+    # 2-coloring a triangle is unsatisfiable: DBA runs its breakout
+    # loop and terminates via max_distance; exactly one constraint
+    # stays violated (the optimum)
+    r = run_dcop(dcop, "dba", distribution="oneagent", timeout=40,
+                 infinity=10, max_distance=4, seed=1)
+    assert r.metrics["status"] in ("FINISHED", "TIMEOUT")
+    violated = sum(
+        1 for c in dcop.constraints.values()
+        if c(**{v.name: r.assignment[v.name] for v in c.dimensions})
+        >= 10000)
+    assert violated == 1
