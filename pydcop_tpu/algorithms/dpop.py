@@ -582,9 +582,17 @@ DpopUtilMessage = message_type("dpop_util", ["dims", "costs"])
 DpopValueMessage = message_type("dpop_value", ["assignment"])
 
 
+_WIRE_INF = 1e30
+
+
 def _wire_util(util: NAryMatrixRelation):
     dims = [[v.name, list(v.domain.values)] for v in util.dimensions]
-    return dims, util.matrix.tolist()
+    # non-finite costs (hard constraints written as inf) are not
+    # JSON-compliant — the HTTP transport rejects them; clamp to a
+    # sentinel far above any soft cost
+    m = np.nan_to_num(util.matrix, nan=_WIRE_INF, posinf=_WIRE_INF,
+                      neginf=-_WIRE_INF)
+    return dims, m.tolist()
 
 
 def _unwire_util(dims, costs) -> NAryMatrixRelation:

@@ -170,6 +170,17 @@ from ..infrastructure.computations import (
 
 INFINITY = float("inf")
 
+
+def _wire_ub(ub: float):
+    """inf is not JSON-compliant (the HTTP transport rejects it): an
+    unset upper bound travels as None."""
+    return None if ub == INFINITY or ub == -INFINITY else ub
+
+
+def _unwire_ub(ub) -> float:
+    return INFINITY if ub is None else float(ub)
+
+
 #: current_path: [[var, value, cost], ...]
 SyncBBForwardMessage = message_type("syncbb_forward",
                                     ["current_path", "ub"])
@@ -259,14 +270,14 @@ class SyncBBMpComputation(VariableComputation):
                 self.value_selection(val, self._sign * self.upper_bound)
         if self.next_var is not None:
             self.post_msg(self.next_var, SyncBBTerminateMessage(
-                assignment, self.upper_bound), MSG_ALGO)
+                assignment, _wire_ub(self.upper_bound)), MSG_ALGO)
         self.finished()
 
     # ------------------------------------------------------ handlers
 
     @register("syncbb_terminate")
     def _on_terminate(self, sender, msg, t):
-        self.upper_bound = msg.ub
+        self.upper_bound = _unwire_ub(msg.ub)
         self._best_assignment = msg.assignment
         self._terminate()
 
@@ -274,14 +285,16 @@ class SyncBBMpComputation(VariableComputation):
     def _on_forward(self, sender, msg, t):
         current_path = [list(e) for e in msg.current_path]
         if msg.ub is not None and msg.ub < self.upper_bound:
-            self.upper_bound = msg.ub
+            self.upper_bound = float(msg.ub)
+        if msg.ub is not None and float(msg.ub) < self.upper_bound:
+            self.upper_bound = float(msg.ub)
         nxt = self._next_assignment(None, current_path)
         if nxt is None:
             if self.previous_var is None:
                 self._terminate()
             else:
                 self.post_msg(self.previous_var, SyncBBBackwardMessage(
-                    current_path, self.upper_bound,
+                    current_path, _wire_ub(self.upper_bound),
                     self._best_assignment), MSG_ALGO)
             self.new_cycle()
             return
@@ -303,22 +316,23 @@ class SyncBBMpComputation(VariableComputation):
                     break
                 value, cost = nxt
             self.post_msg(self.previous_var, SyncBBBackwardMessage(
-                current_path, self.upper_bound,
+                current_path, _wire_ub(self.upper_bound),
                 self._best_assignment), MSG_ALGO)
         else:
             value, cost = nxt
             new_path = current_path + [[self.name, value, cost]]
             self.post_msg(self.next_var, SyncBBForwardMessage(
-                new_path, self.upper_bound), MSG_ALGO)
+                new_path, _wire_ub(self.upper_bound)), MSG_ALGO)
         self.new_cycle()
 
     @register("syncbb_backward")
     def _on_backward(self, sender, msg, t):
         current_path = [list(e) for e in msg.current_path]
-        if msg.ub < self.upper_bound or (
-                msg.ub == self.upper_bound
+        ub = _unwire_ub(msg.ub)
+        if ub < self.upper_bound or (
+                ub == self.upper_bound
                 and self._best_assignment is None):
-            self.upper_bound = msg.ub
+            self.upper_bound = ub
             if msg.best is not None:
                 self._best_assignment = msg.best
         var, val, _ = current_path[-1]
@@ -328,12 +342,12 @@ class SyncBBMpComputation(VariableComputation):
             new_path = current_path[:-1] + [[self.name, new_val,
                                              new_cost]]
             self.post_msg(self.next_var, SyncBBForwardMessage(
-                new_path, self.upper_bound), MSG_ALGO)
+                new_path, _wire_ub(self.upper_bound)), MSG_ALGO)
         elif self.previous_var is None:
             self._terminate()
         else:
             self.post_msg(self.previous_var, SyncBBBackwardMessage(
-                current_path[:-1], self.upper_bound,
+                current_path[:-1], _wire_ub(self.upper_bound),
                 self._best_assignment), MSG_ALGO)
         self.new_cycle()
 

@@ -93,8 +93,13 @@ class CommunicationLayer:
     def _handle_error(self, dest_agent, msg, on_error, err=None) -> bool:
         if on_error == "fail":
             raise UnreachableAgent(dest_agent, msg) from err
-        logger.warning("Dropping undeliverable message to %s: %s",
-                       dest_agent, msg)
+        inner = getattr(msg, "msg", msg)
+        logger.warning(
+            "Dropping undeliverable message to %s (%s -> %s, type=%s, "
+            "on_error=%s): %s",
+            dest_agent, getattr(msg, "src_comp", "?"),
+            getattr(msg, "dest_comp", "?"),
+            getattr(inner, "type", type(inner).__name__), on_error, err)
         return False
 
 
@@ -255,11 +260,6 @@ class HttpCommunicationLayer(CommunicationLayer):
                  prio: int = MSG_ALGO, on_error: str = "ignore") -> bool:
         import requests
 
-        try:
-            address = self.discovery.agent_address(dest_agent)
-        except Exception as e:
-            return self._handle_error(dest_agent, msg, on_error, e)
-        url = f"http://{address.host}:{address.port}/pydcop"
         headers = {"sender-agent": str(src_agent),
                    "dest-agent": str(dest_agent),
                    "prio": str(prio),
@@ -267,6 +267,10 @@ class HttpCommunicationLayer(CommunicationLayer):
         retries = 5 if on_error == "retry" else 1
         for attempt in range(retries):
             try:
+                # the address lookup is part of the retried work: the
+                # peer may register with discovery mid-backoff
+                address = self.discovery.agent_address(dest_agent)
+                url = f"http://{address.host}:{address.port}/pydcop"
                 resp = requests.post(url, json=simple_repr(msg),
                                      headers=headers,
                                      timeout=self._timeout)
@@ -381,16 +385,29 @@ class Messaging:
             # stay aligned (reference tags every message with cycle_id)
             full = _Envelope(src_comp, dest_comp, msg,
                              getattr(msg, "_cycle_id", None))
-            if on_error is None and (prio or MSG_ALGO) < MSG_ALGO:
-                # management/value-report traffic (deploy commands,
-                # value changes, finished reports) must survive a
-                # transient transport hiccup: one dropped finished
-                # report stalls the whole orchestrated run on a loaded
-                # host (observed with process-mode HTTP under full-CI
-                # contention)
+            if on_error is None:
+                # default to retry-with-backoff for everything that
+                # crosses the network: one dropped management message
+                # (deploy / finished report) stalls the orchestrated
+                # run, and one dropped algorithm message deadlocks any
+                # synchronous round or kills a token protocol outright
+                # (observed: SyncBB's CPA token lost to a still-booting
+                # agent's HTTP server under full-CI contention).  An
+                # explicit on_error from the caller still wins.
                 on_error = "retry"
-            self._comm.send_msg(self._agent_name, dest_agent, full,
-                                prio=prio or MSG_ALGO, on_error=on_error)
+            delivered = self._comm.send_msg(
+                self._agent_name, dest_agent, full,
+                prio=prio or MSG_ALGO, on_error=on_error)
+            if delivered is False and on_error == "retry":
+                # transport exhausted its retries (agent address not
+                # yet known, or its server still booting): park the
+                # message like an unknown destination and re-send when
+                # discovery (re)announces the computation — dropping
+                # it would deadlock the sender's synchronous round
+                with self._lock:
+                    self._waiting.setdefault(dest_comp, []).append(
+                        (src_comp, dest_comp, msg, prio, on_error))
+                self._subscribe_for_parked(dest_comp)
 
     def post_local(self, envelope, prio: int = MSG_ALGO):
         """Deliver a message arriving from the network."""

@@ -693,3 +693,38 @@ agents: [a1, a2, a3]
         if c(**{v.name: r.assignment[v.name] for v in c.dimensions})
         >= 10000)
     assert violated == 1
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_dba_real_messages():
+    """DBA over HTTP: the asynchronous dba_end termination broadcast
+    must stop every OS process cleanly."""
+    dcop = load_dcop(GC3_HARD)
+    result = run_dcop(dcop, "dba", mode="process",
+                      distribution="oneagent", timeout=90,
+                      infinity=10, max_distance=3, seed=1)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment["v1"] != result.assignment["v2"]
+    assert result.assignment["v2"] != result.assignment["v3"]
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_amaxsum_real_messages():
+    """Asynchronous maxsum over HTTP: receipt-driven recomputation and
+    the quiescence detector across process boundaries."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "amaxsum", mode="process", timeout=90,
+                      seed=1)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment in VALID_GC3
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_syncbb_real_messages():
+    """The CPA token crossing real process boundaries."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "syncbb", mode="process",
+                      distribution="oneagent", timeout=90)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment in VALID_GC3
+    assert result.cost == pytest.approx(-0.1)

@@ -119,7 +119,10 @@ def test_wire_vocabulary_is_covered():
 def test_message_json_roundtrip(mod_name, cls):
     kwargs = {f: SAMPLE_VALUES.get(f, 1) for f in cls._fields}
     msg = cls(**kwargs)
-    wire = json.dumps(simple_repr(msg))
+    # allow_nan=False mirrors the HTTP transport: non-finite floats are
+    # rejected on the wire (regression: SyncBB shipped ub=inf and every
+    # token POST failed identically)
+    wire = json.dumps(simple_repr(msg), allow_nan=False)
     back = from_repr(json.loads(wire), allowed_prefixes=ALLOW)
     assert type(back) is cls
     for f in cls._fields:
