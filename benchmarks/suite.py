@@ -110,8 +110,6 @@ def bench_dpop_device_widetree(quick=False):
     Reports the host-numpy path and the jitted device-spine path (cold
     = includes the one-time XLA compile; warm = steady state, the
     deployment regime where the same problem shape re-solves)."""
-    import time as _time
-
     from pydcop_tpu.algorithms.dpop import solve_direct
     from pydcop_tpu.generators.meetingscheduling import generate_meetings
 

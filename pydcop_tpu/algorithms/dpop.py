@@ -43,8 +43,11 @@ algo_params = []
 
 #: compiled spine programs, keyed by the spine's structural signature —
 #: re-solving the same problem shape (the normal batch/bench pattern)
-#: reuses the executable instead of re-tracing and re-compiling
+#: reuses the executable instead of re-tracing and re-compiling.
+#: Bounded: a stream of structurally distinct problems would otherwise
+#: accumulate XLA executables forever
 _SPINE_CACHE: Dict[Any, Any] = {}
+_SPINE_CACHE_MAX = 16
 
 #: device path kicks in when the predicted UTIL work crosses this many
 #: table cells — below it, per-level dispatch overhead beats the win
@@ -227,10 +230,9 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
     import jax
     import jax.numpy as jnp
 
-    # bottom-up and top-down spine orders
+    # bottom-up spine order (the VALUE pass iterates it reversed)
     bottom_up = [n for level in reversed(g.depth_ordered())
                  for n in level if n.name in spine]
-    top_down = list(reversed(bottom_up))
 
     # external inputs, flattened in a stable order
     ext_arrays = []
@@ -264,8 +266,7 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
                     a = np.asarray(arr, dtype=np.float32)
                     inputs.append(("ext", ext(a),
                                    tuple(range(a.ndim))))
-        node_specs.append((node.name, out_dims, packed, inputs,
-                           list(node.children)))
+        node_specs.append((node.name, out_dims, packed, inputs))
 
     dom_sizes = sizes
 
@@ -273,7 +274,7 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
         util = {}
         joined = {}
         sep_layout = {}
-        for name, out_dims, packed, inputs, _children in node_specs:
+        for name, out_dims, packed, inputs in node_specs:
             s_own = dom_sizes[out_dims[-1]]
             if packed:
                 shape = tuple(dom_sizes[d] for d in out_dims[:-2]) + (
@@ -308,21 +309,16 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
         # ---- VALUE: top-down argmin slicing, all on device ----------
         chosen = {}
         out = []
-        for name, out_dims, packed, _inputs, _children in \
-                reversed(node_specs):
+        for name, out_dims, packed, _inputs in reversed(node_specs):
             table = joined[name]
             s_own = dom_sizes[out_dims[-1]]
             if packed:
-                starts = [chosen[d] if d in chosen else 0
+                # spine is upward-closed: every separator dim belongs
+                # to an ancestor spine node, so chosen[] has them all
+                starts = [jnp.asarray(chosen[d], dtype=jnp.int32)
                           for d in out_dims[:-2]]
-                last_sep = chosen.get(out_dims[-2], 0)
-                starts = [jnp.asarray(i, dtype=jnp.int32)
-                          for i in starts]
-                starts.append(jnp.asarray(last_sep * s_own,
-                                          dtype=jnp.int32)
-                              if not isinstance(last_sep, int)
-                              else jnp.asarray(last_sep * s_own,
-                                               dtype=jnp.int32))
+                starts.append(jnp.asarray(
+                    chosen[out_dims[-2]] * s_own, dtype=jnp.int32))
                 sizes_slice = (1,) * (table.ndim - 1) + (s_own,)
                 block = jax.lax.dynamic_slice(table, starts,
                                               sizes_slice)
@@ -338,10 +334,12 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
         (name, tuple(out_dims), packed,
          tuple((k, r if k == "spine" else ext_arrays[r].shape, p)
                for k, r, p in inputs))
-        for name, out_dims, packed, inputs, _ch in node_specs))
+        for name, out_dims, packed, inputs in node_specs))
     fitted = _SPINE_CACHE.get(sig)
     if fitted is None:
         fitted = jax.jit(spine_fn)
+        if len(_SPINE_CACHE) >= _SPINE_CACHE_MAX:
+            _SPINE_CACHE.pop(next(iter(_SPINE_CACHE)))
         _SPINE_CACHE[sig] = fitted
     idxs = np.asarray(jax.device_get(fitted(*[
         jnp.asarray(a) for a in ext_arrays])))
