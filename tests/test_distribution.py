@@ -315,3 +315,31 @@ def test_oilp_cgdp_pins_devices(secp_setup):
         for comp, cost in agent.hosting_costs.items():
             if cost == 0:
                 assert dist.agent_for(comp) == agent.name
+
+
+def test_pin_explicit_zero_first_agent_wins(secp_setup):
+    """Two agents declaring an explicit zero hosting cost for the same
+    computation: the first (in agent order) wins; the ILP stays
+    feasible (review finding: double-pinning made the exactly-once row
+    infeasible)."""
+    from pydcop_tpu.dcop.objects import AgentDef
+    from pydcop_tpu.distribution._secp import pin_explicit_zero_hosting
+
+    _dcop, _, cg, _, dsa = secp_setup
+    node = cg.nodes[0].name
+    agents = [
+        AgentDef("b1", capacity=100, hosting_costs={node: 0},
+                 default_hosting_cost=10),
+        AgentDef("b2", capacity=100, hosting_costs={node: 0},
+                 default_hosting_cost=10),
+    ]
+    pinned = pin_explicit_zero_hosting(cg, agents)
+    assert pinned == {"b1": [node]}
+
+    m = load_distribution_module("oilp_cgdp")
+    # enough extra agents to host everything
+    agents += [AgentDef(f"b{i}", capacity=100, default_hosting_cost=10)
+               for i in range(3, 3 + len(cg.nodes))]
+    dist = m.distribute(cg, agents, None, dsa.computation_memory,
+                        dsa.communication_load)
+    assert dist.agent_for(node) == "b1"

@@ -728,3 +728,86 @@ def test_run_dcop_process_mode_syncbb_real_messages():
     assert result.metrics["status"] == "FINISHED"
     assert result.assignment in VALID_GC3
     assert result.cost == pytest.approx(-0.1)
+
+
+def test_gdba_fabric_multiplicative_transversal():
+    """GDBA mode combinations on the fabric: multiplicative modifiers +
+    transversal increase + non-minimum violation."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "gdba", distribution="oneagent", timeout=40,
+                      stop_cycle=15, seed=5, modifier="M",
+                      violation="NM", increase_mode="T")
+    assert result.metrics["status"] == "FINISHED"
+    assert set(result.assignment) == {"v1", "v2", "v3"}
+
+
+def test_mixeddsa_fabric_hard_constraints():
+    """MixedDSA on the fabric must clear hard (infinite-cost-table)
+    constraints before optimizing soft ones."""
+    src = """
+name: mixed
+objective: min
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d, cost_function: 0.5 * x}
+  y: {domain: d, cost_function: 0.5 * y}
+  z: {domain: d}
+constraints:
+  hard_xy: {type: intention, function: 100000 if x == y else 0}
+  soft_yz: {type: intention, function: abs(y - z)}
+agents: [a1, a2, a3]
+"""
+    dcop = load_dcop(src)
+    result = run_dcop(dcop, "mixeddsa", distribution="oneagent",
+                      timeout=40, stop_cycle=30, seed=2)
+    assert result.metrics["status"] == "FINISHED"
+    a = result.assignment
+    assert a["x"] != a["y"]  # hard constraint satisfied
+
+
+def test_mgm2_fabric_max_mode():
+    """mode=max: signed-space gains must still move toward the
+    maximum."""
+    src = """
+name: maxmode
+objective: max
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  c: {type: intention, function: 10 if (x == 1 and y == 1) else x + y}
+agents: [a1, a2]
+"""
+    dcop = load_dcop(src)
+    result = run_dcop(dcop, "mgm2", distribution="oneagent", timeout=30,
+                      stop_cycle=10, seed=1, threshold=0.7)
+    assert result.assignment == {"x": 1, "y": 1}
+    assert result.cost == 10
+
+
+def test_replication_k2_three_agents():
+    """k=2 replication: every computation ends up with two replicas on
+    distinct other agents."""
+    dcop = load_dcop(GC3)
+    from pydcop_tpu.infrastructure.run import _prepare_run, \
+        run_local_thread_dcop
+
+    algo_def, cg, dist = _prepare_run(dcop, "dsa", "oneagent",
+                                      algo_params={"stop_cycle": 5})
+    orch = run_local_thread_dcop(algo_def, cg, dist, dcop,
+                                 replication="dist_ucs_hostingcosts")
+    try:
+        orch.deploy_computations(timeout=20)
+        replica_map = orch.start_replication(2)
+        for comp in ("v1", "v2", "v3"):
+            holders = set(replica_map.get(comp, []))
+            assert len(holders) == 2, (comp, replica_map)
+            assert dist.agent_for(comp) not in holders
+    finally:
+        orch.stop_agents()
+        orch.stop()
+        for agent in orch.local_agents:
+            agent.clean_shutdown(1)
