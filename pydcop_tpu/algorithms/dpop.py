@@ -230,6 +230,10 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
     import jax
     import jax.numpy as jnp
 
+    from ..engine._cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     # bottom-up spine order (the VALUE pass iterates it reversed)
     bottom_up = [n for level in reversed(g.depth_ordered())
                  for n in level if n.name in spine]

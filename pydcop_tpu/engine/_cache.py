@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache.
+
+Compiles are the cold-start cost of the compiled data plane (20-40 s for
+the first 10k-variable step on the tunneled chip, several seconds per
+DPOP device spine).  JAX can persist compiled executables to disk keyed
+by the HLO hash; enabling it makes every fresh process after the first
+start warm — benchmarks, batch campaigns, process-mode agents.
+
+Opt-out with ``PYDCOP_TPU_NO_CACHE=1``; relocate with
+``PYDCOP_TPU_CACHE_DIR``.  Failure to set the cache up (read-only
+filesystem, old jax) is non-fatal: solving just compiles as before.
+"""
+
+import os
+
+_done = False
+
+
+def enable_persistent_cache():
+    global _done
+    if _done:
+        return
+    _done = True
+    if os.environ.get("PYDCOP_TPU_NO_CACHE"):
+        return
+    path = os.environ.get(
+        "PYDCOP_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "pydcop_tpu",
+                     "xla"))
+    try:
+        import jax
+
+        # CPU executables are AOT-compiled against exact machine
+        # features and XLA warns reloading them can SIGILL on feature
+        # drift — only persist for accelerator backends
+        if (jax.config.jax_platforms or "") == "cpu":
+            return
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that takes noticeable time, not only the
+        # default >1s compiles
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.1)
+    except Exception:  # pragma: no cover - best effort
+        pass

@@ -15,11 +15,13 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ._cache import enable_persistent_cache
 from .solver import ArraySolver, RunResult
 
 
 class SyncEngine:
     def __init__(self, solver: ArraySolver, chunk_size: int = 32):
+        enable_persistent_cache()
         self._solver = solver
         self._chunk = chunk_size
 
