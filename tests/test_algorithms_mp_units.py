@@ -1,0 +1,237 @@
+"""Unit tests for the message-passing backends: computations driven
+directly with a stub message sender, no agents or transports.
+
+Mirrors the reference's per-algorithm unit tier
+(`/root/reference/tests/unit/test_algorithms_mgm2.py` and siblings):
+handler dispatch, phase transitions and decision rules in isolation.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs.constraints_hypergraph import \
+    build_computation_graph as build_hypergraph
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def make_comp(algo_name, var_name, params=None, src=GC3):
+    """Build one computation wired to a sent-message recorder."""
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    dcop = load_dcop(src)
+    cg = build_hypergraph(dcop)
+    module = load_algorithm_module(algo_name)
+    algo = AlgorithmDef.build_with_default_param(
+        algo_name, params or {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == var_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda src_c, dest, msg, prio, on_error: sent.append(
+            (dest, msg)))
+    return comp, sent
+
+
+def deliver(comp, sender, msg, cycle_id=None):
+    if cycle_id is not None:
+        msg._cycle_id = cycle_id
+    comp.on_message(sender, msg, 0.0)
+
+
+# ------------------------------------------------------------------ dsa
+
+
+def test_dsa_unit_round_decision():
+    from pydcop_tpu.algorithms.dsa import DsaValueMessage
+
+    comp, sent = make_comp("dsa", "v2", {"seed": 1, "variant": "B",
+                                         "probability": 1.0})
+    comp.start()
+    assert len(sent) == 2  # value to both neighbors
+    sent.clear()
+    # v1=R and v3=R: v2's best response is G (conflict-free + own cost)
+    deliver(comp, "v1", DsaValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", DsaValueMessage("R"), cycle_id=0)
+    assert comp.current_value == "G"
+    # new round announced to both neighbors
+    assert [d for d, _ in sent] == ["v1", "v3"]
+
+
+def test_dsa_variant_a_never_moves_sideways():
+    from pydcop_tpu.algorithms.dsa import DsaValueMessage
+
+    comp, sent = make_comp("dsa", "v2", {"seed": 3, "variant": "A",
+                                         "probability": 1.0})
+    comp.start()
+    comp.value_selection("G")  # already at the optimum given R/R
+    deliver(comp, "v1", DsaValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", DsaValueMessage("R"), cycle_id=0)
+    assert comp.current_value == "G"
+
+
+# ------------------------------------------------------------------ mgm
+
+
+def test_mgm_gain_phase_strict_winner_moves():
+    from pydcop_tpu.algorithms.mgm import MgmGainMessage, \
+        MgmValueMessage
+
+    comp, sent = make_comp("mgm", "v2", {"seed": 2})
+    comp.start()
+    deliver(comp, "v1", MgmValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", MgmValueMessage("R"), cycle_id=0)
+    # gain messages went out; now lose the gain phase
+    gains = [m for d, m in sent if m.type == "mgm_gain"]
+    assert gains and gains[0].gain > 0
+    my_gain = gains[0].gain
+    before = comp.current_value
+    deliver(comp, "v1", MgmGainMessage(my_gain + 5.0, 0.0), cycle_id=1)
+    deliver(comp, "v3", MgmGainMessage(0.0, 0.0), cycle_id=1)
+    # a neighbor had a strictly larger gain: no move this iteration
+    assert comp.current_value == before
+    assert comp._cycle_count == 1  # one full MGM iteration closed
+
+
+def test_mgm_lexic_tie_lower_name_wins():
+    from pydcop_tpu.algorithms.mgm import MgmGainMessage, \
+        MgmValueMessage
+
+    comp, sent = make_comp("mgm", "v2", {"seed": 2})
+    comp.start()
+    deliver(comp, "v1", MgmValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", MgmValueMessage("R"), cycle_id=0)
+    gains = [m for d, m in sent if m.type == "mgm_gain"]
+    my_gain = gains[0].gain
+    # equal gains: v1 < v2 lexically, so v2 must NOT move
+    before = comp.current_value
+    deliver(comp, "v1", MgmGainMessage(my_gain, 0.0), cycle_id=1)
+    deliver(comp, "v3", MgmGainMessage(0.0, 0.0), cycle_id=1)
+    assert comp.current_value == before
+
+
+# ----------------------------------------------------------------- mgm2
+
+
+def test_mgm2_offer_content_improving_pairs_only():
+    from pydcop_tpu.algorithms.mgm2 import Mgm2ValueMessage
+
+    comp, sent = make_comp("mgm2", "v2", {"seed": 4, "threshold": 1.0})
+    comp.start()
+    deliver(comp, "v1", Mgm2ValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", Mgm2ValueMessage("R"), cycle_id=0)
+    offers = [(d, m) for d, m in sent if m.type == "mgm2_offer"]
+    # threshold=1: always an offerer; exactly one partner gets a real
+    # offer, the other an empty one
+    real = [m for _, m in offers if m.is_offering]
+    empty = [m for _, m in offers if not m.is_offering]
+    assert len(real) == 1 and len(empty) == 1
+    # every offered pair strictly improves v2's neighborhood
+    for _mv, _pv, gain in real[0].offers:
+        assert gain > 0
+
+
+def test_mgm2_response_rejected_when_both_offer():
+    from pydcop_tpu.algorithms.mgm2 import Mgm2OfferMessage, \
+        Mgm2ValueMessage
+
+    comp, sent = make_comp("mgm2", "v2", {"seed": 4, "threshold": 1.0})
+    comp.start()
+    deliver(comp, "v1", Mgm2ValueMessage("R"), cycle_id=0)
+    deliver(comp, "v3", Mgm2ValueMessage("R"), cycle_id=0)
+    sent.clear()
+    # v2 is itself an offerer (threshold=1): it must reject incoming
+    # offers (reference: mgm2.py:792-800)
+    deliver(comp, "v1", Mgm2OfferMessage([["G", "G", 1.0]], True),
+            cycle_id=1)
+    deliver(comp, "v3", Mgm2OfferMessage([], False), cycle_id=1)
+    responses = [(d, m) for d, m in sent if m.type == "mgm2_response"]
+    assert responses == [("v1", responses[0][1])]
+    assert responses[0][1].accept is False
+
+
+# ------------------------------------------------------------------ dba
+
+
+def test_dba_weights_grow_at_quasi_local_minimum():
+    from pydcop_tpu.algorithms.dba import DbaImproveMessage, \
+        DbaOkMessage
+
+    src = GC3.replace("1 if", "10000 if")
+    comp, sent = make_comp("dba", "v2", {"seed": 5, "infinity": 10},
+                           src=src)
+    comp.start()
+    comp.value_selection("R")
+    # both neighbors on R too: every value of v2 violates something?
+    # R conflicts with both; G resolves both -> improvement exists
+    deliver(comp, "v1", DbaOkMessage("G"), cycle_id=0)
+    deliver(comp, "v3", DbaOkMessage("R"), cycle_id=0)
+    # v2=R violates diff_2_3; moving to G violates diff_1_2: improve=0
+    assert comp._my_improve == pytest.approx(0.0)
+    w_before = list(comp._weights)
+    deliver(comp, "v1", DbaImproveMessage(0.0, 1, 0), cycle_id=1)
+    deliver(comp, "v3", DbaImproveMessage(0.0, 1, 0), cycle_id=1)
+    # quasi-local minimum: the violated constraint's weight grew
+    assert sum(comp._weights) > sum(w_before)
+
+
+# ----------------------------------------------------------------- adsa
+
+
+def test_adsa_tick_waits_for_full_view():
+    from pydcop_tpu.algorithms.adsa import ADsaValueMessage
+
+    comp, sent = make_comp("adsa", "v2", {"seed": 6, "period": 10.0,
+                                          "probability": 1.0})
+    # bypass the agent timer wheel: drive the tick directly
+    comp._periodic_action_handler = lambda period, cb: object()
+    comp.start()
+    comp._delayed_start()
+    comp.value_selection("R")
+    deliver(comp, "v1", ADsaValueMessage("R"))
+    comp._tick()  # only one neighbor known: no decision yet
+    assert comp.current_value == "R"
+    deliver(comp, "v3", ADsaValueMessage("R"))
+    comp._tick()
+    assert comp.current_value == "G"
+
+
+# --------------------------------------------------------------- syncbb
+
+
+def test_syncbb_unit_forward_extends_path():
+    from pydcop_tpu.algorithms.syncbb import SyncBBForwardMessage
+
+    dcop = load_dcop(GC3)
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.graphs.ordered_graph import build_computation_graph
+
+    cg = build_computation_graph(dcop)
+    module = load_algorithm_module("syncbb")
+    algo = AlgorithmDef.build_with_default_param("syncbb", {})
+    node = next(n for n in cg.nodes if n.name == "v2")
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    comp.start()
+    comp.on_message("v1", SyncBBForwardMessage(
+        [["v1", "R", -0.1]], None), 0.0)
+    fwd = [(d, m) for d, m in sent if m.type == "syncbb_forward"]
+    assert fwd and fwd[0][0] == "v3"
+    path = fwd[0][1].current_path
+    assert [e[0] for e in path] == ["v1", "v2"]
