@@ -19,3 +19,66 @@ def make_mesh(n_devices: int = None, tp: int = None):
 
 
 __all__ = ["BatchedMaxSum", "ShardedMaxSum", "make_mesh"]
+
+
+def solve_sharded(dcop, algo: str, n_cycles: int = 100,
+                  mesh=None, batch: int = None, seed: int = 0,
+                  **params):
+    """Solve a DCOP on a (dp, tp) device mesh — the multi-chip
+    counterpart of ``infrastructure.run.solve``.
+
+    ``algo``: maxsum (edge- or lane-major), dsa or mgm.  ``batch``
+    independent restarts ride the dp axis (default: one per dp row);
+    the best-cost restart is returned.  Returns (assignment dict,
+    cost, cycles).
+    """
+    import numpy as np
+
+    from ..dcop.dcop import filter_dcop
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+
+    if mesh is None:
+        mesh = make_mesh()
+    if batch is None:
+        batch = mesh.shape["dp"]
+
+    if algo == "maxsum":
+        arrays = FactorGraphArrays.build(dcop)
+        from .sharded_maxsum import ShardedMaxSum
+
+        solver = ShardedMaxSum(arrays, mesh, batch=batch, **params)
+        sel, cycles = solver.run(n_cycles, seed=seed)
+    elif algo == "dsa":
+        arrays = HypergraphArrays.build(filter_dcop(dcop))
+        from .sharded_localsearch import ShardedDsa
+
+        solver = ShardedDsa(arrays, mesh, batch=batch, **params)
+        sel, cycles = solver.run(n_cycles, seed=seed)
+    elif algo == "mgm":
+        arrays = HypergraphArrays.build(filter_dcop(dcop))
+        from .sharded_localsearch import ShardedMgm
+
+        solver = ShardedMgm(arrays, mesh, batch=batch, **params)
+        sel, cycles = solver.run(n_cycles, seed=seed)
+    else:
+        raise ValueError(
+            f"solve_sharded supports maxsum/dsa/mgm, not {algo!r}")
+
+    variables = [dcop.variable(n) for n in arrays.var_names]
+    best_cost, best_assignment = None, None
+    for row in np.asarray(sel):
+        assignment = {
+            v.name: v.domain.values[int(i)]
+            for v, i in zip(variables, row)
+        }
+        cost, _violations = dcop.solution_cost(assignment)
+        better = best_cost is None or (
+            cost < best_cost if dcop.objective == "min"
+            else cost > best_cost)
+        if better:
+            best_cost, best_assignment = cost, assignment
+    return best_assignment, best_cost, cycles
+
+
+__all__ = ["BatchedMaxSum", "ShardedMaxSum", "make_mesh",
+           "solve_sharded"]

@@ -170,3 +170,42 @@ def test_sharded_dsa_dp_tp():
     # random coloring would average ~128 conflicts over the batch;
     # 25 DSA-B cycles must cut that way down
     assert conflicts < 48
+
+
+def test_solve_sharded_api_from_dcop():
+    """solve_sharded: a real DCOP (YAML model, not fast-generator
+    arrays) solved over the mesh, best restart returned."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.parallel import solve_sharded
+
+    src = """
+name: gc5
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+  v4: {domain: colors}
+  v5: {domain: colors}
+constraints:
+  c12: {type: intention, function: 10 if v1 == v2 else 0}
+  c23: {type: intention, function: 10 if v2 == v3 else 0}
+  c34: {type: intention, function: 10 if v3 == v4 else 0}
+  c45: {type: intention, function: 10 if v4 == v5 else 0}
+  c51: {type: intention, function: 10 if v5 == v1 else 0}
+agents: [a1, a2, a3, a4, a5]
+"""
+    for algo, params in (("dsa", {}), ("mgm", {}),
+                         ("maxsum", {"noise": 0.05})):
+        # solver noise breaks the 5-cycle's symmetry for max-sum
+        # (belief ties decode inconsistently otherwise, as on any
+        # unary-cost-free instance)
+        dcop = load_dcop(src)
+        assignment, cost, cycles = solve_sharded(
+            dcop, algo, n_cycles=40, seed=3, **params)
+        assert set(assignment) == {f"v{i}" for i in range(1, 6)}
+        # a 5-cycle is 3-colorable: the best restart should be clean
+        # for local search; maxsum on the odd cycle may keep one clash
+        assert cost <= (10 if algo == "maxsum" else 0), (algo, cost)

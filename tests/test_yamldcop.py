@@ -255,3 +255,81 @@ agents: [a1, a2]
     # the reference concatenates multiple files; emulate with strings
     dcop = load_dcop(part1 + part2)
     assert set(dcop.agents) == {"a1", "a2"}
+
+
+# ---- round 3: malformed-input error paths (reference: the yaml loader
+# rejects bad documents with clear errors, not stack traces) -----------
+
+
+def test_unknown_domain_reference_raises():
+    import pytest
+
+    src = """
+name: bad
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: nope}
+"""
+    with pytest.raises(Exception) as exc:
+        load_dcop(src)
+    assert "nope" in str(exc.value) or "domain" in str(exc.value).lower()
+
+
+def test_constraint_over_unknown_variable_raises():
+    import pytest
+
+    src = """
+name: bad
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d}
+constraints:
+  c: {type: intention, function: v1 + ghost}
+"""
+    with pytest.raises(Exception):
+        load_dcop(src)
+
+
+def test_bad_objective_raises():
+    import pytest
+
+    src = """
+name: bad
+objective: sideways
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d}
+"""
+    with pytest.raises(Exception):
+        load_dcop(src)
+
+
+def test_extensional_default_and_overrides():
+    """Extensional constraints: default cost + '|'-listed overrides
+    (reference yaml dialect)."""
+    src = """
+name: ext
+objective: min
+domains:
+  d: {values: [a, b]}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+constraints:
+  c:
+    type: extensional
+    variables: [v1, v2]
+    default: 5
+    values:
+      0: a a | b b
+"""
+    dcop = load_dcop(src)
+    c = dcop.constraints["c"]
+    assert c(v1="a", v2="a") == 0
+    assert c(v1="b", v2="b") == 0
+    assert c(v1="a", v2="b") == 5
