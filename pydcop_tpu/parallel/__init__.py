@@ -1,5 +1,5 @@
 from .batch import BatchedMaxSum
-from .sharded_maxsum import ShardedMaxSum
+from .sharded_maxsum import ShardedAMaxSum, ShardedMaxSum
 
 
 def make_mesh(n_devices: int = None, tp: int = None):
@@ -44,7 +44,7 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
     if algo == "maxsum":
         arrays = FactorGraphArrays.build(dcop)
-        from .sharded_maxsum import ShardedMaxSum
+        from .sharded_maxsum import ShardedAMaxSum, ShardedMaxSum
 
         solver = ShardedMaxSum(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
@@ -62,7 +62,8 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
         sel, cycles = solver.run(n_cycles, seed=seed)
     else:
         raise ValueError(
-            f"solve_sharded supports maxsum/dsa/mgm, not {algo!r}")
+            f"solve_sharded supports maxsum/amaxsum/dsa/mgm, "
+            f"not {algo!r}")
 
     variables = [dcop.variable(n) for n in arrays.var_names]
     best_cost, best_assignment = None, None
@@ -80,5 +81,5 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
     return best_assignment, best_cost, cycles
 
 
-__all__ = ["BatchedMaxSum", "ShardedMaxSum", "make_mesh",
-           "solve_sharded"]
+__all__ = ["BatchedMaxSum", "ShardedAMaxSum", "ShardedMaxSum",
+           "make_mesh", "solve_sharded"]

@@ -340,3 +340,52 @@ class ShardedMaxSum:
             state["q"], state["r"], jax.random.PRNGKey(seed), *args)
         jax.block_until_ready(sel)
         return np.asarray(jax.device_get(sel))
+
+
+class ShardedAMaxSum(ShardedMaxSum):
+    """Asynchronous MaxSum over the mesh: each cycle an independent
+    random subset of shard-local edges refreshes its messages (the
+    stochastic-activation model of the single-chip ``AMaxSumSolver``),
+    everything else rides :class:`ShardedMaxSum` unchanged."""
+
+    def __init__(self, arrays: FactorGraphArrays, mesh,
+                 activation: float = 0.7, **kwargs):
+        self.activation = float(activation)
+        super().__init__(arrays, mesh, **kwargs)
+
+    def _build_step(self):
+        super()._build_step()
+        base_step = self._step
+        activation = self.activation
+        mesh = self.mesh
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
+                      P("dp", "tp"), P("dp", "tp")),
+            out_specs=(P("dp", "tp"), P("dp", "tp")),
+        )
+        def mask_update(q_new, r_new, key, q_old, r_old):
+            # per-(dp, tp) shard streams
+            dp_idx = jax.lax.axis_index("dp")
+            tp_idx = jax.lax.axis_index("tp")
+            sub = jax.random.fold_in(
+                jax.random.fold_in(jax.random.fold_in(key, 1), dp_idx),
+                tp_idx)
+            k_q, k_r = jax.random.split(sub)
+            act_q = jax.random.uniform(k_q, q_new.shape[:-1]) \
+                < activation
+            act_r = jax.random.uniform(k_r, r_new.shape[:-1]) \
+                < activation
+            q = jnp.where(act_q[..., None], q_new, q_old)
+            r = jnp.where(act_r[..., None], r_new, r_old)
+            return q, r
+
+        mask_update = jax.jit(mask_update)
+
+        def step(q, r, key, *args):
+            q_new, r_new, sel, delta = base_step(q, r, key, *args)
+            q2, r2 = mask_update(q_new, r_new, key, q, r)
+            return q2, r2, sel, delta
+
+        self._step = step

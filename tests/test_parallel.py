@@ -209,3 +209,28 @@ agents: [a1, a2, a3, a4, a5]
         # a 5-cycle is 3-colorable: the best restart should be clean
         # for local search; maxsum on the odd cycle may keep one clash
         assert cost <= (10 if algo == "maxsum" else 0), (algo, cost)
+
+
+def test_sharded_amaxsum_runs_and_solves():
+    """Sharded asynchronous MaxSum: stochastic edge activation over the
+    mesh; solves the instance like the sync variant."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedAMaxSum
+
+    from pydcop_tpu.algorithms.amaxsum import AMaxSumSolver
+
+    arrays = coloring_factor_arrays(30, 60, 3, seed=1, noise=0.05)
+    mesh = make_mesh(8)
+    sm = ShardedAMaxSum(arrays, mesh, activation=0.7, batch=4)
+    sel, cycles = sm.run(120)
+    assert sel.shape == (4, 30)
+
+    solver = AMaxSumSolver(arrays, activation=0.7, damping=0.5)
+    engine = SyncEngine(solver)
+    res = engine.run(max_cycles=120)
+    sel_single = np.array([res.assignment[n] for n in arrays.var_names])
+    c_single = conflicts(arrays, sel_single)
+    # async loopy max-sum is noisier than the sync variant on both
+    # paths: the sharded quality envelope must match the single-chip
+    # stochastic-activation solver's
+    for b in range(4):
+        assert conflicts(arrays, sel[b]) <= c_single + 3
