@@ -36,10 +36,16 @@ from ..dcop.relations import (
 )
 from ..engine.solver import RunResult
 from ..graphs import pseudotree
+from . import AlgoParameterDef
 
 GRAPH_TYPE = "pseudotree"
 
-algo_params = []
+algo_params = [
+    # execution engine for the UTIL/VALUE sweeps: vectorized-numpy
+    # host path, the jitted device spine, or auto-select on predicted
+    # table work (see device_util_sweep)
+    AlgoParameterDef("device", "str", ["auto", "host", "jax"], "auto"),
+]
 
 #: compiled spine programs, keyed by the spine's structural signature —
 #: re-solving the same problem shape (the normal batch/bench pattern)
