@@ -1,0 +1,107 @@
+"""Discovery/directory integration tests: two agents + a directory,
+registrations and subscriptions crossing the (in-process) network
+(reference: tests/unit test tier for infrastructure.discovery)."""
+
+import time
+
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import (
+    InProcessCommunicationLayer)
+from pydcop_tpu.infrastructure.discovery import DIRECTORY_COMP, Directory
+
+
+def _wait(pred, timeout=5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _directory_system():
+    """(directory agent, [agent1, agent2]) wired like the orchestrator
+    does it: everyone knows where the directory lives."""
+    d_agent = Agent("_dir_agent", InProcessCommunicationLayer())
+    directory = Directory(d_agent.discovery)
+    d_agent.add_computation(directory.directory_computation,
+                            publish=False)
+    agents = []
+    for name in ("ag1", "ag2"):
+        a = Agent(name, InProcessCommunicationLayer())
+        a.discovery.register_agent("_dir_agent", d_agent.address,
+                                   publish=False)
+        a.discovery.register_computation(
+            DIRECTORY_COMP, "_dir_agent", publish=False)
+        agents.append(a)
+    d_agent.start()
+    directory.directory_computation.start()
+    for a in agents:
+        a.start()
+        a.discovery.discovery_computation.start()
+        # announce ourselves to the directory so publications can be
+        # routed back (what OrchestrationComputation.on_start does)
+        a.discovery.register_agent(a.name, a.address)
+        a.discovery.register_computation(
+            a.discovery.discovery_computation.name, a.name)
+    return d_agent, agents
+
+
+def test_registration_propagates_to_subscriber():
+    d_agent, (a1, a2) = _directory_system()
+    try:
+        events = []
+        a2.discovery.subscribe_computation(
+            "comp_x", lambda e, n, ag: events.append((e, n, ag)))
+        # registration publishes through the directory to subscribers
+        a1.discovery.register_computation("comp_x", "ag1")
+        assert _wait(lambda: ("computation_added", "comp_x", "ag1")
+                     in events)
+        assert a2.discovery.computation_agent("comp_x") == "ag1"
+    finally:
+        for a in (a1, a2, d_agent):
+            a.clean_shutdown(1)
+
+
+def test_unregistration_publishes_removal():
+    d_agent, (a1, a2) = _directory_system()
+    try:
+        events = []
+        a2.discovery.subscribe_computation(
+            "comp_y", lambda e, n, ag: events.append(e))
+        a1.discovery.register_computation("comp_y", "ag1")
+        assert _wait(lambda: "computation_added" in events)
+        a1.discovery.unregister_computation("comp_y")
+        assert _wait(lambda: "computation_removed" in events)
+    finally:
+        for a in (a1, a2, d_agent):
+            a.clean_shutdown(1)
+
+
+def test_wildcard_agent_subscription():
+    d_agent, (a1, a2) = _directory_system()
+    try:
+        seen = []
+        a2.discovery.subscribe_agent(
+            "*", lambda e, n, ad: seen.append((e, n)))
+        a1.discovery.register_agent("ag_late", a1.address)
+        assert _wait(lambda: ("agent_added", "ag_late") in seen)
+    finally:
+        for a in (a1, a2, d_agent):
+            a.clean_shutdown(1)
+
+
+def test_replica_registration_visible_to_peer():
+    d_agent, (a1, a2) = _directory_system()
+    try:
+        a1.discovery.register_replica("comp_z", "ag1")
+        got = []
+        a2.discovery.subscribe_replica(
+            "comp_z", lambda e, n, ag: got.append((e, n, ag)))
+        assert _wait(
+            lambda: ("replica_added", "comp_z", "ag1") in got)
+        assert _wait(
+            lambda: a2.discovery.replica_agents("comp_z") == {"ag1"})
+    finally:
+        for a in (a1, a2, d_agent):
+            a.clean_shutdown(1)
