@@ -811,3 +811,93 @@ def test_replication_k2_three_agents():
         orch.stop()
         for agent in orch.local_agents:
             agent.clean_shutdown(1)
+
+
+def test_replication_respects_hosting_costs():
+    """UCS replication: with k=1 and one clearly-cheaper candidate, the
+    replica lands on the low-hosting-cost agent (the UCS explores
+    route+hosting cost in order — reference dist_ucs semantics)."""
+    src = """
+name: rep
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d}
+constraints:
+  u1: {type: intention, function: v1}
+agents:
+  a1: {capacity: 100}
+  a2: {capacity: 100}
+  a3: {capacity: 100}
+hosting_costs:
+  a2: {default: 100}
+  a3: {default: 0}
+"""
+    dcop = load_dcop(src)
+    from pydcop_tpu.infrastructure.run import _prepare_run, \
+        run_local_thread_dcop
+
+    algo_def, cg, dist = _prepare_run(dcop, "dsa", "oneagent",
+                                      algo_params={"stop_cycle": 3})
+    orch = run_local_thread_dcop(algo_def, cg, dist, dcop,
+                                 replication="dist_ucs_hostingcosts")
+    try:
+        orch.deploy_computations(timeout=20)
+        replica_map = orch.start_replication(1)
+        holders = replica_map.get("v1", [])
+        # v1 is hosted on a1 (oneagent): its single replica must pick
+        # the free agent a3 over the expensive a2
+        assert holders == ["a3"], replica_map
+    finally:
+        orch.stop_agents()
+        orch.stop()
+        for agent in orch.local_agents:
+            agent.clean_shutdown(1)
+
+
+def test_replication_skips_full_agents():
+    """An agent without capacity for the replica's footprint is not
+    chosen even when cheap (v1's footprint is 1 — one hypergraph
+    neighbor; a3's capacity 0.5 cannot hold it)."""
+    src = """
+name: rep2
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+constraints:
+  c12: {type: intention, function: 10 if v1 == v2 else 0}
+agents:
+  a1: {capacity: 100}
+  a2: {capacity: 100}
+  a3: {capacity: 0.5}
+  a4: {capacity: 100}
+hosting_costs:
+  a1: {default: 50}
+  a2: {default: 50}
+  a3: {default: 0}
+  a4: {default: 5}
+"""
+    dcop = load_dcop(src)
+    from pydcop_tpu.infrastructure.run import _prepare_run, \
+        run_local_thread_dcop
+
+    algo_def, cg, dist = _prepare_run(dcop, "dsa", "oneagent",
+                                      algo_params={"stop_cycle": 3})
+    orch = run_local_thread_dcop(algo_def, cg, dist, dcop,
+                                 replication="dist_ucs_hostingcosts")
+    try:
+        orch.deploy_computations(timeout=20)
+        replica_map = orch.start_replication(1)
+        holders = replica_map.get("v1", [])
+        # a3 is free but too small; a4 is the cheapest feasible agent
+        # that doesn't already host v1
+        assert holders == ["a4"], replica_map
+    finally:
+        orch.stop_agents()
+        orch.stop()
+        for agent in orch.local_agents:
+            agent.clean_shutdown(1)
