@@ -218,3 +218,27 @@ def test_orchestrator_and_agents_multimachine(gc3_file, tmp_path):
     finally:
         agent.terminate()
         orch.terminate()
+
+
+@pytest.mark.slow
+def test_solve_thread_mode_mgm2(gc3_file):
+    """Orchestrated thread mode through the CLI with the five-phase
+    backend."""
+    proc = run_cli("-t", "60", "solve", "-a", "mgm2", "-m", "thread",
+                   "-d", "oneagent", "-p", "stop_cycle:10",
+                   "-p", "seed:3", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["status"] == "FINISHED"
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+    assert result["msg_count"] > 50
+
+
+@pytest.mark.slow
+def test_solve_thread_mode_dpop(gc3_file):
+    """Exact DPOP through the CLI on the agent fabric."""
+    proc = run_cli("-t", "60", "solve", "-a", "dpop", "-m", "thread",
+                   "-d", "oneagent", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["status"] == "FINISHED"
+    assert result["assignment"] == {"v1": "R", "v2": "G", "v3": "R"}
+    assert result["cost"] == -0.1
