@@ -22,3 +22,19 @@ def solve(dcop, algo_def, distribution="oneagent", timeout=5, **kwargs):
     from .infrastructure.run import solve as _solve
 
     return _solve(dcop, algo_def, distribution, timeout=timeout, **kwargs)
+
+
+def run_dcop(dcop, algo_def, **kwargs):
+    """Full orchestrated run (agents, replication, scenarios) — see
+    :func:`pydcop_tpu.infrastructure.run.run_dcop`."""
+    from .infrastructure.run import run_dcop as _run
+
+    return _run(dcop, algo_def, **kwargs)
+
+
+def solve_sharded(dcop, algo, **kwargs):
+    """Multi-chip solve over a (dp, tp) device mesh — see
+    :func:`pydcop_tpu.parallel.solve_sharded`."""
+    from .parallel import solve_sharded as _shard
+
+    return _shard(dcop, algo, **kwargs)
