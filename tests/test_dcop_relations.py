@@ -234,3 +234,80 @@ def test_projection_to_scalar():
     p = projection(m, v1, "min")
     assert p.arity == 0
     assert p() == 1.0
+
+
+# ---- round 3: algebra properties of join/projection (DPOP's core) ----
+
+
+def _rand_rel(names, rng):
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    variables = [
+        Variable(n, Domain(f"d{n}", "", list(range(2 + ord(n) % 2))))
+        for n in names
+    ]
+    shape = tuple(len(v.domain) for v in variables)
+    return NAryMatrixRelation(
+        variables, rng.uniform(0, 10, size=shape).astype("f8"),
+        name="r_" + "".join(names))
+
+
+def test_join_is_associative_and_commutative_in_value():
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import join
+
+    rng = np.random.default_rng(7)
+    a = _rand_rel(["x", "y"], rng)
+    b = _rand_rel(["y", "z"], rng)
+    c = _rand_rel(["z", "w"], rng)
+
+    left = join(join(a, b), c)
+    right = join(a, join(b, c))
+    # same scope either way; compare cell-by-cell through assignments
+    import itertools
+
+    dom = {v.name: list(v.domain.values) for v in left.dimensions}
+    for combo in itertools.product(*dom.values()):
+        asgt = dict(zip(dom.keys(), combo))
+        assert left(**asgt) == pytest.approx(right(**asgt))
+        assert join(b, a)(**{k: v for k, v in asgt.items()
+                             if k in ("x", "y", "z")}) == \
+            pytest.approx(join(a, b)(**{k: v for k, v in asgt.items()
+                                        if k in ("x", "y", "z")}))
+
+
+def test_projection_is_brute_force_min():
+    import itertools
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import join, projection
+
+    rng = np.random.default_rng(8)
+    a = _rand_rel(["x", "y", "z"], rng)
+    x = a.dimensions[0]
+    proj = projection(a, x, "min")
+    dom = {v.name: list(v.domain.values) for v in proj.dimensions}
+    for combo in itertools.product(*dom.values()):
+        asgt = dict(zip(dom.keys(), combo))
+        brute = min(
+            a(**{**asgt, "x": xv}) for xv in x.domain.values)
+        assert proj(**asgt) == pytest.approx(brute)
+
+
+def test_projection_max_mode():
+    import itertools
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import projection
+
+    rng = np.random.default_rng(9)
+    a = _rand_rel(["p", "q"], rng)
+    p = a.dimensions[0]
+    proj = projection(a, p, "max")
+    for qv in a.dimensions[1].domain.values:
+        brute = max(a(p=pv, q=qv) for pv in p.domain.values)
+        assert proj(q=qv) == pytest.approx(brute)
