@@ -214,3 +214,54 @@ agents: [a1]
     assert fga.sign == -1.0
     cube = fga.buckets[0].cubes[0]
     assert cube[1, 1] == -1.0
+
+
+def test_pseudotree_separator_dims_are_ancestors():
+    """The property the DPOP device spine relies on: every separator
+    dim of every node is an ancestor of that node in the DFS tree
+    (lowest-node rule + DFS back-edges only)."""
+    from pydcop_tpu.algorithms.dpop import _util_plans
+    from pydcop_tpu.dcop.relations import UnaryFunctionRelation
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+    from pydcop_tpu.graphs import pseudotree
+
+    dcop = generate_graph_coloring(30, colors_count=3, p_edge=0.12,
+                                   seed=3, allow_subgraph=True)
+    g = pseudotree.build_computation_graph(dcop)
+    plans = _util_plans(g, {})
+    ancestors = {}
+    for level in g.depth_ordered():
+        for node in level:
+            parent = node.parent
+            ancestors[node.name] = (
+                {parent} | ancestors.get(parent, set())
+                if parent else set())
+    for name, plan in plans.items():
+        for d in plan["sep_dims"]:
+            assert d in ancestors[name], (name, d)
+
+
+def test_pseudotree_every_constraint_owned_once():
+    """Lowest-node rule: each constraint is owned by exactly one node,
+    and that node is the deepest variable of its scope."""
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+    from pydcop_tpu.graphs import pseudotree
+
+    dcop = generate_graph_coloring(25, colors_count=3, p_edge=0.15,
+                                   seed=5, allow_subgraph=True)
+    g = pseudotree.build_computation_graph(dcop)
+    depth = {}
+    for lvl, level in enumerate(g.depth_ordered()):
+        for node in level:
+            depth[node.name] = lvl
+    owners = {}
+    for node in g.nodes:
+        for c in node.constraints:
+            assert c.name not in owners, c.name
+            owners[c.name] = node.name
+            scope_depths = [depth[v.name] for v in c.dimensions
+                            if v.name in depth]
+            assert depth[node.name] == max(scope_depths), c.name
+    assert set(owners) == set(dcop.constraints)
