@@ -343,3 +343,20 @@ def test_pin_explicit_zero_first_agent_wins(secp_setup):
     dist = m.distribute(cg, agents, None, dsa.computation_memory,
                         dsa.communication_load)
     assert dist.agent_for(node) == "b1"
+
+
+def test_gh_secp_fgdp_rules_near_their_scope(secp_setup):
+    """Rule factors land on an agent already hosting one of their
+    dependencies (the heuristic's whole point: no rule is marooned on
+    an agent with none of its scope)."""
+    dcop, fg, _, maxsum, _ = secp_setup
+    m = load_distribution_module("gh_secp_fgdp")
+    dist = m.distribute(fg, dcop.agents_def, None,
+                        maxsum.computation_memory,
+                        maxsum.communication_load)
+    for node in fg.nodes:
+        if not node.name.startswith("r"):
+            continue  # rule factors are named r<j> by the generator
+        agent = dist.agent_for(node.name)
+        hosted = set(dist.computations_hosted(agent))
+        assert hosted & set(node.neighbors), (node.name, agent)
