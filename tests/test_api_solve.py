@@ -250,3 +250,35 @@ def test_amaxsum_engine_matches_fabric_distribution():
     assert e_mean <= 6.0, engine_conf
     assert f_mean <= 7.0, fabric_conf
     assert abs(e_mean - f_mean) <= 4.0, (engine_conf, fabric_conf)
+
+
+def test_api_max_objective_exact_and_local():
+    """objective: max through the public API for an exact algorithm and
+    a local-search one — the sign-compilation must report true model
+    costs (maximized)."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    src = """
+name: maxprob
+objective: max
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+  z: {domain: d}
+constraints:
+  cxy: {type: intention, function: 5 if x != y else 0}
+  cyz: {type: intention, function: 5 if y != z else 0}
+  ux:  {type: intention, function: x}
+"""
+    exact = solve_result(load_dcop(src), "dpop", timeout=30)
+    # optimum: x=2 (+2), x!=y, y!=z -> 5+5+2 = 12
+    assert exact.cost == 12
+    assert exact.assignment["x"] == 2
+
+    ls = solve_result(load_dcop(src), "dsa", timeout=30,
+                      stop_cycle=40, seed=1)
+    # local search may stop at the x=1 local optimum (11): moving x
+    # alone to 2 collides with y — accept any near-optimal maximum
+    assert ls.cost >= 11, ls.assignment
