@@ -282,3 +282,21 @@ constraints:
     # local search may stop at the x=1 local optimum (11): moving x
     # alone to 2 collides with y — accept any near-optimal maximum
     assert ls.cost >= 11, ls.assignment
+
+
+def test_cost_trace_mgm_monotone():
+    """collect_cost_every: the engine's cost trace for MGM (a monotonic
+    algorithm) must be non-increasing — exercises the chunked trace
+    plumbing and the algorithm's core invariant at once."""
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+
+    dcop = generate_graph_coloring(60, colors_count=3, p_edge=0.08,
+                                   soft=True, seed=9,
+                                   allow_subgraph=True)
+    res = solve_result(dcop, "mgm", timeout=60, stop_cycle=40, seed=2,
+                       collect_cost_every=5)
+    assert len(res.cost_trace) >= 4
+    costs = [c for _cycle, c in res.cost_trace]
+    for earlier, later in zip(costs, costs[1:]):
+        assert later <= earlier + 1e-6
