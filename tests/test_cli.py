@@ -242,3 +242,25 @@ def test_solve_thread_mode_dpop(gc3_file):
     assert result["status"] == "FINISHED"
     assert result["assignment"] == {"v1": "R", "v2": "G", "v3": "R"}
     assert result["cost"] == -0.1
+
+
+def test_distribute_secp_methods_via_cli(tmp_path):
+    """The SECP distribution strategies work end-to-end through the
+    CLI: generate a SECP, distribute with each method, check every
+    computation is hosted and lights stay on their devices."""
+    secp_file = str(tmp_path / "secp.yaml")
+    run_cli("-o", secp_file, "generate", "secp", "-l", "4", "-m", "2",
+            "-r", "1", "--seed", "3")
+    for method, algo in (("gh_secp_fgdp", "maxsum"),
+                         ("oilp_secp_cgdp", "dsa")):
+        proc = run_cli("distribute", "-d", method, "-a", algo,
+                       secp_file)
+        result = json.loads(proc.stdout)
+        dist = result["distribution"]
+        hosted = [c for cs in dist.values() for c in cs]
+        assert len(hosted) == len(set(hosted))
+        # every light variable is on its own device agent (a<i> - l<i>)
+        for agent, comps in dist.items():
+            for comp in comps:
+                if comp.startswith("l"):
+                    assert agent == "a" + comp[1:], (agent, comp)

@@ -333,3 +333,20 @@ constraints:
     assert c(v1="a", v2="a") == 0
     assert c(v1="b", v2="b") == 0
     assert c(v1="a", v2="b") == 5
+
+
+def test_yaml_roundtrip_preserves_hosting_costs_and_routes():
+    """Serialize-back regression: hosting costs and routes must survive
+    dcop -> yaml -> dcop (they silently vanished before, breaking the
+    generate -> distribute CLI round-trip for SECPs)."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.generators.secp import generate_secp
+
+    dcop = generate_secp(lights_count=3, models_count=1, rules_count=1,
+                         seed=2)
+    back = load_dcop(dcop_yaml(dcop))
+    for name, agent in dcop.agents.items():
+        agent2 = back.agents[name]
+        assert agent2.default_hosting_cost == \
+            agent.default_hosting_cost
+        assert agent2.hosting_costs == agent.hosting_costs
