@@ -264,3 +264,24 @@ def test_distribute_secp_methods_via_cli(tmp_path):
             for comp in comps:
                 if comp.startswith("l"):
                     assert agent == "a" + comp[1:], (agent, comp)
+
+
+@pytest.mark.parametrize("gen_args", [
+    ["graph_coloring", "-v", "8", "-c", "3", "--p_edge", "0.3"],
+    ["ising", "--row_count", "3", "--col_count", "3"],
+    ["meeting_scheduling", "--slots_count", "4", "--events_count", "3",
+     "--resources_count", "3"],
+    ["iot", "--num_device", "6"],
+    ["small_world", "-v", "8"],
+], ids=["coloring", "ising", "meetings", "iot", "smallworld"])
+def test_generate_families_roundtrip_solve(tmp_path, gen_args):
+    """Every generator family round-trips generate -> YAML -> solve
+    through the CLI (the serialize-back path that silently dropped
+    hosting_costs for SECPs until round 3)."""
+    out = str(tmp_path / "gen.yaml")
+    run_cli("-o", out, "generate", *gen_args, "--seed", "2")
+    proc = run_cli("-t", "30", "solve", "-a", "dsa",
+                   "-p", "stop_cycle:10", out)
+    result = json.loads(proc.stdout)
+    assert result["status"] in ("FINISHED", "MAX_CYCLES")
+    assert result["assignment"]
