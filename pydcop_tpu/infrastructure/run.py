@@ -142,13 +142,23 @@ def _prepare_run(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     graph_module = load_graph_module(graph or algo_module.GRAPH_TYPE)
     cg = graph_module.build_computation_graph(dcop)
     if isinstance(distribution, str):
-        from ..distribution import load_distribution_module
+        import os
 
-        dist_module = load_distribution_module(distribution)
-        dist = dist_module.distribute(
-            cg, dcop.agents_def, dcop.dist_hints,
-            algo_module.computation_memory,
-            algo_module.communication_load)
+        if distribution.endswith((".yaml", ".yml")) or \
+                os.path.isfile(distribution):
+            # a pre-computed placement file (reference: run/solve accept
+            # either a method name or a distribution yaml)
+            from ..distribution.yamlformat import load_dist_from_file
+
+            dist = load_dist_from_file(distribution)
+        else:
+            from ..distribution import load_distribution_module
+
+            dist_module = load_distribution_module(distribution)
+            dist = dist_module.distribute(
+                cg, dcop.agents_def, dcop.dist_hints,
+                algo_module.computation_memory,
+                algo_module.communication_load)
     else:
         dist = distribution
     return algo_def, cg, dist

@@ -285,3 +285,19 @@ def test_generate_families_roundtrip_solve(tmp_path, gen_args):
     result = json.loads(proc.stdout)
     assert result["status"] in ("FINISHED", "MAX_CYCLES")
     assert result["assignment"]
+
+
+def test_distribution_file_roundtrip(tmp_path, gc3_file):
+    """distribute -> file -> solve -m thread -d <file>: a pre-computed
+    placement feeds back into an orchestrated run (the reference's
+    documented workflow; the file path was advertised but unwired
+    until round 3)."""
+    dist_file = str(tmp_path / "dist.yaml")
+    run_cli("-o", dist_file, "distribute", "-d", "oneagent", "-a",
+            "dsa", gc3_file)
+    proc = run_cli("-t", "40", "solve", "-a", "dsa", "-m", "thread",
+                   "-d", dist_file, "-p", "stop_cycle:10",
+                   "-p", "seed:2", gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["status"] == "FINISHED"
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
