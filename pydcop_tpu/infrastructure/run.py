@@ -55,10 +55,20 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     algo_module = load_algorithm_module(algo_def.algo)
 
     if hasattr(algo_module, "solve_direct"):
-        # exact / sequential algorithms (dpop, syncbb, ncbb) run their own
-        # sweep instead of the cyclic engine
-        return algo_module.solve_direct(dcop, algo_def.params,
-                                        timeout=timeout)
+        # exact / sequential algorithms (dpop, syncbb, ncbb) run their
+        # own sweep instead of the cyclic engine; a placement file still
+        # gets validated up front and reported in the metrics
+        dist_obj = None
+        if _is_distribution_file(distribution):
+            graph = load_graph_module(
+                algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+            dist_obj = _load_checked_dist(distribution, graph,
+                                          dcop.agents_def)
+        result = algo_module.solve_direct(dcop, algo_def.params,
+                                          timeout=timeout)
+        if dist_obj is not None:
+            result.metrics["distribution"] = dist_obj.mapping()
+        return result
 
     import logging
 
@@ -70,33 +80,41 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         # one compiled program (reference: run.py:108-124 builds the
         # graph + distribution before deploying).  Only computed when the
         # caller asks for one (default None: the engine doesn't need it).
-        from ..distribution import load_distribution_module
-
-        # an unknown distribution name is a user error: fail hard, as is
-        # a graph build failure (a real bug, not an infeasible placement)
-        dist_module = load_distribution_module(distribution)
         graph = load_graph_module(
             algo_module.GRAPH_TYPE).build_computation_graph(dcop)
-        # ...but a placement that merely cannot be computed — capacity
-        # infeasible, or an algorithm with no footprint model (dpop) —
-        # must not kill the solve: the engine does not need the
-        # placement for the math.  Only those two declared failure modes
-        # are tolerated; a genuine bug in a distribution module
-        # propagates (VERDICT r2 weak 6: a bare ``except Exception``
-        # made distribution bugs invisible to every engine-mode test)
-        from ..distribution.objects import \
-            ImpossibleDistributionException
+        if _is_distribution_file(distribution):
+            # a pre-computed placement file (same dispatch as the
+            # thread/process path in _prepare_run)
+            dist_obj = _load_checked_dist(distribution, graph,
+                                          dcop.agents_def)
+        else:
+            # an unknown distribution name is a user error: fail hard,
+            # as is a graph build failure (a real bug, not an infeasible
+            # placement)...
+            from ..distribution import load_distribution_module
 
-        try:
-            dist_obj = dist_module.distribute(
-                graph, dcop.agents_def, dcop.dist_hints,
-                algo_module.computation_memory,
-                algo_module.communication_load)
-        except (ImpossibleDistributionException,
-                NotImplementedError) as e:
-            logging.getLogger("pydcop_tpu.run").warning(
-                "Could not compute the %s distribution (%s); solving "
-                "without a placement", distribution, e)
+            dist_module = load_distribution_module(distribution)
+            # ...but a placement that merely cannot be computed —
+            # capacity infeasible, or an algorithm with no footprint
+            # model (dpop) — must not kill the solve: the engine does
+            # not need the placement for the math.  Only those two
+            # declared failure modes are tolerated; a genuine bug in a
+            # distribution module propagates (VERDICT r2 weak 6: a bare
+            # ``except Exception`` made distribution bugs invisible to
+            # every engine-mode test)
+            from ..distribution.objects import \
+                ImpossibleDistributionException
+
+            try:
+                dist_obj = dist_module.distribute(
+                    graph, dcop.agents_def, dcop.dist_hints,
+                    algo_module.computation_memory,
+                    algo_module.communication_load)
+            except (ImpossibleDistributionException,
+                    NotImplementedError) as e:
+                logging.getLogger("pydcop_tpu.run").warning(
+                    "Could not compute the %s distribution (%s); "
+                    "solving without a placement", distribution, e)
     solver = algo_module.build_solver(dcop, algo_def.params)
     engine = SyncEngine(solver)
     result = engine.run(
@@ -113,6 +131,58 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     if dist_obj is not None:
         result.metrics["distribution"] = dist_obj.mapping()
     return result
+
+def _is_distribution_file(distribution) -> bool:
+    """A ``-d`` value names a placement *file* only by its yaml suffix —
+    a bare method name must never be shadowed by a same-named file in
+    the working directory (e.g. an earlier ``distribute`` output saved
+    as ``oneagent``)."""
+    return isinstance(distribution, str) and \
+        distribution.endswith((".yaml", ".yml"))
+
+
+def _load_checked_dist(filename: str, cg, agents):
+    """Load a placement file and validate it against the graph and
+    agents it is about to deploy — the single dispatch point for every
+    ``-d <file>`` path (engine, solve_direct, thread/process)."""
+    from ..distribution.yamlformat import load_dist_from_file
+
+    dist = load_dist_from_file(filename)
+    _check_distribution_covers(dist, cg, filename, agents)
+    return dist
+
+
+def _check_distribution_covers(dist, cg, filename: str, agents=None):
+    """A placement loaded from file must exactly cover the graph it is
+    about to deploy, on agents the problem knows; a stale or mismatched
+    file (wrong algorithm/graph type, other instance) otherwise fails
+    far downstream — undeployed computations or unknown agents leave an
+    orchestrated run waiting until timeout, and computations absent from
+    the graph KeyError mid-deploy."""
+    placed = set(dist.computations)
+    nodes = {n.name for n in cg.nodes}
+    missing = sorted(nodes - placed)
+    if missing:
+        raise ValueError(
+            f"Distribution file {filename!r} does not place "
+            f"computations {missing}; it was probably computed for a "
+            f"different algorithm or graph type — re-run `distribute` "
+            f"with the matching -a/-g")
+    extra = sorted(placed - nodes)
+    if extra:
+        raise ValueError(
+            f"Distribution file {filename!r} places computations "
+            f"{extra} that do not exist in this graph; it was probably "
+            f"computed for a different algorithm or graph type — "
+            f"re-run `distribute` with the matching -a/-g")
+    if agents is not None:
+        known = {a.name for a in agents}
+        unknown = sorted(set(dist.agents) - known)
+        if unknown:
+            raise ValueError(
+                f"Distribution file {filename!r} names agents "
+                f"{unknown} that are not part of this problem")
+
 
 # --------------------------------------------------------------------------
 # Orchestrated runtime bootstrap (reference: infrastructure/run.py:145-287)
@@ -142,15 +212,11 @@ def _prepare_run(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     graph_module = load_graph_module(graph or algo_module.GRAPH_TYPE)
     cg = graph_module.build_computation_graph(dcop)
     if isinstance(distribution, str):
-        import os
-
-        if distribution.endswith((".yaml", ".yml")) or \
-                os.path.isfile(distribution):
+        if _is_distribution_file(distribution):
             # a pre-computed placement file (reference: run/solve accept
             # either a method name or a distribution yaml)
-            from ..distribution.yamlformat import load_dist_from_file
-
-            dist = load_dist_from_file(distribution)
+            dist = _load_checked_dist(distribution, cg,
+                                      dcop.agents_def)
         else:
             from ..distribution import load_distribution_module
 

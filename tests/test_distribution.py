@@ -360,3 +360,117 @@ def test_gh_secp_fgdp_rules_near_their_scope(secp_setup):
         agent = dist.agent_for(node.name)
         hosted = set(dist.computations_hosted(agent))
         assert hosted & set(node.neighbors), (node.name, agent)
+
+
+# ------------------------------------------------- placement-file dispatch
+
+
+def test_engine_mode_accepts_distribution_yaml_file(tmp_path):
+    """solve_result (engine mode, the default) must accept ``-d`` as a
+    pre-computed placement file, exactly like the thread/process path —
+    the help text advertises both for every mode."""
+    from pydcop_tpu.distribution.yamlformat import yaml_dist
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop(YAML)
+    cg = constraints_hypergraph.build_computation_graph(dcop)
+    mapping = {f"a{i+1}": [n.name] for i, n in enumerate(cg.nodes)}
+    dist_file = tmp_path / "dist.yaml"
+    dist_file.write_text(yaml_dist(Distribution(mapping)))
+
+    res = solve_result(dcop, "dsa", distribution=str(dist_file),
+                       timeout=20, stop_cycle=5, seed=1)
+    assert res.assignment
+    assert res.metrics["distribution"] == {
+        a: comps for a, comps in mapping.items()}
+
+
+def test_stale_distribution_file_fails_fast(tmp_path):
+    """A placement file that does not place this graph's computations
+    (computed for another algorithm/graph) must error immediately, not
+    leave the run waiting for undeployed computations."""
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop(YAML)
+    dist_file = tmp_path / "stale.yaml"
+    dist_file.write_text(
+        "distribution:\n  a1: [w1, w2]\n  a2: [w3]\n")
+    with pytest.raises(ValueError, match="does not place"):
+        solve_result(dcop, "dsa", distribution=str(dist_file),
+                     timeout=20, stop_cycle=5, seed=1)
+
+
+def test_method_name_never_shadowed_by_cwd_file(tmp_path, monkeypatch):
+    """A file named like a distribution method in the cwd must not
+    hijack ``-d oneagent``: only a .yaml/.yml suffix means 'file'."""
+    from pydcop_tpu.infrastructure.run import _prepare_run
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "oneagent").write_text("not a distribution\n")
+    dcop = load_dcop(YAML)
+    _, _, dist = _prepare_run(dcop, "dsa", distribution="oneagent")
+    # the real oneagent method ran: one computation per agent
+    assert all(len(comps) <= 1 for comps in dist.mapping().values())
+
+
+def test_distribution_file_with_unknown_agents_fails_fast(tmp_path):
+    """All computations placed, but on agents the problem doesn't know:
+    an orchestrated run would spawn no matching agent and block until
+    the registration timeout — must error immediately instead."""
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop(YAML)
+    dist_file = tmp_path / "foreign.yaml"
+    dist_file.write_text("distribution:\n  b1: [v1, v2, v3]\n")
+    with pytest.raises(ValueError, match="not part of this problem"):
+        solve_result(dcop, "dsa", distribution=str(dist_file),
+                     timeout=20, stop_cycle=5, seed=1)
+
+
+def test_distribution_file_with_extra_computations_fails_fast(tmp_path):
+    """A file computed for a richer graph (e.g. factor graph with 'c12'
+    factor nodes) must not pass coverage for a hypergraph run — the
+    deploy path would KeyError on the unknown computation mid-startup."""
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop(YAML)
+    dist_file = tmp_path / "richer.yaml"
+    dist_file.write_text(
+        "distribution:\n  a1: [v1, v2, v3, c12, c23]\n")
+    with pytest.raises(ValueError, match="do not exist in this graph"):
+        solve_result(dcop, "dsa", distribution=str(dist_file),
+                     timeout=20, stop_cycle=5, seed=1)
+
+
+def test_solve_direct_validates_distribution_file(tmp_path):
+    """Exact algorithms (dpop) bypass the cyclic engine but must still
+    validate an explicit placement file and report it in the metrics."""
+    from pydcop_tpu.distribution.yamlformat import yaml_dist
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = load_dcop(YAML)
+    stale = tmp_path / "stale.yaml"
+    stale.write_text("distribution:\n  a1: [w1]\n")
+    with pytest.raises(ValueError, match="does not place"):
+        solve_result(dcop, "dpop", distribution=str(stale), timeout=20)
+
+    good = tmp_path / "good.yaml"
+    good.write_text(yaml_dist(Distribution(
+        {"a1": ["v1"], "a2": ["v2"], "a3": ["v3"]})))
+    res = solve_result(dcop, "dpop", distribution=str(good), timeout=20)
+    assert res.metrics["distribution"] == {
+        "a1": ["v1"], "a2": ["v2"], "a3": ["v3"]}
+    assert res.violations == 0
+
+
+def test_thread_path_rejects_unknown_agents_in_dist_file(tmp_path):
+    """_prepare_run (thread/process bootstrap) applies the same agent
+    validation as engine mode — an unknown-agent placement would spawn
+    zero agents and block on the registration timeout."""
+    from pydcop_tpu.infrastructure.run import _prepare_run
+
+    dcop = load_dcop(YAML)
+    dist_file = tmp_path / "foreign.yaml"
+    dist_file.write_text("distribution:\n  b1: [v1, v2, v3]\n")
+    with pytest.raises(ValueError, match="not part of this problem"):
+        _prepare_run(dcop, "dsa", distribution=str(dist_file))
