@@ -1,0 +1,481 @@
+"""Deep unit tier for the MGM-2 message-passing backend.
+
+The reference dedicates its largest algorithm test file to MGM-2's
+five-state offer machine (`/root/reference/tests/unit/
+test_algorithms_mgm2.py`, ~1,400 LoC): offer construction, global-gain
+evaluation, commit/response rules, the gain comparison, and the go
+confirmation.  This file covers the same decision surface against
+`pydcop_tpu/algorithms/mgm2.py`, driving one computation's phase
+handlers directly (no agents, no transports) plus one full two-party
+protocol run over an in-memory pump.
+"""
+
+import collections
+
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.algorithms.mgm2 import (Mgm2GainMessage, Mgm2GoMessage,
+                                        Mgm2OfferMessage,
+                                        Mgm2ResponseMessage,
+                                        Mgm2ValueMessage)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs.constraints_hypergraph import \
+    build_computation_graph as build_hypergraph
+from pydcop_tpu.infrastructure.computations import SynchronizationMsg
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def make_comp(var_name, params=None, src=GC3, sender=None):
+    dcop = load_dcop(src)
+    cg = build_hypergraph(dcop)
+    module = load_algorithm_module("mgm2")
+    algo = AlgorithmDef.build_with_default_param(
+        "mgm2", params or {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == var_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = sender or (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return comp, sent
+
+
+def deliver(comp, sender, msg, cycle_id):
+    msg._cycle_id = cycle_id
+    comp.on_message(sender, msg, 0.0)
+
+
+def prime_value_phase(comp, my_value="R", neighbor_value="R"):
+    """Run phase 0 with every neighbor announcing ``neighbor_value``."""
+    comp.start()
+    comp.value_selection(my_value)
+    for n in sorted(comp.neighbors):
+        deliver(comp, n, Mgm2ValueMessage(neighbor_value), cycle_id=0)
+
+
+# ----------------------------------------------------------- value phase
+
+
+def test_no_neighbor_variable_finishes_immediately():
+    src = GC3.replace("constraints:",
+                      "  v4: {domain: colors}\nconstraints:")
+    # (v4 rides the variables block; yaml indentation keeps it there)
+    comp, sent = make_comp("v4", {"seed": 1}, src=src)
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    assert done == [True]
+    assert comp.current_value in ("R", "G")
+    assert sent == []  # nobody to talk to
+
+
+def test_value_phase_records_neighbors_and_signed_cost():
+    comp, _ = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    prime_value_phase(comp, "R", "R")
+    assert comp._neighbor_values == {"v1": "R", "v3": "R"}
+    # v2=R against R/R: diff_1_2=1, diff_2_3=1, unary(R)=0.1
+    assert comp._current_signed == pytest.approx(2.1)
+    # unilateral best response is G: cost -0.1, gain 2.2
+    assert comp._potential_value == "G"
+    assert comp._potential_gain == pytest.approx(2.2)
+
+
+def test_value_phase_non_offerer_sends_empty_offers_to_all():
+    comp, sent = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    prime_value_phase(comp)
+    offers = [(d, m) for d, m in sent if m.type == "mgm2_offer"]
+    assert sorted(d for d, _ in offers) == ["v1", "v3"]
+    assert all(not m.is_offering and m.offers == [] for _, m in offers)
+
+
+def test_value_phase_offerer_sends_exactly_one_real_offer():
+    comp, sent = make_comp("v2", {"seed": 4, "threshold": 1.0})
+    prime_value_phase(comp)
+    offers = [(d, m) for d, m in sent if m.type == "mgm2_offer"]
+    real = [d for d, m in offers if m.is_offering]
+    assert len(real) == 1 and real[0] in ("v1", "v3")
+    assert comp._is_offerer and comp._partner == real[0]
+
+
+def test_compute_offers_exact_gains():
+    """Every strictly-improving (my_value, partner_value) pair, with the
+    offerer's local gain (reference: mgm2.py:520-553)."""
+    comp, _ = make_comp("v2", {"seed": 2, "threshold": 1.0})
+    comp.start()
+    comp.value_selection("R")
+    comp._neighbor_values = {"v1": "R", "v3": "R"}
+    comp._current_signed = 2.1
+    comp._partner = "v1"
+    offers = {(mv, pv): g for mv, pv, g in comp._compute_offers()}
+    # (R,R) keeps cost 2.1: not improving, excluded
+    assert ("R", "R") not in offers
+    assert offers[("R", "G")] == pytest.approx(1.0)   # cost 1.1
+    assert offers[("G", "R")] == pytest.approx(2.2)   # cost -0.1
+    assert offers[("G", "G")] == pytest.approx(1.2)   # cost 0.9
+
+
+def test_compute_offers_empty_when_nothing_improves():
+    comp, _ = make_comp("v2", {"seed": 2, "threshold": 1.0})
+    comp.start()
+    comp.value_selection("G")
+    comp._neighbor_values = {"v1": "R", "v3": "R"}
+    comp._current_signed = -0.1  # already at the neighborhood optimum
+    comp._partner = "v1"
+    assert comp._compute_offers() == []
+
+
+# ----------------------------------------------------------- offer phase
+
+
+def test_find_best_offer_reference_global_gain():
+    """global_gain = my delta over NON-shared constraints (+unary) plus
+    the offerer's announced gain — the reference's exact formula,
+    including its double count of the shared constraint
+    (mgm2.py:555-603: `self.current_cost - cost + partner_local_gain`
+    where only `cost` excludes shared constraints)."""
+    comp, _ = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    comp.start()
+    comp.value_selection("R")
+    comp._neighbor_values = {"v1": "R", "v3": "R"}
+    comp._current_signed = 2.1
+    comp._offers_recv = [("v1", [["G", "G", 1.5]], True)]
+    bests, gain = comp._find_best_offer()
+    # not-shared = diff_2_3: cost(v2=G, v3=R)=0, unary(G)=-0.1
+    # => (2.1 - (-0.1)) + 1.5 = 3.7
+    assert gain == pytest.approx(3.7)
+    assert bests == [("G", "G", "v1")]
+
+
+def test_find_best_offer_picks_max_over_senders():
+    comp, _ = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    comp.start()
+    comp.value_selection("R")
+    comp._neighbor_values = {"v1": "R", "v3": "R"}
+    comp._current_signed = 2.1
+    comp._offers_recv = [
+        ("v1", [["G", "G", 0.5], ["G", "R", 0.1]], True),
+        ("v3", [["G", "G", 2.0]], True),
+    ]
+    bests, gain = comp._find_best_offer()
+    assert bests == [("G", "G", "v3")]
+    # v3's offer: not-shared = diff_1_2: cost(v1=R, v2=G)=0, unary -0.1
+    assert gain == pytest.approx(2.2 + 2.0)
+
+
+def test_offer_phase_commit_beats_unilateral_and_accepts():
+    comp, sent = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    prime_value_phase(comp)  # unilateral potential gain 2.2
+    sent.clear()
+    deliver(comp, "v1", Mgm2OfferMessage([["G", "G", 5.0]], True),
+            cycle_id=1)
+    deliver(comp, "v3", Mgm2OfferMessage([], False), cycle_id=1)
+    assert comp._committed and comp._partner == "v1"
+    assert comp._potential_value == "G"
+    assert comp._potential_gain == pytest.approx(2.2 + 5.0)
+    responses = [(d, m) for d, m in sent if m.type == "mgm2_response"]
+    assert len(responses) == 1 and responses[0][0] == "v1"
+    assert responses[0][1].accept is True
+    assert responses[0][1].value == "G"
+    # the idle neighbor still gets the round closed via a sync message
+    syncs = [d for d, m in sent if isinstance(m, SynchronizationMsg)]
+    assert "v3" in syncs
+
+
+def test_offer_phase_rejects_when_unilateral_wins():
+    comp, sent = make_comp("v2", {"seed": 2, "threshold": 0.0})
+    prime_value_phase(comp)  # unilateral potential gain 2.2
+    sent.clear()
+    # global gain = 2.2 + (-2.0) = 0.2 < 2.2 unilateral
+    deliver(comp, "v1", Mgm2OfferMessage([["G", "G", -2.0]], True),
+            cycle_id=1)
+    deliver(comp, "v3", Mgm2OfferMessage([], False), cycle_id=1)
+    assert not comp._committed
+    responses = [(d, m) for d, m in sent if m.type == "mgm2_response"]
+    assert len(responses) == 1
+    assert responses[0][1].accept is False
+    assert responses[0][1].value is None
+
+
+def test_offer_phase_tie_favor_unilateral_rejects():
+    comp, sent = make_comp(
+        "v2", {"seed": 2, "threshold": 0.0, "favor": "unilateral"})
+    prime_value_phase(comp)
+    sent.clear()
+    # partner gain 0 => global gain 2.2 == unilateral 2.2 (tie)
+    deliver(comp, "v1", Mgm2OfferMessage([["G", "G", 0.0]], True),
+            cycle_id=1)
+    deliver(comp, "v3", Mgm2OfferMessage([], False), cycle_id=1)
+    assert not comp._committed
+
+
+def test_offer_phase_tie_favor_coordinated_commits():
+    comp, sent = make_comp(
+        "v2", {"seed": 2, "threshold": 0.0, "favor": "coordinated"})
+    prime_value_phase(comp)
+    sent.clear()
+    deliver(comp, "v1", Mgm2OfferMessage([["G", "G", 0.0]], True),
+            cycle_id=1)
+    deliver(comp, "v3", Mgm2OfferMessage([], False), cycle_id=1)
+    assert comp._committed and comp._partner == "v1"
+
+
+# -------------------------------------------------------- response phase
+
+
+def test_response_phase_offerer_accepted_commits_pair():
+    comp, sent = make_comp("v2", {"seed": 4, "threshold": 1.0})
+    comp.start()
+    comp._is_offerer = True
+    comp._partner = "v1"
+    comp._potential_gain = 2.2
+    comp._potential_value = "G"
+    sent.clear()
+    comp._response_phase(
+        {"v1": (Mgm2ResponseMessage(True, "G", 4.0), 0.0)})
+    assert comp._committed
+    assert comp._potential_value == "G"
+    assert comp._potential_gain == pytest.approx(4.0)
+    gains = [(d, m) for d, m in sent if m.type == "mgm2_gain"]
+    assert sorted(d for d, _ in gains) == ["v1", "v3"]
+    assert all(m.gain == pytest.approx(4.0) for _, m in gains)
+
+
+def test_response_phase_offerer_rejected_falls_back_to_unilateral():
+    comp, sent = make_comp("v2", {"seed": 4, "threshold": 1.0})
+    comp.start()
+    comp._is_offerer = True
+    comp._partner = "v1"
+    comp._potential_gain = 2.2
+    comp._potential_value = "G"
+    sent.clear()
+    comp._response_phase(
+        {"v1": (Mgm2ResponseMessage(False, None, 0.0), 0.0)})
+    assert not comp._committed
+    gains = [m for d, m in sent if m.type == "mgm2_gain"]
+    # announces the unilateral gain instead
+    assert all(m.gain == pytest.approx(2.2) for m in gains)
+
+
+# ------------------------------------------------------------ gain phase
+
+
+def test_gain_phase_committed_winner_sends_go_true():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp._committed = True
+    comp._partner = "v1"
+    comp._potential_gain = 4.0
+    sent.clear()
+    comp._gain_phase({"v1": (Mgm2GainMessage(4.0), 0.0),
+                      "v3": (Mgm2GainMessage(1.0), 0.0)})
+    # the partner's own gain (4.0) does not compete against the pair
+    assert comp._can_move is True
+    gos = [(d, m) for d, m in sent if m.type == "mgm2_go"]
+    assert gos == [("v1", gos[0][1])] and gos[0][1].go is True
+
+
+def test_gain_phase_committed_loser_sends_go_false():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp._committed = True
+    comp._partner = "v1"
+    comp._potential_gain = 4.0
+    sent.clear()
+    comp._gain_phase({"v1": (Mgm2GainMessage(4.0), 0.0),
+                      "v3": (Mgm2GainMessage(9.0), 0.0)})
+    assert comp._can_move is False
+    gos = [m for d, m in sent if m.type == "mgm2_go"]
+    assert len(gos) == 1 and gos[0].go is False
+
+
+def test_gain_phase_zero_gain_idles_with_syncs_only():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp._potential_gain = 0.0
+    sent.clear()
+    comp._gain_phase({"v1": (Mgm2GainMessage(3.0), 0.0),
+                      "v3": (Mgm2GainMessage(1.0), 0.0)})
+    assert comp._can_move is False
+    assert all(isinstance(m, SynchronizationMsg) for _, m in sent)
+
+
+def test_gain_phase_unilateral_strict_winner_moves():
+    comp, _ = make_comp("v2", {"seed": 2})
+    comp.start()
+    comp.value_selection("R")
+    comp._potential_gain = 2.2
+    comp._potential_value = "G"
+    comp._current_signed = 2.1
+    comp._gain_phase({"v1": (Mgm2GainMessage(1.0), 0.0),
+                      "v3": (Mgm2GainMessage(0.5), 0.0)})
+    assert comp.current_value == "G"
+    assert comp.current_cost == pytest.approx(-0.1)
+
+
+def test_gain_phase_unilateral_tie_lower_name_wins():
+    # v2 ties with v1: lexic order gives the move to v1, not v2
+    comp, _ = make_comp("v2", {"seed": 2})
+    comp.start()
+    comp.value_selection("R")
+    comp._potential_gain = 2.2
+    comp._potential_value = "G"
+    comp._gain_phase({"v1": (Mgm2GainMessage(2.2), 0.0),
+                      "v3": (Mgm2GainMessage(0.0), 0.0)})
+    assert comp.current_value == "R"
+    # ...but v1 in the same spot moves (it IS the lexic minimum)
+    comp1, _ = make_comp("v1", {"seed": 2})
+    comp1.start()
+    comp1.value_selection("R")
+    comp1._potential_gain = 2.2
+    comp1._potential_value = "G"
+    comp1._gain_phase({"v2": (Mgm2GainMessage(2.2), 0.0)})
+    assert comp1.current_value == "G"
+
+
+# -------------------------------------------------------------- go phase
+
+
+def test_go_phase_coordinated_move_needs_both_goes():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp.value_selection("R")
+    comp._partner = "v1"
+    comp._can_move = True
+    comp._potential_value = "G"
+    comp._potential_gain = 4.0
+    sent.clear()
+    comp._go_phase({"v1": (Mgm2GoMessage(True), 0.0)})
+    assert comp.current_value == "G"
+    # iteration closed: fresh value message for the next one, state reset
+    values = [m for d, m in sent if m.type == "mgm2_value"]
+    assert len(values) == 2 and all(m.value == "G" for m in values)
+    assert comp._partner is None and not comp._committed
+    assert comp._cycle_count >= 1  # one full MGM-2 iteration closed
+
+
+def test_go_phase_partner_cancel_blocks_move():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp.value_selection("R")
+    comp._partner = "v1"
+    comp._can_move = True
+    comp._potential_value = "G"
+    sent.clear()
+    comp._go_phase({"v1": (Mgm2GoMessage(False), 0.0)})
+    assert comp.current_value == "R"
+
+
+def test_go_phase_own_veto_blocks_move_despite_partner_go():
+    comp, _ = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp.value_selection("R")
+    comp._partner = "v1"
+    comp._can_move = False  # we lost the gain comparison
+    comp._potential_value = "G"
+    comp._go_phase({"v1": (Mgm2GoMessage(True), 0.0)})
+    assert comp.current_value == "R"
+
+
+def test_go_phase_stop_cycle_finishes():
+    comp, sent = make_comp("v2", {"seed": 4, "stop_cycle": 1})
+    comp.start()
+    comp.value_selection("R")
+    done = []
+    comp.finished = lambda: done.append(True)
+    sent.clear()
+    comp._go_phase({})
+    assert done == [True]
+    # no value message for a next iteration after finishing
+    assert [m for d, m in sent if m.type == "mgm2_value"] == []
+
+
+# ------------------------------------------------------- rejoin behavior
+
+
+def test_fast_forward_value_subcycle_reannounces():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp.value_selection("R")
+    sent.clear()
+    # a message from round 10 (10 % 5 == value sub-cycle) arrives: the
+    # mixin fast-forwards and mgm2 re-announces its value for that round
+    deliver(comp, "v1", Mgm2ValueMessage("G"), cycle_id=10)
+    values = [m for d, m in sent if m.type == "mgm2_value"]
+    assert len(values) == 2
+    assert comp._current_cycle == 10
+    assert comp._partner is None  # iteration state wiped
+
+
+def test_fast_forward_offer_subcycle_sends_empty_offers():
+    comp, sent = make_comp("v2", {"seed": 4})
+    comp.start()
+    comp.value_selection("R")
+    sent.clear()
+    deliver(comp, "v1", Mgm2OfferMessage([], False), cycle_id=11)
+    offers = [m for d, m in sent if m.type == "mgm2_offer"]
+    assert len(offers) == 2 and all(not m.is_offering for m in offers)
+
+
+# ------------------------------------------- full two-party protocol run
+
+
+def pump(comps, queue, max_msgs=600):
+    by_name = {c.name: c for c in comps}
+    n = 0
+    while queue and n < max_msgs:
+        src, dest, msg = queue.popleft()
+        by_name[dest].on_message(src, msg, 0.0)
+        n += 1
+    return n
+
+
+def test_two_party_coordinated_protocol_resolves_conflict():
+    """v1 (always offerer) and v2 (never) run the real five-phase wire
+    protocol against each other until stop_cycle; the pair must end on
+    different colors (any same-color state has an improving move, via
+    coordination or the unilateral rule)."""
+    src = """
+name: pair
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+constraints:
+  diff: {type: intention, function: 1 if v1 == v2 else 0}
+agents: [a1, a2]
+"""
+    queue = collections.deque()
+    comps = []
+    for name, threshold in (("v1", 1.0), ("v2", 0.0)):
+        comp, _ = make_comp(
+            name, {"seed": 11, "threshold": threshold, "stop_cycle": 4},
+            src=src,
+            sender=lambda s, d, m, p, e, _src=name: queue.append(
+                (_src, d, m)))
+        comps.append(comp)
+    for c in comps:
+        c.start()
+    pumped = pump(comps, queue)
+    assert pumped > 0
+    v1, v2 = comps[0].current_value, comps[1].current_value
+    assert v1 != v2, f"conflict remains after 4 iterations: {v1}={v2}"
+    # both closed the same number of iterations (the mixin kept them in
+    # lock-step through all five sub-cycles)
+    assert comps[0].cycle_count == comps[1].cycle_count
