@@ -284,8 +284,6 @@ class SyncBBMpComputation(VariableComputation):
     @register("syncbb_forward")
     def _on_forward(self, sender, msg, t):
         current_path = [list(e) for e in msg.current_path]
-        if msg.ub is not None and msg.ub < self.upper_bound:
-            self.upper_bound = float(msg.ub)
         if msg.ub is not None and float(msg.ub) < self.upper_bound:
             self.upper_bound = float(msg.ub)
         nxt = self._next_assignment(None, current_path)

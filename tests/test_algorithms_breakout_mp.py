@@ -1,0 +1,402 @@
+"""Deep unit tier for the breakout-family message-passing backends
+(DBA and GDBA).
+
+Mirrors the reference's per-algorithm suites
+(`/root/reference/tests/unit/test_algorithms_dba.py`, ~600 LoC, and
+`test_algorithms_gdba.py`): weighted-violation evals, ok?/improve wave
+decisions, quasi-local-minimum breakouts, modifier arithmetic
+(A/M x NZ/NM/MX x E/R/C/T), asynchronous termination.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs.constraints_hypergraph import \
+    build_computation_graph as build_hypergraph
+
+#: CSP-style: hard equality conflicts marked with the infinity cost
+CSP3 = """
+name: csp3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+constraints:
+  diff_1_2: {type: intention, function: 10000 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 10000 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+#: soft costs with a non-zero minimum (separates NZ from NM semantics)
+SOFT3 = """
+name: soft3
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+  v3: {domain: d}
+constraints:
+  c12: {type: intention, function: 2 if v1 == v2 else 1}
+  c23: {type: intention, function: 2 if v2 == v3 else 1}
+agents: [a1, a2, a3]
+"""
+
+
+def make_comp(algo_name, var_name, params=None, src=CSP3, mode=None):
+    dcop = load_dcop(src)
+    cg = build_hypergraph(dcop)
+    module = load_algorithm_module(algo_name)
+    algo = AlgorithmDef.build_with_default_param(
+        algo_name, params or {}, mode=mode or dcop.objective)
+    node = next(n for n in cg.nodes if n.name == var_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return comp, sent
+
+
+def deliver(comp, sender, msg, cycle_id):
+    msg._cycle_id = cycle_id
+    comp.on_message(sender, msg, 0.0)
+
+
+# ================================================================== DBA
+
+
+def dba_msgs():
+    from pydcop_tpu.algorithms.dba import (DbaEndMessage,
+                                           DbaImproveMessage,
+                                           DbaOkMessage)
+    return DbaOkMessage, DbaImproveMessage, DbaEndMessage
+
+
+def test_dba_rejects_max_mode():
+    with pytest.raises(ValueError, match="satisfaction"):
+        make_comp("dba", "v2", {"seed": 1},
+                  src=CSP3.replace("objective: min", "objective: max"),
+                  mode="max")
+
+
+def test_dba_eval_counts_weighted_violations():
+    comp, _ = make_comp("dba", "v2", {"seed": 1})
+    comp.start()
+    comp._neighbor_values = {"v1": "R", "v3": "G"}
+    # v2=R violates diff_1_2 only; v2=G violates diff_2_3 only
+    ev_r, viol_r = comp._eval_value("R")
+    ev_g, viol_g = comp._eval_value("G")
+    assert ev_r == 1.0 and len(viol_r) == 1
+    assert ev_g == 1.0 and len(viol_g) == 1
+    # a raised weight flows into the eval
+    comp._weights[viol_r[0]] = 3.0
+    ev_r2, _ = comp._eval_value("R")
+    assert ev_r2 == 3.0
+
+
+def test_dba_ok_phase_improvement_announced():
+    OkMsg, _, _ = dba_msgs()
+    comp, sent = make_comp("dba", "v2", {"seed": 1})
+    comp.start()
+    comp.value_selection("R")
+    sent.clear()
+    deliver(comp, "v1", OkMsg("R"), cycle_id=0)
+    deliver(comp, "v3", OkMsg("G"), cycle_id=0)
+    # v2=R violates diff_1_2 (weight 1); v2=G would violate diff_2_3 —
+    # no improvement: quasi-local-minimum announced with improve=0
+    assert comp._current_eval == pytest.approx(1.0)
+    assert comp._quasi_local_minimum
+    improves = [m for d, m in sent if m.type == "dba_improve"]
+    assert len(improves) == 2
+    assert improves[0].improve == pytest.approx(0.0)
+    assert improves[0].current_eval == pytest.approx(1.0)
+
+
+def test_dba_ok_phase_can_move_when_improving():
+    OkMsg, _, _ = dba_msgs()
+    comp, sent = make_comp("dba", "v2", {"seed": 1})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v1", OkMsg("G"), cycle_id=0)
+    deliver(comp, "v3", OkMsg("G"), cycle_id=0)
+    # v2=R violates nothing? R vs G/G: no conflict -> eval 0, consistent
+    assert comp._current_eval == 0.0 and comp._consistent
+    # now a conflicted start: neighbors on R
+    comp2, _ = make_comp("dba", "v1", {"seed": 1})
+    comp2.start()
+    comp2.value_selection("R")
+    deliver(comp2, "v2", OkMsg("R"), cycle_id=0)
+    assert comp2._my_improve == pytest.approx(1.0)
+    assert comp2._can_move and comp2._new_value == "G"
+
+
+def test_dba_improve_phase_strict_loser_stays():
+    OkMsg, ImpMsg, _ = dba_msgs()
+    comp, _ = make_comp("dba", "v1", {"seed": 1})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v2", OkMsg("R"), cycle_id=0)
+    assert comp._can_move
+    deliver(comp, "v2", ImpMsg(5.0, 1.0, 0), cycle_id=1)
+    assert comp.current_value == "R"  # v2 improves more: we stay
+
+
+def test_dba_improve_phase_tie_lower_name_moves():
+    OkMsg, ImpMsg, _ = dba_msgs()
+    comp, _ = make_comp("dba", "v1", {"seed": 1})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v2", OkMsg("R"), cycle_id=0)
+    my_improve = comp._my_improve
+    deliver(comp, "v2", ImpMsg(my_improve, 1.0, 0), cycle_id=1)
+    assert comp.current_value == "G"  # v1 < v2: tie goes to us
+    # symmetric case: v2 ties with v1 and must NOT move
+    comp2, _ = make_comp("dba", "v2", {"seed": 1})
+    comp2.start()
+    comp2.value_selection("R")
+    deliver(comp2, "v1", OkMsg("R"), cycle_id=0)
+    deliver(comp2, "v3", OkMsg("R"), cycle_id=0)
+    assert comp2._can_move  # moving to G fixes both constraints
+    mi = comp2._my_improve
+    deliver(comp2, "v1", ImpMsg(mi, 1.0, 0), cycle_id=1)
+    deliver(comp2, "v3", ImpMsg(0.0, 0.0, 0), cycle_id=1)
+    assert comp2.current_value == "R"
+
+
+def test_dba_breakout_bumps_only_violated_weights():
+    OkMsg, ImpMsg, _ = dba_msgs()
+    comp, _ = make_comp("dba", "v2", {"seed": 1})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v1", OkMsg("R"), cycle_id=0)
+    deliver(comp, "v3", OkMsg("G"), cycle_id=0)
+    # v2=R violates diff_1_2; v2=G violates diff_2_3: stuck either way
+    assert comp._quasi_local_minimum
+    violated = list(comp._violated)
+    deliver(comp, "v1", ImpMsg(0.0, 1.0, 0), cycle_id=1)
+    deliver(comp, "v3", ImpMsg(0.0, 1.0, 0), cycle_id=1)
+    for i, w in enumerate(comp._weights):
+        assert w == pytest.approx(2.0 if i in violated else 1.0)
+
+
+def test_dba_termination_wave_after_max_distance():
+    OkMsg, ImpMsg, _ = dba_msgs()
+    comp, sent = make_comp("dba", "v1", {"seed": 1, "max_distance": 2})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp.value_selection("R")
+    cycle = 0
+    for _ in range(2):  # two consistent full iterations
+        deliver(comp, "v2", OkMsg("G"), cycle_id=cycle)
+        assert comp._consistent
+        deliver(comp, "v2", ImpMsg(0.0, 0.0, comp._termination_counter),
+                cycle_id=cycle + 1)
+        cycle += 2
+    assert done == [True]
+    assert not comp.is_running
+    ends = [m for d, m in sent if m.type == "dba_end"]
+    assert len(ends) == 1  # end wave broadcast to the neighbor
+
+
+def test_dba_termination_counter_resets_on_violation():
+    OkMsg, ImpMsg, _ = dba_msgs()
+    comp, _ = make_comp("dba", "v1", {"seed": 1, "max_distance": 3})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v2", OkMsg("G"), cycle_id=0)
+    deliver(comp, "v2", ImpMsg(0.0, 0.0, 0), cycle_id=1)
+    assert comp._termination_counter == 1
+    # next iteration the neighborhood reports a violation somewhere
+    deliver(comp, "v2", OkMsg("G"), cycle_id=2)
+    deliver(comp, "v2", ImpMsg(0.0, 5.0, 0), cycle_id=3)
+    assert comp._termination_counter == 0
+
+
+def test_dba_end_message_is_asynchronous():
+    """dba_end bypasses the round barrier (reference: dba.py:568-581):
+    a finished neighbor must not deadlock our half-open cycle."""
+    _, _, EndMsg = dba_msgs()
+    comp, sent = make_comp("dba", "v1", {"seed": 1})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    # mid-cycle (no messages delivered at all), the neighbor ends
+    deliver(comp, "v2", EndMsg(), cycle_id=7)
+    assert done == [True]
+    assert not comp.is_running
+    assert [m for d, m in sent if m.type == "dba_end"]
+
+
+# ================================================================= GDBA
+
+
+def gdba_msgs():
+    from pydcop_tpu.algorithms.gdba import (GdbaImproveMessage,
+                                            GdbaOkMessage)
+    return GdbaOkMessage, GdbaImproveMessage
+
+
+def test_gdba_eff_cost_additive_and_multiplicative():
+    comp, _ = make_comp("gdba", "v2", {"seed": 1, "modifier": "A"},
+                        src=SOFT3)
+    comp.start()
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    asgt = comp._scope_assignment(comp.constraints[0], 0)
+    assert comp._eff_cost(0, asgt) == pytest.approx(2.0)  # base, mod 0
+    comp._bump(0, asgt)
+    assert comp._eff_cost(0, asgt) == pytest.approx(3.0)  # 2 + 1
+
+    comp_m, _ = make_comp("gdba", "v2", {"seed": 1, "modifier": "M"},
+                          src=SOFT3)
+    comp_m.start()
+    comp_m._neighbor_values = {"v1": 0, "v3": 0}
+    asgt = comp_m._scope_assignment(comp_m.constraints[0], 0)
+    assert comp_m._eff_cost(0, asgt) == pytest.approx(2.0)  # 2 * 1
+    comp_m._bump(0, asgt)
+    assert comp_m._eff_cost(0, asgt) == pytest.approx(4.0)  # 2 * 2
+
+
+@pytest.mark.parametrize("mode,expected", [
+    ("NZ", {0: True, 1: True}),   # costs 2 and 1: both non-zero
+    ("NM", {0: True, 1: False}),  # min is 1: only the 2 is 'violated'
+    ("MX", {0: True, 1: False}),  # max is 2
+])
+def test_gdba_violation_modes(mode, expected):
+    comp, _ = make_comp("gdba", "v2", {"seed": 1, "violation": mode},
+                        src=SOFT3)
+    comp.start()
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    c = comp.constraints[0]  # c12
+    equal = comp._scope_assignment(c, 0)       # cost 2
+    assert comp._is_violated(0, equal) is expected[0]
+    comp._neighbor_values = {"v1": 1, "v3": 0}
+    diff = comp._scope_assignment(c, 0)        # cost 1
+    assert comp._is_violated(0, diff) is expected[1]
+
+
+def test_gdba_increase_mode_e_bumps_one_cell():
+    comp, _ = make_comp("gdba", "v2",
+                        {"seed": 1, "increase_mode": "E"}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    comp._increase_modifiers(0)
+    assert len(comp._modifiers[0]) == 1
+    bumped = comp._scope_assignment(comp.constraints[0], 0)
+    assert comp._modifiers[0][frozenset(bumped.items())] == 1.0
+
+
+def test_gdba_increase_mode_r_bumps_my_row():
+    comp, _ = make_comp("gdba", "v2",
+                        {"seed": 1, "increase_mode": "R"}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    comp._increase_modifiers(0)
+    # v1 fixed at 0, both of my values bumped
+    assert len(comp._modifiers[0]) == 2
+
+
+def test_gdba_increase_mode_t_bumps_every_cell():
+    comp, _ = make_comp("gdba", "v2",
+                        {"seed": 1, "increase_mode": "T"}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    comp._increase_modifiers(0)
+    assert len(comp._modifiers[0]) == 4  # 2x2 cells
+
+
+def test_gdba_modifiers_shift_best_response():
+    OkMsg, ImpMsg = gdba_msgs()
+    comp, _ = make_comp("gdba", "v2", {"seed": 1}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    comp._neighbor_values = {"v1": 0, "v3": 1}
+    # v2=0: c12 cost 2, c23 cost 1 -> 3; v2=1: 1 + 2 -> 3: tie, stuck
+    ev0, _ = comp._eval_value(0)
+    ev1, _ = comp._eval_value(1)
+    assert ev0 == pytest.approx(3.0) and ev1 == pytest.approx(3.0)
+    # bump the (v1=0, v2=0) cell: 0 becomes strictly worse
+    comp._bump(0, {"v1": 0, "v2": 0})
+    ev0b, _ = comp._eval_value(0)
+    assert ev0b == pytest.approx(4.0)
+
+
+def test_gdba_improve_phase_winner_moves_loser_stays():
+    OkMsg, ImpMsg = gdba_msgs()
+    comp, _ = make_comp("gdba", "v2", {"seed": 1}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    deliver(comp, "v1", OkMsg(0), cycle_id=0)
+    deliver(comp, "v3", OkMsg(0), cycle_id=0)
+    # v2=0 -> 2+2=4; v2=1 -> 1+1=2: improve 2, move candidate
+    assert comp._my_improve == pytest.approx(2.0)
+    deliver(comp, "v1", ImpMsg(0.5), cycle_id=1)
+    deliver(comp, "v3", ImpMsg(1.0), cycle_id=1)
+    assert comp.current_value == 1  # strict winner
+    # loser case
+    comp2, _ = make_comp("gdba", "v2", {"seed": 1}, src=SOFT3)
+    comp2.start()
+    comp2.value_selection(0)
+    deliver(comp2, "v1", OkMsg(0), cycle_id=0)
+    deliver(comp2, "v3", OkMsg(0), cycle_id=0)
+    deliver(comp2, "v1", ImpMsg(5.0), cycle_id=1)
+    deliver(comp2, "v3", ImpMsg(0.0), cycle_id=1)
+    assert comp2.current_value == 0
+
+
+def test_gdba_stuck_neighborhood_increases_modifiers():
+    OkMsg, ImpMsg = gdba_msgs()
+    comp, _ = make_comp("gdba", "v2",
+                        {"seed": 1, "increase_mode": "E"}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    deliver(comp, "v1", OkMsg(0), cycle_id=0)
+    deliver(comp, "v3", OkMsg(1), cycle_id=0)
+    # tie (3 vs 3): no own improvement
+    assert comp._my_improve <= 1e-9
+    violated = list(comp._violated)
+    assert violated  # NZ mode: soft costs are all non-zero
+    deliver(comp, "v1", ImpMsg(0.0), cycle_id=1)
+    deliver(comp, "v3", ImpMsg(0.0), cycle_id=1)
+    bumped = [i for i, m in enumerate(comp._modifiers) if m]
+    assert bumped == violated
+
+
+def test_gdba_max_mode_signed_eval():
+    src = SOFT3.replace("objective: min", "objective: max")
+    comp, _ = make_comp("gdba", "v2", {"seed": 1}, src=src, mode="max")
+    comp.start()
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    # max mode: higher raw cost = better = lower signed eval
+    ev_equal, _ = comp._eval_value(0)   # raw 4
+    ev_diff, _ = comp._eval_value(1)    # raw 2
+    assert ev_equal == pytest.approx(-4.0)
+    assert ev_diff == pytest.approx(-2.0)
+    assert ev_equal < ev_diff
+
+
+def test_gdba_stop_cycle_finishes():
+    OkMsg, ImpMsg = gdba_msgs()
+    comp, sent = make_comp("gdba", "v2",
+                           {"seed": 1, "stop_cycle": 1}, src=SOFT3)
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp.value_selection(0)
+    deliver(comp, "v1", OkMsg(0), cycle_id=0)
+    deliver(comp, "v3", OkMsg(0), cycle_id=0)
+    sent.clear()
+    deliver(comp, "v1", ImpMsg(0.0), cycle_id=1)
+    deliver(comp, "v3", ImpMsg(0.0), cycle_id=1)
+    assert done == [True]
+    # no ok message for a next iteration after finishing
+    assert [m for d, m in sent if m.type == "gdba_ok"] == []

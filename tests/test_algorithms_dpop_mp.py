@@ -1,0 +1,269 @@
+"""Deep unit tier for the DPOP message-passing backend: the UTIL/VALUE
+wire protocol node by node.
+
+Mirrors the reference's `/root/reference/tests/unit/
+test_algorithms_dpop.py`: leaf UTIL content, internal-node join gating,
+root selection, VALUE conditioning through separators, and full
+pseudo-tree protocol runs (chain and triangle-with-pseudo-parent) over
+an in-memory pump, checked against the brute-force optimum.
+"""
+
+import collections
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs.pseudotree import build_computation_graph
+
+CHAIN3 = """
+name: chain3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+TRIANGLE = """
+name: triangle
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors, cost_function: 0.0 if v1 == 'R' else 0.5}
+  v2: {domain: colors, cost_function: 0.0 if v2 == 'G' else 0.5}
+  v3: {domain: colors, cost_function: 0.0 if v3 == 'B' else 0.5}
+constraints:
+  c12: {type: intention, function: 10 if v1 == v2 else 0}
+  c23: {type: intention, function: 10 if v2 == v3 else 0}
+  c13: {type: intention, function: 10 if v1 == v3 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def make_comps(src, **params):
+    dcop = load_dcop(src)
+    cg = build_computation_graph(dcop)
+    module = load_algorithm_module("dpop")
+    algo = AlgorithmDef.build_with_default_param(
+        "dpop", dict(params), mode=dcop.objective)
+    comps = {}
+    for node in cg.nodes:
+        comps[node.name] = module.build_computation(
+            ComputationDef(node, algo))
+    return dcop, cg, comps
+
+
+def record(comp):
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return sent
+
+
+def brute_force(dcop):
+    best, best_cost = None, None
+    domains = {n: list(v.domain.values)
+               for n, v in dcop.variables.items()}
+    names = sorted(domains)
+    for combo in itertools.product(*[domains[n] for n in names]):
+        asgt = dict(zip(names, combo))
+        cost, _ = dcop.solution_cost(asgt)
+        if best_cost is None or cost < best_cost:
+            best, best_cost = asgt, cost
+    return best, best_cost
+
+
+# ---------------------------------------------------------- single nodes
+
+
+def test_chain_tree_shape():
+    _, cg, comps = make_comps(CHAIN3)
+    # max-degree root heuristic: v2 (degree 2) is the root
+    assert comps["v2"].is_root
+    assert comps["v2"].children == ["v1", "v3"] or \
+        comps["v2"].children == ["v3", "v1"]
+    assert comps["v1"].parent == "v2" and comps["v1"].is_leaf
+    assert comps["v3"].parent == "v2" and comps["v3"].is_leaf
+
+
+def test_leaf_fires_exact_util_at_start():
+    _, _, comps = make_comps(CHAIN3)
+    leaf = comps["v1"]
+    sent = record(leaf)
+    leaf.start()
+    assert len(sent) == 1
+    dest, msg = sent[0]
+    assert dest == "v2" and msg.type == "dpop_util"
+    assert msg.dims == [["v2", ["R", "G"]]]
+    # util(v2) = min_v1 [ cost(v1) + diff(v1,v2) ]:
+    #   v2=R: min(-0.1+1, 0.1+0) = 0.1 ; v2=G: min(-0.1+0, 0.1+1) = -0.1
+    assert msg.costs == pytest.approx([0.1, -0.1])
+
+
+def test_internal_node_waits_for_all_children():
+    from pydcop_tpu.algorithms.dpop import DpopUtilMessage
+
+    _, _, comps = make_comps(CHAIN3)
+    root = comps["v2"]
+    sent = record(root)
+    root.start()
+    assert sent == []  # root with children: quiet until UTILs arrive
+    root.on_message("v1", DpopUtilMessage(
+        [["v2", ["R", "G"]]], [0.1, -0.1]), 0.0)
+    assert sent == []  # one child still pending
+    root.on_message("v3", DpopUtilMessage(
+        [["v2", ["R", "G"]]], [0.1, -0.1]), 0.0)
+    # both in: root selects and floods VALUE to both children
+    values = [(d, m) for d, m in sent if m.type == "dpop_value"]
+    assert sorted(d for d, _ in values) == ["v1", "v3"]
+    # root cost: v2=G: -0.1 (unary) + -0.1 + -0.1 = -0.3 beats v2=R: 0.3
+    assert root.current_value == "G"
+    assert root.current_cost == pytest.approx(-0.3)
+    for _, m in values:
+        assert m.assignment == [["v2", "G"]]
+
+
+def test_value_message_conditions_leaf_selection():
+    from pydcop_tpu.algorithms.dpop import DpopValueMessage
+
+    _, _, comps = make_comps(CHAIN3)
+    leaf = comps["v1"]
+    sent = record(leaf)
+    done = []
+    leaf.finished = lambda: done.append(True)
+    leaf.start()
+    leaf.on_message("v2", DpopValueMessage([["v2", "G"]]), 0.0)
+    # given v2=G: v1=R costs -0.1+0, v1=G costs 0.1+1
+    assert leaf.current_value == "R"
+    assert leaf.current_cost == pytest.approx(-0.1)
+    assert done == [True]
+
+
+def test_isolated_variable_selects_alone():
+    src = CHAIN3.replace("constraints:",
+                         "  v4: {domain: colors, cost_function: "
+                         "-1 if v4 == 'G' else 0}\nconstraints:")
+    _, _, comps = make_comps(src)
+    iso = comps["v4"]
+    record(iso)
+    done = []
+    iso.finished = lambda: done.append(True)
+    iso.start()
+    assert iso.current_value == "G"
+    assert done == [True]
+
+
+# ------------------------------------------------------------- wire form
+
+
+def test_util_wire_form_is_json_safe_with_inf():
+    from pydcop_tpu.algorithms.dpop import (_unwire_util, _wire_util,
+                                            _WIRE_INF)
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    d = Domain("d", "", ["a", "b"])
+    v1, v2 = Variable("x1", d), Variable("x2", d)
+    m = np.array([[0.5, np.inf], [-np.inf, 2.0]])
+    util = NAryMatrixRelation([v1, v2], m, name="u")
+    dims, costs = _wire_util(util)
+    # the HTTP transport rejects non-finite floats: must be JSON-safe
+    wire = json.dumps(costs, allow_nan=False)
+    back = _unwire_util(dims, json.loads(wire))
+    assert back.scope_names == ["x1", "x2"]
+    assert back.matrix[0, 0] == pytest.approx(0.5)
+    assert back.matrix[0, 1] == pytest.approx(_WIRE_INF)
+    assert back.matrix[1, 0] == pytest.approx(-_WIRE_INF)
+
+
+# ------------------------------------------------------ full wire runs
+
+
+def pump_run(src, mode_check=None):
+    dcop, cg, comps = make_comps(src)
+    queue = collections.deque()
+    done = {}
+    for name, comp in comps.items():
+        comp.message_sender = (
+            lambda s, d, m, p, e, _n=name: queue.append((_n, d, m)))
+        done[name] = []
+        comp.finished = (lambda _n=name: done[_n].append(True))
+    for comp in comps.values():
+        comp.start()
+    n = 0
+    while queue and n < 500:
+        src_name, dest, msg = queue.popleft()
+        comps[dest].on_message(src_name, msg, 0.0)
+        n += 1
+    assert all(done[name] for name in comps), done
+    return dcop, {n: c.current_value for n, c in comps.items()}
+
+
+def test_chain_protocol_reaches_exact_optimum():
+    dcop, assignment = pump_run(CHAIN3)
+    expected, expected_cost = brute_force(dcop)
+    assert assignment == expected  # R, G, R
+    cost, violations = dcop.solution_cost(assignment)
+    assert cost == pytest.approx(expected_cost) and violations == 0
+
+
+def test_triangle_with_pseudo_parent_reaches_exact_optimum():
+    """The triangle forces a back-edge (pseudo-parent): the lowest node
+    joins a constraint whose scope includes a non-parent ancestor, so
+    its UTIL separator has two variables and the VALUE wave must carry
+    the grandparent's assignment down through the middle node."""
+    dcop, assignment = pump_run(TRIANGLE)
+    expected, expected_cost = brute_force(dcop)
+    cost, violations = dcop.solution_cost(assignment)
+    assert violations == 0
+    assert cost == pytest.approx(expected_cost)
+    assert assignment == expected  # R, G, B
+
+
+def test_triangle_util_separator_has_two_vars():
+    _, _, comps = make_comps(TRIANGLE)
+    # the deepest node holds a constraint to its pseudo-parent: its UTIL
+    # message's dims mention both ancestors
+    depths = {n: 0 for n in comps}
+    for name, comp in comps.items():
+        d, p = 0, comp.parent
+        while p is not None:
+            d, p = d + 1, comps[p].parent
+        depths[name] = d
+    lowest = max(depths, key=depths.get)
+    assert depths[lowest] == 2  # a chain of 3 in the DFS tree
+    leaf = comps[lowest]
+    sent = record(leaf)
+    leaf.start()
+    (dest, msg), = sent
+    assert dest == leaf.parent
+    assert sorted(d[0] for d in msg.dims) == sorted(
+        n for n in comps if n != lowest)
+    assert np.asarray(msg.costs).shape == (3, 3)
+
+
+def test_max_mode_protocol():
+    src = CHAIN3.replace("objective: min", "objective: max")
+    dcop, assignment = pump_run(src)
+    # max: pick the costliest coloring — v2 conflicts with both
+    # neighbors and everyone takes their expensive unary value
+    best, best_cost = None, None
+    for combo in itertools.product(["R", "G"], repeat=3):
+        asgt = dict(zip(["v1", "v2", "v3"], combo))
+        cost, _ = dcop.solution_cost(asgt)
+        if best_cost is None or cost > best_cost:
+            best, best_cost = asgt, cost
+    cost, _ = dcop.solution_cost(assignment)
+    assert cost == pytest.approx(best_cost)

@@ -266,9 +266,10 @@ def test_response_phase_offerer_rejected_falls_back_to_unilateral():
     comp._response_phase(
         {"v1": (Mgm2ResponseMessage(False, None, 0.0), 0.0)})
     assert not comp._committed
-    gains = [m for d, m in sent if m.type == "mgm2_gain"]
-    # announces the unilateral gain instead
-    assert all(m.gain == pytest.approx(2.2) for m in gains)
+    gains = [(d, m) for d, m in sent if m.type == "mgm2_gain"]
+    # announces the unilateral gain instead, to every neighbor
+    assert sorted(d for d, _ in gains) == ["v1", "v3"]
+    assert all(m.gain == pytest.approx(2.2) for _, m in gains)
 
 
 # ------------------------------------------------------------ gain phase
@@ -311,7 +312,11 @@ def test_gain_phase_zero_gain_idles_with_syncs_only():
     comp._gain_phase({"v1": (Mgm2GainMessage(3.0), 0.0),
                       "v3": (Mgm2GainMessage(1.0), 0.0)})
     assert comp._can_move is False
-    assert all(isinstance(m, SynchronizationMsg) for _, m in sent)
+    # the idle round still closes for every neighbor via syncs
+    assert sorted(d for d, m in sent
+                  if isinstance(m, SynchronizationMsg)) == ["v1", "v3"]
+    assert [m for _, m in sent
+            if not isinstance(m, SynchronizationMsg)] == []
 
 
 def test_gain_phase_unilateral_strict_winner_moves():
@@ -441,6 +446,7 @@ def pump(comps, queue, max_msgs=600):
         src, dest, msg = queue.popleft()
         by_name[dest].on_message(src, msg, 0.0)
         n += 1
+    assert not queue, "message budget exhausted (protocol livelock?)"
     return n
 
 

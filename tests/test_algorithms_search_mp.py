@@ -1,0 +1,296 @@
+"""Deep unit tier for the exact-search message-passing backends:
+SyncBB's Current-Partial-Assignment token and NCBB's INIT waves.
+
+Mirrors the reference's `/root/reference/tests/unit/
+test_algorithms_syncbb.py` (forward/backward token content, bound
+pruning, termination) and the NCBB suite: each handler driven directly,
+plus full chain/tree protocol runs against the brute-force optimum.
+"""
+
+import collections
+import itertools
+
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+
+CHAIN3 = """
+name: chain3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def brute_force(dcop, objective="min"):
+    domains = {n: list(v.domain.values)
+               for n, v in dcop.variables.items()}
+    names = sorted(domains)
+    best, best_cost = None, None
+    for combo in itertools.product(*[domains[n] for n in names]):
+        asgt = dict(zip(names, combo))
+        cost, _ = dcop.solution_cost(asgt)
+        better = (best_cost is None
+                  or (cost < best_cost if objective == "min"
+                      else cost > best_cost))
+        if better:
+            best, best_cost = asgt, cost
+    return best, best_cost
+
+
+# ================================================================ SyncBB
+
+
+def make_syncbb(src=CHAIN3):
+    from pydcop_tpu.graphs.ordered_graph import build_computation_graph
+
+    dcop = load_dcop(src)
+    cg = build_computation_graph(dcop)
+    module = load_algorithm_module("syncbb")
+    algo = AlgorithmDef.build_with_default_param(
+        "syncbb", {}, mode=dcop.objective)
+    comps = {n.name: module.build_computation(ComputationDef(n, algo))
+             for n in cg.nodes}
+    return dcop, comps
+
+
+def record(comp):
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return sent
+
+
+def test_syncbb_chain_order_is_lexical():
+    _, comps = make_syncbb()
+    assert comps["v1"].previous_var is None
+    assert comps["v1"].next_var == "v2"
+    assert comps["v2"].next_var == "v3"
+    assert comps["v3"].next_var is None
+
+
+def test_syncbb_head_seeds_path_with_unary_cost():
+    _, comps = make_syncbb()
+    head = comps["v1"]
+    sent = record(head)
+    head.start()
+    (dest, msg), = sent
+    assert dest == "v2" and msg.type == "syncbb_forward"
+    # first domain value R with its unary cost -0.1 (the reference
+    # seeds 0 and loses it, syncbb.py:203)
+    assert msg.current_path == [["v1", "R", pytest.approx(-0.1)]]
+    assert msg.ub is None  # inf travels as None on the wire
+
+
+def test_syncbb_middle_extends_with_constraint_cost():
+    from pydcop_tpu.algorithms.syncbb import SyncBBForwardMessage
+
+    _, comps = make_syncbb()
+    mid = comps["v2"]
+    sent = record(mid)
+    mid.start()
+    assert sent == []  # middle nodes wait for the token
+    mid.on_message("v1", SyncBBForwardMessage(
+        [["v1", "R", -0.1]], None), 0.0)
+    (dest, msg), = sent
+    assert dest == "v3"
+    # v2 picks R first: unary 0.1 + conflict with v1=R -> 1.1
+    assert msg.current_path == [
+        ["v1", "R", pytest.approx(-0.1)],
+        ["v2", "R", pytest.approx(1.1)]]
+
+
+def test_syncbb_bound_prunes_candidates():
+    from pydcop_tpu.algorithms.syncbb import SyncBBForwardMessage
+
+    _, comps = make_syncbb()
+    mid = comps["v2"]
+    sent = record(mid)
+    mid.start()
+    # a tight bound: only v2=G (path -0.1 + -0.1 = -0.2) fits under -0.15
+    mid.on_message("v1", SyncBBForwardMessage(
+        [["v1", "R", -0.1]], -0.15), 0.0)
+    (dest, msg), = sent
+    assert dest == "v3"
+    assert msg.current_path[-1][1] == "G"  # R pruned by the bound
+
+
+def test_syncbb_exhausted_domain_backtracks():
+    from pydcop_tpu.algorithms.syncbb import SyncBBForwardMessage
+
+    _, comps = make_syncbb()
+    mid = comps["v2"]
+    sent = record(mid)
+    mid.start()
+    # bound so tight nothing fits: backward to the previous variable
+    mid.on_message("v1", SyncBBForwardMessage(
+        [["v1", "R", -0.1]], -5.0), 0.0)
+    (dest, msg), = sent
+    assert dest == "v1" and msg.type == "syncbb_backward"
+
+
+def test_syncbb_tail_sweeps_and_improves_bound():
+    from pydcop_tpu.algorithms.syncbb import SyncBBForwardMessage
+
+    _, comps = make_syncbb()
+    tail = comps["v3"]
+    sent = record(tail)
+    tail.start()
+    tail.on_message("v2", SyncBBForwardMessage(
+        [["v1", "R", -0.1], ["v2", "G", -0.1]], None), 0.0)
+    (dest, msg), = sent
+    assert dest == "v2" and msg.type == "syncbb_backward"
+    # best completion: v3=R (unary 0.1, no conflict) -> total -0.1
+    assert msg.ub == pytest.approx(-0.1)
+    assert msg.best == [["v1", "R"], ["v2", "G"], ["v3", "R"]]
+    assert tail.current_value == "R"
+
+
+def test_syncbb_terminate_assigns_and_propagates():
+    from pydcop_tpu.algorithms.syncbb import SyncBBTerminateMessage
+
+    _, comps = make_syncbb()
+    mid = comps["v2"]
+    sent = record(mid)
+    done = []
+    mid.finished = lambda: done.append(True)
+    mid.start()
+    mid.on_message("v1", SyncBBTerminateMessage(
+        [["v1", "R"], ["v2", "G"], ["v3", "R"]], -0.1), 0.0)
+    assert mid.current_value == "G"
+    assert done == [True]
+    (dest, msg), = sent
+    assert dest == "v3" and msg.type == "syncbb_terminate"
+
+
+def pump(comps, queue, limit=1000):
+    n = 0
+    while queue and n < limit:
+        src, dest, msg = queue.popleft()
+        comps[dest].on_message(src, msg, 0.0)
+        n += 1
+    assert not queue, "message budget exhausted"
+    return n
+
+
+def wire(comps):
+    queue = collections.deque()
+    done = {}
+    for name, comp in comps.items():
+        comp.message_sender = (
+            lambda s, d, m, p, e, _n=name: queue.append((_n, d, m)))
+        done[name] = []
+        comp.finished = (lambda _n=name: done[_n].append(True))
+    return queue, done
+
+
+@pytest.mark.parametrize("objective", ["min", "max"])
+def test_syncbb_full_chain_exact(objective):
+    src = CHAIN3.replace("objective: min", f"objective: {objective}")
+    dcop, comps = make_syncbb(src)
+    queue, done = wire(comps)
+    for c in comps.values():
+        c.start()
+    pump(comps, queue)
+    assert all(done.values())
+    assignment = {n: c.current_value for n, c in comps.items()}
+    expected, expected_cost = brute_force(dcop, objective)
+    cost, _ = dcop.solution_cost(assignment)
+    assert cost == pytest.approx(expected_cost)
+    assert assignment == expected
+
+
+# ================================================================= NCBB
+
+
+def make_ncbb(src=CHAIN3):
+    from pydcop_tpu.graphs.pseudotree import build_computation_graph
+
+    dcop = load_dcop(src)
+    cg = build_computation_graph(dcop)
+    module = load_algorithm_module("ncbb")
+    algo = AlgorithmDef.build_with_default_param(
+        "ncbb", {}, mode=dcop.objective)
+    comps = {n.name: module.build_computation(ComputationDef(n, algo))
+             for n in cg.nodes}
+    return dcop, comps
+
+
+def test_ncbb_root_greedy_kickoff():
+    _, comps = make_ncbb()
+    root = comps["v2"]  # max-degree root
+    sent = record(root)
+    root.start()
+    # root picks its cheapest unary value and floods descendants
+    assert root.current_value == "G"
+    values = [(d, m) for d, m in sent if m.type == "ncbb_value"]
+    assert sorted(d for d, _ in values) == ["v1", "v3"]
+    assert all(m.value == "G" for _, m in values)
+
+
+def test_ncbb_child_conditions_on_ancestors():
+    from pydcop_tpu.algorithms.ncbb import NcbbValueMessage
+
+    _, comps = make_ncbb()
+    leaf = comps["v1"]
+    sent = record(leaf)
+    done = []
+    leaf.finished = lambda: done.append(True)
+    leaf.start()
+    assert sent == []  # non-roots wait for ancestor values
+    leaf.on_message("v2", NcbbValueMessage("G"), 0.0)
+    # greedy under v2=G: v1=R (-0.1 + 0) beats v1=G (0.1 + 1)
+    assert leaf.current_value == "R"
+    # leaf starts the cost wave to its tree parent and finishes
+    costs = [(d, m) for d, m in sent if m.type == "ncbb_cost"]
+    assert costs and costs[0][0] == "v2"
+    assert costs[0][1].cost == pytest.approx(-0.1)
+    assert done == [True]
+
+
+def test_ncbb_root_aggregates_subtree_costs():
+    from pydcop_tpu.algorithms.ncbb import NcbbCostMessage
+
+    _, comps = make_ncbb()
+    root = comps["v2"]
+    sent = record(root)
+    done = []
+    root.finished = lambda: done.append(True)
+    root.start()
+    sent.clear()
+    root.on_message("v1", NcbbCostMessage(-0.1), 0.0)
+    assert done == []  # one child cost still pending
+    root.on_message("v3", NcbbCostMessage(-0.1), 0.0)
+    assert done == [True]
+    # greedy bound: root's own -0.1 plus both children
+    stops = [m for d, m in sent if m.type == "ncbb_stop"]
+    assert len(stops) == 2
+    assert stops[0].bound == pytest.approx(-0.3)
+    assert root.current_cost == pytest.approx(-0.3)
+
+
+def test_ncbb_full_tree_greedy_bound():
+    dcop, comps = make_ncbb()
+    queue, done = wire(comps)
+    for c in comps.values():
+        c.start()
+    pump(comps, queue)
+    assert all(done.values())
+    assignment = {n: c.current_value for n, c in comps.items()}
+    # the greedy descent happens to be exact on this instance:
+    # v2=G (-0.1), v1=R (-0.1), v3=R (+0.1) = -0.1, the true optimum
+    expected, expected_cost = brute_force(dcop)
+    cost, violations = dcop.solution_cost(assignment)
+    assert violations == 0
+    assert cost == pytest.approx(expected_cost)
+    assert assignment == expected
