@@ -308,6 +308,7 @@ def test_gain_phase_zero_gain_idles_with_syncs_only():
     comp, sent = make_comp("v2", {"seed": 4})
     comp.start()
     comp._potential_gain = 0.0
+    comp._sent_this_cycle = set()  # fresh sub-cycle, nothing sent yet
     sent.clear()
     comp._gain_phase({"v1": (Mgm2GainMessage(3.0), 0.0),
                       "v3": (Mgm2GainMessage(1.0), 0.0)})
