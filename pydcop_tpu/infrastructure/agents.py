@@ -41,6 +41,21 @@ def notify_wrap(f: Callable, cb: Callable) -> Callable:
     return wrapped
 
 
+def _notify_finished_once(f: Callable, cb: Callable) -> Callable:
+    """Like :func:`notify_wrap` but the notification fires only on the
+    first call: a finished computation stays finished."""
+    fired = []
+
+    def wrapped(*args, **kwargs):
+        out = f(*args, **kwargs)
+        if not fired:
+            fired.append(True)
+            cb()
+        return out
+
+    return wrapped
+
+
 class _PeriodicAction:
     """One entry of the agent's timer wheel
     (reference: agents.py:743-852)."""
@@ -176,9 +191,13 @@ class Agent:
                 computation._on_new_cycle,
                 lambda count, _c=computation:
                     self._on_computation_new_cycle(_c.name, count))
-        computation.finished = notify_wrap(
+        # once-guard: asynchronous algorithms may call finished() on
+        # every post-convergence receipt (e.g. amaxsum's stability
+        # counter); the agent reports a computation finished exactly
+        # once, like the reference's single FINISHED transition
+        computation.finished = _notify_finished_once(
             computation.finished,
-            lambda *a, _c=computation:
+            lambda _c=computation:
                 self._on_computation_finished(_c.name))
         self.discovery.register_computation(
             name, self._name, self.address, publish=publish)

@@ -1,0 +1,332 @@
+"""Deep unit tier for the asynchronous message-passing backends: A-DSA
+(periodic activation on the agent timer wheel) and A-MaxSum (message
+suppression, quiescence detection, start_messages policies).
+
+Mirrors the reference's `/root/reference/tests/unit/
+test_algorithms_adsa.py` and the amaxsum suite: activations and
+receipts driven directly, timer wheel stubbed at the computation
+boundary.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.algorithms.maxsum import SAME_COUNT
+from pydcop_tpu.dcop.yamldcop import load_dcop
+
+GC3 = """
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors, cost_function: -0.1 if v1 == 'R' else 0.1}
+  v2: {domain: colors, cost_function: -0.1 if v2 == 'G' else 0.1}
+  v3: {domain: colors, cost_function: -0.1 if v3 == 'G' else 0.1}
+constraints:
+  diff_1_2: {type: intention, function: 1 if v1 == v2 else 0}
+  diff_2_3: {type: intention, function: 1 if v3 == v2 else 0}
+agents: [a1, a2, a3]
+"""
+
+#: adds a unary constraint so the factor graph has a leaf factor
+GC2_UNARY = """
+name: gc2u
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+constraints:
+  diff: {type: intention, function: 1 if v1 == v2 else 0}
+  u1: {type: intention, function: 0.5 if v1 == 'R' else 0}
+agents: [a1, a2]
+"""
+
+
+class TimerStub:
+    """Captures the computation's periodic actions; fire them manually."""
+
+    def __init__(self, comp):
+        self.actions = {}  # handle -> (period, cb)
+        self._n = 0
+        comp._periodic_action_handler = self._add
+        comp._periodic_action_remover = self._remove
+
+    def _add(self, period, cb):
+        self._n += 1
+        handle = f"h{self._n}"
+        self.actions[handle] = (period, cb)
+        return handle
+
+    def _remove(self, handle):
+        self.actions.pop(handle, None)
+
+    def fire_all(self):
+        for _, cb in list(self.actions.values()):
+            cb()
+
+
+def make_comp(algo_name, var_name, params=None, src=GC3,
+              graph="constraints_hypergraph"):
+    import importlib
+
+    dcop = load_dcop(src)
+    gmod = importlib.import_module(f"pydcop_tpu.graphs.{graph}")
+    cg = gmod.build_computation_graph(dcop)
+    module = load_algorithm_module(algo_name)
+    algo = AlgorithmDef.build_with_default_param(
+        algo_name, params or {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == var_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return comp, sent
+
+
+# ================================================================= A-DSA
+
+
+def adsa_value(v):
+    from pydcop_tpu.algorithms.adsa import ADsaValueMessage
+    return ADsaValueMessage(v)
+
+
+def test_adsa_start_is_delayed_and_desynchronized():
+    comp, sent = make_comp("adsa", "v2", {"seed": 6, "period": 2.0})
+    timer = TimerStub(comp)
+    comp.start()
+    # nothing announced yet: only the randomized start delay is armed
+    assert sent == []
+    assert len(timer.actions) == 1
+    (delay, _), = timer.actions.values()
+    assert 0 < delay <= 2.0
+    timer.fire_all()  # the delayed start fires
+    values = [m for d, m in sent if m.type == "adsa_value"]
+    assert len(values) == 2  # announced to both neighbors
+    # the start handle was swapped for the periodic tick at full period
+    assert len(timer.actions) == 1
+    (period, _), = timer.actions.values()
+    assert period == 2.0
+
+
+def test_adsa_tick_ignored_while_paused():
+    comp, sent = make_comp("adsa", "v2", {"seed": 6, "period": 1.0,
+                                          "probability": 1.0})
+    timer = TimerStub(comp)
+    comp.start()
+    timer.fire_all()
+    comp.value_selection("R")
+    comp.on_message("v1", adsa_value("R"), 0.0)
+    comp.on_message("v3", adsa_value("R"), 0.0)
+    comp.pause(True)
+    before = comp.current_value
+    comp._tick()
+    assert comp.current_value == before  # paused: no activation
+    comp.pause(False)
+    # messages buffered during pause are replayed on resume; tick works
+    comp._tick()
+    assert comp.current_value == "G"
+
+
+def test_adsa_variant_a_needs_strict_improvement():
+    comp, _ = make_comp("adsa", "v2", {"seed": 6, "variant": "A",
+                                       "probability": 1.0})
+    TimerStub(comp)
+    comp.start()
+    comp._delayed_start()
+    comp.value_selection("G")  # optimal already, given R/R below
+    comp.on_message("v1", adsa_value("R"), 0.0)
+    comp.on_message("v3", adsa_value("R"), 0.0)
+    comp._tick()
+    assert comp.current_value == "G"  # no sideways move in variant A
+
+
+def test_adsa_variant_c_moves_sideways():
+    # v1 and v3 on different colors: v2 conflicts with exactly one of
+    # them either way (cost tie), variant C still hops between minima
+    src = GC3.replace("-0.1 if v2 == 'G' else 0.1", "0")
+    comp, _ = make_comp("adsa", "v2", {"seed": 6, "variant": "C",
+                                       "probability": 1.0}, src=src)
+    TimerStub(comp)
+    comp.start()
+    comp._delayed_start()
+    comp.value_selection("R")
+    comp.on_message("v1", adsa_value("R"), 0.0)
+    comp.on_message("v3", adsa_value("G"), 0.0)
+    comp._tick()
+    assert comp.current_value == "G"  # tie, but C prefers a different min
+
+
+def test_adsa_stop_cycle_bounds_activations():
+    comp, _ = make_comp("adsa", "v2", {"seed": 6, "probability": 1.0,
+                                       "stop_cycle": 3})
+    TimerStub(comp)
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp._delayed_start()
+    comp.on_message("v1", adsa_value("R"), 0.0)
+    comp.on_message("v3", adsa_value("R"), 0.0)
+    for _ in range(3):
+        comp._tick()
+    assert done == [True]
+
+
+def test_adsa_isolated_variable_finishes_at_delayed_start():
+    src = GC3.replace("constraints:",
+                      "  v4: {domain: colors}\nconstraints:")
+    comp, sent = make_comp("adsa", "v4", {"seed": 6}, src=src)
+    TimerStub(comp)
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp._delayed_start()
+    assert done == [True] and sent == []
+
+
+# =============================================================== A-MaxSum
+
+
+def am_costs(costs):
+    from pydcop_tpu.algorithms.amaxsum import AMaxSumCostsMessage
+    return AMaxSumCostsMessage(costs)
+
+
+def make_amaxsum(node_name, params=None, src=GC2_UNARY):
+    comp, sent = make_comp("amaxsum", node_name, params, src=src,
+                           graph="factor_graph")
+    TimerStub(comp)
+    return comp, sent
+
+
+def test_amaxsum_variable_sends_at_start_by_default():
+    comp, sent = make_amaxsum("v1", {"damping": 0.0})
+    comp.start()
+    # leafs_vars policy: variables announce immediately to all factors
+    assert {d for d, m in sent if m.type == "amaxsum_costs"} == \
+        {"diff", "u1"}
+
+
+def test_amaxsum_leafs_policy_silences_variables():
+    comp, sent = make_amaxsum(
+        "v1", {"damping": 0.0, "start_messages": "leafs"})
+    comp.start()
+    assert [m for d, m in sent if m.type == "amaxsum_costs"] == []
+
+
+def test_amaxsum_leaf_factor_fires_under_leafs_policy():
+    comp, sent = make_amaxsum(
+        "u1", {"damping": 0.0, "start_messages": "leafs"})
+    comp.start()
+    # unary factor = leaf: sends its cost row unprompted
+    (dest, msg), = [(d, m) for d, m in sent
+                    if m.type == "amaxsum_costs"]
+    assert dest == "v1"
+    assert msg.costs == pytest.approx([0.5, 0.0])
+
+
+def test_amaxsum_binary_factor_waits_for_full_view():
+    comp, sent = make_amaxsum("diff", {"damping": 0.0})
+    comp.start()
+    assert sent == []  # binary factor: not a leaf, quiet at start
+    comp.on_message("v1", am_costs([0.0, 0.0]), 0.0)
+    assert sent == []  # half a view: still quiet
+    comp.on_message("v2", am_costs([0.0, 5.0]), 0.0)
+    # full view: marginal re-sent to everyone but the sender
+    msgs = [(d, m) for d, m in sent if m.type == "amaxsum_costs"]
+    assert [d for d, _ in msgs] == ["v1"]
+    assert msgs[0][1].costs == pytest.approx([1.0, 0.0])
+
+
+def test_amaxsum_variable_suppresses_stable_messages():
+    comp, sent = make_amaxsum("v1", {"damping": 0.0, "stability": 0.1})
+    comp.start()
+    # identical receipts: outgoing q stabilizes; after SAME_COUNT
+    # repeats the variable stops chatting (message suppression)
+    for _ in range(SAME_COUNT + 3):
+        sent.clear()
+        comp.on_message("diff", am_costs([0.0, 0.0]), 0.0)
+    assert [m for d, m in sent if m.type == "amaxsum_costs"] == []
+
+
+def test_amaxsum_variable_resumes_on_real_change():
+    comp, sent = make_amaxsum("v1", {"damping": 0.0, "stability": 0.1})
+    comp.start()
+    for _ in range(SAME_COUNT + 3):
+        comp.on_message("diff", am_costs([0.0, 0.0]), 0.0)
+    sent.clear()
+    comp.on_message("diff", am_costs([9.0, 0.0]), 0.0)  # big change
+    assert [m for d, m in sent if m.type == "amaxsum_costs"]
+
+
+def test_amaxsum_variable_finishes_when_stable_and_suppressed():
+    comp, sent = make_amaxsum("v1", {"damping": 0.0, "stability": 0.1})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    for _ in range(3 * SAME_COUNT):
+        comp.on_message("diff", am_costs([0.0, 0.0]), 0.0)
+        comp.on_message("u1", am_costs([0.5, 0.0]), 0.0)
+        if done:
+            break
+    # the raw hook may re-fire on post-convergence receipts; the agent
+    # wrapper dedups it (test_agent_reports_finished_once)
+    assert done
+    assert comp.current_value == "G"  # u1 pushes away from R
+
+
+def test_agent_reports_finished_once():
+    """Asynchronous computations may call finished() on every receipt
+    after convergence; the hosting agent must report the FINISHED
+    transition exactly once."""
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer
+
+    import importlib
+
+    agent = Agent("a1", InProcessCommunicationLayer())
+    dcop = load_dcop(GC2_UNARY)
+    gmod = importlib.import_module("pydcop_tpu.graphs.factor_graph")
+    cg = gmod.build_computation_graph(dcop)
+    module = load_algorithm_module("amaxsum")
+    algo = AlgorithmDef.build_with_default_param(
+        "amaxsum", {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == "v1")
+    comp = module.build_computation(ComputationDef(node, algo))
+    reports = []
+    agent._on_computation_finished = (
+        lambda name: reports.append(name))
+    agent.add_computation(comp)
+    comp.finished()
+    comp.finished()
+    comp.finished()
+    assert reports == ["v1"]
+
+
+def test_amaxsum_quiescence_detector_finishes_silent_graph():
+    comp, _ = make_amaxsum("v1", {"damping": 0.0})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp.on_message("diff", am_costs([0.0, 1.0]), 0.0)
+    # silence: pretend the last receipt was long ago, then the periodic
+    # quiescence check fires
+    comp._last_receipt -= 10.0
+    comp._check_quiescence()
+    assert done == [True]
+
+
+def test_amaxsum_quiescence_needs_prior_traffic():
+    comp, _ = make_amaxsum("v1", {"damping": 0.0})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    comp._last_receipt -= 10.0
+    comp._check_quiescence()  # no receipts yet: not converged, waiting
+    assert done == []
