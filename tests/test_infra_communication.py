@@ -1,0 +1,188 @@
+"""Unit tier for the communication layer: HTTP transport round-trips,
+delivery-error modes (ignore/fail/retry), and the envelope's cycle-tag
+propagation.
+
+Mirrors the reference's transport coverage (the real HTTP layer
+exercised on localhost, `tests/dcop_cli` process-mode; here the layer is
+driven directly so every error path is reachable deterministically).
+"""
+
+import socket
+import threading
+
+import pytest
+
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO, Address, HttpCommunicationLayer,
+    InProcessCommunicationLayer, Messaging, UnreachableAgent, _Envelope)
+from pydcop_tpu.infrastructure.computations import message_type
+
+PingMessage = message_type("comm_test_ping", ["payload"])
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class StubDiscovery:
+    """agent name -> address, counting lookups."""
+
+    def __init__(self, addresses=None):
+        self.addresses = dict(addresses or {})
+        self.lookups = 0
+
+    def agent_address(self, agent):
+        self.lookups += 1
+        try:
+            return self.addresses[agent]
+        except KeyError:
+            raise Exception(f"unknown agent {agent}")
+
+
+class CaptureMessaging:
+    def __init__(self):
+        self.received = []
+
+    def post_local(self, envelope, prio=MSG_ALGO):
+        self.received.append((envelope, prio))
+
+
+@pytest.fixture
+def http_pair():
+    layers = []
+
+    def make():
+        layer = HttpCommunicationLayer(("127.0.0.1", free_port()))
+        layers.append(layer)
+        return layer
+
+    yield make
+    for layer in layers:
+        layer.shutdown()
+
+
+def test_http_roundtrip_delivers_envelope_with_cycle_tag(http_pair):
+    a, b = http_pair(), http_pair()
+    a.discovery = StubDiscovery({"agt_b": b.address})
+    sink = CaptureMessaging()
+    b.messaging = sink
+
+    # a real framework wire message: classes defined in test modules are
+    # (correctly) refused by the receiver's deserialization allowlist
+    from pydcop_tpu.algorithms.dsa import DsaValueMessage
+
+    msg = DsaValueMessage("R")
+    env = _Envelope("c_src", "c_dst", msg, 7)
+    assert a.send_msg("agt_a", "agt_b", env, MSG_ALGO, "fail") is True
+    (envelope, prio), = sink.received
+    assert isinstance(envelope, _Envelope)
+    assert envelope.src_comp == "c_src"
+    assert envelope.dest_comp == "c_dst"
+    assert envelope.cycle_id == 7
+    assert envelope.msg.type == "dsa_value"
+    assert envelope.msg.value == "R"
+    assert prio == MSG_ALGO
+
+
+def test_http_receiver_rejects_non_allowlisted_payload(http_pair):
+    """A malicious peer POSTing a class outside the framework namespace
+    gets a 500 and nothing reaches the agent queue."""
+    import requests
+
+    b = http_pair()
+    sink = CaptureMessaging()
+    b.messaging = sink
+    url = f"http://{b.address.host}:{b.address.port}/pydcop"
+    evil = {"__qualname__": "Popen", "__module__": "subprocess",
+            "args": ["true"]}
+    resp = requests.post(url, json=evil, timeout=2,
+                         headers={"sender-agent": "x",
+                                  "dest-agent": "y", "prio": "20"})
+    assert resp.status_code == 500
+    assert sink.received == []
+
+
+def test_http_non_200_is_a_delivery_failure(http_pair):
+    """The sender must treat a receiver rejection as failure (regression
+    for the round-2 fix: non-200 used to count as delivered)."""
+    a, b = http_pair(), http_pair()
+    a.discovery = StubDiscovery({"agt_b": b.address})
+    b.messaging = CaptureMessaging()
+    # a plain dict serializes as itself and the receiver's allowlist
+    # rejects it -> 500 -> failure on the sending side
+    bad = {"__qualname__": "Popen", "__module__": "subprocess"}
+    assert a.send_msg("agt_a", "agt_b", bad, MSG_ALGO, "ignore") is False
+    with pytest.raises(UnreachableAgent):
+        a.send_msg("agt_a", "agt_b", bad, MSG_ALGO, "fail")
+
+
+def test_http_retry_mode_retries_the_lookup(http_pair):
+    """on_error='retry' re-resolves the address each attempt — the peer
+    may register with discovery mid-backoff."""
+    a = http_pair()
+    disco = StubDiscovery({})  # never resolves
+    a.discovery = disco
+    ok = a.send_msg("agt_a", "agt_missing",
+                    _Envelope("s", "d", PingMessage([]), None),
+                    MSG_ALGO, "retry")
+    assert ok is False
+    assert disco.lookups == 5  # 5 attempts in retry mode
+
+
+def test_http_ignore_mode_single_attempt(http_pair):
+    a = http_pair()
+    disco = StubDiscovery({})
+    a.discovery = disco
+    ok = a.send_msg("agt_a", "agt_missing",
+                    _Envelope("s", "d", PingMessage([]), None),
+                    MSG_ALGO, "ignore")
+    assert ok is False
+    assert disco.lookups == 1
+
+
+def test_inprocess_error_modes():
+    layer = InProcessCommunicationLayer()
+    layer.discovery = StubDiscovery({})
+    msg = PingMessage([])
+    assert layer.send_msg("a", "missing", msg, MSG_ALGO,
+                          "ignore") is False
+    with pytest.raises(UnreachableAgent):
+        layer.send_msg("a", "missing", msg, MSG_ALGO, "fail")
+
+
+def test_inprocess_rejects_foreign_address_type():
+    """An address that is not an InProcess layer (e.g. an HTTP Address
+    left over in discovery) is a delivery error, not a crash."""
+    layer = InProcessCommunicationLayer()
+    layer.discovery = StubDiscovery(
+        {"agt_b": Address("127.0.0.1", 9999)})
+    assert layer.send_msg("a", "agt_b", PingMessage([]), MSG_ALGO,
+                          "ignore") is False
+
+
+def test_messaging_parks_on_remote_delivery_failure():
+    """A remote send that exhausts its retries is parked (not dropped):
+    a lost message would deadlock the sender's synchronous round."""
+    layer = InProcessCommunicationLayer()
+
+    class Disco(StubDiscovery):
+        def computation_agent(self, comp):
+            return "agt_remote"  # known computation...
+
+        def agent_address(self, agent):
+            raise Exception("...on an agent with no address yet")
+
+        def subscribe_computation_local(self, *a, **kw):
+            pass
+
+        def subscribe_computation(self, *a, **kw):
+            pass
+
+    layer.discovery = Disco()
+    m = Messaging("agt_local", layer)
+    m.post_msg("c_src", "c_far", PingMessage(["x"]), MSG_ALGO,
+               on_error=None)
+    assert "c_far" in m._waiting
+    assert len(m._waiting["c_far"]) == 1
