@@ -1,0 +1,144 @@
+"""Unit tier for the MixedDSA message-passing backend: hard/soft
+constraint classification and the two-tier (violated-hard count, soft
+cost) decision rule.
+
+Mirrors the reference's `/root/reference/tests/unit/
+test_algorithms_mixeddsa.py` coverage of the hard/soft split
+(mixeddsa.py:203-225) and the tiered move probabilities.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import (AlgorithmDef, ComputationDef,
+                                   load_algorithm_module)
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.graphs.constraints_hypergraph import \
+    build_computation_graph as build_hypergraph
+
+#: hard inequality v1!=v2 (infinite cost) + soft preference on v2/v3
+MIXED = """
+name: mixed
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+constraints:
+  hard_12:
+    type: extensional
+    variables: [v1, v2]
+    default: .inf
+    values:
+      0: R G | G R
+  soft_23: {type: intention, function: 2 if v2 == v3 else 0}
+agents: [a1, a2, a3]
+"""
+
+
+def make_comp(var_name, params=None, src=MIXED):
+    dcop = load_dcop(src)
+    cg = build_hypergraph(dcop)
+    module = load_algorithm_module("mixeddsa")
+    algo = AlgorithmDef.build_with_default_param(
+        "mixeddsa", params or {}, mode=dcop.objective)
+    node = next(n for n in cg.nodes if n.name == var_name)
+    comp = module.build_computation(ComputationDef(node, algo))
+    sent = []
+    comp.message_sender = (
+        lambda s, d, m, p, e: sent.append((d, m)))
+    return comp, sent
+
+
+def deliver(comp, sender, msg, cycle_id):
+    msg._cycle_id = cycle_id
+    comp.on_message(sender, msg, 0.0)
+
+
+def value_msg(v):
+    from pydcop_tpu.algorithms.mixeddsa import MixedDsaValueMessage
+    return MixedDsaValueMessage(v)
+
+
+def test_constraints_classified_by_infinite_entries():
+    comp, _ = make_comp("v2", {"seed": 1})
+    assert [c.name for c in comp.hard_constraints] == ["hard_12"]
+    assert [c.name for c in comp.soft_constraints] == ["soft_23"]
+
+
+def test_tier_cost_counts_hard_violations_and_soft_cost():
+    comp, _ = make_comp("v2", {"seed": 1})
+    comp.start()
+    comp._neighbor_values = {"v1": "R", "v3": "G"}
+    # v2=R: hard_12(R,R) violated; soft_23(R,G)=0
+    assert comp._tier_cost("R") == (1, pytest.approx(0.0))
+    # v2=G: hard ok; soft_23(G,G)=2
+    assert comp._tier_cost("G") == (0, pytest.approx(2.0))
+
+
+def test_hard_violation_dominates_soft_cost():
+    """Escaping a hard violation wins even when it costs soft points
+    (the two-tier ranking, reference mixeddsa.py:410-447)."""
+    comp, _ = make_comp("v2", {"seed": 1, "proba_hard": 1.0})
+    comp.start()
+    comp.value_selection("R")
+    deliver(comp, "v1", value_msg("R"), cycle_id=0)
+    deliver(comp, "v3", value_msg("G"), cycle_id=0)
+    # moves to G: pays soft 2 to clear the hard violation
+    assert comp.current_value == "G"
+    assert comp.current_cost == pytest.approx(2.0)
+
+
+def test_soft_move_uses_soft_probability():
+    # v3 touches only the soft constraint: v3=G against v2=G costs 2,
+    # moving to R saves it — proba_soft (not proba_hard) gates the move
+    comp, _ = make_comp("v3", {"seed": 1, "proba_soft": 0.0})
+    comp.start()
+    comp.value_selection("G")
+    deliver(comp, "v2", value_msg("G"), cycle_id=0)
+    assert comp.current_value == "G"  # proba_soft=0: never moves
+    comp2, _ = make_comp("v3", {"seed": 1, "proba_soft": 1.0})
+    comp2.start()
+    comp2.value_selection("G")
+    deliver(comp2, "v2", value_msg("G"), cycle_id=0)
+    assert comp2.current_value == "R"  # proba_soft=1: always moves
+
+
+def test_hard_move_uses_hard_probability():
+    # v2=G against v1=G violates hard_12 either way it stays; escaping
+    # to R is gated by proba_hard
+    comp, _ = make_comp("v2", {"seed": 1, "proba_hard": 0.0})
+    comp.start()
+    comp.value_selection("G")
+    deliver(comp, "v1", value_msg("G"), cycle_id=0)
+    deliver(comp, "v3", value_msg("G"), cycle_id=0)
+    assert comp.current_value == "G"  # proba_hard=0: stuck in violation
+    comp2, _ = make_comp("v2", {"seed": 1, "proba_hard": 1.0})
+    comp2.start()
+    comp2.value_selection("G")
+    deliver(comp2, "v1", value_msg("G"), cycle_id=0)
+    deliver(comp2, "v3", value_msg("G"), cycle_id=0)
+    assert comp2.current_value == "R"  # proba_hard=1: escapes
+
+
+def test_round_announces_value_for_next_cycle():
+    comp, sent = make_comp("v2", {"seed": 1, "proba_hard": 1.0})
+    comp.start()
+    comp.value_selection("R")
+    sent.clear()
+    deliver(comp, "v1", value_msg("R"), cycle_id=0)
+    deliver(comp, "v3", value_msg("G"), cycle_id=0)
+    values = [(d, m) for d, m in sent if m.type == "mixed_dsa_value"]
+    assert sorted(d for d, _ in values) == ["v1", "v3"]
+    assert all(m.value == comp.current_value for _, m in values)
+
+
+def test_stop_cycle_finishes():
+    comp, _ = make_comp("v2", {"seed": 1, "stop_cycle": 1})
+    done = []
+    comp.finished = lambda: done.append(True)
+    comp.start()
+    deliver(comp, "v1", value_msg("R"), cycle_id=0)
+    deliver(comp, "v3", value_msg("G"), cycle_id=0)
+    assert done == [True]
