@@ -174,8 +174,10 @@ class UnaryFunctionRelation(Constraint):
 
 
 class UnaryBooleanRelation(Constraint):
-    """Unary hard relation: cost 0 if the (truthy) value holds, else inf
-    (reference: relations.py:380-455)."""
+    """Unary relation returning the truthiness of its variable's value —
+    a *condition* relation, meant as a ConditionalRelation guard
+    (reference: relations.py:380-455 returns True/False, NOT a cost;
+    round 3 fixed an inverted 0/inf cost semantic here)."""
 
     def __init__(self, name: str, variable: Variable):
         super().__init__(name)
@@ -189,11 +191,11 @@ class UnaryBooleanRelation(Constraint):
         if not partial_assignment:
             return self
         val = partial_assignment[self._variable.name]
-        return ZeroAryRelation(self._name, 0 if val else float("inf"))
+        return ZeroAryRelation(self._name, True if val else False)
 
     def __call__(self, *args, **kwargs):
         val = args[0] if args else kwargs[self._variable.name]
-        return 0 if val else float("inf")
+        return True if val else False
 
 
 class NAryFunctionRelation(Constraint):

@@ -48,10 +48,14 @@ def test_unary_function_relation():
 
 
 def test_unary_boolean_relation():
+    # a CONDITION relation: returns the value's truthiness, not a cost
+    # (reference: relations.py:380-455; guards ConditionalRelations)
     v = Variable("v1", d3)
     r = UnaryBooleanRelation("u", v)
-    assert r(0) == float("inf")
-    assert r(1) == 0
+    assert r(0) is False
+    assert r(1) is True
+    assert r.slice({"v1": 1})() is True
+    assert r.slice({"v1": 0})() is False
 
 
 def test_nary_function_relation():
@@ -311,3 +315,112 @@ def test_projection_max_mode():
     for qv in a.dimensions[1].domain.values:
         brute = max(a(p=pv, q=qv) for pv in p.domain.values)
         assert proj(q=qv) == pytest.approx(brute)
+
+
+def test_constraint_from_external_definition(tmp_path):
+    """Expression helpers loaded from an external python source file
+    (reference: relations.py:1314-1366, the yaml `source:` field)."""
+    from pydcop_tpu.dcop.relations import \
+        constraint_from_external_definition
+
+    src = tmp_path / "helpers.py"
+    src.write_text("def penalty(a, b):\n    return 3 * (a == b)\n")
+    d = Domain("d", "", [0, 1])
+    x, y = Variable("x", d), Variable("y", d)
+    c = constraint_from_external_definition(
+        "ext", src, "penalty(x, y) + x", [x, y])
+    assert sorted(c.scope_names) == ["x", "y"]
+    assert c(x=1, y=1) == 4
+    assert c(x=0, y=1) == 0
+
+
+def test_assignment_matrix_shape_and_independence():
+    from pydcop_tpu.dcop.relations import assignment_matrix
+
+    d2 = Domain("d2", "", [0, 1])
+    d3 = Domain("d3", "", ["a", "b", "c"])
+    m = assignment_matrix([Variable("x", d2), Variable("y", d3)], 0)
+    assert len(m) == 2 and len(m[0]) == 3
+    m[0][1] = 9
+    assert m[1][1] == 0  # rows must not share storage
+
+
+def test_filter_assignment_and_var_match():
+    from pydcop_tpu.dcop.relations import (count_var_match,
+                                           filter_assignment_dict)
+
+    d = Domain("d", "", [0, 1])
+    x, y = Variable("x", d), Variable("y", d)
+    c = NAryFunctionRelation(lambda x, y: x + y, [x, y], name="c")
+    asgt = {"x": 1, "y": 0, "z": 1}
+    assert filter_assignment_dict(asgt, [x, y]) == {"x": 1, "y": 0}
+    assert count_var_match(asgt, c) == 2
+    assert count_var_match({"z": 1}, c) == 0
+
+
+def test_is_compatible():
+    from pydcop_tpu.dcop.relations import is_compatible
+
+    assert is_compatible({"x": 1}, {"x": 1, "y": 2})
+    assert not is_compatible({"x": 1}, {"x": 2})
+    assert is_compatible({"x": 1}, {"y": 2})  # disjoint: trivially ok
+    assert is_compatible({}, {"y": 2})
+
+
+def test_arg_projection_matches_projection():
+    """arg_projection returns, per remaining assignment, the index that
+    projection's optimum comes from (the DPOP VALUE-phase companion)."""
+    from pydcop_tpu.dcop.relations import arg_projection, projection
+
+    d = Domain("d", "", [0, 1, 2])
+    x, y = Variable("x", d), Variable("y", d)
+    rng = np.random.default_rng(3)
+    m = NAryMatrixRelation([x, y], rng.uniform(0, 10, (3, 3)), name="m")
+    proj = projection(m, y, "min")
+    args = arg_projection(m, y, "min")
+    for xi, xv in enumerate(d.values):
+        assert m(x=xv, y=d.values[args[xi]]) == pytest.approx(
+            proj(x=xv))
+    args_max = arg_projection(m, y, "max")
+    proj_max = projection(m, y, "max")
+    for xi, xv in enumerate(d.values):
+        assert m(x=xv, y=d.values[args_max[xi]]) == pytest.approx(
+            proj_max(x=xv))
+
+
+def test_conditional_relation_slice_condition_true():
+    """Slicing that resolves the condition to true returns the inner
+    relation; to false, a constant over the remaining scope."""
+    from pydcop_tpu.dcop.relations import (ConditionalRelation,
+                                           UnaryBooleanRelation)
+
+    d = Domain("d", "", [0, 1])
+    g, x = Variable("g", d), Variable("x", d)
+    cond = UnaryBooleanRelation("cond", g)  # true iff g truthy
+    inner = UnaryFunctionRelation("inner", x, lambda v: 10 * v)
+    c = ConditionalRelation(cond, inner, return_value_if_false=-1.0)
+    assert sorted(v.name for v in c.dimensions) == ["g", "x"]
+    assert c(g=1, x=1) == 10
+    assert c(g=0, x=1) == -1.0
+
+    sliced_true = c.slice({"g": 1})
+    assert sliced_true(x=1) == 10
+    sliced_false = c.slice({"g": 0})
+    assert sliced_false(x=1) == -1.0
+    assert sliced_false(x=0) == -1.0
+
+
+def test_conditional_relation_in_matrix_form():
+    """to_matrix materializes the guarded costs over the union scope."""
+    from pydcop_tpu.dcop.relations import (ConditionalRelation,
+                                           UnaryBooleanRelation)
+
+    d = Domain("d", "", [0, 1])
+    g, x = Variable("g", d), Variable("x", d)
+    c = ConditionalRelation(
+        UnaryBooleanRelation("cond", g),
+        UnaryFunctionRelation("inner", x, lambda v: 10 * v))
+    m = c.to_matrix()
+    for gv in (0, 1):
+        for xv in (0, 1):
+            assert m(g=gv, x=xv) == (10 * xv if gv else 0)
