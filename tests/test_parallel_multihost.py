@@ -1,0 +1,96 @@
+"""Unit tier for the multi-host mesh helpers and the replication
+route-graph path utilities (the DCN/control-plane support modules that
+only integration paths touched before).
+"""
+
+import pytest
+
+from pydcop_tpu.parallel.multihost import global_mesh
+from pydcop_tpu.replication.path_utils import (
+    before_last, cheapest_path_to, filter_missing_agents_paths, head,
+    last, path_starting_with, uniform_cost_search)
+
+# ------------------------------------------------------------- meshes
+
+
+def test_global_mesh_explicit_axes():
+    mesh = global_mesh(dp=4, tp=2)
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_global_mesh_defaults_cover_all_devices():
+    import jax
+
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_global_mesh_rejects_mismatched_factorization():
+    with pytest.raises(ValueError, match="global devices"):
+        global_mesh(dp=3, tp=3)  # 9 != 8 virtual devices
+
+
+# --------------------------------------------------------- path utils
+
+
+def test_path_accessors():
+    assert head(("a", "b")) == "a"
+    assert last(("a", "b")) == "b"
+    assert before_last(("a", "b", "c")) == "b"
+    assert head(()) is None and last(()) is None
+    with pytest.raises(IndexError):
+        before_last(("a",))
+
+
+def test_path_starting_with_returns_sorted_suffixes():
+    paths = {("a", "b"): 1.0, ("a", "b", "c"): 3.0,
+             ("a", "d"): 0.5, ("x", "y"): 0.1}
+    out = path_starting_with(("a",), paths)
+    assert out == [(0.5, ("d",)), (1.0, ("b",)), (3.0, ("b", "c"))]
+    # full-prefix match only
+    assert path_starting_with(("a", "b"), paths) == [(3.0, ("c",))]
+
+
+def test_filter_missing_agents_paths():
+    paths = {("a", "b"): 1.0, ("a", "c"): 2.0, ("a", "b", "c"): 3.0}
+    kept = filter_missing_agents_paths(paths, ["a", "b"])
+    assert kept == {("a", "b"): 1.0}
+
+
+def test_cheapest_path_to():
+    paths = {("a", "b"): 1.0, ("a", "c", "b"): 0.7, ("a", "c"): 0.4}
+    cost, path = cheapest_path_to("b", paths)
+    assert cost == pytest.approx(0.7)
+    assert path == ("a", "c", "b")
+    cost_missing, path_missing = cheapest_path_to("z", paths)
+    assert cost_missing == float("inf") and path_missing == ()
+
+
+def test_uniform_cost_search_finds_cheapest_routes():
+    """Dijkstra over a weighted triangle + spur: indirect route beats
+    the direct expensive hop (the same space the reference's UCS
+    protocol explores hop-by-hop, dist_ucs_hostingcosts.py:573-860)."""
+    hops = {("a", "b"): 10.0, ("b", "a"): 10.0,
+            ("a", "c"): 1.0, ("c", "a"): 1.0,
+            ("c", "b"): 1.0, ("b", "c"): 1.0,
+            ("b", "d"): 1.0, ("d", "b"): 1.0}
+
+    def route(x, y):
+        return hops.get((x, y), float("inf"))
+
+    table = uniform_cost_search("a", ["a", "b", "c", "d"], route)
+    cost_b, path_b = cheapest_path_to("b", table)
+    assert cost_b == pytest.approx(2.0)       # a-c-b, not a-b (10)
+    assert path_b == ("a", "c", "b")
+    cost_d, _ = cheapest_path_to("d", table)
+    assert cost_d == pytest.approx(3.0)       # a-c-b-d
+
+
+def test_uniform_cost_search_max_paths_bound():
+    def route(x, y):
+        return 1.0
+
+    table = uniform_cost_search("a", list("abcdef"), route,
+                                max_paths=3)
+    assert len(table) == 3
