@@ -29,6 +29,15 @@ def set_parser(subparsers):
                         choices=["value_change", "cycle_change",
                                  "period"])
     parser.add_argument("--period", type=float, default=None)
+    parser.add_argument("--run_metrics", type=str, default=None,
+                        help="CSV file streaming run metrics")
+    parser.add_argument("--end_metrics", type=str, default=None,
+                        help="CSV file to append one end-of-run "
+                             "summary row to")
+    parser.add_argument("-i", "--infinity", type=float, default=10000,
+                        help="finite stand-in for infinite costs in "
+                             "reported metrics (reference: "
+                             "run.py:290-297)")
     parser.add_argument("--max_cycles", type=int, default=1_000_000)
     parser.add_argument("--seed", type=int, default=0)
     parser.set_defaults(func=run_cmd)
@@ -36,6 +45,11 @@ def set_parser(subparsers):
 
 
 def run_cmd(args, timeout=None):
+    import queue
+    import threading
+
+    from .solve import _append_end_metrics, _collect_to_csv
+
     t0 = time.perf_counter()
     dcop = load_dcop_from_file(args.dcop_files)
     scenario = load_scenario_from_file(args.scenario)
@@ -43,21 +57,41 @@ def run_cmd(args, timeout=None):
                               mode=dcop.objective)
     from ..infrastructure.run import run_dcop
 
+    collector, collector_thread, stop_evt = None, None, None
+    if args.run_metrics:
+        collector = queue.Queue()
+        stop_evt = threading.Event()
+        collector_thread = threading.Thread(
+            target=_collect_to_csv,
+            args=(collector, args.run_metrics, stop_evt), daemon=True)
+        collector_thread.start()
+
     res = run_dcop(
         dcop, algo_def, distribution=args.distribution, mode=args.mode,
         scenario=scenario, timeout=timeout, ktarget=args.ktarget,
         replication=args.replication_method,
         collect_moment=args.collect_on, collect_period=args.period,
-        seed=args.seed, max_cycles=args.max_cycles)
+        seed=args.seed, max_cycles=args.max_cycles,
+        collector=collector)
+    if stop_evt is not None:
+        stop_evt.set()
+        collector_thread.join(2)
+
+    cost = res.cost
+    if res.assignment and set(res.assignment) == set(dcop.variables):
+        cost, _ = dcop.solution_cost(res.assignment,
+                                     infinity=args.infinity)
     result = {
         "status": res.status,
         "assignment": res.assignment,
-        "cost": res.cost,
+        "cost": cost,
         "violation": res.violations,
         "cycle": res.cycles,
         "time": time.perf_counter() - t0,
         "msg_count": res.metrics.get("msg_count", 0),
         "msg_size": res.metrics.get("msg_size", 0),
     }
+    if args.end_metrics:
+        _append_end_metrics(args.end_metrics, result)
     output_json(result, args.output)
     return 0

@@ -9,6 +9,7 @@ exercised.  Prints a JSON result.
 """
 
 import csv
+import os
 import queue
 import threading
 import time
@@ -42,8 +43,17 @@ def set_parser(subparsers):
                              "mode")
     parser.add_argument("--run_metrics", type=str, default=None,
                         help="CSV file for run metrics")
+    parser.add_argument("--end_metrics", type=str, default=None,
+                        help="CSV file to append one end-of-run summary "
+                             "row to (reference: solve.py:162)")
+    parser.add_argument("-i", "--infinity", type=float, default=10000,
+                        help="finite stand-in for infinite costs in "
+                             "reported metrics (hard-constraint "
+                             "violations; reference: solve.py:316-323)")
     parser.add_argument("--delay", type=float, default=None,
                         help="inter-message delay (thread/process mode)")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="websocket UI port base (thread mode)")
     parser.add_argument("--max_cycles", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
     parser.set_defaults(func=run_cmd)
@@ -90,17 +100,25 @@ def run_cmd(args, timeout: Optional[float] = None):
             mode=args.mode, timeout=timeout, max_cycles=args.max_cycles,
             seed=args.seed, collector=collector,
             collect_moment=args.collect_on,
-            collect_period=args.period, delay=args.delay)
+            collect_period=args.period, delay=args.delay,
+            uiport=args.uiport)
         metrics = res.metrics
 
     if stop_evt is not None:
         stop_evt.set()
         collector_thread.join(2)
 
+    cost = res.cost
+    if res.assignment and set(res.assignment) == set(dcop.variables):
+        # reported cost uses the finite infinity stand-in: each hard
+        # violation adds args.infinity instead of poisoning the sum
+        # (reference: solve.py:448 + dcop.py:319-369)
+        cost, _ = dcop.solution_cost(res.assignment,
+                                     infinity=args.infinity)
     result = {
         "status": res.status,
         "assignment": res.assignment,
-        "cost": res.cost,
+        "cost": cost,
         "violation": res.violations,
         "cycle": res.cycles,
         "time": time.perf_counter() - t0,
@@ -109,8 +127,28 @@ def run_cmd(args, timeout: Optional[float] = None):
     }
     if res.cost_trace:
         result["cost_trace"] = res.cost_trace
+    if args.end_metrics:
+        _append_end_metrics(args.end_metrics, result)
     output_json(result, args.output)
     return 0
+
+
+END_METRICS_COLUMNS = ["time", "status", "cost", "violation", "cycle",
+                       "msg_count", "msg_size"]
+
+
+def _append_end_metrics(path: str, result: dict):
+    """Append one end-of-run summary row, writing the header when the
+    file is new (reference: solve.py:411-443)."""
+    new_file = not os.path.exists(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(END_METRICS_COLUMNS)
+        writer.writerow([result[c] for c in END_METRICS_COLUMNS])
 
 
 def _collect_to_csv(collector: "queue.Queue", path: str,

@@ -342,6 +342,7 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
              seed: int = 0, max_cycles: int = 2000,
              port: int = 9000, graph: Optional[str] = None,
              delay: Optional[float] = None,
+             uiport: Optional[int] = None,
              **algo_params) -> RunResult:
     """End-to-end orchestrated run, with optional dynamic scenario +
     k-replication (the library-level counterpart of the ``run`` CLI;
@@ -361,7 +362,7 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             algo_def, cg, dist, dcop, collector=collector,
             collect_moment=collect_moment,
             collect_period=collect_period, replication=rep,
-            delay=delay or 0)
+            delay=delay or 0, uiport=uiport)
     else:
         orchestrator = run_local_process_dcop(
             algo_def, cg, dist, dcop, collector=collector,

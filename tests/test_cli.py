@@ -301,3 +301,72 @@ def test_distribution_file_roundtrip(tmp_path, gc3_file):
     result = json.loads(proc.stdout)
     assert result["status"] == "FINISHED"
     assert set(result["assignment"]) == {"v1", "v2", "v3"}
+
+
+def test_solve_end_metrics_appends_rows(tmp_path, gc3_file):
+    """--end_metrics appends one summary row per run, header once
+    (reference: solve.py:411-443)."""
+    import csv as _csv
+
+    end_csv = str(tmp_path / "end.csv")
+    for _ in range(2):
+        run_cli("-t", "30", "solve", "-a", "dsa",
+                "-p", "stop_cycle:5", "-p", "seed:1",
+                "--end_metrics", end_csv, gc3_file)
+    with open(end_csv) as f:
+        rows = list(_csv.reader(f))
+    assert rows[0] == ["time", "status", "cost", "violation", "cycle",
+                       "msg_count", "msg_size"]
+    assert len(rows) == 3  # header + one row per run
+    assert all(r[1] in ("FINISHED", "MAX_CYCLES") for r in rows[1:])
+
+
+def test_solve_infinity_replaces_infinite_cost(tmp_path):
+    """An assignment violating a hard constraint reports the finite
+    --infinity stand-in, keeping the JSON numeric."""
+    hard = tmp_path / "hard.yaml"
+    hard.write_text("""
+name: hard2
+objective: min
+domains:
+  d: {values: [0]}
+variables:
+  x1: {domain: d}
+  x2: {domain: d}
+constraints:
+  never: {type: intention, function: float('inf') if x1 == x2 else 0}
+agents: [a1, a2]
+""")
+    proc = run_cli("-t", "30", "solve", "-a", "dsa",
+                   "-p", "stop_cycle:2", "-i", "777", str(hard))
+    result = json.loads(proc.stdout)
+    # the single possible assignment violates the hard constraint: the
+    # reported cost is the finite stand-in, one per violation
+    assert result["cost"] == 777.0
+    assert result["violation"] == 1
+
+
+def test_run_metrics_files(gc3_file, tmp_path):
+    """run carries the same observability surface as solve:
+    --run_metrics streams during the run, --end_metrics appends one
+    summary row."""
+    import csv as _csv
+
+    scen = tmp_path / "scen.yaml"
+    scen.write_text("events:\n  - id: d1\n    delay: 0.2\n")
+    run_csv = str(tmp_path / "run.csv")
+    end_csv = str(tmp_path / "end.csv")
+    proc = run_cli("-t", "30", "run", "-a", "dsa",
+                   "-p", "stop_cycle:10", "-p", "seed:3",
+                   "-s", str(scen), "-k", "1",
+                   "--run_metrics", run_csv, "--end_metrics", end_csv,
+                   gc3_file, timeout=180)
+    result = json.loads(proc.stdout)
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+    with open(run_csv) as f:
+        rows = list(_csv.reader(f))
+    assert rows[0] == ["time", "computation", "value", "cost", "cycle"]
+    assert len(rows) > 1  # value changes were streamed
+    with open(end_csv) as f:
+        end_rows = list(_csv.reader(f))
+    assert len(end_rows) == 2 and end_rows[1][1] == result["status"]
