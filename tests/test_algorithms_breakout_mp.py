@@ -400,3 +400,19 @@ def test_gdba_stop_cycle_finishes():
     assert done == [True]
     # no ok message for a next iteration after finishing
     assert [m for d, m in sent if m.type == "gdba_ok"] == []
+
+
+def test_gdba_increase_mode_c_bumps_my_value_column():
+    """C: all neighbor assignments with my value fixed — 2 cells of the
+    2x2 table (reference: gdba.py:622-651)."""
+    comp, _ = make_comp("gdba", "v2",
+                        {"seed": 1, "increase_mode": "C"}, src=SOFT3)
+    comp.start()
+    comp.value_selection(0)
+    comp._neighbor_values = {"v1": 0, "v3": 0}
+    comp._increase_modifiers(0)
+    bumped = comp._modifiers[0]
+    assert len(bumped) == 2
+    # every bumped cell fixes v2 at its current value 0
+    for cell in bumped:
+        assert ("v2", 0) in cell
