@@ -1,0 +1,12 @@
+"""Helper functions for intention constraints defined in an external
+python source file (the yaml `source:` field)."""
+
+
+def mismatch_penalty(a, b, weight=1):
+    """Cost `weight` when both take the same value, else 0."""
+    return weight if a == b else 0
+
+
+def prefer(value, wanted, bonus=-0.1):
+    """Small negative cost (reward) when value == wanted."""
+    return bonus if value == wanted else 0.0

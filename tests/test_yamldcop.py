@@ -3,6 +3,7 @@ import pytest
 from pydcop_tpu.dcop.yamldcop import (
     dcop_yaml,
     load_dcop,
+    load_dcop_from_file,
     load_scenario,
     str_2_domain_values,
     yaml_scenario,
@@ -350,3 +351,82 @@ def test_yaml_roundtrip_preserves_hosting_costs_and_routes():
         assert agent2.default_hosting_cost == \
             agent.default_hosting_cost
         assert agent2.hosting_costs == agent.hosting_costs
+
+
+def test_load_external_source_constraints():
+    """Intention constraints whose expressions call helpers from an
+    external python file via the yaml `source:` field (reference:
+    yamldcop.py constraint parsing + relations.py:1314-1366)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "instances",
+                        "coloring_external_func.yaml")
+    dcop = load_dcop_from_file(path)
+    c12 = dcop.constraints["c12"]
+    assert c12(v1="R", v2="R") == 5
+    assert c12(v1="R", v2="G") == 0
+    c23 = dcop.constraints["c23"]
+    assert c23(v2="G", v3="G") == pytest.approx(5 - 0.1)
+    assert c23(v2="R", v3="G") == pytest.approx(-0.1)
+
+
+def test_solve_external_source_instance():
+    import os
+
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    path = os.path.join(os.path.dirname(__file__), "instances",
+                        "coloring_external_func.yaml")
+    dcop = load_dcop_from_file(path)
+    res = solve_result(dcop, "dpop", timeout=20)
+    # optimum: alternating colors with v3 = G
+    assert res.violations == 0
+    assert res.assignment["v3"] == "G"
+    assert res.assignment["v2"] != res.assignment["v3"]
+    assert res.assignment["v1"] != res.assignment["v2"]
+
+
+def test_load_capacity_and_costs_instance():
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "instances",
+                        "coloring_capacity_costs.yaml")
+    dcop = load_dcop_from_file(path)
+    a1 = dcop.agent("a1")
+    assert a1.capacity == 40
+    assert a1.hosting_cost("v1") == 0
+    assert a1.hosting_cost("v9") == 5
+    assert a1.route("a2") == 0.5
+    assert a1.route("a3") == 1  # default route
+    # hosting-cost-aware distribution places the pinned computations
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.distribution import load_distribution_module
+    from pydcop_tpu.graphs.constraints_hypergraph import \
+        build_computation_graph
+
+    cg = build_computation_graph(dcop)
+    dsa = load_algorithm_module("dsa")
+    dist = load_distribution_module("heur_comhost").distribute(
+        cg, dcop.agents_def, None, dsa.computation_memory,
+        dsa.communication_load)
+    assert dist.agent_for("v1") == "a1"  # zero hosting cost wins
+
+
+def test_agent_level_hosting_costs_rejected_with_clear_error():
+    """hosting_costs/routes belong in their top-level sections; nesting
+    them inside an agent used to die with an opaque TypeError."""
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    src = """
+name: bad
+objective: min
+domains: {d: {values: [0, 1]}}
+variables:
+  v1: {domain: d}
+constraints:
+  c: {type: intention, function: v1}
+agents:
+  a1: {capacity: 10, hosting_costs: {default: 5}}
+"""
+    with pytest.raises(DcopInvalidFormatError, match="top-level"):
+        load_dcop(src)
