@@ -329,3 +329,29 @@ def test_stats_tracing(tmp_path):
 def test_stats_disabled_noop(tmp_path):
     stats.teardown_tracing()
     stats.trace_computation("v1", 1, 0.5)  # must not raise
+
+
+def test_sync_pause_buffers_and_replays_rounds():
+    """Messages arriving while paused are buffered (not dropped, not
+    barrier-counted) and replayed on resume, closing the round then
+    (reference: computations.py:400-446 pause buffering, applied to
+    the mixin's on_message)."""
+    c = SyncComp("a", ["b", "c"])
+    c.message_sender = MagicMock()
+    c.start()
+    m1 = Message("v")
+    m1._cycle_id = 0
+    c.on_message("b", m1, 0.0)
+    c.pause(True)
+    m2 = Message("v")
+    m2._cycle_id = 0
+    c.on_message("c", m2, 0.0)  # buffered: round must not close
+    assert c.cycles == []
+    c.pause(False)              # replay closes cycle 0
+    assert [cid for cid, _ in c.cycles] == [0]
+    assert set(c.cycles[0][1]) == {"b", "c"}
+
+
+def test_sync_cycle_count_lazy_init():
+    c = SyncComp("a", ["b"])
+    assert c.cycle_count == 0  # readable before start_cycle
