@@ -370,11 +370,15 @@ def run_dcop(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             collect_period=collect_period, replication=rep, port=port,
             delay=delay or 0)
     try:
-        # process mode spawns one interpreter per agent: registration can
-        # take tens of seconds for larger fleets, scale the wait with it
+        # process mode spawns one interpreter per agent (each importing
+        # jax): registration takes tens of seconds for larger fleets or
+        # under host contention — scale the wait and give process mode
+        # a higher floor (observed: 3 spawns missing a 15 s floor while
+        # a TPU benchmark saturated the host)
         n_agents = len(list(dist.agents))
+        floor = 40.0 if mode == "process" else 15.0
         orchestrator.deploy_computations(
-            timeout=max(15.0, 4.0 * n_agents))
+            timeout=max(floor, 4.0 * n_agents))
         if ktarget:
             orchestrator.start_replication(ktarget)
         result = orchestrator.run(scenario=scenario, timeout=timeout,

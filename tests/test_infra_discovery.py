@@ -105,3 +105,86 @@ def test_replica_registration_visible_to_peer():
     finally:
         for a in (a1, a2, d_agent):
             a.clean_shutdown(1)
+
+
+# --------------------------------------------- local (cache-only) tier
+
+
+def _local_disco():
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    return Discovery("a_test", address="addr_test")
+
+
+def test_local_register_and_lookup():
+    import pytest
+
+    from pydcop_tpu.infrastructure.communication import (
+        UnknownAgent, UnknownComputation)
+
+    d = _local_disco()
+    d.register_agent("a1", "addr1", publish=False)
+    d.register_computation("c1", "a1", publish=False)
+    assert "a1" in d.agents()
+    assert d.agent_address("a1") == "addr1"
+    assert d.computation_agent("c1") == "a1"
+    assert "c1" in d.agent_computations("a1")
+    with pytest.raises(UnknownAgent):
+        d.agent_address("ghost")
+    with pytest.raises(UnknownComputation):
+        d.computation_agent("ghost_comp")
+
+
+def test_local_unregister_clears_cache():
+    d = _local_disco()
+    d.register_agent("a1", "addr1", publish=False)
+    d.register_computation("c1", "a1", publish=False)
+    d.unregister_computation("c1", "a1", publish=False)
+    assert "c1" not in d.computations()
+    d.unregister_agent("a1", publish=False)
+    assert "a1" not in d.agents()
+
+
+def test_local_subscription_callbacks_fire():
+    d = _local_disco()
+    events = []
+    d.subscribe_agent_local(
+        "a9", lambda evt, name, addr: events.append((evt, name, addr)))
+    d.register_agent("a9", "addr9", publish=False)
+    d.unregister_agent("a9", publish=False)
+    assert events == [("agent_added", "a9", "addr9"),
+                      ("agent_removed", "a9", None)]
+
+
+def test_local_computation_subscription_fires_once_per_event():
+    d = _local_disco()
+    events = []
+    d.subscribe_computation_local(
+        "c5", lambda evt, name, agent: events.append((evt, name, agent)))
+    d.register_agent("a1", "addr1", publish=False)
+    d.register_computation("c5", "a1", publish=False)
+    # re-registration on the same agent must not re-fire
+    d.register_computation("c5", "a1", publish=False)
+    assert events == [("computation_added", "c5", "a1")]
+
+
+def test_replica_cache_tracks_sets():
+    d = _local_disco()
+    d.register_agent("a1", "addr1", publish=False)
+    d.register_agent("a2", "addr2", publish=False)
+    d.register_replica("c1", agent="a1", publish=False)
+    d.register_replica("c1", agent="a2", publish=False)
+    assert d.replica_agents("c1") == {"a1", "a2"}
+    d.unregister_replica("c1", agent="a1", publish=False)
+    assert d.replica_agents("c1") == {"a2"}
+    assert d.replica_agents("unknown") == set()
+
+
+def test_technical_computations_filtered():
+    d = _local_disco()
+    d.register_agent("a1", "addr1", publish=False)
+    d.register_computation("v1", "a1", publish=False)
+    d.register_computation("_mgt_a1", "a1", publish=False)
+    assert "v1" in d.computations()
+    assert "_mgt_a1" not in d.computations()
+    assert "_mgt_a1" in d.computations(include_technical=True)
