@@ -901,3 +901,26 @@ hosting_costs:
         orch.stop()
         for agent in orch.local_agents:
             agent.clean_shutdown(1)
+
+
+@pytest.mark.parametrize("algo,cycles", [("mgm", 30), ("maxsum", 40)])
+def test_fabric_matches_engine_quality_more_algorithms(algo, cycles):
+    """The dsa cross-check, extended: mgm (monotone local search) and
+    maxsum (belief propagation) must also reach engine-grade quality
+    through the real agent fabric under the same seed."""
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    yaml_src = _random_coloring_yaml()
+    engine = solve_result(load_dcop(yaml_src), algo, timeout=30,
+                          stop_cycle=cycles, seed=5)
+    # adhoc: maxsum's factor graph has more computations (vars+factors)
+    # than agents, so oneagent is infeasible there
+    fabric = run_dcop(load_dcop(yaml_src), algo,
+                      distribution="adhoc", timeout=90,
+                      stop_cycle=cycles, seed=5)
+    assert fabric.metrics["status"] == "FINISHED"
+    assert set(fabric.assignment) == set(engine.assignment)
+    assert engine.violations <= 2
+    assert fabric.violations <= 2
+    # real messages moved on the fabric (not mirrors)
+    assert fabric.metrics["msg_count"] > 50
