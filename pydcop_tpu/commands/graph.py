@@ -15,8 +15,50 @@ def set_parser(subparsers):
                         help="graph model: factor_graph | "
                              "constraints_hypergraph | pseudotree | "
                              "ordered_graph")
+    parser.add_argument("--display", nargs="?", const="graph.png",
+                        default=None, metavar="FILE",
+                        help="render the constraint graph to an image "
+                             "(default graph.png; reference's --display "
+                             "opens a window — headless here)")
     parser.set_defaults(func=run_cmd)
     return parser
+
+
+def _render(dcop, graph_type: str, path: str):
+    """Draw the constraint graph with networkx + matplotlib (reference:
+    graph.py:130-155 display_graph/display_bipartite_graph)."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless: render to file, never open a UI
+    import matplotlib.pyplot as plt
+    import networkx as nx
+
+    g = nx.Graph()
+    if graph_type == "factor_graph":
+        var_names = list(dcop.variables)
+        g.add_nodes_from(var_names, bipartite=0)
+        g.add_nodes_from(dcop.constraints, bipartite=1)
+        for c_name, c in dcop.constraints.items():
+            for v in c.scope_names:
+                g.add_edge(c_name, v)
+        colors = ["#7fb3d5" if n in dcop.variables else "#f5b041"
+                  for n in g.nodes]
+    else:
+        g.add_nodes_from(dcop.variables)
+        for c in dcop.constraints.values():
+            scope = c.scope_names
+            for i, a in enumerate(scope):
+                for b in scope[i + 1:]:
+                    g.add_edge(a, b)
+        colors = "#7fb3d5"
+    plt.figure(figsize=(8, 6))
+    nx.draw_networkx(g, pos=nx.spring_layout(g, seed=1),
+                     node_color=colors, font_size=8,
+                     node_size=450, edge_color="#888888")
+    plt.axis("off")
+    plt.tight_layout()
+    plt.savefig(path, dpi=120)
+    plt.close()
 
 
 def run_cmd(args, timeout=None):
@@ -24,6 +66,8 @@ def run_cmd(args, timeout=None):
 
     dcop = load_dcop_from_file(args.dcop_files)
     cg = load_graph_module(args.graph).build_computation_graph(dcop)
+    if args.display:
+        _render(dcop, args.graph, args.display)
     edges_count = len(cg.links)
     nodes_count = len(cg.nodes)
     result = {

@@ -370,3 +370,12 @@ def test_run_metrics_files(gc3_file, tmp_path):
     with open(end_csv) as f:
         end_rows = list(_csv.reader(f))
     assert len(end_rows) == 2 and end_rows[1][1] == result["status"]
+
+
+def test_graph_display_renders_png(gc3_file, tmp_path):
+    out_png = str(tmp_path / "cg.png")
+    proc = run_cli("graph", "-g", "factor_graph",
+                   "--display", out_png, gc3_file)
+    result = json.loads(proc.stdout)
+    assert result["graph"]["nodes_count"] == 5
+    assert os.path.getsize(out_png) > 1000  # a real image came out
