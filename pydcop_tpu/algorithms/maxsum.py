@@ -190,19 +190,27 @@ class MaxSumSolver(ArraySolver):
 
         # --- selection & convergence ------------------------------------
         selection = masked_argmin(belief, self.domain_mask)
+        # stability <= 0 disables message-delta convergence entirely
+        # (delta < 0 can never hold): skip the full-array max reduce
         delta = jnp.max(jnp.where(edge_mask, jnp.abs(q_new - q), 0.0)) \
-            if self.E else jnp.float32(0)
+            if self.E and self.stability > 0 else jnp.float32(0)
         return self._advance(s, key, q_new, new_r, selection, delta)
 
     def _advance(self, s, key, q_new, new_r, selection, delta):
         """Shared convergence bookkeeping (SAME_COUNT stable cycles,
         stop_cycle cap) — one copy for every state layout."""
-        stable = jnp.logical_and(
-            jnp.all(selection == s["selection"]), delta < self.stability
-        )
-        same = jnp.where(stable, s["same"] + 1, 0)
         cycle = s["cycle"] + 1
-        finished = same >= SAME_COUNT
+        if self.stability > 0:
+            stable = jnp.logical_and(
+                jnp.all(selection == s["selection"]),
+                delta < self.stability)
+            same = jnp.where(stable, s["same"] + 1, 0)
+            finished = same >= SAME_COUNT
+        else:
+            # stability disabled: only stop_cycle / max_cycles end the
+            # run, so the stable/same comparisons are dead compute
+            same = s["same"]
+            finished = jnp.bool_(False)
         if self.stop_cycle:
             finished = jnp.logical_or(finished, cycle >= self.stop_cycle)
         out = dict(s)  # preserve algorithm-private extras (e.g. dynamic
@@ -349,7 +357,7 @@ class MaxSumLaneSolver(MaxSumSolver):
 
         selection = self._select(belief)
         delta = jnp.max(jnp.where(self.emaskT, jnp.abs(q_new - q), 0.0)) \
-            if self.E else jnp.float32(0)
+            if self.E and self.stability > 0 else jnp.float32(0)
         return self._advance(s, key, q_new, new_r, selection, delta)
 
 
