@@ -262,10 +262,15 @@ class ShardedMaxSum:
                 sel = jnp.argmin(
                     jnp.where(domain_mask[:V], belief[:V], BIG * 2),
                     axis=-1)
-                delta_local = jnp.max(
-                    jnp.where(mask_e, jnp.abs(q_new - q1), 0.0)) \
-                    if E else jnp.float32(0)
-                delta = jax.lax.pmax(delta_local, "tp")
+                # stability <= 0 disables delta convergence (same dead-
+                # compute elision as the single-chip solvers): skip the
+                # full-array reduce AND its cross-shard pmax collective
+                if E and self.stability > 0:
+                    delta_local = jnp.max(
+                        jnp.where(mask_e, jnp.abs(q_new - q1), 0.0))
+                    delta = jax.lax.pmax(delta_local, "tp")
+                else:
+                    delta = jnp.float32(0)
                 return q_new, new_r, sel, delta
 
             dp_idx = jax.lax.axis_index("dp")
