@@ -1,0 +1,141 @@
+"""Direct unit tiers for the batch campaign expansion and the
+reparation removal helpers.
+
+Mirrors the reference's `tests/unit/test_batch.py` (job expansion,
+cartesian parameter grids) and `test_reparation_removal.py` (orphans,
+candidates, repair info).
+"""
+
+import pytest
+
+from pydcop_tpu.commands.batch import (CliError, expand_jobs, _job_argv,
+                                       parameters_configuration)
+from pydcop_tpu.reparation.removal import (build_repair_info,
+                                           candidate_agents,
+                                           orphaned_computations)
+
+# ================================================================ batch
+
+
+def test_parameters_configuration_cartesian_product():
+    confs = list(parameters_configuration(
+        {"algo": ["dsa", "mgm"], "timeout": 5, "seed": [1, 2]}))
+    assert len(confs) == 4
+    assert {(c["algo"], c["seed"]) for c in confs} == {
+        ("dsa", 1), ("dsa", 2), ("mgm", 1), ("mgm", 2)}
+    assert all(c["timeout"] == 5 for c in confs)
+
+
+def test_parameters_configuration_no_lists_single_job():
+    confs = list(parameters_configuration({"algo": "dsa"}))
+    assert confs == [{"algo": "dsa"}]
+
+
+def test_job_argv_shapes():
+    argv = _job_argv("solve", "prob.yaml",
+                     {"algo": "dsa", "timeout": 7,
+                      "algo_params": ["stop_cycle:5", "seed:1"],
+                      "simulate_flag": True})
+    # global timeout rides before the subcommand
+    i = argv.index("--timeout")
+    assert argv[i + 1] == "7" and argv.index("solve") > i
+    # list-valued options repeat the flag
+    assert argv.count("--algo_params") == 2
+    # booleans become bare flags
+    assert "--simulate_flag" in argv
+    assert argv[-1] == "prob.yaml"
+
+
+def test_expand_jobs_sets_batches_iterations(tmp_path):
+    for n in ("p1.yaml", "p2.yaml"):
+        (tmp_path / n).write_text("name: x\n")
+    bench = {
+        "sets": {"s": {"path": str(tmp_path / "p*.yaml"),
+                       "iterations": 2}},
+        "batches": {
+            "b": {"command": "solve",
+                  "command_options": {"algo": ["dsa", "mgm"]}}},
+        "global_options": {"timeout": 9},
+    }
+    jobs = expand_jobs(bench)
+    # 2 files x 2 algos x 2 iterations
+    assert len(jobs) == 8
+    ids = [j for j, _ in jobs]
+    assert len(set(ids)) == 8  # unique job ids (resume-file keys)
+    assert all("--timeout" in argv for _, argv in jobs)
+
+
+def test_expand_jobs_requires_batches():
+    with pytest.raises(CliError, match="batches"):
+        expand_jobs({"sets": {}})
+
+
+def test_expand_jobs_empty_glob_is_an_error():
+    bench = {"sets": {"s": {"path": "/nonexistent/xyz*.yaml"}},
+             "batches": {"b": {"command": "solve"}}}
+    with pytest.raises(CliError, match="no file matches"):
+        expand_jobs(bench)
+
+
+# ============================================================ reparation
+
+
+class DiscoStub:
+    def __init__(self, hosted, replicas):
+        self._hosted = hosted      # agent -> [comp]
+        self._replicas = replicas  # comp -> {agent}
+
+    def agent_computations(self, agent):
+        return list(self._hosted.get(agent, []))
+
+    def replica_agents(self, comp):
+        return set(self._replicas.get(comp, set()))
+
+
+def test_orphaned_computations_sorted_deduped():
+    disco = DiscoStub({"a1": ["c2", "c1"], "a2": ["c1", "c3"]}, {})
+    assert orphaned_computations(["a1", "a2"], disco) == \
+        ["c1", "c2", "c3"]
+    assert orphaned_computations(["a1"], disco) == ["c1", "c2"]
+
+
+def test_candidate_agents_excludes_departed():
+    disco = DiscoStub(
+        {"a1": ["c1"]},
+        {"c1": {"a2", "a3", "a1"}})
+    cands = candidate_agents(["c1"], disco, departed=["a1"])
+    assert cands == {"c1": {"a2", "a3"}}
+
+
+def test_build_repair_info_remaining_capacity():
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    disco = DiscoStub(
+        {"a_gone": ["cX"], "a2": ["h1", "h2"], "a3": []},
+        {"cX": {"a2", "a3"}})
+    defs = {
+        "a2": AgentDef("a2", capacity=10,
+                       hosting_costs={"cX": 2}),
+        "a3": AgentDef("a3", capacity=4),
+    }
+    info = build_repair_info(
+        ["a_gone"], disco, agent_defs=defs,
+        footprints={"h1": 3.0, "h2": 4.0})
+    assert info["orphaned"] == ["cX"]
+    assert set(info["candidates"]["cX"]) == {"a2", "a3"}
+    # remaining capacity: a2 holds h1+h2 (7.0 of 10), a3 holds nothing
+    assert info["capacity"]["a2"] == pytest.approx(3.0)
+    assert info["capacity"]["a3"] == pytest.approx(4.0)
+    assert info["hosting_costs"]["a2"]["cX"] == pytest.approx(2.0)
+    assert info["hosting_costs"]["a3"]["cX"] == pytest.approx(0.0)
+
+
+def test_build_repair_info_deterministic():
+    """Every candidate must derive the same dict (they all solve the
+    same repair DCOP independently)."""
+    disco = DiscoStub({"gone": ["c1", "c2"]},
+                      {"c1": {"a2"}, "c2": {"a2", "a3"}})
+    i1 = build_repair_info(["gone"], disco)
+    i2 = build_repair_info(["gone"], disco)
+    assert i1 == i2
+    assert i1["orphaned"] == ["c1", "c2"]
