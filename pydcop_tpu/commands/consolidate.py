@@ -22,6 +22,25 @@ def set_parser(subparsers):
     return parser
 
 
+def _job_id_params(filename: str) -> dict:
+    """Batch job ids encode the campaign coordinates
+    (``set__batch__problem__k=v_k=v__iteration.json``, see
+    batch._job_id); recover them as columns so campaign CSVs group by
+    algorithm / parameters directly (the reference's consolidate
+    extracts job metadata the same way, consolidate.py:129-235)."""
+    stem = filename[:-5] if filename.endswith(".json") else filename
+    parts = stem.split("__")
+    if len(parts) != 5:
+        return {}
+    out = {"set": parts[0], "batch": parts[1], "problem": parts[2],
+           "iteration": parts[4]}
+    for kv in parts[3].split("_"):
+        if "=" in kv:
+            k, v = kv.split("=", 1)
+            out[k] = v
+    return out
+
+
 def run_cmd(args, timeout=None):
     files: List[str] = []
     for pattern in args.result_files:
@@ -38,7 +57,7 @@ def run_cmd(args, timeout=None):
         except (OSError, json.JSONDecodeError) as e:
             print(f"warning: cannot read {path}: {e}", file=sys.stderr)
             continue
-        rows.append({
+        row = {
             "file": os.path.basename(path),
             "status": data.get("status"),
             "cost": data.get("cost"),
@@ -47,9 +66,13 @@ def run_cmd(args, timeout=None):
             "time": data.get("time"),
             "msg_count": data.get("msg_count"),
             "msg_size": data.get("msg_size"),
-        })
+        }
+        row.update(_job_id_params(os.path.basename(path)))
+        rows.append(row)
     fieldnames = ["file", "status", "cost", "violation", "cycle",
                   "time", "msg_count", "msg_size"]
+    extra = sorted({k for r in rows for k in r} - set(fieldnames))
+    fieldnames += extra
     out = open(args.csv_out, "w", newline="") if args.csv_out \
         else sys.stdout
     try:

@@ -139,3 +139,33 @@ def test_build_repair_info_deterministic():
     i2 = build_repair_info(["gone"], disco)
     assert i1 == i2
     assert i1["orphaned"] == ["c1", "c2"]
+
+
+# ============================================================ consolidate
+
+
+def test_consolidate_extracts_job_parameters(tmp_path, capsys):
+    """Campaign result CSVs carry the job coordinates (set, batch,
+    problem, parameters like algo) as columns so groupby works."""
+    import csv as _csv
+    import json
+    from argparse import Namespace
+
+    from pydcop_tpu.commands.consolidate import run_cmd
+
+    for algo in ("dsa", "mgm"):
+        p = tmp_path / f"s1__b1__gc.yaml__algo={algo}__0.json"
+        p.write_text(json.dumps(
+            {"status": "FINISHED", "cost": 1.0, "violation": 0,
+             "cycle": 5, "time": 0.1, "msg_count": 10, "msg_size": 99}))
+    out_csv = tmp_path / "all.csv"
+    run_cmd(Namespace(result_files=[str(tmp_path / "*.json")],
+                      csv_out=str(out_csv)))
+    with open(out_csv) as f:
+        rows = list(_csv.DictReader(f))
+    assert len(rows) == 2
+    assert {r["algo"] for r in rows} == {"dsa", "mgm"}
+    assert all(r["set"] == "s1" and r["batch"] == "b1"
+               and r["problem"] == "gc.yaml" and r["iteration"] == "0"
+               for r in rows)
+    assert all(r["status"] == "FINISHED" for r in rows)
