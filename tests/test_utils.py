@@ -153,3 +153,38 @@ def test_from_repr_untrusted_blocks_side_effect_classes():
                          "__module__": "builtins",
                          "values": ["0.0.0.0", 4444]}},
             allowed_prefixes=("pydcop_tpu.",))
+
+
+# ---------------------------------------------------- networkx adapters
+
+
+def test_networkx_adapters_and_metrics():
+    """Constraint graph / bipartite adapters + cycle and diameter
+    metrics (reference: utils/graphs.py:131-306)."""
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation
+    from pydcop_tpu.utils.graphs import (as_bipartite_graph,
+                                         as_networkx_graph,
+                                         cycles_count, graph_diameter)
+
+    d = Domain("d", "", [0, 1])
+    v1, v2, v3 = (Variable(f"v{i}", d) for i in (1, 2, 3))
+    triangle = [
+        NAryFunctionRelation(lambda x, y: 0, [v1, v2], name="c12"),
+        NAryFunctionRelation(lambda x, y: 0, [v2, v3], name="c23"),
+        NAryFunctionRelation(lambda x, y: 0, [v1, v3], name="c13"),
+    ]
+    g = as_networkx_graph([v1, v2, v3], triangle)
+    assert set(g.nodes) == {"v1", "v2", "v3"}
+    assert g.number_of_edges() == 3
+    assert cycles_count([v1, v2, v3], triangle) == 1
+    assert graph_diameter([v1, v2, v3], triangle) == [1]
+
+    b = as_bipartite_graph([v1, v2, v3], triangle)
+    assert set(b.nodes) == {"v1", "v2", "v3", "c12", "c23", "c13"}
+    assert b.number_of_edges() == 6  # 2 endpoints per constraint
+
+    # chain: no cycle, diameter 2
+    chain = triangle[:2]
+    assert cycles_count([v1, v2, v3], chain) == 0
+    assert graph_diameter([v1, v2, v3], chain) == [2]
