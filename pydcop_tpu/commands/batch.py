@@ -97,7 +97,10 @@ def expand_jobs(bench_def: Dict) -> List[Tuple[str, List[str]]]:
 
 
 def _job_id(set_name, batch_name, path, conf, iteration) -> str:
-    conf_s = "_".join(
+    # ',' joins the k=v pairs: it cannot appear in CLI flag names and
+    # is filename-safe, so consolidate can split the params segment
+    # unambiguously even when keys or values contain '_'
+    conf_s = ",".join(
         f"{k}={v}" for k, v in sorted(conf.items())
         if k not in ("timeout",))
     base = os.path.basename(path) if path else "nofile"

@@ -22,6 +22,10 @@ def set_parser(subparsers):
     return parser
 
 
+BASE_COLUMNS = ("file", "status", "cost", "violation", "cycle",
+                "time", "msg_count", "msg_size")
+
+
 def _job_id_params(filename: str) -> dict:
     """Batch job ids encode the campaign coordinates
     (``set__batch__problem__k=v_k=v__iteration.json``, see
@@ -34,10 +38,15 @@ def _job_id_params(filename: str) -> dict:
         return {}
     out = {"set": parts[0], "batch": parts[1], "problem": parts[2],
            "iteration": parts[4]}
-    for kv in parts[3].split("_"):
+    # batch._job_id joins k=v pairs with ',' (collision-free: keys and
+    # values may both contain '_'); legacy '_'-joined ids from older
+    # campaigns are still split on '_' as before
+    sep = "," if "," in parts[3] or "_" not in parts[3] else "_"
+    for kv in parts[3].split(sep):
         if "=" in kv:
             k, v = kv.split("=", 1)
-            out[k] = v
+            if k not in BASE_COLUMNS:  # never clobber a measured value
+                out[k] = v
     return out
 
 
@@ -69,8 +78,7 @@ def run_cmd(args, timeout=None):
         }
         row.update(_job_id_params(os.path.basename(path)))
         rows.append(row)
-    fieldnames = ["file", "status", "cost", "violation", "cycle",
-                  "time", "msg_count", "msg_size"]
+    fieldnames = list(BASE_COLUMNS)
     extra = sorted({k for r in rows for k in r} - set(fieldnames))
     fieldnames += extra
     out = open(args.csv_out, "w", newline="") if args.csv_out \

@@ -3,7 +3,7 @@
 reference parity: pydcop/commands/graph.py:144-198.
 """
 
-from . import output_json
+from . import CliError, output_json
 from ..dcop.yamldcop import load_dcop_from_file
 
 
@@ -15,11 +15,10 @@ def set_parser(subparsers):
                         help="graph model: factor_graph | "
                              "constraints_hypergraph | pseudotree | "
                              "ordered_graph")
-    parser.add_argument("--display", nargs="?", const="graph.png",
-                        default=None, metavar="FILE",
+    parser.add_argument("--display", default=None, metavar="FILE",
                         help="render the constraint graph to an image "
-                             "(default graph.png; reference's --display "
-                             "opens a window — headless here)")
+                             "at FILE (reference's --display opens a "
+                             "window — headless here)")
     parser.set_defaults(func=run_cmd)
     return parser
 
@@ -67,6 +66,12 @@ def run_cmd(args, timeout=None):
     dcop = load_dcop_from_file(args.dcop_files)
     cg = load_graph_module(args.graph).build_computation_graph(dcop)
     if args.display:
+        if args.display.endswith((".yaml", ".yml")):
+            # almost certainly a problem file swallowed by --display
+            raise CliError(
+                f"--display expects an image output path, got "
+                f"{args.display!r} (a yaml file — did you mean "
+                f"`--display out.png {args.display}`?)")
         _render(dcop, args.graph, args.display)
     edges_count = len(cg.links)
     nodes_count = len(cg.nodes)

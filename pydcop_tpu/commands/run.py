@@ -34,10 +34,12 @@ def set_parser(subparsers):
     parser.add_argument("--end_metrics", type=str, default=None,
                         help="CSV file to append one end-of-run "
                              "summary row to")
-    parser.add_argument("-i", "--infinity", type=float, default=10000,
-                        help="finite stand-in for infinite costs in "
-                             "reported metrics (reference: "
-                             "run.py:290-297)")
+    parser.add_argument("-i", "--infinity", type=float,
+                        default=float("inf"),
+                        help="stand-in cost for each hard-constraint "
+                             "violation; inf by default, pass a finite "
+                             "value to keep reported costs numeric "
+                             "(reference: run.py:290-297)")
     parser.add_argument("--max_cycles", type=int, default=1_000_000)
     parser.add_argument("--seed", type=int, default=0)
     parser.set_defaults(func=run_cmd)
@@ -77,15 +79,17 @@ def run_cmd(args, timeout=None):
         stop_evt.set()
         collector_thread.join(2)
 
-    cost = res.cost
+    cost, violations = res.cost, res.violations
     if res.assignment and set(res.assignment) == set(dcop.variables):
-        cost, _ = dcop.solution_cost(res.assignment,
-                                     infinity=args.infinity)
+        # cost and violation derive from the same solution_cost call so
+        # the reported pair is always consistent
+        cost, violations = dcop.solution_cost(res.assignment,
+                                              infinity=args.infinity)
     result = {
         "status": res.status,
         "assignment": res.assignment,
         "cost": cost,
-        "violation": res.violations,
+        "violation": violations,
         "cycle": res.cycles,
         "time": time.perf_counter() - t0,
         "msg_count": res.metrics.get("msg_count", 0),

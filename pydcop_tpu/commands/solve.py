@@ -46,10 +46,14 @@ def set_parser(subparsers):
     parser.add_argument("--end_metrics", type=str, default=None,
                         help="CSV file to append one end-of-run summary "
                              "row to (reference: solve.py:162)")
-    parser.add_argument("-i", "--infinity", type=float, default=10000,
-                        help="finite stand-in for infinite costs in "
-                             "reported metrics (hard-constraint "
-                             "violations; reference: solve.py:316-323)")
+    parser.add_argument("-i", "--infinity", type=float,
+                        default=float("inf"),
+                        help="stand-in cost for each hard-constraint "
+                             "violation; by default (inf, like the "
+                             "reference) a violated solution reports "
+                             "cost Infinity — pass a finite value to "
+                             "keep campaign CSVs numeric (reference: "
+                             "solve.py:316-323)")
     parser.add_argument("--delay", type=float, default=None,
                         help="inter-message delay (thread/process mode)")
     parser.add_argument("--uiport", type=int, default=None,
@@ -108,18 +112,19 @@ def run_cmd(args, timeout: Optional[float] = None):
         stop_evt.set()
         collector_thread.join(2)
 
-    cost = res.cost
+    cost, violations = res.cost, res.violations
     if res.assignment and set(res.assignment) == set(dcop.variables):
-        # reported cost uses the finite infinity stand-in: each hard
-        # violation adds args.infinity instead of poisoning the sum
-        # (reference: solve.py:448 + dcop.py:319-369)
-        cost, _ = dcop.solution_cost(res.assignment,
-                                     infinity=args.infinity)
+        # each hard violation is priced at args.infinity (inf by
+        # default); cost and violation come from the SAME solution_cost
+        # call so they can never disagree (reference: solve.py:448 +
+        # dcop.py:319-369)
+        cost, violations = dcop.solution_cost(res.assignment,
+                                              infinity=args.infinity)
     result = {
         "status": res.status,
         "assignment": res.assignment,
         "cost": cost,
-        "violation": res.violations,
+        "violation": violations,
         "cycle": res.cycles,
         "time": time.perf_counter() - t0,
         "msg_count": metrics.get("msg_count", 0),

@@ -150,10 +150,12 @@ class DCOP:
                 else:
                     scoped[v.name] = assignment[v.name]
             c_cost = c(**scoped)
-            if c_cost == float("inf") or (infinity != float("inf")
-                                          and c_cost >= infinity):
+            if c_cost >= infinity:
+                # a violated hard constraint is priced at the infinity
+                # stand-in — inf by default, so an infeasible solution
+                # can never rank below a feasible one on cost
                 violations += 1
-                cost += infinity if infinity != float("inf") else 0
+                cost += infinity
             else:
                 cost += c_cost
         for v_name, v in self.variables.items():

@@ -30,7 +30,10 @@ def load_distribution_module(name: str):
     if name not in DISTRIBUTION_METHODS:
         raise ImportError(
             f"Unknown distribution method {name!r}; "
-            f"available: {DISTRIBUTION_METHODS}"
+            f"available: {DISTRIBUTION_METHODS}. To pass a "
+            f"pre-computed placement *file* instead, its name must "
+            f"end in .yaml/.yml — other filenames are read as method "
+            f"names."
         )
     return import_module(f"pydcop_tpu.distribution.{name}")
 

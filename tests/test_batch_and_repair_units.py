@@ -169,3 +169,51 @@ def test_consolidate_extracts_job_parameters(tmp_path, capsys):
                and r["problem"] == "gc.yaml" and r["iteration"] == "0"
                for r in rows)
     assert all(r["status"] == "FINISHED" for r in rows)
+
+
+def test_consolidate_underscore_values_and_collisions(tmp_path):
+    """Params whose keys or values contain '_' (max_cycles, dsa_b)
+    round-trip intact through the job id, and a job-id key colliding
+    with a measured column (time=...) never overwrites the measured
+    value (ADVICE r3)."""
+    import csv as _csv
+    import json
+    from argparse import Namespace
+
+    from pydcop_tpu.commands.batch import _job_id
+    from pydcop_tpu.commands.consolidate import run_cmd
+
+    job = _job_id("s1", "b1", "gc.yaml",
+                  {"variant": "dsa_b", "max_cycles": "100",
+                   "time": "long"}, 0)
+    p = tmp_path / f"{job}.json"
+    p.write_text(json.dumps(
+        {"status": "FINISHED", "cost": 1.0, "violation": 0,
+         "cycle": 5, "time": 0.25, "msg_count": 10, "msg_size": 99}))
+    out_csv = tmp_path / "all.csv"
+    run_cmd(Namespace(result_files=[str(p)], csv_out=str(out_csv)))
+    with open(out_csv) as f:
+        rows = list(_csv.DictReader(f))
+    assert rows[0]["variant"] == "dsa_b"
+    assert rows[0]["max_cycles"] == "100"
+    assert rows[0]["time"] == "0.25"  # measured, not the job-id 'long'
+
+
+def test_consolidate_legacy_underscore_job_ids(tmp_path):
+    """Old campaigns joined params with '_'; those files still parse
+    (best-effort, as before the separator change)."""
+    import csv as _csv
+    import json
+    from argparse import Namespace
+
+    from pydcop_tpu.commands.consolidate import run_cmd
+
+    p = tmp_path / "s1__b1__gc.yaml__algo=dsa_k=3__0.json"
+    p.write_text(json.dumps(
+        {"status": "FINISHED", "cost": 1.0, "violation": 0,
+         "cycle": 5, "time": 0.1, "msg_count": 10, "msg_size": 99}))
+    out_csv = tmp_path / "all.csv"
+    run_cmd(Namespace(result_files=[str(p)], csv_out=str(out_csv)))
+    with open(out_csv) as f:
+        rows = list(_csv.DictReader(f))
+    assert rows[0]["algo"] == "dsa" and rows[0]["k"] == "3"

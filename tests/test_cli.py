@@ -379,3 +379,44 @@ def test_graph_display_renders_png(gc3_file, tmp_path):
     result = json.loads(proc.stdout)
     assert result["graph"]["nodes_count"] == 5
     assert os.path.getsize(out_png) > 1000  # a real image came out
+
+
+def test_graph_display_rejects_yaml_path(gc3_file):
+    """`graph --display problem.yaml` (the problem file swallowed by
+    --display) fails with a clear error instead of overwriting the yaml
+    with a PNG (ADVICE r3)."""
+    # with a single positional, argparse itself now reports the missing
+    # dcop file (no silent PNG-over-yaml)
+    proc = run_cli("graph", "-g", "factor_graph",
+                   "--display", gc3_file, expect_ok=False)
+    assert proc.returncode != 0
+    assert "dcop_files" in proc.stderr
+    # with two positionals the yaml-suffix guard catches the mistake
+    proc = run_cli("graph", "-g", "factor_graph",
+                   "--display", gc3_file, gc3_file, expect_ok=False)
+    assert proc.returncode != 0
+    assert "image output path" in proc.stderr
+
+
+def test_solve_default_infinity_keeps_large_finite_costs(tmp_path):
+    """By default (-i unset) only costs that are exactly inf count as
+    violations: a legitimate finite cost >= 10000 is reported as-is,
+    not clamped (ADVICE r3 medium)."""
+    big = tmp_path / "big.yaml"
+    big.write_text("""
+name: bigcost
+objective: min
+domains:
+  d: {values: [0]}
+variables:
+  x1: {domain: d}
+  x2: {domain: d}
+constraints:
+  pricey: {type: intention, function: 50000 if x1 == x2 else 0}
+agents: [a1, a2]
+""")
+    proc = run_cli("-t", "30", "solve", "-a", "dsa",
+                   "-p", "stop_cycle:2", str(big))
+    result = json.loads(proc.stdout)
+    assert result["cost"] == 50000.0
+    assert result["violation"] == 0
