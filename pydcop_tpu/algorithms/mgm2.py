@@ -58,38 +58,27 @@ class Mgm2Solver(LocalSearchSolver):
         self.threshold = float(threshold)
         self.favor = favor
 
-        # --- host-side pair-edge compilation -----------------------------
+        # --- host-side pair-edge compilation (vectorized builders
+        # shared with the sharded solver, graphs/arrays.py) --------------
+        from ..graphs.arrays import (out_edge_table, pair_edge_lookup,
+                                     pair_eids_for_bucket)
+
         src = np.asarray(arrays.nbr_src)
         dst = np.asarray(arrays.nbr_dst)
         self.P = len(src)
-        eid = {(int(a), int(b)): i for i, (a, b) in enumerate(zip(src, dst))}
+        lookup = pair_edge_lookup(src, dst, arrays.n_vars)
 
         # per bucket: pair-edge id for each ordered position pair
-        self.pair_eids = []
-        for b in arrays.buckets:
-            a = b.arity
-            m = np.zeros((b.var_ids.shape[0], a, a), dtype=np.int32)
-            for p in range(a):
-                for q in range(a):
-                    if p == q:
-                        continue
-                    for c in range(b.var_ids.shape[0]):
-                        u, v = int(b.var_ids[c, p]), int(b.var_ids[c, q])
-                        m[c, p, q] = eid.get((u, v), 0) if u != v else 0
-            self.pair_eids.append(jnp.asarray(m))
+        self.pair_eids = [
+            jnp.asarray(pair_eids_for_bucket(
+                lookup, np.asarray(b.var_ids)))
+            for b in arrays.buckets
+        ]
 
         # padded per-variable out-edge lists for random partner choice
-        deg = np.zeros(arrays.n_vars, dtype=np.int64)
-        for s in src:
-            deg[s] += 1
-        maxdeg = max(1, int(deg.max()) if len(deg) else 1)
-        out_edges = np.zeros((arrays.n_vars, maxdeg), dtype=np.int32)
-        fill = np.zeros(arrays.n_vars, dtype=np.int64)
-        for i, s in enumerate(src):
-            out_edges[s, fill[s]] = i
-            fill[s] += 1
+        out_edges, deg = out_edge_table(src, arrays.n_vars)
         self.out_edges = jnp.asarray(out_edges)
-        self.out_degree = jnp.asarray(deg.astype(np.int32))
+        self.out_degree = jnp.asarray(deg)
         self.pair_src = jnp.asarray(src.astype(np.int32))
         self.pair_dst = jnp.asarray(dst.astype(np.int32))
 

@@ -273,3 +273,61 @@ class HypergraphArrays:
             max_degree=int(degree.max()) if V else 0,
             max_arity_minus_one=max(0, max_arity - 1),
         )
+
+
+# --------------------------------------------------------------------- pairs
+# Host-side pair-edge table builders shared by the MGM-2 solvers (single
+# chip and sharded).  The directed neighbor-pair edge list (nbr_src,
+# nbr_dst) is the decision plane of coordinated-move algorithms; these
+# compile the per-constraint position pairs onto it with vectorized
+# searchsorted lookups instead of per-constraint Python loops.
+
+
+def pair_edge_lookup(src: np.ndarray, dst: np.ndarray, n_vars: int):
+    """Vectorized ``(u, v) -> directed pair-edge id`` lookup.
+
+    Returns a callable mapping int arrays ``u``, ``v`` (any shape) to the
+    edge id of ``(u, v)`` in the ``(src, dst)`` list, or 0 where the pair
+    is not an edge (callers make slot 0 inert, e.g. by summing all-zero
+    dummy contributions into it).
+    """
+    keys = (np.asarray(src, dtype=np.int64) * (n_vars + 1)
+            + np.asarray(dst, dtype=np.int64))
+    order = np.argsort(keys).astype(np.int64)
+    skeys = keys[order]
+
+    def lookup(u, v):
+        u = np.asarray(u, dtype=np.int64)
+        v = np.asarray(v, dtype=np.int64)
+        k = u * (n_vars + 1) + v
+        if len(skeys) == 0:
+            return np.zeros(k.shape, dtype=np.int32)
+        pos = np.clip(np.searchsorted(skeys, k), 0, len(skeys) - 1)
+        found = skeys[pos] == k
+        return np.where(found, order[pos], 0).astype(np.int32)
+
+    return lookup
+
+
+def pair_eids_for_bucket(lookup, var_ids: np.ndarray) -> np.ndarray:
+    """``(..., arity)`` var ids -> ``(..., arity, arity)`` pair-edge ids
+    (0 on the diagonal and for absent pairs)."""
+    a = var_ids.shape[-1]
+    m = lookup(var_ids[..., :, None], var_ids[..., None, :])
+    m[..., np.eye(a, dtype=bool)] = 0
+    return m
+
+
+def out_edge_table(src: np.ndarray, n_vars: int):
+    """Padded per-variable out-edge lists for random partner choice:
+    ``((n_vars, max_degree) edge ids, (n_vars,) out-degrees)``."""
+    src = np.asarray(src, dtype=np.int64)
+    deg = np.bincount(src, minlength=n_vars) if len(src) \
+        else np.zeros(n_vars, dtype=np.int64)
+    maxdeg = max(1, int(deg.max()) if len(deg) else 1)
+    out_edges = np.zeros((n_vars, maxdeg), dtype=np.int32)
+    if len(src):
+        order = np.argsort(src, kind="stable")
+        slot = np.arange(len(src)) - np.searchsorted(src[order], src[order])
+        out_edges[src[order], slot] = order.astype(np.int32)
+    return out_edges, deg.astype(np.int32)

@@ -18,19 +18,16 @@ def make_mesh(n_devices: int = None, tp: int = None):
     return jax.make_mesh((dp, tp), ("dp", "tp"))
 
 
-__all__ = ["BatchedMaxSum", "ShardedMaxSum", "make_mesh"]
-
-
 def solve_sharded(dcop, algo: str, n_cycles: int = 100,
                   mesh=None, batch: int = None, seed: int = 0,
                   **params):
     """Solve a DCOP on a (dp, tp) device mesh — the multi-chip
     counterpart of ``infrastructure.run.solve``.
 
-    ``algo``: maxsum (edge- or lane-major), dsa or mgm.  ``batch``
-    independent restarts ride the dp axis (default: one per dp row);
-    the best-cost restart is returned.  Returns (assignment dict,
-    cost, cycles).
+    ``algo``: maxsum / amaxsum (edge- or lane-major), dsa, mgm or
+    mgm2.  ``batch`` independent restarts ride the dp axis (default:
+    one per dp row); the best-cost restart is returned.  Returns
+    (assignment dict, cost, cycles).
     """
     import numpy as np
 
@@ -42,11 +39,12 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
     if batch is None:
         batch = mesh.shape["dp"]
 
-    if algo == "maxsum":
+    if algo in ("maxsum", "amaxsum"):
         arrays = FactorGraphArrays.build(dcop)
         from .sharded_maxsum import ShardedAMaxSum, ShardedMaxSum
 
-        solver = ShardedMaxSum(arrays, mesh, batch=batch, **params)
+        cls = ShardedAMaxSum if algo == "amaxsum" else ShardedMaxSum
+        solver = cls(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo == "dsa":
         arrays = HypergraphArrays.build(filter_dcop(dcop))
@@ -60,9 +58,15 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
         solver = ShardedMgm(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
+    elif algo == "mgm2":
+        arrays = HypergraphArrays.build(filter_dcop(dcop))
+        from .sharded_mgm2 import ShardedMgm2
+
+        solver = ShardedMgm2(arrays, mesh, batch=batch, **params)
+        sel, cycles = solver.run(n_cycles, seed=seed)
     else:
         raise ValueError(
-            f"solve_sharded supports maxsum/amaxsum/dsa/mgm, "
+            f"solve_sharded supports maxsum/amaxsum/dsa/mgm/mgm2, "
             f"not {algo!r}")
 
     variables = [dcop.variable(n) for n in arrays.var_names]
@@ -81,5 +85,7 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
     return best_assignment, best_cost, cycles
 
 
+from .sharded_mgm2 import ShardedMgm2  # noqa: E402
+
 __all__ = ["BatchedMaxSum", "ShardedAMaxSum", "ShardedMaxSum",
-           "make_mesh", "solve_sharded"]
+           "ShardedMgm2", "make_mesh", "solve_sharded"]

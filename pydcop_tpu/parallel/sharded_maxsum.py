@@ -104,7 +104,8 @@ class ShardedMaxSum:
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
-                 layout: str = "auto", batch: int = 1):
+                 layout: str = "auto", batch: int = 1,
+                 use_pallas: Optional[bool] = None):
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -134,6 +135,17 @@ class ShardedMaxSum:
                 sb.arity > 2 for sb in shard_buckets):
             raise ValueError("lane_major needs arities <= 2")
         self.layout = layout
+        if use_pallas is None:
+            # same measured default as the single-chip lane solver
+            # (algorithms/maxsum.py:266-272): the fused kernel wins in
+            # isolation but blocks XLA's elementwise fusion around it,
+            # so the all-jnp step is faster on the benched chip; the
+            # kernel stays available for larger domains / other chips
+            use_pallas = False
+        self.use_pallas = bool(use_pallas)
+        # off-TPU the fused kernel runs in pallas interpret mode so the
+        # kernel path itself is testable on the virtual CPU mesh
+        self._pallas_interpret = jax.default_backend() != "tpu"
 
         vc = np.concatenate(
             [arrays.var_costs,
@@ -199,9 +211,14 @@ class ShardedMaxSum:
             jnp.concatenate(blocks, axis=0)
 
     def _factor_update_lane_major(self, qT, cubes):
-        """(D, E) layout: lane kernels, same math as MaxSumLaneSolver."""
-        from ..ops.pallas_kernels import \
-            factor_messages_binary_lane_major_ref
+        """(D, E) layout: lane kernels, same math as MaxSumLaneSolver —
+        including the fused pallas kernel when ``use_pallas`` is set
+        (one kernel per bucket instead of the broadcast-add/min chain;
+        the shard-local update is identical to the single-chip dispatch
+        at maxsum.py:308-334)."""
+        from ..ops.pallas_kernels import (
+            factor_messages_binary_lane_major,
+            factor_messages_binary_lane_major_ref)
 
         D, E = self.D, self.E_loc
         blocks = []
@@ -216,7 +233,12 @@ class ShardedMaxSum:
             cubesT = jnp.transpose(cu, (1, 2, 0))           # (D, D, F)
             q_blk = qT[:, sb.offset:sb.offset + 2 * f]
             q0, q1 = q_blk[:, 0::2], q_blk[:, 1::2]
-            m0, m1 = factor_messages_binary_lane_major_ref(cubesT, q0, q1)
+            if self.use_pallas:
+                m0, m1 = factor_messages_binary_lane_major(
+                    cubesT, q0, q1, interpret=self._pallas_interpret)
+            else:
+                m0, m1 = factor_messages_binary_lane_major_ref(
+                    cubesT, q0, q1)
             blocks.append(jnp.stack([m0, m1], axis=2)
                           .reshape(D, 2 * f))
         if not blocks:
@@ -288,6 +310,9 @@ class ShardedMaxSum:
                 P(), P(), P(),
             ),
             out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
+            # pallas_call cannot declare vma on its outputs yet, so the
+            # varying-mesh-axis check must be off for the kernel path
+            check_vma=False,
         )
         def sharded(q, r, key, edge_var, cubes, var_costs,
                     domain_mask, domain_size):

@@ -1,0 +1,346 @@
+"""Multi-chip MGM-2: the 5-phase coordinated-move machine over a
+dp x tp mesh.
+
+Closes the round-3 gap: MGM-2 (the BASELINE config-4 algorithm,
+reference pydcop/algorithms/mgm2.py:435 — the value / offer / answer /
+gain / go state machine) was the only major family with no scale-out
+path.  The sharding follows :mod:`sharded_localsearch`: constraints are
+partitioned across ``tp`` (each device enumerates its shard's
+constraint slices), and the two expensive tensors — the ``(V, D)``
+candidate-cost matrix ``L`` and the ``(P, D, D)`` shared-pair slice
+tensor ``S`` over the directed neighbor-pair edges — are assembled with
+one ``psum`` over ``tp`` each (the collectives ride ICI).  The 5-phase
+decision logic (roles, offers, answers, announced gains, go) runs
+replicated per device on the small reduced state, exactly as in the
+single-chip :class:`~pydcop_tpu.algorithms.mgm2.Mgm2Solver`; ``dp``
+shards independent instances.
+
+Selection equality: each instance's PRNG chain replicates the
+single-chip solver's (``init_state`` split + 5-way step split), and the
+phase arithmetic is the same ops in the same order, so for integer-cost
+instances a sharded run is bit-identical to a single-chip engine run
+with the same seed (asserted in tests/test_parallel.py).
+"""
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..graphs.arrays import (BIG, HypergraphArrays, out_edge_table,
+                             pair_edge_lookup, pair_eids_for_bucket)
+from ..ops.kernels import candidate_costs
+from .sharded_localsearch import _partition_constraints
+
+_EPS = 1e-6
+
+
+class ShardedMgm2:
+    """MGM-2 over a (dp, tp) mesh; ``batch`` independent instances.
+
+    Parameters mirror the single-chip solver: ``threshold`` (offerer
+    probability) and ``favor`` (tie policy between unilateral and
+    coordinated moves).
+    """
+
+    def __init__(self, arrays: HypergraphArrays, mesh,
+                 threshold: float = 0.5, favor: str = "unilateral",
+                 batch: int = 1):
+        self.mesh = mesh
+        self.tp = mesh.shape["tp"]
+        self.dp = mesh.shape["dp"]
+        if batch % self.dp != 0:
+            raise ValueError(
+                f"batch {batch} must be a multiple of dp={self.dp}")
+        self.B = batch
+        self.V = arrays.n_vars
+        self.D = arrays.max_domain
+        self.threshold = float(threshold)
+        self.favor = favor
+        self.var_names = arrays.var_names
+
+        self.sharded_buckets = _partition_constraints(arrays, self.tp)
+
+        # ---- pair-edge decision plane (replicated; same builders as
+        # the single-chip solver) ------------------------------------
+        src = np.asarray(arrays.nbr_src, dtype=np.int32)
+        dst = np.asarray(arrays.nbr_dst, dtype=np.int32)
+        self.has_neighbors = len(src) > 0
+        # keep at least one (inert) edge so every P-sized op has a
+        # static nonzero shape; dummy contributions sum zeros into it
+        if len(src) == 0:
+            src = np.zeros(1, dtype=np.int32)
+            dst = np.zeros(1, dtype=np.int32)
+        self.P = len(src)
+        lookup = pair_edge_lookup(src, dst, self.V) \
+            if self.has_neighbors else (lambda u, v: np.zeros(
+                np.broadcast_shapes(np.shape(u), np.shape(v)),
+                dtype=np.int32))
+        # per sharded bucket: (TP, F, a, a) pair-edge ids; dummy slots
+        # (sink var ids) resolve to 0, where their all-zero cubes land
+        self.pair_eids = [
+            pair_eids_for_bucket(lookup, var_ids)
+            for _a, _c, var_ids in self.sharded_buckets
+        ]
+        out_edges, deg = out_edge_table(
+            src if self.has_neighbors else src[:0], self.V)
+        self.out_edges = out_edges
+        self.out_degree = deg
+        self.pair_src = src
+        self.pair_dst = dst
+
+        self.var_costs = np.asarray(arrays.var_costs)       # (V, D)
+        self.domain_mask = np.asarray(arrays.domain_mask)   # (V, D)
+        self.domain_size = np.asarray(arrays.domain_size)
+        self.initial_idx = np.asarray(arrays.initial_idx)
+        self.has_initial = np.asarray(arrays.has_initial)
+
+        self._build_step()
+
+    # ------------------------------------------------------------- init
+
+    def _init_instance(self, seed: int):
+        """Replicates ``Mgm2Solver.init_state`` bit-for-bit: split the
+        instance key, draw the random start (LocalSearchSolver
+        .random_values)."""
+        key, sub = jax.random.split(jax.random.PRNGKey(int(seed)))
+        r = jax.random.uniform(sub, (self.V,))
+        rand_idx = (r * self.domain_size).astype(jnp.int32)
+        x = jnp.where(jnp.asarray(self.has_initial),
+                      jnp.asarray(self.initial_idx), rand_idx)
+        return np.asarray(x), np.asarray(key)
+
+    # ------------------------------------------------------------- step
+
+    def _shared_slices_local(self, x_ext, cubes, var_ids_l, pair_eids_l):
+        """Shard-local part of the (P, D, D) shared-pair slice tensor
+        (same per-bucket arithmetic as ``Mgm2Solver.shared_slices``)."""
+        D, Pn = self.D, self.P
+        S = jnp.zeros((Pn, D, D))
+        for (a, _c, _v), cu, vi, peid in zip(
+                self.sharded_buckets, cubes, var_ids_l, pair_eids_l):
+            if a < 2:
+                continue
+            C = cu.shape[0]
+            vals = x_ext[vi]
+            for p in range(a):
+                for q in range(a):
+                    if p == q:
+                        continue
+                    t = jnp.moveaxis(cu, p + 1, a)      # p -> last
+                    q_axis = q + 1 if q < p else q
+                    t = jnp.moveaxis(t, q_axis, a - 1)
+                    t = t.reshape(C, -1, D, D)
+                    idx = jnp.zeros((C,), dtype=jnp.int32)
+                    for r in range(a):
+                        if r != p and r != q:
+                            idx = idx * D + vals[:, r]
+                    contrib = t[jnp.arange(C), idx]     # (C, D_q, D_p)
+                    contrib = jnp.swapaxes(contrib, 1, 2)
+                    S = S + jax.ops.segment_sum(
+                        contrib, peid[:, p, q], num_segments=Pn)
+        return S
+
+    def _build_step(self):
+        V, D, Pn = self.V, self.D, self.P
+        threshold, favor = self.threshold, self.favor
+        has_neighbors = self.has_neighbors
+        arities = [a for a, _, _ in self.sharded_buckets]
+
+        def one(x1, k1, cubes, var_ids_l, pair_eids_l, var_costs,
+                domain_mask, out_edges, out_degree, pair_src, pair_dst):
+            key, k_best, k_role, k_pick, k_tie = jax.random.split(k1, 5)
+            ar = jnp.arange(V)
+            # dummy constraints point at the sink id V: extend x
+            x_ext = jnp.concatenate(
+                [x1, jnp.zeros((1,), dtype=x1.dtype)])
+
+            # phase 1: local view (psum-assembled candidate costs, then
+            # the exact best_response arithmetic of LocalSearchSolver)
+            cand = jnp.zeros((V + 1, D))
+            for a, cu, vi in zip(arities, cubes, var_ids_l):
+                cand = cand + candidate_costs(cu, vi, x_ext, V + 1)
+            cand = jax.lax.psum(cand, "tp")[:V]
+            costs = var_costs + cand
+            cur = costs[ar, x1]
+            c = jnp.where(domain_mask, costs, BIG * 2)
+            best_cost = jnp.min(c, axis=-1)
+            is_min = (c <= best_cost[:, None] + 1e-9) & domain_mask
+            not_cur = is_min & ~jax.nn.one_hot(x1, D, dtype=bool)
+            has_other = jnp.any(not_cur, axis=-1)
+            pick_from = jnp.where(has_other[:, None], not_cur, is_min)
+            noise = jax.random.uniform(k_best, c.shape)
+            best_val = jnp.argmax(pick_from * (1.0 + noise), axis=-1)
+            solo_gain = cur - best_cost
+            L = costs
+
+            # phase 2: roles + offers (Mgm2Solver.step phase 2)
+            offerer = jax.random.uniform(k_role, (V,)) < threshold
+            pick = (jax.random.uniform(k_pick, (V,))
+                    * jnp.maximum(out_degree, 1)).astype(jnp.int32)
+            chosen_edge = out_edges[ar, pick]
+            has_nbr = out_degree > 0
+
+            S = jax.lax.psum(
+                self._shared_slices_local(
+                    x_ext, cubes, var_ids_l, pair_eids_l), "tp")
+            o, t = pair_src, pair_dst
+            pair_cost = (
+                L[o][:, :, None] + L[t][:, None, :]
+                - S[jnp.arange(Pn), :, x1[t]][:, :, None]
+                - S[jnp.arange(Pn), x1[o], :][:, None, :]
+                + S
+            )
+            mask2 = (domain_mask[o][:, :, None]
+                     & domain_mask[t][:, None, :])
+            pair_cost = jnp.where(mask2, pair_cost, BIG * 2)
+            pair_cur = cur[o] + cur[t] - S[jnp.arange(Pn), x1[o], x1[t]]
+            flat = pair_cost.reshape(Pn, -1)
+            pair_best = jnp.min(flat, axis=1)
+            pair_arg = jnp.argmin(flat, axis=1)
+            pair_d1 = pair_arg // D
+            pair_d2 = pair_arg % D
+            pair_gain = pair_cur - pair_best
+
+            is_offer = (offerer[o] & has_nbr[o]
+                        & (chosen_edge[o] == jnp.arange(Pn))
+                        & ~offerer[t] & (pair_gain > _EPS))
+
+            # phase 3: answers
+            tie = jax.random.uniform(k_tie, (Pn,))
+            offer_score = jnp.where(
+                is_offer, pair_gain + tie * _EPS, -jnp.inf)
+            best_offer_at = jax.ops.segment_max(
+                offer_score, t, num_segments=V)
+            accepted = is_offer & (offer_score >= best_offer_at[t]) \
+                & jnp.isfinite(best_offer_at[t])
+
+            in_pair_src = jax.ops.segment_max(
+                accepted.astype(jnp.int32), o, num_segments=V) > 0
+            in_pair_dst = jax.ops.segment_max(
+                accepted.astype(jnp.int32), t, num_segments=V) > 0
+            in_pair = in_pair_src | in_pair_dst
+            eidx = jnp.arange(Pn)
+            edge_of_src = jax.ops.segment_max(
+                jnp.where(accepted, eidx, -1), o, num_segments=V)
+            edge_of_dst = jax.ops.segment_max(
+                jnp.where(accepted, eidx, -1), t, num_segments=V)
+            my_edge = jnp.maximum(edge_of_src, edge_of_dst)
+            partner = jnp.where(
+                in_pair_src, t[jnp.clip(my_edge, 0)],
+                o[jnp.clip(my_edge, 0)])
+
+            # phase 4: announced gains
+            favor_bonus = {"unilateral": -_EPS, "coordinated": _EPS,
+                           "no": 0.0}[favor]
+            g_pair = pair_gain[jnp.clip(my_edge, 0)] + favor_bonus
+            announced = jnp.where(
+                in_pair, g_pair,
+                jnp.where(offerer, 0.0, solo_gain))
+
+            # phase 5: go — strict max in neighborhood
+            exclude = in_pair[pair_dst] \
+                & (pair_src == partner[pair_dst])
+            nbr_gain = jnp.where(
+                exclude, -jnp.inf, announced[pair_src])
+            nbr_max = jax.ops.segment_max(
+                nbr_gain, pair_dst, num_segments=V) \
+                if has_neighbors else jnp.full((V,), -jnp.inf)
+
+            my_go = announced > nbr_max + _EPS
+            partner_go = my_go[partner]
+            pair_moves = in_pair & my_go & partner_go \
+                & (announced > _EPS)
+            solo_moves = (~in_pair) & (~offerer) \
+                & (solo_gain > _EPS) & my_go
+
+            pair_val = jnp.where(
+                in_pair_src, pair_d1[jnp.clip(my_edge, 0)],
+                pair_d2[jnp.clip(my_edge, 0)])
+            x_new = jnp.where(pair_moves, pair_val,
+                              jnp.where(solo_moves, best_val, x1))
+            return x_new, key
+
+        @partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(
+                P("dp"), P("dp"),
+                [P("tp") for _ in self.sharded_buckets],
+                [P("tp") for _ in self.sharded_buckets],
+                [P("tp") for _ in self.sharded_buckets],
+                P(), P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P("dp"), P("dp")),
+        )
+        def sharded(x, keys, cubes, var_ids, pair_eids, var_costs,
+                    domain_mask, out_edges, out_degree, pair_src,
+                    pair_dst):
+            cubes_l = [c[0] for c in cubes]
+            vids_l = [v[0] for v in var_ids]
+            peids_l = [p[0] for p in pair_eids]
+            return jax.vmap(
+                lambda x1, k1: one(
+                    x1, k1, cubes_l, vids_l, peids_l, var_costs,
+                    domain_mask, out_edges, out_degree, pair_src,
+                    pair_dst))(x, keys)
+
+        self._step = jax.jit(sharded)
+
+    # -------------------------------------------------------------- run
+
+    def _device_put(self, seeds: Sequence[int]):
+        mesh = self.mesh
+        inits = [self._init_instance(s) for s in seeds]
+        x0 = np.stack([x for x, _ in inits]).astype(np.int32)
+        k0 = np.stack([k for _, k in inits])
+        x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
+        keys = jax.device_put(k0, NamedSharding(mesh, P("dp")))
+        consts = (
+            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+             for _, c, _ in self.sharded_buckets],
+            [jax.device_put(v, NamedSharding(mesh, P("tp")))
+             for _, _, v in self.sharded_buckets],
+            [jax.device_put(pe, NamedSharding(mesh, P("tp")))
+             for pe in self.pair_eids],
+            jax.device_put(jnp.asarray(self.var_costs),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.domain_mask),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.out_edges),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.out_degree),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.pair_src),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.asarray(self.pair_dst),
+                           NamedSharding(mesh, P())),
+        )
+        return x, keys, consts
+
+    def run(self, n_cycles: int, seed: int = 0,
+            seeds: Optional[Sequence[int]] = None
+            ) -> Tuple[np.ndarray, int]:
+        """Returns ((B, V) selections, cycles run).  ``seeds`` gives
+        each instance its own engine seed (default ``seed + i``); an
+        instance's run is then bit-identical to a single-chip
+        ``SyncEngine(Mgm2Solver(...)).run(key=that_seed)``."""
+        if seeds is None:
+            seeds = [seed + i for i in range(self.B)]
+        if len(seeds) != self.B:
+            raise ValueError(
+                f"need {self.B} seeds, got {len(seeds)}")
+        x, keys, consts = self._device_put(seeds)
+        for _ in range(n_cycles):
+            x, keys = self._step(x, keys, *consts)
+        return np.asarray(jax.device_get(x)), n_cycles
+
+    def step_once(self, seed: int = 0) -> np.ndarray:
+        """One sharded step (compile-check of the multi-chip path)."""
+        x, keys, consts = self._device_put(
+            [seed + i for i in range(self.B)])
+        x, keys = self._step(x, keys, *consts)
+        jax.block_until_ready(x)
+        return np.asarray(jax.device_get(x))
