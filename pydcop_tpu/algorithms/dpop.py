@@ -145,9 +145,21 @@ def _pack_input(arr: np.ndarray, dims, out_dims, sizes):
     return arr, positions
 
 
+def _spine_mesh():
+    """Default 1-axis ("tp") mesh over every visible device, used to
+    shard oversized UTIL tables; None when only one device exists."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return jax.sharding.Mesh(np.array(devs), ("tp",))
+
+
 def device_util_sweep(g, var_cost_rel, mode: str,
                       memory_limit: int = 10 ** 8,
-                      node_device_cells: int = 200_000):
+                      node_device_cells: int = 200_000,
+                      mesh=None):
     """Hybrid UTIL/VALUE split: the pseudo-tree *spine* — every node
     whose table crosses ``node_device_cells`` plus all its ancestors up
     to the root — runs as ONE jitted device program (joins, projections
@@ -174,18 +186,36 @@ def device_util_sweep(g, var_cost_rel, mode: str,
     sizes = _domain_sizes(g)
 
     # ---- spine membership: big nodes + ancestors (upward-closed) ----
+    # A table beyond one device's memory_limit is sharded over the tp
+    # mesh (leading separator axis carries a NamedSharding) instead of
+    # failing — the multi-chip escape hatch for wide separators
+    # (reference dpop.py:313-377 joins at beyond-one-chip scale).
     cells_of = {}
+    oversized = set()
     for name, plan in plans.items():
         cells_of[name] = int(np.prod(
             [sizes[d] for d in plan["out_dims"]]))
         if cells_of[name] > memory_limit:
-            raise MemoryError(
-                f"DPOP UTIL table for {name} exceeds memory limit")
+            oversized.add(name)
+    if oversized:
+        if mesh is None:
+            mesh = _spine_mesh()
+        ntp = mesh.shape["tp"] if mesh is not None else 1
+        for name in sorted(oversized):
+            per_device = (cells_of[name] + ntp - 1) // ntp
+            if mesh is None or per_device > memory_limit:
+                raise MemoryError(
+                    f"DPOP UTIL table for {name} exceeds memory limit "
+                    f"({cells_of[name]} cells"
+                    + (f", {per_device} per device over tp={ntp}"
+                       if mesh is not None else ", single device")
+                    + ")")
     spine = set()
     for level in reversed(g.depth_ordered()):
         for node in level:
-            if cells_of[node.name] >= node_device_cells or any(
-                    c in spine for c in node.children):
+            if (cells_of[node.name] >= node_device_cells
+                    or node.name in oversized or any(
+                    c in spine for c in node.children)):
                 spine.add(node.name)
 
     def np_reduce_last(total):
@@ -223,11 +253,13 @@ def device_util_sweep(g, var_cost_rel, mode: str,
     spine_assignment = {}
     if spine:
         spine_assignment = _run_spine(
-            g, plans, sizes, spine, util_of, mode)
+            g, plans, sizes, spine, util_of, mode,
+            mesh=mesh if oversized else None, oversized=oversized)
     return plans, host_joined, spine_assignment
 
 
-def _run_spine(g, plans, sizes, spine, host_util_of, mode):
+def _run_spine(g, plans, sizes, spine, host_util_of, mode,
+               mesh=None, oversized=frozenset()):
     """Compile + run the spine as one device program.  The jitted
     function takes every external input table as an argument (host
     utils of the spine's children, constraint matrices, unary costs),
@@ -280,6 +312,20 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
 
     dom_sizes = sizes
 
+    # oversized tables carry a NamedSharding over the tp mesh on their
+    # leading separator axis; XLA/GSPMD partitions the joins, the
+    # projection reduce_window and the VALUE slicing accordingly, so
+    # the table never materializes on one device
+    shard_spec = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        for name, out_dims, packed, _inputs in node_specs:
+            if name in oversized and packed and len(out_dims) >= 2:
+                ndim = len(out_dims) - 1  # packed: minor pair merged
+                shard_spec[name] = NamedSharding(
+                    mesh, PartitionSpec("tp", *([None] * (ndim - 1))))
+
     def spine_fn(*args):
         util = {}
         joined = {}
@@ -303,6 +349,9 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
                         arr, sep_layout[ref], out_dims, dom_sizes)
                 total = total + jax.lax.broadcast_in_dim(
                     arr, shape, positions)
+            if name in shard_spec:
+                total = jax.lax.with_sharding_constraint(
+                    total, shard_spec[name])
             joined[name] = total
             if packed:
                 window = (1,) * (total.ndim - 1) + (s_own,)
@@ -340,7 +389,11 @@ def _run_spine(g, plans, sizes, spine, host_util_of, mode):
             out.append(idx)
         return jnp.stack(out)
 
-    sig = (mode, tuple(
+    sig = (mode,
+           None if mesh is None else
+           (tuple(sorted(oversized)), tuple(d.id for d in
+                                            mesh.devices.flat)),
+           tuple(
         (name, tuple(out_dims), packed,
          tuple((k, r if k == "spine" else ext_arrays[r].shape, p)
                for k, r, p in inputs))
@@ -410,13 +463,17 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
                  memory_limit: int = 10 ** 8,
                  timeout: Optional[float] = None,
                  device: str = "auto",
+                 mesh=None,
                  **_kwargs) -> RunResult:
     """Run DPOP to optimality (or TIMEOUT with an empty assignment —
     DPOP has no meaningful anytime solution mid-UTIL-sweep).
 
     ``device``: "host" = vectorized numpy joins; "jax" = the batched
     device UTIL sweep (:func:`device_util_sweep`); "auto" picks the
-    device once the predicted UTIL work crosses ``DEVICE_AUTO_CELLS``.
+    device once the predicted UTIL work crosses ``DEVICE_AUTO_CELLS``
+    or any single UTIL table exceeds one device's ``memory_limit``
+    (the jax path shards such tables over the ``mesh`` — default: all
+    visible devices on a "tp" axis).
     """
     import time
 
@@ -439,14 +496,17 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
     if device == "auto":
         sizes = _domain_sizes(g)
-        cells = 0
+        cells, max_node_cells = 0, 0
         for name, plan in _util_plans(g, var_cost_rel).items():
-            cells += int(np.prod([sizes[d]
-                                  for d in plan["out_dims"]]))
-        device = "jax" if cells >= DEVICE_AUTO_CELLS else "host"
+            node_cells = int(np.prod([sizes[d]
+                                      for d in plan["out_dims"]]))
+            cells += node_cells
+            max_node_cells = max(max_node_cells, node_cells)
+        device = "jax" if (cells >= DEVICE_AUTO_CELLS
+                           or max_node_cells > memory_limit) else "host"
     if device == "jax":
         return _solve_device(dcop, g, var_cost_rel, mode, memory_limit,
-                             t0, timeout)
+                             t0, timeout, mesh=mesh)
 
     levels = g.depth_ordered()
     util_of: Dict[str, Any] = {}
@@ -512,14 +572,14 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
 
 
 def _solve_device(dcop, g, var_cost_rel, mode, memory_limit, t0,
-                  timeout):
+                  timeout, mesh=None):
     """Device path: the wide spine runs as one jitted device program
     (UTIL joins + VALUE argmins); the host finishes the VALUE walk for
     the small subtrees below it."""
     import time
 
     plans, host_joined, spine_assignment = device_util_sweep(
-        g, var_cost_rel, mode, memory_limit=memory_limit)
+        g, var_cost_rel, mode, memory_limit=memory_limit, mesh=mesh)
     levels = g.depth_ordered()
     dom_index = {
         node.name: {v: i for i, v in
