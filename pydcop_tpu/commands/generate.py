@@ -79,6 +79,29 @@ def set_parser(subparsers):
     sw.add_argument("--seed", type=int, default=None)
     sw.set_defaults(func=_gen_small_world)
 
+    mixed = sub.add_parser(
+        "mixed_problem",
+        help="weighted-sum problem with a fraction of hard "
+             "constraints (reference: generate.py:449)")
+    mixed.add_argument("-v", "--variable_count", type=int,
+                       required=True)
+    mixed.add_argument("-c", "--constraint_count", type=int, default=0,
+                       help="number of constraints (ignored for arity "
+                            "2, where the graph's edges are the "
+                            "constraints)")
+    mixed.add_argument("-H", "--hard_constraint", type=float,
+                       required=True,
+                       help="proportion of hard constraints in [0, 1]")
+    mixed.add_argument("-A", "--arity", type=int, default=2)
+    mixed.add_argument("-r", "--range", type=int, default=10,
+                       dest="domain_range",
+                       help="variable domain: 0 .. r-1")
+    mixed.add_argument("-d", "--density", type=float, default=0.3)
+    mixed.add_argument("-a", "--agents", type=int, default=None)
+    mixed.add_argument("--capacity", type=int, default=0)
+    mixed.add_argument("--seed", type=int, default=None)
+    mixed.set_defaults(func=_gen_mixed)
+
     agts = sub.add_parser("agents")
     agts.add_argument("--count", type=int, default=None)
     agts.add_argument("--dcop_files", nargs="*", default=None)
@@ -172,6 +195,19 @@ def _gen_small_world(args, timeout=None):
     dcop = generate_small_world(
         args.variables_count, k=args.k, p=args.p,
         colors_count=args.colors_count, seed=args.seed)
+    _emit(args, dcop_yaml(dcop))
+    return 0
+
+
+def _gen_mixed(args, timeout=None):
+    from ..dcop.yamldcop import dcop_yaml
+    from ..generators.mixed import generate_mixed_problem
+
+    dcop = generate_mixed_problem(
+        args.variable_count, args.constraint_count,
+        hard_proportion=args.hard_constraint, arity=args.arity,
+        domain_range=args.domain_range, density=args.density,
+        agents=args.agents, capacity=args.capacity, seed=args.seed)
     _emit(args, dcop_yaml(dcop))
     return 0
 

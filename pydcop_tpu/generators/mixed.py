@@ -1,0 +1,221 @@
+"""Mixed soft/hard constraint problem generator.
+
+reference parity: pydcop/commands/generate.py:449-748
+(``generate_mixed_problem``): weighted-sum constraints over a random
+structure — unary chains (arity 1), a connected random graph (arity 2)
+or a random variable/constraint bipartite incidence (arity > 2) — with
+a ``hard_proportion`` fraction of the constraints *hard* (cost
+``inf`` away from a reachable objective) and the rest *soft* (absolute
+deviation from a random target).  This is the reference's benchmark
+family for hard-constraint-heavy problems, the home turf of
+mixeddsa / dba.
+"""
+
+import random
+from typing import Dict, List, Optional
+
+from ..dcop.dcop import DCOP
+from ..dcop.objects import AgentDef, Domain, Variable
+from ..dcop.relations import constraint_from_str
+
+
+def _weight(rng) -> float:
+    """A random nonzero weight in (0, 1], 2 decimals (reference:
+    generate.py:770-775 choose_weight)."""
+    w = 0.0
+    while w == 0.0:
+        w = round(rng.uniform(0, 1), 2)
+    return w
+
+
+def _reachable_objective(weights: List[float], values_top: int,
+                         rng) -> float:
+    """A target the weighted sum can actually hit: evaluate it at a
+    random domain point, so every hard constraint is satisfiable
+    (reference: generate.py:816-827 find_objective)."""
+    return round(sum(w * rng.choice(range(max(1, values_top)))
+                     for w in weights), 2)
+
+
+def _sum_expr(var_names: List[str], weights: List[float]) -> str:
+    return " + ".join(
+        f"{w}*{n}" if w != 1 else n
+        for n, w in zip(var_names, weights))
+
+
+def _unary_constraints(variable_count, hard_count, domain_range, rng):
+    """Arity 1: one constraint per variable, pairing shuffled so the
+    hard ones land on random variables."""
+    order = list(range(variable_count))
+    rng.shuffle(order)
+    specs = {}
+    for rank, n in enumerate(order):
+        w = _weight(rng)
+        hard = rank < hard_count
+        if hard:
+            obj = _reachable_objective([w], domain_range - 1, rng)
+            expr = f"float('inf') if {w}*v{n} != {obj} else 0"
+        else:
+            obj = round(rng.uniform(0, domain_range - 1), 2)
+            expr = f"{w}*v{n} - {obj}"
+        specs[f"c{rank}"] = (expr, [f"v{n}"])
+    return specs
+
+
+def _binary_constraints(variable_count, density, hard_proportion,
+                        domain_range, rng):
+    """Arity 2: edges of a connected G(n, p) graph; a hard edge is an
+    inequality constraint, a soft edge penalises the distance of the
+    endpoint sum from a random target."""
+    import networkx as nx
+
+    for attempt in range(100):
+        g = nx.gnp_random_graph(
+            variable_count, density, seed=rng.randrange(2 ** 31))
+        if nx.is_connected(g):
+            break
+    else:
+        raise ValueError(
+            f"could not draw a connected graph at density {density}; "
+            f"raise -d")
+    edges = list(g.edges())
+    hard_count = int(round(hard_proportion * len(edges)))
+    specs = {}
+    for i, (u, v) in enumerate(edges):
+        if i < hard_count:
+            expr = f"0 if v{u} != v{v} else float('inf')"
+        else:
+            w0, w1 = _weight(rng), _weight(rng)
+            target = round(rng.uniform(0, (w0 + w1) * domain_range), 2)
+            expr = f"abs(v{u} + v{v} - {target})"
+        specs[f"c{i}"] = (expr, [f"v{u}", f"v{v}"])
+    return specs
+
+
+def _nary_incidence(variable_count, constraint_count, arity,
+                    edges_target, rng) -> Dict[int, List[int]]:
+    """Random variable/constraint bipartite incidence: every variable
+    appears somewhere, every constraint has at least one variable, no
+    constraint exceeds ``arity`` members, extra memberships are drawn
+    uniformly from the remaining open slots."""
+    members: Dict[int, List[int]] = {c: [] for c in
+                                     range(constraint_count)}
+    open_pairs = {(v, c) for v in range(variable_count)
+                  for c in range(constraint_count)}
+
+    def attach(v, c):
+        members[c].append(v)
+        open_pairs.discard((v, c))
+        if len(members[c]) == arity:
+            for vv in range(variable_count):
+                open_pairs.discard((vv, c))
+
+    # every variable into a random not-full constraint
+    for v in range(variable_count):
+        candidates = [c for c in members if len(members[c]) < arity]
+        attach(v, rng.choice(candidates))
+    # every still-empty constraint gets a random variable
+    for c in range(constraint_count):
+        if not members[c]:
+            attach(rng.randrange(variable_count), c)
+    # fill up to the density target
+    budget = edges_target - sum(len(m) for m in members.values())
+    while budget > 0 and open_pairs:
+        v, c = rng.choice(sorted(open_pairs))
+        attach(v, c)
+        budget -= 1
+    return members
+
+
+def _nary_constraints(variable_count, constraint_count, arity,
+                      density, hard_count, domain_range, rng):
+    edges_target = int(
+        constraint_count * min(arity, variable_count) * density)
+    members = _nary_incidence(variable_count, constraint_count, arity,
+                              edges_target, rng)
+    specs = {}
+    for c, vs in members.items():
+        names = [f"v{v}" for v in vs]
+        weights = [_weight(rng) for _ in vs]
+        body = _sum_expr(names, weights)
+        if c < hard_count:
+            obj = _reachable_objective(weights, domain_range, rng)
+            expr = f"0 if {body} == {obj} else float('inf')"
+        else:
+            obj = round(rng.uniform(0, len(weights) * domain_range), 2)
+            expr = f"abs({body} - {obj})" if obj else body
+        specs[f"c{c}"] = (expr, names)
+    return specs
+
+
+def generate_mixed_problem(
+        variable_count: int, constraint_count: int,
+        hard_proportion: float, arity: int = 2,
+        domain_range: int = 10, density: float = 0.3,
+        agents: Optional[int] = None, capacity: int = 0,
+        seed: Optional[int] = None) -> DCOP:
+    """Generate a mixed soft/hard weighted-sum problem
+    (reference: generate.py:449 generate_mixed_problem).
+
+    ``hard_proportion`` of the constraints are hard (infinite cost off
+    a reachable objective), the rest soft.  ``arity`` selects the
+    structure: 1 = one unary constraint per variable, 2 = edges of a
+    connected random graph at ``density``, >2 = a random bipartite
+    incidence capped at ``arity`` variables per constraint.
+    """
+    if arity < 1:
+        raise ValueError(f"arity must be >= 1, got {arity}")
+    if arity > variable_count:
+        raise ValueError(
+            f"arity {arity} exceeds the variable count "
+            f"{variable_count}")
+    if not 0 <= hard_proportion <= 1:
+        raise ValueError(
+            f"hard_proportion must be in [0, 1], got "
+            f"{hard_proportion}")
+    if arity != 2 and constraint_count <= 0:
+        # arity 2 takes its constraint count from the graph's edges
+        # (like the reference, generate.py:560-568)
+        raise ValueError("constraint_count must be positive")
+    if arity == 1 and constraint_count != variable_count:
+        raise ValueError(
+            "arity 1 pairs every variable with exactly one unary "
+            f"constraint: variable_count ({variable_count}) and "
+            f"constraint_count ({constraint_count}) must be equal")
+
+    rng = random.Random(seed)
+    d = Domain("levels", "level", list(range(domain_range)))
+    variables = {f"v{i}": Variable(f"v{i}", d)
+                 for i in range(variable_count)}
+
+    hard_count = int(round(hard_proportion * constraint_count))
+    if arity == 1:
+        specs = _unary_constraints(
+            variable_count, hard_count, domain_range, rng)
+    elif arity == 2:
+        specs = _binary_constraints(
+            variable_count, density, hard_proportion, domain_range,
+            rng)
+    else:
+        specs = _nary_constraints(
+            variable_count, constraint_count, arity, density,
+            hard_count, domain_range, rng)
+
+    constraints = {
+        name: constraint_from_str(
+            name, expr, [variables[v] for v in scope])
+        for name, (expr, scope) in specs.items()
+    }
+
+    if agents is None:
+        agent_defs = {f"a{i}": AgentDef(f"a{i}", capacity=capacity)
+                      for i in range(variable_count)}
+    else:
+        agent_defs = {f"a{i}": AgentDef(f"a{i}", capacity=capacity)
+                      for i in range(agents)}
+
+    return DCOP(
+        "mixed constraints problem", "min",
+        domains={"levels": d}, variables=variables,
+        constraints=constraints, agents=agent_defs,
+    )

@@ -137,6 +137,10 @@ def test_generate_scenario():
     ("smallworld", lambda: generate_small_world(10, k=4, p=0.2,
                                                 colors_count=3,
                                                 seed=7)),
+    ("mixed", lambda: __import__(
+        "pydcop_tpu.generators.mixed", fromlist=["m"]
+    ).generate_mixed_problem(8, 0, hard_proportion=0.3, arity=2,
+                             domain_range=4, density=0.4, seed=5)),
 ])
 def test_yaml_roundtrip_preserves_costs(family, make):
     """Serialize-back fidelity for every generated family: the reloaded
@@ -157,3 +161,77 @@ def test_yaml_roundtrip_preserves_costs(family, make):
         c2, viol2 = dcop2.solution_cost(asgt)
         assert c1 == pytest.approx(c2), (family, asgt)
         assert viol1 == viol2
+
+
+# ------------------------------------------------------------- mixed
+
+
+def test_mixed_problem_arity1():
+    from pydcop_tpu.generators.mixed import generate_mixed_problem
+
+    dcop = generate_mixed_problem(6, 6, hard_proportion=0.5, arity=1,
+                                  domain_range=4, seed=1)
+    assert len(dcop.variables) == 6
+    assert len(dcop.constraints) == 6
+    assert all(len(c.dimensions) == 1
+               for c in dcop.constraints.values())
+    # exactly half hard, each reachable (cost 0 somewhere)
+    hards = 0
+    for c in dcop.constraints.values():
+        v = c.dimensions[0]
+        costs = [c(**{v.name: val}) for val in v.domain.values]
+        if float("inf") in costs:
+            hards += 1
+            assert 0 in costs, c.name
+    assert hards == 3
+
+
+def test_mixed_problem_arity2_structure_and_solve():
+    from pydcop_tpu.generators.mixed import generate_mixed_problem
+
+    dcop = generate_mixed_problem(8, 0, hard_proportion=0.3, arity=2,
+                                  domain_range=5, density=0.4, seed=2)
+    assert len(dcop.variables) == 8
+    assert all(len(c.dimensions) == 2
+               for c in dcop.constraints.values())
+    # the family exists for the hard-constraint algorithms: mixeddsa
+    # and dba must run on it end-to-end
+    res = solve_result(dcop, "mixeddsa", timeout=30, stop_cycle=20)
+    assert set(res.assignment) == set(dcop.variables)
+    res = solve_result(dcop, "dba", timeout=30, max_distance=10)
+    assert set(res.assignment) == set(dcop.variables)
+
+
+def test_mixed_problem_nary_reachable_hard():
+    import itertools
+
+    from pydcop_tpu.generators.mixed import generate_mixed_problem
+
+    dcop = generate_mixed_problem(8, 5, hard_proportion=0.4, arity=3,
+                                  domain_range=3, density=0.6, seed=3)
+    assert len(dcop.constraints) == 5
+    assert all(1 <= len(c.dimensions) <= 3
+               for c in dcop.constraints.values())
+    hards = 0
+    for c in dcop.constraints.values():
+        doms = [list(v.domain.values) for v in c.dimensions]
+        names = [v.name for v in c.dimensions]
+        costs = [c(**dict(zip(names, combo)))
+                 for combo in itertools.product(*doms)]
+        if float("inf") in costs:
+            hards += 1
+            assert 0 in costs, c.name  # objective is reachable
+    assert hards == 2
+
+
+def test_mixed_problem_validation():
+    from pydcop_tpu.generators.mixed import generate_mixed_problem
+
+    with pytest.raises(ValueError):
+        generate_mixed_problem(5, 5, hard_proportion=1.5)
+    with pytest.raises(ValueError):
+        generate_mixed_problem(5, 4, hard_proportion=0.5, arity=1)
+    with pytest.raises(ValueError):
+        generate_mixed_problem(3, 5, hard_proportion=0.5, arity=4)
+    with pytest.raises(ValueError):
+        generate_mixed_problem(5, 0, hard_proportion=0.5, arity=3)
