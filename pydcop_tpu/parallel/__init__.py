@@ -1,4 +1,4 @@
-from .batch import BatchedMaxSum
+from .batch import BatchedDsa, BatchedMaxSum, BatchedMgm
 from .sharded_maxsum import ShardedAMaxSum, ShardedMaxSum
 
 
@@ -87,5 +87,6 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
 from .sharded_mgm2 import ShardedMgm2  # noqa: E402
 
-__all__ = ["BatchedMaxSum", "ShardedAMaxSum", "ShardedMaxSum",
-           "ShardedMgm2", "make_mesh", "solve_sharded"]
+__all__ = ["BatchedDsa", "BatchedMaxSum", "BatchedMgm",
+           "ShardedAMaxSum", "ShardedMaxSum", "ShardedMgm2",
+           "make_mesh", "solve_sharded"]

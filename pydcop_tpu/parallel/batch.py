@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..graphs.arrays import FactorGraphArrays
+from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
 from ..algorithms.maxsum import MaxSumSolver
 
 
@@ -77,3 +77,84 @@ class BatchedMaxSum:
             self._jitted[max_cycles] = run_all
         sel, cycles, finished = run_all(self.solver_buckets_batched, keys)
         return (np.asarray(sel), np.asarray(cycles), np.asarray(finished))
+
+
+class _BatchedLocalSearch:
+    """vmap a local-search solver over stacked per-instance constraint
+    cubes sharing one topology — the campaign workload of BASELINE
+    config 5 (1024 random Ising / coloring draws) for the DSA/MGM
+    family, companion of :class:`BatchedMaxSum`."""
+
+    solver_cls = None  # set by subclasses
+
+    def __init__(self, template: HypergraphArrays,
+                 cubes_batches: Optional[List[np.ndarray]] = None,
+                 batch: int = 1, **params):
+        self.solver = self.solver_cls(template, **params)
+        if cubes_batches is not None:
+            batch = cubes_batches[0].shape[0]
+            self.cubes_batched = [jnp.asarray(cb)
+                                  for cb in cubes_batches]
+        else:
+            self.cubes_batched = [
+                jnp.broadcast_to(cubes[None], (batch,) + cubes.shape)
+                for cubes, _ in self.solver.buckets
+            ]
+        self.B = batch
+        self.max_cycles = 200
+        self._jitted = {}
+
+        base = self.solver
+
+        def one_instance(cubes_list, key):
+            # swap in this instance's cubes; the per-constraint optima
+            # (DSA-B's violation test) must be re-derived from them
+            orig, orig_opt = base.buckets, base.bucket_optima
+            base.buckets = [
+                (c, vi) for c, (_, vi) in zip(cubes_list, orig)
+            ]
+            base.bucket_optima = [
+                jnp.min(c.reshape(c.shape[0], -1), axis=-1)
+                if c.shape[0] else jnp.zeros((0,), dtype=c.dtype)
+                for c in cubes_list
+            ]
+            state = base.init_state(key)
+            try:
+                def body(s):
+                    return base.step(s)
+
+                def cond(s):
+                    return jnp.logical_and(
+                        jnp.logical_not(s["finished"]),
+                        s["cycle"] < self.max_cycles)
+
+                final = jax.lax.while_loop(cond, body, state)
+            finally:
+                base.buckets, base.bucket_optima = orig, orig_opt
+            return final["x"], final["cycle"], final["finished"]
+
+        self._one = one_instance
+
+    def run(self, seed: int = 0, max_cycles: int = 200):
+        """Returns (selections (B, V), cycles (B,), finished (B,))."""
+        self.max_cycles = max_cycles
+        keys = jax.random.split(jax.random.PRNGKey(seed), self.B)
+        run_all = self._jitted.get(max_cycles)
+        if run_all is None:
+            run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+            self._jitted[max_cycles] = run_all
+        sel, cycles, finished = run_all(self.cubes_batched, keys)
+        return (np.asarray(sel), np.asarray(cycles),
+                np.asarray(finished))
+
+
+class BatchedDsa(_BatchedLocalSearch):
+    """vmap DSA (A/B/C variants) over per-instance cost cubes."""
+
+    from ..algorithms.dsa import DsaSolver as solver_cls
+
+
+class BatchedMgm(_BatchedLocalSearch):
+    """vmap MGM over per-instance cost cubes."""
+
+    from ..algorithms.mgm import MgmSolver as solver_cls
