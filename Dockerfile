@@ -1,0 +1,13 @@
+# Minimal runtime image for the distributed fabric (orchestrator and
+# agent processes; the compute path needs jax — CPU wheels by default,
+# swap the base image for a TPU VM image on real pods).
+FROM python:3.12-slim
+
+WORKDIR /opt/pydcop_tpu
+COPY pyproject.toml .
+COPY pydcop_tpu ./pydcop_tpu
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml numpy scipy networkx \
+    websockets && pip install --no-cache-dir .
+
+ENV JAX_PLATFORMS=cpu
+ENTRYPOINT []

@@ -2,7 +2,7 @@
 # cli + api tiers).  Tests force the CPU backend with a virtual
 # 8-device mesh (tests/conftest.py).
 
-.PHONY: test test-fast bench suite lint
+.PHONY: test test-fast bench suite lint typecheck
 
 test:
 	python -m pytest tests/ -q
@@ -18,3 +18,11 @@ suite:
 
 lint:
 	python -m compileall -q pydcop_tpu
+
+# reference parity: Makefile:21 (mypy --ignore-missing-imports).
+# mypy is not baked into the benchmark image; install it in dev
+# environments (`pip install mypy`) to run this tier.
+typecheck:
+	@python -c "import mypy" 2>/dev/null || \
+	  (echo "mypy is not installed: pip install mypy" && exit 1)
+	python -m mypy --ignore-missing-imports pydcop_tpu
