@@ -189,9 +189,12 @@ class MaxSumSolver(ArraySolver):
         q_new = jnp.where(edge_mask, q_new, BIG)
 
         # --- selection & convergence ------------------------------------
-        selection = masked_argmin(belief, self.domain_mask)
-        # stability <= 0 disables message-delta convergence entirely
-        # (delta < 0 can never hold): skip the full-array max reduce
+        # stability <= 0 disables convergence detection entirely: the
+        # per-cycle argmin AND the delta max-reduce are dead compute in
+        # the loop — carry the stale selection and recompute it from the
+        # final messages in assignment_indices (dead-reduce elision)
+        selection = masked_argmin(belief, self.domain_mask) \
+            if self.stability > 0 else s["selection"]
         delta = jnp.max(jnp.where(edge_mask, jnp.abs(q_new - q), 0.0)) \
             if self.E and self.stability > 0 else jnp.float32(0)
         return self._advance(s, key, q_new, new_r, selection, delta)
@@ -222,13 +225,19 @@ class MaxSumSolver(ArraySolver):
         return out
 
     def assignment_indices(self, s):
-        return s["selection"]
+        if self.stability > 0:
+            return s["selection"]
+        # lazy selection (see step): rebuild beliefs from the final
+        # factor->var messages, which is exactly the in-step belief
+        belief = self.var_costs + jax.ops.segment_sum(
+            s["r"], self.edge_var, num_segments=self.V)
+        return masked_argmin(belief, self.domain_mask)
 
     def cost(self, s):
         return assignment_cost_device(
             [(cubes, var_ids) for cubes, (_, _, var_ids)
              in zip(self._cubes(s), self.buckets)],
-            self.var_costs, s["selection"],
+            self.var_costs, self.assignment_indices(s),
         )
 
 
@@ -305,6 +314,13 @@ class MaxSumLaneSolver(MaxSumSolver):
         return jnp.argmin(
             jnp.where(self.domain_maskT, beliefT, BIG * 2), axis=0)
 
+    def assignment_indices(self, s):
+        if self.stability > 0:
+            return s["selection"]
+        sum_r = jnp.zeros((self.D, self.V), dtype=s["r"].dtype) \
+            .at[:, self.edge_var].add(s["r"])
+        return self._select(self.var_costsT + sum_r)
+
     def _factor_update(self, q):
         from ..ops.pallas_kernels import (
             factor_messages_binary_lane_major,
@@ -355,7 +371,10 @@ class MaxSumLaneSolver(MaxSumSolver):
             q_new = self.damping * q + (1 - self.damping) * q_new
         q_new = jnp.where(self.emaskT, q_new, BIG)
 
-        selection = self._select(belief)
+        # same dead-reduce elision as the base solver: with stability
+        # disabled, neither the argmin nor the delta feeds anything
+        selection = self._select(belief) if self.stability > 0 \
+            else s["selection"]
         delta = jnp.max(jnp.where(self.emaskT, jnp.abs(q_new - q), 0.0)) \
             if self.E and self.stability > 0 else jnp.float32(0)
         return self._advance(s, key, q_new, new_r, selection, delta)
