@@ -35,6 +35,10 @@ algo_params = [
 
 
 class AMaxSumSolver(MaxSumSolver):
+    #: stochastic edge activation draws from the jax PRNG stream —
+    #: a numpy mirror could not reproduce it, so no host engine
+    host_path = False
+
     def __init__(self, arrays: FactorGraphArrays, activation: float = 0.7,
                  **kwargs):
         super().__init__(arrays, **kwargs)

@@ -72,15 +72,11 @@ class MaxSumSolver(ArraySolver):
         self.noise = float(noise)
         self.stop_cycle = int(stop_cycle)
 
-        self.var_costs = jnp.asarray(arrays.var_costs)
-        self.domain_mask = jnp.asarray(arrays.domain_mask)
-        self.domain_size = jnp.asarray(arrays.domain_size)
-        self.edge_var = jnp.asarray(arrays.edge_var)
-        self.buckets = [
-            (jnp.asarray(b.cubes), jnp.asarray(b.edge_ids),
-             jnp.asarray(b.var_ids))
-            for b in arrays.buckets
-        ]
+        # device constants are LAZY: materializing them eagerly would
+        # initialize the accelerator backend (seconds through the
+        # tunnel) even for tiny problems the host engine solves in
+        # microseconds without ever touching a device
+        self._dev_cache: Dict[str, object] = {}
         self.E = arrays.n_edges
         self.D = arrays.max_domain
         self.V = arrays.n_vars
@@ -89,6 +85,58 @@ class MaxSumSolver(ArraySolver):
         # per-bucket gather/scatter degenerates into reshapes, removing
         # the two most expensive irregular ops of the cycle on TPU.
         self._canonical = self._detect_canonical(arrays)
+
+    @staticmethod
+    def _tracing() -> bool:
+        try:
+            from jax._src.core import trace_state_clean
+
+            return not trace_state_clean()
+        except Exception:  # pragma: no cover - jax internals moved
+            return True  # can't tell: never cache
+
+    def _dev(self, name, build):
+        out = self._dev_cache.get(name)
+        if out is None:
+            if self._tracing():
+                # under a jit trace jnp.asarray yields jaxpr-constant
+                # tracers: use them for this trace but never cache
+                return build()
+            out = self._dev_cache[name] = build()
+        return out
+
+    @property
+    def var_costs(self):
+        return self._dev("var_costs",
+                         lambda: jnp.asarray(self.arrays.var_costs))
+
+    @property
+    def domain_mask(self):
+        return self._dev("domain_mask",
+                         lambda: jnp.asarray(self.arrays.domain_mask))
+
+    @property
+    def domain_size(self):
+        return self._dev("domain_size",
+                         lambda: jnp.asarray(self.arrays.domain_size))
+
+    @property
+    def edge_var(self):
+        return self._dev("edge_var",
+                         lambda: jnp.asarray(self.arrays.edge_var))
+
+    @property
+    def buckets(self):
+        return self._dev("buckets", lambda: [
+            (jnp.asarray(b.cubes), jnp.asarray(b.edge_ids),
+             jnp.asarray(b.var_ids))
+            for b in self.arrays.buckets
+        ])
+
+    @buckets.setter
+    def buckets(self, value):
+        # BatchedMaxSum swaps per-instance cubes in under vmap
+        self._dev_cache["buckets"] = value
 
     @staticmethod
     def _detect_canonical(arrays):
@@ -233,6 +281,141 @@ class MaxSumSolver(ArraySolver):
             s["r"], self.edge_var, num_segments=self.V)
         return masked_argmin(belief, self.domain_mask)
 
+    # ---------------------------------------------------------- host path
+
+    #: subclasses with device-only semantics (stochastic activation,
+    #: dynamic factor swaps) opt out of the host engine
+    host_path = True
+
+    def host_cells(self) -> int:
+        """Per-cycle work in table cells — the host/device dispatch
+        metric for tiny problems (see SyncEngine)."""
+        import numpy as np
+
+        a = self.arrays
+        return int(sum(np.asarray(b.cubes).size * max(1, b.cubes.ndim - 1)
+                       for b in a.buckets)) + a.n_edges * a.max_domain
+
+    def use_host_engine(self) -> bool:
+        return self.host_path and self.noise == 0
+
+    def host_run(self, max_cycles: int, timeout=None,
+                 collect_cost_every=None, variables=None):
+        """Pure-numpy mirror of the compiled cycle for tiny problems:
+        an XLA trace+compile costs seconds while a 10-variable solve is
+        microseconds of arithmetic — the reference's CI-sized instances
+        (tests/api/test_api_solve.py:36-93) must answer instantly, not
+        after a compile.  Same math as :meth:`step` (damping, mean
+        normalization, SAME_COUNT/stability convergence, argmin
+        tie-to-first), so results match the device path for noise=0."""
+        import time as _time
+
+        import numpy as np
+
+        from ..engine.solver import RunResult
+
+        t0 = _time.perf_counter()
+        a = self.arrays
+        E, D, V = a.n_edges, a.max_domain, a.n_vars
+        np_buckets = [
+            (np.asarray(b.cubes, dtype=np.float32),
+             np.asarray(b.edge_ids), np.asarray(b.var_ids))
+            for b in a.buckets
+        ]
+        edge_var = np.asarray(a.edge_var)
+        var_costs = np.asarray(a.var_costs, dtype=np.float32)
+        domain_mask = np.asarray(a.domain_mask)
+        dsize = np.asarray(a.domain_size, dtype=np.float32)
+        emask = domain_mask[edge_var]
+
+        def select(belief):
+            return np.argmin(np.where(domain_mask, belief, BIG * 2),
+                             axis=1)
+
+        def total_cost(sel):
+            cost = float(var_costs[np.arange(V), sel].sum())
+            for cubes, _, var_ids in np_buckets:
+                arity = cubes.ndim - 1
+                idx = (np.arange(cubes.shape[0]),) + tuple(
+                    sel[var_ids[:, p]] for p in range(arity))
+                cost += float(cubes[idx].sum())
+            return cost
+
+        q = np.where(emask, 0.0, BIG).astype(np.float32)
+        r = np.zeros_like(q)
+        sel = select(var_costs)
+        same, cycle, finished = 0, 0, False
+        timed_out = False
+        trace = []
+        while cycle < max_cycles and not finished:
+            if timeout is not None and \
+                    _time.perf_counter() - t0 > timeout:
+                timed_out = True
+                break
+            new_r = np.zeros_like(q)
+            for cubes, edge_ids, _ in np_buckets:
+                arity = cubes.ndim - 1
+                if arity == 0:
+                    continue
+                shaped = []
+                total = cubes
+                for p in range(arity):
+                    shp = [cubes.shape[0]] + [1] * arity
+                    shp[p + 1] = D
+                    s_p = q[edge_ids[:, p]].reshape(shp)
+                    shaped.append(s_p)
+                    total = total + s_p
+                for p in range(arity):
+                    axes = tuple(i + 1 for i in range(arity) if i != p)
+                    msg = total - shaped[p]
+                    new_r[edge_ids[:, p]] = \
+                        msg.min(axis=axes) if axes else msg
+            if self.damping_nodes in ("factors", "both") \
+                    and self.damping > 0:
+                new_r = self.damping * r + (1 - self.damping) * new_r
+            sum_r = np.zeros((V, D), dtype=np.float32)
+            np.add.at(sum_r, edge_var, new_r)
+            belief = var_costs + sum_r
+            q_new = belief[edge_var] - new_r
+            mean = np.where(emask, q_new, 0.0).sum(axis=1) \
+                / dsize[edge_var]
+            q_new = q_new - mean[:, None]
+            if self.damping_nodes in ("vars", "both") \
+                    and self.damping > 0:
+                q_new = self.damping * q + (1 - self.damping) * q_new
+            q_new = np.where(emask, q_new, BIG).astype(np.float32)
+            new_sel = select(belief)
+            if self.stability > 0:
+                delta = float(np.max(np.where(
+                    emask, np.abs(q_new - q), 0.0))) if E else 0.0
+                stable = np.array_equal(new_sel, sel) \
+                    and delta < self.stability
+                same = same + 1 if stable else 0
+                finished = same >= SAME_COUNT
+            q, r, sel = q_new, new_r, new_sel
+            cycle += 1
+            if self.stop_cycle and cycle >= self.stop_cycle:
+                finished = True
+            if collect_cost_every and cycle % collect_cost_every == 0:
+                trace.append((cycle, total_cost(sel)))
+
+        if variables is not None:
+            by_name = {v.name: v for v in variables}
+            assignment = {
+                name: by_name[name].domain.values[int(i)]
+                for name, i in zip(self.var_names, sel)}
+        else:
+            assignment = {name: int(i)
+                          for name, i in zip(self.var_names, sel)}
+        return RunResult(
+            assignment=assignment, cycles=cycle, finished=finished,
+            cost=total_cost(sel), violations=0,
+            duration=_time.perf_counter() - t0,
+            status="FINISHED" if finished
+            else ("TIMEOUT" if timed_out else "MAX_CYCLES"),
+            cost_trace=trace,
+        )
+
     def cost(self, s):
         return assignment_cost_device(
             [(cubes, var_ids) for cubes, (_, _, var_ids)
@@ -270,8 +453,6 @@ class MaxSumLaneSolver(MaxSumSolver):
             raise ValueError(
                 "lane-major layout needs the canonical factor-major "
                 "edge layout and arity <= 2 buckets")
-        import numpy as np
-
         if use_pallas is None:
             # measured on-chip: the fused pallas kernel beats the jnp
             # factor update in isolation (0.81 vs 1.50 ms) but blocks
@@ -280,21 +461,41 @@ class MaxSumLaneSolver(MaxSumSolver):
             # keep the kernel opt-in for larger domains/other chips
             use_pallas = False
         self.use_pallas = bool(use_pallas)
-        self.var_costsT = jnp.asarray(arrays.var_costs.T)       # (D, V)
-        self.domain_maskT = jnp.asarray(arrays.domain_mask.T)   # (D, V)
-        self.emaskT = self.domain_maskT[:, self.edge_var]       # (D, E)
-        self.bucketsT = []
-        for (cubes, _, _), spec in zip(self.buckets, self._canonical):
-            if spec is None:
-                self.bucketsT.append(None)
-                continue
-            _, f, arity = spec
-            c = np.asarray(cubes)
-            if arity == 1:
-                self.bucketsT.append(jnp.asarray(c.T))         # (D, F)
-            else:
-                self.bucketsT.append(
-                    jnp.asarray(np.transpose(c, (1, 2, 0))))   # (D,D,F)
+
+    # transposed device constants, lazy like the base class's
+    @property
+    def var_costsT(self):
+        return self._dev("var_costsT",
+                         lambda: jnp.asarray(self.arrays.var_costs.T))
+
+    @property
+    def domain_maskT(self):
+        return self._dev(
+            "domain_maskT",
+            lambda: jnp.asarray(self.arrays.domain_mask.T))
+
+    @property
+    def emaskT(self):
+        return self._dev(
+            "emaskT", lambda: self.domain_maskT[:, self.edge_var])
+
+    @property
+    def bucketsT(self):
+        import numpy as np
+
+        def build():
+            out = []
+            for b, spec in zip(self.arrays.buckets, self._canonical):
+                if spec is None:
+                    out.append(None)
+                    continue
+                _, f, arity = spec
+                c = np.asarray(b.cubes)
+                out.append(jnp.asarray(
+                    c.T if arity == 1 else np.transpose(c, (1, 2, 0))))
+            return out
+
+        return self._dev("bucketsT", build)
 
     def init_state(self, key):
         zeros = jnp.where(self.emaskT, 0.0, BIG)

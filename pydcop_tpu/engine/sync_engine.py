@@ -18,6 +18,13 @@ import jax.numpy as jnp
 from ._cache import enable_persistent_cache
 from .solver import ArraySolver, RunResult
 
+#: problems whose per-cycle work is below this many table cells run on
+#: the solver's pure-numpy host mirror instead of compiling: an XLA
+#: trace+compile costs seconds, a 10-variable cycle costs microseconds
+#: (the reference solves its CI instances inside 3-5 s timeouts —
+#: tests/api/test_api_solve.py:36-93 — compile-free)
+HOST_ENGINE_CELLS = 50_000
+
 
 class SyncEngine:
     def __init__(self, solver: ArraySolver, chunk_size: int = 32):
@@ -46,6 +53,14 @@ class SyncEngine:
             collect_cost_every: Optional[int] = None,
             variables=None) -> RunResult:
         """Run until convergence, cycle cap, or wall-clock timeout."""
+        solver = self._solver
+        if (getattr(solver, "host_path", False)
+                and solver.use_host_engine()
+                and solver.host_cells() <= HOST_ENGINE_CELLS):
+            return solver.host_run(
+                max_cycles=max_cycles, timeout=timeout,
+                collect_cost_every=collect_cost_every,
+                variables=variables)
         if isinstance(key, int):
             key = jax.random.PRNGKey(key)
         state = self._solver.init_state(key)
