@@ -215,6 +215,13 @@ class HttpCommunicationLayer(CommunicationLayer):
                     # arbitrary imports + constructor calls
                     msg = from_repr(
                         content, allowed_prefixes=("pydcop_tpu.",))
+                    if not isinstance(msg, _Envelope):
+                        # only envelopes ride the wire (Messaging
+                        # wraps every message); a bare list/str/dict
+                        # would crash the agent loop downstream
+                        raise ValueError(
+                            f"wire payload is not an envelope: "
+                            f"{type(msg).__name__}")
                 except Exception as e:  # malformed/rejected: report 500
                     logger.warning(
                         "Rejected message from %s to %s: %s",
@@ -223,7 +230,12 @@ class HttpCommunicationLayer(CommunicationLayer):
                     self.send_response(500)
                     self.end_headers()
                     return
-                prio = int(self.headers.get("prio", MSG_ALGO))
+                try:
+                    prio = int(self.headers.get("prio", MSG_ALGO))
+                except (TypeError, ValueError):
+                    # a garbled priority must not wedge the handler:
+                    # deliver at the default algo priority
+                    prio = MSG_ALGO
                 src = self.headers.get("sender-agent")
                 dest = self.headers.get("dest-agent")
                 comm.on_post_message(src, dest, msg, prio)

@@ -924,3 +924,72 @@ def test_fabric_matches_engine_quality_more_algorithms(algo, cycles):
     assert fabric.violations <= 2
     # real messages moved on the fabric (not mirrors)
     assert fabric.metrics["msg_count"] > 50
+
+
+# ---- round 4: process-mode coverage for the remaining 6 algorithms ----
+# (VERDICT r3 item 7: every algorithm's wire format crosses real HTTP)
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_gdba_real_messages():
+    """GDBA's modifier hypercubes rebuilt in every agent process."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "gdba", mode="process",
+                      distribution="oneagent", timeout=90, port=9520,
+                      stop_cycle=12, seed=3)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment["v1"] != result.assignment["v2"]
+    assert result.assignment["v2"] != result.assignment["v3"]
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_mixeddsa_real_messages():
+    """MixedDSA's two-tier hard/soft rule over HTTP/JSON."""
+    dcop = load_dcop(GC3_HARD)
+    result = run_dcop(dcop, "mixeddsa", mode="process",
+                      distribution="oneagent", timeout=90, port=9530,
+                      stop_cycle=15, seed=3)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment["v1"] != result.assignment["v2"]
+    assert result.assignment["v2"] != result.assignment["v3"]
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_dsatuto_real_messages():
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "dsatuto", mode="process",
+                      distribution="oneagent", timeout=90, port=9540,
+                      stop_cycle=15, seed=3)
+    assert result.metrics["status"] == "FINISHED"
+    assert set(result.assignment) == {"v1", "v2", "v3"}
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_ncbb_real_messages():
+    """NCBB's INIT value/cost waves + stop wave across processes."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "ncbb", mode="process",
+                      distribution="oneagent", timeout=90, port=9550)
+    assert result.assignment in VALID_GC3
+    assert result.metrics["status"] == "FINISHED"
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_adsa_periodic_actions():
+    """A-DSA's timer-wheel activations inside each agent process."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "adsa", mode="process",
+                      distribution="oneagent", timeout=90, port=9560,
+                      stop_cycle=10, period=0.1, seed=3)
+    assert result.metrics["status"] == "FINISHED"
+    assert result.assignment["v1"] != result.assignment["v2"]
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_maxsum_dynamic_real_messages():
+    """Dynamic MaxSum's factor computations serialized to processes."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "maxsum_dynamic", mode="process",
+                      timeout=90, port=9570, seed=3)
+    assert result.assignment in VALID_GC3
+    assert result.metrics["status"] == "FINISHED"

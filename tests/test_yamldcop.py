@@ -430,3 +430,216 @@ agents:
 """
     with pytest.raises(DcopInvalidFormatError, match="top-level"):
         load_dcop(src)
+
+
+# ---- round 4: dialect oddities (VERDICT r3 item 7) --------------------
+
+
+def test_domain_range_shorthand_variants():
+    from pydcop_tpu.dcop.yamldcop import str_2_domain_values
+
+    assert str_2_domain_values("0..5") == [0, 1, 2, 3, 4, 5]
+    assert str_2_domain_values("-2..2") == [-2, -1, 0, 1, 2]
+    # non-int range falls back to the list form (dialect strips the
+    # leading bracket character, like the reference)
+    assert str_2_domain_values("[a, b, c") == ["a", "b", "c"]
+    assert str_2_domain_values("[1, 2, 3") == [1, 2, 3]
+
+
+def test_domain_range_in_yaml_and_type_field():
+    dcop = load_dcop("""
+name: t
+domains:
+  lum: {values: ['0..3'], type: luminosity}
+variables:
+  x: {domain: lum}
+agents: [a1]
+""")
+    d = dcop.domains["lum"]
+    assert list(d.values) == [0, 1, 2, 3]
+    assert d.type == "luminosity"
+
+
+def test_initial_value_outside_domain_rejected():
+    with pytest.raises(ValueError, match="initial value"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  x: {domain: d, initial_value: 9}
+agents: [a1]
+""")
+
+
+def test_constraint_missing_type_rejected():
+    with pytest.raises(ValueError, match="type is"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  x: {domain: d}
+constraints:
+  c: {function: x}
+agents: [a1]
+""")
+
+
+def test_constraint_unknown_type_rejected():
+    with pytest.raises(ValueError, match="intention or extensional"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [1, 2]}
+variables:
+  x: {domain: d}
+constraints:
+  c: {type: matrix, function: x}
+agents: [a1]
+""")
+
+
+def test_extensional_wrong_arity_cell_rejected():
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    with pytest.raises(DcopInvalidFormatError, match="has 1 values"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [A, B]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  c:
+    type: extensional
+    default: 0
+    variables: [x, y]
+    values:
+      3: A
+agents: [a1]
+""")
+
+
+def test_extensional_missing_cells_without_default_rejected():
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    with pytest.raises(DcopInvalidFormatError, match="default"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [A, B]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  c:
+    type: extensional
+    variables: [x, y]
+    values:
+      3: A A | B B
+agents: [a1]
+""")
+
+
+def test_extensional_single_variable_shorthand_scalar_cells():
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [1, 2, 3]}
+variables:
+  x: {domain: d}
+constraints:
+  c:
+    type: extensional
+    default: 0
+    variables: x
+    values:
+      7: 2
+      9: 1 | 3
+agents: [a1]
+""")
+    c = dcop.constraints["c"]
+    assert c(x=2) == 7 and c(x=1) == 9 and c(x=3) == 9
+
+
+def test_routes_conflicting_definitions_rejected():
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    with pytest.raises(DcopInvalidFormatError, match="conflicting"):
+        load_dcop("""
+name: t
+domains:
+  d: {values: [1]}
+variables:
+  x: {domain: d}
+agents:
+  a1: {}
+  a2: {}
+routes:
+  a1: {a2: 5}
+  a2: {a1: 7}
+""")
+
+
+def test_routes_symmetric_restatement_allowed():
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [1]}
+variables:
+  x: {domain: d}
+agents:
+  a1: {}
+  a2: {}
+routes:
+  default: 3
+  a1: {a2: 5}
+  a2: {a1: 5}
+""")
+    assert dcop.agents["a1"].route("a2") == 5
+    assert dcop.agents["a2"].route("a1") == 5
+
+
+def test_routes_and_hosting_unknown_agent_rejected():
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    base = """
+name: t
+domains:
+  d: {values: [1]}
+variables:
+  x: {domain: d}
+agents: [a1]
+"""
+    with pytest.raises(DcopInvalidFormatError, match="unknown agent"):
+        load_dcop(base + "routes:\n  ghost: {a1: 2}\n")
+    with pytest.raises(DcopInvalidFormatError, match="unknown agent"):
+        load_dcop(base + "hosting_costs:\n  ghost:\n    default: 2\n")
+
+
+def test_hosting_costs_three_level_defaults():
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [1]}
+variables:
+  x: {domain: d}
+agents:
+  a1: {}
+  a2: {}
+  a3: {}
+hosting_costs:
+  default: 9
+  a2:
+    default: 4
+  a3:
+    default: 2
+    computations:
+      x: 0
+""")
+    assert dcop.agents["a1"].hosting_cost("x") == 9    # global default
+    assert dcop.agents["a2"].hosting_cost("x") == 4    # agent default
+    assert dcop.agents["a3"].hosting_cost("x") == 0    # explicit
+    assert dcop.agents["a3"].hosting_cost("other") == 2
