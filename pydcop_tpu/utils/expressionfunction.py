@@ -30,6 +30,13 @@ _SAFE_BUILTINS = {
     "float": float,
     "str": str,
     "bool": bool,
+    "range": range,
+    "sorted": sorted,
+    "all": all,
+    "any": any,
+    "zip": zip,
+    "enumerate": enumerate,
+    "divmod": divmod,
     "math": math,
     "sqrt": math.sqrt,
     "exp": math.exp,
@@ -190,9 +197,13 @@ class ExpressionFunction(SimpleRepr):
             raise TypeError(f"Missing variables {sorted(missing)} for {self}")
         if self._fn is not None:
             return self._fn(**{k: env[k] for k in self._fn_args})
+        # variables ride the GLOBALS dict: a comprehension body inside
+        # eval resolves free names in globals only, so split
+        # globals/locals would break "sum(x * i for i in range(3))"
         g = dict(self._globals)
         g["__builtins__"] = {}
-        return eval(self._code, g, env)  # noqa: S307 - host-side model eval
+        g.update(env)
+        return eval(self._code, g)  # noqa: S307 - host-side model eval
 
     def partial(self, **kwargs) -> "ExpressionFunction":
         """Fix some variables, returning a narrower function."""

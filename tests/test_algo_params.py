@@ -1,0 +1,104 @@
+"""Algorithm parameter plumbing (reference: tests/unit/
+test_algorithms.py + algorithms/__init__.py:99-137/446-505): casting,
+value constraints, defaults, unknown-parameter rejection, and the
+per-algorithm declared parameter surfaces."""
+
+import pytest
+
+from pydcop_tpu.algorithms import (AlgoParameterDef,
+                                   AlgoParameterException, AlgorithmDef,
+                                   check_param_value,
+                                   list_available_algorithms,
+                                   load_algorithm_module,
+                                   prepare_algo_params)
+
+ALL_ALGOS = ["adsa", "amaxsum", "dba", "dpop", "dsa", "dsatuto", "gdba",
+             "maxsum", "maxsum_dynamic", "mgm", "mgm2", "mixeddsa",
+             "ncbb", "syncbb"]
+
+
+def test_all_fourteen_algorithms_discovered():
+    assert list_available_algorithms() == ALL_ALGOS
+
+
+def test_check_param_value_casts_by_declared_type():
+    assert check_param_value("3", AlgoParameterDef("p", "int")) == 3
+    assert check_param_value("0.5",
+                             AlgoParameterDef("p", "float")) == 0.5
+    assert check_param_value(1, AlgoParameterDef("p", "bool")) is True
+    assert check_param_value(7, AlgoParameterDef("p", "str")) == "7"
+
+
+def test_check_param_value_none_returns_default():
+    assert check_param_value(
+        None, AlgoParameterDef("p", "int", None, 42)) == 42
+
+
+def test_check_param_value_rejects_uncastable():
+    with pytest.raises(AlgoParameterException):
+        check_param_value("high", AlgoParameterDef("p", "float"))
+
+
+def test_check_param_value_enforces_allowed_values():
+    pd = AlgoParameterDef("variant", "str", ["A", "B", "C"], "B")
+    assert check_param_value("A", pd) == "A"
+    with pytest.raises(AlgoParameterException):
+        check_param_value("D", pd)
+
+
+def test_prepare_algo_params_fills_defaults_and_rejects_unknown():
+    defs = [AlgoParameterDef("a", "int", None, 1),
+            AlgoParameterDef("b", "float", None, 0.5)]
+    out = prepare_algo_params({"a": "3"}, defs)
+    assert out == {"a": 3, "b": 0.5}
+    with pytest.raises(AlgoParameterException, match="Unknown"):
+        prepare_algo_params({"zz": 1}, defs)
+
+
+def test_algorithm_def_build_with_default_param():
+    ad = AlgorithmDef.build_with_default_param(
+        "dsa", {"variant": "C"}, mode="max")
+    assert ad.algo == "dsa"
+    assert ad.params["variant"] == "C"
+    assert ad.params["probability"] == 0.7  # declared default
+    assert ad.mode == "max"
+
+
+def test_algorithm_def_rejects_bad_value_through_build():
+    with pytest.raises(AlgoParameterException):
+        AlgorithmDef.build_with_default_param(
+            "dsa", {"variant": "Z"})
+
+
+@pytest.mark.parametrize("algo", ALL_ALGOS)
+def test_declared_params_have_sane_defaults(algo):
+    """Every declared default passes its own validation — the contract
+    the reference enforces at module load (algorithms/__init__.py)."""
+    module = load_algorithm_module(algo)
+    for pd in module.algo_params:
+        assert pd.type in ("str", "int", "float", "bool"), (algo, pd)
+        if pd.default is not None:
+            checked = check_param_value(pd.default, pd)
+            assert checked is not None, (algo, pd)
+        if pd.values:
+            assert pd.default is None or pd.default in pd.values, \
+                (algo, pd)
+
+
+@pytest.mark.parametrize("algo", ALL_ALGOS)
+def test_graph_type_declared_and_loadable(algo):
+    from pydcop_tpu.graphs import load_graph_module
+
+    module = load_algorithm_module(algo)
+    assert load_graph_module(module.GRAPH_TYPE) is not None
+
+
+def test_algorithm_def_simple_repr_roundtrip():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    ad = AlgorithmDef.build_with_default_param("mgm2",
+                                               {"threshold": 0.6})
+    back = from_repr(simple_repr(ad))
+    assert back.algo == "mgm2"
+    assert back.params == ad.params
+    assert back.mode == ad.mode

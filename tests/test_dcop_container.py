@@ -1,0 +1,151 @@
+"""DCOP container corners (reference: tests/unit/test_dcop_dcop.py):
+accessors, incremental construction, solution_cost edge cases and
+filter_dcop normalization."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP, filter_dcop
+from pydcop_tpu.dcop.objects import (AgentDef, Domain, ExternalVariable,
+                                     Variable, VariableWithCostDict)
+from pydcop_tpu.dcop.relations import (NAryFunctionRelation,
+                                       UnaryFunctionRelation,
+                                       constraint_from_str)
+
+
+@pytest.fixture()
+def d():
+    return Domain("d", "", [0, 1, 2])
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        DCOP("bad", objective="optimize")
+
+
+def test_add_constraint_auto_registers_variables_and_domains(d):
+    dcop = DCOP("t")
+    x, y = Variable("x", d), Variable("y", d)
+    c = NAryFunctionRelation(lambda x, y: x + y, [x, y], name="c")
+    dcop.add_constraint(c)
+    assert set(dcop.variables) == {"x", "y"}
+    assert "d" in dcop.domains
+    assert dcop.constraint("c") is c
+
+
+def test_iadd_accepts_variables_constraints_agents(d):
+    dcop = DCOP("t")
+    x = Variable("x", d)
+    dcop += x
+    assert dcop.variable("x") is x
+    c = UnaryFunctionRelation("c", x, lambda v: v)
+    dcop += c
+    assert dcop.constraint("c") is c
+    dcop += AgentDef("a1")
+    assert dcop.agent("a1").name == "a1"
+
+
+def test_variables_of_and_constraints_of(d):
+    dcop = DCOP("t")
+    x, y, z = (Variable(n, d) for n in "xyz")
+    cxy = NAryFunctionRelation(lambda x, y: x + y, [x, y], name="cxy")
+    cyz = NAryFunctionRelation(lambda y, z: y + z, [y, z], name="cyz")
+    dcop.add_constraint(cxy)
+    dcop.add_constraint(cyz)
+    dcop += Variable("lonely", d)
+    assert {v.name for v in dcop.variables_of("cxy")} == {"x", "y"}
+    assert {c.name for c in dcop.constraints_of("y")} == {"cxy", "cyz"}
+    assert dcop.constraints_of("lonely") == []
+
+
+def test_unknown_accessors_raise(d):
+    dcop = DCOP("t")
+    for getter in (dcop.domain, dcop.variable, dcop.constraint,
+                   dcop.agent):
+        with pytest.raises(KeyError):
+            getter("missing")
+
+
+def test_solution_cost_missing_variable_raises(d):
+    dcop = DCOP("t")
+    dcop += Variable("x", d)
+    dcop += Variable("y", d)
+    with pytest.raises(ValueError, match="missing"):
+        dcop.solution_cost({"x": 0})
+
+
+def test_solution_cost_uses_external_variable_value(d):
+    dcop = DCOP("t")
+    x = Variable("x", d)
+    ext = ExternalVariable("sensor", d, 2)
+    c = NAryFunctionRelation(lambda x, sensor: 10 * sensor + x,
+                             [x, ext], name="c")
+    dcop += x
+    dcop.external_variables["sensor"] = ext
+    dcop.add_constraint(c)
+    cost, violations = dcop.solution_cost({"x": 1})
+    assert cost == 21 and violations == 0
+    ext.value = 0
+    cost, _ = dcop.solution_cost({"x": 1})
+    assert cost == 1
+
+
+def test_solution_cost_max_objective_counts_no_violation(d):
+    dcop = DCOP("t", objective="max")
+    x = Variable("x", d)
+    dcop += x
+    dcop.add_constraint(
+        UnaryFunctionRelation("u", x, lambda v: v * 2))
+    cost, violations = dcop.solution_cost({"x": 2})
+    assert cost == 4 and violations == 0
+
+
+def test_filter_dcop_folds_unary_into_variable_costs(d):
+    dcop = DCOP("t")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop += x
+    dcop += y
+    dcop.add_constraint(UnaryFunctionRelation("ux", x, lambda v: 5 * v))
+    dcop.add_constraint(
+        NAryFunctionRelation(lambda x, y: x + y, [x, y], name="cxy"))
+    filtered = filter_dcop(dcop)
+    assert set(filtered.constraints) == {"cxy"}
+    fx = filtered.variables["x"]
+    assert isinstance(fx, VariableWithCostDict)
+    assert fx.cost_for_val(2) == 10
+    # total cost is preserved
+    a = {"x": 2, "y": 1}
+    assert filtered.solution_cost(a)[0] == dcop.solution_cost(a)[0]
+
+
+def test_filter_dcop_keeps_unary_on_external_variables(d):
+    dcop = DCOP("t")
+    x = Variable("x", d)
+    ext = ExternalVariable("sensor", d, 1)
+    dcop += x
+    dcop.external_variables["sensor"] = ext
+    dcop.add_constraint(
+        UnaryFunctionRelation("us", ext, lambda v: v * 3))
+    dcop.add_constraint(
+        NAryFunctionRelation(lambda x, sensor: x + sensor, [x, ext],
+                             name="c"))
+    filtered = filter_dcop(dcop)
+    # the external's unary cannot fold into a decision variable
+    assert "us" in filtered.constraints
+
+
+def test_add_agents_accepts_iterable_and_dict():
+    dcop = DCOP("t")
+    dcop.add_agents([AgentDef("a1"), AgentDef("a2")])
+    dcop.add_agents({"a3": AgentDef("a3")})
+    assert set(dcop.agents) == {"a1", "a2", "a3"}
+
+
+def test_constraint_from_str_integrates(d):
+    dcop = DCOP("t")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop += x
+    dcop += y
+    c = constraint_from_str("c", "1 if x == y else 0", [x, y])
+    dcop.add_constraint(c)
+    assert dcop.solution_cost({"x": 1, "y": 1})[0] == 1
+    assert dcop.solution_cost({"x": 1, "y": 2})[0] == 0

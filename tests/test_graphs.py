@@ -265,3 +265,55 @@ def test_pseudotree_every_constraint_owned_once():
                             if v.name in depth]
             assert depth[node.name] == max(scope_depths), c.name
     assert set(owners) == set(dcop.constraints)
+
+
+# ---- pair-edge table builders (round 4, shared by mgm2 + sharded) -----
+
+
+def test_pair_edge_lookup_vectorized():
+    import numpy as np
+
+    from pydcop_tpu.graphs.arrays import pair_edge_lookup
+
+    src = np.array([0, 0, 1, 2, 2, 3])
+    dst = np.array([1, 2, 0, 0, 3, 2])
+    lookup = pair_edge_lookup(src, dst, 4)
+    u = np.array([0, 2, 3, 1])
+    v = np.array([2, 3, 0, 3])
+    ids = lookup(u, v)
+    assert ids.tolist() == [1, 4, 0, 0]  # (3,0) and (1,3) absent -> 0
+    # arbitrary-shape inputs broadcast
+    ids2 = lookup(np.array([[0], [2]]), np.array([[1, 2], [0, 3]]))
+    assert ids2.tolist() == [[0, 1], [3, 4]]
+
+
+def test_pair_eids_for_bucket_zeroes_diagonal():
+    import numpy as np
+
+    from pydcop_tpu.graphs.arrays import (pair_edge_lookup,
+                                          pair_eids_for_bucket)
+
+    src = np.array([0, 1, 1, 2])
+    dst = np.array([1, 0, 2, 1])
+    lookup = pair_edge_lookup(src, dst, 3)
+    m = pair_eids_for_bucket(lookup, np.array([[0, 1], [1, 2]]))
+    assert m.shape == (2, 2, 2)
+    assert m[0, 0, 1] == 0 and m[0, 1, 0] == 1
+    assert m[1, 0, 1] == 2 and m[1, 1, 0] == 3
+    assert m[0, 0, 0] == 0 and m[1, 1, 1] == 0  # diagonal inert
+
+
+def test_out_edge_table_slots_and_degrees():
+    import numpy as np
+
+    from pydcop_tpu.graphs.arrays import out_edge_table
+
+    src = np.array([2, 0, 2, 1, 2])
+    out_edges, deg = out_edge_table(src, 4)
+    assert deg.tolist() == [1, 1, 3, 0]
+    assert out_edges.shape == (4, 3)
+    assert out_edges[0, 0] == 1 and out_edges[1, 0] == 3
+    assert sorted(out_edges[2].tolist()) == [0, 2, 4]
+    # empty edge list: one padded slot, all-zero degrees
+    oe, dg = out_edge_table(np.array([], dtype=np.int64), 2)
+    assert oe.shape == (2, 1) and dg.tolist() == [0, 0]

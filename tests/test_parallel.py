@@ -398,3 +398,75 @@ def test_batched_dsa_distinct_cost_cubes():
                         probability=0.7, variant="B")
     sel, _c, _f = runner.run(seed=2, max_cycles=40)
     assert sel.shape == (4, 12)
+
+
+def test_sharded_mgm2_validation_and_edge_cases():
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    arrays = coloring_hypergraph_arrays(12, 24, 3, seed=1)
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError):
+        ShardedMgm2(arrays, mesh, batch=3)  # not a dp multiple
+    sm = ShardedMgm2(arrays, mesh, batch=4)
+    with pytest.raises(ValueError):
+        sm.run(5, seeds=[1, 2])  # wrong seed count
+    sel = sm.step_once()
+    assert sel.shape == (4, 12)
+
+
+def test_sharded_mgm2_no_binary_constraints():
+    """A problem with no neighbor pairs still compiles (inert padded
+    pair edge): every variable just takes its unary optimum."""
+    import numpy as np
+
+    from pydcop_tpu.graphs.arrays import HypergraphArrays
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import UnaryFunctionRelation
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    d = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("unary")
+    for i in range(4):
+        v = Variable(f"v{i}", d)
+        dcop += v
+        dcop.add_constraint(UnaryFunctionRelation(
+            f"u{i}", v, lambda val, i=i: abs(val - (i % 3))))
+    arrays = HypergraphArrays.build(dcop)
+    mesh = make_mesh(8)
+    sm = ShardedMgm2(arrays, mesh, batch=4)
+    sel, _ = sm.run(6)
+    for row in sel:
+        assert row.tolist() == [0, 1, 2, 0]
+
+
+def test_solve_sharded_unknown_algo():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.parallel import solve_sharded
+
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+agents: [a1]
+""")
+    with pytest.raises(ValueError, match="solve_sharded supports"):
+        solve_sharded(dcop, "dpop")
+
+
+def test_lane_solver_host_engine_equivalence():
+    """The lane solver shares the host mirror (it operates on the
+    layout-independent arrays): selections match the edge-major host
+    run exactly."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver, \
+        MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    arrays = coloring_factor_arrays(16, 32, 3, seed=3, noise=0.05)
+    lane = MaxSumLaneSolver(arrays, damping=0.5)
+    base = MaxSumSolver(arrays, damping=0.5)
+    r_lane = SyncEngine(lane).run(max_cycles=40)
+    r_base = SyncEngine(base).run(max_cycles=40)
+    assert r_lane.assignment == r_base.assignment

@@ -1,0 +1,68 @@
+"""Scenario model corners (reference: tests/unit/test_dcop_scenario.py):
+event/action equality, yaml round-trips, and the dialect's delay vs
+actions forms."""
+
+import pytest
+
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+from pydcop_tpu.dcop.yamldcop import load_scenario, yaml_scenario
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def test_event_action_equality_and_args():
+    a1 = EventAction("remove_agent", agents=["a1", "a2"])
+    a2 = EventAction("remove_agent", agents=["a1", "a2"])
+    a3 = EventAction("remove_agent", agents=["a3"])
+    assert a1 == a2 and a1 != a3
+    assert a1.args == {"agents": ["a1", "a2"]}
+
+
+def test_event_delay_vs_actions_forms():
+    delay = DcopEvent("d1", delay=2.5)
+    assert delay.is_delay and delay.actions is None
+    act = DcopEvent("e1", actions=[EventAction("remove_agent",
+                                               agents=["a1"])])
+    assert not act.is_delay
+    assert act.actions[0].type == "remove_agent"
+
+
+def test_scenario_iteration_and_len():
+    events = [DcopEvent("d1", delay=1.0),
+              DcopEvent("e1", actions=[EventAction("x")])]
+    s = Scenario(events)
+    assert len(s) == 2
+    assert [e.id for e in s] == ["d1", "e1"]
+    assert Scenario().events == []
+
+
+def test_scenario_yaml_roundtrip_preserves_structure():
+    s = Scenario([
+        DcopEvent("w1", delay=0.5),
+        DcopEvent("kill", actions=[
+            EventAction("remove_agent", agents=["a2"]),
+            EventAction("remove_agent", agents=["a3"]),
+        ]),
+    ])
+    back = load_scenario(yaml_scenario(s))
+    assert back == s
+
+
+def test_scenario_simple_repr_roundtrip():
+    s = Scenario([DcopEvent("e", actions=[
+        EventAction("remove_agent", agents=["a1"])])])
+    assert from_repr(simple_repr(s)) == s
+
+
+def test_load_scenario_dialect():
+    s = load_scenario("""
+events:
+  - id: wait
+    delay: 3
+  - id: boom
+    actions:
+      - type: remove_agent
+        agents: [a1]
+""")
+    assert len(s) == 2
+    assert s.events[0].is_delay and s.events[0].delay == 3
+    assert s.events[1].actions[0].args == {"agents": ["a1"]}

@@ -188,3 +188,35 @@ def test_networkx_adapters_and_metrics():
     chain = triangle[:2]
     assert cycles_count([v1, v2, v3], chain) == 0
     assert graph_diameter([v1, v2, v3], chain) == [2]
+
+
+def test_expression_function_comprehension_and_calls():
+    f = ExpressionFunction("sum(x * i for i in range(3)) + y")
+    assert sorted(f.variable_names) == ["x", "y"]
+    assert f(x=1, y=2) == 5
+
+
+def test_expression_function_nested_ternary_vars():
+    f = ExpressionFunction("a if c1 else (b if c2 else d)")
+    assert sorted(f.variable_names) == ["a", "b", "c1", "c2", "d"]
+
+
+def test_expression_function_math_module():
+    f = ExpressionFunction("round(abs(min(x, -2.7)))")
+    assert f(x=-1) == 3
+
+
+def test_expression_function_fixed_vars_partial():
+    f = ExpressionFunction("x + 10 * y", y=2)
+    assert sorted(f.variable_names) == ["x"]
+    assert f(x=1) == 21
+
+
+def test_expression_function_syntax_error():
+    with pytest.raises(SyntaxError):
+        ExpressionFunction("x +* y")
+
+
+def test_expression_function_string_methods():
+    f = ExpressionFunction("1 if v1 == 'R' else 0")
+    assert f(v1="R") == 1 and f(v1="G") == 0

@@ -1,0 +1,115 @@
+"""Wire-format round-trips for every algorithm's message types.
+
+Every message an algorithm posts must survive
+``simple_repr -> json -> from_repr`` under the receiver's allowlist —
+this is exactly what process mode does per message (the reference
+round-trips its message classes per algorithm test file, e.g.
+tests/unit/test_algorithms_maxsum.py)."""
+
+import json
+
+import pytest
+
+from pydcop_tpu.algorithms.adsa import ADsaValueMessage
+from pydcop_tpu.algorithms.amaxsum import AMaxSumCostsMessage
+from pydcop_tpu.algorithms.dba import (DbaEndMessage, DbaImproveMessage,
+                                       DbaOkMessage)
+from pydcop_tpu.algorithms.dpop import DpopUtilMessage, DpopValueMessage
+from pydcop_tpu.algorithms.dsa import DsaValueMessage
+from pydcop_tpu.algorithms.maxsum import MaxSumCostsMessage
+from pydcop_tpu.algorithms.mgm import MgmGainMessage, MgmValueMessage
+from pydcop_tpu.algorithms.mgm2 import (Mgm2GainMessage, Mgm2GoMessage,
+                                        Mgm2OfferMessage,
+                                        Mgm2ResponseMessage,
+                                        Mgm2ValueMessage)
+from pydcop_tpu.algorithms.ncbb import (NcbbCostMessage, NcbbStopMessage,
+                                        NcbbValueMessage)
+from pydcop_tpu.algorithms.syncbb import (SyncBBBackwardMessage,
+                                          SyncBBForwardMessage,
+                                          SyncBBTerminateMessage)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def _roundtrip(msg):
+    wire = json.dumps(simple_repr(msg))
+    return from_repr(json.loads(wire),
+                     allowed_prefixes=("pydcop_tpu.",))
+
+
+CASES = [
+    (lambda: DsaValueMessage("R"),
+     lambda m: m.value == "R"),
+    (lambda: ADsaValueMessage("G"),
+     lambda m: m.value == "G"),
+    (lambda: MgmValueMessage(2),
+     lambda m: m.value == 2),
+    (lambda: MgmGainMessage(0.25, -3.0),
+     lambda m: (m.gain, m.priority) == (0.25, -3.0)),
+    (lambda: Mgm2ValueMessage("G"),
+     lambda m: m.value == "G"),
+    (lambda: Mgm2OfferMessage([["R", "G", 1.5]], True),
+     lambda m: m.offers == [["R", "G", 1.5]] and m.is_offering is True),
+    (lambda: Mgm2ResponseMessage(True, "R", 2.0),
+     lambda m: m.accept and m.value == "R" and m.gain == 2.0),
+    (lambda: Mgm2GainMessage(0.0),
+     lambda m: m.gain == 0.0),
+    (lambda: Mgm2GoMessage(False),
+     lambda m: m.go is False),
+    (lambda: DbaOkMessage("B"),
+     lambda m: m.value == "B"),
+    (lambda: DbaImproveMessage(1.0, 2.0, 3),
+     lambda m: (m.improve, m.current_eval,
+                m.termination_counter) == (1.0, 2.0, 3)),
+    (lambda: DbaEndMessage(),
+     lambda m: True),
+    (lambda: MaxSumCostsMessage({"R": 0.5, "G": 1.5}),
+     lambda m: m.costs == {"R": 0.5, "G": 1.5}),
+    (lambda: AMaxSumCostsMessage({"R": 0.0}),
+     lambda m: m.costs == {"R": 0.0}),
+    (lambda: DpopUtilMessage([["x", ["R", "G"]]], [1.0, 2.0]),
+     lambda m: m.dims == [["x", ["R", "G"]]]
+     and m.costs == [1.0, 2.0]),
+    (lambda: DpopValueMessage([["x", "R"], ["y", "G"]]),
+     lambda m: m.assignment == [["x", "R"], ["y", "G"]]),
+    (lambda: NcbbValueMessage("R"),
+     lambda m: m.value == "R"),
+    (lambda: NcbbCostMessage(3.5),
+     lambda m: m.cost == 3.5),
+    (lambda: NcbbStopMessage(9.0),
+     lambda m: m.bound == 9.0),
+    (lambda: SyncBBForwardMessage([["v1", "R", 0.5]], 7.0),
+     lambda m: m.current_path == [["v1", "R", 0.5]] and m.ub == 7.0),
+    (lambda: SyncBBBackwardMessage([["v1", "R", 0.5]], 3.0,
+                                   [["v1", "R"]]),
+     lambda m: m.best == [["v1", "R"]] and m.ub == 3.0),
+    (lambda: SyncBBTerminateMessage([["v1", "R"], ["v2", "G"]], 2.0),
+     lambda m: m.assignment == [["v1", "R"], ["v2", "G"]]),
+]
+
+
+@pytest.mark.parametrize("factory,check", CASES,
+                         ids=[f().type for f, _ in CASES])
+def test_message_wire_roundtrip(factory, check):
+    msg = factory()
+    back = _roundtrip(msg)
+    assert back.type == msg.type
+    assert check(back)
+    assert back == msg
+
+
+def test_deep_nested_util_table_roundtrip():
+    """A 3-dim UTIL table (nested cost lists) crosses the wire with
+    exact cell values."""
+    costs = [[[0.0, 1.0], [2.0, 3.0]], [[4.0, 5.0], [6.0, 7.0]]]
+    msg = DpopUtilMessage(
+        [["x", [0, 1]], ["y", [0, 1]], ["z", [0, 1]]], costs)
+    back = _roundtrip(msg)
+    assert back.costs == costs
+
+
+def test_wire_size_accounting_is_finite():
+    """Every message type reports a usable size for the msg_size
+    metrics (reference counts message sizes per post)."""
+    for factory, _ in CASES:
+        msg = factory()
+        assert isinstance(msg.size, int) and msg.size >= 0, msg.type
