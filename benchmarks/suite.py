@@ -205,9 +205,64 @@ def bench_batched(quick=False):
     }
 
 
+def bench_mixed_hard_constraints(quick=False):
+    """The mixed soft/hard family (generate mixed_problem) on its home
+    algorithms: dba and mixeddsa drive the hard-constraint machinery
+    end-to-end through the compiled engine."""
+    from pydcop_tpu.generators.mixed import generate_mixed_problem
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    n = 20 if quick else 60
+    dcop = generate_mixed_problem(
+        n, 0, hard_proportion=0.3, arity=2, domain_range=6,
+        density=max(0.12, 6.0 / n), seed=23)
+    out = {}
+    for algo, params in (("mixeddsa", {"stop_cycle": 40}),
+                         ("dba", {"max_distance": 20,
+                                  "infinity": 10000})):
+        t0 = time.perf_counter()
+        res = solve_result(dcop, algo, timeout=120, **params)
+        out[algo] = {
+            "seconds": round(time.perf_counter() - t0, 3),
+            "violations": res.violations,
+            "status": res.status,
+        }
+    return {
+        "metric": f"mixed_{n}var_hard30pct",
+        "value": out, "unit": "per-algo",
+    }
+
+
+def bench_batched_localsearch(quick=False):
+    """BatchedDsa / BatchedMgm campaign throughput (BASELINE config 5's
+    local-search counterpart of bench_batched)."""
+    import jax
+
+    from pydcop_tpu.generators.fast import coloring_hypergraph_arrays
+    from pydcop_tpu.parallel.batch import BatchedDsa, BatchedMgm
+
+    batch = 64 if quick else 1024
+    template = coloring_hypergraph_arrays(100, 300, 3, seed=19)
+    out = {}
+    for name, cls, kw in (
+            ("dsa_b", BatchedDsa,
+             {"probability": 0.7, "variant": "B"}),
+            ("mgm", BatchedMgm, {})):
+        runner = cls(template, batch=batch, **kw)
+        t0 = time.perf_counter()
+        selections, _cycles, _fin = runner.run(seed=0, max_cycles=50)
+        jax.block_until_ready(selections)
+        out[name] = round(batch / (time.perf_counter() - t0), 1)
+    return {
+        "metric": f"batched_localsearch_{batch}x100var_instances_per_sec",
+        "value": out, "unit": "instances/s",
+    }
+
+
 BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_dpop_device_widetree,
-           bench_dpop_meetings, bench_localsearch_10k, bench_batched]
+           bench_dpop_meetings, bench_localsearch_10k, bench_batched,
+           bench_mixed_hard_constraints, bench_batched_localsearch]
 
 
 def main():
