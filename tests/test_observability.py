@@ -1,0 +1,126 @@
+"""Event bus + stats tracing unit tier (reference:
+tests/unit/test_infrastructure_Events.py + stats.py:49-103)."""
+
+import csv
+
+import pytest
+
+from pydcop_tpu.infrastructure import stats
+from pydcop_tpu.infrastructure.Events import EventDispatcher
+
+
+def test_bus_disabled_by_default_drops_events():
+    bus = EventDispatcher()
+    seen = []
+    bus.subscribe("topic.a", lambda t, e: seen.append((t, e)))
+    bus.send("topic.a", 1)
+    assert seen == []
+    bus.enabled = True
+    bus.send("topic.a", 2)
+    assert seen == [("topic.a", 2)]
+
+
+def test_bus_wildcard_prefix_subscription():
+    bus = EventDispatcher(enabled=True)
+    seen = []
+    bus.subscribe("computations.value.*",
+                  lambda t, e: seen.append(t))
+    bus.send("computations.value.v1", 0)
+    bus.send("computations.value.v2", 0)
+    bus.send("computations.cycle.v1", 0)
+    assert seen == ["computations.value.v1", "computations.value.v2"]
+
+
+def test_bus_unsubscribe_by_id():
+    bus = EventDispatcher(enabled=True)
+    seen = []
+    sid = bus.subscribe("t", lambda t, e: seen.append(e))
+    bus.send("t", 1)
+    bus.unsubscribe(sid)
+    bus.send("t", 2)
+    assert seen == [1]
+
+
+def test_bus_callback_error_does_not_break_others():
+    bus = EventDispatcher(enabled=True)
+    seen = []
+
+    def bad(t, e):
+        raise RuntimeError("boom")
+
+    bus.subscribe("t", bad, sub_id="bad")
+    bus.subscribe("t", lambda t, e: seen.append(e), sub_id="good")
+    bus.send("t", 7)
+    assert seen == [7]
+
+
+def test_bus_reset_clears_all():
+    bus = EventDispatcher(enabled=True)
+    seen = []
+    bus.subscribe("t", lambda t, e: seen.append(e))
+    bus.reset()
+    bus.send("t", 1)
+    assert seen == []
+
+
+def test_stats_tracer_rows_and_teardown(tmp_path):
+    path = str(tmp_path / "trace.csv")
+    stats.setup_tracing(path)
+    try:
+        stats.trace_computation("v1", 1, 0.002, msg_in_size=10,
+                                msg_out_size=20, op_count=3,
+                                non_concurrent_ops=1, value="R")
+        stats.trace_computation("v2", 2, 0.004)
+    finally:
+        stats.teardown_tracing()
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == stats.COLUMNS
+    assert len(rows) == 3
+    assert rows[1][1] == "v1" and rows[1][8] == "R"
+    assert rows[2][1] == "v2"
+    # tracing disabled after teardown: no error, no rows anywhere
+    stats.trace_computation("v3", 3, 0.001)
+
+
+def test_stats_setup_replaces_previous_tracer(tmp_path):
+    p1, p2 = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    stats.setup_tracing(p1)
+    stats.setup_tracing(p2)  # closes the first
+    try:
+        stats.trace_computation("v", 1, 0.001)
+    finally:
+        stats.teardown_tracing()
+    with open(p1) as f:
+        assert len(list(csv.reader(f))) == 1  # header only
+    with open(p2) as f:
+        assert len(list(csv.reader(f))) == 2
+
+
+def test_host_engine_cost_trace_collection():
+    """--run_metrics in engine mode rides the cost trace; the host
+    engine produces the same (cycle, cost) stream shape as the
+    compiled path."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(12, 24, 3, seed=5, noise=0.05)
+    solver = MaxSumSolver(arrays, damping=0.5, stop_cycle=12,
+                          stability=0.0)
+    res = SyncEngine(solver).run(max_cycles=50, collect_cost_every=4)
+    assert res.cycles == 12
+    assert [c for c, _ in res.cost_trace] == [4, 8, 12]
+    assert all(isinstance(c, float) for _, c in res.cost_trace)
+
+
+def test_host_engine_timeout_status():
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(12, 24, 3, seed=5)
+    solver = MaxSumSolver(arrays, stability=0.0)
+    res = SyncEngine(solver).run(max_cycles=10 ** 9, timeout=0.0)
+    assert res.status == "TIMEOUT"
+    assert res.cycles < 10 ** 9
