@@ -18,12 +18,7 @@ import numpy as np
 
 from ..engine.solver import ArraySolver
 from ..graphs.arrays import BIG, HypergraphArrays
-from ..ops.kernels import (
-    assignment_cost_device,
-    bucket_cost,
-    candidate_costs,
-    masked_argmin,
-)
+from ..ops.kernels import bucket_cost, candidate_costs
 
 
 class LocalSearchSolver(ArraySolver):
@@ -58,12 +53,25 @@ class LocalSearchSolver(ArraySolver):
 
     # --- shared kernels --------------------------------------------------
 
+    def _reduce_vplane(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Cross-shard reduction point for bucket-accumulated variable-
+        plane tensors ((V, D) candidate sums, (V,) counts).  Identity on
+        a single chip; the sharded harness (parallel/sharded_breakout)
+        overrides it with a psum over the tp mesh axis so the SAME step
+        code runs tp-sharded."""
+        return arr
+
+    def _reduce_scalar(self, v: jnp.ndarray) -> jnp.ndarray:
+        """Cross-shard reduction point for bucket-accumulated scalars
+        (violation totals).  Identity on a single chip."""
+        return v
+
     def local_costs(self, x: jnp.ndarray) -> jnp.ndarray:
         """(V, D) cost of each candidate value given neighbors at ``x``."""
-        total = self.var_costs
+        acc = jnp.zeros((self.V, self.D))
         for cubes, var_ids in self.buckets:
-            total = total + candidate_costs(cubes, var_ids, x, self.V)
-        return total
+            acc = acc + candidate_costs(cubes, var_ids, x, self.V)
+        return self.var_costs + self._reduce_vplane(acc)
 
     def random_values(self, key) -> jnp.ndarray:
         """Random initial value per variable (or the declared initial)."""
@@ -72,22 +80,25 @@ class LocalSearchSolver(ArraySolver):
         return jnp.where(self.has_initial, self.initial_idx, rand_idx)
 
     def total_cost(self, x: jnp.ndarray) -> jnp.ndarray:
-        return assignment_cost_device(self.buckets, self.var_costs, x)
+        V = self.var_costs.shape[0]
+        unary = jnp.sum(self.var_costs[jnp.arange(V), x])
+        acc = jnp.float32(0)
+        for cubes, var_ids in self.buckets:
+            acc = acc + jnp.sum(bucket_cost(cubes, var_ids, x))
+        return unary + self._reduce_scalar(acc)
 
     def var_has_violated_constraint(self, x: jnp.ndarray) -> jnp.ndarray:
         """(V,) bool: does the variable touch a constraint that is not at
         its own optimum (reference dsa.py exists_violated_constraint)."""
-        out = jnp.zeros((self.V,), dtype=bool)
+        counts = jnp.zeros((self.V,), dtype=jnp.int32)
         for (cubes, var_ids), opt in zip(self.buckets, self.bucket_optima):
             violated = bucket_cost(cubes, var_ids, x) > opt + 1e-6
             for p in range(var_ids.shape[1]):
-                out = out | (
-                    jax.ops.segment_max(
-                        violated.astype(jnp.int32), var_ids[:, p],
-                        num_segments=self.V,
-                    ) > 0
+                counts = counts + jax.ops.segment_sum(
+                    violated.astype(jnp.int32), var_ids[:, p],
+                    num_segments=self.V,
                 )
-        return out
+        return self._reduce_vplane(counts) > 0
 
     def neighbor_max_gain(self, gain: jnp.ndarray) -> jnp.ndarray:
         """(V,) max gain among each variable's neighbors (-inf if none)."""

@@ -75,7 +75,7 @@ class DbaSolver(LocalSearchSolver):
             shape = (ind.shape[0],) + (1,) * (ind.ndim - 1)
             total = total + candidate_costs(
                 ind * w.reshape(shape), var_ids, x, self.V)
-        return total
+        return self._reduce_vplane(total)
 
     def step(self, s):
         key, k_best = jax.random.split(s["key"])
@@ -115,7 +115,7 @@ class DbaSolver(LocalSearchSolver):
         cycle = s["cycle"] + 1
         return {
             "cycle": cycle,
-            "finished": total_violations < 0.5,
+            "finished": self._reduce_scalar(total_violations) < 0.5,
             "key": key,
             "x": x_new,
             "weights": tuple(new_weights),

@@ -96,9 +96,10 @@ class GdbaSolver(LocalSearchSolver):
         ar = jnp.arange(self.V)
 
         eff = self.effective_cubes(modifiers)
-        costs = self.var_costs
+        acc = jnp.zeros((self.V, self.D))
         for cubes, var_ids in eff:
-            costs = costs + candidate_costs(cubes, var_ids, x, self.V)
+            acc = acc + candidate_costs(cubes, var_ids, x, self.V)
+        costs = self.var_costs + self._reduce_vplane(acc)
         from ..ops.kernels import masked_min, random_argmin
 
         cur = jnp.where(self.domain_mask, costs, BIG * 2)[ar, x]

@@ -56,7 +56,7 @@ class MixedDsaSolver(LocalSearchSolver):
         total = jnp.zeros((self.V, self.D))
         for cubes, var_ids in self.hard_buckets:
             total = total + candidate_costs(cubes, var_ids, x, self.V)
-        return total
+        return self._reduce_vplane(total)
 
     def init_state(self, key):
         key, sub = jax.random.split(key)

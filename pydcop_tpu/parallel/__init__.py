@@ -64,10 +64,19 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
         solver = ShardedMgm2(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
+    elif algo in ("mixeddsa", "dba", "gdba"):
+        from .sharded_breakout import (ShardedDba, ShardedGdba,
+                                       ShardedMixedDsa)
+
+        cls = {"mixeddsa": ShardedMixedDsa, "dba": ShardedDba,
+               "gdba": ShardedGdba}[algo]
+        arrays = HypergraphArrays.build(filter_dcop(dcop))
+        solver = cls(arrays, mesh, batch=batch, **params)
+        sel, cycles = solver.run(n_cycles, seed=seed)
     else:
         raise ValueError(
-            f"solve_sharded supports maxsum/amaxsum/dsa/mgm/mgm2, "
-            f"not {algo!r}")
+            f"solve_sharded supports maxsum/amaxsum/dsa/mgm/mgm2/"
+            f"mixeddsa/dba/gdba, not {algo!r}")
 
     variables = [dcop.variable(n) for n in arrays.var_names]
     best_cost, best_assignment = None, None
@@ -85,8 +94,11 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
     return best_assignment, best_cost, cycles
 
 
+from .sharded_breakout import (ShardedDba, ShardedGdba,  # noqa: E402
+                               ShardedMixedDsa)
 from .sharded_mgm2 import ShardedMgm2  # noqa: E402
 
 __all__ = ["BatchedDsa", "BatchedMaxSum", "BatchedMgm",
-           "ShardedAMaxSum", "ShardedMaxSum", "ShardedMgm2",
+           "ShardedAMaxSum", "ShardedDba", "ShardedGdba",
+           "ShardedMaxSum", "ShardedMgm2", "ShardedMixedDsa",
            "make_mesh", "solve_sharded"]

@@ -470,3 +470,65 @@ def test_lane_solver_host_engine_equivalence():
     r_lane = SyncEngine(lane).run(max_cycles=40)
     r_base = SyncEngine(base).run(max_cycles=40)
     assert r_lane.assignment == r_base.assignment
+
+
+# ---- round 4: the generic breakout sharding harness -------------------
+
+
+def test_sharded_breakout_bit_identical_to_single_chip():
+    """The harness runs the UNMODIFIED solver step with psum hooks, so
+    a tp-sharded run is bit-identical to the single-chip solver on the
+    same sink-augmented view (integer costs: psum association exact)."""
+    from pydcop_tpu.parallel.sharded_breakout import (
+        ShardedDba, ShardedGdba, ShardedMixedDsa, _sink_view)
+    from pydcop_tpu.parallel.sharded_localsearch import \
+        _partition_constraints
+
+    arrays = coloring_hypergraph_arrays(24, 48, 3, seed=6)
+    mesh = make_mesh(8)
+    seeds = [5, 9, 11, 13]
+    for cls, kw in ((ShardedMixedDsa, {}),
+                    (ShardedDba, {"max_distance": 30}),
+                    (ShardedGdba, {})):
+        sharded = cls(arrays, mesh, batch=4, **kw)
+        sel, cycles = sharded.run(15, seeds=seeds)
+
+        full_view = _sink_view(arrays, _partition_constraints(arrays, 1),
+                               0)
+        for i, s in enumerate(seeds):
+            single = cls.solver_cls(full_view, **kw)
+            st = single.init_state(jax.random.PRNGKey(s))
+            for _ in range(cycles):
+                st = single.step(st)
+            expected = np.asarray(st["x"])[:24]
+            assert np.array_equal(sel[i], expected), \
+                (cls.__name__, s)
+
+
+def test_sharded_dba_terminates_on_solved():
+    """DBA's distributed termination (zero weighted violations) fires
+    across the mesh: run() stops before the cycle budget."""
+    from pydcop_tpu.parallel.sharded_breakout import ShardedDba
+
+    arrays = coloring_hypergraph_arrays(18, 30, 3, seed=2)
+    mesh = make_mesh(8)
+    sd = ShardedDba(arrays, mesh, batch=4, max_distance=50)
+    sel, cycles = sd.run(200)
+    assert cycles < 200
+    b = arrays.buckets[0]
+    for row in sel:
+        assert int(np.sum(row[b.var_ids[:, 0]] ==
+                          row[b.var_ids[:, 1]])) == 0
+
+
+def test_sharded_gdba_mode_combos_compile():
+    from pydcop_tpu.parallel.sharded_breakout import ShardedGdba
+
+    arrays = coloring_hypergraph_arrays(15, 24, 3, seed=3)
+    mesh = make_mesh(8)
+    for modifier, violation, increase in (
+            ("M", "NM", "R"), ("A", "MX", "C"), ("A", "NZ", "T")):
+        sg = ShardedGdba(arrays, mesh, batch=4, modifier=modifier,
+                         violation=violation, increase_mode=increase)
+        sel, _ = sg.run(8)
+        assert sel.shape == (4, 15)
