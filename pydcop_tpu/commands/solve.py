@@ -31,9 +31,15 @@ def set_parser(subparsers):
     parser.add_argument("-d", "--distribution", default="oneagent",
                         help="distribution method or yaml file")
     parser.add_argument("-m", "--mode", default="engine",
-                        choices=["engine", "thread", "process"],
+                        choices=["engine", "thread", "process",
+                                 "sharded"],
                         help="engine = compiled fast path (default); "
-                             "thread/process = orchestrated runtime")
+                             "thread/process = orchestrated runtime; "
+                             "sharded = dp x tp device-mesh data "
+                             "plane (multi-chip)")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="sharded mode: independent restarts on "
+                             "the dp axis (default: one per dp row)")
     parser.add_argument("-c", "--collect_on", default="value_change",
                         choices=["value_change", "cycle_change",
                                  "period"])
@@ -77,6 +83,37 @@ def run_cmd(args, timeout: Optional[float] = None):
             target=_collect_to_csv,
             args=(collector, args.run_metrics, stop_evt), daemon=True)
         collector_thread.start()
+
+    if args.mode == "sharded":
+        from . import parse_algo_params
+        from ..parallel import solve_sharded
+
+        # only user-given params travel (validated/cast by algo_def);
+        # defaults come from the sharded solvers themselves, and
+        # engine-level knobs are not sharded-solver constructor args
+        given = parse_algo_params(args.algo_params)
+        params = {k: algo_def.params[k] for k in given}
+        for engine_only in ("stop_cycle", "seed"):
+            params.pop(engine_only, None)
+        assignment, _best_cost, cycles = solve_sharded(
+            dcop, args.algo, n_cycles=args.max_cycles,
+            batch=args.batch, seed=args.seed, **params)
+        cost, violations = dcop.solution_cost(
+            assignment, infinity=args.infinity)
+        result = {
+            "status": "FINISHED",
+            "assignment": assignment,
+            "cost": cost,
+            "violation": violations,
+            "cycle": cycles,
+            "time": time.perf_counter() - t0,
+            "msg_count": 0,
+            "msg_size": 0,
+        }
+        if args.end_metrics:
+            _append_end_metrics(args.end_metrics, result)
+        output_json(result, args.output)
+        return 0
 
     if args.mode == "engine":
         from ..infrastructure.run import solve_result

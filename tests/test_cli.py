@@ -441,3 +441,14 @@ def test_generate_mixed_problem_roundtrip(tmp_path):
                    timeout=180)
     result = json.loads(proc.stdout)
     assert len(result["assignment"]) == 6
+
+
+def test_solve_sharded_mode(gc3_file):
+    """`solve -m sharded` drives the dp x tp device-mesh data plane
+    from the CLI (8 virtual devices in tests)."""
+    proc = run_cli("-t", "60", "solve", "-a", "dsa", "-m", "sharded",
+                   "--max_cycles", "30", gc3_file, timeout=180)
+    result = json.loads(proc.stdout)
+    assert result["status"] == "FINISHED"
+    assert result["assignment"]["v1"] != result["assignment"]["v2"]
+    assert result["assignment"]["v2"] != result["assignment"]["v3"]
