@@ -64,19 +64,22 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
         solver = ShardedMgm2(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
-    elif algo in ("mixeddsa", "dba", "gdba"):
-        from .sharded_breakout import (ShardedDba, ShardedGdba,
+    elif algo in ("mixeddsa", "dba", "gdba", "adsa", "dsatuto"):
+        from .sharded_breakout import (ShardedAdsa, ShardedDba,
+                                       ShardedDsatuto, ShardedGdba,
                                        ShardedMixedDsa)
 
         cls = {"mixeddsa": ShardedMixedDsa, "dba": ShardedDba,
-               "gdba": ShardedGdba}[algo]
+               "gdba": ShardedGdba, "adsa": ShardedAdsa,
+               "dsatuto": ShardedDsatuto}[algo]
         arrays = HypergraphArrays.build(filter_dcop(dcop))
         solver = cls(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
     else:
         raise ValueError(
-            f"solve_sharded supports maxsum/amaxsum/dsa/mgm/mgm2/"
-            f"mixeddsa/dba/gdba, not {algo!r}")
+            f"solve_sharded supports every iterative algorithm "
+            f"(maxsum/amaxsum/dsa/adsa/dsatuto/mgm/mgm2/mixeddsa/"
+            f"dba/gdba), not {algo!r}")
 
     variables = [dcop.variable(n) for n in arrays.var_names]
     best_cost, best_assignment = None, None

@@ -298,3 +298,15 @@ class ShardedDsaHarness(ShardedLocalSearch):
     against a known-good algorithm)."""
 
     solver_cls = DsaSolver
+
+
+class ShardedAdsa(ShardedLocalSearch):
+    """A-DSA (stochastic per-variable activation) over the mesh."""
+
+    from ..algorithms.adsa import ADsaSolver as solver_cls
+
+
+class ShardedDsatuto(ShardedLocalSearch):
+    """DSA-tuto over the mesh."""
+
+    from ..algorithms.dsatuto import DsaTutoSolver as solver_cls

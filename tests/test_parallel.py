@@ -532,3 +532,28 @@ def test_sharded_gdba_mode_combos_compile():
                          violation=violation, increase_mode=increase)
         sel, _ = sg.run(8)
         assert sel.shape == (4, 15)
+
+
+def test_sharded_adsa_and_dsatuto_through_harness():
+    """A-DSA and DSA-tuto ride the generic harness (they subclass
+    DsaSolver, whose accumulations route through the psum hooks) —
+    bit-identical to single chip on the sink view, like the rest."""
+    from pydcop_tpu.parallel.sharded_breakout import (
+        ShardedAdsa, ShardedDsatuto, _sink_view)
+    from pydcop_tpu.parallel.sharded_localsearch import \
+        _partition_constraints
+
+    arrays = coloring_hypergraph_arrays(20, 40, 3, seed=8)
+    mesh = make_mesh(8)
+    full_view = _sink_view(arrays, _partition_constraints(arrays, 1), 0)
+    for cls, kw in ((ShardedAdsa, {"period": 0.5}),
+                    (ShardedDsatuto, {})):
+        sharded = cls(arrays, mesh, batch=4, **kw)
+        sel, cycles = sharded.run(12, seeds=[1, 2, 3, 4])
+        single = cls.solver_cls(full_view, **kw)
+        for i, s in enumerate([1, 2, 3, 4]):
+            st = single.init_state(jax.random.PRNGKey(s))
+            for _ in range(cycles):
+                st = single.step(st)
+            assert np.array_equal(sel[i], np.asarray(st["x"])[:20]), \
+                (cls.__name__, s)
