@@ -39,10 +39,12 @@ def _job_id_params(filename: str) -> dict:
     out = {"set": parts[0], "batch": parts[1], "problem": parts[2],
            "iteration": parts[4]}
     # batch._job_id joins k=v pairs with ',' (collision-free: keys and
-    # values may both contain '_'); legacy '_'-joined ids from older
-    # campaigns are still split on '_' as before
-    sep = "," if "," in parts[3] or "_" not in parts[3] else "_"
-    for kv in parts[3].split(sep):
+    # values may both contain '_').  Legacy '_'-joined ids are detected
+    # by multiple '=' without a ',': a single param (one '=') must NOT
+    # be split on '_' — its key may contain one (damping_nodes=vars)
+    seg = parts[3]
+    sep = "," if "," in seg or seg.count("=") <= 1 else "_"
+    for kv in seg.split(sep):
         if "=" in kv:
             k, v = kv.split("=", 1)
             if k not in BASE_COLUMNS:  # never clobber a measured value

@@ -101,7 +101,10 @@ def run_cmd(args, timeout: Optional[float] = None):
         cost, violations = dcop.solution_cost(
             assignment, infinity=args.infinity)
         result = {
-            "status": "FINISHED",
+            # sharded runners stop early only on algorithm
+            # termination (SAME_COUNT stability, DBA zero violations)
+            "status": "FINISHED" if cycles < args.max_cycles
+            else "MAX_CYCLES",
             "assignment": assignment,
             "cost": cost,
             "violation": violations,

@@ -53,7 +53,10 @@ def _unary_constraints(variable_count, hard_count, domain_range, rng):
         w = _weight(rng)
         hard = rank < hard_count
         if hard:
-            obj = _reachable_objective([w], domain_range - 1, rng)
+            # full 0..r-1 draw like the n-ary path (the reference's
+            # unary path double-excludes the top value, generate.py:533
+            # — with r=2 its objective would always be 0)
+            obj = _reachable_objective([w], domain_range, rng)
             expr = f"float('inf') if {w}*v{n} != {obj} else 0"
         else:
             obj = round(rng.uniform(0, domain_range - 1), 2)
@@ -79,6 +82,9 @@ def _binary_constraints(variable_count, density, hard_proportion,
             f"could not draw a connected graph at density {density}; "
             f"raise -d")
     edges = list(g.edges())
+    # shuffled so hard constraints land on random edges, not the
+    # low-index vertices networkx enumerates first
+    rng.shuffle(edges)
     hard_count = int(round(hard_proportion * len(edges)))
     specs = {}
     for i, (u, v) in enumerate(edges):
@@ -96,32 +102,40 @@ def _nary_incidence(variable_count, constraint_count, arity,
                     edges_target, rng) -> Dict[int, List[int]]:
     """Random variable/constraint bipartite incidence: every variable
     appears somewhere, every constraint has at least one variable, no
-    constraint exceeds ``arity`` members, extra memberships are drawn
-    uniformly from the remaining open slots."""
+    constraint exceeds ``arity`` members.  Extra memberships are drawn
+    by rejection sampling over (not-full constraint, variable) pairs —
+    never materializing the V x C cross product, so 100k-scale
+    instances generate in seconds."""
     members: Dict[int, List[int]] = {c: [] for c in
                                      range(constraint_count)}
-    open_pairs = {(v, c) for v in range(variable_count)
-                  for c in range(constraint_count)}
+    not_full = list(range(constraint_count))  # swap-remove list
 
     def attach(v, c):
         members[c].append(v)
-        open_pairs.discard((v, c))
         if len(members[c]) == arity:
-            for vv in range(variable_count):
-                open_pairs.discard((vv, c))
+            i = not_full.index(c)
+            not_full[i] = not_full[-1]
+            not_full.pop()
 
     # every variable into a random not-full constraint
     for v in range(variable_count):
-        candidates = [c for c in members if len(members[c]) < arity]
-        attach(v, rng.choice(candidates))
+        attach(v, not_full[rng.randrange(len(not_full))])
     # every still-empty constraint gets a random variable
     for c in range(constraint_count):
         if not members[c]:
             attach(rng.randrange(variable_count), c)
-    # fill up to the density target
+    # fill up to the density target by rejection (a constraint has at
+    # most `arity` members, so a uniform variable draw almost always
+    # lands on a fresh slot)
     budget = edges_target - sum(len(m) for m in members.values())
-    while budget > 0 and open_pairs:
-        v, c = rng.choice(sorted(open_pairs))
+    stale = 0
+    while budget > 0 and not_full and stale < 64:
+        c = not_full[rng.randrange(len(not_full))]
+        v = rng.randrange(variable_count)
+        if v in members[c]:
+            stale += 1
+            continue
+        stale = 0
         attach(v, c)
         budget -= 1
     return members

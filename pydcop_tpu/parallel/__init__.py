@@ -82,19 +82,21 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
             f"dba/gdba), not {algo!r}")
 
     variables = [dcop.variable(n) for n in arrays.var_names]
-    best_cost, best_assignment = None, None
+    best_key, best = None, None
     for row in np.asarray(sel):
         assignment = {
             v.name: v.domain.values[int(i)]
             for v, i in zip(variables, row)
         }
-        cost, _violations = dcop.solution_cost(assignment)
-        better = best_cost is None or (
-            cost < best_cost if dcop.objective == "min"
-            else cost > best_cost)
-        if better:
-            best_cost, best_assignment = cost, assignment
-    return best_assignment, best_cost, cycles
+        cost, violations = dcop.solution_cost(assignment)
+        # rank restarts lexicographically by (violations, cost): with
+        # the default inf pricing every infeasible restart costs inf,
+        # so cost alone cannot distinguish 1 violation from 12
+        key = (violations,
+               cost if dcop.objective == "min" else -cost)
+        if best_key is None or key < best_key:
+            best_key, best = key, (assignment, cost)
+    return best[0], best[1], cycles
 
 
 from .sharded_breakout import (ShardedDba, ShardedGdba,  # noqa: E402

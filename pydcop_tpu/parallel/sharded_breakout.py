@@ -106,7 +106,11 @@ class ShardedLocalSearch:
         shard_buckets = _partition_constraints(arrays, self.tp)
         # one solver per shard view: shard 0's doubles as the template
         # whose step we trace; the others only donate their
-        # bucket-derived constants (violation cubes, optima, ...)
+        # bucket-derived constants (violation cubes, optima, ...).
+        # This re-creates the replicated V-plane constants tp times —
+        # transient megabytes, accepted so the harness needs zero
+        # per-algorithm knowledge of how those constants derive from
+        # the cubes
         shard_solvers = [
             self.solver_cls(_sink_view(arrays, shard_buckets, g),
                             **params)

@@ -311,8 +311,10 @@ class ShardedMaxSum:
             ),
             out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
             # pallas_call cannot declare vma on its outputs yet, so the
-            # varying-mesh-axis check must be off for the kernel path
-            check_vma=False,
+            # varying-mesh-axis check is off ONLY for the kernel path;
+            # the jnp paths keep the trace-time spec verification
+            check_vma=not (self.layout == "lane_major"
+                           and self.use_pallas),
         )
         def sharded(q, r, key, edge_var, cubes, var_costs,
                     domain_mask, domain_size):

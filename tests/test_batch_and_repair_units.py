@@ -217,3 +217,26 @@ def test_consolidate_legacy_underscore_job_ids(tmp_path):
     with open(out_csv) as f:
         rows = list(_csv.DictReader(f))
     assert rows[0]["algo"] == "dsa" and rows[0]["k"] == "3"
+
+
+def test_consolidate_single_param_with_underscore_key(tmp_path):
+    """One param whose KEY contains '_' (damping_nodes=vars) must not
+    be split on the underscore (code-review r4)."""
+    import csv as _csv
+    import json
+    from argparse import Namespace
+
+    from pydcop_tpu.commands.batch import _job_id
+    from pydcop_tpu.commands.consolidate import run_cmd
+
+    job = _job_id("s1", "b1", "gc.yaml", {"damping_nodes": "vars"}, 0)
+    p = tmp_path / f"{job}.json"
+    p.write_text(json.dumps(
+        {"status": "FINISHED", "cost": 1.0, "violation": 0,
+         "cycle": 5, "time": 0.1, "msg_count": 1, "msg_size": 9}))
+    out_csv = tmp_path / "all.csv"
+    run_cmd(Namespace(result_files=[str(p)], csv_out=str(out_csv)))
+    with open(out_csv) as f:
+        rows = list(_csv.DictReader(f))
+    assert rows[0]["damping_nodes"] == "vars"
+    assert "nodes" not in rows[0]

@@ -449,6 +449,7 @@ def test_solve_sharded_mode(gc3_file):
     proc = run_cli("-t", "60", "solve", "-a", "dsa", "-m", "sharded",
                    "--max_cycles", "30", gc3_file, timeout=180)
     result = json.loads(proc.stdout)
-    assert result["status"] == "FINISHED"
+    # DSA has no self-termination: a full-budget run reports the cap
+    assert result["status"] == "MAX_CYCLES"
     assert result["assignment"]["v1"] != result["assignment"]["v2"]
     assert result["assignment"]["v2"] != result["assignment"]["v3"]

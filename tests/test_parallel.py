@@ -557,3 +557,34 @@ def test_sharded_adsa_and_dsatuto_through_harness():
                 st = single.step(st)
             assert np.array_equal(sel[i], np.asarray(st["x"])[:20]), \
                 (cls.__name__, s)
+
+
+def test_solve_sharded_ranks_restarts_by_violations():
+    """With inf-priced violations, cost alone cannot rank infeasible
+    restarts: the best-restart pick is lexicographic by
+    (violations, cost) (code-review r4)."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.parallel import solve_sharded
+
+    # a 2-colorable triangle is infeasible: every restart has >= 1
+    # violation, and the pick must still return a 1-violation optimum
+    src = """
+name: tri
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b}
+  y: {domain: b}
+  z: {domain: b}
+constraints:
+  cxy: {type: intention, function: float('inf') if x == y else 0}
+  cyz: {type: intention, function: float('inf') if y == z else 0}
+  czx: {type: intention, function: float('inf') if z == x else 0}
+agents: [a1, a2, a3]
+"""
+    dcop = load_dcop(src)
+    assignment, cost, _ = solve_sharded(dcop, "dsa", n_cycles=20,
+                                        seed=0, batch=8)
+    _, violations = dcop.solution_cost(assignment)
+    assert violations == 1  # the true optimum for this instance
