@@ -453,3 +453,19 @@ def test_solve_sharded_mode(gc3_file):
     assert result["status"] == "MAX_CYCLES"
     assert result["assignment"]["v1"] != result["assignment"]["v2"]
     assert result["assignment"]["v2"] != result["assignment"]["v3"]
+
+
+@pytest.mark.slow
+def test_replica_dist_command(gc3_file):
+    """`pydcop replica_dist -k 1` deploys, runs the UCS replication
+    protocol and prints the replica placement (reference:
+    commands/replica_dist.py:160-279)."""
+    proc = run_cli("-t", "60", "replica_dist", "-k", "1",
+                   "-a", "dsa", gc3_file, timeout=180)
+    result = json.loads(proc.stdout)
+    placement = result["replica_dist"]
+    # every variable computation has exactly one replica on another
+    # agent than its host
+    assert set(placement) >= {"v1", "v2", "v3"}
+    for comp, agents in placement.items():
+        assert len(agents) >= 1, comp

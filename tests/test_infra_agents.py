@@ -101,3 +101,64 @@ def test_agent_computation_listing_and_removal():
     assert c in a.computations()
     a.remove_computation("c")
     assert not a.has_computation("c")
+
+
+def test_notify_wrap_fires_after_wrapped():
+    from pydcop_tpu.infrastructure.agents import (_notify_finished_once,
+                                                  notify_wrap)
+
+    calls = []
+    wrapped = notify_wrap(lambda x: calls.append(("f", x)) or x * 2,
+                          lambda x: calls.append(("cb", x)))
+    assert wrapped(3) == 6
+    assert calls == [("f", 3), ("cb", 3)]
+
+    once_calls = []
+    wrapped_once = _notify_finished_once(
+        lambda: once_calls.append("f"), lambda: once_calls.append("cb"))
+    wrapped_once()
+    wrapped_once()
+    assert once_calls == ["f", "cb", "f"]  # cb fires only once
+
+
+def test_resilient_agent_replica_registry():
+    from pydcop_tpu.infrastructure.agents import (AgentException,
+                                                  ResilientAgent)
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer
+
+    agent = ResilientAgent("ra", InProcessCommunicationLayer(),
+                           replication="dist_ucs_hostingcosts")
+    agent.accept_replica("c1", {"fake": "def"})
+    assert "c1" in agent.replicas
+    assert "ra" in agent.discovery.replica_agents("c1")
+    agent.drop_replica("c1")
+    assert "c1" not in agent.replicas
+    assert "ra" not in agent.discovery.replica_agents("c1")
+
+    bare = ResilientAgent("rb", InProcessCommunicationLayer())
+    with pytest.raises(AgentException):
+        bare.replicate(2)
+
+
+def test_agent_metrics_activity_ratio_and_dict():
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer
+
+    agent = Agent("am", InProcessCommunicationLayer())
+    m = agent.metrics.to_dict()
+    assert {"count_ext_msg", "size_ext_msg", "activity_ratio",
+            "cycles"} <= set(m)
+    assert 0.0 <= agent.metrics.activity_ratio <= 1.0
+
+
+def test_agent_unknown_computation_raises():
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer
+
+    agent = Agent("ax", InProcessCommunicationLayer())
+    with pytest.raises(Exception):
+        agent.computation("missing")
+    assert not agent.has_computation("missing")
