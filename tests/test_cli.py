@@ -469,3 +469,37 @@ def test_replica_dist_command(gc3_file):
     assert set(placement) >= {"v1", "v2", "v3"}
     for comp, agents in placement.items():
         assert len(agents) >= 1, comp
+
+
+def test_strict_timeout_kills_at_deadline(tmp_path):
+    """--strict_timeout arms SIGALRM at --timeout (no 40s grace): a
+    run that cannot finish is killed with a clear message."""
+    import time as _time
+
+    slow = tmp_path / "slow.yaml"
+    # a big instance in thread mode cannot finish in 1s
+    n = 30
+    slow.write_text("""
+name: slow
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+""" + "".join(f"  v{i}: {{domain: colors}}\n" for i in range(n)) +
+"constraints:\n" + "".join(
+    f"  c{i}: {{type: intention, function: 1 if v{i} == v{(i+1)%n} "
+    f"else 0}}\n" for i in range(n)) +
+"agents: [" + ", ".join(f"a{i}" for i in range(n)) + "]\n")
+    t0 = _time.perf_counter()
+    proc = run_cli("-t", "1", "--strict_timeout", "solve", "-a", "dsa",
+                   "-m", "thread", str(slow), expect_ok=False,
+                   timeout=60)
+    elapsed = _time.perf_counter() - t0
+    # either the SIGALRM kill fired, or the run managed a graceful
+    # TIMEOUT teardown first — both must happen near the deadline,
+    # never after the 40 s non-strict slack
+    if proc.returncode == 1:
+        assert "Timeout exceeded" in proc.stderr
+    else:
+        assert json.loads(proc.stdout)["status"] == "TIMEOUT"
+    assert elapsed < 30
