@@ -503,3 +503,23 @@ variables:
     else:
         assert json.loads(proc.stdout)["status"] == "TIMEOUT"
     assert elapsed < 30
+
+
+def test_graph_stats_all_models(gc3_file):
+    """Every graph model the CLI advertises builds and reports stats."""
+    for model, nodes in (("constraints_hypergraph", 3),
+                         ("pseudotree", 3), ("ordered_graph", 3)):
+        proc = run_cli("graph", "-g", model, gc3_file)
+        result = json.loads(proc.stdout)
+        assert result["graph"]["nodes_count"] == nodes, model
+        assert result["inputs"]["graph"] == model
+
+
+def test_distribute_with_graph_only_and_cost(gc3_file):
+    """distribute accepts --graph without an algorithm (reference:
+    distribute.py) and reports the placement cost when applicable."""
+    proc = run_cli("distribute", "-d", "adhoc",
+                   "-g", "constraints_hypergraph", gc3_file)
+    result = json.loads(proc.stdout)
+    hosted = [c for cs in result["distribution"].values() for c in cs]
+    assert sorted(hosted) == ["v1", "v2", "v3"]
