@@ -572,3 +572,127 @@ def test_matrix_relation_argument_order_independent():
         r = base.to_matrix()
     assert r(x=1, y=0) == 2
     assert r(y=0, x=1) == 2  # kwargs order must not matter
+
+
+# ---- round 4b: hash / repr / slicing / init-form corners --------------
+# (reference: test_dcop_relations.py's per-class tiers)
+
+
+@pytest.fixture()
+def _xyd():
+    d = Domain("d", "", [0, 1, 2])
+    return Variable("x", d), Variable("y", d), d
+
+
+def test_relation_hashes_are_stable_and_usable_in_sets(_xyd):
+    x, y, d = _xyd
+    r1 = NAryFunctionRelation(lambda x, y: x + y, [x, y], name="r")
+    r2 = NAryFunctionRelation(lambda x, y: y + x, [x, y], name="r")
+    u1 = UnaryFunctionRelation("u", x, lambda v: v)
+    z = ZeroAryRelation("z", 1.0)
+    m = NAryMatrixRelation([x], np.zeros(3), name="m")
+    assert hash(r1) == hash(r2)  # same name+scope: same bucket
+    assert len({r1, r2}) == 1    # and equal pointwise
+    assert len({u1, z, m}) == 3
+
+
+def test_nary_function_relation_positional_arity_check(_xyd):
+    x, y, _ = _xyd
+    r = NAryFunctionRelation(lambda x, y: x - y, [x, y], name="r")
+    assert r(2, 1) == 1
+    with pytest.raises(ValueError):
+        r(1)
+    with pytest.raises(ValueError):
+        r(1, 2, 3)
+
+
+def test_nary_function_slice_unknown_var_raises(_xyd):
+    x, y, _ = _xyd
+    r = NAryFunctionRelation(lambda x, y: x + y, [x, y], name="r")
+    with pytest.raises(ValueError, match="unknown"):
+        r.slice({"zz": 1})
+
+
+def test_nary_function_with_expression_simple_repr(_xyd):
+    x, y, _ = _xyd
+    from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+    r = NAryFunctionRelation(ExpressionFunction("x * 10 + y"), [x, y],
+                             name="r")
+    back = from_repr(simple_repr(r))
+    assert back(x=2, y=1) == 21
+    assert back == r
+
+
+def test_nary_function_arbitrary_callable_reprs_as_matrix(_xyd):
+    """A lambda cannot serialize; simple_repr falls back to the
+    equivalent extensional matrix (our divergence from the reference,
+    which raises — the matrix form is wire-safe)."""
+    x, y, _ = _xyd
+    r = NAryFunctionRelation(lambda x, y: 2 * x + y, [x, y], name="r")
+    back = from_repr(simple_repr(r))
+    assert isinstance(back, NAryMatrixRelation)
+    for vx in (0, 1, 2):
+        for vy in (0, 1, 2):
+            assert back(x=vx, y=vy) == 2 * vx + vy
+
+
+def test_matrix_relation_init_forms(_xyd):
+    x, y, _ = _xyd
+    zero = NAryMatrixRelation([x, y], name="z")
+    assert zero(x=1, y=2) == 0.0
+    flat = NAryMatrixRelation([x], [5, 6, 7], name="one")
+    assert flat(x=2) == 7.0
+    nested = NAryMatrixRelation(
+        [x, y], [[0, 1, 2], [3, 4, 5], [6, 7, 8]], name="two")
+    assert nested(x=1, y=2) == 5.0
+    npm = NAryMatrixRelation([x], np.array([1.5, 2.5, 3.5]), name="np")
+    assert npm(x=0) == 1.5
+    scalarless = NAryMatrixRelation([], np.array(4.0), name="c")
+    assert scalarless() == 4.0
+
+
+def test_matrix_relation_value_by_list_and_dict(_xyd):
+    x, y, _ = _xyd
+    m = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3),
+                           name="m")
+    assert m.get_value_for_assignment([1, 2]) == 5.0
+    assert m.get_value_for_assignment({"x": 1, "y": 2}) == 5.0
+
+
+def test_matrix_relation_slice_unknown_var_raises(_xyd):
+    x, y, _ = _xyd
+    m = NAryMatrixRelation([x, y], np.zeros((3, 3)), name="m")
+    with pytest.raises(ValueError, match="unknown"):
+        m.slice({"zz": 0})
+
+
+def test_matrix_relation_slice_all_vars_gives_scalar(_xyd):
+    x, y, _ = _xyd
+    m = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3),
+                           name="m")
+    s = m.slice({"x": 2, "y": 0})
+    assert s.arity == 0 and s() == 6.0
+
+
+def test_from_func_relation_lifts_any_constraint(_xyd):
+    x, y, _ = _xyd
+    r = NAryFunctionRelation(lambda x, y: x * y, [x, y], name="r")
+    m = NAryMatrixRelation.from_func_relation(r)
+    assert m.name == "r" and m.shape == (3, 3)
+    assert m(x=2, y=2) == 4.0
+    # lifting a matrix copies it
+    m2 = NAryMatrixRelation.from_func_relation(m)
+    assert m2 == m and m2.matrix is not m.matrix
+
+
+def test_as_nary_decorator_preserves_name_and_scope(_xyd):
+    x, y, _ = _xyd
+
+    @AsNAryFunctionRelation(x, y)
+    def my_constraint(x, y):
+        return abs(x - y)
+
+    assert my_constraint.name == "my_constraint"
+    assert my_constraint.scope_names == ["x", "y"]
+    assert my_constraint(0, 2) == 2
