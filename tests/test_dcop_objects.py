@@ -145,3 +145,131 @@ def test_create_agents():
     agents = create_agents("a", list(range(5)), capacity=10)
     assert len(agents) == 5
     assert agents["a0"].capacity == 10
+
+
+# ---- round 4: variable/domain/agent corner tier -----------------------
+# (reference: tests/unit/test_dcop_variables.py, 46 tests)
+
+
+def test_domain_dunder_surface():
+    d = Domain("d", "t", ["a", "b", "c"])
+    assert len(d) == 3
+    assert list(d) == ["a", "b", "c"]
+    assert d[1] == "b"
+    assert "b" in d and "z" not in d
+    assert d.index("c") == 2
+    with pytest.raises(ValueError):
+        d.index("z")
+    with pytest.raises(ValueError):
+        d.to_domain_value("z")
+
+
+def test_domain_equality_by_content():
+    assert Domain("d", "t", [1, 2]) == Domain("d", "t", [1, 2])
+    assert Domain("d", "t", [1, 2]) != Domain("d", "t", [2, 1])
+    assert Domain("d", "t", [1, 2]) != Domain("e", "t", [1, 2])
+    assert len({Domain("d", "t", [1, 2]),
+                Domain("d", "t", [1, 2])}) == 1
+
+
+def test_variable_clone_is_independent_equal():
+    d = Domain("d", "", [0, 1])
+    v = Variable("v", d, initial_value=1)
+    c = v.clone()
+    assert c == v and c is not v
+    assert c.initial_value == 1
+
+
+def test_variable_equality_includes_initial_value():
+    d = Domain("d", "", [0, 1])
+    assert Variable("v", d, 1) == Variable("v", d, 1)
+    assert Variable("v", d, 1) != Variable("v", d, 0)
+    assert Variable("v", d) != Variable("w", d)
+
+
+def test_variable_from_plain_iterable_domain():
+    v = Variable("v", [5, 6, 7])
+    assert isinstance(v.domain, Domain)
+    assert list(v.domain.values) == [5, 6, 7]
+    assert v.cost_for_val(6) == 0  # plain variables cost nothing
+
+
+def test_variable_with_cost_dict_clone_and_eq():
+    from pydcop_tpu.dcop.objects import VariableWithCostDict
+
+    d = Domain("d", "", [0, 1])
+    v = VariableWithCostDict("v", d, {0: 0.5, 1: 1.5})
+    assert v.cost_for_val(1) == 1.5
+    c = v.clone()
+    assert c == v
+    assert c.cost_for_val(0) == 0.5
+    v2 = VariableWithCostDict("v", d, {0: 0.5, 1: 9.9})
+    assert v != v2
+
+
+def test_variable_with_cost_func_eq_pointwise():
+    from pydcop_tpu.dcop.objects import VariableWithCostFunc
+    from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+    d = Domain("d", "", [0, 1, 2])
+    v1 = VariableWithCostFunc("v", d, ExpressionFunction("v * 2"))
+    v2 = VariableWithCostFunc("v", d, lambda x: x + x)
+    v3 = VariableWithCostFunc("v", d, lambda x: x * 3)
+    assert v1 == v2  # same costs over the domain
+    assert v1 != v3
+    assert v1.clone() == v1
+
+
+def test_noisy_cost_func_repr_roundtrip_keeps_costs():
+    from pydcop_tpu.dcop.objects import VariableNoisyCostFunc
+    from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    d = Domain("d", "", [0, 1, 2])
+    v = VariableNoisyCostFunc("v", d, ExpressionFunction("v * 1.0"),
+                              noise_level=0.05)
+    back = from_repr(simple_repr(v))
+    assert back.noise_level == v.noise_level
+    # noise is deterministic per (name, value): costs survive the wire
+    for val in d:
+        assert back.cost_for_val(val) == pytest.approx(
+            v.cost_for_val(val))
+
+
+def test_binary_variable_domain_and_clone():
+    from pydcop_tpu.dcop.objects import BinaryVariable
+
+    b = BinaryVariable("flag", initial_value=1)
+    assert list(b.domain.values) == [0, 1]
+    assert b.clone().initial_value == 1
+
+
+def test_binary_create_variables_prefix_forms():
+    from pydcop_tpu.dcop.objects import create_binary_variables
+
+    vs = create_binary_variables("b_", ["x", "y"])
+    assert set(vs) == {"b_x", "b_y"}
+
+
+def test_agentdef_extra_attrs_and_defaults():
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    a = AgentDef("a1", capacity=7, color="blue")
+    assert a.capacity == 7
+    assert a.color == "blue"  # arbitrary extras via __getattr__
+    with pytest.raises(AttributeError):
+        a.missing_attr
+    assert a.hosting_cost("anything") == 0
+    assert a.route("other") == 1
+
+
+def test_agentdef_route_symmetry_and_overrides():
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    a = AgentDef("a1", routes={"a2": 5}, default_route=2,
+                 hosting_costs={"c1": 3}, default_hosting_cost=9)
+    assert a.route("a2") == 5
+    assert a.route("a3") == 2
+    assert a.route("a1") == 0  # self route is free
+    assert a.hosting_cost("c1") == 3
+    assert a.hosting_cost("cX") == 9
