@@ -4,6 +4,8 @@ registrations and subscriptions crossing the (in-process) network
 
 import time
 
+import pytest
+
 from pydcop_tpu.infrastructure.agents import Agent
 from pydcop_tpu.infrastructure.communication import (
     InProcessCommunicationLayer)
@@ -188,3 +190,98 @@ def test_technical_computations_filtered():
     assert "v1" in d.computations()
     assert "_mgt_a1" not in d.computations()
     assert "_mgt_a1" in d.computations(include_technical=True)
+
+
+# ---- round 4: local-view corner tier ---------------------------------
+# (reference: tests/unit/test_infra_discovery.py, 37 tests)
+
+
+def test_unknown_agent_and_computation_raise():
+    from pydcop_tpu.infrastructure.discovery import (Discovery,
+                                                     UnknownAgent,
+                                                     UnknownComputation)
+
+    disco = Discovery("me", address="addr-me")
+    with pytest.raises(UnknownAgent):
+        disco.agent_address("ghost")
+    with pytest.raises(UnknownComputation):
+        disco.computation_agent("ghost_c")
+    with pytest.raises(UnknownAgent):
+        disco.unregister_agent("ghost")
+    with pytest.raises(UnknownComputation):
+        disco.unregister_computation("ghost_c")
+
+
+def test_unregister_agent_drops_its_computations():
+    from pydcop_tpu.infrastructure.discovery import (Discovery,
+                                                     UnknownComputation)
+
+    disco = Discovery("me")
+    disco.register_agent("a2", "addr2", publish=False)
+    disco.register_computation("c1", agent="a2", publish=False)
+    disco.register_computation("c2", agent="me", publish=False)
+    disco.unregister_agent("a2", publish=False)
+    with pytest.raises(UnknownComputation):
+        disco.computation_agent("c1")
+    assert disco.computation_agent("c2") == "me"
+
+
+def test_stale_unregistration_ignored():
+    """Unregistering a computation naming a stale host is a no-op:
+    someone else re-registered it meanwhile."""
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    disco = Discovery("me")
+    disco.register_computation("c1", agent="a1", publish=False)
+    disco.register_computation("c1", agent="a2", publish=False)
+    disco.unregister_computation("c1", agent="a1", publish=False)
+    assert disco.computation_agent("c1") == "a2"  # survived
+
+
+def test_one_shot_callbacks_fire_once():
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    disco = Discovery("me")
+    events = []
+    disco.subscribe_agent_local(
+        "a2", lambda evt, *a: events.append(evt), one_shot=True)
+    disco.register_agent("a2", "x", publish=False)
+    disco.unregister_agent("a2", publish=False)
+    assert events == ["agent_added"]
+
+
+def test_unsubscribe_specific_callback():
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    disco = Discovery("me")
+    kept, dropped = [], []
+    keep_cb = lambda evt, *a: kept.append(evt)  # noqa: E731
+    drop_cb = lambda evt, *a: dropped.append(evt)  # noqa: E731
+    disco.subscribe_agent_local("a2", keep_cb)
+    disco.subscribe_agent_local("a2", drop_cb)
+    disco.unsubscribe_agent("a2", drop_cb)
+    disco.register_agent("a2", "x", publish=False)
+    assert kept == ["agent_added"] and dropped == []
+
+
+def test_register_computation_defaults_to_own_agent():
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    disco = Discovery("me", address="addr-me")
+    disco.register_computation("c9", publish=False)
+    assert disco.computation_agent("c9") == "me"
+    assert "c9" in disco.agent_computations("me")
+
+
+def test_re_register_same_computation_no_duplicate_event():
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    disco = Discovery("me")
+    events = []
+    disco.subscribe_computation_local(
+        "c1", lambda evt, *a: events.append(evt))
+    disco.register_computation("c1", agent="a1", publish=False)
+    disco.register_computation("c1", agent="a1", publish=False)  # same
+    assert events == ["computation_added"]
+    disco.register_computation("c1", agent="a2", publish=False)  # moved
+    assert events == ["computation_added", "computation_added"]
