@@ -94,3 +94,61 @@ def test_uniform_cost_search_max_paths_bound():
     table = uniform_cost_search("a", list("abcdef"), route,
                                 max_paths=3)
     assert len(table) == 3
+
+
+# ---- round 4: UCS route-graph corners --------------------------------
+# (reference: tests/unit/test_replication_path_utils.py, 20 tests)
+
+
+def test_ucs_finds_cheapest_multihop_route():
+    routes = {("s", "a"): 5.0, ("s", "b"): 1.0, ("b", "a"): 1.0,
+              ("a", "t"): 1.0, ("b", "t"): 10.0}
+
+    def route(u, v):
+        return routes.get((u, v), routes.get((v, u), float("inf")))
+
+    table = uniform_cost_search("s", ["s", "a", "b", "t"], route)
+    cost, path = cheapest_path_to("t", table)
+    # s->b->a->t (1+1+1) beats s->a->t (5+1) and s->b->t (1+10)
+    assert cost == 3.0 and path == ("s", "b", "a", "t")
+
+
+def test_ucs_unreachable_agents_absent():
+    def route(u, v):
+        return 1.0 if {u, v} == {"s", "a"} else float("inf")
+
+    table = uniform_cost_search("s", ["s", "a", "island"], route)
+    targets = {p[-1] for p in table}
+    assert targets == {"a"}
+    cost, path = cheapest_path_to("island", table)
+    assert cost == float("inf") and path == ()
+
+
+def test_ucs_max_paths_caps_expansion():
+    def route(u, v):
+        return 1.0
+
+    agents = [f"a{i}" for i in range(6)] + ["s"]
+    table = uniform_cost_search("s", agents, route, max_paths=3)
+    assert len(table) == 3
+
+
+def test_path_starting_with_sorted_suffixes():
+    table = {("s", "a"): 2.0, ("s", "a", "b"): 3.0,
+             ("s", "c"): 1.0, ("x", "y"): 0.5}
+    out = path_starting_with(("s",), table)
+    assert out == [(1.0, ("c",)), (2.0, ("a",)), (3.0, ("a", "b"))]
+    # exact-prefix-only: a path equal to the prefix is not an extension
+    assert path_starting_with(("s", "a", "b"), table) == []
+
+
+def test_filter_missing_agents_paths_drops_traversals():
+    table = {("s", "a", "t"): 3.0, ("s", "b"): 1.0}
+    kept = filter_missing_agents_paths(table, ["s", "b", "t"])
+    assert kept == {("s", "b"): 1.0}
+
+
+def test_before_last_requires_two_hops():
+    assert before_last(("a", "b", "c")) == "b"
+    with pytest.raises(IndexError):
+        before_last(("a",))
