@@ -643,3 +643,78 @@ hosting_costs:
     assert dcop.agents["a2"].hosting_cost("x") == 4    # agent default
     assert dcop.agents["a3"].hosting_cost("x") == 0    # explicit
     assert dcop.agents["a3"].hosting_cost("other") == 2
+
+
+def test_boolean_domain_values():
+    dcop = load_dcop("""
+name: t
+domains:
+  onoff: {values: [true, false], type: binary}
+variables:
+  x: {domain: onoff}
+agents: [a1]
+""")
+    assert list(dcop.domains["onoff"].values) == [True, False]
+    assert dcop.variables["x"].domain.type == "binary"
+
+
+def test_multiline_intention_constraint():
+    """Statement-form constraint bodies (return + newlines) load
+    through the yaml block scalar (reference: multiline intention
+    constraints)."""
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  c:
+    type: intention
+    function: |
+      diff = abs(x - y)
+      return diff * 2
+agents: [a1]
+""")
+    c = dcop.constraints["c"]
+    assert c(x=0, y=2) == 4
+    assert c(x=1, y=1) == 0
+
+
+def test_host_with_hints_symmetric_closure():
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+  z: {domain: d}
+agents: [a1, a2]
+distribution_hints:
+  host_with:
+    x: [y, z]
+""")
+    hints = dcop.dist_hints
+    # symmetric + transitive closure: y and z each host with the others
+    assert set(hints.host_with("x")) == {"y", "z"}
+    assert set(hints.host_with("y")) == {"x", "z"}
+    assert set(hints.host_with("z")) == {"x", "y"}
+
+
+def test_must_host_unknown_agent_or_target_raises():
+    base = """
+name: t
+domains:
+  d: {values: [0]}
+variables:
+  x: {domain: d}
+agents: [a1]
+distribution_hints:
+  must_host:
+"""
+    with pytest.raises(ValueError, match="unknown agent"):
+        load_dcop(base + "    ghost: [x]\n")
+    with pytest.raises(ValueError, match="unknown variable"):
+        load_dcop(base + "    a1: [nope]\n")
