@@ -371,6 +371,17 @@ class SynchronousComputationMixin:
         if getattr(self, "_is_paused", False):
             self._paused_messages_recv.append((sender, msg, t))
             return
+        if sender not in self.neighbors:
+            # a non-neighbor cannot take part in the round barrier: its
+            # message would sit in the round payload and confuse the
+            # algorithm's per-sender handling (the reference rejects
+            # unknown-computation messages outright; dropping is the
+            # distributed-safe form — e.g. a removed computation's last
+            # messages arriving after a repair re-deploy)
+            self.logger.warning(
+                "%s dropping message from non-neighbor %s (%s)",
+                self.name, sender, msg.type)
+            return
         cycle_id = getattr(msg, "_cycle_id", self._current_cycle)
         if cycle_id == self._current_cycle:
             self._cycle_messages[sender] = (msg, t)

@@ -355,3 +355,75 @@ def test_sync_pause_buffers_and_replays_rounds():
 def test_sync_cycle_count_lazy_init():
     c = SyncComp("a", ["b"])
     assert c.cycle_count == 0  # readable before start_cycle
+
+
+# ---- round 4: sync-mixin corner tier ---------------------------------
+# (reference: tests/unit/test_infra_synchronous_computation.py)
+
+
+def test_sync_no_neighbors_round_stays_open():
+    """The mixin's barrier never closes without neighbors — isolated
+    computations bypass it in on_start (every algorithm's mp backend
+    selects its unary optimum and calls finished() there)."""
+    c = SyncComp("a", [])
+    c.message_sender = MagicMock()
+    c.start()
+    c.start_cycle()
+    assert c.cycles == []
+
+
+def test_sync_shifted_neighbors_interleaved_rounds():
+    """One neighbor a round ahead: its early messages buffer and close
+    the next round exactly once the slower neighbor arrives."""
+    c = SyncComp("a", ["fast", "slow"])
+    c.message_sender = MagicMock()
+    c.start()
+    for cid in (0, 1):
+        m = Message("v")
+        m._cycle_id = cid
+        c.on_message("fast", m, 0.0)
+    assert c.cycles == []  # nothing closes without `slow`
+    m = Message("v")
+    m._cycle_id = 0
+    c.on_message("slow", m, 0.0)
+    assert [cid for cid, _ in c.cycles] == [0]
+    m = Message("v")
+    m._cycle_id = 1
+    c.on_message("slow", m, 0.0)
+    assert [cid for cid, _ in c.cycles] == [0, 1]
+    for cid, msgs in c.cycles:
+        assert set(msgs) == {"fast", "slow"}
+
+
+def test_sync_cycle_id_stamped_on_post(monkeypatch):
+    """post_msg during round N stamps _cycle_id=N on the outgoing
+    message (the receiver's barrier depends on it)."""
+    c = SyncComp("a", ["b"])
+    sent = []
+    c.message_sender = lambda src, dest, msg, prio, on_error=None: \
+        sent.append((dest, msg))
+    c.start()
+    c.post_msg("b", Message("v"))
+    assert sent and sent[0][1]._cycle_id == 0
+    # close round 0: the next post carries cycle 1
+    m = Message("v")
+    m._cycle_id = 0
+    c.on_message("b", m, 0.0)
+    c.post_msg("b", Message("v"))
+    assert sent[-1][1]._cycle_id == 1
+
+
+def test_sync_message_from_unknown_sender_ignored():
+    """A message from a non-neighbor must not corrupt the barrier."""
+    c = SyncComp("a", ["b"])
+    c.message_sender = MagicMock()
+    c.start()
+    rogue = Message("v")
+    rogue._cycle_id = 0
+    c.on_message("stranger", rogue, 0.0)  # dropped with a warning
+    assert c.cycles == []  # round did not close early
+    m = Message("v")
+    m._cycle_id = 0
+    c.on_message("b", m, 0.0)
+    assert len(c.cycles) == 1
+    assert "stranger" not in c.cycles[0][1]
