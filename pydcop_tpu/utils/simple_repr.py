@@ -187,14 +187,24 @@ def from_repr(r: Any, allowed_prefixes=None):
             if k not in (SIMPLE_REPR_CLASS_KEY, SIMPLE_REPR_MODULE_KEY)
         }
         if allowed_prefixes is None:
-            if hasattr(cls, "_from_repr"):
-                return cls._from_repr(**kwargs)
-            return cls(**kwargs)
+            try:
+                if hasattr(cls, "_from_repr"):
+                    return cls._from_repr(**kwargs)
+                return cls(**kwargs)
+            except TypeError as e:
+                # a repr missing (or carrying extra) constructor args:
+                # surface it as a malformed-repr error, not a bare
+                # TypeError deep inside the constructor
+                raise SimpleReprException(
+                    f"Invalid repr for {cls.__name__}: {e}")
         token = _UNTRUSTED.set(True)
         try:
             if hasattr(cls, "_from_repr"):
                 return cls._from_repr(**kwargs)
             return cls(**kwargs)
+        except TypeError as e:
+            raise SimpleReprException(
+                f"Invalid repr for {cls.__name__}: {e}")
         finally:
             _UNTRUSTED.reset(token)
     return r

@@ -220,3 +220,77 @@ def test_expression_function_syntax_error():
 def test_expression_function_string_methods():
     f = ExpressionFunction("1 if v1 == 'R' else 0")
     assert f(v1="R") == 1 and f(v1="G") == 0
+
+
+# ---- round 4: simple_repr corner tier --------------------------------
+# (reference: tests/unit/test_utils_simplerepr.py)
+
+
+def test_simple_repr_scalars_and_none_passthrough():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    for v in (1, 2.5, "s", True, None):
+        assert simple_repr(v) == v
+        assert from_repr(simple_repr(v)) == v
+
+
+def test_simple_repr_mixed_nested_collections():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    o = {"a": [1, {"b": (2, 3)}], "c": {4, 5}}
+    back = from_repr(simple_repr(o))
+    assert back["a"][0] == 1
+    assert tuple(back["a"][1]["b"]) == (2, 3)
+    assert set(back["c"]) == {4, 5}
+
+
+def test_simple_repr_object_in_collection():
+    from pydcop_tpu.dcop.objects import Domain
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    ds = [Domain("d1", "", [0]), Domain("d2", "", [1])]
+    back = from_repr(simple_repr(ds))
+    assert back == ds
+    assert all(isinstance(d, Domain) for d in back)
+
+
+def test_simple_repr_rejects_arbitrary_object():
+    from pydcop_tpu.utils.simple_repr import (SimpleReprException,
+                                              simple_repr)
+
+    class NotRepr:
+        pass
+
+    with pytest.raises(SimpleReprException):
+        simple_repr(NotRepr())
+
+
+def test_from_repr_missing_argument_raises():
+    from pydcop_tpu.dcop.objects import Domain
+    from pydcop_tpu.utils.simple_repr import (SimpleReprException,
+                                              from_repr, simple_repr)
+
+    r = simple_repr(Domain("d", "t", [0, 1]))
+    del r["values"]
+    with pytest.raises(SimpleReprException):
+        from_repr(r)
+
+
+class MappedPoint(SimpleRepr):
+    """Ctor arg `x` stored as `self._a`: declared via _repr_mapping
+    (reference: simple_repr attr remapping)."""
+
+    _repr_mapping = {"x": "a", "y": "b"}
+
+    def __init__(self, x, y):
+        self._a, self._b = x, y
+
+    def __eq__(self, o):
+        return (self._a, self._b) == (o._a, o._b)
+
+
+def test_simple_repr_constructor_attr_mapping():
+    p = MappedPoint(1, 2)
+    r = simple_repr(p)
+    assert r["x"] == 1 and r["y"] == 2
+    assert from_repr(r) == p
