@@ -269,6 +269,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
                         help="smaller sizes (CI-friendly)")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSONL results here "
+                             "(default: BENCH_SUITE.json next to this "
+                             "script's repo root, unless --quick)")
     args = parser.parse_args()
     results = []
     for bench in BENCHES:
@@ -282,8 +286,18 @@ def main():
         results.append(r)
         print(json.dumps(r))
     ok = sum(1 for r in results if "error" not in r)
-    print(json.dumps({"suite": "baseline_configs", "ok": ok,
-                      "total": len(results)}))
+    results.append({"suite": "baseline_configs", "ok": ok,
+                    "total": len(results)})
+    print(json.dumps(results[-1]))
+    out = args.out
+    if out is None and not args.quick:
+        import os
+
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_SUITE.json")
+    if out:
+        with open(out, "w") as f:
+            f.write("\n".join(json.dumps(r) for r in results) + "\n")
 
 
 if __name__ == "__main__":
