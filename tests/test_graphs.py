@@ -317,3 +317,59 @@ def test_out_edge_table_slots_and_degrees():
     # empty edge list: one padded slot, all-zero degrees
     oe, dg = out_edge_table(np.array([], dtype=np.int64), 2)
     assert oe.shape == (2, 1) and dg.tolist() == [0, 0]
+
+
+# ---- round 4: ordered graph + graph-object corners -------------------
+
+
+def test_ordered_graph_chain_structure():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.ordered_graph import build_computation_graph
+
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  vb: {domain: d}
+  va: {domain: d}
+  vc: {domain: d}
+constraints:
+  c1: {type: intention, function: va + vb}
+  c2: {type: intention, function: vb + vc}
+agents: [a1]
+""")
+    g = build_computation_graph(dcop)
+    nodes = {n.name: n for n in g.nodes}
+    # lexical order: va, vb, vc
+    assert nodes["va"].position == 0
+    assert nodes["va"].previous_node is None
+    assert nodes["va"].next_node == "vb"
+    assert nodes["vb"].previous_node == "va"
+    assert nodes["vb"].next_node == "vc"
+    assert nodes["vc"].next_node is None
+    # a constraint is owned by its LAST variable in the order
+    assert {c.name for c in nodes["vb"].constraints} == {"c1"}
+    assert {c.name for c in nodes["vc"].constraints} == {"c2"}
+
+
+def test_order_link_validation():
+    from pydcop_tpu.graphs.ordered_graph import OrderLink
+
+    link = OrderLink("next", "a", "b")
+    assert link.source == "a" and link.target == "b"
+    assert link.has_node("a") and not link.has_node("c")
+    with pytest.raises(ValueError):
+        OrderLink("sideways", "a", "b")
+
+
+def test_link_equality_and_node_membership():
+    from pydcop_tpu.graphs.objects import ComputationNode, Link
+
+    l1 = Link(["a", "b"], "link")
+    l2 = Link(["b", "a"], "link")
+    assert l1 == l2  # undirected membership equality
+    assert l1 != Link(["a", "c"], "link")
+    node = ComputationNode("a", "test", links=[l1])
+    assert "b" in node.neighbors
+    assert "a" not in node.neighbors  # no self link
