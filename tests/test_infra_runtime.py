@@ -993,3 +993,24 @@ def test_run_dcop_process_mode_maxsum_dynamic_real_messages():
                       timeout=90, port=9570, seed=3)
     assert result.assignment in VALID_GC3
     assert result.metrics["status"] == "FINISHED"
+
+
+@pytest.mark.slow
+def test_run_dcop_process_mode_scenario_agent_removal():
+    """Dynamic DCOP across OS processes: replication + an agent
+    removal + repair, all over real HTTP (the thread-mode repair path
+    has run since round 1; this drives the same protocol through the
+    process fabric)."""
+    from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, \
+        Scenario
+
+    dcop = load_dcop(GC3)
+    scenario = Scenario([
+        DcopEvent("d1", delay=1.0),
+        DcopEvent("e1", actions=[
+            EventAction("remove_agent", agents=["a1"])]),
+    ])
+    result = run_dcop(dcop, "maxsum", mode="process", timeout=120,
+                      port=9620, ktarget=1, scenario=scenario,
+                      max_cycles=100000)
+    assert set(result.assignment) == {"v1", "v2", "v3"}
