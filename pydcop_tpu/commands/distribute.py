@@ -39,6 +39,21 @@ def run_cmd(args, timeout=None):
     else:
         raise CliError("distribute needs --algo or --graph")
     cg = load_graph_module(graph_name).build_computation_graph(dcop)
+    # some algorithms declare no footprint model (dpop raises, like the
+    # reference's dpop.py:80-85): distribute without one instead of
+    # failing — methods then treat computations as unit-sized
+    if footprint is not None and cg.nodes:
+        probe = cg.nodes[0]
+        try:
+            footprint(probe)
+        except NotImplementedError:
+            footprint = None
+        try:
+            load(probe, "")
+        except NotImplementedError:
+            load = None
+        except Exception:
+            pass  # a real target may be needed; keep the callback
     dist_module = load_distribution_module(args.distribution)
     dist = dist_module.distribute(
         cg, dcop.agents_def, dcop.dist_hints, footprint, load)

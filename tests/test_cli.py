@@ -523,3 +523,31 @@ def test_distribute_with_graph_only_and_cost(gc3_file):
     result = json.loads(proc.stdout)
     hosted = [c for cs in result["distribution"].values() for c in cs]
     assert sorted(hosted) == ["v1", "v2", "v3"]
+
+
+@pytest.mark.parametrize("method,algo", [
+    # oneagent needs one agent per computation: gc3's 3 agents fit the
+    # 3-node hypergraph/pseudotree models but not the 5-node factor graph
+    ("oneagent", "mgm"), ("oneagent", "dsa"), ("oneagent", "dpop"),
+    ("adhoc", "maxsum"), ("adhoc", "dsa"), ("adhoc", "dpop"),
+    ("heur_comhost", "dsa"), ("ilp_fgdp", "maxsum"),
+    ("ilp_compref", "dsa"), ("gh_cgdp", "dsa"),
+])
+def test_distribute_cli_matrix(method, algo, gc3_file):
+    """The reference's dcop_cli distribute tier: every major method x
+    algorithm graph combo through the real CLI."""
+    proc = run_cli("distribute", "-d", method, "-a", algo, gc3_file,
+                   timeout=120)
+    result = json.loads(proc.stdout)
+    hosted = sorted(
+        c for cs in result["distribution"].values() for c in cs)
+    # every variable computation is placed exactly once
+    for v in ("v1", "v2", "v3"):
+        assert hosted.count(v) == 1, (method, algo)
+
+
+def test_distribute_cli_unknown_method(gc3_file):
+    proc = run_cli("distribute", "-d", "nosuchmethod", "-a", "dsa",
+                   gc3_file, expect_ok=False)
+    assert proc.returncode == 2
+    assert "Unknown distribution" in proc.stderr
