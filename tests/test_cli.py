@@ -551,3 +551,38 @@ def test_distribute_cli_unknown_method(gc3_file):
                    gc3_file, expect_ok=False)
     assert proc.returncode == 2
     assert "Unknown distribution" in proc.stderr
+
+
+@pytest.mark.slow
+def test_batch_parallel_jobs(tmp_path, gc3_file):
+    """--parallel N runs campaign jobs concurrently (the reference's
+    acknowledged TODO, commands/batch.py:68) — all results land and
+    the resume file survives concurrent appends."""
+    bench = tmp_path / "bench.yaml"
+    bench.write_text(f"""
+sets:
+  s1:
+    path: '{gc3_file}'
+    iterations: 2
+batches:
+  b1:
+    command: solve
+    command_options:
+      algo: [dsa, mgm]
+      algo_params:
+        - stop_cycle:5
+      timeout: 30
+""")
+    out_dir = str(tmp_path / "out")
+    run_cli("batch", str(bench), "--dir", out_dir, "--parallel", "4",
+            timeout=300)
+    results = [f for f in os.listdir(out_dir) if f.endswith(".json")]
+    assert len(results) == 4  # 2 algos x 2 iterations
+    for f in results:
+        with open(os.path.join(out_dir, f)) as fh:
+            assert json.load(fh)["status"] in ("FINISHED",
+                                               "MAX_CYCLES")
+    # resume: everything done
+    proc = run_cli("batch", str(bench), "--dir", out_dir,
+                   "--parallel", "4")
+    assert "0 to run" in proc.stdout

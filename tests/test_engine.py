@@ -100,3 +100,31 @@ def test_cost_trace_granularity():
     assert all(c <= 32 for c in cycles)
     # every trace entry carries a float cost
     assert all(isinstance(cost, float) for _, cost in res.cost_trace)
+
+
+def test_persistent_cache_respects_opt_out(monkeypatch, tmp_path):
+    """PYDCOP_TPU_NO_CACHE disables the XLA compilation cache; the CPU
+    platform never persists (AOT feature-drift SIGILL risk)."""
+    from pydcop_tpu.engine import _cache
+
+    monkeypatch.setattr(_cache, "_done", False)
+    monkeypatch.setenv("PYDCOP_TPU_NO_CACHE", "1")
+    monkeypatch.setenv("PYDCOP_TPU_CACHE_DIR", str(tmp_path / "xla"))
+    _cache.enable_persistent_cache()
+    assert not (tmp_path / "xla").exists()
+
+    # without the opt-out, the cpu platform still declines to persist
+    monkeypatch.setattr(_cache, "_done", False)
+    monkeypatch.delenv("PYDCOP_TPU_NO_CACHE")
+    _cache.enable_persistent_cache()
+    assert not (tmp_path / "xla").exists()
+
+
+def test_persistent_cache_is_idempotent(monkeypatch):
+    from pydcop_tpu.engine import _cache
+
+    monkeypatch.setattr(_cache, "_done", False)
+    _cache.enable_persistent_cache()
+    assert _cache._done
+    _cache.enable_persistent_cache()  # second call is a no-op
+    assert _cache._done
