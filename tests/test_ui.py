@@ -72,3 +72,59 @@ def test_ui_event_forwarding():
         event_bus.enabled = was_enabled
         server.stop()
         agent.clean_shutdown()
+
+
+def test_ui_unknown_command_and_garbage_frames():
+    """Unknown commands answer with an error frame; non-JSON frames
+    must not kill the connection."""
+    from websockets.sync.client import connect
+
+    agent = Agent("ui_err", InProcessCommunicationLayer())
+    agent.start()
+    server = UiServer(agent, port=0)
+    server.start()
+    try:
+        time.sleep(0.2)
+        with connect(f"ws://127.0.0.1:{server.port}") as ws:
+            ws.send(json.dumps({"cmd": "selfdestruct"}))
+            resp = json.loads(ws.recv(timeout=5))
+            assert "unknown command" in resp["error"]
+            ws.send("{not json")
+            # the server stays up: a well-formed request still answers
+            ws.send(json.dumps({"cmd": "agent"}))
+            answers = []
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                frame = json.loads(ws.recv(timeout=5))
+                answers.append(frame)
+                if any("agent" in a for a in answers):
+                    break
+            assert any(a.get("agent") == "ui_err" for a in answers)
+    finally:
+        server.stop()
+        agent.stop()
+        agent.clean_shutdown(1)
+
+
+def test_ui_two_concurrent_clients():
+    """Every connected client gets its own answer stream."""
+    from websockets.sync.client import connect
+
+    agent = Agent("ui_multi", InProcessCommunicationLayer())
+    agent.start()
+    server = UiServer(agent, port=0)
+    server.start()
+    try:
+        time.sleep(0.2)
+        with connect(f"ws://127.0.0.1:{server.port}") as w1, \
+                connect(f"ws://127.0.0.1:{server.port}") as w2:
+            w1.send(json.dumps({"cmd": "agent"}))
+            w2.send(json.dumps({"cmd": "computations"}))
+            r1 = json.loads(w1.recv(timeout=5))
+            r2 = json.loads(w2.recv(timeout=5))
+            assert r1["agent"] == "ui_multi"
+            assert r2["computations"] == []
+    finally:
+        server.stop()
+        agent.stop()
+        agent.clean_shutdown(1)
