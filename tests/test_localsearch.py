@@ -5,6 +5,7 @@ Golden values follow the reference's CI envelope
 must end in one of the two acceptable colorings.
 """
 
+import numpy as np
 import pytest
 
 from pydcop_tpu.dcop.yamldcop import load_dcop
@@ -175,3 +176,111 @@ def test_adsa_activation():
     a = solve(dcop, "adsa", timeout=30, max_cycles=400, seed=5,
               activation=0.3)
     assert no_conflicts(a), a
+
+
+# ---- round 4: compiled-solver semantic distinctions -------------------
+
+
+def _plateau_arrays():
+    """Two variables, one constraint that is constant: every move is
+    cost-neutral (a pure plateau)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation
+    from pydcop_tpu.graphs.arrays import HypergraphArrays
+
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("plateau")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop += x
+    dcop += y
+    dcop.add_constraint(
+        NAryFunctionRelation(lambda x, y: 1.0, [x, y], name="flat"))
+    return HypergraphArrays.build(dcop)
+
+
+def test_dsa_variant_a_never_moves_on_plateau():
+    """Variant A moves only on strict improvement: a flat landscape
+    freezes it; variant C keeps moving sideways."""
+    import jax
+
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+
+    arrays = _plateau_arrays()
+    for variant, expect_moves in (("A", False), ("C", True)):
+        solver = DsaSolver(arrays, probability=1.0, variant=variant)
+        s = solver.init_state(jax.random.PRNGKey(2))
+        x0 = np.asarray(s["x"]).copy()
+        moved = False
+        for _ in range(6):
+            s = solver.step(s)
+            if not np.array_equal(np.asarray(s["x"]), x0):
+                moved = True
+        assert moved == expect_moves, variant
+
+
+def test_dsa_variant_b_moves_only_when_violated():
+    """Variant B allows sideways moves only next to a violated
+    constraint: on a satisfied plateau it stays put."""
+    import jax
+
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+
+    arrays = _plateau_arrays()  # flat constraint is never 'violated'
+    solver = DsaSolver(arrays, probability=1.0, variant="B")
+    s = solver.init_state(jax.random.PRNGKey(2))
+    x0 = np.asarray(s["x"]).copy()
+    for _ in range(6):
+        s = solver.step(s)
+        assert np.array_equal(np.asarray(s["x"]), x0)
+
+
+def test_adsa_zero_activation_is_frozen():
+    import jax
+
+    from pydcop_tpu.algorithms.adsa import ADsaSolver
+    from pydcop_tpu.generators.fast import coloring_hypergraph_arrays
+
+    arrays = coloring_hypergraph_arrays(10, 20, 3, seed=1)
+    solver = ADsaSolver(arrays, probability=1.0, activation=0.0)
+    s = solver.init_state(jax.random.PRNGKey(0))
+    x0 = np.asarray(s["x"]).copy()
+    for _ in range(5):
+        s = solver.step(s)
+    assert np.array_equal(np.asarray(s["x"]), x0)
+
+
+def test_mixeddsa_prefers_hard_reduction():
+    """proba_hard=1, proba_soft=0: only moves that reduce hard
+    violations fire."""
+    import jax
+
+    from pydcop_tpu.algorithms.mixeddsa import MixedDsaSolver
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryFunctionRelation, \
+        UnaryFunctionRelation
+    from pydcop_tpu.graphs.arrays import HypergraphArrays
+
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("mixed")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop += x
+    dcop += y
+    # hard: x != y (infinite cost, the framework's hard marker);
+    # soft: prefer x == 1 (cost when x == 0)
+    dcop.add_constraint(NAryFunctionRelation(
+        lambda x, y: float("inf") if x == y else 0.0, [x, y],
+        name="hard"))
+    dcop.add_constraint(UnaryFunctionRelation(
+        "soft", x, lambda v: 0.5 if v == 0 else 0.0))
+    arrays = HypergraphArrays.build(dcop)
+    # proba_hard < 1 breaks the simultaneous-swap oscillation (two
+    # equal variables both moving every cycle stay equal forever)
+    solver = MixedDsaSolver(arrays, proba_hard=0.9, proba_soft=0.0)
+    s = solver.init_state(jax.random.PRNGKey(7))
+    for _ in range(20):
+        s = solver.step(s)
+    sel = np.asarray(s["x"])
+    names = arrays.var_names
+    assert sel[names.index("x")] != sel[names.index("y")]  # hard met
