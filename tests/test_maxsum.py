@@ -5,6 +5,7 @@ Golden values follow the reference's own CI assertions
 2-color graph coloring the optimum is v1=R, v2=G, v3=R.
 """
 
+import numpy as np
 import pytest
 
 from pydcop_tpu.algorithms import (
@@ -345,3 +346,48 @@ def test_host_engine_respects_stop_cycle_and_size_gate():
     # solver noise draws from the jax PRNG: must NOT take the host path
     noisy = MaxSumSolver(arrays, noise=0.01)
     assert not noisy.use_host_engine()
+
+
+def test_amaxsum_full_activation_equals_sync_maxsum():
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    """activation=1.0 refreshes every edge every cycle: the async
+    solver's trajectory collapses to the synchronous one exactly
+    (noise=0 makes both key-independent)."""
+    import jax
+
+    from pydcop_tpu.algorithms.amaxsum import AMaxSumSolver
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(16, 32, 3, seed=9, noise=0.05)
+    sync = MaxSumSolver(arrays, damping=0.5)
+    asyn = AMaxSumSolver(arrays, activation=1.0, damping=0.5)
+    s1 = sync.init_state(jax.random.PRNGKey(0))
+    s2 = asyn.init_state(jax.random.PRNGKey(123))  # key must not matter
+    for _ in range(15):
+        s1 = sync.step(s1)
+        s2 = asyn.step(s2)
+        assert np.array_equal(np.asarray(s1["q"]), np.asarray(s2["q"]))
+    assert np.array_equal(np.asarray(s1["selection"]),
+                          np.asarray(s2["selection"]))
+
+
+def test_damping_zero_is_undamped():
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    """damping=0 with any damping_nodes equals the raw update."""
+    import jax
+
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(12, 24, 3, seed=4, noise=0.05)
+    trajectories = []
+    for nodes in ("vars", "factors", "both", "none"):
+        solver = MaxSumSolver(arrays, damping=0.0,
+                              damping_nodes=nodes)
+        s = solver.init_state(jax.random.PRNGKey(0))
+        for _ in range(10):
+            s = solver.step(s)
+        trajectories.append(np.asarray(s["q"]))
+    for t in trajectories[1:]:
+        assert np.array_equal(trajectories[0], t)
