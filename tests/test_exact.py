@@ -227,3 +227,73 @@ def test_dpop_oversized_util_shards_over_mesh():
         dcop = load_dcop(src)
         dpop.solve_direct(dcop, device="jax", memory_limit=2000,
                           mesh=one)
+
+
+# ---- round 4: DPOP device-spine packing units ------------------------
+
+
+def test_util_plans_shape_and_ownership():
+    """Each node's plan: separators sorted, own variable last, every
+    constraint input mapped to existing dims."""
+    from pydcop_tpu.algorithms.dpop import _util_plans
+    from pydcop_tpu.dcop.relations import UnaryFunctionRelation
+    from pydcop_tpu.graphs import pseudotree
+
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  a: {domain: d}
+  b: {domain: d}
+  c: {domain: d}
+constraints:
+  cab: {type: intention, function: a + b}
+  cbc: {type: intention, function: b + c}
+  cac: {type: intention, function: a + c}
+agents: [x]
+""")
+    g = pseudotree.build_computation_graph(dcop)
+    plans = _util_plans(g, {})
+    for name, plan in plans.items():
+        assert plan["out_dims"][-1] == name  # own variable last
+        seps = list(plan["out_dims"][:-1])
+        assert seps == sorted(seps)
+        for _kind, _payload, dims in plan["inputs"]:
+            assert set(dims) <= set(plan["out_dims"])
+
+
+def test_pack_input_merges_minor_pair():
+    """_pack_input folds (last separator, own var) into one axis and
+    expands inputs that touch either of them over BOTH."""
+    import numpy as np
+
+    from pydcop_tpu.algorithms.dpop import _pack_input
+
+    sizes = {"s1": 2, "s2": 3, "own": 4}
+    out_dims = ("s1", "s2", "own")
+    # input over (s2, own): touches both merged dims -> last axis 12
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    packed, positions = _pack_input(arr, ("s2", "own"), out_dims, sizes)
+    assert packed.shape == (12,)
+    assert positions == (1,)
+    # input over s1 only: untouched, direct axis mapping
+    arr1 = np.ones(2, dtype=np.float32)
+    packed1, pos1 = _pack_input(arr1, ("s1",), out_dims, sizes)
+    assert packed1.shape == (2,) and pos1 == (0,)
+    # input over (own,) alone expands over the merged pair
+    arr2 = np.arange(4, dtype=np.float32)
+    packed2, pos2 = _pack_input(arr2, ("own",), out_dims, sizes)
+    assert packed2.shape == (12,)
+    assert pos2 == (1,)
+    # tiling: own varies fastest within the merged axis
+    assert packed2.tolist() == [0, 1, 2, 3] * 3
+
+
+def test_dpop_device_timeout_status():
+    from pydcop_tpu.algorithms import dpop
+
+    dcop = load_dcop(GC3)
+    res = dpop.solve_direct(dcop, device="host", timeout=0.0)
+    assert res.status == "TIMEOUT"
+    assert res.assignment == {}
