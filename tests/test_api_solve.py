@@ -300,3 +300,25 @@ def test_cost_trace_mgm_monotone():
     costs = [c for _cycle, c in res.cost_trace]
     for earlier, later in zip(costs, costs[1:]):
         assert later <= earlier + 1e-6
+
+
+def test_top_level_package_api():
+    """The one-import surface a reference user lands on:
+    pydcop_tpu.load_dcop_from_file / solve / run_dcop /
+    solve_sharded."""
+    import pydcop_tpu
+
+    path = os.path.join(INSTANCES, "graph_coloring_3.yaml")
+    dcop = pydcop_tpu.load_dcop_from_file(path)
+    assignment = pydcop_tpu.solve(dcop, "maxsum", timeout=10)
+    assert assignment == OPTIMUM
+
+    dcop = pydcop_tpu.load_dcop_from_file(path)
+    a2, _cost, cycles = pydcop_tpu.solve_sharded(dcop, "dsa",
+                                                 n_cycles=30, seed=1)
+    assert set(a2) == {"v1", "v2", "v3"} and cycles == 30
+
+    dcop = pydcop_tpu.load_dcop_from_file(path)
+    res = pydcop_tpu.run_dcop(dcop, "dsa", timeout=30, stop_cycle=10,
+                              seed=2)
+    assert set(res.assignment) == {"v1", "v2", "v3"}
