@@ -290,7 +290,10 @@ def main():
                     "total": len(results)})
     print(json.dumps(results[-1]))
     out = args.out
-    if out is None and not args.quick:
+    if out is None and not args.quick and ok == len(results) - 1:
+        # only a fully-green run may replace the checked-in baseline;
+        # a degraded run (dead accelerator -> error rows) must not
+        # clobber the numbers README cites
         import os
 
         out = os.path.join(os.path.dirname(os.path.dirname(

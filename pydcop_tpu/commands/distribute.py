@@ -48,6 +48,8 @@ def run_cmd(args, timeout=None):
             footprint(probe)
         except NotImplementedError:
             footprint = None
+        except Exception:
+            pass  # probe-node mismatch etc.: keep the callback
         try:
             load(probe, "")
         except NotImplementedError:

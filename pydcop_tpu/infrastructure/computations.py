@@ -348,6 +348,9 @@ class SynchronousComputationMixin:
         self._cycle_messages: Dict[str, Tuple[Message, float]] = {}
         self._next_cycle_messages: Dict[str, Tuple[Message, float]] = {}
         self._sent_this_cycle: set = set()
+        # neighbors are fixed per deployment: cache the membership set
+        # once instead of rebuilding the list per incoming message
+        self._neighbor_set = frozenset(self.neighbors)
         self._sync_initialized = True
 
     @property
@@ -371,7 +374,7 @@ class SynchronousComputationMixin:
         if getattr(self, "_is_paused", False):
             self._paused_messages_recv.append((sender, msg, t))
             return
-        if sender not in self.neighbors:
+        if sender not in self._neighbor_set:
             # a non-neighbor cannot take part in the round barrier: its
             # message would sit in the round payload and confuse the
             # algorithm's per-sender handling (the reference rejects

@@ -186,7 +186,7 @@ def from_repr(r: Any, allowed_prefixes=None):
             for k, v in r.items()
             if k not in (SIMPLE_REPR_CLASS_KEY, SIMPLE_REPR_MODULE_KEY)
         }
-        if allowed_prefixes is None:
+        def build():
             try:
                 if hasattr(cls, "_from_repr"):
                     return cls._from_repr(**kwargs)
@@ -196,15 +196,13 @@ def from_repr(r: Any, allowed_prefixes=None):
                 # surface it as a malformed-repr error, not a bare
                 # TypeError deep inside the constructor
                 raise SimpleReprException(
-                    f"Invalid repr for {cls.__name__}: {e}")
+                    f"Invalid repr for {cls.__name__}: {e}") from e
+
+        if allowed_prefixes is None:
+            return build()
         token = _UNTRUSTED.set(True)
         try:
-            if hasattr(cls, "_from_repr"):
-                return cls._from_repr(**kwargs)
-            return cls(**kwargs)
-        except TypeError as e:
-            raise SimpleReprException(
-                f"Invalid repr for {cls.__name__}: {e}")
+            return build()
         finally:
             _UNTRUSTED.reset(token)
     return r
