@@ -696,3 +696,38 @@ def test_as_nary_decorator_preserves_name_and_scope(_xyd):
     assert my_constraint.name == "my_constraint"
     assert my_constraint.scope_names == ["x", "y"]
     assert my_constraint(0, 2) == 2
+
+
+def test_neutral_relation_slice_and_matrix(_xyd):
+    x, y, _ = _xyd
+    n = NeutralRelation([x, y], name="n")
+    assert n(x=0, y=2) == 0
+    s = n.slice({"x": 1})
+    assert s.scope_names == ["y"] and s(y=0) == 0
+    m = n.to_matrix()
+    assert float(np.max(np.abs(m.matrix))) == 0.0
+
+
+def test_conditional_relation_false_condition_neutral(_xyd):
+    x, y, _ = _xyd
+    cond = UnaryBooleanRelation("c", x)
+    rel = UnaryFunctionRelation("r", y, lambda v: v * 5)
+    cr = ConditionalRelation(cond, rel)
+    # condition false (x=0): whole relation is neutral
+    assert cr(x=0, y=2) == 0
+    assert cr(x=1, y=2) == 10
+    # matrix form preserves the gating
+    m = NAryMatrixRelation.from_func_relation(cr)
+    assert m(x=0, y=2) == 0 and m(x=1, y=2) == 10
+
+
+def test_generate_assignment_orders_match(_xyd):
+    """generate_assignment (lists) and generate_assignment_as_dict
+    enumerate the same assignments in the same order — DPOP's matrix
+    semantics depend on it."""
+    x, y, _ = _xyd
+    lists = list(generate_assignment([x, y]))
+    dicts = list(generate_assignment_as_dict([x, y]))
+    assert len(lists) == len(dicts) == 9
+    for lst, dct in zip(lists, dicts):
+        assert lst == [dct["x"], dct["y"]]

@@ -260,3 +260,40 @@ def test_http_missing_headers_still_delivers(http_pair):
     assert resp.status_code == 200
     (envelope, _prio), = sink.received
     assert envelope.dest_comp == "c2" and envelope.cycle_id == 3
+
+
+def test_priority_constants_order():
+    """The four wire priorities keep the reference's ordering:
+    discovery < mgt < value < algo (lower number = served first)."""
+    from pydcop_tpu.infrastructure import communication as comm
+
+    assert comm.MSG_DISCOVERY < comm.MSG_MGT < comm.MSG_VALUE \
+        < comm.MSG_ALGO
+
+
+def test_messaging_fifo_within_priority():
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer, MSG_ALGO
+    from pydcop_tpu.infrastructure.computations import Message
+
+    agent = Agent("fifo", InProcessCommunicationLayer())
+    msging = agent.messaging
+    for i in range(5):
+        msging.post_local(Message("algo", i), MSG_ALGO)
+    got = [msging.next_msg().msg.content for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_messaging_counts_sizes():
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import \
+        InProcessCommunicationLayer, MSG_ALGO
+    from pydcop_tpu.infrastructure.computations import Message
+
+    agent = Agent("sz", InProcessCommunicationLayer())
+    msging = agent.messaging
+    before = dict(msging.count_ext_msg)
+    msging.post_local(Message("algo", "x"), MSG_ALGO)
+    # local posts are not external traffic
+    assert msging.count_ext_msg == before
