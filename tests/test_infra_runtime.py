@@ -1014,3 +1014,21 @@ def test_run_dcop_process_mode_scenario_agent_removal():
                       port=9620, ktarget=1, scenario=scenario,
                       max_cycles=100000)
     assert set(result.assignment) == {"v1", "v2", "v3"}
+
+
+def test_global_metrics_structure_and_activity():
+    """run_dcop's metrics carry the reference's global-metrics surface:
+    per-agent activity ratios, message counts/sizes, cost/violations
+    (reference: orchestrator.py:1215)."""
+    dcop = load_dcop(GC3)
+    result = run_dcop(dcop, "dsa", distribution="oneagent", timeout=30,
+                      stop_cycle=15, seed=4)
+    m = result.metrics
+    assert m["status"] == "FINISHED"
+    assert m["msg_count"] > 0 and m["msg_size"] > 0
+    activity = m["agents_activity"]
+    assert set(activity) == {"a1", "a2", "a3"}
+    for ratio in activity.values():
+        assert 0.0 <= ratio <= 1.0
+    assert m["violation_count"] == 0
+    assert m["cost"] == result.cost
