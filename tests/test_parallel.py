@@ -588,3 +588,53 @@ agents: [a1, a2, a3]
                                         seed=0, batch=8)
     _, violations = dcop.solution_cost(assignment)
     assert violations == 1  # the true optimum for this instance
+
+
+def test_sharded_maxsum_converges_early():
+    """SAME_COUNT stability fires across the mesh: an easy instance
+    stops well before the cycle budget."""
+    arrays = coloring_factor_arrays(16, 30, 3, seed=4, noise=0.05)
+    mesh = make_mesh(8)
+    sm = ShardedMaxSum(arrays, mesh, damping=0.5, stability=0.1,
+                       batch=4)
+    sel, cycles = sm.run(n_cycles=200)
+    assert cycles < 200
+    assert sel.shape == (4, 16)
+
+
+def test_sharded_cli_maxsum_layout_param(tmp_path):
+    """solve -m sharded passes algorithm params (layout) through to
+    the sharded solver."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prob = tmp_path / "gc.yaml"
+    prob.write_text("""
+name: gc4
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+  v4: {domain: colors}
+constraints:
+  c12: {type: intention, function: 1 if v1 == v2 else 0}
+  c23: {type: intention, function: 1 if v2 == v3 else 0}
+  c34: {type: intention, function: 1 if v3 == v4 else 0}
+agents: [a1, a2, a3, a4]
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "60",
+         "solve", "-a", "maxsum", "-m", "sharded",
+         "-p", "layout:edge_major", "-p", "noise:0.05",
+         "--max_cycles", "60", str(prob)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr
+    result = _json.loads(proc.stdout)
+    assert len(result["assignment"]) == 4
