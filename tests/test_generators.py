@@ -235,3 +235,41 @@ def test_mixed_problem_validation():
         generate_mixed_problem(3, 5, hard_proportion=0.5, arity=4)
     with pytest.raises(ValueError):
         generate_mixed_problem(5, 0, hard_proportion=0.5, arity=3)
+
+
+def test_ising_cost_ranges_and_grid_toroidality():
+    from pydcop_tpu.generators.ising import generate_ising
+
+    dcop = generate_ising(4, 4, bin_range=1.6, un_range=0.05, seed=3)
+    assert len(dcop.variables) == 16
+    # toroidal 4x4 grid: 2 * 16 binary constraints
+    binaries = [c for c in dcop.constraints.values()
+                if len(c.dimensions) == 2]
+    assert len(binaries) == 32
+    for c in binaries:
+        vals = [c(**{c.dimensions[0].name: a, c.dimensions[1].name: b})
+                for a in (0, 1) for b in (0, 1)]
+        assert all(abs(v) <= 1.6 + 1e-9 for v in vals)
+        # ising coupling: equal-spin cells mirror unequal-spin cells
+        assert vals[0] == vals[3] and vals[1] == vals[2]
+        assert vals[0] == -vals[1]
+
+
+def test_graphcoloring_intentional_extensional_same_costs():
+    """--extensive only changes the representation: both forms assign
+    identical costs to every assignment."""
+    import itertools
+    import random
+
+    a = generate_graph_coloring(6, 3, graph_type="random", p_edge=0.5,
+                                soft=True, seed=5, extensive=False)
+    b = generate_graph_coloring(6, 3, graph_type="random", p_edge=0.5,
+                                soft=True, seed=5, extensive=True)
+    assert set(a.constraints) == set(b.constraints)
+    rnd = random.Random(1)
+    for _ in range(12):
+        asgt = {n: rnd.choice(list(v.domain.values))
+                for n, v in a.variables.items()}
+        ca, va = a.solution_cost(asgt)
+        cb, vb = b.solution_cost(asgt)
+        assert ca == pytest.approx(cb) and va == vb
