@@ -427,3 +427,49 @@ def test_sync_message_from_unknown_sender_ignored():
     c.on_message("b", m, 0.0)
     assert len(c.cycles) == 1
     assert "stranger" not in c.cycles[0][1]
+
+
+# ---- round 4b: pause/resume + lifecycle corners ----------------------
+
+
+class TickComp(MessagePassingComputation):
+    def __init__(self, name):
+        super().__init__(name)
+        self.received = []
+
+    @register("tick")
+    def _on_tick(self, sender, msg, t):
+        self.received.append(msg.content)
+
+
+def test_pause_resume_replays_posted_messages():
+    c = TickComp("p1")
+    sent = []
+    c.message_sender = lambda src, dest, msg, prio, on_error=None: \
+        sent.append((dest, msg.content))
+    c.start()
+    c.pause()
+    c.post_msg("other", Message("tick", 1))
+    c.post_msg("other", Message("tick", 2))
+    assert sent == []  # buffered while paused
+    c.pause(False)
+    assert [x for _, x in sent] == [1, 2]
+
+
+def test_pause_buffers_incoming_until_resume():
+    c = TickComp("p2")
+    c.message_sender = lambda *a, **k: None
+    c.start()
+    c.pause()
+    c.on_message("x", Message("tick", 7), 0.0)
+    assert c.received == []
+    c.pause(False)
+    assert c.received == [7]
+
+
+def test_message_equality_and_size():
+    m1 = Message("t", {"a": 1})
+    m2 = Message("t", {"a": 1})
+    m3 = Message("t", {"a": 2})
+    assert m1 == m2 and m1 != m3
+    assert m1.size > 0
