@@ -474,3 +474,37 @@ def test_thread_path_rejects_unknown_agents_in_dist_file(tmp_path):
     dist_file.write_text("distribution:\n  b1: [v1, v2, v3]\n")
     with pytest.raises(ValueError, match="not part of this problem"):
         _prepare_run(dcop, "dsa", distribution=str(dist_file))
+
+
+# ---- round 4: Distribution object mutation corners -------------------
+
+
+def test_distribution_move_and_remove():
+    d = Distribution({"a1": ["c1", "c2"], "a2": ["c3"]})
+    d.move_computation("c2", "a2")
+    assert d.agent_for("c2") == "a2"
+    assert d.computations_hosted("a1") == ["c1"]
+    orphans = d.remove_agent("a2")
+    assert sorted(orphans) == ["c2", "c3"]
+    assert "a2" not in d.agents
+    assert not d.has_computation("c3")
+    with pytest.raises(Exception):
+        d.agent_for("c3")
+
+
+def test_distribution_host_on_agent_appends():
+    d = Distribution({"a1": ["c1"]})
+    d.host_on_agent("a1", ["c2"])
+    d.host_on_agent("a3", ["c4"])
+    assert sorted(d.computations_hosted("a1")) == ["c1", "c2"]
+    assert d.agent_for("c4") == "a3"
+    assert d.is_hosted(["c1", "c2", "c4"])
+    assert not d.is_hosted(["c1", "ghost"])
+
+
+def test_distribution_hints_defaults():
+    from pydcop_tpu.distribution.objects import DistributionHints
+
+    hints = DistributionHints(None, None)
+    assert hints.must_host("anyone") == []
+    assert hints.host_with("anything") == []
