@@ -373,3 +373,60 @@ def test_link_equality_and_node_membership():
     node = ComputationNode("a", "test", links=[l1])
     assert "b" in node.neighbors
     assert "a" not in node.neighbors  # no self link
+
+
+def test_arrays_carry_initial_values():
+    """Declared initial_value survives into the padded arrays and the
+    solvers' random_values respects it."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.arrays import HypergraphArrays
+
+    dcop = load_dcop("""
+name: t
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors, initial_value: B}
+  v2: {domain: colors}
+constraints:
+  c: {type: intention, function: 1 if v1 == v2 else 0}
+agents: [a1]
+""")
+    arrays = HypergraphArrays.build(dcop)
+    i1 = arrays.var_names.index("v1")
+    i2 = arrays.var_names.index("v2")
+    assert bool(arrays.has_initial[i1]) and arrays.initial_idx[i1] == 2
+    assert not bool(arrays.has_initial[i2])
+    solver = DsaSolver(arrays)
+    starts = {int(np.asarray(
+        solver.init_state(jax.random.PRNGKey(s))["x"])[i1])
+        for s in range(5)}
+    assert starts == {2}  # v1 always starts at its declared value
+
+
+def test_factor_graph_node_kinds_and_links():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.factor_graph import build_computation_graph
+
+    dcop = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+  y: {domain: d}
+constraints:
+  cxy: {type: intention, function: x + y}
+agents: [a1]
+""")
+    g = build_computation_graph(dcop)
+    names = {n.name for n in g.nodes}
+    assert names == {"x", "y", "cxy"}
+    factor = g.computation("cxy")
+    assert sorted(factor.neighbors) == ["x", "y"]
+    var = g.computation("x")
+    assert var.neighbors == ["cxy"]
