@@ -430,3 +430,38 @@ agents: [a1]
     assert sorted(factor.neighbors) == ["x", "y"]
     var = g.computation("x")
     assert var.neighbors == ["cxy"]
+
+
+def test_pseudotree_deterministic_rebuild():
+    """Same constraint graph -> identical pseudo-tree (parents, depths,
+    pseudo-parents): the exact solvers' reproducibility rests on it."""
+    d = Domain("d", "", [0, 1])
+    vs = {n: Variable(n, d) for n in "abcdef"}
+    constraints = [
+        constraint_from_str(f"c_{u}{v}", f"{u} + {v}", vs.values())
+        for u, v in ("ab", "bc", "cd", "da", "ce", "ef")
+    ]
+    def snapshot():
+        g = pseudotree.build_computation_graph(
+            variables=list(vs.values()), constraints=constraints)
+        return {
+            n.name: (n.parent, n.depth, tuple(sorted(n.pseudo_parents)),
+                     tuple(sorted(c.name for c in n.constraints)))
+            for n in g.nodes
+        }
+    assert snapshot() == snapshot()
+
+
+def test_pseudotree_pseudo_children_mirror_pseudo_parents():
+    d = Domain("d", "", [0, 1])
+    vs = {n: Variable(n, d) for n in ("a", "b", "c")}
+    constraints = [
+        constraint_from_str("c_ab", "a + b", vs.values()),
+        constraint_from_str("c_bc", "b + c", vs.values()),
+        constraint_from_str("c_ac", "a + c", vs.values()),
+    ]
+    g = pseudotree.build_computation_graph(
+        variables=list(vs.values()), constraints=constraints)
+    pp = [(n.name, p) for n in g.nodes for p in n.pseudo_parents]
+    pc = [(c, n.name) for n in g.nodes for c in n.pseudo_children]
+    assert sorted(pp) == sorted(pc)
