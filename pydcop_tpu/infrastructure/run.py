@@ -80,9 +80,15 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         # one compiled program (reference: run.py:108-124 builds the
         # graph + distribution before deploying).  Only computed when the
         # caller asks for one (default None: the engine doesn't need it).
+        from ..distribution.objects import Distribution
+
         graph = load_graph_module(
             algo_module.GRAPH_TYPE).build_computation_graph(dcop)
-        if _is_distribution_file(distribution):
+        if isinstance(distribution, Distribution):
+            # a pre-built placement object, like the thread/process
+            # path accepts (reference run.py takes all three forms)
+            dist_obj = distribution
+        elif _is_distribution_file(distribution):
             # a pre-computed placement file (same dispatch as the
             # thread/process path in _prepare_run)
             dist_obj = _load_checked_dist(distribution, graph,

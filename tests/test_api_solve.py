@@ -322,3 +322,30 @@ def test_top_level_package_api():
     res = pydcop_tpu.run_dcop(dcop, "dsa", timeout=30, stop_cycle=10,
                               seed=2)
     assert set(res.assignment) == {"v1", "v2", "v3"}
+
+
+def test_solve_result_accepts_distribution_object(gc3):
+    """A pre-built Distribution object is accepted anywhere a method
+    name or file is (reference run.py accepts all three)."""
+    from pydcop_tpu.distribution.objects import Distribution
+
+    dist = Distribution({"a1": ["v1", "v2", "v3", "diff_1_2",
+                                "diff_2_3"]})
+    res = solve_result(gc3, "maxsum", distribution=dist, timeout=10)
+    assert res.assignment == OPTIMUM
+
+
+def test_run_dcop_accepts_distribution_object():
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.distribution.objects import Distribution
+    from pydcop_tpu.infrastructure.run import run_dcop
+
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_3.yaml"))
+    dist = Distribution({
+        "a1": ["v1"], "a2": ["v2"], "a3": ["v3"]})
+    res = run_dcop(dcop, "dsa", distribution=dist, timeout=30,
+                   stop_cycle=10, seed=1)
+    assert set(res.assignment) == {"v1", "v2", "v3"}
+    placed = res.metrics.get("distribution") or dist.mapping()
+    assert placed["a2"] == ["v2"]
