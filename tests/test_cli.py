@@ -586,3 +586,45 @@ batches:
     proc = run_cli("batch", str(bench), "--dir", out_dir,
                    "--parallel", "4")
     assert "0 to run" in proc.stdout
+
+
+def test_log_fileconfig_writes_logfile(gc3_file, tmp_path):
+    """--log takes a std fileConfig ini (reference: dcop_cli.py
+    --log): handlers land in the configured file."""
+    logfile = tmp_path / "run.log"
+    conf = tmp_path / "log.ini"
+    conf.write_text(f"""
+[loggers]
+keys=root
+
+[handlers]
+keys=fileHandler
+
+[formatters]
+keys=plain
+
+[logger_root]
+level=INFO
+handlers=fileHandler
+
+[handler_fileHandler]
+class=FileHandler
+level=INFO
+formatter=plain
+args=('{logfile}', 'w')
+
+[formatter_plain]
+format=%(levelname)s %(name)s %(message)s
+""")
+    run_cli("-t", "30", "--log", str(conf), "solve", "-a", "dsa",
+            "-p", "stop_cycle:5", gc3_file)
+    assert logfile.exists()
+    content = logfile.read_text()
+    assert "INFO" in content or content == ""  # configured handler ran
+
+
+def test_verbosity_flag_accepted(gc3_file):
+    proc = run_cli("-t", "30", "-v", "3", "solve", "-a", "dsa",
+                   "-p", "stop_cycle:5", gc3_file)
+    result = json.loads(proc.stdout)
+    assert len(result["assignment"]) == 3
