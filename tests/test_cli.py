@@ -628,3 +628,25 @@ def test_verbosity_flag_accepted(gc3_file):
                    "-p", "stop_cycle:5", gc3_file)
     result = json.loads(proc.stdout)
     assert len(result["assignment"]) == 3
+
+
+@pytest.mark.parametrize("moment", ["cycle_change", "period"])
+def test_run_metrics_collection_moments(gc3_file, tmp_path, moment):
+    """-c cycle_change / period: the run-metrics stream follows the
+    selected collection moment (reference solve.py collect_on)."""
+    import csv as _csv
+
+    run_csv = str(tmp_path / f"{moment}.csv")
+    args = ["-t", "40", "solve", "-a", "dsa", "-m", "thread",
+            "-p", "stop_cycle:12", "-p", "seed:3",
+            "-c", moment, "--run_metrics", run_csv]
+    if moment == "period":
+        # slow the run down so the periodic sampler fires at least once
+        args += ["--period", "0.05", "--delay", "0.01"]
+        args[args.index("stop_cycle:12")] = "stop_cycle:40"
+    run_cli(*args, gc3_file, timeout=180)
+    with open(run_csv) as f:
+        rows = list(_csv.reader(f))
+    assert rows[0] == ["time", "computation", "value", "cost",
+                       "cycle"]
+    assert len(rows) > 1, moment
