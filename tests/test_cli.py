@@ -650,3 +650,44 @@ def test_run_metrics_collection_moments(gc3_file, tmp_path, moment):
     assert rows[0] == ["time", "computation", "value", "cost",
                        "cycle"]
     assert len(rows) > 1, moment
+
+
+@pytest.mark.slow
+def test_solve_thread_uiport_serves_websocket(gc3_file):
+    """--uiport in thread mode: each agent serves its live-state
+    websocket while the solve runs (docs/agent_ui.md)."""
+    import socket
+    import subprocess
+    import threading
+    import time as _time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "40",
+         "solve", "-a", "dsa", "-m", "thread", "-p", "stop_cycle:200",
+         "-p", "seed:1", "--delay", "0.02", "--uiport", str(base + 1),
+         gc3_file],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        import json as _json
+
+        from websockets.sync.client import connect
+
+        answer = None
+        deadline = _time.time() + 20
+        while _time.time() < deadline and answer is None:
+            try:
+                with connect(f"ws://127.0.0.1:{base + 2}",
+                             open_timeout=2) as ws:
+                    ws.send(_json.dumps({"cmd": "agent"}))
+                    answer = _json.loads(ws.recv(timeout=5))
+            except Exception:
+                _time.sleep(0.3)
+        assert answer is not None and answer["is_running"] is True
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
