@@ -273,3 +273,41 @@ def test_graphcoloring_intentional_extensional_same_costs():
         ca, va = a.solution_cost(asgt)
         cb, vb = b.solution_cost(asgt)
         assert ca == pytest.approx(cb) and va == vb
+
+
+def test_smallworld_ring_degree_structure():
+    from pydcop_tpu.generators.smallworld import generate_small_world
+
+    dcop = generate_small_world(12, k=4, p=0.0, seed=1)
+    # p=0: pure ring lattice, every variable touches exactly k others
+    deg = {v: 0 for v in dcop.variables}
+    for c in dcop.constraints.values():
+        a, b = (x.name for x in c.dimensions)
+        deg[a] += 1
+        deg[b] += 1
+    assert set(deg.values()) == {4}
+    assert len(dcop.constraints) == 12 * 4 // 2
+
+
+def test_meetings_peav_variables_per_resource_event():
+    """PEAV: one variable per (resource, event) pair the resource may
+    attend; all variables of one resource pairwise all-different."""
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+
+    dcop = generate_meetings(slots_count=5, events_count=3,
+                             resources_count=2, max_resources_event=2,
+                             seed=8)
+    # every variable name encodes meeting + resource (m<i>_r<j>)
+    for name in dcop.variables:
+        m, r = name.split("_")
+        assert m.startswith("m") and r.startswith("r")
+    # eq_* constraints bind the SAME meeting across resources;
+    # mutex_* constraints bind the SAME resource across meetings
+    for c in dcop.constraints.values():
+        if len(c.dimensions) != 2:
+            continue
+        (m0, r0), (m1, r1) = (v.name.split("_") for v in c.dimensions)
+        if c.name.startswith("eq_"):
+            assert m0 == m1 and r0 != r1, c.name
+        elif c.name.startswith("mutex_"):
+            assert r0 == r1 and m0 != m1, c.name
