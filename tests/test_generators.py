@@ -311,3 +311,39 @@ def test_meetings_peav_variables_per_resource_event():
             assert m0 == m1 and r0 != r1, c.name
         elif c.name.startswith("mutex_"):
             assert r0 == r1 and m0 != m1, c.name
+
+
+def test_iot_scale_free_attachment_and_domains():
+    from pydcop_tpu.generators.iot import generate_iot
+
+    dcop = generate_iot(num_device=15, m_edge=2, states_count=4,
+                        seed=9)
+    assert len(dcop.variables) == 15
+    for v in dcop.variables.values():
+        assert len(v.domain) == 4
+    # BA(m=2): 2 * (n - m) edges
+    binaries = [c for c in dcop.constraints.values()
+                if len(c.dimensions) == 2]
+    assert len(binaries) == 2 * (15 - 2)
+    # every agent exists and owns its device cheaply vs others
+    assert len(dcop.agents) == 15
+
+
+def test_secp_rule_factors_reference_models_and_lights():
+    from pydcop_tpu.generators.secp import generate_secp
+
+    dcop = generate_secp(lights_count=6, models_count=3, rules_count=2,
+                         levels=5, seed=11)
+    rules = {n: c for n, c in dcop.constraints.items()
+             if n.startswith("r")}
+    assert len(rules) == 2
+    for c in rules.values():
+        scope = set(c.scope_names)
+        # a rule constrains at least one model or light variable
+        assert any(s.startswith("m") or s.startswith("l")
+                   for s in scope)
+    # every light has a cost factor with explicit zero hosting on its
+    # own agent (the SECP distribution family depends on it)
+    for i in range(6):
+        agent = dcop.agent(f"a{i:02d}")
+        assert agent.hosting_cost(f"l{i:02d}") == 0
