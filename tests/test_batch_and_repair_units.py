@@ -240,3 +240,28 @@ def test_consolidate_single_param_with_underscore_key(tmp_path):
         rows = list(_csv.DictReader(f))
     assert rows[0]["damping_nodes"] == "vars"
     assert "nodes" not in rows[0]
+
+
+def test_analysing_results_doc_campaign_expands(tmp_path):
+    """The campaign yaml documented in docs/analysing_results.md is a
+    valid bench file: it expands into runnable jobs against real
+    instance files."""
+    import os
+    import re
+
+    import yaml as _yaml
+
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "analysing_results.md")
+    block = re.findall(r"```yaml\n(.*?)```",
+                       open(doc, encoding="utf-8").read(),
+                       re.DOTALL)[0]
+    bench = _yaml.safe_load(block)
+    # point the documented glob at a real instance
+    (tmp_path / "p1.yaml").write_text("name: x\n")
+    for s in bench["sets"].values():
+        s["path"] = str(tmp_path / "p*.yaml")
+    jobs = expand_jobs(bench)
+    assert jobs
+    for job_id, argv in jobs:
+        assert "solve" in argv
