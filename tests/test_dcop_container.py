@@ -149,3 +149,35 @@ def test_constraint_from_str_integrates(d):
     dcop.add_constraint(c)
     assert dcop.solution_cost({"x": 1, "y": 1})[0] == 1
     assert dcop.solution_cost({"x": 1, "y": 2})[0] == 0
+
+
+def test_filter_dcop_folds_existing_cost_functions_too(d):
+    """A variable that already carries a cost function gets the unary
+    constraint ADDED to it, not replaced."""
+    from pydcop_tpu.dcop.objects import VariableWithCostFunc
+    from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+    dcop = DCOP("t")
+    x = VariableWithCostFunc("x", d, ExpressionFunction("x * 2"))
+    dcop += x
+    dcop.add_constraint(UnaryFunctionRelation("ux", x, lambda v: v + 1))
+    filtered = filter_dcop(dcop)
+    fx = filtered.variables["x"]
+    # combined: 2v (own) + v+1 (folded constraint)
+    assert fx.cost_for_val(2) == pytest.approx(4 + 3)
+    assert fx.cost_for_val(0) == pytest.approx(0 + 1)
+
+
+def test_filter_dcop_idempotent(d):
+    dcop = DCOP("t")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop += x
+    dcop += y
+    dcop.add_constraint(UnaryFunctionRelation("u", x, lambda v: v))
+    dcop.add_constraint(
+        NAryFunctionRelation(lambda x, y: x * y, [x, y], name="b"))
+    once = filter_dcop(dcop)
+    twice = filter_dcop(once)
+    a = {"x": 2, "y": 1}
+    assert once.solution_cost(a) == twice.solution_cost(a)
+    assert set(twice.constraints) == {"b"}

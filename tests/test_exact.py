@@ -297,3 +297,18 @@ def test_dpop_device_timeout_status():
     res = dpop.solve_direct(dcop, device="host", timeout=0.0)
     assert res.status == "TIMEOUT"
     assert res.assignment == {}
+
+
+def test_dpop_message_size_accounting():
+    import numpy as np
+
+    from pydcop_tpu.algorithms.dpop import message_size
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    d = Domain("d", "", [0, 1, 2])
+    x, y = Variable("x", d), Variable("y", d)
+    util = NAryMatrixRelation([x, y], np.zeros((3, 3)), name="u")
+    assert message_size(util) == 9
+    scalar = NAryMatrixRelation([], np.array(1.0), name="s")
+    assert message_size(scalar) == 1
