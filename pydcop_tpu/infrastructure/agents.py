@@ -421,6 +421,12 @@ class ResilientAgent(Agent):
         self._repair_info: Optional[Dict[str, Any]] = None
         self._replication_comp = None
         if replication is not None:
+            if replication != "dist_ucs_hostingcosts":
+                # the reference resolves replication.<name>; an unknown
+                # name must fail loudly, not silently skip replication
+                raise AgentException(
+                    f"Unknown replication method {replication!r}; "
+                    f"available: ['dist_ucs_hostingcosts']")
             from ..replication.dist_ucs_hostingcosts import UCSReplication
 
             self._replication_comp = UCSReplication(self)

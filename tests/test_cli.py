@@ -691,3 +691,29 @@ def test_solve_thread_uiport_serves_websocket(gc3_file):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+@pytest.mark.parametrize("gen_args,algo", [
+    (["ising", "--row_count", "3"], "maxsum"),
+    (["small_world", "-v", "8", "-k", "4", "-p", "0.1"], "dsa"),
+    (["iot", "-n", "8"], "mgm"),
+])
+def test_generate_families_solve_roundtrip(tmp_path, gen_args, algo):
+    """Each generator family emits YAML the solver consumes (the
+    reference's generate -> solve CLI loop)."""
+    gen_file = str(tmp_path / "gen.yaml")
+    run_cli("-o", gen_file, "generate", *gen_args, "--seed", "1")
+    proc = run_cli("-t", "30", "solve", "-a", algo,
+                   "-p", "stop_cycle:10", gen_file, timeout=180)
+    result = json.loads(proc.stdout)
+    assert result["assignment"]
+
+
+def test_run_unknown_replication_method_fails_clearly(gc3_file,
+                                                     tmp_path):
+    scen = tmp_path / "s.yaml"
+    scen.write_text("events:\n  - id: d1\n    delay: 0.1\n")
+    proc = run_cli("-t", "30", "run", "-a", "dsa", "-s", str(scen),
+                   "-k", "1", "--replication_method", "nosuch",
+                   gc3_file, expect_ok=False, timeout=120)
+    assert proc.returncode != 0
