@@ -128,3 +128,14 @@ def test_algorithm_def_params_property_isolated():
         "dsa", {}).params["probability"] == 0.7
     assert ad.params["probability"] in (0.0, 0.7)  # own copy or live —
     # but a FRESH def is never affected (no shared class state)
+
+
+def test_engine_params_strips_mp_only_keys():
+    """The engine-side solvers never see mp-backend-only params
+    (seed travels to the engine as the PRNG key, not a kwarg)."""
+    from pydcop_tpu.algorithms._mp import engine_params
+
+    out = engine_params({"probability": 0.7, "seed": 42})
+    assert "seed" not in out
+    assert out["probability"] == 0.7
+    assert engine_params(None) == {}
