@@ -16,6 +16,7 @@ import pytest
 MODULES = [import_module(n) for n in (
     "pydcop_tpu.dcop.objects",
     "pydcop_tpu.dcop.dcop",
+    "pydcop_tpu.dcop.relations",
     "pydcop_tpu.algorithms",
     "pydcop_tpu.infrastructure.computations",
     "pydcop_tpu.utils.expressionfunction",
