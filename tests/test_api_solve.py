@@ -386,3 +386,19 @@ def test_implementing_algorithms_tutorial_runs():
     sh = ShardedTutorial(arrays, make_mesh(8), batch=4, stop_cycle=0)
     sel, _ = sh.run(10)
     assert sel.shape == (4, 12)
+
+
+def test_problem_modeling_doc_snippets_run():
+    """docs/problem_modeling.md python snippets execute in sequence
+    against the real API (shared namespace, like a reader's session)."""
+    import re
+
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "problem_modeling.md")
+    blocks = re.findall(r"```python\n(.*?)```",
+                        open(doc, encoding="utf-8").read(), re.DOTALL)
+    assert len(blocks) >= 3
+    ns = {}
+    for block in blocks:
+        exec(block, ns)  # noqa: S102 - doc snippets under test
+    assert "dcop" in ns and ns["dcop"].variables
