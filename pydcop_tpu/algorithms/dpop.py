@@ -202,7 +202,13 @@ def device_util_sweep(g, var_cost_rel, mode: str,
             mesh = _spine_mesh()
         ntp = mesh.shape["tp"] if mesh is not None else 1
         for name in sorted(oversized):
-            per_device = (cells_of[name] + ntp - 1) // ntp
+            # sharding splits only the LEADING separator axis: with a
+            # leading domain of size L over tp=N devices the largest
+            # shard holds ceil(L/N) slices, not cells/N (e.g. L=3 on
+            # tp=8 leaves cells/3 per device)
+            lead = sizes[plans[name]["out_dims"][0]]
+            slice_cells = cells_of[name] // lead
+            per_device = ((lead + ntp - 1) // ntp) * slice_cells
             if mesh is None or per_device > memory_limit:
                 raise MemoryError(
                     f"DPOP UTIL table for {name} exceeds memory limit "

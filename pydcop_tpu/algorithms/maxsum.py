@@ -86,6 +86,8 @@ class MaxSumSolver(ArraySolver):
         # the two most expensive irregular ops of the cycle on TPU.
         self._canonical = self._detect_canonical(arrays)
 
+    _trace_fallback_warned = False
+
     @staticmethod
     def _tracing() -> bool:
         try:
@@ -93,7 +95,26 @@ class MaxSumSolver(ArraySolver):
 
             return not trace_state_clean()
         except Exception:  # pragma: no cover - jax internals moved
-            return True  # can't tell: never cache
+            # fall back to a PUBLIC signal: a primitive bound under an
+            # active trace yields a Tracer.  Loudly, once — the probe
+            # array materializes on the backend when NOT under a trace,
+            # so the fallback silently costs a backend init that the
+            # lazy-constants design otherwise avoids.
+            if not MaxSumSolver._trace_fallback_warned:
+                MaxSumSolver._trace_fallback_warned = True
+                import warnings
+
+                warnings.warn(
+                    "jax._src.core.trace_state_clean is gone in this "
+                    "jax version; falling back to a Tracer-instance "
+                    "probe for trace detection (device constants may "
+                    "trigger an eager backend init)", RuntimeWarning)
+            try:
+                import jax
+
+                return isinstance(jnp.zeros(()), jax.core.Tracer)
+            except Exception:
+                return True  # can't tell at all: never cache
 
     def _dev(self, name, build):
         out = self._dev_cache.get(name)

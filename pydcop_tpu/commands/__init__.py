@@ -9,6 +9,7 @@ handling.
 """
 
 import json
+import math
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -63,9 +64,31 @@ class NumpyEncoder(json.JSONEncoder):
         return super().default(o)
 
 
+def _finitize(o):
+    """Replace non-finite floats with their string form so the emitted
+    JSON stays RFC 8259-valid (json.dumps would otherwise print the
+    non-standard ``Infinity``/``NaN`` literals that strict parsers —
+    jq, Go, Rust — reject).  Numpy scalars/arrays are normalized FIRST:
+    NumpyEncoder only sees values after this pass, so a float32 inf or
+    an ndarray cell would otherwise slip through the builtin-float
+    check."""
+    if isinstance(o, np.ndarray):
+        return _finitize(o.tolist())
+    if isinstance(o, np.floating):
+        o = float(o)
+    if isinstance(o, float) and not math.isfinite(o):
+        return str(o)
+    if isinstance(o, dict):
+        return {k: _finitize(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_finitize(v) for v in o]
+    return o
+
+
 def output_json(data: Dict, output: Optional[str] = None):
     """Dump result JSON to stdout and optionally a file."""
-    txt = json.dumps(data, sort_keys=True, indent=2, cls=NumpyEncoder)
+    txt = json.dumps(_finitize(data), sort_keys=True, indent=2,
+                     cls=NumpyEncoder)
     try:
         print(txt)
     except BrokenPipeError:  # e.g. piped into `head`

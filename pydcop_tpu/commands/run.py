@@ -36,9 +36,10 @@ def set_parser(subparsers):
                              "summary row to")
     parser.add_argument("-i", "--infinity", type=float,
                         default=float("inf"),
-                        help="stand-in cost for each hard-constraint "
-                             "violation; inf by default, pass a finite "
-                             "value to keep reported costs numeric "
+                        help="threshold at or above which a constraint "
+                             "counts as a hard violation; violations "
+                             "are counted separately and excluded from "
+                             "the (always finite) reported cost "
                              "(reference: run.py:290-297)")
     parser.add_argument("--max_cycles", type=int, default=1_000_000)
     parser.add_argument("--seed", type=int, default=0)

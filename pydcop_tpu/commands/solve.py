@@ -54,12 +54,12 @@ def set_parser(subparsers):
                              "row to (reference: solve.py:162)")
     parser.add_argument("-i", "--infinity", type=float,
                         default=float("inf"),
-                        help="stand-in cost for each hard-constraint "
-                             "violation; by default (inf, like the "
-                             "reference) a violated solution reports "
-                             "cost Infinity — pass a finite value to "
-                             "keep campaign CSVs numeric (reference: "
-                             "solve.py:316-323)")
+                        help="threshold at or above which a constraint "
+                             "counts as a hard violation; violations "
+                             "are counted separately and excluded from "
+                             "the (always finite) reported cost "
+                             "(reference: solve.py:316-323 + "
+                             "dcop.py:319-369)")
     parser.add_argument("--delay", type=float, default=None,
                         help="inter-message delay (thread/process mode)")
     parser.add_argument("--uiport", type=int, default=None,
@@ -95,16 +95,16 @@ def run_cmd(args, timeout: Optional[float] = None):
         params = {k: algo_def.params[k] for k in given}
         for engine_only in ("stop_cycle", "seed"):
             params.pop(engine_only, None)
-        assignment, _best_cost, cycles = solve_sharded(
+        assignment, _best_cost, cycles, finished = solve_sharded(
             dcop, args.algo, n_cycles=args.max_cycles,
             batch=args.batch, seed=args.seed, **params)
         cost, violations = dcop.solution_cost(
             assignment, infinity=args.infinity)
         result = {
-            # sharded runners stop early only on algorithm
-            # termination (SAME_COUNT stability, DBA zero violations)
-            "status": "FINISHED" if cycles < args.max_cycles
-            else "MAX_CYCLES",
+            # the runner reports whether its own termination fired
+            # (SAME_COUNT stability, DBA zero violations) — even when
+            # it fires exactly on the last budgeted cycle
+            "status": "FINISHED" if finished else "MAX_CYCLES",
             "assignment": assignment,
             "cost": cost,
             "violation": violations,
@@ -154,10 +154,10 @@ def run_cmd(args, timeout: Optional[float] = None):
 
     cost, violations = res.cost, res.violations
     if res.assignment and set(res.assignment) == set(dcop.variables):
-        # each hard violation is priced at args.infinity (inf by
-        # default); cost and violation come from the SAME solution_cost
-        # call so they can never disagree (reference: solve.py:448 +
-        # dcop.py:319-369)
+        # violations are counted against args.infinity and excluded
+        # from the soft cost; cost and violation come from the SAME
+        # solution_cost call so they can never disagree (reference:
+        # solve.py:448 + dcop.py:319-369)
         cost, violations = dcop.solution_cost(res.assignment,
                                               infinity=args.infinity)
     result = {

@@ -151,15 +151,19 @@ class DCOP:
                     scoped[v.name] = assignment[v.name]
             c_cost = c(**scoped)
             if c_cost >= infinity:
-                # a violated hard constraint is priced at the infinity
-                # stand-in — inf by default, so an infeasible solution
-                # can never rank below a feasible one on cost
+                # a violated hard constraint is *counted*, not priced:
+                # the soft cost stays finite (and JSON-serializable) and
+                # rankings that must exclude infeasible results compare
+                # (violations, cost) lexicographically
                 violations += 1
-                cost += infinity
             else:
                 cost += c_cost
         for v_name, v in self.variables.items():
-            cost += v.cost_for_val(assignment[v_name])
+            v_cost = v.cost_for_val(assignment[v_name])
+            if v_cost >= infinity:
+                violations += 1
+            else:
+                cost += v_cost
         return cost, violations
 
 

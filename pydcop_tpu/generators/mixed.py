@@ -138,6 +138,23 @@ def _nary_incidence(variable_count, constraint_count, arity,
         stale = 0
         attach(v, c)
         budget -= 1
+    if budget > 0 and not_full:
+        # dense regime (arity close to variable_count): rejection went
+        # stale — sample uniformly over ALL remaining free
+        # (constraint, variable) slots so the density target is met
+        # without skewing membership toward any constraint
+        free_slots = []
+        for c in not_full:
+            taken = set(members[c])
+            free_slots.extend(
+                (c, v) for v in range(variable_count) if v not in taken)
+        rng.shuffle(free_slots)
+        for c, v in free_slots:
+            if budget <= 0:
+                break
+            if len(members[c]) < arity:  # may have filled meanwhile
+                attach(v, c)
+                budget -= 1
     return members
 
 

@@ -27,7 +27,10 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
     ``algo``: maxsum / amaxsum (edge- or lane-major), dsa, mgm or
     mgm2.  ``batch`` independent restarts ride the dp axis (default:
     one per dp row); the best-cost restart is returned.  Returns
-    (assignment dict, cost, cycles).
+    (assignment dict, cost, cycles, finished) — ``finished`` is True
+    iff the algorithm's own termination rule fired (possibly exactly
+    on the final cycle), so callers never infer status from
+    ``cycles < n_cycles``.
     """
     import numpy as np
 
@@ -89,14 +92,14 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
             for v, i in zip(variables, row)
         }
         cost, violations = dcop.solution_cost(assignment)
-        # rank restarts lexicographically by (violations, cost): with
-        # the default inf pricing every infeasible restart costs inf,
-        # so cost alone cannot distinguish 1 violation from 12
+        # rank restarts lexicographically by (violations, cost): the
+        # soft cost excludes violated constraints, so cost alone cannot
+        # rank a feasible restart above an infeasible one
         key = (violations,
                cost if dcop.objective == "min" else -cost)
         if best_key is None or key < best_key:
             best_key, best = key, (assignment, cost)
-    return best[0], best[1], cycles
+    return best[0], best[1], cycles, bool(solver.finished)
 
 
 from .sharded_breakout import (ShardedDba, ShardedGdba,  # noqa: E402

@@ -91,6 +91,10 @@ class ShardedLocalSearch:
     bucket_attrs: Tuple[str, ...] = ("buckets", "bucket_optima")
     state_bucket_keys: Tuple[str, ...] = ()
 
+    #: whether the algorithm's own termination rule fired on the
+    #: last completed run() (False before/without a completed run)
+    finished = False
+
     def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1,
                  **params):
         self.mesh = mesh
@@ -254,10 +258,14 @@ class ShardedLocalSearch:
             raise ValueError(f"need {self.B} seeds, got {len(seeds)}")
         x, keys, bucket_state, consts = self._device_put(seeds)
         cycle = 0
+        self.finished = False
         for cycle in range(1, n_cycles + 1):
             x, keys, finished, bucket_state = self._step(
                 x, keys, bucket_state, consts)
+            # checked on the FINAL cycle too, so termination firing
+            # exactly at the budget still reports finished
             if bool(np.all(np.asarray(jax.device_get(finished)))):
+                self.finished = True
                 break
         sel = np.asarray(jax.device_get(x))[:, :self.V]
         return sel, cycle

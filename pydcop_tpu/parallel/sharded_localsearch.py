@@ -57,6 +57,10 @@ def _partition_constraints(arrays: HypergraphArrays, tp: int):
 class ShardedDsa:
     """DSA-B over a (dp, tp) mesh; ``batch`` independent instances."""
 
+    #: whether the algorithm's own termination rule fired on the
+    #: last completed run() (False before/without a completed run)
+    finished = False
+
     def __init__(self, arrays: HypergraphArrays, mesh,
                  probability: float = 0.7, batch: int = 1):
         self.mesh = mesh
@@ -177,6 +181,7 @@ class ShardedDsa:
             key, sub = jax.random.split(key)
             x = self._step(x, sub, cubes, var_ids, var_costs,
                            domain_mask)
+        self.finished = False  # DSA has no self-termination rule
         sel = np.asarray(jax.device_get(x))[:, :self.V]
         return sel, n_cycles
 
@@ -203,6 +208,10 @@ class ShardedMgm:
     at-max neighbors' priorities.  Monotonic: only strictly-improving
     moves, so the conflict count never increases.
     """
+
+    #: whether the algorithm's own termination rule fired on the
+    #: last completed run() (False before/without a completed run)
+    finished = False
 
     def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1):
         self.mesh = mesh
@@ -336,6 +345,7 @@ class ShardedMgm:
             self._device_put(seed, x0)
         for cycle in range(n_cycles):
             x = self._step(x, cubes, var_ids, var_costs, domain_mask)
+        self.finished = False  # runs the full budget by design
         sel = np.asarray(jax.device_get(x))[:, :self.V]
         return sel, n_cycles
 

@@ -101,6 +101,10 @@ class ShardedMaxSum:
     of the mesh's dp size).
     """
 
+    #: whether the algorithm's own termination rule fired on the
+    #: last completed run() (False before/without a completed run)
+    finished = False
+
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
@@ -345,6 +349,7 @@ class ShardedMaxSum:
         same = 0
         cycle = 0
         sel = None
+        self.finished = False
         while cycle < n_cycles:
             key, sub = jax.random.split(key)
             q, r, sel, delta = self._step(q, r, sub, *args)
@@ -356,6 +361,8 @@ class ShardedMaxSum:
                     delta_h < self.stability:
                 same += 1
                 if same >= SAME_COUNT:
+                    # may fire on the final cycle: still "finished"
+                    self.finished = True
                     break
             else:
                 same = 0

@@ -46,6 +46,10 @@ class ShardedMgm2:
     coordinated moves).
     """
 
+    #: whether the algorithm's own termination rule fired on the
+    #: last completed run() (False before/without a completed run)
+    finished = False
+
     def __init__(self, arrays: HypergraphArrays, mesh,
                  threshold: float = 0.5, favor: str = "unilateral",
                  batch: int = 1):
@@ -335,6 +339,7 @@ class ShardedMgm2:
         x, keys, consts = self._device_put(seeds)
         for _ in range(n_cycles):
             x, keys = self._step(x, keys, *consts)
+        self.finished = False  # runs the full budget by design
         return np.asarray(jax.device_get(x)), n_cycles
 
     def step_once(self, seed: int = 0) -> np.ndarray:

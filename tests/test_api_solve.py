@@ -314,8 +314,8 @@ def test_top_level_package_api():
     assert assignment == OPTIMUM
 
     dcop = pydcop_tpu.load_dcop_from_file(path)
-    a2, _cost, cycles = pydcop_tpu.solve_sharded(dcop, "dsa",
-                                                 n_cycles=30, seed=1)
+    a2, _cost, cycles, _fin = pydcop_tpu.solve_sharded(
+        dcop, "dsa", n_cycles=30, seed=1)
     assert set(a2) == {"v1", "v2", "v3"} and cycles == 30
 
     dcop = pydcop_tpu.load_dcop_from_file(path)

@@ -321,9 +321,11 @@ def test_solve_end_metrics_appends_rows(tmp_path, gc3_file):
     assert all(r[1] in ("FINISHED", "MAX_CYCLES") for r in rows[1:])
 
 
-def test_solve_infinity_replaces_infinite_cost(tmp_path):
-    """An assignment violating a hard constraint reports the finite
-    --infinity stand-in, keeping the JSON numeric."""
+def test_solve_infinity_counts_violations(tmp_path):
+    """An assignment violating a hard constraint (any cost at or above
+    the --infinity threshold) is counted in `violation` and EXCLUDED
+    from the soft cost, which stays finite (reference dcop.py:319-369
+    semantics) — the JSON stays strictly numeric."""
     hard = tmp_path / "hard.yaml"
     hard.write_text("""
 name: hard2
@@ -340,9 +342,9 @@ agents: [a1, a2]
     proc = run_cli("-t", "30", "solve", "-a", "dsa",
                    "-p", "stop_cycle:2", "-i", "777", str(hard))
     result = json.loads(proc.stdout)
-    # the single possible assignment violates the hard constraint: the
-    # reported cost is the finite stand-in, one per violation
-    assert result["cost"] == 777.0
+    # the single possible assignment violates the hard constraint:
+    # counted once, soft cost finite (no other constraint contributes)
+    assert result["cost"] == 0.0
     assert result["violation"] == 1
 
 
@@ -717,3 +719,25 @@ def test_run_unknown_replication_method_fails_clearly(gc3_file,
                    "-k", "1", "--replication_method", "nosuch",
                    gc3_file, expect_ok=False, timeout=120)
     assert proc.returncode != 0
+
+
+def test_output_json_finitizes_numpy_nonfinite(tmp_path, capsys):
+    """Non-finite values — builtin OR numpy float, scalar or inside an
+    ndarray — serialize as strings so the emitted JSON never carries
+    the non-standard Infinity/NaN literals (code-review r5)."""
+    import numpy as np
+
+    from pydcop_tpu.commands import output_json
+
+    out = str(tmp_path / "o.json")
+    output_json({
+        "a": float("inf"), "b": np.float32("-inf"),
+        "c": np.array([1.0, np.inf, np.nan]),
+        "d": [np.float64("nan")], "e": 1.5,
+    }, out)
+    with open(out) as f:
+        txt = f.read()
+    assert "Infinity" not in txt and "NaN" not in txt
+    d = json.loads(txt)  # strict parse succeeds
+    assert d["a"] == "inf" and d["b"] == "-inf"
+    assert d["c"] == [1.0, "inf", "nan"] and d["e"] == 1.5

@@ -203,7 +203,7 @@ agents: [a1, a2, a3, a4, a5]
         # (belief ties decode inconsistently otherwise, as on any
         # unary-cost-free instance)
         dcop = load_dcop(src)
-        assignment, cost, cycles = solve_sharded(
+        assignment, cost, cycles, _fin = solve_sharded(
             dcop, algo, n_cycles=40, seed=3, **params)
         assert set(assignment) == {f"v{i}" for i in range(1, 6)}
         # a 5-cycle is 3-colorable: the best restart should be clean
@@ -349,13 +349,14 @@ constraints:
 agents: [a1, a2, a3, a4]
 """
     dcop = load_dcop(src)
-    assignment, cost, _ = solve_sharded(dcop, "mgm2", n_cycles=30,
-                                        seed=1)
+    assignment, cost, _, _fin = solve_sharded(dcop, "mgm2",
+                                              n_cycles=30, seed=1)
     assert set(assignment) == {"v1", "v2", "v3", "v4"}
     assert cost == 0
     dcop = load_dcop(src)
-    assignment, cost, _ = solve_sharded(dcop, "amaxsum", n_cycles=120,
-                                        seed=1, noise=0.05)
+    assignment, cost, _, _fin = solve_sharded(dcop, "amaxsum",
+                                              n_cycles=120, seed=1,
+                                              noise=0.05)
     assert set(assignment) == {"v1", "v2", "v3", "v4"}
     assert cost == 0
 
@@ -560,9 +561,9 @@ def test_sharded_adsa_and_dsatuto_through_harness():
 
 
 def test_solve_sharded_ranks_restarts_by_violations():
-    """With inf-priced violations, cost alone cannot rank infeasible
-    restarts: the best-restart pick is lexicographic by
-    (violations, cost) (code-review r4)."""
+    """Violated constraints are excluded from the soft cost, so cost
+    alone cannot rank infeasible restarts: the best-restart pick is
+    lexicographic by (violations, cost) (code-review r4)."""
     from pydcop_tpu.dcop.yamldcop import load_dcop
     from pydcop_tpu.parallel import solve_sharded
 
@@ -584,8 +585,9 @@ constraints:
 agents: [a1, a2, a3]
 """
     dcop = load_dcop(src)
-    assignment, cost, _ = solve_sharded(dcop, "dsa", n_cycles=20,
-                                        seed=0, batch=8)
+    assignment, cost, _, _fin = solve_sharded(dcop, "dsa",
+                                              n_cycles=20, seed=0,
+                                              batch=8)
     _, violations = dcop.solution_cost(assignment)
     assert violations == 1  # the true optimum for this instance
 
