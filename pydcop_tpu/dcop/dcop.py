@@ -150,17 +150,19 @@ class DCOP:
                 else:
                     scoped[v.name] = assignment[v.name]
             c_cost = c(**scoped)
-            if c_cost >= infinity:
+            if not -infinity < c_cost < infinity:
                 # a violated hard constraint is *counted*, not priced:
                 # the soft cost stays finite (and JSON-serializable) and
                 # rankings that must exclude infeasible results compare
-                # (violations, cost) lexicographically
+                # (violations, cost) lexicographically.  Both signs are
+                # hard markers: +inf cost (min objective) and -inf
+                # utility (max objective)
                 violations += 1
             else:
                 cost += c_cost
         for v_name, v in self.variables.items():
             v_cost = v.cost_for_val(assignment[v_name])
-            if v_cost >= infinity:
+            if not -infinity < v_cost < infinity:
                 violations += 1
             else:
                 cost += v_cost

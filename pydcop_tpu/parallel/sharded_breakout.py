@@ -42,6 +42,18 @@ from ..graphs.arrays import ConstraintBucket, HypergraphArrays
 from .sharded_localsearch import _partition_constraints
 
 
+def _mesh_reduce_vplane(a):
+    """Cross-shard reduction hook installed on the solver during the
+    traced step (module-level so a test can deliberately break it and
+    prove the dryrun's quality assertions catch wrong collective
+    math)."""
+    return jax.lax.psum(a, "tp")
+
+
+def _mesh_reduce_scalar(v):
+    return jax.lax.psum(v, "tp")
+
+
 def _sink_view(arrays: HypergraphArrays,
                shard_buckets, shard_idx: int) -> HypergraphArrays:
     """A copy of ``arrays`` with one extra sink variable and shard
@@ -150,8 +162,8 @@ class ShardedLocalSearch:
                          for name in attr_names}
             for name, value in zip(attr_names, attr_locals):
                 setattr(solver, name, value)
-            solver._reduce_vplane = lambda a: jax.lax.psum(a, "tp")
-            solver._reduce_scalar = lambda v: jax.lax.psum(v, "tp")
+            solver._reduce_vplane = _mesh_reduce_vplane
+            solver._reduce_scalar = _mesh_reduce_scalar
             try:
                 def one(x1, k1, bstate):
                     s = {"cycle": jnp.int32(0),

@@ -428,3 +428,104 @@ class ShardedAMaxSum(ShardedMaxSum):
             return q2, r2, sel, delta
 
         self._step = step
+
+
+class ShardedDynamicMaxSum(ShardedMaxSum):
+    """The mesh path for maxsum_dynamic: MaxSum over (dp, tp) with
+    host-swappable factor tables.
+
+    Mirrors the single-chip :class:`~pydcop_tpu.algorithms.\
+maxsum_dynamic.DynamicMaxSumSolver` (reference maxsum_dynamic.py:40-186):
+    the sharded cost cubes are session state the HOST can rewrite
+    between steps — ``change_factor_function`` swaps one factor's
+    table in place on its owning tp shard while the message arrays
+    (q, r) are preserved, so belief propagation continues through the
+    dynamics instead of restarting.  The compiled sharded step is
+    reused unchanged across swaps (same trick as the single-chip
+    solver's cubes-in-state pytree).
+
+    Drive it as a session::
+
+        sdm.start(seed)
+        sdm.step_cycles(5)
+        sdm.change_factor_function("c3", new_constraint)
+        sel = sdm.step_cycles(5)
+    """
+
+    def __init__(self, arrays: FactorGraphArrays, mesh, **kwargs):
+        super().__init__(arrays, mesh, **kwargs)
+        self.arrays = arrays
+        # factor name -> (bucket index, bucket row, tp shard, shard row)
+        # (the partition is round-robin: bucket row i lands on shard
+        # i % tp at local row i // tp, see _round_robin)
+        self._factor_pos = {}
+        for b_idx, b in enumerate(arrays.buckets):
+            for i, f_id in enumerate(b.factor_ids):
+                self._factor_pos[arrays.factor_names[int(f_id)]] = (
+                    b_idx, i, i % self.tp, i // self.tp)
+        self._session = None
+
+    # -------------------------------------------------------- session
+
+    def start(self, seed: int = 0):
+        state, consts = self._device_put()
+        self._session = {
+            "q": state["q"], "r": state["r"],
+            "consts": consts,
+            "key": jax.random.PRNGKey(seed),
+            "sel": None,
+        }
+        return self
+
+    def step_cycles(self, n: int = 1) -> np.ndarray:
+        """Advance ``n`` sharded cycles; returns the (B, V) selections."""
+        s = self._session
+        if s is None:
+            raise RuntimeError("call start() first")
+        c = s["consts"]
+        args = (c["edge_var"], c["cubes"], c["var_costs"],
+                c["domain_mask"], c["domain_size"])
+        for _ in range(n):
+            s["key"], sub = jax.random.split(s["key"])
+            s["q"], s["r"], s["sel"], _delta = self._step(
+                s["q"], s["r"], sub, *args)
+        return np.asarray(jax.device_get(s["sel"]))
+
+    # ---------------------------------------------------- host dynamics
+
+    def change_factor_function(self, factor_name: str, constraint):
+        """Swap one factor's cost function, dimensions unchanged —
+        the update touches exactly the owning tp shard's row of the
+        sharded cube stack (reference maxsum_dynamic.py:40-110)."""
+        from ..graphs.arrays import _padded_cube
+
+        if self._session is None:
+            raise RuntimeError("call start() first")
+        try:
+            b_idx, row, g, loc = self._factor_pos[factor_name]
+        except KeyError:
+            raise KeyError(f"unknown factor {factor_name!r}")
+        bucket = self.arrays.buckets[b_idx]
+        if constraint.arity != bucket.arity:
+            raise ValueError(
+                f"change_factor_function: factor {factor_name!r} has "
+                f"arity {bucket.arity}, new constraint has "
+                f"{constraint.arity}; dimension changes need a rebuild")
+        expect = [self.arrays.var_names[int(v)]
+                  for v in bucket.var_ids[row]]
+        got = [v.name for v in constraint.dimensions]
+        if expect != got:
+            raise ValueError(
+                f"change_factor_function: factor {factor_name!r} scope "
+                f"is {expect}, new constraint scope is {got}; dimension "
+                f"changes need a rebuild")
+        cube = _padded_cube(constraint, self.D, self.arrays.sign)
+        # rewrite the owning shard's row on the HOST copy and re-place
+        # it with the same P("tp") sharding (an eager scatter on the
+        # explicitly-sharded device array would need a mesh context)
+        sb = self.buckets[b_idx]
+        sb.cubes[g, loc] = cube
+        cubes = list(self._session["consts"]["cubes"])
+        cubes[b_idx] = jax.device_put(
+            sb.cubes, NamedSharding(self.mesh, P("tp")))
+        self._session["consts"]["cubes"] = cubes

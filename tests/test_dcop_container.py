@@ -181,3 +181,17 @@ def test_filter_dcop_idempotent(d):
     a = {"x": 2, "y": 1}
     assert once.solution_cost(a) == twice.solution_cost(a)
     assert set(twice.constraints) == {"b"}
+
+
+def test_solution_cost_max_objective_neg_inf_counts_violation(d):
+    """-inf utility is the hard marker under objective: max — counted,
+    excluded from the (finite) soft cost (code-review r5)."""
+    dcop = DCOP("t", objective="max")
+    x = Variable("x", d)
+    dcop += x
+    dcop.add_constraint(UnaryFunctionRelation(
+        "u", x, lambda v: float("-inf") if v == 2 else v))
+    cost, violations = dcop.solution_cost({"x": 2})
+    assert cost == 0.0 and violations == 1
+    cost, violations = dcop.solution_cost({"x": 1})
+    assert cost == 1.0 and violations == 0

@@ -640,3 +640,72 @@ agents: [a1, a2, a3, a4]
     assert proc.returncode == 0, proc.stderr
     result = _json.loads(proc.stdout)
     assert len(result["assignment"]) == 4
+
+
+def test_sharded_dynamic_maxsum_factor_swap():
+    """maxsum_dynamic's mesh path (VERDICT r4 item 4): factor tables
+    host-swappable on the sharded cube stack, message state preserved
+    across the swap, and the swapped cost actually redirects the
+    selection."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedDynamicMaxSum
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+
+    src = """
+name: dyn
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b, cost_function: 0.3 * x}
+  y: {domain: b, cost_function: 0.1 * (1 - y)}
+constraints:
+  cxy: {type: intention, function: 5.0 if x != y else 0.0}
+agents: [a1, a2]
+"""
+    # asymmetric unary costs give both phases a UNIQUE optimum (belief
+    # ties decode inconsistently on symmetric instances): pre-swap
+    # (equality factor) the optimum is (0, 0) at cost 0.1; post-swap
+    # (x == y costs 5) it is (0, 1) at cost 0
+    dcop = load_dcop(src)
+    arrays = FactorGraphArrays.build(dcop)
+    mesh = make_mesh(8)
+    sdm = ShardedDynamicMaxSum(arrays, mesh, damping=0.5,
+                               stability=0.0, batch=4)
+    sdm.start(seed=0)
+    sel = sdm.step_cycles(10)
+    assert np.all(sel == 0), sel
+
+    # swap cxy: agreement now costs 5, disagreement 0
+    x, y = dcop.variable("x"), dcop.variable("y")
+    new_c = NAryMatrixRelation(
+        [x, y], np.array([[5.0, 0.0], [0.0, 5.0]]), name="cxy")
+    sdm.change_factor_function("cxy", new_c)
+    sel = sdm.step_cycles(20)
+    assert np.all(sel[:, 0] == 0) and np.all(sel[:, 1] == 1), sel
+
+    # scope/arity guards mirror the single-chip solver's
+    bad = NAryMatrixRelation(
+        [y, x], np.array([[5.0, 0.0], [0.0, 5.0]]), name="cxy")
+    with pytest.raises(ValueError, match="scope"):
+        sdm.change_factor_function("cxy", bad)
+    with pytest.raises(KeyError):
+        sdm.change_factor_function("nosuch", new_c)
+
+
+@pytest.mark.slow
+def test_dryrun_fails_on_broken_psum_hook(monkeypatch):
+    """A deliberately-broken cross-shard reduction must FAIL the driver
+    dryrun (VERDICT r4 item 4): the quality gates make a sharded path
+    that compiles-but-computes-garbage a hard error, not a logged
+    number."""
+    import jax.numpy as jnp
+
+    import __graft_entry__ as g
+    from pydcop_tpu.parallel import sharded_breakout
+
+    monkeypatch.setattr(sharded_breakout, "_mesh_reduce_vplane",
+                        lambda a: jnp.zeros_like(a))
+    with pytest.raises(AssertionError, match="quality bound"):
+        g.dryrun_multichip(8)
