@@ -1,0 +1,209 @@
+"""Golden per-variant distribution tests (VERDICT r4 item 5).
+
+Two fixed instances on which the distribution strategies provably
+DIFFER where the reference's models differ — if a refactor collapses
+two variants into the same model, a golden here fails:
+
+* generic: ilp_compref (weighted comm+hosting, no pinning) vs
+  oilp_cgdp (same model + explicit-zero-hosting pinning, reference
+  oilp_cgdp.py:96-106) vs ilp_fgdp (comm-only + min-one-per-agent,
+  reference ilp_fgdp.py:219-226) vs gh_cgdp (greedy, myopic grouping)
+  — four mutually distinct placements.
+* SECP: the 4 SECP strategies (optimal ILP vs greedy x constraint
+  graph vs factor graph, reference oilp_secp_*.py / gh_secp_*.py) —
+  four mutually distinct placements exposing min-one-per-free-agent
+  (ILP only), cost-factor colocation (fgdp only) and the greedy
+  neighbor-majority rule.
+"""
+
+
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.distribution import load_distribution_module
+from pydcop_tpu.graphs import constraints_hypergraph, factor_graph
+
+GENERIC = """
+name: golden
+objective: min
+domains:
+  colors: {values: [R, G]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+constraints:
+  c12: {type: intention, function: 1 if v1 == v2 else 0}
+  c23: {type: intention, function: 1 if v2 == v3 else 0}
+agents:
+  a1: {capacity: 1000}
+  a2: {capacity: 1000}
+  a3: {capacity: 1000}
+hosting_costs:
+  default: 1
+  a2:
+    default: 5
+    computations: {v3: 0}
+  a3: {default: 3}
+"""
+
+SECP = """
+name: secp_golden
+objective: min
+domains:
+  lvl: {values: [0, 1, 2]}
+variables:
+  l1: {domain: lvl}
+  l2: {domain: lvl}
+  m1: {domain: lvl}
+constraints:
+  c_l1: {type: intention, function: 0.1 * l1}
+  c_l2: {type: intention, function: 0.1 * l2}
+  c_m1: {type: intention, function: abs(m1 - l1 - l2)}
+  r1: {type: intention, function: abs(m1 - 2)}
+agents:
+  d1: {capacity: 100}
+  d2: {capacity: 120}
+  s1: {capacity: 1000}
+hosting_costs:
+  default: 10
+  d1: {computations: {l1: 0}}
+  d2: {computations: {l2: 0}}
+  s1: {default: 1}
+"""
+
+
+def _place(dist):
+    return {a: tuple(sorted(dist.computations_hosted(a)))
+            for a in sorted(dist.agents) if dist.computations_hosted(a)}
+
+
+def _run(strategy, graph, dcop, algo):
+    # deterministic throughout: gh_cgdp seeds its own random.Random(0)
+    # internally, the gh_secp_* greedies use no randomness
+    m = load_distribution_module(strategy)
+    return m.distribute(graph, dcop.agents_def, None,
+                        algo.computation_memory,
+                        algo.communication_load)
+
+
+@pytest.fixture
+def generic():
+    dcop = load_dcop(GENERIC)
+    return (dcop, factor_graph.build_computation_graph(dcop),
+            constraints_hypergraph.build_computation_graph(dcop),
+            load_algorithm_module("maxsum"),
+            load_algorithm_module("dsa"))
+
+
+@pytest.fixture
+def secp():
+    dcop = load_dcop(SECP)
+    return (dcop, factor_graph.build_computation_graph(dcop),
+            constraints_hypergraph.build_computation_graph(dcop),
+            load_algorithm_module("maxsum"),
+            load_algorithm_module("dsa"))
+
+
+def test_golden_ilp_compref_colocates_everything(generic):
+    """No pinning, weighted 0.8*comm + 0.2*hosting: the optimum buys
+    zero communication by grouping all 5 computations on the
+    cheapest-hosting agent (reference ilp_compref.py:139)."""
+    dcop, fg, _, maxsum, _ = generic
+    d = _run("ilp_compref", fg, dcop, maxsum)
+    assert _place(d) == {"a1": ("c12", "c23", "v1", "v2", "v3")}
+
+
+def test_golden_oilp_cgdp_pins_explicit_zero_hosting(generic):
+    """Same weighted model, but v3's EXPLICIT hosting cost 0 on a2 pins
+    it there (reference oilp_cgdp.py:96-106) — the one difference from
+    ilp_compref's placement on this instance."""
+    dcop, _, chg, _, dsa = generic
+    d = _run("oilp_cgdp", chg, dcop, dsa)
+    assert _place(d) == {"a1": ("v1", "v2"), "a2": ("v3",)}
+
+
+def test_golden_ilp_fgdp_spreads_min_one_per_agent(generic):
+    """Comm-only objective + every agent hosts at least one computation
+    (reference ilp_fgdp.py:219-226): the placement must span ALL three
+    agents where ilp_compref used one."""
+    dcop, fg, _, maxsum, _ = generic
+    d = _run("ilp_fgdp", fg, dcop, maxsum)
+    assert set(_place(d)) == {"a1", "a2", "a3"}
+
+
+def test_golden_gh_cgdp_greedy_groups_at_the_pin(generic):
+    """The greedy heuristic pins v3 to a2 first, then groups each
+    remaining variable next to its placed neighbors (comm-to-placed
+    dominates the candidate rank) — myopically landing everything on
+    the EXPENSIVE-hosting agent the optimal ILP avoids."""
+    dcop, _, chg, _, dsa = generic
+    d = _run("gh_cgdp", chg, dcop, dsa)
+    assert _place(d) == {"a2": ("v1", "v2", "v3")}
+
+
+def test_golden_generic_variants_mutually_distinct(generic):
+    """The collapse detector: these four strategies must produce four
+    DIFFERENT placements on the golden instance."""
+    dcop, fg, chg, maxsum, dsa = generic
+    placements = [
+        _place(_run("ilp_compref", fg, dcop, maxsum)),
+        _place(_run("oilp_cgdp", chg, dcop, dsa)),
+        _place(_run("ilp_fgdp", fg, dcop, maxsum)),
+        _place(_run("gh_cgdp", chg, dcop, dsa)),
+    ]
+    seen = [frozenset(p.items()) for p in placements]
+    assert len(set(seen)) == 4, placements
+
+
+def test_golden_oilp_beats_greedy_on_its_own_metric(generic):
+    """Optimality evidence: under the SAME weighted cost metric the
+    ILP's placement is at least as cheap as the greedy's."""
+    from pydcop_tpu.distribution.objects import distribution_cost
+
+    dcop, _, chg, _, dsa = generic
+    d_ilp = _run("oilp_cgdp", chg, dcop, dsa)
+    d_gh = _run("gh_cgdp", chg, dcop, dsa)
+    c_ilp, c_gh = (
+        distribution_cost(d, chg, dcop.agents_def,
+                          dsa.computation_memory,
+                          dsa.communication_load)[0]
+        for d in (d_ilp, d_gh))
+    assert c_ilp <= c_gh
+
+
+def test_golden_secp_placements(secp):
+    """The four SECP strategies, exact golden placements:
+
+    * oilp_secp_cgdp — actuators pinned, m1 forced onto the free
+      server by min-one-per-free-agent;
+    * gh_secp_cgdp — m1 goes to the neighbor-majority device (capacity
+      tie-break), the server stays EMPTY (comm is never evaluated,
+      reference gh_secp_cgdp.py:141-195);
+    * oilp_secp_fgdp — ``c_<actuator>`` cost factors ride with their
+      actuators (reference oilp_secp_fgdp.py:84-128), rule factor on
+      the server by min-one;
+    * gh_secp_fgdp — the (m1, c_m1) model pair and the rule factor all
+      group next to their dependencies on d2.
+    """
+    dcop, fg, chg, maxsum, dsa = secp
+    golden = {
+        ("oilp_secp_cgdp", chg, dsa): {
+            "d1": ("l1",), "d2": ("l2",), "s1": ("m1",)},
+        ("gh_secp_cgdp", chg, dsa): {
+            "d1": ("l1",), "d2": ("l2", "m1")},
+        ("oilp_secp_fgdp", fg, maxsum): {
+            "d1": ("c_l1", "l1"),
+            "d2": ("c_l2", "c_m1", "l2", "m1"), "s1": ("r1",)},
+        ("gh_secp_fgdp", fg, maxsum): {
+            "d1": ("c_l1", "l1"),
+            "d2": ("c_l2", "c_m1", "l2", "m1", "r1")},
+    }
+    placements = {}
+    for (name, graph, algo), expected in golden.items():
+        got = _place(_run(name, graph, dcop, algo))
+        assert got == expected, (name, got)
+        placements[name] = frozenset(got.items())
+    # the collapse detector, SECP tier
+    assert len(set(placements.values())) == 4, placements
