@@ -85,14 +85,18 @@ def _finitize(o):
     return o
 
 
-def output_json(data: Dict, output: Optional[str] = None):
-    """Dump result JSON to stdout and optionally a file."""
+def output_json(data: Dict, output: Optional[str] = None,
+                quiet: bool = False):
+    """Dump result JSON to stdout (unless ``quiet``) and optionally a
+    file (``quiet`` is for bulk writers like the fused batch runner —
+    one campaign would otherwise print a thousand results)."""
     txt = json.dumps(_finitize(data), sort_keys=True, indent=2,
                      cls=NumpyEncoder)
-    try:
-        print(txt)
-    except BrokenPipeError:  # e.g. piped into `head`
-        pass
+    if not quiet:
+        try:
+            print(txt)
+        except BrokenPipeError:  # e.g. piped into `head`
+            pass
     if output:
         with open(output, "w") as f:
             f.write(txt)

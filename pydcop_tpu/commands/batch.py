@@ -30,7 +30,7 @@ import shlex
 import subprocess
 import sys
 import time
-from typing import Any, Dict, Iterator, List, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import yaml
 
@@ -48,6 +48,10 @@ def set_parser(subparsers):
                         help="print the jobs without running them")
     parser.add_argument("--parallel", type=int, default=1,
                         help="number of jobs to run concurrently")
+    parser.add_argument("--no-fuse", dest="fuse", action="store_false",
+                        help="disable data-plane fusion (homogeneous "
+                             "engine solve jobs normally run as ONE "
+                             "vmapped program per topology group)")
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
@@ -67,8 +71,11 @@ def parameters_configuration(options: Dict[str, Any]
         yield dict(zip(keys, combo))
 
 
-def expand_jobs(bench_def: Dict) -> List[Tuple[str, List[str]]]:
-    """All (job_id, argv) pairs of the campaign."""
+def expand_jobs(bench_def: Dict
+                ) -> List[Tuple[str, List[str], Dict[str, Any]]]:
+    """All (job_id, argv, meta) triples of the campaign; ``meta``
+    carries the structured (command, path, conf, iteration) the fused
+    data-plane runner needs without re-parsing argv."""
     sets = bench_def.get("sets", {"default": {"path": None}})
     batches = bench_def.get("batches")
     if not batches:
@@ -92,7 +99,9 @@ def expand_jobs(bench_def: Dict) -> List[Tuple[str, List[str]]]:
                         job_id = _job_id(set_name, batch_name, path,
                                          conf, it)
                         argv = _job_argv(command, path, conf)
-                        jobs.append((job_id, argv))
+                        jobs.append((job_id, argv, {
+                            "command": command, "path": path,
+                            "conf": conf, "iteration": it}))
     return jobs
 
 
@@ -131,12 +140,143 @@ def _job_argv(command: str, path, conf: Dict[str, Any]) -> List[str]:
     return argv
 
 
+# ---------------------------------------------------------------------
+# Fused data-plane path: homogeneous engine solve jobs become ONE
+# vmapped program (parallel/batch.py) instead of one subprocess each —
+# the TPU resolution of the reference's "run in parallel" TODO
+# (batch.py:68): --parallel gives subprocess concurrency, fusion gives
+# data-plane concurrency, and they compose (fused groups first, the
+# rest through the pool).
+# ---------------------------------------------------------------------
+
+#: algorithms with a vmapped multi-instance solver
+FUSABLE_ALGOS = {"maxsum": "factor", "dsa": "hyper", "mgm": "hyper"}
+#: engine-level options the fused path understands; a job with any
+#: other option — including a per-job `timeout`, which a single fused
+#: program cannot enforce per instance — falls back to the subprocess
+#: path untouched
+_FUSE_CONF_KEYS = {"algo", "algo_params", "max_cycles", "mode"}
+#: the `solve` CLI's --max_cycles default: fused and subprocess runs of
+#: the same campaign must stop at the same budget
+_SOLVE_MAX_CYCLES_DEFAULT = 2000
+
+
+def _fuse_group_key(meta) -> Optional[Tuple]:
+    conf = meta["conf"]
+    algo = conf.get("algo")
+    if (meta["command"] != "solve" or meta["path"] is None
+            or algo not in FUSABLE_ALGOS
+            or conf.get("mode", "engine") != "engine"
+            or not set(conf) <= _FUSE_CONF_KEYS):
+        return None
+    ap = conf.get("algo_params", [])
+    ap = tuple(sorted(ap if isinstance(ap, list) else [ap]))
+    return (algo, ap,
+            int(conf.get("max_cycles", _SOLVE_MAX_CYCLES_DEFAULT)))
+
+
+def _topology_signature(arrays) -> Tuple:
+    """Instances fuse only when everything BUT the constraint cost
+    tables matches: the vmapped solvers batch over cubes, all other
+    solver constants come from the shared template."""
+    buckets = [(b.arity, b.var_ids.tobytes()) for b in arrays.buckets]
+    return (tuple(arrays.var_names), arrays.domain_size.tobytes(),
+            arrays.var_costs.tobytes(), tuple(buckets))
+
+
+def _run_fused_group(key, rows, out_dir, register_done):
+    """Solve every (job_id, path, iteration) row of one group as a
+    single vmapped program; write the same per-job result JSON the
+    subprocess path produces, so resume files and ``consolidate`` CSVs
+    are indistinguishable."""
+    import numpy as np
+
+    from ..dcop.dcop import filter_dcop
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+    from ..parallel.batch import BatchedDsa, BatchedMaxSum, BatchedMgm
+    from . import build_algo_def, output_json, parse_algo_params
+
+    algo, algo_params, max_cycles = key
+    # validated/cast exactly like `solve` does; only user-given params
+    # travel to the vmapped solver constructor
+    algo_def = build_algo_def(algo, list(algo_params), "min")
+    given = parse_algo_params(list(algo_params))
+    params = {k: algo_def.params[k] for k in given}
+    params.pop("stop_cycle", None)
+
+    dcops, arrays_of = {}, {}
+    for _job, path, _it in rows:
+        if path not in dcops:
+            dcop = load_dcop_from_file(path)
+            dcops[path] = dcop
+            if FUSABLE_ALGOS[algo] == "factor":
+                arrays_of[path] = FactorGraphArrays.build(dcop)
+            else:
+                arrays_of[path] = HypergraphArrays.build(
+                    filter_dcop(dcop))
+
+    # sub-group by topology: only same-shape instances share a program
+    by_topo: Dict[Tuple, List] = {}
+    for row in rows:
+        sig = _topology_signature(arrays_of[row[1]])
+        by_topo.setdefault(sig, []).append(row)
+
+    for sub in by_topo.values():
+        template = arrays_of[sub[0][1]]
+        if len({path for _j, path, _it in sub}) == 1:
+            # repeated iterations of ONE instance: the batched solvers
+            # broadcast a single cube set across the batch axis — no
+            # N identical host/device copies (1024 iterations of a big
+            # instance would otherwise stack gigabytes)
+            cubes_batches = None
+        else:
+            cubes_batches = [
+                np.stack([arrays_of[path].buckets[i].cubes
+                          for _j, path, _it in sub])
+                for i in range(len(template.buckets))
+            ]
+        cls = {"maxsum": BatchedMaxSum, "dsa": BatchedDsa,
+               "mgm": BatchedMgm}[algo]
+        runner = cls(template, cubes_batches=cubes_batches,
+                     batch=len(sub), **params)
+        t0 = time.perf_counter()
+        sel, cycles, finished = runner.run(seed=0,
+                                           max_cycles=max_cycles)
+        elapsed = time.perf_counter() - t0
+        var_names = template.var_names
+        for i, (job_id, path, _it) in enumerate(sub):
+            dcop = dcops[path]
+            assignment = {
+                n: dcop.variable(n).domain.values[int(v)]
+                for n, v in zip(var_names, sel[i])
+            }
+            cost, violations = dcop.solution_cost(assignment)
+            out_path = os.path.join(out_dir, f"{job_id}.json")
+            output_json({
+                "status": ("FINISHED" if bool(finished[i])
+                           else "MAX_CYCLES"),
+                "assignment": assignment,
+                "cost": cost,
+                "violation": violations,
+                "cycle": int(cycles[i]),
+                # amortized: the whole sub-group ran as one program
+                "time": elapsed / len(sub),
+                "msg_count": 0,
+                "msg_size": 0,
+                "fused_batch": len(sub),
+            }, out_path, quiet=True)
+            register_done(job_id)
+            print(f"[ok] {job_id} (fused x{len(sub)}, "
+                  f"{elapsed:.1f}s total)")
+
+
 def run_cmd(args, timeout=None):
     with open(args.bench_def) as f:
         bench_def = yaml.safe_load(f)
     jobs = expand_jobs(bench_def)
     if args.simulate:
-        for job_id, argv in jobs:
+        for job_id, argv, _meta in jobs:
             print(job_id, "->", " ".join(shlex.quote(a) for a in argv))
         print(f"{len(jobs)} jobs")
         return 0
@@ -146,7 +286,7 @@ def run_cmd(args, timeout=None):
     if os.path.exists(progress_path):
         with open(progress_path) as f:
             done = {line.strip() for line in f if line.strip()}
-    todo = [(j, a) for j, a in jobs if j not in done]
+    todo = [job for job in jobs if job[0] not in done]
     print(f"{len(jobs)} jobs, {len(done)} done, {len(todo)} to run")
 
     import threading
@@ -154,8 +294,45 @@ def run_cmd(args, timeout=None):
 
     progress_lock = threading.Lock()
 
+    def register_done(job_id):
+        with progress_lock, open(progress_path, "a") as f:
+            f.write(job_id + "\n")
+
+    # partition: fusable engine-solve jobs by group key (>= 2 rows,
+    # else the subprocess path is simpler and equally fast)
+    fused_groups: Dict[Tuple, List] = {}
+    if getattr(args, "fuse", True):
+        for job_id, _argv, meta in todo:
+            fkey = _fuse_group_key(meta)
+            if fkey is not None:
+                fused_groups.setdefault(fkey, []).append(
+                    (job_id, meta["path"], meta["iteration"]))
+    fused_groups = {k: v for k, v in fused_groups.items()
+                    if len(v) >= 2}
+    fused_ids = {job_id for rows in fused_groups.values()
+                 for job_id, _p, _i in rows}
+    for fkey, rows in fused_groups.items():
+        completed = set()
+
+        def register_fused(job_id):
+            register_done(job_id)
+            completed.add(job_id)
+
+        try:
+            _run_fused_group(fkey, rows, args.out_dir, register_fused)
+        except Exception as e:  # fall back: report, run as processes
+            print(f"[fuse FAIL -> subprocess fallback] {fkey}: {e!r}",
+                  file=sys.stderr)
+            # only rows the group did NOT finish return to the
+            # subprocess path (a mid-group failure must not re-run —
+            # and overwrite — already-registered results)
+            fused_ids -= ({job_id for job_id, _p, _i in rows}
+                          - completed)
+    todo = [job for job in jobs
+            if job[0] not in done and job[0] not in fused_ids]
+
     def run_one(job):
-        job_id, argv = job
+        job_id, argv, _meta = job
         out_path = os.path.join(args.out_dir, f"{job_id}.json")
         argv = argv[:3] + ["--output", out_path] + argv[3:]
         t0 = time.perf_counter()
@@ -171,11 +348,10 @@ def run_cmd(args, timeout=None):
         except subprocess.TimeoutExpired:
             failure = f"timed out after {args.job_timeout}s"
         if failure is None:
-            # register_job immediately (not in submission order) so an
+            # register immediately (not in submission order) so an
             # interrupted --parallel campaign never re-runs a finished
             # job on resume (reference: batch.py:501)
-            with progress_lock, open(progress_path, "a") as f:
-                f.write(job_id + "\n")
+            register_done(job_id)
         else:
             with open(os.path.join(args.out_dir,
                                    f"{job_id}.log"), "w") as f:

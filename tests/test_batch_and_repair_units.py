@@ -60,9 +60,11 @@ def test_expand_jobs_sets_batches_iterations(tmp_path):
     jobs = expand_jobs(bench)
     # 2 files x 2 algos x 2 iterations
     assert len(jobs) == 8
-    ids = [j for j, _ in jobs]
+    ids = [j for j, _argv, _meta in jobs]
     assert len(set(ids)) == 8  # unique job ids (resume-file keys)
-    assert all("--timeout" in argv for _, argv in jobs)
+    assert all("--timeout" in argv for _, argv, _m in jobs)
+    # meta mirrors the expansion for the fused data-plane path
+    assert all(m["command"] == "solve" and m["path"] for *_, m in jobs)
 
 
 def test_expand_jobs_requires_batches():
@@ -263,5 +265,5 @@ def test_analysing_results_doc_campaign_expands(tmp_path):
         s["path"] = str(tmp_path / "p*.yaml")
     jobs = expand_jobs(bench)
     assert jobs
-    for job_id, argv in jobs:
+    for job_id, argv, _meta in jobs:
         assert "solve" in argv
