@@ -45,14 +45,20 @@ BASELINE_EDGES = 3_000
 
 def _build(stability: float):
     sys.path.insert(0, REPO)
-    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver)
     from pydcop_tpu.generators.fast import coloring_factor_arrays
 
     arrays = coloring_factor_arrays(
         N_VARS, N_EDGES, N_COLORS, seed=7, noise=0.05)
-    # lane-major layout: edges in the 128-lane dim (1.5x edge-major)
-    return arrays, MaxSumLaneSolver(arrays, damping=0.5,
-                                    stability=stability)
+    # lane-major layout: edges in the 128-lane dim (1.5x edge-major).
+    # PYDCOP_BENCH_LAYOUT=fused switches to the var-sorted one-gather
+    # layout; flip the default once an on-chip A/B
+    # (benchmarks/ab_variants.py) proves it faster there
+    cls = MaxSumFusedSolver \
+        if os.environ.get("PYDCOP_BENCH_LAYOUT") == "fused" \
+        else MaxSumLaneSolver
+    return arrays, cls(arrays, damping=0.5, stability=stability)
 
 
 def _conflicts(arrays, sel):

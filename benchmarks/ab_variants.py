@@ -1,0 +1,117 @@
+"""Process-isolated A/B of MaxSum step layouts (lane vs fused).
+
+The round-4 methodology finding (PERF_NOTES): on the tunneled chip the
+FIRST program compiled in a process runs ~1.6x faster than every later
+one, so cross-program A/B inside one process is invalid.  This driver
+runs ONE variant per child process, interleaved A/B/A/B..., and takes
+per-variant bests across processes.
+
+Usage:
+    python benchmarks/ab_variants.py [--rounds 3] [--cycles 60]
+    python benchmarks/ab_variants.py --child lane --cycles 60  # internal
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_VARS, N_EDGES, N_COLORS = 10_000, 30_000, 3
+
+
+def child(variant: str, cycles: int):
+    from functools import partial
+
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver)
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(
+        N_VARS, N_EDGES, N_COLORS, seed=7, noise=0.05)
+    cls = {"lane": MaxSumLaneSolver, "fused": MaxSumFusedSolver}[variant]
+    solver = cls(arrays, damping=0.5, stability=0.0)
+
+    @partial(jax.jit, donate_argnums=0)
+    def run_k(s):
+        return jax.lax.fori_loop(
+            0, cycles, lambda i, st: solver.step(st), s)
+
+    s = run_k(solver.init_state(jax.random.PRNGKey(0)))
+    jax.block_until_ready(s["q"])
+    best = float("inf")
+    for _ in range(5):
+        s0 = solver.init_state(jax.random.PRNGKey(0))
+        jax.block_until_ready(s0["q"])
+        t0 = time.perf_counter()
+        s = run_k(s0)
+        jax.block_until_ready(s["q"])
+        best = min(best, time.perf_counter() - t0)
+    sel = np.asarray(solver.assignment_indices(s))
+    b = arrays.buckets[0]
+    conflicts = int(np.sum(sel[b.var_ids[:, 0]] == sel[b.var_ids[:, 1]]))
+    print("AB_RESULT " + json.dumps({
+        "variant": variant,
+        "msgs_per_sec": 2 * arrays.n_edges * cycles / best,
+        "ms_per_cycle": best * 1000 / cycles,
+        "conflicts": conflicts,
+    }))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--cycles", type=int, default=60)
+    p.add_argument("--child", choices=("lane", "fused"), default=None)
+    args = p.parse_args()
+    if args.child:
+        child(args.child, args.cycles)
+        return
+    best = {"lane": None, "fused": None}
+    for rnd in range(args.rounds):
+        for variant in ("lane", "fused"):
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--child", variant, "--cycles", str(args.cycles)],
+                    capture_output=True, text=True, timeout=900,
+                    cwd=REPO)
+            except subprocess.TimeoutExpired:
+                # the tunneled chip's observed failure mode is a HANG,
+                # not an exit: record and keep the A/B going
+                print(f"round {rnd} {variant}: TIMEOUT (900s)")
+                continue
+            res = None
+            for line in proc.stdout.splitlines():
+                if line.startswith("AB_RESULT "):
+                    res = json.loads(line[len("AB_RESULT "):])
+            if res is None:
+                print(f"round {rnd} {variant}: FAILED "
+                      f"{proc.stderr.strip().splitlines()[-1:]}")
+                continue
+            print(f"round {rnd} {variant}: "
+                  f"{res['msgs_per_sec'] / 1e6:.1f} M msgs/s "
+                  f"({res['ms_per_cycle']:.3f} ms/cycle, "
+                  f"{res['conflicts']} conflicts)")
+            if best[variant] is None or res["msgs_per_sec"] > \
+                    best[variant]["msgs_per_sec"]:
+                best[variant] = res
+    if best["lane"] and best["fused"]:
+        ratio = best["fused"]["msgs_per_sec"] / \
+            best["lane"]["msgs_per_sec"]
+        print(json.dumps({
+            "lane_best_msgs_per_sec": best["lane"]["msgs_per_sec"],
+            "fused_best_msgs_per_sec": best["fused"]["msgs_per_sec"],
+            "fused_over_lane": round(ratio, 4),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,9 +48,12 @@ algo_params = [
     AlgoParameterDef("noise", "float", None, 0.0),
     AlgoParameterDef("stop_cycle", "int", None, 0),
     # lane_major puts edges in the 128-wide lane dim + uses the fused
-    # pallas factor kernel on TPU; auto picks it when the graph allows
+    # pallas factor kernel on TPU; fused additionally var-sorts the
+    # edge slots so the whole cycle has ONE irregular op (binary
+    # factors only); auto picks lane_major when the graph allows
     AlgoParameterDef("layout", "str",
-                     ["auto", "edge_major", "lane_major"], "auto"),
+                     ["auto", "edge_major", "lane_major", "fused"],
+                     "auto"),
 ]
 
 
@@ -602,11 +605,276 @@ class MaxSumLaneSolver(MaxSumSolver):
         return self._advance(s, key, q_new, new_r, selection, delta)
 
 
+class MaxSumFusedSolver(MaxSumLaneSolver):
+    """Var-sorted, degree-bucketed ``(D, E')`` layout: ONE irregular op
+    per cycle.
+
+    The lane solver's cycle carries two irregular ops — the
+    ``.at[:, edge_var].add`` scatter building per-variable belief sums
+    and the ``belief[:, edge_var]`` gather redistributing them — which
+    the round-3 ablation measured at half the cycle (~0.58 ms of
+    ~1.13 ms, benchmarks/PERF_NOTES.md).  This layout is the
+    "var-sorted second edge ordering" that ablation proposed:
+
+    * edge slots are grouped BY VARIABLE, each variable padded to a
+      power-of-two slot count K and variables bucketed by K, so the
+      segment-sum becomes a static ``reshape(D, nv, K).sum(2)`` and the
+      belief redistribution a static broadcast — both fusable by XLA
+      into the surrounding elementwise chain;
+    * the factor update reads its partner messages through ONE static
+      permutation gather (``q[:, partner_slot]``) and evaluates the
+      per-slot oriented cube slice ``(D_other, D_self, E')`` with a
+      broadcast-add + min-reduce — no per-bucket slicing, no scatter.
+
+    Average padding overhead on random graphs is ~1.3-1.6x edge slots;
+    the bet (per the PERF_NOTES per-kernel-floor measurement: op COUNT
+    dominates FLOPs at these shapes) is that removing an irregular op
+    and letting XLA fuse the entire post-gather chain beats the extra
+    lanes.  Semantics are identical to :class:`MaxSumLaneSolver` up to
+    float association (exact-selection equality is asserted in tests).
+
+    Requires the canonical factor-major edge layout with ONLY binary
+    factors (fold unary constraints into variable costs via
+    ``filter_dcop`` first — the fast generators already emit this
+    form).
+    """
+
+    @staticmethod
+    def eligible(arrays: FactorGraphArrays) -> bool:
+        layout = MaxSumSolver._detect_canonical(arrays)
+        if layout is None or arrays.n_edges == 0:
+            return False
+        return all(spec is None or spec[2] == 2 for spec in layout)
+
+    def __init__(self, arrays: FactorGraphArrays, **kwargs):
+        kwargs.pop("use_pallas", None)  # no hand kernel on this path:
+        # the whole point is letting XLA fuse the single-gather chain
+        super().__init__(arrays, use_pallas=False, **kwargs)
+        self._build_fused_layout()
+
+    # ------------------------------------------------------ host layout
+
+    def _build_fused_layout(self):
+        import numpy as np
+
+        arrays = self.arrays
+        E, V = arrays.n_edges, self.V
+        edge_var = np.asarray(arrays.edge_var)
+
+        # canonical partner: edges 2i / 2i+1 of a binary bucket are the
+        # two endpoints of factor i
+        partner = np.empty(E, dtype=np.int64)
+        for spec in self._canonical:
+            if spec is None:
+                continue
+            off, f, _arity = spec
+            rel = np.arange(2 * f, dtype=np.int64)
+            partner[off + rel] = off + (rel ^ 1)
+
+        deg = np.bincount(edge_var, minlength=V)
+        kof = np.where(
+            deg <= 1, 1,
+            2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
+        ks = sorted(set(int(k) for k in kof))
+        var_order = np.concatenate(
+            [np.where(kof == k)[0] for k in ks]).astype(np.int64)
+        var_pos = np.empty(V, dtype=np.int64)
+        var_pos[var_order] = np.arange(V)
+
+        # slot table: per sorted variable, its incident edges then -1
+        # padding up to its bucket's K
+        incident = [[] for _ in range(V)]
+        for e, v in enumerate(edge_var):
+            incident[v].append(e)
+        kbuckets = []          # (slot_off, var_off, n_vars, K)
+        slot_edge = []
+        var_off = 0
+        for k in ks:
+            vs = var_order[var_off:var_off + int((kof == k).sum())]
+            kbuckets.append((len(slot_edge), var_off, len(vs), k))
+            for v in vs:
+                es = incident[v]
+                slot_edge.extend(es)
+                slot_edge.extend([-1] * (k - len(es)))
+            var_off += len(vs)
+        slot_edge = np.asarray(slot_edge, dtype=np.int64)
+        ep = len(slot_edge)
+        valid = slot_edge >= 0
+
+        slot_of_edge = np.empty(E, dtype=np.int64)
+        slot_of_edge[slot_edge[valid]] = np.where(valid)[0]
+        partner_slot = np.zeros(ep, dtype=np.int32)
+        partner_slot[valid] = slot_of_edge[partner[slot_edge[valid]]]
+
+        # oriented per-slot cube slice: new_r[ds, s] =
+        #   min_do cube_slotT[do, ds, s] + q_partner[do, s]
+        D = self.D
+        cube_slotT = np.zeros((D, D, ep), dtype=np.float32)
+        for spec, b in zip(self._canonical, arrays.buckets):
+            if spec is None:
+                continue
+            off, f, _arity = spec
+            cubes = np.asarray(b.cubes)              # (f, D, D)
+            for pos in range(2):
+                es = off + 2 * np.arange(f) + pos
+                ss = slot_of_edge[es]
+                # pos 0 receives over axis 1 (transpose), pos 1 over
+                # axis 0 (as-is): cube_slotT[do, ds]
+                sl = np.transpose(cubes, (2, 1, 0)) if pos == 0 \
+                    else np.transpose(cubes, (1, 2, 0))
+                cube_slotT[:, :, ss] = sl
+        slot_var_sorted = np.repeat(
+            np.arange(V), np.concatenate(
+                [[k] * nv for _off, _voff, nv, k in kbuckets]
+                if kbuckets else [[]]).astype(np.int64))
+
+        self._kbuckets = kbuckets
+        self._np_fused = {
+            "partner_slot": partner_slot,
+            "cube_slotT": cube_slotT,
+            "var_order": var_order,
+            "var_pos": var_pos,
+            "valid": valid,
+            "slot_var_sorted": slot_var_sorted,
+        }
+        self.EP = ep
+
+    # ---------------------------------------------- device constants
+
+    @property
+    def partner_slot(self):
+        return self._dev("partner_slot", lambda: jnp.asarray(
+            self._np_fused["partner_slot"]))
+
+    @property
+    def cube_slotT(self):
+        return self._dev("cube_slotT", lambda: jnp.asarray(
+            self._np_fused["cube_slotT"]))
+
+    @property
+    def var_costsT_sorted(self):
+        return self._dev("var_costsT_sorted", lambda: jnp.asarray(
+            self.arrays.var_costs.T[:, self._np_fused["var_order"]]))
+
+    @property
+    def domain_maskT_sorted(self):
+        return self._dev("domain_maskT_sorted", lambda: jnp.asarray(
+            self.arrays.domain_mask.T[:, self._np_fused["var_order"]]))
+
+    @property
+    def emaskT_fused(self):
+        def build():
+            import numpy as np
+
+            nf = self._np_fused
+            m = self.arrays.domain_mask.T[
+                :, nf["var_order"]][:, nf["slot_var_sorted"]]
+            return jnp.asarray(m & nf["valid"][None, :])
+
+        return self._dev("emaskT_fused", build)
+
+    @property
+    def slot_dsize(self):
+        def build():
+            import numpy as np
+
+            nf = self._np_fused
+            ds = np.asarray(self.arrays.domain_size)[
+                nf["var_order"]][nf["slot_var_sorted"]]
+            return jnp.asarray(np.maximum(ds, 1).astype(np.float32))
+
+        return self._dev("slot_dsize", build)
+
+    @property
+    def var_pos_dev(self):
+        return self._dev("var_pos_dev", lambda: jnp.asarray(
+            self._np_fused["var_pos"]))
+
+    # ------------------------------------------------------------ state
+
+    def init_state(self, key):
+        zeros = jnp.where(self.emaskT_fused, 0.0, BIG)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": key,
+            "q": zeros,                       # (D, E') var-sorted
+            "r": jnp.zeros_like(zeros),
+            "selection": self._select_sorted(self.var_costsT_sorted),
+            "same": jnp.int32(0),
+        }
+
+    def _select_sorted(self, beliefT_sorted):
+        return jnp.argmin(
+            jnp.where(self.domain_maskT_sorted, beliefT_sorted,
+                      BIG * 2), axis=0)
+
+    def _variable_update(self, new_r):
+        """Static belief/redistribution: per degree bucket, a reshape
+        sum over the K slot axis and a broadcast subtract."""
+        D = self.D
+        belief_parts, q_parts = [], []
+        for slot_off, var_off, nv, k in self._kbuckets:
+            blk = new_r[:, slot_off:slot_off + nv * k] \
+                .reshape(D, nv, k)
+            belief_blk = self.var_costsT_sorted[
+                :, var_off:var_off + nv] + blk.sum(axis=2)
+            q_parts.append(
+                (belief_blk[:, :, None] - blk).reshape(D, nv * k))
+            belief_parts.append(belief_blk)
+        belief = belief_parts[0] if len(belief_parts) == 1 else \
+            jnp.concatenate(belief_parts, axis=1)
+        q_new = q_parts[0] if len(q_parts) == 1 else \
+            jnp.concatenate(q_parts, axis=1)
+        return belief, q_new
+
+    def step(self, s):
+        q, r = s["q"], s["r"]
+        # the cycle's ONE irregular op: partner permutation
+        q_part = q[:, self.partner_slot]
+        new_r = jnp.min(self.cube_slotT + q_part[:, None, :], axis=0)
+        new_r = jnp.where(self.emaskT_fused, new_r, 0.0)
+        if self.damping_nodes in ("factors", "both") and self.damping > 0:
+            new_r = self.damping * r + (1 - self.damping) * new_r
+
+        belief, q_new = self._variable_update(new_r)
+        mean = (jnp.sum(jnp.where(self.emaskT_fused, q_new, 0.0),
+                        axis=0) / self.slot_dsize)
+        q_new = q_new - mean[None, :]
+        key = s["key"]
+        if self.noise > 0:
+            key, sub = jax.random.split(key)
+            q_new = q_new + self.noise * jax.random.uniform(
+                sub, q_new.shape)
+        if self.damping_nodes in ("vars", "both") and self.damping > 0:
+            q_new = self.damping * q + (1 - self.damping) * q_new
+        q_new = jnp.where(self.emaskT_fused, q_new, BIG)
+
+        selection = self._select_sorted(belief) if self.stability > 0 \
+            else s["selection"]
+        delta = jnp.max(jnp.where(self.emaskT_fused,
+                                  jnp.abs(q_new - q), 0.0)) \
+            if self.EP and self.stability > 0 else jnp.float32(0)
+        return self._advance(s, key, q_new, new_r, selection, delta)
+
+    def assignment_indices(self, s):
+        if self.stability > 0:
+            sel_sorted = s["selection"]
+        else:
+            belief, _ = self._variable_update(
+                jnp.where(self.emaskT_fused, s["r"], 0.0))
+            sel_sorted = self._select_sorted(belief)
+        # state order is degree-sorted; decode to original variables
+        return sel_sorted[self.var_pos_dev]
+
+
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> MaxSumSolver:
     params = dict(params) if params else {}
     layout = params.pop("layout", "auto")
     arrays = FactorGraphArrays.build(dcop, variables, constraints)
+    if layout == "fused":
+        return MaxSumFusedSolver(arrays, **params)
     if layout == "lane_major" or (
             layout == "auto" and MaxSumLaneSolver.eligible(arrays)):
         return MaxSumLaneSolver(arrays, **params)

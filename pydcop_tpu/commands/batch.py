@@ -98,7 +98,7 @@ def expand_jobs(bench_def: Dict
                     for it in range(iterations):
                         job_id = _job_id(set_name, batch_name, path,
                                          conf, it)
-                        argv = _job_argv(command, path, conf)
+                        argv = _job_argv(command, path, conf, it)
                         jobs.append((job_id, argv, {
                             "command": command, "path": path,
                             "conf": conf, "iteration": it}))
@@ -117,7 +117,16 @@ def _job_id(set_name, batch_name, path, conf, iteration) -> str:
         .replace("/", "-").replace(" ", "")
 
 
-def _job_argv(command: str, path, conf: Dict[str, Any]) -> List[str]:
+def _has_seed(conf: Dict[str, Any]) -> bool:
+    if "seed" in conf:
+        return True
+    ap = conf.get("algo_params", [])
+    ap = ap if isinstance(ap, list) else [ap]
+    return any(str(p).strip().startswith("seed:") for p in ap)
+
+
+def _job_argv(command: str, path, conf: Dict[str, Any],
+              iteration: int = 0) -> List[str]:
     argv = [sys.executable, "-m", "pydcop_tpu.dcop_cli"]
     timeout = conf.get("timeout")
     if timeout is not None:
@@ -135,6 +144,11 @@ def _job_argv(command: str, path, conf: Dict[str, Any]) -> List[str]:
                 argv += [flag, str(item)]
         else:
             argv += [flag, str(v)]
+    if command == "solve" and not _has_seed(conf):
+        # replicates must be fresh draws: each iteration gets its own
+        # seed (the solve CLI's fixed default would make every
+        # iteration of a stochastic algorithm byte-identical)
+        argv += ["--seed", str(iteration)]
     if path:
         argv.append(path)
     return argv
@@ -155,7 +169,8 @@ FUSABLE_ALGOS = {"maxsum": "factor", "dsa": "hyper", "mgm": "hyper"}
 #: other option — including a per-job `timeout`, which a single fused
 #: program cannot enforce per instance — falls back to the subprocess
 #: path untouched
-_FUSE_CONF_KEYS = {"algo", "algo_params", "max_cycles", "mode"}
+_FUSE_CONF_KEYS = {"algo", "algo_params", "max_cycles", "mode",
+                   "seed"}
 #: the `solve` CLI's --max_cycles default: fused and subprocess runs of
 #: the same campaign must stop at the same budget
 _SOLVE_MAX_CYCLES_DEFAULT = 2000
@@ -171,17 +186,23 @@ def _fuse_group_key(meta) -> Optional[Tuple]:
         return None
     ap = conf.get("algo_params", [])
     ap = tuple(sorted(ap if isinstance(ap, list) else [ap]))
+    seed = conf.get("seed")
     return (algo, ap,
-            int(conf.get("max_cycles", _SOLVE_MAX_CYCLES_DEFAULT)))
+            int(conf.get("max_cycles", _SOLVE_MAX_CYCLES_DEFAULT)),
+            int(seed) if seed is not None else None)
 
 
 def _topology_signature(arrays) -> Tuple:
     """Instances fuse only when everything BUT the constraint cost
     tables matches: the vmapped solvers batch over cubes, all other
-    solver constants come from the shared template."""
+    solver constants — including declared initial values, which seed
+    the local-search start state — come from the shared template."""
     buckets = [(b.arity, b.var_ids.tobytes()) for b in arrays.buckets]
+    initial = (arrays.initial_idx.tobytes(),
+               arrays.has_initial.tobytes()) \
+        if hasattr(arrays, "initial_idx") else ()
     return (tuple(arrays.var_names), arrays.domain_size.tobytes(),
-            arrays.var_costs.tobytes(), tuple(buckets))
+            arrays.var_costs.tobytes(), initial, tuple(buckets))
 
 
 def _run_fused_group(key, rows, out_dir, register_done):
@@ -197,13 +218,20 @@ def _run_fused_group(key, rows, out_dir, register_done):
     from ..parallel.batch import BatchedDsa, BatchedMaxSum, BatchedMgm
     from . import build_algo_def, output_json, parse_algo_params
 
-    algo, algo_params, max_cycles = key
+    algo, algo_params, max_cycles, conf_seed = key
     # validated/cast exactly like `solve` does; only user-given params
     # travel to the vmapped solver constructor
     algo_def = build_algo_def(algo, list(algo_params), "min")
     given = parse_algo_params(list(algo_params))
     params = {k: algo_def.params[k] for k in given}
     params.pop("stop_cycle", None)
+    # engine-level seed: explicit (--seed / -p seed:) pins every row,
+    # otherwise each row draws from its ITERATION index — matching the
+    # subprocess path, where iterations get --seed <iteration> so
+    # replicates are fresh draws, not N identical runs
+    explicit_seed = conf_seed if conf_seed is not None \
+        else params.pop("seed", None)
+    params.pop("seed", None)
 
     dcops, arrays_of = {}, {}
     for _job, path, _it in rows:
@@ -240,9 +268,11 @@ def _run_fused_group(key, rows, out_dir, register_done):
                "mgm": BatchedMgm}[algo]
         runner = cls(template, cubes_batches=cubes_batches,
                      batch=len(sub), **params)
+        seeds = [int(explicit_seed) if explicit_seed is not None
+                 else it for _j, _p, it in sub]
         t0 = time.perf_counter()
-        sel, cycles, finished = runner.run(seed=0,
-                                           max_cycles=max_cycles)
+        sel, cycles, finished = runner.run(max_cycles=max_cycles,
+                                           seeds=seeds)
         elapsed = time.perf_counter() - t0
         var_names = template.var_names
         for i, (job_id, path, _it) in enumerate(sub):
@@ -269,6 +299,28 @@ def _run_fused_group(key, rows, out_dir, register_done):
             register_done(job_id)
             print(f"[ok] {job_id} (fused x{len(sub)}, "
                   f"{elapsed:.1f}s total)")
+
+
+def _fused_child_main(argv=None) -> int:
+    """Child entry for one fused group (`python -m
+    pydcop_tpu.commands.batch <spec.json>`): isolates the vmapped run
+    so the parent can enforce --job_timeout with a kill, exactly like
+    the subprocess job path."""
+    import json
+
+    spec_path = (argv or sys.argv[1:])[0]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    key = (spec["key"][0], tuple(spec["key"][1]), spec["key"][2],
+           spec["key"][3])
+    rows = [tuple(r) for r in spec["rows"]]
+
+    def register_done(job_id):
+        with open(spec["progress_path"], "a") as f:
+            f.write(job_id + "\n")
+
+    _run_fused_group(key, rows, spec["out_dir"], register_done)
+    return 0
 
 
 def run_cmd(args, timeout=None):
@@ -311,23 +363,48 @@ def run_cmd(args, timeout=None):
                     if len(v) >= 2}
     fused_ids = {job_id for rows in fused_groups.values()
                  for job_id, _p, _i in rows}
-    for fkey, rows in fused_groups.items():
-        completed = set()
+    for gi, (fkey, rows) in enumerate(fused_groups.items()):
+        # one child process per group: --job_timeout bounds the WHOLE
+        # fused group (fusion's amortization promise: a group costs
+        # about one job) and a kill cannot corrupt the parent
+        import json as _json
 
-        def register_fused(job_id):
-            register_done(job_id)
-            completed.add(job_id)
-
+        spec_path = os.path.join(args.out_dir, f".fused_{gi}.json")
+        with open(spec_path, "w") as f:
+            _json.dump({"key": list(fkey), "rows": [list(r)
+                                                    for r in rows],
+                        "out_dir": args.out_dir,
+                        "progress_path": progress_path}, f)
+        failure = None
         try:
-            _run_fused_group(fkey, rows, args.out_dir, register_fused)
-        except Exception as e:  # fall back: report, run as processes
-            print(f"[fuse FAIL -> subprocess fallback] {fkey}: {e!r}",
-                  file=sys.stderr)
-            # only rows the group did NOT finish return to the
-            # subprocess path (a mid-group failure must not re-run —
-            # and overwrite — already-registered results)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pydcop_tpu.commands.batch",
+                 spec_path], capture_output=True, text=True,
+                timeout=args.job_timeout)
+            sys.stdout.write(proc.stdout)
+            if proc.returncode != 0:
+                failure = (proc.stderr.strip().splitlines()
+                           or ["no output"])[-1][:300]
+        except subprocess.TimeoutExpired:
+            failure = f"fused group timed out ({args.job_timeout}s)"
+        finally:
+            try:
+                os.remove(spec_path)
+            except OSError:
+                pass
+        if failure is not None:
+            print(f"[fuse FAIL -> subprocess fallback] {fkey}: "
+                  f"{failure}", file=sys.stderr)
+            # the child registers each job as it completes: only rows
+            # it did NOT finish return to the subprocess path (never
+            # re-run — and overwrite — an already-registered result)
+            registered = set()
+            if os.path.exists(progress_path):
+                with open(progress_path) as f:
+                    registered = {line.strip() for line in f
+                                  if line.strip()}
             fused_ids -= ({job_id for job_id, _p, _i in rows}
-                          - completed)
+                          - registered)
     todo = [job for job in jobs
             if job[0] not in done and job[0] not in fused_ids]
 
@@ -368,3 +445,7 @@ def run_cmd(args, timeout=None):
               f"(see *.log in {args.out_dir})", file=sys.stderr)
         return 1
     return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_fused_child_main())

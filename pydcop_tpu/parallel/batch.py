@@ -17,6 +17,14 @@ from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
 from ..algorithms.maxsum import MaxSumSolver
 
 
+def _batch_keys(seed, seeds, b):
+    if seeds is None:
+        return jax.random.split(jax.random.PRNGKey(seed), b)
+    if len(seeds) != b:
+        raise ValueError(f"need {b} seeds, got {len(seeds)}")
+    return jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+
+
 class BatchedMaxSum:
     """vmap MaxSum over stacked per-instance cost cubes (same topology)."""
 
@@ -65,10 +73,13 @@ class BatchedMaxSum:
         self.max_cycles = 200
         self._jitted = {}  # max_cycles -> compiled vmapped runner
 
-    def run(self, seed: int = 0, max_cycles: int = 200):
-        """Returns (selections (B, V), cycles (B,), finished (B,))."""
+    def run(self, seed: int = 0, max_cycles: int = 200, seeds=None):
+        """Returns (selections (B, V), cycles (B,), finished (B,)).
+        ``seeds`` gives each instance its own engine seed (fused batch
+        campaigns: row i carries job i's declared seed); default is the
+        split-key stream of ``seed``."""
         self.max_cycles = max_cycles
-        keys = jax.random.split(jax.random.PRNGKey(seed), self.B)
+        keys = _batch_keys(seed, seeds, self.B)
         # max_cycles is baked into the traced while-loop via the closure,
         # so the compiled runner is cached per max_cycles value
         run_all = self._jitted.get(max_cycles)
@@ -135,10 +146,11 @@ class _BatchedLocalSearch:
 
         self._one = one_instance
 
-    def run(self, seed: int = 0, max_cycles: int = 200):
-        """Returns (selections (B, V), cycles (B,), finished (B,))."""
+    def run(self, seed: int = 0, max_cycles: int = 200, seeds=None):
+        """Returns (selections (B, V), cycles (B,), finished (B,));
+        ``seeds`` optionally fixes one engine seed per instance."""
         self.max_cycles = max_cycles
-        keys = jax.random.split(jax.random.PRNGKey(seed), self.B)
+        keys = _batch_keys(seed, seeds, self.B)
         run_all = self._jitted.get(max_cycles)
         if run_all is None:
             run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))

@@ -391,3 +391,87 @@ def test_damping_zero_is_undamped():
         trajectories.append(np.asarray(s["q"]))
     for t in trajectories[1:]:
         assert np.array_equal(trajectories[0], t)
+
+
+def test_fused_layout_matches_lane_exactly():
+    """MaxSumFusedSolver (var-sorted degree-bucketed slots, ONE
+    irregular op per cycle — the PERF_NOTES round-4 design) must track
+    the lane solver's selections and convergence exactly."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver)
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(120, 360, 3, seed=9, noise=0.05)
+    lane = MaxSumLaneSolver(arrays, damping=0.5, stability=0.1)
+    fused = MaxSumFusedSolver(arrays, damping=0.5, stability=0.1)
+    # padded slots: each variable rounds up to a power-of-two degree
+    assert fused.EP >= arrays.n_edges
+    sl = lane.init_state(jax.random.PRNGKey(0))
+    sf = fused.init_state(jax.random.PRNGKey(0))
+    step_l, step_f = jax.jit(lane.step), jax.jit(fused.step)
+    for _ in range(30):
+        sl, sf = step_l(sl), step_f(sf)
+        assert np.array_equal(np.asarray(lane.assignment_indices(sl)),
+                              np.asarray(fused.assignment_indices(sf)))
+        assert bool(sl["finished"]) == bool(sf["finished"])
+        assert int(sl["same"]) == int(sf["same"])
+
+
+def test_fused_layout_lazy_decode_and_eligibility():
+    """stability=0 elides the per-cycle argmin: the fused decode must
+    rebuild beliefs from the final messages like the lane solver; a
+    non-binary factor graph is rejected."""
+    import jax
+    import numpy as np
+    import pytest as _pytest
+
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver)
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(60, 150, 3, seed=2, noise=0.05)
+    lane = MaxSumLaneSolver(arrays, damping=0.5, stability=0.0)
+    fused = MaxSumFusedSolver(arrays, damping=0.5, stability=0.0)
+    sl, sf = (s.init_state(jax.random.PRNGKey(0))
+              for s in (lane, fused))
+    step_l, step_f = jax.jit(lane.step), jax.jit(fused.step)
+    for _ in range(12):
+        sl, sf = step_l(sl), step_f(sf)
+    assert np.array_equal(np.asarray(lane.assignment_indices(sl)),
+                          np.asarray(fused.assignment_indices(sf)))
+
+    ternary = load_dcop("""
+name: t3
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b}
+  y: {domain: b}
+  z: {domain: b}
+constraints:
+  c: {type: intention, function: x + y + z}
+agents: [a1, a2, a3]
+""")
+    with _pytest.raises(ValueError):
+        MaxSumFusedSolver(FactorGraphArrays.build(ternary))
+
+
+def test_build_solver_fused_layout_param():
+    """`-p layout:fused` reaches the fused solver through the public
+    param surface and still solves the CI golden."""
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              build_solver)
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.run import solve
+
+    dcop = load_dcop(GC3)
+    assert type(build_solver(dcop, {"layout": "fused"})) \
+        is MaxSumFusedSolver
+    assert solve(dcop, "maxsum", timeout=10,
+                 layout="fused") == {"v1": "R", "v2": "G", "v3": "R"}
