@@ -21,8 +21,6 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-N_VARS, N_EDGES, N_COLORS = 10_000, 30_000, 3
-
 
 def child(variant: str, cycles: int):
     from functools import partial
@@ -30,12 +28,16 @@ def child(variant: str, cycles: int):
     import jax
     import numpy as np
 
+    # the A/B must measure EXACTLY the headline bench's problem: reuse
+    # its construction (instance constants, seed, noise) so a bench
+    # change can never silently desynchronize the comparison that
+    # gates flipping the default layout
+    import bench
     from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
                                               MaxSumLaneSolver)
-    from pydcop_tpu.generators.fast import coloring_factor_arrays
 
-    arrays = coloring_factor_arrays(
-        N_VARS, N_EDGES, N_COLORS, seed=7, noise=0.05)
+    os.environ.pop("PYDCOP_BENCH_LAYOUT", None)
+    arrays, _ = bench._build(stability=0.0)
     cls = {"lane": MaxSumLaneSolver, "fused": MaxSumFusedSolver}[variant]
     solver = cls(arrays, damping=0.5, stability=0.0)
 
@@ -55,8 +57,7 @@ def child(variant: str, cycles: int):
         jax.block_until_ready(s["q"])
         best = min(best, time.perf_counter() - t0)
     sel = np.asarray(solver.assignment_indices(s))
-    b = arrays.buckets[0]
-    conflicts = int(np.sum(sel[b.var_ids[:, 0]] == sel[b.var_ids[:, 1]]))
+    conflicts = bench._conflicts(arrays, sel)
     print("AB_RESULT " + json.dumps({
         "variant": variant,
         "msgs_per_sec": 2 * arrays.n_edges * cycles / best,

@@ -225,13 +225,21 @@ def _run_fused_group(key, rows, out_dir, register_done):
     given = parse_algo_params(list(algo_params))
     params = {k: algo_def.params[k] for k in given}
     params.pop("stop_cycle", None)
-    # engine-level seed: explicit (--seed / -p seed:) pins every row,
-    # otherwise each row draws from its ITERATION index — matching the
-    # subprocess path, where iterations get --seed <iteration> so
-    # replicates are fresh draws, not N identical runs
-    explicit_seed = conf_seed if conf_seed is not None \
-        else params.pop("seed", None)
-    params.pop("seed", None)
+    # engine-level seed, mirroring the subprocess path exactly:
+    # `--seed N` (conf) pins every row; a `-p seed:` algo-param is
+    # INERT for compiled engine solvers (mp-plane only, see
+    # algorithms/_mp.py) but its presence suppresses the per-iteration
+    # default, so rows then share the solve CLI's default seed 0;
+    # otherwise each row draws from its ITERATION index (the
+    # `--seed <iteration>` _job_argv appends) so replicates are fresh
+    # draws, not N identical runs
+    ap_has_seed = params.pop("seed", None) is not None
+    if conf_seed is not None:
+        explicit_seed = conf_seed
+    elif ap_has_seed:
+        explicit_seed = 0
+    else:
+        explicit_seed = None
 
     dcops, arrays_of = {}, {}
     for _job, path, _it in rows:

@@ -461,6 +461,27 @@ agents: [a1, a2, a3]
     with _pytest.raises(ValueError):
         MaxSumFusedSolver(FactorGraphArrays.build(ternary))
 
+    # a unary FACTOR graph is lane-eligible but not fused-eligible:
+    # the error must state the fused requirement (binary factors /
+    # filter_dcop), not the lane solver's (code-review r5)
+    unary = load_dcop("""
+name: u1
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b}
+  y: {domain: b}
+constraints:
+  pref: {type: intention, function: 2 * x}
+  cxy: {type: intention, function: 1 if x == y else 0}
+agents: [a1, a2]
+""")
+    u_arrays = FactorGraphArrays.build(unary)
+    assert MaxSumLaneSolver.eligible(u_arrays)
+    with _pytest.raises(ValueError, match="binary factors"):
+        MaxSumFusedSolver(u_arrays)
+
 
 def test_build_solver_fused_layout_param():
     """`-p layout:fused` reaches the fused solver through the public
@@ -475,3 +496,39 @@ def test_build_solver_fused_layout_param():
         is MaxSumFusedSolver
     assert solve(dcop, "maxsum", timeout=10,
                  layout="fused") == {"v1": "R", "v2": "G", "v3": "R"}
+
+
+def test_delta_on_beliefs_converges_and_matches():
+    """delta_on=beliefs (the cheap V-sized convergence delta, VERDICT
+    r4 item 6) converges on an easy instance with the same final
+    selection as the message-delta default, in every layout."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver,
+                                              MaxSumSolver)
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    arrays = coloring_factor_arrays(40, 50, 3, seed=5, noise=0.05)
+    finals = {}
+    for cls in (MaxSumSolver, MaxSumLaneSolver, MaxSumFusedSolver):
+        for delta_on in ("messages", "beliefs"):
+            solver = cls(arrays, damping=0.5, stability=0.1,
+                         delta_on=delta_on)
+            s = solver.init_state(jax.random.PRNGKey(0))
+            step = jax.jit(solver.step)
+            for _ in range(80):
+                s = step(s)
+                if bool(s["finished"]):
+                    break
+            assert bool(s["finished"]), (cls.__name__, delta_on)
+            finals[(cls.__name__, delta_on)] = (
+                tuple(np.asarray(solver.assignment_indices(s))),
+                int(s["cycle"]))
+    sels = {v[0] for v in finals.values()}
+    assert len(sels) == 1, finals  # same fixed point everywhere
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="delta_on"):
+        MaxSumSolver(arrays, delta_on="nope")
