@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Optional
 
-from . import build_algo_def, output_json
+from . import CliError, build_algo_def, output_json
 from ..dcop.yamldcop import load_dcop_from_file
 
 
@@ -95,6 +95,12 @@ def run_cmd(args, timeout: Optional[float] = None):
         params = {k: algo_def.params[k] for k in given}
         for engine_only in ("stop_cycle", "seed"):
             params.pop(engine_only, None)
+        # single-chip-only engine knob: reject loudly rather than let
+        # the sharded solver constructor TypeError on it
+        if params.pop("delta_on", "messages") != "messages":
+            raise CliError(
+                "delta_on:beliefs is a single-chip engine knob; "
+                "sharded convergence keeps the message-delta semantics")
         assignment, _best_cost, cycles, finished = solve_sharded(
             dcop, args.algo, n_cycles=args.max_cycles,
             batch=args.batch, seed=args.seed, **params)

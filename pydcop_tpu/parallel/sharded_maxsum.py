@@ -128,6 +128,15 @@ class ShardedMaxSum:
                 f"batch {batch} must be a multiple of dp={self.dp}")
         self.B = batch
 
+        # validate BEFORE the host-side factor partition: a bad layout
+        # must fail fast, not after padding every bucket across shards
+        if layout not in ("auto", "edge_major", "lane_major"):
+            raise ValueError(
+                f"sharded maxsum supports layouts auto/edge_major/"
+                f"lane_major, not {layout!r} (the fused var-sorted "
+                f"layout is single-chip only: its per-shard degree "
+                f"bucketing would be shape-heterogeneous across "
+                f"shards)")
         shard_buckets, edge_var, e_loc = _partition(arrays, self.tp)
         self.E_loc = e_loc
         self.buckets = shard_buckets

@@ -709,3 +709,12 @@ def test_dryrun_fails_on_broken_psum_hook(monkeypatch):
                         lambda a: jnp.zeros_like(a))
     with pytest.raises(AssertionError, match="quality bound"):
         g.dryrun_multichip(8)
+
+
+def test_sharded_maxsum_rejects_single_chip_only_layout():
+    """-p layout:fused is valid for the single-chip engine but must be
+    rejected loudly (not silently downgraded) on the mesh."""
+    arrays = coloring_factor_arrays(10, 15, 3, seed=0)
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="single-chip only"):
+        ShardedMaxSum(arrays, mesh, layout="fused", batch=4)
