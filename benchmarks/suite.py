@@ -260,24 +260,16 @@ def bench_batched_localsearch(quick=False):
 
 
 _SHARDED_UTIL_CHILD = r"""
-import itertools, json, time
+import json, time
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
 from pydcop_tpu.algorithms import dpop
 from pydcop_tpu.dcop.yamldcop import load_dcop
+from pydcop_tpu.generators.fast import clique_dcop_yaml
 
 N, LIMIT = {n}, {limit}
-lines = ["name: w", "objective: min", "domains:",
-         "  d: {{values: [0,1,2,3,4,5,6,7]}}", "variables:"]
-for i in range(N):
-    lines.append(f"  v{{i}}: {{{{domain: d}}}}")
-lines.append("constraints:")
-for i, j in itertools.combinations(range(N), 2):
-    lines.append(f"  c{{i}}{{j}}: {{{{type: intention, function: "
-                 f"(v{{i}}*3+v{{j}}*5+{{(i+j) % 7}}) % 11}}}}")
-lines.append("agents: [" + ", ".join(f"a{{i}}" for i in range(N)) + "]")
-src = "\n".join(lines)
+src = clique_dcop_yaml(N, 8)
 mesh = jax.sharding.Mesh(np.array(jax.devices()), ("tp",))
 
 t0 = time.perf_counter()

@@ -736,23 +736,29 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
         var_pos[var_order] = np.arange(V)
 
         # slot table: per sorted variable, its incident edges then -1
-        # padding up to its bucket's K
-        incident = [[] for _ in range(V)]
-        for e, v in enumerate(edge_var):
-            incident[v].append(e)
+        # padding up to its bucket's K — fully vectorized (no Python
+        # loop over edges: million-edge instances build in milliseconds)
         kbuckets = []          # (slot_off, var_off, n_vars, K)
-        slot_edge = []
-        var_off = 0
+        slot_off = var_off = 0
         for k in ks:
-            vs = var_order[var_off:var_off + int((kof == k).sum())]
-            kbuckets.append((len(slot_edge), var_off, len(vs), k))
-            for v in vs:
-                es = incident[v]
-                slot_edge.extend(es)
-                slot_edge.extend([-1] * (k - len(es)))
-            var_off += len(vs)
-        slot_edge = np.asarray(slot_edge, dtype=np.int64)
-        ep = len(slot_edge)
+            nv = int((kof == k).sum())
+            kbuckets.append((slot_off, var_off, nv, k))
+            slot_off += nv * k
+            var_off += nv
+        ep = slot_off
+        # first slot of each variable, by ORIGINAL variable id
+        base_sorted = np.concatenate([
+            off + np.arange(nv, dtype=np.int64) * k
+            for off, _voff, nv, k in kbuckets]) if kbuckets else \
+            np.zeros(0, dtype=np.int64)
+        slot_base = np.empty(V, dtype=np.int64)
+        slot_base[var_order] = base_sorted
+        # edges grouped by variable; each edge's rank within its group
+        order = np.argsort(edge_var, kind="stable")
+        run_start = np.concatenate([[0], np.cumsum(deg)[:-1]])
+        rank = np.arange(E, dtype=np.int64) - np.repeat(run_start, deg)
+        slot_edge = np.full(ep, -1, dtype=np.int64)
+        slot_edge[slot_base[edge_var[order]] + rank] = order
         valid = slot_edge >= 0
 
         slot_of_edge = np.empty(E, dtype=np.int64)

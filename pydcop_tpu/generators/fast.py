@@ -182,3 +182,26 @@ def ising_factor_arrays(rows: int, cols: int, seed: int = 0,
         edge_var=edge_var, edge_factor=edge_factor,
         buckets=[bucket],
     )
+
+
+def clique_dcop_yaml(n_vars: int, domain: int, modulo: int = 11) -> str:
+    """YAML for a dense ``n_vars``-clique with deterministic mixed
+    costs — the wide-separator DPOP stress shape (every pseudo-tree
+    separator is full-width).  Used by the multichip dryrun and the
+    sharded-UTIL bench so both exercise the same instance family."""
+    import itertools
+
+    lines = [f"name: clique{n_vars}", "objective: min", "domains:",
+             "  d: {values: ["
+             + ", ".join(str(i) for i in range(domain)) + "]}",
+             "variables:"]
+    for i in range(n_vars):
+        lines.append(f"  v{i}: {{domain: d}}")
+    lines.append("constraints:")
+    for i, j in itertools.combinations(range(n_vars), 2):
+        lines.append(f"  c{i}_{j}: {{type: intention, function: "
+                     f"(v{i} * 3 + v{j} * 5 + {(i + j) % 7}) "
+                     f"% {modulo}}}")
+    lines.append("agents: ["
+                 + ", ".join(f"a{i}" for i in range(n_vars)) + "]")
+    return "\n".join(lines)
