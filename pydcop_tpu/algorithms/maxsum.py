@@ -651,6 +651,41 @@ class MaxSumLaneSolver(MaxSumSolver):
                              belief=belief)
 
 
+def degree_slot_layout(deg):
+    """The fused layouts' shared variable bucketing: given per-variable
+    slot demands ``deg``, bucket variables by the next power of two and
+    lay out per-variable slot blocks.  Returns (var_order, var_pos,
+    kbuckets, slot_base, n_slots) — ONE implementation so the
+    single-chip and mesh fused solvers can never drift apart (their
+    exact-equality contract depends on identical layouts)."""
+    import numpy as np
+
+    v = len(deg)
+    kof = np.where(
+        deg <= 1, 1,
+        2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
+    ks = sorted(set(int(k) for k in kof))
+    var_order = np.concatenate(
+        [np.where(kof == k)[0] for k in ks]).astype(np.int64) \
+        if v else np.zeros(0, np.int64)
+    var_pos = np.empty(v, dtype=np.int64)
+    var_pos[var_order] = np.arange(v)
+    kbuckets = []          # (slot_off, var_off, n_vars, K)
+    slot_off = var_off = 0
+    for k in ks:
+        nv = int((kof == k).sum())
+        kbuckets.append((slot_off, var_off, nv, k))
+        slot_off += nv * k
+        var_off += nv
+    base_sorted = np.concatenate([
+        off + np.arange(nv, dtype=np.int64) * k
+        for off, _voff, nv, k in kbuckets]) if kbuckets else \
+        np.zeros(0, dtype=np.int64)
+    slot_base = np.empty(v, dtype=np.int64)
+    slot_base[var_order] = base_sorted
+    return var_order, var_pos, kbuckets, slot_base, slot_off
+
+
 class MaxSumFusedSolver(MaxSumLaneSolver):
     """Var-sorted, degree-bucketed ``(D, E')`` layout: ONE irregular op
     per cycle.
@@ -726,34 +761,12 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             partner[off + rel] = off + (rel ^ 1)
 
         deg = np.bincount(edge_var, minlength=V)
-        kof = np.where(
-            deg <= 1, 1,
-            2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64))
-        ks = sorted(set(int(k) for k in kof))
-        var_order = np.concatenate(
-            [np.where(kof == k)[0] for k in ks]).astype(np.int64)
-        var_pos = np.empty(V, dtype=np.int64)
-        var_pos[var_order] = np.arange(V)
-
+        var_order, var_pos, kbuckets, slot_base, ep = \
+            degree_slot_layout(deg)
         # slot table: per sorted variable, its incident edges then -1
         # padding up to its bucket's K — fully vectorized (no Python
-        # loop over edges: million-edge instances build in milliseconds)
-        kbuckets = []          # (slot_off, var_off, n_vars, K)
-        slot_off = var_off = 0
-        for k in ks:
-            nv = int((kof == k).sum())
-            kbuckets.append((slot_off, var_off, nv, k))
-            slot_off += nv * k
-            var_off += nv
-        ep = slot_off
-        # first slot of each variable, by ORIGINAL variable id
-        base_sorted = np.concatenate([
-            off + np.arange(nv, dtype=np.int64) * k
-            for off, _voff, nv, k in kbuckets]) if kbuckets else \
-            np.zeros(0, dtype=np.int64)
-        slot_base = np.empty(V, dtype=np.int64)
-        slot_base[var_order] = base_sorted
-        # edges grouped by variable; each edge's rank within its group
+        # loop over edges: million-edge instances build in milliseconds);
+        # edges grouped by variable, each edge's rank within its group
         order = np.argsort(edge_var, kind="stable")
         run_start = np.concatenate([[0], np.cumsum(deg)[:-1]])
         rank = np.arange(E, dtype=np.int64) - np.repeat(run_start, deg)

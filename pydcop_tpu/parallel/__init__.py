@@ -44,9 +44,22 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 
     if algo in ("maxsum", "amaxsum"):
         arrays = FactorGraphArrays.build(dcop)
-        from .sharded_maxsum import ShardedAMaxSum, ShardedMaxSum
+        from .sharded_maxsum import (ShardedAMaxSum, ShardedFusedMaxSum,
+                                     ShardedMaxSum)
 
-        cls = ShardedAMaxSum if algo == "amaxsum" else ShardedMaxSum
+        layout = params.pop("layout", None)
+        if algo == "amaxsum":
+            cls = ShardedAMaxSum
+        elif layout == "fused":
+            # the fused var-sorted layout has its own mesh class (one
+            # local gather + one psum per cycle)
+            cls = ShardedFusedMaxSum
+        else:
+            cls = ShardedMaxSum
+        if layout is not None and layout != "fused":
+            # pass every other value through so ShardedMaxSum keeps
+            # honoring explicit layouts and loudly rejecting bad ones
+            params["layout"] = layout
         solver = cls(arrays, mesh, batch=batch, **params)
         sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo == "dsa":

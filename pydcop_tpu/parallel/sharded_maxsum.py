@@ -105,18 +105,18 @@ class ShardedMaxSum:
     #: last completed run() (False before/without a completed run)
     finished = False
 
-    def __init__(self, arrays: FactorGraphArrays, mesh,
-                 damping: float = 0.5, damping_nodes: str = "vars",
-                 stability: float = 0.1, noise: float = 0.0,
-                 layout: str = "auto", batch: int = 1,
-                 use_pallas: Optional[bool] = None):
+    def _init_params(self, arrays, mesh, damping, damping_nodes,
+                     stability, noise, batch):
+        """The parameter block every mesh layout shares — ONE copy of
+        the damping-invariant convergence-threshold rule
+        (algorithms/maxsum.py:64-70) and the batch/dp check, so the
+        fused mesh class can never diverge from the lane mesh on
+        convergence semantics."""
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
         self.damping = float(damping)
         self.damping_nodes = damping_nodes
-        # damping-invariant convergence threshold, same rule as the
-        # single-chip solver (algorithms/maxsum.py:64-70)
         self.stability = float(stability)
         if damping_nodes in ("vars", "both") and 0 < damping < 1:
             self.stability *= (1 - float(damping))
@@ -128,15 +128,22 @@ class ShardedMaxSum:
                 f"batch {batch} must be a multiple of dp={self.dp}")
         self.B = batch
 
+    def __init__(self, arrays: FactorGraphArrays, mesh,
+                 damping: float = 0.5, damping_nodes: str = "vars",
+                 stability: float = 0.1, noise: float = 0.0,
+                 layout: str = "auto", batch: int = 1,
+                 use_pallas: Optional[bool] = None):
+        self._init_params(arrays, mesh, damping, damping_nodes,
+                          stability, noise, batch)
+
         # validate BEFORE the host-side factor partition: a bad layout
         # must fail fast, not after padding every bucket across shards
         if layout not in ("auto", "edge_major", "lane_major"):
             raise ValueError(
-                f"sharded maxsum supports layouts auto/edge_major/"
+                f"ShardedMaxSum supports layouts auto/edge_major/"
                 f"lane_major, not {layout!r} (the fused var-sorted "
-                f"layout is single-chip only: its per-shard degree "
-                f"bucketing would be shape-heterogeneous across "
-                f"shards)")
+                f"layout lives in ShardedFusedMaxSum; solve_sharded "
+                f"dispatches -p layout:fused there)")
         shard_buckets, edge_var, e_loc = _partition(arrays, self.tp)
         self.E_loc = e_loc
         self.buckets = shard_buckets
@@ -341,6 +348,19 @@ class ShardedMaxSum:
 
     # -------------------------------------------------------------- run
 
+    def _step_args(self, consts):
+        """The constant tail of a ``_step`` call — layout subclasses
+        carry different constants through the same run loop."""
+        return (consts["edge_var"], consts["cubes"],
+                consts["var_costs"], consts["domain_mask"],
+                consts["domain_size"])
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        """Map the step's selection output to ORIGINAL variable order
+        (identity here; the fused layout solves in degree-sorted
+        order)."""
+        return sel_np
+
     def run(self, n_cycles: int, seed: int = 0
             ) -> Tuple[np.ndarray, int]:
         """Run until SAME_COUNT-stable (same convergence rule as the
@@ -350,9 +370,7 @@ class ShardedMaxSum:
         Returns ((B, V) selections, cycles run)."""
         state, consts = self._device_put()
         q, r = state["q"], state["r"]
-        args = (consts["edge_var"], consts["cubes"],
-                consts["var_costs"], consts["domain_mask"],
-                consts["domain_size"])
+        args = self._step_args(consts)
         key = jax.random.PRNGKey(seed)
         prev_sel = None
         same = 0
@@ -376,18 +394,243 @@ class ShardedMaxSum:
             else:
                 same = 0
             prev_sel = sel_h
-        return np.asarray(jax.device_get(sel)), cycle
+        return self._decode_sel(np.asarray(jax.device_get(sel))), cycle
 
     def step_once(self, seed: int = 0):
         """One sharded step (for compile-checking the multi-chip path)."""
         state, consts = self._device_put()
-        args = (consts["edge_var"], consts["cubes"],
-                consts["var_costs"], consts["domain_mask"],
-                consts["domain_size"])
+        args = self._step_args(consts)
         q, r, sel, _delta = self._step(
             state["q"], state["r"], jax.random.PRNGKey(seed), *args)
         jax.block_until_ready(sel)
-        return np.asarray(jax.device_get(sel))
+        return self._decode_sel(np.asarray(jax.device_get(sel)))
+
+
+class ShardedFusedMaxSum(ShardedMaxSum):
+    """The fused var-sorted layout on the (dp, tp) mesh: per shard, ONE
+    irregular op per cycle (the partner gather) plus the belief psum.
+
+    The mesh form of :class:`~pydcop_tpu.algorithms.maxsum.\
+MaxSumFusedSolver`: a factor's two endpoint slots always live on the
+    factor's own shard (factors are partitioned, edges follow), so the
+    partner permutation stays shard-LOCAL; every shard's slot table
+    shares ONE global variable ordering bucketed by the max-over-shards
+    local degree, so shapes are identical across shards and the
+    per-variable partial sums are static reshape+reduce — assembled
+    with a single ``psum`` over tp, exactly where the lane layout psums
+    its scatter partials.  Requires binary factors only, like the
+    single-chip fused solver.
+    """
+
+    def __init__(self, arrays: FactorGraphArrays, mesh,
+                 damping: float = 0.5, damping_nodes: str = "vars",
+                 stability: float = 0.1, noise: float = 0.0,
+                 batch: int = 1):
+        if any(b.arity != 2 for b in arrays.buckets):
+            raise ValueError(
+                "the fused mesh layout needs ONLY binary factors — "
+                "fold unary constraints into variable costs first "
+                "(filter_dcop)")
+        self._init_params(arrays, mesh, damping, damping_nodes,
+                          stability, noise, batch)
+        self.layout = "fused"
+        self.use_pallas = False
+        self._build_fused_shards(arrays)
+        self._build_step()
+
+    # ----------------------------------------------------- host layout
+
+    def _build_fused_shards(self, arrays):
+        V, D, tp = self.V, self.D, self.tp
+        shard_buckets, edge_var, e_loc = _partition(arrays, tp)
+
+        # local canonical partner: within each bucket block, edges
+        # 2i/2i+1 are the factor's two endpoints (same for all shards)
+        partner_local = np.empty(e_loc, dtype=np.int64)
+        for sb in shard_buckets:
+            f = sb.cubes.shape[1]
+            rel = np.arange(2 * f, dtype=np.int64)
+            partner_local[sb.offset + rel] = sb.offset + (rel ^ 1)
+
+        # ONE global variable ordering: bucket by the max-over-shards
+        # local degree, so every shard's slot table has the same shape
+        # — the SAME layout helper as the single-chip fused solver
+        # (their exact-equality contract depends on identical layouts)
+        from ..algorithms.maxsum import degree_slot_layout
+
+        deg_g = np.zeros((tp, V), dtype=np.int64)
+        for g in range(tp):
+            ev = edge_var[g]
+            deg_g[g] = np.bincount(ev[ev < V], minlength=V)
+        var_order, var_pos, kbuckets, slot_base, ep = \
+            degree_slot_layout(deg_g.max(axis=0))
+
+        # per-slot ORIGINAL variable (shared by all shards)
+        slot_var = np.repeat(
+            var_order, np.concatenate(
+                [[k] * nv for _o, _v, nv, k in kbuckets]).astype(
+                    np.int64)) if kbuckets else np.zeros(0, np.int64)
+
+        slot_edge = np.full((tp, ep), -1, dtype=np.int64)
+        partner_slot = np.zeros((tp, ep), dtype=np.int32)
+        cube_slotT = np.zeros((tp, D, D, ep), dtype=np.float32)
+        for g in range(tp):
+            ev = edge_var[g]
+            real = np.where(ev < V)[0]
+            order = real[np.argsort(ev[real], kind="stable")]
+            dg = deg_g[g]
+            run_start = np.concatenate([[0], np.cumsum(dg)[:-1]])
+            rank = np.arange(len(order), dtype=np.int64) - \
+                np.repeat(run_start, dg)
+            slots = slot_base[ev[order]] + rank
+            slot_edge[g, slots] = order
+            slot_of_local = np.full(e_loc, -1, dtype=np.int64)
+            slot_of_local[order] = slots
+            valid_g = slot_edge[g] >= 0
+            partner_slot[g, valid_g] = slot_of_local[
+                partner_local[slot_edge[g, valid_g]]]
+            # oriented cube slices written straight into this shard's
+            # slot table (no dense per-edge temporary): pos 0 receives
+            # over the cube's second axis (transpose), pos 1 over the
+            # first — the same orientation rule as the single-chip
+            # fused solver
+            for sb in shard_buckets:
+                f = sb.cubes.shape[1]
+                # both sides put the advanced (slot) index FIRST:
+                # shapes are (n, D_other, D_self)
+                for pos, axes in ((0, (0, 2, 1)), (1, (0, 1, 2))):
+                    les = sb.offset + 2 * np.arange(f) + pos
+                    ss = slot_of_local[les]
+                    ok = ss >= 0
+                    cube_slotT[g, :, :, ss[ok]] = np.transpose(
+                        sb.cubes[g][ok], axes)
+
+        valid = slot_edge >= 0                       # (TP, EP)
+        emask = (np.asarray(arrays.domain_mask)[slot_var].T[None]
+                 & valid[:, None, :])                # (TP, D, EP)
+        self.EP = ep
+        self._kbuckets = kbuckets
+        self._np = {
+            "partner_slot": partner_slot,
+            "cube_slotT": cube_slotT,
+            "emask": emask,
+            "var_costsT_sorted":
+                np.asarray(arrays.var_costs).T[:, var_order]
+                .astype(np.float32),
+            "domain_maskT_sorted":
+                np.asarray(arrays.domain_mask).T[:, var_order],
+            "slot_dsize": np.maximum(
+                np.asarray(arrays.domain_size)[slot_var], 1)
+                .astype(np.float32),
+            "var_pos": var_pos,
+        }
+
+    # ---------------------------------------------------------- device
+
+    def _device_put(self):
+        mesh, B, tp = self.mesh, self.B, self.tp
+        n = self._np
+        q0 = np.where(n["emask"], 0.0, BIG).astype(np.float32)
+        q0 = np.broadcast_to(q0[None], (B,) + q0.shape).copy()
+        sh = NamedSharding(mesh, P("dp", "tp"))
+        state = {"q": jax.device_put(q0, sh),
+                 "r": jax.device_put(np.zeros_like(q0), sh)}
+        tp_sh = NamedSharding(mesh, P("tp"))
+        rep = NamedSharding(mesh, P())
+        consts = {
+            "partner_slot": jax.device_put(n["partner_slot"], tp_sh),
+            "cube_slotT": jax.device_put(n["cube_slotT"], tp_sh),
+            "emask": jax.device_put(n["emask"], tp_sh),
+            "var_costsT_sorted": jax.device_put(
+                jnp.asarray(n["var_costsT_sorted"]), rep),
+            "domain_maskT_sorted": jax.device_put(
+                jnp.asarray(n["domain_maskT_sorted"]), rep),
+            "slot_dsize": jax.device_put(
+                jnp.asarray(n["slot_dsize"]), rep),
+        }
+        return state, consts
+
+    def _step_args(self, consts):
+        return (consts["partner_slot"], consts["cube_slotT"],
+                consts["emask"], consts["var_costsT_sorted"],
+                consts["domain_maskT_sorted"], consts["slot_dsize"])
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        return sel_np[:, self._np["var_pos"]]
+
+    # ------------------------------------------------------------ step
+
+    def _build_step(self):
+        D, V = self.D, self.V
+        damping, damping_nodes = self.damping, self.damping_nodes
+        noise = self.noise
+        kbuckets = self._kbuckets
+
+        def local_step(q, r, key, partner, cube, emask, vcT, dmT,
+                       dsize):
+            # q, r: (B_loc, D, EP) shard-local var-sorted slots
+            def one(q1, r1, k1):
+                q_part = q1[:, partner]          # the ONE local gather
+                new_r = jnp.min(cube + q_part[:, None, :], axis=0)
+                new_r = jnp.where(emask, new_r, 0.0)
+                if damping_nodes in ("factors", "both") and damping > 0:
+                    new_r = damping * r1 + (1 - damping) * new_r
+                # static per-bucket partial sums -> one psum over tp
+                parts = []
+                for s_off, v_off, nv, k in kbuckets:
+                    parts.append(new_r[:, s_off:s_off + nv * k]
+                                 .reshape(D, nv, k).sum(axis=2))
+                partial = parts[0] if len(parts) == 1 else                     jnp.concatenate(parts, axis=1)       # (D, V)
+                belief = vcT + jax.lax.psum(partial, "tp")
+                blocks = []
+                for s_off, v_off, nv, k in kbuckets:
+                    blk = new_r[:, s_off:s_off + nv * k]                         .reshape(D, nv, k)
+                    blocks.append(
+                        (belief[:, v_off:v_off + nv, None] - blk)
+                        .reshape(D, nv * k))
+                q_new = blocks[0] if len(blocks) == 1 else                     jnp.concatenate(blocks, axis=1)
+                mean = (jnp.sum(jnp.where(emask, q_new, 0.0), axis=0)
+                        / dsize)
+                q_new = q_new - mean[None, :]
+                if noise > 0:
+                    tp_idx = jax.lax.axis_index("tp")
+                    sub = jax.random.fold_in(k1, tp_idx)
+                    q_new = q_new + noise * jax.random.uniform(
+                        sub, q_new.shape)
+                if damping_nodes in ("vars", "both") and damping > 0:
+                    q_new = damping * q1 + (1 - damping) * q_new
+                q_new = jnp.where(emask, q_new, BIG)
+                sel = jnp.argmin(
+                    jnp.where(dmT, belief, BIG * 2), axis=0)
+                if self.EP and self.stability > 0:
+                    delta = jax.lax.pmax(jnp.max(jnp.where(
+                        emask, jnp.abs(q_new - q1), 0.0)), "tp")
+                else:
+                    delta = jnp.float32(0)
+                return q_new, new_r, sel, delta
+
+            # per-instance keys differ across dp shards (parity with
+            # ShardedMaxSum's stream layout)
+            dp_idx = jax.lax.axis_index("dp")
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(key, dp_idx), i))(
+                jnp.arange(q.shape[0]))
+            return jax.vmap(one)(q, r, keys)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
+                      P("tp"), P("tp"), P("tp"), P(), P(), P()),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
+        )
+        def sharded(q, r, key, partner, cube, emask, vcT, dmT, dsize):
+            q2, r2, sel, delta = local_step(
+                q[:, 0], r[:, 0], key, partner[0], cube[0], emask[0],
+                vcT, dmT, dsize)
+            return q2[:, None], r2[:, None], sel, delta
+
+        self._step = jax.jit(sharded)
 
 
 class ShardedAMaxSum(ShardedMaxSum):
@@ -492,8 +735,7 @@ maxsum_dynamic.DynamicMaxSumSolver` (reference maxsum_dynamic.py:40-186):
         if s is None:
             raise RuntimeError("call start() first")
         c = s["consts"]
-        args = (c["edge_var"], c["cubes"], c["var_costs"],
-                c["domain_mask"], c["domain_size"])
+        args = self._step_args(c)
         for _ in range(n):
             s["key"], sub = jax.random.split(s["key"])
             s["q"], s["r"], s["sel"], _delta = self._step(

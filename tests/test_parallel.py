@@ -711,10 +711,74 @@ def test_dryrun_fails_on_broken_psum_hook(monkeypatch):
         g.dryrun_multichip(8)
 
 
-def test_sharded_maxsum_rejects_single_chip_only_layout():
-    """-p layout:fused is valid for the single-chip engine but must be
-    rejected loudly (not silently downgraded) on the mesh."""
+def test_sharded_maxsum_layout_dispatch():
+    """-p layout:fused reaches ShardedFusedMaxSum through solve_sharded;
+    passing it to ShardedMaxSum directly is a loud error, never a
+    silent downgrade."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedFusedMaxSum
+
     arrays = coloring_factor_arrays(10, 15, 3, seed=0)
     mesh = make_mesh(8)
-    with pytest.raises(ValueError, match="single-chip only"):
+    with pytest.raises(ValueError, match="ShardedFusedMaxSum"):
         ShardedMaxSum(arrays, mesh, layout="fused", batch=4)
+    sf = ShardedFusedMaxSum(arrays, mesh, batch=4)
+    sel, _ = sf.run(5)
+    assert sel.shape == (4, 10)
+
+
+def test_sharded_fused_matches_single_chip_and_lane_mesh():
+    """The fused mesh layout (ShardedFusedMaxSum: one shard-local
+    partner gather + one psum per cycle) must reproduce BOTH the
+    single-chip fused solver's selections and the lane-mesh
+    selections exactly, with matching convergence."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumFusedSolver
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedFusedMaxSum
+
+    arrays = coloring_factor_arrays(30, 60, 3, seed=1, noise=0.05)
+    mesh = make_mesh(8)
+    sf = ShardedFusedMaxSum(arrays, mesh, damping=0.5, stability=0.1,
+                            batch=4)
+    sel_f, cyc_f = sf.run(n_cycles=40)
+
+    sm = ShardedMaxSum(arrays, mesh, damping=0.5, stability=0.1,
+                       batch=4)
+    sel_m, cyc_m = sm.run(n_cycles=40)
+    assert np.array_equal(sel_f, sel_m) and cyc_f == cyc_m
+
+    single = MaxSumFusedSolver(arrays, damping=0.5, stability=0.1)
+    res = SyncEngine(single).run(max_cycles=40)
+    sel_s = np.array([res.assignment[n] for n in arrays.var_names])
+    for b in range(4):
+        assert np.array_equal(sel_f[b], sel_s)
+
+
+def test_solve_sharded_fused_layout_param():
+    """`solve_sharded(..., layout="fused")` dispatches the fused mesh
+    class and still solves."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.parallel import solve_sharded
+
+    src = """
+name: gc4
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors, cost_function: 0 if v1 == 'R' else (0.05 if v1
+    == 'G' else 0.1)}
+  v2: {domain: colors, cost_function: 0 if v2 == 'G' else (0.05 if v2
+    == 'R' else 0.1)}
+  v3: {domain: colors, cost_function: 0 if v3 == 'R' else (0.05 if v3
+    == 'G' else 0.1)}
+constraints:
+  c12: {type: intention, function: 10 if v1 == v2 else 0}
+  c23: {type: intention, function: 10 if v2 == v3 else 0}
+agents: [a1, a2, a3]
+"""
+    # strict per-variable preference orders: the unique optimum is
+    # (R, G, R) at cost 0 (symmetric ties decode badly in any max-sum)
+    dcop = load_dcop(src)
+    assignment, cost, _cyc, _fin = solve_sharded(
+        dcop, "maxsum", n_cycles=30, seed=1, layout="fused")
+    assert assignment == {"v1": "R", "v2": "G", "v3": "R"}
+    assert cost == 0
