@@ -563,6 +563,12 @@ def solve_direct(dcop: DCOP, params: Optional[Dict] = None,
             assignment[node.name] = node.variable.domain.values[i]
             if not node.is_root:
                 msg_count += 1
+                # VALUE message = the separator's (variable, value)
+                # pairs: size 2 x |separator| (reference dpop.py:98-108
+                # ValueMessage.size) — with UTIL's prod-of-dims above,
+                # the getting-started 3-var chain reports the reference
+                # tutorial's "4 messages / total size 8"
+                msg_size += 2 * len(fixed)
 
     cost, violations = dcop.solution_cost(assignment)
     return RunResult(
@@ -605,6 +611,9 @@ def _solve_device(dcop, g, var_cost_rel, mode, memory_limit, t0,
                     sizes = [len(g.node(d).variable.domain)
                              for d in dims[:-1]]
                     msg_size += int(np.prod(sizes)) if sizes else 1
+                    # + the VALUE message down: 2 x |separator|
+                    # (host-path parity, reference dpop.py:98-108)
+                    msg_size += 2 * len(dims[:-1])
                 continue
             arr = host_joined[node.name]
             idx = tuple(
@@ -618,6 +627,7 @@ def _solve_device(dcop, g, var_cost_rel, mode, memory_limit, t0,
                 # one UTIL message up + one VALUE message down per node
                 msg_count += 2
                 msg_size += int(np.prod(arr.shape[:-1]))
+                msg_size += 2 * (len(dims) - 1)
     cost, violations = dcop.solution_cost(assignment)
     return RunResult(
         assignment=assignment,

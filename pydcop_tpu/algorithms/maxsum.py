@@ -52,9 +52,12 @@ algo_params = [
     AlgoParameterDef("noise", "float", None, 0.0),
     AlgoParameterDef("stop_cycle", "int", None, 0),
     # lane_major puts edges in the 128-wide lane dim + uses the fused
-    # pallas factor kernel on TPU; fused additionally var-sorts the
-    # edge slots so the whole cycle has ONE irregular op (binary
-    # factors only); auto picks lane_major when the graph allows
+    # pallas factor kernels on TPU (binary and small-n-ary buckets);
+    # fused additionally var-sorts the edge slots so the cycle's only
+    # irregular ops are static permutation gathers (one for binary-only
+    # graphs; one per (arity, position) bucket + one assembly gather
+    # for n-ary, zero scatters either way); auto picks lane_major when
+    # every bucket's D**arity fits the fast-path threshold
     AlgoParameterDef("layout", "str",
                      ["auto", "edge_major", "lane_major", "fused"],
                      "auto"),
@@ -182,25 +185,9 @@ class MaxSumSolver(ArraySolver):
 
     @staticmethod
     def _detect_canonical(arrays):
-        import numpy as np
+        from ..graphs.arrays import canonical_edge_layout
 
-        offset = 0
-        layout = []
-        for b in arrays.buckets:
-            arity = b.cubes.ndim - 1
-            if arity == 0:
-                layout.append(None)
-                continue
-            f = b.edge_ids.shape[0]
-            expected = offset + np.arange(f * arity, dtype=np.int64) \
-                .reshape(f, arity)
-            if not np.array_equal(np.asarray(b.edge_ids), expected):
-                return None
-            layout.append((offset, f, arity))
-            offset += f * arity
-        if offset != arrays.n_edges:
-            return None
-        return layout
+        return canonical_edge_layout(arrays)
 
     def init_state(self, key):
         edge_mask = self.domain_mask[self.edge_var]
@@ -497,22 +484,32 @@ class MaxSumLaneSolver(MaxSumSolver):
     lane dimension instead of the tiny domain axis (which pads to 128
     lanes in edge-major layout and wastes ~|D|/128 of every tile).
 
-    Requires the canonical factor-major edge layout and arity <= 2
-    buckets; ``build_solver`` falls back to :class:`MaxSumSolver`
-    otherwise.  On TPU the binary-factor update runs as one fused pallas
-    kernel (``ops/pallas_kernels.py``); elsewhere a jnp fallback keeps
-    results identical.  Same message semantics and convergence rules as
-    the base solver (messages equal up to float assoc).
+    Requires the canonical factor-major edge layout with every bucket's
+    per-factor hypercube small enough to unroll
+    (``D**arity <= NARY_FAST_MAX_CELLS``); ``build_solver`` falls back
+    to :class:`MaxSumSolver` — the generic XLA path, kept as the
+    correctness oracle — otherwise.  The factor update dispatches per
+    arity bucket: on TPU binary and n-ary buckets each run as one fused
+    pallas kernel (``ops/pallas_kernels.py``); elsewhere jnp fallbacks
+    keep results identical.  Same message semantics and convergence
+    rules as the base solver (messages equal up to float assoc).
     """
 
     @staticmethod
     def eligible(arrays: FactorGraphArrays) -> bool:
         """True when the graph supports lane-major layout: canonical
-        factor-major edges and arity <= 2 buckets only."""
+        factor-major edges, every bucket's hypercube under the
+        fast-path unroll threshold."""
+        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+
         layout = MaxSumSolver._detect_canonical(arrays)
         if layout is None:
             return False
-        return all(spec is None or spec[2] <= 2 for spec in layout)
+        D = arrays.max_domain
+        return all(
+            spec is None or spec[2] <= 2
+            or D ** spec[2] <= NARY_FAST_MAX_CELLS
+            for spec in layout)
 
     def __init__(self, arrays: FactorGraphArrays, use_pallas=None,
                  **kwargs):
@@ -520,7 +517,10 @@ class MaxSumLaneSolver(MaxSumSolver):
         if not self.eligible(arrays):
             raise ValueError(
                 "lane-major layout needs the canonical factor-major "
-                "edge layout and arity <= 2 buckets")
+                "edge layout (build arrays with arity_sorted=True) and "
+                "per-factor hypercubes small enough to unroll "
+                "(D**arity <= NARY_FAST_MAX_CELLS) — use the generic "
+                "edge_major layout for bigger factors")
         if use_pallas is None:
             # measured on-chip: the fused pallas kernel beats the jnp
             # factor update in isolation (0.81 vs 1.50 ms) but blocks
@@ -529,6 +529,9 @@ class MaxSumLaneSolver(MaxSumSolver):
             # keep the kernel opt-in for larger domains/other chips
             use_pallas = False
         self.use_pallas = bool(use_pallas)
+        # off-TPU the kernels run in pallas interpret mode so the
+        # opt-in path stays testable on CPU (mirrors ShardedMaxSum)
+        self._pallas_interpret = jax.default_backend() != "tpu"
 
     # transposed device constants, lazy like the base class's
     @property
@@ -549,19 +552,12 @@ class MaxSumLaneSolver(MaxSumSolver):
 
     @property
     def bucketsT(self):
-        import numpy as np
-
         def build():
-            out = []
-            for b, spec in zip(self.arrays.buckets, self._canonical):
-                if spec is None:
-                    out.append(None)
-                    continue
-                _, f, arity = spec
-                c = np.asarray(b.cubes)
-                out.append(jnp.asarray(
-                    c.T if arity == 1 else np.transpose(c, (1, 2, 0))))
-            return out
+            return [
+                None if spec is None
+                else jnp.asarray(b.cubes_lane_major())
+                for b, spec in zip(self.arrays.buckets, self._canonical)
+            ]
 
         return self._dev("bucketsT", build)
 
@@ -591,11 +587,17 @@ class MaxSumLaneSolver(MaxSumSolver):
             .at[:, self.edge_var].add(s["r"])
         return self._select(self.var_costsT + sum_r)
 
-    def _factor_update(self, q):
-        from ..ops.pallas_kernels import (
-            factor_messages_binary_lane_major,
-            factor_messages_binary_lane_major_ref)
+    def _bucket_messages(self, cubesT, q_in, arity):
+        """One arity bucket's outgoing messages, lane-major — the
+        shared per-bucket kernel dispatch (pallas kernels opt-in, jnp
+        fallbacks by default)."""
+        from ..ops.pallas_kernels import factor_messages_lane_major
 
+        return factor_messages_lane_major(
+            cubesT, q_in, arity, use_pallas=self.use_pallas,
+            interpret=self._pallas_interpret)
+
+    def _factor_update(self, q):
         blocks = []
         for cubesT, spec in zip(self.bucketsT, self._canonical):
             if spec is None:
@@ -604,15 +606,11 @@ class MaxSumLaneSolver(MaxSumSolver):
             if arity == 1:
                 blocks.append(cubesT)  # unary msg = the cost row
                 continue
-            q_blk = q[:, offset:offset + 2 * f]
-            q0, q1 = q_blk[:, 0::2], q_blk[:, 1::2]
-            if self.use_pallas:
-                m0, m1 = factor_messages_binary_lane_major(cubesT, q0, q1)
-            else:
-                m0, m1 = factor_messages_binary_lane_major_ref(
-                    cubesT, q0, q1)
-            blocks.append(jnp.stack([m0, m1], axis=2)
-                          .reshape(self.D, 2 * f))
+            q_blk = q[:, offset:offset + arity * f]
+            q_in = [q_blk[:, p::arity] for p in range(arity)]
+            msgs = self._bucket_messages(cubesT, q_in, arity)
+            blocks.append(jnp.stack(msgs, axis=2)
+                          .reshape(self.D, arity * f))
         if not blocks:
             return jnp.zeros((self.D, self.E))
         if len(blocks) == 1:
@@ -714,18 +712,39 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     lanes.  Semantics are identical to :class:`MaxSumLaneSolver` up to
     float association (exact-selection equality is asserted in tests).
 
-    Requires the canonical factor-major edge layout with ONLY binary
-    factors (fold unary constraints into variable costs via
+    N-ary graphs (the PEAV/SECP workload shapes) use arity-bucketed
+    slot tables instead of the single slot-aligned cube: per (arity,
+    position) bucket ONE static gather pulls that position's incoming
+    messages out of slot space, the bucket's lane-major hypercube
+    sweep produces all its outgoing messages (same per-bucket dispatch
+    as the lane solver), and ONE static assembly permutation lays the
+    canonical-edge-ordered results back into slots — so a mixed-arity
+    cycle carries one gather per (arity, position) bucket plus the
+    assembly gather, and ZERO scatters.  Binary-only graphs keep the
+    single-partner-gather form above.
+
+    Requires the canonical factor-major edge layout with factor
+    arities >= 2 (fold unary constraints into variable costs via
     ``filter_dcop`` first — the fast generators already emit this
-    form).
+    form) and per-factor hypercubes under the unroll threshold
+    (``D**arity <= NARY_FAST_MAX_CELLS``).
     """
 
     @staticmethod
     def eligible(arrays: FactorGraphArrays) -> bool:
+        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+
         layout = MaxSumSolver._detect_canonical(arrays)
         if layout is None or arrays.n_edges == 0:
             return False
-        return all(spec is None or spec[2] == 2 for spec in layout)
+        D = arrays.max_domain
+        # binary buckets are unconditional (the slot-aligned path does
+        # no hypercube unroll — any domain size); the cell gate bounds
+        # only the n-ary lane-major sweep
+        return all(
+            spec is None or spec[2] == 2 or (
+                spec[2] > 2 and D ** spec[2] <= NARY_FAST_MAX_CELLS)
+            for spec in layout)
 
     def __init__(self, arrays: FactorGraphArrays, **kwargs):
         if not MaxSumFusedSolver.eligible(arrays):
@@ -734,8 +753,11 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             # folding unary constraints into variable costs
             raise ValueError(
                 "fused layout needs the canonical factor-major edge "
-                "layout and ONLY binary factors — fold unary "
-                "constraints into variable costs first (filter_dcop)")
+                "layout (arity_sorted=True arrays), factor arities "
+                ">= 2 — fold unary constraints into variable costs "
+                "first (filter_dcop) — and arity >= 3 hypercubes "
+                "under the unroll threshold "
+                "(D**arity <= NARY_FAST_MAX_CELLS)")
         kwargs.pop("use_pallas", None)  # no hand kernel on this path:
         # the whole point is letting XLA fuse the single-gather chain
         super().__init__(arrays, use_pallas=False, **kwargs)
@@ -749,16 +771,6 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
         arrays = self.arrays
         E, V = arrays.n_edges, self.V
         edge_var = np.asarray(arrays.edge_var)
-
-        # canonical partner: edges 2i / 2i+1 of a binary bucket are the
-        # two endpoints of factor i
-        partner = np.empty(E, dtype=np.int64)
-        for spec in self._canonical:
-            if spec is None:
-                continue
-            off, f, _arity = spec
-            rel = np.arange(2 * f, dtype=np.int64)
-            partner[off + rel] = off + (rel ^ 1)
 
         deg = np.bincount(edge_var, minlength=V)
         var_order, var_pos, kbuckets, slot_base, ep = \
@@ -776,12 +788,56 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
 
         slot_of_edge = np.empty(E, dtype=np.int64)
         slot_of_edge[slot_edge[valid]] = np.where(valid)[0]
+        slot_var_sorted = np.repeat(
+            np.arange(V), np.concatenate(
+                [[k] * nv for _off, _voff, nv, k in kbuckets]
+                if kbuckets else [[]]).astype(np.int64))
+
+        self._kbuckets = kbuckets
+        self._np_fused = {
+            "var_order": var_order,
+            "var_pos": var_pos,
+            "valid": valid,
+            "slot_var_sorted": slot_var_sorted,
+        }
+        self.EP = ep
+
+        D = self.D
+        self._all_binary = all(
+            spec is None or spec[2] == 2 for spec in self._canonical)
+        if not self._all_binary:
+            # arity-bucketed slot tables: per (arity, position) bucket,
+            # the var-sorted slots of that position's edges (ONE static
+            # gather each pulls its incoming messages out of slot
+            # space); results come back in canonical edge order, so the
+            # assembly map is just slot -> edge id (E = the appended
+            # zeros column for padding slots).  Zero scatters.
+            self._np_fused["pos_slots"] = [
+                None if spec is None else
+                slot_of_edge[spec[0] + np.arange(spec[1] * spec[2])
+                             .reshape(spec[1], spec[2])].T
+                .astype(np.int32).copy()
+                for spec in self._canonical
+            ]
+            self._np_fused["slot_src"] = np.where(
+                valid, slot_edge, E).astype(np.int32)
+            return
+
+        # binary-only: the single slot-aligned table — canonical
+        # partner: edges 2i / 2i+1 of a binary bucket are the two
+        # endpoints of factor i
+        partner = np.empty(E, dtype=np.int64)
+        for spec in self._canonical:
+            if spec is None:
+                continue
+            off, f, _arity = spec
+            rel = np.arange(2 * f, dtype=np.int64)
+            partner[off + rel] = off + (rel ^ 1)
         partner_slot = np.zeros(ep, dtype=np.int32)
         partner_slot[valid] = slot_of_edge[partner[slot_edge[valid]]]
 
         # oriented per-slot cube slice: new_r[ds, s] =
         #   min_do cube_slotT[do, ds, s] + q_partner[do, s]
-        D = self.D
         cube_slotT = np.zeros((D, D, ep), dtype=np.float32)
         for spec, b in zip(self._canonical, arrays.buckets):
             if spec is None:
@@ -796,21 +852,8 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
                 sl = np.transpose(cubes, (2, 1, 0)) if pos == 0 \
                     else np.transpose(cubes, (1, 2, 0))
                 cube_slotT[:, :, ss] = sl
-        slot_var_sorted = np.repeat(
-            np.arange(V), np.concatenate(
-                [[k] * nv for _off, _voff, nv, k in kbuckets]
-                if kbuckets else [[]]).astype(np.int64))
-
-        self._kbuckets = kbuckets
-        self._np_fused = {
-            "partner_slot": partner_slot,
-            "cube_slotT": cube_slotT,
-            "var_order": var_order,
-            "var_pos": var_pos,
-            "valid": valid,
-            "slot_var_sorted": slot_var_sorted,
-        }
-        self.EP = ep
+        self._np_fused["partner_slot"] = partner_slot
+        self._np_fused["cube_slotT"] = cube_slotT
 
     # ---------------------------------------------- device constants
 
@@ -823,6 +866,18 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     def cube_slotT(self):
         return self._dev("cube_slotT", lambda: jnp.asarray(
             self._np_fused["cube_slotT"]))
+
+    @property
+    def pos_slots(self):
+        return self._dev("pos_slots", lambda: [
+            None if ps is None else jnp.asarray(ps)
+            for ps in self._np_fused["pos_slots"]
+        ])
+
+    @property
+    def slot_src(self):
+        return self._dev("slot_src", lambda: jnp.asarray(
+            self._np_fused["slot_src"]))
 
     @property
     def var_costsT_sorted(self):
@@ -902,11 +957,36 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             jnp.concatenate(q_parts, axis=1)
         return belief, q_new
 
+    def _factor_update_slots(self, q):
+        """N-ary factor update in slot space: one static gather per
+        (arity, position) bucket (that position's incoming messages),
+        the shared per-bucket lane-major kernel dispatch, and one
+        static assembly permutation from canonical edge order back to
+        slots.  Zero scatters."""
+        blocks = []
+        for cubesT, ps, spec in zip(self.bucketsT, self.pos_slots,
+                                    self._canonical):
+            if spec is None:
+                continue
+            _off, f, arity = spec
+            q_in = [q[:, ps[p]] for p in range(arity)]
+            msgs = self._bucket_messages(cubesT, q_in, arity)
+            blocks.append(jnp.stack(msgs, axis=2)
+                          .reshape(self.D, arity * f))
+        msgs_all = blocks[0] if len(blocks) == 1 else \
+            jnp.concatenate(blocks, axis=1)
+        msgs_all = jnp.concatenate(
+            [msgs_all, jnp.zeros((self.D, 1), msgs_all.dtype)], axis=1)
+        return msgs_all[:, self.slot_src]
+
     def step(self, s):
         q, r = s["q"], s["r"]
-        # the cycle's ONE irregular op: partner permutation
-        q_part = q[:, self.partner_slot]
-        new_r = jnp.min(self.cube_slotT + q_part[:, None, :], axis=0)
+        if self._all_binary:
+            # the cycle's ONE irregular op: partner permutation
+            q_part = q[:, self.partner_slot]
+            new_r = jnp.min(self.cube_slotT + q_part[:, None, :], axis=0)
+        else:
+            new_r = self._factor_update_slots(q)
         new_r = jnp.where(self.emaskT_fused, new_r, 0.0)
         if self.damping_nodes in ("factors", "both") and self.damping > 0:
             new_r = self.damping * r + (1 - self.damping) * new_r
@@ -947,7 +1027,15 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
                  variables=None, constraints=None) -> MaxSumSolver:
     params = dict(params) if params else {}
     layout = params.pop("layout", "auto")
-    arrays = FactorGraphArrays.build(dcop, variables, constraints)
+    # the fast layouts need the canonical factor-major edge layout;
+    # arity-sorting the constraints produces it for ANY model (mixed
+    # arities included), so n-ary PEAV/SECP instances reach the fast
+    # paths instead of silently degrading to gather/scatter.  Explicit
+    # edge_major keeps the model's own order — the untouched generic
+    # oracle.
+    arrays = FactorGraphArrays.build(
+        dcop, variables, constraints,
+        arity_sorted=layout != "edge_major")
     if layout == "fused":
         return MaxSumFusedSolver(arrays, **params)
     if layout == "lane_major" or (

@@ -54,8 +54,11 @@ def set_parser(subparsers):
                              "row to (reference: solve.py:162)")
     parser.add_argument("-i", "--infinity", type=float,
                         default=float("inf"),
-                        help="threshold at or above which a constraint "
-                             "counts as a hard violation; violations "
+                        help="threshold AT OR ABOVE which a constraint "
+                             "cost counts as a hard violation, either "
+                             "sign (|cost| >= infinity; stricter than "
+                             "the reference's ==infinity test — see "
+                             "docs/analysing_results.md); violations "
                              "are counted separately and excluded from "
                              "the (always finite) reported cost "
                              "(reference: solve.py:316-323 + "

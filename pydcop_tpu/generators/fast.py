@@ -184,6 +184,72 @@ def ising_factor_arrays(rows: int, cols: int, seed: int = 0,
     )
 
 
+def nary_factor_arrays(n_vars: int, factor_counts, n_values: int = 3,
+                       seed: int = 0, noise: float = 0.05
+                       ) -> FactorGraphArrays:
+    """Random mixed-arity factor graph in the canonical factor-major
+    layout, arrays only — the PEAV/SECP workload *shape* (n-ary cost
+    hypercubes over a shared variable pool) without the host object
+    model, for fast-path tests and benchmarks at scale.
+
+    ``factor_counts``: ``{arity: count}`` — e.g. ``{2: 300, 3: 100}``.
+    Buckets are emitted in ascending arity with globally sequential
+    edge ids (the canonical layout ``canonical_edge_layout`` detects);
+    scopes are distinct random variables, tables uniform(0, 1), unary
+    costs uniform(0, noise) breaking belief ties.
+    """
+    rng = np.random.default_rng(seed)
+    D, V = n_values, n_vars
+    buckets = []
+    edge_var_parts = []
+    edge_factor_parts = []
+    offset = 0
+    factor_id = 0
+    factor_names = []
+    for arity in sorted(factor_counts):
+        count = factor_counts[arity]
+        if count == 0:
+            continue
+        if arity > n_vars:
+            raise ValueError(
+                f"arity {arity} needs at least that many variables, "
+                f"got {n_vars}")
+        # distinct variables per scope: argsort of a random matrix is a
+        # batch of random permutations; take the first `arity` columns
+        scopes = np.argsort(
+            rng.random((count, n_vars)), axis=1)[:, :arity] \
+            .astype(np.int32)
+        cubes = rng.uniform(
+            0, 1, size=(count,) + (D,) * arity).astype(np.float32)
+        edge_ids = (offset + np.arange(count * arity)
+                    .reshape(count, arity)).astype(np.int32)
+        buckets.append(FactorBucket(
+            arity, np.arange(factor_id, factor_id + count,
+                             dtype=np.int32),
+            cubes, edge_ids, scopes))
+        edge_var_parts.append(scopes.reshape(-1))
+        edge_factor_parts.append(np.repeat(
+            np.arange(factor_id, factor_id + count), arity))
+        factor_names += [f"c{factor_id + i}" for i in range(count)]
+        offset += count * arity
+        factor_id += count
+    edge_var = (np.concatenate(edge_var_parts) if edge_var_parts
+                else np.zeros(0)).astype(np.int32)
+    edge_factor = (np.concatenate(edge_factor_parts)
+                   if edge_factor_parts else np.zeros(0)) \
+        .astype(np.int32)
+    return FactorGraphArrays(
+        n_vars=V, n_factors=factor_id, n_edges=offset, max_domain=D,
+        sign=1.0, var_names=[f"v{i}" for i in range(V)],
+        factor_names=factor_names,
+        domain_size=np.full(V, D, dtype=np.int32),
+        domain_mask=np.ones((V, D), dtype=bool),
+        var_costs=rng.uniform(0, noise, size=(V, D)).astype(np.float32),
+        edge_var=edge_var, edge_factor=edge_factor,
+        buckets=buckets,
+    )
+
+
 def clique_dcop_yaml(n_vars: int, domain: int, modulo: int = 11) -> str:
     """YAML for a dense ``n_vars``-clique with deterministic mixed
     costs — the wide-separator DPOP stress shape (every pseudo-tree

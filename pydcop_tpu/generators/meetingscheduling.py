@@ -21,7 +21,13 @@ def generate_meetings(slots_count: int = 5, events_count: int = 4,
                       resources_count: int = 3,
                       max_resources_event: int = 2,
                       max_value: int = 10,
-                      seed: Optional[int] = None) -> DCOP:
+                      seed: Optional[int] = None,
+                      nary_equalities: bool = False) -> DCOP:
+    """``nary_equalities=True`` emits ONE k-ary all-equal constraint
+    per event (arity = the event's resource count) instead of the
+    reference's pairwise chain — the same feasible set and optimum,
+    but the factor graph carries genuine n-ary factors, the workload
+    shape the n-ary fast path targets."""
     if seed is not None:
         random.seed(seed)
     slots = list(range(1, slots_count + 1))
@@ -46,9 +52,15 @@ def generate_meetings(slots_count: int = 5, events_count: int = 4,
             dcop.add_constraint(UnaryFunctionRelation(
                 f"value_{v.name}", v, lambda s, _v=value: _v[s]))
 
-    # intra-event equality: all participants pick the same slot
+    # intra-event equality: all participants pick the same slot —
+    # pairwise chain (reference form) or one k-ary all-equal factor
     for e, resources in events.items():
         vs = [variables[(e, r)] for r in resources]
+        if nary_equalities and len(vs) >= 2:
+            dcop.add_constraint(NAryFunctionRelation(
+                lambda *slots: 0 if len(set(slots)) == 1 else -10000,
+                vs, name=f"eq_e{e}"))
+            continue
         for i in range(len(vs) - 1):
             v1, v2 = vs[i], vs[i + 1]
             dcop.add_constraint(NAryFunctionRelation(

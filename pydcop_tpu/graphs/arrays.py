@@ -75,6 +75,45 @@ class FactorBucket:
     edge_ids: np.ndarray            # (Fa, arity) edge index per position
     var_ids: np.ndarray             # (Fa, arity) variable index per position
 
+    def cubes_lane_major(self) -> np.ndarray:
+        """The lane-major view of the stacked hypercubes: factor axis
+        LAST (``(D, ..., D, Fa)``), so factors ride the 128-wide lane
+        dimension and the small domain axes live in sublanes — the
+        layout the fused factor kernels consume
+        (``ops/pallas_kernels.py``)."""
+        return np.moveaxis(self.cubes, 0, -1)
+
+
+def canonical_edge_layout(arrays: "FactorGraphArrays"):
+    """Per-bucket ``(edge_offset, n_factors, arity)`` specs when the
+    edge layout is canonical factor-major — bucket blocks are
+    contiguous and edges ``a*i .. a*i+arity-1`` of a block are factor
+    ``i``'s positions in order — else ``None``.
+
+    Canonical layout turns every per-bucket edge gather/scatter of the
+    message-passing cycle into a static slice + reshape; the fast
+    generators emit it directly and :meth:`FactorGraphArrays.build`
+    produces it for any model when given ``arity_sorted=True``.
+    Arity-0 buckets (constants) get a ``None`` spec entry.
+    """
+    offset = 0
+    layout = []
+    for b in arrays.buckets:
+        arity = b.cubes.ndim - 1
+        if arity == 0:
+            layout.append(None)
+            continue
+        f = b.edge_ids.shape[0]
+        expected = offset + np.arange(f * arity, dtype=np.int64) \
+            .reshape(f, arity)
+        if not np.array_equal(np.asarray(b.edge_ids), expected):
+            return None
+        layout.append((offset, f, arity))
+        offset += f * arity
+    if offset != arrays.n_edges:
+        return None
+    return layout
+
 
 @dataclass
 class FactorGraphArrays:
@@ -96,12 +135,19 @@ class FactorGraphArrays:
 
     @classmethod
     def build(cls, dcop: DCOP,
-              variables=None, constraints=None) -> "FactorGraphArrays":
+              variables=None, constraints=None,
+              arity_sorted: bool = False) -> "FactorGraphArrays":
         if variables is None:
             variables = list(dcop.variables.values())
         if constraints is None:
             constraints = list(dcop.constraints.values())
         constraints = _bind_externals(dcop, constraints)
+        if arity_sorted:
+            # stable arity sort makes every bucket's edge block
+            # contiguous, i.e. the canonical factor-major layout the
+            # lane/fused solvers need (see canonical_edge_layout) —
+            # for ANY model, not just single-arity generator output
+            constraints = sorted(constraints, key=lambda c: c.arity)
         sign = 1.0 if dcop.objective == "min" else -1.0
 
         var_names = [v.name for v in variables]

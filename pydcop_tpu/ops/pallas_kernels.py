@@ -1,32 +1,44 @@
 """Pallas TPU kernels for the hot ops.
 
-The MaxSum binary-factor update is the framework's hottest op (one per
-cycle over every factor).  In lane-major layout — factors in the
-128-wide lane dimension, the small domain axis in sublanes — both
+The MaxSum factor update is the framework's hottest op (one per cycle
+over every factor).  In lane-major layout — factors in the 128-wide
+lane dimension, the small domain axes in sublanes — ALL of a factor's
 outgoing min-marginal messages fuse into ONE kernel: per-cycle cost on
 the benched chip is dominated by the number of separate kernels, not
 FLOPs (see benchmarks/PERF_NOTES.md), so fusing the broadcast-add +
-two axis-mins + subtraction chain into a single pallas_call removes
+axis-mins + subtraction chain into a single pallas_call removes
 several kernel launches per cycle.
 
-Layout contract (lane-major):
-  cubesT: (D, D, F)   cost tables, factor axis last (lanes)
-  q0,q1:  (D, F)      incoming var->factor messages per endpoint
-  m0,m1:  (D, F)      outgoing factor->var min-marginals
+Layout contract (lane-major, arity a):
+  cubesT: (D, ..., D, F)  cost hypercubes, factor axis last (lanes)
+  q_p:    (D, F)          incoming var->factor messages per position
+  m_p:    (D, F)          outgoing factor->var min-marginals
 
-  m0[d0, f] = min_d1 (cubesT[d0, d1, f] + q1[d1, f])
-  m1[d1, f] = min_d0 (cubesT[d0, d1, f] + q0[d0, f])
+  m_p[d, f] = min over the other positions' values of
+              (cubesT[..., f] + sum_{p' != p} q_p'[d_p', f])
 
-The domain axis D is small and static, so the kernel unrolls D*D fused
-vector ops over (BLK,) lanes — pure VPU work with perfect tiling.
+The domain axes are small and static, so the kernels unroll the
+``D**arity`` hypercube sweep into fused vector ops over (BLK,) lanes —
+pure VPU work with perfect tiling.  The binary kernel is the a=2
+special case kept in its historically-benched form; ``_nary_kernel``
+generalizes it for the PEAV/SECP n-ary factor families.  The unroll
+only pays while ``D**arity`` stays small — ``NARY_FAST_MAX_CELLS``
+gates dispatch; bigger hypercubes take the generic XLA path.
 """
 
 import functools
+import itertools
 
 import jax
 import jax.numpy as jnp
 
 BLK_F = 512  # factors per grid step (multiple of the 128-lane tile)
+
+#: per-factor hypercube cells (D**arity) at or below which the unrolled
+#: lane-major fast paths (this kernel family and the fused var-sorted
+#: layout) dispatch; above it, callers fall back to the generic
+#: gather/scatter XLA path, which stays the correctness oracle
+NARY_FAST_MAX_CELLS = 4096
 
 
 def _binary_kernel(cube_ref, q0_ref, q1_ref, m0_ref, m1_ref):
@@ -87,3 +99,123 @@ def factor_messages_binary_lane_major_ref(cubesT, q0, q1):
     m0 = jnp.min(cubesT + q1[None, :, :], axis=1)
     m1 = jnp.min(cubesT + q0[:, None, :], axis=0)
     return m0, m1
+
+
+# ------------------------------------------------------------- n-ary
+
+
+def _make_nary_kernel(arity, D):
+    """Kernel for one arity bucket: all ``arity`` outgoing min-marginal
+    messages of a (D, ..., D, BLK) hypercube block in one pallas_call.
+
+    Unrolls the ``D**arity`` joint-assignment sweep: each assignment
+    contributes ONE summed (BLK,) lane vector, reused for every
+    position's accumulator via echo subtraction — the same
+    total-minus-own-message association as the generic
+    ``ops.kernels.factor_messages``, so messages match it bit-exactly.
+    """
+
+    def kernel(cube_ref, *refs):
+        q_refs, m_refs = refs[:arity], refs[arity:]
+        acc = [[None] * D for _ in range(arity)]
+        for idx in itertools.product(range(D), repeat=arity):
+            total = cube_ref[idx + (slice(None),)]
+            for p in range(arity):
+                total = total + q_refs[p][idx[p], :]
+            for p in range(arity):
+                v = total - q_refs[p][idx[p], :]
+                a = acc[p][idx[p]]
+                acc[p][idx[p]] = v if a is None else jnp.minimum(a, v)
+        for p in range(arity):
+            for d in range(D):
+                m_refs[p][d, :] = acc[p][d]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def factor_messages_nary_lane_major(cubesT, qs, interpret=False):
+    """Fused n-ary factor min-marginals, lane-major (see module doc).
+
+    cubesT: (D, ..., D, F) — ``arity = cubesT.ndim - 1`` domain axes;
+    qs: per-position incoming messages, each (D, F).  Returns the
+    ``arity`` outgoing messages, each (D, F).  Pads F up to a BLK_F
+    multiple; the padded tail reads zeros and is sliced away.
+    """
+    from jax.experimental import pallas as pl
+
+    qs = list(qs)
+    arity = cubesT.ndim - 1
+    if arity != len(qs):
+        raise ValueError(
+            f"cubesT has {arity} domain axes but {len(qs)} q arrays")
+    D, F = cubesT.shape[0], cubesT.shape[-1]
+    F_pad = ((F + BLK_F - 1) // BLK_F) * BLK_F
+    if F_pad != F:
+        cubesT = jnp.pad(
+            cubesT, ((0, 0),) * arity + ((0, F_pad - F),))
+        qs = [jnp.pad(q, ((0, 0), (0, F_pad - F))) for q in qs]
+    grid = (F_pad // BLK_F,)
+    cube_block = (D,) * arity + (BLK_F,)
+
+    def cube_index(i):
+        return (0,) * arity + (i,)
+
+    msgs = pl.pallas_call(
+        _make_nary_kernel(arity, D),
+        grid=grid,
+        in_specs=[pl.BlockSpec(cube_block, cube_index)] + [
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i))
+            for _ in range(arity)
+        ],
+        out_specs=[
+            pl.BlockSpec((D, BLK_F), lambda i: (0, i))
+            for _ in range(arity)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((D, F_pad), cubesT.dtype)
+            for _ in range(arity)
+        ],
+        interpret=interpret,
+    )(cubesT, *qs)
+    return [m[:, :F] for m in msgs]
+
+
+def factor_messages_lane_major(cubesT, q_in, arity, use_pallas=False,
+                               interpret=False):
+    """Per-arity-bucket kernel dispatch shared by every lane-major
+    consumer (single-chip lane/fused solvers and the mesh twins):
+    binary buckets keep the historically-benched binary kernel/ref,
+    n-ary buckets take the arity-generic pair; ``use_pallas`` opts
+    into the hand kernels (``interpret`` for off-TPU testing)."""
+    if arity == 2:
+        if use_pallas:
+            return list(factor_messages_binary_lane_major(
+                cubesT, *q_in, interpret=interpret))
+        return list(factor_messages_binary_lane_major_ref(
+            cubesT, *q_in))
+    if use_pallas:
+        return factor_messages_nary_lane_major(
+            cubesT, q_in, interpret=interpret)
+    return factor_messages_nary_lane_major_ref(cubesT, q_in)
+
+
+def factor_messages_nary_lane_major_ref(cubesT, qs):
+    """jnp reference implementation (and the non-TPU fallback): the
+    lane-major transpose of ``ops.kernels.factor_messages`` — same
+    total-minus-echo association, so messages match it bit-exactly."""
+    arity = cubesT.ndim - 1
+    F = cubesT.shape[-1]
+    total = cubesT
+    q_b = []
+    for p, q in enumerate(qs):
+        shape = [1] * arity + [F]
+        shape[p] = q.shape[0]
+        q_b.append(q.reshape(shape))
+        total = total + q_b[p]
+    out = []
+    for p in range(arity):
+        t = total - q_b[p]
+        reduce_axes = tuple(i for i in range(arity) if i != p)
+        out.append(jnp.min(t, axis=reduce_axes) if reduce_axes else t)
+    return out

@@ -43,12 +43,24 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
         batch = mesh.shape["dp"]
 
     if algo in ("maxsum", "amaxsum"):
-        arrays = FactorGraphArrays.build(dcop)
         from .sharded_maxsum import (ShardedAMaxSum, ShardedFusedMaxSum,
                                      ShardedMaxSum)
 
         layout = params.pop("layout", None)
+        # arity-sorted build gives mixed-arity models the canonical
+        # factor-major edge layout the fast mesh layouts need;
+        # edge_major keeps the model's own order (the generic oracle)
+        arrays = FactorGraphArrays.build(
+            dcop, arity_sorted=layout != "edge_major")
         if algo == "amaxsum":
+            if layout == "fused":
+                # loud rejection, never a silent downgrade (the repo
+                # policy ShardedMaxSum itself enforces for layouts)
+                raise ValueError(
+                    "amaxsum has no fused mesh layout: -p layout:fused "
+                    "is only supported for maxsum "
+                    "(ShardedFusedMaxSum); use layout edge_major/"
+                    "lane_major or drop the param")
             cls = ShardedAMaxSum
         elif layout == "fused":
             # the fused var-sorted layout has its own mesh class (one

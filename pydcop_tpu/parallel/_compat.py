@@ -1,0 +1,22 @@
+"""jax version compatibility for the mesh layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` into the
+top-level ``jax`` namespace (and its replication-check kwarg was
+renamed ``check_rep`` -> ``check_vma``) across jax releases; the mesh
+solvers run on both spellings through this resolver so a jax downgrade
+never takes the whole multi-chip layer down with an AttributeError.
+"""
+
+import jax
+
+_new_style = hasattr(jax, "shard_map")
+if _new_style:
+    _shard_map = jax.shard_map
+else:  # older jax: the experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, **kwargs):
+    if not _new_style and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

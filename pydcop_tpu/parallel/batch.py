@@ -67,7 +67,13 @@ class BatchedMaxSum:
                 final = jax.lax.while_loop(cond, body, state)
             finally:
                 base.buckets = orig
-            return final["selection"], final["cycle"], final["finished"]
+            # decode through assignment_indices, NOT the raw selection
+            # field: with stability:0 the step elides the per-cycle
+            # argmin and carries the INIT-state selection — the live
+            # assignment must be rebuilt from the final messages, the
+            # same decode the sync engine uses
+            return (base.assignment_indices(final), final["cycle"],
+                    final["finished"])
 
         self._one = one_instance
         self.max_cycles = 200

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..algorithms.dba import DbaSolver
 from ..algorithms.dsa import DsaSolver
 from ..algorithms.gdba import GdbaSolver
@@ -188,7 +189,7 @@ class ShardedLocalSearch:
         ]
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(
                 P("dp"), P("dp"),
                 tuple([P("dp", "tp")] * len(self.state_bucket_keys)),

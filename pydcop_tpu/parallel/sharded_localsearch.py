@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..graphs.arrays import BIG, HypergraphArrays
 from ..ops.kernels import bucket_cost, candidate_costs
 
@@ -135,7 +136,7 @@ class ShardedDsa:
             return jax.vmap(one)(x, keys)
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(
                 P("dp"), P(),
                 [P("tp") for _ in self.sharded_buckets],
@@ -296,7 +297,7 @@ class ShardedMgm:
             return jax.vmap(one)(x)
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(
                 P("dp"),
                 [P("tp") for _ in self.sharded_buckets],

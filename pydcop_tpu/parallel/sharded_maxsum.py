@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..graphs.arrays import BIG, FactorGraphArrays
 from ..ops.kernels import factor_messages
 
@@ -148,12 +149,20 @@ class ShardedMaxSum:
         self.E_loc = e_loc
         self.buckets = shard_buckets
         self.edge_var = edge_var                        # (TP, E_loc)
+        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+
+        def _lane_ok(sb):
+            return sb.arity <= 2 or \
+                self.D ** sb.arity <= NARY_FAST_MAX_CELLS
         if layout == "auto":
             layout = "lane_major" if all(
-                sb.arity <= 2 for sb in shard_buckets) else "edge_major"
-        if layout == "lane_major" and any(
-                sb.arity > 2 for sb in shard_buckets):
-            raise ValueError("lane_major needs arities <= 2")
+                _lane_ok(sb) for sb in shard_buckets) else "edge_major"
+        if layout == "lane_major" and not all(
+                _lane_ok(sb) for sb in shard_buckets):
+            raise ValueError(
+                "lane_major needs per-factor hypercubes small enough "
+                "to unroll (D**arity <= NARY_FAST_MAX_CELLS); use "
+                "edge_major for bigger factors")
         self.layout = layout
         if use_pallas is None:
             # same measured default as the single-chip lane solver
@@ -232,14 +241,9 @@ class ShardedMaxSum:
 
     def _factor_update_lane_major(self, qT, cubes):
         """(D, E) layout: lane kernels, same math as MaxSumLaneSolver —
-        including the fused pallas kernel when ``use_pallas`` is set
-        (one kernel per bucket instead of the broadcast-add/min chain;
-        the shard-local update is identical to the single-chip dispatch
-        at maxsum.py:308-334)."""
-        from ..ops.pallas_kernels import (
-            factor_messages_binary_lane_major,
-            factor_messages_binary_lane_major_ref)
-
+        per-arity-bucket dispatch identical to the single-chip solver
+        (binary and small-n-ary buckets each one fused kernel on the
+        pallas path, jnp fallbacks elsewhere)."""
         D, E = self.D, self.E_loc
         blocks = []
         for sb, cu in zip(self.buckets, cubes):
@@ -250,17 +254,16 @@ class ShardedMaxSum:
             if a == 1:
                 blocks.append(jnp.transpose(cu))            # (D, F)
                 continue
-            cubesT = jnp.transpose(cu, (1, 2, 0))           # (D, D, F)
-            q_blk = qT[:, sb.offset:sb.offset + 2 * f]
-            q0, q1 = q_blk[:, 0::2], q_blk[:, 1::2]
-            if self.use_pallas:
-                m0, m1 = factor_messages_binary_lane_major(
-                    cubesT, q0, q1, interpret=self._pallas_interpret)
-            else:
-                m0, m1 = factor_messages_binary_lane_major_ref(
-                    cubesT, q0, q1)
-            blocks.append(jnp.stack([m0, m1], axis=2)
-                          .reshape(D, 2 * f))
+            cubesT = jnp.moveaxis(cu, 0, -1)            # (D, ..., D, F)
+            q_blk = qT[:, sb.offset:sb.offset + a * f]
+            q_in = [q_blk[:, p::a] for p in range(a)]
+            from ..ops.pallas_kernels import factor_messages_lane_major
+
+            msgs = factor_messages_lane_major(
+                cubesT, q_in, a, use_pallas=self.use_pallas,
+                interpret=self._pallas_interpret)
+            blocks.append(jnp.stack(msgs, axis=2)
+                          .reshape(D, a * f))
         if not blocks:
             return jnp.zeros((D, E), dtype=qT.dtype)
         return blocks[0] if len(blocks) == 1 else \
@@ -323,7 +326,7 @@ class ShardedMaxSum:
             return jax.vmap(one)(q, r, keys)
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(
                 P("dp", "tp"), P("dp", "tp"), P(), P("tp"),
                 [P("tp") for _ in self.buckets],
@@ -418,19 +421,37 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     local degree, so shapes are identical across shards and the
     per-variable partial sums are static reshape+reduce — assembled
     with a single ``psum`` over tp, exactly where the lane layout psums
-    its scatter partials.  Requires binary factors only, like the
-    single-chip fused solver.
+    its scatter partials.
+
+    N-ary graphs (PEAV/SECP shapes) use the same arity-bucketed slot
+    tables as the single-chip fused solver: per (arity, position)
+    bucket one shard-local static gather pulls that position's
+    incoming messages out of slot space, the bucket's lane-major
+    hypercube sweep emits all its messages, and one static assembly
+    permutation lays them back into slots — zero scatters, and the
+    partner traffic stays shard-local because a factor's slots always
+    live on its own shard.  Requires factor arities >= 2 under the
+    unroll threshold, like the single-chip fused solver.
     """
 
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
                  batch: int = 1):
-        if any(b.arity != 2 for b in arrays.buckets):
+        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+
+        # binary buckets are unconditional (no hypercube unroll); the
+        # cell gate bounds only the n-ary lane-major sweep — mirrors
+        # MaxSumFusedSolver.eligible
+        if any(b.arity < 2 or (
+                b.arity > 2 and
+                arrays.max_domain ** b.arity > NARY_FAST_MAX_CELLS)
+               for b in arrays.buckets):
             raise ValueError(
-                "the fused mesh layout needs ONLY binary factors — "
+                "the fused mesh layout needs factor arities >= 2 — "
                 "fold unary constraints into variable costs first "
-                "(filter_dcop)")
+                "(filter_dcop) — with arity >= 3 hypercubes under the "
+                "unroll threshold (D**arity <= NARY_FAST_MAX_CELLS)")
         self._init_params(arrays, mesh, damping, damping_nodes,
                           stability, noise, batch)
         self.layout = "fused"
@@ -443,14 +464,7 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     def _build_fused_shards(self, arrays):
         V, D, tp = self.V, self.D, self.tp
         shard_buckets, edge_var, e_loc = _partition(arrays, tp)
-
-        # local canonical partner: within each bucket block, edges
-        # 2i/2i+1 are the factor's two endpoints (same for all shards)
-        partner_local = np.empty(e_loc, dtype=np.int64)
-        for sb in shard_buckets:
-            f = sb.cubes.shape[1]
-            rel = np.arange(2 * f, dtype=np.int64)
-            partner_local[sb.offset + rel] = sb.offset + (rel ^ 1)
+        self._all_binary = all(sb.arity == 2 for sb in shard_buckets)
 
         # ONE global variable ordering: bucket by the max-over-shards
         # local degree, so every shard's slot table has the same shape
@@ -471,9 +485,10 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
                 [[k] * nv for _o, _v, nv, k in kbuckets]).astype(
                     np.int64)) if kbuckets else np.zeros(0, np.int64)
 
+        # per-shard slot assignment: real edges grouped by variable in
+        # local edge order, padded to the shared bucket widths
         slot_edge = np.full((tp, ep), -1, dtype=np.int64)
-        partner_slot = np.zeros((tp, ep), dtype=np.int32)
-        cube_slotT = np.zeros((tp, D, D, ep), dtype=np.float32)
+        slot_of_local = np.full((tp, e_loc), -1, dtype=np.int64)
         for g in range(tp):
             ev = edge_var[g]
             real = np.where(ev < V)[0]
@@ -484,26 +499,7 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
                 np.repeat(run_start, dg)
             slots = slot_base[ev[order]] + rank
             slot_edge[g, slots] = order
-            slot_of_local = np.full(e_loc, -1, dtype=np.int64)
-            slot_of_local[order] = slots
-            valid_g = slot_edge[g] >= 0
-            partner_slot[g, valid_g] = slot_of_local[
-                partner_local[slot_edge[g, valid_g]]]
-            # oriented cube slices written straight into this shard's
-            # slot table (no dense per-edge temporary): pos 0 receives
-            # over the cube's second axis (transpose), pos 1 over the
-            # first — the same orientation rule as the single-chip
-            # fused solver
-            for sb in shard_buckets:
-                f = sb.cubes.shape[1]
-                # both sides put the advanced (slot) index FIRST:
-                # shapes are (n, D_other, D_self)
-                for pos, axes in ((0, (0, 2, 1)), (1, (0, 1, 2))):
-                    les = sb.offset + 2 * np.arange(f) + pos
-                    ss = slot_of_local[les]
-                    ok = ss >= 0
-                    cube_slotT[g, :, :, ss[ok]] = np.transpose(
-                        sb.cubes[g][ok], axes)
+            slot_of_local[g, order] = slots
 
         valid = slot_edge >= 0                       # (TP, EP)
         emask = (np.asarray(arrays.domain_mask)[slot_var].T[None]
@@ -511,8 +507,6 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
         self.EP = ep
         self._kbuckets = kbuckets
         self._np = {
-            "partner_slot": partner_slot,
-            "cube_slotT": cube_slotT,
             "emask": emask,
             "var_costsT_sorted":
                 np.asarray(arrays.var_costs).T[:, var_order]
@@ -524,6 +518,66 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
                 .astype(np.float32),
             "var_pos": var_pos,
         }
+
+        if not self._all_binary:
+            # arity-bucketed slot tables (the n-ary form, mirroring the
+            # single-chip fused solver): per (arity, position) bucket
+            # ONE static gather reads that position's incoming
+            # messages out of slot space; results come back in local
+            # canonical edge order, so the assembly map is slot ->
+            # local edge id (e_loc = the appended zeros column for
+            # padding slots).  Dummy factors' edges have no slot; their
+            # gather indices clip to 0 and their messages are never
+            # assembled.  Zero scatters.
+            pos_slots = []   # per bucket: (TP, arity, fmax)
+            cubesT = []      # per bucket: (TP, D, ..., D, fmax)
+            for sb in shard_buckets:
+                a = sb.arity
+                f = sb.cubes.shape[1]
+                eids = sb.offset + np.arange(f * a).reshape(f, a)
+                ps = np.maximum(
+                    slot_of_local[:, eids], 0)       # (TP, f, a)
+                pos_slots.append(np.transpose(ps, (0, 2, 1))
+                                 .astype(np.int32).copy())
+                cubesT.append(np.moveaxis(sb.cubes, 1, -1).copy())
+            self._np["pos_slots"] = pos_slots
+            self._np["cubesT"] = cubesT
+            self._np["slot_src"] = np.where(
+                valid, slot_edge, e_loc).astype(np.int32)
+            return
+
+        # binary-only: the single slot-aligned table.  Local canonical
+        # partner: within each bucket block, edges 2i/2i+1 are the
+        # factor's two endpoints (same for all shards)
+        partner_local = np.empty(e_loc, dtype=np.int64)
+        for sb in shard_buckets:
+            f = sb.cubes.shape[1]
+            rel = np.arange(2 * f, dtype=np.int64)
+            partner_local[sb.offset + rel] = sb.offset + (rel ^ 1)
+
+        partner_slot = np.zeros((tp, ep), dtype=np.int32)
+        cube_slotT = np.zeros((tp, D, D, ep), dtype=np.float32)
+        for g in range(tp):
+            valid_g = valid[g]
+            partner_slot[g, valid_g] = slot_of_local[
+                g, partner_local[slot_edge[g, valid_g]]]
+            # oriented cube slices written straight into this shard's
+            # slot table (no dense per-edge temporary): pos 0 receives
+            # over the cube's second axis (transpose), pos 1 over the
+            # first — the same orientation rule as the single-chip
+            # fused solver
+            for sb in shard_buckets:
+                f = sb.cubes.shape[1]
+                # both sides put the advanced (slot) index FIRST:
+                # shapes are (n, D_other, D_self)
+                for pos, axes in ((0, (0, 2, 1)), (1, (0, 1, 2))):
+                    les = sb.offset + 2 * np.arange(f) + pos
+                    ss = slot_of_local[g, les]
+                    ok = ss >= 0
+                    cube_slotT[g, :, :, ss[ok]] = np.transpose(
+                        sb.cubes[g][ok], axes)
+        self._np["partner_slot"] = partner_slot
+        self._np["cube_slotT"] = cube_slotT
 
     # ---------------------------------------------------------- device
 
@@ -538,8 +592,6 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
         tp_sh = NamedSharding(mesh, P("tp"))
         rep = NamedSharding(mesh, P())
         consts = {
-            "partner_slot": jax.device_put(n["partner_slot"], tp_sh),
-            "cube_slotT": jax.device_put(n["cube_slotT"], tp_sh),
             "emask": jax.device_put(n["emask"], tp_sh),
             "var_costsT_sorted": jax.device_put(
                 jnp.asarray(n["var_costsT_sorted"]), rep),
@@ -548,11 +600,27 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             "slot_dsize": jax.device_put(
                 jnp.asarray(n["slot_dsize"]), rep),
         }
+        if self._all_binary:
+            consts["partner_slot"] = jax.device_put(
+                n["partner_slot"], tp_sh)
+            consts["cube_slotT"] = jax.device_put(
+                n["cube_slotT"], tp_sh)
+        else:
+            consts["pos_slots"] = [
+                jax.device_put(ps, tp_sh) for ps in n["pos_slots"]]
+            consts["cubesT"] = [
+                jax.device_put(c, tp_sh) for c in n["cubesT"]]
+            consts["slot_src"] = jax.device_put(n["slot_src"], tp_sh)
         return state, consts
 
     def _step_args(self, consts):
-        return (consts["partner_slot"], consts["cube_slotT"],
-                consts["emask"], consts["var_costsT_sorted"],
+        if self._all_binary:
+            return (consts["partner_slot"], consts["cube_slotT"],
+                    consts["emask"], consts["var_costsT_sorted"],
+                    consts["domain_maskT_sorted"], consts["slot_dsize"])
+        return (consts["pos_slots"], consts["cubesT"],
+                consts["slot_src"], consts["emask"],
+                consts["var_costsT_sorted"],
                 consts["domain_maskT_sorted"], consts["slot_dsize"])
 
     def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
@@ -560,66 +628,86 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
 
     # ------------------------------------------------------------ step
 
-    def _build_step(self):
-        D, V = self.D, self.V
+    def _fused_cycle_tail(self, q1, r1, k1, new_r, emask, vcT, dmT,
+                          dsize):
+        """Everything after the factor update — shared by the binary
+        (slot-aligned single-gather) and n-ary (arity-bucketed) factor
+        updates so the two modes can never diverge on variable-update
+        or convergence semantics."""
+        D = self.D
         damping, damping_nodes = self.damping, self.damping_nodes
         noise = self.noise
         kbuckets = self._kbuckets
 
+        new_r = jnp.where(emask, new_r, 0.0)
+        if damping_nodes in ("factors", "both") and damping > 0:
+            new_r = damping * r1 + (1 - damping) * new_r
+        # static per-bucket partial sums -> one psum over tp
+        parts = []
+        for s_off, v_off, nv, k in kbuckets:
+            parts.append(new_r[:, s_off:s_off + nv * k]
+                         .reshape(D, nv, k).sum(axis=2))
+        partial_sum = parts[0] if len(parts) == 1 else \
+            jnp.concatenate(parts, axis=1)       # (D, V)
+        belief = vcT + jax.lax.psum(partial_sum, "tp")
+        blocks = []
+        for s_off, v_off, nv, k in kbuckets:
+            blk = new_r[:, s_off:s_off + nv * k] \
+                .reshape(D, nv, k)
+            blocks.append(
+                (belief[:, v_off:v_off + nv, None] - blk)
+                .reshape(D, nv * k))
+        q_new = blocks[0] if len(blocks) == 1 else \
+            jnp.concatenate(blocks, axis=1)
+        mean = (jnp.sum(jnp.where(emask, q_new, 0.0), axis=0)
+                / dsize)
+        q_new = q_new - mean[None, :]
+        if noise > 0:
+            tp_idx = jax.lax.axis_index("tp")
+            sub = jax.random.fold_in(k1, tp_idx)
+            q_new = q_new + noise * jax.random.uniform(
+                sub, q_new.shape)
+        if damping_nodes in ("vars", "both") and damping > 0:
+            q_new = damping * q1 + (1 - damping) * q_new
+        q_new = jnp.where(emask, q_new, BIG)
+        sel = jnp.argmin(
+            jnp.where(dmT, belief, BIG * 2), axis=0)
+        if self.EP and self.stability > 0:
+            delta = jax.lax.pmax(jnp.max(jnp.where(
+                emask, jnp.abs(q_new - q1), 0.0)), "tp")
+        else:
+            delta = jnp.float32(0)
+        return q_new, new_r, sel, delta
+
+    def _keys_for(self, key, n):
+        """Per-instance keys, differing across dp shards (parity with
+        ShardedMaxSum's stream layout)."""
+        dp_idx = jax.lax.axis_index("dp")
+        return jax.vmap(
+            lambda i: jax.random.fold_in(
+                jax.random.fold_in(key, dp_idx), i))(jnp.arange(n))
+
+    def _build_step(self):
+        if self._all_binary:
+            self._build_step_binary()
+        else:
+            self._build_step_nary()
+
+    def _build_step_binary(self):
         def local_step(q, r, key, partner, cube, emask, vcT, dmT,
                        dsize):
             # q, r: (B_loc, D, EP) shard-local var-sorted slots
             def one(q1, r1, k1):
                 q_part = q1[:, partner]          # the ONE local gather
                 new_r = jnp.min(cube + q_part[:, None, :], axis=0)
-                new_r = jnp.where(emask, new_r, 0.0)
-                if damping_nodes in ("factors", "both") and damping > 0:
-                    new_r = damping * r1 + (1 - damping) * new_r
-                # static per-bucket partial sums -> one psum over tp
-                parts = []
-                for s_off, v_off, nv, k in kbuckets:
-                    parts.append(new_r[:, s_off:s_off + nv * k]
-                                 .reshape(D, nv, k).sum(axis=2))
-                partial = parts[0] if len(parts) == 1 else                     jnp.concatenate(parts, axis=1)       # (D, V)
-                belief = vcT + jax.lax.psum(partial, "tp")
-                blocks = []
-                for s_off, v_off, nv, k in kbuckets:
-                    blk = new_r[:, s_off:s_off + nv * k]                         .reshape(D, nv, k)
-                    blocks.append(
-                        (belief[:, v_off:v_off + nv, None] - blk)
-                        .reshape(D, nv * k))
-                q_new = blocks[0] if len(blocks) == 1 else                     jnp.concatenate(blocks, axis=1)
-                mean = (jnp.sum(jnp.where(emask, q_new, 0.0), axis=0)
-                        / dsize)
-                q_new = q_new - mean[None, :]
-                if noise > 0:
-                    tp_idx = jax.lax.axis_index("tp")
-                    sub = jax.random.fold_in(k1, tp_idx)
-                    q_new = q_new + noise * jax.random.uniform(
-                        sub, q_new.shape)
-                if damping_nodes in ("vars", "both") and damping > 0:
-                    q_new = damping * q1 + (1 - damping) * q_new
-                q_new = jnp.where(emask, q_new, BIG)
-                sel = jnp.argmin(
-                    jnp.where(dmT, belief, BIG * 2), axis=0)
-                if self.EP and self.stability > 0:
-                    delta = jax.lax.pmax(jnp.max(jnp.where(
-                        emask, jnp.abs(q_new - q1), 0.0)), "tp")
-                else:
-                    delta = jnp.float32(0)
-                return q_new, new_r, sel, delta
+                return self._fused_cycle_tail(
+                    q1, r1, k1, new_r, emask, vcT, dmT, dsize)
 
-            # per-instance keys differ across dp shards (parity with
-            # ShardedMaxSum's stream layout)
-            dp_idx = jax.lax.axis_index("dp")
-            keys = jax.vmap(
-                lambda i: jax.random.fold_in(
-                    jax.random.fold_in(key, dp_idx), i))(
-                jnp.arange(q.shape[0]))
+            keys = self._keys_for(key, q.shape[0])
             return jax.vmap(one)(q, r, keys)
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
                       P("tp"), P("tp"), P("tp"), P(), P(), P()),
             out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
@@ -628,6 +716,54 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             q2, r2, sel, delta = local_step(
                 q[:, 0], r[:, 0], key, partner[0], cube[0], emask[0],
                 vcT, dmT, dsize)
+            return q2[:, None], r2[:, None], sel, delta
+
+        self._step = jax.jit(sharded)
+
+    def _build_step_nary(self):
+        from ..ops.pallas_kernels import factor_messages_lane_major
+
+        D = self.D
+        nb = len(self._np["pos_slots"])
+
+        def local_step(q, r, key, pos_slots, cubesT, slot_src, emask,
+                       vcT, dmT, dsize):
+            def one(q1, r1, k1):
+                # one static gather per (arity, position) bucket, the
+                # shared lane-major hypercube sweep, one assembly
+                # permutation back to slots — zero scatters
+                blocks = []
+                for ps, cu in zip(pos_slots, cubesT):
+                    a = cu.ndim - 1
+                    f = cu.shape[-1]
+                    q_in = [q1[:, ps[p]] for p in range(a)]
+                    msgs = factor_messages_lane_major(cu, q_in, a)
+                    blocks.append(jnp.stack(msgs, axis=2)
+                                  .reshape(D, a * f))
+                m = blocks[0] if len(blocks) == 1 else \
+                    jnp.concatenate(blocks, axis=1)
+                m = jnp.concatenate(
+                    [m, jnp.zeros((D, 1), m.dtype)], axis=1)
+                new_r = m[:, slot_src]
+                return self._fused_cycle_tail(
+                    q1, r1, k1, new_r, emask, vcT, dmT, dsize)
+
+            keys = self._keys_for(key, q.shape[0])
+            return jax.vmap(one)(q, r, keys)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
+                      [P("tp")] * nb, [P("tp")] * nb, P("tp"),
+                      P("tp"), P(), P(), P()),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp")),
+        )
+        def sharded(q, r, key, pos_slots, cubesT, slot_src, emask,
+                    vcT, dmT, dsize):
+            q2, r2, sel, delta = local_step(
+                q[:, 0], r[:, 0], key,
+                [p[0] for p in pos_slots], [c[0] for c in cubesT],
+                slot_src[0], emask[0], vcT, dmT, dsize)
             return q2[:, None], r2[:, None], sel, delta
 
         self._step = jax.jit(sharded)
@@ -651,7 +787,7 @@ class ShardedAMaxSum(ShardedMaxSum):
         mesh = self.mesh
 
         @partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
                       P("dp", "tp"), P("dp", "tp")),
             out_specs=(P("dp", "tp"), P("dp", "tp")),

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..graphs.arrays import (BIG, HypergraphArrays, out_edge_table,
                              pair_edge_lookup, pair_eids_for_bucket)
 from ..ops.kernels import candidate_costs
@@ -269,7 +270,7 @@ class ShardedMgm2:
             return x_new, key
 
         @partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(
                 P("dp"), P("dp"),
                 [P("tp") for _ in self.sharded_buckets],

@@ -312,3 +312,19 @@ def test_dpop_message_size_accounting():
     assert message_size(util) == 9
     scalar = NAryMatrixRelation([], np.array(1.0), name="s")
     assert message_size(scalar) == 1
+
+
+def test_dpop_getting_started_msg_metrics_golden():
+    """The documented getting-started numbers (docs/getting_started.md,
+    mirroring the reference tutorial): on the 3-variable chain DPOP
+    exchanges 4 messages with total size 8 — 2 UTIL of prod(dims)=2
+    each plus 2 VALUE of 2x|separator|=2 each — on BOTH the host and
+    the device paths."""
+    from pydcop_tpu.algorithms.dpop import solve_direct
+
+    res = solve_direct(load_dcop(GC3), device="host")
+    assert res.metrics["msg_count"] == 4
+    assert res.metrics["msg_size"] == 8
+    res_dev = solve_direct(load_dcop(GC3), device="jax")
+    assert res_dev.metrics["msg_count"] == 4
+    assert res_dev.metrics["msg_size"] == 8

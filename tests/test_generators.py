@@ -72,6 +72,37 @@ def test_meetings_peav():
     assert set(res.assignment) == set(dcop.variables)
 
 
+def test_meetings_peav_nary_equalities():
+    """The k-ary event-equality encoding: same variables and optimum
+    cost as the pairwise chain (one all-equal factor per multi-resource
+    event instead of len-1 binary equalities), genuinely n-ary factors
+    when an event has 3+ resources."""
+    kw = dict(slots_count=4, events_count=5, resources_count=4,
+              max_resources_event=3, seed=5)
+    chain = generate_meetings(**kw)
+    nary = generate_meetings(nary_equalities=True, **kw)
+    assert set(nary.variables) == set(chain.variables)
+    arities = {c.arity for c in nary.constraints.values()}
+    assert max(arities) >= 3
+    # identical cost on any assignment with all events in agreement:
+    # evaluate both models on the all-slot-1 assignment
+    a = {v: 1 for v in nary.variables}
+    assert nary.solution_cost(a) == chain.solution_cost(a)
+    # and a broken event prices exactly one violation marker per model
+    # form difference is allowed, but feasibility must agree: the
+    # nary penalty fires iff some pairwise penalty fires
+    import itertools
+
+    for ev_vars in itertools.islice(
+            (c.dimensions for c in nary.constraints.values()
+             if c.name.startswith("eq_e") and c.arity >= 2), 1):
+        b = dict(a)
+        b[ev_vars[0].name] = 2
+        c_chain, _ = chain.solution_cost(b)
+        c_nary, _ = nary.solution_cost(b)
+        assert (c_chain < 0) == (c_nary < 0)  # both see the -10000
+
+
 def test_secp():
     dcop = generate_secp(lights_count=6, models_count=2, rules_count=1,
                          seed=7)

@@ -445,6 +445,8 @@ def test_fused_layout_lazy_decode_and_eligibility():
     assert np.array_equal(np.asarray(lane.assignment_indices(sl)),
                           np.asarray(fused.assignment_indices(sf)))
 
+    # a ternary factor graph is now fused-eligible (the n-ary slot
+    # tables): it must build AND solve to the optimum
     ternary = load_dcop("""
 name: t3
 objective: min
@@ -458,11 +460,36 @@ constraints:
   c: {type: intention, function: x + y + z}
 agents: [a1, a2, a3]
 """)
-    with _pytest.raises(ValueError):
-        MaxSumFusedSolver(FactorGraphArrays.build(ternary))
+    t_solver = MaxSumFusedSolver(FactorGraphArrays.build(ternary))
+    st = t_solver.init_state(jax.random.PRNGKey(0))
+    for _ in range(10):
+        st = t_solver.step(st)
+    assert np.asarray(t_solver.assignment_indices(st)).tolist() \
+        == [0, 0, 0]
+
+    # an over-threshold hypercube (D**arity > NARY_FAST_MAX_CELLS) is
+    # rejected loudly — the generic path stays the oracle there
+    from pydcop_tpu.generators.fast import nary_factor_arrays
+    big = nary_factor_arrays(8, {7: 2}, n_values=4, seed=0)  # 4**7
+    assert not MaxSumFusedSolver.eligible(big)
+    with _pytest.raises(ValueError, match="NARY_FAST_MAX_CELLS"):
+        MaxSumFusedSolver(big)
+
+    # but BINARY buckets stay unconditional at any domain size (the
+    # slot-aligned path does no hypercube unroll): D=70 binary graphs
+    # keep the fused fast path (code-review regression)
+    wide = coloring_factor_arrays(10, 15, n_colors=70, seed=0,
+                                  noise=0.05)
+    assert MaxSumFusedSolver.eligible(wide)
+    MaxSumFusedSolver(wide)
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedFusedMaxSum
+    import jax as _jax
+    if len(_jax.devices()) >= 8:
+        from pydcop_tpu.parallel import make_mesh
+        ShardedFusedMaxSum(wide, make_mesh(8), batch=4)
 
     # a unary FACTOR graph is lane-eligible but not fused-eligible:
-    # the error must state the fused requirement (binary factors /
+    # the error must state the fused requirement (arities >= 2 /
     # filter_dcop), not the lane solver's (code-review r5)
     unary = load_dcop("""
 name: u1
@@ -479,7 +506,7 @@ agents: [a1, a2]
 """)
     u_arrays = FactorGraphArrays.build(unary)
     assert MaxSumLaneSolver.eligible(u_arrays)
-    with _pytest.raises(ValueError, match="binary factors"):
+    with _pytest.raises(ValueError, match="filter_dcop"):
         MaxSumFusedSolver(u_arrays)
 
 
@@ -532,3 +559,130 @@ def test_delta_on_beliefs_converges_and_matches():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="delta_on"):
         MaxSumSolver(arrays, delta_on="nope")
+
+
+# ---- n-ary fast path: cross-layout exact equality ---------------------
+
+
+def _assert_layout_parity(arrays, cycles=30, damping=0.5,
+                          stability=0.1, use_pallas_too=True):
+    """Generic (edge-major oracle) vs lane-major vs fused vs
+    pallas-lane: selections must match exactly every cycle, and the
+    convergence observables must agree."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver,
+                                              MaxSumSolver)
+
+    solvers = [MaxSumSolver(arrays, damping=damping,
+                            stability=stability),
+               MaxSumLaneSolver(arrays, damping=damping,
+                                stability=stability),
+               MaxSumFusedSolver(arrays, damping=damping,
+                                 stability=stability)]
+    if use_pallas_too:
+        solvers.append(MaxSumLaneSolver(arrays, damping=damping,
+                                        stability=stability,
+                                        use_pallas=True))
+    states = [s.init_state(jax.random.PRNGKey(0)) for s in solvers]
+    steps = [jax.jit(s.step) for s in solvers]
+    for i in range(cycles):
+        states = [st(s) for st, s in zip(steps, states)]
+        sels = [np.asarray(sv.assignment_indices(s))
+                for sv, s in zip(solvers, states)]
+        for j, sel in enumerate(sels[1:], 1):
+            assert np.array_equal(sels[0], sel), \
+                (i, type(solvers[j]).__name__)
+        fins = {bool(s["finished"]) for s in states}
+        assert len(fins) == 1, i
+    return states
+
+
+def test_nary_arity3_cross_layout_exact():
+    """Pure arity-3 instance: generic vs lane vs fused vs pallas-lane
+    selections bit-exact every cycle (the tentpole's core contract)."""
+    from pydcop_tpu.generators.fast import nary_factor_arrays
+
+    arrays = nary_factor_arrays(50, {3: 60}, n_values=3, seed=11)
+    _assert_layout_parity(arrays)
+
+
+def test_nary_arity4_cross_layout_exact():
+    from pydcop_tpu.generators.fast import nary_factor_arrays
+
+    arrays = nary_factor_arrays(40, {4: 25}, n_values=3, seed=5)
+    _assert_layout_parity(arrays)
+
+
+def test_nary_mixed_arity_cross_layout_exact():
+    """Mixed binary + ternary + quaternary buckets: the fused solver's
+    per-(arity, position) slot tables and the lane solver's per-bucket
+    dispatch both reproduce the generic oracle exactly."""
+    from pydcop_tpu.generators.fast import nary_factor_arrays
+
+    arrays = nary_factor_arrays(60, {2: 80, 3: 40, 4: 15},
+                                n_values=3, seed=7)
+    states = _assert_layout_parity(arrays)
+    # and the lazy stability=0 decode path on the same mixed graph
+    arrays2 = nary_factor_arrays(30, {2: 30, 3: 15}, n_values=3,
+                                 seed=2)
+    _assert_layout_parity(arrays2, cycles=12, stability=0.0)
+
+
+def test_nary_peav_and_secp_instances_cross_layout():
+    """The real workload shapes: a PEAV meeting-scheduling instance
+    (binary eq/mutex after filter_dcop) and a SECP instance (arity 3-4
+    model factors) through every layout, selections equal to the
+    generic oracle each cycle.  Tiny unary noise breaks the exact
+    belief ties both generators produce (integer slot values / scene
+    targets), same role as the binary parity tests' noise=0.05."""
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+    from pydcop_tpu.generators.secp import generate_secp
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays, \
+        canonical_edge_layout
+
+    rng = np.random.default_rng(0)
+    peav = filter_dcop(generate_meetings(
+        slots_count=4, events_count=5, resources_count=4,
+        max_resources_event=2, seed=13))
+    secp = filter_dcop(generate_secp(
+        lights_count=8, models_count=4, rules_count=2, seed=3))
+    for dcop in (peav, secp):
+        arrays = FactorGraphArrays.build(dcop, arity_sorted=True)
+        assert canonical_edge_layout(arrays) is not None
+        arrays.var_costs = arrays.var_costs + rng.uniform(
+            0, 1e-3, arrays.var_costs.shape).astype(np.float32)
+        _assert_layout_parity(arrays, cycles=25)
+    # SECP really exercises the n-ary path
+    secp_arities = {b.arity for b in FactorGraphArrays.build(
+        secp, arity_sorted=True).buckets}
+    assert max(secp_arities) >= 3
+
+
+def test_build_solver_auto_picks_lane_for_nary():
+    """layout=auto compiles mixed-arity models canonically (arity-
+    sorted) and picks the lane fast path; explicit fused reaches the
+    n-ary fused solver; edge_major stays the untouched oracle."""
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver,
+                                              MaxSumSolver, build_solver)
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.generators.secp import generate_secp
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    secp = filter_dcop(generate_secp(
+        lights_count=6, models_count=3, rules_count=1, seed=1))
+    auto = build_solver(secp, {})
+    assert type(auto) is MaxSumLaneSolver
+    fused = build_solver(secp, {"layout": "fused"})
+    assert type(fused) is MaxSumFusedSolver
+    generic = build_solver(secp, {"layout": "edge_major"})
+    assert type(generic) is MaxSumSolver
+    res = solve_result(secp, "maxsum", timeout=20, layout="fused")
+    assert res.status in ("FINISHED", "MAX_CYCLES")
+    assert len(res.assignment) == len(secp.variables)
