@@ -55,6 +55,16 @@ def set_parser(subparsers):
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
+    parser.add_argument("--consolidated-out", dest="consolidated_out",
+                        default=None, metavar="results.jsonl",
+                        help="opt-in: stream ONE JSON line per job "
+                             "({'job_id': ..., **result}) to this file "
+                             "instead of writing per-job JSON files "
+                             "(a 1024-job campaign otherwise costs "
+                             "1024 file creations — PERF_NOTES round "
+                             "6).  Trade: `consolidate` reads per-job "
+                             "files, so consume the jsonl directly; "
+                             "progress/resume tracking is unchanged")
     parser.set_defaults(func=run_cmd)
     return parser
 
@@ -205,11 +215,39 @@ def _topology_signature(arrays) -> Tuple:
             arrays.var_costs.tobytes(), initial, tuple(buckets))
 
 
-def _run_fused_group(key, rows, out_dir, register_done):
+_jsonl_lock = None
+
+
+def _append_jsonl(path: str, job_id: str, result: dict):
+    """One line per job, written as a SINGLE os.write to an O_APPEND
+    fd: a buffered text write would split lines larger than the I/O
+    buffer into multiple syscalls, letting concurrent ``--parallel``
+    threads interleave partial rows.  A process-local lock guards the
+    encode+write pair as well (the fused child runs before the
+    subprocess pool, so cross-process appends never overlap)."""
+    import json as _json
+    import threading
+
+    global _jsonl_lock
+    if _jsonl_lock is None:
+        _jsonl_lock = threading.Lock()
+    data = (_json.dumps(dict(result, job_id=job_id)) + "\n").encode()
+    with _jsonl_lock:
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                     0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+
+
+def _run_fused_group(key, rows, out_dir, register_done,
+                     consolidated_out=None):
     """Solve every (job_id, path, iteration) row of one group as a
     single vmapped program; write the same per-job result JSON the
     subprocess path produces, so resume files and ``consolidate`` CSVs
-    are indistinguishable."""
+    are indistinguishable (or one jsonl line per job when the campaign
+    opted into ``--consolidated-out``)."""
     import numpy as np
 
     from ..dcop.dcop import filter_dcop
@@ -290,8 +328,7 @@ def _run_fused_group(key, rows, out_dir, register_done):
                 for n, v in zip(var_names, sel[i])
             }
             cost, violations = dcop.solution_cost(assignment)
-            out_path = os.path.join(out_dir, f"{job_id}.json")
-            output_json({
+            result = {
                 "status": ("FINISHED" if bool(finished[i])
                            else "MAX_CYCLES"),
                 "assignment": assignment,
@@ -303,7 +340,12 @@ def _run_fused_group(key, rows, out_dir, register_done):
                 "msg_count": 0,
                 "msg_size": 0,
                 "fused_batch": len(sub),
-            }, out_path, quiet=True)
+            }
+            if consolidated_out:
+                _append_jsonl(consolidated_out, job_id, result)
+            else:
+                out_path = os.path.join(out_dir, f"{job_id}.json")
+                output_json(result, out_path, quiet=True)
             register_done(job_id)
             print(f"[ok] {job_id} (fused x{len(sub)}, "
                   f"{elapsed:.1f}s total)")
@@ -327,7 +369,8 @@ def _fused_child_main(argv=None) -> int:
         with open(spec["progress_path"], "a") as f:
             f.write(job_id + "\n")
 
-    _run_fused_group(key, rows, spec["out_dir"], register_done)
+    _run_fused_group(key, rows, spec["out_dir"], register_done,
+                     consolidated_out=spec.get("consolidated_out"))
     return 0
 
 
@@ -382,7 +425,9 @@ def run_cmd(args, timeout=None):
             _json.dump({"key": list(fkey), "rows": [list(r)
                                                     for r in rows],
                         "out_dir": args.out_dir,
-                        "progress_path": progress_path}, f)
+                        "progress_path": progress_path,
+                        "consolidated_out": getattr(
+                            args, "consolidated_out", None)}, f)
         failure = None
         try:
             proc = subprocess.run(
@@ -416,6 +461,8 @@ def run_cmd(args, timeout=None):
     todo = [job for job in jobs
             if job[0] not in done and job[0] not in fused_ids]
 
+    consolidated_out = getattr(args, "consolidated_out", None)
+
     def run_one(job):
         job_id, argv, _meta = job
         out_path = os.path.join(args.out_dir, f"{job_id}.json")
@@ -432,6 +479,18 @@ def run_cmd(args, timeout=None):
                            f"{proc.stderr}")
         except subprocess.TimeoutExpired:
             failure = f"timed out after {args.job_timeout}s"
+        if failure is None and consolidated_out:
+            # opt-in jsonl stream: fold the job's result file into one
+            # consolidated line and drop the per-job artifact
+            import json as _json
+
+            try:
+                with open(out_path) as f:
+                    result = _json.load(f)
+                _append_jsonl(consolidated_out, job_id, result)
+                os.remove(out_path)
+            except (OSError, ValueError) as e:
+                failure = f"consolidated-out fold failed: {e}"
         if failure is None:
             # register immediately (not in submission order) so an
             # interrupted --parallel campaign never re-runs a finished

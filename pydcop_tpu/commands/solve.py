@@ -89,7 +89,7 @@ def run_cmd(args, timeout: Optional[float] = None):
 
     if args.mode == "sharded":
         from . import parse_algo_params
-        from ..parallel import solve_sharded
+        from ..parallel import solve_sharded_result
 
         # only user-given params travel (validated/cast by algo_def);
         # defaults come from the sharded solvers themselves, and
@@ -104,24 +104,41 @@ def run_cmd(args, timeout: Optional[float] = None):
             raise CliError(
                 "delta_on:beliefs is a single-chip engine knob; "
                 "sharded convergence keeps the message-delta semantics")
-        assignment, _best_cost, cycles, finished = solve_sharded(
+        # same trace granularity rules as engine mode; the sharded
+        # trace is recorded ON DEVICE by the mesh engine (zero extra
+        # host round-trips), so asking for it never slows the sync path
+        collect_every = None
+        if args.period:
+            collect_every = max(1, int(round(args.period)))
+        elif args.run_metrics:
+            collect_every = 16
+        res = solve_sharded_result(
             dcop, args.algo, n_cycles=args.max_cycles,
-            batch=args.batch, seed=args.seed, **params)
+            batch=args.batch, seed=args.seed, timeout=timeout,
+            collect_cost_every=collect_every, **params)
         cost, violations = dcop.solution_cost(
-            assignment, infinity=args.infinity)
+            res.assignment, infinity=args.infinity)
+        if collector is not None:
+            for cycle, c in res.cost_trace:
+                collector.put(("", "global", "", c, cycle))
+        if stop_evt is not None:
+            stop_evt.set()
+            collector_thread.join(2)
         result = {
             # the runner reports whether its own termination fired
             # (SAME_COUNT stability, DBA zero violations) — even when
             # it fires exactly on the last budgeted cycle
-            "status": "FINISHED" if finished else "MAX_CYCLES",
-            "assignment": assignment,
+            "status": res.status,
+            "assignment": res.assignment,
             "cost": cost,
             "violation": violations,
-            "cycle": cycles,
+            "cycle": res.cycles,
             "time": time.perf_counter() - t0,
             "msg_count": 0,
             "msg_size": 0,
         }
+        if res.cost_trace:
+            result["cost_trace"] = res.cost_trace
         if args.end_metrics:
             _append_end_metrics(args.end_metrics, result)
         output_json(result, args.output)

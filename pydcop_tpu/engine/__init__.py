@@ -1,4 +1,6 @@
+from .mesh_engine import MeshSolverMixin, ShardedSyncEngine
 from .solver import ArraySolver, RunResult
 from .sync_engine import SyncEngine
 
-__all__ = ["ArraySolver", "RunResult", "SyncEngine"]
+__all__ = ["ArraySolver", "MeshSolverMixin", "RunResult",
+           "ShardedSyncEngine", "SyncEngine"]

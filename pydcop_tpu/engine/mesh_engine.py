@@ -1,0 +1,320 @@
+"""The mesh sync engine: chunked on-device cycle execution for the
+sharded (dp x tp) solvers.
+
+The single-chip :class:`~pydcop_tpu.engine.sync_engine.SyncEngine`
+already runs chunks of algorithm cycles inside one ``lax.while_loop``
+on device; until this module every mesh solver drove one jitted step
+per Python-loop iteration with a device->host transfer of the full
+selection array every cycle — PERF_NOTES rounds 5-6 measured the
+~0.3-0.5 ms per-dispatch floor as the dominant mesh cost.  The mesh
+engine removes that term:
+
+* each sharded solver exposes a pure ``mesh_step(state) -> state``
+  whose carry includes the convergence bookkeeping (``sel``,
+  ``same``, ``cycle``, ``finished``), so the SAME_COUNT-stability rule
+  evaluates **on device** instead of pulling ``sel``/``delta`` to host
+  every cycle;
+* the engine jits ``K`` cycles per dispatch as one
+  ``lax.while_loop`` chunk with buffer donation on the carried state
+  (the ``shard_map``-ped step stages cleanly inside the loop), and
+  syncs to host only between chunks — for the timeout check, the
+  finished flag, and optional metrics;
+* an **anytime cost trace** rides the carry: when requested, the chunk
+  body writes the per-cycle best-over-batch assignment cost into a
+  fixed-size on-device buffer (one float per cycle), so sharded runs
+  return the same ``RunResult.cost_trace`` the single-chip engine
+  produces with zero extra host round-trips.
+
+A mesh solver plugs in by implementing:
+
+* ``mesh_init(...) -> state`` — device-placed carry with at least
+  ``cycle`` (int32 scalar) and ``finished`` (bool scalar); any other
+  entries are solver-private (messages, assignment, PRNG key, ...),
+* ``mesh_step(state) -> state`` — ONE synchronous cycle, pure and
+  jit-traceable, preserving unknown carry keys (the engine may add a
+  ``trace`` buffer),
+* optionally ``mesh_cost(state) -> (B,)`` — per-instance assignment
+  cost of the current selection (sign-compiled, lower-is-better),
+  used for the anytime trace.
+
+Chunk size: ``chunk_size`` argument, else the
+``PYDCOP_TPU_MESH_CHUNK`` environment variable, else 32.
+"""
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ._cache import enable_persistent_cache
+
+#: default cycles per device dispatch (one host sync per chunk)
+DEFAULT_CHUNK = 32
+
+
+def _default_chunk() -> int:
+    try:
+        return max(1, int(os.environ.get("PYDCOP_TPU_MESH_CHUNK",
+                                         DEFAULT_CHUNK)))
+    except ValueError:
+        return DEFAULT_CHUNK
+
+
+class ShardedSyncEngine:
+    """Drive a mesh solver's ``mesh_step`` in compiled chunks.
+
+    Mirrors :class:`~pydcop_tpu.engine.sync_engine.SyncEngine` for the
+    sharded solvers: at most ``ceil(n_cycles / chunk)`` host syncs per
+    run instead of one per cycle.  ``last_stats`` records the dispatch
+    and host-sync counts of the most recent :meth:`drive` (the A/B
+    bench's transfer counter).
+    """
+
+    def __init__(self, solver, chunk_size: Optional[int] = None):
+        enable_persistent_cache()
+        self._solver = solver
+        self._chunk = int(chunk_size) if chunk_size else _default_chunk()
+        self._compiled: Dict[bool, Any] = {}
+        #: stats of the most recent drive(): dispatches (compiled chunk
+        #: launches), host_syncs (loop iterations that read
+        #: cycle/finished back), status, duration
+        self.last_stats: Dict[str, Any] = {}
+
+    @property
+    def chunk_size(self) -> int:
+        return self._chunk
+
+    # ------------------------------------------------------------ chunks
+
+    def _run_chunk(self, traced: bool):
+        if traced not in self._compiled:
+            import jax
+            import jax.numpy as jnp
+
+            step = self._solver.mesh_step
+            cost = self._solver.mesh_cost if traced else None
+
+            def body(s):
+                s2 = step(s)
+                if cost is not None:
+                    # best-over-batch anytime cost, written at the
+                    # PRE-increment cycle index: trace[i] is the cost
+                    # after cycle i+1
+                    c = jnp.min(cost(s2))
+                    s2 = dict(s2)
+                    s2["trace"] = s2["trace"].at[s["cycle"]].set(c)
+                return s2
+
+            def run_chunk(state, limit):
+                def cond(s):
+                    return jnp.logical_and(
+                        jnp.logical_not(s["finished"]),
+                        s["cycle"] < limit)
+
+                return jax.lax.while_loop(cond, body, state)
+
+            # donate the carried state: q/r/x buffers are reused in
+            # place across chunks (the trace buffer too)
+            self._compiled[traced] = jax.jit(
+                run_chunk, donate_argnums=(0,))
+        return self._compiled[traced]
+
+    # ------------------------------------------------------------- drive
+
+    def drive(self, state: Dict[str, Any], n_cycles: int,
+              timeout: Optional[float] = None,
+              collect_cost: bool = False,
+              chunk_size: Optional[int] = None) -> Dict[str, Any]:
+        """Run until the solver's ``finished`` flag, the cycle budget,
+        or the wall-clock timeout; returns the final carry (with the
+        filled ``trace`` buffer when ``collect_cost``)."""
+        import jax.numpy as jnp
+
+        chunk = int(chunk_size) if chunk_size else self._chunk
+        if collect_cost and "trace" not in state:
+            state = dict(state)
+            state["trace"] = jnp.full((max(1, n_cycles),), jnp.nan,
+                                      dtype=jnp.float32)
+        run_chunk = self._run_chunk(collect_cost)
+        t0 = time.perf_counter()
+        status = "MAX_CYCLES"
+        dispatches = 0
+        host_syncs = 0
+        while True:
+            # ONE host sync per chunk boundary: the cycle counter and
+            # finished flag (two scalars), nothing else
+            host_syncs += 1
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                break
+            if cycle >= n_cycles:
+                break
+            if timeout is not None and \
+                    time.perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+            limit = min(cycle + chunk, n_cycles)
+            state = run_chunk(state, jnp.int32(limit))
+            dispatches += 1
+        self.last_stats = {
+            "dispatches": dispatches,
+            "host_syncs": host_syncs,
+            "chunk_size": chunk,
+            "status": status,
+            "duration": time.perf_counter() - t0,
+            "engine": "chunked",
+        }
+        return state
+
+    # ------------------------------------------------------------- trace
+
+    @staticmethod
+    def take_trace(state: Dict[str, Any], cycles: int,
+                   every: int = 1) -> List[Tuple[int, float]]:
+        """Extract the on-device cost buffer as the single-chip
+        engine's ``[(cycle, cost), ...]`` trace, subsampled to every
+        ``every``-th cycle (the final executed cycle always kept)."""
+        import jax
+
+        if "trace" not in state:
+            return []
+        buf = np.asarray(jax.device_get(state["trace"]))
+        every = max(1, int(every))
+        out = []
+        for i in range(min(cycles, len(buf))):
+            cyc = i + 1
+            if not np.isfinite(buf[i]):
+                continue
+            if cyc % every == 0 or cyc == cycles:
+                out.append((cyc, float(buf[i])))
+        return out
+
+
+class MeshSolverMixin:
+    """The shared ``run()`` plumbing of the five sharded solver
+    families: one engine per solver instance (compiled chunks and
+    device constants live as long as the solver), one code path for
+    convergence, stats, and the anytime cost trace.
+
+    Subclasses implement ``mesh_init`` / ``mesh_step`` (and optionally
+    ``mesh_cost``), plus ``_mesh_sel(state)`` returning the device
+    selection array the final decode reads.
+    """
+
+    #: whether the algorithm's own termination rule fired on the last
+    #: completed run() (False before/without a completed run)
+    finished = False
+    #: [(cycle, cost)] anytime trace of the last run() that asked for
+    #: one (empty otherwise)
+    last_cost_trace: List[Tuple[int, float]] = []
+    #: dispatch/host-sync counters of the last run()
+    last_run_stats: Dict[str, Any] = {}
+    #: per-instance caches (instance attrs shadow these on first set)
+    _mesh_consts = None
+    _mesh_cost_fn = None
+    _mesh_engine_obj = None
+
+    # ------------------------------------------------- per-instance caches
+
+    def _make_consts(self):
+        raise NotImplementedError
+
+    def _consts(self):
+        """Device constants (cubes, slot tables, masks) transferred
+        ONCE per solver instance, not on every run()/step_once()."""
+        if self._mesh_consts is None:
+            self._mesh_consts = self._make_consts()
+        return self._mesh_consts
+
+    def _build_cost_fn(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement a mesh cost "
+            f"evaluator; run with collect_cost_every=None")
+
+    def _ensure_cost_fn(self):
+        """Built OUTSIDE any trace (its device_puts must produce real
+        arrays, not tracers)."""
+        if self._mesh_cost_fn is None:
+            self._mesh_cost_fn = self._build_cost_fn()
+        return self._mesh_cost_fn
+
+    def _invalidate_mesh_cache(self):
+        """Drop every compiled/placed artifact derived from host-side
+        solver constants (cubes swapped in place, ...): the device
+        constants, the cost evaluator capturing them, AND the engine
+        whose compiled chunks closure-captured them at trace time."""
+        self._mesh_consts = None
+        self._mesh_cost_fn = None
+        self._mesh_engine_obj = None
+
+    # ----------------------------------------------------------- protocol
+
+    def _mesh_cost_input(self, state):
+        return state["x"]
+
+    def mesh_cost(self, state):
+        """(B,) assignment cost of the current selections — evaluated
+        tp-sharded with one psum (see ``parallel/_mesh_cost.py``)."""
+        return self._ensure_cost_fn()(self._mesh_cost_input(state))
+
+    def _mesh_sel(self, state):
+        return state["sel"]
+
+    def _seeds_for(self, seed: int, seeds) -> List[int]:
+        if seeds is None:
+            seeds = [seed + i for i in range(self.B)]
+        if len(seeds) != self.B:
+            raise ValueError(f"need {self.B} seeds, got {len(seeds)}")
+        return seeds
+
+    def _eager_stats(self, cycles: int, status: str, t0: float
+                     ) -> Dict[str, Any]:
+        """The run_eager() counterpart of the engine's last_stats: one
+        dispatch and one full-selection host sync per cycle."""
+        return {
+            "dispatches": cycles, "host_syncs": cycles,
+            "chunk_size": 1, "status": status,
+            "duration": time.perf_counter() - t0, "engine": "eager",
+        }
+
+    def _mesh_engine(self) -> ShardedSyncEngine:
+        engine = self._mesh_engine_obj
+        if engine is None:
+            # created with the instance default; per-run chunk_size
+            # overrides travel through drive(), never stick
+            engine = ShardedSyncEngine(self)
+            self._mesh_engine_obj = engine
+        return engine
+
+    def _drive_mesh(self, state, n_cycles: int,
+                    collect_cost_every: Optional[int] = None,
+                    chunk_size: Optional[int] = None,
+                    timeout: Optional[float] = None):
+        """Run the chunked engine and decode: returns the single
+        source of truth for ``finished`` / trace / stats, plus the
+        ((B, V) selections, cycles run) pair every run() returns."""
+        import jax
+
+        # materialize device constants (and the cost evaluator when
+        # tracing) BEFORE the chunk trace: a device_put staged inside
+        # the traced body would cache tracers, not arrays
+        self._consts()
+        if collect_cost_every:
+            self._ensure_cost_fn()
+        engine = self._mesh_engine()
+        state = engine.drive(state, n_cycles, timeout=timeout,
+                             collect_cost=bool(collect_cost_every),
+                             chunk_size=chunk_size)
+        cycles = int(state["cycle"])
+        self.finished = bool(state["finished"])
+        self.last_run_stats = engine.last_stats
+        self.last_cost_trace = engine.take_trace(
+            state, cycles, every=collect_cost_every or 1) \
+            if collect_cost_every else []
+        sel = np.asarray(jax.device_get(self._mesh_sel(state)))
+        return self._decode_sel(sel), cycles
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        return sel_np

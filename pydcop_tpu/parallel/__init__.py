@@ -18,29 +18,12 @@ def make_mesh(n_devices: int = None, tp: int = None):
     return jax.make_mesh((dp, tp), ("dp", "tp"))
 
 
-def solve_sharded(dcop, algo: str, n_cycles: int = 100,
-                  mesh=None, batch: int = None, seed: int = 0,
-                  **params):
-    """Solve a DCOP on a (dp, tp) device mesh — the multi-chip
-    counterpart of ``infrastructure.run.solve``.
-
-    ``algo``: maxsum / amaxsum (edge- or lane-major), dsa, mgm or
-    mgm2.  ``batch`` independent restarts ride the dp axis (default:
-    one per dp row); the best-cost restart is returned.  Returns
-    (assignment dict, cost, cycles, finished) — ``finished`` is True
-    iff the algorithm's own termination rule fired (possibly exactly
-    on the final cycle), so callers never infer status from
-    ``cycles < n_cycles``.
-    """
-    import numpy as np
-
+def _build_sharded_solver(dcop, algo: str, mesh, batch: int, params):
+    """Construct the sharded solver + its compiled arrays for one
+    algorithm name (shared by :func:`solve_sharded` and
+    :func:`solve_sharded_result`)."""
     from ..dcop.dcop import filter_dcop
     from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
-
-    if mesh is None:
-        mesh = make_mesh()
-    if batch is None:
-        batch = mesh.shape["dp"]
 
     if algo in ("maxsum", "amaxsum"):
         from .sharded_maxsum import (ShardedAMaxSum, ShardedFusedMaxSum,
@@ -73,25 +56,21 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
             # honoring explicit layouts and loudly rejecting bad ones
             params["layout"] = layout
         solver = cls(arrays, mesh, batch=batch, **params)
-        sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo == "dsa":
         arrays = HypergraphArrays.build(filter_dcop(dcop))
         from .sharded_localsearch import ShardedDsa
 
         solver = ShardedDsa(arrays, mesh, batch=batch, **params)
-        sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo == "mgm":
         arrays = HypergraphArrays.build(filter_dcop(dcop))
         from .sharded_localsearch import ShardedMgm
 
         solver = ShardedMgm(arrays, mesh, batch=batch, **params)
-        sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo == "mgm2":
         arrays = HypergraphArrays.build(filter_dcop(dcop))
         from .sharded_mgm2 import ShardedMgm2
 
         solver = ShardedMgm2(arrays, mesh, batch=batch, **params)
-        sel, cycles = solver.run(n_cycles, seed=seed)
     elif algo in ("mixeddsa", "dba", "gdba", "adsa", "dsatuto"):
         from .sharded_breakout import (ShardedAdsa, ShardedDba,
                                        ShardedDsatuto, ShardedGdba,
@@ -102,12 +81,42 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
                "dsatuto": ShardedDsatuto}[algo]
         arrays = HypergraphArrays.build(filter_dcop(dcop))
         solver = cls(arrays, mesh, batch=batch, **params)
-        sel, cycles = solver.run(n_cycles, seed=seed)
     else:
         raise ValueError(
             f"solve_sharded supports every iterative algorithm "
             f"(maxsum/amaxsum/dsa/adsa/dsatuto/mgm/mgm2/mixeddsa/"
             f"dba/gdba), not {algo!r}")
+    return solver, arrays
+
+
+def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
+                         mesh=None, batch: int = None, seed: int = 0,
+                         collect_cost_every: int = None,
+                         chunk_size: int = None, timeout: float = None,
+                         **params):
+    """Like :func:`solve_sharded` but returns the full
+    :class:`~pydcop_tpu.engine.solver.RunResult` — including the
+    anytime ``cost_trace`` recorded ON DEVICE by the mesh engine
+    (``collect_cost_every`` cycles between kept samples; traces cost
+    nothing in host round-trips), and the engine's dispatch/host-sync
+    counters in ``metrics``.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from ..engine.solver import RunResult
+
+    t0 = _time.perf_counter()
+    if mesh is None:
+        mesh = make_mesh()
+    if batch is None:
+        batch = mesh.shape["dp"]
+    solver, arrays = _build_sharded_solver(dcop, algo, mesh, batch,
+                                           params)
+    sel, cycles = solver.run(
+        n_cycles, seed=seed, collect_cost_every=collect_cost_every,
+        chunk_size=chunk_size, timeout=timeout)
 
     variables = [dcop.variable(n) for n in arrays.var_names]
     best_key, best = None, None
@@ -123,8 +132,42 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
         key = (violations,
                cost if dcop.objective == "min" else -cost)
         if best_key is None or key < best_key:
-            best_key, best = key, (assignment, cost)
-    return best[0], best[1], cycles, bool(solver.finished)
+            best_key, best = key, (assignment, cost, violations)
+    stats = dict(getattr(solver, "last_run_stats", {}))
+    finished = bool(solver.finished)
+    return RunResult(
+        assignment=best[0],
+        cycles=cycles,
+        finished=finished,
+        cost=best[1],
+        violations=best[2],
+        duration=_time.perf_counter() - t0,
+        status="FINISHED" if finished
+        else stats.get("status", "MAX_CYCLES"),
+        cost_trace=list(getattr(solver, "last_cost_trace", [])),
+        metrics=stats,
+    )
+
+
+def solve_sharded(dcop, algo: str, n_cycles: int = 100,
+                  mesh=None, batch: int = None, seed: int = 0,
+                  **params):
+    """Solve a DCOP on a (dp, tp) device mesh — the multi-chip
+    counterpart of ``infrastructure.run.solve``.
+
+    ``algo``: maxsum / amaxsum (edge- or lane-major), dsa, mgm or
+    mgm2.  ``batch`` independent restarts ride the dp axis (default:
+    one per dp row); the best-cost restart is returned.  Returns
+    (assignment dict, cost, cycles, finished) — ``finished`` is True
+    iff the algorithm's own termination rule fired (possibly exactly
+    on the final cycle), so callers never infer status from
+    ``cycles < n_cycles``.  For the anytime cost trace and engine
+    metrics, use :func:`solve_sharded_result`.
+    """
+    res = solve_sharded_result(dcop, algo, n_cycles=n_cycles,
+                               mesh=mesh, batch=batch, seed=seed,
+                               **params)
+    return res.assignment, res.cost, res.cycles, res.finished
 
 
 from .sharded_breakout import (ShardedDba, ShardedGdba,  # noqa: E402
@@ -134,4 +177,4 @@ from .sharded_mgm2 import ShardedMgm2  # noqa: E402
 __all__ = ["BatchedDsa", "BatchedMaxSum", "BatchedMgm",
            "ShardedAMaxSum", "ShardedDba", "ShardedGdba",
            "ShardedMaxSum", "ShardedMgm2", "ShardedMixedDsa",
-           "make_mesh", "solve_sharded"]
+           "make_mesh", "solve_sharded", "solve_sharded_result"]

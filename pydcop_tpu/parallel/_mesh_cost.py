@@ -1,0 +1,88 @@
+"""On-device assignment cost for the sharded solvers' anytime trace.
+
+One shard_map'ed evaluator shared by every mesh family: each tp shard
+sums its own constraint cubes at the current assignment (the same
+round-robin partition the solver steps over), one ``psum`` assembles
+the total, and the replicated unary costs are added once — so the mesh
+engine's per-cycle cost trace needs zero host round-trips and no
+replicated copy of the cube stacks.
+
+Dummy padding rows are handled per family: the local-search partitions
+pad with all-zero cubes (contribute nothing), the MaxSum factor
+partition pads with BIG-filled cubes and needs the explicit validity
+mask.
+"""
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ._compat import shard_map
+from ..ops.kernels import bucket_cost
+
+
+def build_mesh_cost(mesh, n_vars: int,
+                    buckets: List[Tuple[np.ndarray, np.ndarray,
+                                        Optional[np.ndarray]]],
+                    var_costs: np.ndarray, x_has_sink: bool):
+    """Compile ``cost(x) -> (B,)`` over the (dp, tp) mesh.
+
+    ``buckets``: per arity bucket ``(cubes (TP, F, D, ..., D),
+    var_ids (TP, F, a), valid (TP, F) or None)`` — ``valid`` masks
+    padded rows whose cube values are not inert (MaxSum's BIG fill);
+    ``None`` means padding contributes zero by construction.
+    ``var_costs``: the ORIGINAL (V, D) unary costs (no sink row).
+    ``x_has_sink``: whether the assignment carries the sink column
+    already (local-search state) or needs it appended (selections).
+    """
+    nb = len(buckets)
+    V = n_vars
+    tp_sh = NamedSharding(mesh, P("tp"))
+    cubes_d = [jax.device_put(c, tp_sh) for c, _v, _m in buckets]
+    vids_d = [jax.device_put(np.asarray(v, dtype=np.int32), tp_sh)
+              for _c, v, _m in buckets]
+    valid_d = [None if m is None else jax.device_put(
+        np.asarray(m, dtype=bool), tp_sh) for _c, _v, m in buckets]
+    has_mask = [m is not None for _c, _v, m in buckets]
+    mask_args = [m for m in valid_d if m is not None]
+    vc_d = jax.device_put(
+        jnp.asarray(np.asarray(var_costs[:V], dtype=np.float32)),
+        NamedSharding(mesh, P()))
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp"), [P("tp")] * nb, [P("tp")] * nb,
+                  [P("tp")] * sum(has_mask), P()),
+        out_specs=P("dp"),
+    )
+    def cost_fn(x, cubes, var_ids, masks, vc):
+        cubes_l = [c[0] for c in cubes]
+        vids_l = [v[0] for v in var_ids]
+        masks_l = iter([m[0] for m in masks])
+        mask_of = [next(masks_l) if hm else None for hm in has_mask]
+
+        def one(x1):
+            x1 = x1.astype(jnp.int32)
+            x_ext = x1 if x_has_sink else jnp.concatenate(
+                [x1, jnp.zeros((1,), dtype=jnp.int32)])
+            tot = jnp.float32(0)
+            for cu, vi, m in zip(cubes_l, vids_l, mask_of):
+                if cu.shape[0] == 0:
+                    continue
+                c = bucket_cost(cu, vi, x_ext)
+                if m is not None:
+                    c = jnp.where(m, c, 0.0)
+                tot = tot + jnp.sum(c)
+            tot = jax.lax.psum(tot, "tp")
+            return tot + jnp.sum(vc[jnp.arange(V), x_ext[:V]])
+
+        return jax.vmap(one)(x)
+
+    def cost(x):
+        return cost_fn(x, cubes_d, vids_d, mask_args, vc_d)
+
+    return cost

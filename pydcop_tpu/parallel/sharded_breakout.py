@@ -35,10 +35,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ._compat import shard_map
+from ._mesh_cost import build_mesh_cost
 from ..algorithms.dba import DbaSolver
 from ..algorithms.dsa import DsaSolver
 from ..algorithms.gdba import GdbaSolver
 from ..algorithms.mixeddsa import MixedDsaSolver
+from ..engine._cache import enable_persistent_cache
+from ..engine.mesh_engine import MeshSolverMixin
 from ..graphs.arrays import ConstraintBucket, HypergraphArrays
 from .sharded_localsearch import _partition_constraints
 
@@ -92,7 +95,7 @@ def _sink_view(arrays: HypergraphArrays,
     )
 
 
-class ShardedLocalSearch:
+class ShardedLocalSearch(MeshSolverMixin):
     """Run a :class:`LocalSearchSolver` subclass over a (dp, tp) mesh.
 
     Subclasses set ``solver_cls``, the per-bucket constant attributes
@@ -104,12 +107,9 @@ class ShardedLocalSearch:
     bucket_attrs: Tuple[str, ...] = ("buckets", "bucket_optima")
     state_bucket_keys: Tuple[str, ...] = ()
 
-    #: whether the algorithm's own termination rule fired on the
-    #: last completed run() (False before/without a completed run)
-    finished = False
-
     def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1,
                  **params):
+        enable_persistent_cache()
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -121,6 +121,11 @@ class ShardedLocalSearch:
         self.var_names = arrays.var_names
 
         shard_buckets = _partition_constraints(arrays, self.tp)
+        # raw partitioned cubes + unary costs, kept for the on-device
+        # cost trace (algorithm state like DBA weights deliberately
+        # excluded: the trace reports RAW assignment cost)
+        self.sharded_buckets = shard_buckets
+        self._raw_var_costs = np.asarray(arrays.var_costs)
         # one solver per shard view: shard 0's doubles as the template
         # whose step we trace; the others only donate their
         # bucket-derived constants (violation cubes, optima, ...).
@@ -225,7 +230,7 @@ class ShardedLocalSearch:
 
     # -------------------------------------------------------------- run
 
-    def _device_put(self, seeds: Sequence[int]):
+    def _init_state_arrays(self, seeds: Sequence[int]):
         mesh = self.mesh
         xs, keys, bstates = [], [], []
         for s in seeds:
@@ -251,25 +256,82 @@ class ShardedLocalSearch:
                 stacked)
             bucket_state.append(jax.device_put(
                 stacked, NamedSharding(mesh, P("dp", "tp"))))
-        consts = tuple(
+        return x, k, tuple(bucket_state)
+
+    def _make_consts(self):
+        mesh = self.mesh
+        return tuple(
             [jax.tree.map(
                 lambda a: jax.device_put(
                     a, NamedSharding(mesh, P("tp"))), b)
              for b in self._attr_stacks[attr]]
             for attr in self.bucket_attrs
         )
-        return x, k, tuple(bucket_state), consts
+
+    def _device_put(self, seeds: Sequence[int]):
+        x, k, bucket_state = self._init_state_arrays(seeds)
+        return x, k, bucket_state, self._consts()
+
+    # ---------------------------------------------- mesh engine protocol
+
+    def mesh_init(self, seed: int = 0,
+                  seeds: Optional[Sequence[int]] = None):
+        x, k, bucket_state = self._init_state_arrays(
+            self._seeds_for(seed, seeds))
+        return {"x": x, "keys": k, "bstate": bucket_state,
+                "cycle": jnp.int32(0),
+                "finished": jnp.bool_(False)}
+
+    def mesh_step(self, s):
+        x, keys, fin, bstate = self._step(
+            s["x"], s["keys"], s["bstate"], self._consts())
+        out = dict(s)
+        # the algorithm's own termination (e.g. DBA's zero-violations
+        # rule), checked on the FINAL cycle too — all instances must
+        # have fired, exactly like the eager loop's np.all
+        out.update(x=x, keys=keys, bstate=bstate,
+                   cycle=s["cycle"] + 1, finished=jnp.all(fin))
+        return out
+
+    def _build_cost_fn(self):
+        return build_mesh_cost(
+            self.mesh, self.V,
+            [(c, v, None) for _a, c, v in self.sharded_buckets],
+            self._raw_var_costs, x_has_sink=True)
+
+    def _mesh_sel(self, state):
+        return state["x"]
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        return sel_np[:, :self.V]
+
+    # ------------------------------------------------------------- runs
 
     def run(self, n_cycles: int, seed: int = 0,
-            seeds: Optional[Sequence[int]] = None
+            seeds: Optional[Sequence[int]] = None,
+            collect_cost_every: Optional[int] = None,
+            chunk_size: Optional[int] = None,
+            timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run); stops early when
-        the algorithm's own termination fires on every instance."""
-        if seeds is None:
-            seeds = [seed + i for i in range(self.B)]
-        if len(seeds) != self.B:
-            raise ValueError(f"need {self.B} seeds, got {len(seeds)}")
-        x, keys, bucket_state, consts = self._device_put(seeds)
+        the algorithm's own termination fires on every instance.
+        Cycles execute in compiled chunks on device, the termination
+        test included (engine/mesh_engine.py)."""
+        return self._drive_mesh(
+            self.mesh_init(seed, seeds), n_cycles,
+            collect_cost_every=collect_cost_every,
+            chunk_size=chunk_size, timeout=timeout)
+
+    def run_eager(self, n_cycles: int, seed: int = 0,
+                  seeds: Optional[Sequence[int]] = None
+                  ) -> Tuple[np.ndarray, int]:
+        """Pre-engine loop (one dispatch per cycle): the equivalence
+        oracle for the chunked engine and the A/B bench leg."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        x, keys, bucket_state, consts = self._device_put(
+            self._seeds_for(seed, seeds))
         cycle = 0
         self.finished = False
         for cycle in range(1, n_cycles + 1):
@@ -281,6 +343,8 @@ class ShardedLocalSearch:
                 self.finished = True
                 break
         sel = np.asarray(jax.device_get(x))[:, :self.V]
+        self.last_run_stats = self._eager_stats(
+            cycle, "FINISHED" if self.finished else "MAX_CYCLES", t0)
         return sel, cycle
 
     def step_once(self, seed: int = 0) -> np.ndarray:

@@ -23,6 +23,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ._compat import shard_map
+from ._mesh_cost import build_mesh_cost
+from ..engine._cache import enable_persistent_cache
+from ..engine.mesh_engine import MeshSolverMixin
 from ..graphs.arrays import BIG, HypergraphArrays
 from ..ops.kernels import bucket_cost, candidate_costs
 
@@ -55,15 +58,12 @@ def _partition_constraints(arrays: HypergraphArrays, tp: int):
     return out
 
 
-class ShardedDsa:
+class ShardedDsa(MeshSolverMixin):
     """DSA-B over a (dp, tp) mesh; ``batch`` independent instances."""
-
-    #: whether the algorithm's own termination rule fired on the
-    #: last completed run() (False before/without a completed run)
-    finished = False
 
     def __init__(self, arrays: HypergraphArrays, mesh,
                  probability: float = 0.7, batch: int = 1):
+        enable_persistent_cache()
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -153,14 +153,16 @@ class ShardedDsa:
 
         self._step = jax.jit(sharded)
 
-    def _device_put(self, seed: int):
-        mesh = self.mesh
+    def _init_x(self, seed: int):
         rng = np.random.default_rng(seed)
         x0 = rng.integers(
             0, np.maximum(self.domain_size, 1),
             size=(self.B, self.V + 1)).astype(np.int32)
-        x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
-        consts = (
+        return jax.device_put(x0, NamedSharding(self.mesh, P("dp")))
+
+    def _make_consts(self):
+        mesh = self.mesh
+        return (
             [jax.device_put(c, NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
@@ -170,11 +172,63 @@ class ShardedDsa:
             jax.device_put(jnp.asarray(self.domain_mask),
                            NamedSharding(mesh, P())),
         )
-        return x, consts
 
-    def run(self, n_cycles: int, seed: int = 0
+    def _device_put(self, seed: int):
+        return self._init_x(seed), self._consts()
+
+    # ---------------------------------------------- mesh engine protocol
+
+    def mesh_init(self, seed: int):
+        import jax.numpy as _jnp
+
+        return {"x": self._init_x(seed),
+                "key": jax.random.PRNGKey(seed),
+                "cycle": _jnp.int32(0),
+                # DSA has no self-termination rule: the flag never
+                # flips, runs stop at the cycle budget like the eager
+                # loop always did
+                "finished": _jnp.bool_(False)}
+
+    def mesh_step(self, s):
+        key, sub = jax.random.split(s["key"])
+        x = self._step(s["x"], sub, *self._consts())
+        out = dict(s)
+        out.update(x=x, key=key, cycle=s["cycle"] + 1)
+        return out
+
+    def _build_cost_fn(self):
+        return build_mesh_cost(
+            self.mesh, self.V,
+            [(c, v, None) for _a, c, v in self.sharded_buckets],
+            self.var_costs, x_has_sink=True)
+
+    def _mesh_sel(self, state):
+        return state["x"]
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        return sel_np[:, :self.V]
+
+    # ------------------------------------------------------------- runs
+
+    def run(self, n_cycles: int, seed: int = 0,
+            collect_cost_every: Optional[int] = None,
+            chunk_size: Optional[int] = None,
+            timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
-        """Returns ((B, V) selections, cycles run)."""
+        """Returns ((B, V) selections, cycles run); cycles execute in
+        compiled chunks on device (engine/mesh_engine.py)."""
+        return self._drive_mesh(
+            self.mesh_init(seed), n_cycles,
+            collect_cost_every=collect_cost_every,
+            chunk_size=chunk_size, timeout=timeout)
+
+    def run_eager(self, n_cycles: int, seed: int = 0
+                  ) -> Tuple[np.ndarray, int]:
+        """Pre-engine loop (one dispatch per cycle): the equivalence
+        oracle for the chunked engine and the A/B bench leg."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         x, (cubes, var_ids, var_costs, domain_mask) = \
             self._device_put(seed)
         key = jax.random.PRNGKey(seed)
@@ -184,6 +238,8 @@ class ShardedDsa:
                            domain_mask)
         self.finished = False  # DSA has no self-termination rule
         sel = np.asarray(jax.device_get(x))[:, :self.V]
+        self.last_run_stats = self._eager_stats(n_cycles,
+                                                "MAX_CYCLES", t0)
         return sel, n_cycles
 
     def step_once(self, seed: int = 0) -> np.ndarray:
@@ -195,7 +251,7 @@ class ShardedDsa:
         return np.asarray(jax.device_get(x))[:, :self.V]
 
 
-class ShardedMgm:
+class ShardedMgm(MeshSolverMixin):
     """MGM over a (dp, tp) mesh (the round-2 gap: no mgm-family solver
     had a sharded path).
 
@@ -210,11 +266,8 @@ class ShardedMgm:
     moves, so the conflict count never increases.
     """
 
-    #: whether the algorithm's own termination rule fired on the
-    #: last completed run() (False before/without a completed run)
-    finished = False
-
     def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1):
+        enable_persistent_cache()
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -314,8 +367,7 @@ class ShardedMgm:
 
         self._step = jax.jit(sharded)
 
-    def _device_put(self, seed: int, x0: Optional[np.ndarray] = None):
-        mesh = self.mesh
+    def _init_x(self, seed: int, x0: Optional[np.ndarray] = None):
         if x0 is None:
             rng = np.random.default_rng(seed)
             x0 = rng.integers(
@@ -325,8 +377,11 @@ class ShardedMgm:
             sink = np.zeros((self.B, 1), dtype=np.int32)
             x0 = np.concatenate(
                 [np.asarray(x0, dtype=np.int32), sink], axis=1)
-        x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
-        consts = (
+        return jax.device_put(x0, NamedSharding(self.mesh, P("dp")))
+
+    def _make_consts(self):
+        mesh = self.mesh
+        return (
             [jax.device_put(c, NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
@@ -336,18 +391,67 @@ class ShardedMgm:
             jax.device_put(jnp.asarray(self.domain_mask),
                            NamedSharding(mesh, P())),
         )
-        return x, consts
+
+    def _device_put(self, seed: int, x0: Optional[np.ndarray] = None):
+        return self._init_x(seed, x0), self._consts()
+
+    # ---------------------------------------------- mesh engine protocol
+
+    def mesh_init(self, seed: int, x0: Optional[np.ndarray] = None):
+        return {"x": self._init_x(seed, x0),
+                "cycle": jnp.int32(0),
+                # MGM runs the full budget by design
+                "finished": jnp.bool_(False)}
+
+    def mesh_step(self, s):
+        x = self._step(s["x"], *self._consts())
+        out = dict(s)
+        out.update(x=x, cycle=s["cycle"] + 1)
+        return out
+
+    def _build_cost_fn(self):
+        return build_mesh_cost(
+            self.mesh, self.V,
+            [(c, v, None) for _a, c, v in self.sharded_buckets],
+            self.var_costs, x_has_sink=True)
+
+    def _mesh_sel(self, state):
+        return state["x"]
+
+    def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
+        return sel_np[:, :self.V]
+
+    # ------------------------------------------------------------- runs
 
     def run(self, n_cycles: int, seed: int = 0,
-            x0: Optional[np.ndarray] = None) -> Tuple[np.ndarray, int]:
+            x0: Optional[np.ndarray] = None,
+            collect_cost_every: Optional[int] = None,
+            chunk_size: Optional[int] = None,
+            timeout: Optional[float] = None) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run).  ``x0`` optionally
-        fixes the initial (B, V) assignment (equivalence tests)."""
+        fixes the initial (B, V) assignment (equivalence tests);
+        cycles execute in compiled chunks on device."""
+        return self._drive_mesh(
+            self.mesh_init(seed, x0), n_cycles,
+            collect_cost_every=collect_cost_every,
+            chunk_size=chunk_size, timeout=timeout)
+
+    def run_eager(self, n_cycles: int, seed: int = 0,
+                  x0: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, int]:
+        """Pre-engine loop (one dispatch per cycle): the equivalence
+        oracle for the chunked engine and the A/B bench leg."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         x, (cubes, var_ids, var_costs, domain_mask) = \
             self._device_put(seed, x0)
         for cycle in range(n_cycles):
             x = self._step(x, cubes, var_ids, var_costs, domain_mask)
         self.finished = False  # runs the full budget by design
         sel = np.asarray(jax.device_get(x))[:, :self.V]
+        self.last_run_stats = self._eager_stats(n_cycles,
+                                                "MAX_CYCLES", t0)
         return sel, n_cycles
 
     def step_once(self, seed: int = 0) -> np.ndarray:

@@ -39,6 +39,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ._compat import shard_map
+from ._mesh_cost import build_mesh_cost
+from ..engine._cache import enable_persistent_cache
+from ..engine.mesh_engine import MeshSolverMixin
 from ..graphs.arrays import BIG, FactorGraphArrays
 from ..ops.kernels import factor_messages
 
@@ -89,7 +92,7 @@ def _partition(arrays: FactorGraphArrays, tp: int):
     return shard_buckets, edge_var, e_loc
 
 
-class ShardedMaxSum:
+class ShardedMaxSum(MeshSolverMixin):
     """MaxSum over a (dp, tp) mesh.
 
     Parameters mirror the single-chip solver
@@ -113,6 +116,10 @@ class ShardedMaxSum:
         (algorithms/maxsum.py:64-70) and the batch/dp check, so the
         fused mesh class can never diverge from the lane mesh on
         convergence semantics."""
+        # mesh runs re-traced from cold every process before the mesh
+        # engine: turn the persistent XLA cache on for every sharded
+        # construction path, like SyncEngine does for single-chip
+        enable_persistent_cache()
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -191,19 +198,21 @@ class ShardedMaxSum:
 
     # ------------------------------------------------------------ state
 
-    def _device_put(self):
-        """Shard the state and constants onto the mesh."""
+    def _init_state(self):
+        """Fresh per-run message state, sharded onto the mesh."""
         B, TP, E, D = self.B, self.tp, self.E_loc, self.D
-        mesh = self.mesh
         mask_e = self.domain_mask[self.edge_var]        # (TP, E, D)
         q0 = np.where(mask_e, 0.0, BIG).astype(np.float32)
         r0 = np.zeros_like(q0)
         q0 = np.broadcast_to(q0[None], (B, TP, E, D)).copy()
         r0 = np.broadcast_to(r0[None], (B, TP, E, D)).copy()
-        sh = NamedSharding(mesh, P("dp", "tp"))
-        state = {"q": jax.device_put(q0, sh),
-                 "r": jax.device_put(r0, sh)}
-        consts = {
+        sh = NamedSharding(self.mesh, P("dp", "tp"))
+        return {"q": jax.device_put(q0, sh),
+                "r": jax.device_put(r0, sh)}
+
+    def _make_consts(self):
+        mesh = self.mesh
+        return {
             "edge_var": jax.device_put(
                 self.edge_var, NamedSharding(mesh, P("tp"))),
             "cubes": [
@@ -218,7 +227,12 @@ class ShardedMaxSum:
             "domain_size": jax.device_put(
                 jnp.asarray(self.domain_size), NamedSharding(mesh, P())),
         }
-        return state, consts
+
+    def _device_put(self):
+        """Shard the state and constants onto the mesh (constants come
+        from the per-instance cache; the dict is a shallow copy so a
+        session may swap entries without touching the cache)."""
+        return self._init_state(), dict(self._consts())
 
     # ------------------------------------------------------------- step
 
@@ -364,13 +378,94 @@ class ShardedMaxSum:
         order)."""
         return sel_np
 
-    def run(self, n_cycles: int, seed: int = 0
+    # ---------------------------------------------- mesh engine protocol
+
+    def mesh_init(self, seed: int):
+        """The engine carry: message state + on-device convergence
+        bookkeeping (prev selection, SAME_COUNT streak)."""
+        state = self._init_state()
+        state.update({
+            "key": jax.random.PRNGKey(seed),
+            # -1 never equals an argmin index: the first cycle can
+            # never count as stable, like the eager loop's prev_sel
+            # = None warm-up
+            "sel": jax.device_put(
+                np.full((self.B, self.V), -1, dtype=np.int32),
+                NamedSharding(self.mesh, P("dp"))),
+            "same": jnp.int32(0),
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+        })
+        return state
+
+    def mesh_step(self, s):
+        """One cycle, pure: the sharded step plus the SAME_COUNT-
+        stability rule (selection unchanged across the WHOLE batch AND
+        message delta below the stability threshold) evaluated on
+        device — the exact arithmetic of the eager host loop."""
+        key, sub = jax.random.split(s["key"])
+        q, r, sel, delta = self._step(
+            s["q"], s["r"], sub, *self._step_args(self._consts()))
+        stable = jnp.logical_and(
+            jnp.all(sel == s["sel"]),
+            jnp.max(delta) < jnp.float32(self.stability))
+        same = jnp.where(stable, s["same"] + 1, jnp.int32(0))
+        out = dict(s)
+        out.update(q=q, r=r, key=key, sel=sel, same=same,
+                   cycle=s["cycle"] + 1,
+                   finished=same >= SAME_COUNT)
+        return out
+
+    def _cost_buckets(self):
+        """(cubes, var_ids, valid) triples for the on-device cost: the
+        MaxSum partition pads with BIG-filled cubes, so padded rows
+        need the explicit mask."""
+        return [(sb.cubes, sb.var_ids, sb.var_ids[:, :, 0] < self.V)
+                for sb in self.buckets]
+
+    def _mesh_sel_device(self, state):
+        """The selection in ORIGINAL variable order, on device (layout
+        subclasses override to undo their solve-order permutation)."""
+        return state["sel"]
+
+    def _build_cost_fn(self):
+        """On-device cost matching the single-chip solver's ``cost``
+        (cubes at selection + unary costs)."""
+        return build_mesh_cost(self.mesh, self.V, self._cost_buckets(),
+                               self.var_costs, x_has_sink=False)
+
+    def _mesh_cost_input(self, state):
+        return self._mesh_sel_device(state)
+
+    # ------------------------------------------------------------- runs
+
+    def run(self, n_cycles: int, seed: int = 0,
+            collect_cost_every: Optional[int] = None,
+            chunk_size: Optional[int] = None,
+            timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
         """Run until SAME_COUNT-stable (same convergence rule as the
         single-chip solver: selection unchanged AND message delta below
-        the stability threshold) or ``n_cycles``.
+        the stability threshold) or ``n_cycles``, in compiled chunks on
+        device (one host sync per chunk, see
+        ``engine/mesh_engine.py``).  ``collect_cost_every`` fills
+        ``self.last_cost_trace`` from the on-device anytime buffer.
 
         Returns ((B, V) selections, cycles run)."""
+        return self._drive_mesh(
+            self.mesh_init(seed), n_cycles,
+            collect_cost_every=collect_cost_every,
+            chunk_size=chunk_size, timeout=timeout)
+
+    def run_eager(self, n_cycles: int, seed: int = 0
+                  ) -> Tuple[np.ndarray, int]:
+        """The pre-engine loop — one dispatch and one sel+delta
+        device->host transfer per cycle.  Kept as the equivalence
+        oracle for the chunked engine (bit-exactness tests) and the
+        A/B leg of ``suite.py bench_mesh_dispatch``."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         state, consts = self._device_put()
         q, r = state["q"], state["r"]
         args = self._step_args(consts)
@@ -397,6 +492,8 @@ class ShardedMaxSum:
             else:
                 same = 0
             prev_sel = sel_h
+        self.last_run_stats = self._eager_stats(
+            cycle, "FINISHED" if self.finished else "MAX_CYCLES", t0)
         return self._decode_sel(np.asarray(jax.device_get(sel))), cycle
 
     def step_once(self, seed: int = 0):
@@ -464,6 +561,12 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     def _build_fused_shards(self, arrays):
         V, D, tp = self.V, self.D, self.tp
         shard_buckets, edge_var, e_loc = _partition(arrays, tp)
+        # kept for the on-device cost trace (the slot tables below are
+        # message-passing transforms; cost evaluation reads raw cubes)
+        self.buckets = shard_buckets
+        self.var_costs = np.concatenate(
+            [np.asarray(arrays.var_costs, dtype=np.float32),
+             np.full((1, D), BIG, dtype=np.float32)])
         self._all_binary = all(sb.arity == 2 for sb in shard_buckets)
 
         # ONE global variable ordering: bucket by the max-over-shards
@@ -581,14 +684,18 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
 
     # ---------------------------------------------------------- device
 
-    def _device_put(self):
-        mesh, B, tp = self.mesh, self.B, self.tp
+    def _init_state(self):
+        B = self.B
         n = self._np
         q0 = np.where(n["emask"], 0.0, BIG).astype(np.float32)
         q0 = np.broadcast_to(q0[None], (B,) + q0.shape).copy()
-        sh = NamedSharding(mesh, P("dp", "tp"))
-        state = {"q": jax.device_put(q0, sh),
-                 "r": jax.device_put(np.zeros_like(q0), sh)}
+        sh = NamedSharding(self.mesh, P("dp", "tp"))
+        return {"q": jax.device_put(q0, sh),
+                "r": jax.device_put(np.zeros_like(q0), sh)}
+
+    def _make_consts(self):
+        mesh = self.mesh
+        n = self._np
         tp_sh = NamedSharding(mesh, P("tp"))
         rep = NamedSharding(mesh, P())
         consts = {
@@ -611,7 +718,12 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             consts["cubesT"] = [
                 jax.device_put(c, tp_sh) for c in n["cubesT"]]
             consts["slot_src"] = jax.device_put(n["slot_src"], tp_sh)
-        return state, consts
+        return consts
+
+    def _mesh_sel_device(self, state):
+        # the fused layout solves in degree-sorted order; the cost
+        # trace evaluates raw cubes, which index ORIGINAL variables
+        return state["sel"][:, jnp.asarray(self._np["var_pos"])]
 
     def _step_args(self, consts):
         if self._all_binary:
@@ -916,3 +1028,7 @@ maxsum_dynamic.DynamicMaxSumSolver` (reference maxsum_dynamic.py:40-186):
         cubes[b_idx] = jax.device_put(
             sb.cubes, NamedSharding(self.mesh, P("tp")))
         self._session["consts"]["cubes"] = cubes
+        # the device-constant cache, the cost evaluator AND the mesh
+        # engine's compiled chunks (which closure-captured the consts
+        # at trace time) all hold stale cubes: rebuild lazily
+        self._invalidate_mesh_cache()

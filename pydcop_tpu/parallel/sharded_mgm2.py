@@ -31,6 +31,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ._compat import shard_map
+from ._mesh_cost import build_mesh_cost
+from ..engine._cache import enable_persistent_cache
+from ..engine.mesh_engine import MeshSolverMixin
 from ..graphs.arrays import (BIG, HypergraphArrays, out_edge_table,
                              pair_edge_lookup, pair_eids_for_bucket)
 from ..ops.kernels import candidate_costs
@@ -39,7 +42,7 @@ from .sharded_localsearch import _partition_constraints
 _EPS = 1e-6
 
 
-class ShardedMgm2:
+class ShardedMgm2(MeshSolverMixin):
     """MGM-2 over a (dp, tp) mesh; ``batch`` independent instances.
 
     Parameters mirror the single-chip solver: ``threshold`` (offerer
@@ -47,13 +50,10 @@ class ShardedMgm2:
     coordinated moves).
     """
 
-    #: whether the algorithm's own termination rule fired on the
-    #: last completed run() (False before/without a completed run)
-    finished = False
-
     def __init__(self, arrays: HypergraphArrays, mesh,
                  threshold: float = 0.5, favor: str = "unilateral",
                  batch: int = 1):
+        enable_persistent_cache()
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -296,14 +296,18 @@ class ShardedMgm2:
 
     # -------------------------------------------------------------- run
 
-    def _device_put(self, seeds: Sequence[int]):
+    def _init_xk(self, seeds: Sequence[int]):
         mesh = self.mesh
         inits = [self._init_instance(s) for s in seeds]
         x0 = np.stack([x for x, _ in inits]).astype(np.int32)
         k0 = np.stack([k for _, k in inits])
         x = jax.device_put(x0, NamedSharding(mesh, P("dp")))
         keys = jax.device_put(k0, NamedSharding(mesh, P("dp")))
-        consts = (
+        return x, keys
+
+    def _make_consts(self):
+        mesh = self.mesh
+        return (
             [jax.device_put(c, NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
@@ -323,24 +327,69 @@ class ShardedMgm2:
             jax.device_put(jnp.asarray(self.pair_dst),
                            NamedSharding(mesh, P())),
         )
-        return x, keys, consts
+
+    def _device_put(self, seeds: Sequence[int]):
+        x, keys = self._init_xk(seeds)
+        return x, keys, self._consts()
+
+    # ---------------------------------------------- mesh engine protocol
+
+    def mesh_init(self, seed: int = 0,
+                  seeds: Optional[Sequence[int]] = None):
+        x, keys = self._init_xk(self._seeds_for(seed, seeds))
+        return {"x": x, "keys": keys,
+                "cycle": jnp.int32(0),
+                # MGM-2 runs the full budget by design
+                "finished": jnp.bool_(False)}
+
+    def mesh_step(self, s):
+        x, keys = self._step(s["x"], s["keys"], *self._consts())
+        out = dict(s)
+        out.update(x=x, keys=keys, cycle=s["cycle"] + 1)
+        return out
+
+    def _build_cost_fn(self):
+        return build_mesh_cost(
+            self.mesh, self.V,
+            [(c, v, None) for _a, c, v in self.sharded_buckets],
+            self.var_costs, x_has_sink=False)
+
+    def _mesh_sel(self, state):
+        return state["x"]
+
+    # ------------------------------------------------------------- runs
 
     def run(self, n_cycles: int, seed: int = 0,
-            seeds: Optional[Sequence[int]] = None
+            seeds: Optional[Sequence[int]] = None,
+            collect_cost_every: Optional[int] = None,
+            chunk_size: Optional[int] = None,
+            timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run).  ``seeds`` gives
         each instance its own engine seed (default ``seed + i``); an
         instance's run is then bit-identical to a single-chip
-        ``SyncEngine(Mgm2Solver(...)).run(key=that_seed)``."""
-        if seeds is None:
-            seeds = [seed + i for i in range(self.B)]
-        if len(seeds) != self.B:
-            raise ValueError(
-                f"need {self.B} seeds, got {len(seeds)}")
-        x, keys, consts = self._device_put(seeds)
+        ``SyncEngine(Mgm2Solver(...)).run(key=that_seed)``.  Cycles
+        execute in compiled chunks on device."""
+        return self._drive_mesh(
+            self.mesh_init(seed, seeds), n_cycles,
+            collect_cost_every=collect_cost_every,
+            chunk_size=chunk_size, timeout=timeout)
+
+    def run_eager(self, n_cycles: int, seed: int = 0,
+                  seeds: Optional[Sequence[int]] = None
+                  ) -> Tuple[np.ndarray, int]:
+        """Pre-engine loop (one dispatch per cycle): the equivalence
+        oracle for the chunked engine and the A/B bench leg."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        x, keys, consts = self._device_put(
+            self._seeds_for(seed, seeds))
         for _ in range(n_cycles):
             x, keys = self._step(x, keys, *consts)
         self.finished = False  # runs the full budget by design
+        self.last_run_stats = self._eager_stats(n_cycles,
+                                                "MAX_CYCLES", t0)
         return np.asarray(jax.device_get(x)), n_cycles
 
     def step_once(self, seed: int = 0) -> np.ndarray:

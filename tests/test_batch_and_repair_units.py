@@ -17,6 +17,60 @@ from pydcop_tpu.reparation.removal import (build_repair_info,
 # ================================================================ batch
 
 
+def test_consolidated_out_streams_one_line_per_job(tmp_path):
+    """--consolidated-out: the fused runner streams {'job_id', ...}
+    jsonl lines instead of per-job JSON files (PERF_NOTES round 6's
+    explained tooling cost, now opt-in); the default per-job artifact
+    contract is untouched when the flag is absent."""
+    import glob
+    import json
+    import os
+
+    from pydcop_tpu.commands.batch import _append_jsonl, \
+        _run_fused_group
+
+    inst = tmp_path / "gc3.yaml"
+    inst.write_text("""
+name: gc3
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+constraints:
+  c12: {type: intention, function: 1 if v1 == v2 else 0}
+  c23: {type: intention, function: 1 if v2 == v3 else 0}
+agents: [a1, a2, a3]
+""")
+    out_dir = tmp_path / "out"
+    os.makedirs(out_dir)
+    done = []
+    key = ("dsa", (), 5, None)
+    rows = [(f"s1__b__gc3.yaml__algo=dsa__{i}", str(inst), i)
+            for i in range(3)]
+
+    jsonl = tmp_path / "results.jsonl"
+    _run_fused_group(key, rows, str(out_dir), done.append,
+                     consolidated_out=str(jsonl))
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(lines) == 3
+    assert {l["job_id"] for l in lines} == {r[0] for r in rows}
+    assert all("cost" in l and "status" in l for l in lines)
+    assert glob.glob(str(out_dir / "*.json")) == []  # no per-job files
+    assert sorted(done) == sorted(r[0] for r in rows)
+
+    # default contract unchanged: without the flag, per-job files
+    _run_fused_group(key, rows, str(out_dir), done.append)
+    assert len(glob.glob(str(out_dir / "*.json"))) == 3
+
+    # appends are one line each (fused child + subprocess pool both
+    # funnel through _append_jsonl)
+    _append_jsonl(str(jsonl), "extra", {"cost": 1})
+    assert len(jsonl.read_text().splitlines()) == 4
+
+
 def test_parameters_configuration_cartesian_product():
     confs = list(parameters_configuration(
         {"algo": ["dsa", "mgm"], "timeout": 5, "seed": [1, 2]}))

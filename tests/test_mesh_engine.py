@@ -1,0 +1,352 @@
+"""Bit-exactness guard for the chunked mesh sync engine (ISSUE 2).
+
+Every sharded solver family must produce IDENTICAL selections AND the
+identical ``cycles_run`` (SAME_COUNT firing on the same cycle) through
+the chunked on-device engine as through the eager one-dispatch-per-
+cycle loop it replaced — on coloring, PEAV/SECP and mixed-arity
+instances, on the virtual 8-device CPU mesh (the driver separately
+dry-runs real multichip).
+
+Marked ``mesh`` so a future chip lane can select these suites directly
+(`pytest -m mesh`); they stay in tier-1 because the virtual mesh runs
+them fast.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+from pydcop_tpu.generators.fast import (
+    coloring_factor_arrays,
+    coloring_hypergraph_arrays,
+    nary_factor_arrays,
+)
+from pydcop_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.mesh
+
+
+def _host_cost(arrays, x):
+    """Reference assignment cost from the UNPARTITIONED arrays."""
+    x = np.asarray(x)
+    total = float(np.sum(
+        np.asarray(arrays.var_costs)[np.arange(arrays.n_vars), x]))
+    for b in arrays.buckets:
+        vals = x[np.asarray(b.var_ids)]
+        cu = np.asarray(b.cubes)
+        total += float(np.sum(
+            cu[(np.arange(cu.shape[0]),) + tuple(vals.T)]))
+    return total
+
+
+# ------------------------------------------------- chunked == eager
+
+
+@pytest.mark.parametrize("layout", ["edge_major", "lane_major"])
+def test_chunked_matches_eager_maxsum(layout):
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    arrays = coloring_factor_arrays(30, 60, 3, seed=1, noise=0.05)
+    mesh = make_mesh(8)
+    sm = ShardedMaxSum(arrays, mesh, damping=0.5, stability=0.1,
+                       layout=layout, batch=4)
+    sel_e, cyc_e = sm.run_eager(40)
+    fin_e = sm.finished
+    sel_c, cyc_c = sm.run(40)
+    assert np.array_equal(sel_e, sel_c), layout
+    assert cyc_e == cyc_c
+    assert sm.finished == fin_e
+
+
+def test_chunked_converges_on_identical_cycle_any_chunk_size():
+    """SAME_COUNT fires on the SAME cycle whether or not it lands on a
+    chunk boundary (chunk 7 deliberately misaligned)."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    arrays = coloring_factor_arrays(16, 30, 3, seed=4, noise=0.05)
+    mesh = make_mesh(8)
+    sm = ShardedMaxSum(arrays, mesh, damping=0.5, stability=0.1,
+                       batch=4)
+    sel_e, cyc_e = sm.run_eager(200)
+    assert sm.finished and cyc_e < 200  # the rule actually fired
+    for chunk in (1, 7, 32):
+        sel_c, cyc_c = sm.run(200, chunk_size=chunk)
+        assert cyc_c == cyc_e, chunk
+        assert np.array_equal(sel_c, sel_e), chunk
+        assert sm.finished
+
+
+def test_chunked_matches_eager_fused_binary_and_nary():
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedFusedMaxSum
+
+    mesh = make_mesh(8)
+    binary = coloring_factor_arrays(24, 48, 3, seed=2, noise=0.05)
+    nary = nary_factor_arrays(40, {2: 60, 3: 20}, n_values=3, seed=5)
+    for arrays in (binary, nary):
+        sf = ShardedFusedMaxSum(arrays, mesh, damping=0.5,
+                                stability=0.1, batch=4)
+        sel_e, cyc_e = sf.run_eager(30)
+        sel_c, cyc_c = sf.run(30)
+        assert np.array_equal(sel_e, sel_c)
+        assert cyc_e == cyc_c
+
+
+def test_chunked_matches_eager_peav_and_secp():
+    """The reference's marquee n-ary families through the mesh engine:
+    PEAV meeting scheduling (k-ary event equalities) and SECP, fused
+    and lane layouts."""
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.generators.meetingscheduling import generate_meetings
+    from pydcop_tpu.generators.secp import generate_secp
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.sharded_maxsum import (ShardedFusedMaxSum,
+                                                    ShardedMaxSum)
+
+    mesh = make_mesh(8)
+    peav = filter_dcop(generate_meetings(
+        slots_count=4, events_count=6, resources_count=6,
+        max_resources_event=2, seed=13, nary_equalities=True))
+    secp = filter_dcop(generate_secp(
+        lights_count=5, models_count=3, rules_count=2, seed=7))
+    for dcop in (peav, secp):
+        arrays = FactorGraphArrays.build(dcop, arity_sorted=True)
+        for cls in (ShardedMaxSum, ShardedFusedMaxSum):
+            sm = cls(arrays, mesh, damping=0.5, stability=0.1,
+                     batch=4)
+            sel_e, cyc_e = sm.run_eager(25)
+            sel_c, cyc_c = sm.run(25)
+            assert np.array_equal(sel_e, sel_c), cls.__name__
+            assert cyc_e == cyc_c, cls.__name__
+
+
+def test_chunked_matches_eager_amaxsum():
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedAMaxSum
+
+    arrays = coloring_factor_arrays(20, 40, 3, seed=5, noise=0.05)
+    mesh = make_mesh(8)
+    am = ShardedAMaxSum(arrays, mesh, activation=0.7, batch=4)
+    sel_e, cyc_e = am.run_eager(30, seed=2)
+    sel_c, cyc_c = am.run(30, seed=2)
+    assert np.array_equal(sel_e, sel_c)
+    assert cyc_e == cyc_c
+
+
+def test_chunked_matches_eager_dsa_mgm_mgm2():
+    from pydcop_tpu.parallel.sharded_localsearch import (ShardedDsa,
+                                                         ShardedMgm)
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    arrays = coloring_hypergraph_arrays(24, 48, 3, seed=6)
+    mesh = make_mesh(8)
+    for solver in (ShardedDsa(arrays, mesh, batch=4),
+                   ShardedMgm(arrays, mesh, batch=4),
+                   ShardedMgm2(arrays, mesh, batch=8)):
+        sel_e, cyc_e = solver.run_eager(20, seed=3)
+        sel_c, cyc_c = solver.run(20, seed=3)
+        assert np.array_equal(sel_e, sel_c), type(solver).__name__
+        assert cyc_e == cyc_c
+
+
+def test_chunked_matches_eager_breakout_harness():
+    """The generic harness family, including DBA's own termination
+    rule evaluated on device (early stop on the identical cycle)."""
+    from pydcop_tpu.parallel.sharded_breakout import (ShardedDba,
+                                                      ShardedMixedDsa)
+
+    arrays = coloring_hypergraph_arrays(18, 30, 3, seed=8)
+    mesh = make_mesh(8)
+    for solver in (
+            ShardedDba(arrays, mesh, batch=8, max_distance=30,
+                       infinity=1000),
+            ShardedMixedDsa(arrays, mesh, batch=8)):
+        sel_e, cyc_e = solver.run_eager(40)
+        fin_e = solver.finished
+        sel_c, cyc_c = solver.run(40)
+        assert np.array_equal(sel_e, sel_c), type(solver).__name__
+        assert cyc_e == cyc_c
+        assert solver.finished == fin_e
+
+
+# --------------------------------------------------- engine contract
+
+
+def test_host_sync_contract_and_chunk_invariance():
+    """At most ceil(n/K) dispatches and ceil(n/K)+1 host syncs per
+    run, selections invariant to K."""
+    from pydcop_tpu.parallel.sharded_localsearch import ShardedDsa
+
+    arrays = coloring_hypergraph_arrays(20, 40, 3, seed=9)
+    mesh = make_mesh(8)
+    sd = ShardedDsa(arrays, mesh, batch=4)
+    n = 25
+    base = None
+    for k in (1, 8, 32):
+        sel, cycles = sd.run(n, seed=1, chunk_size=k)
+        assert cycles == n
+        stats = sd.last_run_stats
+        assert stats["dispatches"] <= math.ceil(n / k), k
+        assert stats["host_syncs"] <= math.ceil(n / k) + 1, k
+        if base is None:
+            base = sel
+        else:
+            assert np.array_equal(sel, base), k
+
+
+def test_device_constants_transferred_once():
+    """Cubes/slot tables/masks go to device once per solver instance,
+    not per run()/step_once()."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    arrays = coloring_factor_arrays(16, 30, 3, seed=4)
+    mesh = make_mesh(8)
+    sm = ShardedMaxSum(arrays, mesh, batch=4)
+    c1 = sm._consts()
+    sm.run(5)
+    sm.step_once()
+    assert sm._consts() is c1
+
+
+def test_factor_swap_invalidates_compiled_chunks():
+    """change_factor_function must drop the mesh engine's compiled
+    chunks too: they closure-capture the device cube constants at
+    trace time, so a chunked run() after the swap would otherwise
+    silently solve against the PRE-swap tables."""
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedDynamicMaxSum
+
+    src = """
+name: dyn
+objective: min
+domains:
+  b: {values: [0, 1]}
+variables:
+  x: {domain: b, cost_function: 0.3 * x}
+  y: {domain: b, cost_function: 0.1 * (1 - y)}
+constraints:
+  cxy: {type: intention, function: 5.0 if x != y else 0.0}
+agents: [a1, a2]
+"""
+    dcop = load_dcop(src)
+    arrays = FactorGraphArrays.build(dcop)
+    mesh = make_mesh(8)
+    sdm = ShardedDynamicMaxSum(arrays, mesh, damping=0.5,
+                               stability=0.0, batch=4)
+    sdm.start(seed=0)
+    sel, _ = sdm.run(10)                 # compiles the chunk
+    assert np.all(sel == 0), sel         # equality factor: (0, 0)
+
+    x, y = dcop.variable("x"), dcop.variable("y")
+    sdm.change_factor_function("cxy", NAryMatrixRelation(
+        [x, y], np.array([[5.0, 0.0], [0.0, 5.0]]), name="cxy"))
+    sel_c, _ = sdm.run(30)               # chunked, post-swap
+    assert np.all(sel_c[:, 0] == 0) and np.all(sel_c[:, 1] == 1), sel_c
+    sel_e, _ = sdm.run_eager(30)
+    assert np.array_equal(sel_c, sel_e)
+
+
+# -------------------------------------------------- anytime cost trace
+
+
+def test_cost_trace_on_device_no_extra_syncs():
+    """collect_cost_every fills last_cost_trace per cycle from the
+    on-device buffer; host-sync count is unchanged vs a traceless
+    run, and the final sample equals the host-recomputed cost of the
+    returned selections (best over batch)."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    arrays = coloring_factor_arrays(20, 40, 3, seed=3, noise=0.05)
+    mesh = make_mesh(8)
+    sm = ShardedMaxSum(arrays, mesh, damping=0.5, stability=0.0,
+                       batch=4)
+    n = 12
+    sel_plain, _ = sm.run(n, chunk_size=4)
+    syncs_plain = sm.last_run_stats["host_syncs"]
+    sel, cycles = sm.run(n, chunk_size=4, collect_cost_every=1)
+    assert np.array_equal(sel, sel_plain)
+    assert sm.last_run_stats["host_syncs"] == syncs_plain
+    trace = sm.last_cost_trace
+    assert [c for c, _ in trace] == list(range(1, n + 1))
+    best = min(_host_cost(arrays, row) for row in sel)
+    assert trace[-1][1] == pytest.approx(best, rel=1e-4, abs=1e-3)
+
+
+def test_cost_trace_subsampling_and_families():
+    """Every sharded family produces a populated trace; every k-th
+    cycle plus the final one is kept."""
+    from pydcop_tpu.parallel.sharded_breakout import ShardedDba
+    from pydcop_tpu.parallel.sharded_localsearch import ShardedMgm
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    arrays = coloring_hypergraph_arrays(18, 30, 3, seed=2)
+    mesh = make_mesh(8)
+    n = 10
+    for solver in (ShardedMgm(arrays, mesh, batch=4),
+                   ShardedMgm2(arrays, mesh, batch=8),
+                   ShardedDba(arrays, mesh, batch=8,
+                              max_distance=50, infinity=1000)):
+        sel, cycles = solver.run(n, collect_cost_every=4)
+        trace = solver.last_cost_trace
+        assert trace, type(solver).__name__
+        expect = sorted({c for c in range(4, cycles + 1, 4)}
+                        | {cycles})
+        assert [c for c, _ in trace] == expect
+        best = min(_host_cost(arrays, row) for row in sel)
+        assert trace[-1][1] == pytest.approx(best, rel=1e-4,
+                                             abs=1e-3)
+
+
+def test_mgm_trace_monotone_non_increasing():
+    """MGM is monotonic: the on-device anytime trace must be too (the
+    classic cost-trace sanity check from docs/analysing_results.md)."""
+    from pydcop_tpu.parallel.sharded_localsearch import ShardedMgm
+
+    arrays = coloring_hypergraph_arrays(24, 48, 3, seed=11)
+    mesh = make_mesh(8)
+    sm = ShardedMgm(arrays, mesh, batch=4)
+    sm.run(20, collect_cost_every=1)
+    costs = [c for _cyc, c in sm.last_cost_trace]
+    assert costs, "trace must be populated"
+    for earlier, later in zip(costs, costs[1:]):
+        assert later <= earlier + 1e-5
+
+
+def test_fused_trace_decodes_sorted_selection():
+    """The fused layout solves in degree-sorted order; the on-device
+    cost must evaluate the ORIGINAL-order selection (a permutation bug
+    would show as a wrong final cost)."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedFusedMaxSum
+
+    arrays = coloring_factor_arrays(20, 40, 3, seed=7, noise=0.05)
+    mesh = make_mesh(8)
+    sf = ShardedFusedMaxSum(arrays, mesh, damping=0.5, stability=0.0,
+                            batch=4)
+    sel, cycles = sf.run(8, collect_cost_every=1)
+    best = min(_host_cost(arrays, row) for row in sel)
+    assert sf.last_cost_trace[-1][1] == pytest.approx(
+        best, rel=1e-4, abs=1e-3)
+
+
+# ------------------------------------------------------ API plumbing
+
+
+def test_solve_sharded_result_populates_cost_trace():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.generators.fast import clique_dcop_yaml
+    from pydcop_tpu.parallel import solve_sharded_result
+
+    dcop = load_dcop(clique_dcop_yaml(5, 3))
+    for algo in ("maxsum", "dsa"):
+        res = solve_sharded_result(dcop, algo, n_cycles=12,
+                                   collect_cost_every=3)
+        assert res.cost_trace, algo
+        assert all(cyc % 3 == 0 or cyc == res.cycles
+                   for cyc, _c in res.cost_trace)
+        assert res.metrics["engine"] == "chunked"
+        assert res.metrics["dispatches"] <= math.ceil(12 / 32) + 1
+        assert res.status in ("FINISHED", "MAX_CYCLES")
+        assert set(res.assignment) == set(dcop.variables)

@@ -14,6 +14,10 @@ from pydcop_tpu.generators.fast import (
 )
 from pydcop_tpu.parallel import ShardedMaxSum, make_mesh
 
+# the sharded equivalence suite: fast on the virtual 8-device CPU
+# mesh, directly selectable by a chip lane with `pytest -m mesh`
+pytestmark = pytest.mark.mesh
+
 
 def conflicts(arrays, sel):
     b = arrays.buckets[0]
