@@ -508,6 +508,140 @@ batches:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_hetero_batch(quick=False):
+    """Heterogeneous campaign A/B (ISSUE 3 tentpole): ~256 mixed-size
+    coloring + Ising jobs through the campaign tooling, (a) one
+    subprocess per job (--no-fuse --parallel, measured on a subset and
+    reported as-is — the per-job cost is constant, dominated by CLI
+    startup + XLA retrace) vs (b) shape-bucketed fused
+    (--fuse-hetero): instances padded into the power-of-two ladder run
+    as <= #rungs compiled programs.
+
+    Contract asserted: programs <= rungs < #distinct topologies,
+    reported padding waste <= 2.0x total cells, and end-to-end
+    campaign inst/s beats the subprocess path.  Process-isolated legs;
+    numbers are host-CPU (XLA-CPU + subprocess startup on the same
+    silicon) per the round-4 protocol, not chip evidence."""
+    import os
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    iterations = 8 if quick else 32
+    sub_iterations = 1 if quick else 2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    work = tempfile.mkdtemp(prefix="pydcop_hetero_")
+    try:
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.generators.graphcoloring import \
+            generate_graph_coloring
+        from pydcop_tpu.generators.ising import generate_ising
+
+        # 8 distinct topologies: 6 soft colorings in two size bands
+        # (each band shares a pow2 rung) + 2 Ising grids
+        topo = 0
+        for nv in (20, 24, 28, 36, 44, 48):
+            # scale-free: deterministic edge count 2(n-2), so each
+            # size band lands on one pow2 rung by construction
+            dcop = generate_graph_coloring(
+                nv, 3, "scalefree", m_edge=2, soft=True, seed=nv)
+            with open(os.path.join(work, f"i{topo}.yaml"), "w") as f:
+                f.write(dcop_yaml(dcop))
+            topo += 1
+        for side in (4, 5):
+            with open(os.path.join(work, f"i{topo}.yaml"), "w") as f:
+                f.write(dcop_yaml(generate_ising(side, side,
+                                                 seed=side)))
+            topo += 1
+
+        def bench_yaml(path, its):
+            with open(path, "w") as f:
+                f.write(f"""
+sets:
+  s1:
+    path: '{work}/i*.yaml'
+    iterations: {its}
+batches:
+  campaign:
+    command: solve
+    command_options:
+      algo: [dsa]
+      max_cycles: 30
+""")
+
+        # fused leg: the whole campaign, one process
+        fused_yaml = os.path.join(work, "bench_fused.yaml")
+        bench_yaml(fused_yaml, iterations)
+        n_jobs = topo * iterations
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "batch",
+             fused_yaml, "--fuse-hetero",
+             "--dir", os.path.join(work, "out_fused")],
+            capture_output=True, text=True, timeout=1200, env=env,
+            cwd=repo)
+        fused_s = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"fused leg rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}")
+        m = re.search(r"\[fuse-hetero\] jobs=(\d+) programs=(\d+) "
+                      r"rungs=(\d+) waste=([\d.]+)", proc.stdout)
+        if not m:
+            raise RuntimeError("no [fuse-hetero] stats line "
+                               f"in: {proc.stdout[-300:]}")
+        jobs_f, programs, rungs, waste = (
+            int(m.group(1)), int(m.group(2)), int(m.group(3)),
+            float(m.group(4)))
+        contract_ok = (jobs_f == n_jobs and programs <= rungs
+                       and rungs < topo and waste <= 2.0)
+        if not contract_ok:
+            raise RuntimeError(
+                f"hetero contract violated: jobs={jobs_f}/{n_jobs} "
+                f"programs={programs} rungs={rungs} (topologies="
+                f"{topo}) waste={waste}")
+
+        # subprocess leg: same campaign shape, subset of iterations
+        # (per-job cost is constant: CLI startup + XLA retrace each)
+        sub_yaml = os.path.join(work, "bench_sub.yaml")
+        bench_yaml(sub_yaml, sub_iterations)
+        n_sub = topo * sub_iterations
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "batch",
+             sub_yaml, "--no-fuse", "--parallel", "8",
+             "--dir", os.path.join(work, "out_sub")],
+            capture_output=True, text=True, timeout=1200, env=env,
+            cwd=repo)
+        sub_s = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"subprocess leg rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}")
+        fused_ips = round(n_jobs / fused_s, 1)
+        sub_ips = round(n_sub / sub_s, 1)
+        if fused_ips <= sub_ips:
+            raise RuntimeError(
+                f"fused {fused_ips} inst/s did not beat subprocess "
+                f"{sub_ips} inst/s")
+        return {
+            "metric": f"hetero_batch_ab_{n_jobs}job_instances_per_sec",
+            "value": {"bucketed_fused": fused_ips,
+                      "subprocess_per_job": sub_ips},
+            "unit": "instances/s",
+            "speedup": round(fused_ips / sub_ips, 1),
+            "topologies": topo,
+            "compiled_programs": programs,
+            "ladder_rungs": rungs,
+            "padding_waste": waste,
+            "contract_ok": contract_ok,
+            "subprocess_jobs_measured": n_sub,
+            "hardware": "cpu-host",
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _nary_ab_one(solvers, n_edges, k=30):
     """msgs/s per named solver on the SAME instance, same-program
     best-of-3 each; adds fast-vs-generic speedups and a selections
@@ -623,7 +757,7 @@ BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_dpop_meetings, bench_localsearch_10k, bench_batched,
            bench_mixed_hard_constraints, bench_batched_localsearch,
            bench_batch_campaign_fused, bench_nary_fastpath,
-           bench_mesh_dispatch]
+           bench_mesh_dispatch, bench_hetero_batch]
 
 
 def main():

@@ -18,11 +18,21 @@ import numpy as np
 
 from ..engine.solver import ArraySolver
 from ..graphs.arrays import BIG, HypergraphArrays
-from ..ops.kernels import bucket_cost, candidate_costs
+from ..ops.kernels import bucket_cost, candidate_costs, prefix_uniform
 
 
 class LocalSearchSolver(ArraySolver):
     """Base: holds device arrays + the shared kernels."""
+
+    #: pad-stable RNG: draw per-variable uniforms with
+    #: ``ops.kernels.prefix_uniform`` (row i depends only on (key, i))
+    #: instead of one shape-coupled ``jax.random.uniform``.  Opted into
+    #: by the hetero-fusable algorithms (dsa, mgm) so a job solved
+    #: inside a shape-padded fused campaign batch reproduces its
+    #: unpadded subprocess solve bit-exactly; the rest of the family
+    #: (mgm2, dba, ...) keeps the historical draw order, which their
+    #: sharded replicas mirror key-for-key.
+    pad_stable_rng = False
 
     def __init__(self, arrays: HypergraphArrays, stop_cycle: int = 0):
         self.arrays = arrays
@@ -73,9 +83,22 @@ class LocalSearchSolver(ArraySolver):
             acc = acc + candidate_costs(cubes, var_ids, x, self.V)
         return self.var_costs + self._reduce_vplane(acc)
 
+    def uniform_v(self, key) -> jnp.ndarray:
+        """One uniform per variable — pad-stable when the algorithm
+        opted in (see :attr:`pad_stable_rng`)."""
+        if self.pad_stable_rng:
+            return prefix_uniform(key, self.V)
+        return jax.random.uniform(key, (self.V,))
+
+    def uniform_vd(self, key) -> jnp.ndarray:
+        """(V, D) uniforms, pad-stable per variable row when opted in."""
+        if self.pad_stable_rng:
+            return prefix_uniform(key, self.V, self.D)
+        return jax.random.uniform(key, (self.V, self.D))
+
     def random_values(self, key) -> jnp.ndarray:
         """Random initial value per variable (or the declared initial)."""
-        r = jax.random.uniform(key, (self.V,))
+        r = self.uniform_v(key)
         rand_idx = (r * self.domain_size).astype(jnp.int32)
         return jnp.where(self.has_initial, self.initial_idx, rand_idx)
 
@@ -136,7 +159,7 @@ class LocalSearchSolver(ArraySolver):
         not_cur = is_min & ~jax.nn.one_hot(x, self.D, dtype=bool)
         has_other = jnp.any(not_cur, axis=-1)
         pick_from = jnp.where(has_other[:, None], not_cur, is_min)
-        noise = jax.random.uniform(key, c.shape)
+        noise = self.uniform_vd(key)
         best_val = jnp.argmax(pick_from * (1.0 + noise), axis=-1)
         return costs, cur, best_cost, best_val
 

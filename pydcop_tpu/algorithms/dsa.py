@@ -36,22 +36,33 @@ algo_params = [
 ]
 
 
+def arity_probability(arrays: HypergraphArrays) -> np.ndarray:
+    """``p_mode=arity``'s per-variable activation threshold
+    ``1.2 / sum(arity - 1)`` over the variable's constraints
+    (dsa.py:256-263).  Module-level so the batched hetero-campaign
+    runner can re-derive each padded instance's own vector."""
+    n_count = np.zeros(arrays.n_vars, dtype=np.float64)
+    for b in arrays.buckets:
+        for p in range(b.arity):
+            np.add.at(n_count, b.var_ids[:, p], b.arity - 1)
+    with np.errstate(divide="ignore"):
+        prob = np.where(n_count > 0, 1.2 / n_count, 1.0)
+    return np.clip(prob, 0.0, 1.0).astype(np.float32)
+
+
 class DsaSolver(LocalSearchSolver):
+    # pad-stable per-variable draws: a shape-padded fused campaign row
+    # must reproduce its unpadded subprocess solve bit-exactly
+    pad_stable_rng = True
+
     def __init__(self, arrays: HypergraphArrays, probability: float = 0.7,
                  variant: str = "B", stop_cycle: int = 0,
                  p_mode: str = "fixed"):
         super().__init__(arrays, stop_cycle)
         self.variant = variant
+        self.p_mode = p_mode
         if p_mode == "arity":
-            # per-variable threshold 1.2 / sum(arity-1) (dsa.py:256-263)
-            n_count = np.zeros(arrays.n_vars, dtype=np.float64)
-            for b in arrays.buckets:
-                for p in range(b.arity):
-                    np.add.at(n_count, b.var_ids[:, p], b.arity - 1)
-            with np.errstate(divide="ignore"):
-                prob = np.where(n_count > 0, 1.2 / n_count, 1.0)
-            self.probability = jnp.asarray(
-                np.clip(prob, 0.0, 1.0), dtype=jnp.float32)
+            self.probability = jnp.asarray(arity_probability(arrays))
         else:
             self.probability = jnp.float32(probability)
 
@@ -79,7 +90,7 @@ class DsaSolver(LocalSearchSolver):
         else:  # C
             want = improve | equal
 
-        lucky = jax.random.uniform(k_prob, (self.V,)) < self.probability
+        lucky = self.uniform_v(k_prob) < self.probability
         change = want & lucky
         x_new = jnp.where(change, best_val, x)
         cycle = s["cycle"] + 1

@@ -28,6 +28,10 @@ algo_params = [
 
 
 class MgmSolver(LocalSearchSolver):
+    # pad-stable per-variable draws: a shape-padded fused campaign row
+    # must reproduce its unpadded subprocess solve bit-exactly
+    pad_stable_rng = True
+
     def __init__(self, arrays: HypergraphArrays,
                  break_mode: str = "lexic", stop_cycle: int = 0):
         super().__init__(arrays, stop_cycle)
@@ -52,7 +56,7 @@ class MgmSolver(LocalSearchSolver):
         gain = cur - best_cost  # >= 0
 
         if self.break_mode == "random":
-            priority = jax.random.uniform(k_pri, (self.V,))
+            priority = self.uniform_v(k_pri)
         else:
             priority = self.lexic_priority
         nbr_max = self.neighbor_max_gain(gain)

@@ -52,6 +52,19 @@ def set_parser(subparsers):
                         help="disable data-plane fusion (homogeneous "
                              "engine solve jobs normally run as ONE "
                              "vmapped program per topology group)")
+    parser.add_argument("--fuse-hetero", dest="fuse_hetero",
+                        action="store_true",
+                        help="also fuse jobs whose instances have "
+                             "DIFFERENT topologies: instances are "
+                             "padded into a small power-of-two ladder "
+                             "of shared shapes (phantom variables / "
+                             "factors, masked out of results) so a "
+                             "mixed campaign runs in <= #ladder-rungs "
+                             "compiled programs instead of one "
+                             "subprocess per job; selections stay "
+                             "bit-exact with the per-job solve and "
+                             "padding-waste / program-count stats "
+                             "land in the results")
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
@@ -186,13 +199,36 @@ _FUSE_CONF_KEYS = {"algo", "algo_params", "max_cycles", "mode",
 _SOLVE_MAX_CYCLES_DEFAULT = 2000
 
 
+def _fuse_exclusion_reason(meta) -> Optional[str]:
+    """Why a job cannot take the fused data plane, or None when it
+    can.  Surfaced by ``run_cmd`` (one log line per excluded group):
+    a per-job ``timeout``, a non-engine mode or an algo without a
+    vmapped solver used to take the subprocess path SILENTLY, hiding
+    from campaign authors why their run was slow."""
+    conf = meta["conf"]
+    if meta["command"] != "solve":
+        return f"command '{meta['command']}' is not solve"
+    if meta["path"] is None:
+        return "no instance file"
+    algo = conf.get("algo")
+    if algo not in FUSABLE_ALGOS:
+        return f"algo '{algo}' has no vmapped batch solver"
+    mode = conf.get("mode", "engine")
+    if mode != "engine":
+        return f"mode '{mode}' is not engine"
+    extra = sorted(set(conf) - _FUSE_CONF_KEYS)
+    if extra:
+        keys = ", ".join(f"'{k}'" for k in extra)
+        return (f"option(s) {keys} outside the fused path "
+                "(a single fused program cannot enforce per-job "
+                "settings)")
+    return None
+
+
 def _fuse_group_key(meta) -> Optional[Tuple]:
     conf = meta["conf"]
     algo = conf.get("algo")
-    if (meta["command"] != "solve" or meta["path"] is None
-            or algo not in FUSABLE_ALGOS
-            or conf.get("mode", "engine") != "engine"
-            or not set(conf) <= _FUSE_CONF_KEYS):
+    if _fuse_exclusion_reason(meta) is not None:
         return None
     ap = conf.get("algo_params", [])
     ap = tuple(sorted(ap if isinstance(ap, list) else [ap]))
@@ -242,18 +278,24 @@ def _append_jsonl(path: str, job_id: str, result: dict):
 
 
 def _run_fused_group(key, rows, out_dir, register_done,
-                     consolidated_out=None):
+                     consolidated_out=None, hetero=False):
     """Solve every (job_id, path, iteration) row of one group as a
-    single vmapped program; write the same per-job result JSON the
-    subprocess path produces, so resume files and ``consolidate`` CSVs
-    are indistinguishable (or one jsonl line per job when the campaign
-    opted into ``--consolidated-out``)."""
+    handful of vmapped programs — ONE per topology by default, or (with
+    ``hetero``) one per shape-bucket rung: distinct topologies are
+    padded to a shared power-of-two shape (graphs.arrays pad_to +
+    parallel/bucketing.py) and batched together, cost <= #rungs
+    compilations for the whole mixed group.  Writes the same per-job
+    result JSON the subprocess path produces, so resume files and
+    ``consolidate`` CSVs are indistinguishable (or one jsonl line per
+    job when the campaign opted into ``--consolidated-out``)."""
     import numpy as np
 
     from ..dcop.dcop import filter_dcop
     from ..dcop.yamldcop import load_dcop_from_file
     from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
-    from ..parallel.batch import BatchedDsa, BatchedMaxSum, BatchedMgm
+    from ..parallel.batch import (BatchedDsa, BatchedMaxSum, BatchedMgm,
+                                  runner_for_rung)
+    from ..parallel.bucketing import ShapeProfile, plan_rungs
     from . import build_algo_def, output_json, parse_algo_params
 
     algo, algo_params, max_cycles, conf_seed = key
@@ -279,24 +321,71 @@ def _run_fused_group(key, rows, out_dir, register_done,
     else:
         explicit_seed = None
 
+    # maxsum noise draws are shape-coupled, so a shape-padded run would
+    # not reproduce the per-job solve: noisy groups keep exact-topology
+    # fusion only (the bit-exactness guard rail comes first)
+    if float(params.get("noise", 0) or 0) != 0:
+        hetero = False
+
     dcops, arrays_of = {}, {}
     for _job, path, _it in rows:
         if path not in dcops:
             dcop = load_dcop_from_file(path)
             dcops[path] = dcop
             if FUSABLE_ALGOS[algo] == "factor":
-                arrays_of[path] = FactorGraphArrays.build(dcop)
+                # arity_sorted: the canonical factor-major edge layout
+                # pad_to re-emits, and the same build the solve CLI uses
+                arrays_of[path] = FactorGraphArrays.build(
+                    dcop, arity_sorted=True)
             else:
                 arrays_of[path] = HypergraphArrays.build(
                     filter_dcop(dcop))
 
-    # sub-group by topology: only same-shape instances share a program
+    # sub-group by topology: same-shape instances share a program as-is
     by_topo: Dict[Tuple, List] = {}
     for row in rows:
         sig = _topology_signature(arrays_of[row[1]])
         by_topo.setdefault(sig, []).append(row)
 
-    for sub in by_topo.values():
+    def emit(sub, sel_rows, cycles, finished, elapsed, extra_of, tag):
+        for i, (job_id, path, _it) in enumerate(sub):
+            dcop = dcops[path]
+            var_names = arrays_of[path].var_names
+            assignment = {
+                n: dcop.variable(n).domain.values[int(v)]
+                for n, v in zip(var_names, sel_rows[i])
+            }
+            cost, violations = dcop.solution_cost(assignment)
+            result = {
+                "status": ("FINISHED" if bool(finished[i])
+                           else "MAX_CYCLES"),
+                "assignment": assignment,
+                "cost": cost,
+                "violation": violations,
+                "cycle": int(cycles[i]),
+                # amortized: the whole sub-group ran as one program
+                "time": elapsed / len(sub),
+                "msg_count": 0,
+                "msg_size": 0,
+                "fused_batch": len(sub),
+            }
+            result.update(extra_of(path))
+            if consolidated_out:
+                _append_jsonl(consolidated_out, job_id, result)
+            else:
+                out_path = os.path.join(out_dir, f"{job_id}.json")
+                output_json(result, out_path, quiet=True)
+            register_done(job_id)
+            print(f"[ok] {job_id} ({tag} x{len(sub)}, "
+                  f"{elapsed:.1f}s total)")
+
+    def row_seeds(sub):
+        return [int(explicit_seed) if explicit_seed is not None
+                else it for _j, _p, it in sub]
+
+    def run_exact(sub, extra_of=lambda path: {}, tag="fused"):
+        """Same-topology sub-group: one vmapped program over stacked
+        (or broadcast) cost cubes, the pre-hetero fast path."""
         template = arrays_of[sub[0][1]]
         if len({path for _j, path, _it in sub}) == 1:
             # repeated iterations of ONE instance: the batched solvers
@@ -314,41 +403,67 @@ def _run_fused_group(key, rows, out_dir, register_done,
                "mgm": BatchedMgm}[algo]
         runner = cls(template, cubes_batches=cubes_batches,
                      batch=len(sub), **params)
-        seeds = [int(explicit_seed) if explicit_seed is not None
-                 else it for _j, _p, it in sub]
         t0 = time.perf_counter()
         sel, cycles, finished = runner.run(max_cycles=max_cycles,
-                                           seeds=seeds)
+                                           seeds=row_seeds(sub))
         elapsed = time.perf_counter() - t0
-        var_names = template.var_names
-        for i, (job_id, path, _it) in enumerate(sub):
-            dcop = dcops[path]
-            assignment = {
-                n: dcop.variable(n).domain.values[int(v)]
-                for n, v in zip(var_names, sel[i])
-            }
-            cost, violations = dcop.solution_cost(assignment)
-            result = {
-                "status": ("FINISHED" if bool(finished[i])
-                           else "MAX_CYCLES"),
-                "assignment": assignment,
-                "cost": cost,
-                "violation": violations,
-                "cycle": int(cycles[i]),
-                # amortized: the whole sub-group ran as one program
-                "time": elapsed / len(sub),
-                "msg_count": 0,
-                "msg_size": 0,
-                "fused_batch": len(sub),
-            }
-            if consolidated_out:
-                _append_jsonl(consolidated_out, job_id, result)
-            else:
-                out_path = os.path.join(out_dir, f"{job_id}.json")
-                output_json(result, out_path, quiet=True)
-            register_done(job_id)
-            print(f"[ok] {job_id} (fused x{len(sub)}, "
-                  f"{elapsed:.1f}s total)")
+        emit(sub, list(sel), cycles, finished, elapsed, extra_of, tag)
+
+    topo_groups = list(by_topo.values())
+    if not (hetero and len(topo_groups) > 1):
+        for sub in topo_groups:
+            run_exact(sub)
+        return
+
+    # ---- shape-bucketed hetero fusion: pad distinct topologies into a
+    # power-of-two ladder and run each rung as ONE vmapped program
+    templates = [arrays_of[sub[0][1]] for sub in topo_groups]
+    profiles = [ShapeProfile.of(t) for t in templates]
+    rungs = plan_rungs(profiles)
+    programs = 0
+    job_true = job_padded = 0
+    for ri, rung in enumerate(rungs):
+        if len(rung.members) == 1:
+            # a rung of one topology needs no padding at all
+            sub = topo_groups[rung.members[0]]
+            run_exact(sub,
+                      lambda path, ri=ri: {"fuse_rung": ri,
+                                           "padding_waste": 1.0})
+            programs += 1
+            job_true += profiles[rung.members[0]].cells * len(sub)
+            job_padded += profiles[rung.members[0]].cells * len(sub)
+            continue
+        padded_of = {}           # path -> padded arrays (shared by rows)
+        waste_of = {}
+        sub = []
+        for ti in rung.members:
+            grp = topo_groups[ti]
+            tpl = templates[ti]
+            padded = rung.pad(tpl)
+            for _j, path, _it in grp:
+                padded_of[path] = padded
+                waste_of[path] = round(rung.waste_for(profiles[ti]), 3)
+            sub.extend(grp)
+            job_true += profiles[ti].cells * len(grp)
+            job_padded += rung.cells * len(grp)
+        instances = [padded_of[path] for _j, path, _it in sub]
+        runner = runner_for_rung(algo, instances, params,
+                                 rung_signature=rung.signature)
+        t0 = time.perf_counter()
+        sel, cycles, finished = runner.run(max_cycles=max_cycles,
+                                           seeds=row_seeds(sub))
+        elapsed = time.perf_counter() - t0
+        # masked decode: phantom variables never reach the results
+        emit(sub, runner.decode(sel), cycles, finished, elapsed,
+             lambda path, ri=ri: {"fuse_rung": ri,
+                                  "padding_waste": waste_of[path]},
+             "fused-hetero")
+        programs += 1
+    # one parsable stats line per group: the bench_hetero_batch
+    # program-count contract reads it, campaign authors grep it
+    print(f"[fuse-hetero] jobs={len(rows)} programs={programs} "
+          f"rungs={len(rungs)} "
+          f"waste={job_padded / max(job_true, 1):.3f}")
 
 
 def _fused_child_main(argv=None) -> int:
@@ -370,7 +485,8 @@ def _fused_child_main(argv=None) -> int:
             f.write(job_id + "\n")
 
     _run_fused_group(key, rows, spec["out_dir"], register_done,
-                     consolidated_out=spec.get("consolidated_out"))
+                     consolidated_out=spec.get("consolidated_out"),
+                     hetero=spec.get("hetero", False))
     return 0
 
 
@@ -405,11 +521,29 @@ def run_cmd(args, timeout=None):
     # else the subprocess path is simpler and equally fast)
     fused_groups: Dict[Tuple, List] = {}
     if getattr(args, "fuse", True):
+        fallbacks: Dict[Tuple, int] = {}
         for job_id, _argv, meta in todo:
             fkey = _fuse_group_key(meta)
             if fkey is not None:
                 fused_groups.setdefault(fkey, []).append(
                     (job_id, meta["path"], meta["iteration"]))
+            else:
+                reason = _fuse_exclusion_reason(meta)
+                k = (reason, meta["conf"].get("algo"),
+                     meta["conf"].get("mode", "engine"))
+                fallbacks[k] = fallbacks.get(k, 0) + 1
+        # name WHY each excluded group takes the subprocess path — a
+        # silently-degraded campaign (e.g. one per-job `timeout` key)
+        # used to be indistinguishable from a fused one
+        for (reason, f_algo, f_mode), n in sorted(fallbacks.items()):
+            print(f"[fuse fallback] {n} job(s) (algo={f_algo}, "
+                  f"mode={f_mode}): {reason}")
+    singletons = sum(1 for v in fused_groups.values() if len(v) < 2)
+    if singletons:
+        # these ARE fusable but alone in their group: say so instead
+        # of silently handing them to the subprocess pool
+        print(f"[fuse fallback] {singletons} job(s): group of one "
+              "(fusion needs >= 2 jobs sharing command options)")
     fused_groups = {k: v for k, v in fused_groups.items()
                     if len(v) >= 2}
     fused_ids = {job_id for rows in fused_groups.values()
@@ -426,6 +560,7 @@ def run_cmd(args, timeout=None):
                                                     for r in rows],
                         "out_dir": args.out_dir,
                         "progress_path": progress_path,
+                        "hetero": getattr(args, "fuse_hetero", False),
                         "consolidated_out": getattr(
                             args, "consolidated_out", None)}, f)
         failure = None

@@ -47,6 +47,57 @@ def _padded_cube(constraint: Constraint, max_domain: int,
     return np.pad(cube, pads, constant_values=BIG)
 
 
+def _pad_var_plane(arrays, n_vars: int):
+    """Shared variable-plane padding for ``pad_to``: phantom variables
+    occupy rows ``[arrays.n_vars, n_vars)`` with a single valid domain
+    slot of cost 0, so they can never influence a reduction over real
+    variables and always select index 0.  Returns the padded
+    ``(var_names, domain_size, domain_mask, var_costs, var_valid)``."""
+    V, D = arrays.n_vars, arrays.max_domain
+    pad = n_vars - V
+    var_names = list(arrays.var_names) + [f"__pad{i}" for i in range(pad)]
+    domain_size = np.concatenate(
+        [arrays.domain_size, np.ones(pad, dtype=np.int32)])
+    pad_mask = np.zeros((pad, D), dtype=bool)
+    pad_mask[:, 0] = True
+    domain_mask = np.concatenate([arrays.domain_mask, pad_mask])
+    pad_costs = np.full((pad, D), BIG, dtype=np.float32)
+    pad_costs[:, 0] = 0.0
+    var_costs = np.concatenate([arrays.var_costs, pad_costs])
+    var_valid = np.arange(n_vars) < V
+    return var_names, domain_size, domain_mask, var_costs, var_valid
+
+
+def _phantom_cube(arity: int, max_domain: int) -> np.ndarray:
+    """The phantom factor's identity cost cube: 0 at the all-zero
+    assignment (the only valid assignment of phantom variables, whose
+    domains are the single slot 0) and BIG elsewhere — the same padded
+    form a real domain-1 constraint compiles to."""
+    cube = np.full((max_domain,) * arity, BIG, dtype=np.float32)
+    cube[(0,) * arity] = 0.0
+    return cube
+
+
+def _check_pad_targets(arrays, n_vars: int, bucket_slots):
+    counts = {b.arity: len(b.cons_ids) if hasattr(b, "cons_ids")
+              else len(b.factor_ids) for b in arrays.buckets}
+    if n_vars < arrays.n_vars:
+        raise ValueError(
+            f"pad_to target n_vars={n_vars} below instance "
+            f"n_vars={arrays.n_vars}")
+    for arity, have in counts.items():
+        if bucket_slots.get(arity, 0) < have:
+            raise ValueError(
+                f"pad_to target {bucket_slots.get(arity, 0)} slots for "
+                f"arity {arity} below instance count {have}")
+    needs_phantom = any(
+        bucket_slots[a] > counts.get(a, 0) for a in bucket_slots)
+    if needs_phantom and n_vars == arrays.n_vars:
+        raise ValueError(
+            "padding in phantom factors needs at least one phantom "
+            "variable to anchor them: pass n_vars > instance n_vars")
+
+
 def _bind_externals(dcop: Optional[DCOP], constraints: list) -> list:
     """External (sensor) variables are not decision variables: fix them at
     their current value by slicing the constraints at compile time.  The
@@ -132,6 +183,10 @@ class FactorGraphArrays:
     edge_var: np.ndarray             # (E,)
     edge_factor: np.ndarray          # (E,)
     buckets: List[FactorBucket] = field(default_factory=list)
+    # set by pad_to: the instance's true variable count and a (V,) bool
+    # mask of real (non-phantom) variable rows
+    n_vars_true: Optional[int] = None
+    var_valid: Optional[np.ndarray] = None
 
     @classmethod
     def build(cls, dcop: DCOP,
@@ -211,6 +266,80 @@ class FactorGraphArrays:
             for v, i in zip(variables, idx)
         }
 
+    def pad_to(self, n_vars: int,
+               bucket_slots: Dict[int, int]) -> "FactorGraphArrays":
+        """Pad this instance to a canonical shared shape so instances
+        with different V/E/arity profiles fuse into ONE vmapped program
+        (parallel/bucketing.py picks the targets).
+
+        Phantom variables (rows ``[self.n_vars, n_vars)``) have a single
+        valid domain slot of cost 0 and are masked out of every
+        selection and cost; phantom factors carry the identity cost
+        cube of that slot and anchor ALL their positions on the last
+        phantom variable, so no phantom quantity ever reaches a real
+        variable's messages, beliefs, or convergence delta.  Edges are
+        renumbered into the canonical factor-major layout over the
+        padded buckets (real factors keep their relative order inside
+        each arity bucket), so every instance padded to the same
+        targets shares one index structure and the fast slice/reshape
+        paths stay available.  The result records ``n_vars_true`` and a
+        ``var_valid`` mask for the masked decode."""
+        _check_pad_targets(self, n_vars, bucket_slots)
+        D = self.max_domain
+        var_names, domain_size, domain_mask, var_costs, var_valid = \
+            _pad_var_plane(self, n_vars)
+        sink = n_vars - 1
+
+        by_arity = {b.cubes.ndim - 1: b for b in self.buckets}
+        factor_names: List[str] = []
+        buckets, edge_var, edge_factor = [], [], []
+        n_factors = 0
+        for arity in sorted(bucket_slots):
+            slots = bucket_slots[arity]
+            if slots == 0:
+                continue
+            b = by_arity.get(arity)
+            have = len(b.factor_ids) if b is not None else 0
+            pad = slots - have
+            cubes = [np.asarray(b.cubes)] if b is not None else []
+            v_ids = [np.asarray(b.var_ids)] if b is not None else []
+            if b is not None:
+                factor_names += [self.factor_names[f]
+                                 for f in b.factor_ids]
+            if pad:
+                cubes.append(np.broadcast_to(
+                    _phantom_cube(arity, D), (pad,) + (D,) * arity))
+                v_ids.append(np.full((pad, arity), sink,
+                                     dtype=np.int32))
+                factor_names += [f"__padf{arity}_{i}"
+                                 for i in range(pad)]
+            cubes = np.concatenate(cubes) if len(cubes) > 1 \
+                else cubes[0]
+            v_ids = np.concatenate(v_ids) if len(v_ids) > 1 \
+                else v_ids[0]
+            f_ids = n_factors + np.arange(slots, dtype=np.int32)
+            e_ids = (len(edge_var)
+                     + np.arange(slots * arity, dtype=np.int32)
+                     .reshape(slots, arity)) if arity else \
+                np.zeros((slots, 0), dtype=np.int32)
+            edge_var.extend(v_ids.reshape(-1).tolist())
+            edge_factor.extend(np.repeat(f_ids, arity).tolist())
+            n_factors += slots
+            buckets.append(FactorBucket(
+                arity, f_ids, np.ascontiguousarray(cubes), e_ids,
+                np.ascontiguousarray(v_ids)))
+
+        return FactorGraphArrays(
+            n_vars=n_vars, n_factors=n_factors, n_edges=len(edge_var),
+            max_domain=D, sign=self.sign, var_names=var_names,
+            factor_names=factor_names, domain_size=domain_size,
+            domain_mask=domain_mask, var_costs=var_costs,
+            edge_var=np.array(edge_var, dtype=np.int32),
+            edge_factor=np.array(edge_factor, dtype=np.int32),
+            buckets=buckets,
+            n_vars_true=self.n_vars, var_valid=var_valid,
+        )
+
 
 @dataclass
 class ConstraintBucket:
@@ -243,6 +372,10 @@ class HypergraphArrays:
     nbr_dst: np.ndarray = None       # (P,)
     max_degree: int = 0              # max #neighbors of any variable
     max_arity_minus_one: int = 0     # for DSA p_mode thresholds
+    # set by pad_to: the instance's true variable count and a (V,) bool
+    # mask of real (non-phantom) variable rows
+    n_vars_true: Optional[int] = None
+    var_valid: Optional[np.ndarray] = None
 
     @classmethod
     def build(cls, dcop: DCOP,
@@ -318,6 +451,96 @@ class HypergraphArrays:
             nbr_dst=np.array(dst, dtype=np.int32),
             max_degree=int(degree.max()) if V else 0,
             max_arity_minus_one=max(0, max_arity - 1),
+        )
+
+    def pad_to(self, n_vars: int, bucket_slots: Dict[int, int],
+               n_pairs: Optional[int] = None) -> "HypergraphArrays":
+        """Hypergraph twin of :meth:`FactorGraphArrays.pad_to`: pad to
+        the shared shape a bucket rung prescribes.  Phantom variables
+        carry a declared initial value of slot 0 (their only valid
+        slot), phantom constraints anchor every position on the last
+        phantom variable with the identity cost cube (optimum == cost
+        == 0, so they never read as violated), and the neighbor-pair
+        edge list is padded with inert ``(sink, sink)`` pairs to
+        ``n_pairs`` so gain-exchange reductions keep one static shape
+        per rung."""
+        _check_pad_targets(self, n_vars, bucket_slots)
+        D = self.max_domain
+        var_names, domain_size, domain_mask, var_costs, var_valid = \
+            _pad_var_plane(self, n_vars)
+        pad_v = n_vars - self.n_vars
+        initial_idx = np.concatenate(
+            [self.initial_idx, np.zeros(pad_v, dtype=np.int32)])
+        has_initial = np.concatenate(
+            [self.has_initial, np.ones(pad_v, dtype=bool)])
+        sink = n_vars - 1
+
+        by_arity = {b.cubes.ndim - 1: b for b in self.buckets}
+        buckets = []
+        n_cons = 0
+        for arity in sorted(bucket_slots):
+            slots = bucket_slots[arity]
+            if slots == 0:
+                continue
+            b = by_arity.get(arity)
+            have = len(b.cons_ids) if b is not None else 0
+            pad = slots - have
+            cubes = [np.asarray(b.cubes)] if b is not None else []
+            v_ids = [np.asarray(b.var_ids)] if b is not None else []
+            if pad:
+                cubes.append(np.broadcast_to(
+                    _phantom_cube(arity, D), (pad,) + (D,) * arity))
+                v_ids.append(np.full((pad, arity), sink,
+                                     dtype=np.int32))
+            cubes = np.concatenate(cubes) if len(cubes) > 1 \
+                else cubes[0]
+            v_ids = np.concatenate(v_ids) if len(v_ids) > 1 \
+                else v_ids[0]
+            buckets.append(ConstraintBucket(
+                arity,
+                n_cons + np.arange(slots, dtype=np.int32),
+                np.ascontiguousarray(cubes),
+                np.ascontiguousarray(v_ids)))
+            n_cons += slots
+
+        P = len(self.nbr_src)
+        if n_pairs is None:
+            n_pairs = P
+        if n_pairs < P:
+            raise ValueError(
+                f"pad_to target n_pairs={n_pairs} below instance "
+                f"pair count {P}")
+        if n_pairs > P and n_vars == self.n_vars:
+            # padding pairs must self-loop on a PHANTOM sink: anchored
+            # on a real variable they would feed that variable's own
+            # gain/priority back into its neighbor-max and freeze it
+            raise ValueError(
+                "padding in neighbor pairs needs a phantom sink "
+                "variable to anchor them: pass n_vars > instance "
+                "n_vars")
+        pad_p = n_pairs - P
+        nbr_src = np.concatenate(
+            [self.nbr_src,
+             np.full(pad_p, sink, dtype=np.int32)])
+        nbr_dst = np.concatenate(
+            [self.nbr_dst,
+             np.full(pad_p, sink, dtype=np.int32)])
+        degree = np.bincount(nbr_src, minlength=n_vars) \
+            if len(nbr_src) else np.zeros(n_vars, dtype=np.int64)
+
+        return HypergraphArrays(
+            n_vars=n_vars, n_constraints=n_cons, max_domain=D,
+            sign=self.sign, var_names=var_names,
+            domain_size=domain_size, domain_mask=domain_mask,
+            var_costs=var_costs, initial_idx=initial_idx,
+            has_initial=has_initial, buckets=buckets,
+            nbr_src=nbr_src, nbr_dst=nbr_dst,
+            max_degree=int(degree.max()) if n_vars else 0,
+            max_arity_minus_one=max(
+                self.max_arity_minus_one,
+                max((a - 1 for a in bucket_slots if bucket_slots[a]),
+                    default=0)),
+            n_vars_true=self.n_vars, var_valid=var_valid,
         )
 
 

@@ -14,7 +14,7 @@ These replace the reference's per-message Python hot loops (SURVEY.md §3.3):
 All shapes are static per arity bucket; everything here is jit-traceable.
 """
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -111,6 +111,22 @@ def masked_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def masked_min(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.min(jnp.where(mask, costs, BIG * 2), axis=-1)
+
+
+def prefix_uniform(key: jax.Array, n: int,
+                   width: Optional[int] = None) -> jnp.ndarray:
+    """Per-row uniform draws that are PREFIX-STABLE in ``n``: row ``i``
+    depends only on ``(key, i)``, so padding ``n`` upward (phantom
+    variables appended by ``graphs.arrays.*.pad_to``) draws fresh tail
+    rows without disturbing the first ``n`` — unlike
+    ``jax.random.uniform(key, (n,))``, whose threefry counter layout
+    couples every element to the total shape.  This is what lets a
+    shape-padded fused campaign job reproduce its unpadded subprocess
+    solve bit-exactly.  Returns ``(n,)`` or ``(n, width)``."""
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.arange(n))
+    shape = () if width is None else (width,)
+    return jax.vmap(lambda k: jax.random.uniform(k, shape))(keys)
 
 
 def random_argmin(key: jax.Array, costs: jnp.ndarray,

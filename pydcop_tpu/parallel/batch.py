@@ -2,12 +2,24 @@
 
 reference parity: ``pydcop batch`` runs jobs *sequentially* (the reference
 acknowledges "run in parallel" as a TODO, commands/batch.py:68).  Here a
-batch of instances sharing a topology (e.g. 1024 random graph-coloring /
-Ising draws — BASELINE config 5) is one vmapped solver whose batch axis
-can additionally be sharded over the mesh's dp axis.
+batch of instances is one vmapped solver whose batch axis can
+additionally be sharded over the mesh's dp axis.  Two fusion regimes:
+
+* **same topology** (BASELINE config 5: 1024 random coloring / Ising
+  draws of one graph): only the cost cubes ride the batch axis, all
+  index tables come from the shared template;
+* **heterogeneous, shape-bucketed** (``instances=[...]``): instances
+  padded to one rung shape by ``graphs.arrays.*.pad_to`` batch their
+  whole topology — cubes AND the edge/var index tables, variable
+  planes and neighbor-pair lists — so a mixed campaign runs in
+  ≤ #rungs compiled programs (``parallel/bucketing.py`` plans the
+  rungs).  Selections stay bit-exact with each instance's unpadded
+  solve (phantom rows are inert by construction; dsa/mgm draw
+  pad-stable per-variable randomness, see ``ops.kernels.prefix_uniform``),
+  and :meth:`decode` masks phantom variables out of the result.
 """
 
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,59 +37,62 @@ def _batch_keys(seed, seeds, b):
     return jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
 
 
-class BatchedMaxSum:
-    """vmap MaxSum over stacked per-instance cost cubes (same topology)."""
+def _stacked(instances, pick) -> jnp.ndarray:
+    return jnp.asarray(np.stack([np.asarray(pick(a))
+                                 for a in instances]))
 
-    def __init__(self, template: FactorGraphArrays,
-                 cubes_batches: Optional[List[np.ndarray]] = None,
-                 batch: int = 1, **params):
-        self.solver = MaxSumSolver(template, **params)
-        if cubes_batches is not None:
-            batch = cubes_batches[0].shape[0]
-            self.solver_buckets_batched = [
-                jnp.asarray(cb) for cb in cubes_batches
-            ]
-        else:
-            self.solver_buckets_batched = [
-                jnp.broadcast_to(cubes[None],
-                                 (batch,) + cubes.shape)
-                for cubes, _, _ in self.solver.buckets
-            ]
-        self.B = batch
 
-        base = self.solver
+def _check_same_shape(instances):
+    shapes = {
+        (a.n_vars, a.max_domain,
+         tuple((b.cubes.ndim - 1, b.cubes.shape[0])
+               for b in a.buckets),
+         len(a.nbr_src) if hasattr(a, "nbr_src") else 0)
+        for a in instances}
+    if len(shapes) != 1:
+        raise ValueError(
+            "heterogeneous instances must be padded to ONE shared "
+            f"shape first (graphs.arrays pad_to); got {len(shapes)} "
+            "distinct shapes")
 
-        def one_instance(cubes_list, key):
-            # swap the solver's cubes for this instance's
-            orig = base.buckets
-            base.buckets = [
-                (c, ei, vi)
-                for c, (_, ei, vi) in zip(cubes_list, orig)
-            ]
-            state = base.init_state(key)
-            try:
-                def body(s):
-                    return base.step(s)
 
-                def cond(s):
-                    return jnp.logical_and(
-                        jnp.logical_not(s["finished"]),
-                        s["cycle"] < self.max_cycles)
+class _BatchedRunnerBase:
+    """Shared runner body for every batched family: the per-max_cycles
+    compiled-program cache, the ``lax.while_loop`` drive, seed/key
+    handling and the masked decode.  Subclasses set ``self._one``
+    (instance args + key -> (selection, cycle, finished)),
+    ``self._instance_args``, ``self.B`` and ``self.n_vars_true``."""
 
-                final = jax.lax.while_loop(cond, body, state)
-            finally:
-                base.buckets = orig
-            # decode through assignment_indices, NOT the raw selection
-            # field: with stability:0 the step elides the per-cycle
-            # argmin and carries the INIT-state selection — the live
-            # assignment must be rebuilt from the final messages, the
-            # same decode the sync engine uses
-            return (base.assignment_indices(final), final["cycle"],
-                    final["finished"])
-
-        self._one = one_instance
+    def __init__(self):
         self.max_cycles = 200
-        self._jitted = {}  # max_cycles -> compiled vmapped runner
+        self._jitted: Dict[int, object] = {}
+        self.n_vars_true: Optional[List[int]] = None
+
+    def _drive(self, base, state):
+        """The shared convergence loop: step until the solver reports
+        finished or the cycle budget runs out.  ``max_cycles`` is baked
+        into the trace via the closure, hence the per-value cache."""
+        def cond(s):
+            return jnp.logical_and(
+                jnp.logical_not(s["finished"]),
+                s["cycle"] < self.max_cycles)
+
+        return jax.lax.while_loop(cond, base.step, state)
+
+    def set_instances(self, instances) -> None:
+        """Re-point the runner at a new instance set of the SAME
+        padded shape: the instance arrays are program *arguments*, so
+        the compiled vmapped programs in the trace cache are reused
+        as-is (this is what makes the rung-signature runner cache pay
+        for in-process callers that revisit a rung)."""
+        if len(instances) != self.B:
+            raise ValueError(
+                f"runner compiled for batch {self.B}, "
+                f"got {len(instances)} instances")
+        _check_same_shape([self._template] + list(instances))
+        self._instance_args = self._build_args(instances)
+        self.n_vars_true = [a.n_vars_true or a.n_vars
+                            for a in instances]
 
     def run(self, seed: int = 0, max_cycles: int = 200, seeds=None):
         """Returns (selections (B, V), cycles (B,), finished (B,)).
@@ -86,84 +101,253 @@ class BatchedMaxSum:
         split-key stream of ``seed``."""
         self.max_cycles = max_cycles
         keys = _batch_keys(seed, seeds, self.B)
-        # max_cycles is baked into the traced while-loop via the closure,
-        # so the compiled runner is cached per max_cycles value
         run_all = self._jitted.get(max_cycles)
         if run_all is None:
             run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
             self._jitted[max_cycles] = run_all
-        sel, cycles, finished = run_all(self.solver_buckets_batched, keys)
-        return (np.asarray(sel), np.asarray(cycles), np.asarray(finished))
+        sel, cycles, finished = run_all(self._instance_args, keys)
+        return (np.asarray(sel), np.asarray(cycles),
+                np.asarray(finished))
+
+    def decode(self, sel: np.ndarray) -> List[np.ndarray]:
+        """Masked decode: each row sliced to its instance's true
+        variable count, so phantom variables never leak into
+        selections."""
+        if self.n_vars_true is None:
+            return [sel[i] for i in range(self.B)]
+        return [sel[i, :n] for i, n in enumerate(self.n_vars_true)]
 
 
-class _BatchedLocalSearch:
+_MISSING = object()
+
+
+def _swap_dev(base, updates):
+    """Swap device-constant cache entries of a lazy-constants solver
+    (MaxSumSolver) for one vmapped instance's arrays; returns what to
+    restore."""
+    saved = {k: base._dev_cache.get(k, _MISSING) for k in updates}
+    base._dev_cache.update(updates)
+    return saved
+
+
+def _restore_dev(base, saved):
+    for k, v in saved.items():
+        if v is _MISSING:
+            base._dev_cache.pop(k, None)
+        else:
+            base._dev_cache[k] = v
+
+
+class BatchedMaxSum(_BatchedRunnerBase):
+    """vmap MaxSum over stacked per-instance arrays: cost cubes only
+    (same-topology fusion) or the full padded topology
+    (``instances=[...]``, shape-bucketed hetero fusion)."""
+
+    def __init__(self, template: FactorGraphArrays,
+                 cubes_batches: Optional[List[np.ndarray]] = None,
+                 batch: int = 1,
+                 instances: Optional[List[FactorGraphArrays]] = None,
+                 **params):
+        super().__init__()
+        self.solver = MaxSumSolver(template, **params)
+        self._template = template
+        self._hetero = instances is not None
+        if self._hetero:
+            if self.solver._canonical is None:
+                raise ValueError(
+                    "hetero batching needs the canonical factor-major "
+                    "edge layout (pad_to emits it; build source arrays "
+                    "with arity_sorted=True)")
+            batch = len(instances)
+            self._instance_args = self._build_args(instances)
+            self.n_vars_true = [a.n_vars_true or a.n_vars
+                                for a in instances]
+        elif cubes_batches is not None:
+            batch = cubes_batches[0].shape[0]
+            self._instance_args = {
+                "cubes": [jnp.asarray(cb) for cb in cubes_batches]}
+        else:
+            self._instance_args = {"cubes": [
+                jnp.broadcast_to(cubes[None], (batch,) + cubes.shape)
+                for cubes, _, _ in self.solver.buckets
+            ]}
+        self.B = batch
+
+        base = self.solver
+        hetero = self._hetero
+
+        def one_instance(args, key):
+            # swap the template solver's device constants for this
+            # instance's; the per-instance arrays are vmapped ARGUMENTS,
+            # so one compiled program serves any instance set of the
+            # same shape
+            orig = base.buckets
+            updates = {"buckets": [
+                (c, ei, args["var_ids"][bi] if hetero else vi)
+                for bi, (c, (_, ei, vi))
+                in enumerate(zip(args["cubes"], orig))
+            ]}
+            if hetero:
+                updates.update(
+                    var_costs=args["var_costs"],
+                    domain_mask=args["domain_mask"],
+                    domain_size=args["domain_size"],
+                    edge_var=args["edge_var"],
+                )
+            saved = _swap_dev(base, updates)
+            try:
+                final = self._drive(base, base.init_state(key))
+                # decode through assignment_indices, NOT the raw
+                # selection field: with stability:0 the step elides the
+                # per-cycle argmin and carries the INIT-state selection
+                # — the live assignment must be rebuilt from the final
+                # messages, the same decode the sync engine uses
+                sel = base.assignment_indices(final)
+            finally:
+                _restore_dev(base, saved)
+            return sel, final["cycle"], final["finished"]
+
+        self._one = one_instance
+
+    def _build_args(self, instances):
+        _check_same_shape(instances)
+        nb = len(instances[0].buckets)
+        return {
+            "cubes": [_stacked(instances, lambda a, i=i:
+                               a.buckets[i].cubes)
+                      for i in range(nb)],
+            "var_ids": [_stacked(instances, lambda a, i=i:
+                                 a.buckets[i].var_ids)
+                        for i in range(nb)],
+            "edge_var": _stacked(instances, lambda a: a.edge_var),
+            "var_costs": _stacked(instances, lambda a: a.var_costs),
+            "domain_mask": _stacked(instances, lambda a: a.domain_mask),
+            "domain_size": _stacked(instances, lambda a: a.domain_size),
+        }
+
+    @property
+    def solver_buckets_batched(self):
+        """The batched per-bucket cube stacks (callers re-shard them
+        onto a device mesh before run, e.g. __graft_entry__)."""
+        return self._instance_args["cubes"]
+
+    @solver_buckets_batched.setter
+    def solver_buckets_batched(self, value):
+        self._instance_args = dict(self._instance_args,
+                                   cubes=list(value))
+
+
+class _BatchedLocalSearch(_BatchedRunnerBase):
     """vmap a local-search solver over stacked per-instance constraint
-    cubes sharing one topology — the campaign workload of BASELINE
-    config 5 (1024 random Ising / coloring draws) for the DSA/MGM
-    family, companion of :class:`BatchedMaxSum`."""
+    cubes sharing one topology — or, with ``instances=[...]``, over
+    whole shape-padded topologies — the campaign workload of BASELINE
+    config 5 for the DSA/MGM family, companion of
+    :class:`BatchedMaxSum`."""
 
     solver_cls = None  # set by subclasses
 
+    #: plain solver attributes swapped per instance on the hetero path
+    _swap_attrs = ("var_costs", "domain_mask", "domain_size",
+                   "initial_idx", "has_initial", "nbr_src", "nbr_dst")
+
     def __init__(self, template: HypergraphArrays,
                  cubes_batches: Optional[List[np.ndarray]] = None,
-                 batch: int = 1, **params):
+                 batch: int = 1,
+                 instances: Optional[List[HypergraphArrays]] = None,
+                 **params):
+        super().__init__()
         self.solver = self.solver_cls(template, **params)
-        if cubes_batches is not None:
+        self._template = template
+        self._hetero = instances is not None
+        # p_mode=arity derives a per-variable probability vector from
+        # the topology: on the hetero path each instance batches its
+        # own (phantom rows land on 1.0, which is inert — they never
+        # satisfy `want`)
+        self._swap_probability = self._hetero and \
+            getattr(self.solver, "p_mode", "fixed") == "arity"
+        if self._hetero:
+            batch = len(instances)
+            self._instance_args = self._build_args(instances)
+            self.n_vars_true = [a.n_vars_true or a.n_vars
+                                for a in instances]
+        elif cubes_batches is not None:
             batch = cubes_batches[0].shape[0]
-            self.cubes_batched = [jnp.asarray(cb)
-                                  for cb in cubes_batches]
+            self._instance_args = {
+                "cubes": [jnp.asarray(cb) for cb in cubes_batches]}
         else:
-            self.cubes_batched = [
+            self._instance_args = {"cubes": [
                 jnp.broadcast_to(cubes[None], (batch,) + cubes.shape)
                 for cubes, _ in self.solver.buckets
-            ]
+            ]}
         self.B = batch
-        self.max_cycles = 200
-        self._jitted = {}
 
         base = self.solver
+        hetero = self._hetero
+        swap_prob = self._swap_probability
 
-        def one_instance(cubes_list, key):
+        def one_instance(args, key):
             # swap in this instance's cubes; the per-constraint optima
             # (DSA-B's violation test) must be re-derived from them
-            orig, orig_opt = base.buckets, base.bucket_optima
-            base.buckets = [
-                (c, vi) for c, (_, vi) in zip(cubes_list, orig)
-            ]
-            base.bucket_optima = [
-                jnp.min(c.reshape(c.shape[0], -1), axis=-1)
-                if c.shape[0] else jnp.zeros((0,), dtype=c.dtype)
-                for c in cubes_list
-            ]
-            state = base.init_state(key)
+            saved = {a: getattr(base, a) for a in self._swap_attrs}
+            saved["buckets"] = base.buckets
+            saved["bucket_optima"] = base.bucket_optima
+            if swap_prob:
+                saved["probability"] = base.probability
             try:
-                def body(s):
-                    return base.step(s)
-
-                def cond(s):
-                    return jnp.logical_and(
-                        jnp.logical_not(s["finished"]),
-                        s["cycle"] < self.max_cycles)
-
-                final = jax.lax.while_loop(cond, body, state)
+                base.buckets = [
+                    (c, args["var_ids"][bi] if hetero else vi)
+                    for bi, (c, (_, vi))
+                    in enumerate(zip(args["cubes"], saved["buckets"]))
+                ]
+                base.bucket_optima = [
+                    jnp.min(c.reshape(c.shape[0], -1), axis=-1)
+                    if c.shape[0] else jnp.zeros((0,), dtype=c.dtype)
+                    for c in args["cubes"]
+                ]
+                if hetero:
+                    for a in self._swap_attrs:
+                        setattr(base, a, args[a])
+                if swap_prob:
+                    base.probability = args["probability"]
+                final = self._drive(base, base.init_state(key))
             finally:
-                base.buckets, base.bucket_optima = orig, orig_opt
+                for a, v in saved.items():
+                    setattr(base, a, v)
             return final["x"], final["cycle"], final["finished"]
 
         self._one = one_instance
 
-    def run(self, seed: int = 0, max_cycles: int = 200, seeds=None):
-        """Returns (selections (B, V), cycles (B,), finished (B,));
-        ``seeds`` optionally fixes one engine seed per instance."""
-        self.max_cycles = max_cycles
-        keys = _batch_keys(seed, seeds, self.B)
-        run_all = self._jitted.get(max_cycles)
-        if run_all is None:
-            run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
-            self._jitted[max_cycles] = run_all
-        sel, cycles, finished = run_all(self.cubes_batched, keys)
-        return (np.asarray(sel), np.asarray(cycles),
-                np.asarray(finished))
+    def _build_args(self, instances):
+        _check_same_shape(instances)
+        nb = len(instances[0].buckets)
+        args = {
+            "cubes": [_stacked(instances, lambda a, i=i:
+                               a.buckets[i].cubes)
+                      for i in range(nb)],
+            "var_ids": [_stacked(instances, lambda a, i=i:
+                                 a.buckets[i].var_ids)
+                        for i in range(nb)],
+        }
+        for name in self._swap_attrs:
+            args[name] = _stacked(instances,
+                                  lambda a, n=name: getattr(a, n))
+        if self._swap_probability:
+            from ..algorithms.dsa import arity_probability
+
+            args["probability"] = _stacked(instances,
+                                           arity_probability)
+        return args
+
+    @property
+    def cubes_batched(self):
+        """The batched per-bucket cube stacks (callers re-shard them
+        onto a device mesh before run, e.g. __graft_entry__)."""
+        return self._instance_args["cubes"]
+
+    @cubes_batched.setter
+    def cubes_batched(self, value):
+        self._instance_args = dict(self._instance_args,
+                                   cubes=list(value))
 
 
 class BatchedDsa(_BatchedLocalSearch):
@@ -176,3 +360,43 @@ class BatchedMgm(_BatchedLocalSearch):
     """vmap MGM over per-instance cost cubes."""
 
     from ..algorithms.mgm import MgmSolver as solver_cls
+
+
+# ------------------------------------------------------- runner cache
+
+BATCHED_CLASSES = {"maxsum": BatchedMaxSum, "dsa": BatchedDsa,
+                   "mgm": BatchedMgm}
+
+#: (algo, rung signature, batch, params) -> runner.  The instance
+#: arrays are call ARGUMENTS of the compiled vmapped program, so a
+#: cached runner serves any instance set padded to its rung signature
+#: without retracing.  Scope, stated honestly: the cache is
+#: per-PROCESS — within one fused campaign group a rung costs one
+#: compilation by construction, and IN-PROCESS callers (library use,
+#: repeated `_run_fused_group` calls, benches) amortize across groups
+#: sharing a rung; the CLI's one-child-per-group isolation does not
+#: carry it across groups.  Bounded: oldest runners (and their padded
+#: device arrays) are evicted past the cap.
+_RUNNER_CACHE: Dict[Tuple, object] = {}
+_RUNNER_CACHE_CAP = 32
+
+
+def runner_for_rung(algo: str, instances, params: dict,
+                    rung_signature: Optional[Tuple] = None):
+    """Build — or fetch and re-point — the batched runner for ``algo``
+    over instances padded to one rung shape."""
+    cls = BATCHED_CLASSES[algo]
+    key = None
+    if rung_signature is not None:
+        key = (algo, rung_signature, len(instances),
+               tuple(sorted(params.items())))
+        runner = _RUNNER_CACHE.get(key)
+        if runner is not None:
+            runner.set_instances(instances)
+            return runner
+    runner = cls(instances[0], instances=list(instances), **params)
+    if key is not None:
+        while len(_RUNNER_CACHE) >= _RUNNER_CACHE_CAP:
+            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
+        _RUNNER_CACHE[key] = runner
+    return runner

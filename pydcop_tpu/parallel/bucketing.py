@@ -1,0 +1,174 @@
+"""Shape-bucketed padding planner for heterogeneous batch fusion.
+
+The fused campaign path (commands/batch.py -> parallel/batch.py) turns
+N same-topology jobs into ONE vmapped program; a *mixed* campaign used
+to degrade to one subprocess per job — full CLI startup + XLA retrace
+each (the round-6 measured tooling cost).  This planner is the
+sequence-length-bucketing pattern from inference serving applied to
+DCOP instances: group jobs into a small geometric ladder of shared
+padded shapes (next power-of-two rungs on variable count and per-arity
+bucket slot counts), pad every instance of a rung to the rung's shape
+with phantom variables/factors (``graphs.arrays.*.pad_to``), and a
+whole mixed campaign becomes ≤ #rungs compiled programs.
+
+Padding waste is capped and reported: the pure power-of-two ladder
+bounds each instance's padded/true cell ratio at 2x by construction,
+and the rung-consolidation pass (which merges a small rung into a
+covering bigger one to cut program count further) only fires while
+every merged member stays under ``max_waste``.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (0 stays 0: an absent bucket)."""
+    if n <= 0:
+        return 0
+    return 1 << (int(n) - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class ShapeProfile:
+    """The padding-relevant shape of one compiled instance."""
+
+    kind: str                                  # "factor" | "hyper"
+    max_domain: int
+    n_vars: int
+    bucket_counts: Tuple[Tuple[int, int], ...]  # sorted (arity, count)
+    n_pairs: int = 0                           # hyper: neighbor pairs
+
+    @classmethod
+    def of(cls, arrays) -> "ShapeProfile":
+        counts = tuple(sorted(
+            (b.cubes.ndim - 1, int(b.cubes.shape[0]))
+            for b in arrays.buckets))
+        if hasattr(arrays, "nbr_src"):       # HypergraphArrays
+            return cls("hyper", int(arrays.max_domain),
+                       int(arrays.n_vars), counts,
+                       int(len(arrays.nbr_src)))
+        return cls("factor", int(arrays.max_domain),
+                   int(arrays.n_vars), counts)
+
+    @property
+    def cells(self) -> int:
+        """Table cells the instance really occupies (variable plane +
+        cost cubes) — the denominator of the waste ratio."""
+        D = self.max_domain
+        return self.n_vars * D + sum(
+            c * D ** a for a, c in self.bucket_counts)
+
+
+@dataclass
+class Rung:
+    """One shared padded shape and the jobs assigned to it."""
+
+    kind: str
+    max_domain: int
+    n_vars: int                      # padded V (includes the sink row)
+    bucket_slots: Dict[int, int]     # arity -> padded factor count
+    n_pairs: int                     # hyper: padded neighbor pairs
+    members: List[int] = field(default_factory=list)
+
+    @property
+    def signature(self) -> Tuple:
+        """Hashable rung identity — the in-process trace-cache key:
+        every instance padded to the same signature reuses one
+        compiled program."""
+        return (self.kind, self.max_domain, self.n_vars,
+                tuple(sorted(self.bucket_slots.items())), self.n_pairs)
+
+    @property
+    def cells(self) -> int:
+        D = self.max_domain
+        return self.n_vars * D + sum(
+            c * D ** a for a, c in self.bucket_slots.items())
+
+    def waste_for(self, profile: ShapeProfile) -> float:
+        return self.cells / max(profile.cells, 1)
+
+    def covers(self, profile: ShapeProfile) -> bool:
+        return (self.kind == profile.kind
+                and self.max_domain == profile.max_domain
+                # the sink row: phantom factors need an anchor
+                and self.n_vars > profile.n_vars
+                and self.n_pairs >= profile.n_pairs
+                and all(self.bucket_slots.get(a, 0) >= c
+                        for a, c in profile.bucket_counts))
+
+    def pad(self, arrays):
+        """Pad one member's arrays to this rung's shape."""
+        if self.kind == "hyper":
+            return arrays.pad_to(self.n_vars, dict(self.bucket_slots),
+                                 n_pairs=self.n_pairs)
+        return arrays.pad_to(self.n_vars, dict(self.bucket_slots))
+
+
+def _base_rung(profile: ShapeProfile) -> Rung:
+    """The profile's home rung: next power of two per dimension, plus
+    one sink variable row anchoring phantom factors."""
+    return Rung(
+        kind=profile.kind, max_domain=profile.max_domain,
+        n_vars=next_pow2(profile.n_vars) + 1,
+        bucket_slots={a: next_pow2(c)
+                      for a, c in profile.bucket_counts if c},
+        n_pairs=next_pow2(profile.n_pairs),
+    )
+
+
+def plan_rungs(profiles: List[ShapeProfile],
+               max_waste: float = 2.0) -> List["Rung"]:
+    """Group instance profiles into a padding ladder.
+
+    Pass 1 assigns each profile its power-of-two home rung (identical
+    home rungs share one entry).  Pass 2 consolidates: smaller rungs
+    merge into the cheapest covering bigger rung while every merged
+    member's padded/true cell ratio stays <= ``max_waste`` — fewer
+    rungs means fewer compiled programs, the quantity the
+    ``bench_hetero_batch`` contract asserts.  Members lists index into
+    ``profiles``."""
+    by_sig: Dict[Tuple, Rung] = {}
+    for i, p in enumerate(profiles):
+        rung = _base_rung(p)
+        rung = by_sig.setdefault(rung.signature, rung)
+        rung.members.append(i)
+
+    rungs = sorted(by_sig.values(), key=lambda r: r.cells,
+                   reverse=True)
+    kept: List[Rung] = []
+    for rung in rungs:
+        target = None
+        for big in kept:
+            if all(big.covers(profiles[i]) and
+                   big.waste_for(profiles[i]) <= max_waste
+                   for i in rung.members):
+                if target is None or big.cells < target.cells:
+                    target = big
+        if target is not None:
+            target.members.extend(rung.members)
+        else:
+            kept.append(rung)
+    for rung in kept:
+        rung.members.sort()
+    return kept
+
+
+def plan_stats(rungs: List[Rung],
+               profiles: List[ShapeProfile]) -> Dict[str, object]:
+    """Aggregate ladder stats for campaign results and the bench
+    contract: compiled-program count and total-cell padding waste."""
+    true_cells = padded_cells = 0
+    for rung in rungs:
+        for i in rung.members:
+            true_cells += profiles[i].cells
+            padded_cells += rung.cells
+    return {
+        "programs": len(rungs),
+        "jobs": sum(len(r.members) for r in rungs),
+        "true_cells": true_cells,
+        "padded_cells": padded_cells,
+        "padding_waste": round(padded_cells / max(true_cells, 1), 3),
+    }

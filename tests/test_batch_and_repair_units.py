@@ -6,6 +6,8 @@ cartesian parameter grids) and `test_reparation_removal.py` (orphans,
 candidates, repair info).
 """
 
+import json
+
 import pytest
 
 from pydcop_tpu.commands.batch import (CliError, expand_jobs, _job_argv,
@@ -69,6 +71,91 @@ agents: [a1, a2, a3]
     # funnel through _append_jsonl)
     _append_jsonl(str(jsonl), "extra", {"cost": 1})
     assert len(jsonl.read_text().splitlines()) == 4
+
+
+def test_fuse_exclusion_reason_names_key_algo_mode():
+    """A job excluded from fusion gets a nameable reason (the
+    subprocess fallback used to be silent): per-job timeout, foreign
+    options, non-engine mode, non-fusable algo."""
+    from pydcop_tpu.commands.batch import _fuse_exclusion_reason
+
+    ok = {"command": "solve", "path": "x.yaml",
+          "conf": {"algo": "dsa", "max_cycles": 10}, "iteration": 0}
+    assert _fuse_exclusion_reason(ok) is None
+    timeouty = dict(ok, conf={"algo": "dsa", "timeout": 5})
+    assert "'timeout'" in _fuse_exclusion_reason(timeouty)
+    moded = dict(ok, conf={"algo": "dsa", "mode": "thread"})
+    assert "mode 'thread'" in _fuse_exclusion_reason(moded)
+    algoed = dict(ok, conf={"algo": "dpop"})
+    assert "algo 'dpop'" in _fuse_exclusion_reason(algoed)
+    cmded = dict(ok, command="run")
+    assert "command 'run'" in _fuse_exclusion_reason(cmded)
+    pathless = dict(ok, path=None)
+    assert "no instance file" in _fuse_exclusion_reason(pathless)
+
+
+@pytest.mark.hetero
+def test_consolidated_out_with_fused_and_parallel(tmp_path, capsys):
+    """--consolidated-out under a REAL mixed campaign: the hetero-fused
+    child and the --parallel subprocess pool both append to one jsonl
+    through the lock-guarded single-write path — exactly one intact
+    line per job, no interleaving, no stray per-job files; and the
+    non-fusable jobs' exclusion reason is logged."""
+    import sys
+    from argparse import Namespace
+
+    from pydcop_tpu.commands.batch import run_cmd
+
+    # two distinct topologies -> the fused group is heterogeneous
+    for name, nv in (("a", 4), ("b", 6)):
+        lines = ["name: " + name, "objective: min", "domains:",
+                 "  colors: {values: [R, G, B]}", "variables:"]
+        lines += [f"  v{i}: {{domain: colors}}" for i in range(nv)]
+        lines.append("constraints:")
+        lines += [f"  c{i}: {{type: intention, "
+                  f"function: 2 if v{i} == v{i + 1} else 0}}"
+                  for i in range(nv - 1)]
+        lines.append("agents: [%s]"
+                     % ", ".join(f"a{i}" for i in range(nv)))
+        (tmp_path / f"inst_{name}.yaml").write_text(
+            "\n".join(lines) + "\n")
+    bench = tmp_path / "bench.yaml"
+    bench.write_text(f"""
+sets:
+  s1:
+    path: '{tmp_path}/inst_*.yaml'
+    iterations: 2
+batches:
+  fused:
+    command: solve
+    command_options:
+      algo: [dsa]
+      max_cycles: 10
+  pooled:
+    command: solve
+    command_options:
+      algo: [dsa]
+      max_cycles: 10
+      timeout: 60          # per-job timeout -> subprocess fallback
+""")
+    out_dir = tmp_path / "out"
+    jsonl = tmp_path / "all.jsonl"
+    rc = run_cmd(Namespace(
+        bench_def=str(bench), simulate=False, parallel=2, fuse=True,
+        fuse_hetero=True, job_timeout=150, out_dir=str(out_dir),
+        consolidated_out=str(jsonl)))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[fuse fallback]" in out and "'timeout'" in out
+    raw = jsonl.read_text().splitlines()
+    rows = [json.loads(line) for line in raw]   # every line intact
+    assert len(rows) == 8                       # 2 files x 2 its x 2
+    assert len({r["job_id"] for r in rows}) == 8
+    assert all("cost" in r and "status" in r for r in rows)
+    # jsonl mode leaves no per-job artifacts behind
+    import glob
+
+    assert glob.glob(str(out_dir / "*.json")) == []
 
 
 def test_parameters_configuration_cartesian_product():

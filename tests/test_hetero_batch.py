@@ -1,0 +1,332 @@
+"""Shape-bucketed heterogeneous batch fusion (ISSUE 3).
+
+Layers under test:
+
+* ``graphs/arrays.py pad_to`` — phantom variables / factors, validity
+  masks, canonical edge layout preservation;
+* ``parallel/bucketing.py`` — the power-of-two padding ladder, rung
+  consolidation under the waste cap, plan stats;
+* ``parallel/batch.py`` — hetero ``instances=[...]`` batching with
+  masked decode and the rung-signature runner cache;
+* ``commands/batch.py _run_fused_group(hetero=True)`` — the campaign
+  path end-to-end.
+
+The load-bearing guard rail (carried from PRs 1-2): for a mixed
+campaign of distinct topologies across maxsum/dsa/mgm, every
+bucketed-fused job's selection equals its subprocess-path solve
+bit-exactly — same selections AND same convergence cycle — and phantom
+variables never leak into selections, costs, or cycle counts.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators.fast import (coloring_factor_arrays,
+                                        coloring_hypergraph_arrays)
+from pydcop_tpu.graphs.arrays import BIG, canonical_edge_layout
+from pydcop_tpu.parallel.bucketing import (ShapeProfile, next_pow2,
+                                           plan_rungs, plan_stats)
+
+pytestmark = pytest.mark.hetero
+
+
+# ------------------------------------------------------------- planner
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (0, 1, 2, 3, 4, 5, 31, 32, 33)] == \
+        [0, 1, 2, 4, 4, 8, 32, 32, 64]
+
+
+def test_plan_rungs_pow2_ladder_and_waste_cap():
+    insts = [coloring_hypergraph_arrays(10, 20, 3, seed=1),
+             coloring_hypergraph_arrays(14, 25, 3, seed=2),
+             coloring_hypergraph_arrays(9, 15, 3, seed=3)]
+    profiles = [ShapeProfile.of(a) for a in insts]
+    rungs = plan_rungs(profiles)
+    stats = plan_stats(rungs, profiles)
+    # every job lands exactly once
+    assert sorted(i for r in rungs for i in r.members) == [0, 1, 2]
+    assert stats["jobs"] == 3
+    assert stats["programs"] == len(rungs) < 3
+    # the pure pow2 ladder bounds waste at 2x total cells
+    assert stats["padding_waste"] <= 2.0
+    for rung in rungs:
+        for i in rung.members:
+            assert rung.covers(profiles[i])
+            assert rung.waste_for(profiles[i]) <= 2.0
+
+
+def test_plan_rungs_merge_respects_waste_cap():
+    big = ShapeProfile("hyper", 3, 100, ((2, 300),), 600)
+    tiny = ShapeProfile("hyper", 3, 5, ((2, 4),), 8)
+    # tiny would waste far more than 2x inside big's rung: two rungs
+    rungs = plan_rungs([big, tiny], max_waste=2.0)
+    assert len(rungs) == 2
+    # a generous cap lets the consolidation pass merge them
+    rungs = plan_rungs([big, tiny], max_waste=1000.0)
+    assert len(rungs) == 1
+    assert rungs[0].members == [0, 1]
+
+
+def test_plan_rungs_domain_mismatch_never_merges():
+    a = ShapeProfile("hyper", 3, 10, ((2, 16),), 32)
+    b = ShapeProfile("hyper", 2, 10, ((2, 16),), 32)
+    assert len(plan_rungs([a, b], max_waste=1e9)) == 2
+
+
+# -------------------------------------------------------------- pad_to
+
+
+def test_pad_to_factor_phantoms_and_masks():
+    arrays = coloring_factor_arrays(10, 20, 3, seed=1, noise=0.05)
+    padded = arrays.pad_to(13, {2: 32})
+    assert padded.n_vars == 13 and padded.n_vars_true == 10
+    assert list(padded.var_valid) == [True] * 10 + [False] * 3
+    assert padded.var_names[:10] == arrays.var_names
+    # phantom variables: one valid slot of cost 0
+    assert (padded.domain_size[10:] == 1).all()
+    assert (padded.var_costs[10:, 0] == 0).all()
+    assert (padded.var_costs[10:, 1:] == BIG).all()
+    # phantom factors: identity cube anchored on the sink variable
+    b = padded.buckets[0]
+    assert b.cubes.shape == (32, 3, 3)
+    assert (b.var_ids[20:] == 12).all()
+    assert (b.cubes[20:, 0, 0] == 0).all()
+    assert (b.cubes[20:, 1:, :] == BIG).all()
+    # real factors untouched, canonical layout re-established
+    assert np.array_equal(b.cubes[:20], arrays.buckets[0].cubes)
+    assert np.array_equal(b.var_ids[:20], arrays.buckets[0].var_ids)
+    assert canonical_edge_layout(padded) is not None
+    assert padded.n_edges == 64
+
+
+def test_pad_to_hypergraph_phantoms_and_pairs():
+    arrays = coloring_hypergraph_arrays(8, 12, 3, seed=2)
+    P = len(arrays.nbr_src)
+    padded = arrays.pad_to(11, {2: 16}, n_pairs=P + 6)
+    assert padded.n_vars_true == 8
+    # phantoms start pinned at slot 0 (declared initial)
+    assert padded.has_initial[8:].all()
+    assert (padded.initial_idx[8:] == 0).all()
+    # padding pairs are inert sink self-loops appended after the real
+    # prefix
+    assert np.array_equal(padded.nbr_src[:P], arrays.nbr_src)
+    assert (padded.nbr_src[P:] == 10).all()
+    assert (padded.nbr_dst[P:] == 10).all()
+    # phantom constraints can never read as violated: optimum == cost
+    cubes = padded.buckets[0].cubes
+    assert (cubes[12:, 0, 0] == 0).all()
+
+
+def test_pad_to_validation():
+    arrays = coloring_hypergraph_arrays(8, 12, 3, seed=2)
+    with pytest.raises(ValueError, match="below instance"):
+        arrays.pad_to(4, {2: 16})
+    with pytest.raises(ValueError, match="below instance"):
+        arrays.pad_to(10, {2: 4})
+    with pytest.raises(ValueError, match="phantom variable"):
+        arrays.pad_to(8, {2: 16})
+    with pytest.raises(ValueError, match="n_pairs"):
+        arrays.pad_to(10, {2: 12}, n_pairs=2)
+    # pair padding anchored on a REAL variable would freeze it in the
+    # gain-exchange reductions: demand a phantom sink
+    with pytest.raises(ValueError, match="phantom sink"):
+        arrays.pad_to(8, {2: 12},
+                      n_pairs=len(arrays.nbr_src) + 2)
+
+
+# -------------------------------------------- pad-stable RNG primitive
+
+
+def test_prefix_uniform_is_prefix_stable():
+    import jax
+
+    from pydcop_tpu.ops.kernels import prefix_uniform
+
+    key = jax.random.PRNGKey(7)
+    small = np.asarray(prefix_uniform(key, 10))
+    large = np.asarray(prefix_uniform(key, 17))
+    assert np.array_equal(small, large[:10])
+    small2 = np.asarray(prefix_uniform(key, 10, 3))
+    large2 = np.asarray(prefix_uniform(key, 17, 3))
+    assert np.array_equal(small2, large2[:10])
+
+
+# -------------------------------------- bit-exactness of padded solves
+
+
+def _hyper_instances():
+    return [coloring_hypergraph_arrays(10, 20, 3, seed=1),
+            coloring_hypergraph_arrays(14, 25, 3, seed=2),
+            coloring_hypergraph_arrays(9, 15, 3, seed=3)]
+
+
+def _one_rung(instances, max_waste=50.0):
+    profiles = [ShapeProfile.of(a) for a in instances]
+    rungs = plan_rungs(profiles, max_waste=max_waste)
+    assert len(rungs) == 1, "test setup: expected a single merged rung"
+    return rungs[0]
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("dsa", {"probability": 0.7, "variant": "B", "stop_cycle": 15}),
+    ("dsa", {"p_mode": "arity", "stop_cycle": 12}),
+    ("mgm", {"stop_cycle": 15}),
+])
+def test_hetero_batched_localsearch_bit_exact(algo, params):
+    """Padded fused rows reproduce each instance's unpadded engine
+    solve bit-exactly — selections AND cycle counts — because dsa/mgm
+    draw pad-stable per-variable randomness."""
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+    from pydcop_tpu.algorithms.mgm import MgmSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.parallel.batch import BATCHED_CLASSES
+
+    instances = _hyper_instances()
+    rung = _one_rung(instances)
+    padded = [rung.pad(a) for a in instances]
+    runner = BATCHED_CLASSES[algo](padded[0], instances=padded,
+                                   **params)
+    sel, cycles, _fin = runner.run(max_cycles=15, seeds=[0, 1, 2])
+    decoded = runner.decode(sel)
+    solver_cls = {"dsa": DsaSolver, "mgm": MgmSolver}[algo]
+    for i, arrays in enumerate(instances):
+        res = SyncEngine(solver_cls(arrays, **params)).run(
+            key=i, max_cycles=15)
+        single = np.array([res.assignment[n]
+                           for n in arrays.var_names])
+        assert decoded[i].shape == (arrays.n_vars,)
+        assert np.array_equal(decoded[i], single), (algo, i)
+        assert int(cycles[i]) == res.cycles, (algo, i)
+
+
+def test_hetero_batched_maxsum_bit_exact_and_no_phantom_leak():
+    """MaxSum across three padded topologies: selections, convergence
+    cycles and costs equal the per-instance engine solve; phantom
+    variables never appear in the decode."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    instances = [coloring_factor_arrays(10, 20, 3, seed=1, noise=0.05),
+                 coloring_factor_arrays(14, 25, 3, seed=2, noise=0.05),
+                 coloring_factor_arrays(9, 15, 3, seed=3, noise=0.05)]
+    rung = _one_rung(instances)
+    padded = [rung.pad(a) for a in instances]
+    runner = BatchedMaxSum(padded[0], instances=padded, damping=0.5)
+    sel, cycles, _fin = runner.run(max_cycles=60, seeds=[0, 1, 2])
+    decoded = runner.decode(sel)
+    for i, arrays in enumerate(instances):
+        res = SyncEngine(MaxSumSolver(arrays, damping=0.5)).run(
+            key=i, max_cycles=60)
+        single = np.array([res.assignment[n]
+                           for n in arrays.var_names])
+        assert decoded[i].shape == (arrays.n_vars,)
+        assert np.array_equal(decoded[i], single), i
+        # convergence fires on the identical cycle: phantom edges
+        # contribute a 0 delta and a constant selection
+        assert int(cycles[i]) == res.cycles, i
+
+
+def test_runner_cache_reuses_compiled_programs():
+    """The rung-signature runner cache: a second instance set padded to
+    the same rung re-uses the SAME runner (and its compiled programs) —
+    N campaign groups on one rung cost one compilation."""
+    from pydcop_tpu.parallel.batch import runner_for_rung
+
+    insts_a = _hyper_instances()
+    rung = _one_rung(insts_a)
+    padded_a = [rung.pad(a) for a in insts_a]
+    params = {"stop_cycle": 10}
+    r1 = runner_for_rung("mgm", padded_a, params,
+                         rung_signature=rung.signature)
+    sel_a, _c, _f = r1.run(max_cycles=10, seeds=[0, 1, 2])
+
+    insts_b = [coloring_hypergraph_arrays(11, 18, 3, seed=9),
+               coloring_hypergraph_arrays(13, 22, 3, seed=8),
+               coloring_hypergraph_arrays(12, 21, 3, seed=7)]
+    padded_b = [rung.pad(a) for a in insts_b]
+    r2 = runner_for_rung("mgm", padded_b, params,
+                         rung_signature=rung.signature)
+    assert r2 is r1                      # cache hit, no retrace
+    sel_b, _c, _f = r2.run(max_cycles=10, seeds=[0, 1, 2])
+    # the cached program really ran the NEW instances
+    from pydcop_tpu.algorithms.mgm import MgmSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    for i, arrays in enumerate(insts_b):
+        res = SyncEngine(MgmSolver(arrays, stop_cycle=10)).run(
+            key=i, max_cycles=10)
+        single = np.array([res.assignment[n]
+                           for n in arrays.var_names])
+        assert np.array_equal(r2.decode(sel_b)[i], single), i
+
+    # a different rung signature is a different runner
+    r3 = runner_for_rung("mgm", padded_b, params,
+                         rung_signature=("other",) + rung.signature)
+    assert r3 is not r1
+
+
+# --------------------------------------------- campaign path (_run_fused_group)
+
+
+def _write_instance(path, name, edges, nv, w):
+    lines = [f"name: {name}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(nv):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k, (a, b) in enumerate(edges):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {w + k} if v{a} == v{b} else 0}}")
+    lines.append("agents: [%s]"
+                 % ", ".join(f"a{i}" for i in range(nv)))
+    path.write_text("\n".join(lines) + "\n")
+
+
+@pytest.mark.parametrize("algo", ["maxsum", "dsa", "mgm"])
+def test_mixed_campaign_fused_equals_subprocess_solve(tmp_path, algo):
+    """The ISSUE 3 acceptance guard: a mixed campaign (three distinct
+    topologies) run through ``_run_fused_group(hetero=True)`` produces,
+    for EVERY job, the same assignment, cost and cycle count as the
+    per-job solve the subprocess path executes (``solve_result`` is
+    exactly what ``pydcop solve -m engine`` runs), and the results
+    carry the fuse_rung / padding_waste stats."""
+    from pydcop_tpu.commands.batch import _run_fused_group
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    specs = [("chain4", [(0, 1), (1, 2), (2, 3)], 4, 3),
+             ("ring5", [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)], 5, 5),
+             ("star6", [(0, i) for i in range(1, 6)], 6, 7)]
+    files = []
+    for name, edges, nv, w in specs:
+        p = tmp_path / f"{name}.yaml"
+        _write_instance(p, name, edges, nv, w)
+        files.append(str(p))
+    out_dir = tmp_path / "out"
+    os.makedirs(out_dir)
+    done = []
+    key = (algo, (), 25, None)
+    rows = [(f"s__b__{os.path.basename(p)}__algo={algo}__{it}", p, it)
+            for p in files for it in range(2)]
+    _run_fused_group(key, rows, str(out_dir), done.append,
+                     hetero=True)
+    assert sorted(done) == sorted(r[0] for r in rows)
+    for job_id, p, it in rows:
+        with open(out_dir / f"{job_id}.json") as f:
+            r = json.load(f)
+        dcop = load_dcop_from_file(p)
+        res = solve_result(dcop, algo, timeout=60, max_cycles=25,
+                           seed=it)
+        assert r["assignment"] == dict(res.assignment), job_id
+        assert r["cycle"] == res.cycles, job_id
+        assert abs(r["cost"] - res.cost) < 1e-6, job_id
+        # phantom variables never leak into the result
+        assert set(r["assignment"]) == set(dcop.variables), job_id
+        assert "fuse_rung" in r and "padding_waste" in r
+        assert r["padding_waste"] <= 2.0

@@ -88,7 +88,7 @@ def test_sharded_mgm_deterministic_and_matches_single_chip():
 
     solver = MgmSolver(arrays)
     engine = SyncEngine(solver)
-    res = engine.run(max_cycles=30)
+    res = engine.run(key=1, max_cycles=30)
     sel_single = np.array([res.assignment[n] for n in arrays.var_names])
     c_single = conflicts(arrays, sel_single)
     # both are monotonic MGM: same neighborhood-argmax rule, different
@@ -375,7 +375,7 @@ def test_batched_dsa_and_mgm():
     for cls, kw in ((BatchedDsa, {"probability": 0.7, "variant": "B"}),
                     (BatchedMgm, {})):
         runner = cls(template, batch=8, **kw)
-        sel, cycles, finished = runner.run(seed=1, max_cycles=60)
+        sel, cycles, finished = runner.run(seed=0, max_cycles=60)
         assert sel.shape == (8, 20)
         assert cycles.shape == (8,)
         for b in range(8):
