@@ -17,8 +17,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..engine.solver import ArraySolver
-from ..graphs.arrays import BIG, HypergraphArrays
+from ..graphs.arrays import SENTINEL, HypergraphArrays
 from ..ops.kernels import bucket_cost, candidate_costs, prefix_uniform
+from ..ops.precision import resolve as resolve_precision
 
 
 class LocalSearchSolver(ArraySolver):
@@ -34,27 +35,39 @@ class LocalSearchSolver(ArraySolver):
     #: sharded replicas mirror key-for-key.
     pad_stable_rng = False
 
-    def __init__(self, arrays: HypergraphArrays, stop_cycle: int = 0):
+    def __init__(self, arrays: HypergraphArrays, stop_cycle: int = 0,
+                 precision=None):
         self.arrays = arrays
         self.var_names = arrays.var_names
         self.stop_cycle = int(stop_cycle)
+        # mixed-precision policy (ops/precision.py): cost planes
+        # (cubes, unary costs, per-constraint optima) live on device in
+        # store_dtype; candidate/total sums upcast to accum_dtype at
+        # every reduction boundary, so integer-cost instances keep
+        # f32-bit-exact selections under bf16 storage
+        self.policy = resolve_precision(precision)
+        store = self.policy.store_dtype
 
         self.V = arrays.n_vars
         self.D = arrays.max_domain
-        self.var_costs = jnp.asarray(arrays.var_costs)
+        self.var_costs = jnp.asarray(arrays.var_costs, dtype=store)
         self.domain_mask = jnp.asarray(arrays.domain_mask)
         self.domain_size = jnp.asarray(arrays.domain_size)
         self.initial_idx = jnp.asarray(arrays.initial_idx)
         self.has_initial = jnp.asarray(arrays.has_initial)
         self.buckets = [
-            (jnp.asarray(b.cubes), jnp.asarray(b.var_ids))
+            (jnp.asarray(b.cubes, dtype=store),
+             jnp.asarray(b.var_ids))
             for b in arrays.buckets
         ]
         # per-constraint best achievable value, per bucket (for
-        # "violated constraint" tests, reference dsa.py:450-466)
+        # "violated constraint" tests, reference dsa.py:450-466) —
+        # host mins of the store-dtype cubes: exact under bf16 (min is
+        # order-preserving, the cubes were already rounded at store)
         self.bucket_optima = [
-            jnp.asarray(
-                np.min(b.cubes.reshape(b.cubes.shape[0], -1), axis=1))
+            jnp.asarray(np.min(
+                np.asarray(b.cubes, dtype=store)
+                .reshape(b.cubes.shape[0], -1), axis=1))
             for b in arrays.buckets
         ]
         self.nbr_src = jnp.asarray(arrays.nbr_src)
@@ -77,10 +90,14 @@ class LocalSearchSolver(ArraySolver):
         return v
 
     def local_costs(self, x: jnp.ndarray) -> jnp.ndarray:
-        """(V, D) cost of each candidate value given neighbors at ``x``."""
-        acc = jnp.zeros((self.V, self.D))
+        """(V, D) cost of each candidate value given neighbors at
+        ``x`` — accumulated in the policy's accum dtype (f32), the
+        unary store-dtype plane upcasting exactly at the final add."""
+        accum = self.policy.accum_dtype
+        acc = jnp.zeros((self.V, self.D), dtype=accum)
         for cubes, var_ids in self.buckets:
-            acc = acc + candidate_costs(cubes, var_ids, x, self.V)
+            acc = acc + candidate_costs(cubes, var_ids, x, self.V,
+                                        accum_dtype=accum)
         return self.var_costs + self._reduce_vplane(acc)
 
     def uniform_v(self, key) -> jnp.ndarray:
@@ -103,11 +120,14 @@ class LocalSearchSolver(ArraySolver):
         return jnp.where(self.has_initial, self.initial_idx, rand_idx)
 
     def total_cost(self, x: jnp.ndarray) -> jnp.ndarray:
+        accum = self.policy.accum_dtype
         V = self.var_costs.shape[0]
-        unary = jnp.sum(self.var_costs[jnp.arange(V), x])
-        acc = jnp.float32(0)
+        unary = jnp.sum(
+            self.var_costs[jnp.arange(V), x].astype(accum))
+        acc = jnp.zeros((), dtype=accum)
         for cubes, var_ids in self.buckets:
-            acc = acc + jnp.sum(bucket_cost(cubes, var_ids, x))
+            acc = acc + jnp.sum(
+                bucket_cost(cubes, var_ids, x).astype(accum))
         return unary + self._reduce_scalar(acc)
 
     def var_has_violated_constraint(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -152,7 +172,8 @@ class LocalSearchSolver(ArraySolver):
         several minima exist (reference dsa.py variant_b/c)."""
         costs = self.local_costs(x)
         cur = costs[jnp.arange(self.V), x]
-        c = jnp.where(self.domain_mask, costs, BIG * 2)
+        c = jnp.where(self.domain_mask, costs,
+                      jnp.asarray(SENTINEL, costs.dtype))
         best_cost = jnp.min(c, axis=-1)
         is_min = (c <= best_cost[:, None] + 1e-9) & self.domain_mask
         # prefer a minimum other than the current value when one exists

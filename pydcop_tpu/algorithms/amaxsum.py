@@ -31,6 +31,9 @@ algo_params = [
     AlgoParameterDef("noise", "float", None, 0.0),
     AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("activation", "float", None, 0.7),
+    # mixed-precision policy (ops/precision.py), inherited from the
+    # MaxSum solver family: bf16 cost planes, f32 accumulation
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "auto"], None),
 ]
 
 

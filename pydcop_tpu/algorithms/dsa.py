@@ -33,6 +33,9 @@ algo_params = [
     AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("p_mode", "str", ["fixed", "arity"], "fixed"),
+    # mixed-precision policy (ops/precision.py): bf16 cost planes with
+    # f32 accumulation; None defers to PYDCOP_TPU_PRECISION, then f32
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "auto"], None),
 ]
 
 
@@ -57,8 +60,8 @@ class DsaSolver(LocalSearchSolver):
 
     def __init__(self, arrays: HypergraphArrays, probability: float = 0.7,
                  variant: str = "B", stop_cycle: int = 0,
-                 p_mode: str = "fixed"):
-        super().__init__(arrays, stop_cycle)
+                 p_mode: str = "fixed", precision=None):
+        super().__init__(arrays, stop_cycle, precision=precision)
         self.variant = variant
         self.p_mode = p_mode
         if p_mode == "arity":
@@ -108,7 +111,8 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
 
     params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
-                                    constraints)
+                                    constraints,
+                                    precision=params.get("precision"))
     return DsaSolver(arrays, **params)
 
 

@@ -23,12 +23,13 @@ import jax.numpy as jnp
 
 from ..dcop.dcop import DCOP
 from ..engine.solver import ArraySolver
-from ..graphs.arrays import BIG, FactorGraphArrays
+from ..graphs.arrays import BIG, SENTINEL, FactorGraphArrays
 from ..ops.kernels import (
     assignment_cost_device,
     factor_messages,
     masked_argmin,
 )
+from ..ops.precision import resolve as resolve_precision
 from . import AlgoParameterDef
 
 GRAPH_TYPE = "factor_graph"
@@ -61,6 +62,13 @@ algo_params = [
     AlgoParameterDef("layout", "str",
                      ["auto", "edge_major", "lane_major", "fused"],
                      "auto"),
+    # mixed-precision policy (ops/precision.py): bf16 stores the cost
+    # planes (cubes + unary costs) at half the bytes; sums and the
+    # recurrent message planes stay in f32, so integer-cost instances
+    # reproduce the f32 selections and convergence cycles bit-exactly.
+    # Default None defers to the PYDCOP_TPU_PRECISION environment
+    # variable, then f32; auto = bf16 on TPU backends only.
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "auto"], None),
 ]
 
 
@@ -68,9 +76,14 @@ class MaxSumSolver(ArraySolver):
     def __init__(self, arrays: FactorGraphArrays, damping: float = 0.5,
                  damping_nodes: str = "vars", stability: float = 0.1,
                  noise: float = 0.0, stop_cycle: int = 0,
-                 delta_on: str = "messages"):
+                 delta_on: str = "messages", precision=None):
         self.arrays = arrays
         self.var_names = arrays.var_names
+        # mixed-precision policy: cost planes materialize on device in
+        # store_dtype; the q/r message recurrence and every sum stay in
+        # accum_dtype (f32) — see ops/precision.py for why min is safe
+        # in bf16 and sums are not
+        self.policy = resolve_precision(precision)
         self.damping = float(damping)
         self.damping_nodes = damping_nodes
         if delta_on not in ("messages", "beliefs"):
@@ -152,8 +165,10 @@ class MaxSumSolver(ArraySolver):
 
     @property
     def var_costs(self):
-        return self._dev("var_costs",
-                         lambda: jnp.asarray(self.arrays.var_costs))
+        return self._dev(
+            "var_costs",
+            lambda: jnp.asarray(self.arrays.var_costs,
+                                dtype=self.policy.store_dtype))
 
     @property
     def domain_mask(self):
@@ -173,8 +188,8 @@ class MaxSumSolver(ArraySolver):
     @property
     def buckets(self):
         return self._dev("buckets", lambda: [
-            (jnp.asarray(b.cubes), jnp.asarray(b.edge_ids),
-             jnp.asarray(b.var_ids))
+            (jnp.asarray(b.cubes, dtype=self.policy.store_dtype),
+             jnp.asarray(b.edge_ids), jnp.asarray(b.var_ids))
             for b in self.arrays.buckets
         ])
 
@@ -282,8 +297,15 @@ class MaxSumSolver(ArraySolver):
         """Attach the delta_on=beliefs carry — COPIED: the initial
         belief aliases a cached device constant, and a donated state
         pytree would otherwise delete the cache out from under the
-        next init_state."""
+        next init_state.  Cast to the in-step belief dtype (store +
+        accum promotion): under the bf16 policy the initial belief IS
+        the bf16 cost plane while every stepped belief is an f32 sum,
+        and a ``lax.while_loop`` carry must keep one dtype."""
         if self.stability > 0 and self.delta_on == "beliefs":
+            accum = jnp.promote_types(belief.dtype,
+                                      self.policy.accum_dtype)
+            if belief.dtype != accum:
+                belief = belief.astype(accum)
             state["belief"] = belief.copy()
         return state
 
@@ -384,7 +406,7 @@ class MaxSumSolver(ArraySolver):
         emask = domain_mask[edge_var]
 
         def select(belief):
-            return np.argmin(np.where(domain_mask, belief, BIG * 2),
+            return np.argmin(np.where(domain_mask, belief, SENTINEL),
                              axis=1)
 
         def total_cost(sel):
@@ -536,8 +558,10 @@ class MaxSumLaneSolver(MaxSumSolver):
     # transposed device constants, lazy like the base class's
     @property
     def var_costsT(self):
-        return self._dev("var_costsT",
-                         lambda: jnp.asarray(self.arrays.var_costs.T))
+        return self._dev(
+            "var_costsT",
+            lambda: jnp.asarray(self.arrays.var_costs.T,
+                                dtype=self.policy.store_dtype))
 
     @property
     def domain_maskT(self):
@@ -555,7 +579,8 @@ class MaxSumLaneSolver(MaxSumSolver):
         def build():
             return [
                 None if spec is None
-                else jnp.asarray(b.cubes_lane_major())
+                else jnp.asarray(b.cubes_lane_major(),
+                                 dtype=self.policy.store_dtype)
                 for b, spec in zip(self.arrays.buckets, self._canonical)
             ]
 
@@ -576,9 +601,12 @@ class MaxSumLaneSolver(MaxSumSolver):
         return self._init_belief_carry(state, belief)
 
     def _select(self, beliefT):
-        """Masked argmin over the (sublane) domain axis — no transpose."""
+        """Masked argmin over the (sublane) domain axis — no transpose.
+        The sentinel rides the beliefs' own dtype (bf16-safe ordering,
+        see graphs/arrays.py SENTINEL)."""
         return jnp.argmin(
-            jnp.where(self.domain_maskT, beliefT, BIG * 2), axis=0)
+            jnp.where(self.domain_maskT, beliefT,
+                      jnp.asarray(SENTINEL, beliefT.dtype)), axis=0)
 
     def assignment_indices(self, s):
         if self.stability > 0:
@@ -604,7 +632,10 @@ class MaxSumLaneSolver(MaxSumSolver):
                 continue
             offset, f, arity = spec
             if arity == 1:
-                blocks.append(cubesT)  # unary msg = the cost row
+                # unary msg = the cost row, upcast to the message
+                # (accum) dtype so mixed-arity concatenation never
+                # demotes the f32 planes to the bf16 store dtype
+                blocks.append(cubesT.astype(q.dtype))
                 continue
             q_blk = q[:, offset:offset + arity * f]
             q_in = [q_blk[:, p::arity] for p in range(arity)]
@@ -865,7 +896,8 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     @property
     def cube_slotT(self):
         return self._dev("cube_slotT", lambda: jnp.asarray(
-            self._np_fused["cube_slotT"]))
+            self._np_fused["cube_slotT"],
+            dtype=self.policy.store_dtype))
 
     @property
     def pos_slots(self):
@@ -882,7 +914,8 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     @property
     def var_costsT_sorted(self):
         return self._dev("var_costsT_sorted", lambda: jnp.asarray(
-            self.arrays.var_costs.T[:, self._np_fused["var_order"]]))
+            self.arrays.var_costs.T[:, self._np_fused["var_order"]],
+            dtype=self.policy.store_dtype))
 
     @property
     def domain_maskT_sorted(self):
@@ -936,7 +969,8 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     def _select_sorted(self, beliefT_sorted):
         return jnp.argmin(
             jnp.where(self.domain_maskT_sorted, beliefT_sorted,
-                      BIG * 2), axis=0)
+                      jnp.asarray(SENTINEL, beliefT_sorted.dtype)),
+            axis=0)
 
     def _variable_update(self, new_r):
         """Static belief/redistribution: per degree bucket, a reshape
@@ -1035,7 +1069,8 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
     # oracle.
     arrays = FactorGraphArrays.build(
         dcop, variables, constraints,
-        arity_sorted=layout != "edge_major")
+        arity_sorted=layout != "edge_major",
+        precision=params.get("precision"))
     if layout == "fused":
         return MaxSumFusedSolver(arrays, **params)
     if layout == "lane_major" or (

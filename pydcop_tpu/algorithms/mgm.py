@@ -24,6 +24,9 @@ GRAPH_TYPE = "constraints_hypergraph"
 algo_params = [
     AlgoParameterDef("break_mode", "str", ["lexic", "random"], "lexic"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    # mixed-precision policy (ops/precision.py): bf16 cost planes with
+    # f32 accumulation; None defers to PYDCOP_TPU_PRECISION, then f32
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "auto"], None),
 ]
 
 
@@ -33,8 +36,9 @@ class MgmSolver(LocalSearchSolver):
     pad_stable_rng = True
 
     def __init__(self, arrays: HypergraphArrays,
-                 break_mode: str = "lexic", stop_cycle: int = 0):
-        super().__init__(arrays, stop_cycle)
+                 break_mode: str = "lexic", stop_cycle: int = 0,
+                 precision=None):
+        super().__init__(arrays, stop_cycle, precision=precision)
         self.break_mode = break_mode
         # lexic tie-break: lower variable index wins -> encode priority as
         # -index so that "higher priority wins" applies uniformly
@@ -78,7 +82,8 @@ def build_solver(dcop: DCOP, params: Optional[Dict] = None,
 
     params = engine_params(params)
     arrays = HypergraphArrays.build(filter_dcop(dcop), variables,
-                                    constraints)
+                                    constraints,
+                                    precision=params.get("precision"))
     return MgmSolver(arrays, **params)
 
 

@@ -65,6 +65,26 @@ def set_parser(subparsers):
                              "bit-exact with the per-job solve and "
                              "padding-waste / program-count stats "
                              "land in the results")
+    parser.add_argument("--precision", default=None,
+                        choices=["f32", "bf16", "auto"],
+                        help="mixed-precision policy for every solve "
+                             "job of the campaign (fused and "
+                             "subprocess legs): bf16 stores cost "
+                             "planes at half the bytes with f32 "
+                             "accumulation — bit-exact selections on "
+                             "integer-cost instances "
+                             "(docs/architecture.md).  Jobs already "
+                             "carrying a precision algo-param keep "
+                             "it; algorithms without the param reject "
+                             "the flag loudly")
+    parser.add_argument("--max_rung_mb", type=float, default=None,
+                        help="cap the padded per-instance memory a "
+                             "--fuse-hetero consolidation rung may "
+                             "reach, priced at the precision policy's "
+                             "store itemsize — at bf16 each cell "
+                             "costs 2 bytes instead of 4, so the same "
+                             "cap admits rungs twice as large (fewer "
+                             "compiled programs).  Default: no cap")
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
@@ -278,7 +298,8 @@ def _append_jsonl(path: str, job_id: str, result: dict):
 
 
 def _run_fused_group(key, rows, out_dir, register_done,
-                     consolidated_out=None, hetero=False):
+                     consolidated_out=None, hetero=False,
+                     precision=None, max_rung_mb=None):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -287,12 +308,22 @@ def _run_fused_group(key, rows, out_dir, register_done,
     compilations for the whole mixed group.  Writes the same per-job
     result JSON the subprocess path produces, so resume files and
     ``consolidate`` CSVs are indistinguishable (or one jsonl line per
-    job when the campaign opted into ``--consolidated-out``)."""
+    job when the campaign opted into ``--consolidated-out``).
+
+    Result costs/violations come from ONE vmapped device evaluation
+    per rung (``runner.evaluate``) instead of a per-job Python re-walk
+    of every constraint — the fused leg's remaining host cost named in
+    PERF_NOTES round 8.  ``precision`` applies the campaign-level
+    mixed-precision policy to rows that carry none of their own;
+    ``max_rung_mb`` caps consolidation-rung memory priced at the
+    policy's store itemsize (parallel/bucketing.py)."""
     import numpy as np
 
     from ..dcop.dcop import filter_dcop
     from ..dcop.yamldcop import load_dcop_from_file
     from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+    from ..ops.precision import ENV_VAR as PRECISION_ENV
+    from ..ops.precision import resolve as resolve_precision
     from ..parallel.batch import (BatchedDsa, BatchedMaxSum, BatchedMgm,
                                   runner_for_rung)
     from ..parallel.bucketing import ShapeProfile, plan_rungs
@@ -321,6 +352,15 @@ def _run_fused_group(key, rows, out_dir, register_done,
     else:
         explicit_seed = None
 
+    # campaign-level precision: a job's own -p precision: wins, then
+    # the --precision flag (threaded through the spec), then the env
+    if precision and "precision" not in params:
+        params["precision"] = precision
+    requested_precision = params.get("precision") \
+        or os.environ.get(PRECISION_ENV)
+    policy = resolve_precision(requested_precision)
+    precision_name = policy.name if requested_precision else None
+
     # maxsum noise draws are shape-coupled, so a shape-padded run would
     # not reproduce the per-job solve: noisy groups keep exact-topology
     # fusion only (the bit-exactness guard rail comes first)
@@ -336,10 +376,12 @@ def _run_fused_group(key, rows, out_dir, register_done,
                 # arity_sorted: the canonical factor-major edge layout
                 # pad_to re-emits, and the same build the solve CLI uses
                 arrays_of[path] = FactorGraphArrays.build(
-                    dcop, arity_sorted=True)
+                    dcop, arity_sorted=True,
+                    precision=params.get("precision"))
             else:
                 arrays_of[path] = HypergraphArrays.build(
-                    filter_dcop(dcop))
+                    filter_dcop(dcop),
+                    precision=params.get("precision"))
 
     # sub-group by topology: same-shape instances share a program as-is
     by_topo: Dict[Tuple, List] = {}
@@ -347,7 +389,12 @@ def _run_fused_group(key, rows, out_dir, register_done,
         sig = _topology_signature(arrays_of[row[1]])
         by_topo.setdefault(sig, []).append(row)
 
-    def emit(sub, sel_rows, cycles, finished, elapsed, extra_of, tag):
+    def emit(sub, sel_rows, costs, viols, cycles, finished, elapsed,
+             extra_of, tag):
+        """Per-job result files from the batched outputs.  Costs and
+        violation counts arrive from the runner's ONE vmapped device
+        evaluation (``runner.evaluate``); the host only decodes value
+        names."""
         for i, (job_id, path, _it) in enumerate(sub):
             dcop = dcops[path]
             var_names = arrays_of[path].var_names
@@ -355,13 +402,12 @@ def _run_fused_group(key, rows, out_dir, register_done,
                 n: dcop.variable(n).domain.values[int(v)]
                 for n, v in zip(var_names, sel_rows[i])
             }
-            cost, violations = dcop.solution_cost(assignment)
             result = {
                 "status": ("FINISHED" if bool(finished[i])
                            else "MAX_CYCLES"),
                 "assignment": assignment,
-                "cost": cost,
-                "violation": violations,
+                "cost": float(costs[i]),
+                "violation": int(viols[i]),
                 "cycle": int(cycles[i]),
                 # amortized: the whole sub-group ran as one program
                 "time": elapsed / len(sub),
@@ -369,6 +415,8 @@ def _run_fused_group(key, rows, out_dir, register_done,
                 "msg_size": 0,
                 "fused_batch": len(sub),
             }
+            if precision_name:
+                result["precision"] = precision_name
             result.update(extra_of(path))
             if consolidated_out:
                 _append_jsonl(consolidated_out, job_id, result)
@@ -395,7 +443,7 @@ def _run_fused_group(key, rows, out_dir, register_done,
             cubes_batches = None
         else:
             cubes_batches = [
-                np.stack([arrays_of[path].buckets[i].cubes
+                np.stack([np.asarray(arrays_of[path].buckets[i].cubes)
                           for _j, path, _it in sub])
                 for i in range(len(template.buckets))
             ]
@@ -406,8 +454,10 @@ def _run_fused_group(key, rows, out_dir, register_done,
         t0 = time.perf_counter()
         sel, cycles, finished = runner.run(max_cycles=max_cycles,
                                            seeds=row_seeds(sub))
+        costs, viols = runner.evaluate(sel)
         elapsed = time.perf_counter() - t0
-        emit(sub, list(sel), cycles, finished, elapsed, extra_of, tag)
+        emit(sub, list(sel), costs, viols, cycles, finished, elapsed,
+             extra_of, tag)
 
     topo_groups = list(by_topo.values())
     if not (hetero and len(topo_groups) > 1):
@@ -419,7 +469,14 @@ def _run_fused_group(key, rows, out_dir, register_done,
     # power-of-two ladder and run each rung as ONE vmapped program
     templates = [arrays_of[sub[0][1]] for sub in topo_groups]
     profiles = [ShapeProfile.of(t) for t in templates]
-    rungs = plan_rungs(profiles)
+    # rung memory is priced at the policy's store itemsize: a bf16
+    # campaign advertises 2-byte cells, so a --max_rung_mb budget
+    # admits rungs twice as large (fewer compiled programs)
+    rungs = plan_rungs(
+        profiles,
+        max_rung_bytes=(None if max_rung_mb is None
+                        else int(max_rung_mb * 2 ** 20)),
+        bytes_per_cell=policy.store_itemsize)
     programs = 0
     job_true = job_padded = 0
     for ri, rung in enumerate(rungs):
@@ -452,9 +509,13 @@ def _run_fused_group(key, rows, out_dir, register_done,
         t0 = time.perf_counter()
         sel, cycles, finished = runner.run(max_cycles=max_cycles,
                                            seeds=row_seeds(sub))
+        # ONE vmapped device evaluation per rung (phantom rows
+        # contribute exactly zero, so padded costs == true costs)
+        costs, viols = runner.evaluate(sel)
         elapsed = time.perf_counter() - t0
         # masked decode: phantom variables never reach the results
-        emit(sub, runner.decode(sel), cycles, finished, elapsed,
+        emit(sub, runner.decode(sel), costs, viols, cycles, finished,
+             elapsed,
              lambda path, ri=ri: {"fuse_rung": ri,
                                   "padding_waste": waste_of[path]},
              "fused-hetero")
@@ -486,11 +547,23 @@ def _fused_child_main(argv=None) -> int:
 
     _run_fused_group(key, rows, spec["out_dir"], register_done,
                      consolidated_out=spec.get("consolidated_out"),
-                     hetero=spec.get("hetero", False))
+                     hetero=spec.get("hetero", False),
+                     precision=spec.get("precision"),
+                     max_rung_mb=spec.get("max_rung_mb"))
     return 0
 
 
 def run_cmd(args, timeout=None):
+    from ..ops.precision import ENV_VAR as _PRECISION_ENV
+    from ..ops.precision import resolve as _resolve_precision
+
+    if os.environ.get(_PRECISION_ENV):
+        # fail the campaign up front on a malformed environment value
+        # instead of letting every fused child / solve job die on it
+        try:
+            _resolve_precision(os.environ[_PRECISION_ENV])
+        except ValueError as e:
+            raise CliError(str(e))
     with open(args.bench_def) as f:
         bench_def = yaml.safe_load(f)
     jobs = expand_jobs(bench_def)
@@ -561,6 +634,9 @@ def run_cmd(args, timeout=None):
                         "out_dir": args.out_dir,
                         "progress_path": progress_path,
                         "hetero": getattr(args, "fuse_hetero", False),
+                        "precision": getattr(args, "precision", None),
+                        "max_rung_mb": getattr(args, "max_rung_mb",
+                                               None),
                         "consolidated_out": getattr(
                             args, "consolidated_out", None)}, f)
         failure = None
@@ -602,6 +678,23 @@ def run_cmd(args, timeout=None):
         job_id, argv, _meta = job
         out_path = os.path.join(args.out_dir, f"{job_id}.json")
         argv = argv[:3] + ["--output", out_path] + argv[3:]
+        conf = _meta["conf"]
+        # -p and --algo_params are the same solve option: a campaign
+        # may spell the key either way in command_options
+        ap = list(conf.get("algo_params", []) if isinstance(
+            conf.get("algo_params", []), list)
+            else [conf.get("algo_params")])
+        short = conf.get("p", [])
+        ap += short if isinstance(short, list) else [short]
+        job_has_precision = "precision" in conf or any(
+            str(p).strip().startswith("precision:") for p in ap)
+        if getattr(args, "precision", None) \
+                and _meta["command"] == "solve" \
+                and not job_has_precision:
+            # campaign-level policy for subprocess solve jobs too; a
+            # job's own precision setting wins (trailing options are
+            # fine after the positional files)
+            argv += ["--precision", args.precision]
         t0 = time.perf_counter()
         failure = None
         try:

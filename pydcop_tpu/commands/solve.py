@@ -69,15 +69,67 @@ def set_parser(subparsers):
                         help="websocket UI port base (thread mode)")
     parser.add_argument("--max_cycles", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--precision", default=None,
+                        choices=["f32", "bf16", "auto"],
+                        help="mixed-precision policy for the compiled "
+                             "data plane (engine/sharded modes): bf16 "
+                             "stores cost cubes + unary planes at half "
+                             "the bytes while sums and messages "
+                             "accumulate in f32 — integer-cost "
+                             "instances reproduce f32 selections and "
+                             "convergence cycles bit-exactly "
+                             "(docs/architecture.md).  auto = bf16 on "
+                             "TPU only.  Default: the "
+                             "PYDCOP_TPU_PRECISION env var, then f32. "
+                             "Equivalent to -p precision:<value> (the "
+                             "flag wins when both are given)")
     parser.set_defaults(func=run_cmd)
     return parser
 
 
+def _resolved_precision_name(args) -> Optional[str]:
+    """The precision to report in the result — only when one was
+    actually requested (flag, -p param, or environment); a plain f32
+    run keeps its historical result schema.  A malformed environment
+    value dies as a clean CLI error, like every other misconfiguration
+    (the argparse flag is already choice-validated)."""
+    from . import parse_algo_params
+    from ..ops.precision import ENV_VAR, resolve
+
+    requested = (getattr(args, "precision", None)
+                 or parse_algo_params(args.algo_params).get("precision")
+                 or os.environ.get(ENV_VAR))
+    if not requested:
+        return None
+    try:
+        return resolve(requested).name
+    except ValueError as e:
+        raise CliError(str(e))
+
+
 def run_cmd(args, timeout: Optional[float] = None):
     t0 = time.perf_counter()
+    if getattr(args, "precision", None) and args.mode != "sharded":
+        # the flag is sugar for the algorithm parameter; appending it
+        # last makes the flag win over an explicit -p precision:.
+        # Sharded mode skips the append: every sharded family takes
+        # the policy as a constructor kwarg (injected below) even when
+        # the algorithm's own engine params predate it (mgm2, dba, ...)
+        # — validating it as an algo-param would reject those.
+        args.algo_params = (args.algo_params or []) + [
+            f"precision:{args.precision}"]
+    precision_name = _resolved_precision_name(args)
     dcop = load_dcop_from_file(args.dcop_files)
     algo_def = build_algo_def(args.algo, args.algo_params,
                               mode=dcop.objective)
+    if precision_name and args.mode != "sharded" \
+            and "precision" not in algo_def.params:
+        # the algorithm never consults the policy (e.g. dpop): an
+        # env-var default must not mislabel an f32 computation as
+        # bf16 in the result.  Sharded mode is exempt — every sharded
+        # family consumes the policy even when the algorithm's own
+        # engine params predate it
+        precision_name = None
     collector, collector_thread, stop_evt = None, None, None
     if args.run_metrics:
         collector = queue.Queue()
@@ -98,6 +150,11 @@ def run_cmd(args, timeout: Optional[float] = None):
         params = {k: algo_def.params[k] for k in given}
         for engine_only in ("stop_cycle", "seed"):
             params.pop(engine_only, None)
+        if getattr(args, "precision", None):
+            # the flag wins over -p precision: (where declared); for
+            # families whose engine params predate the policy this is
+            # the only flag path — the kwarg exists on all of them
+            params["precision"] = args.precision
         # single-chip-only engine knob: reject loudly rather than let
         # the sharded solver constructor TypeError on it
         if params.pop("delta_on", "messages") != "messages":
@@ -137,6 +194,8 @@ def run_cmd(args, timeout: Optional[float] = None):
             "msg_count": 0,
             "msg_size": 0,
         }
+        if precision_name:
+            result["precision"] = precision_name
         if res.cost_trace:
             result["cost_trace"] = res.cost_trace
         if args.end_metrics:
@@ -196,6 +255,10 @@ def run_cmd(args, timeout: Optional[float] = None):
         "msg_count": metrics.get("msg_count", 0),
         "msg_size": metrics.get("msg_size", 0),
     }
+    if precision_name and args.mode == "engine":
+        # the orchestrated (thread/process) fabric computes in host
+        # float64 — the policy applies to the compiled data plane only
+        result["precision"] = precision_name
     if res.cost_trace:
         result["cost_trace"] = res.cost_trace
     if args.end_metrics:

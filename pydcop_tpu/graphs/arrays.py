@@ -32,6 +32,26 @@ BIG = np.float32(1e9)
 # Hard-constraint costs (inf in the model) are clipped to this so sums of a
 # few violations stay well under BIG and far from float32 overflow.
 HARD = np.float32(1e7)
+# The masking sentinel every masked min/argmin substitutes for invalid
+# slots (ops/kernels.py masked_argmin / masked_min / random_argmin and
+# the solvers' inlined selections).  Strictly above BIG so a masked slot
+# can never tie a BIG-padded (but valid-shaped) entry, and chosen to
+# survive bf16 rounding with the ordering intact: the precision layer
+# (ops/precision.py) stores cost planes in bfloat16, whose 8 significand
+# bits round both constants, so SENTINEL > BIG must hold AFTER rounding
+# too — asserted at import below, not assumed.
+SENTINEL = np.float32(2e9)
+
+try:
+    from ml_dtypes import bfloat16 as _bf16
+
+    assert float(_bf16(SENTINEL)) > float(_bf16(BIG)) > float(
+        _bf16(HARD)) > 0.0, (
+        "masking sentinels must stay strictly ordered after bf16 "
+        "rounding (SENTINEL > BIG > HARD); adjust the constants")
+    del _bf16
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
 
 
 def _clip_costs(cube: np.ndarray, sign: float) -> np.ndarray:
@@ -61,19 +81,21 @@ def _pad_var_plane(arrays, n_vars: int):
     pad_mask = np.zeros((pad, D), dtype=bool)
     pad_mask[:, 0] = True
     domain_mask = np.concatenate([arrays.domain_mask, pad_mask])
-    pad_costs = np.full((pad, D), BIG, dtype=np.float32)
+    # dtype-preserving: a bf16-stored instance pads with bf16 phantoms
+    pad_costs = np.full((pad, D), BIG, dtype=arrays.var_costs.dtype)
     pad_costs[:, 0] = 0.0
     var_costs = np.concatenate([arrays.var_costs, pad_costs])
     var_valid = np.arange(n_vars) < V
     return var_names, domain_size, domain_mask, var_costs, var_valid
 
 
-def _phantom_cube(arity: int, max_domain: int) -> np.ndarray:
+def _phantom_cube(arity: int, max_domain: int,
+                  dtype=np.float32) -> np.ndarray:
     """The phantom factor's identity cost cube: 0 at the all-zero
     assignment (the only valid assignment of phantom variables, whose
     domains are the single slot 0) and BIG elsewhere — the same padded
     form a real domain-1 constraint compiles to."""
-    cube = np.full((max_domain,) * arity, BIG, dtype=np.float32)
+    cube = np.full((max_domain,) * arity, BIG, dtype=dtype)
     cube[(0,) * arity] = 0.0
     return cube
 
@@ -96,6 +118,26 @@ def _check_pad_targets(arrays, n_vars: int, bucket_slots):
         raise ValueError(
             "padding in phantom factors needs at least one phantom "
             "variable to anchor them: pass n_vars > instance n_vars")
+
+
+def _apply_precision(arrays, precision):
+    """Cast the cost planes (cubes + unary variable costs) of freshly
+    built arrays to the policy's ``store_dtype``
+    (``ops/precision.py``).  Index tables, masks and sizes stay
+    integer/bool; ``None`` keeps the f32 default so every existing
+    caller is untouched.  bf16 storage is exact for integer costs with
+    ``|cost| <= 256`` — the built-in generators — and the BIG padding
+    constant rounds monotonically (SENTINEL > bf16(BIG) asserted
+    above), so masked slots keep dominating every reduction."""
+    if precision is None:
+        return arrays
+    from ..ops.precision import resolve, store
+
+    policy = resolve(precision)
+    arrays.var_costs = store(arrays.var_costs, policy)
+    for b in arrays.buckets:
+        b.cubes = store(b.cubes, policy)
+    return arrays
 
 
 def _bind_externals(dcop: Optional[DCOP], constraints: list) -> list:
@@ -191,7 +233,8 @@ class FactorGraphArrays:
     @classmethod
     def build(cls, dcop: DCOP,
               variables=None, constraints=None,
-              arity_sorted: bool = False) -> "FactorGraphArrays":
+              arity_sorted: bool = False,
+              precision=None) -> "FactorGraphArrays":
         if variables is None:
             variables = list(dcop.variables.values())
         if constraints is None:
@@ -249,7 +292,7 @@ class FactorGraphArrays:
             buckets.append(FactorBucket(
                 arity, np.array(ids, dtype=np.int32), cubes, e_ids, v_ids))
 
-        return cls(
+        out = cls(
             n_vars=V, n_factors=F, n_edges=E, max_domain=D, sign=sign,
             var_names=var_names, factor_names=factor_names,
             domain_size=domain_size, domain_mask=domain_mask,
@@ -258,6 +301,7 @@ class FactorGraphArrays:
             edge_factor=np.array(edge_factor, dtype=np.int32),
             buckets=buckets,
         )
+        return _apply_precision(out, precision)
 
     def assignment_from_indices(self, idx: np.ndarray,
                                 variables) -> Dict[str, object]:
@@ -308,7 +352,9 @@ class FactorGraphArrays:
                                  for f in b.factor_ids]
             if pad:
                 cubes.append(np.broadcast_to(
-                    _phantom_cube(arity, D), (pad,) + (D,) * arity))
+                    _phantom_cube(arity, D,
+                                  dtype=self.var_costs.dtype),
+                    (pad,) + (D,) * arity))
                 v_ids.append(np.full((pad, arity), sink,
                                      dtype=np.int32))
                 factor_names += [f"__padf{arity}_{i}"
@@ -379,7 +425,8 @@ class HypergraphArrays:
 
     @classmethod
     def build(cls, dcop: DCOP,
-              variables=None, constraints=None) -> "HypergraphArrays":
+              variables=None, constraints=None,
+              precision=None) -> "HypergraphArrays":
         if variables is None:
             variables = list(dcop.variables.values())
         if constraints is None:
@@ -441,7 +488,7 @@ class HypergraphArrays:
             degree[s] += 1
         max_arity = max((c.arity for c in constraints), default=1)
 
-        return cls(
+        out = cls(
             n_vars=V, n_constraints=len(constraints), max_domain=D,
             sign=sign, var_names=var_names,
             domain_size=domain_size, domain_mask=domain_mask,
@@ -452,6 +499,7 @@ class HypergraphArrays:
             max_degree=int(degree.max()) if V else 0,
             max_arity_minus_one=max(0, max_arity - 1),
         )
+        return _apply_precision(out, precision)
 
     def pad_to(self, n_vars: int, bucket_slots: Dict[int, int],
                n_pairs: Optional[int] = None) -> "HypergraphArrays":
@@ -489,7 +537,9 @@ class HypergraphArrays:
             v_ids = [np.asarray(b.var_ids)] if b is not None else []
             if pad:
                 cubes.append(np.broadcast_to(
-                    _phantom_cube(arity, D), (pad,) + (D,) * arity))
+                    _phantom_cube(arity, D,
+                                  dtype=self.var_costs.dtype),
+                    (pad,) + (D,) * arity))
                 v_ids.append(np.full((pad, arity), sink,
                                      dtype=np.int32))
             cubes = np.concatenate(cubes) if len(cubes) > 1 \

@@ -1,14 +1,20 @@
 from .kernels import (
     assignment_cost_device,
+    assignment_cost_violations,
     bucket_cost,
     candidate_costs,
     factor_messages,
     masked_argmin,
     masked_min,
+    prefix_uniform,
     random_argmin,
 )
+from .precision import BF16, F32, Policy
+from .precision import resolve as resolve_precision
 
 __all__ = [
-    "assignment_cost_device", "bucket_cost", "candidate_costs",
-    "factor_messages", "masked_argmin", "masked_min", "random_argmin",
+    "BF16", "F32", "Policy", "assignment_cost_device",
+    "assignment_cost_violations", "bucket_cost", "candidate_costs",
+    "factor_messages", "masked_argmin", "masked_min", "prefix_uniform",
+    "random_argmin", "resolve_precision",
 ]

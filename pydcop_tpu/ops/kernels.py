@@ -12,6 +12,16 @@ These replace the reference's per-message Python hot loops (SURVEY.md §3.3):
 * ``buckets_cost``           ↔ dcop.solution_cost (dcop.py:308) on device.
 
 All shapes are static per arity bucket; everything here is jit-traceable.
+
+Precision (ops/precision.py): the kernels are dtype-polymorphic over the
+cost planes — a bf16-stored cube flows through broadcasts and ``min``
+reductions in its own dtype (rounding is monotone, so min/argmin are
+order-preserving), and every SUM upcasts to the accumulation dtype
+(f32 by default) exactly at the reduction boundary: ``segment_sum``
+contributions, per-variable belief assembly, and total-cost
+accumulation.  jax's type promotion does the upcast for free wherever
+a bf16 plane meets an f32 message array; the explicit ``.astype`` calls
+below cover the reductions whose inputs are pure plane gathers.
 """
 
 from typing import List, Optional, Sequence, Tuple
@@ -19,7 +29,15 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..graphs.arrays import BIG
+from ..graphs.arrays import HARD, SENTINEL
+
+
+def _masked(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Invalid slots replaced by the SENTINEL in the costs' OWN dtype:
+    a bf16 plane stays bf16 through the min/argmin (ordering survives
+    rounding — asserted at import in graphs/arrays.py), an f32 plane is
+    bit-identical to the historical ``BIG * 2`` substitution."""
+    return jnp.where(mask, costs, jnp.asarray(SENTINEL, costs.dtype))
 
 
 def _broadcast_q(q_p: jnp.ndarray, position: int, arity: int) -> jnp.ndarray:
@@ -39,6 +57,11 @@ def factor_messages(cubes: jnp.ndarray,
     q: per-position incoming messages, each (F, D).
     Returns per-position outgoing messages, each (F, D):
       r_p[d] = min over other vars' values of (cube + sum_{p'!=p} q_{p'}).
+
+    Dtype: the output rides ``promote_types(cubes, q)`` — bf16 cubes
+    against f32 messages upcast at the first broadcast-add (the exact
+    upcast, since bf16 is a prefix of f32), so the sums inside the min
+    sweep never accumulate in reduced precision.
     """
     arity = cubes.ndim - 1
     total = cubes
@@ -54,19 +77,25 @@ def factor_messages(cubes: jnp.ndarray,
 
 
 def candidate_costs(cubes: jnp.ndarray, var_ids: jnp.ndarray,
-                    x: jnp.ndarray, n_vars: int) -> jnp.ndarray:
+                    x: jnp.ndarray, n_vars: int,
+                    accum_dtype=jnp.float32) -> jnp.ndarray:
     """Contribution of one constraint bucket to every variable's
     per-candidate-value cost, holding all *other* variables at ``x``.
 
     cubes: (C, D, ..., D); var_ids: (C, arity); x: (V,) value indices.
     Returns (V, D): sum over constraints of the cost slice obtained by
     fixing every scope variable except the target at its current value.
+
+    Accumulates in ``accum_dtype`` (f32): the gathered slices may be
+    bf16-stored, but a high-degree variable sums hundreds of them —
+    the textbook case where reduced-precision accumulation drifts
+    (tests/test_precision.py asserts the f32 path engages).
     """
     arity = cubes.ndim - 1
     C = cubes.shape[0]
     D = cubes.shape[-1]
     vals = x[var_ids]  # (C, arity)
-    total = jnp.zeros((n_vars, D), dtype=cubes.dtype)
+    total = jnp.zeros((n_vars, D), dtype=accum_dtype)
     for p in range(arity):
         t = jnp.moveaxis(cubes, p + 1, arity)  # target axis last
         t = t.reshape(C, -1, D)
@@ -76,13 +105,16 @@ def candidate_costs(cubes: jnp.ndarray, var_ids: jnp.ndarray,
                 idx = idx * D + vals[:, q]
         contrib = t[jnp.arange(C), idx, :]  # (C, D)
         total = total + jax.ops.segment_sum(
-            contrib, var_ids[:, p], num_segments=n_vars)
+            contrib.astype(accum_dtype), var_ids[:, p],
+            num_segments=n_vars)
     return total
 
 
 def bucket_cost(cubes: jnp.ndarray, var_ids: jnp.ndarray,
                 x: jnp.ndarray) -> jnp.ndarray:
-    """Per-constraint cost of assignment ``x`` for one bucket: (C,)."""
+    """Per-constraint cost of assignment ``x`` for one bucket: (C,).
+    A pure gather — values come back in the cubes' store dtype; callers
+    summing them upcast at their reduction boundary."""
     C = cubes.shape[0]
     D = cubes.shape[-1]
     arity = cubes.ndim - 1
@@ -95,22 +127,60 @@ def bucket_cost(cubes: jnp.ndarray, var_ids: jnp.ndarray,
 
 def assignment_cost_device(buckets: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
                            var_costs: jnp.ndarray,
-                           x: jnp.ndarray) -> jnp.ndarray:
-    """Total cost of assignment ``x``: constraint costs + unary costs."""
+                           x: jnp.ndarray,
+                           accum_dtype=jnp.float32) -> jnp.ndarray:
+    """Total cost of assignment ``x``: constraint costs + unary costs,
+    accumulated in ``accum_dtype`` regardless of the planes' store
+    dtype (cost traces stay f32 under the bf16 policy)."""
     V = var_costs.shape[0]
-    total = jnp.sum(var_costs[jnp.arange(V), x])
+    total = jnp.sum(
+        var_costs[jnp.arange(V), x].astype(accum_dtype))
     for cubes, var_ids in buckets:
-        total = total + jnp.sum(bucket_cost(cubes, var_ids, x))
+        total = total + jnp.sum(
+            bucket_cost(cubes, var_ids, x).astype(accum_dtype))
     return total
 
 
+def assignment_cost_violations(
+        buckets: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+        var_costs: jnp.ndarray, x: jnp.ndarray,
+        hard: float = float(HARD)) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device twin of ``DCOP.solution_cost(assignment)``: (soft cost,
+    hard-violation count) of assignment ``x`` in the compiled (signed,
+    clipped) cost space.
+
+    The array compiler clips infinite model costs to ``±HARD``
+    (graphs/arrays.py _clip_costs), so an entry with ``|cost| >= hard``
+    IS the compiled marker of a hard violation: it is counted and
+    excluded from the soft sum, exactly like the host evaluator with
+    the default ``infinity`` threshold.  (A model whose *finite* costs
+    reach HARD = 1e7 is outside the compiled representation's contract
+    everywhere, not just here.)  Sums accumulate in f32; the returned
+    cost is signed (multiply by ``arrays.sign`` for the model-space
+    value).
+    """
+    V = var_costs.shape[0]
+    unary = var_costs[jnp.arange(V), x].astype(jnp.float32)
+    u_viol = jnp.abs(unary) >= hard
+    cost = jnp.sum(jnp.where(u_viol, 0.0, unary))
+    violations = jnp.sum(u_viol.astype(jnp.int32))
+    for cubes, var_ids in buckets:
+        c = bucket_cost(cubes, var_ids, x).astype(jnp.float32)
+        v = jnp.abs(c) >= hard
+        cost = cost + jnp.sum(jnp.where(v, 0.0, c))
+        violations = violations + jnp.sum(v.astype(jnp.int32))
+    return cost, violations
+
+
 def masked_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Argmin over valid domain slots, rows = variables."""
-    return jnp.argmin(jnp.where(mask, costs, BIG * 2), axis=-1)
+    """Argmin over valid domain slots, rows = variables.  Runs in the
+    costs' own dtype (min is order-preserving under monotone bf16
+    rounding; sums are not — see module doc)."""
+    return jnp.argmin(_masked(costs, mask), axis=-1)
 
 
 def masked_min(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    return jnp.min(jnp.where(mask, costs, BIG * 2), axis=-1)
+    return jnp.min(_masked(costs, mask), axis=-1)
 
 
 def prefix_uniform(key: jax.Array, n: int,
@@ -132,9 +202,16 @@ def prefix_uniform(key: jax.Array, n: int,
 def random_argmin(key: jax.Array, costs: jnp.ndarray,
                   mask: jnp.ndarray) -> jnp.ndarray:
     """Argmin with uniform random tie-breaking among equal minima —
-    replaces the reference's ``random.choice(best_values)`` idiom."""
-    c = jnp.where(mask, costs, BIG * 2)
+    replaces the reference's ``random.choice(best_values)`` idiom.
+
+    The tie-break noise is drawn with :func:`prefix_uniform`, so row
+    ``i``'s draw depends only on ``(key, i)``: padding the variable
+    plane (phantom rows appended by ``pad_to``) leaves every real row's
+    tie-break unchanged.  The previous ``jax.random.uniform(key,
+    c.shape)`` draw was shape-coupled through the threefry counter
+    layout — the exact hazard ``prefix_uniform`` exists to kill."""
+    c = _masked(costs, mask)
     m = jnp.min(c, axis=-1, keepdims=True)
     is_min = (c <= m) & mask
-    noise = jax.random.uniform(key, c.shape)
+    noise = prefix_uniform(key, c.shape[0], width=c.shape[-1])
     return jnp.argmax(is_min * (1.0 + noise), axis=-1)

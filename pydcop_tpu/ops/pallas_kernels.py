@@ -57,6 +57,19 @@ def _binary_kernel(cube_ref, q0_ref, q1_ref, m0_ref, m1_ref):
         m1_ref[d1, :] = acc
 
 
+def _common_dtype(cubesT, qs):
+    """The kernels' working dtype: cost planes may arrive bf16-stored
+    (ops/precision.py) while messages ride the f32 accumulation dtype;
+    the hand kernels sum cube + messages per joint assignment, so the
+    bf16 plane upcasts ONCE at kernel entry (exact — bf16 is a prefix
+    of f32) instead of re-rounding every partial sum inside the
+    unrolled sweep."""
+    dt = cubesT.dtype
+    for q in qs:
+        dt = jnp.promote_types(dt, q.dtype)
+    return dt
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def factor_messages_binary_lane_major(cubesT, q0, q1, interpret=False):
     """Fused binary-factor min-marginals, lane-major (see module doc).
@@ -66,6 +79,9 @@ def factor_messages_binary_lane_major(cubesT, q0, q1, interpret=False):
     """
     from jax.experimental import pallas as pl
 
+    dt = _common_dtype(cubesT, (q0, q1))
+    cubesT = cubesT.astype(dt)
+    q0, q1 = q0.astype(dt), q1.astype(dt)
     D, _, F = cubesT.shape
     F_pad = ((F + BLK_F - 1) // BLK_F) * BLK_F
     if F_pad != F:
@@ -149,6 +165,9 @@ def factor_messages_nary_lane_major(cubesT, qs, interpret=False):
     if arity != len(qs):
         raise ValueError(
             f"cubesT has {arity} domain axes but {len(qs)} q arrays")
+    dt = _common_dtype(cubesT, qs)
+    cubesT = cubesT.astype(dt)
+    qs = [q.astype(dt) for q in qs]
     D, F = cubesT.shape[0], cubesT.shape[-1]
     F_pad = ((F + BLK_F - 1) // BLK_F) * BLK_F
     if F_pad != F:

@@ -1,0 +1,117 @@
+"""Mixed-precision policy: bf16 cost planes with f32 accumulation.
+
+The message-passing hot paths are bandwidth-and-dispatch dominated
+(benchmarks/PERF_NOTES.md rounds 5-8): the big per-cycle reads are the
+stacked cost hypercubes ``(F, D, ..., D)`` and the variable cost
+planes ``(V, D)``.  bfloat16 is native on TPU and halves the bytes of
+every cost-plane read; the numerical contract that makes this shippable
+splits the work into three dtype roles:
+
+* ``store_dtype`` — what the cost planes (cubes, unary variable costs)
+  are STORED in.  bf16 has f32's exponent range and 8 significand
+  bits: every integer with ``|cost| <= 256`` is exact, so all built-in
+  coloring / Ising / PEAV / SECP generators round-trip without loss.
+* ``compute_dtype`` — what plane-local elementwise work and
+  ``min`` / ``argmin`` reductions may run in.  ``min`` is safe in bf16
+  because rounding f32 -> bf16 is monotone (order-preserving): the
+  argmin over rounded values is the argmin over exact values whenever
+  the exact values are representable, and never inverts an order.
+* ``accum_dtype`` — what SUMS run in: ``segment_sum``, the
+  per-variable ``sum_r`` belief assembly, mean normalization, total
+  costs and cost traces.  Sums are NOT safe in reduced precision: each
+  partial sum re-rounds, so a high-degree variable accumulating
+  hundreds of bf16 messages drifts by O(degree * ulp).  Every kernel
+  upcasts to ``accum_dtype`` exactly at these reduction boundaries.
+
+The recurrent MaxSum message planes (q, r) also ride ``accum_dtype``:
+they are sums by construction (beliefs minus echoes, damped running
+averages), and rounding the recurrence each cycle would break the
+bit-exact reproduction contract below.  The bandwidth win is the cost
+planes, which are re-read every cycle and dominate bytes (a binary
+factor's cube is ``D**2`` cells vs ``2 D`` message cells).
+
+Correctness contract (asserted by ``tests/test_precision.py`` and
+``suite.py bench_precision``): on integer-valued cost instances with
+``|cost| <= 256``, a ``bf16`` run reproduces the ``f32`` run's
+selections AND convergence cycles bit-exactly; on non-integer
+instances the guard is a documented final-cost tolerance plus
+identical violation counts (store rounding perturbs each table entry
+by at most one bf16 ulp, ~0.4%).
+"""
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; keep the import failure loud but late
+    import ml_dtypes
+
+    bfloat16 = ml_dtypes.bfloat16
+except ImportError:  # pragma: no cover - jax always depends on it
+    bfloat16 = None
+
+#: environment default consumed when a solver/CLI gives no explicit
+#: precision (the CLI flag always wins over the environment)
+ENV_VAR = "PYDCOP_TPU_PRECISION"
+
+
+@dataclass(frozen=True)
+class Policy:
+    """One named precision policy (see module doc for the roles)."""
+
+    name: str
+    store_dtype: object
+    compute_dtype: object
+    accum_dtype: object
+
+    @property
+    def store_itemsize(self) -> int:
+        """Bytes per cost-plane cell — the unit ``parallel/bucketing``
+        prices padded rungs in."""
+        return int(np.dtype(self.store_dtype).itemsize)
+
+
+F32 = Policy("f32", np.float32, np.float32, np.float32)
+BF16 = Policy("bf16", bfloat16, bfloat16, np.float32)
+
+POLICIES = {"f32": F32, "bf16": BF16}
+
+
+def resolve(precision=None) -> Policy:
+    """Resolve a precision request to a :class:`Policy`.
+
+    ``None`` falls back to the ``PYDCOP_TPU_PRECISION`` environment
+    variable, then ``f32``.  ``auto`` picks ``bf16`` on a TPU backend
+    (where bf16 planes are native tile currency) and ``f32`` elsewhere,
+    so a portable script never silently changes CPU results.
+    """
+    if isinstance(precision, Policy):
+        return precision
+    if precision is None:
+        precision = os.environ.get(ENV_VAR) or "f32"
+    name = str(precision).strip().lower()
+    if name == "auto":
+        import jax
+
+        name = "bf16" if jax.default_backend() == "tpu" else "f32"
+    try:
+        policy = POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of "
+            f"{sorted(POLICIES)} or 'auto'")
+    if policy.store_dtype is None:  # pragma: no cover - see import
+        raise RuntimeError(
+            "bf16 precision needs the ml_dtypes package (a jax "
+            "dependency); it failed to import")
+    return policy
+
+
+def store(arr: np.ndarray, policy: Policy) -> np.ndarray:
+    """Cast a host cost plane to the policy's store dtype (no copy when
+    already there)."""
+    arr = np.asarray(arr)
+    if arr.dtype == np.dtype(policy.store_dtype):
+        return arr
+    return arr.astype(policy.store_dtype)

@@ -73,7 +73,9 @@ def build_mesh_cost(mesh, n_vars: int,
             for cu, vi, m in zip(cubes_l, vids_l, mask_of):
                 if cu.shape[0] == 0:
                     continue
-                c = bucket_cost(cu, vi, x_ext)
+                # upcast at the reduction boundary: cubes may be
+                # bf16-stored (ops/precision.py), the trace sums in f32
+                c = bucket_cost(cu, vi, x_ext).astype(jnp.float32)
                 if m is not None:
                     c = jnp.where(m, c, 0.0)
                 tot = tot + jnp.sum(c)

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
 from ..algorithms.maxsum import MaxSumSolver
+from ..ops.kernels import assignment_cost_violations
 
 
 def _batch_keys(seed, seeds, b):
@@ -66,6 +67,7 @@ class _BatchedRunnerBase:
     def __init__(self):
         self.max_cycles = 200
         self._jitted: Dict[int, object] = {}
+        self._eval_jit = None
         self.n_vars_true: Optional[List[int]] = None
 
     def _drive(self, base, state):
@@ -117,6 +119,45 @@ class _BatchedRunnerBase:
             return [sel[i] for i in range(self.B)]
         return [sel[i, :n] for i, n in enumerate(self.n_vars_true)]
 
+    def _eval_one(self, args, x):
+        """One instance's (cost, violations) for :meth:`evaluate` —
+        buckets and unary costs from the vmapped args on the hetero
+        path, from the shared template otherwise.  Works for both
+        bucket flavors (FactorBucket / ConstraintBucket): only
+        ``var_ids`` and the stacked cubes are read."""
+        if self._hetero:
+            buckets = list(zip(args["cubes"], args["var_ids"]))
+            var_costs = args["var_costs"]
+        else:
+            buckets = [
+                (c, jnp.asarray(b.var_ids))
+                for c, b in zip(args["cubes"], self._template.buckets)]
+            var_costs = jnp.asarray(self._template.var_costs)
+        return assignment_cost_violations(buckets, var_costs, x)
+
+    def evaluate(self, sel: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Device-side cost/violation re-evaluation of the (B, V)
+        selections: ONE jitted vmapped call over the same stacked
+        instance arrays the solve ran on, replacing the per-job host
+        Python re-walk of every constraint (PERF_NOTES round 8 named
+        it the fused leg's remaining cost).  Phantom rows contribute
+        exactly zero (their only valid slot costs 0), so padded and
+        unpadded evaluations agree.  Returns (model-space costs (B,),
+        hard-violation counts (B,)) — the compiled ``±HARD`` clip is
+        the violation marker, mirroring ``DCOP.solution_cost`` with
+        the default infinity threshold
+        (``ops.kernels.assignment_cost_violations``)."""
+        fn = self._eval_jit
+        if fn is None:
+            fn = self._eval_jit = jax.jit(
+                jax.vmap(self._eval_one, in_axes=(0, 0)))
+        cost, viol = fn(self._instance_args,
+                        jnp.asarray(np.asarray(sel, dtype=np.int32)))
+        # device costs are signed (min-compiled); undo for max models
+        return (self._sign * np.asarray(cost, dtype=np.float64),
+                np.asarray(viol))
+
 
 _MISSING = object()
 
@@ -151,6 +192,7 @@ class BatchedMaxSum(_BatchedRunnerBase):
         super().__init__()
         self.solver = MaxSumSolver(template, **params)
         self._template = template
+        self._sign = float(template.sign)
         self._hetero = instances is not None
         if self._hetero:
             if self.solver._canonical is None:
@@ -165,7 +207,9 @@ class BatchedMaxSum(_BatchedRunnerBase):
         elif cubes_batches is not None:
             batch = cubes_batches[0].shape[0]
             self._instance_args = {
-                "cubes": [jnp.asarray(cb) for cb in cubes_batches]}
+                "cubes": [jnp.asarray(
+                    cb, dtype=self.solver.policy.store_dtype)
+                    for cb in cubes_batches]}
         else:
             self._instance_args = {"cubes": [
                 jnp.broadcast_to(cubes[None], (batch,) + cubes.shape)
@@ -212,15 +256,21 @@ class BatchedMaxSum(_BatchedRunnerBase):
     def _build_args(self, instances):
         _check_same_shape(instances)
         nb = len(instances[0].buckets)
+        store = self.solver.policy.store_dtype
         return {
-            "cubes": [_stacked(instances, lambda a, i=i:
-                               a.buckets[i].cubes)
-                      for i in range(nb)],
+            # cost planes ride the policy's store dtype (bf16 halves
+            # the per-rung cell bytes, letting bucketing.py admit
+            # larger rungs under the same byte budget)
+            "cubes": [jnp.asarray(
+                _stacked(instances, lambda a, i=i: a.buckets[i].cubes),
+                dtype=store) for i in range(nb)],
             "var_ids": [_stacked(instances, lambda a, i=i:
                                  a.buckets[i].var_ids)
                         for i in range(nb)],
             "edge_var": _stacked(instances, lambda a: a.edge_var),
-            "var_costs": _stacked(instances, lambda a: a.var_costs),
+            "var_costs": jnp.asarray(
+                _stacked(instances, lambda a: a.var_costs),
+                dtype=store),
             "domain_mask": _stacked(instances, lambda a: a.domain_mask),
             "domain_size": _stacked(instances, lambda a: a.domain_size),
         }
@@ -258,6 +308,7 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
         super().__init__()
         self.solver = self.solver_cls(template, **params)
         self._template = template
+        self._sign = float(template.sign)
         self._hetero = instances is not None
         # p_mode=arity derives a per-variable probability vector from
         # the topology: on the hetero path each instance batches its
@@ -273,7 +324,9 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
         elif cubes_batches is not None:
             batch = cubes_batches[0].shape[0]
             self._instance_args = {
-                "cubes": [jnp.asarray(cb) for cb in cubes_batches]}
+                "cubes": [jnp.asarray(
+                    cb, dtype=self.solver.policy.store_dtype)
+                    for cb in cubes_batches]}
         else:
             self._instance_args = {"cubes": [
                 jnp.broadcast_to(cubes[None], (batch,) + cubes.shape)
@@ -320,10 +373,11 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
     def _build_args(self, instances):
         _check_same_shape(instances)
         nb = len(instances[0].buckets)
+        store = self.solver.policy.store_dtype
         args = {
-            "cubes": [_stacked(instances, lambda a, i=i:
-                               a.buckets[i].cubes)
-                      for i in range(nb)],
+            "cubes": [jnp.asarray(
+                _stacked(instances, lambda a, i=i: a.buckets[i].cubes),
+                dtype=store) for i in range(nb)],
             "var_ids": [_stacked(instances, lambda a, i=i:
                                  a.buckets[i].var_ids)
                         for i in range(nb)],
@@ -331,6 +385,8 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
         for name in self._swap_attrs:
             args[name] = _stacked(instances,
                                   lambda a, n=name: getattr(a, n))
+        args["var_costs"] = jnp.asarray(args["var_costs"],
+                                        dtype=store)
         if self._swap_probability:
             from ..algorithms.dsa import arity_probability
 

@@ -120,7 +120,9 @@ def _base_rung(profile: ShapeProfile) -> Rung:
 
 
 def plan_rungs(profiles: List[ShapeProfile],
-               max_waste: float = 2.0) -> List["Rung"]:
+               max_waste: float = 2.0,
+               max_rung_bytes: Optional[int] = None,
+               bytes_per_cell: int = 4) -> List["Rung"]:
     """Group instance profiles into a padding ladder.
 
     Pass 1 assigns each profile its power-of-two home rung (identical
@@ -129,12 +131,25 @@ def plan_rungs(profiles: List[ShapeProfile],
     member's padded/true cell ratio stays <= ``max_waste`` — fewer
     rungs means fewer compiled programs, the quantity the
     ``bench_hetero_batch`` contract asserts.  Members lists index into
-    ``profiles``."""
+    ``profiles``.
+
+    ``max_rung_bytes`` (optional) caps the padded PER-INSTANCE memory
+    a consolidation target may reach, priced at ``bytes_per_cell`` —
+    the precision policy's store itemsize (``Policy.store_itemsize``).
+    This is where mixed precision buys program count: a campaign run
+    at bf16 advertises 2-byte cells, so the same byte budget admits
+    rungs twice as large and more small topologies merge into them.
+    ``None`` keeps the historical cells-only behavior."""
     by_sig: Dict[Tuple, Rung] = {}
     for i, p in enumerate(profiles):
         rung = _base_rung(p)
         rung = by_sig.setdefault(rung.signature, rung)
         rung.members.append(i)
+
+    def fits_budget(rung: "Rung") -> bool:
+        if max_rung_bytes is None:
+            return True
+        return rung.cells * bytes_per_cell <= max_rung_bytes
 
     rungs = sorted(by_sig.values(), key=lambda r: r.cells,
                    reverse=True)
@@ -142,9 +157,10 @@ def plan_rungs(profiles: List[ShapeProfile],
     for rung in rungs:
         target = None
         for big in kept:
-            if all(big.covers(profiles[i]) and
-                   big.waste_for(profiles[i]) <= max_waste
-                   for i in rung.members):
+            if fits_budget(big) and all(
+                    big.covers(profiles[i]) and
+                    big.waste_for(profiles[i]) <= max_waste
+                    for i in rung.members):
                 if target is None or big.cells < target.cells:
                     target = big
         if target is not None:
@@ -153,13 +169,29 @@ def plan_rungs(profiles: List[ShapeProfile],
             kept.append(rung)
     for rung in kept:
         rung.members.sort()
+        if not fits_budget(rung):
+            # the budget can veto merges, but a single instance's own
+            # power-of-two home rung may already exceed it — that rung
+            # cannot be shrunk, so say so instead of silently planning
+            # an over-budget program (repo policy: no silent caps)
+            import warnings
+
+            warnings.warn(
+                f"fuse-hetero rung {rung.signature} needs "
+                f"{rung.cells * bytes_per_cell} bytes per instance, "
+                f"over the {max_rung_bytes}-byte budget; the budget "
+                "only bounds consolidation merges — this instance "
+                "shape alone exceeds it", RuntimeWarning)
     return kept
 
 
 def plan_stats(rungs: List[Rung],
-               profiles: List[ShapeProfile]) -> Dict[str, object]:
+               profiles: List[ShapeProfile],
+               bytes_per_cell: int = 4) -> Dict[str, object]:
     """Aggregate ladder stats for campaign results and the bench
-    contract: compiled-program count and total-cell padding waste."""
+    contract: compiled-program count, total-cell padding waste, and
+    the padded memory priced at the precision policy's store itemsize
+    (``bytes_per_cell``: 4 for f32, 2 for bf16)."""
     true_cells = padded_cells = 0
     for rung in rungs:
         for i in rung.members:
@@ -170,5 +202,6 @@ def plan_stats(rungs: List[Rung],
         "jobs": sum(len(r.members) for r in rungs),
         "true_cells": true_cells,
         "padded_cells": padded_cells,
+        "padded_bytes": padded_cells * bytes_per_cell,
         "padding_waste": round(padded_cells / max(true_cells, 1), 3),
     }

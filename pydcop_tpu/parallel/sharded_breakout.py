@@ -110,6 +110,16 @@ class ShardedLocalSearch(MeshSolverMixin):
     def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1,
                  **params):
         enable_persistent_cache()
+        # mixed-precision policy (ops/precision.py): handled at the
+        # HARNESS level — popped here so solver classes that predate
+        # the policy never see an unknown kwarg.  Only the cost-plane
+        # constants (cubes + per-constraint optima) are store-cast in
+        # _make_consts; per-constraint ALGORITHM state (DBA weights,
+        # GDBA modifiers) keeps full precision — weights are counters
+        # whose increments a bf16 store would start dropping at 256
+        from ..ops.precision import resolve as _resolve_precision
+
+        self.policy = _resolve_precision(params.pop("precision", None))
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -260,10 +270,20 @@ class ShardedLocalSearch(MeshSolverMixin):
 
     def _make_consts(self):
         mesh = self.mesh
+        store = jnp.dtype(self.policy.store_dtype)
+        # cost-plane attrs ride the store dtype; algorithm-state attrs
+        # (weights, modifiers, violation indicators) keep theirs
+        store_attrs = {"buckets", "bucket_optima"}
+
+        def place(a, cast):
+            if cast and jnp.issubdtype(a.dtype, jnp.floating) \
+                    and a.dtype != store:
+                a = a.astype(store)
+            return jax.device_put(a, NamedSharding(mesh, P("tp")))
+
         return tuple(
             [jax.tree.map(
-                lambda a: jax.device_put(
-                    a, NamedSharding(mesh, P("tp"))), b)
+                lambda a, _c=(attr in store_attrs): place(a, _c), b)
              for b in self._attr_stacks[attr]]
             for attr in self.bucket_attrs
         )

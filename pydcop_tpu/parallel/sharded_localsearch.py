@@ -26,8 +26,9 @@ from ._compat import shard_map
 from ._mesh_cost import build_mesh_cost
 from ..engine._cache import enable_persistent_cache
 from ..engine.mesh_engine import MeshSolverMixin
-from ..graphs.arrays import BIG, HypergraphArrays
+from ..graphs.arrays import SENTINEL, HypergraphArrays
 from ..ops.kernels import bucket_cost, candidate_costs
+from ..ops.precision import resolve as resolve_precision
 
 
 def _partition_constraints(arrays: HypergraphArrays, tp: int):
@@ -62,8 +63,12 @@ class ShardedDsa(MeshSolverMixin):
     """DSA-B over a (dp, tp) mesh; ``batch`` independent instances."""
 
     def __init__(self, arrays: HypergraphArrays, mesh,
-                 probability: float = 0.7, batch: int = 1):
+                 probability: float = 0.7, batch: int = 1,
+                 precision=None):
         enable_persistent_cache()
+        # mixed-precision policy: constraint cubes + unary planes in
+        # store_dtype, candidate sums in accum f32 (ops/precision.py)
+        self.policy = resolve_precision(precision)
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -95,11 +100,16 @@ class ShardedDsa(MeshSolverMixin):
             def one(x1, k1):
                 # shard-local constraint contributions; unary costs are
                 # added AFTER the psum (they are replicated — adding
-                # them per shard would count them tp times)
-                cand = jnp.zeros_like(var_costs)  # (V+1, D)
+                # them per shard would count them tp times).  The
+                # accumulator is f32 even when the planes are
+                # bf16-stored: sums upcast at the reduction boundary
+                cand = jnp.zeros(var_costs.shape,
+                                 dtype=self.policy.accum_dtype)
                 violated = jnp.zeros((V + 1,), dtype=jnp.int32)
                 for a, cu, vi in zip(arities, cubes, var_ids):
-                    cand = cand + candidate_costs(cu, vi, x1, V + 1)
+                    cand = cand + candidate_costs(
+                        cu, vi, x1, V + 1,
+                        accum_dtype=self.policy.accum_dtype)
                     ccost = bucket_cost(cu, vi, x1)
                     # per-constraint optimum from the shard-local cubes
                     # (dummy all-zero constraints: optimum == cost == 0,
@@ -111,7 +121,8 @@ class ShardedDsa(MeshSolverMixin):
                 cand = jax.lax.psum(cand, "tp")
                 violated = jax.lax.psum(violated, "tp") > 0
                 cand = cand + var_costs
-                cand = jnp.where(domain_mask, cand, BIG * 2)
+                cand = jnp.where(domain_mask, cand,
+                                 jnp.asarray(SENTINEL, cand.dtype))
                 best = jnp.argmin(cand, axis=-1)          # (V+1,)
                 cur_cost = jnp.take_along_axis(
                     cand, x1[:, None], axis=-1)[:, 0]
@@ -162,12 +173,14 @@ class ShardedDsa(MeshSolverMixin):
 
     def _make_consts(self):
         mesh = self.mesh
+        store = self.policy.store_dtype
         return (
-            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+            [jax.device_put(np.asarray(c, dtype=store),
+                            NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
              for _, _, v in self.sharded_buckets],
-            jax.device_put(jnp.asarray(self.var_costs),
+            jax.device_put(jnp.asarray(self.var_costs, dtype=store),
                            NamedSharding(mesh, P())),
             jax.device_put(jnp.asarray(self.domain_mask),
                            NamedSharding(mesh, P())),
@@ -266,8 +279,10 @@ class ShardedMgm(MeshSolverMixin):
     moves, so the conflict count never increases.
     """
 
-    def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1):
+    def __init__(self, arrays: HypergraphArrays, mesh, batch: int = 1,
+                 precision=None):
         enable_persistent_cache()
+        self.policy = resolve_precision(precision)
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -298,12 +313,16 @@ class ShardedMgm(MeshSolverMixin):
 
         def local_step(x, cubes, var_ids, var_costs, domain_mask):
             def one(x1):
-                cand = jnp.zeros_like(var_costs)  # (V+1, D)
+                cand = jnp.zeros(var_costs.shape,
+                                 dtype=self.policy.accum_dtype)
                 for a, cu, vi in zip(arities, cubes, var_ids):
-                    cand = cand + candidate_costs(cu, vi, x1, V + 1)
+                    cand = cand + candidate_costs(
+                        cu, vi, x1, V + 1,
+                        accum_dtype=self.policy.accum_dtype)
                 cand = jax.lax.psum(cand, "tp")
                 cand = cand + var_costs
-                cand = jnp.where(domain_mask, cand, BIG * 2)
+                cand = jnp.where(domain_mask, cand,
+                                 jnp.asarray(SENTINEL, cand.dtype))
                 best = jnp.argmin(cand, axis=-1)          # (V+1,)
                 cur_cost = jnp.take_along_axis(
                     cand, x1[:, None], axis=-1)[:, 0]
@@ -381,12 +400,14 @@ class ShardedMgm(MeshSolverMixin):
 
     def _make_consts(self):
         mesh = self.mesh
+        store = self.policy.store_dtype
         return (
-            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+            [jax.device_put(np.asarray(c, dtype=store),
+                            NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
              for _, _, v in self.sharded_buckets],
-            jax.device_put(jnp.asarray(self.var_costs),
+            jax.device_put(jnp.asarray(self.var_costs, dtype=store),
                            NamedSharding(mesh, P())),
             jax.device_put(jnp.asarray(self.domain_mask),
                            NamedSharding(mesh, P())),

@@ -42,8 +42,9 @@ from ._compat import shard_map
 from ._mesh_cost import build_mesh_cost
 from ..engine._cache import enable_persistent_cache
 from ..engine.mesh_engine import MeshSolverMixin
-from ..graphs.arrays import BIG, FactorGraphArrays
+from ..graphs.arrays import BIG, SENTINEL, FactorGraphArrays
 from ..ops.kernels import factor_messages
+from ..ops.precision import resolve as resolve_precision
 
 SAME_COUNT = 4
 
@@ -110,7 +111,7 @@ class ShardedMaxSum(MeshSolverMixin):
     finished = False
 
     def _init_params(self, arrays, mesh, damping, damping_nodes,
-                     stability, noise, batch):
+                     stability, noise, batch, precision=None):
         """The parameter block every mesh layout shares — ONE copy of
         the damping-invariant convergence-threshold rule
         (algorithms/maxsum.py:64-70) and the batch/dp check, so the
@@ -120,6 +121,10 @@ class ShardedMaxSum(MeshSolverMixin):
         # engine: turn the persistent XLA cache on for every sharded
         # construction path, like SyncEngine does for single-chip
         enable_persistent_cache()
+        # mixed-precision policy: cost planes (cubes, unary costs) are
+        # device-placed in store_dtype; message planes, psums and the
+        # on-device cost trace stay in accum f32 (ops/precision.py)
+        self.policy = resolve_precision(precision)
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -140,9 +145,10 @@ class ShardedMaxSum(MeshSolverMixin):
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
                  layout: str = "auto", batch: int = 1,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 precision=None):
         self._init_params(arrays, mesh, damping, damping_nodes,
-                          stability, noise, batch)
+                          stability, noise, batch, precision=precision)
 
         # validate BEFORE the host-side factor partition: a bad layout
         # must fail fast, not after padding every bucket across shards
@@ -184,7 +190,7 @@ class ShardedMaxSum(MeshSolverMixin):
         self._pallas_interpret = jax.default_backend() != "tpu"
 
         vc = np.concatenate(
-            [arrays.var_costs,
+            [np.asarray(arrays.var_costs, dtype=np.float32),
              np.full((1, self.D), BIG, dtype=np.float32)])
         self.var_costs = vc                             # (V+1, D)
         dm = np.concatenate(
@@ -212,15 +218,19 @@ class ShardedMaxSum(MeshSolverMixin):
 
     def _make_consts(self):
         mesh = self.mesh
+        store = self.policy.store_dtype
         return {
             "edge_var": jax.device_put(
                 self.edge_var, NamedSharding(mesh, P("tp"))),
+            # cost planes ride the store dtype (half the HBM bytes per
+            # cycle under bf16); everything integer/bool is untouched
             "cubes": [
-                jax.device_put(sb.cubes, NamedSharding(mesh, P("tp")))
+                jax.device_put(np.asarray(sb.cubes, dtype=store),
+                               NamedSharding(mesh, P("tp")))
                 for sb in self.buckets
             ],
             "var_costs": jax.device_put(
-                jnp.asarray(self.var_costs),
+                jnp.asarray(self.var_costs, dtype=store),
                 NamedSharding(mesh, P())),
             "domain_mask": jax.device_put(
                 jnp.asarray(self.domain_mask), NamedSharding(mesh, P())),
@@ -266,7 +276,9 @@ class ShardedMaxSum(MeshSolverMixin):
                 continue
             f = cu.shape[0]
             if a == 1:
-                blocks.append(jnp.transpose(cu))            # (D, F)
+                # unary msg = the cost row, upcast to the message
+                # (accum) dtype before mixed-arity concatenation
+                blocks.append(jnp.transpose(cu).astype(qT.dtype))
                 continue
             cubesT = jnp.moveaxis(cu, 0, -1)            # (D, ..., D, F)
             q_blk = qT[:, sb.offset:sb.offset + a * f]
@@ -319,7 +331,8 @@ class ShardedMaxSum(MeshSolverMixin):
                     q_new = damping * q1 + (1 - damping) * q_new
                 q_new = jnp.where(mask_e, q_new, BIG)
                 sel = jnp.argmin(
-                    jnp.where(domain_mask[:V], belief[:V], BIG * 2),
+                    jnp.where(domain_mask[:V], belief[:V],
+                              jnp.asarray(SENTINEL, belief.dtype)),
                     axis=-1)
                 # stability <= 0 disables delta convergence (same dead-
                 # compute elision as the single-chip solvers): skip the
@@ -419,8 +432,11 @@ class ShardedMaxSum(MeshSolverMixin):
     def _cost_buckets(self):
         """(cubes, var_ids, valid) triples for the on-device cost: the
         MaxSum partition pads with BIG-filled cubes, so padded rows
-        need the explicit mask."""
-        return [(sb.cubes, sb.var_ids, sb.var_ids[:, :, 0] < self.V)
+        need the explicit mask.  Cubes ride the store dtype (the cost
+        evaluator upcasts to f32 at its sums)."""
+        store = self.policy.store_dtype
+        return [(np.asarray(sb.cubes, dtype=store), sb.var_ids,
+                 sb.var_ids[:, :, 0] < self.V)
                 for sb in self.buckets]
 
     def _mesh_sel_device(self, state):
@@ -534,7 +550,7 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
-                 batch: int = 1):
+                 batch: int = 1, precision=None):
         from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
 
         # binary buckets are unconditional (no hypercube unroll); the
@@ -550,7 +566,7 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
                 "(filter_dcop) — with arity >= 3 hypercubes under the "
                 "unroll threshold (D**arity <= NARY_FAST_MAX_CELLS)")
         self._init_params(arrays, mesh, damping, damping_nodes,
-                          stability, noise, batch)
+                          stability, noise, batch, precision=precision)
         self.layout = "fused"
         self.use_pallas = False
         self._build_fused_shards(arrays)
@@ -696,12 +712,13 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     def _make_consts(self):
         mesh = self.mesh
         n = self._np
+        store = self.policy.store_dtype
         tp_sh = NamedSharding(mesh, P("tp"))
         rep = NamedSharding(mesh, P())
         consts = {
             "emask": jax.device_put(n["emask"], tp_sh),
             "var_costsT_sorted": jax.device_put(
-                jnp.asarray(n["var_costsT_sorted"]), rep),
+                jnp.asarray(n["var_costsT_sorted"], dtype=store), rep),
             "domain_maskT_sorted": jax.device_put(
                 jnp.asarray(n["domain_maskT_sorted"]), rep),
             "slot_dsize": jax.device_put(
@@ -711,12 +728,13 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             consts["partner_slot"] = jax.device_put(
                 n["partner_slot"], tp_sh)
             consts["cube_slotT"] = jax.device_put(
-                n["cube_slotT"], tp_sh)
+                np.asarray(n["cube_slotT"], dtype=store), tp_sh)
         else:
             consts["pos_slots"] = [
                 jax.device_put(ps, tp_sh) for ps in n["pos_slots"]]
             consts["cubesT"] = [
-                jax.device_put(c, tp_sh) for c in n["cubesT"]]
+                jax.device_put(np.asarray(c, dtype=store), tp_sh)
+                for c in n["cubesT"]]
             consts["slot_src"] = jax.device_put(n["slot_src"], tp_sh)
         return consts
 
@@ -783,7 +801,8 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             q_new = damping * q1 + (1 - damping) * q_new
         q_new = jnp.where(emask, q_new, BIG)
         sel = jnp.argmin(
-            jnp.where(dmT, belief, BIG * 2), axis=0)
+            jnp.where(dmT, belief, jnp.asarray(SENTINEL, belief.dtype)),
+            axis=0)
         if self.EP and self.stability > 0:
             delta = jax.lax.pmax(jnp.max(jnp.where(
                 emask, jnp.abs(q_new - q1), 0.0)), "tp")

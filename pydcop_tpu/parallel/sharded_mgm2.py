@@ -34,9 +34,10 @@ from ._compat import shard_map
 from ._mesh_cost import build_mesh_cost
 from ..engine._cache import enable_persistent_cache
 from ..engine.mesh_engine import MeshSolverMixin
-from ..graphs.arrays import (BIG, HypergraphArrays, out_edge_table,
+from ..graphs.arrays import (SENTINEL, HypergraphArrays, out_edge_table,
                              pair_edge_lookup, pair_eids_for_bucket)
 from ..ops.kernels import candidate_costs
+from ..ops.precision import resolve as resolve_precision
 from .sharded_localsearch import _partition_constraints
 
 _EPS = 1e-6
@@ -52,8 +53,11 @@ class ShardedMgm2(MeshSolverMixin):
 
     def __init__(self, arrays: HypergraphArrays, mesh,
                  threshold: float = 0.5, favor: str = "unilateral",
-                 batch: int = 1):
+                 batch: int = 1, precision=None):
         enable_persistent_cache()
+        # mixed-precision policy: cubes + unary planes in store_dtype,
+        # candidate/pair-slice sums in accum f32 (ops/precision.py)
+        self.policy = resolve_precision(precision)
         self.mesh = mesh
         self.tp = mesh.shape["tp"]
         self.dp = mesh.shape["dp"]
@@ -124,7 +128,7 @@ class ShardedMgm2(MeshSolverMixin):
         """Shard-local part of the (P, D, D) shared-pair slice tensor
         (same per-bucket arithmetic as ``Mgm2Solver.shared_slices``)."""
         D, Pn = self.D, self.P
-        S = jnp.zeros((Pn, D, D))
+        S = jnp.zeros((Pn, D, D), dtype=self.policy.accum_dtype)
         for (a, _c, _v), cu, vi, peid in zip(
                 self.sharded_buckets, cubes, var_ids_l, pair_eids_l):
             if a < 2:
@@ -145,8 +149,11 @@ class ShardedMgm2(MeshSolverMixin):
                             idx = idx * D + vals[:, r]
                     contrib = t[jnp.arange(C), idx]     # (C, D_q, D_p)
                     contrib = jnp.swapaxes(contrib, 1, 2)
+                    # upcast at the reduction boundary: bf16-stored
+                    # slices sum in f32 (ops/precision.py)
                     S = S + jax.ops.segment_sum(
-                        contrib, peid[:, p, q], num_segments=Pn)
+                        contrib.astype(S.dtype), peid[:, p, q],
+                        num_segments=Pn)
         return S
 
     def _build_step(self):
@@ -165,13 +172,16 @@ class ShardedMgm2(MeshSolverMixin):
 
             # phase 1: local view (psum-assembled candidate costs, then
             # the exact best_response arithmetic of LocalSearchSolver)
-            cand = jnp.zeros((V + 1, D))
+            cand = jnp.zeros((V + 1, D), dtype=self.policy.accum_dtype)
             for a, cu, vi in zip(arities, cubes, var_ids_l):
-                cand = cand + candidate_costs(cu, vi, x_ext, V + 1)
+                cand = cand + candidate_costs(
+                    cu, vi, x_ext, V + 1,
+                    accum_dtype=self.policy.accum_dtype)
             cand = jax.lax.psum(cand, "tp")[:V]
             costs = var_costs + cand
             cur = costs[ar, x1]
-            c = jnp.where(domain_mask, costs, BIG * 2)
+            c = jnp.where(domain_mask, costs,
+                          jnp.asarray(SENTINEL, costs.dtype))
             best_cost = jnp.min(c, axis=-1)
             is_min = (c <= best_cost[:, None] + 1e-9) & domain_mask
             not_cur = is_min & ~jax.nn.one_hot(x1, D, dtype=bool)
@@ -201,7 +211,9 @@ class ShardedMgm2(MeshSolverMixin):
             )
             mask2 = (domain_mask[o][:, :, None]
                      & domain_mask[t][:, None, :])
-            pair_cost = jnp.where(mask2, pair_cost, BIG * 2)
+            pair_cost = jnp.where(mask2, pair_cost,
+                                  jnp.asarray(SENTINEL,
+                                              pair_cost.dtype))
             pair_cur = cur[o] + cur[t] - S[jnp.arange(Pn), x1[o], x1[t]]
             flat = pair_cost.reshape(Pn, -1)
             pair_best = jnp.min(flat, axis=1)
@@ -307,14 +319,16 @@ class ShardedMgm2(MeshSolverMixin):
 
     def _make_consts(self):
         mesh = self.mesh
+        store = self.policy.store_dtype
         return (
-            [jax.device_put(c, NamedSharding(mesh, P("tp")))
+            [jax.device_put(np.asarray(c, dtype=store),
+                            NamedSharding(mesh, P("tp")))
              for _, c, _ in self.sharded_buckets],
             [jax.device_put(v, NamedSharding(mesh, P("tp")))
              for _, _, v in self.sharded_buckets],
             [jax.device_put(pe, NamedSharding(mesh, P("tp")))
              for pe in self.pair_eids],
-            jax.device_put(jnp.asarray(self.var_costs),
+            jax.device_put(jnp.asarray(self.var_costs, dtype=store),
                            NamedSharding(mesh, P())),
             jax.device_put(jnp.asarray(self.domain_mask),
                            NamedSharding(mesh, P())),

@@ -156,6 +156,54 @@ def test_prefix_uniform_is_prefix_stable():
     assert np.array_equal(small2, large2[:10])
 
 
+def test_random_argmin_tie_break_is_pad_stable():
+    """``random_argmin`` draws its tie-break noise per-row through
+    ``prefix_uniform`` now: on a TIE-HEAVY plane (uniform-cost
+    coloring — every valid slot costs the same, so the noise decides
+    every row), padding the variable plane with phantom rows leaves
+    every real row's pick unchanged.  The control shows the historical
+    draw (``jax.random.uniform(key, c.shape)``) fails exactly this
+    property: its threefry counter layout couples every element to the
+    total shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops.kernels import random_argmin
+
+    key = jax.random.PRNGKey(3)
+    V, D, pad = 12, 3, 5
+    # uniform-cost: all-zero costs, all slots valid -> every row ties
+    costs = np.zeros((V, D), dtype=np.float32)
+    mask = np.ones((V, D), dtype=bool)
+    costs_p = np.zeros((V + pad, D), dtype=np.float32)
+    mask_p = np.ones((V + pad, D), dtype=bool)
+    mask_p[V:, 1:] = False  # phantom rows: single valid slot
+
+    sel = np.asarray(random_argmin(key, jnp.asarray(costs),
+                                   jnp.asarray(mask)))
+    sel_p = np.asarray(random_argmin(key, jnp.asarray(costs_p),
+                                     jnp.asarray(mask_p)))
+    assert len(set(sel.tolist())) > 1, \
+        "test setup: ties should spread picks across slots"
+    assert np.array_equal(sel, sel_p[:V])
+    assert (sel_p[V:] == 0).all()  # phantoms pick their only slot
+
+    # control: the old shape-coupled draw diverges under the same pad
+    def old_draw(k, c, m):
+        c = jnp.where(m, c, 2e9)
+        mn = jnp.min(c, axis=-1, keepdims=True)
+        is_min = (c <= mn) & m
+        return jnp.argmax(is_min * (1.0 + jax.random.uniform(
+            k, c.shape)), axis=-1)
+
+    old = np.asarray(old_draw(key, jnp.asarray(costs),
+                              jnp.asarray(mask)))
+    old_p = np.asarray(old_draw(key, jnp.asarray(costs_p),
+                                jnp.asarray(mask_p)))
+    assert not np.array_equal(old, old_p[:V]), \
+        "the shape-coupled draw was expected to break pad-stability"
+
+
 # -------------------------------------- bit-exactness of padded solves
 
 

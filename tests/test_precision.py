@@ -1,0 +1,514 @@
+"""Mixed-precision message passing (ISSUE 4): bf16 cost planes with
+f32 accumulation.
+
+Layers under test:
+
+* ``ops/precision.py`` — policy resolution (names, env var, auto);
+* ``graphs/arrays.py`` — store-dtype builds, SENTINEL/BIG/HARD
+  ordering surviving the bf16 round-trip, dtype-preserving ``pad_to``;
+* ``ops/kernels.py`` — bf16-vs-f32 selection parity of the factor and
+  candidate kernels, and the f32 accumulation boundary actually
+  engaging (a bf16-accumulated control visibly drifts);
+* engine / sharded / fused-batch solvers — THE acceptance contract:
+  on integer-cost instances (every entry exactly representable in
+  bf16), a bf16 run reproduces the f32 run's selections AND
+  convergence cycles bit-exactly, on the single-chip engine, the
+  (dp, tp) mesh, and the shape-bucketed fused campaign path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.generators.fast import (coloring_factor_arrays,
+                                        coloring_hypergraph_arrays)
+from pydcop_tpu.graphs.arrays import BIG, HARD, SENTINEL
+from pydcop_tpu.ops.precision import BF16, ENV_VAR, F32, resolve
+
+pytestmark = pytest.mark.precision
+
+bf16 = BF16.store_dtype
+
+
+# ---------------------------------------------------------- instances
+
+
+def integer_factor_arrays(n, e, seed, lo=0, hi=9):
+    """Coloring-shaped factor graph with random INTEGER cubes and unary
+    costs — every entry exact in bf16 (|cost| <= 256)."""
+    a = coloring_factor_arrays(n, e, 3, seed=seed, noise=0.0)
+    rng = np.random.default_rng(seed)
+    for b in a.buckets:
+        b.cubes = rng.integers(lo, hi, size=b.cubes.shape) \
+            .astype(np.float32)
+    a.var_costs = rng.integers(lo, 5, size=a.var_costs.shape) \
+        .astype(np.float32)
+    return a
+
+
+def integer_hypergraph_arrays(n, e, seed, lo=0, hi=9):
+    a = coloring_hypergraph_arrays(n, e, 3, seed=seed, noise=0.0)
+    rng = np.random.default_rng(seed)
+    for b in a.buckets:
+        b.cubes = rng.integers(lo, hi, size=b.cubes.shape) \
+            .astype(np.float32)
+    a.var_costs = rng.integers(lo, 5, size=a.var_costs.shape) \
+        .astype(np.float32)
+    return a
+
+
+# ------------------------------------------------------------- policy
+
+
+def test_policy_resolution_names_env_auto(monkeypatch):
+    assert resolve(None) is F32
+    assert resolve("f32") is F32
+    assert resolve("bf16") is BF16
+    assert resolve(BF16) is BF16
+    monkeypatch.setenv(ENV_VAR, "bf16")
+    assert resolve(None) is BF16          # env default engages
+    assert resolve("f32") is F32          # explicit beats env
+    # auto is backend-gated: bf16 only where it is native tile currency
+    expected = BF16 if jax.default_backend() == "tpu" else F32
+    assert resolve("auto") is expected
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve("f16")
+    assert F32.store_itemsize == 4 and BF16.store_itemsize == 2
+
+
+def test_arrays_build_in_store_dtype_and_pad_preserves_it():
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, plan_rungs
+
+    insts = [integer_factor_arrays(10, 20, 1),
+             integer_factor_arrays(14, 25, 2)]
+    for a in insts:
+        a.var_costs = a.var_costs.astype(bf16)
+        for b in a.buckets:
+            b.cubes = b.cubes.astype(bf16)
+    rung = plan_rungs([ShapeProfile.of(a) for a in insts],
+                      max_waste=50.0)[0]
+    padded = rung.pad(insts[0])
+    # phantom rows/cubes inherit the instance's store dtype
+    assert padded.var_costs.dtype == np.dtype(bf16)
+    assert padded.buckets[0].cubes.dtype == np.dtype(bf16)
+    # and the identity-phantom structure survives (0 / BIG pattern)
+    assert float(padded.var_costs[-1, 0]) == 0.0
+    assert float(padded.buckets[0].cubes[-1, 0, 0]) == 0.0
+
+
+def test_build_precision_param_casts_planes():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+
+    src = """
+name: t
+objective: min
+domains:
+  d: {values: [a, b, c]}
+variables:
+  v0: {domain: d}
+  v1: {domain: d}
+constraints:
+  c0: {type: intention, function: 3 if v0 == v1 else 0}
+agents: [a0, a1]
+"""
+    arrays = FactorGraphArrays.build(load_dcop(src), precision="bf16")
+    assert arrays.var_costs.dtype == np.dtype(bf16)
+    assert arrays.buckets[0].cubes.dtype == np.dtype(bf16)
+    f32_arrays = FactorGraphArrays.build(load_dcop(src))
+    assert f32_arrays.var_costs.dtype == np.float32
+    # integer costs round-trip exactly
+    assert np.array_equal(
+        np.asarray(arrays.buckets[0].cubes, dtype=np.float32),
+        np.asarray(f32_arrays.buckets[0].cubes))
+
+
+# ----------------------------------------------- sentinels under bf16
+
+
+def test_sentinels_survive_bf16_roundtrip():
+    """(c) of the satellite suite: the masking constants keep their
+    strict ordering after bf16 rounding, so masked slots still dominate
+    every reduction over bf16-stored planes."""
+    s, b, h = (float(bf16(SENTINEL)), float(bf16(BIG)),
+               float(bf16(HARD)))
+    assert s > b > h > 0
+    # BIG-padded invalid slots of a bf16 plane never win a masked
+    # argmin, and the sentinel never ties them
+    from pydcop_tpu.ops.kernels import masked_argmin, masked_min
+
+    plane = np.full((4, 3), BIG, dtype=np.float32)
+    plane[:, 0] = [5, 1, 7, 2]
+    plane[:2, 1] = [0, 3]
+    mask = plane < BIG / 2
+    for dtype in (np.float32, bf16):
+        sel = np.asarray(masked_argmin(jnp.asarray(
+            plane.astype(dtype)), jnp.asarray(mask)))
+        assert np.array_equal(sel, [1, 0, 0, 0])
+        mn = np.asarray(masked_min(jnp.asarray(plane.astype(dtype)),
+                                   jnp.asarray(mask)),
+                        dtype=np.float32)
+        assert np.array_equal(mn, [0, 1, 7, 2])
+
+
+# ----------------------------------------------------- kernel parity
+
+
+@pytest.mark.parametrize("arity", [2, 3, 4])
+def test_factor_messages_bf16_parity(arity):
+    """(a): min-marginals over bf16-stored integer cubes equal the f32
+    ones bit-exactly (upcast at the broadcast-add is exact, min is
+    order-preserving)."""
+    from pydcop_tpu.ops.kernels import factor_messages
+
+    rng = np.random.default_rng(arity)
+    D, F = 3, 17
+    cubes = rng.integers(0, 256, size=(F,) + (D,) * arity) \
+        .astype(np.float32)
+    q = [rng.integers(-8, 8, size=(F, D)).astype(np.float32)
+         for _ in range(arity)]
+    m32 = factor_messages(jnp.asarray(cubes),
+                          [jnp.asarray(x) for x in q])
+    mbf = factor_messages(jnp.asarray(cubes.astype(bf16)),
+                          [jnp.asarray(x) for x in q])
+    for a, b in zip(m32, mbf):
+        assert b.dtype == jnp.float32  # upcast at the reduction
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("arity", [2, 3, 4])
+def test_candidate_costs_bf16_parity(arity):
+    from pydcop_tpu.ops.kernels import candidate_costs
+
+    rng = np.random.default_rng(10 + arity)
+    D, C, V = 3, 23, 9
+    cubes = rng.integers(0, 200, size=(C,) + (D,) * arity) \
+        .astype(np.float32)
+    var_ids = np.stack([rng.permutation(V)[:arity]
+                        for _ in range(C)]).astype(np.int32)
+    x = rng.integers(0, D, size=V).astype(np.int32)
+    c32 = candidate_costs(jnp.asarray(cubes), jnp.asarray(var_ids),
+                          jnp.asarray(x), V)
+    cbf = candidate_costs(jnp.asarray(cubes.astype(bf16)),
+                          jnp.asarray(var_ids), jnp.asarray(x), V)
+    assert cbf.dtype == jnp.float32
+    assert np.array_equal(np.asarray(c32), np.asarray(cbf))
+
+
+def test_f32_accumulation_engages_on_high_degree_star():
+    """(b): a star variable summing hundreds of integer slices.  The
+    f32-accumulated kernel matches f32 exactly; the CONTROL — the same
+    contributions summed in bf16 — visibly drifts, proving the
+    boundary is load-bearing, not vacuously satisfied."""
+    from pydcop_tpu.ops.kernels import bucket_cost, candidate_costs
+
+    rng = np.random.default_rng(7)
+    D, C = 3, 400  # star: every constraint touches variable 0
+    V = C + 1
+    cubes = rng.integers(1, 9, size=(C, D, D)).astype(np.float32)
+    var_ids = np.stack([np.zeros(C), np.arange(1, C + 1)], axis=1) \
+        .astype(np.int32)
+    x = rng.integers(0, D, size=V).astype(np.int32)
+
+    c32 = np.asarray(candidate_costs(
+        jnp.asarray(cubes), jnp.asarray(var_ids), jnp.asarray(x), V))
+    cbf = np.asarray(candidate_costs(
+        jnp.asarray(cubes.astype(bf16)), jnp.asarray(var_ids),
+        jnp.asarray(x), V))
+    assert np.array_equal(c32, cbf)
+
+    # control: accumulate the identical bf16 contributions IN bf16
+    drifted = np.asarray(candidate_costs(
+        jnp.asarray(cubes.astype(bf16)), jnp.asarray(var_ids),
+        jnp.asarray(x), V, accum_dtype=jnp.bfloat16),
+        dtype=np.float32)
+    assert not np.array_equal(c32[0], drifted[0]), \
+        "star-row bf16 accumulation was expected to drift"
+
+    # total-cost sums behave the same way
+    t32 = float(jnp.sum(bucket_cost(
+        jnp.asarray(cubes), jnp.asarray(var_ids),
+        jnp.asarray(x)).astype(jnp.float32)))
+    tbf = float(jnp.sum(bucket_cost(
+        jnp.asarray(cubes.astype(bf16)), jnp.asarray(var_ids),
+        jnp.asarray(x)).astype(jnp.float32)))
+    assert t32 == tbf
+
+
+# --------------------------------------------- engine solvers (1 chip)
+
+
+def _device_run(solver, max_cycles):
+    """Drive the jitted step to convergence exactly like SyncEngine's
+    device path (the tiny test instances would otherwise take the
+    pure-numpy host mirror, which never touches the policy)."""
+    def cond(s):
+        return jnp.logical_and(jnp.logical_not(s["finished"]),
+                               s["cycle"] < max_cycles)
+
+    final = jax.jit(
+        lambda s: jax.lax.while_loop(cond, solver.step, s))(
+        solver.init_state(jax.random.PRNGKey(0)))
+    return (np.asarray(solver.assignment_indices(final)),
+            int(final["cycle"]), float(solver.cost(final)))
+
+
+@pytest.mark.parametrize("layout", ["edge_major", "lane", "fused"])
+def test_maxsum_bf16_bit_exact_selections_and_cycles(layout):
+    from pydcop_tpu.algorithms.maxsum import (MaxSumFusedSolver,
+                                              MaxSumLaneSolver,
+                                              MaxSumSolver)
+
+    cls = {"edge_major": MaxSumSolver, "lane": MaxSumLaneSolver,
+           "fused": MaxSumFusedSolver}[layout]
+    arrays = integer_factor_arrays(20, 40, seed=1)
+    sel32, cyc32, cost32 = _device_run(
+        cls(arrays, damping=0.5, precision="f32"), 60)
+    selbf, cycbf, costbf = _device_run(
+        cls(arrays, damping=0.5, precision="bf16"), 60)
+    assert np.array_equal(sel32, selbf)
+    assert cyc32 == cycbf
+    assert cost32 == costbf  # f32-accumulated cost trace
+
+
+def test_maxsum_bf16_delta_on_beliefs_carry_dtype():
+    """The delta_on=beliefs carry must keep one dtype through the
+    while_loop even though the INITIAL belief is the bf16 plane."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    arrays = integer_factor_arrays(12, 20, seed=5)
+    sel32, cyc32, _ = _device_run(
+        MaxSumSolver(arrays, delta_on="beliefs", precision="f32"), 50)
+    selbf, cycbf, _ = _device_run(
+        MaxSumSolver(arrays, delta_on="beliefs", precision="bf16"), 50)
+    assert np.array_equal(sel32, selbf) and cyc32 == cycbf
+
+
+@pytest.mark.parametrize("algo", ["dsa", "mgm"])
+def test_localsearch_bf16_bit_exact(algo):
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+    from pydcop_tpu.algorithms.mgm import MgmSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    cls = {"dsa": DsaSolver, "mgm": MgmSolver}[algo]
+    arrays = integer_hypergraph_arrays(20, 40, seed=2)
+    r32 = SyncEngine(cls(arrays, stop_cycle=15, precision="f32")) \
+        .run(key=0, max_cycles=15)
+    rbf = SyncEngine(cls(arrays, stop_cycle=15, precision="bf16")) \
+        .run(key=0, max_cycles=15)
+    assert r32.assignment == rbf.assignment
+    assert r32.cycles == rbf.cycles
+    assert r32.cost == rbf.cost
+
+
+def test_store_dtype_actually_bf16_on_device():
+    """The policy is not a no-op: bf16 solvers really hold bf16 planes
+    (the memory/bandwidth claim rests on this)."""
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    arrays = integer_factor_arrays(10, 15, seed=3)
+    ms = MaxSumSolver(arrays, precision="bf16")
+    assert ms.var_costs.dtype == jnp.bfloat16
+    assert ms.buckets[0][0].dtype == jnp.bfloat16
+    h = integer_hypergraph_arrays(10, 15, seed=3)
+    ds = DsaSolver(h, precision="bf16")
+    assert ds.var_costs.dtype == jnp.bfloat16
+    assert ds.buckets[0][0].dtype == jnp.bfloat16
+    assert ds.bucket_optima[0].dtype == jnp.bfloat16
+
+
+# --------------------------------------------------- sharded families
+
+mesh_mark = pytest.mark.mesh
+
+
+@mesh_mark
+@pytest.mark.parametrize("family", ["maxsum", "fused_maxsum", "dsa",
+                                    "mgm", "mgm2", "dba"])
+def test_sharded_bf16_bit_exact(family):
+    """All five sharded families consume the policy: bf16 runs on the
+    (dp, tp) mesh reproduce the f32 selections (and cycles, where the
+    family self-terminates) bit-exactly on integer instances."""
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_breakout import ShardedDba
+    from pydcop_tpu.parallel.sharded_localsearch import (ShardedDsa,
+                                                         ShardedMgm)
+    from pydcop_tpu.parallel.sharded_maxsum import (ShardedFusedMaxSum,
+                                                    ShardedMaxSum)
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    mesh = make_mesh(8)
+    if family in ("maxsum", "fused_maxsum"):
+        arrays = integer_factor_arrays(24, 50, seed=3)
+        cls = {"maxsum": ShardedMaxSum,
+               "fused_maxsum": ShardedFusedMaxSum}[family]
+        kw = {"damping": 0.5}
+        cycles = 30
+    else:
+        arrays = integer_hypergraph_arrays(24, 50, seed=4)
+        cls = {"dsa": ShardedDsa, "mgm": ShardedMgm,
+               "mgm2": ShardedMgm2, "dba": ShardedDba}[family]
+        kw = {}
+        cycles = 12
+    sel32, cyc32 = cls(arrays, mesh, batch=4, precision="f32",
+                       **kw).run(cycles, seed=0)
+    selbf, cycbf = cls(arrays, mesh, batch=4, precision="bf16",
+                       **kw).run(cycles, seed=0)
+    assert np.array_equal(sel32, selbf)
+    assert cyc32 == cycbf
+
+
+@mesh_mark
+def test_sharded_bf16_cost_trace_accumulates_f32():
+    """The on-device anytime cost trace stays f32 under bf16 storage
+    and equals the f32 run's trace on integer instances."""
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    mesh = make_mesh(8)
+    arrays = integer_factor_arrays(24, 50, seed=6)
+    traces = {}
+    for prec in ("f32", "bf16"):
+        sm = ShardedMaxSum(arrays, mesh, damping=0.5, batch=4,
+                           precision=prec)
+        sm.run(16, seed=0, collect_cost_every=4)
+        traces[prec] = sm.last_cost_trace
+    assert traces["f32"] == traces["bf16"]
+    assert traces["f32"]  # non-empty
+
+
+# ------------------------------------------------- fused batch (hetero)
+
+
+@pytest.mark.hetero
+@pytest.mark.parametrize("algo,params", [
+    ("maxsum", {"damping": 0.5}),
+    ("dsa", {"probability": 0.7, "variant": "B", "stop_cycle": 15}),
+    ("mgm", {"stop_cycle": 15}),
+])
+def test_hetero_fused_batch_bf16_bit_exact(algo, params):
+    """The fused-campaign path under bf16: padded, vmapped, bf16-stored
+    rows reproduce the f32 fused run's selections, cycles, and device
+    re-evaluated costs bit-exactly on integer instances."""
+    from pydcop_tpu.parallel.batch import BATCHED_CLASSES
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, plan_rungs
+
+    make = integer_factor_arrays if algo == "maxsum" \
+        else integer_hypergraph_arrays
+    insts = [make(10, 20, 1), make(14, 25, 2), make(9, 15, 3)]
+    rungs = plan_rungs([ShapeProfile.of(a) for a in insts],
+                       max_waste=50.0)
+    assert len(rungs) == 1
+    padded = [rungs[0].pad(a) for a in insts]
+    out = {}
+    for prec in ("f32", "bf16"):
+        runner = BATCHED_CLASSES[algo](
+            padded[0], instances=padded, precision=prec, **params)
+        sel, cycles, fin = runner.run(max_cycles=40, seeds=[0, 1, 2])
+        costs, viols = runner.evaluate(sel)
+        out[prec] = (runner.decode(sel), cycles, costs, viols)
+    for a, b in zip(out["f32"][0], out["bf16"][0]):
+        assert np.array_equal(a, b)
+    assert np.array_equal(out["f32"][1], out["bf16"][1])
+    assert np.array_equal(out["f32"][2], out["bf16"][2])
+    assert np.array_equal(out["f32"][3], out["bf16"][3])
+
+
+def test_batched_evaluate_matches_host_reeval():
+    """The device re-evaluation (one vmapped call per rung) returns
+    exactly the host evaluator's cost/violations — including phantom
+    inertness on the padded shape."""
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, plan_rungs
+
+    insts = [integer_factor_arrays(10, 20, 1),
+             integer_factor_arrays(14, 25, 2)]
+    rungs = plan_rungs([ShapeProfile.of(a) for a in insts],
+                       max_waste=50.0)
+    padded = [rungs[0].pad(a) for a in insts]
+    runner = BatchedMaxSum(padded[0], instances=padded, damping=0.5)
+    sel, _c, _f = runner.run(max_cycles=30, seeds=[0, 1])
+    costs, viols = runner.evaluate(sel)
+    for i, arrays in enumerate(insts):
+        x = runner.decode(sel)[i]
+        expect = float(arrays.var_costs[np.arange(arrays.n_vars),
+                                        x].sum())
+        for b in arrays.buckets:
+            idx = (np.arange(b.cubes.shape[0]),) + tuple(
+                x[b.var_ids[:, p]] for p in range(b.arity))
+            expect += float(b.cubes[idx].sum())
+        assert costs[i] == pytest.approx(expect, abs=1e-6)
+        assert viols[i] == 0
+
+
+def test_bucketing_bf16_byte_budget_admits_larger_rungs():
+    """Per-rung memory priced at the store itemsize: under a byte cap
+    that blocks f32 consolidation, the bf16 pricing (2 bytes/cell)
+    admits the merge — fewer compiled programs for the same budget."""
+    from pydcop_tpu.parallel.bucketing import (ShapeProfile,
+                                               plan_rungs, plan_stats)
+
+    big = ShapeProfile("hyper", 3, 100, ((2, 300),), 600)
+    tiny = ShapeProfile("hyper", 3, 5, ((2, 4),), 8)
+    budget = 16_000  # bytes: below big-rung f32 cost, above bf16 cost
+    f32_rungs = plan_rungs([big, tiny], max_waste=1000.0,
+                           max_rung_bytes=budget, bytes_per_cell=4)
+    bf16_rungs = plan_rungs([big, tiny], max_waste=1000.0,
+                            max_rung_bytes=budget, bytes_per_cell=2)
+    assert len(f32_rungs) == 2      # f32 pricing: merge refused
+    assert len(bf16_rungs) == 1     # bf16 pricing: merge admitted
+    stats = plan_stats(bf16_rungs, [big, tiny], bytes_per_cell=2)
+    assert stats["padded_bytes"] == stats["padded_cells"] * 2
+
+
+# ------------------------------------------------------------ the CLI
+
+
+def test_solve_cli_precision_flag_engine(tmp_path):
+    """--precision bf16 runs end-to-end and lands the precision result
+    field; bf16 and f32 agree on the integer instance."""
+    import json
+
+    from pydcop_tpu.dcop_cli import main
+
+    src = tmp_path / "i.yaml"
+    lines = ["name: t", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(6):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k in range(6):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {k + 2} if v{k} == v{(k + 1) % 6} "
+                     f"else 0}}")
+    lines.append("agents: [%s]" % ", ".join(
+        f"a{i}" for i in range(6)))
+    src.write_text("\n".join(lines) + "\n")
+    results = {}
+    for prec in ("f32", "bf16"):
+        out = tmp_path / f"r_{prec}.json"
+        rc = main(["-o", str(out), "solve", "-a", "maxsum",
+                   "--precision", prec, "--max_cycles", "40",
+                   str(src)])
+        assert rc == 0
+        with open(out) as f:
+            results[prec] = json.load(f)
+        assert results[prec]["precision"] == prec
+    assert results["f32"]["assignment"] == results["bf16"]["assignment"]
+    assert results["f32"]["cost"] == results["bf16"]["cost"]
+    assert results["f32"]["cycle"] == results["bf16"]["cycle"]
+
+
+def test_precision_env_var_reaches_solver(monkeypatch):
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    arrays = integer_factor_arrays(8, 12, seed=9)
+    monkeypatch.setenv(ENV_VAR, "bf16")
+    solver = MaxSumSolver(arrays)
+    assert solver.policy is BF16
+    assert solver.var_costs.dtype == jnp.bfloat16
