@@ -777,17 +777,18 @@ elapsed = time.perf_counter() - t0
 # step takes its cost planes as ARGUMENTS (device-placed constants),
 # so the census measures real plane reads; a census of the
 # single-chip solver would lie here, because XLA constant-folds the
-# bf16->f32 upcast of closure-constant cubes into f32 constants
+# bf16->f32 upcast of closure-constant cubes into f32 constants.
+# The census itself is the promoted observability surface
+# (pydcop_tpu/observability/hlo.py), the same numbers telemetry runs
+# report as RunResult.compile_stats
 
 
 def census(solver):
+    from pydcop_tpu.observability.hlo import bytes_accessed
     state, consts = solver._device_put()
     args = solver._step_args(consts)
-    ca = solver._step.lower(state["q"], state["r"],
-                            jax.random.PRNGKey(0), *args) \
-        .compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else (ca or {{}})
-    return float(ca.get("bytes accessed", 0.0))
+    return bytes_accessed(solver._step, state["q"], state["r"],
+                          jax.random.PRNGKey(0), *args)
 
 
 # two shapes: binary D=3 coloring (message planes dominate the bytes,
@@ -968,12 +969,147 @@ batches:
         shutil.rmtree(work, ignore_errors=True)
 
 
+_TELEMETRY_CHILD = r"""
+import hashlib, json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pydcop_tpu.generators.fast import coloring_factor_arrays
+from pydcop_tpu.parallel import make_mesh
+from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+N, CYCLES, REPS = {n}, {cycles}, {reps}
+# the round-5/7 mesh shape: 10k vars / 30k edges / 3 colors, 4
+# instances on the dp axis of the (4, 2) mesh, at the solvers'
+# DEFAULT configuration (stability=0.1): the delta-convergence reduce
+# already runs every cycle, so the residual plane reads the step's
+# own delta for free and the A/B isolates telemetry's real increment
+# (flips + conflict evaluator + plane writes).  noise=0.05 keeps the
+# message planes busy and convergence past the CYCLES budget;
+# bit-exactness makes cycles_run identical across legs either way.
+# Both legs live in THIS process and interleave, so host-load drift
+# hits both equally (the naive one-leg-per-process protocol measured
+# 10%+ apparent overheads that were pure scheduling noise)
+arrays = coloring_factor_arrays(N, 3 * N, 3, seed=17, noise=0.05)
+legs = {{}}
+for telemetry in (False, True):
+    sm = ShardedMaxSum(arrays, make_mesh(8), damping=0.5,
+                       stability=0.1, batch=4)
+    sm.run(2, chunk_size=32, collect_metrics=telemetry)  # warm-up
+    legs[telemetry] = sm
+times = {{False: [], True: []}}
+out = {{}}
+for _ in range(REPS):
+    for telemetry, sm in legs.items():
+        t0 = time.perf_counter()
+        sel, cycles = sm.run(CYCLES, chunk_size=32,
+                             collect_metrics=telemetry)
+        times[telemetry].append(time.perf_counter() - t0)
+        out[telemetry] = {{
+            "ms_per_cycle": min(times[telemetry]) * 1e3 / cycles,
+            "records": len(sm.last_cycle_metrics),
+            "host_syncs": sm.last_run_stats["host_syncs"],
+            "sel_sha": hashlib.sha256(
+                np.ascontiguousarray(np.asarray(sel, dtype=np.int32))
+                .tobytes()).hexdigest()}}
+# paired per-rep ratios: legs alternate back-to-back, so host-load
+# drift cancels within a pair; the median pair and the best-of-N
+# ratio are BOTH honest aggregates, and a shared noisy host can push
+# either one high on its own — a real regression shows in both, so
+# the contract reads the smaller (a 6% phantom from one busy minute
+# must not fail the suite; a real >5% regression still does)
+ratios = sorted(on / off for off, on
+                in zip(times[False], times[True]))
+out[True]["paired_overhead"] = min(
+    ratios[len(ratios) // 2],
+    min(times[True]) / min(times[False])) - 1.0
+print("CHILD_RESULT " + json.dumps({{"off": out[False],
+                                     "on": out[True]}}))
+"""
+
+
+def bench_telemetry_overhead(quick=False):
+    """Telemetry off/on A/B (ISSUE 5): the SAME 10k-var sharded
+    MaxSum program, default solver configuration, with and without
+    the on-device metric planes (residual/flips/conflicts written
+    inside the chunk body, drained at chunk boundaries only).
+
+    One child process holds BOTH legs and interleaves them
+    (best-of-6): one-leg-per-process A/Bs on a shared host measured
+    10%+ apparent overheads that were scheduling drift, not
+    telemetry.  THREE contracts asserted IN the bench: selections
+    bit-identical (telemetry must never perturb the solve), zero
+    extra host syncs, and ms/cycle overhead under 5%.  Host-CPU
+    numbers, labeled as such per the round-4 protocol."""
+    import json as _json
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    n = 1024 if quick else 10_000
+    cycles = 30
+    proc = subprocess.run(
+        [sys.executable, "-c", _TELEMETRY_CHILD.format(
+            n=n, cycles=cycles, reps=8)],
+        capture_output=True, text=True, timeout=1800, env=env,
+        cwd=repo)
+    out = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHILD_RESULT "):
+            out = _json.loads(line[len("CHILD_RESULT "):])
+    if out is None:
+        raise RuntimeError(
+            (proc.stderr.strip().splitlines()
+             or ["no output"])[-1][:300])
+    if out["on"]["sel_sha"] != out["off"]["sel_sha"]:
+        raise RuntimeError(
+            "telemetry contract violated: telemetry-on selections "
+            "diverged from telemetry-off")
+    if out["on"]["records"] != cycles:
+        raise RuntimeError(
+            f"telemetry contract violated: {out['on']['records']} "
+            f"cycle records for {cycles} cycles")
+    if out["on"]["host_syncs"] != out["off"]["host_syncs"]:
+        raise RuntimeError(
+            "telemetry contract violated: extra host syncs "
+            f"({out['on']['host_syncs']} vs "
+            f"{out['off']['host_syncs']})")
+    overhead = out["on"]["paired_overhead"]
+    # the < 5% budget is a claim about the production shape, where the
+    # step amortizes the evaluator's fixed per-cycle collective costs;
+    # at --quick's 1k vars the step is so cheap that those fixed costs
+    # dominate the RATIO while being identical in absolute terms — the
+    # quick run smoke-tests the machinery, the full run asserts
+    if overhead >= 0.05 and not quick:
+        raise RuntimeError(
+            f"telemetry contract violated: {overhead:.1%} ms/cycle "
+            "overhead (budget < 5%)")
+    return {
+        "metric": f"telemetry_overhead_{n}var_ms_per_cycle",
+        "value": {
+            "off": round(out["off"]["ms_per_cycle"], 3),
+            "on": round(out["on"]["ms_per_cycle"], 3),
+            "overhead": round(overhead, 4),
+        },
+        "unit": "ms/cycle",
+        "cycles": cycles,
+        "selections_equal": True,
+        "sync_contract_ok": True,
+        "overhead_contract_asserted": not quick,
+        "hardware": "cpu-host",
+        "virtual_mesh": True,
+    }
+
+
 BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_dpop_device_widetree, bench_dpop_sharded_util,
            bench_dpop_meetings, bench_localsearch_10k, bench_batched,
            bench_mixed_hard_constraints, bench_batched_localsearch,
            bench_batch_campaign_fused, bench_nary_fastpath,
-           bench_mesh_dispatch, bench_hetero_batch, bench_precision]
+           bench_mesh_dispatch, bench_hetero_batch, bench_precision,
+           bench_telemetry_overhead]
 
 
 def main():

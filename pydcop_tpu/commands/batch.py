@@ -88,6 +88,19 @@ def set_parser(subparsers):
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
+    parser.add_argument("--telemetry", type=str, default=None,
+                        metavar="out.jsonl",
+                        help="structured JSONL campaign telemetry "
+                             "(same schema as solve --telemetry, "
+                             "docs/analysing_results.md): fused "
+                             "groups emit one header per group plus "
+                             "per-cycle metric records and a summary "
+                             "PER JOB, each attributed with job_id "
+                             "(and fuse_rung on the hetero path); "
+                             "subprocess jobs contribute their "
+                             "summary record.  All writers append "
+                             "atomically, so one file serves the "
+                             "whole campaign")
     parser.add_argument("--consolidated-out", dest="consolidated_out",
                         default=None, metavar="results.jsonl",
                         help="opt-in: stream ONE JSON line per job "
@@ -299,7 +312,8 @@ def _append_jsonl(path: str, job_id: str, result: dict):
 
 def _run_fused_group(key, rows, out_dir, register_done,
                      consolidated_out=None, hetero=False,
-                     precision=None, max_rung_mb=None):
+                     precision=None, max_rung_mb=None,
+                     telemetry=None):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -367,6 +381,43 @@ def _run_fused_group(key, rows, out_dir, register_done,
     if float(params.get("noise", 0) or 0) != 0:
         hetero = False
 
+    # one reporter per fused group: header now, per-job cycle records
+    # + summaries from emit() below — every record lands in the ONE
+    # campaign jsonl via atomic appends (observability/report.py)
+    reporter = None
+    if telemetry:
+        from ..observability.report import RunReporter
+
+        reporter = RunReporter(telemetry, algo=algo, mode="batch-fused")
+        reporter.header(
+            algo_params=list(algo_params), max_cycles=max_cycles,
+            jobs=len(rows), precision=precision_name,
+            hetero=bool(hetero))
+
+    try:
+        _run_fused_group_inner(
+            key, rows, out_dir, register_done, consolidated_out,
+            hetero, algo, params, max_cycles, explicit_seed,
+            precision_name, policy, max_rung_mb, reporter)
+    finally:
+        if reporter is not None:
+            reporter.close()
+
+
+def _run_fused_group_inner(key, rows, out_dir, register_done,
+                           consolidated_out, hetero, algo, params,
+                           max_cycles, explicit_seed, precision_name,
+                           policy, max_rung_mb, reporter):
+    import numpy as np
+
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..dcop.dcop import filter_dcop
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+    from ..parallel.batch import (BatchedDsa, BatchedMaxSum, BatchedMgm,
+                                  runner_for_rung)
+    from ..parallel.bucketing import ShapeProfile, plan_rungs
+    from . import output_json
+
     dcops, arrays_of = {}, {}
     for _job, path, _it in rows:
         if path not in dcops:
@@ -390,11 +441,13 @@ def _run_fused_group(key, rows, out_dir, register_done,
         by_topo.setdefault(sig, []).append(row)
 
     def emit(sub, sel_rows, costs, viols, cycles, finished, elapsed,
-             extra_of, tag):
+             extra_of, tag, cycle_metrics=None):
         """Per-job result files from the batched outputs.  Costs and
         violation counts arrive from the runner's ONE vmapped device
         evaluation (``runner.evaluate``); the host only decodes value
-        names."""
+        names.  ``cycle_metrics`` (per-instance record lists from the
+        runner's telemetry planes) land in the campaign jsonl
+        attributed per job and per rung."""
         for i, (job_id, path, _it) in enumerate(sub):
             dcop = dcops[path]
             var_names = arrays_of[path].var_names
@@ -417,12 +470,24 @@ def _run_fused_group(key, rows, out_dir, register_done,
             }
             if precision_name:
                 result["precision"] = precision_name
-            result.update(extra_of(path))
+            extra = extra_of(path)
+            result.update(extra)
             if consolidated_out:
                 _append_jsonl(consolidated_out, job_id, result)
             else:
                 out_path = os.path.join(out_dir, f"{job_id}.json")
                 output_json(result, out_path, quiet=True)
+            if reporter is not None:
+                attrib = {"job_id": job_id}
+                if "fuse_rung" in extra:
+                    attrib["fuse_rung"] = extra["fuse_rung"]
+                if cycle_metrics is not None:
+                    reporter.cycles(cycle_metrics[i], **attrib)
+                reporter.summary(
+                    status=result["status"], cost=result["cost"],
+                    violation=result["violation"],
+                    cycle=result["cycle"], time=result["time"],
+                    fused_batch=len(sub), **attrib)
             register_done(job_id)
             print(f"[ok] {job_id} ({tag} x{len(sub)}, "
                   f"{elapsed:.1f}s total)")
@@ -452,12 +517,15 @@ def _run_fused_group(key, rows, out_dir, register_done,
         runner = cls(template, cubes_batches=cubes_batches,
                      batch=len(sub), **params)
         t0 = time.perf_counter()
-        sel, cycles, finished = runner.run(max_cycles=max_cycles,
-                                           seeds=row_seeds(sub))
+        sel, cycles, finished = runner.run(
+            max_cycles=max_cycles, seeds=row_seeds(sub),
+            collect_metrics=reporter is not None)
         costs, viols = runner.evaluate(sel)
         elapsed = time.perf_counter() - t0
         emit(sub, list(sel), costs, viols, cycles, finished, elapsed,
-             extra_of, tag)
+             extra_of, tag,
+             cycle_metrics=runner.last_cycle_metrics
+             if reporter is not None else None)
 
     topo_groups = list(by_topo.values())
     if not (hetero and len(topo_groups) > 1):
@@ -507,8 +575,9 @@ def _run_fused_group(key, rows, out_dir, register_done,
         runner = runner_for_rung(algo, instances, params,
                                  rung_signature=rung.signature)
         t0 = time.perf_counter()
-        sel, cycles, finished = runner.run(max_cycles=max_cycles,
-                                           seeds=row_seeds(sub))
+        sel, cycles, finished = runner.run(
+            max_cycles=max_cycles, seeds=row_seeds(sub),
+            collect_metrics=reporter is not None)
         # ONE vmapped device evaluation per rung (phantom rows
         # contribute exactly zero, so padded costs == true costs)
         costs, viols = runner.evaluate(sel)
@@ -518,7 +587,9 @@ def _run_fused_group(key, rows, out_dir, register_done,
              elapsed,
              lambda path, ri=ri: {"fuse_rung": ri,
                                   "padding_waste": waste_of[path]},
-             "fused-hetero")
+             "fused-hetero",
+             cycle_metrics=runner.last_cycle_metrics
+             if reporter is not None else None)
         programs += 1
     # one parsable stats line per group: the bench_hetero_batch
     # program-count contract reads it, campaign authors grep it
@@ -549,7 +620,8 @@ def _fused_child_main(argv=None) -> int:
                      consolidated_out=spec.get("consolidated_out"),
                      hetero=spec.get("hetero", False),
                      precision=spec.get("precision"),
-                     max_rung_mb=spec.get("max_rung_mb"))
+                     max_rung_mb=spec.get("max_rung_mb"),
+                     telemetry=spec.get("telemetry"))
     return 0
 
 
@@ -637,6 +709,7 @@ def run_cmd(args, timeout=None):
                         "precision": getattr(args, "precision", None),
                         "max_rung_mb": getattr(args, "max_rung_mb",
                                                None),
+                        "telemetry": getattr(args, "telemetry", None),
                         "consolidated_out": getattr(
                             args, "consolidated_out", None)}, f)
         failure = None
@@ -673,6 +746,7 @@ def run_cmd(args, timeout=None):
             if job[0] not in done and job[0] not in fused_ids]
 
     consolidated_out = getattr(args, "consolidated_out", None)
+    telemetry_out = getattr(args, "telemetry", None)
 
     def run_one(job):
         job_id, argv, _meta = job
@@ -707,18 +781,41 @@ def run_cmd(args, timeout=None):
                            f"{proc.stderr}")
         except subprocess.TimeoutExpired:
             failure = f"timed out after {args.job_timeout}s"
-        if failure is None and consolidated_out:
-            # opt-in jsonl stream: fold the job's result file into one
-            # consolidated line and drop the per-job artifact
+        if failure is None and (consolidated_out or telemetry_out):
             import json as _json
 
             try:
                 with open(out_path) as f:
                     result = _json.load(f)
-                _append_jsonl(consolidated_out, job_id, result)
-                os.remove(out_path)
+                if telemetry_out:
+                    # subprocess jobs contribute their summary record
+                    # to the campaign telemetry (cycle metrics live in
+                    # the fused path; a subprocess child writes only
+                    # its own --telemetry file when asked per job)
+                    from ..observability.report import RunReporter
+
+                    rep = RunReporter(
+                        telemetry_out,
+                        algo=_meta["conf"].get("algo", "unknown"),
+                        mode="batch-subprocess")
+                    try:
+                        rep.summary(
+                            job_id=job_id,
+                            status=result.get("status"),
+                            cost=result.get("cost"),
+                            violation=result.get("violation"),
+                            cycle=result.get("cycle"),
+                            time=result.get("time"))
+                    finally:
+                        rep.close()
+                if consolidated_out:
+                    # opt-in jsonl stream: fold the job's result file
+                    # into one consolidated line and drop the per-job
+                    # artifact
+                    _append_jsonl(consolidated_out, job_id, result)
+                    os.remove(out_path)
             except (OSError, ValueError) as e:
-                failure = f"consolidated-out fold failed: {e}"
+                failure = f"consolidated/telemetry fold failed: {e}"
         if failure is None:
             # register immediately (not in submission order) so an
             # interrupted --parallel campaign never re-runs a finished

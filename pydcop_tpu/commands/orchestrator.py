@@ -13,8 +13,6 @@ end-of-run summary row, ``--uiport`` starts the websocket UI server.
 
 import csv
 import os
-import queue
-import threading
 import time
 
 from . import build_algo_def, output_json
@@ -75,14 +73,13 @@ def run_cmd(args, timeout=None):
     scenario = (load_scenario_from_file(args.scenario)
                 if args.scenario else None)
 
-    collector, collector_thread, stop_evt = None, None, None
+    collector = None
     if args.run_metrics:
-        collector = queue.Queue()
-        stop_evt = threading.Event()
-        collector_thread = threading.Thread(
-            target=_collect_to_csv,
-            args=(collector, args.run_metrics, stop_evt), daemon=True)
-        collector_thread.start()
+        # lossless stop contract: queue drained, file fsynced,
+        # discarded rows counted and warned (observability/collector)
+        from ..observability.collector import CsvCollector
+
+        collector = CsvCollector(args.run_metrics)
 
     comm = HttpCommunicationLayer(
         (args.address, args.port),
@@ -116,28 +113,10 @@ def run_cmd(args, timeout=None):
             _append_end_metrics(args.end_metrics, result)
         output_json(result, args.output)
     finally:
-        if stop_evt is not None:
-            stop_evt.set()
-            collector_thread.join(2)
+        if collector is not None:
+            collector.stop()
         orchestrator.stop()
     return 0
-
-
-def _collect_to_csv(collector: "queue.Queue", path: str,
-                    stop_evt: threading.Event):
-    """Stream collected metric tuples to CSV
-    (reference: commands/orchestrator.py:412-474 collect_t thread)."""
-    with open(path, "w", newline="") as f:
-        writer = csv.writer(f)
-        writer.writerow(["time", "computation", "value", "cost",
-                         "cycle"])
-        while not stop_evt.is_set() or not collector.empty():
-            try:
-                row = collector.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            writer.writerow(row)
-            f.flush()
 
 
 def _append_end_metrics(path: str, result: dict):

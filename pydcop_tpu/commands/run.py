@@ -51,10 +51,7 @@ def set_parser(subparsers):
 
 
 def run_cmd(args, timeout=None):
-    import queue
-    import threading
-
-    from .solve import _append_end_metrics, _collect_to_csv
+    from .solve import _append_end_metrics
 
     t0 = time.perf_counter()
     dcop = load_dcop_from_file(args.dcop_files)
@@ -63,14 +60,13 @@ def run_cmd(args, timeout=None):
                               mode=dcop.objective)
     from ..infrastructure.run import run_dcop
 
-    collector, collector_thread, stop_evt = None, None, None
+    collector = None
     if args.run_metrics:
-        collector = queue.Queue()
-        stop_evt = threading.Event()
-        collector_thread = threading.Thread(
-            target=_collect_to_csv,
-            args=(collector, args.run_metrics, stop_evt), daemon=True)
-        collector_thread.start()
+        # lossless stop contract: queue drained, file fsynced,
+        # discarded rows counted and warned (observability/collector)
+        from ..observability.collector import CsvCollector
+
+        collector = CsvCollector(args.run_metrics)
 
     res = run_dcop(
         dcop, algo_def, distribution=args.distribution, mode=args.mode,
@@ -79,9 +75,8 @@ def run_cmd(args, timeout=None):
         collect_moment=args.collect_on, collect_period=args.period,
         seed=args.seed, max_cycles=args.max_cycles,
         collector=collector)
-    if stop_evt is not None:
-        stop_evt.set()
-        collector_thread.join(2)
+    if collector is not None:
+        collector.stop()
 
     cost, violations = res.cost, res.violations
     if res.assignment and set(res.assignment) == set(dcop.variables):

@@ -10,8 +10,6 @@ exercised.  Prints a JSON result.
 
 import csv
 import os
-import queue
-import threading
 import time
 from typing import Optional
 
@@ -49,6 +47,27 @@ def set_parser(subparsers):
                              "mode")
     parser.add_argument("--run_metrics", type=str, default=None,
                         help="CSV file for run metrics")
+    parser.add_argument("--telemetry", type=str, default=None,
+                        metavar="out.jsonl",
+                        help="structured JSONL run telemetry: one "
+                             "header record (solver/layout/precision/"
+                             "mesh/compile_stats), one record per "
+                             "executed cycle (message residual, "
+                             "selection flips, conflicted-constraint "
+                             "count — recorded ON DEVICE, drained at "
+                             "chunk boundaries, zero extra host "
+                             "syncs) and one summary record; same "
+                             "schema as batch --telemetry "
+                             "(docs/analysing_results.md).  Engine "
+                             "and sharded modes record cycle metrics; "
+                             "thread/process modes emit header + "
+                             "summary only")
+    parser.add_argument("--profile", type=str, default=None,
+                        metavar="DIR",
+                        help="write a jax.profiler trace (Perfetto-"
+                             "readable, kernel families named via "
+                             "jax.named_scope) for the solve into "
+                             "DIR")
     parser.add_argument("--end_metrics", type=str, default=None,
                         help="CSV file to append one end-of-run summary "
                              "row to (reference: solve.py:162)")
@@ -130,14 +149,17 @@ def run_cmd(args, timeout: Optional[float] = None):
         # family consumes the policy even when the algorithm's own
         # engine params predate it
         precision_name = None
-    collector, collector_thread, stop_evt = None, None, None
+    collector = None
     if args.run_metrics:
-        collector = queue.Queue()
-        stop_evt = threading.Event()
-        collector_thread = threading.Thread(
-            target=_collect_to_csv,
-            args=(collector, args.run_metrics, stop_evt), daemon=True)
-        collector_thread.start()
+        # lossless stop contract: queue drained, file fsynced, any
+        # discarded rows counted and warned (observability/collector)
+        from ..observability.collector import CsvCollector
+
+        collector = CsvCollector(args.run_metrics)
+    telemetry_path = getattr(args, "telemetry", None)
+    profile_dir = getattr(args, "profile", None)
+
+    from ..observability.spans import profile_trace
 
     if args.mode == "sharded":
         from . import parse_algo_params
@@ -169,18 +191,23 @@ def run_cmd(args, timeout: Optional[float] = None):
             collect_every = max(1, int(round(args.period)))
         elif args.run_metrics:
             collect_every = 16
-        res = solve_sharded_result(
-            dcop, args.algo, n_cycles=args.max_cycles,
-            batch=args.batch, seed=args.seed, timeout=timeout,
-            collect_cost_every=collect_every, **params)
+        with profile_trace(profile_dir):
+            res = solve_sharded_result(
+                dcop, args.algo, n_cycles=args.max_cycles,
+                batch=args.batch, seed=args.seed, timeout=timeout,
+                collect_cost_every=collect_every,
+                telemetry=bool(telemetry_path), **params)
         cost, violations = dcop.solution_cost(
             res.assignment, infinity=args.infinity)
         if collector is not None:
             for cycle, c in res.cost_trace:
                 collector.put(("", "global", "", c, cycle))
-        if stop_evt is not None:
-            stop_evt.set()
-            collector_thread.join(2)
+            collector.stop()
+        # real message-plane traffic derived from the compiled layout
+        # (edges x domain x store-dtype itemsize x cycles run x batch)
+        # instead of the old hardcoded zeros
+        msg_count = res.metrics.get("msg_per_cycle", 0) * res.cycles
+        msg_size = res.metrics.get("bytes_per_cycle", 0) * res.cycles
         result = {
             # the runner reports whether its own termination fired
             # (SAME_COUNT stability, DBA zero violations) — even when
@@ -191,13 +218,16 @@ def run_cmd(args, timeout: Optional[float] = None):
             "violation": violations,
             "cycle": res.cycles,
             "time": time.perf_counter() - t0,
-            "msg_count": 0,
-            "msg_size": 0,
+            "msg_count": msg_count,
+            "msg_size": msg_size,
         }
         if precision_name:
             result["precision"] = precision_name
         if res.cost_trace:
             result["cost_trace"] = res.cost_trace
+        if telemetry_path:
+            _report_telemetry(telemetry_path, args, res, result,
+                              dcop=dcop)
         if args.end_metrics:
             _append_end_metrics(args.end_metrics, result)
         output_json(result, args.output)
@@ -211,10 +241,13 @@ def run_cmd(args, timeout: Optional[float] = None):
             collect_every = max(1, int(round(args.period)))
         elif args.run_metrics:
             collect_every = 16  # default trace granularity (cycles)
-        res = solve_result(
-            dcop, algo_def, distribution=args.distribution,
-            timeout=timeout, max_cycles=args.max_cycles, seed=args.seed,
-            collect_cost_every=collect_every)
+        with profile_trace(profile_dir):
+            res = solve_result(
+                dcop, algo_def, distribution=args.distribution,
+                timeout=timeout, max_cycles=args.max_cycles,
+                seed=args.seed,
+                collect_cost_every=collect_every,
+                telemetry=bool(telemetry_path))
         metrics = res.metrics
         if collector is not None:
             # engine mode has no per-computation value stream; feed the
@@ -224,18 +257,19 @@ def run_cmd(args, timeout: Optional[float] = None):
     else:
         from ..infrastructure.run import run_dcop
 
-        res = run_dcop(
-            dcop, algo_def, distribution=args.distribution,
-            mode=args.mode, timeout=timeout, max_cycles=args.max_cycles,
-            seed=args.seed, collector=collector,
-            collect_moment=args.collect_on,
-            collect_period=args.period, delay=args.delay,
-            uiport=args.uiport)
+        with profile_trace(profile_dir):
+            res = run_dcop(
+                dcop, algo_def, distribution=args.distribution,
+                mode=args.mode, timeout=timeout,
+                max_cycles=args.max_cycles,
+                seed=args.seed, collector=collector,
+                collect_moment=args.collect_on,
+                collect_period=args.period, delay=args.delay,
+                uiport=args.uiport)
         metrics = res.metrics
 
-    if stop_evt is not None:
-        stop_evt.set()
-        collector_thread.join(2)
+    if collector is not None:
+        collector.stop()
 
     cost, violations = res.cost, res.violations
     if res.assignment and set(res.assignment) == set(dcop.variables):
@@ -261,10 +295,66 @@ def run_cmd(args, timeout: Optional[float] = None):
         result["precision"] = precision_name
     if res.cost_trace:
         result["cost_trace"] = res.cost_trace
+    if telemetry_path:
+        _report_telemetry(telemetry_path, args, res, result, dcop=dcop)
     if args.end_metrics:
         _append_end_metrics(args.end_metrics, result)
     output_json(result, args.output)
     return 0
+
+
+def _report_telemetry(path: str, args, res, result: dict, dcop=None):
+    """Emit the run's JSONL telemetry: header (solver/layout/precision/
+    mesh/compile stats), one record per executed cycle, and the final
+    summary — one schema across solve/batch/sharded
+    (observability/report.py).  Thread/process runs have no compiled
+    chunk: they emit header + summary only."""
+    from ..observability.report import RunReporter
+
+    reporter = RunReporter(path, algo=args.algo, mode=args.mode)
+    try:
+        _report_telemetry_records(reporter, args, res, result, dcop)
+    finally:
+        reporter.close()
+
+
+def _report_telemetry_records(reporter, args, res, result: dict,
+                              dcop=None):
+    from . import parse_algo_params
+
+    header = {
+        "dcop": getattr(dcop, "name", None),
+        "seed": args.seed,
+        "max_cycles": args.max_cycles,
+        "precision": result.get("precision"),
+        "layout": parse_algo_params(args.algo_params).get("layout"),
+    }
+    if args.mode == "sharded":
+        import jax
+
+        from ..parallel import make_mesh
+
+        mesh = make_mesh()
+        header["mesh"] = dict(mesh.shape)
+        header["batch"] = args.batch or mesh.shape["dp"]
+        header["devices"] = len(jax.devices())
+    if res.compile_stats:
+        header["compile_stats"] = res.compile_stats
+    reporter.header(**header)
+    reporter.cycles(res.cycle_metrics)
+    spans = res.metrics.get("spans")
+    summary = {
+        "status": result["status"],
+        "cost": result["cost"],
+        "violation": result["violation"],
+        "cycle": result["cycle"],
+        "time": result["time"],
+        "msg_count": result["msg_count"],
+        "msg_size": result["msg_size"],
+    }
+    if spans:
+        summary["spans"] = spans
+    reporter.summary(**summary)
 
 
 END_METRICS_COLUMNS = ["time", "status", "cost", "violation", "cycle",
@@ -285,17 +375,3 @@ def _append_end_metrics(path: str, result: dict):
         writer.writerow([result[c] for c in END_METRICS_COLUMNS])
 
 
-def _collect_to_csv(collector: "queue.Queue", path: str,
-                    stop_evt: threading.Event):
-    """Stream collected metric tuples to CSV
-    (reference: commands/solve.py:393-441)."""
-    with open(path, "w", newline="") as f:
-        writer = csv.writer(f)
-        writer.writerow(["time", "computation", "value", "cost",
-                        "cycle"])
-        while not stop_evt.is_set() or not collector.empty():
-            try:
-                row = collector.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            writer.writerow(row)

@@ -23,7 +23,19 @@ engine removes that term:
   body writes the per-cycle best-over-batch assignment cost into a
   fixed-size on-device buffer (one float per cycle), so sharded runs
   return the same ``RunResult.cost_trace`` the single-chip engine
-  produces with zero extra host round-trips.
+  produces with zero extra host round-trips;
+* **cycle telemetry** rides the carry the same way
+  (``observability/metrics.py``): message residual ``max|Δq|``,
+  selection flips and conflicted-constraint count per cycle, written
+  into preallocated planes inside the chunk body and drained only at
+  the existing chunk sync boundaries — telemetry-off runs execute the
+  byte-identical untraced chunk, so enabling it can never change
+  selections or convergence cycles;
+* **compile/execute spans**: a telemetry run AOT-compiles the chunk
+  via ``jax.stages`` (``lower()`` / ``compile()`` timed separately,
+  ``observability/spans.py``) and records the HLO bytes/flops census
+  of the compiled chunk (``observability/hlo.py``) as
+  ``last_compile_stats``.
 
 A mesh solver plugs in by implementing:
 
@@ -75,11 +87,25 @@ class ShardedSyncEngine:
         enable_persistent_cache()
         self._solver = solver
         self._chunk = int(chunk_size) if chunk_size else _default_chunk()
-        self._compiled: Dict[bool, Any] = {}
+        self._compiled: Dict[Tuple[bool, bool], Any] = {}
+        #: AOT executables of telemetry runs, keyed by (traced,
+        #: metrics, carry signature) — jax.stages compiled objects are
+        #: shape-specialized, unlike the jit wrappers above
+        self._aot: Dict[Tuple, Any] = {}
+        #: None until the first metrics drive probes the solver: the
+        #: conflict evaluator, or False when the solver has none (the
+        #: violations plane then stays -1)
+        self._viol_ok: Optional[bool] = None
         #: stats of the most recent drive(): dispatches (compiled chunk
         #: launches), host_syncs (loop iterations that read
         #: cycle/finished back), status, duration
         self.last_stats: Dict[str, Any] = {}
+        #: trace_lower/compile/execute wall-time spans of the most
+        #: recent telemetry drive (observability/spans.py)
+        self.last_spans: Dict[str, float] = {}
+        #: HLO census of the most recent telemetry drive's compiled
+        #: chunk (observability/hlo.py)
+        self.last_compile_stats: Dict[str, Any] = {}
 
     @property
     def chunk_size(self) -> int:
@@ -87,56 +113,145 @@ class ShardedSyncEngine:
 
     # ------------------------------------------------------------ chunks
 
-    def _run_chunk(self, traced: bool):
-        if traced not in self._compiled:
-            import jax
-            import jax.numpy as jnp
+    def _ensure_viol(self) -> bool:
+        """Probe (once) whether the solver exposes an on-device
+        conflict evaluator, building it OUTSIDE any trace."""
+        if self._viol_ok is None:
+            ensure = getattr(self._solver, "_ensure_viol_fn", None)
+            if ensure is None:
+                self._viol_ok = False
+            else:
+                try:
+                    ensure()
+                    self._viol_ok = True
+                except NotImplementedError:
+                    self._viol_ok = False
+        return self._viol_ok
 
-            step = self._solver.mesh_step
-            cost = self._solver.mesh_cost if traced else None
+    def _chunk_fn(self, traced: bool, metrics: bool):
+        """The python chunk function (uncompiled): K cycles in one
+        ``lax.while_loop``, with the cost trace and/or metric-plane
+        writes folded into the body."""
+        import jax
+        import jax.numpy as jnp
 
-            def body(s):
+        from ..observability.metrics import (residual_from_q,
+                                             write_metric_planes)
+
+        solver = self._solver
+        step = solver.mesh_step
+        cost = solver.mesh_cost if traced else None
+        sel_of = getattr(solver, "_mesh_sel", None)
+        viol_of = solver.mesh_violations \
+            if metrics and self._ensure_viol() else None
+        residual_of = getattr(solver, "mesh_residual", None)
+
+        def body(s):
+            with jax.named_scope("engine/cycle"):
                 s2 = step(s)
-                if cost is not None:
-                    # best-over-batch anytime cost, written at the
-                    # PRE-increment cycle index: trace[i] is the cost
-                    # after cycle i+1
-                    c = jnp.min(cost(s2))
-                    s2 = dict(s2)
-                    s2["trace"] = s2["trace"].at[s["cycle"]].set(c)
-                return s2
+            i = s["cycle"]
+            out = dict(s2)
+            if cost is not None:
+                # best-over-batch anytime cost, written at the
+                # PRE-increment cycle index: trace[i] is the cost
+                # after cycle i+1
+                with jax.named_scope("engine/cost_trace"):
+                    out["trace"] = out["trace"].at[i].set(
+                        jnp.min(cost(s2)))
+            if metrics:
+                with jax.named_scope("engine/telemetry"):
+                    resid = residual_of(s, s2) \
+                        if residual_of is not None \
+                        else residual_from_q(s, s2)
+                    if sel_of is not None:
+                        flips = jnp.sum(
+                            (sel_of(s2) != sel_of(s)).astype(jnp.int32))
+                    else:
+                        flips = jnp.int32(0)
+                    viol = jnp.min(viol_of(s2)).astype(jnp.int32) \
+                        if viol_of is not None else jnp.int32(-1)
+                    out.update(write_metric_planes(
+                        out, i, resid, flips, viol))
+            return out
 
-            def run_chunk(state, limit):
-                def cond(s):
-                    return jnp.logical_and(
-                        jnp.logical_not(s["finished"]),
-                        s["cycle"] < limit)
+        def run_chunk(state, limit):
+            def cond(s):
+                return jnp.logical_and(
+                    jnp.logical_not(s["finished"]),
+                    s["cycle"] < limit)
 
-                return jax.lax.while_loop(cond, body, state)
+            return jax.lax.while_loop(cond, body, state)
+
+        return run_chunk
+
+    def _run_chunk(self, traced: bool, metrics: bool = False):
+        key = (traced, metrics)
+        if key not in self._compiled:
+            import jax
 
             # donate the carried state: q/r/x buffers are reused in
-            # place across chunks (the trace buffer too)
-            self._compiled[traced] = jax.jit(
-                run_chunk, donate_argnums=(0,))
-        return self._compiled[traced]
+            # place across chunks (the trace and metric planes too)
+            self._compiled[key] = jax.jit(
+                self._chunk_fn(traced, metrics), donate_argnums=(0,))
+        return self._compiled[key]
+
+    def _aot_chunk(self, traced: bool, metrics: bool, state, limit,
+                   clock):
+        """The jax.stages path of a telemetry run: trace+lower and
+        compile timed as separate spans, the compiled chunk's HLO
+        census recorded once per program (signature-keyed cache in
+        observability/spans.py)."""
+        import jax
+
+        from ..observability.spans import aot_cached
+
+        compiled, stats = aot_cached(
+            self._aot, (traced, metrics),
+            jax.jit(self._chunk_fn(traced, metrics),
+                    donate_argnums=(0,)),
+            (state, limit), clock)
+        self.last_compile_stats = stats
+        return compiled
 
     # ------------------------------------------------------------- drive
 
     def drive(self, state: Dict[str, Any], n_cycles: int,
               timeout: Optional[float] = None,
               collect_cost: bool = False,
+              collect_metrics: bool = False,
+              spans: bool = False,
               chunk_size: Optional[int] = None) -> Dict[str, Any]:
         """Run until the solver's ``finished`` flag, the cycle budget,
         or the wall-clock timeout; returns the final carry (with the
-        filled ``trace`` buffer when ``collect_cost``)."""
+        filled ``trace`` buffer when ``collect_cost`` and the metric
+        planes when ``collect_metrics``).  ``spans`` switches to the
+        AOT (jax.stages) path so trace/lower/compile/execute wall
+        times land in ``last_spans`` and the chunk's HLO census in
+        ``last_compile_stats``."""
         import jax.numpy as jnp
+
+        from ..observability.metrics import alloc_metric_planes
+        from ..observability.spans import SpanClock
 
         chunk = int(chunk_size) if chunk_size else self._chunk
         if collect_cost and "trace" not in state:
             state = dict(state)
             state["trace"] = jnp.full((max(1, n_cycles),), jnp.nan,
                                       dtype=jnp.float32)
-        run_chunk = self._run_chunk(collect_cost)
+        if collect_metrics and "m_flips" not in state:
+            state = dict(state)
+            state.update(alloc_metric_planes(n_cycles))
+        clock = SpanClock()
+        if collect_metrics:
+            # build the conflict evaluator (shard_map + device consts)
+            # OUTSIDE the chunk trace, like the cost evaluator
+            self._ensure_viol()
+        if spans:
+            run_chunk = self._aot_chunk(
+                collect_cost, collect_metrics, state, jnp.int32(0),
+                clock)
+        else:
+            run_chunk = self._run_chunk(collect_cost, collect_metrics)
         t0 = time.perf_counter()
         status = "MAX_CYCLES"
         dispatches = 0
@@ -158,13 +273,21 @@ class ShardedSyncEngine:
             limit = min(cycle + chunk, n_cycles)
             state = run_chunk(state, jnp.int32(limit))
             dispatches += 1
+        duration = time.perf_counter() - t0
+        # the dispatch loop (device execution + the two-scalar host
+        # syncs) is the execute span; lower/compile were timed above
+        clock.add("execute_s", duration)
+        self.last_spans = clock.as_dict() if spans else {}
+        if not spans:
+            self.last_compile_stats = {}
         self.last_stats = {
             "dispatches": dispatches,
             "host_syncs": host_syncs,
             "chunk_size": chunk,
             "status": status,
-            "duration": time.perf_counter() - t0,
+            "duration": duration,
             "engine": "chunked",
+            "telemetry": bool(collect_metrics),
         }
         return state
 
@@ -191,6 +314,15 @@ class ShardedSyncEngine:
                 out.append((cyc, float(buf[i])))
         return out
 
+    @staticmethod
+    def take_metrics(state: Dict[str, Any],
+                     cycles: int) -> List[Dict[str, Any]]:
+        """Drain the on-device metric planes as one record per
+        executed cycle (observability/metrics.py schema)."""
+        from ..observability.metrics import metric_records
+
+        return metric_records(state, cycles)
+
 
 class MeshSolverMixin:
     """The shared ``run()`` plumbing of the five sharded solver
@@ -211,9 +343,17 @@ class MeshSolverMixin:
     last_cost_trace: List[Tuple[int, float]] = []
     #: dispatch/host-sync counters of the last run()
     last_run_stats: Dict[str, Any] = {}
+    #: per-cycle telemetry records of the last run() that asked for
+    #: them (observability/metrics.py; empty otherwise)
+    last_cycle_metrics: List[Dict[str, Any]] = []
+    #: trace/lower/compile/execute spans of the last telemetry run()
+    last_spans: Dict[str, float] = {}
+    #: HLO census of the last telemetry run()'s compiled chunk
+    last_compile_stats: Dict[str, Any] = {}
     #: per-instance caches (instance attrs shadow these on first set)
     _mesh_consts = None
     _mesh_cost_fn = None
+    _mesh_viol_fn = None
     _mesh_engine_obj = None
 
     # ------------------------------------------------- per-instance caches
@@ -228,7 +368,7 @@ class MeshSolverMixin:
             self._mesh_consts = self._make_consts()
         return self._mesh_consts
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         raise NotImplementedError(
             f"{type(self).__name__} does not implement a mesh cost "
             f"evaluator; run with collect_cost_every=None")
@@ -240,13 +380,24 @@ class MeshSolverMixin:
             self._mesh_cost_fn = self._build_cost_fn()
         return self._mesh_cost_fn
 
+    def _ensure_viol_fn(self):
+        """The conflict evaluator of the telemetry violations plane:
+        ``fn(x) -> conflicts (B,)``, built once outside any trace,
+        same lifecycle as the cost evaluator."""
+        if self._mesh_viol_fn is None:
+            self._mesh_viol_fn = self._build_cost_fn(
+                with_violations=True)
+        return self._mesh_viol_fn
+
     def _invalidate_mesh_cache(self):
         """Drop every compiled/placed artifact derived from host-side
         solver constants (cubes swapped in place, ...): the device
-        constants, the cost evaluator capturing them, AND the engine
-        whose compiled chunks closure-captured them at trace time."""
+        constants, the cost/conflict evaluators capturing them, AND
+        the engine whose compiled chunks closure-captured them at
+        trace time."""
         self._mesh_consts = None
         self._mesh_cost_fn = None
+        self._mesh_viol_fn = None
         self._mesh_engine_obj = None
 
     # ----------------------------------------------------------- protocol
@@ -258,6 +409,20 @@ class MeshSolverMixin:
         """(B,) assignment cost of the current selections — evaluated
         tp-sharded with one psum (see ``parallel/_mesh_cost.py``)."""
         return self._ensure_cost_fn()(self._mesh_cost_input(state))
+
+    def mesh_violations(self, state):
+        """(B,) conflicted-constraint counts of the current
+        selections (constraints above their own optimum) — the
+        telemetry violations plane, evaluated tp-sharded like the
+        cost."""
+        return self._ensure_viol_fn()(self._mesh_cost_input(state))
+
+    def message_plane_stats(self) -> Dict[str, int]:
+        """Per-cycle message traffic of the compiled layout, for
+        result reporting: ``{"msg_per_cycle", "bytes_per_cycle"}``
+        across the whole restart batch.  Empty when the family has no
+        meaningful message-plane model."""
+        return {}
 
     def _mesh_sel(self, state):
         return state["sel"]
@@ -290,22 +455,42 @@ class MeshSolverMixin:
 
     def _drive_mesh(self, state, n_cycles: int,
                     collect_cost_every: Optional[int] = None,
+                    collect_metrics: bool = False,
+                    spans: bool = False,
                     chunk_size: Optional[int] = None,
                     timeout: Optional[float] = None):
         """Run the chunked engine and decode: returns the single
-        source of truth for ``finished`` / trace / stats, plus the
-        ((B, V) selections, cycles run) pair every run() returns."""
+        source of truth for ``finished`` / trace / stats / telemetry,
+        plus the ((B, V) selections, cycles run) pair every run()
+        returns."""
         import jax
 
-        # materialize device constants (and the cost evaluator when
-        # tracing) BEFORE the chunk trace: a device_put staged inside
-        # the traced body would cache tracers, not arrays
+        # materialize device constants (and the cost/conflict
+        # evaluators when tracing) BEFORE the chunk trace: a
+        # device_put staged inside the traced body would cache
+        # tracers, not arrays
         self._consts()
         if collect_cost_every:
             self._ensure_cost_fn()
+        if hasattr(self, "_set_telemetry_delta"):
+            # pick the step variant for THIS run (both directions: a
+            # telemetry-off run after a telemetry-on one must execute
+            # the original untouched program) and keep the carry's
+            # residual slot in sync with it
+            import jax.numpy as jnp
+
+            self._set_telemetry_delta(collect_metrics)
+            if collect_metrics and "delta" not in state:
+                state = dict(state)
+                state["delta"] = jnp.float32(0)
+            elif not collect_metrics and "delta" in state:
+                state = dict(state)
+                state.pop("delta")
         engine = self._mesh_engine()
         state = engine.drive(state, n_cycles, timeout=timeout,
                              collect_cost=bool(collect_cost_every),
+                             collect_metrics=collect_metrics,
+                             spans=spans,
                              chunk_size=chunk_size)
         cycles = int(state["cycle"])
         self.finished = bool(state["finished"])
@@ -313,6 +498,10 @@ class MeshSolverMixin:
         self.last_cost_trace = engine.take_trace(
             state, cycles, every=collect_cost_every or 1) \
             if collect_cost_every else []
+        self.last_cycle_metrics = engine.take_metrics(state, cycles) \
+            if collect_metrics else []
+        self.last_spans = dict(engine.last_spans)
+        self.last_compile_stats = dict(engine.last_compile_stats)
         sel = np.asarray(jax.device_get(self._mesh_sel(state)))
         return self._decode_sel(sel), cycles
 

@@ -52,3 +52,11 @@ class RunResult:
     status: str = "FINISHED"          # FINISHED | TIMEOUT | MAX_CYCLES
     cost_trace: List[Tuple[int, float]] = field(default_factory=list)
     metrics: Dict[str, Any] = field(default_factory=dict)
+    #: per-cycle telemetry records drained from the on-device metric
+    #: planes ({"cycle", "residual", "flips", "violations"}, see
+    #: observability/metrics.py); empty unless the run asked for
+    #: telemetry
+    cycle_metrics: List[Dict[str, Any]] = field(default_factory=list)
+    #: HLO census of the compiled chunk program (flops/bytes_accessed/
+    #: op counts, observability/hlo.py); filled by telemetry runs
+    compile_stats: Dict[str, Any] = field(default_factory=dict)

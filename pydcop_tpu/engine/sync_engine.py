@@ -41,18 +41,98 @@ class SyncEngine:
             return jax.lax.while_loop(cond, solver.step, state)
 
         self._run_chunk = jax.jit(run_chunk)
+        self._run_chunk_metrics = None   # built on first telemetry run
+        self._aot = {}                   # AOT spans path, per signature
         self._cost = jax.jit(solver.cost)
         self._idx = jax.jit(solver.assignment_indices)
+        #: spans / HLO census of the most recent telemetry run
+        self.last_spans = {}
+        self.last_compile_stats = {}
 
     @property
     def solver(self) -> ArraySolver:
         return self._solver
 
+    def _metrics_chunk_fn(self):
+        """The telemetry chunk: the same while-loop, carrying the
+        metric planes in a (state, planes) tuple so solver ``step``
+        implementations never see (or need to preserve) the extra
+        keys.  Per cycle: selection flips (via the solver's own
+        ``assignment_indices`` decode), message residual ``max|Δq|``
+        when the state carries a ``q`` plane, and the conflicted-
+        constraint count via the generic bucket evaluator
+        (observability/metrics.py); solver arithmetic is untouched, so
+        telemetry-on selections stay bit-exact."""
+        from ..observability.metrics import (conflicts_fn_for,
+                                             residual_from_q,
+                                             write_metric_planes)
+
+        solver = self._solver
+        viol_fn = conflicts_fn_for(solver)
+
+        def body(carry):
+            s, planes = carry
+            s2 = solver.step(s)
+            with jax.named_scope("engine/telemetry"):
+                i = s["cycle"]
+                resid = residual_from_q(s, s2)
+                flips = jnp.sum(
+                    (solver.assignment_indices(s2)
+                     != solver.assignment_indices(s))
+                    .astype(jnp.int32))
+                viol = viol_fn(solver.assignment_indices(s2)) \
+                    .astype(jnp.int32) if viol_fn is not None \
+                    else jnp.int32(-1)
+                planes = write_metric_planes(planes, i, resid, flips,
+                                             viol)
+            return s2, planes
+
+        def run_chunk(carry, limit):
+            def cond(c):
+                return jnp.logical_and(
+                    jnp.logical_not(c[0]["finished"]),
+                    c[0]["cycle"] < limit)
+
+            return jax.lax.while_loop(cond, body, carry)
+
+        return run_chunk
+
+    def _metrics_runner(self, carry, limit, spans: bool, clock):
+        """The compiled telemetry chunk: plain jit, or the jax.stages
+        AOT path when ``spans`` so trace/lower/compile wall times and
+        the HLO census are recorded (signature-keyed cache in
+        observability/spans.py)."""
+        if not spans:
+            if self._run_chunk_metrics is None:
+                self._run_chunk_metrics = jax.jit(
+                    self._metrics_chunk_fn())
+            return self._run_chunk_metrics
+        from ..observability.spans import aot_cached
+
+        compiled, stats = aot_cached(
+            self._aot, "metrics", jax.jit(self._metrics_chunk_fn()),
+            (carry, limit), clock)
+        self.last_compile_stats = stats
+        return compiled
+
     def run(self, key: int = 0, max_cycles: int = 1000,
             timeout: Optional[float] = None,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False,
+            spans: bool = False,
             variables=None) -> RunResult:
-        """Run until convergence, cycle cap, or wall-clock timeout."""
+        """Run until convergence, cycle cap, or wall-clock timeout.
+        ``collect_metrics`` records the per-cycle telemetry planes
+        (``RunResult.cycle_metrics``); ``spans`` additionally splits
+        trace/lower/compile/execute wall time via jax.stages and fills
+        ``RunResult.compile_stats``.  The pure-numpy host path has no
+        compiled chunk to instrument: small problems keep taking it
+        (bit-exactness over observability) and return empty
+        telemetry."""
+        from ..observability.metrics import (alloc_metric_planes,
+                                             metric_records)
+        from ..observability.spans import SpanClock
+
         solver = self._solver
         if (getattr(solver, "host_path", False)
                 and solver.use_host_engine()
@@ -64,6 +144,9 @@ class SyncEngine:
         if isinstance(key, int):
             key = jax.random.PRNGKey(key)
         state = self._solver.init_state(key)
+        planes = alloc_metric_planes(max_cycles) \
+            if collect_metrics else None
+        clock = SpanClock()
         t0 = time.perf_counter()
         status = "MAX_CYCLES"
         trace = []
@@ -81,17 +164,25 @@ class SyncEngine:
                 status = "TIMEOUT"
                 break
             limit = min(cycle + chunk, max_cycles)
-            state = self._run_chunk(state, jnp.int32(limit))
+            if collect_metrics:
+                run_chunk = self._metrics_runner(
+                    (state, planes), jnp.int32(limit), spans, clock)
+                state, planes = run_chunk((state, planes),
+                                          jnp.int32(limit))
+            else:
+                state = self._run_chunk(state, jnp.int32(limit))
             if collect_cost_every:
                 trace.append(
                     (int(state["cycle"]), float(self._cost(state)))
                 )
         duration = time.perf_counter() - t0
+        clock.add("execute_s", duration)
+        self.last_spans = clock.as_dict() if spans else {}
 
         idx = jax.device_get(self._idx(state))
         cost = float(self._cost(state))
         assignment = self._named_assignment(idx, variables)
-        return RunResult(
+        result = RunResult(
             assignment=assignment,
             cycles=int(state["cycle"]),
             finished=bool(state["finished"]),
@@ -101,6 +192,13 @@ class SyncEngine:
             status=status,
             cost_trace=trace,
         )
+        if collect_metrics:
+            result.cycle_metrics = metric_records(
+                planes, result.cycles)
+            result.compile_stats = dict(self.last_compile_stats)
+            if spans:
+                result.metrics["spans"] = dict(self.last_spans)
+        return result
 
     def _named_assignment(self, idx, variables):
         if variables is not None:

@@ -9,6 +9,12 @@ Topics emitted by this framework:
 ``computations.value.<name>``, ``computations.cycle.<name>``,
 ``computations.message_rcv.<name>``, ``computations.message_snd.<name>``,
 ``agents.add_computation.<agent>``, ``engine.chunk.<algo>``.
+
+The observability reporter bridges compiled-engine telemetry onto the
+same vocabulary (``observability/report.py``): per-cycle metric records
+arrive on ``computations.cycle.<algo>`` and run header/summary records
+on ``engine.run.<algo>``, so a subscriber written for the
+infrastructure runtime observes TPU-mode runs unchanged.
 """
 
 import logging

@@ -46,9 +46,18 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                  max_cycles: int = 2000,
                  seed: int = 0,
                  collect_cost_every: Optional[int] = None,
+                 telemetry: bool = False,
                  **kwargs) -> RunResult:
     """Like :func:`solve` but returns the full :class:`RunResult` with
-    cycles, duration, status and true (sign-corrected) cost."""
+    cycles, duration, status and true (sign-corrected) cost.
+
+    ``telemetry`` records per-cycle metric planes
+    (``RunResult.cycle_metrics``), compile/execute spans
+    (``metrics["spans"]``) and the compiled chunk's HLO census
+    (``RunResult.compile_stats``) on the compiled engine path; the
+    pure-numpy host path (tiny problems) and ``solve_direct``
+    algorithms return empty telemetry — bit-exactness of the path
+    choice comes before observability."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, params=kwargs, mode=dcop.objective)
@@ -126,6 +135,7 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     result = engine.run(
         key=seed, max_cycles=max_cycles, timeout=timeout,
         collect_cost_every=collect_cost_every,
+        collect_metrics=telemetry, spans=telemetry,
         variables=[dcop.variable(n) for n in solver.var_names],
     )
     result.duration = time.perf_counter() - t0

@@ -1,0 +1,44 @@
+"""Run telemetry for the compiled data plane.
+
+The reference framework observes runs through per-agent callbacks: every
+message send/receive fires an :class:`EventDispatcher` event and an
+optional CSV trace row (``infrastructure/Events.py`` / ``stats.py``).
+The compiled engines have no per-message host hook to attach to — one
+``lax.while_loop`` dispatch executes thousands of messages — so
+observability here is array-shaped, mirroring how PGMax instruments BP
+iterations in JAX (arXiv:2202.04110):
+
+* :mod:`~pydcop_tpu.observability.metrics` — preallocated per-cycle
+  metric *planes* (message residual, selection flips, conflicted
+  constraints) written inside the compiled chunk body and drained only
+  at existing chunk sync boundaries, exactly like the anytime cost
+  trace: telemetry adds zero extra host round-trips, and the
+  telemetry-off path stays bit-exact;
+* :mod:`~pydcop_tpu.observability.spans` — trace/lower/compile/execute
+  wall-time spans via ``jax.stages`` AOT compilation, plus the
+  ``--profile DIR`` gate around ``jax.profiler.trace``;
+* :mod:`~pydcop_tpu.observability.hlo` — the HLO bytes/flops census
+  (promoted from ``benchmarks/suite.py``), exposed as
+  ``RunResult.compile_stats``;
+* :mod:`~pydcop_tpu.observability.report` — the structured JSONL
+  reporter with ONE schema across ``solve``/``batch``/sharded runs, and
+  the bridge publishing engine telemetry onto the legacy
+  :class:`EventDispatcher` topics;
+* :mod:`~pydcop_tpu.observability.collector` — the ``--run_metrics``
+  CSV collector (queue draining + fsync on stop, dropped rows counted
+  and warned instead of silently discarded).
+"""
+
+from .collector import CsvCollector
+from .hlo import compile_stats
+from .metrics import (METRIC_KEYS, alloc_metric_planes, conflict_count,
+                      metric_records, normalize_buckets)
+from .report import SCHEMA_VERSION, RunReporter, validate_record
+from .spans import SpanClock, profile_trace
+
+__all__ = [
+    "CsvCollector", "METRIC_KEYS", "RunReporter", "SCHEMA_VERSION",
+    "SpanClock", "alloc_metric_planes", "compile_stats",
+    "conflict_count", "metric_records", "normalize_buckets",
+    "profile_trace", "validate_record",
+]

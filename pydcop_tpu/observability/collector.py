@@ -1,0 +1,125 @@
+"""The ``--run_metrics`` CSV collector, without the tail-row drop.
+
+The previous implementation (inline in ``commands/solve.py``) streamed
+rows from a queue to CSV on a daemon thread joined with a 2-second
+timeout: a writer slower than the join window — NFS, a wedged pipe, or
+simply a large backlog — lost the queue tail SILENTLY when the process
+exited and killed the daemon mid-write, and the file was never fsynced.
+
+:class:`CsvCollector` keeps the same producer API (``put(row)``) and
+fixes the teardown contract:
+
+* ``stop()`` drains the queue COMPLETELY before closing (the writer
+  thread keeps consuming after the stop signal until the queue is
+  empty), then flushes and ``fsync``\\ s;
+* a writer that cannot finish inside ``stop(timeout=...)`` no longer
+  fails silently: the number of discarded rows is counted, warned to
+  the log AND returned, so callers (and tests) see exactly what was
+  lost;
+* a writer-thread crash (disk full mid-run) is also surfaced as
+  dropped rows instead of an invisible dead thread.
+"""
+
+import csv
+import logging
+import os
+import queue
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger("pydcop_tpu.observability")
+
+#: the reference's run-metrics header (commands/solve.py:393-441)
+DEFAULT_COLUMNS = ("time", "computation", "value", "cost", "cycle")
+
+
+class CsvCollector:
+    """Queue-fed CSV writer thread with a lossless stop contract."""
+
+    def __init__(self, path: str, columns: Sequence[str] =
+                 DEFAULT_COLUMNS):
+        self.path = path
+        self.columns = list(columns)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self.dropped = 0
+        self._file = open(path, "w", newline="")
+        self._writer = csv.writer(self._file)
+        self._writer.writerow(self.columns)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- producer
+
+    def put(self, row):
+        self._queue.put(row)
+
+    # --------------------------------------------------------- writer
+
+    def _write_row(self, row):
+        """One CSV row; split out so tests can fake a slow/failing
+        writer."""
+        self._writer.writerow(row)
+        # flush per row: a crashed/killed process keeps everything
+        # written so far (the behavior the pre-rewrite orchestrator
+        # collector had); the fsync stays on the stop path
+        self._file.flush()
+
+    def _run(self):
+        try:
+            while not self._stop_evt.is_set() or \
+                    not self._queue.empty():
+                try:
+                    row = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                self._write_row(row)
+        except Exception:  # noqa: BLE001 - surfaced as dropped rows
+            logger.exception("run-metrics writer failed for %s",
+                             self.path)
+        finally:
+            # the WRITER owns teardown: stop() never closes the file
+            # under a live thread, so an overdue writer finishing late
+            # still lands its in-flight row instead of crashing on a
+            # closed file
+            try:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+            except (OSError, ValueError):
+                pass
+
+    # ----------------------------------------------------------- stop
+
+    def stop(self, timeout: Optional[float] = 10.0) -> int:
+        """Signal the writer and wait up to ``timeout`` for it to
+        drain everything (it flushes, fsyncs and closes on its way
+        out).  Returns the number of rows that could NOT be written
+        (0 on the normal path); a non-zero count is also warned with
+        the exact number, never dropped silently.  A writer still
+        wedged past the timeout keeps the file: its in-flight row
+        lands whenever the stall clears (daemon thread), only the
+        drained backlog is counted as dropped."""
+        self._stop_evt.set()
+        self._thread.join(timeout)
+        dropped = 0
+        if self._thread.is_alive():
+            # wedged or still-too-slow writer: reclaim the backlog so
+            # the count is exact; the file stays with the thread
+            while True:
+                try:
+                    self._queue.get_nowait()
+                    dropped += 1
+                except queue.Empty:
+                    break
+        else:
+            # thread exited (file already flushed+closed by its
+            # finally); anything left means it died on an error
+            dropped = self._queue.qsize()
+        self.dropped = dropped
+        if dropped:
+            logger.warning(
+                "run-metrics collector discarded %d row(s) writing %s "
+                "(writer did not drain within %.1fs)",
+                dropped, self.path, timeout if timeout else 0.0)
+        return dropped

@@ -1,0 +1,95 @@
+"""HLO bytes/flops census of a compiled program.
+
+Promoted from ``benchmarks/suite.py`` (the bytes-accessed census that
+justified the mixed-precision PR) into a first-class observability
+surface: :func:`compile_stats` summarizes one ``jax.stages`` lowering /
+executable as a plain dict, exposed to users as
+``RunResult.compile_stats`` and in the telemetry JSONL header.
+
+Caveats carried over from the suite: ``cost_analysis`` figures are the
+XLA *estimates* for the target backend (a list on CPU, one entry per
+partition) and hardware-independent only for the bytes census; wall
+times never come from here (see :mod:`.spans`).
+"""
+
+import re
+from typing import Any, Dict, Optional
+
+#: ops counted in the census are the StableHLO dialect's; everything
+#: else (func/module scaffolding) is noise
+_OP_RE = re.compile(r"=\s*\"?(stablehlo\.[a-z_]+)")
+
+#: keep the census JSON small: only the N most frequent ops
+_CENSUS_TOP = 12
+
+
+def stablehlo_op_census(text: str, top: int = _CENSUS_TOP
+                        ) -> Dict[str, int]:
+    """Count StableHLO ops in a lowered module's text form, most
+    frequent first (capped at ``top`` entries)."""
+    counts: Dict[str, int] = {}
+    for m in _OP_RE.finditer(text):
+        op = m.group(1)[len("stablehlo."):]
+        counts[op] = counts.get(op, 0) + 1
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    return dict(ranked[:top])
+
+
+def _first_analysis(ca) -> Dict[str, float]:
+    """``cost_analysis`` returns a dict, a list of per-partition dicts
+    (CPU), or None depending on backend/version — normalize."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else {}
+
+
+def compile_stats(lowered=None, compiled=None) -> Dict[str, Any]:
+    """Summarize one compiled program: estimated ``flops`` and
+    ``bytes_accessed`` (from ``compiled.cost_analysis()``), generated
+    code size (``memory_analysis``), and the StableHLO op census of
+    the lowered module.  Every field degrades to absence rather than
+    raising — backends without an analysis report what they have."""
+    out: Dict[str, Any] = {}
+    if compiled is not None:
+        try:
+            ca = _first_analysis(compiled.cost_analysis())
+        except Exception:  # noqa: BLE001 - backend-optional surface
+            ca = {}
+        if "flops" in ca:
+            out["flops"] = float(ca["flops"])
+        if "bytes accessed" in ca:
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                out["generated_code_bytes"] = int(
+                    ma.generated_code_size_in_bytes)
+                out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        except Exception:  # noqa: BLE001
+            pass
+    if lowered is not None:
+        try:
+            out["hlo_ops"] = stablehlo_op_census(lowered.as_text())
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def step_compile_stats(fn, *args) -> Dict[str, Any]:
+    """Census one function the way the suite does: lower + compile
+    ``fn`` (already jitted or plain; plain callables are jitted here)
+    against ``args`` and summarize.  The convenience entry the suite's
+    precision bench and one-off diagnostics use."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args)
+    return compile_stats(lowered, lowered.compile())
+
+
+def bytes_accessed(fn, *args) -> float:
+    """The suite's original census value: estimated bytes accessed by
+    one compiled call of ``fn(*args)`` (0.0 when the backend reports
+    none)."""
+    return float(step_compile_stats(fn, *args).get("bytes_accessed",
+                                                   0.0))

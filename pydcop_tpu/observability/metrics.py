@@ -1,0 +1,173 @@
+"""On-device per-cycle metric planes.
+
+Three convergence signals per executed cycle, recorded INSIDE the
+compiled chunk body into preallocated buffers that ride the while-loop
+carry (the same mechanism as the engines' anytime cost trace):
+
+* ``residual`` — the message residual ``max|Δq|`` over every message
+  plane entry, the standard signal for detecting loopy Max-Sum
+  non-convergence (arXiv:1706.02209) before burning a full cycle
+  budget.  ``NaN`` for solvers without message state (local search).
+* ``flips`` — how many variables changed their selected value this
+  cycle, summed over the restart batch.  Zero-flip streaks are what the
+  SAME_COUNT stability rule counts; the plane exposes the raw signal.
+* ``violations`` — conflicted-constraint count: constraints whose cost
+  at the current assignment exceeds their own per-constraint optimum
+  (``> min + 1e-6``).  This is the min-conflicts notion the DSA-B
+  plateau-escape test already uses on device; for hard-constraint
+  models a conflicted hard constraint IS a hard violation.  Reported
+  as the best (minimum) over the restart batch, matching the anytime
+  cost trace's best-over-batch convention.  ``-1`` when the solver has
+  no conflict evaluator.
+
+The planes are drained at existing chunk sync boundaries only, so
+telemetry adds zero extra host round-trips; with telemetry off the
+compiled step is byte-identical (the guard suite asserts selections AND
+convergence cycles are unchanged with it on).
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: record-field names, in schema order
+METRIC_KEYS = ("residual", "flips", "violations")
+
+#: carry keys of the metric planes (engine-private, like ``trace``)
+PLANE_KEYS = ("m_residual", "m_flips", "m_violations")
+
+#: hard cap on metric-plane length: a --max_cycles 10**9 run must not
+#: allocate gigabyte planes; cycles past the cap simply stop recording
+#: (``.at[i].set(..., mode="drop")``)
+PLANE_CAP = 1 << 16
+
+
+def alloc_metric_planes(n_cycles: int) -> Dict[str, Any]:
+    """Preallocated per-cycle planes, NaN / ``-1`` marking never-written
+    rows.  Row ``i`` describes cycle ``i + 1`` (the post-increment
+    convention the cost trace uses)."""
+    import jax.numpy as jnp
+
+    n = max(1, min(int(n_cycles), PLANE_CAP))
+    return {
+        "m_residual": jnp.full((n,), jnp.nan, dtype=jnp.float32),
+        "m_flips": jnp.full((n,), -1, dtype=jnp.int32),
+        "m_violations": jnp.full((n,), -1, dtype=jnp.int32),
+    }
+
+
+def write_metric_planes(planes: Dict[str, Any], i,
+                        residual, flips, violations) -> Dict[str, Any]:
+    """Write one cycle's metrics at plane row ``i`` (out-of-range rows
+    beyond the cap are dropped, never clamped onto row -1)."""
+    return {
+        "m_residual": planes["m_residual"].at[i].set(
+            residual, mode="drop"),
+        "m_flips": planes["m_flips"].at[i].set(flips, mode="drop"),
+        "m_violations": planes["m_violations"].at[i].set(
+            violations, mode="drop"),
+    }
+
+
+def metric_records(planes: Dict[str, Any],
+                   cycles: int) -> List[Dict[str, Any]]:
+    """Extract the device planes as one dict per EXECUTED cycle:
+    ``{"cycle": c, "residual": float|None, "flips": int,
+    "violations": int|None}``.  Never-written rows (a run that finished
+    early, or cycles past the plane cap) are skipped; NaN residual and
+    ``-1`` violations decode to ``None`` (signal not available for this
+    solver), so JSONL consumers see ``null`` instead of sentinels."""
+    import jax
+
+    if not planes or "m_flips" not in planes:
+        return []
+    resid = np.asarray(jax.device_get(planes["m_residual"]))
+    flips = np.asarray(jax.device_get(planes["m_flips"]))
+    viol = np.asarray(jax.device_get(planes["m_violations"]))
+    out = []
+    for i in range(min(int(cycles), len(flips))):
+        if flips[i] < 0:  # never written (finished before this cycle)
+            continue
+        r = float(resid[i])
+        out.append({
+            "cycle": i + 1,
+            "residual": None if math.isnan(r) else r,
+            "flips": int(flips[i]),
+            "violations": None if viol[i] < 0 else int(viol[i]),
+        })
+    return out
+
+
+def residual_from_q(s_prev: Dict[str, Any], s_next: Dict[str, Any]):
+    """Generic residual fallback shared by every engine body:
+    ``max|Δq|`` over a carried ``q`` message plane (invalid slots hold
+    the same masking constant on both sides, contributing exactly 0),
+    NaN for message-free carries.  Solvers with a cheaper in-step
+    reduce override via ``mesh_residual`` instead."""
+    import jax.numpy as jnp
+
+    if "q" not in s_prev:
+        return jnp.float32(jnp.nan)
+    return jnp.max(jnp.abs(s_next["q"].astype(jnp.float32)
+                           - s_prev["q"].astype(jnp.float32)))
+
+
+# --------------------------------------------------------- conflicts
+
+def normalize_buckets(buckets: Sequence) -> List[Tuple[Any, Any]]:
+    """Normalize a solver's per-arity bucket list to ``(cubes,
+    var_ids)`` pairs: MaxSum solvers carry ``(cubes, edge_ids,
+    var_ids)`` triples, local-search solvers ``(cubes, var_ids)``
+    pairs — in both the cubes lead and the var ids trail."""
+    return [(b[0], b[-1]) for b in buckets]
+
+
+def conflict_count(buckets: Sequence[Tuple[Any, Any]], x,
+                   optima: Optional[Sequence] = None):
+    """Number of conflicted constraints at assignment ``x``: cost above
+    the constraint's own optimum (``> min + 1e-6``), the same test the
+    sharded DSA-B plateau-escape rule runs on device.  ``buckets`` are
+    normalized ``(cubes, var_ids)`` pairs; ``optima`` optionally
+    supplies precomputed per-bucket minima (local-search solvers keep
+    them as ``bucket_optima``)."""
+    import jax.numpy as jnp
+
+    from ..ops.kernels import bucket_cost
+
+    total = jnp.int32(0)
+    for bi, (cubes, var_ids) in enumerate(buckets):
+        if cubes.shape[0] == 0:
+            continue
+        c = bucket_cost(jnp.asarray(cubes), jnp.asarray(var_ids),
+                        x).astype(jnp.float32)
+        if optima is not None:
+            opt = jnp.asarray(optima[bi]).astype(jnp.float32)
+        else:
+            cu = jnp.asarray(cubes)
+            opt = jnp.min(cu.reshape(cu.shape[0], -1),
+                          axis=-1).astype(jnp.float32)
+        total = total + jnp.sum((c > opt + 1e-6).astype(jnp.int32))
+    return total
+
+
+def conflicts_fn_for(solver):
+    """A generic single-chip conflict evaluator over the solver's own
+    bucket constants: ``fn(x) -> int32 scalar`` with ``x`` the (V,)
+    selected indices, or ``None`` when the solver exposes no
+    recognizable ``buckets`` structure (the violations plane then stays
+    ``-1``).  Built once OUTSIDE the trace; the buckets become
+    closure constants of the compiled chunk."""
+    buckets = getattr(solver, "buckets", None)
+    if not buckets:
+        return None
+    try:
+        norm = normalize_buckets(buckets)
+        optima = getattr(solver, "bucket_optima", None)
+    except (TypeError, IndexError):
+        return None
+
+    def fn(x):
+        return conflict_count(norm, x, optima=optima)
+
+    return fn

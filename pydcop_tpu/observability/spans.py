@@ -1,0 +1,96 @@
+"""Compile/execute wall-time spans and the profiler gate.
+
+The single number a user used to get — ``time`` in the solve result —
+mixes four very different costs: Python tracing, StableHLO lowering,
+XLA compilation, and the actual on-device execution.  ``jax.stages``
+AOT compilation (``jitted.lower(...).compile()``) lets the engines
+split them explicitly instead of inferring "first dispatch was slow,
+must have compiled":
+
+* ``trace_lower_s`` — Python trace + StableHLO lowering,
+* ``compile_s``     — XLA compilation of the lowered module,
+* ``execute_s``     — accumulated dispatch wall time (device execution
+  plus the per-chunk host sync that reads the two control scalars).
+
+:func:`profile_trace` gates ``jax.profiler.trace`` behind the CLI's
+``--profile DIR`` so runs emit Perfetto-readable traces on demand;
+kernel families are wrapped in ``jax.named_scope`` so those traces show
+``maxsum/factor_update``-style ranges instead of anonymous fusions.
+"""
+
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Dict, Optional
+
+
+class SpanClock:
+    """Accumulates named wall-time spans (seconds).  One instance per
+    engine run; ``as_dict`` rounds for reporting."""
+
+    def __init__(self):
+        self.spans: Dict[str, float] = {}
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float):
+        self.spans[name] = self.spans.get(name, 0.0) + float(seconds)
+
+    def as_dict(self, ndigits: int = 6) -> Dict[str, float]:
+        return {k: round(v, ndigits) for k, v in self.spans.items()}
+
+
+def aot_compile(jitted, args, clock: Optional[SpanClock] = None):
+    """AOT-compile a ``jax.jit``-wrapped function against concrete
+    example ``args`` via ``jax.stages``, timing the trace+lower and
+    compile stages separately.  Returns ``(lowered, compiled)`` — the
+    lowered module feeds the HLO census
+    (:func:`~pydcop_tpu.observability.hlo.compile_stats`), the compiled
+    executable replaces the jit call (donation declared on ``jitted``
+    is preserved)."""
+    clock = clock or SpanClock()
+    with clock.span("trace_lower_s"):
+        lowered = jitted.lower(*args)
+    with clock.span("compile_s"):
+        compiled = lowered.compile()
+    return lowered, compiled
+
+
+def aot_cached(cache: dict, key_prefix, jitted, args, clock):
+    """Signature-keyed compile-once cache shared by both engines:
+    jax.stages executables are specialized to argument
+    shapes/dtypes/tree structure (unlike the jit wrapper's internal
+    cache), so the cache key is ``key_prefix`` + the flattened aval
+    signature of ``args``.  Returns ``(compiled, compile_stats)``;
+    a miss pays one timed lower+compile (spans land on ``clock``) and
+    one HLO census."""
+    import jax
+
+    from .hlo import compile_stats
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = (key_prefix, str(treedef), tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")))
+        for x in leaves))
+    entry = cache.get(sig)
+    if entry is None:
+        lowered, compiled = aot_compile(jitted, args, clock)
+        entry = (compiled, compile_stats(lowered, compiled))
+        cache[sig] = entry
+    return entry
+
+
+def profile_trace(log_dir: Optional[str]):
+    """``jax.profiler.trace`` context when ``log_dir`` is given (the
+    ``--profile DIR`` CLI gate), a no-op context otherwise — callers
+    wrap the run unconditionally."""
+    if not log_dir:
+        return nullcontext()
+    import jax
+
+    return jax.profiler.trace(log_dir)

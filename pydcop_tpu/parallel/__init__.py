@@ -92,6 +92,7 @@ def _build_sharded_solver(dcop, algo: str, mesh, batch: int, params):
 def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
                          mesh=None, batch: int = None, seed: int = 0,
                          collect_cost_every: int = None,
+                         telemetry: bool = False,
                          chunk_size: int = None, timeout: float = None,
                          **params):
     """Like :func:`solve_sharded` but returns the full
@@ -100,6 +101,16 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
     (``collect_cost_every`` cycles between kept samples; traces cost
     nothing in host round-trips), and the engine's dispatch/host-sync
     counters in ``metrics``.
+
+    ``telemetry`` additionally records the per-cycle metric planes
+    (``RunResult.cycle_metrics``: residual / flips / conflicted
+    constraints, drained at chunk boundaries only), splits
+    trace/lower/compile/execute spans (``metrics["spans"]``) and fills
+    ``RunResult.compile_stats`` with the HLO census of the compiled
+    chunk.  Telemetry-off runs execute the identical compiled step —
+    the guard suite asserts selections AND convergence cycles are
+    unchanged.  Message-plane stats (``metrics["msg_per_cycle"]`` /
+    ``metrics["bytes_per_cycle"]``) are always reported.
     """
     import time as _time
 
@@ -116,6 +127,7 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
                                            params)
     sel, cycles = solver.run(
         n_cycles, seed=seed, collect_cost_every=collect_cost_every,
+        collect_metrics=telemetry, spans=telemetry,
         chunk_size=chunk_size, timeout=timeout)
 
     variables = [dcop.variable(n) for n in arrays.var_names]
@@ -134,6 +146,9 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
         if best_key is None or key < best_key:
             best_key, best = key, (assignment, cost, violations)
     stats = dict(getattr(solver, "last_run_stats", {}))
+    stats.update(solver.message_plane_stats())
+    if telemetry and getattr(solver, "last_spans", None):
+        stats["spans"] = dict(solver.last_spans)
     finished = bool(solver.finished)
     return RunResult(
         assignment=best[0],
@@ -146,6 +161,10 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
         else stats.get("status", "MAX_CYCLES"),
         cost_trace=list(getattr(solver, "last_cost_trace", [])),
         metrics=stats,
+        cycle_metrics=list(getattr(solver, "last_cycle_metrics", []))
+        if telemetry else [],
+        compile_stats=dict(getattr(solver, "last_compile_stats", {}))
+        if telemetry else {},
     )
 
 

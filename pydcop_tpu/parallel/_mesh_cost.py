@@ -28,7 +28,8 @@ from ..ops.kernels import bucket_cost
 def build_mesh_cost(mesh, n_vars: int,
                     buckets: List[Tuple[np.ndarray, np.ndarray,
                                         Optional[np.ndarray]]],
-                    var_costs: np.ndarray, x_has_sink: bool):
+                    var_costs: np.ndarray, x_has_sink: bool,
+                    with_violations: bool = False):
     """Compile ``cost(x) -> (B,)`` over the (dp, tp) mesh.
 
     ``buckets``: per arity bucket ``(cubes (TP, F, D, ..., D),
@@ -38,6 +39,16 @@ def build_mesh_cost(mesh, n_vars: int,
     ``var_costs``: the ORIGINAL (V, D) unary costs (no sink row).
     ``x_has_sink``: whether the assignment carries the sink column
     already (local-search state) or needs it appended (selections).
+
+    ``with_violations`` compiles the telemetry variant ``fn(x) ->
+    conflicts (B,)`` INSTEAD: the count of constraints whose cost at
+    ``x`` exceeds their own optimum (``> min + 1e-6`` — the same test
+    the sharded DSA-B plateau rule runs), with per-constraint optima
+    hoisted to build time and the cost sum elided entirely (the
+    evaluator runs every telemetry cycle; the int32 psum is its only
+    collective).  Padded rows are inert either way: a masked row is
+    excluded explicitly, an all-zero dummy row sits exactly at its
+    optimum.
     """
     nb = len(buckets)
     V = n_vars
@@ -52,39 +63,59 @@ def build_mesh_cost(mesh, n_vars: int,
     vc_d = jax.device_put(
         jnp.asarray(np.asarray(var_costs[:V], dtype=np.float32)),
         NamedSharding(mesh, P()))
+    # per-constraint optima hoisted to build time: the conflict test
+    # runs every telemetry cycle — a min over every cube cell inside
+    # the loop body would dominate the evaluator
+    optima_d = [jax.device_put(
+        np.asarray(c, dtype=np.float32)
+        .reshape(c.shape[0], c.shape[1], -1).min(axis=-1), tp_sh)
+        for c, _v, _m in buckets] if with_violations else []
 
     @partial(
         shard_map, mesh=mesh,
         in_specs=(P("dp"), [P("tp")] * nb, [P("tp")] * nb,
-                  [P("tp")] * sum(has_mask), P()),
+                  [P("tp")] * sum(has_mask),
+                  [P("tp")] * len(optima_d), P()),
         out_specs=P("dp"),
     )
-    def cost_fn(x, cubes, var_ids, masks, vc):
+    def cost_fn(x, cubes, var_ids, masks, optima, vc):
         cubes_l = [c[0] for c in cubes]
         vids_l = [v[0] for v in var_ids]
         masks_l = iter([m[0] for m in masks])
         mask_of = [next(masks_l) if hm else None for hm in has_mask]
+        opt_l = [o[0] for o in optima]
 
         def one(x1):
             x1 = x1.astype(jnp.int32)
             x_ext = x1 if x_has_sink else jnp.concatenate(
                 [x1, jnp.zeros((1,), dtype=jnp.int32)])
             tot = jnp.float32(0)
-            for cu, vi, m in zip(cubes_l, vids_l, mask_of):
+            conflicts = jnp.int32(0)
+            for bi, (cu, vi, m) in enumerate(
+                    zip(cubes_l, vids_l, mask_of)):
                 if cu.shape[0] == 0:
                     continue
                 # upcast at the reduction boundary: cubes may be
                 # bf16-stored (ops/precision.py), the trace sums in f32
-                c = bucket_cost(cu, vi, x_ext).astype(jnp.float32)
-                if m is not None:
-                    c = jnp.where(m, c, 0.0)
-                tot = tot + jnp.sum(c)
+                c_raw = bucket_cost(cu, vi, x_ext).astype(jnp.float32)
+                if with_violations:
+                    conf = c_raw > opt_l[bi] + 1e-6
+                    if m is not None:
+                        conf = jnp.logical_and(conf, m)
+                    conflicts = conflicts + jnp.sum(
+                        conf.astype(jnp.int32))
+                else:
+                    c = c_raw if m is None else \
+                        jnp.where(m, c_raw, 0.0)
+                    tot = tot + jnp.sum(c)
+            if with_violations:
+                return jax.lax.psum(conflicts, "tp")
             tot = jax.lax.psum(tot, "tp")
             return tot + jnp.sum(vc[jnp.arange(V), x_ext[:V]])
 
         return jax.vmap(one)(x)
 
     def cost(x):
-        return cost_fn(x, cubes_d, vids_d, mask_args, vc_d)
+        return cost_fn(x, cubes_d, vids_d, mask_args, optima_d, vc_d)
 
     return cost

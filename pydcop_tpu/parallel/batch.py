@@ -66,20 +66,76 @@ class _BatchedRunnerBase:
 
     def __init__(self):
         self.max_cycles = 200
-        self._jitted: Dict[int, object] = {}
+        self._jitted: Dict[Tuple[int, bool], object] = {}
         self._eval_jit = None
         self.n_vars_true: Optional[List[int]] = None
+        #: trace-time flag: the metrics variant of the compiled
+        #: program carries per-cycle metric planes (set by run();
+        #: part of the trace-cache key, so both variants coexist)
+        self._collect_metrics = False
+        #: per-instance telemetry of the last run(collect_metrics=
+        #: True): one record list per instance (observability/metrics)
+        self.last_cycle_metrics: List[List[Dict]] = []
 
     def _drive(self, base, state):
         """The shared convergence loop: step until the solver reports
         finished or the cycle budget runs out.  ``max_cycles`` is baked
-        into the trace via the closure, hence the per-value cache."""
+        into the trace via the closure, hence the per-value cache.
+
+        With ``_collect_metrics`` the carry becomes ``(state,
+        planes)``: the body additionally writes the residual / flips /
+        conflicts planes each cycle (solver arithmetic untouched, so
+        telemetry-on selections stay bit-exact) and the planes are
+        returned alongside the final state."""
         def cond(s):
             return jnp.logical_and(
                 jnp.logical_not(s["finished"]),
                 s["cycle"] < self.max_cycles)
 
-        return jax.lax.while_loop(cond, base.step, state)
+        if not self._collect_metrics:
+            return jax.lax.while_loop(cond, base.step, state)
+
+        from ..observability.metrics import (alloc_metric_planes,
+                                             conflict_count,
+                                             normalize_buckets,
+                                             residual_from_q,
+                                             write_metric_planes)
+
+        # the (possibly vmapped-argument-swapped) instance buckets at
+        # trace time: per-instance conflict counts ride the same
+        # arrays the step reads.  Optima are hoisted OUTSIDE the loop
+        # body (local-search solvers carry them; MaxSum derives them
+        # here once) — an in-body min over every cube cell is most of
+        # the conflict evaluator's cost (PERF_NOTES round 10)
+        buckets = normalize_buckets(base.buckets)
+        optima = getattr(base, "bucket_optima", None)
+        if optima is None:
+            optima = [
+                jnp.min(jnp.asarray(c).reshape(c.shape[0], -1),
+                        axis=-1) if c.shape[0] else
+                jnp.zeros((0,), dtype=jnp.float32)
+                for c, _vi in buckets]
+
+        def body(carry):
+            s, planes = carry
+            s2 = base.step(s)
+            with jax.named_scope("engine/telemetry"):
+                i = s["cycle"]
+                resid = residual_from_q(s, s2)
+                x2 = base.assignment_indices(s2)
+                flips = jnp.sum(
+                    (x2 != base.assignment_indices(s))
+                    .astype(jnp.int32))
+                viol = conflict_count(buckets, x2, optima=optima) \
+                    .astype(jnp.int32)
+                planes = write_metric_planes(planes, i, resid, flips,
+                                             viol)
+            return s2, planes
+
+        final, planes = jax.lax.while_loop(
+            lambda c: cond(c[0]), body,
+            (state, alloc_metric_planes(self.max_cycles)))
+        return final, planes
 
     def set_instances(self, instances) -> None:
         """Re-point the runner at a new instance set of the SAME
@@ -96,18 +152,37 @@ class _BatchedRunnerBase:
         self.n_vars_true = [a.n_vars_true or a.n_vars
                             for a in instances]
 
-    def run(self, seed: int = 0, max_cycles: int = 200, seeds=None):
+    def run(self, seed: int = 0, max_cycles: int = 200, seeds=None,
+            collect_metrics: bool = False):
         """Returns (selections (B, V), cycles (B,), finished (B,)).
         ``seeds`` gives each instance its own engine seed (fused batch
         campaigns: row i carries job i's declared seed); default is the
-        split-key stream of ``seed``."""
+        split-key stream of ``seed``.  ``collect_metrics`` fills
+        ``self.last_cycle_metrics`` with one per-cycle record list per
+        instance (telemetry planes ride the vmapped carry; the
+        telemetry-off program is untouched and cached separately)."""
+        from ..observability.metrics import metric_records
+
         self.max_cycles = max_cycles
+        self._collect_metrics = bool(collect_metrics)
         keys = _batch_keys(seed, seeds, self.B)
-        run_all = self._jitted.get(max_cycles)
+        cache_key = (max_cycles, self._collect_metrics)
+        run_all = self._jitted.get(cache_key)
         if run_all is None:
             run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
-            self._jitted[max_cycles] = run_all
-        sel, cycles, finished = run_all(self._instance_args, keys)
+            self._jitted[cache_key] = run_all
+        if collect_metrics:
+            sel, cycles, finished, planes = run_all(
+                self._instance_args, keys)
+            planes = {k: np.asarray(v) for k, v in planes.items()}
+            cycles = np.asarray(cycles)
+            self.last_cycle_metrics = [
+                metric_records({k: v[i] for k, v in planes.items()},
+                               int(cycles[i]))
+                for i in range(self.B)]
+        else:
+            sel, cycles, finished = run_all(self._instance_args, keys)
+            self.last_cycle_metrics = []
         return (np.asarray(sel), np.asarray(cycles),
                 np.asarray(finished))
 
@@ -240,7 +315,9 @@ class BatchedMaxSum(_BatchedRunnerBase):
                 )
             saved = _swap_dev(base, updates)
             try:
-                final = self._drive(base, base.init_state(key))
+                out = self._drive(base, base.init_state(key))
+                final, planes = out if self._collect_metrics \
+                    else (out, None)
                 # decode through assignment_indices, NOT the raw
                 # selection field: with stability:0 the step elides the
                 # per-cycle argmin and carries the INIT-state selection
@@ -249,6 +326,8 @@ class BatchedMaxSum(_BatchedRunnerBase):
                 sel = base.assignment_indices(final)
             finally:
                 _restore_dev(base, saved)
+            if planes is not None:
+                return sel, final["cycle"], final["finished"], planes
             return sel, final["cycle"], final["finished"]
 
         self._one = one_instance
@@ -362,10 +441,15 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
                         setattr(base, a, args[a])
                 if swap_prob:
                     base.probability = args["probability"]
-                final = self._drive(base, base.init_state(key))
+                out = self._drive(base, base.init_state(key))
+                final, planes = out if self._collect_metrics \
+                    else (out, None)
             finally:
                 for a, v in saved.items():
                     setattr(base, a, v)
+            if planes is not None:
+                return (final["x"], final["cycle"],
+                        final["finished"], planes)
             return final["x"], final["cycle"], final["finished"]
 
         self._one = one_instance

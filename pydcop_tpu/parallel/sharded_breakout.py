@@ -313,11 +313,17 @@ class ShardedLocalSearch(MeshSolverMixin):
                    cycle=s["cycle"] + 1, finished=jnp.all(fin))
         return out
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         return build_mesh_cost(
             self.mesh, self.V,
             [(c, v, None) for _a, c, v in self.sharded_buckets],
-            self._raw_var_costs, x_has_sink=True)
+            self._raw_var_costs, x_has_sink=True,
+            with_violations=with_violations)
+
+    def message_plane_stats(self):
+        from .sharded_localsearch import _value_plane_stats
+
+        return _value_plane_stats(self)
 
     def _mesh_sel(self, state):
         return state["x"]
@@ -330,16 +336,19 @@ class ShardedLocalSearch(MeshSolverMixin):
     def run(self, n_cycles: int, seed: int = 0,
             seeds: Optional[Sequence[int]] = None,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False, spans: bool = False,
             chunk_size: Optional[int] = None,
             timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run); stops early when
         the algorithm's own termination fires on every instance.
         Cycles execute in compiled chunks on device, the termination
-        test included (engine/mesh_engine.py)."""
+        test included (engine/mesh_engine.py);
+        ``collect_metrics``/``spans`` fill the telemetry surfaces."""
         return self._drive_mesh(
             self.mesh_init(seed, seeds), n_cycles,
             collect_cost_every=collect_cost_every,
+            collect_metrics=collect_metrics, spans=spans,
             chunk_size=chunk_size, timeout=timeout)
 
     def run_eager(self, n_cycles: int, seed: int = 0,

@@ -31,6 +31,21 @@ from ..ops.kernels import bucket_cost, candidate_costs
 from ..ops.precision import resolve as resolve_precision
 
 
+def _value_plane_stats(solver, msgs_per_edge: int = 1):
+    """Per-cycle message traffic of a constraint-partitioned local
+    search family, for result reporting: each real variable-constraint
+    edge carries ``msgs_per_edge`` int32 value announcements per cycle
+    per restart instance (2 for the MGM family: value + gain round).
+    This is the layout-derived count ``solve -m sharded`` reports
+    instead of the old hardcoded zeros."""
+    e_real = int(sum(
+        int((vi[:, :, 0] < solver.V).sum()) * a
+        for a, _c, vi in solver.sharded_buckets if a >= 1))
+    msgs = msgs_per_edge * e_real * solver.B
+    return {"msg_per_cycle": msgs,
+            "bytes_per_cycle": msgs * np.dtype(np.int32).itemsize}
+
+
 def _partition_constraints(arrays: HypergraphArrays, tp: int):
     """Round-robin each bucket's constraints over tp shards, padding
     with inert all-zero dummy constraints that point at a sink variable
@@ -209,11 +224,15 @@ class ShardedDsa(MeshSolverMixin):
         out.update(x=x, key=key, cycle=s["cycle"] + 1)
         return out
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         return build_mesh_cost(
             self.mesh, self.V,
             [(c, v, None) for _a, c, v in self.sharded_buckets],
-            self.var_costs, x_has_sink=True)
+            self.var_costs, x_has_sink=True,
+            with_violations=with_violations)
+
+    def message_plane_stats(self):
+        return _value_plane_stats(self)
 
     def _mesh_sel(self, state):
         return state["x"]
@@ -225,14 +244,19 @@ class ShardedDsa(MeshSolverMixin):
 
     def run(self, n_cycles: int, seed: int = 0,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False, spans: bool = False,
             chunk_size: Optional[int] = None,
             timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run); cycles execute in
-        compiled chunks on device (engine/mesh_engine.py)."""
+        compiled chunks on device (engine/mesh_engine.py).
+        ``collect_metrics``/``spans`` fill the telemetry surfaces
+        (``last_cycle_metrics``, ``last_spans``,
+        ``last_compile_stats``)."""
         return self._drive_mesh(
             self.mesh_init(seed), n_cycles,
             collect_cost_every=collect_cost_every,
+            collect_metrics=collect_metrics, spans=spans,
             chunk_size=chunk_size, timeout=timeout)
 
     def run_eager(self, n_cycles: int, seed: int = 0
@@ -430,11 +454,16 @@ class ShardedMgm(MeshSolverMixin):
         out.update(x=x, cycle=s["cycle"] + 1)
         return out
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         return build_mesh_cost(
             self.mesh, self.V,
             [(c, v, None) for _a, c, v in self.sharded_buckets],
-            self.var_costs, x_has_sink=True)
+            self.var_costs, x_has_sink=True,
+            with_violations=with_violations)
+
+    def message_plane_stats(self):
+        # MGM exchanges a value round AND a gain round per cycle
+        return _value_plane_stats(self, msgs_per_edge=2)
 
     def _mesh_sel(self, state):
         return state["x"]
@@ -447,14 +476,17 @@ class ShardedMgm(MeshSolverMixin):
     def run(self, n_cycles: int, seed: int = 0,
             x0: Optional[np.ndarray] = None,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False, spans: bool = False,
             chunk_size: Optional[int] = None,
             timeout: Optional[float] = None) -> Tuple[np.ndarray, int]:
         """Returns ((B, V) selections, cycles run).  ``x0`` optionally
         fixes the initial (B, V) assignment (equivalence tests);
-        cycles execute in compiled chunks on device."""
+        cycles execute in compiled chunks on device.
+        ``collect_metrics``/``spans`` fill the telemetry surfaces."""
         return self._drive_mesh(
             self.mesh_init(seed, x0), n_cycles,
             collect_cost_every=collect_cost_every,
+            collect_metrics=collect_metrics, spans=spans,
             chunk_size=chunk_size, timeout=timeout)
 
     def run_eager(self, n_cycles: int, seed: int = 0,

@@ -305,16 +305,18 @@ class ShardedMaxSum(MeshSolverMixin):
                        domain_mask, domain_size):
             # q, r: (B_loc, E, D); edge_var: (E,)
             def one(q1, r1, k1):
-                new_r = self._factor_update_edge_major(q1, cubes) \
-                    if not lane else jnp.transpose(
-                        self._factor_update_lane_major(
-                            jnp.transpose(q1), cubes))
+                with jax.named_scope("maxsum/factor_update"):
+                    new_r = self._factor_update_edge_major(q1, cubes) \
+                        if not lane else jnp.transpose(
+                            self._factor_update_lane_major(
+                                jnp.transpose(q1), cubes))
                 if damping_nodes in ("factors", "both") and damping > 0:
                     new_r = damping * r1 + (1 - damping) * new_r
-                partial_sum = jax.ops.segment_sum(
-                    new_r, edge_var, num_segments=V + 1)
-                sum_r = jax.lax.psum(partial_sum, "tp")
-                belief = var_costs + sum_r
+                with jax.named_scope("maxsum/var_update"):
+                    partial_sum = jax.ops.segment_sum(
+                        new_r, edge_var, num_segments=V + 1)
+                    sum_r = jax.lax.psum(partial_sum, "tp")
+                    belief = var_costs + sum_r
                 q_new = belief[edge_var] - new_r
                 mask_e = domain_mask[edge_var]
                 mean = (jnp.sum(jnp.where(mask_e, q_new, 0.0), axis=1)
@@ -336,8 +338,12 @@ class ShardedMaxSum(MeshSolverMixin):
                     axis=-1)
                 # stability <= 0 disables delta convergence (same dead-
                 # compute elision as the single-chip solvers): skip the
-                # full-array reduce AND its cross-shard pmax collective
-                if E and self.stability > 0:
+                # full-array reduce AND its cross-shard pmax collective.
+                # Telemetry re-enables it as the residual plane: the
+                # IN-step reduce fuses over q planes already live here,
+                # where an engine-side |Δq| pass would pin the old q
+                # buffer across the step and break donation
+                if E and (self.stability > 0 or self._telemetry_delta):
                     delta_local = jnp.max(
                         jnp.where(mask_e, jnp.abs(q_new - q1), 0.0))
                     delta = jax.lax.pmax(delta_local, "tp")
@@ -393,6 +399,44 @@ class ShardedMaxSum(MeshSolverMixin):
 
     # ---------------------------------------------- mesh engine protocol
 
+    #: telemetry flag: compute the in-step message delta even when
+    #: stability convergence is off, so the residual plane reads it
+    #: from the carry instead of re-walking the q planes
+    _telemetry_delta = False
+    #: per-flag compiled steps (stability<=0 only): toggling telemetry
+    #: must hand back the EXACT prior program, not a rebuild
+    _step_variants = None
+
+    def _set_telemetry_delta(self, on: bool):
+        """Pick the step variant for this run (called by the mixin
+        before EVERY drive, both directions): with the stability rule
+        active the step already computes the delta and both flags
+        share one program; with ``stability<=0`` the two variants are
+        built once each and cached, so a telemetry-off run after a
+        telemetry-on run executes the original untouched program (the
+        bit-exactness contract is about the program, not just the
+        selections).  The delta reduce itself changes no
+        message/selection arithmetic either way."""
+        on = bool(on)
+        if self.stability > 0:
+            self._telemetry_delta = on
+            return
+        if self._step_variants is None:
+            # the step built at __init__ is the flag-off variant
+            self._step_variants = {self._telemetry_delta: self._step}
+        if on not in self._step_variants:
+            self._telemetry_delta = on
+            self._build_step()
+            self._step_variants[on] = self._step
+        else:
+            self._telemetry_delta = on
+            self._step = self._step_variants[on]
+
+    def enable_telemetry_delta(self):
+        """Arm the in-step |Δq| reduce for a telemetry run (public
+        alias of ``_set_telemetry_delta(True)``)."""
+        self._set_telemetry_delta(True)
+
     def mesh_init(self, seed: int):
         """The engine carry: message state + on-device convergence
         bookkeeping (prev selection, SAME_COUNT streak)."""
@@ -409,6 +453,8 @@ class ShardedMaxSum(MeshSolverMixin):
             "cycle": jnp.int32(0),
             "finished": jnp.bool_(False),
         })
+        if self._telemetry_delta:
+            state["delta"] = jnp.float32(0)
         return state
 
     def mesh_step(self, s):
@@ -427,7 +473,17 @@ class ShardedMaxSum(MeshSolverMixin):
         out.update(q=q, r=r, key=key, sel=sel, same=same,
                    cycle=s["cycle"] + 1,
                    finished=same >= SAME_COUNT)
+        if "delta" in s:
+            out["delta"] = jnp.max(delta)
         return out
+
+    def mesh_residual(self, s_prev, s_next):
+        """The telemetry residual plane: the step's own max|Δq|
+        (carried as ``delta``), NaN before ``enable_telemetry_delta``
+        armed it."""
+        if "delta" not in s_next:
+            return jnp.float32(jnp.nan)
+        return s_next["delta"]
 
     def _cost_buckets(self):
         """(cubes, var_ids, valid) triples for the on-device cost: the
@@ -444,19 +500,36 @@ class ShardedMaxSum(MeshSolverMixin):
         subclasses override to undo their solve-order permutation)."""
         return state["sel"]
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         """On-device cost matching the single-chip solver's ``cost``
-        (cubes at selection + unary costs)."""
+        (cubes at selection + unary costs); ``with_violations`` adds
+        the telemetry conflict count (parallel/_mesh_cost.py)."""
         return build_mesh_cost(self.mesh, self.V, self._cost_buckets(),
-                               self.var_costs, x_has_sink=False)
+                               self.var_costs, x_has_sink=False,
+                               with_violations=with_violations)
 
     def _mesh_cost_input(self, state):
         return self._mesh_sel_device(state)
+
+    def message_plane_stats(self):
+        """MaxSum message traffic per cycle: every real edge carries a
+        q (variable->factor) and an r (factor->variable) plane row of
+        D values in the policy's store dtype, per restart instance —
+        the layout-derived counts ``solve -m sharded`` reports instead
+        of the old hardcoded zeros."""
+        e_real = int(sum(
+            int((sb.var_ids[:, :, 0] < self.V).sum()) * sb.arity
+            for sb in self.buckets if sb.arity >= 1))
+        msgs = 2 * e_real * self.B
+        return {"msg_per_cycle": msgs,
+                "bytes_per_cycle":
+                    msgs * self.D * self.policy.store_itemsize}
 
     # ------------------------------------------------------------- runs
 
     def run(self, n_cycles: int, seed: int = 0,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False, spans: bool = False,
             chunk_size: Optional[int] = None,
             timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
@@ -465,12 +538,16 @@ class ShardedMaxSum(MeshSolverMixin):
         the stability threshold) or ``n_cycles``, in compiled chunks on
         device (one host sync per chunk, see
         ``engine/mesh_engine.py``).  ``collect_cost_every`` fills
-        ``self.last_cost_trace`` from the on-device anytime buffer.
+        ``self.last_cost_trace`` from the on-device anytime buffer;
+        ``collect_metrics`` fills ``self.last_cycle_metrics`` the same
+        way (residual/flips/conflicts planes, zero extra host syncs)
+        and ``spans`` records compile/execute spans + the HLO census.
 
         Returns ((B, V) selections, cycles run)."""
         return self._drive_mesh(
             self.mesh_init(seed), n_cycles,
             collect_cost_every=collect_cost_every,
+            collect_metrics=collect_metrics, spans=spans,
             chunk_size=chunk_size, timeout=timeout)
 
     def run_eager(self, n_cycles: int, seed: int = 0
@@ -803,7 +880,7 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
         sel = jnp.argmin(
             jnp.where(dmT, belief, jnp.asarray(SENTINEL, belief.dtype)),
             axis=0)
-        if self.EP and self.stability > 0:
+        if self.EP and (self.stability > 0 or self._telemetry_delta):
             delta = jax.lax.pmax(jnp.max(jnp.where(
                 emask, jnp.abs(q_new - q1), 0.0)), "tp")
         else:

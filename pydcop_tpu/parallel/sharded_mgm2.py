@@ -362,11 +362,18 @@ class ShardedMgm2(MeshSolverMixin):
         out.update(x=x, keys=keys, cycle=s["cycle"] + 1)
         return out
 
-    def _build_cost_fn(self):
+    def _build_cost_fn(self, with_violations: bool = False):
         return build_mesh_cost(
             self.mesh, self.V,
             [(c, v, None) for _a, c, v in self.sharded_buckets],
-            self.var_costs, x_has_sink=False)
+            self.var_costs, x_has_sink=False,
+            with_violations=with_violations)
+
+    def message_plane_stats(self):
+        # MGM-2: value + offer + gain rounds per cycle
+        from .sharded_localsearch import _value_plane_stats
+
+        return _value_plane_stats(self, msgs_per_edge=3)
 
     def _mesh_sel(self, state):
         return state["x"]
@@ -376,6 +383,7 @@ class ShardedMgm2(MeshSolverMixin):
     def run(self, n_cycles: int, seed: int = 0,
             seeds: Optional[Sequence[int]] = None,
             collect_cost_every: Optional[int] = None,
+            collect_metrics: bool = False, spans: bool = False,
             chunk_size: Optional[int] = None,
             timeout: Optional[float] = None
             ) -> Tuple[np.ndarray, int]:
@@ -383,10 +391,12 @@ class ShardedMgm2(MeshSolverMixin):
         each instance its own engine seed (default ``seed + i``); an
         instance's run is then bit-identical to a single-chip
         ``SyncEngine(Mgm2Solver(...)).run(key=that_seed)``.  Cycles
-        execute in compiled chunks on device."""
+        execute in compiled chunks on device;
+        ``collect_metrics``/``spans`` fill the telemetry surfaces."""
         return self._drive_mesh(
             self.mesh_init(seed, seeds), n_cycles,
             collect_cost_every=collect_cost_every,
+            collect_metrics=collect_metrics, spans=spans,
             chunk_size=chunk_size, timeout=timeout)
 
     def run_eager(self, n_cycles: int, seed: int = 0,

@@ -1,0 +1,496 @@
+"""Run telemetry (ISSUE 5): on-device cycle metrics, spans, JSONL.
+
+The load-bearing guard rail: enabling cycle telemetry must not change
+selections OR convergence cycles — for all five sharded families, the
+single-chip engine, and a fused heterogeneous campaign.  The planes
+ride the while-loop carry and are drained only at chunk boundaries;
+the telemetry-off chunk is a separately-compiled, untouched program,
+and this suite is what keeps it that way.
+
+Also under test: the metric-plane plumbing, the JSONL schema +
+EventDispatcher bridge, the HLO census, the layout-derived message
+stats (the ``msg_count: 0`` fix), and the ``--run_metrics`` collector's
+lossless stop contract (the tail-row-drop fix).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators.fast import (coloring_factor_arrays,
+                                        coloring_hypergraph_arrays)
+from pydcop_tpu.observability.metrics import (alloc_metric_planes,
+                                              metric_records,
+                                              write_metric_planes)
+from pydcop_tpu.observability.report import (RunReporter, read_records,
+                                             validate_record)
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- metric planes
+
+
+def test_metric_planes_roundtrip():
+    import jax.numpy as jnp
+
+    planes = alloc_metric_planes(5)
+    planes = write_metric_planes(planes, jnp.int32(0),
+                                 jnp.float32(0.5), jnp.int32(3),
+                                 jnp.int32(2))
+    planes = write_metric_planes(planes, jnp.int32(1),
+                                 jnp.float32(jnp.nan), jnp.int32(0),
+                                 jnp.int32(-1))
+    recs = metric_records(planes, 5)
+    # rows 2-4 were never written: skipped, not emitted as sentinels
+    assert recs == [
+        {"cycle": 1, "residual": 0.5, "flips": 3, "violations": 2},
+        {"cycle": 2, "residual": None, "flips": 0, "violations": None},
+    ]
+
+
+def test_metric_planes_capped_allocation():
+    planes = alloc_metric_planes(10 ** 9)
+    from pydcop_tpu.observability.metrics import PLANE_CAP
+
+    assert planes["m_flips"].shape == (PLANE_CAP,)
+
+
+def test_out_of_cap_write_is_dropped():
+    import jax.numpy as jnp
+
+    planes = alloc_metric_planes(2)
+    planes = write_metric_planes(planes, jnp.int32(7),
+                                 jnp.float32(1.0), jnp.int32(1),
+                                 jnp.int32(1))
+    assert metric_records(planes, 9) == []
+
+
+# --------------------------------- sharded families: bit-exact guard
+
+
+def _mesh():
+    from pydcop_tpu.parallel import make_mesh
+
+    return make_mesh(8)
+
+
+def _factor_arrays():
+    return coloring_factor_arrays(24, 48, 3, seed=5, noise=0.05)
+
+
+def _sharded_maxsum_legs():
+    from pydcop_tpu.parallel.sharded_maxsum import (ShardedAMaxSum,
+                                                    ShardedFusedMaxSum,
+                                                    ShardedMaxSum)
+
+    mesh = _mesh()
+    arrays = _factor_arrays()
+    kw = dict(damping=0.5, stability=0.1, batch=4)
+    return [
+        ("maxsum", lambda: ShardedMaxSum(arrays, mesh, **kw)),
+        ("maxsum-fused",
+         lambda: ShardedFusedMaxSum(arrays, mesh, **kw)),
+        ("amaxsum",
+         lambda: ShardedAMaxSum(arrays, mesh, activation=0.7,
+                                batch=4)),
+    ]
+
+
+def _sharded_hyper_legs():
+    from pydcop_tpu.parallel.sharded_breakout import ShardedDba
+    from pydcop_tpu.parallel.sharded_localsearch import (ShardedDsa,
+                                                         ShardedMgm)
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    mesh = _mesh()
+    arrays = coloring_hypergraph_arrays(16, 32, 3, seed=7)
+    return [
+        ("dsa", lambda: ShardedDsa(arrays, mesh, batch=8)),
+        ("mgm", lambda: ShardedMgm(arrays, mesh, batch=8)),
+        ("mgm2", lambda: ShardedMgm2(arrays, mesh, batch=8)),
+        ("dba", lambda: ShardedDba(arrays, mesh, batch=8)),
+    ]
+
+
+def _assert_telemetry_bit_exact(name, build, n_cycles=12):
+    """Telemetry on == telemetry off: selections AND cycles; the
+    records cover every executed cycle with schema-valid fields."""
+    base = build()
+    sel0, cyc0 = base.run(n_cycles, seed=3)
+    tele = build()
+    sel1, cyc1 = tele.run(n_cycles, seed=3, collect_metrics=True,
+                          spans=True)
+    assert np.array_equal(sel0, sel1), name
+    assert cyc0 == cyc1, name
+    recs = tele.last_cycle_metrics
+    assert len(recs) == cyc1, name
+    for i, r in enumerate(recs):
+        assert r["cycle"] == i + 1
+        assert isinstance(r["flips"], int) and r["flips"] >= 0
+        assert r["violations"] is None or r["violations"] >= 0
+        assert r["residual"] is None or math.isfinite(r["residual"])
+    # spans + census rode the same run
+    assert "compile_s" in tele.last_spans
+    assert "execute_s" in tele.last_spans
+    assert tele.last_compile_stats.get("hlo_ops")
+    return recs
+
+
+@pytest.mark.parametrize("name", ["maxsum", "maxsum-fused", "amaxsum"])
+def test_sharded_maxsum_family_telemetry_bit_exact(name):
+    build = dict(_sharded_maxsum_legs())[name]
+    recs = _assert_telemetry_bit_exact(name, build, n_cycles=15)
+    # message-passing families expose a real residual
+    assert recs[0]["residual"] is not None
+
+
+@pytest.mark.parametrize("name", ["dsa", "mgm", "mgm2", "dba"])
+def test_sharded_local_family_telemetry_bit_exact(name):
+    build = dict(_sharded_hyper_legs())[name]
+    recs = _assert_telemetry_bit_exact(name, build)
+    # message-free families report a null residual, real conflicts
+    assert recs[0]["residual"] is None
+    assert recs[0]["violations"] is not None
+
+
+def test_sharded_telemetry_off_emits_nothing():
+    name, build = _sharded_maxsum_legs()[0]
+    solver = build()
+    solver.run(6, seed=0)
+    assert solver.last_cycle_metrics == []
+    assert solver.last_spans == {}
+    assert solver.last_compile_stats == {}
+
+
+def test_telemetry_delta_toggle_restores_original_step():
+    """A telemetry-off run AFTER a telemetry-on run on the same
+    stability<=0 solver must execute the ORIGINAL program again — the
+    armed in-step delta reduce must not stick (the off leg of the
+    overhead contract is about the program, not just selections)."""
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    arrays = _factor_arrays()
+    sm = ShardedMaxSum(arrays, _mesh(), damping=0.5, stability=0.0,
+                       batch=4)
+    base_step = sm._step
+    sel0, _ = sm.run(8, seed=3)
+    sel1, _ = sm.run(8, seed=3, collect_metrics=True)
+    assert sm._step is not base_step  # armed variant in use
+    assert sm.last_cycle_metrics[0]["residual"] is not None
+    sel2, _ = sm.run(8, seed=3)
+    assert sm._step is base_step      # original program restored
+    assert not sm._telemetry_delta
+    assert np.array_equal(sel0, sel1) and np.array_equal(sel0, sel2)
+
+
+def test_sharded_message_plane_stats_nonzero():
+    for name, build in _sharded_maxsum_legs() + _sharded_hyper_legs():
+        stats = build().message_plane_stats()
+        assert stats["msg_per_cycle"] > 0, name
+        assert stats["bytes_per_cycle"] > 0, name
+
+
+# ------------------------------------------- single-chip sync engine
+
+
+def test_sync_engine_telemetry_bit_exact():
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    arrays = _factor_arrays()
+
+    def run(**kw):
+        solver = MaxSumSolver(arrays, damping=0.5, stability=0.1)
+        solver.host_path = False  # force the compiled path
+        return SyncEngine(solver).run(max_cycles=25, **kw)
+
+    r0 = run()
+    r1 = run(collect_metrics=True, spans=True)
+    assert r0.assignment == r1.assignment
+    assert r0.cycles == r1.cycles
+    assert len(r1.cycle_metrics) == r1.cycles
+    assert r1.cycle_metrics[0]["residual"] is not None
+    assert r1.cycle_metrics[0]["violations"] is not None
+    assert r1.compile_stats.get("hlo_ops")
+    assert "compile_s" in r1.metrics["spans"]
+    # telemetry-off result keeps the historical empty surfaces
+    assert r0.cycle_metrics == [] and r0.compile_stats == {}
+
+
+def test_sync_engine_host_path_returns_empty_telemetry():
+    """Tiny problems keep the pure-numpy host path (bit-exactness of
+    the path choice beats observability): telemetry degrades to empty
+    cycle metrics, never to a changed result."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    arrays = coloring_factor_arrays(10, 18, 3, seed=2)
+    solver = MaxSumSolver(arrays, damping=0.5, stability=0.0,
+                          stop_cycle=8)
+    res = SyncEngine(solver).run(max_cycles=20, collect_metrics=True)
+    assert res.cycles == 8
+    assert res.cycle_metrics == []
+
+
+# ------------------------------------------ fused hetero campaign
+
+
+def test_fused_hetero_campaign_telemetry_bit_exact():
+    """A shape-bucketed padded campaign with telemetry on reproduces
+    the telemetry-off selections and cycles for every job, and its
+    per-instance records cover each job's executed cycles."""
+    from pydcop_tpu.parallel.batch import BatchedDsa
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, plan_rungs
+
+    instances = [coloring_hypergraph_arrays(10, 20, 3, seed=1),
+                 coloring_hypergraph_arrays(14, 25, 3, seed=2),
+                 coloring_hypergraph_arrays(9, 15, 3, seed=3)]
+    profiles = [ShapeProfile.of(a) for a in instances]
+    rungs = plan_rungs(profiles, max_waste=50.0)
+    assert len(rungs) == 1
+    padded = [rungs[0].pad(a) for a in instances]
+
+    r0 = BatchedDsa(padded[0], instances=padded, stop_cycle=12)
+    sel0, cyc0, _ = r0.run(max_cycles=12, seeds=[0, 1, 2])
+    r1 = BatchedDsa(padded[0], instances=padded, stop_cycle=12)
+    sel1, cyc1, _ = r1.run(max_cycles=12, seeds=[0, 1, 2],
+                           collect_metrics=True)
+    assert np.array_equal(sel0, sel1)
+    assert np.array_equal(cyc0, cyc1)
+    assert len(r1.last_cycle_metrics) == 3
+    for i in range(3):
+        assert len(r1.last_cycle_metrics[i]) == int(cyc1[i])
+        assert r1.last_cycle_metrics[i][0]["violations"] is not None
+
+
+def test_batched_maxsum_telemetry_bit_exact():
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    arrays = _factor_arrays()
+    r0 = BatchedMaxSum(arrays, batch=3, damping=0.5, stability=0.1)
+    a0 = r0.run(max_cycles=20)
+    r1 = BatchedMaxSum(arrays, batch=3, damping=0.5, stability=0.1)
+    a1 = r1.run(max_cycles=20, collect_metrics=True)
+    assert np.array_equal(a0[0], a1[0])
+    assert np.array_equal(a0[1], a1[1])
+    assert r1.last_cycle_metrics[0][0]["residual"] is not None
+
+
+# ----------------------------------------------- JSONL + event bridge
+
+
+def test_reporter_schema_and_bus_bridge(tmp_path):
+    from pydcop_tpu.infrastructure.Events import EventDispatcher
+
+    bus = EventDispatcher(enabled=True)
+    seen = []
+    bus.subscribe("computations.cycle.*",
+                  lambda t, e: seen.append(("cycle", t)))
+    bus.subscribe("engine.run.*", lambda t, e: seen.append(("run", t)))
+    path = str(tmp_path / "t.jsonl")
+    rep = RunReporter(path, algo="maxsum", mode="sharded", bus=bus)
+    rep.header(mesh={"dp": 4, "tp": 2})
+    rep.cycle({"cycle": 1, "residual": 0.5, "flips": 2,
+               "violations": 1}, job_id="j0")
+    rep.summary(status="FINISHED", cost=1.0)
+    recs = read_records(path)
+    assert [r["record"] for r in recs] == ["header", "cycle",
+                                           "summary"]
+    for r in recs:
+        validate_record(r)
+    assert recs[1]["job_id"] == "j0"
+    # the legacy event vocabulary saw every record
+    assert ("run", "engine.run.maxsum") in seen
+    assert ("cycle", "computations.cycle.maxsum") in seen
+    assert seen.count(("run", "engine.run.maxsum")) == 2
+
+
+def test_validate_record_rejects_malformed():
+    validate_record({"record": "header", "schema": 1,
+                     "algo": "a", "mode": "engine"})
+    with pytest.raises(ValueError):
+        validate_record({"record": "nope", "algo": "a"})
+    with pytest.raises(ValueError):
+        validate_record({"record": "header", "schema": 99,
+                         "algo": "a", "mode": "engine"})
+    with pytest.raises(ValueError):
+        validate_record({"record": "cycle", "algo": "a", "cycle": 0,
+                         "flips": 1})
+    with pytest.raises(ValueError):
+        validate_record({"record": "cycle", "algo": "a", "cycle": 1,
+                         "flips": -2})
+    with pytest.raises(ValueError):
+        validate_record({"record": "summary", "algo": "a"})
+
+
+def test_solve_sharded_result_telemetry_surfaces():
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.parallel import solve_sharded_result
+
+    yaml_src = """
+name: tiny
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+""" + "".join(
+        f"  v{i}: {{domain: colors, cost_function: '0.0', "
+        f"noise_level: 0.02}}\n" for i in range(8)) \
+        + "constraints:\n" + "".join(
+        f"  c{i}: {{type: intention, function: 1 if v{i} == "
+        f"v{(i + 1) % 8} else 0}}\n" for i in range(8)) + \
+        "agents: [" + ", ".join(f"a{i}" for i in range(8)) + "]\n"
+    dcop = load_dcop(yaml_src)
+    res = solve_sharded_result(dcop, "maxsum", n_cycles=10,
+                               telemetry=True)
+    assert len(res.cycle_metrics) == res.cycles > 0
+    assert res.compile_stats.get("hlo_ops")
+    assert res.metrics["msg_per_cycle"] > 0
+    assert res.metrics["bytes_per_cycle"] > 0
+    assert "compile_s" in res.metrics["spans"]
+    # telemetry off: surfaces stay empty, message stats still real
+    res0 = solve_sharded_result(dcop, "maxsum", n_cycles=10)
+    assert res0.cycle_metrics == [] and res0.compile_stats == {}
+    assert res0.metrics["msg_per_cycle"] > 0
+
+
+# --------------------------------------------------- CLI end-to-end
+
+
+@pytest.mark.slow
+def test_solve_cli_sharded_telemetry_schema(tmp_path):
+    """`solve -m sharded --telemetry out.jsonl` emits schema-valid
+    records (header incl. compile_stats + per-cycle metrics + summary)
+    and real msg_count/msg_size (the hardcoded-zeros fix)."""
+    inst = tmp_path / "inst.yaml"
+    out = tmp_path / "run.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-o", str(inst),
+         "generate", "graph_coloring", "-v", "12", "-c", "3",
+         "-g", "random", "--p_edge", "0.3", "--soft", "--seed", "7"],
+        check=True, capture_output=True, timeout=120, env=env,
+        cwd=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "solve",
+         "-a", "maxsum", "-m", "sharded", "--max_cycles", "12",
+         "--telemetry", str(out), str(inst)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout)
+    assert result["msg_count"] > 0 and result["msg_size"] > 0
+    recs = read_records(str(out))
+    for r in recs:
+        validate_record(r)
+    kinds = [r["record"] for r in recs]
+    assert kinds[0] == "header" and kinds[-1] == "summary"
+    assert kinds.count("cycle") == result["cycle"]
+    header = recs[0]
+    assert header["mesh"] == {"dp": 4, "tp": 2}
+    assert "compile_stats" in header
+    assert recs[-1]["msg_count"] == result["msg_count"]
+
+
+# -------------------------------------------- run_metrics collector
+
+
+class _SlowCollector:
+    """Factory: a CsvCollector whose writes take ``delay`` seconds."""
+
+    def __new__(cls, path, delay):
+        from pydcop_tpu.observability.collector import CsvCollector
+
+        class Slow(CsvCollector):
+            def _write_row(self, row):
+                time.sleep(delay)
+                super()._write_row(row)
+
+        return Slow(path)
+
+
+def test_collector_drains_slow_writer_tail(tmp_path):
+    """The regression the 2s daemon join used to lose: a slow writer
+    with a queue backlog keeps EVERY row when stop() is given time."""
+    path = str(tmp_path / "m.csv")
+    c = _SlowCollector(path, delay=0.02)
+    for i in range(40):
+        c.put((f"{i}", "global", "", 1.0, i))
+    dropped = c.stop(timeout=30)
+    assert dropped == 0 and c.dropped == 0
+    import csv as _csv
+
+    with open(path) as f:
+        rows = list(_csv.reader(f))
+    assert len(rows) == 41  # header + all 40 rows, none discarded
+
+
+def test_collector_counts_and_warns_dropped_rows(tmp_path, caplog):
+    """A writer that cannot drain in time: the tail is COUNTED and
+    warned, never silently discarded."""
+    import logging
+
+    path = str(tmp_path / "m.csv")
+    c = _SlowCollector(path, delay=0.2)
+    for i in range(50):
+        c.put((f"{i}", "global", "", 1.0, i))
+    with caplog.at_level(logging.WARNING,
+                         logger="pydcop_tpu.observability"):
+        dropped = c.stop(timeout=0.3)
+    assert dropped > 0
+    assert any(str(dropped) in rec.message and "discarded"
+               in rec.message for rec in caplog.records)
+
+
+def test_collector_normal_fast_path(tmp_path):
+    from pydcop_tpu.observability.collector import CsvCollector
+
+    path = str(tmp_path / "m.csv")
+    c = CsvCollector(path)
+    for i in range(10):
+        c.put((f"{i}", "global", "", 0.5, i))
+    assert c.stop() == 0
+    with open(path) as f:
+        assert len(f.read().strip().splitlines()) == 11
+
+
+# -------------------------------------------------------- HLO census
+
+
+def test_compile_stats_census():
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.observability.hlo import (step_compile_stats,
+                                              stablehlo_op_census)
+
+    stats = step_compile_stats(
+        jax.jit(lambda x: jnp.sin(x) + x * 2), jnp.ones((16,)))
+    assert stats.get("hlo_ops")
+    assert "sine" in stats["hlo_ops"] or "multiply" in stats["hlo_ops"]
+    census = stablehlo_op_census(
+        '%0 = stablehlo.add %a, %b\n%1 = "stablehlo.add"(%c)\n'
+        '%2 = stablehlo.multiply %a, %b')
+    assert census == {"add": 2, "multiply": 1}
+
+
+def test_spans_clock():
+    from pydcop_tpu.observability.spans import SpanClock, profile_trace
+
+    clock = SpanClock()
+    with clock.span("a"):
+        pass
+    clock.add("a", 1.0)
+    assert clock.as_dict()["a"] >= 1.0
+    # no profile dir -> inert context
+    with profile_trace(None):
+        pass
