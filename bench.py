@@ -20,6 +20,7 @@ compiled engine's CPU-mirror throughput with ``"hardware":
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -209,61 +210,88 @@ _CHILD_CODE = (
 )
 
 
+def _watchdogged(argv, budget_s, env=None):
+    """Run ``argv`` with a HARD deadline: the child gets its own
+    session, and on timeout the whole process GROUP is SIGKILLed.
+
+    ``subprocess.run(timeout=...)`` kills only the direct child; a hung
+    TPU runtime keeps helper threads/grandchildren alive holding the
+    stdout/stderr pipes, so the parent's post-kill ``communicate()``
+    blocks past the nominal budget — exactly the BENCH_r04/r05 probe
+    hang.  Returns ``(stdout, stderr, timed_out)``."""
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO, env=env, start_new_session=True)
+    try:
+        out, errout = proc.communicate(timeout=budget_s)
+        return out, errout, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        # the group is dead: nothing holds the pipes, this returns
+        out, errout = proc.communicate()
+        return out or "", errout or "", True
+
+
 def _run_child(env, budget_s, best_of, conv_best_of):
     code = _CHILD_CODE.format(best_of=best_of,
                               conv_best_of=conv_best_of)
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True,
-            text=True, timeout=budget_s, cwd=REPO, env=env)
-        for line in proc.stdout.splitlines():
-            if line.startswith("BENCH_RESULT "):
-                tpu, conv = json.loads(line[len("BENCH_RESULT "):])
-                return (tuple(tpu), tuple(conv)), None
-        return None, (proc.stderr.strip().splitlines() or ["no output"]
-                      )[-1][:200]
-    except subprocess.TimeoutExpired:
+    out, errout, timed_out = _watchdogged(
+        [sys.executable, "-c", code], budget_s, env=env)
+    if timed_out:
         return None, f"no result in {budget_s:.0f}s"
+    for line in out.splitlines():
+        if line.startswith("BENCH_RESULT "):
+            tpu, conv = json.loads(line[len("BENCH_RESULT "):])
+            return (tuple(tpu), tuple(conv)), None
+    return None, (errout.strip().splitlines() or ["no output"]
+                  )[-1][:200]
 
 
 def probe_device(attempts: int = 2, budget_s: float = 45.0):
     """Bounded device probe: `jax.devices()` through the tunnel hangs
-    forever when the tunnel is down, so never call it in-process."""
+    forever when the tunnel is down, so never call it in-process (and
+    kill the probe's whole process group on timeout — see
+    :func:`_watchdogged`).  Prerequisite: the tunnel/plugin setup in
+    ``provisioning/README.md``."""
     err = None
     for _ in range(attempts):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print('NDEV', len(d), d[0].platform)"],
-                capture_output=True, text=True, timeout=budget_s,
-                cwd=REPO)
-            for line in proc.stdout.splitlines():
-                if not line.startswith("NDEV"):
-                    continue
-                platform = line.split()[-1].lower()
-                # a fast-FAILING plugin falls back to the host backend:
-                # that is an outage, not hardware — never label a CPU
-                # run "tpu"
-                if platform == "cpu":
-                    return False, f"probe found only {platform} devices"
-                return True, None
-            err = (proc.stderr.strip().splitlines() or ["no output"]
-                   )[-1][:200]
-        except subprocess.TimeoutExpired:
+        out, errout, timed_out = _watchdogged(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('NDEV', len(d), d[0].platform)"], budget_s)
+        if timed_out:
             err = f"device probe hung ({budget_s:.0f}s)"
+            continue
+        for line in out.splitlines():
+            if not line.startswith("NDEV"):
+                continue
+            platform = line.split()[-1].lower()
+            # a fast-FAILING plugin falls back to the host backend:
+            # that is an outage, not hardware — never label a CPU
+            # run "tpu"
+            if platform == "cpu":
+                return False, f"probe found only {platform} devices"
+            return True, None
+        else:
+            err = (errout.strip().splitlines() or ["no output"]
+                   )[-1][:200]
     return False, err
 
 
 def measure_accelerator():
-    """Returns (results, hardware, error): hardware is "tpu" or
-    "unavailable" (results then come from the CPU mirror)."""
+    """Returns ``(results, hardware, probe_error, error)``: hardware is
+    "tpu" or "unavailable" (results then come from the CPU mirror).
+    ``probe_error`` is the structured reason the device probe/run leg
+    failed; ``error`` is a CPU-mirror failure, if any."""
     ok, probe_err = probe_device()
     if ok:
         results, err = _run_child(None, budget_s=900.0, best_of=5,
                                   conv_best_of=3)
         if results is not None:
-            return results, "tpu", None
+            return results, "tpu", None, None
         probe_err = err
     # CPU mirror: the same compiled program on the host backend.
     # JAX_PLATFORMS=cpu alone does NOT stop the axon plugin from
@@ -273,22 +301,25 @@ def measure_accelerator():
     results, err = _run_child(env, budget_s=240.0, best_of=1,
                               conv_best_of=1)
     if results is not None:
-        return results, "unavailable", probe_err
-    return None, "unavailable", f"{probe_err}; cpu mirror: {err}"
+        return results, "unavailable", probe_err, None
+    return None, "unavailable", probe_err, f"cpu mirror: {err}"
 
 
 def main():
-    results, hardware, err = measure_accelerator()
+    results, hardware, probe_err, err = measure_accelerator()
     if results is None:
         # even the CPU mirror failed: emit the explicit failure record
-        print(json.dumps({
+        rec = {
             "metric": "maxsum_msgs_per_sec_10kvar_coloring",
             "value": 0.0,
             "unit": "msgs/s",
             "vs_baseline": 0.0,
             "hardware": "unavailable",
             "error": err,
-        }))
+        }
+        if probe_err:
+            rec["probe_error"] = probe_err
+        print(json.dumps(rec))
         return
     (tpu_msgs_per_sec, elapsed, cycles, tpu_conflicts), \
         (conv_seconds, conv_cycles, conv_finished, conv_conflicts) = \
@@ -320,6 +351,10 @@ def main():
         "convergence_conflict_rate": round(conv_rate, 5),
         "convergence_cost_parity": bool(conv_rate <= cpu_rate + 0.005),
     }
+    if probe_err:
+        # structured: why the hardware leg failed, NOT buried in a
+        # generic error string (BENCH_r04/r05 triage ask)
+        out["probe_error"] = probe_err
     if err:
         out["error"] = err
     print(json.dumps(out))

@@ -1103,17 +1103,206 @@ def bench_telemetry_overhead(quick=False):
     }
 
 
+def bench_decimation(quick=False):
+    """Decimated Max-Sum A/B (ISSUE 6) on the loopy 10k-var coloring
+    mesh (the bench.py instance shape, where plain Max-Sum sits at
+    ~15% conflict rate and never settles): plain vs
+    ``decimation_p=0.25, decimation_every=4``, same seed, whole
+    horizon in ONE jitted fori_loop per leg (zero mid-run host
+    syncs).  Cycles-to-convergence is the last cycle the decoded
+    selection CHANGED — the honest measure on an instance where
+    message quiescence never happens.  TWO contracts asserted IN the
+    bench: the decimated leg settles strictly earlier than plain
+    (which must still be changing at the horizon — otherwise the
+    instance stopped being a regression witness), and its final
+    conflict rate is strictly lower.  Host-CPU numbers, labeled."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    n = 1024 if quick else 10_000
+    e = 3 * n
+    horizon = 96 if quick else 256
+    arrays = coloring_factor_arrays(n, e, 3, seed=7, noise=0.05)
+    b = arrays.buckets[0]
+    u = jnp.asarray(b.var_ids[:, 0])
+    v = jnp.asarray(b.var_ids[:, 1])
+
+    def leg(solver):
+        def body(i, carry):
+            s, prev, last = carry
+            s = solver.step(s)
+            sel = solver.assignment_indices(s)
+            last = jnp.where(jnp.any(sel != prev), i + 1, last)
+            return s, sel, last
+
+        @jax.jit
+        def run(s):
+            sel0 = solver.assignment_indices(s)
+            s2, sel, last = jax.lax.fori_loop(
+                0, horizon, body, (s, sel0, jnp.int32(0)))
+            conf = jnp.sum(sel[u] == sel[v]).astype(jnp.int32)
+            return sel, last, conf
+
+        s0 = solver.init_state(jax.random.PRNGKey(0))
+        _, last, conf = run(s0)  # warm-up/compile included: one shot
+        t0 = time.perf_counter()
+        _, last, conf = run(solver.init_state(jax.random.PRNGKey(0)))
+        jax.block_until_ready(conf)
+        dt = time.perf_counter() - t0
+        return int(last), int(conf), dt
+
+    kw = dict(damping=0.5, stability=0.0)
+    plain_last, plain_conf, plain_s = leg(MaxSumLaneSolver(
+        arrays, **kw))
+    dec_last, dec_conf, dec_s = leg(MaxSumLaneSolver(
+        arrays, decimation_p=0.25, decimation_every=4, **kw))
+    if plain_last < horizon - 8:
+        raise RuntimeError(
+            f"decimation contract witness lost: plain Max-Sum settled "
+            f"at cycle {plain_last}/{horizon} — the instance is no "
+            f"longer loopy enough to regress against")
+    if dec_last >= plain_last:
+        raise RuntimeError(
+            f"decimation contract violated: decimated run settled at "
+            f"cycle {dec_last}, plain at {plain_last} (want strictly "
+            f"fewer cycles-to-convergence)")
+    if dec_conf >= plain_conf:
+        raise RuntimeError(
+            f"decimation contract violated: decimated final conflicts "
+            f"{dec_conf} >= plain {plain_conf}")
+    return {
+        "metric": f"decimation_ab_{n}var_coloring",
+        "value": {
+            "plain": {"last_change_cycle": plain_last,
+                      "conflicts": plain_conf,
+                      "conflict_rate": round(plain_conf / e, 5),
+                      "seconds": round(plain_s, 3)},
+            "decimated": {"last_change_cycle": dec_last,
+                          "conflicts": dec_conf,
+                          "conflict_rate": round(dec_conf / e, 5),
+                          "seconds": round(dec_s, 3),
+                          "p": 0.25, "every": 4},
+        },
+        "unit": "cycles",
+        "horizon": horizon,
+        "contracts_asserted": True,
+        "hardware": jax.default_backend(),
+    }
+
+
+def bench_bnb_pruning(quick=False):
+    """Branch-and-bound pruned-reduction A/B (ISSUE 6) on the two
+    marquee n-ary families.  PEAV meeting scheduling with k-ary
+    event-equality factors is the bound-friendly shape (a few cheap
+    diagonal cells, a high penalty plateau everywhere else): the
+    asserted leg — selections BIT-EXACT with the full scan and a
+    >= 30% mean pruned-cell fraction.  SECP rules are the
+    bound-hostile shape (smooth utility cubes, weak per-slot bounds):
+    reported, not asserted, so the trade stays visible.  ms/cycle is
+    host-CPU (a sequential while_loop sweep vs one fused full scan —
+    the chip trade differs), labeled per the round-4 protocol."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.generators.meetingscheduling import \
+        generate_meetings
+    from pydcop_tpu.generators.secp import generate_secp
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+
+    cycles = 10 if quick else 30
+
+    def ab(arrays):
+        def leg(solver):
+            # untimed pass: compile AND collect the per-cycle pruned
+            # fractions here — a float(s["pruned"]) host sync inside
+            # the timed loop would bias the bnb leg's ms/cycle upward
+            # vs the full scan, which never pays that round-trip
+            step = jax.jit(solver.step)
+            s = solver.init_state(jax.random.PRNGKey(0))
+            fr = []
+            for _ in range(cycles):
+                s = step(s)
+                if "pruned" in s:
+                    fr.append(float(s["pruned"]))
+            sel = np.asarray(solver.assignment_indices(s))
+            # timed pass: steps only, one block at the end
+            s = solver.init_state(jax.random.PRNGKey(0))
+            jax.block_until_ready(s["q"])
+            t0 = time.perf_counter()
+            for _ in range(cycles):
+                s = step(s)
+            jax.block_until_ready(s["q"])
+            ms = (time.perf_counter() - t0) / cycles * 1000
+            return sel, ms, (float(np.mean(fr)) if fr else None)
+
+        sel_f, ms_f, _ = leg(MaxSumSolver(arrays, damping=0.5))
+        sel_b, ms_b, pruned = leg(MaxSumSolver(arrays, damping=0.5,
+                                               bnb=True))
+        if not np.array_equal(sel_f, sel_b):
+            raise RuntimeError(
+                "bnb contract violated: pruned selections diverged "
+                "from the full scan")
+        return {"ms_per_cycle_full": round(ms_f, 3),
+                "ms_per_cycle_bnb": round(ms_b, 3),
+                # None = no bucket cleared the plan gates (all cubes
+                # under BNB_MIN_CELLS or arity < 3): nothing to prune
+                "pruned_fraction": None if pruned is None
+                else round(pruned, 4),
+                "selections_equal": True}
+
+    peav = filter_dcop(generate_meetings(
+        slots_count=8, events_count=20 if quick else 80,
+        resources_count=16 if quick else 60, max_resources_event=3,
+        seed=13, nary_equalities=True))
+    secp = filter_dcop(generate_secp(
+        lights_count=20 if quick else 60,
+        models_count=12 if quick else 40,
+        rules_count=10 if quick else 30, seed=7))
+    out = {
+        "peav_nary": ab(FactorGraphArrays.build(peav,
+                                                arity_sorted=True)),
+        "secp": ab(FactorGraphArrays.build(secp, arity_sorted=True)),
+    }
+    # the asserted contract: the bound-friendly workload must prune
+    # at least 30% of its plannable cells at full parity
+    frac = out["peav_nary"]["pruned_fraction"]
+    if frac is None or frac < 0.30:
+        raise RuntimeError(
+            f"bnb contract violated: PEAV pruned-cell fraction "
+            f"{frac if frac is None else format(frac, '.1%')} < 30% "
+            f"(None = no bucket built a plan)")
+    return {
+        "metric": "bnb_pruning_ab_nary",
+        "value": out,
+        "unit": "pruned-cell fraction",
+        "cycles": cycles,
+        "contracts_asserted": True,
+        "hardware": jax.default_backend(),
+    }
+
+
 BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_dpop_device_widetree, bench_dpop_sharded_util,
            bench_dpop_meetings, bench_localsearch_10k, bench_batched,
            bench_mixed_hard_constraints, bench_batched_localsearch,
            bench_batch_campaign_fused, bench_nary_fastpath,
            bench_mesh_dispatch, bench_hetero_batch, bench_precision,
-           bench_telemetry_overhead]
+           bench_telemetry_overhead, bench_decimation,
+           bench_bnb_pruning]
 
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("benches", nargs="*", metavar="BENCH",
+                        help="run only these benchmarks by function "
+                             "name (e.g. bench_decimation); default: "
+                             "the full suite")
     parser.add_argument("--quick", action="store_true",
                         help="smaller sizes (CI-friendly)")
     parser.add_argument("--out", default=None,
@@ -1121,8 +1310,16 @@ def main():
                              "(default: BENCH_SUITE.json next to this "
                              "script's repo root, unless --quick)")
     args = parser.parse_args()
+    benches = BENCHES
+    if args.benches:
+        by_name = {b.__name__: b for b in BENCHES}
+        unknown = [n for n in args.benches if n not in by_name]
+        if unknown:
+            parser.error(f"unknown benchmark(s) {unknown}; choose "
+                         f"from {sorted(by_name)}")
+        benches = [by_name[n] for n in args.benches]
     results = []
-    for bench in BENCHES:
+    for bench in benches:
         try:
             if "quick" in bench.__code__.co_varnames:
                 r = bench(quick=args.quick)
@@ -1137,7 +1334,8 @@ def main():
                     "total": len(results)})
     print(json.dumps(results[-1]))
     out = args.out
-    if out is None and not args.quick and ok == len(results) - 1:
+    if out is None and not args.quick and not args.benches \
+            and ok == len(results) - 1:
         # only a fully-green run may replace the checked-in baseline;
         # a degraded run (dead accelerator -> error rows) must not
         # clobber the numbers README cites

@@ -43,6 +43,14 @@ _CASTS = {
 }
 
 
+def param_bool(value) -> bool:
+    """THE bool-param truthiness rule (the ``_CASTS['bool']`` cast),
+    shared with the CLIs: a feature echo or fuse-exclusion decision
+    must never disagree with what the solver's own param parsing
+    enabled."""
+    return _CASTS["bool"](value)
+
+
 def check_param_value(value: Any, param_def: AlgoParameterDef) -> Any:
     """Cast and validate one parameter value
     (reference: algorithms/__init__.py:446-505)."""

@@ -44,6 +44,15 @@ class AMaxSumSolver(MaxSumSolver):
 
     def __init__(self, arrays: FactorGraphArrays, activation: float = 0.7,
                  **kwargs):
+        if float(kwargs.get("decimation_p", 0) or 0) != 0:
+            # loud rejection, never a silent downgrade: the stochastic
+            # activation mask below re-admits PRE-freeze messages on
+            # non-activated edges, which would quietly undo the freeze
+            # clamp decimation depends on
+            raise ValueError(
+                "amaxsum does not support decimation: stochastic edge "
+                "activation re-admits pre-freeze messages, undoing the "
+                "frozen-variable clamp; use maxsum for decimated runs")
         super().__init__(arrays, **kwargs)
         self.activation = float(activation)
 

@@ -26,7 +26,12 @@ from ..engine.solver import ArraySolver
 from ..graphs.arrays import BIG, SENTINEL, FactorGraphArrays
 from ..ops.kernels import (
     assignment_cost_device,
+    belief_margins,
+    build_pruned_plan,
+    decimation_select,
+    device_pruned_plan,
     factor_messages,
+    factor_messages_pruned,
     masked_argmin,
 )
 from ..ops.precision import resolve as resolve_precision
@@ -37,6 +42,28 @@ GRAPH_TYPE = "factor_graph"
 #: cycles of stable costs+selection before declaring convergence
 #: (reference: maxsum.py:106 SAME_COUNT = 4)
 SAME_COUNT = 4
+
+#: default decimation period (cycles between freeze events) when
+#: ``decimation_p`` is set without an explicit ``decimation_every`` —
+#: matches the mesh engine's default chunk (engine/mesh_engine.py
+#: DEFAULT_CHUNK), so freeze events land exactly on the chunked
+#: engines' existing sync boundaries: zero extra host round-trips,
+#: like the PR 5 telemetry drain
+DECIMATION_DEFAULT_EVERY = 32
+
+
+def normalize_decimation(p, every):
+    """Validate the decimation knobs; returns ``(p, enabled, every)``.
+    ONE copy of the rule for the single-chip AND sharded families, so
+    the schedule semantics can never drift between them."""
+    p = float(p)
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"decimation_p must be in [0, 1], got {p!r}")
+    every = int(every) or DECIMATION_DEFAULT_EVERY
+    if every < 1:
+        raise ValueError(
+            f"decimation_every must be >= 1, got {every!r}")
+    return p, p > 0, every
 
 HEADER_SIZE = 0
 UNIT_SIZE = 1
@@ -69,6 +96,23 @@ algo_params = [
     # Default None defers to the PYDCOP_TPU_PRECISION environment
     # variable, then f32; auto = bf16 on TPU backends only.
     AlgoParameterDef("precision", "str", ["f32", "bf16", "auto"], None),
+    # decimated Max-Sum (arXiv 1706.02209): every `decimation_every`
+    # cycles, pin the top-`decimation_p` fraction of the most-confident
+    # (largest belief-margin) unfrozen variables and clamp their
+    # outgoing messages, so loopy instances settle instead of
+    # oscillating.  0 (the default) disables decimation entirely — the
+    # compiled step is byte-identical to the undecimated solver.
+    AlgoParameterDef("decimation_p", "float", None, 0.0),
+    # cycles between freeze events; 0 = the chunk-aligned default
+    # (DECIMATION_DEFAULT_EVERY) when decimation_p > 0
+    AlgoParameterDef("decimation_every", "int", None, 0),
+    # branch-and-bound pruned factor reductions (arXiv 1906.06863):
+    # arity >= 3 buckets big enough to pay for bound checks sweep
+    # their hypercubes in build-time bound-sorted order and early-out
+    # cells a per-factor suffix bound already excludes.  Messages stay
+    # bit-exact with the full scan; off (the default) leaves every
+    # kernel untouched.
+    AlgoParameterDef("bnb", "bool", None, False),
 ]
 
 
@@ -76,7 +120,9 @@ class MaxSumSolver(ArraySolver):
     def __init__(self, arrays: FactorGraphArrays, damping: float = 0.5,
                  damping_nodes: str = "vars", stability: float = 0.1,
                  noise: float = 0.0, stop_cycle: int = 0,
-                 delta_on: str = "messages", precision=None):
+                 delta_on: str = "messages", precision=None,
+                 decimation_p: float = 0.0, decimation_every: int = 0,
+                 bnb: bool = False):
         self.arrays = arrays
         self.var_names = arrays.var_names
         # mixed-precision policy: cost planes materialize on device in
@@ -108,6 +154,22 @@ class MaxSumSolver(ArraySolver):
             self.stability *= (1 - float(damping))
         self.noise = float(noise)
         self.stop_cycle = int(stop_cycle)
+        self._init_decimation(decimation_p, decimation_every)
+        self.bnb = bool(bnb)
+        # branch-and-bound reduction plans, built alongside the other
+        # host-side layout work: one per arity >= 3 bucket big enough
+        # to pay for the bound checks (ops/kernels.py BNB_MIN_CELLS);
+        # None entries keep the full-scan kernels.  With bnb off the
+        # list stays empty and every compiled program is untouched.
+        self._bnb_plans_np = [
+            build_pruned_plan(b.cubes) for b in arrays.buckets
+        ] if self.bnb else []
+        self._bnb_active = any(p is not None
+                               for p in self._bnb_plans_np)
+        self._bnb_cells_total = sum(
+            p.n_blocks * p.block * b.cubes.shape[0]
+            for p, b in zip(self._bnb_plans_np, arrays.buckets)
+            if p is not None)
 
         # device constants are LAZY: materializing them eagerly would
         # initialize the accelerator backend (seconds through the
@@ -204,6 +266,123 @@ class MaxSumSolver(ArraySolver):
 
         return canonical_edge_layout(arrays)
 
+    # -------------------------------------------- decimation plumbing
+
+    def _init_decimation(self, p, every):
+        """Validate and normalize the decimation knobs (shared with
+        the sharded families, which call this from ``_init_params``)."""
+        (self.decimation_p, self.decimation,
+         self.decimation_every) = normalize_decimation(p, every)
+
+    @property
+    def bnb_plans(self):
+        """Device-placed branch-and-bound plans, aligned with the
+        bucket list (None = full scan); cube values ride the precision
+        policy's store dtype like every other cost plane."""
+        return self._dev("bnb_plans", lambda: [
+            None if p is None
+            else device_pruned_plan(p, self.policy.store_dtype)
+            for p in self._bnb_plans_np
+        ])
+
+    def _pruned_fraction(self, runs):
+        """Executed-block counts -> the cycle's pruned-cell fraction
+        (over the planned buckets only; 0.0 when nothing qualified)."""
+        if not runs or not self._bnb_cells_total:
+            return jnp.float32(0)
+        executed = jnp.float32(0)
+        for br, cells_per_block in runs:
+            executed = executed + br.astype(jnp.float32) \
+                * jnp.float32(cells_per_block)
+        return 1.0 - executed / jnp.float32(self._bnb_cells_total)
+
+    def _init_extras_state(self, state):
+        """Attach the decimation freeze plane / pin values and the
+        pruned-fraction slot to a freshly built carry — no-ops (and
+        byte-identical carries) when both features are off."""
+        if self.decimation:
+            state["frozen"] = jnp.zeros((self.V,), dtype=bool)
+            state["pin"] = jnp.zeros((self.V,), dtype=jnp.int32)
+        if self._bnb_active:
+            state["pruned"] = jnp.float32(0)
+        return state
+
+    def _decim_eligible(self):
+        """Freeze candidacy: variables with a real choice.  Phantom
+        variables from ``pad_to`` expose exactly one valid slot, so
+        ``domain_size > 1`` keeps them (and genuinely fixed variables)
+        out of the freeze budget — per-instance fractions stay honest
+        under the vmapped hetero runners, whose swapped-in
+        ``domain_size`` plane this reads."""
+        return self.domain_size > 1
+
+    def _apply_decimation(self, s, belief, bmask, q_new, owner,
+                          eligible, lane, select_fn):
+        """One cycle's decimation work, shared by every layout: on
+        event cycles (``(cycle + 1) % decimation_every == 0`` — the
+        chunk-aligned schedule) freeze the top-p most-confident
+        unfrozen variables at their current argmin; every cycle, clamp
+        frozen variables' outgoing messages to a hard pin (0 at the
+        pinned slot, BIG elsewhere).  ``owner`` maps message columns/
+        rows to variable indices in this layout's variable order;
+        ``lane`` flips the (D, E) vs (E, D) orientation.  The freeze
+        computation itself rides a ``lax.cond``, so non-event cycles
+        skip the sort entirely (under vmap it degrades to a select —
+        still correct, just not free)."""
+        frozen, pin = s["frozen"], s["pin"]
+        do = ((s["cycle"] + 1) % self.decimation_every) == 0
+
+        def _freeze(_):
+            margins = belief_margins(belief, bmask,
+                                     axis=0 if lane else -1)
+            newly = decimation_select(margins, frozen, eligible,
+                                      self.decimation_p)
+            return newly, select_fn(belief)
+
+        def _skip(_):
+            return jnp.zeros_like(frozen), pin
+
+        newly, sel_raw = jax.lax.cond(do, _freeze, _skip, None)
+        frozen = jnp.logical_or(frozen, newly)
+        pin = jnp.where(newly, sel_raw, pin)
+        froz_e = frozen[owner]
+        pin_e = pin[owner]
+        if lane:
+            clamp = jnp.where(
+                jnp.arange(self.D)[:, None] == pin_e[None, :],
+                0.0, BIG)
+            q_new = jnp.where(froz_e[None, :],
+                              clamp.astype(q_new.dtype), q_new)
+        else:
+            clamp = jnp.where(
+                jnp.arange(self.D)[None, :] == pin_e[:, None],
+                0.0, BIG)
+            q_new = jnp.where(froz_e[:, None],
+                              clamp.astype(q_new.dtype), q_new)
+        return q_new, frozen, pin
+
+    def _finish_step(self, s, key, q_new, new_r, selection, delta,
+                     belief, frozen=None, pin=None, pruned=None):
+        """The layout-shared step tail: pin frozen selections, run the
+        convergence bookkeeping, re-attach feature carries."""
+        if frozen is not None and self.stability > 0:
+            selection = jnp.where(frozen, pin, selection)
+        out = self._advance(s, key, q_new, new_r, selection, delta,
+                            belief=belief)
+        if frozen is not None:
+            out["frozen"] = frozen
+            out["pin"] = pin
+        if pruned is not None:
+            out["pruned"] = pruned
+        return out
+
+    def _pin_indices(self, s, idx):
+        """Frozen variables keep their pinned value through any
+        selection decode."""
+        if self.decimation and "frozen" in s:
+            return jnp.where(s["frozen"], s["pin"], idx)
+        return idx
+
     def init_state(self, key):
         edge_mask = self.domain_mask[self.edge_var]
         zeros = jnp.where(edge_mask, 0.0, BIG)
@@ -217,7 +396,8 @@ class MaxSumSolver(ArraySolver):
             "selection": masked_argmin(belief, self.domain_mask),
             "same": jnp.int32(0),
         }
-        return self._init_belief_carry(state, belief)
+        return self._init_belief_carry(
+            self._init_extras_state(state), belief)
 
     def _cubes(self, s):
         """Per-bucket cost hypercubes.  Static solver constants here; the
@@ -225,22 +405,37 @@ class MaxSumSolver(ArraySolver):
         the host can swap factor functions between steps."""
         return [cubes for cubes, _, _ in self.buckets]
 
+    def _bucket_factor_messages(self, bi, cubes, q_in, pruned_runs):
+        """One bucket's messages: the branch-and-bound sweep when a
+        plan exists (recording its executed-block count), else the
+        full-scan broadcast kernel — bit-exact either way."""
+        plan = self.bnb_plans[bi] if self._bnb_active else None
+        if plan is None:
+            return factor_messages(cubes, q_in)
+        msgs, blocks_run = factor_messages_pruned(plan, q_in)
+        pruned_runs.append(
+            (blocks_run, plan.block * cubes.shape[0]))
+        return msgs
+
     def step(self, s):
         q, r = s["q"], s["r"]
         edge_mask = self.domain_mask[self.edge_var]
 
         # --- factor update: min-marginal messages per arity bucket -------
+        pruned_runs = []
         if self._canonical is not None:
             # factor-major layout: slices + reshapes, no gather/scatter
             blocks = []
-            for cubes, spec in zip(self._cubes(s), self._canonical):
+            for bi, (cubes, spec) in enumerate(
+                    zip(self._cubes(s), self._canonical)):
                 if spec is None:
                     continue
                 offset, f, arity = spec
                 q_blk = q[offset:offset + f * arity] \
                     .reshape(f, arity, self.D)
                 q_in = [q_blk[:, p] for p in range(arity)]
-                msgs = factor_messages(cubes, q_in)
+                msgs = self._bucket_factor_messages(
+                    bi, cubes, q_in, pruned_runs)
                 blocks.append(jnp.stack(msgs, axis=1)
                               .reshape(f * arity, self.D))
             if not blocks:  # unary-only problem: no factor messages
@@ -251,13 +446,14 @@ class MaxSumSolver(ArraySolver):
                 new_r = jnp.concatenate(blocks, axis=0)
         else:
             new_r = jnp.zeros((self.E, self.D), dtype=q.dtype)
-            for cubes, (_, edge_ids, _) in zip(self._cubes(s),
-                                               self.buckets):
+            for bi, (cubes, (_, edge_ids, _)) in enumerate(
+                    zip(self._cubes(s), self.buckets)):
                 arity = cubes.ndim - 1
                 if arity == 0:
                     continue
                 q_in = [q[edge_ids[:, p]] for p in range(arity)]
-                msgs = factor_messages(cubes, q_in)
+                msgs = self._bucket_factor_messages(
+                    bi, cubes, q_in, pruned_runs)
                 for p in range(arity):
                     new_r = new_r.at[edge_ids[:, p]].set(msgs[p])
         if self.damping_nodes in ("factors", "both") and self.damping > 0:
@@ -281,6 +477,14 @@ class MaxSumSolver(ArraySolver):
             q_new = self.damping * q + (1 - self.damping) * q_new
         q_new = jnp.where(edge_mask, q_new, BIG)
 
+        # --- decimation: freeze events + frozen-message clamp -----------
+        frozen = pin = None
+        if self.decimation:
+            q_new, frozen, pin = self._apply_decimation(
+                s, belief, self.domain_mask, q_new, self.edge_var,
+                self._decim_eligible(), lane=False,
+                select_fn=lambda b: masked_argmin(b, self.domain_mask))
+
         # --- selection & convergence ------------------------------------
         # stability <= 0 disables convergence detection entirely: the
         # per-cycle argmin AND the delta max-reduce are dead compute in
@@ -290,8 +494,11 @@ class MaxSumSolver(ArraySolver):
             if self.stability > 0 else s["selection"]
         delta = self._convergence_delta(
             s, q, q_new, belief, edge_mask, self.domain_mask, self.E)
-        return self._advance(s, key, q_new, new_r, selection, delta,
-                             belief=belief)
+        return self._finish_step(
+            s, key, q_new, new_r, selection, delta, belief=belief,
+            frozen=frozen, pin=pin,
+            pruned=self._pruned_fraction(pruned_runs)
+            if self._bnb_active else None)
 
     def _init_belief_carry(self, state, belief):
         """Attach the delta_on=beliefs carry — COPIED: the initial
@@ -351,12 +558,13 @@ class MaxSumSolver(ArraySolver):
 
     def assignment_indices(self, s):
         if self.stability > 0:
-            return s["selection"]
+            return self._pin_indices(s, s["selection"])
         # lazy selection (see step): rebuild beliefs from the final
         # factor->var messages, which is exactly the in-step belief
         belief = self.var_costs + jax.ops.segment_sum(
             s["r"], self.edge_var, num_segments=self.V)
-        return masked_argmin(belief, self.domain_mask)
+        return self._pin_indices(
+            s, masked_argmin(belief, self.domain_mask))
 
     # ---------------------------------------------------------- host path
 
@@ -374,7 +582,11 @@ class MaxSumSolver(ArraySolver):
                        for b in a.buckets)) + a.n_edges * a.max_domain
 
     def use_host_engine(self) -> bool:
-        return self.host_path and self.noise == 0
+        # decimation needs the compiled freeze plane; the numpy mirror
+        # stays the plain-MaxSum oracle (bnb is output-identical, so it
+        # simply doesn't apply on the host path)
+        return self.host_path and self.noise == 0 \
+            and not self.decimation
 
     def host_run(self, max_cycles: int, timeout=None,
                  collect_cost_every=None, variables=None):
@@ -521,27 +733,27 @@ class MaxSumLaneSolver(MaxSumSolver):
     def eligible(arrays: FactorGraphArrays) -> bool:
         """True when the graph supports lane-major layout: canonical
         factor-major edges, every bucket's hypercube under the
-        fast-path unroll threshold."""
-        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+        fast-path unroll threshold (``ops.pallas_kernels.
+        nary_fast_eligible`` — the ONE copy of that predicate)."""
+        from ..ops.pallas_kernels import nary_fast_eligible
 
         layout = MaxSumSolver._detect_canonical(arrays)
         if layout is None:
             return False
         D = arrays.max_domain
-        return all(
-            spec is None or spec[2] <= 2
-            or D ** spec[2] <= NARY_FAST_MAX_CELLS
-            for spec in layout)
+        return all(spec is None or nary_fast_eligible(D, spec[2])
+                   for spec in layout)
 
     def __init__(self, arrays: FactorGraphArrays, use_pallas=None,
                  **kwargs):
         super().__init__(arrays, **kwargs)
         if not self.eligible(arrays):
+            from ..ops.pallas_kernels import NARY_FALLBACK_TEXT
+
             raise ValueError(
                 "lane-major layout needs the canonical factor-major "
-                "edge layout (build arrays with arity_sorted=True) and "
-                "per-factor hypercubes small enough to unroll "
-                "(D**arity <= NARY_FAST_MAX_CELLS) — use the generic "
+                "edge layout (build arrays with arity_sorted=True) "
+                f"and {NARY_FALLBACK_TEXT} — use the generic "
                 "edge_major layout for bigger factors")
         if use_pallas is None:
             # measured on-chip: the fused pallas kernel beats the jnp
@@ -598,7 +810,8 @@ class MaxSumLaneSolver(MaxSumSolver):
             "selection": self._select(belief),
             "same": jnp.int32(0),
         }
-        return self._init_belief_carry(state, belief)
+        return self._init_belief_carry(
+            self._init_extras_state(state), belief)
 
     def _select(self, beliefT):
         """Masked argmin over the (sublane) domain axis — no transpose.
@@ -610,24 +823,34 @@ class MaxSumLaneSolver(MaxSumSolver):
 
     def assignment_indices(self, s):
         if self.stability > 0:
-            return s["selection"]
+            return self._pin_indices(s, s["selection"])
         sum_r = jnp.zeros((self.D, self.V), dtype=s["r"].dtype) \
             .at[:, self.edge_var].add(s["r"])
-        return self._select(self.var_costsT + sum_r)
+        return self._pin_indices(
+            s, self._select(self.var_costsT + sum_r))
 
-    def _bucket_messages(self, cubesT, q_in, arity):
+    def _bucket_messages(self, cubesT, q_in, arity, plan=None):
         """One arity bucket's outgoing messages, lane-major — the
         shared per-bucket kernel dispatch (pallas kernels opt-in, jnp
-        fallbacks by default)."""
+        fallbacks by default; a branch-and-bound ``plan`` reroutes to
+        the pruned bound-ordered sweep).  Returns ``(msgs,
+        blocks_run-or-None)``."""
         from ..ops.pallas_kernels import factor_messages_lane_major
 
-        return factor_messages_lane_major(
+        out = factor_messages_lane_major(
             cubesT, q_in, arity, use_pallas=self.use_pallas,
-            interpret=self._pallas_interpret)
+            interpret=self._pallas_interpret, plan=plan)
+        if plan is not None:
+            return out
+        return out, None
 
     def _factor_update(self, q):
+        """Returns ``(new_r, pruned_runs)`` — the second entry feeds
+        the pruned-cell telemetry and stays empty without bnb."""
         blocks = []
-        for cubesT, spec in zip(self.bucketsT, self._canonical):
+        pruned_runs = []
+        for bi, (cubesT, spec) in enumerate(
+                zip(self.bucketsT, self._canonical)):
             if spec is None:
                 continue
             offset, f, arity = spec
@@ -639,18 +862,22 @@ class MaxSumLaneSolver(MaxSumSolver):
                 continue
             q_blk = q[:, offset:offset + arity * f]
             q_in = [q_blk[:, p::arity] for p in range(arity)]
-            msgs = self._bucket_messages(cubesT, q_in, arity)
+            plan = self.bnb_plans[bi] if self._bnb_active else None
+            msgs, blocks_run = self._bucket_messages(
+                cubesT, q_in, arity, plan=plan)
+            if blocks_run is not None:
+                pruned_runs.append((blocks_run, plan.block * f))
             blocks.append(jnp.stack(msgs, axis=2)
                           .reshape(self.D, arity * f))
         if not blocks:
-            return jnp.zeros((self.D, self.E))
+            return jnp.zeros((self.D, self.E)), pruned_runs
         if len(blocks) == 1:
-            return blocks[0]
-        return jnp.concatenate(blocks, axis=1)
+            return blocks[0], pruned_runs
+        return jnp.concatenate(blocks, axis=1), pruned_runs
 
     def step(self, s):
         q, r = s["q"], s["r"]
-        new_r = self._factor_update(q)
+        new_r, pruned_runs = self._factor_update(q)
         if self.damping_nodes in ("factors", "both") and self.damping > 0:
             new_r = self.damping * r + (1 - self.damping) * new_r
 
@@ -670,14 +897,24 @@ class MaxSumLaneSolver(MaxSumSolver):
             q_new = self.damping * q + (1 - self.damping) * q_new
         q_new = jnp.where(self.emaskT, q_new, BIG)
 
+        frozen = pin = None
+        if self.decimation:
+            q_new, frozen, pin = self._apply_decimation(
+                s, belief, self.domain_maskT, q_new, self.edge_var,
+                self._decim_eligible(), lane=True,
+                select_fn=self._select)
+
         # same dead-reduce elision as the base solver: with stability
         # disabled, neither the argmin nor the delta feeds anything
         selection = self._select(belief) if self.stability > 0 \
             else s["selection"]
         delta = self._convergence_delta(
             s, q, q_new, belief, self.emaskT, self.domain_maskT, self.E)
-        return self._advance(s, key, q_new, new_r, selection, delta,
-                             belief=belief)
+        return self._finish_step(
+            s, key, q_new, new_r, selection, delta, belief=belief,
+            frozen=frozen, pin=pin,
+            pruned=self._pruned_fraction(pruned_runs)
+            if self._bnb_active else None)
 
 
 def degree_slot_layout(deg):
@@ -763,22 +1000,25 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
 
     @staticmethod
     def eligible(arrays: FactorGraphArrays) -> bool:
-        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+        from ..ops.pallas_kernels import nary_fast_eligible
 
         layout = MaxSumSolver._detect_canonical(arrays)
         if layout is None or arrays.n_edges == 0:
             return False
         D = arrays.max_domain
         # binary buckets are unconditional (the slot-aligned path does
-        # no hypercube unroll — any domain size); the cell gate bounds
-        # only the n-ary lane-major sweep
+        # no hypercube unroll — any domain size); the shared cell gate
+        # (ops/pallas_kernels.nary_fast_eligible) bounds only the
+        # n-ary lane-major sweep
         return all(
-            spec is None or spec[2] == 2 or (
-                spec[2] > 2 and D ** spec[2] <= NARY_FAST_MAX_CELLS)
+            spec is None or (spec[2] >= 2
+                             and nary_fast_eligible(D, spec[2]))
             for spec in layout)
 
     def __init__(self, arrays: FactorGraphArrays, **kwargs):
         if not MaxSumFusedSolver.eligible(arrays):
+            from ..ops.pallas_kernels import NARY_FALLBACK_TEXT
+
             # raise OUR requirement, not the lane solver's (which a
             # unary-factor graph may well satisfy): the user's fix is
             # folding unary constraints into variable costs
@@ -786,9 +1026,7 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
                 "fused layout needs the canonical factor-major edge "
                 "layout (arity_sorted=True arrays), factor arities "
                 ">= 2 — fold unary constraints into variable costs "
-                "first (filter_dcop) — and arity >= 3 hypercubes "
-                "under the unroll threshold "
-                "(D**arity <= NARY_FAST_MAX_CELLS)")
+                f"first (filter_dcop) — and {NARY_FALLBACK_TEXT}")
         kwargs.pop("use_pallas", None)  # no hand kernel on this path:
         # the whole point is letting XLA fuse the single-gather chain
         super().__init__(arrays, use_pallas=False, **kwargs)
@@ -951,6 +1189,23 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
         return self._dev("var_pos_dev", lambda: jnp.asarray(
             self._np_fused["var_pos"]))
 
+    @property
+    def slot_sorted_var(self):
+        """Per-slot SORTED variable index — the decimation clamp's
+        owner map in this layout's solve order."""
+        return self._dev("slot_sorted_var", lambda: jnp.asarray(
+            self._np_fused["slot_var_sorted"]))
+
+    @property
+    def dsize_sorted_vars(self):
+        def build():
+            import numpy as np
+
+            return jnp.asarray(np.asarray(self.arrays.domain_size)[
+                self._np_fused["var_order"]])
+
+        return self._dev("dsize_sorted_vars", build)
+
     # ------------------------------------------------------------ state
 
     def init_state(self, key):
@@ -964,7 +1219,10 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             "selection": self._select_sorted(self.var_costsT_sorted),
             "same": jnp.int32(0),
         }
-        return self._init_belief_carry(state, self.var_costsT_sorted)
+        # the freeze plane (like the selection) lives in SORTED
+        # variable order here; assignment_indices decodes both at once
+        return self._init_belief_carry(
+            self._init_extras_state(state), self.var_costsT_sorted)
 
     def _select_sorted(self, beliefT_sorted):
         return jnp.argmin(
@@ -994,33 +1252,40 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
     def _factor_update_slots(self, q):
         """N-ary factor update in slot space: one static gather per
         (arity, position) bucket (that position's incoming messages),
-        the shared per-bucket lane-major kernel dispatch, and one
-        static assembly permutation from canonical edge order back to
-        slots.  Zero scatters."""
+        the shared per-bucket lane-major kernel dispatch (or the
+        branch-and-bound sweep when a plan exists), and one static
+        assembly permutation from canonical edge order back to slots.
+        Zero scatters.  Returns ``(new_r, pruned_runs)``."""
         blocks = []
-        for cubesT, ps, spec in zip(self.bucketsT, self.pos_slots,
-                                    self._canonical):
+        pruned_runs = []
+        for bi, (cubesT, ps, spec) in enumerate(
+                zip(self.bucketsT, self.pos_slots, self._canonical)):
             if spec is None:
                 continue
             _off, f, arity = spec
             q_in = [q[:, ps[p]] for p in range(arity)]
-            msgs = self._bucket_messages(cubesT, q_in, arity)
+            plan = self.bnb_plans[bi] if self._bnb_active else None
+            msgs, blocks_run = self._bucket_messages(
+                cubesT, q_in, arity, plan=plan)
+            if blocks_run is not None:
+                pruned_runs.append((blocks_run, plan.block * f))
             blocks.append(jnp.stack(msgs, axis=2)
                           .reshape(self.D, arity * f))
         msgs_all = blocks[0] if len(blocks) == 1 else \
             jnp.concatenate(blocks, axis=1)
         msgs_all = jnp.concatenate(
             [msgs_all, jnp.zeros((self.D, 1), msgs_all.dtype)], axis=1)
-        return msgs_all[:, self.slot_src]
+        return msgs_all[:, self.slot_src], pruned_runs
 
     def step(self, s):
         q, r = s["q"], s["r"]
+        pruned_runs = []
         if self._all_binary:
             # the cycle's ONE irregular op: partner permutation
             q_part = q[:, self.partner_slot]
             new_r = jnp.min(self.cube_slotT + q_part[:, None, :], axis=0)
         else:
-            new_r = self._factor_update_slots(q)
+            new_r, pruned_runs = self._factor_update_slots(q)
         new_r = jnp.where(self.emaskT_fused, new_r, 0.0)
         if self.damping_nodes in ("factors", "both") and self.damping > 0:
             new_r = self.damping * r + (1 - self.damping) * new_r
@@ -1038,13 +1303,26 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             q_new = self.damping * q + (1 - self.damping) * q_new
         q_new = jnp.where(self.emaskT_fused, q_new, BIG)
 
+        frozen = pin = None
+        if self.decimation:
+            # everything (beliefs, owner map, eligibility) in SORTED
+            # variable order — the pin rides the sorted selection and
+            # decodes through var_pos with it
+            q_new, frozen, pin = self._apply_decimation(
+                s, belief, self.domain_maskT_sorted, q_new,
+                self.slot_sorted_var, self.dsize_sorted_vars > 1,
+                lane=True, select_fn=self._select_sorted)
+
         selection = self._select_sorted(belief) if self.stability > 0 \
             else s["selection"]
         delta = self._convergence_delta(
             s, q, q_new, belief, self.emaskT_fused,
             self.domain_maskT_sorted, self.EP)
-        return self._advance(s, key, q_new, new_r, selection, delta,
-                             belief=belief)
+        return self._finish_step(
+            s, key, q_new, new_r, selection, delta, belief=belief,
+            frozen=frozen, pin=pin,
+            pruned=self._pruned_fraction(pruned_runs)
+            if self._bnb_active else None)
 
     def assignment_indices(self, s):
         if self.stability > 0:
@@ -1054,7 +1332,8 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
                 jnp.where(self.emaskT_fused, s["r"], 0.0))
             sel_sorted = self._select_sorted(belief)
         # state order is degree-sorted; decode to original variables
-        return sel_sorted[self.var_pos_dev]
+        # (the freeze pin lives in the same sorted order)
+        return self._pin_indices(s, sel_sorted)[self.var_pos_dev]
 
 
 def build_solver(dcop: DCOP, params: Optional[Dict] = None,

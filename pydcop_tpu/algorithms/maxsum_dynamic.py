@@ -54,6 +54,15 @@ class DynamicMaxSumSolver(AMaxSumSolver):
 
     def __init__(self, arrays: FactorGraphArrays, **kwargs):
         kwargs.setdefault("activation", 1.0)
+        if kwargs.get("bnb"):
+            # loud rejection: bnb plans are build-time constants of the
+            # cube CONTENTS (sorted cell order + suffix bounds), and
+            # this solver swaps cubes through the state pytree between
+            # steps — a swap would leave the plans silently stale
+            raise ValueError(
+                "maxsum_dynamic does not support bnb: pruned-reduction "
+                "plans are build-time cube constants and factor tables "
+                "are host-swappable here; use the static maxsum solver")
         super().__init__(arrays, **kwargs)
         # factor name -> (bucket index, row in bucket)
         self._factor_pos: Dict[str, tuple] = {}

@@ -77,6 +77,23 @@ def set_parser(subparsers):
                              "carrying a precision algo-param keep "
                              "it; algorithms without the param reject "
                              "the flag loudly")
+    parser.add_argument("--decimation", default=None,
+                        metavar="P[:EVERY]",
+                        help="campaign-level decimated Max-Sum for "
+                             "every maxsum solve job (fused and "
+                             "subprocess legs): every EVERY cycles "
+                             "pin the top-P most-confident unfrozen "
+                             "variables (solve --decimation).  Jobs "
+                             "already carrying a decimation_p "
+                             "algo-param keep their own setting")
+    parser.add_argument("--bnb", action="store_true",
+                        help="campaign-level branch-and-bound pruned "
+                             "factor reductions for every maxsum "
+                             "solve job (solve --bnb).  bnb has no "
+                             "vmapped batch solver (pruning plans are "
+                             "per-instance cube constants), so maxsum "
+                             "jobs take the subprocess path — the "
+                             "fallback is announced, never silent")
     parser.add_argument("--max_rung_mb", type=float, default=None,
                         help="cap the padded per-instance memory a "
                              "--fuse-hetero consolidation rung may "
@@ -232,7 +249,27 @@ _FUSE_CONF_KEYS = {"algo", "algo_params", "max_cycles", "mode",
 _SOLVE_MAX_CYCLES_DEFAULT = 2000
 
 
-def _fuse_exclusion_reason(meta) -> Optional[str]:
+def _job_algo_params(conf) -> List[str]:
+    """A job's algo params as a flat string list (either the
+    ``algo_params`` or the ``p`` spelling)."""
+    ap = conf.get("algo_params", [])
+    ap = list(ap) if isinstance(ap, list) else [ap]
+    short = conf.get("p", [])
+    ap += short if isinstance(short, list) else [short]
+    return [str(p) for p in ap if p is not None]
+
+
+def _job_has_bnb(conf) -> bool:
+    from ..algorithms import param_bool
+
+    for p in _job_algo_params(conf):
+        k, _sep, v = p.strip().partition(":")
+        if k == "bnb" and param_bool(v.strip()):
+            return True
+    return False
+
+
+def _fuse_exclusion_reason(meta, campaign_bnb=False) -> Optional[str]:
     """Why a job cannot take the fused data plane, or None when it
     can.  Surfaced by ``run_cmd`` (one log line per excluded group):
     a per-job ``timeout``, a non-engine mode or an algo without a
@@ -255,13 +292,19 @@ def _fuse_exclusion_reason(meta) -> Optional[str]:
         return (f"option(s) {keys} outside the fused path "
                 "(a single fused program cannot enforce per-job "
                 "settings)")
+    if _job_has_bnb(conf) or (campaign_bnb and algo == "maxsum"):
+        # pruning plans are build-time constants of ONE instance's
+        # cube contents; batched cubes are per-instance vmapped
+        # arguments (parallel/batch.py rejects the combination)
+        return ("bnb pruned reductions have no vmapped batch solver "
+                "(pruning plans are per-instance cube constants)")
     return None
 
 
-def _fuse_group_key(meta) -> Optional[Tuple]:
+def _fuse_group_key(meta, campaign_bnb=False) -> Optional[Tuple]:
     conf = meta["conf"]
     algo = conf.get("algo")
-    if _fuse_exclusion_reason(meta) is not None:
+    if _fuse_exclusion_reason(meta, campaign_bnb) is not None:
         return None
     ap = conf.get("algo_params", [])
     ap = tuple(sorted(ap if isinstance(ap, list) else [ap]))
@@ -313,7 +356,7 @@ def _append_jsonl(path: str, job_id: str, result: dict):
 def _run_fused_group(key, rows, out_dir, register_done,
                      consolidated_out=None, hetero=False,
                      precision=None, max_rung_mb=None,
-                     telemetry=None):
+                     telemetry=None, decimation=None):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -370,6 +413,16 @@ def _run_fused_group(key, rows, out_dir, register_done,
     # the --precision flag (threaded through the spec), then the env
     if precision and "precision" not in params:
         params["precision"] = precision
+
+    # campaign-level decimation (maxsum only — the vmapped dsa/mgm
+    # runners have no freeze plane); a job's own -p decimation_p: wins
+    if decimation and algo == "maxsum" \
+            and "decimation_p" not in params:
+        from .solve import parse_decimation_flag
+
+        p, every = parse_decimation_flag(decimation)
+        params["decimation_p"] = p
+        params["decimation_every"] = every
     requested_precision = params.get("precision") \
         or os.environ.get(PRECISION_ENV)
     policy = resolve_precision(requested_precision)
@@ -621,14 +674,19 @@ def _fused_child_main(argv=None) -> int:
                      hetero=spec.get("hetero", False),
                      precision=spec.get("precision"),
                      max_rung_mb=spec.get("max_rung_mb"),
-                     telemetry=spec.get("telemetry"))
+                     telemetry=spec.get("telemetry"),
+                     decimation=spec.get("decimation"))
     return 0
 
 
 def run_cmd(args, timeout=None):
     from ..ops.precision import ENV_VAR as _PRECISION_ENV
     from ..ops.precision import resolve as _resolve_precision
+    from .solve import parse_decimation_flag
 
+    # fail the campaign up front on a malformed --decimation instead
+    # of letting every job die on it
+    parse_decimation_flag(getattr(args, "decimation", None))
     if os.environ.get(_PRECISION_ENV):
         # fail the campaign up front on a malformed environment value
         # instead of letting every fused child / solve job die on it
@@ -667,13 +725,14 @@ def run_cmd(args, timeout=None):
     fused_groups: Dict[Tuple, List] = {}
     if getattr(args, "fuse", True):
         fallbacks: Dict[Tuple, int] = {}
+        campaign_bnb = bool(getattr(args, "bnb", False))
         for job_id, _argv, meta in todo:
-            fkey = _fuse_group_key(meta)
+            fkey = _fuse_group_key(meta, campaign_bnb)
             if fkey is not None:
                 fused_groups.setdefault(fkey, []).append(
                     (job_id, meta["path"], meta["iteration"]))
             else:
-                reason = _fuse_exclusion_reason(meta)
+                reason = _fuse_exclusion_reason(meta, campaign_bnb)
                 k = (reason, meta["conf"].get("algo"),
                      meta["conf"].get("mode", "engine"))
                 fallbacks[k] = fallbacks.get(k, 0) + 1
@@ -707,6 +766,8 @@ def run_cmd(args, timeout=None):
                         "progress_path": progress_path,
                         "hetero": getattr(args, "fuse_hetero", False),
                         "precision": getattr(args, "precision", None),
+                        "decimation": getattr(args, "decimation",
+                                              None),
                         "max_rung_mb": getattr(args, "max_rung_mb",
                                                None),
                         "telemetry": getattr(args, "telemetry", None),
@@ -769,6 +830,17 @@ def run_cmd(args, timeout=None):
             # job's own precision setting wins (trailing options are
             # fine after the positional files)
             argv += ["--precision", args.precision]
+        if _meta["command"] == "solve" \
+                and conf.get("algo") == "maxsum":
+            # campaign-level decimation/bnb for subprocess maxsum
+            # jobs; a job's own algo-param wins
+            if getattr(args, "decimation", None) and not any(
+                    str(p).strip().startswith("decimation_p:")
+                    for p in ap):
+                argv += ["--decimation", args.decimation]
+            if getattr(args, "bnb", False) and not any(
+                    str(p).strip().startswith("bnb:") for p in ap):
+                argv += ["--bnb"]
         t0 = time.perf_counter()
         failure = None
         try:

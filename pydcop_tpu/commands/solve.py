@@ -102,8 +102,89 @@ def set_parser(subparsers):
                              "PYDCOP_TPU_PRECISION env var, then f32. "
                              "Equivalent to -p precision:<value> (the "
                              "flag wins when both are given)")
+    parser.add_argument("--decimation", default=None,
+                        metavar="P[:EVERY]",
+                        help="decimated Max-Sum (maxsum only, "
+                             "engine/sharded modes): every EVERY "
+                             "cycles pin the top-P fraction of the "
+                             "most-confident (largest belief-margin) "
+                             "unfrozen variables and clamp their "
+                             "outgoing messages, so loopy instances "
+                             "settle instead of oscillating "
+                             "(docs/architecture.md).  EVERY defaults "
+                             "to the engines' chunk size (32), so "
+                             "freeze events land on existing sync "
+                             "boundaries.  Equivalent to "
+                             "-p decimation_p:P -p "
+                             "decimation_every:EVERY")
+    parser.add_argument("--bnb", action="store_true",
+                        help="branch-and-bound pruned factor "
+                             "reductions (maxsum family): arity >= 3 "
+                             "cost hypercubes big enough to pay for "
+                             "bound checks sweep their cells in "
+                             "build-time bound-sorted order and "
+                             "early-out cells a per-factor suffix "
+                             "bound excludes — messages (and thus "
+                             "selections AND convergence cycles) stay "
+                             "bit-exact with the full scan.  "
+                             "Equivalent to -p bnb:1")
     parser.set_defaults(func=run_cmd)
     return parser
+
+
+def parse_decimation_flag(value) -> Optional[tuple]:
+    """``--decimation P[:EVERY]`` -> ``(p, every)`` (``every`` 0 =
+    the solver's chunk-aligned default), or None when the flag is
+    absent.  Shared with ``batch`` so the two CLIs can never drift on
+    the flag grammar; malformed values die as clean CLI errors."""
+    if value is None:
+        return None
+    parts = str(value).split(":")
+    try:
+        if len(parts) == 1:
+            p, every = float(parts[0]), 0
+        elif len(parts) == 2:
+            p, every = float(parts[0]), int(parts[1])
+        else:
+            raise ValueError(value)
+        from ..algorithms.maxsum import normalize_decimation
+
+        p, _enabled, every = normalize_decimation(p, every)
+    except ValueError as e:
+        raise CliError(
+            f"--decimation wants P[:EVERY] with P a fraction in "
+            f"(0, 1] and EVERY a positive cycle count: {e}")
+    if p <= 0:
+        raise CliError(
+            "--decimation P must be > 0 (omit the flag to disable)")
+    return p, every
+
+
+def _feature_result_fields(args, decim, bnb_flag) -> dict:
+    """The ``decimation``/``bnb`` result fields, from the flags or
+    their ``-p`` spellings — absent entirely (historical schema) when
+    neither feature was requested."""
+    from . import parse_algo_params
+
+    given = parse_algo_params(args.algo_params)
+    out = {}
+    try:
+        p = decim[0] if decim else \
+            float(given.get("decimation_p", 0) or 0)
+    except ValueError:
+        p = 0.0  # malformed -p values die later in algo validation
+    if p > 0:
+        from ..algorithms.maxsum import normalize_decimation
+
+        every = decim[1] if decim else \
+            int(given.get("decimation_every", 0) or 0)
+        p, _enabled, every = normalize_decimation(p, every)
+        out["decimation"] = {"p": p, "every": every}
+    from ..algorithms import param_bool
+
+    if bnb_flag or param_bool(str(given.get("bnb", "")).strip()):
+        out["bnb"] = True
+    return out
 
 
 def _resolved_precision_name(args) -> Optional[str]:
@@ -137,6 +218,33 @@ def run_cmd(args, timeout: Optional[float] = None):
         # — validating it as an algo-param would reject those.
         args.algo_params = (args.algo_params or []) + [
             f"precision:{args.precision}"]
+    decim = parse_decimation_flag(getattr(args, "decimation", None))
+    bnb_flag = bool(getattr(args, "bnb", False))
+    if args.mode != "sharded":
+        # same sugar rule as --precision: the flags become the
+        # algorithm parameters, so algorithms without them (dsa, dpop,
+        # ...) reject the request loudly through algo-param validation
+        if decim:
+            args.algo_params = (args.algo_params or []) + [
+                f"decimation_p:{decim[0]}",
+                f"decimation_every:{decim[1]}"]
+        if bnb_flag:
+            args.algo_params = (args.algo_params or []) + ["bnb:1"]
+    elif (decim or bnb_flag) and args.algo not in ("maxsum", "amaxsum"):
+        # the sharded decimation/bnb kwargs exist on the maxsum mesh
+        # family only — fail fast instead of a constructor TypeError
+        raise CliError(
+            "--decimation/--bnb are maxsum-family options; "
+            f"sharded {args.algo!r} supports neither")
+    elif decim and args.algo == "amaxsum":
+        # per-feature gate: ShardedAMaxSum takes bnb but rejects
+        # decimation (stochastic activation re-admits pre-freeze
+        # messages) — surface that as a clean CLI error, not a
+        # constructor traceback
+        raise CliError(
+            "--decimation is not supported with amaxsum (stochastic "
+            "edge activation undoes the freeze clamp); use maxsum "
+            "for decimated runs")
     precision_name = _resolved_precision_name(args)
     dcop = load_dcop_from_file(args.dcop_files)
     algo_def = build_algo_def(args.algo, args.algo_params,
@@ -177,6 +285,11 @@ def run_cmd(args, timeout: Optional[float] = None):
             # families whose engine params predate the policy this is
             # the only flag path — the kwarg exists on all of them
             params["precision"] = args.precision
+        if decim:
+            params["decimation_p"] = decim[0]
+            params["decimation_every"] = decim[1]
+        if bnb_flag:
+            params["bnb"] = True
         # single-chip-only engine knob: reject loudly rather than let
         # the sharded solver constructor TypeError on it
         if params.pop("delta_on", "messages") != "messages":
@@ -223,6 +336,7 @@ def run_cmd(args, timeout: Optional[float] = None):
         }
         if precision_name:
             result["precision"] = precision_name
+        result.update(_feature_result_fields(args, decim, bnb_flag))
         if res.cost_trace:
             result["cost_trace"] = res.cost_trace
         if telemetry_path:
@@ -293,6 +407,8 @@ def run_cmd(args, timeout: Optional[float] = None):
         # the orchestrated (thread/process) fabric computes in host
         # float64 — the policy applies to the compiled data plane only
         result["precision"] = precision_name
+    if args.mode == "engine":
+        result.update(_feature_result_fields(args, decim, bnb_flag))
     if res.cost_trace:
         result["cost_trace"] = res.cost_trace
     if telemetry_path:

@@ -135,7 +135,8 @@ class ShardedSyncEngine:
         import jax
         import jax.numpy as jnp
 
-        from ..observability.metrics import (residual_from_q,
+        from ..observability.metrics import (feature_metrics,
+                                             residual_from_q,
                                              write_metric_planes)
 
         solver = self._solver
@@ -170,8 +171,10 @@ class ShardedSyncEngine:
                         flips = jnp.int32(0)
                     viol = jnp.min(viol_of(s2)).astype(jnp.int32) \
                         if viol_of is not None else jnp.int32(-1)
+                    freezes, pruned = feature_metrics(s2)
                     out.update(write_metric_planes(
-                        out, i, resid, flips, viol))
+                        out, i, resid, flips, viol,
+                        freezes=freezes, pruned=pruned))
             return out
 
         def run_chunk(state, limit):

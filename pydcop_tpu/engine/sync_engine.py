@@ -64,6 +64,7 @@ class SyncEngine:
         (observability/metrics.py); solver arithmetic is untouched, so
         telemetry-on selections stay bit-exact."""
         from ..observability.metrics import (conflicts_fn_for,
+                                             feature_metrics,
                                              residual_from_q,
                                              write_metric_planes)
 
@@ -83,8 +84,10 @@ class SyncEngine:
                 viol = viol_fn(solver.assignment_indices(s2)) \
                     .astype(jnp.int32) if viol_fn is not None \
                     else jnp.int32(-1)
+                freezes, pruned = feature_metrics(s2)
                 planes = write_metric_planes(planes, i, resid, flips,
-                                             viol)
+                                             viol, freezes=freezes,
+                                             pruned=pruned)
             return s2, planes
 
         def run_chunk(carry, limit):

@@ -20,6 +20,15 @@ carry (the same mechanism as the engines' anytime cost trace):
   cost trace's best-over-batch convention.  ``-1`` when the solver has
   no conflict evaluator.
 
+Two feature planes ride alongside (PR 6):
+
+* ``freezes`` — decimated Max-Sum's cumulative frozen-variable count
+  (summed over the restart batch), read straight off the carried
+  freeze plane.  ``-1``/``null`` when the run has no decimation.
+* ``pruned`` — the branch-and-bound pruned-cell fraction of this
+  cycle's factor reductions (1.0 = everything skipped), averaged over
+  the planned buckets.  ``NaN``/``null`` without bnb.
+
 The planes are drained at existing chunk sync boundaries only, so
 telemetry adds zero extra host round-trips; with telemetry off the
 compiled step is byte-identical (the guard suite asserts selections AND
@@ -32,10 +41,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 #: record-field names, in schema order
-METRIC_KEYS = ("residual", "flips", "violations")
+METRIC_KEYS = ("residual", "flips", "violations", "freezes", "pruned")
 
 #: carry keys of the metric planes (engine-private, like ``trace``)
-PLANE_KEYS = ("m_residual", "m_flips", "m_violations")
+PLANE_KEYS = ("m_residual", "m_flips", "m_violations", "m_freezes",
+              "m_pruned")
 
 #: hard cap on metric-plane length: a --max_cycles 10**9 run must not
 #: allocate gigabyte planes; cycles past the cap simply stop recording
@@ -54,19 +64,49 @@ def alloc_metric_planes(n_cycles: int) -> Dict[str, Any]:
         "m_residual": jnp.full((n,), jnp.nan, dtype=jnp.float32),
         "m_flips": jnp.full((n,), -1, dtype=jnp.int32),
         "m_violations": jnp.full((n,), -1, dtype=jnp.int32),
+        "m_freezes": jnp.full((n,), -1, dtype=jnp.int32),
+        "m_pruned": jnp.full((n,), jnp.nan, dtype=jnp.float32),
     }
 
 
+def feature_metrics(state: Dict[str, Any]):
+    """The decimation/bnb signals of one post-step carry, in plane
+    encoding: ``(freezes, pruned)`` — the cumulative frozen-variable
+    count over the batch when the carry has a freeze plane (else
+    ``-1``) and the cycle's pruned-cell fraction when it has one (else
+    ``NaN``).  Presence is static (the feature flags fix the carry
+    keys at build time), so feature-off programs trace the constants
+    and stay untouched."""
+    import jax.numpy as jnp
+
+    freezes = jnp.sum(state["frozen"].astype(jnp.int32)) \
+        if "frozen" in state else jnp.int32(-1)
+    pruned = jnp.asarray(state["pruned"], jnp.float32) \
+        if "pruned" in state else jnp.float32(jnp.nan)
+    return freezes, pruned
+
+
 def write_metric_planes(planes: Dict[str, Any], i,
-                        residual, flips, violations) -> Dict[str, Any]:
+                        residual, flips, violations,
+                        freezes=None, pruned=None) -> Dict[str, Any]:
     """Write one cycle's metrics at plane row ``i`` (out-of-range rows
-    beyond the cap are dropped, never clamped onto row -1)."""
+    beyond the cap are dropped, never clamped onto row -1).  The
+    feature fields default to their not-available sentinels."""
+    import jax.numpy as jnp
+
+    if freezes is None:
+        freezes = jnp.int32(-1)
+    if pruned is None:
+        pruned = jnp.float32(jnp.nan)
     return {
         "m_residual": planes["m_residual"].at[i].set(
             residual, mode="drop"),
         "m_flips": planes["m_flips"].at[i].set(flips, mode="drop"),
         "m_violations": planes["m_violations"].at[i].set(
             violations, mode="drop"),
+        "m_freezes": planes["m_freezes"].at[i].set(
+            freezes, mode="drop"),
+        "m_pruned": planes["m_pruned"].at[i].set(pruned, mode="drop"),
     }
 
 
@@ -85,16 +125,25 @@ def metric_records(planes: Dict[str, Any],
     resid = np.asarray(jax.device_get(planes["m_residual"]))
     flips = np.asarray(jax.device_get(planes["m_flips"]))
     viol = np.asarray(jax.device_get(planes["m_violations"]))
+    # feature planes are absent from pre-PR-6 plane dicts (tests
+    # hand-roll them); decode as not-available
+    freezes = np.asarray(jax.device_get(planes["m_freezes"])) \
+        if "m_freezes" in planes else np.full_like(flips, -1)
+    pruned = np.asarray(jax.device_get(planes["m_pruned"])) \
+        if "m_pruned" in planes else np.full_like(resid, np.nan)
     out = []
     for i in range(min(int(cycles), len(flips))):
         if flips[i] < 0:  # never written (finished before this cycle)
             continue
         r = float(resid[i])
+        p = float(pruned[i])
         out.append({
             "cycle": i + 1,
             "residual": None if math.isnan(r) else r,
             "flips": int(flips[i]),
             "violations": None if viol[i] < 0 else int(viol[i]),
+            "freezes": None if freezes[i] < 0 else int(freezes[i]),
+            "pruned": None if math.isnan(p) else p,
         })
     return out
 

@@ -157,6 +157,16 @@ def validate_record(rec: Dict[str, Any]):
         if viol is not None and (not isinstance(viol, int) or viol < 0):
             raise ValueError(
                 f"cycle record with bad violations {viol!r}")
+        freezes = rec.get("freezes")
+        if freezes is not None and (not isinstance(freezes, int)
+                                    or freezes < 0):
+            raise ValueError(
+                f"cycle record with bad freezes {freezes!r}")
+        pruned = rec.get("pruned")
+        if pruned is not None and (not isinstance(pruned, (int, float))
+                                   or not -1e-6 <= pruned <= 1 + 1e-6):
+            raise ValueError(
+                f"cycle record with bad pruned {pruned!r}")
     elif kind == "summary":
         if "status" not in rec:
             raise ValueError("summary missing 'status'")

@@ -24,7 +24,8 @@ a bf16 plane meets an f32 message array; the explicit ``.astype`` calls
 below cover the reductions whose inputs are pure plane gathers.
 """
 
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -197,6 +198,184 @@ def prefix_uniform(key: jax.Array, n: int,
         key, jnp.arange(n))
     shape = () if width is None else (width,)
     return jax.vmap(lambda k: jax.random.uniform(k, shape))(keys)
+
+
+# ------------------------------------------ branch-and-bound pruning
+
+#: cube cells (D**arity) below which the pruned sweep is never worth
+#: its per-block bound checks: tiny cubes stay on the unrolled fast
+#: paths (the bench round-3 lesson — op count dominates FLOPs there)
+BNB_MIN_CELLS = 128
+
+#: joint assignments per while-loop iteration of the pruned sweep —
+#: coarse enough that the per-iteration bound check amortizes, fine
+#: enough that a good bound ordering skips most of a big cube
+BNB_BLOCK_CELLS = 64
+
+
+@dataclass
+class PrunedPlan:
+    """Build-time constants of one arity bucket's branch-and-bound
+    reduction (computed alongside the PR 5 hoisted per-constraint
+    optima, see ``build_pruned_plan``).  ``cube_cells``/``digits``/
+    ``suffix_min`` are host numpy here; solvers device-place them
+    (cubes in the precision policy's store dtype) via
+    :func:`device_pruned_plan`."""
+
+    digits: Any       # (arity, n_cells_pad) int32, bound-sorted order
+    cube_cells: Any   # (n_cells_pad, F) cube values in sorted order
+    suffix_min: Any   # (n_blocks + 1, F) f32 remaining-cells minima
+    block: int        # cells per while-loop iteration
+    n_blocks: int
+    n_cells: int      # real (unpadded) joint assignments
+
+
+# a registered pytree so plans ride jit/shard_map argument lists (the
+# sharded solvers pass per-shard plan stacks through P("tp") specs)
+jax.tree_util.register_pytree_node(
+    PrunedPlan,
+    lambda p: ((p.digits, p.cube_cells, p.suffix_min),
+               (p.block, p.n_blocks, p.n_cells)),
+    lambda aux, kids: PrunedPlan(kids[0], kids[1], kids[2], *aux))
+
+
+def build_pruned_plan(cubes, block: int = BNB_BLOCK_CELLS
+                      ) -> Optional[PrunedPlan]:
+    """The branch-and-bound reduction plan of one arity bucket:
+    ``cubes (F, D, ..., D)``.  Joint assignments are ordered ascending
+    by their per-slot lower bound — the min cube value over the
+    bucket's factors, a pure build-time quantity — so the runtime sweep
+    (``ops.pallas_kernels.factor_messages_nary_lane_major_pruned``)
+    visits optimistic cells first and the per-factor suffix minima
+    bound the tail.  Returns ``None`` for buckets too small to pay for
+    the bound checks (``D**arity < BNB_MIN_CELLS``) or below arity 3
+    (binary buckets ride the historically-benched kernels)."""
+    import numpy as np
+
+    cubes = np.asarray(cubes)
+    F = cubes.shape[0]
+    arity = cubes.ndim - 1
+    D = cubes.shape[-1] if arity else 1
+    n_cells = int(D ** arity)
+    if F == 0 or arity < 3 or n_cells < BNB_MIN_CELLS:
+        return None
+    flat = np.asarray(cubes, dtype=np.float32).reshape(F, n_cells)
+    order = np.argsort(flat.min(axis=0), kind="stable")
+    digits = np.empty((arity, n_cells), dtype=np.int32)
+    rem = order.copy()
+    for p in range(arity - 1, -1, -1):
+        digits[p] = rem % D
+        rem //= D
+    n_blocks = (n_cells + block - 1) // block
+    pad = n_blocks * block - n_cells
+    cube_cells = np.ascontiguousarray(flat[:, order].T)  # (n_cells, F)
+    if pad:
+        # +inf padding: a padded cell can never win a min (inf + q =
+        # inf) and an all-padding tail makes the suffix bound fire
+        cube_cells = np.concatenate(
+            [cube_cells, np.full((pad, F), np.inf, np.float32)])
+        digits = np.concatenate(
+            [digits, np.zeros((arity, pad), np.int32)], axis=1)
+    return PrunedPlan(digits=digits, cube_cells=cube_cells,
+                      suffix_min=pruned_suffix_min(cube_cells, block,
+                                                   n_blocks),
+                      block=block, n_blocks=n_blocks,
+                      n_cells=n_cells)
+
+
+def pruned_suffix_min(cube_cells, block: int, n_blocks: int):
+    """Per-factor suffix minima over the block grid of ``cube_cells``
+    (``(..., n_blocks * block, F)``, any leading batch dims), f32.
+
+    Device placement MUST recompute the bounds from the values the
+    sweep will actually read: a plan built from f32 cubes whose
+    ``cube_cells`` are then rounded to a narrower store dtype (bf16
+    rounds to nearest, i.e. sometimes DOWN) would otherwise carry
+    suffix minima ABOVE the true floor of the stored values — an
+    invalid bound that can early-out past a winning cell."""
+    import numpy as np
+
+    cc = np.asarray(cube_cells, dtype=np.float32)
+    *lead, _n_pad, F = cc.shape
+    bm = cc.reshape(*lead, n_blocks, block, F).min(axis=-2)
+    sm = np.full((*lead, n_blocks + 1, F), np.inf, dtype=np.float32)
+    for i in range(n_blocks - 1, -1, -1):
+        sm[..., i, :] = np.minimum(sm[..., i + 1, :], bm[..., i, :])
+    return sm
+
+
+def device_pruned_plan(plan: PrunedPlan, store_dtype) -> PrunedPlan:
+    """Device-placed copy of a host plan: cube values ride the
+    precision policy's store dtype (the same exact-upcast-at-entry
+    contract as the full-scan kernels), with the suffix bounds
+    recomputed from the STORED values (see
+    :func:`pruned_suffix_min`); indices untouched."""
+    import numpy as np
+
+    stored = np.asarray(plan.cube_cells).astype(store_dtype)
+    return PrunedPlan(
+        digits=jnp.asarray(plan.digits),
+        cube_cells=jnp.asarray(stored),
+        suffix_min=jnp.asarray(pruned_suffix_min(
+            stored, plan.block, plan.n_blocks)),
+        block=plan.block, n_blocks=plan.n_blocks,
+        n_cells=plan.n_cells)
+
+
+def factor_messages_pruned(plan: PrunedPlan,
+                           q: Sequence[jnp.ndarray]
+                           ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Edge-major adapter of the pruned bound-ordered sweep: takes the
+    (F, D) per-position messages the edge-major solvers carry, runs
+    the shared lane-major core, and transposes back.  Returns
+    ``(messages [(F, D) ...], blocks_run)``; messages are bit-exact
+    with :func:`factor_messages` on the same bucket."""
+    from .pallas_kernels import factor_messages_nary_lane_major_pruned
+
+    msgs, blocks_run = factor_messages_nary_lane_major_pruned(
+        plan, [jnp.transpose(qp) for qp in q])
+    return [jnp.transpose(m) for m in msgs], blocks_run
+
+
+# ------------------------------------------------- decimation helpers
+
+
+def belief_margins(belief: jnp.ndarray, mask: jnp.ndarray,
+                   axis: int = -1) -> jnp.ndarray:
+    """Per-variable confidence of the current beliefs: second-best
+    minus best cost over valid domain slots (the q-margin of decimated
+    Max-Sum, arXiv:1706.02209).  ``axis`` is the domain axis (-1 for
+    the (V, D) edge-major layout, 0 for the lane-major (D, V) one);
+    variables with fewer than two valid slots come back huge — callers
+    exclude them via the eligibility mask anyway."""
+    b = jnp.where(mask, belief,
+                  jnp.asarray(SENTINEL, belief.dtype))
+    srt = jnp.sort(b.astype(jnp.float32), axis=axis)
+    lo = jax.lax.index_in_dim(srt, 0, axis=axis, keepdims=False)
+    hi = jax.lax.index_in_dim(srt, 1, axis=axis, keepdims=False)
+    return hi - lo
+
+
+def decimation_select(margins: jnp.ndarray, frozen: jnp.ndarray,
+                      eligible: jnp.ndarray, p: float) -> jnp.ndarray:
+    """One decimation event: the top-``ceil(p * n_candidates)``
+    most-confident (largest-margin) unfrozen eligible variables.
+    Returns the newly-frozen bool mask.  The cut is an exact rank-k
+    (one argsort + one scatter on device), ties broken by variable
+    index — a value-threshold cut would freeze EVERY tied candidate,
+    which on instances with symmetric integer beliefs can pin the
+    whole graph in one event regardless of ``p``.  Phantom/fixed
+    variables are excluded via ``eligible``."""
+    cand = jnp.logical_and(eligible, jnp.logical_not(frozen))
+    n_cand = jnp.sum(cand.astype(jnp.int32))
+    k = jnp.ceil(jnp.float32(p) * n_cand.astype(jnp.float32)) \
+        .astype(jnp.int32)
+    k = jnp.minimum(k, n_cand)
+    m = jnp.where(cand, margins.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-m)  # descending, stable: ties by index
+    ranks = jnp.zeros_like(order).at[order].set(
+        jnp.arange(m.shape[0], dtype=order.dtype))
+    return jnp.logical_and(cand, ranks < k)
 
 
 def random_argmin(key: jax.Array, costs: jnp.ndarray,

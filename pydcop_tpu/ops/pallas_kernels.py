@@ -28,6 +28,8 @@ gates dispatch; bigger hypercubes take the generic XLA path.
 
 import functools
 import itertools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -37,8 +39,58 @@ BLK_F = 512  # factors per grid step (multiple of the 128-lane tile)
 #: per-factor hypercube cells (D**arity) at or below which the unrolled
 #: lane-major fast paths (this kernel family and the fused var-sorted
 #: layout) dispatch; above it, callers fall back to the generic
-#: gather/scatter XLA path, which stays the correctness oracle
+#: gather/scatter XLA path, which stays the correctness oracle.
+#: This is the built-in default — consult :func:`nary_fast_max_cells`
+#: (overridable via ``PYDCOP_TPU_NARY_MAX_CELLS`` for A/B runs) at
+#: every dispatch decision instead of reading the constant directly.
 NARY_FAST_MAX_CELLS = 4096
+
+#: environment override of the fast-path cell ceiling (A/B runs tune
+#: the ladder without a code edit)
+NARY_MAX_CELLS_ENV = "PYDCOP_TPU_NARY_MAX_CELLS"
+
+#: the ONE fallback/rejection explanation every eligibility error
+#: embeds — previously copied (and drifting) across the lane/fused
+#: solvers and the sharded mesh family
+NARY_FALLBACK_TEXT = (
+    "per-factor hypercubes small enough to unroll "
+    "(D**arity <= NARY_FAST_MAX_CELLS, overridable via the "
+    f"{NARY_MAX_CELLS_ENV} environment variable)")
+
+_warned_bad_env = False
+
+
+def nary_fast_max_cells() -> int:
+    """The effective fast-path cell ceiling: the
+    ``PYDCOP_TPU_NARY_MAX_CELLS`` environment variable when set (>= 1),
+    else :data:`NARY_FAST_MAX_CELLS`.  Malformed values warn once and
+    fall back to the default instead of silently changing dispatch."""
+    raw = os.environ.get(NARY_MAX_CELLS_ENV)
+    if not raw:
+        return NARY_FAST_MAX_CELLS
+    try:
+        v = int(raw)
+        if v < 1:
+            raise ValueError(raw)
+        return v
+    except ValueError:
+        global _warned_bad_env
+        if not _warned_bad_env:
+            _warned_bad_env = True
+            warnings.warn(
+                f"ignoring malformed {NARY_MAX_CELLS_ENV}={raw!r} "
+                f"(want a positive integer); using the default "
+                f"{NARY_FAST_MAX_CELLS}", RuntimeWarning)
+        return NARY_FAST_MAX_CELLS
+
+
+def nary_fast_eligible(max_domain: int, arity: int) -> bool:
+    """THE n-ary fast-path eligibility predicate, in one place: binary
+    (and unary) buckets are unconditionally eligible, bigger arities
+    must keep their ``D**arity`` hypercube under the (env-overridable)
+    unroll ceiling.  Every lane/fused/mesh dispatch decision routes
+    through here so the gate can never drift between layouts."""
+    return arity <= 2 or max_domain ** arity <= nary_fast_max_cells()
 
 
 def _binary_kernel(cube_ref, q0_ref, q1_ref, m0_ref, m1_ref):
@@ -201,12 +253,22 @@ def factor_messages_nary_lane_major(cubesT, qs, interpret=False):
 
 
 def factor_messages_lane_major(cubesT, q_in, arity, use_pallas=False,
-                               interpret=False):
+                               interpret=False, plan=None):
     """Per-arity-bucket kernel dispatch shared by every lane-major
     consumer (single-chip lane/fused solvers and the mesh twins):
     binary buckets keep the historically-benched binary kernel/ref,
     n-ary buckets take the arity-generic pair; ``use_pallas`` opts
-    into the hand kernels (``interpret`` for off-TPU testing)."""
+    into the hand kernels (``interpret`` for off-TPU testing).
+
+    ``plan`` (a device-placed branch-and-bound reduction plan, see
+    ``ops.kernels.build_pruned_plan``) reroutes the bucket through the
+    pruned bound-ordered sweep instead of the full-scan kernels; the
+    caller then receives ``(messages, blocks_run)`` — messages are
+    bit-exact with the full scan (the bound only excludes cells that
+    cannot lower any accumulator), ``blocks_run`` counts the executed
+    cell blocks for the pruned-cell telemetry."""
+    if plan is not None:
+        return factor_messages_nary_lane_major_pruned(plan, q_in)
     if arity == 2:
         if use_pallas:
             return list(factor_messages_binary_lane_major(
@@ -217,6 +279,91 @@ def factor_messages_lane_major(cubesT, q_in, arity, use_pallas=False,
         return factor_messages_nary_lane_major(
             cubesT, q_in, interpret=interpret)
     return factor_messages_nary_lane_major_ref(cubesT, q_in)
+
+
+# --------------------------------------------- branch-and-bound sweep
+
+
+def factor_messages_nary_lane_major_pruned(plan, qs):
+    """Branch-and-bound pruned n-ary min-marginals, lane-major.
+
+    ``plan`` is a device-placed :class:`ops.kernels.PrunedPlan` (built
+    once alongside the PR 5 hoisted per-constraint optima): the
+    ``D**arity`` joint assignments of the bucket's hypercubes are
+    pre-sorted ascending by their per-slot lower bound (min cube value
+    over the bucket's factors) and swept in blocks inside a
+    ``lax.while_loop``.  The loop carries one ``(arity, D, F)``
+    accumulator stack and EARLY-OUTS as soon as the remaining cells'
+    bound — the build-time per-factor suffix minimum of the sorted cube
+    values plus the cycle's per-position ``min_d q_p`` slack — can no
+    longer lower ANY accumulator entry.  A skipped cell satisfies
+    ``cube[c] + sum_{p' != p} q_p'[c_p'] >= suffix_min + qexcl_p >=
+    max_d acc[p, d]``, so the produced messages equal the full scan
+    BIT-EXACTLY (per-cell sums associate in the same position order as
+    ``factor_messages``; min is order-insensitive).
+
+    Unlike the unrolled fast-path kernels this sweep never
+    materializes the whole hypercube walk in the program, so it stays
+    usable ABOVE the ``NARY_FAST_MAX_CELLS`` ceiling.
+
+    qs: per-position incoming messages, each ``(D, F)``.  Returns
+    ``([m_p (D, F) ...], blocks_run)`` — ``blocks_run`` is the traced
+    number of executed cell blocks (pruned fraction =
+    ``1 - blocks_run / plan.n_blocks``).
+    """
+    cube_cells, digits, suffix_min = (
+        plan.cube_cells, plan.digits, plan.suffix_min)
+    block, n_blocks = plan.block, plan.n_blocks
+    arity = len(qs)
+    D = qs[0].shape[0]
+    dt = _common_dtype(cube_cells, qs)
+    qs = [q.astype(dt) for q in qs]
+    from ..graphs.arrays import SENTINEL
+
+    # per-position slack: the least any OTHER position's message can
+    # contribute — recomputed per cycle (cheap: one min per plane)
+    qmin = [jnp.min(q, axis=0) for q in qs]             # (F,) each
+    qmin_all = qmin[0]
+    for m in qmin[1:]:
+        qmin_all = qmin_all + m
+    qexcl = jnp.stack([qmin_all - m for m in qmin])     # (arity, F)
+    acc0 = jnp.full((arity, D, cube_cells.shape[1]),
+                    jnp.asarray(SENTINEL, dt))
+
+    def cond(c):
+        i, _acc, stop = c
+        return jnp.logical_and(i < n_blocks, jnp.logical_not(stop))
+
+    def body(c):
+        i, acc, _stop = c
+        cb = jax.lax.dynamic_slice_in_dim(
+            cube_cells, i * block, block, axis=0)       # (BC, F)
+        dg = jax.lax.dynamic_slice_in_dim(
+            digits, i * block, block, axis=1)           # (arity, BC)
+        # same association order as factor_messages: cube + q_0 + ...
+        total = cb.astype(dt)
+        gathered = []
+        for p in range(arity):
+            g = qs[p][dg[p], :]                         # (BC, F)
+            gathered.append(g)
+            total = total + g
+        new_acc = []
+        for p in range(arity):
+            seg = jax.ops.segment_min(
+                total - gathered[p], dg[p], num_segments=D)
+            new_acc.append(jnp.minimum(acc[p], seg))
+        acc = jnp.stack(new_acc)
+        nxt = i + 1
+        # remaining-cells bound per (position, factor) vs the WORST
+        # accumulator entry: stop only when no entry can improve
+        bound = (suffix_min[nxt][None, :].astype(jnp.float32)
+                 + qexcl.astype(jnp.float32))           # (arity, F)
+        worst = jnp.max(acc.astype(jnp.float32), axis=1)
+        return nxt, acc, jnp.all(bound >= worst)
+
+    blocks_run, acc, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), acc0, jnp.bool_(False)))
+    return [acc[p] for p in range(arity)], blocks_run
 
 
 def factor_messages_nary_lane_major_ref(cubesT, qs):

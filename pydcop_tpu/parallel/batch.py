@@ -97,6 +97,7 @@ class _BatchedRunnerBase:
 
         from ..observability.metrics import (alloc_metric_planes,
                                              conflict_count,
+                                             feature_metrics,
                                              normalize_buckets,
                                              residual_from_q,
                                              write_metric_planes)
@@ -128,8 +129,10 @@ class _BatchedRunnerBase:
                     .astype(jnp.int32))
                 viol = conflict_count(buckets, x2, optima=optima) \
                     .astype(jnp.int32)
+                freezes, pruned = feature_metrics(s2)
                 planes = write_metric_planes(planes, i, resid, flips,
-                                             viol)
+                                             viol, freezes=freezes,
+                                             pruned=pruned)
             return s2, planes
 
         final, planes = jax.lax.while_loop(
@@ -265,6 +268,20 @@ class BatchedMaxSum(_BatchedRunnerBase):
                  instances: Optional[List[FactorGraphArrays]] = None,
                  **params):
         super().__init__()
+        if params.get("bnb"):
+            # loud rejection, never a silent downgrade: bnb plans are
+            # build-time constants of the cube CONTENTS (sorted cell
+            # order + suffix bounds), but a batched runner's cubes are
+            # vmapped program ARGUMENTS swapped per instance — the
+            # template's plan would silently misprune every other
+            # instance.  Decimation composes fine (the freeze plane is
+            # per-instance state under the vmap).
+            raise ValueError(
+                "batched runners do not support bnb: pruned-reduction "
+                "plans are build-time constants of one instance's "
+                "cubes, but batched cubes are per-instance vmapped "
+                "arguments; run bnb through the engine or sharded "
+                "paths")
         self.solver = MaxSumSolver(template, **params)
         self._template = template
         self._sign = float(template.sign)

@@ -43,7 +43,9 @@ from ._mesh_cost import build_mesh_cost
 from ..engine._cache import enable_persistent_cache
 from ..engine.mesh_engine import MeshSolverMixin
 from ..graphs.arrays import BIG, SENTINEL, FactorGraphArrays
-from ..ops.kernels import factor_messages
+from ..ops.kernels import (PrunedPlan, belief_margins,
+                           build_pruned_plan, decimation_select,
+                           factor_messages, factor_messages_pruned)
 from ..ops.precision import resolve as resolve_precision
 
 SAME_COUNT = 4
@@ -111,12 +113,24 @@ class ShardedMaxSum(MeshSolverMixin):
     finished = False
 
     def _init_params(self, arrays, mesh, damping, damping_nodes,
-                     stability, noise, batch, precision=None):
+                     stability, noise, batch, precision=None,
+                     decimation_p=0.0, decimation_every=0):
         """The parameter block every mesh layout shares — ONE copy of
         the damping-invariant convergence-threshold rule
         (algorithms/maxsum.py:64-70) and the batch/dp check, so the
         fused mesh class can never diverge from the lane mesh on
         convergence semantics."""
+        from ..algorithms.maxsum import normalize_decimation
+
+        (self.decimation_p, self.decimation,
+         self.decimation_every) = normalize_decimation(
+            decimation_p, decimation_every)
+        # subclasses without plans (fused mesh, which rejects bnb)
+        # inherit the inert defaults
+        self.bnb = False
+        self._bnb_plans_np = []
+        self._bnb_active = False
+        self._bnb_cells_total = 0
         # mesh runs re-traced from cold every process before the mesh
         # engine: turn the persistent XLA cache on for every sharded
         # construction path, like SyncEngine does for single-chip
@@ -146,9 +160,12 @@ class ShardedMaxSum(MeshSolverMixin):
                  stability: float = 0.1, noise: float = 0.0,
                  layout: str = "auto", batch: int = 1,
                  use_pallas: Optional[bool] = None,
-                 precision=None):
+                 precision=None, decimation_p: float = 0.0,
+                 decimation_every: int = 0, bnb: bool = False):
         self._init_params(arrays, mesh, damping, damping_nodes,
-                          stability, noise, batch, precision=precision)
+                          stability, noise, batch, precision=precision,
+                          decimation_p=decimation_p,
+                          decimation_every=decimation_every)
 
         # validate BEFORE the host-side factor partition: a bad layout
         # must fail fast, not after padding every bucket across shards
@@ -162,19 +179,27 @@ class ShardedMaxSum(MeshSolverMixin):
         self.E_loc = e_loc
         self.buckets = shard_buckets
         self.edge_var = edge_var                        # (TP, E_loc)
-        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+        self._build_bnb_plans(bnb, shard_buckets)
+        from ..ops.pallas_kernels import (NARY_FALLBACK_TEXT,
+                                          nary_fast_eligible)
 
-        def _lane_ok(sb):
-            return sb.arity <= 2 or \
-                self.D ** sb.arity <= NARY_FAST_MAX_CELLS
+        def _lane_ok(bi, sb):
+            # the shared (env-overridable) fast-path gate; a bnb plan
+            # replaces the unrolled sweep for its bucket, so planned
+            # buckets pass regardless of cell count
+            return nary_fast_eligible(self.D, sb.arity) or (
+                self._bnb_active
+                and self._bnb_plans_np[bi] is not None)
         if layout == "auto":
             layout = "lane_major" if all(
-                _lane_ok(sb) for sb in shard_buckets) else "edge_major"
+                _lane_ok(bi, sb)
+                for bi, sb in enumerate(shard_buckets)) \
+                else "edge_major"
         if layout == "lane_major" and not all(
-                _lane_ok(sb) for sb in shard_buckets):
+                _lane_ok(bi, sb)
+                for bi, sb in enumerate(shard_buckets)):
             raise ValueError(
-                "lane_major needs per-factor hypercubes small enough "
-                "to unroll (D**arity <= NARY_FAST_MAX_CELLS); use "
+                f"lane_major needs {NARY_FALLBACK_TEXT}; use "
                 "edge_major for bigger factors")
         self.layout = layout
         if use_pallas is None:
@@ -202,6 +227,44 @@ class ShardedMaxSum(MeshSolverMixin):
 
         self._build_step()
 
+    # -------------------------------------------------- bnb plumbing
+
+    def _build_bnb_plans(self, bnb, shard_buckets):
+        """Per-shard branch-and-bound plans, stacked along a leading
+        TP axis (every shard's bucket has identical padded shape, so
+        block counts agree; cell ORDER is per-shard).  Buckets too
+        small to pay for bound checks stay None — full scan."""
+        self.bnb = bool(bnb)
+        self._bnb_plans_np = []
+        if self.bnb:
+            for sb in shard_buckets:
+                per_shard = [build_pruned_plan(sb.cubes[g])
+                             for g in range(self.tp)]
+                if not per_shard or per_shard[0] is None:
+                    self._bnb_plans_np.append(None)
+                    continue
+                self._bnb_plans_np.append(PrunedPlan(
+                    digits=np.stack([p.digits for p in per_shard]),
+                    cube_cells=np.stack(
+                        [p.cube_cells for p in per_shard]),
+                    suffix_min=np.stack(
+                        [p.suffix_min for p in per_shard]),
+                    block=per_shard[0].block,
+                    n_blocks=per_shard[0].n_blocks,
+                    n_cells=per_shard[0].n_cells))
+        self._bnb_active = any(p is not None
+                               for p in self._bnb_plans_np)
+        self._bnb_cells_total = sum(
+            pl.n_blocks * pl.block * sb.cubes.shape[1]
+            for pl, sb in zip(self._bnb_plans_np, shard_buckets)
+            if pl is not None)
+
+    def _features_on(self) -> bool:
+        """Whether the extended (decimation/bnb) step signature is in
+        force; off means the compiled program is byte-identical to the
+        pre-feature solver."""
+        return self.decimation or self._bnb_active
+
     # ------------------------------------------------------------ state
 
     def _init_state(self):
@@ -219,7 +282,7 @@ class ShardedMaxSum(MeshSolverMixin):
     def _make_consts(self):
         mesh = self.mesh
         store = self.policy.store_dtype
-        return {
+        consts = {
             "edge_var": jax.device_put(
                 self.edge_var, NamedSharding(mesh, P("tp"))),
             # cost planes ride the store dtype (half the HBM bytes per
@@ -237,6 +300,30 @@ class ShardedMaxSum(MeshSolverMixin):
             "domain_size": jax.device_put(
                 jnp.asarray(self.domain_size), NamedSharding(mesh, P())),
         }
+        if self._bnb_active:
+            from ..ops.kernels import pruned_suffix_min
+
+            tp_sh = NamedSharding(mesh, P("tp"))
+
+            def _place_plan(pl):
+                # bounds recomputed from the STORE-ROUNDED values the
+                # sweep reads, never the f32 build values (bf16 rounds
+                # down: an f32 bound above the stored floor could
+                # early-out past a winning cell)
+                stored = np.asarray(pl.cube_cells, dtype=store)
+                return PrunedPlan(
+                    digits=jax.device_put(pl.digits, tp_sh),
+                    cube_cells=jax.device_put(stored, tp_sh),
+                    suffix_min=jax.device_put(pruned_suffix_min(
+                        stored, pl.block, pl.n_blocks), tp_sh),
+                    block=pl.block, n_blocks=pl.n_blocks,
+                    n_cells=pl.n_cells)
+
+            consts["bnb_plans"] = [
+                None if pl is None else _place_plan(pl)
+                for pl in self._bnb_plans_np
+            ]
+        return consts
 
     def _device_put(self):
         """Shard the state and constants onto the mesh (constants come
@@ -246,31 +333,44 @@ class ShardedMaxSum(MeshSolverMixin):
 
     # ------------------------------------------------------------- step
 
-    def _factor_update_edge_major(self, q, cubes):
-        """(E, D) layout: per-bucket factor_messages, canonical slices."""
+    def _factor_update_edge_major(self, q, cubes, plans=None):
+        """(E, D) layout: per-bucket factor_messages, canonical
+        slices; a branch-and-bound ``plans`` entry reroutes its bucket
+        through the pruned sweep (bit-exact).  Returns ``(new_r,
+        pruned_runs)``."""
         E, D = self.E_loc, self.D
         blocks = []
-        for sb, cu in zip(self.buckets, cubes):
+        runs = []
+        for bi, (sb, cu) in enumerate(zip(self.buckets, cubes)):
             a = sb.arity
             if a == 0:
                 continue
             f = cu.shape[0]
             q_blk = q[sb.offset:sb.offset + f * a].reshape(f, a, D)
-            msgs = factor_messages(cu, [q_blk[:, p] for p in range(a)])
+            q_in = [q_blk[:, p] for p in range(a)]
+            plan = plans[bi] if plans is not None else None
+            if plan is not None:
+                msgs, br = factor_messages_pruned(plan, q_in)
+                runs.append((br, plan.block * f))
+            else:
+                msgs = factor_messages(cu, q_in)
             blocks.append(jnp.stack(msgs, axis=1).reshape(f * a, D))
         if not blocks:
-            return jnp.zeros((E, D), dtype=q.dtype)
-        return blocks[0] if len(blocks) == 1 else \
-            jnp.concatenate(blocks, axis=0)
+            return jnp.zeros((E, D), dtype=q.dtype), runs
+        return (blocks[0] if len(blocks) == 1 else
+                jnp.concatenate(blocks, axis=0)), runs
 
-    def _factor_update_lane_major(self, qT, cubes):
+    def _factor_update_lane_major(self, qT, cubes, plans=None):
         """(D, E) layout: lane kernels, same math as MaxSumLaneSolver —
         per-arity-bucket dispatch identical to the single-chip solver
         (binary and small-n-ary buckets each one fused kernel on the
-        pallas path, jnp fallbacks elsewhere)."""
+        pallas path, jnp fallbacks elsewhere; branch-and-bound plans
+        reroute to the pruned sweep).  Returns ``(new_r,
+        pruned_runs)``."""
         D, E = self.D, self.E_loc
         blocks = []
-        for sb, cu in zip(self.buckets, cubes):
+        runs = []
+        for bi, (sb, cu) in enumerate(zip(self.buckets, cubes)):
             a = sb.arity
             if a == 0:
                 continue
@@ -285,17 +385,29 @@ class ShardedMaxSum(MeshSolverMixin):
             q_in = [q_blk[:, p::a] for p in range(a)]
             from ..ops.pallas_kernels import factor_messages_lane_major
 
-            msgs = factor_messages_lane_major(
+            plan = plans[bi] if plans is not None else None
+            out = factor_messages_lane_major(
                 cubesT, q_in, a, use_pallas=self.use_pallas,
-                interpret=self._pallas_interpret)
+                interpret=self._pallas_interpret, plan=plan)
+            if plan is not None:
+                msgs, br = out
+                runs.append((br, plan.block * f))
+            else:
+                msgs = out
             blocks.append(jnp.stack(msgs, axis=2)
                           .reshape(D, a * f))
         if not blocks:
-            return jnp.zeros((D, E), dtype=qT.dtype)
-        return blocks[0] if len(blocks) == 1 else \
-            jnp.concatenate(blocks, axis=1)
+            return jnp.zeros((D, E), dtype=qT.dtype), runs
+        return (blocks[0] if len(blocks) == 1 else
+                jnp.concatenate(blocks, axis=1)), runs
 
     def _build_step(self):
+        if self._features_on():
+            # decimation/bnb runs compile the EXTENDED step; with both
+            # off this builder stays byte-for-byte the historical one
+            # (the off == today bit-exactness contract)
+            self._build_step_features()
+            return
         V, D, E = self.V, self.D, self.E_loc
         damping, damping_nodes = self.damping, self.damping_nodes
         noise = self.noise
@@ -306,10 +418,11 @@ class ShardedMaxSum(MeshSolverMixin):
             # q, r: (B_loc, E, D); edge_var: (E,)
             def one(q1, r1, k1):
                 with jax.named_scope("maxsum/factor_update"):
-                    new_r = self._factor_update_edge_major(q1, cubes) \
+                    new_r = self._factor_update_edge_major(
+                        q1, cubes)[0] \
                         if not lane else jnp.transpose(
                             self._factor_update_lane_major(
-                                jnp.transpose(q1), cubes))
+                                jnp.transpose(q1), cubes)[0])
                 if damping_nodes in ("factors", "both") and damping > 0:
                     new_r = damping * r1 + (1 - damping) * new_r
                 with jax.named_scope("maxsum/var_update"):
@@ -382,14 +495,182 @@ class ShardedMaxSum(MeshSolverMixin):
 
         self._step = jax.jit(sharded)
 
+    def _build_step_features(self):
+        """The decimation/bnb-extended sharded step: same per-cycle
+        math as ``_build_step``'s, plus the frozen-variable clamp and
+        chunk-aligned freeze events (decimation) and/or the pruned
+        factor reductions (bnb).  Signature grows by ``(frozen, pin,
+        cycle)`` state-side and the plan constants; outputs add
+        ``(frozen, pin, pruned)``.  The freeze selection runs in a
+        ``lax.cond`` OUTSIDE the per-instance vmap, so non-event
+        cycles skip the margin sort entirely."""
+        V, D, E = self.V, self.D, self.E_loc
+        damping, damping_nodes = self.damping, self.damping_nodes
+        noise = self.noise
+        lane = self.layout == "lane_major"
+        decim = self.decimation
+        bnb = self._bnb_active
+        p_frac = self.decimation_p
+        every = self.decimation_every
+        cells_total = self._bnb_cells_total
+
+        def local_step(q, r, key, frozen, pin, cycle, edge_var, cubes,
+                       var_costs, domain_mask, domain_size, plans):
+            # q, r: (B_loc, E, D); frozen/pin: (B_loc, V); edge_var:
+            # (E,) with V marking dummy (sink) edges.  A decimation-
+            # only run carries NO plans (empty list) — full scans for
+            # every bucket
+            if not plans:
+                plans = None
+
+            def one(q1, r1, k1):
+                with jax.named_scope("maxsum/factor_update"):
+                    if lane:
+                        new_rT, runs = self._factor_update_lane_major(
+                            jnp.transpose(q1), cubes, plans)
+                        new_r = jnp.transpose(new_rT)
+                    else:
+                        new_r, runs = self._factor_update_edge_major(
+                            q1, cubes, plans)
+                if damping_nodes in ("factors", "both") and damping > 0:
+                    new_r = damping * r1 + (1 - damping) * new_r
+                with jax.named_scope("maxsum/var_update"):
+                    partial_sum = jax.ops.segment_sum(
+                        new_r, edge_var, num_segments=V + 1)
+                    sum_r = jax.lax.psum(partial_sum, "tp")
+                    belief = var_costs + sum_r
+                q_new = belief[edge_var] - new_r
+                mask_e = domain_mask[edge_var]
+                mean = (jnp.sum(jnp.where(mask_e, q_new, 0.0), axis=1)
+                        / domain_size[edge_var])
+                q_new = q_new - mean[:, None]
+                if noise > 0:
+                    tp_idx = jax.lax.axis_index("tp")
+                    sub = jax.random.fold_in(k1, tp_idx)
+                    q_new = q_new + noise * jax.random.uniform(
+                        sub, q_new.shape)
+                if damping_nodes in ("vars", "both") and damping > 0:
+                    q_new = damping * q1 + (1 - damping) * q_new
+                q_new = jnp.where(mask_e, q_new, BIG)
+                sel = jnp.argmin(
+                    jnp.where(domain_mask[:V], belief[:V],
+                              jnp.asarray(SENTINEL, belief.dtype)),
+                    axis=-1)
+                if bnb and cells_total:
+                    executed = jnp.float32(0)
+                    for br, w in runs:
+                        executed = executed + \
+                            br.astype(jnp.float32) * jnp.float32(w)
+                    frac = 1.0 - executed / jnp.float32(cells_total)
+                else:
+                    frac = jnp.float32(0)
+                return q_new, new_r, sel, belief, frac
+
+            dp_idx = jax.lax.axis_index("dp")
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(key, dp_idx), i))(
+                jnp.arange(q.shape[0]))
+            q2, r2, sel, beliefs, frac = jax.vmap(one)(q, r, keys)
+            # per-instance pruned-cell fraction, tp-averaged so the
+            # out spec stays tp-invariant (shards prune independently)
+            pruned = jax.lax.pmean(frac, "tp") if bnb else frac
+
+            if decim:
+                do = ((cycle + 1) % every) == 0
+                elig = domain_size[:V] > 1
+
+                def _on(_):
+                    with jax.named_scope("maxsum/decimation"):
+                        margins = jax.vmap(
+                            lambda b: belief_margins(
+                                b[:V], domain_mask[:V]))(beliefs)
+                        return jax.vmap(
+                            lambda m, f: decimation_select(
+                                m, f, elig, p_frac))(margins, frozen)
+
+                newly = jax.lax.cond(
+                    do, _on, lambda _: jnp.zeros_like(frozen), None)
+                frozen2 = jnp.logical_or(frozen, newly)
+                pin2 = jnp.where(newly, sel, pin)
+                b_loc = frozen2.shape[0]
+                froz_full = jnp.concatenate(
+                    [frozen2, jnp.zeros((b_loc, 1), bool)], axis=1)
+                pin_full = jnp.concatenate(
+                    [pin2, jnp.zeros((b_loc, 1), jnp.int32)], axis=1)
+                froz_e = froz_full[:, edge_var]         # (B, E)
+                pin_e = pin_full[:, edge_var]
+                clamp = jnp.where(
+                    jnp.arange(D)[None, None, :] == pin_e[..., None],
+                    0.0, BIG)
+                q2 = jnp.where(froz_e[..., None],
+                               clamp.astype(q2.dtype), q2)
+                sel = jnp.where(frozen2, pin2, sel)
+            else:
+                frozen2, pin2 = frozen, pin
+            # convergence delta AFTER the clamp (single-chip order)
+            mask_e = domain_mask[edge_var]
+            if E and (self.stability > 0 or self._telemetry_delta):
+                delta_b = jnp.max(jnp.where(
+                    mask_e[None], jnp.abs(q2 - q), 0.0), axis=(1, 2))
+                delta = jax.lax.pmax(delta_b, "tp")
+            else:
+                delta = jnp.zeros((q.shape[0],), jnp.float32)
+            return q2, r2, sel, delta, frozen2, pin2, pruned
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(
+                P("dp", "tp"), P("dp", "tp"), P(),
+                P("dp"), P("dp"), P(),
+                P("tp"),
+                [P("tp") for _ in self.buckets],
+                P(), P(), P(),
+                P("tp"),  # bnb plan leaves (empty list without bnb)
+            ),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp"),
+                       P("dp"), P("dp"), P("dp")),
+            # the replication checker has no rule for pallas calls or
+            # for the pruned sweep's lax.while_loop — disable it for
+            # those programs (the specs above still shard correctly)
+            check_vma=not (self._bnb_active
+                           or (self.layout == "lane_major"
+                               and self.use_pallas)),
+        )
+        def sharded(q, r, key, frozen, pin, cycle, edge_var, cubes,
+                    var_costs, domain_mask, domain_size, plans):
+            local_plans = [
+                None if pl is None else PrunedPlan(
+                    pl.digits[0], pl.cube_cells[0], pl.suffix_min[0],
+                    pl.block, pl.n_blocks, pl.n_cells)
+                for pl in plans]
+            q2, r2, sel, delta, frozen2, pin2, pruned = local_step(
+                q[:, 0], r[:, 0], key, frozen, pin, cycle,
+                edge_var[0], [c[0] for c in cubes],
+                var_costs, domain_mask, domain_size, local_plans)
+            return (q2[:, None], r2[:, None], sel, delta,
+                    frozen2, pin2, pruned)
+
+        self._step = jax.jit(sharded)
+
     # -------------------------------------------------------------- run
 
     def _step_args(self, consts):
         """The constant tail of a ``_step`` call — layout subclasses
         carry different constants through the same run loop."""
-        return (consts["edge_var"], consts["cubes"],
+        args = (consts["edge_var"], consts["cubes"],
                 consts["var_costs"], consts["domain_mask"],
                 consts["domain_size"])
+        if self._features_on():
+            args = args + (consts.get("bnb_plans", []),)
+        return args
+
+    def _dummy_feature_state(self):
+        """Placeholder ``(frozen, pin)`` planes for bnb-only runs: the
+        extended step signature carries them uniformly, the decimation
+        branch never reads them."""
+        return (jnp.zeros((self.B, self.V), dtype=bool),
+                jnp.zeros((self.B, self.V), dtype=jnp.int32))
 
     def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
         """Map the step's selection output to ORIGINAL variable order
@@ -455,6 +736,14 @@ class ShardedMaxSum(MeshSolverMixin):
         })
         if self._telemetry_delta:
             state["delta"] = jnp.float32(0)
+        if self.decimation:
+            dp_sh = NamedSharding(self.mesh, P("dp"))
+            state["frozen"] = jax.device_put(
+                np.zeros((self.B, self.V), dtype=bool), dp_sh)
+            state["pin"] = jax.device_put(
+                np.zeros((self.B, self.V), dtype=np.int32), dp_sh)
+        if self._bnb_active:
+            state["pruned"] = jnp.float32(0)
         return state
 
     def mesh_step(self, s):
@@ -463,8 +752,15 @@ class ShardedMaxSum(MeshSolverMixin):
         message delta below the stability threshold) evaluated on
         device — the exact arithmetic of the eager host loop."""
         key, sub = jax.random.split(s["key"])
-        q, r, sel, delta = self._step(
-            s["q"], s["r"], sub, *self._step_args(self._consts()))
+        if self._features_on():
+            frozen, pin = (s["frozen"], s["pin"]) if self.decimation \
+                else self._dummy_feature_state()
+            q, r, sel, delta, frozen2, pin2, pruned = self._step(
+                s["q"], s["r"], sub, frozen, pin, s["cycle"],
+                *self._step_args(self._consts()))
+        else:
+            q, r, sel, delta = self._step(
+                s["q"], s["r"], sub, *self._step_args(self._consts()))
         stable = jnp.logical_and(
             jnp.all(sel == s["sel"]),
             jnp.max(delta) < jnp.float32(self.stability))
@@ -475,6 +771,11 @@ class ShardedMaxSum(MeshSolverMixin):
                    finished=same >= SAME_COUNT)
         if "delta" in s:
             out["delta"] = jnp.max(delta)
+        if self.decimation:
+            out["frozen"] = frozen2
+            out["pin"] = pin2
+        if self._bnb_active:
+            out["pruned"] = jnp.mean(pruned)
         return out
 
     def mesh_residual(self, s_prev, s_next):
@@ -568,9 +869,20 @@ class ShardedMaxSum(MeshSolverMixin):
         cycle = 0
         sel = None
         self.finished = False
+        features = self._features_on()
+        if features:
+            dp_sh = NamedSharding(self.mesh, P("dp"))
+            frozen = jax.device_put(
+                np.zeros((self.B, self.V), dtype=bool), dp_sh)
+            pin = jax.device_put(
+                np.zeros((self.B, self.V), dtype=np.int32), dp_sh)
         while cycle < n_cycles:
             key, sub = jax.random.split(key)
-            q, r, sel, delta = self._step(q, r, sub, *args)
+            if features:
+                q, r, sel, delta, frozen, pin, _pruned = self._step(
+                    q, r, sub, frozen, pin, jnp.int32(cycle), *args)
+            else:
+                q, r, sel, delta = self._step(q, r, sub, *args)
             cycle += 1
             sel_h = np.asarray(jax.device_get(sel))
             delta_h = float(np.max(np.asarray(jax.device_get(delta))))
@@ -593,8 +905,15 @@ class ShardedMaxSum(MeshSolverMixin):
         """One sharded step (for compile-checking the multi-chip path)."""
         state, consts = self._device_put()
         args = self._step_args(consts)
-        q, r, sel, _delta = self._step(
-            state["q"], state["r"], jax.random.PRNGKey(seed), *args)
+        if self._features_on():
+            frozen, pin = self._dummy_feature_state()
+            out = self._step(state["q"], state["r"],
+                             jax.random.PRNGKey(seed), frozen, pin,
+                             jnp.int32(0), *args)
+        else:
+            out = self._step(state["q"], state["r"],
+                             jax.random.PRNGKey(seed), *args)
+        sel = out[2]
         jax.block_until_ready(sel)
         return self._decode_sel(np.asarray(jax.device_get(sel)))
 
@@ -627,23 +946,35 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  damping: float = 0.5, damping_nodes: str = "vars",
                  stability: float = 0.1, noise: float = 0.0,
-                 batch: int = 1, precision=None):
-        from ..ops.pallas_kernels import NARY_FAST_MAX_CELLS
+                 batch: int = 1, precision=None,
+                 decimation_p: float = 0.0, decimation_every: int = 0,
+                 bnb: bool = False):
+        from ..ops.pallas_kernels import (NARY_FALLBACK_TEXT,
+                                          nary_fast_eligible)
 
+        if bnb:
+            # loud rejection, never a silent downgrade: the fused mesh
+            # layout's slot-assembly factor update has no pruned twin
+            # (the lane/edge mesh layouts and every single-chip layout
+            # do) — route bnb runs through layout lane_major/edge_major
+            raise ValueError(
+                "the fused mesh layout does not support bnb pruned "
+                "reductions; use -p layout:lane_major or edge_major "
+                "for branch-and-bound mesh runs")
         # binary buckets are unconditional (no hypercube unroll); the
-        # cell gate bounds only the n-ary lane-major sweep — mirrors
-        # MaxSumFusedSolver.eligible
-        if any(b.arity < 2 or (
-                b.arity > 2 and
-                arrays.max_domain ** b.arity > NARY_FAST_MAX_CELLS)
+        # shared (env-overridable) cell gate bounds only the n-ary
+        # lane-major sweep — mirrors MaxSumFusedSolver.eligible
+        if any(b.arity < 2
+               or not nary_fast_eligible(arrays.max_domain, b.arity)
                for b in arrays.buckets):
             raise ValueError(
                 "the fused mesh layout needs factor arities >= 2 — "
                 "fold unary constraints into variable costs first "
-                "(filter_dcop) — with arity >= 3 hypercubes under the "
-                "unroll threshold (D**arity <= NARY_FAST_MAX_CELLS)")
+                f"(filter_dcop) — with {NARY_FALLBACK_TEXT}")
         self._init_params(arrays, mesh, damping, damping_nodes,
-                          stability, noise, batch, precision=precision)
+                          stability, noise, batch, precision=precision,
+                          decimation_p=decimation_p,
+                          decimation_every=decimation_every)
         self.layout = "fused"
         self.use_pallas = False
         self._build_fused_shards(arrays)
@@ -713,6 +1044,16 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
                 np.asarray(arrays.domain_size)[slot_var], 1)
                 .astype(np.float32),
             "var_pos": var_pos,
+            # decimation constants, SORTED variable order: per-slot
+            # sorted-variable owner (the freeze clamp's map) and the
+            # per-sorted-variable domain size (freeze eligibility)
+            "slot_sorted_var": np.repeat(
+                np.arange(V), np.concatenate(
+                    [[k] * nv for _o, _v, nv, k in kbuckets]).astype(
+                        np.int64)).astype(np.int32) if kbuckets
+            else np.zeros(0, np.int32),
+            "dsize_sorted": np.asarray(
+                arrays.domain_size)[var_order].astype(np.int32),
         }
 
         if not self._all_binary:
@@ -801,6 +1142,11 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             "slot_dsize": jax.device_put(
                 jnp.asarray(n["slot_dsize"]), rep),
         }
+        if self.decimation:
+            consts["slot_sorted_var"] = jax.device_put(
+                jnp.asarray(n["slot_sorted_var"]), rep)
+            consts["dsize_sorted"] = jax.device_put(
+                jnp.asarray(n["dsize_sorted"]), rep)
         if self._all_binary:
             consts["partner_slot"] = jax.device_put(
                 n["partner_slot"], tp_sh)
@@ -822,25 +1168,30 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
 
     def _step_args(self, consts):
         if self._all_binary:
-            return (consts["partner_slot"], consts["cube_slotT"],
+            args = (consts["partner_slot"], consts["cube_slotT"],
                     consts["emask"], consts["var_costsT_sorted"],
                     consts["domain_maskT_sorted"], consts["slot_dsize"])
-        return (consts["pos_slots"], consts["cubesT"],
-                consts["slot_src"], consts["emask"],
-                consts["var_costsT_sorted"],
-                consts["domain_maskT_sorted"], consts["slot_dsize"])
+        else:
+            args = (consts["pos_slots"], consts["cubesT"],
+                    consts["slot_src"], consts["emask"],
+                    consts["var_costsT_sorted"],
+                    consts["domain_maskT_sorted"],
+                    consts["slot_dsize"])
+        if self._features_on():  # fused: decimation only (bnb rejected)
+            args = args + (consts["slot_sorted_var"],
+                           consts["dsize_sorted"])
+        return args
 
     def _decode_sel(self, sel_np: np.ndarray) -> np.ndarray:
         return sel_np[:, self._np["var_pos"]]
 
     # ------------------------------------------------------------ step
 
-    def _fused_cycle_tail(self, q1, r1, k1, new_r, emask, vcT, dmT,
-                          dsize):
-        """Everything after the factor update — shared by the binary
-        (slot-aligned single-gather) and n-ary (arity-bucketed) factor
-        updates so the two modes can never diverge on variable-update
-        or convergence semantics."""
+    def _fused_cycle_core(self, q1, r1, k1, new_r, emask, vcT, dsize):
+        """The variable-update body shared by ALL fused step variants
+        (binary/n-ary, plain/decimated): masking, damping, the static
+        per-bucket partial sums + one psum, mean normalization, noise.
+        Returns ``(q_new, new_r, belief)``."""
         D = self.D
         damping, damping_nodes = self.damping, self.damping_nodes
         noise = self.noise
@@ -877,15 +1228,80 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
         if damping_nodes in ("vars", "both") and damping > 0:
             q_new = damping * q1 + (1 - damping) * q_new
         q_new = jnp.where(emask, q_new, BIG)
-        sel = jnp.argmin(
+        return q_new, new_r, belief
+
+    def _fused_select(self, belief, dmT):
+        return jnp.argmin(
             jnp.where(dmT, belief, jnp.asarray(SENTINEL, belief.dtype)),
             axis=0)
+
+    def _fused_cycle_tail(self, q1, r1, k1, new_r, emask, vcT, dmT,
+                          dsize):
+        """Everything after the factor update — shared by the binary
+        (slot-aligned single-gather) and n-ary (arity-bucketed) factor
+        updates so the two modes can never diverge on variable-update
+        or convergence semantics."""
+        q_new, new_r, belief = self._fused_cycle_core(
+            q1, r1, k1, new_r, emask, vcT, dsize)
+        sel = self._fused_select(belief, dmT)
         if self.EP and (self.stability > 0 or self._telemetry_delta):
             delta = jax.lax.pmax(jnp.max(jnp.where(
                 emask, jnp.abs(q_new - q1), 0.0)), "tp")
         else:
             delta = jnp.float32(0)
         return q_new, new_r, sel, delta
+
+    def _fused_cycle_tail_ext(self, q1, r1, k1, new_r, emask, vcT,
+                              dmT, dsize):
+        """The decimated variant's per-instance tail: same core, but
+        the convergence delta moves AFTER the freeze clamp (computed
+        in ``_fused_features_tail``) and the belief is returned for
+        the margin computation."""
+        q_new, new_r, belief = self._fused_cycle_core(
+            q1, r1, k1, new_r, emask, vcT, dsize)
+        return q_new, new_r, self._fused_select(belief, dmT), belief
+
+    def _fused_features_tail(self, q_old, q2, r2, sel, beliefs,
+                             frozen, pin, cycle, emask, dmT,
+                             slot_sorted_var, dsize_sorted):
+        """Post-vmap decimation for the fused mesh layouts: freeze
+        events in a scalar ``lax.cond`` (skipped entirely off-event),
+        the per-slot clamp through the sorted-owner map, and the
+        convergence delta on the clamped messages — all in SORTED
+        variable order, like the carry."""
+        D = self.D
+        do = ((cycle + 1) % self.decimation_every) == 0
+        elig = dsize_sorted > 1
+
+        def _on(_):
+            with jax.named_scope("maxsum/decimation"):
+                margins = jax.vmap(
+                    lambda b: belief_margins(b, dmT, axis=0))(beliefs)
+                return jax.vmap(
+                    lambda m, f: decimation_select(
+                        m, f, elig, self.decimation_p))(margins,
+                                                        frozen)
+
+        newly = jax.lax.cond(
+            do, _on, lambda _: jnp.zeros_like(frozen), None)
+        frozen2 = jnp.logical_or(frozen, newly)
+        pin2 = jnp.where(newly, sel, pin)
+        froz_slot = frozen2[:, slot_sorted_var]         # (B, EP)
+        pin_slot = pin2[:, slot_sorted_var]
+        clamp = jnp.where(
+            jnp.arange(D)[None, :, None] == pin_slot[:, None, :],
+            0.0, BIG)
+        q2 = jnp.where(froz_slot[:, None, :],
+                       clamp.astype(q2.dtype), q2)
+        sel = jnp.where(frozen2, pin2, sel)
+        if self.EP and (self.stability > 0 or self._telemetry_delta):
+            delta = jax.lax.pmax(jnp.max(jnp.where(
+                emask[None], jnp.abs(q2 - q_old), 0.0),
+                axis=(1, 2)), "tp")
+        else:
+            delta = jnp.zeros((q2.shape[0],), jnp.float32)
+        pruned = jnp.zeros((q2.shape[0],), jnp.float32)
+        return q2, r2, sel, delta, frozen2, pin2, pruned
 
     def _keys_for(self, key, n):
         """Per-instance keys, differing across dp shards (parity with
@@ -902,6 +1318,10 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
             self._build_step_nary()
 
     def _build_step_binary(self):
+        if self._features_on():  # fused: decimation only (bnb rejected)
+            self._build_step_binary_features()
+            return
+
         def local_step(q, r, key, partner, cube, emask, vcT, dmT,
                        dsize):
             # q, r: (B_loc, D, EP) shard-local var-sorted slots
@@ -928,7 +1348,51 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
 
         self._step = jax.jit(sharded)
 
+    def _build_step_binary_features(self):
+        """The decimated binary fused step: the identical slot-aligned
+        factor update, then the shared features tail (freeze events,
+        per-slot clamp, post-clamp delta) — signature extended by
+        ``(frozen, pin, cycle)`` in and ``(frozen, pin, pruned)``
+        out, like the lane/edge mesh layouts."""
+        def local_step(q, r, key, frozen, pin, cycle, partner, cube,
+                       emask, vcT, dmT, dsize, ssv, dss):
+            def one(q1, r1, k1):
+                q_part = q1[:, partner]
+                new_r = jnp.min(cube + q_part[:, None, :], axis=0)
+                return self._fused_cycle_tail_ext(
+                    q1, r1, k1, new_r, emask, vcT, dmT, dsize)
+
+            keys = self._keys_for(key, q.shape[0])
+            q2, r2, sel, beliefs = jax.vmap(one)(q, r, keys)
+            return self._fused_features_tail(
+                q, q2, r2, sel, beliefs, frozen, pin, cycle, emask,
+                dmT, ssv, dss)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
+                      P("dp"), P("dp"), P(),
+                      P("tp"), P("tp"), P("tp"), P(), P(), P(),
+                      P(), P()),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp"),
+                       P("dp"), P("dp"), P("dp")),
+        )
+        def sharded(q, r, key, frozen, pin, cycle, partner, cube,
+                    emask, vcT, dmT, dsize, ssv, dss):
+            q2, r2, sel, delta, frozen2, pin2, pruned = local_step(
+                q[:, 0], r[:, 0], key, frozen, pin, cycle,
+                partner[0], cube[0], emask[0], vcT, dmT, dsize,
+                ssv, dss)
+            return (q2[:, None], r2[:, None], sel, delta,
+                    frozen2, pin2, pruned)
+
+        self._step = jax.jit(sharded)
+
     def _build_step_nary(self):
+        if self._features_on():
+            self._build_step_nary_features()
+            return
+
         from ..ops.pallas_kernels import factor_messages_lane_major
 
         D = self.D
@@ -976,6 +1440,60 @@ MaxSumFusedSolver`: a factor's two endpoint slots always live on the
 
         self._step = jax.jit(sharded)
 
+    def _build_step_nary_features(self):
+        """The decimated n-ary fused step: identical arity-bucketed
+        slot-space factor update, then the shared features tail."""
+        from ..ops.pallas_kernels import factor_messages_lane_major
+
+        D = self.D
+        nb = len(self._np["pos_slots"])
+
+        def local_step(q, r, key, frozen, pin, cycle, pos_slots,
+                       cubesT, slot_src, emask, vcT, dmT, dsize, ssv,
+                       dss):
+            def one(q1, r1, k1):
+                blocks = []
+                for ps, cu in zip(pos_slots, cubesT):
+                    a = cu.ndim - 1
+                    f = cu.shape[-1]
+                    q_in = [q1[:, ps[p]] for p in range(a)]
+                    msgs = factor_messages_lane_major(cu, q_in, a)
+                    blocks.append(jnp.stack(msgs, axis=2)
+                                  .reshape(D, a * f))
+                m = blocks[0] if len(blocks) == 1 else \
+                    jnp.concatenate(blocks, axis=1)
+                m = jnp.concatenate(
+                    [m, jnp.zeros((D, 1), m.dtype)], axis=1)
+                new_r = m[:, slot_src]
+                return self._fused_cycle_tail_ext(
+                    q1, r1, k1, new_r, emask, vcT, dmT, dsize)
+
+            keys = self._keys_for(key, q.shape[0])
+            q2, r2, sel, beliefs = jax.vmap(one)(q, r, keys)
+            return self._fused_features_tail(
+                q, q2, r2, sel, beliefs, frozen, pin, cycle, emask,
+                dmT, ssv, dss)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P("dp", "tp"), P("dp", "tp"), P(),
+                      P("dp"), P("dp"), P(),
+                      [P("tp")] * nb, [P("tp")] * nb, P("tp"),
+                      P("tp"), P(), P(), P(), P(), P()),
+            out_specs=(P("dp", "tp"), P("dp", "tp"), P("dp"), P("dp"),
+                       P("dp"), P("dp"), P("dp")),
+        )
+        def sharded(q, r, key, frozen, pin, cycle, pos_slots, cubesT,
+                    slot_src, emask, vcT, dmT, dsize, ssv, dss):
+            q2, r2, sel, delta, frozen2, pin2, pruned = local_step(
+                q[:, 0], r[:, 0], key, frozen, pin, cycle,
+                [p[0] for p in pos_slots], [c[0] for c in cubesT],
+                slot_src[0], emask[0], vcT, dmT, dsize, ssv, dss)
+            return (q2[:, None], r2[:, None], sel, delta,
+                    frozen2, pin2, pruned)
+
+        self._step = jax.jit(sharded)
+
 
 class ShardedAMaxSum(ShardedMaxSum):
     """Asynchronous MaxSum over the mesh: each cycle an independent
@@ -985,6 +1503,15 @@ class ShardedAMaxSum(ShardedMaxSum):
 
     def __init__(self, arrays: FactorGraphArrays, mesh,
                  activation: float = 0.7, **kwargs):
+        if float(kwargs.get("decimation_p", 0) or 0) != 0:
+            # the same loud rejection as the single-chip AMaxSumSolver:
+            # the stochastic activation mask below re-admits PRE-freeze
+            # messages on non-activated edges, silently undoing the
+            # frozen-variable clamp decimation depends on
+            raise ValueError(
+                "amaxsum does not support decimation: stochastic edge "
+                "activation re-admits pre-freeze messages, undoing the "
+                "frozen-variable clamp; use maxsum for decimated runs")
         self.activation = float(activation)
         super().__init__(arrays, mesh, **kwargs)
 
@@ -1018,10 +1545,21 @@ class ShardedAMaxSum(ShardedMaxSum):
 
         mask_update = jax.jit(mask_update)
 
-        def step(q, r, key, *args):
-            q_new, r_new, sel, delta = base_step(q, r, key, *args)
-            q2, r2 = mask_update(q_new, r_new, key, q, r)
-            return q2, r2, sel, delta
+        if self._features_on():
+            # bnb only (decimation is rejected at __init__): the
+            # extended signature flows through, the activation mask
+            # still touches just the message planes
+            def step(q, r, key, frozen, pin, cycle, *args):
+                (q_new, r_new, sel, delta, frozen2, pin2,
+                 pruned) = base_step(q, r, key, frozen, pin, cycle,
+                                     *args)
+                q2, r2 = mask_update(q_new, r_new, key, q, r)
+                return q2, r2, sel, delta, frozen2, pin2, pruned
+        else:
+            def step(q, r, key, *args):
+                q_new, r_new, sel, delta = base_step(q, r, key, *args)
+                q2, r2 = mask_update(q_new, r_new, key, q, r)
+                return q2, r2, sel, delta
 
         self._step = step
 
@@ -1049,6 +1587,25 @@ maxsum_dynamic.DynamicMaxSumSolver` (reference maxsum_dynamic.py:40-186):
     """
 
     def __init__(self, arrays: FactorGraphArrays, mesh, **kwargs):
+        if kwargs.get("bnb"):
+            # same loud rejection as the single-chip dynamic solver:
+            # bnb plans are build-time constants of the cube CONTENTS
+            # and this class swaps cubes between steps — a swap would
+            # leave the plans silently stale
+            raise ValueError(
+                "maxsum_dynamic does not support bnb: pruned-reduction "
+                "plans are build-time cube constants and factor tables "
+                "are host-swappable here; use the static maxsum solver")
+        if float(kwargs.get("decimation_p", 0) or 0) != 0:
+            # the session driver (step_cycles) deliberately keeps the
+            # historical 4-output step; a freeze plane across host
+            # cube swaps would also pin variables against a problem
+            # that no longer exists
+            raise ValueError(
+                "maxsum_dynamic does not support decimation: frozen "
+                "variables would stay pinned across host factor "
+                "swaps; use the static maxsum solver for decimated "
+                "runs")
         super().__init__(arrays, mesh, **kwargs)
         self.arrays = arrays
         # factor name -> (bucket index, bucket row, tp shard, shard row)

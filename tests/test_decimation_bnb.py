@@ -1,0 +1,496 @@
+"""Decimated + branch-and-bound Max-Sum (ISSUE 6).
+
+Layers under test:
+
+* ``ops/kernels.py`` — ``build_pruned_plan`` / ``factor_messages_pruned``
+  (bound-sorted early-out reductions, bit-exact vs the full scan, f32
+  AND bf16) and the decimation primitives (``belief_margins``,
+  ``decimation_select``);
+* ``algorithms/maxsum.py`` — solver-level ``decimation_p`` /
+  ``decimation_every`` / ``bnb`` knobs, freeze-plane mechanics, the
+  loud rejections on solvers the features cannot compose with;
+* ``engine/`` + ``parallel/`` — the off-by-default bit-exactness guard
+  (disabled == today's solver: selections AND convergence cycles)
+  across the sharded families and the fused hetero campaign path, and
+  the loopy-graph regression decimation exists for;
+* ``observability/`` — the ``freezes`` / ``pruned`` telemetry planes;
+* ``ops/pallas_kernels.py`` — the ONE fast-path eligibility predicate
+  and its ``PYDCOP_TPU_NARY_MAX_CELLS`` override.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.generators.fast import (coloring_factor_arrays,
+                                        coloring_hypergraph_arrays,
+                                        nary_factor_arrays)
+
+pytestmark = pytest.mark.decim
+
+
+def _nary_arrays(seed=3, n_vars=20, count=10, arity=3, D=6):
+    """A mixed-size n-ary instance whose cubes clear BNB_MIN_CELLS
+    (6**3 = 216 cells), so pruned plans actually build."""
+    return nary_factor_arrays(n_vars, {arity: count}, n_values=D,
+                              seed=seed)
+
+
+# ----------------------------------------------------- knob validation
+
+
+def test_normalize_decimation_validation():
+    from pydcop_tpu.algorithms.maxsum import (DECIMATION_DEFAULT_EVERY,
+                                              normalize_decimation)
+
+    assert normalize_decimation(0.0, 0) == (
+        0.0, False, DECIMATION_DEFAULT_EVERY)
+    assert normalize_decimation(0.25, 8) == (0.25, True, 8)
+    # every=0 means "default", not "never"
+    p, enabled, every = normalize_decimation(0.1, 0)
+    assert enabled and every == DECIMATION_DEFAULT_EVERY
+    with pytest.raises(ValueError, match="decimation_p"):
+        normalize_decimation(1.5, 0)
+    with pytest.raises(ValueError, match="decimation_p"):
+        normalize_decimation(-0.1, 0)
+    with pytest.raises(ValueError, match="decimation_every"):
+        normalize_decimation(0.2, -3)
+
+
+def test_parse_decimation_flag():
+    from pydcop_tpu.commands import CliError
+    from pydcop_tpu.commands.solve import parse_decimation_flag
+
+    assert parse_decimation_flag(None) is None
+    p, every = parse_decimation_flag("0.2")
+    assert p == pytest.approx(0.2) and every >= 1
+    assert parse_decimation_flag("0.1:8") == (pytest.approx(0.1), 8)
+    with pytest.raises(CliError):
+        parse_decimation_flag("2.0")
+    with pytest.raises(CliError):
+        parse_decimation_flag("0")  # p == 0: omit the flag instead
+    with pytest.raises(CliError):
+        parse_decimation_flag("nope")
+
+
+# ------------------------------------- pruned-reduction equivalence
+
+
+@pytest.mark.parametrize("arity,D", [(3, 6), (4, 4)])
+def test_pruned_reduction_equals_full_scan_f32(arity, D):
+    """Bound-sorted early-out min/argmin == the full scan, bit-exact,
+    on random cubes (the while_loop never skips a cell that could
+    still win)."""
+    from pydcop_tpu.ops.kernels import (build_pruned_plan,
+                                        device_pruned_plan,
+                                        factor_messages,
+                                        factor_messages_pruned)
+
+    rng = np.random.default_rng(arity * 10 + D)
+    F = 7
+    cubes = rng.uniform(0, 5, size=(F,) + (D,) * arity) \
+        .astype(np.float32)
+    q = [jnp.asarray(rng.uniform(0, 1, size=(F, D)).astype(np.float32))
+         for _ in range(arity)]
+    plan = build_pruned_plan(cubes)
+    assert plan is not None and plan.n_cells == D ** arity
+    dev = device_pruned_plan(plan, jnp.float32)
+    pruned, blocks_run = factor_messages_pruned(dev, q)
+    full = factor_messages(jnp.asarray(cubes), q)
+    assert int(blocks_run) <= plan.n_blocks
+    for p in range(arity):
+        mp, mf = np.asarray(pruned[p]), np.asarray(full[p])
+        assert np.array_equal(mp, mf), f"position {p}"
+        # min AND argmin agree (selection decode reads the argmin)
+        assert np.array_equal(mp.argmin(axis=-1), mf.argmin(axis=-1))
+
+
+@pytest.mark.parametrize("seed", [9, 17, 42])
+def test_pruned_reduction_equals_full_scan_bf16(seed):
+    """The precision-policy contract: the plan is built from the RAW
+    f32 cubes (what the solvers pass), ``device_pruned_plan`` rounds
+    the cells to the bf16 store dtype AND recomputes the suffix
+    bounds from the ROUNDED values — an f32-derived bound can sit
+    above the stored floor (bf16 rounds to nearest, i.e. sometimes
+    down) and early-out past a winning cell.  Pruned == full scan
+    bit-exactly on the stored values."""
+    from pydcop_tpu.ops.kernels import (build_pruned_plan,
+                                        device_pruned_plan,
+                                        factor_messages,
+                                        factor_messages_pruned,
+                                        pruned_suffix_min)
+
+    rng = np.random.default_rng(seed)
+    F, D, arity = 5, 6, 3
+    raw = rng.uniform(0, 5, size=(F,) + (D,) * arity) \
+        .astype(np.float32)
+    plan = build_pruned_plan(raw)           # f32 build values
+    dev = device_pruned_plan(plan, jnp.bfloat16)
+    # the device bounds are the ROUNDED values' suffix minima, not a
+    # copy of the f32 build bounds
+    assert np.array_equal(
+        np.asarray(dev.suffix_min),
+        pruned_suffix_min(np.asarray(dev.cube_cells,
+                                     dtype=np.float32),
+                          plan.block, plan.n_blocks))
+    stored = jnp.asarray(raw).astype(jnp.bfloat16)  # full-scan leg
+    q = [jnp.asarray(rng.uniform(0, 1, size=(F, D)).astype(np.float32))
+         for _ in range(arity)]
+    pruned, _ = factor_messages_pruned(dev, q)
+    full = factor_messages(stored, q)
+    for p in range(arity):
+        assert np.array_equal(
+            np.asarray(pruned[p], dtype=np.float32),
+            np.asarray(full[p], dtype=np.float32)), f"position {p}"
+
+
+def test_pruned_plan_gates():
+    """Tiny cubes and binary buckets never build plans: they stay on
+    the historically-benched unrolled kernels."""
+    from pydcop_tpu.ops.kernels import BNB_MIN_CELLS, build_pruned_plan
+
+    rng = np.random.default_rng(0)
+    # arity 2: out, regardless of size
+    assert build_pruned_plan(
+        rng.uniform(size=(4, 30, 30)).astype(np.float32)) is None
+    # arity 3 but under the cell floor: out
+    small = rng.uniform(size=(4, 3, 3, 3)).astype(np.float32)
+    assert 27 < BNB_MIN_CELLS and build_pruned_plan(small) is None
+    # empty bucket: out
+    assert build_pruned_plan(
+        np.zeros((0, 6, 6, 6), np.float32)) is None
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_bnb_solver_bit_exact(precision):
+    """Solver-level guard: bnb on == bnb off, message planes AND
+    selections, in both precision policies (bounds compare in the
+    accum dtype)."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+
+    arrays = _nary_arrays()
+    a = MaxSumSolver(arrays, damping=0.5, precision=precision)
+    b = MaxSumSolver(arrays, damping=0.5, precision=precision,
+                     bnb=True)
+    assert b._bnb_active
+    sa = a.init_state(jax.random.PRNGKey(0))
+    sb = b.init_state(jax.random.PRNGKey(0))
+    step_a, step_b = jax.jit(a.step), jax.jit(b.step)
+    for _ in range(12):
+        sa, sb = step_a(sa), step_b(sb)
+    assert np.array_equal(
+        np.asarray(sa["q"], dtype=np.float32),
+        np.asarray(sb["q"], dtype=np.float32))
+    assert np.array_equal(np.asarray(a.assignment_indices(sa)),
+                          np.asarray(b.assignment_indices(sb)))
+    # the bnb carry reports a pruned-cell fraction in [0, 1]
+    assert 0.0 <= float(sb["pruned"]) <= 1.0
+
+
+# ------------------------------------------------- decimation mechanics
+
+
+def test_decimation_freeze_monotone_and_pinned():
+    """The freeze plane only grows, and a frozen variable's selection
+    never changes after its freeze cycle."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+
+    arrays = coloring_factor_arrays(40, 120, 3, seed=5, noise=0.05)
+    solver = MaxSumLaneSolver(arrays, damping=0.5, decimation_p=0.25,
+                              decimation_every=4)
+    s = solver.init_state(jax.random.PRNGKey(0))
+    step = jax.jit(solver.step)
+    prev_frozen = np.zeros(arrays.n_vars, dtype=bool)
+    prev_sel = None
+    for _ in range(24):
+        s = step(s)
+        frozen = np.asarray(s["frozen"])
+        sel = np.asarray(solver.assignment_indices(s))
+        # monotone: no variable ever unfreezes
+        assert np.all(frozen[prev_frozen])
+        if prev_sel is not None:
+            # pinned: selections of previously-frozen variables hold
+            assert np.array_equal(sel[prev_frozen],
+                                  prev_sel[prev_frozen])
+        prev_frozen, prev_sel = frozen, sel
+    assert prev_frozen.sum() > 0  # events actually fired
+
+
+def test_decimation_loopy_graph_regression():
+    """The reason decimation exists: on a dense frustrated coloring
+    instance undamped Max-Sum oscillates through the whole horizon,
+    while the decimated run settles (strictly fewer cycles to the last
+    selection change)."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+
+    arrays = coloring_factor_arrays(30, 90, 3, seed=2, noise=0.02)
+    horizon = 60
+
+    def last_change(solver):
+        s = solver.init_state(jax.random.PRNGKey(0))
+        step = jax.jit(solver.step)
+        prev, last = None, 0
+        for c in range(1, horizon + 1):
+            s = step(s)
+            sel = np.asarray(solver.assignment_indices(s))
+            if prev is not None and not np.array_equal(sel, prev):
+                last = c
+            prev = sel
+        return last
+
+    plain = last_change(MaxSumLaneSolver(arrays, damping=0.0))
+    decim = last_change(MaxSumLaneSolver(
+        arrays, damping=0.0, decimation_p=0.15, decimation_every=5))
+    # plain oscillates into the tail of the horizon...
+    assert plain >= horizon - 5, plain
+    # ...decimation pins the instance down, strictly earlier
+    assert decim < plain, (decim, plain)
+
+
+def test_decimation_converges_engine_run():
+    """Through the SyncEngine: the decimated run reaches the stability
+    stop on an instance the plain run never settles within the
+    budget."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    arrays = coloring_factor_arrays(30, 90, 3, seed=2, noise=0.02)
+    plain = SyncEngine(MaxSumLaneSolver(arrays, damping=0.0)) \
+        .run(max_cycles=40)
+    decim = SyncEngine(MaxSumLaneSolver(
+        arrays, damping=0.0, decimation_p=0.15, decimation_every=5)) \
+        .run(max_cycles=40)
+    assert decim.cycles < plain.cycles
+
+
+# --------------------------------------- off-by-default bit-exactness
+
+
+def test_engine_off_is_bit_exact():
+    """decimation_p=0 + bnb=False == the flags never given: same
+    selections AND same convergence cycle through the single-chip
+    engine."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumLaneSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+
+    arrays = coloring_factor_arrays(30, 90, 3, seed=2, noise=0.02)
+    base = SyncEngine(MaxSumLaneSolver(arrays, damping=0.5)) \
+        .run(max_cycles=40)
+    off = SyncEngine(MaxSumLaneSolver(
+        arrays, damping=0.5, decimation_p=0.0, decimation_every=0,
+        bnb=False)).run(max_cycles=40)
+    assert base.assignment == off.assignment
+    assert base.cycles == off.cycles
+
+
+@pytest.mark.mesh
+def test_sharded_maxsum_family_off_is_bit_exact():
+    """The three maxsum-family mesh solvers: explicit feature-off
+    kwargs compile the EXACT pre-feature step (selections AND cycles
+    equal the default construction)."""
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_maxsum import (ShardedAMaxSum,
+                                                    ShardedFusedMaxSum,
+                                                    ShardedMaxSum)
+
+    mesh = make_mesh(8)
+    arrays = coloring_factor_arrays(40, 120, 3, seed=5, noise=0.05)
+    off_kw = dict(decimation_p=0.0, decimation_every=0)
+    for cls, kw in ((ShardedMaxSum, dict(off_kw, bnb=False)),
+                    (ShardedFusedMaxSum, dict(off_kw, bnb=False)),
+                    (ShardedAMaxSum, off_kw)):
+        base = cls(arrays, mesh, batch=4, damping=0.5)
+        off = cls(arrays, mesh, batch=4, damping=0.5, **kw)
+        assert not off._features_on(), cls.__name__
+        sel_b, cyc_b = base.run(15, seed=0)
+        sel_o, cyc_o = off.run(15, seed=0)
+        assert np.array_equal(sel_b, sel_o), cls.__name__
+        assert cyc_b == cyc_o, cls.__name__
+
+
+@pytest.mark.mesh
+def test_untouched_sharded_families_reject_feature_kwargs():
+    """The localsearch/mgm2/breakout families never grew the feature
+    kwargs — passing them is a loud TypeError, not a silent no-op, so
+    a campaign config cannot believe it decimated a dsa run."""
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_breakout import ShardedDba
+    from pydcop_tpu.parallel.sharded_localsearch import ShardedDsa
+    from pydcop_tpu.parallel.sharded_mgm2 import ShardedMgm2
+
+    mesh = make_mesh(8)
+    arrays = coloring_hypergraph_arrays(18, 30, 3, seed=8)
+    for cls, extra in ((ShardedDsa, {}), (ShardedMgm2, {}),
+                       (ShardedDba, dict(max_distance=30,
+                                         infinity=1000))):
+        with pytest.raises(TypeError):
+            cls(arrays, mesh, batch=4, decimation_p=0.2, **extra)
+        with pytest.raises(TypeError):
+            cls(arrays, mesh, batch=4, bnb=True, **extra)
+
+
+@pytest.mark.mesh
+def test_sharded_bnb_bit_exact():
+    """Sharded bnb on == off: selections AND cycles, chunked engine."""
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    mesh = make_mesh(8)
+    arrays = _nary_arrays(n_vars=24, count=12)
+    base = ShardedMaxSum(arrays, mesh, batch=4, damping=0.5)
+    bnb = ShardedMaxSum(arrays, mesh, batch=4, damping=0.5, bnb=True)
+    assert bnb._bnb_active
+    sel_b, cyc_b = base.run(15, seed=0)
+    sel_p, cyc_p = bnb.run(15, seed=0)
+    assert np.array_equal(sel_b, sel_p)
+    assert cyc_b == cyc_p
+
+
+@pytest.mark.hetero
+def test_hetero_fused_campaign_off_is_bit_exact():
+    """The fused hetero campaign runner: decimation_p=0 == no kwargs
+    (selections per job), and a decimated campaign actually freezes
+    per instance under the vmap."""
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    t = coloring_factor_arrays(20, 50, 3, seed=1, noise=0.05)
+    insts = [coloring_factor_arrays(20, 50, 3, seed=s, noise=0.05)
+             for s in (1, 2, 3)]
+    base = BatchedMaxSum(t, instances=insts, damping=0.5) \
+        .run(seed=0, max_cycles=20)
+    off = BatchedMaxSum(t, instances=insts, damping=0.5,
+                        decimation_p=0.0).run(seed=0, max_cycles=20)
+    for rb, ro in zip(base[0], off[0]):
+        assert np.array_equal(np.asarray(rb), np.asarray(ro))
+    # on: runs, and at least one job's selections differ from plain
+    on = BatchedMaxSum(t, instances=insts, damping=0.5,
+                       decimation_p=0.3, decimation_every=4) \
+        .run(seed=0, max_cycles=20)
+    assert len(on[0]) == len(insts)
+
+
+def test_decimation_select_tied_margins_bounded():
+    """The rank cut is exact: with EVERY margin tied (symmetric
+    integer beliefs), one event freezes ceil(p * n) variables, never
+    the whole plane."""
+    from pydcop_tpu.ops.kernels import decimation_select
+
+    n = 100
+    margins = jnp.ones((n,), dtype=jnp.float32)
+    frozen = jnp.zeros((n,), dtype=bool)
+    eligible = jnp.ones((n,), dtype=bool)
+    newly = np.asarray(decimation_select(margins, frozen, eligible,
+                                         0.1))
+    assert newly.sum() == 10
+    # p=0 freezes nothing even with candidates available
+    none = np.asarray(decimation_select(margins, frozen, eligible,
+                                        0.0))
+    assert none.sum() == 0
+    # already-frozen and ineligible variables never re-freeze
+    frozen2 = jnp.asarray(newly)
+    second = np.asarray(decimation_select(margins, frozen2, eligible,
+                                          0.1))
+    assert second.sum() == 9  # ceil(0.1 * 90)
+    assert not np.any(second & newly)
+
+
+# --------------------------------------------------- loud rejections
+
+
+def test_amaxsum_rejects_decimation():
+    from pydcop_tpu.algorithms.amaxsum import AMaxSumSolver
+
+    arrays = coloring_factor_arrays(10, 20, 3, seed=1)
+    with pytest.raises(ValueError, match="amaxsum does not support"):
+        AMaxSumSolver(arrays, decimation_p=0.2)
+
+
+def test_dynamic_maxsum_rejects_bnb():
+    from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSumSolver
+
+    arrays = _nary_arrays()
+    with pytest.raises(ValueError, match="does not support bnb"):
+        DynamicMaxSumSolver(arrays, bnb=True)
+
+
+def test_batched_runner_rejects_bnb():
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    t = coloring_factor_arrays(10, 20, 3, seed=1)
+    with pytest.raises(ValueError, match="do not support bnb"):
+        BatchedMaxSum(t, bnb=True)
+
+
+# ----------------------------------------------------- telemetry planes
+
+
+@pytest.mark.obs
+@pytest.mark.mesh
+def test_feature_metric_planes():
+    """freezes/pruned ride the existing metric planes: null without
+    the features, monotone counts / [0, 1] fractions with them, zero
+    schema changes elsewhere."""
+    from pydcop_tpu.observability.report import validate_record
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.sharded_maxsum import ShardedMaxSum
+
+    mesh = make_mesh(8)
+    arrays = _nary_arrays(n_vars=24, count=12)
+    plain = ShardedMaxSum(arrays, mesh, batch=4, damping=0.5)
+    plain.run(10, seed=0, collect_metrics=True)
+    for rec in plain.last_cycle_metrics:
+        assert rec["freezes"] is None and rec["pruned"] is None
+
+    both = ShardedMaxSum(arrays, mesh, batch=4, damping=0.5,
+                         decimation_p=0.2, decimation_every=4,
+                         bnb=True)
+    both.run(12, seed=0, collect_metrics=True)
+    recs = both.last_cycle_metrics
+    assert recs, "no telemetry records"
+    freezes = [r["freezes"] for r in recs]
+    assert all(f is not None for f in freezes)
+    assert freezes == sorted(freezes)  # cumulative, never shrinks
+    assert freezes[-1] > 0
+    for r in recs:
+        assert 0.0 <= r["pruned"] <= 1.0
+        # records validate against the v1 JSONL schema once stamped
+        # the way RunReporter emits them
+        validate_record(dict(r, record="cycle", algo="maxsum"))
+
+
+# ----------------------------------- fast-path predicate + env override
+
+
+def test_nary_fast_eligible_single_predicate(monkeypatch):
+    from pydcop_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.delenv(pk.NARY_MAX_CELLS_ENV, raising=False)
+    assert pk.nary_fast_eligible(1000, 2)  # binary: always
+    assert pk.nary_fast_eligible(16, 3)    # 4096 == ceiling
+    assert not pk.nary_fast_eligible(17, 3)
+
+
+def test_nary_max_cells_env_override(monkeypatch):
+    from pydcop_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setenv(pk.NARY_MAX_CELLS_ENV, "100")
+    assert pk.nary_fast_max_cells() == 100
+    assert not pk.nary_fast_eligible(5, 3)  # 125 > 100
+    monkeypatch.setenv(pk.NARY_MAX_CELLS_ENV, "200")
+    assert pk.nary_fast_eligible(5, 3)      # 125 <= 200
+
+
+def test_nary_max_cells_env_malformed_warns_once(monkeypatch):
+    from pydcop_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setenv(pk.NARY_MAX_CELLS_ENV, "banana")
+    monkeypatch.setattr(pk, "_warned_bad_env", False)
+    with pytest.warns(RuntimeWarning, match="malformed"):
+        assert pk.nary_fast_max_cells() == pk.NARY_FAST_MAX_CELLS
+    # second call: silent fallback, no warning spam
+    import warnings as w
+    with w.catch_warnings():
+        w.simplefilter("error")
+        assert pk.nary_fast_max_cells() == pk.NARY_FAST_MAX_CELLS

@@ -50,10 +50,14 @@ def test_metric_planes_roundtrip():
                                  jnp.float32(jnp.nan), jnp.int32(0),
                                  jnp.int32(-1))
     recs = metric_records(planes, 5)
-    # rows 2-4 were never written: skipped, not emitted as sentinels
+    # rows 2-4 were never written: skipped, not emitted as sentinels;
+    # the feature fields (freezes/pruned) decode to their null
+    # not-available form on runs without decimation/bnb
     assert recs == [
-        {"cycle": 1, "residual": 0.5, "flips": 3, "violations": 2},
-        {"cycle": 2, "residual": None, "flips": 0, "violations": None},
+        {"cycle": 1, "residual": 0.5, "flips": 3, "violations": 2,
+         "freezes": None, "pruned": None},
+        {"cycle": 2, "residual": None, "flips": 0, "violations": None,
+         "freezes": None, "pruned": None},
     ]
 
 
