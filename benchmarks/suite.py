@@ -642,6 +642,169 @@ batches:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_serve(quick=False):
+    """Solver-as-a-service A/B (ISSUE 9 tentpole): one burst of mixed
+    jobs (6 coloring topologies sharing two pow2 rungs, dsa + maxsum)
+    through stdin `serve` daemons, (a) sequential per-job dispatch
+    (--max-batch 1: every job runs alone, the no-dynamic-batching
+    control) vs (b) dynamic batching (--max-batch 8
+    --max-delay-ms 100: rungs fill or deadline-fire).
+
+    Each leg runs its daemon TWICE against a shared executable cache
+    and measures the SECOND (warm-restarted) process — the steady
+    state of a service, where cold rungs deserialize instead of
+    compiling; the cold run's compile span total is reported alongside
+    the warm remainder as the cache's measured saving.  Per-job
+    latency is the summary records' ``queue_wait_s`` (admission ->
+    dispatch completion, so it includes time queued behind earlier
+    dispatches); throughput is completed jobs over the daemon's own
+    ``uptime_s`` (serving time, interpreter/jax startup excluded).
+    Contract asserted: warm dynamic batching beats warm sequential
+    dispatch on throughput with fewer dispatches, WITHOUT degrading
+    p99 latency.  Process-isolated legs, host-CPU numbers (XLA-CPU),
+    per the round-4 protocol."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    # enough jobs that SUSTAINED dispatch dominates the one-time warm
+    # costs both legs share (per-runner deserializes, first-touch
+    # admission builds) — at 32 jobs the legs tie on shared fixed cost
+    n_jobs = 160 if quick else 480
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    work = tempfile.mkdtemp(prefix="pydcop_serve_")
+    try:
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.generators.graphcoloring import \
+            generate_graph_coloring
+        from pydcop_tpu.observability.report import read_records
+
+        # two size bands -> two pow2 rungs per algo family.  Jobs are
+        # deliberately SMALL and short (service-shaped: the per-job
+        # device work is milliseconds, so per-dispatch fixed costs —
+        # Python dispatch, arg stacking, device round-trips — are the
+        # quantity under test; dynamic batching amortizes exactly
+        # those)
+        # sizes chosen so each band shares ONE home rung per algo
+        # family: vars 12/14/16 -> pow2 17 with 2(n-2) edges 20/24/28
+        # -> 32 slots; vars 20/24/28 -> 33 with edges 36/44/52 -> 64
+        bands = {"small": [], "big": []}
+        for nv in (12, 14, 16, 20, 24, 28):
+            dcop = generate_graph_coloring(
+                nv, 3, "scalefree", m_edge=2, soft=True, seed=nv)
+            p = os.path.join(work, f"i{nv}.yaml")
+            with open(p, "w") as f:
+                f.write(dcop_yaml(dcop))
+            bands["small" if nv <= 16 else "big"].append(p)
+        # round-robin over the four (algo x size-band) groups so every
+        # group sees n_jobs/4 jobs — the load is mixed WITHIN each
+        # dispatch window without skewing group sizes (a lopsided mix
+        # only measures the skew, not the dispatch policy)
+        group_of = [("maxsum", "small"), ("dsa", "small"),
+                    ("maxsum", "big"), ("dsa", "big")]
+        jobs = []
+        for i in range(n_jobs):
+            algo, band = group_of[i % 4]
+            jobs.append(json.dumps({
+                "id": f"j{i}",
+                "dcop": bands[band][(i // 4) % len(bands[band])],
+                "algo": algo, "max_cycles": 10, "seed": i}))
+        jobs_text = "".join(j + "\n" for j in jobs)
+
+        def run_daemon(tag, max_batch, max_delay_ms, exec_dir, run_i):
+            out = os.path.join(work, f"{tag}_{run_i}.jsonl")
+            proc = subprocess.run(
+                [sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+                 "--out", out, "--exec-cache", exec_dir,
+                 "--max-batch", str(max_batch),
+                 "--max-delay-ms", str(max_delay_ms)],
+                input=jobs_text, capture_output=True, text=True,
+                timeout=1800, env=env, cwd=repo)
+            if proc.returncode != 0:
+                raise RuntimeError(f"{tag} leg rc={proc.returncode}: "
+                                   f"{proc.stderr[-300:]}")
+            return read_records(out)
+
+        def leg(tag, max_batch, max_delay_ms):
+            exec_dir = os.path.join(work, f"exec_{tag}")
+            cold = run_daemon(tag, max_batch, max_delay_ms, exec_dir, 0)
+            warm = run_daemon(tag, max_batch, max_delay_ms, exec_dir, 1)
+
+            def span_total(records, key):
+                return sum(
+                    r["spans"].get(key, 0.0) for r in records
+                    if r.get("record") == "serve"
+                    and r.get("event") == "dispatch")
+
+            waits = sorted(
+                r["queue_wait_s"] for r in warm
+                if r.get("record") == "summary"
+                and r.get("status") != "REJECTED")
+            if len(waits) != n_jobs:
+                raise RuntimeError(
+                    f"{tag} leg completed {len(waits)}/{n_jobs}")
+            final = warm[-1]
+            if final.get("record") != "serve" \
+                    or final.get("event") != "drained":
+                raise RuntimeError(
+                    f"{tag} warm leg did not end with the drained "
+                    f"serve record: {final}")
+            uptime = final["uptime_s"]
+            dispatches = sum(
+                1 for r in warm if r.get("record") == "serve"
+                and r.get("event") == "dispatch")
+            return {
+                "throughput_jobs_per_s": round(n_jobs / uptime, 2),
+                "p50_latency_s": round(waits[len(waits) // 2], 4),
+                "p99_latency_s": round(
+                    waits[min(len(waits) - 1,
+                              int(len(waits) * 0.99))], 4),
+                "dispatches": dispatches,
+                "uptime_s": round(uptime, 3),
+                "cold_compile_s": round(sum(
+                    span_total(cold, k) for k in
+                    ("compile_s", "trace_lower_s", "eval_compile_s",
+                     "eval_trace_lower_s")), 3),
+                "warm_compile_s": round(sum(
+                    span_total(warm, k) for k in
+                    ("compile_s", "trace_lower_s", "eval_compile_s",
+                     "eval_trace_lower_s")), 3),
+                "warm_deserialize_s": round(
+                    span_total(warm, "deserialize_s")
+                    + span_total(warm, "eval_deserialize_s"), 3),
+            }
+
+        # sequential dispatches immediately (max_batch 1), so its
+        # deadline is inert; the dynamic deadline is tuned to ~2x the
+        # per-dispatch service time — tighter and partially-filled
+        # rungs deadline-fire behind slow dispatches, fragmenting the
+        # batch-size universe (each fragment size is its own compiled
+        # program + warm deserialize)
+        seq = leg("sequential", 1, 25)
+        dyn = leg("dynamic", 8, 100)
+        contract_ok = (
+            dyn["throughput_jobs_per_s"] > seq["throughput_jobs_per_s"]
+            and dyn["p99_latency_s"] <= seq["p99_latency_s"]
+            and dyn["dispatches"] < seq["dispatches"])
+        if not contract_ok:
+            raise RuntimeError(
+                f"serve contract violated: dynamic {dyn} vs "
+                f"sequential {seq}")
+        return {
+            "metric": f"serve_ab_{n_jobs}job_burst_warm_restart",
+            "value": {"dynamic_batching": dyn, "sequential": seq},
+            "unit": "jobs/s + latency percentiles",
+            "speedup": round(dyn["throughput_jobs_per_s"]
+                             / seq["throughput_jobs_per_s"], 2),
+            "contract_ok": contract_ok,
+            "hardware": "cpu-host",
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _nary_ab_one(solvers, n_edges, k=30):
     """msgs/s per named solver on the SAME instance, same-program
     best-of-3 each; adds fast-vs-generic speedups and a selections
@@ -1294,7 +1457,7 @@ BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_batch_campaign_fused, bench_nary_fastpath,
            bench_mesh_dispatch, bench_hetero_batch, bench_precision,
            bench_telemetry_overhead, bench_decimation,
-           bench_bnb_pruning]
+           bench_bnb_pruning, bench_serve]
 
 
 def main():

@@ -1,0 +1,180 @@
+"""``pydcop serve``: the persistent solver-as-a-service daemon.
+
+No reference-parity anchor — the reference framework's long-running
+shape is its agent/orchestrator runtime; this command is the compiled
+data plane's equivalent (ROADMAP: solver-as-a-service).  Jobs arrive
+continuously as JSONL (``serving/schema.py``), are admitted onto the
+power-of-two bucketing ladder, and dispatch as batched vmapped
+programs when a rung fills or the oldest job's latency deadline
+expires.  Results and daemon telemetry stream to ``--out`` over the v1
+JSONL schema; socket clients additionally receive their own jobs'
+records back on their connection.
+
+Three input modes::
+
+    pydcop serve --oneshot jobs.jsonl       # file -> drain -> exit
+    cat jobs.jsonl | pydcop serve           # stdin (EOF drains)
+    pydcop serve --socket /tmp/pydcop.sock  # unix socket daemon
+
+SIGTERM stops gracefully: the in-flight rung completes, every queued
+job is rejected with a structured reason.
+"""
+
+import os
+import signal
+import sys
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "serve",
+        help="run the persistent solver daemon (JSONL jobs in, "
+             "dynamic batching over the rung ladder, JSONL results "
+             "out)")
+    parser.add_argument("--oneshot", type=str, default=None,
+                        metavar="JOBS.jsonl",
+                        help="read job requests from this file, drain "
+                             "the queue, exit — the daemon's "
+                             "socket-free smoke path (CI runs it)")
+    parser.add_argument("--socket", type=str, default=None,
+                        metavar="PATH",
+                        help="accept JSONL job requests on a unix "
+                             "domain socket at PATH; each client gets "
+                             "its own jobs' result records streamed "
+                             "back on its connection.  Default (no "
+                             "--socket, no --oneshot): read requests "
+                             "from stdin, EOF drains")
+    parser.add_argument("--out", type=str, default="serve_out.jsonl",
+                        metavar="out.jsonl",
+                        help="JSONL output: per-job summary records "
+                             "plus serve telemetry records (queue "
+                             "depth, wait times, compile/deserialize/"
+                             "execute spans, cache counters), same v1 "
+                             "schema as solve/batch --telemetry "
+                             "(docs/analysing_results.md)")
+    parser.add_argument("--max-batch", dest="max_batch", type=int,
+                        default=8,
+                        help="dispatch a rung as soon as this many "
+                             "jobs share it (the rung-fills trigger)")
+    parser.add_argument("--max-delay-ms", dest="max_delay_ms",
+                        type=float, default=50.0,
+                        help="dispatch a rung when its oldest job has "
+                             "waited this long even if not full (the "
+                             "latency-deadline trigger; per-job "
+                             "deadline_ms can only tighten it)")
+    parser.add_argument("--max_cycles", "--max-cycles",
+                        dest="max_cycles", type=int, default=2000,
+                        help="default cycle budget for jobs that do "
+                             "not carry max_cycles (same default and "
+                             "spelling as solve; the dash alias "
+                             "matches this parser's other flags)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="default engine seed for jobs without "
+                             "one")
+    parser.add_argument("--precision", default=None,
+                        choices=["f32", "bf16", "auto"],
+                        help="default mixed-precision policy for jobs "
+                             "that do not request one; jobs carrying "
+                             "their own precision keep it (and never "
+                             "share a rung with differently-policied "
+                             "jobs)")
+    parser.add_argument("--exec-cache", dest="exec_cache",
+                        type=str, default=None, metavar="DIR",
+                        help="directory for serialized jax.stages rung "
+                             "executables (default: "
+                             "$PYDCOP_TPU_CACHE_DIR/executables, i.e. "
+                             "~/.cache/pydcop_tpu/executables) — a "
+                             "restarted daemon cold-starts a known "
+                             "rung by DESERIALIZING it instead of "
+                             "retracing+recompiling; "
+                             "PYDCOP_TPU_NO_CACHE=1 disables")
+    parser.add_argument("--no-exec-cache", dest="no_exec_cache",
+                        action="store_true",
+                        help="disable the executable cache for this "
+                             "daemon (every cold rung recompiles)")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..engine._cache import ExecutableCache
+    from ..observability.report import RunReporter
+    from ..serving.daemon import ServeLoop
+    from ..serving.dispatcher import Dispatcher
+    from ..serving.queue import AdmissionQueue
+
+    if args.oneshot and args.socket:
+        raise CliError("--oneshot and --socket are mutually exclusive")
+    if args.max_batch < 1:
+        raise CliError("--max-batch must be >= 1")
+    if args.max_delay_ms < 0:
+        raise CliError("--max-delay-ms must be >= 0")
+    from ..parallel.batch import runner_cache_cap
+
+    try:
+        # a malformed PYDCOP_TPU_RUNNER_CACHE must kill the daemon at
+        # STARTUP, not poison every dispatch's telemetry call later
+        runner_cache_cap()
+    except ValueError as e:
+        raise CliError(str(e))
+
+    exec_cache = None
+    if not args.no_exec_cache:
+        exec_cache = ExecutableCache(path=args.exec_cache)
+
+    reporter = RunReporter(args.out, algo="serve", mode="serve")
+    try:
+        reporter.header(
+            max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+            max_cycles=args.max_cycles, precision=args.precision,
+            exec_cache=(exec_cache.path
+                        if exec_cache is not None
+                        and exec_cache.enabled else None),
+            source=("oneshot" if args.oneshot
+                    else "socket" if args.socket else "stdin"))
+        admission = AdmissionQueue(
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1000.0)
+        dispatcher = Dispatcher(reporter=reporter,
+                                exec_cache=exec_cache)
+        loop = ServeLoop(admission, dispatcher, reporter=reporter,
+                         default_max_cycles=args.max_cycles,
+                         default_seed=args.seed,
+                         default_precision=args.precision)
+
+        # the SIGTERM contract: finish the in-flight rung, reject the
+        # rest with a structured reason.  Registered here (not in
+        # dcop_cli) so only the serve command changes signal behavior
+        prev_term = signal.signal(
+            signal.SIGTERM, lambda _s, _f: loop.request_stop())
+        try:
+            if args.oneshot:
+                if not os.path.exists(args.oneshot):
+                    raise CliError(
+                        f"oneshot jobs file not found: {args.oneshot}")
+                with open(args.oneshot) as f:
+                    stats = loop.run_oneshot(f.readlines())
+            elif args.socket:
+                from ..serving.sources import SocketServer
+
+                server = SocketServer(loop, args.socket)
+                try:
+                    stats = loop.run()
+                finally:
+                    server.close()
+            else:
+                from ..serving.sources import stdin_source
+
+                stdin_source(loop)
+                stats = loop.run()
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+        print(f"[serve] received={stats['received']} "
+              f"admitted={stats['admitted']} "
+              f"completed={stats['completed']} "
+              f"rejected={stats['rejected']}", file=sys.stderr)
+    finally:
+        reporter.close()
+    return 0

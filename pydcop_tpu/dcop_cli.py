@@ -42,10 +42,10 @@ def _make_parser():
     subparsers = parser.add_subparsers(dest="command", required=True)
     from .commands import (agent, batch, consolidate, distribute,
                            generate, graph, orchestrator, replica_dist,
-                           run, solve)
+                           run, serve, solve)
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
-                   generate, replica_dist, batch, consolidate):
+                   generate, replica_dist, batch, consolidate, serve):
         module.set_parser(subparsers)
     return parser
 

@@ -1,19 +1,53 @@
-"""Persistent XLA compilation cache.
+"""Persistent compilation caches.
 
-Compiles are the cold-start cost of the compiled data plane (20-40 s for
-the first 10k-variable step on the tunneled chip, several seconds per
-DPOP device spine).  JAX can persist compiled executables to disk keyed
-by the HLO hash; enabling it makes every fresh process after the first
-start warm — benchmarks, batch campaigns, process-mode agents.
+Two layers, both keyed to survive process restarts:
 
-Opt-out with ``PYDCOP_TPU_NO_CACHE=1``; relocate with
-``PYDCOP_TPU_CACHE_DIR``.  Failure to set the cache up (read-only
-filesystem, old jax) is non-fatal: solving just compiles as before.
+* :func:`enable_persistent_cache` — JAX's own XLA compilation cache
+  (HLO-hash keyed).  Compiles are the cold-start cost of the compiled
+  data plane (20-40 s for the first 10k-variable step on the tunneled
+  chip, several seconds per DPOP device spine); enabling it makes every
+  fresh process after the first start warm — benchmarks, batch
+  campaigns, process-mode agents.
+* :class:`ExecutableCache` — whole ``jax.stages`` executables,
+  serialized with ``jax.experimental.serialize_executable`` and keyed
+  by an explicit logical identity (rung signature × algorithm ×
+  precision policy for the serving data plane) plus the argument aval
+  signature.  Where the XLA cache still pays a full Python trace +
+  lowering on every cold start, a hit here is ONE deserialize: the
+  difference between a demo and a `serve` daemon restart.
+
+Opt-out of both with ``PYDCOP_TPU_NO_CACHE=1``; relocate with
+``PYDCOP_TPU_CACHE_DIR``.  Failure to set a cache up (read-only
+filesystem, old jax) is non-fatal: solving just compiles as before —
+but it is WARNED once per process with the attempted path, because a
+silently cold cache reads exactly like a warm one until the bill
+arrives.
 """
 
+import hashlib
+import logging
 import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _done = False
+
+
+def default_cache_dir(subdir: str) -> str:
+    """``$PYDCOP_TPU_CACHE_DIR/<subdir>`` (default
+    ``~/.cache/pydcop_tpu/<subdir>``) — the XLA cache and the
+    executable cache live side by side under one relocatable root."""
+    root = os.environ.get(
+        "PYDCOP_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "pydcop_tpu"))
+    return os.path.join(root, subdir)
+
+
+def cache_disabled() -> bool:
+    return bool(os.environ.get("PYDCOP_TPU_NO_CACHE"))
 
 
 def enable_persistent_cache():
@@ -21,12 +55,9 @@ def enable_persistent_cache():
     if _done:
         return
     _done = True
-    if os.environ.get("PYDCOP_TPU_NO_CACHE"):
+    if cache_disabled():
         return
-    path = os.environ.get(
-        "PYDCOP_TPU_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "pydcop_tpu",
-                     "xla"))
+    path = default_cache_dir("xla")
     try:
         import jax
 
@@ -41,5 +72,145 @@ def enable_persistent_cache():
         # default >1s compiles
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.1)
-    except Exception:  # pragma: no cover - best effort
-        pass
+    except Exception as e:  # pragma: no cover - depends on environment
+        logger.warning(
+            "persistent XLA compilation cache unavailable at %s (%s); "
+            "every fresh process will pay full compiles — relocate "
+            "with PYDCOP_TPU_CACHE_DIR or silence with "
+            "PYDCOP_TPU_NO_CACHE=1", path, e)
+
+
+# --------------------------------------------------- executable cache
+
+
+class ExecutableCache:
+    """Disk-persisted ``jax.stages`` executables.
+
+    ``store`` serializes a compiled executable
+    (``serialize_executable.serialize`` payload + in/out pytree defs)
+    under a content-addressed file name derived from the caller's
+    logical key; ``load`` deserializes it back into a callable that
+    replaces the jit dispatch entirely — no trace, no lowering, no XLA
+    compile.  The batched campaign runners attach one of these when the
+    `serve` daemon (or any caller that restarts processes over a known
+    rung ladder) wants warm cold-starts: the logical key is the rung
+    signature × algorithm × precision policy × batch (see
+    ``parallel/batch.py runner_for_rung``).
+
+    Serialized executables are machine- and version-specific, so the
+    environment fingerprint (jax version, backend, machine arch,
+    device count) is folded into every key — a key from another
+    environment simply misses.  Deserialize failures are demoted to a
+    miss (warned once): the caller recompiles, correctness never
+    depends on the cache.
+
+    Unlike the XLA cache above, CPU executables ARE persisted: the
+    fingerprint pins the machine architecture, and a stale entry costs
+    a recompile, not a wrong answer.  Disable with
+    ``PYDCOP_TPU_NO_CACHE=1`` or ``enabled=False``.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        self.path = path or default_cache_dir("executables")
+        if enabled is None:
+            enabled = not cache_disabled()
+        self.enabled = bool(enabled)
+        self.stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "stores": 0, "errors": 0}
+        self._warned = False
+        if self.enabled:
+            try:
+                os.makedirs(self.path, exist_ok=True)
+            except OSError as e:
+                self.enabled = False
+                logger.warning(
+                    "executable cache unavailable at %s (%s); serve "
+                    "cold-starts will recompile every rung", self.path,
+                    e)
+
+    # ------------------------------------------------------------ keys
+
+    @staticmethod
+    def _fingerprint() -> Tuple:
+        import platform
+
+        import jax
+
+        return (jax.__version__, jax.default_backend(),
+                platform.machine(), jax.device_count())
+
+    def _file_for(self, key: Tuple) -> str:
+        digest = hashlib.sha256(
+            repr((self._fingerprint(), key)).encode()).hexdigest()
+        return os.path.join(self.path, digest + ".jaxexe")
+
+    # ------------------------------------------------------------- i/o
+
+    def load(self, key: Tuple) -> Optional[Any]:
+        """The deserialized executable for ``key``, or None on a miss.
+        Any failure (corrupt file, incompatible jaxlib) counts as a
+        miss so callers always have the recompile fallback."""
+        if not self.enabled:
+            return None
+        path = self._file_for(key)
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+        except FileNotFoundError:
+            self.stats["misses"] += 1
+            return None
+        except Exception as e:
+            self.stats["errors"] += 1
+            self.stats["misses"] += 1
+            self._warn_once(f"failed to read {path}: {e}")
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            loaded = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:
+            self.stats["errors"] += 1
+            self.stats["misses"] += 1
+            self._warn_once(f"failed to deserialize {path}: {e}")
+            return None
+        self.stats["hits"] += 1
+        return loaded
+
+    def store(self, key: Tuple, compiled) -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic tmp+rename so a
+        concurrent reader never sees a torn file).  Returns whether the
+        entry landed; failures are warned, never raised."""
+        if not self.enabled:
+            return False
+        path = self._file_for(key)
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump((payload, in_tree, out_tree), f)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:
+            self.stats["errors"] += 1
+            self._warn_once(f"failed to store executable {path}: {e}")
+            return False
+        self.stats["stores"] += 1
+        return True
+
+    def _warn_once(self, msg: str):
+        if not self._warned:
+            self._warned = True
+            logger.warning(
+                "executable cache degraded (%s); recompiling instead",
+                msg)

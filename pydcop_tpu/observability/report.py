@@ -15,6 +15,14 @@ line:
   record with ``job_id`` and ``fuse_rung``.
 * ``summary`` — one per run/job: status, cost, violation, cycles,
   duration, message stats, spans.
+* ``serve`` — serve-daemon lifecycle and dispatch telemetry
+  (``serving/``): one record per queue event worth observing, tagged
+  with ``event`` (``dispatch``, ``drained``, ``stopped``) and carrying
+  queue depth, per-job wait-time stats, jax.stages spans
+  (``compile_s``/``deserialize_s``/``execute_s``) and the runner /
+  executable cache counters.  Per-job serve RESULTS stay ``summary``
+  records — the serve kind is the daemon's own telemetry, not a second
+  result schema.
 
 Records append atomically (one ``os.write`` to an ``O_APPEND`` fd, the
 same discipline as ``batch --consolidated-out``), so a campaign's fused
@@ -36,7 +44,7 @@ from typing import Any, Dict, Iterable, Optional
 
 SCHEMA_VERSION = 1
 
-RECORD_KINDS = ("header", "cycle", "summary")
+RECORD_KINDS = ("header", "cycle", "summary", "serve")
 
 
 class RunReporter:
@@ -114,6 +122,14 @@ class RunReporter:
         self._emit(rec, f"engine.run.{self.algo}")
         return rec
 
+    def serve(self, event: str, **fields) -> Dict[str, Any]:
+        """Serve-daemon telemetry record (queue depth, wait times,
+        spans, cache counters), published on ``engine.serve``."""
+        rec = {"record": "serve", "algo": self.algo,
+               "mode": self.mode, "event": str(event), **fields}
+        self._emit(rec, "engine.serve")
+        return rec
+
 
 def read_records(path: str):
     """Parse a telemetry JSONL file back into record dicts."""
@@ -170,3 +186,17 @@ def validate_record(rec: Dict[str, Any]):
     elif kind == "summary":
         if "status" not in rec:
             raise ValueError("summary missing 'status'")
+    elif kind == "serve":
+        event = rec.get("event")
+        if not isinstance(event, str) or not event:
+            raise ValueError(f"serve record with bad event {event!r}")
+        depth = rec.get("queue_depth")
+        if depth is not None and (not isinstance(depth, int)
+                                  or depth < 0):
+            raise ValueError(
+                f"serve record with bad queue_depth {depth!r}")
+        batch = rec.get("batch")
+        if batch is not None and (not isinstance(batch, int)
+                                  or batch < 1):
+            raise ValueError(
+                f"serve record with bad batch {batch!r}")

@@ -45,18 +45,34 @@ class SpanClock:
         return {k: round(v, ndigits) for k, v in self.spans.items()}
 
 
-def aot_compile(jitted, args, clock: Optional[SpanClock] = None):
+def aval_signature(args) -> tuple:
+    """The flattened shape/dtype/tree signature of concrete call args —
+    THE shape-specialization component of every jax.stages cache key
+    (the engines' in-process ``aot_cached`` and the serving
+    executable-cache keys in ``parallel/batch.py`` must never drift on
+    it: a ``Compiled`` only accepts exactly-matching avals)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (str(treedef), tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")))
+        for x in leaves))
+
+
+def aot_compile(jitted, args, clock: Optional[SpanClock] = None,
+                prefix: str = ""):
     """AOT-compile a ``jax.jit``-wrapped function against concrete
     example ``args`` via ``jax.stages``, timing the trace+lower and
-    compile stages separately.  Returns ``(lowered, compiled)`` — the
-    lowered module feeds the HLO census
+    compile stages separately (span names carry ``prefix``, e.g. the
+    batched runners' ``eval_`` evaluator).  Returns
+    ``(lowered, compiled)`` — the lowered module feeds the HLO census
     (:func:`~pydcop_tpu.observability.hlo.compile_stats`), the compiled
     executable replaces the jit call (donation declared on ``jitted``
     is preserved)."""
     clock = clock or SpanClock()
-    with clock.span("trace_lower_s"):
+    with clock.span(prefix + "trace_lower_s"):
         lowered = jitted.lower(*args)
-    with clock.span("compile_s"):
+    with clock.span(prefix + "compile_s"):
         compiled = lowered.compile()
     return lowered, compiled
 
@@ -69,14 +85,9 @@ def aot_cached(cache: dict, key_prefix, jitted, args, clock):
     signature of ``args``.  Returns ``(compiled, compile_stats)``;
     a miss pays one timed lower+compile (spans land on ``clock``) and
     one HLO census."""
-    import jax
-
     from .hlo import compile_stats
 
-    leaves, treedef = jax.tree_util.tree_flatten(args)
-    sig = (key_prefix, str(treedef), tuple(
-        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")))
-        for x in leaves))
+    sig = (key_prefix,) + aval_signature(args)
     entry = cache.get(sig)
     if entry is None:
         lowered, compiled = aot_compile(jitted, args, clock)

@@ -19,6 +19,8 @@ additionally be sharded over the mesh's dp axis.  Two fusion regimes:
   and :meth:`decode` masks phantom variables out of the result.
 """
 
+import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -76,6 +78,18 @@ class _BatchedRunnerBase:
         #: per-instance telemetry of the last run(collect_metrics=
         #: True): one record list per instance (observability/metrics)
         self.last_cycle_metrics: List[List[Dict]] = []
+        #: optional disk executable cache (engine/_cache.ExecutableCache)
+        #: + the logical identity prefix its keys carry: when both are
+        #: set (runner_for_rung attaches them for serving callers),
+        #: run() AOT-compiles via jax.stages instead of jit dispatch —
+        #: a restarted process's cold start for a known rung becomes a
+        #: deserialize, not a retrace+compile.  ``last_spans`` reports
+        #: where the last run() spent its wall time
+        #: (trace_lower_s/compile_s on a cache miss, deserialize_s on a
+        #: hit, execute_s always).
+        self.exec_cache = None
+        self.exec_cache_key: Optional[Tuple] = None
+        self.last_spans: Dict[str, float] = {}
 
     def _drive(self, base, state):
         """The shared convergence loop: step until the solver reports
@@ -166,28 +180,76 @@ class _BatchedRunnerBase:
         telemetry-off program is untouched and cached separately)."""
         from ..observability.metrics import metric_records
 
+        from ..observability.spans import SpanClock
+
         self.max_cycles = max_cycles
         self._collect_metrics = bool(collect_metrics)
         keys = _batch_keys(seed, seeds, self.B)
         cache_key = (max_cycles, self._collect_metrics)
+        spans = SpanClock()
         run_all = self._jitted.get(cache_key)
         if run_all is None:
-            run_all = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+            run_all = self._compile_run(cache_key, keys, spans)
             self._jitted[cache_key] = run_all
-        if collect_metrics:
-            sel, cycles, finished, planes = run_all(
-                self._instance_args, keys)
-            planes = {k: np.asarray(v) for k, v in planes.items()}
-            cycles = np.asarray(cycles)
-            self.last_cycle_metrics = [
-                metric_records({k: v[i] for k, v in planes.items()},
-                               int(cycles[i]))
-                for i in range(self.B)]
-        else:
-            sel, cycles, finished = run_all(self._instance_args, keys)
-            self.last_cycle_metrics = []
-        return (np.asarray(sel), np.asarray(cycles),
-                np.asarray(finished))
+        with spans.span("execute_s"):
+            if collect_metrics:
+                sel, cycles, finished, planes = run_all(
+                    self._instance_args, keys)
+                planes = {k: np.asarray(v) for k, v in planes.items()}
+                cycles = np.asarray(cycles)
+                self.last_cycle_metrics = [
+                    metric_records(
+                        {k: v[i] for k, v in planes.items()},
+                        int(cycles[i]))
+                    for i in range(self.B)]
+            else:
+                sel, cycles, finished = run_all(
+                    self._instance_args, keys)
+                self.last_cycle_metrics = []
+            out = (np.asarray(sel), np.asarray(cycles),
+                   np.asarray(finished))
+        self.last_spans = spans.as_dict()
+        return out
+
+    def _compile_run(self, cache_key: Tuple, keys,
+                     spans) -> object:
+        """The compiled whole-batch program for ``cache_key``.  Without
+        an attached executable cache this is the historical jit wrapper
+        (compiles lazily on first dispatch).  With one, the program is
+        AOT-compiled through ``jax.stages`` so the compiled executable
+        can be serialized to disk — and a later process's cold start
+        for the same logical key (rung signature × algo × precision ×
+        batch, plus the argument aval signature and this runner's
+        ``cache_key``) deserializes it instead of retracing: the spans
+        then show ``deserialize_s`` and NO ``compile_s``, the warm-start
+        evidence the serve telemetry asserts on."""
+        jitted = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+        if self.exec_cache is None or self.exec_cache_key is None:
+            return jitted
+        return self._aot_via_cache(jitted, (self._instance_args, keys),
+                                   cache_key, spans)
+
+    def _aot_via_cache(self, jitted, args, extra_key, spans,
+                       prefix: str = ""):
+        """Load-or-compile-and-store through the attached executable
+        cache, shared by the run program and the evaluator (``prefix``
+        names their spans apart).  The deserialize span is recorded
+        ONLY on a hit: telemetry consumers classify cold vs warm
+        dispatches by its presence."""
+        from ..observability.spans import aot_compile, aval_signature
+
+        full_key = (self.exec_cache_key, extra_key,
+                    aval_signature(args))
+        t0 = time.perf_counter()
+        compiled = self.exec_cache.load(full_key)
+        if compiled is not None:
+            spans.add(prefix + "deserialize_s",
+                      time.perf_counter() - t0)
+            return compiled
+        _lowered, compiled = aot_compile(jitted, args, spans,
+                                         prefix=prefix)
+        self.exec_cache.store(full_key, compiled)
+        return compiled
 
     def decode(self, sel: np.ndarray) -> List[np.ndarray]:
         """Masked decode: each row sliced to its instance's true
@@ -226,15 +288,36 @@ class _BatchedRunnerBase:
         the violation marker, mirroring ``DCOP.solution_cost`` with
         the default infinity threshold
         (``ops.kernels.assignment_cost_violations``)."""
+        x = jnp.asarray(np.asarray(sel, dtype=np.int32))
         fn = self._eval_jit
         if fn is None:
-            fn = self._eval_jit = jax.jit(
-                jax.vmap(self._eval_one, in_axes=(0, 0)))
-        cost, viol = fn(self._instance_args,
-                        jnp.asarray(np.asarray(sel, dtype=np.int32)))
+            fn = self._eval_jit = self._compile_eval(x)
+        cost, viol = fn(self._instance_args, x)
         # device costs are signed (min-compiled); undo for max models
         return (self._sign * np.asarray(cost, dtype=np.float64),
                 np.asarray(viol))
+
+    def _compile_eval(self, x):
+        """The vmapped cost/violation evaluator — exec-cached like the
+        run program when a cache is attached (a warm serve restart
+        must pay ZERO compiles, and the evaluator's was measurably the
+        larger of the two leftovers), plain jit otherwise.  Its spans
+        (``eval_*``) MERGE into ``last_spans`` so the dispatch record
+        shows the whole compile story of one dispatch."""
+        jitted = jax.jit(jax.vmap(self._eval_one, in_axes=(0, 0)))
+        if self.exec_cache is None or self.exec_cache_key is None:
+            return jitted
+        from ..observability.spans import SpanClock
+
+        spans = SpanClock()
+        compiled = self._aot_via_cache(
+            jitted, (self._instance_args, x), "evaluate", spans,
+            prefix="eval_")
+        # merge ROUNDED, like run()'s spans — a dispatch record must
+        # not mix 6-digit and raw-float precisions
+        for k, v in spans.as_dict().items():
+            self.last_spans[k] = self.last_spans.get(k, 0.0) + v
+        return compiled
 
 
 _MISSING = object()
@@ -530,18 +613,51 @@ BATCHED_CLASSES = {"maxsum": BatchedMaxSum, "dsa": BatchedDsa,
 #: without retracing.  Scope, stated honestly: the cache is
 #: per-PROCESS — within one fused campaign group a rung costs one
 #: compilation by construction, and IN-PROCESS callers (library use,
-#: repeated `_run_fused_group` calls, benches) amortize across groups
-#: sharing a rung; the CLI's one-child-per-group isolation does not
-#: carry it across groups.  Bounded: oldest runners (and their padded
-#: device arrays) are evicted past the cap.
+#: repeated `_run_fused_group` calls, the `serve` dispatcher, benches)
+#: amortize across groups sharing a rung; the CLI's one-child-per-group
+#: isolation does not carry it across groups.  Bounded: oldest runners
+#: (and their padded device arrays) are evicted past the cap
+#: (``PYDCOP_TPU_RUNNER_CACHE``, default 32); hits/misses/evictions
+#: are counted and surfaced in serve telemetry summaries.
 _RUNNER_CACHE: Dict[Tuple, object] = {}
 _RUNNER_CACHE_CAP = 32
+_RUNNER_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+RUNNER_CACHE_ENV = "PYDCOP_TPU_RUNNER_CACHE"
+
+
+def runner_cache_cap() -> int:
+    """The bound, read per call so tests and long-lived daemons can
+    retune it; a malformed env value dies loudly instead of silently
+    keeping the default."""
+    raw = os.environ.get(RUNNER_CACHE_ENV)
+    if raw is None:
+        return _RUNNER_CACHE_CAP
+    try:
+        cap = int(raw)
+        if cap < 1:
+            raise ValueError(raw)
+    except ValueError:
+        raise ValueError(
+            f"{RUNNER_CACHE_ENV} wants a positive integer runner "
+            f"count, got {raw!r}")
+    return cap
+
+
+def runner_cache_stats() -> Dict[str, int]:
+    """Point-in-time cache counters (plus current size and bound) for
+    telemetry summaries."""
+    return dict(_RUNNER_CACHE_STATS, size=len(_RUNNER_CACHE),
+                cap=runner_cache_cap())
 
 
 def runner_for_rung(algo: str, instances, params: dict,
-                    rung_signature: Optional[Tuple] = None):
+                    rung_signature: Optional[Tuple] = None,
+                    exec_cache=None):
     """Build — or fetch and re-point — the batched runner for ``algo``
-    over instances padded to one rung shape."""
+    over instances padded to one rung shape.  ``exec_cache`` (an
+    :class:`~pydcop_tpu.engine._cache.ExecutableCache`) additionally
+    persists the compiled program across PROCESSES, keyed by this
+    rung-signature identity — the serve daemon's warm restart."""
     cls = BATCHED_CLASSES[algo]
     key = None
     if rung_signature is not None:
@@ -549,11 +665,22 @@ def runner_for_rung(algo: str, instances, params: dict,
                tuple(sorted(params.items())))
         runner = _RUNNER_CACHE.get(key)
         if runner is not None:
+            _RUNNER_CACHE_STATS["hits"] += 1
+            if exec_cache is not None:
+                runner.exec_cache = exec_cache
+                runner.exec_cache_key = key
             runner.set_instances(instances)
             return runner
+        _RUNNER_CACHE_STATS["misses"] += 1
     runner = cls(instances[0], instances=list(instances), **params)
+    if exec_cache is not None:
+        runner.exec_cache = exec_cache
+        runner.exec_cache_key = key if key is not None else (
+            algo, len(instances), tuple(sorted(params.items())))
     if key is not None:
-        while len(_RUNNER_CACHE) >= _RUNNER_CACHE_CAP:
+        cap = runner_cache_cap()
+        while len(_RUNNER_CACHE) >= cap:
             _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
+            _RUNNER_CACHE_STATS["evictions"] += 1
         _RUNNER_CACHE[key] = runner
     return runner

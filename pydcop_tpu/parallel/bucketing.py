@@ -119,6 +119,15 @@ def _base_rung(profile: ShapeProfile) -> Rung:
     )
 
 
+def home_rung(profile: ShapeProfile) -> Rung:
+    """The profile's power-of-two home rung, public: the serving
+    admission path (``serving/queue.py``) assigns each ARRIVING job its
+    rung directly — no campaign-wide consolidation pass exists when
+    jobs trickle in one at a time, so two jobs batch exactly when their
+    home-rung signatures (and solver options) match."""
+    return _base_rung(profile)
+
+
 def plan_rungs(profiles: List[ShapeProfile],
                max_waste: float = 2.0,
                max_rung_bytes: Optional[int] = None,

@@ -1,0 +1,45 @@
+"""Solver-as-a-service: the `serve` daemon's data plane.
+
+The campaign stack (``commands/batch.py`` → ``parallel/batch.py``)
+solves work it can see all at once; production serving is the opposite
+shape — jobs arrive continuously and latency is part of the contract
+(ROADMAP: admission, not campaigns; Conditional Max-Sum,
+arXiv 2502.13194, is the reference for asynchronous job arrival).
+This package is that admission path:
+
+* :mod:`~pydcop_tpu.serving.schema` — the JSONL request/response
+  schema, validated at the trust boundary;
+* :mod:`~pydcop_tpu.serving.queue` — admission onto the existing
+  power-of-two bucketing ladder (each job's home rung is its batching
+  identity) and the two dynamic-batching triggers: rung fills, or the
+  oldest job's latency deadline expires;
+* :mod:`~pydcop_tpu.serving.dispatcher` — one group = one vmapped
+  program via the rung-signature runner cache, batch axis padded to a
+  power of two, with per-job ``summary`` + per-dispatch ``serve``
+  telemetry;
+* :mod:`~pydcop_tpu.serving.daemon` — the single-threaded serve loop
+  with deadline-timed polling, end-of-input drain, and the SIGTERM
+  contract (in-flight rung completes, queued jobs get structured
+  rejections);
+* :mod:`~pydcop_tpu.serving.sources` — stdin / unix-socket feeders.
+
+Cold starts are the other half of serving: with an attached
+:class:`~pydcop_tpu.engine._cache.ExecutableCache`, every compiled
+rung program is serialized via ``jax.stages``, and a restarted
+daemon's first dispatch of a known rung deserializes instead of
+recompiling (asserted by the warm-start test via the
+``compile_s``/``deserialize_s`` spans).
+"""
+
+from .daemon import ServeLoop
+from .dispatcher import Dispatcher
+from .queue import AdmissionQueue, AdmittedJob, DispatchGroup, \
+    prepare_job
+from .schema import (REQUEST_FIELDS, SERVABLE_ALGOS, RequestError,
+                     parse_request, rejection, validate_request)
+
+__all__ = [
+    "AdmissionQueue", "AdmittedJob", "DispatchGroup", "Dispatcher",
+    "REQUEST_FIELDS", "RequestError", "SERVABLE_ALGOS", "ServeLoop",
+    "parse_request", "prepare_job", "rejection", "validate_request",
+]

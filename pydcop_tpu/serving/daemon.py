@@ -1,0 +1,258 @@
+"""The `serve` loop: continuous admission, deadline-driven dispatch,
+graceful drain.
+
+Threading model, kept deliberately small: input sources (stdin reader,
+unix-socket connection readers, the ``--oneshot`` file) FEED raw lines
+into a thread-safe inbox from their own threads; all admission,
+dispatch and reporting happen on the single loop thread inside
+:meth:`ServeLoop.run`.  The loop blocks on the inbox with a timeout
+equal to the time until the earliest queued deadline, so a waiting
+daemon costs no busy-polling and a deadline fires at most one tick
+late.
+
+Shutdown contract (the SIGTERM satellite): ``request_stop()`` is
+async-signal-safe (sets an Event).  The loop finishes the dispatch it
+is executing — an in-flight rung always completes and its results are
+delivered — then every still-queued job and every unread inbox line
+receives a structured ``REJECTED`` summary, and a final ``serve``
+record with lifetime counters closes the output.  End-of-input (EOF on
+stdin, oneshot file exhausted) instead DRAINS: remaining groups are
+dispatched, nothing is rejected, and the loop exits when the queue is
+empty — which is exactly the ``serve --oneshot`` smoke path the test
+tier drives without sockets.
+"""
+
+import queue as _stdqueue
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .dispatcher import Dispatcher
+from .queue import AdmissionQueue, prepare_job
+from .schema import RequestError, parse_request, rejection
+
+#: inbox poll cap (s): an idle daemon wakes at least this often to
+#: notice request_stop() even with no deadlines pending
+_IDLE_TICK = 0.2
+
+#: how long the stop path keeps draining the inbox for lines a reader
+#: thread already has in flight (read from its stream, not yet put()):
+#: bounded so shutdown terminates even against a babbling client, long
+#: enough that a line mid-hand-off still gets its REJECTED response
+_STOP_DRAIN_GRACE = 0.25
+
+
+class ServeLoop:
+    """One loop instance per daemon process."""
+
+    def __init__(self, admission: AdmissionQueue,
+                 dispatcher: Dispatcher, reporter=None,
+                 default_max_cycles: int = 2000,
+                 default_seed: int = 0,
+                 default_precision: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.admission = admission
+        self.dispatcher = dispatcher
+        self.reporter = reporter
+        self.default_max_cycles = int(default_max_cycles)
+        self.default_seed = int(default_seed)
+        self.default_precision = default_precision
+        self.clock = clock
+        self._inbox: "_stdqueue.Queue" = _stdqueue.Queue()
+        self._stop = threading.Event()
+        self._input_closed = threading.Event()
+        self.stats: Dict[str, int] = {
+            "received": 0, "admitted": 0, "rejected": 0,
+            "completed": 0}
+
+    # ----------------------------------------------------------- input
+
+    def feed(self, line: str,
+             reply: Optional[Callable[[Dict], None]] = None):
+        """Queue one raw request line (any thread)."""
+        self._inbox.put((line, reply))
+
+    def close_input(self):
+        """No more lines will arrive; the loop drains and exits."""
+        self._input_closed.set()
+
+    def request_stop(self):
+        """Graceful shutdown (signal-handler safe): finish the
+        in-flight dispatch, reject everything still queued."""
+        self._stop.set()
+
+    # ------------------------------------------------------- admission
+
+    def _emit_rejection(self, job_id, reason, reply=None, algo=None):
+        rec = rejection(job_id, reason)
+        if algo is not None:
+            rec["algo"] = algo
+        self.stats["rejected"] += 1
+        if self.reporter is not None:
+            self.reporter.summary(**rec)
+        if reply is not None:
+            reply(dict(rec, record="summary", mode="serve"))
+
+    def _admit_line(self, line: str, reply=None):
+        line = line.strip()
+        if not line:
+            return
+        self.stats["received"] += 1
+        try:
+            request = parse_request(line)
+        except RequestError as e:
+            self._emit_rejection(e.job_id, str(e), reply)
+            return
+        try:
+            job = prepare_job(
+                request, default_max_cycles=self.default_max_cycles,
+                default_seed=self.default_seed,
+                default_precision=self.default_precision, reply=reply)
+        except Exception as e:
+            # the FULL breadth of "bad job" lands here, not just the
+            # anticipated ValueErrors: a file that exists but holds
+            # invalid yaml (ScannerError) or a structurally bad DCOP
+            # (DcopInvalidFormatError) must reject THIS job, never
+            # kill the daemon
+            self._emit_rejection(request["id"],
+                                 f"{type(e).__name__}: {e}", reply,
+                                 algo=request.get("algo"))
+            return
+        self.admission.admit(job)
+        self.stats["admitted"] += 1
+
+    # -------------------------------------------------------- dispatch
+
+    def _dispatch(self, groups) -> int:
+        n = 0
+        for group in groups:
+            try:
+                records = self.dispatcher.dispatch(
+                    group, queue_depth=self.admission.depth())
+            except Exception as e:
+                # the trust boundary extends past admission: one
+                # group's compile/execute failure (device OOM, a
+                # solver bug on this shape) rejects ITS jobs with a
+                # structured reason and the daemon keeps serving every
+                # other group
+                for job in group.jobs:
+                    self._emit_rejection(
+                        job.job_id, f"dispatch failed: {e}",
+                        job.reply, algo=group.key[0])
+                continue
+            n += len(records)
+        self.stats["completed"] += n
+        return n
+
+    def _poll_timeout(self) -> float:
+        deadline = self.admission.next_deadline()
+        if deadline is None:
+            return _IDLE_TICK
+        return min(_IDLE_TICK, max(0.0, deadline - self.clock()))
+
+    # ------------------------------------------------------------ loop
+
+    def run(self) -> Dict[str, int]:
+        """Serve until stop or drained end-of-input; returns the
+        lifetime stats (also emitted as the final ``serve`` record)."""
+        t_start = self.clock()
+        while not self._stop.is_set():
+            try:
+                line, reply = self._inbox.get(
+                    timeout=self._poll_timeout())
+                self._admit_line(line, reply)
+                # admit what's already buffered before dispatching, so
+                # a burst that arrived together can fill a rung instead
+                # of straggling through deadline dispatches — but
+                # BOUNDED by line count: under sustained input faster
+                # than admission, an uncapped drain would never reach
+                # the dispatch call and the latency deadline would
+                # blow past without limit.  (A per-line expired-
+                # deadline break would bound it tighter but fragments
+                # rungs whenever a slow dispatch left deadlines
+                # already due — measured to cost more in partial-batch
+                # programs than it saves in wait.)
+                for _ in range(128):
+                    try:
+                        line, reply = self._inbox.get_nowait()
+                    except _stdqueue.Empty:
+                        break
+                    self._admit_line(line, reply)
+            except _stdqueue.Empty:
+                pass
+            if self._stop.is_set():
+                break
+            self._dispatch(self.admission.due())
+            if self._input_closed.is_set() and self._inbox.empty():
+                # end of input: drain remaining groups and finish
+                # (due() just ran above and nothing can be admitted
+                # on this single loop thread in between)
+                self._dispatch(self.admission.drain())
+                if self._inbox.empty():
+                    break
+        if self._stop.is_set():
+            # graceful stop: queued jobs and unread lines are REJECTED
+            # with a structured reason (never silently dropped)
+            for group in self.admission.drain():
+                for job in group.jobs:
+                    self._emit_rejection(
+                        job.job_id, "serve daemon shutting down "
+                        "(queued, not yet dispatched)", job.reply,
+                        algo=group.key[0])
+            grace_until = self.clock() + _STOP_DRAIN_GRACE
+            while True:
+                try:
+                    line, reply = self._inbox.get(timeout=0.02)
+                except _stdqueue.Empty:
+                    # readers may still be mid-hand-off (line read
+                    # from the stream, not yet put()): keep draining
+                    # until input closes or the bounded grace expires
+                    # — a momentarily-empty inbox is not proof nothing
+                    # more is coming
+                    if self._input_closed.is_set() \
+                            or self.clock() >= grace_until:
+                        break
+                    continue
+                job_id = None
+                try:
+                    job_id = parse_request(line.strip())["id"]
+                except RequestError as e:
+                    # parse_request wraps every failure (bad JSON
+                    # included) in RequestError, so this arm is total
+                    job_id = e.job_id
+                if line.strip():
+                    # count it received: the stats must reconcile
+                    # (received == admitted + rejected-at-the-door)
+                    self.stats["received"] += 1
+                    self._emit_rejection(
+                        job_id, "serve daemon shutting down "
+                        "(received, not yet admitted)", reply)
+        if self.reporter is not None:
+            from ..parallel.batch import runner_cache_stats
+            from .queue import instance_cache_stats
+
+            exec_cache = getattr(self.dispatcher, "exec_cache", None)
+            self.reporter.serve(
+                event="stopped" if self._stop.is_set() else "drained",
+                queue_depth=self.admission.depth(),
+                # serving wall time excluding interpreter/jax startup:
+                # the denominator bench_serve prices throughput with
+                uptime_s=round(self.clock() - t_start, 6),
+                stats=dict(self.stats),
+                admission=dict(self.admission.stats),
+                dispatcher=dict(self.dispatcher.stats),
+                instance_cache=instance_cache_stats(),
+                runner_cache=runner_cache_stats(),
+                exec_cache=(dict(exec_cache.stats)
+                            if exec_cache is not None else None))
+        return dict(self.stats)
+
+    # --------------------------------------------------- oneshot drive
+
+    def run_oneshot(self, lines) -> Dict[str, int]:
+        """Feed ``lines``, close input, run to drain — the socket-free
+        smoke path (``serve --oneshot jobs.jsonl``)."""
+        for line in lines:
+            self.feed(line)
+        self.close_input()
+        return self.run()

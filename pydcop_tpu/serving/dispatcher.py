@@ -1,0 +1,125 @@
+"""Batched dispatch of admitted job groups onto the compiled data
+plane.
+
+One :class:`DispatchGroup` becomes one vmapped program execution: the
+group's padded instances go through ``parallel/batch.runner_for_rung``
+(so revisited rungs reuse the in-process compiled runner) and — when an
+executable cache is attached — through the ``jax.stages`` disk cache,
+so a freshly restarted daemon's first dispatch of a known rung is a
+deserialize, not a retrace+compile.
+
+Compiled-program economics force one extra shaping step the campaign
+path doesn't need: a dynamic batch's size is whatever happened to be
+queued (1..max_batch), and every distinct batch size is a distinct
+compiled program.  The dispatcher therefore pads the batch axis to the
+next power of two by REPEATING the last instance (inert rows, sliced
+off before decode), bounding the compile universe per rung at
+log2(max_batch)+1 programs instead of max_batch.
+
+Results stream back as v1 ``summary`` records (one per job, with
+``queue_wait_s`` and rung attribution) plus one ``serve`` dispatch
+record carrying queue depth, wait stats, spans and cache counters —
+the telemetry `bench_serve` and the warm-start tests assert on.
+"""
+
+import time
+from typing import Any, Callable, Dict, List
+
+from ..parallel.batch import runner_for_rung, runner_cache_stats
+from ..parallel.bucketing import next_pow2
+from .queue import DispatchGroup
+
+
+class Dispatcher:
+    """Executes dispatch groups; owns no queue state of its own."""
+
+    def __init__(self, reporter=None, exec_cache=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 batch_pow2: bool = True):
+        self.reporter = reporter
+        self.exec_cache = exec_cache
+        self.clock = clock
+        self.batch_pow2 = bool(batch_pow2)
+        self.stats: Dict[str, int] = {"dispatches": 0, "jobs": 0}
+        #: spans of the most recent dispatch (tests read this)
+        self.last_spans: Dict[str, float] = {}
+
+    def dispatch(self, group: DispatchGroup,
+                 queue_depth: int = 0) -> List[Dict[str, Any]]:
+        """Run one group; emit and return its per-job summary
+        records."""
+        jobs = group.jobs
+        algo, params_t, max_cycles, rung_sig = group.key
+        params = dict(params_t)
+        B = len(jobs)
+        padded_B = next_pow2(B) if self.batch_pow2 else B
+        instances = [j.padded for j in jobs]
+        seeds = [j.seed for j in jobs]
+        if padded_B > B:
+            instances += [instances[-1]] * (padded_B - B)
+            seeds += [seeds[-1]] * (padded_B - B)
+
+        t0 = self.clock()
+        runner = runner_for_rung(algo, instances, params,
+                                 rung_signature=rung_sig,
+                                 exec_cache=self.exec_cache)
+        sel, cycles, finished = runner.run(max_cycles=max_cycles,
+                                           seeds=seeds)
+        costs, viols = runner.evaluate(sel)
+        decoded = runner.decode(sel)
+        elapsed = self.clock() - t0
+        self.last_spans = dict(runner.last_spans)
+        # per-job `time` is EXECUTE wall amortized over the batch, per
+        # the documented schema — compile/deserialize live in the
+        # spans field, and folding a cold rung's compile into every
+        # job's time would make identical jobs read 100x apart
+        exec_s = runner.last_spans.get("execute_s", elapsed)
+        now = self.clock()
+        waits = [max(0.0, now - j.t_admitted) for j in jobs]
+
+        records = []
+        for i, job in enumerate(jobs):
+            assignment = {
+                name: job.dcop.variable(name).domain.values[int(v)]
+                for name, v in zip(job.arrays.var_names, decoded[i])}
+            rec = {
+                "job_id": job.job_id,
+                # the job's REAL algorithm, overriding the reporter's
+                # own 'serve' stamp: consumers filter v1 records by
+                # algo, and the --out file and socket replies must
+                # agree on it
+                "algo": algo,
+                "status": ("FINISHED" if bool(finished[i])
+                           else "MAX_CYCLES"),
+                "assignment": assignment,
+                "cost": float(costs[i]),
+                "violation": int(viols[i]),
+                "cycle": int(cycles[i]),
+                "time": exec_s / B,
+                "queue_wait_s": round(waits[i], 6),
+                "batch": B,
+                "dispatch_reason": group.reason,
+            }
+            if "precision" in params:
+                rec["precision"] = params["precision"]
+            records.append(rec)
+            if self.reporter is not None:
+                self.reporter.summary(**rec)
+            if job.reply is not None:
+                job.reply(dict(rec, record="summary", mode="serve"))
+
+        self.stats["dispatches"] += 1
+        self.stats["jobs"] += B
+        if self.reporter is not None:
+            spans = dict(runner.last_spans)
+            self.reporter.serve(
+                event="dispatch", reason=group.reason,
+                rung=list(rung_sig), batch=B, padded_batch=padded_B,
+                queue_depth=int(queue_depth),
+                wait_s={"max": round(max(waits), 6),
+                        "mean": round(sum(waits) / len(waits), 6)},
+                spans=spans,
+                exec_cache=(dict(self.exec_cache.stats)
+                            if self.exec_cache is not None else None),
+                runner_cache=runner_cache_stats())
+        return records

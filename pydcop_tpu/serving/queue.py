@@ -1,0 +1,269 @@
+"""Admission queue with dynamic batching over the bucketing ladder.
+
+The campaign path (``commands/batch.py``) sees every job up front and
+can plan a consolidated padding ladder; a service sees jobs one at a
+time.  Admission therefore assigns each arriving job its power-of-two
+HOME rung (``parallel/bucketing.home_rung``) immediately and groups
+jobs by ``(algo, solver params, cycle budget, rung signature)`` — the
+exact identity under which the batched runners share one compiled
+program.  Mixed-precision jobs can never share a rung by construction:
+the resolved precision policy is a solver param, so it is part of the
+group key.
+
+Dispatch policy — the two classic dynamic-batching triggers, whichever
+fires first per group:
+
+* **rung fills**: a group reaches ``max_batch`` queued jobs;
+* **deadline**: the OLDEST job in a group has waited
+  ``max_delay_s`` (or its own tighter per-job ``deadline_ms``).
+
+The clock is injected (``clock=time.monotonic`` by default) so the
+trigger logic is testable with a fake clock — no sleeps in the test
+tier.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..parallel.bucketing import ShapeProfile, home_rung
+
+
+@dataclass
+class AdmittedJob:
+    """One validated, array-built, rung-padded job waiting to batch."""
+
+    job_id: str
+    request: Dict[str, Any]
+    dcop: Any                 # the loaded DCOP (value decode at emit)
+    arrays: Any               # unpadded instance arrays (true shape)
+    padded: Any               # padded to the home rung's shape
+    group_key: Tuple          # (algo, params, max_cycles, rung sig)
+    seed: int
+    max_cycles: int
+    deadline_s: Optional[float] = None  # per-job dispatch deadline
+    reply: Optional[Callable[[Dict[str, Any]], None]] = None
+    t_admitted: float = 0.0
+
+
+@dataclass
+class DispatchGroup:
+    """Jobs popped together for one batched dispatch."""
+
+    key: Tuple
+    jobs: List[AdmittedJob]
+    reason: str               # "full" | "deadline" | "drain"
+
+
+#: admission-side instance cache: (abspath, mtime, family, precision)
+#: -> (dcop, arrays, home rung, padded arrays).  A service is fed the
+#: same model files over and over (perturbed costs arrive as NEW files
+#: with new mtimes, so staleness is keyed away); re-parsing the yaml
+#: and rebuilding+repadding the arrays per request was measurably the
+#: admission bottleneck in bench_serve, equalizing the two dispatch
+#: policies it exists to compare.  FIFO-bounded like the runner cache.
+_INSTANCE_CACHE: Dict[Tuple, Tuple] = {}
+_INSTANCE_CACHE_CAP = 128
+_INSTANCE_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def instance_cache_stats() -> Dict[str, int]:
+    """Admission-cache counters for the final serve record — parity
+    with the runner/executable caches, whose effectiveness is likewise
+    visible in telemetry."""
+    return dict(_INSTANCE_CACHE_STATS, size=len(_INSTANCE_CACHE),
+                cap=_INSTANCE_CACHE_CAP)
+
+
+def _load_instance(path: str, family: str,
+                   precision: Optional[str]) -> Tuple:
+    """(dcop, arrays, rung, padded) for one model file, cached on the
+    file's identity + build-relevant options."""
+    import os
+
+    from ..dcop.dcop import filter_dcop
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+
+    try:
+        st = os.stat(path)
+    except OSError:
+        raise ValueError(f"dcop file not found: {path}")
+    # mtime_ns + size, not float mtime: coarse-granularity filesystems
+    # would otherwise serve a stale model after an in-place rewrite
+    # within the same second
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size, family,
+           precision)
+    entry = _INSTANCE_CACHE.get(key)
+    if entry is not None:
+        _INSTANCE_CACHE_STATS["hits"] += 1
+        return entry
+    _INSTANCE_CACHE_STATS["misses"] += 1
+    dcop = load_dcop_from_file(path)
+    if family == "factor":
+        arrays = FactorGraphArrays.build(dcop, arity_sorted=True,
+                                         precision=precision)
+    else:
+        arrays = HypergraphArrays.build(filter_dcop(dcop),
+                                        precision=precision)
+    rung = home_rung(ShapeProfile.of(arrays))
+    entry = (dcop, arrays, rung, rung.pad(arrays))
+    while len(_INSTANCE_CACHE) >= _INSTANCE_CACHE_CAP:
+        _INSTANCE_CACHE.pop(next(iter(_INSTANCE_CACHE)))
+    _INSTANCE_CACHE[key] = entry
+    return entry
+
+
+def prepare_job(request: Dict[str, Any],
+                default_max_cycles: int = 2000,
+                default_seed: int = 0,
+                default_precision: Optional[str] = None,
+                reply: Optional[Callable] = None) -> AdmittedJob:
+    """A validated request -> :class:`AdmittedJob`: load the instance
+    (through the admission cache), validate/cast the algorithm params
+    exactly like ``solve`` does, and pad to the home rung.  Any failure
+    raises ``ValueError`` (the daemon turns it into a structured
+    rejection — one bad job never takes the service down)."""
+    import os
+
+    from ..commands import CliError, build_algo_def, parse_algo_params
+    from ..commands.batch import FUSABLE_ALGOS
+    from ..ops.precision import ENV_VAR as PRECISION_ENV
+    from ..ops.precision import resolve as resolve_precision
+
+    algo = request["algo"]
+    algo_params = list(request.get("algo_params", []))
+    try:
+        algo_def = build_algo_def(algo, algo_params, "min")
+        given = parse_algo_params(algo_params)
+    except CliError as e:
+        raise ValueError(str(e))
+    params = {k: algo_def.params[k] for k in given}
+    params.pop("stop_cycle", None)
+    params.pop("seed", None)
+    from ..algorithms import param_bool
+
+    if param_bool(params.get("bnb", False)):
+        # same loud rejection as parallel/batch.py: pruning plans are
+        # per-instance cube constants, incompatible with vmapped
+        # instance arguments
+        raise ValueError(
+            "bnb pruned reductions have no vmapped batch solver; "
+            "serve cannot batch this job")
+    requested_precision = (request.get("precision")
+                           or params.get("precision")
+                           or default_precision
+                           or os.environ.get(PRECISION_ENV))
+    if requested_precision:
+        # normalized to the POLICY name so "auto" and its resolution
+        # land in the same rung, and so the group key (which must keep
+        # mixed-precision jobs apart) compares canonical names
+        params["precision"] = resolve_precision(
+            requested_precision).name
+
+    dcop, arrays, rung, padded = _load_instance(
+        request["dcop"], FUSABLE_ALGOS[algo],
+        params.get("precision"))
+    max_cycles = int(request.get("max_cycles", default_max_cycles))
+    group_key = (algo, tuple(sorted(params.items())), max_cycles,
+                 rung.signature)
+    deadline_ms = request.get("deadline_ms")
+    return AdmittedJob(
+        job_id=request["id"], request=request, dcop=dcop,
+        arrays=arrays, padded=padded, group_key=group_key,
+        seed=int(request.get("seed", default_seed)),
+        max_cycles=max_cycles,
+        deadline_s=(float(deadline_ms) / 1000.0
+                    if deadline_ms is not None else None),
+        reply=reply)
+
+
+class AdmissionQueue:
+    """Per-group FIFO queues plus the two dispatch triggers."""
+
+    def __init__(self, max_batch: int = 8, max_delay_s: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_delay_s < 0:
+            raise ValueError(
+                f"max_delay_s must be >= 0, got {max_delay_s}")
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self.clock = clock
+        self._groups: Dict[Tuple, List[AdmittedJob]] = {}
+        self.stats: Dict[str, int] = {
+            "admitted": 0, "dispatched_full": 0,
+            "dispatched_deadline": 0, "drained": 0}
+
+    # ------------------------------------------------------- admission
+
+    def admit(self, job: AdmittedJob) -> int:
+        """Queue ``job`` with its group; returns the group's new
+        depth."""
+        job.t_admitted = self.clock()
+        group = self._groups.setdefault(job.group_key, [])
+        group.append(job)
+        self.stats["admitted"] += 1
+        return len(group)
+
+    def depth(self) -> int:
+        return sum(len(g) for g in self._groups.values())
+
+    # -------------------------------------------------------- dispatch
+
+    def _deadline_of(self, job: AdmittedJob) -> float:
+        """The absolute clock time at which ``job`` forces a dispatch:
+        admission time + the tighter of the daemon delay and the job's
+        own deadline."""
+        delay = self.max_delay_s
+        if job.deadline_s is not None:
+            delay = min(delay, job.deadline_s)
+        return job.t_admitted + delay
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest absolute deadline across all queued jobs (the
+        daemon sleeps until then), or None when empty.  Min over ALL
+        jobs, not group heads: a tighter per-job ``deadline_ms`` on a
+        later arrival can make it the earliest."""
+        deadlines = [self._deadline_of(j)
+                     for g in self._groups.values() for j in g]
+        return min(deadlines) if deadlines else None
+
+    def due(self) -> List[DispatchGroup]:
+        """Pop every group chunk whose trigger has fired: full rungs
+        first (oldest ``max_batch`` jobs per pop, repeatedly), then
+        deadline-expired remainders.  The deadline test mins over the
+        whole group (not just its head) so a tight per-job
+        ``deadline_ms`` fires wherever the job sits in the rung — and
+        stays consistent with :meth:`next_deadline`, which the daemon
+        sleeps on."""
+        now = self.clock()
+        out: List[DispatchGroup] = []
+        for key in list(self._groups):
+            group = self._groups[key]
+            while len(group) >= self.max_batch:
+                out.append(DispatchGroup(
+                    key, group[:self.max_batch], "full"))
+                del group[:self.max_batch]
+                self.stats["dispatched_full"] += 1
+            if group and min(self._deadline_of(j)
+                             for j in group) <= now:
+                out.append(DispatchGroup(key, group[:], "deadline"))
+                group.clear()
+                self.stats["dispatched_deadline"] += 1
+            if not group:
+                del self._groups[key]
+        return out
+
+    def drain(self) -> List[DispatchGroup]:
+        """Pop EVERYTHING (shutdown / oneshot end-of-input), in
+        max_batch-sized chunks so drain dispatches stay bounded."""
+        out: List[DispatchGroup] = []
+        for key in list(self._groups):
+            group = self._groups.pop(key)
+            for i in range(0, len(group), self.max_batch):
+                out.append(DispatchGroup(
+                    key, group[i:i + self.max_batch], "drain"))
+                self.stats["drained"] += 1
+        return out

@@ -1,0 +1,136 @@
+"""Request/response schema of the `serve` daemon.
+
+Requests are JSONL — one JSON object per line, arriving over a unix
+socket, stdin, or a ``--oneshot`` file.  Every line is validated HERE,
+before any file I/O or array building, and a malformed line costs a
+structured rejection response, never a daemon crash: admission is the
+trust boundary of a long-running service.
+
+A request::
+
+    {"id": "job-1", "dcop": "coloring.yaml", "algo": "maxsum",
+     "algo_params": ["damping:0.5"], "max_cycles": 200, "seed": 3,
+     "precision": "bf16", "deadline_ms": 25}
+
+``id``, ``dcop`` and ``algo`` are required; everything else is
+optional.  Unknown fields are rejected loudly (a typoed ``dedline_ms``
+silently ignored would be a latency bug nobody can see).
+
+Responses reuse the v1 JSONL telemetry schema
+(:mod:`~pydcop_tpu.observability.report`): each job's result is ONE
+``summary`` record (``job_id``, ``status``, ``assignment``, ``cost``,
+``violation``, ``cycle``, ``queue_wait_s``, rung attribution), and
+daemon-side telemetry rides ``serve`` records — so a serve output file
+is readable by the exact tooling that already consumes ``solve
+--telemetry`` files.
+"""
+
+import json
+from typing import Any, Dict, Optional
+
+#: algorithms the serving data plane accepts: exactly the vmapped
+#: batched families (commands/batch.py FUSABLE_ALGOS is the same set —
+#: asserted by the test tier so the two can never drift)
+SERVABLE_ALGOS = ("maxsum", "dsa", "mgm")
+
+#: every accepted request field -> short doc (the schema, used both
+#: for validation and the docs)
+REQUEST_FIELDS = {
+    "op": "optional, must be 'solve' (the only op; reserved)",
+    "id": "required job id (non-empty string, unique per client)",
+    "dcop": "required path to the DCOP yaml file",
+    "algo": f"required algorithm, one of {', '.join(SERVABLE_ALGOS)}",
+    "algo_params": "optional list of 'name:value' algorithm params",
+    "max_cycles": "optional cycle budget (positive int)",
+    "seed": "optional engine seed (int)",
+    "precision": "optional mixed-precision policy: f32 | bf16 | auto",
+    "deadline_ms": "optional per-job dispatch deadline (positive ms); "
+                   "tightens the daemon's --max-delay-ms for the rung "
+                   "this job waits in",
+}
+
+_PRECISIONS = ("f32", "bf16", "auto")
+
+
+class RequestError(ValueError):
+    """A malformed request; ``job_id`` is carried when the line was at
+    least parseable enough to name one, so the rejection response can
+    still be correlated by the client."""
+
+    def __init__(self, message: str, job_id: Optional[str] = None):
+        super().__init__(message)
+        self.job_id = job_id
+
+
+def parse_request(line: str) -> Dict[str, Any]:
+    """One JSONL line -> validated request dict."""
+    try:
+        rec = json.loads(line)
+    except ValueError as e:
+        raise RequestError(f"request is not valid JSON: {e}")
+    if not isinstance(rec, dict):
+        raise RequestError(
+            f"request must be a JSON object, got {type(rec).__name__}")
+    return validate_request(rec)
+
+
+def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Schema check; raises :class:`RequestError` naming the offending
+    field.  Returns ``rec`` unchanged on success."""
+    job_id = rec.get("id")
+    if not isinstance(job_id, str) or not job_id.strip():
+        raise RequestError("request missing 'id' (non-empty string)")
+    # normalize ONCE: every downstream record (accepted or rejected)
+    # must correlate by the same id, stripped
+    job_id = rec["id"] = job_id.strip()
+
+    def bad(msg):
+        return RequestError(msg, job_id=job_id)
+
+    unknown = sorted(set(rec) - set(REQUEST_FIELDS))
+    if unknown:
+        raise bad(f"unknown request field(s): {', '.join(unknown)}")
+    if rec.get("op", "solve") != "solve":
+        raise bad(f"unsupported op {rec.get('op')!r}; only 'solve'")
+    dcop = rec.get("dcop")
+    if not isinstance(dcop, str) or not dcop:
+        raise bad("request missing 'dcop' (yaml file path)")
+    algo = rec.get("algo")
+    if algo not in SERVABLE_ALGOS:
+        raise bad(
+            f"algo {algo!r} has no vmapped batch solver; servable: "
+            f"{', '.join(SERVABLE_ALGOS)}")
+    ap = rec.get("algo_params", [])
+    if not (isinstance(ap, list)
+            and all(isinstance(p, str) and ":" in p for p in ap)):
+        raise bad("'algo_params' must be a list of 'name:value' "
+                  "strings")
+    mc = rec.get("max_cycles")
+    # bool is a subclass of int: `true` would silently become a
+    # 1-cycle budget, the exact coercion class this schema rejects
+    if mc is not None and (isinstance(mc, bool)
+                           or not isinstance(mc, int) or mc < 1):
+        raise bad(f"'max_cycles' must be a positive int, got {mc!r}")
+    seed = rec.get("seed")
+    if seed is not None and (isinstance(seed, bool)
+                             or not isinstance(seed, int)):
+        raise bad(f"'seed' must be an int, got {seed!r}")
+    prec = rec.get("precision")
+    if prec is not None and prec not in _PRECISIONS:
+        raise bad(f"'precision' must be one of "
+                  f"{', '.join(_PRECISIONS)}, got {prec!r}")
+    dl = rec.get("deadline_ms")
+    if dl is not None and (not isinstance(dl, (int, float))
+                           or isinstance(dl, bool) or dl <= 0):
+        raise bad(f"'deadline_ms' must be a positive number, "
+                  f"got {dl!r}")
+    return rec
+
+
+def rejection(job_id: Optional[str], reason: str,
+              **extra) -> Dict[str, Any]:
+    """The structured rejection body (goes out as a ``summary`` record
+    with ``status: REJECTED`` — same kind as a result, so clients need
+    one reader)."""
+    return {"job_id": job_id or "?", "status": "REJECTED",
+            "error": str(reason), **extra}

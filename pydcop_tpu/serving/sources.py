@@ -1,0 +1,116 @@
+"""Input sources feeding a :class:`~pydcop_tpu.serving.daemon.ServeLoop`.
+
+Three ways requests reach the daemon, all producing the same JSONL
+lines into the loop's inbox:
+
+* :func:`stdin_source` — a reader thread over ``sys.stdin`` (the
+  default ``pydcop serve`` mode: pipe requests in, EOF drains);
+* :class:`SocketServer` — a unix-domain-socket accept loop, one reader
+  thread per connection; each client's jobs get a ``reply`` callback
+  that streams that job's ``summary`` record back over ITS connection
+  (newline-delimited JSON), independent of the shared ``--out`` file;
+* ``serve --oneshot FILE`` — no thread at all: the CLI feeds the file's
+  lines and drains (``ServeLoop.run_oneshot``), which is how the test
+  tier exercises the daemon without sockets.
+"""
+
+import json
+import os
+import socket
+import threading
+
+from .daemon import ServeLoop
+
+
+def stdin_source(loop: ServeLoop, stream=None) -> threading.Thread:
+    """Start the stdin reader thread; EOF closes the loop's input (the
+    loop then drains and exits)."""
+    import sys
+
+    stream = stream if stream is not None else sys.stdin
+
+    def read():
+        try:
+            for line in stream:
+                loop.feed(line)
+        finally:
+            loop.close_input()
+
+    t = threading.Thread(target=read, name="serve-stdin", daemon=True)
+    t.start()
+    return t
+
+
+class SocketServer:
+    """Unix-domain-socket acceptor for a serve loop."""
+
+    def __init__(self, loop: ServeLoop, path: str, backlog: int = 16):
+        self.loop = loop
+        self.path = path
+        if os.path.exists(path):
+            import stat as _stat
+
+            # a stale socket file from a killed daemon blocks bind;
+            # refuse to steal a LIVE one — and never delete something
+            # that is not a socket at all (a typoed --socket pointing
+            # at a real file must error, not destroy it)
+            if not _stat.S_ISSOCK(os.stat(path).st_mode):
+                raise OSError(
+                    f"--socket path {path} exists and is not a "
+                    "socket; refusing to remove it")
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(path)
+            except OSError:
+                os.remove(path)
+            else:
+                probe.close()
+                raise OSError(
+                    f"socket {path} is in use by a live daemon")
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(backlog)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._read_conn, args=(conn,),
+                             name="serve-conn", daemon=True).start()
+
+    def _read_conn(self, conn: socket.socket):
+        wlock = threading.Lock()
+
+        def reply(record: dict):
+            # best effort: a client that hung up forfeits its replies,
+            # the shared --out jsonl still has them
+            try:
+                data = (json.dumps(record) + "\n").encode()
+                with wlock:
+                    conn.sendall(data)
+            except OSError:
+                pass
+
+        try:
+            with conn, conn.makefile("r", encoding="utf-8",
+                                     errors="replace") as f:
+                for line in f:
+                    self.loop.feed(line, reply=reply)
+        except OSError:
+            pass
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._sock.close()
+        finally:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass

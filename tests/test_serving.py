@@ -1,0 +1,643 @@
+"""Solver-as-a-service (ISSUE 9).
+
+Layers under test:
+
+* ``serving/schema.py`` — request validation at the trust boundary;
+* ``serving/queue.py`` — admission onto the bucketing ladder and BOTH
+  dynamic-batching triggers, driven by an injected fake clock (no
+  sleeps): rung fills first, deadline fires first, per-job deadlines,
+  mixed-precision rung isolation;
+* ``serving/daemon.py`` — end-of-input drain and the SIGTERM contract
+  (in-flight rung completes, queued jobs get structured rejections);
+* ``serving/dispatcher.py`` + ``commands/serve.py --oneshot`` — the
+  socket-free smoke path, bit-consistent with the per-job engine solve;
+* ``engine/_cache.ExecutableCache`` + ``parallel/batch.py`` — the
+  jax.stages executable cache: a SECOND serve process handling a rung
+  already compiled by the first shows NO compile span, only a
+  deserialize (the ISSUE 9 warm-start acceptance criterion), with
+  identical results;
+* ``runner_for_rung`` — the configurable bound
+  (``PYDCOP_TPU_RUNNER_CACHE``) and the hits/misses/evictions counters
+  surfaced in serve telemetry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.serving.queue import (AdmissionQueue, AdmittedJob,
+                                      prepare_job)
+from pydcop_tpu.serving.schema import (RequestError, parse_request,
+                                       rejection, validate_request)
+
+pytestmark = pytest.mark.serve
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _job(jid, key, deadline_s=None, seed=0):
+    """A queue-logic-only job: the trigger machinery reads nothing but
+    ids, keys and deadlines."""
+    return AdmittedJob(job_id=jid, request={"id": jid}, dcop=None,
+                       arrays=None, padded=None, group_key=key,
+                       seed=seed, max_cycles=10, deadline_s=deadline_s)
+
+
+def _write_instance(path, name, edges, nv, w):
+    lines = [f"name: {name}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(nv):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k, (a, b) in enumerate(edges):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {w + k} if v{a} == v{b} else 0}}")
+    lines.append("agents: [%s]"
+                 % ", ".join(f"a{i}" for i in range(nv)))
+    path.write_text("\n".join(lines) + "\n")
+
+
+@pytest.fixture
+def instances(tmp_path):
+    specs = [("chain4", [(0, 1), (1, 2), (2, 3)], 4, 3),
+             ("ring5", [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)], 5, 5)]
+    files = {}
+    for name, edges, nv, w in specs:
+        p = tmp_path / f"{name}.yaml"
+        _write_instance(p, name, edges, nv, w)
+        files[name] = str(p)
+    return files
+
+
+# -------------------------------------------------------------- schema
+
+
+def test_request_schema_valid_and_parity():
+    rec = validate_request({"id": "a", "dcop": "x.yaml",
+                            "algo": "maxsum",
+                            "algo_params": ["damping:0.5"],
+                            "max_cycles": 10, "seed": 3,
+                            "precision": "bf16", "deadline_ms": 5})
+    assert rec["id"] == "a"
+    # the servable set IS the vmapped-batch set; drift would admit
+    # jobs the dispatcher cannot batch
+    from pydcop_tpu.commands.batch import FUSABLE_ALGOS
+    from pydcop_tpu.serving.schema import SERVABLE_ALGOS
+
+    assert set(SERVABLE_ALGOS) == set(FUSABLE_ALGOS)
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ({"dcop": "x.yaml", "algo": "maxsum"}, "id"),
+    ({"id": "a", "algo": "maxsum"}, "dcop"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "dpop"}, "vmapped"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "dedline_ms": 5}, "unknown request field"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "max_cycles": 0}, "max_cycles"),
+    # bool is a subclass of int: `true` must not become a 1-cycle run
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "max_cycles": True}, "max_cycles"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "seed": False}, "seed"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "deadline_ms": -1}, "deadline_ms"),
+    ({"id": "a", "dcop": "x.yaml", "algo": "maxsum",
+      "precision": "f16"}, "precision"),
+])
+def test_request_schema_rejects_with_field_named(bad, needle):
+    with pytest.raises(RequestError, match=needle):
+        validate_request(bad)
+
+
+def test_parse_request_carries_job_id_when_parseable():
+    try:
+        parse_request(json.dumps({"id": "j9", "algo": "nope",
+                                  "dcop": "x"}))
+    except RequestError as e:
+        assert e.job_id == "j9"
+    else:
+        pytest.fail("expected RequestError")
+    with pytest.raises(RequestError):
+        parse_request("{not json")
+    rej = rejection(None, "boom")
+    assert rej["status"] == "REJECTED" and rej["job_id"] == "?"
+
+
+# ------------------------------------------- queue triggers (fake clock)
+
+
+def test_rung_fills_first():
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=3, max_delay_s=10.0, clock=clock)
+    for i in range(2):
+        q.admit(_job(f"j{i}", ("k",)))
+    assert q.due() == []               # neither trigger fired
+    q.admit(_job("j2", ("k",)))
+    groups = q.due()                   # full fires with NO clock move
+    assert len(groups) == 1
+    assert groups[0].reason == "full"
+    assert [j.job_id for j in groups[0].jobs] == ["j0", "j1", "j2"]
+    assert q.depth() == 0
+
+
+def test_deadline_fires_first():
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=8, max_delay_s=0.05, clock=clock)
+    q.admit(_job("j0", ("k",)))
+    clock.advance(0.02)
+    q.admit(_job("j1", ("k",)))
+    assert q.due() == []
+    assert q.next_deadline() == pytest.approx(0.05)  # oldest job's
+    clock.advance(0.04)                # j0 is now 60 ms old
+    groups = q.due()
+    assert len(groups) == 1
+    assert groups[0].reason == "deadline"
+    # the whole partial rung rides the oldest job's deadline
+    assert [j.job_id for j in groups[0].jobs] == ["j0", "j1"]
+
+
+def test_per_job_deadline_tightens_the_daemon_delay():
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=8, max_delay_s=1.0, clock=clock)
+    q.admit(_job("fast", ("k",), deadline_s=0.01))
+    assert q.next_deadline() == pytest.approx(0.01)
+    clock.advance(0.02)
+    assert [g.reason for g in q.due()] == ["deadline"]
+
+
+def test_per_job_deadline_fires_from_behind_the_group_head():
+    """A tight ``deadline_ms`` on a NON-head job must dispatch the
+    whole rung it waits in — and agree with ``next_deadline`` (the
+    time the daemon sleeps until), else the loop busy-spins on a
+    deadline ``due()`` never honors."""
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=8, max_delay_s=1.0, clock=clock)
+    q.admit(_job("patient", ("k",)))            # head: 1.0 s deadline
+    clock.advance(0.001)
+    q.admit(_job("urgent", ("k",), deadline_s=0.01))
+    assert q.next_deadline() == pytest.approx(0.011)
+    clock.advance(0.02)                          # past urgent's, not head's
+    groups = q.due()
+    assert [g.reason for g in groups] == ["deadline"]
+    assert [j.job_id for j in groups[0].jobs] == ["patient", "urgent"]
+
+
+def test_full_pops_repeatedly_and_oldest_first():
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=2, max_delay_s=10.0, clock=clock)
+    for i in range(5):
+        q.admit(_job(f"j{i}", ("k",)))
+    groups = q.due()
+    assert [g.reason for g in groups] == ["full", "full"]
+    assert [[j.job_id for j in g.jobs] for g in groups] == \
+        [["j0", "j1"], ["j2", "j3"]]
+    assert q.depth() == 1              # j4 waits for its deadline
+
+
+def test_groups_are_isolated_by_key_and_drain_chunks():
+    clock = FakeClock()
+    q = AdmissionQueue(max_batch=2, max_delay_s=10.0, clock=clock)
+    for i in range(3):
+        q.admit(_job(f"a{i}", ("ka",)))
+    q.admit(_job("b0", ("kb",)))
+    # distinct keys never co-dispatch
+    groups = q.due()
+    assert len(groups) == 1
+    assert all(j.group_key == ("ka",) for j in groups[0].jobs)
+    drained = q.drain()
+    assert sorted(len(g.jobs) for g in drained) == [1, 1]
+    assert all(g.reason == "drain" for g in drained)
+    assert q.depth() == 0
+
+
+# ------------------------------------- admission builds real group keys
+
+
+def test_mixed_precision_jobs_never_share_a_rung(instances):
+    base = {"id": "x", "dcop": instances["chain4"], "algo": "maxsum",
+            "max_cycles": 10}
+    j_f32 = prepare_job(dict(base, precision="f32"))
+    j_bf16 = prepare_job(dict(base, precision="bf16"))
+    j_f32b = prepare_job(dict(base, id="y", precision="f32"))
+    assert j_f32.group_key != j_bf16.group_key
+    assert j_f32.group_key == j_f32b.group_key
+    # the rung SIGNATURE part matches — only the params differ
+    assert j_f32.group_key[3] == j_bf16.group_key[3]
+    assert dict(j_bf16.group_key[1])["precision"] == "bf16"
+
+
+def test_group_key_separates_algo_cycles_and_topology(instances):
+    a = prepare_job({"id": "a", "dcop": instances["chain4"],
+                     "algo": "dsa", "max_cycles": 10})
+    b = prepare_job({"id": "b", "dcop": instances["chain4"],
+                     "algo": "dsa", "max_cycles": 20})
+    c = prepare_job({"id": "c", "dcop": instances["ring5"],
+                     "algo": "dsa", "max_cycles": 10})
+    d = prepare_job({"id": "d", "dcop": instances["chain4"],
+                     "algo": "mgm", "max_cycles": 10})
+    keys = {a.group_key, b.group_key, c.group_key, d.group_key}
+    assert len(keys) == 4
+    # same topology family and budget -> same rung, ready to batch
+    a2 = prepare_job({"id": "a2", "dcop": instances["chain4"],
+                      "algo": "dsa", "max_cycles": 10})
+    assert a2.group_key == a.group_key
+
+
+def test_admission_rejects_bnb_and_bad_params(instances):
+    with pytest.raises(ValueError, match="bnb"):
+        prepare_job({"id": "a", "dcop": instances["chain4"],
+                     "algo": "maxsum", "algo_params": ["bnb:1"]})
+    with pytest.raises(ValueError):
+        prepare_job({"id": "a", "dcop": instances["chain4"],
+                     "algo": "maxsum",
+                     "algo_params": ["nosuchparam:1"]})
+    with pytest.raises(ValueError, match="not found"):
+        prepare_job({"id": "a", "dcop": "/does/not/exist.yaml",
+                     "algo": "maxsum"})
+
+
+# ------------------------------------------------ serve loop semantics
+
+
+class _StubDispatcher:
+    """Records groups; optionally stops the loop mid-dispatch (the
+    SIGTERM-arrives-while-a-rung-runs scenario)."""
+
+    def __init__(self, stop_loop=None):
+        self.groups = []
+        self.stop_loop = stop_loop
+        self.stats = {"dispatches": 0, "jobs": 0}
+        self.exec_cache = None
+
+    def dispatch(self, group, queue_depth=0):
+        self.groups.append(group)
+        self.stats["dispatches"] += 1
+        self.stats["jobs"] += len(group.jobs)
+        if self.stop_loop is not None:
+            self.stop_loop()
+        return [{"job_id": j.job_id, "status": "FINISHED"}
+                for j in group.jobs]
+
+
+def _loop(tmp_path, instances, max_batch=2, stub=None):
+    from pydcop_tpu.observability.report import RunReporter
+    from pydcop_tpu.serving.daemon import ServeLoop
+
+    reporter = RunReporter(str(tmp_path / "serve.jsonl"), algo="serve",
+                           mode="serve")
+    admission = AdmissionQueue(max_batch=max_batch, max_delay_s=0.01)
+    dispatcher = stub if stub is not None else _StubDispatcher()
+    loop = ServeLoop(admission, dispatcher, reporter=reporter,
+                     default_max_cycles=10)
+    line = lambda jid: json.dumps(
+        {"id": jid, "dcop": instances["chain4"], "algo": "dsa"})
+    return loop, dispatcher, reporter, line
+
+
+def test_sigterm_drain_inflight_completes_queued_rejected(
+        tmp_path, instances):
+    """The shutdown satellite: stop arrives DURING a dispatch — that
+    rung completes and is delivered; the job still queued (group of
+    one, waiting on its deadline) gets a structured rejection."""
+    from pydcop_tpu.observability.report import (read_records,
+                                                 validate_record)
+
+    stub_holder = {}
+    stub = _StubDispatcher(
+        stop_loop=lambda: stub_holder["loop"].request_stop())
+    loop, dispatcher, reporter, line = _loop(
+        tmp_path, instances, max_batch=2, stub=stub)
+    stub_holder["loop"] = loop
+    for jid in ("j0", "j1", "j2"):     # j0+j1 fill the rung; j2 waits
+        loop.feed(line(jid))
+    stats = loop.run()
+    reporter.close()
+    assert [sorted(j.job_id for j in g.jobs)
+            for g in dispatcher.groups] == [["j0", "j1"]]
+    assert stats["rejected"] == 1 and stats["completed"] == 2
+    records = read_records(str(tmp_path / "serve.jsonl"))
+    for rec in records:
+        validate_record(rec)
+    rejections = [r for r in records
+                  if r.get("status") == "REJECTED"]
+    assert [r["job_id"] for r in rejections] == ["j2"]
+    assert "shutting down" in rejections[0]["error"]
+    final = records[-1]
+    assert final["record"] == "serve" and final["event"] == "stopped"
+    assert "runner_cache" in final
+
+
+def test_malformed_model_file_rejects_not_crashes(tmp_path, instances):
+    """A dcop file that EXISTS but holds invalid yaml (or a
+    structurally bad DCOP) raises outside the ValueError family —
+    admission must still turn it into a structured rejection, not a
+    daemon crash."""
+    from pydcop_tpu.observability.report import read_records
+
+    bad = tmp_path / "corrupt.yaml"
+    bad.write_text("variables: [unclosed\n  nonsense: {{{{\n")
+    loop, dispatcher, reporter, line = _loop(tmp_path, instances,
+                                             max_batch=8)
+    stats = loop.run_oneshot([
+        json.dumps({"id": "corrupt", "dcop": str(bad),
+                    "algo": "maxsum"}),
+        line("ok0"),
+    ])
+    reporter.close()
+    assert stats["completed"] == 1 and stats["rejected"] == 1
+    records = read_records(str(tmp_path / "serve.jsonl"))
+    rej = [r for r in records if r.get("status") == "REJECTED"]
+    assert [r["job_id"] for r in rej] == ["corrupt"]
+    final = records[-1]
+    assert final["event"] == "drained"
+    assert final["instance_cache"]["misses"] >= 1
+
+
+def test_dispatch_failure_rejects_group_daemon_survives(
+        tmp_path, instances):
+    """A group whose dispatch RAISES (device OOM, a solver bug on that
+    shape) must reject its own jobs with a structured reason while
+    every other group still dispatches and the daemon exits
+    normally."""
+    from pydcop_tpu.observability.report import read_records
+
+    class _FlakyDispatcher(_StubDispatcher):
+        def dispatch(self, group, queue_depth=0):
+            if any(j.job_id == "poison" for j in group.jobs):
+                raise RuntimeError("XLA compile exploded")
+            return super().dispatch(group, queue_depth)
+
+    stub = _FlakyDispatcher()
+    loop, dispatcher, reporter, line = _loop(tmp_path, instances,
+                                             max_batch=8, stub=stub)
+    poison = json.dumps({"id": "poison", "dcop": instances["chain4"],
+                         "algo": "mgm"})     # its own group
+    stats = loop.run_oneshot([line("ok0"), poison, line("ok1")])
+    reporter.close()
+    assert stats["completed"] == 2 and stats["rejected"] == 1
+    records = read_records(str(tmp_path / "serve.jsonl"))
+    rej = [r for r in records if r.get("status") == "REJECTED"]
+    assert [r["job_id"] for r in rej] == ["poison"]
+    assert "dispatch failed" in rej[0]["error"]
+    assert rej[0]["algo"] == "mgm"
+    assert records[-1]["event"] == "drained"
+
+
+def test_end_of_input_drains_without_rejection(tmp_path, instances):
+    loop, dispatcher, reporter, line = _loop(tmp_path, instances,
+                                             max_batch=8)
+    stats = loop.run_oneshot([line("j0"), line("j1"), "",
+                              "not even json"])
+    reporter.close()
+    # both real jobs dispatched as ONE drain group; the garbage line
+    # was rejected at admission, the blank line ignored
+    assert [sorted(j.job_id for j in g.jobs)
+            for g in dispatcher.groups] == [["j0", "j1"]]
+    assert stats["completed"] == 2 and stats["rejected"] == 1
+    from pydcop_tpu.observability.report import read_records
+
+    final = read_records(str(tmp_path / "serve.jsonl"))[-1]
+    assert final["event"] == "drained"
+
+
+# ------------------------------------ dispatcher + oneshot, end to end
+
+
+def test_dispatcher_pow2_batch_padding(tmp_path, instances):
+    """A 3-job group runs as a padded batch of 4 (one program per
+    power-of-two batch size, not per batch size) and still emits
+    exactly 3 correct per-job records."""
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.queue import DispatchGroup
+
+    jobs = [prepare_job({"id": f"j{i}", "dcop": instances["chain4"],
+                         "algo": "dsa", "max_cycles": 10, "seed": i})
+            for i in range(3)]
+    assert len({j.group_key for j in jobs}) == 1
+    disp = Dispatcher()
+    records = disp.dispatch(
+        DispatchGroup(jobs[0].group_key, jobs, "deadline"))
+    assert [r["job_id"] for r in records] == ["j0", "j1", "j2"]
+    assert all(r["batch"] == 3 for r in records)
+    assert all(len(r["assignment"]) == 4 for r in records)
+
+
+def test_oneshot_smoke_bit_consistent_with_engine(tmp_path, instances):
+    """``serve --oneshot``: drain a mixed file (two algos, two
+    topologies, one malformed job) in-process; every result matches
+    the per-job engine solve (assignment, cost AND cycles), every
+    record validates against the v1 schema."""
+    from pydcop_tpu.dcop_cli import main
+    from pydcop_tpu.infrastructure.run import solve_result
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.observability.report import (read_records,
+                                                 validate_record)
+
+    jobs = [
+        {"id": "m1", "dcop": instances["chain4"], "algo": "maxsum",
+         "max_cycles": 25},
+        {"id": "m2", "dcop": instances["ring5"], "algo": "maxsum",
+         "max_cycles": 25},
+        {"id": "d1", "dcop": instances["chain4"], "algo": "dsa",
+         "max_cycles": 15, "seed": 1},
+        {"id": "bad", "dcop": instances["chain4"], "algo": "dpop"},
+    ]
+    jobs_path = tmp_path / "jobs.jsonl"
+    jobs_path.write_text(
+        "".join(json.dumps(j) + "\n" for j in jobs))
+    out = tmp_path / "serve.jsonl"
+    rc = main(["serve", "--oneshot", str(jobs_path), "--out", str(out),
+               "--no-exec-cache", "--max-batch", "4",
+               "--max-delay-ms", "20"])
+    assert rc == 0
+    records = read_records(str(out))
+    for rec in records:
+        validate_record(rec)
+    by_id = {r["job_id"]: r for r in records
+             if r.get("record") == "summary"}
+    assert by_id["bad"]["status"] == "REJECTED"
+    # result records carry the JOB's algorithm, not the reporter's
+    # 'serve' stamp — consumers filter the v1 stream by algo
+    assert by_id["m1"]["algo"] == "maxsum"
+    assert by_id["d1"]["algo"] == "dsa"
+    for job in jobs[:3]:
+        res = solve_result(load_dcop_from_file(job["dcop"]),
+                           job["algo"], timeout=60,
+                           max_cycles=job["max_cycles"],
+                           seed=job.get("seed", 0))
+        rec = by_id[job["id"]]
+        assert rec["assignment"] == dict(res.assignment), job["id"]
+        assert rec["cycle"] == res.cycles, job["id"]
+        assert abs(rec["cost"] - res.cost) < 1e-6, job["id"]
+        assert rec["queue_wait_s"] >= 0
+    serve_recs = [r for r in records if r["record"] == "serve"]
+    assert serve_recs[-1]["event"] == "drained"
+    dispatches = [r for r in serve_recs if r["event"] == "dispatch"]
+    assert dispatches and all("spans" in r and "runner_cache" in r
+                              for r in dispatches)
+
+
+# ------------------------------------------- executable cache (warm start)
+
+
+def test_executable_cache_roundtrip_and_corruption(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exe"))
+    jitted = jax.jit(lambda x: x * 2 + 1)
+    args = (jnp.arange(4, dtype=jnp.float32),)
+    key = ("unit", "roundtrip")
+    assert cache.load(key) is None           # miss
+    compiled = jitted.lower(*args).compile()
+    assert cache.store(key, compiled)
+    loaded = cache.load(key)
+    assert loaded is not None
+    assert np.array_equal(np.asarray(loaded(*args)),
+                          np.asarray(compiled(*args)))
+    assert cache.stats["hits"] == 1 and cache.stats["misses"] == 1
+    # corruption is a MISS (callers recompile), never an exception
+    for f in os.listdir(cache.path):
+        with open(os.path.join(cache.path, f), "wb") as fh:
+            fh.write(b"garbage")
+    assert cache.load(key) is None
+    assert cache.stats["errors"] == 1
+
+
+def test_executable_cache_disabled_by_env(tmp_path, monkeypatch):
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    monkeypatch.setenv("PYDCOP_TPU_NO_CACHE", "1")
+    cache = ExecutableCache(path=str(tmp_path / "exe"))
+    assert not cache.enabled
+    assert cache.load(("k",)) is None
+    assert cache.store(("k",), object()) is False
+
+
+def _run_serve_subprocess(tmp_path, jobs_path, out, exec_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+         "--oneshot", str(jobs_path), "--out", str(out),
+         "--exec-cache", str(exec_dir), "--max-batch", "4",
+         "--max-delay-ms", "20"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    from pydcop_tpu.observability.report import read_records
+
+    return read_records(str(out))
+
+
+def test_serve_warm_start_across_processes(tmp_path, instances):
+    """The ISSUE 9 acceptance criterion: a second `serve` PROCESS
+    handling a rung already compiled by the first shows no compile
+    span at all in its dispatch telemetry — the jax.stages executable
+    was deserialized from the shared cache — and returns identical
+    results."""
+    jobs = [
+        {"id": "m1", "dcop": instances["chain4"], "algo": "maxsum",
+         "max_cycles": 25},
+        {"id": "d1", "dcop": instances["chain4"], "algo": "dsa",
+         "max_cycles": 15, "seed": 1},
+    ]
+    jobs_path = tmp_path / "jobs.jsonl"
+    jobs_path.write_text(
+        "".join(json.dumps(j) + "\n" for j in jobs))
+    exec_dir = tmp_path / "exec_cache"
+    cold = _run_serve_subprocess(tmp_path, jobs_path,
+                                 tmp_path / "cold.jsonl", exec_dir)
+    warm = _run_serve_subprocess(tmp_path, jobs_path,
+                                 tmp_path / "warm.jsonl", exec_dir)
+
+    def dispatches(records):
+        return [r for r in records
+                if r.get("record") == "serve"
+                and r.get("event") == "dispatch"]
+
+    cold_d, warm_d = dispatches(cold), dispatches(warm)
+    assert len(cold_d) == len(warm_d) == 2
+    for rec in cold_d:
+        assert rec["spans"]["compile_s"] > 0
+        assert rec["spans"]["trace_lower_s"] > 0
+        # the deserialize span marks a HIT: cold dispatches (miss ->
+        # compile) must not carry it, so consumers can classify
+        # cold/warm by presence
+        assert "deserialize_s" not in rec["spans"], rec["spans"]
+        assert "eval_deserialize_s" not in rec["spans"], rec["spans"]
+    # the warm process never compiled NOR retraced — neither the run
+    # program nor the evaluator: only deserializes and the execution
+    # itself appear in its spans
+    for rec in warm_d:
+        for k in ("compile_s", "trace_lower_s", "eval_compile_s",
+                  "eval_trace_lower_s"):
+            assert k not in rec["spans"], rec["spans"]
+        assert rec["spans"]["deserialize_s"] > 0
+        assert rec["spans"]["eval_deserialize_s"] > 0
+    # two dispatches x (run program + evaluator) each
+    assert warm_d[-1]["exec_cache"]["hits"] == 4
+    assert warm_d[-1]["exec_cache"]["misses"] == 0
+    assert cold_d[-1]["exec_cache"]["stores"] == 4
+    # warm results are the cold results, bit for bit
+    def results(records):
+        return {r["job_id"]: (r["assignment"], r["cost"], r["cycle"])
+                for r in records if r.get("record") == "summary"}
+
+    assert results(warm) == results(cold)
+
+
+# ------------------------------------------------- runner cache bounds
+
+
+def test_runner_cache_env_bound_and_stats(instances, monkeypatch):
+    from pydcop_tpu.parallel import batch as pbatch
+
+    # isolate from other tests' cache state
+    monkeypatch.setattr(pbatch, "_RUNNER_CACHE", {})
+    monkeypatch.setattr(
+        pbatch, "_RUNNER_CACHE_STATS",
+        {"hits": 0, "misses": 0, "evictions": 0})
+    monkeypatch.setenv(pbatch.RUNNER_CACHE_ENV, "1")
+    jobs = [prepare_job({"id": f"j{i}", "dcop": instances[name],
+                         "algo": "dsa", "max_cycles": 5})
+            for name in ("chain4", "ring5") for i in range(2)]
+    by_key = {}
+    for j in jobs:
+        by_key.setdefault(j.group_key, []).append(j.padded)
+    (key_a, insts_a), (key_b, insts_b) = sorted(
+        by_key.items(), key=lambda kv: str(kv[0]))
+    params = {"stop_cycle": 5}
+    r1 = pbatch.runner_for_rung("dsa", insts_a, params,
+                                rung_signature=key_a[3])
+    r1b = pbatch.runner_for_rung("dsa", insts_a, params,
+                                 rung_signature=key_a[3])
+    assert r1b is r1
+    pbatch.runner_for_rung("dsa", insts_b, params,
+                           rung_signature=key_b[3])
+    stats = pbatch.runner_cache_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 2
+    assert stats["evictions"] == 1     # cap 1: the second build evicts
+    assert stats["size"] == 1 and stats["cap"] == 1
+
+    monkeypatch.setenv(pbatch.RUNNER_CACHE_ENV, "zero")
+    with pytest.raises(ValueError, match="PYDCOP_TPU_RUNNER_CACHE"):
+        pbatch.runner_cache_cap()
