@@ -1450,6 +1450,113 @@ def bench_bnb_pruning(quick=False):
     }
 
 
+def bench_dynamic(quick=False):
+    """Dynamic-DCOP A/B (ISSUE 10): a 20-event scenario over a
+    10k-var coloring mesh — cold-solve-per-event (a fresh solver +
+    engine per perturbed instance, the pre-dynamics workflow) vs the
+    warm delta replay (ONE compiled program, in-place plane edits,
+    carried message state).  THE contract, asserted in the bench:
+    after the first solve, every warm ``apply(delta)`` dispatch shows
+    ZERO ``compile_s``/``trace_lower_s`` spans — re-solves re-enter
+    the same executable.  The cold leg pays a trace (+ compile or
+    XLA-disk-cache load) per event by construction.  Host-CPU
+    numbers, labeled; event mix: cost updates + constraint add/remove
+    pairs (the reserve knob provisions the add capacity)."""
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.dynamics import DynamicEngine
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+
+    n = 1024 if quick else 10_000
+    e = 3 * n
+    n_events = 8 if quick else 20
+    max_cycles = 24 if quick else 48
+    arrays = coloring_factor_arrays(n, e, 3, seed=7)
+    rng = np.random.RandomState(11)
+
+    def make_events():
+        """The 20-event mix over factor names c0..c{e-1}: mostly cost
+        updates, every 4th event an add+remove pair (edit capacity
+        from the reserve)."""
+        events = []
+        for i in range(n_events):
+            if i % 4 == 3:
+                u, v = rng.randint(0, n, size=2)
+                events.append([
+                    {"type": "add_constraint", "name": f"dyn{i}",
+                     "scope": [arrays.var_names[u],
+                               arrays.var_names[v if v != u
+                                                else (u + 1) % n]],
+                     "costs": rng.randint(0, 9,
+                                          size=(3, 3)).tolist()},
+                ] + ([{"type": "remove_constraint",
+                       "name": f"dyn{i - 4}"}] if i >= 7 else []))
+            else:
+                picks = rng.randint(0, e, size=4)
+                events.append([
+                    {"type": "change_costs", "name": f"c{int(f)}",
+                     "costs": rng.randint(0, 9,
+                                          size=(3, 3)).tolist()}
+                    for f in picks])
+        return events
+
+    events = make_events()
+
+    # ---- warm leg: one engine, in-place deltas, carried state
+    eng = DynamicEngine(arrays, reserve="vars:8,2:32",
+                        chunk_size=max_cycles)
+    t0 = time.perf_counter()
+    r0 = eng.solve(max_cycles=max_cycles)
+    first_s = time.perf_counter() - t0
+    assert "trace_lower_s" in r0["spans"] or \
+        "deserialize_s" in r0["spans"]
+    t0 = time.perf_counter()
+    for ev in events:
+        eng.apply(ev)
+        r = eng.solve(max_cycles=max_cycles)
+        if "compile_s" in r["spans"] or "trace_lower_s" in r["spans"]:
+            raise RuntimeError(
+                f"warm contract violated: re-solve spans {r['spans']}"
+                f" carry a trace/compile after the first solve")
+        if not r["warm_start"]:
+            raise RuntimeError("warm contract violated: dispatch "
+                               "not marked warm_start")
+    warm_s = time.perf_counter() - t0
+
+    # ---- cold leg: a fresh solver + engine per perturbed instance
+    # (the same edited planes, so both legs solve identical problems)
+    eng2 = DynamicEngine(arrays, reserve="vars:8,2:32")
+    cold_s = 0.0
+    for ev in events:
+        eng2.apply(ev)
+        snap = eng2.instance.snapshot_arrays()
+        t0 = time.perf_counter()
+        solver = MaxSumSolver(snap)
+        engine = SyncEngine(solver, chunk_size=max_cycles)
+        engine.run(max_cycles=max_cycles)
+        cold_s += time.perf_counter() - t0
+
+    return {
+        "metric": f"dynamic_scenario_{n}var_{n_events}events",
+        "value": {
+            "first_solve_s": round(first_s, 3),
+            "warm_replay_s": round(warm_s, 3),
+            "warm_per_event_ms": round(1000 * warm_s / n_events, 2),
+            "cold_per_event_s": round(cold_s / n_events, 3),
+            "cold_replay_s": round(cold_s, 3),
+            "speedup": round(cold_s / max(warm_s, 1e-9), 1),
+        },
+        "unit": "seconds",
+        "events": n_events,
+        "max_cycles": max_cycles,
+        "contracts_asserted": True,  # zero trace/compile spans warm
+        "hardware": jax.default_backend(),
+    }
+
+
 BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_dpop_device_widetree, bench_dpop_sharded_util,
            bench_dpop_meetings, bench_localsearch_10k, bench_batched,
@@ -1457,7 +1564,7 @@ BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_batch_campaign_fused, bench_nary_fastpath,
            bench_mesh_dispatch, bench_hetero_batch, bench_precision,
            bench_telemetry_overhead, bench_decimation,
-           bench_bnb_pruning, bench_serve]
+           bench_bnb_pruning, bench_serve, bench_dynamic]
 
 
 def main():

@@ -102,6 +102,19 @@ def set_parser(subparsers):
                              "costs 2 bytes instead of 4, so the same "
                              "cap admits rungs twice as large (fewer "
                              "compiled programs).  Default: no cap")
+    parser.add_argument("--reserve-slots", dest="reserve_slots",
+                        type=str, default=None, metavar="SPEC",
+                        help="explicit phantom headroom for every "
+                             "--fuse-hetero rung, as 'vars:N,ARITY:N'"
+                             " (extra variable rows / per-arity "
+                             "factor slots beyond the power-of-two "
+                             "ladder) — provisions in-place edit "
+                             "capacity for dynamic workloads "
+                             "(docs/architecture.md dynamics "
+                             "section).  The reservation is part of "
+                             "each rung's shape and is echoed in the "
+                             "fused result rows and the "
+                             "[fuse-hetero] stats line")
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
@@ -356,7 +369,8 @@ def _append_jsonl(path: str, job_id: str, result: dict):
 def _run_fused_group(key, rows, out_dir, register_done,
                      consolidated_out=None, hetero=False,
                      precision=None, max_rung_mb=None,
-                     telemetry=None, decimation=None):
+                     telemetry=None, decimation=None,
+                     reserve=None):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -445,13 +459,14 @@ def _run_fused_group(key, rows, out_dir, register_done,
         reporter.header(
             algo_params=list(algo_params), max_cycles=max_cycles,
             jobs=len(rows), precision=precision_name,
-            hetero=bool(hetero))
+            hetero=bool(hetero), reserve=reserve)
 
     try:
         _run_fused_group_inner(
             key, rows, out_dir, register_done, consolidated_out,
             hetero, algo, params, max_cycles, explicit_seed,
-            precision_name, policy, max_rung_mb, reporter)
+            precision_name, policy, max_rung_mb, reporter,
+            reserve=reserve)
     finally:
         if reporter is not None:
             reporter.close()
@@ -460,7 +475,8 @@ def _run_fused_group(key, rows, out_dir, register_done,
 def _run_fused_group_inner(key, rows, out_dir, register_done,
                            consolidated_out, hetero, algo, params,
                            max_cycles, explicit_seed, precision_name,
-                           policy, max_rung_mb, reporter):
+                           policy, max_rung_mb, reporter,
+                           reserve=None):
     import numpy as np
 
     from ..dcop.yamldcop import load_dcop_from_file
@@ -597,7 +613,8 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
         profiles,
         max_rung_bytes=(None if max_rung_mb is None
                         else int(max_rung_mb * 2 ** 20)),
-        bytes_per_cell=policy.store_itemsize)
+        bytes_per_cell=policy.store_itemsize,
+        reserve=reserve)
     programs = 0
     job_true = job_padded = 0
     for ri, rung in enumerate(rungs):
@@ -605,8 +622,10 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
             # a rung of one topology needs no padding at all
             sub = topo_groups[rung.members[0]]
             run_exact(sub,
-                      lambda path, ri=ri: {"fuse_rung": ri,
-                                           "padding_waste": 1.0})
+                      lambda path, ri=ri: dict(
+                          {"fuse_rung": ri, "padding_waste": 1.0},
+                          **({"reserve": reserve} if reserve
+                             else {})))
             programs += 1
             job_true += profiles[rung.members[0]].cells * len(sub)
             job_padded += profiles[rung.members[0]].cells * len(sub)
@@ -638,8 +657,10 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
         # masked decode: phantom variables never reach the results
         emit(sub, runner.decode(sel), costs, viols, cycles, finished,
              elapsed,
-             lambda path, ri=ri: {"fuse_rung": ri,
-                                  "padding_waste": waste_of[path]},
+             lambda path, ri=ri: dict(
+                 {"fuse_rung": ri,
+                  "padding_waste": waste_of[path]},
+                 **({"reserve": reserve} if reserve else {})),
              "fused-hetero",
              cycle_metrics=runner.last_cycle_metrics
              if reporter is not None else None)
@@ -648,7 +669,8 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
     # program-count contract reads it, campaign authors grep it
     print(f"[fuse-hetero] jobs={len(rows)} programs={programs} "
           f"rungs={len(rungs)} "
-          f"waste={job_padded / max(job_true, 1):.3f}")
+          f"waste={job_padded / max(job_true, 1):.3f}"
+          + (f" reserve={reserve}" if reserve else ""))
 
 
 def _fused_child_main(argv=None) -> int:
@@ -675,7 +697,8 @@ def _fused_child_main(argv=None) -> int:
                      precision=spec.get("precision"),
                      max_rung_mb=spec.get("max_rung_mb"),
                      telemetry=spec.get("telemetry"),
-                     decimation=spec.get("decimation"))
+                     decimation=spec.get("decimation"),
+                     reserve=spec.get("reserve"))
     return 0
 
 
@@ -692,6 +715,15 @@ def run_cmd(args, timeout=None):
         # instead of letting every fused child / solve job die on it
         try:
             _resolve_precision(os.environ[_PRECISION_ENV])
+        except ValueError as e:
+            raise CliError(str(e))
+    if getattr(args, "reserve_slots", None):
+        # same rule for a malformed --reserve-slots grammar: die at
+        # campaign startup, not inside every fused child
+        from ..parallel.bucketing import parse_reserve
+
+        try:
+            parse_reserve(args.reserve_slots)
         except ValueError as e:
             raise CliError(str(e))
     with open(args.bench_def) as f:
@@ -770,6 +802,8 @@ def run_cmd(args, timeout=None):
                                               None),
                         "max_rung_mb": getattr(args, "max_rung_mb",
                                                None),
+                        "reserve": getattr(args, "reserve_slots",
+                                           None),
                         "telemetry": getattr(args, "telemetry", None),
                         "consolidated_out": getattr(
                             args, "consolidated_out", None)}, f)

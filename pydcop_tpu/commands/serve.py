@@ -80,6 +80,19 @@ def set_parser(subparsers):
                              "their own precision keep it (and never "
                              "share a rung with differently-policied "
                              "jobs)")
+    parser.add_argument("--reserve-slots", dest="reserve_slots",
+                        type=str, default=None,
+                        metavar="SPEC",
+                        help="explicit phantom-slot headroom every "
+                             "admitted rung is provisioned with, as "
+                             "'vars:N,ARITY:N' (e.g. vars:8,2:16): "
+                             "extra variable rows / per-arity factor "
+                             "slots beyond the power-of-two ladder, "
+                             "the edit capacity 'delta' jobs activate "
+                             "in place.  Part of the rung signature "
+                             "(jobs batch only with like-provisioned "
+                             "jobs); the remaining budget is echoed "
+                             "in delta dispatch telemetry")
     parser.add_argument("--exec-cache", dest="exec_cache",
                         type=str, default=None, metavar="DIR",
                         help="directory for serialized jax.stages rung "
@@ -112,11 +125,14 @@ def run_cmd(args, timeout=None):
     if args.max_delay_ms < 0:
         raise CliError("--max-delay-ms must be >= 0")
     from ..parallel.batch import runner_cache_cap
+    from ..parallel.bucketing import parse_reserve
 
     try:
         # a malformed PYDCOP_TPU_RUNNER_CACHE must kill the daemon at
         # STARTUP, not poison every dispatch's telemetry call later
         runner_cache_cap()
+        # same rule for a malformed --reserve-slots grammar
+        parse_reserve(getattr(args, "reserve_slots", None))
     except ValueError as e:
         raise CliError(str(e))
 
@@ -126,9 +142,11 @@ def run_cmd(args, timeout=None):
 
     reporter = RunReporter(args.out, algo="serve", mode="serve")
     try:
+        reserve = getattr(args, "reserve_slots", None)
         reporter.header(
             max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
             max_cycles=args.max_cycles, precision=args.precision,
+            reserve=reserve,
             exec_cache=(exec_cache.path
                         if exec_cache is not None
                         and exec_cache.enabled else None),
@@ -138,11 +156,13 @@ def run_cmd(args, timeout=None):
             max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1000.0)
         dispatcher = Dispatcher(reporter=reporter,
-                                exec_cache=exec_cache)
+                                exec_cache=exec_cache,
+                                reserve=reserve)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,
-                         default_precision=args.precision)
+                         default_precision=args.precision,
+                         reserve=reserve)
 
         # the SIGTERM contract: finish the in-flight rung, reject the
         # rest with a structured reason.  Registered here (not in

@@ -88,6 +88,44 @@ def set_parser(subparsers):
                         help="websocket UI port base (thread mode)")
     parser.add_argument("--max_cycles", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--scenario", type=str, default=None,
+                        metavar="FILE",
+                        help="dynamic-DCOP replay (maxsum, "
+                             "engine/sharded modes): after the "
+                             "initial solve, apply the scenario "
+                             "yaml's events (add_variable / "
+                             "remove_variable / add_constraint / "
+                             "remove_constraint / change_costs) as "
+                             "in-place edits of the phantom-padded "
+                             "instance and re-solve WARM — no "
+                             "retrace, no recompile, message state "
+                             "carried over for untouched regions "
+                             "(docs/architecture.md dynamics "
+                             "section).  Per-event results land in "
+                             "the 'scenario' result field and, with "
+                             "--telemetry, as summary records "
+                             "carrying edit/warm_start")
+    parser.add_argument("--reserve-slots", dest="reserve_slots",
+                        type=str, default=None, metavar="SPEC",
+                        help="explicit phantom headroom for "
+                             "--scenario: 'vars:N,ARITY:N' extra "
+                             "variable rows / per-arity factor slots "
+                             "beyond the power-of-two padding, the "
+                             "capacity add events activate (an event "
+                             "exceeding it is rejected loudly); the "
+                             "remaining budget is echoed in the "
+                             "result")
+    parser.add_argument("--carry", default="messages",
+                        choices=["messages", "reset"],
+                        help="--scenario warm-state policy: "
+                             "'messages' (default) carries the "
+                             "previous fixed point's q/r planes for "
+                             "untouched regions (conditional-Max-Sum "
+                             "partial update); 'reset' starts each "
+                             "re-solve from neutral messages — still "
+                             "retrace-free, and structurally "
+                             "bit-exact with a cold solve of the "
+                             "edited instance")
     parser.add_argument("--precision", default=None,
                         choices=["f32", "bf16", "auto"],
                         help="mixed-precision policy for the compiled "
@@ -245,8 +283,18 @@ def run_cmd(args, timeout: Optional[float] = None):
             "--decimation is not supported with amaxsum (stochastic "
             "edge activation undoes the freeze clamp); use maxsum "
             "for decimated runs")
+    if getattr(args, "reserve_slots", None) \
+            and not getattr(args, "scenario", None):
+        # same die-at-startup rule as batch/serve: a typoed or
+        # misplaced reservation must never be silently ignored
+        raise CliError(
+            "--reserve-slots provisions edit headroom for a dynamic "
+            "replay: it requires --scenario on solve")
     precision_name = _resolved_precision_name(args)
     dcop = load_dcop_from_file(args.dcop_files)
+    if getattr(args, "scenario", None):
+        return _run_scenario(args, dcop, t0, timeout,
+                             precision_name)
     algo_def = build_algo_def(args.algo, args.algo_params,
                               mode=dcop.objective)
     if precision_name and args.mode != "sharded" \
@@ -417,6 +465,124 @@ def run_cmd(args, timeout: Optional[float] = None):
         _append_end_metrics(args.end_metrics, result)
     output_json(result, args.output)
     return 0
+
+
+def _run_scenario(args, dcop, t0: float, timeout,
+                  precision_name: Optional[str]) -> int:
+    """``solve --scenario``: the warm dynamic-DCOP replay.  The
+    initial solve compiles once; every event re-solve re-enters the
+    same program (``dynamics/engine.py``) — the spans in the
+    telemetry records prove it."""
+    from . import output_json, parse_algo_params
+    from ..dcop.scenario import ScenarioError
+    from ..dcop.yamldcop import load_scenario_from_file
+    from ..dynamics import DeltaError, DynamicEngine, replay_scenario
+
+    if args.algo != "maxsum":
+        raise CliError(
+            "--scenario replays through the compiled scenario "
+            f"engine, which speaks maxsum only (got {args.algo!r})")
+    if args.mode not in ("engine", "sharded"):
+        raise CliError(
+            "--scenario needs the compiled data plane: mode engine "
+            f"or sharded, not {args.mode!r} (the orchestrated "
+            "runtime replays scenarios via the `run` command)")
+    if getattr(args, "decimation", None) or getattr(args, "bnb",
+                                                    False):
+        raise CliError(
+            "--scenario composes with neither --decimation nor "
+            "--bnb (both bake per-instance state the edits would "
+            "leave stale)")
+    try:
+        scenario = load_scenario_from_file(args.scenario)
+    except ScenarioError as e:
+        raise CliError(f"bad scenario {args.scenario}: {e}")
+    given = parse_algo_params(args.algo_params)
+    algo_def = build_algo_def(args.algo, args.algo_params,
+                              mode=dcop.objective)
+    # engine-only keys (stop_cycle/seed/layout) are stripped by
+    # DynamicEngine itself — ONE authority for the filter
+    params = {k: algo_def.params[k] for k in given}
+    if getattr(args, "precision", None):
+        params["precision"] = args.precision
+    try:
+        engine = DynamicEngine(
+            dcop, algo=args.algo, mode=args.mode,
+            reserve=getattr(args, "reserve_slots", None),
+            params=params, max_cycles=args.max_cycles,
+            carry=getattr(args, "carry", "messages"))
+    except ValueError as e:
+        raise CliError(str(e))
+
+    reporter = None
+    telemetry_path = getattr(args, "telemetry", None)
+    if telemetry_path:
+        from ..observability.report import RunReporter
+
+        reporter = RunReporter(telemetry_path, algo=args.algo,
+                               mode=args.mode)
+        reporter.header(
+            dcop=getattr(dcop, "name", None), seed=args.seed,
+            max_cycles=args.max_cycles,
+            precision=precision_name,
+            scenario=args.scenario,
+            carry=engine.carry,
+            reserve=getattr(args, "reserve_slots", None))
+    try:
+        replay = replay_scenario(
+            engine, scenario, max_cycles=args.max_cycles,
+            seed=args.seed, timeout=timeout, reporter=reporter)
+    except DeltaError as e:
+        raise CliError(
+            f"scenario event rejected ({e.kind}): {e} "
+            f"[{e.details}]")
+    finally:
+        if reporter is not None:
+            reporter.close()
+    solved = [e for e in replay["events"] if "assignment" in e]
+    final = solved[-1] if solved else replay["initial"]
+    result = {
+        "status": final["status"],
+        "assignment": final["assignment"],
+        "cost": final["cost"],
+        "violation": final["violation"],
+        "cycle": final["cycle"],
+        "time": time.perf_counter() - t0,
+        "scenario": {
+            "file": args.scenario,
+            "events_applied": len(solved),
+            "delays": sum(1 for e in replay["events"]
+                          if "delay" in e),
+            "carry": engine.carry,
+            "reserve": getattr(args, "reserve_slots", None),
+            "budget": replay["budget"],
+            "initial": _scenario_event_summary(replay["initial"]),
+            "events": [
+                e if "status" not in e
+                else _scenario_event_summary(e)
+                for e in replay["events"]],
+        },
+    }
+    if precision_name:
+        result["precision"] = precision_name
+    if args.end_metrics:
+        # per-run summary semantics: the FINAL state's numbers
+        result_row = dict(result, msg_count=0, msg_size=0)
+        _append_end_metrics(args.end_metrics, result_row)
+    output_json(result, args.output)
+    return 0
+
+
+def _scenario_event_summary(e: dict) -> dict:
+    """Per-event result row of the scenario block: everything except
+    the (potentially huge) per-event assignment — the top-level
+    result carries the final one."""
+    out = {k: e[k] for k in ("status", "cost", "violation", "cycle",
+                             "warm_start", "spans") if k in e}
+    for k in ("event", "edit"):
+        if e.get(k) is not None:
+            out[k] = e[k]
+    return out
 
 
 def _report_telemetry(path: str, args, res, result: dict, dcop=None):

@@ -1,11 +1,82 @@
 """Dynamic DCOP scenarios: timed event streams.
 
 reference parity: pydcop/dcop/scenario.py:37-108.
+
+The action vocabulary is validated here (ONE copy shared by the yaml
+loader, the serve ``delta`` job kind and the compiled scenario engine
+in ``pydcop_tpu/dynamics/``): a malformed event costs a structured
+:class:`ScenarioError` naming the event, the action index and the
+offending field — never a bare ``KeyError`` from deep inside a
+replay.
 """
 
-from typing import Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 from ..utils.simple_repr import SimpleRepr
+
+#: every known action type -> the argument names it REQUIRES (a tuple
+#: entry means "any of these", e.g. the reference dialect spells both
+#: ``agents: [a1, a2]`` and ``agent: a1``).  The agent-level actions
+#: (add_agent / remove_agent) drive the host orchestrator runtime
+#: (``commands/run.py``); the variable / factor / cost actions are
+#: the compiled dialect the dynamics engine applies as in-place array
+#: edits (``dynamics/deltas.py``).
+KNOWN_ACTIONS: Dict[str, tuple] = {
+    "add_agent": (("agents", "agent"),),
+    "remove_agent": (("agents", "agent"),),
+    "add_variable": ("name",),
+    "remove_variable": ("name",),
+    "add_constraint": ("name", "scope", "costs"),
+    "remove_constraint": ("name",),
+    "change_costs": ("name", "costs"),
+}
+
+
+class ScenarioError(ValueError):
+    """A malformed scenario/event/action; carries structured context
+    (``event``: event id when known, ``action``: action index within
+    the event, ``details``: free-form field dict) so callers — the
+    CLI, the serve daemon's rejection path, tests — can report the
+    exact offender instead of a stack trace."""
+
+    def __init__(self, message: str, event: Optional[str] = None,
+                 action: Optional[int] = None, **details):
+        parts = []
+        if event is not None:
+            parts.append(f"event {event!r}")
+        if action is not None:
+            parts.append(f"action #{action}")
+        prefix = " ".join(parts)
+        super().__init__(f"{prefix}: {message}" if prefix else message)
+        self.event = event
+        self.action = action
+        self.details = dict(details)
+
+
+def validate_action(type: str, args: Dict[str, Any],  # noqa: A002
+                    event: Optional[str] = None,
+                    action: Optional[int] = None) -> None:
+    """Check one action against the vocabulary: known type, every
+    required argument present.  Raises :class:`ScenarioError`."""
+    if not isinstance(type, str) or not type:
+        raise ScenarioError(
+            "action needs a non-empty string 'type'",
+            event=event, action=action, got=type)
+    if type not in KNOWN_ACTIONS:
+        raise ScenarioError(
+            f"unknown action type {type!r}; known: "
+            f"{', '.join(sorted(KNOWN_ACTIONS))}",
+            event=event, action=action, type=type)
+    missing = []
+    for req in KNOWN_ACTIONS[type]:
+        alts = req if isinstance(req, tuple) else (req,)
+        if not any(a in args for a in alts):
+            missing.append("|".join(alts))
+    if missing:
+        raise ScenarioError(
+            f"action {type!r} missing required argument(s): "
+            f"{', '.join(missing)}",
+            event=event, action=action, type=type, missing=missing)
 
 
 class EventAction(SimpleRepr):

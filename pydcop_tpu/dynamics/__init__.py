@@ -1,0 +1,23 @@
+"""Dynamic DCOP on the compiled data plane.
+
+The host runtime's dynamic machinery (``dcop/scenario.py``,
+``replication/``, ``reparation/``) redeploys agents; this subsystem
+instead turns a :class:`~pydcop_tpu.dcop.scenario.Scenario` into
+in-place array edits against a phantom-padded instance, so a
+perturbed instance re-solves WARM — no retrace, no recompile, message
+state carried over for everything the edit did not touch.  See
+``docs/architecture.md`` (dynamics section).
+"""
+
+from .deltas import (DeltaError, DynamicInstance, TopologyDelta,
+                     build_dynamic_instance)
+from .engine import DynamicEngine, eval_cost_violations_np
+from .replay import replay_batched, replay_scenario, \
+    scenario_descendants
+
+__all__ = [
+    "DeltaError", "DynamicEngine", "DynamicInstance",
+    "TopologyDelta", "build_dynamic_instance",
+    "eval_cost_violations_np", "replay_batched", "replay_scenario",
+    "scenario_descendants",
+]

@@ -1,0 +1,653 @@
+"""Compiled topology deltas: scenario actions as in-place array edits.
+
+The host runtime applies a :class:`~pydcop_tpu.dcop.scenario.Scenario`
+by tearing agents down and redeploying computations; the compiled data
+plane cannot afford that — every shape change is a retrace+recompile.
+This module is the alternative ROADMAP names the "traffic" workload:
+a phantom-padded instance (``graphs/arrays.py pad_to``) already
+reserves inert variable rows and factor slots, so a topology edit is
+**data, not shape** (PGMax, arXiv 2202.04110):
+
+* **variable add** — activate a reserved phantom row: flip
+  ``var_valid``, write the domain mask/size and the unary cost plane;
+* **variable / factor remove** — deactivate: restore the phantom form
+  (single 0-cost slot, identity cube anchored on the sink), which every
+  reduction masks out by construction;
+* **factor add** — claim a reserved phantom slot: write the cost cube,
+  the scope's variable ids, and the slot's canonical edge entries;
+* **cost update** — overwrite the cube cells, indices untouched.
+
+:func:`DynamicInstance.compile_event` turns one event's actions into a
+:class:`TopologyDelta` — a pytree of ``(index, plane)`` writes
+validated against the pad budget (a loud, structured
+:class:`DeltaError` when an event exceeds the reserved slots) —
+and :meth:`DynamicInstance.apply` executes the writes against the
+instance's own numpy planes.  The edited planes are program
+*arguments* of the warm engine (``dynamics/engine.py``), exactly like
+the fused campaign path's instances, so a re-solve after ``apply``
+re-enters the SAME compiled program: no retrace, no recompile.
+
+The delta also names the **touched** message rows: the warm engine
+resets exactly those edges' q/r state to neutral and carries everything
+else over from the previous fixed point — the partial-update semantics
+of conditional Max-Sum (arXiv 2502.13194).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dcop.scenario import DcopEvent, EventAction, validate_action
+from ..graphs.arrays import (BIG, FactorGraphArrays, _clip_costs,
+                             _phantom_cube, canonical_edge_layout)
+
+
+class DeltaError(ValueError):
+    """An event the instance cannot absorb: exceeded slot budget,
+    unknown/duplicate names, malformed cost tables.  ``kind`` is a
+    machine-readable class (``slot_budget`` / ``var_budget`` /
+    ``unknown_variable`` / ``unknown_constraint`` /
+    ``duplicate_variable`` / ``duplicate_constraint`` /
+    ``attached_factors`` / ``domain_budget`` / ``bad_args``) and
+    ``details`` carries the structured context (arity, budget, live
+    and free counts, names) — the serve daemon and the CLI surface
+    these as rejection records, never stack traces."""
+
+    def __init__(self, message: str, kind: str, **details):
+        super().__init__(message)
+        self.kind = kind
+        self.details = dict(details)
+
+
+@dataclass
+class TopologyDelta:
+    """One event compiled to fixed-shape plane writes.
+
+    Every array is a *write list* (row indices + replacement rows);
+    the delta's size scales with the edit, never with the instance.
+    ``touched_edges`` / ``touched_vars`` drive the warm engine's
+    message-state reset; ``summary`` is the ``edit`` field of the v1.1
+    telemetry schema (``observability/report.py EDIT_KEYS``).
+    """
+
+    summary: Dict[str, int]
+    # variable-plane writes
+    var_rows: np.ndarray                    # (n,) int64
+    var_valid: np.ndarray                   # (n,) bool
+    domain_size: np.ndarray                 # (n,) int32
+    domain_mask: np.ndarray                 # (n, D) bool
+    var_costs: np.ndarray                   # (n, D) f32
+    # per-bucket factor writes, aligned with arrays.buckets
+    bucket_slots: List[np.ndarray] = field(default_factory=list)
+    bucket_cubes: List[np.ndarray] = field(default_factory=list)
+    bucket_var_ids: List[np.ndarray] = field(default_factory=list)
+    # canonical edge-table writes
+    edge_ids: np.ndarray = None             # (k,)
+    edge_var: np.ndarray = None             # (k,)
+    # warm-state reset targets
+    touched_edges: np.ndarray = None        # (t,)
+    touched_vars: np.ndarray = None         # (u,)
+    # registry ops executed by DynamicInstance.apply, in order
+    registry: List[Tuple] = field(default_factory=list)
+
+
+def _as_actions(actions) -> List[Tuple[str, Dict[str, Any]]]:
+    """Normalize an event / EventAction list / dict list into
+    ``(type, args)`` pairs, validated against the scenario
+    vocabulary."""
+    if isinstance(actions, DcopEvent):
+        if actions.is_delay:
+            return []
+        actions = actions.actions or []
+    out = []
+    for i, a in enumerate(actions):
+        if isinstance(a, EventAction):
+            t, args = a.type, dict(a.args)
+        elif isinstance(a, dict):
+            t = a.get("type")
+            args = {k: v for k, v in a.items() if k != "type"}
+        else:
+            raise DeltaError(
+                f"action #{i} must be an EventAction or mapping, got "
+                f"{type(a).__name__}", kind="bad_args", action=i)
+        validate_action(t, args, action=i)
+        out.append((t, args))
+    return out
+
+
+def _padded_cost_cube(costs, dsizes: Sequence[int], D: int,
+                      sign: float, name: str) -> np.ndarray:
+    """A raw cost table -> the compiled padded cube: sign-applied,
+    hard-clipped, padded to ``(D,) * arity`` with BIG."""
+    cube = np.asarray(costs, dtype=np.float32)
+    expect = tuple(int(d) for d in dsizes)
+    if cube.size != int(np.prod(expect)):
+        raise DeltaError(
+            f"constraint {name!r} costs have {cube.size} entries, "
+            f"scope domains want {expect}", kind="bad_costs",
+            name=name, expected_shape=list(expect))
+    cube = _clip_costs(cube.reshape(expect), sign)
+    pads = [(0, D - s) for s in expect]
+    return np.pad(cube, pads, constant_values=BIG)
+
+
+class DynamicInstance:
+    """A mutable phantom-padded factor-graph instance plus the slot
+    registry deltas are validated against.
+
+    Owns deep copies of every plane, so edits never alias the arrays a
+    caller padded (or a sibling snapshot).  The canonical factor-major
+    edge layout ``pad_to`` emits is required — it is what makes a
+    factor slot's edge ids a static formula (``offset + slot*arity +
+    pos``) instead of a lookup.
+    """
+
+    def __init__(self, arrays: FactorGraphArrays,
+                 values_by_name: Optional[Dict[str, tuple]] = None):
+        if arrays.var_valid is None:
+            raise ValueError(
+                "DynamicInstance needs a phantom-padded instance "
+                "(FactorGraphArrays.pad_to); build one via "
+                "bucketing.home_rung(...).pad(arrays)")
+        self.arrays = _copy_arrays(arrays)
+        self.layout = canonical_edge_layout(self.arrays)
+        if self.layout is None:  # pragma: no cover - pad_to guarantees
+            raise ValueError(
+                "DynamicInstance needs the canonical factor-major "
+                "edge layout (pad_to emits it)")
+        a = self.arrays
+        self.sink = a.n_vars - 1
+        if bool(a.var_valid[self.sink]):
+            raise ValueError(
+                "the last padded row must stay a phantom sink "
+                "(anchor for deactivated factors); pad with at least "
+                "one phantom variable row")
+        values_by_name = values_by_name or {}
+        self.live_vars: Dict[str, int] = {}
+        self.values_of: Dict[int, Optional[tuple]] = {}
+        self.free_var_rows: List[int] = []
+        for row in range(a.n_vars):
+            if bool(a.var_valid[row]):
+                name = a.var_names[row]
+                self.live_vars[name] = row
+                v = values_by_name.get(name)
+                self.values_of[row] = tuple(v) if v is not None else None
+            elif row != self.sink:
+                self.free_var_rows.append(row)
+        # per-bucket factor registry: a slot is live iff its positions
+        # do not all anchor on the sink (pad_to's phantom form; a
+        # removed factor returns to exactly that form)
+        self.live_factors: Dict[str, Tuple[int, int]] = {}
+        self.free_slots: List[List[int]] = []
+        self.factors_of: Dict[int, set] = {}
+        for bi, b in enumerate(a.buckets):
+            free = []
+            for slot in range(b.var_ids.shape[0]):
+                rows = b.var_ids[slot]
+                if b.arity and bool(np.all(rows == self.sink)):
+                    free.append(slot)
+                    continue
+                name = a.factor_names[int(b.factor_ids[slot])]
+                self.live_factors[name] = (bi, slot)
+                for r in rows:
+                    self.factors_of.setdefault(int(r), set()).add(name)
+            self.free_slots.append(free)
+
+    # ------------------------------------------------------------ info
+
+    @property
+    def arity_of_bucket(self) -> List[int]:
+        return [b.arity for b in self.arrays.buckets]
+
+    def budget(self) -> Dict[str, Any]:
+        """The provisioned edit capacity, echoed in results and serve
+        telemetry: total/live/free slot counts per arity plus the
+        variable-row headroom."""
+        a = self.arrays
+        slots = {}
+        for bi, b in enumerate(a.buckets):
+            total = int(b.var_ids.shape[0])
+            free = len(self.free_slots[bi])
+            slots[int(b.arity)] = {"total": total, "free": free,
+                                   "live": total - free}
+        return {
+            "n_var_rows": int(a.n_vars),
+            "live_vars": len(self.live_vars),
+            "free_var_rows": len(self.free_var_rows),
+            "slots": slots,
+        }
+
+    def decode(self, sel: np.ndarray,
+               as_indices: bool = False) -> Dict[str, Any]:
+        """A full padded selection row -> ``{live var name: value}``.
+        Variables added by deltas occupy rows past the original
+        ``n_vars_true``, so the registry (not a slice) is the decode
+        authority."""
+        out = {}
+        for name, row in self.live_vars.items():
+            idx = int(sel[row])
+            values = self.values_of.get(row)
+            out[name] = idx if (as_indices or values is None) \
+                else values[idx]
+        return out
+
+    def snapshot_arrays(self) -> FactorGraphArrays:
+        """A deep copy of the current padded planes — one batched-
+        replay descendant (``dynamics/replay.py``)."""
+        return _copy_arrays(self.arrays)
+
+    def snapshot_decoder(self):
+        """A frozen ``(sel row) -> assignment`` decoder of the CURRENT
+        registry, safe to keep across later edits."""
+        live = dict(self.live_vars)
+        values = dict(self.values_of)
+
+        def decode(sel):
+            return {
+                name: (int(sel[row]) if values.get(row) is None
+                       else values[row][int(sel[row])])
+                for name, row in live.items()}
+        return decode
+
+    # --------------------------------------------------------- compile
+
+    def compile_event(self, actions) -> TopologyDelta:
+        """One event's actions -> a validated :class:`TopologyDelta`.
+
+        Pure with respect to the instance: validation runs against a
+        shadow of the registry (so an event may remove a factor and
+        then its variable), and nothing is written until
+        :meth:`apply`.  Raises :class:`DeltaError` — including the
+        loud slot-budget rejection when the event needs more phantom
+        capacity than ``pad_to``/``reserve`` provisioned.
+        """
+        a = self.arrays
+        D, sign = a.max_domain, a.sign
+        # shadow registries: sequential semantics without mutation
+        live_vars = dict(self.live_vars)
+        free_rows = list(self.free_var_rows)
+        live_factors = dict(self.live_factors)
+        free_slots = [list(s) for s in self.free_slots]
+        factors_of = {r: set(s) for r, s in self.factors_of.items()}
+        dsize = {}  # row -> shadow domain size (overlay)
+
+        def dsize_of(row):
+            return dsize.get(row, int(a.domain_size[row]))
+
+        var_writes: Dict[int, Tuple] = {}       # row -> planes
+        fac_writes: Dict[Tuple[int, int], Tuple] = {}  # (bi,slot)->..
+        edge_writes: Dict[int, int] = {}        # edge id -> var row
+        touched_edges: set = set()
+        touched_vars: set = set()
+        registry: List[Tuple] = []
+        summary: Dict[str, int] = {}
+
+        def bucket_of(arity):
+            for bi, b in enumerate(a.buckets):
+                if b.arity == arity:
+                    return bi
+            return None
+
+        def slot_edges(bi, slot):
+            offset, _slots, arity = self.layout[bi]
+            return offset + slot * arity + np.arange(arity,
+                                                     dtype=np.int64)
+
+        for t, args in _as_actions(actions):
+            summary[t] = summary.get(t, 0) + 1
+            if t in ("add_agent", "remove_agent"):
+                raise DeltaError(
+                    f"{t} is a host-runtime (orchestrator) action; "
+                    "the compiled scenario engine speaks the "
+                    "variable/constraint dialect (add_variable, "
+                    "remove_variable, add_constraint, "
+                    "remove_constraint, change_costs)",
+                    kind="bad_args", type=t)
+
+            if t == "add_variable":
+                name = args["name"]
+                if name in live_vars:
+                    raise DeltaError(
+                        f"variable {name!r} already exists",
+                        kind="duplicate_variable", name=name)
+                values = args.get("values")
+                costs = args.get("costs")
+                if values is None and costs is None:
+                    raise DeltaError(
+                        f"add_variable {name!r} needs 'values' "
+                        "(domain values) and/or 'costs' (unary "
+                        "costs)", kind="bad_args", name=name)
+                if values is None:
+                    values = list(range(len(costs)))
+                d = len(values)
+                if costs is None:
+                    costs = [0.0] * d
+                if len(costs) != d:
+                    raise DeltaError(
+                        f"add_variable {name!r}: {len(costs)} costs "
+                        f"for {d} domain values", kind="bad_args",
+                        name=name)
+                if not 1 <= d <= D:
+                    raise DeltaError(
+                        f"add_variable {name!r}: domain size {d} "
+                        f"exceeds the padded instance's max_domain "
+                        f"{D} (domains are a SHAPE, not editable "
+                        "data)", kind="domain_budget", name=name,
+                        domain=d, max_domain=D)
+                if not free_rows:
+                    raise DeltaError(
+                        f"add_variable {name!r}: no free phantom "
+                        f"variable rows left ({a.n_vars} padded rows,"
+                        f" {len(live_vars)} live, sink reserved); "
+                        "provision headroom with reserve / "
+                        "--reserve-slots vars:N",
+                        kind="var_budget", name=name,
+                        n_var_rows=int(a.n_vars),
+                        live=len(live_vars), free=0)
+                row = free_rows.pop(0)
+                mask = np.zeros(D, dtype=bool)
+                mask[:d] = True
+                plane = np.full(D, BIG, dtype=np.float32)
+                plane[:d] = _clip_costs(
+                    np.asarray(costs, dtype=np.float32), sign)
+                var_writes[row] = (True, d, mask, plane)
+                live_vars[name] = row
+                dsize[row] = d
+                touched_vars.add(row)
+                registry.append(("add_var", row, name, tuple(values)))
+
+            elif t == "remove_variable":
+                name = args["name"]
+                row = live_vars.get(name)
+                if row is None:
+                    raise DeltaError(
+                        f"unknown variable {name!r}",
+                        kind="unknown_variable", name=name)
+                attached = sorted(factors_of.get(row, ()))
+                if attached:
+                    raise DeltaError(
+                        f"remove_variable {name!r}: still in the "
+                        f"scope of {attached}; remove those "
+                        "constraints first (same event is fine)",
+                        kind="attached_factors", name=name,
+                        factors=attached)
+                mask = np.zeros(D, dtype=bool)
+                mask[0] = True
+                plane = np.full(D, BIG, dtype=np.float32)
+                plane[0] = 0.0
+                var_writes[row] = (False, 1, mask, plane)
+                del live_vars[name]
+                dsize[row] = 1
+                free_rows.append(row)
+                free_rows.sort()
+                touched_vars.add(row)
+                registry.append(("rm_var", row, name))
+
+            elif t == "add_constraint":
+                name = args["name"]
+                if name in live_factors:
+                    raise DeltaError(
+                        f"constraint {name!r} already exists",
+                        kind="duplicate_constraint", name=name)
+                scope = list(args["scope"])
+                if not scope:
+                    raise DeltaError(
+                        f"add_constraint {name!r}: empty scope",
+                        kind="bad_args", name=name)
+                rows = []
+                for vn in scope:
+                    r = live_vars.get(vn)
+                    if r is None:
+                        raise DeltaError(
+                            f"add_constraint {name!r}: unknown scope "
+                            f"variable {vn!r}",
+                            kind="unknown_variable", name=vn)
+                    rows.append(r)
+                arity = len(scope)
+                bi = bucket_of(arity)
+                free = free_slots[bi] if bi is not None else []
+                if bi is None or not free:
+                    have = (int(a.buckets[bi].var_ids.shape[0])
+                            if bi is not None else 0)
+                    raise DeltaError(
+                        f"add_constraint {name!r}: event exceeds the "
+                        f"reserved arity-{arity} slots ({have} "
+                        f"padded, 0 free); provision headroom with "
+                        f"reserve / --reserve-slots {arity}:N",
+                        kind="slot_budget", name=name, arity=arity,
+                        slots=have, free=0)
+                slot = free.pop(0)
+                cube = _padded_cost_cube(
+                    args["costs"], [dsize_of(r) for r in rows], D,
+                    sign, name)
+                fac_writes[(bi, slot)] = (cube,
+                                          np.asarray(rows,
+                                                     dtype=np.int32))
+                eids = slot_edges(bi, slot)
+                for e, r in zip(eids, rows):
+                    edge_writes[int(e)] = int(r)
+                    touched_edges.add(int(e))
+                live_factors[name] = (bi, slot)
+                for r in rows:
+                    factors_of.setdefault(r, set()).add(name)
+                registry.append(("add_factor", bi, slot, name,
+                                 tuple(rows)))
+
+            elif t == "remove_constraint":
+                name = args["name"]
+                pos = live_factors.get(name)
+                if pos is None:
+                    raise DeltaError(
+                        f"unknown constraint {name!r}",
+                        kind="unknown_constraint", name=name)
+                bi, slot = pos
+                arity = a.buckets[bi].arity
+                rows = self._slot_rows(bi, slot, fac_writes)
+                cube = _phantom_cube(arity, D)
+                fac_writes[(bi, slot)] = (
+                    cube, np.full(arity, self.sink, dtype=np.int32))
+                eids = slot_edges(bi, slot)
+                for e in eids:
+                    edge_writes[int(e)] = int(self.sink)
+                    touched_edges.add(int(e))
+                del live_factors[name]
+                free_slots[bi].append(slot)
+                free_slots[bi].sort()
+                for r in rows:
+                    factors_of.get(int(r), set()).discard(name)
+                registry.append(("rm_factor", bi, slot, name))
+
+            elif t == "change_costs":
+                name = args["name"]
+                pos = live_factors.get(name)
+                if pos is None:
+                    raise DeltaError(
+                        f"unknown constraint {name!r}",
+                        kind="unknown_constraint", name=name)
+                bi, slot = pos
+                rows = self._slot_rows(bi, slot, fac_writes)
+                cube = _padded_cost_cube(
+                    args["costs"], [dsize_of(int(r)) for r in rows],
+                    D, sign, name)
+                fac_writes[(bi, slot)] = (cube, np.asarray(
+                    rows, dtype=np.int32))
+                for e in slot_edges(bi, slot):
+                    touched_edges.add(int(e))
+                registry.append(("upd_factor", bi, slot, name))
+
+            else:  # pragma: no cover - validate_action gates types
+                raise DeltaError(f"unhandled action {t!r}",
+                                 kind="bad_args", type=t)
+
+        return self._build_delta(var_writes, fac_writes, edge_writes,
+                                 touched_edges, touched_vars,
+                                 registry, summary)
+
+    def _slot_rows(self, bi: int, slot: int, fac_writes) -> np.ndarray:
+        """A slot's CURRENT scope rows, pending writes of this event
+        included (add_constraint then change_costs composes)."""
+        pending = fac_writes.get((bi, slot))
+        if pending is not None:
+            return pending[1]
+        return np.asarray(self.arrays.buckets[bi].var_ids[slot])
+
+    def _build_delta(self, var_writes, fac_writes, edge_writes,
+                     touched_edges, touched_vars, registry,
+                     summary) -> TopologyDelta:
+        a = self.arrays
+        D = a.max_domain
+        rows = np.asarray(sorted(var_writes), dtype=np.int64)
+        n = len(rows)
+        valid = np.zeros(n, dtype=bool)
+        dsz = np.zeros(n, dtype=np.int32)
+        mask = np.zeros((n, D), dtype=bool)
+        costs = np.zeros((n, D), dtype=np.float32)
+        for i, r in enumerate(rows):
+            valid[i], dsz[i], mask[i], costs[i] = var_writes[int(r)]
+        b_slots, b_cubes, b_vids = [], [], []
+        for bi, b in enumerate(a.buckets):
+            slots = sorted(s for (wb, s) in fac_writes if wb == bi)
+            b_slots.append(np.asarray(slots, dtype=np.int64))
+            if slots:
+                b_cubes.append(np.stack(
+                    [fac_writes[(bi, s)][0] for s in slots]))
+                b_vids.append(np.stack(
+                    [fac_writes[(bi, s)][1] for s in slots]))
+            else:
+                b_cubes.append(
+                    np.zeros((0,) + (D,) * b.arity, dtype=np.float32))
+                b_vids.append(np.zeros((0, b.arity), dtype=np.int32))
+        eids = np.asarray(sorted(edge_writes), dtype=np.int64)
+        summary = dict(summary)
+        summary["touched_edges"] = len(touched_edges)
+        summary["touched_vars"] = len(touched_vars)
+        return TopologyDelta(
+            summary=summary,
+            var_rows=rows, var_valid=valid, domain_size=dsz,
+            domain_mask=mask, var_costs=costs,
+            bucket_slots=b_slots, bucket_cubes=b_cubes,
+            bucket_var_ids=b_vids,
+            edge_ids=eids,
+            edge_var=np.asarray([edge_writes[int(e)] for e in eids],
+                                dtype=np.int32),
+            touched_edges=np.asarray(sorted(touched_edges),
+                                     dtype=np.int64),
+            touched_vars=np.asarray(sorted(touched_vars),
+                                    dtype=np.int64),
+            registry=registry,
+        )
+
+    # ----------------------------------------------------------- apply
+
+    def apply(self, delta: TopologyDelta) -> Dict[str, int]:
+        """Execute the delta's writes against the instance planes and
+        registries.  Pure array stores — the warm engine re-reads the
+        planes as program arguments, so this is the WHOLE cost of a
+        topology edit."""
+        a = self.arrays
+        if len(delta.var_rows):
+            rows = delta.var_rows
+            a.var_valid[rows] = delta.var_valid
+            a.domain_size[rows] = delta.domain_size
+            a.domain_mask[rows] = delta.domain_mask
+            a.var_costs[rows] = delta.var_costs.astype(
+                a.var_costs.dtype)
+        for bi, b in enumerate(a.buckets):
+            slots = delta.bucket_slots[bi]
+            if not len(slots):
+                continue
+            b.cubes[slots] = delta.bucket_cubes[bi].astype(
+                b.cubes.dtype)
+            b.var_ids[slots] = delta.bucket_var_ids[bi]
+        if len(delta.edge_ids):
+            a.edge_var[delta.edge_ids] = delta.edge_var
+        for op in delta.registry:
+            self._apply_registry(op)
+        return dict(delta.summary)
+
+    def _apply_registry(self, op: Tuple):
+        a = self.arrays
+        kind = op[0]
+        if kind == "add_var":
+            _k, row, name, values = op
+            self.live_vars[name] = row
+            self.values_of[row] = values
+            self.free_var_rows.remove(row)
+            a.var_names[row] = name
+        elif kind == "rm_var":
+            _k, row, name = op
+            self.live_vars.pop(name, None)
+            self.values_of.pop(row, None)
+            self.free_var_rows.append(row)
+            self.free_var_rows.sort()
+            a.var_names[row] = f"__pad{row}"
+            self.factors_of.pop(row, None)
+        elif kind == "add_factor":
+            _k, bi, slot, name, rows = op
+            self.live_factors[name] = (bi, slot)
+            self.free_slots[bi].remove(slot)
+            a.factor_names[int(a.buckets[bi].factor_ids[slot])] = name
+            for r in rows:
+                self.factors_of.setdefault(int(r), set()).add(name)
+        elif kind == "rm_factor":
+            _k, bi, slot, name = op
+            self.live_factors.pop(name, None)
+            self.free_slots[bi].append(slot)
+            self.free_slots[bi].sort()
+            fid = int(a.buckets[bi].factor_ids[slot])
+            a.factor_names[fid] = f"__padf{a.buckets[bi].arity}_{slot}"
+            for s in self.factors_of.values():
+                s.discard(name)
+        # upd_factor: no registry change
+
+
+def _copy_arrays(arrays: FactorGraphArrays) -> FactorGraphArrays:
+    """A deep (plane-owning) copy of a padded factor graph."""
+    from ..graphs.arrays import FactorBucket
+
+    return FactorGraphArrays(
+        n_vars=arrays.n_vars, n_factors=arrays.n_factors,
+        n_edges=arrays.n_edges, max_domain=arrays.max_domain,
+        sign=arrays.sign,
+        var_names=list(arrays.var_names),
+        factor_names=list(arrays.factor_names),
+        domain_size=np.array(arrays.domain_size),
+        domain_mask=np.array(arrays.domain_mask),
+        var_costs=np.array(arrays.var_costs),
+        edge_var=np.array(arrays.edge_var),
+        edge_factor=np.array(arrays.edge_factor),
+        buckets=[FactorBucket(
+            b.arity, np.array(b.factor_ids), np.array(b.cubes),
+            np.array(b.edge_ids), np.array(b.var_ids))
+            for b in arrays.buckets],
+        n_vars_true=arrays.n_vars_true,
+        var_valid=np.array(arrays.var_valid),
+    )
+
+
+def build_dynamic_instance(dcop, reserve=None, precision=None):
+    """DCOP -> (rung, :class:`DynamicInstance`): compile arity-sorted
+    arrays, provision the power-of-two home rung plus the explicit
+    ``reserve`` headroom (``parallel/bucketing.parse_reserve``
+    grammar), pad, and wrap with the live-name registry.  The shared
+    entry of the warm engine, the batched replay and the serve delta
+    sessions — ONE copy of the provisioning rule.  ``dcop`` may also
+    be pre-built :class:`FactorGraphArrays` (the fast generators'
+    output): assignments then decode as value indices."""
+    from ..parallel.bucketing import ShapeProfile, home_rung
+
+    if isinstance(dcop, FactorGraphArrays):
+        arrays, values = dcop, {}
+        if canonical_edge_layout(arrays) is None:
+            raise ValueError(
+                "pre-built arrays need the canonical factor-major "
+                "edge layout (build with arity_sorted=True)")
+    else:
+        arrays = FactorGraphArrays.build(dcop, arity_sorted=True,
+                                         precision=precision)
+        values = {v.name: tuple(v.domain.values)
+                  for v in dcop.variables.values()}
+    rung = home_rung(ShapeProfile.of(arrays), reserve=reserve)
+    padded = rung.pad(arrays)
+    return rung, DynamicInstance(padded, values_by_name=values)

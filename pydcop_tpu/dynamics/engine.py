@@ -1,0 +1,630 @@
+"""The warm scenario engine: retrace-free re-solves of edited instances.
+
+:class:`DynamicEngine` wraps the compiled data plane the way
+``SyncEngine`` wraps one solve: it owns a phantom-padded
+:class:`~pydcop_tpu.dynamics.deltas.DynamicInstance`, compiles ONE
+program per (rung, params) whose **instance planes are arguments** —
+exactly the contract of the fused campaign runners (PR 3) — and drives
+it to convergence in chunks.  ``apply(delta)`` then edits the planes in
+place and re-enters the same program:
+
+* **no retrace / no recompile** — the program signature is independent
+  of the delta (shapes come from the rung, deltas are data).  Every
+  solve is AOT-compiled through ``jax.stages``, so the spans prove it:
+  the first solve of a rung pays ``trace_lower_s``/``compile_s`` (or a
+  ``deserialize_s`` when the serving executable cache already knows the
+  rung), every subsequent ``apply → solve`` shows ``execute_s`` only;
+* **warm state carry-over** — the q/r message planes of the previous
+  fixed point are kept; only the delta's *touched* edges reset to the
+  neutral message, the partial-update semantics of conditional Max-Sum
+  (arXiv 2502.13194).  Convergence bookkeeping (``same``/``finished``/
+  ``cycle``) restarts, so each re-solve gets a fresh budget.
+
+Two modes share the public API: ``engine`` (single chip, the generic
+edge-major :class:`~pydcop_tpu.algorithms.maxsum.MaxSumSolver` step
+with its device constants swapped per call) and ``sharded``
+(:class:`DynamicShardedMaxSum`, whose mesh constants ride the engine
+CARRY instead of being closure-captured, so a consts swap cannot force
+a retrace).
+"""
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..graphs.arrays import BIG, HARD, SENTINEL, FactorGraphArrays
+from .deltas import TopologyDelta, build_dynamic_instance
+
+#: solver knobs the warm engine refuses: each would make a warm apply
+#: silently wrong, so the rejection is loud (repo policy)
+_REJECTED_PARAMS = {
+    "bnb": "pruned-reduction plans are build-time constants of the "
+           "cube contents; an in-place cube edit would leave them "
+           "stale (same rule as maxsum_dynamic)",
+    "noise": "noise draws are not edit-stable, so a warm re-solve "
+             "could not match a cold solve of the edited instance",
+    "decimation_p": "the freeze plane pins variables of the "
+                    "PRE-edit instance; a topology edit would solve "
+                    "with stale pins",
+}
+
+
+def eval_cost_violations_np(arrays: FactorGraphArrays,
+                            sel: np.ndarray) -> Tuple[float, int]:
+    """Host mirror of ``ops.kernels.assignment_cost_violations`` over
+    one padded selection row: (model-space soft cost, hard-violation
+    count).  Phantom rows/slots contribute exactly zero by
+    construction, so padded == true."""
+    a = arrays
+    V = a.n_vars
+    unary = np.asarray(a.var_costs, dtype=np.float32)[
+        np.arange(V), sel]
+    viol = np.abs(unary) >= HARD
+    cost = float(np.where(viol, 0.0, unary).sum())
+    violations = int(viol.sum())
+    for b in a.buckets:
+        if not b.cubes.shape[0]:
+            continue
+        cubes = np.asarray(b.cubes, dtype=np.float32)
+        idx = (np.arange(cubes.shape[0]),) + tuple(
+            sel[b.var_ids[:, p]] for p in range(b.arity))
+        cells = cubes[idx]
+        v = np.abs(cells) >= HARD
+        cost += float(np.where(v, 0.0, cells).sum())
+        violations += int(v.sum())
+    return cost * float(a.sign), violations
+
+
+def _check_params(params: Dict[str, Any]):
+    from ..algorithms import param_bool
+
+    for k, why in _REJECTED_PARAMS.items():
+        v = params.get(k, 0)
+        bad = param_bool(v) if k == "bnb" else float(v or 0) > 0
+        if bad:
+            raise ValueError(
+                f"DynamicEngine does not support {k}: {why}")
+    if params.get("delta_on", "messages") != "messages":
+        raise ValueError(
+            "DynamicEngine keeps the message-delta convergence "
+            "semantics; delta_on:beliefs is a single-solve knob")
+    stability = float(params.get("stability", 0.1))
+    if stability <= 0:
+        raise ValueError(
+            "DynamicEngine needs the stability convergence rule "
+            "(stability > 0): warm re-solves stop on SAME_COUNT "
+            "stable cycles, not a fixed budget")
+
+
+class DynamicEngine:
+    """Warm, retrace-free re-solves of a phantom-padded instance."""
+
+    def __init__(self, dcop, algo: str = "maxsum",
+                 mode: str = "engine", reserve=None,
+                 params: Optional[Dict[str, Any]] = None,
+                 mesh=None, batch: Optional[int] = None,
+                 chunk_size: int = 32,
+                 max_cycles: int = 2000,
+                 exec_cache=None,
+                 carry: str = "messages"):
+        if carry not in ("messages", "reset"):
+            raise ValueError(
+                f"carry must be 'messages' (conditional-Max-Sum "
+                f"partial update: untouched q/r rows keep the "
+                f"previous fixed point) or 'reset' (fresh messages "
+                f"every apply — still retrace-free, and the mode "
+                f"whose selections are STRUCTURALLY bit-exact with a "
+                f"cold solve of the edited instance), got {carry!r}")
+        self.carry = carry
+        if algo != "maxsum":
+            raise ValueError(
+                f"the compiled scenario engine speaks the maxsum "
+                f"factor-graph family only, not {algo!r} (local-"
+                "search state has no per-edge message plane to "
+                "carry over)")
+        if mode not in ("engine", "sharded"):
+            raise ValueError(
+                f"DynamicEngine mode must be 'engine' or 'sharded', "
+                f"got {mode!r}")
+        params = dict(params or {})
+        # engine-level knobs are not solver parameters and must not
+        # fragment the program/cache identity (a per-job seed in the
+        # exec-cache key would defeat warm restarts) — stripped HERE,
+        # the one authority, so callers never need their own copy
+        for engine_only in ("stop_cycle", "seed", "layout"):
+            params.pop(engine_only, None)
+        _check_params(params)
+        self.algo = algo
+        self.mode = mode
+        self.chunk = int(chunk_size)
+        self.max_cycles = int(max_cycles)
+        self.exec_cache = exec_cache
+        self.rung, self.instance = build_dynamic_instance(
+            dcop, reserve=reserve,
+            precision=params.get("precision"))
+        self.params = params
+        solver_params = dict(params)
+        self.last_spans: Dict[str, float] = {}
+        self.last_edit: Optional[Dict[str, int]] = None
+        self.solves = 0
+        self._state = None
+        self._args_dev = None
+        self._aot: Dict[Tuple, Any] = {}
+        if mode == "engine":
+            from ..algorithms.maxsum import MaxSumSolver
+
+            self._base = MaxSumSolver(self.instance.arrays,
+                                      **solver_params)
+            self._chunk_jit = None
+            self._solver = None
+        else:
+            from ..parallel import make_mesh
+
+            self._base = None
+            mesh = mesh if mesh is not None else make_mesh()
+            self._solver = DynamicShardedMaxSum(
+                self.instance.arrays, mesh,
+                batch=batch if batch is not None
+                else mesh.shape["dp"],
+                **solver_params)
+            self._edge_map = self._build_edge_map()
+        self._key = tuple(sorted(
+            (k, str(v)) for k, v in params.items()))
+
+    # ----------------------------------------------------------- info
+
+    def budget(self) -> Dict[str, Any]:
+        """The instance's provisioned edit capacity (echoed in CLI
+        results and serve telemetry)."""
+        return self.instance.budget()
+
+    @property
+    def warm(self) -> bool:
+        """Whether the next solve starts from carried message state."""
+        return self._state is not None
+
+    # ---------------------------------------------------------- apply
+
+    def apply(self, event) -> Dict[str, int]:
+        """Compile one event's actions into a
+        :class:`~pydcop_tpu.dynamics.deltas.TopologyDelta`, execute
+        its in-place writes, and reset exactly the touched message
+        rows of the carried state.  Raises
+        :class:`~pydcop_tpu.dynamics.deltas.DeltaError` (instance
+        untouched) when the event exceeds the reserved capacity."""
+        delta = self.instance.compile_event(event)
+        self.instance.apply(delta)
+        self.last_edit = dict(delta.summary)
+        if self.mode == "sharded":
+            # the solver's host mirrors (partitioned cubes, edge
+            # tables) must track the edited planes for state init,
+            # decode masks and the next carry_consts device_put
+            self._sync_sharded_consts()
+        if self._state is not None:
+            if self.carry == "reset":
+                # fresh message state next solve — the compiled
+                # program (and the executable cache entry) is still
+                # reused as-is, so this mode pays zero retraces too
+                self._state = None
+            elif self.mode == "engine":
+                self._warm_reset_engine(delta)
+            else:
+                self._warm_reset_sharded(delta)
+        self._args_dev = None    # re-read planes on next solve
+        return dict(delta.summary)
+
+    # ---------------------------------------------------------- solve
+
+    def solve(self, max_cycles: Optional[int] = None, seed: int = 0,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Run to convergence (or the cycle budget) and decode.  The
+        first call cold-starts (fresh messages + one AOT compile or
+        executable-cache deserialize); after an :meth:`apply`, the run
+        is WARM: carried q/r, same compiled program, spans free of
+        ``trace_lower_s``/``compile_s``."""
+        budget = int(max_cycles or self.max_cycles)
+        # warm = the compiled program (and, under carry='messages',
+        # the message state) is reused: every solve after the first.
+        # Asserted by telemetry as "no trace/compile span".
+        warm = self.solves > 0
+        if self.mode == "engine":
+            out = self._solve_engine(budget, seed, timeout)
+        else:
+            out = self._solve_sharded(budget, seed, timeout)
+        out["warm_start"] = bool(warm)
+        out["carry"] = self.carry
+        out["edit"] = dict(self.last_edit) if warm and self.last_edit \
+            else None
+        self.last_edit = None
+        self.solves += 1
+        return out
+
+    # ------------------------------------------------- single-chip mode
+
+    def _args_engine(self):
+        a = self.instance.arrays
+        import jax.numpy as jnp
+
+        store = self._base.policy.store_dtype
+        return {
+            "cubes": [jnp.asarray(b.cubes, dtype=store)
+                      for b in a.buckets],
+            "var_ids": [jnp.asarray(b.var_ids) for b in a.buckets],
+            "var_costs": jnp.asarray(a.var_costs, dtype=store),
+            "domain_mask": jnp.asarray(a.domain_mask),
+            "domain_size": jnp.asarray(a.domain_size),
+            "edge_var": jnp.asarray(a.edge_var),
+        }
+
+    def _chunk_fn(self):
+        """The warm chunk: the base solver's step driven to ``limit``
+        with every topology-dependent device constant swapped for the
+        ARGUMENT planes — one compiled program per rung, any edit
+        re-enters it."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.batch import _restore_dev, _swap_dev
+
+        base = self._base
+        tmpl = base.arrays
+
+        def run_chunk(args, state, limit):
+            updates = {
+                "buckets": [
+                    (args["cubes"][bi],
+                     jnp.asarray(tmpl.buckets[bi].edge_ids),
+                     args["var_ids"][bi])
+                    for bi in range(len(tmpl.buckets))],
+                "var_costs": args["var_costs"],
+                "domain_mask": args["domain_mask"],
+                "domain_size": args["domain_size"],
+                "edge_var": args["edge_var"],
+            }
+            saved = _swap_dev(base, updates)
+            try:
+                def cond(s):
+                    return jnp.logical_and(
+                        jnp.logical_not(s["finished"]),
+                        s["cycle"] < limit)
+
+                return jax.lax.while_loop(cond, base.step, state)
+            finally:
+                _restore_dev(base, saved)
+
+        return run_chunk
+
+    def _fresh_state_engine(self, seed: int):
+        import jax
+        import jax.numpy as jnp
+
+        a = self.instance.arrays
+        emask = np.asarray(a.domain_mask)[np.asarray(a.edge_var)]
+        q = np.where(emask, 0.0, BIG).astype(np.float32)
+        sel = np.argmin(
+            np.where(a.domain_mask,
+                     np.asarray(a.var_costs, dtype=np.float32),
+                     SENTINEL), axis=1).astype(np.int32)
+        return {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": jax.random.PRNGKey(int(seed)),
+            "q": jnp.asarray(q),
+            "r": jnp.zeros_like(jnp.asarray(q)),
+            "selection": jnp.asarray(sel),
+            "same": jnp.int32(0),
+        }
+
+    def _warm_reset_engine(self, delta: TopologyDelta):
+        """Carry the previous fixed point; neutralize exactly the
+        touched rows.  Convergence bookkeeping restarts so the
+        re-solve gets its own budget."""
+        import jax.numpy as jnp
+
+        a = self.instance.arrays
+        s = self._state
+        q = np.array(s["q"])
+        r = np.array(s["r"])
+        te = delta.touched_edges
+        if len(te):
+            emask = np.asarray(a.domain_mask)[
+                np.asarray(a.edge_var)[te]]
+            q[te] = np.where(emask, 0.0, BIG)
+            r[te] = 0.0
+        sel = np.array(s["selection"])
+        for row in delta.touched_vars:
+            sel[row] = int(np.argmin(np.where(
+                a.domain_mask[row],
+                np.asarray(a.var_costs[row], dtype=np.float32),
+                SENTINEL)))
+        self._state = {
+            "cycle": jnp.int32(0),
+            "finished": jnp.bool_(False),
+            "key": s["key"],
+            "q": jnp.asarray(q),
+            "r": jnp.asarray(r),
+            "selection": jnp.asarray(sel),
+            "same": jnp.int32(0),
+        }
+
+    def _runner_engine(self, args, state, clock):
+        """The AOT-compiled chunk — in-process signature cache plus
+        the optional cross-process executable cache (the serve warm
+        restart: a known rung DESERIALIZES instead of compiling)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..observability.spans import (aot_compile, aot_cached,
+                                           aval_signature)
+
+        if self._chunk_jit is None:
+            self._chunk_jit = jax.jit(self._chunk_fn())
+        ex_args = (args, state, jnp.int32(0))
+        if self.exec_cache is not None:
+            full_key = (("dynamics", self.algo, self.mode,
+                         self.rung.signature, self._key),
+                        aval_signature(ex_args))
+            sig = ("dyn",) + aval_signature(ex_args)
+            entry = self._aot.get(sig)
+            if entry is not None:
+                return entry
+            t0 = time.perf_counter()
+            compiled = self.exec_cache.load(full_key)
+            if compiled is not None:
+                clock.add("deserialize_s", time.perf_counter() - t0)
+            else:
+                _lowered, compiled = aot_compile(
+                    self._chunk_jit, ex_args, clock)
+                self.exec_cache.store(full_key, compiled)
+            self._aot[sig] = compiled
+            return compiled
+        compiled, _stats = aot_cached(
+            self._aot, "dyn", self._chunk_jit, ex_args, clock)
+        return compiled
+
+    def _solve_engine(self, budget: int, seed: int,
+                      timeout: Optional[float]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..observability.spans import SpanClock
+
+        clock = SpanClock()
+        if self._state is None:
+            self._state = self._fresh_state_engine(seed)
+        if self._args_dev is None:
+            self._args_dev = self._args_engine()
+        state = self._state
+        run = self._runner_engine(self._args_dev, state, clock)
+        t0 = time.perf_counter()
+        status = "MAX_CYCLES"
+        while True:
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                break
+            if cycle >= budget:
+                break
+            if timeout is not None and \
+                    time.perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+            limit = min(cycle + self.chunk, budget)
+            state = run(self._args_dev, state, jnp.int32(limit))
+        clock.add("execute_s", time.perf_counter() - t0)
+        self._state = state
+        self.last_spans = clock.as_dict()
+        sel = np.array(state["selection"])
+        return self._result(sel, int(state["cycle"]), status)
+
+    # ---------------------------------------------------- sharded mode
+
+    def _build_edge_map(self):
+        """Global canonical edge id -> (tp shard, local edge id), a
+        STATIC map of the rung's partition (round-robin per bucket:
+        factor f of a bucket lands on shard ``f % tp``, local row
+        ``f // tp``)."""
+        from ..graphs.arrays import canonical_edge_layout
+
+        solver = self._solver
+        tp = solver.tp
+        a = self.instance.arrays
+        layout = canonical_edge_layout(a)
+        E = a.n_edges
+        g_of = np.zeros(E, dtype=np.int64)
+        le_of = np.zeros(E, dtype=np.int64)
+        for bi, spec in enumerate(layout):
+            if spec is None:
+                continue
+            offset, slots, arity = spec
+            sb = solver.buckets[bi]
+            f = np.arange(slots, dtype=np.int64)
+            g = f % tp
+            lf = f // tp
+            for p in range(arity):
+                ge = offset + f * arity + p
+                g_of[ge] = g
+                le_of[ge] = sb.offset + lf * arity + p
+        return g_of, le_of
+
+    def _sync_sharded_consts(self):
+        """Re-partition the edited planes onto the solver's host
+        mirrors (same shapes by construction — the rung is static)."""
+        from ..parallel.sharded_maxsum import _partition
+
+        solver = self._solver
+        a = self.instance.arrays
+        shard_buckets, edge_var, e_loc = _partition(a, solver.tp)
+        assert e_loc == solver.E_loc, "rung shapes must be static"
+        solver.buckets = shard_buckets
+        solver.edge_var = edge_var
+        D = a.max_domain
+        solver.var_costs = np.concatenate(
+            [np.asarray(a.var_costs, dtype=np.float32),
+             np.full((1, D), BIG, dtype=np.float32)])
+        solver.domain_mask = np.concatenate(
+            [a.domain_mask, np.zeros((1, D), dtype=bool)])
+        solver.domain_size = np.concatenate(
+            [a.domain_size, np.ones((1,), dtype=np.int32)])
+
+    def _warm_reset_sharded(self, delta: TopologyDelta):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        solver = self._solver
+        s = self._state
+        q = np.array(s["q"])            # (B, TP, E_loc, D)
+        r = np.array(s["r"])
+        g_of, le_of = self._edge_map
+        te = delta.touched_edges
+        if len(te):
+            g, le = g_of[te], le_of[te]
+            emask = solver.domain_mask[solver.edge_var]  # (TP,E,D)
+            q[:, g, le] = np.where(emask[g, le], 0.0, BIG)
+            r[:, g, le] = 0.0
+        sel = np.array(s["sel"])        # (B, V)
+        for row in delta.touched_vars:
+            sel[:, row] = int(np.argmin(np.where(
+                solver.domain_mask[row],
+                solver.var_costs[row], SENTINEL)))
+        mesh = solver.mesh
+        dp_tp = NamedSharding(mesh, P("dp", "tp"))
+        state = dict(s)
+        state.update(
+            q=jax.device_put(q, dp_tp),
+            r=jax.device_put(r, dp_tp),
+            sel=jax.device_put(sel, NamedSharding(mesh, P("dp"))),
+            same=jnp.int32(0), cycle=jnp.int32(0),
+            finished=jnp.bool_(False))
+        state.update(solver.carry_consts())
+        self._state = state
+
+    def _solve_sharded(self, budget: int, seed: int,
+                       timeout: Optional[float]) -> Dict[str, Any]:
+        import jax
+
+        solver = self._solver
+        if self._state is None:
+            self._state = solver.mesh_init(int(seed))
+        eng = solver._mesh_engine()
+        state = eng.drive(self._state, budget, timeout=timeout,
+                          spans=True)
+        self._state = state
+        self.last_spans = dict(eng.last_spans)
+        cycles = int(state["cycle"])
+        status = "FINISHED" if bool(state["finished"]) else \
+            eng.last_stats.get("status", "MAX_CYCLES")
+        sel = np.asarray(jax.device_get(state["sel"]))[0]
+        return self._result(sel, cycles, status)
+
+    # ----------------------------------------------------------- decode
+
+    def _result(self, sel: np.ndarray, cycles: int,
+                status: str) -> Dict[str, Any]:
+        cost, violations = eval_cost_violations_np(
+            self.instance.arrays, sel)
+        return {
+            "status": status,
+            "assignment": self.instance.decode(sel),
+            "cost": cost,
+            "violation": violations,
+            "cycle": cycles,
+            "spans": dict(self.last_spans),
+            "budget": self.budget(),
+        }
+
+
+class DynamicShardedMaxSum:
+    """:class:`~pydcop_tpu.parallel.sharded_maxsum.ShardedMaxSum`
+    whose mesh constants ride the engine CARRY.
+
+    The stock sharded solver's constants (cubes, edge tables, domain
+    planes) are closure-captured into the compiled chunk at trace
+    time, so swapping them forces a retrace.  Here they travel as
+    state-dict entries (``c_*`` keys) through the
+    ``ShardedSyncEngine`` while-loop carry: the body passes them
+    through unchanged, a delta apply ``device_put``s replacements into
+    the carry, and the chunk — compiled once per carry signature —
+    never retraces.
+    """
+
+    def __new__(cls, arrays, mesh, **kwargs):
+        from ..parallel.sharded_maxsum import ShardedMaxSum
+
+        # build the concrete subclass lazily so importing dynamics
+        # never drags the mesh stack in (mirrors parallel/__init__)
+        class _Impl(ShardedMaxSum):
+            def __init__(self, arrays, mesh, **kw):
+                for k in ("decimation_p", "bnb"):
+                    v = kw.get(k, 0)
+                    if v:
+                        raise ValueError(
+                            f"DynamicShardedMaxSum does not support "
+                            f"{k} (see DynamicEngine)")
+                if float(kw.get("noise", 0) or 0) > 0:
+                    raise ValueError(
+                        "DynamicShardedMaxSum does not support "
+                        "noise > 0 (not edit-stable)")
+                super().__init__(arrays, mesh, **kw)
+
+            def _consts(self):
+                # constants live in the carry, not the closure
+                return {}
+
+            def carry_consts(self):
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+
+                mesh = self.mesh
+                store = self.policy.store_dtype
+                tp_sh = NamedSharding(mesh, P("tp"))
+                rep = NamedSharding(mesh, P())
+                return {
+                    "c_edge_var": jax.device_put(self.edge_var,
+                                                 tp_sh),
+                    "c_cubes": [
+                        jax.device_put(
+                            np.asarray(sb.cubes, dtype=store), tp_sh)
+                        for sb in self.buckets],
+                    "c_var_costs": jax.device_put(
+                        jnp.asarray(self.var_costs, dtype=store),
+                        rep),
+                    "c_domain_mask": jax.device_put(
+                        jnp.asarray(self.domain_mask), rep),
+                    "c_domain_size": jax.device_put(
+                        jnp.asarray(self.domain_size), rep),
+                }
+
+            def mesh_init(self, seed: int):
+                state = super().mesh_init(seed)
+                state.update(self.carry_consts())
+                return state
+
+            def mesh_step(self, s):
+                import jax
+                import jax.numpy as jnp
+
+                from ..parallel.sharded_maxsum import SAME_COUNT
+
+                key, sub = jax.random.split(s["key"])
+                q, r, sel, delta = self._step(
+                    s["q"], s["r"], sub, s["c_edge_var"],
+                    s["c_cubes"], s["c_var_costs"],
+                    s["c_domain_mask"], s["c_domain_size"])
+                stable = jnp.logical_and(
+                    jnp.all(sel == s["sel"]),
+                    jnp.max(delta) < jnp.float32(self.stability))
+                same = jnp.where(stable, s["same"] + 1,
+                                 jnp.int32(0))
+                out = dict(s)
+                out.update(q=q, r=r, key=key, sel=sel, same=same,
+                           cycle=s["cycle"] + 1,
+                           finished=same >= SAME_COUNT)
+                if "delta" in s:
+                    out["delta"] = jnp.max(delta)
+                return out
+
+        return _Impl(arrays, mesh, **kwargs)

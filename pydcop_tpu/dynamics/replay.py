@@ -1,0 +1,149 @@
+"""Scenario replay: one warm campaign, or N descendants in one batch.
+
+Two replay regimes over the same
+:class:`~pydcop_tpu.dynamics.deltas.DynamicInstance` machinery:
+
+* :func:`replay_scenario` — the ONLINE regime (``solve --scenario``,
+  serve ``delta`` sessions): events apply sequentially to one warm
+  :class:`~pydcop_tpu.dynamics.engine.DynamicEngine`; every re-solve
+  after the first is retrace-free and carries the previous fixed
+  point.  Delay events are recorded, not slept — a compiled campaign
+  replays the *sequence*, the wall-clock pacing belongs to the host
+  runtime (``commands/run.py``).
+
+* :func:`replay_batched` — the OFFLINE regime: materialize the
+  instance state after every action event as a same-shape snapshot
+  (they all live on the one padded rung by construction) and run the
+  whole family — base instance plus N perturbed descendants — as ONE
+  vmapped program through the existing fused runners
+  (``parallel/batch.runner_for_rung``).  This is the "N perturbed
+  descendants of one instance" workload: cold per-descendant solves,
+  amortized to a single compile.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..dcop.scenario import Scenario
+from .deltas import build_dynamic_instance
+from .engine import DynamicEngine, eval_cost_violations_np
+
+
+def replay_scenario(engine: DynamicEngine, scenario: Scenario,
+                    max_cycles: Optional[int] = None, seed: int = 0,
+                    timeout: Optional[float] = None,
+                    reporter=None) -> Dict[str, Any]:
+    """Replay ``scenario`` through one warm engine.
+
+    Returns ``{"initial": result, "events": [per-event records],
+    "budget": remaining capacity}``; each action event's record
+    carries the solve result plus ``edit`` (the delta's write counts)
+    and ``warm_start``.  ``timeout`` bounds the WHOLE replay (like
+    every other solve mode's wall budget, not per event): each solve
+    gets the remaining budget, and events past exhaustion are
+    recorded as ``status: TIMEOUT`` rows instead of silently running
+    over.  With a ``reporter``
+    (:class:`~pydcop_tpu.observability.report.RunReporter`), every
+    solve emits a v1.1 ``summary`` record attributed with the event
+    id."""
+    import time as _time
+
+    t_start = _time.perf_counter()
+
+    def remaining():
+        if timeout is None:
+            return None
+        return timeout - (_time.perf_counter() - t_start)
+
+    def emit(rec, event_id):
+        if reporter is not None:
+            out = {k: v for k, v in rec.items()
+                   if k in ("status", "cost", "violation", "cycle",
+                            "warm_start", "spans")}
+            if rec.get("edit"):
+                out["edit"] = rec["edit"]
+            reporter.summary(event=event_id, **out)
+
+    initial = engine.solve(max_cycles=max_cycles, seed=seed,
+                           timeout=remaining())
+    emit(initial, "__initial__")
+    events: List[Dict[str, Any]] = []
+    timed_out = False
+    for event in scenario:
+        if event.is_delay:
+            events.append({"event": event.id, "delay": event.delay})
+            continue
+        left = remaining()
+        if timed_out or (left is not None and left <= 0):
+            timed_out = True
+            rec = {"event": event.id, "status": "TIMEOUT"}
+            emit(rec, event.id)
+            events.append(rec)
+            continue
+        edit = engine.apply(event)
+        res = engine.solve(max_cycles=max_cycles, seed=seed,
+                           timeout=left)
+        res["event"] = event.id
+        res["edit"] = edit
+        emit(res, event.id)
+        events.append(res)
+    return {"initial": initial, "events": events,
+            "budget": engine.budget()}
+
+
+def scenario_descendants(dcop, scenario: Scenario, reserve=None,
+                         precision=None):
+    """The instance family a scenario generates: ``(rung, [(label,
+    padded arrays snapshot, decoder)])`` — entry 0 is the unedited
+    instance, entry *i* the state after the *i*-th action event.
+    Every snapshot shares the rung's padded shape, so the whole family
+    fuses into one vmapped program."""
+    rung, inst = build_dynamic_instance(dcop, reserve=reserve,
+                                        precision=precision)
+    family = [("__initial__", inst.snapshot_arrays(),
+               inst.snapshot_decoder())]
+    for event in scenario:
+        if event.is_delay:
+            continue
+        inst.apply(inst.compile_event(event))
+        family.append((event.id, inst.snapshot_arrays(),
+                       inst.snapshot_decoder()))
+    return rung, family
+
+
+def replay_batched(dcop, scenario: Scenario,
+                   params: Optional[Dict[str, Any]] = None,
+                   reserve=None, max_cycles: int = 2000,
+                   seed: int = 0) -> List[Dict[str, Any]]:
+    """Run a scenario's whole instance family as ONE fused batch: the
+    base instance and each action event's descendant ride the batch
+    axis of the existing vmapped maxsum runner (cold solves, one
+    compiled program, rung-signature runner cache).  Returns one
+    result record per family member, in scenario order."""
+    from ..parallel.batch import runner_for_rung
+
+    params = dict(params or {})
+    params.pop("stop_cycle", None)
+    rung, family = scenario_descendants(
+        dcop, scenario, reserve=reserve,
+        precision=params.get("precision"))
+    instances = [arrays for _id, arrays, _dec in family]
+    runner = runner_for_rung("maxsum", instances, params,
+                             rung_signature=rung.signature)
+    sel, cycles, finished = runner.run(
+        max_cycles=max_cycles, seeds=[seed] * len(instances))
+    out = []
+    for i, (event_id, arrays, decode) in enumerate(family):
+        cost, violations = eval_cost_violations_np(
+            arrays, np.asarray(sel[i]))
+        out.append({
+            "event": event_id,
+            "status": ("FINISHED" if bool(finished[i])
+                       else "MAX_CYCLES"),
+            "assignment": decode(np.asarray(sel[i])),
+            "cost": cost,
+            "violation": violations,
+            "cycle": int(cycles[i]),
+        })
+    return out

@@ -100,6 +100,25 @@ def _phantom_cube(arity: int, max_domain: int,
     return cube
 
 
+def _apply_reserve(bucket_slots: Dict[int, int],
+                   reserve: Optional[Dict[int, int]]) -> Dict[int, int]:
+    """Fold an explicit headroom reservation into the pad targets:
+    ``reserve[arity]`` EXTRA phantom slots beyond whatever the ladder
+    (or the caller) already asked for — including arities the instance
+    has no factors of yet, which is exactly how a dynamic workload
+    provisions capacity for constraints a scenario will add later
+    (``dynamics/``).  Negative reservations are a caller bug."""
+    out = dict(bucket_slots)
+    for arity, extra in (reserve or {}).items():
+        arity, extra = int(arity), int(extra)
+        if arity < 1 or extra < 0:
+            raise ValueError(
+                f"reserve wants {{arity >= 1: extra slots >= 0}}, got "
+                f"{{{arity}: {extra}}}")
+        out[arity] = out.get(arity, 0) + extra
+    return out
+
+
 def _check_pad_targets(arrays, n_vars: int, bucket_slots):
     counts = {b.arity: len(b.cons_ids) if hasattr(b, "cons_ids")
               else len(b.factor_ids) for b in arrays.buckets}
@@ -311,10 +330,17 @@ class FactorGraphArrays:
         }
 
     def pad_to(self, n_vars: int,
-               bucket_slots: Dict[int, int]) -> "FactorGraphArrays":
+               bucket_slots: Dict[int, int],
+               reserve: Optional[Dict[int, int]] = None
+               ) -> "FactorGraphArrays":
         """Pad this instance to a canonical shared shape so instances
         with different V/E/arity profiles fuse into ONE vmapped program
-        (parallel/bucketing.py picks the targets).
+        (parallel/bucketing.py picks the targets).  ``reserve`` adds
+        EXPLICIT headroom on top: ``{arity: extra slots}`` phantom
+        factor slots beyond ``bucket_slots`` (new arities allowed), the
+        edit capacity dynamic workloads activate in place
+        (``dynamics/deltas.py``) — variable headroom travels through a
+        larger ``n_vars``.
 
         Phantom variables (rows ``[self.n_vars, n_vars)``) have a single
         valid domain slot of cost 0 and are masked out of every
@@ -328,6 +354,7 @@ class FactorGraphArrays:
         targets shares one index structure and the fast slice/reshape
         paths stay available.  The result records ``n_vars_true`` and a
         ``var_valid`` mask for the masked decode."""
+        bucket_slots = _apply_reserve(bucket_slots, reserve)
         _check_pad_targets(self, n_vars, bucket_slots)
         D = self.max_domain
         var_names, domain_size, domain_mask, var_costs, var_valid = \
@@ -502,7 +529,9 @@ class HypergraphArrays:
         return _apply_precision(out, precision)
 
     def pad_to(self, n_vars: int, bucket_slots: Dict[int, int],
-               n_pairs: Optional[int] = None) -> "HypergraphArrays":
+               n_pairs: Optional[int] = None,
+               reserve: Optional[Dict[int, int]] = None
+               ) -> "HypergraphArrays":
         """Hypergraph twin of :meth:`FactorGraphArrays.pad_to`: pad to
         the shared shape a bucket rung prescribes.  Phantom variables
         carry a declared initial value of slot 0 (their only valid
@@ -511,7 +540,9 @@ class HypergraphArrays:
         == 0, so they never read as violated), and the neighbor-pair
         edge list is padded with inert ``(sink, sink)`` pairs to
         ``n_pairs`` so gain-exchange reductions keep one static shape
-        per rung."""
+        per rung.  ``reserve`` adds explicit per-arity slot headroom,
+        same contract as the factor-graph twin."""
+        bucket_slots = _apply_reserve(bucket_slots, reserve)
         _check_pad_targets(self, n_vars, bucket_slots)
         D = self.max_domain
         var_names, domain_size, domain_mask, var_costs, var_valid = \

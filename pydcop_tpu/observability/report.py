@@ -44,7 +44,22 @@ from typing import Any, Dict, Iterable, Optional
 
 SCHEMA_VERSION = 1
 
+#: minor revision WITHIN schema v1 — additive, optional fields only,
+#: so every v1 reader stays green.  Minor 1 added the dynamic-DCOP
+#: fields: ``edit`` (per-action write counts of a warm delta apply)
+#: and ``warm_start`` (bool) on summary records, plus the
+#: ``schema_minor`` header stamp itself.
+SCHEMA_MINOR = 1
+
 RECORD_KINDS = ("header", "cycle", "summary", "serve")
+
+#: the per-action count keys an ``edit`` summary field may carry
+#: (``dynamics/deltas.py`` TopologyDelta.summary) — anything else is
+#: a schema violation, so emitters and the documented vocabulary
+#: cannot drift
+EDIT_KEYS = ("add_variable", "remove_variable", "add_constraint",
+             "remove_constraint", "change_costs", "touched_edges",
+             "touched_vars")
 
 
 class RunReporter:
@@ -100,6 +115,7 @@ class RunReporter:
 
     def header(self, **fields) -> Dict[str, Any]:
         rec = {"record": "header", "schema": SCHEMA_VERSION,
+               "schema_minor": SCHEMA_MINOR,
                "algo": self.algo, "mode": self.mode, **fields}
         self._emit(rec, f"engine.run.{self.algo}")
         return rec
@@ -156,6 +172,15 @@ def validate_record(rec: Dict[str, Any]):
             raise ValueError(
                 f"header schema {rec.get('schema')!r} != "
                 f"{SCHEMA_VERSION}")
+        minor = rec.get("schema_minor")
+        # absent = minor 0 (pre-dynamics emitters): v1 readers and v1
+        # files stay green in both directions — the major gate above
+        # is the only compatibility wall
+        if minor is not None and (isinstance(minor, bool)
+                                  or not isinstance(minor, int)
+                                  or minor < 0):
+            raise ValueError(
+                f"header with bad schema_minor {minor!r}")
         if "mode" not in rec:
             raise ValueError("header missing 'mode'")
     elif kind == "cycle":
@@ -186,6 +211,26 @@ def validate_record(rec: Dict[str, Any]):
     elif kind == "summary":
         if "status" not in rec:
             raise ValueError("summary missing 'status'")
+        warm = rec.get("warm_start")
+        if warm is not None and not isinstance(warm, bool):
+            raise ValueError(
+                f"summary with bad warm_start {warm!r}")
+        edit = rec.get("edit")
+        if edit is not None:
+            if not isinstance(edit, dict):
+                raise ValueError(
+                    f"summary 'edit' must be a dict of write "
+                    f"counts, got {type(edit).__name__}")
+            for k, v in edit.items():
+                if k not in EDIT_KEYS:
+                    raise ValueError(
+                        f"summary edit with unknown key {k!r}; "
+                        f"known: {', '.join(EDIT_KEYS)}")
+                if isinstance(v, bool) or not isinstance(v, int) \
+                        or v < 0:
+                    raise ValueError(
+                        f"summary edit[{k!r}] must be a "
+                        f"non-negative int, got {v!r}")
     elif kind == "serve":
         event = rec.get("event")
         if not isinstance(event, str) or not event:

@@ -31,6 +31,71 @@ def next_pow2(n: int) -> int:
     return 1 << (int(n) - 1).bit_length()
 
 
+def parse_reserve(spec) -> Tuple[int, Dict[int, int]]:
+    """Parse the ``--reserve-slots`` grammar into ``(extra phantom
+    variable rows, {arity: extra factor slots})``.
+
+    Grammar: comma-separated ``vars:N`` / ``ARITY:N`` entries, e.g.
+    ``"vars:8,2:16,3:4"`` = 8 spare variable rows, 16 spare binary
+    slots, 4 spare ternary slots.  Dict input (``{"vars": 8, 2: 16}``)
+    passes through with the same validation; ``None``/empty means no
+    reservation.  The ladder sizes phantom capacity purely from the
+    power-of-two rung otherwise — this is the explicit headroom knob
+    dynamic workloads use to provision edit capacity
+    (``dynamics/``)."""
+    if spec is None:
+        return 0, {}
+    if isinstance(spec, tuple) and len(spec) == 2 \
+            and isinstance(spec[1], dict):
+        # already-parsed form: idempotent, so hot loops can parse
+        # once and pass the result through
+        return int(spec[0]), {int(a): int(n)
+                              for a, n in spec[1].items()}
+    if isinstance(spec, str):
+        items = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition(":")
+            if not sep:
+                raise ValueError(
+                    f"--reserve-slots wants 'vars:N' / 'ARITY:N' "
+                    f"entries, got {part!r}")
+            items[key.strip()] = val.strip()
+        spec = items
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"reserve spec must be a 'vars:N,ARITY:N' string or a "
+            f"dict, got {type(spec).__name__}")
+    extra_vars = 0
+    slots: Dict[int, int] = {}
+    for key, val in spec.items():
+        try:
+            n = int(val)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"reserve count for {key!r} must be an int, "
+                f"got {val!r}")
+        if n < 0:
+            raise ValueError(
+                f"reserve count for {key!r} must be >= 0, got {n}")
+        if str(key).strip().lower() == "vars":
+            extra_vars = n
+            continue
+        try:
+            arity = int(key)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"reserve key must be 'vars' or an arity int, "
+                f"got {key!r}")
+        if arity < 1:
+            raise ValueError(
+                f"reserve arity must be >= 1, got {arity}")
+        slots[arity] = slots.get(arity, 0) + n
+    return extra_vars, slots
+
+
 @dataclass(frozen=True)
 class ShapeProfile:
     """The padding-relevant shape of one compiled instance."""
@@ -107,31 +172,41 @@ class Rung:
         return arrays.pad_to(self.n_vars, dict(self.bucket_slots))
 
 
-def _base_rung(profile: ShapeProfile) -> Rung:
+def _base_rung(profile: ShapeProfile, reserve=None) -> Rung:
     """The profile's home rung: next power of two per dimension, plus
-    one sink variable row anchoring phantom factors."""
+    one sink variable row anchoring phantom factors.  ``reserve``
+    (anything :func:`parse_reserve` accepts) adds explicit headroom on
+    top: extra variable rows and per-arity slots — part of the rung
+    SIGNATURE, so two jobs batch only when they were provisioned
+    alike."""
+    extra_vars, extra_slots = parse_reserve(reserve)
+    slots = {a: next_pow2(c)
+             for a, c in profile.bucket_counts if c}
+    for a, n in extra_slots.items():
+        slots[a] = slots.get(a, 0) + n
     return Rung(
         kind=profile.kind, max_domain=profile.max_domain,
-        n_vars=next_pow2(profile.n_vars) + 1,
-        bucket_slots={a: next_pow2(c)
-                      for a, c in profile.bucket_counts if c},
+        n_vars=next_pow2(profile.n_vars) + 1 + extra_vars,
+        bucket_slots=slots,
         n_pairs=next_pow2(profile.n_pairs),
     )
 
 
-def home_rung(profile: ShapeProfile) -> Rung:
+def home_rung(profile: ShapeProfile, reserve=None) -> Rung:
     """The profile's power-of-two home rung, public: the serving
     admission path (``serving/queue.py``) assigns each ARRIVING job its
     rung directly — no campaign-wide consolidation pass exists when
     jobs trickle in one at a time, so two jobs batch exactly when their
-    home-rung signatures (and solver options) match."""
-    return _base_rung(profile)
+    home-rung signatures (and solver options) match.  ``reserve``
+    provisions explicit edit headroom (see :func:`parse_reserve`)."""
+    return _base_rung(profile, reserve=reserve)
 
 
 def plan_rungs(profiles: List[ShapeProfile],
                max_waste: float = 2.0,
                max_rung_bytes: Optional[int] = None,
-               bytes_per_cell: int = 4) -> List["Rung"]:
+               bytes_per_cell: int = 4,
+               reserve=None) -> List["Rung"]:
     """Group instance profiles into a padding ladder.
 
     Pass 1 assigns each profile its power-of-two home rung (identical
@@ -148,10 +223,18 @@ def plan_rungs(profiles: List[ShapeProfile],
     This is where mixed precision buys program count: a campaign run
     at bf16 advertises 2-byte cells, so the same byte budget admits
     rungs twice as large and more small topologies merge into them.
-    ``None`` keeps the historical cells-only behavior."""
+    ``None`` keeps the historical cells-only behavior.
+
+    ``reserve`` (see :func:`parse_reserve`) adds explicit per-arity
+    slot and variable-row headroom to EVERY rung — the ``batch
+    --reserve-slots`` knob, provisioning edit capacity a dynamic
+    campaign activates in place.  The reservation rides the rung
+    signatures, so it costs compiled-program identity only when it
+    changes shapes (which is its entire point)."""
+    reserve = parse_reserve(reserve)   # once, not per profile
     by_sig: Dict[Tuple, Rung] = {}
     for i, p in enumerate(profiles):
-        rung = _base_rung(p)
+        rung = _base_rung(p, reserve=reserve)
         rung = by_sig.setdefault(rung.signature, rung)
         rung.members.append(i)
 

@@ -23,6 +23,12 @@ This package is that admission path:
   rejections);
 * :mod:`~pydcop_tpu.serving.sources` — stdin / unix-socket feeders.
 
+``delta`` jobs (the dynamic-DCOP kind) skip the batching queue: each
+targets a previously admitted maxsum job, whose warm scenario-engine
+session (:class:`~pydcop_tpu.serving.dispatcher.DeltaSessions`,
+``pydcop_tpu/dynamics/``) applies the edit in place and re-solves with
+no retrace.
+
 Cold starts are the other half of serving: with an attached
 :class:`~pydcop_tpu.engine._cache.ExecutableCache`, every compiled
 rung program is serialized via ``jax.stages``, and a restarted
@@ -32,14 +38,16 @@ recompiling (asserted by the warm-start test via the
 """
 
 from .daemon import ServeLoop
-from .dispatcher import Dispatcher
+from .dispatcher import DeltaSessions, Dispatcher
 from .queue import AdmissionQueue, AdmittedJob, DispatchGroup, \
     prepare_job
-from .schema import (REQUEST_FIELDS, SERVABLE_ALGOS, RequestError,
-                     parse_request, rejection, validate_request)
+from .schema import (DELTA_FIELDS, REQUEST_FIELDS, SERVABLE_ALGOS,
+                     RequestError, parse_request, rejection,
+                     validate_request)
 
 __all__ = [
-    "AdmissionQueue", "AdmittedJob", "DispatchGroup", "Dispatcher",
-    "REQUEST_FIELDS", "RequestError", "SERVABLE_ALGOS", "ServeLoop",
-    "parse_request", "prepare_job", "rejection", "validate_request",
+    "AdmissionQueue", "AdmittedJob", "DELTA_FIELDS", "DeltaSessions",
+    "DispatchGroup", "Dispatcher", "REQUEST_FIELDS", "RequestError",
+    "SERVABLE_ALGOS", "ServeLoop", "parse_request", "prepare_job",
+    "rejection", "validate_request",
 ]

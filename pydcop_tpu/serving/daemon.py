@@ -50,6 +50,7 @@ class ServeLoop:
                  default_max_cycles: int = 2000,
                  default_seed: int = 0,
                  default_precision: Optional[str] = None,
+                 reserve=None,
                  clock: Callable[[], float] = time.monotonic):
         self.admission = admission
         self.dispatcher = dispatcher
@@ -57,10 +58,20 @@ class ServeLoop:
         self.default_max_cycles = int(default_max_cycles)
         self.default_seed = int(default_seed)
         self.default_precision = default_precision
+        #: --reserve-slots: explicit phantom headroom every admitted
+        #: rung is provisioned with (parallel/bucketing.parse_reserve)
+        self.reserve = reserve
         self.clock = clock
         self._inbox: "_stdqueue.Queue" = _stdqueue.Queue()
         self._stop = threading.Event()
         self._input_closed = threading.Event()
+        #: admitted maxsum solve requests by job id — the targets a
+        #: later ``delta`` job may open a warm session against.
+        #: FIFO-bounded like every other serving-side store (a
+        #: million-job daemon must not retain a million request
+        #: dicts); only the delta-capable family is indexed at all
+        self._admitted_requests: Dict[str, Dict] = {}
+        self._admitted_requests_cap = 1024
         self.stats: Dict[str, int] = {
             "received": 0, "admitted": 0, "rejected": 0,
             "completed": 0}
@@ -103,11 +114,17 @@ class ServeLoop:
         except RequestError as e:
             self._emit_rejection(e.job_id, str(e), reply)
             return
+        if request.get("op") == "delta":
+            # deltas bypass the batching queue: a warm session is
+            # singular state, dispatch happens at admission
+            self._dispatch_delta(request, reply)
+            return
         try:
             job = prepare_job(
                 request, default_max_cycles=self.default_max_cycles,
                 default_seed=self.default_seed,
-                default_precision=self.default_precision, reply=reply)
+                default_precision=self.default_precision,
+                reserve=self.reserve, reply=reply)
         except Exception as e:
             # the FULL breadth of "bad job" lands here, not just the
             # anticipated ValueErrors: a file that exists but holds
@@ -119,7 +136,52 @@ class ServeLoop:
                                  algo=request.get("algo"))
             return
         self.admission.admit(job)
+        if request.get("algo") == "maxsum":
+            while len(self._admitted_requests) >= \
+                    self._admitted_requests_cap:
+                self._admitted_requests.pop(
+                    next(iter(self._admitted_requests)))
+            self._admitted_requests[request["id"]] = request
         self.stats["admitted"] += 1
+
+    def _dispatch_delta(self, request, reply=None):
+        """One delta job end-to-end: resolve the target session,
+        apply + warm re-solve.  Every failure — unknown target, an
+        event exceeding the reserved slots (``DeltaError``), a bad
+        cost table — is a structured rejection; the daemon keeps
+        serving."""
+        target = request["target"]
+        target_request = self._admitted_requests.get(target)
+        sessions = getattr(self.dispatcher, "delta_sessions", None)
+        if target_request is None and not (
+                sessions is not None and sessions.has(target)):
+            # an already-open warm session keeps its target reachable
+            # even after the bounded admitted-request index evicted
+            # the original request (the request is only needed to
+            # OPEN a session)
+            self._emit_rejection(
+                request["id"],
+                f"delta target {target!r} is not an admitted "
+                f"maxsum solve job of this daemon", reply,
+                algo="maxsum")
+            return
+        try:
+            self.dispatcher.dispatch_delta(
+                request, target_request,
+                default_max_cycles=self.default_max_cycles,
+                default_seed=self.default_seed,
+                default_precision=self.default_precision,
+                reply=reply, queue_depth=self.admission.depth())
+        except Exception as e:
+            # rejected-at-dispatch, never admitted: the stats
+            # reconciliation (received == admitted + rejected) the
+            # stop path documents must keep holding for deltas
+            self._emit_rejection(
+                request["id"], f"{type(e).__name__}: {e}", reply,
+                algo="maxsum")
+            return
+        self.stats["admitted"] += 1
+        self.stats["completed"] += 1
 
     # -------------------------------------------------------- dispatch
 
@@ -244,7 +306,11 @@ class ServeLoop:
                 instance_cache=instance_cache_stats(),
                 runner_cache=runner_cache_stats(),
                 exec_cache=(dict(exec_cache.stats)
-                            if exec_cache is not None else None))
+                            if exec_cache is not None else None),
+                sessions=(dict(self.dispatcher.delta_sessions.stats)
+                          if getattr(self.dispatcher,
+                                     "delta_sessions", None)
+                          is not None else None))
         return dict(self.stats)
 
     # --------------------------------------------------- oneshot drive

@@ -30,19 +30,105 @@ from ..parallel.bucketing import next_pow2
 from .queue import DispatchGroup
 
 
+class DeltaSessions:
+    """Warm scenario-engine sessions for the ``delta`` job kind.
+
+    A delta job targets a previously admitted maxsum solve job; the
+    FIRST delta against a target opens its session — a
+    :class:`~pydcop_tpu.dynamics.engine.DynamicEngine` built from the
+    target's request, cold-solved once (through the executable cache,
+    so a daemon restart deserializes a known rung instead of
+    compiling) — and every further delta applies in place and
+    re-solves warm: no retrace, no recompile, telemetry spans free of
+    ``trace_lower_s``/``compile_s``.  FIFO-bounded like the other
+    serving caches."""
+
+    def __init__(self, exec_cache=None, reserve=None, cap: int = 16):
+        self.exec_cache = exec_cache
+        self.reserve = reserve
+        self.cap = int(cap)
+        self._sessions: Dict[str, Any] = {}
+        self.stats: Dict[str, int] = {"opened": 0, "hits": 0,
+                                      "evictions": 0}
+
+    def get(self, target: str, target_request: Dict[str, Any],
+            default_max_cycles: int, default_seed: int,
+            default_precision=None):
+        """The target's warm engine, opening (and cold-solving) the
+        session on first use.  Returns ``(engine, opened)``."""
+        engine = self._sessions.get(target)
+        if engine is not None:
+            self.stats["hits"] += 1
+            return engine, False
+        from ..commands import CliError, build_algo_def, \
+            parse_algo_params
+        from ..dcop.yamldcop import load_dcop_from_file
+        from ..dynamics.engine import DynamicEngine
+
+        algo = target_request.get("algo")
+        if algo != "maxsum":
+            raise ValueError(
+                f"delta sessions speak the maxsum family only; "
+                f"target job used {algo!r}")
+        algo_params = list(target_request.get("algo_params", []))
+        try:
+            algo_def = build_algo_def(algo, algo_params, "min")
+            given = parse_algo_params(algo_params)
+        except CliError as e:
+            raise ValueError(str(e))
+        # engine-only keys are stripped by DynamicEngine itself
+        params = {k: algo_def.params[k] for k in given}
+        precision = (target_request.get("precision")
+                     or params.get("precision") or default_precision)
+        if precision:
+            params["precision"] = precision
+        dcop = load_dcop_from_file(target_request["dcop"])
+        engine = DynamicEngine(
+            dcop, algo=algo, mode="engine", reserve=self.reserve,
+            params=params,
+            max_cycles=int(target_request.get("max_cycles",
+                                              default_max_cycles)),
+            exec_cache=self.exec_cache)
+        while len(self._sessions) >= self.cap:
+            self._sessions.pop(next(iter(self._sessions)))
+            self.stats["evictions"] += 1
+        self._sessions[target] = engine
+        self.stats["opened"] += 1
+        return engine, True
+
+    def has(self, target: str) -> bool:
+        """Whether an open warm session exists for ``target`` (the
+        daemon consults this so a session outliving the bounded
+        admitted-request index stays reachable)."""
+        return target in self._sessions
+
+    def drop(self, target: str):
+        """Close a session whose state can no longer be trusted (a
+        base solve or a post-edit re-solve failed): the next delta
+        against the target reopens from the target's base instance —
+        well-defined recovery instead of a silently divergent or
+        half-open session."""
+        if self._sessions.pop(target, None) is not None:
+            self.stats["dropped"] = self.stats.get("dropped", 0) + 1
+
+
 class Dispatcher:
     """Executes dispatch groups; owns no queue state of its own."""
 
     def __init__(self, reporter=None, exec_cache=None,
                  clock: Callable[[], float] = time.monotonic,
-                 batch_pow2: bool = True):
+                 batch_pow2: bool = True, reserve=None):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
         self.batch_pow2 = bool(batch_pow2)
-        self.stats: Dict[str, int] = {"dispatches": 0, "jobs": 0}
+        self.stats: Dict[str, int] = {"dispatches": 0, "jobs": 0,
+                                      "deltas": 0}
         #: spans of the most recent dispatch (tests read this)
         self.last_spans: Dict[str, float] = {}
+        #: warm scenario sessions for delta jobs (lazy per target)
+        self.delta_sessions = DeltaSessions(exec_cache=exec_cache,
+                                            reserve=reserve)
 
     def dispatch(self, group: DispatchGroup,
                  queue_depth: int = 0) -> List[Dict[str, Any]]:
@@ -123,3 +209,90 @@ class Dispatcher:
                             if self.exec_cache is not None else None),
                 runner_cache=runner_cache_stats())
         return records
+
+    def dispatch_delta(self, request: Dict[str, Any],
+                       target_request: Dict[str, Any],
+                       default_max_cycles: int = 2000,
+                       default_seed: int = 0,
+                       default_precision=None,
+                       reply=None,
+                       queue_depth: int = 0) -> Dict[str, Any]:
+        """One ``delta`` job: apply the actions to the target's warm
+        session and re-solve.  Deltas bypass the batching queue — a
+        session is singular state, there is nothing to batch — and
+        dispatch immediately at admission.  Emits the per-job v1.1
+        ``summary`` (with ``edit``/``warm_start``) plus a ``serve``
+        dispatch record with ``reason: delta``; the spans prove the
+        warm contract (an open session re-solve carries no
+        ``trace_lower_s``/``compile_s``)."""
+        t0 = self.clock()
+        engine, opened = self.delta_sessions.get(
+            request["target"], target_request,
+            default_max_cycles, default_seed, default_precision)
+        open_spans = None
+        if opened:
+            # the session's base solve: compile or exec-cache
+            # deserialize happens HERE, once per (rung, params)
+            try:
+                engine.solve(
+                    seed=int(request.get("seed", default_seed)))
+            except Exception:
+                # a half-open session (cached, never base-solved)
+                # would mislabel every later delta as warm: close it
+                # so the next delta retries the cold open
+                self.delta_sessions.drop(request["target"])
+                raise
+            open_spans = dict(engine.last_spans)
+        # apply() either commits fully or raises with the instance
+        # untouched (compile_event validates before any write), so a
+        # DeltaError rejection leaves the session trustworthy
+        engine.apply(request["actions"])
+        try:
+            res = engine.solve(
+                max_cycles=request.get("max_cycles"))
+        except Exception as e:
+            # the edit is already committed but the client will see a
+            # rejection: a retried delta would then double-apply.
+            # Close the session so state stays well-defined — the
+            # next delta reopens from the target's base instance
+            self.delta_sessions.drop(request["target"])
+            raise ValueError(
+                f"warm re-solve failed after the edit was applied "
+                f"({type(e).__name__}: {e}); session for target "
+                f"{request['target']!r} closed — the next delta "
+                f"reopens it from the base instance") from e
+        elapsed = self.clock() - t0
+        self.last_spans = dict(engine.last_spans)
+        rec = {
+            "job_id": request["id"],
+            "algo": "maxsum",
+            "status": res["status"],
+            "assignment": res["assignment"],
+            "cost": res["cost"],
+            "violation": res["violation"],
+            "cycle": res["cycle"],
+            "time": res["spans"].get("execute_s", elapsed),
+            "target": request["target"],
+            "dispatch_reason": "delta",
+            "warm_start": res["warm_start"],
+        }
+        if res.get("edit"):
+            rec["edit"] = res["edit"]
+        if self.reporter is not None:
+            self.reporter.summary(**rec)
+        if reply is not None:
+            reply(dict(rec, record="summary", mode="serve"))
+        self.stats["deltas"] += 1
+        if self.reporter is not None:
+            self.reporter.serve(
+                event="dispatch", reason="delta",
+                rung=list(engine.rung.signature), batch=1,
+                queue_depth=int(queue_depth),
+                session_opened=bool(opened),
+                open_spans=open_spans,
+                reserve=res["budget"],
+                spans=dict(engine.last_spans),
+                exec_cache=(dict(self.exec_cache.stats)
+                            if self.exec_cache is not None else None),
+                sessions=dict(self.delta_sessions.stats))
+        return rec

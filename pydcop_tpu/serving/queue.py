@@ -76,9 +76,11 @@ def instance_cache_stats() -> Dict[str, int]:
 
 
 def _load_instance(path: str, family: str,
-                   precision: Optional[str]) -> Tuple:
+                   precision: Optional[str],
+                   reserve=None) -> Tuple:
     """(dcop, arrays, rung, padded) for one model file, cached on the
-    file's identity + build-relevant options."""
+    file's identity + build-relevant options (``reserve`` shapes the
+    rung, so it is part of the key)."""
     import os
 
     from ..dcop.dcop import filter_dcop
@@ -93,7 +95,7 @@ def _load_instance(path: str, family: str,
     # would otherwise serve a stale model after an in-place rewrite
     # within the same second
     key = (os.path.abspath(path), st.st_mtime_ns, st.st_size, family,
-           precision)
+           precision, str(reserve) if reserve else None)
     entry = _INSTANCE_CACHE.get(key)
     if entry is not None:
         _INSTANCE_CACHE_STATS["hits"] += 1
@@ -106,7 +108,7 @@ def _load_instance(path: str, family: str,
     else:
         arrays = HypergraphArrays.build(filter_dcop(dcop),
                                         precision=precision)
-    rung = home_rung(ShapeProfile.of(arrays))
+    rung = home_rung(ShapeProfile.of(arrays), reserve=reserve)
     entry = (dcop, arrays, rung, rung.pad(arrays))
     while len(_INSTANCE_CACHE) >= _INSTANCE_CACHE_CAP:
         _INSTANCE_CACHE.pop(next(iter(_INSTANCE_CACHE)))
@@ -118,6 +120,7 @@ def prepare_job(request: Dict[str, Any],
                 default_max_cycles: int = 2000,
                 default_seed: int = 0,
                 default_precision: Optional[str] = None,
+                reserve=None,
                 reply: Optional[Callable] = None) -> AdmittedJob:
     """A validated request -> :class:`AdmittedJob`: load the instance
     (through the admission cache), validate/cast the algorithm params
@@ -163,7 +166,7 @@ def prepare_job(request: Dict[str, Any],
 
     dcop, arrays, rung, padded = _load_instance(
         request["dcop"], FUSABLE_ALGOS[algo],
-        params.get("precision"))
+        params.get("precision"), reserve=reserve)
     max_cycles = int(request.get("max_cycles", default_max_cycles))
     group_key = (algo, tuple(sorted(params.items())), max_cycles,
                  rung.signature)

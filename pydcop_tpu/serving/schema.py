@@ -33,10 +33,10 @@ from typing import Any, Dict, Optional
 #: asserted by the test tier so the two can never drift)
 SERVABLE_ALGOS = ("maxsum", "dsa", "mgm")
 
-#: every accepted request field -> short doc (the schema, used both
-#: for validation and the docs)
+#: every accepted ``solve`` request field -> short doc (the schema,
+#: used both for validation and the docs)
 REQUEST_FIELDS = {
-    "op": "optional, must be 'solve' (the only op; reserved)",
+    "op": "optional: 'solve' (default) or 'delta' (see DELTA_FIELDS)",
     "id": "required job id (non-empty string, unique per client)",
     "dcop": "required path to the DCOP yaml file",
     "algo": f"required algorithm, one of {', '.join(SERVABLE_ALGOS)}",
@@ -47,6 +47,25 @@ REQUEST_FIELDS = {
     "deadline_ms": "optional per-job dispatch deadline (positive ms); "
                    "tightens the daemon's --max-delay-ms for the rung "
                    "this job waits in",
+}
+
+#: the ``delta`` job kind: a topology/cost edit against a previously
+#: admitted maxsum solve job, dispatched through the WARM scenario
+#: engine (``dynamics/``) — the re-solve reuses the session's compiled
+#: program (and the executable cache across restarts), so a known
+#: rung never compiles
+DELTA_FIELDS = {
+    "op": "required: 'delta'",
+    "id": "required job id for THIS delta dispatch",
+    "target": "required id of a previously admitted 'solve' job "
+              "(algo maxsum) whose instance this delta edits; the "
+              "first delta against a target opens its warm session",
+    "actions": "required non-empty list of scenario actions "
+               "(add_variable / remove_variable / add_constraint / "
+               "remove_constraint / change_costs — "
+               "dcop/scenario.py KNOWN_ACTIONS)",
+    "max_cycles": "optional cycle budget for the warm re-solve",
+    "seed": "optional engine seed (first solve of the session only)",
 }
 
 _PRECISIONS = ("f32", "bf16", "auto")
@@ -87,11 +106,14 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
     def bad(msg):
         return RequestError(msg, job_id=job_id)
 
+    op = rec.get("op", "solve")
+    if op == "delta":
+        return _validate_delta(rec, bad)
+    if op != "solve":
+        raise bad(f"unsupported op {op!r}; 'solve' or 'delta'")
     unknown = sorted(set(rec) - set(REQUEST_FIELDS))
     if unknown:
         raise bad(f"unknown request field(s): {', '.join(unknown)}")
-    if rec.get("op", "solve") != "solve":
-        raise bad(f"unsupported op {rec.get('op')!r}; only 'solve'")
     dcop = rec.get("dcop")
     if not isinstance(dcop, str) or not dcop:
         raise bad("request missing 'dcop' (yaml file path)")
@@ -124,6 +146,46 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
                            or isinstance(dl, bool) or dl <= 0):
         raise bad(f"'deadline_ms' must be a positive number, "
                   f"got {dl!r}")
+    return rec
+
+
+def _validate_delta(rec: Dict[str, Any], bad) -> Dict[str, Any]:
+    """The ``delta`` branch of :func:`validate_request` — action
+    payloads are validated against the scenario vocabulary HERE, at
+    the admission trust boundary, so a typoed action type is a
+    structured rejection before any session work."""
+    from ..dcop.scenario import ScenarioError, validate_action
+
+    unknown = sorted(set(rec) - set(DELTA_FIELDS))
+    if unknown:
+        raise bad(f"unknown delta request field(s): "
+                  f"{', '.join(unknown)}")
+    target = rec.get("target")
+    if not isinstance(target, str) or not target.strip():
+        raise bad("delta request missing 'target' (the id of a "
+                  "previously admitted solve job)")
+    rec["target"] = target.strip()
+    actions = rec.get("actions")
+    if not isinstance(actions, list) or not actions:
+        raise bad("delta request needs a non-empty 'actions' list")
+    for i, action in enumerate(actions):
+        if not isinstance(action, dict):
+            raise bad(f"actions[{i}] must be a mapping, got "
+                      f"{type(action).__name__}")
+        try:
+            validate_action(action.get("type"),
+                            {k: v for k, v in action.items()
+                             if k != "type"}, action=i)
+        except ScenarioError as e:
+            raise bad(str(e))
+    mc = rec.get("max_cycles")
+    if mc is not None and (isinstance(mc, bool)
+                           or not isinstance(mc, int) or mc < 1):
+        raise bad(f"'max_cycles' must be a positive int, got {mc!r}")
+    seed = rec.get("seed")
+    if seed is not None and (isinstance(seed, bool)
+                             or not isinstance(seed, int)):
+        raise bad(f"'seed' must be an int, got {seed!r}")
     return rec
 
 

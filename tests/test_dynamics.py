@@ -1,0 +1,726 @@
+"""Dynamic DCOP on device (ISSUE 10).
+
+Layers under test:
+
+* ``dynamics/deltas.py`` — EventAction -> TopologyDelta compilation:
+  slot/var budget validation (loud structured ``DeltaError``),
+  sequential event semantics, transactional compile;
+* ``dynamics/engine.py`` — the warm engine's retrace-free contract
+  (spans of every post-first solve free of trace/compile) and the
+  bit-exactness guard: for EACH event type, a warm ``apply(delta)``
+  equals a cold solve of the hand-edited DCOP — selections AND final
+  cost — on the maxsum single-chip, sharded, and batched paths;
+* ``dynamics/replay.py`` — scenario replay (one warm campaign) and
+  the batched descendants regime through the fused runners;
+* ``serving/`` — the ``delta`` job kind: warm sessions, structured
+  rejections, dispatch telemetry;
+* ``observability/report.py`` — the v1.1 ``edit``/``warm_start``
+  fields and the ``schema_minor`` stamp (v1 readers stay green);
+* ``graphs/arrays.py pad_to(reserve=...)`` +
+  ``parallel/bucketing.py`` — the explicit headroom knob.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dcop.yamldcop import load_scenario
+from pydcop_tpu.dynamics import (DeltaError, DynamicEngine,
+                                 build_dynamic_instance,
+                                 replay_batched, replay_scenario)
+from pydcop_tpu.engine.sync_engine import SyncEngine
+from pydcop_tpu.graphs.arrays import FactorGraphArrays
+
+pytestmark = pytest.mark.dyn
+
+
+# ------------------------------------------------------------ fixtures
+
+
+def chain_dcop(n=6, d=3, seed=0, edit=None):
+    """Random-integer-cost chain: tree-structured, so min-sum has one
+    fixed point, and integer costs keep every float sum exact — the
+    preconditions of the bit-exactness guard."""
+    rng = np.random.RandomState(seed)
+    dcop = DCOP("chain")
+    dom = Domain("dom", "d", list(range(d)))
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n - 1):
+        m = rng.randint(0, 10, size=(d, d))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[i + 1]], m, name=f"c{i}"))
+    if edit:
+        edit(dcop, dom)
+    return dcop
+
+
+NEW_COSTS = np.arange(9).reshape(3, 3).tolist()
+ADD_COSTS = (np.arange(9).reshape(3, 3) % 5).tolist()
+
+
+def edit_change(dcop, dom):
+    dcop.constraints["c2"]._m = np.asarray(NEW_COSTS,
+                                           dtype=np.float64)
+
+
+def edit_add(dcop, dom):
+    v6 = Variable("v6", dom)
+    dcop.add_variable(v6)
+    dcop.add_constraint(NAryMatrixRelation(
+        [dcop.variables["v5"], v6], ADD_COSTS, name="c_new"))
+
+
+def cold_result(dcop, max_cycles=500):
+    """The repo's canonical cold oracle: build + SyncEngine solve of
+    the (hand-edited) DCOP."""
+    arrays = FactorGraphArrays.build(dcop, arity_sorted=True)
+    engine = SyncEngine(MaxSumSolver(arrays))
+    return engine.run(max_cycles=max_cycles,
+                      variables=list(dcop.variables.values()))
+
+
+def assert_warm_spans(spans):
+    """The no-retrace contract: a warm dispatch never traces or
+    compiles."""
+    assert "trace_lower_s" not in spans, spans
+    assert "compile_s" not in spans, spans
+    assert "execute_s" in spans
+
+
+# --------------------------------------------------- delta compilation
+
+
+def test_budget_reports_reserved_capacity():
+    rung, inst = build_dynamic_instance(chain_dcop(),
+                                        reserve="vars:4,2:6")
+    b = inst.budget()
+    # pow2(6 vars) + 1 sink + 4 reserved rows
+    assert b["n_var_rows"] == 8 + 1 + 4
+    assert b["free_var_rows"] == b["n_var_rows"] - 6 - 1
+    # pow2(5 factors) + 6 reserved slots
+    assert b["slots"][2] == {"total": 8 + 6, "free": 9, "live": 5}
+
+
+def test_compile_is_transactional_on_rejection():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    before = inst.budget()
+    with pytest.raises(DeltaError) as e:
+        # second action fails (unknown var): nothing may stick
+        inst.compile_event([
+            {"type": "remove_constraint", "name": "c0"},
+            {"type": "change_costs", "name": "nope",
+             "costs": NEW_COSTS},
+        ])
+    assert e.value.kind == "unknown_constraint"
+    assert inst.budget() == before
+    assert "c0" in inst.live_factors
+
+
+def test_slot_budget_rejection_is_structured():
+    # chain of 5 binary factors pads to 8 slots: 3 free
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    ok = [{"type": "add_constraint", "name": f"x{i}",
+           "scope": ["v0", "v2"], "costs": NEW_COSTS}
+          for i in range(3)]
+    inst.apply(inst.compile_event(ok))
+    with pytest.raises(DeltaError) as e:
+        inst.compile_event([{"type": "add_constraint", "name": "x3",
+                             "scope": ["v0", "v3"],
+                             "costs": NEW_COSTS}])
+    assert e.value.kind == "slot_budget"
+    assert e.value.details["arity"] == 2
+    assert e.value.details["free"] == 0
+    assert "--reserve-slots" in str(e.value)
+
+
+def test_no_bucket_for_arity_is_slot_budget():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    with pytest.raises(DeltaError) as e:
+        inst.compile_event([{"type": "add_constraint", "name": "t",
+                             "scope": ["v0", "v1", "v2"],
+                             "costs": np.zeros((3, 3, 3)).tolist()}])
+    assert e.value.kind == "slot_budget"
+    assert e.value.details["arity"] == 3
+
+
+def test_var_budget_and_domain_budget_rejections():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    free = inst.budget()["free_var_rows"]
+    grow = [{"type": "add_variable", "name": f"w{i}",
+             "values": [0, 1]} for i in range(free)]
+    inst.apply(inst.compile_event(grow))
+    with pytest.raises(DeltaError) as e:
+        inst.compile_event([{"type": "add_variable", "name": "wX",
+                             "values": [0, 1]}])
+    assert e.value.kind == "var_budget"
+    _rung2, inst2 = build_dynamic_instance(chain_dcop())
+    with pytest.raises(DeltaError) as e:
+        inst2.compile_event([{"type": "add_variable", "name": "big",
+                              "values": [0, 1, 2, 3]}])
+    assert e.value.kind == "domain_budget"
+
+
+def test_remove_variable_with_attached_factors_rejected():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    with pytest.raises(DeltaError) as e:
+        inst.compile_event([{"type": "remove_variable",
+                             "name": "v2"}])
+    assert e.value.kind == "attached_factors"
+    assert set(e.value.details["factors"]) == {"c1", "c2"}
+    # same event removing the factors first is legal
+    delta = inst.compile_event([
+        {"type": "remove_constraint", "name": "c1"},
+        {"type": "remove_constraint", "name": "c2"},
+        {"type": "remove_variable", "name": "v2"},
+    ])
+    assert delta.summary["remove_constraint"] == 2
+    assert delta.summary["remove_variable"] == 1
+
+
+def test_agent_actions_rejected_on_compiled_path():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    with pytest.raises(DeltaError) as e:
+        inst.compile_event([{"type": "remove_agent",
+                             "agents": ["a1"]}])
+    assert e.value.kind == "bad_args"
+
+
+def test_duplicate_and_unknown_names():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    for actions, kind in [
+        ([{"type": "add_variable", "name": "v0",
+           "values": [0]}], "duplicate_variable"),
+        ([{"type": "add_constraint", "name": "c0",
+           "scope": ["v0", "v1"], "costs": NEW_COSTS}],
+         "duplicate_constraint"),
+        ([{"type": "remove_variable", "name": "zz"}],
+         "unknown_variable"),
+        ([{"type": "change_costs", "name": "zz",
+           "costs": NEW_COSTS}], "unknown_constraint"),
+        ([{"type": "add_constraint", "name": "n",
+           "scope": ["v0", "zz"], "costs": NEW_COSTS}],
+         "unknown_variable"),
+        ([{"type": "change_costs", "name": "c0",
+           "costs": [[1, 2], [3, 4]]}], "bad_costs"),
+    ]:
+        with pytest.raises(DeltaError) as e:
+            inst.compile_event(actions)
+        assert e.value.kind == kind, actions
+
+
+def test_touched_edges_are_the_slot_edges():
+    _rung, inst = build_dynamic_instance(chain_dcop())
+    bi, slot = inst.live_factors["c2"]
+    offset, _slots, arity = inst.layout[bi]
+    delta = inst.compile_event([{"type": "change_costs", "name": "c2",
+                                 "costs": NEW_COSTS}])
+    expect = offset + slot * arity + np.arange(arity)
+    assert np.array_equal(delta.touched_edges, expect)
+
+
+# ------------------------------------- warm == cold bit-exactness guard
+
+
+@pytest.mark.parametrize("event,editor", [
+    ([{"type": "change_costs", "name": "c2",
+       "costs": NEW_COSTS}], edit_change),
+    ([{"type": "add_variable", "name": "v6", "values": [0, 1, 2]},
+      {"type": "add_constraint", "name": "c_new",
+       "scope": ["v5", "v6"], "costs": ADD_COSTS}], edit_add),
+])
+def test_warm_apply_equals_cold_solve_single_chip(event, editor):
+    """The guard: warm apply(delta) == cold solve of the hand-edited
+    DCOP, selections AND final cost, with no trace/compile span on
+    the warm dispatch.  carry='reset' is the structural-equality
+    mode (fresh messages over the edited ARGUMENT planes — identical
+    arithmetic to a cold solve, phantom rows inert)."""
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4",
+                        carry="reset")
+    r0 = eng.solve(max_cycles=500)
+    assert not r0["warm_start"]
+    eng.apply(event)
+    warm = eng.solve(max_cycles=500)
+    assert warm["warm_start"]
+    assert_warm_spans(warm["spans"])
+    cold = cold_result(chain_dcop(edit=editor))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+    assert warm["cycle"] == cold.cycles
+
+
+def test_warm_remove_equals_cold_solve_single_chip():
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4",
+                        carry="reset")
+    eng.solve(max_cycles=500)
+    eng.apply([{"type": "add_variable", "name": "v6",
+                "values": [0, 1, 2]},
+               {"type": "add_constraint", "name": "c_new",
+                "scope": ["v5", "v6"], "costs": ADD_COSTS}])
+    eng.solve(max_cycles=500)
+    eng.apply([{"type": "remove_constraint", "name": "c_new"},
+               {"type": "remove_variable", "name": "v6"}])
+    warm = eng.solve(max_cycles=500)
+    assert_warm_spans(warm["spans"])
+    cold = cold_result(chain_dcop())   # removal restores the base
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+
+
+def test_warm_carry_messages_reaches_same_fixed_point():
+    """carry='messages' (the conditional-Max-Sum default): untouched
+    q/r rows carry the previous fixed point; on a tree with clear
+    margins the warm re-solve lands on the SAME answer — still
+    retrace-free."""
+    eng = DynamicEngine(chain_dcop(seed=3), reserve="2:4")
+    eng.solve(max_cycles=500)
+    event = [{"type": "change_costs", "name": "c1",
+              "costs": (np.arange(9).reshape(3, 3) % 7).tolist()}]
+    eng.apply(event)
+    warm = eng.solve(max_cycles=500)
+    assert warm["warm_start"] and warm["carry"] == "messages"
+    assert_warm_spans(warm["spans"])
+
+    def editor(dcop, dom):
+        dcop.constraints["c1"]._m = np.asarray(
+            np.arange(9).reshape(3, 3) % 7, dtype=np.float64)
+    cold = cold_result(chain_dcop(seed=3, edit=editor))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+
+
+@pytest.mark.mesh
+def test_warm_apply_equals_cold_solve_sharded():
+    """The sharded leg of the guard: DynamicShardedMaxSum carries its
+    mesh constants in the engine carry, so a delta apply re-enters
+    the SAME compiled chunk (no trace/compile span) and matches the
+    cold oracle bit-exactly."""
+    eng = DynamicEngine(chain_dcop(), mode="sharded",
+                        reserve="vars:4,2:4", carry="reset")
+    r0 = eng.solve(max_cycles=500)
+    cold0 = cold_result(chain_dcop())
+    assert r0["assignment"] == cold0.assignment
+    assert r0["cost"] == pytest.approx(cold0.cost)
+
+    eng.apply([{"type": "change_costs", "name": "c2",
+                "costs": NEW_COSTS}])
+    warm = eng.solve(max_cycles=500)
+    assert_warm_spans(warm["spans"])
+    cold = cold_result(chain_dcop(edit=edit_change))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+
+    eng.apply([{"type": "add_variable", "name": "v6",
+                "values": [0, 1, 2]},
+               {"type": "add_constraint", "name": "c_new",
+                "scope": ["v5", "v6"], "costs": ADD_COSTS}])
+    warm2 = eng.solve(max_cycles=500)
+    assert_warm_spans(warm2["spans"])
+
+    def both(dcop, dom):
+        edit_change(dcop, dom)
+        edit_add(dcop, dom)
+    cold2 = cold_result(chain_dcop(edit=both))
+    assert warm2["assignment"] == cold2.assignment
+    assert warm2["cost"] == pytest.approx(cold2.cost)
+
+
+SCEN_YAML = """
+events:
+  - id: w1
+    delay: 1
+  - id: e1
+    actions:
+      - type: change_costs
+        name: c2
+        costs: [[0,1,2],[3,4,5],[6,7,8]]
+  - id: e2
+    actions:
+      - type: add_variable
+        name: v6
+        values: [0, 1, 2]
+      - type: add_constraint
+        name: c_new
+        scope: [v5, v6]
+        costs: [[0,1,2],[3,4,0],[1,0,3]]
+  - id: e3
+    actions:
+      - type: remove_constraint
+        name: c_new
+      - type: remove_variable
+        name: v6
+"""
+
+
+def test_batched_replay_matches_per_event_solves():
+    """The batched leg of the guard: the scenario's whole descendant
+    family through ONE fused vmapped program equals the per-event
+    warm replay — selections, costs AND convergence cycles."""
+    scen = load_scenario(SCEN_YAML)
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4",
+                        carry="reset")
+    rep = replay_scenario(eng, scen, max_cycles=500)
+    batched = replay_batched(chain_dcop(), scen,
+                             reserve="vars:4,2:4", max_cycles=500)
+    assert [r["event"] for r in batched] == \
+        ["__initial__", "e1", "e2", "e3"]
+    warm_by_event = {e["event"]: e for e in rep["events"]
+                     if "status" in e}
+    warm_by_event["__initial__"] = rep["initial"]
+    warm_by_event["__initial__"]["event"] = "__initial__"
+    for row in batched:
+        w = warm_by_event[row["event"]]
+        assert row["assignment"] == w["assignment"], row["event"]
+        assert row["cost"] == pytest.approx(w["cost"])
+        assert row["cycle"] == w["cycle"]
+
+
+def test_replay_scenario_records_and_spans(tmp_path):
+    """A full >= 3-event-kind scenario replays through one warm
+    engine: exactly one compile (the initial solve), every event
+    dispatch warm; reporter records validate against the v1.1
+    schema."""
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+
+    scen = load_scenario(SCEN_YAML)
+    out = str(tmp_path / "replay.jsonl")
+    reporter = RunReporter(out, algo="maxsum", mode="engine")
+    reporter.header(scenario="inline")
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4")
+    rep = replay_scenario(eng, scen, max_cycles=500,
+                          reporter=reporter)
+    reporter.close()
+    assert "compile_s" in rep["initial"]["spans"]
+    solved = [e for e in rep["events"] if "status" in e]
+    assert len(solved) == 3
+    for e in solved:
+        assert_warm_spans(e["spans"])
+        assert e["warm_start"]
+        assert e["edit"]
+    delays = [e for e in rep["events"] if "delay" in e]
+    assert delays == [{"event": "w1", "delay": 1}]
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    summaries = [r for r in records if r["record"] == "summary"]
+    assert [s.get("event") for s in summaries] == \
+        ["__initial__", "e1", "e2", "e3"]
+    assert summaries[2]["edit"]["add_variable"] == 1
+    assert all(s["warm_start"] for s in summaries[1:])
+
+
+def test_exec_cache_restart_deserializes_dynamics(tmp_path):
+    """A NEW engine over the same (rung, params) with the serving
+    executable cache attached cold-starts by DESERIALIZING the warm
+    program: no compile span, identical result."""
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    if not cache.enabled:
+        pytest.skip("executable cache unavailable")
+    e1 = DynamicEngine(chain_dcop(), reserve="2:4",
+                       exec_cache=cache)
+    r1 = e1.solve(max_cycles=500)
+    assert "compile_s" in r1["spans"]
+    e2 = DynamicEngine(chain_dcop(), reserve="2:4",
+                       exec_cache=cache)
+    r2 = e2.solve(max_cycles=500)
+    assert "deserialize_s" in r2["spans"]
+    assert "compile_s" not in r2["spans"]
+    assert r2["assignment"] == r1["assignment"]
+
+
+# ----------------------------------------------------- engine rejections
+
+
+@pytest.mark.parametrize("params,needle", [
+    ({"bnb": True}, "bnb"),
+    ({"noise": 0.1}, "noise"),
+    ({"decimation_p": 0.2}, "decimation"),
+    ({"delta_on": "beliefs"}, "delta_on"),
+    ({"stability": 0}, "stability"),
+])
+def test_engine_rejects_incompatible_params(params, needle):
+    with pytest.raises(ValueError, match=needle):
+        DynamicEngine(chain_dcop(), params=params)
+
+
+def test_engine_rejects_non_maxsum_and_bad_carry():
+    with pytest.raises(ValueError, match="maxsum"):
+        DynamicEngine(chain_dcop(), algo="dsa")
+    with pytest.raises(ValueError, match="carry"):
+        DynamicEngine(chain_dcop(), carry="warmish")
+
+
+# ------------------------------------------------------- serve deltas
+
+
+def _instance_yaml(tmp_path):
+    lines = ["name: dyn", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(4):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k in range(3):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {4 + k} if v{k} == v{k + 1} else 0}}")
+    lines.append("agents: [a0, a1, a2, a3]")
+    p = tmp_path / "dyn.yaml"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_delta_request_schema():
+    from pydcop_tpu.serving.schema import (RequestError,
+                                           validate_request)
+
+    ok = validate_request({
+        "op": "delta", "id": "d1", "target": " j1 ",
+        "actions": [{"type": "change_costs", "name": "c0",
+                     "costs": [[0]]}]})
+    assert ok["target"] == "j1"
+    for bad, needle in [
+        ({"op": "delta", "id": "d", "actions": [
+            {"type": "change_costs", "name": "c", "costs": []}]},
+         "target"),
+        ({"op": "delta", "id": "d", "target": "j",
+          "actions": []}, "actions"),
+        ({"op": "delta", "id": "d", "target": "j",
+          "actions": [{"type": "explode"}]}, "unknown action"),
+        ({"op": "delta", "id": "d", "target": "j",
+          "actions": [{"type": "change_costs", "name": "c"}]},
+         "missing required"),
+        ({"op": "delta", "id": "d", "target": "j", "dcop": "x",
+          "actions": [{"type": "remove_constraint", "name": "c"}]},
+         "unknown delta request field"),
+    ]:
+        with pytest.raises(RequestError, match=needle):
+            validate_request(bad)
+
+
+@pytest.mark.serve
+def test_serve_delta_session_end_to_end(tmp_path):
+    """The acceptance path: a solve job admits an instance; delta
+    jobs against it open ONE warm session — the second delta's
+    dispatch shows no trace/compile span — and bad deltas reject
+    structurally while the daemon keeps serving."""
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+    from pydcop_tpu.serving.daemon import ServeLoop
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    dcop_file = _instance_yaml(tmp_path)
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(
+        AdmissionQueue(max_batch=2, max_delay_s=0.01),
+        Dispatcher(reporter=reporter, exec_cache=None,
+                   reserve="vars:2,2:4"),
+        reporter=reporter, default_max_cycles=300,
+        reserve="vars:2,2:4")
+    lines = [
+        json.dumps({"id": "j1", "dcop": dcop_file,
+                    "algo": "maxsum", "max_cycles": 300}),
+        json.dumps({"id": "d1", "op": "delta", "target": "j1",
+                    "actions": [{"type": "change_costs",
+                                 "name": "c1",
+                                 "costs": [[0, 5, 9], [5, 0, 1],
+                                           [9, 1, 0]]}]}),
+        json.dumps({"id": "d2", "op": "delta", "target": "j1",
+                    "actions": [
+                        {"type": "add_variable", "name": "v4",
+                         "values": [0, 1, 2]},
+                        {"type": "add_constraint", "name": "c3",
+                         "scope": ["v3", "v4"],
+                         "costs": [[4, 0, 2], [0, 4, 2],
+                                   [2, 2, 0]]}]}),
+        json.dumps({"id": "d_badtarget", "op": "delta",
+                    "target": "nope", "actions": [
+                        {"type": "remove_constraint",
+                         "name": "c3"}]}),
+        json.dumps({"id": "d_badbudget", "op": "delta",
+                    "target": "j1", "actions": [
+                        {"type": "add_constraint", "name": "t3",
+                         "scope": ["v0", "v1", "v2"],
+                         "costs": np.zeros((3, 3, 3)).tolist()}]}),
+    ]
+    stats = loop.run_oneshot(lines)
+    reporter.close()
+    assert stats["completed"] >= 3        # j1 + d1 + d2
+    assert stats["rejected"] == 2
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    summaries = {r["job_id"]: r for r in records
+                 if r["record"] == "summary"}
+    assert summaries["d1"]["warm_start"] is True
+    assert summaries["d1"]["edit"]["change_costs"] == 1
+    assert summaries["d2"]["edit"]["add_variable"] == 1
+    assert summaries["d_badtarget"]["status"] == "REJECTED"
+    assert "not an admitted maxsum solve job" in \
+        summaries["d_badtarget"]["error"]
+    assert summaries["d_badbudget"]["status"] == "REJECTED"
+    assert "slot_budget" in summaries["d_badbudget"]["error"] or \
+        "reserved" in summaries["d_badbudget"]["error"]
+    deltas = [r for r in records if r["record"] == "serve"
+              and r.get("reason") == "delta"]
+    assert len(deltas) == 2
+    assert deltas[0]["session_opened"] is True
+    assert deltas[1]["session_opened"] is False
+    # the second delta re-entered the session's compiled program
+    assert "compile_s" not in deltas[1]["spans"]
+    assert "trace_lower_s" not in deltas[1]["spans"]
+    # the reserved budget is echoed (keys stringified by JSON)
+    assert deltas[0]["reserve"]["slots"]["2"]["total"] >= 8
+
+
+def test_cli_solve_scenario_end_to_end(tmp_path):
+    """The acceptance path: a full >= 3-event-kind scenario replays
+    through `solve --scenario` (real CLI subprocess) without a
+    retrace — per-event telemetry records are warm with
+    execute-only spans."""
+    import os
+    import subprocess
+    import sys
+
+    from pydcop_tpu.observability.report import (read_records,
+                                                 validate_record)
+
+    dcop_file = _instance_yaml(tmp_path)
+    scen_file = tmp_path / "scen.yaml"
+    scen_file.write_text(SCEN_YAML.replace("v5", "v3")
+                         .replace("v6", "v4"))
+    tel = str(tmp_path / "tel.jsonl")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "solve",
+         dcop_file, "-a", "maxsum", "--scenario", str(scen_file),
+         "--reserve-slots", "vars:4,2:4", "--telemetry", tel,
+         "--max_cycles", "300"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout)
+    assert result["scenario"]["events_applied"] == 3
+    assert result["scenario"]["delays"] == 1
+    records = read_records(tel)
+    for rec in records:
+        validate_record(rec)
+    summaries = [r for r in records if r["record"] == "summary"]
+    assert [s["event"] for s in summaries] == \
+        ["__initial__", "e1", "e2", "e3"]
+    assert "compile_s" in summaries[0]["spans"] or \
+        "deserialize_s" in summaries[0]["spans"]
+    for s in summaries[1:]:
+        assert s["warm_start"] is True
+        assert "compile_s" not in s["spans"]
+        assert "trace_lower_s" not in s["spans"]
+        assert s["edit"]
+
+
+# ------------------------------------------------- reserve provisioning
+
+
+def test_parse_reserve_grammar_and_errors():
+    from pydcop_tpu.parallel.bucketing import parse_reserve
+
+    assert parse_reserve(None) == (0, {})
+    assert parse_reserve("vars:8,2:16,3:4") == (8, {2: 16, 3: 4})
+    assert parse_reserve({"vars": 2, 2: 5}) == (2, {2: 5})
+    for bad in ("vars", "2:x", "0:4", "vars:-1", 42):
+        with pytest.raises(ValueError):
+            parse_reserve(bad)
+
+
+def test_home_rung_reserve_changes_signature_and_capacity():
+    from pydcop_tpu.parallel.bucketing import (ShapeProfile,
+                                               home_rung)
+
+    arrays = FactorGraphArrays.build(chain_dcop(), arity_sorted=True)
+    prof = ShapeProfile.of(arrays)
+    plain = home_rung(prof)
+    reserved = home_rung(prof, reserve="vars:4,2:6,3:2")
+    assert reserved.signature != plain.signature
+    assert reserved.n_vars == plain.n_vars + 4
+    assert reserved.bucket_slots[2] == plain.bucket_slots[2] + 6
+    assert reserved.bucket_slots[3] == 2      # new arity, reservable
+    padded = reserved.pad(arrays)
+    assert padded.n_vars == reserved.n_vars
+    assert any(b.arity == 3 for b in padded.buckets)
+
+
+def test_pad_to_reserve_kwarg():
+    arrays = FactorGraphArrays.build(chain_dcop(), arity_sorted=True)
+    padded = arrays.pad_to(arrays.n_vars + 2, {2: 8},
+                           reserve={2: 4, 3: 2})
+    by_arity = {b.arity: b.cubes.shape[0] for b in padded.buckets}
+    assert by_arity[2] == 12 and by_arity[3] == 2
+    with pytest.raises(ValueError):
+        arrays.pad_to(arrays.n_vars + 1, {2: 8}, reserve={2: -1})
+
+
+def test_plan_rungs_reserve_applies_to_every_rung():
+    from pydcop_tpu.generators.fast import coloring_factor_arrays
+    from pydcop_tpu.parallel.bucketing import (ShapeProfile,
+                                               plan_rungs)
+
+    profiles = [ShapeProfile.of(coloring_factor_arrays(
+        8 + 4 * i, 14 + 2 * i, 3, seed=i)) for i in range(3)]
+    rungs = plan_rungs(profiles, reserve="vars:2,2:8")
+    for rung in rungs:
+        assert rung.bucket_slots[2] >= 8  # headroom present
+        for i in rung.members:
+            assert rung.covers(profiles[i])
+
+
+# -------------------------------------------------- v1.1 schema fields
+
+
+def test_validate_record_edit_and_warm_start():
+    from pydcop_tpu.observability.report import validate_record
+
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "warm_start": True,
+                     "edit": {"change_costs": 1,
+                              "touched_edges": 2}})
+    with pytest.raises(ValueError, match="warm_start"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "warm_start": "yes"})
+    with pytest.raises(ValueError, match="unknown key"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "edit": {"exploded": 1}})
+    with pytest.raises(ValueError, match="edit"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK",
+                         "edit": {"change_costs": -1}})
+
+
+def test_header_schema_minor_versioning():
+    from pydcop_tpu.observability.report import (SCHEMA_MINOR,
+                                                 RunReporter,
+                                                 validate_record)
+
+    # v1.0 headers (no minor) stay green: old files remain readable
+    validate_record({"record": "header", "schema": 1, "algo": "m",
+                     "mode": "engine"})
+    validate_record({"record": "header", "schema": 1,
+                     "schema_minor": SCHEMA_MINOR, "algo": "m",
+                     "mode": "engine"})
+    with pytest.raises(ValueError, match="schema_minor"):
+        validate_record({"record": "header", "schema": 1,
+                         "schema_minor": "one", "algo": "m",
+                         "mode": "engine"})
+    assert SCHEMA_MINOR >= 1

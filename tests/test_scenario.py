@@ -66,3 +66,73 @@ events:
     assert len(s) == 2
     assert s.events[0].is_delay and s.events[0].delay == 3
     assert s.events[1].actions[0].args == {"agents": ["a1"]}
+
+
+# ------------------------------------------------------ negative paths
+#
+# A scenario file is external input to long-running replays (`solve
+# --scenario`, serve delta sessions): every malformed event must be a
+# structured ScenarioError naming the offender, never a KeyError from
+# deep inside a replay.
+
+from pydcop_tpu.dcop.scenario import (KNOWN_ACTIONS, ScenarioError,
+                                      validate_action)
+
+
+def test_load_scenario_unknown_action_type():
+    with pytest.raises(ScenarioError) as e:
+        load_scenario("""
+events:
+  - id: boom
+    actions:
+      - type: detonate_agent
+        agents: [a1]
+""")
+    assert e.value.event == "boom" and e.value.action == 0
+    assert "unknown action type" in str(e.value)
+    assert e.value.details["type"] == "detonate_agent"
+
+
+def test_load_scenario_missing_action_args():
+    with pytest.raises(ScenarioError) as e:
+        load_scenario("""
+events:
+  - id: boom
+    actions:
+      - type: add_constraint
+        name: c9
+""")
+    assert e.value.details["missing"] == ["scope", "costs"]
+    assert "event 'boom' action #0" in str(e.value)
+
+
+@pytest.mark.parametrize("yaml_text,needle", [
+    ("not a mapping", "mapping with an 'events' list"),
+    ("events: {a: 1}", "'events' must be a list"),
+    ("events: [42]", "must be a mapping"),
+    ("events:\n  - delay: 1", "non-empty scalar 'id'"),
+    ("events:\n  - id: e\n    delay: -2", "non-negative number"),
+    ("events:\n  - id: e\n    delay: 1\n    actions: "
+     "[{type: remove_agent, agents: [a]}]", "EITHER a delay"),
+    ("events:\n  - id: e", "either 'delay' or 'actions'"),
+    ("events:\n  - id: e\n    actions: []", "non-empty list"),
+    ("events:\n  - id: e\n    actions: [17]", "must be a mapping"),
+    ("events:\n  - id: e\n    actions: [{agents: [a]}]",
+     "non-empty string 'type'"),
+])
+def test_load_scenario_structural_errors(yaml_text, needle):
+    with pytest.raises(ScenarioError, match=needle):
+        load_scenario(yaml_text)
+
+
+def test_validate_action_vocabulary_is_complete():
+    # the compiled dialect + the host agent actions, nothing silent
+    assert set(KNOWN_ACTIONS) == {
+        "add_agent", "remove_agent", "add_variable",
+        "remove_variable", "add_constraint", "remove_constraint",
+        "change_costs"}
+    validate_action("change_costs", {"name": "c", "costs": []})
+    with pytest.raises(ScenarioError) as e:
+        validate_action("change_costs", {"name": "c"}, event="ev",
+                        action=3)
+    assert e.value.event == "ev" and e.value.action == 3
