@@ -18,6 +18,13 @@ Three input modes::
 
 SIGTERM stops gracefully: the in-flight rung completes, every queued
 job is rejected with a structured reason.
+
+The ops plane (ISSUE 11): a metrics registry instruments admission
+and dispatch by default (`--no-metrics` disables), `--metrics-port`
+exposes it as a Prometheus endpoint, `--heartbeat-s` emits periodic
+queue/rate/memory records to ``--out``, a ``stats`` request (or
+``pydcop serve-status``) snapshots a running daemon, and every job's
+pipeline life is reconstructable from its ``trace_id``.
 """
 
 import os
@@ -107,6 +114,27 @@ def set_parser(subparsers):
                         action="store_true",
                         help="disable the executable cache for this "
                              "daemon (every cold rung recompiles)")
+    parser.add_argument("--metrics-port", dest="metrics_port",
+                        type=int, default=None, metavar="PORT",
+                        help="serve Prometheus metrics over HTTP on "
+                             "127.0.0.1:PORT (/metrics: text "
+                             "exposition; /stats: the JSON snapshot a "
+                             "daemon-socket stats request returns). "
+                             "PORT 0 picks an ephemeral port, printed "
+                             "to stderr")
+    parser.add_argument("--heartbeat-s", dest="heartbeat_s",
+                        type=float, default=None, metavar="SECONDS",
+                        help="emit a periodic heartbeat serve record "
+                             "(queue depth, per-second rates, memory "
+                             "accounting) every SECONDS to --out; "
+                             "default: no heartbeats")
+    parser.add_argument("--no-metrics", dest="no_metrics",
+                        action="store_true",
+                        help="disable the in-process metrics registry "
+                             "(counters/gauges/latency histograms); "
+                             "the JSONL telemetry in --out is "
+                             "unaffected.  Mostly for the bench's "
+                             "instrumentation-overhead control")
     parser.set_defaults(func=run_cmd)
     return parser
 
@@ -124,6 +152,13 @@ def run_cmd(args, timeout=None):
         raise CliError("--max-batch must be >= 1")
     if args.max_delay_ms < 0:
         raise CliError("--max-delay-ms must be >= 0")
+    heartbeat_s = getattr(args, "heartbeat_s", None)
+    if heartbeat_s is not None and heartbeat_s <= 0:
+        raise CliError("--heartbeat-s must be > 0")
+    metrics_port = getattr(args, "metrics_port", None)
+    if metrics_port is not None and getattr(args, "no_metrics", False):
+        raise CliError("--metrics-port needs the registry; drop "
+                       "--no-metrics")
     from ..parallel.batch import runner_cache_cap
     from ..parallel.bucketing import parse_reserve
 
@@ -140,7 +175,14 @@ def run_cmd(args, timeout=None):
     if not args.no_exec_cache:
         exec_cache = ExecutableCache(path=args.exec_cache)
 
+    registry = None
+    if not getattr(args, "no_metrics", False):
+        from ..observability.registry import MetricsRegistry
+
+        registry = MetricsRegistry()
+
     reporter = RunReporter(args.out, algo="serve", mode="serve")
+    metrics_server = None
     try:
         reserve = getattr(args, "reserve_slots", None)
         reporter.header(
@@ -157,12 +199,24 @@ def run_cmd(args, timeout=None):
             max_delay_s=args.max_delay_ms / 1000.0)
         dispatcher = Dispatcher(reporter=reporter,
                                 exec_cache=exec_cache,
-                                reserve=reserve)
+                                reserve=reserve,
+                                registry=registry)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,
                          default_precision=args.precision,
-                         reserve=reserve)
+                         reserve=reserve,
+                         registry=registry,
+                         heartbeat_s=heartbeat_s)
+        if metrics_port is not None:
+            from ..observability.registry import MetricsHTTPServer
+
+            metrics_server = MetricsHTTPServer(
+                registry, port=metrics_port,
+                snapshot_fn=loop.stats_snapshot)
+            print(f"[serve] metrics on "
+                  f"http://127.0.0.1:{metrics_server.port}/metrics",
+                  file=sys.stderr)
 
         # the SIGTERM contract: finish the in-flight rung, reject the
         # rest with a structured reason.  Registered here (not in
@@ -196,5 +250,7 @@ def run_cmd(args, timeout=None):
               f"completed={stats['completed']} "
               f"rejected={stats['rejected']}", file=sys.stderr)
     finally:
+        if metrics_server is not None:
+            metrics_server.close()
         reporter.close()
     return 0

@@ -1,0 +1,167 @@
+"""``pydcop serve-status``: ask a running daemon for its snapshot.
+
+The operator's one-liner over the daemon's ``stats`` request
+(``serving/schema.py STATS_FIELDS``): connect to the unix socket a
+``pydcop serve --socket PATH`` daemon listens on, send one stats
+line, pretty-print the snapshot — queue depth, lifetime stats and
+rates, cache effectiveness, memory accounting, and the registry's
+latency quantiles.  ``--json`` dumps the raw snapshot for scripts;
+for HTTP-side scraping the same payload lives at
+``serve --metrics-port``'s ``/stats`` endpoint.
+"""
+
+import json
+import socket
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "serve-status",
+        help="query a running serve daemon's operational snapshot "
+             "(queue depth, rates, latency quantiles, memory) over "
+             "its unix socket")
+    parser.add_argument("--socket", type=str, required=True,
+                        metavar="PATH",
+                        help="the daemon's --socket path")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="print the raw JSON snapshot instead of "
+                             "the human summary")
+    parser.add_argument("--connect-timeout", dest="connect_timeout",
+                        type=float, default=5.0, metavar="S",
+                        help="socket connect/read timeout (s)")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def fetch_status(path: str, timeout: float = 5.0) -> dict:
+    """One stats round-trip over the daemon socket; raises
+    ``CliError`` with an actionable message on every failure mode
+    (no daemon, wrong path, a daemon that never answers)."""
+    import os
+
+    request = json.dumps({"op": "stats",
+                          "id": f"status-{os.getpid()}"})
+    try:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(timeout)
+        conn.connect(path)
+    except OSError as e:
+        raise CliError(
+            f"cannot connect to serve daemon at {path}: {e}")
+    try:
+        conn.sendall((request + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise CliError(
+                    f"daemon at {path} closed the connection "
+                    f"without answering the stats request")
+            buf += chunk
+    except socket.timeout:
+        raise CliError(
+            f"daemon at {path} did not answer the stats request "
+            f"within {timeout}s")
+    finally:
+        conn.close()
+    try:
+        snap = json.loads(buf.decode())
+    except ValueError as e:
+        raise CliError(f"unparseable stats reply: {e}")
+    # the reply must BE a stats snapshot before it is rendered as
+    # one: a daemon predating the stats op (or any rejection path)
+    # answers with a REJECTED summary, and rendering that as a
+    # healthy idle daemon would hide a live, loaded service
+    if not (isinstance(snap, dict) and snap.get("record") == "serve"
+            and snap.get("event") == "stats"):
+        if isinstance(snap, dict):
+            detail = snap.get("error") or (
+                f"got record={snap.get('record')!r} "
+                f"status={snap.get('status')!r}")
+        else:
+            detail = f"got {type(snap).__name__}"
+        raise CliError(
+            f"daemon at {path} did not answer with a stats "
+            f"snapshot ({detail}); is it an older daemon without "
+            f"the stats op?")
+    return snap
+
+
+def human_bytes(n) -> str:
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n:.1f} {unit}")
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _cache_line(name: str, stats) -> str:
+    if not stats:
+        return f"  {name:<10} disabled"
+    hits = stats.get("hits", 0)
+    misses = stats.get("misses", 0)
+    total = hits + misses
+    rate = f"{100.0 * hits / total:.1f}%" if total else "n/a"
+    extras = ", ".join(f"{k}={v}" for k, v in sorted(stats.items())
+                       if k not in ("hits", "misses"))
+    return (f"  {name:<10} hit-rate {rate} "
+            f"(hits={hits}, misses={misses}"
+            f"{', ' + extras if extras else ''})")
+
+
+def render_status(snap: dict) -> str:
+    """The human rendering of one stats snapshot (pure function: the
+    test tier feeds it canned snapshots)."""
+    lines = [f"serve daemon status "
+             f"(uptime {snap.get('uptime_s', 0):.1f}s)"]
+    st = snap.get("stats", {})
+    lines.append(
+        f"  queue depth {snap.get('queue_depth', 0)} | "
+        f"received {st.get('received', 0)}, "
+        f"admitted {st.get('admitted', 0)}, "
+        f"completed {st.get('completed', 0)}, "
+        f"rejected {st.get('rejected', 0)}")
+    for name in ("runner_cache", "exec_cache", "instance_cache",
+                 "sessions"):
+        lines.append(_cache_line(name.replace("_cache", ""),
+                                 snap.get(name)))
+    memory = snap.get("memory") or {}
+    if memory:
+        lines.append("  memory:")
+        for k in sorted(memory):
+            v = memory[k]
+            if isinstance(v, dict):
+                continue
+            pretty = (human_bytes(v) if k.endswith("bytes")
+                      else ("n/a" if v is None else str(v)))
+            lines.append(f"    {k:<24} {pretty}")
+        for rung, b in sorted(
+                (memory.get("runner_cache_by_rung") or {}).items()):
+            lines.append(f"      {rung:<22} {human_bytes(b)}")
+    hists = (snap.get("metrics") or {}).get("histograms", {})
+    stage = hists.get("pydcop_serve_stage_seconds", {})
+    if stage:
+        lines.append("  stage latency (p50 / p99, s):")
+        for key in sorted(stage):
+            entry = stage[key]
+            if not entry.get("count"):
+                continue
+            lines.append(
+                f"    {key:<40} {entry.get('p50', 0):.6f} / "
+                f"{entry.get('p99', 0):.6f}  (n={entry['count']})")
+    return "\n".join(lines)
+
+
+def run_cmd(args, timeout=None):
+    snap = fetch_status(args.socket, timeout=args.connect_timeout)
+    if args.as_json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    else:
+        print(render_status(snap))
+    return 0

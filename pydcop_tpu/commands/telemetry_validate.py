@@ -1,0 +1,80 @@
+"""``pydcop telemetry-validate FILE``: schema-check a telemetry file.
+
+Streams every line of a v1 JSONL telemetry file through
+:func:`~pydcop_tpu.observability.report.validate_record` and exits
+non-zero at the FIRST invalid record, naming the line and the
+offending field.  This is the CI teeth of the schema contract: the
+test tier runs it over the files the serving/dynamics suites already
+produce, so an emitter that drifts from the documented schema fails
+the build with a line number instead of surviving until some
+downstream reader chokes.
+
+Streaming, not slurping: a serve daemon's output file can be
+gigabytes; memory use here is one line.
+"""
+
+import json
+import sys
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "telemetry-validate",
+        help="validate a v1 JSONL telemetry file against the record "
+             "schema; non-zero exit (with file:line) on the first "
+             "invalid record")
+    parser.add_argument("file", type=str, metavar="FILE.jsonl",
+                        help="telemetry file to validate (solve/"
+                             "batch --telemetry, serve --out)")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress the per-kind summary on "
+                             "success")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def validate_file(path: str):
+    """(record-kind counts, schema minor ceiling) for a valid file;
+    raises ``CliError`` carrying ``file:line: reason`` on the first
+    invalid line."""
+    from ..observability.report import validate_record
+
+    counts = {}
+    max_minor = 0
+    try:
+        f = open(path)
+    except OSError as e:
+        raise CliError(str(e))
+    with f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise CliError(
+                    f"{path}:{lineno}: not valid JSON: {e}")
+            try:
+                validate_record(rec)
+            except ValueError as e:
+                raise CliError(f"{path}:{lineno}: {e}")
+            kind = rec["record"]
+            counts[kind] = counts.get(kind, 0) + 1
+            if kind == "header":
+                max_minor = max(max_minor,
+                                rec.get("schema_minor") or 0)
+    return counts, max_minor
+
+
+def run_cmd(args, timeout=None):
+    counts, minor = validate_file(args.file)
+    if not args.quiet:
+        total = sum(counts.values())
+        kinds = ", ".join(f"{k}={counts[k]}" for k in sorted(counts))
+        print(f"{args.file}: {total} records valid "
+              f"(schema 1.{minor}; {kinds or 'empty file'})",
+              file=sys.stderr)
+    return 0

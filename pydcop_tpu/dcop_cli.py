@@ -42,10 +42,12 @@ def _make_parser():
     subparsers = parser.add_subparsers(dest="command", required=True)
     from .commands import (agent, batch, consolidate, distribute,
                            generate, graph, orchestrator, replica_dist,
-                           run, serve, solve)
+                           run, serve, serve_status, solve,
+                           telemetry_validate)
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
-                   generate, replica_dist, batch, consolidate, serve):
+                   generate, replica_dist, batch, consolidate, serve,
+                   serve_status, telemetry_validate):
         module.set_parser(subparsers)
     return parser
 

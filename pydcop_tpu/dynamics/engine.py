@@ -184,6 +184,20 @@ class DynamicEngine:
         """Whether the next solve starts from carried message state."""
         return self._state is not None
 
+    def resident_bytes(self) -> int:
+        """Approximate bytes this warm session keeps resident: the
+        carried message state (q/r planes and friends), the device
+        argument planes, and the host instance arrays.  This is the
+        per-session cost a byte-budgeted session store (ROADMAP: LRU
+        eviction) weighs against its budget — an estimate for policy,
+        not an allocator truth."""
+        from ..observability.memory import approx_object_bytes
+
+        seen = set()
+        return (approx_object_bytes(self._state, seen)
+                + approx_object_bytes(self._args_dev, seen)
+                + approx_object_bytes(self.instance.arrays, seen))
+
     # ---------------------------------------------------------- apply
 
     def apply(self, event) -> Dict[str, int]:

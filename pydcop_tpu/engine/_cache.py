@@ -208,6 +208,18 @@ class ExecutableCache:
         self.stats["stores"] += 1
         return True
 
+    def disk_bytes(self) -> int:
+        """On-disk footprint of the cache directory (serialized
+        executables only; in-flight ``.tmp`` files count too — they
+        occupy the same disk).  0 when disabled: the ops plane's
+        memory snapshot reports what THIS daemon can spend, and a
+        disabled cache spends nothing."""
+        if not self.enabled:
+            return 0
+        from ..observability.memory import dir_bytes
+
+        return dir_bytes(self.path)
+
     def _warn_once(self, msg: str):
         if not self._warned:
             self._warned = True

@@ -26,18 +26,29 @@ iterations in JAX (arXiv:2202.04110):
   :class:`EventDispatcher` topics;
 * :mod:`~pydcop_tpu.observability.collector` — the ``--run_metrics``
   CSV collector (queue draining + fsync on stop, dropped rows counted
-  and warned instead of silently discarded).
+  and warned instead of silently discarded);
+* :mod:`~pydcop_tpu.observability.registry` — the serving ops plane's
+  aggregate store: label-aware counters/gauges/log-bucketed latency
+  histograms (p50/p95/p99 without samples), a Prometheus text
+  exporter and the ``--metrics-port`` HTTP endpoint;
+* :mod:`~pydcop_tpu.observability.memory` — device/host memory
+  accounting (live-buffer census, per-store resident-byte estimates,
+  host RSS) feeding the registry gauges, heartbeat ``serve`` records
+  and the daemon's ``stats`` snapshot.
 """
 
 from .collector import CsvCollector
 from .hlo import compile_stats
 from .metrics import (METRIC_KEYS, alloc_metric_planes, conflict_count,
                       metric_records, normalize_buckets)
-from .report import SCHEMA_VERSION, RunReporter, validate_record
+from .registry import (MetricsHTTPServer, MetricsRegistry)
+from .report import (SCHEMA_MINOR, SCHEMA_VERSION, RunReporter,
+                     validate_record)
 from .spans import SpanClock, profile_trace
 
 __all__ = [
-    "CsvCollector", "METRIC_KEYS", "RunReporter", "SCHEMA_VERSION",
+    "CsvCollector", "METRIC_KEYS", "MetricsHTTPServer",
+    "MetricsRegistry", "RunReporter", "SCHEMA_MINOR", "SCHEMA_VERSION",
     "SpanClock", "alloc_metric_planes", "compile_stats",
     "conflict_count", "metric_records", "normalize_buckets",
     "profile_trace", "validate_record",

@@ -17,7 +17,11 @@ fixes the teardown contract:
   the log AND returned, so callers (and tests) see exactly what was
   lost;
 * a writer-thread crash (disk full mid-run) is also surfaced as
-  dropped rows instead of an invisible dead thread.
+  dropped rows instead of an invisible dead thread;
+* with a :class:`~pydcop_tpu.observability.registry.MetricsRegistry`
+  attached, the dropped count additionally feeds the
+  ``pydcop_collector_dropped_rows_total`` counter — a fleet scraper
+  (and the serve heartbeat) sees data loss without reading logs.
 """
 
 import csv
@@ -32,14 +36,23 @@ logger = logging.getLogger("pydcop_tpu.observability")
 #: the reference's run-metrics header (commands/solve.py:393-441)
 DEFAULT_COLUMNS = ("time", "computation", "value", "cost", "cycle")
 
+#: the registry counter fed by every collector that drops rows
+DROPPED_ROWS_METRIC = "pydcop_collector_dropped_rows_total"
+
 
 class CsvCollector:
     """Queue-fed CSV writer thread with a lossless stop contract."""
 
     def __init__(self, path: str, columns: Sequence[str] =
-                 DEFAULT_COLUMNS):
+                 DEFAULT_COLUMNS, registry=None):
         self.path = path
         self.columns = list(columns)
+        self._dropped_counter = None
+        if registry is not None:
+            self._dropped_counter = registry.counter(
+                DROPPED_ROWS_METRIC,
+                "run-metrics CSV rows discarded at collector stop "
+                "(writer could not drain in time, or died)")
         self._queue: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
         self.dropped = 0
@@ -117,6 +130,8 @@ class CsvCollector:
             # finally); anything left means it died on an error
             dropped = self._queue.qsize()
         self.dropped = dropped
+        if dropped and self._dropped_counter is not None:
+            self._dropped_counter.inc(dropped)
         if dropped:
             logger.warning(
                 "run-metrics collector discarded %d row(s) writing %s "

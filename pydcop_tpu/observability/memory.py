@@ -1,0 +1,135 @@
+"""Device/host memory accounting for the serving/dynamics stack.
+
+The ROADMAP's byte-budgeted session store (LRU eviction of warm
+``DeltaSessions``) needs one thing before any eviction policy can
+exist: a truthful answer to "how many bytes does each resident thing
+hold".  This module is that measurement substrate, shared by the
+daemon's ``stats`` request, the ``/metrics`` gauges and the heartbeat
+``serve`` records:
+
+* :func:`live_buffer_census` — every live jax array in the process
+  (count + bytes), the device-side ground truth the per-store numbers
+  must reconcile against;
+* :func:`approx_object_bytes` — array bytes reachable from an object
+  graph (``__dict__``/sequences/dicts/namedtuples walked with a seen
+  set), the estimator behind per-runner, per-session and
+  admission-cache accounting.  It counts ARRAY payloads only —
+  Python object overhead is noise next to cost cubes — and both
+  numpy and jax arrays expose ``nbytes``;
+* :func:`host_rss_bytes` — resident set size from ``/proc`` (Linux)
+  with a ``getrusage`` peak fallback;
+* :func:`dir_bytes` — on-disk footprint of a cache directory
+  (the ``ExecutableCache`` leg).
+
+Per-store hooks live with their stores (``parallel/batch.py
+runner_cache_bytes``, ``serving.dispatcher.DeltaSessions
+.resident_bytes``, ``serving.queue.instance_cache_bytes``,
+``engine._cache.ExecutableCache.disk_bytes``); the serve loop
+assembles them into one ``memory`` snapshot dict.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+#: recursion guard for the object walker: the instance-array object
+#: graphs are shallow (arrays dataclass -> bucket namedtuples ->
+#: ndarrays); anything deeper is a cycle or an unrelated structure
+_MAX_DEPTH = 8
+
+
+def array_nbytes(x: Any) -> int:
+    """Payload bytes of one array-like (numpy or jax), else 0."""
+    n = getattr(x, "nbytes", None)
+    return int(n) if isinstance(n, int) else 0
+
+
+def approx_object_bytes(obj: Any, _seen=None,
+                        _depth: int = 0) -> int:
+    """Total array bytes reachable from ``obj``.
+
+    Deliberately approximate: shared arrays are counted once (the
+    seen set is keyed by ``id``), Python object overhead is ignored,
+    and the walk stops at ``_MAX_DEPTH``.  Good enough to drive an
+    eviction policy; never used for correctness."""
+    if obj is None or _depth > _MAX_DEPTH:
+        return 0
+    if _seen is None:
+        _seen = set()
+    oid = id(obj)
+    if oid in _seen:
+        return 0
+    _seen.add(oid)
+    n = array_nbytes(obj)
+    if n:
+        return n
+    total = 0
+    if isinstance(obj, dict):
+        for v in obj.values():
+            total += approx_object_bytes(v, _seen, _depth + 1)
+        return total
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for v in obj:
+            total += approx_object_bytes(v, _seen, _depth + 1)
+        return total
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        for v in d.values():
+            total += approx_object_bytes(v, _seen, _depth + 1)
+    return total
+
+
+def live_buffer_census() -> Dict[str, Optional[int]]:
+    """Process-wide live jax arrays: ``{"buffers": n, "bytes": b}``
+    (None values when jax is unavailable or the census API is
+    missing).  This is the on-device ground truth: the sum of every
+    per-store estimate below it can only under-count (host mirrors,
+    transient temporaries), never exceed it for long."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 - census is best effort
+        return {"buffers": None, "bytes": None}
+    total = 0
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+        except Exception:  # noqa: BLE001 - deleted between list & read
+            pass
+    return {"buffers": len(arrays), "bytes": total}
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size, or the peak when only ``getrusage``
+    is available (macOS), or None."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KiB, macOS bytes; this branch is the macOS one
+        return int(peak)
+    except Exception:  # noqa: BLE001 - platform without getrusage
+        return None
+
+
+def dir_bytes(path: Optional[str]) -> int:
+    """Total size of regular files under ``path`` (0 for missing)."""
+    if not path:
+        return 0
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, name))
+                except OSError:
+                    pass
+    except OSError:
+        return 0
+    return total

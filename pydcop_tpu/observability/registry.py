@@ -1,0 +1,437 @@
+"""Label-aware metrics registry for the serving/dynamics ops plane.
+
+The JSONL reporter (:mod:`report`) is an event log: one record per
+thing that happened, perfect for *reconstruction* but useless for
+"what is your p99 right now" — answering that from the log means
+re-reading the whole file.  The registry is the complementary
+*aggregate* store, the shape every fleet scraper (Prometheus,
+Grafana agents) already speaks:
+
+* **counters** — monotonically increasing totals (admissions,
+  rejections by reason, dispatches by rung×reason);
+* **gauges** — point-in-time values (queue depth, resident bytes);
+* **histograms** — log-bucketed latency distributions whose p50/p95/
+  p99 come from bucket interpolation, so quantiles cost O(#buckets)
+  memory, never a sample list.  A daemon that has dispatched a
+  million jobs holds the same few hundred integers as one that has
+  dispatched ten.
+
+Everything is thread-safe behind one lock (the serve loop mutates
+from its thread, the /metrics HTTP thread and `stats` requests read
+concurrently) and instrumentation is strictly additive: a component
+handed ``registry=None`` skips every call, so non-serving paths stay
+byte-identical.
+
+Two read surfaces:
+
+* :meth:`MetricsRegistry.render` — the Prometheus text exposition
+  format (v0.0.4), served by :class:`MetricsHTTPServer` under
+  ``/metrics`` (``serve --metrics-port``);
+* :meth:`MetricsRegistry.snapshot` — a JSON-able dict (histograms
+  reduced to count/sum/quantiles), the payload of the daemon's
+  ``stats`` request and the ``pydcop serve-status`` CLI.
+
+Registered *samplers* run before every read, refreshing gauges whose
+truth lives elsewhere (queue depth, cache stats dicts, the memory
+census) — pull-based freshness without per-event write traffic.
+"""
+
+import json
+import math
+import threading
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: log-bucket boundaries for latency histograms: powers of two from
+#: ~1 µs (2^-20 s) to 128 s (2^7) — 28 buckets cover every span this
+#: stack measures (device dispatches are µs-ms, compiles are seconds)
+#: with <2x relative quantile error, the classic Prometheus trade
+HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
+    2.0 ** e for e in range(-20, 8))
+
+
+def _label_key(label_names: Sequence[str], labels: Dict[str, str]
+               ) -> Tuple[str, ...]:
+    if set(labels) != set(label_names):
+        raise ValueError(
+            f"metric wants labels {tuple(label_names)}, "
+            f"got {tuple(sorted(labels))}")
+    return tuple(str(labels[n]) for n in label_names)
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(label_names: Sequence[str],
+                values: Tuple[str, ...], extra: str = "") -> str:
+    parts = [f'{n}="{_escape(v)}"'
+             for n, v in zip(label_names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    """Full-precision exposition value: integers render as integers,
+    floats via ``repr`` — ``%g`` would quantize a counter past 1e6
+    events (1234567 -> '1.23457e+06'), making ``rate()`` read zero
+    between scrapes on a long-lived daemon."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2 ** 53:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    """Shared label-children plumbing; subclasses define the child
+    value shape and the exposition lines."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 help: str, labels: Sequence[str] = ()):
+        self.registry = registry
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _child(self, labels: Dict[str, str]):
+        key = _label_key(self.label_names, labels)
+        child = self._children.get(key)
+        if child is None:
+            child = self._children[key] = self._new_child()
+        return child
+
+    def _new_child(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic total.  ``inc`` for in-process events; ``set_total``
+    mirrors an externally-accumulated monotonic count (the cache-stats
+    dicts predate the registry and stay authoritative — a sampler
+    copies them here at read time instead of double-counting)."""
+
+    kind = "counter"
+
+    def _new_child(self):
+        return [0.0]
+
+    def inc(self, amount: float = 1, **labels):
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, "
+                             f"got {amount}")
+        with self.registry._lock:
+            self._child(labels)[0] += amount
+
+    def set_total(self, value: float, **labels):
+        with self.registry._lock:
+            cell = self._child(labels)
+            cell[0] = max(cell[0], float(value))
+
+    def value(self, **labels) -> float:
+        with self.registry._lock:
+            return float(self._child(labels)[0])
+
+    def _render(self) -> List[str]:
+        return [f"{self.name}"
+                f"{_fmt_labels(self.label_names, key)} "
+                f"{_fmt_value(val[0])}"
+                for key, val in sorted(self._children.items())]
+
+    def _snap(self):
+        return {",".join(k) if k else "": v[0]
+                for k, v in self._children.items()}
+
+
+class Gauge(_Metric):
+    """Point-in-time value; typically refreshed by a sampler."""
+
+    kind = "gauge"
+
+    def _new_child(self):
+        return [0.0]
+
+    def set(self, value: float, **labels):
+        with self.registry._lock:
+            self._child(labels)[0] = float(value)
+
+    def inc(self, amount: float = 1, **labels):
+        with self.registry._lock:
+            self._child(labels)[0] += amount
+
+    def value(self, **labels) -> float:
+        with self.registry._lock:
+            return float(self._child(labels)[0])
+
+    _render = Counter._render
+    _snap = Counter._snap
+
+
+class _HistogramChild:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1: the +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Log-bucketed distribution with interpolated quantiles.
+
+    ``observe`` is O(log #buckets) (bisect) and stores no samples;
+    ``quantile`` walks the cumulative counts and returns the
+    geometric midpoint of the target bucket — exact enough for ops
+    dashboards (relative error bounded by the bucket ratio, 2x here)
+    and immune to the unbounded-memory failure of sample reservoirs
+    on a daemon that never restarts."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labels=(),
+                 bounds: Sequence[float] = HISTOGRAM_BOUNDS):
+        super().__init__(registry, name, help, labels)
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must be strictly "
+                             "increasing")
+
+    def _new_child(self):
+        return _HistogramChild(len(self.bounds))
+
+    def observe(self, value: float, **labels):
+        value = float(value)
+        if math.isnan(value):
+            return
+        with self.registry._lock:
+            child = self._child(labels)
+            child.counts[bisect_left(self.bounds, value)] += 1
+            child.sum += value
+            child.count += 1
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Interpolated q-quantile (0 < q <= 1), or None when the
+        child has no observations yet."""
+        with self.registry._lock:
+            key = _label_key(self.label_names, labels)
+            child = self._children.get(key)
+            if child is None or child.count == 0:
+                return None
+            return self._quantile_locked(child, q)
+
+    def _quantile_locked(self, child: _HistogramChild,
+                         q: float) -> float:
+        target = q * child.count
+        cum = 0
+        for i, n in enumerate(child.counts):
+            cum += n
+            if cum >= target and n:
+                if i >= len(self.bounds):      # overflow bucket
+                    return self.bounds[-1]
+                hi = self.bounds[i]
+                lo = self.bounds[i - 1] if i else hi / 2.0
+                return math.sqrt(lo * hi)      # geometric midpoint
+        return self.bounds[-1]
+
+    def _render(self) -> List[str]:
+        lines = []
+        for key, child in sorted(self._children.items()):
+            cum = 0
+            for bound, n in zip(self.bounds, child.counts):
+                cum += n
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, key, extra=self._le(bound))}"
+                    f" {cum}")
+            lines.append(
+                f"{self.name}_bucket"
+                f'{_fmt_labels(self.label_names, key, extra=self._le(None))}'
+                f" {child.count}")
+            base = _fmt_labels(self.label_names, key)
+            lines.append(f"{self.name}_sum{base} "
+                         f"{_fmt_value(child.sum)}")
+            lines.append(f"{self.name}_count{base} {child.count}")
+        return lines
+
+    @staticmethod
+    def _le(bound: Optional[float]) -> str:
+        return f'le="{bound:g}"' if bound is not None else 'le="+Inf"'
+
+    def _snap(self):
+        out = {}
+        for key, child in self._children.items():
+            entry = {"count": child.count,
+                     "sum": round(child.sum, 6)}
+            if child.count:
+                for q, tag in ((0.5, "p50"), (0.95, "p95"),
+                               (0.99, "p99")):
+                    entry[tag] = round(
+                        self._quantile_locked(child, q), 6)
+            out[",".join(key) if key else ""] = entry
+        return out
+
+
+class MetricsRegistry:
+    """One per daemon; components receive it (or None) at build time."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._samplers: List[Callable[[], None]] = []
+
+    # --------------------------------------------------- registration
+
+    def _register(self, cls, name, help, labels, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or \
+                        existing.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels "
+                        f"{existing.label_names}")
+                return existing
+            metric = cls(self, name, help, labels, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str,
+                  labels: Sequence[str] = (),
+                  bounds: Sequence[float] = HISTOGRAM_BOUNDS
+                  ) -> Histogram:
+        return self._register(Histogram, name, help, labels,
+                              bounds=bounds)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def add_sampler(self, fn: Callable[[], None]):
+        """Run ``fn`` before every render/snapshot to refresh pull
+        metrics (queue depth, cache stats, memory census).  A sampler
+        that raises is skipped for that read — a scrape must never
+        take the serving loop down, and the loop may be mutating the
+        structures a sampler walks."""
+        with self._lock:
+            self._samplers.append(fn)
+
+    def collect(self):
+        for fn in list(self._samplers):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - scrape never breaks serving
+                pass
+
+    # ---------------------------------------------------------- reads
+
+    def render(self) -> str:
+        """Prometheus text exposition format v0.0.4."""
+        self.collect()
+        with self._lock:
+            lines: List[str] = []
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} {m.kind}")
+                lines.extend(m._render())
+            return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """JSON-able aggregate view (the ``stats`` request payload):
+        counters/gauges as value maps, histograms as
+        count/sum/p50/p95/p99 — keyed by comma-joined label values."""
+        self.collect()
+        with self._lock:
+            out: Dict[str, Dict] = {}
+            for name, m in self._metrics.items():
+                out.setdefault(m.kind + "s", {})[name] = m._snap()
+            return out
+
+
+class MetricsHTTPServer:
+    """The ``serve --metrics-port`` endpoint: ``/metrics`` in
+    Prometheus text format, ``/stats`` as the JSON snapshot (the same
+    payload a daemon-socket ``stats`` request returns, for operators
+    with curl but no socket client).  Binds loopback by default —
+    the ops plane is not the data plane, exposing it beyond the host
+    is a deliberate operator choice (``host=``).  ``port=0`` picks an
+    ephemeral port (tests); the bound port is ``self.port``."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1",
+                 snapshot_fn: Optional[Callable[[], Dict]] = None):
+        import http.server
+
+        self.registry = registry
+        self.snapshot_fn = snapshot_fn
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/metrics", "/"):
+                        body = outer.registry.render().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    elif path == "/stats":
+                        body = json.dumps(outer._snapshot()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception:  # noqa: BLE001 - scrape never dies
+                    # a snapshot raced the serving loop harder than
+                    # the retries could absorb: a scrape answers 503,
+                    # it never tracebacks in the operator's face
+                    self.send_error(503, "snapshot raced the "
+                                         "serving loop; retry")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            (host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def _snapshot(self) -> Dict:
+        """The /stats payload, retried a few times: snapshot_fn runs
+        on THIS handler thread while the serve loop mutates the
+        structures it walks (caches, live-array census), and a
+        mid-iteration mutation raises RuntimeError — almost always
+        clean on the next attempt."""
+        fn = self.snapshot_fn or self.registry.snapshot
+        for attempt in range(3):
+            try:
+                return fn()
+            except RuntimeError:
+                if attempt == 2:
+                    raise
+        raise RuntimeError("unreachable")  # pragma: no cover
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -17,12 +17,23 @@ line:
   duration, message stats, spans.
 * ``serve`` — serve-daemon lifecycle and dispatch telemetry
   (``serving/``): one record per queue event worth observing, tagged
-  with ``event`` (``dispatch``, ``drained``, ``stopped``) and carrying
-  queue depth, per-job wait-time stats, jax.stages spans
-  (``compile_s``/``deserialize_s``/``execute_s``) and the runner /
-  executable cache counters.  Per-job serve RESULTS stay ``summary``
-  records — the serve kind is the daemon's own telemetry, not a second
-  result schema.
+  with ``event`` (``dispatch``, ``heartbeat``, ``stats``, ``drained``,
+  ``stopped``) and carrying queue depth, per-job wait-time stats,
+  jax.stages spans (``compile_s``/``deserialize_s``/``execute_s``),
+  the runner / executable cache counters, and (heartbeat / final /
+  stats events) the ``memory`` accounting snapshot
+  (``observability/memory.py``).  Per-job serve RESULTS stay
+  ``summary`` records — the serve kind is the daemon's own telemetry,
+  not a second result schema.
+* ``trace`` — per-job pipeline traces (schema minor 2): every job the
+  serve daemon admits gets a ``trace_id``, and its life across the
+  queue -> rung -> device pipeline is emitted as trace records
+  (``event``: ``admit``, ``done``, ``reject``) whose ``spans`` reuse
+  the :class:`~pydcop_tpu.observability.spans.SpanClock` vocabulary
+  (``queue_wait_s``, ``batch_form_s``, ``deserialize_s``,
+  ``compile_s``, ``execute_s``).  The job's ``summary`` record carries
+  the same ``trace_id``, so one grep over the JSONL reconstructs a
+  job end to end.
 
 Records append atomically (one ``os.write`` to an ``O_APPEND`` fd, the
 same discipline as ``batch --consolidated-out``), so a campaign's fused
@@ -37,6 +48,7 @@ event vocabulary they already speak.  The bus is disabled by default,
 exactly as before — the bridge costs nothing until someone subscribes.
 """
 
+import atexit
 import json
 import os
 import threading
@@ -48,10 +60,18 @@ SCHEMA_VERSION = 1
 #: so every v1 reader stays green.  Minor 1 added the dynamic-DCOP
 #: fields: ``edit`` (per-action write counts of a warm delta apply)
 #: and ``warm_start`` (bool) on summary records, plus the
-#: ``schema_minor`` header stamp itself.
-SCHEMA_MINOR = 1
+#: ``schema_minor`` header stamp itself.  Minor 2 (the ops plane)
+#: added the ``trace`` record kind, the optional ``trace_id``
+#: attribution on summary/serve records, and the heartbeat/stats
+#: ``serve`` fields (``rates``, ``memory``).  A v1.0/1.1 reader stays
+#: green by the one documented forward-compat rule: consumers filter
+#: the stream by the record kinds they speak and ignore the rest.
+SCHEMA_MINOR = 2
 
-RECORD_KINDS = ("header", "cycle", "summary", "serve")
+RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
+
+#: the trace-record event vocabulary (one job's pipeline life)
+TRACE_EVENTS = ("admit", "done", "reject")
 
 #: the per-action count keys an ``edit`` summary field may carry
 #: (``dynamics/deltas.py`` TopologyDelta.summary) — anything else is
@@ -71,6 +91,15 @@ class RunReporter:
     reporter, one ``os.write`` per record: atomicity comes from the
     single append write, not from reopening — a 10k-cycle drain costs
     10k writes, not 30k open/write/close syscalls.
+
+    Lifecycle contract: :meth:`close` is idempotent, the reporter is
+    a context manager (``with RunReporter(...) as rep:``), and every
+    reporter registers an ``atexit`` fallback close — an abandoned
+    reporter (caller crashed past its close) still releases its fd at
+    interpreter exit instead of leaning on the non-guaranteed
+    ``__del__``.  Records themselves are durable the moment ``_emit``
+    returns (unbuffered ``os.write``), so the fallback loses nothing
+    that was ever reported.
     """
 
     def __init__(self, path: str, algo: str, mode: str,
@@ -89,6 +118,7 @@ class RunReporter:
         self._fd = os.open(path,
                            os.O_WRONLY | os.O_APPEND | os.O_CREAT,
                            0o644)
+        atexit.register(self.close)
 
     # ------------------------------------------------------------ write
 
@@ -102,10 +132,28 @@ class RunReporter:
         self._bus.send(topic, record)
 
     def close(self):
+        """Release the fd; safe to call any number of times, from
+        ``with``, the owner's finally, ``__del__`` and the atexit
+        fallback alike."""
         with self._lock:
-            if self._fd is not None:
-                os.close(self._fd)
-                self._fd = None
+            if self._fd is None:
+                return
+            os.close(self._fd)
+            self._fd = None
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._fd is None
+
+    def __enter__(self) -> "RunReporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __del__(self):
         try:
@@ -144,6 +192,18 @@ class RunReporter:
         rec = {"record": "serve", "algo": self.algo,
                "mode": self.mode, "event": str(event), **fields}
         self._emit(rec, "engine.serve")
+        return rec
+
+    def trace(self, trace_id: str, job_id: str, event: str,
+              **fields) -> Dict[str, Any]:
+        """Per-job pipeline trace record (schema minor 2), published
+        on ``engine.trace``: one line per stage of one job's life
+        (``admit``/``done``/``reject``), correlated by ``trace_id``
+        across trace AND summary records."""
+        rec = {"record": "trace", "algo": self.algo,
+               "trace_id": str(trace_id), "job_id": job_id,
+               "event": str(event), **fields}
+        self._emit(rec, "engine.trace")
         return rec
 
 
@@ -245,3 +305,89 @@ def validate_record(rec: Dict[str, Any]):
                                   or batch < 1):
             raise ValueError(
                 f"serve record with bad batch {batch!r}")
+        _check_rates(rec.get("rates"))
+        _check_memory(rec.get("memory"))
+    elif kind == "trace":
+        tid = rec.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            raise ValueError(
+                f"trace record with bad trace_id {tid!r}")
+        if "job_id" not in rec:
+            raise ValueError("trace record missing 'job_id'")
+        event = rec.get("event")
+        if event not in TRACE_EVENTS:
+            raise ValueError(
+                f"trace record with unknown event {event!r}; "
+                f"known: {', '.join(TRACE_EVENTS)}")
+        _check_spans(rec.get("spans"))
+        qw = rec.get("queue_wait_s")
+        if qw is not None and (isinstance(qw, bool)
+                               or not isinstance(qw, (int, float))
+                               or qw < 0):
+            raise ValueError(
+                f"trace record with bad queue_wait_s {qw!r}")
+    if kind in ("summary", "serve", "trace"):
+        tid = rec.get("trace_id")
+        if tid is not None and (not isinstance(tid, str) or not tid):
+            raise ValueError(
+                f"{kind} record with bad trace_id {tid!r}")
+
+
+def _check_spans(spans):
+    """Optional ``spans`` field: SpanClock vocabulary — name ->
+    non-negative seconds."""
+    if spans is None:
+        return
+    if not isinstance(spans, dict):
+        raise ValueError(
+            f"'spans' must be a dict of name -> seconds, got "
+            f"{type(spans).__name__}")
+    for k, v in spans.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or v < 0:
+            raise ValueError(
+                f"spans[{k!r}] must be non-negative seconds, "
+                f"got {v!r}")
+
+
+def _check_rates(rates):
+    """Optional heartbeat ``rates`` field: name -> per-second rate."""
+    if rates is None:
+        return
+    if not isinstance(rates, dict):
+        raise ValueError(
+            f"'rates' must be a dict of name -> per-second rate, "
+            f"got {type(rates).__name__}")
+    for k, v in rates.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or v < 0:
+            raise ValueError(
+                f"rates[{k!r}] must be a non-negative number, "
+                f"got {v!r}")
+
+
+def _check_memory(memory):
+    """Optional ``memory`` accounting snapshot: field -> byte count
+    (or None when a census leg is unavailable); one nesting level of
+    per-label dicts (``runner_cache_by_rung``) is allowed."""
+    if memory is None:
+        return
+    if not isinstance(memory, dict):
+        raise ValueError(
+            f"'memory' must be a dict of accounting fields, got "
+            f"{type(memory).__name__}")
+    for k, v in memory.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                _check_memory_value(f"{k}.{k2}", v2)
+        else:
+            _check_memory_value(k, v)
+
+
+def _check_memory_value(name, v):
+    if v is not None and (isinstance(v, bool)
+                          or not isinstance(v, (int, float))
+                          or v < 0):
+        raise ValueError(
+            f"memory[{name!r}] must be a non-negative number or "
+            f"null, got {v!r}")

@@ -20,23 +20,37 @@ kernel families are wrapped in ``jax.named_scope`` so those traces show
 
 import time
 from contextlib import contextmanager, nullcontext
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 
 class SpanClock:
     """Accumulates named wall-time spans (seconds).  One instance per
-    engine run; ``as_dict`` rounds for reporting."""
+    engine run; ``as_dict`` rounds for reporting.
 
-    def __init__(self):
+    ``time_source`` injects the clock (default
+    ``time.perf_counter``), the same pattern the serving stack uses
+    for its dispatch clocks — span assertions in tests advance a fake
+    clock instead of sleeping, and a dispatcher can hand its own
+    injected clock down so every span in one dispatch shares a
+    timebase."""
+
+    def __init__(self,
+                 time_source: Optional[Callable[[], float]] = None):
         self.spans: Dict[str, float] = {}
+        self._time = time_source or time.perf_counter
+
+    def now(self) -> float:
+        """The clock this SpanClock measures with (callers timing
+        non-contiguous stretches share the same timebase)."""
+        return self._time()
 
     @contextmanager
     def span(self, name: str):
-        t0 = time.perf_counter()
+        t0 = self._time()
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            self.add(name, self._time() - t0)
 
     def add(self, name: str, seconds: float):
         self.spans[name] = self.spans.get(name, 0.0) + float(seconds)

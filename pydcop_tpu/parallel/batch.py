@@ -90,6 +90,10 @@ class _BatchedRunnerBase:
         self.exec_cache = None
         self.exec_cache_key: Optional[Tuple] = None
         self.last_spans: Dict[str, float] = {}
+        #: trace ids of the jobs the last run() executed for, in batch
+        #: order (serve dispatches thread them through so a shared
+        #: runner's spans stay attributable to the jobs that rode it)
+        self.last_trace_ids: List[str] = []
 
     def _drive(self, base, state):
         """The shared convergence loop: step until the solver reports
@@ -170,20 +174,29 @@ class _BatchedRunnerBase:
                             for a in instances]
 
     def run(self, seed: int = 0, max_cycles: int = 200, seeds=None,
-            collect_metrics: bool = False):
+            collect_metrics: bool = False, trace_ids=None):
         """Returns (selections (B, V), cycles (B,), finished (B,)).
         ``seeds`` gives each instance its own engine seed (fused batch
         campaigns: row i carries job i's declared seed); default is the
         split-key stream of ``seed``.  ``collect_metrics`` fills
         ``self.last_cycle_metrics`` with one per-cycle record list per
         instance (telemetry planes ride the vmapped carry; the
-        telemetry-off program is untouched and cached separately)."""
+        telemetry-off program is untouched and cached separately).
+        ``trace_ids`` (serve dispatches) lands in
+        ``self.last_trace_ids`` so the per-dispatch spans stay joined
+        to the jobs that produced them."""
         from ..observability.metrics import metric_records
 
         from ..observability.spans import SpanClock
 
         self.max_cycles = max_cycles
         self._collect_metrics = bool(collect_metrics)
+        if trace_ids is not None and len(trace_ids) > self.B:
+            # fewer is fine (pow2-padded batches carry inert rows with
+            # no job behind them); more means the caller mis-batched
+            raise ValueError(
+                f"got {len(trace_ids)} trace ids for batch {self.B}")
+        self.last_trace_ids = [str(t) for t in (trace_ids or [])]
         keys = _batch_keys(seed, seeds, self.B)
         cache_key = (max_cycles, self._collect_metrics)
         spans = SpanClock()
@@ -648,6 +661,24 @@ def runner_cache_stats() -> Dict[str, int]:
     telemetry summaries."""
     return dict(_RUNNER_CACHE_STATS, size=len(_RUNNER_CACHE),
                 cap=runner_cache_cap())
+
+
+def runner_cache_bytes() -> Dict[str, int]:
+    """Approximate resident array bytes per cached runner, keyed by a
+    compact ``algo/rung/batch`` label — the live-buffer census leg the
+    serve memory snapshot attributes to rungs (each runner pins its
+    padded instance arguments on device for as long as it is
+    cached)."""
+    from ..observability.memory import approx_object_bytes
+    from .bucketing import rung_label
+
+    out: Dict[str, int] = {}
+    for key, runner in list(_RUNNER_CACHE.items()):
+        algo, sig, b = key[0], key[1], key[2]
+        label = f"{algo}/{rung_label(sig)}/b{b}"
+        out[label] = out.get(label, 0) + approx_object_bytes(
+            getattr(runner, "_instance_args", None))
+    return out
 
 
 def runner_for_rung(algo: str, instances, params: dict,

@@ -172,6 +172,30 @@ class Rung:
         return arrays.pad_to(self.n_vars, dict(self.bucket_slots))
 
 
+def rung_label(signature: Tuple) -> str:
+    """A rung signature compacted into one metric-label-safe token,
+    e.g. ``factor:d3:v17:a2x32`` — the ``rung`` label of the serve
+    registry's dispatch counters, stage histograms and memory gauges
+    (the raw tuple would make every Prometheus label an eyesore and
+    every grouping query a substring hunt).  ``runner_for_rung``
+    accepts ANY hashable as a rung signature (library callers key
+    however they like), so a tuple that is not :attr:`Rung.signature`
+    shaped falls back to a generic flattening instead of failing a
+    telemetry read."""
+    try:
+        kind, max_domain, n_vars, slots, n_pairs = signature
+        parts = [str(kind), f"d{max_domain}", f"v{n_vars}"]
+        parts.extend(f"a{a}x{c}" for a, c in slots)
+        if n_pairs:
+            parts.append(f"p{n_pairs}")
+        return ":".join(parts)
+    except (TypeError, ValueError):
+        flat = "_".join(
+            str(x) for x in (signature if isinstance(
+                signature, (tuple, list)) else (signature,)))
+        return flat.replace(" ", "")[:64] or "unkeyed"
+
+
 def _base_rung(profile: ShapeProfile, reserve=None) -> Rung:
     """The profile's home rung: next power of two per dimension, plus
     one sink variable row anchoring phantom factors.  ``reserve``

@@ -22,10 +22,11 @@ empty — which is exactly the ``serve --oneshot`` smoke path the test
 tier drives without sockets.
 """
 
+import itertools
 import queue as _stdqueue
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from .dispatcher import Dispatcher
 from .queue import AdmissionQueue, prepare_job
@@ -51,7 +52,9 @@ class ServeLoop:
                  default_seed: int = 0,
                  default_precision: Optional[str] = None,
                  reserve=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None,
+                 heartbeat_s: Optional[float] = None):
         self.admission = admission
         self.dispatcher = dispatcher
         self.reporter = reporter
@@ -62,6 +65,27 @@ class ServeLoop:
         #: rung is provisioned with (parallel/bucketing.parse_reserve)
         self.reserve = reserve
         self.clock = clock
+        #: the ops-plane aggregate store (None = uninstrumented: the
+        #: bench's overhead control and every pre-existing caller)
+        self.registry = registry
+        #: heartbeat period (s): emit a periodic ``serve`` record with
+        #: queue depth, rates and the memory snapshot.  None/0 = off.
+        #: Measured with the injected clock, so tests drive it without
+        #: sleeping.
+        self.heartbeat_s = (float(heartbeat_s)
+                            if heartbeat_s else None)
+        self._hb_next: Optional[float] = None
+        self._hb_last_t: Optional[float] = None
+        self._hb_last_stats: Dict[str, int] = {}
+        #: a memory census pinned for the duration of ONE stats read,
+        #: so the registry sampler that read triggers reuses it
+        #: instead of walking everything twice; never reused across
+        #: reads — staleness would make a stats reply contradict the
+        #: state change that just happened.  Thread-LOCAL: the HTTP
+        #: /stats handler snapshots concurrently with the serve
+        #: loop's own heartbeats/stats, and one thread's pin must
+        #: never leak into (or be cleared under) another's read
+        self._tls = threading.local()
         self._inbox: "_stdqueue.Queue" = _stdqueue.Queue()
         self._stop = threading.Event()
         self._input_closed = threading.Event()
@@ -74,7 +98,246 @@ class ServeLoop:
         self._admitted_requests_cap = 1024
         self.stats: Dict[str, int] = {
             "received": 0, "admitted": 0, "rejected": 0,
-            "completed": 0}
+            "completed": 0, "stats_served": 0}
+        #: per-job trace ids, unique within this daemon's lifetime
+        #: (and therefore within its output file)
+        self._trace_seq = itertools.count()
+        self._t_start = self.clock()
+        self._metrics = None
+        if registry is not None:
+            self._metrics = self._register_metrics(registry)
+
+    # ------------------------------------------------------- ops plane
+
+    def _register_metrics(self, registry):
+        """The daemon's standard metric set: event counters written
+        at their sites, plus a sampler refreshing the pull metrics
+        (queue depth, cache counters, session/memory gauges) at every
+        scrape/snapshot — freshness without per-event writes."""
+        m = {
+            "received": registry.counter(
+                "pydcop_serve_received_total",
+                "request lines received"),
+            "admitted": registry.counter(
+                "pydcop_serve_admitted_total", "jobs admitted"),
+            "completed": registry.counter(
+                "pydcop_serve_completed_total", "jobs completed"),
+            "rejected": registry.counter(
+                "pydcop_serve_rejected_total",
+                "jobs rejected, by pipeline stage",
+                labels=("reason",)),
+            "stats_served": registry.counter(
+                "pydcop_serve_stats_requests_total",
+                "stats snapshot requests answered"),
+            "heartbeats": registry.counter(
+                "pydcop_serve_heartbeats_total",
+                "heartbeat serve records emitted"),
+            "queue_depth": registry.gauge(
+                "pydcop_serve_queue_depth",
+                "jobs queued awaiting dispatch"),
+            "sessions_open": registry.gauge(
+                "pydcop_serve_sessions_open",
+                "warm delta sessions currently resident"),
+            "cache_events": registry.counter(
+                "pydcop_cache_events_total",
+                "monotonic cache counters mirrored from the serving "
+                "stores (hits/misses/evictions/stores/...)",
+                labels=("cache", "event")),
+            "cache_state": registry.gauge(
+                "pydcop_cache_state",
+                "non-monotonic cache state (current size, "
+                "configured cap)", labels=("cache", "field")),
+            "memory": registry.gauge(
+                "pydcop_memory_bytes",
+                "resident/disk bytes by accounting leg",
+                labels=("kind",)),
+        }
+
+        def sample():
+            m["queue_depth"].set(self.admission.depth())
+            caches = {
+                "admission": dict(self.admission.stats),
+                "dispatcher": dict(self.dispatcher.stats),
+            }
+            from ..parallel.batch import runner_cache_stats
+            from .queue import instance_cache_stats
+
+            caches["runner"] = runner_cache_stats()
+            caches["instance"] = instance_cache_stats()
+            exec_cache = getattr(self.dispatcher, "exec_cache", None)
+            if exec_cache is not None:
+                caches["exec"] = dict(exec_cache.stats)
+            sessions = getattr(self.dispatcher, "delta_sessions",
+                               None)
+            if sessions is not None:
+                caches["sessions"] = dict(sessions.stats)
+                m["sessions_open"].set(len(sessions))
+            for cache, stats in caches.items():
+                for event, value in stats.items():
+                    if event in ("size", "cap"):
+                        # current occupancy / configured bound: NOT
+                        # monotonic — a counter's max() mirror would
+                        # pin the historical peak forever
+                        m["cache_state"].set(value, cache=cache,
+                                             field=event)
+                    else:
+                        m["cache_events"].set_total(
+                            value, cache=cache, event=event)
+            for kind, value in self.memory_snapshot().items():
+                if isinstance(value, (int, float)) \
+                        and not isinstance(value, bool):
+                    m["memory"].set(value, kind=kind)
+
+        registry.add_sampler(sample)
+        return m
+
+    def _count(self, name: str, amount: int = 1, **labels):
+        self.stats[name] = self.stats.get(name, 0) + amount
+        if self._metrics is not None and name in self._metrics:
+            self._metrics[name].inc(amount, **labels)
+
+    def memory_snapshot(self) -> Dict[str, Any]:
+        """The daemon's memory accounting (``observability/memory``):
+        host RSS, the device live-buffer census, and per-store
+        resident-byte estimates — the measurement substrate the
+        ROADMAP's byte-budgeted session store consumes.  Emitted in
+        heartbeat/final/stats ``serve`` records and mirrored as
+        ``pydcop_memory_bytes`` gauges.  Always fresh; within one
+        :meth:`stats_snapshot` read the census is pinned so the
+        registry sampler reuses it instead of walking twice."""
+        pinned = getattr(self._tls, "mem_pin", None)
+        if pinned is not None:
+            return pinned
+        from ..observability import memory as _mem
+        from ..parallel.batch import runner_cache_bytes
+        from .queue import instance_cache_bytes
+
+        census = _mem.live_buffer_census()
+        by_rung = runner_cache_bytes()
+        snap: Dict[str, Any] = {
+            "host_rss_bytes": _mem.host_rss_bytes(),
+            "device_live_buffers": census["buffers"],
+            "device_live_bytes": census["bytes"],
+            "runner_cache_bytes": sum(by_rung.values()),
+            "instance_cache_bytes": instance_cache_bytes(),
+        }
+        if by_rung:
+            snap["runner_cache_by_rung"] = by_rung
+        exec_cache = getattr(self.dispatcher, "exec_cache", None)
+        if exec_cache is not None:
+            snap["exec_cache_disk_bytes"] = exec_cache.disk_bytes()
+        sessions = getattr(self.dispatcher, "delta_sessions", None)
+        if sessions is not None:
+            per_session = sessions.resident_bytes()
+            snap["sessions_bytes"] = sum(per_session.values())
+            snap["sessions_open"] = len(per_session)
+        return snap
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """Point-in-time operational snapshot: the payload of a
+        ``stats`` request (and the HTTP ``/stats`` endpoint), shaped
+        as a ``serve`` record so every existing v1 reader can ingest
+        it."""
+        from ..parallel.batch import runner_cache_stats
+        from .queue import instance_cache_stats
+
+        exec_cache = getattr(self.dispatcher, "exec_cache", None)
+        sessions = getattr(self.dispatcher, "delta_sessions", None)
+        # one fresh census per stats read: pinned while the registry
+        # snapshot's sampler runs, so the expensive walk (live
+        # arrays + every cached runner/session graph) happens once,
+        # and both surfaces report the SAME numbers
+        memory = self.memory_snapshot()
+        self._tls.mem_pin = memory
+        try:
+            metrics = (self.registry.snapshot()
+                       if self.registry is not None else None)
+        finally:
+            self._tls.mem_pin = None
+        snap = {
+            "record": "serve", "algo": "serve", "mode": "serve",
+            "event": "stats",
+            "queue_depth": self.admission.depth(),
+            "uptime_s": round(self.clock() - self._t_start, 6),
+            "stats": dict(self.stats),
+            "admission": dict(self.admission.stats),
+            "dispatcher": dict(self.dispatcher.stats),
+            "instance_cache": instance_cache_stats(),
+            "runner_cache": runner_cache_stats(),
+            "exec_cache": (dict(exec_cache.stats)
+                           if exec_cache is not None else None),
+            "sessions": (dict(sessions.stats)
+                         if sessions is not None else None),
+            "memory": memory,
+        }
+        if metrics is not None:
+            snap["metrics"] = metrics
+        return snap
+
+    def _handle_stats(self, request: Dict, reply=None):
+        """Answer a ``stats`` op immediately at admission — a
+        control-plane read never queues behind solve work.  The
+        snapshot goes to the requester's reply channel when it has
+        one (socket clients, serve-status); otherwise it lands in the
+        output file as a ``serve`` record so stdin/oneshot drives can
+        observe it too."""
+        self._count("stats_served")
+        snap = self.stats_snapshot()
+        snap["id"] = request["id"]
+        if reply is not None:
+            reply(snap)
+        elif self.reporter is not None:
+            fields = {k: v for k, v in snap.items()
+                      if k not in ("record", "algo", "mode", "event")}
+            self.reporter.serve(event="stats", **fields)
+
+    def _maybe_heartbeat(self):
+        """Emit the periodic heartbeat ``serve`` record when the
+        (injected) clock has crossed the next beat: queue depth,
+        lifetime stats, per-second rates since the previous beat, and
+        the memory snapshot.  Also refreshes the registry heartbeat
+        counter — a stalled loop is visible as a flatlined counter."""
+        if self.heartbeat_s is None:
+            return
+        now = self.clock()
+        if self._hb_next is None:
+            # first call arms the timer; no record for the zeroth beat
+            self._hb_next = now + self.heartbeat_s
+            self._hb_last_t = now
+            self._hb_last_stats = dict(self.stats)
+            return
+        if now < self._hb_next:
+            return
+        last_t = self._hb_last_t if self._hb_last_t is not None \
+            else now
+        dt = max(now - last_t, 1e-9)
+        rates = {
+            f"{k}_per_s": round(
+                max(0, v - self._hb_last_stats.get(k, 0)) / dt, 3)
+            for k, v in self.stats.items()}
+        self._count("heartbeats")
+        dropped = None
+        if self.registry is not None:
+            counter = self.registry.get(
+                "pydcop_collector_dropped_rows_total")
+            if counter is not None:
+                dropped = int(counter.value())
+        if self.reporter is not None:
+            self.reporter.serve(
+                event="heartbeat",
+                queue_depth=self.admission.depth(),
+                uptime_s=round(now - self._t_start, 6),
+                stats=dict(self.stats), rates=rates,
+                memory=self.memory_snapshot(),
+                **({"dropped_rows": dropped}
+                   if dropped is not None else {}))
+        self._hb_last_t = now
+        self._hb_last_stats = dict(self.stats)
+        # rearming from NOW (not from the missed slot) skips missed
+        # beats instead of bursting to catch up: after a long
+        # dispatch the operator wants ONE fresh heartbeat, not a
+        # backlog of stale ones
+        self._hb_next = now + self.heartbeat_s
 
     # ----------------------------------------------------------- input
 
@@ -94,13 +357,20 @@ class ServeLoop:
 
     # ------------------------------------------------------- admission
 
-    def _emit_rejection(self, job_id, reason, reply=None, algo=None):
+    def _emit_rejection(self, job_id, reason, reply=None, algo=None,
+                        reason_class: str = "prepare",
+                        trace_id: str = ""):
         rec = rejection(job_id, reason)
         if algo is not None:
             rec["algo"] = algo
-        self.stats["rejected"] += 1
+        if trace_id:
+            rec["trace_id"] = trace_id
+        self._count("rejected", reason=reason_class)
         if self.reporter is not None:
             self.reporter.summary(**rec)
+            if trace_id:
+                self.reporter.trace(trace_id, job_id or "?",
+                                    "reject", reason=reason_class)
         if reply is not None:
             reply(dict(rec, record="summary", mode="serve"))
 
@@ -108,23 +378,30 @@ class ServeLoop:
         line = line.strip()
         if not line:
             return
-        self.stats["received"] += 1
+        self._count("received")
         try:
             request = parse_request(line)
         except RequestError as e:
-            self._emit_rejection(e.job_id, str(e), reply)
+            self._emit_rejection(e.job_id, str(e), reply,
+                                 reason_class="parse")
             return
+        if request.get("op") == "stats":
+            # control-plane read: answered immediately, never queued
+            self._handle_stats(request, reply)
+            return
+        trace_id = f"t{next(self._trace_seq):08d}"
         if request.get("op") == "delta":
             # deltas bypass the batching queue: a warm session is
             # singular state, dispatch happens at admission
-            self._dispatch_delta(request, reply)
+            self._dispatch_delta(request, reply, trace_id=trace_id)
             return
         try:
             job = prepare_job(
                 request, default_max_cycles=self.default_max_cycles,
                 default_seed=self.default_seed,
                 default_precision=self.default_precision,
-                reserve=self.reserve, reply=reply)
+                reserve=self.reserve, reply=reply,
+                trace_id=trace_id)
         except Exception as e:
             # the FULL breadth of "bad job" lands here, not just the
             # anticipated ValueErrors: a file that exists but holds
@@ -133,7 +410,9 @@ class ServeLoop:
             # kill the daemon
             self._emit_rejection(request["id"],
                                  f"{type(e).__name__}: {e}", reply,
-                                 algo=request.get("algo"))
+                                 algo=request.get("algo"),
+                                 reason_class="prepare",
+                                 trace_id=trace_id)
             return
         self.admission.admit(job)
         if request.get("algo") == "maxsum":
@@ -142,9 +421,17 @@ class ServeLoop:
                 self._admitted_requests.pop(
                     next(iter(self._admitted_requests)))
             self._admitted_requests[request["id"]] = request
-        self.stats["admitted"] += 1
+        self._count("admitted")
+        if self.reporter is not None:
+            # the trace's opening record: one line pins the job's
+            # trace_id to its id, algo and the depth it queued behind
+            self.reporter.trace(
+                trace_id, job.job_id, "admit",
+                algo=request["algo"],
+                queue_depth=self.admission.depth())
 
-    def _dispatch_delta(self, request, reply=None):
+    def _dispatch_delta(self, request, reply=None,
+                        trace_id: str = ""):
         """One delta job end-to-end: resolve the target session,
         apply + warm re-solve.  Every failure — unknown target, an
         event exceeding the reserved slots (``DeltaError``), a bad
@@ -163,25 +450,34 @@ class ServeLoop:
                 request["id"],
                 f"delta target {target!r} is not an admitted "
                 f"maxsum solve job of this daemon", reply,
-                algo="maxsum")
+                algo="maxsum", reason_class="delta",
+                trace_id=trace_id)
             return
+        if self.reporter is not None and trace_id:
+            self.reporter.trace(
+                trace_id, request["id"], "admit", algo="maxsum",
+                target=target,
+                queue_depth=self.admission.depth())
         try:
             self.dispatcher.dispatch_delta(
                 request, target_request,
                 default_max_cycles=self.default_max_cycles,
                 default_seed=self.default_seed,
                 default_precision=self.default_precision,
-                reply=reply, queue_depth=self.admission.depth())
+                reply=reply, queue_depth=self.admission.depth(),
+                trace_id=trace_id)
         except Exception as e:
             # rejected-at-dispatch, never admitted: the stats
-            # reconciliation (received == admitted + rejected) the
-            # stop path documents must keep holding for deltas
+            # reconciliation (received == admitted + rejected +
+            # stats_served) the stop path documents must keep holding
+            # for deltas
             self._emit_rejection(
                 request["id"], f"{type(e).__name__}: {e}", reply,
-                algo="maxsum")
+                algo="maxsum", reason_class="delta",
+                trace_id=trace_id)
             return
-        self.stats["admitted"] += 1
-        self.stats["completed"] += 1
+        self._count("admitted")
+        self._count("completed")
 
     # -------------------------------------------------------- dispatch
 
@@ -200,10 +496,12 @@ class ServeLoop:
                 for job in group.jobs:
                     self._emit_rejection(
                         job.job_id, f"dispatch failed: {e}",
-                        job.reply, algo=group.key[0])
+                        job.reply, algo=group.key[0],
+                        reason_class="dispatch",
+                        trace_id=job.trace_id)
                 continue
             n += len(records)
-        self.stats["completed"] += n
+        self._count("completed", n)
         return n
 
     def _poll_timeout(self) -> float:
@@ -217,7 +515,8 @@ class ServeLoop:
     def run(self) -> Dict[str, int]:
         """Serve until stop or drained end-of-input; returns the
         lifetime stats (also emitted as the final ``serve`` record)."""
-        t_start = self.clock()
+        t_start = self._t_start = self.clock()
+        self._maybe_heartbeat()          # arm the heartbeat timer
         while not self._stop.is_set():
             try:
                 line, reply = self._inbox.get(
@@ -245,6 +544,7 @@ class ServeLoop:
             if self._stop.is_set():
                 break
             self._dispatch(self.admission.due())
+            self._maybe_heartbeat()
             if self._input_closed.is_set() and self._inbox.empty():
                 # end of input: drain remaining groups and finish
                 # (due() just ran above and nothing can be admitted
@@ -260,7 +560,8 @@ class ServeLoop:
                     self._emit_rejection(
                         job.job_id, "serve daemon shutting down "
                         "(queued, not yet dispatched)", job.reply,
-                        algo=group.key[0])
+                        algo=group.key[0], reason_class="shutdown",
+                        trace_id=job.trace_id)
             grace_until = self.clock() + _STOP_DRAIN_GRACE
             while True:
                 try:
@@ -284,11 +585,13 @@ class ServeLoop:
                     job_id = e.job_id
                 if line.strip():
                     # count it received: the stats must reconcile
-                    # (received == admitted + rejected-at-the-door)
-                    self.stats["received"] += 1
+                    # (received == admitted + rejected-at-the-door
+                    # + stats_served)
+                    self._count("received")
                     self._emit_rejection(
                         job_id, "serve daemon shutting down "
-                        "(received, not yet admitted)", reply)
+                        "(received, not yet admitted)", reply,
+                        reason_class="shutdown")
         if self.reporter is not None:
             from ..parallel.batch import runner_cache_stats
             from .queue import instance_cache_stats
@@ -310,7 +613,10 @@ class ServeLoop:
                 sessions=(dict(self.dispatcher.delta_sessions.stats)
                           if getattr(self.dispatcher,
                                      "delta_sessions", None)
-                          is not None else None))
+                          is not None else None),
+                # the memory accounting snapshot closes every run:
+                # post-mortems read residency without a live daemon
+                memory=self.memory_snapshot())
         return dict(self.stats)
 
     # --------------------------------------------------- oneshot drive

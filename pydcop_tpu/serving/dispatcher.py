@@ -17,17 +17,52 @@ off before decode), bounding the compile universe per rung at
 log2(max_batch)+1 programs instead of max_batch.
 
 Results stream back as v1 ``summary`` records (one per job, with
-``queue_wait_s`` and rung attribution) plus one ``serve`` dispatch
-record carrying queue depth, wait stats, spans and cache counters —
-the telemetry `bench_serve` and the warm-start tests assert on.
+``queue_wait_s``, ``trace_id`` and rung attribution) plus one
+``serve`` dispatch record carrying queue depth, wait stats, spans and
+cache counters — the telemetry `bench_serve` and the warm-start tests
+assert on.  With a registry attached (the serve ops plane), every
+dispatch additionally feeds the aggregate metrics — dispatches by
+rung×reason, per-rung stage latency histograms (queue-wait /
+batch-form / deserialize / compile / execute) — and every job gets a
+``trace`` record closing its pipeline story.
 """
 
 import time
 from typing import Any, Callable, Dict, List
 
 from ..parallel.batch import runner_for_rung, runner_cache_stats
-from ..parallel.bucketing import next_pow2
+from ..parallel.bucketing import next_pow2, rung_label
 from .queue import DispatchGroup
+
+#: the per-rung latency stages the ops plane histograms: each maps to
+#: the SpanClock span names that make it up (a stage observed only
+#: when at least one of its spans appeared in the dispatch)
+STAGE_SPANS = {
+    "queue_wait": ("queue_wait_s",),            # per job
+    "batch_form": ("batch_form_s",),            # per dispatch
+    "deserialize": ("deserialize_s", "eval_deserialize_s"),
+    "compile": ("trace_lower_s", "compile_s",
+                "eval_trace_lower_s", "eval_compile_s"),
+    "execute": ("execute_s",),
+}
+
+
+def _stage_metrics(registry):
+    """The dispatcher's registry handles (idempotent: registration
+    returns the existing metric on re-entry)."""
+    return {
+        "dispatches": registry.counter(
+            "pydcop_serve_dispatches_total",
+            "batched dispatches executed", labels=("rung", "reason")),
+        "jobs": registry.counter(
+            "pydcop_serve_dispatched_jobs_total",
+            "jobs completed through dispatches", labels=("rung",)),
+        "stage": registry.histogram(
+            "pydcop_serve_stage_seconds",
+            "per-rung pipeline stage latency (queue_wait/batch_form/"
+            "deserialize/compile/execute)",
+            labels=("rung", "stage")),
+    }
 
 
 class DeltaSessions:
@@ -102,6 +137,18 @@ class DeltaSessions:
         admitted-request index stays reachable)."""
         return target in self._sessions
 
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def resident_bytes(self) -> Dict[str, int]:
+        """Approximate resident bytes per open session (carried
+        message state + device planes + host arrays) — the
+        measurement the ROADMAP's byte-budgeted session store
+        consumes, surfaced today as memory gauges and in ``serve``
+        records."""
+        return {target: engine.resident_bytes()
+                for target, engine in list(self._sessions.items())}
+
     def drop(self, target: str):
         """Close a session whose state can no longer be trusted (a
         base solve or a post-edit re-solve failed): the next delta
@@ -117,11 +164,15 @@ class Dispatcher:
 
     def __init__(self, reporter=None, exec_cache=None,
                  clock: Callable[[], float] = time.monotonic,
-                 batch_pow2: bool = True, reserve=None):
+                 batch_pow2: bool = True, reserve=None,
+                 registry=None):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
         self.batch_pow2 = bool(batch_pow2)
+        self.registry = registry
+        self._metrics = (_stage_metrics(registry)
+                         if registry is not None else None)
         self.stats: Dict[str, int] = {"dispatches": 0, "jobs": 0,
                                       "deltas": 0}
         #: spans of the most recent dispatch (tests read this)
@@ -130,31 +181,63 @@ class Dispatcher:
         self.delta_sessions = DeltaSessions(exec_cache=exec_cache,
                                             reserve=reserve)
 
+    # --------------------------------------------------- registry feed
+
+    def _observe_dispatch(self, rung: str, reason: str, n_jobs: int,
+                          waits: List[float],
+                          spans: Dict[str, float]):
+        """Feed one dispatch into the aggregate metrics: the dispatch
+        counter by rung×reason and the per-rung stage histograms.
+        Queue-wait is observed per JOB (it is a per-job quantity; the
+        p99 an operator reads must be a job p99); the device-side
+        stages happened once for the whole batch and are observed
+        once."""
+        if self._metrics is None:
+            return
+        m = self._metrics
+        m["dispatches"].inc(rung=rung, reason=reason)
+        m["jobs"].inc(n_jobs, rung=rung)
+        for w in waits:
+            m["stage"].observe(w, rung=rung, stage="queue_wait")
+        for stage, span_names in STAGE_SPANS.items():
+            if stage == "queue_wait":
+                continue
+            total = sum(spans[k] for k in span_names if k in spans)
+            if total or any(k in spans for k in span_names):
+                m["stage"].observe(total, rung=rung, stage=stage)
+
     def dispatch(self, group: DispatchGroup,
                  queue_depth: int = 0) -> List[Dict[str, Any]]:
         """Run one group; emit and return its per-job summary
         records."""
+        from ..observability.spans import SpanClock
+
         jobs = group.jobs
         algo, params_t, max_cycles, rung_sig = group.key
         params = dict(params_t)
         B = len(jobs)
-        padded_B = next_pow2(B) if self.batch_pow2 else B
-        instances = [j.padded for j in jobs]
-        seeds = [j.seed for j in jobs]
-        if padded_B > B:
-            instances += [instances[-1]] * (padded_B - B)
-            seeds += [seeds[-1]] * (padded_B - B)
-
-        t0 = self.clock()
-        runner = runner_for_rung(algo, instances, params,
-                                 rung_signature=rung_sig,
-                                 exec_cache=self.exec_cache)
-        sel, cycles, finished = runner.run(max_cycles=max_cycles,
-                                           seeds=seeds)
+        clock = SpanClock(time_source=self.clock)
+        t0 = clock.now()
+        with clock.span("batch_form_s"):
+            # batch formation: pow2 padding, arg stacking and the
+            # runner build/re-point — the host-side cost dynamic
+            # batching amortizes, now its own stage in the ladder
+            padded_B = next_pow2(B) if self.batch_pow2 else B
+            instances = [j.padded for j in jobs]
+            seeds = [j.seed for j in jobs]
+            if padded_B > B:
+                instances += [instances[-1]] * (padded_B - B)
+                seeds += [seeds[-1]] * (padded_B - B)
+            runner = runner_for_rung(algo, instances, params,
+                                     rung_signature=rung_sig,
+                                     exec_cache=self.exec_cache)
+        sel, cycles, finished = runner.run(
+            max_cycles=max_cycles, seeds=seeds,
+            trace_ids=[j.trace_id for j in jobs])
         costs, viols = runner.evaluate(sel)
         decoded = runner.decode(sel)
         elapsed = self.clock() - t0
-        self.last_spans = dict(runner.last_spans)
+        self.last_spans = dict(clock.as_dict(), **runner.last_spans)
         # per-job `time` is EXECUTE wall amortized over the batch, per
         # the documented schema — compile/deserialize live in the
         # spans field, and folding a cold rung's compile into every
@@ -186,6 +269,8 @@ class Dispatcher:
                 "batch": B,
                 "dispatch_reason": group.reason,
             }
+            if job.trace_id:
+                rec["trace_id"] = job.trace_id
             if "precision" in params:
                 rec["precision"] = params["precision"]
             records.append(rec)
@@ -196,8 +281,21 @@ class Dispatcher:
 
         self.stats["dispatches"] += 1
         self.stats["jobs"] += B
+        spans = dict(self.last_spans)
+        label = f"{algo}/{rung_label(rung_sig)}"
+        self._observe_dispatch(label, group.reason, B, waits, spans)
         if self.reporter is not None:
-            spans = dict(runner.last_spans)
+            for i, job in enumerate(jobs):
+                if not job.trace_id:
+                    continue
+                # the job's pipeline story closes here: its own
+                # queue wait plus the dispatch-shared device spans
+                # (batch_form/deserialize/compile/execute happened
+                # once for the whole rung the job rode)
+                self.reporter.trace(
+                    job.trace_id, job.job_id, "done", rung=label,
+                    reason=group.reason, batch=B,
+                    queue_wait_s=round(waits[i], 6), spans=spans)
             self.reporter.serve(
                 event="dispatch", reason=group.reason,
                 rung=list(rung_sig), batch=B, padded_batch=padded_B,
@@ -216,7 +314,8 @@ class Dispatcher:
                        default_seed: int = 0,
                        default_precision=None,
                        reply=None,
-                       queue_depth: int = 0) -> Dict[str, Any]:
+                       queue_depth: int = 0,
+                       trace_id: str = "") -> Dict[str, Any]:
         """One ``delta`` job: apply the actions to the target's warm
         session and re-solve.  Deltas bypass the batching queue — a
         session is singular state, there is nothing to batch — and
@@ -278,12 +377,25 @@ class Dispatcher:
         }
         if res.get("edit"):
             rec["edit"] = res["edit"]
+        if trace_id:
+            rec["trace_id"] = trace_id
         if self.reporter is not None:
             self.reporter.summary(**rec)
         if reply is not None:
             reply(dict(rec, record="summary", mode="serve"))
         self.stats["deltas"] += 1
+        label = f"maxsum/{rung_label(engine.rung.signature)}"
+        # deltas bypass the queue (dispatch happens at admission), so
+        # their queue wait is structurally zero — observed as such so
+        # a delta-heavy daemon's wait p99 reflects reality
+        self._observe_dispatch(label, "delta", 1, [0.0],
+                               dict(engine.last_spans))
         if self.reporter is not None:
+            if trace_id:
+                self.reporter.trace(
+                    trace_id, request["id"], "done", rung=label,
+                    reason="delta", batch=1,
+                    spans=dict(engine.last_spans))
             self.reporter.serve(
                 event="dispatch", reason="delta",
                 rung=list(engine.rung.signature), batch=1,

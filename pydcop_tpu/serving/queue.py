@@ -44,6 +44,10 @@ class AdmittedJob:
     deadline_s: Optional[float] = None  # per-job dispatch deadline
     reply: Optional[Callable[[Dict[str, Any]], None]] = None
     t_admitted: float = 0.0
+    #: per-job trace id (assigned at admission by the serve loop);
+    #: every record of this job's pipeline life carries it, so the
+    #: queue->rung->device story reconstructs from the JSONL alone
+    trace_id: str = ""
 
 
 @dataclass
@@ -73,6 +77,22 @@ def instance_cache_stats() -> Dict[str, int]:
     visible in telemetry."""
     return dict(_INSTANCE_CACHE_STATS, size=len(_INSTANCE_CACHE),
                 cap=_INSTANCE_CACHE_CAP)
+
+
+def instance_cache_bytes() -> int:
+    """Approximate array bytes held by the admission cache (the built
+    and rung-padded host arrays; the parsed DCOP objects are skipped —
+    pure-Python overhead the array estimator cannot see and the
+    eviction-policy consumer does not budget)."""
+    from ..observability.memory import approx_object_bytes
+
+    seen: set = set()
+    total = 0
+    for entry in list(_INSTANCE_CACHE.values()):
+        _dcop, arrays, _rung, padded = entry
+        total += approx_object_bytes(arrays, seen)
+        total += approx_object_bytes(padded, seen)
+    return total
 
 
 def _load_instance(path: str, family: str,
@@ -121,7 +141,8 @@ def prepare_job(request: Dict[str, Any],
                 default_seed: int = 0,
                 default_precision: Optional[str] = None,
                 reserve=None,
-                reply: Optional[Callable] = None) -> AdmittedJob:
+                reply: Optional[Callable] = None,
+                trace_id: str = "") -> AdmittedJob:
     """A validated request -> :class:`AdmittedJob`: load the instance
     (through the admission cache), validate/cast the algorithm params
     exactly like ``solve`` does, and pad to the home rung.  Any failure
@@ -178,7 +199,7 @@ def prepare_job(request: Dict[str, Any],
         max_cycles=max_cycles,
         deadline_s=(float(deadline_ms) / 1000.0
                     if deadline_ms is not None else None),
-        reply=reply)
+        reply=reply, trace_id=str(trace_id))
 
 
 class AdmissionQueue:
@@ -211,7 +232,11 @@ class AdmissionQueue:
         return len(group)
 
     def depth(self) -> int:
-        return sum(len(g) for g in self._groups.values())
+        # list() first: depth is also read from ops-plane threads
+        # (HTTP /stats, registry samplers) while the loop thread
+        # admits — the C-level copy is atomic under the GIL, a
+        # Python-level generator over a mutating dict is not
+        return sum(len(g) for g in list(self._groups.values()))
 
     # -------------------------------------------------------- dispatch
 

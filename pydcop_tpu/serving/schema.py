@@ -36,7 +36,8 @@ SERVABLE_ALGOS = ("maxsum", "dsa", "mgm")
 #: every accepted ``solve`` request field -> short doc (the schema,
 #: used both for validation and the docs)
 REQUEST_FIELDS = {
-    "op": "optional: 'solve' (default) or 'delta' (see DELTA_FIELDS)",
+    "op": "optional: 'solve' (default), 'delta' (see DELTA_FIELDS) "
+          "or 'stats' (see STATS_FIELDS)",
     "id": "required job id (non-empty string, unique per client)",
     "dcop": "required path to the DCOP yaml file",
     "algo": f"required algorithm, one of {', '.join(SERVABLE_ALGOS)}",
@@ -66,6 +67,17 @@ DELTA_FIELDS = {
                "dcop/scenario.py KNOWN_ACTIONS)",
     "max_cycles": "optional cycle budget for the warm re-solve",
     "seed": "optional engine seed (first solve of the session only)",
+}
+
+#: the ``stats`` control op: ask a running daemon for its operational
+#: snapshot (queue depth, lifetime stats, cache counters, memory
+#: accounting, registry aggregates).  Answered immediately at
+#: admission — it never queues behind solve work — as one ``serve``
+#: record with ``event: "stats"`` on the requester's reply channel
+#: (socket clients; ``pydcop serve-status`` wraps exactly this)
+STATS_FIELDS = {
+    "op": "required: 'stats'",
+    "id": "required request id (echoed in the snapshot record)",
 }
 
 _PRECISIONS = ("f32", "bf16", "auto")
@@ -109,8 +121,15 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
     op = rec.get("op", "solve")
     if op == "delta":
         return _validate_delta(rec, bad)
+    if op == "stats":
+        unknown = sorted(set(rec) - set(STATS_FIELDS))
+        if unknown:
+            raise bad(f"unknown stats request field(s): "
+                      f"{', '.join(unknown)}")
+        return rec
     if op != "solve":
-        raise bad(f"unsupported op {op!r}; 'solve' or 'delta'")
+        raise bad(f"unsupported op {op!r}; 'solve', 'delta' or "
+                  f"'stats'")
     unknown = sorted(set(rec) - set(REQUEST_FIELDS))
     if unknown:
         raise bad(f"unknown request field(s): {', '.join(unknown)}")

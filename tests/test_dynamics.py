@@ -561,6 +561,11 @@ def test_serve_delta_session_end_to_end(tmp_path):
     records = read_records(out)
     for rec in records:
         validate_record(rec)
+    # the CI wiring of the schema contract: the streaming validator
+    # CLI agrees with the in-process loop above
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    assert cli_main(["telemetry-validate", out, "--quiet"]) == 0
     summaries = {r["job_id"]: r for r in records
                  if r["record"] == "summary"}
     assert summaries["d1"]["warm_start"] is True

@@ -1,0 +1,311 @@
+"""The serve ops plane's aggregate layer (ISSUE 11 tentpole).
+
+Layers under test:
+
+* ``observability/registry.py`` — label-aware counters/gauges,
+  log-bucketed histograms whose p50/p95/p99 come from bucket
+  interpolation (bounded relative error, no sample storage), the
+  Prometheus text exporter, samplers, and the ``--metrics-port`` HTTP
+  endpoint;
+* ``observability/memory.py`` + its per-store hooks — the byte
+  accounting the ROADMAP's session-store eviction item consumes:
+  object-graph array bytes, the live-buffer census, host RSS,
+  ``ExecutableCache.disk_bytes``, admission-cache bytes and the
+  compact rung labels;
+* the SpanClock injectable time source (satellite: span assertions on
+  a fake clock instead of sleeps).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.observability.registry import (HISTOGRAM_BOUNDS,
+                                               MetricsHTTPServer,
+                                               MetricsRegistry)
+
+pytestmark = pytest.mark.obs
+
+
+# ------------------------------------------------------------ counters
+
+
+def test_counter_labels_and_values():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs", labels=("reason",))
+    c.inc(reason="full")
+    c.inc(2, reason="full")
+    c.inc(reason="deadline")
+    assert c.value(reason="full") == 3
+    assert c.value(reason="deadline") == 1
+    with pytest.raises(ValueError, match="labels"):
+        c.inc(nope="x")
+    with pytest.raises(ValueError, match=">= 0"):
+        c.inc(-1, reason="full")
+
+
+def test_counter_set_total_is_monotonic():
+    """set_total mirrors an external stats dict; a racing stale read
+    must never move the counter backwards."""
+    reg = MetricsRegistry()
+    c = reg.counter("cache_hits_total", "hits")
+    c.set_total(10)
+    c.set_total(7)          # stale mirror read: ignored
+    assert c.value() == 10
+
+
+def test_registration_is_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "x")
+    assert reg.counter("x_total", "x") is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total", "x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("x_total", "x", labels=("other",))
+
+
+# ---------------------------------------------------------- histograms
+
+
+def test_histogram_quantiles_without_samples():
+    """Log-bucketed quantiles: every estimate must land within one
+    bucket ratio (2x) of the true value — the exporter's documented
+    error bound — and count/sum must be exact."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", labels=("stage",))
+    values = [0.001 * (i + 1) for i in range(100)]  # 1..100 ms
+    for v in values:
+        h.observe(v, stage="execute")
+    snap = h._snap()["execute"]
+    assert snap["count"] == 100
+    assert snap["sum"] == pytest.approx(sum(values), rel=1e-6)
+    for q in (0.5, 0.95, 0.99):
+        true = values[min(99, int(q * 100))]
+        est = h.quantile(q, stage="execute")
+        assert true / 2 <= est <= true * 2, (q, true, est)
+    # no observations yet on another child -> None, not garbage
+    assert h.quantile(0.99, stage="compile") is None
+
+
+def test_histogram_overflow_and_nan():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat")
+    h.observe(10 ** 9)                  # beyond the last bound
+    h.observe(float("nan"))             # dropped, not poisoning sums
+    assert h._snap()[""]["count"] == 1
+    assert h.quantile(0.99) == pytest.approx(HISTOGRAM_BOUNDS[-1])
+
+
+# ------------------------------------------------- exporter + snapshot
+
+
+def test_prometheus_render_format():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs served", labels=("algo",))
+    c.inc(3, algo="maxsum")
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    h = reg.histogram("lat_seconds", "latency")
+    h.observe(0.01)
+    text = reg.render()
+    assert "# HELP jobs_total jobs served\n# TYPE jobs_total counter" \
+        in text
+    assert 'jobs_total{algo="maxsum"} 3' in text
+    assert "# TYPE depth gauge" in text and "depth 7" in text
+    assert "# TYPE lat_seconds histogram" in text
+    # buckets are CUMULATIVE and closed by +Inf == count
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_count 1" in text
+    # label values are escaped
+    c.inc(algo='we"ird\\')
+    assert r'algo="we\"ird\\"' in reg.render()
+
+
+def test_snapshot_shape_and_sampler_refresh():
+    reg = MetricsRegistry()
+    depth = {"value": 0}
+    g = reg.gauge("depth", "d")
+    reg.add_sampler(lambda: g.set(depth["value"]))
+    depth["value"] = 42
+    snap = reg.snapshot()
+    assert snap["gauges"]["depth"][""] == 42
+    # a sampler that raises is skipped, never breaks the scrape
+
+    def boom():
+        raise RuntimeError("scrape-time race")
+
+    reg.add_sampler(boom)
+    depth["value"] = 43
+    assert reg.snapshot()["gauges"]["depth"][""] == 43
+    json.dumps(reg.snapshot())          # JSON-able end to end
+
+
+def test_metrics_http_server_endpoints():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x").inc()
+    srv = MetricsHTTPServer(reg, port=0,
+                            snapshot_fn=lambda: {"queue_depth": 5})
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            assert "x_total 1" in resp.read().decode()
+        with urllib.request.urlopen(f"{base}/stats") as resp:
+            assert json.loads(resp.read()) == {"queue_depth": 5}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------- memory account
+
+
+def test_approx_object_bytes_counts_arrays_once():
+    from pydcop_tpu.observability.memory import approx_object_bytes
+
+    a = np.zeros((10, 10), dtype=np.float32)      # 400 bytes
+    b = np.zeros(25, dtype=np.int64)              # 200 bytes
+
+    class Holder:
+        def __init__(self):
+            self.a = a
+            self.nested = {"b": b, "list": [a, (b,)]}  # shared refs
+
+    assert approx_object_bytes(Holder()) == 600   # a + b, once each
+    assert approx_object_bytes(None) == 0
+    assert approx_object_bytes({"x": 1, "y": "s"}) == 0
+
+
+def test_live_buffer_census_and_host_rss():
+    import jax.numpy as jnp
+
+    from pydcop_tpu.observability.memory import (host_rss_bytes,
+                                                 live_buffer_census)
+
+    keep = jnp.zeros((128, 128), dtype=jnp.float32)  # 64 KiB live
+    census = live_buffer_census()
+    assert census["buffers"] >= 1
+    assert census["bytes"] >= keep.nbytes
+    rss = host_rss_bytes()
+    assert rss is None or rss > 10 * 1024 * 1024  # a jax process
+
+
+def test_exec_cache_disk_bytes(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exe"))
+    assert cache.disk_bytes() == 0
+    compiled = jax.jit(lambda x: x + 1).lower(
+        jnp.arange(4.0)).compile()
+    assert cache.store(("k",), compiled)
+    assert cache.disk_bytes() > 0
+    disabled = ExecutableCache(path=str(tmp_path / "exe"),
+                               enabled=False)
+    assert disabled.disk_bytes() == 0
+
+
+def test_instance_cache_bytes_tracks_admissions(tmp_path):
+    from pydcop_tpu.serving import queue as squeue
+
+    yaml = tmp_path / "m.yaml"
+    yaml.write_text(
+        "name: m\nobjective: min\n"
+        "domains:\n  colors: {values: [R, G, B]}\n"
+        "variables:\n  v0: {domain: colors}\n  v1: {domain: colors}\n"
+        "constraints:\n  c0: {type: intention, "
+        "function: 1 if v0 == v1 else 0}\n"
+        "agents: [a0, a1]\n")
+    squeue.prepare_job({"id": "x", "dcop": str(yaml),
+                        "algo": "dsa", "max_cycles": 5})
+    assert squeue.instance_cache_bytes() > 0
+
+
+def test_runner_cache_bytes_by_rung(tmp_path, monkeypatch):
+    from pydcop_tpu.generators.fast import coloring_hypergraph_arrays
+    from pydcop_tpu.parallel import batch as pbatch
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, home_rung
+
+    monkeypatch.setattr(pbatch, "_RUNNER_CACHE", {})
+    arrays = coloring_hypergraph_arrays(10, 20, 3, seed=1)
+    rung = home_rung(ShapeProfile.of(arrays))
+    padded = rung.pad(arrays)
+    pbatch.runner_for_rung("dsa", [padded, padded], {"stop_cycle": 3},
+                           rung_signature=rung.signature)
+    by_rung = pbatch.runner_cache_bytes()
+    assert len(by_rung) == 1
+    label, nbytes = next(iter(by_rung.items()))
+    assert label.startswith("dsa/hyper:") and "/b2" in label
+    assert nbytes > 0
+
+
+def test_rung_label_compact():
+    from pydcop_tpu.parallel.bucketing import rung_label
+
+    assert rung_label(("factor", 3, 17, ((2, 32),), 0)) == \
+        "factor:d3:v17:a2x32"
+    assert rung_label(("hyper", 4, 9, ((2, 8), (3, 4)), 16)) == \
+        "hyper:d4:v9:a2x8:a3x4:p16"
+    # runner_for_rung accepts ANY hashable signature (library callers
+    # key however they like — test_hetero_batch uses ("other",) +
+    # signature): a telemetry read over a foreign key must fall back
+    # to a generic flattening, never raise
+    assert rung_label(("other", "hyper", 3, 17, ((2, 32),), 64)) \
+        .startswith("other_hyper_3_17")
+    assert rung_label("custom-key") == "custom-key"
+    assert rung_label(()) == "unkeyed"
+
+
+def test_dynamic_engine_resident_bytes(tmp_path):
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.dynamics.engine import DynamicEngine
+
+    yaml = tmp_path / "m.yaml"
+    yaml.write_text(
+        "name: m\nobjective: min\n"
+        "domains:\n  colors: {values: [R, G, B]}\n"
+        "variables:\n" + "".join(
+            f"  v{i}: {{domain: colors}}\n" for i in range(4)) +
+        "constraints:\n" + "".join(
+            f"  c{k}: {{type: intention, function: "
+            f"{2 + k} if v{k} == v{k + 1} else 0}}\n"
+            for k in range(3)) +
+        "agents: [a0, a1, a2, a3]\n")
+    engine = DynamicEngine(load_dcop_from_file(str(yaml)),
+                           max_cycles=20)
+    cold = engine.resident_bytes()
+    assert cold > 0                     # host arrays count pre-solve
+    engine.solve(seed=0)
+    warm = engine.resident_bytes()
+    assert warm > cold                  # carried state + device planes
+
+
+# --------------------------------------------- SpanClock fake time src
+
+
+def test_span_clock_injectable_time_source():
+    """The satellite: span assertions with an advanced fake clock —
+    exact values, no sleeps."""
+    from pydcop_tpu.observability.spans import SpanClock
+
+    class FakeTime:
+        def __init__(self):
+            self.now = 100.0
+
+        def __call__(self):
+            return self.now
+
+    ft = FakeTime()
+    clock = SpanClock(time_source=ft)
+    with clock.span("execute_s"):
+        ft.now += 1.5
+    with clock.span("execute_s"):       # accumulates
+        ft.now += 0.25
+    assert clock.as_dict() == {"execute_s": 1.75}
+    assert clock.now() == ft.now

@@ -1,0 +1,460 @@
+"""Serve-grade ops plane (ISSUE 11): stats requests, /metrics,
+heartbeats, per-job traces and memory accounting on a live daemon.
+
+The acceptance spine: a daemon under a mixed 100+ job load answers a
+``stats`` request and a ``/metrics`` scrape MID-RUN with consistent
+queue/latency/memory numbers, and after the drain every completed
+job's pipeline (admit -> rung -> device spans -> result) is
+reconstructable from its ``trace_id`` in the JSONL alone.  The
+``pydcop telemetry-validate`` subcommand runs over the files these
+tests produce — the CI wiring of the schema contract.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pydcop_tpu.observability.registry import (MetricsHTTPServer,
+                                               MetricsRegistry)
+from pydcop_tpu.observability.report import (read_records,
+                                             validate_record)
+from pydcop_tpu.serving.daemon import ServeLoop
+from pydcop_tpu.serving.dispatcher import Dispatcher
+from pydcop_tpu.serving.queue import AdmissionQueue
+
+pytestmark = pytest.mark.serve
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _write_instance(path, name, edges, nv, w):
+    lines = [f"name: {name}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(nv):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k, (a, b) in enumerate(edges):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {w + k} if v{a} == v{b} else 0}}")
+    lines.append("agents: [%s]"
+                 % ", ".join(f"a{i}" for i in range(nv)))
+    path.write_text("\n".join(lines) + "\n")
+
+
+@pytest.fixture
+def instances(tmp_path):
+    specs = [("chain4", [(0, 1), (1, 2), (2, 3)], 4, 3),
+             ("ring5", [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)], 5, 5)]
+    files = {}
+    for name, edges, nv, w in specs:
+        p = tmp_path / f"{name}.yaml"
+        _write_instance(p, name, edges, nv, w)
+        files[name] = str(p)
+    return files
+
+
+def _ops_loop(tmp_path, max_batch=8, max_delay_s=0.01,
+              heartbeat_s=None, clock=None):
+    from pydcop_tpu.observability.report import RunReporter
+
+    registry = MetricsRegistry()
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    kw = {} if clock is None else {"clock": clock}
+    admission = AdmissionQueue(max_batch=max_batch,
+                               max_delay_s=max_delay_s, **kw)
+    dispatcher = Dispatcher(reporter=reporter, registry=registry,
+                            **kw)
+    loop = ServeLoop(admission, dispatcher, reporter=reporter,
+                     default_max_cycles=10, registry=registry,
+                     heartbeat_s=heartbeat_s, **kw)
+    return loop, dispatcher, reporter, registry, out
+
+
+# ------------------------------------ the 100+ job acceptance spine
+
+
+def test_mixed_load_stats_metrics_and_traces(tmp_path, instances):
+    """108 mixed jobs (2 algos x 2 topologies) + 1 malformed line +
+    a mid-feed ``stats`` request, served in-process with the registry
+    and the /metrics HTTP endpoint attached."""
+    n_jobs = 108
+    loop, dispatcher, reporter, registry, out = _ops_loop(tmp_path)
+    server = MetricsHTTPServer(registry, port=0,
+                               snapshot_fn=loop.stats_snapshot)
+    group_of = [("maxsum", "chain4"), ("dsa", "chain4"),
+                ("dsa", "ring5"), ("mgm", "ring5")]
+    stats_replies = []
+    try:
+        for i in range(n_jobs):
+            algo, inst = group_of[i % 4]
+            loop.feed(json.dumps({
+                "id": f"j{i}", "dcop": instances[inst],
+                "algo": algo, "max_cycles": 8, "seed": i}))
+            if i == n_jobs // 2:
+                # mid-run by construction: the stats line sits in the
+                # middle of the admission burst, before any dispatch
+                loop.feed(json.dumps({"op": "stats", "id": "s-mid"}),
+                          reply=stats_replies.append)
+        loop.feed("{not json")
+        runner = threading.Thread(target=loop.run, daemon=True)
+        runner.start()
+        # scrape /metrics while the daemon is dispatching; the scrape
+        # must parse and never disturb the loop
+        mid = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics",
+            timeout=10).read().decode()
+        assert "pydcop_serve_queue_depth" in mid
+        loop.close_input()
+        runner.join(timeout=600)
+        assert not runner.is_alive()
+        final_scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics",
+            timeout=10).read().decode()
+    finally:
+        server.close()
+        reporter.close()
+
+    # ---- the mid-run stats reply is consistent
+    assert len(stats_replies) == 1
+    snap = stats_replies[0]
+    assert snap["event"] == "stats" and snap["id"] == "s-mid"
+    assert snap["queue_depth"] > 0          # asked mid-admission
+    assert snap["uptime_s"] >= 0
+    memory = snap["memory"]
+    assert memory["instance_cache_bytes"] > 0
+    assert memory["host_rss_bytes"] is None \
+        or memory["host_rss_bytes"] > 0
+    assert "metrics" in snap and "counters" in snap["metrics"]
+    json.dumps(snap)                        # socket-serializable
+
+    # ---- lifetime stats reconcile
+    assert loop.stats["completed"] == n_jobs
+    assert loop.stats["rejected"] == 1
+    assert loop.stats["stats_served"] == 1
+    assert loop.stats["received"] == n_jobs + 2
+
+    # ---- the registry agrees with the event-log truth
+    snap = registry.snapshot()
+    counters = snap["counters"]
+    assert counters["pydcop_serve_completed_total"][""] == n_jobs
+    assert counters["pydcop_serve_rejected_total"]["parse"] == 1
+    dispatches = sum(
+        counters["pydcop_serve_dispatches_total"].values())
+    assert dispatches == dispatcher.stats["dispatches"]
+    stage = snap["histograms"]["pydcop_serve_stage_seconds"]
+    waits = [v for k, v in stage.items()
+             if k.endswith(",queue_wait")]
+    assert sum(e["count"] for e in waits) == n_jobs
+    for entry in waits:
+        assert entry["p99"] >= entry["p50"] >= 0
+    execs = [v for k, v in stage.items() if k.endswith(",execute")]
+    assert sum(e["count"] for e in execs) == \
+        dispatcher.stats["dispatches"]
+    assert "pydcop_serve_stage_seconds_bucket" in final_scrape
+    assert f"pydcop_serve_completed_total {n_jobs}" in final_scrape
+
+    # ---- every completed job reconstructs from its trace_id
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    summaries = {r["job_id"]: r for r in records
+                 if r["record"] == "summary"
+                 and r.get("status") != "REJECTED"}
+    assert len(summaries) == n_jobs
+    traces = {}
+    for r in records:
+        if r["record"] == "trace":
+            traces.setdefault(r["trace_id"], []).append(r)
+    assert len(traces) >= n_jobs            # unique per job
+    for job_id, summary in summaries.items():
+        tid = summary["trace_id"]
+        events = {t["event"]: t for t in traces[tid]}
+        assert set(events) == {"admit", "done"}, job_id
+        assert all(t["job_id"] == job_id for t in traces[tid])
+        done = events["done"]
+        assert done["spans"]["execute_s"] >= 0
+        assert "batch_form_s" in done["spans"]
+        assert done["queue_wait_s"] >= 0
+        assert done["batch"] == summary["batch"]
+        assert done["reason"] == summary["dispatch_reason"]
+
+    # ---- the final serve record carries the memory accounting
+    final = records[-1]
+    assert final["event"] == "drained"
+    assert final["memory"]["runner_cache_bytes"] > 0
+
+    # ---- and the CI wiring validates the produced file
+    from pydcop_tpu.dcop_cli import main
+
+    assert main(["telemetry-validate", out, "--quiet"]) == 0
+
+
+# --------------------------------------------- heartbeat (fake clock)
+
+
+def test_heartbeat_fires_on_injected_clock(tmp_path, instances):
+    """No sleeps: the heartbeat rides the loop's injected clock."""
+    clock = FakeClock()
+    loop, dispatcher, reporter, registry, out = _ops_loop(
+        tmp_path, heartbeat_s=10.0, clock=clock)
+    loop._maybe_heartbeat()                 # arms the timer
+    loop._admit_line(json.dumps({
+        "id": "j0", "dcop": instances["chain4"], "algo": "dsa",
+        "max_cycles": 5}))
+    clock.advance(5.0)
+    loop._maybe_heartbeat()                 # not due yet
+    clock.advance(6.0)
+    loop._maybe_heartbeat()                 # 11 s since arm: fires
+    loop._maybe_heartbeat()                 # same instant: no burst
+    reporter.close()
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    beats = [r for r in records if r["record"] == "serve"
+             and r["event"] == "heartbeat"]
+    assert len(beats) == 1
+    hb = beats[0]
+    assert hb["queue_depth"] == 1
+    assert hb["uptime_s"] == pytest.approx(11.0)
+    # one admission over 11 fake seconds
+    assert hb["rates"]["admitted_per_s"] == pytest.approx(1 / 11.0,
+                                                          rel=1e-3)
+    assert hb["stats"]["admitted"] == 1
+    assert hb["memory"]["instance_cache_bytes"] > 0
+    assert registry.snapshot()["counters"][
+        "pydcop_serve_heartbeats_total"][""] == 1
+
+
+def test_heartbeat_oneshot_end_to_end(tmp_path, instances):
+    """A real (wall-clock) oneshot drain with a tiny heartbeat period
+    emits schema-valid heartbeats into the shared output file."""
+    loop, dispatcher, reporter, registry, out = _ops_loop(
+        tmp_path, heartbeat_s=0.0001)
+    lines = [json.dumps({"id": f"j{i}",
+                         "dcop": instances["chain4"],
+                         "algo": "dsa", "max_cycles": 5})
+             for i in range(4)]
+    loop.run_oneshot(lines)
+    reporter.close()
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    beats = [r for r in records if r["record"] == "serve"
+             and r["event"] == "heartbeat"]
+    assert beats, "no heartbeat emitted during the drain"
+    assert all("memory" in b and "rates" in b for b in beats)
+
+
+# ------------------------------------------- stats over a real socket
+
+
+def test_stats_request_over_socket_and_serve_status(tmp_path,
+                                                    instances):
+    """The operator path end to end: a socket daemon answers a
+    ``stats`` request; ``serve-status``'s fetch + render consume it."""
+    from pydcop_tpu.commands.serve_status import (fetch_status,
+                                                  human_bytes,
+                                                  render_status)
+    from pydcop_tpu.serving.sources import SocketServer
+
+    loop, dispatcher, reporter, registry, out = _ops_loop(tmp_path)
+    sock_path = str(tmp_path / "d.sock")
+    server = SocketServer(loop, sock_path)
+    runner = threading.Thread(target=loop.run, daemon=True)
+    runner.start()
+    try:
+        snap = fetch_status(sock_path, timeout=30)
+    finally:
+        loop.request_stop()
+        loop.close_input()
+        runner.join(timeout=60)
+        server.close()
+        reporter.close()
+    assert snap["record"] == "serve" and snap["event"] == "stats"
+    assert snap["queue_depth"] == 0
+    assert "memory" in snap and "metrics" in snap
+    text = render_status(snap)
+    assert "serve daemon status" in text
+    assert "queue depth 0" in text
+    assert human_bytes(None) == "n/a"
+    assert human_bytes(512) == "512 B"
+    assert human_bytes(2 * 1024 * 1024) == "2.0 MiB"
+
+
+def test_serve_status_rejects_non_stats_reply(tmp_path):
+    """A daemon that answers anything but a stats snapshot (an older
+    daemon rejecting the op, a rejection path) must surface as a
+    CliError naming the reason — never render as a healthy idle
+    daemon."""
+    import socket as sk
+
+    from pydcop_tpu.commands import CliError
+    from pydcop_tpu.commands.serve_status import fetch_status
+
+    path = str(tmp_path / "old.sock")
+    srv = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+
+    def answer():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall((json.dumps(
+            {"record": "summary", "status": "REJECTED",
+             "error": "unsupported op 'stats'"}) + "\n").encode())
+        conn.close()
+
+    t = threading.Thread(target=answer, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(CliError, match="unsupported op"):
+            fetch_status(path, timeout=10)
+    finally:
+        t.join(timeout=10)
+        srv.close()
+
+
+def test_stats_op_schema():
+    from pydcop_tpu.serving.schema import (RequestError,
+                                           validate_request)
+
+    assert validate_request({"op": "stats", "id": "s1"})["id"] == "s1"
+    with pytest.raises(RequestError, match="unknown stats request"):
+        validate_request({"op": "stats", "id": "s1", "dcop": "x"})
+    with pytest.raises(RequestError, match="id"):
+        validate_request({"op": "stats"})
+
+
+# ----------------------------------------------- delta jobs get traces
+
+
+def test_delta_jobs_traced_and_sessions_accounted(tmp_path,
+                                                  instances):
+    loop, dispatcher, reporter, registry, out = _ops_loop(tmp_path)
+    lines = [
+        json.dumps({"id": "j1", "dcop": instances["chain4"],
+                    "algo": "maxsum", "max_cycles": 200}),
+        json.dumps({"id": "d1", "op": "delta", "target": "j1",
+                    "actions": [{"type": "change_costs",
+                                 "name": "c1",
+                                 "costs": [[0, 5, 9], [5, 0, 1],
+                                           [9, 1, 0]]}]}),
+    ]
+    loop.run_oneshot(lines)
+    reporter.close()
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    traces = [r for r in records if r["record"] == "trace"]
+    by_job = {}
+    for t in traces:
+        by_job.setdefault(t["job_id"], set()).add(t["event"])
+    assert by_job["d1"] == {"admit", "done"}
+    done = [t for t in traces
+            if t["job_id"] == "d1" and t["event"] == "done"][0]
+    assert done["reason"] == "delta"
+    assert done["rung"].startswith("maxsum/factor:")
+    summary = [r for r in records if r["record"] == "summary"
+               and r["job_id"] == "d1"][0]
+    assert summary["trace_id"] == done["trace_id"]
+    # the warm session's residency is measured and surfaced
+    final = records[-1]
+    assert final["memory"]["sessions_open"] == 1
+    assert final["memory"]["sessions_bytes"] > 0
+    assert registry.snapshot()["gauges"][
+        "pydcop_serve_sessions_open"][""] == 1
+
+
+# ------------------------------------------- telemetry-validate CLI
+
+
+def test_telemetry_validate_rejects_bad_file(tmp_path, capsys):
+    from pydcop_tpu.dcop_cli import main
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        json.dumps({"record": "header", "schema": 1, "algo": "a",
+                    "mode": "engine"}) + "\n\n" +
+        json.dumps({"record": "summary", "algo": "a",
+                    "status": "FINISHED"}) + "\n")
+    assert main(["telemetry-validate", str(good)]) == 0
+    assert "2 records valid" in capsys.readouterr().err
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"record": "header", "schema": 1, "algo": "a",
+                    "mode": "engine"}) + "\n" +
+        json.dumps({"record": "trace", "algo": "a",
+                    "trace_id": "", "job_id": "j",
+                    "event": "done"}) + "\n")
+    assert main(["telemetry-validate", str(bad)]) != 0
+    err = capsys.readouterr().err
+    assert f"{bad}:2" in err and "trace_id" in err
+
+    notjson = tmp_path / "nj.jsonl"
+    notjson.write_text("{broken\n")
+    assert main(["telemetry-validate", str(notjson)]) != 0
+    assert main(["telemetry-validate",
+                 str(tmp_path / "missing.jsonl")]) != 0
+
+
+# ----------------------------------- v1.0 reader stays green on v1.2
+
+
+def _v10_validate(rec):
+    """A frozen copy of the v1.0 reader's checks (as shipped in PR 5:
+    kinds header/cycle/summary/serve, no minor-version knowledge) —
+    applied only to the kinds a v1.0 consumer filters for, which is
+    the documented forward-compat discipline."""
+    kind = rec.get("record")
+    assert kind in ("header", "cycle", "summary", "serve")
+    assert "algo" in rec
+    if kind == "header":
+        assert rec.get("schema") == 1
+        assert "mode" in rec
+    elif kind == "cycle":
+        assert isinstance(rec.get("cycle"), int) and rec["cycle"] >= 1
+    elif kind == "summary":
+        assert "status" in rec
+    elif kind == "serve":
+        assert isinstance(rec.get("event"), str)
+
+
+def test_v10_reader_green_against_v12_file(tmp_path, instances):
+    """A v1.2 file (trace records, heartbeats, memory fields) read by
+    a v1.0 consumer: every record of a kind it speaks still
+    validates; the kinds it does not know are skippable by the one
+    rule it always had (filter on ``record``)."""
+    loop, dispatcher, reporter, registry, out = _ops_loop(
+        tmp_path, heartbeat_s=0.0001)
+    lines = [json.dumps({"id": f"j{i}",
+                         "dcop": instances["chain4"],
+                         "algo": "dsa", "max_cycles": 5})
+             for i in range(3)]
+    lines.append(json.dumps({"op": "stats", "id": "s1"}))
+    loop.run_oneshot(lines)
+    reporter.close()
+    records = read_records(out)
+    kinds = {r["record"] for r in records}
+    assert "trace" in kinds             # the file really is v1.2
+    v10_known = [r for r in records
+                 if r["record"] in ("header", "cycle", "summary",
+                                    "serve")]
+    assert len(v10_known) >= 5          # header + summaries + serves
+    for rec in v10_known:
+        _v10_validate(rec)
+    # and the full v1.2 validator accepts everything
+    for rec in records:
+        validate_record(rec)

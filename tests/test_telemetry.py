@@ -336,6 +336,139 @@ def test_validate_record_rejects_malformed():
         validate_record({"record": "summary", "algo": "a"})
 
 
+# ------------------------------------------------- schema v1.2 (ops)
+
+
+def test_validate_trace_records():
+    """Schema v1.2: trace records accepted when well-formed, rejected
+    with the offending field named otherwise."""
+    validate_record({"record": "trace", "algo": "serve",
+                     "trace_id": "t0001", "job_id": "j1",
+                     "event": "admit", "queue_depth": 3})
+    validate_record({"record": "trace", "algo": "serve",
+                     "trace_id": "t0001", "job_id": "j1",
+                     "event": "done", "queue_wait_s": 0.01,
+                     "spans": {"execute_s": 0.5,
+                               "batch_form_s": 0.001}})
+    for bad, needle in [
+        (dict(record="trace", algo="s", job_id="j",
+              event="done"), "trace_id"),
+        (dict(record="trace", algo="s", trace_id="", job_id="j",
+              event="done"), "trace_id"),
+        (dict(record="trace", algo="s", trace_id="t", job_id="j",
+              event="teleport"), "unknown event"),
+        (dict(record="trace", algo="s", trace_id="t",
+              event="done"), "job_id"),
+        (dict(record="trace", algo="s", trace_id="t", job_id="j",
+              event="done", spans={"execute_s": -1}), "spans"),
+        (dict(record="trace", algo="s", trace_id="t", job_id="j",
+              event="done", spans=["nope"]), "spans"),
+        (dict(record="trace", algo="s", trace_id="t", job_id="j",
+              event="done", queue_wait_s=-0.1), "queue_wait_s"),
+    ]:
+        with pytest.raises(ValueError, match=needle):
+            validate_record(bad)
+
+
+def test_validate_serve_heartbeat_fields():
+    validate_record({
+        "record": "serve", "algo": "serve", "event": "heartbeat",
+        "queue_depth": 2, "uptime_s": 1.5,
+        "rates": {"admitted_per_s": 3.0},
+        "memory": {"host_rss_bytes": 1024,
+                   "device_live_bytes": None,
+                   "runner_cache_by_rung": {"dsa/hyper:d3:v9": 512}}})
+    with pytest.raises(ValueError, match="rates"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "heartbeat",
+                         "rates": {"x_per_s": -1}})
+    with pytest.raises(ValueError, match="memory"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "heartbeat",
+                         "memory": {"host_rss_bytes": "lots"}})
+    with pytest.raises(ValueError, match="memory"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "heartbeat", "memory": [1, 2]})
+    with pytest.raises(ValueError, match="trace_id"):
+        validate_record({"record": "summary", "algo": "s",
+                         "status": "FINISHED", "trace_id": ""})
+
+
+def test_schema_minor_is_2_and_v1_readers_stay_green():
+    from pydcop_tpu.observability.report import (SCHEMA_MINOR,
+                                                 SCHEMA_VERSION)
+
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 2
+    # a minor-0 header (pre-dynamics emitter) still validates: the
+    # major gate is the only compatibility wall
+    validate_record({"record": "header", "schema": 1, "algo": "a",
+                     "mode": "engine"})
+
+
+# ----------------------------------------- reporter lifecycle (ops)
+
+
+def test_reporter_close_idempotent_and_context_manager(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    from pydcop_tpu.infrastructure.Events import EventDispatcher
+
+    with RunReporter(path, algo="a", mode="engine",
+                     bus=EventDispatcher()) as rep:
+        rep.summary(status="FINISHED")
+        assert not rep.closed
+    assert rep.closed
+    rep.close()                          # second close: no-op
+    rep.close()
+    with pytest.raises(ValueError, match="closed"):
+        rep.summary(status="FINISHED")
+    assert len(read_records(path)) == 1
+
+
+def test_abandoned_reporter_still_flushes_last_record(tmp_path):
+    """The satellite regression: a reporter abandoned without close()
+    — caller forgot, or died past its finally — must still have its
+    last record on disk at interpreter exit (atexit fallback + the
+    unbuffered append write)."""
+    import subprocess
+
+    path = str(tmp_path / "abandoned.jsonl")
+    code = (
+        "from pydcop_tpu.observability.report import RunReporter\n"
+        "from pydcop_tpu.infrastructure.Events import "
+        "EventDispatcher\n"
+        "rep = RunReporter(%r, algo='a', mode='engine', "
+        "bus=EventDispatcher())\n"
+        "rep.summary(status='FINISHED', cost=1.0)\n"
+        "# no close(), no del: the atexit fallback owns teardown\n"
+        % path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True,
+                          timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr
+    recs = read_records(path)
+    assert len(recs) == 1 and recs[0]["status"] == "FINISHED"
+
+
+def test_reporter_trace_records_and_bus_topic(tmp_path):
+    from pydcop_tpu.infrastructure.Events import EventDispatcher
+
+    bus = EventDispatcher(enabled=True)
+    seen = []
+    bus.subscribe("engine.trace", lambda t, e: seen.append(e))
+    path = str(tmp_path / "t.jsonl")
+    rep = RunReporter(path, algo="serve", mode="serve", bus=bus)
+    rep.trace("t001", "j1", "admit", queue_depth=1)
+    rep.trace("t001", "j1", "done",
+              spans={"execute_s": 0.1}, queue_wait_s=0.02)
+    rep.close()
+    recs = read_records(path)
+    assert [r["event"] for r in recs] == ["admit", "done"]
+    for r in recs:
+        validate_record(r)
+    assert len(seen) == 2 and seen[0]["trace_id"] == "t001"
+
+
 def test_solve_sharded_result_telemetry_surfaces():
     from pydcop_tpu.dcop.yamldcop import load_dcop
     from pydcop_tpu.parallel import solve_sharded_result
@@ -411,7 +544,7 @@ def test_solve_cli_sharded_telemetry_schema(tmp_path):
 class _SlowCollector:
     """Factory: a CsvCollector whose writes take ``delay`` seconds."""
 
-    def __new__(cls, path, delay):
+    def __new__(cls, path, delay, **kw):
         from pydcop_tpu.observability.collector import CsvCollector
 
         class Slow(CsvCollector):
@@ -419,7 +552,7 @@ class _SlowCollector:
                 time.sleep(delay)
                 super()._write_row(row)
 
-        return Slow(path)
+        return Slow(path, **kw)
 
 
 def test_collector_drains_slow_writer_tail(tmp_path):
@@ -455,6 +588,40 @@ def test_collector_counts_and_warns_dropped_rows(tmp_path, caplog):
                in rec.message for rec in caplog.records)
 
 
+def test_collector_dropped_rows_feed_the_registry(tmp_path, caplog):
+    """The satellite: a slow writer's discarded tail lands in the
+    ops-plane counter (``pydcop_collector_dropped_rows_total``), not
+    only in a log line nobody scrapes — the serve heartbeat surfaces
+    exactly this counter."""
+    import logging
+
+    from pydcop_tpu.observability.collector import DROPPED_ROWS_METRIC
+    from pydcop_tpu.observability.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    path = str(tmp_path / "m.csv")
+    c = _SlowCollector(path, delay=0.2, registry=registry)
+    for i in range(50):
+        c.put((f"{i}", "global", "", 1.0, i))
+    with caplog.at_level(logging.WARNING,
+                         logger="pydcop_tpu.observability"):
+        dropped = c.stop(timeout=0.3)
+    assert dropped > 0
+    counter = registry.get(DROPPED_ROWS_METRIC)
+    assert counter.value() == dropped
+    # a lossless collector leaves the counter untouched
+    c2 = CsvCollectorFactory(tmp_path / "ok.csv", registry)
+    c2.put(("1", "global", "", 1.0, 1))
+    assert c2.stop(timeout=30) == 0
+    assert counter.value() == dropped
+
+
+def CsvCollectorFactory(path, registry):
+    from pydcop_tpu.observability.collector import CsvCollector
+
+    return CsvCollector(str(path), registry=registry)
+
+
 def test_collector_normal_fast_path(tmp_path):
     from pydcop_tpu.observability.collector import CsvCollector
 
@@ -488,13 +655,21 @@ def test_compile_stats_census():
 
 
 def test_spans_clock():
+    """Migrated onto the injectable time source (the SpanClock
+    satellite): span values assert EXACTLY against an advanced fake
+    clock — the wall clock never participates."""
     from pydcop_tpu.observability.spans import SpanClock, profile_trace
 
-    clock = SpanClock()
+    fake = {"now": 50.0}
+    clock = SpanClock(time_source=lambda: fake["now"])
     with clock.span("a"):
-        pass
+        fake["now"] += 0.75
     clock.add("a", 1.0)
-    assert clock.as_dict()["a"] >= 1.0
+    assert clock.as_dict() == {"a": 1.75}
+    assert clock.now() == 50.75
+    # the default source still works (smoke, no timing assertion)
+    with SpanClock().span("b"):
+        pass
     # no profile dir -> inert context
     with profile_trace(None):
         pass
