@@ -25,6 +25,13 @@ exposes it as a Prometheus endpoint, `--heartbeat-s` emits periodic
 queue/rate/memory records to ``--out``, a ``stats`` request (or
 ``pydcop serve-status``) snapshots a running daemon, and every job's
 pipeline life is reconstructable from its ``trace_id``.
+
+Warm delta traffic (ISSUE 12): ``delta`` jobs apply in place against
+resident device planes (compiled scatter, O(touched-rows) upload per
+event) in a byte-budgeted LRU session store — ``--session-budget-mb``
+bounds the summed resident bytes, ``--session-cap`` the session
+count; eviction closes the engine and the next delta against the
+target reopens through the executable cache.
 """
 
 import os
@@ -100,6 +107,25 @@ def set_parser(subparsers):
                              "(jobs batch only with like-provisioned "
                              "jobs); the remaining budget is echoed "
                              "in delta dispatch telemetry")
+    parser.add_argument("--session-budget-mb",
+                        dest="session_budget_mb", type=float,
+                        default=None, metavar="MB",
+                        help="byte budget for the warm delta-session "
+                             "store: sessions keep their instance "
+                             "planes and message state resident on "
+                             "device, and the least-recently-used "
+                             "sessions are closed (buffers released, "
+                             "evicted bytes counted) whenever the "
+                             "summed resident estimate exceeds this "
+                             "budget.  An evicted target's next delta "
+                             "reopens through the executable cache "
+                             "(deserialize, not compile).  Default: "
+                             "no byte budget (count cap only)")
+    parser.add_argument("--session-cap", dest="session_cap",
+                        type=int, default=16, metavar="N",
+                        help="maximum number of warm delta sessions "
+                             "held open regardless of bytes "
+                             "(default 16); LRU eviction past it")
     parser.add_argument("--exec-cache", dest="exec_cache",
                         type=str, default=None, metavar="DIR",
                         help="directory for serialized jax.stages rung "
@@ -155,6 +181,15 @@ def run_cmd(args, timeout=None):
     heartbeat_s = getattr(args, "heartbeat_s", None)
     if heartbeat_s is not None and heartbeat_s <= 0:
         raise CliError("--heartbeat-s must be > 0")
+    session_budget_mb = getattr(args, "session_budget_mb", None)
+    if session_budget_mb is not None and session_budget_mb <= 0:
+        raise CliError("--session-budget-mb must be > 0")
+    session_cap = getattr(args, "session_cap", 16)
+    if session_cap < 1:
+        raise CliError("--session-cap must be >= 1")
+    session_budget_bytes = (int(session_budget_mb * 1024 * 1024)
+                            if session_budget_mb is not None
+                            else None)
     metrics_port = getattr(args, "metrics_port", None)
     if metrics_port is not None and getattr(args, "no_metrics", False):
         raise CliError("--metrics-port needs the registry; drop "
@@ -189,6 +224,8 @@ def run_cmd(args, timeout=None):
             max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
             max_cycles=args.max_cycles, precision=args.precision,
             reserve=reserve,
+            session_budget_mb=session_budget_mb,
+            session_cap=session_cap,
             exec_cache=(exec_cache.path
                         if exec_cache is not None
                         and exec_cache.enabled else None),
@@ -197,10 +234,11 @@ def run_cmd(args, timeout=None):
         admission = AdmissionQueue(
             max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1000.0)
-        dispatcher = Dispatcher(reporter=reporter,
-                                exec_cache=exec_cache,
-                                reserve=reserve,
-                                registry=registry)
+        dispatcher = Dispatcher(
+            reporter=reporter, exec_cache=exec_cache,
+            reserve=reserve, registry=registry,
+            session_cap=session_cap,
+            session_budget_bytes=session_budget_bytes)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,

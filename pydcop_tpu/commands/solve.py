@@ -578,7 +578,8 @@ def _scenario_event_summary(e: dict) -> dict:
     the (potentially huge) per-event assignment — the top-level
     result carries the final one."""
     out = {k: e[k] for k in ("status", "cost", "violation", "cycle",
-                             "warm_start", "spans") if k in e}
+                             "warm_start", "spans", "upload_bytes")
+           if k in e}
     for k in ("event", "edit"):
         if e.get(k) is not None:
             out[k] = e[k]

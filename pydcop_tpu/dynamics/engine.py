@@ -107,7 +107,8 @@ class DynamicEngine:
                  chunk_size: int = 32,
                  max_cycles: int = 2000,
                  exec_cache=None,
-                 carry: str = "messages"):
+                 carry: str = "messages",
+                 resident: bool = True):
         if carry not in ("messages", "reset"):
             raise ValueError(
                 f"carry must be 'messages' (conditional-Max-Sum "
@@ -148,6 +149,20 @@ class DynamicEngine:
         self.last_spans: Dict[str, float] = {}
         self.last_edit: Optional[Dict[str, int]] = None
         self.solves = 0
+        #: resident-plane mode (the default): instance planes stay on
+        #: device and ``apply`` runs a compiled, donated scatter over
+        #: them — per-event upload is O(touched rows).  ``False``
+        #: keeps the PR 10 re-upload path (full ``jnp.asarray`` of the
+        #: edited host planes per event); both produce bit-identical
+        #: selections and cycles, asserted in tests/test_dynamics.py
+        self.resident = bool(resident)
+        #: host->device bytes transferred since the previous solve
+        #: (delta scatter arguments on the resident path, full plane
+        #: re-materialization on the re-upload path); surfaced as the
+        #: ``upload_bytes`` result field
+        self.last_upload_bytes = 0
+        self._pending_upload = 0
+        self._pending_spans: Dict[str, float] = {}
         self._state = None
         self._args_dev = None
         self._aot: Dict[Tuple, Any] = {}
@@ -204,9 +219,15 @@ class DynamicEngine:
         """Compile one event's actions into a
         :class:`~pydcop_tpu.dynamics.deltas.TopologyDelta`, execute
         its in-place writes, and reset exactly the touched message
-        rows of the carried state.  Raises
+        rows of the carried state.  On the resident path the writes
+        additionally land on the device planes through the compiled
+        scatter (``dynamics/scatter.py``); the host planes stay
+        authoritative for decode/eval/snapshot either way.  Raises
         :class:`~pydcop_tpu.dynamics.deltas.DeltaError` (instance
         untouched) when the event exceeds the reserved capacity."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         delta = self.instance.compile_event(event)
         self.instance.apply(delta)
         self.last_edit = dict(delta.summary)
@@ -215,18 +236,118 @@ class DynamicEngine:
             # tables) must track the edited planes for state init,
             # decode masks and the next carry_consts device_put
             self._sync_sharded_consts()
-        if self._state is not None:
-            if self.carry == "reset":
-                # fresh message state next solve — the compiled
-                # program (and the executable cache entry) is still
-                # reused as-is, so this mode pays zero retraces too
-                self._state = None
-            elif self.mode == "engine":
-                self._warm_reset_engine(delta)
+        if self.mode == "engine":
+            if self.resident and self._args_dev is not None:
+                with_state = (self._state is not None
+                              and self.carry == "messages")
+                self._apply_resident_engine(delta, with_state)
+                if self.carry == "reset":
+                    # fresh message state next solve — the compiled
+                    # program (and the executable cache entry) is
+                    # still reused as-is: zero retraces in this mode
+                    # too, and the cube planes stay resident
+                    self._state = None
             else:
-                self._warm_reset_sharded(delta)
-        self._args_dev = None    # re-read planes on next solve
+                if self._state is not None:
+                    if self.carry == "reset":
+                        self._state = None
+                    else:
+                        self._warm_reset_engine(delta)
+                self._args_dev = None   # re-read planes next solve
+        else:
+            if self._state is not None:
+                if self.carry == "reset":
+                    self._state = None
+                elif self.resident:
+                    self._apply_resident_sharded(delta)
+                else:
+                    self._warm_reset_sharded(delta)
+        self._pending_spans["apply_s"] = \
+            self._pending_spans.get("apply_s", 0.0) + \
+            (_time.perf_counter() - t0)
         return dict(delta.summary)
+
+    # ------------------------------------------------ resident scatter
+
+    def _scatter_compiled(self, key: Tuple, build_fn, ex_args,
+                          donate: Tuple[int, ...],
+                          out_shardings=None):
+        """The AOT-compiled, donated scatter program for one pow2
+        write-list shape (in-process signature cache; the program is
+        tiny, so it never rides the cross-process executable cache).
+        Its trace/compile spans land on the NEXT solve's record as
+        ``apply_trace_lower_s``/``apply_compile_s`` — distinct names,
+        so the warm contract (no ``trace_lower_s``/``compile_s`` on
+        the solve executable) stays assertable."""
+        import jax
+
+        from ..observability.spans import (SpanClock, aot_compile,
+                                           aval_signature)
+
+        sig = key + aval_signature(ex_args)
+        compiled = self._aot.get(sig)
+        if compiled is None:
+            clock = SpanClock()
+            jitted = jax.jit(build_fn(), donate_argnums=donate,
+                             **({"out_shardings": out_shardings}
+                                if out_shardings is not None else {}))
+            _lowered, compiled = aot_compile(jitted, ex_args, clock,
+                                             prefix="apply_")
+            self._aot[sig] = compiled
+            for k, v in clock.as_dict().items():
+                self._pending_spans[k] = \
+                    self._pending_spans.get(k, 0.0) + v
+        return compiled
+
+    def _apply_resident_engine(self, delta: TopologyDelta,
+                               with_state: bool):
+        """Scatter the delta into the resident argument planes (and
+        the touched q/r/selection rows) via buffer donation: the next
+        solve re-enters the same executable over the updated buffers,
+        and the per-event upload is the write lists alone."""
+        from functools import partial
+
+        from .scatter import (delta_write_lists, engine_scatter_fn,
+                              tree_nbytes)
+
+        w = delta_write_lists(self.instance.arrays, delta,
+                              with_state=with_state)
+        self._pending_upload += tree_nbytes(w)
+        if with_state:
+            compiled = self._scatter_compiled(
+                ("scatter_engine", True),
+                partial(engine_scatter_fn, True),
+                (self._args_dev, self._state, w), donate=(0, 1))
+            self._args_dev, self._state = compiled(
+                self._args_dev, self._state, w)
+        else:
+            compiled = self._scatter_compiled(
+                ("scatter_engine", False),
+                partial(engine_scatter_fn, False),
+                (self._args_dev, w), donate=(0,))
+            self._args_dev = compiled(self._args_dev, w)
+
+    def _apply_resident_sharded(self, delta: TopologyDelta):
+        """The sharded twin: the delta scatters straight into the
+        engine CARRY (whose ``c_*`` entries ARE the mesh constants),
+        replacing the full ``carry_consts()`` re-``device_put`` plus
+        the host round-trip of the q/r planes.  Output shardings are
+        pinned to the carry's own, so the solve chunk's signature
+        cannot drift."""
+        import jax
+
+        from .scatter import (shard_write_lists, sharded_scatter_fn,
+                              tree_nbytes)
+
+        w = shard_write_lists(self.instance.arrays, delta,
+                              self._solver.tp, self._edge_map)
+        self._pending_upload += tree_nbytes(w)
+        shardings = jax.tree_util.tree_map(lambda x: x.sharding,
+                                           self._state)
+        compiled = self._scatter_compiled(
+            ("scatter_sharded",), sharded_scatter_fn,
+            (self._state, w), donate=(0,), out_shardings=shardings)
+        self._state = compiled(self._state, w)
 
     # ---------------------------------------------------------- solve
 
@@ -246,6 +367,22 @@ class DynamicEngine:
             out = self._solve_engine(budget, seed, timeout)
         else:
             out = self._solve_sharded(budget, seed, timeout)
+        # fold the pending apply spans (apply_s wall, plus any one-off
+        # apply_trace_lower_s/apply_compile_s of a new scatter shape)
+        # into this solve's record, and close the upload accounting
+        # window: upload_bytes = host->device bytes since the previous
+        # solve.  Span names are distinct from the solve executable's
+        # trace_lower_s/compile_s, so the warm no-retrace assertions
+        # keep holding letter for letter
+        if self._pending_spans:
+            for k, v in self._pending_spans.items():
+                self.last_spans[k] = round(
+                    self.last_spans.get(k, 0.0) + v, 6)
+            self._pending_spans = {}
+            out["spans"] = dict(self.last_spans)
+        self.last_upload_bytes = self._pending_upload
+        self._pending_upload = 0
+        out["upload_bytes"] = int(self.last_upload_bytes)
         out["warm_start"] = bool(warm)
         out["carry"] = self.carry
         out["edit"] = dict(self.last_edit) if warm and self.last_edit \
@@ -254,14 +391,31 @@ class DynamicEngine:
         self.solves += 1
         return out
 
+    def close(self):
+        """Release the engine's device residency: the carried message
+        state, the resident argument planes and the per-signature
+        compiled-program handles.  The byte-budgeted session store
+        calls this on eviction; the engine stays usable — a later
+        solve re-uploads from the (authoritative) host planes and
+        re-enters the rung's executable through the cache."""
+        self._state = None
+        self._args_dev = None
+        self._aot.clear()
+        if self.mode == "engine":
+            self._chunk_jit = None
+        self._pending_spans = {}
+        self._pending_upload = 0
+
     # ------------------------------------------------- single-chip mode
 
     def _args_engine(self):
         a = self.instance.arrays
         import jax.numpy as jnp
 
+        from .scatter import tree_nbytes
+
         store = self._base.policy.store_dtype
-        return {
+        args = {
             "cubes": [jnp.asarray(b.cubes, dtype=store)
                       for b in a.buckets],
             "var_ids": [jnp.asarray(b.var_ids) for b in a.buckets],
@@ -270,6 +424,10 @@ class DynamicEngine:
             "domain_size": jnp.asarray(a.domain_size),
             "edge_var": jnp.asarray(a.edge_var),
         }
+        # the re-upload tax the resident path eliminates: the FULL
+        # plane materialization counts against upload_bytes
+        self._pending_upload += tree_nbytes(args)
+        return args
 
     def _chunk_fn(self):
         """The warm chunk: the base solver's step driven to ``limit``
@@ -320,6 +478,7 @@ class DynamicEngine:
             np.where(a.domain_mask,
                      np.asarray(a.var_costs, dtype=np.float32),
                      SENTINEL), axis=1).astype(np.int32)
+        self._pending_upload += 2 * q.nbytes + sel.nbytes
         return {
             "cycle": jnp.int32(0),
             "finished": jnp.bool_(False),
@@ -352,6 +511,8 @@ class DynamicEngine:
                 a.domain_mask[row],
                 np.asarray(a.var_costs[row], dtype=np.float32),
                 SENTINEL)))
+        # the host round-trip re-uploads the FULL message state
+        self._pending_upload += q.nbytes + r.nbytes + sel.nbytes
         self._state = {
             "cycle": jnp.int32(0),
             "finished": jnp.bool_(False),
@@ -511,7 +672,14 @@ class DynamicEngine:
             sel=jax.device_put(sel, NamedSharding(mesh, P("dp"))),
             same=jnp.int32(0), cycle=jnp.int32(0),
             finished=jnp.bool_(False))
-        state.update(solver.carry_consts())
+        consts = solver.carry_consts()
+        state.update(consts)
+        # the re-upload tax: full q/r/sel round-trip plus the whole
+        # carry-consts device_put, per event
+        from .scatter import tree_nbytes
+
+        self._pending_upload += (q.nbytes + r.nbytes + sel.nbytes
+                                 + tree_nbytes(consts))
         self._state = state
 
     def _solve_sharded(self, budget: int, seed: int,
@@ -520,7 +688,10 @@ class DynamicEngine:
 
         solver = self._solver
         if self._state is None:
+            from .scatter import tree_nbytes
+
             self._state = solver.mesh_init(int(seed))
+            self._pending_upload += tree_nbytes(self._state)
         eng = solver._mesh_engine()
         state = eng.drive(self._state, budget, timeout=timeout,
                           spans=True)

@@ -60,7 +60,7 @@ def replay_scenario(engine: DynamicEngine, scenario: Scenario,
         if reporter is not None:
             out = {k: v for k, v in rec.items()
                    if k in ("status", "cost", "violation", "cycle",
-                            "warm_start", "spans")}
+                            "warm_start", "spans", "upload_bytes")}
             if rec.get("edit"):
                 out["edit"] = rec["edit"]
             reporter.summary(event=event_id, **out)

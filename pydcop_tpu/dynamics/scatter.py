@@ -1,0 +1,265 @@
+"""Device-side delta application: donated scatter programs.
+
+Before this module the warm engine's ``apply`` edited the HOST planes
+and re-materialized every device argument on the next solve —
+``jnp.asarray`` of the full cubes/var_costs/domain planes per event
+(PERF_NOTES round 12 named it the re-upload tax), plus a host
+round-trip of the full q/r message planes for the touched-row reset.
+Here the instance planes stay **resident on device** and the
+``TopologyDelta`` itself becomes a compiled program:
+
+* the ``(index, rows)`` write lists ``deltas.py`` already produces are
+  padded to the next power of two (by repeating the last entry — a
+  duplicate ``.at[i].set(v)`` carries an identical value, so the
+  padded scatter is value-identical to the unpadded one) and shipped
+  as device arguments;
+* a tiny jitted program — one per (mode, pow2 write-list shape) — does
+  ``.at[idx].set(rows)`` into the resident argument planes AND the
+  touched q/r/selection rows of the carried state, with **buffer
+  donation** so the edit is in place, not a copy;
+* every write VALUE is computed host-side from the post-apply f32
+  planes (the q-row neutral messages, the selection argmins), so the
+  device program is pure scatter and the resident planes stay
+  bit-identical to a full re-upload — the equality guard
+  ``tests/test_dynamics.py`` asserts.
+
+Per-event device upload becomes O(touched rows) — the ``upload_bytes``
+result field the bench asserts on — and per-event cost approaches pure
+execute time.  The pow2 padding bounds the compiled-scatter universe
+at log2(touched) programs per mode, mirroring the dispatcher's batch
+padding.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graphs.arrays import BIG, SENTINEL
+from .deltas import TopologyDelta
+
+__all__ = ["delta_write_lists", "shard_write_lists", "tree_nbytes",
+           "engine_scatter_fn", "sharded_scatter_fn"]
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total array payload bytes across a pytree — the per-event
+    ``upload_bytes`` accounting (host->device transfer volume)."""
+    import jax
+
+    return sum(int(getattr(x, "nbytes", 0)) or 0
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def _pow2_pad(idx: np.ndarray, *rows: np.ndarray):
+    """Pad a write list to the next power of two by REPEATING its last
+    entry; empty lists stay empty (a zero-length scatter is a no-op
+    with its own tiny aval)."""
+    from ..parallel.bucketing import next_pow2
+
+    n = int(idx.shape[0])
+    m = next_pow2(n)
+    if m == n:
+        return (idx,) + rows
+    pad = m - n
+    out = [np.concatenate([idx, np.repeat(idx[-1:], pad, axis=0)])]
+    for r in rows:
+        out.append(np.concatenate([r, np.repeat(r[-1:], pad,
+                                                axis=0)]))
+    return tuple(out)
+
+
+def _touched_values(arrays, delta: TopologyDelta
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """The warm-reset write VALUES, computed host-side from the
+    POST-apply planes exactly like ``_warm_reset_engine`` does: the
+    touched edges' neutral q rows and the touched variables' restart
+    selections.  f32 host arithmetic on both paths == bit-exact."""
+    a = arrays
+    te = delta.touched_edges
+    if len(te):
+        emask = np.asarray(a.domain_mask)[np.asarray(a.edge_var)[te]]
+        q_rows = np.where(emask, 0.0, BIG).astype(np.float32)
+    else:
+        q_rows = np.zeros((0, a.max_domain), dtype=np.float32)
+    sel_vals = np.asarray([
+        int(np.argmin(np.where(
+            a.domain_mask[row],
+            np.asarray(a.var_costs[row], dtype=np.float32),
+            SENTINEL)))
+        for row in delta.touched_vars], dtype=np.int32)
+    return q_rows, sel_vals
+
+
+def delta_write_lists(arrays, delta: TopologyDelta,
+                      with_state: bool = True) -> Dict[str, Any]:
+    """A :class:`TopologyDelta` -> the pow2-padded host write lists one
+    scatter execution consumes (single-chip coordinates).  All values
+    are plain numpy; the caller's AOT call transfers them, which is
+    the WHOLE per-event upload."""
+    w: Dict[str, Any] = {}
+    rows = delta.var_rows.astype(np.int32)
+    rows, mask, costs, dsz = _pow2_pad(
+        rows, delta.domain_mask, delta.var_costs,
+        delta.domain_size)
+    w["var_rows"], w["var_mask"] = rows, mask
+    w["var_costs"], w["var_size"] = costs, dsz
+    buckets: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    for bi in range(len(arrays.buckets)):
+        slots = delta.bucket_slots[bi].astype(np.int32)
+        slots, cubes, vids = _pow2_pad(
+            slots, delta.bucket_cubes[bi], delta.bucket_var_ids[bi])
+        buckets.append((slots, cubes, vids))
+    w["buckets"] = buckets
+    eids, evar = _pow2_pad(delta.edge_ids.astype(np.int32),
+                           delta.edge_var)
+    w["edge_ids"], w["edge_var"] = eids, evar
+    if with_state:
+        q_rows, sel_vals = _touched_values(arrays, delta)
+        te, q_rows = _pow2_pad(delta.touched_edges.astype(np.int32),
+                               q_rows)
+        tv, sel_vals = _pow2_pad(delta.touched_vars.astype(np.int32),
+                                 sel_vals)
+        w["te"], w["q_rows"] = te, q_rows
+        w["tv"], w["sel_vals"] = tv, sel_vals
+    return w
+
+
+def shard_write_lists(arrays, delta: TopologyDelta, tp: int,
+                      edge_map: Tuple[np.ndarray, np.ndarray]
+                      ) -> Dict[str, Any]:
+    """The sharded-carry coordinates of one delta: global edge ids map
+    through the STATIC round-robin partition (``g = f % tp``, local
+    row ``f // tp``; the engine's ``_build_edge_map``), factor slots
+    through the same formula per bucket.  Variable-plane writes stay
+    global (the carry's var planes are replicated)."""
+    g_of, le_of = edge_map
+    w: Dict[str, Any] = {}
+    rows = delta.var_rows.astype(np.int32)
+    rows, mask, costs, dsz = _pow2_pad(
+        rows, delta.domain_mask, delta.var_costs, delta.domain_size)
+    w["var_rows"], w["var_mask"] = rows, mask
+    w["var_costs"], w["var_size"] = costs, dsz
+    buckets = []
+    for bi in range(len(arrays.buckets)):
+        slots = delta.bucket_slots[bi]
+        g = (slots % tp).astype(np.int32)
+        lf = (slots // tp).astype(np.int32)
+        g, lf, cubes = _pow2_pad(g, lf, delta.bucket_cubes[bi])
+        buckets.append((g, lf, cubes))
+    w["buckets"] = buckets
+    eids = delta.edge_ids
+    eg = g_of[eids].astype(np.int32) if len(eids) else \
+        np.zeros(0, dtype=np.int32)
+    ele = le_of[eids].astype(np.int32) if len(eids) else \
+        np.zeros(0, dtype=np.int32)
+    eg, ele, evar = _pow2_pad(eg, ele, delta.edge_var)
+    w["edge_g"], w["edge_le"], w["edge_var"] = eg, ele, evar
+    q_rows, sel_vals = _touched_values(arrays, delta)
+    te = delta.touched_edges
+    tg = g_of[te].astype(np.int32) if len(te) else \
+        np.zeros(0, dtype=np.int32)
+    tle = le_of[te].astype(np.int32) if len(te) else \
+        np.zeros(0, dtype=np.int32)
+    tg, tle, q_rows = _pow2_pad(tg, tle, q_rows)
+    tv, sel_vals = _pow2_pad(delta.touched_vars.astype(np.int32),
+                             sel_vals)
+    w["te_g"], w["te_le"], w["q_rows"] = tg, tle, q_rows
+    w["tv"], w["sel_vals"] = tv, sel_vals
+    return w
+
+
+def engine_scatter_fn(with_state: bool):
+    """The single-chip scatter program body: edits the resident
+    argument planes (and, ``with_state``, the touched rows of the
+    carried q/r/selection) in place via donation.  Shapes of the write
+    lists are static per compiled program; zero-length lists compile
+    to no-ops."""
+    import jax.numpy as jnp
+
+    def scatter_args(args, w):
+        args = dict(args)
+        if w["var_rows"].shape[0]:
+            rows = w["var_rows"]
+            args["var_costs"] = args["var_costs"].at[rows].set(
+                w["var_costs"].astype(args["var_costs"].dtype))
+            args["domain_mask"] = args["domain_mask"].at[rows].set(
+                w["var_mask"])
+            args["domain_size"] = args["domain_size"].at[rows].set(
+                w["var_size"])
+        cubes = list(args["cubes"])
+        vids = list(args["var_ids"])
+        for bi, (slots, bcubes, bvids) in enumerate(w["buckets"]):
+            if slots.shape[0]:
+                cubes[bi] = cubes[bi].at[slots].set(
+                    bcubes.astype(cubes[bi].dtype))
+                vids[bi] = vids[bi].at[slots].set(bvids)
+        args["cubes"], args["var_ids"] = cubes, vids
+        if w["edge_ids"].shape[0]:
+            args["edge_var"] = args["edge_var"].at[
+                w["edge_ids"]].set(w["edge_var"])
+        return args
+
+    if not with_state:
+        return scatter_args
+
+    def scatter(args, state, w):
+        args = scatter_args(args, w)
+        s = dict(state)
+        if w["te"].shape[0]:
+            s["q"] = s["q"].at[w["te"]].set(w["q_rows"])
+            s["r"] = s["r"].at[w["te"]].set(
+                jnp.zeros_like(w["q_rows"]))
+        if w["tv"].shape[0]:
+            s["selection"] = s["selection"].at[w["tv"]].set(
+                w["sel_vals"])
+        # convergence bookkeeping restarts; the carried key and the
+        # untouched q/r rows pass through (donated, so in place)
+        s["cycle"] = jnp.int32(0)
+        s["finished"] = jnp.bool_(False)
+        s["same"] = jnp.int32(0)
+        return args, s
+
+    return scatter
+
+
+def sharded_scatter_fn():
+    """The sharded scatter program body: the delta lands directly in
+    the engine CARRY — the ``c_*`` mesh constants ride the state dict
+    (``DynamicShardedMaxSum``), so editing them here replaces the full
+    ``carry_consts()`` re-``device_put`` of the re-upload path."""
+    import jax.numpy as jnp
+
+    def scatter(state, w):
+        s = dict(state)
+        if w["var_rows"].shape[0]:
+            rows = w["var_rows"]
+            s["c_var_costs"] = s["c_var_costs"].at[rows].set(
+                w["var_costs"].astype(s["c_var_costs"].dtype))
+            s["c_domain_mask"] = s["c_domain_mask"].at[rows].set(
+                w["var_mask"])
+            s["c_domain_size"] = s["c_domain_size"].at[rows].set(
+                w["var_size"])
+        cubes = list(s["c_cubes"])
+        for bi, (g, lf, bcubes) in enumerate(w["buckets"]):
+            if g.shape[0]:
+                cubes[bi] = cubes[bi].at[g, lf].set(
+                    bcubes.astype(cubes[bi].dtype))
+        s["c_cubes"] = cubes
+        if w["edge_g"].shape[0]:
+            s["c_edge_var"] = s["c_edge_var"].at[
+                w["edge_g"], w["edge_le"]].set(w["edge_var"])
+        if w["te_g"].shape[0]:
+            # q/r: (B, TP, E_loc, D); the (t, D) neutral rows
+            # broadcast over the batch axis
+            s["q"] = s["q"].at[:, w["te_g"], w["te_le"]].set(
+                w["q_rows"])
+            s["r"] = s["r"].at[:, w["te_g"], w["te_le"]].set(
+                jnp.zeros_like(w["q_rows"]))
+        if w["tv"].shape[0]:
+            s["sel"] = s["sel"].at[:, w["tv"]].set(w["sel_vals"])
+        s["cycle"] = jnp.int32(0)
+        s["finished"] = jnp.bool_(False)
+        s["same"] = jnp.int32(0)
+        return s
+
+    return scatter

@@ -63,10 +63,18 @@ SCHEMA_VERSION = 1
 #: ``schema_minor`` header stamp itself.  Minor 2 (the ops plane)
 #: added the ``trace`` record kind, the optional ``trace_id``
 #: attribution on summary/serve records, and the heartbeat/stats
-#: ``serve`` fields (``rates``, ``memory``).  A v1.0/1.1 reader stays
-#: green by the one documented forward-compat rule: consumers filter
-#: the stream by the record kinds they speak and ignore the rest.
-SCHEMA_MINOR = 2
+#: ``serve`` fields (``rates``, ``memory``).  Minor 3 (resident-plane
+#: deltas) added the optional ``upload_bytes`` field on summary and
+#: serve records (host->device bytes of one warm dispatch), the
+#: ``apply_s``/``apply_trace_lower_s``/``apply_compile_s`` span names
+#: (the spans vocabulary was already open), the delta-dispatch
+#: ``sessions`` occupancy fields (``size``/``resident_bytes``/
+#: ``budget_bytes``/``evicted_bytes``) and the memory-snapshot
+#: ``sessions_budget_bytes``/``sessions_evicted_bytes`` legs.  A
+#: v1.0/1.1/1.2 reader stays green by the one documented
+#: forward-compat rule: consumers filter the stream by the record
+#: kinds (and fields) they speak and ignore the rest.
+SCHEMA_MINOR = 3
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -291,10 +299,12 @@ def validate_record(rec: Dict[str, Any]):
                     raise ValueError(
                         f"summary edit[{k!r}] must be a "
                         f"non-negative int, got {v!r}")
+        _check_upload_bytes(rec, "summary")
     elif kind == "serve":
         event = rec.get("event")
         if not isinstance(event, str) or not event:
             raise ValueError(f"serve record with bad event {event!r}")
+        _check_upload_bytes(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -331,6 +341,16 @@ def validate_record(rec: Dict[str, Any]):
         if tid is not None and (not isinstance(tid, str) or not tid):
             raise ValueError(
                 f"{kind} record with bad trace_id {tid!r}")
+
+
+def _check_upload_bytes(rec, kind):
+    """Optional ``upload_bytes`` field (schema minor 3): host->device
+    bytes one warm dispatch transferred — non-negative int."""
+    ub = rec.get("upload_bytes")
+    if ub is not None and (isinstance(ub, bool)
+                           or not isinstance(ub, int) or ub < 0):
+        raise ValueError(
+            f"{kind} record with bad upload_bytes {ub!r}")
 
 
 def _check_spans(spans):

@@ -231,6 +231,10 @@ class ServeLoop:
             per_session = sessions.resident_bytes()
             snap["sessions_bytes"] = sum(per_session.values())
             snap["sessions_open"] = len(per_session)
+            snap["sessions_budget_bytes"] = getattr(
+                sessions, "budget_bytes", None)
+            snap["sessions_evicted_bytes"] = sessions.stats.get(
+                "evicted_bytes", 0)
         return snap
 
     def stats_snapshot(self) -> Dict[str, Any]:
@@ -266,7 +270,7 @@ class ServeLoop:
             "runner_cache": runner_cache_stats(),
             "exec_cache": (dict(exec_cache.stats)
                            if exec_cache is not None else None),
-            "sessions": (dict(sessions.stats)
+            "sessions": (sessions.snapshot()
                          if sessions is not None else None),
             "memory": memory,
         }
@@ -610,7 +614,7 @@ class ServeLoop:
                 runner_cache=runner_cache_stats(),
                 exec_cache=(dict(exec_cache.stats)
                             if exec_cache is not None else None),
-                sessions=(dict(self.dispatcher.delta_sessions.stats)
+                sessions=(self.dispatcher.delta_sessions.snapshot()
                           if getattr(self.dispatcher,
                                      "delta_sessions", None)
                           is not None else None),

@@ -28,7 +28,7 @@ batch-form / deserialize / compile / execute) — and every job gets a
 """
 
 import time
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 from ..parallel.batch import runner_for_rung, runner_cache_stats
 from ..parallel.bucketing import next_pow2, rung_label
@@ -66,7 +66,8 @@ def _stage_metrics(registry):
 
 
 class DeltaSessions:
-    """Warm scenario-engine sessions for the ``delta`` job kind.
+    """Warm scenario-engine sessions for the ``delta`` job kind — a
+    **byte-budgeted LRU store**.
 
     A delta job targets a previously admitted maxsum solve job; the
     FIRST delta against a target opens its session — a
@@ -75,25 +76,51 @@ class DeltaSessions:
     so a daemon restart deserializes a known rung instead of
     compiling) — and every further delta applies in place and
     re-solves warm: no retrace, no recompile, telemetry spans free of
-    ``trace_lower_s``/``compile_s``.  FIFO-bounded like the other
-    serving caches."""
+    ``trace_lower_s``/``compile_s``.
 
-    def __init__(self, exec_cache=None, reserve=None, cap: int = 16):
+    Residency policy (``serve --session-budget-mb``): sessions keep
+    their message state and instance planes resident on device, so
+    the store is bounded TWICE — a count cap and a byte budget over
+    the per-session ``resident_bytes`` estimate (the PR 11 memory
+    accounting).  Hits refresh recency; eviction takes the least-
+    recently-used session, counts its resident bytes
+    (``evicted_bytes``) and CLOSES the engine so its device buffers
+    are released.  An evicted target is not lost: the next delta
+    against it reopens through the executable cache — a deserialize,
+    not a compile."""
+
+    def __init__(self, exec_cache=None, reserve=None, cap: int = 16,
+                 budget_bytes: Optional[int] = None,
+                 resident: bool = True):
+        from collections import OrderedDict
+
         self.exec_cache = exec_cache
         self.reserve = reserve
         self.cap = int(cap)
-        self._sessions: Dict[str, Any] = {}
-        self.stats: Dict[str, int] = {"opened": 0, "hits": 0,
-                                      "evictions": 0}
+        #: byte budget over the summed per-session resident_bytes
+        #: (None = count cap only)
+        self.budget_bytes = (int(budget_bytes) if budget_bytes
+                             else None)
+        #: resident-plane delta applies for opened engines (the
+        #: re-upload path is kept selectable for A/B benches)
+        self.resident = bool(resident)
+        self._sessions: "OrderedDict[str, Any]" = OrderedDict()
+        # every counter exists from construction, so /stats and serve
+        # records carry the full key set before the first drop/evict
+        self.stats: Dict[str, int] = {
+            "opened": 0, "hits": 0, "evictions": 0, "dropped": 0,
+            "evicted_bytes": 0}
 
     def get(self, target: str, target_request: Dict[str, Any],
             default_max_cycles: int, default_seed: int,
             default_precision=None):
         """The target's warm engine, opening (and cold-solving) the
-        session on first use.  Returns ``(engine, opened)``."""
+        session on first use; a hit refreshes the target's LRU
+        recency.  Returns ``(engine, opened)``."""
         engine = self._sessions.get(target)
         if engine is not None:
             self.stats["hits"] += 1
+            self._sessions.move_to_end(target)
             return engine, False
         from ..commands import CliError, build_algo_def, \
             parse_algo_params
@@ -123,12 +150,11 @@ class DeltaSessions:
             params=params,
             max_cycles=int(target_request.get("max_cycles",
                                               default_max_cycles)),
-            exec_cache=self.exec_cache)
-        while len(self._sessions) >= self.cap:
-            self._sessions.pop(next(iter(self._sessions)))
-            self.stats["evictions"] += 1
+            exec_cache=self.exec_cache,
+            resident=self.resident)
         self._sessions[target] = engine
         self.stats["opened"] += 1
+        self.enforce()
         return engine, True
 
     def has(self, target: str) -> bool:
@@ -143,11 +169,56 @@ class DeltaSessions:
     def resident_bytes(self) -> Dict[str, int]:
         """Approximate resident bytes per open session (carried
         message state + device planes + host arrays) — the
-        measurement the ROADMAP's byte-budgeted session store
-        consumes, surfaced today as memory gauges and in ``serve``
-        records."""
+        measurement the byte budget weighs, surfaced as memory gauges
+        and in ``serve`` records."""
         return {target: engine.resident_bytes()
                 for target, engine in list(self._sessions.items())}
+
+    def resident_bytes_total(self) -> int:
+        """The summed residency the budget is enforced against."""
+        return sum(self.resident_bytes().values())
+
+    def enforce(self) -> int:
+        """Apply the count cap, then the byte budget: least-recently-
+        used sessions are evicted (engine CLOSED, device buffers
+        released, resident bytes counted as ``evicted_bytes``) until
+        both hold.  Called after every open and after every delta
+        dispatch — session state grows with the first solve, so the
+        budget must be re-checked when the bytes are real, not just
+        at admission.  Returns the number of sessions evicted."""
+        evicted = 0
+        while len(self._sessions) > self.cap:
+            self._evict()
+            evicted += 1
+        if self.budget_bytes is not None:
+            # one full residency walk, then subtract what each
+            # eviction released — evicting k of n sessions must not
+            # cost k+1 walks of every engine's object graph
+            total = self.resident_bytes_total()
+            while self._sessions and total > self.budget_bytes:
+                total -= self._evict()
+                evicted += 1
+        return evicted
+
+    def _evict(self) -> int:
+        """Evict the LRU session; returns its resident bytes."""
+        target, engine = self._sessions.popitem(last=False)
+        freed = int(engine.resident_bytes())
+        self.stats["evictions"] += 1
+        self.stats["evicted_bytes"] += freed
+        # drop-style close: the device buffers are released NOW, not
+        # when the garbage collector gets around to the engine
+        engine.close()
+        return freed
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Counters plus live occupancy for serve records: size, the
+        resident-byte gauge and the configured budget ride along so a
+        dispatch record proves the budget held at that point."""
+        return dict(self.stats, size=len(self._sessions),
+                    cap=self.cap,
+                    resident_bytes=self.resident_bytes_total(),
+                    budget_bytes=self.budget_bytes)
 
     def drop(self, target: str):
         """Close a session whose state can no longer be trusted (a
@@ -155,8 +226,10 @@ class DeltaSessions:
         against the target reopens from the target's base instance —
         well-defined recovery instead of a silently divergent or
         half-open session."""
-        if self._sessions.pop(target, None) is not None:
-            self.stats["dropped"] = self.stats.get("dropped", 0) + 1
+        engine = self._sessions.pop(target, None)
+        if engine is not None:
+            self.stats["dropped"] += 1
+            engine.close()
 
 
 class Dispatcher:
@@ -165,7 +238,9 @@ class Dispatcher:
     def __init__(self, reporter=None, exec_cache=None,
                  clock: Callable[[], float] = time.monotonic,
                  batch_pow2: bool = True, reserve=None,
-                 registry=None):
+                 registry=None, session_cap: int = 16,
+                 session_budget_bytes: Optional[int] = None,
+                 resident_deltas: bool = True):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
@@ -177,9 +252,12 @@ class Dispatcher:
                                       "deltas": 0}
         #: spans of the most recent dispatch (tests read this)
         self.last_spans: Dict[str, float] = {}
-        #: warm scenario sessions for delta jobs (lazy per target)
-        self.delta_sessions = DeltaSessions(exec_cache=exec_cache,
-                                            reserve=reserve)
+        #: warm scenario sessions for delta jobs (lazy per target),
+        #: LRU-bounded by count AND resident bytes
+        self.delta_sessions = DeltaSessions(
+            exec_cache=exec_cache, reserve=reserve, cap=session_cap,
+            budget_bytes=session_budget_bytes,
+            resident=resident_deltas)
 
     # --------------------------------------------------- registry feed
 
@@ -362,6 +440,9 @@ class Dispatcher:
                 f"reopens it from the base instance") from e
         elapsed = self.clock() - t0
         self.last_spans = dict(engine.last_spans)
+        # the budget holds AFTER every dispatch: the solve just grew
+        # the session's carried state, so the bytes are real now
+        self.delta_sessions.enforce()
         rec = {
             "job_id": request["id"],
             "algo": "maxsum",
@@ -375,6 +456,8 @@ class Dispatcher:
             "dispatch_reason": "delta",
             "warm_start": res["warm_start"],
         }
+        if res.get("upload_bytes") is not None:
+            rec["upload_bytes"] = int(res["upload_bytes"])
         if res.get("edit"):
             rec["edit"] = res["edit"]
         if trace_id:
@@ -400,11 +483,15 @@ class Dispatcher:
                 event="dispatch", reason="delta",
                 rung=list(engine.rung.signature), batch=1,
                 queue_depth=int(queue_depth),
+                target=request["target"],
                 session_opened=bool(opened),
                 open_spans=open_spans,
                 reserve=res["budget"],
+                upload_bytes=int(res.get("upload_bytes") or 0),
                 spans=dict(engine.last_spans),
                 exec_cache=(dict(self.exec_cache.stats)
                             if self.exec_cache is not None else None),
-                sessions=dict(self.delta_sessions.stats))
+                # the snapshot (counters + size/resident/budget)
+                # proves the byte budget held after THIS dispatch
+                sessions=self.delta_sessions.snapshot())
         return rec

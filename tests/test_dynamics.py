@@ -331,6 +331,98 @@ def test_warm_apply_equals_cold_solve_sharded():
     assert warm2["cost"] == pytest.approx(cold2.cost)
 
 
+# --------------------------- resident scatter == re-upload (ISSUE 12)
+
+
+#: one event per event TYPE, in a sequence that exercises them all
+#: against live state (the add must precede the remove)
+RESIDENT_EVENTS = [
+    [{"type": "change_costs", "name": "c2", "costs": NEW_COSTS}],
+    [{"type": "add_variable", "name": "v6", "values": [0, 1, 2]},
+     {"type": "add_constraint", "name": "c_new",
+      "scope": ["v5", "v6"], "costs": ADD_COSTS}],
+    [{"type": "change_costs", "name": "c_new",
+      "costs": (np.arange(9).reshape(3, 3) % 7).tolist()}],
+    [{"type": "remove_constraint", "name": "c_new"},
+     {"type": "remove_variable", "name": "v6"}],
+]
+
+
+def _assert_resident_equals_reupload(mode):
+    """The ISSUE 12 guard: the resident-scatter apply produces
+    selections AND convergence cycles identical to the host-plane
+    re-upload path for EVERY event type, under the carried-message
+    default.  Also pins the telemetry split: the resident leg's
+    per-event ``upload_bytes`` is a tiny fraction of the re-upload
+    leg's, and ``apply_s`` rides the spans."""
+    res = DynamicEngine(chain_dcop(), mode=mode,
+                        reserve="vars:4,2:4")
+    reup = DynamicEngine(chain_dcop(), mode=mode,
+                         reserve="vars:4,2:4", resident=False)
+    assert res.resident and not reup.resident
+    a, b = res.solve(max_cycles=500), reup.solve(max_cycles=500)
+    assert a["assignment"] == b["assignment"]
+    assert a["cycle"] == b["cycle"]
+    for event in RESIDENT_EVENTS:
+        res.apply(event)
+        reup.apply(event)
+        a = res.solve(max_cycles=500)
+        b = reup.solve(max_cycles=500)
+        assert a["assignment"] == b["assignment"], event
+        assert a["cost"] == pytest.approx(b["cost"])
+        assert a["cycle"] == b["cycle"], event
+        # warm on both paths: the solve executable never re-traces
+        # (the scatter's own one-off compiles ride the distinct
+        # apply_* span names)
+        assert_warm_spans(a["spans"])
+        assert_warm_spans(b["spans"])
+        assert "apply_s" in a["spans"]
+        # the tentpole's measurable: O(touched rows) per event, not
+        # O(instance) — on this tiny chain already >= 10x apart
+        assert a["upload_bytes"] * 10 <= b["upload_bytes"], (
+            a["upload_bytes"], b["upload_bytes"])
+
+
+def test_resident_scatter_equals_reupload_single_chip():
+    _assert_resident_equals_reupload("engine")
+
+
+@pytest.mark.mesh
+def test_resident_scatter_equals_reupload_sharded():
+    _assert_resident_equals_reupload("sharded")
+
+
+def test_resident_close_releases_and_reopens():
+    """close() (the session store's eviction hook) drops the device
+    residency; the engine stays usable and a later solve re-uploads
+    from the authoritative host planes with identical results."""
+    eng = DynamicEngine(chain_dcop(), reserve="2:4")
+    r1 = eng.solve(max_cycles=500)
+    assert eng.resident_bytes() > 0
+    baseline = eng.resident_bytes()
+    eng.close()
+    assert eng._state is None and eng._args_dev is None
+    assert eng.resident_bytes() < baseline
+    r2 = eng.solve(max_cycles=500)
+    assert r2["assignment"] == r1["assignment"]
+    eng.apply([{"type": "change_costs", "name": "c2",
+                "costs": NEW_COSTS}])
+    r3 = eng.solve(max_cycles=500)
+    assert r3["warm_start"] and "apply_s" in r3["spans"]
+
+
+def test_upload_bytes_reported_on_every_solve():
+    """Cold solves report the full materialization; resident warm
+    solves report only the delta write lists."""
+    eng = DynamicEngine(chain_dcop(), reserve="2:4")
+    r0 = eng.solve(max_cycles=500)
+    assert r0["upload_bytes"] > 0
+    eng.apply([{"type": "change_costs", "name": "c0",
+                "costs": NEW_COSTS}])
+    r1 = eng.solve(max_cycles=500)
+    assert 0 < r1["upload_bytes"] < r0["upload_bytes"]
+
+
 SCEN_YAML = """
 events:
   - id: w1

@@ -1,0 +1,284 @@
+"""The byte-budgeted LRU delta-session store (ISSUE 12).
+
+Layers under test:
+
+* ``serving/dispatcher.py DeltaSessions`` — LRU recency refresh on
+  hit, count-cap and byte-budget eviction (drop-style close: device
+  buffers released, evicted bytes counted), counters initialized at
+  construction;
+* the dispatch integration — the budget holds AFTER every delta
+  dispatch (session state grows with the solve), a delta against an
+  evicted target reopens WARM through the executable cache
+  (deserialize, no compile span);
+* the serve loop surface — ``--session-budget-mb`` plumbing, the
+  ``sessions`` snapshot on dispatch records, the memory-accounting
+  legs (``sessions_budget_bytes``/``sessions_evicted_bytes``);
+* ``benchmarks/suite.py bench_serve_dynamic`` — the quick leg runs
+  in-process and its serve JSONL validates through the
+  ``pydcop telemetry-validate`` CLI (the CI teeth of the schema
+  contract).
+"""
+
+import json
+import os
+
+import pytest
+
+from pydcop_tpu.serving.dispatcher import DeltaSessions, Dispatcher
+
+pytestmark = [pytest.mark.serve, pytest.mark.dyn]
+
+
+def _instance_yaml(tmp_path, n_vars=4, tag="dyn"):
+    lines = [f"name: {tag}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(n_vars):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k in range(n_vars - 1):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {4 + k} if v{k} == v{k + 1} else 0}}")
+    lines.append("agents: [" +
+                 ", ".join(f"a{i}" for i in range(n_vars)) + "]")
+    p = tmp_path / f"{tag}.yaml"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _target_request(path):
+    return {"id": "j", "dcop": path, "algo": "maxsum",
+            "max_cycles": 200}
+
+
+def _delta(target, ident="d0"):
+    return {"op": "delta", "id": ident, "target": target,
+            "actions": [{"type": "change_costs", "name": "c0",
+                         "costs": [[0, 5, 9], [5, 0, 1],
+                                   [9, 1, 0]]}]}
+
+
+# ------------------------------------------------------ store policy
+
+
+def test_stats_initialized_at_construction():
+    """The satellite: every counter — ``dropped`` included — exists
+    from construction, so /stats and serve records always carry the
+    full key set instead of keys appearing after the first event."""
+    sessions = DeltaSessions()
+    assert sessions.stats == {"opened": 0, "hits": 0, "evictions": 0,
+                              "dropped": 0, "evicted_bytes": 0}
+    snap = sessions.snapshot()
+    assert snap["size"] == 0 and snap["resident_bytes"] == 0
+    assert snap["budget_bytes"] is None and snap["cap"] == 16
+
+
+def test_lru_refresh_on_hit(tmp_path):
+    """A hit moves the session to most-recently-used: with cap=2,
+    touching A before opening C must evict B, not A."""
+    sessions = DeltaSessions(cap=2)
+    reqs = {t: _target_request(_instance_yaml(tmp_path, tag=t))
+            for t in ("A", "B", "C")}
+    for t in ("A", "B"):
+        _engine, opened = sessions.get(t, reqs[t], 200, 0)
+        assert opened
+    engine_a, opened = sessions.get("A", reqs["A"], 200, 0)  # refresh
+    assert not opened and sessions.stats["hits"] == 1
+    sessions.get("C", reqs["C"], 200, 0)
+    assert sessions.has("A") and sessions.has("C")
+    assert not sessions.has("B")
+    assert sessions.stats["evictions"] == 1
+    assert len(sessions) == 2
+
+
+def test_byte_budget_evicts_lru_and_counts_bytes(tmp_path):
+    """Byte pressure mid-stream: once the summed resident estimate
+    crosses the budget, LRU sessions are closed (buffers released)
+    and their bytes counted as ``evicted_bytes``."""
+    sessions = DeltaSessions()
+    reqs = {t: _target_request(_instance_yaml(tmp_path, tag=t))
+            for t in ("A", "B", "C")}
+    engine_a, _ = sessions.get("A", reqs["A"], 200, 0)
+    engine_a.solve()
+    per_session = engine_a.resident_bytes()
+    assert per_session > 0
+    # room for about two solved sessions, not three
+    sessions.budget_bytes = int(2.2 * per_session)
+    engine_b, _ = sessions.get("B", reqs["B"], 200, 0)
+    engine_b.solve()
+    assert sessions.enforce() == 0          # two fit
+    engine_c, _ = sessions.get("C", reqs["C"], 200, 0)
+    engine_c.solve()
+    sessions.enforce()                      # three do not
+    assert sessions.stats["evictions"] >= 1
+    assert not sessions.has("A")            # LRU went first
+    assert sessions.has("C")
+    assert sessions.stats["evicted_bytes"] >= per_session // 2
+    assert sessions.resident_bytes_total() <= sessions.budget_bytes
+    # drop-style close: the evicted engine released its residency
+    assert engine_a._state is None and engine_a._args_dev is None
+
+
+def test_drop_closes_engine_and_counts(tmp_path):
+    sessions = DeltaSessions()
+    req = _target_request(_instance_yaml(tmp_path))
+    engine, _ = sessions.get("A", req, 200, 0)
+    engine.solve()
+    sessions.drop("A")
+    assert sessions.stats["dropped"] == 1
+    assert engine._state is None
+    sessions.drop("A")                      # absent: no double count
+    assert sessions.stats["dropped"] == 1
+
+
+# ----------------------------------------- dispatch-level integration
+
+
+def test_budget_enforced_after_dispatch_and_warm_reopen(tmp_path):
+    """The acceptance path: a delta dispatch that grows a session
+    past the budget evicts at dispatch end; a delta against the
+    evicted target reopens WARM via the executable cache — the
+    reopening dispatch's open spans show a deserialize, never a
+    compile."""
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    if not cache.enabled:
+        pytest.skip("executable cache unavailable")
+    path_a = _instance_yaml(tmp_path, tag="A")
+    path_b = _instance_yaml(tmp_path, tag="B")
+    records = []
+
+    class Rep:
+        def summary(self, **kw):
+            records.append(dict(kw, record="summary"))
+
+        def serve(self, **kw):
+            records.append(dict(kw, record="serve"))
+
+        def trace(self, *a, **kw):
+            pass
+
+    disp = Dispatcher(reporter=Rep(), exec_cache=cache)
+    disp.dispatch_delta(_delta("jA", "d1"), _target_request(path_a))
+    per_session = disp.delta_sessions.resident_bytes_total()
+    # budget admits ONE solved session; opening the second must evict
+    # the first at dispatch end
+    disp.delta_sessions.budget_bytes = int(1.5 * per_session)
+    disp.dispatch_delta(_delta("jB", "d2"), _target_request(path_b))
+    assert disp.delta_sessions.has("jB")
+    assert not disp.delta_sessions.has("jA")
+    assert disp.delta_sessions.stats["evictions"] >= 1
+    assert disp.delta_sessions.resident_bytes_total() <= \
+        disp.delta_sessions.budget_bytes
+    # the evicted target reopens warm: deserialize, no compile
+    disp.dispatch_delta(_delta("jA", "d3"), _target_request(path_a))
+    reopen = [r for r in records if r.get("record") == "serve"
+              and r.get("reason") == "delta"][-1]
+    assert reopen["session_opened"] is True
+    assert "deserialize_s" in reopen["open_spans"]
+    assert "compile_s" not in reopen["open_spans"]
+    # every dispatch record proves the budget held at its point
+    for rec in records:
+        if rec.get("record") == "serve" and "sessions" in rec:
+            s = rec["sessions"]
+            if s["budget_bytes"] is not None:
+                assert s["resident_bytes"] <= s["budget_bytes"]
+    # and the summary records carry the upload split
+    warm = [r for r in records if r.get("record") == "summary"
+            and r.get("warm_start")]
+    assert warm and all(r.get("upload_bytes", 0) >= 0 for r in warm)
+
+
+def test_serve_loop_budget_surface(tmp_path):
+    """End-to-end through the loop: dispatch records snapshot the
+    store (size/resident/budget), the memory accounting grows the
+    budget and evicted legs, and telemetry-validate stays green."""
+    from pydcop_tpu.dcop_cli import main as cli_main
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+    from pydcop_tpu.serving.daemon import ServeLoop
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    dcop_file = _instance_yaml(tmp_path)
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(
+        AdmissionQueue(max_batch=2, max_delay_s=0.01),
+        Dispatcher(reporter=reporter,
+                   session_budget_bytes=64 * 1024 * 1024),
+        reporter=reporter, default_max_cycles=200)
+    lines = [
+        json.dumps({"id": "j1", "dcop": dcop_file, "algo": "maxsum",
+                    "max_cycles": 200}),
+        json.dumps(_delta("j1", "d1")),
+        json.dumps(_delta("j1", "d2")),
+    ]
+    stats = loop.run_oneshot(lines)
+    reporter.close()
+    assert stats["completed"] == 3
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    assert cli_main(["telemetry-validate", out, "--quiet"]) == 0
+    deltas = [r for r in records if r.get("record") == "serve"
+              and r.get("reason") == "delta"]
+    assert len(deltas) == 2
+    for rec in deltas:
+        s = rec["sessions"]
+        assert s["budget_bytes"] == 64 * 1024 * 1024
+        assert 0 < s["resident_bytes"] <= s["budget_bytes"]
+        assert s["size"] == 1
+        assert "upload_bytes" in rec
+    final = records[-1]
+    assert final["record"] == "serve"
+    mem = final["memory"]
+    assert mem["sessions_budget_bytes"] == 64 * 1024 * 1024
+    assert mem["sessions_evicted_bytes"] == 0
+    assert final["sessions"]["evicted_bytes"] == 0
+    assert final["sessions"]["dropped"] == 0   # key present unfired
+
+
+def test_serve_cli_session_budget_flag_validation(capsys):
+    """A malformed budget/cap kills the daemon at startup with a
+    structured error, never mid-dispatch."""
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    assert cli_main(["serve", "--oneshot", "nope.jsonl",
+                     "--session-budget-mb", "-1"]) == 2
+    assert "session-budget-mb" in capsys.readouterr().err
+    assert cli_main(["serve", "--oneshot", "nope.jsonl",
+                     "--session-cap", "0"]) == 2
+    assert "session-cap" in capsys.readouterr().err
+
+
+# -------------------------------------- bench wiring (CI, ISSUE 12)
+
+
+def test_bench_serve_dynamic_quick_validates(tmp_path):
+    """The test-tier leg of ``bench_serve_dynamic``: the quick bench
+    runs in-process (budget respected after every dispatch, warm
+    spans clean, evictions + cache reopens observed — the bench
+    raises on any violated contract) and its serve JSONL output
+    validates through the ``pydcop telemetry-validate`` CLI."""
+    import importlib.util
+
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pydcop_bench_suite",
+        os.path.join(repo, "benchmarks", "suite.py"))
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+    result = suite.bench_serve_dynamic(quick=True,
+                                       out_dir=str(tmp_path))
+    assert result["contracts_asserted"]
+    value = result["value"]
+    assert value["upload_reduction"] >= 10
+    for leg in ("resident", "reupload"):
+        assert value[leg]["evictions"] >= 1
+        out = value[leg]["out"]
+        assert os.path.exists(out)
+        assert cli_main(["telemetry-validate", out, "--quiet"]) == 0

@@ -394,15 +394,36 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_2_and_v1_readers_stay_green():
+def test_schema_minor_is_3_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 2
-    # a minor-0 header (pre-dynamics emitter) still validates: the
-    # major gate is the only compatibility wall
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 3
+    # the frozen-reader assertions: headers stamped by EVERY earlier
+    # minor (and minor-0 pre-dynamics emitters with no stamp at all)
+    # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
+    for minor in (1, 2, 3):
+        validate_record({"record": "header", "schema": 1,
+                         "schema_minor": minor, "algo": "a",
+                         "mode": "engine"})
+    # minor-3 additive fields: optional, typed — a record without
+    # them (any v1.x emitter) and one with them both pass
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "warm_start": True,
+                     "upload_bytes": 320})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "dispatch", "upload_bytes": 0,
+                     "sessions": {"opened": 1, "resident_bytes": 99,
+                                  "budget_bytes": None}})
+    with pytest.raises(ValueError, match="upload_bytes"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "upload_bytes": -1})
+    with pytest.raises(ValueError, match="upload_bytes"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch",
+                         "upload_bytes": "many"})
 
 
 # ----------------------------------------- reporter lifecycle (ops)
