@@ -154,6 +154,38 @@ def set_parser(subparsers):
                              "(queue depth, per-second rates, memory "
                              "accounting) every SECONDS to --out; "
                              "default: no heartbeats")
+    parser.add_argument("--fault-plan", dest="fault_plan",
+                        type=str, default=None, metavar="FILE",
+                        help="inject faults from this JSON plan "
+                             "(serving/faults.py: seeded rate + "
+                             "explicit schedule over compile_error / "
+                             "execute_error / execute_hang / "
+                             "cache_corrupt / nan_planes) — the "
+                             "deterministic chaos harness.  Absent "
+                             "(the default), every injection hook is "
+                             "dead code and dispatch behavior is "
+                             "byte-identical")
+    parser.add_argument("--session-journal", dest="session_journal",
+                        type=str, default=None, metavar="DIR",
+                        help="journal every warm delta session to "
+                             "this directory (append-only fsync'd "
+                             "JSONL: base job + each answered "
+                             "delta); after a daemon CRASH, a delta "
+                             "against a journaled target rebuilds "
+                             "the warm engine bit-exactly by "
+                             "replaying through the executable "
+                             "cache.  Clean shutdown and eviction "
+                             "truncate the journal.  Default: no "
+                             "journaling")
+    parser.add_argument("--execute-deadline-s",
+                        dest="execute_deadline_s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="wall-clock watchdog over each "
+                             "dispatch's device span: a dispatch "
+                             "exceeding it FAILS (then retries / "
+                             "bisects / sheds like any failure) "
+                             "instead of freezing the daemon behind "
+                             "a hang.  Default: no deadline")
     parser.add_argument("--no-metrics", dest="no_metrics",
                         action="store_true",
                         help="disable the in-process metrics registry "
@@ -206,9 +238,36 @@ def run_cmd(args, timeout=None):
     except ValueError as e:
         raise CliError(str(e))
 
+    execute_deadline_s = getattr(args, "execute_deadline_s", None)
+    if execute_deadline_s is not None and execute_deadline_s <= 0:
+        raise CliError("--execute-deadline-s must be > 0")
+    faults = None
+    fault_plan = getattr(args, "fault_plan", None)
+    if fault_plan:
+        from ..serving.faults import FaultPlan
+
+        try:
+            # a malformed plan kills the daemon at startup with the
+            # offending field, never mid-dispatch
+            faults = FaultPlan.load(fault_plan)
+        except ValueError as e:
+            raise CliError(str(e))
+    journal = None
+    session_journal = getattr(args, "session_journal", None)
+    if session_journal:
+        from ..dynamics.journal import JournalStore
+
+        try:
+            journal = JournalStore(session_journal)
+        except OSError as e:
+            raise CliError(
+                f"--session-journal directory unusable: {e}")
+
     exec_cache = None
     if not args.no_exec_cache:
         exec_cache = ExecutableCache(path=args.exec_cache)
+        if faults is not None:
+            exec_cache.faults = faults
 
     registry = None
     if not getattr(args, "no_metrics", False):
@@ -229,6 +288,9 @@ def run_cmd(args, timeout=None):
             exec_cache=(exec_cache.path
                         if exec_cache is not None
                         and exec_cache.enabled else None),
+            fault_plan=fault_plan,
+            session_journal=session_journal,
+            execute_deadline_s=execute_deadline_s,
             source=("oneshot" if args.oneshot
                     else "socket" if args.socket else "stdin"))
         admission = AdmissionQueue(
@@ -238,14 +300,17 @@ def run_cmd(args, timeout=None):
             reporter=reporter, exec_cache=exec_cache,
             reserve=reserve, registry=registry,
             session_cap=session_cap,
-            session_budget_bytes=session_budget_bytes)
+            session_budget_bytes=session_budget_bytes,
+            faults=faults, execute_deadline_s=execute_deadline_s,
+            journal=journal)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,
                          default_precision=args.precision,
                          reserve=reserve,
                          registry=registry,
-                         heartbeat_s=heartbeat_s)
+                         heartbeat_s=heartbeat_s,
+                         faults=faults)
         if metrics_port is not None:
             from ..observability.registry import MetricsHTTPServer
 

@@ -12,11 +12,13 @@ state carried over for everything the edit did not touch.  See
 from .deltas import (DeltaError, DynamicInstance, TopologyDelta,
                      build_dynamic_instance)
 from .engine import DynamicEngine, eval_cost_violations_np
+from .journal import JournalError, JournalStore, SessionJournal
 from .replay import replay_batched, replay_scenario, \
     scenario_descendants
 
 __all__ = [
     "DeltaError", "DynamicEngine", "DynamicInstance",
+    "JournalError", "JournalStore", "SessionJournal",
     "TopologyDelta", "build_dynamic_instance",
     "eval_cost_violations_np", "replay_batched", "replay_scenario",
     "scenario_descendants",

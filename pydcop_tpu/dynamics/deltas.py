@@ -127,6 +127,15 @@ def _padded_cost_cube(costs, dsizes: Sequence[int], D: int,
             f"constraint {name!r} costs have {cube.size} entries, "
             f"scope domains want {expect}", kind="bad_costs",
             name=name, expected_shape=list(expect))
+    nan = int(np.isnan(cube).sum())
+    if nan:
+        # same poison the build-time CostPlaneError guards: NaN would
+        # launder to cost 0 in _clip_costs and silently corrupt the
+        # warm session's planes
+        raise DeltaError(
+            f"constraint {name!r} costs carry {nan} NaN value(s); "
+            f"use inf for hard constraints, finite costs otherwise",
+            kind="bad_costs", name=name, nan_count=nan)
     cube = _clip_costs(cube.reshape(expect), sign)
     pads = [(0, D - s) for s in expect]
     return np.pad(cube, pads, constant_values=BIG)
@@ -361,12 +370,18 @@ class DynamicInstance:
                         kind="var_budget", name=name,
                         n_var_rows=int(a.n_vars),
                         live=len(live_vars), free=0)
+                raw = np.asarray(costs, dtype=np.float32)
+                if int(np.isnan(raw).sum()):
+                    raise DeltaError(
+                        f"add_variable {name!r}: unary costs carry "
+                        f"NaN; use inf for hard constraints, finite "
+                        f"costs otherwise", kind="bad_costs",
+                        name=name)
                 row = free_rows.pop(0)
                 mask = np.zeros(D, dtype=bool)
                 mask[:d] = True
                 plane = np.full(D, BIG, dtype=np.float32)
-                plane[:d] = _clip_costs(
-                    np.asarray(costs, dtype=np.float32), sign)
+                plane[:d] = _clip_costs(raw, sign)
                 var_writes[row] = (True, d, mask, plane)
                 live_vars[name] = row
                 dsize[row] = d

@@ -1,0 +1,178 @@
+"""Crash-recoverable warm sessions: the per-session delta journal.
+
+A warm :class:`~pydcop_tpu.dynamics.engine.DynamicEngine` session is
+pure derived state — the base request, the base-solve seed, and the
+ordered list of applied deltas determine the carried message planes
+exactly (every solve is deterministic given its inputs).  So crash
+recovery is the paper's repair protocol reborn as *replay through the
+executable cache*: journal those inputs durably, and a restarted
+daemon rebuilds any journaled session bit-exactly — deserialize the
+rung's cached executable (no compile), re-run the base solve, re-apply
+and re-solve every journaled delta.  The replayed engine's next answer
+is identical, selections AND convergence cycles, to the engine that
+never crashed (asserted in tests/test_faults.py).
+
+Durability contract (``serve --session-journal DIR``):
+
+* one append-only JSONL file per session, named by the sha256 of the
+  target id (client-chosen ids are not filesystem-safe; the target is
+  recorded inside the file);
+* the ``base`` record is appended after the base solve SUCCEEDS, each
+  ``delta`` record after its warm re-solve succeeds — the journal
+  holds exactly the state clients have seen answers for, so a crash
+  mid-solve replays to the last answered state and a client retry is
+  not a double-apply;
+* every append is flushed + ``fsync``'d before the record counts as
+  journaled;
+* clean close and eviction TRUNCATE (remove) the file: recovery is
+  for crashes, and an evicted/dropped session's documented contract
+  (reopen from the base instance) stays unchanged.
+"""
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class JournalError(ValueError):
+    """A journal file that cannot be replayed (truncated mid-append,
+    hand-edited, version drift).  Recovery treats it as absent —
+    rejecting the delta with a structured reason beats replaying a
+    half-written state."""
+
+
+def _file_name(target: str) -> str:
+    return hashlib.sha256(target.encode()).hexdigest() + ".journal.jsonl"
+
+
+class SessionJournal:
+    """One open session's append handle (created via
+    :class:`JournalStore`)."""
+
+    def __init__(self, path: str, target: str):
+        self.path = path
+        self.target = target
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _append(self, record: Dict[str, Any]):
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def record_base(self, request: Dict[str, Any], seed: int,
+                    max_cycles: int):
+        """The session's base solve — appended AFTER it succeeded."""
+        self._append({"kind": "base", "target": self.target,
+                      "request": request, "seed": int(seed),
+                      "max_cycles": int(max_cycles)})
+
+    def record_delta(self, actions: List[Dict[str, Any]],
+                     max_cycles: Optional[int]):
+        """One answered delta — appended AFTER its warm re-solve
+        succeeded."""
+        self._append({"kind": "delta", "actions": actions,
+                      "max_cycles": max_cycles})
+
+    def close(self, truncate: bool):
+        """``truncate=True`` (clean close / eviction / drop) removes
+        the file — the session ended in a well-defined way and must
+        not be replayed; ``False`` just releases the handle."""
+        try:
+            self._f.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+        if truncate:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+class JournalStore:
+    """The journal directory: open/inspect/load per-target session
+    journals.  One store per daemon; absent (``None`` everywhere it
+    threads) the serving stack journals nothing and behaves exactly
+    as before."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, target: str) -> str:
+        return os.path.join(self.directory, _file_name(target))
+
+    def open(self, target: str) -> SessionJournal:
+        return SessionJournal(self._path(target), target)
+
+    def journaled(self, target: str) -> bool:
+        """Whether a non-empty journal exists for ``target`` — the
+        restart-recovery gate the serve daemon consults alongside its
+        (empty, post-restart) admitted-request index."""
+        path = self._path(target)
+        try:
+            return os.path.getsize(path) > 0
+        except OSError:
+            return False
+
+    def discard(self, target: str):
+        """Remove a target's journal without an open handle (recovery
+        failed and the file must not poison the next attempt)."""
+        try:
+            os.remove(self._path(target))
+        except OSError:
+            pass
+
+    def load(self, target: str
+             ) -> Tuple[Dict[str, Any], int, int,
+                        List[Dict[str, Any]]]:
+        """Parse a target's journal: ``(base_request, base_seed,
+        base_max_cycles, delta_entries)``.  Raises
+        :class:`JournalError` on a file that cannot be replayed; a
+        trailing torn line (crash mid-append) is DROPPED, not fatal —
+        its record never counted as journaled."""
+        path = self._path(target)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            raise JournalError(
+                f"no replayable journal for target {target!r}: {e}")
+        records = []
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    break           # torn tail: crash mid-append
+                raise JournalError(
+                    f"journal for {target!r} corrupt at line "
+                    f"{i + 1} (not the tail; refusing to replay a "
+                    f"hole)")
+        if not records or records[0].get("kind") != "base":
+            raise JournalError(
+                f"journal for {target!r} has no base record; "
+                f"cannot replay")
+        base = records[0]
+        if base.get("target") != target:
+            raise JournalError(
+                f"journal names target {base.get('target')!r}, "
+                f"expected {target!r}")
+        request = base.get("request")
+        if not isinstance(request, dict):
+            raise JournalError(
+                f"journal base record for {target!r} carries no "
+                f"request")
+        deltas = []
+        for rec in records[1:]:
+            if rec.get("kind") != "delta" \
+                    or not isinstance(rec.get("actions"), list):
+                raise JournalError(
+                    f"journal for {target!r} carries a malformed "
+                    f"delta record")
+            deltas.append(rec)
+        return (request, int(base.get("seed", 0)),
+                int(base.get("max_cycles", 0)) or 0, deltas)

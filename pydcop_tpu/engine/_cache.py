@@ -117,7 +117,13 @@ class ExecutableCache:
             enabled = not cache_disabled()
         self.enabled = bool(enabled)
         self.stats: Dict[str, int] = {
-            "hits": 0, "misses": 0, "stores": 0, "errors": 0}
+            "hits": 0, "misses": 0, "stores": 0, "errors": 0,
+            "corrupt": 0}
+        #: optional fault plan (serving/faults.FaultPlan): the
+        #: ``cache_corrupt`` chaos point genuinely garbles the on-disk
+        #: entry before the read, so the quarantine path below is
+        #: exercised end-to-end.  None (the default) = dead code
+        self.faults = None
         self._warned = False
         if self.enabled:
             try:
@@ -149,11 +155,25 @@ class ExecutableCache:
 
     def load(self, key: Tuple) -> Optional[Any]:
         """The deserialized executable for ``key``, or None on a miss.
-        Any failure (corrupt file, incompatible jaxlib) counts as a
-        miss so callers always have the recompile fallback."""
+        A corrupt entry (torn write survived a crash, disk bit-rot,
+        the ``cache_corrupt`` chaos point) is QUARANTINED, not merely
+        missed: the file is moved aside to ``*.corrupt`` so every
+        later start pays one recompile instead of re-reading the same
+        garbage forever, the ``corrupt`` counter increments (surfaced
+        as ``pydcop_cache_corrupt_total``), and the caller recompiles
+        — correctness never depends on the cache."""
         if not self.enabled:
             return None
         path = self._file_for(key)
+        if self.faults is not None and os.path.exists(path):
+            try:
+                self.faults.check("cache_corrupt",
+                                  job_ids=(os.path.basename(path),))
+            except Exception:
+                # garble in place: the REAL read/quarantine machinery
+                # below must handle it, not a simulated branch
+                with open(path, "wb") as f:
+                    f.write(b"\x00chaos: injected cache corruption")
         try:
             with open(path, "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
@@ -161,9 +181,7 @@ class ExecutableCache:
             self.stats["misses"] += 1
             return None
         except Exception as e:
-            self.stats["errors"] += 1
-            self.stats["misses"] += 1
-            self._warn_once(f"failed to read {path}: {e}")
+            self._quarantine(path, f"failed to read {path}: {e}")
             return None
         try:
             from jax.experimental import serialize_executable
@@ -171,12 +189,26 @@ class ExecutableCache:
             loaded = serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree)
         except Exception as e:
-            self.stats["errors"] += 1
-            self.stats["misses"] += 1
-            self._warn_once(f"failed to deserialize {path}: {e}")
+            self._quarantine(path,
+                             f"failed to deserialize {path}: {e}")
             return None
         self.stats["hits"] += 1
         return loaded
+
+    def _quarantine(self, path: str, msg: str):
+        """Move a corrupt entry aside (``*.corrupt``; replaced if a
+        previous quarantine left one) and count it.  Removal failures
+        degrade to the old warn-and-miss behavior — a read-only cache
+        dir must not turn a miss into a crash."""
+        self.stats["errors"] += 1
+        self.stats["misses"] += 1
+        self.stats["corrupt"] += 1
+        try:
+            os.replace(path, path + ".corrupt")
+            moved = "quarantined to *.corrupt"
+        except OSError as e:
+            moved = f"could not quarantine: {e}"
+        self._warn_once(f"{msg} ({moved})")
 
     def store(self, key: Tuple, compiled) -> bool:
         """Serialize ``compiled`` under ``key`` (atomic tmp+rename so a

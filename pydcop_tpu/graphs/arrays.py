@@ -54,6 +54,39 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     pass
 
 
+class CostPlaneError(ValueError):
+    """A cost table that would silently corrupt the compiled planes.
+
+    NaN is the poison this guards: ``_clip_costs`` would launder it to
+    cost 0 (``nan_to_num``), after which every min-sum reduction on
+    device happily optimizes a model the user never wrote — invisible
+    until someone audits an answer.  ``±inf`` is NOT rejected: it is
+    the documented hard-constraint encoding, clipped to ``±HARD`` at
+    build time (``--infinity`` at-or-above semantics).  ``kind`` is
+    ``"constraint"`` or ``"variable"`` and ``name`` the offending
+    model element, so serve admission can surface a structured
+    ``REJECTED`` reason naming it."""
+
+    def __init__(self, kind: str, name: str, nan_count: int):
+        super().__init__(
+            f"{kind} {name!r} carries {nan_count} NaN cost "
+            f"value(s); NaN would silently become cost 0 in the "
+            f"compiled planes and poison min-sum reductions — use "
+            f"inf for hard constraints, finite costs otherwise")
+        self.kind = kind
+        self.name = name
+        self.nan_count = int(nan_count)
+
+
+def _require_no_nan(raw: np.ndarray, kind: str, name: str):
+    """Loud build-time gate on raw cost input (before the sign/clip
+    laundering); raises :class:`CostPlaneError` naming the model
+    element."""
+    nan = int(np.isnan(np.asarray(raw, dtype=np.float32)).sum())
+    if nan:
+        raise CostPlaneError(kind, name, nan)
+
+
 def _clip_costs(cube: np.ndarray, sign: float) -> np.ndarray:
     cube = np.asarray(cube, dtype=np.float32) * np.float32(sign)
     cube = np.nan_to_num(cube, posinf=HARD, neginf=-HARD)
@@ -62,7 +95,9 @@ def _clip_costs(cube: np.ndarray, sign: float) -> np.ndarray:
 
 def _padded_cube(constraint: Constraint, max_domain: int,
                  sign: float) -> np.ndarray:
-    cube = _clip_costs(constraint.cost_hypercube(), sign)
+    raw = constraint.cost_hypercube()
+    _require_no_nan(raw, "constraint", constraint.name)
+    cube = _clip_costs(raw, sign)
     pads = [(0, max_domain - s) for s in cube.shape]
     return np.pad(cube, pads, constant_values=BIG)
 
@@ -278,9 +313,9 @@ class FactorGraphArrays:
         domain_mask = np.arange(D)[None, :] < domain_size[:, None]
         var_costs = np.full((V, D), BIG, dtype=np.float32)
         for i, v in enumerate(variables):
-            costs = _clip_costs(
-                np.array([v.cost_for_val(val) for val in v.domain]), sign)
-            var_costs[i, : len(v.domain)] = costs
+            raw = np.array([v.cost_for_val(val) for val in v.domain])
+            _require_no_nan(raw, "variable", v.name)
+            var_costs[i, : len(v.domain)] = _clip_costs(raw, sign)
 
         edge_var, edge_factor = [], []
         by_arity: Dict[int, List[int]] = {}
@@ -473,9 +508,9 @@ class HypergraphArrays:
         initial_idx = np.zeros(V, dtype=np.int32)
         has_initial = np.zeros(V, dtype=bool)
         for i, v in enumerate(variables):
-            costs = _clip_costs(
-                np.array([v.cost_for_val(val) for val in v.domain]), sign)
-            var_costs[i, : len(v.domain)] = costs
+            raw = np.array([v.cost_for_val(val) for val in v.domain])
+            _require_no_nan(raw, "variable", v.name)
+            var_costs[i, : len(v.domain)] = _clip_costs(raw, sign)
             if v.initial_value is not None:
                 initial_idx[i] = v.domain.index(v.initial_value)
                 has_initial[i] = True

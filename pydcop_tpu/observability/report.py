@@ -70,11 +70,21 @@ SCHEMA_VERSION = 1
 #: (the spans vocabulary was already open), the delta-dispatch
 #: ``sessions`` occupancy fields (``size``/``resident_bytes``/
 #: ``budget_bytes``/``evicted_bytes``) and the memory-snapshot
-#: ``sessions_budget_bytes``/``sessions_evicted_bytes`` legs.  A
-#: v1.0/1.1/1.2 reader stays green by the one documented
-#: forward-compat rule: consumers filter the stream by the record
-#: kinds (and fields) they speak and ignore the rest.
-SCHEMA_MINOR = 3
+#: ``sessions_budget_bytes``/``sessions_evicted_bytes`` legs.
+#: Minor 4 (fault-tolerant serving, ISSUE 13) added the structured
+#: ``reason_class`` on REJECTED summary records (``poisoned`` /
+#: ``circuit_open`` / ``shutdown`` / ...), the serve ``event: fault``
+#: failure-audit records with ``action`` (``retry`` / ``bisect`` /
+#: ``poisoned`` / ``circuit_open`` / ``breaker_open`` /
+#: ``breaker_probe`` / ``breaker_close``), the optional ``fault``
+#: attribution dict (``point``/``key`` of an injected chaos fault),
+#: the ``retry`` dict (``attempt``/``backoff_s``), and
+#: ``journal_replayed`` on delta dispatch records (warm session
+#: rebuilt by crash-journal replay).  A v1.0/1.1/1.2/1.3 reader
+#: stays green by the one documented forward-compat rule: consumers
+#: filter the stream by the record kinds (and fields) they speak and
+#: ignore the rest.
+SCHEMA_MINOR = 4
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -88,6 +98,11 @@ TRACE_EVENTS = ("admit", "done", "reject")
 EDIT_KEYS = ("add_variable", "remove_variable", "add_constraint",
              "remove_constraint", "change_costs", "touched_edges",
              "touched_vars")
+
+#: the ``action`` vocabulary of serve ``event: fault`` records
+#: (schema minor 4) — the failure-handling audit trail
+FAULT_ACTIONS = ("retry", "bisect", "poisoned", "circuit_open",
+                 "breaker_open", "breaker_probe", "breaker_close")
 
 
 class RunReporter:
@@ -300,10 +315,27 @@ def validate_record(rec: Dict[str, Any]):
                         f"summary edit[{k!r}] must be a "
                         f"non-negative int, got {v!r}")
         _check_upload_bytes(rec, "summary")
+        rc = rec.get("reason_class")
+        if rc is not None and (not isinstance(rc, str) or not rc):
+            raise ValueError(
+                f"summary with bad reason_class {rc!r}")
     elif kind == "serve":
         event = rec.get("event")
         if not isinstance(event, str) or not event:
             raise ValueError(f"serve record with bad event {event!r}")
+        if event == "fault":
+            action = rec.get("action")
+            if action not in FAULT_ACTIONS:
+                raise ValueError(
+                    f"fault serve record with unknown action "
+                    f"{action!r}; known: {', '.join(FAULT_ACTIONS)}")
+        _check_fault(rec.get("fault"))
+        _check_retry(rec.get("retry"))
+        jr = rec.get("journal_replayed")
+        if jr is not None and (isinstance(jr, bool)
+                               or not isinstance(jr, int) or jr < 0):
+            raise ValueError(
+                f"serve record with bad journal_replayed {jr!r}")
         _check_upload_bytes(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
@@ -351,6 +383,49 @@ def _check_upload_bytes(rec, kind):
                            or not isinstance(ub, int) or ub < 0):
         raise ValueError(
             f"{kind} record with bad upload_bytes {ub!r}")
+
+
+def _check_fault(fault):
+    """Optional ``fault`` attribution (schema minor 4): the injected
+    chaos fault behind a failure record — ``point`` (a
+    serving/faults.FAULT_POINTS name) plus the scheduling ``key``."""
+    if fault is None:
+        return
+    if not isinstance(fault, dict):
+        raise ValueError(
+            f"'fault' must be a dict with a 'point', got "
+            f"{type(fault).__name__}")
+    point = fault.get("point")
+    if not isinstance(point, str) or not point:
+        raise ValueError(f"fault with bad point {point!r}")
+    unknown = sorted(set(fault) - {"point", "key"})
+    if unknown:
+        raise ValueError(
+            f"fault with unknown field(s): {', '.join(unknown)}")
+
+
+def _check_retry(retry):
+    """Optional ``retry`` field (schema minor 4): one backoff retry —
+    ``attempt`` (positive int) and ``backoff_s`` (non-negative
+    seconds)."""
+    if retry is None:
+        return
+    if not isinstance(retry, dict):
+        raise ValueError(
+            f"'retry' must be a dict, got {type(retry).__name__}")
+    attempt = retry.get("attempt")
+    if isinstance(attempt, bool) or not isinstance(attempt, int) \
+            or attempt < 1:
+        raise ValueError(f"retry with bad attempt {attempt!r}")
+    backoff = retry.get("backoff_s")
+    if backoff is not None and (
+            isinstance(backoff, bool)
+            or not isinstance(backoff, (int, float)) or backoff < 0):
+        raise ValueError(f"retry with bad backoff_s {backoff!r}")
+    unknown = sorted(set(retry) - {"attempt", "backoff_s"})
+    if unknown:
+        raise ValueError(
+            f"retry with unknown field(s): {', '.join(unknown)}")
 
 
 def _check_spans(spans):

@@ -89,6 +89,13 @@ class _BatchedRunnerBase:
         #: hit, execute_s always).
         self.exec_cache = None
         self.exec_cache_key: Optional[Tuple] = None
+        #: optional fault-injection gate (serving/faults.py): the
+        #: serve dispatcher points this at its FaultPlan for the
+        #: duration of one dispatch and clears it after.  Called with
+        #: the site name ("compile" at program build, "execute" at
+        #: dispatch) and raises FaultInjected when the plan fires;
+        #: None (always, outside chaos runs) is dead code
+        self.fault_hook = None
         self.last_spans: Dict[str, float] = {}
         #: trace ids of the jobs the last run() executed for, in batch
         #: order (serve dispatches thread them through so a shared
@@ -205,6 +212,8 @@ class _BatchedRunnerBase:
             run_all = self._compile_run(cache_key, keys, spans)
             self._jitted[cache_key] = run_all
         with spans.span("execute_s"):
+            if self.fault_hook is not None:
+                self.fault_hook("execute")
             if collect_metrics:
                 sel, cycles, finished, planes = run_all(
                     self._instance_args, keys)
@@ -236,6 +245,8 @@ class _BatchedRunnerBase:
         ``cache_key``) deserializes it instead of retracing: the spans
         then show ``deserialize_s`` and NO ``compile_s``, the warm-start
         evidence the serve telemetry asserts on."""
+        if self.fault_hook is not None:
+            self.fault_hook("compile")
         jitted = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
         if self.exec_cache is None or self.exec_cache_key is None:
             return jitted
@@ -679,6 +690,22 @@ def runner_cache_bytes() -> Dict[str, int]:
         out[label] = out.get(label, 0) + approx_object_bytes(
             getattr(runner, "_instance_args", None))
     return out
+
+
+def evict_runner(algo: str, rung_signature: Tuple, batch: int,
+                 params: dict) -> bool:
+    """Drop one cached runner by its exact identity.  The serve
+    dispatcher calls this after a watchdog timeout: the abandoned
+    worker thread may still be executing the timed-out runner, so the
+    retry/bisection attempts must build a FRESH runner instead of
+    calling ``set_instances`` on (and racing against) the one in
+    flight.  Returns whether an entry was dropped."""
+    key = (algo, rung_signature, int(batch),
+           tuple(sorted(params.items())))
+    if _RUNNER_CACHE.pop(key, None) is not None:
+        _RUNNER_CACHE_STATS["evictions"] += 1
+        return True
+    return False
 
 
 def runner_for_rung(algo: str, instances, params: dict,

@@ -39,6 +39,8 @@ recompiling (asserted by the warm-start test via the
 
 from .daemon import ServeLoop
 from .dispatcher import DeltaSessions, Dispatcher
+from .faults import (FAULT_POINTS, CircuitBreaker, DispatchTimeout,
+                     FaultInjected, FaultPlan)
 from .queue import AdmissionQueue, AdmittedJob, DispatchGroup, \
     prepare_job
 from .schema import (DELTA_FIELDS, REQUEST_FIELDS, SERVABLE_ALGOS,
@@ -46,8 +48,10 @@ from .schema import (DELTA_FIELDS, REQUEST_FIELDS, SERVABLE_ALGOS,
                      validate_request)
 
 __all__ = [
-    "AdmissionQueue", "AdmittedJob", "DELTA_FIELDS", "DeltaSessions",
-    "DispatchGroup", "Dispatcher", "REQUEST_FIELDS", "RequestError",
-    "SERVABLE_ALGOS", "ServeLoop", "parse_request", "prepare_job",
-    "rejection", "validate_request",
+    "AdmissionQueue", "AdmittedJob", "CircuitBreaker",
+    "DELTA_FIELDS", "DeltaSessions", "DispatchGroup",
+    "DispatchTimeout", "Dispatcher", "FAULT_POINTS", "FaultInjected",
+    "FaultPlan", "REQUEST_FIELDS", "RequestError", "SERVABLE_ALGOS",
+    "ServeLoop", "parse_request", "prepare_job", "rejection",
+    "validate_request",
 ]

@@ -14,12 +14,22 @@ Shutdown contract (the SIGTERM satellite): ``request_stop()`` is
 async-signal-safe (sets an Event).  The loop finishes the dispatch it
 is executing — an in-flight rung always completes and its results are
 delivered — then every still-queued job and every unread inbox line
-receives a structured ``REJECTED`` summary, and a final ``serve``
-record with lifetime counters closes the output.  End-of-input (EOF on
-stdin, oneshot file exhausted) instead DRAINS: remaining groups are
-dispatched, nothing is rejected, and the loop exits when the queue is
-empty — which is exactly the ``serve --oneshot`` smoke path the test
-tier drives without sockets.
+receives a structured ``REJECTED`` summary, every open warm session
+closes (buffers released, crash journals truncated), and a final
+``serve`` record with lifetime counters closes the output.
+End-of-input (EOF on stdin, oneshot file exhausted) instead DRAINS:
+remaining groups are dispatched, nothing is rejected, and the loop
+exits when the queue is empty — which is exactly the ``serve
+--oneshot`` smoke path the test tier drives without sockets.
+
+Dispatch failure contract (ISSUE 13): a failing rung group is no
+longer all-or-nothing.  The group is retried once with exponential
+backoff (injected sleep), then BISECTED until the poisoned job(s) are
+isolated — healthy siblings complete, poisoned jobs reject with the
+structured ``poisoned`` class — and a per-rung circuit breaker sheds
+jobs (``circuit_open``) from a rung that keeps failing TOTALLY,
+half-open probing it after a cooldown.  See ``serving/faults.py`` and
+docs/architecture.md ("Operating under failure").
 """
 
 import itertools
@@ -29,7 +39,8 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from .dispatcher import Dispatcher
-from .queue import AdmissionQueue, prepare_job
+from .faults import CircuitBreaker, FaultInjected
+from .queue import AdmissionQueue, DispatchGroup, prepare_job
 from .schema import RequestError, parse_request, rejection
 
 #: inbox poll cap (s): an idle daemon wakes at least this often to
@@ -54,7 +65,13 @@ class ServeLoop:
                  reserve=None,
                  clock: Callable[[], float] = time.monotonic,
                  registry=None,
-                 heartbeat_s: Optional[float] = None):
+                 heartbeat_s: Optional[float] = None,
+                 faults=None,
+                 max_retries: int = 1,
+                 retry_backoff_s: float = 0.05,
+                 breaker_threshold: int = 4,
+                 breaker_cooldown_s: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep):
         self.admission = admission
         self.dispatcher = dispatcher
         self.reporter = reporter
@@ -98,7 +115,21 @@ class ServeLoop:
         self._admitted_requests_cap = 1024
         self.stats: Dict[str, int] = {
             "received": 0, "admitted": 0, "rejected": 0,
-            "completed": 0, "stats_served": 0}
+            "completed": 0, "stats_served": 0,
+            "retries": 0, "bisections": 0, "shed": 0, "poisoned": 0}
+        #: the fault-tolerance layer (ISSUE 13): an optional injected
+        #: FaultPlan (chaos runs; None = every hook dead, dispatch
+        #: behavior byte-identical), the retry/backoff knobs (sleep is
+        #: injected so the state machine tests without wall-clock
+        #: waits), and the per-rung circuit breaker on the loop's own
+        #: (injectable) clock
+        self.faults = faults
+        self._max_retries = max(0, int(max_retries))
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._sleep = sleep
+        self._breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s, clock=self.clock)
         #: per-job trace ids, unique within this daemon's lifetime
         #: (and therefore within its output file)
         self._trace_seq = itertools.count()
@@ -151,6 +182,32 @@ class ServeLoop:
                 "pydcop_memory_bytes",
                 "resident/disk bytes by accounting leg",
                 labels=("kind",)),
+            "retries": registry.counter(
+                "pydcop_serve_retries_total",
+                "failed dispatches retried after backoff"),
+            "bisections": registry.counter(
+                "pydcop_serve_bisections_total",
+                "failed-group bisection splits"),
+            "shed": registry.counter(
+                "pydcop_serve_shed_jobs_total",
+                "jobs shed without a dispatch attempt, by reason",
+                labels=("reason",)),
+            "poisoned": registry.counter(
+                "pydcop_serve_poisoned_jobs_total",
+                "jobs isolated by bisection and rejected as "
+                "poisoned"),
+            "breaker_state": registry.gauge(
+                "pydcop_serve_breaker_state",
+                "per-rung circuit breaker state "
+                "(0 closed, 1 half-open, 2 open)",
+                labels=("rung",)),
+            "cache_corrupt": registry.counter(
+                "pydcop_cache_corrupt_total",
+                "executable-cache entries quarantined as corrupt"),
+            "journal_replays": registry.counter(
+                "pydcop_session_journal_replays_total",
+                "warm sessions rebuilt by journal replay after a "
+                "restart"),
         }
 
         def sample():
@@ -167,11 +224,19 @@ class ServeLoop:
             exec_cache = getattr(self.dispatcher, "exec_cache", None)
             if exec_cache is not None:
                 caches["exec"] = dict(exec_cache.stats)
+                m["cache_corrupt"].set_total(
+                    exec_cache.stats.get("corrupt", 0))
             sessions = getattr(self.dispatcher, "delta_sessions",
                                None)
             if sessions is not None:
                 caches["sessions"] = dict(sessions.stats)
                 m["sessions_open"].set(len(sessions))
+                m["journal_replays"].set_total(
+                    sessions.stats.get("journal_replays", 0))
+            from .faults import BREAKER_STATES
+            for rung, r in self._breaker.snapshot().items():
+                m["breaker_state"].set(
+                    BREAKER_STATES[r["state"]], rung=rung)
             for cache, stats in caches.items():
                 for event, value in stats.items():
                     if event in ("size", "cap"):
@@ -365,6 +430,10 @@ class ServeLoop:
                         reason_class: str = "prepare",
                         trace_id: str = ""):
         rec = rejection(job_id, reason)
+        # machine-readable rejection class (schema minor 4): clients
+        # and chaos benches branch on `poisoned`/`circuit_open`/...
+        # without parsing the prose reason
+        rec["reason_class"] = reason_class
         if algo is not None:
             rec["algo"] = algo
         if trace_id:
@@ -418,6 +487,28 @@ class ServeLoop:
                                  reason_class="prepare",
                                  trace_id=trace_id)
             return
+        if self.faults is not None \
+                and self.faults.job_fires("nan_planes", job.job_id):
+            # chaos point: poison a COPY of the job's cost planes (the
+            # shared admission cache must stay clean) and run the same
+            # finite gate FactorGraphArrays.build enforces — the
+            # rejection exercises the real NaN machinery end-to-end
+            import numpy as np
+
+            from ..graphs.arrays import CostPlaneError, _require_no_nan
+
+            planes = np.array(np.asarray(job.padded.var_costs,
+                                         dtype=np.float32))
+            planes[0, 0] = np.nan
+            try:
+                _require_no_nan(planes, "variable",
+                                job.padded.var_names[0])
+            except CostPlaneError as e:
+                self._emit_rejection(
+                    job.job_id, f"{type(e).__name__}: {e}", reply,
+                    algo=request.get("algo"),
+                    reason_class="nan_planes", trace_id=trace_id)
+                return
         self.admission.admit(job)
         if request.get("algo") == "maxsum":
             while len(self._admitted_requests) >= \
@@ -445,11 +536,14 @@ class ServeLoop:
         target_request = self._admitted_requests.get(target)
         sessions = getattr(self.dispatcher, "delta_sessions", None)
         if target_request is None and not (
-                sessions is not None and sessions.has(target)):
+                sessions is not None and (
+                    sessions.has(target)
+                    or sessions.journaled(target))):
             # an already-open warm session keeps its target reachable
             # even after the bounded admitted-request index evicted
             # the original request (the request is only needed to
-            # OPEN a session)
+            # OPEN a session) — and so does a crash journal: a
+            # restarted daemon rebuilds the warm engine by replay
             self._emit_rejection(
                 request["id"],
                 f"delta target {target!r} is not an admitted "
@@ -470,6 +564,21 @@ class ServeLoop:
                 default_precision=self.default_precision,
                 reply=reply, queue_depth=self.admission.depth(),
                 trace_id=trace_id)
+        except FaultInjected as e:
+            # a poisoned delta job: there is no batch to bisect — it
+            # is already isolated — so it rejects directly with the
+            # structured `poisoned` class the chaos contract asserts
+            self._count("poisoned")
+            self._emit_rejection(
+                request["id"], f"dispatch failed (poisoned): {e}",
+                reply, algo="maxsum", reason_class="poisoned",
+                trace_id=trace_id)
+            if self.reporter is not None:
+                self.reporter.serve(
+                    event="fault", action="poisoned",
+                    job_id=request["id"],
+                    fault={"point": e.point, "key": str(e.key)})
+            return
         except Exception as e:
             # rejected-at-dispatch, never admitted: the stats
             # reconciliation (received == admitted + rejected +
@@ -488,25 +597,148 @@ class ServeLoop:
     def _dispatch(self, groups) -> int:
         n = 0
         for group in groups:
+            n += self._dispatch_resilient(group)
+        self._count("completed", n)
+        return n
+
+    # ------------------------------------- fault-tolerant dispatch
+
+    def _rung_label(self, group) -> str:
+        from ..parallel.bucketing import rung_label
+
+        algo = group.key[0]
+        rung_sig = group.key[3]
+        return f"{algo}/{rung_label(rung_sig)}"
+
+    @staticmethod
+    def _fault_field(err) -> Dict[str, Any]:
+        """Attribute an injected failure to its plan entry in serve
+        ``fault`` records; organic failures carry no ``fault``."""
+        if isinstance(err, FaultInjected):
+            return {"fault": {"point": err.point,
+                              "key": str(err.key)}}
+        return {}
+
+    def _serve_fault(self, action: str, rung: str, **fields):
+        """One ``event: fault`` serve record (schema minor 4): the
+        failure-handling audit trail — retries, bisections, poisoned
+        isolations, breaker transitions, shed groups."""
+        if self.reporter is not None:
+            self.reporter.serve(event="fault", action=action,
+                                rung=rung, **fields)
+
+    def _breaker_gauge(self, label: str):
+        if self._metrics is not None:
+            from .faults import BREAKER_STATES
+
+            self._metrics["breaker_state"].set(
+                BREAKER_STATES[self._breaker.state(label)],
+                rung=label)
+
+    def _dispatch_resilient(self, group) -> int:
+        """One group end-to-end through the fault-tolerance ladder:
+        circuit-breaker gate -> dispatch, retried once with
+        exponential backoff -> bisection until the poisoned job(s)
+        are isolated (healthy siblings complete) -> breaker
+        accounting.  The trust boundary extends past admission: one
+        group's compile/execute failure (device OOM, a solver bug on
+        this shape, an injected chaos fault) must never take the
+        daemon down — and, new with ISSUE 13, must no longer take the
+        group's healthy SIBLINGS down either."""
+        label = self._rung_label(group)
+        if self._breaker.before_dispatch(label) == "shed":
+            # quarantined rung, still cooling down: shed without a
+            # dispatch attempt — bounded amplification is the point
+            self._count("shed", len(group.jobs),
+                        reason="circuit_open")
+            for job in group.jobs:
+                self._emit_rejection(
+                    job.job_id,
+                    f"rung {label} circuit open after repeated "
+                    f"dispatch failures; job shed while the rung "
+                    f"cools down", job.reply, algo=group.key[0],
+                    reason_class="circuit_open",
+                    trace_id=job.trace_id)
+            self._serve_fault("circuit_open", label,
+                              shed=len(group.jobs))
+            return 0
+        probing = self._breaker.state(label) == "half_open"
+        if probing:
+            self._serve_fault("breaker_probe", label,
+                              batch=len(group.jobs))
+        err: Optional[Exception] = None
+        for attempt in range(self._max_retries + 1):
+            if attempt:
+                backoff = self._retry_backoff_s * (2 ** (attempt - 1))
+                self._count("retries")
+                self._serve_fault(
+                    "retry", label,
+                    retry={"attempt": attempt,
+                           "backoff_s": round(backoff, 6)},
+                    error=str(err), **self._fault_field(err))
+                self._sleep(backoff)
             try:
                 records = self.dispatcher.dispatch(
                     group, queue_depth=self.admission.depth())
-            except Exception as e:
-                # the trust boundary extends past admission: one
-                # group's compile/execute failure (device OOM, a
-                # solver bug on this shape) rejects ITS jobs with a
-                # structured reason and the daemon keeps serving every
-                # other group
-                for job in group.jobs:
-                    self._emit_rejection(
-                        job.job_id, f"dispatch failed: {e}",
-                        job.reply, algo=group.key[0],
-                        reason_class="dispatch",
-                        trace_id=job.trace_id)
+            except Exception as e:  # noqa: BLE001 - the whole point
+                err = e
                 continue
-            n += len(records)
-        self._count("completed", n)
-        return n
+            self._breaker.record_success(label)
+            if probing:
+                self._serve_fault("breaker_close", label)
+            self._breaker_gauge(label)
+            return len(records)
+        # retry exhausted: the failure is deterministic for this
+        # load — isolate the poisoned job(s) by bisection
+        done = self._bisect(group, err, label)
+        if done:
+            # healthy jobs completed: the RUNG works, only inputs
+            # were poisoned — never quarantine it for that
+            self._breaker.record_success(label)
+        else:
+            if self._breaker.record_failure(label):
+                self._serve_fault(
+                    "breaker_open", label,
+                    cooldown_s=self._breaker.cooldown_s,
+                    **self._fault_field(err))
+        self._breaker_gauge(label)
+        return done
+
+    def _bisect(self, group, err, label: str, depth: int = 0) -> int:
+        """Recursive halving of a deterministically failing group:
+        a single-job leaf that still fails IS the poisoned job and
+        rejects with the structured ``poisoned`` class; every healthy
+        sibling re-dispatches and completes.  Dispatch rounds are
+        bounded by ceil(log2(batch)) levels.  Returns the number of
+        completed jobs."""
+        jobs = group.jobs
+        if len(jobs) == 1:
+            job = jobs[0]
+            self._count("poisoned")
+            self._emit_rejection(
+                job.job_id,
+                f"dispatch failed after retry; job isolated by "
+                f"bisection (poisoned): {err}", job.reply,
+                algo=group.key[0], reason_class="poisoned",
+                trace_id=job.trace_id)
+            self._serve_fault("poisoned", label, job_id=job.job_id,
+                              error=str(err),
+                              **self._fault_field(err))
+            return 0
+        mid = len(jobs) // 2
+        self._count("bisections")
+        self._serve_fault("bisect", label, batch=len(jobs),
+                          depth=depth, **self._fault_field(err))
+        done = 0
+        for half in (jobs[:mid], jobs[mid:]):
+            sub = DispatchGroup(group.key, half, group.reason)
+            try:
+                records = self.dispatcher.dispatch(
+                    sub, queue_depth=self.admission.depth())
+                done += len(records)
+            except Exception as e:  # noqa: BLE001 - recurse
+                done += self._bisect(sub, e, label, depth + 1)
+        return done
 
     def _poll_timeout(self) -> float:
         deadline = self.admission.next_deadline()
@@ -596,6 +828,13 @@ class ServeLoop:
                         job_id, "serve daemon shutting down "
                         "(received, not yet admitted)", reply,
                         reason_class="shutdown")
+        # shutdown hygiene (ISSUE 13 satellite): every open warm
+        # engine closes on SIGTERM AND clean drain — device buffers
+        # released, journals truncated — BEFORE the final record, so
+        # its memory snapshot proves zero resident session bytes
+        sessions = getattr(self.dispatcher, "delta_sessions", None)
+        if sessions is not None:
+            sessions.close_all()
         if self.reporter is not None:
             from ..parallel.batch import runner_cache_stats
             from .queue import instance_cache_stats

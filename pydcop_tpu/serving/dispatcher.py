@@ -91,7 +91,7 @@ class DeltaSessions:
 
     def __init__(self, exec_cache=None, reserve=None, cap: int = 16,
                  budget_bytes: Optional[int] = None,
-                 resident: bool = True):
+                 resident: bool = True, journal=None):
         from collections import OrderedDict
 
         self.exec_cache = exec_cache
@@ -104,12 +104,19 @@ class DeltaSessions:
         #: resident-plane delta applies for opened engines (the
         #: re-upload path is kept selectable for A/B benches)
         self.resident = bool(resident)
+        #: crash-recovery journal store (dynamics/journal.JournalStore,
+        #: ``serve --session-journal DIR``): each open session appends
+        #: its base job + every answered delta, so a restarted daemon
+        #: rebuilds the warm engine by replay.  None = no journaling,
+        #: behavior unchanged
+        self.journal = journal
+        self._journals: Dict[str, Any] = {}
         self._sessions: "OrderedDict[str, Any]" = OrderedDict()
         # every counter exists from construction, so /stats and serve
         # records carry the full key set before the first drop/evict
         self.stats: Dict[str, int] = {
             "opened": 0, "hits": 0, "evictions": 0, "dropped": 0,
-            "evicted_bytes": 0}
+            "evicted_bytes": 0, "closed": 0, "journal_replays": 0}
 
     def get(self, target: str, target_request: Dict[str, Any],
             default_max_cycles: int, default_seed: int,
@@ -209,7 +216,126 @@ class DeltaSessions:
         # drop-style close: the device buffers are released NOW, not
         # when the garbage collector gets around to the engine
         engine.close()
+        # an evicted session reopens from the base instance (the
+        # documented contract), so its journal must not replay
+        self._journal_close(target, truncate=True)
         return freed
+
+    # ------------------------------------------------ journal plumbing
+
+    def _journal_close(self, target: str, truncate: bool):
+        handle = self._journals.pop(target, None)
+        if handle is not None:
+            handle.close(truncate=truncate)
+        elif truncate and self.journal is not None:
+            # no open handle (e.g. a recovery that failed before
+            # re-opening one): remove the file directly
+            self.journal.discard(target)
+
+    def journal_begin(self, target: str, request: Dict[str, Any],
+                      seed: int, max_cycles: int):
+        """Open the target's journal and record its (successful) base
+        solve.  No-op without a journal store.  Any leftover journal
+        for the target is DISCARDED first: a fresh session open (the
+        client re-admitted the base job after a crash, bypassing
+        recovery) must start a fresh journal — appending a second
+        base record onto stale entries would corrupt the next
+        replay.  Only :meth:`recover` reattaches in append mode."""
+        if self.journal is None:
+            return
+        self._journal_close(target, truncate=True)
+        handle = self.journal.open(target)
+        handle.record_base(request, seed, max_cycles)
+        self._journals[target] = handle
+
+    def journal_append(self, target: str,
+                       actions: List[Dict[str, Any]],
+                       max_cycles: Optional[int]):
+        """Record one ANSWERED delta (apply + warm re-solve both
+        succeeded).  No-op without a journal store or open handle."""
+        handle = self._journals.get(target)
+        if handle is not None:
+            handle.record_delta(actions, max_cycles)
+
+    def journaled(self, target: str) -> bool:
+        """Whether ``target`` has a replayable journal (the
+        restart-recovery gate)."""
+        return self.journal is not None \
+            and self.journal.journaled(target)
+
+    def recover(self, target: str, default_max_cycles: int,
+                default_seed: int, default_precision=None):
+        """Rebuild ``target``'s warm session from its journal: open
+        the engine from the journaled base request (the base solve
+        deserializes the rung's cached executable — no compile),
+        then re-apply and re-solve every journaled delta in order.
+        The replayed message state is bit-exact with a session that
+        never crashed.  Returns ``(engine, base_request, n_replayed,
+        spans)`` — ``spans`` sums the replay solves' span dicts, so a
+        restart dispatch shows the base solve's ``deserialize_s``
+        (the rung came back through the executable cache) and no
+        ``compile_s``.  On any replay failure the journal is
+        discarded and the error propagates as a structured
+        rejection."""
+        try:
+            base_request, seed, base_mc, entries = self.journal.load(
+                target)
+            # the journaled base max_cycles is the RESOLVED value of
+            # the crashed daemon (its --max-cycles default folded
+            # in): replay must use it, or a restart under a
+            # different default would diverge from the never-crashed
+            # session
+            engine, _opened = self.get(
+                target, base_request,
+                base_mc or default_max_cycles, default_seed,
+                default_precision)
+        except Exception:
+            # an unreplayable journal (corrupt non-tail line, the
+            # journaled model file gone) must not leave the target
+            # permanently rejecting on the same load error: discard
+            # it so the next delta gets the clean unknown-target
+            # rejection (and drop any half-open session)
+            self.drop(target)
+            self.journal.discard(target)
+            raise
+        spans: Dict[str, float] = {}
+
+        def fold():
+            for k, v in engine.last_spans.items():
+                spans[k] = round(spans.get(k, 0.0) + v, 6)
+
+        try:
+            engine.solve(seed=seed)
+            fold()
+            for e in entries:
+                engine.apply(e["actions"])
+                engine.solve(max_cycles=e.get("max_cycles"))
+                fold()
+        except Exception:
+            # a half-replayed session is worse than none: drop it
+            # (journal discarded) so the next delta fails cleanly
+            # against a missing target instead of a divergent state
+            self.drop(target)
+            raise
+        self.stats["journal_replays"] += 1
+        # keep journaling: the file already holds base + replayed
+        # deltas, append-mode reattach continues where it left off
+        self._journals[target] = self.journal.open(target)
+        return engine, base_request, len(entries), spans
+
+    def close_all(self) -> int:
+        """Shutdown hygiene (SIGTERM / clean exit): close every open
+        warm engine — device buffers released, journals truncated —
+        so the post-shutdown memory snapshot reports zero resident
+        session bytes.  Returns the number of sessions closed."""
+        closed = 0
+        while self._sessions:
+            target, engine = self._sessions.popitem(last=False)
+            engine.close()
+            self._journal_close(target, truncate=True)
+            self.stats["closed"] += 1
+            closed += 1
+        return closed
 
     def snapshot(self) -> Dict[str, Any]:
         """Counters plus live occupancy for serve records: size, the
@@ -225,11 +351,13 @@ class DeltaSessions:
         base solve or a post-edit re-solve failed): the next delta
         against the target reopens from the target's base instance —
         well-defined recovery instead of a silently divergent or
-        half-open session."""
+        half-open session.  The journal is truncated for the same
+        reason: it must never replay a state the store disowned."""
         engine = self._sessions.pop(target, None)
         if engine is not None:
             self.stats["dropped"] += 1
             engine.close()
+        self._journal_close(target, truncate=True)
 
 
 class Dispatcher:
@@ -240,7 +368,9 @@ class Dispatcher:
                  batch_pow2: bool = True, reserve=None,
                  registry=None, session_cap: int = 16,
                  session_budget_bytes: Optional[int] = None,
-                 resident_deltas: bool = True):
+                 resident_deltas: bool = True,
+                 faults=None, execute_deadline_s: Optional[float] = None,
+                 journal=None):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
@@ -248,8 +378,18 @@ class Dispatcher:
         self.registry = registry
         self._metrics = (_stage_metrics(registry)
                          if registry is not None else None)
+        #: injected fault plan (serving/faults.FaultPlan; chaos runs
+        #: only — None keeps every hook dead) and the execute
+        #: watchdog deadline: with a deadline set, the device span of
+        #: a dispatch runs on a worker thread and a stall past the
+        #: deadline becomes a DispatchTimeout FAILURE (retried /
+        #: bisected / shed upstream) instead of freezing the daemon
+        self.faults = faults
+        self.execute_deadline_s = (float(execute_deadline_s)
+                                   if execute_deadline_s else None)
+        self._dispatch_seq = 0
         self.stats: Dict[str, int] = {"dispatches": 0, "jobs": 0,
-                                      "deltas": 0}
+                                      "deltas": 0, "timeouts": 0}
         #: spans of the most recent dispatch (tests read this)
         self.last_spans: Dict[str, float] = {}
         #: warm scenario sessions for delta jobs (lazy per target),
@@ -257,7 +397,60 @@ class Dispatcher:
         self.delta_sessions = DeltaSessions(
             exec_cache=exec_cache, reserve=reserve, cap=session_cap,
             budget_bytes=session_budget_bytes,
-            resident=resident_deltas)
+            resident=resident_deltas, journal=journal)
+
+    # ---------------------------------------------- fault / watchdog
+
+    def _fault_hook(self, job_ids: List[str], dispatch_index: int):
+        """The per-dispatch injection gate handed to the batched
+        runner (``_BatchedRunnerBase.fault_hook``): raises
+        FaultInjected at the compile/execute sites when the attached
+        plan fires for this dispatch's jobs or index."""
+        faults = self.faults
+
+        def hook(site: str):
+            if site == "compile":
+                faults.check("compile_error", job_ids=job_ids,
+                             dispatch_index=dispatch_index)
+            else:
+                faults.check("execute_error", job_ids=job_ids,
+                             dispatch_index=dispatch_index)
+                faults.check("execute_hang", job_ids=job_ids,
+                             dispatch_index=dispatch_index)
+        return hook
+
+    def _with_deadline(self, fn):
+        """Run the device span under the execute watchdog: without a
+        deadline, inline (byte-identical to the pre-watchdog path);
+        with one, on a daemon worker thread joined with a timeout —
+        a compiled execution cannot be interrupted, so on expiry the
+        thread is abandoned (it holds no daemon locks) and the
+        dispatch FAILS with DispatchTimeout instead of hanging the
+        serve loop forever."""
+        if self.execute_deadline_s is None:
+            return fn()
+        import threading
+
+        from .faults import DispatchTimeout
+
+        box: Dict[str, Any] = {}
+
+        def work():
+            try:
+                box["out"] = fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised
+                box["err"] = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="pydcop-dispatch-watchdog")
+        t.start()
+        t.join(self.execute_deadline_s)
+        if t.is_alive():
+            self.stats["timeouts"] += 1
+            raise DispatchTimeout(self.execute_deadline_s)
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
 
     # --------------------------------------------------- registry feed
 
@@ -294,6 +487,10 @@ class Dispatcher:
         algo, params_t, max_cycles, rung_sig = group.key
         params = dict(params_t)
         B = len(jobs)
+        # dispatch ATTEMPTS in daemon order, failures included — the
+        # key a fault plan's transient dispatch_index entries fire on
+        dispatch_index = self._dispatch_seq
+        self._dispatch_seq += 1
         clock = SpanClock(time_source=self.clock)
         t0 = clock.now()
         with clock.span("batch_form_s"):
@@ -309,10 +506,35 @@ class Dispatcher:
             runner = runner_for_rung(algo, instances, params,
                                      rung_signature=rung_sig,
                                      exec_cache=self.exec_cache)
-        sel, cycles, finished = runner.run(
-            max_cycles=max_cycles, seeds=seeds,
-            trace_ids=[j.trace_id for j in jobs])
-        costs, viols = runner.evaluate(sel)
+        if self.faults is not None:
+            runner.fault_hook = self._fault_hook(
+                [j.job_id for j in jobs], dispatch_index)
+        try:
+            def device_span():
+                sel_, cycles_, finished_ = runner.run(
+                    max_cycles=max_cycles, seeds=seeds,
+                    trace_ids=[j.trace_id for j in jobs])
+                costs_, viols_ = runner.evaluate(sel_)
+                return sel_, cycles_, finished_, costs_, viols_
+
+            sel, cycles, finished, costs, viols = \
+                self._with_deadline(device_span)
+        except Exception as e:
+            from .faults import DispatchTimeout
+
+            if isinstance(e, DispatchTimeout):
+                # the abandoned worker thread may still be executing
+                # THIS runner: evict it so the retry/bisection builds
+                # a fresh one instead of re-pointing (and racing on)
+                # the in-flight runner's instance arguments
+                from ..parallel.batch import evict_runner
+
+                evict_runner(algo, rung_sig, padded_B, params)
+            raise
+        finally:
+            # runners are cached and shared across dispatches: a
+            # stale hook keyed to this group's jobs must not leak
+            runner.fault_hook = None
         decoded = runner.decode(sel)
         elapsed = self.clock() - t0
         self.last_spans = dict(clock.as_dict(), **runner.last_spans)
@@ -403,30 +625,61 @@ class Dispatcher:
         warm contract (an open session re-solve carries no
         ``trace_lower_s``/``compile_s``)."""
         t0 = self.clock()
-        engine, opened = self.delta_sessions.get(
-            request["target"], target_request,
-            default_max_cycles, default_seed, default_precision)
+        target = request["target"]
+        if self.faults is not None:
+            # a poisoned delta job fires BEFORE any session work, so
+            # the rejection leaves the target session trustworthy
+            self.faults.check("execute_error",
+                              job_ids=(request["id"],))
+            self.faults.check("execute_hang",
+                              job_ids=(request["id"],))
         open_spans = None
-        if opened:
+        journal_replayed = None
+        if target_request is None \
+                and not self.delta_sessions.has(target) \
+                and self.delta_sessions.journaled(target):
+            # crash recovery: the daemon restarted with this warm
+            # session journaled — rebuild it by replay through the
+            # executable cache, then serve the delta normally
+            t_rep = time.perf_counter()
+            engine, target_request, journal_replayed, open_spans = \
+                self.delta_sessions.recover(
+                    target, default_max_cycles, default_seed,
+                    default_precision)
+            opened = True
+            open_spans = dict(open_spans)
+            open_spans["journal_replay_s"] = round(
+                time.perf_counter() - t_rep, 6)
+        else:
+            engine, opened = self.delta_sessions.get(
+                target, target_request,
+                default_max_cycles, default_seed, default_precision)
+        if opened and journal_replayed is None:
             # the session's base solve: compile or exec-cache
             # deserialize happens HERE, once per (rung, params)
+            base_seed = int(request.get("seed", default_seed))
             try:
-                engine.solve(
-                    seed=int(request.get("seed", default_seed)))
+                # the watchdog covers warm-session dispatches too: a
+                # hung base solve must fail (session dropped), not
+                # freeze the serve loop
+                self._with_deadline(
+                    lambda: engine.solve(seed=base_seed))
             except Exception:
                 # a half-open session (cached, never base-solved)
                 # would mislabel every later delta as warm: close it
                 # so the next delta retries the cold open
-                self.delta_sessions.drop(request["target"])
+                self.delta_sessions.drop(target)
                 raise
             open_spans = dict(engine.last_spans)
+            self.delta_sessions.journal_begin(
+                target, target_request, base_seed, engine.max_cycles)
         # apply() either commits fully or raises with the instance
         # untouched (compile_event validates before any write), so a
         # DeltaError rejection leaves the session trustworthy
         engine.apply(request["actions"])
         try:
-            res = engine.solve(
-                max_cycles=request.get("max_cycles"))
+            res = self._with_deadline(lambda: engine.solve(
+                max_cycles=request.get("max_cycles")))
         except Exception as e:
             # the edit is already committed but the client will see a
             # rejection: a retried delta would then double-apply.
@@ -440,8 +693,12 @@ class Dispatcher:
                 f"reopens it from the base instance") from e
         elapsed = self.clock() - t0
         self.last_spans = dict(engine.last_spans)
-        # the budget holds AFTER every dispatch: the solve just grew
-        # the session's carried state, so the bytes are real now
+        # the delta is ANSWERED: journal it (fsync'd) before the
+        # reply, so a crash after this point replays to a state the
+        # client has seen.  Then enforce the budget — the solve just
+        # grew the session's carried state, so the bytes are real now
+        self.delta_sessions.journal_append(
+            target, request["actions"], request.get("max_cycles"))
         self.delta_sessions.enforce()
         rec = {
             "job_id": request["id"],
@@ -486,6 +743,8 @@ class Dispatcher:
                 target=request["target"],
                 session_opened=bool(opened),
                 open_spans=open_spans,
+                **({"journal_replayed": int(journal_replayed)}
+                   if journal_replayed is not None else {}),
                 reserve=res["budget"],
                 upload_bytes=int(res.get("upload_bytes") or 0),
                 spans=dict(engine.last_spans),

@@ -1,0 +1,326 @@
+"""Deterministic fault injection and the serving fault-tolerance
+primitives (ISSUE 13).
+
+The source paper's resilience story is algorithmic — k-replicated
+computations plus a repair protocol survive agent loss mid-solve.
+This module is the infrastructure twin for the compiled serving
+stack: a **seeded, reproducible chaos harness** (`serve --fault-plan
+FILE`) that makes compile/execute/cache/input failures first-class
+test inputs, and the two state machines the serve loop recovers with:
+
+* :class:`FaultPlan` — named fault points (:data:`FAULT_POINTS`)
+  scheduled explicitly (by ``job_id`` or ``dispatch_index``) or drawn
+  from a seeded hash at a configured ``rate``.  Decisions are pure
+  functions of ``(seed, point, key)``: the same plan over the same
+  load fires the same faults in every run, so chaos benches assert
+  exact rejected-job sets instead of eyeballing flakiness.  The plan
+  threads through ``ServeLoop`` / ``Dispatcher`` /
+  ``_BatchedRunnerBase`` / ``ExecutableCache`` behind a ``None``
+  default — with no plan attached every hook is dead code and serve
+  behavior is byte-identical.
+* :class:`CircuitBreaker` — per-rung quarantine bounding worst-case
+  retry amplification: ``threshold`` consecutive *total* dispatch
+  failures (no job of the group completed, retries and bisection
+  included) open the rung; while open, its jobs are shed immediately
+  with a structured ``circuit_open`` rejection; after ``cooldown_s``
+  (injected clock) ONE probe group is let through half-open —
+  success closes the breaker, failure re-opens the cooldown.
+
+Job-id faults model *poisoned inputs*: they fail every dispatch that
+contains the job, which is exactly what lets the serve loop's
+bisection isolate them (split, re-dispatch halves, recurse) while
+every healthy sibling still completes.  Dispatch-index faults model
+*transient* failures: they fire on one dispatch attempt only, so the
+single backoff retry absorbs them.
+"""
+
+import hashlib
+import json
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+#: the injectable fault points, each naming the layer it fires in:
+#: ``compile_error``   — _BatchedRunnerBase._compile_run (a rung whose
+#:                       program cannot be built);
+#: ``execute_error``   — _BatchedRunnerBase.run / delta dispatch (the
+#:                       device raised mid-execution);
+#: ``execute_hang``    — same site, but the failure mode is a STALL
+#:                       (sleeps ``hang_s`` wall-clock — the slow path
+#:                       the dispatch watchdog must convert into a
+#:                       failure) before raising;
+#: ``cache_corrupt``   — ExecutableCache.load (the on-disk serialized
+#:                       executable is garbage; quarantine + recompile);
+#: ``nan_planes``      — serve admission (the job's cost planes carry
+#:                       NaN; the build-time finite check must reject
+#:                       it with a structured reason).
+FAULT_POINTS = ("compile_error", "execute_error", "execute_hang",
+                "cache_corrupt", "nan_planes")
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired.  Carries the ``point`` and the ``key``
+    (job id or dispatch index) that scheduled it, so telemetry can
+    attribute the failure to the plan instead of the hardware."""
+
+    def __init__(self, point: str, key):
+        super().__init__(f"injected fault {point!r} (key={key!r})")
+        self.point = str(point)
+        self.key = key
+
+
+class DispatchTimeout(RuntimeError):
+    """The dispatch watchdog expired: the device span exceeded the
+    configured execute deadline.  The worker thread may still be
+    running (a compiled execution cannot be interrupted) — the daemon
+    treats the dispatch as FAILED and keeps serving instead of
+    freezing behind it."""
+
+    def __init__(self, deadline_s: float):
+        super().__init__(
+            f"dispatch exceeded the {deadline_s:g}s execute deadline "
+            f"(watchdog); treating the rung dispatch as failed")
+        self.deadline_s = float(deadline_s)
+
+
+def _unit_hash(seed: int, point: str, key) -> float:
+    """Deterministic uniform draw in [0, 1) for one (point, key)
+    decision — stable across processes and platforms (sha256, not
+    Python's salted ``hash``)."""
+    digest = hashlib.sha256(
+        f"{int(seed)}:{point}:{key}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+
+class FaultPlan:
+    """A seeded, schedule-driven fault plan.
+
+    JSON file grammar (``serve --fault-plan FILE``)::
+
+        {"seed": 7,
+         "rate": 0.05,
+         "points": ["execute_error"],
+         "hang_s": 0.5,
+         "schedule": [
+           {"point": "execute_error", "job_id": "j17"},
+           {"point": "compile_error", "dispatch_index": 3},
+           {"point": "cache_corrupt"}
+         ]}
+
+    ``rate``/``points`` draw per-JOB faults from the seeded hash:
+    job ``j`` is poisoned at point ``p`` iff
+    ``hash(seed, p, j) < rate`` — a property of the job, not of the
+    dispatch, so retries and bisection see a consistent world.
+    ``schedule`` entries force specific fires: by ``job_id`` (sticky,
+    like rate faults), by ``dispatch_index`` (fires on that one
+    dispatch attempt only — a transient), or unconditional (every
+    probe of that point; useful for ``cache_corrupt``).
+    """
+
+    def __init__(self, seed: int = 0, rate: float = 0.0,
+                 points: Iterable[str] = (),
+                 schedule: Iterable[Dict[str, Any]] = (),
+                 hang_s: float = 0.5):
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ValueError(
+                f"fault plan rate must be in [0, 1], got {rate!r}")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.points = tuple(points)
+        for p in self.points:
+            if p not in FAULT_POINTS:
+                raise ValueError(
+                    f"unknown fault point {p!r}; known: "
+                    f"{', '.join(FAULT_POINTS)}")
+        self.hang_s = float(hang_s)
+        self.schedule: List[Dict[str, Any]] = []
+        for i, entry in enumerate(schedule):
+            if not isinstance(entry, dict) or "point" not in entry:
+                raise ValueError(
+                    f"schedule[{i}] must be a mapping with a 'point'")
+            if entry["point"] not in FAULT_POINTS:
+                raise ValueError(
+                    f"schedule[{i}]: unknown fault point "
+                    f"{entry['point']!r}; known: "
+                    f"{', '.join(FAULT_POINTS)}")
+            unknown = set(entry) - {"point", "job_id",
+                                    "dispatch_index"}
+            if unknown:
+                raise ValueError(
+                    f"schedule[{i}]: unknown field(s) "
+                    f"{', '.join(sorted(unknown))}")
+            self.schedule.append(dict(entry))
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        """Parse a JSON fault-plan file; raises ``ValueError`` with
+        the offending field (the serve CLI turns it into a startup
+        error, never a mid-dispatch surprise)."""
+        try:
+            with open(path) as f:
+                spec = json.load(f)
+        except OSError as e:
+            raise ValueError(f"fault plan unreadable: {e}")
+        except json.JSONDecodeError as e:
+            raise ValueError(f"fault plan {path} is not valid JSON: "
+                             f"{e}")
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"fault plan {path} must be a JSON object, got "
+                f"{type(spec).__name__}")
+        unknown = set(spec) - {"seed", "rate", "points", "schedule",
+                               "hang_s"}
+        if unknown:
+            raise ValueError(
+                f"fault plan {path}: unknown field(s) "
+                f"{', '.join(sorted(unknown))}")
+        return cls(seed=spec.get("seed", 0),
+                   rate=spec.get("rate", 0.0),
+                   points=spec.get("points", ()),
+                   schedule=spec.get("schedule", ()),
+                   hang_s=spec.get("hang_s", 0.5))
+
+    # ------------------------------------------------------- decisions
+
+    def job_fires(self, point: str, job_id: str) -> bool:
+        """Whether ``job_id`` is poisoned at ``point`` — a sticky,
+        dispatch-independent property (rate draw + job_id schedule
+        entries)."""
+        for entry in self.schedule:
+            if entry["point"] == point \
+                    and entry.get("job_id") == job_id \
+                    and "dispatch_index" not in entry:
+                return True
+        if self.rate and point in self.points:
+            return _unit_hash(self.seed, point, job_id) < self.rate
+        return False
+
+    def dispatch_fires(self, point: str,
+                       dispatch_index: Optional[int]) -> Optional[Dict]:
+        """The schedule entry firing at ``dispatch_index`` for
+        ``point`` (transient: that one attempt only), or an
+        unconditional entry (no job_id, no dispatch_index: fires on
+        every probe of the point), else None."""
+        for entry in self.schedule:
+            if entry["point"] != point:
+                continue
+            if dispatch_index is not None \
+                    and entry.get("dispatch_index") == dispatch_index:
+                return entry
+            if "dispatch_index" not in entry \
+                    and "job_id" not in entry:
+                return entry
+        return None
+
+    def poisoned_jobs(self, point: str,
+                      job_ids: Iterable[str]) -> List[str]:
+        """The subset of ``job_ids`` poisoned at ``point`` — what a
+        chaos bench compares the rejected set against."""
+        return [j for j in job_ids if self.job_fires(point, j)]
+
+    def check(self, point: str, job_ids: Iterable[str] = (),
+              dispatch_index: Optional[int] = None,
+              sleep: Callable[[float], None] = time.sleep):
+        """The injection gate the serving hooks call: raises
+        :class:`FaultInjected` when the plan fires for this
+        (point, jobs, dispatch) combination; returns silently
+        otherwise.  ``execute_hang`` sleeps ``hang_s`` (real wall
+        clock — the watchdog must observe a genuine stall) before
+        raising."""
+        fired_key = None
+        entry = self.dispatch_fires(point, dispatch_index)
+        if entry is not None:
+            fired_key = entry.get("dispatch_index", "*")
+        if fired_key is None:
+            for j in job_ids:
+                if self.job_fires(point, j):
+                    fired_key = j
+                    break
+        if fired_key is None:
+            return
+        if point == "execute_hang":
+            sleep(self.hang_s)
+        raise FaultInjected(point, fired_key)
+
+
+# ------------------------------------------------------ circuit breaker
+
+#: breaker states, also the value of the ``pydcop_serve_breaker_state``
+#: gauge (closed=0, half_open=1, open=2)
+BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class CircuitBreaker:
+    """Per-rung consecutive-total-failure quarantine.
+
+    A *failure* here is a whole dispatch group resolving with ZERO
+    completed jobs — retry exhausted and every bisection leaf failed.
+    A group that completes any job (a successful bisection isolating
+    a poisoned sibling included) is a success and resets the rung's
+    count: poisoned INPUTS must never quarantine a healthy RUNG.
+    ``threshold`` consecutive failures open the breaker; open rungs
+    shed jobs without dispatching until ``cooldown_s`` has passed on
+    the injected clock, then exactly one group probes half-open.
+    """
+
+    def __init__(self, threshold: int = 4, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if threshold < 1:
+            raise ValueError(
+                f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        #: rung label -> {"state", "failures", "open_until"}
+        self._rungs: Dict[str, Dict[str, Any]] = {}
+
+    def _rung(self, label: str) -> Dict[str, Any]:
+        return self._rungs.setdefault(
+            label, {"state": "closed", "failures": 0,
+                    "open_until": 0.0})
+
+    def state(self, label: str) -> str:
+        return self._rung(label)["state"]
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-rung breaker state for serve records / stats."""
+        return {label: dict(r) for label, r in self._rungs.items()}
+
+    def before_dispatch(self, label: str) -> str:
+        """Gate one group: ``"dispatch"`` (closed, or the half-open
+        probe slot) or ``"shed"`` (open, cooling down).  Entering the
+        probe slot transitions the rung to ``half_open`` so telemetry
+        shows the probe in flight."""
+        r = self._rung(label)
+        if r["state"] == "closed":
+            return "dispatch"
+        if r["state"] == "half_open":
+            # a probe is already the in-flight dispatch; on the
+            # single-threaded serve loop the probe resolves before the
+            # next group, so this arm only guards misuse
+            return "shed"
+        if self.clock() >= r["open_until"]:
+            r["state"] = "half_open"
+            return "dispatch"
+        return "shed"
+
+    def record_success(self, label: str):
+        r = self._rung(label)
+        r["state"] = "closed"
+        r["failures"] = 0
+        r["open_until"] = 0.0
+
+    def record_failure(self, label: str) -> bool:
+        """Count one total-failure resolution; returns True when this
+        failure OPENED (or re-opened) the breaker."""
+        r = self._rung(label)
+        if r["state"] == "half_open":
+            # failed probe: straight back to open, count preserved
+            r["state"] = "open"
+            r["open_until"] = self.clock() + self.cooldown_s
+            return True
+        r["failures"] += 1
+        if r["failures"] >= self.threshold:
+            r["state"] = "open"
+            r["open_until"] = self.clock() + self.cooldown_s
+            return True
+        return False

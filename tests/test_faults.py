@@ -1,0 +1,881 @@
+"""Fault-tolerant serving (ISSUE 13).
+
+Layers under test:
+
+* ``serving/faults.py`` — the seeded deterministic :class:`FaultPlan`
+  (rate draws sticky per job id, transient dispatch-index schedule
+  entries, validation) and the per-rung :class:`CircuitBreaker` on a
+  fake clock;
+* the serve loop's retry/bisection state machine — injected clock AND
+  injected sleep, so the backoff schedule and the
+  poisoned-job-isolated-in-<=log2-rounds bound assert without a single
+  wall-clock wait;
+* the dispatch watchdog (``Dispatcher._with_deadline``) turning hangs
+  into failures;
+* ``ExecutableCache`` corruption quarantine (move-aside + ``corrupt``
+  counter + recompile-style miss);
+* the NaN cost-plane rejection (build time, serve admission, delta
+  actions) — the ``nan_planes`` chaos point exercises the same gate;
+* ``dynamics/journal.py`` — crash-recoverable warm sessions: journal
+  roundtrip, truncate-on-clean-close, and the BIT-EXACT replay
+  contract (a killed-and-restarted dispatcher answers a delta with
+  selections AND cycles identical to the uninterrupted one, through a
+  ``deserialize_s`` + ``journal_replay_s`` open and no ``compile_s``);
+* ``benchmarks/suite.py bench_chaos`` quick leg — the end-to-end
+  chaos contract on every PR, its JSONL validated by the
+  ``pydcop telemetry-validate`` CLI.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.serving.daemon import ServeLoop
+from pydcop_tpu.serving.dispatcher import Dispatcher
+from pydcop_tpu.serving.faults import (FAULT_POINTS, CircuitBreaker,
+                                       DispatchTimeout, FaultInjected,
+                                       FaultPlan)
+from pydcop_tpu.serving.queue import AdmissionQueue
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+# ------------------------------------------------------- fault plans
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan(points=("explode",))
+    with pytest.raises(ValueError, match="rate"):
+        FaultPlan(rate=1.5)
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan(schedule=[{"point": "explode"}])
+    with pytest.raises(ValueError, match="unknown field"):
+        FaultPlan(schedule=[{"point": "execute_error",
+                             "jobid": "typo"}])
+
+
+def test_fault_plan_load_rejects_bad_files(tmp_path):
+    missing = tmp_path / "nope.json"
+    with pytest.raises(ValueError, match="unreadable"):
+        FaultPlan.load(str(missing))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FaultPlan.load(str(bad))
+    unknown = tmp_path / "unknown.json"
+    unknown.write_text(json.dumps({"rte": 0.05}))
+    with pytest.raises(ValueError, match="unknown field"):
+        FaultPlan.load(str(unknown))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "seed": 3, "rate": 0.1, "points": ["execute_error"],
+        "schedule": [{"point": "compile_error",
+                      "dispatch_index": 2}]}))
+    plan = FaultPlan.load(str(good))
+    assert plan.seed == 3 and plan.rate == 0.1
+
+
+def test_rate_draws_are_sticky_deterministic_and_calibrated():
+    """A job's poisoning is a property of (seed, point, job id):
+    stable across calls and across plan instances, and the empirical
+    rate over many ids tracks the configured one."""
+    plan = FaultPlan(seed=5, rate=0.05, points=("execute_error",))
+    twin = FaultPlan(seed=5, rate=0.05, points=("execute_error",))
+    ids = [f"job{i}" for i in range(2000)]
+    poisoned = plan.poisoned_jobs("execute_error", ids)
+    assert poisoned == twin.poisoned_jobs("execute_error", ids)
+    assert poisoned == plan.poisoned_jobs("execute_error", ids)
+    assert 0.02 < len(poisoned) / len(ids) < 0.09
+    # a different seed draws a different set; a point not in the
+    # plan's list never fires from the rate
+    other = FaultPlan(seed=6, rate=0.05, points=("execute_error",))
+    assert set(other.poisoned_jobs("execute_error", ids)) \
+        != set(poisoned)
+    assert plan.poisoned_jobs("compile_error", ids) == []
+
+
+def test_schedule_entries_job_dispatch_and_unconditional():
+    plan = FaultPlan(schedule=[
+        {"point": "execute_error", "job_id": "jx"},
+        {"point": "compile_error", "dispatch_index": 3},
+        {"point": "cache_corrupt"},
+    ])
+    assert plan.job_fires("execute_error", "jx")
+    assert not plan.job_fires("execute_error", "jy")
+    with pytest.raises(FaultInjected) as e:
+        plan.check("execute_error", job_ids=("jy", "jx"))
+    assert e.value.point == "execute_error" and e.value.key == "jx"
+    # dispatch-index entries are TRANSIENT: that one attempt only
+    plan.check("compile_error", job_ids=("jy",), dispatch_index=2)
+    with pytest.raises(FaultInjected):
+        plan.check("compile_error", dispatch_index=3)
+    # unconditional entries fire on every probe of their point
+    with pytest.raises(FaultInjected):
+        plan.check("cache_corrupt", job_ids=("whatever",))
+    plan.check("execute_hang", job_ids=("jy",))   # silent: no entry
+
+
+def test_execute_hang_sleeps_then_raises_injected_sleep():
+    slept = []
+    plan = FaultPlan(hang_s=7.5, schedule=[
+        {"point": "execute_hang", "job_id": "jh"}])
+    with pytest.raises(FaultInjected):
+        plan.check("execute_hang", job_ids=("jh",),
+                   sleep=slept.append)
+    assert slept == [7.5]
+
+
+# -------------------------------------------------- circuit breaker
+
+
+def test_breaker_opens_sheds_probes_and_recovers():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=3, cooldown_s=10.0, clock=clock)
+    rung = "maxsum/factor:x"
+    for i in range(3):
+        assert b.before_dispatch(rung) == "dispatch"
+        opened = b.record_failure(rung)
+        assert opened == (i == 2)
+    assert b.state(rung) == "open"
+    assert b.before_dispatch(rung) == "shed"          # cooling down
+    clock.advance(9.9)
+    assert b.before_dispatch(rung) == "shed"
+    clock.advance(0.2)
+    # cooldown over: exactly one half-open probe goes through
+    assert b.before_dispatch(rung) == "dispatch"
+    assert b.state(rung) == "half_open"
+    b.record_success(rung)
+    assert b.state(rung) == "closed"
+    assert b.before_dispatch(rung) == "dispatch"
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    b.record_failure("r")
+    assert b.state("r") == "open"
+    clock.advance(5.1)
+    assert b.before_dispatch("r") == "dispatch"       # the probe
+    assert b.record_failure("r")                      # probe failed
+    assert b.state("r") == "open"
+    assert b.before_dispatch("r") == "shed"           # new cooldown
+    clock.advance(5.1)
+    assert b.before_dispatch("r") == "dispatch"
+    # success after the second probe closes for good
+    b.record_success("r")
+    assert b.state("r") == "closed"
+    # an interleaved success resets the consecutive count
+    b2 = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=clock)
+    b2.record_failure("r")
+    b2.record_success("r")
+    assert not b2.record_failure("r")                 # count restarted
+    assert b2.state("r") == "closed"
+
+
+# ------------------------- retry / bisection on a fake clock + sleep
+
+
+class _ScriptedDispatcher:
+    """Counts dispatch calls; fails any group containing a job id in
+    ``poisoned`` (sticky — the bisection-isolable shape) and any call
+    whose global index is in ``transient`` (the retry-absorbable
+    shape)."""
+
+    def __init__(self, poisoned=(), transient=()):
+        self.poisoned = set(poisoned)
+        self.transient = set(transient)
+        self.calls = []
+        self.stats = {"dispatches": 0, "jobs": 0}
+        self.exec_cache = None
+
+    def dispatch(self, group, queue_depth=0):
+        idx = len(self.calls)
+        self.calls.append([j.job_id for j in group.jobs])
+        if idx in self.transient:
+            raise RuntimeError(f"transient failure at dispatch {idx}")
+        bad = [j.job_id for j in group.jobs
+               if j.job_id in self.poisoned]
+        if bad:
+            raise FaultInjected("execute_error", bad[0])
+        self.stats["dispatches"] += 1
+        self.stats["jobs"] += len(group.jobs)
+        return [{"job_id": j.job_id, "status": "FINISHED"}
+                for j in group.jobs]
+
+
+def _fault_loop(tmp_path, dispatcher, clock=None, **kw):
+    from pydcop_tpu.observability.report import RunReporter
+
+    clock = clock or FakeClock()
+    slept = []
+    reporter = RunReporter(str(tmp_path / "faults.jsonl"),
+                           algo="serve", mode="serve")
+    loop = ServeLoop(
+        AdmissionQueue(max_batch=8, max_delay_s=0.01, clock=clock),
+        dispatcher, reporter=reporter, default_max_cycles=10,
+        clock=clock, sleep=slept.append, **kw)
+    return loop, reporter, clock, slept
+
+
+def _stub_jobs(n, key=("maxsum", (), 10, ("factor", 3, 4, (), 0))):
+    from pydcop_tpu.serving.queue import AdmittedJob, DispatchGroup
+
+    jobs = [AdmittedJob(job_id=f"job{i}", request={"id": f"job{i}"},
+                        dcop=None, arrays=None, padded=None,
+                        group_key=key, seed=0, max_cycles=10)
+            for i in range(n)]
+    return DispatchGroup(key, jobs, "full")
+
+
+def test_single_poisoned_job_isolated_in_log2_rounds(tmp_path):
+    """The acceptance shape: one poisoned job in an 8-job rung.  The
+    seven healthy siblings all complete, the poisoned job rejects
+    with the structured ``poisoned`` class, bisection recursion depth
+    is <= log2(8) = 3, and the only wait was ONE injected backoff —
+    no wall-clock sleeps anywhere."""
+    from pydcop_tpu.observability.report import (read_records,
+                                                 validate_record)
+
+    disp = _ScriptedDispatcher(poisoned=("job5",))
+    loop, reporter, clock, slept = _fault_loop(tmp_path, disp)
+    group = _stub_jobs(8)
+    done = loop._dispatch([group])
+    reporter.close()
+    assert done == 7
+    # dispatch rounds: initial + retry on the full group, then a
+    # binary descent — at most 2 calls per level over 3 levels
+    assert len(disp.calls) <= 2 + 2 * 3
+    completed = {j for call in disp.calls for j in call
+                 if len(call) and "job5" not in call}
+    assert completed == {f"job{i}" for i in range(8)} - {"job5"}
+    # exactly one backoff retry, on the injected sleep
+    assert slept == [loop._retry_backoff_s]
+    records = read_records(str(tmp_path / "faults.jsonl"))
+    for rec in records:
+        validate_record(rec)
+    rej = [r for r in records if r.get("status") == "REJECTED"]
+    assert [r["job_id"] for r in rej] == ["job5"]
+    assert rej[0]["reason_class"] == "poisoned"
+    assert "dispatch failed" in rej[0]["error"]
+    faults = [r for r in records if r.get("record") == "serve"
+              and r.get("event") == "fault"]
+    actions = [r["action"] for r in faults]
+    assert actions.count("retry") == 1
+    assert "bisect" in actions and "poisoned" in actions
+    # the injected fault is attributed in the audit trail
+    poisoned_rec = [r for r in faults if r["action"] == "poisoned"][0]
+    assert poisoned_rec["fault"] == {"point": "execute_error",
+                                     "key": "job5"}
+    assert max(r.get("depth", 0) for r in faults) <= 3
+    assert loop.stats["poisoned"] == 1
+    assert loop.stats["retries"] == 1
+    assert loop.stats["bisections"] >= 1
+
+
+def test_transient_failure_absorbed_by_backoff_retry(tmp_path):
+    """A dispatch-index (transient) failure: the retry succeeds, all
+    jobs complete, nothing is rejected, and the backoff schedule is
+    exponential on the injected sleep."""
+    disp = _ScriptedDispatcher(transient=(0,))
+    loop, reporter, clock, slept = _fault_loop(tmp_path, disp)
+    done = loop._dispatch([_stub_jobs(4)])
+    reporter.close()
+    assert done == 4 and loop.stats["rejected"] == 0
+    assert slept == [loop._retry_backoff_s]
+    assert len(disp.calls) == 2
+
+
+def test_backoff_schedule_is_exponential_without_sleeping(tmp_path):
+    """With max_retries=3 every retry doubles the injected backoff:
+    [b, 2b, 4b] — asserted with zero wall-clock waits."""
+    disp = _ScriptedDispatcher(poisoned=("job0",))
+    loop, reporter, clock, slept = _fault_loop(
+        tmp_path, disp, max_retries=3, retry_backoff_s=0.2)
+    done = loop._dispatch([_stub_jobs(1)])
+    reporter.close()
+    assert done == 0
+    assert slept == [pytest.approx(0.2), pytest.approx(0.4),
+                     pytest.approx(0.8)]
+
+
+def test_breaker_opens_after_n_total_failures_then_recovers(
+        tmp_path):
+    """Rung-level quarantine end-to-end: groups that fail TOTALLY (a
+    broken rung, not a poisoned input) open the breaker after the
+    threshold; the next group sheds with ``circuit_open`` and NO
+    dispatch attempt; after the cooldown (fake clock) the half-open
+    probe dispatches, succeeds, and the rung serves again."""
+    from pydcop_tpu.observability.report import read_records
+
+    disp = _ScriptedDispatcher(
+        poisoned=tuple(f"job{i}" for i in range(8)))  # everything
+    loop, reporter, clock, slept = _fault_loop(
+        tmp_path, disp, breaker_threshold=2, breaker_cooldown_s=30.0)
+    assert loop._dispatch([_stub_jobs(1)]) == 0   # total failure 1
+    assert loop._dispatch([_stub_jobs(1)]) == 0   # 2 -> breaker opens
+    calls_before = len(disp.calls)
+    assert loop._dispatch([_stub_jobs(2)]) == 0   # shed, no dispatch
+    assert len(disp.calls) == calls_before
+    assert loop.stats["shed"] == 2
+    clock.advance(30.1)
+    disp.poisoned = set()                         # rung healed
+    assert loop._dispatch([_stub_jobs(2)]) == 2   # half-open probe ok
+    assert loop._dispatch([_stub_jobs(2)]) == 2   # closed again
+    reporter.close()
+    records = read_records(str(tmp_path / "faults.jsonl"))
+    rej = [r for r in records if r.get("status") == "REJECTED"]
+    shed = [r for r in rej if r["reason_class"] == "circuit_open"]
+    assert len(shed) == 2
+    actions = [r["action"] for r in records
+               if r.get("record") == "serve"
+               and r.get("event") == "fault"]
+    assert "breaker_open" in actions
+    assert "circuit_open" in actions
+    assert "breaker_probe" in actions
+    assert "breaker_close" in actions
+
+
+def test_poisoned_probe_reopens_breaker(tmp_path):
+    disp = _ScriptedDispatcher(
+        poisoned=tuple(f"job{i}" for i in range(8)))
+    loop, reporter, clock, slept = _fault_loop(
+        tmp_path, disp, breaker_threshold=1, breaker_cooldown_s=5.0)
+    assert loop._dispatch([_stub_jobs(1)]) == 0   # opens (threshold 1)
+    clock.advance(5.1)
+    assert loop._dispatch([_stub_jobs(1)]) == 0   # probe fails
+    label = loop._rung_label(_stub_jobs(1))
+    assert loop._breaker.state(label) == "open"
+    calls = len(disp.calls)
+    assert loop._dispatch([_stub_jobs(1)]) == 0   # shed again
+    assert len(disp.calls) == calls
+    reporter.close()
+
+
+# --------------------------------------------------------- watchdog
+
+
+def test_watchdog_turns_hang_into_failure():
+    import time as _time
+
+    disp = Dispatcher(execute_deadline_s=0.05)
+    with pytest.raises(DispatchTimeout, match="deadline"):
+        disp._with_deadline(lambda: _time.sleep(0.5))
+    assert disp.stats["timeouts"] == 1
+    # fast work passes through, values and exceptions intact
+    assert disp._with_deadline(lambda: 42) == 42
+
+    def boom():
+        raise RuntimeError("organic")
+
+    with pytest.raises(RuntimeError, match="organic"):
+        disp._with_deadline(boom)
+    # without a deadline the call is inline (byte-identical path)
+    assert Dispatcher()._with_deadline(lambda: 7) == 7
+
+
+# ------------------------------------------- cache quarantine
+
+
+def test_exec_cache_quarantines_corrupt_entries(tmp_path):
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    if not cache.enabled:
+        pytest.skip("executable cache unavailable")
+    key = ("rung", "maxsum", 8)
+    path = cache._file_for(key)
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage, definitely not a pickle")
+    assert cache.load(key) is None
+    assert cache.stats["corrupt"] == 1
+    assert cache.stats["misses"] == 1
+    # quarantined: moved aside, not re-read every start
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    assert cache.load(key) is None               # plain miss now
+    assert cache.stats["corrupt"] == 1
+    assert cache.stats["misses"] == 2
+
+
+def test_cache_corrupt_fault_point_drives_quarantine(tmp_path):
+    """The chaos point garbles a real on-disk entry; the REAL read
+    path quarantines it and the caller recompiles."""
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    if not cache.enabled:
+        pytest.skip("executable cache unavailable")
+    import jax
+
+    compiled = jax.jit(lambda x: x + 1).lower(1.0).compile()
+    key = ("k",)
+    if not cache.store(key, compiled):
+        pytest.skip("jax.stages serialization unavailable")
+    assert cache.load(key) is not None           # healthy roundtrip
+    cache.faults = FaultPlan(
+        schedule=[{"point": "cache_corrupt"}])   # fires every load
+    assert cache.load(key) is None
+    assert cache.stats["corrupt"] == 1
+    assert os.path.exists(cache._file_for(key) + ".corrupt")
+
+
+# ------------------------------------------------ NaN cost planes
+
+
+def _nan_yaml(tmp_path, bad="0 * 1e400"):
+    src = "\n".join([
+        "name: nantest", "objective: min", "domains:",
+        "  colors: {values: [R, G]}", "variables:",
+        "  v0: {domain: colors}", "  v1: {domain: colors}",
+        "constraints:",
+        "  cgood: {type: intention, function: 2 if v0 == v1 else 0}",
+        f"  cbad: {{type: intention, "
+        f"function: {bad} if v0 == v1 else 1}}",
+        "agents: [a0, a1]", ""])
+    p = tmp_path / "nan.yaml"
+    p.write_text(src)
+    return str(p)
+
+
+def test_nan_costs_rejected_at_build_both_graphs(tmp_path):
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.graphs.arrays import (CostPlaneError,
+                                          FactorGraphArrays,
+                                          HypergraphArrays)
+
+    dcop = load_dcop_from_file(_nan_yaml(tmp_path))
+    with pytest.raises(CostPlaneError, match="cbad") as e:
+        FactorGraphArrays.build(dcop, arity_sorted=True)
+    assert e.value.kind == "constraint" and e.value.name == "cbad"
+    with pytest.raises(CostPlaneError, match="cbad"):
+        HypergraphArrays.build(filter_dcop(dcop))
+    # +-inf is NOT rejected: it is the documented hard-constraint
+    # encoding, clipped to +-HARD at build time
+    from pydcop_tpu.graphs.arrays import HARD
+
+    inf_dcop = load_dcop_from_file(_nan_yaml(tmp_path, bad="1e400"))
+    arrays = FactorGraphArrays.build(inf_dcop, arity_sorted=True)
+    assert float(max(np.max(b.cubes) for b in arrays.buckets)) \
+        == float(HARD)
+
+
+def test_nan_model_rejected_at_serve_admission(tmp_path):
+    """Serve admission surfaces the build-time NaN gate as a
+    structured REJECTED reason naming the constraint; siblings keep
+    serving."""
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+
+    good = tmp_path / "good.yaml"
+    good.write_text("\n".join([
+        "name: ok", "objective: min", "domains:",
+        "  colors: {values: [R, G]}", "variables:",
+        "  v0: {domain: colors}", "  v1: {domain: colors}",
+        "constraints:",
+        "  c0: {type: intention, function: 2 if v0 == v1 else 0}",
+        "agents: [a0, a1]", ""]))
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(AdmissionQueue(max_batch=8, max_delay_s=0.01),
+                     Dispatcher(reporter=reporter),
+                     reporter=reporter, default_max_cycles=10)
+    stats = loop.run_oneshot([
+        json.dumps({"id": "bad", "dcop": _nan_yaml(tmp_path),
+                    "algo": "maxsum", "max_cycles": 10}),
+        json.dumps({"id": "ok", "dcop": str(good),
+                    "algo": "maxsum", "max_cycles": 10}),
+    ])
+    reporter.close()
+    assert stats["completed"] == 1 and stats["rejected"] == 1
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    rej = [r for r in records if r.get("status") == "REJECTED"][0]
+    assert rej["job_id"] == "bad"
+    assert rej["reason_class"] == "prepare"
+    assert "CostPlaneError" in rej["error"] and "cbad" in rej["error"]
+
+
+def test_nan_delta_costs_rejected_structurally():
+    from pydcop_tpu.dynamics.deltas import DeltaError
+
+    from tests.test_faults import _nan_yaml  # noqa: F401 (self)
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.dynamics import build_dynamic_instance
+
+    dcop = load_dcop("\n".join([
+        "name: d", "objective: min", "domains:",
+        "  colors: {values: [R, G]}", "variables:",
+        "  v0: {domain: colors}", "  v1: {domain: colors}",
+        "constraints:",
+        "  c0: {type: intention, function: 2 if v0 == v1 else 0}",
+        "agents: [a0, a1]", ""]))
+    _rung, inst = build_dynamic_instance(dcop)
+    with pytest.raises(DeltaError, match="NaN") as e:
+        inst.compile_event([{"type": "change_costs", "name": "c0",
+                             "costs": [[0, float("nan")], [1, 0]]}])
+    assert e.value.kind == "bad_costs"
+
+
+def test_nan_planes_chaos_point_rejects_at_admission(tmp_path):
+    """The injected nan_planes fault: the scheduled job rejects with
+    the structured ``nan_planes`` class through the same finite gate;
+    its siblings complete."""
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records)
+
+    model = tmp_path / "m.yaml"
+    model.write_text("\n".join([
+        "name: ok", "objective: min", "domains:",
+        "  colors: {values: [R, G]}", "variables:",
+        "  v0: {domain: colors}", "  v1: {domain: colors}",
+        "constraints:",
+        "  c0: {type: intention, function: 2 if v0 == v1 else 0}",
+        "agents: [a0, a1]", ""]))
+    out = str(tmp_path / "serve.jsonl")
+    plan = FaultPlan(schedule=[{"point": "nan_planes",
+                                "job_id": "poisonme"}])
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(AdmissionQueue(max_batch=8, max_delay_s=0.01),
+                     Dispatcher(reporter=reporter),
+                     reporter=reporter, default_max_cycles=10,
+                     faults=plan)
+    stats = loop.run_oneshot([
+        json.dumps({"id": "poisonme", "dcop": str(model),
+                    "algo": "maxsum", "max_cycles": 10}),
+        json.dumps({"id": "fine", "dcop": str(model),
+                    "algo": "maxsum", "max_cycles": 10}),
+    ])
+    reporter.close()
+    assert stats["completed"] == 1 and stats["rejected"] == 1
+    rej = [r for r in read_records(out)
+           if r.get("status") == "REJECTED"][0]
+    assert rej["job_id"] == "poisonme"
+    assert rej["reason_class"] == "nan_planes"
+
+
+# ---------------------------------------- crash-recoverable sessions
+
+
+def _instance_yaml(tmp_path, n_vars=4, tag="dyn"):
+    lines = [f"name: {tag}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(n_vars):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k in range(n_vars - 1):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {4 + k} if v{k} == v{k + 1} else 0}}")
+    lines.append("agents: [" +
+                 ", ".join(f"a{i}" for i in range(n_vars)) + "]")
+    p = tmp_path / f"{tag}.yaml"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _target_request(path):
+    return {"id": "j", "dcop": path, "algo": "maxsum",
+            "max_cycles": 200}
+
+
+def _delta(target, ident, costs):
+    return {"op": "delta", "id": ident, "target": target,
+            "actions": [{"type": "change_costs", "name": "c0",
+                         "costs": costs}]}
+
+
+_C1 = [[0, 5, 9], [5, 0, 1], [9, 1, 0]]
+_C2 = [[3, 0, 2], [0, 4, 1], [2, 1, 0]]
+_C3 = [[1, 2, 0], [2, 0, 3], [0, 3, 1]]
+
+
+def test_journal_roundtrip_torn_tail_and_truncate(tmp_path):
+    from pydcop_tpu.dynamics.journal import JournalError, JournalStore
+
+    store = JournalStore(str(tmp_path / "j"))
+    assert not store.journaled("t1")
+    handle = store.open("t1")
+    handle.record_base({"id": "t1", "dcop": "x.yaml",
+                        "algo": "maxsum"}, seed=3, max_cycles=50)
+    handle.record_delta([{"type": "change_costs", "name": "c0",
+                          "costs": _C1}], max_cycles=None)
+    assert store.journaled("t1")
+    req, seed, mc, deltas = store.load("t1")
+    assert req["id"] == "t1" and seed == 3 and mc == 50
+    assert len(deltas) == 1
+    assert deltas[0]["actions"][0]["name"] == "c0"
+    # a torn tail (crash mid-append) is dropped, not fatal
+    with open(handle.path, "a") as f:
+        f.write('{"kind": "delta", "actio')
+    _req, _s, _mc, deltas = store.load("t1")
+    assert len(deltas) == 1
+    # corruption NOT at the tail refuses to replay
+    lines = open(handle.path).read().splitlines()
+    with open(handle.path, "w") as f:
+        f.write(lines[0] + "\n{broken}\n" + lines[1] + "\n")
+    with pytest.raises(JournalError, match="corrupt"):
+        store.load("t1")
+    # clean close truncates: nothing left to replay
+    handle.close(truncate=True)
+    assert not store.journaled("t1")
+
+
+def test_journal_replay_bit_exact_with_uninterrupted_session(
+        tmp_path):
+    """THE acceptance criterion: a killed-and-restarted dispatcher
+    answers delta #3 against a journaled session with selections AND
+    convergence cycles identical to the dispatcher that never
+    crashed, and the restart dispatch's open spans show
+    ``deserialize_s`` + ``journal_replay_s`` but no ``compile_s``."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+    from pydcop_tpu.engine._cache import ExecutableCache
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    if not cache.enabled:
+        pytest.skip("executable cache unavailable")
+    path = _instance_yaml(tmp_path)
+
+    class Rep:
+        def __init__(self):
+            self.records = []
+
+        def summary(self, **kw):
+            self.records.append(dict(kw, record="summary"))
+
+        def serve(self, **kw):
+            self.records.append(dict(kw, record="serve"))
+
+        def trace(self, *a, **kw):
+            pass
+
+    # the uninterrupted control: no journal, same exec cache
+    rep0 = Rep()
+    d0 = Dispatcher(reporter=rep0, exec_cache=cache)
+    d0.dispatch_delta(_delta("jA", "d1", _C1), _target_request(path))
+    d0.dispatch_delta(_delta("jA", "d2", _C2), _target_request(path))
+    expected = d0.dispatch_delta(_delta("jA", "d3", _C3),
+                                 _target_request(path))
+
+    # the crashed daemon: journaled, answers d1+d2, then "dies"
+    # (no close_all — the journal survives exactly like a kill -9)
+    store = JournalStore(str(tmp_path / "journals"))
+    d1 = Dispatcher(exec_cache=cache, journal=store)
+    d1.dispatch_delta(_delta("jA", "d1", _C1), _target_request(path))
+    d1.dispatch_delta(_delta("jA", "d2", _C2), _target_request(path))
+    assert store.journaled("jA")
+
+    # the restarted daemon: fresh dispatcher, EMPTY admitted-request
+    # index (target_request=None) — recovery must rebuild the warm
+    # session from the journal and answer d3 bit-exactly
+    rep2 = Rep()
+    d2 = Dispatcher(reporter=rep2, exec_cache=cache, journal=store)
+    recovered = d2.dispatch_delta(_delta("jA", "d3", _C3), None)
+    assert recovered["assignment"] == expected["assignment"]
+    assert recovered["cycle"] == expected["cycle"]
+    assert recovered["cost"] == expected["cost"]
+    assert recovered["warm_start"] is True
+    disp_rec = [r for r in rep2.records
+                if r.get("record") == "serve"
+                and r.get("reason") == "delta"][-1]
+    assert disp_rec["session_opened"] is True
+    assert disp_rec["journal_replayed"] == 2
+    spans = disp_rec["open_spans"]
+    assert "journal_replay_s" in spans
+    assert "deserialize_s" in spans
+    assert "compile_s" not in spans
+    assert "trace_lower_s" not in spans
+    assert d2.delta_sessions.stats["journal_replays"] == 1
+    # the recovered session keeps journaling: d3 is appended
+    _req, _seed, _mc, deltas = store.load("jA")
+    assert len(deltas) == 3
+
+
+def test_clean_shutdown_truncates_journals_and_residency(tmp_path):
+    """Clean exit is NOT a crash: the serve loop closes every warm
+    engine (zero resident session bytes in the final record) and
+    truncates the journals — recovery is for kills only."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+
+    path = _instance_yaml(tmp_path)
+    store = JournalStore(str(tmp_path / "journals"))
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(
+        AdmissionQueue(max_batch=2, max_delay_s=0.01),
+        Dispatcher(reporter=reporter, journal=store),
+        reporter=reporter, default_max_cycles=200)
+    stats = loop.run_oneshot([
+        json.dumps({"id": "j1", "dcop": path, "algo": "maxsum",
+                    "max_cycles": 200}),
+        json.dumps(_delta("j1", "d1", _C1)),
+    ])
+    reporter.close()
+    assert stats["completed"] == 2
+    assert not store.journaled("j1")
+    assert os.listdir(store.directory) == []
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    final = records[-1]
+    assert final["record"] == "serve"
+    assert final["sessions"]["closed"] == 1
+    assert final["memory"]["sessions_bytes"] == 0
+    assert final["memory"]["sessions_open"] == 0
+
+
+def test_fresh_session_open_truncates_stale_crash_journal(tmp_path):
+    """A client that re-admits the base job after a crash (bypassing
+    recovery, since the admitted-request index knows the target
+    again) must start a FRESH journal: appending a second base onto
+    the stale entries would corrupt every later replay."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+
+    path = _instance_yaml(tmp_path)
+    store = JournalStore(str(tmp_path / "journals"))
+    d1 = Dispatcher(journal=store)
+    d1.dispatch_delta(_delta("jA", "d1", _C1), _target_request(path))
+    d1.dispatch_delta(_delta("jA", "d2", _C2), _target_request(path))
+    # crash (no close); the restarted daemon sees the base job
+    # re-admitted, so the session opens FRESH with target_request set
+    d2 = Dispatcher(journal=store)
+    d2.dispatch_delta(_delta("jA", "d3", _C3), _target_request(path))
+    req, _seed, _mc, deltas = store.load("jA")
+    assert req["id"] == "j"          # exactly one (new) base record
+    assert len(deltas) == 1          # d3 only — stale d1/d2 gone
+    # and the fresh journal still replays
+    d3 = Dispatcher(journal=store)
+    rec = d3.dispatch_delta(_delta("jA", "d4", _C1), None)
+    assert rec["status"] in ("FINISHED", "MAX_CYCLES")
+
+
+def test_recover_uses_journaled_base_max_cycles(tmp_path):
+    """Replay must run under the CRASHED daemon's resolved cycle
+    budget, not the restarted daemon's default — a different budget
+    diverges the carried message planes."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+
+    path = _instance_yaml(tmp_path)
+    store = JournalStore(str(tmp_path / "journals"))
+    d1 = Dispatcher(journal=store)
+    req = dict(_target_request(path))
+    del req["max_cycles"]            # resolved from the daemon default
+    d1.dispatch_delta(_delta("jA", "d1", _C1), req,
+                      default_max_cycles=200)
+    d2 = Dispatcher(journal=store)
+    d2.dispatch_delta(_delta("jA", "d2", _C2), None,
+                      default_max_cycles=50)
+    engine = d2.delta_sessions._sessions["jA"]
+    assert engine.max_cycles == 200
+
+
+def test_unreplayable_journal_discarded_not_sticky(tmp_path):
+    """A journal that cannot replay (corrupt non-tail line) must be
+    discarded on the failed recovery, so the target falls back to
+    the clean unknown-target rejection instead of repeating the same
+    load error forever."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+
+    path = _instance_yaml(tmp_path)
+    store = JournalStore(str(tmp_path / "journals"))
+    d1 = Dispatcher(journal=store)
+    d1.dispatch_delta(_delta("jA", "d1", _C1), _target_request(path))
+    d1.dispatch_delta(_delta("jA", "d2", _C2), _target_request(path))
+    jpath = d1.delta_sessions._journals["jA"].path
+    lines = open(jpath).read().splitlines()
+    with open(jpath, "w") as f:
+        f.write(lines[0] + "\n{broken}\n" + lines[1] + "\n")
+    d2 = Dispatcher(journal=store)
+    with pytest.raises(Exception, match="corrupt"):
+        d2.dispatch_delta(_delta("jA", "d3", _C3), None)
+    assert not store.journaled("jA")
+    assert not d2.delta_sessions.has("jA")
+
+
+def test_timeout_evicts_cached_runner():
+    """After a watchdog timeout the abandoned worker may still be
+    executing the cached runner: the retry must build a fresh one."""
+    from pydcop_tpu.parallel.batch import (_RUNNER_CACHE,
+                                           evict_runner)
+
+    key = ("maxsum", ("factor", 3, 4, (), 0), 4, ())
+    _RUNNER_CACHE[key] = object()
+    try:
+        assert evict_runner("maxsum", ("factor", 3, 4, (), 0), 4, {})
+        assert key not in _RUNNER_CACHE
+        assert not evict_runner("maxsum", ("factor", 3, 4, (), 0),
+                                4, {})
+    finally:
+        _RUNNER_CACHE.pop(key, None)
+
+
+def test_eviction_and_drop_truncate_journal(tmp_path):
+    from pydcop_tpu.dynamics.journal import JournalStore
+
+    path_a = _instance_yaml(tmp_path, tag="A")
+    path_b = _instance_yaml(tmp_path, tag="B")
+    store = JournalStore(str(tmp_path / "journals"))
+    disp = Dispatcher(journal=store)
+    disp.delta_sessions.cap = 1
+    disp.dispatch_delta(_delta("jA", "d1", _C1),
+                        _target_request(path_a))
+    assert store.journaled("jA")
+    # opening B evicts A (cap 1): A's journal must not replay
+    disp.dispatch_delta(_delta("jB", "d2", _C2),
+                        _target_request(path_b))
+    assert not store.journaled("jA")
+    assert store.journaled("jB")
+    disp.delta_sessions.drop("jB")
+    assert not store.journaled("jB")
+
+
+# ------------------------------------ bench wiring (CI, ISSUE 13)
+
+
+def test_bench_chaos_quick_validates(tmp_path):
+    """The tier-1 leg of ``bench_chaos``: the quick chaos contract —
+    no daemon crash, every healthy job completes, only the plan's
+    poisoned jobs rejected (structured classes), retry+bisection
+    exercised, p99 within the degradation bound — runs on every PR,
+    and both legs' serve JSONL validates through the
+    ``pydcop telemetry-validate`` CLI."""
+    import importlib.util
+
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pydcop_bench_suite", os.path.join(repo, "benchmarks",
+                                           "suite.py"))
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+    result = suite.bench_chaos(quick=True, out_dir=str(tmp_path))
+    assert result["contracts_asserted"]
+    value = result["value"]
+    assert value["chaos"]["retries"] >= 1
+    assert value["chaos"]["bisections"] >= 1
+    assert value["chaos"]["poisoned"] >= 1
+    assert value["poisoned_jobs"]
+    for leg in ("control", "chaos"):
+        out = value[leg]["out"]
+        assert os.path.exists(out)
+        assert cli_main(["telemetry-validate", out, "--quiet"]) == 0

@@ -351,6 +351,10 @@ def test_delta_jobs_traced_and_sessions_accounted(tmp_path,
                                  "name": "c1",
                                  "costs": [[0, 5, 9], [5, 0, 1],
                                            [9, 1, 0]]}]}),
+        # mid-run probe: the warm session's residency must be
+        # measured WHILE it is open (the final record now proves the
+        # opposite — shutdown hygiene closed it)
+        json.dumps({"op": "stats", "id": "s1"}),
     ]
     loop.run_oneshot(lines)
     reporter.close()
@@ -369,12 +373,21 @@ def test_delta_jobs_traced_and_sessions_accounted(tmp_path,
     summary = [r for r in records if r["record"] == "summary"
                and r["job_id"] == "d1"][0]
     assert summary["trace_id"] == done["trace_id"]
-    # the warm session's residency is measured and surfaced
+    # the warm session's residency is measured and surfaced while
+    # the session is open (the mid-run stats record)...
+    stats_rec = [r for r in records if r["record"] == "serve"
+                 and r.get("event") == "stats"][0]
+    assert stats_rec["memory"]["sessions_open"] == 1
+    assert stats_rec["memory"]["sessions_bytes"] > 0
+    # ...and the FINAL record proves shutdown hygiene (ISSUE 13):
+    # clean exit closed every warm engine before reporting, so the
+    # post-mortem memory snapshot shows zero resident session bytes
     final = records[-1]
-    assert final["memory"]["sessions_open"] == 1
-    assert final["memory"]["sessions_bytes"] > 0
+    assert final["memory"]["sessions_open"] == 0
+    assert final["memory"]["sessions_bytes"] == 0
+    assert final["sessions"]["closed"] == 1
     assert registry.snapshot()["gauges"][
-        "pydcop_serve_sessions_open"][""] == 1
+        "pydcop_serve_sessions_open"][""] == 0
 
 
 # ------------------------------------------- telemetry-validate CLI

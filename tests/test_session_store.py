@@ -66,7 +66,8 @@ def test_stats_initialized_at_construction():
     full key set instead of keys appearing after the first event."""
     sessions = DeltaSessions()
     assert sessions.stats == {"opened": 0, "hits": 0, "evictions": 0,
-                              "dropped": 0, "evicted_bytes": 0}
+                              "dropped": 0, "evicted_bytes": 0,
+                              "closed": 0, "journal_replays": 0}
     snap = sessions.snapshot()
     assert snap["size"] == 0 and snap["resident_bytes"] == 0
     assert snap["budget_bytes"] is None and snap["cap"] == 16

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_3_and_v1_readers_stay_green():
+def test_schema_minor_is_4_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 3
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 4
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3):
+    for minor in (1, 2, 3, 4):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -424,6 +424,42 @@ def test_schema_minor_is_3_and_v1_readers_stay_green():
         validate_record({"record": "serve", "algo": "s",
                          "event": "dispatch",
                          "upload_bytes": "many"})
+    # minor-4 additive fields (fault-tolerant serving): structured
+    # rejection classes, the fault/retry audit records, and the
+    # journal-replay attribution all validate; malformed ones reject
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "REJECTED", "error": "boom",
+                     "reason_class": "poisoned"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "fault", "action": "retry",
+                     "rung": "maxsum/factor:x",
+                     "retry": {"attempt": 1, "backoff_s": 0.05},
+                     "fault": {"point": "execute_error",
+                               "key": "j17"}})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "fault", "action": "poisoned",
+                     "job_id": "j17", "error": "injected"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "dispatch", "reason": "delta",
+                     "journal_replayed": 3})
+    with pytest.raises(ValueError, match="reason_class"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "REJECTED", "reason_class": ""})
+    with pytest.raises(ValueError, match="action"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "fault", "action": "explode"})
+    with pytest.raises(ValueError, match="attempt"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "fault", "action": "retry",
+                         "retry": {"attempt": 0}})
+    with pytest.raises(ValueError, match="point"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "fault", "action": "bisect",
+                         "fault": {"key": "j1"}})
+    with pytest.raises(ValueError, match="journal_replayed"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch",
+                         "journal_replayed": -1})
 
 
 # ----------------------------------------- reporter lifecycle (ops)

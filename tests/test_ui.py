@@ -1,10 +1,21 @@
 """UI websocket server tests (reference: infrastructure/ui.py +
 tests/utils/ws-client.html)."""
 
+import importlib.util
 import json
 import time
 
 import pytest
+
+#: the client side of these tests drives the server through the
+#: optional ``websockets`` package (the server itself has no hard
+#: dependency on it) — on environments without it the four
+#: client-driven tests skip cleanly instead of erroring with
+#: ModuleNotFoundError
+needs_websockets = pytest.mark.skipif(
+    importlib.util.find_spec("websockets") is None,
+    reason="optional dependency 'websockets' is not installed "
+           "(client library for driving the UI websocket server)")
 
 from pydcop_tpu.infrastructure.agents import Agent
 from pydcop_tpu.infrastructure.communication import \
@@ -21,6 +32,7 @@ def test_func_args():
     assert func_args(f) == ["a", "b", "c", "d"]
 
 
+@needs_websockets
 def test_ui_server_agent_and_computations():
     from websockets.sync.client import connect
 
@@ -46,6 +58,7 @@ def test_ui_server_agent_and_computations():
         agent.clean_shutdown()
 
 
+@needs_websockets
 def test_ui_event_forwarding():
     from websockets.sync.client import connect
 
@@ -74,6 +87,7 @@ def test_ui_event_forwarding():
         agent.clean_shutdown()
 
 
+@needs_websockets
 def test_ui_unknown_command_and_garbage_frames():
     """Unknown commands answer with an error frame; non-JSON frames
     must not kill the connection."""
@@ -106,6 +120,7 @@ def test_ui_unknown_command_and_garbage_frames():
         agent.clean_shutdown(1)
 
 
+@needs_websockets
 def test_ui_two_concurrent_clients():
     """Every connected client gets its own answer stream."""
     from websockets.sync.client import connect
