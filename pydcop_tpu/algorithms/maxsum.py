@@ -952,6 +952,69 @@ def degree_slot_layout(deg):
     return var_order, var_pos, kbuckets, slot_base, slot_off
 
 
+def _oriented_cube_slices(cubes, pos: int):
+    """Stacked ``(f, D, D)`` binary cubes -> the ``(D, D, f)``
+    oriented ``cube_slotT`` slices of one edge position: the fused
+    update computes ``new_r[ds, s] = min_do cube_slotT[do, ds, s] +
+    q_partner[do, s]``, so pos 0 receives over axis 1 (transpose)
+    and pos 1 over axis 0 (as-is)."""
+    import numpy as np
+
+    return np.transpose(cubes, (2, 1, 0)) if pos == 0 \
+        else np.transpose(cubes, (1, 2, 0))
+
+
+def fused_cube_slot_table(arrays, canonical, slot_of_edge,
+                          ep: int):
+    """The full oriented per-slot cube table ``(D, D, E')`` of a
+    binary-only fused layout, built from the CURRENT cube planes —
+    one copy shared by the solver's layout build and the warm dynamic
+    engine's cold re-materialization (whose planes may have been
+    edited since construction)."""
+    import numpy as np
+
+    D = arrays.max_domain
+    cube_slotT = np.zeros((D, D, ep), dtype=np.float32)
+    for spec, b in zip(canonical, arrays.buckets):
+        if spec is None:
+            continue
+        off, f, _arity = spec
+        cubes = np.asarray(b.cubes)              # (f, D, D)
+        for pos in range(2):
+            es = off + 2 * np.arange(f) + pos
+            cube_slotT[:, :, slot_of_edge[es]] = \
+                _oriented_cube_slices(cubes, pos)
+    return cube_slotT
+
+
+def fused_cube_slot_writes(canonical, slot_of_edge, bucket_slots,
+                           bucket_cubes):
+    """One delta's binary cube edits as ``cube_slotT`` column writes:
+    ``(slots, values)`` with values row-major ``(2k, D, D)`` — each
+    edited factor contributes its two oriented slices.  The write-
+    list twin of :func:`fused_cube_slot_table`
+    (``dynamics/scatter.py`` pads and ships them)."""
+    import numpy as np
+
+    slots_out, vals_out = [], []
+    for bi, spec in enumerate(canonical):
+        if spec is None or not len(bucket_slots[bi]):
+            continue
+        off, _f, _arity = spec
+        fsl = np.asarray(bucket_slots[bi], dtype=np.int64)
+        cubes = np.asarray(bucket_cubes[bi], dtype=np.float32)
+        for pos in range(2):
+            slots_out.append(slot_of_edge[off + 2 * fsl + pos])
+            vals_out.append(np.transpose(
+                _oriented_cube_slices(cubes, pos), (2, 0, 1)))
+    if not slots_out:
+        D = 0
+        return (np.zeros(0, dtype=np.int64),
+                np.zeros((0, D, D), dtype=np.float32))
+    return (np.concatenate(slots_out),
+            np.concatenate(vals_out))
+
+
 class MaxSumFusedSolver(MaxSumLaneSolver):
     """Var-sorted, degree-bucketed ``(D, E')`` layout: ONE irregular op
     per cycle.
@@ -1068,6 +1131,10 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
             "var_pos": var_pos,
             "valid": valid,
             "slot_var_sorted": slot_var_sorted,
+            # canonical edge id -> slot position: the renumbering the
+            # warm dynamic engine maps touched-edge resets and cube
+            # writes through (dynamics/scatter.py)
+            "slot_of_edge": slot_of_edge,
         }
         self.EP = ep
 
@@ -1105,24 +1172,9 @@ class MaxSumFusedSolver(MaxSumLaneSolver):
         partner_slot = np.zeros(ep, dtype=np.int32)
         partner_slot[valid] = slot_of_edge[partner[slot_edge[valid]]]
 
-        # oriented per-slot cube slice: new_r[ds, s] =
-        #   min_do cube_slotT[do, ds, s] + q_partner[do, s]
-        cube_slotT = np.zeros((D, D, ep), dtype=np.float32)
-        for spec, b in zip(self._canonical, arrays.buckets):
-            if spec is None:
-                continue
-            off, f, _arity = spec
-            cubes = np.asarray(b.cubes)              # (f, D, D)
-            for pos in range(2):
-                es = off + 2 * np.arange(f) + pos
-                ss = slot_of_edge[es]
-                # pos 0 receives over axis 1 (transpose), pos 1 over
-                # axis 0 (as-is): cube_slotT[do, ds]
-                sl = np.transpose(cubes, (2, 1, 0)) if pos == 0 \
-                    else np.transpose(cubes, (1, 2, 0))
-                cube_slotT[:, :, ss] = sl
         self._np_fused["partner_slot"] = partner_slot
-        self._np_fused["cube_slotT"] = cube_slotT
+        self._np_fused["cube_slotT"] = fused_cube_slot_table(
+            arrays, self._canonical, slot_of_edge, ep)
 
     # ---------------------------------------------- device constants
 

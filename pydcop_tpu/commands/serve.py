@@ -126,6 +126,36 @@ def set_parser(subparsers):
                         help="maximum number of warm delta sessions "
                              "held open regardless of bytes "
                              "(default 16); LRU eviction past it")
+    parser.add_argument("--layout", default="edge_major",
+                        choices=["edge_major", "lane_major", "fused",
+                                 "auto"],
+                        help="warm-engine step layout delta sessions "
+                             "open at: edge_major (generic oracle, "
+                             "default), lane_major (edges on the "
+                             "128-wide lane dim — the TPU-tile "
+                             "layout; all event types), fused "
+                             "(fastest compiled cycle, ~2x on host "
+                             "CPU; cost and variable edits only — "
+                             "constraint add/remove rejects "
+                             "structurally), auto (lane_major when "
+                             "eligible).  All layouts are bit-exact; "
+                             "a target job's own -p layout:... "
+                             "overrides per session.  Echoed in "
+                             "dispatch records and the session "
+                             "journal (recovery replays under the "
+                             "journaled layout)")
+    parser.add_argument("--warm-budget", dest="warm_budget",
+                        default="adaptive",
+                        choices=["adaptive", "fixed"],
+                        help="warm re-solve cycle-budget schedule: "
+                             "adaptive (default) dispatches a "
+                             "geometric chunk schedule and stops at "
+                             "the first chunk boundary where the "
+                             "on-device stability rule fired "
+                             "(settle_chunk in dispatch records); "
+                             "fixed keeps constant chunk_size "
+                             "chunks.  Identical selections and "
+                             "cycles either way")
     parser.add_argument("--exec-cache", dest="exec_cache",
                         type=str, default=None, metavar="DIR",
                         help="directory for serialized jax.stages rung "
@@ -285,6 +315,8 @@ def run_cmd(args, timeout=None):
             reserve=reserve,
             session_budget_mb=session_budget_mb,
             session_cap=session_cap,
+            session_layout=getattr(args, "layout", "edge_major"),
+            warm_budget=getattr(args, "warm_budget", "adaptive"),
             exec_cache=(exec_cache.path
                         if exec_cache is not None
                         and exec_cache.enabled else None),
@@ -302,7 +334,9 @@ def run_cmd(args, timeout=None):
             session_cap=session_cap,
             session_budget_bytes=session_budget_bytes,
             faults=faults, execute_deadline_s=execute_deadline_s,
-            journal=journal)
+            journal=journal,
+            session_layout=getattr(args, "layout", "edge_major"),
+            warm_budget=getattr(args, "warm_budget", "adaptive"))
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,

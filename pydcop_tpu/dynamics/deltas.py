@@ -49,7 +49,9 @@ class DeltaError(ValueError):
     machine-readable class (``slot_budget`` / ``var_budget`` /
     ``unknown_variable`` / ``unknown_constraint`` /
     ``duplicate_variable`` / ``duplicate_constraint`` /
-    ``attached_factors`` / ``domain_budget`` / ``bad_args``) and
+    ``attached_factors`` / ``domain_budget`` / ``bad_args`` /
+    ``layout`` — a degree-changing event against a fused-layout
+    warm session) and
     ``details`` carries the structured context (arity, budget, live
     and free counts, names) — the serve daemon and the CLI surface
     these as rejection records, never stack traces."""
@@ -90,6 +92,18 @@ class TopologyDelta:
     touched_vars: np.ndarray = None         # (u,)
     # registry ops executed by DynamicInstance.apply, in order
     registry: List[Tuple] = field(default_factory=list)
+
+    @property
+    def degree_changing(self) -> bool:
+        """Whether this delta re-points canonical edges (constraint
+        add/remove changes which variable owns an edge).  The fused
+        warm layout bakes the variable-degree slot structure into the
+        compiled program (``algorithms/maxsum.degree_slot_layout``),
+        so it can absorb cost and variable-plane edits but not these —
+        ``DynamicEngine(layout='fused')`` rejects them loudly and
+        points at ``lane_major``/``edge_major``."""
+        return bool(self.summary.get("add_constraint")
+                    or self.summary.get("remove_constraint"))
 
 
 def _as_actions(actions) -> List[Tuple[str, Dict[str, Any]]]:

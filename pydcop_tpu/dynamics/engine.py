@@ -20,12 +20,50 @@ place and re-enters the same program:
   (arXiv 2502.13194).  Convergence bookkeeping (``same``/``finished``/
   ``cycle``) restarts, so each re-solve gets a fresh budget.
 
-Two modes share the public API: ``engine`` (single chip, the generic
-edge-major :class:`~pydcop_tpu.algorithms.maxsum.MaxSumSolver` step
-with its device constants swapped per call) and ``sharded``
+Two modes share the public API: ``engine`` (single chip, any of the
+three maxsum layouts — see below) and ``sharded``
 (:class:`DynamicShardedMaxSum`, whose mesh constants ride the engine
 CARRY instead of being closure-captured, so a consts swap cannot force
 a retrace).
+
+**Layouts** (``layout=`` kwarg, engine mode): the warm chunk can run
+any of the maxsum step layouts, each with its own swapped-argument
+plane set so every edit still re-enters the same compiled program:
+
+* ``edge_major`` (default) — the generic
+  :class:`~pydcop_tpu.algorithms.maxsum.MaxSumSolver` oracle; always
+  eligible, the only layout the sharded mode speaks;
+* ``lane_major`` — :class:`~pydcop_tpu.algorithms.maxsum.
+  MaxSumLaneSolver`: ``(D, E)`` state with edges on the 128-wide lane
+  dim (~6x faster per message in ``bench_mesh_dispatch``); argument
+  planes are the transposed cost/mask planes plus per-bucket
+  lane-major cubes, touched-edge resets become column writes;
+* ``fused`` — :class:`~pydcop_tpu.algorithms.maxsum.
+  MaxSumFusedSolver`: var-sorted slot space, one irregular op per
+  cycle; cost and variable-plane edits map through the canonical edge
+  renumbering (``slot_of_edge``/``var_pos``), while degree-changing
+  edits (constraint add/remove) are rejected loudly — the slot
+  structure is compiled shape, use ``lane_major`` for topology
+  traffic;
+* ``auto`` — ``lane_major`` when the padded instance is eligible,
+  else ``edge_major``.
+
+All layouts produce bit-identical selections AND convergence cycles
+on integer-cost instances (the ``dyn`` test matrix asserts it), so
+the choice is purely a throughput knob.
+
+**Convergence-aware budgets** (``warm_budget="adaptive"``, the
+default): a warm re-solve dispatches a geometric chunk schedule —
+small first chunk growing toward ``chunk_size`` — and stops at the
+first chunk boundary where the on-device stability rule
+(SAME_COUNT stable cycles) has fired, so a 3-cycle settle costs a
+small dispatched chunk instead of a full ``chunk_size`` program, with
+zero extra host syncs in engine mode (the two-scalar boundary read
+the fixed schedule already paid; the sharded adaptive path re-enters
+``drive`` per chunk and pays two extra scalar reads each — host
+microseconds).  ``warm_budget="fixed"`` keeps constant
+``chunk_size`` chunks; both return identical selections and cycles —
+the chunked step arithmetic is boundary-invariant (the PR 2 guard).
 """
 
 import time
@@ -108,7 +146,21 @@ class DynamicEngine:
                  max_cycles: int = 2000,
                  exec_cache=None,
                  carry: str = "messages",
-                 resident: bool = True):
+                 resident: bool = True,
+                 layout: str = "edge_major",
+                 warm_budget: str = "adaptive"):
+        if layout not in ("edge_major", "lane_major", "fused",
+                          "auto"):
+            raise ValueError(
+                f"layout must be 'edge_major', 'lane_major', 'fused' "
+                f"or 'auto', got {layout!r}")
+        if warm_budget not in ("fixed", "adaptive"):
+            raise ValueError(
+                f"warm_budget must be 'fixed' (constant chunk_size "
+                f"chunks) or 'adaptive' (geometric schedule, stop at "
+                f"the first settled chunk boundary), got "
+                f"{warm_budget!r}")
+        self.warm_budget = warm_budget
         if carry not in ("messages", "reset"):
             raise ValueError(
                 f"carry must be 'messages' (conditional-Max-Sum "
@@ -166,11 +218,31 @@ class DynamicEngine:
         self._state = None
         self._args_dev = None
         self._aot: Dict[Tuple, Any] = {}
-        if mode == "engine":
-            from ..algorithms.maxsum import MaxSumSolver
+        if mode == "sharded" and layout not in ("edge_major", "auto"):
+            raise ValueError(
+                f"the sharded dynamic engine carries its mesh "
+                f"constants in the edge-major carry layout only; "
+                f"{layout!r} warm re-solves are single-chip "
+                f"(mode='engine')")
+        if layout == "auto":
+            from ..algorithms.maxsum import MaxSumLaneSolver
 
-            self._base = MaxSumSolver(self.instance.arrays,
-                                      **solver_params)
+            layout = ("lane_major"
+                      if mode == "engine"
+                      and MaxSumLaneSolver.eligible(
+                          self.instance.arrays)
+                      else "edge_major")
+        self.layout = layout
+        if mode == "engine":
+            from ..algorithms.maxsum import (MaxSumFusedSolver,
+                                             MaxSumLaneSolver,
+                                             MaxSumSolver)
+
+            solver_cls = {"edge_major": MaxSumSolver,
+                          "lane_major": MaxSumLaneSolver,
+                          "fused": MaxSumFusedSolver}[layout]
+            self._base = solver_cls(self.instance.arrays,
+                                    **solver_params)
             self._chunk_jit = None
             self._solver = None
         else:
@@ -202,16 +274,27 @@ class DynamicEngine:
     def resident_bytes(self) -> int:
         """Approximate bytes this warm session keeps resident: the
         carried message state (q/r planes and friends), the device
-        argument planes, and the host instance arrays.  This is the
-        per-session cost a byte-budgeted session store (ROADMAP: LRU
-        eviction) weighs against its budget — an estimate for policy,
-        not an allocator truth."""
+        argument planes, the solver's cached device constants, and
+        the host instance arrays.  This is the per-session cost a
+        byte-budgeted session store (ROADMAP: LRU eviction) weighs
+        against its budget — an estimate for policy, not an allocator
+        truth."""
         from ..observability.memory import approx_object_bytes
 
         seen = set()
-        return (approx_object_bytes(self._state, seen)
-                + approx_object_bytes(self._args_dev, seen)
-                + approx_object_bytes(self.instance.arrays, seen))
+        total = (approx_object_bytes(self._state, seen)
+                 + approx_object_bytes(self._args_dev, seen)
+                 + approx_object_bytes(self.instance.arrays, seen))
+        if self._base is not None:
+            # the layout's static device constants live in the
+            # solver's lazy-constant cache, NOT the argument planes
+            # (the fused slot tables — cube orientation aside, a
+            # (D, D, E') table rivals the cubes themselves — and the
+            # lane masks): counting only the edge-major plane set
+            # under-reported lane/fused sessions to the session
+            # store's --session-budget-mb evictor
+            total += approx_object_bytes(self._base._dev_cache, seen)
+        return total
 
     # ---------------------------------------------------------- apply
 
@@ -229,6 +312,22 @@ class DynamicEngine:
 
         t0 = _time.perf_counter()
         delta = self.instance.compile_event(event)
+        if self.layout == "fused" and delta.degree_changing:
+            from .deltas import DeltaError
+
+            # compile_event is pure, so the instance is untouched:
+            # the rejection is transactional like every DeltaError
+            raise DeltaError(
+                "the fused layout bakes the variable-degree slot "
+                "structure into the compiled program; constraint "
+                "add/remove events need layout='lane_major' (or "
+                "'edge_major') — fused warm sessions absorb "
+                "change_costs and variable add/remove only",
+                kind="layout", layout="fused",
+                add_constraint=int(
+                    delta.summary.get("add_constraint", 0)),
+                remove_constraint=int(
+                    delta.summary.get("remove_constraint", 0)))
         self.instance.apply(delta)
         self.last_edit = dict(delta.summary)
         if self.mode == "sharded":
@@ -304,27 +403,45 @@ class DynamicEngine:
         """Scatter the delta into the resident argument planes (and
         the touched q/r/selection rows) via buffer donation: the next
         solve re-enters the same executable over the updated buffers,
-        and the per-event upload is the write lists alone."""
+        and the per-event upload is the write lists alone.  Each
+        layout has its own write-list coordinates and scatter body
+        (``dynamics/scatter.py``): canonical edge rows for
+        edge_major, transposed columns for lane_major, the
+        ``slot_of_edge``/``var_pos`` renumbering for fused."""
         from functools import partial
 
         from .scatter import (delta_write_lists, engine_scatter_fn,
+                              fused_scatter_fn, fused_write_lists,
+                              lane_scatter_fn, lane_write_lists,
                               tree_nbytes)
 
-        w = delta_write_lists(self.instance.arrays, delta,
-                              with_state=with_state)
+        if self.layout == "lane_major":
+            w = lane_write_lists(self.instance.arrays, delta,
+                                 with_state=with_state)
+            build = partial(lane_scatter_fn, with_state)
+            key = ("scatter_lane", with_state)
+        elif self.layout == "fused":
+            w = fused_write_lists(self.instance.arrays, self._base,
+                                  delta, with_state=with_state)
+            build = partial(fused_scatter_fn,
+                            self._base._all_binary, with_state)
+            key = ("scatter_fused", self._base._all_binary,
+                   with_state)
+        else:
+            w = delta_write_lists(self.instance.arrays, delta,
+                                  with_state=with_state)
+            build = partial(engine_scatter_fn, with_state)
+            key = ("scatter_engine", with_state)
         self._pending_upload += tree_nbytes(w)
         if with_state:
             compiled = self._scatter_compiled(
-                ("scatter_engine", True),
-                partial(engine_scatter_fn, True),
+                key, build,
                 (self._args_dev, self._state, w), donate=(0, 1))
             self._args_dev, self._state = compiled(
                 self._args_dev, self._state, w)
         else:
             compiled = self._scatter_compiled(
-                ("scatter_engine", False),
-                partial(engine_scatter_fn, False),
-                (self._args_dev, w), donate=(0,))
+                key, build, (self._args_dev, w), donate=(0,))
             self._args_dev = compiled(self._args_dev, w)
 
     def _apply_resident_sharded(self, delta: TopologyDelta):
@@ -364,9 +481,9 @@ class DynamicEngine:
         # Asserted by telemetry as "no trace/compile span".
         warm = self.solves > 0
         if self.mode == "engine":
-            out = self._solve_engine(budget, seed, timeout)
+            out = self._solve_engine(budget, seed, timeout, warm)
         else:
-            out = self._solve_sharded(budget, seed, timeout)
+            out = self._solve_sharded(budget, seed, timeout, warm)
         # fold the pending apply spans (apply_s wall, plus any one-off
         # apply_trace_lower_s/apply_compile_s of a new scatter shape)
         # into this solve's record, and close the upload accounting
@@ -385,6 +502,11 @@ class DynamicEngine:
         out["upload_bytes"] = int(self.last_upload_bytes)
         out["warm_start"] = bool(warm)
         out["carry"] = self.carry
+        out["layout"] = self.layout
+        # the convergence-aware budget telemetry (schema minor 5):
+        # executed cycles, dispatched chunks, and the chunk index at
+        # which the stability rule fired (None = never settled)
+        out["cycles_run"] = int(out.get("cycle", 0))
         out["edit"] = dict(self.last_edit) if warm and self.last_edit \
             else None
         self.last_edit = None
@@ -393,47 +515,117 @@ class DynamicEngine:
 
     def close(self):
         """Release the engine's device residency: the carried message
-        state, the resident argument planes and the per-signature
-        compiled-program handles.  The byte-budgeted session store
-        calls this on eviction; the engine stays usable — a later
-        solve re-uploads from the (authoritative) host planes and
-        re-enters the rung's executable through the cache."""
+        state, the resident argument planes, the solver's cached
+        device constants and the per-signature compiled-program
+        handles.  The byte-budgeted session store calls this on
+        eviction; the engine stays usable — a later solve re-uploads
+        from the (authoritative) host planes and re-enters the rung's
+        executable through the cache."""
         self._state = None
         self._args_dev = None
         self._aot.clear()
         if self.mode == "engine":
             self._chunk_jit = None
+            if self._base is not None:
+                # the lane/fused static constants (slot tables,
+                # transposed masks) are device buffers too: eviction
+                # must release them, not just the argument planes
+                self._base._dev_cache.clear()
         self._pending_spans = {}
         self._pending_upload = 0
 
     # ------------------------------------------------- single-chip mode
 
     def _args_engine(self):
+        """The layout's swapped-argument plane set, materialized from
+        the CURRENT (possibly edited) host planes.  The re-upload tax
+        the resident path eliminates: the FULL materialization counts
+        against upload_bytes."""
         a = self.instance.arrays
         import jax.numpy as jnp
 
         from .scatter import tree_nbytes
 
-        store = self._base.policy.store_dtype
-        args = {
-            "cubes": [jnp.asarray(b.cubes, dtype=store)
-                      for b in a.buckets],
-            "var_ids": [jnp.asarray(b.var_ids) for b in a.buckets],
-            "var_costs": jnp.asarray(a.var_costs, dtype=store),
-            "domain_mask": jnp.asarray(a.domain_mask),
-            "domain_size": jnp.asarray(a.domain_size),
-            "edge_var": jnp.asarray(a.edge_var),
-        }
-        # the re-upload tax the resident path eliminates: the FULL
-        # plane materialization counts against upload_bytes
+        base = self._base
+        store = base.policy.store_dtype
+        if self.layout == "lane_major":
+            maskT = np.asarray(a.domain_mask).T
+            args = {
+                "cubesT": [
+                    None if spec is None
+                    else jnp.asarray(b.cubes_lane_major(),
+                                     dtype=store)
+                    for b, spec in zip(a.buckets, base._canonical)],
+                "var_costsT": jnp.asarray(
+                    np.asarray(a.var_costs).T, dtype=store),
+                "domain_maskT": jnp.asarray(maskT),
+                "emaskT": jnp.asarray(
+                    maskT[:, np.asarray(a.edge_var)]),
+                "domain_size": jnp.asarray(a.domain_size),
+                "edge_var": jnp.asarray(a.edge_var),
+            }
+        elif self.layout == "fused":
+            from ..algorithms.maxsum import fused_cube_slot_table
+
+            nf = base._np_fused
+            # materialize the static slot structure ONCE into the
+            # solver's device-constant cache: traced as constants,
+            # counted by resident_bytes, released by close().  The
+            # supported fused edits (cost / variable planes) never
+            # touch it — degree-changing deltas are rejected
+            # upstream.  slot_dsize / dsize_sorted_vars stay stale
+            # constants on purpose: variable add/remove only touches
+            # rows whose slots are INVALID under the fused dialect
+            # (degree 0 at build), where emaskT_fused masks every
+            # read of them, and the one other consumer
+            # (_decim_eligible) is unreachable — DynamicEngine
+            # rejects decimation on every layout.  If that rejection
+            # is ever lifted, these must become swapped arguments
+            # like domain_size is on the other two layouts
+            _ = (base.emaskT_fused, base.slot_dsize,
+                 base.var_pos_dev)
+            _ = (base.partner_slot,) if base._all_binary \
+                else (base.pos_slots, base.slot_src)
+            args = {
+                "var_costsT_sorted": jnp.asarray(
+                    np.asarray(a.var_costs).T[:, nf["var_order"]],
+                    dtype=store),
+                "domain_maskT_sorted": jnp.asarray(
+                    np.asarray(a.domain_mask).T[:, nf["var_order"]]),
+            }
+            if base._all_binary:
+                args["cube_slotT"] = jnp.asarray(
+                    fused_cube_slot_table(
+                        a, base._canonical, nf["slot_of_edge"],
+                        base.EP),
+                    dtype=store)
+            else:
+                args["cubesT"] = [
+                    None if spec is None
+                    else jnp.asarray(b.cubes_lane_major(),
+                                     dtype=store)
+                    for b, spec in zip(a.buckets, base._canonical)]
+        else:
+            args = {
+                "cubes": [jnp.asarray(b.cubes, dtype=store)
+                          for b in a.buckets],
+                "var_ids": [jnp.asarray(b.var_ids)
+                            for b in a.buckets],
+                "var_costs": jnp.asarray(a.var_costs, dtype=store),
+                "domain_mask": jnp.asarray(a.domain_mask),
+                "domain_size": jnp.asarray(a.domain_size),
+                "edge_var": jnp.asarray(a.edge_var),
+            }
         self._pending_upload += tree_nbytes(args)
         return args
 
     def _chunk_fn(self):
         """The warm chunk: the base solver's step driven to ``limit``
         with every topology-dependent device constant swapped for the
-        ARGUMENT planes — one compiled program per rung, any edit
-        re-enters it."""
+        ARGUMENT planes — one compiled program per (rung, layout),
+        any edit re-enters it.  Which constants swap is the layout's
+        contract; everything else (fused slot tables, canonical
+        offsets) stays a compiled constant."""
         import jax
         import jax.numpy as jnp
 
@@ -441,9 +633,30 @@ class DynamicEngine:
 
         base = self._base
         tmpl = base.arrays
+        layout = self.layout
 
-        def run_chunk(args, state, limit):
-            updates = {
+        def updates_of(args):
+            if layout == "lane_major":
+                return {
+                    "bucketsT": args["cubesT"],
+                    "var_costsT": args["var_costsT"],
+                    "domain_maskT": args["domain_maskT"],
+                    "emaskT": args["emaskT"],
+                    "domain_size": args["domain_size"],
+                    "edge_var": args["edge_var"],
+                }
+            if layout == "fused":
+                u = {
+                    "var_costsT_sorted": args["var_costsT_sorted"],
+                    "domain_maskT_sorted":
+                        args["domain_maskT_sorted"],
+                }
+                if base._all_binary:
+                    u["cube_slotT"] = args["cube_slotT"]
+                else:
+                    u["bucketsT"] = args["cubesT"]
+                return u
+            return {
                 "buckets": [
                     (args["cubes"][bi],
                      jnp.asarray(tmpl.buckets[bi].edge_ids),
@@ -454,7 +667,9 @@ class DynamicEngine:
                 "domain_size": args["domain_size"],
                 "edge_var": args["edge_var"],
             }
-            saved = _swap_dev(base, updates)
+
+        def run_chunk(args, state, limit):
+            saved = _swap_dev(base, updates_of(args))
             try:
                 def cond(s):
                     return jnp.logical_and(
@@ -467,17 +682,39 @@ class DynamicEngine:
 
         return run_chunk
 
+    def _sel_restart(self, row: int) -> int:
+        """A touched variable's restart selection: the masked unary
+        argmin, identical host arithmetic on every layout/path."""
+        a = self.instance.arrays
+        return int(np.argmin(np.where(
+            a.domain_mask[row],
+            np.asarray(a.var_costs[row], dtype=np.float32),
+            SENTINEL)))
+
     def _fresh_state_engine(self, seed: int):
         import jax
         import jax.numpy as jnp
 
         a = self.instance.arrays
-        emask = np.asarray(a.domain_mask)[np.asarray(a.edge_var)]
+        mask = np.asarray(a.domain_mask)
+        costs = np.asarray(a.var_costs, dtype=np.float32)
+        if self.layout == "fused":
+            nf = self._base._np_fused
+            order = nf["var_order"]
+            emask = (mask.T[:, order][:, nf["slot_var_sorted"]]
+                     & nf["valid"][None, :])          # (D, E')
+            sel = np.argmin(
+                np.where(mask[order], costs[order], SENTINEL),
+                axis=1).astype(np.int32)              # sorted order
+        elif self.layout == "lane_major":
+            emask = mask.T[:, np.asarray(a.edge_var)]  # (D, E)
+            sel = np.argmin(np.where(mask, costs, SENTINEL),
+                            axis=1).astype(np.int32)
+        else:
+            emask = mask[np.asarray(a.edge_var)]       # (E, D)
+            sel = np.argmin(np.where(mask, costs, SENTINEL),
+                            axis=1).astype(np.int32)
         q = np.where(emask, 0.0, BIG).astype(np.float32)
-        sel = np.argmin(
-            np.where(a.domain_mask,
-                     np.asarray(a.var_costs, dtype=np.float32),
-                     SENTINEL), axis=1).astype(np.int32)
         self._pending_upload += 2 * q.nbytes + sel.nbytes
         return {
             "cycle": jnp.int32(0),
@@ -491,26 +728,36 @@ class DynamicEngine:
 
     def _warm_reset_engine(self, delta: TopologyDelta):
         """Carry the previous fixed point; neutralize exactly the
-        touched rows.  Convergence bookkeeping restarts so the
-        re-solve gets its own budget."""
+        touched rows — mapped into the layout's own state
+        coordinates (edge rows, lane columns, or fused slots).
+        Convergence bookkeeping restarts so the re-solve gets its own
+        budget."""
         import jax.numpy as jnp
 
         a = self.instance.arrays
         s = self._state
         q = np.array(s["q"])
         r = np.array(s["r"])
+        sel = np.array(s["selection"])
         te = delta.touched_edges
         if len(te):
             emask = np.asarray(a.domain_mask)[
-                np.asarray(a.edge_var)[te]]
-            q[te] = np.where(emask, 0.0, BIG)
-            r[te] = 0.0
-        sel = np.array(s["selection"])
+                np.asarray(a.edge_var)[te]]           # (t, D)
+            neutral = np.where(emask, 0.0, BIG)
+            if self.layout == "fused":
+                ts = self._base._np_fused["slot_of_edge"][te]
+                q[:, ts] = neutral.T
+                r[:, ts] = 0.0
+            elif self.layout == "lane_major":
+                q[:, te] = neutral.T
+                r[:, te] = 0.0
+            else:
+                q[te] = neutral
+                r[te] = 0.0
         for row in delta.touched_vars:
-            sel[row] = int(np.argmin(np.where(
-                a.domain_mask[row],
-                np.asarray(a.var_costs[row], dtype=np.float32),
-                SENTINEL)))
+            pos = (self._base._np_fused["var_pos"][row]
+                   if self.layout == "fused" else row)
+            sel[pos] = self._sel_restart(int(row))
         # the host round-trip re-uploads the FULL message state
         self._pending_upload += q.nbytes + r.nbytes + sel.nbytes
         self._state = {
@@ -538,7 +785,8 @@ class DynamicEngine:
         ex_args = (args, state, jnp.int32(0))
         if self.exec_cache is not None:
             full_key = (("dynamics", self.algo, self.mode,
-                         self.rung.signature, self._key),
+                         self.layout, self.rung.signature,
+                         self._key),
                         aval_signature(ex_args))
             sig = ("dyn",) + aval_signature(ex_args)
             entry = self._aot.get(sig)
@@ -558,8 +806,19 @@ class DynamicEngine:
             self._aot, "dyn", self._chunk_jit, ex_args, clock)
         return compiled
 
+    def _first_chunk(self, warm: bool) -> int:
+        """The schedule's opening chunk: warm adaptive re-solves
+        start small (most warm events settle within a few cycles —
+        conditional Max-Sum's premise) and grow geometrically toward
+        ``chunk_size``; cold solves and fixed budgets dispatch
+        constant ``chunk_size`` chunks."""
+        if not warm or self.warm_budget == "fixed":
+            return self.chunk
+        return max(1, self.chunk // 8)
+
     def _solve_engine(self, budget: int, seed: int,
-                      timeout: Optional[float]) -> Dict[str, Any]:
+                      timeout: Optional[float],
+                      warm: bool) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ..observability.spans import SpanClock
@@ -573,10 +832,17 @@ class DynamicEngine:
         run = self._runner_engine(self._args_dev, state, clock)
         t0 = time.perf_counter()
         status = "MAX_CYCLES"
+        step_chunk = self._first_chunk(warm)
+        chunks_run = 0
+        settle_chunk = None
         while True:
+            # the two-scalar boundary sync the fixed schedule already
+            # paid: the stability rule is evaluated ON DEVICE inside
+            # the chunk, the host only reads its verdict here
             cycle = int(state["cycle"])
             if bool(state["finished"]):
                 status = "FINISHED"
+                settle_chunk = chunks_run
                 break
             if cycle >= budget:
                 break
@@ -584,13 +850,22 @@ class DynamicEngine:
                     time.perf_counter() - t0 > timeout:
                 status = "TIMEOUT"
                 break
-            limit = min(cycle + self.chunk, budget)
+            limit = min(cycle + step_chunk, budget)
             state = run(self._args_dev, state, jnp.int32(limit))
+            chunks_run += 1
+            step_chunk = min(self.chunk, step_chunk * 2)
         clock.add("execute_s", time.perf_counter() - t0)
         self._state = state
         self.last_spans = clock.as_dict()
         sel = np.array(state["selection"])
-        return self._result(sel, int(state["cycle"]), status)
+        if self.layout == "fused":
+            # fused state order is degree-sorted: decode to original
+            # variable rows before eval/registry decode
+            sel = sel[self._base._np_fused["var_pos"]]
+        out = self._result(sel, int(state["cycle"]), status)
+        out["chunks_run"] = chunks_run
+        out["settle_chunk"] = settle_chunk
+        return out
 
     # ---------------------------------------------------- sharded mode
 
@@ -683,7 +958,8 @@ class DynamicEngine:
         self._state = state
 
     def _solve_sharded(self, budget: int, seed: int,
-                       timeout: Optional[float]) -> Dict[str, Any]:
+                       timeout: Optional[float],
+                       warm: bool) -> Dict[str, Any]:
         import jax
 
         solver = self._solver
@@ -693,15 +969,69 @@ class DynamicEngine:
             self._state = solver.mesh_init(int(seed))
             self._pending_upload += tree_nbytes(self._state)
         eng = solver._mesh_engine()
-        state = eng.drive(self._state, budget, timeout=timeout,
-                          spans=True)
+        if not warm or self.warm_budget == "fixed":
+            # the fixed schedule IS drive's own internal loop: one
+            # call, one boundary sync per chunk — exactly the
+            # pre-adaptive dispatch pattern
+            state = eng.drive(self._state, budget, timeout=timeout,
+                              spans=True, chunk_size=self.chunk)
+            self._state = state
+            self.last_spans = dict(eng.last_spans)
+            cycles = int(state["cycle"])
+            finished = bool(state["finished"])
+            status = "FINISHED" if finished else \
+                eng.last_stats.get("status", "MAX_CYCLES")
+            sel = np.asarray(jax.device_get(state["sel"]))[0]
+            out = self._result(sel, cycles, status)
+            out["chunks_run"] = int(eng.last_stats.get(
+                "dispatches", 0))
+            out["settle_chunk"] = (out["chunks_run"]
+                                   if finished else None)
+            return out
+        t0 = time.perf_counter()
+        state = self._state
+        status = "MAX_CYCLES"
+        step_chunk = self._first_chunk(warm)
+        chunks_run = 0
+        settle_chunk = None
+        spans: Dict[str, float] = {}
+        while True:
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                settle_chunk = chunks_run
+                break
+            if cycle >= budget:
+                break
+            left = None if timeout is None else \
+                timeout - (time.perf_counter() - t0)
+            if left is not None and left <= 0:
+                status = "TIMEOUT"
+                break
+            # one geometric-schedule chunk per drive call: the mesh
+            # engine's AOT cache is per-solver, so every call after
+            # the first re-enters the same compiled chunk.  Honest
+            # cost note: drive re-reads the two boundary scalars at
+            # its own loop head and tail, so the sharded adaptive
+            # path pays two extra two-scalar syncs per chunk over
+            # the fixed schedule — host microseconds against a
+            # multi-ms mesh chunk, but not literally zero
+            state = eng.drive(state,
+                              min(cycle + step_chunk, budget),
+                              timeout=left, spans=True,
+                              chunk_size=step_chunk)
+            for k, v in eng.last_spans.items():
+                spans[k] = round(spans.get(k, 0.0) + v, 6)
+            chunks_run += 1
+            step_chunk = min(self.chunk, step_chunk * 2)
         self._state = state
-        self.last_spans = dict(eng.last_spans)
+        self.last_spans = spans
         cycles = int(state["cycle"])
-        status = "FINISHED" if bool(state["finished"]) else \
-            eng.last_stats.get("status", "MAX_CYCLES")
         sel = np.asarray(jax.device_get(state["sel"]))[0]
-        return self._result(sel, cycles, status)
+        out = self._result(sel, cycles, status)
+        out["chunks_run"] = chunks_run
+        out["settle_chunk"] = settle_chunk
+        return out
 
     # ----------------------------------------------------------- decode
 

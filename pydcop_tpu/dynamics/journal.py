@@ -61,11 +61,19 @@ class SessionJournal:
         os.fsync(self._f.fileno())
 
     def record_base(self, request: Dict[str, Any], seed: int,
-                    max_cycles: int):
-        """The session's base solve — appended AFTER it succeeded."""
-        self._append({"kind": "base", "target": self.target,
-                      "request": request, "seed": int(seed),
-                      "max_cycles": int(max_cycles)})
+                    max_cycles: int,
+                    layout: Optional[str] = None):
+        """The session's base solve — appended AFTER it succeeded.
+        ``layout`` records the RESOLVED warm-engine layout the
+        session ran under (same rule as the resolved ``max_cycles``):
+        recovery must rebuild the session at the journaled layout,
+        not whatever a restarted daemon's default happens to be."""
+        rec = {"kind": "base", "target": self.target,
+               "request": request, "seed": int(seed),
+               "max_cycles": int(max_cycles)}
+        if layout:
+            rec["layout"] = str(layout)
+        self._append(rec)
 
     def record_delta(self, actions: List[Dict[str, Any]],
                      max_cycles: Optional[int]):
@@ -124,13 +132,17 @@ class JournalStore:
             pass
 
     def load(self, target: str
-             ) -> Tuple[Dict[str, Any], int, int,
+             ) -> Tuple[Dict[str, Any], int, int, Optional[str],
                         List[Dict[str, Any]]]:
         """Parse a target's journal: ``(base_request, base_seed,
-        base_max_cycles, delta_entries)``.  Raises
-        :class:`JournalError` on a file that cannot be replayed; a
-        trailing torn line (crash mid-append) is DROPPED, not fatal —
-        its record never counted as journaled."""
+        base_max_cycles, base_layout, delta_entries)`` —
+        ``base_layout`` is ``None`` for pre-layout journals; recovery
+        pins those to ``edge_major``, the only layout that existed
+        when they were written (NOT the restarted daemon's
+        ``--layout`` default, which may differ).  Raises :class:`JournalError` on a file that cannot
+        be replayed; a trailing torn line (crash mid-append) is
+        DROPPED, not fatal — its record never counted as
+        journaled."""
         path = self._path(target)
         try:
             with open(path, encoding="utf-8") as f:
@@ -175,4 +187,5 @@ class JournalStore:
                     f"delta record")
             deltas.append(rec)
         return (request, int(base.get("seed", 0)),
-                int(base.get("max_cycles", 0)) or 0, deltas)
+                int(base.get("max_cycles", 0)) or 0,
+                base.get("layout") or None, deltas)

@@ -60,7 +60,15 @@ def replay_scenario(engine: DynamicEngine, scenario: Scenario,
         if reporter is not None:
             out = {k: v for k, v in rec.items()
                    if k in ("status", "cost", "violation", "cycle",
-                            "warm_start", "spans", "upload_bytes")}
+                            "warm_start", "spans", "upload_bytes",
+                            "layout", "cycles_run", "chunks_run")
+                   and v is not None}
+            # settle_chunk's documented encoding: explicit null =
+            # the budget ran out before the stability rule fired;
+            # absent = a pre-minor-5 emitter.  Emit it whenever the
+            # budget telemetry is present
+            if "chunks_run" in out:
+                out["settle_chunk"] = rec.get("settle_chunk")
             if rec.get("edit"):
                 out["edit"] = rec["edit"]
             reporter.summary(event=event_id, **out)

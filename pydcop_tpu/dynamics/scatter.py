@@ -38,7 +38,9 @@ from ..graphs.arrays import BIG, SENTINEL
 from .deltas import TopologyDelta
 
 __all__ = ["delta_write_lists", "shard_write_lists", "tree_nbytes",
-           "engine_scatter_fn", "sharded_scatter_fn"]
+           "engine_scatter_fn", "sharded_scatter_fn",
+           "lane_write_lists", "lane_scatter_fn",
+           "fused_write_lists", "fused_scatter_fn"]
 
 
 def tree_nbytes(tree: Any) -> int:
@@ -218,6 +220,212 @@ def engine_scatter_fn(with_state: bool):
         s["finished"] = jnp.bool_(False)
         s["same"] = jnp.int32(0)
         return args, s
+
+    return scatter
+
+
+def _emask_rows(arrays, edges: np.ndarray) -> np.ndarray:
+    """Post-apply ``(t, D)`` domain-mask rows of the given canonical
+    edges — the lane/fused layouts keep an ``emaskT`` argument plane
+    (the edge-major step derives it in-trace), so edge re-points must
+    rewrite its touched columns."""
+    a = arrays
+    if not len(edges):
+        return np.zeros((0, a.max_domain), dtype=bool)
+    return np.asarray(a.domain_mask)[np.asarray(a.edge_var)[edges]]
+
+
+def _bucket_write_lists(arrays, delta: TopologyDelta
+                        ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Per-bucket ``(slots, cubes)`` write lists, pow2-padded — the
+    shared lane-major cube-edit coordinates of the lane layout and
+    the fused layout's n-ary branch."""
+    buckets = []
+    for bi in range(len(arrays.buckets)):
+        slots = delta.bucket_slots[bi].astype(np.int32)
+        slots, cubes = _pow2_pad(slots, delta.bucket_cubes[bi])
+        buckets.append((slots, cubes))
+    return buckets
+
+
+def _scatter_cubesT(cubesT, bucket_writes):
+    """Shared per-bucket lane-major cube column writes: the host
+    ships cubes row-major, the in-trace ``moveaxis`` (fused into the
+    scatter by XLA) lays them factor-axis-last."""
+    import jax.numpy as jnp
+
+    cubesT = list(cubesT)
+    for bi, (slots, bcubes) in enumerate(bucket_writes):
+        if slots.shape[0] and cubesT[bi] is not None:
+            cubesT[bi] = cubesT[bi].at[..., slots].set(
+                jnp.moveaxis(bcubes, 0, -1)
+                .astype(cubesT[bi].dtype))
+    return cubesT
+
+
+def _reset_lane_state(state, slots, q_rows, sel_pos, sel_vals):
+    """Shared ``(D, E*)``-state warm reset (lane columns / fused
+    slots): touched q/r columns to neutral, touched selection
+    entries to their restart argmin, convergence bookkeeping
+    restarted."""
+    import jax.numpy as jnp
+
+    s = dict(state)
+    if slots.shape[0]:
+        q_cols = q_rows.T
+        s["q"] = s["q"].at[:, slots].set(q_cols)
+        s["r"] = s["r"].at[:, slots].set(jnp.zeros_like(q_cols))
+    if sel_pos.shape[0]:
+        s["selection"] = s["selection"].at[sel_pos].set(sel_vals)
+    s["cycle"] = jnp.int32(0)
+    s["finished"] = jnp.bool_(False)
+    s["same"] = jnp.int32(0)
+    return s
+
+
+def lane_write_lists(arrays, delta: TopologyDelta,
+                     with_state: bool = True) -> Dict[str, Any]:
+    """The lane-major (``(D, E)`` state) coordinates of one delta.
+    Same canonical edge/slot ids as the edge-major lists — the lane
+    layout IS canonical edge order, transposed — plus the ``emaskT``
+    column rewrites; write values stay row-major on the host (the
+    compiled scatter transposes them in-trace, which XLA fuses into
+    the scatter itself)."""
+    w: Dict[str, Any] = {}
+    rows = delta.var_rows.astype(np.int32)
+    rows, mask, costs, dsz = _pow2_pad(
+        rows, delta.domain_mask, delta.var_costs, delta.domain_size)
+    w["var_rows"], w["var_mask"] = rows, mask
+    w["var_costs"], w["var_size"] = costs, dsz
+    w["buckets"] = _bucket_write_lists(arrays, delta)
+    eids, evar = _pow2_pad(delta.edge_ids.astype(np.int32),
+                           delta.edge_var)
+    w["edge_ids"], w["edge_var"] = eids, evar
+    te_m, emask = _pow2_pad(
+        delta.touched_edges.astype(np.int32),
+        _emask_rows(arrays, delta.touched_edges))
+    w["te_m"], w["emask_rows"] = te_m, emask
+    if with_state:
+        q_rows, sel_vals = _touched_values(arrays, delta)
+        te, q_rows = _pow2_pad(delta.touched_edges.astype(np.int32),
+                               q_rows)
+        tv, sel_vals = _pow2_pad(delta.touched_vars.astype(np.int32),
+                                 sel_vals)
+        w["te"], w["q_rows"] = te, q_rows
+        w["tv"], w["sel_vals"] = tv, sel_vals
+    return w
+
+
+def lane_scatter_fn(with_state: bool):
+    """The lane-major scatter program body: column writes into the
+    transposed argument planes (and the touched q/r columns of the
+    ``(D, E)`` carried state)."""
+
+    def scatter_args(args, w):
+        args = dict(args)
+        if w["var_rows"].shape[0]:
+            rows = w["var_rows"]
+            args["var_costsT"] = args["var_costsT"].at[:, rows].set(
+                w["var_costs"].T.astype(args["var_costsT"].dtype))
+            args["domain_maskT"] = args["domain_maskT"] \
+                .at[:, rows].set(w["var_mask"].T)
+            args["domain_size"] = args["domain_size"].at[rows].set(
+                w["var_size"])
+        args["cubesT"] = _scatter_cubesT(args["cubesT"],
+                                         w["buckets"])
+        if w["edge_ids"].shape[0]:
+            args["edge_var"] = args["edge_var"].at[
+                w["edge_ids"]].set(w["edge_var"])
+        if w["te_m"].shape[0]:
+            args["emaskT"] = args["emaskT"].at[:, w["te_m"]].set(
+                w["emask_rows"].T)
+        return args
+
+    if not with_state:
+        return scatter_args
+
+    def scatter(args, state, w):
+        args = scatter_args(args, w)
+        return args, _reset_lane_state(
+            state, w["te"], w["q_rows"], w["tv"], w["sel_vals"])
+
+    return scatter
+
+
+def fused_write_lists(arrays, solver, delta: TopologyDelta,
+                      with_state: bool = True) -> Dict[str, Any]:
+    """The fused (var-sorted slot space) coordinates of one delta:
+    variable planes map through ``var_pos`` (original row -> sorted
+    column), touched edges through ``slot_of_edge`` (the canonical
+    edge renumbering), and binary cost cubes become their two
+    oriented ``cube_slotT`` column slices.  Degree-changing deltas
+    never reach here — ``DynamicEngine.apply`` rejects them for this
+    layout before any write."""
+    from ..algorithms.maxsum import fused_cube_slot_writes
+
+    nf = solver._np_fused
+    w: Dict[str, Any] = {}
+    pos = nf["var_pos"][delta.var_rows].astype(np.int32)
+    pos, mask, costs = _pow2_pad(pos, delta.domain_mask,
+                                 delta.var_costs)
+    w["var_pos"], w["var_mask"], w["var_costs"] = pos, mask, costs
+    if solver._all_binary:
+        cs_slots, cs_vals = fused_cube_slot_writes(
+            solver._canonical, nf["slot_of_edge"], delta.bucket_slots,
+            delta.bucket_cubes)
+        cs_slots, cs_vals = _pow2_pad(cs_slots.astype(np.int32),
+                                      cs_vals)
+        w["cs_slots"], w["cs_vals"] = cs_slots, cs_vals
+    else:
+        w["buckets"] = _bucket_write_lists(arrays, delta)
+    if with_state:
+        q_rows, sel_vals = _touched_values(arrays, delta)
+        ts = nf["slot_of_edge"][delta.touched_edges] \
+            .astype(np.int32) if len(delta.touched_edges) else \
+            np.zeros(0, dtype=np.int32)
+        ts, q_rows = _pow2_pad(ts, q_rows)
+        tv = nf["var_pos"][delta.touched_vars].astype(np.int32)
+        tv, sel_vals = _pow2_pad(tv, sel_vals)
+        w["ts"], w["q_rows"] = ts, q_rows
+        w["tv_pos"], w["sel_vals"] = tv, sel_vals
+    return w
+
+
+def fused_scatter_fn(all_binary: bool, with_state: bool):
+    """The fused scatter program body: sorted-column variable writes,
+    oriented ``cube_slotT`` slices (binary) or lane-major bucket cube
+    writes (n-ary), and touched q/r slot columns of the carried
+    state."""
+    import jax.numpy as jnp
+
+    def scatter_args(args, w):
+        args = dict(args)
+        if w["var_pos"].shape[0]:
+            pos = w["var_pos"]
+            args["var_costsT_sorted"] = args["var_costsT_sorted"] \
+                .at[:, pos].set(w["var_costs"].T.astype(
+                    args["var_costsT_sorted"].dtype))
+            args["domain_maskT_sorted"] = args["domain_maskT_sorted"] \
+                .at[:, pos].set(w["var_mask"].T)
+        if all_binary:
+            if w["cs_slots"].shape[0]:
+                args["cube_slotT"] = args["cube_slotT"] \
+                    .at[:, :, w["cs_slots"]].set(
+                        jnp.moveaxis(w["cs_vals"], 0, -1)
+                        .astype(args["cube_slotT"].dtype))
+        else:
+            args["cubesT"] = _scatter_cubesT(args["cubesT"],
+                                             w["buckets"])
+        return args
+
+    if not with_state:
+        return scatter_args
+
+    def scatter(args, state, w):
+        args = scatter_args(args, w)
+        return args, _reset_lane_state(
+            state, w["ts"], w["q_rows"], w["tv_pos"],
+            w["sel_vals"])
 
     return scatter
 

@@ -80,11 +80,18 @@ SCHEMA_VERSION = 1
 #: attribution dict (``point``/``key`` of an injected chaos fault),
 #: the ``retry`` dict (``attempt``/``backoff_s``), and
 #: ``journal_replayed`` on delta dispatch records (warm session
-#: rebuilt by crash-journal replay).  A v1.0/1.1/1.2/1.3 reader
-#: stays green by the one documented forward-compat rule: consumers
-#: filter the stream by the record kinds (and fields) they speak and
-#: ignore the rest.
-SCHEMA_MINOR = 4
+#: rebuilt by crash-journal replay).
+#: Minor 5 (fast warm re-solves, ISSUE 14) added the warm-engine
+#: ``layout`` echo (``edge_major``/``lane_major``/``fused``) and the
+#: convergence-aware budget telemetry on summary and serve dispatch
+#: records: ``cycles_run`` (executed cycles of the dispatch),
+#: ``chunks_run`` (compiled chunks dispatched under the geometric
+#: schedule) and ``settle_chunk`` (the chunk index at which the
+#: on-device stability rule fired; null when the budget ran out
+#: first).  A v1.0-1.4 reader stays green by the one documented
+#: forward-compat rule: consumers filter the stream by the record
+#: kinds (and fields) they speak and ignore the rest.
+SCHEMA_MINOR = 5
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -315,6 +322,7 @@ def validate_record(rec: Dict[str, Any]):
                         f"summary edit[{k!r}] must be a "
                         f"non-negative int, got {v!r}")
         _check_upload_bytes(rec, "summary")
+        _check_budget_fields(rec, "summary")
         rc = rec.get("reason_class")
         if rc is not None and (not isinstance(rc, str) or not rc):
             raise ValueError(
@@ -337,6 +345,7 @@ def validate_record(rec: Dict[str, Any]):
             raise ValueError(
                 f"serve record with bad journal_replayed {jr!r}")
         _check_upload_bytes(rec, "serve")
+        _check_budget_fields(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -383,6 +392,30 @@ def _check_upload_bytes(rec, kind):
                            or not isinstance(ub, int) or ub < 0):
         raise ValueError(
             f"{kind} record with bad upload_bytes {ub!r}")
+
+
+#: the warm-engine layout vocabulary echoed on dispatch records
+#: (schema minor 5) — ``auto`` never appears: records carry the
+#: RESOLVED layout
+LAYOUTS = ("edge_major", "lane_major", "fused")
+
+
+def _check_budget_fields(rec, kind):
+    """Optional schema-minor-5 fields: the warm-engine ``layout``
+    echo plus the convergence-aware budget telemetry
+    (``cycles_run``/``chunks_run`` non-negative ints,
+    ``settle_chunk`` non-negative int or null = never settled)."""
+    layout = rec.get("layout")
+    if layout is not None and layout not in LAYOUTS:
+        raise ValueError(
+            f"{kind} record with unknown layout {layout!r}; "
+            f"known: {', '.join(LAYOUTS)}")
+    for field in ("cycles_run", "chunks_run", "settle_chunk"):
+        v = rec.get(field)
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, int) or v < 0):
+            raise ValueError(
+                f"{kind} record with bad {field} {v!r}")
 
 
 def _check_fault(fault):

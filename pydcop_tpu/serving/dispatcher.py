@@ -91,12 +91,26 @@ class DeltaSessions:
 
     def __init__(self, exec_cache=None, reserve=None, cap: int = 16,
                  budget_bytes: Optional[int] = None,
-                 resident: bool = True, journal=None):
+                 resident: bool = True, journal=None,
+                 layout: str = "edge_major",
+                 warm_budget: str = "adaptive"):
         from collections import OrderedDict
 
         self.exec_cache = exec_cache
         self.reserve = reserve
         self.cap = int(cap)
+        #: warm-engine step layout sessions open at (``serve
+        #: --layout``): edge_major (the generic oracle, default),
+        #: lane_major (~6x faster per message), fused (cost/variable
+        #: edits only), or auto.  A target request carrying its own
+        #: ``-p layout:...`` algo param overrides the daemon default
+        #: for that session
+        self.layout = str(layout)
+        #: warm re-solve budget schedule (``serve --warm-budget``):
+        #: adaptive (geometric chunks, stop at the first settled
+        #: boundary) or fixed — identical selections and cycles
+        #: either way
+        self.warm_budget = str(warm_budget)
         #: byte budget over the summed per-session resident_bytes
         #: (None = count cap only)
         self.budget_bytes = (int(budget_bytes) if budget_bytes
@@ -120,10 +134,14 @@ class DeltaSessions:
 
     def get(self, target: str, target_request: Dict[str, Any],
             default_max_cycles: int, default_seed: int,
-            default_precision=None):
+            default_precision=None, layout: Optional[str] = None):
         """The target's warm engine, opening (and cold-solving) the
         session on first use; a hit refreshes the target's LRU
-        recency.  Returns ``(engine, opened)``."""
+        recency.  ``layout`` overrides the resolution chain (used by
+        journal recovery, which must rebuild under the JOURNALED
+        layout); otherwise the target request's own ``layout`` algo
+        param wins over the store default.  Returns ``(engine,
+        opened)``."""
         engine = self._sessions.get(target)
         if engine is not None:
             self.stats["hits"] += 1
@@ -145,20 +163,31 @@ class DeltaSessions:
             given = parse_algo_params(algo_params)
         except CliError as e:
             raise ValueError(str(e))
-        # engine-only keys are stripped by DynamicEngine itself
+        # engine-only keys are stripped by DynamicEngine itself —
+        # except layout, which the warm engine takes as its own
+        # kwarg (it is program identity, not a solver parameter)
         params = {k: algo_def.params[k] for k in given}
+        if layout is None:
+            layout = params.get("layout") or self.layout
+        params.pop("layout", None)
         precision = (target_request.get("precision")
                      or params.get("precision") or default_precision)
         if precision:
             params["precision"] = precision
         dcop = load_dcop_from_file(target_request["dcop"])
+        # a ValueError here (e.g. a layout the instance is not
+        # eligible for) propagates as-is: the serve loop's handler
+        # turns it into a structured rejection, subclass identity
+        # (DeltaError kind/details) intact
         engine = DynamicEngine(
-            dcop, algo=algo, mode="engine", reserve=self.reserve,
+            dcop, algo=algo, mode="engine",
+            reserve=self.reserve,
             params=params,
-            max_cycles=int(target_request.get("max_cycles",
-                                              default_max_cycles)),
+            max_cycles=int(target_request.get(
+                "max_cycles", default_max_cycles)),
             exec_cache=self.exec_cache,
-            resident=self.resident)
+            resident=self.resident,
+            layout=layout, warm_budget=self.warm_budget)
         self._sessions[target] = engine
         self.stats["opened"] += 1
         self.enforce()
@@ -233,7 +262,8 @@ class DeltaSessions:
             self.journal.discard(target)
 
     def journal_begin(self, target: str, request: Dict[str, Any],
-                      seed: int, max_cycles: int):
+                      seed: int, max_cycles: int,
+                      layout: Optional[str] = None):
         """Open the target's journal and record its (successful) base
         solve.  No-op without a journal store.  Any leftover journal
         for the target is DISCARDED first: a fresh session open (the
@@ -245,7 +275,7 @@ class DeltaSessions:
             return
         self._journal_close(target, truncate=True)
         handle = self.journal.open(target)
-        handle.record_base(request, seed, max_cycles)
+        handle.record_base(request, seed, max_cycles, layout=layout)
         self._journals[target] = handle
 
     def journal_append(self, target: str,
@@ -278,17 +308,19 @@ class DeltaSessions:
         discarded and the error propagates as a structured
         rejection."""
         try:
-            base_request, seed, base_mc, entries = self.journal.load(
-                target)
-            # the journaled base max_cycles is the RESOLVED value of
-            # the crashed daemon (its --max-cycles default folded
-            # in): replay must use it, or a restart under a
+            (base_request, seed, base_mc, base_layout,
+             entries) = self.journal.load(target)
+            # the journaled base max_cycles AND layout are the
+            # RESOLVED values of the crashed daemon (its defaults
+            # folded in): replay must use them, or a restart under a
             # different default would diverge from the never-crashed
-            # session
+            # session.  Pre-layout journals carry none — those
+            # sessions ran the then-only edge_major layout
             engine, _opened = self.get(
                 target, base_request,
                 base_mc or default_max_cycles, default_seed,
-                default_precision)
+                default_precision,
+                layout=base_layout or "edge_major")
         except Exception:
             # an unreplayable journal (corrupt non-tail line, the
             # journaled model file gone) must not leave the target
@@ -370,7 +402,8 @@ class Dispatcher:
                  session_budget_bytes: Optional[int] = None,
                  resident_deltas: bool = True,
                  faults=None, execute_deadline_s: Optional[float] = None,
-                 journal=None):
+                 journal=None, session_layout: str = "edge_major",
+                 warm_budget: str = "adaptive"):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
@@ -397,7 +430,8 @@ class Dispatcher:
         self.delta_sessions = DeltaSessions(
             exec_cache=exec_cache, reserve=reserve, cap=session_cap,
             budget_bytes=session_budget_bytes,
-            resident=resident_deltas, journal=journal)
+            resident=resident_deltas, journal=journal,
+            layout=session_layout, warm_budget=warm_budget)
 
     # ---------------------------------------------- fault / watchdog
 
@@ -672,7 +706,8 @@ class Dispatcher:
                 raise
             open_spans = dict(engine.last_spans)
             self.delta_sessions.journal_begin(
-                target, target_request, base_seed, engine.max_cycles)
+                target, target_request, base_seed, engine.max_cycles,
+                layout=engine.layout)
         # apply() either commits fully or raises with the instance
         # untouched (compile_event validates before any write), so a
         # DeltaError rejection leaves the session trustworthy
@@ -712,7 +747,19 @@ class Dispatcher:
             "target": request["target"],
             "dispatch_reason": "delta",
             "warm_start": res["warm_start"],
+            # the layout the session runs at plus the convergence-
+            # aware budget telemetry (schema minor 5): executed
+            # cycles, dispatched chunks, and the chunk index where
+            # the stability rule fired (null = ran out the budget)
+            "layout": engine.layout,
+            "cycles_run": int(res.get("cycles_run", res["cycle"])),
         }
+        if res.get("chunks_run") is not None:
+            rec["chunks_run"] = int(res["chunks_run"])
+            # null = the budget ran out before the stability rule
+            # fired — emitted explicitly (not omitted), the one
+            # documented encoding on summary AND serve records
+            rec["settle_chunk"] = res.get("settle_chunk")
         if res.get("upload_bytes") is not None:
             rec["upload_bytes"] = int(res["upload_bytes"])
         if res.get("edit"):
@@ -742,6 +789,10 @@ class Dispatcher:
                 queue_depth=int(queue_depth),
                 target=request["target"],
                 session_opened=bool(opened),
+                layout=engine.layout,
+                cycles_run=int(res.get("cycles_run", res["cycle"])),
+                chunks_run=res.get("chunks_run"),
+                settle_chunk=res.get("settle_chunk"),
                 open_spans=open_spans,
                 **({"journal_replayed": int(journal_replayed)}
                    if journal_replayed is not None else {}),

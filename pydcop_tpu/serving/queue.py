@@ -165,6 +165,13 @@ def prepare_job(request: Dict[str, Any],
     params = {k: algo_def.params[k] for k in given}
     params.pop("stop_cycle", None)
     params.pop("seed", None)
+    # the batched dispatch path picks its own vmapped step layout;
+    # a job's `layout` algo param is honored where it IS meaningful —
+    # the warm delta SESSION opened against this target
+    # (DeltaSessions.get reads it off the admitted request).  Left in
+    # the params it would reach MaxSumSolver as an unknown kwarg and
+    # poison the whole rung's dispatch
+    params.pop("layout", None)
     from ..algorithms import param_bool
 
     if param_bool(params.get("bnb", False)):

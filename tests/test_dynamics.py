@@ -423,6 +423,192 @@ def test_upload_bytes_reported_on_every_solve():
     assert 0 < r1["upload_bytes"] < r0["upload_bytes"]
 
 
+# ------------------------- layout x event-type x carry matrix (ISSUE 14)
+
+
+#: fused-compatible event stream: cost edits + variable add/remove —
+#: the degree-preserving subset (constraint add/remove is compiled
+#: shape for the fused slot structure and rejects loudly, asserted
+#: separately)
+FUSED_EVENTS = [
+    [{"type": "change_costs", "name": "c2", "costs": NEW_COSTS}],
+    [{"type": "add_variable", "name": "v6", "values": [0, 1, 2],
+      "costs": [3.0, 0.0, 1.0]}],
+    [{"type": "change_costs", "name": "c0",
+      "costs": (np.arange(9).reshape(3, 3) % 7).tolist()}],
+    [{"type": "remove_variable", "name": "v6"}],
+]
+
+#: per-layout event coverage: lane_major speaks every event type;
+#: fused the degree-preserving subset
+LAYOUT_EVENTS = {
+    "edge_major": RESIDENT_EVENTS,
+    "lane_major": RESIDENT_EVENTS,
+    "fused": FUSED_EVENTS,
+}
+
+
+def _run_events(layout, resident, carry, events, **kw):
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4",
+                        layout=layout, resident=resident,
+                        carry=carry, **kw)
+    outs = [eng.solve(max_cycles=500)]
+    for ev in events:
+        eng.apply(ev)
+        outs.append(eng.solve(max_cycles=500))
+    return outs
+
+
+@pytest.mark.parametrize("layout", ["lane_major", "fused"])
+@pytest.mark.parametrize("resident", [True, False])
+def test_layout_reset_bit_exact_vs_edge_major(layout, resident):
+    """The extended oracle: under carry='reset' (the structurally
+    cold-exact mode) a lane/fused warm re-solve reproduces the
+    edge-major selections AND convergence cycles for every supported
+    event type, on the resident-scatter and re-upload paths alike —
+    with the warm no-retrace contract intact."""
+    events = LAYOUT_EVENTS[layout]
+    ref = _run_events("edge_major", True, "reset", events)
+    got = _run_events(layout, resident, "reset", events)
+    for a, b in zip(ref, got):
+        assert b["assignment"] == a["assignment"]
+        assert b["cycle"] == a["cycle"]
+        assert b["cost"] == pytest.approx(a["cost"])
+        assert b["layout"] == layout
+    for o in got[1:]:
+        assert_warm_spans(o["spans"])
+        assert o["warm_start"]
+
+
+@pytest.mark.parametrize("layout",
+                         ["edge_major", "lane_major", "fused"])
+def test_layout_messages_carry_deterministic(layout):
+    """Under the conditional-Max-Sum default (carry='messages') each
+    layout's warm trajectory is deterministic: the resident scatter
+    and the re-upload path produce identical selections AND cycles.
+    Cross-layout, message VALUES agree only up to float association
+    (the documented static-layout contract), so the cross-layout
+    cycle oracle lives in the carry='reset' test above."""
+    events = LAYOUT_EVENTS[layout]
+    a = _run_events(layout, True, "messages", events)
+    b = _run_events(layout, False, "messages", events)
+    for x, y in zip(a, b):
+        assert x["assignment"] == y["assignment"]
+        assert x["cycle"] == y["cycle"]
+    for o in a[1:]:
+        assert_warm_spans(o["spans"])
+        # the tentpole's measurable rides every layout: O(touched)
+        # upload on the resident path
+    for x, y in zip(a[1:], b[1:]):
+        assert x["upload_bytes"] * 10 <= y["upload_bytes"]
+
+
+def test_fused_rejects_degree_changing_events():
+    """Constraint add/remove changes the variable-degree slot
+    structure the fused program compiled over: the rejection is loud,
+    structured, and transactional (instance untouched, session still
+    serviceable)."""
+    eng = DynamicEngine(chain_dcop(), reserve="vars:4,2:4",
+                        layout="fused")
+    eng.solve(max_cycles=500)
+    before = eng.budget()
+    with pytest.raises(DeltaError) as e:
+        eng.apply([{"type": "add_constraint", "name": "x0",
+                    "scope": ["v0", "v2"], "costs": NEW_COSTS}])
+    assert e.value.kind == "layout"
+    assert "lane_major" in str(e.value)
+    assert eng.budget() == before
+    with pytest.raises(DeltaError) as e:
+        eng.apply([{"type": "remove_constraint", "name": "c0"}])
+    assert e.value.kind == "layout"
+    # the session keeps serving its supported dialect
+    eng.apply([{"type": "change_costs", "name": "c0",
+                "costs": NEW_COSTS}])
+    out = eng.solve(max_cycles=500)
+    assert out["warm_start"]
+    assert_warm_spans(out["spans"])
+
+
+def test_layout_auto_and_sharded_rules():
+    eng = DynamicEngine(chain_dcop(), reserve="2:4", layout="auto")
+    assert eng.layout == "lane_major"   # chain is lane-eligible
+    with pytest.raises(ValueError, match="layout"):
+        DynamicEngine(chain_dcop(), layout="diagonal")
+    with pytest.raises(ValueError, match="edge-major"):
+        DynamicEngine(chain_dcop(), mode="sharded",
+                      layout="lane_major")
+
+
+def test_resident_bytes_counts_layout_plane_set():
+    """The satellite bugfix: a fused session's resident estimate must
+    include the solver's cached device constants (the slot tables and
+    masks live there, not in the argument planes) — and close() must
+    release them, or eviction would leak device buffers past the
+    byte-budgeted store."""
+    from pydcop_tpu.observability.memory import approx_object_bytes
+
+    eng = DynamicEngine(chain_dcop(), reserve="2:4", layout="fused")
+    eng.solve(max_cycles=500)
+    const_bytes = approx_object_bytes(eng._base._dev_cache)
+    assert const_bytes > 0
+    assert eng.resident_bytes() >= const_bytes
+    baseline = eng.resident_bytes()
+    eng.close()
+    assert not eng._base._dev_cache
+    assert eng.resident_bytes() < baseline - const_bytes + 1
+
+
+# ------------------------------- convergence-aware budgets (ISSUE 14)
+
+
+def test_adaptive_budget_identical_to_fixed():
+    """The early-stop guard: the geometric schedule returns identical
+    selections AND cycles to the fixed-budget run (chunk boundaries
+    never change the step arithmetic), while reporting where the run
+    settled."""
+    events = RESIDENT_EVENTS
+    fixed = _run_events("lane_major", True, "messages", events,
+                        warm_budget="fixed")
+    adapt = _run_events("lane_major", True, "messages", events,
+                        warm_budget="adaptive")
+    for f, a in zip(fixed, adapt):
+        assert a["assignment"] == f["assignment"]
+        assert a["cycle"] == f["cycle"]
+        assert a["cycles_run"] == f["cycles_run"]
+    for a in adapt[1:]:     # warm re-solves under the geometric
+        assert a["chunks_run"] >= 1
+        if a["status"] == "FINISHED":
+            assert a["settle_chunk"] is not None
+            assert a["settle_chunk"] <= a["chunks_run"]
+    with pytest.raises(ValueError, match="warm_budget"):
+        DynamicEngine(chain_dcop(), warm_budget="loose")
+
+
+def test_settle_chunk_monotone_under_perturbation_size():
+    """Growing perturbations settle in the same or a later chunk of
+    the geometric schedule: the settle_chunk telemetry orders warm
+    events by how much re-solving they actually needed."""
+    def settle_of(n_edits):
+        eng = DynamicEngine(chain_dcop(n=24, seed=5), reserve="2:4",
+                            layout="lane_major", chunk_size=8)
+        eng.solve(max_cycles=500)
+        rng = np.random.RandomState(9)
+        eng.apply([
+            {"type": "change_costs", "name": f"c{k}",
+             "costs": rng.randint(0, 10, size=(3, 3)).tolist()}
+            for k in range(n_edits)])
+        out = eng.solve(max_cycles=500)
+        assert out["status"] == "FINISHED"
+        return out["settle_chunk"]
+
+    settles = [settle_of(n) for n in (1, 8, 20)]
+    assert all(s is not None for s in settles)
+    assert settles == sorted(settles), settles
+    # and it genuinely discriminates: the 20-factor perturbation
+    # needs more re-solving than the single-factor one
+    assert settles[0] < settles[-1], settles
+
+
 SCEN_YAML = """
 events:
   - id: w1
@@ -681,6 +867,64 @@ def test_serve_delta_session_end_to_end(tmp_path):
     assert deltas[0]["reserve"]["slots"]["2"]["total"] >= 8
 
 
+@pytest.mark.serve
+def test_serve_delta_sessions_open_at_configured_layout(tmp_path):
+    """``serve --layout lane_major``: delta sessions open at the
+    configured warm layout, dispatch records echo it plus the
+    budget telemetry (cycles_run/chunks_run/settle_chunk), and a
+    target job's own ``-p layout:...`` overrides per session."""
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+    from pydcop_tpu.serving.daemon import ServeLoop
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    dcop_file = _instance_yaml(tmp_path)
+    out = str(tmp_path / "serve.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve")
+    loop = ServeLoop(
+        AdmissionQueue(max_batch=2, max_delay_s=0.01),
+        Dispatcher(reporter=reporter, reserve="vars:2,2:4",
+                   session_layout="lane_major"),
+        reporter=reporter, default_max_cycles=300,
+        reserve="vars:2,2:4")
+    lines = [
+        json.dumps({"id": "j1", "dcop": dcop_file,
+                    "algo": "maxsum", "max_cycles": 300}),
+        json.dumps({"id": "j2", "dcop": dcop_file,
+                    "algo": "maxsum", "max_cycles": 300,
+                    "algo_params": ["layout:fused"]}),
+        json.dumps({"id": "d1", "op": "delta", "target": "j1",
+                    "actions": [{"type": "change_costs",
+                                 "name": "c1",
+                                 "costs": [[0, 5, 9], [5, 0, 1],
+                                           [9, 1, 0]]}]}),
+        json.dumps({"id": "d2", "op": "delta", "target": "j2",
+                    "actions": [{"type": "change_costs",
+                                 "name": "c2",
+                                 "costs": [[2, 0, 1], [0, 2, 1],
+                                           [1, 1, 0]]}]}),
+    ]
+    stats = loop.run_oneshot(lines)
+    reporter.close()
+    assert stats["completed"] == 4
+    records = read_records(out)
+    for rec in records:
+        validate_record(rec)
+    summaries = {r["job_id"]: r for r in records
+                 if r["record"] == "summary"}
+    assert summaries["d1"]["layout"] == "lane_major"
+    assert summaries["d2"]["layout"] == "fused"   # per-job override
+    assert summaries["d1"]["cycles_run"] >= 1
+    deltas = [r for r in records if r["record"] == "serve"
+              and r.get("reason") == "delta"]
+    assert [d["layout"] for d in deltas] == ["lane_major", "fused"]
+    for d in deltas:
+        assert isinstance(d["cycles_run"], int)
+        assert d["chunks_run"] >= 1
+
+
 def test_cli_solve_scenario_end_to_end(tmp_path):
     """The acceptance path: a full >= 3-event-kind scenario replays
     through `solve --scenario` (real CLI subprocess) without a
@@ -705,6 +949,7 @@ def test_cli_solve_scenario_end_to_end(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "pydcop_tpu.dcop_cli", "solve",
          dcop_file, "-a", "maxsum", "--scenario", str(scen_file),
+         "-p", "layout:lane_major", "--warm-budget", "adaptive",
          "--reserve-slots", "vars:4,2:4", "--telemetry", tel,
          "--max_cycles", "300"],
         capture_output=True, text=True, timeout=300, env=env,
@@ -713,6 +958,8 @@ def test_cli_solve_scenario_end_to_end(tmp_path):
     result = json.loads(proc.stdout)
     assert result["scenario"]["events_applied"] == 3
     assert result["scenario"]["delays"] == 1
+    assert result["scenario"]["layout"] == "lane_major"
+    assert result["scenario"]["warm_budget"] == "adaptive"
     records = read_records(tel)
     for rec in records:
         validate_record(rec)

@@ -612,18 +612,20 @@ def test_journal_roundtrip_torn_tail_and_truncate(tmp_path):
     assert not store.journaled("t1")
     handle = store.open("t1")
     handle.record_base({"id": "t1", "dcop": "x.yaml",
-                        "algo": "maxsum"}, seed=3, max_cycles=50)
+                        "algo": "maxsum"}, seed=3, max_cycles=50,
+                       layout="lane_major")
     handle.record_delta([{"type": "change_costs", "name": "c0",
                           "costs": _C1}], max_cycles=None)
     assert store.journaled("t1")
-    req, seed, mc, deltas = store.load("t1")
+    req, seed, mc, layout, deltas = store.load("t1")
     assert req["id"] == "t1" and seed == 3 and mc == 50
+    assert layout == "lane_major"
     assert len(deltas) == 1
     assert deltas[0]["actions"][0]["name"] == "c0"
     # a torn tail (crash mid-append) is dropped, not fatal
     with open(handle.path, "a") as f:
         f.write('{"kind": "delta", "actio')
-    _req, _s, _mc, deltas = store.load("t1")
+    _req, _s, _mc, _lay, deltas = store.load("t1")
     assert len(deltas) == 1
     # corruption NOT at the tail refuses to replay
     lines = open(handle.path).read().splitlines()
@@ -702,8 +704,32 @@ def test_journal_replay_bit_exact_with_uninterrupted_session(
     assert "trace_lower_s" not in spans
     assert d2.delta_sessions.stats["journal_replays"] == 1
     # the recovered session keeps journaling: d3 is appended
-    _req, _seed, _mc, deltas = store.load("jA")
+    _req, _seed, _mc, _lay, deltas = store.load("jA")
     assert len(deltas) == 3
+
+
+def test_journal_recovery_replays_under_journaled_layout(tmp_path):
+    """The layout twin of the max_cycles rule: a session opened at
+    lane_major journals that RESOLVED layout, and a restarted daemon
+    configured with a different default rebuilds the session under
+    the journaled one — bit-exact with the uninterrupted session."""
+    from pydcop_tpu.dynamics.journal import JournalStore
+
+    path = _instance_yaml(tmp_path)
+    store = JournalStore(str(tmp_path / "journals"))
+    d1 = Dispatcher(journal=store, session_layout="lane_major")
+    expected = d1.dispatch_delta(_delta("jA", "d1", _C1),
+                                 _target_request(path))
+    assert expected["layout"] == "lane_major"
+    _req, _seed, _mc, layout, _deltas = store.load("jA")
+    assert layout == "lane_major"
+    # crash; the restarted daemon defaults to edge_major
+    d2 = Dispatcher(journal=store, session_layout="edge_major")
+    rec = d2.dispatch_delta(_delta("jA", "d2", _C2), None)
+    engine = d2.delta_sessions._sessions["jA"]
+    assert engine.layout == "lane_major"
+    assert rec["layout"] == "lane_major"
+    assert rec["status"] in ("FINISHED", "MAX_CYCLES")
 
 
 def test_clean_shutdown_truncates_journals_and_residency(tmp_path):
@@ -758,7 +784,7 @@ def test_fresh_session_open_truncates_stale_crash_journal(tmp_path):
     # re-admitted, so the session opens FRESH with target_request set
     d2 = Dispatcher(journal=store)
     d2.dispatch_delta(_delta("jA", "d3", _C3), _target_request(path))
-    req, _seed, _mc, deltas = store.load("jA")
+    req, _seed, _mc, _lay, deltas = store.load("jA")
     assert req["id"] == "j"          # exactly one (new) base record
     assert len(deltas) == 1          # d3 only — stale d1/d2 gone
     # and the fresh journal still replays

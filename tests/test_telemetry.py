@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_4_and_v1_readers_stay_green():
+def test_schema_minor_is_5_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 4
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 5
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4):
+    for minor in (1, 2, 3, 4, 5):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -460,6 +460,31 @@ def test_schema_minor_is_4_and_v1_readers_stay_green():
         validate_record({"record": "serve", "algo": "s",
                          "event": "dispatch",
                          "journal_replayed": -1})
+    # minor-5 additive fields (fast warm re-solves): the layout echo
+    # and the convergence-aware budget telemetry validate; malformed
+    # ones reject.  settle_chunk 0 = settled before the first chunk
+    # dispatched (already stable at the boundary read)
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "warm_start": True,
+                     "layout": "lane_major", "cycles_run": 7,
+                     "chunks_run": 2, "settle_chunk": 2})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "dispatch", "reason": "delta",
+                     "layout": "fused", "cycles_run": 48,
+                     "chunks_run": 4, "settle_chunk": None})
+    with pytest.raises(ValueError, match="layout"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "layout": "diagonal"})
+    with pytest.raises(ValueError, match="layout"):
+        # records must carry the RESOLVED layout, never 'auto'
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch", "layout": "auto"})
+    with pytest.raises(ValueError, match="settle_chunk"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "settle_chunk": -1})
+    with pytest.raises(ValueError, match="cycles_run"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch", "cycles_run": "many"})
 
 
 # ----------------------------------------- reporter lifecycle (ops)
