@@ -2,13 +2,21 @@
 # cli + api tiers).  Tests force the CPU backend with a virtual
 # 8-device mesh (tests/conftest.py).
 
-.PHONY: test test-fast bench suite lint typecheck
+.PHONY: test test-fast bench suite lint typecheck chaos
 
 test:
 	python -m pytest tests/ -q
 
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
+
+# the disruption tier: the chaos + checkpoint test markers (fault
+# plans, kill->resume bit-exactness) plus the bench_chaos contract —
+# whose preempt leg SIGKILLs a checkpointed solve mid-chunk and
+# asserts the --resume run reproduces selections and cycles bit-exactly
+chaos:
+	python -m pytest tests/ -q -m "chaos or ckpt"
+	python benchmarks/suite.py bench_chaos --quick
 
 bench:
 	python bench.py

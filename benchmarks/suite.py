@@ -2158,6 +2158,87 @@ def bench_serve_dynamic(quick=False, out_dir=None):
             shutil.rmtree(work, ignore_errors=True)
 
 
+def _chaos_preempt_leg(work, quick=False):
+    """The ISSUE 15 preemption leg: a REAL kill -9 mid-solve, then
+    resume.  Three subprocess runs of the same `solve` job:
+
+    1. uninterrupted (the oracle);
+    2. checkpointed with ``PYDCOP_TPU_PREEMPT_AFTER=2`` — the process
+       SIGKILLs itself right after its second snapshot lands, i.e.
+       genuinely dies mid-solve at a deterministic chunk boundary
+       (no flaky timing-based kills);
+    3. ``--resume`` — restores the snapshot and finishes.
+
+    Asserted: the kill actually happened (SIGKILL exit), the resume
+    actually restored (``resumed_from_cycle`` > 0), and the resumed
+    run reproduces the uninterrupted run's selections AND cycle count
+    bit-exactly."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+
+    n = 48 if quick else 300
+    max_cycles = 96 if quick else 256
+    every = 16 if quick else 32
+    inst = os.path.join(work, "preempt.yaml")
+    with open(inst, "w") as f:
+        f.write(dcop_yaml(generate_graph_coloring(
+            n, 3, "scalefree", m_edge=2, soft=True, seed=11)))
+    ck_dir = os.path.join(work, "preempt_ck")
+    argv = [_sys.executable, "-m", "pydcop_tpu.dcop_cli", "solve",
+            "-a", "maxsum", "--max_cycles", str(max_cycles),
+            "--seed", "7", inst]
+    ck_args = ["--checkpoint", ck_dir,
+               "--checkpoint-every", str(every)]
+
+    def run(extra, env_extra=None):
+        env = dict(os.environ, **(env_extra or {}))
+        return subprocess.run(argv[:-1] + extra + [inst],
+                              capture_output=True, text=True,
+                              env=env, timeout=600)
+
+    oracle = run([])
+    if oracle.returncode != 0:
+        raise RuntimeError(
+            f"preempt leg oracle failed: {oracle.stderr[-400:]}")
+    oracle_res = json.loads(oracle.stdout)
+
+    killed = run(ck_args, {"PYDCOP_TPU_PREEMPT_AFTER": "2"})
+    if killed.returncode != -signal.SIGKILL:
+        raise RuntimeError(
+            f"preempt leg: expected a SIGKILL mid-solve, got exit "
+            f"{killed.returncode}: {killed.stderr[-400:]}")
+
+    resumed = run(ck_args + ["--resume"])
+    if resumed.returncode != 0:
+        raise RuntimeError(
+            f"preempt leg resume failed: {resumed.stderr[-400:]}")
+    res = json.loads(resumed.stdout)
+    if not res.get("resumed_from_cycle"):
+        raise RuntimeError(
+            f"preempt leg: resume did not restore a snapshot "
+            f"(resumed_from_cycle={res.get('resumed_from_cycle')!r})")
+    if res["cycle"] != oracle_res["cycle"] \
+            or res["assignment"] != oracle_res["assignment"]:
+        raise RuntimeError(
+            f"preempt leg NOT bit-exact: resumed cycle "
+            f"{res['cycle']} vs {oracle_res['cycle']}, assignments "
+            f"{'equal' if res['assignment'] == oracle_res['assignment'] else 'DIFFER'}")
+    return {
+        "vars": n, "max_cycles": max_cycles,
+        "killed_exit": killed.returncode,
+        "resumed_from_cycle": res["resumed_from_cycle"],
+        "checkpoint_bytes": res.get("checkpoint_bytes"),
+        "cycle": res["cycle"],
+        "bit_exact": True,
+    }
+
+
 def bench_chaos(quick=False, out_dir=None):
     """The chaos contract (ISSUE 13): the `bench_serve`-shaped mixed
     load — cold maxsum + dsa solves plus warm delta traffic — driven
@@ -2393,6 +2474,9 @@ def bench_chaos(quick=False, out_dir=None):
                 f"chaos p99 {chaos['p99_s']}s exceeds the "
                 f"degradation bound {bound:.4f}s (control p99 "
                 f"{control['p99_s']}s)")
+        # ---- the preemption leg (ISSUE 15): kill -9 mid-solve at a
+        # deterministic checkpoint, --resume, assert bit-exactness
+        preempt = _chaos_preempt_leg(work, quick=quick)
         return {
             "metric": f"serve_chaos_{n_jobs}job_5pct_faults",
             "value": {
@@ -2412,6 +2496,7 @@ def bench_chaos(quick=False, out_dir=None):
                 "nan_jobs": sorted(nan_ids),
                 "p99_degradation": round(
                     chaos["p99_s"] / max(control["p99_s"], 1e-9), 2),
+                "preempt": preempt,
             },
             "unit": "latency percentiles under a 5% fault plan",
             "contracts_asserted": True,

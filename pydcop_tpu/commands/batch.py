@@ -39,6 +39,62 @@ from . import CliError
 PROGRESS_FILE = "batch_progress.txt"
 
 
+def read_progress(path: str) -> set:
+    """The registered-done job ids.  Tolerates a torn final line
+    (legacy append-mode files written by a killed campaign): a
+    partial id simply re-runs its job, which is safe — results are
+    idempotent per-job files.  Only a MISSING file reads as empty;
+    any other read failure propagates — register_progress rewrites
+    the whole file from this set, and treating a transient EIO as
+    "no progress" would let the rewrite wipe every recorded job."""
+    done = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    done.add(line)
+    except FileNotFoundError:
+        pass
+    return done
+
+
+def register_progress(path: str, job_id: str):
+    """Crash-safe progress registration: read-merge-rewrite through
+    the shared atomic-write helper (write-temp + flush+fsync +
+    rename, ``robustness/checkpoint.atomic_write``), so a kill at ANY
+    point leaves either the previous complete file or the new one —
+    the historical append could die mid-``write`` and tear the resume
+    state of the whole campaign.  Read-merge (not an in-memory set)
+    keeps the fused child process and the parent pool coherent: they
+    run sequentially, and each rewrite folds whatever the other
+    already registered.  Cost, stated honestly: one linear file scan
+    + rewrite per registration — O(jobs²) lines over a campaign,
+    trivial at this CLI's hundreds-to-thousands-of-jobs scale (a 1024
+    job campaign is ~1M line ops total); the 100k-job regime is the
+    serve daemon's workload, which tracks jobs in its own telemetry,
+    not this file."""
+    from ..robustness.checkpoint import atomic_write
+
+    done = read_progress(path)
+    done.add(job_id)
+    atomic_write(path, "\n".join(sorted(done)) + "\n")
+
+
+def register_progress_many(path: str, job_ids):
+    """Register a whole fused RUNG's jobs in one atomic write.  This
+    closes the per-job registration window a kill could land in:
+    after a rung's solve, either every job of it is registered (a
+    resumed campaign skips the rung entirely) or none is (the rung
+    re-forms with the SAME job set, so its checkpoint name matches
+    and the snapshot restores instead of re-solving)."""
+    from ..robustness.checkpoint import atomic_write
+
+    done = read_progress(path)
+    done.update(str(j) for j in job_ids)
+    atomic_write(path, "\n".join(sorted(done)) + "\n")
+
+
 def set_parser(subparsers):
     parser = subparsers.add_parser(
         "batch", help="run a benchmark campaign from a yaml definition")
@@ -115,6 +171,32 @@ def set_parser(subparsers):
                              "each rung's shape and is echoed in the "
                              "fused result rows and the "
                              "[fuse-hetero] stats line")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        metavar="DIR",
+                        help="preemption-safe campaigns: fused rung "
+                             "solves snapshot their whole batched "
+                             "carry into DIR at chunk boundaries "
+                             "(atomic write + fingerprint manifest, "
+                             "docs/architecture.md), and subprocess "
+                             "solve jobs get solve --checkpoint DIR "
+                             "appended — so a killed campaign "
+                             "re-launched with --resume continues "
+                             "INSIDE the interrupted solves instead "
+                             "of only skipping registered-done jobs "
+                             "via the progress file")
+    parser.add_argument("--checkpoint-every", dest="checkpoint_every",
+                        type=int, default=256, metavar="N",
+                        help="cycles between campaign snapshots "
+                             "(default 256)")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore existing --checkpoint "
+                             "snapshots (rung carries for fused "
+                             "groups, per-job solve snapshots for "
+                             "subprocess jobs); mismatched "
+                             "precision/backend snapshots refuse "
+                             "loudly, missing ones start fresh.  "
+                             "Progress-file job skipping is always "
+                             "on, with or without this flag")
     parser.add_argument("--job_timeout", type=float, default=300)
     parser.add_argument("--dir", dest="out_dir", default="batch_out",
                         help="output directory for job results")
@@ -366,11 +448,56 @@ def _append_jsonl(path: str, job_id: str, result: dict):
             os.close(fd)
 
 
+def _solve_direct_algo(algo) -> bool:
+    """Whether ``algo`` runs a one-shot exact sweep
+    (``solve_direct``) — derived from the algorithm module itself,
+    the same capability test ``infrastructure/run.py`` dispatches on,
+    so a new exact family can never drift out of sync with this
+    check.  Unknown algo names return False: the job will fail on its
+    own terms, not on a checkpoint decision."""
+    try:
+        from ..algorithms import load_algorithm_module
+
+        return hasattr(load_algorithm_module(str(algo)),
+                       "solve_direct")
+    except Exception:
+        return False
+
+
+def _rung_checkpointer(checkpoint_dir, checkpoint_every, algo, sub,
+                       precision_name):
+    """One fused sub-group's :class:`SolveCheckpointer` (or None):
+    named by the job ids it solves — unique within a campaign — and
+    fingerprinted by the program identity, so a resumed campaign can
+    only restore a rung carry into the same batched program."""
+    if not checkpoint_dir:
+        return None
+    import hashlib
+    import json as _json
+
+    from ..robustness.checkpoint import (CheckpointStore,
+                                         SolveCheckpointer,
+                                         checkpoint_fingerprint)
+
+    name = "batch:" + hashlib.sha256(_json.dumps(
+        [algo, sorted(job_id for job_id, _p, _i in sub)]
+    ).encode()).hexdigest()
+    return SolveCheckpointer(
+        CheckpointStore(checkpoint_dir), name,
+        every=checkpoint_every,
+        fingerprint=checkpoint_fingerprint(
+            precision=precision_name or "f32", layout="batched",
+            algo=algo))
+
+
 def _run_fused_group(key, rows, out_dir, register_done,
                      consolidated_out=None, hetero=False,
                      precision=None, max_rung_mb=None,
                      telemetry=None, decimation=None,
-                     reserve=None):
+                     reserve=None, checkpoint=None,
+                     checkpoint_every=None,
+                     checkpoint_resume=False,
+                     register_many=None):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -455,6 +582,14 @@ def _run_fused_group(key, rows, out_dir, register_done,
     if telemetry:
         from ..observability.report import RunReporter
 
+        if checkpoint:
+            # named, never silent: the batched snapshot excludes the
+            # metric-plane carry, so checkpointed fused groups emit
+            # header + summaries without per-cycle records
+            print("[checkpoint] per-cycle telemetry records are "
+                  "disabled for checkpointed fused groups (the "
+                  "metric planes are not part of the snapshot); "
+                  "summaries still land in the campaign jsonl")
         reporter = RunReporter(telemetry, algo=algo, mode="batch-fused")
         reporter.header(
             algo_params=list(algo_params), max_cycles=max_cycles,
@@ -466,7 +601,10 @@ def _run_fused_group(key, rows, out_dir, register_done,
             key, rows, out_dir, register_done, consolidated_out,
             hetero, algo, params, max_cycles, explicit_seed,
             precision_name, policy, max_rung_mb, reporter,
-            reserve=reserve)
+            reserve=reserve, checkpoint=checkpoint,
+            checkpoint_every=checkpoint_every,
+            checkpoint_resume=checkpoint_resume,
+            register_many=register_many)
     finally:
         if reporter is not None:
             reporter.close()
@@ -476,7 +614,10 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                            consolidated_out, hetero, algo, params,
                            max_cycles, explicit_seed, precision_name,
                            policy, max_rung_mb, reporter,
-                           reserve=None):
+                           reserve=None, checkpoint=None,
+                           checkpoint_every=None,
+                           checkpoint_resume=False,
+                           register_many=None):
     import numpy as np
 
     from ..dcop.yamldcop import load_dcop_from_file
@@ -557,9 +698,18 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                     violation=result["violation"],
                     cycle=result["cycle"], time=result["time"],
                     fused_batch=len(sub), **attrib)
-            register_done(job_id)
+            if register_many is None:
+                register_done(job_id)
             print(f"[ok] {job_id} ({tag} x{len(sub)}, "
                   f"{elapsed:.1f}s total)")
+        if register_many is not None:
+            # one atomic registration per rung, AFTER every result
+            # landed: a kill leaves the rung either wholly registered
+            # (resume skips it) or wholly unregistered (resume
+            # re-forms the SAME job set, so its checkpoint name
+            # matches and the snapshot restores) — never a partial
+            # survivor set that would orphan the rung snapshot
+            register_many([job_id for job_id, _p, _it in sub])
 
     def row_seeds(sub):
         return [int(explicit_seed) if explicit_seed is not None
@@ -585,16 +735,23 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                "mgm": BatchedMgm}[algo]
         runner = cls(template, cubes_batches=cubes_batches,
                      batch=len(sub), **params)
+        ck = _rung_checkpointer(checkpoint, checkpoint_every, algo,
+                                sub, precision_name)
         t0 = time.perf_counter()
         sel, cycles, finished = runner.run(
             max_cycles=max_cycles, seeds=row_seeds(sub),
-            collect_metrics=reporter is not None)
+            collect_metrics=reporter is not None and ck is None,
+            checkpointer=ck, resume=checkpoint_resume)
         costs, viols = runner.evaluate(sel)
         elapsed = time.perf_counter() - t0
         emit(sub, list(sel), costs, viols, cycles, finished, elapsed,
              extra_of, tag,
              cycle_metrics=runner.last_cycle_metrics
-             if reporter is not None else None)
+             if reporter is not None and ck is None else None)
+        if ck is not None:
+            # every job of the rung is registered done: the snapshot
+            # has nothing left to protect
+            ck.store.delete(ck.name)
 
     topo_groups = list(by_topo.values())
     if not (hetero and len(topo_groups) > 1):
@@ -646,10 +803,13 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
         instances = [padded_of[path] for _j, path, _it in sub]
         runner = runner_for_rung(algo, instances, params,
                                  rung_signature=rung.signature)
+        ck = _rung_checkpointer(checkpoint, checkpoint_every, algo,
+                                sub, precision_name)
         t0 = time.perf_counter()
         sel, cycles, finished = runner.run(
             max_cycles=max_cycles, seeds=row_seeds(sub),
-            collect_metrics=reporter is not None)
+            collect_metrics=reporter is not None and ck is None,
+            checkpointer=ck, resume=checkpoint_resume)
         # ONE vmapped device evaluation per rung (phantom rows
         # contribute exactly zero, so padded costs == true costs)
         costs, viols = runner.evaluate(sel)
@@ -663,7 +823,9 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                  **({"reserve": reserve} if reserve else {})),
              "fused-hetero",
              cycle_metrics=runner.last_cycle_metrics
-             if reporter is not None else None)
+             if reporter is not None and ck is None else None)
+        if ck is not None:
+            ck.store.delete(ck.name)
         programs += 1
     # one parsable stats line per group: the bench_hetero_batch
     # program-count contract reads it, campaign authors grep it
@@ -688,17 +850,31 @@ def _fused_child_main(argv=None) -> int:
     rows = [tuple(r) for r in spec["rows"]]
 
     def register_done(job_id):
-        with open(spec["progress_path"], "a") as f:
-            f.write(job_id + "\n")
+        register_progress(spec["progress_path"], job_id)
 
+    def register_many(job_ids):
+        register_progress_many(spec["progress_path"], job_ids)
+
+    # rung-atomic registration ONLY under --checkpoint, where the
+    # snapshot name hashes the rung's job set and a partial survivor
+    # set would orphan it; without checkpointing the historical
+    # per-job registration keeps the re-emit window (duplicate
+    # consolidated rows after a kill mid-rung) at one job, not a rung
     _run_fused_group(key, rows, spec["out_dir"], register_done,
+                     register_many=(register_many
+                                    if spec.get("checkpoint")
+                                    else None),
                      consolidated_out=spec.get("consolidated_out"),
                      hetero=spec.get("hetero", False),
                      precision=spec.get("precision"),
                      max_rung_mb=spec.get("max_rung_mb"),
                      telemetry=spec.get("telemetry"),
                      decimation=spec.get("decimation"),
-                     reserve=spec.get("reserve"))
+                     reserve=spec.get("reserve"),
+                     checkpoint=spec.get("checkpoint"),
+                     checkpoint_every=spec.get("checkpoint_every"),
+                     checkpoint_resume=spec.get("checkpoint_resume",
+                                                False))
     return 0
 
 
@@ -736,10 +912,7 @@ def run_cmd(args, timeout=None):
         return 0
     os.makedirs(args.out_dir, exist_ok=True)
     progress_path = os.path.join(args.out_dir, PROGRESS_FILE)
-    done = set()
-    if os.path.exists(progress_path):
-        with open(progress_path) as f:
-            done = {line.strip() for line in f if line.strip()}
+    done = read_progress(progress_path)
     todo = [job for job in jobs if job[0] not in done]
     print(f"{len(jobs)} jobs, {len(done)} done, {len(todo)} to run")
 
@@ -749,8 +922,10 @@ def run_cmd(args, timeout=None):
     progress_lock = threading.Lock()
 
     def register_done(job_id):
-        with progress_lock, open(progress_path, "a") as f:
-            f.write(job_id + "\n")
+        # atomic rewrite (see register_progress): a kill mid-write
+        # can no longer truncate the campaign's resume state
+        with progress_lock:
+            register_progress(progress_path, job_id)
 
     # partition: fusable engine-solve jobs by group key (>= 2 rows,
     # else the subprocess path is simpler and equally fast)
@@ -805,6 +980,12 @@ def run_cmd(args, timeout=None):
                         "reserve": getattr(args, "reserve_slots",
                                            None),
                         "telemetry": getattr(args, "telemetry", None),
+                        "checkpoint": getattr(args, "checkpoint",
+                                              None),
+                        "checkpoint_every": getattr(
+                            args, "checkpoint_every", None),
+                        "checkpoint_resume": getattr(
+                            args, "resume", False),
                         "consolidated_out": getattr(
                             args, "consolidated_out", None)}, f)
         failure = None
@@ -830,11 +1011,7 @@ def run_cmd(args, timeout=None):
             # the child registers each job as it completes: only rows
             # it did NOT finish return to the subprocess path (never
             # re-run — and overwrite — an already-registered result)
-            registered = set()
-            if os.path.exists(progress_path):
-                with open(progress_path) as f:
-                    registered = {line.strip() for line in f
-                                  if line.strip()}
+            registered = read_progress(progress_path)
             fused_ids -= ({job_id for job_id, _p, _i in rows}
                           - registered)
     todo = [job for job in jobs
@@ -864,6 +1041,22 @@ def run_cmd(args, timeout=None):
             # job's own precision setting wins (trailing options are
             # fine after the positional files)
             argv += ["--precision", args.precision]
+        if getattr(args, "checkpoint", None) \
+                and _meta["command"] == "solve" \
+                and conf.get("mode", "engine") in ("engine",
+                                                   "sharded") \
+                and not _solve_direct_algo(conf.get("algo")):
+            # (the exact one-shot sweeps have no chunk boundaries to
+            # snapshot at — solve rejects the flag for them)
+            # subprocess solve jobs ride the same checkpoint
+            # directory (per-job snapshot names, see
+            # robustness/checkpoint.solve_checkpoint_name); a
+            # resumed campaign continues them mid-solve too
+            argv += ["--checkpoint", args.checkpoint,
+                     "--checkpoint-every",
+                     str(getattr(args, "checkpoint_every", 256))]
+            if getattr(args, "resume", False):
+                argv += ["--resume"]
         if _meta["command"] == "solve" \
                 and conf.get("algo") == "maxsum":
             # campaign-level decimation/bnb for subprocess maxsum

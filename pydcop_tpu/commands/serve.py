@@ -207,6 +207,25 @@ def set_parser(subparsers):
                              "cache.  Clean shutdown and eviction "
                              "truncate the journal.  Default: no "
                              "journaling")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        metavar="DIR",
+                        help="preemption-safe serving (ISSUE 15): "
+                             "SIGTERM becomes a preemption DRAIN — "
+                             "still-queued jobs and unread request "
+                             "lines are REQUEUED to DIR/requeue.jsonl "
+                             "(atomic, fsync'd) instead of rejected, "
+                             "and warm delta sessions keep their "
+                             "crash journals plus a post-base-solve "
+                             "state snapshot in DIR.  A restarted "
+                             "daemon with the same --checkpoint "
+                             "re-admits the requeued jobs first and "
+                             "rebuilds journaled sessions by restore+"
+                             "replay (bit-exact), so preemption "
+                             "costs a restart, not the work.  "
+                             "Corrupt snapshots are quarantined "
+                             "(*.corrupt + counter); counters "
+                             "surface in serve-status and as "
+                             "pydcop_checkpoint_* metrics")
     parser.add_argument("--execute-deadline-s",
                         dest="execute_deadline_s", type=float,
                         default=None, metavar="SECONDS",
@@ -293,6 +312,18 @@ def run_cmd(args, timeout=None):
             raise CliError(
                 f"--session-journal directory unusable: {e}")
 
+    checkpoints = None
+    checkpoint_dir = getattr(args, "checkpoint", None)
+    if checkpoint_dir:
+        from ..robustness.checkpoint import CheckpointStore
+
+        try:
+            checkpoints = CheckpointStore(checkpoint_dir)
+        except OSError as e:
+            raise CliError(f"--checkpoint directory unusable: {e}")
+        if faults is not None:
+            checkpoints.faults = faults
+
     exec_cache = None
     if not args.no_exec_cache:
         exec_cache = ExecutableCache(path=args.exec_cache)
@@ -322,6 +353,7 @@ def run_cmd(args, timeout=None):
                         and exec_cache.enabled else None),
             fault_plan=fault_plan,
             session_journal=session_journal,
+            checkpoint=checkpoint_dir,
             execute_deadline_s=execute_deadline_s,
             source=("oneshot" if args.oneshot
                     else "socket" if args.socket else "stdin"))
@@ -336,7 +368,8 @@ def run_cmd(args, timeout=None):
             faults=faults, execute_deadline_s=execute_deadline_s,
             journal=journal,
             session_layout=getattr(args, "layout", "edge_major"),
-            warm_budget=getattr(args, "warm_budget", "adaptive"))
+            warm_budget=getattr(args, "warm_budget", "adaptive"),
+            checkpoints=checkpoints)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,
@@ -344,7 +377,21 @@ def run_cmd(args, timeout=None):
                          reserve=reserve,
                          registry=registry,
                          heartbeat_s=heartbeat_s,
-                         faults=faults)
+                         faults=faults,
+                         checkpoints=checkpoints)
+        if checkpoints is not None:
+            # a previous daemon's preemption drain left requeued
+            # jobs: re-admit them FIRST, ahead of the live sources —
+            # continue, don't recompute
+            from ..serving.daemon import requeue_take
+
+            requeued = requeue_take(checkpoints.directory)
+            for line in requeued:
+                loop.feed(line)
+            if requeued:
+                print(f"[serve] re-admitted {len(requeued)} "
+                      f"requeued job(s) from {checkpoints.directory}",
+                      file=sys.stderr)
         if metrics_port is not None:
             from ..observability.registry import MetricsHTTPServer
 

@@ -131,6 +131,20 @@ def render_status(snap: dict) -> str:
                  "sessions"):
         lines.append(_cache_line(name.replace("_cache", ""),
                                  snap.get(name)))
+    ck = snap.get("checkpoints")
+    if ck is not None:
+        # the preemption-safety counters (serve --checkpoint):
+        # snapshots written/restored, corrupt-quarantined, plus the
+        # session-store restore counters and requeued-on-preempt
+        sessions = snap.get("sessions") or {}
+        lines.append(
+            f"  checkpoint  written {ck.get('saved', 0)}, "
+            f"restored {ck.get('restored', 0)}, "
+            f"corrupt-quarantined {ck.get('corrupt', 0)} | "
+            f"session snapshots saved "
+            f"{sessions.get('checkpoint_saved', 0)}, restored "
+            f"{sessions.get('checkpoint_restored', 0)} | "
+            f"requeued-on-preempt {st.get('requeued', 0)}")
     memory = snap.get("memory") or {}
     if memory:
         lines.append("  memory:")

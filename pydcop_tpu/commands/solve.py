@@ -88,6 +88,41 @@ def set_parser(subparsers):
                         help="websocket UI port base (thread mode)")
     parser.add_argument("--max_cycles", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        metavar="DIR",
+                        help="preemption-safe solving "
+                             "(engine/sharded modes): snapshot the "
+                             "solver carry (q/r message planes, "
+                             "selections, cycle, RNG key, telemetry "
+                             "planes) into DIR at the engine's "
+                             "existing chunk sync boundaries — "
+                             "atomic write-temp+fsync+rename, "
+                             "manifest keyed to the "
+                             "jax/backend/arch/precision/layout "
+                             "fingerprint.  A killed run re-launched "
+                             "with --resume continues from the last "
+                             "snapshot and reproduces the "
+                             "uninterrupted run's selections AND "
+                             "convergence cycles bit-exactly "
+                             "(docs/architecture.md).  Off (the "
+                             "default): byte-identical programs, "
+                             "zero overhead")
+    parser.add_argument("--checkpoint-every", dest="checkpoint_every",
+                        type=int, default=256, metavar="N",
+                        help="cycles between snapshots (landing on "
+                             "the first chunk boundary at or past "
+                             "each multiple; the final boundary "
+                             "always snapshots).  Default 256")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the --checkpoint snapshot for "
+                             "this exact job identity (files + algo "
+                             "+ params + seed + budget) and continue "
+                             "from its cycle; a snapshot from a "
+                             "different precision/layout/backend/"
+                             "mesh refuses with a structured "
+                             "mismatch error, a missing or "
+                             "quarantined-corrupt snapshot starts "
+                             "fresh")
     parser.add_argument("--scenario", type=str, default=None,
                         metavar="FILE",
                         help="dynamic-DCOP replay (maxsum, "
@@ -246,6 +281,60 @@ def _feature_result_fields(args, decim, bnb_flag) -> dict:
     return out
 
 
+def _build_checkpointer(args, precision_name: Optional[str]):
+    """The run's :class:`~pydcop_tpu.robustness.checkpoint.
+    SolveCheckpointer` from ``--checkpoint DIR``, or None.  The
+    snapshot name is the job identity (files × algo × params × seed ×
+    budget), the fingerprint the program identity (precision, layout,
+    backend, ...), so ``--resume`` can only ever restore THIS job's
+    state into THIS program — anything else misses or refuses with a
+    structured mismatch."""
+    directory = getattr(args, "checkpoint", None)
+    if not directory:
+        if getattr(args, "resume", False):
+            raise CliError(
+                "--resume restores a --checkpoint snapshot: give "
+                "the checkpoint directory too")
+        return None
+    if args.mode not in ("engine", "sharded"):
+        raise CliError(
+            "--checkpoint snapshots the compiled solver carry at "
+            "chunk boundaries: mode engine or sharded, not "
+            f"{args.mode!r}")
+    if getattr(args, "scenario", None):
+        raise CliError(
+            "--checkpoint covers ONE long solve; a --scenario warm "
+            "replay is protected by the session journal instead "
+            "(checkpoint = base snapshot, journal = replayable "
+            "delta tail — docs/dynamic_dcops.md)")
+    every = getattr(args, "checkpoint_every", 256)
+    if every < 1:
+        raise CliError("--checkpoint-every must be >= 1 cycles")
+    from . import parse_algo_params
+    from ..robustness.checkpoint import (CheckpointStore,
+                                         SolveCheckpointer,
+                                         checkpoint_fingerprint,
+                                         env_preempt_hook,
+                                         solve_checkpoint_name)
+
+    try:
+        preempt_after, on_preempt = env_preempt_hook()
+        store = CheckpointStore(directory)
+    except (OSError, ValueError) as e:
+        raise CliError(str(e))
+    layout = parse_algo_params(args.algo_params).get("layout")
+    return SolveCheckpointer(
+        store,
+        solve_checkpoint_name(args.dcop_files, args.algo, args.mode,
+                              args.algo_params, args.seed,
+                              precision_name),
+        every=every,
+        fingerprint=checkpoint_fingerprint(
+            precision=precision_name or "f32", layout=layout,
+            algo=args.algo),
+        preempt_after=preempt_after, on_preempt=on_preempt)
+
+
 def _resolved_precision_name(args) -> Optional[str]:
     """The precision to report in the result — only when one was
     actually requested (flag, -p param, or environment); a plain f32
@@ -312,6 +401,7 @@ def run_cmd(args, timeout: Optional[float] = None):
             "--reserve-slots provisions edit headroom for a dynamic "
             "replay: it requires --scenario on solve")
     precision_name = _resolved_precision_name(args)
+    checkpointer = _build_checkpointer(args, precision_name)
     dcop = load_dcop_from_file(args.dcop_files)
     if getattr(args, "scenario", None):
         return _run_scenario(args, dcop, t0, timeout,
@@ -378,7 +468,9 @@ def run_cmd(args, timeout: Optional[float] = None):
                 dcop, args.algo, n_cycles=args.max_cycles,
                 batch=args.batch, seed=args.seed, timeout=timeout,
                 collect_cost_every=collect_every,
-                telemetry=bool(telemetry_path), **params)
+                telemetry=bool(telemetry_path),
+                checkpointer=checkpointer,
+                resume=getattr(args, "resume", False), **params)
         cost, violations = dcop.solution_cost(
             res.assignment, infinity=args.infinity)
         if collector is not None:
@@ -406,6 +498,8 @@ def run_cmd(args, timeout: Optional[float] = None):
         if precision_name:
             result["precision"] = precision_name
         result.update(_feature_result_fields(args, decim, bnb_flag))
+        if checkpointer is not None:
+            result.update(checkpointer.telemetry())
         if res.cost_trace:
             result["cost_trace"] = res.cost_trace
         if telemetry_path:
@@ -425,12 +519,27 @@ def run_cmd(args, timeout: Optional[float] = None):
         elif args.run_metrics:
             collect_every = 16  # default trace granularity (cycles)
         with profile_trace(profile_dir):
-            res = solve_result(
-                dcop, algo_def, distribution=args.distribution,
-                timeout=timeout, max_cycles=args.max_cycles,
-                seed=args.seed,
-                collect_cost_every=collect_every,
-                telemetry=bool(telemetry_path))
+            try:
+                res = solve_result(
+                    dcop, algo_def, distribution=args.distribution,
+                    timeout=timeout, max_cycles=args.max_cycles,
+                    seed=args.seed,
+                    collect_cost_every=collect_every,
+                    telemetry=bool(telemetry_path),
+                    checkpointer=checkpointer,
+                    resume=getattr(args, "resume", False))
+            except ValueError as e:
+                from ..robustness.checkpoint import CheckpointError
+
+                if checkpointer is not None and (
+                        isinstance(e, CheckpointError)
+                        or "--checkpoint" in str(e)):
+                    # a structured refusal (fingerprint/state
+                    # mismatch, or a solve_direct family with no
+                    # chunk boundaries) is a clean CLI error, not a
+                    # traceback
+                    raise CliError(str(e))
+                raise
         metrics = res.metrics
         if collector is not None:
             # engine mode has no per-computation value stream; feed the
@@ -478,6 +587,8 @@ def run_cmd(args, timeout: Optional[float] = None):
         result["precision"] = precision_name
     if args.mode == "engine":
         result.update(_feature_result_fields(args, decim, bnb_flag))
+    if checkpointer is not None:
+        result.update(checkpointer.telemetry())
     if res.cost_trace:
         result["cost_trace"] = res.cost_trace
     if telemetry_path:
@@ -668,6 +779,12 @@ def _report_telemetry_records(reporter, args, res, result: dict,
     }
     if spans:
         summary["spans"] = spans
+    # the preemption-safety fields (schema minor 6) ride the summary
+    # whenever the run checkpointed or resumed
+    for k in ("checkpoint_s", "checkpoint_bytes",
+              "resumed_from_cycle"):
+        if k in result:
+            summary[k] = result[k]
     reporter.summary(**summary)
 
 

@@ -513,6 +513,62 @@ class DynamicEngine:
         self.solves += 1
         return out
 
+    # ---------------------------------------------------- checkpoint
+
+    def state_snapshot(self) -> Dict[str, Any]:
+        """The warm session's carried solve state as a host pytree —
+        the BASE snapshot of the checkpoint-vs-journal division of
+        labor (ISSUE 15): taken right after the base solve, it lets a
+        restarted daemon skip re-running the base solve entirely and
+        replay only the journal's delta tail on top.  Engine mode
+        only (serve sessions are engine-mode by construction)."""
+        if self.mode != "engine":
+            raise ValueError(
+                "state_snapshot covers engine-mode warm sessions; "
+                "sharded dynamic state carries mesh constants that "
+                "re-device_put from the host planes instead")
+        if self._state is None:
+            raise ValueError(
+                "nothing to snapshot: the session has no carried "
+                "state (solve first)")
+        from ..robustness.checkpoint import tree_to_host
+
+        return {"state": tree_to_host(self._state),
+                "solves": int(self.solves),
+                "layout": self.layout, "carry": self.carry}
+
+    def restore_state(self, snapshot: Dict[str, Any]):
+        """Adopt a :meth:`state_snapshot` taken by a previous process
+        over the SAME base instance: the carried message state comes
+        back on device, the host planes stay the authoritative base
+        the delta tail then edits — so restore + journal replay is
+        bit-exact with the session that never crashed.  Layout/carry
+        drift refuses loudly (the snapshot's state coordinates are
+        layout-specific)."""
+        if self.mode != "engine":
+            raise ValueError(
+                "restore_state covers engine-mode warm sessions")
+        from ..robustness.checkpoint import (CheckpointError,
+                                             tree_to_device)
+
+        mismatched = {
+            k: (snapshot.get(k), getattr(self, k))
+            for k in ("layout", "carry")
+            if snapshot.get(k) != getattr(self, k)}
+        if mismatched:
+            diff = ", ".join(f"{k}: saved={s!r} current={c!r}"
+                             for k, (s, c) in sorted(
+                                 mismatched.items()))
+            raise CheckpointError(
+                f"session snapshot mismatch ({diff}); refusing to "
+                f"restore into a differently-configured warm engine",
+                kind="fingerprint", **mismatched)
+        self._state = tree_to_device(snapshot["state"])
+        self.solves = int(snapshot.get("solves", 1))
+        # the argument planes re-materialize from the (base) host
+        # planes on the next solve; resident scatters then edit them
+        self._args_dev = None
+
     def close(self):
         """Release the engine's device residency: the carried message
         state, the resident argument planes, the solver's cached

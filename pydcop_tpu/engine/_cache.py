@@ -80,6 +80,28 @@ def enable_persistent_cache():
             "PYDCOP_TPU_NO_CACHE=1", path, e)
 
 
+# ----------------------------------------------------- quarantine
+
+
+def quarantine_file(path: str) -> str:
+    """Move a corrupt on-disk entry aside to ``path + ".corrupt"``
+    (replacing any previous quarantine) and describe what happened.
+
+    Shared by every disk store that can meet a torn or bit-rotted
+    entry — the executable cache below and the solver checkpoint
+    store (``robustness/checkpoint.py``) — so the quarantine policy
+    cannot drift between them: the bad file stops being re-read on
+    every start, the ``*.corrupt`` artifact stays inspectable, and a
+    removal failure (read-only directory) degrades to the old
+    warn-and-miss behavior instead of turning a miss into a crash.
+    Callers own the counting and warning; this helper only moves."""
+    try:
+        os.replace(path, path + ".corrupt")
+        return "quarantined to *.corrupt"
+    except OSError as e:
+        return f"could not quarantine: {e}"
+
+
 # --------------------------------------------------- executable cache
 
 
@@ -203,12 +225,7 @@ class ExecutableCache:
         self.stats["errors"] += 1
         self.stats["misses"] += 1
         self.stats["corrupt"] += 1
-        try:
-            os.replace(path, path + ".corrupt")
-            moved = "quarantined to *.corrupt"
-        except OSError as e:
-            moved = f"could not quarantine: {e}"
-        self._warn_once(f"{msg} ({moved})")
+        self._warn_once(f"{msg} ({quarantine_file(path)})")
 
     def store(self, key: Tuple, compiled) -> bool:
         """Serialize ``compiled`` under ``key`` (atomic tmp+rename so a

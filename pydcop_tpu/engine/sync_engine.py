@@ -26,6 +26,12 @@ from .solver import ArraySolver, RunResult
 HOST_ENGINE_CELLS = 50_000
 
 
+def _host_tree(tree):
+    from ..robustness.checkpoint import tree_to_host
+
+    return tree_to_host(tree)
+
+
 class SyncEngine:
     def __init__(self, solver: ArraySolver, chunk_size: int = 32):
         enable_persistent_cache()
@@ -123,7 +129,9 @@ class SyncEngine:
             collect_cost_every: Optional[int] = None,
             collect_metrics: bool = False,
             spans: bool = False,
-            variables=None) -> RunResult:
+            variables=None,
+            checkpointer=None,
+            resume: bool = False) -> RunResult:
         """Run until convergence, cycle cap, or wall-clock timeout.
         ``collect_metrics`` records the per-cycle telemetry planes
         (``RunResult.cycle_metrics``); ``spans`` additionally splits
@@ -131,13 +139,26 @@ class SyncEngine:
         ``RunResult.compile_stats``.  The pure-numpy host path has no
         compiled chunk to instrument: small problems keep taking it
         (bit-exactness over observability) and return empty
-        telemetry."""
+        telemetry.
+
+        ``checkpointer`` (robustness/checkpoint.SolveCheckpointer)
+        snapshots the solver carry — and the telemetry planes when
+        collecting — at the loop's EXISTING chunk boundaries (the
+        per-boundary two-scalar read is the only host sync either
+        way); ``resume`` restores the snapshot (fingerprint- and
+        signature-checked, refusing loudly on mismatch) instead of a
+        fresh ``init_state``, reproducing the uninterrupted run's
+        selections and cycles bit-exactly (boundary-invariant chunk
+        arithmetic, the PR 2 guard).  A checkpointed run always takes
+        the compiled path: the host mirror has no chunk boundaries to
+        snapshot at."""
         from ..observability.metrics import (alloc_metric_planes,
                                              metric_records)
         from ..observability.spans import SpanClock
 
         solver = self._solver
-        if (getattr(solver, "host_path", False)
+        if (checkpointer is None
+                and getattr(solver, "host_path", False)
                 and solver.use_host_engine()
                 and solver.host_cells() <= HOST_ENGINE_CELLS):
             return solver.host_run(
@@ -149,6 +170,18 @@ class SyncEngine:
         state = self._solver.init_state(key)
         planes = alloc_metric_planes(max_cycles) \
             if collect_metrics else None
+        if checkpointer is not None and resume:
+            from ..robustness.checkpoint import (tree_to_device,
+                                                 tree_to_host)
+
+            template = {"state": tree_to_host(state),
+                        "planes": (tree_to_host(planes)
+                                   if planes is not None else None)}
+            restored = checkpointer.load(template=template)
+            if restored is not None:
+                state = tree_to_device(restored["state"])
+                if planes is not None:
+                    planes = tree_to_device(restored["planes"])
         clock = SpanClock()
         t0 = time.perf_counter()
         status = "MAX_CYCLES"
@@ -166,6 +199,13 @@ class SyncEngine:
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 status = "TIMEOUT"
                 break
+            if checkpointer is not None and cycle:
+                # the boundary the loop head just paid its two-scalar
+                # sync for; the snapshot gather happens only when due
+                checkpointer.maybe_save(cycle, lambda: {
+                    "state": _host_tree(state),
+                    "planes": (_host_tree(planes)
+                               if planes is not None else None)})
             limit = min(cycle + chunk, max_cycles)
             if collect_metrics:
                 run_chunk = self._metrics_runner(
@@ -178,6 +218,15 @@ class SyncEngine:
                 trace.append(
                     (int(state["cycle"]), float(self._cost(state)))
                 )
+        if checkpointer is not None:
+            # the final boundary (finished, budget, or timeout): a
+            # resume replays this snapshot and continues — or, for a
+            # finished run, decodes the identical result
+            checkpointer.maybe_save(cycle, lambda: {
+                "state": _host_tree(state),
+                "planes": (_host_tree(planes)
+                           if planes is not None else None)},
+                final=True)
         duration = time.perf_counter() - t0
         clock.add("execute_s", duration)
         self.last_spans = clock.as_dict() if spans else {}
@@ -201,6 +250,8 @@ class SyncEngine:
             result.compile_stats = dict(self.last_compile_stats)
             if spans:
                 result.metrics["spans"] = dict(self.last_spans)
+        if checkpointer is not None:
+            result.metrics["checkpoint"] = checkpointer.telemetry()
         return result
 
     def _named_assignment(self, idx, variables):

@@ -47,6 +47,8 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                  seed: int = 0,
                  collect_cost_every: Optional[int] = None,
                  telemetry: bool = False,
+                 checkpointer=None,
+                 resume: bool = False,
                  **kwargs) -> RunResult:
     """Like :func:`solve` but returns the full :class:`RunResult` with
     cycles, duration, status and true (sign-corrected) cost.
@@ -64,6 +66,11 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     algo_module = load_algorithm_module(algo_def.algo)
 
     if hasattr(algo_module, "solve_direct"):
+        if checkpointer is not None:
+            raise ValueError(
+                f"{algo_def.algo} runs a one-shot exact sweep with "
+                f"no chunk boundaries to checkpoint at; --checkpoint "
+                f"covers the cyclic engine families")
         # exact / sequential algorithms (dpop, syncbb, ncbb) run their
         # own sweep instead of the cyclic engine; a placement file still
         # gets validated up front and reported in the metrics
@@ -137,6 +144,7 @@ def solve_result(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         collect_cost_every=collect_cost_every,
         collect_metrics=telemetry, spans=telemetry,
         variables=[dcop.variable(n) for n in solver.var_names],
+        checkpointer=checkpointer, resume=resume,
     )
     result.duration = time.perf_counter() - t0
     # report the true model cost (the engine's is sign/noise-compiled)

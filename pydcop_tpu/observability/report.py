@@ -88,10 +88,18 @@ SCHEMA_VERSION = 1
 #: ``chunks_run`` (compiled chunks dispatched under the geometric
 #: schedule) and ``settle_chunk`` (the chunk index at which the
 #: on-device stability rule fired; null when the budget ran out
-#: first).  A v1.0-1.4 reader stays green by the one documented
-#: forward-compat rule: consumers filter the stream by the record
-#: kinds (and fields) they speak and ignore the rest.
-SCHEMA_MINOR = 5
+#: first).
+#: Minor 6 (preemption-safe solves, ISSUE 15) added the checkpoint
+#: telemetry on summary and serve records: ``checkpoint_s`` (wall
+#: seconds spent writing snapshots), ``checkpoint_bytes`` (bytes
+#: written) and ``resumed_from_cycle`` (the cycle the run restored
+#: from; absent on fresh runs), the serve ``event: preempt_drain``
+#: record with ``requeued``/``requeue_total``, the ``preempt``
+#: fault-record action, and the ``checkpoints`` counter block on
+#: stats/final serve records.  A v1.0-1.5 reader stays green by the
+#: one documented forward-compat rule: consumers filter the stream by
+#: the record kinds (and fields) they speak and ignore the rest.
+SCHEMA_MINOR = 6
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -107,9 +115,11 @@ EDIT_KEYS = ("add_variable", "remove_variable", "add_constraint",
              "touched_vars")
 
 #: the ``action`` vocabulary of serve ``event: fault`` records
-#: (schema minor 4) — the failure-handling audit trail
+#: (schema minor 4; ``preempt`` added by minor 6) — the
+#: failure-handling audit trail
 FAULT_ACTIONS = ("retry", "bisect", "poisoned", "circuit_open",
-                 "breaker_open", "breaker_probe", "breaker_close")
+                 "breaker_open", "breaker_probe", "breaker_close",
+                 "preempt")
 
 
 class RunReporter:
@@ -323,6 +333,7 @@ def validate_record(rec: Dict[str, Any]):
                         f"non-negative int, got {v!r}")
         _check_upload_bytes(rec, "summary")
         _check_budget_fields(rec, "summary")
+        _check_ckpt_fields(rec, "summary")
         rc = rec.get("reason_class")
         if rc is not None and (not isinstance(rc, str) or not rc):
             raise ValueError(
@@ -346,6 +357,7 @@ def validate_record(rec: Dict[str, Any]):
                 f"serve record with bad journal_replayed {jr!r}")
         _check_upload_bytes(rec, "serve")
         _check_budget_fields(rec, "serve")
+        _check_ckpt_fields(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -411,6 +423,26 @@ def _check_budget_fields(rec, kind):
             f"{kind} record with unknown layout {layout!r}; "
             f"known: {', '.join(LAYOUTS)}")
     for field in ("cycles_run", "chunks_run", "settle_chunk"):
+        v = rec.get(field)
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, int) or v < 0):
+            raise ValueError(
+                f"{kind} record with bad {field} {v!r}")
+
+
+def _check_ckpt_fields(rec, kind):
+    """Optional schema-minor-6 fields: the preemption-safety
+    telemetry — ``checkpoint_s`` non-negative seconds,
+    ``checkpoint_bytes``/``resumed_from_cycle``/``requeued``/
+    ``requeue_total`` non-negative ints."""
+    cs = rec.get("checkpoint_s")
+    if cs is not None and (isinstance(cs, bool)
+                           or not isinstance(cs, (int, float))
+                           or cs < 0):
+        raise ValueError(
+            f"{kind} record with bad checkpoint_s {cs!r}")
+    for field in ("checkpoint_bytes", "resumed_from_cycle",
+                  "requeued", "requeue_total"):
         v = rec.get(field)
         if v is not None and (isinstance(v, bool)
                               or not isinstance(v, int) or v < 0):

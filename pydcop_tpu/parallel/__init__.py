@@ -94,6 +94,7 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
                          collect_cost_every: int = None,
                          telemetry: bool = False,
                          chunk_size: int = None, timeout: float = None,
+                         checkpointer=None, resume: bool = False,
                          **params):
     """Like :func:`solve_sharded` but returns the full
     :class:`~pydcop_tpu.engine.solver.RunResult` — including the
@@ -125,6 +126,14 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
         batch = mesh.shape["dp"]
     solver, arrays = _build_sharded_solver(dcop, algo, mesh, batch,
                                            params)
+    if checkpointer is not None:
+        # the mesh shape is part of the snapshot's identity: the
+        # sharded carry's array shapes bake (dp, tp) in, so resume
+        # onto a different mesh must refuse, not crash mid-device_put
+        if not checkpointer.fingerprint.get("mesh"):
+            checkpointer.fingerprint["mesh"] = dict(mesh.shape)
+        solver.checkpointer = checkpointer
+        solver.checkpoint_resume = bool(resume)
     sel, cycles = solver.run(
         n_cycles, seed=seed, collect_cost_every=collect_cost_every,
         collect_metrics=telemetry, spans=telemetry,
@@ -147,6 +156,8 @@ def solve_sharded_result(dcop, algo: str, n_cycles: int = 100,
             best_key, best = key, (assignment, cost, violations)
     stats = dict(getattr(solver, "last_run_stats", {}))
     stats.update(solver.message_plane_stats())
+    if checkpointer is not None:
+        stats["checkpoint"] = checkpointer.telemetry()
     if telemetry and getattr(solver, "last_spans", None):
         stats["spans"] = dict(solver.last_spans)
     finished = bool(solver.finished)

@@ -21,6 +21,7 @@ additionally be sharded over the mesh's dp axis.  Two fusion regimes:
 
 import os
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -180,8 +181,107 @@ class _BatchedRunnerBase:
         self.n_vars_true = [a.n_vars_true or a.n_vars
                             for a in instances]
 
+    # ----------------------------------------- checkpointed chunks
+
+    def _one_start(self, args, key):
+        """One instance's fresh state (the checkpoint path's init
+        program)."""
+        with self._swapped(args) as base:
+            return base.init_state(key)
+
+    def _one_chunk(self, args, state, limit):
+        """One instance driven to the TRACED ``limit`` — unlike
+        :meth:`_drive`, the budget is a program argument, so the
+        whole chunk schedule reuses ONE compiled program regardless
+        of where a resume lands."""
+        with self._swapped(args) as base:
+            def cond(s):
+                return jnp.logical_and(
+                    jnp.logical_not(s["finished"]),
+                    s["cycle"] < limit)
+
+            return jax.lax.while_loop(cond, base.step, state)
+
+    def _one_finish(self, args, state):
+        with self._swapped(args) as base:
+            return base.assignment_indices(state)
+
+    def _ckpt_programs(self):
+        """The three compiled programs of the checkpointed drive —
+        built ONLY when a checkpointer is attached, so checkpoint-off
+        runs keep their historical byte-identical program set."""
+        progs = self._jitted.get("ckpt")
+        if progs is None:
+            if self.fault_hook is not None:
+                self.fault_hook("compile")
+            progs = (
+                jax.jit(jax.vmap(self._one_start, in_axes=(0, 0))),
+                jax.jit(jax.vmap(self._one_chunk,
+                                 in_axes=(0, 0, None))),
+                jax.jit(jax.vmap(self._one_finish,
+                                 in_axes=(0, 0))),
+            )
+            self._jitted["ckpt"] = progs
+        return progs
+
+    def _run_checkpointed(self, seed, max_cycles, seeds,
+                          checkpointer, resume, trace_ids):
+        """The preemption-safe drive (``robustness/checkpoint.py``):
+        the vmapped solve runs as compiled chunks of the
+        checkpointer's cadence, snapshotting the whole batched carry
+        at each chunk boundary — atomic write, fingerprint manifest —
+        and, on ``resume``, restoring it (signature-checked against a
+        freshly initialized carry) so a killed campaign rung
+        continues mid-job.  Selections AND per-instance convergence
+        cycles are bit-exact with the single-program run: the chunked
+        step arithmetic is boundary-invariant (the PR 2 guard, here
+        asserted by the ckpt test matrix)."""
+        from ..observability.spans import SpanClock
+        from ..robustness.checkpoint import (tree_to_device,
+                                             tree_to_host)
+
+        self.max_cycles = max_cycles
+        self._collect_metrics = False
+        self.last_cycle_metrics = []
+        self.last_trace_ids = [str(t) for t in (trace_ids or [])]
+        keys = _batch_keys(seed, seeds, self.B)
+        spans = SpanClock()
+        init_all, chunk_all, decode_all = self._ckpt_programs()
+        args = self._instance_args
+        with spans.span("execute_s"):
+            if self.fault_hook is not None:
+                self.fault_hook("execute")
+            state = init_all(args, keys)
+            if resume:
+                restored = checkpointer.load(
+                    template=tree_to_host(state))
+                if restored is not None:
+                    state = tree_to_device(restored)
+            every = checkpointer.every or max_cycles
+            while True:
+                cycles = np.asarray(state["cycle"])
+                fin = np.asarray(state["finished"])
+                live = ~fin & (cycles < max_cycles)
+                frontier = int(cycles[live].min()) if live.any() \
+                    else int(cycles.min())
+                if frontier:
+                    checkpointer.maybe_save(
+                        frontier, lambda: tree_to_host(state),
+                        final=not live.any())
+                if not live.any():
+                    break
+                limit = min(
+                    ((frontier // every) + 1) * every, max_cycles)
+                state = chunk_all(args, state, jnp.int32(limit))
+            sel = decode_all(args, state)
+            out = (np.asarray(sel), np.asarray(state["cycle"]),
+                   np.asarray(state["finished"]))
+        self.last_spans = spans.as_dict()
+        return out
+
     def run(self, seed: int = 0, max_cycles: int = 200, seeds=None,
-            collect_metrics: bool = False, trace_ids=None):
+            collect_metrics: bool = False, trace_ids=None,
+            checkpointer=None, resume: bool = False):
         """Returns (selections (B, V), cycles (B,), finished (B,)).
         ``seeds`` gives each instance its own engine seed (fused batch
         campaigns: row i carries job i's declared seed); default is the
@@ -191,11 +291,25 @@ class _BatchedRunnerBase:
         telemetry-off program is untouched and cached separately).
         ``trace_ids`` (serve dispatches) lands in
         ``self.last_trace_ids`` so the per-dispatch spans stay joined
-        to the jobs that produced them."""
+        to the jobs that produced them.  ``checkpointer``
+        (robustness/checkpoint.SolveCheckpointer) switches to the
+        chunked preemption-safe drive — snapshots at chunk
+        boundaries, ``resume`` restores — with bit-exact selections
+        and cycles; without one this path compiles nothing new."""
         from ..observability.metrics import metric_records
 
         from ..observability.spans import SpanClock
 
+        if checkpointer is not None:
+            if collect_metrics:
+                raise ValueError(
+                    "checkpointed campaign runs do not collect the "
+                    "per-cycle telemetry planes (the metric-plane "
+                    "carry is not part of the batched snapshot); "
+                    "run telemetry and checkpointing separately")
+            return self._run_checkpointed(seed, max_cycles, seeds,
+                                          checkpointer, resume,
+                                          trace_ids)
         self.max_cycles = max_cycles
         self._collect_metrics = bool(collect_metrics)
         if trace_ids is not None and len(trace_ids) > self.B:
@@ -416,29 +530,12 @@ class BatchedMaxSum(_BatchedRunnerBase):
             ]}
         self.B = batch
 
-        base = self.solver
-        hetero = self._hetero
-
         def one_instance(args, key):
             # swap the template solver's device constants for this
             # instance's; the per-instance arrays are vmapped ARGUMENTS,
             # so one compiled program serves any instance set of the
             # same shape
-            orig = base.buckets
-            updates = {"buckets": [
-                (c, ei, args["var_ids"][bi] if hetero else vi)
-                for bi, (c, (_, ei, vi))
-                in enumerate(zip(args["cubes"], orig))
-            ]}
-            if hetero:
-                updates.update(
-                    var_costs=args["var_costs"],
-                    domain_mask=args["domain_mask"],
-                    domain_size=args["domain_size"],
-                    edge_var=args["edge_var"],
-                )
-            saved = _swap_dev(base, updates)
-            try:
+            with self._swapped(args) as base:
                 out = self._drive(base, base.init_state(key))
                 final, planes = out if self._collect_metrics \
                     else (out, None)
@@ -448,13 +545,40 @@ class BatchedMaxSum(_BatchedRunnerBase):
                 # — the live assignment must be rebuilt from the final
                 # messages, the same decode the sync engine uses
                 sel = base.assignment_indices(final)
-            finally:
-                _restore_dev(base, saved)
             if planes is not None:
                 return sel, final["cycle"], final["finished"], planes
             return sel, final["cycle"], final["finished"]
 
         self._one = one_instance
+
+    def _swap_updates(self, args):
+        """This instance's device-constant overrides (the cube stacks
+        plus, on the hetero path, the whole batched topology)."""
+        updates = {"buckets": [
+            (c, ei, args["var_ids"][bi] if self._hetero else vi)
+            for bi, (c, (_, ei, vi))
+            in enumerate(zip(args["cubes"], self.solver.buckets))
+        ]}
+        if self._hetero:
+            updates.update(
+                var_costs=args["var_costs"],
+                domain_mask=args["domain_mask"],
+                domain_size=args["domain_size"],
+                edge_var=args["edge_var"],
+            )
+        return updates
+
+    @contextmanager
+    def _swapped(self, args):
+        """The template solver with one vmapped instance's arrays
+        swapped into its device-constant cache — the shared body of
+        the single-program run AND the chunked checkpoint programs,
+        so the swap logic cannot drift between them."""
+        saved = _swap_dev(self.solver, self._swap_updates(args))
+        try:
+            yield self.solver
+        finally:
+            _restore_dev(self.solver, saved)
 
     def _build_args(self, instances):
         _check_same_shape(instances)
@@ -537,46 +661,51 @@ class _BatchedLocalSearch(_BatchedRunnerBase):
             ]}
         self.B = batch
 
-        base = self.solver
-        hetero = self._hetero
-        swap_prob = self._swap_probability
-
         def one_instance(args, key):
-            # swap in this instance's cubes; the per-constraint optima
-            # (DSA-B's violation test) must be re-derived from them
-            saved = {a: getattr(base, a) for a in self._swap_attrs}
-            saved["buckets"] = base.buckets
-            saved["bucket_optima"] = base.bucket_optima
-            if swap_prob:
-                saved["probability"] = base.probability
-            try:
-                base.buckets = [
-                    (c, args["var_ids"][bi] if hetero else vi)
-                    for bi, (c, (_, vi))
-                    in enumerate(zip(args["cubes"], saved["buckets"]))
-                ]
-                base.bucket_optima = [
-                    jnp.min(c.reshape(c.shape[0], -1), axis=-1)
-                    if c.shape[0] else jnp.zeros((0,), dtype=c.dtype)
-                    for c in args["cubes"]
-                ]
-                if hetero:
-                    for a in self._swap_attrs:
-                        setattr(base, a, args[a])
-                if swap_prob:
-                    base.probability = args["probability"]
+            with self._swapped(args) as base:
                 out = self._drive(base, base.init_state(key))
                 final, planes = out if self._collect_metrics \
                     else (out, None)
-            finally:
-                for a, v in saved.items():
-                    setattr(base, a, v)
             if planes is not None:
                 return (final["x"], final["cycle"],
                         final["finished"], planes)
             return final["x"], final["cycle"], final["finished"]
 
         self._one = one_instance
+
+    @contextmanager
+    def _swapped(self, args):
+        """The template solver with one vmapped instance's cubes (and,
+        on the hetero path, its whole topology) swapped in; the
+        per-constraint optima (DSA-B's violation test) are re-derived
+        from the swapped cubes.  Shared by the single-program run and
+        the chunked checkpoint programs."""
+        base = self.solver
+        saved = {a: getattr(base, a) for a in self._swap_attrs}
+        saved["buckets"] = base.buckets
+        saved["bucket_optima"] = base.bucket_optima
+        if self._swap_probability:
+            saved["probability"] = base.probability
+        try:
+            base.buckets = [
+                (c, args["var_ids"][bi] if self._hetero else vi)
+                for bi, (c, (_, vi))
+                in enumerate(zip(args["cubes"], saved["buckets"]))
+            ]
+            base.bucket_optima = [
+                jnp.min(c.reshape(c.shape[0], -1), axis=-1)
+                if c.shape[0] else jnp.zeros((0,), dtype=c.dtype)
+                for c in args["cubes"]
+            ]
+            if self._hetero:
+                for a in self._swap_attrs:
+                    setattr(base, a, args[a])
+            if self._swap_probability:
+                base.probability = args["probability"]
+            yield base
+        finally:
+            for a, v in saved.items():
+                setattr(base, a, v)
 
     def _build_args(self, instances):
         _check_same_shape(instances)

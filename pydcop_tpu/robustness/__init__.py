@@ -1,0 +1,20 @@
+"""Robustness layer: surviving preemption instead of losing the run.
+
+The source paper's resilience story is algorithmic — k-replicated
+computations plus a distributed repair protocol survive *agents*
+vanishing mid-solve.  The compiled stack's analog of a vanished agent
+is the device/process being PREEMPTED mid-solve, and the answer is
+the canonical training-stack shape: periodic checkpoints at the
+existing chunk sync boundaries plus a deterministic, bit-exact
+restore (``checkpoint.py``).  PR 13's crash journals cover the warm
+*delta-session* tail; this package covers the solve itself.
+"""
+
+from .checkpoint import (CheckpointError, CheckpointStore, Preempted,
+                         SolveCheckpointer, checkpoint_fingerprint,
+                         state_signature, tree_to_device,
+                         tree_to_host)
+
+__all__ = ["CheckpointError", "CheckpointStore", "Preempted",
+           "SolveCheckpointer", "checkpoint_fingerprint",
+           "state_signature", "tree_to_device", "tree_to_host"]

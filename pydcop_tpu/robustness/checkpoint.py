@@ -1,0 +1,534 @@
+"""Preemption-safe solves: chunk-boundary checkpoints with bit-exact
+resume (ISSUE 15).
+
+A long solve's carry — q/r message planes, selections, cycle counter,
+RNG key, freeze/telemetry planes — is a pure function of its inputs,
+so a snapshot of the carry taken at a chunk sync boundary is enough
+to continue the run EXACTLY where a kill stopped it: the chunked step
+arithmetic is boundary-invariant (the PR 2 chunked==eager guard), so
+the resumed run reproduces the uninterrupted run's selections AND
+convergence cycles bit-exactly.  Three pieces:
+
+* :class:`CheckpointStore` — a directory of atomically written
+  snapshot files (write-temp → flush+fsync → rename; a kill mid-write
+  can never tear the previous snapshot).  A file that fails to read
+  back is QUARANTINED (moved aside to ``*.corrupt`` through the same
+  helper the executable cache uses — ``engine/_cache.quarantine_file``
+  — and counted), never re-read forever and never fatal: the caller
+  starts fresh.
+* **manifest fingerprinting** — every snapshot carries the
+  environment/program identity it was taken under
+  (:func:`checkpoint_fingerprint`: jax version, backend, machine
+  arch, device count, precision policy, step layout, mesh shape) plus
+  the state tree's shape/dtype signature.  Resume into a MISMATCHED
+  program refuses loudly with a :class:`CheckpointError` naming every
+  mismatched field — a bf16 daemon silently continuing an f32
+  snapshot would diverge without a trace, and that failure mode is
+  exactly what the manifest exists to make impossible.
+* :class:`SolveCheckpointer` — the per-run driver the engines call at
+  their EXISTING chunk boundaries (``maybe_save``): it decides when a
+  snapshot is due (``every`` executed cycles, plus always at the
+  final boundary), materializes the carry on host, and accounts
+  ``checkpoint_s``/``checkpoint_bytes``/``resumed_from_cycle`` for
+  the telemetry record (schema minor 6).  Checkpointing adds no host
+  syncs: saves happen only where the engine already read the two
+  boundary control scalars, and with no checkpointer attached every
+  hook is dead code and the compiled programs are byte-identical.
+
+The deterministic "kill -9 mid-solve" the chaos bench drives is the
+``preempt_after`` hook: after the N-th successful snapshot the
+checkpointer fires ``on_preempt`` (default: raise :class:`Preempted`;
+the CLI's ``PYDCOP_TPU_PREEMPT_AFTER`` maps it to a real
+``SIGKILL``-style process death), so kill→resume tests are exact, not
+timing-dependent.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..engine._cache import quarantine_file
+
+logger = logging.getLogger(__name__)
+
+#: env hook: after this many successful snapshot writes the process
+#: kills itself (SIGKILL) — the deterministic mid-solve preemption the
+#: kill→resume tests and the bench_chaos preempt leg drive
+PREEMPT_ENV = "PYDCOP_TPU_PREEMPT_AFTER"
+
+
+def atomic_write(path: str, data) -> int:
+    """Durable file replacement: write-temp in the target directory →
+    flush+fsync → rename.  A kill at ANY point leaves either the
+    previous complete file or the new one, never a torn file.  ONE
+    implementation for every store that needs the discipline (the
+    checkpoint snapshots here, ``commands/batch.py``'s progress file,
+    the serve preemption requeue file) so the durability policy
+    cannot drift between them.  ``data`` is bytes or str; returns the
+    byte count written."""
+    if isinstance(data, str):
+        data = data.encode()
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            # mkstemp creates 0600 and os.replace preserves it: chmod
+            # to the repo's usual 0644 so a rewritten progress/requeue
+            # file stays readable to whoever could read it before
+            os.fchmod(f.fileno(), 0o644)
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return len(data)
+
+
+class CheckpointError(ValueError):
+    """A snapshot that must NOT be restored: the manifest's
+    environment/program fingerprint or the state tree's
+    shape/dtype signature does not match the program about to consume
+    it.  ``kind`` classifies (``fingerprint`` | ``state`` |
+    ``store``), ``details`` names every mismatched field with the
+    (saved, current) pair — a structured refusal, never a silent
+    divergence."""
+
+    def __init__(self, msg: str, kind: str = "fingerprint",
+                 **details):
+        super().__init__(msg)
+        self.kind = str(kind)
+        self.details = dict(details)
+
+
+class Preempted(RuntimeError):
+    """The injected preemption fired: the run died right after a
+    snapshot landed (the in-process stand-in for kill -9)."""
+
+    def __init__(self, saves: int):
+        super().__init__(
+            f"preempted after checkpoint #{saves} (injected)")
+        self.saves = int(saves)
+
+
+def checkpoint_fingerprint(precision: Optional[str] = None,
+                           layout: Optional[str] = None,
+                           mesh: Optional[Dict[str, int]] = None,
+                           algo: Optional[str] = None) -> Dict[str, Any]:
+    """The identity a snapshot is only valid under.  Same spirit as
+    ``ExecutableCache._fingerprint`` — jax version, backend, machine
+    architecture, device count — extended with the PROGRAM identity
+    knobs that change the numerics or the state coordinates: the
+    precision policy, the step layout, the (dp, tp) mesh shape and
+    the algorithm family."""
+    import platform
+
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "arch": platform.machine(),
+        "devices": jax.device_count(),
+        "precision": str(precision) if precision else None,
+        "layout": str(layout) if layout else None,
+        "mesh": dict(mesh) if mesh else None,
+        "algo": str(algo) if algo else None,
+    }
+
+
+def check_fingerprint(saved: Dict[str, Any], current: Dict[str, Any]):
+    """Field-by-field comparison; raises :class:`CheckpointError`
+    naming EVERY mismatched field (not just the first — an operator
+    fixing a resume wants the whole diff at once)."""
+    mismatched = {}
+    for field in sorted(set(saved) | set(current)):
+        if saved.get(field) != current.get(field):
+            mismatched[field] = (saved.get(field),
+                                 current.get(field))
+    if mismatched:
+        diff = ", ".join(
+            f"{k}: saved={s!r} current={c!r}"
+            for k, (s, c) in sorted(mismatched.items()))
+        raise CheckpointError(
+            f"checkpoint fingerprint mismatch ({diff}); refusing to "
+            f"resume into a different program — re-run without "
+            f"--resume to start fresh, or restore the original "
+            f"{'/'.join(sorted(mismatched))} configuration",
+            kind="fingerprint", **mismatched)
+
+
+# --------------------------------------------------- host<->device
+
+
+def tree_to_host(tree):
+    """Materialize a (possibly device-resident, possibly sharded)
+    state pytree on host as plain numpy — ONE gather per leaf, at a
+    boundary where the engine already synced.  For sharded carries
+    this is the per-shard save: every shard's rows land in the full
+    host array (addressable single-process meshes)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def tree_to_device(tree, shardings=None):
+    """Re-place a host snapshot on device.  With ``shardings`` (a
+    matching pytree of ``jax.sharding.Sharding``, taken from the
+    freshly initialized template state) every leaf is re-sharded via
+    ``device_put`` — the resume-side re-shard of a mesh carry;
+    without, plain ``jnp.asarray`` placement (single chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    if shardings is None:
+        return jax.tree_util.tree_map(jnp.asarray, tree)
+
+    def place(x, s):
+        # only pin leaves that genuinely span the mesh: committing a
+        # control scalar (cycle/finished) to its incidental single
+        # device would conflict with the multi-device chunk program
+        # the uncommitted original dispatched into
+        if s is not None and len(getattr(s, "device_set", ())) > 1:
+            return jax.device_put(x, s)
+        return jnp.asarray(x)
+
+    return jax.tree_util.tree_map(place, tree, shardings)
+
+
+def state_signature(tree) -> Tuple:
+    """Flattened (path, shape, dtype) signature of a state pytree —
+    the restore-side compatibility gate: a snapshot can only flow
+    into a carry of the exact same structure.  JSON-stable (string
+    paths, listed shapes) so it survives the manifest roundtrip."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(
+        (jax.tree_util.keystr(path),
+         tuple(int(d) for d in getattr(x, "shape", ())),
+         str(np.asarray(x).dtype if not hasattr(x, "dtype")
+             else x.dtype))
+        for path, x in leaves)
+
+
+def _signature_jsonable(sig) -> list:
+    return [[p, list(shape), dt] for p, shape, dt in sig]
+
+
+def _signature_from_json(raw) -> Tuple:
+    return tuple((p, tuple(shape), dt) for p, shape, dt in raw)
+
+
+# ------------------------------------------------------------- store
+
+
+class CheckpointStore:
+    """A directory of atomically written, fingerprint-manifested
+    snapshots.
+
+    One file per snapshot name (``<sha256(name)>.ckpt``: caller-chosen
+    names are not filesystem-safe; the name is recorded inside the
+    manifest), holding ``pickle((manifest, payload))``.  Writes are
+    write-temp → flush+fsync → rename, so a concurrent reader or a
+    kill mid-save always sees either the previous complete snapshot
+    or the new one, never a torn file.  Reads that fail (torn by a
+    crash that predates the atomic discipline, disk bit-rot, the
+    ``checkpoint_corrupt`` chaos point) QUARANTINE the file and
+    return a miss.  ``stats`` mirrors the executable cache's counter
+    shape so the serve ops plane surfaces both the same way."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.stats: Dict[str, int] = {
+            "saved": 0, "restored": 0, "missing": 0, "corrupt": 0,
+            "deleted": 0, "bytes_written": 0}
+        #: optional fault plan (serving/faults.FaultPlan): the
+        #: ``checkpoint_corrupt`` chaos point garbles the on-disk
+        #: snapshot before the read so the REAL quarantine machinery
+        #: is exercised end-to-end; None (default) = dead code
+        self.faults = None
+        self._warned = False
+        os.makedirs(directory, exist_ok=True)
+
+    def path_for(self, name: str) -> str:
+        digest = hashlib.sha256(str(name).encode()).hexdigest()
+        return os.path.join(self.directory, digest + ".ckpt")
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self.path_for(name))
+
+    def save(self, name: str, payload, manifest: Dict[str, Any]) -> int:
+        """Serialize one snapshot atomically; returns bytes written.
+        ``manifest`` is stored verbatim (plus the name); the payload
+        must already be host-side (``tree_to_host``)."""
+        path = self.path_for(name)
+        manifest = dict(manifest, name=str(name))
+        size = atomic_write(path, pickle.dumps(
+            (manifest, payload), protocol=pickle.HIGHEST_PROTOCOL))
+        self.stats["saved"] += 1
+        self.stats["bytes_written"] += int(size)
+        return int(size)
+
+    def load(self, name: str
+             ) -> Optional[Tuple[Dict[str, Any], Any]]:
+        """``(manifest, payload)`` or None on a miss.  A file that
+        cannot be unpickled is quarantined (``*.corrupt`` move-aside
+        via the shared ``engine/_cache.quarantine_file`` helper),
+        counted, warned once — and reported as a miss so the caller
+        starts fresh instead of dying on the same garbage forever."""
+        path = self.path_for(name)
+        if self.faults is not None and os.path.exists(path):
+            try:
+                self.faults.check("checkpoint_corrupt",
+                                  job_ids=(str(name),))
+            except Exception:
+                # garble in place: the real read/quarantine machinery
+                # below must absorb it, not a simulated branch
+                with open(path, "wb") as f:
+                    f.write(b"\x00chaos: injected checkpoint "
+                            b"corruption")
+        try:
+            with open(path, "rb") as f:
+                manifest, payload = pickle.load(f)
+            if not isinstance(manifest, dict):
+                raise ValueError(
+                    f"manifest is {type(manifest).__name__}, "
+                    f"not a dict")
+        except FileNotFoundError:
+            self.stats["missing"] += 1
+            return None
+        except Exception as e:
+            self.stats["corrupt"] += 1
+            self._warn_once(
+                f"unreadable checkpoint {path}: {e} "
+                f"({quarantine_file(path)}); starting fresh")
+            return None
+        # NOT counted restored yet: the caller still runs the
+        # fingerprint/signature gates, and a refused load must not
+        # inflate pydcop_checkpoint_restores_total — adopters call
+        # count_restored() once the payload is actually in use
+        return manifest, payload
+
+    def count_restored(self):
+        """One snapshot genuinely ADOPTED (all gates passed, state in
+        use) — the event ``restored`` / the restores metric count."""
+        self.stats["restored"] += 1
+
+    def delete(self, name: str) -> bool:
+        """Remove a completed run's snapshot (batch rungs drop theirs
+        once every job's result is registered)."""
+        try:
+            os.remove(self.path_for(name))
+        except OSError:
+            return False
+        self.stats["deleted"] += 1
+        return True
+
+    def _warn_once(self, msg: str):
+        if not self._warned:
+            self._warned = True
+            logger.warning("checkpoint store degraded: %s", msg)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Counters for serve records / ``serve-status``."""
+        return dict(self.stats)
+
+
+# ------------------------------------------------------ checkpointer
+
+
+def _default_preempt(saves: int):
+    raise Preempted(saves)
+
+
+def env_preempt_hook() -> Tuple[Optional[int], Optional[Callable]]:
+    """The CLI's deterministic-kill hook: ``(preempt_after,
+    on_preempt)`` from :data:`PREEMPT_ENV`, or ``(None, None)``.  The
+    hook is a REAL process death (SIGKILL to self) so kill→resume
+    legs exercise the same path an external preemption does — no
+    atexit, no finally blocks, no flushed buffers."""
+    raw = os.environ.get(PREEMPT_ENV)
+    if not raw:
+        return None, None
+    try:
+        after = int(raw)
+        if after < 1:
+            raise ValueError(raw)
+    except ValueError:
+        raise ValueError(
+            f"{PREEMPT_ENV} wants a positive checkpoint count, "
+            f"got {raw!r}")
+
+    def kill(_saves: int):
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    return after, kill
+
+
+class SolveCheckpointer:
+    """One run's checkpoint driver: owns the (store, name, cadence,
+    fingerprint) tuple and the telemetry accounting; the engines call
+    :meth:`maybe_save` at their existing chunk boundaries and
+    :meth:`load` before initializing state on ``--resume``.
+
+    ``every`` is an executed-cycle cadence, not a boundary guarantee:
+    snapshots land on the FIRST chunk boundary at or past each
+    multiple (plus always on the final boundary) — so chunk-size and
+    cadence never have to divide each other, and snapshots still
+    occur only where the engine already synced."""
+
+    def __init__(self, store: CheckpointStore, name: str,
+                 every: Optional[int] = None,
+                 fingerprint: Optional[Dict[str, Any]] = None,
+                 preempt_after: Optional[int] = None,
+                 on_preempt: Optional[Callable[[int], None]] = None):
+        self.store = store
+        self.name = str(name)
+        self.every = max(1, int(every)) if every else None
+        self.fingerprint = dict(fingerprint or {})
+        self.saves = 0
+        self.last_saved_cycle: Optional[int] = None
+        #: telemetry accounting (schema minor 6)
+        self.checkpoint_s = 0.0
+        self.checkpoint_bytes = 0
+        self.resumed_from_cycle: Optional[int] = None
+        self._preempt_after = preempt_after
+        self._on_preempt = on_preempt or _default_preempt
+
+    # ------------------------------------------------------------ save
+
+    def due(self, cycle: int, final: bool = False) -> bool:
+        cycle = int(cycle)
+        if self.last_saved_cycle is not None \
+                and cycle <= self.last_saved_cycle:
+            return False
+        if final:
+            return True
+        if self.every is None:
+            return False
+        anchor = self.last_saved_cycle or 0
+        return cycle >= anchor + self.every
+
+    def maybe_save(self, cycle: int, payload, final: bool = False,
+                   extra: Optional[Dict[str, Any]] = None) -> bool:
+        """Save when due.  ``payload`` may be the host tree itself or
+        a zero-arg callable producing it (so the device→host gather
+        only happens on boundaries that actually save)."""
+        if not self.due(cycle, final=final):
+            return False
+        self.save(cycle, payload, extra=extra)
+        return True
+
+    def save(self, cycle: int, payload,
+             extra: Optional[Dict[str, Any]] = None):
+        t0 = time.perf_counter()
+        if callable(payload):
+            payload = payload()
+        manifest = {
+            "fingerprint": dict(self.fingerprint),
+            "cycle": int(cycle),
+            "signature": _signature_jsonable(
+                state_signature(payload)),
+            "saved_unix": time.time(),
+        }
+        if extra:
+            manifest.update(extra)
+        size = self.store.save(self.name, payload, manifest)
+        self.checkpoint_bytes += int(size)
+        self.checkpoint_s += time.perf_counter() - t0
+        self.saves += 1
+        self.last_saved_cycle = int(cycle)
+        if self._preempt_after is not None \
+                and self.saves >= self._preempt_after:
+            self._on_preempt(self.saves)
+
+    # ------------------------------------------------------------ load
+
+    def load(self, template=None):
+        """The snapshot's payload, fingerprint- and signature-checked,
+        or None when absent/quarantined (the caller starts fresh).
+        ``template`` — the freshly initialized carry the payload is
+        about to replace — gates the state signature; a mismatch is a
+        structured refusal (a snapshot of a DIFFERENT instance or
+        telemetry configuration must never flow into this program)."""
+        entry = self.store.load(self.name)
+        if entry is None:
+            return None
+        manifest, payload = entry
+        check_fingerprint(manifest.get("fingerprint") or {},
+                          self.fingerprint)
+        if template is not None:
+            saved_sig = _signature_from_json(
+                manifest.get("signature") or [])
+            want_sig = state_signature(template)
+            if saved_sig != want_sig:
+                drift = [p for (p, sh, dt), (p2, sh2, dt2)
+                         in zip(saved_sig, want_sig)
+                         if (sh, dt) != (sh2, dt2)] \
+                    if len(saved_sig) == len(want_sig) else ["tree"]
+                raise CheckpointError(
+                    f"checkpoint state signature mismatch at "
+                    f"{', '.join(drift) or 'tree structure'}: the "
+                    f"snapshot was taken for a different instance "
+                    f"shape or run configuration; refusing to resume",
+                    kind="state", drift=drift)
+        self.resumed_from_cycle = int(manifest.get("cycle", 0))
+        self.last_saved_cycle = self.resumed_from_cycle
+        self.store.count_restored()
+        return payload
+
+    # -------------------------------------------------------- telemetry
+
+    def telemetry(self) -> Dict[str, Any]:
+        """The schema-minor-6 fields of this run's summary record."""
+        out: Dict[str, Any] = {
+            "checkpoint_s": round(self.checkpoint_s, 6),
+            "checkpoint_bytes": int(self.checkpoint_bytes),
+        }
+        if self.resumed_from_cycle is not None:
+            out["resumed_from_cycle"] = int(self.resumed_from_cycle)
+        return out
+
+
+def solve_checkpoint_name(dcop_files, algo: str, mode: str,
+                          algo_params, seed: int,
+                          precision: Optional[str]) -> str:
+    """The ``solve`` CLI's snapshot name: one checkpoint per job
+    identity, so a directory can host a whole campaign's checkpoints
+    without collisions — and a --resume against the wrong job misses
+    instead of restoring someone else's state.  The cycle BUDGET is
+    deliberately not part of the identity: the carry does not depend
+    on it (boundary-invariant chunk arithmetic), so a resume may
+    extend ``--max_cycles`` and keep solving the same state.  One
+    caveat, enforced by the signature gate rather than silently
+    mis-restored: runs whose carry includes budget-SIZED planes (the
+    telemetry metric planes, the sharded anytime cost-trace buffer)
+    must resume with the same budget — the plane shapes bake it in,
+    and a changed budget refuses with a structured ``state``
+    mismatch instead of truncating or padding recorded telemetry."""
+    del precision  # fingerprint-only, see below
+    # precision and layout are PROGRAM identity, not job identity:
+    # they live in the manifest fingerprint, where a drifted resume
+    # REFUSES with a structured mismatch — folding them into the name
+    # would turn that refusal into a silent fresh start
+    params = sorted(str(p) for p in algo_params or []
+                    if not str(p).strip().startswith(
+                        ("precision:", "layout:")))
+    ident = json.dumps([sorted(str(p) for p in dcop_files), algo,
+                        mode, params, int(seed)])
+    return "solve:" + hashlib.sha256(ident.encode()).hexdigest()

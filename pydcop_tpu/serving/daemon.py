@@ -33,6 +33,8 @@ docs/architecture.md ("Operating under failure").
 """
 
 import itertools
+import json
+import os
 import queue as _stdqueue
 import threading
 import time
@@ -53,6 +55,50 @@ _IDLE_TICK = 0.2
 #: enough that a line mid-hand-off still gets its REJECTED response
 _STOP_DRAIN_GRACE = 0.25
 
+#: the preemption drain's requeue file, inside the --checkpoint
+#: directory: one raw request line per job the stopping daemon did
+#: not get to, re-admitted by the next daemon start
+REQUEUE_FILE = "requeue.jsonl"
+
+
+def requeue_write(directory: str, lines) -> int:
+    """Merge ``lines`` into DIR/requeue.jsonl atomically (read the
+    survivors of any previous unconsumed preemption, append, one
+    write-temp+fsync+rename via the shared
+    ``robustness/checkpoint.atomic_write`` helper) — the same
+    durability discipline as the checkpoints beside it.  Returns the
+    file's total line count."""
+    from ..robustness.checkpoint import atomic_write
+
+    path = os.path.join(directory, REQUEUE_FILE)
+    existing = []
+    try:
+        with open(path) as f:
+            existing = [ln.rstrip("\n") for ln in f if ln.strip()]
+    except OSError:
+        pass
+    merged = existing + [ln.rstrip("\n") for ln in lines
+                         if ln.strip()]
+    atomic_write(path,
+                 "\n".join(merged) + ("\n" if merged else ""))
+    return len(merged)
+
+
+def requeue_take(directory: str):
+    """Consume DIR/requeue.jsonl: its lines, file removed — the
+    restarted daemon feeds them ahead of its live sources."""
+    path = os.path.join(directory, REQUEUE_FILE)
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError:
+        return []
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return lines
+
 
 class ServeLoop:
     """One loop instance per daemon process."""
@@ -71,7 +117,8 @@ class ServeLoop:
                  retry_backoff_s: float = 0.05,
                  breaker_threshold: int = 4,
                  breaker_cooldown_s: float = 5.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 checkpoints=None):
         self.admission = admission
         self.dispatcher = dispatcher
         self.reporter = reporter
@@ -116,7 +163,19 @@ class ServeLoop:
         self.stats: Dict[str, int] = {
             "received": 0, "admitted": 0, "rejected": 0,
             "completed": 0, "stats_served": 0,
-            "retries": 0, "bisections": 0, "shed": 0, "poisoned": 0}
+            "retries": 0, "bisections": 0, "shed": 0, "poisoned": 0,
+            "requeued": 0}
+        #: preemption checkpointing (``serve --checkpoint DIR``,
+        #: robustness/checkpoint.CheckpointStore): a stopping daemon
+        #: REQUEUES still-queued jobs into DIR/requeue.jsonl (atomic)
+        #: instead of rejecting them, and warm sessions keep their
+        #: journals + base snapshots — a restarted daemon re-admits
+        #: the requeue file and continues rather than recomputes.
+        #: None (the default): the historical reject-on-stop contract
+        self.checkpoints = checkpoints
+        #: loop passes probed by the ``preempt`` fault point (the
+        #: dispatch_index a chaos plan schedules preemption by)
+        self._preempt_probe = 0
         #: the fault-tolerance layer (ISSUE 13): an optional injected
         #: FaultPlan (chaos runs; None = every hook dead, dispatch
         #: behavior byte-identical), the retry/backoff knobs (sleep is
@@ -208,6 +267,19 @@ class ServeLoop:
                 "pydcop_session_journal_replays_total",
                 "warm sessions rebuilt by journal replay after a "
                 "restart"),
+            "requeued": registry.counter(
+                "pydcop_serve_requeued_total",
+                "jobs requeued to the checkpoint directory on a "
+                "preemption drain instead of rejected"),
+            "checkpoint_writes": registry.counter(
+                "pydcop_checkpoint_writes_total",
+                "solver/session checkpoints written"),
+            "checkpoint_restores": registry.counter(
+                "pydcop_checkpoint_restores_total",
+                "solver/session checkpoints restored"),
+            "checkpoint_corrupt": registry.counter(
+                "pydcop_checkpoint_corrupt_total",
+                "checkpoints quarantined as corrupt"),
         }
 
         def sample():
@@ -233,6 +305,15 @@ class ServeLoop:
                 m["sessions_open"].set(len(sessions))
                 m["journal_replays"].set_total(
                     sessions.stats.get("journal_replays", 0))
+            checkpoints = self.checkpoints
+            if checkpoints is not None:
+                caches["checkpoint"] = dict(checkpoints.stats)
+                m["checkpoint_writes"].set_total(
+                    checkpoints.stats.get("saved", 0))
+                m["checkpoint_restores"].set_total(
+                    checkpoints.stats.get("restored", 0))
+                m["checkpoint_corrupt"].set_total(
+                    checkpoints.stats.get("corrupt", 0))
             from .faults import BREAKER_STATES
             for rung, r in self._breaker.snapshot().items():
                 m["breaker_state"].set(
@@ -337,6 +418,13 @@ class ServeLoop:
                            if exec_cache is not None else None),
             "sessions": (sessions.snapshot()
                          if sessions is not None else None),
+            # the preemption-safety counters (ISSUE 15): snapshots
+            # written/restored/quarantined plus the sessions' own
+            # checkpoint_saved/checkpoint_restored ride `sessions`
+            # above; requeued-on-preempt rides `stats`
+            "checkpoints": (self.checkpoints.snapshot()
+                            if self.checkpoints is not None
+                            else None),
             "memory": memory,
         }
         if metrics is not None:
@@ -779,6 +867,21 @@ class ServeLoop:
                 pass
             if self._stop.is_set():
                 break
+            if self.faults is not None:
+                # the preempt chaos point: the Nth loop pass is where
+                # the seeded plan kills this daemon — it stops like a
+                # SIGTERM, and with a checkpoint store the drain
+                # below REQUEUES instead of rejecting
+                fired = self.faults.dispatch_fires(
+                    "preempt", self._preempt_probe)
+                self._preempt_probe += 1
+                if fired is not None:
+                    self._serve_fault(
+                        "preempt", "serve",
+                        probe=self._preempt_probe - 1,
+                        checkpointed=self.checkpoints is not None)
+                    self.request_stop()
+                    break
             self._dispatch(self.admission.due())
             self._maybe_heartbeat()
             if self._input_closed.is_set() and self._inbox.empty():
@@ -789,10 +892,19 @@ class ServeLoop:
                 if self._inbox.empty():
                     break
         if self._stop.is_set():
-            # graceful stop: queued jobs and unread lines are REJECTED
-            # with a structured reason (never silently dropped)
+            # graceful stop.  Default contract: queued jobs and
+            # unread lines are REJECTED with a structured reason
+            # (never silently dropped).  Preemption contract (a
+            # checkpoint store is attached): they are REQUEUED to
+            # DIR/requeue.jsonl instead, so the restarted daemon
+            # continues where this one was killed
+            requeue: list = []
             for group in self.admission.drain():
                 for job in group.jobs:
+                    if self.checkpoints is not None:
+                        requeue.append(json.dumps(job.request))
+                        self._count("requeued")
+                        continue
                     self._emit_rejection(
                         job.job_id, "serve daemon shutting down "
                         "(queued, not yet dispatched)", job.reply,
@@ -812,6 +924,21 @@ class ServeLoop:
                             or self.clock() >= grace_until:
                         break
                     continue
+                if not line.strip():
+                    continue
+                # count it received: the reconciliation invariant is
+                # received == admitted + rejected-at-the-door +
+                # stats_served + requeued-FROM-THE-INBOX (this arm).
+                # Queued-job requeues above were already counted
+                # `admitted` at feed time, so `requeued` as a whole
+                # deliberately double-counts them against `admitted`
+                # — it answers "how many jobs moved to the next
+                # daemon", not "how many lines arrived"
+                self._count("received")
+                if self.checkpoints is not None:
+                    requeue.append(line)
+                    self._count("requeued")
+                    continue
                 job_id = None
                 try:
                     job_id = parse_request(line.strip())["id"]
@@ -819,22 +946,31 @@ class ServeLoop:
                     # parse_request wraps every failure (bad JSON
                     # included) in RequestError, so this arm is total
                     job_id = e.job_id
-                if line.strip():
-                    # count it received: the stats must reconcile
-                    # (received == admitted + rejected-at-the-door
-                    # + stats_served)
-                    self._count("received")
-                    self._emit_rejection(
-                        job_id, "serve daemon shutting down "
-                        "(received, not yet admitted)", reply,
-                        reason_class="shutdown")
+                self._emit_rejection(
+                    job_id, "serve daemon shutting down "
+                    "(received, not yet admitted)", reply,
+                    reason_class="shutdown")
+            if self.checkpoints is not None:
+                total = requeue_write(self.checkpoints.directory,
+                                      requeue)
+                if self.reporter is not None:
+                    self.reporter.serve(
+                        event="preempt_drain",
+                        requeued=len(requeue),
+                        requeue_total=total,
+                        queue_depth=self.admission.depth())
         # shutdown hygiene (ISSUE 13 satellite): every open warm
         # engine closes on SIGTERM AND clean drain — device buffers
         # released, journals truncated — BEFORE the final record, so
-        # its memory snapshot proves zero resident session bytes
+        # its memory snapshot proves zero resident session bytes.
+        # Preemption (stop + checkpoint store) PRESERVES journals and
+        # base snapshots so the restarted daemon rebuilds the warm
+        # sessions instead of recomputing them
         sessions = getattr(self.dispatcher, "delta_sessions", None)
         if sessions is not None:
-            sessions.close_all()
+            sessions.close_all(
+                preserve=(self._stop.is_set()
+                          and self.checkpoints is not None))
         if self.reporter is not None:
             from ..parallel.batch import runner_cache_stats
             from .queue import instance_cache_stats
@@ -857,6 +993,9 @@ class ServeLoop:
                           if getattr(self.dispatcher,
                                      "delta_sessions", None)
                           is not None else None),
+                checkpoints=(self.checkpoints.snapshot()
+                             if self.checkpoints is not None
+                             else None),
                 # the memory accounting snapshot closes every run:
                 # post-mortems read residency without a live daemon
                 memory=self.memory_snapshot())

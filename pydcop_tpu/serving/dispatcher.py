@@ -93,12 +93,20 @@ class DeltaSessions:
                  budget_bytes: Optional[int] = None,
                  resident: bool = True, journal=None,
                  layout: str = "edge_major",
-                 warm_budget: str = "adaptive"):
+                 warm_budget: str = "adaptive",
+                 checkpoints=None):
         from collections import OrderedDict
 
         self.exec_cache = exec_cache
         self.reserve = reserve
         self.cap = int(cap)
+        #: optional CheckpointStore (``serve --checkpoint DIR``): each
+        #: session's post-base-solve carry is snapshotted once, so
+        #: recovery RESTORES the base state instead of re-solving it —
+        #: checkpoint = base snapshot, journal = replayable delta tail
+        #: (the ISSUE 15 division of labor).  None = replay-only
+        #: recovery, behavior unchanged
+        self.checkpoints = checkpoints
         #: warm-engine step layout sessions open at (``serve
         #: --layout``): edge_major (the generic oracle, default),
         #: lane_major (~6x faster per message), fused (cost/variable
@@ -130,7 +138,8 @@ class DeltaSessions:
         # records carry the full key set before the first drop/evict
         self.stats: Dict[str, int] = {
             "opened": 0, "hits": 0, "evictions": 0, "dropped": 0,
-            "evicted_bytes": 0, "closed": 0, "journal_replays": 0}
+            "evicted_bytes": 0, "closed": 0, "journal_replays": 0,
+            "checkpoint_saved": 0, "checkpoint_restored": 0}
 
     def get(self, target: str, target_request: Dict[str, Any],
             default_max_cycles: int, default_seed: int,
@@ -260,6 +269,79 @@ class DeltaSessions:
             # no open handle (e.g. a recovery that failed before
             # re-opening one): remove the file directly
             self.journal.discard(target)
+        if truncate and self.checkpoints is not None:
+            # the base snapshot shares the journal's lifecycle: a
+            # session that ended in a well-defined way (clean close,
+            # eviction, drop) must not be restorable
+            self.checkpoints.delete(self._ckpt_name(target))
+
+    # --------------------------------------------- base checkpoints
+
+    @staticmethod
+    def _ckpt_name(target: str) -> str:
+        return f"session:{target}"
+
+    def checkpoint_base(self, target: str, engine):
+        """Snapshot the session's post-base-solve carry (atomic write
+        + fingerprint manifest) so recovery can restore instead of
+        re-solving.  Best-effort: a failed snapshot degrades to
+        replay-only recovery, never to a failed dispatch."""
+        if self.checkpoints is None:
+            return
+        from ..robustness.checkpoint import checkpoint_fingerprint
+
+        try:
+            payload = engine.state_snapshot()
+            manifest = {"fingerprint": checkpoint_fingerprint(
+                precision=engine.params.get("precision") or "f32",
+                layout=engine.layout, algo=engine.algo)}
+            self.checkpoints.save(self._ckpt_name(target), payload,
+                                  manifest)
+            self.stats["checkpoint_saved"] += 1
+        except Exception as e:  # noqa: BLE001 - durability best-effort
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "session base checkpoint for %r failed (%s); "
+                "recovery will replay the base solve instead",
+                target, e)
+
+    def _restore_base(self, target: str, engine) -> bool:
+        """Try to adopt the target's base snapshot; False (snapshot
+        absent, quarantined-corrupt, or fingerprint-mismatched) means
+        the caller re-runs the base solve — replay recovery is
+        bit-exact either way, the snapshot only saves the work."""
+        if self.checkpoints is None:
+            return False
+        from ..robustness.checkpoint import (check_fingerprint,
+                                             checkpoint_fingerprint)
+
+        entry = self.checkpoints.load(self._ckpt_name(target))
+        if entry is None:
+            return False
+        manifest, payload = entry
+        try:
+            check_fingerprint(
+                manifest.get("fingerprint") or {},
+                checkpoint_fingerprint(
+                    precision=engine.params.get("precision")
+                    or "f32",
+                    layout=engine.layout, algo=engine.algo))
+            engine.restore_state(payload)
+        except Exception:  # noqa: BLE001 - replay owns the truth
+            # ANY adoption failure — fingerprint drift
+            # (CheckpointError), but also a payload whose dict layout
+            # came from another code revision (KeyError) or a failed
+            # device placement — must fall back to the full replay,
+            # which reproduces the same state from first principles.
+            # Letting it escape would hit recover()'s catch-all and
+            # discard the JOURNAL, destroying the recovery the
+            # snapshot only exists to accelerate
+            self.checkpoints.delete(self._ckpt_name(target))
+            return False
+        self.checkpoints.count_restored()
+        self.stats["checkpoint_restored"] += 1
+        return True
 
     def journal_begin(self, target: str, request: Dict[str, Any],
                       seed: int, max_cycles: int,
@@ -337,8 +419,11 @@ class DeltaSessions:
                 spans[k] = round(spans.get(k, 0.0) + v, 6)
 
         try:
-            engine.solve(seed=seed)
-            fold()
+            if not self._restore_base(target, engine):
+                # no usable base snapshot: replay the base solve too
+                # (through the executable cache — a deserialize)
+                engine.solve(seed=seed)
+                fold()
             for e in entries:
                 engine.apply(e["actions"])
                 engine.solve(max_cycles=e.get("max_cycles"))
@@ -355,16 +440,27 @@ class DeltaSessions:
         self._journals[target] = self.journal.open(target)
         return engine, base_request, len(entries), spans
 
-    def close_all(self) -> int:
+    def close_all(self, preserve: bool = False) -> int:
         """Shutdown hygiene (SIGTERM / clean exit): close every open
         warm engine — device buffers released, journals truncated —
         so the post-shutdown memory snapshot reports zero resident
-        session bytes.  Returns the number of sessions closed."""
+        session bytes.  Returns the number of sessions closed.
+
+        ``preserve`` is the PREEMPTION variant (``serve --checkpoint``
+        + SIGTERM): engines still close, but journals and base
+        snapshots stay on disk — the restarted daemon rebuilds each
+        journaled session (restore base snapshot + replay the delta
+        tail) instead of recomputing from scratch."""
         closed = 0
         while self._sessions:
             target, engine = self._sessions.popitem(last=False)
             engine.close()
-            self._journal_close(target, truncate=True)
+            if preserve:
+                handle = self._journals.pop(target, None)
+                if handle is not None:
+                    handle.close(truncate=False)
+            else:
+                self._journal_close(target, truncate=True)
             self.stats["closed"] += 1
             closed += 1
         return closed
@@ -403,7 +499,8 @@ class Dispatcher:
                  resident_deltas: bool = True,
                  faults=None, execute_deadline_s: Optional[float] = None,
                  journal=None, session_layout: str = "edge_major",
-                 warm_budget: str = "adaptive"):
+                 warm_budget: str = "adaptive",
+                 checkpoints=None):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
@@ -427,11 +524,16 @@ class Dispatcher:
         self.last_spans: Dict[str, float] = {}
         #: warm scenario sessions for delta jobs (lazy per target),
         #: LRU-bounded by count AND resident bytes
+        #: the preemption checkpoint store (None outside
+        #: ``serve --checkpoint`` daemons); also read by the serve
+        #: loop's preempt drain
+        self.checkpoints = checkpoints
         self.delta_sessions = DeltaSessions(
             exec_cache=exec_cache, reserve=reserve, cap=session_cap,
             budget_bytes=session_budget_bytes,
             resident=resident_deltas, journal=journal,
-            layout=session_layout, warm_budget=warm_budget)
+            layout=session_layout, warm_budget=warm_budget,
+            checkpoints=checkpoints)
 
     # ---------------------------------------------- fault / watchdog
 
@@ -708,6 +810,9 @@ class Dispatcher:
             self.delta_sessions.journal_begin(
                 target, target_request, base_seed, engine.max_cycles,
                 layout=engine.layout)
+            # checkpoint = base snapshot; the journal the deltas
+            # append to is the replayable tail on top of it
+            self.delta_sessions.checkpoint_base(target, engine)
         # apply() either commits fully or raises with the instance
         # untouched (compile_event validates before any write), so a
         # DeltaError rejection leaves the session trustworthy

@@ -52,9 +52,19 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 #:                       executable is garbage; quarantine + recompile);
 #: ``nan_planes``      — serve admission (the job's cost planes carry
 #:                       NaN; the build-time finite check must reject
-#:                       it with a structured reason).
+#:                       it with a structured reason);
+#: ``preempt``         — the serve loop's per-iteration probe (ISSUE
+#:                       15): the daemon is preempted mid-run under
+#:                       the seeded plan — with ``--checkpoint`` it
+#:                       drains like a SIGTERM, requeueing queued jobs
+#:                       instead of rejecting them (schedule by
+#:                       ``dispatch_index`` = the Nth loop pass);
+#: ``checkpoint_corrupt`` — CheckpointStore.load (the on-disk solver
+#:                       snapshot is garbage; quarantine + fresh
+#:                       start, never a half-restored carry).
 FAULT_POINTS = ("compile_error", "execute_error", "execute_hang",
-                "cache_corrupt", "nan_planes")
+                "cache_corrupt", "nan_planes", "preempt",
+                "checkpoint_corrupt")
 
 
 class FaultInjected(RuntimeError):

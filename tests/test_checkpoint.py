@@ -1,0 +1,624 @@
+"""Preemption-safe solves (ISSUE 15).
+
+Layers under test:
+
+* ``robustness/checkpoint.py`` — the atomic snapshot store (write-temp
+  + fsync + rename), the environment/program fingerprint manifest
+  (mismatched resumes REFUSE with a structured
+  :class:`CheckpointError` naming every drifted field), the
+  corrupt-snapshot quarantine (shared ``engine/_cache.quarantine_file``
+  helper: ``*.corrupt`` move-aside + counter, never fatal), and the
+  deterministic ``preempt_after`` kill hook;
+* kill-mid-chunk → ``--resume`` **bit-exactness** across all four
+  execution surfaces: :class:`SyncEngine` (via ``solve_result``), the
+  sharded mesh (``solve_sharded_result``), the fused campaign runners
+  (``BatchedMaxSum``/``BatchedDsa`` chunked checkpoint drive), and the
+  warm delta session (base snapshot + journal-tail replay through
+  ``DeltaSessions.recover``) — selections AND convergence cycles equal
+  the uninterrupted run's;
+* checkpointing-off invariants: no new compiled programs, and a
+  checkpointing-ON sharded run pays the SAME dispatch/host-sync counts
+  (snapshots ride existing chunk boundaries);
+* the serve preemption drain: SIGTERM-with-``--checkpoint`` requeues
+  queued jobs (atomic ``requeue.jsonl``) instead of rejecting, the
+  ``preempt`` fault point triggers it under a seeded plan, and a
+  restarted loop completes the requeued jobs;
+* ``batch`` crash-safe progress registration (atomic rewrite,
+  torn-tail tolerant) and schema-minor-6 telemetry (frozen minor ≤5
+  readers stay green).
+
+No real sleeps: preemption is the injected ``preempt_after`` hook,
+serve loops run oneshot with tight deadlines.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
+from pydcop_tpu.robustness.checkpoint import (CheckpointError,
+                                              CheckpointStore,
+                                              Preempted,
+                                              SolveCheckpointer,
+                                              checkpoint_fingerprint,
+                                              solve_checkpoint_name,
+                                              tree_to_host)
+
+pytestmark = pytest.mark.ckpt
+
+
+def _coloring(n=40, seed=3):
+    return generate_graph_coloring(n, 3, "scalefree", m_edge=2,
+                                   soft=True, seed=seed)
+
+
+def _fp(**kw):
+    kw.setdefault("precision", "f32")
+    kw.setdefault("algo", "maxsum")
+    return checkpoint_fingerprint(**kw)
+
+
+# ------------------------------------------------------------- store
+
+
+def test_store_roundtrip_atomic_layout(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "job", every=4, fingerprint=_fp())
+    state = {"cycle": np.int32(8), "q": np.zeros((3, 4))}
+    assert ck.maybe_save(8, lambda: state)
+    # due() cadence: not again until 4 more cycles
+    assert not ck.due(10)
+    assert ck.due(12)
+    # always on the final boundary, but never twice for one cycle
+    assert ck.due(9, final=True)
+    # one .ckpt file, no leftover temp files
+    names = os.listdir(tmp_path)
+    assert [n for n in names if n.endswith(".ckpt")]
+    assert not [n for n in names if n.endswith(".tmp")]
+    ck2 = SolveCheckpointer(store, "job", fingerprint=_fp())
+    restored = ck2.load(template=state)
+    assert ck2.resumed_from_cycle == 8
+    assert np.array_equal(restored["q"], state["q"])
+    tele = ck.telemetry()
+    assert tele["checkpoint_bytes"] > 0
+    assert tele["checkpoint_s"] >= 0
+
+
+def test_fingerprint_mismatch_names_every_field(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    SolveCheckpointer(store, "j", fingerprint=_fp()).save(
+        4, {"x": np.zeros(2)})
+    other = SolveCheckpointer(
+        store, "j",
+        fingerprint=_fp(precision="bf16", layout="lane_major"))
+    with pytest.raises(CheckpointError) as e:
+        other.load()
+    assert e.value.kind == "fingerprint"
+    assert set(e.value.details) == {"precision", "layout"}
+    assert "precision" in str(e.value) and "layout" in str(e.value)
+
+
+def test_state_signature_mismatch_refuses(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    SolveCheckpointer(store, "j", fingerprint=_fp()).save(
+        4, {"x": np.zeros((2, 2), dtype=np.float32)})
+    ck = SolveCheckpointer(store, "j", fingerprint=_fp())
+    with pytest.raises(CheckpointError) as e:
+        ck.load(template={"x": np.zeros((3, 3), dtype=np.float32)})
+    assert e.value.kind == "state"
+
+
+def test_corrupt_snapshot_quarantined_not_fatal(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "j", fingerprint=_fp())
+    ck.save(4, {"x": np.zeros(2)})
+    path = store.path_for("j")
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage")
+    ck2 = SolveCheckpointer(store, "j", fingerprint=_fp())
+    assert ck2.load() is None          # a miss, not an exception
+    assert store.stats["corrupt"] == 1
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    # the NEXT load is a plain miss (no re-read of the garbage)
+    assert ck2.load() is None
+    assert store.stats["corrupt"] == 1
+
+
+def test_checkpoint_corrupt_fault_point_garbles_for_real(tmp_path):
+    from pydcop_tpu.serving.faults import FaultPlan
+
+    store = CheckpointStore(str(tmp_path))
+    SolveCheckpointer(store, "j", fingerprint=_fp()).save(
+        4, {"x": np.zeros(2)})
+    store.faults = FaultPlan(
+        schedule=[{"point": "checkpoint_corrupt"}])
+    assert store.load("j") is None
+    assert store.stats["corrupt"] == 1
+    assert os.path.exists(store.path_for("j") + ".corrupt")
+
+
+def test_preempt_after_hook_fires_on_nth_save(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "j", every=1, fingerprint=_fp(),
+                           preempt_after=2)
+    ck.save(1, {"x": np.zeros(1)})
+    with pytest.raises(Preempted) as e:
+        ck.save(2, {"x": np.zeros(1)})
+    assert e.value.saves == 2
+    # the snapshot LANDED before the kill — that is the whole point
+    assert store.load("j") is not None
+
+
+def test_solve_checkpoint_name_identity():
+    a = solve_checkpoint_name(["f.yaml"], "maxsum", "engine",
+                              ["damping:0.5"], 0, None)
+    # precision/layout are fingerprint-only: same name, the
+    # fingerprint refuses instead of silently starting fresh
+    assert a == solve_checkpoint_name(
+        ["f.yaml"], "maxsum", "engine",
+        ["damping:0.5", "precision:bf16", "layout:lane_major"], 0,
+        "bf16")
+    assert a != solve_checkpoint_name(["f.yaml"], "maxsum", "engine",
+                                      ["damping:0.5"], 1, None)
+    assert a != solve_checkpoint_name(["g.yaml"], "maxsum", "engine",
+                                      ["damping:0.5"], 0, None)
+
+
+# ------------------------------------------------- engine (SyncEngine)
+
+
+def test_engine_kill_resume_bit_exact(tmp_path):
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = _coloring()
+    full = solve_result(dcop, "maxsum", max_cycles=160, seed=0,
+                        timeout=None)
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "j", every=16, fingerprint=_fp(),
+                           preempt_after=2)
+    with pytest.raises(Preempted):
+        solve_result(dcop, "maxsum", max_cycles=160, seed=0,
+                     timeout=None, checkpointer=ck)
+    ck2 = SolveCheckpointer(store, "j", every=16, fingerprint=_fp())
+    res = solve_result(dcop, "maxsum", max_cycles=160, seed=0,
+                       timeout=None, checkpointer=ck2, resume=True)
+    assert ck2.resumed_from_cycle and ck2.resumed_from_cycle > 0
+    assert res.cycles == full.cycles
+    assert res.assignment == full.assignment
+    assert res.metrics["checkpoint"]["resumed_from_cycle"] == \
+        ck2.resumed_from_cycle
+
+
+def test_engine_resume_of_finished_run_is_identity(tmp_path):
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    dcop = _coloring()
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "j", every=32, fingerprint=_fp())
+    done = solve_result(dcop, "maxsum", max_cycles=96, seed=0,
+                        timeout=None, checkpointer=ck)
+    ck2 = SolveCheckpointer(store, "j", every=32, fingerprint=_fp())
+    again = solve_result(dcop, "maxsum", max_cycles=96, seed=0,
+                         timeout=None, checkpointer=ck2, resume=True)
+    assert again.cycles == done.cycles
+    assert again.assignment == done.assignment
+
+
+def test_solve_direct_rejects_checkpoint(tmp_path):
+    from pydcop_tpu.infrastructure.run import solve_result
+
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "j", fingerprint=_fp())
+    with pytest.raises(ValueError, match="--checkpoint|chunk"):
+        solve_result(_coloring(12), "dpop", checkpointer=ck)
+
+
+# --------------------------------------------------------- sharded
+
+
+def test_sharded_kill_resume_bit_exact_and_no_extra_syncs(tmp_path):
+    from pydcop_tpu.parallel import solve_sharded_result
+
+    dcop = _coloring()
+    full = solve_sharded_result(dcop, "maxsum", n_cycles=96, seed=0)
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "s", every=32, fingerprint=_fp(),
+                           preempt_after=1)
+    with pytest.raises(Preempted):
+        solve_sharded_result(dcop, "maxsum", n_cycles=96, seed=0,
+                             checkpointer=ck)
+    ck2 = SolveCheckpointer(store, "s", every=32, fingerprint=_fp())
+    res = solve_sharded_result(dcop, "maxsum", n_cycles=96, seed=0,
+                               checkpointer=ck2, resume=True)
+    assert ck2.resumed_from_cycle == 32
+    assert res.cycles == full.cycles
+    assert res.assignment == full.assignment
+    # checkpointing ON pays the identical dispatch/host-sync counts:
+    # snapshots ride boundaries the loop already synced at
+    ck3 = SolveCheckpointer(store, "s2", every=32,
+                            fingerprint=_fp())
+    on = solve_sharded_result(dcop, "maxsum", n_cycles=96, seed=0,
+                              checkpointer=ck3)
+    assert on.metrics["host_syncs"] == full.metrics["host_syncs"]
+    assert on.metrics["dispatches"] == full.metrics["dispatches"]
+    assert on.assignment == full.assignment
+    assert on.cycles == full.cycles
+
+
+def test_sharded_resume_mesh_mismatch_refuses(tmp_path):
+    from pydcop_tpu.parallel import solve_sharded_result
+
+    dcop = _coloring(24)
+    store = CheckpointStore(str(tmp_path))
+    ck = SolveCheckpointer(store, "s", every=32, fingerprint=_fp())
+    solve_sharded_result(dcop, "maxsum", n_cycles=64, seed=0,
+                         checkpointer=ck)
+    assert ck.fingerprint["mesh"]  # solve_sharded_result folded it in
+    bad = SolveCheckpointer(
+        store, "s", every=32,
+        fingerprint=dict(_fp(), mesh={"dp": 1, "tp": 1}))
+    with pytest.raises(CheckpointError) as e:
+        solve_sharded_result(dcop, "maxsum", n_cycles=64, seed=0,
+                             checkpointer=bad, resume=True)
+    assert "mesh" in e.value.details
+
+
+# --------------------------------------------------- fused campaign
+
+
+def _padded_factor_instances(seeds=(1, 2, 3, 4), n=20):
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, home_rung
+
+    arrays = [FactorGraphArrays.build(_coloring(n, seed=s),
+                                      arity_sorted=True)
+              for s in seeds]
+    rung = home_rung(ShapeProfile.of(arrays[0]))
+    return [rung.pad(a) for a in arrays]
+
+
+def test_batched_maxsum_kill_resume_bit_exact(tmp_path):
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    padded = _padded_factor_instances()
+    oracle = BatchedMaxSum(padded[0], instances=padded)
+    sel0, cyc0, fin0 = oracle.run(max_cycles=60, seeds=[0, 1, 2, 3])
+
+    store = CheckpointStore(str(tmp_path))
+    fp = _fp(layout="batched")
+    ck = SolveCheckpointer(store, "rung", every=8, fingerprint=fp,
+                           preempt_after=2)
+    r2 = BatchedMaxSum(padded[0], instances=padded)
+    with pytest.raises(Preempted):
+        r2.run(max_cycles=60, seeds=[0, 1, 2, 3], checkpointer=ck)
+    ck2 = SolveCheckpointer(store, "rung", every=8, fingerprint=fp)
+    r3 = BatchedMaxSum(padded[0], instances=padded)
+    sel1, cyc1, fin1 = r3.run(max_cycles=60, seeds=[0, 1, 2, 3],
+                              checkpointer=ck2, resume=True)
+    assert ck2.resumed_from_cycle == 16
+    assert np.array_equal(sel0, sel1)
+    assert np.array_equal(cyc0, cyc1)
+    assert np.array_equal(fin0, fin1)
+
+
+def test_batched_dsa_kill_resume_bit_exact(tmp_path):
+    from pydcop_tpu.dcop.dcop import filter_dcop
+    from pydcop_tpu.graphs.arrays import HypergraphArrays
+    from pydcop_tpu.parallel.batch import BatchedDsa
+    from pydcop_tpu.parallel.bucketing import ShapeProfile, home_rung
+
+    arrays = [HypergraphArrays.build(filter_dcop(_coloring(20, s)))
+              for s in (1, 2, 3, 4)]
+    rung = home_rung(ShapeProfile.of(arrays[0]))
+    padded = [rung.pad(a) for a in arrays]
+    oracle = BatchedDsa(padded[0], instances=padded)
+    sel0, cyc0, _ = oracle.run(max_cycles=40, seeds=[0, 1, 2, 3])
+    store = CheckpointStore(str(tmp_path))
+    fp = _fp(algo="dsa", layout="batched")
+    ck = SolveCheckpointer(store, "rung", every=8, fingerprint=fp,
+                           preempt_after=1)
+    r2 = BatchedDsa(padded[0], instances=padded)
+    with pytest.raises(Preempted):
+        r2.run(max_cycles=40, seeds=[0, 1, 2, 3], checkpointer=ck)
+    ck2 = SolveCheckpointer(store, "rung", every=8, fingerprint=fp)
+    r3 = BatchedDsa(padded[0], instances=padded)
+    sel1, cyc1, _ = r3.run(max_cycles=40, seeds=[0, 1, 2, 3],
+                           checkpointer=ck2, resume=True)
+    assert np.array_equal(sel0, sel1)
+    assert np.array_equal(cyc0, cyc1)
+
+
+def test_batched_checkpoint_off_builds_no_ckpt_programs():
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    padded = _padded_factor_instances(seeds=(1, 2))
+    runner = BatchedMaxSum(padded[0], instances=padded)
+    runner.run(max_cycles=20, seeds=[0, 1])
+    # the chunked checkpoint programs exist ONLY when a checkpointer
+    # is attached: off = the historical program set, byte-identical
+    assert "ckpt" not in runner._jitted
+    with pytest.raises(ValueError, match="telemetry"):
+        runner.run(max_cycles=20, seeds=[0, 1],
+                   collect_metrics=True,
+                   checkpointer=SolveCheckpointer(
+                       CheckpointStore("/tmp"), "x",
+                       fingerprint=_fp()))
+
+
+# ----------------------------------------------------- warm session
+
+
+def test_session_base_snapshot_restore_plus_journal_tail(tmp_path):
+    from pydcop_tpu.dcop.yamldcop import (dcop_yaml,
+                                          load_dcop_from_file)
+    from pydcop_tpu.dynamics.journal import JournalStore
+    from pydcop_tpu.engine._cache import ExecutableCache
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+
+    inst = tmp_path / "i.yaml"
+    inst.write_text(dcop_yaml(_coloring(14, seed=2)))
+    factors = sorted(load_dcop_from_file(str(inst)).constraints)
+    base_req = {"id": "j0", "dcop": str(inst), "algo": "maxsum",
+                "max_cycles": 12, "seed": 0}
+
+    def dreq(i):
+        return {"id": f"d{i}", "op": "delta", "target": "j0",
+                "actions": [{"type": "change_costs",
+                             "name": factors[i % len(factors)],
+                             "costs": [[i, 1, 2], [2, 0, 1],
+                                       [1, 2, 0]]}]}
+
+    cache = ExecutableCache(path=str(tmp_path / "exec"))
+    # uninterrupted oracle
+    disp_a = Dispatcher(exec_cache=cache)
+    for i in range(2):
+        disp_a.dispatch_delta(dreq(i), base_req,
+                              default_max_cycles=12)
+    oracle = disp_a.dispatch_delta(dreq(2), base_req,
+                                   default_max_cycles=12)
+
+    # crashed daemon: answered d0/d1, then the process died (no
+    # clean close — journal and base snapshot survive on disk)
+    store = CheckpointStore(str(tmp_path / "ck"))
+    journal = JournalStore(str(tmp_path / "jr"))
+    disp_b = Dispatcher(exec_cache=cache, journal=journal,
+                        checkpoints=store)
+    disp_b.dispatch_delta(dreq(0), base_req, default_max_cycles=12)
+    disp_b.dispatch_delta(dreq(1), base_req, default_max_cycles=12)
+    assert disp_b.delta_sessions.stats["checkpoint_saved"] == 1
+
+    # restarted daemon: recovery restores the base snapshot (no base
+    # re-solve) and replays the journal tail — bit-exact next answer
+    disp_c = Dispatcher(exec_cache=cache, journal=journal,
+                        checkpoints=store)
+    rec = disp_c.dispatch_delta(dreq(2), None, default_max_cycles=12)
+    assert disp_c.delta_sessions.stats["checkpoint_restored"] == 1
+    assert disp_c.delta_sessions.stats["journal_replays"] == 1
+    assert rec["assignment"] == oracle["assignment"]
+    assert rec["cycle"] == oracle["cycle"]
+
+
+def test_session_clean_close_deletes_snapshot(tmp_path):
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.dynamics.journal import JournalStore
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+
+    inst = tmp_path / "i.yaml"
+    inst.write_text(dcop_yaml(_coloring(12, seed=2)))
+    base_req = {"id": "j0", "dcop": str(inst), "algo": "maxsum",
+                "max_cycles": 10, "seed": 0}
+    store = CheckpointStore(str(tmp_path / "ck"))
+    journal = JournalStore(str(tmp_path / "jr"))
+    disp = Dispatcher(journal=journal, checkpoints=store)
+    disp.dispatch_delta(
+        {"id": "d0", "op": "delta", "target": "j0", "actions": []},
+        base_req, default_max_cycles=10)
+    name = disp.delta_sessions._ckpt_name("j0")
+    assert store.exists(name)
+    # clean close truncates journal AND deletes the base snapshot
+    disp.delta_sessions.close_all()
+    assert not store.exists(name)
+    assert not journal.journaled("j0")
+    # preemption variant preserves both
+    disp2 = Dispatcher(journal=journal, checkpoints=store)
+    disp2.dispatch_delta(
+        {"id": "d1", "op": "delta", "target": "j0", "actions": []},
+        base_req, default_max_cycles=10)
+    disp2.delta_sessions.close_all(preserve=True)
+    assert store.exists(name)
+    assert journal.journaled("j0")
+
+
+# ------------------------------------------------ serve preempt drain
+
+
+def _serve_lines(tmp_path, n=6):
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    inst = tmp_path / "i.yaml"
+    inst.write_text(dcop_yaml(_coloring(14, seed=2)))
+    return [json.dumps({"id": f"j{i}", "dcop": str(inst),
+                        "algo": "maxsum", "max_cycles": 8,
+                        "seed": i})
+            for i in range(n)]
+
+
+def test_preempt_fault_point_requeues_then_restart_completes(
+        tmp_path):
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records)
+    from pydcop_tpu.serving.daemon import (ServeLoop, requeue_take)
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.faults import FaultPlan
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    lines = _serve_lines(tmp_path)
+    store = CheckpointStore(str(tmp_path / "ck"))
+    plan = FaultPlan(schedule=[{"point": "preempt",
+                                "dispatch_index": 0}])
+    out = tmp_path / "out.jsonl"
+    rep = RunReporter(str(out), algo="serve", mode="serve")
+    loop = ServeLoop(AdmissionQueue(max_batch=8, max_delay_s=10.0),
+                     Dispatcher(reporter=rep), reporter=rep,
+                     default_max_cycles=8, faults=plan,
+                     checkpoints=store)
+    stats = loop.run_oneshot(lines)
+    rep.close()
+    assert stats["requeued"] == len(lines)
+    assert stats["completed"] == 0
+    assert stats["rejected"] == 0      # requeued, NOT rejected
+    events = [r.get("event") for r in read_records(str(out))
+              if r.get("record") == "serve"]
+    assert "preempt_drain" in events
+    fault = [r for r in read_records(str(out))
+             if r.get("record") == "serve"
+             and r.get("event") == "fault"]
+    assert fault and fault[0]["action"] == "preempt"
+    # the requeue file is atomic jsonl, consumed exactly once
+    requeued = requeue_take(str(tmp_path / "ck"))
+    assert len(requeued) == len(lines)
+    assert requeue_take(str(tmp_path / "ck")) == []
+    out2 = tmp_path / "out2.jsonl"
+    rep2 = RunReporter(str(out2), algo="serve", mode="serve")
+    loop2 = ServeLoop(
+        AdmissionQueue(max_batch=8, max_delay_s=0.01),
+        Dispatcher(reporter=rep2), reporter=rep2,
+        default_max_cycles=8, checkpoints=store)
+    stats2 = loop2.run_oneshot(requeued)
+    rep2.close()
+    assert stats2["completed"] == len(lines)
+
+
+def test_sigterm_without_checkpoint_keeps_reject_contract(tmp_path):
+    from pydcop_tpu.serving.daemon import ServeLoop
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.faults import FaultPlan
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    lines = _serve_lines(tmp_path, n=3)
+    plan = FaultPlan(schedule=[{"point": "preempt",
+                                "dispatch_index": 0}])
+    loop = ServeLoop(AdmissionQueue(max_batch=8, max_delay_s=10.0),
+                     Dispatcher(), default_max_cycles=8,
+                     faults=plan)
+    stats = loop.run_oneshot(lines)
+    # no checkpoint store: historical contract, structured rejections
+    assert stats["rejected"] == 3
+    assert stats.get("requeued", 0) == 0
+
+
+def test_serve_status_renders_checkpoint_counters():
+    from pydcop_tpu.commands.serve_status import render_status
+
+    snap = {"record": "serve", "event": "stats", "uptime_s": 1.0,
+            "queue_depth": 0,
+            "stats": {"received": 4, "admitted": 4, "completed": 2,
+                      "rejected": 0, "requeued": 2},
+            "checkpoints": {"saved": 3, "restored": 1, "corrupt": 1,
+                            "missing": 0, "deleted": 0,
+                            "bytes_written": 999},
+            "sessions": {"checkpoint_saved": 1,
+                         "checkpoint_restored": 1, "hits": 0,
+                         "misses": 0},
+            "memory": {}}
+    text = render_status(snap)
+    assert "written 3" in text
+    assert "restored 1" in text
+    assert "corrupt-quarantined 1" in text
+    assert "requeued-on-preempt 2" in text
+
+
+# --------------------------------------------------- batch progress
+
+
+def test_batch_progress_atomic_and_torn_tail_tolerant(tmp_path):
+    from pydcop_tpu.commands.batch import (read_progress,
+                                           register_progress)
+
+    path = str(tmp_path / "batch_progress.txt")
+    register_progress(path, "job_a")
+    register_progress(path, "job_b")
+    assert read_progress(path) == {"job_a", "job_b"}
+    # merge-rewrite folds entries another process registered
+    with open(path, "a") as f:
+        f.write("job_external\n")
+    register_progress(path, "job_c")
+    assert read_progress(path) == {"job_a", "job_b", "job_c",
+                                   "job_external"}
+    # a torn legacy tail re-runs that one job, nothing else
+    with open(path, "a") as f:
+        f.write("job_tor")  # no newline: torn mid-append
+    done = read_progress(path)
+    assert "job_a" in done and "job_tor" in done
+    # no temp litter
+    assert not [n for n in os.listdir(tmp_path)
+                if n.endswith(".tmp")]
+
+
+# ------------------------------------------------------- schema v1.6
+
+
+def test_schema_minor_6_fields_validate():
+    from pydcop_tpu.observability.report import (SCHEMA_MINOR,
+                                                 validate_record)
+
+    assert SCHEMA_MINOR == 6
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "mode": "engine", "status": "FINISHED",
+                     "checkpoint_s": 0.01, "checkpoint_bytes": 1024,
+                     "resumed_from_cycle": 64})
+    validate_record({"record": "serve", "algo": "serve",
+                     "mode": "serve", "event": "preempt_drain",
+                     "requeued": 3, "requeue_total": 3})
+    validate_record({"record": "serve", "algo": "serve",
+                     "mode": "serve", "event": "fault",
+                     "action": "preempt"})
+    for bad in ({"checkpoint_s": -1}, {"checkpoint_bytes": -5},
+                {"resumed_from_cycle": True},
+                {"checkpoint_bytes": 1.5}):
+        with pytest.raises(ValueError):
+            validate_record({"record": "summary", "algo": "a",
+                             "mode": "m", "status": "OK", **bad})
+
+
+def test_frozen_minor_5_and_earlier_readers_stay_green():
+    """A v1.x reader filtering by the fields it speaks must ingest
+    minor-6 files; minor <=5 records must validate unchanged."""
+    from pydcop_tpu.observability.report import validate_record
+
+    # a frozen minor-5 record set (no minor-6 fields)
+    validate_record({"record": "header", "schema": 1,
+                     "schema_minor": 5, "algo": "maxsum",
+                     "mode": "engine"})
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "mode": "serve", "status": "FINISHED",
+                     "layout": "fused", "cycles_run": 9,
+                     "chunks_run": 2, "settle_chunk": 1})
+    # a frozen v1.0-style reader: filters to the keys it knows and
+    # must find them untouched in a minor-6 summary
+    minor6 = {"record": "summary", "algo": "maxsum",
+              "mode": "engine", "status": "FINISHED", "cost": 4.0,
+              "checkpoint_s": 0.1, "checkpoint_bytes": 10,
+              "resumed_from_cycle": 3}
+    validate_record(minor6)
+    v10_view = {k: minor6[k] for k in ("record", "algo", "mode",
+                                       "status", "cost")}
+    validate_record(v10_view)
+
+
+def test_telemetry_validate_cli_accepts_minor_6(tmp_path):
+    from pydcop_tpu.commands.telemetry_validate import validate_file
+    from pydcop_tpu.observability.report import RunReporter
+
+    out = tmp_path / "t.jsonl"
+    rep = RunReporter(str(out), algo="maxsum", mode="engine")
+    rep.header(dcop="x")
+    rep.summary(status="FINISHED", cost=1.0, checkpoint_s=0.2,
+                checkpoint_bytes=2048, resumed_from_cycle=32)
+    rep.close()
+    counts, minor = validate_file(str(out))
+    assert minor == 6
+    assert counts == {"header": 1, "summary": 1}

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_5_and_v1_readers_stay_green():
+def test_schema_minor_is_6_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 5
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 6
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4, 5):
+    for minor in (1, 2, 3, 4, 5, 6):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -485,6 +485,21 @@ def test_schema_minor_is_5_and_v1_readers_stay_green():
     with pytest.raises(ValueError, match="cycles_run"):
         validate_record({"record": "serve", "algo": "s",
                          "event": "dispatch", "cycles_run": "many"})
+    # minor-6 additive fields (preemption-safe solves): the
+    # checkpoint telemetry and the preempt drain validate; malformed
+    # ones reject (tests/test_checkpoint.py covers the full matrix)
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "checkpoint_s": 0.02,
+                     "checkpoint_bytes": 4096,
+                     "resumed_from_cycle": 64})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "preempt_drain", "requeued": 4,
+                     "requeue_total": 4})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "fault", "action": "preempt"})
+    with pytest.raises(ValueError, match="checkpoint_bytes"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "checkpoint_bytes": -1})
 
 
 # ----------------------------------------- reporter lifecycle (ops)
