@@ -2,7 +2,7 @@
 # cli + api tiers).  Tests force the CPU backend with a virtual
 # 8-device mesh (tests/conftest.py).
 
-.PHONY: test test-fast bench suite lint typecheck chaos
+.PHONY: test test-fast bench suite lint typecheck chaos bench-roi
 
 test:
 	python -m pytest tests/ -q
@@ -17,6 +17,14 @@ test-fast:
 chaos:
 	python -m pytest tests/ -q -m "chaos or ckpt"
 	python benchmarks/suite.py bench_chaos --quick
+
+# the O(region) tier: the roi test marker plus the bench_roi ladder —
+# perturbation sizes x graph sizes, asserting warm ms/event scales
+# with the touched region (not |V|) and settled-region selections
+# stay bit-identical to the full-sweep oracle
+bench-roi:
+	python -m pytest tests/ -q -m "roi"
+	python benchmarks/suite.py bench_roi --quick
 
 bench:
 	python bench.py

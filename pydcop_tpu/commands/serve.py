@@ -156,6 +156,26 @@ def set_parser(subparsers):
                              "fixed keeps constant chunk_size "
                              "chunks.  Identical selections and "
                              "cycles either way")
+    parser.add_argument("--roi", action="store_true",
+                        help="region-of-interest warm re-solves for "
+                             "delta sessions: each delta's solve "
+                             "sweeps only the activity window seeded "
+                             "from the touched rows, grown one "
+                             "neighborhood hop at chunk boundaries "
+                             "while boundary residuals stay hot — "
+                             "delta cost scales with the "
+                             "perturbation, not instance size.  "
+                             "Dispatch records carry "
+                             "active_fraction / frontier_expansions "
+                             "(also Prometheus gauges, see "
+                             "serve-status)")
+    parser.add_argument("--roi-residual-threshold",
+                        dest="roi_residual_threshold", type=float,
+                        default=None, metavar="EPS",
+                        help="--roi frontier gate: grow the active "
+                             "region while chunk-boundary residuals "
+                             "are >= EPS (default: the solver's "
+                             "damping-scaled stability threshold)")
     parser.add_argument("--exec-cache", dest="exec_cache",
                         type=str, default=None, metavar="DIR",
                         help="directory for serialized jax.stages rung "
@@ -369,7 +389,10 @@ def run_cmd(args, timeout=None):
             journal=journal,
             session_layout=getattr(args, "layout", "edge_major"),
             warm_budget=getattr(args, "warm_budget", "adaptive"),
-            checkpoints=checkpoints)
+            checkpoints=checkpoints,
+            session_roi=getattr(args, "roi", False),
+            roi_residual_threshold=getattr(
+                args, "roi_residual_threshold", None))
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,

@@ -158,7 +158,24 @@ def render_status(snap: dict) -> str:
         for rung, b in sorted(
                 (memory.get("runner_cache_by_rung") or {}).items()):
             lines.append(f"      {rung:<22} {human_bytes(b)}")
-    hists = (snap.get("metrics") or {}).get("histograms", {})
+    metrics = snap.get("metrics") or {}
+    roi_af = (metrics.get("gauges") or {}).get(
+        "pydcop_roi_active_fraction", {})
+    roi_fx = (metrics.get("counters") or {}).get(
+        "pydcop_roi_frontier_expansions_total", {})
+    if roi_af or roi_fx:
+        # region-of-interest warm-solve telemetry (serve --roi):
+        # per-target last-dispatch active fraction + total frontier
+        # hops the residual gate granted
+        lines.append("  roi (active fraction | frontier expansions):")
+        for target in sorted(set(roi_af) | set(roi_fx)):
+            af = roi_af.get(target)
+            fx = roi_fx.get(target, 0)
+            lines.append(
+                f"    {target or '<all>':<24} "
+                f"{'n/a' if af is None else f'{af:.4f}'} | "
+                f"{int(fx)}")
+    hists = metrics.get("histograms", {})
     stage = hists.get("pydcop_serve_stage_seconds", {})
     if stage:
         lines.append("  stage latency (p50 / p99, s):")

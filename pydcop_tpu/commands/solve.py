@@ -171,6 +171,31 @@ def set_parser(subparsers):
                              "constraint add/remove); lane_major is "
                              "the TPU-tile layout and speaks every "
                              "event type")
+    parser.add_argument("--roi", action="store_true",
+                        help="--scenario region-of-interest warm "
+                             "re-solves: each event's solve sweeps "
+                             "only an activity window seeded from "
+                             "the delta's touched rows and grown "
+                             "one neighborhood hop at chunk "
+                             "boundaries while boundary residuals "
+                             "stay hot — event cost scales with the "
+                             "perturbation, not |V|.  Rows outside "
+                             "the region keep the carried fixed "
+                             "point bit-exactly.  Needs mode "
+                             "engine, carry messages; telemetry "
+                             "records carry active_fraction / "
+                             "frontier_expansions")
+    parser.add_argument("--roi-residual-threshold",
+                        dest="roi_residual_threshold", type=float,
+                        default=None, metavar="EPS",
+                        help="--roi frontier gate: expand the "
+                             "active region while chunk-boundary "
+                             "residuals are >= EPS (default: the "
+                             "solver's own damping-scaled stability "
+                             "threshold).  Lower = chase smaller "
+                             "ripples further (closer to the full "
+                             "sweep); higher = tighter regions, "
+                             "faster events")
     parser.add_argument("--carry", default="messages",
                         choices=["messages", "reset"],
                         help="--scenario warm-state policy: "
@@ -647,7 +672,10 @@ def _run_scenario(args, dcop, t0: float, timeout,
             params=params, max_cycles=args.max_cycles,
             carry=getattr(args, "carry", "messages"),
             layout=layout,
-            warm_budget=getattr(args, "warm_budget", "adaptive"))
+            warm_budget=getattr(args, "warm_budget", "adaptive"),
+            roi=getattr(args, "roi", False),
+            roi_residual_threshold=getattr(
+                args, "roi_residual_threshold", None))
     except ValueError as e:
         raise CliError(str(e))
 
@@ -695,6 +723,7 @@ def _run_scenario(args, dcop, t0: float, timeout,
             "carry": engine.carry,
             "layout": engine.layout,
             "warm_budget": engine.warm_budget,
+            "roi": engine.roi,
             "reserve": getattr(args, "reserve_slots", None),
             "budget": replay["budget"],
             "initial": _scenario_event_summary(replay["initial"]),
@@ -720,7 +749,9 @@ def _scenario_event_summary(e: dict) -> dict:
     result carries the final one."""
     out = {k: e[k] for k in ("status", "cost", "violation", "cycle",
                              "warm_start", "spans", "upload_bytes",
-                             "chunks_run", "settle_chunk")
+                             "chunks_run", "settle_chunk",
+                             "active_fraction",
+                             "frontier_expansions")
            if k in e}
     for k in ("event", "edit"):
         if e.get(k) is not None:

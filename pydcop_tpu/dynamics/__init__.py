@@ -15,11 +15,12 @@ from .engine import DynamicEngine, eval_cost_violations_np
 from .journal import JournalError, JournalStore, SessionJournal
 from .replay import replay_batched, replay_scenario, \
     scenario_descendants
+from .roi import roi_seed_filter
 
 __all__ = [
     "DeltaError", "DynamicEngine", "DynamicInstance",
     "JournalError", "JournalStore", "SessionJournal",
     "TopologyDelta", "build_dynamic_instance",
     "eval_cost_violations_np", "replay_batched", "replay_scenario",
-    "scenario_descendants",
+    "roi_seed_filter", "scenario_descendants",
 ]

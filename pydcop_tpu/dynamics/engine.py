@@ -148,7 +148,9 @@ class DynamicEngine:
                  carry: str = "messages",
                  resident: bool = True,
                  layout: str = "edge_major",
-                 warm_budget: str = "adaptive"):
+                 warm_budget: str = "adaptive",
+                 roi: bool = False,
+                 roi_residual_threshold: Optional[float] = None):
         if layout not in ("edge_major", "lane_major", "fused",
                           "auto"):
             raise ValueError(
@@ -185,7 +187,8 @@ class DynamicEngine:
         # fragment the program/cache identity (a per-job seed in the
         # exec-cache key would defeat warm restarts) — stripped HERE,
         # the one authority, so callers never need their own copy
-        for engine_only in ("stop_cycle", "seed", "layout"):
+        for engine_only in ("stop_cycle", "seed", "layout", "roi",
+                            "roi_residual_threshold"):
             params.pop(engine_only, None)
         _check_params(params)
         self.algo = algo
@@ -258,6 +261,53 @@ class DynamicEngine:
             self._edge_map = self._build_edge_map()
         self._key = tuple(sorted(
             (k, str(v)) for k, v in params.items()))
+        # ---- region-of-interest warm solves (ISSUE 16) ----
+        self.roi = bool(roi)
+        if roi_residual_threshold is not None:
+            roi_residual_threshold = float(roi_residual_threshold)
+            if roi_residual_threshold <= 0:
+                raise ValueError(
+                    "roi_residual_threshold must be > 0 (it gates "
+                    "the frontier expansion against the boundary "
+                    "residuals)")
+        self.roi_residual_threshold = roi_residual_threshold
+        if self.roi:
+            if mode != "engine":
+                raise ValueError(
+                    "roi=True needs mode='engine': the windowed "
+                    "chunk gathers from the single-chip message "
+                    "planes (sharded carries are mesh-partitioned)")
+            if self.carry != "messages":
+                raise ValueError(
+                    "roi=True needs carry='messages': the activity "
+                    "plane is only sound over a carried fixed point "
+                    "(carry='reset' restarts every row anyway)")
+            bad = [(bi, b.arity)
+                   for bi, b in enumerate(self.instance.arrays.buckets)
+                   if b.arity > 2 and b.cubes.shape[0]]
+            if bad:
+                raise ValueError(
+                    f"roi=True covers arity <= 2 factor buckets; "
+                    f"this instance reserves higher-arity slots "
+                    f"{bad} (bucket, arity) — solve them with "
+                    f"roi=False")
+        # per-session ROI state: pending activity seed (accumulated
+        # over applies since the last solve), dirty rows/slots for the
+        # incremental evaluator, host adjacency, cached decode state
+        self._roi_adj = None
+        self._roi_eval = None
+        self._roi_seed = set()
+        self._roi_dirty_rows = set()
+        self._roi_dirty_facs: Dict[int, set] = {}
+        self._roi_assign = None
+        self._roi_row_name = None
+        self._roi_registry_stale = False
+        self._roi_last_sel = None
+        self._roi_last_status = None
+        self._roi_last_active = None
+        self._roi_ever_active = None
+        self._roi_live_cache = None
+        self._roi_expansions_total = 0
 
     # ----------------------------------------------------------- info
 
@@ -316,19 +366,46 @@ class DynamicEngine:
             from .deltas import DeltaError
 
             # compile_event is pure, so the instance is untouched:
-            # the rejection is transactional like every DeltaError
+            # the rejection is transactional like every DeltaError.
+            # Name the offending entries, not just the counts: the
+            # event kinds that re-point edges, the canonical edge
+            # rows they re-point, and the variable rows whose degree
+            # would change (pre-apply owners + touched rows)
+            kinds = [k for k in ("add_constraint", "remove_constraint")
+                     if delta.summary.get(k)]
+            edge_rows = [int(e) for e in np.asarray(
+                delta.edge_ids if delta.edge_ids is not None else [])]
+            owners = np.asarray(self.instance.arrays.edge_var)[
+                np.asarray(delta.touched_edges, dtype=np.int64)] \
+                if delta.touched_edges is not None \
+                and len(delta.touched_edges) else np.zeros(0, int)
+            var_rows = sorted({int(v) for v in delta.touched_vars}
+                              | {int(v) for v in owners})
             raise DeltaError(
-                "the fused layout bakes the variable-degree slot "
-                "structure into the compiled program; constraint "
-                "add/remove events need layout='lane_major' (or "
-                "'edge_major') — fused warm sessions absorb "
-                "change_costs and variable add/remove only",
-                kind="layout", layout="fused",
+                f"the fused layout bakes the variable-degree slot "
+                f"structure into the compiled program; "
+                f"{'/'.join(kinds)} event(s) re-point edge rows "
+                f"{edge_rows} (variable rows {var_rows}) and need "
+                f"layout='lane_major' (or 'edge_major') — fused warm "
+                f"sessions absorb change_costs and variable "
+                f"add/remove only",
+                kind="layout", layout="fused", event_kinds=kinds,
+                edge_rows=edge_rows, var_rows=var_rows,
                 add_constraint=int(
                     delta.summary.get("add_constraint", 0)),
                 remove_constraint=int(
                     delta.summary.get("remove_constraint", 0)))
+        pre_owner = None
+        if self.roi and delta.touched_edges is not None \
+                and len(delta.touched_edges):
+            # edge owners BEFORE the apply: a removed constraint's
+            # edges re-point to the sink, but the variables losing it
+            # must enter the activity seed
+            pre_owner = np.asarray(self.instance.arrays.edge_var)[
+                np.asarray(delta.touched_edges, dtype=np.int64)]
         self.instance.apply(delta)
+        if self.roi:
+            self._roi_note_delta(delta, pre_owner)
         self.last_edit = dict(delta.summary)
         if self.mode == "sharded":
             # the solver's host mirrors (partitioned cubes, edge
@@ -533,9 +610,29 @@ class DynamicEngine:
                 "state (solve first)")
         from ..robustness.checkpoint import tree_to_host
 
-        return {"state": tree_to_host(self._state),
+        snap = {"state": tree_to_host(self._state),
                 "solves": int(self.solves),
-                "layout": self.layout, "carry": self.carry}
+                "layout": self.layout, "carry": self.carry,
+                "roi": bool(self.roi)}
+        if self.roi:
+            # the activity plane + frontier state (ISSUE 16): enough
+            # for a restored session to resume the windowed path
+            # bit-exactly — pending seed/dirt from applies since the
+            # last solve, the last solve's verdict (the windowed
+            # path's eligibility), and the frontier counters
+            snap["roi_state"] = {
+                "seed": sorted(self._roi_seed),
+                "dirty_rows": sorted(self._roi_dirty_rows),
+                "dirty_facs": {
+                    int(bi): sorted(s)
+                    for bi, s in self._roi_dirty_facs.items()},
+                "last_status": self._roi_last_status,
+                "expansions_total": int(self._roi_expansions_total),
+                "active": (
+                    np.flatnonzero(self._roi_last_active).tolist()
+                    if self._roi_last_active is not None else None),
+            }
+        return snap
 
     def restore_state(self, snapshot: Dict[str, Any]):
         """Adopt a :meth:`state_snapshot` taken by a previous process
@@ -555,6 +652,9 @@ class DynamicEngine:
             k: (snapshot.get(k), getattr(self, k))
             for k in ("layout", "carry")
             if snapshot.get(k) != getattr(self, k)}
+        if bool(snapshot.get("roi", False)) != self.roi:
+            mismatched["roi"] = (bool(snapshot.get("roi", False)),
+                                 self.roi)
         if mismatched:
             diff = ", ".join(f"{k}: saved={s!r} current={c!r}"
                              for k, (s, c) in sorted(
@@ -568,6 +668,32 @@ class DynamicEngine:
         # the argument planes re-materialize from the (base) host
         # planes on the next solve; resident scatters then edit them
         self._args_dev = None
+        if self.roi:
+            rs = snapshot.get("roi_state") or {}
+            self._roi_seed = set(int(v) for v in rs.get("seed", []))
+            self._roi_dirty_rows = set(
+                int(v) for v in rs.get("dirty_rows", []))
+            self._roi_dirty_facs = {
+                int(bi): set(int(s) for s in slots)
+                for bi, slots in (rs.get("dirty_facs") or {}).items()}
+            self._roi_last_status = rs.get("last_status")
+            self._roi_expansions_total = int(
+                rs.get("expansions_total", 0))
+            act = rs.get("active")
+            if act is not None:
+                plane = np.zeros(self.instance.arrays.n_vars,
+                                 dtype=bool)
+                plane[np.asarray(act, dtype=np.int64)] = True
+                self._roi_last_active = plane
+            else:
+                self._roi_last_active = None
+            # decode/eval caches rebuild lazily from the restored
+            # state on the next windowed solve
+            self._roi_eval = None
+            self._roi_assign = None
+            self._roi_last_sel = None
+            self._roi_adj = None
+            self._roi_live_cache = None
 
     def close(self):
         """Release the engine's device residency: the carried message
@@ -875,6 +1001,41 @@ class DynamicEngine:
     def _solve_engine(self, budget: int, seed: int,
                       timeout: Optional[float],
                       warm: bool) -> Dict[str, Any]:
+        if not self.roi:
+            return self._solve_engine_full(budget, seed, timeout,
+                                           warm)
+        # ROI dispatch: a warm solve over a settled carry runs the
+        # windowed program over the activity region; anything else
+        # (cold start, a previous solve that never FINISHED — the
+        # carry is not a fixed point, so the region premise fails)
+        # falls back to the full sweep, honestly labeled
+        # active_fraction=1.0
+        windowed = (warm and self._state is not None
+                    and self._roi_last_status == "FINISHED")
+        if windowed and self._roi_last_sel is None:
+            # restored session: rebuild the host caches from the
+            # carried state once (O(V), per restore — the selections
+            # ARE the crashed session's, so replay stays bit-exact)
+            self._roi_rebuild_from_state()
+        if windowed:
+            seed_rows = self._roi_pending_seed_rows()
+            if not seed_rows.size:
+                out = self._roi_short_circuit()
+            else:
+                out = self._roi_windowed_solve(seed_rows, budget,
+                                               timeout)
+        else:
+            out = self._solve_engine_full(budget, seed, timeout,
+                                          warm)
+            out["active_fraction"] = 1.0
+            out["frontier_expansions"] = 0
+            self._roi_ever_active = None
+        self._roi_last_status = out["status"]
+        return out
+
+    def _solve_engine_full(self, budget: int, seed: int,
+                           timeout: Optional[float],
+                           warm: bool) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ..observability.spans import SpanClock
@@ -922,6 +1083,484 @@ class DynamicEngine:
         out["chunks_run"] = chunks_run
         out["settle_chunk"] = settle_chunk
         return out
+
+    # --------------------------------------- region-of-interest solves
+
+    def _roi_note_delta(self, delta: TopologyDelta,
+                        pre_owner: Optional[np.ndarray]):
+        """Accumulate one applied delta into the pending ROI state:
+        the activity seed, the incremental evaluator's dirty rows and
+        factor slots, and (for degree-changing edits) the adjacency
+        invalidation."""
+        from .roi import roi_seed_rows
+
+        for v in roi_seed_rows(delta, pre_owner):
+            self._roi_seed.add(int(v))
+        for r in np.asarray(delta.var_rows, dtype=np.int64):
+            self._roi_dirty_rows.add(int(r))
+        for bi, slots in enumerate(delta.bucket_slots):
+            if slots is not None and len(slots):
+                self._roi_dirty_facs.setdefault(bi, set()).update(
+                    int(s) for s in np.asarray(slots))
+        if delta.degree_changing:
+            self._roi_adj = None
+        if delta.summary.get("add_variable") \
+                or delta.summary.get("remove_variable"):
+            self._roi_registry_stale = True
+            self._roi_live_cache = None
+
+    def _roi_threshold(self) -> float:
+        """The frontier-expansion residual gate; defaults to the base
+        solver's (damping-scaled) stability threshold, so by default a
+        region stays active exactly while its residuals could still
+        block convergence."""
+        if self.roi_residual_threshold is not None:
+            return float(self.roi_residual_threshold)
+        return float(self._base.stability)
+
+    def _roi_adjacency(self):
+        if self._roi_adj is None:
+            from .roi import RoiAdjacency
+
+            self._roi_adj = RoiAdjacency(self.instance.arrays)
+        return self._roi_adj
+
+    def _roi_layout_maps(self):
+        """(edge coord map, selection coord map, edge-axis width,
+        lane orientation) — how canonical window coordinates land on
+        this layout's state planes."""
+        if self.layout == "fused":
+            nf = self._base._np_fused
+            return (nf["slot_of_edge"], nf["var_pos"],
+                    int(self._base.EP), True)
+        return (None, None, int(self.instance.arrays.n_edges),
+                self.layout == "lane_major")
+
+    def _roi_live_arrays(self):
+        """(live row ids, live boolean plane, live count), cached —
+        iterating the 100k-entry registry dict per event is exactly
+        the O(|V|) host floor ROI exists to remove.  Invalidated only
+        by registry-changing deltas (add/remove_variable)."""
+        if self._roi_live_cache is None:
+            rows = np.fromiter(self.instance.live_vars.values(),
+                               dtype=np.int64)
+            mask = np.zeros(self.instance.arrays.n_vars, dtype=bool)
+            mask[rows] = True
+            self._roi_live_cache = (rows, mask, max(1, rows.size))
+        return self._roi_live_cache
+
+    def _roi_pending_seed_rows(self) -> np.ndarray:
+        # mask-indexed fast path of roi_seed_filter(rows, live): the
+        # cached boolean live plane makes the per-event filter
+        # O(seed) instead of np.isin's O(|V| log |V|); semantics are
+        # identical (sorted unique live rows)
+        if not self._roi_seed:
+            return np.zeros(0, dtype=np.int64)
+        _live, mask, _n = self._roi_live_arrays()
+        rows = np.fromiter(self._roi_seed, dtype=np.int64)
+        rows = rows[(rows >= 0) & (rows < mask.size)]
+        return np.unique(rows[mask[rows]])
+
+    def _roi_window(self, active: np.ndarray, clock):
+        """Compile the current activity plane to window lists (host
+        numpy, counted as upload — the compiled call ships them)."""
+        from .roi import build_window
+        from .scatter import tree_nbytes
+
+        eix, six, width, _lane = self._roi_layout_maps()
+        av = np.flatnonzero(active)
+        w, n_v = build_window(self.instance.arrays,
+                              self._roi_adjacency(), av, eix, six,
+                              width, self._base.policy.store_dtype)
+        self._pending_upload += tree_nbytes(w)
+        return w, av, n_v
+
+    def _roi_chunk_fn(self):
+        """The windowed warm chunk: the exact Max-Sum update
+        (``MaxSumSolver.step`` operation order, both damping modes)
+        over the gathered window, while-looped to ``limit`` with a
+        per-window-variable residual riding the carry — the boundary
+        signal the frontier logic reads.  One compiled program per
+        (layout, window capacity signature); pow2 capacities bound
+        the ladder, and the program touches ONLY the state and the
+        window lists, so cost-plane edits never retrace it."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..algorithms.maxsum import SAME_COUNT
+        from ..ops.kernels import (roi_gather_edges, roi_scatter_edges,
+                                   roi_window_factors,
+                                   roi_window_variables)
+
+        base = self._base
+        lane = self.layout in ("lane_major", "fused")
+        damping = float(base.damping)
+        damp_f = base.damping_nodes in ("factors", "both")
+        damp_v = base.damping_nodes in ("vars", "both")
+        stability = float(base.stability)
+        big = float(BIG)
+
+        def run_roi(state, w, limit):
+            # the O(region) discipline: gather the referenced edge
+            # rows into a LOCAL plane once, iterate the Max-Sum
+            # update entirely in local coordinates (every index list
+            # in ``w`` is pre-mapped by build_window), scatter the
+            # local plane back once.  Keeping the full q/r planes in
+            # the while_loop carry would make XLA double-buffer them
+            # — an O(|V|) copy per CYCLE, the exact cost this path
+            # exists to remove.
+            loc = w["loc"]
+            lwidth = loc.shape[0]
+            # static split points, derivable from the argument shapes
+            # alone (same-shape windows share one compiled program):
+            # lq_ix = [e0 | e1 | wv_edges.ravel()],
+            # lr_ix = [e0 | e1 | wu_e]
+            nu = w["wu_row"].shape[0]
+            nf = (w["lr_ix"].shape[0] - nu) // 2
+            cv = w["wv_sel"].shape[0]
+            kk = (w["lq_ix"].shape[0] - 2 * nf) // cv
+            wv_ix = w["lq_ix"][2 * nf:]
+            in_range = (wv_ix < lwidth).reshape(cv, kk)
+
+            def body(carry):
+                # the local plane is row-major (capacity, D) whatever
+                # the full layout is — entry/exit own the lane
+                # transposition, so in-loop ops always run lane=False.
+                # Each plane is gathered/scattered ONCE per cycle over
+                # the combined index lists: XLA:CPU charges a fixed
+                # dispatch cost per gather/scatter op, which dominates
+                # small-window cycles if each role gets its own op
+                lq, lr, lsel, same, cycle, finished, _ = carry
+                qg = roi_gather_edges(lq, w["lq_ix"], False)
+                q0, q1 = qg[:nf], qg[nf:2 * nf]
+                q_old = qg[2 * nf:].reshape(cv, kk, -1)
+                rg = roi_gather_edges(lr, w["lr_ix"], False)
+                r0, r1, wu_old = rg[:nf], rg[nf:2 * nf], rg[2 * nf:]
+                m0, m1 = roi_window_factors(
+                    w["wf_cube"], q0, q1, r0, r1, damping, damp_f)
+                wu = w["wu_row"]
+                if damp_f and damping > 0:
+                    # unary edge slots are disjoint from every binary
+                    # slot, so reading them BEFORE the combined
+                    # scatter sees exactly what a read between the
+                    # m-scatters and the wu-scatter used to see
+                    wu = damping * wu_old + (1 - damping) * wu
+                lr = roi_scatter_edges(
+                    lr, w["lr_ix"], jnp.concatenate([m0, m1, wu]),
+                    False)
+                r_g = roi_gather_edges(lr, wv_ix, False) \
+                    .reshape(cv, kk, -1)
+                q_new, _belief, sel_w, resid = roi_window_variables(
+                    r_g, q_old, w["wv_costs"], w["wv_mask"],
+                    w["wv_dsize"], in_range, damping, damp_v, big)
+                lq = roi_scatter_edges(
+                    lq, wv_ix, q_new.reshape(cv * kk, -1), False)
+                stable = jnp.logical_and(
+                    jnp.all(sel_w == lsel),
+                    jnp.max(resid) < jnp.float32(stability))
+                same = jnp.where(stable, same + 1, jnp.int32(0))
+                return (lq, lr, sel_w, same, cycle + 1,
+                        same >= SAME_COUNT, resid)
+
+            def cond(carry):
+                _lq, _lr, _ls, _sm, cycle, finished, _ = carry
+                return jnp.logical_and(jnp.logical_not(finished),
+                                       cycle < limit)
+
+            init = (roi_gather_edges(state["q"], loc, lane),
+                    roi_gather_edges(state["r"], loc, lane),
+                    state["selection"][w["wv_sel"]],
+                    state["same"], state["cycle"],
+                    state["finished"],
+                    jnp.full((w["wv_sel"].shape[0],), big,
+                             dtype=jnp.float32))
+            lq, lr, lsel, same, cycle, finished, resid = \
+                jax.lax.while_loop(cond, body, init)
+            out = dict(state)
+            out.update(
+                q=roi_scatter_edges(state["q"], loc, lq, lane),
+                r=roi_scatter_edges(state["r"], loc, lr, lane),
+                selection=state["selection"].at[w["wv_sel"]].set(
+                    lsel),
+                same=same, cycle=cycle, finished=finished)
+            # lsel rides back so the host can keep its own selection
+            # view for the window rows without a separate gather
+            # dispatch at solve exit
+            return out, resid, lsel
+
+        return run_roi
+
+    def _roi_runner(self, state, w, clock):
+        """AOT-compile (or fetch) the windowed chunk for this window
+        capacity signature.  The state is DONATED: the window writes
+        O(region) elements, so a non-donated full-plane copy per
+        chunk would put the O(|V|) cost right back.  Spans carry the
+        ``roi_`` prefix, so the solve executable's no-retrace
+        assertions (bare ``trace_lower_s``/``compile_s``) stay
+        honest."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..observability.spans import aot_compile
+
+        # the cache key is hand-rolled instead of a full
+        # aval_signature over the state pytree: the state avals are
+        # pinned by the engine's layout/size for its whole lifetime
+        # (q/r share shape+dtype; the scalars never vary), so hashing
+        # the window shapes is enough — and this lookup is on the
+        # per-event hot path
+        q = state["q"]
+        sig = ("roi", self.layout, q.shape, str(q.dtype),
+               state["selection"].shape) + tuple(
+                   (k, v.shape, str(v.dtype)) for k, v in w.items())
+        compiled = self._aot.get(sig)
+        if compiled is None:
+            ex_args = (state, w, jnp.int32(0))
+            jitted = jax.jit(self._roi_chunk_fn(),
+                             donate_argnums=(0,))
+            _lowered, compiled = aot_compile(jitted, ex_args, clock,
+                                             prefix="roi_")
+            self._aot[sig] = compiled
+        return compiled
+
+    def _roi_windowed_solve(self, seed_rows: np.ndarray, budget: int,
+                            timeout: Optional[float]
+                            ) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..observability.spans import SpanClock
+
+        clock = SpanClock()
+        a = self.instance.arrays
+        adj = self._roi_adjacency()
+        _live_rows, live_mask, n_live = self._roi_live_arrays()
+        # the opening window is the seed plus its one-hop halo: every
+        # variable whose incoming messages the first chunk can move
+        # is monitored from cycle one (later hops come from the
+        # boundary residuals)
+        grown0 = adj.expand(seed_rows)
+        grown0 = grown0[live_mask[grown0]]
+        active = np.zeros(a.n_vars, dtype=bool)
+        active[grown0] = True
+        ever_active = active.copy()
+        thr = self._roi_threshold()
+        state = self._state
+        t0 = time.perf_counter()
+        status = "MAX_CYCLES"
+        # windowed cycles cost O(region), so the fixed per-dispatch
+        # overhead (host boundary work + the compiled-call launch)
+        # dominates the event: open with a limit that covers the
+        # common small-edit settle (tens of cycles) in ONE dispatch.
+        # The device stability rule exits the loop the cycle the
+        # window settles, so an oversized limit never burns cycles
+        # the way an oversized full-sweep chunk would — it only
+        # coarsens the frontier-expansion cadence for regions that
+        # stay hot past it
+        step_chunk = max(self._first_chunk(True), 32)
+        chunks_run = 0
+        settle_chunk = None
+        expansions = 0
+        frac_sum = 0.0
+        resid_np = None
+        w = None
+        av = np.zeros(0, dtype=np.int64)
+        n_v = 0
+        # host-side view of the window rows' selections, refreshed
+        # from each chunk's returned local selections — saves the
+        # solve-exit gather dispatch against the device plane
+        sel_acc = self._roi_last_sel.copy()
+        while True:
+            cycle = int(state["cycle"])
+            if bool(state["finished"]):
+                status = "FINISHED"
+                settle_chunk = chunks_run
+                break
+            if cycle >= budget:
+                break
+            if timeout is not None and \
+                    time.perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+            if resid_np is not None:
+                # chunk-boundary frontier logic: still-hot rows keep
+                # (or grow) the region one neighborhood hop; settled
+                # rows drop out.  An empty hot set with an unfinished
+                # chunk keeps the window as-is and lets the
+                # SAME_COUNT stability rule fire on device
+                hot = av[resid_np[:n_v] >= thr]
+                if hot.size:
+                    grown = adj.expand(hot)
+                    grown = grown[live_mask[grown]]
+                    shrunk = np.zeros_like(active)
+                    shrunk[grown] = True
+                    if (shrunk & ~active).any():
+                        expansions += 1
+                    if not np.array_equal(shrunk, active):
+                        active = shrunk
+                        ever_active |= active
+                        w = None
+            if w is None:
+                w, av, n_v = self._roi_window(active, clock)
+            run = self._roi_runner(state, w, clock)
+            limit = min(cycle + step_chunk, budget)
+            state, resid, lsel = run(state, w, jnp.int32(limit))
+            self._state = state
+            resid_np = np.asarray(resid)
+            sel_acc[av] = np.asarray(lsel)[:n_v]
+            chunks_run += 1
+            frac_sum += n_v / n_live
+            step_chunk = min(self.chunk, step_chunk * 2)
+        clock.add("execute_s", time.perf_counter() - t0)
+        self._state = state
+        self.last_spans = clock.as_dict()
+        # only rows that were ever in a window this solve can have a
+        # changed selection on device, and every one of them sat in
+        # some chunk's window — so the accumulated host view already
+        # holds their fresh selections; no device gather needed
+        rows = np.flatnonzero(ever_active)
+        sel_rows = sel_acc[rows]
+        out = self._roi_result(rows, sel_rows, int(state["cycle"]),
+                               status)
+        out["chunks_run"] = chunks_run
+        out["settle_chunk"] = settle_chunk
+        out["active_fraction"] = (round(frac_sum / chunks_run, 6)
+                                  if chunks_run else 0.0)
+        out["frontier_expansions"] = expansions
+        self._roi_expansions_total += expansions
+        self._roi_last_active = active
+        self._roi_ever_active = ever_active
+        return out
+
+    def _roi_short_circuit(self) -> Dict[str, Any]:
+        """An empty activity seed (e.g. an empty delta, or a solve
+        with no pending edit) over a settled carry: nothing can move,
+        so the previous fixed point IS the answer — zero cycles, zero
+        dispatches.  Any pending cost-plane dirt (possible only for
+        phantom-slot edits) still flows through the evaluator."""
+        a = self.instance.arrays
+        sel = self._roi_last_sel
+        if self._roi_dirty_rows or self._roi_dirty_facs:
+            cost, violations = self._roi_eval.update(
+                a, sel,
+                np.fromiter(self._roi_dirty_rows, dtype=np.int64),
+                {bi: np.fromiter(s, dtype=np.int64)
+                 for bi, s in self._roi_dirty_facs.items()})
+        else:
+            cost, violations = self._roi_eval.totals(a)
+        self._roi_clear_pending()
+        self._roi_ever_active = np.zeros(a.n_vars, dtype=bool)
+        self.last_spans = {}
+        return {
+            "status": "FINISHED",
+            "assignment": dict(self._roi_assign),
+            "cost": cost,
+            "violation": violations,
+            "cycle": 0,
+            "spans": {},
+            "budget": self.budget(),
+            "chunks_run": 0,
+            "settle_chunk": 0,
+            "active_fraction": 0.0,
+            "frontier_expansions": 0,
+        }
+
+    def _roi_clear_pending(self):
+        self._roi_seed.clear()
+        self._roi_dirty_rows.clear()
+        self._roi_dirty_facs = {}
+
+    def _roi_result(self, win_rows: np.ndarray,
+                    win_sel: np.ndarray, cycles: int,
+                    status: str) -> Dict[str, Any]:
+        """The O(region) result path: incremental cost/violation
+        update plus an incrementally-maintained assignment dict —
+        the full-sweep ``_result`` (decode + host eval, both O(|V|))
+        would put the floor right back under a 100k-variable warm
+        event.  ``win_rows``/``win_sel`` are the only rows a window
+        ever updated this solve (base coordinates + their fresh
+        selections); everything else is untouched by construction."""
+        a = self.instance.arrays
+        changed = win_rows[win_sel != self._roi_last_sel[win_rows]]
+        self._roi_last_sel[win_rows] = win_sel
+        sel = self._roi_last_sel
+        rows = set(int(r) for r in changed) | self._roi_dirty_rows
+        fac_sets = {bi: set(int(s) for s in slots)
+                    for bi, slots in self._roi_adjacency()
+                    .fac_slots_of(changed).items()}
+        for bi, s in self._roi_dirty_facs.items():
+            fac_sets.setdefault(bi, set()).update(s)
+        cost, violations = self._roi_eval.update(
+            a, sel, np.fromiter(rows, dtype=np.int64),
+            {bi: np.fromiter(s, dtype=np.int64)
+             for bi, s in fac_sets.items()})
+        if self._roi_assign is None or self._roi_registry_stale:
+            self._roi_assign = self.instance.decode(sel)
+            self._roi_row_name = {
+                row: name
+                for name, row in self.instance.live_vars.items()}
+            self._roi_registry_stale = False
+        else:
+            values_of = self.instance.values_of
+            for r in changed:
+                name = self._roi_row_name.get(int(r))
+                if name is None:
+                    continue
+                idx = int(sel[r])
+                values = values_of.get(int(r))
+                self._roi_assign[name] = (idx if values is None
+                                          else values[idx])
+        self._roi_clear_pending()
+        return {
+            "status": status,
+            "assignment": dict(self._roi_assign),
+            "cost": cost,
+            "violation": violations,
+            "cycle": cycles,
+            "spans": dict(self.last_spans),
+            "budget": self.budget(),
+        }
+
+    def _roi_refresh_full(self, sel: np.ndarray
+                          ) -> Tuple[float, int, Dict[str, Any]]:
+        """Rebuild every ROI host cache from a full-sweep result (the
+        oracle): contribution arrays, decode table, last selection.
+        The pending dirt is absorbed — the full sweep saw it all."""
+        from .roi import RoiEval
+
+        if self._roi_eval is None:
+            self._roi_eval = RoiEval()
+        cost, violations = self._roi_eval.refresh(
+            self.instance.arrays, sel)
+        self._roi_assign = self.instance.decode(sel)
+        self._roi_row_name = {
+            row: name
+            for name, row in self.instance.live_vars.items()}
+        self._roi_registry_stale = False
+        self._roi_last_sel = np.asarray(sel).copy()
+        self._roi_clear_pending()
+        return cost, violations, dict(self._roi_assign)
+
+    def _roi_rebuild_from_state(self):
+        """After :meth:`restore_state`: rebuild the host-side ROI
+        caches from the carried device state (one O(V) pass per
+        restore).  The selections are exactly the crashed session's,
+        so the journal's delta-tail replay stays bit-exact."""
+        sel = np.array(self._state["selection"])
+        if self.layout == "fused":
+            sel = sel[self._base._np_fused["var_pos"]]
+        self._roi_refresh_full_keep_pending(sel)
+
+    def _roi_refresh_full_keep_pending(self, sel: np.ndarray):
+        """Like :meth:`_roi_refresh_full` but preserving the pending
+        seed/dirt (restored from a snapshot taken between applies)."""
+        seed = set(self._roi_seed)
+        dirty_rows = set(self._roi_dirty_rows)
+        dirty_facs = {bi: set(s)
+                      for bi, s in self._roi_dirty_facs.items()}
+        self._roi_refresh_full(sel)
+        self._roi_seed = seed
+        self._roi_dirty_rows = dirty_rows
+        self._roi_dirty_facs = dirty_facs
 
     # ---------------------------------------------------- sharded mode
 
@@ -1093,11 +1732,20 @@ class DynamicEngine:
 
     def _result(self, sel: np.ndarray, cycles: int,
                 status: str) -> Dict[str, Any]:
-        cost, violations = eval_cost_violations_np(
-            self.instance.arrays, sel)
+        if self.roi and self.mode == "engine":
+            # full-sweep solve of an ROI session: same totals as the
+            # host eval (RoiEval.refresh IS that sweep), and the
+            # refreshed contribution caches make the next windowed
+            # solve O(region)
+            cost, violations, assignment = self._roi_refresh_full(
+                sel)
+        else:
+            cost, violations = eval_cost_violations_np(
+                self.instance.arrays, sel)
+            assignment = self.instance.decode(sel)
         return {
             "status": status,
-            "assignment": self.instance.decode(sel),
+            "assignment": assignment,
             "cost": cost,
             "violation": violations,
             "cycle": cycles,

@@ -61,7 +61,9 @@ def replay_scenario(engine: DynamicEngine, scenario: Scenario,
             out = {k: v for k, v in rec.items()
                    if k in ("status", "cost", "violation", "cycle",
                             "warm_start", "spans", "upload_bytes",
-                            "layout", "cycles_run", "chunks_run")
+                            "layout", "cycles_run", "chunks_run",
+                            "active_fraction",
+                            "frontier_expansions")
                    and v is not None}
             # settle_chunk's documented encoding: explicit null =
             # the budget ran out before the stability rule fired;

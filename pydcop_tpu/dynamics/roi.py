@@ -1,0 +1,497 @@
+"""Region-of-interest warm solves: the host side of the activity plane.
+
+ISSUE 16: a warm re-solve of a small :class:`TopologyDelta` should
+cost O(touched region), not O(|V|) — PR 14's adaptive budgets cut the
+number of full sweeps, this cuts the width of each sweep.  The device
+side (``ops/kernels.py`` ``roi_*`` primitives, the windowed chunk in
+``dynamics/engine.py``) runs the exact Max-Sum update over a gathered
+window of the carried message planes; this module owns everything the
+host decides between chunks:
+
+* :class:`RoiAdjacency` — the factor-graph neighborhood structure
+  (variable -> incident edges / factors / neighbor variables) rebuilt
+  from the canonical edge layout whenever a degree-changing delta
+  lands.  Sink-anchored (phantom) factors are excluded, so the
+  adjacency always describes the LIVE graph.
+* the **activity plane** — a boolean per-variable mask seeded from the
+  rows a delta touched (:func:`roi_seed_filter`), expanded one
+  graph-neighborhood hop at chunk boundaries while boundary residuals
+  exceed ``roi_residual_threshold``, and shrunk as regions settle
+  (the engine keeps only the still-hot frontier plus its halo).
+* :func:`build_window` — the activity plane compiled to the pow2-padded
+  gather/scatter lists one windowed chunk consumes.  Capacities are
+  powers of two, so the compiled-program ladder is bounded (same trick
+  as the delta scatter write lists) and the retrace-free contract
+  holds: a window of the same capacity re-enters the same executable.
+* :class:`RoiEval` — incremental cost/violation bookkeeping.  The full
+  host sweep of ``eval_cost_violations_np`` is O(|V| + |F|) per solve,
+  which would put an O(|V|) floor right back under every event; this
+  keeps per-factor/per-variable contributions and re-evaluates only
+  rows whose selection (or cost plane) changed.
+
+The activity plane is CONVERGENCE state — which rows can still move —
+unlike PR 6's freeze plane, which is DECIMATION state (rows clamped by
+policy).  Same masking mechanics, different meaning; a frozen row must
+never be activated, which is why :func:`roi_seed_filter` takes an
+optional ``frozen`` plane.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graphs.arrays import HARD, canonical_edge_layout
+from .deltas import TopologyDelta
+from .scatter import _pow2_pad
+
+__all__ = ["RoiAdjacency", "RoiEval", "build_window",
+           "roi_seed_rows", "roi_seed_filter"]
+
+# The window-capacity floor: every non-empty window list pads to at
+# least this many entries.  Bare pow2 padding makes each fresh
+# COMBINATION of tiny capacities across the window planes (factor
+# pairs x unary rows x variable rows) a fresh compiled program, so
+# steady-state warm traffic with varying small regions keeps paying
+# trace+compile; flooring collapses every small-region window onto
+# ONE capacity signature, and the pow2 ladder takes over only once a
+# region genuinely outgrows the floor.
+ROI_MIN_CAPACITY = 64
+
+
+def _pow2_pad_floor(idx: np.ndarray, *rows: np.ndarray):
+    """``_pow2_pad`` with the :data:`ROI_MIN_CAPACITY` floor.  Padding
+    semantics are unchanged — repeat the last entry (duplicate
+    scatters write identical values, redundant gathers read real
+    rows); empty lists stay empty (their no-op aval is already one
+    signature)."""
+    out = _pow2_pad(idx, *rows)
+    n = int(out[0].shape[0])
+    if not n or n >= ROI_MIN_CAPACITY:
+        return out
+    pad = ROI_MIN_CAPACITY - n
+    return tuple(np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+                 for a in out)
+
+
+def roi_seed_rows(delta: TopologyDelta,
+                  pre_owner: Optional[np.ndarray]) -> np.ndarray:
+    """The variable rows one delta touches, as an activity seed: the
+    delta's own ``touched_vars``, the owners of its touched edges
+    BEFORE the apply (``pre_owner`` — a removed constraint's edges
+    re-point to the sink, but the variables that lost it must wake),
+    and the owners it re-points edges to."""
+    parts = [np.asarray(delta.touched_vars, dtype=np.int64)]
+    if pre_owner is not None and len(pre_owner):
+        parts.append(np.asarray(pre_owner, dtype=np.int64))
+    if delta.edge_var is not None and len(delta.edge_var):
+        parts.append(np.asarray(delta.edge_var, dtype=np.int64))
+    if not parts:
+        return np.zeros(0, dtype=np.int64)
+    return np.unique(np.concatenate(parts))
+
+
+def roi_seed_filter(rows: np.ndarray, live_rows: np.ndarray,
+                    frozen: Optional[np.ndarray] = None) -> np.ndarray:
+    """Filter a raw activity seed down to rows that may actually run:
+    live registry rows only (the sink and removed/invalid rows drop —
+    a delta that removes a variable touches its row, but a dead row
+    has nothing to propagate), minus any ``frozen`` rows (a decimated
+    row is pinned by policy and must stay out of the window even when
+    a delta grazes it).  Returns sorted unique int64 rows."""
+    rows = np.unique(np.asarray(rows, dtype=np.int64))
+    live = np.zeros(0, dtype=bool)
+    if rows.size:
+        live_set = np.asarray(live_rows, dtype=np.int64)
+        live = np.isin(rows, live_set)
+        rows = rows[live]
+    if frozen is not None and rows.size:
+        fr = np.asarray(frozen, dtype=bool)
+        rows = rows[~fr[rows]]
+    return rows
+
+
+def _csr_from_pairs(owner: np.ndarray, item: np.ndarray,
+                    n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(owner, item) pairs -> a CSR (offsets (n+1,), items) with each
+    owner's items contiguous."""
+    order = np.argsort(owner, kind="stable")
+    items = item[order]
+    counts = np.bincount(owner, minlength=n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return offsets, items
+
+
+def _csr_gather(offsets: np.ndarray, items: np.ndarray,
+                rows: np.ndarray) -> np.ndarray:
+    """Concatenate the CSR segments of ``rows`` (vectorized — no
+    per-row python loop: this runs at every chunk boundary)."""
+    counts = (offsets[rows + 1] - offsets[rows]).astype(np.int64)
+    total = int(counts.sum())
+    if not total:
+        return np.zeros(0, dtype=items.dtype)
+    starts = offsets[rows]
+    base = np.repeat(starts, counts)
+    shift = np.arange(total, dtype=np.int64) - np.repeat(
+        np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    return items[base + shift]
+
+
+class RoiAdjacency:
+    """Host adjacency of the LIVE factor graph, from the canonical
+    edge layout: per-variable incident edges, incident factors (for
+    the incremental evaluator) and neighbor variables (for the
+    one-hop frontier expansion).  Rebuilt whenever a degree-changing
+    delta re-points edges; cost-only traffic never pays for it."""
+
+    def __init__(self, arrays):
+        a = arrays
+        V = a.n_vars
+        sink = V - 1
+        ev = np.asarray(a.edge_var)
+        layout = canonical_edge_layout(a)
+        bin_bi: List[np.ndarray] = []
+        bin_slot: List[np.ndarray] = []
+        bin_e0: List[np.ndarray] = []
+        bin_e1: List[np.ndarray] = []
+        un_bi: List[np.ndarray] = []
+        un_slot: List[np.ndarray] = []
+        un_e: List[np.ndarray] = []
+        for bi, spec in enumerate(layout):
+            if spec is None:
+                continue
+            offset, slots, arity = spec
+            if not slots:
+                continue
+            f = np.arange(slots, dtype=np.int64)
+            if arity == 1:
+                e = offset + f
+                live = ev[e] != sink
+                un_bi.append(np.full(int(live.sum()), bi,
+                                     dtype=np.int64))
+                un_slot.append(f[live])
+                un_e.append(e[live])
+            elif arity == 2:
+                e0 = offset + 2 * f
+                e1 = e0 + 1
+                live = (ev[e0] != sink) & (ev[e1] != sink)
+                bin_bi.append(np.full(int(live.sum()), bi,
+                                      dtype=np.int64))
+                bin_slot.append(f[live])
+                bin_e0.append(e0[live])
+                bin_e1.append(e1[live])
+            else:
+                raise ValueError(
+                    f"ROI warm solves cover arity <= 2 factor "
+                    f"buckets; bucket {bi} has arity {arity}")
+
+        def cat(parts, dtype=np.int64):
+            return (np.concatenate(parts) if parts
+                    else np.zeros(0, dtype=dtype))
+
+        self.bin_bi = cat(bin_bi)
+        self.bin_slot = cat(bin_slot)
+        self.bin_e0 = cat(bin_e0)
+        self.bin_e1 = cat(bin_e1)
+        self.un_bi = cat(un_bi)
+        self.un_slot = cat(un_slot)
+        self.un_e = cat(un_e)
+        nb = self.bin_e0.shape[0]
+        nu = self.un_e.shape[0]
+        # variable -> incident live edges (the window's wv_edges rows)
+        owners = np.concatenate([ev[self.bin_e0], ev[self.bin_e1],
+                                 ev[self.un_e]]).astype(np.int64)
+        edges = np.concatenate([self.bin_e0, self.bin_e1, self.un_e])
+        self.v_e_off, self.v_e_idx = _csr_from_pairs(owners, edges, V)
+        # variable -> incident factor indices (into the bin_*/un_*
+        # flat tables; unary factors offset by nb)
+        facs = np.concatenate([np.arange(nb), np.arange(nb),
+                               nb + np.arange(nu)]).astype(np.int64)
+        self.v_f_off, self.v_f_idx = _csr_from_pairs(owners, facs, V)
+        # variable -> neighbor variables (binary factors only)
+        nbr_owner = np.concatenate([ev[self.bin_e0], ev[self.bin_e1]]
+                                   ).astype(np.int64)
+        nbr_other = np.concatenate([ev[self.bin_e1], ev[self.bin_e0]]
+                                   ).astype(np.int64)
+        self.v_n_off, self.v_n_idx = _csr_from_pairs(
+            nbr_owner, nbr_other, V)
+        deg = self.v_e_off[1:] - self.v_e_off[:-1]
+        self.max_degree = int(deg.max()) if deg.size else 0
+
+    # ------------------------------------------------------- queries
+
+    def incident_edges(self, rows: np.ndarray) -> np.ndarray:
+        return _csr_gather(self.v_e_off, self.v_e_idx, rows)
+
+    def incident_factors(self, rows: np.ndarray) -> np.ndarray:
+        return np.unique(_csr_gather(self.v_f_off, self.v_f_idx,
+                                     rows))
+
+    def neighbors(self, rows: np.ndarray) -> np.ndarray:
+        return np.unique(_csr_gather(self.v_n_off, self.v_n_idx,
+                                     rows))
+
+    def expand(self, hot: np.ndarray) -> np.ndarray:
+        """One frontier hop: the still-hot rows plus their direct
+        graph neighbors (sorted unique)."""
+        if not hot.size:
+            return hot
+        return np.unique(np.concatenate([hot, self.neighbors(hot)]))
+
+    def fac_slots_of(self, rows: np.ndarray
+                     ) -> Dict[int, np.ndarray]:
+        """The (bucket -> slot rows) incident to ``rows`` — what the
+        incremental evaluator must re-score after those variables'
+        selections changed."""
+        gf = self.incident_factors(np.asarray(rows, dtype=np.int64))
+        if not gf.size:
+            return {}
+        nb = self.bin_e0.shape[0]
+        b = gf[gf < nb]
+        u = gf[gf >= nb] - nb
+        parts: Dict[int, List[np.ndarray]] = {}
+        for bis, slots, sub in ((self.bin_bi, self.bin_slot, b),
+                                (self.un_bi, self.un_slot, u)):
+            for bi in (np.unique(bis[sub]) if sub.size else ()):
+                m = bis[sub] == bi
+                parts.setdefault(int(bi), []).append(slots[sub][m])
+        return {bi: np.unique(np.concatenate(ps))
+                for bi, ps in parts.items()}
+
+
+def build_window(arrays, adj: RoiAdjacency, active_rows: np.ndarray,
+                 eix: Optional[np.ndarray], six: Optional[np.ndarray],
+                 width: int, store_dtype) -> Tuple[Dict, int]:
+    """The activity plane compiled to one windowed chunk's argument
+    lists (host numpy; shipped to device by the compiled call).
+
+    active_rows: sorted live variable rows.  eix/six: the layout's
+    edge/selection coordinate maps (``None`` = identity for
+    edge_major/lane_major; ``slot_of_edge``/``var_pos`` for fused).
+    width: the plane's edge-axis extent — also the OUT-OF-RANGE pad
+    index (gathers fill, scatters drop, so pads can never
+    double-count a belief sum).  Index lists pad to floored powers of
+    two by repeating their last entry (duplicate scatters write
+    identical values; the capacities keep the compiled ladder
+    bounded), then re-map to LOCAL coordinates — positions in the
+    ``loc`` edge union — so the compiled chunk iterates on a gathered
+    O(region) plane and touches the full message planes exactly twice
+    per chunk.  Local out-of-range is ``loc``'s capacity; ``loc``
+    itself pads with ``width``.
+
+    The window closes over the active rows' full incident factor set
+    (halo factors included), so each active variable sees every one of
+    its incoming messages — the variable update inside the window is
+    EXACT; halo variables' outgoing messages are read but never
+    written, the conditional-Max-Sum boundary condition.
+
+    Returns ``(window dict, n_active)``."""
+    a = arrays
+    av = np.asarray(active_rows, dtype=np.int64)
+    n_v = int(av.size)
+    if not n_v:
+        raise ValueError("empty ROI window (callers short-circuit "
+                         "empty seeds before building a window)")
+    D = int(np.asarray(a.var_costs).shape[1])
+    gf = adj.incident_factors(av)
+    nb_all = adj.bin_e0.shape[0]
+    bf = gf[gf < nb_all]
+    uf = gf[gf >= nb_all] - nb_all
+
+    def to_layout(edge_ids: np.ndarray) -> np.ndarray:
+        e = edge_ids if eix is None else eix[edge_ids]
+        return np.asarray(e, dtype=np.int32)
+
+    # binary window factors: both edges, canonical-orientation cubes
+    e0 = adj.bin_e0[bf]
+    e1 = adj.bin_e1[bf]
+    cube_w = np.zeros((bf.size, D, D), dtype=np.float32)
+    for bi in np.unique(adj.bin_bi[bf]) if bf.size else ():
+        m = adj.bin_bi[bf] == bi
+        cube_w[m] = np.asarray(
+            a.buckets[bi].cubes, dtype=np.float32)[adj.bin_slot[bf][m]]
+    wf_e0, wf_e1, wf_cube = _pow2_pad_floor(
+        to_layout(e0), to_layout(e1),
+        cube_w.astype(store_dtype))
+    # unary window factors: the message IS the (store-rounded) cost row
+    ue = adj.un_e[uf]
+    urow = np.zeros((uf.size, D), dtype=np.float32)
+    for bi in np.unique(adj.un_bi[uf]) if uf.size else ():
+        m = adj.un_bi[uf] == bi
+        urow[m] = np.asarray(
+            a.buckets[bi].cubes, dtype=np.float32)[
+                adj.un_slot[uf][m]].astype(store_dtype)
+    wu_e, wu_row = _pow2_pad_floor(to_layout(ue), urow)
+    # per-variable gather rows: incident edges padded out-of-range.
+    # K is the WINDOW's max degree (pow2, floored), not the graph's:
+    # one hub variable anywhere in the graph must not inflate every
+    # window's (C_v, K, D) tensors — pad columns are exact zeros in
+    # the belief sums, so the shrink is bit-exact, and the pow2 rungs
+    # keep the compiled ladder bounded
+    from ..parallel.bucketing import next_pow2
+
+    counts = (adj.v_e_off[av + 1] - adj.v_e_off[av]).astype(np.int64)
+    K = max(4, next_pow2(int(counts.max()) if counts.size else 1))
+    flat = adj.incident_edges(av)
+    wv_edges = np.full((n_v, K), width, dtype=np.int32)
+    if flat.size:
+        rows = np.repeat(np.arange(n_v, dtype=np.int64), counts)
+        cols = np.arange(flat.size, dtype=np.int64) - np.repeat(
+            np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+        wv_edges[rows, cols] = to_layout(flat)
+    sel_ix = av if six is None else six[av]
+    mask = np.asarray(a.domain_mask)[av]
+    # store-rounded unary plane, upcast exactly like the full sweep's
+    # belief assembly (store plane + f32 messages)
+    costs = np.asarray(a.var_costs, dtype=np.float32)[av] \
+        .astype(store_dtype).astype(np.float32)
+    dsize = np.asarray(a.domain_size, dtype=np.float32)[av]
+    wv_sel, wv_edges, wv_costs, wv_mask, wv_dsize = _pow2_pad_floor(
+        np.asarray(sel_ix, dtype=np.int32), wv_edges, costs, mask,
+        dsize)
+    # localize: the chunk iterates on a GATHERED local edge plane
+    # (full planes touched once per chunk — entry gather, exit
+    # scatter), so every index list re-maps from full-plane
+    # coordinates to positions in ``loc``, the sorted unique union of
+    # referenced edges.  ``loc`` pads with the full plane's
+    # out-of-range index (entry gathers fill, the exit scatter
+    # drops) — NEVER by repeating a real edge, which would let a pad
+    # slot's stale copy overwrite that edge's updated value on exit.
+    all_ix = np.concatenate([wf_e0, wf_e1, wu_e, wv_edges.ravel()])
+    loc = np.unique(all_ix[all_ix < width]).astype(np.int32)
+    cap = max(next_pow2(int(loc.size)), ROI_MIN_CAPACITY)
+    loc_p = np.full(cap, width, dtype=np.int32)
+    loc_p[:loc.size] = loc
+
+    def to_local(ix: np.ndarray) -> np.ndarray:
+        out = np.full(ix.shape, cap, dtype=np.int32)
+        real = ix < width
+        out[real] = np.searchsorted(loc, ix[real]).astype(np.int32)
+        return out
+
+    # fuse the per-role index lists into two combined gather/scatter
+    # lists: XLA:CPU pays a fixed dispatch cost per gather/scatter op
+    # inside the while_loop body, so 4 q-gathers + 3 r-scatters as
+    # separate ops dominate a small window's cycle.  The chunk body
+    # splits them back by STATIC offsets derivable from the argument
+    # shapes alone (nu from wu_row, nf from lr_ix, K from lq_ix), so
+    # equal-shape windows still share one compiled program.  Unary
+    # edge slots are disjoint from every binary slot by construction,
+    # which is what makes the single combined r-scatter (and reading
+    # the unary rows pre-scatter) exact.
+    le0, le1 = to_local(wf_e0), to_local(wf_e1)
+    return {
+        "loc": loc_p,
+        "lq_ix": np.concatenate(
+            [le0, le1, to_local(wv_edges).ravel()]),
+        "lr_ix": np.concatenate([le0, le1, to_local(wu_e)]),
+        "wf_cube": wf_cube,
+        "wu_row": wu_row,
+        "wv_sel": wv_sel,
+        "wv_costs": wv_costs, "wv_mask": wv_mask,
+        "wv_dsize": wv_dsize,
+    }, n_v
+
+
+class RoiEval:
+    """Incremental (cost, violations) bookkeeping: per-factor and
+    per-variable contribution arrays plus float64 running totals.
+    ``refresh`` recomputes everything (one full host sweep — paid on
+    cold/full solves only); ``update`` re-scores exactly the rows and
+    factor slots a warm event perturbed.  Contributions are computed
+    in f32 exactly like ``eval_cost_violations_np``; only the running
+    totals accumulate in float64 (so incremental order cannot drift
+    them)."""
+
+    def __init__(self):
+        self.valid = False
+        self.var_cells: Optional[np.ndarray] = None
+        self.var_viol: Optional[np.ndarray] = None
+        self.fac_cells: Dict[int, np.ndarray] = {}
+        self.fac_viol: Dict[int, np.ndarray] = {}
+        self.cost_total = 0.0
+        self.viol_total = 0
+
+    @staticmethod
+    def _score_bucket(bucket, sel: np.ndarray,
+                      slots: Optional[np.ndarray] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        cubes = np.asarray(bucket.cubes, dtype=np.float32)
+        vids = np.asarray(bucket.var_ids)
+        if slots is not None:
+            cubes = cubes[slots]
+            vids = vids[slots]
+        idx = (np.arange(cubes.shape[0]),) + tuple(
+            sel[vids[:, p]] for p in range(bucket.arity))
+        cells = cubes[idx]
+        viol = np.abs(cells) >= HARD
+        return np.where(viol, 0.0, cells).astype(np.float32), viol
+
+    def refresh(self, arrays, sel: np.ndarray) -> Tuple[float, int]:
+        a = arrays
+        V = a.n_vars
+        unary = np.asarray(a.var_costs, dtype=np.float32)[
+            np.arange(V), sel]
+        viol = np.abs(unary) >= HARD
+        self.var_cells = np.where(viol, 0.0, unary).astype(np.float32)
+        self.var_viol = viol
+        self.fac_cells = {}
+        self.fac_viol = {}
+        total = float(self.var_cells.sum(dtype=np.float64))
+        viols = int(viol.sum())
+        for bi, b in enumerate(a.buckets):
+            if not b.cubes.shape[0]:
+                continue
+            cells, v = self._score_bucket(b, sel)
+            self.fac_cells[bi] = cells
+            self.fac_viol[bi] = v
+            total += float(cells.sum(dtype=np.float64))
+            viols += int(v.sum())
+        self.cost_total = total
+        self.viol_total = viols
+        self.valid = True
+        return self.totals(a)
+
+    def update(self, arrays, sel: np.ndarray, rows: np.ndarray,
+               fac_slots: Dict[int, np.ndarray]) -> Tuple[float, int]:
+        assert self.valid, "RoiEval.update before refresh"
+        a = arrays
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size:
+            unary = np.asarray(a.var_costs, dtype=np.float32)[
+                rows, sel[rows]]
+            viol = np.abs(unary) >= HARD
+            cells = np.where(viol, 0.0, unary).astype(np.float32)
+            self.cost_total += float(cells.sum(dtype=np.float64)) \
+                - float(self.var_cells[rows].sum(dtype=np.float64))
+            self.viol_total += int(viol.sum()) \
+                - int(self.var_viol[rows].sum())
+            self.var_cells[rows] = cells
+            self.var_viol[rows] = viol
+        for bi, slots in fac_slots.items():
+            slots = np.asarray(slots, dtype=np.int64)
+            if not slots.size:
+                continue
+            b = a.buckets[bi]
+            old_c = self.fac_cells.get(bi)
+            if old_c is None:
+                # a bucket that scored empty at refresh time (all
+                # phantom) gained live slots via a delta: full rescore
+                cells, v = self._score_bucket(b, sel)
+                self.fac_cells[bi] = cells
+                self.fac_viol[bi] = v
+                self.cost_total += float(cells.sum(dtype=np.float64))
+                self.viol_total += int(v.sum())
+                continue
+            cells, v = self._score_bucket(b, sel, slots)
+            self.cost_total += float(cells.sum(dtype=np.float64)) \
+                - float(old_c[slots].sum(dtype=np.float64))
+            self.viol_total += int(v.sum()) \
+                - int(self.fac_viol[bi][slots].sum())
+            old_c[slots] = cells
+            self.fac_viol[bi][slots] = v
+        return self.totals(a)
+
+    def totals(self, arrays) -> Tuple[float, int]:
+        return (float(self.cost_total) * float(arrays.sign),
+                int(self.viol_total))

@@ -53,6 +53,27 @@ PLANE_KEYS = ("m_residual", "m_flips", "m_violations", "m_freezes",
 PLANE_CAP = 1 << 16
 
 
+def roi_metrics(registry):
+    """Serving-registry handles for the region-of-interest warm-solve
+    telemetry (ISSUE 16): a per-target gauge with the last delta
+    dispatch's mean windowed fraction of live variables, and the
+    running total of chunk-boundary frontier expansions the residual
+    gate granted.  Idempotent — registration returns the existing
+    metric on re-entry — and surfaced by ``serve-status``."""
+    return {
+        "active_fraction": registry.gauge(
+            "pydcop_roi_active_fraction",
+            "mean fraction of live variables swept by the last ROI "
+            "delta dispatch (1.0 = full sweep, 0.0 = short-circuit)",
+            labels=("target",)),
+        "frontier_expansions": registry.counter(
+            "pydcop_roi_frontier_expansions_total",
+            "chunk-boundary neighborhood hops granted by the ROI "
+            "residual gate",
+            labels=("target",)),
+    }
+
+
 def alloc_metric_planes(n_cycles: int) -> Dict[str, Any]:
     """Preallocated per-cycle planes, NaN / ``-1`` marking never-written
     rows.  Row ``i`` describes cycle ``i + 1`` (the post-increment

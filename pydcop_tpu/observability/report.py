@@ -96,10 +96,17 @@ SCHEMA_VERSION = 1
 #: from; absent on fresh runs), the serve ``event: preempt_drain``
 #: record with ``requeued``/``requeue_total``, the ``preempt``
 #: fault-record action, and the ``checkpoints`` counter block on
-#: stats/final serve records.  A v1.0-1.5 reader stays green by the
-#: one documented forward-compat rule: consumers filter the stream by
-#: the record kinds (and fields) they speak and ignore the rest.
-SCHEMA_MINOR = 6
+#: stats/final serve records.
+#: Minor 7 (region-of-interest warm solves, ISSUE 16) added the
+#: activity-plane telemetry on summary and serve dispatch records:
+#: ``active_fraction`` (mean fraction of live variables inside the
+#: windowed sweep, in [0, 1]; 1.0 = full sweep, 0.0 = short-circuit)
+#: and ``frontier_expansions`` (chunk-boundary neighborhood hops the
+#: residual gate granted this dispatch).  A v1.0-1.6 reader stays
+#: green by the one documented forward-compat rule: consumers filter
+#: the stream by the record kinds (and fields) they speak and ignore
+#: the rest.
+SCHEMA_MINOR = 7
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -334,6 +341,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_upload_bytes(rec, "summary")
         _check_budget_fields(rec, "summary")
         _check_ckpt_fields(rec, "summary")
+        _check_roi_fields(rec, "summary")
         rc = rec.get("reason_class")
         if rc is not None and (not isinstance(rc, str) or not rc):
             raise ValueError(
@@ -358,6 +366,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_upload_bytes(rec, "serve")
         _check_budget_fields(rec, "serve")
         _check_ckpt_fields(rec, "serve")
+        _check_roi_fields(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -448,6 +457,23 @@ def _check_ckpt_fields(rec, kind):
                               or not isinstance(v, int) or v < 0):
             raise ValueError(
                 f"{kind} record with bad {field} {v!r}")
+
+
+def _check_roi_fields(rec, kind):
+    """Optional schema-minor-7 fields: the region-of-interest
+    telemetry — ``active_fraction`` a float in [0, 1],
+    ``frontier_expansions`` a non-negative int."""
+    af = rec.get("active_fraction")
+    if af is not None and (isinstance(af, bool)
+                           or not isinstance(af, (int, float))
+                           or not 0.0 <= af <= 1.0):
+        raise ValueError(
+            f"{kind} record with bad active_fraction {af!r}")
+    fx = rec.get("frontier_expansions")
+    if fx is not None and (isinstance(fx, bool)
+                           or not isinstance(fx, int) or fx < 0):
+        raise ValueError(
+            f"{kind} record with bad frontier_expansions {fx!r}")
 
 
 def _check_fault(fault):

@@ -394,3 +394,103 @@ def random_argmin(key: jax.Array, costs: jnp.ndarray,
     is_min = (c <= m) & mask
     noise = prefix_uniform(key, c.shape[0], width=c.shape[-1])
     return jnp.argmax(is_min * (1.0 + noise), axis=-1)
+
+
+# ------------------------------------------------------- ROI window sweeps
+#
+# Region-of-interest warm re-solves (ISSUE 16) run the Max-Sum update
+# over a small gathered WINDOW of the full message planes instead of
+# sweeping every row: the activity plane picks the rows, the window
+# ships as pow2-padded index/value lists (fixed shapes per capacity
+# rung — masking and padding, never dynamic shapes), and these
+# primitives do the per-cycle gather -> update -> scatter.  They are
+# the freeze-plane trick of decimation (PR 6) applied to convergence
+# state instead of decimation state: rows outside the window simply
+# keep their previous values, exactly like a frozen row keeps its
+# clamp.  Padding contract: factor/selection lists pad by repeating
+# their last entry (duplicate scatters write identical values), the
+# per-variable edge table ``wv_edges`` pads with an OUT-OF-RANGE index
+# (the plane's edge-axis width) so belief sums cannot double-count —
+# gathers use ``mode='fill'`` and scatters ``mode='drop'``.
+
+
+def roi_gather_edges(plane: jnp.ndarray, idx: jnp.ndarray,
+                     lane: bool) -> jnp.ndarray:
+    """Window gather of message rows: ``(..., idx)`` columns of a
+    lane-oriented ``(D, E)`` plane or ``idx`` rows of an edge-major
+    ``(E, D)`` plane, always returned edge-major ``(*idx.shape, D)``.
+    Out-of-range pad indices fill with 0 (callers mask them)."""
+    if lane:
+        g = jnp.take(plane, idx.reshape(-1), axis=1, mode="fill",
+                     fill_value=0).T
+    else:
+        g = jnp.take(plane, idx.reshape(-1), axis=0, mode="fill",
+                     fill_value=0)
+    return g.reshape(idx.shape + (plane.shape[0 if lane else -1],))
+
+
+def roi_scatter_edges(plane: jnp.ndarray, idx: jnp.ndarray,
+                      rows: jnp.ndarray, lane: bool) -> jnp.ndarray:
+    """Window scatter, the inverse of :func:`roi_gather_edges`:
+    edge-major ``rows`` land on the plane's own orientation;
+    out-of-range pad indices drop."""
+    D = plane.shape[0] if lane else plane.shape[-1]
+    flat_i = idx.reshape(-1)
+    flat_v = rows.reshape(-1, D).astype(plane.dtype)
+    if lane:
+        return plane.at[:, flat_i].set(flat_v.T, mode="drop")
+    return plane.at[flat_i].set(flat_v, mode="drop")
+
+
+def roi_window_factors(cube_w: jnp.ndarray, q0: jnp.ndarray,
+                       q1: jnp.ndarray, r0_old: jnp.ndarray,
+                       r1_old: jnp.ndarray, damping: float,
+                       damp_factors: bool
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Binary window-factor messages: :func:`factor_messages` over the
+    gathered cubes, with the solver's factor-side damping blend when
+    the base program runs ``damping_nodes in ('factors', 'both')`` —
+    the window must replicate the full sweep's arithmetic exactly."""
+    m0, m1 = factor_messages(cube_w, [q0, q1])
+    if damp_factors and damping > 0:
+        # python-float coefficients, exactly like MaxSumSolver.step
+        m0 = damping * r0_old + (1 - damping) * m0
+        m1 = damping * r1_old + (1 - damping) * m1
+    return m0, m1
+
+
+def roi_window_variables(r_g: jnp.ndarray, q_old: jnp.ndarray,
+                         wv_costs: jnp.ndarray, wv_mask: jnp.ndarray,
+                         wv_dsize: jnp.ndarray, in_range: jnp.ndarray,
+                         damping: float, damp_vars: bool, big: float
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray, jnp.ndarray]:
+    """The per-variable half of one ROI Max-Sum cycle, mirroring
+    ``MaxSumSolver.step`` operation for operation over the window:
+    belief assembly, message normalization, the variable-side damping
+    blend, invalid-slot masking, selection, and the per-variable
+    residual that drives the frontier logic.
+
+    r_g / q_old: ``(C_v, K, D)`` gathered incoming messages / previous
+    outgoing messages (pad slots filled with 0).  in_range:
+    ``(C_v, K)`` marks real edge slots.  Returns ``(q_new, belief,
+    selection, resid)`` with ``resid`` the masked max-|dq| per window
+    variable — the same quantity the full sweep maxes globally into
+    its convergence delta."""
+    mask3 = wv_mask[:, None, :]
+    valid = in_range[:, :, None] & mask3
+    belief = wv_costs + jnp.sum(
+        jnp.where(in_range[:, :, None], r_g, 0.0).astype(jnp.float32),
+        axis=1)                                        # (C_v, D)
+    q_new = belief[:, None, :] - r_g                   # (C_v, K, D)
+    mean = jnp.sum(jnp.where(valid, q_new, 0.0), axis=2) \
+        / wv_dsize[:, None]
+    q_new = q_new - mean[:, :, None]
+    if damp_vars and damping > 0:
+        # python-float coefficients, exactly like MaxSumSolver.step
+        q_new = damping * q_old + (1 - damping) * q_new
+    q_new = jnp.where(mask3, q_new, jnp.float32(big))
+    selection = masked_argmin(belief, wv_mask).astype(jnp.int32)
+    resid = jnp.max(jnp.where(valid, jnp.abs(q_new - q_old), 0.0),
+                    axis=(1, 2))                       # (C_v,)
+    return q_new, belief, selection, resid

@@ -94,7 +94,8 @@ class DeltaSessions:
                  resident: bool = True, journal=None,
                  layout: str = "edge_major",
                  warm_budget: str = "adaptive",
-                 checkpoints=None):
+                 checkpoints=None, roi: bool = False,
+                 roi_residual_threshold: Optional[float] = None):
         from collections import OrderedDict
 
         self.exec_cache = exec_cache
@@ -119,6 +120,13 @@ class DeltaSessions:
         #: boundary) or fixed — identical selections and cycles
         #: either way
         self.warm_budget = str(warm_budget)
+        #: region-of-interest warm re-solves (``serve --roi``):
+        #: sessions open their engines with the activity-gated
+        #: windowed sweep, so delta cost scales with the touched
+        #: region — dispatch records carry ``active_fraction`` /
+        #: ``frontier_expansions``
+        self.roi = bool(roi)
+        self.roi_residual_threshold = roi_residual_threshold
         #: byte budget over the summed per-session resident_bytes
         #: (None = count cap only)
         self.budget_bytes = (int(budget_bytes) if budget_bytes
@@ -196,7 +204,9 @@ class DeltaSessions:
                 "max_cycles", default_max_cycles)),
             exec_cache=self.exec_cache,
             resident=self.resident,
-            layout=layout, warm_budget=self.warm_budget)
+            layout=layout, warm_budget=self.warm_budget,
+            roi=self.roi,
+            roi_residual_threshold=self.roi_residual_threshold)
         self._sessions[target] = engine
         self.stats["opened"] += 1
         self.enforce()
@@ -500,7 +510,8 @@ class Dispatcher:
                  faults=None, execute_deadline_s: Optional[float] = None,
                  journal=None, session_layout: str = "edge_major",
                  warm_budget: str = "adaptive",
-                 checkpoints=None):
+                 checkpoints=None, session_roi: bool = False,
+                 roi_residual_threshold: Optional[float] = None):
         self.reporter = reporter
         self.exec_cache = exec_cache
         self.clock = clock
@@ -508,6 +519,10 @@ class Dispatcher:
         self.registry = registry
         self._metrics = (_stage_metrics(registry)
                          if registry is not None else None)
+        from ..observability.metrics import roi_metrics
+
+        self._roi_metrics = (roi_metrics(registry)
+                             if registry is not None else None)
         #: injected fault plan (serving/faults.FaultPlan; chaos runs
         #: only — None keeps every hook dead) and the execute
         #: watchdog deadline: with a deadline set, the device span of
@@ -533,7 +548,8 @@ class Dispatcher:
             budget_bytes=session_budget_bytes,
             resident=resident_deltas, journal=journal,
             layout=session_layout, warm_budget=warm_budget,
-            checkpoints=checkpoints)
+            checkpoints=checkpoints, roi=session_roi,
+            roi_residual_threshold=roi_residual_threshold)
 
     # ---------------------------------------------- fault / watchdog
 
@@ -865,6 +881,20 @@ class Dispatcher:
             # fired — emitted explicitly (not omitted), the one
             # documented encoding on summary AND serve records
             rec["settle_chunk"] = res.get("settle_chunk")
+        if res.get("active_fraction") is not None:
+            # region-of-interest telemetry (schema minor 7): the mean
+            # windowed fraction of live variables this dispatch swept
+            # and the frontier hops the residual gate granted
+            rec["active_fraction"] = float(res["active_fraction"])
+            rec["frontier_expansions"] = int(
+                res.get("frontier_expansions") or 0)
+            if self._roi_metrics is not None:
+                self._roi_metrics["active_fraction"].set(
+                    rec["active_fraction"], target=request["target"])
+                if rec["frontier_expansions"]:
+                    self._roi_metrics["frontier_expansions"].inc(
+                        rec["frontier_expansions"],
+                        target=request["target"])
         if res.get("upload_bytes") is not None:
             rec["upload_bytes"] = int(res["upload_bytes"])
         if res.get("edit"):
@@ -898,6 +928,10 @@ class Dispatcher:
                 cycles_run=int(res.get("cycles_run", res["cycle"])),
                 chunks_run=res.get("chunks_run"),
                 settle_chunk=res.get("settle_chunk"),
+                **({"active_fraction": float(res["active_fraction"]),
+                    "frontier_expansions": int(
+                        res.get("frontier_expansions") or 0)}
+                   if res.get("active_fraction") is not None else {}),
                 open_spans=open_spans,
                 **({"journal_replayed": int(journal_replayed)}
                    if journal_replayed is not None else {}),

@@ -1,0 +1,468 @@
+"""Region-of-interest warm solves (ISSUE 16).
+
+Layers under test:
+
+* ``dynamics/engine.py`` — the activity-gated windowed path:
+  eligibility validation, the full-window-vs-full-sweep equivalence
+  guard (all three layouts), the settled-region contract (rows never
+  activated hold the shared base fixed point), the empty-seed
+  short-circuit, ROI telemetry fields on every solve result;
+* ``dynamics/roi.py`` — ``roi_seed_filter`` edge cases (dead rows,
+  frozen rows, duplicates);
+* checkpoint/restore — the activity plane + frontier state ride the
+  PR 15 session snapshot, restore + delta-tail replay is bit-exact,
+  and an ``roi`` configuration mismatch refuses loudly;
+* fused-layout rejection — a degree-changing event against a fused
+  warm session raises a structured ``DeltaError`` naming the
+  offending event kinds and the edge/variable rows;
+* ``observability/report.py`` — the schema-minor-7
+  ``active_fraction``/``frontier_expansions`` accept/reject matrix,
+  with frozen minor-6 readers staying green;
+* ``observability/metrics.py`` + ``commands/serve_status.py`` — the
+  ``pydcop_roi_*`` registry handles and their status rendering.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dynamics import DeltaError, DynamicEngine
+from pydcop_tpu.dynamics.roi import roi_seed_filter
+from pydcop_tpu.engine.sync_engine import SyncEngine
+from pydcop_tpu.graphs.arrays import FactorGraphArrays
+
+pytestmark = [pytest.mark.dyn, pytest.mark.roi]
+
+
+# ------------------------------------------------------------ fixtures
+
+
+def chain_dcop(n=12, d=3, seed=0, edit=None):
+    """Random-integer-cost chain: tree-structured (one min-sum fixed
+    point) with integer costs (exact float sums) — the preconditions
+    of the bit-exactness guards, same recipe as tests/test_dynamics."""
+    rng = np.random.RandomState(seed)
+    dcop = DCOP("chain")
+    dom = Domain("dom", "d", list(range(d)))
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n - 1):
+        m = rng.randint(0, 10, size=(d, d))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[i + 1]], m, name=f"c{i}"))
+    if edit:
+        edit(dcop, dom)
+    return dcop
+
+
+NEW_COSTS = np.arange(9).reshape(3, 3).tolist()
+ADD_COSTS = (np.arange(9).reshape(3, 3) % 5).tolist()
+
+
+def cold_result(dcop, max_cycles=500):
+    arrays = FactorGraphArrays.build(dcop, arity_sorted=True)
+    engine = SyncEngine(MaxSumSolver(arrays))
+    return engine.run(max_cycles=max_cycles,
+                      variables=list(dcop.variables.values()))
+
+
+def mk(dcop=None, layout="fused", roi=True, **kw):
+    kw.setdefault("reserve", "vars:4,2:8")
+    kw.setdefault("max_cycles", 500)
+    return DynamicEngine(dcop if dcop is not None else chain_dcop(),
+                         layout=layout, roi=roi, **kw)
+
+
+def assert_no_bare_retrace(spans):
+    """ROI programs compile under the distinct ``roi_*`` span names;
+    the bare warm-contract names must never appear on a warm
+    dispatch, windowed or not."""
+    assert "trace_lower_s" not in spans, spans
+    assert "compile_s" not in spans, spans
+
+
+# ------------------------------------------------------- eligibility
+
+
+def test_roi_needs_engine_mode():
+    with pytest.raises(ValueError, match="roi=True needs "
+                                         "mode='engine'"):
+        DynamicEngine(chain_dcop(), mode="sharded", roi=True)
+
+
+def test_roi_needs_messages_carry():
+    with pytest.raises(ValueError, match="roi=True needs "
+                                         "carry='messages'"):
+        mk(carry="reset")
+
+
+def test_roi_threshold_must_be_positive():
+    for bad in (0, -0.5):
+        with pytest.raises(ValueError,
+                           match="roi_residual_threshold must be"):
+            mk(roi_residual_threshold=bad)
+
+
+def test_roi_rejects_higher_arity_factors():
+    dcop = chain_dcop(n=4)
+    dom = dcop.domains["dom"]
+    vs = [dcop.variables[f"v{i}"] for i in range(3)]
+    dcop.add_constraint(NAryMatrixRelation(
+        vs, np.zeros((3, 3, 3)), name="tern"))
+    with pytest.raises(ValueError, match="arity <= 2"):
+        mk(dcop, reserve=None)
+    # the same instance solves fine without the windowed path
+    eng = mk(dcop, roi=False, reserve=None)
+    assert eng.solve()["status"] == "FINISHED"
+    eng.close()
+
+
+# ------------------------------- full-window equivalence (the oracle)
+
+
+@pytest.mark.parametrize("layout",
+                         ["edge_major", "lane_major", "fused"])
+def test_full_window_equals_full_sweep(layout):
+    """Seeding EVERY live row turns the windowed program into a full
+    sweep over window coordinates: assignment and cost must match the
+    roi=False engine on the same event exactly.  Cycle counts are NOT
+    asserted — the windowed shrink changes when the stability rule
+    fires, not where the fixed point lands."""
+    event = [{"type": "change_costs", "name": "c4",
+              "costs": NEW_COSTS}]
+    roi_eng, full = mk(layout=layout), mk(layout=layout, roi=False)
+    for eng in (roi_eng, full):
+        assert eng.solve()["status"] == "FINISHED"
+        eng.apply(event)
+    n = roi_eng.instance.arrays.n_vars
+    roi_eng._roi_seed.update(range(n))
+    r = roi_eng.solve()
+    f = full.solve()
+    assert_no_bare_retrace(r["spans"])
+    assert r["assignment"] == f["assignment"]
+    assert np.isclose(r["cost"], f["cost"])
+    assert r["status"] == "FINISHED"
+    roi_eng.close()
+    full.close()
+
+
+# ---------------------------- settled-region contract + ROI telemetry
+
+
+def test_small_edit_activates_small_region_and_holds_settled_rows():
+    eng = mk(chain_dcop(n=24))
+    base = eng.solve()
+    assert base["status"] == "FINISHED"
+    # the cold base solve is a full sweep, honestly labeled
+    assert base["active_fraction"] == 1.0
+    assert base["frontier_expansions"] == 0
+    eng.apply([{"type": "change_costs", "name": "c11",
+                "costs": NEW_COSTS}])
+    warm = eng.solve()
+    assert_no_bare_retrace(warm["spans"])
+    assert warm["status"] == "FINISHED"
+    assert 0.0 < warm["active_fraction"] < 1.0
+    assert isinstance(warm["frontier_expansions"], int)
+    assert warm["frontier_expansions"] >= 0
+    # rows the window never reached hold the base fixed point
+    ever = eng._roi_ever_active
+    assert ever is not None and not ever.all()
+    for name, val in base["assignment"].items():
+        if not ever[int(name[1:])]:
+            assert warm["assignment"][name] == val, name
+    # and the windowed answer IS the cold answer on this chain
+    def editor(dcop, dom):
+        dcop.constraints["c11"]._m = np.asarray(NEW_COSTS,
+                                                dtype=np.float64)
+    cold = cold_result(chain_dcop(n=24, edit=editor))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+    eng.close()
+
+
+def test_empty_seed_short_circuits_to_zero_cycles():
+    eng = mk()
+    base = eng.solve()
+    again = eng.solve()   # warm, no pending delta: nothing can move
+    assert again["status"] == "FINISHED"
+    assert again["cycle"] == 0
+    assert again["chunks_run"] == 0
+    assert again["active_fraction"] == 0.0
+    assert again["frontier_expansions"] == 0
+    assert again["assignment"] == base["assignment"]
+    assert again["cost"] == pytest.approx(base["cost"])
+    eng.close()
+
+
+@pytest.mark.parametrize("layout", ["edge_major", "lane_major"])
+def test_degree_changing_events_on_mutable_layouts(layout):
+    """add/remove constraint+variable re-point edge rows; the edge
+    and lane layouts absorb them and the windowed re-solve matches
+    the cold oracle of the edited DCOP."""
+    eng = mk(layout=layout)
+    eng.solve()
+    eng.apply([{"type": "add_variable", "name": "v12",
+                "values": [0, 1, 2]},
+               {"type": "add_constraint", "name": "c_new",
+                "scope": ["v11", "v12"], "costs": ADD_COSTS}])
+    warm = eng.solve()
+    assert_no_bare_retrace(warm["spans"])
+
+    def edit_add(dcop, dom):
+        v = Variable("v12", dom)
+        dcop.add_variable(v)
+        dcop.add_constraint(NAryMatrixRelation(
+            [dcop.variables["v11"], v], ADD_COSTS, name="c_new"))
+    cold = cold_result(chain_dcop(edit=edit_add))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+
+    # removal: the delta touches rows that go dead — the seed filter
+    # must drop them, and the re-solve restores the base answer
+    eng.apply([{"type": "remove_constraint", "name": "c_new"},
+               {"type": "remove_variable", "name": "v12"}])
+    warm2 = eng.solve()
+    assert_no_bare_retrace(warm2["spans"])
+    cold2 = cold_result(chain_dcop())
+    assert warm2["assignment"] == cold2.assignment
+    assert warm2["cost"] == pytest.approx(cold2.cost)
+    eng.close()
+
+
+def test_duplicate_touches_dedupe_in_the_seed():
+    eng = mk()
+    eng.solve()
+    eng.apply([{"type": "change_costs", "name": "c5",
+                "costs": NEW_COSTS},
+               {"type": "change_costs", "name": "c5",
+                "costs": ADD_COSTS}])
+    warm = eng.solve()
+
+    def editor(dcop, dom):
+        dcop.constraints["c5"]._m = np.asarray(ADD_COSTS,
+                                               dtype=np.float64)
+    cold = cold_result(chain_dcop(edit=editor))
+    assert warm["assignment"] == cold.assignment
+    assert warm["cost"] == pytest.approx(cold.cost)
+    eng.close()
+
+
+# --------------------------------------------- roi_seed_filter (unit)
+
+
+def test_seed_filter_drops_dead_rows_and_dedupes():
+    live = np.array([0, 2, 5, 7], dtype=np.int64)
+    rows = np.array([5, 2, 9, 2, 3, 5], dtype=np.int64)
+    out = roi_seed_filter(rows, live)
+    assert out.tolist() == [2, 5]      # sorted unique live rows
+
+
+def test_seed_filter_excludes_frozen_rows():
+    live = np.arange(8, dtype=np.int64)
+    frozen = np.zeros(8, dtype=bool)
+    frozen[3] = True
+    out = roi_seed_filter(np.array([1, 3, 6]), live, frozen=frozen)
+    assert out.tolist() == [1, 6]
+
+
+def test_seed_filter_empty_seed():
+    assert roi_seed_filter(np.zeros(0, dtype=np.int64),
+                           np.arange(4)).size == 0
+
+
+# --------------------------------------- fused rejection (structured)
+
+
+def test_fused_rejects_degree_change_naming_kinds_and_rows():
+    eng = mk(layout="fused")
+    eng.solve()
+    with pytest.raises(DeltaError) as e:
+        eng.apply([{"type": "add_variable", "name": "v12",
+                    "values": [0, 1, 2]},
+                   {"type": "add_constraint", "name": "c_new",
+                    "scope": ["v11", "v12"], "costs": ADD_COSTS}])
+    err = e.value
+    assert err.kind == "layout"
+    assert err.details["layout"] == "fused"
+    assert "add_constraint" in err.details["event_kinds"]
+    assert len(err.details["edge_rows"]) > 0
+    assert len(err.details["var_rows"]) > 0
+    assert "add_constraint" in str(err)
+    # the rejection is transactional: cost edits still flow after it
+    eng.apply([{"type": "change_costs", "name": "c3",
+                "costs": NEW_COSTS}])
+    warm = eng.solve()
+
+    def editor(dcop, dom):
+        dcop.constraints["c3"]._m = np.asarray(NEW_COSTS,
+                                               dtype=np.float64)
+    cold = cold_result(chain_dcop(edit=editor))
+    assert warm["assignment"] == cold.assignment
+    eng.close()
+
+
+# ------------------------------------------------ checkpoint / resume
+
+
+def test_snapshot_carries_activity_plane_and_restore_replays_exact():
+    """The serve division of labor (ISSUE 15 + 16): base snapshot,
+    then a crashed session's delta tail replayed on a restored engine
+    must land on the same selections and cost per event as the
+    session that never crashed."""
+    tail = [
+        [{"type": "change_costs", "name": "c4",
+          "costs": NEW_COSTS}],
+        [{"type": "change_costs", "name": "c9",
+          "costs": ADD_COSTS}],
+    ]
+    live = mk()
+    assert live.solve()["status"] == "FINISHED"
+    snap = live.state_snapshot()
+    assert snap["roi"] is True
+    assert snap["roi_state"]["last_status"] == "FINISHED"
+    want = []
+    for ev in tail:
+        live.apply(ev)
+        want.append(live.solve())
+    restored = mk()
+    restored.restore_state(snap)
+    for ev, w in zip(tail, want):
+        restored.apply(ev)
+        r = restored.solve()
+        assert r["assignment"] == w["assignment"]
+        assert r["cost"] == pytest.approx(w["cost"])
+        assert_no_bare_retrace(r["spans"])
+        assert r["active_fraction"] < 1.0   # windowed, not fallback
+    live.close()
+    restored.close()
+
+
+def test_snapshot_mid_tail_preserves_pending_seed():
+    """A snapshot taken AFTER an apply but BEFORE its solve carries
+    the pending activity seed.  The host cost planes are NOT in the
+    snapshot (they stay the authoritative base the journal tail then
+    edits), so the restore path re-applies the delta — seeding is
+    idempotent and the windowed dispatch lands on the same answer."""
+    event = [{"type": "change_costs", "name": "c7",
+              "costs": NEW_COSTS}]
+    live = mk()
+    live.solve()
+    live.apply(event)
+    snap = live.state_snapshot()
+    assert snap["roi_state"]["seed"]
+    want = live.solve()
+    restored = mk()
+    restored.restore_state(snap)
+    assert restored._roi_seed           # the plane survived the trip
+    restored.apply(event)               # the journal replay
+    got = restored.solve()
+    assert got["assignment"] == want["assignment"]
+    assert got["cost"] == pytest.approx(want["cost"])
+    assert got["active_fraction"] < 1.0
+    live.close()
+    restored.close()
+
+
+def test_restore_refuses_roi_config_mismatch():
+    from pydcop_tpu.robustness.checkpoint import CheckpointError
+
+    live = mk()
+    live.solve()
+    snap = live.state_snapshot()
+    plain = mk(roi=False)
+    with pytest.raises(CheckpointError, match="roi"):
+        plain.restore_state(snap)
+    # and the reverse direction: a plain snapshot into an ROI engine
+    plain2 = mk(roi=False)
+    plain2.solve()
+    snap2 = plain2.state_snapshot()
+    roi_eng = mk()
+    with pytest.raises(CheckpointError, match="roi"):
+        roi_eng.restore_state(snap2)
+    for e in (live, plain, plain2, roi_eng):
+        e.close()
+
+
+# ------------------------------------- schema minor 7 (frozen readers)
+
+
+def test_roi_fields_accept_reject_matrix():
+    from pydcop_tpu.observability.report import validate_record
+
+    ok = {"record": "summary", "algo": "maxsum", "status": "FINISHED",
+          "warm_start": True}
+    validate_record({**ok, "active_fraction": 0.0,
+                     "frontier_expansions": 0})
+    validate_record({**ok, "active_fraction": 1.0,
+                     "frontier_expansions": 17})
+    validate_record(ok)   # both optional: minor-6 records unchanged
+    for bad_af in (1.5, -0.1, True, "0.3"):
+        with pytest.raises(ValueError, match="active_fraction"):
+            validate_record({**ok, "active_fraction": bad_af})
+    for bad_fx in (-1, True, 0.5):
+        with pytest.raises(ValueError, match="frontier_expansions"):
+            validate_record({**ok, "frontier_expansions": bad_fx})
+    # the serve record kind validates the same pair
+    serve = {"record": "serve", "algo": "serve", "event": "dispatch"}
+    validate_record({**serve, "active_fraction": 0.25,
+                     "frontier_expansions": 3})
+    with pytest.raises(ValueError, match="active_fraction"):
+        validate_record({**serve, "active_fraction": 2.0})
+
+
+def test_frozen_minor_6_readers_stay_green():
+    """Minor 7 is additive: a minor-6 record validates unchanged, and
+    stripping the two ROI fields from a minor-7 record yields a valid
+    minor-6 view with every shared field untouched."""
+    from pydcop_tpu.observability.report import (SCHEMA_MINOR,
+                                                 validate_record)
+
+    assert SCHEMA_MINOR >= 7
+    minor6 = {"record": "summary", "algo": "maxsum",
+              "status": "FINISHED", "schema_minor": 6,
+              "checkpoint_bytes": 1024, "warm_start": True}
+    validate_record(minor6)
+    minor7 = dict(minor6, schema_minor=7, active_fraction=0.125,
+                  frontier_expansions=2)
+    validate_record(minor7)
+    v6_view = {k: minor7[k] for k in minor6}
+    v6_view["schema_minor"] = 6
+    validate_record(v6_view)
+    assert {k: v6_view[k] for k in minor6 if k != "schema_minor"} \
+        == {k: minor6[k] for k in minor6 if k != "schema_minor"}
+
+
+# ------------------------------------------- metrics + serve-status
+
+
+def test_roi_metrics_register_and_render_in_status():
+    from pydcop_tpu.commands.serve_status import render_status
+    from pydcop_tpu.observability.metrics import roi_metrics
+    from pydcop_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    m = roi_metrics(reg)
+    # idempotent: re-registration hands back the same metrics
+    again = roi_metrics(reg)
+    assert again["active_fraction"] is m["active_fraction"]
+    assert again["frontier_expansions"] is m["frontier_expansions"]
+    m["active_fraction"].set(0.25, target="grid10")
+    m["frontier_expansions"].inc(3, target="grid10")
+    snap = reg.snapshot()
+    assert snap["gauges"]["pydcop_roi_active_fraction"] == {
+        "grid10": 0.25}
+    assert snap["counters"][
+        "pydcop_roi_frontier_expansions_total"] == {"grid10": 3}
+    out = render_status({"uptime_s": 1.0, "queue_depth": 0,
+                         "stats": {}, "metrics": snap})
+    assert "roi (active fraction | frontier expansions):" in out
+    assert "grid10" in out
+    assert "0.2500 | 3" in out
+    # without the gauges the section stays silent
+    quiet = render_status({"uptime_s": 1.0, "stats": {},
+                           "metrics": {}})
+    assert "roi (active fraction" not in quiet

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_6_and_v1_readers_stay_green():
+def test_schema_minor_is_7_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 6
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 7
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4, 5, 6):
+    for minor in (1, 2, 3, 4, 5, 6, 7):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -500,6 +500,16 @@ def test_schema_minor_is_6_and_v1_readers_stay_green():
     with pytest.raises(ValueError, match="checkpoint_bytes"):
         validate_record({"record": "summary", "algo": "m",
                          "status": "OK", "checkpoint_bytes": -1})
+    # minor-7 additive fields (region-of-interest warm solves):
+    # active_fraction/frontier_expansions validate; malformed ones
+    # reject (tests/test_roi.py covers the full matrix)
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "warm_start": True,
+                     "active_fraction": 0.03,
+                     "frontier_expansions": 2})
+    with pytest.raises(ValueError, match="active_fraction"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "active_fraction": 1.5})
 
 
 # ----------------------------------------- reporter lifecycle (ops)
