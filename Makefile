@@ -2,7 +2,8 @@
 # cli + api tiers).  Tests force the CPU backend with a virtual
 # 8-device mesh (tests/conftest.py).
 
-.PHONY: test test-fast bench suite lint typecheck chaos bench-roi
+.PHONY: test test-fast bench suite lint typecheck chaos bench-roi \
+	bench-portfolio
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +26,16 @@ chaos:
 bench-roi:
 	python -m pytest tests/ -q -m "roi"
 	python benchmarks/suite.py bench_roi --quick
+
+# the arm-race tier: the portfolio test marker plus the bench_portfolio
+# contract — an 8-arm race on one loopy grid instance, asserting the
+# winner matches the best solo arm, the race wall stays under 2x one
+# arm (full mode), early kills reclaim >=50% of the naive 8x
+# lane-cycles, and a mid-race kill -9 + --resume reproduces the
+# uninterrupted winner bit-exactly
+bench-portfolio:
+	python -m pytest tests/ -q -m "portfolio"
+	python benchmarks/suite.py bench_portfolio --quick
 
 bench:
 	python bench.py

@@ -150,6 +150,19 @@ def set_parser(subparsers):
                              "per-instance cube constants), so maxsum "
                              "jobs take the subprocess path — the "
                              "fallback is announced, never silent")
+    parser.add_argument("--portfolio", default=None, metavar="SPEC",
+                        help="campaign-level solver-portfolio races "
+                             "for every engine-mode solve job (solve "
+                             "--portfolio): 'auto' or an arm grid, "
+                             "e.g. 'maxsum;maxsum,damping:0.9;dsa,"
+                             "variant:A'.  Each job races the arms "
+                             "over ITS instance and records the "
+                             "winner; jobs already carrying a "
+                             "portfolio option keep their own grid.  "
+                             "Races dispatch their own one-instance x "
+                             "N-arm vmapped program, so these jobs "
+                             "take the subprocess path (announced, "
+                             "never silent)")
     parser.add_argument("--max_rung_mb", type=float, default=None,
                         help="cap the padded per-instance memory a "
                              "--fuse-hetero consolidation rung may "
@@ -364,7 +377,8 @@ def _job_has_bnb(conf) -> bool:
     return False
 
 
-def _fuse_exclusion_reason(meta, campaign_bnb=False) -> Optional[str]:
+def _fuse_exclusion_reason(meta, campaign_bnb=False,
+                           campaign_portfolio=False) -> Optional[str]:
     """Why a job cannot take the fused data plane, or None when it
     can.  Surfaced by ``run_cmd`` (one log line per excluded group):
     a per-job ``timeout``, a non-engine mode or an algo without a
@@ -381,6 +395,12 @@ def _fuse_exclusion_reason(meta, campaign_bnb=False) -> Optional[str]:
     mode = conf.get("mode", "engine")
     if mode != "engine":
         return f"mode '{mode}' is not engine"
+    if conf.get("portfolio") or campaign_portfolio:
+        # an arm race is its own one-instance x N-arm vmapped
+        # program; the fused path vmaps instances through ONE config
+        return ("portfolio arm races dispatch their own vmapped "
+                "program (one instance x N arms) and cannot ride "
+                "the multi-instance fused path")
     extra = sorted(set(conf) - _FUSE_CONF_KEYS)
     if extra:
         keys = ", ".join(f"'{k}'" for k in extra)
@@ -396,10 +416,12 @@ def _fuse_exclusion_reason(meta, campaign_bnb=False) -> Optional[str]:
     return None
 
 
-def _fuse_group_key(meta, campaign_bnb=False) -> Optional[Tuple]:
+def _fuse_group_key(meta, campaign_bnb=False,
+                    campaign_portfolio=False) -> Optional[Tuple]:
     conf = meta["conf"]
     algo = conf.get("algo")
-    if _fuse_exclusion_reason(meta, campaign_bnb) is not None:
+    if _fuse_exclusion_reason(meta, campaign_bnb,
+                              campaign_portfolio) is not None:
         return None
     ap = conf.get("algo_params", [])
     ap = tuple(sorted(ap if isinstance(ap, list) else [ap]))
@@ -886,6 +908,16 @@ def run_cmd(args, timeout=None):
     # fail the campaign up front on a malformed --decimation instead
     # of letting every job die on it
     parse_decimation_flag(getattr(args, "decimation", None))
+    if getattr(args, "portfolio", None):
+        # same rule for the arm-grid grammar: every arm names its
+        # family explicitly, so the spec validates without a base algo
+        from ..parallel.portfolio import (PortfolioSpecError,
+                                          parse_portfolio_spec)
+
+        try:
+            parse_portfolio_spec(args.portfolio)
+        except PortfolioSpecError as e:
+            raise CliError(str(e))
     if os.environ.get(_PRECISION_ENV):
         # fail the campaign up front on a malformed environment value
         # instead of letting every fused child / solve job die on it
@@ -933,13 +965,16 @@ def run_cmd(args, timeout=None):
     if getattr(args, "fuse", True):
         fallbacks: Dict[Tuple, int] = {}
         campaign_bnb = bool(getattr(args, "bnb", False))
+        campaign_portfolio = bool(getattr(args, "portfolio", None))
         for job_id, _argv, meta in todo:
-            fkey = _fuse_group_key(meta, campaign_bnb)
+            fkey = _fuse_group_key(meta, campaign_bnb,
+                                   campaign_portfolio)
             if fkey is not None:
                 fused_groups.setdefault(fkey, []).append(
                     (job_id, meta["path"], meta["iteration"]))
             else:
-                reason = _fuse_exclusion_reason(meta, campaign_bnb)
+                reason = _fuse_exclusion_reason(meta, campaign_bnb,
+                                                campaign_portfolio)
                 k = (reason, meta["conf"].get("algo"),
                      meta["conf"].get("mode", "engine"))
                 fallbacks[k] = fallbacks.get(k, 0) + 1
@@ -1068,6 +1103,15 @@ def run_cmd(args, timeout=None):
             if getattr(args, "bnb", False) and not any(
                     str(p).strip().startswith("bnb:") for p in ap):
                 argv += ["--bnb"]
+        if getattr(args, "portfolio", None) \
+                and _meta["command"] == "solve" \
+                and conf.get("mode", "engine") == "engine" \
+                and conf.get("algo") in FUSABLE_ALGOS \
+                and not conf.get("portfolio"):
+            # campaign-level arm races for subprocess solve jobs; a
+            # job's own portfolio option wins (solve --portfolio
+            # requires engine mode and a racing-capable base algo)
+            argv += ["--portfolio", args.portfolio]
         t0 = time.perf_counter()
         failure = None
         try:

@@ -156,7 +156,8 @@ def set_parser(subparsers):
                              "fixed keeps constant chunk_size "
                              "chunks.  Identical selections and "
                              "cycles either way")
-    parser.add_argument("--roi", action="store_true",
+    parser.add_argument("--roi", nargs="?", const=True,
+                        default=False, metavar="auto",
                         help="region-of-interest warm re-solves for "
                              "delta sessions: each delta's solve "
                              "sweeps only the activity window seeded "
@@ -165,6 +166,10 @@ def set_parser(subparsers):
                              "while boundary residuals stay hot — "
                              "delta cost scales with the "
                              "perturbation, not instance size.  "
+                             "'--roi auto' starts windowed and "
+                             "permanently falls back to full sweeps "
+                             "for a session whose deltas keep "
+                             "touching most of the instance.  "
                              "Dispatch records carry "
                              "active_fraction / frontier_expansions "
                              "(also Prometheus gauges, see "
@@ -279,6 +284,10 @@ def run_cmd(args, timeout=None):
         raise CliError("--max-batch must be >= 1")
     if args.max_delay_ms < 0:
         raise CliError("--max-delay-ms must be >= 0")
+    roi = getattr(args, "roi", False)
+    if isinstance(roi, str) and roi != "auto":
+        raise CliError(
+            f"--roi takes no value or 'auto', got {roi!r}")
     heartbeat_s = getattr(args, "heartbeat_s", None)
     if heartbeat_s is not None and heartbeat_s <= 0:
         raise CliError("--heartbeat-s must be > 0")
@@ -390,7 +399,7 @@ def run_cmd(args, timeout=None):
             session_layout=getattr(args, "layout", "edge_major"),
             warm_budget=getattr(args, "warm_budget", "adaptive"),
             checkpoints=checkpoints,
-            session_roi=getattr(args, "roi", False),
+            session_roi=roi,
             roi_residual_threshold=getattr(
                 args, "roi_residual_threshold", None))
         loop = ServeLoop(admission, dispatcher, reporter=reporter,

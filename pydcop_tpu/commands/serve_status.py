@@ -175,6 +175,26 @@ def render_status(snap: dict) -> str:
                 f"    {target or '<all>':<24} "
                 f"{'n/a' if af is None else f'{af:.4f}'} | "
                 f"{int(fx)}")
+    pf_started = (metrics.get("counters") or {}).get(
+        "pydcop_portfolio_arms_started_total", {})
+    pf_killed = (metrics.get("counters") or {}).get(
+        "pydcop_portfolio_arms_killed_total", {})
+    pf_margin = (metrics.get("gauges") or {}).get(
+        "pydcop_portfolio_win_margin", {})
+    if pf_started or pf_killed or pf_margin:
+        # arm-race telemetry (portfolio jobs), per base algorithm:
+        # started minus killed is the work early-kill reclaimed; a
+        # near-zero win margin says the grid's arms are near-ties
+        lines.append(
+            "  portfolio (arms started / killed | last win margin):")
+        for algo in sorted(set(pf_started) | set(pf_killed)
+                           | set(pf_margin)):
+            wm = pf_margin.get(algo)
+            lines.append(
+                f"    {algo or '<all>':<24} "
+                f"{int(pf_started.get(algo, 0))} / "
+                f"{int(pf_killed.get(algo, 0))} | "
+                f"{'n/a' if wm is None else f'{wm:.6g}'}")
     hists = metrics.get("histograms", {})
     stage = hists.get("pydcop_serve_stage_seconds", {})
     if stage:

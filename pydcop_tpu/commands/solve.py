@@ -171,7 +171,8 @@ def set_parser(subparsers):
                              "constraint add/remove); lane_major is "
                              "the TPU-tile layout and speaks every "
                              "event type")
-    parser.add_argument("--roi", action="store_true",
+    parser.add_argument("--roi", nargs="?", const=True,
+                        default=False, metavar="auto",
                         help="--scenario region-of-interest warm "
                              "re-solves: each event's solve sweeps "
                              "only an activity window seeded from "
@@ -184,7 +185,15 @@ def set_parser(subparsers):
                              "point bit-exactly.  Needs mode "
                              "engine, carry messages; telemetry "
                              "records carry active_fraction / "
-                             "frontier_expansions")
+                             "frontier_expansions.  '--roi auto' "
+                             "adds the escape hatch: when the "
+                             "active fraction trends toward 1 over "
+                             "a sliding window of events (edits "
+                             "touching the whole graph), the "
+                             "session permanently flips to full "
+                             "sweeps and stops paying window "
+                             "overhead; the flip lands in telemetry "
+                             "as roi_flipped")
     parser.add_argument("--roi-residual-threshold",
                         dest="roi_residual_threshold", type=float,
                         default=None, metavar="EPS",
@@ -247,6 +256,55 @@ def set_parser(subparsers):
                              "selections AND convergence cycles) stay "
                              "bit-exact with the full scan.  "
                              "Equivalent to -p bnb:1")
+    parser.add_argument("--portfolio", type=str, default=None,
+                        metavar="SPEC",
+                        help="race N solver arms over this instance "
+                             "as vmapped lanes and keep the winner "
+                             "(parallel/portfolio.py).  SPEC is "
+                             "'auto' (the built-in 8-arm preset) or "
+                             "a ';'-separated arm grid — each arm "
+                             "'family[,name:value...]' with seed:N / "
+                             "seeds:N specials; arms of the -a "
+                             "family inherit the -p params as their "
+                             "baseline.  Losing arms are killed "
+                             "early at chunk boundaries (see the "
+                             "--portfolio-* knobs) and their lanes "
+                             "become no-ops; survivors rebatch down "
+                             "the pow2 ladder.  The result reports "
+                             "the winning arm, per-arm best costs "
+                             "and cycles survived; --checkpoint/"
+                             "--resume make long races "
+                             "preemption-safe (the survivor set "
+                             "snapshots at boundaries and a resumed "
+                             "race reproduces the uninterrupted "
+                             "winner bit-exactly)")
+    parser.add_argument("--portfolio-every", dest="portfolio_every",
+                        type=int, default=32, metavar="N",
+                        help="--portfolio scoring cadence in cycles: "
+                             "every arm is scored (and the kill rule "
+                             "applied) each N cycles, at the chunked "
+                             "drive's existing host sync.  Default "
+                             "32")
+    parser.add_argument("--portfolio-margin",
+                        dest="portfolio_margin", type=float,
+                        default=0.05, metavar="F",
+                        help="--portfolio kill rule: an arm is "
+                             "'trailing' when its best cost sits "
+                             "more than this relative fraction "
+                             "behind the leader's (violations "
+                             "compare first).  Default 0.05")
+    parser.add_argument("--portfolio-patience",
+                        dest="portfolio_patience", type=int,
+                        default=3, metavar="K",
+                        help="--portfolio kill rule: kill an arm "
+                             "after K consecutive trailing "
+                             "boundaries.  Default 3")
+    parser.add_argument("--portfolio-plateau",
+                        dest="portfolio_plateau", type=int,
+                        default=6, metavar="K",
+                        help="--portfolio kill rule: kill an arm "
+                             "whose own best has not improved for K "
+                             "consecutive boundaries.  Default 6")
     parser.set_defaults(func=run_cmd)
     return parser
 
@@ -393,6 +451,14 @@ def run_cmd(args, timeout: Optional[float] = None):
             f"precision:{args.precision}"]
     decim = parse_decimation_flag(getattr(args, "decimation", None))
     bnb_flag = bool(getattr(args, "bnb", False))
+    roi = getattr(args, "roi", False)
+    if isinstance(roi, str) and roi != "auto":
+        raise CliError(
+            f"--roi takes no value (window every event) or 'auto' "
+            f"(flip to full sweeps when the active fraction trends "
+            f"toward 1), got {roi!r}")
+    if getattr(args, "portfolio", None):
+        return _run_portfolio(args, t0, timeout, decim, bnb_flag)
     if args.mode != "sharded":
         # same sugar rule as --precision: the flags become the
         # algorithm parameters, so algorithms without them (dsa, dpop,
@@ -624,6 +690,142 @@ def run_cmd(args, timeout: Optional[float] = None):
     return 0
 
 
+def _build_portfolio_checkpointer(args, race, precision_name):
+    """The race's checkpointer from ``--checkpoint DIR``: named by
+    instance × canonical arm grid × base seed, fingerprinted by the
+    program identity PLUS the arm-grid hash and kill-rule knobs
+    (``PortfolioRace.fingerprint_extra``) — a resume under a drifted
+    grid or referee refuses with a structured mismatch."""
+    directory = getattr(args, "checkpoint", None)
+    if not directory:
+        if getattr(args, "resume", False):
+            raise CliError(
+                "--resume restores a --checkpoint snapshot: give "
+                "the checkpoint directory too")
+        return None
+    every = getattr(args, "checkpoint_every", 256)
+    if every < 1:
+        raise CliError("--checkpoint-every must be >= 1 cycles")
+    from ..parallel.portfolio import canonical_spec
+    from ..robustness.checkpoint import (CheckpointStore,
+                                         SolveCheckpointer,
+                                         checkpoint_fingerprint,
+                                         env_preempt_hook,
+                                         portfolio_checkpoint_name)
+
+    try:
+        preempt_after, on_preempt = env_preempt_hook()
+        store = CheckpointStore(directory)
+    except (OSError, ValueError) as e:
+        raise CliError(str(e))
+    fingerprint = checkpoint_fingerprint(
+        precision=precision_name or "f32", algo="portfolio")
+    fingerprint.update(race.fingerprint_extra())
+    return SolveCheckpointer(
+        store,
+        portfolio_checkpoint_name(args.dcop_files,
+                                  canonical_spec(race.arms),
+                                  args.seed),
+        every=every, fingerprint=fingerprint,
+        preempt_after=preempt_after, on_preempt=on_preempt)
+
+
+def _run_portfolio(args, t0: float, timeout, decim,
+                   bnb_flag: bool) -> int:
+    """``solve --portfolio``: race arm configurations over one
+    instance as vmapped lanes, early-kill losers at chunk boundaries,
+    keep the winner (``parallel/portfolio.py``)."""
+    from . import parse_algo_params
+    from ..parallel.portfolio import (PortfolioRace,
+                                      PortfolioSpecError,
+                                      parse_portfolio_spec)
+    from ..robustness.checkpoint import CheckpointError
+
+    if args.mode != "engine":
+        raise CliError(
+            "--portfolio races vmapped arm lanes through the "
+            "compiled batch runners: mode engine only, not "
+            f"{args.mode!r}")
+    if getattr(args, "scenario", None):
+        raise CliError(
+            "--portfolio races ONE static instance; a --scenario "
+            "warm replay keeps its single configured engine")
+    if bnb_flag:
+        raise CliError(
+            "--portfolio arms run through batched runners, which "
+            "reject bnb (per-instance pruning plans cannot ride a "
+            "vmapped arm lane)")
+    precision_name = _resolved_precision_name(args)
+    base_params = parse_algo_params(args.algo_params)
+    if decim:
+        # the --decimation flag becomes the maxsum arms' baseline
+        # schedule, same sugar rule as the plain solve path
+        base_params.setdefault("decimation_p", str(decim[0]))
+        base_params.setdefault("decimation_every", str(decim[1]))
+    dcop = load_dcop_from_file(args.dcop_files)
+    try:
+        arms = parse_portfolio_spec(
+            args.portfolio, base_algo=args.algo,
+            base_params=base_params, base_seed=args.seed,
+            mode=dcop.objective)
+        race = PortfolioRace(
+            dcop, arms, max_cycles=args.max_cycles,
+            every=getattr(args, "portfolio_every", 32),
+            margin=getattr(args, "portfolio_margin", 0.05),
+            patience=getattr(args, "portfolio_patience", 3),
+            plateau=getattr(args, "portfolio_plateau", 6),
+            precision=precision_name)
+    except (PortfolioSpecError, ValueError) as e:
+        raise CliError(str(e))
+    checkpointer = _build_portfolio_checkpointer(args, race,
+                                                 precision_name)
+    try:
+        result = race.run(checkpointer=checkpointer,
+                          resume=getattr(args, "resume", False),
+                          timeout=timeout)
+    except CheckpointError as e:
+        raise CliError(str(e))
+    if result["assignment"] and \
+            set(result["assignment"]) == set(dcop.variables):
+        # the headline cost/violation follow the CLI's --infinity
+        # semantics exactly like the plain solve path; the per-arm
+        # bests in the portfolio block stay the device evaluator's
+        cost, violations = dcop.solution_cost(
+            result["assignment"], infinity=args.infinity)
+        result["cost"], result["violation"] = cost, violations
+    result["time"] = time.perf_counter() - t0
+    result["msg_count"] = 0
+    result["msg_size"] = 0
+    if precision_name:
+        result["precision"] = precision_name
+    if checkpointer is not None:
+        result.update(checkpointer.telemetry())
+    telemetry_path = getattr(args, "telemetry", None)
+    if telemetry_path:
+        from ..observability.report import RunReporter
+
+        with RunReporter(telemetry_path, algo=args.algo,
+                         mode="portfolio") as reporter:
+            reporter.header(
+                dcop=getattr(dcop, "name", None), seed=args.seed,
+                max_cycles=args.max_cycles,
+                precision=precision_name,
+                portfolio=result["portfolio"]["spec"])
+            summary = {k: result[k] for k in
+                       ("status", "cost", "violation", "cycle",
+                        "time", "msg_count", "msg_size",
+                        "portfolio")}
+            for k in ("checkpoint_s", "checkpoint_bytes",
+                      "resumed_from_cycle"):
+                if k in result:
+                    summary[k] = result[k]
+            reporter.summary(**summary)
+    if args.end_metrics:
+        _append_end_metrics(args.end_metrics, result)
+    output_json(result, args.output)
+    return 0
+
+
 def _run_scenario(args, dcop, t0: float, timeout,
                   precision_name: Optional[str]) -> int:
     """``solve --scenario``: the warm dynamic-DCOP replay.  The
@@ -724,6 +926,7 @@ def _run_scenario(args, dcop, t0: float, timeout,
             "layout": engine.layout,
             "warm_budget": engine.warm_budget,
             "roi": engine.roi,
+            "roi_mode": engine.roi_mode,
             "reserve": getattr(args, "reserve_slots", None),
             "budget": replay["budget"],
             "initial": _scenario_event_summary(replay["initial"]),
@@ -751,7 +954,8 @@ def _scenario_event_summary(e: dict) -> dict:
                              "warm_start", "spans", "upload_bytes",
                              "chunks_run", "settle_chunk",
                              "active_fraction",
-                             "frontier_expansions")
+                             "frontier_expansions",
+                             "roi_mode", "roi_flipped")
            if k in e}
     for k in ("event", "edit"):
         if e.get(k) is not None:

@@ -67,7 +67,7 @@ the chunked step arithmetic is boundary-invariant (the PR 2 guard).
 """
 
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -262,6 +262,13 @@ class DynamicEngine:
         self._key = tuple(sorted(
             (k, str(v)) for k, v in params.items()))
         # ---- region-of-interest warm solves (ISSUE 16) ----
+        if roi not in (False, True, "auto"):
+            raise ValueError(
+                f"roi must be False, True or 'auto', got {roi!r}")
+        #: 'off' / 'on' / 'auto' — echoed as ``roi_mode`` on every
+        #: ROI-session result (schema minor 8)
+        self.roi_mode = ("auto" if roi == "auto"
+                         else "on" if roi else "off")
         self.roi = bool(roi)
         if roi_residual_threshold is not None:
             roi_residual_threshold = float(roi_residual_threshold)
@@ -308,6 +315,22 @@ class DynamicEngine:
         self._roi_ever_active = None
         self._roi_live_cache = None
         self._roi_expansions_total = 0
+        #: roi='auto' fallback state: the sliding window of the last
+        #: few WINDOWED solves' active fractions.  When a full window
+        #: covers most of the instance every time, the session flips
+        #: permanently to full sweeps — at high coverage the windowed
+        #: program's gather/scatter overhead is pure loss, and a
+        #: session whose deltas keep touching everything will not
+        #: shrink back.  Honest fallback sweeps (cold start, unsettled
+        #: carry) never enter the window: their 1.0 says nothing about
+        #: delta locality
+        self._roi_auto_window: List[float] = []
+        self._roi_auto_flipped = False
+
+    #: roi='auto' flip rule: every one of the last ROI_AUTO_WINDOW
+    #: windowed solves swept >= ROI_AUTO_THRESHOLD of live variables
+    ROI_AUTO_WINDOW = 4
+    ROI_AUTO_THRESHOLD = 0.5
 
     # ----------------------------------------------------------- info
 
@@ -613,7 +636,7 @@ class DynamicEngine:
         snap = {"state": tree_to_host(self._state),
                 "solves": int(self.solves),
                 "layout": self.layout, "carry": self.carry,
-                "roi": bool(self.roi)}
+                "roi": bool(self.roi), "roi_mode": self.roi_mode}
         if self.roi:
             # the activity plane + frontier state (ISSUE 16): enough
             # for a restored session to resume the windowed path
@@ -631,6 +654,8 @@ class DynamicEngine:
                 "active": (
                     np.flatnonzero(self._roi_last_active).tolist()
                     if self._roi_last_active is not None else None),
+                "auto_window": list(self._roi_auto_window),
+                "auto_flipped": bool(self._roi_auto_flipped),
             }
         return snap
 
@@ -655,6 +680,12 @@ class DynamicEngine:
         if bool(snapshot.get("roi", False)) != self.roi:
             mismatched["roi"] = (bool(snapshot.get("roi", False)),
                                  self.roi)
+        # pre-minor-8 snapshots carry no roi_mode: infer it from the
+        # roi flag so old checkpoints restore into matching sessions
+        snap_mode = snapshot.get(
+            "roi_mode", "on" if snapshot.get("roi") else "off")
+        if snap_mode != self.roi_mode:
+            mismatched["roi_mode"] = (snap_mode, self.roi_mode)
         if mismatched:
             diff = ", ".join(f"{k}: saved={s!r} current={c!r}"
                              for k, (s, c) in sorted(
@@ -679,6 +710,10 @@ class DynamicEngine:
             self._roi_last_status = rs.get("last_status")
             self._roi_expansions_total = int(
                 rs.get("expansions_total", 0))
+            self._roi_auto_window = [
+                float(f) for f in rs.get("auto_window", [])]
+            self._roi_auto_flipped = bool(
+                rs.get("auto_flipped", False))
             act = rs.get("active")
             if act is not None:
                 plane = np.zeros(self.instance.arrays.n_vars,
@@ -1004,6 +1039,17 @@ class DynamicEngine:
         if not self.roi:
             return self._solve_engine_full(budget, seed, timeout,
                                            warm)
+        if self._roi_auto_flipped:
+            # a flipped roi='auto' session is a full-sweep session
+            # for good; labels stay honest so telemetry shows why a
+            # --roi daemon stopped windowing this session
+            out = self._solve_engine_full(budget, seed, timeout,
+                                          warm)
+            out["active_fraction"] = 1.0
+            out["frontier_expansions"] = 0
+            out["roi_mode"] = self.roi_mode
+            self._roi_last_status = out["status"]
+            return out
         # ROI dispatch: a warm solve over a settled carry runs the
         # windowed program over the activity region; anything else
         # (cold start, a previous solve that never FINISHED — the
@@ -1030,8 +1076,29 @@ class DynamicEngine:
             out["active_fraction"] = 1.0
             out["frontier_expansions"] = 0
             self._roi_ever_active = None
+        out["roi_mode"] = self.roi_mode
+        if self.roi_mode == "auto" and windowed:
+            self._roi_auto_note(out)
         self._roi_last_status = out["status"]
         return out
+
+    def _roi_auto_note(self, out: Dict[str, Any]) -> None:
+        """Fold one windowed solve's coverage into the roi='auto'
+        window and fire the permanent flip when it fills with
+        high-coverage sweeps; the flip solve itself carries
+        ``roi_flipped: true`` so operators can find the moment in the
+        telemetry."""
+        af = out.get("active_fraction")
+        if af is None:
+            return
+        self._roi_auto_window.append(float(af))
+        if len(self._roi_auto_window) > self.ROI_AUTO_WINDOW:
+            del self._roi_auto_window[0]
+        if (len(self._roi_auto_window) >= self.ROI_AUTO_WINDOW
+                and all(f >= self.ROI_AUTO_THRESHOLD
+                        for f in self._roi_auto_window)):
+            self._roi_auto_flipped = True
+            out["roi_flipped"] = True
 
     def _solve_engine_full(self, budget: int, seed: int,
                            timeout: Optional[float],

@@ -63,7 +63,8 @@ def replay_scenario(engine: DynamicEngine, scenario: Scenario,
                             "warm_start", "spans", "upload_bytes",
                             "layout", "cycles_run", "chunks_run",
                             "active_fraction",
-                            "frontier_expansions")
+                            "frontier_expansions",
+                            "roi_mode", "roi_flipped")
                    and v is not None}
             # settle_chunk's documented encoding: explicit null =
             # the budget ran out before the stability rule fired;

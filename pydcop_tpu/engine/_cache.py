@@ -11,10 +11,13 @@ Two layers, both keyed to survive process restarts:
 * :class:`ExecutableCache` — whole ``jax.stages`` executables,
   serialized with ``jax.experimental.serialize_executable`` and keyed
   by an explicit logical identity (rung signature × algorithm ×
-  precision policy for the serving data plane) plus the argument aval
-  signature.  Where the XLA cache still pays a full Python trace +
-  lowering on every cold start, a hit here is ONE deserialize: the
-  difference between a demo and a `serve` daemon restart.
+  precision policy for the serving data plane; portfolio arm groups
+  key on the arm signature — instance identity × family × non-seed
+  hyperparams, ``parallel/batch.runner_for_arm_group``) plus the
+  argument aval signature.  Where the XLA cache still pays a full
+  Python trace + lowering on every cold start, a hit here is ONE
+  deserialize: the difference between a demo and a `serve` daemon
+  restart.
 
 Opt-out of both with ``PYDCOP_TPU_NO_CACHE=1``; relocate with
 ``PYDCOP_TPU_CACHE_DIR``.  Failure to set a cache up (read-only

@@ -74,6 +74,32 @@ def roi_metrics(registry):
     }
 
 
+def portfolio_metrics(registry):
+    """Serving-registry handles for the arm-race telemetry (ISSUE 17):
+    lifetime arm launch/kill counters and the last race's win margin,
+    labeled by the job's base algorithm.  ``arms_started - arms_killed``
+    read together tell an operator how much work early-kill reclaims;
+    ``win_margin`` near zero means the grid's arms are near-ties and
+    the portfolio buys little over a single solve.  Idempotent like
+    :func:`roi_metrics`, and surfaced by ``serve-status``."""
+    return {
+        "arms_started": registry.counter(
+            "pydcop_portfolio_arms_started_total",
+            "solver arms launched by portfolio dispatches",
+            labels=("algo",)),
+        "arms_killed": registry.counter(
+            "pydcop_portfolio_arms_killed_total",
+            "solver arms early-killed by the race referee "
+            "(trailing-beyond-margin or plateau)",
+            labels=("algo",)),
+        "win_margin": registry.gauge(
+            "pydcop_portfolio_win_margin",
+            "score gap between the last race's winner and its "
+            "second-best arm (objective units)",
+            labels=("algo",)),
+    }
+
+
 def alloc_metric_planes(n_cycles: int) -> Dict[str, Any]:
     """Preallocated per-cycle planes, NaN / ``-1`` marking never-written
     rows.  Row ``i`` describes cycle ``i + 1`` (the post-increment

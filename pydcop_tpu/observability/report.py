@@ -102,11 +102,23 @@ SCHEMA_VERSION = 1
 #: ``active_fraction`` (mean fraction of live variables inside the
 #: windowed sweep, in [0, 1]; 1.0 = full sweep, 0.0 = short-circuit)
 #: and ``frontier_expansions`` (chunk-boundary neighborhood hops the
-#: residual gate granted this dispatch).  A v1.0-1.6 reader stays
-#: green by the one documented forward-compat rule: consumers filter
-#: the stream by the record kinds (and fields) they speak and ignore
-#: the rest.
-SCHEMA_MINOR = 7
+#: residual gate granted this dispatch).
+#: Minor 8 (solver portfolios, ISSUE 17) added the ``portfolio``
+#: block on summary and serve records — the arm-race result: the arm
+#: grid (``spec``), the kill-rule knobs (``every``/``margin``/
+#: ``patience``/``plateau``), ``winner``, ``win_margin`` (the
+#: lexicographic score gap to the best non-winning arm; null when
+#: unmeasurable), per-arm rows (``arm``/``best_cost``/
+#: ``best_violation``/``cycles``/``status``/``kill_reason``) and the
+#: race counters (``arms_started``/``arms_killed``/``boundaries``/
+#: ``groups``/``rebatches``) — plus the ``roi_mode`` echo
+#: (``off``/``on``/``auto``) and the ``roi_flipped`` bool on dynamic
+#: dispatch records (the roi=auto escape hatch fired: this and every
+#: later event runs full sweeps).  A v1.0-1.7 reader stays green by
+#: the one documented forward-compat rule: consumers filter the
+#: stream by the record kinds (and fields) they speak and ignore the
+#: rest.
+SCHEMA_MINOR = 8
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -127,6 +139,17 @@ EDIT_KEYS = ("add_variable", "remove_variable", "add_constraint",
 FAULT_ACTIONS = ("retry", "bisect", "poisoned", "circuit_open",
                  "breaker_open", "breaker_probe", "breaker_close",
                  "preempt")
+
+#: per-arm lifecycle vocabulary of the ``portfolio`` block (schema
+#: minor 8) — mirrors ``ops.arm_race.ARM_STATUSES``/``KILL_REASONS``
+#: (asserted equal in the schema tests; duplicated here like
+#: EDIT_KEYS so the validator stays import-light)
+PORTFOLIO_ARM_STATUSES = ("winner", "finished", "killed", "budget")
+PORTFOLIO_KILL_REASONS = ("trailing", "plateau")
+
+#: the ``roi_mode`` echo vocabulary (schema minor 8): the session's
+#: region-of-interest policy as RESOLVED by the dynamic engine
+ROI_MODES = ("off", "on", "auto")
 
 
 class RunReporter:
@@ -342,6 +365,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_budget_fields(rec, "summary")
         _check_ckpt_fields(rec, "summary")
         _check_roi_fields(rec, "summary")
+        _check_portfolio_fields(rec, "summary")
         rc = rec.get("reason_class")
         if rc is not None and (not isinstance(rc, str) or not rc):
             raise ValueError(
@@ -367,6 +391,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_budget_fields(rec, "serve")
         _check_ckpt_fields(rec, "serve")
         _check_roi_fields(rec, "serve")
+        _check_portfolio_fields(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -474,6 +499,128 @@ def _check_roi_fields(rec, kind):
                            or not isinstance(fx, int) or fx < 0):
         raise ValueError(
             f"{kind} record with bad frontier_expansions {fx!r}")
+
+
+#: the ``portfolio`` block's legal top-level keys (schema minor 8)
+_PORTFOLIO_KEYS = ("spec", "every", "margin", "patience", "plateau",
+                   "groups", "rebatches", "winner", "win_margin",
+                   "arms", "arms_started", "arms_killed",
+                   "boundaries")
+
+#: one arm row's legal keys
+_PORTFOLIO_ARM_KEYS = ("arm", "best_cost", "best_violation",
+                       "cycles", "status", "kill_reason")
+
+
+def _check_portfolio_fields(rec, kind):
+    """Optional schema-minor-8 fields: the solver-portfolio result
+    block plus the ``roi_mode``/``roi_flipped`` echoes.  Exhaustive
+    like the ``fault``/``retry`` validators — unknown keys are a
+    schema violation, so the emitter and the documented vocabulary
+    cannot drift."""
+    rm = rec.get("roi_mode")
+    if rm is not None and rm not in ROI_MODES:
+        raise ValueError(
+            f"{kind} record with unknown roi_mode {rm!r}; known: "
+            f"{', '.join(ROI_MODES)}")
+    rf = rec.get("roi_flipped")
+    if rf is not None and not isinstance(rf, bool):
+        raise ValueError(
+            f"{kind} record with bad roi_flipped {rf!r}")
+    block = rec.get("portfolio")
+    if block is None:
+        return
+    if kind == "serve":
+        # serve dispatch events carry the group's canonical grid SPEC
+        # (a string); the full result block rides each job's summary
+        if not isinstance(block, str) or not block:
+            raise ValueError(
+                "serve 'portfolio' must be the non-empty canonical "
+                f"spec string, got {block!r}")
+        return
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"{kind} 'portfolio' must be a dict, got "
+            f"{type(block).__name__}")
+    unknown = sorted(set(block) - set(_PORTFOLIO_KEYS))
+    if unknown:
+        raise ValueError(
+            f"portfolio block with unknown field(s): "
+            f"{', '.join(unknown)}")
+    winner = block.get("winner")
+    if not isinstance(winner, str) or not winner:
+        raise ValueError(f"portfolio with bad winner {winner!r}")
+    wm = block.get("win_margin")
+    if wm is not None and (isinstance(wm, bool)
+                           or not isinstance(wm, (int, float))
+                           or wm < 0):
+        raise ValueError(f"portfolio with bad win_margin {wm!r}")
+    for field in ("every", "patience", "plateau", "groups",
+                  "rebatches", "arms_started", "arms_killed",
+                  "boundaries"):
+        v = block.get(field)
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, int) or v < 0):
+            raise ValueError(
+                f"portfolio with bad {field} {v!r}")
+    margin = block.get("margin")
+    if margin is not None and (isinstance(margin, bool)
+                               or not isinstance(margin, (int, float))
+                               or margin < 0):
+        raise ValueError(f"portfolio with bad margin {margin!r}")
+    arms = block.get("arms")
+    if arms is None:
+        return
+    if not isinstance(arms, list) or not arms:
+        raise ValueError(
+            "portfolio 'arms' must be a non-empty list of arm rows")
+    for row in arms:
+        if not isinstance(row, dict):
+            raise ValueError(
+                f"portfolio arm row must be a dict, got "
+                f"{type(row).__name__}")
+        unknown = sorted(set(row) - set(_PORTFOLIO_ARM_KEYS))
+        if unknown:
+            raise ValueError(
+                f"portfolio arm row with unknown field(s): "
+                f"{', '.join(unknown)}")
+        name = row.get("arm")
+        if not isinstance(name, str) or not name:
+            raise ValueError(
+                f"portfolio arm row with bad arm {name!r}")
+        status = row.get("status")
+        if status not in PORTFOLIO_ARM_STATUSES:
+            raise ValueError(
+                f"portfolio arm {name!r} with unknown status "
+                f"{status!r}; known: "
+                f"{', '.join(PORTFOLIO_ARM_STATUSES)}")
+        reason = row.get("kill_reason")
+        if reason is not None and reason not in \
+                PORTFOLIO_KILL_REASONS:
+            raise ValueError(
+                f"portfolio arm {name!r} with unknown kill_reason "
+                f"{reason!r}; known: "
+                f"{', '.join(PORTFOLIO_KILL_REASONS)}")
+        if (status == "killed") != (reason is not None):
+            raise ValueError(
+                f"portfolio arm {name!r}: kill_reason must be "
+                f"present exactly when status is 'killed'")
+        bc = row.get("best_cost")
+        if bc is not None and (isinstance(bc, bool)
+                               or not isinstance(bc, (int, float))):
+            raise ValueError(
+                f"portfolio arm {name!r} with bad best_cost {bc!r}")
+        bv = row.get("best_violation")
+        if bv is not None and (isinstance(bv, bool)
+                               or not isinstance(bv, int) or bv < 0):
+            raise ValueError(
+                f"portfolio arm {name!r} with bad best_violation "
+                f"{bv!r}")
+        cyc = row.get("cycles")
+        if isinstance(cyc, bool) or not isinstance(cyc, int) \
+                or cyc < 0:
+            raise ValueError(
+                f"portfolio arm {name!r} with bad cycles {cyc!r}")
 
 
 def _check_fault(fault):

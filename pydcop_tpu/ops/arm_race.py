@@ -1,0 +1,248 @@
+"""Arm scoring and early-kill rules for solver portfolios (ISSUE 17).
+
+A portfolio race runs N solver *arms* (seed x family x hyperparams)
+over ONE instance as vmapped lanes and scores every arm at each chunk
+boundary — the same two-scalar host sync the chunked drive already
+pays, so racing adds zero extra round-trips.  This module is the
+HOST-side referee: pure numpy, deterministic, and independent of the
+device programs, so the kill rule can be unit-tested on a fake scorer
+without ever building a runner.
+
+Ranking is lexicographic ``(violations, objective-adjusted cost)`` —
+the exact best-restart rule ``solve_sharded_result`` applies — and the
+kill decision is a function of nothing but the per-boundary score
+history:
+
+* ``trailing`` — the arm's best-so-far has trailed the leader's by
+  more than ``margin`` (a relative cost fraction) for ``patience``
+  consecutive boundaries;
+* ``plateau`` — the arm's own best has not improved for ``plateau``
+  consecutive boundaries (the residual-plateau signal: a stuck arm
+  stops paying for its lanes even when it happens to sit near the
+  leader).
+
+The leader is never killed, arms that FINISHED on their own terms are
+never killed (their lanes are already no-ops), and ties break toward
+the lowest arm index — determinism is the contract the checkpoint
+resume path (bit-exact replay of the race) is built on.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: kill reasons, in the order tests and telemetry enumerate them
+KILL_REASONS = ("trailing", "plateau")
+
+#: arm lifecycle states reported in the ``portfolio`` result block
+ARM_STATUSES = ("winner", "finished", "killed", "budget")
+
+
+def new_race(n_arms: int, minimize: bool = True) -> Dict[str, Any]:
+    """Fresh host race state for ``n_arms`` arms.  Plain numpy arrays
+    plus scalars — the whole dict rides the survivor-set checkpoint
+    verbatim (``tree_to_host`` has nothing to do)."""
+    if n_arms < 1:
+        raise ValueError(f"a race needs >= 1 arm, got {n_arms}")
+    n = int(n_arms)
+    return {
+        "minimize": bool(minimize),
+        "boundaries": 0,
+        "best_cost": np.full(n, np.inf, dtype=np.float64),
+        "best_viol": np.full(n, np.iinfo(np.int64).max,
+                             dtype=np.int64),
+        "best_cycle": np.zeros(n, dtype=np.int64),
+        "cycles": np.zeros(n, dtype=np.int64),
+        "trail": np.zeros(n, dtype=np.int64),
+        "stale": np.zeros(n, dtype=np.int64),
+        "alive": np.ones(n, dtype=bool),
+        "finished": np.zeros(n, dtype=bool),
+        "killed_at": np.full(n, -1, dtype=np.int64),
+        # fixed-width reason codes ('' = not killed) keep the array
+        # checkpoint-serializable without object dtype
+        "kill_reason": np.zeros(n, dtype="U16"),
+    }
+
+
+def _score_key(viol: np.ndarray, cost: np.ndarray,
+               minimize: bool) -> np.ndarray:
+    """Per-arm sortable cost in MINIMIZATION orientation: violations
+    dominate, cost breaks ties (negated for max objectives)."""
+    return np.where(np.isfinite(cost),
+                    cost if minimize else -cost, np.inf)
+
+
+def leader_index(race: Dict[str, Any]) -> int:
+    """The current leader: best ``(violations, cost)`` among arms that
+    have ever been scored, alive arms preferred, lowest index on
+    ties.  Deterministic by construction (stable argmin)."""
+    viol = race["best_viol"]
+    cost = _score_key(race["best_viol"], race["best_cost"],
+                      race["minimize"])
+    # alive-or-finished arms outrank killed ones at equal score: the
+    # winner must be an arm whose result is actually being carried
+    dead_penalty = (~(race["alive"] | race["finished"])).astype(
+        np.int64)
+    order = np.lexsort((np.arange(len(viol)), cost, viol,
+                        dead_penalty))
+    return int(order[0])
+
+
+def race_update(race: Dict[str, Any],
+                costs: Sequence[float],
+                viols: Sequence[int],
+                cycles: Sequence[int],
+                finished: Sequence[bool],
+                margin: float = 0.05,
+                patience: int = 3,
+                plateau: int = 6) -> Dict[str, Any]:
+    """Fold one chunk boundary's scores into the race and decide
+    kills.  Mutates ``race`` in place and returns a summary::
+
+        {"killed": [arm indices killed THIS boundary],
+         "leader": leader arm index,
+         "live": count of arms still racing}
+
+    ``costs``/``viols`` are the vmapped evaluator's per-arm outputs
+    (model-space cost, conflicted-constraint count); entries for dead
+    arms are ignored.  ``finished`` marks arms whose own stability
+    rule fired — they stop being kill candidates but keep their best.
+    """
+    n = len(race["alive"])
+    costs = np.asarray(costs, dtype=np.float64)
+    viols = np.asarray(viols, dtype=np.int64)
+    cycles = np.asarray(cycles, dtype=np.int64)
+    finished = np.asarray(finished, dtype=bool)
+    if not (len(costs) == len(viols) == len(cycles)
+            == len(finished) == n):
+        raise ValueError(
+            f"race_update got {len(costs)} scores for {n} arms")
+    race["boundaries"] += 1
+    racing = race["alive"]
+    key_now = _score_key(viols, costs, race["minimize"])
+    key_best = _score_key(race["best_viol"], race["best_cost"],
+                          race["minimize"])
+    improved = racing & ((viols < race["best_viol"])
+                         | ((viols == race["best_viol"])
+                            & (key_now < key_best)))
+    race["best_cost"] = np.where(improved, costs, race["best_cost"])
+    race["best_viol"] = np.where(improved, viols, race["best_viol"])
+    race["best_cycle"] = np.where(improved, cycles,
+                                  race["best_cycle"])
+    race["cycles"] = np.where(racing, cycles, race["cycles"])
+    race["stale"] = np.where(racing & ~improved, race["stale"] + 1, 0)
+    race["finished"] |= racing & finished
+
+    lead = leader_index(race)
+    lead_viol = race["best_viol"][lead]
+    lead_cost = race["best_cost"][lead]
+    lead_key = _score_key(np.asarray([lead_viol]),
+                          np.asarray([lead_cost]),
+                          race["minimize"])[0]
+    # relative margin anchored at the leader's |cost| (floor 1.0 so a
+    # zero-cost leader still grants an absolute band)
+    band = float(margin) * max(1.0, abs(float(lead_key))
+                               if np.isfinite(lead_key) else 1.0)
+    key_best = _score_key(race["best_viol"], race["best_cost"],
+                          race["minimize"])
+    trailing = racing & ((race["best_viol"] > lead_viol)
+                         | ((race["best_viol"] == lead_viol)
+                            & (key_best > lead_key + band)))
+    race["trail"] = np.where(trailing, race["trail"] + 1, 0)
+
+    candidates = racing & ~race["finished"]
+    candidates[lead] = False
+    kill_trail = candidates & (race["trail"] >= int(patience))
+    kill_stale = candidates & (race["stale"] >= int(plateau))
+    kill = kill_trail | kill_stale
+    killed = np.flatnonzero(kill)
+    for i in killed:
+        race["alive"][i] = False
+        race["killed_at"][i] = race["boundaries"]
+        race["kill_reason"][i] = ("trailing" if kill_trail[i]
+                                  else "plateau")
+    # finished arms leave the racing set too (their lanes are no-ops
+    # already; `alive` tracks lanes still worth paying for)
+    race["alive"] &= ~race["finished"]
+    return {"killed": [int(i) for i in killed],
+            "leader": lead,
+            "live": int(race["alive"].sum())}
+
+
+def race_summary(race: Dict[str, Any],
+                 labels: Optional[Sequence[str]] = None
+                 ) -> Dict[str, Any]:
+    """The ``portfolio`` telemetry block's per-arm view: winner, per-
+    arm best cost / violations / survived cycles, and kill reasons.
+    ``labels`` names the arms (defaults to ``arm<i>``)."""
+    n = len(race["alive"])
+    labels = list(labels) if labels is not None \
+        else [f"arm{i}" for i in range(n)]
+    win = leader_index(race)
+    arms = []
+    for i in range(n):
+        if i == win:
+            status = "winner"
+        elif race["kill_reason"][i]:
+            status = "killed"
+        elif race["finished"][i]:
+            status = "finished"
+        else:
+            status = "budget"
+        cost = race["best_cost"][i]
+        arms.append({
+            "arm": labels[i],
+            "best_cost": float(cost) if np.isfinite(cost) else None,
+            "best_violation": (int(race["best_viol"][i])
+                               if np.isfinite(cost) else None),
+            "cycles": int(race["cycles"][i]),
+            "status": status,
+            "kill_reason": str(race["kill_reason"][i]) or None,
+        })
+    second = None
+    if n > 1:
+        keys = _score_key(race["best_viol"], race["best_cost"],
+                          race["minimize"])
+        others = [(race["best_viol"][i], keys[i]) for i in range(n)
+                  if i != win and np.isfinite(keys[i])]
+        if others:
+            second = min(others)
+    win_key = _score_key(race["best_viol"][win:win + 1],
+                         race["best_cost"][win:win + 1],
+                         race["minimize"])[0]
+    win_margin = None
+    if second is not None and np.isfinite(win_key):
+        win_margin = float(second[1] - win_key)
+    return {
+        "winner": labels[win],
+        "winner_index": win,
+        "win_margin": win_margin,
+        "arms": arms,
+        "arms_started": n,
+        "arms_killed": int((race["kill_reason"] != "").sum()),
+        "boundaries": int(race["boundaries"]),
+    }
+
+
+def race_to_host(race: Dict[str, Any]) -> Dict[str, Any]:
+    """Checkpoint encoding: numpy arrays -> plain lists (the snapshot
+    pickles fine either way; lists keep the payload backend-neutral
+    and diffable in tests)."""
+    out = {}
+    for k, v in race.items():
+        out[k] = v.tolist() if isinstance(v, np.ndarray) else v
+    return out
+
+
+def race_from_host(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`race_to_host`; restores exact dtypes so a
+    resumed race's kill decisions are bit-identical."""
+    fresh = new_race(len(payload["alive"]),
+                     minimize=payload.get("minimize", True))
+    race = {"minimize": bool(payload.get("minimize", True)),
+            "boundaries": int(payload["boundaries"])}
+    for k, proto in fresh.items():
+        if k in race:
+            continue
+        race[k] = np.asarray(payload[k], dtype=proto.dtype)
+    return race

@@ -203,8 +203,12 @@ def solve_sharded(dcop, algo: str, n_cycles: int = 100,
 from .sharded_breakout import (ShardedDba, ShardedGdba,  # noqa: E402
                                ShardedMixedDsa)
 from .sharded_mgm2 import ShardedMgm2  # noqa: E402
+from .portfolio import (Arm, PortfolioRace,  # noqa: E402
+                        PortfolioSpecError, parse_portfolio_spec)
 
-__all__ = ["BatchedDsa", "BatchedMaxSum", "BatchedMgm",
-           "ShardedAMaxSum", "ShardedDba", "ShardedGdba",
-           "ShardedMaxSum", "ShardedMgm2", "ShardedMixedDsa",
-           "make_mesh", "solve_sharded", "solve_sharded_result"]
+__all__ = ["Arm", "BatchedDsa", "BatchedMaxSum", "BatchedMgm",
+           "PortfolioRace", "PortfolioSpecError", "ShardedAMaxSum",
+           "ShardedDba", "ShardedGdba", "ShardedMaxSum",
+           "ShardedMgm2", "ShardedMixedDsa", "make_mesh",
+           "parse_portfolio_spec", "solve_sharded",
+           "solve_sharded_result"]

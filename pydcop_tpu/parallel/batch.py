@@ -871,3 +871,51 @@ def runner_for_rung(algo: str, instances, params: dict,
             _RUNNER_CACHE_STATS["evictions"] += 1
         _RUNNER_CACHE[key] = runner
     return runner
+
+
+def runner_for_arm_group(algo: str, template, batch: int,
+                         params: dict,
+                         group_signature: Optional[Tuple] = None,
+                         exec_cache=None):
+    """The portfolio flip of :func:`runner_for_rung`: ONE instance
+    broadcast across ``batch`` arm lanes (same family + hyperparams,
+    per-lane seeds).  The broadcast constructor path makes the cubes
+    views of one buffer, so an arm group costs one instance's device
+    memory regardless of lane count, and the vmapped chunk programs
+    trace once per (group, batch) — the rebatch ladder's rungs.
+
+    ``group_signature`` must carry a stable INSTANCE identity (the
+    serve queue passes its ``(path, mtime_ns, size)`` key): unlike the
+    rung-padded hetero path, the broadcast cubes bake this instance's
+    contents into the cached runner, so caching without that identity
+    would hand another instance's program to the caller.  Without a
+    signature the runner is built fresh and never cached."""
+    cls = BATCHED_CLASSES[algo]
+    key = None
+    if group_signature is not None:
+        key = (algo, ("arm",) + tuple(group_signature), int(batch),
+               tuple(sorted(params.items())))
+        runner = _RUNNER_CACHE.get(key)
+        if runner is not None:
+            _RUNNER_CACHE_STATS["hits"] += 1
+            if exec_cache is not None:
+                runner.exec_cache = exec_cache
+                runner.exec_cache_key = key
+            return runner
+        _RUNNER_CACHE_STATS["misses"] += 1
+    runner = cls(template, batch=int(batch), **params)
+    # the broadcast path leaves per-lane true sizes unset (it serves
+    # one instance); every lane decodes to the template's true width
+    runner.n_vars_true = [getattr(template, "n_vars_true", None)
+                          or template.n_vars] * int(batch)
+    if exec_cache is not None:
+        runner.exec_cache = exec_cache
+        runner.exec_cache_key = key if key is not None else (
+            algo, "arm", int(batch), tuple(sorted(params.items())))
+    if key is not None:
+        cap = runner_cache_cap()
+        while len(_RUNNER_CACHE) >= cap:
+            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
+            _RUNNER_CACHE_STATS["evictions"] += 1
+        _RUNNER_CACHE[key] = runner
+    return runner

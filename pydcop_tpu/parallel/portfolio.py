@@ -1,0 +1,699 @@
+"""On-device solver portfolios: race arm configs, keep the winner.
+
+The fused batch runners (``parallel/batch.py``) vmap many INSTANCES
+through one solver config.  A portfolio flips that axis: ONE instance
+rides every lane, and the lanes differ by solver *arm* — seed, family
+(maxsum / dsa / mgm), damping, decimation schedule, DSA variant...
+No single config dominates (the DSA vs decimated-MaxSum conflict-rate
+gaps in bench_decimation are the motivating measurement), so the
+principled answer is to race them and keep the winner.
+
+Mechanics, all reused from machinery already proven bit-exact:
+
+* Arms sharing a trace signature (family + every non-seed hyperparam)
+  become ONE vmapped broadcast-batched runner — the instance cubes are
+  broadcast across the lanes, per-arm RNG comes from per-lane PRNG
+  keys (``_batch_keys``; dsa/mgm per-variable draws are pad-stable via
+  ``ops.kernels.prefix_uniform``).  Arm hyperparameters that differ
+  only by SEED are program arguments, so an arm set never retraces;
+  arms with different hyperparams group into separate programs
+  (hyperparams are trace constants of the compiled step — damping
+  folds into the message recurrence, decimation changes the carry).
+* The race advances in compiled chunks through the checkpointed drive
+  triple (``_ckpt_programs``: init / chunk-to-traced-limit / decode).
+  At each chunk boundary — the existing two-scalar host sync, zero
+  extra round-trips — every arm is scored by the vmapped
+  ``assignment_cost_violations`` evaluator and the host referee
+  (``ops/arm_race.py``) kills losing arms: trailing the leader beyond
+  a margin for ``patience`` consecutive boundaries, or a best-cost
+  plateau for ``plateau`` boundaries.
+* A killed arm's lanes become masked no-op lanes inside the compiled
+  chunk (``finished |= dead`` — the while-loop cond already skips
+  finished lanes, the decimation freeze-plane trick applied to whole
+  lanes), and when the live count halves the survivors REBATCH down
+  the pow2 rung ladder (``runner_for_arm_group``): state sliced by
+  ``tree_map``, a fresh smaller runner whose compile is that rung's
+  first dispatch.
+* The survivor set rides the PR 15 checkpoint: at every boundary the
+  group states + referee state + per-arm best selections snapshot
+  through :class:`~pydcop_tpu.robustness.checkpoint.SolveCheckpointer`
+  (atomic write, fingerprint manifest carrying the ARM-GRID hash so a
+  drifted resume refuses), and a ``kill -9`` + ``--resume`` reproduces
+  the uninterrupted race bit-exactly — scoring and kills are pure
+  functions of the restored state.
+
+Spec grammar (``solve --portfolio``, ``batch --portfolio``, the serve
+``portfolio`` job field)::
+
+    auto                                  # the built-in 8-arm preset
+    "maxsum;maxsum,damping:0.9;dsa,variant:A,seeds:2"
+
+Arms are ``;``-separated; each arm is ``family[,name:value...]`` with
+two special keys: ``seed:N`` pins the arm's engine seed and
+``seeds:N`` expands the arm into N replicas seeded ``base..base+N-1``.
+``layout`` and ``bnb`` are rejected loudly (layouts are warm-engine
+program identity, bnb plans are per-instance trace constants — neither
+can ride a vmapped arm lane).
+"""
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.arm_race import (new_race, race_from_host, race_summary,
+                            race_to_host, race_update)
+
+#: families with a vmapped batched solver — the only legal arm families
+#: (mirrors serving/schema.SERVABLE_ALGOS; asserted equal in tests)
+PORTFOLIO_FAMILIES = ("maxsum", "dsa", "mgm")
+
+#: the ``auto`` preset: one spread across the family x schedule space
+#: the decimation/DSA benches showed no single point of dominating —
+#: two damping points, a decimated arm, the DSA variants, MGM, and a
+#: second seed on the default maxsum arm
+AUTO_SPEC = ("maxsum;"
+             "maxsum,seed:1;"
+             "maxsum,damping:0.9;"
+             "maxsum,decimation_p:0.05,decimation_every:8;"
+             "dsa,variant:A;"
+             "dsa,variant:B;"
+             "dsa,variant:C;"
+             "mgm")
+
+#: arm-parameter keys that can never ride a vmapped lane, with the
+#: reason given on rejection (never a silent downgrade)
+_REJECTED_ARM_PARAMS = {
+    "layout": "layouts are warm-engine program identity, not a "
+              "batched-arm parameter (every arm lane runs the "
+              "canonical edge-major step)",
+    "bnb": "bnb pruned-reduction plans are build-time constants of "
+           "one instance's cubes and cannot ride a vmapped arm lane",
+    "stop_cycle": "stop_cycle is an engine-level knob; give the race "
+                  "one budget via max_cycles",
+}
+
+_PORTFOLIO_DEFAULTS = {"every": 32, "margin": 0.05, "patience": 3,
+                       "plateau": 6}
+
+
+class PortfolioSpecError(ValueError):
+    """A malformed ``--portfolio`` spec; raised at parse time (CLI
+    startup / serve admission), never mid-race."""
+
+
+@dataclass(frozen=True)
+class Arm:
+    """One racing configuration: a solver family, an engine seed and
+    the family's (typed, validated) hyperparameters."""
+
+    algo: str
+    seed: int
+    params: Tuple[Tuple[str, Any], ...] = ()
+
+    @property
+    def params_dict(self) -> Dict[str, Any]:
+        return dict(self.params)
+
+    @property
+    def label(self) -> str:
+        """Stable human-readable arm name used in telemetry and the
+        result block: ``maxsum[damping:0.9,s3]``."""
+        inner = ",".join(f"{k}:{v}" for k, v in self.params)
+        inner = f"{inner},s{self.seed}" if inner else f"s{self.seed}"
+        return f"{self.algo}[{inner}]"
+
+    @property
+    def group_key(self) -> Tuple:
+        """The trace-signature part of the arm: everything but the
+        seed.  Arms sharing it run as lanes of ONE vmapped program."""
+        return (self.algo, self.params)
+
+
+def parse_portfolio_spec(spec: str,
+                         base_algo: Optional[str] = None,
+                         base_params: Optional[Dict[str, Any]] = None,
+                         base_seed: int = 0,
+                         mode: str = "min") -> List[Arm]:
+    """Spec string -> validated arm list (see the module docstring for
+    the grammar).  ``base_params`` seed the params of arms whose family
+    matches ``base_algo`` (the solve CLI's ``-a``/``-p`` become the
+    baseline every same-family arm inherits); an arm's own ``k:v``
+    wins.  Values are cast and validated through the family's own
+    ``AlgoParameterDef`` table, so a typoed arm parameter dies here
+    with the algorithm's error message, never inside a compiled race.
+    """
+    from ..algorithms import AlgoParameterException, AlgorithmDef
+
+    text = (spec or "").strip()
+    if not text:
+        raise PortfolioSpecError("empty --portfolio spec")
+    if text == "auto":
+        text = AUTO_SPEC
+    arms: List[Arm] = []
+    for ai, chunk in enumerate(text.split(";")):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = [p.strip() for p in chunk.split(",") if p.strip()]
+        algo = parts[0]
+        if algo not in PORTFOLIO_FAMILIES:
+            raise PortfolioSpecError(
+                f"arm {ai} ({chunk!r}): family {algo!r} has no "
+                f"vmapped batch solver; portfolio families: "
+                f"{', '.join(PORTFOLIO_FAMILIES)}")
+        raw: Dict[str, str] = {}
+        if base_params and algo == base_algo:
+            for k, v in base_params.items():
+                if k == "seed":
+                    continue  # the race owns per-arm seeding
+                if k in _REJECTED_ARM_PARAMS:
+                    raise PortfolioSpecError(
+                        f"base -p param {k}: "
+                        f"{_REJECTED_ARM_PARAMS[k]}")
+                raw[k] = str(v)
+        seed: Optional[int] = None
+        replicas = 1
+        for p in parts[1:]:
+            k, sep, v = p.partition(":")
+            k, v = k.strip(), v.strip()
+            if not sep or not k or not v:
+                raise PortfolioSpecError(
+                    f"arm {ai} ({chunk!r}): parameter {p!r} is not "
+                    f"'name:value'")
+            if k in _REJECTED_ARM_PARAMS:
+                raise PortfolioSpecError(
+                    f"arm {ai} ({chunk!r}): {k}: "
+                    f"{_REJECTED_ARM_PARAMS[k]}")
+            if k == "seed":
+                seed = _spec_int(ai, chunk, k, v)
+            elif k == "seeds":
+                replicas = _spec_int(ai, chunk, k, v)
+                if replicas < 1:
+                    raise PortfolioSpecError(
+                        f"arm {ai} ({chunk!r}): seeds wants a "
+                        f"positive replica count, got {v!r}")
+            else:
+                raw[k] = v
+        try:
+            algo_def = AlgorithmDef.build_with_default_param(
+                algo, params=dict(raw), mode=mode)
+        except AlgoParameterException as e:
+            raise PortfolioSpecError(
+                f"arm {ai} ({chunk!r}): {e}")
+        params = tuple(sorted(
+            (k, algo_def.params[k]) for k in raw))
+        if seed is not None and replicas > 1:
+            raise PortfolioSpecError(
+                f"arm {ai} ({chunk!r}): seed: and seeds: are "
+                f"mutually exclusive (seeds expands replicas from "
+                f"the base seed)")
+        if seed is not None:
+            arms.append(Arm(algo, int(seed), params))
+        else:
+            for r in range(replicas):
+                arms.append(Arm(algo, int(base_seed) + r, params))
+    if not arms:
+        raise PortfolioSpecError(f"spec {spec!r} defines no arms")
+    labels = [a.label for a in arms]
+    dupes = sorted({x for x in labels if labels.count(x) > 1})
+    if dupes:
+        raise PortfolioSpecError(
+            f"duplicate arm(s) {', '.join(dupes)}: identical "
+            f"family+params+seed lanes would race byte-identical "
+            f"programs")
+    return arms
+
+
+def _spec_int(ai, chunk, k, v) -> int:
+    try:
+        return int(v)
+    except ValueError:
+        raise PortfolioSpecError(
+            f"arm {ai} ({chunk!r}): {k} wants an integer, got {v!r}")
+
+
+def canonical_spec(arms: Sequence[Arm]) -> str:
+    """The normalized spec string: arm labels joined by ``;`` — the
+    form that feeds serve group keys and checkpoint fingerprints, so
+    two spellings of the same grid share identity."""
+    return ";".join(a.label for a in arms)
+
+
+def spec_fingerprint(arms: Sequence[Arm]) -> str:
+    """Short stable hash of the arm grid for checkpoint manifests."""
+    return hashlib.sha256(
+        canonical_spec(arms).encode()).hexdigest()[:16]
+
+
+# ----------------------------------------------------------- the race
+
+
+@dataclass
+class _Group:
+    """One arm group's racing machinery: the broadcast-batched runner,
+    its compiled drive triple, the vmapped carry, and the lane -> arm
+    map (``-1`` marks pow2 padding lanes, finished from birth)."""
+
+    algo: str
+    params: Dict[str, Any]
+    arm_idx: List[int]
+    runner: Any = None
+    programs: Tuple = ()
+    state: Any = None
+    lane_arms: List[int] = field(default_factory=list)
+    rebatches: int = 0
+
+    @property
+    def batch(self) -> int:
+        return len(self.lane_arms)
+
+
+class PortfolioRace:
+    """Race ``arms`` over one DCOP instance; :meth:`run` returns a
+    solve-shaped result dict plus the ``portfolio`` telemetry block.
+
+    ``every`` is the scoring/kill cadence in cycles (each boundary is
+    one compiled chunk per group), ``margin``/``patience``/``plateau``
+    parameterize the kill rule (``ops/arm_race.py``).  ``precision``
+    is the race-level default policy; an arm's own ``precision:``
+    param wins.  ``exec_cache`` + ``instance_key`` (a stable identity
+    of the instance file) let repeated races over the same instance —
+    the serve admission shape — reuse runners and serialized
+    evaluators across dispatches."""
+
+    def __init__(self, dcop, arms: Sequence[Arm],
+                 max_cycles: int = 2000,
+                 every: int = _PORTFOLIO_DEFAULTS["every"],
+                 margin: float = _PORTFOLIO_DEFAULTS["margin"],
+                 patience: int = _PORTFOLIO_DEFAULTS["patience"],
+                 plateau: int = _PORTFOLIO_DEFAULTS["plateau"],
+                 precision: Optional[str] = None,
+                 exec_cache=None,
+                 instance_key: Optional[Tuple] = None):
+        if not arms:
+            raise PortfolioSpecError("a portfolio needs >= 1 arm")
+        if every < 1:
+            raise ValueError(f"--portfolio-every must be >= 1, "
+                             f"got {every}")
+        if patience < 1 or plateau < 1:
+            raise ValueError("portfolio patience/plateau must be "
+                             ">= 1")
+        if margin < 0:
+            raise ValueError(f"portfolio margin must be >= 0, "
+                             f"got {margin}")
+        self.dcop = dcop
+        self.arms = list(arms)
+        self.max_cycles = int(max_cycles)
+        self.every = int(every)
+        self.margin = float(margin)
+        self.patience = int(patience)
+        self.plateau = int(plateau)
+        self.precision = precision
+        self.exec_cache = exec_cache
+        self.instance_key = instance_key
+        self.minimize = getattr(dcop, "objective", "min") != "max"
+        #: per-family template arrays, built once per (family,
+        #: precision) the grid actually uses
+        self._templates: Dict[Tuple, Any] = {}
+        #: filled by run(): the boundary-by-boundary race event log
+        #: (kills, rebatches) for observability consumers
+        self.events: List[Dict[str, Any]] = []
+        self.last_spans: Dict[str, float] = {}
+
+    # ------------------------------------------------------ templates
+
+    def _template_for(self, algo: str,
+                      params: Dict[str, Any]):
+        from ..dcop.dcop import filter_dcop
+        from ..graphs.arrays import (FactorGraphArrays,
+                                     HypergraphArrays)
+
+        precision = params.get("precision") or self.precision
+        family = "factor" if algo == "maxsum" else "hyper"
+        key = (family, precision)
+        arrays = self._templates.get(key)
+        if arrays is None:
+            if family == "factor":
+                arrays = FactorGraphArrays.build(
+                    self.dcop, arity_sorted=True,
+                    precision=precision)
+            else:
+                arrays = HypergraphArrays.build(
+                    filter_dcop(self.dcop), precision=precision)
+            self._templates[key] = arrays
+        return arrays
+
+    # --------------------------------------------------------- groups
+
+    def _build_groups(self) -> List[_Group]:
+        """Arms grouped by trace signature, in first-appearance order
+        (deterministic: the group list and lane order are part of the
+        race's replayable identity)."""
+        order: List[Tuple] = []
+        by_key: Dict[Tuple, List[int]] = {}
+        for i, arm in enumerate(self.arms):
+            k = arm.group_key
+            if k not in by_key:
+                by_key[k] = []
+                order.append(k)
+            by_key[k].append(i)
+        groups = []
+        for k in order:
+            algo, params_t = k
+            params = dict(params_t)
+            if self.precision and "precision" not in params:
+                params["precision"] = self.precision
+            groups.append(_Group(algo=algo, params=params,
+                                 arm_idx=list(by_key[k])))
+        return groups
+
+    def _group_signature(self, group: _Group) -> Optional[Tuple]:
+        """Cross-race runner/executable cache identity for one group:
+        instance identity x family x params x arm-grid-free.  None
+        without an ``instance_key`` (the compiled programs close over
+        this instance's index tables, so caching without a stable
+        instance identity would serve another instance's program)."""
+        if self.instance_key is None:
+            return None
+        return (("portfolio",) + tuple(self.instance_key),
+                group.algo, tuple(sorted(
+                    (k, str(v)) for k, v in group.params.items())))
+
+    def _open_group(self, group: _Group, lane_arms: List[int],
+                    init_keys=None):
+        """(Re)build one group's runner at ``len(lane_arms)`` lanes
+        (already pow2-padded; ``-1`` = padding) and compile/fetch its
+        drive triple.  ``init_keys`` seeds fresh lanes; omit it when
+        the caller will install a restored/sliced state instead."""
+        from .batch import runner_for_arm_group
+
+        template = self._template_for(group.algo, group.params)
+        runner = runner_for_arm_group(
+            group.algo, template, len(lane_arms), group.params,
+            group_signature=self._group_signature(group),
+            exec_cache=self.exec_cache)
+        group.runner = runner
+        group.programs = runner._ckpt_programs()
+        group.lane_arms = list(lane_arms)
+        if init_keys is not None:
+            init_all = group.programs[0]
+            group.state = init_all(runner._instance_args, init_keys)
+            pad = np.asarray([a < 0 for a in lane_arms], dtype=bool)
+            if pad.any():
+                group.state = self._mask_finished(group.state, pad)
+
+    @staticmethod
+    def _mask_finished(state, mask: np.ndarray):
+        """Freeze lanes: ``finished |= mask`` makes them no-op lanes
+        of the compiled chunk (its while-loop cond already skips
+        finished lanes) — the decimation freeze-plane mechanics
+        applied to whole lanes."""
+        import jax.numpy as jnp
+
+        fin = jnp.logical_or(state["finished"],
+                             jnp.asarray(mask))
+        return dict(state, finished=fin)
+
+    # ----------------------------------------------------------- run
+
+    def run(self, checkpointer=None, resume: bool = False,
+            timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Run the race to a winner.  With ``checkpointer`` the
+        survivor set snapshots at chunk boundaries and ``resume``
+        restores the newest snapshot (arm-grid fingerprint checked by
+        the manifest) and continues — reproducing the uninterrupted
+        race bit-exactly."""
+        import jax.numpy as jnp
+
+        from .batch import _batch_keys
+        from .bucketing import next_pow2
+
+        t0 = time.perf_counter()
+        race = new_race(len(self.arms), minimize=self.minimize)
+        best_sel: List[Optional[np.ndarray]] = \
+            [None] * len(self.arms)
+        groups = self._build_groups()
+        self.events = []
+        boundary = 0
+
+        restored = None
+        if resume and checkpointer is not None:
+            restored = checkpointer.load(template=None)
+        if restored is not None:
+            boundary, race, best_sel = self._restore(
+                groups, restored)
+        else:
+            for g in groups:
+                b = next_pow2(len(g.arm_idx))
+                lane_arms = list(g.arm_idx) + [-1] * (b - len(
+                    g.arm_idx))
+                seeds = [self.arms[a].seed if a >= 0
+                         else self.arms[lane_arms[0]].seed
+                         for a in lane_arms]
+                self._open_group(g, lane_arms,
+                                 init_keys=_batch_keys(0, seeds, b))
+
+        status = None
+        while boundary < self.max_cycles and race["alive"].any():
+            if timeout is not None and \
+                    time.perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+            limit = min(boundary + self.every, self.max_cycles)
+            for g in groups:
+                if not any(self._arm_live(race, a)
+                           for a in g.lane_arms):
+                    continue
+                chunk_all = g.programs[1]
+                g.state = chunk_all(g.runner._instance_args, g.state,
+                                    jnp.int32(limit))
+            boundary = limit
+            self._score_boundary(groups, race, best_sel, boundary)
+            if checkpointer is not None:
+                done = (boundary >= self.max_cycles
+                        or not race["alive"].any())
+                payload = self._snapshot(groups, race, best_sel,
+                                         boundary)
+                checkpointer.maybe_save(boundary, lambda: payload,
+                                        final=done)
+            self._rebatch(groups, race, next_pow2)
+
+        summary = race_summary(race,
+                               labels=[a.label for a in self.arms])
+        win = summary["winner_index"]
+        if status is None:
+            status = ("FINISHED" if race["finished"][win]
+                      else "MAX_CYCLES")
+        result = self._result(win, best_sel, race, summary, status,
+                              time.perf_counter() - t0, groups)
+        return result
+
+    @staticmethod
+    def _arm_live(race, arm: int) -> bool:
+        return arm >= 0 and bool(race["alive"][arm])
+
+    def _score_boundary(self, groups: List[_Group], race,
+                        best_sel: List, boundary: int) -> None:
+        """One boundary's scoring + kill pass: decode and evaluate
+        every group's lanes (ONE vmapped evaluator call per group),
+        fold the per-arm scores into the referee, then freeze the
+        lanes of arms it killed."""
+        n = len(self.arms)
+        costs = np.full(n, np.nan)
+        viols = np.zeros(n, dtype=np.int64)
+        cycles = np.zeros(n, dtype=np.int64)
+        finished = np.zeros(n, dtype=bool)
+        sels: List[Optional[np.ndarray]] = [None] * n
+        for g in groups:
+            if not any(a >= 0 for a in g.lane_arms):
+                continue
+            decode_all = g.programs[2]
+            sel = np.asarray(decode_all(g.runner._instance_args,
+                                        g.state))
+            cost_g, viol_g = g.runner.evaluate(sel)
+            cyc = np.asarray(g.state["cycle"])
+            fin = np.asarray(g.state["finished"])
+            for lane, arm in enumerate(g.lane_arms):
+                if arm < 0 or not race["alive"][arm]:
+                    continue
+                costs[arm] = cost_g[lane]
+                viols[arm] = viol_g[lane]
+                cycles[arm] = cyc[lane]
+                finished[arm] = fin[lane]
+                sels[arm] = sel[lane]
+        scored = ~np.isnan(costs)
+        costs = np.where(scored, costs, np.inf)
+        prev_best_viol = race["best_viol"].copy()
+        prev_best_cost = race["best_cost"].copy()
+        update = race_update(race, costs, viols, cycles, finished,
+                             margin=self.margin,
+                             patience=self.patience,
+                             plateau=self.plateau)
+        improved = scored & (
+            (race["best_viol"] != prev_best_viol)
+            | (race["best_cost"] != prev_best_cost)
+            | np.isinf(prev_best_cost))
+        for a in np.flatnonzero(improved):
+            if sels[a] is not None:
+                best_sel[a] = sels[a].copy()
+        if update["killed"]:
+            self.events.append({
+                "event": "kill", "boundary_cycle": int(boundary),
+                "arms": [self.arms[a].label
+                         for a in update["killed"]],
+                "reasons": [str(race["kill_reason"][a])
+                            for a in update["killed"]],
+                "leader": self.arms[update["leader"]].label,
+                "live": update["live"]})
+            for g in groups:
+                dead = np.asarray(
+                    [a in update["killed"] for a in g.lane_arms],
+                    dtype=bool)
+                if dead.any():
+                    g.state = self._mask_finished(g.state, dead)
+
+    def _rebatch(self, groups: List[_Group], race,
+                 next_pow2) -> None:
+        """Survivor rebatch down the pow2 rung ladder: when a group's
+        live lane count has halved, slice the survivors' carry rows
+        out (``tree_map``) and continue on a fresh smaller runner —
+        its compile is that rung's first dispatch, every later chunk
+        of the rung reuses it."""
+        import jax
+        import jax.numpy as jnp
+
+        for g in groups:
+            live = [i for i, a in enumerate(g.lane_arms)
+                    if self._arm_live(race, a)]
+            if not live or g.batch <= 1:
+                continue
+            new_b = next_pow2(len(live))
+            if new_b > g.batch // 2:
+                continue
+            keep = live + [live[-1]] * (new_b - len(live))
+            idx = jnp.asarray(np.asarray(keep, dtype=np.int32))
+            state = jax.tree_util.tree_map(lambda x: x[idx], g.state)
+            lane_arms = [g.lane_arms[i] for i in live] \
+                + [-1] * (new_b - len(live))
+            old_b = g.batch
+            self._open_group(g, lane_arms)
+            g.state = state
+            pad = np.asarray([a < 0 for a in lane_arms], dtype=bool)
+            if pad.any():
+                g.state = self._mask_finished(g.state, pad)
+            g.rebatches += 1
+            self.events.append({
+                "event": "rebatch", "algo": g.algo,
+                "from_batch": old_b, "to_batch": new_b,
+                "arms": [self.arms[a].label for a in lane_arms
+                         if a >= 0]})
+
+    # ----------------------------------------------------- checkpoint
+
+    def fingerprint_extra(self) -> Dict[str, Any]:
+        """Manifest fields beyond the standard program fingerprint:
+        the arm-grid hash and the kill-rule knobs — a resume under a
+        different grid or referee must refuse, not silently diverge.
+        """
+        return {"portfolio_arms": spec_fingerprint(self.arms),
+                "portfolio_every": self.every,
+                "portfolio_margin": self.margin,
+                "portfolio_patience": self.patience,
+                "portfolio_plateau": self.plateau}
+
+    def _snapshot(self, groups: List[_Group], race, best_sel,
+                  boundary: int) -> Dict[str, Any]:
+        from ..robustness.checkpoint import tree_to_host
+
+        return {
+            "kind": "portfolio",
+            "boundary": int(boundary),
+            "race": race_to_host(race),
+            "best_sel": [None if s is None else
+                         np.asarray(s).tolist() for s in best_sel],
+            "groups": [{
+                "algo": g.algo,
+                "lane_arms": list(g.lane_arms),
+                "rebatches": int(g.rebatches),
+                "state": tree_to_host(g.state),
+            } for g in groups],
+        }
+
+    def _restore(self, groups: List[_Group],
+                 payload: Dict[str, Any]):
+        """Install a snapshot: rebuild each group's runner at the
+        SNAPSHOT's lane count (rebatches that already happened stay
+        happened) and put the carries back on device.  The referee
+        state restores with exact dtypes, so every later kill decision
+        replays identically."""
+        from ..robustness.checkpoint import (CheckpointError,
+                                             tree_to_device)
+
+        if payload.get("kind") != "portfolio":
+            raise CheckpointError(
+                "snapshot is not a portfolio survivor set",
+                kind="state")
+        saved = payload.get("groups", [])
+        if len(saved) != len(groups):
+            raise CheckpointError(
+                f"snapshot has {len(saved)} arm group(s), this race "
+                f"builds {len(groups)} — the arm grid drifted",
+                kind="state")
+        for g, s in zip(groups, saved):
+            if s["algo"] != g.algo:
+                raise CheckpointError(
+                    f"snapshot group order drifted: {s['algo']} vs "
+                    f"{g.algo}", kind="state")
+            self._open_group(g, [int(a) for a in s["lane_arms"]])
+            g.state = tree_to_device(s["state"])
+            g.rebatches = int(s.get("rebatches", 0))
+        race = race_from_host(payload["race"])
+        best_sel = [None if s is None
+                    else np.asarray(s, dtype=np.int64)
+                    for s in payload["best_sel"]]
+        return int(payload["boundary"]), race, best_sel
+
+    # -------------------------------------------------------- results
+
+    def _result(self, win: int, best_sel, race, summary,
+                status: str, elapsed: float,
+                groups: List[_Group]) -> Dict[str, Any]:
+        arm = self.arms[win]
+        template = self._template_for(
+            arm.algo, dict(arm.params))
+        sel = best_sel[win]
+        assignment = {}
+        if sel is not None:
+            n_true = getattr(template, "n_vars_true", None) \
+                or template.n_vars
+            names = list(template.var_names)[:n_true]
+            assignment = {
+                name: self.dcop.variable(name).domain.values[int(v)]
+                for name, v in zip(names, sel[:n_true])}
+        cost = race["best_cost"][win]
+        block = {
+            "spec": canonical_spec(self.arms),
+            "every": self.every,
+            "margin": self.margin,
+            "patience": self.patience,
+            "plateau": self.plateau,
+            "groups": len(groups),
+            "rebatches": sum(g.rebatches for g in groups),
+            **{k: v for k, v in summary.items()
+               if k != "winner_index"},
+        }
+        return {
+            "status": status,
+            "assignment": assignment,
+            "cost": float(cost) if np.isfinite(cost) else None,
+            "violation": (int(race["best_viol"][win])
+                          if np.isfinite(cost) else None),
+            "cycle": int(race["cycles"][win]),
+            "algo": arm.algo,
+            "time": elapsed,
+            "portfolio": block,
+        }

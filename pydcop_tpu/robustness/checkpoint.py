@@ -532,3 +532,20 @@ def solve_checkpoint_name(dcop_files, algo: str, mode: str,
     ident = json.dumps([sorted(str(p) for p in dcop_files), algo,
                         mode, params, int(seed)])
     return "solve:" + hashlib.sha256(ident.encode()).hexdigest()
+
+
+def portfolio_checkpoint_name(dcop_files, spec: str,
+                              seed: int) -> str:
+    """The portfolio race's snapshot name: instance files × the
+    CANONICAL arm spec × the base seed.  The canonical spec (expanded
+    labels, ``parallel.portfolio.canonical_spec``) means two spellings
+    of the same grid share one snapshot, while any real grid change
+    misses.  The kill-rule knobs (margin/patience/plateau/every) are
+    PROGRAM identity: they ride the manifest fingerprint
+    (``PortfolioRace.fingerprint_extra``), so a resume under a
+    different referee refuses loudly instead of silently replaying
+    different kills.  The cycle budget stays out for the same reason
+    as :func:`solve_checkpoint_name`: a resume may extend it."""
+    ident = json.dumps([sorted(str(p) for p in dcop_files),
+                        str(spec), int(seed)])
+    return "portfolio:" + hashlib.sha256(ident.encode()).hexdigest()

@@ -124,8 +124,9 @@ class DeltaSessions:
         #: sessions open their engines with the activity-gated
         #: windowed sweep, so delta cost scales with the touched
         #: region — dispatch records carry ``active_fraction`` /
-        #: ``frontier_expansions``
-        self.roi = bool(roi)
+        #: ``frontier_expansions``.  False / True / 'auto' — passed
+        #: through verbatim (bool() would squash the auto policy)
+        self.roi = roi
         self.roi_residual_threshold = roi_residual_threshold
         #: byte budget over the summed per-session resident_bytes
         #: (None = count cap only)
@@ -519,10 +520,13 @@ class Dispatcher:
         self.registry = registry
         self._metrics = (_stage_metrics(registry)
                          if registry is not None else None)
-        from ..observability.metrics import roi_metrics
+        from ..observability.metrics import (portfolio_metrics,
+                                             roi_metrics)
 
         self._roi_metrics = (roi_metrics(registry)
                              if registry is not None else None)
+        self._portfolio_metrics = (portfolio_metrics(registry)
+                                   if registry is not None else None)
         #: injected fault plan (serving/faults.FaultPlan; chaos runs
         #: only — None keeps every hook dead) and the execute
         #: watchdog deadline: with a deadline set, the device span of
@@ -635,6 +639,12 @@ class Dispatcher:
         records."""
         from ..observability.spans import SpanClock
 
+        if len(group.key) > 4 and group.key[4][0] == "portfolio":
+            # the 5th key element marks an arm-race group (queue
+            # admission appends it); route BEFORE the positional
+            # unpack below, which expects exactly four elements
+            return self.dispatch_portfolio(group,
+                                           queue_depth=queue_depth)
         jobs = group.jobs
         algo, params_t, max_cycles, rung_sig = group.key
         params = dict(params_t)
@@ -755,6 +765,131 @@ class Dispatcher:
                 wait_s={"max": round(max(waits), 6),
                         "mean": round(sum(waits) / len(waits), 6)},
                 spans=spans,
+                exec_cache=(dict(self.exec_cache.stats)
+                            if self.exec_cache is not None else None),
+                runner_cache=runner_cache_stats())
+        return records
+
+    def dispatch_portfolio(self, group: DispatchGroup,
+                           queue_depth: int = 0
+                           ) -> List[Dict[str, Any]]:
+        """Run one portfolio group: each job races its arm grid to a
+        winner (``parallel/portfolio.py``) and replies with the
+        winner's summary record carrying the schema-1.8 ``portfolio``
+        block.  The race is its own batched program — N arms vmapped
+        over ONE instance — so jobs dispatch sequentially rather than
+        stacking instances; grouping still bounds admission-side work
+        (one canonical grid per group) and keeps races out of the
+        plain-solve fusion path."""
+        import os
+
+        from ..commands import parse_algo_params
+        from ..parallel.portfolio import (PortfolioRace,
+                                          parse_portfolio_spec)
+
+        algo, params_t, max_cycles, rung_sig = group.key[:4]
+        params = dict(params_t)
+        precision = params.get("precision")
+        dispatch_index = self._dispatch_seq
+        self._dispatch_seq += 1
+        t0 = self.clock()
+        records = []
+        waits = []
+        for job in group.jobs:
+            # re-derive the arms exactly as admission did (same base
+            # params/seed/objective -> same canonical grid; admission
+            # already proved the spec parses)
+            given = parse_algo_params(
+                list(job.request.get("algo_params", [])))
+            for k in ("seed", "stop_cycle", "layout"):
+                given.pop(k, None)
+            arms = parse_portfolio_spec(
+                job.request["portfolio"], base_algo=algo,
+                base_params=given, base_seed=job.seed,
+                mode=job.dcop.objective)
+            path = job.request["dcop"]
+            try:
+                st = os.stat(path)
+                instance_key = (os.path.abspath(path), st.st_mtime_ns,
+                                st.st_size)
+            except OSError:
+                # file vanished after admission: races still run off
+                # the loaded dcop, just without cross-job runner reuse
+                instance_key = None
+            race = PortfolioRace(
+                job.dcop, arms, max_cycles=job.max_cycles,
+                precision=precision, exec_cache=self.exec_cache,
+                instance_key=instance_key)
+            # the execute deadline doubles as the race's own
+            # boundary-checked timeout — a race can stop cleanly
+            # BETWEEN chunks (status TIMEOUT, best-so-far reply)
+            # where the watchdog thread can only abandon a stalled
+            # compiled chunk
+            result = self._with_deadline(
+                lambda: race.run(timeout=self.execute_deadline_s))
+            now = self.clock()
+            wait = max(0.0, now - job.t_admitted)
+            waits.append(wait)
+            rec = {
+                "job_id": job.job_id,
+                # the WINNER's algorithm — consumers filtering by algo
+                # see what actually produced the assignment; the raced
+                # grid itself is in the portfolio block's spec
+                "algo": result["algo"],
+                "status": result["status"],
+                "assignment": result["assignment"],
+                "cost": result["cost"],
+                "violation": result["violation"],
+                "cycle": result["cycle"],
+                "time": result["time"],
+                "queue_wait_s": round(wait, 6),
+                "batch": len(group.jobs),
+                "dispatch_reason": group.reason,
+                "portfolio": result["portfolio"],
+            }
+            if job.trace_id:
+                rec["trace_id"] = job.trace_id
+            if precision is not None:
+                rec["precision"] = precision
+            records.append(rec)
+            if self._portfolio_metrics is not None:
+                m = self._portfolio_metrics
+                block = result["portfolio"]
+                m["arms_started"].inc(block["arms_started"],
+                                      algo=algo)
+                m["arms_killed"].inc(block["arms_killed"], algo=algo)
+                if block.get("win_margin") is not None:
+                    m["win_margin"].set(float(block["win_margin"]),
+                                        algo=algo)
+            if self.reporter is not None:
+                self.reporter.summary(**rec)
+            if job.reply is not None:
+                job.reply(dict(rec, record="summary", mode="serve"))
+
+        self.stats["dispatches"] += 1
+        self.stats["jobs"] += len(group.jobs)
+        self.last_spans = {"execute_s": self.clock() - t0}
+        label = f"{algo}/portfolio/{rung_label(rung_sig)}"
+        self._observe_dispatch(label, group.reason, len(group.jobs),
+                               waits, dict(self.last_spans))
+        if self.reporter is not None:
+            for i, job in enumerate(group.jobs):
+                if not job.trace_id:
+                    continue
+                self.reporter.trace(
+                    job.trace_id, job.job_id, "done", rung=label,
+                    reason=group.reason, batch=len(group.jobs),
+                    queue_wait_s=round(waits[i], 6),
+                    spans=dict(self.last_spans))
+            self.reporter.serve(
+                event="dispatch", reason=group.reason,
+                rung=list(rung_sig), batch=len(group.jobs),
+                padded_batch=len(group.jobs),
+                queue_depth=int(queue_depth),
+                portfolio=group.key[4][1],
+                wait_s={"max": round(max(waits), 6),
+                        "mean": round(sum(waits) / len(waits), 6)},
+                spans=dict(self.last_spans),
                 exec_cache=(dict(self.exec_cache.stats)
                             if self.exec_cache is not None else None),
                 runner_cache=runner_cache_stats())
@@ -888,6 +1023,13 @@ class Dispatcher:
             rec["active_fraction"] = float(res["active_fraction"])
             rec["frontier_expansions"] = int(
                 res.get("frontier_expansions") or 0)
+            if res.get("roi_mode") is not None:
+                # the session's ROI policy, plus the one-off flip
+                # marker of a roi='auto' session that just fell back
+                # to full sweeps for good (schema minor 8)
+                rec["roi_mode"] = res["roi_mode"]
+                if res.get("roi_flipped"):
+                    rec["roi_flipped"] = True
             if self._roi_metrics is not None:
                 self._roi_metrics["active_fraction"].set(
                     rec["active_fraction"], target=request["target"])

@@ -198,6 +198,26 @@ def prepare_job(request: Dict[str, Any],
     max_cycles = int(request.get("max_cycles", default_max_cycles))
     group_key = (algo, tuple(sorted(params.items())), max_cycles,
                  rung.signature)
+    if request.get("portfolio"):
+        # portfolio jobs append a 5th key element: they dispatch
+        # through the arm-race path, never fuse with plain solves,
+        # and only group with races over the SAME canonical grid.
+        # Downstream consumers unpack the first four positionally
+        # (dispatcher, daemon rung labels), so appending is additive
+        from ..parallel.portfolio import (PortfolioSpecError,
+                                          canonical_spec,
+                                          parse_portfolio_spec)
+
+        try:
+            arms = parse_portfolio_spec(
+                request["portfolio"], base_algo=algo,
+                base_params={k: str(v) for k, v in given.items()},
+                base_seed=int(request.get("seed", default_seed)),
+                mode=dcop.objective)
+        except PortfolioSpecError as e:
+            raise ValueError(f"bad portfolio spec: {e}")
+        group_key = group_key + (
+            ("portfolio", canonical_spec(arms)),)
     deadline_ms = request.get("deadline_ms")
     return AdmittedJob(
         job_id=request["id"], request=request, dcop=dcop,

@@ -48,6 +48,12 @@ REQUEST_FIELDS = {
     "deadline_ms": "optional per-job dispatch deadline (positive ms); "
                    "tightens the daemon's --max-delay-ms for the rung "
                    "this job waits in",
+    "portfolio": "optional arm-race spec ('auto' or an arm grid, "
+                 "parallel/portfolio.py grammar): the job races N "
+                 "solver arms inside its deadline and replies with "
+                 "the winner — better cost at the same p99; the "
+                 "summary record carries the schema-1.8 'portfolio' "
+                 "block",
 }
 
 #: the ``delta`` job kind: a topology/cost edit against a previously
@@ -165,6 +171,24 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
                            or isinstance(dl, bool) or dl <= 0):
         raise bad(f"'deadline_ms' must be a positive number, "
                   f"got {dl!r}")
+    spec = rec.get("portfolio")
+    if spec is not None:
+        if not isinstance(spec, str) or not spec.strip():
+            raise bad("'portfolio' must be a non-empty arm-grid "
+                      "spec string (or 'auto')")
+        # full grammar check at the admission trust boundary: arm
+        # params are typed through the algorithm's own tables, so a
+        # typoed arm dies here as a structured rejection, never
+        # inside a compiled race
+        from ..parallel.portfolio import (PortfolioSpecError,
+                                          parse_portfolio_spec)
+
+        try:
+            parse_portfolio_spec(spec, base_algo=algo,
+                                 base_params=None,
+                                 base_seed=rec.get("seed") or 0)
+        except PortfolioSpecError as e:
+            raise bad(f"bad portfolio spec: {e}")
     return rec
 
 

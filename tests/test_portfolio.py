@@ -1,0 +1,582 @@
+"""Solver portfolios: race arm configs over ONE instance (ISSUE 17).
+
+Layers under test:
+
+* ``parallel/portfolio.py`` — the spec grammar (auto preset, seed
+  pinning vs ``seeds:`` expansion, base-param inheritance, loud
+  rejection of lane-hostile keys) and :class:`PortfolioRace` itself:
+  a single-arm race IS the plain batched solve (selections, cycles,
+  cost), kills reclaim lanes, survivors rebatch down the pow2 ladder,
+  and the whole race replays bit-exactly through a mid-race preempt +
+  ``--resume``;
+* ``ops/arm_race.py`` — the host referee on a fake scorer: trailing
+  and plateau kills fire deterministically, the leader and finished
+  arms are never killed, violations dominate cost, and the race state
+  survives the host/JSON checkpoint encoding with exact dtypes;
+* ``serving/`` — portfolio jobs end to end: admission validates the
+  spec at the trust boundary, the group key grows the arm-grid
+  element, the dispatcher replies with the winner's summary record
+  and increments the ``pydcop_portfolio_*`` metrics rendered by
+  serve-status;
+* ``observability/report.py`` — the schema-minor-8 ``portfolio``
+  block and ``roi_mode``/``roi_flipped`` accept/reject matrix, with
+  frozen minor-7 readers staying green.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
+from pydcop_tpu.ops.arm_race import (ARM_STATUSES, KILL_REASONS,
+                                     leader_index, new_race,
+                                     race_from_host, race_summary,
+                                     race_to_host, race_update)
+from pydcop_tpu.parallel.portfolio import (AUTO_SPEC,
+                                           PORTFOLIO_FAMILIES,
+                                           PortfolioRace,
+                                           PortfolioSpecError,
+                                           canonical_spec,
+                                           parse_portfolio_spec,
+                                           spec_fingerprint)
+
+pytestmark = pytest.mark.portfolio
+
+
+def _coloring(n=16, seed=3):
+    return generate_graph_coloring(n, 3, "scalefree", m_edge=2,
+                                   soft=True, seed=seed)
+
+
+def _chain(n=12, d=3, seed=0):
+    """Random-integer-cost chain: tree-structured, so max-sum
+    CONVERGES to its one fixed point — the precondition of the
+    single-arm bit-exactness guard (same recipe as tests/test_roi)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.RandomState(seed)
+    dcop = DCOP("chain")
+    dom = Domain("dom", "d", list(range(d)))
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n - 1):
+        m = rng.randint(0, 10, size=(d, d))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[i + 1]], m, name=f"c{i}"))
+    return dcop
+
+
+@pytest.fixture(scope="module")
+def coloring():
+    return _coloring()
+
+
+# ------------------------------------------------------- spec grammar
+
+
+def test_auto_preset_expands_to_eight_distinct_arms():
+    arms = parse_portfolio_spec("auto")
+    assert len(arms) == 8
+    assert parse_portfolio_spec(AUTO_SPEC) == arms
+    labels = [a.label for a in arms]
+    assert len(set(labels)) == 8
+    assert {a.algo for a in arms} == set(PORTFOLIO_FAMILIES)
+    # canonical form + fingerprint are deterministic functions of the
+    # grid — they feed serve group keys and checkpoint manifests
+    assert canonical_spec(arms) == ";".join(labels)
+    fp = spec_fingerprint(arms)
+    assert fp == spec_fingerprint(parse_portfolio_spec("auto"))
+    assert len(fp) == 16 and int(fp, 16) >= 0
+
+
+def test_seed_pin_seeds_expansion_and_base_inheritance():
+    arms = parse_portfolio_spec(
+        "maxsum,seeds:3;dsa,variant:B,seed:9",
+        base_algo="maxsum", base_params={"damping": 0.7, "seed": 11},
+        base_seed=5)
+    assert [a.seed for a in arms] == [5, 6, 7, 9]
+    # base -p params seed same-family arms only; the race owns
+    # seeding, so a base 'seed' is skipped (not an error)
+    for a in arms[:3]:
+        assert a.algo == "maxsum"
+        assert a.params_dict["damping"] == pytest.approx(0.7)
+    assert arms[3].algo == "dsa"
+    assert "damping" not in arms[3].params_dict
+    assert arms[3].label == "dsa[variant:B,s9]"
+    # an arm's own k:v beats the inherited baseline
+    override = parse_portfolio_spec(
+        "maxsum,damping:0.9", base_algo="maxsum",
+        base_params={"damping": 0.7})
+    assert override[0].params_dict["damping"] == pytest.approx(0.9)
+
+
+@pytest.mark.parametrize("spec,needle", [
+    ("", "empty"),
+    ("   ;  ", "no arms"),
+    ("dpop", "vmapped batch solver"),
+    ("maxsum,layout:lane_major", "layout"),
+    ("maxsum,bnb:on", "bnb"),
+    ("maxsum,stop_cycle:5", "stop_cycle"),
+    ("maxsum,damping", "name:value"),
+    ("maxsum,damping:hot", "damping"),
+    ("maxsum,seed:two", "integer"),
+    ("maxsum,seeds:0", "positive replica"),
+    ("maxsum,seed:2,seeds:3", "mutually exclusive"),
+    ("maxsum;maxsum", "duplicate"),
+    ("dsa,seeds:2;dsa,seed:1", "duplicate"),
+])
+def test_spec_rejection_matrix(spec, needle):
+    with pytest.raises(PortfolioSpecError, match=needle):
+        parse_portfolio_spec(spec)
+
+
+def test_base_params_cannot_smuggle_lane_hostile_keys():
+    # layouts/bnb plans cannot ride a vmapped lane even when they
+    # arrive via the CLI's -p baseline instead of the spec itself
+    with pytest.raises(PortfolioSpecError, match="layout"):
+        parse_portfolio_spec("maxsum", base_algo="maxsum",
+                             base_params={"layout": "fused"})
+
+
+def test_vocabulary_mirrors_are_frozen_together():
+    """The report validator duplicates the referee/serving vocab so
+    telemetry readers need no solver imports — drift is a test
+    failure, not a silent schema split."""
+    from pydcop_tpu.observability.report import (
+        PORTFOLIO_ARM_STATUSES, PORTFOLIO_KILL_REASONS, ROI_MODES,
+        SCHEMA_MINOR)
+    from pydcop_tpu.serving.schema import SERVABLE_ALGOS
+
+    assert PORTFOLIO_ARM_STATUSES == ARM_STATUSES
+    assert PORTFOLIO_KILL_REASONS == KILL_REASONS
+    assert set(SERVABLE_ALGOS) == set(PORTFOLIO_FAMILIES)
+    assert ROI_MODES == ("off", "on", "auto")
+    assert SCHEMA_MINOR >= 8
+
+
+# ------------------------------------- the referee, on a fake scorer
+
+
+def _feed(race, costs, viols=None, finished=None, **knobs):
+    n = len(race["alive"])
+    b = race["boundaries"] + 1
+    return race_update(
+        race, costs,
+        viols if viols is not None else [0] * n,
+        [b * 32] * n,
+        finished if finished is not None else [False] * n,
+        **knobs)
+
+
+def test_trailing_kill_fires_after_patience_boundaries():
+    knobs = dict(margin=0.05, patience=3, plateau=99)
+    race = new_race(3)
+    updates = [_feed(race, [1.0, 1.02, 5.0], **knobs)
+               for _ in range(3)]
+    # arm1 sits inside the 5% leader band: never a kill candidate;
+    # arm2 trails beyond it and dies exactly at the 3rd boundary
+    assert [u["killed"] for u in updates] == [[], [], [2]]
+    assert updates[-1]["leader"] == 0
+    assert race["kill_reason"][2] == "trailing"
+    assert race["killed_at"][2] == 3
+    summary = race_summary(race, labels=["a", "b", "c"])
+    by_arm = {r["arm"]: r for r in summary["arms"]}
+    assert by_arm["a"]["status"] == "winner"
+    assert by_arm["b"]["status"] == "budget"
+    assert by_arm["c"] == {"arm": "c", "best_cost": 5.0,
+                           "best_violation": 0, "cycles": 96,
+                           "status": "killed",
+                           "kill_reason": "trailing"}
+    assert summary["arms_started"] == 3
+    assert summary["arms_killed"] == 1
+    # the rule is a pure function of the score history: replaying the
+    # same feed reproduces the same kills (the resume contract)
+    race2 = new_race(3)
+    assert [_feed(race2, [1.0, 1.02, 5.0], **knobs)["killed"]
+            for _ in range(3)] == [[], [], [2]]
+    assert race_summary(race2, labels=["a", "b", "c"]) == summary
+
+
+def test_plateau_kills_stale_arm_but_never_the_leader():
+    race = new_race(2)
+    kills = [_feed(race, [2.0, 2.0], margin=0.5, patience=99,
+                   plateau=3)["killed"]
+             for _ in range(4)]
+    # boundary 1 improves both (inf -> 2.0); then both go stale, and
+    # at stale == 3 only the non-leader dies — ties break toward the
+    # lowest index, and the leader is never a kill candidate
+    assert kills == [[], [], [], [1]]
+    assert race["kill_reason"][1] == "plateau"
+    assert bool(race["alive"][0])
+
+
+def test_violations_dominate_cost_and_finished_arms_survive():
+    race = new_race(2)
+    # arm1 is cheaper but infeasible: the feasible arm leads
+    _feed(race, [10.0, 0.5], viols=[0, 2], margin=0.0, patience=1,
+          plateau=99)
+    assert leader_index(race) == 0
+    # a FINISHED arm stops being a kill candidate even while trailing
+    race = new_race(2)
+    for _ in range(5):
+        _feed(race, [1.0, 50.0], finished=[False, True],
+              margin=0.0, patience=1, plateau=1)
+    assert race["kill_reason"][1] == ""
+    summary = race_summary(race)
+    assert summary["arms"][1]["status"] == "finished"
+    assert summary["win_margin"] == pytest.approx(49.0)
+
+
+def test_race_state_survives_host_roundtrip_with_exact_dtypes():
+    race = new_race(3, minimize=False)
+    for costs in ([3.0, 1.0, 2.0], [4.0, 1.5, 2.0]):
+        _feed(race, costs, margin=0.1, patience=2, plateau=4)
+    # through JSON — the checkpoint payload is host-encoded exactly so
+    back = race_from_host(json.loads(json.dumps(race_to_host(race))))
+    assert set(back) == set(race)
+    for k, v in race.items():
+        if isinstance(v, np.ndarray):
+            assert back[k].dtype == v.dtype, k
+            assert np.array_equal(back[k], v), k
+        else:
+            assert back[k] == v, k
+
+
+# ------------------------------------------------- the race, for real
+
+
+def test_single_arm_race_is_the_plain_batched_solve():
+    """One arm == no race: on a CONVERGENT instance the result must
+    be the plain broadcast-batched solve of that config bit-exactly —
+    selections, cycles, cost and violations — even though the race
+    drives the program in scoring chunks instead of one full run."""
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    dcop = _chain()
+    arms = parse_portfolio_spec("maxsum,seed:7")
+    race = PortfolioRace(dcop, arms, max_cycles=200, every=16)
+    res = race.run()
+    assert res["status"] == "FINISHED"
+
+    template = FactorGraphArrays.build(dcop, arity_sorted=True)
+    runner = BatchedMaxSum(template, batch=1)
+    sel, cycles, fin = runner.run(max_cycles=200, seeds=[7])
+    sel = np.asarray(sel)
+    assert bool(fin[0])
+    assert res["cycle"] == int(cycles[0])
+    n_true = getattr(template, "n_vars_true", None) or template.n_vars
+    names = list(template.var_names)[:n_true]
+    plain = {nm: dcop.variable(nm).domain.values[int(v)]
+             for nm, v in zip(names, sel[0][:n_true])}
+    assert res["assignment"] == plain
+    cost, viol = runner.evaluate(sel)
+    assert res["cost"] == pytest.approx(float(cost[0]))
+    assert res["violation"] == int(viol[0])
+    block = res["portfolio"]
+    assert block["winner"] == "maxsum[s7]" and res["algo"] == "maxsum"
+    assert block["arms_started"] == 1 and block["arms_killed"] == 0
+    assert block["rebatches"] == 0 and block["win_margin"] is None
+
+
+def test_race_result_is_anytime_best_not_final(coloring):
+    """On a NON-convergent loopy instance the race's answer is the
+    best boundary score seen, never the (possibly worse) final
+    oscillation state — the anytime contract single solves lack."""
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    arms = parse_portfolio_spec("maxsum,seed:7")
+    race = PortfolioRace(coloring, arms, max_cycles=200, every=16)
+    res = race.run()
+    assert res["status"] == "MAX_CYCLES"
+    template = FactorGraphArrays.build(coloring, arity_sorted=True)
+    runner = BatchedMaxSum(template, batch=1)
+    sel, cycles, _fin = runner.run(max_cycles=200, seeds=[7])
+    assert res["cycle"] == int(cycles[0])
+    cost, viol = runner.evaluate(np.asarray(sel))
+    assert (res["violation"], res["cost"]) <= \
+        (int(viol[0]), float(cost[0]))
+
+
+def test_kills_reclaim_lanes_and_survivors_rebatch_down_pow2(coloring):
+    """An 8-replica DSA grid under an aggressive referee: losing arms
+    die, their lanes freeze, and the survivor set rebatches down the
+    pow2 rung ladder — deterministically, twice."""
+    def run_once():
+        arms = parse_portfolio_spec("dsa,variant:A,seeds:8")
+        race = PortfolioRace(coloring, arms, max_cycles=96, every=8,
+                             margin=0.0, patience=1, plateau=2)
+        return race.run(), race.events
+
+    res, events = run_once()
+    block = res["portfolio"]
+    assert block["arms_started"] == 8
+    assert block["arms_killed"] >= 4
+    assert block["rebatches"] >= 1
+    kills = [e for e in events if e["event"] == "kill"]
+    assert kills and all(r in KILL_REASONS
+                         for e in kills for r in e["reasons"])
+    rebatches = [e for e in events if e["event"] == "rebatch"]
+    for e in rebatches:
+        assert e["to_batch"] < e["from_batch"]
+        assert e["to_batch"] & (e["to_batch"] - 1) == 0
+        assert e["to_batch"] <= e["from_batch"] // 2
+    by_status = {r["arm"]: r for r in block["arms"]}
+    assert by_status[block["winner"]]["status"] == "winner"
+    for row in block["arms"]:
+        assert (row["status"] == "killed") == (
+            row["kill_reason"] is not None)
+    assert res["assignment"] and res["cost"] is not None
+    # byte-identical second race: seeding, scoring, kills and the
+    # rebatch schedule are all deterministic
+    res2, events2 = run_once()
+    assert res2["portfolio"] == block
+    assert res2["assignment"] == res["assignment"]
+    assert events2 == events
+
+
+def test_mid_race_preempt_then_resume_is_bit_exact(coloring, tmp_path):
+    """The acceptance contract: kill the race after its 2nd boundary
+    snapshot, resume from disk, and get the uninterrupted race's
+    winner, assignment AND full portfolio block bit-exactly."""
+    from pydcop_tpu.robustness.checkpoint import (
+        CheckpointError, CheckpointStore, Preempted, SolveCheckpointer,
+        checkpoint_fingerprint, portfolio_checkpoint_name)
+
+    spec = "maxsum;dsa,variant:B,seeds:2"
+
+    def race_for(margin=0.02):
+        arms = parse_portfolio_spec(spec, base_seed=1)
+        return PortfolioRace(coloring, arms, max_cycles=64, every=8,
+                             margin=margin, patience=2, plateau=4)
+
+    def ckpt_for(race, **kw):
+        fp = checkpoint_fingerprint(precision="f32", algo="portfolio")
+        fp.update(race.fingerprint_extra())
+        return SolveCheckpointer(
+            CheckpointStore(str(tmp_path)),
+            portfolio_checkpoint_name(["x.yaml"],
+                                      canonical_spec(race.arms), 1),
+            every=8, fingerprint=fp, **kw)
+
+    base = race_for().run()          # uninterrupted reference
+
+    victim = race_for()
+    with pytest.raises(Preempted):
+        victim.run(checkpointer=ckpt_for(victim, preempt_after=2))
+
+    survivor = race_for()
+    ck = ckpt_for(survivor)
+    resumed = survivor.run(checkpointer=ck, resume=True)
+    assert ck.resumed_from_cycle == 16
+    for k in ("status", "assignment", "cost", "violation", "cycle",
+              "algo"):
+        assert resumed[k] == base[k], k
+    assert resumed["portfolio"] == base["portfolio"]
+
+    # a drifted referee is a different program: the manifest
+    # fingerprint carries the kill-rule knobs and refuses the restore
+    drifted = race_for(margin=0.4)
+    with pytest.raises(CheckpointError):
+        drifted.run(checkpointer=ckpt_for(drifted), resume=True)
+
+
+# --------------------------------------------------- serve, end to end
+
+
+def _write_instance(path, name, edges, nv, w):
+    lines = [f"name: {name}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(nv):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k, (a, b) in enumerate(edges):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {w + k} if v{a} == v{b} else 0}}")
+    lines.append("agents: [%s]"
+                 % ", ".join(f"a{i}" for i in range(nv)))
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_serve_portfolio_job_end_to_end(tmp_path):
+    from pydcop_tpu.commands.serve_status import render_status
+    from pydcop_tpu.observability.registry import MetricsRegistry
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.queue import DispatchGroup, prepare_job
+    from pydcop_tpu.serving.schema import (RequestError,
+                                           validate_request)
+
+    inst = tmp_path / "ring5.yaml"
+    _write_instance(inst, "ring5",
+                    [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)], 5, 5)
+    req = {"id": "p1", "dcop": str(inst), "algo": "maxsum",
+           "portfolio": "maxsum;dsa,variant:A", "max_cycles": 48,
+           "seed": 2}
+    validate_request(dict(req))
+    # the spec is validated at the admission trust boundary with the
+    # full grammar — a lane-hostile key is a structured rejection
+    with pytest.raises(RequestError, match="portfolio"):
+        validate_request(dict(req, portfolio="maxsum,layout:fused"))
+    with pytest.raises(RequestError, match="portfolio"):
+        validate_request(dict(req, portfolio=""))
+
+    j1 = prepare_job(dict(req))
+    j2 = prepare_job(dict(req, id="p2"))
+    plain = prepare_job({"id": "q", "dcop": str(inst),
+                         "algo": "maxsum", "max_cycles": 48,
+                         "seed": 2})
+    # the arm grid rides the group key as a 5th element: same grid
+    # batches together, a plain solve of the same rung stays apart
+    assert len(j1.group_key) == 5
+    assert j1.group_key[4] == ("portfolio",
+                               "maxsum[s2];dsa[variant:A,s2]")
+    assert j1.group_key == j2.group_key
+    assert len(plain.group_key) == 4
+    assert j1.group_key[:4] == plain.group_key
+
+    out = tmp_path / "serve.jsonl"
+    rep = RunReporter(str(out), algo="serve", mode="serve")
+    reg = MetricsRegistry()
+    disp = Dispatcher(reporter=rep, registry=reg)
+    records = disp.dispatch(
+        DispatchGroup(j1.group_key, [j1, j2], "deadline"))
+    assert [r["job_id"] for r in records] == ["p1", "p2"]
+    for r in records:
+        assert r["algo"] in PORTFOLIO_FAMILIES
+        assert r["status"] in ("FINISHED", "MAX_CYCLES")
+        assert len(r["assignment"]) == 5
+        assert r["portfolio"]["spec"] == j1.group_key[4][1]
+        assert r["portfolio"]["arms_started"] == 2
+    # identical jobs race identically
+    assert records[0]["portfolio"] == records[1]["portfolio"]
+    assert records[0]["assignment"] == records[1]["assignment"]
+    # the plain group still dispatches through the 4-element path
+    plain_recs = disp.dispatch(
+        DispatchGroup(plain.group_key, [plain], "deadline"))
+    assert plain_recs[0]["status"] in ("FINISHED", "MAX_CYCLES")
+    assert "portfolio" not in plain_recs[0]
+    rep.close()
+
+    for rec in read_records(str(out)):
+        validate_record(rec)
+    serve_events = [r for r in read_records(str(out))
+                    if r.get("record") == "serve"
+                    and r.get("event") == "dispatch"]
+    assert any(r.get("portfolio") == j1.group_key[4][1]
+               for r in serve_events)
+
+    snap = reg.snapshot()
+    assert snap["counters"][
+        "pydcop_portfolio_arms_started_total"] == {"maxsum": 4}
+    assert "pydcop_portfolio_win_margin" in snap["gauges"]
+    status = render_status({"uptime_s": 1.0, "queue_depth": 0,
+                            "stats": {}, "metrics": snap})
+    assert "portfolio (arms started / killed | last win margin):" \
+        in status
+    assert "maxsum" in status
+
+
+# --------------------------------------- schema minor 8 (frozen readers)
+
+
+def _arm_row(**over):
+    row = {"arm": "maxsum[s0]", "best_cost": 1.5, "best_violation": 0,
+           "cycles": 64, "status": "winner", "kill_reason": None}
+    row.update(over)
+    return row
+
+
+def _block(**over):
+    block = {"spec": "maxsum[s0];dsa[variant:A,s0]", "every": 32,
+             "margin": 0.05, "patience": 3, "plateau": 6, "groups": 2,
+             "rebatches": 0, "winner": "maxsum[s0]",
+             "win_margin": 0.25,
+             "arms": [_arm_row(),
+                      _arm_row(arm="dsa[variant:A,s0]", best_cost=2.0,
+                               cycles=32, status="killed",
+                               kill_reason="trailing")],
+             "arms_started": 2, "arms_killed": 1, "boundaries": 2}
+    block.update(over)
+    return block
+
+
+def test_portfolio_block_accept_reject_matrix():
+    from pydcop_tpu.observability.report import validate_record
+
+    ok = {"record": "summary", "algo": "maxsum", "status": "FINISHED"}
+    validate_record({**ok, "portfolio": _block()})
+    validate_record(ok)    # the block is optional: minor-7 unchanged
+    for bad, needle in [
+        (_block(turbo=1), "unknown field"),
+        (_block(winner=""), "winner"),
+        (_block(win_margin=-0.1), "win_margin"),
+        (_block(arms_started=True), "arms_started"),
+        (_block(margin=-0.5), "margin"),
+        (_block(arms=[]), "arms"),
+        (_block(arms=[_arm_row(status="zombie")]), "unknown status"),
+        (_block(arms=[_arm_row(status="killed")]), "kill_reason"),
+        (_block(arms=[_arm_row(kill_reason="trailing")]),
+         "kill_reason"),
+        (_block(arms=[_arm_row(kill_reason="boredom",
+                               status="killed")]), "kill_reason"),
+        (_block(arms=[_arm_row(extra=1)]), "unknown field"),
+        (_block(arms=[_arm_row(best_violation=-1)]),
+         "best_violation"),
+        ("maxsum[s0]", "dict"),
+    ]:
+        with pytest.raises(ValueError, match=needle):
+            validate_record({**ok, "portfolio": bad})
+    # serve dispatch events carry the canonical SPEC string instead
+    serve = {"record": "serve", "algo": "serve", "event": "dispatch"}
+    validate_record({**serve, "portfolio": "maxsum[s0]"})
+    for bad in ("", _block()):
+        with pytest.raises(ValueError, match="spec string"):
+            validate_record({**serve, "portfolio": bad})
+
+
+def test_roi_mode_echo_accept_reject_matrix():
+    from pydcop_tpu.observability.report import validate_record
+
+    ok = {"record": "summary", "algo": "maxsum", "status": "FINISHED"}
+    for mode in ("off", "on", "auto"):
+        validate_record({**ok, "roi_mode": mode})
+    validate_record({**ok, "roi_mode": "auto", "roi_flipped": True})
+    with pytest.raises(ValueError, match="roi_mode"):
+        validate_record({**ok, "roi_mode": "warm"})
+    with pytest.raises(ValueError, match="roi_flipped"):
+        validate_record({**ok, "roi_flipped": 1})
+    serve = {"record": "serve", "algo": "serve", "event": "dispatch"}
+    validate_record({**serve, "roi_mode": "auto"})
+    with pytest.raises(ValueError, match="roi_mode"):
+        validate_record({**serve, "roi_mode": "fast"})
+
+
+def test_frozen_minor_7_readers_stay_green():
+    """Minor 8 is additive: a minor-7 record validates unchanged, and
+    stripping the portfolio/roi_mode fields from a minor-8 record
+    yields a valid minor-7 view with every shared field untouched."""
+    from pydcop_tpu.observability.report import (SCHEMA_MINOR,
+                                                 validate_record)
+
+    assert SCHEMA_MINOR >= 8
+    minor7 = {"record": "summary", "algo": "maxsum",
+              "status": "FINISHED", "schema_minor": 7,
+              "active_fraction": 0.125, "frontier_expansions": 2,
+              "warm_start": True}
+    validate_record(minor7)
+    minor8 = dict(minor7, schema_minor=8, roi_mode="auto",
+                  roi_flipped=True, portfolio=_block())
+    validate_record(minor8)
+    v7_view = {k: minor8[k] for k in minor7}
+    v7_view["schema_minor"] = 7
+    validate_record(v7_view)
+    assert {k: v7_view[k] for k in minor7 if k != "schema_minor"} \
+        == {k: minor7[k] for k in minor7 if k != "schema_minor"}

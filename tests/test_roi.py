@@ -466,3 +466,95 @@ def test_roi_metrics_register_and_render_in_status():
     quiet = render_status({"uptime_s": 1.0, "stats": {},
                            "metrics": {}})
     assert "roi (active fraction" not in quiet
+
+
+# ------------------------------------------------- roi=auto (minor 8)
+
+
+def _sweep_all(eng, rnd):
+    """One whole-instance edit: every chain constraint changes, so the
+    windowed solve's active fraction is ~1.0 — the workload roi=auto
+    exists to detect."""
+    costs = NEW_COSTS if rnd % 2 else ADD_COSTS
+    eng.apply([{"type": "change_costs", "name": f"c{i}",
+                "costs": costs} for i in range(11)])
+
+
+def test_roi_auto_validates_and_echoes_mode():
+    with pytest.raises(ValueError, match="roi"):
+        mk(roi="always")
+    assert mk(roi=False).roi_mode == "off"
+    assert mk(roi=True).roi_mode == "on"
+    eng = mk(roi="auto")
+    assert eng.roi is True and eng.roi_mode == "auto"
+    res = eng.solve()
+    assert res["roi_mode"] == "auto"
+    assert "roi_flipped" not in res
+    eng.close()
+
+
+def test_roi_auto_flips_after_window_of_sweeping_deltas():
+    eng = mk(roi="auto")
+    eng.solve()
+    flips = []
+    for rnd in range(2 * DynamicEngine.ROI_AUTO_WINDOW):
+        _sweep_all(eng, rnd)
+        res = eng.solve()
+        assert res["status"] == "FINISHED"
+        assert_no_bare_retrace(res["spans"])
+        flips.append(bool(res.get("roi_flipped")))
+        if flips[-1]:
+            break
+    # the flip fires exactly once, on the solve that fills the window
+    assert flips == [False] * (DynamicEngine.ROI_AUTO_WINDOW - 1) \
+        + [True]
+    # permanently full-sweep from here: af 1.0, no frontier work, and
+    # the one-time flip marker never repeats
+    _sweep_all(eng, 99)
+    post = eng.solve()
+    assert post["active_fraction"] == 1.0
+    assert post["frontier_expansions"] == 0
+    assert post["roi_mode"] == "auto"
+    assert "roi_flipped" not in post
+    eng.close()
+
+
+def test_roi_auto_local_deltas_never_flip():
+    eng = mk(roi="auto")
+    eng.solve()
+    for rnd in range(2 * DynamicEngine.ROI_AUTO_WINDOW):
+        eng.apply([{"type": "change_costs", "name": "c4",
+                    "costs": NEW_COSTS if rnd % 2 else ADD_COSTS}])
+        res = eng.solve()
+        assert res.get("roi_flipped") is None
+        assert res["active_fraction"] < DynamicEngine.ROI_AUTO_THRESHOLD
+    assert eng._roi_auto_flipped is False
+    eng.close()
+
+
+def test_roi_auto_flip_rides_snapshot_and_mode_mismatch_refuses():
+    from pydcop_tpu.robustness.checkpoint import CheckpointError
+
+    eng = mk(roi="auto")
+    eng.solve()
+    for rnd in range(DynamicEngine.ROI_AUTO_WINDOW):
+        _sweep_all(eng, rnd)
+        eng.solve()
+    assert eng._roi_auto_flipped is True
+    snap = eng.state_snapshot()
+    assert snap["roi_mode"] == "auto"
+    assert snap["roi_state"]["auto_flipped"] is True
+    restored = mk(roi="auto")
+    restored.restore_state(snap)
+    restored.apply([{"type": "change_costs", "name": "c4",
+                     "costs": NEW_COSTS}])
+    r = restored.solve()
+    # the flip survived the trip: a tiny delta still full-sweeps
+    assert r["active_fraction"] == 1.0
+    assert r["frontier_expansions"] == 0
+    # an roi=on engine is a different session configuration
+    other = mk(roi=True)
+    with pytest.raises(CheckpointError, match="roi_mode"):
+        other.restore_state(snap)
+    for e in (eng, restored, other):
+        e.close()
