@@ -3,7 +3,7 @@
 # 8-device mesh (tests/conftest.py).
 
 .PHONY: test test-fast bench suite lint typecheck chaos bench-roi \
-	bench-portfolio
+	bench-portfolio bench-autotune
 
 test:
 	python -m pytest tests/ -q
@@ -36,6 +36,16 @@ bench-roi:
 bench-portfolio:
 	python -m pytest tests/ -q -m "portfolio"
 	python benchmarks/suite.py bench_portfolio --quick
+
+# the autotuner tier: the tuning test marker plus the bench_autotune
+# contract — tune a small rung ladder through the real runners, then
+# assert never-slower on every rung (the search argmin contains the
+# default), a measured speedup on at least one rung, and that the
+# sidecar-resolved winner stays bit-exact with the same config pinned
+# explicitly
+bench-autotune:
+	python -m pytest tests/ -q -m "tuning"
+	python benchmarks/suite.py bench_autotune --quick
 
 bench:
 	python bench.py

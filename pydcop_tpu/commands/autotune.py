@@ -1,0 +1,155 @@
+"""``pydcop autotune``: measure the knob grid per rung, persist the
+winners.
+
+Three ways to say which rungs to tune — explicit labels
+(``--rung factor:d3:v17:a2x32``, the grammar ``serve-status`` and the
+dispatch metrics already print), a corpus of DCOP files (grouped by
+their ``home_rung``, the same rung each file would dispatch on), or a
+serve telemetry JSONL (``--from-telemetry``: replay the rungs a
+daemon actually saw).  Every valid config runs through the real
+batched runners (warmup + best-of-N medians, successive-halving
+pruning); the measured-fastest config and the full ms/cycle table
+persist as JSON sidecars beside the executable cache, where
+``solve``/``batch --fuse-hetero``/serve dispatch resolve un-pinned
+knobs from them (explicit flags always win; see
+``docs/analysing_results.md``).
+"""
+
+from . import CliError, output_json, parse_algo_params
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "autotune",
+        help="benchmark the knob grid per rung through the real "
+             "runners and persist the measured-fastest configs "
+             "beside the executable cache for dispatch to consume")
+    parser.add_argument("corpus", nargs="*", metavar="DCOP_FILE",
+                        help="DCOP files whose home rungs to tune "
+                             "(measured on the files themselves)")
+    parser.add_argument("-a", "--algo", type=str, default="maxsum",
+                        help="algorithm family to tune "
+                             "(batched families: maxsum, dsa, mgm)")
+    parser.add_argument("--rung", action="append", default=None,
+                        metavar="LABEL",
+                        help="explicit rung label to tune (e.g. "
+                             "factor:d3:v17:a2x32; repeatable; "
+                             "measured on synthetic instances padded "
+                             "to the rung)")
+    parser.add_argument("--from-telemetry", dest="from_telemetry",
+                        type=str, default=None, metavar="JSONL",
+                        help="replay the (algo, rung) pairs a serve "
+                             "daemon's telemetry recorded")
+    parser.add_argument("-p", "--algo_params", action="append",
+                        default=None, metavar="NAME:VALUE",
+                        help="pinned params (searched around, never "
+                             "overridden — explicit always wins at "
+                             "dispatch too)")
+    parser.add_argument("--cycles", type=int, default=32,
+                        help="full measurement budget per repeat "
+                             "(cycles; the halving stage runs a "
+                             "quarter of it)")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="timed repeats at full budget "
+                             "(best-of-N)")
+    parser.add_argument("--batch", type=int, default=4,
+                        help="synthetic instances per rung for "
+                             "--rung/--from-telemetry modes")
+    parser.add_argument("--store-dir", dest="store_dir", type=str,
+                        default=None, metavar="DIR",
+                        help="tuned-store directory (default: the "
+                             "'tuned' dir beside the executable "
+                             "cache, PYDCOP_TPU_CACHE_DIR-relative)")
+    parser.add_argument("--dry-run", dest="dry_run",
+                        action="store_true",
+                        help="measure and print, persist nothing")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def _coerce(value):
+    """``-p name:value`` strings into the types the runner
+    constructors expect (the same coercion AlgorithmDef applies on
+    the solve path)."""
+    low = str(value).strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except (TypeError, ValueError):
+            continue
+    return value
+
+
+def run_cmd(args, timeout=None):
+    from ..engine._cache import ExecutableCache
+    from ..tuning.autotune import (autotune, parse_rung_label,
+                                   rungs_from_corpus,
+                                   rungs_from_telemetry,
+                                   synthetic_instances)
+    from ..tuning.store import TunedConfigStore
+
+    modes = sum(bool(x) for x in
+                (args.corpus, args.rung, args.from_telemetry))
+    if modes != 1:
+        raise CliError(
+            "autotune wants exactly one rung source: DCOP corpus "
+            "files, --rung labels, or --from-telemetry JSONL")
+    pinned = {k: _coerce(v) for k, v in
+              parse_algo_params(args.algo_params).items()}
+
+    rung_sets = []
+    try:
+        if args.corpus:
+            for rung, instances in rungs_from_corpus(
+                    args.corpus, args.algo):
+                rung_sets.append(
+                    (args.algo, rung.signature, instances))
+        elif args.rung:
+            for label in args.rung:
+                sig = parse_rung_label(label)
+                rung_sets.append((args.algo, sig, synthetic_instances(
+                    sig, args.algo, batch=args.batch)))
+        else:
+            for algo, sig in rungs_from_telemetry(
+                    args.from_telemetry, algo=None):
+                rung_sets.append((algo, sig, synthetic_instances(
+                    sig, algo, batch=args.batch)))
+    except (OSError, ValueError) as e:
+        raise CliError(str(e))
+
+    store = None
+    if not args.dry_run:
+        store = TunedConfigStore(path=args.store_dir)
+        if not store.enabled:
+            raise CliError(
+                f"tuned-config store disabled or unavailable at "
+                f"{store.path}; nothing would persist — pass "
+                f"--dry-run to measure anyway")
+    try:
+        results = autotune(
+            rung_sets, cycles=args.cycles, repeats=args.repeats,
+            pinned=pinned, store=store,
+            exec_cache=ExecutableCache(), progress=print)
+    except ValueError as e:
+        raise CliError(str(e))
+    output_json({
+        "command": "autotune",
+        "algo": args.algo,
+        "pinned": pinned,
+        "store": None if store is None else store.path,
+        "rungs": results,
+    }, getattr(args, "output", None), quiet=True)
+    summary = {
+        "store": None if store is None else store.path,
+        "rungs": [
+            {"algo": r["algo"], "rung": r["rung_label"],
+             "best": r["best_label"],
+             "ms_per_cycle": r["best_ms_per_cycle"],
+             "default_ms_per_cycle": r["default_ms_per_cycle"],
+             "speedup": r["speedup_vs_default"]}
+            for r in results],
+    }
+    output_json(summary)
+    return 0

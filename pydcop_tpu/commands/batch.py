@@ -121,6 +121,14 @@ def set_parser(subparsers):
                              "bit-exact with the per-job solve and "
                              "padding-waste / program-count stats "
                              "land in the results")
+    parser.add_argument("--no-tuned", dest="no_tuned",
+                        action="store_true",
+                        help="ignore autotuned per-rung configs "
+                             "(`pydcop autotune` sidecars beside the "
+                             "executable cache); fused-hetero rungs "
+                             "normally adopt the measured-fastest "
+                             "config for any knob no flag or "
+                             "algo-param pinned")
     parser.add_argument("--precision", default=None,
                         choices=["f32", "bf16", "auto"],
                         help="mixed-precision policy for every solve "
@@ -519,7 +527,7 @@ def _run_fused_group(key, rows, out_dir, register_done,
                      reserve=None, checkpoint=None,
                      checkpoint_every=None,
                      checkpoint_resume=False,
-                     register_many=None):
+                     register_many=None, no_tuned=False):
     """Solve every (job_id, path, iteration) row of one group as a
     handful of vmapped programs — ONE per topology by default, or (with
     ``hetero``) one per shape-bucket rung: distinct topologies are
@@ -626,7 +634,7 @@ def _run_fused_group(key, rows, out_dir, register_done,
             reserve=reserve, checkpoint=checkpoint,
             checkpoint_every=checkpoint_every,
             checkpoint_resume=checkpoint_resume,
-            register_many=register_many)
+            register_many=register_many, no_tuned=no_tuned)
     finally:
         if reporter is not None:
             reporter.close()
@@ -639,7 +647,7 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                            reserve=None, checkpoint=None,
                            checkpoint_every=None,
                            checkpoint_resume=False,
-                           register_many=None):
+                           register_many=None, no_tuned=False):
     import numpy as np
 
     from ..dcop.yamldcop import load_dcop_from_file
@@ -649,6 +657,17 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                                   runner_for_rung)
     from ..parallel.bucketing import ShapeProfile, plan_rungs
     from . import output_json
+
+    # autotuned per-rung configs: fused-hetero rungs consult the
+    # sidecar store for any knob the campaign didn't pin (explicit
+    # params always win inside resolve_knobs); --no-tuned opts out
+    tuned_store = None
+    if not no_tuned:
+        from ..tuning.store import TunedConfigStore
+
+        tuned_store = TunedConfigStore()
+        if not tuned_store.enabled:
+            tuned_store = None
 
     dcops, arrays_of = {}, {}
     for _job, path, _it in rows:
@@ -715,11 +734,16 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
                     attrib["fuse_rung"] = extra["fuse_rung"]
                 if cycle_metrics is not None:
                     reporter.cycles(cycle_metrics[i], **attrib)
+                summary_extra = dict(attrib)
+                if "tuning" in extra:
+                    # per-knob resolved source (tuned/explicit/
+                    # default): schema minor 9
+                    summary_extra["tuning"] = extra["tuning"]
                 reporter.summary(
                     status=result["status"], cost=result["cost"],
                     violation=result["violation"],
                     cycle=result["cycle"], time=result["time"],
-                    fused_batch=len(sub), **attrib)
+                    fused_batch=len(sub), **summary_extra)
             if register_many is None:
                 register_done(job_id)
             print(f"[ok] {job_id} ({tag} x{len(sub)}, "
@@ -824,7 +848,9 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
             job_padded += rung.cells * len(grp)
         instances = [padded_of[path] for _j, path, _it in sub]
         runner = runner_for_rung(algo, instances, params,
-                                 rung_signature=rung.signature)
+                                 rung_signature=rung.signature,
+                                 tuned_store=tuned_store)
+        tuning_sources = getattr(runner, "tuning_sources", None)
         ck = _rung_checkpointer(checkpoint, checkpoint_every, algo,
                                 sub, precision_name)
         t0 = time.perf_counter()
@@ -839,10 +865,11 @@ def _run_fused_group_inner(key, rows, out_dir, register_done,
         # masked decode: phantom variables never reach the results
         emit(sub, runner.decode(sel), costs, viols, cycles, finished,
              elapsed,
-             lambda path, ri=ri: dict(
+             lambda path, ri=ri, ts=tuning_sources: dict(
                  {"fuse_rung": ri,
                   "padding_waste": waste_of[path]},
-                 **({"reserve": reserve} if reserve else {})),
+                 **({"reserve": reserve} if reserve else {}),
+                 **({"tuning": ts} if ts else {})),
              "fused-hetero",
              cycle_metrics=runner.last_cycle_metrics
              if reporter is not None and ck is None else None)
@@ -888,6 +915,7 @@ def _fused_child_main(argv=None) -> int:
                                     else None),
                      consolidated_out=spec.get("consolidated_out"),
                      hetero=spec.get("hetero", False),
+                     no_tuned=spec.get("no_tuned", False),
                      precision=spec.get("precision"),
                      max_rung_mb=spec.get("max_rung_mb"),
                      telemetry=spec.get("telemetry"),
@@ -1007,6 +1035,7 @@ def run_cmd(args, timeout=None):
                         "out_dir": args.out_dir,
                         "progress_path": progress_path,
                         "hetero": getattr(args, "fuse_hetero", False),
+                        "no_tuned": getattr(args, "no_tuned", False),
                         "precision": getattr(args, "precision", None),
                         "decimation": getattr(args, "decimation",
                                               None),

@@ -195,6 +195,18 @@ def set_parser(subparsers):
                         action="store_true",
                         help="disable the executable cache for this "
                              "daemon (every cold rung recompiles)")
+    parser.add_argument("--tuned-store", dest="tuned_store",
+                        type=str, default=None, metavar="DIR",
+                        help="directory of autotuned per-rung config "
+                             "sidecars (`pydcop autotune`; default: "
+                             "the 'tuned' dir beside the executable "
+                             "cache) — dispatch adopts the measured-"
+                             "fastest config for any knob the request "
+                             "didn't pin; explicit params always win")
+    parser.add_argument("--no-tuned", dest="no_tuned",
+                        action="store_true",
+                        help="never consult autotuned configs: every "
+                             "un-pinned knob stays at its default")
     parser.add_argument("--metrics-port", dest="metrics_port",
                         type=int, default=None, metavar="PORT",
                         help="serve Prometheus metrics over HTTP on "
@@ -359,6 +371,19 @@ def run_cmd(args, timeout=None):
         if faults is not None:
             exec_cache.faults = faults
 
+    # autotuned per-rung configs (`pydcop autotune` sidecars beside
+    # the executable cache): dispatch resolves un-pinned knobs from
+    # them; --no-tuned (or a disabled cache dir) keeps dispatch on
+    # explicit/default resolution only
+    tuned_store = None
+    if not getattr(args, "no_tuned", False):
+        from ..tuning.store import TunedConfigStore
+
+        tuned_store = TunedConfigStore(
+            path=getattr(args, "tuned_store", None))
+        if not tuned_store.enabled:
+            tuned_store = None
+
     registry = None
     if not getattr(args, "no_metrics", False):
         from ..observability.registry import MetricsRegistry
@@ -401,7 +426,8 @@ def run_cmd(args, timeout=None):
             checkpoints=checkpoints,
             session_roi=roi,
             roi_residual_threshold=getattr(
-                args, "roi_residual_threshold", None))
+                args, "roi_residual_threshold", None),
+            tuned_store=tuned_store)
         loop = ServeLoop(admission, dispatcher, reporter=reporter,
                          default_max_cycles=args.max_cycles,
                          default_seed=args.seed,

@@ -131,6 +131,23 @@ def render_status(snap: dict) -> str:
                  "sessions"):
         lines.append(_cache_line(name.replace("_cache", ""),
                                  snap.get(name)))
+    ts = snap.get("tuning_store")
+    if ts is not None:
+        # the autotuned-config store (`pydcop autotune` sidecars):
+        # hit/miss/refused counters plus each rung's persisted winner
+        # and its age — a stale age after an upgrade says re-tune
+        tstats = ts.get("stats") or {}
+        lines.append(_cache_line("tuned", tstats))
+        for entry in ts.get("entries", []):
+            best = entry.get("best") or {}
+            label = (",".join(f"{k}:{v}" for k, v in sorted(
+                best.items())) or "default")
+            age = entry.get("age_s")
+            lines.append(
+                f"    {entry.get('algo', '?')}/"
+                f"{entry.get('rung_label') or '?':<20} "
+                f"{label:<28} "
+                f"age {'n/a' if age is None else f'{age:.0f}s'}")
     ck = snap.get("checkpoints")
     if ck is not None:
         # the preemption-safety counters (serve --checkpoint):

@@ -216,6 +216,16 @@ def set_parser(subparsers):
                              "retrace-free, and structurally "
                              "bit-exact with a cold solve of the "
                              "edited instance")
+    parser.add_argument("--no-tuned", dest="no_tuned",
+                        action="store_true",
+                        help="ignore the per-rung tuned-config store "
+                             "(pydcop autotune): run pure defaults "
+                             "for every knob not given explicitly.  "
+                             "By default, knobs you did not pin are "
+                             "resolved from the instance's home-rung "
+                             "sidecar when one exists; the per-knob "
+                             "resolution (explicit/tuned/default) is "
+                             "echoed in the result's 'tuning' field")
     parser.add_argument("--precision", default=None,
                         choices=["f32", "bf16", "auto"],
                         help="mixed-precision policy for the compiled "
@@ -364,6 +374,71 @@ def _feature_result_fields(args, decim, bnb_flag) -> dict:
     return out
 
 
+#: algo family -> instance-array kind for the tuned-config lookup;
+#: algorithms outside the tuned families skip the store entirely
+_TUNABLE_FAMILY = {"maxsum": "factor", "amaxsum": "factor",
+                   "dsa": "hyper", "mgm": "hyper"}
+
+
+def _tuned_resolution(args, dcop, explicit_params: dict,
+                      context: str, adoptable):
+    """Resolve un-pinned knobs from the instance's home-rung sidecar
+    (``pydcop autotune``), returning ``(adopted knob values, per-knob
+    sources, rung label)``.  Explicit params always win
+    (``tuning/store.resolve_knobs``); knobs outside ``adoptable`` —
+    the set this dispatch surface can actually apply — are reported
+    as ``default`` even if a sidecar carries them.  The store is only
+    consulted when it exists AND holds sidecars, so solves on
+    untuned machines never pay the extra array build the rung
+    identity needs."""
+    if getattr(args, "no_tuned", False):
+        return {}, {}, None
+    kind = _TUNABLE_FAMILY.get(args.algo)
+    if kind is None:
+        return {}, {}, None
+    from ..tuning.store import SIDECAR_SUFFIX, default_store, \
+        resolve_knobs
+
+    store = default_store()
+    try:
+        empty = store.enabled and not any(
+            n.endswith(SIDECAR_SUFFIX) for n in os.listdir(store.path))
+    except OSError:
+        empty = True
+    if not store.enabled or empty:
+        return {}, {}, None
+    from ..dcop.dcop import filter_dcop
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+    from ..parallel.bucketing import ShapeProfile, home_rung, \
+        rung_label
+
+    if kind == "factor":
+        arrays = FactorGraphArrays.build(dcop, arity_sorted=True)
+    else:
+        arrays = HypergraphArrays.build(filter_dcop(dcop))
+    sig = home_rung(ShapeProfile.of(arrays)).signature
+    resolved, sources = resolve_knobs(
+        args.algo, explicit_params, sig, store, context=context)
+    adopted = {}
+    for knob, src in list(sources.items()):
+        if src != "tuned":
+            continue
+        if knob in adoptable:
+            adopted[knob] = resolved[knob]
+        else:
+            sources[knob] = "default"
+    return adopted, sources, rung_label(sig)
+
+
+def _knob_param_str(knob: str, value) -> str:
+    """One adopted knob as the ``-p name:value`` string the algo-param
+    validator consumes (bools in the flag spelling the CLI already
+    uses, e.g. ``bnb:1``)."""
+    if isinstance(value, bool):
+        value = int(value)
+    return f"{knob}:{value}"
+
+
 def _build_checkpointer(args, precision_name: Optional[str]):
     """The run's :class:`~pydcop_tpu.robustness.checkpoint.
     SolveCheckpointer` from ``--checkpoint DIR``, or None.  The
@@ -499,6 +574,31 @@ def run_cmd(args, timeout: Optional[float] = None):
                              precision_name)
     algo_def = build_algo_def(args.algo, args.algo_params,
                               mode=dcop.objective)
+    tuning_sources, tuned_rung = {}, None
+    if args.mode == "engine":
+        from . import parse_algo_params
+
+        # consult the per-rung tuned-config store for every knob the
+        # caller didn't pin; adopted knobs travel as ordinary -p
+        # params, so algo-param validation covers them like any
+        # explicit spelling and the rebuilt algo_def is identical to
+        # the same config passed by hand (bit-exactness by
+        # construction)
+        adopted, tuning_sources, tuned_rung = _tuned_resolution(
+            args, dcop, parse_algo_params(args.algo_params),
+            "engine", adoptable=set(algo_def.params))
+        if adopted:
+            args.algo_params = (args.algo_params or []) + [
+                _knob_param_str(k, v) for k, v in adopted.items()]
+            algo_def = build_algo_def(args.algo, args.algo_params,
+                                      mode=dcop.objective)
+            if "precision" in adopted:
+                precision_name = _resolved_precision_name(args)
+                if checkpointer is not None:
+                    # the snapshot fingerprint carries the precision
+                    # the run really uses, tuned or not
+                    checkpointer = _build_checkpointer(
+                        args, precision_name)
     if precision_name and args.mode != "sharded" \
             and "precision" not in algo_def.params:
         # the algorithm never consults the policy (e.g. dpop): an
@@ -546,6 +646,18 @@ def run_cmd(args, timeout: Optional[float] = None):
             raise CliError(
                 "delta_on:beliefs is a single-chip engine knob; "
                 "sharded convergence keeps the message-delta semantics")
+        # tuned-config consumption, sharded context: layout/precision/
+        # bnb adopt from the home-rung sidecar when not pinned (the
+        # space's validity rules keep e.g. fused off amaxsum)
+        adopted, tuning_sources, tuned_rung = _tuned_resolution(
+            args, dcop, params, "sharded",
+            adoptable={"layout", "precision", "bnb"})
+        params.update(adopted)
+        if "precision" in adopted and not precision_name:
+            from ..ops.precision import resolve as _resolve_precision
+
+            precision_name = _resolve_precision(
+                adopted["precision"]).name
         # same trace granularity rules as engine mode; the sharded
         # trace is recorded ON DEVICE by the mesh engine (zero extra
         # host round-trips), so asking for it never slows the sync path
@@ -589,6 +701,9 @@ def run_cmd(args, timeout: Optional[float] = None):
         if precision_name:
             result["precision"] = precision_name
         result.update(_feature_result_fields(args, decim, bnb_flag))
+        if tuning_sources:
+            result["tuning"] = tuning_sources
+            result["tuned_rung"] = tuned_rung
         if checkpointer is not None:
             result.update(checkpointer.telemetry())
         if res.cost_trace:
@@ -678,6 +793,11 @@ def run_cmd(args, timeout: Optional[float] = None):
         result["precision"] = precision_name
     if args.mode == "engine":
         result.update(_feature_result_fields(args, decim, bnb_flag))
+        if tuning_sources:
+            # per-knob resolution echo (explicit/tuned/default) plus
+            # the rung whose sidecar was consulted
+            result["tuning"] = tuning_sources
+            result["tuned_rung"] = tuned_rung
     if checkpointer is not None:
         result.update(checkpointer.telemetry())
     if res.cost_trace:
@@ -1018,6 +1138,11 @@ def _report_telemetry_records(reporter, args, res, result: dict,
     # whenever the run checkpointed or resumed
     for k in ("checkpoint_s", "checkpoint_bytes",
               "resumed_from_cycle"):
+        if k in result:
+            summary[k] = result[k]
+    # per-knob tuned-config resolution (schema minor 9) rides the
+    # summary whenever the store was consulted
+    for k in ("tuning", "tuned_rung"):
         if k in result:
             summary[k] = result[k]
     reporter.summary(**summary)

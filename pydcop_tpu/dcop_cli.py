@@ -40,14 +40,14 @@ def _make_parser():
                         version=f"pydcop_tpu {__version__}")
 
     subparsers = parser.add_subparsers(dest="command", required=True)
-    from .commands import (agent, batch, consolidate, distribute,
-                           generate, graph, orchestrator, replica_dist,
-                           run, serve, serve_status, solve,
-                           telemetry_validate)
+    from .commands import (agent, autotune, batch, consolidate,
+                           distribute, generate, graph, orchestrator,
+                           replica_dist, run, serve, serve_status,
+                           solve, telemetry_validate)
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, replica_dist, batch, consolidate, serve,
-                   serve_status, telemetry_validate):
+                   serve_status, telemetry_validate, autotune):
         module.set_parser(subparsers)
     return parser
 

@@ -114,11 +114,21 @@ SCHEMA_VERSION = 1
 #: ``groups``/``rebatches``) — plus the ``roi_mode`` echo
 #: (``off``/``on``/``auto``) and the ``roi_flipped`` bool on dynamic
 #: dispatch records (the roi=auto escape hatch fired: this and every
-#: later event runs full sweeps).  A v1.0-1.7 reader stays green by
-#: the one documented forward-compat rule: consumers filter the
-#: stream by the record kinds (and fields) they speak and ignore the
-#: rest.
-SCHEMA_MINOR = 8
+#: later event runs full sweeps).
+#: Minor 9 (per-rung autotuning, ISSUE 18) added the ``tuning``
+#: per-knob resolution echo on summary and serve dispatch records —
+#: a dict mapping each tunable knob (``layout``/``precision``/
+#: ``chunk_size``/``warm_budget``/``nary_max_cells``/``bnb``/
+#: ``delta_on``) to the source its value resolved from (``explicit``:
+#: the caller pinned it; ``tuned``: adopted from the rung's
+#: ``pydcop autotune`` sidecar; ``default``) — plus ``tuned_rung``
+#: (the rung label whose sidecar was consulted) on summary records
+#: and the ``tuning_store`` snapshot block (path, counters, per-entry
+#: winner + age) on stats/heartbeat serve records.  A v1.0-1.8 reader
+#: stays green by the one documented forward-compat rule: consumers
+#: filter the stream by the record kinds (and fields) they speak and
+#: ignore the rest.
+SCHEMA_MINOR = 9
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -150,6 +160,14 @@ PORTFOLIO_KILL_REASONS = ("trailing", "plateau")
 #: the ``roi_mode`` echo vocabulary (schema minor 8): the session's
 #: region-of-interest policy as RESOLVED by the dynamic engine
 ROI_MODES = ("off", "on", "auto")
+
+#: the ``tuning`` echo vocabulary (schema minor 9): per-knob value
+#: provenance on dispatch records — mirrors ``tuning.space.KNOBS`` /
+#: ``TUNING_SOURCES`` (asserted equal in the schema tests; duplicated
+#: here like EDIT_KEYS so the validator stays import-light)
+TUNING_KNOBS = ("layout", "precision", "chunk_size", "warm_budget",
+                "nary_max_cells", "bnb", "delta_on")
+TUNING_SOURCES = ("explicit", "tuned", "default")
 
 
 class RunReporter:
@@ -366,6 +384,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_ckpt_fields(rec, "summary")
         _check_roi_fields(rec, "summary")
         _check_portfolio_fields(rec, "summary")
+        _check_tuning_fields(rec, "summary")
         rc = rec.get("reason_class")
         if rc is not None and (not isinstance(rc, str) or not rc):
             raise ValueError(
@@ -392,6 +411,7 @@ def validate_record(rec: Dict[str, Any]):
         _check_ckpt_fields(rec, "serve")
         _check_roi_fields(rec, "serve")
         _check_portfolio_fields(rec, "serve")
+        _check_tuning_fields(rec, "serve")
         depth = rec.get("queue_depth")
         if depth is not None and (not isinstance(depth, int)
                                   or depth < 0):
@@ -499,6 +519,39 @@ def _check_roi_fields(rec, kind):
                            or not isinstance(fx, int) or fx < 0):
         raise ValueError(
             f"{kind} record with bad frontier_expansions {fx!r}")
+
+
+def _check_tuning_fields(rec, kind):
+    """Optional schema-minor-9 fields: the per-knob ``tuning``
+    resolution echo (knob -> explicit/tuned/default), ``tuned_rung``
+    (the rung label whose sidecar dispatch consulted) and the
+    ``tuning_store`` snapshot on stats/heartbeat serve records.
+    Exhaustive like ``edit``: an unknown knob or source is a schema
+    violation, so emitters and the vocabulary cannot drift."""
+    tuning = rec.get("tuning")
+    if tuning is not None:
+        if not isinstance(tuning, dict):
+            raise ValueError(
+                f"{kind} 'tuning' must be a dict of knob -> source, "
+                f"got {type(tuning).__name__}")
+        for k, v in tuning.items():
+            if k not in TUNING_KNOBS:
+                raise ValueError(
+                    f"{kind} tuning with unknown knob {k!r}; "
+                    f"known: {', '.join(TUNING_KNOBS)}")
+            if v not in TUNING_SOURCES:
+                raise ValueError(
+                    f"{kind} tuning[{k!r}] with unknown source "
+                    f"{v!r}; known: {', '.join(TUNING_SOURCES)}")
+    tr = rec.get("tuned_rung")
+    if tr is not None and (not isinstance(tr, str) or not tr):
+        raise ValueError(
+            f"{kind} record with bad tuned_rung {tr!r}")
+    ts = rec.get("tuning_store")
+    if ts is not None and not isinstance(ts, dict):
+        raise ValueError(
+            f"{kind} 'tuning_store' must be the store snapshot "
+            f"dict, got {type(ts).__name__}")
 
 
 #: the ``portfolio`` block's legal top-level keys (schema minor 8)

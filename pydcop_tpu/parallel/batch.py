@@ -97,6 +97,11 @@ class _BatchedRunnerBase:
         #: dispatch) and raises FaultInjected when the plan fires;
         #: None (always, outside chaos runs) is dead code
         self.fault_hook = None
+        #: per-knob resolution of the params this runner was built
+        #: with (explicit/tuned/default), set by ``runner_for_rung``
+        #: when a tuned-config store was consulted; None when tuning
+        #: was not in play (direct construction, no store)
+        self.tuning_sources: Optional[Dict[str, str]] = None
         self.last_spans: Dict[str, float] = {}
         #: trace ids of the jobs the last run() executed for, in batch
         #: order (serve dispatches thread them through so a shared
@@ -839,13 +844,31 @@ def evict_runner(algo: str, rung_signature: Tuple, batch: int,
 
 def runner_for_rung(algo: str, instances, params: dict,
                     rung_signature: Optional[Tuple] = None,
-                    exec_cache=None):
+                    exec_cache=None, tuned_store=None):
     """Build — or fetch and re-point — the batched runner for ``algo``
     over instances padded to one rung shape.  ``exec_cache`` (an
     :class:`~pydcop_tpu.engine._cache.ExecutableCache`) additionally
     persists the compiled program across PROCESSES, keyed by this
-    rung-signature identity — the serve daemon's warm restart."""
+    rung-signature identity — the serve daemon's warm restart.
+
+    ``tuned_store`` (a :class:`~pydcop_tpu.tuning.store
+    .TunedConfigStore`) folds the rung's measured-fastest knobs into
+    ``params`` BEFORE the cache key is computed — a caller pinning
+    the winning config explicitly and a caller resolving it from the
+    store land on the SAME cached runner and the SAME compiled
+    program, which is what makes tuned selections bit-exact with the
+    explicit spelling by construction.  Explicit params always win;
+    the per-knob resolution (``explicit``/``tuned``/``default``)
+    lands on ``runner.tuning_sources`` for result blocks and
+    telemetry."""
     cls = BATCHED_CLASSES[algo]
+    tuning_sources = None
+    if tuned_store is not None and rung_signature is not None:
+        from ..tuning.store import resolve_knobs
+
+        params, tuning_sources = resolve_knobs(
+            algo, params, rung_signature, tuned_store,
+            context="batched")
     key = None
     if rung_signature is not None:
         key = (algo, rung_signature, len(instances),
@@ -856,10 +879,12 @@ def runner_for_rung(algo: str, instances, params: dict,
             if exec_cache is not None:
                 runner.exec_cache = exec_cache
                 runner.exec_cache_key = key
+            runner.tuning_sources = tuning_sources
             runner.set_instances(instances)
             return runner
         _RUNNER_CACHE_STATS["misses"] += 1
     runner = cls(instances[0], instances=list(instances), **params)
+    runner.tuning_sources = tuning_sources
     if exec_cache is not None:
         runner.exec_cache = exec_cache
         runner.exec_cache_key = key if key is not None else (

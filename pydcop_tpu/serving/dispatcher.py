@@ -62,6 +62,14 @@ def _stage_metrics(registry):
             "per-rung pipeline stage latency (queue_wait/batch_form/"
             "deserialize/compile/execute)",
             labels=("rung", "stage")),
+        "tuning_hits": registry.counter(
+            "pydcop_tuning_hits_total",
+            "dispatches that adopted an autotuned per-rung config",
+            labels=("rung",)),
+        "tuning_misses": registry.counter(
+            "pydcop_tuning_misses_total",
+            "dispatches with no usable tuned config for the rung",
+            labels=("rung",)),
     }
 
 
@@ -512,9 +520,15 @@ class Dispatcher:
                  journal=None, session_layout: str = "edge_major",
                  warm_budget: str = "adaptive",
                  checkpoints=None, session_roi: bool = False,
-                 roi_residual_threshold: Optional[float] = None):
+                 roi_residual_threshold: Optional[float] = None,
+                 tuned_store=None):
         self.reporter = reporter
         self.exec_cache = exec_cache
+        #: autotuned per-rung config sidecars (tuning/store.py; None =
+        #: dispatch never consults them).  Knobs the request didn't
+        #: pin resolve from the rung's measured-fastest config; the
+        #: per-knob sources ride every summary and dispatch record
+        self.tuned_store = tuned_store
         self.clock = clock
         self.batch_pow2 = bool(batch_pow2)
         self.registry = registry
@@ -648,6 +662,20 @@ class Dispatcher:
         jobs = group.jobs
         algo, params_t, max_cycles, rung_sig = group.key
         params = dict(params_t)
+        # autotuned per-rung config: resolve un-pinned knobs from the
+        # sidecar store BEFORE the runner build, so the resolved
+        # params feed the runner-cache key (tuned and explicit
+        # same-config dispatches share one compiled program) and the
+        # per-knob sources are known for every record of this
+        # dispatch.  resolve_knobs degrades to all-default on
+        # fingerprint/store refusal (warned once inside the store)
+        tuning_sources = None
+        if self.tuned_store is not None:
+            from ..tuning.store import resolve_knobs
+
+            params, tuning_sources = resolve_knobs(
+                algo, params, rung_sig, self.tuned_store,
+                context="batched")
         B = len(jobs)
         # dispatch ATTEMPTS in daemon order, failures included — the
         # key a fault plan's transient dispatch_index entries fire on
@@ -735,6 +763,8 @@ class Dispatcher:
                 rec["trace_id"] = job.trace_id
             if "precision" in params:
                 rec["precision"] = params["precision"]
+            if tuning_sources is not None:
+                rec["tuning"] = dict(tuning_sources)
             records.append(rec)
             if self.reporter is not None:
                 self.reporter.summary(**rec)
@@ -746,6 +776,14 @@ class Dispatcher:
         spans = dict(self.last_spans)
         label = f"{algo}/{rung_label(rung_sig)}"
         self._observe_dispatch(label, group.reason, B, waits, spans)
+        if tuning_sources is not None and self._metrics is not None:
+            # hit = at least one knob actually came from the sidecar
+            # (an all-default resolution is a miss for this rung)
+            key = ("tuning_hits"
+                   if any(s == "tuned"
+                          for s in tuning_sources.values())
+                   else "tuning_misses")
+            self._metrics[key].inc(rung=label)
         if self.reporter is not None:
             for i, job in enumerate(jobs):
                 if not job.trace_id:
@@ -765,6 +803,8 @@ class Dispatcher:
                 wait_s={"max": round(max(waits), 6),
                         "mean": round(sum(waits) / len(waits), 6)},
                 spans=spans,
+                **({"tuning": dict(tuning_sources)}
+                   if tuning_sources is not None else {}),
                 exec_cache=(dict(self.exec_cache.stats)
                             if self.exec_cache is not None else None),
                 runner_cache=runner_cache_stats())

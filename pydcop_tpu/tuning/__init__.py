@@ -1,0 +1,40 @@
+"""Per-rung offline autotuning.
+
+The knob pile the perf rounds accumulated — step layout, precision
+policy, engine chunk size, warm-budget schedule, n-ary cell ceiling,
+branch-and-bound pruning — is rung-dependent: fused beats edge-major
+1.76x on the warm mesh ladder, bf16 admits 2x rungs per byte budget,
+bnb prunes 87.5% on PEAV and 12.2% on SECP.  Because the program
+universe is bounded by the pow2 rung ladder (``parallel/bucketing``),
+an offline search over (rung × knob grid) is tractable and its
+results are durable artifacts:
+
+* :mod:`space` — the declarative knob space with per-rung validity
+  predicates mirroring the existing loud-rejection rules;
+* :mod:`autotune` — the measurement loop (warmup + best-of-N
+  medians through the real runners, successive-halving pruning)
+  behind ``pydcop autotune``;
+* :mod:`store` — the :class:`TunedConfigStore`: JSON sidecars beside
+  the executable cache, keyed by rung-signature × algorithm, carrying
+  the winning config and the measured ms/cycle table, fingerprinted
+  like checkpoint manifests (drift refuses the sidecar with a
+  structured error) and consumed by ``runner_for_rung`` / ``solve`` /
+  ``batch --fuse-hetero`` / the serve dispatcher whenever a knob was
+  not pinned explicitly.  Explicit flags always win; the resolved
+  source of every knob (``explicit``/``tuned``/``default``) is echoed
+  in result blocks and telemetry (schema minor 9).
+"""
+
+from .space import (CONTEXTS, KNOBS, TUNING_SOURCES, config_label,
+                    enumerate_configs, invalid_reason, knob_domain)
+from .store import (STORE_VERSION, TunedConfigStore, TuningError,
+                    check_tuning_fingerprint, default_store,
+                    resolve_knobs, tuning_fingerprint)
+
+__all__ = [
+    "CONTEXTS", "KNOBS", "TUNING_SOURCES", "config_label",
+    "enumerate_configs", "invalid_reason", "knob_domain",
+    "STORE_VERSION", "TunedConfigStore", "TuningError",
+    "check_tuning_fingerprint", "default_store", "resolve_knobs",
+    "tuning_fingerprint",
+]

@@ -1,0 +1,381 @@
+"""The offline measurement loop behind ``pydcop autotune``.
+
+The program universe is bounded: the pow2 rung ladder
+(``parallel/bucketing``) quantizes every instance shape into a small
+set of compiled programs, so an offline search over (rung × knob
+grid) is tractable and its winners are durable artifacts (PGMax makes
+the same observation for its bounded factor-shape universe).  The
+loop here:
+
+1. **Rung acquisition** — three spellings of "which rungs matter":
+   explicit labels (:func:`parse_rung_label`, the exact inverse of
+   ``bucketing.rung_label``), a corpus of DCOP files grouped by their
+   ``home_rung`` (:func:`rungs_from_corpus` — the same
+   build-arrays → profile → rung path the fused campaign runner
+   walks), or a serve telemetry JSONL replayed for the rungs the
+   daemon actually dispatched (:func:`rungs_from_telemetry`).
+2. **Measurement** — every candidate runs through the REAL dispatch
+   path (``runner_for_rung`` + optional ``ExecutableCache``), so
+   compile cost is paid once per (rung, config) and the measured
+   program is byte-identical to what production dispatch will run.
+   Warmup run first (compiles), then best-of-N timed repeats;
+   ms/cycle divides by the cycles the batch actually executed.
+3. **Successive halving** — the full grid runs one SHORT stage
+   (quarter cycle budget, single repeat), the bottom half is pruned,
+   survivors re-measure at full budget.  The default config is never
+   pruned: the final argmin must always contain the default's
+   full-budget measurement, which is what makes the never-slower
+   contract an arithmetic identity rather than a hope.
+
+The winner and the complete measured table persist through
+:class:`~pydcop_tpu.tuning.store.TunedConfigStore` — dispatch reads
+them back via ``resolve_knobs``.
+"""
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .space import config_label, enumerate_configs, invalid_reason
+from .store import TunedConfigStore
+
+logger = logging.getLogger(__name__)
+
+#: algo family -> instance-array kind, mirroring
+#: ``commands/batch.FUSABLE_ALGOS`` for the batched runner families
+ALGO_KIND = {"maxsum": "factor", "dsa": "hyper", "mgm": "hyper"}
+
+
+# ------------------------------------------------------ rung parsing
+
+
+def parse_rung_label(label: str) -> Tuple:
+    """The inverse of ``bucketing.rung_label``:
+    ``factor:d3:v17:a2x32`` (optionally ``:pN`` for hyper pairs) back
+    into the ``Rung.signature`` tuple.  Malformed labels die loudly
+    with the expected grammar — an autotune run over a typo'd rung
+    would persist a sidecar no dispatch ever reads."""
+    parts = [p for p in str(label).split(":") if p]
+    try:
+        kind = parts[0]
+        if kind not in ("factor", "hyper"):
+            raise ValueError(f"kind {kind!r}")
+        if not (parts[1].startswith("d") and parts[2].startswith("v")):
+            raise ValueError("missing d/v fields")
+        max_domain = int(parts[1][1:])
+        n_vars = int(parts[2][1:])
+        slots = []
+        n_pairs = 0
+        for part in parts[3:]:
+            if part.startswith("a") and "x" in part:
+                arity, count = part[1:].split("x")
+                slots.append((int(arity), int(count)))
+            elif part.startswith("p"):
+                n_pairs = int(part[1:])
+            else:
+                raise ValueError(f"field {part!r}")
+        return (kind, max_domain, n_vars, tuple(sorted(slots)),
+                n_pairs)
+    except (IndexError, ValueError) as e:
+        raise ValueError(
+            f"rung label {label!r} does not parse ({e}); expected "
+            f"the rung_label grammar, e.g. factor:d3:v17:a2x32 or "
+            f"hyper:d3:v33:a2x64:p128")
+
+
+def _rung_from_signature(signature):
+    from ..parallel.bucketing import Rung
+
+    kind, max_domain, n_vars, slots, n_pairs = signature
+    return Rung(kind=str(kind), max_domain=int(max_domain),
+                n_vars=int(n_vars),
+                bucket_slots={int(a): int(c) for a, c in slots},
+                n_pairs=int(n_pairs))
+
+
+# ------------------------------------------- synthetic rung instances
+
+
+def synthetic_instances(signature, algo: str, batch: int = 4,
+                        seed: int = 0) -> List:
+    """A batch of synthetic instances padded to ``signature``'s shape
+    — what label/telemetry-mode autotune measures on when no corpus
+    supplies real instances.  Coloring-family generators sized just
+    under the rung capacity, one seed per batch row, padded through
+    the SAME ``Rung.pad`` path the fused campaign uses (``pad_to``
+    emits the canonical layout the hetero runners require)."""
+    from ..generators.fast import (coloring_factor_arrays,
+                                   coloring_hypergraph_arrays,
+                                   nary_factor_arrays)
+    from ..parallel.bucketing import ShapeProfile
+
+    kind = ALGO_KIND.get(algo)
+    if kind is None:
+        raise ValueError(
+            f"{algo} has no batched runner to autotune (families: "
+            f"{', '.join(sorted(ALGO_KIND))})")
+    rung = _rung_from_signature(signature)
+    if rung.kind != kind:
+        raise ValueError(
+            f"rung {signature} is {rung.kind}-kind but {algo} "
+            f"runs on {kind} instances")
+    # the rung's own sink row means real instances stay strictly
+    # under the padded variable count
+    nv = max(2, rung.n_vars - 1)
+    max_edges = nv * (nv - 1) // 2
+    slots = dict(rung.bucket_slots)
+    out = []
+    for i in range(int(batch)):
+        if kind == "hyper":
+            n_edges = max(1, min(slots.get(2, 1),
+                                 rung.n_pairs // 2 or 1, max_edges))
+            arrays = coloring_hypergraph_arrays(
+                nv, n_edges, n_colors=rung.max_domain, seed=seed + i)
+        elif set(slots) <= {2}:
+            n_edges = max(1, min(slots.get(2, 1), max_edges))
+            arrays = coloring_factor_arrays(
+                nv, n_edges, n_colors=rung.max_domain, seed=seed + i)
+        else:
+            arrays = nary_factor_arrays(
+                nv, {a: max(1, c) for a, c in slots.items()},
+                n_values=rung.max_domain, seed=seed + i)
+        profile = ShapeProfile.of(arrays)
+        if not rung.covers(profile):
+            raise ValueError(
+                f"synthetic instance {profile} escaped rung "
+                f"{signature}; cannot measure this rung without a "
+                f"corpus instance that fits it")
+        out.append(rung.pad(arrays))
+    return out
+
+
+# ------------------------------------------------------- measurement
+
+
+def measure_ms_per_cycle(algo: str, instances, params: Dict,
+                         rung_signature, cycles: int = 32,
+                         repeats: int = 3, exec_cache=None) -> float:
+    """Best-of-``repeats`` ms/cycle of one (rung, config) through the
+    real batched dispatch path.  The warmup run pays the compile; the
+    timed runs measure exactly the program production dispatch reuses
+    (same ``runner_for_rung`` cache key, same executable)."""
+    from ..parallel.batch import runner_for_rung
+
+    runner = runner_for_rung(algo, instances, dict(params),
+                             rung_signature=rung_signature,
+                             exec_cache=exec_cache)
+    runner.run(seed=0, max_cycles=int(cycles))          # warmup
+    best = float("inf")
+    for _ in range(max(1, int(repeats))):
+        t0 = time.perf_counter()
+        _sel, cyc, _fin = runner.run(seed=0, max_cycles=int(cycles))
+        elapsed = time.perf_counter() - t0
+        executed = float(np.mean(np.asarray(cyc)))
+        best = min(best, elapsed * 1e3 / max(executed, 1.0))
+    return best
+
+
+def autotune_rung(algo: str, instances, rung_signature,
+                  cycles: int = 32, repeats: int = 3,
+                  pinned: Optional[Dict] = None,
+                  context: str = "batched", exec_cache=None,
+                  progress=None) -> Dict:
+    """Search the valid candidate grid for one rung and return the
+    result block: winning config, full measured table, halving
+    stats.  ``pinned`` knobs are excluded from the search (explicit
+    always wins at dispatch, so their alternatives are unreachable).
+    """
+    pinned = dict(pinned or {})
+    candidates = enumerate_configs(algo, context, pinned=pinned)
+    say = progress or (lambda msg: None)
+
+    def run(config, budget, reps):
+        return measure_ms_per_cycle(
+            algo, instances, dict(pinned, **config), rung_signature,
+            cycles=budget, repeats=reps, exec_cache=exec_cache)
+
+    # stage 1: the whole grid at a quarter budget, one repeat
+    short = max(4, int(cycles) // 4)
+    stage1 = []
+    for config in candidates:
+        ms = run(config, short, 1)
+        stage1.append((ms, config))
+        say(f"  stage1 {config_label(config)}: {ms:.3f} ms/cycle")
+    # keep the top half; the default ({}) is NEVER pruned — the final
+    # argmin must contain its full-budget measurement (never-slower)
+    keep = max(1, (len(stage1) + 1) // 2)
+    ranked = sorted(stage1, key=lambda t: t[0])
+    survivors = [c for _ms, c in ranked[:keep]]
+    if {} not in survivors:
+        survivors.insert(0, {})
+    stage1_ms = {config_label(c): ms for ms, c in stage1}
+
+    # stage 2: survivors at full budget, best-of-N
+    table = []
+    for config in candidates:
+        label = config_label(config)
+        row = {"label": label, "config": config,
+               "stage1_ms_per_cycle": round(stage1_ms[label], 4),
+               "pruned": config not in survivors,
+               "ms_per_cycle": None}
+        if config in survivors:
+            ms = run(config, int(cycles), repeats)
+            row["ms_per_cycle"] = round(ms, 4)
+            say(f"  full   {label}: {ms:.3f} ms/cycle")
+        table.append(row)
+    finals = [r for r in table if r["ms_per_cycle"] is not None]
+    best_row = min(finals, key=lambda r: r["ms_per_cycle"])
+    default_row = next(r for r in finals if not r["config"])
+    return {
+        "algo": algo,
+        "context": context,
+        "best": dict(best_row["config"]),
+        "best_label": best_row["label"],
+        "best_ms_per_cycle": best_row["ms_per_cycle"],
+        "default_ms_per_cycle": default_row["ms_per_cycle"],
+        "speedup_vs_default": round(
+            default_row["ms_per_cycle"]
+            / max(best_row["ms_per_cycle"], 1e-9), 3),
+        "candidates": len(candidates),
+        "pruned": sum(r["pruned"] for r in table),
+        "cycles": int(cycles),
+        "repeats": int(repeats),
+        "table": table,
+    }
+
+
+# -------------------------------------------------- rung acquisition
+
+
+def rungs_from_corpus(paths: Sequence[str], algo: str,
+                      reserve=None) -> List[Tuple]:
+    """(rung, padded member instances) per distinct home rung of a
+    DCOP-file corpus — the exact build-arrays → profile → home-rung
+    walk the fused campaign and serve admission use, so autotune
+    measures the rungs those paths will dispatch."""
+    from ..dcop.dcop import filter_dcop
+    from ..dcop.yamldcop import load_dcop_from_file
+    from ..graphs.arrays import FactorGraphArrays, HypergraphArrays
+    from ..parallel.bucketing import ShapeProfile, home_rung
+
+    kind = ALGO_KIND.get(algo)
+    if kind is None:
+        raise ValueError(
+            f"{algo} has no batched runner to autotune (families: "
+            f"{', '.join(sorted(ALGO_KIND))})")
+    arrays_list = []
+    for path in paths:
+        dcop = load_dcop_from_file(path)
+        if kind == "factor":
+            arrays_list.append(
+                FactorGraphArrays.build(dcop, arity_sorted=True))
+        else:
+            arrays_list.append(
+                HypergraphArrays.build(filter_dcop(dcop)))
+    by_sig: Dict[Tuple, Tuple] = {}
+    for arrays in arrays_list:
+        rung = home_rung(ShapeProfile.of(arrays), reserve=reserve)
+        sig = rung.signature
+        if sig not in by_sig:
+            by_sig[sig] = (rung, [])
+        by_sig[sig][1].append(rung.pad(arrays))
+    return [(rung, members) for rung, members in by_sig.values()]
+
+
+def rungs_from_telemetry(path: str,
+                         algo: Optional[str] = None) -> List[Tuple]:
+    """(algo, rung signature) pairs replayed from a serve telemetry
+    JSONL — the rungs (and algorithms) a daemon actually dispatched,
+    read from the ``rung`` field its dispatch/summary records carry.
+    ``algo`` filters to one family; unparseable lines are skipped
+    (telemetry files interleave many record kinds), but a file
+    yielding NO rungs is an error, not an empty tune."""
+    import json
+
+    seen, out = set(), []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            rung = rec.get("rung")
+            rec_algo = rec.get("algo")
+            if not rung or not rec_algo:
+                continue
+            if algo is not None and rec_algo != algo:
+                continue
+            try:
+                sig = _norm(rung)
+                if len(sig) != 5:
+                    continue
+            except TypeError:
+                continue
+            key = (rec_algo, sig)
+            if key not in seen:
+                seen.add(key)
+                out.append(key)
+    if not out:
+        raise ValueError(
+            f"{path} carries no dispatch records with rung "
+            f"signatures"
+            + (f" for algo {algo}" if algo else "")
+            + "; is it a serve telemetry file?")
+    return out
+
+
+def _norm(sig):
+    if isinstance(sig, (list, tuple)):
+        return tuple(_norm(s) for s in sig)
+    return sig
+
+
+# ------------------------------------------------------------ driver
+
+
+def autotune(rung_sets: List[Tuple], cycles: int = 32,
+             repeats: int = 3, pinned: Optional[Dict] = None,
+             context: str = "batched",
+             store: Optional[TunedConfigStore] = None,
+             exec_cache=None, progress=None) -> List[Dict]:
+    """Tune every (algo, rung, instances) triple in ``rung_sets`` and
+    persist each winner (plus its full measured table) to ``store``.
+    Invalid pins die up front — one loud error beats a whole
+    measurement campaign of unreachable configs."""
+    from ..parallel.bucketing import rung_label
+
+    say = progress or (lambda msg: None)
+    pinned = dict(pinned or {})
+    results = []
+    for algo, rung_signature, instances in rung_sets:
+        reason = invalid_reason(algo, pinned, context)
+        if reason is not None:
+            raise ValueError(
+                f"pinned params invalid for {algo}/{context}: "
+                f"{reason}")
+        label = rung_label(rung_signature)
+        say(f"[autotune] {algo} {label} "
+            f"(batch {len(instances)}, {cycles} cycles)")
+        result = autotune_rung(
+            algo, instances, rung_signature, cycles=cycles,
+            repeats=repeats, pinned=pinned, context=context,
+            exec_cache=exec_cache, progress=progress)
+        result["rung"] = list(_norm(rung_signature))
+        result["rung_label"] = label
+        result["batch"] = len(instances)
+        if store is not None:
+            result["sidecar"] = store.store(
+                algo, rung_signature, result["best"], result["table"],
+                rung_label=label)
+            say(f"[autotune] {algo} {label} -> "
+                f"{result['best_label']} "
+                f"({result['best_ms_per_cycle']} ms/cycle, "
+                f"default {result['default_ms_per_cycle']}) "
+                f"persisted")
+        results.append(result)
+    return results

@@ -1,0 +1,165 @@
+"""The declarative knob space the autotuner searches.
+
+One table declares, per knob: which dispatch *contexts* it exists in
+(``batched`` — the vmapped campaign runners behind ``runner_for_rung``;
+``engine`` — the single-instance sync engine; ``sharded`` — the device
+mesh; ``warm`` — the dynamic warm engine) and which algorithm families
+accept it.  The validity predicate :func:`invalid_reason` mirrors the
+LOUD-rejection rules the runtime already enforces — it never invents a
+new rule, so a config the space admits is a config the runners accept:
+
+* batched runners reject ``bnb`` (pruned-reduction plans are
+  build-time constants of one instance's cubes —
+  ``parallel/batch.BatchedMaxSum``);
+* ``amaxsum`` has no fused layout (``parallel/__init__`` raises);
+* sharded convergence keeps message-delta semantics
+  (``delta_on:beliefs`` is single-chip only — ``commands/solve``);
+* the sharded mesh stays ``edge_major`` except the maxsum fused
+  layout (``ShardedFusedMaxSum``).
+
+:func:`enumerate_configs` expands the grid for one (algo, context),
+always listing the **default config** (``{}``) first — the autotuner's
+never-slower contract is an argmin over a candidate set that contains
+the default, so tuning can only match or improve it.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+#: every dispatch surface a tuned config can apply to
+CONTEXTS = ("batched", "engine", "sharded", "warm")
+
+#: the tunable knobs, in the canonical (sidecar/record) order
+KNOBS = ("layout", "precision", "chunk_size", "warm_budget",
+         "nary_max_cells", "bnb", "delta_on")
+
+#: how a knob's value was resolved at dispatch (echoed per knob in
+#: result blocks and telemetry — schema minor 9)
+TUNING_SOURCES = ("explicit", "tuned", "default")
+
+#: algo families the batched campaign runners implement
+#: (``parallel/batch.BATCHED_CLASSES``)
+BATCHED_FAMILIES = ("maxsum", "dsa", "mgm")
+
+#: knob -> (applicable contexts, candidate values).  Values are the
+#: SEARCHED grid; validity per (algo, context) is refined below.
+_KNOB_TABLE: Dict[str, Tuple[Tuple[str, ...], Tuple]] = {
+    "layout": (("warm", "sharded"),
+               ("edge_major", "lane_major", "fused")),
+    "precision": (("batched", "engine", "sharded", "warm"),
+                  ("f32", "bf16")),
+    "chunk_size": (("engine", "warm"), (8, 16, 32, 64)),
+    "warm_budget": (("warm",), ("adaptive", "fixed")),
+    "nary_max_cells": (("engine",), (2048, 4096, 8192)),
+    "bnb": (("engine", "sharded"), (False, True)),
+    "delta_on": (("batched", "engine"), ("messages", "beliefs")),
+}
+
+
+def knob_domain(knob: str, algo: str, context: str) -> Tuple:
+    """The candidate values of ``knob`` for one (algo, context) —
+    empty when the knob does not exist on that dispatch surface."""
+    if knob not in _KNOB_TABLE:
+        raise ValueError(
+            f"unknown knob {knob!r}; known: {', '.join(KNOBS)}")
+    if context not in CONTEXTS:
+        raise ValueError(
+            f"unknown context {context!r}; known: "
+            f"{', '.join(CONTEXTS)}")
+    contexts, values = _KNOB_TABLE[knob]
+    if context not in contexts:
+        return ()
+    kept = tuple(
+        v for v in values
+        if invalid_reason(algo, {knob: v}, context) is None)
+    return kept
+
+
+def invalid_reason(algo: str, config: Dict, context: str
+                   ) -> Optional[str]:
+    """Why ``config`` is invalid for (algo, context) — None when it is
+    valid.  Each rule names the runtime rejection it mirrors, so the
+    space and the runners cannot drift silently."""
+    for knob in config:
+        if knob not in _KNOB_TABLE:
+            return (f"unknown knob {knob!r}; known: "
+                    f"{', '.join(KNOBS)}")
+        if context not in _KNOB_TABLE[knob][0]:
+            return (f"{knob} is not a {context}-context knob "
+                    f"(applies to: "
+                    f"{', '.join(_KNOB_TABLE[knob][0])})")
+    if config.get("bnb") and context == "batched":
+        # mirror: parallel/batch.BatchedMaxSum raises — pruned
+        # reduction plans are build-time constants of ONE instance's
+        # cubes, batched cubes are vmapped arguments
+        return ("batched runners reject bnb: pruned-reduction plans "
+                "are build-time constants of one instance's cubes")
+    if config.get("bnb") and algo not in ("maxsum", "amaxsum"):
+        return f"bnb is a maxsum-family knob, not {algo}"
+    if config.get("layout") == "fused" and algo == "amaxsum":
+        # mirror: parallel/__init__._build_sharded_solver raises
+        return ("amaxsum has no fused mesh layout (only maxsum's "
+                "ShardedFusedMaxSum speaks it)")
+    if context == "sharded" and \
+            config.get("layout") not in (None, "edge_major") and \
+            not (algo == "maxsum" and config.get("layout") == "fused"):
+        # the mesh families compile the edge-major step; only maxsum
+        # grew the fused shard-local alternative
+        return (f"sharded {algo} stays edge_major "
+                f"(layout {config['layout']!r} has no mesh program)")
+    if config.get("delta_on", "messages") != "messages":
+        if algo != "maxsum":
+            return f"delta_on is a maxsum knob, not {algo}"
+        if context == "sharded":
+            # mirror: commands/solve rejects -p delta_on:beliefs in
+            # sharded mode — mesh convergence keeps message deltas
+            return ("delta_on:beliefs is a single-chip engine knob; "
+                    "sharded convergence keeps message-delta "
+                    "semantics")
+    if context == "batched" and algo not in BATCHED_FAMILIES:
+        return (f"{algo} has no batched campaign runner (families: "
+                f"{', '.join(BATCHED_FAMILIES)})")
+    return None
+
+
+def enumerate_configs(algo: str, context: str = "batched",
+                      pinned: Optional[Dict] = None) -> List[Dict]:
+    """The valid candidate grid for one (algo, context), default
+    config first.  ``pinned`` knobs (the operator's explicit ``-p``
+    params) are excluded from the search dimensions — an explicit
+    knob always wins, so searching over it would measure configs
+    dispatch can never run."""
+    pinned = dict(pinned or {})
+    dims: List[Tuple[str, Tuple]] = []
+    for knob in KNOBS:
+        if knob in pinned:
+            continue
+        values = knob_domain(knob, algo, context)
+        # only knobs with a real choice become search dimensions
+        if len(values) > 1:
+            dims.append((knob, values))
+    configs: List[Dict] = [{}]
+    for knob, values in dims:
+        default = _KNOB_TABLE[knob][1][0]
+        configs = [
+            dict(c, **({} if v == default else {knob: v}))
+            for c in configs for v in values]
+    # dedupe (defaults collapse to {}), keep {} first, drop invalid
+    seen, out = set(), []
+    for c in configs:
+        key = tuple(sorted(c.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        if invalid_reason(algo, dict(pinned, **c), context) is None:
+            out.append(c)
+    out.sort(key=lambda c: (len(c) != 0, config_label(c)))
+    return out
+
+
+def config_label(config: Dict) -> str:
+    """One compact token per candidate (tables, logs, metric labels):
+    ``default`` for the empty config, else ``knob:value`` pairs in
+    canonical knob order."""
+    if not config:
+        return "default"
+    return ",".join(f"{k}:{config[k]}" for k in KNOBS if k in config)

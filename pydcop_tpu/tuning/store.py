@@ -1,0 +1,344 @@
+"""The persisted tuned-config store.
+
+One JSON sidecar per (algo × rung-signature), living beside the
+executable cache (``default_cache_dir("tuned")`` next to
+``"executables"``), carrying the measured-fastest config, the full
+ms/cycle table for every candidate the search ran, and the
+environment fingerprint it was measured under.  Three policies are
+deliberately inherited, not re-invented:
+
+* **fingerprinting** follows the checkpoint manifests
+  (``robustness/checkpoint.py``): a sidecar measured under a
+  different jax version / backend / machine arch / device count is
+  REFUSED with a structured :class:`TuningError` naming every drifted
+  field — timings from another environment are not merely stale, they
+  can invert (the bnb prune rate flips between PEAV and SECP; fused
+  wins on mesh and loses on host CPU).  Unlike the executable cache
+  (which folds the fingerprint into the key so a drifted environment
+  just misses), the sidecar is keyed WITHOUT the fingerprint: a
+  drifted environment *finds* the file and gets the loud refusal,
+  so the operator learns their tuning is void instead of silently
+  running defaults forever.
+* **corruption** reuses ``engine/_cache.quarantine_file``: a torn or
+  bit-rotted sidecar moves aside to ``*.corrupt``, counts, and reads
+  as a miss — never a crash, never re-read forever.
+* **writes** go through ``robustness/checkpoint.atomic_write``
+  (write-temp → fsync → rename): a kill mid-store leaves the previous
+  sidecar intact.
+
+Consumption (:func:`resolve_knobs`) enforces the precedence contract:
+``explicit`` (caller pinned the knob) beats ``tuned`` (store supplied
+it) beats ``default`` (runner's own default).  The resolved source of
+every applicable knob is returned beside the resolved params so every
+dispatch path — solve result blocks, batch records, serve dispatch
+records — can echo exactly where each knob came from.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine._cache import (cache_disabled, default_cache_dir,
+                             quarantine_file)
+from .space import KNOBS, invalid_reason, knob_domain
+
+logger = logging.getLogger(__name__)
+
+#: bump on any incompatible sidecar layout change; readers refuse
+#: newer-versioned sidecars loudly instead of misparsing them
+STORE_VERSION = 1
+
+#: sidecar file suffix — distinguishable from the ``.jaxexe`` entries
+#: sharing the cache root
+SIDECAR_SUFFIX = ".tuned.json"
+
+
+class TuningError(ValueError):
+    """A sidecar that must NOT be consumed: measured under a drifted
+    environment fingerprint, or written by a newer store format.
+    ``kind`` classifies (``fingerprint`` | ``store``), ``details``
+    names every mismatched field with the (saved, current) pair —
+    the same structured-refusal shape as ``CheckpointError``."""
+
+    def __init__(self, msg: str, kind: str = "fingerprint",
+                 **details):
+        super().__init__(msg)
+        self.kind = str(kind)
+        self.details = dict(details)
+
+
+def tuning_fingerprint() -> Dict[str, Any]:
+    """The environment identity a measurement is only valid under —
+    the same four fields ``ExecutableCache._fingerprint`` keys on,
+    as a named dict so a mismatch can say WHICH field drifted."""
+    import platform
+
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "arch": platform.machine(),
+        "devices": jax.device_count(),
+    }
+
+
+def check_tuning_fingerprint(saved: Dict[str, Any],
+                             current: Dict[str, Any]):
+    """Field-by-field comparison; raises :class:`TuningError` naming
+    EVERY drifted field (the whole diff at once, like the checkpoint
+    manifests — an operator re-tuning wants to know if it was a jax
+    upgrade, a backend switch, or both)."""
+    mismatched = {}
+    for field in sorted(set(saved) | set(current)):
+        if saved.get(field) != current.get(field):
+            mismatched[field] = (saved.get(field), current.get(field))
+    if mismatched:
+        diff = ", ".join(
+            f"{k}: tuned={s!r} current={c!r}"
+            for k, (s, c) in sorted(mismatched.items()))
+        raise TuningError(
+            f"tuned-config fingerprint mismatch ({diff}); refusing "
+            f"the sidecar — timings from another environment can "
+            f"invert, re-run `pydcop autotune` on this "
+            f"{'/'.join(sorted(mismatched))}",
+            kind="fingerprint", **mismatched)
+
+
+def _norm_sig(sig) -> Tuple:
+    """Rung signatures roundtrip through JSON (sidecars, telemetry
+    records) as nested lists; normalize to nested tuples so every
+    spelling of one rung keys the same sidecar."""
+    if isinstance(sig, (list, tuple)):
+        return tuple(_norm_sig(s) for s in sig)
+    return sig
+
+
+class TunedConfigStore:
+    """Disk-persisted winning configs, one sidecar per
+    (algo × rung-signature).
+
+    Like the executable cache it sits beside: opt-out via
+    ``PYDCOP_TPU_NO_CACHE=1`` or ``enabled=False``, relocate via
+    ``PYDCOP_TPU_CACHE_DIR``, unavailable directories degrade to
+    warn-once + all-miss, and ``stats`` feeds the ops plane
+    (``pydcop_tuning_hits_total`` / ``..._misses_total``).
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        self.path = path or default_cache_dir("tuned")
+        if enabled is None:
+            enabled = not cache_disabled()
+        self.enabled = bool(enabled)
+        self.stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "stores": 0, "corrupt": 0,
+            "refused": 0}
+        self._warned = False
+        if self.enabled:
+            try:
+                os.makedirs(self.path, exist_ok=True)
+            except OSError as e:
+                self.enabled = False
+                logger.warning(
+                    "tuned-config store unavailable at %s (%s); "
+                    "dispatch runs defaults", self.path, e)
+
+    # ------------------------------------------------------------ keys
+
+    def _file_for(self, algo: str, rung_signature) -> str:
+        digest = hashlib.sha256(
+            repr((str(algo), _norm_sig(rung_signature))).encode()
+        ).hexdigest()
+        return os.path.join(self.path, digest + SIDECAR_SUFFIX)
+
+    # ------------------------------------------------------------- i/o
+
+    def load(self, algo: str, rung_signature) -> Optional[Dict]:
+        """The sidecar entry for (algo, rung), or None on a miss.
+
+        A malformed sidecar is quarantined (``quarantine_file``) and
+        reads as a miss.  A WELL-FORMED sidecar whose fingerprint or
+        store version doesn't match this process raises
+        :class:`TuningError` — the refusal is the point; callers that
+        must survive it (dispatch) catch it in :func:`resolve_knobs`.
+        """
+        if not self.enabled:
+            return None
+        path = self._file_for(algo, rung_signature)
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+            if not isinstance(entry, dict) or \
+                    not isinstance(entry.get("best"), dict):
+                raise ValueError("sidecar is not a tuned-config entry")
+        except FileNotFoundError:
+            self.stats["misses"] += 1
+            return None
+        except Exception as e:
+            self.stats["corrupt"] += 1
+            self.stats["misses"] += 1
+            self._warn_once(
+                f"corrupt tuned sidecar {path}: {e} "
+                f"({quarantine_file(path)})")
+            return None
+        version = entry.get("store_version")
+        if version != STORE_VERSION:
+            self.stats["refused"] += 1
+            raise TuningError(
+                f"tuned sidecar {os.path.basename(path)} has store "
+                f"version {version!r}, this build reads "
+                f"{STORE_VERSION}; re-run `pydcop autotune`",
+                kind="store",
+                store_version=(version, STORE_VERSION))
+        try:
+            check_tuning_fingerprint(entry.get("fingerprint") or {},
+                                     tuning_fingerprint())
+        except TuningError:
+            self.stats["refused"] += 1
+            raise
+        self.stats["hits"] += 1
+        return entry
+
+    def store(self, algo: str, rung_signature, best: Dict,
+              table: List[Dict],
+              rung_label: Optional[str] = None) -> str:
+        """Persist the winning ``best`` config and the full measured
+        ``table`` (one row per candidate: label, config, ms/cycle
+        stages) for (algo, rung).  Atomic; returns the sidecar path.
+        """
+        from ..robustness.checkpoint import atomic_write
+
+        entry = {
+            "store_version": STORE_VERSION,
+            "fingerprint": tuning_fingerprint(),
+            "algo": str(algo),
+            "rung": _to_jsonable(_norm_sig(rung_signature)),
+            "rung_label": rung_label,
+            "best": dict(best),
+            "table": list(table),
+            "created_at": time.time(),
+        }
+        path = self._file_for(algo, rung_signature)
+        atomic_write(path, json.dumps(entry, indent=1, sort_keys=True))
+        self.stats["stores"] += 1
+        return path
+
+    # ------------------------------------------------------ surfacing
+
+    def entries(self) -> List[Dict]:
+        """Every readable sidecar in the store directory (skipping
+        corrupt/foreign files silently — this is the ops-plane
+        inventory scan, not a dispatch path)."""
+        if not self.enabled:
+            return []
+        out = []
+        try:
+            names = sorted(os.listdir(self.path))
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(SIDECAR_SUFFIX):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    entry = json.load(f)
+                if isinstance(entry, dict) and \
+                        isinstance(entry.get("best"), dict):
+                    out.append(entry)
+            except Exception:
+                continue
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The serve-surfacing view: stats plus a compact per-sidecar
+        inventory (algo, rung label, winning config, age) — what
+        heartbeat records and ``serve-status`` render."""
+        now = time.time()
+        return {
+            "path": self.path,
+            "enabled": self.enabled,
+            "stats": dict(self.stats),
+            "entries": [
+                {
+                    "algo": e.get("algo"),
+                    "rung_label": e.get("rung_label"),
+                    "best": e.get("best"),
+                    "age_s": round(
+                        max(0.0, now - float(e.get("created_at") or
+                                             now)), 3),
+                }
+                for e in self.entries()
+            ],
+        }
+
+    def _warn_once(self, msg: str):
+        if not self._warned:
+            self._warned = True
+            logger.warning(
+                "tuned-config store degraded (%s); affected rungs "
+                "run defaults", msg)
+
+
+def _to_jsonable(value):
+    """Nested tuples → nested lists for JSON (rung signatures)."""
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+def default_store(enabled: Optional[bool] = None) -> TunedConfigStore:
+    """The store at the canonical location beside the executable
+    cache — what every dispatch path constructs unless pointed
+    elsewhere."""
+    return TunedConfigStore(enabled=enabled)
+
+
+def resolve_knobs(algo: str, params: Dict, rung_signature,
+                  store: Optional[TunedConfigStore],
+                  context: str = "batched"
+                  ) -> Tuple[Dict, Dict[str, str]]:
+    """Fold tuned knobs into ``params`` under the precedence contract
+    **explicit > tuned > default**, returning
+    ``(resolved_params, sources)``.
+
+    ``sources`` maps every knob applicable to (algo, context) to how
+    its value was decided: ``explicit`` (the caller pinned it — never
+    overridden), ``tuned`` (adopted from the sidecar), ``default``
+    (no sidecar, no pin, or the tuned value is invalid for this
+    dispatch surface).  A fingerprint/store-version refusal from the
+    sidecar is warned once and degrades to all-default — dispatch
+    must not die because the daemon host got a jax upgrade — but the
+    refusal stays structured in the store's ``refused`` counter.
+    """
+    params = dict(params or {})
+    sources: Dict[str, str] = {}
+    for knob in KNOBS:
+        if knob in params:
+            sources[knob] = "explicit"
+        elif knob_domain(knob, algo, context):
+            sources[knob] = "default"
+    if store is None or rung_signature is None:
+        return params, sources
+    try:
+        entry = store.load(algo, rung_signature)
+    except TuningError as e:
+        store._warn_once(str(e))
+        return params, sources
+    if not entry:
+        return params, sources
+    for knob in KNOBS:
+        if knob not in entry["best"] or knob in params:
+            continue
+        value = entry["best"][knob]
+        if invalid_reason(algo, {knob: value}, context) is not None:
+            # tuned under another context (e.g. an engine-only knob
+            # consulted by a batched dispatch): not an error, the
+            # knob simply doesn't exist here
+            continue
+        params[knob] = value
+        sources[knob] = "tuned"
+    return params, sources

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_7_and_v1_readers_stay_green():
+def test_schema_minor_is_9_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 7
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 9
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4, 5, 6, 7):
+    for minor in (1, 2, 3, 4, 5, 6, 7, 8, 9):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -510,6 +510,37 @@ def test_schema_minor_is_7_and_v1_readers_stay_green():
     with pytest.raises(ValueError, match="active_fraction"):
         validate_record({"record": "summary", "algo": "m",
                          "status": "OK", "active_fraction": 1.5})
+    # minor-8 additive fields (solver portfolios + roi echoes)
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "roi_mode": "auto",
+                     "roi_flipped": True})
+    with pytest.raises(ValueError, match="roi_mode"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "roi_mode": "sideways"})
+    # minor-9 additive fields (per-rung autotuning): the per-knob
+    # tuning echo, tuned_rung and the tuning_store snapshot validate;
+    # malformed ones reject (tests/test_tuning.py covers the matrix)
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED",
+                     "tuning": {"precision": "tuned",
+                                "delta_on": "explicit",
+                                "bnb": "default"},
+                     "tuned_rung": "factor:d3:v17:a2x32"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "heartbeat",
+                     "tuning_store": {"path": "/x", "stats": {},
+                                      "entries": []}})
+    with pytest.raises(ValueError, match="unknown knob"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK",
+                         "tuning": {"turbo": "tuned"}})
+    with pytest.raises(ValueError, match="unknown source"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch",
+                         "tuning": {"precision": "guessed"}})
+    with pytest.raises(ValueError, match="tuned_rung"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "tuned_rung": ""})
 
 
 # ----------------------------------------- reporter lifecycle (ops)

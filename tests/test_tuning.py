@@ -1,0 +1,727 @@
+"""Per-rung offline autotuner (ISSUE 18).
+
+Layers under test:
+
+* ``tuning/space.py`` — the declarative knob grid and its validity
+  predicate (which must mirror, never invent, the runtime's loud
+  rejections);
+* ``tuning/store.py`` — sidecar roundtrip, fingerprint-drift refusal
+  naming every drifted field, store-version gate, corrupt-sidecar
+  quarantine, and the explicit > tuned > default precedence of
+  ``resolve_knobs``;
+* ``tuning/autotune.py`` + ``pydcop autotune`` — rung-label grammar,
+  synthetic rung instances, the successive-halving search whose final
+  argmin always contains the default (never-slower by construction);
+* consumption — ``runner_for_rung`` (tuned and explicit spellings of
+  one config share one cached runner: bit-exactness by construction),
+  a fresh-process ``solve`` adopting a sidecar with per-knob source
+  echo, and ``serve --oneshot`` dispatch records carrying the echo;
+* the two ride-along regressions: ``BatchedMaxSum`` decode under
+  ``stability:0`` and the ``amaxsum``+``-p layout:fused`` loud
+  rejection through the CLI params path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators.fast import (coloring_factor_arrays,
+                                        coloring_hypergraph_arrays)
+from pydcop_tpu.parallel.bucketing import (ShapeProfile, plan_rungs,
+                                           rung_label)
+from pydcop_tpu.tuning.space import (BATCHED_FAMILIES, KNOBS,
+                                     TUNING_SOURCES, config_label,
+                                     enumerate_configs, invalid_reason,
+                                     knob_domain)
+from pydcop_tpu.tuning.store import (STORE_VERSION, TunedConfigStore,
+                                     TuningError, resolve_knobs,
+                                     tuning_fingerprint)
+
+pytestmark = pytest.mark.tuning
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- knob space
+
+
+def test_batched_maxsum_grid_default_first():
+    configs = enumerate_configs("maxsum", "batched")
+    assert configs[0] == {}
+    # precision x delta_on are the only batched maxsum dimensions
+    assert configs == [{}, {"delta_on": "beliefs"},
+                       {"precision": "bf16"},
+                       {"precision": "bf16", "delta_on": "beliefs"}]
+
+
+@pytest.mark.parametrize("algo", ["dsa", "mgm"])
+def test_batched_localsearch_grid_is_precision_only(algo):
+    # delta_on is a maxsum knob; its domain collapses to the default
+    # for dsa/mgm, so it never becomes a search dimension
+    assert enumerate_configs(algo, "batched") == \
+        [{}, {"precision": "bf16"}]
+
+
+def test_non_batched_family_has_no_batched_grid():
+    assert "amaxsum" not in BATCHED_FAMILIES
+    assert enumerate_configs("amaxsum", "batched") == []
+    assert "no batched campaign runner" in \
+        invalid_reason("amaxsum", {}, "batched")
+
+
+def test_validity_mirrors_runtime_rejections():
+    # bnb never reaches the batched surface
+    assert "bnb" in invalid_reason("maxsum", {"bnb": True}, "batched")
+    assert knob_domain("bnb", "maxsum", "batched") == ()
+    # bnb stays in the maxsum family everywhere it exists
+    assert "maxsum-family" in \
+        invalid_reason("dsa", {"bnb": True}, "engine")
+    # amaxsum has no fused mesh layout (parallel/__init__ raises)
+    assert "fused" in \
+        invalid_reason("amaxsum", {"layout": "fused"}, "sharded")
+    assert knob_domain("layout", "amaxsum", "sharded") == \
+        ("edge_major",)
+    # only maxsum grew the fused shard-local alternative
+    assert "fused" in [v for v in
+                       knob_domain("layout", "maxsum", "sharded")]
+    assert "edge_major" in \
+        invalid_reason("dsa", {"layout": "lane_major"}, "sharded")
+    # delta_on:beliefs is single-chip only
+    assert invalid_reason("maxsum", {"delta_on": "beliefs"},
+                          "sharded") is not None
+    assert invalid_reason("maxsum", {"delta_on": "beliefs"},
+                          "engine") is None
+    # knobs outside a context read as absent, not invalid values
+    assert knob_domain("chunk_size", "maxsum", "batched") == ()
+    assert "unknown knob" in \
+        invalid_reason("maxsum", {"turbo": 1}, "batched")
+
+
+def test_config_label_canonical_knob_order():
+    assert config_label({}) == "default"
+    # KNOBS order, not insertion or alphabetical order
+    assert config_label({"delta_on": "beliefs",
+                         "precision": "bf16"}) == \
+        "precision:bf16,delta_on:beliefs"
+
+
+def test_pinned_knobs_leave_the_search():
+    configs = enumerate_configs("maxsum", "batched",
+                                pinned={"precision": "bf16"})
+    assert configs == [{}, {"delta_on": "beliefs"}]
+
+
+def test_report_vocab_mirrors_space():
+    # report.py re-declares the vocab import-light (like EDIT_KEYS);
+    # this pin is what keeps the validator and the space from drifting
+    from pydcop_tpu.observability import report
+
+    assert report.TUNING_KNOBS == KNOBS
+    assert report.TUNING_SOURCES == TUNING_SOURCES
+
+
+# ---------------------------------------------------------- tuned store
+
+
+_SIG = ("factor", 3, 17, ((2, 32),), 0)
+
+
+def _seed(tmp_path, best, algo="maxsum", sig=_SIG):
+    store = TunedConfigStore(path=str(tmp_path / "tuned"))
+    store.store(algo, sig, best,
+                [{"label": config_label(best), "config": best,
+                  "ms_per_cycle": 1.0}],
+                rung_label=rung_label(sig))
+    return store
+
+
+def test_store_roundtrip_exact_values(tmp_path):
+    best = {"precision": "bf16", "delta_on": "beliefs"}
+    store = _seed(tmp_path, best)
+    entry = store.load("maxsum", _SIG)
+    assert entry["best"] == best
+    assert entry["algo"] == "maxsum"
+    assert entry["rung_label"] == rung_label(_SIG)
+    assert entry["store_version"] == STORE_VERSION
+    assert entry["fingerprint"] == tuning_fingerprint()
+    assert entry["table"][0]["ms_per_cycle"] == 1.0
+    # the JSON (nested-list) spelling of the signature keys the SAME
+    # sidecar — telemetry-replayed rungs must hit
+    listy = ["factor", 3, 17, [[2, 32]], 0]
+    assert store.load("maxsum", listy)["best"] == best
+    assert store.stats["hits"] == 2 and store.stats["stores"] == 1
+    # a different algo over the same rung is a different sidecar
+    assert store.load("dsa", _SIG) is None
+    assert store.stats["misses"] == 1
+
+
+def test_fingerprint_drift_refused_naming_every_field(tmp_path):
+    store = _seed(tmp_path, {"precision": "bf16"})
+    path = store._file_for("maxsum", _SIG)
+    with open(path) as f:
+        entry = json.load(f)
+    entry["fingerprint"]["jax"] = "0.0.1"
+    entry["fingerprint"]["backend"] = "tpu"
+    with open(path, "w") as f:
+        json.dump(entry, f)
+    with pytest.raises(TuningError) as ei:
+        store.load("maxsum", _SIG)
+    err = ei.value
+    assert err.kind == "fingerprint"
+    # EVERY drifted field is named with its (saved, current) pair
+    assert set(err.details) == {"jax", "backend"}
+    assert "jax: tuned='0.0.1'" in str(err)
+    assert "backend: tuned='tpu'" in str(err)
+    assert "re-run `pydcop autotune`" in str(err)
+    assert store.stats["refused"] == 1
+    # dispatch survives the refusal: resolve_knobs degrades to
+    # defaults (warn-once) instead of dying
+    params, sources = resolve_knobs("maxsum", {}, _SIG, store)
+    assert params == {}
+    assert set(sources.values()) == {"default"}
+    assert store._warned
+
+
+def test_newer_store_version_refused(tmp_path):
+    store = _seed(tmp_path, {"precision": "bf16"})
+    path = store._file_for("maxsum", _SIG)
+    with open(path) as f:
+        entry = json.load(f)
+    entry["store_version"] = 999
+    with open(path, "w") as f:
+        json.dump(entry, f)
+    with pytest.raises(TuningError) as ei:
+        store.load("maxsum", _SIG)
+    assert ei.value.kind == "store"
+    assert ei.value.details["store_version"] == (999, STORE_VERSION)
+    assert store.stats["refused"] == 1
+
+
+def test_corrupt_sidecar_quarantined_reads_as_miss(tmp_path):
+    store = _seed(tmp_path, {"precision": "bf16"})
+    path = store._file_for("maxsum", _SIG)
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert store.load("maxsum", _SIG) is None      # miss, no crash
+    assert store.stats["corrupt"] == 1
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)                # never re-read
+    assert store.load("maxsum", _SIG) is None
+    assert store.stats["corrupt"] == 1             # counted once
+
+
+def test_store_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYDCOP_TPU_NO_CACHE", "1")
+    store = TunedConfigStore(path=str(tmp_path / "tuned"))
+    assert not store.enabled
+    assert store.load("maxsum", _SIG) is None
+    assert store.snapshot()["entries"] == []
+
+
+def test_snapshot_inventory(tmp_path):
+    store = _seed(tmp_path, {"delta_on": "beliefs"})
+    snap = store.snapshot()
+    assert snap["enabled"] and snap["stats"]["stores"] == 1
+    (entry,) = snap["entries"]
+    assert entry["algo"] == "maxsum"
+    assert entry["rung_label"] == rung_label(_SIG)
+    assert entry["best"] == {"delta_on": "beliefs"}
+    assert entry["age_s"] >= 0.0
+
+
+# -------------------------------------------- resolution precedence
+
+
+def test_resolve_knobs_explicit_beats_tuned_beats_default(tmp_path):
+    store = _seed(tmp_path, {"precision": "bf16",
+                             "delta_on": "beliefs"})
+    # nothing pinned: both knobs adopt from the sidecar
+    params, sources = resolve_knobs("maxsum", {}, _SIG, store)
+    assert params == {"precision": "bf16", "delta_on": "beliefs"}
+    assert sources == {"precision": "tuned", "delta_on": "tuned"}
+    # an explicit pin is NEVER overridden, even by a winning config
+    params, sources = resolve_knobs(
+        "maxsum", {"delta_on": "messages"}, _SIG, store)
+    assert params == {"precision": "bf16", "delta_on": "messages"}
+    assert sources == {"precision": "tuned", "delta_on": "explicit"}
+    # no store: everything stays default and params are untouched
+    params, sources = resolve_knobs("maxsum", {}, _SIG, None)
+    assert params == {}
+    assert sources == {"precision": "default", "delta_on": "default"}
+
+
+def test_resolve_knobs_skips_off_context_tuned_values(tmp_path):
+    # an engine-context winner (chunk_size) consulted by a batched
+    # dispatch: the knob simply doesn't exist here — not an error
+    store = _seed(tmp_path, {"chunk_size": 16, "precision": "bf16"})
+    params, sources = resolve_knobs("maxsum", {}, _SIG, store,
+                                    context="batched")
+    assert params == {"precision": "bf16"}
+    assert "chunk_size" not in params and "chunk_size" not in sources
+    assert sources["precision"] == "tuned"
+
+
+# ------------------------------------------------- rung-label grammar
+
+
+def test_parse_rung_label_roundtrip():
+    from pydcop_tpu.tuning.autotune import parse_rung_label
+
+    for label in ("factor:d3:v17:a2x32", "hyper:d3:v33:a2x64:p128",
+                  "factor:d5:v9:a2x8:a3x4"):
+        sig = parse_rung_label(label)
+        assert rung_label(sig) == label
+
+
+@pytest.mark.parametrize("bad", ["bogus:d3:v4:a2x4", "factor:x3",
+                                 "factor:d3:v17:q9", ""])
+def test_parse_rung_label_dies_loudly(bad):
+    from pydcop_tpu.tuning.autotune import parse_rung_label
+
+    with pytest.raises(ValueError, match="does not parse"):
+        parse_rung_label(bad)
+
+
+def test_synthetic_instances_fit_their_rung():
+    from pydcop_tpu.tuning.autotune import (parse_rung_label,
+                                            synthetic_instances)
+
+    sig = parse_rung_label("factor:d3:v9:a2x16")
+    insts = synthetic_instances(sig, "maxsum", batch=3)
+    assert len(insts) == 3
+    # padded to exactly the rung's shape, distinct per seed row
+    assert all(a.n_vars == 9 for a in insts)
+    hsig = parse_rung_label("hyper:d3:v9:a2x16:p32")
+    assert len(synthetic_instances(hsig, "dsa", batch=2)) == 2
+    with pytest.raises(ValueError, match="factor-kind"):
+        synthetic_instances(sig, "dsa")
+    with pytest.raises(ValueError, match="no batched runner"):
+        synthetic_instances(sig, "dpop")
+
+
+# ------------------------------------------ runner_for_rung consumption
+
+
+def _factor_instances():
+    return [coloring_factor_arrays(10, 20, 3, seed=1, noise=0.05),
+            coloring_factor_arrays(14, 25, 3, seed=2, noise=0.05),
+            coloring_factor_arrays(9, 15, 3, seed=3, noise=0.05)]
+
+
+def _one_rung(instances):
+    rungs = plan_rungs([ShapeProfile.of(a) for a in instances],
+                       max_waste=50.0)
+    assert len(rungs) == 1
+    return rungs[0]
+
+
+def test_tuned_and_explicit_spellings_share_one_runner(
+        tmp_path, monkeypatch):
+    """The bit-exactness acceptance criterion: tuned knobs fold in
+    BEFORE the runner-cache key, so the tuned spelling and the
+    explicit spelling of one config land on the SAME runner and the
+    SAME compiled program."""
+    import pydcop_tpu.parallel.batch as pbatch
+    from pydcop_tpu.parallel.batch import (BatchedMaxSum,
+                                           runner_for_rung)
+
+    monkeypatch.setattr(pbatch, "_RUNNER_CACHE", {})
+    instances = _factor_instances()
+    rung = _one_rung(instances)
+    padded = [rung.pad(a) for a in instances]
+    store = _seed(tmp_path, {"delta_on": "beliefs"},
+                  sig=rung.signature)
+
+    r_tuned = runner_for_rung("maxsum", padded, {},
+                              rung_signature=rung.signature,
+                              tuned_store=store)
+    assert r_tuned.tuning_sources == {"precision": "default",
+                                      "delta_on": "tuned"}
+    assert store.stats["hits"] == 1
+    r_exp = runner_for_rung("maxsum", padded,
+                            {"delta_on": "beliefs"},
+                            rung_signature=rung.signature)
+    assert r_exp is r_tuned          # same key -> same program
+    assert r_exp.tuning_sources is None   # no store consulted
+
+    sel, _c, _f = r_tuned.run(max_cycles=30, seeds=[0, 1, 2])
+    direct = BatchedMaxSum(padded[0], instances=padded,
+                           delta_on="beliefs")
+    sel_d, _c2, _f2 = direct.run(max_cycles=30, seeds=[0, 1, 2])
+    for i in range(len(instances)):
+        assert np.array_equal(r_tuned.decode(sel)[i],
+                              direct.decode(sel_d)[i]), i
+
+
+# --------------------------------------------- the autotune search loop
+
+
+def test_autotune_rung_never_prunes_the_default(tmp_path):
+    from pydcop_tpu.tuning.autotune import autotune
+
+    instances = [coloring_hypergraph_arrays(10, 20, 3, seed=1),
+                 coloring_hypergraph_arrays(9, 15, 3, seed=2)]
+    rung = _one_rung(instances)
+    padded = [rung.pad(a) for a in instances]
+    store = TunedConfigStore(path=str(tmp_path / "tuned"))
+    (result,) = autotune([("dsa", rung.signature, padded)],
+                         cycles=4, repeats=1, store=store)
+    assert result["candidates"] == 2      # {} and precision:bf16
+    assert result["rung_label"] == rung_label(rung.signature)
+    labels = {r["label"] for r in result["table"]}
+    assert labels == {"default", "precision:bf16"}
+    default_row = next(r for r in result["table"]
+                       if r["label"] == "default")
+    # the default always gets a full-budget measurement — the final
+    # argmin contains it, which is the never-slower contract
+    assert not default_row["pruned"]
+    assert default_row["ms_per_cycle"] is not None
+    assert result["best_ms_per_cycle"] <= \
+        result["default_ms_per_cycle"]
+    assert result["speedup_vs_default"] >= 1.0
+    # the winner persisted and reads back exactly
+    entry = store.load("dsa", rung.signature)
+    assert entry["best"] == result["best"]
+    assert result["sidecar"] == store._file_for("dsa",
+                                                rung.signature)
+
+
+def test_autotune_rejects_invalid_pins(tmp_path):
+    from pydcop_tpu.tuning.autotune import autotune
+
+    with pytest.raises(ValueError, match="maxsum-family"):
+        autotune([("dsa", _SIG, [])], pinned={"bnb": True},
+                 context="engine")
+
+
+def test_autotune_cli_persists_consumable_sidecar(
+        tmp_path, monkeypatch, capsys):
+    from pydcop_tpu.dcop_cli import main
+    from pydcop_tpu.tuning.autotune import parse_rung_label
+
+    monkeypatch.setenv("PYDCOP_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    store_dir = tmp_path / "tuned"
+    label = "hyper:d3:v9:a2x8:p16"
+    rc = main(["autotune", "--rung", label, "-a", "dsa",
+               "--cycles", "4", "--repeats", "1", "--batch", "2",
+               "--store-dir", str(store_dir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    assert summary["rungs"][0]["rung"] == label
+    assert summary["rungs"][0]["default_ms_per_cycle"] is not None
+    store = TunedConfigStore(path=str(store_dir))
+    entry = store.load("dsa", parse_rung_label(label))
+    assert entry is not None and "best" in entry
+    # exactly one rung source is accepted
+    assert main(["autotune"]) == 2
+    assert main(["autotune", "--rung", "factor:bogus"]) == 2
+
+
+# ------------------------------------------- fresh-process consumption
+
+
+GC7 = """
+name: gc7
+objective: min
+domains:
+  colors: {values: [R, G, B]}
+variables:
+%s
+constraints:
+%s
+agents: [%s]
+"""
+
+
+def _gc7_file(tmp_path):
+    nv = 7
+    edges = [(i, (i + 1) % nv) for i in range(nv)] + [(0, 3), (2, 5)]
+    variables = "\n".join(f"  v{i}: {{domain: colors}}"
+                          for i in range(nv))
+    constraints = "\n".join(
+        f"  c{k}: {{type: intention, "
+        f"function: {1 + k} if v{a} == v{b} else 0}}"
+        for k, (a, b) in enumerate(edges))
+    agents = ", ".join(f"a{i}" for i in range(nv))
+    p = tmp_path / "gc7.yaml"
+    p.write_text(GC7 % (variables, constraints, agents))
+    return str(p)
+
+
+def test_fresh_process_solve_adopts_tuned_knobs(tmp_path):
+    """The ISSUE 18 acceptance criterion: a sidecar written by one
+    process is consumed by a FRESH solve process, the adopted knob is
+    echoed source=tuned, an explicit pin overrides it, and --no-tuned
+    runs pure defaults."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.parallel.bucketing import home_rung
+
+    dcop_file = _gc7_file(tmp_path)
+    cache_dir = tmp_path / "cache"
+    # the exact rung identity the solve path computes
+    arrays = FactorGraphArrays.build(load_dcop_from_file(dcop_file),
+                                     arity_sorted=True)
+    sig = home_rung(ShapeProfile.of(arrays)).signature
+    store = TunedConfigStore(path=str(cache_dir / "tuned"))
+    store.store("maxsum", sig, {"delta_on": "beliefs"}, [],
+                rung_label=rung_label(sig))
+
+    driver = tmp_path / "driver.py"
+    driver.write_text(
+        "import sys\n"
+        "from pydcop_tpu.dcop_cli import main\n"
+        "f, out = sys.argv[1], sys.argv[2]\n"
+        "base = ['-t', '60', 'solve', '-a', 'maxsum',\n"
+        "        '-p', 'stop_cycle:25', f]\n"
+        "assert main(['-o', out + '.tuned'] + base) == 0\n"
+        "assert main(['-o', out + '.explicit', '-t', '60', 'solve',\n"
+        "             '-a', 'maxsum', '-p', 'stop_cycle:25',\n"
+        "             '-p', 'delta_on:messages', f]) == 0\n"
+        "assert main(['-o', out + '.notuned', '-t', '60', 'solve',\n"
+        "             '-a', 'maxsum', '-p', 'stop_cycle:25',\n"
+        "             '--no-tuned', f]) == 0\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               PYDCOP_TPU_CACHE_DIR=str(cache_dir))
+    out = str(tmp_path / "res")
+    proc = subprocess.run(
+        [sys.executable, str(driver), dcop_file, out],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+
+    with open(out + ".tuned") as f:
+        tuned = json.load(f)
+    assert tuned["status"] == "FINISHED"
+    assert tuned["tuning"]["delta_on"] == "tuned"
+    assert tuned["tuned_rung"] == rung_label(sig)
+    with open(out + ".explicit") as f:
+        explicit = json.load(f)
+    assert explicit["tuning"]["delta_on"] == "explicit"
+    with open(out + ".notuned") as f:
+        notuned = json.load(f)
+    assert "tuning" not in notuned
+    assert notuned["status"] == "FINISHED"
+
+
+# -------------------------------------------------- serve consumption
+
+
+def _write_instance(path, name, edges, nv, w):
+    lines = [f"name: {name}", "objective: min", "domains:",
+             "  colors: {values: [R, G, B]}", "variables:"]
+    for i in range(nv):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for k, (a, b) in enumerate(edges):
+        lines.append(f"  c{k}: {{type: intention, "
+                     f"function: {w + k} if v{a} == v{b} else 0}}")
+    lines.append("agents: [%s]"
+                 % ", ".join(f"a{i}" for i in range(nv)))
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_serve_oneshot_echoes_tuned_sources(tmp_path):
+    """Serve dispatch consults the store per rung: summary and
+    dispatch records carry the per-knob source echo, and every record
+    still validates against the v1 schema."""
+    from pydcop_tpu.dcop_cli import main
+    from pydcop_tpu.observability.report import (read_records,
+                                                 validate_record)
+    from pydcop_tpu.serving.queue import prepare_job
+
+    model = tmp_path / "chain4.yaml"
+    _write_instance(model, "chain4",
+                    [(0, 1), (1, 2), (2, 3)], 4, 3)
+    # the sidecar keys on the job's home rung — derive it exactly the
+    # way admission does
+    job = prepare_job({"id": "probe", "dcop": str(model),
+                       "algo": "maxsum", "max_cycles": 20})
+    sig = job.group_key[3]
+    store_dir = tmp_path / "tuned"
+    TunedConfigStore(path=str(store_dir)).store(
+        "maxsum", sig, {"delta_on": "beliefs"}, [],
+        rung_label=rung_label(sig))
+
+    jobs = [{"id": f"j{i}", "dcop": str(model), "algo": "maxsum",
+             "max_cycles": 20, "seed": i} for i in range(2)]
+    jobs_path = tmp_path / "jobs.jsonl"
+    jobs_path.write_text(
+        "".join(json.dumps(j) + "\n" for j in jobs))
+    out = tmp_path / "serve.jsonl"
+    rc = main(["serve", "--oneshot", str(jobs_path),
+               "--out", str(out), "--no-exec-cache",
+               "--tuned-store", str(store_dir),
+               "--max-batch", "4", "--max-delay-ms", "20"])
+    assert rc == 0
+    records = read_records(str(out))
+    for rec in records:
+        validate_record(rec)
+    summaries = [r for r in records if r.get("record") == "summary"]
+    assert len(summaries) == 2
+    for rec in summaries:
+        assert rec["tuning"]["delta_on"] == "tuned"
+        assert rec["tuning"]["precision"] == "default"
+    dispatches = [r for r in records if r.get("record") == "serve"
+                  and r.get("event") == "dispatch"]
+    assert dispatches and all(
+        r["tuning"]["delta_on"] == "tuned" for r in dispatches)
+
+
+def test_serve_oneshot_no_tuned_stays_silent(tmp_path):
+    from pydcop_tpu.dcop_cli import main
+    from pydcop_tpu.observability.report import read_records
+
+    model = tmp_path / "chain4.yaml"
+    _write_instance(model, "chain4",
+                    [(0, 1), (1, 2), (2, 3)], 4, 3)
+    jobs_path = tmp_path / "jobs.jsonl"
+    jobs_path.write_text(json.dumps(
+        {"id": "j0", "dcop": str(model), "algo": "maxsum",
+         "max_cycles": 20}) + "\n")
+    out = tmp_path / "serve.jsonl"
+    rc = main(["serve", "--oneshot", str(jobs_path),
+               "--out", str(out), "--no-exec-cache", "--no-tuned",
+               "--max-batch", "2", "--max-delay-ms", "20"])
+    assert rc == 0
+    for rec in read_records(str(out)):
+        assert "tuning" not in rec
+
+
+def test_serve_status_renders_tuning_store():
+    from pydcop_tpu.commands.serve_status import render_status
+
+    snap = {"record": "serve", "event": "stats", "uptime_s": 1.0,
+            "queue_depth": 0, "stats": {},
+            "tuning_store": {
+                "stats": {"hits": 3, "misses": 1, "refused": 1},
+                "entries": [{"algo": "maxsum",
+                             "rung_label": "factor:d3:v17:a2x32",
+                             "best": {"delta_on": "beliefs"},
+                             "age_s": 42.0}]}}
+    text = render_status(snap)
+    assert "tuned" in text
+    assert "hits=3" in text
+    assert "refused=1" in text
+    assert "maxsum/factor:d3:v17:a2x32" in text
+    assert "delta_on:beliefs" in text
+    assert "age 42s" in text
+
+
+# ------------------------------------- batch --fuse-hetero consumption
+
+
+def test_fused_campaign_adopts_tuned_knobs(tmp_path, monkeypatch):
+    """The fourth consumption surface: `batch --fuse-hetero` rungs
+    resolve un-pinned knobs from the default-path sidecar store
+    (relocated via PYDCOP_TPU_CACHE_DIR, exactly how an operator
+    points a campaign at an autotuned cache), echo the per-knob
+    source in every per-job result, and `--no-tuned` opts out."""
+    from pydcop_tpu.commands.batch import _run_fused_group
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.graphs.arrays import FactorGraphArrays
+    from pydcop_tpu.tuning.space import TUNING_SOURCES
+
+    monkeypatch.setenv("PYDCOP_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    # ring5 and star6 share a power-of-two home rung (v8 / a2x8), so
+    # the hetero planner fuses them into ONE multi-member rung — the
+    # path that consults the store (a single-topology rung runs the
+    # exact pre-hetero program and never pads)
+    specs = [("ring5", [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0)], 5, 5),
+             ("star6", [(0, i) for i in range(1, 6)], 6, 7)]
+    files = []
+    for name, edges, nv, w in specs:
+        p = tmp_path / f"{name}.yaml"
+        _write_instance(p, name, edges, nv, w)
+        files.append(str(p))
+    # derive the fused rung exactly like the campaign will: arity-
+    # sorted factor builds, default waste cap
+    templates = [FactorGraphArrays.build(load_dcop_from_file(p),
+                                         arity_sorted=True)
+                 for p in files]
+    rungs = plan_rungs([ShapeProfile.of(t) for t in templates])
+    assert len(rungs) == 1 and len(rungs[0].members) == 2
+    store = TunedConfigStore(
+        path=os.path.join(str(tmp_path / "cache"), "tuned"))
+    store.store("maxsum", rungs[0].signature, {"delta_on": "beliefs"},
+                [{"label": "delta_on:beliefs",
+                  "config": {"delta_on": "beliefs"},
+                  "ms_per_cycle": 1.0}],
+                rung_label=rung_label(rungs[0].signature))
+
+    def campaign(out_name, **kw):
+        out_dir = tmp_path / out_name
+        os.makedirs(out_dir)
+        done = []
+        rows = [(f"s__b__{os.path.basename(p)}__algo=maxsum__{it}",
+                 p, it) for p in files for it in range(2)]
+        _run_fused_group(("maxsum", (), 25, None), rows, str(out_dir),
+                         done.append, hetero=True, **kw)
+        assert sorted(done) == sorted(r[0] for r in rows)
+        results = {}
+        for job_id, _p, _it in rows:
+            with open(out_dir / f"{job_id}.json") as f:
+                results[job_id] = json.load(f)
+        return results
+
+    for r in campaign("out_tuned").values():
+        assert r["tuning"]["delta_on"] == "tuned"
+        assert all(v in TUNING_SOURCES for v in r["tuning"].values())
+        assert r["fused_batch"] == 4
+    # --no-tuned: the store is never consulted, no source echo at all
+    for r in campaign("out_plain", no_tuned=True).values():
+        assert "tuning" not in r
+
+
+# ------------------------------------------------ satellite regressions
+
+
+def test_batched_maxsum_stability_zero_decodes_live_assignment():
+    """Regression: with stability:0 the step elides the per-cycle
+    argmin, so the raw selection field carries the INIT state — the
+    decode must rebuild the live assignment from the final messages,
+    matching the sync engine bit-exactly."""
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.engine.sync_engine import SyncEngine
+    from pydcop_tpu.parallel.batch import BatchedMaxSum
+
+    instances = _factor_instances()
+    rung = _one_rung(instances)
+    padded = [rung.pad(a) for a in instances]
+    runner = BatchedMaxSum(padded[0], instances=padded,
+                           stability=0.0, damping=0.5)
+    sel, _cycles, _fin = runner.run(max_cycles=25, seeds=[0, 1, 2])
+    decoded = runner.decode(sel)
+    for i, arrays in enumerate(instances):
+        res = SyncEngine(MaxSumSolver(arrays, stability=0.0,
+                                      damping=0.5)).run(
+            key=i, max_cycles=25)
+        single = np.array([res.assignment[n]
+                           for n in arrays.var_names])
+        assert np.array_equal(decoded[i], single), i
+
+
+def test_amaxsum_fused_layout_rejected_via_cli_params(
+        tmp_path, capsys):
+    """Regression: amaxsum + layout:fused is never a silent downgrade.
+    The CLI params path dies at validation (amaxsum declares no
+    layout param), and the solve_sharded params path names the
+    missing fused program."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.dcop_cli import main
+    from pydcop_tpu.parallel import solve_sharded
+
+    dcop_file = _gc7_file(tmp_path)
+    rc = main(["solve", "-a", "amaxsum", "-m", "sharded",
+               "-p", "layout:fused", dcop_file])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "layout" in err           # rejected, not silently dropped
+    with pytest.raises(ValueError, match="amaxsum has no fused"):
+        solve_sharded(load_dcop_from_file(dcop_file), "amaxsum",
+                      n_cycles=5, layout="fused")
