@@ -3,7 +3,7 @@
 # 8-device mesh (tests/conftest.py).
 
 .PHONY: test test-fast bench suite lint typecheck chaos bench-roi \
-	bench-portfolio bench-autotune
+	bench-portfolio bench-autotune fleet
 
 test:
 	python -m pytest tests/ -q
@@ -46,6 +46,17 @@ bench-portfolio:
 bench-autotune:
 	python -m pytest tests/ -q -m "tuning"
 	python benchmarks/suite.py bench_autotune --quick
+
+# the scale-out tier: the fleet test marker (hash-ring determinism,
+# router policy, failover re-send, release-op migration, repeatable
+# serve-status) plus the bench_fleet contract — N real worker daemons
+# behind the consistent-hash router, asserting throughput scale-out
+# (core-gated), rolling restart with zero lost jobs and zero
+# recompiles, and kill -9 failover whose migrated warm session stays
+# bit-exact with the uninterrupted oracle
+fleet:
+	python -m pytest tests/ -q -m "fleet"
+	python benchmarks/suite.py bench_fleet --quick
 
 bench:
 	python bench.py

@@ -3020,6 +3020,372 @@ def bench_chaos(quick=False, out_dir=None):
             shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_fleet(quick=False, out_dir=None):
+    """The serve-fleet contract (ISSUE 19): N real worker daemons
+    (subprocesses) behind one consistent-hash router, driven with the
+    mixed cold+delta stream.  Asserted, not eyeballed:
+
+    * throughput scale-out at 1/2(/4 full) workers over a SHARED
+      pre-warmed executable cache (every leg runs deserialize-steady-
+      state, so the jobs/s ratio measures dispatch concurrency, not
+      compile amortization).  The near-linear asserts (>= 1.7x at 2
+      workers, >= 3x at 4) are gated on the host actually having
+      that many cores — on a smaller host the legs still run and the
+      bench asserts no-collapse (>= 0.35x single-worker) and records
+      ``scaling_asserted: false`` with the reason;
+    * rolling restart mid-stream loses ZERO jobs — queued jobs come
+      back through the drained worker's requeue-<id>.jsonl (router
+      merge), in-flight jobs re-send from the router's pending
+      table, and the restarted leg's dispatch spans show
+      ``deserialize_s`` and ZERO ``compile_s`` (warm sessions came
+      back by journal recovery through the shared cache, nothing
+      recompiled);
+    * ``kill -9`` of one worker mid-load: every healthy job
+      completes, and the dead worker's warm session migrates — its
+      post-failover delta selections/costs/cycles are BIT-EXACT
+      against the uninterrupted single-worker oracle leg (the
+      journal replays the exact pre-kill sequence).  The kill lands
+      while cold solves are in flight (trivially re-sendable);
+      resent deltas are at-least-once, so fleet delta traffic under
+      failover should be idempotent edits (change_costs), which is
+      what this stream uses;
+    * the aggregated ``stats`` fan-out answers with every live
+      worker's snapshot riding along (what repeatable serve-status
+      renders).
+
+    ``--max-batch 1`` everywhere: a deterministic one-rung-per-
+    (algo, size) compile universe that the warmup leg fully
+    pre-warms, keeping the zero-compile contract assertable.
+    ``out_dir`` keeps the per-leg shared JSONL telemetry (the tier-1
+    quick leg telemetry-validates it).  Host-CPU numbers, labeled."""
+    import os
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import jax
+
+    from pydcop_tpu.dcop.yamldcop import (dcop_yaml,
+                                          load_dcop_from_file)
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records)
+    from pydcop_tpu.serving.fleet import (FleetManager, FleetRouter,
+                                          ROUTER_ID)
+
+    sizes = (10,) if quick else (12, 14, 16)
+    n_targets = 2 if quick else 3
+    n_jobs = 18 if quick else 96
+    max_cycles = 6 if quick else 10
+    worker_counts = (1, 2) if quick else (1, 2, 4)
+    keep = out_dir is not None
+    work = out_dir or tempfile.mkdtemp(prefix="pydcop_fleet_")
+    os.makedirs(work, exist_ok=True)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env = {"PYTHONPATH": repo_root + (
+        ":" + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else "")}
+    # ONE executable cache for every leg (appended last, so it
+    # overrides the per-leg FleetManager default): the warmup is paid
+    # once, all measured legs deserialize
+    shared_exec = os.path.join(work, "exec_shared")
+    try:
+        paths, factor_names = [], []
+        for nv in sizes:
+            dcop = generate_graph_coloring(
+                nv, 3, "scalefree", m_edge=2, soft=True, seed=nv)
+            p = os.path.join(work, f"i{nv}.yaml")
+            with open(p, "w") as f:
+                f.write(dcop_yaml(dcop))
+            paths.append(p)
+            factor_names.append(
+                sorted(load_dcop_from_file(p).constraints))
+
+        # the stream: maxsum targets first, then alternating cold
+        # solves with an idempotent change_costs delta every 3rd job
+        lines, delta_ids, all_ids = [], [], []
+        for t in range(n_targets):
+            jid = f"j{t}"
+            lines.append(json.dumps({
+                "id": jid, "dcop": paths[t % len(paths)],
+                "algo": "maxsum", "max_cycles": max_cycles,
+                "seed": t}))
+            all_ids.append(jid)
+        i = n_targets
+        while len(all_ids) < n_jobs:
+            if i % 3 == 2:
+                t = (i // 3) % n_targets
+                jid = f"d{i}"
+                fn = factor_names[t % len(factor_names)]
+                lines.append(json.dumps({
+                    "id": jid, "op": "delta", "target": f"j{t}",
+                    "actions": [{
+                        "type": "change_costs",
+                        "name": fn[i % len(fn)],
+                        "costs": [[(i + r + c) % 9 for c in range(3)]
+                                  for r in range(3)]}]}))
+                delta_ids.append(jid)
+            else:
+                jid = f"s{i}"
+                lines.append(json.dumps({
+                    "id": jid, "dcop": paths[i % len(paths)],
+                    "algo": "maxsum" if i % 2 else "dsa",
+                    "max_cycles": max_cycles, "seed": i}))
+            all_ids.append(jid)
+            i += 1
+        solve_lines = [ln for ln in lines
+                       if json.loads(ln).get("op", "solve")
+                       == "solve"
+                       and json.loads(ln)["id"].startswith("s")]
+
+        def start_fleet(tag, n_workers):
+            mgr = FleetManager(
+                os.path.join(work, f"fleet_{tag}"), env=env,
+                max_batch=1, max_delay_ms=5.0,
+                max_cycles=max_cycles,
+                worker_args=["--exec-cache", shared_exec])
+            reporter = RunReporter(mgr.out, algo="serve",
+                                   mode="serve", worker_id=ROUTER_ID)
+            reporter.header(leg=tag, fleet_workers=n_workers)
+            router = FleetRouter(reporter=reporter,
+                                 checkpoint_dir=mgr.ckpt_dir)
+            mgr.start(router, n_workers)
+            return mgr, router, reporter
+
+        def run_leg(tag, n_workers):
+            mgr, router, reporter = start_fleet(tag, n_workers)
+            replies = {}
+            try:
+                t0 = time.perf_counter()
+                for ln in lines:
+                    router.feed(
+                        ln, reply=lambda r: replies.__setitem__(
+                            r.get("job_id") or r.get("id"), r))
+                if not router.drain(timeout=900):
+                    raise RuntimeError(
+                        f"{tag}: fleet did not drain "
+                        f"({len(replies)}/{n_jobs} replied)")
+                wall = time.perf_counter() - t0
+            finally:
+                mgr.shutdown(router)
+                reporter.close()
+            rejected = sorted(j for j, r in replies.items()
+                              if r.get("status") == "REJECTED")
+            if rejected or set(replies) != set(all_ids):
+                raise RuntimeError(
+                    f"{tag}: incomplete/rejected: "
+                    f"{sorted(set(all_ids) - set(replies))} missing, "
+                    f"{rejected} rejected")
+            return {"wall_s": round(wall, 3),
+                    "jobs_s": round(n_jobs / wall, 2),
+                    "replies": replies, "out": mgr.out}
+
+        # ---- warmup (compiles the whole rung universe into the
+        # shared cache) then the measured throughput ladder; the
+        # single-worker leg doubles as the bit-exactness oracle
+        run_leg("warmup", 1)
+        legs = {n: run_leg(f"throughput_{n}w", n)
+                for n in worker_counts}
+        oracle = legs[1]["replies"]
+        cores = os.cpu_count() or 1
+        base = legs[1]["jobs_s"]
+        scaling = {}
+        for n in worker_counts[1:]:
+            want = {2: 1.7, 4: 3.0}[n]
+            ratio = round(legs[n]["jobs_s"] / base, 2)
+            asserted = cores >= n
+            if asserted and ratio < want:
+                raise RuntimeError(
+                    f"fleet scaling: {n} workers gave {ratio}x "
+                    f"(want >= {want}x) on a {cores}-core host")
+            if not asserted and ratio < 0.35:
+                raise RuntimeError(
+                    f"fleet collapsed at {n} workers: {ratio}x "
+                    f"single-worker throughput")
+            scaling[n] = {
+                "jobs_s": legs[n]["jobs_s"], "ratio_vs_1w": ratio,
+                "scaling_asserted": asserted,
+                **({} if asserted else {
+                    "reason": f"host has {cores} core(s), "
+                              f"needs >= {n}"})}
+
+        # ---- rolling restart mid-stream: zero lost jobs, zero
+        # compiles (requeue merge + pending re-send + journal
+        # recovery through the warm shared cache)
+        mgr, router, reporter = start_fleet("restart", 2)
+        replies = {}
+
+        def _reply(r):
+            replies[r.get("job_id") or r.get("id")] = r
+
+        try:
+            cut = int(len(lines) * 0.6)
+            for ln in lines[:cut]:
+                router.feed(ln, reply=_reply)
+            # restart w0 with the stream mid-flight: its queued jobs
+            # requeue, its in-flight jobs re-send, its sessions keep
+            # their journals; rejoining remaps its targets back and
+            # releases them from the survivor (live migration)
+            mgr.restart_worker(router, "w0")
+            for ln in lines[cut:]:
+                router.feed(ln, reply=_reply)
+            if not router.drain(timeout=900):
+                raise RuntimeError("restart leg did not drain")
+            # the aggregated stats fan-out (repeatable serve-status
+            # renders this shape): every live worker rides along
+            stats_reply = {}
+            router.feed(json.dumps({"op": "stats", "id": "st1"}),
+                        reply=lambda r: (stats_reply.update(r)))
+            deadline = time.time() + 30
+            while "fleet" not in stats_reply \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            mgr.shutdown(router)
+            reporter.close()
+        rejected = sorted(j for j, r in replies.items()
+                          if r.get("status") == "REJECTED")
+        if rejected or set(replies) != set(all_ids):
+            raise RuntimeError(
+                f"rolling restart lost jobs: "
+                f"{sorted(set(all_ids) - set(replies))} missing, "
+                f"{rejected} rejected")
+        if len(stats_reply.get("workers") or {}) != 2:
+            raise RuntimeError(
+                f"stats fan-out answered with "
+                f"{sorted(stats_reply.get('workers') or {})}, "
+                f"want 2 workers")
+        def _leg_spans(records):
+            for r in records:
+                if r.get("record") != "serve":
+                    continue
+                for d in (r.get("spans"), r.get("open_spans")):
+                    if isinstance(d, dict):
+                        yield d
+        restart_records = read_records(mgr.out)
+        compiled = [d for d in _leg_spans(restart_records)
+                    if "compile_s" in d or "eval_compile_s" in d]
+        if compiled:
+            raise RuntimeError(
+                f"rolling restart recompiled {len(compiled)} "
+                f"span(s); warm dispatch must deserialize: "
+                f"{compiled[0]}")
+        if not any("deserialize_s" in d or "eval_deserialize_s" in d
+                   for d in _leg_spans(restart_records)):
+            raise RuntimeError(
+                "restart leg shows no deserialize_s span; the "
+                "shared-cache warm path did not run")
+        restart_out = mgr.out
+
+        # ---- kill -9 one worker mid-load: healthy jobs all
+        # complete; the dead worker's warm session migrates and its
+        # post-failover deltas are bit-exact vs the oracle
+        mgr, router, reporter = start_fleet("kill", 2)
+        replies = {}
+        try:
+            pre = [ln for ln in lines
+                   if json.loads(ln)["id"].startswith("j")] \
+                + [ln for ln in lines
+                   if json.loads(ln)["id"] in delta_ids[:n_targets]]
+            for ln in pre:
+                router.feed(ln, reply=_reply_into(replies))
+            if not router.drain(timeout=900):
+                raise RuntimeError("kill leg warm phase stalled")
+            victim = router._session_owner.get("j0")
+            if victim is None:
+                raise RuntimeError("kill leg: j0 has no owner")
+            # a burst of cold solves in flight, then SIGKILL the
+            # worker owning j0's warm session.  Solves are safely
+            # resendable; the pending deltas come AFTER the kill so
+            # the journal replay sequence matches the oracle exactly
+            for ln in solve_lines:
+                router.feed(ln, reply=_reply_into(replies))
+            router.workers[victim].process.send_signal(
+                _signal.SIGKILL)
+            # wait for the router to notice the corpse before
+            # feeding the post-kill deltas: a delta sent into the
+            # victim's dying socket could be journaled-but-unreplied
+            # and its re-send would double-apply, breaking the
+            # oracle comparison; solves don't care
+            deadline = time.time() + 60
+            while victim in router.live_workers() \
+                    and time.time() < deadline:
+                time.sleep(0.02)
+            post_deltas = [ln for ln in lines
+                           if json.loads(ln)["id"] in
+                           delta_ids[n_targets:]]
+            for ln in post_deltas:
+                router.feed(ln, reply=_reply_into(replies))
+            if not router.drain(timeout=900):
+                raise RuntimeError("kill leg did not drain")
+        finally:
+            mgr.shutdown(router)
+            reporter.close()
+        fed_ids = {json.loads(ln)["id"]
+                   for ln in pre + solve_lines + post_deltas}
+        rejected = sorted(j for j, r in replies.items()
+                          if r.get("status") == "REJECTED")
+        if rejected or set(replies) != fed_ids:
+            raise RuntimeError(
+                f"kill -9 lost healthy jobs: "
+                f"{sorted(fed_ids - set(replies))} missing, "
+                f"{rejected} rejected")
+        if router.stats["failovers"] < 1:
+            raise RuntimeError("kill leg recorded no failover")
+        migrated = [j for j in delta_ids[n_targets:]
+                    if json.loads(lines[all_ids.index(j)])
+                    ["target"] == "j0"]
+        if not migrated:
+            raise RuntimeError(
+                "kill leg has no post-failover deltas for j0; "
+                "regenerate the stream")
+        for jid in migrated:
+            got, want = replies[jid], oracle[jid]
+            if (got.get("assignment") != want.get("assignment")
+                    or got.get("cost") != want.get("cost")
+                    or got.get("cycle") != want.get("cycle")):
+                raise RuntimeError(
+                    f"migrated session diverged on {jid}: "
+                    f"{got.get('cost')}/{got.get('cycle')} vs "
+                    f"oracle {want.get('cost')}/"
+                    f"{want.get('cycle')}")
+
+        return {
+            "metric": f"serve_fleet_{n_jobs}job_"
+                      f"{max(worker_counts)}w",
+            "value": {
+                "jobs_s_1w": base,
+                "scaling": scaling,
+                "cores": cores,
+                "rolling_restart": {
+                    "lost_jobs": 0, "recompiles": 0,
+                    "out": restart_out},
+                "kill9": {
+                    "victim": victim,
+                    "failovers": router.stats["failovers"],
+                    "resent": router.stats["resent"],
+                    "migrated_deltas_bitexact": len(migrated),
+                    "out": mgr.out},
+                "outs": {f"{n}w": legs[n]["out"]
+                         for n in worker_counts},
+            },
+            "unit": "jobs/s scale-out + restart/failover contracts",
+            "contracts_asserted": True,
+            "hardware": jax.default_backend(),
+        }
+    finally:
+        if not keep:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+def _reply_into(replies):
+    def _r(rec):
+        replies[rec.get("job_id") or rec.get("id")] = rec
+    return _r
+
+
 def bench_autotune(quick=False):
     """The ISSUE 18 contract: autotune a small rung ladder on host
     CPU through the real batched runners, then A/B tuned-vs-default
@@ -3208,7 +3574,7 @@ BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_telemetry_overhead, bench_decimation,
            bench_bnb_pruning, bench_serve, bench_dynamic,
            bench_roi, bench_portfolio, bench_serve_dynamic,
-           bench_chaos, bench_autotune]
+           bench_chaos, bench_autotune, bench_fleet]
 
 
 def main():

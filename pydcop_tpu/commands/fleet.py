@@ -1,0 +1,205 @@
+"""``pydcop fleet``: N serve workers behind one routing socket.
+
+The horizontal half of solver-as-a-service (ISSUE 19): one
+:class:`~pydcop_tpu.serving.fleet.FleetRouter` owns the client-facing
+unix socket and speaks the same request schema as a solo ``pydcop
+serve`` daemon, consistent-hashing delta targets (and the maxsum
+solves that may become targets) across N worker daemons while
+spilling other cold solves to the shallowest queue.  Workers share
+one executable cache, tuned-config store, session-journal and
+checkpoint directory under ``--fleet-dir``, and append (worker_id-
+stamped, schema minor 10) to one ``--out`` file.
+
+SIGTERM drains the fleet: each worker is rolling-drained (its queued
+jobs requeue, its warm sessions keep their journals) and the router
+exits once every in-flight job is answered or re-routed.
+
+Examples::
+
+    pydcop fleet --workers 4 --socket /tmp/fleet.sock \
+        --fleet-dir /var/lib/pydcop/fleet
+    pydcop fleet --workers 2 --oneshot jobs.jsonl --fleet-dir d/
+
+``pydcop serve-status --socket /tmp/fleet.sock`` renders the
+aggregated snapshot (repeat ``--socket`` to also interrogate worker
+sockets directly).
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "fleet",
+        help="run N serve workers behind one consistent-hash "
+             "routing socket (shared exec cache / tuned store / "
+             "session journals; live warm-session migration)")
+    parser.add_argument("--workers", type=int, default=2,
+                        metavar="N",
+                        help="number of worker daemons to spawn "
+                             "(default 2)")
+    parser.add_argument("--socket", type=str, default=None,
+                        metavar="PATH",
+                        help="client-facing unix socket (same "
+                             "schema as `pydcop serve --socket`); "
+                             "default: read requests from stdin, "
+                             "EOF drains")
+    parser.add_argument("--oneshot", type=str, default=None,
+                        metavar="JOBS.jsonl",
+                        help="feed requests from this file, drain "
+                             "the fleet, exit")
+    parser.add_argument("--fleet-dir", dest="fleet_dir", type=str,
+                        default="pydcop_fleet", metavar="DIR",
+                        help="fleet state root: exec/ tuned/ "
+                             "journal/ ckpt/ subdirs shared by all "
+                             "workers, plus per-worker sockets and "
+                             "stderr captures (default: "
+                             "./pydcop_fleet)")
+    parser.add_argument("--out", type=str, default=None,
+                        metavar="out.jsonl",
+                        help="shared JSONL telemetry file all "
+                             "workers and the router append to, "
+                             "each record stamped with its "
+                             "worker_id (default: "
+                             "FLEET_DIR/fleet_out.jsonl)")
+    parser.add_argument("--max-batch", dest="max_batch", type=int,
+                        default=8,
+                        help="per-worker rung-fills dispatch "
+                             "trigger (forwarded to every worker)")
+    parser.add_argument("--max-delay-ms", dest="max_delay_ms",
+                        type=float, default=25.0,
+                        help="per-worker latency-deadline dispatch "
+                             "trigger (forwarded)")
+    parser.add_argument("--max-cycles", "--max_cycles",
+                        dest="max_cycles", type=int, default=2000,
+                        help="default cycle budget (forwarded)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="default engine seed (forwarded)")
+    parser.add_argument("--metrics-port", dest="metrics_port",
+                        type=int, default=None, metavar="PORT",
+                        help="Prometheus endpoint for the ROUTER's "
+                             "worker-labeled fleet metrics "
+                             "(pydcop_fleet_*); /stats serves the "
+                             "aggregated fleet snapshot")
+    parser.add_argument("--worker-arg", dest="worker_args",
+                        action="append", default=None,
+                        metavar="ARG",
+                        help="extra flag forwarded verbatim to "
+                             "every worker's `pydcop serve` command "
+                             "line (repeatable), e.g. "
+                             "--worker-arg=--roi")
+    parser.add_argument("--connect-timeout-s",
+                        dest="connect_timeout_s", type=float,
+                        default=180.0, metavar="S",
+                        help="how long to wait for each spawned "
+                             "worker to bind its socket (workers "
+                             "import jax on startup)")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..observability.report import RunReporter
+    from ..serving.fleet import (ROUTER_ID, FleetManager, FleetRouter,
+                                 WorkerError)
+
+    if args.workers < 1:
+        raise CliError("--workers must be >= 1")
+    if args.oneshot and args.socket:
+        raise CliError("--oneshot and --socket are mutually exclusive")
+
+    manager = FleetManager(
+        args.fleet_dir, out=args.out,
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        max_cycles=args.max_cycles, seed=args.seed,
+        worker_args=args.worker_args)
+
+    registry = None
+    from ..observability.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+
+    reporter = RunReporter(manager.out, algo="serve", mode="serve",
+                           worker_id=ROUTER_ID)
+    metrics_server = None
+    stop = threading.Event()
+    router = None
+    try:
+        reporter.header(
+            fleet_workers=args.workers, fleet_dir=manager.fleet_dir,
+            max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+            max_cycles=args.max_cycles,
+            source=("oneshot" if args.oneshot
+                    else "socket" if args.socket else "stdin"))
+        router = FleetRouter(reporter=reporter, registry=registry,
+                             checkpoint_dir=manager.ckpt_dir)
+        try:
+            manager.start(router, args.workers,
+                          connect_timeout=args.connect_timeout_s)
+        except WorkerError as e:
+            raise CliError(str(e))
+        print(f"[fleet] {args.workers} worker(s) up under "
+              f"{manager.fleet_dir}", file=sys.stderr)
+
+        if args.metrics_port is not None:
+            from ..observability.registry import MetricsHTTPServer
+
+            metrics_server = MetricsHTTPServer(
+                registry, port=args.metrics_port,
+                snapshot_fn=router.stats_snapshot)
+            print(f"[fleet] metrics on http://127.0.0.1:"
+                  f"{metrics_server.port}/metrics", file=sys.stderr)
+
+        prev_term = signal.signal(
+            signal.SIGTERM, lambda _s, _f: stop.set())
+        try:
+            if args.oneshot:
+                if not os.path.exists(args.oneshot):
+                    raise CliError(
+                        f"oneshot jobs file not found: "
+                        f"{args.oneshot}")
+                with open(args.oneshot) as f:
+                    for line in f:
+                        router.feed(line)
+                router.drain()
+            elif args.socket:
+                from ..serving.sources import SocketServer
+
+                server = SocketServer(router, args.socket)
+                try:
+                    while not stop.wait(0.2):
+                        pass
+                finally:
+                    server.close()
+                router.drain(timeout=60.0)
+            else:
+                for line in sys.stdin:
+                    if stop.is_set():
+                        break
+                    router.feed(line)
+                router.drain()
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+        snap = router.stats_snapshot()
+        fl = snap["fleet"]["router"]
+        print(f"[fleet] received={fl['received']} "
+              f"routed={fl['routed']} spilled={fl['spilled']} "
+              f"replies={fl['replies']} "
+              f"failovers={fl['failovers']}", file=sys.stderr)
+        reporter.serve(event="stats",
+                       **{k: v for k, v in snap.items()
+                          if k not in ("record", "algo", "mode",
+                                       "event")})
+    finally:
+        if metrics_server is not None:
+            metrics_server.close()
+        if router is not None:
+            manager.shutdown(router)
+        reporter.close()
+    return 0

@@ -272,6 +272,17 @@ def set_parser(subparsers):
                              "bisects / sheds like any failure) "
                              "instead of freezing the daemon behind "
                              "a hang.  Default: no deadline")
+    parser.add_argument("--worker-id", dest="worker_id",
+                        type=str, default=None, metavar="ID",
+                        help="fleet identity of this daemon (`pydcop "
+                             "fleet` sets it): stamps worker_id on "
+                             "every record written to --out (schema "
+                             "minor 10, so N workers can share one "
+                             "out file) and names this worker's "
+                             "requeue file requeue-ID.jsonl inside a "
+                             "SHARED --checkpoint directory.  "
+                             "Default: solo daemon, no stamp, legacy "
+                             "requeue.jsonl")
     parser.add_argument("--no-metrics", dest="no_metrics",
                         action="store_true",
                         help="disable the in-process metrics registry "
@@ -390,7 +401,9 @@ def run_cmd(args, timeout=None):
 
         registry = MetricsRegistry()
 
-    reporter = RunReporter(args.out, algo="serve", mode="serve")
+    worker_id = getattr(args, "worker_id", None)
+    reporter = RunReporter(args.out, algo="serve", mode="serve",
+                           worker_id=worker_id)
     metrics_server = None
     try:
         reserve = getattr(args, "reserve_slots", None)
@@ -436,14 +449,16 @@ def run_cmd(args, timeout=None):
                          registry=registry,
                          heartbeat_s=heartbeat_s,
                          faults=faults,
-                         checkpoints=checkpoints)
+                         checkpoints=checkpoints,
+                         worker_id=worker_id)
         if checkpoints is not None:
             # a previous daemon's preemption drain left requeued
             # jobs: re-admit them FIRST, ahead of the live sources —
             # continue, don't recompute
             from ..serving.daemon import requeue_take
 
-            requeued = requeue_take(checkpoints.directory)
+            requeued = requeue_take(checkpoints.directory,
+                                    worker_id=worker_id)
             for line in requeued:
                 loop.feed(line)
             if requeued:

@@ -8,6 +8,13 @@ rates, cache effectiveness, memory accounting, and the registry's
 latency quantiles.  ``--json`` dumps the raw snapshot for scripts;
 for HTTP-side scraping the same payload lives at
 ``serve --metrics-port``'s ``/stats`` endpoint.
+
+Fleet-aware (ISSUE 19): ``--socket`` is repeatable — each socket
+gets its own labeled section and a final aggregated view sums the
+lifetime counters and queue depths across them.  Pointing one
+``--socket`` at a ``pydcop fleet`` router renders the router's own
+aggregation plus the per-worker snapshots that rode along in its
+reply.
 """
 
 import json
@@ -22,9 +29,13 @@ def set_parser(subparsers):
         help="query a running serve daemon's operational snapshot "
              "(queue depth, rates, latency quantiles, memory) over "
              "its unix socket")
-    parser.add_argument("--socket", type=str, required=True,
+    parser.add_argument("--socket", dest="sockets", type=str,
+                        required=True, action="append",
                         metavar="PATH",
-                        help="the daemon's --socket path")
+                        help="a daemon's --socket path; repeatable "
+                             "— with several sockets (e.g. one per "
+                             "fleet worker) each renders its own "
+                             "section plus one aggregated view")
     parser.add_argument("--json", dest="as_json", action="store_true",
                         help="print the raw JSON snapshot instead of "
                              "the human summary")
@@ -115,11 +126,40 @@ def _cache_line(name: str, stats) -> str:
             f"{', ' + extras if extras else ''})")
 
 
+def aggregate_snapshots(snaps: dict) -> dict:
+    """Fold several daemons' snapshots into one fleet-wide view
+    (pure function): lifetime counters and queue depths sum, uptime
+    takes the longest-lived member.  ``snaps`` maps a label (socket
+    path or worker id) to its snapshot."""
+    agg_stats: dict = {}
+    queue_depth = 0
+    uptime = 0.0
+    for snap in snaps.values():
+        queue_depth += snap.get("queue_depth", 0) or 0
+        uptime = max(uptime, snap.get("uptime_s", 0) or 0)
+        for k, v in (snap.get("stats") or {}).items():
+            if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                agg_stats[k] = agg_stats.get(k, 0) + v
+    return {"record": "serve", "event": "stats",
+            "aggregated": sorted(snaps),
+            "uptime_s": uptime, "queue_depth": queue_depth,
+            "stats": agg_stats}
+
+
 def render_status(snap: dict) -> str:
     """The human rendering of one stats snapshot (pure function: the
     test tier feeds it canned snapshots)."""
-    lines = [f"serve daemon status "
-             f"(uptime {snap.get('uptime_s', 0):.1f}s)"]
+    members = snap.get("aggregated")
+    if members:
+        head = (f"fleet aggregate over {len(members)} daemon(s) "
+                f"(max uptime {snap.get('uptime_s', 0):.1f}s)")
+    else:
+        who = snap.get("worker_id")
+        head = (f"serve daemon status"
+                f"{f' [{who}]' if who else ''} "
+                f"(uptime {snap.get('uptime_s', 0):.1f}s)")
+    lines = [head]
     st = snap.get("stats", {})
     lines.append(
         f"  queue depth {snap.get('queue_depth', 0)} | "
@@ -127,6 +167,30 @@ def render_status(snap: dict) -> str:
         f"admitted {st.get('admitted', 0)}, "
         f"completed {st.get('completed', 0)}, "
         f"rejected {st.get('rejected', 0)}")
+    fleet = snap.get("fleet")
+    if fleet is not None:
+        # a `pydcop fleet` router snapshot: its routing counters,
+        # membership, and the per-worker snapshots that rode along
+        router = fleet.get("router") or {}
+        lines.append(
+            f"  fleet       workers "
+            f"{'/'.join(fleet.get('workers') or []) or 'none'} "
+            f"(of {'/'.join(fleet.get('members') or []) or '-'}) | "
+            f"routed {router.get('routed', 0)}, "
+            f"spilled {router.get('spilled', 0)}, "
+            f"resent {router.get('resent', 0)}, "
+            f"failovers {router.get('failovers', 0)}, "
+            f"requeue-merged {router.get('requeue_merged', 0)} | "
+            f"in-flight {fleet.get('pending', 0)}")
+        for wid, wsnap in sorted(
+                (snap.get("workers") or {}).items()):
+            wst = wsnap.get("stats") or {}
+            lines.append(
+                f"    {wid:<8} queue {wsnap.get('queue_depth', 0)}"
+                f" | received {wst.get('received', 0)}, "
+                f"completed {wst.get('completed', 0)}, "
+                f"rejected {wst.get('rejected', 0)} | "
+                f"uptime {wsnap.get('uptime_s', 0):.1f}s")
     for name in ("runner_cache", "exec_cache", "instance_cache",
                  "sessions"):
         lines.append(_cache_line(name.replace("_cache", ""),
@@ -227,9 +291,26 @@ def render_status(snap: dict) -> str:
 
 
 def run_cmd(args, timeout=None):
-    snap = fetch_status(args.socket, timeout=args.connect_timeout)
+    sockets = args.sockets
+    snaps = {path: fetch_status(path, timeout=args.connect_timeout)
+             for path in sockets}
+    if len(snaps) == 1:
+        # single-socket back-compat: the raw snapshot / one section
+        snap = next(iter(snaps.values()))
+        if args.as_json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+        else:
+            print(render_status(snap))
+        return 0
+    agg = aggregate_snapshots(snaps)
     if args.as_json:
-        print(json.dumps(snap, indent=2, sort_keys=True))
-    else:
-        print(render_status(snap))
+        print(json.dumps({"sockets": snaps, "aggregate": agg},
+                         indent=2, sort_keys=True))
+        return 0
+    for path in sockets:
+        print(f"== {path} ==")
+        print(render_status(snaps[path]))
+        print()
+    print("== aggregate ==")
+    print(render_status(agg))
     return 0

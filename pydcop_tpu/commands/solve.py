@@ -532,6 +532,17 @@ def run_cmd(args, timeout: Optional[float] = None):
             f"--roi takes no value (window every event) or 'auto' "
             f"(flip to full sweeps when the active fraction trends "
             f"toward 1), got {roi!r}")
+    if roi and args.mode == "sharded":
+        # ROADMAP: the activity-gated windowed sweep lives in the
+        # compiled warm engine only; the sharded (reference-parity)
+        # runtime has no window machinery.  Silently ignoring the
+        # flag would report full-sweep costs as if they were
+        # windowed, so the conflict is a loud startup rejection —
+        # same rc-2 contract as every other CLI conflict
+        raise CliError(
+            "--roi needs the compiled warm engine (-m engine); "
+            "sharded mode has no region-of-interest sweep — drop "
+            "--roi or drop -m sharded")
     if getattr(args, "portfolio", None):
         return _run_portfolio(args, t0, timeout, decim, bnb_flag)
     if args.mode != "sharded":

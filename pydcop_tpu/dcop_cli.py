@@ -41,13 +41,13 @@ def _make_parser():
 
     subparsers = parser.add_subparsers(dest="command", required=True)
     from .commands import (agent, autotune, batch, consolidate,
-                           distribute, generate, graph, orchestrator,
-                           replica_dist, run, serve, serve_status,
-                           solve, telemetry_validate)
+                           distribute, fleet, generate, graph,
+                           orchestrator, replica_dist, run, serve,
+                           serve_status, solve, telemetry_validate)
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, replica_dist, batch, consolidate, serve,
-                   serve_status, telemetry_validate, autotune):
+                   serve_status, telemetry_validate, autotune, fleet):
         module.set_parser(subparsers)
     return parser
 

@@ -124,11 +124,25 @@ SCHEMA_VERSION = 1
 #: ``pydcop autotune`` sidecar; ``default``) — plus ``tuned_rung``
 #: (the rung label whose sidecar was consulted) on summary records
 #: and the ``tuning_store`` snapshot block (path, counters, per-entry
-#: winner + age) on stats/heartbeat serve records.  A v1.0-1.8 reader
-#: stays green by the one documented forward-compat rule: consumers
-#: filter the stream by the record kinds (and fields) they speak and
-#: ignore the rest.
-SCHEMA_MINOR = 9
+#: winner + age) on stats/heartbeat serve records.
+#: Minor 10 (serve fleet, ISSUE 19) added the multi-worker
+#: attribution and routing audit: the optional ``worker_id`` stamp
+#: (non-empty string) on header/summary/serve/trace records — every
+#: record a ``pydcop serve --worker-id W`` daemon (or the fleet
+#: router) emits into a shared out file names its emitter — plus the
+#: serve ``event: fleet`` routing-audit records with ``action``
+#: (``route``: a delta followed its target's hash-ring owner;
+#: ``spill``: a cold solve went to the shallowest queue for its home
+#: rung; ``release``: a warm session was drained to the shared
+#: checkpoint dir for migration; ``rebalance``: a worker was
+#: preempt-drained and its load re-routed; ``failover``: a dead
+#: worker's in-flight jobs were re-sent to survivors; ``worker_up`` /
+#: ``worker_down``: fleet membership changes; ``requeue_merge``: a
+#: departed worker's requeue file was merged by the router).  A
+#: v1.0-1.9 reader stays green by the one documented forward-compat
+#: rule: consumers filter the stream by the record kinds (and fields)
+#: they speak and ignore the rest.
+SCHEMA_MINOR = 10
 
 RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
 
@@ -149,6 +163,14 @@ EDIT_KEYS = ("add_variable", "remove_variable", "add_constraint",
 FAULT_ACTIONS = ("retry", "bisect", "poisoned", "circuit_open",
                  "breaker_open", "breaker_probe", "breaker_close",
                  "preempt")
+
+#: the ``action`` vocabulary of serve ``event: fleet`` records
+#: (schema minor 10) — the fleet router's routing/membership audit
+#: trail; exhaustive like FAULT_ACTIONS so router and validator
+#: cannot drift
+FLEET_ACTIONS = ("route", "spill", "release", "rebalance",
+                 "failover", "worker_up", "worker_down",
+                 "requeue_merge")
 
 #: per-arm lifecycle vocabulary of the ``portfolio`` block (schema
 #: minor 8) — mirrors ``ops.arm_race.ARM_STATUSES``/``KILL_REASONS``
@@ -191,10 +213,14 @@ class RunReporter:
     """
 
     def __init__(self, path: str, algo: str, mode: str,
-                 bus=None):
+                 bus=None, worker_id=None):
         self.path = path
         self.algo = str(algo)
         self.mode = str(mode)
+        # schema minor 10: when set, every record this reporter emits
+        # carries the worker attribution — N fleet workers appending
+        # to one shared out file stay tellable apart
+        self.worker_id = str(worker_id) if worker_id else None
         if bus is None:
             from ..infrastructure.Events import event_bus
             bus = event_bus
@@ -211,6 +237,8 @@ class RunReporter:
     # ------------------------------------------------------------ write
 
     def _emit(self, record: Dict[str, Any], topic: str):
+        if self.worker_id is not None:
+            record.setdefault("worker_id", self.worker_id)
         data = (json.dumps(record) + "\n").encode()
         with self._lock:
             if self._fd is None:
@@ -399,6 +427,12 @@ def validate_record(rec: Dict[str, Any]):
                 raise ValueError(
                     f"fault serve record with unknown action "
                     f"{action!r}; known: {', '.join(FAULT_ACTIONS)}")
+        if event == "fleet":
+            action = rec.get("action")
+            if action not in FLEET_ACTIONS:
+                raise ValueError(
+                    f"fleet serve record with unknown action "
+                    f"{action!r}; known: {', '.join(FLEET_ACTIONS)}")
         _check_fault(rec.get("fault"))
         _check_retry(rec.get("retry"))
         jr = rec.get("journal_replayed")
@@ -448,6 +482,12 @@ def validate_record(rec: Dict[str, Any]):
         if tid is not None and (not isinstance(tid, str) or not tid):
             raise ValueError(
                 f"{kind} record with bad trace_id {tid!r}")
+    # the minor-10 multi-worker attribution: any attributed record in
+    # a shared fleet out file may name its emitting worker
+    wid = rec.get("worker_id")
+    if wid is not None and (not isinstance(wid, str) or not wid):
+        raise ValueError(
+            f"{kind} record with bad worker_id {wid!r}")
 
 
 def _check_upload_bytes(rec, kind):

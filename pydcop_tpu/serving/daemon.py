@@ -61,16 +61,26 @@ _STOP_DRAIN_GRACE = 0.25
 REQUEUE_FILE = "requeue.jsonl"
 
 
-def requeue_write(directory: str, lines) -> int:
-    """Merge ``lines`` into DIR/requeue.jsonl atomically (read the
-    survivors of any previous unconsumed preemption, append, one
-    write-temp+fsync+rename via the shared
+def requeue_file(worker_id: Optional[str] = None) -> str:
+    """The requeue file name for one daemon: the legacy
+    ``requeue.jsonl`` for a solo daemon, ``requeue-<worker_id>.jsonl``
+    for a fleet worker — N workers sharing one checkpoint directory
+    must never clobber each other's drain."""
+    return (f"requeue-{worker_id}.jsonl" if worker_id
+            else REQUEUE_FILE)
+
+
+def requeue_write(directory: str, lines,
+                  worker_id: Optional[str] = None) -> int:
+    """Merge ``lines`` into the daemon's requeue file atomically
+    (read the survivors of any previous unconsumed preemption,
+    append, one write-temp+fsync+rename via the shared
     ``robustness/checkpoint.atomic_write`` helper) — the same
     durability discipline as the checkpoints beside it.  Returns the
     file's total line count."""
     from ..robustness.checkpoint import atomic_write
 
-    path = os.path.join(directory, REQUEUE_FILE)
+    path = os.path.join(directory, requeue_file(worker_id))
     existing = []
     try:
         with open(path) as f:
@@ -79,15 +89,20 @@ def requeue_write(directory: str, lines) -> int:
         pass
     merged = existing + [ln.rstrip("\n") for ln in lines
                          if ln.strip()]
-    atomic_write(path,
-                 "\n".join(merged) + ("\n" if merged else ""))
+    if not merged:
+        # nothing to persist: a clean drain must not leave an empty
+        # requeue file behind (a restart would treat it as consumed
+        # state, and the fleet router as a merge candidate)
+        return 0
+    atomic_write(path, "\n".join(merged) + "\n")
     return len(merged)
 
 
-def requeue_take(directory: str):
-    """Consume DIR/requeue.jsonl: its lines, file removed — the
-    restarted daemon feeds them ahead of its live sources."""
-    path = os.path.join(directory, REQUEUE_FILE)
+def requeue_take(directory: str, worker_id: Optional[str] = None):
+    """Consume the daemon's requeue file: its lines, file removed —
+    the restarted daemon feeds them ahead of its live sources (and
+    the fleet router merges a DEAD worker's file the same way)."""
+    path = os.path.join(directory, requeue_file(worker_id))
     try:
         with open(path) as f:
             lines = [ln for ln in f if ln.strip()]
@@ -118,10 +133,17 @@ class ServeLoop:
                  breaker_threshold: int = 4,
                  breaker_cooldown_s: float = 5.0,
                  sleep: Callable[[float], None] = time.sleep,
-                 checkpoints=None):
+                 checkpoints=None,
+                 worker_id: Optional[str] = None):
         self.admission = admission
         self.dispatcher = dispatcher
         self.reporter = reporter
+        #: fleet identity (schema minor 10): names this daemon's
+        #: requeue file inside a SHARED checkpoint directory and rides
+        #: the stats snapshot so serve-status can label per-worker
+        #: views; record stamping itself is the reporter's job
+        #: (RunReporter(worker_id=...))
+        self.worker_id = str(worker_id) if worker_id else None
         self.default_max_cycles = int(default_max_cycles)
         self.default_seed = int(default_seed)
         self.default_precision = default_precision
@@ -425,6 +447,8 @@ class ServeLoop:
         snap = {
             "record": "serve", "algo": "serve", "mode": "serve",
             "event": "stats",
+            **({"worker_id": self.worker_id}
+               if self.worker_id else {}),
             "queue_depth": self.admission.depth(),
             "uptime_s": round(self.clock() - self._t_start, 6),
             "stats": dict(self.stats),
@@ -470,6 +494,32 @@ class ServeLoop:
             fields = {k: v for k, v in snap.items()
                       if k not in ("record", "algo", "mode", "event")}
             self.reporter.serve(event="stats", **fields)
+
+    def _handle_release(self, request: Dict, reply=None):
+        """Answer a ``release`` op (schema ``RELEASE_FIELDS``): drain
+        the named warm session to the shared checkpoint/journal dirs
+        so a peer worker can ``recover()`` it — the live half of the
+        fleet's rebalance mechanic.  Ack is a ``serve`` record,
+        ``event: fleet``, ``action: release``; releasing an unknown
+        or journal-only target is a no-op ack (``released: false``),
+        never an error — the router may race a release against an
+        eviction."""
+        sessions = getattr(self.dispatcher, "delta_sessions", None)
+        released = bool(sessions is not None
+                        and sessions.release(request["target"]))
+        rec = {"record": "serve", "algo": "serve", "mode": "serve",
+               "event": "fleet", "action": "release",
+               "id": request["id"], "target": request["target"],
+               "released": released,
+               **({"worker_id": self.worker_id}
+                  if self.worker_id else {})}
+        if reply is not None:
+            reply(rec)
+        if self.reporter is not None:
+            self.reporter.serve(
+                event="fleet", action="release",
+                job_id=request["id"], target=request["target"],
+                released=released)
 
     def _maybe_heartbeat(self):
         """Emit the periodic heartbeat ``serve`` record when the
@@ -559,7 +609,9 @@ class ServeLoop:
                 self.reporter.trace(trace_id, job_id or "?",
                                     "reject", reason=reason_class)
         if reply is not None:
-            reply(dict(rec, record="summary", mode="serve"))
+            reply(dict(rec, record="summary", mode="serve",
+                       **({"worker_id": self.worker_id}
+                          if self.worker_id else {})))
 
     def _admit_line(self, line: str, reply=None):
         line = line.strip()
@@ -575,6 +627,11 @@ class ServeLoop:
         if request.get("op") == "stats":
             # control-plane read: answered immediately, never queued
             self._handle_stats(request, reply)
+            return
+        if request.get("op") == "release":
+            # control-plane write (the fleet's migration handshake):
+            # drain one warm session to the shared dirs, immediately
+            self._handle_release(request, reply)
             return
         trace_id = f"t{next(self._trace_seq):08d}"
         if request.get("op") == "delta":
@@ -978,7 +1035,8 @@ class ServeLoop:
                     reason_class="shutdown")
             if self.checkpoints is not None:
                 total = requeue_write(self.checkpoints.directory,
-                                      requeue)
+                                      requeue,
+                                      worker_id=self.worker_id)
                 if self.reporter is not None:
                     self.reporter.serve(
                         event="preempt_drain",

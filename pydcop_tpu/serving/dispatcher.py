@@ -156,7 +156,8 @@ class DeltaSessions:
         self.stats: Dict[str, int] = {
             "opened": 0, "hits": 0, "evictions": 0, "dropped": 0,
             "evicted_bytes": 0, "closed": 0, "journal_replays": 0,
-            "checkpoint_saved": 0, "checkpoint_restored": 0}
+            "checkpoint_saved": 0, "checkpoint_restored": 0,
+            "released": 0}
 
     def get(self, target: str, target_request: Dict[str, Any],
             default_max_cycles: int, default_seed: int,
@@ -484,6 +485,28 @@ class DeltaSessions:
             closed += 1
         return closed
 
+    def release(self, target: str) -> bool:
+        """Preempt-drain ONE warm session for migration (the fleet's
+        ``release`` op, the per-session analogue of
+        ``close_all(preserve=True)``): close the resident engine —
+        device buffers released now — but keep the journal and base
+        snapshot on disk, so a peer worker sharing the journal /
+        checkpoint / exec-cache dirs rebuilds the session bit-exact
+        with :meth:`recover` (base restore + delta-tail replay, no
+        compile).  Returns True when a resident engine was drained;
+        False (no open session) is a clean no-op — the journal, if
+        one exists, is already the migratable artifact."""
+        engine = self._sessions.pop(target, None)
+        if engine is None:
+            return False
+        engine.close()
+        handle = self._journals.pop(target, None)
+        if handle is not None:
+            handle.close(truncate=False)
+        self.stats["released"] += 1
+        self.stats["closed"] += 1
+        return True
+
     def snapshot(self) -> Dict[str, Any]:
         """Counters plus live occupancy for serve records: size, the
         resident-byte gauge and the configured budget ride along so a
@@ -523,6 +546,13 @@ class Dispatcher:
                  roi_residual_threshold: Optional[float] = None,
                  tuned_store=None):
         self.reporter = reporter
+        #: socket replies are built from the summary kwargs BEFORE the
+        #: reporter stamps worker_id into the JSONL copy, so a fleet
+        #: client could not tell which worker served it — stamp the
+        #: reply dicts too
+        self._reply_stamp = (
+            {"worker_id": reporter.worker_id}
+            if getattr(reporter, "worker_id", None) else {})
         self.exec_cache = exec_cache
         #: autotuned per-rung config sidecars (tuning/store.py; None =
         #: dispatch never consults them).  Knobs the request didn't
@@ -769,7 +799,8 @@ class Dispatcher:
             if self.reporter is not None:
                 self.reporter.summary(**rec)
             if job.reply is not None:
-                job.reply(dict(rec, record="summary", mode="serve"))
+                job.reply(dict(rec, record="summary", mode="serve",
+                               **self._reply_stamp))
 
         self.stats["dispatches"] += 1
         self.stats["jobs"] += B
@@ -904,7 +935,8 @@ class Dispatcher:
             if self.reporter is not None:
                 self.reporter.summary(**rec)
             if job.reply is not None:
-                job.reply(dict(rec, record="summary", mode="serve"))
+                job.reply(dict(rec, record="summary", mode="serve",
+                               **self._reply_stamp))
 
         self.stats["dispatches"] += 1
         self.stats["jobs"] += len(group.jobs)
@@ -1086,7 +1118,8 @@ class Dispatcher:
         if self.reporter is not None:
             self.reporter.summary(**rec)
         if reply is not None:
-            reply(dict(rec, record="summary", mode="serve"))
+            reply(dict(rec, record="summary", mode="serve",
+                       **self._reply_stamp))
         self.stats["deltas"] += 1
         label = f"maxsum/{rung_label(engine.rung.signature)}"
         # deltas bypass the queue (dispatch happens at admission), so

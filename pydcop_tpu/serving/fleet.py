@@ -1,0 +1,933 @@
+"""Serve fleet: a front router over N worker daemons (ISSUE 19).
+
+One ``pydcop serve`` process is a throughput ceiling; the fleet stacks
+N of them behind a single client-facing unix socket.  The router owns
+the client socket and speaks the exact serving schema
+(``serving/schema.py``) — clients cannot tell a fleet from a solo
+daemon — and forwards each request line to one worker daemon over a
+persistent per-worker connection:
+
+* ``delta`` jobs (and the maxsum solves that may later become delta
+  **targets**) are **consistent-hashed** by target id onto the worker
+  ring — session affinity: every delta for a target lands where its
+  warm session lives, across router restarts and fleet membership
+  churn alike;
+* cold solves of the non-delta-capable families (dsa, mgm) **spill**
+  to the worker with the shallowest queue for the job's home rung
+  (proxied by ``(algo, dcop)`` — jobs sharing both share a rung),
+  deterministic tie-break by worker age;
+* ``stats`` fans out to every live worker and answers with the
+  aggregated snapshot (per-worker views riding along), which is what
+  a repeatable ``pydcop serve-status --socket`` renders.
+
+Workers share one executable-cache directory, one tuned-config store,
+one session-journal directory and one checkpoint directory — so a
+rung compiled anywhere is a deserialize everywhere, and a warm
+session is a *portable value*: base snapshot + replayable journal
+tail (``DeltaSessions.checkpoint_base`` / ``recover``).  That makes
+rebalance, rolling restart and failover the same mechanic:
+
+* **release** (live migration): the router asks worker A to drain one
+  session to the shared dirs (engine closed, journal + base snapshot
+  kept); the next delta routes to worker B, which rebuilds it
+  bit-exact with zero compiles;
+* **rolling restart / drain**: SIGTERM a worker — its preemption
+  drain requeues still-queued jobs to its per-worker
+  ``requeue-<id>.jsonl`` and preserves every session's journal; the
+  router merges the requeue file, re-sends the worker's in-flight
+  jobs to survivors, and warm sessions come back by journal recovery;
+* **failover** (``kill -9``, send error, EOF): same path minus the
+  requeue file — everything the dead worker never answered is still
+  in the router's pending table and re-sends in order.
+
+Per-worker health generalizes the per-rung circuit breakers (PR 13):
+a worker is OPEN (dead) after a send/read failure or process exit;
+its hash range redistributes immediately.  Delta re-sends are
+at-least-once: a worker killed between journaling a delta and
+answering it replays that delta on the survivor and then re-applies
+the re-sent copy — idempotent for ``change_costs`` edits (the
+recommended delta traffic under failover), surfaced in the routing
+audit either way.
+
+Telemetry: the router stamps ``worker_id: "router"`` on its own
+records and emits the schema-minor-10 ``event: fleet`` audit records
+(``route`` / ``spill`` / ``release`` / ``rebalance`` / ``failover`` /
+``worker_up`` / ``worker_down`` / ``requeue_merge``); Prometheus
+metrics carry a ``worker`` label.
+"""
+
+import bisect
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from .schema import rejection
+
+#: the router's own worker_id stamp on records it emits itself
+ROUTER_ID = "router"
+
+
+def _stable_hash(key: str) -> int:
+    """64-bit stable hash (process- and run-independent: the ring
+    must route identically across router restarts, which Python's
+    seeded ``hash()`` would not)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"),
+                        digest_size=8).digest(), "big")
+
+
+def _rung_key(dcop) -> str:
+    """Hashable proxy for a job's home rung: the dcop path string,
+    or a stable digest of an inline dcop object (jobs sharing the
+    instance share the rung, which is all the spill policy needs)."""
+    if isinstance(dcop, str):
+        return dcop
+    try:
+        return hashlib.blake2b(
+            json.dumps(dcop, sort_keys=True).encode(),
+            digest_size=8).hexdigest()
+    except (TypeError, ValueError):
+        return repr(type(dcop))
+
+
+class ConsistentHashRing:
+    """Classic consistent hashing with virtual nodes: each member
+    owns ``replicas`` points on a 64-bit ring; a key routes to the
+    first point clockwise.  Removing a member redistributes ONLY its
+    arcs — every other key keeps its owner, which is exactly the
+    session-affinity property the fleet leans on."""
+
+    def __init__(self, replicas: int = 64):
+        self.replicas = int(replicas)
+        self._points: List[int] = []      # sorted vnode hashes
+        self._owner: Dict[int, str] = {}  # vnode hash -> member
+        self._members: set = set()
+
+    def add(self, member: str):
+        if member in self._members:
+            return
+        self._members.add(member)
+        for i in range(self.replicas):
+            h = _stable_hash(f"{member}#{i}")
+            # vnode collisions between members are astronomically
+            # unlikely at 64 bits; first owner keeps the point so
+            # add/remove stays symmetric
+            if h in self._owner:
+                continue
+            bisect.insort(self._points, h)
+            self._owner[h] = member
+
+    def remove(self, member: str):
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        self._points = [h for h in self._points
+                        if self._owner.get(h) != member]
+        self._owner = {h: m for h, m in self._owner.items()
+                       if m != member}
+
+    def members(self):
+        return set(self._members)
+
+    def route(self, key: str) -> Optional[str]:
+        """The live owner of ``key``; None on an empty ring."""
+        if not self._points:
+            return None
+        h = _stable_hash(key)
+        i = bisect.bisect_right(self._points, h)
+        if i == len(self._points):
+            i = 0
+        return self._owner[self._points[i]]
+
+
+class WorkerError(RuntimeError):
+    """A worker that could not be reached/started."""
+
+
+class WorkerClient:
+    """The router's handle to one worker daemon: its (optional) owned
+    subprocess, the persistent socket connection, and a reader thread
+    that surfaces every reply record (``on_record``) and the
+    connection's death (``on_disconnect``)."""
+
+    def __init__(self, worker_id: str, socket_path: str,
+                 process: Optional[subprocess.Popen] = None):
+        self.worker_id = str(worker_id)
+        self.socket_path = str(socket_path)
+        self.process = process
+        self.alive = False
+        #: set by drain_worker: no NEW routes while the worker winds
+        #: down (in-flight replies still arrive and are forwarded)
+        self.draining = False
+        self._conn = None
+        self._wlock = threading.Lock()
+        self._closing = False
+        self.on_record: Optional[Callable[[str, Dict], None]] = None
+        self.on_disconnect: Optional[Callable[[str], None]] = None
+
+    def connect(self, timeout: float = 120.0, poll: float = 0.05):
+        """Connect to the worker's socket, waiting out its startup
+        (a subprocess worker imports jax before it binds).  Raises
+        :class:`WorkerError` if the process died or the deadline
+        passed."""
+        import socket as socketlib
+
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.process is not None \
+                    and self.process.poll() is not None:
+                raise WorkerError(
+                    f"worker {self.worker_id} exited rc="
+                    f"{self.process.returncode} before binding "
+                    f"{self.socket_path}")
+            try:
+                conn = socketlib.socket(socketlib.AF_UNIX,
+                                        socketlib.SOCK_STREAM)
+                conn.connect(self.socket_path)
+                break
+            except OSError:
+                conn.close()
+                if time.monotonic() > deadline:
+                    raise WorkerError(
+                        f"worker {self.worker_id} did not bind "
+                        f"{self.socket_path} within {timeout}s")
+                time.sleep(poll)
+        self._conn = conn
+        self.alive = True
+        threading.Thread(target=self._read_loop,
+                         name=f"fleet-read-{self.worker_id}",
+                         daemon=True).start()
+
+    def _read_loop(self):
+        try:
+            with self._conn.makefile(
+                    "r", encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if self.on_record is not None:
+                        self.on_record(self.worker_id, rec)
+        except (OSError, ValueError):
+            pass
+        finally:
+            was_alive, self.alive = self.alive, False
+            if was_alive and not self._closing \
+                    and self.on_disconnect is not None:
+                self.on_disconnect(self.worker_id)
+
+    def send(self, line: str):
+        """One request line to the worker; ``OSError`` propagates —
+        the router turns it into a failover."""
+        data = (line.rstrip("\n") + "\n").encode()
+        with self._wlock:
+            if self._conn is None:
+                raise OSError("worker connection closed")
+            self._conn.sendall(data)
+
+    def terminate(self, sig: int = signal.SIGTERM):
+        """Signal the OWNED worker process (no-op for attached
+        workers)."""
+        if self.process is not None and self.process.poll() is None:
+            self.process.send_signal(sig)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        if self.process is None:
+            return None
+        return self.process.wait(timeout)
+
+    def close(self):
+        """Clean local close: no failover fires."""
+        self._closing = True
+        self.alive = False
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+
+
+class FleetRouter:
+    """The front router.  Duck-types a :class:`ServeLoop` for
+    :class:`~pydcop_tpu.serving.sources.SocketServer` — ``feed(line,
+    reply)`` is the whole contract — so the fleet reuses the solo
+    daemon's socket acceptor verbatim."""
+
+    def __init__(self, reporter=None, registry=None,
+                 checkpoint_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 stats_timeout_s: float = 10.0):
+        self.reporter = reporter
+        self.registry = registry
+        #: the SHARED checkpoint directory (workers' --checkpoint):
+        #: where a drained worker's requeue-<id>.jsonl lands, merged
+        #: here on worker_down
+        self.checkpoint_dir = checkpoint_dir
+        self.clock = clock
+        self.stats_timeout_s = float(stats_timeout_s)
+        self.workers: Dict[str, WorkerClient] = {}
+        #: worker ids in join order — the deterministic tie-break of
+        #: the spill policy
+        self._order: List[str] = []
+        self.ring = ConsistentHashRing()
+        self._lock = threading.RLock()
+        #: job_id -> routing entry for every unanswered request, in
+        #: send order — the failover re-send source of truth
+        self._pending: "OrderedDict[str, Dict]" = OrderedDict()
+        self._outstanding: Dict[str, int] = {}
+        self._key_depth: Dict[Any, int] = {}
+        #: target -> worker that currently holds (or last held) its
+        #: warm session; consulted on membership change so a target
+        #: remapping to a new ring owner gets a clean release first
+        self._session_owner: Dict[str, str] = {}
+        #: explicit rebalance overrides (win over the ring)
+        self._sticky: Dict[str, str] = {}
+        self._stats_waiters: Dict[str, Any] = {}
+        self._seq = 0
+        self._t_start = self.clock()
+        self.stats: Dict[str, int] = {
+            "received": 0, "routed": 0, "spilled": 0, "replies": 0,
+            "rejected": 0, "resent": 0, "failovers": 0,
+            "requeue_merged": 0, "releases": 0, "stats_served": 0}
+        self._metrics = (self._register_metrics(registry)
+                         if registry is not None else None)
+
+    # -------------------------------------------------------- ops plane
+
+    def _register_metrics(self, registry):
+        return {
+            "routed": registry.counter(
+                "pydcop_fleet_routed_total",
+                "jobs forwarded to a worker, by routing kind",
+                labels=("worker", "kind")),
+            "up": registry.gauge(
+                "pydcop_fleet_worker_up",
+                "1 while the worker is live and routable",
+                labels=("worker",)),
+            "outstanding": registry.gauge(
+                "pydcop_fleet_outstanding",
+                "requests sent to the worker and not yet answered",
+                labels=("worker",)),
+            "failovers": registry.counter(
+                "pydcop_fleet_failovers_total",
+                "worker deaths the router re-routed around",
+                labels=("worker",)),
+            "resent": registry.counter(
+                "pydcop_fleet_resent_total",
+                "in-flight jobs re-sent to a survivor",
+                labels=("worker",)),
+        }
+
+    def _fleet_record(self, action: str, **fields):
+        if self.reporter is not None:
+            self.reporter.serve(event="fleet", action=action,
+                                **fields)
+
+    # ------------------------------------------------------- membership
+
+    def add_worker(self, client: WorkerClient):
+        """Join a (connected) worker: wire its callbacks, add it to
+        the ring, then release any tracked session whose ring owner
+        just changed — the scale-out half of the rebalance
+        mechanic."""
+        wid = client.worker_id
+        client.on_record = self.on_record
+        client.on_disconnect = self._on_disconnect
+        with self._lock:
+            self.workers[wid] = client
+            if wid not in self._order:
+                self._order.append(wid)
+            self._outstanding.setdefault(wid, 0)
+            self.ring.add(wid)
+            remap = [(t, o) for t, o in self._session_owner.items()
+                     if o != wid and self._owner_of(t) == wid
+                     and t not in self._sticky]
+        if self._metrics is not None:
+            self._metrics["up"].set(1, worker=wid)
+        self._fleet_record("worker_up", worker=wid)
+        for target, old in remap:
+            # the returning/new worker now owns this target's hash
+            # range: drain the session where it currently lives so
+            # the next delta recovers it HERE instead of journaling
+            # from two processes
+            self.rebalance_target(target, wid, _from=old)
+
+    def _on_disconnect(self, wid: str):
+        self._worker_down(wid, cause="eof")
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return [w for w in self._order
+                    if (c := self.workers.get(w)) is not None
+                    and c.alive and not c.draining]
+
+    # ---------------------------------------------------------- routing
+
+    def feed(self, line: str, reply=None):
+        """One raw request line from a client (SocketServer calls
+        this from its per-connection reader threads)."""
+        line = line.strip()
+        if not line:
+            return
+        self.stats["received"] += 1
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError(
+                    f"request must be a JSON object, got "
+                    f"{type(rec).__name__}")
+        except ValueError as e:
+            self._reject(None, f"request is not valid JSON: {e}",
+                         reply)
+            return
+        job_id = rec.get("id")
+        if not isinstance(job_id, str) or not job_id.strip():
+            self._reject(None, "request missing 'id' (non-empty "
+                               "string)", reply)
+            return
+        job_id = job_id.strip()
+        op = rec.get("op", "solve")
+        if op == "stats":
+            self._handle_stats(job_id, reply)
+            return
+        if op == "delta" or op == "release":
+            target = rec.get("target")
+            if not isinstance(target, str) or not target.strip():
+                self._reject(job_id, f"{op} request missing "
+                                     f"'target'", reply)
+                return
+            target = target.strip()
+            wid = self._owner_of(target)
+            if wid is None:
+                self._reject(job_id, "no live workers", reply)
+                return
+            self.stats["routed" if op == "delta" else "releases"] += 1
+            if op == "delta":
+                with self._lock:
+                    self._session_owner[target] = wid
+            self._fleet_record("route", worker=wid, job_id=job_id,
+                               target=target, op=op)
+            self._count_routed(wid, "route")
+            self._dispatch(wid, job_id, line, reply, kind="route",
+                           key=("delta", target), target=target)
+            return
+        # a cold solve.  The delta-capable family routes by ring on
+        # its own id — the job IS a potential delta target, and its
+        # session must open where later deltas will hash; everything
+        # else spills to the shallowest queue for its home rung
+        key = (rec.get("algo"), _rung_key(rec.get("dcop")))
+        if rec.get("algo") == "maxsum":
+            wid = self._owner_of(job_id)
+            kind = "route"
+            if wid is not None:
+                with self._lock:
+                    self._session_owner[job_id] = wid
+        else:
+            wid = self._shallowest(key)
+            kind = "spill"
+        if wid is None:
+            self._reject(job_id, "no live workers", reply)
+            return
+        self.stats["routed" if kind == "route" else "spilled"] += 1
+        self._fleet_record(kind, worker=wid, job_id=job_id,
+                           algo=rec.get("algo"))
+        self._count_routed(wid, kind)
+        self._dispatch(wid, job_id, line, reply, kind=kind, key=key,
+                       target=None)
+
+    def _count_routed(self, wid, kind):
+        if self._metrics is not None:
+            self._metrics["routed"].inc(worker=wid, kind=kind)
+
+    def _owner_of(self, target: str) -> Optional[str]:
+        with self._lock:
+            wid = self._sticky.get(target)
+            if wid is not None:
+                c = self.workers.get(wid)
+                if c is not None and c.alive and not c.draining:
+                    return wid
+            return self.ring.route(target)
+
+    def _shallowest(self, key) -> Optional[str]:
+        """The spill policy: fewest outstanding jobs for this home
+        rung, then fewest outstanding overall, then join order."""
+        with self._lock:
+            live = [w for w in self._order
+                    if (c := self.workers.get(w)) is not None
+                    and c.alive and not c.draining]
+            if not live:
+                return None
+            return min(live, key=lambda w: (
+                self._key_depth.get((w, key), 0),
+                self._outstanding.get(w, 0),
+                self._order.index(w)))
+
+    def _dispatch(self, wid: str, job_id: str, line: str, reply,
+                  kind: str, key, target: Optional[str],
+                  resend: bool = False):
+        with self._lock:
+            client = self.workers.get(wid)
+            dead = client is None or not client.alive
+        if dead:
+            # lost a race with a failover: settle the corpse (the
+            # guard makes this idempotent), then pick again
+            if client is not None:
+                self._worker_down(wid, cause="send_error")
+            alt = (self._owner_of(target or job_id)
+                   if kind == "route" else self._shallowest(key))
+            if alt is None or alt == wid:
+                self._reject(job_id, "no live workers", reply)
+                return
+            self._dispatch(alt, job_id, line, reply, kind, key,
+                           target, resend=resend)
+            return
+        with self._lock:
+            self._pending[job_id] = {
+                "line": line, "reply": reply, "worker": wid,
+                "kind": kind, "key": key, "target": target}
+            self._outstanding[wid] = self._outstanding.get(wid, 0) + 1
+            self._key_depth[(wid, key)] = \
+                self._key_depth.get((wid, key), 0) + 1
+            if self._metrics is not None:
+                self._metrics["outstanding"].set(
+                    self._outstanding[wid], worker=wid)
+        try:
+            client.send(line)
+        except OSError:
+            # the send itself found the corpse: failover re-routes
+            # every pending job of this worker, including this one
+            self._worker_down(wid, cause="send_error")
+
+    def _reject(self, job_id, reason: str, reply,
+                reason_class: str = "fleet"):
+        self.stats["rejected"] += 1
+        rec = dict(rejection(job_id, reason),
+                   record="summary", algo="serve", mode="serve",
+                   reason_class=reason_class, worker_id=ROUTER_ID)
+        if self.reporter is not None:
+            self.reporter.summary(
+                **{k: v for k, v in rec.items()
+                   if k not in ("record", "algo", "mode",
+                                "worker_id")})
+        if reply is not None:
+            reply(rec)
+
+    # ---------------------------------------------------------- replies
+
+    def on_record(self, wid: str, rec: Dict):
+        """Every record a worker writes back on the router's
+        connection: stats sub-replies are collected, job replies are
+        forwarded to the client that sent the job."""
+        rid = rec.get("job_id") or rec.get("id")
+        if rid is None:
+            return
+        waiter = self._stats_waiters.pop(rid, None)
+        if waiter is not None:
+            holder, event = waiter
+            holder[wid] = rec
+            event.set()
+            return
+        with self._lock:
+            entry = self._pending.pop(rid, None)
+            if entry is not None:
+                self._settle_counts(entry)
+        if entry is None:
+            return
+        self.stats["replies"] += 1
+        if entry["reply"] is not None:
+            entry["reply"](rec)
+
+    def _settle_counts(self, entry):
+        wid, key = entry["worker"], entry["key"]
+        self._outstanding[wid] = max(
+            0, self._outstanding.get(wid, 0) - 1)
+        kd = self._key_depth.get((wid, key), 0)
+        if kd > 1:
+            self._key_depth[(wid, key)] = kd - 1
+        else:
+            self._key_depth.pop((wid, key), None)
+        if self._metrics is not None:
+            self._metrics["outstanding"].set(
+                self._outstanding[wid], worker=wid)
+
+    # --------------------------------------------------------- failover
+
+    def _worker_down(self, wid: str, cause: str):
+        """A worker died (EOF, send error, kill -9) or finished its
+        drain: remove it from the ring, merge its requeue file, and
+        re-send everything it never answered to the survivors — in
+        the original send order, so per-target delta sequences stay
+        sequences."""
+        with self._lock:
+            client = self.workers.get(wid)
+            if client is None or getattr(client, "_down_done", False):
+                return
+            client._down_done = True
+            client.alive = False
+            self.ring.remove(wid)
+            self._sticky = {t: o for t, o in self._sticky.items()
+                            if o != wid}
+            moved = [(jid, e) for jid, e in self._pending.items()
+                     if e["worker"] == wid]
+            for jid, entry in moved:
+                del self._pending[jid]
+                self._settle_counts(entry)
+        client.close()
+        self.stats["failovers"] += 1
+        if self._metrics is not None:
+            self._metrics["up"].set(0, worker=wid)
+            self._metrics["failovers"].inc(worker=wid)
+        self._fleet_record("worker_down", worker=wid, cause=cause)
+        # a SIGTERM-drained worker left its still-queued jobs in its
+        # per-worker requeue file; a kill -9 left nothing — either
+        # way the router's pending table still holds every unanswered
+        # job, so the file only contributes ids the router has never
+        # seen (e.g. re-queued lines from a PREVIOUS fleet run)
+        merged = []
+        if self.checkpoint_dir:
+            from .daemon import requeue_take
+
+            merged = requeue_take(self.checkpoint_dir, worker_id=wid)
+            if merged:
+                self.stats["requeue_merged"] += len(merged)
+                self._fleet_record("requeue_merge", worker=wid,
+                                   merged=len(merged))
+        pending_ids = {jid for jid, _ in moved}
+        if moved:
+            self._fleet_record("failover", worker=wid,
+                               resent=len(moved), cause=cause)
+        for jid, entry in moved:
+            self.stats["resent"] += 1
+            if self._metrics is not None:
+                self._metrics["resent"].inc(worker=wid)
+            target = entry["target"]
+            if target is not None:
+                with self._lock:
+                    if self._session_owner.get(target) == wid:
+                        del self._session_owner[target]
+            nxt = (self._owner_of(target or jid)
+                   if entry["kind"] == "route"
+                   else self._shallowest(entry["key"]))
+            if nxt is None:
+                self._reject(jid, "no live workers after failover "
+                             f"of {wid}", entry["reply"])
+                continue
+            if target is not None:
+                with self._lock:
+                    self._session_owner[target] = nxt
+            self._dispatch(nxt, jid, entry["line"], entry["reply"],
+                           entry["kind"], entry["key"], target,
+                           resend=True)
+        for line in merged:
+            try:
+                jid = json.loads(line).get("id")
+            except ValueError:
+                jid = None
+            if jid in pending_ids:
+                continue
+            self.feed(line)
+
+    def drain_worker(self, wid: str, timeout: float = 120.0) -> bool:
+        """Rolling-restart / scale-in step: stop routing to the
+        worker, SIGTERM it (its --checkpoint drain requeues queued
+        jobs and preserves session journals), wait for exit; the
+        reader thread's EOF then runs the same
+        merge-requeue-and-re-send failover path.  Returns True when
+        the process exited within ``timeout``."""
+        with self._lock:
+            client = self.workers.get(wid)
+            if client is None:
+                return False
+            client.draining = True
+            self.ring.remove(wid)
+        self._fleet_record("rebalance", worker=wid, cause="drain")
+        client.terminate(signal.SIGTERM)
+        try:
+            client.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return False
+        # give the reader thread a grace window to consume the final
+        # buffered replies and fire the EOF failover itself — forcing
+        # _worker_down early would re-send jobs that were in fact
+        # answered; only force if the thread never gets there
+        deadline = time.monotonic() + min(timeout, 10.0)
+        while not getattr(client, "_down_done", False) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if not getattr(client, "_down_done", False):
+            self._worker_down(wid, cause="drain")
+        return True
+
+    def rebalance_target(self, target: str, to_wid: str,
+                         timeout: float = 30.0,
+                         _from: Optional[str] = None) -> bool:
+        """Live warm-session migration: ``release`` the session on
+        its current worker (journal + base snapshot stay in the
+        shared dirs), then pin the target to ``to_wid`` — its next
+        delta recovers the session there, bit-exact, no compiles."""
+        owner = _from if _from is not None \
+            else self._owner_of(target)
+        if owner == to_wid:
+            return True
+        done = threading.Event()
+        self._seq += 1
+        rid = f"__fleet-release-{self._seq}"
+        ack: Dict[str, Any] = {}
+
+        def on_ack(rec):
+            ack.update(rec)
+            done.set()
+
+        line = json.dumps({"op": "release", "id": rid,
+                           "target": target})
+        if owner is not None and owner in self.workers \
+                and self.workers[owner].alive:
+            self._dispatch(owner, rid, line, on_ack, kind="route",
+                           key=("release", target), target=target)
+            done.wait(timeout)
+        with self._lock:
+            self._sticky[target] = to_wid
+            self._session_owner[target] = to_wid
+        self.stats["releases"] += 1
+        self._fleet_record(
+            "rebalance", worker=to_wid, target=target,
+            released_from=owner,
+            released=bool(ack.get("released")))
+        return done.is_set() or owner is None
+
+    # ------------------------------------------------------------ stats
+
+    def _handle_stats(self, job_id: str, reply):
+        """Fan the stats op out to every live worker, aggregate, and
+        answer with a fleet-shaped snapshot (per-worker views under
+        ``workers``, router counters under ``fleet``)."""
+        self.stats["stats_served"] += 1
+        live = self.live_workers()
+        holder: Dict[str, Dict] = {}
+        events = []
+        for wid in live:
+            client = self.workers.get(wid)
+            if client is None or not client.alive:
+                continue
+            self._seq += 1
+            sub_id = f"__fleet-stats-{self._seq}"
+            event = threading.Event()
+            self._stats_waiters[sub_id] = (holder, event)
+            events.append((sub_id, event))
+            try:
+                client.send(json.dumps({"op": "stats",
+                                        "id": sub_id}))
+            except OSError:
+                self._stats_waiters.pop(sub_id, None)
+                self._worker_down(wid, cause="send_error")
+        deadline = time.monotonic() + self.stats_timeout_s
+        for sub_id, event in events:
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                event.wait(remaining)
+            self._stats_waiters.pop(sub_id, None)
+        snap = self.stats_snapshot(workers=holder)
+        snap["id"] = job_id
+        if reply is not None:
+            reply(snap)
+        elif self.reporter is not None:
+            self.reporter.serve(
+                event="stats",
+                **{k: v for k, v in snap.items()
+                   if k not in ("record", "algo", "mode", "event")})
+
+    def stats_snapshot(self,
+                       workers: Optional[Dict[str, Dict]] = None
+                       ) -> Dict[str, Any]:
+        """The aggregated fleet snapshot, shaped as a ``serve``
+        record with ``event: stats`` exactly like a solo daemon's —
+        ``pydcop serve-status`` pointed at the ROUTER socket renders
+        it unchanged, with the per-worker views riding along."""
+        workers = workers or {}
+        with self._lock:
+            live = self.live_workers()
+            pending = len(self._pending)
+            outstanding = dict(self._outstanding)
+        agg: Dict[str, int] = {}
+        for wsnap in workers.values():
+            for k, v in (wsnap.get("stats") or {}).items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    agg[k] = agg.get(k, 0) + v
+        queue_depth = sum(w.get("queue_depth", 0)
+                          for w in workers.values())
+        return {
+            "record": "serve", "algo": "serve", "mode": "serve",
+            "event": "stats", "worker_id": ROUTER_ID,
+            "uptime_s": round(self.clock() - self._t_start, 6),
+            "queue_depth": queue_depth,
+            "stats": agg,
+            "fleet": {
+                "workers": live,
+                "members": list(self._order),
+                "pending": pending,
+                "outstanding": outstanding,
+                "router": dict(self.stats),
+            },
+            "workers": workers,
+        }
+
+    # -------------------------------------------------------- lifecycle
+
+    def drain(self, timeout: float = 600.0,
+              poll: float = 0.02) -> bool:
+        """Block until every routed job has been answered (the
+        oneshot/bench wait)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return True
+            time.sleep(poll)
+        return False
+
+    def close(self):
+        """Clean router shutdown: detach reader callbacks and close
+        worker connections (worker processes are the manager's to
+        stop)."""
+        with self._lock:
+            clients = list(self.workers.values())
+        for client in clients:
+            client.close()
+
+
+class FleetManager:
+    """Owns the fleet's shared directory layout and the N worker
+    subprocesses.  Layout under ``fleet_dir``::
+
+        exec/       shared executable cache (compile once, anywhere)
+        tuned/      shared autotuned-config store
+        journal/    shared session journals (the migratable tails)
+        ckpt/       shared checkpoints + per-worker requeue files
+        w<K>.sock   each worker's unix socket
+        w<K>.err    each worker's captured stderr
+
+    All workers append to ONE shared ``out`` file (the reporter's
+    O_APPEND atomicity), each stamping its ``worker_id``."""
+
+    def __init__(self, fleet_dir: str, out: Optional[str] = None,
+                 max_batch: int = 8, max_delay_ms: float = 25.0,
+                 max_cycles: int = 2000, seed: int = 0,
+                 worker_args: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 python: str = sys.executable):
+        self.fleet_dir = str(fleet_dir)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.out = out or os.path.join(self.fleet_dir,
+                                       "fleet_out.jsonl")
+        self.exec_dir = os.path.join(self.fleet_dir, "exec")
+        self.tuned_dir = os.path.join(self.fleet_dir, "tuned")
+        self.journal_dir = os.path.join(self.fleet_dir, "journal")
+        self.ckpt_dir = os.path.join(self.fleet_dir, "ckpt")
+        self.max_batch = int(max_batch)
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_cycles = int(max_cycles)
+        self.seed = int(seed)
+        self.worker_args = list(worker_args or [])
+        self.env = dict(os.environ)
+        if env:
+            self.env.update(env)
+        self.python = python
+        self._err_files: List[Any] = []
+
+    def socket_path(self, wid: str) -> str:
+        return os.path.join(self.fleet_dir, f"{wid}.sock")
+
+    def worker_cmd(self, wid: str) -> List[str]:
+        return [
+            self.python, "-m", "pydcop_tpu.dcop_cli", "serve",
+            "--socket", self.socket_path(wid),
+            "--worker-id", wid,
+            "--out", self.out,
+            "--exec-cache", self.exec_dir,
+            "--tuned-store", self.tuned_dir,
+            "--session-journal", self.journal_dir,
+            "--checkpoint", self.ckpt_dir,
+            "--max-batch", str(self.max_batch),
+            "--max-delay-ms", str(self.max_delay_ms),
+            "--max-cycles", str(self.max_cycles),
+            "--seed", str(self.seed),
+        ] + self.worker_args
+
+    def spawn(self, wid: str) -> WorkerClient:
+        """Start one worker daemon subprocess (not yet connected —
+        call ``client.connect()`` / use :meth:`start`)."""
+        sock = self.socket_path(wid)
+        try:
+            os.remove(sock)
+        except OSError:
+            pass
+        err = open(os.path.join(self.fleet_dir, f"{wid}.err"), "ab")
+        self._err_files.append(err)
+        proc = subprocess.Popen(
+            self.worker_cmd(wid), stdout=err, stderr=err,
+            env=self.env)
+        return WorkerClient(wid, sock, process=proc)
+
+    def start(self, router: FleetRouter, n_workers: int,
+              connect_timeout: float = 180.0) -> List[WorkerClient]:
+        """Spawn + connect + join ``n_workers`` workers (w0..wN-1)."""
+        clients = [self.spawn(f"w{k}") for k in range(n_workers)]
+        try:
+            for client in clients:
+                client.connect(timeout=connect_timeout)
+                router.add_worker(client)
+        except WorkerError:
+            for client in clients:
+                client.terminate(signal.SIGKILL)
+            raise
+        return clients
+
+    def restart_worker(self, router: FleetRouter, wid: str,
+                       timeout: float = 180.0) -> WorkerClient:
+        """One rolling-restart step: drain the worker (requeue merge
+        + failover re-send happen inside the router), spawn its
+        replacement under the same id, rejoin the ring."""
+        if not router.drain_worker(wid, timeout=timeout):
+            raise WorkerError(
+                f"worker {wid} did not drain within {timeout}s")
+        client = self.spawn(wid)
+        client.connect(timeout=timeout)
+        router.add_worker(client)
+        return client
+
+    def shutdown(self, router: Optional[FleetRouter] = None,
+                 timeout: float = 30.0):
+        """Stop every owned worker (SIGTERM, escalate to SIGKILL)."""
+        clients = (list(router.workers.values()) if router is not None
+                   else [])
+        if router is not None:
+            router.close()
+        for client in clients:
+            client.terminate(signal.SIGTERM)
+        for client in clients:
+            try:
+                client.wait(timeout)
+            except subprocess.TimeoutExpired:
+                client.terminate(signal.SIGKILL)
+                try:
+                    client.wait(10)
+                except subprocess.TimeoutExpired:
+                    pass
+        for err in self._err_files:
+            try:
+                err.close()
+            except OSError:
+                pass
+        self._err_files = []

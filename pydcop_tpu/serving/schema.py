@@ -36,8 +36,9 @@ SERVABLE_ALGOS = ("maxsum", "dsa", "mgm")
 #: every accepted ``solve`` request field -> short doc (the schema,
 #: used both for validation and the docs)
 REQUEST_FIELDS = {
-    "op": "optional: 'solve' (default), 'delta' (see DELTA_FIELDS) "
-          "or 'stats' (see STATS_FIELDS)",
+    "op": "optional: 'solve' (default), 'delta' (see DELTA_FIELDS), "
+          "'stats' (see STATS_FIELDS) or 'release' "
+          "(see RELEASE_FIELDS)",
     "id": "required job id (non-empty string, unique per client)",
     "dcop": "required path to the DCOP yaml file",
     "algo": f"required algorithm, one of {', '.join(SERVABLE_ALGOS)}",
@@ -84,6 +85,21 @@ DELTA_FIELDS = {
 STATS_FIELDS = {
     "op": "required: 'stats'",
     "id": "required request id (echoed in the snapshot record)",
+}
+
+#: the ``release`` control op (the fleet's live-migration handshake):
+#: drain ONE warm session to the shared checkpoint/journal dirs —
+#: close its resident engine, keep the base snapshot + replayable
+#: journal tail on disk — so another worker sharing those dirs can
+#: ``recover()`` it bit-exact on its next delta.  Answered immediately
+#: at admission with a ``serve`` record, ``event: "fleet"``,
+#: ``action: "release"``, ``released`` true when a resident session
+#: was drained (false: nothing resident — already released, or the
+#: session only ever existed as a journal)
+RELEASE_FIELDS = {
+    "op": "required: 'release'",
+    "id": "required request id (echoed in the ack record)",
+    "target": "required id of the warm session to drain",
 }
 
 _PRECISIONS = ("f32", "bf16", "auto")
@@ -133,9 +149,20 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
             raise bad(f"unknown stats request field(s): "
                       f"{', '.join(unknown)}")
         return rec
+    if op == "release":
+        unknown = sorted(set(rec) - set(RELEASE_FIELDS))
+        if unknown:
+            raise bad(f"unknown release request field(s): "
+                      f"{', '.join(unknown)}")
+        target = rec.get("target")
+        if not isinstance(target, str) or not target.strip():
+            raise bad("release request missing 'target' (the id of "
+                      "the warm session to drain)")
+        rec["target"] = target.strip()
+        return rec
     if op != "solve":
-        raise bad(f"unsupported op {op!r}; 'solve', 'delta' or "
-                  f"'stats'")
+        raise bad(f"unsupported op {op!r}; 'solve', 'delta', "
+                  f"'stats' or 'release'")
     unknown = sorted(set(rec) - set(REQUEST_FIELDS))
     if unknown:
         raise bad(f"unknown request field(s): {', '.join(unknown)}")

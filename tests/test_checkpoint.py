@@ -492,6 +492,81 @@ def test_preempt_fault_point_requeues_then_restart_completes(
     assert stats2["completed"] == len(lines)
 
 
+def test_restart_over_requeue_admits_before_socket_traffic(tmp_path):
+    """A restarted daemon started over a NON-empty requeue file
+    re-admits the requeued jobs before any new socket traffic (the
+    serve command feeds the file before it binds the socket, so the
+    bind is the ordering barrier), and ``requeue_write`` merges into
+    an existing file rather than clobbering it."""
+    import socket as sk
+    import subprocess
+    import sys as _sys
+    import time
+
+    from pydcop_tpu.observability.report import read_records
+    from pydcop_tpu.serving.daemon import (requeue_file,
+                                           requeue_write)
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    lines = _serve_lines(tmp_path, n=3)
+    # merge-not-clobber: two separate drains accumulate in order
+    assert requeue_write(str(ck), [lines[0]]) == 1
+    assert requeue_write(str(ck), [lines[1]]) == 2
+    on_disk = [json.loads(ln) for ln in
+               (ck / requeue_file()).read_text().splitlines()]
+    assert [r["id"] for r in on_disk] == ["j0", "j1"]
+
+    out = tmp_path / "out.jsonl"
+    sock = str(tmp_path / "d.sock")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+         "--socket", sock, "--out", str(out),
+         "--checkpoint", str(ck), "--max-batch", "1",
+         "--max-delay-ms", "1", "--max-cycles", "8"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "daemon died: " + proc.stderr.read().decode())
+            assert time.monotonic() < deadline, "daemon never bound"
+            time.sleep(0.05)
+        client = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+        client.settimeout(120)
+        client.connect(sock)
+        client.sendall((lines[2] + "\n").encode())
+        buf = b""
+        while b"\n" not in buf:
+            buf += client.recv(65536)
+        reply = json.loads(buf.split(b"\n", 1)[0])
+        client.close()
+        assert (reply.get("job_id") or reply.get("id")) == "j2"
+        assert reply.get("status") != "REJECTED", reply
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            proc.stderr.close()
+    # the requeue file was consumed exactly once by the restart
+    assert not (ck / requeue_file()).exists()
+    admits = [r["job_id"] for r in read_records(str(out))
+              if r.get("record") == "trace"
+              and r.get("event") == "admit"]
+    assert admits[:2] == ["j0", "j1"], admits
+    assert "j2" in admits and admits.index("j2") >= 2, admits
+
+
 def test_sigterm_without_checkpoint_keeps_reject_contract(tmp_path):
     from pydcop_tpu.serving.daemon import ServeLoop
     from pydcop_tpu.serving.dispatcher import Dispatcher

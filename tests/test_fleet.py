@@ -1,0 +1,536 @@
+"""Serve fleet (ISSUE 19): consistent-hash routing, N-worker
+scale-out, and live warm-session migration.
+
+* the hash ring: process-independent determinism, balanced spread,
+  MINIMAL remap (removing a member moves only its own keys — the
+  session-affinity property everything else leans on);
+* the router's policy matrix on fake workers: delta/maxsum affinity
+  (a target's solve and all its deltas land together), cold spill to
+  the shallowest per-rung queue with a deterministic tie-break,
+  sticky overrides from an explicit rebalance, structured rejection
+  with no live workers;
+* failover: a dead worker's pending jobs re-send IN ORDER to
+  survivors, its per-worker requeue file merges without double-
+  feeding ids the router already holds, fleet telemetry records the
+  worker_down/failover/requeue_merge audit trail;
+* the ``release`` op end-to-end through a real in-process daemon:
+  ack shape, idempotence (second release -> released false), journal
+  + snapshot preserved so the NEXT delta recovers the session warm;
+* per-worker requeue files (``requeue-<id>.jsonl``) coexisting with
+  the legacy solo file in one shared checkpoint dir;
+* repeatable ``serve-status``: the pure aggregation over several
+  snapshots and the fleet-section rendering of a router snapshot;
+* CLI conflicts reject with rc 2;
+* the ``bench_fleet`` quick contract end-to-end (real worker
+  subprocesses), every leg's shared JSONL green under
+  ``pydcop telemetry-validate``.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from pydcop_tpu.serving.fleet import (ROUTER_ID, ConsistentHashRing,
+                                      FleetRouter, _rung_key)
+
+pytestmark = pytest.mark.fleet
+
+
+class FakeClient:
+    """A WorkerClient stand-in: records sends, never needs a
+    process or a socket."""
+
+    def __init__(self, worker_id, fail=False):
+        self.worker_id = worker_id
+        self.alive = True
+        self.draining = False
+        self.process = None
+        self.sent = []
+        self.fail = fail
+        self.on_stats = None
+
+    def send(self, line):
+        if self.fail:
+            raise OSError("broken pipe")
+        self.sent.append(line)
+        if self.on_stats is not None:
+            rec = json.loads(line)
+            if rec.get("op") == "stats":
+                self.on_stats(self.worker_id, rec["id"])
+
+    def sent_ids(self):
+        return [json.loads(s).get("id") for s in self.sent]
+
+    def close(self):
+        self.alive = False
+
+    def terminate(self, sig=None):
+        pass
+
+    def wait(self, timeout=None):
+        return 0
+
+
+def mk_router(n=2, **kw):
+    router = FleetRouter(**kw)
+    clients = [FakeClient(f"w{k}") for k in range(n)]
+    for c in clients:
+        router.add_worker(c)
+    return router, clients
+
+
+# ------------------------------------------------------- hash ring
+
+
+def test_ring_is_deterministic_across_instances():
+    a, b = ConsistentHashRing(), ConsistentHashRing()
+    for ring in (a, b):
+        for w in ("w0", "w1", "w2"):
+            ring.add(w)
+    keys = [f"target-{i}" for i in range(300)]
+    assert [a.route(k) for k in keys] == [b.route(k) for k in keys]
+
+
+def test_ring_spreads_and_remaps_minimally():
+    ring = ConsistentHashRing()
+    for w in ("w0", "w1", "w2"):
+        ring.add(w)
+    keys = [f"t{i}" for i in range(600)]
+    before = {k: ring.route(k) for k in keys}
+    per = {w: sum(1 for o in before.values() if o == w)
+           for w in ("w0", "w1", "w2")}
+    # vnode spread: no member owns less than a tenth or more than
+    # two thirds of the keyspace
+    assert all(60 <= n <= 400 for n in per.values()), per
+    ring.remove("w1")
+    after = {k: ring.route(k) for k in keys}
+    # ONLY w1's keys moved, and none landed back on w1
+    for k in keys:
+        if before[k] != "w1":
+            assert after[k] == before[k]
+        else:
+            assert after[k] in ("w0", "w2")
+    # re-adding restores the exact original assignment
+    ring.add("w1")
+    assert {k: ring.route(k) for k in keys} == before
+
+
+def test_ring_empty_and_membership():
+    ring = ConsistentHashRing()
+    assert ring.route("anything") is None
+    ring.add("w0")
+    assert ring.route("anything") == "w0"
+    ring.remove("w0")
+    assert ring.route("anything") is None
+    assert ring.members() == set()
+
+
+def test_rung_key_hashable_for_inline_and_path_dcops():
+    assert _rung_key("a/b.yaml") == "a/b.yaml"
+    k1 = _rung_key({"name": "x", "domains": {"d": [0, 1]}})
+    k2 = _rung_key({"domains": {"d": [0, 1]}, "name": "x"})
+    assert k1 == k2  # key-order independent
+    assert isinstance(hash(("maxsum", k1)), int)
+
+
+# -------------------------------------------------- routing policy
+
+
+def test_delta_and_maxsum_solve_colocate():
+    router, (c0, c1) = mk_router()
+    router.feed(json.dumps({"id": "tgt", "algo": "maxsum",
+                            "dcop": "i.yaml"}))
+    owner = router._session_owner["tgt"]
+    for k in range(3):
+        router.feed(json.dumps({"id": f"d{k}", "op": "delta",
+                                "target": "tgt", "actions": []}))
+    home = c0 if owner == "w0" else c1
+    other = c1 if owner == "w0" else c0
+    assert home.sent_ids() == ["tgt", "d0", "d1", "d2"]
+    assert other.sent == []
+
+
+def test_cold_spill_balances_by_rung_depth_deterministically():
+    router, (c0, c1) = mk_router()
+    for k in range(4):
+        router.feed(json.dumps({"id": f"s{k}", "algo": "dsa",
+                                "dcop": "same.yaml"}))
+    # same rung -> alternating spill, join-order tie-break first
+    assert c0.sent_ids() == ["s0", "s2"]
+    assert c1.sent_ids() == ["s1", "s3"]
+    # a different rung starts from the shallowest again
+    router.on_record("w0", {"record": "summary", "job_id": "s0"})
+    router.on_record("w0", {"record": "summary", "job_id": "s2"})
+    router.feed(json.dumps({"id": "x0", "algo": "dsa",
+                            "dcop": "other.yaml"}))
+    assert c0.sent_ids()[-1] == "x0"  # fewest outstanding overall
+    assert router.stats["spilled"] == 5
+
+
+def test_no_live_workers_rejects_structurally():
+    router = FleetRouter()
+    got = []
+    router.feed(json.dumps({"id": "j1", "algo": "dsa",
+                            "dcop": "x"}), reply=got.append)
+    assert got and got[0]["status"] == "REJECTED"
+    assert "no live workers" in got[0]["error"]
+    assert got[0]["worker_id"] == ROUTER_ID
+    assert router.stats["rejected"] == 1
+
+
+def test_bad_json_and_missing_id_reject():
+    router, _ = mk_router()
+    got = []
+    router.feed("{not json", reply=got.append)
+    router.feed(json.dumps({"algo": "dsa", "dcop": "x"}),
+                reply=got.append)
+    assert len(got) == 2
+    assert all(r["status"] == "REJECTED" for r in got)
+
+
+def test_release_with_missing_target_rejects():
+    router, _ = mk_router()
+    got = []
+    router.feed(json.dumps({"id": "r1", "op": "release"}),
+                reply=got.append)
+    assert got and got[0]["status"] == "REJECTED"
+    assert "target" in got[0]["error"]
+
+
+# ---------------------------------------------------------- failover
+
+
+def test_worker_down_resends_pending_in_order(tmp_path):
+    router, (c0, c1) = mk_router(checkpoint_dir=str(tmp_path))
+    router.feed(json.dumps({"id": "tgt", "algo": "maxsum",
+                            "dcop": "i.yaml"}))
+    owner = router._session_owner["tgt"]
+    home, survivor = ((c0, c1) if owner == "w0" else (c1, c0))
+    for k in range(3):
+        router.feed(json.dumps({"id": f"d{k}", "op": "delta",
+                                "target": "tgt", "actions": []}))
+    survivor_before = list(survivor.sent_ids())
+    router._worker_down(owner, cause="kill")
+    # the dead worker's 4 unanswered jobs re-sent to the survivor,
+    # original order preserved (delta sequences stay sequences)
+    assert survivor.sent_ids() == survivor_before + \
+        ["tgt", "d0", "d1", "d2"]
+    assert router.stats["failovers"] == 1
+    assert router.stats["resent"] == 4
+    assert router._session_owner["tgt"] == survivor.worker_id
+    # ring no longer routes anything to the corpse
+    assert router._owner_of("tgt") == survivor.worker_id
+
+
+def test_worker_down_merges_requeue_without_double_feeding(tmp_path):
+    from pydcop_tpu.serving.daemon import requeue_write
+
+    router, (c0, c1) = mk_router(checkpoint_dir=str(tmp_path))
+    # j-pending is in the router's pending table AND in the dead
+    # worker's requeue file (drained mid-queue); j-fresh is only in
+    # the file (e.g. requeued by a previous fleet run)
+    router.feed(json.dumps({"id": "j-pending", "algo": "dsa",
+                            "dcop": "x"}))
+    victim = c0 if "j-pending" in c0.sent_ids() else c1
+    survivor = c1 if victim is c0 else c0
+    requeue_write(str(tmp_path), [
+        json.dumps({"id": "j-pending", "algo": "dsa", "dcop": "x"}),
+        json.dumps({"id": "j-fresh", "algo": "dsa", "dcop": "x"}),
+    ], worker_id=victim.worker_id)
+    router._worker_down(victim.worker_id, cause="kill")
+    ids = survivor.sent_ids()
+    assert ids.count("j-pending") == 1  # re-sent once, not twice
+    assert ids.count("j-fresh") == 1   # merged from the file
+    assert router.stats["requeue_merged"] == 2
+    # the file was consumed
+    assert not os.path.exists(
+        tmp_path / f"requeue-{victim.worker_id}.jsonl")
+
+
+def test_send_error_triggers_failover_rerouting():
+    router, (c0, c1) = mk_router()
+    router.feed(json.dumps({"id": "tgt", "algo": "maxsum",
+                            "dcop": "i.yaml"}))
+    owner = router._session_owner["tgt"]
+    home = c0 if owner == "w0" else c1
+    survivor = c1 if owner == "w0" else c0
+    home.fail = True
+    # affinity routes the delta at the now-broken home; the send
+    # error fails over and re-sends it (plus the pending tgt solve)
+    router.feed(json.dumps({"id": "d0", "op": "delta",
+                            "target": "tgt", "actions": []}))
+    assert survivor.sent_ids()[-2:] == ["tgt", "d0"]
+    assert router.stats["failovers"] == 1
+    assert not home.alive
+
+
+def test_fleet_records_carry_schema_minor_10_actions(tmp_path):
+    from pydcop_tpu.observability.report import (RunReporter,
+                                                 read_records,
+                                                 validate_record)
+
+    out = str(tmp_path / "out.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve",
+                           worker_id=ROUTER_ID)
+    router = FleetRouter(reporter=reporter,
+                         checkpoint_dir=str(tmp_path))
+    c0, c1 = FakeClient("w0"), FakeClient("w1")
+    router.add_worker(c0)
+    router.add_worker(c1)
+    router.feed(json.dumps({"id": "t", "algo": "maxsum",
+                            "dcop": "i.yaml"}))
+    router.feed(json.dumps({"id": "s", "algo": "dsa",
+                            "dcop": "i.yaml"}))
+    router._worker_down("w0", cause="kill")
+    reporter.close()
+    recs = read_records(out)
+    for r in recs:
+        validate_record(r)
+    actions = {r.get("action") for r in recs
+               if r.get("event") == "fleet"}
+    assert {"worker_up", "route", "spill", "worker_down"} <= actions
+    assert all(r.get("worker_id") == ROUTER_ID for r in recs
+               if r.get("record") == "serve")
+
+
+# ------------------------------------------------- stats aggregation
+
+
+def test_stats_fanout_aggregates_per_worker_snapshots():
+    router, (c0, c1) = mk_router(stats_timeout_s=5.0)
+
+    def answer(wid, sub_id):
+        # answer from another thread like a real worker connection
+        threading.Thread(target=router.on_record, args=(wid, {
+            "record": "serve", "event": "stats", "id": sub_id,
+            "queue_depth": 2 if wid == "w0" else 3,
+            "stats": {"received": 10, "completed": 7},
+            "uptime_s": 1.0})).start()
+
+    c0.on_stats = c1.on_stats = answer
+    got = []
+    router.feed(json.dumps({"op": "stats", "id": "st"}),
+                reply=got.append)
+    assert got, "stats fan-out never answered"
+    snap = got[0]
+    assert snap["event"] == "stats"
+    assert snap["id"] == "st"
+    assert snap["worker_id"] == ROUTER_ID
+    assert set(snap["workers"]) == {"w0", "w1"}
+    assert snap["queue_depth"] == 5
+    assert snap["stats"]["received"] == 20
+    assert snap["fleet"]["workers"] == ["w0", "w1"]
+
+
+def test_serve_status_aggregation_and_fleet_rendering():
+    from pydcop_tpu.commands.serve_status import (aggregate_snapshots,
+                                                  render_status)
+
+    snaps = {
+        "a.sock": {"uptime_s": 10.0, "queue_depth": 1,
+                   "stats": {"received": 5, "completed": 4},
+                   "worker_id": "w0"},
+        "b.sock": {"uptime_s": 20.0, "queue_depth": 2,
+                   "stats": {"received": 7, "completed": 6}},
+    }
+    agg = aggregate_snapshots(snaps)
+    assert agg["queue_depth"] == 3
+    assert agg["uptime_s"] == 20.0
+    assert agg["stats"] == {"received": 12, "completed": 10}
+    text = render_status(agg)
+    assert "fleet aggregate over 2 daemon(s)" in text
+    assert "received 12" in text
+    # a single worker snapshot names its worker
+    assert "[w0]" in render_status(snaps["a.sock"])
+    # a router snapshot renders the fleet section + members
+    rtext = render_status({
+        "uptime_s": 5.0, "queue_depth": 0, "stats": {},
+        "fleet": {"workers": ["w0", "w1"],
+                  "members": ["w0", "w1"],
+                  "pending": 4,
+                  "router": {"routed": 9, "spilled": 3,
+                             "resent": 1, "failovers": 1,
+                             "requeue_merged": 2}},
+        "workers": {"w0": {"queue_depth": 1,
+                           "stats": {"received": 6}}}})
+    assert "workers w0/w1" in rtext
+    assert "routed 9" in rtext
+    assert "in-flight 4" in rtext
+    assert "w0" in rtext
+
+
+# --------------------------------------------------- rebalance/release
+
+
+def test_rebalance_sets_sticky_and_sends_release():
+    router, (c0, c1) = mk_router()
+    router.feed(json.dumps({"id": "tgt", "algo": "maxsum",
+                            "dcop": "i.yaml"}))
+    owner = router._session_owner["tgt"]
+    home = c0 if owner == "w0" else c1
+    dest = "w1" if owner == "w0" else "w0"
+    router.rebalance_target("tgt", dest, timeout=0.1)
+    sent = [json.loads(s) for s in home.sent]
+    assert any(r.get("op") == "release" and r.get("target") == "tgt"
+               for r in sent)
+    assert router._sticky["tgt"] == dest
+    # the next delta follows the override, not the ring
+    router.feed(json.dumps({"id": "d0", "op": "delta",
+                            "target": "tgt", "actions": []}))
+    dest_client = c1 if dest == "w1" else c0
+    assert "d0" in dest_client.sent_ids()
+
+
+def test_release_op_end_to_end_preserves_journal(tmp_path):
+    """The live-migration primitive through a REAL in-process daemon:
+    release acks (released true / false on the second call), the
+    journal and base snapshot survive, and the next delta recovers
+    the session warm and bit-exact."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.dynamics.journal import JournalStore
+    from pydcop_tpu.engine._cache import ExecutableCache
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+    from pydcop_tpu.robustness.checkpoint import CheckpointStore
+    from pydcop_tpu.serving.daemon import ServeLoop
+    from pydcop_tpu.serving.dispatcher import Dispatcher
+    from pydcop_tpu.serving.queue import AdmissionQueue
+
+    yml = tmp_path / "i.yaml"
+    yml.write_text(dcop_yaml(generate_graph_coloring(
+        8, 3, "scalefree", m_edge=2, soft=True, seed=3)))
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    fname = sorted(load_dcop_from_file(str(yml)).constraints)[0]
+
+    def build(root, worker_id):
+        disp = Dispatcher(
+            exec_cache=ExecutableCache(path=str(root / "exec")),
+            journal=JournalStore(str(root / "journal")),
+            checkpoints=CheckpointStore(str(root / "ckpt")))
+        return ServeLoop(AdmissionQueue(max_batch=1,
+                                        max_delay_s=0.0),
+                         disp, default_max_cycles=6,
+                         worker_id=worker_id), disp
+
+    base = {"id": "t0", "dcop": str(yml), "algo": "maxsum",
+            "max_cycles": 6}
+    d0 = {"id": "d0", "op": "delta", "target": "t0",
+          "actions": [{"type": "change_costs", "name": fname,
+                       "costs": [[1, 2, 3], [4, 5, 6],
+                                 [7, 8, 9]]}]}
+    d1 = {"id": "d1", "op": "delta", "target": "t0",
+          "actions": [{"type": "change_costs", "name": fname,
+                       "costs": [[2, 0, 1], [0, 2, 1],
+                                 [1, 1, 0]]}]}
+
+    def run(loop, requests):
+        replies = []
+        for r in requests:
+            loop.feed(json.dumps(r), reply=replies.append)
+        loop.run_oneshot([])
+        return {r.get("job_id") or r.get("id"): r for r in replies}
+
+    # the oracle: base + d0 + d1 on one uninterrupted daemon
+    shared, oracle_dir = tmp_path / "shared", tmp_path / "oracle"
+    loopO, _ = build(oracle_dir, "oracle")
+    oracle = run(loopO, [base, d0, d1])["d1"]
+
+    # worker A in the SHARED dirs: base + d0, then release twice
+    loopA, dispA = build(shared, "wA")
+    got = run(loopA, [base, d0,
+                      {"id": "r0", "op": "release", "target": "t0"},
+                      {"id": "r1", "op": "release", "target": "t0"}])
+    ack, again = got["r0"], got["r1"]
+    assert ack["event"] == "fleet" and ack["action"] == "release"
+    assert ack["released"] is True
+    assert ack["worker_id"] == "wA"
+    assert again["released"] is False  # already drained: idempotent
+    assert dispA.delta_sessions.stats["released"] == 1
+    assert not dispA.delta_sessions.has("t0")
+    assert dispA.delta_sessions.journaled("t0")  # journal preserved
+
+    # worker B (fresh daemon, same shared dirs): d1 recovers the
+    # released session by journal replay and matches the oracle
+    # bit-exactly — the live-migration contract
+    loopB, _ = build(shared, "wB")
+    recovered = run(loopB, [d1])["d1"]
+    assert recovered["status"] != "REJECTED"
+    assert recovered["warm_start"] is True
+    assert recovered["assignment"] == oracle["assignment"]
+    assert recovered["cost"] == oracle["cost"]
+    assert recovered["cycle"] == oracle["cycle"]
+
+
+# ------------------------------------------- per-worker requeue files
+
+
+def test_per_worker_requeue_files_coexist(tmp_path):
+    from pydcop_tpu.serving.daemon import (requeue_file,
+                                           requeue_take,
+                                           requeue_write)
+
+    assert requeue_file(None) == "requeue.jsonl"
+    assert requeue_file("w3") == "requeue-w3.jsonl"
+    d = str(tmp_path)
+    requeue_write(d, ["solo-line"])
+    requeue_write(d, ["w0-line-a"], worker_id="w0")
+    requeue_write(d, ["w0-line-b"], worker_id="w0")  # merge
+    requeue_write(d, ["w1-line"], worker_id="w1")
+    # lines come back newline-terminated (the daemon's feed strips)
+    assert [l.strip() for l in requeue_take(d, worker_id="w0")] == \
+        ["w0-line-a", "w0-line-b"]
+    assert [l.strip() for l in requeue_take(d, worker_id="w1")] == \
+        ["w1-line"]
+    assert [l.strip() for l in requeue_take(d)] == ["solo-line"]
+    assert requeue_take(d, worker_id="w0") == []  # consumed
+
+
+# ------------------------------------------------------ CLI conflicts
+
+
+def test_fleet_cli_rejects_bad_configs():
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    assert cli_main(["fleet", "--workers", "0"]) == 2
+    assert cli_main(["fleet", "--oneshot", "a.jsonl",
+                     "--socket", "/tmp/x.sock"]) == 2
+
+
+# ------------------------------------------ bench wiring (CI, tier 1)
+
+
+def test_bench_fleet_quick_validates(tmp_path):
+    """The tier-1 leg of ``bench_fleet``: real worker subprocesses
+    behind the router — scale-out legs (core-gated asserts), rolling
+    restart with zero lost jobs and zero recompiles, kill -9
+    failover with bit-exact warm-session migration — and every leg's
+    shared JSONL green under ``pydcop telemetry-validate``."""
+    import importlib.util
+
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pydcop_bench_suite", os.path.join(repo, "benchmarks",
+                                           "suite.py"))
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+    result = suite.bench_fleet(quick=True, out_dir=str(tmp_path))
+    assert result["contracts_asserted"]
+    value = result["value"]
+    assert value["rolling_restart"]["lost_jobs"] == 0
+    assert value["rolling_restart"]["recompiles"] == 0
+    assert value["kill9"]["failovers"] >= 1
+    assert value["kill9"]["migrated_deltas_bitexact"] >= 1
+    for n, leg in value["scaling"].items():
+        assert leg["scaling_asserted"] == (
+            value["cores"] >= int(n))
+    outs = [value["rolling_restart"]["out"], value["kill9"]["out"]] \
+        + list(value["outs"].values())
+    for out in outs:
+        assert os.path.exists(out)
+        assert cli_main(["telemetry-validate", out, "--quiet"]) == 0

@@ -94,6 +94,28 @@ def test_roi_needs_engine_mode():
         DynamicEngine(chain_dcop(), mode="sharded", roi=True)
 
 
+def test_cli_rejects_roi_with_sharded_mode(capsys):
+    # the conflict gate fires before the dcop file is loaded, so the
+    # yaml need not exist; rc-2 is the CLI conflict contract
+    from pydcop_tpu.dcop_cli import main as cli_main
+    rc = cli_main(["solve", "-a", "maxsum", "-m", "sharded",
+                   "does_not_exist.yaml", "--roi"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--roi" in err
+    assert "sharded" in err
+    assert "-m engine" in err
+
+
+def test_cli_rejects_roi_auto_with_sharded_mode(capsys):
+    from pydcop_tpu.dcop_cli import main as cli_main
+    rc = cli_main(["solve", "-a", "maxsum", "-m", "sharded",
+                   "does_not_exist.yaml", "--roi", "auto"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "region-of-interest" in err
+
+
 def test_roi_needs_messages_carry():
     with pytest.raises(ValueError, match="roi=True needs "
                                          "carry='messages'"):

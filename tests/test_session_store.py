@@ -69,7 +69,8 @@ def test_stats_initialized_at_construction():
                               "dropped": 0, "evicted_bytes": 0,
                               "closed": 0, "journal_replays": 0,
                               "checkpoint_saved": 0,
-                              "checkpoint_restored": 0}
+                              "checkpoint_restored": 0,
+                              "released": 0}
     snap = sessions.snapshot()
     assert snap["size"] == 0 and snap["resident_bytes"] == 0
     assert snap["budget_bytes"] is None and snap["cap"] == 16

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_9_and_v1_readers_stay_green():
+def test_schema_minor_is_10_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 9
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 10
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4, 5, 6, 7, 8, 9):
+    for minor in (1, 2, 3, 4, 5, 6, 7, 8, 9, 10):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})
@@ -541,6 +541,34 @@ def test_schema_minor_is_9_and_v1_readers_stay_green():
     with pytest.raises(ValueError, match="tuned_rung"):
         validate_record({"record": "summary", "algo": "m",
                          "status": "OK", "tuned_rung": ""})
+    # minor-10 additive fields (serve fleet): the worker_id stamp on
+    # every attributed record kind and the fleet routing-audit action
+    # vocabulary validate; malformed ones reject
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "status": "FINISHED", "worker_id": "w1"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "event": "dispatch", "worker_id": "w0"})
+    validate_record({"record": "trace", "algo": "serve",
+                     "trace_id": "t1", "job_id": "j1",
+                     "event": "admit", "worker_id": "w0"})
+    for action in ("route", "spill", "release", "rebalance",
+                   "failover", "worker_up", "worker_down",
+                   "requeue_merge"):
+        validate_record({"record": "serve", "algo": "serve",
+                         "event": "fleet", "action": action,
+                         "worker_id": "w1"})
+    with pytest.raises(ValueError, match="worker_id"):
+        validate_record({"record": "summary", "algo": "m",
+                         "status": "OK", "worker_id": ""})
+    with pytest.raises(ValueError, match="worker_id"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "dispatch", "worker_id": 7})
+    with pytest.raises(ValueError, match="fleet serve record"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "fleet", "action": "teleport"})
+    with pytest.raises(ValueError, match="fleet serve record"):
+        validate_record({"record": "serve", "algo": "s",
+                         "event": "fleet"})
 
 
 # ----------------------------------------- reporter lifecycle (ops)
