@@ -3,7 +3,7 @@
 # 8-device mesh (tests/conftest.py).
 
 .PHONY: test test-fast bench suite lint typecheck chaos bench-roi \
-	bench-portfolio bench-autotune fleet
+	bench-portfolio bench-autotune fleet trace-demo
 
 test:
 	python -m pytest tests/ -q
@@ -57,6 +57,16 @@ bench-autotune:
 fleet:
 	python -m pytest tests/ -q -m "fleet"
 	python benchmarks/suite.py bench_fleet --quick
+
+# the observability demo (ISSUE 20): run the bench_fleet quick
+# contract with its telemetry kept under /tmp/pydcop_trace_demo —
+# including the kill -9 failover leg — then validate the kill leg's
+# directory (cross-file trace references must resolve) and render a
+# failed-over job's reassembled span tree with `pydcop trace`.  The
+# whole tracing pipeline, one target.
+trace-demo:
+	rm -rf /tmp/pydcop_trace_demo
+	python benchmarks/trace_demo.py /tmp/pydcop_trace_demo
 
 bench:
 	python bench.py

@@ -3352,6 +3352,32 @@ def bench_fleet(quick=False, out_dir=None):
                     f"oracle {want.get('cost')}/"
                     f"{want.get('cycle')}")
 
+        # ---- trace reconstruction (ISSUE 20): every failed-over
+        # job's records — router audit, both workers' JSONL, the dead
+        # worker's flight-recorder spill — must stitch back into ONE
+        # connected span tree from the telemetry directory alone
+        from pydcop_tpu.observability.tracing import (
+            assemble, load_telemetry_dir)
+
+        tele_recs, tele_spills = load_telemetry_dir(mgr.fleet_dir)
+        failover_links = [
+            r for r in tele_recs
+            if r.get("record") == "trace"
+            and r.get("event") == "link"
+            and (r.get("link") or {}).get("kind") == "failover"]
+        if not failover_links:
+            raise RuntimeError(
+                "kill leg wrote no failover link span; the re-sent "
+                "jobs' trees cannot be joined")
+        for link in failover_links:
+            roots = assemble(tele_recs, tele_spills,
+                             link["trace_id"])
+            if len(roots) != 1:
+                raise RuntimeError(
+                    f"trace {link['trace_id']} reassembled to "
+                    f"{len(roots)} roots; a failed-over job must "
+                    f"be ONE connected tree")
+
         return {
             "metric": f"serve_fleet_{n_jobs}job_"
                       f"{max(worker_counts)}w",
@@ -3367,6 +3393,7 @@ def bench_fleet(quick=False, out_dir=None):
                     "failovers": router.stats["failovers"],
                     "resent": router.stats["resent"],
                     "migrated_deltas_bitexact": len(migrated),
+                    "trace_trees_connected": len(failover_links),
                     "out": mgr.out},
                 "outs": {f"{n}w": legs[n]["out"]
                          for n in worker_counts},
@@ -3384,6 +3411,126 @@ def _reply_into(replies):
     def _r(rec):
         replies[rec.get("job_id") or rec.get("id")] = rec
     return _r
+
+
+def bench_obs_overhead(quick=False):
+    """The observability tax A/B (ISSUE 20): the SAME mixed job
+    burst through stdin ``serve`` daemons with the full ops plane ON
+    (metrics registry + heartbeat-cadence SLO evaluation + flight
+    recorder) vs OFF (``--no-metrics --no-flightrec``), both warm
+    against one shared executable cache.  The measured legs
+    INTERLEAVE (bare, obs, bare, obs, ...) and each side keeps its
+    best serving uptime — the bench_telemetry_overhead discipline:
+    one-leg-per-phase A/Bs on a shared host measure scheduling
+    drift, not instrumentation.  Contracts: the obs leg actually
+    exercised the machinery (slo records emitted, a flight-recorder
+    spill on disk), and (full run) the throughput overhead is under
+    5% — at --quick's job count the shared fixed costs dominate the
+    ratio, so quick smoke-tests the machinery only."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    n_jobs = 60 if quick else 240
+    reps = 2 if quick else 3
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    work = tempfile.mkdtemp(prefix="pydcop_obs_")
+    try:
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.generators.graphcoloring import \
+            generate_graph_coloring
+        from pydcop_tpu.observability.report import read_records
+
+        paths = []
+        for nv in (12, 14, 16):
+            dcop = generate_graph_coloring(
+                nv, 3, "scalefree", m_edge=2, soft=True, seed=nv)
+            p = os.path.join(work, f"i{nv}.yaml")
+            with open(p, "w") as f:
+                f.write(dcop_yaml(dcop))
+            paths.append(p)
+        jobs_text = "".join(json.dumps({
+            "id": f"j{i}", "dcop": paths[i % len(paths)],
+            "algo": "maxsum" if i % 2 else "dsa",
+            "max_cycles": 10, "seed": i}) + "\n"
+            for i in range(n_jobs))
+        slo_file = os.path.join(work, "slo.yaml")
+        with open(slo_file, "w") as f:
+            f.write("objectives:\n"
+                    "  - {name: p99, kind: latency_p99, target: 60}\n"
+                    "  - {name: errs, kind: error_rate, target: 0.5}\n"
+                    "  - {name: depth, kind: queue_depth, "
+                    "target: 10000}\n")
+        exec_dir = os.path.join(work, "exec")
+
+        def run_daemon(tag, run_i, extra):
+            out_dir = os.path.join(work, f"{tag}_{run_i}")
+            os.makedirs(out_dir, exist_ok=True)
+            out = os.path.join(out_dir, "out.jsonl")
+            proc = subprocess.run(
+                [sys.executable, "-m", "pydcop_tpu.dcop_cli",
+                 "serve", "--out", out, "--exec-cache", exec_dir,
+                 "--max-batch", "8", "--max-delay-ms", "5",
+                 *extra],
+                input=jobs_text, capture_output=True, text=True,
+                timeout=1800, env=env, cwd=repo)
+            if proc.returncode != 0:
+                raise RuntimeError(f"{tag} rc={proc.returncode}: "
+                                   f"{proc.stderr[-300:]}")
+            records = read_records(out)
+            final = records[-1]
+            if final.get("event") != "drained":
+                raise RuntimeError(f"{tag} did not drain: {final}")
+            done = sum(1 for r in records
+                       if r.get("record") == "summary"
+                       and r.get("status") != "REJECTED")
+            if done != n_jobs:
+                raise RuntimeError(f"{tag} completed {done}/{n_jobs}")
+            return float(final["uptime_s"]), records, out_dir
+
+        obs_extra = ["--slo", slo_file, "--heartbeat-s", "0.2"]
+        bare_extra = ["--no-metrics", "--no-flightrec"]
+        run_daemon("warmup", 0, bare_extra)  # compile into exec_dir
+        bare_times, obs_times = [], []
+        obs_records, obs_dir = None, None
+        for i in range(reps):
+            t, _, _ = run_daemon("bare", i, bare_extra)
+            bare_times.append(t)
+            t, obs_records, obs_dir = run_daemon("obs", i, obs_extra)
+            obs_times.append(t)
+        spill = [n for n in os.listdir(obs_dir)
+                 if n.startswith("flightrec-")]
+        if not spill:
+            raise RuntimeError(
+                "obs leg left no flight-recorder spill beside --out")
+        slo_recs = [r for r in obs_records
+                    if r.get("record") == "slo"]
+        if not slo_recs:
+            raise RuntimeError(
+                "obs leg emitted no slo records (heartbeat SLO "
+                "evaluation did not run)")
+        overhead = min(obs_times) / min(bare_times) - 1.0
+        if overhead >= 0.05 and not quick:
+            raise RuntimeError(
+                f"observability contract violated: {overhead:.1%} "
+                f"throughput overhead with flight recorder + SLO "
+                f"engine on (budget < 5%)")
+        return {
+            "metric": f"obs_overhead_{n_jobs}job",
+            "value": {
+                "bare_uptime_s": round(min(bare_times), 3),
+                "obs_uptime_s": round(min(obs_times), 3),
+                "overhead": round(overhead, 4),
+                "slo_records": len(slo_recs),
+            },
+            "unit": "serving uptime ratio",
+            "contracts_asserted": not quick,
+            "hardware": "cpu-host",
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
 
 
 def bench_autotune(quick=False):
@@ -3574,7 +3721,8 @@ BENCHES = [bench_solve_api_small, bench_amaxsum_1k,
            bench_telemetry_overhead, bench_decimation,
            bench_bnb_pruning, bench_serve, bench_dynamic,
            bench_roi, bench_portfolio, bench_serve_dynamic,
-           bench_chaos, bench_autotune, bench_fleet]
+           bench_chaos, bench_autotune, bench_fleet,
+           bench_obs_overhead]
 
 
 def main():

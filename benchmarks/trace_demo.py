@@ -1,0 +1,68 @@
+"""``make trace-demo``: the tracing pipeline end to end, narrated.
+
+Runs the ``bench_fleet`` quick contract (real router, real worker
+subprocesses, kill -9 failover leg included) keeping its telemetry
+under the given directory, then does what an operator debugging a
+failed-over job would do:
+
+1. ``pydcop telemetry-validate <kill-leg dir>`` — every record green
+   against schema 1.11, every trace parent reference resolving;
+2. pick a failover link span out of the kill leg's shared JSONL;
+3. ``pydcop trace <trace_id> --dir <kill-leg dir>`` — render the
+   reassembled span tree (ONE connected tree: route span, the dead
+   worker's spans, the failover link, the survivor's spans) with
+   timing attribution.
+
+Usage: ``python benchmarks/trace_demo.py [OUT_DIR]``
+"""
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # repo root: pydcop_tpu
+sys.path.insert(0, _HERE)                    # benchmarks: suite.py
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 \
+        else "/tmp/pydcop_trace_demo"
+    import suite as bench_suite  # noqa: E402 - sibling module
+
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    print(f"[trace-demo] running bench_fleet --quick into "
+          f"{out_dir} (spawns real worker daemons; takes a few "
+          f"minutes)", file=sys.stderr)
+    result = bench_suite.bench_fleet(quick=True, out_dir=out_dir)
+    kill_out = result["value"]["kill9"]["out"]
+    kill_dir = os.path.dirname(kill_out)
+    print(f"[trace-demo] kill -9 leg telemetry: {kill_dir}",
+          file=sys.stderr)
+    rc = cli_main(["telemetry-validate", kill_dir])
+    if rc:
+        return rc
+    links = []
+    with open(kill_out) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("record") == "trace" \
+                    and (rec.get("link") or {}).get("kind") \
+                    == "failover":
+                links.append(rec)
+    if not links:
+        print("[trace-demo] no failover link span in the kill leg?!",
+              file=sys.stderr)
+        return 1
+    tid = links[0]["trace_id"]
+    print(f"[trace-demo] rendering failed-over trace {tid}:",
+          file=sys.stderr)
+    return cli_main(["trace", tid, "--dir", kill_dir])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

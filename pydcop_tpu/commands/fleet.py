@@ -87,6 +87,16 @@ def set_parser(subparsers):
                              "worker-labeled fleet metrics "
                              "(pydcop_fleet_*); /stats serves the "
                              "aggregated fleet snapshot")
+    parser.add_argument("--slo", type=str, default=None,
+                        metavar="FILE",
+                        help="declarative service-level objectives "
+                             "(YAML, observability/slo.py), "
+                             "forwarded to every worker: each "
+                             "evaluates locally at its heartbeat and "
+                             "the router aggregates the rows (worst "
+                             "worker wins) in its stats snapshot — "
+                             "`pydcop serve-status` on the router "
+                             "socket renders the fleet-wide table")
     parser.add_argument("--worker-arg", dest="worker_args",
                         action="append", default=None,
                         metavar="ARG",
@@ -114,19 +124,47 @@ def run_cmd(args, timeout=None):
     if args.oneshot and args.socket:
         raise CliError("--oneshot and --socket are mutually exclusive")
 
+    slo_file = getattr(args, "slo", None)
+    if slo_file:
+        from ..observability.slo import SLOError, load_objectives
+
+        try:
+            # validate at the router so a malformed objectives file
+            # fails ONCE here, not N times in worker stderr captures
+            load_objectives(slo_file)
+        except SLOError as e:
+            raise CliError(str(e))
+        except OSError as e:
+            raise CliError(f"--slo file unusable: {e}")
+
     manager = FleetManager(
         args.fleet_dir, out=args.out,
         max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
         max_cycles=args.max_cycles, seed=args.seed,
-        worker_args=args.worker_args)
+        worker_args=args.worker_args, slo=slo_file)
 
     registry = None
     from ..observability.registry import MetricsRegistry
 
     registry = MetricsRegistry()
+    from ..observability.buildinfo import build_info_metric
+
+    build_info_metric(registry)
 
     reporter = RunReporter(manager.out, algo="serve", mode="serve",
                            worker_id=ROUTER_ID)
+    from ..observability.flightrec import (FlightRecorder,
+                                           flightrec_path)
+
+    flightrec = None
+    try:
+        flightrec = FlightRecorder(
+            flightrec_path(os.path.dirname(manager.out) or ".",
+                           ROUTER_ID),
+            worker_id=ROUTER_ID)
+    except OSError as e:
+        print(f"[fleet] flight recorder disabled: {e}",
+              file=sys.stderr)
     metrics_server = None
     stop = threading.Event()
     router = None
@@ -134,11 +172,12 @@ def run_cmd(args, timeout=None):
         reporter.header(
             fleet_workers=args.workers, fleet_dir=manager.fleet_dir,
             max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
-            max_cycles=args.max_cycles,
+            max_cycles=args.max_cycles, slo=slo_file,
             source=("oneshot" if args.oneshot
                     else "socket" if args.socket else "stdin"))
         router = FleetRouter(reporter=reporter, registry=registry,
-                             checkpoint_dir=manager.ckpt_dir)
+                             checkpoint_dir=manager.ckpt_dir,
+                             flightrec=flightrec)
         try:
             manager.start(router, args.workers,
                           connect_timeout=args.connect_timeout_s)
@@ -201,5 +240,8 @@ def run_cmd(args, timeout=None):
             metrics_server.close()
         if router is not None:
             manager.shutdown(router)
+        if flightrec is not None:
+            flightrec.dump("shutdown")
+            flightrec.close()
         reporter.close()
     return 0

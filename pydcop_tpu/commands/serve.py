@@ -283,6 +283,28 @@ def set_parser(subparsers):
                              "SHARED --checkpoint directory.  "
                              "Default: solo daemon, no stamp, legacy "
                              "requeue.jsonl")
+    parser.add_argument("--slo", type=str, default=None,
+                        metavar="FILE",
+                        help="declarative service-level objectives "
+                             "(YAML, observability/slo.py): p99 "
+                             "latency per job kind, error rate, queue "
+                             "depth.  Evaluated from the metrics "
+                             "registry at every heartbeat, emitting "
+                             "'slo' records to --out plus "
+                             "pydcop_slo_burn_rate / "
+                             "pydcop_slo_budget_remaining gauges; "
+                             "serve-status renders the table.  "
+                             "Needs the registry (not --no-metrics)")
+    parser.add_argument("--no-flightrec", dest="no_flightrec",
+                        action="store_true",
+                        help="disable the crash-surviving flight "
+                             "recorder (a bounded in-memory ring of "
+                             "recent daemon events, spilled to an "
+                             "mmap-backed file beside --out at a "
+                             "fixed cadence and dumped eagerly on "
+                             "breaker-open / watchdog timeout / "
+                             "preempt drain, so `pydcop trace` can "
+                             "see a kill -9'd worker's last moments)")
     parser.add_argument("--no-metrics", dest="no_metrics",
                         action="store_true",
                         help="disable the in-process metrics registry "
@@ -400,10 +422,45 @@ def run_cmd(args, timeout=None):
         from ..observability.registry import MetricsRegistry
 
         registry = MetricsRegistry()
+        from ..observability.buildinfo import build_info_metric
+
+        build_info_metric(registry)
+
+    slo_objectives = None
+    slo_file = getattr(args, "slo", None)
+    if slo_file:
+        if registry is None:
+            raise CliError("--slo needs the metrics registry; drop "
+                           "--no-metrics")
+        from ..observability.slo import SLOError, load_objectives
+
+        try:
+            # a malformed objectives file kills the daemon at
+            # startup naming the offending field, never mid-serve
+            slo_objectives = load_objectives(slo_file)
+        except SLOError as e:
+            raise CliError(str(e))
+        except OSError as e:
+            raise CliError(f"--slo file unusable: {e}")
 
     worker_id = getattr(args, "worker_id", None)
     reporter = RunReporter(args.out, algo="serve", mode="serve",
                            worker_id=worker_id)
+    flightrec = None
+    if not getattr(args, "no_flightrec", False):
+        from ..observability.flightrec import (FlightRecorder,
+                                               flightrec_path)
+
+        try:
+            flightrec = FlightRecorder(
+                flightrec_path(os.path.dirname(args.out) or ".",
+                               worker_id),
+                worker_id=worker_id)
+        except OSError as e:
+            # best-effort by design: a read-only telemetry dir must
+            # not take the daemon down
+            print(f"[serve] flight recorder disabled: {e}",
+                  file=sys.stderr)
     metrics_server = None
     try:
         reserve = getattr(args, "reserve_slots", None)
@@ -422,6 +479,7 @@ def run_cmd(args, timeout=None):
             session_journal=session_journal,
             checkpoint=checkpoint_dir,
             execute_deadline_s=execute_deadline_s,
+            slo=slo_file,
             source=("oneshot" if args.oneshot
                     else "socket" if args.socket else "stdin"))
         admission = AdmissionQueue(
@@ -450,7 +508,9 @@ def run_cmd(args, timeout=None):
                          heartbeat_s=heartbeat_s,
                          faults=faults,
                          checkpoints=checkpoints,
-                         worker_id=worker_id)
+                         worker_id=worker_id,
+                         slo_objectives=slo_objectives,
+                         flightrec=flightrec)
         if checkpoints is not None:
             # a previous daemon's preemption drain left requeued
             # jobs: re-admit them FIRST, ahead of the live sources —
@@ -509,5 +569,10 @@ def run_cmd(args, timeout=None):
     finally:
         if metrics_server is not None:
             metrics_server.close()
+        if flightrec is not None:
+            # final spill so a clean exit leaves the same artifact a
+            # crash would — `pydcop trace` reads it either way
+            flightrec.dump("shutdown")
+            flightrec.close()
         reporter.close()
     return 0

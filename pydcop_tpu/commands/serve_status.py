@@ -160,6 +160,13 @@ def render_status(snap: dict) -> str:
                 f"{f' [{who}]' if who else ''} "
                 f"(uptime {snap.get('uptime_s', 0):.1f}s)")
     lines = [head]
+    build = snap.get("build") or {}
+    if build:
+        lines.append(
+            f"  build       pydcop {build.get('version', '?')} | "
+            f"jax {build.get('jax', '?')} "
+            f"[{build.get('backend', '?')}] | "
+            f"schema {build.get('schema', '?')}")
     st = snap.get("stats", {})
     lines.append(
         f"  queue depth {snap.get('queue_depth', 0)} | "
@@ -191,6 +198,41 @@ def render_status(snap: dict) -> str:
                 f"completed {wst.get('completed', 0)}, "
                 f"rejected {wst.get('rejected', 0)} | "
                 f"uptime {wsnap.get('uptime_s', 0):.1f}s")
+    slo = snap.get("slo")
+    if slo:
+        # the SLO engine's last evaluation (serve/fleet --slo): one
+        # row per objective; on a router snapshot the rows are the
+        # worst-worker aggregation
+        lines.append(
+            "  slo (objective: value / target | burn | budget):")
+        for row in slo:
+            value = row.get("value")
+            burn = row.get("burn_rate")
+            budget = row.get("budget_remaining")
+            ok = row.get("ok")
+            verdict = ("n/a" if ok is None
+                       else "ok" if ok else "VIOLATED")
+            workers = row.get("workers")
+            via = (f"  [worst of {'/'.join(workers)}]"
+                   if workers else "")
+            lines.append(
+                f"    {row.get('objective', '?'):<20} "
+                f"{row.get('kind', '?'):<12} "
+                f"{'n/a' if value is None else f'{value:.6g}'} / "
+                f"{row.get('target', '?'):<8} | "
+                f"{'n/a' if burn is None else f'{burn:.2f}'} | "
+                f"{'n/a' if budget is None else f'{budget:.0%}'} "
+                f"{verdict}{via}")
+    fr = snap.get("flightrec")
+    if fr:
+        lines.append(
+            f"  flightrec   {fr.get('events', 0)} event(s) recorded"
+            f", {fr.get('ring', 0)} in ring | "
+            f"spills {fr.get('spills', 0)}, "
+            f"dumps {fr.get('dumps', 0)}"
+            + (f" (last: {fr['last_dump_reason']})"
+               if fr.get("last_dump_reason") else "")
+            + f" | {fr.get('path', '?')}")
     for name in ("runner_cache", "exec_cache", "instance_cache",
                  "sessions"):
         lines.append(_cache_line(name.replace("_cache", ""),

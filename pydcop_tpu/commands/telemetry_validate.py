@@ -1,4 +1,4 @@
-"""``pydcop telemetry-validate FILE``: schema-check a telemetry file.
+"""``pydcop telemetry-validate PATH``: schema-check telemetry.
 
 Streams every line of a v1 JSONL telemetry file through
 :func:`~pydcop_tpu.observability.report.validate_record` and exits
@@ -9,25 +9,47 @@ produce, so an emitter that drifts from the documented schema fails
 the build with a line number instead of surviving until some
 downstream reader chokes.
 
+PATH may also be a DIRECTORY (a fleet's ``--fleet-dir``, schema
+minor 11): every ``*.jsonl`` inside is validated, plus two
+cross-file fleet invariants no single-file pass can see —
+
+* a file named after one emitter (``w0.jsonl``, ``router.jsonl``)
+  must only contain that emitter's ``worker_id`` stamps (a worker
+  writing into another's file is a mis-wired ``--out``);
+* every ``parent_span_id`` and ``link.ref`` must resolve to a
+  ``span_id`` defined SOMEWHERE in the directory — a dangling parent
+  is exactly the broken-tree symptom ``pydcop trace`` would render
+  as DISCONNECTED, caught here with a line number instead.
+
 Streaming, not slurping: a serve daemon's output file can be
-gigabytes; memory use here is one line.
+gigabytes; memory use here is one line (plus the directory mode's
+span-id set).
 """
 
 import json
+import os
+import re
 import sys
 
 from . import CliError
+
+#: filenames that pin an emitter: w<K>.jsonl / router.jsonl (the
+#: fleet's per-worker capture convention); shared out files
+#: (fleet_out.jsonl, serve_out.jsonl) match nothing and may mix
+_EMITTER_STEM = re.compile(r"^(w\d+|router)$")
 
 
 def set_parser(subparsers):
     parser = subparsers.add_parser(
         "telemetry-validate",
-        help="validate a v1 JSONL telemetry file against the record "
-             "schema; non-zero exit (with file:line) on the first "
-             "invalid record")
-    parser.add_argument("file", type=str, metavar="FILE.jsonl",
+        help="validate a v1 JSONL telemetry file — or a whole "
+             "telemetry directory with cross-file trace checks — "
+             "against the record schema; non-zero exit (with "
+             "file:line) on the first invalid record")
+    parser.add_argument("file", type=str, metavar="PATH",
                         help="telemetry file to validate (solve/"
-                             "batch --telemetry, serve --out)")
+                             "batch --telemetry, serve --out), or a "
+                             "directory of them (fleet --fleet-dir)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress the per-kind summary on "
                              "success")
@@ -35,13 +57,12 @@ def set_parser(subparsers):
     return parser
 
 
-def validate_file(path: str):
-    """(record-kind counts, schema minor ceiling) for a valid file;
-    raises ``CliError`` carrying ``file:line: reason`` on the first
-    invalid line."""
+def _validate_lines(path: str, counts, on_record=None):
+    """Stream-validate one file into ``counts``; returns its schema
+    minor ceiling.  ``on_record(rec, lineno)`` feeds the directory
+    mode's cross-file collectors."""
     from ..observability.report import validate_record
 
-    counts = {}
     max_minor = 0
     try:
         f = open(path)
@@ -66,10 +87,84 @@ def validate_file(path: str):
             if kind == "header":
                 max_minor = max(max_minor,
                                 rec.get("schema_minor") or 0)
+            if on_record is not None:
+                on_record(rec, lineno)
+    return max_minor
+
+
+def validate_file(path: str):
+    """(record-kind counts, schema minor ceiling) for a valid file;
+    raises ``CliError`` carrying ``file:line: reason`` on the first
+    invalid line."""
+    counts = {}
+    max_minor = _validate_lines(path, counts)
     return counts, max_minor
 
 
+def validate_dir(directory: str):
+    """(record-kind counts, minor ceiling, file count) over every
+    ``*.jsonl`` in ``directory``, plus the two cross-file
+    invariants: emitter-named files carry only their own worker_id,
+    and every trace parent reference resolves somewhere in the
+    directory."""
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.endswith(".jsonl"))
+    except OSError as e:
+        raise CliError(str(e))
+    if not names:
+        raise CliError(f"{directory}: no *.jsonl telemetry files")
+    counts = {}
+    max_minor = 0
+    defined = set()       # every span_id seen anywhere in the dir
+    references = []       # (path, lineno, field, span_id)
+    for name in names:
+        path = os.path.join(directory, name)
+        stem = name[:-len(".jsonl")]
+        pinned = _EMITTER_STEM.match(stem)
+
+        def on_record(rec, lineno, path=path, pinned=pinned,
+                      stem=stem):
+            wid = rec.get("worker_id")
+            if pinned and wid and wid != stem:
+                raise CliError(
+                    f"{path}:{lineno}: worker_id {wid!r} in a file "
+                    f"named for emitter {stem!r} — mis-wired --out?")
+            sid = rec.get("span_id")
+            if sid:
+                defined.add(sid)
+            parent = rec.get("parent_span_id")
+            if parent:
+                references.append((path, lineno,
+                                   "parent_span_id", parent))
+            link = rec.get("link")
+            if isinstance(link, dict) and link.get("ref"):
+                references.append((path, lineno,
+                                   "link.ref", link["ref"]))
+        max_minor = max(max_minor,
+                        _validate_lines(path, counts, on_record))
+    for path, lineno, field, sid in references:
+        if sid not in defined:
+            raise CliError(
+                f"{path}:{lineno}: {field} {sid!r} does not resolve "
+                f"to any span_id in {directory} — the trace tree is "
+                f"broken (missing file, or an emitter dropped its "
+                f"span record)")
+    return counts, max_minor, len(names)
+
+
 def run_cmd(args, timeout=None):
+    if os.path.isdir(args.file):
+        counts, minor, nfiles = validate_dir(args.file)
+        if not args.quiet:
+            total = sum(counts.values())
+            kinds = ", ".join(
+                f"{k}={counts[k]}" for k in sorted(counts))
+            print(f"{args.file}: {total} records in {nfiles} "
+                  f"file(s) valid, trace references resolve "
+                  f"(schema 1.{minor}; {kinds or 'empty'})",
+                  file=sys.stderr)
+        return 0
     counts, minor = validate_file(args.file)
     if not args.quiet:
         total = sum(counts.values())

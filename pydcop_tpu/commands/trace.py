@@ -1,0 +1,97 @@
+"""``pydcop trace``: assemble one job's fleet-wide causal story.
+
+A job admitted through ``pydcop fleet`` leaves records in several
+files — the router's routing audit, each worker's trace/summary
+records (all sharing one ``trace_id``), and, after a crash, the
+flight-recorder spills of processes that never wrote their JSONL
+tail.  This command reads a telemetry DIRECTORY and stitches them
+back into one indented span tree with timing attribution::
+
+    pydcop trace ft00000001 --dir fleet_dir
+    pydcop trace j42 --dir fleet_dir          # by job id
+    pydcop trace sess-a --dir fleet_dir       # by delta target
+
+A query naming a session (delta target) may resolve to several
+traces — one per delta — and every matching tree is rendered.
+``--json`` emits the machine view: one object per trace with the
+span tree, connectivity verdict and attribution table.
+"""
+
+import json
+import sys
+
+from . import CliError
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "trace",
+        help="assemble and render one trace's span tree from a "
+             "telemetry directory (router + worker JSONL + "
+             "flight-recorder spills)")
+    parser.add_argument("query",
+                        help="a trace id (t.../ft...), a job id, or "
+                             "a session (delta target) id")
+    parser.add_argument("--dir", dest="directory", required=True,
+                        metavar="DIR",
+                        help="telemetry directory to read: every "
+                             "*.jsonl plus every flightrec-*.bin "
+                             "spill (a fleet's --fleet-dir, or any "
+                             "directory of --out files)")
+    parser.add_argument("--json", dest="as_json",
+                        action="store_true",
+                        help="emit the assembled tree(s) as JSON "
+                             "instead of the indented human view")
+    parser.set_defaults(func=run_cmd)
+    return parser
+
+
+def run_cmd(args, timeout=None):
+    from ..observability.tracing import (assemble, attribution,
+                                         find_trace_ids,
+                                         is_connected,
+                                         load_telemetry_dir,
+                                         render_tree, span_to_dict)
+
+    try:
+        records, spills = load_telemetry_dir(args.directory)
+    except ValueError as e:
+        raise CliError(str(e))
+    if not records:
+        raise CliError(f"no telemetry records under "
+                       f"{args.directory!r}")
+    trace_ids = find_trace_ids(records, args.query)
+    if not trace_ids:
+        raise CliError(
+            f"no trace matches {args.query!r} in {args.directory!r} "
+            f"(tried trace_id, job_id and session target)")
+    out = []
+    for tid in trace_ids:
+        roots = assemble(records, spills, tid)
+        if not roots:
+            continue
+        if args.as_json:
+            out.append({
+                "trace_id": tid,
+                "connected": is_connected(roots),
+                "roots": [span_to_dict(r) for r in roots],
+                "attribution": attribution(roots),
+            })
+        else:
+            out.append(render_tree(roots, trace_id=tid))
+    if not out:
+        raise CliError(f"trace {args.query!r} resolved but has no "
+                       f"spans (records predate schema 1.11?)")
+    if args.as_json:
+        print(json.dumps(out if len(out) > 1 else out[0], indent=2))
+    else:
+        print("\n\n".join(out))
+    disconnected = sum(
+        1 for o in out
+        if (isinstance(o, dict) and not o["connected"])
+        or (isinstance(o, str) and "[DISCONNECTED" in o))
+    if disconnected:
+        print(f"[trace] {disconnected} trace(s) DISCONNECTED — "
+              f"records are missing or predate the failover links",
+              file=sys.stderr)
+    return 0

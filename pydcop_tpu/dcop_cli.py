@@ -43,11 +43,13 @@ def _make_parser():
     from .commands import (agent, autotune, batch, consolidate,
                            distribute, fleet, generate, graph,
                            orchestrator, replica_dist, run, serve,
-                           serve_status, solve, telemetry_validate)
+                           serve_status, solve, telemetry_validate,
+                           trace)
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, replica_dist, batch, consolidate, serve,
-                   serve_status, telemetry_validate, autotune, fleet):
+                   serve_status, telemetry_validate, autotune, fleet,
+                   trace):
         module.set_parser(subparsers)
     return parser
 

@@ -1,0 +1,53 @@
+"""Build-identity facts for the ops plane.
+
+The classic Prometheus ``*_build_info`` idiom: a gauge whose VALUE is
+always 1 and whose LABELS carry the identity — version, jax version,
+active backend, telemetry schema minor.  Joining any scraped series
+against it answers "which build / schema is this worker running"
+without a shell on the host, and a mixed-minor fleet (mid-rollout)
+shows up as two label sets on one dashboard.
+
+The same dict rides the ``stats`` snapshot as a ``build`` block, so
+``pydcop serve-status`` can render it for operators without a
+scraper.
+"""
+
+from typing import Dict
+
+from .report import SCHEMA_MINOR, SCHEMA_VERSION
+
+
+def build_info() -> Dict[str, str]:
+    """Identity labels, every value a string (they are label values);
+    probes that can fail (jax import) degrade to ``"unknown"``."""
+    try:
+        from ..version import __version__ as version
+    except ImportError:  # pragma: no cover - version.py is in-tree
+        version = "unknown"
+    try:
+        import jax
+        jax_version = str(jax.__version__)
+        backend = str(jax.default_backend())
+    except Exception:  # noqa: BLE001 - identity must never raise
+        jax_version = backend = "unknown"
+    return {
+        "version": str(version),
+        "jax": jax_version,
+        "backend": backend,
+        "schema": f"{SCHEMA_VERSION}.{SCHEMA_MINOR}",
+    }
+
+
+def build_info_metric(registry, info: Dict[str, str] = None
+                      ) -> Dict[str, str]:
+    """Register + set ``pydcop_build_info`` on ``registry`` (no-op on
+    None); returns the info dict so callers can also stash it on the
+    stats snapshot."""
+    info = dict(info) if info is not None else build_info()
+    if registry is not None:
+        registry.gauge(
+            "pydcop_build_info",
+            "build identity: constant 1, the labels are the payload",
+            labels=tuple(sorted(info)),
+        ).set(1, **info)
+    return info

@@ -52,6 +52,7 @@ import atexit
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, Iterable, Optional
 
 SCHEMA_VERSION = 1
@@ -142,12 +143,44 @@ SCHEMA_VERSION = 1
 #: v1.0-1.9 reader stays green by the one documented forward-compat
 #: rule: consumers filter the stream by the record kinds (and fields)
 #: they speak and ignore the rest.
-SCHEMA_MINOR = 10
+#: Minor 11 (fleet tracing + SLOs, ISSUE 20) added the causal trace
+#: context and the SLO engine's output: optional ``span_id`` /
+#: ``parent_span_id`` stamps (non-empty strings) on summary/serve/
+#: trace records — the router mints a root span at admission, the
+#: worker's admit and done trace records chain under it, so ``pydcop
+#: trace`` can assemble one job's cross-process life into a single
+#: tree — the new ``link`` trace event whose ``link`` block
+#: (``kind`` in TRACE_LINK_KINDS, ``ref`` = the span_id being
+#: continued, optional ``from_worker``/``to_worker``) joins a
+#: failover re-send, a release-op migration, or a requeue resume back
+#: to the original attempt; an optional wall-clock ``t`` stamp on
+#: trace records (failover-gap attribution needs cross-process wall
+#: time, per-process monotonic spans cannot subtract across
+#: emitters); and the new ``slo`` record kind — one objective
+#: evaluation (``objective``, ``kind`` in SLO_KINDS, ``target`` > 0,
+#: measured ``value`` or null for no data yet, ``ok``/``burn_rate``/
+#: ``budget_remaining``) emitted at heartbeat cadence by daemons
+#: started with ``--slo FILE``.
+SCHEMA_MINOR = 11
 
-RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace")
+RECORD_KINDS = ("header", "cycle", "summary", "serve", "trace",
+                "slo")
 
-#: the trace-record event vocabulary (one job's pipeline life)
-TRACE_EVENTS = ("admit", "done", "reject")
+#: the trace-record event vocabulary (one job's pipeline life;
+#: ``link`` joins a re-send/migration/resume back to the span it
+#: continues — schema minor 11)
+TRACE_EVENTS = ("admit", "done", "reject", "link")
+
+#: the ``link.kind`` vocabulary of ``link`` trace events (schema
+#: minor 11) — mirrors ``observability.tracing.LINK_KINDS`` (asserted
+#: equal in the schema tests; duplicated like EDIT_KEYS so the
+#: validator stays import-light)
+TRACE_LINK_KINDS = ("failover", "migration", "resume")
+
+#: the objective vocabulary of ``slo`` records (schema minor 11) —
+#: mirrors ``observability.slo.SLO_KINDS`` (asserted equal in the
+#: schema tests)
+SLO_KINDS = ("latency_p99", "error_rate", "queue_depth")
 
 #: the per-action count keys an ``edit`` summary field may carry
 #: (``dynamics/deltas.py`` TopologyDelta.summary) — anything else is
@@ -314,12 +347,29 @@ class RunReporter:
               **fields) -> Dict[str, Any]:
         """Per-job pipeline trace record (schema minor 2), published
         on ``engine.trace``: one line per stage of one job's life
-        (``admit``/``done``/``reject``), correlated by ``trace_id``
-        across trace AND summary records."""
+        (``admit``/``done``/``reject``, plus the minor-11 ``link``
+        joining a re-send to the attempt it continues), correlated by
+        ``trace_id`` across trace AND summary records.  Minor 11 also
+        wall-stamps every trace record (``t``): failover-gap
+        attribution subtracts stamps across processes, which the
+        per-process monotonic span clocks cannot do."""
         rec = {"record": "trace", "algo": self.algo,
                "trace_id": str(trace_id), "job_id": job_id,
                "event": str(event), **fields}
+        rec.setdefault("t", round(time.time(), 6))
         self._emit(rec, "engine.trace")
+        return rec
+
+    def slo(self, objective: str, kind: str, target: float,
+            **fields) -> Dict[str, Any]:
+        """One SLO objective evaluation (schema minor 11), published
+        on ``engine.slo`` — emitted at heartbeat cadence for every
+        objective a ``--slo FILE`` daemon watches."""
+        rec = {"record": "slo", "algo": self.algo,
+               "objective": str(objective), "kind": str(kind),
+               "target": target, **fields}
+        rec.setdefault("t", round(time.time(), 6))
+        self._emit(rec, "engine.slo")
         return rec
 
 
@@ -477,11 +527,62 @@ def validate_record(rec: Dict[str, Any]):
                                or qw < 0):
             raise ValueError(
                 f"trace record with bad queue_wait_s {qw!r}")
+        _check_link(rec.get("link"), event)
+        t = rec.get("t")
+        if t is not None and (isinstance(t, bool)
+                              or not isinstance(t, (int, float))
+                              or t < 0):
+            raise ValueError(f"trace record with bad t {t!r}")
+    elif kind == "slo":
+        obj = rec.get("objective")
+        if not isinstance(obj, str) or not obj:
+            raise ValueError(
+                f"slo record with bad objective {obj!r}")
+        skind = rec.get("kind")
+        if skind not in SLO_KINDS:
+            raise ValueError(
+                f"slo record with unknown kind {skind!r}; known: "
+                f"{', '.join(SLO_KINDS)}")
+        target = rec.get("target")
+        if isinstance(target, bool) \
+                or not isinstance(target, (int, float)) \
+                or target <= 0:
+            raise ValueError(
+                f"slo record with bad target {target!r}")
+        value = rec.get("value")
+        if value is not None and (isinstance(value, bool)
+                                  or not isinstance(value,
+                                                    (int, float))
+                                  or value < 0):
+            raise ValueError(
+                f"slo record with bad value {value!r}")
+        ok = rec.get("ok")
+        if ok is not None and not isinstance(ok, bool):
+            raise ValueError(f"slo record with bad ok {ok!r}")
+        if (value is None) != (ok is None):
+            raise ValueError(
+                "slo record: 'ok' must be present exactly when "
+                "'value' is measured")
+        for field in ("burn_rate", "budget_remaining"):
+            v = rec.get(field)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or v < 0):
+                raise ValueError(
+                    f"slo record with bad {field} {v!r}")
     if kind in ("summary", "serve", "trace"):
         tid = rec.get("trace_id")
         if tid is not None and (not isinstance(tid, str) or not tid):
             raise ValueError(
                 f"{kind} record with bad trace_id {tid!r}")
+        # the minor-11 causal span stamps: optional on every
+        # trace-correlated kind, non-empty strings when present
+        for field in ("span_id", "parent_span_id"):
+            sid = rec.get(field)
+            if sid is not None and (not isinstance(sid, str)
+                                    or not sid):
+                raise ValueError(
+                    f"{kind} record with bad {field} {sid!r}")
     # the minor-10 multi-worker attribution: any attributed record in
     # a shared fleet out file may name its emitting worker
     wid = rec.get("worker_id")
@@ -757,6 +858,38 @@ def _check_retry(retry):
     if unknown:
         raise ValueError(
             f"retry with unknown field(s): {', '.join(unknown)}")
+
+
+def _check_link(link, event):
+    """The minor-11 ``link`` block — present exactly on ``link``
+    trace events: ``kind`` from TRACE_LINK_KINDS, ``ref`` = the
+    span_id this span continues, optional worker attribution."""
+    if (event == "link") != (link is not None):
+        raise ValueError(
+            "trace record: 'link' block must be present exactly "
+            "when event is 'link'")
+    if link is None:
+        return
+    if not isinstance(link, dict):
+        raise ValueError(
+            f"'link' must be a dict, got {type(link).__name__}")
+    unknown = sorted(set(link) - {"kind", "ref", "from_worker",
+                                  "to_worker"})
+    if unknown:
+        raise ValueError(
+            f"link with unknown field(s): {', '.join(unknown)}")
+    lk = link.get("kind")
+    if lk not in TRACE_LINK_KINDS:
+        raise ValueError(
+            f"link with unknown kind {lk!r}; known: "
+            f"{', '.join(TRACE_LINK_KINDS)}")
+    ref = link.get("ref")
+    if not isinstance(ref, str) or not ref:
+        raise ValueError(f"link with bad ref {ref!r}")
+    for field in ("from_worker", "to_worker"):
+        w = link.get(field)
+        if w is not None and (not isinstance(w, str) or not w):
+            raise ValueError(f"link with bad {field} {w!r}")
 
 
 def _check_spans(spans):

@@ -1,0 +1,260 @@
+"""Declarative service-level objectives, evaluated from the metrics
+the ops plane already keeps.
+
+``pydcop serve --slo FILE`` (and ``pydcop fleet --slo FILE``, which
+forwards the file to every worker) loads a YAML objective list and
+evaluates it at heartbeat cadence — no new measurement plumbing, the
+evaluator READS the existing MetricsRegistry aggregates and the serve
+loop's lifetime counters:
+
+* ``latency_p99`` — interpolated p99 of the per-job end-to-end
+  latency histogram (``pydcop_job_latency_seconds``, labeled by job
+  kind), per-``algo`` objectives supported;
+* ``error_rate`` — rejected / received over the daemon's lifetime
+  counters;
+* ``queue_depth`` — the admission queue's current depth.
+
+Each evaluation emits one ``slo`` record per objective (schema minor
+11), refreshes the ``pydcop_slo_burn_rate`` /
+``pydcop_slo_budget_remaining`` gauges, and keeps the latest rows on
+``.last`` for the stats snapshot — which is how the fleet router
+aggregates worker SLO state (worst burn wins) and how
+``serve-status`` renders the table.
+
+Burn-rate model, deliberately simple (the multiwindow refinement can
+ride the same rows later): ``burn = value / target`` — 1.0 means
+running exactly at objective, above 1.0 the error budget is burning —
+and ``budget_remaining = max(0, 1 - burn)``.  ``value: null`` rows
+mean "no data yet" (no jobs observed); they are neither ok nor
+breaching and burn nothing.
+
+YAML grammar::
+
+    objectives:
+      - name: solve-p99          # required, unique
+        kind: latency_p99        # latency_p99 | error_rate | queue_depth
+        target: 0.5              # required, > 0 (seconds / ratio / jobs)
+        algo: maxsum             # latency_p99 only, optional
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+#: mirrors ``observability.report.SLO_KINDS`` (asserted equal in the
+#: schema tests; duplicated like EDIT_KEYS so each module stays
+#: import-light)
+SLO_KINDS = ("latency_p99", "error_rate", "queue_depth")
+
+
+class SLOError(ValueError):
+    """A malformed objectives file — loud at startup, never at
+    evaluation time."""
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str
+    kind: str
+    target: float
+    algo: str = ""
+
+
+def load_objectives(path: str) -> List[Objective]:
+    """Parse + validate one ``--slo FILE``; raises :class:`SLOError`
+    naming the offending entry."""
+    import yaml
+
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+    except OSError as e:
+        raise SLOError(f"cannot read slo file {path!r}: {e}")
+    except yaml.YAMLError as e:
+        raise SLOError(f"slo file {path!r} is not valid yaml: {e}")
+    if not isinstance(doc, dict) \
+            or not isinstance(doc.get("objectives"), list) \
+            or not doc["objectives"]:
+        raise SLOError(
+            f"slo file {path!r} must be a mapping with a non-empty "
+            f"'objectives' list")
+    known = {"name", "kind", "target", "algo"}
+    out: List[Objective] = []
+    seen = set()
+    for i, entry in enumerate(doc["objectives"]):
+        if not isinstance(entry, dict):
+            raise SLOError(f"objectives[{i}] must be a mapping, got "
+                           f"{type(entry).__name__}")
+        unknown = sorted(set(entry) - known)
+        if unknown:
+            raise SLOError(f"objectives[{i}] has unknown field(s): "
+                           f"{', '.join(unknown)}")
+        name = entry.get("name")
+        if not isinstance(name, str) or not name.strip():
+            raise SLOError(f"objectives[{i}] missing 'name'")
+        name = name.strip()
+        if name in seen:
+            raise SLOError(f"duplicate objective name {name!r}")
+        seen.add(name)
+        kind = entry.get("kind")
+        if kind not in SLO_KINDS:
+            raise SLOError(
+                f"objectives[{i}] ({name}): kind {kind!r} unknown; "
+                f"one of {', '.join(SLO_KINDS)}")
+        target = entry.get("target")
+        if isinstance(target, bool) \
+                or not isinstance(target, (int, float)) \
+                or target <= 0:
+            raise SLOError(f"objectives[{i}] ({name}): 'target' "
+                           f"must be a positive number, got "
+                           f"{target!r}")
+        algo = entry.get("algo", "")
+        if algo and kind != "latency_p99":
+            raise SLOError(f"objectives[{i}] ({name}): 'algo' only "
+                           f"applies to latency_p99")
+        out.append(Objective(name=name, kind=kind,
+                             target=float(target),
+                             algo=str(algo or "")))
+    return out
+
+
+class SLOEvaluator:
+    """Evaluates the objective list against live sources.  Sources
+    are injected callables so the evaluator tests without a daemon —
+    the serve loop wires its own queue/stats and the registry's
+    latency histogram."""
+
+    def __init__(self, objectives: List[Objective],
+                 registry=None, reporter=None,
+                 stats: Optional[Callable[[], Dict[str, int]]] = None,
+                 queue_depth: Optional[Callable[[], int]] = None):
+        self.objectives = list(objectives)
+        self.registry = registry
+        self.reporter = reporter
+        self._stats = stats
+        self._queue_depth = queue_depth
+        #: latest evaluation's rows — the stats-snapshot payload
+        self.last: List[Dict[str, Any]] = []
+        self._gauges = None
+        if registry is not None:
+            self._gauges = {
+                "burn": registry.gauge(
+                    "pydcop_slo_burn_rate",
+                    "measured value / objective target (>1 = the "
+                    "error budget is burning)",
+                    labels=("objective",)),
+                "budget": registry.gauge(
+                    "pydcop_slo_budget_remaining",
+                    "max(0, 1 - burn_rate): headroom to the "
+                    "objective", labels=("objective",)),
+            }
+
+    # ------------------------------------------------------- measure
+
+    def _measure(self, o: Objective) -> Optional[float]:
+        if o.kind == "latency_p99":
+            if self.registry is None:
+                return None
+            hist = self.registry.get("pydcop_job_latency_seconds")
+            if hist is None:
+                return None
+            try:
+                if o.algo:
+                    return hist.quantile(0.99, algo=o.algo)
+                # no algo filter: worst per-kind p99 — the honest
+                # aggregate (bucket merging across label children
+                # would be tighter; worst-of is conservative)
+                qs = [hist.quantile(0.99, algo=algo)
+                      for algo in self._latency_algos(hist)]
+                qs = [q for q in qs if q is not None]
+                return max(qs) if qs else None
+            except ValueError:
+                return None
+        if o.kind == "error_rate":
+            stats = self._stats() if self._stats is not None else None
+            if not stats:
+                return None
+            received = stats.get("received", 0)
+            if not received:
+                return None
+            return stats.get("rejected", 0) / received
+        if o.kind == "queue_depth":
+            if self._queue_depth is None:
+                return None
+            return float(self._queue_depth())
+        return None
+
+    @staticmethod
+    def _latency_algos(hist) -> List[str]:
+        """The label values the latency histogram has seen (its
+        children are keyed by the single ``algo`` label value)."""
+        try:
+            with hist.registry._lock:
+                return [key[0] if isinstance(key, tuple) else key
+                        for key in hist._children]
+        except AttributeError:
+            return []
+
+    # ------------------------------------------------------ evaluate
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """One pass over every objective: rows kept on ``.last``,
+        gauges refreshed, one ``slo`` record each when a reporter is
+        attached.  Called at heartbeat cadence by the serve loop."""
+        rows: List[Dict[str, Any]] = []
+        for o in self.objectives:
+            value = self._measure(o)
+            if value is None:
+                burn = budget = ok = None
+            else:
+                burn = round(value / o.target, 6)
+                budget = round(max(0.0, 1.0 - burn), 6)
+                ok = value <= o.target
+            row = {"objective": o.name, "kind": o.kind,
+                   "target": o.target,
+                   **({"algo": o.algo} if o.algo else {}),
+                   "value": (round(value, 6)
+                             if value is not None else None),
+                   "ok": ok, "burn_rate": burn,
+                   "budget_remaining": budget}
+            rows.append(row)
+            if self._gauges is not None and burn is not None:
+                self._gauges["burn"].set(burn, objective=o.name)
+                self._gauges["budget"].set(budget, objective=o.name)
+            if self.reporter is not None:
+                self.reporter.slo(**row)
+        self.last = rows
+        return rows
+
+
+def aggregate_slo(worker_rows: Dict[str, List[Dict[str, Any]]]
+                  ) -> List[Dict[str, Any]]:
+    """Fleet-level SLO view from per-worker rows: per objective, the
+    WORST worker wins (max value/burn, min budget, ok only if every
+    reporting worker is ok) — a fleet meets an objective when all its
+    workers do.  Pure, so the router and serve-status tests drive it
+    with canned rows."""
+    by_name: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for wid in sorted(worker_rows):
+        for row in worker_rows[wid] or []:
+            name = row.get("objective")
+            if not name:
+                continue
+            agg = by_name.get(name)
+            if agg is None:
+                agg = dict(row, workers=[])
+                by_name[name] = agg
+                order.append(name)
+            agg["workers"].append(wid)
+            if row.get("value") is None:
+                continue
+            if agg.get("value") is None \
+                    or row["value"] > agg["value"]:
+                agg.update({k: row[k] for k in
+                            ("value", "burn_rate",
+                             "budget_remaining")})
+            if row.get("ok") is False:
+                agg["ok"] = False
+            elif agg.get("ok") is None:
+                agg["ok"] = row.get("ok")
+    return [by_name[name] for name in order]

@@ -1,0 +1,389 @@
+"""Fleet-wide causal tracing: context propagation and assembly.
+
+A job admitted through the fleet lives across two processes and
+several JSONL files — the router's ``fleet`` routing-audit records,
+each worker's ``trace``/``summary`` records, and (after a crash) the
+flight-recorder spills.  This module is the glue that makes that one
+story again:
+
+* :class:`TraceContext` — the (trace_id, span_id, parent_span_id)
+  triple minted at ROUTER admission and carried on the request payload
+  (the ``trace`` field of ``serving/schema.py``) to whichever worker
+  the job lands on.  Workers ADOPT an inbound context (their admit
+  span parents the router's route span) and only mint their own
+  trace ids when serving solo — a solo daemon's telemetry is
+  byte-compatible with pre-fleet readers.
+* :class:`SpanIds` — a per-emitter span-id allocator.  Span ids are
+  ``<emitter>:<seq>`` (``router:000003``, ``w1:a000007``): unique
+  within a fleet run without any cross-process coordination, and
+  self-describing enough that a human reading raw JSONL can see which
+  process minted them.
+* :func:`assemble` / :func:`load_telemetry_dir` — read every
+  ``*.jsonl`` (and ``flightrec-*.bin`` spill) in a telemetry
+  directory and stitch one trace back into a span TREE: router route
+  span -> worker admit span -> dispatch/done span, with failover and
+  migration **link spans** (trace records, ``event: link``) joining a
+  re-sent or migrated job's attempts into one connected tree.
+* :func:`render_tree` — the indented human view with timing
+  attribution (queue wait / deserialize / compile / execute / retry /
+  bisect / failover gap), what ``pydcop trace`` prints.
+
+Schema contract (minor 11, ``observability/report.py``): ``span_id``
+/ ``parent_span_id`` are OPTIONAL fields on trace/summary/serve
+records; ``link`` is a dict ``{"kind": failover|migration|resume,
+"ref": <span_id>, ...}``.  Pre-11 readers ignore both — the one
+documented forward-compat rule.
+"""
+
+import itertools
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+#: the ``link.kind`` vocabulary of link spans (trace records with
+#: ``event: link``): ``failover`` — the router re-sent a dead
+#: worker's in-flight job to a survivor; ``migration`` — a warm
+#: session was released on one worker to be recovered on another;
+#: ``resume`` — a requeued line from a previous run re-entered
+#: admission carrying its old context
+LINK_KINDS = ("failover", "migration", "resume")
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The causal triple one request line carries to its worker."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: str = ""
+
+    def to_wire(self) -> Dict[str, str]:
+        """The request-payload encoding (``serving/schema.py``
+        validates exactly this shape)."""
+        wire = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_span_id:
+            wire["parent_span_id"] = self.parent_span_id
+        return wire
+
+    @classmethod
+    def from_wire(cls, wire: Optional[Dict[str, Any]]
+                  ) -> Optional["TraceContext"]:
+        """Parse a request's ``trace`` field; None when absent or
+        unusable (admission then mints fresh — a half-broken context
+        must never take a job down)."""
+        if not isinstance(wire, dict):
+            return None
+        tid = wire.get("trace_id")
+        sid = wire.get("span_id")
+        if not (isinstance(tid, str) and tid
+                and isinstance(sid, str) and sid):
+            return None
+        return cls(trace_id=tid, span_id=sid,
+                   parent_span_id=str(
+                       wire.get("parent_span_id") or ""))
+
+
+class SpanIds:
+    """Per-emitter span-id mint: ``<prefix>:<seq:06d>``.  One
+    instance per process role (router, each daemon); uniqueness
+    across processes comes from the prefix, not coordination."""
+
+    def __init__(self, prefix: str):
+        self.prefix = str(prefix) or "span"
+        self._seq = itertools.count()
+
+    def next(self) -> str:
+        return f"{self.prefix}:{next(self._seq):06d}"
+
+
+# -------------------------------------------------------------- read
+
+def load_telemetry_dir(directory: str
+                       ) -> Tuple[List[Dict], List[Dict]]:
+    """Every record in every ``*.jsonl`` under ``directory`` (file
+    order preserved per file, files in sorted order — append order
+    approximates causal order within one emitter), plus every
+    readable ``flightrec-*.bin`` spill payload.  Unparseable lines
+    are skipped, not fatal: a post-mortem reader must work on the
+    half-written file a crash left behind."""
+    records: List[Dict] = []
+    spills: List[Dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as e:
+        raise ValueError(f"cannot read telemetry dir "
+                         f"{directory!r}: {e}")
+    for name in names:
+        path = os.path.join(directory, name)
+        if name.endswith(".jsonl"):
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(rec, dict):
+                            rec["_file"] = name
+                            records.append(rec)
+            except OSError:
+                continue
+        elif name.startswith("flightrec-") and name.endswith(".bin"):
+            from .flightrec import read_spill
+
+            spill = read_spill(path)
+            if spill is not None:
+                spill["_file"] = name
+                spills.append(spill)
+    return records, spills
+
+
+def find_trace_ids(records: List[Dict], query: str) -> List[str]:
+    """Resolve a CLI query — a trace id, a job id, or a session
+    (delta target) — to the trace id(s) it names, in first-seen
+    order."""
+    out: List[str] = []
+
+    def add(tid):
+        if tid and tid not in out:
+            out.append(tid)
+    for rec in records:
+        if rec.get("trace_id") == query:
+            add(query)
+        elif query in (rec.get("job_id"), rec.get("id"),
+                       rec.get("target")):
+            add(rec.get("trace_id"))
+    return out
+
+
+# ---------------------------------------------------------- assembly
+
+@dataclass
+class Span:
+    """One node of an assembled trace tree."""
+
+    span_id: str
+    parent_span_id: str = ""
+    name: str = ""
+    worker_id: str = ""
+    job_id: str = ""
+    t: Optional[float] = None
+    #: SpanClock-vocabulary durations off the source record
+    durations: Dict[str, float] = field(default_factory=dict)
+    link: Optional[Dict[str, Any]] = None
+    #: non-span annotations (summary status, flightrec events)
+    notes: List[str] = field(default_factory=list)
+    children: List["Span"] = field(default_factory=list)
+
+
+def _span_name(rec: Dict) -> str:
+    kind = rec.get("record")
+    if kind == "trace":
+        event = rec.get("event", "?")
+        if event == "done":
+            return f"done rung={rec.get('rung', '?')}"
+        if event == "link":
+            link = rec.get("link") or {}
+            return f"link kind={link.get('kind', '?')}"
+        return str(event)
+    if kind == "serve":
+        if rec.get("event") == "fleet":
+            extra = (f" worker={rec['worker']}"
+                     if rec.get("worker") else "")
+            return f"{rec.get('action', 'fleet')}{extra}"
+        return str(rec.get("event", "serve"))
+    if kind == "summary":
+        return f"summary status={rec.get('status', '?')}"
+    return str(kind)
+
+
+def assemble(records: List[Dict], spills: List[Dict],
+             trace_id: str) -> List[Span]:
+    """Stitch every record of ``trace_id`` into span trees.  Returns
+    the ROOTS (a fully connected trace has exactly one).  Records
+    with a ``span_id`` become nodes; records with only a
+    ``trace_id`` (summaries, un-spanned serve records) annotate the
+    job's nearest span; flight-recorder events naming the trace or
+    one of its jobs annotate their worker's last span."""
+    mine = [r for r in records if r.get("trace_id") == trace_id]
+    nodes: Dict[str, Span] = {}
+    order: List[str] = []
+    job_last: Dict[str, str] = {}    # job_id -> latest span for it
+    worker_last: Dict[str, str] = {}
+    for rec in mine:
+        sid = rec.get("span_id")
+        if not sid:
+            continue
+        span = nodes.get(sid)
+        if span is None:
+            span = Span(span_id=sid)
+            nodes[sid] = span
+            order.append(sid)
+        span.parent_span_id = (rec.get("parent_span_id")
+                               or span.parent_span_id or "")
+        span.name = _span_name(rec)
+        span.worker_id = str(rec.get("worker_id") or span.worker_id)
+        span.job_id = str(rec.get("job_id") or rec.get("id")
+                          or span.job_id)
+        if isinstance(rec.get("t"), (int, float)):
+            span.t = float(rec["t"])
+        spans = rec.get("spans")
+        if isinstance(spans, dict):
+            for k, v in spans.items():
+                if isinstance(v, (int, float)):
+                    span.durations[k] = float(v)
+        qw = rec.get("queue_wait_s")
+        if isinstance(qw, (int, float)):
+            span.durations.setdefault("queue_wait_s", float(qw))
+        if isinstance(rec.get("link"), dict):
+            span.link = dict(rec["link"])
+        if span.job_id:
+            job_last[span.job_id] = sid
+        if span.worker_id:
+            worker_last[span.worker_id] = sid
+    # annotations: records of this trace that are not spans
+    for rec in mine:
+        if rec.get("span_id"):
+            continue
+        jid = rec.get("job_id") or rec.get("id")
+        sid = job_last.get(str(jid)) if jid else None
+        if sid is None and order:
+            sid = order[-1]
+        if sid is not None:
+            nodes[sid].notes.append(_span_name(rec))
+    # flight-recorder events: post-mortem evidence from processes
+    # that never got to write their JSONL tail (the kill -9 case)
+    job_ids = {s.job_id for s in nodes.values() if s.job_id}
+    for spill in spills:
+        wid = str(spill.get("worker_id") or "?")
+        for evt in spill.get("events", []):
+            if not isinstance(evt, dict):
+                continue
+            if evt.get("trace_id") != trace_id \
+                    and evt.get("job_id") not in job_ids:
+                continue
+            sid = worker_last.get(wid)
+            if sid is None and order:
+                sid = order[0]
+            if sid is not None:
+                t = evt.get("t")
+                stamp = (f" t={t:.3f}"
+                         if isinstance(t, (int, float)) else "")
+                nodes[sid].notes.append(
+                    f"flightrec[{wid}] {evt.get('kind', '?')}"
+                    f"{stamp}")
+    roots: List[Span] = []
+    for sid in order:
+        span = nodes[sid]
+        parent = nodes.get(span.parent_span_id)
+        if parent is not None and parent is not span:
+            parent.children.append(span)
+        else:
+            roots.append(span)
+    return roots
+
+
+def is_connected(roots: List[Span]) -> bool:
+    """One tree == one root: the acceptance property of a failed-over
+    job's trace."""
+    return len(roots) == 1
+
+
+def attribution(roots: List[Span]) -> Dict[str, float]:
+    """Where the trace's time went, summed over the tree: the
+    SpanClock stage durations plus the ``failover_gap_s`` between a
+    link span and the event before it (wall-stamp delta — the time
+    the job spent dead in the water)."""
+    out: Dict[str, float] = {}
+    stamps: List[Tuple[float, Span]] = []
+
+    def walk(span: Span):
+        for k, v in span.durations.items():
+            out[k] = out.get(k, 0.0) + v
+        if span.t is not None:
+            stamps.append((span.t, span))
+        for child in span.children:
+            walk(child)
+    for root in roots:
+        walk(root)
+    stamps.sort(key=lambda p: p[0])
+    for i, (t, span) in enumerate(stamps):
+        if span.link and span.link.get("kind") == "failover" and i:
+            gap = t - stamps[i - 1][0]
+            if gap > 0:
+                out["failover_gap_s"] = \
+                    out.get("failover_gap_s", 0.0) + gap
+    return out
+
+
+def span_to_dict(span: Span) -> Dict[str, Any]:
+    """JSON-able tree node (``pydcop trace --json``)."""
+    d: Dict[str, Any] = {"span_id": span.span_id,
+                         "name": span.name}
+    if span.parent_span_id:
+        d["parent_span_id"] = span.parent_span_id
+    if span.worker_id:
+        d["worker_id"] = span.worker_id
+    if span.job_id:
+        d["job_id"] = span.job_id
+    if span.t is not None:
+        d["t"] = span.t
+    if span.durations:
+        d["durations"] = dict(span.durations)
+    if span.link:
+        d["link"] = dict(span.link)
+    if span.notes:
+        d["notes"] = list(span.notes)
+    if span.children:
+        d["children"] = [span_to_dict(c) for c in span.children]
+    return d
+
+
+def render_tree(roots: List[Span],
+                trace_id: str = "") -> str:
+    """The indented human view: one line per span, worker-attributed,
+    durations inline, annotations nested — closed by the timing
+    attribution table."""
+    lines: List[str] = []
+    if trace_id:
+        lines.append(f"trace {trace_id}"
+                     + ("" if is_connected(roots)
+                        else f"  [DISCONNECTED: {len(roots)} roots]"))
+    t0 = min((s.t for s in _iter_spans(roots)
+              if s.t is not None), default=None)
+
+    def fmt(span: Span, depth: int):
+        pad = "  " * (depth + 1)
+        who = f"[{span.worker_id or '?'}]"
+        rel = (f" +{span.t - t0:.3f}s"
+               if span.t is not None and t0 is not None else "")
+        dur = "".join(
+            f" {k.removesuffix('_s')}={v * 1e3:.1f}ms"
+            for k, v in sorted(span.durations.items()))
+        job = f" job={span.job_id}" if span.job_id else ""
+        lines.append(f"{pad}{who} {span.name}{job}{rel}{dur}")
+        for note in span.notes:
+            lines.append(f"{pad}  · {note}")
+        for child in span.children:
+            fmt(child, depth + 1)
+    for root in roots:
+        fmt(root, 0)
+    attr = attribution(roots)
+    if attr:
+        lines.append("  attribution:")
+        for k in sorted(attr):
+            lines.append(f"    {k.removesuffix('_s'):>18}: "
+                         f"{attr[k] * 1e3:.1f} ms")
+    return "\n".join(lines)
+
+
+def _iter_spans(roots: List[Span]):
+    stack = list(roots)
+    while stack:
+        span = stack.pop()
+        yield span
+        stack.extend(span.children)

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..observability.tracing import SpanIds, TraceContext
 from .dispatcher import Dispatcher
 from .faults import CircuitBreaker, FaultInjected
 from .queue import AdmissionQueue, DispatchGroup, prepare_job
@@ -134,7 +135,9 @@ class ServeLoop:
                  breaker_cooldown_s: float = 5.0,
                  sleep: Callable[[float], None] = time.sleep,
                  checkpoints=None,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 slo_objectives=None,
+                 flightrec=None):
         self.admission = admission
         self.dispatcher = dispatcher
         self.reporter = reporter
@@ -214,6 +217,23 @@ class ServeLoop:
         #: per-job trace ids, unique within this daemon's lifetime
         #: (and therefore within its output file)
         self._trace_seq = itertools.count()
+        #: per-daemon span-id mint (schema minor 11): the admit span
+        #: of every job — which chains under an inbound router span
+        #: when the request carried a trace context
+        self._spans = SpanIds(self.worker_id or "s")
+        #: crash-surviving flight recorder
+        #: (observability/flightrec.FlightRecorder; None = off)
+        self.flightrec = flightrec
+        #: the SLO engine (``--slo FILE``): objectives evaluated at
+        #: heartbeat cadence against this loop's own sources
+        self.slo = None
+        if slo_objectives:
+            from ..observability.slo import SLOEvaluator
+
+            self.slo = SLOEvaluator(
+                slo_objectives, registry=registry,
+                reporter=reporter, stats=lambda: self.stats,
+                queue_depth=self.admission.depth)
         self._t_start = self.clock()
         self._metrics = None
         if registry is not None:
@@ -381,6 +401,17 @@ class ServeLoop:
         if self._metrics is not None and name in self._metrics:
             self._metrics[name].inc(amount, **labels)
 
+    def _flight(self, kind: str, **fields):
+        """Append one event to the flight recorder's ring (no-op
+        without a recorder; record() itself never raises)."""
+        if self.flightrec is not None:
+            self.flightrec.record(kind, **fields)
+
+    def _flight_dump(self, reason: str):
+        """Eager spill at a moment an operator will want the tail."""
+        if self.flightrec is not None:
+            self.flightrec.dump(reason)
+
     def memory_snapshot(self) -> Dict[str, Any]:
         """The daemon's memory accounting (``observability/memory``):
         host RSS, the device live-buffer census, and per-store
@@ -476,6 +507,18 @@ class ServeLoop:
         }
         if metrics is not None:
             snap["metrics"] = metrics
+        from ..observability.buildinfo import build_info
+
+        # build identity (schema minor 11): serve-status renders it,
+        # and a mixed-version fleet is visible per worker
+        snap["build"] = build_info()
+        if self.slo is not None:
+            # heartbeat-fresh rows when beating; evaluated on demand
+            # for a heartbeat-less daemon so a stats read still
+            # answers "are we inside objective"
+            snap["slo"] = list(self.slo.last or self.slo.evaluate())
+        if self.flightrec is not None:
+            snap["flightrec"] = self.flightrec.snapshot()
         return snap
 
     def _handle_stats(self, request: Dict, reply=None):
@@ -564,6 +607,11 @@ class ServeLoop:
                    if tuned is not None else {}),
                 **({"dropped_rows": dropped}
                    if dropped is not None else {}))
+        if self.slo is not None:
+            # SLO objectives ride the heartbeat cadence: one pass
+            # refreshes the burn/budget gauges, emits the `slo`
+            # records and caches the rows for stats/serve-status
+            self.slo.evaluate()
         self._hb_last_t = now
         self._hb_last_stats = dict(self.stats)
         # rearming from NOW (not from the missed slot) skips missed
@@ -592,7 +640,8 @@ class ServeLoop:
 
     def _emit_rejection(self, job_id, reason, reply=None, algo=None,
                         reason_class: str = "prepare",
-                        trace_id: str = ""):
+                        trace_id: str = "", span_id: str = "",
+                        parent_span_id: str = ""):
         rec = rejection(job_id, reason)
         # machine-readable rejection class (schema minor 4): clients
         # and chaos benches branch on `poisoned`/`circuit_open`/...
@@ -603,11 +652,18 @@ class ServeLoop:
         if trace_id:
             rec["trace_id"] = trace_id
         self._count("rejected", reason=reason_class)
+        self._flight("reject", job_id=job_id or "?",
+                     reason=reason_class,
+                     **({"trace_id": trace_id} if trace_id else {}))
         if self.reporter is not None:
             self.reporter.summary(**rec)
             if trace_id:
-                self.reporter.trace(trace_id, job_id or "?",
-                                    "reject", reason=reason_class)
+                self.reporter.trace(
+                    trace_id, job_id or "?", "reject",
+                    reason=reason_class,
+                    **({"span_id": span_id} if span_id else {}),
+                    **({"parent_span_id": parent_span_id}
+                       if parent_span_id else {}))
         if reply is not None:
             reply(dict(rec, record="summary", mode="serve",
                        **({"worker_id": self.worker_id}
@@ -633,11 +689,22 @@ class ServeLoop:
             # drain one warm session to the shared dirs, immediately
             self._handle_release(request, reply)
             return
-        trace_id = f"t{next(self._trace_seq):08d}"
+        ctx = TraceContext.from_wire(request.get("trace"))
+        if ctx is not None:
+            # fleet path: ADOPT the inbound context — this worker's
+            # admit span chains under the router span that sent the
+            # job here, so `pydcop trace` assembles one cross-process
+            # tree.  Solo daemons mint their own ids as before
+            trace_id, parent = ctx.trace_id, ctx.span_id
+        else:
+            trace_id, parent = f"t{next(self._trace_seq):08d}", ""
+        admit_span = self._spans.next()
         if request.get("op") == "delta":
             # deltas bypass the batching queue: a warm session is
             # singular state, dispatch happens at admission
-            self._dispatch_delta(request, reply, trace_id=trace_id)
+            self._dispatch_delta(request, reply, trace_id=trace_id,
+                                 span_id=admit_span,
+                                 parent_span_id=parent)
             return
         try:
             job = prepare_job(
@@ -645,7 +712,7 @@ class ServeLoop:
                 default_seed=self.default_seed,
                 default_precision=self.default_precision,
                 reserve=self.reserve, reply=reply,
-                trace_id=trace_id)
+                trace_id=trace_id, trace_parent=admit_span)
         except Exception as e:
             # the FULL breadth of "bad job" lands here, not just the
             # anticipated ValueErrors: a file that exists but holds
@@ -656,7 +723,9 @@ class ServeLoop:
                                  f"{type(e).__name__}: {e}", reply,
                                  algo=request.get("algo"),
                                  reason_class="prepare",
-                                 trace_id=trace_id)
+                                 trace_id=trace_id,
+                                 span_id=admit_span,
+                                 parent_span_id=parent)
             return
         if self.faults is not None \
                 and self.faults.job_fires("nan_planes", job.job_id):
@@ -678,7 +747,8 @@ class ServeLoop:
                 self._emit_rejection(
                     job.job_id, f"{type(e).__name__}: {e}", reply,
                     algo=request.get("algo"),
-                    reason_class="nan_planes", trace_id=trace_id)
+                    reason_class="nan_planes", trace_id=trace_id,
+                    span_id=admit_span, parent_span_id=parent)
                 return
         self.admission.admit(job)
         if request.get("algo") == "maxsum":
@@ -688,16 +758,21 @@ class ServeLoop:
                     next(iter(self._admitted_requests)))
             self._admitted_requests[request["id"]] = request
         self._count("admitted")
+        self._flight("admit", job_id=job.job_id, trace_id=trace_id,
+                     algo=request["algo"])
         if self.reporter is not None:
             # the trace's opening record: one line pins the job's
             # trace_id to its id, algo and the depth it queued behind
             self.reporter.trace(
                 trace_id, job.job_id, "admit",
                 algo=request["algo"],
-                queue_depth=self.admission.depth())
+                queue_depth=self.admission.depth(),
+                span_id=admit_span,
+                **({"parent_span_id": parent} if parent else {}))
 
     def _dispatch_delta(self, request, reply=None,
-                        trace_id: str = ""):
+                        trace_id: str = "", span_id: str = "",
+                        parent_span_id: str = ""):
         """One delta job end-to-end: resolve the target session,
         apply + warm re-solve.  Every failure — unknown target, an
         event exceeding the reserved slots (``DeltaError``), a bad
@@ -720,13 +795,19 @@ class ServeLoop:
                 f"delta target {target!r} is not an admitted "
                 f"maxsum solve job of this daemon", reply,
                 algo="maxsum", reason_class="delta",
-                trace_id=trace_id)
+                trace_id=trace_id, span_id=span_id,
+                parent_span_id=parent_span_id)
             return
+        self._flight("admit", job_id=request["id"],
+                     trace_id=trace_id, algo="maxsum", target=target)
         if self.reporter is not None and trace_id:
             self.reporter.trace(
                 trace_id, request["id"], "admit", algo="maxsum",
                 target=target,
-                queue_depth=self.admission.depth())
+                queue_depth=self.admission.depth(),
+                **({"span_id": span_id} if span_id else {}),
+                **({"parent_span_id": parent_span_id}
+                   if parent_span_id else {}))
         try:
             self.dispatcher.dispatch_delta(
                 request, target_request,
@@ -734,7 +815,7 @@ class ServeLoop:
                 default_seed=self.default_seed,
                 default_precision=self.default_precision,
                 reply=reply, queue_depth=self.admission.depth(),
-                trace_id=trace_id)
+                trace_id=trace_id, trace_parent=span_id)
         except FaultInjected as e:
             # a poisoned delta job: there is no batch to bisect — it
             # is already isolated — so it rejects directly with the
@@ -743,7 +824,9 @@ class ServeLoop:
             self._emit_rejection(
                 request["id"], f"dispatch failed (poisoned): {e}",
                 reply, algo="maxsum", reason_class="poisoned",
-                trace_id=trace_id)
+                trace_id=trace_id,
+                span_id=f"{span_id}:done" if span_id else "",
+                parent_span_id=span_id)
             if self.reporter is not None:
                 self.reporter.serve(
                     event="fault", action="poisoned",
@@ -758,7 +841,9 @@ class ServeLoop:
             self._emit_rejection(
                 request["id"], f"{type(e).__name__}: {e}", reply,
                 algo="maxsum", reason_class="delta",
-                trace_id=trace_id)
+                trace_id=trace_id,
+                span_id=f"{span_id}:done" if span_id else "",
+                parent_span_id=span_id)
             return
         self._count("admitted")
         self._count("completed")
@@ -829,7 +914,10 @@ class ServeLoop:
                     f"dispatch failures; job shed while the rung "
                     f"cools down", job.reply, algo=group.key[0],
                     reason_class="circuit_open",
-                    trace_id=job.trace_id)
+                    trace_id=job.trace_id,
+                    span_id=(f"{job.trace_parent}:done"
+                             if job.trace_parent else ""),
+                    parent_span_id=job.trace_parent)
             self._serve_fault("circuit_open", label,
                               shed=len(group.jobs))
             return 0
@@ -848,12 +936,31 @@ class ServeLoop:
                            "backoff_s": round(backoff, 6)},
                     error=str(err), **self._fault_field(err))
                 self._sleep(backoff)
+            # one ring event PER JOB: a spill left behind by a killed
+            # worker must name the in-flight jobs so `pydcop trace`
+            # can attach the dead worker's side of the story
+            for job in group.jobs:
+                self._flight("dispatch", rung=label,
+                             job_id=job.job_id,
+                             trace_id=job.trace_id,
+                             batch=len(group.jobs), attempt=attempt)
             try:
                 records = self.dispatcher.dispatch(
                     group, queue_depth=self.admission.depth())
             except Exception as e:  # noqa: BLE001 - the whole point
                 err = e
+                from .faults import DispatchTimeout
+
+                self._flight("dispatch_error", rung=label,
+                             error=f"{type(e).__name__}: {e}")
+                if isinstance(e, DispatchTimeout):
+                    # the watchdog expired on a hung execution: the
+                    # tail leading up to it is exactly what a
+                    # post-mortem wants
+                    self._flight_dump("watchdog_timeout")
                 continue
+            self._flight("dispatch_done", rung=label,
+                         batch=len(group.jobs))
             self._breaker.record_success(label)
             if probing:
                 self._serve_fault("breaker_close", label)
@@ -861,6 +968,7 @@ class ServeLoop:
             return len(records)
         # retry exhausted: the failure is deterministic for this
         # load — isolate the poisoned job(s) by bisection
+        self._flight_dump("dispatch_error")
         done = self._bisect(group, err, label)
         if done:
             # healthy jobs completed: the RUNG works, only inputs
@@ -872,6 +980,7 @@ class ServeLoop:
                     "breaker_open", label,
                     cooldown_s=self._breaker.cooldown_s,
                     **self._fault_field(err))
+                self._flight_dump("breaker_open")
         self._breaker_gauge(label)
         return done
 
@@ -891,7 +1000,10 @@ class ServeLoop:
                 f"dispatch failed after retry; job isolated by "
                 f"bisection (poisoned): {err}", job.reply,
                 algo=group.key[0], reason_class="poisoned",
-                trace_id=job.trace_id)
+                trace_id=job.trace_id,
+                span_id=(f"{job.trace_parent}:done"
+                         if job.trace_parent else ""),
+                parent_span_id=job.trace_parent)
             self._serve_fault("poisoned", label, job_id=job.job_id,
                               error=str(err),
                               **self._fault_field(err))
@@ -963,6 +1075,7 @@ class ServeLoop:
                         "preempt", "serve",
                         probe=self._preempt_probe - 1,
                         checkpointed=self.checkpoints is not None)
+                    self._flight_dump("preempt_drain")
                     self.request_stop()
                     break
             self._dispatch(self.admission.due())
@@ -992,7 +1105,10 @@ class ServeLoop:
                         job.job_id, "serve daemon shutting down "
                         "(queued, not yet dispatched)", job.reply,
                         algo=group.key[0], reason_class="shutdown",
-                        trace_id=job.trace_id)
+                        trace_id=job.trace_id,
+                        span_id=(f"{job.trace_parent}:done"
+                                 if job.trace_parent else ""),
+                        parent_span_id=job.trace_parent)
             grace_until = self.clock() + _STOP_DRAIN_GRACE
             while True:
                 try:
@@ -1043,6 +1159,10 @@ class ServeLoop:
                         requeued=len(requeue),
                         requeue_total=total,
                         queue_depth=self.admission.depth())
+                self._flight("preempt_drain",
+                             requeued=len(requeue),
+                             requeue_total=total)
+                self._flight_dump("preempt_drain")
         # shutdown hygiene (ISSUE 13 satellite): every open warm
         # engine closes on SIGTERM AND clean drain — device buffers
         # released, journals truncated — BEFORE the final record, so

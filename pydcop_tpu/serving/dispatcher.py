@@ -47,6 +47,17 @@ STAGE_SPANS = {
 }
 
 
+def _span_stamp(trace_parent: str) -> Dict[str, str]:
+    """The schema-1.11 causal stamp of a job's terminal trace record:
+    the done/reject span chains under the admit span it closes.  The
+    span id derives deterministically from the parent (one terminal
+    record per admit span), so the dispatcher needs no allocator."""
+    if not trace_parent:
+        return {}
+    return {"span_id": f"{trace_parent}:done",
+            "parent_span_id": trace_parent}
+
+
 def _stage_metrics(registry):
     """The dispatcher's registry handles (idempotent: registration
     returns the existing metric on re-entry)."""
@@ -62,6 +73,13 @@ def _stage_metrics(registry):
             "per-rung pipeline stage latency (queue_wait/batch_form/"
             "deserialize/compile/execute)",
             labels=("rung", "stage")),
+        # the SLO engine's latency source: full admission->completion
+        # per job, labeled by job kind — latency_p99 objectives read
+        # its interpolated quantiles straight off the registry
+        "latency": registry.histogram(
+            "pydcop_job_latency_seconds",
+            "end-to-end per-job latency, admission to reply",
+            labels=("algo",)),
         "tuning_hits": registry.counter(
             "pydcop_tuning_hits_total",
             "dispatches that adopted an autotuned per-rung config",
@@ -677,6 +695,15 @@ class Dispatcher:
             if total or any(k in spans for k in span_names):
                 m["stage"].observe(total, rung=rung, stage=stage)
 
+    def _observe_latency(self, algo: str, latencies: List[float]):
+        """Per-job end-to-end latency (admission -> reply), by job
+        kind — the series latency_p99 SLO objectives are evaluated
+        against."""
+        if self._metrics is None:
+            return
+        for s in latencies:
+            self._metrics["latency"].observe(s, algo=algo)
+
     def dispatch(self, group: DispatchGroup,
                  queue_depth: int = 0) -> List[Dict[str, Any]]:
         """Run one group; emit and return its per-job summary
@@ -807,6 +834,9 @@ class Dispatcher:
         spans = dict(self.last_spans)
         label = f"{algo}/{rung_label(rung_sig)}"
         self._observe_dispatch(label, group.reason, B, waits, spans)
+        # waits were measured AFTER execution, so each one is the
+        # job's full admission->completion latency
+        self._observe_latency(algo, waits)
         if tuning_sources is not None and self._metrics is not None:
             # hit = at least one knob actually came from the sidecar
             # (an all-default resolution is a miss for this rung)
@@ -826,7 +856,8 @@ class Dispatcher:
                 self.reporter.trace(
                     job.trace_id, job.job_id, "done", rung=label,
                     reason=group.reason, batch=B,
-                    queue_wait_s=round(waits[i], 6), spans=spans)
+                    queue_wait_s=round(waits[i], 6), spans=spans,
+                    **_span_stamp(job.trace_parent))
             self.reporter.serve(
                 event="dispatch", reason=group.reason,
                 rung=list(rung_sig), batch=B, padded_batch=padded_B,
@@ -944,6 +975,7 @@ class Dispatcher:
         label = f"{algo}/portfolio/{rung_label(rung_sig)}"
         self._observe_dispatch(label, group.reason, len(group.jobs),
                                waits, dict(self.last_spans))
+        self._observe_latency(algo, waits)
         if self.reporter is not None:
             for i, job in enumerate(group.jobs):
                 if not job.trace_id:
@@ -952,7 +984,8 @@ class Dispatcher:
                     job.trace_id, job.job_id, "done", rung=label,
                     reason=group.reason, batch=len(group.jobs),
                     queue_wait_s=round(waits[i], 6),
-                    spans=dict(self.last_spans))
+                    spans=dict(self.last_spans),
+                    **_span_stamp(job.trace_parent))
             self.reporter.serve(
                 event="dispatch", reason=group.reason,
                 rung=list(rung_sig), batch=len(group.jobs),
@@ -974,7 +1007,8 @@ class Dispatcher:
                        default_precision=None,
                        reply=None,
                        queue_depth: int = 0,
-                       trace_id: str = "") -> Dict[str, Any]:
+                       trace_id: str = "",
+                       trace_parent: str = "") -> Dict[str, Any]:
         """One ``delta`` job: apply the actions to the target's warm
         session and re-solve.  Deltas bypass the batching queue — a
         session is singular state, there is nothing to batch — and
@@ -1127,12 +1161,16 @@ class Dispatcher:
         # a delta-heavy daemon's wait p99 reflects reality
         self._observe_dispatch(label, "delta", 1, [0.0],
                                dict(engine.last_spans))
+        # a delta's admission->reply latency IS its dispatch wall
+        # time (it never queued)
+        self._observe_latency("delta", [elapsed])
         if self.reporter is not None:
             if trace_id:
                 self.reporter.trace(
                     trace_id, request["id"], "done", rung=label,
                     reason="delta", batch=1,
-                    spans=dict(engine.last_spans))
+                    spans=dict(engine.last_spans),
+                    **_span_stamp(trace_parent))
             self.reporter.serve(
                 event="dispatch", reason="delta",
                 rung=list(engine.rung.signature), batch=1,

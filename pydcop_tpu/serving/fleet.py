@@ -69,6 +69,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional
 
 from .schema import rejection
+from ..observability.tracing import SpanIds, TraceContext
 
 #: the router's own worker_id stamp on records it emits itself
 ROUTER_ID = "router"
@@ -267,9 +268,11 @@ class FleetRouter:
     def __init__(self, reporter=None, registry=None,
                  checkpoint_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 stats_timeout_s: float = 10.0):
+                 stats_timeout_s: float = 10.0,
+                 flightrec=None):
         self.reporter = reporter
         self.registry = registry
+        self.flightrec = flightrec
         #: the SHARED checkpoint directory (workers' --checkpoint):
         #: where a drained worker's requeue-<id>.jsonl lands, merged
         #: here on worker_down
@@ -295,6 +298,16 @@ class FleetRouter:
         self._sticky: Dict[str, str] = {}
         self._stats_waiters: Dict[str, Any] = {}
         self._seq = 0
+        #: trace ids minted at admission (``ft``-prefixed so a fleet
+        #: trace never collides with a solo daemon's ``t`` ids) and
+        #: the router's own span allocator — the ROOT span of every
+        #: job's tree lives here, on the admission edge
+        self._trace_seq = 0
+        self._spans = SpanIds(ROUTER_ID)
+        #: target -> (trace_id, span_id) of the LAST route through
+        #: that target's session: the migration link's parent, so a
+        #: rebalanced session chains onto the traffic that warmed it
+        self._session_span: Dict[str, Any] = {}
         self._t_start = self.clock()
         self.stats: Dict[str, int] = {
             "received": 0, "routed": 0, "spilled": 0, "replies": 0,
@@ -333,6 +346,41 @@ class FleetRouter:
         if self.reporter is not None:
             self.reporter.serve(event="fleet", action=action,
                                 **fields)
+
+    def _flight(self, kind: str, **fields):
+        if self.flightrec is not None:
+            self.flightrec.record(kind, **fields)
+
+    def _flight_dump(self, reason: str):
+        if self.flightrec is not None:
+            self.flightrec.dump(reason)
+
+    # ---------------------------------------------------------- tracing
+
+    def _admit_trace(self, rec: Dict, job_id: str):
+        """Mint (or adopt) the job's trace context at the admission
+        edge and stamp it onto the wire record: the router's span is
+        the ROOT of the job's tree, and the worker's admit span will
+        parent under it.  A line that already carries a context (a
+        requeued line from a previous run, or an upstream router) is
+        ADOPTED — same trace_id, new root span, joined to the old
+        attempt by a ``resume`` link — so one logical job stays one
+        tree across fleet restarts."""
+        prior = TraceContext.from_wire(rec.get("trace"))
+        if prior is not None:
+            trace_id = prior.trace_id
+        else:
+            self._trace_seq += 1
+            trace_id = f"ft{self._trace_seq:08d}"
+        span = self._spans.next()
+        if prior is not None and prior.span_id \
+                and self.reporter is not None:
+            self.reporter.trace(
+                trace_id, job_id, "link", worker_id=ROUTER_ID,
+                span_id=span, parent_span_id=prior.span_id,
+                link={"kind": "resume", "ref": prior.span_id})
+        rec["trace"] = TraceContext(trace_id, span).to_wire()
+        return trace_id, span, json.dumps(rec)
 
     # ------------------------------------------------------- membership
 
@@ -416,11 +464,18 @@ class FleetRouter:
             if op == "delta":
                 with self._lock:
                     self._session_owner[target] = wid
+            trace_id, span, line = self._admit_trace(rec, job_id)
+            with self._lock:
+                self._session_span[target] = (trace_id, span)
             self._fleet_record("route", worker=wid, job_id=job_id,
-                               target=target, op=op)
+                               target=target, op=op,
+                               trace_id=trace_id, span_id=span)
+            self._flight("route", job_id=job_id, worker=wid,
+                         trace_id=trace_id, op=op)
             self._count_routed(wid, "route")
             self._dispatch(wid, job_id, line, reply, kind="route",
-                           key=("delta", target), target=target)
+                           key=("delta", target), target=target,
+                           trace_id=trace_id, span=span)
             return
         # a cold solve.  The delta-capable family routes by ring on
         # its own id — the job IS a potential delta target, and its
@@ -440,11 +495,18 @@ class FleetRouter:
             self._reject(job_id, "no live workers", reply)
             return
         self.stats["routed" if kind == "route" else "spilled"] += 1
+        trace_id, span, line = self._admit_trace(rec, job_id)
+        if kind == "route":
+            with self._lock:
+                self._session_span[job_id] = (trace_id, span)
         self._fleet_record(kind, worker=wid, job_id=job_id,
-                           algo=rec.get("algo"))
+                           algo=rec.get("algo"),
+                           trace_id=trace_id, span_id=span)
+        self._flight(kind, job_id=job_id, worker=wid,
+                     trace_id=trace_id)
         self._count_routed(wid, kind)
         self._dispatch(wid, job_id, line, reply, kind=kind, key=key,
-                       target=None)
+                       target=None, trace_id=trace_id, span=span)
 
     def _count_routed(self, wid, kind):
         if self._metrics is not None:
@@ -475,7 +537,8 @@ class FleetRouter:
 
     def _dispatch(self, wid: str, job_id: str, line: str, reply,
                   kind: str, key, target: Optional[str],
-                  resend: bool = False):
+                  resend: bool = False, trace_id: str = "",
+                  span: str = ""):
         with self._lock:
             client = self.workers.get(wid)
             dead = client is None or not client.alive
@@ -490,12 +553,14 @@ class FleetRouter:
                 self._reject(job_id, "no live workers", reply)
                 return
             self._dispatch(alt, job_id, line, reply, kind, key,
-                           target, resend=resend)
+                           target, resend=resend, trace_id=trace_id,
+                           span=span)
             return
         with self._lock:
             self._pending[job_id] = {
                 "line": line, "reply": reply, "worker": wid,
-                "kind": kind, "key": key, "target": target}
+                "kind": kind, "key": key, "target": target,
+                "trace_id": trace_id, "span": span}
             self._outstanding[wid] = self._outstanding.get(wid, 0) + 1
             self._key_depth[(wid, key)] = \
                 self._key_depth.get((wid, key), 0) + 1
@@ -607,6 +672,9 @@ class FleetRouter:
         if moved:
             self._fleet_record("failover", worker=wid,
                                resent=len(moved), cause=cause)
+            self._flight("failover", worker=wid, cause=cause,
+                         resent=len(moved))
+            self._flight_dump("failover")
         for jid, entry in moved:
             self.stats["resent"] += 1
             if self._metrics is not None:
@@ -626,9 +694,35 @@ class FleetRouter:
             if target is not None:
                 with self._lock:
                     self._session_owner[target] = nxt
-            self._dispatch(nxt, jid, entry["line"], entry["reply"],
+            # the re-send is a NEW span in the SAME trace, joined to
+            # the dead attempt by a failover link — the one edge that
+            # keeps a killed-mid-flight job's tree connected.  The
+            # wire context is re-stamped so the survivor's admit span
+            # parents under the re-send, not the corpse
+            line, trace_id, span = entry["line"], \
+                entry.get("trace_id", ""), entry.get("span", "")
+            if trace_id and span:
+                fspan = self._spans.next()
+                if self.reporter is not None:
+                    self.reporter.trace(
+                        trace_id, jid, "link", worker_id=ROUTER_ID,
+                        span_id=fspan, parent_span_id=span,
+                        link={"kind": "failover", "ref": span,
+                              "from_worker": wid, "to_worker": nxt})
+                try:
+                    rec = json.loads(line)
+                    rec["trace"] = TraceContext(trace_id,
+                                                fspan).to_wire()
+                    line = json.dumps(rec)
+                except ValueError:
+                    fspan = span
+                span = fspan
+                if target is not None:
+                    with self._lock:
+                        self._session_span[target] = (trace_id, span)
+            self._dispatch(nxt, jid, line, entry["reply"],
                            entry["kind"], entry["key"], target,
-                           resend=True)
+                           resend=True, trace_id=trace_id, span=span)
         for line in merged:
             try:
                 jid = json.loads(line).get("id")
@@ -689,12 +783,33 @@ class FleetRouter:
             ack.update(rec)
             done.set()
 
-        line = json.dumps({"op": "release", "id": rid,
-                           "target": target})
+        # a migration continues the session's trace: the release op
+        # rides a NEW span in the trace that last touched the target,
+        # joined by a ``migration`` link — ``pydcop trace`` then shows
+        # the warm session's hop as part of the same tree
+        with self._lock:
+            last = self._session_span.get(target)
+        trace_id = span = ""
+        release = {"op": "release", "id": rid, "target": target}
+        if last is not None:
+            trace_id, parent = last
+            span = self._spans.next()
+            if self.reporter is not None:
+                self.reporter.trace(
+                    trace_id, rid, "link", worker_id=ROUTER_ID,
+                    span_id=span, parent_span_id=parent,
+                    link={"kind": "migration", "ref": parent,
+                          **({"from_worker": owner} if owner else {}),
+                          "to_worker": to_wid})
+            release["trace"] = TraceContext(trace_id, span).to_wire()
+            with self._lock:
+                self._session_span[target] = (trace_id, span)
+        line = json.dumps(release)
         if owner is not None and owner in self.workers \
                 and self.workers[owner].alive:
             self._dispatch(owner, rid, line, on_ack, kind="route",
-                           key=("release", target), target=target)
+                           key=("release", target), target=target,
+                           trace_id=trace_id, span=span)
             done.wait(timeout)
         with self._lock:
             self._sticky[target] = to_wid
@@ -767,7 +882,7 @@ class FleetRouter:
                     agg[k] = agg.get(k, 0) + v
         queue_depth = sum(w.get("queue_depth", 0)
                           for w in workers.values())
-        return {
+        snap = {
             "record": "serve", "algo": "serve", "mode": "serve",
             "event": "stats", "worker_id": ROUTER_ID,
             "uptime_s": round(self.clock() - self._t_start, 6),
@@ -782,6 +897,20 @@ class FleetRouter:
             },
             "workers": workers,
         }
+        from ..observability.buildinfo import build_info
+
+        snap["build"] = build_info()
+        # fleet SLO view: worst worker wins per objective — a fleet
+        # meets an objective only when every worker does
+        worker_slo = {wid: w["slo"] for wid, w in workers.items()
+                      if isinstance(w.get("slo"), list)}
+        if worker_slo:
+            from ..observability.slo import aggregate_slo
+
+            snap["slo"] = aggregate_slo(worker_slo)
+        if self.flightrec is not None:
+            snap["flightrec"] = self.flightrec.snapshot()
+        return snap
 
     # -------------------------------------------------------- lifecycle
 
@@ -826,7 +955,8 @@ class FleetManager:
                  max_cycles: int = 2000, seed: int = 0,
                  worker_args: Optional[List[str]] = None,
                  env: Optional[Dict[str, str]] = None,
-                 python: str = sys.executable):
+                 python: str = sys.executable,
+                 slo: Optional[str] = None):
         self.fleet_dir = str(fleet_dir)
         os.makedirs(self.fleet_dir, exist_ok=True)
         self.out = out or os.path.join(self.fleet_dir,
@@ -840,6 +970,10 @@ class FleetManager:
         self.max_cycles = int(max_cycles)
         self.seed = int(seed)
         self.worker_args = list(worker_args or [])
+        #: SLO objectives file forwarded to every worker: each worker
+        #: evaluates locally at its heartbeat; the router aggregates
+        #: the per-worker rows (worst wins) in its stats snapshot
+        self.slo = slo
         self.env = dict(os.environ)
         if env:
             self.env.update(env)
@@ -863,7 +997,8 @@ class FleetManager:
             "--max-delay-ms", str(self.max_delay_ms),
             "--max-cycles", str(self.max_cycles),
             "--seed", str(self.seed),
-        ] + self.worker_args
+        ] + (["--slo", self.slo] if self.slo else []) \
+          + self.worker_args
 
     def spawn(self, wid: str) -> WorkerClient:
         """Start one worker daemon subprocess (not yet connected —

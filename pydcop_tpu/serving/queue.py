@@ -48,6 +48,10 @@ class AdmittedJob:
     #: every record of this job's pipeline life carries it, so the
     #: queue->rung->device story reconstructs from the JSONL alone
     trace_id: str = ""
+    #: the span this job's done/reject record chains under (schema
+    #: 1.11): the admit span's id — which itself chains under an
+    #: inbound router span when the request carried a trace context
+    trace_parent: str = ""
 
 
 @dataclass
@@ -142,7 +146,8 @@ def prepare_job(request: Dict[str, Any],
                 default_precision: Optional[str] = None,
                 reserve=None,
                 reply: Optional[Callable] = None,
-                trace_id: str = "") -> AdmittedJob:
+                trace_id: str = "",
+                trace_parent: str = "") -> AdmittedJob:
     """A validated request -> :class:`AdmittedJob`: load the instance
     (through the admission cache), validate/cast the algorithm params
     exactly like ``solve`` does, and pad to the home rung.  Any failure
@@ -226,7 +231,8 @@ def prepare_job(request: Dict[str, Any],
         max_cycles=max_cycles,
         deadline_s=(float(deadline_ms) / 1000.0
                     if deadline_ms is not None else None),
-        reply=reply, trace_id=str(trace_id))
+        reply=reply, trace_id=str(trace_id),
+        trace_parent=str(trace_parent))
 
 
 class AdmissionQueue:

@@ -55,6 +55,11 @@ REQUEST_FIELDS = {
                  "the winner — better cost at the same p99; the "
                  "summary record carries the schema-1.8 'portfolio' "
                  "block",
+    "trace": "optional inbound trace context {trace_id, span_id, "
+             "parent_span_id?} (schema 1.11): the fleet router — or "
+             "any upstream caller — stamps its span here so the "
+             "worker's admit/done trace records chain under it and "
+             "`pydcop trace` assembles one cross-process tree",
 }
 
 #: the ``delta`` job kind: a topology/cost edit against a previously
@@ -74,6 +79,8 @@ DELTA_FIELDS = {
                "dcop/scenario.py KNOWN_ACTIONS)",
     "max_cycles": "optional cycle budget for the warm re-solve",
     "seed": "optional engine seed (first solve of the session only)",
+    "trace": "optional inbound trace context {trace_id, span_id, "
+             "parent_span_id?} (schema 1.11; see REQUEST_FIELDS)",
 }
 
 #: the ``stats`` control op: ask a running daemon for its operational
@@ -100,9 +107,40 @@ RELEASE_FIELDS = {
     "op": "required: 'release'",
     "id": "required request id (echoed in the ack record)",
     "target": "required id of the warm session to drain",
+    "trace": "optional trace context (the fleet router stamps the "
+             "migration's span here; see REQUEST_FIELDS)",
 }
 
 _PRECISIONS = ("f32", "bf16", "auto")
+
+
+def _validate_trace(rec: Dict[str, Any], bad) -> None:
+    """The optional inbound ``trace`` context (schema 1.11) on solve
+    and delta requests — shape-checked at the admission trust
+    boundary like every other field: a malformed context is a
+    structured rejection, never a daemon crash or a silently broken
+    tree."""
+    ctx = rec.get("trace")
+    if ctx is None:
+        return
+    if not isinstance(ctx, dict):
+        raise bad(f"'trace' must be a context object, got "
+                  f"{type(ctx).__name__}")
+    unknown = sorted(set(ctx) - {"trace_id", "span_id",
+                                 "parent_span_id"})
+    if unknown:
+        raise bad(f"unknown trace context field(s): "
+                  f"{', '.join(unknown)}")
+    for field in ("trace_id", "span_id"):
+        v = ctx.get(field)
+        if not isinstance(v, str) or not v.strip():
+            raise bad(f"trace context missing {field!r} "
+                      f"(non-empty string)")
+    parent = ctx.get("parent_span_id")
+    if parent is not None and (not isinstance(parent, str)
+                               or not parent.strip()):
+        raise bad(f"trace context with bad parent_span_id "
+                  f"{parent!r}")
 
 
 class RequestError(ValueError):
@@ -154,6 +192,7 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
         if unknown:
             raise bad(f"unknown release request field(s): "
                       f"{', '.join(unknown)}")
+        _validate_trace(rec, bad)
         target = rec.get("target")
         if not isinstance(target, str) or not target.strip():
             raise bad("release request missing 'target' (the id of "
@@ -166,6 +205,7 @@ def validate_request(rec: Dict[str, Any]) -> Dict[str, Any]:
     unknown = sorted(set(rec) - set(REQUEST_FIELDS))
     if unknown:
         raise bad(f"unknown request field(s): {', '.join(unknown)}")
+    _validate_trace(rec, bad)
     dcop = rec.get("dcop")
     if not isinstance(dcop, str) or not dcop:
         raise bad("request missing 'dcop' (yaml file path)")
@@ -230,6 +270,7 @@ def _validate_delta(rec: Dict[str, Any], bad) -> Dict[str, Any]:
     if unknown:
         raise bad(f"unknown delta request field(s): "
                   f"{', '.join(unknown)}")
+    _validate_trace(rec, bad)
     target = rec.get("target")
     if not isinstance(target, str) or not target.strip():
         raise bad("delta request missing 'target' (the id of a "

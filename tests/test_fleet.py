@@ -499,6 +499,151 @@ def test_fleet_cli_rejects_bad_configs():
                      "--socket", "/tmp/x.sock"]) == 2
 
 
+# ------------------------------------- trace assembly under kill -9
+
+
+@pytest.mark.trace
+def test_kill9_trace_assembles_one_connected_tree(tmp_path):
+    """The ISSUE 20 acceptance property end-to-end: a job admitted
+    through a REAL router + 2 real worker daemons, kill -9'd on its
+    owner mid-flight (queued, not yet dispatched), failed over and
+    completed on the survivor, must reconstruct as ONE connected span
+    tree — router route span, the dead worker's admit span, the
+    failover link, the survivor's spans — from the shared JSONL plus
+    the dead worker's flight-recorder spill alone."""
+    import signal as signallib
+    import time
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.dcop_cli import main as cli_main
+    from pydcop_tpu.generators.graphcoloring import \
+        generate_graph_coloring
+    from pydcop_tpu.observability.flightrec import (flightrec_path,
+                                                    read_spill)
+    from pydcop_tpu.observability.report import RunReporter
+    from pydcop_tpu.observability.tracing import (assemble,
+                                                  find_trace_ids,
+                                                  is_connected,
+                                                  load_telemetry_dir)
+    from pydcop_tpu.serving.fleet import FleetManager
+
+    yml = tmp_path / "i.yaml"
+    yml.write_text(dcop_yaml(generate_graph_coloring(
+        8, 3, "scalefree", m_edge=2, soft=True, seed=7)))
+    fleet_dir = str(tmp_path / "fleet")
+    # a 4s batch window holds the admitted job QUEUED on its owner:
+    # the kill lands between the admit span and the dispatch
+    mgr = FleetManager(fleet_dir, max_batch=8, max_delay_ms=4000.0,
+                       max_cycles=50, seed=0)
+    reporter = RunReporter(mgr.out, algo="serve", mode="serve",
+                           worker_id=ROUTER_ID)
+    router = FleetRouter(reporter=reporter,
+                         checkpoint_dir=mgr.ckpt_dir)
+
+    def poll(predicate, timeout=120.0, what=""):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def records_in(path):
+        out = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+        except OSError:
+            pass
+        return out
+
+    def admitted(job_id):
+        return any(r.get("record") == "trace"
+                   and r.get("event") == "admit"
+                   and r.get("job_id") == job_id
+                   for r in records_in(mgr.out))
+
+    replies = []
+    try:
+        mgr.start(router, 2)
+        router.feed(json.dumps({"id": "victim", "algo": "maxsum",
+                                "dcop": str(yml), "max_cycles": 8}),
+                    reply=replies.append)
+        owner = router._session_owner["victim"]
+        survivor = "w1" if owner == "w0" else "w0"
+        poll(lambda: admitted("victim"),
+             what="the victim job's admit span on its owner")
+        # a second job hashed onto the SAME owner: its admit event
+        # crosses the recorder's 1s cadence and spills the ring —
+        # the victim's admit event is on disk before the kill
+        time.sleep(1.2)
+        k = next(k for k in range(64)
+                 if router._owner_of(f"tickle-{k}") == owner)
+        router.feed(json.dumps({"id": f"tickle-{k}",
+                                "algo": "maxsum", "dcop": str(yml),
+                                "max_cycles": 8}),
+                    reply=replies.append)
+        spill_path = flightrec_path(fleet_dir, owner)
+        poll(lambda: any(
+            e.get("job_id") == "victim"
+            for e in (read_spill(spill_path) or {}).get("events", [])),
+            what="the owner's flight-recorder spill")
+        router.workers[owner].process.send_signal(signallib.SIGKILL)
+        assert router.drain(timeout=300.0), \
+            "failed-over jobs never completed"
+    finally:
+        mgr.shutdown(router)
+        reporter.close()
+
+    by_id = {r.get("job_id") or r.get("id"): r for r in replies}
+    # completed on the survivor (MAX_CYCLES is a completion too:
+    # the 8-cycle budget ran out before convergence)
+    assert by_id["victim"]["status"] in ("FINISHED", "MAX_CYCLES")
+    assert by_id["victim"]["worker_id"] == survivor
+
+    records, spills = load_telemetry_dir(fleet_dir)
+    # the dead worker's spill is part of the story read back
+    assert any(s.get("worker_id") == owner for s in spills)
+    tids = find_trace_ids(records, "victim")
+    assert len(tids) == 1
+    roots = assemble(records, spills, tids[0])
+    assert is_connected(roots), \
+        f"{len(roots)} roots: the failover link did not join the " \
+        f"re-send to the original attempt"
+
+    def walk(span):
+        yield span
+        for child in span.children:
+            yield from walk(child)
+
+    spans = list(walk(roots[0]))
+    links = [s for s in spans
+             if s.link and s.link.get("kind") == "failover"]
+    assert links, "no failover link span in the tree"
+    assert links[0].link["from_worker"] == owner
+    assert links[0].link["to_worker"] == survivor
+    workers_seen = {s.worker_id for s in spans}
+    # both workers' spans: the corpse's admit AND the survivor's
+    assert {ROUTER_ID, owner, survivor} <= workers_seen
+    dead_spans = [s for s in spans if s.worker_id == owner]
+    assert any(s.name == "admit" for s in dead_spans)
+    assert any(s.name.startswith("done") for s in spans
+               if s.worker_id == survivor)
+    # the spill annotated the dead worker's side of the story
+    assert any(n.startswith(f"flightrec[{owner}]")
+               for s in spans for n in s.notes)
+    # and the operator-facing paths agree: the CLI renders it
+    # connected, and the directory (including cross-file trace
+    # references) is schema-green
+    assert cli_main(["trace", "victim", "--dir", fleet_dir]) == 0
+    assert cli_main(["telemetry-validate", fleet_dir,
+                     "--quiet"]) == 0
+
+
 # ------------------------------------------ bench wiring (CI, tier 1)
 
 

@@ -394,17 +394,17 @@ def test_validate_serve_heartbeat_fields():
                          "status": "FINISHED", "trace_id": ""})
 
 
-def test_schema_minor_is_10_and_v1_readers_stay_green():
+def test_schema_minor_is_11_and_v1_readers_stay_green():
     from pydcop_tpu.observability.report import (SCHEMA_MINOR,
                                                  SCHEMA_VERSION)
 
-    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 10
+    assert SCHEMA_VERSION == 1 and SCHEMA_MINOR == 11
     # the frozen-reader assertions: headers stamped by EVERY earlier
     # minor (and minor-0 pre-dynamics emitters with no stamp at all)
     # still validate — the major gate is the only compatibility wall
     validate_record({"record": "header", "schema": 1, "algo": "a",
                      "mode": "engine"})
-    for minor in (1, 2, 3, 4, 5, 6, 7, 8, 9, 10):
+    for minor in (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11):
         validate_record({"record": "header", "schema": 1,
                          "schema_minor": minor, "algo": "a",
                          "mode": "engine"})

@@ -1,0 +1,847 @@
+"""Fleet-wide observability (ISSUE 20): causal tracing, the
+crash-surviving flight recorder, and the SLO engine.
+
+* TraceContext wire round-trips and the from_wire rejection matrix (a
+  half-broken inbound context must never take a job down);
+* the flight recorder: ring + spill round-trip through the on-disk
+  format, eager dumps, oversize-ring truncation (tail survives),
+  garbage/empty spill files read as None, idempotent close;
+* the SLO engine: the objectives-file rejection matrix, evaluation
+  against a live registry/stats/queue (rows, gauges, emitted ``slo``
+  records all green under validate_record), no-data null rows, and
+  the router's worst-worker-wins aggregation;
+* schema minor 11: the ``slo`` record kind and the span/link/wall-t
+  trace fields accept/reject matrices, the vocabulary mirrors
+  (report vs tracing/slo modules) asserted equal, and frozen pre-11
+  readers — minor <=10 records stay green verbatim;
+* admission: the optional ``trace`` context on solve/delta/release
+  requests (stats stays closed);
+* assembly: canned router+worker records -> ONE connected tree,
+  failover links, summary/flightrec annotations, timing attribution
+  with the failover gap, rendering, and the ``pydcop trace`` CLI
+  (human + --json) over a real telemetry directory;
+* directory-mode ``telemetry-validate``: the worker_id/filename
+  cross-check and dangling parent/link.ref detection.
+"""
+
+import json
+import os
+
+import pytest
+
+from pydcop_tpu.observability import report
+from pydcop_tpu.observability import slo as slo_mod
+from pydcop_tpu.observability import tracing
+from pydcop_tpu.observability.flightrec import (FlightRecorder,
+                                                flightrec_path,
+                                                read_spill)
+from pydcop_tpu.observability.report import validate_record
+from pydcop_tpu.observability.tracing import (SpanIds, TraceContext,
+                                              assemble, attribution,
+                                              find_trace_ids,
+                                              is_connected,
+                                              load_telemetry_dir,
+                                              render_tree,
+                                              span_to_dict)
+
+pytestmark = pytest.mark.trace
+
+
+# ------------------------------------------------------ trace context
+
+
+def test_trace_context_wire_roundtrip():
+    ctx = TraceContext("ft00000001", "router:000000")
+    wire = ctx.to_wire()
+    assert wire == {"trace_id": "ft00000001",
+                    "span_id": "router:000000"}
+    assert "parent_span_id" not in wire  # omitted when empty
+    assert TraceContext.from_wire(wire) == ctx
+    child = TraceContext("ft00000001", "w0:000003",
+                         parent_span_id="router:000000")
+    assert TraceContext.from_wire(child.to_wire()) == child
+
+
+@pytest.mark.parametrize("wire", [
+    None,
+    "ft1:span1",                               # not a dict
+    {},                                        # both ids missing
+    {"trace_id": "t1"},                        # span missing
+    {"span_id": "s1"},                         # trace missing
+    {"trace_id": "", "span_id": "s1"},         # empty trace
+    {"trace_id": "t1", "span_id": ""},         # empty span
+    {"trace_id": 7, "span_id": "s1"},          # non-string
+])
+def test_trace_context_from_wire_rejects_unusable(wire):
+    assert TraceContext.from_wire(wire) is None
+
+
+def test_from_wire_normalizes_null_parent():
+    ctx = TraceContext.from_wire(
+        {"trace_id": "t1", "span_id": "s1", "parent_span_id": None})
+    assert ctx is not None and ctx.parent_span_id == ""
+
+
+def test_span_ids_are_prefixed_and_unique():
+    ids = SpanIds("w3")
+    got = [ids.next() for _ in range(5)]
+    assert got[0] == "w3:000000"
+    assert got[-1] == "w3:000004"
+    assert len(set(got)) == 5
+    assert SpanIds("").next().startswith("span:")
+
+
+def test_vocabulary_mirrors_stay_equal():
+    # duplicated like EDIT_KEYS so each module stays import-light;
+    # this is the drift guard the docstrings promise
+    assert report.TRACE_LINK_KINDS == tracing.LINK_KINDS
+    assert report.SLO_KINDS == slo_mod.SLO_KINDS
+
+
+# ---------------------------------------------------- flight recorder
+
+
+def test_flightrec_spill_roundtrip_and_snapshot(tmp_path):
+    path = flightrec_path(str(tmp_path), "w0")
+    assert path.endswith("flightrec-w0.bin")
+    rec = FlightRecorder(path, worker_id="w0", capacity=8,
+                         spill_every_s=3600.0)
+    rec.record("admit", job_id="j1", trace_id="t1")
+    rec.record("dispatch", job_id="j1")
+    rec.dump("breaker_open")
+    snap = rec.snapshot()
+    assert snap["events"] == 2 and snap["ring"] == 2
+    assert snap["dumps"] == 1
+    assert snap["last_dump_reason"] == "breaker_open"
+    assert snap["path"] == path
+    spill = read_spill(path)
+    assert spill is not None
+    assert spill["worker_id"] == "w0"
+    assert spill["reason"] == "breaker_open"
+    kinds = [e["kind"] for e in spill["events"]]
+    assert kinds == ["admit", "dispatch"]
+    assert spill["events"][0]["job_id"] == "j1"
+    assert all(isinstance(e["t"], float) for e in spill["events"])
+    rec.close()
+    # close performs a final spill, then closing again is a no-op
+    assert read_spill(path)["reason"] == "close"
+    rec.close()
+    rec.record("after_close")  # never raises, even unmapped
+    rec.dump("after_close")
+
+
+def test_flightrec_ring_is_bounded_and_keeps_the_tail(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "fr.bin"), capacity=4,
+                         spill_every_s=3600.0)
+    for k in range(10):
+        rec.record("evt", k=k)
+    rec.dump("probe")
+    spill = read_spill(str(tmp_path / "fr.bin"))
+    assert [e["k"] for e in spill["events"]] == [6, 7, 8, 9]
+    assert rec.snapshot()["events"] == 10  # lifetime counter
+    rec.close()
+
+
+def test_flightrec_cadence_spills_on_fake_clock(tmp_path):
+    t = [0.0]
+    rec = FlightRecorder(str(tmp_path / "fr.bin"), capacity=8,
+                         spill_every_s=1.0, clock=lambda: t[0],
+                         time_source=lambda: 1000.0 + t[0])
+    rec.record("early")           # t=0: before the cadence
+    assert read_spill(str(tmp_path / "fr.bin")) is None
+    t[0] = 1.5
+    rec.record("late")            # crosses the cadence -> spill
+    spill = read_spill(str(tmp_path / "fr.bin"))
+    assert spill is not None and spill["reason"] == "cadence"
+    assert [e["kind"] for e in spill["events"]] == ["early", "late"]
+    assert rec.snapshot()["spills"] == 1
+    # the wall stamp comes from time_source, not the cadence clock
+    assert spill["events"][0]["t"] == 1000.0
+    rec.close()
+
+
+def test_flightrec_oversize_payload_drops_oldest(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "fr.bin"), capacity=512,
+                         spill_every_s=3600.0, size_bytes=4096)
+    for k in range(200):
+        rec.record("evt", k=k, pad="x" * 40)
+    rec.dump("probe")
+    spill = read_spill(str(tmp_path / "fr.bin"))
+    ks = [e["k"] for e in spill["events"]]
+    assert ks, "truncation must keep a non-empty tail"
+    assert ks[-1] == 199            # the newest event survives
+    assert ks == sorted(ks)         # still in order
+    assert len(ks) < 200            # something was dropped
+    rec.close()
+
+
+def test_read_spill_rejects_garbage(tmp_path):
+    assert read_spill(str(tmp_path / "missing.bin")) is None
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"\0" * 4096)   # a recorder that never spilled
+    assert read_spill(str(empty)) is None
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"PYDCOPFR1 000000banana\n{}")
+    assert read_spill(str(bad)) is None
+    trunc = tmp_path / "trunc.bin"
+    trunc.write_bytes(b"PYDCOPFR1 0000009999\n{\"flightrec\": 1}")
+    assert read_spill(str(trunc)) is None   # short payload
+    notjson = tmp_path / "notjson.bin"
+    notjson.write_bytes(b"PYDCOPFR1 0000000003\n{{{")
+    assert read_spill(str(notjson)) is None
+
+
+# ----------------------------------------------------------- slo file
+
+
+def _write_slo(tmp_path, text, name="slo.yaml"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_load_objectives_parses_and_defaults(tmp_path):
+    path = _write_slo(tmp_path, """
+objectives:
+  - name: solve-p99
+    kind: latency_p99
+    target: 0.5
+    algo: maxsum
+  - name: errs
+    kind: error_rate
+    target: 0.01
+  - name: depth
+    kind: queue_depth
+    target: 32
+""")
+    objs = slo_mod.load_objectives(path)
+    assert [o.name for o in objs] == ["solve-p99", "errs", "depth"]
+    assert objs[0].algo == "maxsum"
+    assert objs[1].algo == ""
+    assert objs[2].target == 32.0
+
+
+@pytest.mark.parametrize("text,needle", [
+    ("{", "not valid yaml"),
+    ("objectives: []", "non-empty"),
+    ("- a\n- b", "must be a mapping"),
+    ("objectives:\n  - 7", "objectives[0] must be a mapping"),
+    ("objectives:\n  - kind: queue_depth\n    target: 1",
+     "missing 'name'"),
+    ("objectives:\n  - name: a\n    kind: p99\n    target: 1",
+     "kind 'p99' unknown"),
+    ("objectives:\n  - name: a\n    kind: queue_depth\n    target: 0",
+     "'target' must be a positive number"),
+    ("objectives:\n  - name: a\n    kind: queue_depth\n"
+     "    target: true", "'target' must be a positive number"),
+    ("objectives:\n  - name: a\n    kind: error_rate\n"
+     "    target: 0.1\n    algo: dsa",
+     "'algo' only applies to latency_p99"),
+    ("objectives:\n  - name: a\n    kind: queue_depth\n"
+     "    target: 1\n  - name: a\n    kind: queue_depth\n"
+     "    target: 2", "duplicate objective name"),
+    ("objectives:\n  - name: a\n    kind: queue_depth\n"
+     "    target: 1\n    window: 5m", "unknown field(s): window"),
+])
+def test_load_objectives_rejection_matrix(tmp_path, text, needle):
+    path = _write_slo(tmp_path, text)
+    with pytest.raises(slo_mod.SLOError) as err:
+        slo_mod.load_objectives(path)
+    assert needle in str(err.value)
+
+
+def test_load_objectives_missing_file(tmp_path):
+    with pytest.raises(slo_mod.SLOError) as err:
+        slo_mod.load_objectives(str(tmp_path / "nope.yaml"))
+    assert "cannot read" in str(err.value)
+
+
+# ------------------------------------------------------ slo evaluator
+
+
+def _mk_evaluator(tmp_path, latencies=(), stats=None, depth=None):
+    from pydcop_tpu.observability.registry import MetricsRegistry
+    from pydcop_tpu.observability.report import RunReporter
+
+    registry = MetricsRegistry()
+    hist = registry.histogram(
+        "pydcop_job_latency_seconds", "test", labels=("algo",))
+    for algo, v in latencies:
+        hist.observe(v, algo=algo)
+    out = str(tmp_path / "slo_out.jsonl")
+    reporter = RunReporter(out, algo="serve", mode="serve",
+                           worker_id="w0")
+    objectives = [
+        slo_mod.Objective("p99", "latency_p99", 0.5),
+        slo_mod.Objective("errs", "error_rate", 0.1),
+        slo_mod.Objective("depth", "queue_depth", 8),
+    ]
+    ev = slo_mod.SLOEvaluator(
+        objectives, registry=registry, reporter=reporter,
+        stats=(lambda: stats) if stats is not None else None,
+        queue_depth=(lambda: depth) if depth is not None else None)
+    return ev, registry, reporter, out
+
+
+def test_evaluator_rows_gauges_and_records(tmp_path):
+    ev, registry, reporter, out = _mk_evaluator(
+        tmp_path, latencies=[("maxsum", 0.01)] * 50,
+        stats={"received": 10, "rejected": 2}, depth=3)
+    rows = ev.evaluate()
+    reporter.close()
+    by = {r["objective"]: r for r in rows}
+    assert by["errs"]["value"] == pytest.approx(0.2)
+    assert by["errs"]["ok"] is False       # 0.2 > 0.1: breaching
+    assert by["errs"]["burn_rate"] == pytest.approx(2.0)
+    assert by["errs"]["budget_remaining"] == 0.0
+    assert by["depth"]["value"] == 3.0
+    assert by["depth"]["ok"] is True
+    assert by["depth"]["budget_remaining"] == pytest.approx(
+        1 - 3 / 8)
+    assert by["p99"]["ok"] is True         # 10ms-ish p99 vs 0.5s
+    assert 0 < by["p99"]["value"] < 0.5
+    assert ev.last == rows                 # snapshot payload
+    burn = registry.get("pydcop_slo_burn_rate")
+    assert burn.value(objective="errs") == pytest.approx(2.0)
+    budget = registry.get("pydcop_slo_budget_remaining")
+    assert budget.value(objective="depth") == pytest.approx(1 - 3 / 8)
+    # every emitted slo record is schema-green
+    recs = report.read_records(out)
+    assert [r["record"] for r in recs] == ["slo"] * 3
+    for r in recs:
+        validate_record(r)
+        assert r["worker_id"] == "w0"
+        assert isinstance(r["t"], float)
+
+
+def test_evaluator_no_data_rows_are_null_and_burn_nothing(tmp_path):
+    ev, registry, reporter, out = _mk_evaluator(tmp_path)
+    rows = ev.evaluate()
+    reporter.close()
+    assert all(r["value"] is None and r["ok"] is None
+               and r["burn_rate"] is None for r in rows)
+    # gauges untouched: no child minted for any objective
+    assert not registry.get("pydcop_slo_burn_rate")._children
+    for r in report.read_records(out):
+        validate_record(r)      # null-value slo records stay valid
+
+
+def test_evaluator_per_algo_latency_objective(tmp_path):
+    from pydcop_tpu.observability.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    hist = registry.histogram(
+        "pydcop_job_latency_seconds", "test", labels=("algo",))
+    for _ in range(50):
+        hist.observe(0.01, algo="maxsum")
+        hist.observe(2.0, algo="dsa")
+    ev = slo_mod.SLOEvaluator(
+        [slo_mod.Objective("m", "latency_p99", 0.5, algo="maxsum"),
+         slo_mod.Objective("all", "latency_p99", 0.5)],
+        registry=registry)
+    by = {r["objective"]: r for r in ev.evaluate()}
+    assert by["m"]["ok"] is True           # maxsum alone is fast
+    assert by["all"]["ok"] is False        # worst-of includes dsa
+
+
+def test_aggregate_slo_worst_worker_wins():
+    rows_w0 = [{"objective": "p99", "kind": "latency_p99",
+                "target": 0.5, "value": 0.1, "ok": True,
+                "burn_rate": 0.2, "budget_remaining": 0.8}]
+    rows_w1 = [{"objective": "p99", "kind": "latency_p99",
+                "target": 0.5, "value": 0.9, "ok": False,
+                "burn_rate": 1.8, "budget_remaining": 0.0}]
+    rows_w2 = [{"objective": "p99", "kind": "latency_p99",
+                "target": 0.5, "value": None, "ok": None,
+                "burn_rate": None, "budget_remaining": None}]
+    agg = slo_mod.aggregate_slo(
+        {"w0": rows_w0, "w1": rows_w1, "w2": rows_w2})
+    assert len(agg) == 1
+    row = agg[0]
+    assert row["value"] == 0.9             # worst value wins
+    assert row["burn_rate"] == 1.8
+    assert row["ok"] is False              # any breach breaches
+    assert row["workers"] == ["w0", "w1", "w2"]
+    # all-null workers aggregate to a null row, not a crash
+    only_null = slo_mod.aggregate_slo({"w2": rows_w2})
+    assert only_null[0]["value"] is None
+    assert only_null[0]["ok"] is None
+
+
+# -------------------------------------------------- schema (minor 11)
+
+
+def _slo_rec(**over):
+    rec = {"record": "slo", "algo": "serve", "objective": "p99",
+           "kind": "latency_p99", "target": 0.5, "value": 0.1,
+           "ok": True, "burn_rate": 0.2, "budget_remaining": 0.8,
+           "t": 1000.0}
+    rec.update(over)
+    return {k: v for k, v in rec.items() if v is not ...}
+
+
+def test_slo_record_accepts_measured_and_null():
+    validate_record(_slo_rec())
+    validate_record(_slo_rec(value=None, ok=None, burn_rate=None,
+                             budget_remaining=None))
+    validate_record(_slo_rec(algo="serve", kind="queue_depth",
+                             value=3, target=8, ok=True,
+                             burn_rate=0.375,
+                             budget_remaining=0.625))
+
+
+@pytest.mark.parametrize("over,needle", [
+    ({"objective": ""}, "bad objective"),
+    ({"objective": 7}, "bad objective"),
+    ({"kind": "p99"}, "unknown kind"),
+    ({"target": 0}, "bad target"),
+    ({"target": True}, "bad target"),
+    ({"value": -1}, "bad value"),
+    ({"ok": "yes"}, "bad ok"),
+    ({"value": None}, "'ok' must be present exactly when"),
+    ({"ok": None}, "'ok' must be present exactly when"),
+    ({"burn_rate": -0.1}, "bad burn_rate"),
+    ({"budget_remaining": True}, "bad budget_remaining"),
+])
+def test_slo_record_rejection_matrix(over, needle):
+    with pytest.raises(ValueError) as err:
+        validate_record(_slo_rec(**over))
+    assert needle in str(err.value)
+
+
+def _trace_rec(**over):
+    rec = {"record": "trace", "algo": "serve", "trace_id": "ft1",
+           "job_id": "j1", "event": "admit", "t": 1000.0}
+    rec.update(over)
+    return rec
+
+
+def test_trace_record_span_and_link_matrix():
+    validate_record(_trace_rec(span_id="w0:000001",
+                               parent_span_id="router:000000"))
+    validate_record(_trace_rec(
+        event="link", span_id="router:000002",
+        parent_span_id="router:000000",
+        link={"kind": "failover", "ref": "router:000000",
+              "from_worker": "w0", "to_worker": "w1"}))
+    validate_record(_trace_rec(
+        event="link", span_id="router:000002",
+        link={"kind": "resume", "ref": "s:000001"}))
+    for bad, needle in [
+        (dict(span_id=""), "bad span_id"),
+        (dict(parent_span_id=7), "bad parent_span_id"),
+        (dict(t=-1.0), "bad t"),
+        (dict(t=True), "bad t"),
+        (dict(link={"kind": "failover", "ref": "x"}),
+         "present exactly when event is 'link'"),
+        (dict(event="link"),
+         "present exactly when event is 'link'"),
+        (dict(event="link", link="failover"), "must be a dict"),
+        (dict(event="link", link={"kind": "oops", "ref": "x"}),
+         "unknown kind"),
+        (dict(event="link", link={"kind": "failover"}), "bad ref"),
+        (dict(event="link",
+              link={"kind": "failover", "ref": "x", "extra": 1}),
+         "unknown field"),
+        (dict(event="link",
+              link={"kind": "failover", "ref": "x",
+                    "from_worker": ""}), "bad from_worker"),
+    ]:
+        with pytest.raises(ValueError) as err:
+            validate_record(_trace_rec(**bad))
+        assert needle in str(err.value), bad
+
+
+def test_span_stamps_accepted_on_summary_and_serve():
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "mode": "tpu", "status": "FINISHED",
+                     "trace_id": "ft1", "span_id": "w0:000001",
+                     "parent_span_id": "router:000000"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "mode": "serve", "event": "fleet",
+                     "action": "route", "worker_id": "router",
+                     "trace_id": "ft1", "span_id": "router:000000"})
+    with pytest.raises(ValueError):
+        validate_record({"record": "serve", "algo": "serve",
+                         "mode": "serve", "event": "dispatch",
+                         "span_id": ""})
+
+
+def test_frozen_pre11_records_stay_green():
+    """The forward-compat promise: every record a minor <=10 emitter
+    wrote — no span stamps, no link events, no slo kind — validates
+    under the minor-11 reader verbatim."""
+    validate_record({"record": "header", "schema": 1,
+                     "schema_minor": 10, "algo": "serve",
+                     "mode": "serve"})
+    validate_record({"record": "header", "schema": 1,
+                     "algo": "maxsum", "mode": "tpu"})  # minor 0
+    validate_record({"record": "trace", "algo": "serve",
+                     "trace_id": "t00000001", "job_id": "j1",
+                     "event": "admit",
+                     "spans": {"queue_wait_s": 0.01}})
+    validate_record({"record": "summary", "algo": "maxsum",
+                     "mode": "tpu", "status": "FINISHED",
+                     "trace_id": "t00000001", "worker_id": "w0"})
+    validate_record({"record": "serve", "algo": "serve",
+                     "mode": "serve", "event": "fleet",
+                     "action": "failover", "worker": "w0",
+                     "worker_id": "router"})
+
+
+# --------------------------------------------------- request admission
+
+
+def test_requests_accept_and_reject_trace_context():
+    from pydcop_tpu.serving.schema import (RequestError,
+                                           validate_request)
+
+    ctx = {"trace_id": "ft1", "span_id": "router:000000"}
+    validate_request({"id": "j1", "algo": "maxsum",
+                      "dcop": "i.yaml", "trace": dict(ctx)})
+    validate_request({"id": "d1", "op": "delta", "target": "j1",
+                      "actions": [{"type": "change_costs",
+                                   "name": "c", "costs": [[0.0]]}],
+                      "trace": dict(ctx)})
+    validate_request({"id": "r1", "op": "release", "target": "j1",
+                      "trace": dict(ctx)})
+    with pytest.raises(RequestError):
+        validate_request({"id": "j1", "algo": "maxsum",
+                          "dcop": "i.yaml", "trace": "ft1"})
+    with pytest.raises(RequestError):
+        validate_request({"id": "j1", "algo": "maxsum",
+                          "dcop": "i.yaml",
+                          "trace": {"trace_id": "ft1"}})
+    with pytest.raises(RequestError):
+        validate_request({"id": "j1", "algo": "maxsum",
+                          "dcop": "i.yaml",
+                          "trace": dict(ctx, extra=1)})
+    # the stats op's field set stays closed
+    with pytest.raises(RequestError):
+        validate_request({"id": "s1", "op": "stats",
+                          "trace": dict(ctx)})
+
+
+# ------------------------------------------------------------ assembly
+
+
+def _canned_failover_records():
+    """A killed-mid-flight job's records, as the router + both
+    workers would write them: route root -> w0 admit; failover link
+    -> w1 admit -> done; plus an un-spanned summary annotation."""
+    return [
+        {"record": "serve", "algo": "serve", "mode": "serve",
+         "event": "fleet", "action": "route", "worker": "w0",
+         "worker_id": "router", "job_id": "j1",
+         "trace_id": "ft1", "span_id": "router:000000"},
+        {"record": "trace", "algo": "serve", "trace_id": "ft1",
+         "job_id": "j1", "event": "admit", "worker_id": "w0",
+         "span_id": "w0:000000",
+         "parent_span_id": "router:000000", "t": 100.0,
+         "spans": {"queue_wait_s": 0.002}},
+        {"record": "trace", "algo": "serve", "trace_id": "ft1",
+         "job_id": "j1", "event": "link", "worker_id": "router",
+         "span_id": "router:000001",
+         "parent_span_id": "router:000000", "t": 101.0,
+         "link": {"kind": "failover", "ref": "router:000000",
+                  "from_worker": "w0", "to_worker": "w1"}},
+        {"record": "trace", "algo": "serve", "trace_id": "ft1",
+         "job_id": "j1", "event": "admit", "worker_id": "w1",
+         "span_id": "w1:000000",
+         "parent_span_id": "router:000001", "t": 101.2,
+         "spans": {"queue_wait_s": 0.004}},
+        {"record": "trace", "algo": "serve", "trace_id": "ft1",
+         "job_id": "j1", "event": "done", "worker_id": "w1",
+         "span_id": "w1:000000:done",
+         "parent_span_id": "w1:000000", "t": 101.5, "rung": "r0",
+         "spans": {"execute_s": 0.25, "compile_s": 0.1}},
+        {"record": "summary", "algo": "maxsum", "mode": "tpu",
+         "status": "FINISHED", "job_id": "j1", "trace_id": "ft1",
+         "worker_id": "w1"},
+        {"record": "summary", "algo": "dsa", "mode": "tpu",
+         "status": "FINISHED", "job_id": "other",
+         "trace_id": "ft2"},      # a different trace: ignored
+    ]
+
+
+def test_assemble_failover_into_one_connected_tree():
+    spills = [{"flightrec": 1, "worker_id": "w0", "reason": "kill",
+               "events": [{"t": 100.1, "kind": "dispatch",
+                           "job_id": "j1", "trace_id": "ft1"},
+                          {"t": 99.0, "kind": "noise",
+                           "job_id": "zzz"}]}]
+    roots = assemble(_canned_failover_records(), spills, "ft1")
+    assert is_connected(roots)
+    root = roots[0]
+    assert root.span_id == "router:000000"
+    assert root.worker_id == "router"
+    kids = {c.span_id for c in root.children}
+    assert kids == {"w0:000000", "router:000001"}
+    link = next(c for c in root.children
+                if c.span_id == "router:000001")
+    assert link.link == {"kind": "failover", "ref": "router:000000",
+                         "from_worker": "w0", "to_worker": "w1"}
+    w1 = link.children[0]
+    assert w1.span_id == "w1:000000"
+    done = w1.children[0]
+    assert done.name == "done rung=r0"
+    # the un-spanned summary annotated the job's nearest span, and
+    # the dead worker's flightrec event annotated w0's last span
+    # (the noise event matched neither trace nor job and is absent)
+    assert any("summary status=FINISHED" in n for n in done.notes)
+    w0 = next(c for c in root.children if c.span_id == "w0:000000")
+    assert any(n.startswith("flightrec[w0] dispatch")
+               for n in w0.notes)
+    assert not any("noise" in n for n in w0.notes)
+
+
+def test_attribution_sums_durations_and_failover_gap():
+    roots = assemble(_canned_failover_records(), [], "ft1")
+    attr = attribution(roots)
+    assert attr["queue_wait_s"] == pytest.approx(0.006)
+    assert attr["execute_s"] == pytest.approx(0.25)
+    assert attr["compile_s"] == pytest.approx(0.1)
+    # the failover link at t=101.0 follows the admit at t=100.0
+    assert attr["failover_gap_s"] == pytest.approx(1.0)
+
+
+def test_assemble_disconnected_without_the_link():
+    recs = [r for r in _canned_failover_records()
+            if r.get("event") != "link"]
+    roots = assemble(recs, [], "ft1")
+    assert not is_connected(roots)
+    assert len(roots) == 2          # the w1 attempt floats free
+    text = render_tree(roots, trace_id="ft1")
+    assert "[DISCONNECTED: 2 roots]" in text
+
+
+def test_render_tree_and_dict_views():
+    roots = assemble(_canned_failover_records(), [], "ft1")
+    text = render_tree(roots, trace_id="ft1")
+    assert text.splitlines()[0] == "trace ft1"
+    assert "[router] route worker=w0 job=j1" in text
+    assert "link kind=failover" in text
+    assert "done rung=r0" in text
+    assert "execute=250.0ms" in text
+    assert "attribution:" in text
+    assert "failover_gap" in text
+    d = span_to_dict(roots[0])
+    assert d["span_id"] == "router:000000"
+    assert {c["span_id"] for c in d["children"]} == \
+        {"w0:000000", "router:000001"}
+    json.dumps(d)                   # JSON-able all the way down
+
+
+def test_find_trace_ids_by_trace_job_and_target():
+    recs = _canned_failover_records() + [
+        {"record": "serve", "algo": "serve", "mode": "serve",
+         "event": "fleet", "action": "route", "worker_id": "router",
+         "job_id": "d0", "target": "sess", "trace_id": "ft3",
+         "span_id": "router:000009"}]
+    assert find_trace_ids(recs, "ft1") == ["ft1"]
+    assert find_trace_ids(recs, "j1") == ["ft1"]
+    assert find_trace_ids(recs, "other") == ["ft2"]
+    assert find_trace_ids(recs, "sess") == ["ft3"]
+    assert find_trace_ids(recs, "nope") == []
+
+
+def _write_telemetry_dir(tmp_path, records=None):
+    d = tmp_path / "tele"
+    d.mkdir(exist_ok=True)
+    records = records or _canned_failover_records()
+    with open(d / "fleet_out.jsonl", "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        f.write("\n{half a line\n")   # crash tail: skipped, not fatal
+    fr = FlightRecorder(flightrec_path(str(d), "w0"), worker_id="w0",
+                        spill_every_s=3600.0)
+    fr.record("dispatch", job_id="j1", trace_id="ft1")
+    fr.close()
+    return str(d)
+
+
+def test_load_telemetry_dir_reads_jsonl_and_spills(tmp_path):
+    d = _write_telemetry_dir(tmp_path)
+    records, spills = load_telemetry_dir(d)
+    assert len(records) == len(_canned_failover_records())
+    assert all(r["_file"] == "fleet_out.jsonl" for r in records)
+    assert len(spills) == 1
+    assert spills[0]["worker_id"] == "w0"
+    assert spills[0]["_file"] == "flightrec-w0.bin"
+    with pytest.raises(ValueError):
+        load_telemetry_dir(str(tmp_path / "missing"))
+
+
+def test_trace_cli_renders_and_jsons(tmp_path, capsys):
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    d = _write_telemetry_dir(tmp_path)
+    assert cli_main(["trace", "j1", "--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("trace ft1")
+    assert "link kind=failover" in out
+    assert "flightrec[w0] dispatch" in out
+    assert cli_main(["trace", "ft1", "--dir", d, "--json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["trace_id"] == "ft1"
+    assert got["connected"] is True
+    assert got["attribution"]["failover_gap_s"] == pytest.approx(1.0)
+    # unmatched query and empty dir fail with rc 2, not a traceback
+    assert cli_main(["trace", "nope", "--dir", d]) == 2
+    empty = tmp_path / "void"
+    empty.mkdir()
+    assert cli_main(["trace", "x", "--dir", str(empty)]) == 2
+
+
+def test_trace_cli_flags_disconnected(tmp_path, capsys):
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    recs = [r for r in _canned_failover_records()
+            if r.get("event") != "link"]
+    d = tmp_path / "tele2"
+    d.mkdir()
+    with open(d / "out.jsonl", "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    assert cli_main(["trace", "ft1", "--dir", str(d)]) == 0
+    captured = capsys.readouterr()
+    assert "[DISCONNECTED" in captured.out
+    assert "DISCONNECTED" in captured.err
+
+
+# ------------------------------------------ telemetry-validate --dir
+
+
+def test_validate_dir_green_on_consistent_directory(tmp_path):
+    from pydcop_tpu.commands.telemetry_validate import validate_dir
+    from pydcop_tpu.dcop_cli import main as cli_main
+
+    d = tmp_path / "tele"
+    d.mkdir()
+    recs = [r for r in _canned_failover_records()
+            if "_file" not in r]
+    with open(d / "fleet_out.jsonl", "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    counts, minor, nfiles = validate_dir(str(d))
+    assert nfiles == 1
+    assert counts["trace"] == 4
+    assert cli_main(["telemetry-validate", str(d), "--quiet"]) == 0
+
+
+def test_validate_dir_catches_miswired_worker_file(tmp_path):
+    from pydcop_tpu.commands import CliError
+    from pydcop_tpu.commands.telemetry_validate import validate_dir
+
+    d = tmp_path / "tele"
+    d.mkdir()
+    (d / "w0.jsonl").write_text(json.dumps(
+        {"record": "summary", "algo": "maxsum", "mode": "tpu",
+         "status": "FINISHED", "worker_id": "w1"}) + "\n")
+    with pytest.raises(CliError) as err:
+        validate_dir(str(d))
+    assert "mis-wired" in str(err.value)
+    assert "w0.jsonl:1" in str(err.value)
+    # shared (non-emitter-named) files may mix worker ids freely
+    (d / "w0.jsonl").unlink()
+    (d / "shared_out.jsonl").write_text("\n".join(
+        json.dumps({"record": "summary", "algo": "m", "mode": "t",
+                    "status": "FINISHED", "worker_id": w})
+        for w in ("w0", "w1", "router")) + "\n")
+    validate_dir(str(d))
+
+
+def test_validate_dir_catches_dangling_parent_and_ref(tmp_path):
+    from pydcop_tpu.commands import CliError
+    from pydcop_tpu.commands.telemetry_validate import validate_dir
+
+    d = tmp_path / "tele"
+    d.mkdir()
+    recs = _canned_failover_records()
+    # drop the root span record: both its children's parents dangle
+    broken = [r for r in recs if r.get("span_id") != "router:000000"]
+    with open(d / "fleet_out.jsonl", "w") as f:
+        for rec in broken:
+            f.write(json.dumps(rec) + "\n")
+    with pytest.raises(CliError) as err:
+        validate_dir(str(d))
+    assert "does not resolve" in str(err.value)
+    # cross-FILE resolution: the root living in another file heals it
+    with open(d / "router.jsonl", "w") as f:
+        f.write(json.dumps(recs[0]) + "\n")
+    validate_dir(str(d))
+
+
+def test_validate_dir_rejects_empty_directory(tmp_path):
+    from pydcop_tpu.commands import CliError
+    from pydcop_tpu.commands.telemetry_validate import validate_dir
+
+    with pytest.raises(CliError) as err:
+        validate_dir(str(tmp_path))
+    assert "no *.jsonl" in str(err.value)
+
+
+# -------------------------------------------------- serve-status view
+
+
+def test_render_status_build_slo_and_flightrec_sections():
+    from pydcop_tpu.commands.serve_status import render_status
+
+    snap = {
+        "uptime_s": 5.0, "queue_depth": 0, "stats": {},
+        "worker_id": "w0",
+        "build": {"version": "0.9", "jax": "0.4.1",
+                  "backend": "cpu", "schema": "1.11"},
+        "slo": [
+            {"objective": "p99", "kind": "latency_p99",
+             "target": 0.5, "value": 0.1, "ok": True,
+             "burn_rate": 0.2, "budget_remaining": 0.8},
+            {"objective": "errs", "kind": "error_rate",
+             "target": 0.01, "value": 0.5, "ok": False,
+             "burn_rate": 50.0, "budget_remaining": 0.0,
+             "workers": ["w0", "w1"]},
+            {"objective": "cold", "kind": "queue_depth",
+             "target": 8, "value": None, "ok": None,
+             "burn_rate": None, "budget_remaining": None},
+        ],
+        "flightrec": {"path": "/tmp/flightrec-w0.bin",
+                      "capacity": 512, "ring": 17, "events": 123,
+                      "spills": 9, "dumps": 2,
+                      "last_dump_reason": "failover"},
+    }
+    text = render_status(snap)
+    assert "build       pydcop 0.9 | jax 0.4.1 [cpu] | " \
+           "schema 1.11" in text
+    assert "slo (objective: value / target | burn | budget):" in text
+    assert "ok" in text
+    assert "VIOLATED" in text
+    assert "[worst of w0/w1]" in text
+    assert "n/a" in text            # the no-data row
+    assert "123 event(s) recorded" in text
+    assert "(last: failover)" in text
+    assert "/tmp/flightrec-w0.bin" in text
+    # the sections are optional: a pre-11 snapshot renders unchanged
+    bare = render_status({"uptime_s": 1.0, "queue_depth": 0,
+                          "stats": {}})
+    assert "build" not in bare
+    assert "slo" not in bare
+    assert "flightrec" not in bare
+
+
+def test_build_info_metric_and_stats_block(tmp_path):
+    from pydcop_tpu.observability.buildinfo import (build_info,
+                                                    build_info_metric)
+    from pydcop_tpu.observability.registry import MetricsRegistry
+
+    info = build_info()
+    assert set(info) == {"version", "jax", "backend", "schema"}
+    assert all(isinstance(v, str) for v in info.values())
+    assert info["schema"] == \
+        f"{report.SCHEMA_VERSION}.{report.SCHEMA_MINOR}"
+    registry = MetricsRegistry()
+    echoed = build_info_metric(registry)
+    assert echoed == info
+    gauge = registry.get("pydcop_build_info")
+    assert gauge is not None
+    assert gauge.value(**info) == 1.0
+    assert build_info_metric(None) == info   # registry-less: no-op
